@@ -1,0 +1,32 @@
+"""opengemini_tpu — a TPU-native distributed time-series database framework.
+
+A from-scratch rebuild of the capabilities of openGemini (reference:
+/root/reference, an MPP shared-nothing time-series DB in Go) designed
+TPU-first:
+
+- Columnar storage (record format, encodings, TSSP-like immutable files with
+  per-segment pre-aggregation) lives on CPU with fixed-size, padded segments
+  sized for TPU device blocks.
+- The query compute plane (windowed group-by aggregation, PromQL range/instant
+  vector functions) runs on TPU as JAX segment reductions / Pallas kernels.
+- Distribution is jax.sharding/pjit over a device Mesh (ICI/DCN collectives)
+  in place of the reference's custom spdy RPC exchange; CPU-side meta/raft
+  stays on the host control plane.
+
+Package layout (layer map mirrors SURVEY.md §1):
+- ``record/``    L1 columnar record format (lib/record analog)
+- ``encoding/``  L2 encodings & compression (lib/encoding analog)
+- ``storage/``   L3 storage engine: WAL, memtable, immutable TSSP, shard, engine
+- ``index/``     tsi-style inverted series index, bloom filters
+- ``ops/``       TPU kernels: segment window aggregation, prom functions
+- ``query/``     InfluxQL parser, logical plan, optimizer, pipeline executor
+- ``promql/``    PromQL parser + transpiler
+- ``meta/``      catalog: databases, retention policies, shard groups, nodes
+- ``parallel/``  device mesh, sharding, distributed exchange (psum merges)
+- ``services/``  retention, downsample, continuous queries, stream compute
+- ``http/``      InfluxDB-1.x-compatible HTTP API + Prom endpoints
+- ``models/``    flagship end-to-end query pipelines (jittable entry points)
+- ``utils/``     logger, errors, line protocol, misc
+"""
+
+__version__ = "0.1.0"

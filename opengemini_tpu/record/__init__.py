@@ -1,0 +1,2 @@
+from .schema import DataType, Field, Schema, TIME_FIELD
+from .record import ColVal, Record

@@ -1,0 +1,364 @@
+"""Columnar Record / ColVal — the lingua franca of the whole framework.
+
+Analog of the reference's record.Record / record.ColVal
+(/root/reference/lib/record/record.go, /root/reference/lib/record/column.go):
+a batch of rows for one measurement as per-column value buffers plus validity
+bitmaps.
+
+TPU-first design notes:
+- Numeric columns are contiguous numpy arrays (int64/float64/bool) + a bool
+  validity mask; these upload to device with zero copies beyond the DMA.
+- String columns are arrow-style (offsets int32[n+1] + utf-8 byte buffer);
+  they stay host-side. Tag columns are dictionary-encoded upstream.
+- All mutation is append-at-end; records are sorted by time before flush
+  (the reference keeps the same invariant: rows within a record sorted by
+  timestamp; out-of-order data handled one level up by the merge cursors).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .schema import DataType, Field, Schema, TIME_COL_NAME
+
+__all__ = ["ColVal", "Record"]
+
+
+class ColVal:
+    """One column of values + validity.
+
+    - numeric/bool/time: ``values`` numpy array of the schema dtype,
+      ``valid`` bool array of the same length. Invalid slots hold a zero
+      value (never NaN — aggregation kernels rely on masks, not NaN).
+    - string/tag: ``offsets`` int32[n+1] + ``data`` bytes, plus ``valid``.
+    """
+
+    __slots__ = ("type", "values", "valid", "offsets", "data")
+
+    def __init__(self, type_: DataType, values=None, valid=None,
+                 offsets=None, data=b""):
+        self.type = type_
+        if type_.is_numeric:
+            dt = type_.numpy_dtype
+            self.values = (np.asarray(values, dtype=dt) if values is not None
+                           else np.empty(0, dtype=dt))
+            n = len(self.values)
+            self.valid = (np.asarray(valid, dtype=np.bool_) if valid is not None
+                          else np.ones(n, dtype=np.bool_))
+            if len(self.valid) != n:
+                raise ValueError("valid length mismatch")
+            self.offsets = None
+            self.data = b""
+        else:
+            self.offsets = (np.asarray(offsets, dtype=np.int32)
+                            if offsets is not None
+                            else np.zeros(1, dtype=np.int32))
+            self.data = bytes(data)
+            n = len(self.offsets) - 1
+            self.valid = (np.asarray(valid, dtype=np.bool_) if valid is not None
+                          else np.ones(n, dtype=np.bool_))
+            if len(self.valid) != n:
+                raise ValueError("valid length mismatch")
+            self.values = None
+
+    # ---- construction helpers -------------------------------------------
+
+    @classmethod
+    def from_strings(cls, strs: list[str | None],
+                     type_: DataType = DataType.STRING) -> "ColVal":
+        offsets = np.zeros(len(strs) + 1, dtype=np.int32)
+        valid = np.ones(len(strs), dtype=np.bool_)
+        chunks = []
+        pos = 0
+        for i, s in enumerate(strs):
+            if s is None:
+                valid[i] = False
+            else:
+                b = s.encode("utf-8")
+                chunks.append(b)
+                pos += len(b)
+            offsets[i + 1] = pos
+        return cls(type_, valid=valid, offsets=offsets, data=b"".join(chunks))
+
+    # ---- basic info ------------------------------------------------------
+
+    def __len__(self) -> int:
+        if self.values is not None:
+            return len(self.values)
+        return len(self.offsets) - 1
+
+    @property
+    def null_count(self) -> int:
+        return int(len(self.valid) - np.count_nonzero(self.valid))
+
+    def is_string_like(self) -> bool:
+        return self.values is None
+
+    # ---- accessors -------------------------------------------------------
+
+    def get_string(self, i: int) -> str | None:
+        if not self.valid[i]:
+            return None
+        return self.data[self.offsets[i]:self.offsets[i + 1]].decode("utf-8")
+
+    def to_strings(self) -> list[str | None]:
+        return [self.get_string(i) for i in range(len(self))]
+
+    def get(self, i: int):
+        if not self.valid[i]:
+            return None
+        if self.values is not None:
+            v = self.values[i]
+            if self.type == DataType.BOOLEAN:
+                return bool(v)
+            if self.type == DataType.FLOAT:
+                return float(v)
+            return int(v)
+        return self.get_string(i)
+
+    # ---- mutation --------------------------------------------------------
+
+    def append(self, other: "ColVal") -> None:
+        if other.type != self.type:
+            raise ValueError(f"type mismatch: {self.type} vs {other.type}")
+        if self.values is not None:
+            self.values = np.concatenate([self.values, other.values])
+            self.valid = np.concatenate([self.valid, other.valid])
+        else:
+            base = self.offsets[-1]
+            self.offsets = np.concatenate(
+                [self.offsets, other.offsets[1:] + base])
+            self.data = self.data + other.data
+            self.valid = np.concatenate([self.valid, other.valid])
+
+    # ---- slicing / permutation ------------------------------------------
+
+    def slice(self, start: int, stop: int) -> "ColVal":
+        if self.values is not None:
+            return ColVal(self.type, self.values[start:stop],
+                          self.valid[start:stop])
+        offs = self.offsets[start:stop + 1]
+        lo, hi = int(offs[0]), int(offs[-1])
+        return ColVal(self.type, valid=self.valid[start:stop],
+                      offsets=offs - lo, data=self.data[lo:hi])
+
+    def take(self, idx: np.ndarray) -> "ColVal":
+        """Row gather (used for time-sorting and merge)."""
+        if self.values is not None:
+            return ColVal(self.type, self.values[idx], self.valid[idx])
+        lens = (self.offsets[1:] - self.offsets[:-1])[idx]
+        offsets = np.zeros(len(idx) + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        mv = memoryview(self.data)
+        data = b"".join(
+            mv[self.offsets[j]:self.offsets[j + 1]] for j in idx)
+        return ColVal(self.type, valid=self.valid[idx], offsets=offsets,
+                      data=data)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ColVal) or other.type != self.type:
+            return False
+        if not np.array_equal(self.valid, other.valid):
+            return False
+        if self.values is not None:
+            m = self.valid
+            return np.array_equal(self.values[m], other.values[m])
+        return (np.array_equal(self.offsets, other.offsets)
+                and self.data == other.data)
+
+    def __repr__(self) -> str:
+        return f"ColVal({self.type.name}, n={len(self)}, nulls={self.null_count})"
+
+
+class Record:
+    """A columnar batch of rows for one measurement.
+
+    schema: Schema (fields sorted by name, time last)
+    cols:   list[ColVal] aligned with schema
+    """
+
+    __slots__ = ("schema", "cols")
+
+    def __init__(self, schema: Schema, cols: list[ColVal] | None = None):
+        self.schema = schema
+        if cols is None:
+            cols = [_empty_col(f.type) for f in schema]
+        if len(cols) != len(schema):
+            raise ValueError("cols/schema length mismatch")
+        self.cols = cols
+
+    # ---- info ------------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.cols[-1]) if self.cols else 0
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    @property
+    def times(self) -> np.ndarray:
+        ti = self.schema.time_index
+        if ti < 0:
+            raise ValueError("record has no time column")
+        return self.cols[ti].values
+
+    def column(self, name: str) -> ColVal | None:
+        i = self.schema.field_index(name)
+        return self.cols[i] if i >= 0 else None
+
+    @property
+    def min_time(self) -> int:
+        return int(self.times[0]) if self.num_rows else 0
+
+    @property
+    def max_time(self) -> int:
+        return int(self.times[-1]) if self.num_rows else 0
+
+    # ---- transforms ------------------------------------------------------
+
+    def sort_by_time(self, kind: str = "stable") -> "Record":
+        """Return a record sorted by timestamp (stable: preserves write order
+        for duplicate timestamps, matching the reference's dedup semantics)."""
+        t = self.times
+        if len(t) <= 1 or bool(np.all(t[:-1] <= t[1:])):
+            return self
+        idx = np.argsort(t, kind=kind)
+        return Record(self.schema, [c.take(idx) for c in self.cols])
+
+    def slice(self, start: int, stop: int) -> "Record":
+        return Record(self.schema, [c.slice(start, stop) for c in self.cols])
+
+    def take(self, idx: np.ndarray) -> "Record":
+        return Record(self.schema, [c.take(idx) for c in self.cols])
+
+    def append(self, other: "Record") -> None:
+        if other.schema != self.schema:
+            raise ValueError("schema mismatch on append")
+        for c, oc in zip(self.cols, other.cols):
+            c.append(oc)
+
+    def time_slice(self, t_min: int, t_max: int) -> "Record":
+        """Rows with t_min <= time <= t_max; assumes sorted by time."""
+        t = self.times
+        lo = int(np.searchsorted(t, t_min, side="left"))
+        hi = int(np.searchsorted(t, t_max, side="right"))
+        return self.slice(lo, hi)
+
+    # ---- interop ---------------------------------------------------------
+
+    def to_rows(self) -> list[dict]:
+        """Debug/HTTP helper: rows as dicts (None for nulls)."""
+        out = []
+        for i in range(self.num_rows):
+            out.append({f.name: c.get(i)
+                        for f, c in zip(self.schema, self.cols)})
+        return out
+
+    @classmethod
+    def from_columns(cls, schema: Schema, **arrays) -> "Record":
+        """Build from dense numpy arrays / string lists keyed by field name."""
+        cols = []
+        for f in schema:
+            a = arrays.get(f.name)
+            if a is None:
+                raise ValueError(f"missing column {f.name}")
+            if f.type.is_numeric:
+                cols.append(ColVal(f.type, a))
+            else:
+                cols.append(ColVal.from_strings(list(a), f.type))
+        return cls(schema, cols)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Record) and other.schema == self.schema
+                and all(a == b for a, b in zip(self.cols, other.cols)))
+
+    def __repr__(self) -> str:
+        return f"Record({self.schema}, rows={self.num_rows})"
+
+
+def _empty_col(t: DataType) -> ColVal:
+    return ColVal(t)
+
+
+def merge_sorted_records(a: Record, b: Record, dedup: str = "last") -> Record:
+    """Merge two time-sorted records of the same schema into one sorted
+    record, deduplicating identical timestamps field-wise: the later write
+    wins per field, but a null field in the later row does NOT erase an
+    older non-null value (matching the reference's MergeSameTime semantics,
+    /root/reference/lib/record/meger.go; ordered-merge analog of
+    /root/reference/engine/tsm_merge_cursor.go)."""
+    if a.schema != b.schema:
+        raise ValueError("schema mismatch in merge_sorted_records")
+    if a.num_rows == 0:
+        return Record(b.schema, [c.slice(0, len(c)) for c in b.cols])
+    if b.num_rows == 0:
+        return Record(a.schema, [c.slice(0, len(c)) for c in a.cols])
+    ta, tb = a.times, b.times
+    t = np.concatenate([ta, tb])
+    # stable sort with b after a: for equal timestamps, b's rows come later
+    order = np.argsort(t, kind="stable")
+    # build concatenated columns then gather into sorted order
+    cols = []
+    for ca, cb in zip(a.cols, b.cols):
+        cc = ColVal(ca.type, ca.values.copy() if ca.values is not None else None,
+                    ca.valid.copy(),
+                    ca.offsets.copy() if ca.offsets is not None else None,
+                    ca.data)
+        cc.append(cb)
+        cols.append(cc.take(order))
+    rec = Record(a.schema, cols)
+    ts = rec.times
+    if dedup and len(ts) > 1:
+        dup = ts[1:] == ts[:-1]
+        if dup.any():
+            rec = _dedup_same_time(rec, dup, newest_wins=(dedup == "last"))
+    return rec
+
+
+def _dedup_same_time(rec: Record, dup: np.ndarray, newest_wins: bool) -> Record:
+    """Collapse runs of equal timestamps into one row, merging field-wise:
+    among duplicate rows the preferred (newest for last-wins) VALID value is
+    kept per column; nulls never overwrite values."""
+    n = rec.num_rows
+    keep = np.ones(n, dtype=np.bool_)
+    if newest_wins:
+        keep[:-1][dup] = False      # keep last row of each run
+    else:
+        keep[1:][dup] = False       # keep first row of each run
+    keep_idx = np.nonzero(keep)[0]
+    out = rec.take(keep_idx)
+    # field-wise backfill: walk each duplicate run (rare path, python loop ok)
+    ts = rec.times
+    i = 0
+    oi = 0
+    while i < n:
+        j = i
+        while j + 1 < n and ts[j + 1] == ts[i]:
+            j += 1
+        if j > i:  # duplicate run [i..j]
+            rows = range(j, i - 1, -1) if newest_wins else range(i, j + 1)
+            for ci, col in enumerate(rec.cols):
+                ocol = out.cols[ci]
+                if ocol.valid[oi]:
+                    continue
+                for r in rows:
+                    if col.valid[r]:
+                        _copy_cell(col, r, ocol, oi)
+                        break
+        i = j + 1
+        oi += 1
+    return out
+
+
+def _copy_cell(src: ColVal, si: int, dst: ColVal, di: int) -> None:
+    """Copy one valid cell src[si] → dst[di] (numeric only; string columns
+    are rebuilt). Used only on the rare duplicate-timestamp backfill path."""
+    if dst.values is not None:
+        dst.values[di] = src.values[si]
+        dst.valid[di] = True
+    else:
+        strs = dst.to_strings()
+        strs[di] = src.get_string(si)
+        repl = ColVal.from_strings(strs, dst.type)
+        dst.offsets, dst.data, dst.valid = repl.offsets, repl.data, repl.valid

@@ -1,0 +1,120 @@
+"""Schema / field types for the columnar record format.
+
+Analog of the reference's ``lib/record`` field schema (record.Field /
+record.Schemas, /root/reference/lib/record/record.go) and influx field type
+constants. The canonical column ordering convention is preserved: field
+columns sorted by name, with the ``time`` column LAST (the reference relies on
+this invariant throughout the engine).
+
+TPU-first deviations:
+- numeric dtypes are explicit numpy dtypes so columns map 1:1 onto device
+  arrays (int64/float64 natively; the TPU kernel layer may downcast to
+  float32/bfloat16 per query precision mode).
+- tags are dictionary-encoded to int32 ids on CPU before anything reaches the
+  device; strings never go to TPU.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Column data types (reference influx.Field_Type_* constants)."""
+
+    UNKNOWN = 0
+    INTEGER = 1   # int64
+    FLOAT = 2     # float64
+    BOOLEAN = 3
+    STRING = 4
+    TAG = 5       # dictionary-encoded string (tag key column)
+    TIME = 6      # int64 nanoseconds since epoch
+
+    @property
+    def numpy_dtype(self) -> np.dtype | None:
+        return _NUMPY_DTYPES.get(self)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (DataType.INTEGER, DataType.FLOAT, DataType.BOOLEAN,
+                        DataType.TIME)
+
+
+_NUMPY_DTYPES = {
+    DataType.INTEGER: np.dtype(np.int64),
+    DataType.FLOAT: np.dtype(np.float64),
+    DataType.BOOLEAN: np.dtype(np.bool_),
+    DataType.TIME: np.dtype(np.int64),
+}
+
+TIME_COL_NAME = "time"
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    type: DataType
+
+    def __repr__(self) -> str:
+        return f"Field({self.name}:{self.type.name})"
+
+
+TIME_FIELD = Field(TIME_COL_NAME, DataType.TIME)
+
+
+class Schema:
+    """Ordered list of fields; time column last when present.
+
+    Mirrors record.Schemas (/root/reference/lib/record/record.go): sorted
+    field columns + trailing time column. Provides O(1) name lookup.
+    """
+
+    __slots__ = ("fields", "_index")
+
+    def __init__(self, fields: list[Field]):
+        self.fields = list(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        if len(self._index) != len(self.fields):
+            raise ValueError("duplicate field names in schema")
+
+    @classmethod
+    def from_pairs(cls, pairs: list[tuple[str, DataType]],
+                   add_time: bool = True) -> "Schema":
+        """Build a canonical schema: fields sorted by name, time last."""
+        fields = sorted((Field(n, t) for n, t in pairs), key=lambda f: f.name)
+        if add_time:
+            fields.append(TIME_FIELD)
+        return cls(fields)
+
+    def field_index(self, name: str) -> int:
+        return self._index.get(name, -1)
+
+    def field(self, name: str) -> Field | None:
+        i = self._index.get(name)
+        return self.fields[i] if i is not None else None
+
+    @property
+    def has_time(self) -> bool:
+        return bool(self.fields) and self.fields[-1].name == TIME_COL_NAME
+
+    @property
+    def time_index(self) -> int:
+        return len(self.fields) - 1 if self.has_time else -1
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self) -> str:
+        return f"Schema({', '.join(f'{f.name}:{f.type.name}' for f in self.fields)})"

@@ -1,0 +1,2 @@
+from .errors import GeminiError, ErrInvalidLineProtocol, ErrTypeConflict
+from .logger import get_logger

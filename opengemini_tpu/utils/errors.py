@@ -1,0 +1,55 @@
+"""Error types (analog of reference lib/errno — coded errors, but pythonic).
+
+The reference keeps a numeric errno registry (lib/errno/errno.go); here we use
+an exception hierarchy with an optional numeric code for API compatibility.
+"""
+
+
+class GeminiError(Exception):
+    """Base error for opengemini_tpu."""
+
+    code = 0
+
+    def __init__(self, msg: str = "", code: int | None = None):
+        super().__init__(msg or self.__class__.__name__)
+        if code is not None:
+            self.code = code
+
+
+class ErrInvalidLineProtocol(GeminiError):
+    code = 1001
+
+
+class ErrTypeConflict(GeminiError):
+    """Field written with a different type than its schema (reference:
+    engine/mutable/ts_table.go type-conflict checks)."""
+
+    code = 1002
+
+
+class ErrDatabaseNotFound(GeminiError):
+    code = 2001
+
+
+class ErrMeasurementNotFound(GeminiError):
+    code = 2002
+
+
+class ErrRetentionPolicyNotFound(GeminiError):
+    code = 2003
+
+
+class ErrShardNotFound(GeminiError):
+    code = 2004
+
+
+class ErrQueryError(GeminiError):
+    code = 3001
+
+
+class ErrQueryKilled(GeminiError):
+    code = 3002
+
+
+class ErrQueryTimeout(GeminiError):
+    code = 3003

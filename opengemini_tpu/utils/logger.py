@@ -1,0 +1,17 @@
+"""Structured logging (analog of reference lib/logger zap wrapper)."""
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("OPENGEMINI_TPU_LOG", "INFO").upper()
+        logging.basicConfig(level=level, format=_FORMAT, stream=sys.stderr)
+        _configured = True
+    return logging.getLogger(name)

@@ -1,0 +1,24 @@
+"""Test configuration: force a virtual 8-device CPU mesh for sharding tests.
+
+Real TPU hardware in CI is a single chip; multi-chip sharding paths are
+validated on a virtual CPU mesh (xla_force_host_platform_device_count), the
+same trick the driver's dryrun uses.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

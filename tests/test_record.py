@@ -1,0 +1,128 @@
+"""Unit tests for the columnar record core (analog of reference
+lib/record/record_test.go coverage: append, slice, sort, merge, nulls)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.record import ColVal, DataType, Record, Schema
+from opengemini_tpu.record.record import merge_sorted_records
+
+
+def make_schema():
+    return Schema.from_pairs([("usage_user", DataType.FLOAT),
+                              ("count", DataType.INTEGER),
+                              ("up", DataType.BOOLEAN),
+                              ("host", DataType.TAG)])
+
+
+def test_schema_canonical_order():
+    s = make_schema()
+    names = [f.name for f in s]
+    assert names == ["count", "host", "up", "usage_user", "time"]
+    assert s.has_time and s.time_index == 4
+    assert s.field_index("usage_user") == 3
+    assert s.field_index("nope") == -1
+
+
+def test_colval_numeric_nulls():
+    c = ColVal(DataType.FLOAT, [1.0, 2.0, 3.0], [True, False, True])
+    assert len(c) == 3
+    assert c.null_count == 1
+    assert c.get(0) == 1.0
+    assert c.get(1) is None
+
+
+def test_colval_strings_roundtrip():
+    c = ColVal.from_strings(["a", None, "ccc", ""])
+    assert len(c) == 4
+    assert c.to_strings() == ["a", None, "ccc", ""]
+    assert c.null_count == 1
+    s = c.slice(1, 4)
+    assert s.to_strings() == [None, "ccc", ""]
+    g = c.take(np.array([3, 0, 2]))
+    assert g.to_strings() == ["", "a", "ccc"]
+
+
+def test_colval_append():
+    a = ColVal(DataType.INTEGER, [1, 2])
+    b = ColVal(DataType.INTEGER, [3], [False])
+    a.append(b)
+    assert len(a) == 3 and a.get(2) is None
+    s1 = ColVal.from_strings(["x"])
+    s2 = ColVal.from_strings(["yy", None])
+    s1.append(s2)
+    assert s1.to_strings() == ["x", "yy", None]
+
+
+def test_record_sort_and_slice():
+    sch = Schema.from_pairs([("v", DataType.FLOAT), ("host", DataType.TAG)])
+    rec = Record.from_columns(
+        sch, v=np.array([3.0, 1.0, 2.0]),
+        host=["c", "a", "b"], time=np.array([30, 10, 20]))
+    srt = rec.sort_by_time()
+    assert list(srt.times) == [10, 20, 30]
+    assert srt.column("host").to_strings() == ["a", "b", "c"]
+    assert srt.column("v").get(0) == 1.0
+    ts = srt.time_slice(10, 20)
+    assert ts.num_rows == 2
+
+
+def test_merge_sorted_dedup_last_wins():
+    sch = Schema.from_pairs([("v", DataType.FLOAT)])
+    a = Record.from_columns(sch, v=np.array([1.0, 2.0]),
+                            time=np.array([10, 20]))
+    b = Record.from_columns(sch, v=np.array([9.0, 3.0]),
+                            time=np.array([20, 30]))
+    m = merge_sorted_records(a, b)
+    assert list(m.times) == [10, 20, 30]
+    assert m.column("v").get(1) == 9.0  # b wrote t=20 later → wins
+
+
+def test_merge_dedup_null_does_not_erase():
+    sch = Schema.from_pairs([("u", DataType.FLOAT), ("v", DataType.FLOAT)])
+    a = Record(sch, [ColVal(DataType.FLOAT, [1.0], [True]),
+                     ColVal(DataType.FLOAT, [5.0], [True]),
+                     ColVal(DataType.TIME, [20])])
+    b = Record(sch, [ColVal(DataType.FLOAT, [2.0], [True]),
+                     ColVal(DataType.FLOAT, [0.0], [False]),  # v null
+                     ColVal(DataType.TIME, [20])])
+    m = merge_sorted_records(a, b)
+    assert m.num_rows == 1
+    assert m.column("u").get(0) == 2.0  # newer wins
+    assert m.column("v").get(0) == 5.0  # null does not erase older value
+
+
+def test_merge_schema_mismatch_raises():
+    s1 = Schema.from_pairs([("v", DataType.FLOAT)])
+    s2 = Schema.from_pairs([("w", DataType.FLOAT)])
+    import numpy as _np
+    r1 = Record.from_columns(s1, v=_np.array([1.0]), time=_np.array([1]))
+    r2 = Record.from_columns(s2, w=_np.array([1.0]), time=_np.array([1]))
+    with pytest.raises(ValueError):
+        merge_sorted_records(r1, r2)
+
+
+def test_merge_empty_no_aliasing():
+    sch = Schema.from_pairs([("v", DataType.FLOAT)])
+    import numpy as _np
+    b = Record.from_columns(sch, v=_np.array([1.0]), time=_np.array([1]))
+    empty = Record(sch)
+    m = merge_sorted_records(empty, b)
+    m.append(b)  # must not corrupt b
+    assert b.num_rows == 1 and m.num_rows == 2
+
+
+def test_record_to_rows():
+    sch = Schema.from_pairs([("v", DataType.FLOAT), ("host", DataType.TAG)])
+    rec = Record.from_columns(sch, v=np.array([1.5]), host=["h0"],
+                              time=np.array([42]))
+    assert rec.to_rows() == [{"v": 1.5, "host": "h0", "time": 42}]
+
+
+def test_append_schema_mismatch():
+    s1 = Schema.from_pairs([("v", DataType.FLOAT)])
+    s2 = Schema.from_pairs([("w", DataType.FLOAT)])
+    r1 = Record.from_columns(s1, v=np.array([1.0]), time=np.array([1]))
+    r2 = Record.from_columns(s2, w=np.array([1.0]), time=np.array([1]))
+    with pytest.raises(ValueError):
+        r1.append(r2)
