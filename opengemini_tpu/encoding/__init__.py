@@ -1,0 +1,8 @@
+from .blocks import (
+    encode_integer_block, decode_integer_block,
+    encode_float_block, decode_float_block,
+    encode_boolean_block, decode_boolean_block,
+    encode_string_block, decode_string_block,
+    encode_time_block, decode_time_block,
+    encode_validity, decode_validity,
+)

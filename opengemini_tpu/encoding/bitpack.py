@@ -1,0 +1,58 @@
+"""Fixed-width bit packing, fully vectorized with numpy.
+
+Building block for the simple8b and delta codecs. All packing is big-endian
+bit order within the stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_bits(values: np.ndarray, width: int) -> bytes:
+    """Pack uint64 values into a big-endian bitstream of `width` bits each."""
+    n = len(values)
+    if n == 0 or width == 0:
+        return b""
+    v = values.astype(">u8", copy=False)
+    # (n, 64) bit matrix, keep low `width` bits of each value
+    bits = np.unpackbits(v.view(np.uint8).reshape(n, 8), axis=1)[:, 64 - width:]
+    return np.packbits(bits.reshape(-1)).tobytes()
+
+
+def unpack_bits(buf: bytes | memoryview, n: int, width: int) -> np.ndarray:
+    """Inverse of pack_bits: read n values of `width` bits."""
+    if n == 0 or width == 0:
+        return np.zeros(n, dtype=np.uint64)
+    raw = np.frombuffer(buf, dtype=np.uint8, count=(n * width + 7) // 8)
+    bits = np.unpackbits(raw)[: n * width].reshape(n, width)
+    full = np.zeros((n, 64), dtype=np.uint8)
+    full[:, 64 - width:] = bits
+    return np.packbits(full, axis=1).view(">u8").reshape(n).astype(np.uint64)
+
+
+def zigzag_encode(v: np.ndarray) -> np.ndarray:
+    """int64 -> uint64 zigzag (small magnitudes -> small codes)."""
+    v = v.astype(np.int64, copy=False)
+    return ((v.astype(np.uint64) << np.uint64(1))
+            ^ (v >> np.int64(63)).astype(np.uint64))
+
+
+def zigzag_decode(u: np.ndarray) -> np.ndarray:
+    u = u.astype(np.uint64, copy=False)
+    return ((u >> np.uint64(1)).astype(np.int64)
+            ^ -(u & np.uint64(1)).astype(np.int64))
+
+
+def bit_widths(v: np.ndarray) -> np.ndarray:
+    """Number of significant bits per uint64 value (0 -> 0 bits)."""
+    v = v.astype(np.uint64, copy=False)
+    w = np.zeros(len(v), dtype=np.int64)
+    x = v.copy()
+    for shift in (32, 16, 8, 4, 2, 1):
+        hi = x >> np.uint64(shift)
+        mask = hi != 0
+        w[mask] += shift
+        x = np.where(mask, hi, x)
+    w[v != 0] += 1
+    return w

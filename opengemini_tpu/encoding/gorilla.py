@@ -1,0 +1,104 @@
+"""Gorilla XOR float compression (role of reference lib/encoding/float.go:27).
+
+Facebook Gorilla scheme: each float64 XORed with its predecessor; zero XOR
+encoded as a single 0 bit; otherwise '10' + reuse previous leading/trailing
+zero window, or '11' + 5-bit leading-zero count + 6-bit significant-bit count
++ the significant bits.
+
+This is the inherently-sequential codec; the Python implementation operates on
+per-segment blocks and is kept for format parity and cold data. Hot float
+columns default to the vectorized codecs in blocks.py (RLE / zstd-raw), and a
+C++ implementation can replace this hot loop behind the same byte format.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _BitWriter:
+    __slots__ = ("buf", "acc", "nbits")
+
+    def __init__(self):
+        self.buf = bytearray()
+        self.acc = 0
+        self.nbits = 0
+
+    def write(self, value: int, bits: int):
+        self.acc = (self.acc << bits) | (value & ((1 << bits) - 1))
+        self.nbits += bits
+        while self.nbits >= 8:
+            self.nbits -= 8
+            self.buf.append((self.acc >> self.nbits) & 0xFF)
+        self.acc &= (1 << self.nbits) - 1
+
+    def finish(self) -> bytes:
+        if self.nbits:
+            self.buf.append((self.acc << (8 - self.nbits)) & 0xFF)
+            self.acc = 0
+            self.nbits = 0
+        return bytes(self.buf)
+
+
+class _BitReader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = int.from_bytes(data, "big")
+        self.pos = len(data) * 8
+
+    def read(self, bits: int) -> int:
+        self.pos -= bits
+        return (self.data >> self.pos) & ((1 << bits) - 1)
+
+
+def encode(values: np.ndarray) -> bytes:
+    """Encode float64 array; first value stored raw (64 bits)."""
+    u = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    w = _BitWriter()
+    if len(u) == 0:
+        return b""
+    prev = int(u[0])
+    w.write(prev, 64)
+    lead, sig = -1, -1  # current window (invalid)
+    xors = (u[1:] ^ u[:-1]).tolist()
+    for x in xors:
+        if x == 0:
+            w.write(0, 1)
+            continue
+        xl = 64 - x.bit_length()      # leading zeros
+        xt = (x & -x).bit_length() - 1  # trailing zeros
+        if xl > 31:
+            xl = 31
+        if (lead >= 0 and xl >= lead and xt >= 64 - lead - sig):
+            w.write(0b10, 2)
+            w.write(x >> (64 - lead - sig), sig)
+        else:
+            lead = xl
+            sig = 64 - xl - xt
+            w.write(0b11, 2)
+            w.write(lead, 5)
+            w.write(sig - 1, 6)
+            w.write(x >> xt, sig)
+    return w.finish()
+
+
+def decode(buf: bytes, n: int) -> np.ndarray:
+    if n == 0:
+        return np.zeros(0, dtype=np.float64)
+    r = _BitReader(bytes(buf))
+    out = np.empty(n, dtype=np.uint64)
+    prev = r.read(64)
+    out[0] = prev
+    lead = sig = 0
+    for i in range(1, n):
+        if r.read(1) == 0:
+            out[i] = prev
+            continue
+        if r.read(1) == 1:
+            lead = r.read(5)
+            sig = r.read(6) + 1
+        bits = r.read(sig)
+        prev ^= bits << (64 - lead - sig)
+        out[i] = prev
+    return out.view(np.float64)

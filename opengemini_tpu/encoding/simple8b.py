@@ -1,0 +1,117 @@
+"""Simple8b integer packing (own format, role of reference lib/encoding/int.go).
+
+64-bit words: 4-bit selector + 60-bit payload. Selector table (count, width):
+  0:(240,0) 1:(120,0) 2:(60,1) 3:(30,2) 4:(20,3) 5:(15,4) 6:(12,5) 7:(10,6)
+  8:(8,7) 9:(7,8) 10:(6,10) 11:(5,12) 12:(4,15) 13:(3,20) 14:(2,30) 15:(1,60)
+Selectors 0/1 encode runs of zeros. Values must be < 2^60; callers fall back
+to a raw codec otherwise (the reference likewise falls back to zstd,
+/root/reference/lib/encoding/int.go:21-24).
+
+Encode: greedy longest-fit per word. Feasibility per selector is precomputed
+with vectorized sliding-window maxima; the python loop runs once per OUTPUT
+word, and payload packing is vectorized per selector class. Designed for
+per-segment blocks (<= a few thousand values), where this is plenty fast.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .bitpack import bit_widths
+
+# selector -> (count, width)
+SELECTORS = [(240, 0), (120, 0), (60, 1), (30, 2), (20, 3), (15, 4),
+             (12, 5), (10, 6), (8, 7), (7, 8), (6, 10), (5, 12),
+             (4, 15), (3, 20), (2, 30), (1, 60)]
+
+MAX_VALUE = (1 << 60) - 1
+
+
+def can_encode(values: np.ndarray) -> bool:
+    if len(values) == 0:
+        return True
+    return int(values.astype(np.uint64, copy=False).max()) <= MAX_VALUE
+
+
+def encode(values: np.ndarray) -> bytes:
+    """Pack uint64 values (< 2^60) into simple8b words."""
+    v = values.astype(np.uint64, copy=False)
+    n = len(v)
+    if n == 0:
+        return b""
+    widths = bit_widths(v)
+    if int(widths.max()) > 60:
+        raise ValueError("simple8b: value exceeds 60 bits")
+
+    # runlen[i] = how many consecutive values starting at i fit in `width`
+    # bits; we precompute, per selector, whether a full word fits at i.
+    fits = {}
+    for sel, (count, width) in enumerate(SELECTORS):
+        ok = widths <= width if width else (v == 0)
+        if count == 1:
+            fits[sel] = ok
+        else:
+            # fits[sel][i] == True iff ok[i..i+count-1] all true and in range
+            c = np.cumsum(np.concatenate([[0], ok.astype(np.int64)]))
+            m = np.zeros(n, dtype=np.bool_)
+            last = n - count
+            if last >= 0:
+                m[: last + 1] = (c[count:] - c[:-count]) == count
+            fits[sel] = m
+
+    # greedy: pick the selector with the largest count that fits
+    sel_of_word = []
+    start_of_word = []
+    i = 0
+    while i < n:
+        # selector 15 (count=1, width=60) always fits, so this always breaks
+        for sel, (count, width) in enumerate(SELECTORS):
+            if i + count <= n and fits[sel][i]:
+                sel_of_word.append(sel)
+                start_of_word.append(i)
+                i += count
+                break
+
+    sels = np.array(sel_of_word, dtype=np.int64)
+    starts = np.array(start_of_word, dtype=np.int64)
+    words = np.zeros(len(sels), dtype=np.uint64)
+    # vectorized payload packing per selector class
+    for sel in np.unique(sels):
+        count, width = SELECTORS[sel]
+        idx = np.nonzero(sels == sel)[0]
+        words[idx] |= np.uint64(sel) << np.uint64(60)
+        if width == 0:
+            continue
+        # gather (nwords, count) value matrix; zero-pad past-the-end slots
+        pos = starts[idx][:, None] + np.arange(count)[None, :]
+        vals = v[np.minimum(pos, n - 1)]
+        vals[pos >= n] = 0
+        shifts = (np.uint64(width) * np.arange(count - 1, -1, -1)
+                  .astype(np.uint64))
+        words[idx] |= np.bitwise_or.reduce(vals << shifts[None, :], axis=1)
+    return words.astype(">u8").tobytes()
+
+
+def decode(buf: bytes | memoryview, n: int) -> np.ndarray:
+    """Unpack n uint64 values from simple8b words."""
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    words = np.frombuffer(buf, dtype=">u8").astype(np.uint64)
+    sels = (words >> np.uint64(60)).astype(np.int64)
+    counts = np.array([c for c, _ in SELECTORS], dtype=np.int64)[sels]
+    ends = np.cumsum(counts)
+    total = int(ends[-1])
+    out = np.zeros(total, dtype=np.uint64)
+    offs = ends - counts
+    for sel in np.unique(sels):
+        count, width = SELECTORS[sel]
+        idx = np.nonzero(sels == sel)[0]
+        if width == 0:
+            continue  # zeros already in place
+        shifts = (np.uint64(width) * np.arange(count - 1, -1, -1)
+                  .astype(np.uint64))
+        mask = np.uint64((1 << width) - 1)
+        vals = (words[idx][:, None] >> shifts[None, :]) & mask  # (nw, count)
+        pos = offs[idx][:, None] + np.arange(count)[None, :]
+        out[pos.reshape(-1)] = vals.reshape(-1)
+    return out[:n]

@@ -185,6 +185,13 @@ class Record:
             cols = [_empty_col(f.type) for f in schema]
         if len(cols) != len(schema):
             raise ValueError("cols/schema length mismatch")
+        if cols:
+            n = len(cols[0])
+            for f, c in zip(schema, cols):
+                if len(c) != n:
+                    raise ValueError(
+                        f"column length mismatch: {f.name} has {len(c)} "
+                        f"rows, expected {n}")
         self.cols = cols
 
     # ---- info ------------------------------------------------------------
@@ -222,7 +229,10 @@ class Record:
         for duplicate timestamps, matching the reference's dedup semantics)."""
         t = self.times
         if len(t) <= 1 or bool(np.all(t[:-1] <= t[1:])):
-            return self
+            # fresh ColVal wrappers so the result never aliases self's
+            # mutable column objects (consistent ownership either way)
+            return Record(self.schema,
+                          [c.slice(0, len(c)) for c in self.cols])
         idx = np.argsort(t, kind=kind)
         return Record(self.schema, [c.take(idx) for c in self.cols])
 
@@ -301,10 +311,8 @@ def merge_sorted_records(a: Record, b: Record, dedup: str = "last") -> Record:
     # build concatenated columns then gather into sorted order
     cols = []
     for ca, cb in zip(a.cols, b.cols):
-        cc = ColVal(ca.type, ca.values.copy() if ca.values is not None else None,
-                    ca.valid.copy(),
-                    ca.offsets.copy() if ca.offsets is not None else None,
-                    ca.data)
+        # append() replaces buffers via concatenate, so no defensive copies
+        cc = ColVal(ca.type, ca.values, ca.valid, ca.offsets, ca.data)
         cc.append(cb)
         cols.append(cc.take(order))
     rec = Record(a.schema, cols)
