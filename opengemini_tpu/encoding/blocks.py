@@ -50,11 +50,22 @@ def _zstd_c(b: bytes) -> bytes:
     return c.compress(b)
 
 
-def _zstd_d(b, n: int) -> bytes:
+# cap on a single decompressed block: segments are <=64k values of 8 bytes
+# plus headers, so anything claiming more is corrupt or hostile
+_MAX_BLOCK_BYTES = 64 * 1024 * 1024
+
+
+def _zstd_d(b) -> bytes:
     d = getattr(_tls, "zd", None)
     if d is None:
         d = _tls.zd = zstandard.ZstdDecompressor()
-    return d.decompress(bytes(b), max_output_size=max(n, 1) * 16 + 1024)
+    b = bytes(b)
+    params = zstandard.get_frame_parameters(b)
+    if params.content_size and params.content_size > _MAX_BLOCK_BYTES:
+        raise ValueError(
+            f"zstd block declares {params.content_size} bytes "
+            f"(> {_MAX_BLOCK_BYTES} cap); refusing to decompress")
+    return d.decompress(b, max_output_size=_MAX_BLOCK_BYTES)
 
 
 # ---------------------------------------------------------------- integers
@@ -91,7 +102,7 @@ def decode_integer_block(buf: bytes | memoryview, n: int) -> np.ndarray:
     if codec == RAW:
         return np.frombuffer(payload, dtype=np.int64, count=n).copy()
     if codec == ZSTD:
-        return np.frombuffer(_zstd_d(payload, n * 8), dtype=np.int64,
+        return np.frombuffer(_zstd_d(payload), dtype=np.int64,
                              count=n).copy()
     if codec == CONST:
         return np.full(n, struct.unpack("<q", payload[:8])[0], dtype=np.int64)
@@ -137,7 +148,7 @@ def decode_float_block(buf: bytes | memoryview, n: int) -> np.ndarray:
     if codec == RAW:
         return np.frombuffer(payload, dtype=np.float64, count=n).copy()
     if codec == ZSTD:
-        return np.frombuffer(_zstd_d(payload, n * 8), dtype=np.float64,
+        return np.frombuffer(_zstd_d(payload), dtype=np.float64,
                              count=n).copy()
     if codec == CONST:
         return np.full(n, np.frombuffer(payload[:8], dtype=np.float64)[0])
@@ -183,7 +194,7 @@ def encode_string_block(offsets: np.ndarray, data: bytes) -> bytes:
 def decode_string_block(buf: bytes | memoryview) -> tuple[np.ndarray, bytes]:
     codec, payload = buf[0], memoryview(buf)[1:]
     if codec == ZSTD:
-        payload = memoryview(_zstd_d(payload, len(payload) * 8))
+        payload = memoryview(_zstd_d(payload))
     elif codec != RAW:
         raise ValueError(f"bad string codec {codec}")
     n = struct.unpack("<I", payload[:4])[0]

@@ -41,15 +41,26 @@ class _BitWriter:
 
 
 class _BitReader:
-    __slots__ = ("data", "pos")
+    """Incremental big-endian bit reader (O(n) overall; a whole-buffer
+    Python-int shift would be O(n^2))."""
+
+    __slots__ = ("data", "byte_pos", "acc", "nbits")
 
     def __init__(self, data: bytes):
-        self.data = int.from_bytes(data, "big")
-        self.pos = len(data) * 8
+        self.data = data
+        self.byte_pos = 0
+        self.acc = 0
+        self.nbits = 0
 
     def read(self, bits: int) -> int:
-        self.pos -= bits
-        return (self.data >> self.pos) & ((1 << bits) - 1)
+        while self.nbits < bits:
+            self.acc = (self.acc << 8) | self.data[self.byte_pos]
+            self.byte_pos += 1
+            self.nbits += 8
+        self.nbits -= bits
+        out = (self.acc >> self.nbits) & ((1 << bits) - 1)
+        self.acc &= (1 << self.nbits) - 1
+        return out
 
 
 def encode(values: np.ndarray) -> bytes:

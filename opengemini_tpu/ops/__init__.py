@@ -1,0 +1,20 @@
+"""TPU compute plane: windowed group-by aggregation kernels.
+
+This package is the device-side replacement for the reference's store-side
+aggregation hot path (engine/series_agg_func.gen.go, engine/aggregate_cursor.go,
+engine/agg_tagset_cursor.go — SURVEY.md §2.2): instead of streaming per-window
+reducers over Go records, decoded column blocks become device arrays and
+(tagset, window) pairs become segment ids for fused segment reductions.
+
+Precision: the reference is float64 throughout; x64 is enabled here so the
+"exact" path matches CPU float64 semantics. Queries may opt into float32
+fast mode per-call.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .segment_agg import (  # noqa: E402
+    AggSpec, SegmentAggResult, segment_aggregate, window_ids,
+    dense_window_aggregate, pad_bucket)

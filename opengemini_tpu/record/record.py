@@ -229,10 +229,10 @@ class Record:
         for duplicate timestamps, matching the reference's dedup semantics)."""
         t = self.times
         if len(t) <= 1 or bool(np.all(t[:-1] <= t[1:])):
-            # fresh ColVal wrappers so the result never aliases self's
-            # mutable column objects (consistent ownership either way)
-            return Record(self.schema,
-                          [c.slice(0, len(c)) for c in self.cols])
+            # deep-copy buffers so both paths hand back fully independent
+            # records (the take() branch below already copies via fancy
+            # indexing)
+            return Record(self.schema, [_copy_col(c) for c in self.cols])
         idx = np.argsort(t, kind=kind)
         return Record(self.schema, [c.take(idx) for c in self.cols])
 
@@ -289,6 +289,13 @@ class Record:
 
 def _empty_col(t: DataType) -> ColVal:
     return ColVal(t)
+
+
+def _copy_col(c: ColVal) -> ColVal:
+    if c.values is not None:
+        return ColVal(c.type, c.values.copy(), c.valid.copy())
+    return ColVal(c.type, valid=c.valid.copy(), offsets=c.offsets.copy(),
+                  data=c.data)
 
 
 def merge_sorted_records(a: Record, b: Record, dedup: str = "last") -> Record:

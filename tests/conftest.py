@@ -7,11 +7,18 @@ same trick the driver's dryrun uses.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The box presets JAX_PLATFORMS=axon (real TPU) and the axon plugin overrides
+# the env var, so force CPU via jax.config (unit tests need determinism —
+# axon emulates float64 as float32 pairs, ~1e-15 representation error — plus
+# the 8-device virtual mesh).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

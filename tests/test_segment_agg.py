@@ -1,0 +1,144 @@
+"""TPU kernel tests: numeric parity of segment aggregation vs a plain numpy
+reference (the framework's analog of the reference's generated-kernel tests,
+engine/series_agg_func and aggregate_cursor tests)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import (AggSpec, dense_window_aggregate, pad_bucket,
+                                segment_aggregate, window_ids)
+from opengemini_tpu.ops.segment_agg import merge_seg_results, pad_rows
+
+rng = np.random.default_rng(7)
+
+
+def numpy_reference(values, valid, seg_ids, times, num_segments):
+    """Straight-line float64 reference aggregation (time-ordered)."""
+    out = {k: np.zeros(num_segments) for k in ("sum", "first", "last")}
+    out["count"] = np.zeros(num_segments, dtype=np.int64)
+    out["min"] = np.full(num_segments, np.inf)
+    out["max"] = np.full(num_segments, -np.inf)
+    out["first"][:] = np.nan
+    out["last"][:] = np.nan
+    first_t = np.full(num_segments, np.iinfo(np.int64).max)
+    last_t = np.full(num_segments, np.iinfo(np.int64).min)
+    for i in range(len(values)):
+        s = seg_ids[i]
+        if not valid[i] or s >= num_segments:
+            continue
+        out["count"][s] += 1
+        out["sum"][s] += values[i]
+        out["min"][s] = min(out["min"][s], values[i])
+        out["max"][s] = max(out["max"][s], values[i])
+        if times[i] < first_t[s]:
+            first_t[s] = times[i]
+            out["first"][s] = values[i]
+        if times[i] >= last_t[s]:
+            last_t[s] = times[i]
+            out["last"][s] = values[i]
+    return out
+
+
+def make_case(n=5000, groups=7, windows=11, null_frac=0.1):
+    seg = np.sort(rng.integers(0, groups * windows, n)).astype(np.int64)
+    vals = rng.normal(50, 10, n)
+    valid = rng.random(n) > null_frac
+    times = np.arange(n, dtype=np.int64) * 1000  # increasing within segments
+    return vals, valid, seg, times, groups * windows
+
+
+def test_sparse_matches_numpy_reference():
+    vals, valid, seg, times, ns = make_case()
+    spec = AggSpec.of("count", "sum", "min", "max", "first", "last")
+    res = segment_aggregate(vals, valid, seg, times, ns, spec)
+    ref = numpy_reference(vals, valid, seg, times, ns)
+    assert np.array_equal(np.asarray(res.count), ref["count"])
+    # float64 sums: reduction order differs (tree vs sequential) → exact to
+    # ~1 ulp per step; min/max/first/last are order-free and bit-exact
+    np.testing.assert_allclose(np.asarray(res.sum), ref["sum"], rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(res.min), ref["min"])
+    np.testing.assert_array_equal(np.asarray(res.max), ref["max"])
+    np.testing.assert_array_equal(np.asarray(res.first), ref["first"])
+    np.testing.assert_array_equal(np.asarray(res.last), ref["last"])
+
+
+def test_sparse_with_padding_trash_segment():
+    vals, valid, seg, times, ns = make_case(n=1000)
+    npad = pad_bucket(1000)
+    assert npad == 1024
+    seg_p, vals_p, valid_p, times_p = pad_rows(
+        [seg, vals, valid, times], npad, seg_fill=ns)
+    res = segment_aggregate(vals_p, valid_p, seg_p, times_p, ns,
+                            AggSpec.of("count", "sum"))
+    ref = numpy_reference(vals, valid, seg, times, ns)
+    assert np.array_equal(np.asarray(res.count), ref["count"])
+    np.testing.assert_allclose(np.asarray(res.sum), ref["sum"], rtol=1e-12)
+
+
+def test_mean_and_empty_segments():
+    # segment 3 gets no valid data
+    vals = np.array([2.0, 4.0, 100.0])
+    valid = np.array([True, True, False])
+    seg = np.array([0, 0, 3])
+    res = segment_aggregate(vals, valid, seg, None, 5, AggSpec.of("mean"))
+    mean = np.asarray(res.mean())
+    assert mean[0] == 3.0
+    assert np.asarray(res.count)[3] == 0
+
+
+def test_dense_matches_sparse():
+    G, W, P = 13, 4, 32
+    vals = rng.normal(0, 1, (G * W, P))
+    valid = rng.random((G * W, P)) > 0.2
+    times = np.arange(G * W * P, dtype=np.int64).reshape(G * W, P)
+    spec = AggSpec.of("count", "sum", "min", "max", "first", "last")
+    dres = dense_window_aggregate(vals, valid, times, spec)
+    sres = segment_aggregate(
+        vals.reshape(-1), valid.reshape(-1),
+        np.repeat(np.arange(G * W), P), times.reshape(-1), G * W, spec)
+    for f in ("count", "min", "max", "first", "last"):
+        np.testing.assert_array_equal(np.asarray(getattr(dres, f)),
+                                      np.asarray(getattr(sres, f)),
+                                      err_msg=f)
+    np.testing.assert_allclose(np.asarray(dres.sum), np.asarray(sres.sum),
+                               rtol=1e-12)
+
+
+def test_window_ids():
+    t = np.array([0, 999, 1000, 5999, 6000, -5], dtype=np.int64)
+    w = np.asarray(window_ids(t, 0, 1000, 6))
+    assert list(w) == [0, 0, 1, 5, 6, 6]  # 6000 and -5 → trash window 6
+
+
+def test_merge_partial_states():
+    vals, valid, seg, times, ns = make_case(n=4000)
+    spec = AggSpec.of("count", "sum", "min", "max", "first", "last")
+    half = 2000
+    r1 = segment_aggregate(vals[:half], valid[:half], seg[:half],
+                           times[:half], ns, spec)
+    r2 = segment_aggregate(vals[half:], valid[half:], seg[half:],
+                           times[half:], ns, spec)
+    merged = merge_seg_results(r1, r2)
+    ref = numpy_reference(vals, valid, seg, times, ns)
+    assert np.array_equal(np.asarray(merged.count), ref["count"])
+    np.testing.assert_allclose(np.asarray(merged.sum), ref["sum"], rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(merged.min), ref["min"])
+    np.testing.assert_array_equal(np.asarray(merged.max), ref["max"])
+    np.testing.assert_array_equal(np.asarray(merged.first), ref["first"])
+    np.testing.assert_array_equal(np.asarray(merged.last), ref["last"])
+
+
+def test_float64_precision_is_used():
+    # catastrophic in f32 (1e8 + 1 == 1e8), exact in f64
+    vals = np.array([1e8, 1.0, -1e8])
+    res = segment_aggregate(vals, np.ones(3, bool), np.zeros(3, np.int64),
+                            None, 1, AggSpec.of("sum"))
+    assert np.asarray(res.sum)[0] == 1.0
+
+
+def test_pad_bucket_tiers():
+    assert pad_bucket(5) == 1024
+    assert pad_bucket(1500) == 2048
+    assert pad_bucket(65536) == 65536
+    assert pad_bucket(65537) == 131072
+    assert pad_bucket(200_000) == 262144
