@@ -1,0 +1,1 @@
+from .tsi import SeriesIndex, TagFilter

@@ -1,0 +1,219 @@
+"""Inverted series index (role of the reference's tsi MergeSetIndex,
+engine/index/tsi/mergeset_index.go:261 over lib/util/lifted/vm/mergeset).
+
+Maps measurement → tag key → tag value → posting list of series ids, plus
+sid → (measurement, tags) reverse lookup for group-by. The reference builds
+this on a mergeset LSM; here the working set is dict/numpy-based in memory
+with an append-only persistence log (replayed on open) — series creation is
+rare relative to writes, and posting lists stay as sorted int64 arrays that
+feed straight into the TPU group-lut construction.
+
+Series ids are sequential per index (1-based), so a query's sid→group lookup
+table is a dense numpy array — the device gather for group assignment is a
+single vectorized indexing op.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass(frozen=True)
+class TagFilter:
+    """One tag predicate: key op value (op: '=', '!=', '=~', '!~')."""
+    key: str
+    value: str
+    op: str = "="
+
+
+def series_key(measurement: str, tags: dict[str, str]) -> str:
+    return measurement + "," + ",".join(
+        f"{k}={tags[k]}" for k in sorted(tags))
+
+
+class SeriesIndex:
+    """Per-shard (or per-partition) series index."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._key_to_sid: dict[str, int] = {}
+        self._sid_to_tags: list[dict[str, str] | None] = [None]  # 1-based
+        self._sid_to_mst: list[str | None] = [None]
+        self._mst_sids: dict[str, list[int]] = {}
+        self._postings: dict[tuple[str, str, str], list[int]] = {}
+        self._log = None
+        if path:
+            if os.path.exists(path):
+                self._replay()
+            self._log = open(path, "ab")
+
+    # ---- persistence -----------------------------------------------------
+
+    def _append_log(self, measurement: str, tags: dict[str, str],
+                    sid: int) -> None:
+        if self._log is None:
+            return
+        items = [measurement.encode()] + [
+            f"{k}={v}".encode() for k, v in sorted(tags.items())]
+        payload = b"\x00".join(items)
+        self._log.write(struct.pack("<IQ", len(payload), sid) + payload)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.flush()
+                os.fsync(self._log.fileno())
+
+    def _replay(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        hdr = struct.calcsize("<IQ")
+        while pos + hdr <= len(data):
+            ln, sid = struct.unpack_from("<IQ", data, pos)
+            pos += hdr
+            if pos + ln > len(data):
+                log.warning("series log truncated at %d; ignoring tail", pos)
+                break
+            items = bytes(data[pos:pos + ln]).split(b"\x00")
+            pos += ln
+            measurement = items[0].decode()
+            tags = dict(i.decode().split("=", 1) for i in items[1:])
+            self._insert(measurement, tags, sid)
+
+    # ---- writes ----------------------------------------------------------
+
+    def _insert(self, measurement: str, tags: dict[str, str],
+                sid: int) -> None:
+        key = series_key(measurement, tags)
+        self._key_to_sid[key] = sid
+        while len(self._sid_to_tags) <= sid:
+            self._sid_to_tags.append(None)
+            self._sid_to_mst.append(None)
+        self._sid_to_tags[sid] = tags
+        self._sid_to_mst[sid] = measurement
+        self._mst_sids.setdefault(measurement, []).append(sid)
+        for k, v in tags.items():
+            self._postings.setdefault((measurement, k, v), []).append(sid)
+
+    def get_or_create_sid(self, measurement: str,
+                          tags: dict[str, str]) -> int:
+        key = series_key(measurement, tags)
+        with self._lock:
+            sid = self._key_to_sid.get(key)
+            if sid is not None:
+                return sid
+            sid = len(self._sid_to_tags)
+            self._insert(measurement, tags, sid)
+            self._append_log(measurement, tags, sid)
+            return sid
+
+    def get_sid(self, measurement: str, tags: dict[str, str]) -> int | None:
+        return self._key_to_sid.get(series_key(measurement, tags))
+
+    # ---- queries ---------------------------------------------------------
+
+    @property
+    def series_cardinality(self) -> int:
+        return len(self._key_to_sid)
+
+    @property
+    def max_sid(self) -> int:
+        return len(self._sid_to_tags) - 1
+
+    def measurements(self) -> list[str]:
+        return sorted(self._mst_sids)
+
+    def tags_of(self, sid: int) -> dict[str, str]:
+        return self._sid_to_tags[sid] or {}
+
+    def tag_values(self, measurement: str, key: str) -> list[str]:
+        return sorted({v for (m, k, v) in self._postings
+                       if m == measurement and k == key})
+
+    def tag_keys(self, measurement: str) -> list[str]:
+        return sorted({k for (m, k, _v) in self._postings
+                       if m == measurement})
+
+    def series_ids(self, measurement: str,
+                   filters: list[TagFilter] | None = None) -> np.ndarray:
+        """AND of tag predicates → sorted sid array (the reference's
+        tag_filters.go search, simplified to the supported ops)."""
+        import re
+        with self._lock:
+            base = self._mst_sids.get(measurement)
+            if not base:
+                return np.empty(0, dtype=np.int64)
+            result: set[int] | None = None
+            negatives: list[TagFilter] = []
+            for f in filters or []:
+                if f.op in ("!=", "!~"):
+                    negatives.append(f)
+                    continue
+                if f.op == "=":
+                    sids = set(self._postings.get(
+                        (measurement, f.key, f.value), ()))
+                elif f.op == "=~":
+                    rx = re.compile(f.value)
+                    sids = set()
+                    for (m, k, v), lst in self._postings.items():
+                        if m == measurement and k == f.key and rx.search(v):
+                            sids.update(lst)
+                else:
+                    raise ValueError(f"bad tag filter op {f.op}")
+                result = sids if result is None else (result & sids)
+            if result is None:
+                result = set(base)
+            for f in negatives:
+                if f.op == "!=":
+                    result -= set(self._postings.get(
+                        (measurement, f.key, f.value), ()))
+                else:
+                    rx = re.compile(f.value)
+                    for (m, k, v), lst in self._postings.items():
+                        if m == measurement and k == f.key and rx.search(v):
+                            result -= set(lst)
+            return np.array(sorted(result), dtype=np.int64)
+
+    def group_by_tagsets(self, measurement: str,
+                         group_keys: list[str],
+                         filters: list[TagFilter] | None = None
+                         ) -> list[tuple[tuple[str, ...], np.ndarray]]:
+        """Partition matching series into tagsets by group_keys (the
+        reference's tagset construction, engine/iterators.go:100 'Scan →
+        tagsets'). Returns [(tag values tuple, sorted sid array)], sorted by
+        tag values; series missing a group key get '' for it."""
+        sids = self.series_ids(measurement, filters)
+        groups: dict[tuple[str, ...], list[int]] = {}
+        for sid in sids.tolist():
+            tags = self._sid_to_tags[sid] or {}
+            key = tuple(tags.get(k, "") for k in group_keys)
+            groups.setdefault(key, []).append(sid)
+        return [(k, np.array(v, dtype=np.int64))
+                for k, v in sorted(groups.items())]
+
+    def group_lut(self, tagsets: list[tuple[tuple[str, ...], np.ndarray]]
+                  ) -> np.ndarray:
+        """Dense sid → group-index lookup table for the device kernels;
+        unmatched sids map to -1."""
+        lut = np.full(self.max_sid + 1, -1, dtype=np.int64)
+        for gi, (_k, sids) in enumerate(tagsets):
+            lut[sids] = gi
+        return lut
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.flush()
+                self._log.close()
+                self._log = None

@@ -1,2 +1,2 @@
 from .schema import DataType, Field, Schema, TIME_FIELD
-from .record import ColVal, Record
+from .record import ColVal, Record, merge_sorted_records

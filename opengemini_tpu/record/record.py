@@ -64,6 +64,15 @@ class ColVal:
     # ---- construction helpers -------------------------------------------
 
     @classmethod
+    def nulls(cls, type_: DataType, n: int) -> "ColVal":
+        """All-null column of length n (invalid slots hold zero, never NaN)."""
+        if type_.is_numeric:
+            return cls(type_, np.zeros(n, type_.numpy_dtype),
+                       np.zeros(n, np.bool_))
+        return cls(type_, valid=np.zeros(n, np.bool_),
+                   offsets=np.zeros(n + 1, np.int32), data=b"")
+
+    @classmethod
     def from_strings(cls, strs: list[str | None],
                      type_: DataType = DataType.STRING) -> "ColVal":
         offsets = np.zeros(len(strs) + 1, dtype=np.int32)

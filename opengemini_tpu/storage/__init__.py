@@ -1,0 +1,7 @@
+from .tssp import (SEGMENT_SIZE, ColumnMeta, ChunkMeta, PreAgg, Segment,
+                   TSSPReader, TSSPWriter)
+from .rows import PointRow
+from .memtable import MemTable, MemTables
+from .wal import WAL
+from .shard import Shard
+from .engine import Engine, EngineOptions

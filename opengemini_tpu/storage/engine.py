@@ -1,0 +1,182 @@
+"""Storage engine: databases → retention policies → time-partitioned shard
+groups → shards (role of reference engine/engine.go:74 Engine →
+DBPTInfo → Shard, plus the meta shard-group model from
+lib/util/lifted/influx/meta/shardinfo.go).
+
+Single-node scope: one partition per database; shard groups cut by time
+duration (time partitioning = the framework's first distribution axis,
+SURVEY §2.6.1). Multi-partition hash distribution lives in parallel/.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index import TagFilter
+from ..record import Record
+from ..utils import get_logger
+from ..utils.errors import ErrDatabaseNotFound
+from .rows import PointRow
+from .shard import Shard
+from .tssp import SEGMENT_SIZE
+
+log = get_logger(__name__)
+
+NS_PER_HOUR = 3600 * 10**9
+DEFAULT_SHARD_DURATION = 24 * 7 * NS_PER_HOUR  # 7d, influx default for inf RP
+
+
+@dataclass
+class EngineOptions:
+    shard_duration: int = DEFAULT_SHARD_DURATION
+    flush_bytes: int = 256 * 1024 * 1024
+    wal_sync: bool = False
+    segment_size: int = SEGMENT_SIZE
+
+
+class Database:
+    def __init__(self, name: str, path: str, opts: EngineOptions):
+        self.name = name
+        self.path = path
+        self.opts = opts
+        self.shards: dict[int, Shard] = {}  # key: shard-group index
+        self._lock = threading.RLock()
+        os.makedirs(path, exist_ok=True)
+        self._load()
+
+    def _load(self) -> None:
+        for fn in sorted(os.listdir(self.path)):
+            m = re.fullmatch(r"shard_(-?\d+)", fn)
+            if m:
+                gi = int(m.group(1))
+                self.shards[gi] = self._open_shard(gi)
+
+    def _open_shard(self, gi: int) -> Shard:
+        sd = self.opts.shard_duration
+        return Shard(os.path.join(self.path, f"shard_{gi}"),
+                     shard_id=gi, start_time=gi * sd,
+                     end_time=(gi + 1) * sd,
+                     flush_bytes=self.opts.flush_bytes,
+                     wal_sync=self.opts.wal_sync,
+                     segment_size=self.opts.segment_size)
+
+    def shard_for_time(self, t: int, create: bool = True) -> Shard | None:
+        gi = t // self.opts.shard_duration
+        with self._lock:
+            s = self.shards.get(gi)
+            if s is None and create:
+                s = self.shards[gi] = self._open_shard(gi)
+            return s
+
+    def shards_overlapping(self, t_min: int, t_max: int) -> list[Shard]:
+        """Time-pruned shard selection (reference shard_mapper.go:74-117)."""
+        sd = self.opts.shard_duration
+        lo = t_min // sd
+        hi = t_max // sd
+        with self._lock:
+            return [self.shards[gi] for gi in sorted(self.shards)
+                    if lo <= gi <= hi]
+
+    def all_shards(self) -> list[Shard]:
+        with self._lock:
+            return [self.shards[gi] for gi in sorted(self.shards)]
+
+
+class Engine:
+    """Top storage object (reference Engine engine/engine.go:74)."""
+
+    def __init__(self, data_path: str, opts: EngineOptions | None = None):
+        self.path = data_path
+        self.opts = opts or EngineOptions()
+        self.databases: dict[str, Database] = {}
+        self._lock = threading.RLock()
+        os.makedirs(data_path, exist_ok=True)
+        for fn in sorted(os.listdir(data_path)):
+            if os.path.isdir(os.path.join(data_path, fn)):
+                self.databases[fn] = Database(
+                    fn, os.path.join(data_path, fn), self.opts)
+
+    # ---- DDL -------------------------------------------------------------
+
+    def create_database(self, name: str) -> Database:
+        with self._lock:
+            db = self.databases.get(name)
+            if db is None:
+                db = self.databases[name] = Database(
+                    name, os.path.join(self.path, name), self.opts)
+            return db
+
+    def drop_database(self, name: str) -> None:
+        import shutil
+        with self._lock:
+            db = self.databases.pop(name, None)
+        if db is not None:
+            for s in db.all_shards():
+                s.close()
+            shutil.rmtree(db.path, ignore_errors=True)
+
+    def database(self, name: str) -> Database:
+        db = self.databases.get(name)
+        if db is None:
+            raise ErrDatabaseNotFound(f"database not found: {name}")
+        return db
+
+    # ---- writes (reference Engine.WriteRows engine/engine.go:881) --------
+
+    def write_points(self, db_name: str, rows: list[PointRow],
+                     create_db: bool = True) -> int:
+        db = (self.create_database(db_name) if create_db
+              else self.database(db_name))
+        # group by target shard
+        by_shard: dict[int, list[PointRow]] = {}
+        sd = db.opts.shard_duration
+        for r in rows:
+            by_shard.setdefault(r.time // sd, []).append(r)
+        n = 0
+        for gi, batch in by_shard.items():
+            shard = db.shard_for_time(gi * sd)
+            n += shard.write_rows(batch)
+        return n
+
+    # ---- reads -----------------------------------------------------------
+
+    def measurements(self, db_name: str) -> list[str]:
+        db = self.database(db_name)
+        out: set[str] = set()
+        for s in db.all_shards():
+            out.update(s.measurements())
+        return sorted(out)
+
+    def scan_series(self, db_name: str, measurement: str,
+                    filters: list[TagFilter] | None = None,
+                    columns: list[str] | None = None,
+                    t_min: int | None = None, t_max: int | None = None,
+                    ) -> list[tuple[Shard, int, Record]]:
+        """Flat scan: (shard, sid, record) per matching series with data.
+        Query layers above turn this into device arrays."""
+        db = self.database(db_name)
+        shards = (db.shards_overlapping(t_min, t_max)
+                  if t_min is not None and t_max is not None
+                  else db.all_shards())
+        out = []
+        for s in shards:
+            for sid in s.series_ids(measurement, filters).tolist():
+                rec = s.read_series(measurement, sid, columns, t_min, t_max)
+                if rec is not None:
+                    out.append((s, sid, rec))
+        return out
+
+    def flush_all(self) -> None:
+        for db in list(self.databases.values()):
+            for s in db.all_shards():
+                s.flush()
+
+    def close(self) -> None:
+        for db in list(self.databases.values()):
+            for s in db.all_shards():
+                s.close()

@@ -1,0 +1,197 @@
+"""In-memory write buffer (role of reference engine/mutable/table.go
+MemTable + ts_table.go).
+
+Per (measurement, sid) chunked column builders — appends go to python lists
+of small numpy chunks, so repeated writes are O(1) amortized (no
+concatenate-per-append); finalize() materializes sorted Records per series
+for flush or query.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..record import ColVal, DataType, Field, Record, Schema
+from ..utils.errors import ErrTypeConflict
+
+_FIELD_TYPE = {
+    float: DataType.FLOAT,
+    int: DataType.INTEGER,
+    bool: DataType.BOOLEAN,
+    str: DataType.STRING,
+}
+
+
+def field_type_of(v) -> DataType:
+    # bool is a subclass of int — check it first
+    if isinstance(v, bool):
+        return DataType.BOOLEAN
+    if isinstance(v, int):
+        return DataType.INTEGER
+    if isinstance(v, float):
+        return DataType.FLOAT
+    if isinstance(v, str):
+        return DataType.STRING
+    raise ErrTypeConflict(f"unsupported field value type {type(v)}")
+
+
+class _SeriesBuf:
+    """Column builders for one series: parallel python lists per field."""
+
+    __slots__ = ("times", "fields")
+
+    def __init__(self):
+        self.times: list[int] = []
+        self.fields: dict[str, list] = {}
+
+    def append(self, fields: dict, time: int, schema: dict[str, DataType]):
+        n = len(self.times)
+        self.times.append(time)
+        for k, v in fields.items():
+            col = self.fields.get(k)
+            if col is None:
+                col = self.fields[k] = [None] * n
+            col.append(v)
+        # backfill fields not present in this row
+        for k, col in self.fields.items():
+            if len(col) < len(self.times):
+                col.append(None)
+
+
+class MemTable:
+    """One measurement's in-memory data across its series."""
+
+    def __init__(self, measurement: str):
+        self.measurement = measurement
+        self.schema: dict[str, DataType] = {}
+        self.series: dict[int, _SeriesBuf] = {}
+        self.rows = 0
+        self.approx_bytes = 0
+
+    def validate(self, fields: dict) -> None:
+        """Raise ErrTypeConflict on schema conflict WITHOUT mutating state
+        (called before the row is made durable in the WAL)."""
+        for k, v in fields.items():
+            ft = field_type_of(v)
+            cur = self.schema.get(k)
+            if cur is not None and cur != ft:
+                # int written into float field is coerced (influx semantics)
+                if not (cur == DataType.FLOAT and ft == DataType.INTEGER):
+                    raise ErrTypeConflict(
+                        f"field {k}: {ft.name} conflicts with {cur.name}")
+
+    def write(self, sid: int, fields: dict, time: int) -> None:
+        self.validate(fields)
+        for k, v in fields.items():
+            ft = field_type_of(v)
+            if k not in self.schema:
+                self.schema[k] = ft
+        buf = self.series.get(sid)
+        if buf is None:
+            buf = self.series[sid] = _SeriesBuf()
+        buf.append(fields, time, self.schema)
+        self.rows += 1
+        self.approx_bytes += 24 + 16 * len(fields)
+
+    def record_schema(self) -> Schema:
+        return Schema.from_pairs(sorted(self.schema.items()))
+
+    def series_record(self, sid: int) -> Record | None:
+        """Materialize one series as a time-sorted Record over the full
+        measurement schema (missing fields → null)."""
+        buf = self.series.get(sid)
+        if buf is None or not buf.times:
+            return None
+        n = len(buf.times)
+        schema = self.record_schema()
+        cols = []
+        for f in schema:
+            if f.name == "time":
+                cols.append(ColVal(DataType.TIME,
+                                   np.array(buf.times, dtype=np.int64)))
+                continue
+            raw = buf.fields.get(f.name)
+            if raw is None:
+                cols.append(ColVal.nulls(f.type, n))
+                continue
+            valid = np.array([x is not None for x in raw], dtype=np.bool_)
+            if f.type.is_numeric:
+                vals = np.array(
+                    [x if x is not None else 0 for x in raw],
+                    dtype=f.type.numpy_dtype)
+                cols.append(ColVal(f.type, vals, valid))
+            else:
+                cols.append(ColVal.from_strings(
+                    [x if x is not None else None for x in raw], f.type))
+        return Record(schema, cols).sort_by_time()
+
+    def sids(self) -> list[int]:
+        return sorted(self.series)
+
+
+class MemTables:
+    """All measurements' memtables for one shard, with a snapshot swap for
+    flush (reference shard.go snapshotTbl protocol: writes go to a fresh
+    active table while the snapshot flushes)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.active: dict[str, MemTable] = {}
+        self.snapshot: dict[str, MemTable] | None = None
+
+    def write(self, measurement: str, sid: int, fields: dict,
+              time: int) -> None:
+        with self._lock:
+            mt = self.active.get(measurement)
+            if mt is None:
+                mt = self.active[measurement] = MemTable(measurement)
+            mt.write(sid, fields, time)
+
+    def validate(self, measurement: str, fields: dict) -> None:
+        with self._lock:
+            mt = self.active.get(measurement)
+            if mt is not None:
+                mt.validate(fields)
+
+    @property
+    def approx_bytes(self) -> int:
+        with self._lock:
+            return sum(m.approx_bytes for m in self.active.values())
+
+    def begin_snapshot(self) -> dict[str, MemTable]:
+        with self._lock:
+            if self.snapshot is not None:
+                raise RuntimeError("snapshot already in progress")
+            self.snapshot = self.active
+            self.active = {}
+            return self.snapshot
+
+    def commit_snapshot(self) -> None:
+        with self._lock:
+            self.snapshot = None
+
+    def abort_snapshot(self) -> None:
+        """Put the snapshot back (flush failed); merges with writes that
+        arrived meanwhile by replaying the newer data on top."""
+        with self._lock:
+            snap, self.snapshot = self.snapshot, None
+            if not snap:
+                return
+            newer = self.active
+            self.active = snap
+            for mst, mt in newer.items():
+                for sid, buf in mt.series.items():
+                    for i, t in enumerate(buf.times):
+                        fields = {k: col[i] for k, col in buf.fields.items()
+                                  if col[i] is not None}
+                        self.write(mst, sid, fields, t)
+
+    def tables_for_read(self) -> list[dict[str, MemTable]]:
+        """Active + in-flight snapshot (reads must see both)."""
+        with self._lock:
+            out = [self.active]
+            if self.snapshot is not None:
+                out.append(self.snapshot)
+            return out

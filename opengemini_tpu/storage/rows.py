@@ -1,0 +1,14 @@
+"""Ingest row model (role of the reference's influx.Row from the line
+protocol parser, lib/util/lifted/vm/protoparser/influx)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PointRow:
+    measurement: str
+    tags: dict[str, str] = field(default_factory=dict)
+    fields: dict[str, float | int | bool | str] = field(default_factory=dict)
+    time: int = 0  # ns since epoch

@@ -1,0 +1,317 @@
+"""Shard: one time-range slice of a partition — WAL + memtable + immutable
+TSSP files + series index (role of reference engine/shard.go:119).
+
+Write path (reference shard.WriteRows :478 → writeRowsToTable :813):
+    rows → sid lookup/create (index) → WAL append → memtable
+Flush (reference ts_storage.go:155 shouldSnapshot → writeSnapshot):
+    snapshot memtables → one TSSP file per measurement → commit, drop WAL
+Read path: per-series merge of memtable + TSSP files (newer wins), the
+tsm_merge_cursor analog done record-wise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..index import SeriesIndex, TagFilter
+from ..record import DataType, Record, merge_sorted_records
+from ..utils import get_logger
+from ..utils.errors import ErrTypeConflict
+from .memtable import MemTables, field_type_of
+from .rows import PointRow
+from .tssp import TSSPReader, TSSPWriter, SEGMENT_SIZE
+
+log = get_logger(__name__)
+
+DEFAULT_FLUSH_BYTES = 256 * 1024 * 1024
+
+
+class Shard:
+    def __init__(self, path: str, shard_id: int,
+                 start_time: int, end_time: int,
+                 flush_bytes: int = DEFAULT_FLUSH_BYTES,
+                 wal_sync: bool = False,
+                 segment_size: int = SEGMENT_SIZE):
+        self.path = path
+        self.shard_id = shard_id
+        self.start_time = start_time
+        self.end_time = end_time
+        self.flush_bytes = flush_bytes
+        self.segment_size = segment_size
+        os.makedirs(path, exist_ok=True)
+        os.makedirs(os.path.join(path, "tssp"), exist_ok=True)
+        self.index = SeriesIndex(os.path.join(path, "series.log"))
+        from .wal import WAL
+        self.wal = WAL(os.path.join(path, "wal"), sync=wal_sync)
+        self.mem = MemTables()
+        self._files: dict[str, list[TSSPReader]] = {}
+        self._file_seq = 0
+        self._lock = threading.RLock()
+        # durable measurement→field→type registry: memtable schemas reset at
+        # flush, so type stability across flushes must be enforced here
+        # (role of the reference's measurement schema in ts-meta)
+        self._schema_path = os.path.join(path, "fields.idx")
+        self._schemas: dict[str, dict[str, DataType]] = {}
+        self._load_schemas()
+        self._load_files()
+        self._replay_wal()
+
+    # ---- open ------------------------------------------------------------
+
+    def _load_schemas(self) -> None:
+        if not os.path.exists(self._schema_path):
+            return
+        with open(self._schema_path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) == 3:
+                    self._schemas.setdefault(parts[0], {})[parts[1]] = (
+                        DataType(int(parts[2])))
+
+    def _check_fields(self, staged: dict, mst: str, fields: dict) -> None:
+        """Two-phase type check: validates fields against registry + already
+        staged additions, staging new (mst, field)→type entries into
+        ``staged``. Nothing is applied until _commit_fields — a conflict
+        anywhere in a batch must leave the registry untouched."""
+        sch = self._schemas.get(mst, {})
+        for k, v in fields.items():
+            ft = field_type_of(v)
+            cur = sch.get(k) or staged.get((mst, k))
+            if cur is None:
+                staged[(mst, k)] = ft
+            elif cur != ft and not (cur == DataType.FLOAT
+                                    and ft == DataType.INTEGER):
+                raise ErrTypeConflict(
+                    f"field {k}: {ft.name} conflicts with {cur.name}")
+
+    def _commit_fields(self, staged: dict) -> None:
+        if not staged:
+            return
+        lines = []
+        for (mst, k), ft in staged.items():
+            self._schemas.setdefault(mst, {})[k] = ft
+            lines.append(f"{mst}\t{k}\t{int(ft)}\n")
+        self._persist_schema_lines(lines)
+
+    def _persist_schema_lines(self, lines: list[str]) -> None:
+        with open(self._schema_path, "a", encoding="utf-8") as f:
+            f.writelines(lines)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _load_files(self) -> None:
+        import struct as _struct
+        d = os.path.join(self.path, "tssp")
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".tssp"):
+                continue
+            mst, seq = fn[:-5].rsplit("_", 1)
+            self._file_seq = max(self._file_seq, int(seq))
+            try:
+                self._files.setdefault(mst, []).append(
+                    TSSPReader(os.path.join(d, fn)))
+            except (ValueError, _struct.error, OSError) as e:
+                log.error("skipping corrupt tssp %s: %s", fn, e)
+
+    def _coerce(self, mst: str, fields: dict) -> dict:
+        """int→float coercion for fields registered as FLOAT, so memtable
+        arrays always match the durable schema type."""
+        sch = self._schemas.get(mst)
+        if not sch:
+            return fields
+        out = None
+        for k, v in fields.items():
+            if (type(v) is int and sch.get(k) == DataType.FLOAT):
+                if out is None:
+                    out = dict(fields)
+                out[k] = float(v)
+        return out if out is not None else fields
+
+    def _replay_wal(self) -> None:
+        n = bad = 0
+        for batch in self.wal.replay():
+            for mst, sid, fields, t in batch:
+                try:
+                    self.mem.write(mst, sid, self._coerce(mst, fields), t)
+                    n += 1
+                except Exception as e:  # poison row must not block open
+                    bad += 1
+                    log.error("shard %d: dropping bad wal row (%s %s): %s",
+                              self.shard_id, mst, fields, e)
+        if n or bad:
+            log.info("shard %d: replayed %d rows from wal (%d dropped)",
+                     self.shard_id, n, bad)
+
+    # ---- writes ----------------------------------------------------------
+
+    def write_rows(self, rows: list[PointRow]) -> int:
+        """Returns rows written. Rows outside the shard time range are the
+        caller's bug (engine routes by time)."""
+        batch = []
+        created_sid = False
+        for r in rows:
+            before = self.index.series_cardinality
+            sid = self.index.get_or_create_sid(r.measurement, r.tags)
+            created_sid |= self.index.series_cardinality != before
+            batch.append((r.measurement, sid, r.fields, r.time))
+        with self._lock:
+            # validate against the durable schema registry BEFORE the batch
+            # becomes durable: a type-conflicting row must never reach the
+            # WAL (it would poison every replay)
+            staged: dict = {}
+            for mst, _sid, fields, _t in batch:
+                self._check_fields(staged, mst, fields)
+            self._commit_fields(staged)
+            batch = [(mst, sid, self._coerce(mst, fields), t)
+                     for mst, sid, fields, t in batch]
+            if created_sid:
+                # sid allocations must be durable before rows referencing
+                # them: otherwise crash replay could reassign those sids to
+                # different tag sets and merge unrelated series
+                self.index.flush()
+            # lock spans wal.write + mem.write so a concurrent flush cannot
+            # seal the WAL segment between them (which would let commit
+            # delete the only durable copy of these rows)
+            self.wal.write(batch)
+            for mst, sid, fields, t in batch:
+                self.mem.write(mst, sid, fields, t)
+        if self.mem.approx_bytes >= self.flush_bytes:
+            self.flush()
+        return len(batch)
+
+    # ---- flush -----------------------------------------------------------
+
+    def flush(self) -> None:
+        """Memtable snapshot → TSSP files → commit (reference
+        commitSnapshot shard.go:867)."""
+        with self._lock:
+            if not self.mem.active and self.mem.snapshot is None:
+                return
+            sealed_wal = self.wal.switch()
+            snap = self.mem.begin_snapshot()
+            try:
+                new_files: list[tuple[str, str]] = []
+                for mst, mt in snap.items():
+                    if not mt.series:
+                        continue
+                    self._file_seq += 1
+                    fn = os.path.join(self.path, "tssp",
+                                      f"{mst}_{self._file_seq:06d}.tssp")
+                    w = TSSPWriter(fn, segment_size=self.segment_size)
+                    for sid in mt.sids():
+                        rec = mt.series_record(sid)
+                        if rec is not None:
+                            w.write_series(sid, rec)
+                    w.finalize()
+                    new_files.append((mst, fn))
+                for mst, fn in new_files:
+                    self._files.setdefault(mst, []).append(TSSPReader(fn))
+                self.index.flush()
+                self.mem.commit_snapshot()
+                self.wal.remove_upto(sealed_wal)
+            except Exception:
+                self.mem.abort_snapshot()
+                raise
+
+    # ---- reads -----------------------------------------------------------
+
+    def measurements(self) -> list[str]:
+        with self._lock:
+            msts = set(self._files)
+        for tbl in self.mem.tables_for_read():
+            msts.update(tbl.keys())
+        return sorted(msts)
+
+    def series_ids(self, measurement: str,
+                   filters: list[TagFilter] | None = None) -> np.ndarray:
+        return self.index.series_ids(measurement, filters)
+
+    def read_series(self, measurement: str, sid: int,
+                    columns: list[str] | None = None,
+                    t_min: int | None = None,
+                    t_max: int | None = None) -> Record | None:
+        """Merged view of one series: files (oldest→newest) then memtable,
+        later sources winning on duplicate timestamps."""
+        rec: Record | None = None
+        with self._lock:
+            files = list(self._files.get(measurement, ()))
+        for f in files:
+            part = f.read_series(sid, columns, t_min, t_max)
+            if part is not None:
+                rec = part if rec is None else _merge_parts(rec, part)
+        for tbl in self.mem.tables_for_read()[::-1]:  # snapshot older first
+            mt = tbl.get(measurement)
+            if mt is None:
+                continue
+            part = mt.series_record(sid)
+            if part is not None:
+                if t_min is not None or t_max is not None:
+                    part = part.time_slice(
+                        t_min if t_min is not None else part.min_time,
+                        t_max if t_max is not None else part.max_time)
+                if part.num_rows:
+                    if columns is not None:
+                        part = _project(part, columns)
+                    rec = part if rec is None else _merge_parts(rec, part)
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            self.wal.close()
+            self.index.close()
+            for files in self._files.values():
+                for f in files:
+                    f.close()
+
+
+def _project(rec: Record, columns: list[str]) -> Record:
+    from ..record import Schema
+    names = [n for n in columns
+             if n != "time" and rec.schema.field_index(n) >= 0]
+    fields = [rec.schema.fields[rec.schema.field_index(n)] for n in names]
+    cols = [rec.cols[rec.schema.field_index(n)] for n in names]
+    ti = rec.schema.time_index
+    fields.append(rec.schema.fields[ti])
+    cols.append(rec.cols[ti])
+    return Record(Schema(fields), cols)
+
+
+def _merge_parts(a: Record, b: Record) -> Record:
+    """Merge two per-series records; aligns schemas first (older files may
+    miss newly-added fields)."""
+    if a.schema == b.schema:
+        return merge_sorted_records(a, b)
+    names = sorted(({f.name for f in a.schema}
+                    | {f.name for f in b.schema}) - {"time"})
+    from ..record import ColVal, Schema
+    pairs = []
+    for n in names:
+        fa, fb = a.schema.field(n), b.schema.field(n)
+        if fa is not None and fb is not None and fa.type != fb.type:
+            # defense against type drift in old files: int promotes to float
+            if {fa.type, fb.type} == {DataType.INTEGER, DataType.FLOAT}:
+                pairs.append((n, DataType.FLOAT))
+                continue
+            raise ErrTypeConflict(
+                f"field {n}: {fa.type.name} vs {fb.type.name} across "
+                f"storage generations")
+        pairs.append((n, (fa or fb).type))
+    schema = Schema.from_pairs(pairs)
+    out = []
+    for rec in (a, b):
+        cols = []
+        for f in schema:
+            i = rec.schema.field_index(f.name)
+            if i >= 0:
+                c = rec.cols[i]
+                if c.type == DataType.INTEGER and f.type == DataType.FLOAT:
+                    c = ColVal(DataType.FLOAT,
+                               c.values.astype(np.float64), c.valid)
+                cols.append(c)
+            else:
+                cols.append(ColVal.nulls(f.type, rec.num_rows))
+        out.append(Record(schema, cols))
+    return merge_sorted_records(out[0], out[1])

@@ -1,0 +1,458 @@
+"""TSSP-like immutable columnar file format with per-segment pre-aggregation.
+
+Role of the reference's engine/immutable/ TSSP format (magic 53ac2021,
+table.go:26-61): per-series chunks → per-column segments, chunk metas, a meta
+index, a series-id bloom filter and a trailer. Pre-aggregation per column
+segment (count/min/max/sum + min/max time — pre_aggregation.go:38) lets
+aggregate queries skip decoding entirely.
+
+TPU-first deviations:
+- Segments are fixed-size row blocks (SEGMENT_SIZE rows, last segment ragged)
+  so decoded columns concatenate into padded device blocks without
+  re-chunking; SEGMENT_SIZE is the device block size.
+- A per-segment "regular" flag (const-delta time codec) marks data eligible
+  for the dense reshape kernel path.
+- Chunk metas serialize with a compact struct codec and zstd (role of
+  lib/codec); readers mmap the file and decode lazily via the meta index.
+
+Layout:
+    [magic u32][version u32]
+    data section: encoded column blocks (+validity blocks), back to back
+    chunk meta section: zstd([ChunkMeta...])
+    meta index: [(sid_min, sid_max, offset, size) per meta group]
+    bloom: series-id bloom filter bits
+    trailer: fixed struct with section offsets + file stats
+    [trailer size u32][magic u32]
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..encoding import blocks as enc
+from ..record import ColVal, DataType, Field, Record, Schema
+
+MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
+VERSION = 1
+SEGMENT_SIZE = 4096          # rows per column segment == device block rows
+META_GROUP_SERIES = 256      # series per meta-index group
+
+_TRAILER_FMT = "<QQQQQQQqqQ"  # data_end, meta_off, meta_size, idx_off,
+#                               idx_size, bloom_off, bloom_size,
+#                               min_time, max_time, series_count
+
+
+@dataclass
+class PreAgg:
+    """Per-segment pre-aggregation (reference pre_aggregation.go:38)."""
+    count: int = 0
+    sum: float = 0.0          # float64 for FLOAT, int value for INTEGER
+    min: float = 0.0
+    max: float = 0.0
+    min_time: int = 0
+    max_time: int = 0
+
+    def pack(self) -> bytes:
+        return struct.pack("<qdddqq", self.count, float(self.sum),
+                           float(self.min), float(self.max),
+                           self.min_time, self.max_time)
+
+    @classmethod
+    def unpack(cls, b) -> "PreAgg":
+        c, s, mn, mx, mnt, mxt = struct.unpack("<qdddqq", b)
+        return cls(c, s, mn, mx, mnt, mxt)
+
+PREAGG_SIZE = struct.calcsize("<qdddqq")
+
+
+@dataclass
+class Segment:
+    """One encoded column block (reference tssp_file_meta.go:51)."""
+    offset: int
+    size: int
+    rows: int
+    valid_offset: int
+    valid_size: int
+    preagg: PreAgg | None = None
+
+
+@dataclass
+class ColumnMeta:
+    """(reference tssp_file_meta.go:136)"""
+    name: str
+    type: DataType
+    segments: list[Segment] = field(default_factory=list)
+
+
+@dataclass
+class ChunkMeta:
+    """Per-series chunk meta (reference tssp_file_meta.go:368)."""
+    sid: int
+    min_time: int
+    max_time: int
+    rows: int
+    columns: list[ColumnMeta] = field(default_factory=list)
+    regular: bool = False     # every time segment is const-delta
+
+    def column(self, name: str) -> ColumnMeta | None:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        return None
+
+
+# ------------------------------------------------------------ serialization
+
+def _pack_chunk_meta(cm: ChunkMeta) -> bytes:
+    out = [struct.pack("<QqqqH?", cm.sid, cm.min_time, cm.max_time, cm.rows,
+                       len(cm.columns), cm.regular)]
+    for col in cm.columns:
+        nb = col.name.encode()
+        out.append(struct.pack("<HBH", len(nb), int(col.type),
+                               len(col.segments)))
+        out.append(nb)
+        for s in col.segments:
+            out.append(struct.pack("<QIIQI?", s.offset, s.size, s.rows,
+                                   s.valid_offset, s.valid_size,
+                                   s.preagg is not None))
+            if s.preagg is not None:
+                out.append(s.preagg.pack())
+    return b"".join(out)
+
+
+def _unpack_chunk_meta(buf, pos: int) -> tuple[ChunkMeta, int]:
+    sid, mnt, mxt, rows, ncols, regular = struct.unpack_from("<QqqqH?", buf,
+                                                             pos)
+    pos += struct.calcsize("<QqqqH?")
+    cm = ChunkMeta(sid, mnt, mxt, rows, [], regular)
+    for _ in range(ncols):
+        nlen, ty, nsegs = struct.unpack_from("<HBH", buf, pos)
+        pos += struct.calcsize("<HBH")
+        name = bytes(buf[pos:pos + nlen]).decode()
+        pos += nlen
+        col = ColumnMeta(name, DataType(ty))
+        for _ in range(nsegs):
+            off, size, rws, voff, vsize, has_pa = struct.unpack_from(
+                "<QIIQI?", buf, pos)
+            pos += struct.calcsize("<QIIQI?")
+            pa = None
+            if has_pa:
+                pa = PreAgg.unpack(buf[pos:pos + PREAGG_SIZE])
+                pos += PREAGG_SIZE
+            col.segments.append(Segment(off, size, rws, voff, vsize, pa))
+        cm.columns.append(col)
+    return cm, pos
+
+
+# ------------------------------------------------------------------- bloom
+
+class SeriesBloom:
+    """Series-id bloom filter (reference trailer bloom, table.go:54-61).
+    k=4 hashes from two splitmix64 mixes; ~10 bits/key → <1% fp."""
+
+    def __init__(self, bits: np.ndarray):
+        self.bits = bits  # uint8 array, len power of two
+
+    @classmethod
+    def build(cls, sids: np.ndarray, bits_per_key: int = 10) -> "SeriesBloom":
+        n = max(len(sids), 1)
+        m = 1 << max(int(np.ceil(np.log2(n * bits_per_key))), 6)
+        bits = np.zeros(m // 8, dtype=np.uint8)
+        for h in cls._hashes(np.asarray(sids, dtype=np.uint64), m):
+            np.bitwise_or.at(bits, h // 8, (1 << (h % 8)).astype(np.uint8))
+        return cls(bits)
+
+    @staticmethod
+    def _hashes(sids: np.ndarray, m: int):
+        with np.errstate(over="ignore"):
+            x = sids.copy()
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            h1 = x ^ (x >> np.uint64(31))
+            y = sids + np.uint64(0x9E3779B97F4A7C15)
+            y = (y ^ (y >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            h2 = y ^ (y >> np.uint64(27))
+            for k in range(4):
+                yield ((h1 + np.uint64(k) * h2) % np.uint64(m)).astype(
+                    np.int64)
+
+    def may_contain(self, sid: int) -> bool:
+        m = len(self.bits) * 8
+        s = np.array([sid], dtype=np.uint64)
+        for h in self._hashes(s, m):
+            if not (self.bits[h[0] // 8] >> (h[0] % 8)) & 1:
+                return False
+        return True
+
+
+# ------------------------------------------------------------------ writer
+
+def _compute_preagg(col: ColVal, times: np.ndarray, lo: int,
+                    hi: int) -> PreAgg | None:
+    if col.values is None or col.type not in (DataType.FLOAT,
+                                              DataType.INTEGER,
+                                              DataType.TIME):
+        return None
+    v = col.values[lo:hi]
+    m = col.valid[lo:hi]
+    t = times[lo:hi]
+    cnt = int(np.count_nonzero(m))
+    if cnt == 0:
+        return PreAgg(0, 0.0, 0.0, 0.0, 0, 0)
+    vm = v[m]
+    tm = t[m]
+    return PreAgg(cnt, float(vm.sum(dtype=np.float64)), float(vm.min()),
+                  float(vm.max()), int(tm.min()), int(tm.max()))
+
+
+class TSSPWriter:
+    """Append-only writer: call write_series per series id (ascending,
+    each series once), then finalize(). Analog of immutable/msbuilder.go."""
+
+    def __init__(self, path: str, segment_size: int = SEGMENT_SIZE):
+        self.path = path
+        self.segment_size = segment_size
+        self._f = open(path + ".tmp", "wb")
+        self._f.write(struct.pack("<II", MAGIC, VERSION))
+        self._pos = 8
+        self._metas: list[ChunkMeta] = []
+        self._last_sid = -1
+        self._min_time = None
+        self._max_time = None
+
+    def _append(self, b: bytes) -> tuple[int, int]:
+        off = self._pos
+        self._f.write(b)
+        self._pos += len(b)
+        return off, len(b)
+
+    def write_series(self, sid: int, rec: Record) -> None:
+        if sid <= self._last_sid:
+            raise ValueError("series ids must be written in ascending order")
+        self._last_sid = sid
+        rec = rec.sort_by_time()
+        times = rec.times
+        n = rec.num_rows
+        if n == 0:
+            return
+        cm = ChunkMeta(sid, int(times[0]), int(times[-1]), n, regular=True)
+        self._min_time = (int(times[0]) if self._min_time is None
+                          else min(self._min_time, int(times[0])))
+        self._max_time = (int(times[-1]) if self._max_time is None
+                          else max(self._max_time, int(times[-1])))
+        ss = self.segment_size
+        for f, col in zip(rec.schema, rec.cols):
+            colmeta = ColumnMeta(f.name, f.type)
+            for lo in range(0, n, ss):
+                hi = min(lo + ss, n)
+                if f.type == DataType.TIME:
+                    data = enc.encode_time_block(col.values[lo:hi])
+                    if data[0] != enc.CONST_DELTA:
+                        cm.regular = False
+                elif f.type == DataType.INTEGER:
+                    data = enc.encode_integer_block(col.values[lo:hi])
+                elif f.type == DataType.FLOAT:
+                    data = enc.encode_float_block(col.values[lo:hi])
+                elif f.type == DataType.BOOLEAN:
+                    data = enc.encode_boolean_block(col.values[lo:hi])
+                else:
+                    sub = col.slice(lo, hi)
+                    data = enc.encode_string_block(sub.offsets, sub.data)
+                off, size = self._append(data)
+                voff, vsize = self._append(
+                    enc.encode_validity(col.valid[lo:hi]))
+                seg = Segment(off, size, hi - lo, voff, vsize,
+                              _compute_preagg(col, times, lo, hi))
+                colmeta.segments.append(seg)
+            cm.columns.append(colmeta)
+        self._metas.append(cm)
+
+    def finalize(self) -> None:
+        data_end = self._pos
+        # chunk metas in sid order, grouped for the meta index
+        idx_entries = []
+        meta_off = self._pos
+        for g in range(0, len(self._metas), META_GROUP_SERIES):
+            group = self._metas[g:g + META_GROUP_SERIES]
+            blob = enc._zstd_c(b"".join(_pack_chunk_meta(m) for m in group))
+            off, size = self._append(blob)
+            idx_entries.append((group[0].sid, group[-1].sid, off, size,
+                                len(group)))
+        meta_size = self._pos - meta_off
+        idx_off = self._pos
+        self._append(struct.pack("<I", len(idx_entries)))
+        for e in idx_entries:
+            self._append(struct.pack("<QQQII", *e))
+        idx_size = self._pos - idx_off
+        bloom = SeriesBloom.build(
+            np.array([m.sid for m in self._metas], dtype=np.uint64))
+        bloom_off, bloom_size = self._append(bloom.bits.tobytes())
+        trailer = struct.pack(
+            _TRAILER_FMT, data_end, meta_off, meta_size, idx_off, idx_size,
+            bloom_off, bloom_size,
+            self._min_time if self._min_time is not None else 0,
+            self._max_time if self._max_time is not None else 0,
+            len(self._metas))
+        self._append(trailer)
+        self._append(struct.pack("<II", len(trailer), MAGIC))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self.path + ".tmp", self.path)
+
+    def abort(self) -> None:
+        self._f.close()
+        os.unlink(self.path + ".tmp")
+
+
+# ------------------------------------------------------------------ reader
+
+class TSSPReader:
+    """mmap-backed reader with lazy chunk-meta decode via the meta index
+    (analogs: immutable/reader.go, file_iterator.go, location_cursor.go)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, "rb")
+        self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        mm = self._mm
+        if len(mm) < 16:
+            raise ValueError(f"{path}: truncated TSSP file")
+        magic, version = struct.unpack_from("<II", mm, 0)
+        tsize, tail_magic = struct.unpack_from("<II", mm, len(mm) - 8)
+        if magic != MAGIC or tail_magic != MAGIC:
+            raise ValueError(f"{path}: bad TSSP magic")
+        if version != VERSION:
+            raise ValueError(f"{path}: unsupported version {version}")
+        tr = struct.unpack_from(_TRAILER_FMT, mm, len(mm) - 8 - tsize)
+        (self.data_end, self.meta_off, self.meta_size, self.idx_off,
+         self.idx_size, self.bloom_off, self.bloom_size,
+         self.min_time, self.max_time, self.series_count) = tr
+        # copy (not view) so the mmap can close while the bloom lives on
+        self.bloom = SeriesBloom(np.frombuffer(
+            mm, dtype=np.uint8, count=self.bloom_size,
+            offset=self.bloom_off).copy())
+        # meta index
+        (n_groups,) = struct.unpack_from("<I", mm, self.idx_off)
+        pos = self.idx_off + 4
+        self._index = []
+        for _ in range(n_groups):
+            self._index.append(struct.unpack_from("<QQQII", mm, pos))
+            pos += struct.calcsize("<QQQII")
+        self._meta_cache: dict[int, dict[int, ChunkMeta]] = {}
+
+    def close(self) -> None:
+        self._mm.close()
+        self._file.close()
+
+    # ---- meta access ----------------------------------------------------
+
+    def _load_group(self, gi: int) -> dict[int, ChunkMeta]:
+        cached = self._meta_cache.get(gi)
+        if cached is not None:
+            return cached
+        _, _, off, size, count = self._index[gi]
+        blob = enc._zstd_d(self._mm[off:off + size])
+        metas: dict[int, ChunkMeta] = {}
+        pos = 0
+        for _ in range(count):
+            cm, pos = _unpack_chunk_meta(blob, pos)
+            metas[cm.sid] = cm
+        self._meta_cache[gi] = metas
+        return metas
+
+    def chunk_meta(self, sid: int) -> ChunkMeta | None:
+        if not self.bloom.may_contain(sid):
+            return None
+        for gi, (lo, hi, *_rest) in enumerate(self._index):
+            if lo <= sid <= hi:
+                return self._load_group(gi).get(sid)
+        return None
+
+    def series_ids(self) -> list[int]:
+        out = []
+        for gi in range(len(self._index)):
+            out.extend(self._load_group(gi).keys())
+        return sorted(out)
+
+    # ---- data access ----------------------------------------------------
+
+    def read_segment(self, col: ColumnMeta, seg: Segment) -> ColVal:
+        mm = self._mm
+        raw = mm[seg.offset:seg.offset + seg.size]
+        valid = enc.decode_validity(
+            mm[seg.valid_offset:seg.valid_offset + seg.valid_size], seg.rows)
+        t = col.type
+        if t == DataType.TIME:
+            return ColVal(t, enc.decode_time_block(raw, seg.rows), valid)
+        if t == DataType.INTEGER:
+            return ColVal(t, enc.decode_integer_block(raw, seg.rows), valid)
+        if t == DataType.FLOAT:
+            return ColVal(t, enc.decode_float_block(raw, seg.rows), valid)
+        if t == DataType.BOOLEAN:
+            return ColVal(t, enc.decode_boolean_block(raw, seg.rows), valid)
+        offsets, data = enc.decode_string_block(raw)
+        return ColVal(t, valid=valid, offsets=offsets, data=data)
+
+    def read_series(self, sid: int, columns: list[str] | None = None,
+                    t_min: int | None = None,
+                    t_max: int | None = None) -> Record | None:
+        """Decode one series' columns (optionally a subset / time range)
+        into a Record. Segment-level time pruning via column meta preagg."""
+        cm = self.chunk_meta(sid)
+        if cm is None:
+            return None
+        if t_min is not None and cm.max_time < t_min:
+            return None
+        if t_max is not None and cm.min_time > t_max:
+            return None
+        time_meta = cm.column("time")
+        if time_meta is None:
+            return None
+        names = ([c for c in columns if c != "time"] if columns is not None
+                 else [c.name for c in cm.columns if c.name != "time"])
+        fields = []
+        cols = []
+        # segment selection by time range using the time column's segments
+        nsegs = len(time_meta.segments)
+        keep = []
+        for si in range(nsegs):
+            tcol = time_meta.segments[si]
+            pa = tcol.preagg
+            if pa is not None:
+                if t_min is not None and pa.max_time < t_min:
+                    continue
+                if t_max is not None and pa.min_time > t_max:
+                    continue
+            keep.append(si)
+        if not keep:
+            return None
+        for name in names:
+            colm = cm.column(name)
+            if colm is None:
+                continue
+            parts = [self.read_segment(colm, colm.segments[si])
+                     for si in keep]
+            col = parts[0]
+            for p in parts[1:]:
+                col.append(p)
+            fields.append(Field(name, colm.type))
+            cols.append(col)
+        tparts = [self.read_segment(time_meta, time_meta.segments[si])
+                  for si in keep]
+        tcol = tparts[0]
+        for p in tparts[1:]:
+            tcol.append(p)
+        fields.append(Field("time", DataType.TIME))
+        cols.append(tcol)
+        rec = Record(Schema(fields), cols)
+        if t_min is not None or t_max is not None:
+            lo = t_min if t_min is not None else rec.min_time
+            hi = t_max if t_max is not None else rec.max_time
+            rec = rec.time_slice(lo, hi)
+        return rec if rec.num_rows else None

@@ -1,0 +1,165 @@
+"""Write-ahead log (role of reference engine/wal.go:111 — compressed
+records, rotation via Switch, replay on open).
+
+Frame format: [u32 len][u32 crc32 of compressed payload][zstd payload].
+Payload is a batch of rows serialized compactly (measurement, sid, time,
+fields). Replay validates crc and stops at the first torn frame.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+import zstandard
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+_HDR = struct.Struct("<II")
+
+
+def _pack_batch(rows: list[tuple[str, int, dict, int]]) -> bytes:
+    """rows: (measurement, sid, fields, time)"""
+    out = [struct.pack("<I", len(rows))]
+    for mst, sid, fields, t in rows:
+        mb = mst.encode()
+        out.append(struct.pack("<HQqH", len(mb), sid, t, len(fields)))
+        out.append(mb)
+        for k, v in fields.items():
+            kb = k.encode()
+            if isinstance(v, bool):
+                ty, vb = 3, struct.pack("<?", v)
+            elif isinstance(v, int):
+                ty, vb = 1, struct.pack("<q", v)
+            elif isinstance(v, float):
+                ty, vb = 2, struct.pack("<d", v)
+            else:
+                ty, vb = 4, str(v).encode()
+            out.append(struct.pack("<HBI", len(kb), ty, len(vb)))
+            out.append(kb)
+            out.append(vb)
+    return b"".join(out)
+
+
+def _unpack_batch(buf: bytes) -> list[tuple[str, int, dict, int]]:
+    (n,) = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    rows = []
+    for _ in range(n):
+        mlen, sid, t, nf = struct.unpack_from("<HQqH", buf, pos)
+        pos += struct.calcsize("<HQqH")
+        mst = buf[pos:pos + mlen].decode()
+        pos += mlen
+        fields = {}
+        for _ in range(nf):
+            klen, ty, vlen = struct.unpack_from("<HBI", buf, pos)
+            pos += struct.calcsize("<HBI")
+            k = buf[pos:pos + klen].decode()
+            pos += klen
+            vb = buf[pos:pos + vlen]
+            pos += vlen
+            if ty == 1:
+                v = struct.unpack("<q", vb)[0]
+            elif ty == 2:
+                v = struct.unpack("<d", vb)[0]
+            elif ty == 3:
+                v = struct.unpack("<?", vb)[0]
+            else:
+                v = vb.decode()
+            fields[k] = v
+        rows.append((mst, sid, fields, t))
+    return rows
+
+
+class WAL:
+    def __init__(self, dir_path: str, sync: bool = False):
+        self.dir = dir_path
+        self.sync = sync
+        os.makedirs(dir_path, exist_ok=True)
+        self._lock = threading.Lock()
+        self._seq = self._max_seq() + 1
+        self._f = open(self._path(self._seq), "ab")
+        self._zc = zstandard.ZstdCompressor(level=1)
+
+    def _path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"{seq:06d}.wal")
+
+    def _max_seq(self) -> int:
+        mx = 0
+        for fn in os.listdir(self.dir):
+            if fn.endswith(".wal"):
+                try:
+                    mx = max(mx, int(fn[:-4]))
+                except ValueError:
+                    pass
+        return mx
+
+    def write(self, rows: list[tuple[str, int, dict, int]]) -> None:
+        payload = self._zc.compress(_pack_batch(rows))
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._f.write(frame)
+            if self.sync:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+
+    def switch(self) -> int:
+        """Rotate to a new segment; returns the sealed segment's seq
+        (reference WAL.Switch). The sealed file is removed by
+        remove_sealed() after the matching memtable flush commits."""
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            sealed = self._seq
+            self._seq += 1
+            self._f = open(self._path(self._seq), "ab")
+            return sealed
+
+    def remove_upto(self, seq: int) -> None:
+        for fn in sorted(os.listdir(self.dir)):
+            if fn.endswith(".wal"):
+                try:
+                    s = int(fn[:-4])
+                except ValueError:
+                    continue
+                if s <= seq:
+                    os.unlink(os.path.join(self.dir, fn))
+
+    def replay(self):
+        """Yield row batches from all segments in order; stops at torn/corrupt
+        frames (reference engine/wal.go:562 parallel replay — ours is
+        sequential, one core)."""
+        zd = zstandard.ZstdDecompressor()
+        with self._lock:
+            seqs = sorted(
+                int(fn[:-4]) for fn in os.listdir(self.dir)
+                if fn.endswith(".wal") and fn[:-4].isdigit())
+        for seq in seqs:
+            try:
+                with open(self._path(seq), "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                continue
+            pos = 0
+            while pos + _HDR.size <= len(data):
+                ln, crc = _HDR.unpack_from(data, pos)
+                if pos + _HDR.size + ln > len(data):
+                    log.warning("wal %06d: torn frame at %d", seq, pos)
+                    break
+                payload = data[pos + _HDR.size:pos + _HDR.size + ln]
+                if zlib.crc32(payload) != crc:
+                    log.warning("wal %06d: bad crc at %d", seq, pos)
+                    break
+                yield _unpack_batch(zd.decompress(payload))
+                pos += _HDR.size + ln
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()

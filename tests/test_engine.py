@@ -1,0 +1,226 @@
+"""Engine/shard/WAL/memtable/index tests (reference models:
+engine/shard_test.go, engine/wal_test.go, engine/index tests)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.index import SeriesIndex, TagFilter
+from opengemini_tpu.storage import Engine, EngineOptions, PointRow
+from opengemini_tpu.utils.errors import ErrTypeConflict
+
+
+def mk_rows(n_hosts=3, n_points=10, t0=0, step=10**9, mst="cpu"):
+    rows = []
+    for h in range(n_hosts):
+        for i in range(n_points):
+            rows.append(PointRow(
+                mst, {"host": f"host_{h}", "dc": f"dc{h % 2}"},
+                {"usage_user": float(h * 100 + i), "cnt": i},
+                t0 + i * step))
+    return rows
+
+
+# ---- series index -----------------------------------------------------------
+
+def test_index_create_lookup_filters(tmp_path):
+    idx = SeriesIndex(str(tmp_path / "series.log"))
+    s1 = idx.get_or_create_sid("cpu", {"host": "a", "dc": "east"})
+    s2 = idx.get_or_create_sid("cpu", {"host": "b", "dc": "west"})
+    s3 = idx.get_or_create_sid("mem", {"host": "a"})
+    assert s1 != s2 and idx.get_or_create_sid(
+        "cpu", {"dc": "east", "host": "a"}) == s1  # tag order irrelevant
+    assert idx.series_cardinality == 3
+    assert list(idx.series_ids("cpu")) == [s1, s2]
+    assert list(idx.series_ids("cpu", [TagFilter("host", "a")])) == [s1]
+    assert list(idx.series_ids("cpu", [TagFilter("host", "a", "!=")])) == [s2]
+    assert list(idx.series_ids("cpu", [TagFilter("host", "a|b", "=~")])) == [s1, s2]
+    assert idx.tag_values("cpu", "dc") == ["east", "west"]
+    assert idx.tag_keys("cpu") == ["dc", "host"]
+    idx.close()
+    # replay
+    idx2 = SeriesIndex(str(tmp_path / "series.log"))
+    assert idx2.series_cardinality == 3
+    assert idx2.get_sid("mem", {"host": "a"}) == s3
+    assert idx2.get_or_create_sid("cpu", {"host": "a", "dc": "east"}) == s1
+    idx2.close()
+
+
+def test_index_group_by_tagsets(tmp_path):
+    idx = SeriesIndex(None)
+    for h in ("a", "b"):
+        for dc in ("e", "w"):
+            idx.get_or_create_sid("cpu", {"host": h, "dc": dc})
+    ts = idx.group_by_tagsets("cpu", ["host"])
+    assert [k for k, _ in ts] == [("a",), ("b",)]
+    assert all(len(s) == 2 for _, s in ts)
+    lut = idx.group_lut(ts)
+    assert lut[ts[0][1][0]] == 0 and lut[ts[1][1][1]] == 1
+    # group by both keys → 4 singleton groups
+    ts2 = idx.group_by_tagsets("cpu", ["dc", "host"])
+    assert len(ts2) == 4
+
+
+# ---- engine end-to-end ------------------------------------------------------
+
+def test_write_query_memtable_only(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    rows = mk_rows()
+    assert eng.write_points("db0", rows) == len(rows)
+    res = eng.scan_series("db0", "cpu", t_min=0, t_max=10**12)
+    assert len(res) == 3  # 3 hosts
+    _, _, rec = res[0]
+    assert rec.num_rows == 10
+    assert rec.column("usage_user") is not None
+    eng.close()
+
+
+def test_flush_and_reopen(tmp_path):
+    p = str(tmp_path / "data")
+    eng = Engine(p)
+    eng.write_points("db0", mk_rows())
+    eng.flush_all()
+    res = eng.scan_series("db0", "cpu")
+    assert len(res) == 3 and res[0][2].num_rows == 10
+    eng.close()
+    # reopen from disk (no WAL left, TSSP only)
+    eng2 = Engine(p)
+    res2 = eng2.scan_series("db0", "cpu")
+    assert len(res2) == 3
+    np.testing.assert_array_equal(res2[0][2].column("usage_user").values,
+                                  res[0][2].column("usage_user").values)
+    eng2.close()
+
+
+def test_wal_replay_after_crash(tmp_path):
+    p = str(tmp_path / "data")
+    eng = Engine(p)
+    eng.write_points("db0", mk_rows())
+    eng.close()  # NO flush → data only in WAL
+    eng2 = Engine(p)
+    res = eng2.scan_series("db0", "cpu")
+    assert len(res) == 3 and res[0][2].num_rows == 10
+    eng2.close()
+
+
+def test_memtable_file_merge_last_wins(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", [PointRow("cpu", {"h": "a"},
+                                      {"v": 1.0}, 1000)])
+    eng.flush_all()
+    eng.write_points("db0", [PointRow("cpu", {"h": "a"},
+                                      {"v": 9.0}, 1000)])  # overwrite
+    res = eng.scan_series("db0", "cpu")
+    assert len(res) == 1
+    rec = res[0][2]
+    assert rec.num_rows == 1 and rec.column("v").get(0) == 9.0
+    eng.close()
+
+
+def test_schema_evolution_across_flushes(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", [PointRow("m", {"h": "a"}, {"f1": 1.0}, 1000)])
+    eng.flush_all()
+    eng.write_points("db0", [PointRow("m", {"h": "a"},
+                                      {"f1": 2.0, "f2": 7.0}, 2000)])
+    res = eng.scan_series("db0", "m")
+    rec = res[0][2]
+    assert rec.num_rows == 2
+    f2 = rec.column("f2")
+    assert f2.get(0) is None and f2.get(1) == 7.0
+    eng.close()
+
+
+def test_type_conflict_rejected(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", [PointRow("m", {}, {"f": 1.5}, 0)])
+    with pytest.raises(ErrTypeConflict):
+        eng.write_points("db0", [PointRow("m", {}, {"f": "oops"}, 1)])
+    eng.close()
+
+
+def test_time_partitioned_shards(tmp_path):
+    opts = EngineOptions(shard_duration=10**9)  # 1s shards
+    eng = Engine(str(tmp_path / "data"), opts)
+    rows = [PointRow("m", {"h": "a"}, {"v": float(i)}, i * 10**9 + 5)
+            for i in range(5)]
+    eng.write_points("db0", rows)
+    db = eng.database("db0")
+    assert len(db.all_shards()) == 5
+    assert len(db.shards_overlapping(0, 2 * 10**9)) == 3
+    res = eng.scan_series("db0", "m", t_min=10**9, t_max=2 * 10**9 + 10)
+    total = sum(r.num_rows for _, _, r in res)
+    assert total == 2
+    eng.close()
+
+
+def test_tag_filter_scan(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", mk_rows())
+    res = eng.scan_series("db0", "cpu", filters=[TagFilter("host", "host_1")])
+    assert len(res) == 1
+    eng.close()
+
+
+def test_type_conflict_never_poisons_wal(tmp_path):
+    p = str(tmp_path / "data")
+    eng = Engine(p)
+    eng.write_points("db0", [PointRow("m", {}, {"f": 1.5}, 0)])
+    with pytest.raises(ErrTypeConflict):
+        eng.write_points("db0", [PointRow("m", {}, {"f": "oops"}, 1)])
+    eng.close()
+    # shard must reopen cleanly — the bad row never reached the WAL
+    eng2 = Engine(p)
+    res = eng2.scan_series("db0", "m")
+    assert len(res) == 1 and res[0][2].num_rows == 1
+    eng2.close()
+
+
+def test_type_stable_across_flushes(tmp_path):
+    p = str(tmp_path / "data")
+    eng = Engine(p)
+    eng.write_points("db0", [PointRow("m", {}, {"v": 1.5}, 0)])
+    eng.flush_all()
+    # int value into a float-registered field: coerced, not drifted
+    eng.write_points("db0", [PointRow("m", {}, {"v": 2}, 10**9)])
+    rec = eng.scan_series("db0", "m")[0][2]
+    assert rec.num_rows == 2 and rec.column("v").get(1) == 2.0
+    eng.close()
+    # registry survives restart: float into float ok, string conflicts
+    eng2 = Engine(p)
+    with pytest.raises(ErrTypeConflict):
+        eng2.write_points("db0", [PointRow("m", {}, {"v": "x"}, 2 * 10**9)])
+    eng2.close()
+
+
+def test_projection_with_explicit_time(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", [PointRow("m", {"h": "a"},
+                                      {"v": 1.0, "w": 2.0}, 1000)])
+    eng.flush_all()
+    res = eng.scan_series("db0", "m", columns=["v", "time"])
+    assert [f.name for f in res[0][2].schema] == ["v", "time"]
+    eng.close()
+
+
+def test_time_segment_preagg_present(tmp_path):
+    from opengemini_tpu.storage import TSSPReader
+    import os
+    eng = Engine(str(tmp_path / "data"))
+    eng.write_points("db0", mk_rows(n_hosts=1, n_points=50))
+    eng.flush_all()
+    shard = eng.database("db0").all_shards()[0]
+    tssp_dir = os.path.join(shard.path, "tssp")
+    fn = [f for f in os.listdir(tssp_dir) if f.endswith(".tssp")][0]
+    r = TSSPReader(os.path.join(tssp_dir, fn))
+    cm = r.chunk_meta(r.series_ids()[0])
+    seg = cm.column("time").segments[0]
+    assert seg.preagg is not None and seg.preagg.min_time == 0
+    r.close()
+    eng.close()
+
+
+def test_flush_idempotent_empty(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    eng.create_database("db0")
+    eng.flush_all()  # no data: no-op
+    eng.close()

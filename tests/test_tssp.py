@@ -1,0 +1,155 @@
+"""TSSP immutable file format tests (reference model:
+engine/immutable/*_test.go — roundtrip, preagg, pruning, bloom)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.record import DataType, Record, Schema
+from opengemini_tpu.storage import (SEGMENT_SIZE, TSSPReader, TSSPWriter)
+
+rng = np.random.default_rng(5)
+
+
+def make_series_record(n, t0=0, step=1000):
+    sch = Schema.from_pairs([("usage_user", DataType.FLOAT),
+                            ("count", DataType.INTEGER),
+                            ("note", DataType.STRING)])
+    return Record.from_columns(
+        sch,
+        usage_user=rng.uniform(0, 100, n),
+        count=rng.integers(0, 10, n),
+        note=["n%d" % (i % 3) for i in range(n)],
+        time=t0 + step * np.arange(n, dtype=np.int64))
+
+
+def write_file(tmp_path, series, seg_size=SEGMENT_SIZE):
+    path = str(tmp_path / "t.tssp")
+    w = TSSPWriter(path, segment_size=seg_size)
+    for sid, rec in series:
+        w.write_series(sid, rec)
+    w.finalize()
+    return path
+
+
+def test_roundtrip_single_series(tmp_path):
+    rec = make_series_record(100)
+    path = write_file(tmp_path, [(1, rec)])
+    r = TSSPReader(path)
+    assert r.series_count == 1
+    assert r.series_ids() == [1]
+    out = r.read_series(1)
+    assert out.num_rows == 100
+    assert np.array_equal(out.times, rec.times)
+    assert np.array_equal(out.column("usage_user").values,
+                          rec.column("usage_user").values)
+    assert np.array_equal(out.column("count").values,
+                          rec.column("count").values)
+    assert out.column("note").to_strings() == rec.column("note").to_strings()
+    r.close()
+
+
+def test_multi_segment_and_preagg(tmp_path):
+    n = 1000
+    rec = make_series_record(n)
+    path = write_file(tmp_path, [(7, rec)], seg_size=256)
+    r = TSSPReader(path)
+    cm = r.chunk_meta(7)
+    assert cm.rows == n and cm.regular
+    col = cm.column("usage_user")
+    assert len(col.segments) == (n + 255) // 256
+    # preagg matches numpy per segment
+    v = rec.column("usage_user").values
+    for i, seg in enumerate(col.segments):
+        lo, hi = i * 256, min((i + 1) * 256, n)
+        pa = seg.preagg
+        assert pa.count == hi - lo
+        np.testing.assert_allclose(pa.sum, v[lo:hi].sum(), rtol=1e-15)
+        assert pa.min == v[lo:hi].min() and pa.max == v[lo:hi].max()
+        assert pa.min_time == rec.times[lo] and pa.max_time == rec.times[hi-1]
+    # whole-file preagg sum == column sum
+    total = sum(s.preagg.sum for s in col.segments)
+    np.testing.assert_allclose(total, v.sum(), rtol=1e-12)
+    r.close()
+
+
+def test_time_range_pruning(tmp_path):
+    rec = make_series_record(1000, t0=0, step=1000)  # times 0..999000
+    path = write_file(tmp_path, [(1, rec)], seg_size=100)
+    r = TSSPReader(path)
+    out = r.read_series(1, t_min=500_000, t_max=550_000)
+    assert out.num_rows == 51
+    assert out.min_time == 500_000 and out.max_time == 550_000
+    assert r.read_series(1, t_min=10**12) is None
+    r.close()
+
+
+def test_many_series_and_bloom(tmp_path):
+    series = [(sid, make_series_record(50, t0=sid)) for sid in
+              range(1, 600, 2)]  # odd sids only
+    path = write_file(tmp_path, series)
+    r = TSSPReader(path)
+    assert r.series_count == len(series)
+    # all written sids present (no false negatives)
+    for sid, rec in series[::37]:
+        out = r.read_series(sid)
+        assert out is not None and out.num_rows == 50
+    # absent sids: chunk_meta returns None
+    assert r.chunk_meta(2) is None
+    assert r.chunk_meta(10**9) is None
+    r.close()
+
+
+def test_column_subset(tmp_path):
+    rec = make_series_record(10)
+    path = write_file(tmp_path, [(1, rec)])
+    r = TSSPReader(path)
+    out = r.read_series(1, columns=["usage_user"])
+    assert [f.name for f in out.schema] == ["usage_user", "time"]
+    r.close()
+
+
+def test_ascending_sid_enforced(tmp_path):
+    path = str(tmp_path / "t.tssp")
+    w = TSSPWriter(path)
+    w.write_series(5, make_series_record(10))
+    with pytest.raises(ValueError):
+        w.write_series(3, make_series_record(10))
+    w.abort()
+
+
+def test_nulls_roundtrip(tmp_path):
+    sch = Schema.from_pairs([("v", DataType.FLOAT)])
+    from opengemini_tpu.record import ColVal
+    valid = rng.random(500) > 0.3
+    rec = Record(sch, [ColVal(DataType.FLOAT, rng.normal(0, 1, 500), valid),
+                       ColVal(DataType.TIME, np.arange(500, dtype=np.int64))])
+    path = write_file(tmp_path, [(1, rec)], seg_size=128)
+    r = TSSPReader(path)
+    out = r.read_series(1)
+    assert np.array_equal(out.column("v").valid, valid)
+    m = valid
+    assert np.array_equal(out.column("v").values[m],
+                          rec.column("v").values[m])
+    # preagg only counts valid
+    cm = r.chunk_meta(1)
+    assert sum(s.preagg.count for s in cm.column("v").segments) == m.sum()
+    r.close()
+
+
+def test_corrupt_file_rejected(tmp_path):
+    p = tmp_path / "bad.tssp"
+    p.write_bytes(b"garbagegarbagegarbage")
+    with pytest.raises(ValueError):
+        TSSPReader(str(p))
+
+
+def test_irregular_times_not_regular_flag(tmp_path):
+    sch = Schema.from_pairs([("v", DataType.FLOAT)])
+    t = np.sort(rng.choice(10**6, 300, replace=False)).astype(np.int64)
+    rec = Record.from_columns(sch, v=rng.normal(0, 1, 300), time=t)
+    path = write_file(tmp_path, [(1, rec)])
+    r = TSSPReader(path)
+    assert not r.chunk_meta(1).regular
+    out = r.read_series(1)
+    assert np.array_equal(out.times, t)
+    r.close()
