@@ -1,0 +1,4 @@
+from .influxql import parse_query, ParseError
+from .ast import (SelectStatement, ShowStatement, Call, FieldRef, Literal,
+                  BinaryExpr, Wildcard)
+from .executor import QueryExecutor

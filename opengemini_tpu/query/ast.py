@@ -1,0 +1,138 @@
+"""InfluxQL AST (role of reference lib/util/lifted/influx/influxql/ast.go,
+reduced to the supported statement surface)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Literal:
+    value: float | int | str | bool
+
+    def __repr__(self):
+        return f"Lit({self.value!r})"
+
+
+@dataclass
+class FieldRef:
+    name: str
+
+    def __repr__(self):
+        return f"Ref({self.name})"
+
+
+@dataclass
+class Wildcard:
+    pass
+
+
+@dataclass
+class Call:
+    func: str
+    args: list = field(default_factory=list)
+
+    def __repr__(self):
+        return f"{self.func}({', '.join(map(repr, self.args))})"
+
+
+@dataclass
+class BinaryExpr:
+    op: str          # + - * / and or = != < <= > >= =~ !~
+    lhs: object
+    rhs: object
+
+    def __repr__(self):
+        return f"({self.lhs!r} {self.op} {self.rhs!r})"
+
+
+@dataclass
+class SelectField:
+    expr: object
+    alias: str | None = None
+
+
+@dataclass
+class Dimension:
+    """GROUP BY entry: tag name, time(interval[, offset]) call, or *."""
+    expr: object
+
+
+@dataclass
+class SelectStatement:
+    fields: list[SelectField] = field(default_factory=list)
+    from_measurement: str = ""
+    from_rp: str | None = None
+    from_db: str | None = None
+    condition: object | None = None
+    dimensions: list[Dimension] = field(default_factory=list)
+    fill_option: str = "null"     # null | none | previous | linear | <number>
+    fill_value: float = 0.0
+    order_desc: bool = False
+    limit: int = 0
+    offset: int = 0
+    slimit: int = 0
+    soffset: int = 0
+    tz: str | None = None
+    # sub-select source (SELECT ... FROM (SELECT ...))
+    from_subquery: "SelectStatement | None" = None
+
+    @property
+    def has_group_by_time(self) -> bool:
+        return self.group_by_interval() is not None
+
+    def group_by_interval(self) -> int | None:
+        for d in self.dimensions:
+            if isinstance(d.expr, Call) and d.expr.func == "time":
+                return d.expr.args[0].value if d.expr.args else None
+        return None
+
+    def group_by_offset(self) -> int:
+        for d in self.dimensions:
+            if (isinstance(d.expr, Call) and d.expr.func == "time"
+                    and len(d.expr.args) > 1):
+                return d.expr.args[1].value
+        return 0
+
+    def group_by_tags(self) -> list[str]:
+        out = []
+        for d in self.dimensions:
+            if isinstance(d.expr, FieldRef):
+                out.append(d.expr.name)
+        return out
+
+    @property
+    def group_by_star(self) -> bool:
+        return any(isinstance(d.expr, Wildcard) for d in self.dimensions)
+
+
+@dataclass
+class ShowStatement:
+    what: str                      # measurements|databases|tag keys|...
+    on_db: str | None = None
+    from_measurement: str | None = None
+    key: str | None = None         # for SHOW TAG VALUES WITH KEY = x
+    condition: object | None = None
+    limit: int = 0
+    offset: int = 0
+
+
+@dataclass
+class CreateDatabaseStatement:
+    name: str
+
+
+@dataclass
+class DropDatabaseStatement:
+    name: str
+
+
+@dataclass
+class DropMeasurementStatement:
+    name: str
+
+
+@dataclass
+class DeleteStatement:
+    from_measurement: str | None = None
+    condition: object | None = None

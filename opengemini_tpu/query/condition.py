@@ -1,0 +1,204 @@
+"""WHERE-clause analysis: split a condition into (time range, tag filters,
+residual field predicate) — the planner's condition pushdown (role of the
+reference's influxql.ConditionExpr / shard_mapper time pruning).
+
+Only AND-connected time/tag predicates are extracted; OR trees and field
+comparisons stay in the residual (evaluated row-wise post-scan).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index import TagFilter
+from .ast import BinaryExpr, Call, FieldRef, Literal
+
+MIN_TIME = -(1 << 62)
+MAX_TIME = (1 << 62)
+
+
+class Condition:
+    def __init__(self):
+        self.t_min = MIN_TIME
+        self.t_max = MAX_TIME
+        self.tag_filters: list[TagFilter] = []
+        self.residual = None  # field predicate expr or None
+
+    @property
+    def has_time_range(self) -> bool:
+        return self.t_min != MIN_TIME or self.t_max != MAX_TIME
+
+    def residual_fields(self) -> set[str]:
+        """Field names referenced by the residual predicate (must be scanned
+        even when not selected)."""
+        out: set[str] = set()
+
+        def walk(e):
+            if isinstance(e, FieldRef) and e.name != "time":
+                out.add(e.name)
+            elif isinstance(e, BinaryExpr):
+                walk(e.lhs)
+                walk(e.rhs)
+            elif isinstance(e, Call):
+                for a in e.args:
+                    walk(a)
+
+        if self.residual is not None:
+            walk(self.residual)
+        return out
+
+
+def analyze_condition(expr, tag_keys: set[str] | None = None) -> Condition:
+    """tag_keys: which identifiers are tags (everything else = field)."""
+    cond = Condition()
+    if expr is None:
+        return cond
+    residuals = []
+    _walk_and(expr, cond, residuals, tag_keys or set())
+    if residuals:
+        r = residuals[0]
+        for e in residuals[1:]:
+            r = BinaryExpr("and", r, e)
+        cond.residual = r
+    return cond
+
+
+def _time_value(e) -> int | None:
+    if isinstance(e, Literal):
+        if isinstance(e.value, (int, float)):
+            return int(e.value)
+        if isinstance(e.value, str):
+            from .influxql import parse_time_literal
+            return parse_time_literal(e.value)
+    if isinstance(e, BinaryExpr):
+        l, r = _time_value(e.lhs), _time_value(e.rhs)
+        if l is not None and r is not None:
+            if e.op == "+":
+                return l + r
+            if e.op == "-":
+                return l - r
+    return None
+
+
+def _walk_and(expr, cond: Condition, residuals: list,
+              tag_keys: set[str]) -> None:
+    if isinstance(expr, BinaryExpr) and expr.op == "and":
+        _walk_and(expr.lhs, cond, residuals, tag_keys)
+        _walk_and(expr.rhs, cond, residuals, tag_keys)
+        return
+    if isinstance(expr, BinaryExpr) and expr.op in ("=", "!=", "<", "<=",
+                                                    ">", ">=", "=~", "!~"):
+        lhs, rhs, op = expr.lhs, expr.rhs, expr.op
+        # normalize literal op field → field flipped-op literal
+        if isinstance(lhs, Literal) and isinstance(rhs, FieldRef):
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if isinstance(lhs, FieldRef) and lhs.name == "time":
+            tv = _time_value(rhs)
+            if tv is not None:
+                if op == "=":
+                    cond.t_min = max(cond.t_min, tv)
+                    cond.t_max = min(cond.t_max, tv)
+                elif op == ">":
+                    cond.t_min = max(cond.t_min, tv + 1)
+                elif op == ">=":
+                    cond.t_min = max(cond.t_min, tv)
+                elif op == "<":
+                    cond.t_max = min(cond.t_max, tv - 1)
+                elif op == "<=":
+                    cond.t_max = min(cond.t_max, tv)
+                return
+        if (isinstance(lhs, FieldRef) and isinstance(rhs, Literal)
+                and lhs.name != "time"):
+            is_tag = lhs.name in tag_keys
+            if is_tag and op in ("=", "!=", "=~", "!~") \
+                    and isinstance(rhs.value, str):
+                cond.tag_filters.append(TagFilter(lhs.name, rhs.value, op))
+                return
+    residuals.append(expr)
+
+
+def eval_residual(expr, rec) -> np.ndarray:
+    """Row-wise evaluation of the residual predicate over a Record →
+    bool mask (the reference's filter transform role)."""
+    n = rec.num_rows
+
+    def ev(e):
+        if isinstance(e, Literal):
+            return e.value
+        if isinstance(e, FieldRef):
+            if e.name == "time":
+                return rec.times
+            col = rec.column(e.name)
+            if col is None:
+                return np.zeros(n, dtype=np.float64), np.zeros(n, np.bool_)
+            if col.values is not None:
+                return col.values, col.valid
+            return col, col.valid  # string col
+        if isinstance(e, BinaryExpr):
+            lv = ev(e.lhs)
+            rv = ev(e.rhs)
+            lval, lvalid = lv if isinstance(lv, tuple) else (lv, None)
+            rval, rvalid = rv if isinstance(rv, tuple) else (rv, None)
+            valid = None
+            if lvalid is not None:
+                valid = lvalid
+            if rvalid is not None:
+                valid = rvalid if valid is None else (valid & rvalid)
+            from ..record import ColVal
+            cmp_ops = ("=", "!=", "<", "<=", ">", ">=", "=~", "!~")
+            if isinstance(lval, ColVal) or isinstance(rval, ColVal):
+                # string comparison
+                svals = (lval.to_strings() if isinstance(lval, ColVal)
+                         else [lval] * n)
+                ovals = (rval.to_strings() if isinstance(rval, ColVal)
+                         else [rval] * n)
+                if e.op == "=":
+                    out = np.array([a == b for a, b in zip(svals, ovals)])
+                elif e.op == "!=":
+                    out = np.array([a != b for a, b in zip(svals, ovals)])
+                elif e.op in ("=~", "!~"):
+                    import re as _re
+                    rx = _re.compile(ovals[0])
+                    out = np.array([bool(rx.search(a or ""))
+                                    for a in svals])
+                    if e.op == "!~":
+                        out = ~out
+                else:
+                    raise ValueError(f"bad string op {e.op}")
+                # null comparison is false (influx semantics), settled HERE
+                # so an OR branch with a null operand doesn't kill the row
+                if valid is not None:
+                    out = out & valid
+                return out
+            ops = {
+                "and": lambda a, b: np.logical_and(a, b),
+                "or": lambda a, b: np.logical_or(a, b),
+                "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+                "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+                "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+                "*": lambda a, b: a * b,
+                "/": lambda a, b: np.divide(
+                    a, b, out=np.zeros_like(np.asarray(a, dtype=float)),
+                    where=np.asarray(b) != 0),
+                "%": lambda a, b: np.mod(a, b),
+            }
+            out = ops[e.op](lval, rval)
+            if e.op in cmp_ops:
+                if valid is not None:
+                    out = np.asarray(out, dtype=bool) & valid
+                return out
+            return (out, valid) if valid is not None else out
+        if isinstance(e, Call):
+            raise ValueError(f"call {e.func} not allowed in WHERE")
+        raise ValueError(f"bad residual expr {e!r}")
+
+    res = ev(expr)
+    if isinstance(res, tuple):
+        mask, valid = res
+        mask = np.asarray(mask, dtype=bool)
+        if valid is not None:
+            mask = mask & valid
+        return mask
+    return np.broadcast_to(np.asarray(res, dtype=bool), (n,)).copy()

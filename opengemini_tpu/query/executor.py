@@ -1,0 +1,525 @@
+"""Query executor: AST → scan → TPU kernels → influx-shaped results.
+
+Role of the reference's executor.Select pipeline (engine/executor/select.go:50
+→ logical plan → PipelineExecutor) collapsed into a direct pipeline for the
+supported statement shapes; the staged structure mirrors the reference's
+transform DAG:
+
+    IndexScan (tagsets)  →  Reader (shard scan + decode)  →
+    WindowAgg on TPU (segment_aggregate — the aggregateCursor/series_agg_func
+    analog)  →  final merge/fill/limit on host (HashMerge/Fill/Limit
+    transforms analog)
+
+Raw (non-aggregate) selects skip the device stage.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..record import DataType
+from ..utils import get_logger
+from ..utils.errors import ErrQueryError
+from .ast import (BinaryExpr, Call, FieldRef, Literal, SelectStatement,
+                  ShowStatement, Wildcard, CreateDatabaseStatement,
+                  DropDatabaseStatement, DropMeasurementStatement,
+                  DeleteStatement)
+from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
+
+log = get_logger(__name__)
+
+AGG_FUNCS = {"count", "sum", "mean", "min", "max", "first", "last",
+             "spread"}
+MAX_WINDOWS = 100_000
+
+
+@dataclass
+class AggItem:
+    func: str
+    field: str
+    output: str       # column name in result
+
+
+class QueryExecutor:
+    """Executes parsed statements against a storage Engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    # ------------------------------------------------------------------ api
+
+    def execute(self, stmt, db: str | None = None) -> dict:
+        """Returns one influx-style result object: {"series": [...]} or
+        {"error": ...}."""
+        try:
+            if isinstance(stmt, SelectStatement):
+                return self._select(stmt, stmt.from_db or db)
+            if isinstance(stmt, ShowStatement):
+                return self._show(stmt, stmt.on_db or db)
+            if isinstance(stmt, CreateDatabaseStatement):
+                self.engine.create_database(stmt.name)
+                return {}
+            if isinstance(stmt, DropDatabaseStatement):
+                self.engine.drop_database(stmt.name)
+                return {}
+            if isinstance(stmt, (DropMeasurementStatement, DeleteStatement)):
+                return {"error": "not implemented yet"}
+            return {"error": f"unsupported statement {type(stmt).__name__}"}
+        except ErrQueryError as e:
+            return {"error": str(e)}
+
+    # ----------------------------------------------------------------- SHOW
+
+    def _show(self, stmt: ShowStatement, db: str | None) -> dict:
+        res = self._show_inner(stmt, db)
+        if (stmt.limit or stmt.offset) and "series" in res:
+            for s in res["series"]:
+                lo = stmt.offset
+                hi = lo + stmt.limit if stmt.limit else None
+                s["values"] = s["values"][lo:hi]
+        return res
+
+    def _show_inner(self, stmt: ShowStatement, db: str | None) -> dict:
+        eng = self.engine
+        if stmt.condition is not None:
+            return {"error":
+                    f"WHERE on SHOW {stmt.what.upper()} not supported yet"}
+        if stmt.what == "databases":
+            vals = [[n] for n in sorted(eng.databases)]
+            return _series("databases", ["name"], vals)
+        if db is None or db not in eng.databases:
+            return {"error": f"database not found: {db}"}
+        if stmt.what == "measurements":
+            vals = [[m] for m in eng.measurements(db)]
+            return _series("measurements", ["name"], vals)
+        shards = eng.database(db).all_shards()
+        if stmt.what == "tag keys":
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                keys = sorted({k for s in shards
+                               for k in s.index.tag_keys(m)})
+                if keys:
+                    out.append({"name": m, "columns": ["tagKey"],
+                                "values": [[k] for k in keys]})
+            return {"series": out} if out else {}
+        if stmt.what == "tag values":
+            if not stmt.key:
+                return {"error": "SHOW TAG VALUES requires WITH KEY = <key>"}
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                vals = sorted({v for s in shards
+                               for v in s.index.tag_values(m, stmt.key)})
+                if vals:
+                    out.append({"name": m, "columns": ["key", "value"],
+                                "values": [[stmt.key, v] for v in vals]})
+            return {"series": out} if out else {}
+        if stmt.what == "field keys":
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                types: dict[str, DataType] = {}
+                for s in shards:
+                    types.update(s._schemas.get(m, {}))
+                if types:
+                    out.append({"name": m,
+                                "columns": ["fieldKey", "fieldType"],
+                                "values": [[k, _ftype_name(t)] for k, t
+                                           in sorted(types.items())]})
+            return {"series": out} if out else {}
+        if stmt.what == "series":
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                for s in shards:
+                    for sid in s.index.series_ids(m).tolist():
+                        tags = s.index.tags_of(sid)
+                        key = m + "," + ",".join(
+                            f"{k}={v}" for k, v in sorted(tags.items()))
+                        out.append(key)
+            vals = [[k] for k in sorted(set(out))]
+            return _series("series", ["key"], vals) if vals else {}
+        return {"error": f"unsupported SHOW {stmt.what}"}
+
+    # --------------------------------------------------------------- SELECT
+
+    def _select(self, stmt: SelectStatement, db: str | None) -> dict:
+        if db is None:
+            return {"error": "database required"}
+        if db not in self.engine.databases:
+            return {"error": f"database not found: {db}"}
+        if stmt.from_subquery is not None:
+            return {"error": "subqueries not implemented yet"}
+        mst = stmt.from_measurement
+        aggs, raw_fields, has_wildcard = _classify_fields(stmt)
+        if aggs and raw_fields:
+            return {"error":
+                    "mixing aggregate and non-aggregate queries is not "
+                    "supported"}
+        # tag key universe for condition analysis
+        shards_all = self.engine.database(db).all_shards()
+        tag_keys = {k for s in shards_all for k in s.index.tag_keys(mst)}
+        cond = analyze_condition(stmt.condition, tag_keys)
+        if aggs:
+            return self._select_agg(stmt, db, mst, aggs, cond, tag_keys)
+        return self._select_raw(stmt, db, mst, raw_fields, has_wildcard,
+                                cond, tag_keys)
+
+    # ---- aggregate path --------------------------------------------------
+
+    def _select_agg(self, stmt, db, mst, aggs: list[AggItem], cond,
+                    tag_keys) -> dict:
+        from ..ops import AggSpec, segment_aggregate, window_ids, pad_bucket
+        from ..ops.segment_agg import pad_rows
+
+        interval = stmt.group_by_interval()
+        offset = stmt.group_by_offset()
+        group_tags = (sorted(tag_keys) if stmt.group_by_star
+                      else stmt.group_by_tags())
+        # residual-predicate fields must be scanned even if not aggregated
+        needed_fields = sorted({a.field for a in aggs if a.field}
+                               | cond.residual_fields())
+
+        db_obj = self.engine.database(db)
+        t_min, t_max = cond.t_min, cond.t_max
+        shards = (db_obj.shards_overlapping(t_min, t_max)
+                  if cond.has_time_range else db_obj.all_shards())
+
+        # global tagsets across shards, keyed by tag-value tuple
+        global_groups: dict[tuple, int] = {}
+        per_shard: list[tuple[object, list[tuple[int, int]]]] = []
+        for s in shards:
+            ts = s.index.group_by_tagsets(mst, group_tags, cond.tag_filters)
+            pairs = []
+            for key, sids in ts:
+                gi = global_groups.setdefault(key, len(global_groups))
+                pairs.extend((int(sid), gi) for sid in sids)
+            per_shard.append((s, pairs))
+        G = len(global_groups)
+        if G == 0:
+            return {}
+
+        # gather: flat arrays per needed field + times + group ids
+        t_lo = None if not cond.has_time_range else t_min
+        t_hi = None if not cond.has_time_range else t_max
+        chunks: list[dict] = []
+        data_tmin = MAX_TIME
+        data_tmax = MIN_TIME
+        for s, pairs in per_shard:
+            for sid, gi in pairs:
+                rec = s.read_series(mst, sid, needed_fields or None,
+                                    t_lo, t_hi)
+                if rec is None or rec.num_rows == 0:
+                    continue
+                if cond.residual is not None:
+                    mask = eval_residual(cond.residual, rec)
+                    if not mask.any():
+                        continue
+                    rec = rec.take(np.nonzero(mask)[0])
+                data_tmin = min(data_tmin, rec.min_time)
+                data_tmax = max(data_tmax, rec.max_time)
+                chunks.append({"rec": rec, "gi": gi})
+        if not chunks:
+            return {}
+
+        # window layout
+        if interval:
+            start = (t_min if t_min != MIN_TIME else data_tmin)
+            start = (start - offset) // interval * interval + offset
+            if start > (t_min if t_min != MIN_TIME else data_tmin):
+                start -= interval
+            end = (t_max if t_max != MAX_TIME else data_tmax)
+            W = int((end - start) // interval) + 1
+            if W > MAX_WINDOWS:
+                raise ErrQueryError(
+                    f"too many windows: {W} > {MAX_WINDOWS}")
+        else:
+            start = t_min if t_min != MIN_TIME else data_tmin
+            W = 1
+        interval_eff = interval if interval else MAX_TIME
+
+        n_rows = sum(c["rec"].num_rows for c in chunks)
+        times = np.empty(n_rows, dtype=np.int64)
+        gids = np.empty(n_rows, dtype=np.int64)
+        pos = 0
+        for c in chunks:
+            n = c["rec"].num_rows
+            times[pos:pos + n] = c["rec"].times
+            gids[pos:pos + n] = c["gi"]
+            pos += n
+
+        w = np.asarray(window_ids(times, start, interval_eff, W))
+        seg = np.where(w < W, gids * W + w, G * W).astype(np.int64)
+        num_segments = G * W
+        # seg ids are NOT sorted in general (multi-shard/multi-series
+        # interleave); XLA's indices_are_sorted contract would be violated
+        seg_sorted = bool(np.all(seg[:-1] <= seg[1:])) if len(seg) else True
+
+        # count is always computed: empty-window masking and fill need it
+        spec_names = {"count"}
+        for a in aggs:
+            if a.func in ("mean", "count", "sum"):
+                spec_names.update({"count", "sum"})
+            elif a.func in ("min", "max", "first", "last"):
+                spec_names.add(a.func)
+            elif a.func == "spread":
+                spec_names.update({"min", "max"})
+        spec = AggSpec.of(*spec_names)
+
+        field_results: dict[str, object] = {}
+        field_types: dict[str, DataType] = {}
+        npad = pad_bucket(n_rows)
+        seg_p, times_p = pad_rows([seg, times], npad, seg_fill=num_segments)
+        for fname in needed_fields:
+            vals = np.zeros(n_rows, dtype=np.float64)
+            valid = np.zeros(n_rows, dtype=np.bool_)
+            ftype = DataType.FLOAT
+            pos = 0
+            for c in chunks:
+                rec = c["rec"]
+                n = rec.num_rows
+                col = rec.column(fname)
+                if col is not None and col.values is not None:
+                    vals[pos:pos + n] = col.values.astype(np.float64)
+                    valid[pos:pos + n] = col.valid
+                    if col.type == DataType.INTEGER:
+                        ftype = DataType.INTEGER
+                pos += n
+            vals_p, valid_p = pad_rows([vals, valid], npad, seg_fill=0)
+            res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
+                                    num_segments, spec,
+                                    sorted_ids=seg_sorted)
+            field_results[fname] = res
+            field_types[fname] = ftype
+        # materialize output columns per agg item: (G, W) float arrays
+        out_cols: list[np.ndarray] = []
+        for a in aggs:
+            res = field_results[a.field]
+            arr = _finalize_agg(a.func, res, num_segments)
+            out_cols.append(np.asarray(arr).reshape(G, W))
+        # any data in window (across agg fields) → emit row
+        anyc = np.zeros((G, W), dtype=np.int64)
+        for a in aggs:
+            c = field_results[a.field].count
+            if c is not None:
+                anyc += np.asarray(c).reshape(G, W)
+            else:
+                anyc += 1
+
+        # build series in sorted tag order (deterministic, matches raw path)
+        group_keys = [None] * G
+        for key, gi in global_groups.items():
+            group_keys[gi] = key
+        win_times = start + interval * np.arange(W) if interval else \
+            np.array([start], dtype=np.int64)
+
+        series_out = []
+        order = sorted(range(G), key=lambda gi: group_keys[gi])
+        for gi in order:
+            tags = dict(zip(group_tags, group_keys[gi]))
+            rows = []
+            prev = [None] * len(aggs)
+            for wi in range(W):
+                has = anyc[gi, wi] > 0
+                if not has:
+                    if not interval or stmt.fill_option == "none":
+                        continue
+                    if stmt.fill_option == "null":
+                        row = [int(win_times[wi])] + [None] * len(aggs)
+                        rows.append(row)
+                        continue
+                    if stmt.fill_option == "value":
+                        rows.append([int(win_times[wi])]
+                                    + [stmt.fill_value] * len(aggs))
+                        continue
+                    if stmt.fill_option == "previous":
+                        rows.append([int(win_times[wi])] + list(prev))
+                        continue
+                    continue
+                row = [int(win_times[wi])]
+                for ai, a in enumerate(aggs):
+                    v = out_cols[ai][gi, wi]
+                    cnt = np.asarray(
+                        field_results[a.field].count).reshape(G, W)[gi, wi]
+                    if cnt == 0:
+                        row.append(None)
+                        continue
+                    v = float(v)
+                    if a.func == "count":
+                        v = int(v)
+                    elif (field_types[a.field] == DataType.INTEGER
+                          and a.func in ("sum", "min", "max", "first",
+                                         "last", "spread")):
+                        v = int(v)
+                    row.append(v)
+                    prev[ai] = row[-1]
+                rows.append(row)
+            if not rows:
+                continue
+            if stmt.order_desc:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[:stmt.limit]
+            if not rows:
+                continue
+            entry = {"name": mst,
+                     "columns": ["time"] + [a.output for a in aggs],
+                     "values": rows}
+            if group_tags:
+                entry["tags"] = tags
+            series_out.append(entry)
+        if stmt.soffset:
+            series_out = series_out[stmt.soffset:]
+        if stmt.slimit:
+            series_out = series_out[:stmt.slimit]
+        return {"series": series_out} if series_out else {}
+
+    # ---- raw path --------------------------------------------------------
+
+    def _select_raw(self, stmt, db, mst, raw_fields, has_wildcard, cond,
+                    tag_keys) -> dict:
+        db_obj = self.engine.database(db)
+        t_min, t_max = cond.t_min, cond.t_max
+        shards = (db_obj.shards_overlapping(t_min, t_max)
+                  if cond.has_time_range else db_obj.all_shards())
+        group_tags = (sorted(tag_keys) if stmt.group_by_star
+                      else stmt.group_by_tags())
+
+        # field schema across shards
+        all_fields: dict[str, DataType] = {}
+        for s in shards:
+            all_fields.update(s._schemas.get(mst, {}))
+        if has_wildcard:
+            pairs = [(n, None) for n in sorted(all_fields)]
+        else:
+            pairs = raw_fields
+        sel_names = [n for n, _a in pairs]
+        display = [a or n for n, a in pairs]
+        field_names = [n for n in sel_names if n in all_fields]
+        if not field_names:
+            return {}
+        # residual-predicate fields must be scanned even if not selected
+        scan_names = sorted(set(field_names) | cond.residual_fields())
+
+        t_lo = None if not cond.has_time_range else t_min
+        t_hi = None if not cond.has_time_range else t_max
+
+        groups: dict[tuple, list] = {}
+        for s in shards:
+            for key, sids in s.index.group_by_tagsets(
+                    mst, group_tags, cond.tag_filters):
+                for sid in sids.tolist():
+                    rec = s.read_series(mst, sid, scan_names, t_lo, t_hi)
+                    if rec is None or rec.num_rows == 0:
+                        continue
+                    if cond.residual is not None:
+                        mask = eval_residual(cond.residual, rec)
+                        if not mask.any():
+                            continue
+                        rec = rec.take(np.nonzero(mask)[0])
+                    groups.setdefault(key, []).append(
+                        (s.index.tags_of(sid), rec))
+
+        series_out = []
+        for key in sorted(groups):
+            recs = groups[key]
+            rows = []
+            for tags, rec in recs:
+                for i in range(rec.num_rows):
+                    row = [int(rec.times[i])]
+                    for name in sel_names:
+                        if name in tag_keys:
+                            row.append(tags.get(name))
+                        else:
+                            col = rec.column(name)
+                            row.append(None if col is None else col.get(i))
+                    rows.append(row)
+            rows.sort(key=lambda r: r[0], reverse=stmt.order_desc)
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[:stmt.limit]
+            if not rows:
+                continue
+            entry = {"name": mst, "columns": ["time"] + display,
+                     "values": rows}
+            if group_tags:
+                entry["tags"] = dict(zip(group_tags, key))
+            series_out.append(entry)
+        if stmt.soffset:
+            series_out = series_out[stmt.soffset:]
+        if stmt.slimit:
+            series_out = series_out[:stmt.slimit]
+        return {"series": series_out} if series_out else {}
+
+
+# --------------------------------------------------------------- helpers
+
+def _series(name: str, columns: list[str], values: list) -> dict:
+    return {"series": [{"name": name, "columns": columns,
+                        "values": values}]}
+
+
+def _ftype_name(t: DataType) -> str:
+    return {DataType.FLOAT: "float", DataType.INTEGER: "integer",
+            DataType.BOOLEAN: "boolean", DataType.STRING: "string"
+            }.get(t, "unknown")
+
+
+def _classify_fields(stmt: SelectStatement):
+    """Split select list into agg items vs raw field refs."""
+    aggs: list[AggItem] = []
+    raw: list[tuple[str, str | None]] = []
+    has_wildcard = False
+
+    for sf in stmt.fields:
+        e = sf.expr
+        if isinstance(e, Wildcard):
+            has_wildcard = True
+            continue
+        if isinstance(e, Call):
+            func = e.func
+            if func not in AGG_FUNCS:
+                raise ErrQueryError(f"unsupported function {func}()")
+            if not e.args or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError(
+                    f"{func}() requires a named field argument")
+            aggs.append(AggItem(func, e.args[0].name, sf.alias or func))
+        elif isinstance(e, FieldRef):
+            raw.append((e.name, sf.alias))
+        else:
+            raise ErrQueryError(
+                f"unsupported select expression {e!r}")
+    return aggs, raw, has_wildcard
+
+
+def _finalize_agg(func: str, res, num_segments: int) -> np.ndarray:
+    count = np.asarray(res.count) if res.count is not None else None
+    if func == "count":
+        return count.astype(np.float64)
+    if func == "sum":
+        return np.asarray(res.sum)
+    if func == "mean":
+        s = np.asarray(res.sum)
+        c = np.maximum(count, 1)
+        return s / c
+    if func == "min":
+        return np.asarray(res.min)
+    if func == "max":
+        return np.asarray(res.max)
+    if func == "first":
+        return np.asarray(res.first)
+    if func == "last":
+        return np.asarray(res.last)
+    if func == "spread":
+        return np.asarray(res.max) - np.asarray(res.min)
+    raise ErrQueryError(f"unsupported aggregate {func}")

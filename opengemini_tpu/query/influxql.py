@@ -1,0 +1,471 @@
+"""InfluxQL lexer + recursive-descent parser for the supported subset
+(role of the reference's 19k-LoC yacc parser,
+lib/util/lifted/influx/influxql/parser.go — built fresh as a hand parser;
+grammar grows with the framework).
+
+Supported:
+  SELECT <fields> FROM <source> [WHERE expr] [GROUP BY dims [fill(...)]]
+      [ORDER BY time ASC|DESC] [LIMIT n] [OFFSET n] [SLIMIT n] [SOFFSET n]
+      [TZ('...')]
+  sources: measurement, "quoted", db..m, db.rp.m, (subquery)
+  SHOW DATABASES / MEASUREMENTS / TAG KEYS / TAG VALUES WITH KEY = k /
+      FIELD KEYS / SERIES   [ON db] [FROM m] [WHERE ...] [LIMIT/OFFSET]
+  CREATE DATABASE name / DROP DATABASE name / DROP MEASUREMENT name
+  DELETE FROM m [WHERE ...]
+  multiple statements separated by ';'
+
+Expressions: and/or, comparisons (= != < <= > >= =~ !~), arithmetic
+(+ - * / %), durations (1h2m3s...), time literals ('2020-01-01T00:00:00Z'),
+now() arithmetic, regex /.../, calls.
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timezone
+
+from .ast import (BinaryExpr, Call, CreateDatabaseStatement, DeleteStatement,
+                  Dimension, DropDatabaseStatement, DropMeasurementStatement,
+                  FieldRef, Literal, SelectField, SelectStatement,
+                  ShowStatement, Wildcard)
+
+
+class ParseError(Exception):
+    pass
+
+
+_DUR_RE = re.compile(r"(\d+)(ns|u|µ|ms|s|m|h|d|w)")
+_DUR_NS = {"ns": 1, "u": 10**3, "µ": 10**3, "ms": 10**6, "s": 10**9,
+           "m": 60 * 10**9, "h": 3600 * 10**9, "d": 86400 * 10**9,
+           "w": 7 * 86400 * 10**9}
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<duration>\d+(?:ns|u|µ|ms|s|m|h|d|w)(?:\d+(?:ns|u|µ|ms|s|m|h|d|w))*)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?i?)
+  | (?P<string>'(?:[^'\\]|\\.)*')
+  | (?P<dquoted>"(?:[^"\\]|\\.)*")
+  | (?P<op><=|>=|!=|<>|=~|!~|::|[-+*/%(),.;=<>])
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<other>.)
+""", re.VERBOSE | re.DOTALL)
+
+
+class Lexer:
+    def __init__(self, text: str):
+        self.text = text
+        self.tokens: list[tuple[str, str, int]] = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN_RE.match(text, pos)
+            kind = m.lastgroup
+            val = m.group()
+            pos = m.end()
+            if kind == "ws":
+                continue
+            # 'other' covers characters only valid inside /regex/ bodies,
+            # which the parser re-lexes from raw text via try_regex
+            self.tokens.append((kind, val, m.start()))
+        self.i = 0
+
+    def peek(self, ahead: int = 0):
+        j = self.i + ahead
+        if j < len(self.tokens):
+            return self.tokens[j]
+        return ("eof", "", len(self.text))
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def try_regex(self) -> str | None:
+        """Re-lex from current position as /regex/ (the token stream can't
+        know '/' starts a regex; the parser requests it where valid)."""
+        t = self.peek()
+        if t[0] != "op" or t[1] != "/":
+            return None
+        start = t[2] + 1
+        text = self.text
+        j = start
+        buf = []
+        while j < len(text):
+            c = text[j]
+            if c == "\\" and j + 1 < len(text):
+                buf.append(text[j:j + 2])
+                j += 2
+                continue
+            if c == "/":
+                # resync token stream past the closing slash
+                while (self.i < len(self.tokens)
+                       and self.tokens[self.i][2] <= j):
+                    self.i += 1
+                return "".join(buf)
+            buf.append(c)
+            j += 1
+        raise ParseError("unterminated regex")
+
+
+def parse_duration(s: str) -> int:
+    total = 0
+    for m in _DUR_RE.finditer(s):
+        total += int(m.group(1)) * _DUR_NS[m.group(2)]
+    return total
+
+
+def parse_time_literal(s: str) -> int:
+    """RFC3339 (with optional fraction up to ns) → ns since epoch, exact:
+    the fraction is parsed manually because strptime's %f caps at 6 digits
+    and float64 seconds cannot hold nanoseconds."""
+    s = s.strip()
+    s2 = s.replace("Z", "+00:00") if s.endswith("Z") else s
+    # split off fractional seconds
+    frac_ns = 0
+    m = re.match(r"^([^.]*)\.(\d{1,9})(.*)$", s2)
+    if m:
+        digits = m.group(2)
+        frac_ns = int(digits.ljust(9, "0"))
+        s2 = m.group(1) + m.group(3)
+    fmts = ["%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"]
+    for f in fmts:
+        try:
+            dt = datetime.strptime(s2, f)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=timezone.utc)
+            return int(dt.timestamp()) * 10**9 + frac_ns
+        except ValueError:
+            continue
+    raise ParseError(f"bad time literal {s!r}")
+
+
+class Parser:
+    def __init__(self, text: str, now_ns: int | None = None):
+        self.lx = Lexer(text)
+        import time as _time
+        self.now_ns = (now_ns if now_ns is not None
+                       else int(_time.time() * 1e9))
+
+    # ---- helpers ---------------------------------------------------------
+
+    def _kw(self, word: str) -> bool:
+        k, v, _ = self.lx.peek()
+        if k == "ident" and v.upper() == word:
+            self.lx.next()
+            return True
+        return False
+
+    def _expect_kw(self, word: str):
+        if not self._kw(word):
+            k, v, p = self.lx.peek()
+            raise ParseError(f"expected {word}, got {v!r} at {p}")
+
+    def _op(self, op: str) -> bool:
+        k, v, _ = self.lx.peek()
+        if k == "op" and v == op:
+            self.lx.next()
+            return True
+        return False
+
+    def _expect_op(self, op: str):
+        if not self._op(op):
+            k, v, p = self.lx.peek()
+            raise ParseError(f"expected {op!r}, got {v!r} at {p}")
+
+    def _ident(self) -> str:
+        k, v, p = self.lx.next()
+        if k == "ident":
+            return v
+        if k == "dquoted":
+            return re.sub(r'\\(.)', r'\1', v[1:-1])
+        raise ParseError(f"expected identifier, got {v!r} at {p}")
+
+    # ---- statements ------------------------------------------------------
+
+    def parse_statements(self) -> list:
+        out = []
+        while True:
+            k, v, _ = self.lx.peek()
+            if k == "eof":
+                break
+            if k == "op" and v == ";":
+                self.lx.next()
+                continue
+            out.append(self.parse_statement())
+        return out
+
+    def parse_statement(self):
+        k, v, p = self.lx.peek()
+        u = v.upper() if k == "ident" else ""
+        if u == "SELECT":
+            return self.parse_select()
+        if u == "SHOW":
+            return self.parse_show()
+        if u == "CREATE":
+            self.lx.next()
+            self._expect_kw("DATABASE")
+            return CreateDatabaseStatement(self._ident())
+        if u == "DROP":
+            self.lx.next()
+            if self._kw("DATABASE"):
+                return DropDatabaseStatement(self._ident())
+            self._expect_kw("MEASUREMENT")
+            return DropMeasurementStatement(self._ident())
+        if u == "DELETE":
+            self.lx.next()
+            stmt = DeleteStatement()
+            if self._kw("FROM"):
+                stmt.from_measurement = self._ident()
+            if self._kw("WHERE"):
+                stmt.condition = self.parse_expr()
+            return stmt
+        raise ParseError(f"unsupported statement starting {v!r} at {p}")
+
+    def parse_select(self) -> SelectStatement:
+        self._expect_kw("SELECT")
+        stmt = SelectStatement()
+        stmt.fields.append(self.parse_select_field())
+        while self._op(","):
+            stmt.fields.append(self.parse_select_field())
+        self._expect_kw("FROM")
+        if self._op("("):
+            stmt.from_subquery = self.parse_select()
+            self._expect_op(")")
+        else:
+            first = self._ident()
+            if self._op("."):
+                if self._op("."):          # db..measurement
+                    stmt.from_db = first
+                    stmt.from_measurement = self._ident()
+                else:
+                    second = self._ident()
+                    if self._op("."):      # db.rp.measurement
+                        stmt.from_db, stmt.from_rp = first, second
+                        stmt.from_measurement = self._ident()
+                    else:                  # rp.measurement
+                        stmt.from_rp = first
+                        stmt.from_measurement = second
+            else:
+                stmt.from_measurement = first
+        if self._kw("WHERE"):
+            stmt.condition = self.parse_expr()
+        if self._kw("GROUP"):
+            self._expect_kw("BY")
+            while True:
+                if self._op("*"):
+                    stmt.dimensions.append(Dimension(Wildcard()))
+                else:
+                    e = self.parse_primary()
+                    stmt.dimensions.append(Dimension(e))
+                if not self._op(","):
+                    break
+            k, v, _ = self.lx.peek()
+            if k == "ident" and v.lower() == "fill":
+                self.lx.next()
+                self._expect_op("(")
+                neg = self._op("-")
+                fk, fv, p = self.lx.next()
+                if fk == "ident" and not neg:
+                    if fv.lower() not in ("null", "none", "previous",
+                                          "linear"):
+                        raise ParseError(f"bad fill option {fv!r} at {p}")
+                    stmt.fill_option = fv.lower()
+                elif fk in ("number", "duration"):
+                    stmt.fill_option = "value"
+                    try:
+                        stmt.fill_value = float(fv.rstrip("i"))
+                    except ValueError:
+                        raise ParseError(f"bad fill value {fv!r} at {p}")
+                    if neg:
+                        stmt.fill_value = -stmt.fill_value
+                else:
+                    raise ParseError(f"bad fill argument {fv!r} at {p}")
+                self._expect_op(")")
+        if self._kw("ORDER"):
+            self._expect_kw("BY")
+            self._expect_kw("TIME")
+            if self._kw("DESC"):
+                stmt.order_desc = True
+            else:
+                self._kw("ASC")
+        if self._kw("LIMIT"):
+            stmt.limit = self._int_arg("LIMIT")
+        if self._kw("OFFSET"):
+            stmt.offset = self._int_arg("OFFSET")
+        if self._kw("SLIMIT"):
+            stmt.slimit = self._int_arg("SLIMIT")
+        if self._kw("SOFFSET"):
+            stmt.soffset = self._int_arg("SOFFSET")
+        if self._kw("TZ"):
+            self._expect_op("(")
+            stmt.tz = self.lx.next()[1].strip("'")
+            self._expect_op(")")
+        return stmt
+
+    def parse_select_field(self) -> SelectField:
+        expr = self.parse_expr()
+        alias = None
+        if self._kw("AS"):
+            alias = self._ident()
+        return SelectField(expr, alias)
+
+    def parse_show(self) -> ShowStatement:
+        self._expect_kw("SHOW")
+        k, v, p = self.lx.next()
+        u = v.upper()
+        if u == "DATABASES":
+            return ShowStatement("databases")
+        if u == "MEASUREMENTS":
+            stmt = ShowStatement("measurements")
+        elif u == "SERIES":
+            stmt = ShowStatement("series")
+        elif u == "TAG":
+            w = self.lx.next()[1].upper()
+            stmt = ShowStatement("tag keys" if w == "KEYS" else "tag values")
+        elif u == "FIELD":
+            self._expect_kw("KEYS")
+            stmt = ShowStatement("field keys")
+        elif u == "RETENTION":
+            self._expect_kw("POLICIES")
+            stmt = ShowStatement("retention policies")
+        else:
+            raise ParseError(f"unsupported SHOW {v!r} at {p}")
+        if self._kw("ON"):
+            stmt.on_db = self._ident()
+        if self._kw("FROM"):
+            stmt.from_measurement = self._ident()
+        if self._kw("WITH"):
+            self._expect_kw("KEY")
+            self._expect_op("=")
+            stmt.key = self._ident()
+        if self._kw("WHERE"):
+            stmt.condition = self.parse_expr()
+        if self._kw("LIMIT"):
+            stmt.limit = self._int_arg("LIMIT")
+        if self._kw("OFFSET"):
+            stmt.offset = self._int_arg("OFFSET")
+        return stmt
+
+    def _int_arg(self, what: str) -> int:
+        k, v, p = self.lx.next()
+        if k != "number" or not v.isdigit():
+            raise ParseError(f"{what} requires a non-negative integer, "
+                             f"got {v!r} at {p}")
+        return int(v)
+
+    # ---- expressions -----------------------------------------------------
+
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        lhs = self.parse_and()
+        while self._kw("OR"):
+            lhs = BinaryExpr("or", lhs, self.parse_and())
+        return lhs
+
+    def parse_and(self):
+        lhs = self.parse_cmp()
+        while self._kw("AND"):
+            lhs = BinaryExpr("and", lhs, self.parse_cmp())
+        return lhs
+
+    def parse_cmp(self):
+        lhs = self.parse_additive()
+        while True:
+            k, v, _ = self.lx.peek()
+            if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">=",
+                                   "=~", "!~"):
+                self.lx.next()
+                op = "!=" if v == "<>" else v
+                if op in ("=~", "!~"):
+                    rx = self.lx.try_regex()
+                    if rx is None:
+                        raise ParseError("expected /regex/ after " + op)
+                    lhs = BinaryExpr(op, lhs, Literal(rx))
+                else:
+                    lhs = BinaryExpr(op, lhs, self.parse_additive())
+                continue
+            return lhs
+
+    def parse_additive(self):
+        lhs = self.parse_mult()
+        while True:
+            k, v, _ = self.lx.peek()
+            if k == "op" and v in ("+", "-"):
+                self.lx.next()
+                lhs = BinaryExpr(v, lhs, self.parse_mult())
+                continue
+            return lhs
+
+    def parse_mult(self):
+        lhs = self.parse_primary()
+        while True:
+            k, v, _ = self.lx.peek()
+            if k == "op" and v in ("*", "/", "%"):
+                self.lx.next()
+                lhs = BinaryExpr(v, lhs, self.parse_primary())
+                continue
+            return lhs
+
+    def parse_primary(self):
+        k, v, p = self.lx.peek()
+        if k == "op" and v == "(":
+            self.lx.next()
+            e = self.parse_expr()
+            self._expect_op(")")
+            return e
+        if k == "op" and v == "*":
+            self.lx.next()
+            return Wildcard()
+        if k == "op" and v == "-":
+            self.lx.next()
+            e = self.parse_primary()
+            if isinstance(e, Literal) and isinstance(e.value, (int, float)):
+                return Literal(-e.value)
+            return BinaryExpr("*", Literal(-1), e)
+        if k == "duration":
+            self.lx.next()
+            return Literal(parse_duration(v))
+        if k == "number":
+            self.lx.next()
+            if v.endswith("i"):
+                return Literal(int(v[:-1]))
+            if re.fullmatch(r"\d+", v):
+                return Literal(int(v))
+            return Literal(float(v))
+        if k == "string":
+            self.lx.next()
+            s = re.sub(r"\\(.)", r"\1", v[1:-1])
+            return Literal(s)
+        if k in ("ident", "dquoted"):
+            name = self._ident()
+            u = name.upper()
+            if u == "TRUE":
+                return Literal(True)
+            if u == "FALSE":
+                return Literal(False)
+            if self._op("("):
+                args = []
+                if not self._op(")"):
+                    args.append(self.parse_expr())
+                    while self._op(","):
+                        args.append(self.parse_expr())
+                    self._expect_op(")")
+                call = Call(name.lower(), args)
+                if call.func == "now":
+                    return Literal(self.now_ns)
+                return call
+            # type cast field::tag / field::field — consume and ignore
+            if self._op("::"):
+                self.lx.next()
+            return FieldRef(name)
+        raise ParseError(f"unexpected token {v!r} at {p}")
+
+
+def parse_query(text: str, now_ns: int | None = None) -> list:
+    """Parse one or more ';'-separated statements."""
+    p = Parser(text, now_ns)
+    stmts = p.parse_statements()
+    if not stmts:
+        raise ParseError("empty query")
+    return stmts

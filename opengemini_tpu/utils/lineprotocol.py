@@ -1,0 +1,196 @@
+"""InfluxDB line protocol parser (role of the reference's zero-copy parser,
+lib/util/lifted/vm/protoparser/influx/parser.go).
+
+Syntax:  measurement[,tag=val...] field=value[,field=value...] [timestamp]
+Escapes: '\\,' '\\ ' '\\=' in identifiers/tags; field strings are
+double-quoted with '\\"' escapes. Values: float (default), int with ``i``
+suffix, bool (t/T/true/f/F/false), string ("...").
+"""
+
+from __future__ import annotations
+
+from ..storage.rows import PointRow
+from .errors import ErrInvalidLineProtocol
+
+
+def parse_lines(data: str, default_time_ns: int = 0,
+                precision: str = "ns") -> list[PointRow]:
+    mult = {"ns": 1, "u": 1000, "µ": 1000, "ms": 10**6,
+            "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}.get(precision)
+    if mult is None:
+        raise ErrInvalidLineProtocol(f"bad precision {precision}")
+    rows = []
+    for raw in data.split("\n"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        rows.append(_parse_line(line, default_time_ns, mult))
+    return rows
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    """Split on sep respecting backslash escapes, PRESERVING the escape
+    sequences in the output (unescape happens once, at the end, via
+    _unescape — otherwise nested splits lose track of what was escaped)."""
+    out = []
+    cur = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == sep:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _parse_line(line: str, default_time: int, mult: int) -> PointRow:
+    # split into measurement+tags | fields | timestamp on unescaped,
+    # unquoted spaces
+    parts = []
+    cur = []
+    in_quote = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == "\\" and i + 1 < len(line):
+            cur.append(c)
+            cur.append(line[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+            cur.append(c)
+            i += 1
+            continue
+        if c == " " and not in_quote:
+            if cur:
+                parts.append("".join(cur))
+                cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    if cur:
+        parts.append("".join(cur))
+    if len(parts) < 2 or len(parts) > 3:
+        raise ErrInvalidLineProtocol(f"malformed line: {line!r}")
+
+    head = _split_unescaped(parts[0], ",")
+    measurement = _unescape(head[0])
+    if not measurement:
+        raise ErrInvalidLineProtocol(f"empty measurement: {line!r}")
+    tags = {}
+    for t in head[1:]:
+        kv = _split_unescaped(t, "=")
+        if len(kv) != 2 or not kv[0]:
+            raise ErrInvalidLineProtocol(f"bad tag {t!r} in {line!r}")
+        tags[_unescape(kv[0])] = _unescape(kv[1])
+
+    fields: dict = {}
+    for fpart in _split_fields(parts[1]):
+        eq = _find_unescaped_eq(fpart)
+        if eq < 0:
+            raise ErrInvalidLineProtocol(f"bad field {fpart!r} in {line!r}")
+        fields[_unescape(fpart[:eq])] = _parse_value(fpart[eq + 1:], line)
+    if not fields:
+        raise ErrInvalidLineProtocol(f"no fields: {line!r}")
+
+    if len(parts) == 3:
+        try:
+            ts = int(parts[2]) * mult
+        except ValueError:
+            raise ErrInvalidLineProtocol(f"bad timestamp in {line!r}")
+    else:
+        ts = default_time
+    return PointRow(measurement, tags, fields, ts)
+
+
+def _split_fields(s: str) -> list[str]:
+    """Split the field section on unescaped, unquoted commas."""
+    out = []
+    cur = []
+    in_quote = False
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+            cur.append(c)
+            i += 1
+            continue
+        if c == "," and not in_quote:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _find_unescaped_eq(s: str) -> int:
+    i = 0
+    in_quote = False
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+        elif c == "=" and not in_quote:
+            return i
+        i += 1
+    return -1
+
+
+def _parse_value(v: str, line: str):
+    if not v:
+        raise ErrInvalidLineProtocol(f"empty field value in {line!r}")
+    if v[0] == '"':
+        if len(v) < 2 or v[-1] != '"':
+            raise ErrInvalidLineProtocol(f"bad string value in {line!r}")
+        return v[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if v in ("t", "T", "true", "True", "TRUE"):
+        return True
+    if v in ("f", "F", "false", "False", "FALSE"):
+        return False
+    if v[-1] in ("i", "u"):
+        try:
+            return int(v[:-1])
+        except ValueError:
+            raise ErrInvalidLineProtocol(f"bad int value {v!r} in {line!r}")
+    try:
+        return float(v)
+    except ValueError:
+        raise ErrInvalidLineProtocol(f"bad value {v!r} in {line!r}")

@@ -1,0 +1,147 @@
+"""InfluxQL parser + line protocol tests (reference models: influxql
+parser tests and protoparser tests)."""
+
+import pytest
+
+from opengemini_tpu.query import parse_query, ParseError
+from opengemini_tpu.query.ast import (BinaryExpr, Call, FieldRef, Literal,
+                                      SelectStatement, ShowStatement)
+from opengemini_tpu.query.condition import analyze_condition
+from opengemini_tpu.utils.lineprotocol import parse_lines
+from opengemini_tpu.utils.errors import ErrInvalidLineProtocol
+
+
+# ---- line protocol ----------------------------------------------------------
+
+def test_lp_basic():
+    rows = parse_lines(
+        'cpu,host=a,region=east usage_user=1.5,count=3i,ok=t,msg="hi" 1000')
+    assert len(rows) == 1
+    r = rows[0]
+    assert r.measurement == "cpu"
+    assert r.tags == {"host": "a", "region": "east"}
+    assert r.fields == {"usage_user": 1.5, "count": 3, "ok": True,
+                        "msg": "hi"}
+    assert r.time == 1000
+
+
+def test_lp_escapes_and_quotes():
+    rows = parse_lines(
+        'my\\,mst,ta\\ g=v\\=1 f\\ 1=2,msg="a\\"b, c" 5')
+    r = rows[0]
+    assert r.measurement == "my,mst"
+    assert r.tags == {"ta g": "v=1"}
+    assert r.fields["f 1"] == 2.0
+    assert r.fields["msg"] == 'a"b, c'
+
+
+def test_lp_no_tags_no_time():
+    rows = parse_lines("m value=1", default_time_ns=42)
+    assert rows[0].tags == {} and rows[0].time == 42
+
+
+def test_lp_precision():
+    rows = parse_lines("m v=1 1", precision="s")
+    assert rows[0].time == 10**9
+
+
+def test_lp_errors():
+    for bad in ["novalue", "m ", "m v= 1", "m v=1x 5", 'm v="unclosed 5',
+                ",t=1 v=1"]:
+        with pytest.raises(ErrInvalidLineProtocol):
+            parse_lines(bad)
+
+
+def test_lp_multiline_and_comments():
+    rows = parse_lines("# comment\nm v=1 1\n\nm v=2 2\n")
+    assert [r.time for r in rows] == [1, 2]
+
+
+# ---- influxql parser --------------------------------------------------------
+
+def test_parse_simple_select():
+    (s,) = parse_query("SELECT mean(usage_user) FROM cpu "
+                       "WHERE time >= 0 AND time < 3600000000000 "
+                       "GROUP BY time(1m), hostname")
+    assert isinstance(s, SelectStatement)
+    assert s.from_measurement == "cpu"
+    assert isinstance(s.fields[0].expr, Call)
+    assert s.fields[0].expr.func == "mean"
+    assert s.group_by_interval() == 60 * 10**9
+    assert s.group_by_tags() == ["hostname"]
+
+
+def test_parse_where_time_and_tags():
+    (s,) = parse_query(
+        "SELECT max(v) FROM m WHERE time >= '2020-01-01T00:00:00Z' "
+        "AND time <= '2020-01-02T00:00:00Z' AND host = 'h1' AND dc != 'w'")
+    cond = analyze_condition(s.condition, {"host", "dc"})
+    assert cond.t_min == 1577836800 * 10**9
+    assert cond.t_max == 1577923200 * 10**9
+    assert {(f.key, f.value, f.op) for f in cond.tag_filters} == {
+        ("host", "h1", "="), ("dc", "w", "!=")}
+    assert cond.residual is None
+
+
+def test_parse_now_arithmetic():
+    (s,) = parse_query("SELECT mean(v) FROM m WHERE time > now() - 1h",
+                       now_ns=10**13)
+    cond = analyze_condition(s.condition, set())
+    assert cond.t_min == 10**13 - 3600 * 10**9 + 1
+
+
+def test_parse_regex_tag_filter():
+    (s,) = parse_query("SELECT v FROM m WHERE host =~ /web-[0-9]+/")
+    cond = analyze_condition(s.condition, {"host"})
+    assert cond.tag_filters == [__import__(
+        "opengemini_tpu.index", fromlist=["TagFilter"]
+    ).TagFilter("host", "web-[0-9]+", "=~")]
+
+
+def test_parse_fill_limit_order():
+    (s,) = parse_query("SELECT sum(v) FROM m GROUP BY time(5m) fill(0) "
+                       "ORDER BY time DESC LIMIT 10 OFFSET 5 SLIMIT 2")
+    assert s.fill_option == "value" and s.fill_value == 0
+    assert s.order_desc and s.limit == 10 and s.offset == 5 and s.slimit == 2
+
+
+def test_parse_quoted_identifiers_and_db_qualified():
+    (s,) = parse_query('SELECT "usage user" FROM "my db".."my mst"')
+    assert s.from_db == "my db" and s.from_measurement == "my mst"
+    assert isinstance(s.fields[0].expr, FieldRef)
+    assert s.fields[0].expr.name == "usage user"
+
+
+def test_parse_show_statements():
+    (s,) = parse_query("SHOW MEASUREMENTS ON db0")
+    assert isinstance(s, ShowStatement) and s.what == "measurements"
+    (s,) = parse_query("SHOW TAG VALUES FROM cpu WITH KEY = host")
+    assert s.what == "tag values" and s.key == "host"
+    (s,) = parse_query("SHOW DATABASES")
+    assert s.what == "databases"
+    (s,) = parse_query("SHOW FIELD KEYS FROM cpu")
+    assert s.what == "field keys"
+
+
+def test_parse_multiple_statements():
+    stmts = parse_query("CREATE DATABASE x; SELECT v FROM m")
+    assert len(stmts) == 2
+
+
+def test_parse_field_condition_residual():
+    (s,) = parse_query("SELECT v FROM m WHERE v > 90 AND host = 'a'")
+    cond = analyze_condition(s.condition, {"host"})
+    assert len(cond.tag_filters) == 1
+    assert cond.residual is not None
+
+
+def test_parse_errors():
+    for bad in ["SELECT", "SELECT FROM m", "FROBNICATE x",
+                "SELECT v FROM m GROUP time(1m)"]:
+        with pytest.raises(ParseError):
+            parse_query(bad)
+
+
+def test_parse_alias_and_arith():
+    (s,) = parse_query("SELECT mean(v) AS avg_v FROM m")
+    assert s.fields[0].alias == "avg_v"

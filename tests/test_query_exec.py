@@ -1,0 +1,233 @@
+"""End-to-end query execution: line protocol in → InfluxQL out (the
+in-process analog of the reference's black-box suite tests/server_test.go)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp: str):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text: str, now_ns=None):
+    (stmt,) = parse_query(text, now_ns=now_ns)
+    return ex.execute(stmt, "db0")
+
+
+MIN = 60 * 10**9
+
+
+def seed_cpu(eng, hosts=3, minutes=4, per_min=6):
+    lines = []
+    step = MIN // per_min
+    for h in range(hosts):
+        for i in range(minutes * per_min):
+            t = i * step
+            lines.append(
+                f"cpu,host=h{h},dc=dc{h % 2} "
+                f"usage_user={h * 10 + (i % per_min)},cnt={i}i {t}")
+    write(eng, "\n".join(lines))
+
+
+def test_mean_group_by_time_and_host(db):
+    eng, ex = db
+    seed_cpu(eng)
+    res = q(ex, "SELECT mean(usage_user) FROM cpu WHERE time >= 0 AND "
+                "time < 4m GROUP BY time(1m), host")
+    assert "series" in res
+    series = res["series"]
+    assert len(series) == 3
+    s0 = [s for s in series if s["tags"] == {"host": "h0"}][0]
+    assert s0["columns"] == ["time", "mean"]
+    # mean of 0..5 = 2.5 for h0, every window
+    assert [r[1] for r in s0["values"]] == [2.5] * 4
+    assert [r[0] for r in s0["values"]] == [0, MIN, 2 * MIN, 3 * MIN]
+    s2 = [s for s in series if s["tags"] == {"host": "h2"}][0]
+    assert [r[1] for r in s2["values"]] == [22.5] * 4
+
+
+def test_count_sum_min_max_first_last_spread(db):
+    eng, ex = db
+    write(eng, "m,h=a v=1 1000\nm,h=a v=5 2000\nm,h=a v=3 3000")
+    res = q(ex, "SELECT count(v), sum(v), min(v), max(v), first(v), "
+                "last(v), spread(v) FROM m")
+    row = res["series"][0]["values"][0]
+    # columns: time count sum min max first last spread
+    assert row[1:] == [3, 9.0, 1.0, 5.0, 1.0, 3.0, 4.0]
+
+
+def test_agg_int_field_returns_int(db):
+    eng, ex = db
+    write(eng, "m c=1i 1\nm c=2i 2")
+    res = q(ex, "SELECT sum(c), mean(c) FROM m")
+    row = res["series"][0]["values"][0]
+    assert row[1] == 3 and isinstance(row[1], int)
+    assert row[2] == 1.5
+
+
+def test_fill_options(db):
+    eng, ex = db
+    # window 1 empty (no points in [1m, 2m))
+    write(eng, f"m v=1 0\nm v=2 {2 * MIN}")
+    base = ("SELECT sum(v) FROM m WHERE time >= 0 AND time < 3m "
+            "GROUP BY time(1m) ")
+    vals = q(ex, base)["series"][0]["values"]
+    assert vals == [[0, 1.0], [MIN, None], [2 * MIN, 2.0]]
+    vals = q(ex, base + "fill(0)")["series"][0]["values"]
+    assert vals[1] == [MIN, 0.0]
+    vals = q(ex, base + "fill(none)")["series"][0]["values"]
+    assert len(vals) == 2
+    vals = q(ex, base + "fill(previous)")["series"][0]["values"]
+    assert vals[1] == [MIN, 1.0]
+
+
+def test_raw_select(db):
+    eng, ex = db
+    write(eng, "m,h=a v=1,w=10 1000\nm,h=b v=2 2000")
+    res = q(ex, "SELECT v, w FROM m")
+    s = res["series"][0]
+    assert s["columns"] == ["time", "v", "w"]
+    assert s["values"] == [[1000, 1.0, 10.0], [2000, 2.0, None]]
+
+
+def test_raw_select_group_by_tag_and_wildcard(db):
+    eng, ex = db
+    write(eng, "m,h=a v=1 1000\nm,h=b v=2 2000")
+    res = q(ex, "SELECT * FROM m GROUP BY h")
+    assert len(res["series"]) == 2
+    assert res["series"][0]["tags"] == {"h": "a"}
+    res2 = q(ex, "SELECT v FROM m WHERE h = 'b'")
+    assert res2["series"][0]["values"] == [[2000, 2.0]]
+
+
+def test_field_predicate_residual(db):
+    eng, ex = db
+    write(eng, "m v=1 1\nm v=95 2\nm v=50 3")
+    res = q(ex, "SELECT v FROM m WHERE v > 40")
+    assert [r[1] for r in res["series"][0]["values"]] == [95.0, 50.0]
+    res = q(ex, "SELECT count(v) FROM m WHERE v > 40")
+    assert res["series"][0]["values"][0][1] == 2
+
+
+def test_limit_offset_order(db):
+    eng, ex = db
+    write(eng, "\n".join(f"m v={i} {i}" for i in range(10)))
+    res = q(ex, "SELECT v FROM m ORDER BY time DESC LIMIT 3 OFFSET 1")
+    assert [r[0] for r in res["series"][0]["values"]] == [8, 7, 6]
+
+
+def test_agg_no_group_by_time_whole_range(db):
+    eng, ex = db
+    seed_cpu(eng, hosts=2, minutes=1)
+    res = q(ex, "SELECT mean(usage_user) FROM cpu GROUP BY host")
+    assert len(res["series"]) == 2
+    assert res["series"][0]["values"][0][1] == 2.5
+
+
+def test_show_statements_exec(db):
+    eng, ex = db
+    seed_cpu(eng, hosts=2, minutes=1)
+    assert q(ex, "SHOW MEASUREMENTS")["series"][0]["values"] == [["cpu"]]
+    tk = q(ex, "SHOW TAG KEYS FROM cpu")["series"][0]["values"]
+    assert tk == [["dc"], ["host"]]
+    tv = q(ex, "SHOW TAG VALUES FROM cpu WITH KEY = host")
+    assert tv["series"][0]["values"] == [["host", "h0"], ["host", "h1"]]
+    fk = q(ex, "SHOW FIELD KEYS FROM cpu")["series"][0]["values"]
+    assert fk == [["cnt", "integer"], ["usage_user", "float"]]
+    sr = q(ex, "SHOW SERIES")["series"][0]["values"]
+    assert ["cpu,dc=dc0,host=h0"] in sr
+
+
+def test_create_drop_database(db):
+    eng, ex = db
+    (stmt,) = parse_query("CREATE DATABASE mydb")
+    assert ex.execute(stmt) == {}
+    assert "mydb" in eng.databases
+    (stmt,) = parse_query("DROP DATABASE mydb")
+    ex.execute(stmt)
+    assert "mydb" not in eng.databases
+
+
+def test_agg_across_flush_boundary(db):
+    eng, ex = db
+    write(eng, "m v=1 0\nm v=2 1000")
+    eng.flush_all()
+    write(eng, "m v=3 2000")
+    res = q(ex, "SELECT sum(v), count(v) FROM m")
+    assert res["series"][0]["values"][0][1:] == [6.0, 3]
+
+
+def test_error_mixed_agg_raw(db):
+    eng, ex = db
+    write(eng, "m v=1 0")
+    res = q(ex, "SELECT v, mean(v) FROM m")
+    assert "error" in res
+
+
+def test_where_on_unselected_field(db):
+    eng, ex = db
+    write(eng, "m v=1,w=100 1\nm v=2,w=1 2")
+    res = q(ex, "SELECT v FROM m WHERE w > 50")
+    assert res["series"][0]["values"] == [[1, 1.0]]
+    res = q(ex, "SELECT count(v) FROM m WHERE w > 50")
+    assert res["series"][0]["values"][0][1] == 1
+
+
+def test_or_with_null_operand(db):
+    eng, ex = db
+    write(eng, "m v=10,w=1 1\nm v=10 2\nm w=99 3")
+    res = q(ex, "SELECT v, w FROM m WHERE v > 5 OR w > 50")
+    times = [r[0] for r in res["series"][0]["values"]]
+    assert times == [1, 2, 3]  # null comparison is false, not poisonous
+
+
+def test_agg_series_sorted_by_tag(db):
+    eng, ex = db
+    # second shard (1w later) introduces host z first
+    write(eng, f"m,h=z v=1 {7*24*3600*10**9}\nm,h=a v=2 0")
+    res = q(ex, "SELECT sum(v) FROM m GROUP BY h")
+    assert [s["tags"]["h"] for s in res["series"]] == ["a", "z"]
+
+
+def test_ns_precision_time_literal(db):
+    eng, ex = db
+    write(eng, "m v=7 1577836800000000001")
+    res = q(ex, "SELECT v FROM m WHERE "
+                "time = '2020-01-01T00:00:00.000000001Z'")
+    assert res["series"][0]["values"] == [[1577836800000000001, 7.0]]
+
+
+def test_fill_negative_and_bad_limit():
+    from opengemini_tpu.query import ParseError
+    (s,) = parse_query("SELECT sum(v) FROM m GROUP BY time(1m) fill(-1)")
+    assert s.fill_option == "value" and s.fill_value == -1.0
+    with pytest.raises(ParseError):
+        parse_query("SELECT v FROM m LIMIT x")
+    with pytest.raises(ParseError):
+        parse_query("SELECT v FROM m GROUP BY time(1m) fill(bogus)")
+
+
+def test_show_limit_offset(db):
+    eng, ex = db
+    seed_cpu(eng, hosts=3, minutes=1)
+    tv = q(ex, "SHOW TAG VALUES FROM cpu WITH KEY = host LIMIT 2 OFFSET 1")
+    assert tv["series"][0]["values"] == [["host", "h1"], ["host", "h2"]]
+
+
+def test_unknown_db_and_empty_result(db):
+    eng, ex = db
+    (stmt,) = parse_query("SELECT v FROM nothing")
+    res = ex.execute(stmt, "db0")
+    assert res == {} or "series" not in res
