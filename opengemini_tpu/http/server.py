@@ -1,0 +1,267 @@
+"""InfluxDB-1.x-compatible HTTP API (role of the reference httpd layer,
+lib/util/lifted/influx/httpd/handler.go:223-496 route table; serveWrite
+:1260; serveQuery :1002).
+
+Endpoints:
+    POST /write?db=<db>[&precision=ns|u|ms|s|m|h]   line protocol (gzip ok)
+    GET/POST /query?q=<influxql>[&db=][&epoch=]     JSON results
+    GET  /ping                                      204
+    GET  /health                                    JSON status
+    GET  /debug/vars                                runtime stats
+
+Python stdlib ThreadingHTTPServer: the data plane is the TPU compute path,
+the HTTP layer only parses/formats; a C++ ingest front-end can replace this
+behind the same API surface.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import __version__
+from ..query import QueryExecutor, ParseError, parse_query
+from ..utils import get_logger
+from ..utils.errors import GeminiError
+from ..utils.lineprotocol import PRECISION_NS, parse_lines
+
+log = get_logger(__name__)
+
+
+class HttpServer:
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8086):
+        self.engine = engine
+        self.executor = QueryExecutor(engine)
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.stats = {"writes": 0, "points_written": 0, "queries": 0,
+                      "write_errors": 0, "query_errors": 0,
+                      "started_at": time.time()}
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        # initialize the JAX backend from the MAIN thread: plugin discovery
+        # (axon) can fail when first touched from a request worker thread
+        try:
+            import jax
+            jax.devices()
+        except Exception as e:  # pragma: no cover
+            log.warning("jax backend init failed: %s", e)
+        outer = self
+
+        class Handler(_Handler):
+            server_ref = outer
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="httpd", daemon=True)
+        self._thread.start()
+        log.info("http listening on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # ----------------------------------------------------------- handlers
+
+    def handle_write(self, params: dict, body: bytes) -> tuple[int, dict]:
+        db = params.get("db")
+        if not db:
+            return 400, {"error": "database is required"}
+        precision = params.get("precision", "ns")
+        try:
+            rows = parse_lines(body.decode("utf-8"),
+                               default_time_ns=int(time.time() * 1e9),
+                               precision=precision)
+            n = self.engine.write_points(db, rows)
+        except GeminiError as e:
+            self._bump("write_errors")
+            return 400, {"error": str(e)}
+        except UnicodeDecodeError:
+            self._bump("write_errors")
+            return 400, {"error": "body must be utf-8 line protocol"}
+        except Exception as e:  # engine bug must not kill the connection
+            log.exception("write failed")
+            self._bump("write_errors")
+            return 500, {"error": f"internal error: {e}"}
+        self._bump("writes")
+        self._bump("points_written", n)
+        return 204, {}
+
+    def handle_query(self, params: dict) -> tuple[int, dict]:
+        qtext = params.get("q")
+        if not qtext:
+            return 400, {"error": "missing required parameter \"q\""}
+        db = params.get("db")
+        epoch = params.get("epoch")
+        self._bump("queries")
+        try:
+            stmts = parse_query(qtext)
+        except ParseError as e:
+            self._bump("query_errors")
+            return 400, {"error": f"error parsing query: {e}"}
+        results = []
+        for i, stmt in enumerate(stmts):
+            try:
+                res = self.executor.execute(stmt, db)
+            except Exception as e:  # an executor bug must not kill the conn
+                log.exception("query execution failed: %s", qtext)
+                res = {"error": f"internal error: {e}"}
+            res = dict(res)
+            res["statement_id"] = i
+            if epoch and "series" in res:
+                _convert_epoch(res["series"], epoch)
+            if "error" in res:
+                self._bump("query_errors")
+            results.append(res)
+        return 200, {"results": results}
+
+
+def _convert_epoch(series: list, epoch: str) -> None:
+    div = PRECISION_NS.get(epoch)
+    if div is None or div == 1:
+        return
+    for s in series:
+        if s.get("columns") and s["columns"][0] == "time":
+            for row in s["values"]:
+                row[0] = row[0] // div
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: HttpServer = None  # type: ignore
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route to our logger, not stderr
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    # ---- helpers ---------------------------------------------------------
+
+    def _params(self) -> dict:
+        u = urllib.parse.urlparse(self.path)
+        return {k: v[0] for k, v in
+                urllib.parse.parse_qs(u.query).items()}
+
+    def _path(self) -> str:
+        return urllib.parse.urlparse(self.path).path
+
+    def _body(self) -> bytes:
+        ln = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(ln) if ln else b""
+        if self.headers.get("Content-Encoding") == "gzip":
+            raw = gzip.decompress(raw)
+        return raw
+
+    def _reply(self, code: int, payload: dict | None = None,
+               headers: dict | None = None) -> None:
+        body = (json.dumps(payload).encode() + b"\n") if payload is not None \
+            else b""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("X-Influxdb-Version", "1.8-opengemini-tpu-"
+                         + __version__)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    # ---- methods ---------------------------------------------------------
+
+    def do_GET(self):
+        srv = self.server_ref
+        path = self._path()
+        if path == "/ping":
+            self._reply(204)
+            return
+        if path == "/health":
+            self._reply(200, {"name": "opengemini-tpu", "status": "pass",
+                              "version": __version__})
+            return
+        if path == "/debug/vars":
+            self._reply(200, srv.stats)
+            return
+        if path == "/query":
+            code, payload = srv.handle_query(self._params())
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": f"not found: {path}"})
+
+    def do_POST(self):
+        srv = self.server_ref
+        path = self._path()
+        if path == "/write":
+            try:
+                body = self._body()
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload = srv.handle_write(self._params(), body)
+            self._reply(code, payload if code != 204 else None)
+            return
+        if path == "/query":
+            params = self._params()
+            try:
+                ctype = self.headers.get("Content-Type", "")
+                body = self._body()
+                if body and "application/x-www-form-urlencoded" in ctype:
+                    form = {k: v[0] for k, v in
+                            urllib.parse.parse_qs(body.decode()).items()}
+                    form.update(params)
+                    params = form
+            except Exception as e:  # bad gzip / non-utf8 form body
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload = srv.handle_query(params)
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": f"not found: {path}"})
+
+    def do_HEAD(self):
+        if self._path() == "/ping":
+            self._reply(204)
+        else:
+            self._reply(404)
+
+
+def main():
+    import argparse
+    from ..storage import Engine, EngineOptions
+
+    ap = argparse.ArgumentParser(description="opengemini-tpu single node")
+    ap.add_argument("--data", default="./data")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8086)
+    ap.add_argument("--wal-sync", action="store_true")
+    args = ap.parse_args()
+    eng = Engine(args.data, EngineOptions(wal_sync=args.wal_sync))
+    srv = HttpServer(eng, args.host, args.port)
+    srv.start()
+    log.info("ts-server (single node) ready")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+        eng.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from .mesh import (make_mesh, distributed_window_aggregate,
+                   DistributedAggregator)

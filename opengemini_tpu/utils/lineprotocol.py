@@ -12,11 +12,15 @@ from __future__ import annotations
 from ..storage.rows import PointRow
 from .errors import ErrInvalidLineProtocol
 
+# ns multiplier per precision unit — the single source of truth shared by
+# the write path (timestamp scaling) and query epoch conversion
+PRECISION_NS = {"ns": 1, "u": 1000, "µ": 1000, "ms": 10**6,
+                "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
+
 
 def parse_lines(data: str, default_time_ns: int = 0,
                 precision: str = "ns") -> list[PointRow]:
-    mult = {"ns": 1, "u": 1000, "µ": 1000, "ms": 10**6,
-            "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}.get(precision)
+    mult = PRECISION_NS.get(precision)
     if mult is None:
         raise ErrInvalidLineProtocol(f"bad precision {precision}")
     rows = []

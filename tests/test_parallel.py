@@ -1,0 +1,43 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+
+from opengemini_tpu.parallel import DistributedAggregator, make_mesh
+
+rng = np.random.default_rng(3)
+
+
+def test_distributed_matches_single(eight_devices):
+    C, N, S = 2, 4096, 24
+    vals = rng.normal(0, 1, (C, N))
+    valid = rng.random((C, N)) > 0.1
+    seg = rng.integers(0, S, N).astype(np.int64)
+
+    mesh = make_mesh(n_data=4, n_field=2, devices=eight_devices)
+    agg = DistributedAggregator(mesh)
+    dv, dm, ds = agg.shard_inputs(vals, valid, seg)
+    out = agg(dv, dm, ds, S)
+
+    # reference: single-device numpy
+    for c in range(C):
+        cnt = np.bincount(seg, weights=valid[c].astype(np.int64),
+                          minlength=S)
+        s = np.bincount(seg[valid[c]], weights=vals[c][valid[c]],
+                        minlength=S)
+        np.testing.assert_array_equal(np.asarray(out["count"])[c], cnt)
+        np.testing.assert_allclose(np.asarray(out["sum"])[c], s, rtol=1e-12)
+        mn = np.full(S, np.inf)
+        mx = np.full(S, -np.inf)
+        for i in range(N):
+            if valid[c, i]:
+                mn[seg[i]] = min(mn[seg[i]], vals[c, i])
+                mx[seg[i]] = max(mx[seg[i]], vals[c, i])
+        np.testing.assert_array_equal(np.asarray(out["min"])[c], mn)
+        np.testing.assert_array_equal(np.asarray(out["max"])[c], mx)
+
+
+def test_mesh_shapes(eight_devices):
+    m = make_mesh(devices=eight_devices)
+    assert m.devices.shape == (8, 1)
+    m2 = make_mesh(n_field=4, devices=eight_devices)
+    assert m2.devices.shape == (2, 4)
