@@ -8,6 +8,9 @@ Endpoints:
     GET  /ping                                      204
     GET  /health                                    JSON status
     GET  /debug/vars                                runtime stats
+    GET/POST /api/v1/query, /api/v1/query_range     PromQL (handler_prom.go
+        :362,:367 analog); /api/v1/labels :637, /api/v1/label/<n>/values,
+        /api/v1/series :721
 
 Python stdlib ThreadingHTTPServer: the data plane is the TPU compute path,
 the HTTP layer only parses/formats; a C++ ingest front-end can replace this
@@ -33,9 +36,13 @@ log = get_logger(__name__)
 
 
 class HttpServer:
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8086):
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8086,
+                 prom_db: str = "prometheus"):
+        from ..promql import PromEngine
         self.engine = engine
         self.executor = QueryExecutor(engine)
+        self.prom = PromEngine(engine, prom_db)
+        self.prom_db = prom_db
         self.host = host
         self.port = port
         self._httpd: ThreadingHTTPServer | None = None
@@ -131,6 +138,114 @@ class HttpServer:
             results.append(res)
         return 200, {"results": results}
 
+    # --------------------------------------------------- prom endpoints
+
+    def handle_prom(self, path: str, params: dict,
+                    multi: dict | None = None) -> tuple[int, dict]:
+        """Parse/format only — evaluation and metadata lookups live in
+        PromEngine. `multi` carries repeatable params (match[])."""
+        from ..promql import PromParseError
+        from ..promql.engine import PromQLError
+
+        def err(code, etype, msg):
+            return code, {"status": "error", "errorType": etype,
+                          "error": msg}
+
+        is_query = path in ("/api/v1/query", "/api/v1/query_range")
+        if is_query:
+            self._bump("queries")
+        try:
+            if path == "/api/v1/query":
+                q = params.get("query")
+                if not q:
+                    return err(400, "bad_data", "query missing")
+                t = _prom_time(params.get("time"), time.time())
+                data = self.prom.query_instant(q, t)
+                return 200, {"status": "success",
+                             "data": {"resultType": "vector",
+                                      "result": data}}
+            if path == "/api/v1/query_range":
+                q = params.get("query")
+                if not q:
+                    return err(400, "bad_data", "query missing")
+                start = _prom_time(params.get("start"), None)
+                end = _prom_time(params.get("end"), None)
+                step = _prom_duration(params.get("step"))
+                if start is None or end is None or step is None:
+                    return err(400, "bad_data",
+                               "start/end/step are required")
+                if end < start:
+                    return err(400, "bad_data", "end before start")
+                data = self.prom.query_range(q, start, end, step)
+                return 200, {"status": "success",
+                             "data": {"resultType": "matrix",
+                                      "result": data}}
+            if path == "/api/v1/labels":
+                return 200, {"status": "success",
+                             "data": self.prom.labels()}
+            if path.startswith("/api/v1/label/") and \
+                    path.endswith("/values"):
+                name = path[len("/api/v1/label/"):-len("/values")]
+                return 200, {"status": "success",
+                             "data": self.prom.label_values(name)}
+            if path == "/api/v1/series":
+                sels = (multi or {}).get("match[]") or (
+                    [params["match[]"]] if "match[]" in params else [])
+                if not sels:
+                    return err(400, "bad_data", "match[] missing")
+                return 200, {"status": "success",
+                             "data": self.prom.series(sels)}
+            return err(404, "bad_data", f"unknown prom endpoint {path}")
+        except (PromParseError, PromQLError, _PromBadParam) as e:
+            if is_query:
+                self._bump("query_errors")
+            return err(400, "bad_data", str(e))
+        except Exception as e:
+            if is_query:
+                self._bump("query_errors")
+            log.exception("prom query failed")
+            return err(500, "internal", str(e))
+
+
+class _PromBadParam(Exception):
+    pass
+
+
+def _prom_time(s: str | None, default) -> int | None:
+    """Prom time param: unix seconds (float) or RFC3339 → ns."""
+    if s is None:
+        return int(default * 1e9) if default is not None else None
+    try:
+        return int(float(s) * 1e9)
+    except OverflowError:
+        raise _PromBadParam(f"time value out of range: {s!r}")
+    except ValueError:
+        pass
+    from ..query.influxql import ParseError, parse_time_literal
+    try:
+        return parse_time_literal(s)
+    except ParseError:
+        raise _PromBadParam(f"invalid time value: {s!r}")
+
+
+def _prom_duration(s: str | None) -> int | None:
+    if not s:
+        return None
+    try:
+        v = float(s)
+        if v <= 0:
+            raise _PromBadParam(f"step must be positive: {s!r}")
+        return int(v * 1e9)
+    except OverflowError:
+        raise _PromBadParam(f"step out of range: {s!r}")
+    except ValueError:
+        pass
+    from ..promql.parser import PromParseError, parse_duration
+    try:
+        return parse_duration(s)
+    except PromParseError:
+        raise _PromBadParam(f"invalid step: {s!r}")
+
 
 def _convert_epoch(series: list, epoch: str) -> None:
     div = PRECISION_NS.get(epoch)
@@ -155,6 +270,22 @@ class _Handler(BaseHTTPRequestHandler):
         u = urllib.parse.urlparse(self.path)
         return {k: v[0] for k, v in
                 urllib.parse.parse_qs(u.query).items()}
+
+    def _params_multi(self) -> dict:
+        u = urllib.parse.urlparse(self.path)
+        return urllib.parse.parse_qs(u.query)
+
+    def _form_params(self, params: dict) -> dict:
+        """Merge an x-www-form-urlencoded POST body under the URL params
+        (URL wins). Non-form bodies are ignored."""
+        ctype = self.headers.get("Content-Type", "")
+        body = self._body()
+        if body and "application/x-www-form-urlencoded" in ctype:
+            form = {k: v[0] for k, v in
+                    urllib.parse.parse_qs(body.decode()).items()}
+            form.update(params)
+            return form
+        return params
 
     def _path(self) -> str:
         return urllib.parse.urlparse(self.path).path
@@ -200,6 +331,11 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = srv.handle_query(self._params())
             self._reply(code, payload)
             return
+        if path.startswith("/api/v1/"):
+            code, payload = srv.handle_prom(path, self._params(),
+                                            self._params_multi())
+            self._reply(code, payload)
+            return
         self._reply(404, {"error": f"not found: {path}"})
 
     def do_POST(self):
@@ -215,19 +351,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, payload if code != 204 else None)
             return
         if path == "/query":
-            params = self._params()
             try:
-                ctype = self.headers.get("Content-Type", "")
-                body = self._body()
-                if body and "application/x-www-form-urlencoded" in ctype:
-                    form = {k: v[0] for k, v in
-                            urllib.parse.parse_qs(body.decode()).items()}
-                    form.update(params)
-                    params = form
+                params = self._form_params(self._params())
             except Exception as e:  # bad gzip / non-utf8 form body
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
             code, payload = srv.handle_query(params)
+            self._reply(code, payload)
+            return
+        if path.startswith("/api/v1/"):
+            try:
+                params = self._form_params(self._params())
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload = srv.handle_prom(path, params,
+                                            self._params_multi())
             self._reply(code, payload)
             return
         self._reply(404, {"error": f"not found: {path}"})

@@ -1,0 +1,248 @@
+"""PromQL range/instant vector kernels.
+
+Role of the reference's prom cursors (engine/prom_range_vector_cursor.go:34
+window logic :92-167, engine/prom_instant_vector_cursor.go, reduce funcs
+engine/prom_functions.go, series_agg_func_prom.go).
+
+TPU-first formulation of overlapping range windows: a range query evaluates
+rate(x[R]) at steps t_0, t_0+step, ... — windows overlap whenever R > step.
+Instead of replicating rows into every window they touch (R/step× blowup),
+we compute **disjoint per-(series, step-bucket) partial states** with one
+segment reduction, then merge k = R/step consecutive bucket states per eval
+point with a fold over k shifted state arrays (bucket states form a monoid:
+first/last pick, count/sum/increase add with boundary reset correction).
+O(rows) + O(series × buckets × k) vector ops, no scatter blowup.
+
+Alignment: eval timestamps and bucket edges share the step grid; R must be
+a multiple of step (common dashboard case). Non-aligned R is rounded up to
+the next step multiple (documented deviation; exactness restored when
+step | R).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_I64 = jnp.int64
+
+
+class BucketState(NamedTuple):
+    """Partial state of one (series, step-bucket): a monoid under
+    chronological merge."""
+    count: jax.Array        # valid samples
+    first: jax.Array        # value at earliest sample
+    last: jax.Array         # value at latest sample
+    first_t: jax.Array      # ns
+    last_t: jax.Array       # ns
+    sum: jax.Array
+    min: jax.Array
+    max: jax.Array
+    inc: jax.Array          # reset-corrected increase WITHIN the bucket
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def bucket_states(values, valid, times, seg_ids, series_ids,
+                  num_segments: int) -> BucketState:
+    """One fused pass: rows (sorted by series, then time) → per-segment
+    BucketState. seg_ids = series_index * num_buckets + bucket. series_ids
+    identify series-change boundaries for the reset correction."""
+    ns = num_segments + 1
+    n = values.shape[0]
+    fdt = values.dtype
+    idx = jnp.arange(n, dtype=_I64)
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, seg_ids, ns)[:num_segments]
+
+    cnt = seg_sum(valid.astype(_I64))
+    ssum = seg_sum(jnp.where(valid, values, jnp.zeros((), fdt)))
+    smin = jax.ops.segment_min(
+        jnp.where(valid, values, jnp.array(jnp.inf, fdt)), seg_ids,
+        ns)[:num_segments]
+    smax = jax.ops.segment_max(
+        jnp.where(valid, values, jnp.array(-jnp.inf, fdt)), seg_ids,
+        ns)[:num_segments]
+    fi = jax.ops.segment_min(jnp.where(valid, idx, n), seg_ids,
+                             ns)[:num_segments]
+    li = jax.ops.segment_max(jnp.where(valid, idx, -1), seg_ids,
+                             ns)[:num_segments]
+    fsafe = jnp.minimum(fi, n - 1)
+    lsafe = jnp.maximum(li, 0)
+    has_f = fi < n
+    first = jnp.where(has_f, values[fsafe], jnp.nan)
+    first_t = jnp.where(has_f, times[fsafe], 0)
+    last = jnp.where(li >= 0, values[lsafe], jnp.nan)
+    last_t = jnp.where(li >= 0, times[lsafe], 0)
+
+    # reset-corrected within-bucket increase: for consecutive valid samples
+    # of the SAME series and bucket, step increase = cur - prev if cur>=prev
+    # else cur (counter reset); summed per segment
+    prev_v = jnp.roll(values, 1)
+    same = (jnp.roll(seg_ids, 1) == seg_ids) & valid & jnp.roll(valid, 1)
+    same = same.at[0].set(False)
+    step_inc = jnp.where(values >= prev_v, values - prev_v, values)
+    inc = seg_sum(jnp.where(same, step_inc, jnp.zeros((), fdt)))
+
+    return BucketState(cnt, first, last, first_t, last_t, ssum, smin, smax,
+                       inc)
+
+
+def _merge(a: BucketState, b: BucketState) -> BucketState:
+    """Merge chronologically adjacent states (a earlier than b)."""
+    a_has = a.count > 0
+    b_has = b.count > 0
+    first = jnp.where(a_has, a.first, b.first)
+    first_t = jnp.where(a_has, a.first_t, b.first_t)
+    last = jnp.where(b_has, b.last, a.last)
+    last_t = jnp.where(b_has, b.last_t, a.last_t)
+    # boundary reset correction between a.last and b.first
+    both = a_has & b_has
+    boundary = jnp.where(
+        both,
+        jnp.where(b.first >= a.last, b.first - a.last, b.first),
+        0.0)
+    inc = (jnp.where(a_has, a.inc, 0.0) + jnp.where(b_has, b.inc, 0.0)
+           + boundary)
+    return BucketState(
+        count=a.count + b.count,
+        first=first, last=last, first_t=first_t, last_t=last_t,
+        sum=jnp.where(a_has, a.sum, 0.0) + jnp.where(b_has, b.sum, 0.0),
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+        inc=inc)
+
+
+def _shift_right(s: BucketState, by: int) -> BucketState:
+    """Shift bucket axis (last axis) right by `by` (earlier buckets move
+    toward the eval position); vacated slots become empty states."""
+    def sh(x, fill):
+        y = jnp.roll(x, by, axis=-1)
+        mask_idx = jnp.arange(x.shape[-1]) < by
+        return jnp.where(mask_idx, jnp.asarray(fill, y.dtype), y)
+    return BucketState(
+        count=sh(s.count, 0), first=sh(s.first, jnp.nan),
+        last=sh(s.last, jnp.nan), first_t=sh(s.first_t, 0),
+        last_t=sh(s.last_t, 0), sum=sh(s.sum, 0.0),
+        min=sh(s.min, jnp.inf), max=sh(s.max, -jnp.inf),
+        inc=sh(s.inc, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def fold_windows(states: BucketState, k: int) -> BucketState:
+    """states: (G, B) per-bucket; returns (G, B) where slot b holds the
+    merged state of buckets (b-k, b] — the range window ending at bucket b.
+    Fold over k shifted copies, earliest first (log(k) merges possible;
+    linear fold keeps the reset-correction order exact)."""
+    acc = _shift_right(states, k - 1)
+    for i in range(k - 2, -1, -1):
+        acc = _merge(acc, _shift_right(states, i))
+    return acc
+
+
+# ---------------------------------------------------------------- functions
+
+def prom_rate(win: BucketState, window_end_t, range_ns: int,
+              kind: str = "rate"):
+    """Prometheus extrapolated rate/increase/delta over merged window
+    states (promql extrapolatedRate semantics: extrapolate the sampled
+    slope to the window boundaries, limited to half a sample interval /
+    zero-crossing)."""
+    cnt = win.count
+    ok = cnt >= 2
+    dur = (win.last_t - win.first_t).astype(jnp.float64) / 1e9
+    dur = jnp.maximum(dur, 1e-12)
+    if kind == "delta":
+        delta = win.last - win.first
+    else:
+        delta = win.inc
+    rng_s = range_ns / 1e9
+    # extrapolation (prom extrapolatedRate): window is (end-range, end]
+    start_gap = (win.first_t - (window_end_t - range_ns)).astype(
+        jnp.float64) / 1e9
+    end_gap = (window_end_t - win.last_t).astype(jnp.float64) / 1e9
+    avg_interval = dur / jnp.maximum(cnt - 1, 1).astype(jnp.float64)
+    extra_start = jnp.minimum(start_gap, avg_interval / 2)
+    extra_end = jnp.minimum(end_gap, avg_interval / 2)
+    # counters can't go below zero: limit start extrapolation
+    with np.errstate(divide="ignore", invalid="ignore"):
+        zero_limit = jnp.where(
+            (kind != "delta") & (delta > 0) & (win.first >= 0),
+            win.first / jnp.maximum(delta / dur, 1e-30), jnp.inf)
+    extra_start = jnp.minimum(extra_start, zero_limit)
+    factor = (dur + extra_start + extra_end) / dur
+    ext_delta = delta * factor
+    if kind == "rate":
+        out = ext_delta / rng_s
+    else:  # increase / delta
+        out = ext_delta
+    return jnp.where(ok, out, jnp.nan)
+
+
+def prom_irate(win: BucketState, kind: str = "irate"):
+    """irate/idelta need the last TWO samples — approximated from bucket
+    granularity is wrong, so the caller computes them with a dedicated
+    per-row pass (see irate_states)."""
+    raise NotImplementedError
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def irate_states(values, valid, times, seg_ids, num_segments: int):
+    """Last two samples per segment: returns (last, prev, last_t, prev_t,
+    count). One pass: last via segment_max on index; prev via segment_max
+    on index masked below last."""
+    ns = num_segments + 1
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=_I64)
+    li = jax.ops.segment_max(jnp.where(valid, idx, -1), seg_ids, ns)
+    li_seg = li[:num_segments]
+    # mask out the last sample, find the new max index = prev sample
+    is_last = valid & (li[seg_ids] == idx)
+    pi = jax.ops.segment_max(jnp.where(valid & ~is_last, idx, -1), seg_ids,
+                             ns)[:num_segments]
+    lsafe = jnp.maximum(li_seg, 0)
+    psafe = jnp.maximum(pi, 0)
+    cnt = (li_seg >= 0).astype(_I64) + (pi >= 0).astype(_I64)
+    return (jnp.where(li_seg >= 0, values[lsafe], jnp.nan),
+            jnp.where(pi >= 0, values[psafe], jnp.nan),
+            jnp.where(li_seg >= 0, times[lsafe], 0),
+            jnp.where(pi >= 0, times[psafe], 0),
+            cnt)
+
+
+def prom_irate_value(last, prev, last_t, prev_t, cnt, kind: str = "irate"):
+    ok = cnt >= 2
+    dt = (last_t - prev_t).astype(jnp.float64) / 1e9
+    dt = jnp.maximum(dt, 1e-12)
+    if kind == "idelta":
+        v = last - prev
+    else:
+        d = jnp.where(last >= prev, last - prev, last)  # reset
+        v = d / dt
+    return jnp.where(ok, v, jnp.nan)
+
+
+# over_time family: direct from merged window states
+def over_time_value(win: BucketState, func: str):
+    has = win.count > 0
+    if func == "avg_over_time":
+        v = win.sum / jnp.maximum(win.count, 1)
+    elif func == "sum_over_time":
+        v = win.sum
+    elif func == "min_over_time":
+        v = win.min
+    elif func == "max_over_time":
+        v = win.max
+    elif func == "count_over_time":
+        v = win.count.astype(jnp.float64)
+    elif func == "last_over_time":
+        v = win.last
+    elif func == "first_over_time":
+        v = win.first
+    else:
+        raise ValueError(f"unsupported over_time func {func}")
+    return jnp.where(has, v, jnp.nan)

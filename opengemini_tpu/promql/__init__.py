@@ -1,0 +1,2 @@
+from .parser import parse_promql, PromParseError
+from .engine import PromEngine

@@ -1,0 +1,516 @@
+"""PromQL evaluation engine over the storage engine + TPU prom kernels.
+
+Role of the reference's PromQL path (transpiler + prom cursors + prom
+transforms, SURVEY §3.3) — evaluated natively: selectors scan the series
+index, samples become per-(series, step-bucket) BucketStates on device
+(ops/prom.py), range functions fold bucket windows, aggregations reduce
+across the series axis.
+
+Data model: a prom metric is a measurement whose float samples live in the
+``value`` field (the openGemini prom remote-write mapping); labels are tags.
+
+Bucket alignment: internal bucket width = gcd(step, range/lookback) so
+windows land exactly on bucket edges (capped at _MAX_FOLD shifted-copy
+merges; beyond that the range rounds up to a step multiple — documented
+approximation).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index import TagFilter
+from ..utils import get_logger
+from ..ops import prom as K
+from .parser import (Aggregation, BinaryOp, FuncCall, Matcher, NumberLit,
+                     PromParseError, StringLit, VectorSelector,
+                     RANGE_FUNCS, parse_promql)
+
+log = get_logger(__name__)
+
+DEFAULT_LOOKBACK_NS = 5 * 60 * 10**9
+_MAX_FOLD = 128
+VALUE_FIELD = "value"
+
+
+@dataclass
+class SeriesMatrix:
+    """Evaluation intermediate: S series × B eval steps; NaN = no sample."""
+    labels: list[dict]            # per-series label sets (incl. __name__)
+    values: np.ndarray            # (S, B) float64
+    metric_dropped: bool = False  # set after functions/aggregations
+
+    def drop_metric(self) -> "SeriesMatrix":
+        labels = [{k: v for k, v in ls.items() if k != "__name__"}
+                  for ls in self.labels]
+        return SeriesMatrix(labels, self.values, True)
+
+
+class PromQLError(Exception):
+    pass
+
+
+class PromEngine:
+    def __init__(self, engine, db: str = "prometheus"):
+        self.engine = engine
+        self.db = db
+
+    # ---------------------------------------------------------------- api
+
+    def query_instant(self, text: str, t_ns: int,
+                      lookback_ns: int = DEFAULT_LOOKBACK_NS) -> list[dict]:
+        """Returns prom API 'vector' result list."""
+        expr = parse_promql(text)
+        res = self._eval(expr, t_ns, t_ns, 10**9, lookback_ns)
+        if isinstance(res, float):
+            return [{"metric": {}, "value": [t_ns / 1e9, _fmt(res)]}]
+        out = []
+        for ls, row in zip(res.labels, res.values):
+            v = row[-1]
+            if not np.isnan(v):
+                out.append({"metric": ls, "value": [t_ns / 1e9, _fmt(v)]})
+        return out
+
+    def query_range(self, text: str, start_ns: int, end_ns: int,
+                    step_ns: int,
+                    lookback_ns: int = DEFAULT_LOOKBACK_NS) -> list[dict]:
+        """Returns prom API 'matrix' result list."""
+        expr = parse_promql(text)
+        if step_ns <= 0:
+            raise PromQLError("step must be positive")
+        nsteps = int((end_ns - start_ns) // step_ns) + 1
+        if nsteps > 11000:
+            raise PromQLError("exceeded maximum resolution of 11,000 points")
+        res = self._eval(expr, start_ns, end_ns, step_ns, lookback_ns)
+        ts = [(start_ns + i * step_ns) / 1e9 for i in range(nsteps)]
+        if isinstance(res, float):
+            return [{"metric": {},
+                     "values": [[t, _fmt(res)] for t in ts]}]
+        out = []
+        for ls, row in zip(res.labels, res.values):
+            vals = [[ts[i], _fmt(row[i])] for i in range(nsteps)
+                    if not np.isnan(row[i])]
+            if vals:
+                out.append({"metric": ls, "values": vals})
+        return out
+
+    # ---------------------------------------------------- metadata api
+
+    def _db_obj(self):
+        try:
+            return self.engine.database(self.db)
+        except Exception:
+            return None
+
+    def labels(self) -> list[str]:
+        names = set()
+        db = self._db_obj()
+        if db:
+            for s in db.all_shards():
+                for m in s.measurements():
+                    names.update(s.index.tag_keys(m))
+        return sorted(names | {"__name__"})
+
+    def label_values(self, name: str) -> list[str]:
+        vals = set()
+        db = self._db_obj()
+        if db:
+            for s in db.all_shards():
+                for m in s.measurements():
+                    if name == "__name__":
+                        vals.add(m)
+                    else:
+                        vals.update(s.index.tag_values(m, name))
+        return sorted(vals)
+
+    def series(self, selectors: list[str]) -> list[dict]:
+        """prom /api/v1/series: label sets matching any selector."""
+        db = self._db_obj()
+        seen = set()
+        out = []
+        for sel in selectors:
+            expr = parse_promql(sel)
+            if not isinstance(expr, VectorSelector) or expr.range_ns:
+                raise PromQLError(
+                    f"match[] must be an instant vector selector: {sel!r}")
+            if db is None:
+                continue
+            filters = [TagFilter(m.name, m.value, m.op)
+                       for m in expr.matchers]
+            msts = ([expr.name] if expr.name else
+                    sorted({m for s in db.all_shards()
+                            for m in s.measurements()}))
+            for mst in msts:
+                for s in db.all_shards():
+                    for sid in s.index.series_ids(mst, filters).tolist():
+                        key = (mst,) + tuple(sorted(
+                            s.index.tags_of(sid).items()))
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        ls = dict(key[1:])
+                        ls["__name__"] = mst
+                        out.append(ls)
+        return out
+
+    # ------------------------------------------------------------- eval
+
+    def _eval(self, expr, start_ns, end_ns, step_ns, lookback_ns):
+        """Returns SeriesMatrix or python float (scalar)."""
+        if isinstance(expr, NumberLit):
+            return float(expr.value)
+        if isinstance(expr, StringLit):
+            raise PromQLError("string literal is not a valid expression "
+                              "result")
+        if isinstance(expr, VectorSelector):
+            if expr.range_ns:
+                raise PromQLError(
+                    "range vector selector must be wrapped in a function")
+            return self._eval_selector_instant(expr, start_ns, end_ns,
+                                               step_ns, lookback_ns)
+        if isinstance(expr, FuncCall):
+            return self._eval_func(expr, start_ns, end_ns, step_ns,
+                                   lookback_ns)
+        if isinstance(expr, Aggregation):
+            inner = self._eval(expr.expr, start_ns, end_ns, step_ns,
+                               lookback_ns)
+            if isinstance(inner, float):
+                raise PromQLError(f"{expr.op} expects a vector")
+            return _aggregate(expr, inner)
+        if isinstance(expr, BinaryOp):
+            return self._eval_binop(expr, start_ns, end_ns, step_ns,
+                                    lookback_ns)
+        raise PromQLError(f"unsupported expression {type(expr).__name__}")
+
+    # ---- selectors -------------------------------------------------------
+
+    def _gather(self, vs: VectorSelector, t_min: int, t_max: int):
+        """Scan storage: matching series → flat sorted arrays + per-series
+        labels. Returns (labels, values, times, series_row_ids)."""
+        if not vs.name:
+            raise PromQLError("selector requires a metric name")
+        filters = [TagFilter(m.name, m.value, m.op) for m in vs.matchers]
+        try:
+            db = self.engine.database(self.db)
+        except Exception:
+            return [], np.zeros(0), np.zeros(0, np.int64), np.zeros(
+                0, np.int64)
+        shards = db.shards_overlapping(t_min, t_max)
+        # label-set → row list (same series may span shards)
+        by_labels: dict[tuple, list] = {}
+        for s in shards:
+            for sid in s.index.series_ids(vs.name, filters).tolist():
+                rec = s.read_series(vs.name, sid, [VALUE_FIELD],
+                                    t_min, t_max)
+                if rec is None or rec.num_rows == 0:
+                    continue
+                col = rec.column(VALUE_FIELD)
+                if col is None or col.values is None:
+                    continue
+                tags = s.index.tags_of(sid)
+                key = tuple(sorted(tags.items()))
+                by_labels.setdefault(key, []).append(
+                    (rec.times, col.values.astype(np.float64), col.valid))
+        labels = []
+        vparts, tparts, sparts = [], [], []
+        for si, (key, parts) in enumerate(sorted(by_labels.items())):
+            ls = dict(key)
+            ls["__name__"] = vs.name
+            labels.append(ls)
+            ts = np.concatenate([p[0] for p in parts])
+            v = np.concatenate([p[1] for p in parts])
+            m = np.concatenate([p[2] for p in parts])
+            order = np.argsort(ts, kind="stable")
+            ts, v, m = ts[order], v[order], m[order]
+            keep = m
+            vparts.append(v[keep])
+            tparts.append(ts[keep])
+            sparts.append(np.full(int(keep.sum()), si, dtype=np.int64))
+        if not labels:
+            return [], np.zeros(0), np.zeros(0, np.int64), np.zeros(
+                0, np.int64)
+        return (labels, np.concatenate(vparts), np.concatenate(tparts),
+                np.concatenate(sparts))
+
+    def _window_states(self, vs: VectorSelector, start_ns, end_ns, step_ns,
+                       window_ns):
+        """Shared selector machinery: (labels, BucketState (S, nsteps),
+        window_end_times (nsteps,)). Window = (t_i - window, t_i]."""
+        nsteps = int((end_ns - start_ns) // step_ns) + 1
+        off = vs.offset_ns
+        if nsteps == 1:
+            # single eval point: one bucket of exactly the window width
+            bs, k, stride = window_ns, 1, 1
+        else:
+            # bucket width: gcd so window edges align; cap fold size
+            bs = math.gcd(step_ns, window_ns)
+            k = window_ns // bs
+            if k > _MAX_FOLD:
+                bs = step_ns
+                k = -(-window_ns // bs)  # ceil: rounds window UP to grid
+            if k > _MAX_FOLD:
+                raise PromQLError(
+                    f"window {window_ns/1e9:.0f}s at step "
+                    f"{step_ns/1e9:.0f}s needs {k} merge folds "
+                    f"(max {_MAX_FOLD}); use a larger step")
+        stride = step_ns // bs if nsteps > 1 else 1
+        # bucket right-edges at origin + (j+1)*bs; eval t_i at bucket
+        # index k-1 + i*stride  relative to origin = start - window
+        origin = start_ns - off - (k * bs)
+        t_lo = origin + 1
+        t_hi = end_ns - off
+        labels, values, times, series = self._gather(vs, t_lo, t_hi)
+        S = len(labels)
+        if S == 0:
+            return [], None, None
+        nb = k + (nsteps - 1) * stride
+        bucket = (times - origin - 1) // bs
+        seg = np.where((bucket >= 0) & (bucket < nb),
+                       series * nb + bucket, S * nb)
+        st = K.bucket_states(values, np.ones(len(values), bool), times,
+                             seg, series, S * nb)
+        st = K.BucketState(*[np.asarray(x).reshape(S, nb) for x in st])
+        win = K.fold_windows(st, int(k))
+        # slice eval positions: indices k-1, k-1+stride, ...
+        sel = (k - 1) + stride * np.arange(nsteps)
+        win = K.BucketState(*[np.asarray(x)[:, sel] for x in win])
+        ends = (start_ns - off + step_ns * np.arange(nsteps)).astype(
+            np.int64)
+        return labels, win, np.broadcast_to(ends, (S, nsteps))
+
+    def _eval_selector_instant(self, vs, start_ns, end_ns, step_ns,
+                               lookback_ns) -> SeriesMatrix:
+        labels, win, _ends = self._window_states(
+            vs, start_ns, end_ns, step_ns, lookback_ns)
+        if win is None:
+            return SeriesMatrix([], np.zeros((0, 1)))
+        vals = np.asarray(K.over_time_value(win, "last_over_time"))
+        return SeriesMatrix(labels, vals)
+
+    # ---- functions -------------------------------------------------------
+
+    def _eval_func(self, fc: FuncCall, start_ns, end_ns, step_ns,
+                   lookback_ns):
+        f = fc.func
+        if f in RANGE_FUNCS:
+            if len(fc.args) != 1 or not isinstance(fc.args[0],
+                                                   VectorSelector):
+                raise PromQLError(f"{f}() expects a range vector selector")
+            vs = fc.args[0]
+            if not vs.range_ns:
+                raise PromQLError(f"{f}() expects a range like {f}(x[5m])")
+            labels, win, ends = self._window_states(
+                vs, start_ns, end_ns, step_ns, vs.range_ns)
+            if win is None:
+                return SeriesMatrix([], np.zeros((0, 1)))
+            if f in ("rate", "increase", "delta"):
+                kind = f if f != "increase" else "increase"
+                vals = np.asarray(K.prom_rate(win, ends, vs.range_ns,
+                                              kind))
+            elif f in ("irate", "idelta"):
+                labels, vals = self._irate(vs, start_ns, end_ns, step_ns, f)
+            elif f == "resets" or f == "changes":
+                raise PromQLError(f"{f}() not implemented yet")
+            else:
+                vals = np.asarray(K.over_time_value(win, f))
+            return SeriesMatrix(labels, vals).drop_metric()
+        if f == "scalar":
+            inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
+                               lookback_ns)
+            if isinstance(inner, float):
+                return inner
+            if len(inner.labels) == 1:
+                m = inner.values[0]
+                return SeriesMatrix([{}], m.reshape(1, -1), True)
+            nsteps = int((end_ns - start_ns) // step_ns) + 1
+            return SeriesMatrix([{}], np.full((1, nsteps), np.nan), True)
+        if f in ("abs", "ceil", "floor", "exp", "ln", "log2", "log10",
+                 "sqrt", "round"):
+            inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
+                               lookback_ns)
+            if isinstance(inner, float):
+                inner = SeriesMatrix([{}], np.array([[inner]]), True)
+            fn = {"abs": np.abs, "ceil": np.ceil, "floor": np.floor,
+                  "exp": np.exp, "ln": np.log, "log2": np.log2,
+                  "log10": np.log10, "sqrt": np.sqrt,
+                  "round": np.round}[f]
+            with np.errstate(all="ignore"):
+                return SeriesMatrix(inner.labels, fn(inner.values),
+                                    inner.metric_dropped).drop_metric()
+        if f in ("clamp_min", "clamp_max"):
+            inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
+                               lookback_ns)
+            lim = self._eval(fc.args[1], start_ns, end_ns, step_ns,
+                             lookback_ns)
+            if not isinstance(lim, float):
+                raise PromQLError(f"{f} limit must be a scalar")
+            op = np.maximum if f == "clamp_min" else np.minimum
+            return SeriesMatrix(inner.labels, op(inner.values, lim),
+                                inner.metric_dropped).drop_metric()
+        raise PromQLError(f"unsupported function {f}()")
+
+    def _irate(self, vs, start_ns, end_ns, step_ns, f):
+        """Dedicated per-eval-point last-two-samples pass (bucket
+        granularity can't express 'previous sample')."""
+        nsteps = int((end_ns - start_ns) // step_ns) + 1
+        off = vs.offset_ns
+        labels_all = None
+        cols = []
+        # evaluate per step: segments = (series, this one window)
+        t_los = [start_ns - off + i * step_ns - vs.range_ns
+                 for i in range(nsteps)]
+        labels, values, times, series = self._gather(
+            vs, min(t_los) + 1, end_ns - off)
+        if not labels:
+            return [], np.zeros((0, nsteps))
+        S = len(labels)
+        out = np.full((S, nsteps), np.nan)
+        for i in range(nsteps):
+            t_i = start_ns - off + i * step_ns
+            m = (times > t_i - vs.range_ns) & (times <= t_i)
+            if not m.any():
+                continue
+            seg = np.where(m, series, S)
+            last, prev, lt, pt, cnt = K.irate_states(
+                values, m, times, seg, S)
+            out[:, i] = np.asarray(K.prom_irate_value(
+                np.asarray(last), np.asarray(prev), np.asarray(lt),
+                np.asarray(pt), np.asarray(cnt),
+                "idelta" if f == "idelta" else "irate"))
+        return labels, out
+
+    # ---- binary ops ------------------------------------------------------
+
+    def _eval_binop(self, b: BinaryOp, start_ns, end_ns, step_ns,
+                    lookback_ns):
+        lhs = self._eval(b.lhs, start_ns, end_ns, step_ns, lookback_ns)
+        rhs = self._eval(b.rhs, start_ns, end_ns, step_ns, lookback_ns)
+        if isinstance(lhs, float) and isinstance(rhs, float):
+            return _scalar_op(b.op, lhs, rhs)
+        if isinstance(lhs, float):
+            return SeriesMatrix(
+                rhs.labels, _vec_op(b.op, lhs, rhs.values, b.bool_mode,
+                                    scalar_left=True),
+                rhs.metric_dropped)._maybe_drop(b)
+        if isinstance(rhs, float):
+            return SeriesMatrix(
+                lhs.labels, _vec_op(b.op, lhs.values, rhs, b.bool_mode),
+                lhs.metric_dropped)._maybe_drop(b)
+        # vector-vector: one-to-one on full label match (sans __name__)
+        def key(ls):
+            return tuple(sorted((k, v) for k, v in ls.items()
+                                if k != "__name__"))
+        rmap = {key(ls): i for i, ls in enumerate(rhs.labels)}
+        labels, rows = [], []
+        for i, ls in enumerate(lhs.labels):
+            j = rmap.get(key(ls))
+            if j is None:
+                continue
+            rows.append(_vec_op(b.op, lhs.values[i:i+1],
+                                rhs.values[j:j+1], b.bool_mode))
+            labels.append({k: v for k, v in ls.items() if k != "__name__"})
+        if not rows:
+            nsteps = lhs.values.shape[1] if lhs.values.size else 1
+            return SeriesMatrix([], np.zeros((0, nsteps)), True)
+        return SeriesMatrix(labels, np.vstack(rows), True)
+
+
+def _fmt(v: float) -> str:
+    if np.isnan(v):
+        return "NaN"
+    if np.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def _scalar_op(op, a, b):
+    import operator
+    with np.errstate(all="ignore"):
+        fns = {"+": operator.add, "-": operator.sub, "*": operator.mul,
+               "/": lambda x, y: x / y if y != 0 else math.inf * (1 if x > 0 else -1) if x != 0 else math.nan,
+               "%": lambda x, y: math.fmod(x, y) if y != 0 else math.nan,
+               "^": operator.pow,
+               "==": lambda x, y: 1.0 if x == y else 0.0,
+               "!=": lambda x, y: 1.0 if x != y else 0.0,
+               ">": lambda x, y: 1.0 if x > y else 0.0,
+               "<": lambda x, y: 1.0 if x < y else 0.0,
+               ">=": lambda x, y: 1.0 if x >= y else 0.0,
+               "<=": lambda x, y: 1.0 if x <= y else 0.0}
+        if op not in fns:
+            raise PromQLError(f"unsupported scalar op {op}")
+        return float(fns[op](a, b))
+
+
+def _vec_op(op, a, b, bool_mode, scalar_left=False):
+    with np.errstate(all="ignore"):
+        if op in ("+", "-", "*", "/", "%", "^"):
+            fns = {"+": np.add, "-": np.subtract, "*": np.multiply,
+                   "/": np.divide, "%": np.fmod, "^": np.power}
+            return fns[op](a, b)
+        cmp = {"==": np.equal, "!=": np.not_equal, ">": np.greater,
+               "<": np.less, ">=": np.greater_equal,
+               "<=": np.less_equal}[op]
+        mask = cmp(a, b)
+        vals = a if not scalar_left else np.broadcast_to(
+            b, np.shape(mask)).astype(float)
+        if bool_mode:
+            out = np.where(np.isnan(vals), np.nan,
+                           mask.astype(np.float64))
+            return out
+        return np.where(mask, vals, np.nan)
+
+
+SeriesMatrix._maybe_drop = lambda self, b: (
+    self.drop_metric() if b.op in ("+", "-", "*", "/", "%", "^",)
+    or b.bool_mode else self)
+
+
+def _aggregate(agg: Aggregation, inner: SeriesMatrix) -> SeriesMatrix:
+    S, B = inner.values.shape if inner.values.size else (0, 1)
+    if S == 0:
+        return SeriesMatrix([], np.zeros((0, B)), True)
+    groups: dict[tuple, list[int]] = {}
+    out_labels: dict[tuple, dict] = {}
+    for i, ls in enumerate(inner.labels):
+        if agg.without:
+            kept = {k: v for k, v in ls.items()
+                    if k not in agg.grouping and k != "__name__"}
+        elif agg.grouping:
+            kept = {k: ls[k] for k in agg.grouping if k in ls}
+        else:
+            kept = {}
+        key = tuple(sorted(kept.items()))
+        groups.setdefault(key, []).append(i)
+        out_labels[key] = kept
+    keys = sorted(groups)
+    vals = inner.values
+    out = np.full((len(keys), B), np.nan)
+    for gi, key in enumerate(keys):
+        rows = vals[groups[key]]
+        has = ~np.all(np.isnan(rows), axis=0)
+        with np.errstate(all="ignore"):
+            if agg.op == "sum":
+                r = np.nansum(rows, axis=0)
+            elif agg.op == "avg":
+                r = np.nanmean(rows, axis=0)
+            elif agg.op == "min":
+                r = np.nanmin(np.where(np.isnan(rows), np.inf, rows),
+                              axis=0)
+            elif agg.op == "max":
+                r = np.nanmax(np.where(np.isnan(rows), -np.inf, rows),
+                              axis=0)
+            elif agg.op == "count":
+                r = np.sum(~np.isnan(rows), axis=0).astype(np.float64)
+            elif agg.op == "group":
+                r = np.ones(B)
+            elif agg.op in ("stddev", "stdvar"):
+                r = np.nanvar(rows, axis=0)
+                if agg.op == "stddev":
+                    r = np.sqrt(r)
+            else:
+                raise PromQLError(f"unsupported aggregation {agg.op}")
+        out[gi] = np.where(has, r, np.nan)
+    return SeriesMatrix([out_labels[k] for k in keys], out, True)

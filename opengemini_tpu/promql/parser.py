@@ -1,0 +1,342 @@
+"""PromQL parser (role of the reference's promql2influxql transpiler front
+end, lib/util/lifted/promql2influxql/ — here PromQL evaluates natively
+against the TPU kernels instead of transpiling to InfluxQL).
+
+Supported grammar:
+    <expr> := number | 'str' | <vector> | fn(<expr>...) |
+              agg [by|without (labels)] (<expr>[, param]) |
+              <expr> binop <expr> | (-)<expr> | (<expr>)
+    <vector> := metric_name[{matchers}][[range]][offset dur]
+    matchers: label =|!=|=~|!~ "value"
+    binops: + - * / % ^ == != > < >= <= (with optional `bool`)
+    aggs: sum avg min max count topk bottomk
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class PromParseError(Exception):
+    pass
+
+
+_DUR = re.compile(r"^(\d+)(ms|s|m|h|d|w|y)")
+_DUR_NS = {"ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9,
+           "d": 86400 * 10**9, "w": 7 * 86400 * 10**9,
+           "y": 365 * 86400 * 10**9}
+
+AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk",
+           "group", "stddev", "stdvar"}
+
+RANGE_FUNCS = {"rate", "irate", "increase", "delta", "idelta",
+               "avg_over_time", "sum_over_time", "min_over_time",
+               "max_over_time", "count_over_time", "last_over_time",
+               "first_over_time", "resets", "changes"}
+
+SCALAR_FUNCS = {"abs", "ceil", "floor", "round", "exp", "ln", "log2",
+                "log10", "sqrt", "clamp_min", "clamp_max", "scalar",
+                "timestamp"}
+
+
+@dataclass
+class NumberLit:
+    value: float
+
+
+@dataclass
+class StringLit:
+    value: str
+
+
+@dataclass
+class Matcher:
+    name: str
+    op: str        # = != =~ !~
+    value: str
+
+
+@dataclass
+class VectorSelector:
+    name: str = ""
+    matchers: list[Matcher] = field(default_factory=list)
+    range_ns: int = 0          # 0 = instant selector
+    offset_ns: int = 0
+
+
+@dataclass
+class FuncCall:
+    func: str
+    args: list = field(default_factory=list)
+
+
+@dataclass
+class Aggregation:
+    op: str
+    expr: object = None
+    grouping: list[str] = field(default_factory=list)
+    without: bool = False
+    param: object = None       # topk/bottomk k
+
+
+@dataclass
+class BinaryOp:
+    op: str
+    lhs: object = None
+    rhs: object = None
+    bool_mode: bool = False
+
+
+def parse_duration(s: str) -> int:
+    total = 0
+    pos = 0
+    while pos < len(s):
+        m = _DUR.match(s[pos:])
+        if not m:
+            raise PromParseError(f"bad duration {s!r}")
+        total += int(m.group(1)) * _DUR_NS[m.group(2)]
+        pos += m.end()
+    if total == 0:
+        raise PromParseError(f"bad duration {s!r}")
+    return total
+
+
+class _P:
+    def __init__(self, text: str):
+        self.s = text
+        self.i = 0
+
+    def ws(self):
+        while self.i < len(self.s) and self.s[self.i] in " \t\n":
+            self.i += 1
+
+    def peek(self, n=1) -> str:
+        return self.s[self.i:self.i + n]
+
+    def eat(self, tok: str) -> bool:
+        self.ws()
+        if self.s.startswith(tok, self.i):
+            self.i += len(tok)
+            return True
+        return False
+
+    def expect(self, tok: str):
+        if not self.eat(tok):
+            raise PromParseError(
+                f"expected {tok!r} at {self.i}: ...{self.s[self.i:self.i+20]!r}")
+
+    def ident(self) -> str:
+        self.ws()
+        m = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", self.s[self.i:])
+        if not m:
+            raise PromParseError(f"expected identifier at {self.i}")
+        self.i += m.end()
+        return m.group()
+
+    def string(self) -> str:
+        self.ws()
+        q = self.peek()
+        if q not in "'\"`":
+            raise PromParseError(f"expected string at {self.i}")
+        self.i += 1
+        out = []
+        while self.i < len(self.s):
+            c = self.s[self.i]
+            if c == "\\" and q != "`" and self.i + 1 < len(self.s):
+                nxt = self.s[self.i + 1]
+                out.append({"n": "\n", "t": "\t", "\\": "\\",
+                            q: q}.get(nxt, "\\" + nxt))
+                self.i += 2
+                continue
+            if c == q:
+                self.i += 1
+                return "".join(out)
+            out.append(c)
+            self.i += 1
+        raise PromParseError("unterminated string")
+
+    def duration_tok(self) -> int:
+        self.ws()
+        m = re.match(r"[0-9]+[a-z]+(?:[0-9]+[a-z]+)*", self.s[self.i:])
+        if not m:
+            raise PromParseError(f"expected duration at {self.i}")
+        self.i += m.end()
+        return parse_duration(m.group())
+
+    # ---- grammar ---------------------------------------------------------
+
+    def parse_expr(self, min_prec=0):
+        lhs = self.parse_unary()
+        PREC = {"or": 1, "and": 2, "unless": 2,
+                "==": 3, "!=": 3, ">": 3, "<": 3, ">=": 3, "<=": 3,
+                "+": 4, "-": 4, "*": 5, "/": 5, "%": 5, "^": 6}
+        while True:
+            self.ws()
+            op = None
+            for cand in ("==", "!=", ">=", "<=", "or", "and", "unless",
+                         ">", "<", "+", "-", "*", "/", "%", "^"):
+                if self.s.startswith(cand, self.i):
+                    # word ops need a word boundary
+                    if cand.isalpha():
+                        end = self.i + len(cand)
+                        if end < len(self.s) and (self.s[end].isalnum()
+                                                  or self.s[end] == "_"):
+                            continue
+                    op = cand
+                    break
+            if op is None or PREC[op] < min_prec:
+                return lhs
+            self.i += len(op)
+            bool_mode = False
+            self.ws()
+            if self.s.startswith("bool", self.i):
+                self.i += 4
+                bool_mode = True
+            # ^ is right-assoc, others left
+            nxt = PREC[op] + (0 if op == "^" else 1)
+            rhs = self.parse_expr(nxt)
+            lhs = BinaryOp(op, lhs, rhs, bool_mode)
+
+    def parse_unary(self):
+        self.ws()
+        if self.eat("-"):
+            e = self.parse_unary()
+            if isinstance(e, NumberLit):
+                return NumberLit(-e.value)
+            return BinaryOp("*", NumberLit(-1.0), e)
+        if self.eat("+"):
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self):
+        e = self.parse_primary()
+        while True:
+            self.ws()
+            if self.peek() == "[":
+                if not isinstance(e, VectorSelector) or e.range_ns:
+                    raise PromParseError("range on non-selector")
+                self.expect("[")
+                e.range_ns = self.duration_tok()
+                self.expect("]")
+                continue
+            if self.s.startswith("offset", self.i):
+                self.i += len("offset")
+                if not isinstance(e, VectorSelector):
+                    raise PromParseError("offset on non-selector")
+                e.offset_ns = self.duration_tok()
+                continue
+            return e
+
+    def parse_primary(self):
+        self.ws()
+        if self.i >= len(self.s):
+            raise PromParseError("unexpected end of query")
+        c = self.s[self.i]
+        if c == "(":
+            self.expect("(")
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if c in "'\"`":
+            return StringLit(self.string())
+        m = re.match(r"[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?",
+                     self.s[self.i:])
+        if m and (c.isdigit() or c == "."):
+            # could be a duration-like bare number? numbers are seconds
+            self.i += m.end()
+            return NumberLit(float(m.group()))
+        if c == "{":
+            vs = VectorSelector()
+            self._matchers(vs)
+            return vs
+        name = self.ident()
+        self.ws()
+        if name in AGG_OPS:
+            return self._aggregation(name)
+        if self.peek() == "(":
+            self.expect("(")
+            args = []
+            self.ws()
+            if not self.eat(")"):
+                args.append(self.parse_expr())
+                while self.eat(","):
+                    args.append(self.parse_expr())
+                self.expect(")")
+            return FuncCall(name, args)
+        vs = VectorSelector(name=name)
+        self.ws()
+        if self.peek() == "{":
+            self._matchers(vs)
+        return vs
+
+    def _matchers(self, vs: VectorSelector):
+        self.expect("{")
+        self.ws()
+        if self.eat("}"):
+            return
+        while True:
+            lname = self.ident()
+            self.ws()
+            for op in ("=~", "!~", "!=", "="):
+                if self.eat(op):
+                    break
+            else:
+                raise PromParseError(f"bad matcher op at {self.i}")
+            val = self.string()
+            if lname == "__name__" and op == "=":
+                vs.name = val
+            else:
+                vs.matchers.append(Matcher(lname, op, val))
+            self.ws()
+            if self.eat("}"):
+                return
+            self.expect(",")
+
+    def _aggregation(self, op: str) -> Aggregation:
+        agg = Aggregation(op)
+        self.ws()
+        # prefix grouping: sum by (a,b) (expr)
+        if self.s.startswith("by", self.i) or self.s.startswith("without",
+                                                                self.i):
+            agg.without = self.s.startswith("without", self.i)
+            self.i += 7 if agg.without else 2
+            agg.grouping = self._label_list()
+        self.expect("(")
+        first = self.parse_expr()
+        if self.eat(","):
+            agg.param = first
+            agg.expr = self.parse_expr()
+        else:
+            agg.expr = first
+        self.expect(")")
+        # suffix grouping
+        self.ws()
+        if self.s.startswith("by", self.i) or self.s.startswith("without",
+                                                                self.i):
+            agg.without = self.s.startswith("without", self.i)
+            self.i += 7 if agg.without else 2
+            agg.grouping = self._label_list()
+        return agg
+
+    def _label_list(self) -> list[str]:
+        self.expect("(")
+        out = []
+        self.ws()
+        if self.eat(")"):
+            return out
+        out.append(self.ident())
+        while self.eat(","):
+            out.append(self.ident())
+        self.expect(")")
+        return out
+
+
+def parse_promql(text: str):
+    p = _P(text)
+    e = p.parse_expr()
+    p.ws()
+    if p.i != len(p.s):
+        raise PromParseError(
+            f"unexpected trailing input at {p.i}: {p.s[p.i:p.i+20]!r}")
+    return e

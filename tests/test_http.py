@@ -123,3 +123,51 @@ def test_multi_statement_query(server):
 def test_404(server):
     code, _ = req(server, "GET", "/nope")
     assert code == 404
+
+
+def test_prom_api(server):
+    # seed the prometheus db via line protocol (value field = prom sample)
+    lines = "\n".join(
+        f"up,job=api,host=h{h} value={h + 1} {i * 15_000_000_000}"
+        for h in range(2) for i in range(20))
+    assert write_lp(server, lines, db="prometheus")[0] == 204
+    code, res = req(server, "GET",
+                    "/api/v1/query?query=up&time=300")
+    assert code == 200
+    body = json.loads(res)
+    assert body["status"] == "success"
+    assert len(body["data"]["result"]) == 2
+    code, res = req(server, "GET",
+                    "/api/v1/query_range?query=sum(up)&start=60&end=300"
+                    "&step=60")
+    body = json.loads(res)
+    assert body["data"]["resultType"] == "matrix"
+    assert [v for _t, v in body["data"]["result"][0]["values"]] == ["3.0"] * 5
+    code, res = req(server, "GET", "/api/v1/labels")
+    assert "job" in json.loads(res)["data"]
+    code, res = req(server, "GET", "/api/v1/label/__name__/values")
+    assert json.loads(res)["data"] == ["up"]
+    from urllib.parse import quote
+    code, res = req(server, "GET",
+                    "/api/v1/series?match[]=" + quote('up{job="api"}'))
+    assert len(json.loads(res)["data"]) == 2
+    # error shape
+    code, res = req(server, "GET", "/api/v1/query?query=sum(")
+    assert code == 400 and json.loads(res)["status"] == "error"
+    # bad params → 400 bad_data (not 500)
+    code, res = req(server, "GET", "/api/v1/query?query=up&time=abc")
+    assert code == 400 and json.loads(res)["errorType"] == "bad_data"
+    code, res = req(server, "GET",
+                    "/api/v1/query_range?query=up&start=1&end=2&step=abc")
+    assert code == 400 and b"invalid step" in res
+    # multiple match[] selectors both contribute
+    write_lp(server, "down,job=api value=0 0", db="prometheus")
+    code, res = req(server, "GET",
+                    "/api/v1/series?match[]=up&match[]=down")
+    names = {d["__name__"] for d in json.loads(res)["data"]}
+    assert names == {"up", "down"}
+    # name-less matcher-only selector
+    from urllib.parse import quote as _q
+    code, res = req(server, "GET",
+                    "/api/v1/series?match[]=" + _q('{job="api"}'))
+    assert len(json.loads(res)["data"]) == 3  # 2×up + 1×down

@@ -1,0 +1,162 @@
+"""Prom kernel tests: bucket-state fold formulation vs straight-line
+Prometheus reference semantics (reference model: prom cursor tests +
+upstream promql tests)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import prom as P
+
+
+def py_extrapolated_rate(samples, window_start, window_end, range_s,
+                         kind="rate"):
+    """Straight-line port of Prometheus extrapolatedRate for one window.
+    samples: [(t_sec, v)] within (window_start, window_end]."""
+    if len(samples) < 2:
+        return None
+    ts = [s[0] for s in samples]
+    vs = [s[1] for s in samples]
+    if kind == "delta":
+        delta = vs[-1] - vs[0]
+    else:
+        delta = 0.0
+        prev = vs[0]
+        for v in vs[1:]:
+            delta += (v - prev) if v >= prev else v
+            prev = v
+    dur = ts[-1] - ts[0]
+    if dur <= 0:
+        return None
+    avg_iv = dur / (len(samples) - 1)
+    extra_start = min(ts[0] - window_start, avg_iv / 2)
+    extra_end = min(window_end - ts[-1], avg_iv / 2)
+    if kind != "delta" and delta > 0 and vs[0] >= 0:
+        zl = vs[0] / (delta / dur)
+        extra_start = min(extra_start, zl)
+    factor = (dur + extra_start + extra_end) / dur
+    ext = delta * factor
+    return ext / range_s if kind == "rate" else ext
+
+
+def make_counter_series(n=240, step_s=15, resets=(100, 180)):
+    t = np.arange(n) * step_s
+    inc = np.random.default_rng(0).uniform(0.5, 2.0, n)
+    v = np.cumsum(inc)
+    for r in resets:
+        v[r:] -= v[r] - 0.1  # reset to near zero at index r
+    return t, v
+
+
+def eval_with_kernels(t_sec, v, range_s, step_s, eval_steps, kind="rate"):
+    """Single series: bucket + fold + rate via the TPU kernels."""
+    times = (t_sec * 1e9).astype(np.int64)
+    nb = eval_steps
+    # prom windows are (start, end]: bucket b covers (b*step, (b+1)*step]
+    step_ns = int(step_s * 1e9)
+    bucket = (times - 1) // step_ns
+    seg = np.where((bucket >= 0) & (bucket < nb), bucket, nb)  # trash
+    k = range_s // step_s
+    st = P.bucket_states(v, np.ones(len(v), bool), times, seg,
+                         np.zeros(len(v), np.int64), nb)
+    st = P.BucketState(*[np.asarray(x).reshape(1, nb) for x in st])
+    win = P.fold_windows(st, int(k))
+    # eval time for bucket b = (b+1)*step (right edge)
+    ends = ((np.arange(nb) + 1) * step_s * 1e9).astype(np.int64)
+    out = P.prom_rate(win, ends.reshape(1, nb),
+                      int(range_s * 1e9), kind)
+    return np.asarray(out)[0]
+
+
+@pytest.mark.parametrize("kind", ["rate", "increase", "delta"])
+def test_rate_matches_prom_reference(kind):
+    step_s, range_s = 15, 60
+    t, v = make_counter_series()
+    nb = int(t[-1] // step_s) + 1
+    got = eval_with_kernels(t, v, range_s, step_s, nb, kind)
+    for b in range(4, nb, 7):
+        end = (b + 1) * step_s
+        start = end - range_s
+        mask = (t > start) & (t <= end)
+        ref = py_extrapolated_rate(list(zip(t[mask], v[mask])), start, end,
+                                  range_s, kind)
+        if ref is None:
+            assert np.isnan(got[b])
+        else:
+            np.testing.assert_allclose(got[b], ref, rtol=1e-10,
+                                       err_msg=f"bucket {b}")
+
+
+def test_reset_correction_within_and_across_buckets():
+    # counter: 0,10,20, reset to 2, 12 → increase = 20 + 2 + 10 = 32
+    t = np.array([0, 10, 20, 30, 40])
+    v = np.array([0.0, 10.0, 20.0, 2.0, 12.0])
+    times = (t * 1e9).astype(np.int64)
+    bucket = t // 25  # two buckets: [0,10,20], [2(reset),12]
+    st = P.bucket_states(v, np.ones(5, bool), times, bucket,
+                         np.zeros(5, np.int64), 2)
+    st2 = P.BucketState(*[np.asarray(x).reshape(1, 2) for x in st])
+    win = P.fold_windows(st2, 2)
+    # window ending at bucket 1 covers all samples
+    assert np.asarray(win.inc)[0, 1] == 32.0
+    assert np.asarray(win.first)[0, 1] == 0.0
+    assert np.asarray(win.last)[0, 1] == 12.0
+
+
+def test_multi_series_isolation():
+    # two series back to back; reset correction must not leak across
+    v = np.array([5.0, 6.0, 100.0, 1.0])
+    times = np.array([0, 10**9, 0, 10**9], dtype=np.int64)
+    series = np.array([0, 0, 1, 1], dtype=np.int64)
+    seg = series  # one bucket per series
+    st = P.bucket_states(v, np.ones(4, bool), times, seg, series, 2)
+    inc = np.asarray(st.inc)
+    assert inc[0] == 1.0          # 5→6
+    assert inc[1] == 1.0          # 100→1 is a reset → adds 1.0
+    # cross-series boundary (6 → 100) contributed nothing
+
+
+def test_irate():
+    t = np.array([0, 10, 20, 30], dtype=np.float64)
+    v = np.array([0.0, 5.0, 3.0, 9.0])  # reset at idx 2
+    times = (t * 1e9).astype(np.int64)
+    seg = np.zeros(4, dtype=np.int64)
+    last, prev, lt, pt, cnt = P.irate_states(v, np.ones(4, bool), times,
+                                             seg, 1)
+    out = P.prom_irate_value(np.asarray(last), np.asarray(prev),
+                             np.asarray(lt), np.asarray(pt),
+                             np.asarray(cnt))
+    np.testing.assert_allclose(out[0], (9.0 - 3.0) / 10.0)
+    # idelta
+    out = P.prom_irate_value(np.asarray(last), np.asarray(prev),
+                             np.asarray(lt), np.asarray(pt),
+                             np.asarray(cnt), "idelta")
+    np.testing.assert_allclose(out[0], 6.0)
+
+
+def test_over_time_family():
+    v = np.array([1.0, 2.0, 3.0, 4.0])
+    times = np.arange(4, dtype=np.int64) * 10**9
+    seg = np.array([0, 0, 1, 1], dtype=np.int64)
+    st = P.bucket_states(v, np.ones(4, bool), times, seg,
+                         np.zeros(4, np.int64), 2)
+    st2 = P.BucketState(*[np.asarray(x).reshape(1, 2) for x in st])
+    win = P.fold_windows(st2, 2)
+    assert P.over_time_value(win, "avg_over_time")[0, 1] == 2.5
+    assert P.over_time_value(win, "sum_over_time")[0, 1] == 10.0
+    assert P.over_time_value(win, "min_over_time")[0, 1] == 1.0
+    assert P.over_time_value(win, "max_over_time")[0, 1] == 4.0
+    assert P.over_time_value(win, "count_over_time")[0, 1] == 4.0
+    assert P.over_time_value(win, "last_over_time")[0, 1] == 4.0
+
+
+def test_empty_windows_nan():
+    v = np.array([1.0])
+    times = np.array([0], dtype=np.int64)
+    seg = np.array([0], dtype=np.int64)
+    st = P.bucket_states(v, np.ones(1, bool), times, seg,
+                         np.zeros(1, np.int64), 3)
+    st2 = P.BucketState(*[np.asarray(x).reshape(1, 3) for x in st])
+    win = P.fold_windows(st2, 1)
+    ends = np.array([[10**9, 2 * 10**9, 3 * 10**9]])
+    out = np.asarray(P.prom_rate(win, ends, 10**9))
+    assert np.isnan(out[0, 1]) and np.isnan(out[0, 2])

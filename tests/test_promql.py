@@ -1,0 +1,182 @@
+"""PromQL end-to-end tests: parser + engine over the storage engine
+(reference model: tests/prom_test.go compliance suite, reduced)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.promql import PromEngine, parse_promql, PromParseError
+from opengemini_tpu.promql.parser import (Aggregation, BinaryOp, FuncCall,
+                                          VectorSelector)
+from opengemini_tpu.storage import Engine, PointRow
+
+S = 10**9
+M = 60 * S
+
+
+# ---- parser -----------------------------------------------------------------
+
+def test_parse_selector():
+    e = parse_promql('http_requests_total{job="api", code=~"5.."}[5m] '
+                     'offset 1m')
+    assert isinstance(e, VectorSelector)
+    assert e.name == "http_requests_total"
+    assert [(m.name, m.op, m.value) for m in e.matchers] == [
+        ("job", "=", "api"), ("code", "=~", "5..")]
+    assert e.range_ns == 5 * M and e.offset_ns == M
+
+
+def test_parse_rate_sum_by():
+    e = parse_promql('sum by (host) (rate(node_cpu_seconds_total[5m]))')
+    assert isinstance(e, Aggregation) and e.op == "sum"
+    assert e.grouping == ["host"] and not e.without
+    assert isinstance(e.expr, FuncCall) and e.expr.func == "rate"
+
+
+def test_parse_binop_precedence():
+    e = parse_promql("a + b * c")
+    assert isinstance(e, BinaryOp) and e.op == "+"
+    assert isinstance(e.rhs, BinaryOp) and e.rhs.op == "*"
+    e2 = parse_promql("100 * (1 - x)")
+    assert e2.op == "*"
+
+
+def test_parse_name_matcher():
+    e = parse_promql('{__name__="up", job="x"}')
+    assert e.name == "up" and len(e.matchers) == 1
+
+
+def test_parse_errors():
+    for bad in ["", "sum(", "x[", "x{a=}", "rate(x[5m]) extra"]:
+        with pytest.raises(PromParseError):
+            parse_promql(bad)
+
+
+# ---- engine -----------------------------------------------------------------
+
+@pytest.fixture
+def prom(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    rows = []
+    # counter metric: two hosts, 15s samples over 10 min
+    for h in range(2):
+        c = 0.0
+        for i in range(41):
+            c += 1.0 + h  # host0 rate 1/15s, host1 rate 2/15s
+            rows.append(PointRow("http_requests_total",
+                                 {"host": f"h{h}", "job": "api"},
+                                 {"value": c}, i * 15 * S))
+    # gauge
+    for i in range(41):
+        rows.append(PointRow("mem_used", {"host": "h0"},
+                             {"value": 100.0 + i}, i * 15 * S))
+    eng.write_points("prometheus", rows)
+    yield PromEngine(eng)
+    eng.close()
+
+
+def test_instant_selector(prom):
+    out = prom.query_instant("http_requests_total", 600 * S)
+    assert len(out) == 2
+    m = {o["metric"]["host"]: float(o["value"][1]) for o in out}
+    assert m["h0"] == 41.0 and m["h1"] == 82.0
+    assert out[0]["metric"]["__name__"] == "http_requests_total"
+
+
+def test_instant_with_matcher(prom):
+    out = prom.query_instant('http_requests_total{host="h1"}', 600 * S)
+    assert len(out) == 1 and out[0]["metric"]["host"] == "h1"
+
+
+def test_rate_range_query(prom):
+    # window (t-60, t] holds 4 samples (t-45..t): delta=3 steps over 45s,
+    # prom extrapolation adds half an interval at the start (7.5s capped)
+    # → rate = 3*(52.5/45)/60 = 3.5/60 (the well-known prom quirk)
+    out = prom.query_range("rate(http_requests_total[1m])",
+                           2 * M, 10 * M, M)
+    assert len(out) == 2
+    for o in out:
+        r = 3.5 / 60 if o["metric"]["host"] == "h0" else 7.0 / 60
+        for _t, v in o["values"]:
+            np.testing.assert_allclose(float(v), r, rtol=1e-9)
+        assert "__name__" not in o["metric"]
+
+
+def test_sum_rate_by_job(prom):
+    out = prom.query_range(
+        'sum by (job) (rate(http_requests_total[1m]))', 2 * M, 5 * M, M)
+    assert len(out) == 1
+    assert out[0]["metric"] == {"job": "api"}
+    for _t, v in out[0]["values"]:
+        np.testing.assert_allclose(float(v), 10.5 / 60, rtol=1e-9)
+
+
+def test_increase(prom):
+    # extrapolated increase: delta 3 (resp. 6) × (52.5/45)
+    out = prom.query_range("increase(http_requests_total[1m])",
+                           2 * M, 5 * M, M)
+    m = {o["metric"]["host"]: float(o["values"][0][1]) for o in out}
+    np.testing.assert_allclose(m["h0"], 3.5, rtol=1e-9)
+    np.testing.assert_allclose(m["h1"], 7.0, rtol=1e-9)
+
+
+def test_gauge_functions(prom):
+    out = prom.query_instant("avg_over_time(mem_used[1m])", 10 * M)
+    # samples at 585,570,555,540(s) excluded>? window (540s,600s]: 555..600
+    assert len(out) == 1
+    v = float(out[0]["value"][1])
+    # samples in (9m,10m]: idx 37,38,39,40 → 137..140 avg 138.5
+    np.testing.assert_allclose(v, 138.5)
+    out = prom.query_instant("max_over_time(mem_used[5m])", 10 * M)
+    assert float(out[0]["value"][1]) == 140.0
+
+
+def test_binop_scalar(prom):
+    out = prom.query_instant("mem_used / 100", 10 * M)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 1.4)
+    assert "__name__" not in out[0]["metric"]
+
+
+def test_binop_vector_vector(prom):
+    out = prom.query_instant(
+        'http_requests_total{host="h0"} / mem_used', 10 * M)
+    # different label sets (job tag differs) → no match
+    assert out == []
+    out = prom.query_instant("mem_used + mem_used", 10 * M)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 280.0)
+
+
+def test_comparison_filter(prom):
+    out = prom.query_instant("http_requests_total > 50", 600 * S)
+    assert len(out) == 1 and out[0]["metric"]["host"] == "h1"
+    out = prom.query_instant("http_requests_total > bool 50", 600 * S)
+    vals = {o["metric"]["host"]: float(o["value"][1]) for o in out}
+    assert vals == {"h0": 0.0, "h1": 1.0}
+
+
+def test_irate(prom):
+    out = prom.query_instant("irate(http_requests_total[2m])", 600 * S)
+    m = {o["metric"]["host"]: float(o["value"][1]) for o in out}
+    np.testing.assert_allclose(m["h0"], 1 / 15)
+    np.testing.assert_allclose(m["h1"], 2 / 15)
+
+
+def test_scalar_literal_and_arithmetic(prom):
+    out = prom.query_instant("2 + 3 * 4", 0)
+    assert float(out[0]["value"][1]) == 14.0
+
+
+def test_empty_selector_result(prom):
+    assert prom.query_instant("nonexistent_metric", 600 * S) == []
+
+
+def test_offset(prom):
+    out = prom.query_instant("mem_used offset 5m", 10 * M)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 120.0)
+
+
+def test_lookback_staleness(prom):
+    # beyond 5m lookback after last sample → empty
+    assert prom.query_instant("mem_used", 20 * M) == []
+    # within lookback → last value
+    out = prom.query_instant("mem_used", 12 * M)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 140.0)
