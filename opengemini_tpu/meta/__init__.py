@@ -1,0 +1,1 @@
+from .catalog import Catalog, RetentionPolicy, DownsamplePolicy, StreamTask

@@ -1,0 +1,299 @@
+"""Meta catalog: databases, retention policies, users, downsample policies,
+stream tasks, continuous queries, subscriptions.
+
+Role of the reference's ts-meta store (app/ts-meta/meta/store.go over
+hashicorp-raft with the data model of lib/util/lifted/influx/meta/data.go).
+Single-node deployment persists the catalog as JSON with atomic replace and
+fsync; the cluster deployment replicates the same state machine over the
+raft log in parallel/cluster (every mutation here is a deterministic apply
+of a command dict, so the raft FSM reuses these methods directly).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+from dataclasses import asdict, dataclass, field
+
+from ..utils import get_logger
+from ..utils.errors import (ErrDatabaseNotFound,
+                            ErrRetentionPolicyNotFound, GeminiError)
+
+log = get_logger(__name__)
+
+INF = 0  # duration 0 = infinite retention (influx semantics)
+
+
+@dataclass
+class RetentionPolicy:
+    name: str = "autogen"
+    duration_ns: int = INF
+    shard_group_duration_ns: int = 7 * 24 * 3600 * 10**9
+    replica_n: int = 1
+    default: bool = True
+
+
+@dataclass
+class DownsamplePolicy:
+    """Rewrite data older than `age_ns` at `interval_ns` resolution
+    (reference UpdateDownSampleInfo engine_downsample.go:120)."""
+    rp: str
+    age_ns: int
+    interval_ns: int
+    calls: dict = field(default_factory=lambda: {"float": "mean",
+                                                 "integer": "sum"})
+
+
+@dataclass
+class StreamTask:
+    """Ingest-time windowed aggregation (reference app/ts-store/stream
+    tag_task/time_task)."""
+    name: str
+    src_measurement: str
+    dest_measurement: str
+    interval_ns: int
+    group_tags: list = field(default_factory=list)
+    calls: dict = field(default_factory=dict)   # field -> agg func
+    delay_ns: int = 0
+
+
+@dataclass
+class ContinuousQuery:
+    name: str
+    query: str              # full SELECT ... INTO ... text
+    every_ns: int
+    offset_ns: int = 0
+    last_run_ns: int = 0
+
+
+@dataclass
+class Subscription:
+    name: str
+    db: str
+    mode: str               # ALL | ANY
+    destinations: list = field(default_factory=list)
+
+
+class Catalog:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self.databases: dict[str, dict] = {}
+        self.users: dict[str, dict] = {}
+        self.subscriptions: dict[str, Subscription] = {}
+        if path and os.path.exists(path):
+            self._load()
+
+    # ---- persistence -----------------------------------------------------
+
+    def _load(self) -> None:
+        with open(self.path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        self.databases = raw.get("databases", {})
+        self.users = raw.get("users", {})
+        self.subscriptions = {
+            k: Subscription(**v)
+            for k, v in raw.get("subscriptions", {}).items()}
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            blob = json.dumps(
+                {"databases": self.databases, "users": self.users,
+                 "subscriptions": {k: asdict(v) for k, v in
+                                   self.subscriptions.items()}},
+                indent=1)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+
+    # ---- databases / RPs -------------------------------------------------
+
+    def create_database(self, name: str,
+                        rp: RetentionPolicy | None = None) -> None:
+        with self._lock:
+            if name not in self.databases:
+                rp = rp or RetentionPolicy()
+                self.databases[name] = {
+                    "retention_policies": {rp.name: asdict(rp)},
+                    "default_rp": rp.name,
+                    "downsample_policies": [],
+                    "stream_tasks": {},
+                    "continuous_queries": {},
+                }
+            self.save()
+
+    def drop_database(self, name: str) -> None:
+        with self._lock:
+            self.databases.pop(name, None)
+            self.save()
+
+    def database(self, name: str) -> dict:
+        db = self.databases.get(name)
+        if db is None:
+            raise ErrDatabaseNotFound(f"database not found: {name}")
+        return db
+
+    def retention_policy(self, db: str, rp: str | None = None
+                         ) -> RetentionPolicy:
+        d = self.database(db)
+        rp = rp or d["default_rp"]
+        raw = d["retention_policies"].get(rp)
+        if raw is None:
+            raise ErrRetentionPolicyNotFound(
+                f"retention policy not found: {rp}")
+        return RetentionPolicy(**raw)
+
+    def create_retention_policy(self, db: str, rp: RetentionPolicy,
+                                make_default: bool = False) -> None:
+        with self._lock:
+            d = self.database(db)
+            d["retention_policies"][rp.name] = asdict(rp)
+            if make_default or rp.default:
+                d["default_rp"] = rp.name
+            self.save()
+
+    def alter_retention_policy(self, db: str, name: str, *,
+                               duration_ns: int | None = None,
+                               shard_group_duration_ns: int | None = None,
+                               make_default: bool = False) -> None:
+        with self._lock:
+            d = self.database(db)
+            raw = d["retention_policies"].get(name)
+            if raw is None:
+                raise ErrRetentionPolicyNotFound(
+                    f"retention policy not found: {name}")
+            if duration_ns is not None:
+                raw["duration_ns"] = duration_ns
+            if shard_group_duration_ns is not None:
+                raw["shard_group_duration_ns"] = shard_group_duration_ns
+            if make_default:
+                d["default_rp"] = name
+            self.save()
+
+    def drop_retention_policy(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.database(db)
+            d["retention_policies"].pop(name, None)
+            if d["default_rp"] == name:
+                rps = list(d["retention_policies"])
+                d["default_rp"] = rps[0] if rps else ""
+            self.save()
+
+    # ---- downsample / stream / CQ ---------------------------------------
+
+    def add_downsample_policy(self, db: str, p: DownsamplePolicy) -> None:
+        with self._lock:
+            self.database(db)["downsample_policies"].append(asdict(p))
+            self.save()
+
+    def downsample_policies(self, db: str) -> list[DownsamplePolicy]:
+        return [DownsamplePolicy(**p)
+                for p in self.database(db).get("downsample_policies", [])]
+
+    def register_stream(self, db: str, task: StreamTask) -> None:
+        with self._lock:
+            self.database(db)["stream_tasks"][task.name] = asdict(task)
+            self.save()
+
+    def drop_stream(self, db: str, name: str) -> None:
+        with self._lock:
+            self.database(db)["stream_tasks"].pop(name, None)
+            self.save()
+
+    def stream_tasks(self, db: str) -> list[StreamTask]:
+        return [StreamTask(**t)
+                for t in self.database(db).get("stream_tasks",
+                                               {}).values()]
+
+    def register_cq(self, db: str, cq: ContinuousQuery) -> None:
+        with self._lock:
+            self.database(db)["continuous_queries"][cq.name] = asdict(cq)
+            self.save()
+
+    def drop_cq(self, db: str, name: str) -> None:
+        with self._lock:
+            self.database(db)["continuous_queries"].pop(name, None)
+            self.save()
+
+    def continuous_queries(self, db: str) -> list[ContinuousQuery]:
+        return [ContinuousQuery(**c)
+                for c in self.database(db).get("continuous_queries",
+                                               {}).values()]
+
+    def set_cq_last_run(self, db: str, name: str, t_ns: int) -> None:
+        with self._lock:
+            cqs = self.database(db)["continuous_queries"]
+            if name in cqs:
+                cqs[name]["last_run_ns"] = t_ns
+                self.save()
+
+    # ---- users (reference meta users + httpd auth) ----------------------
+
+    def create_user(self, name: str, password: str,
+                    admin: bool = False) -> None:
+        with self._lock:
+            salt = secrets.token_hex(8)
+            self.users[name] = {
+                "salt": salt,
+                "hash": _hash_pw(password, salt),
+                "admin": admin,
+                "privileges": {},   # db -> READ|WRITE|ALL
+            }
+            self.save()
+
+    def drop_user(self, name: str) -> None:
+        with self._lock:
+            self.users.pop(name, None)
+            self.save()
+
+    def authenticate(self, name: str, password: str) -> bool:
+        u = self.users.get(name)
+        if u is None:
+            return False
+        return secrets.compare_digest(u["hash"],
+                                      _hash_pw(password, u["salt"]))
+
+    def grant(self, user: str, db: str, privilege: str) -> None:
+        with self._lock:
+            u = self.users.get(user)
+            if u is None:
+                raise GeminiError(f"user not found: {user}")
+            u["privileges"][db] = privilege.upper()
+            self.save()
+
+    def authorized(self, user: str, db: str, need: str) -> bool:
+        u = self.users.get(user)
+        if u is None:
+            return False
+        if u.get("admin"):
+            return True
+        p = u["privileges"].get(db, "")
+        return p == "ALL" or p == need.upper()
+
+    # ---- subscriptions ---------------------------------------------------
+
+    def create_subscription(self, sub: Subscription) -> None:
+        with self._lock:
+            self.subscriptions[f"{sub.db}:{sub.name}"] = sub
+            self.save()
+
+    def drop_subscription(self, db: str, name: str) -> None:
+        with self._lock:
+            self.subscriptions.pop(f"{db}:{name}", None)
+            self.save()
+
+    def subscriptions_for(self, db: str) -> list[Subscription]:
+        return [s for s in self.subscriptions.values() if s.db == db]
+
+
+def _hash_pw(pw: str, salt: str) -> str:
+    return hashlib.pbkdf2_hmac("sha256", pw.encode(), salt.encode(),
+                               10_000).hex()

@@ -76,6 +76,9 @@ class SelectStatement:
     tz: str | None = None
     # sub-select source (SELECT ... FROM (SELECT ...))
     from_subquery: "SelectStatement | None" = None
+    # SELECT ... INTO target (continuous queries / downsampling)
+    into_measurement: str | None = None
+    into_db: str | None = None
 
     @property
     def has_group_by_time(self) -> bool:

@@ -168,9 +168,33 @@ class QueryExecutor:
         tag_keys = {k for s in shards_all for k in s.index.tag_keys(mst)}
         cond = analyze_condition(stmt.condition, tag_keys)
         if aggs:
-            return self._select_agg(stmt, db, mst, aggs, cond, tag_keys)
-        return self._select_raw(stmt, db, mst, raw_fields, has_wildcard,
-                                cond, tag_keys)
+            res = self._select_agg(stmt, db, mst, aggs, cond, tag_keys)
+        else:
+            res = self._select_raw(stmt, db, mst, raw_fields, has_wildcard,
+                                   cond, tag_keys)
+        if stmt.into_measurement:
+            return self._write_into(stmt, db, res)
+        return res
+
+    def _write_into(self, stmt, db: str, res: dict) -> dict:
+        """SELECT ... INTO: write result series back as points (the CQ /
+        downsample write-back path; reference statement_executor INTO)."""
+        from ..storage.rows import PointRow
+        if "series" not in res:
+            return _series("result", ["time", "written"], [[0, 0]])
+        rows = []
+        for s in res["series"]:
+            tags = dict(s.get("tags", {}))
+            cols = s["columns"]
+            for v in s["values"]:
+                fields = {c: val for c, val in zip(cols[1:], v[1:])
+                          if val is not None}
+                if fields:
+                    rows.append(PointRow(stmt.into_measurement, tags,
+                                         fields, int(v[0])))
+        target_db = stmt.into_db or db
+        n = self.engine.write_points(target_db, rows)
+        return _series("result", ["time", "written"], [[0, n]])
 
     # ---- aggregate path --------------------------------------------------
 

@@ -226,26 +226,15 @@ class Parser:
         stmt.fields.append(self.parse_select_field())
         while self._op(","):
             stmt.fields.append(self.parse_select_field())
+        if self._kw("INTO"):
+            stmt.into_db, _rp, stmt.into_measurement = self._dotted_target()
         self._expect_kw("FROM")
         if self._op("("):
             stmt.from_subquery = self.parse_select()
             self._expect_op(")")
         else:
-            first = self._ident()
-            if self._op("."):
-                if self._op("."):          # db..measurement
-                    stmt.from_db = first
-                    stmt.from_measurement = self._ident()
-                else:
-                    second = self._ident()
-                    if self._op("."):      # db.rp.measurement
-                        stmt.from_db, stmt.from_rp = first, second
-                        stmt.from_measurement = self._ident()
-                    else:                  # rp.measurement
-                        stmt.from_rp = first
-                        stmt.from_measurement = second
-            else:
-                stmt.from_measurement = first
+            (stmt.from_db, stmt.from_rp,
+             stmt.from_measurement) = self._dotted_target()
         if self._kw("WHERE"):
             stmt.condition = self.parse_expr()
         if self._kw("GROUP"):
@@ -300,6 +289,18 @@ class Parser:
             stmt.tz = self.lx.next()[1].strip("'")
             self._expect_op(")")
         return stmt
+
+    def _dotted_target(self) -> tuple[str | None, str | None, str]:
+        """Parse m | rp.m | db.rp.m | db..m → (db, rp, measurement)."""
+        first = self._ident()
+        if not self._op("."):
+            return None, None, first
+        if self._op("."):                  # db..measurement
+            return first, None, self._ident()
+        second = self._ident()
+        if self._op("."):                  # db.rp.measurement
+            return first, second, self._ident()
+        return None, first, second         # rp.measurement
 
     def parse_select_field(self) -> SelectField:
         expr = self.parse_expr()

@@ -1,0 +1,7 @@
+from .base import Service
+from .retention import RetentionService
+from .downsample import DownsampleService
+from .compaction import CompactionService
+from .continuous_query import ContinuousQueryService
+from .stream import StreamEngine
+from .subscriber import SubscriberService

@@ -1,0 +1,46 @@
+"""Background service lifecycle (role of reference services.Base)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+
+class Service:
+    """Periodic background service: subclass implements run_once()."""
+
+    name = "service"
+
+    def __init__(self, interval_s: float):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        log.info("service %s started (every %.0fs)", self.name,
+                 self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("service %s tick failed", self.name)
+
+    def run_once(self) -> None:
+        raise NotImplementedError

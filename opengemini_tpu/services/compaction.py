@@ -1,0 +1,27 @@
+"""Compaction service: periodic level-compaction over all shards (driver
+for storage/compact.py; role of the reference's background compaction
+scheduler in engine/immutable/compact.go)."""
+
+from __future__ import annotations
+
+from ..storage.compact import Compactor
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+class CompactionService(Service):
+    name = "compaction"
+
+    def __init__(self, engine, interval_s: float = 60, fanout: int = 4):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.fanout = fanout
+
+    def run_once(self) -> int:
+        n = 0
+        for db in list(self.engine.databases.values()):
+            for shard in db.all_shards():
+                n += Compactor(shard, self.fanout).run_once()
+        return n

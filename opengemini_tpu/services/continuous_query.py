@@ -1,0 +1,76 @@
+"""Continuous queries: periodic SELECT ... INTO execution (role of
+reference services/continuousquery/service.go:53 + meta CQ lease).
+
+Each CQ re-runs over the window (last_run, now] aligned to its every
+interval, substituting the time bounds into the statement condition the way
+the reference's CQ scheduler does."""
+
+from __future__ import annotations
+
+import time
+
+from ..query import QueryExecutor, parse_query
+from ..query.ast import BinaryExpr, FieldRef, Literal
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+class ContinuousQueryService(Service):
+    name = "continuous_query"
+
+    # a CQ that fell behind replays at most this many intervals (the very
+    # first run would otherwise span from epoch 0 and always exceed the
+    # executor's window cap, failing forever)
+    MAX_CATCHUP_INTERVALS = 10
+
+    def __init__(self, engine, catalog, interval_s: float = 10,
+                 now_fn=None):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.catalog = catalog
+        self.executor = QueryExecutor(engine)
+        self.now_fn = now_fn or (lambda: int(time.time() * 1e9))
+
+    def run_once(self) -> int:
+        now = self.now_fn()
+        ran = 0
+        for db_name in list(self.engine.databases):
+            try:
+                cqs = self.catalog.continuous_queries(db_name)
+            except Exception:
+                continue
+            for cq in cqs:
+                # run when a full interval has elapsed since last run
+                due = ((cq.last_run_ns // cq.every_ns) + 1) * cq.every_ns
+                if now < due + cq.offset_ns:
+                    continue
+                t_end = (now - cq.offset_ns) // cq.every_ns * cq.every_ns
+                t_start = cq.last_run_ns // cq.every_ns * cq.every_ns
+                t_start = max(
+                    t_start,
+                    t_end - self.MAX_CATCHUP_INTERVALS * cq.every_ns)
+                if t_start >= t_end:
+                    continue
+                try:
+                    self._run_cq(db_name, cq, t_start, t_end)
+                    self.catalog.set_cq_last_run(db_name, cq.name, t_end)
+                    ran += 1
+                except Exception:
+                    log.exception("cq %s failed", cq.name)
+        return ran
+
+    def _run_cq(self, db_name: str, cq, t_start: int, t_end: int) -> None:
+        (stmt,) = parse_query(cq.query)
+        # bound the query to (t_start, t_end] on top of its own condition
+        bound = BinaryExpr(
+            "and",
+            BinaryExpr(">=", FieldRef("time"), Literal(t_start)),
+            BinaryExpr("<", FieldRef("time"), Literal(t_end)))
+        stmt.condition = (bound if stmt.condition is None
+                          else BinaryExpr("and", stmt.condition, bound))
+        res = self.executor.execute(stmt, db_name)
+        if "error" in res:
+            raise RuntimeError(res["error"])
+        log.debug("cq %s ran over [%d, %d)", cq.name, t_start, t_end)

@@ -1,0 +1,183 @@
+"""Downsample service: rewrite old shards at lower resolution (role of
+reference services/downsample + engine side StartDownSampleTask,
+engine/engine_downsample.go:92, stream_downsample.go).
+
+For every shard fully older than a policy's age, each series is re-windowed
+at the policy interval (mean for floats, sum for integers by default —
+per-type calls configurable) and the shard's files are replaced by the
+downsampled data. A marker file records the applied interval so a shard is
+never downsampled twice at the same level."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..record import ColVal, DataType, Record, Schema
+from ..storage.tssp import TSSPWriter, TSSPReader
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+class DownsampleService(Service):
+    name = "downsample"
+
+    def __init__(self, engine, catalog, interval_s: float = 3600,
+                 now_fn=None):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.catalog = catalog
+        self.now_fn = now_fn or (lambda: int(time.time() * 1e9))
+
+    def run_once(self) -> int:
+        now = self.now_fn()
+        done = 0
+        for db_name in list(self.engine.databases):
+            try:
+                policies = self.catalog.downsample_policies(db_name)
+            except Exception:
+                continue
+            if not policies:
+                continue
+            db = self.engine.databases[db_name]
+            for shard in db.all_shards():
+                for p in sorted(policies, key=lambda p: -p.age_ns):
+                    if shard.end_time > now - p.age_ns:
+                        continue
+                    if self._level(shard) >= p.interval_ns:
+                        continue
+                    self.downsample_shard(shard, p)
+                    done += 1
+                    break
+        return done
+
+    @staticmethod
+    def _marker(shard) -> str:
+        return os.path.join(shard.path, "downsample.level")
+
+    def _level(self, shard) -> int:
+        try:
+            with open(self._marker(shard)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 0
+
+    def downsample_shard(self, shard, policy) -> None:
+        """Rewrite every measurement of the shard at policy.interval_ns."""
+        shard.flush()
+        with shard._lock:
+            msts = list(shard._files)
+        for mst in msts:
+            self._downsample_measurement(shard, mst, policy)
+        with open(self._marker(shard), "w") as f:
+            f.write(str(policy.interval_ns))
+        log.info("downsampled shard %d to %ds resolution", shard.shard_id,
+                 policy.interval_ns // 10**9)
+
+    def _downsample_measurement(self, shard, mst, policy) -> None:
+        from ..storage.compact import iter_merged_series
+        with shard._lock:
+            readers = list(shard._files.get(mst, ()))
+        if not readers:
+            return
+        with shard._lock:
+            shard._file_seq += 1
+            out_path = os.path.join(
+                shard.path, "tssp", f"{mst}_{shard._file_seq:06d}.tssp")
+        w = TSSPWriter(out_path, segment_size=shard.segment_size)
+        wrote = False
+        for sid, rec in iter_merged_series(readers):
+            ds = _downsample_record(rec, policy)
+            if ds.num_rows:
+                w.write_series(sid, ds)
+                wrote = True
+        if wrote:
+            w.finalize()
+            new_reader = TSSPReader(out_path)
+        else:
+            w.abort()
+            new_reader = None
+        drop = {id(r) for r in readers}
+        with shard._lock:
+            # keep any files flushed concurrently since the snapshot
+            current = shard._files.get(mst, [])
+            kept = [r for r in current if id(r) not in drop]
+            shard._files[mst] = (([new_reader] if new_reader else [])
+                                 + kept)
+        for r in readers:
+            try:
+                os.unlink(r.path)
+            except OSError:
+                pass
+
+
+def _downsample_record(rec: Record, policy) -> Record:
+    """Window-aggregate one series record at policy.interval_ns."""
+    t = rec.times
+    w = t // policy.interval_ns
+    # group boundaries over sorted times
+    uniq, starts = np.unique(w, return_index=True)
+    bounds = np.append(starts, len(t))
+    out_times = (uniq * policy.interval_ns).astype(np.int64)
+    fields = []
+    cols = []
+    for f, col in zip(rec.schema, rec.cols):
+        if f.name == "time":
+            continue
+        call = policy.calls.get(f.type.name.lower(), "last")
+        if col.values is None or not f.type.is_numeric:
+            vals, valid = _reduce_strcol(col, bounds, call)
+            fields.append(f)
+            cols.append(ColVal(f.type, valid=valid, offsets=vals[0],
+                               data=vals[1]))
+            continue
+        v, m = col.values, col.valid
+        n_out = len(uniq)
+        outv = np.zeros(n_out, dtype=np.float64)
+        outm = np.zeros(n_out, dtype=np.bool_)
+        for i in range(n_out):
+            lo, hi = bounds[i], bounds[i + 1]
+            vv = v[lo:hi][m[lo:hi]]
+            if len(vv) == 0:
+                continue
+            outm[i] = True
+            if call == "mean":
+                outv[i] = vv.mean()
+            elif call == "sum":
+                outv[i] = vv.sum()
+            elif call == "min":
+                outv[i] = vv.min()
+            elif call == "max":
+                outv[i] = vv.max()
+            elif call == "first":
+                outv[i] = vv[0]
+            elif call == "count":
+                outv[i] = len(vv)
+            else:  # last
+                outv[i] = vv[-1]
+        ftype = f.type if call not in ("mean",) else DataType.FLOAT
+        fields.append(type(f)(f.name, ftype))
+        cols.append(ColVal(ftype, outv.astype(ftype.numpy_dtype), outm))
+    fields.append(rec.schema.fields[rec.schema.time_index])
+    cols.append(ColVal(DataType.TIME, out_times))
+    return Record(Schema(fields), cols)
+
+
+def _reduce_strcol(col: ColVal, bounds, call: str):
+    """last-valid string per window."""
+    strs = col.to_strings()
+    out = []
+    for i in range(len(bounds) - 1):
+        lo, hi = bounds[i], bounds[i + 1]
+        pick = None
+        for j in range(hi - 1, lo - 1, -1):
+            if strs[j] is not None:
+                pick = strs[j]
+                break
+        out.append(pick)
+    c = ColVal.from_strings(out, col.type)
+    return (c.offsets, c.data), c.valid

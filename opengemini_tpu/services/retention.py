@@ -1,0 +1,43 @@
+"""Retention service: delete expired shards per retention policy duration
+(role of reference services/retention/service.go:81-331)."""
+
+from __future__ import annotations
+
+import time
+
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+class RetentionService(Service):
+    name = "retention"
+
+    def __init__(self, engine, catalog, interval_s: float = 1800,
+                 now_fn=None):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.catalog = catalog
+        self.now_fn = now_fn or (lambda: int(time.time() * 1e9))
+
+    def run_once(self) -> int:
+        now = self.now_fn()
+        dropped = 0
+        for db_name in list(self.engine.databases):
+            try:
+                rp = self.catalog.retention_policy(db_name)
+            except Exception:
+                continue  # no catalog entry → infinite retention
+            if rp.duration_ns <= 0:
+                continue
+            cutoff = now - rp.duration_ns
+            db = self.engine.databases[db_name]
+            for shard in db.all_shards():
+                if shard.end_time <= cutoff:
+                    log.info("retention: dropping shard %d of %s "
+                             "(end %d <= cutoff %d)", shard.shard_id,
+                             db_name, shard.end_time, cutoff)
+                    db.drop_shard(shard.shard_id)
+                    dropped += 1
+        return dropped

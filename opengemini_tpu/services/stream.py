@@ -1,0 +1,153 @@
+"""Stream compute engine: continuous windowed aggregation at ingest (role
+of reference app/ts-store/stream/stream.go:109-532 — RegisterTask :289,
+WriteRows :514 — plus the sql-side routing points_writer.go:525).
+
+Tasks filter incoming rows by source measurement, bucket them into
+event-time windows per (group-tag values), and on watermark advance
+(max event time - delay) flush closed windows as aggregated points into the
+destination measurement."""
+
+from __future__ import annotations
+
+import threading
+
+from ..meta.catalog import StreamTask
+from ..storage.rows import PointRow
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+_AGGS = {
+    "sum": lambda acc, v: (acc or 0.0) + v,
+    "count": lambda acc, v: (acc or 0) + 1,
+    "min": lambda acc, v: v if acc is None else min(acc, v),
+    "max": lambda acc, v: v if acc is None else max(acc, v),
+    "last": lambda acc, v: v,
+    "first": lambda acc, v: acc if acc is not None else v,
+}
+
+
+class _WindowCache:
+    """(window_start, group_key) → {field → acc} (+ mean numerators)."""
+
+    def __init__(self, task: StreamTask):
+        self.task = task
+        self.windows: dict[tuple, dict] = {}
+        self.max_event_time = 0
+
+
+class StreamEngine:
+    """Registered on the engine's write hook; owns all tasks of all dbs."""
+
+    def __init__(self, engine, catalog):
+        self.engine = engine
+        self.catalog = catalog
+        self._lock = threading.Lock()
+        self._caches: dict[tuple, _WindowCache] = {}
+        engine.write_hooks.append(self.on_write)
+
+    # ---- task admin ------------------------------------------------------
+
+    def register(self, db: str, task: StreamTask) -> None:
+        self.catalog.register_stream(db, task)
+        with self._lock:
+            self._caches[(db, task.name)] = _WindowCache(task)
+
+    def drop(self, db: str, name: str) -> None:
+        self.catalog.drop_stream(db, name)
+        with self._lock:
+            self._caches.pop((db, name), None)
+
+    def load_tasks(self) -> None:
+        for db in list(self.engine.databases):
+            try:
+                for t in self.catalog.stream_tasks(db):
+                    with self._lock:
+                        self._caches.setdefault((db, t.name),
+                                                _WindowCache(t))
+            except Exception:
+                continue
+
+    # ---- ingest hook -----------------------------------------------------
+
+    def on_write(self, db: str, rows: list[PointRow]) -> None:
+        with self._lock:
+            caches = [(key, c) for key, c in self._caches.items()
+                      if key[0] == db]
+        if not caches:
+            return
+        # bucket the batch by measurement ONCE (not per task)
+        by_mst: dict[str, list[PointRow]] = {}
+        for r in rows:
+            by_mst.setdefault(r.measurement, []).append(r)
+        for (key_db, _name), cache in caches:
+            src = cache.task.src_measurement
+            if src in by_mst and src != cache.task.dest_measurement:
+                self._feed(key_db, cache, by_mst[src])
+
+    def _feed(self, db: str, cache: _WindowCache,
+              rows: list[PointRow]) -> None:
+        t = cache.task
+        out = []
+        with self._lock:
+            for r in rows:
+                win = r.time // t.interval_ns * t.interval_ns
+                gkey = tuple(r.tags.get(k, "") for k in t.group_tags)
+                acc = cache.windows.setdefault((win, gkey), {})
+                for fname, func in t.calls.items():
+                    v = r.fields.get(fname)
+                    if v is None or not isinstance(v, (int, float)) \
+                            or isinstance(v, bool):
+                        continue
+                    outname = f"{fname}_{func}"
+                    if func == "mean":
+                        s, c = acc.get(outname, (0.0, 0))
+                        acc[outname] = (s + v, c + 1)
+                    else:
+                        acc[outname] = _AGGS[func](acc.get(outname), v)
+                cache.max_event_time = max(cache.max_event_time, r.time)
+            out = self._collect_closed(cache)
+        if out:
+            self.engine.write_points(db, out)
+
+    def _collect_closed(self, cache: _WindowCache) -> list[PointRow]:
+        """Flush windows fully below the watermark."""
+        t = cache.task
+        watermark = cache.max_event_time - t.delay_ns
+        out = []
+        for (win, gkey) in sorted(cache.windows):
+            if win + t.interval_ns > watermark:
+                continue
+            acc = cache.windows.pop((win, gkey))
+            fields = {}
+            for name, v in acc.items():
+                if isinstance(v, tuple):  # mean (sum, count)
+                    fields[name] = v[0] / v[1] if v[1] else 0.0
+                else:
+                    fields[name] = float(v)
+            if fields:
+                tags = dict(zip(t.group_tags, gkey))
+                out.append(PointRow(t.dest_measurement, tags, fields, win))
+        return out
+
+    def flush_all(self) -> None:
+        """Force-flush every open window (shutdown path)."""
+        pending: list[tuple[str, list[PointRow]]] = []
+        with self._lock:
+            for (db, _name), cache in self._caches.items():
+                t = cache.task
+                out = []
+                for (win, gkey) in sorted(cache.windows):
+                    acc = cache.windows.pop((win, gkey))
+                    fields = {k: (v[0] / v[1] if isinstance(v, tuple) and
+                                  v[1] else float(v[0]) if
+                                  isinstance(v, tuple) else float(v))
+                              for k, v in acc.items()}
+                    if fields:
+                        out.append(PointRow(t.dest_measurement,
+                                            dict(zip(t.group_tags, gkey)),
+                                            fields, win))
+                if out:
+                    pending.append((db, out))
+        for db, out in pending:
+            self.engine.write_points(db, out)

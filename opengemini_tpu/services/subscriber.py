@@ -1,0 +1,103 @@
+"""Subscriber service: forward written points to subscriber endpoints
+(role of reference coordinator/subscriber.go:200-373 — per-db writers,
+ALL = every destination, ANY = round-robin)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import urllib.request
+
+from ..storage.rows import PointRow
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+
+def rows_to_lp(rows: list[PointRow]) -> str:
+    def esc(s, chars):
+        for c in chars:
+            s = s.replace(c, "\\" + c)
+        return s
+
+    out = []
+    for r in rows:
+        m = esc(r.measurement, ", ")
+        tags = "".join(f",{esc(k, ', =')}={esc(v, ', =')}"
+                       for k, v in sorted(r.tags.items()))
+        fs = []
+        for k, v in r.fields.items():
+            k = esc(k, ", =")
+            if isinstance(v, bool):
+                fs.append(f"{k}={'t' if v else 'f'}")
+            elif isinstance(v, int):
+                fs.append(f"{k}={v}i")
+            elif isinstance(v, float):
+                fs.append(f"{k}={v!r}")
+            else:
+                vq = str(v).replace("\\", "\\\\").replace('"', '\\"')
+                fs.append(f'{k}="{vq}"')
+        out.append(f"{m}{tags} {','.join(fs)} {r.time}")
+    return "\n".join(out)
+
+
+class SubscriberService:
+    """Hooks engine writes; ships line protocol to destinations
+    asynchronously (bounded queue, drops with a log on overflow — the
+    reference behaves the same under backpressure)."""
+
+    def __init__(self, engine, catalog, max_queue: int = 1000):
+        self.engine = engine
+        self.catalog = catalog
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        engine.write_hooks.append(self.on_write)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._drain,
+                                        name="subscriber", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._q.put(None)
+            self._thread.join(timeout=5)
+
+    def on_write(self, db: str, rows: list[PointRow]) -> None:
+        subs = self.catalog.subscriptions_for(db)
+        if not subs:
+            return
+        try:
+            self._q.put_nowait((db, rows))
+        except queue.Full:
+            log.warning("subscriber queue full; dropping %d rows",
+                        len(rows))
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            db, rows = item
+            body = rows_to_lp(rows).encode()
+            for sub in self.catalog.subscriptions_for(db):
+                dests = sub.destinations
+                if not dests:
+                    continue
+                if sub.mode.upper() == "ANY":
+                    dests = [dests[self._rr % len(dests)]]
+                    self._rr += 1
+                for d in dests:
+                    self._send(d, db, body)
+
+    @staticmethod
+    def _send(dest: str, db: str, body: bytes) -> None:
+        url = f"{dest.rstrip('/')}/write?db={db}"
+        try:
+            req = urllib.request.Request(url, data=body, method="POST")
+            urllib.request.urlopen(req, timeout=10)
+        except Exception as e:
+            log.warning("subscriber push to %s failed: %s", dest, e)

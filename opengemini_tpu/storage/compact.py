@@ -1,0 +1,138 @@
+"""Compaction: merge many small TSSP files into one (role of reference
+engine/immutable/compact.go LevelCompact :119, merge_out_of_order.go,
+merge_tool.go).
+
+Level policy: files are grouped by size tier (level = log2(size/base)); when
+a measurement accumulates >= `fanout` files in one level, they merge into
+one file at the next level. Out-of-order data merges via the same per-series
+ordered merge used by the read path (last-write-wins, null-preserving), so
+compaction output is exactly what reads would have produced.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils import get_logger
+from .tssp import TSSPReader, TSSPWriter
+
+log = get_logger(__name__)
+
+BASE_SIZE = 1 << 20       # 1 MiB → level 0
+DEFAULT_FANOUT = 4
+MAX_LEVEL = 6
+
+
+def iter_merged_series(readers):
+    """Yield (sid, merged Record) over the union of series in `readers`,
+    merging oldest→newest with the read path's last-write-wins semantics.
+    Shared by compaction and downsampling."""
+    from .shard import _merge_parts
+    sids = sorted({sid for r in readers for sid in r.series_ids()})
+    for sid in sids:
+        rec = None
+        for r in readers:
+            part = r.read_series(sid)
+            if part is not None:
+                rec = part if rec is None else _merge_parts(rec, part)
+        if rec is not None and rec.num_rows:
+            yield sid, rec
+
+
+def file_level(path: str) -> int:
+    sz = os.path.getsize(path)
+    lvl = 0
+    while sz >= BASE_SIZE << (lvl + 1) and lvl < MAX_LEVEL:
+        lvl += 1
+    return lvl
+
+
+class Compactor:
+    """Per-shard compactor; invoked by the shard after flush or by the
+    compaction service."""
+
+    def __init__(self, shard, fanout: int = DEFAULT_FANOUT):
+        self.shard = shard
+        self.fanout = fanout
+
+    def plan(self) -> dict[str, list[TSSPReader]]:
+        """measurement → CONTIGUOUS run of same-level files to merge.
+        Contiguity in the file list is required for correctness: the read
+        path resolves duplicate timestamps by list order (later wins), so a
+        merged output may only replace neighbouring inputs."""
+        out = {}
+        with self.shard._lock:
+            for mst, readers in self.shard._files.items():
+                if len(readers) < self.fanout:
+                    continue
+                levels = [file_level(r.path) for r in readers]
+                best: list[TSSPReader] = []
+                i = 0
+                while i < len(readers):
+                    j = i
+                    while j + 1 < len(readers) and levels[j + 1] == levels[i]:
+                        j += 1
+                    run = readers[i:j + 1]
+                    if len(run) >= self.fanout and len(run) > len(best):
+                        best = run
+                    i = j + 1
+                if best:
+                    out[mst] = best
+        return out
+
+    def compact_measurement(self, mst: str,
+                            readers: list[TSSPReader]) -> str | None:
+        """Merge `readers` (a CONTIGUOUS, oldest→newest slice of the
+        shard's file list) into one new file; swap it in at the slice's
+        position; delete inputs. Returns the new path."""
+        shard = self.shard
+        with shard._lock:
+            shard._file_seq += 1
+            out_path = os.path.join(shard.path, "tssp",
+                                    f"{mst}_{shard._file_seq:06d}.tssp")
+        w = TSSPWriter(out_path, segment_size=shard.segment_size)
+        wrote = False
+        for _sid, rec in iter_merged_series(readers):
+            w.write_series(_sid, rec)
+            wrote = True
+        if not wrote:
+            w.abort()
+            return None
+        w.finalize()
+        new_reader = TSSPReader(out_path)
+        with shard._lock:
+            files = shard._files.get(mst, [])
+            drop = set(id(r) for r in readers)
+            # replace the merged inputs with the output, preserving the
+            # position of the OLDEST input (merge order invariant)
+            new_list = []
+            inserted = False
+            for r in files:
+                if id(r) in drop:
+                    if not inserted:
+                        new_list.append(new_reader)
+                        inserted = True
+                    continue
+                new_list.append(r)
+            if not inserted:
+                new_list.append(new_reader)
+            shard._files[mst] = new_list
+        # unlink but do NOT close: in-flight queries may still hold these
+        # readers (POSIX keeps the mapped data alive after unlink); the
+        # mmap closes when the last reference drops (TSSPReader.__del__)
+        for r in readers:
+            try:
+                os.unlink(r.path)
+            except OSError as e:
+                log.error("compact: failed to remove %s: %s", r.path, e)
+        log.info("compacted %s: %d files -> %s", mst, len(readers),
+                 os.path.basename(out_path))
+        return out_path
+
+    def run_once(self) -> int:
+        """One compaction pass; returns number of merges performed."""
+        n = 0
+        for mst, readers in self.plan().items():
+            self.compact_measurement(mst, readers)
+            n += 1
+        return n

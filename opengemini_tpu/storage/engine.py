@@ -73,6 +73,18 @@ class Database:
                 s = self.shards[gi] = self._open_shard(gi)
             return s
 
+    def drop_shard(self, gi: int) -> None:
+        import shutil
+        with self._lock:
+            # pop + rmtree under the lock so shard_for_time cannot recreate
+            # the directory mid-delete (a later write re-creates it fresh)
+            s = self.shards.pop(gi, None)
+            if s is not None:
+                # keep TSSP mmaps open: in-flight queries may still hold the
+                # readers; they close via GC (unlinked data stays readable)
+                s.close(close_files=False)
+                shutil.rmtree(s.path, ignore_errors=True)
+
     def shards_overlapping(self, t_min: int, t_max: int) -> list[Shard]:
         """Time-pruned shard selection (reference shard_mapper.go:74-117)."""
         sd = self.opts.shard_duration
@@ -95,6 +107,10 @@ class Engine:
         self.opts = opts or EngineOptions()
         self.databases: dict[str, Database] = {}
         self._lock = threading.RLock()
+        # post-write hooks: fn(db_name, rows) after a successful write
+        # (stream engine, subscribers — reference hooks these in the
+        # coordinator PointsWriter, points_writer.go:525)
+        self.write_hooks: list = []
         os.makedirs(data_path, exist_ok=True)
         for fn in sorted(os.listdir(data_path)):
             if os.path.isdir(os.path.join(data_path, fn)):
@@ -138,9 +154,25 @@ class Engine:
         for r in rows:
             by_shard.setdefault(r.time // sd, []).append(r)
         n = 0
+        written: list[PointRow] = []
+        err: Exception | None = None
         for gi, batch in by_shard.items():
-            shard = db.shard_for_time(gi * sd)
-            n += shard.write_rows(batch)
+            try:
+                shard = db.shard_for_time(gi * sd)
+                n += shard.write_rows(batch)
+                written.extend(batch)
+            except Exception as e:
+                err = e
+        # hooks see only rows that were actually stored — derived data
+        # (streams, subscribers) must not diverge from the source
+        if written:
+            for hook in self.write_hooks:
+                try:
+                    hook(db_name, written)
+                except Exception:
+                    log.exception("write hook failed")
+        if err is not None:
+            raise err
         return n
 
     # ---- reads -----------------------------------------------------------

@@ -258,13 +258,16 @@ class Shard:
                     rec = part if rec is None else _merge_parts(rec, part)
         return rec
 
-    def close(self) -> None:
+    def close(self, close_files: bool = True) -> None:
+        """close_files=False leaves TSSP mmaps open for in-flight queries
+        (retention drop path); they close when the last reference drops."""
         with self._lock:
             self.wal.close()
             self.index.close()
-            for files in self._files.values():
-                for f in files:
-                    f.close()
+            if close_files:
+                for files in self._files.values():
+                    for f in files:
+                        f.close()
 
 
 def _project(rec: Record, columns: list[str]) -> Record:

@@ -350,6 +350,13 @@ class TSSPReader:
         self._mm.close()
         self._file.close()
 
+    def __del__(self):  # deferred close for compacted-away files
+        try:
+            if not self._mm.closed:
+                self.close()
+        except Exception:
+            pass
+
     # ---- meta access ----------------------------------------------------
 
     def _load_group(self, gi: int) -> dict[int, ChunkMeta]:

@@ -1,0 +1,232 @@
+"""Tests: meta catalog, compaction, retention, downsample, CQ, stream,
+subscriber (reference models: services/*/service_test.go)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.meta import (Catalog, DownsamplePolicy, RetentionPolicy,
+                                 StreamTask)
+from opengemini_tpu.meta.catalog import ContinuousQuery, Subscription
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.services import (CompactionService,
+                                     ContinuousQueryService,
+                                     DownsampleService, RetentionService,
+                                     StreamEngine)
+from opengemini_tpu.services.subscriber import rows_to_lp
+from opengemini_tpu.storage import Engine, EngineOptions, PointRow
+
+S = 10**9
+H = 3600 * S
+
+
+# ---- catalog ----------------------------------------------------------------
+
+def test_catalog_persistence(tmp_path):
+    p = str(tmp_path / "meta.json")
+    c = Catalog(p)
+    c.create_database("db0", RetentionPolicy("rp1", duration_ns=24 * H))
+    c.create_user("admin", "secret", admin=True)
+    c.create_user("bob", "pw")
+    c.grant("bob", "db0", "READ")
+    c.create_subscription(Subscription("s1", "db0", "ALL",
+                                       ["http://example:8086"]))
+    c2 = Catalog(p)
+    assert c2.retention_policy("db0").duration_ns == 24 * H
+    assert c2.authenticate("admin", "secret")
+    assert not c2.authenticate("admin", "wrong")
+    assert c2.authorized("admin", "anything", "WRITE")
+    assert c2.authorized("bob", "db0", "READ")
+    assert not c2.authorized("bob", "db0", "WRITE")
+    assert len(c2.subscriptions_for("db0")) == 1
+
+
+def test_catalog_rp_lifecycle(tmp_path):
+    c = Catalog(str(tmp_path / "meta.json"))
+    c.create_database("db0")
+    c.create_retention_policy("db0", RetentionPolicy(
+        "week", duration_ns=7 * 24 * H, default=False))
+    c.alter_retention_policy("db0", "week", duration_ns=14 * 24 * H,
+                             make_default=True)
+    assert c.retention_policy("db0").name == "week"
+    assert c.retention_policy("db0").duration_ns == 14 * 24 * H
+    c.drop_retention_policy("db0", "week")
+    assert c.retention_policy("db0").name == "autogen"
+
+
+# ---- compaction -------------------------------------------------------------
+
+def test_compaction_merges_files(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    for i in range(5):
+        eng.write_points("db0", [
+            PointRow("m", {"h": "a"}, {"v": float(i)}, i * 1000)])
+        eng.flush_all()  # one file per flush
+    shard = eng.database("db0").all_shards()[0]
+    assert len(shard._files["m"]) == 5
+    n = CompactionService(eng, fanout=4).run_once()
+    assert n == 1
+    assert len(shard._files["m"]) <= 2
+    # data survives, merged in order
+    res = eng.scan_series("db0", "m")
+    assert [r[2].num_rows for r in res] == [5]
+    assert list(res[0][2].column("v").values) == [0, 1, 2, 3, 4]
+    # old files gone from disk
+    files = os.listdir(os.path.join(shard.path, "tssp"))
+    assert len(files) <= 2
+    eng.close()
+
+
+def test_compaction_dedups_overwrites(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    for i in range(4):
+        eng.write_points("db0", [
+            PointRow("m", {}, {"v": float(i)}, 42)])  # same ts 4 times
+        eng.flush_all()
+    CompactionService(eng, fanout=4).run_once()
+    res = eng.scan_series("db0", "m")
+    assert res[0][2].num_rows == 1
+    assert res[0][2].column("v").get(0) == 3.0  # newest wins
+    eng.close()
+
+
+# ---- retention --------------------------------------------------------------
+
+def test_retention_drops_expired_shards(tmp_path):
+    opts = EngineOptions(shard_duration=H)
+    eng = Engine(str(tmp_path / "d"), opts)
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0", RetentionPolicy(duration_ns=2 * H))
+    now = 10 * H
+    rows = [PointRow("m", {}, {"v": 1.0}, t * H + 1)
+            for t in (1, 5, 9)]  # shards 1, 5, 9
+    eng.write_points("db0", rows)
+    assert len(eng.database("db0").all_shards()) == 3
+    svc = RetentionService(eng, cat, now_fn=lambda: now)
+    dropped = svc.run_once()
+    assert dropped == 2  # shards 1 and 5 expired (end <= 8h cutoff)
+    remaining = eng.database("db0").all_shards()
+    assert [s.shard_id for s in remaining] == [9]
+    eng.close()
+
+
+def test_retention_infinite_keeps_all(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")  # default infinite
+    eng.write_points("db0", [PointRow("m", {}, {"v": 1.0}, 0)])
+    assert RetentionService(eng, cat,
+                            now_fn=lambda: 10**18).run_once() == 0
+    eng.close()
+
+
+# ---- downsample -------------------------------------------------------------
+
+def test_downsample_rewrites_old_shard(tmp_path):
+    opts = EngineOptions(shard_duration=H)
+    eng = Engine(str(tmp_path / "d"), opts)
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")
+    cat.add_downsample_policy("db0", DownsamplePolicy(
+        rp="autogen", age_ns=H, interval_ns=60 * S))
+    # 120 points at 1s spacing in shard 0
+    eng.write_points("db0", [
+        PointRow("m", {"h": "a"}, {"v": float(i), "c": i}, i * S)
+        for i in range(120)])
+    eng.flush_all()
+    svc = DownsampleService(eng, cat, now_fn=lambda: 3 * H)
+    assert svc.run_once() == 1
+    res = eng.scan_series("db0", "m")
+    rec = res[0][2]
+    assert rec.num_rows == 2  # two 1-minute windows
+    np.testing.assert_allclose(rec.column("v").get(0),
+                               np.mean(np.arange(60.0)))
+    assert rec.column("c").get(0) == sum(range(60))  # int sum
+    # second run: marker prevents re-downsampling
+    assert svc.run_once() == 0
+    eng.close()
+
+
+# ---- continuous queries -----------------------------------------------------
+
+def test_cq_runs_select_into(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")
+    eng.create_database("db0")
+    cat.register_cq("db0", ContinuousQuery(
+        "cq1",
+        "SELECT mean(v) INTO m_1m FROM m GROUP BY time(1m), h",
+        every_ns=60 * S))
+    eng.write_points("db0", [
+        PointRow("m", {"h": "a"}, {"v": float(i)}, i * 10 * S)
+        for i in range(12)])  # 2 minutes of data
+    svc = ContinuousQueryService(eng, cat, now_fn=lambda: 2 * 60 * S + 1)
+    assert svc.run_once() == 1
+    res = eng.scan_series("db0", "m_1m")
+    assert len(res) == 1
+    rec = res[0][2]
+    assert rec.num_rows == 2
+    assert rec.column("mean").get(0) == 2.5   # mean of 0..5
+    assert rec.column("mean").get(1) == 8.5   # mean of 6..11
+    # second run with no new complete window: no-op
+    assert svc.run_once() == 0
+    eng.close()
+
+
+# ---- stream -----------------------------------------------------------------
+
+def test_stream_window_aggregation(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")
+    stream = StreamEngine(eng, cat)
+    stream.register("db0", StreamTask(
+        "t1", "m", "m_agg", interval_ns=60 * S, group_tags=["h"],
+        calls={"v": "sum", "v2": "mean"}))
+    # window 0 data then a row in window 2 (advances watermark past w0, w1)
+    rows = ([PointRow("m", {"h": "a"}, {"v": 1.0, "v2": 10.0}, i * 10 * S)
+             for i in range(6)]
+            + [PointRow("m", {"h": "b"}, {"v": 5.0}, 30 * S)])
+    eng.write_points("db0", rows)
+    eng.write_points("db0", [PointRow("m", {"h": "a"}, {"v": 0.0},
+                                      130 * S)])
+    res = eng.scan_series("db0", "m_agg")
+    assert len(res) == 2  # h=a and h=b windows flushed
+    by_tag = {}
+    for s, sid, rec in res:
+        by_tag[s.index.tags_of(sid)["h"]] = rec
+    assert by_tag["a"].column("v_sum").get(0) == 6.0
+    assert by_tag["a"].column("v2_mean").get(0) == 10.0
+    assert by_tag["b"].column("v_sum").get(0) == 5.0
+    eng.close()
+
+
+def test_stream_flush_all(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")
+    stream = StreamEngine(eng, cat)
+    stream.register("db0", StreamTask(
+        "t1", "m", "m_agg", interval_ns=60 * S, calls={"v": "count"}))
+    eng.write_points("db0", [PointRow("m", {}, {"v": 1.0}, 5 * S)])
+    assert eng.scan_series("db0", "m_agg") == []  # window still open
+    stream.flush_all()
+    res = eng.scan_series("db0", "m_agg")
+    assert res[0][2].column("v_count").get(0) == 1.0
+    eng.close()
+
+
+# ---- subscriber helpers -----------------------------------------------------
+
+def test_rows_to_lp_roundtrip():
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    rows = [PointRow("my m", {"ta g": "v=1"},
+                     {"f": 1.5, "i": 3, "b": True, "s": 'say "hi"'}, 42)]
+    lp = rows_to_lp(rows)
+    back = parse_lines(lp)
+    assert back[0].measurement == "my m"
+    assert back[0].tags == {"ta g": "v=1"}
+    assert back[0].fields == rows[0].fields
+    assert back[0].time == 42
