@@ -1,0 +1,28 @@
+"""Cluster layer: meta consensus, RPC transport, routing, distribution.
+
+TPU-native replacement for the reference's cluster stack:
+- transport: typed binary RPC (role of spdy, engine/executor/spdy/, and
+  netstorage, lib/netstorage/storage.go) — control + data plane between
+  sql/store/meta node roles. On-device aggregation exchange stays in
+  parallel/ (XLA collectives); this transport carries host-side partial
+  states and control messages only.
+- raft: CPU-side raft consensus for the meta catalog (role of hashicorp
+  raft, app/ts-meta/meta/raft_wrapper.go:23).
+- meta_data / meta_store / meta_client: replicated cluster catalog
+  (role of lib/util/lifted/influx/meta/data.go + app/ts-meta/meta/store.go
+  + lib/metaclient/meta_client.go:332).
+- points_writer: time+hash routing write fan-out (coordinator/
+  points_writer.go:228).
+- shard_mapper: query scatter/gather with partial-agg merge
+  (coordinator/shard_mapper.go:60).
+"""
+
+from .hashing import series_hash, fnv1a64
+from .transport import RPCServer, RPCClient, RPCError
+from .meta_data import MetaData, DataNode, ShardGroupInfo, PtInfo
+
+__all__ = [
+    "series_hash", "fnv1a64",
+    "RPCServer", "RPCClient", "RPCError",
+    "MetaData", "DataNode", "ShardGroupInfo", "PtInfo",
+]

@@ -1,0 +1,29 @@
+"""Stable series-key hashing for shard routing.
+
+Role of the reference's shard-key hash used by ShardGroupInfo.ShardFor
+(lib/util/lifted/influx/meta/shardinfo.go:369-375). FNV-1a 64 is stable
+across processes and platforms (Python's hash() is salted, so it cannot
+route consistently between nodes).
+"""
+
+from __future__ import annotations
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK
+    return h
+
+
+def series_hash(measurement: str, tags: dict[str, str]) -> int:
+    """Hash of the canonical series key (measurement + sorted tags)."""
+    parts = [measurement]
+    for k in sorted(tags):
+        parts.append(f"{k}={tags[k]}")
+    return fnv1a64(",".join(parts).encode())

@@ -1,0 +1,349 @@
+"""Replicated cluster catalog data model.
+
+Role of the reference's meta data model (lib/util/lifted/influx/meta/
+data.go:1-4200, shardinfo.go) — the state machine content replicated by
+the meta raft group:
+
+- DataNode: a store node (id, rpc addr, status) — data.go DataNode.
+- PtInfo: logical partition of a database, owned by one node
+  (engine/partition.go DBPTInfo assignment; moved on failure).
+- ShardGroupInfo: one time slice of a database; holds one shard per
+  partition. Routing: time → shard group, series hash → shard
+  (ShardFor, shardinfo.go:369-375) or shard-key range (DestShard,
+  shardinfo.go:359-366).
+
+Everything is plain dict/dataclass state, JSON-serializable: the raft
+FSM applies commands to a MetaData, snapshots marshal it whole.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+NS_PER_HOUR = 3600 * 10**9
+DEFAULT_SHARD_DURATION = 24 * 7 * NS_PER_HOUR
+
+STATUS_ALIVE = "alive"
+STATUS_FAILED = "failed"
+
+PT_ONLINE = "online"
+PT_OFFLINE = "offline"
+PT_MIGRATING = "migrating"
+
+
+@dataclass
+class DataNode:
+    id: int
+    addr: str                      # store RPC address host:port
+    status: str = STATUS_ALIVE
+    last_heartbeat: int = 0        # ns timestamp, maintained by meta
+
+    def to_dict(self):
+        return {"id": self.id, "addr": self.addr, "status": self.status,
+                "last_heartbeat": self.last_heartbeat}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+@dataclass
+class PtInfo:
+    db: str
+    pt_id: int
+    owner: int                     # node id
+    status: str = PT_ONLINE
+    replicas: list[int] = field(default_factory=list)  # replica node ids
+
+    def to_dict(self):
+        return {"db": self.db, "pt_id": self.pt_id, "owner": self.owner,
+                "status": self.status, "replicas": self.replicas}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+@dataclass
+class ShardInfo:
+    id: int
+    pt_id: int                     # owning partition
+    min_key: str = ""              # range sharding bounds (optional)
+    max_key: str = ""
+
+    def to_dict(self):
+        return {"id": self.id, "pt_id": self.pt_id,
+                "min_key": self.min_key, "max_key": self.max_key}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+@dataclass
+class ShardGroupInfo:
+    id: int
+    start_time: int                # [start, end) ns
+    end_time: int
+    shards: list[ShardInfo] = field(default_factory=list)
+    deleted: bool = False
+
+    def shard_for(self, h: int) -> ShardInfo:
+        """Hash routing (reference ShardFor shardinfo.go:369-375)."""
+        return self.shards[h % len(self.shards)]
+
+    def dest_shard(self, shard_key: str) -> ShardInfo:
+        """Range routing (reference DestShard shardinfo.go:359-366):
+        shards ordered by min_key; pick the last whose min_key <= key."""
+        keys = [s.min_key for s in self.shards]
+        i = bisect.bisect_right(keys, shard_key) - 1
+        return self.shards[max(i, 0)]
+
+    def contains(self, t: int) -> bool:
+        return self.start_time <= t < self.end_time
+
+    def overlaps(self, t_min: int, t_max: int) -> bool:
+        return self.start_time <= t_max and t_min < self.end_time
+
+    def to_dict(self):
+        return {"id": self.id, "start_time": self.start_time,
+                "end_time": self.end_time, "deleted": self.deleted,
+                "shards": [s.to_dict() for s in self.shards]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(id=d["id"], start_time=d["start_time"],
+                   end_time=d["end_time"], deleted=d.get("deleted", False),
+                   shards=[ShardInfo.from_dict(s) for s in d["shards"]])
+
+
+@dataclass
+class DatabaseInfo:
+    name: str
+    num_pts: int = 1
+    replica_n: int = 1
+    shard_duration: int = DEFAULT_SHARD_DURATION
+    shard_groups: list[ShardGroupInfo] = field(default_factory=list)
+
+    def to_dict(self):
+        return {"name": self.name, "num_pts": self.num_pts,
+                "replica_n": self.replica_n,
+                "shard_duration": self.shard_duration,
+                "shard_groups": [g.to_dict() for g in self.shard_groups]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(name=d["name"], num_pts=d["num_pts"],
+                   replica_n=d.get("replica_n", 1),
+                   shard_duration=d["shard_duration"],
+                   shard_groups=[ShardGroupInfo.from_dict(g)
+                                 for g in d["shard_groups"]])
+
+
+class MetaData:
+    """The replicated catalog. Mutations happen ONLY through apply() —
+    the raft FSM entry point — so every replica deterministically reaches
+    the same state (reference store_fsm.go)."""
+
+    def __init__(self):
+        self.version = 0
+        self.nodes: dict[int, DataNode] = {}
+        self.databases: dict[str, DatabaseInfo] = {}
+        self.pts: dict[str, list[PtInfo]] = {}       # db -> pt list
+        self.next_node_id = 1
+        self.next_shard_id = 1
+        self.next_sg_id = 1
+
+    # ------------------------------------------------------------- queries
+
+    def db(self, name: str) -> DatabaseInfo | None:
+        return self.databases.get(name)
+
+    def alive_nodes(self) -> list[DataNode]:
+        return [n for n in self.nodes.values() if n.status == STATUS_ALIVE]
+
+    def pt_owner(self, db: str, pt_id: int) -> DataNode | None:
+        for pt in self.pts.get(db, []):
+            if pt.pt_id == pt_id:
+                return self.nodes.get(pt.owner)
+        return None
+
+    def shard_group_for_time(self, db: str, t: int) -> ShardGroupInfo | None:
+        info = self.databases.get(db)
+        if info is None:
+            return None
+        for g in info.shard_groups:
+            if not g.deleted and g.contains(t):
+                return g
+        return None
+
+    def shard_groups_overlapping(self, db: str, t_min: int,
+                                 t_max: int) -> list[ShardGroupInfo]:
+        info = self.databases.get(db)
+        if info is None:
+            return []
+        return [g for g in info.shard_groups
+                if not g.deleted and g.overlaps(t_min, t_max)]
+
+    def pts_by_node(self, db: str) -> dict[int, list[PtInfo]]:
+        """node id → partitions of db it owns (online only)."""
+        out: dict[int, list[PtInfo]] = {}
+        for pt in self.pts.get(db, []):
+            if pt.status == PT_ONLINE:
+                out.setdefault(pt.owner, []).append(pt)
+        return out
+
+    # -------------------------------------------------------- FSM commands
+
+    def apply(self, cmd: dict):
+        """Apply one replicated command; returns the command's result.
+        Must be deterministic — no wall clock, no randomness (timestamps
+        ride inside the command)."""
+        op = cmd["op"]
+        fn = getattr(self, f"_apply_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown meta op {op!r}")
+        res = fn(cmd)
+        self.version += 1
+        return res
+
+    def _apply_create_node(self, cmd):
+        addr = cmd["addr"]
+        for n in self.nodes.values():
+            if n.addr == addr:                      # re-join keeps the id
+                n.status = STATUS_ALIVE
+                n.last_heartbeat = cmd.get("now", 0)
+                return n.id
+        nid = self.next_node_id
+        self.next_node_id += 1
+        self.nodes[nid] = DataNode(id=nid, addr=addr,
+                                   last_heartbeat=cmd.get("now", 0))
+        return nid
+
+    def _apply_heartbeat(self, cmd):
+        n = self.nodes.get(cmd["node_id"])
+        if n is not None:
+            n.last_heartbeat = cmd.get("now", 0)
+            if n.status != STATUS_ALIVE:
+                n.status = STATUS_ALIVE
+        return None
+
+    def _apply_set_node_status(self, cmd):
+        n = self.nodes.get(cmd["node_id"])
+        if n is not None:
+            n.status = cmd["status"]
+        return None
+
+    def _apply_create_database(self, cmd):
+        name = cmd["name"]
+        if name in self.databases:
+            return False
+        if not self.alive_nodes():
+            raise ValueError(
+                "cannot create database: no alive data nodes registered")
+        num_pts = cmd.get("num_pts") or len(self.alive_nodes())
+        self.databases[name] = DatabaseInfo(
+            name=name, num_pts=num_pts,
+            replica_n=cmd.get("replica_n", 1),
+            shard_duration=cmd.get("shard_duration",
+                                   DEFAULT_SHARD_DURATION))
+        # assign PTs round-robin over alive nodes (data.go CreateDBPtView)
+        nodes = sorted(n.id for n in self.alive_nodes())
+        pts = []
+        for i in range(num_pts):
+            owner = nodes[i % len(nodes)]
+            # distinct non-owner replicas, clamped to the node count
+            reps = []
+            for r in range(1, len(nodes)):
+                if len(reps) >= cmd.get("replica_n", 1) - 1:
+                    break
+                cand = nodes[(i + r) % len(nodes)]
+                if cand != owner and cand not in reps:
+                    reps.append(cand)
+            pts.append(PtInfo(db=name, pt_id=i, owner=owner,
+                              replicas=reps))
+        self.pts[name] = pts
+        return True
+
+    def _apply_drop_database(self, cmd):
+        self.databases.pop(cmd["name"], None)
+        self.pts.pop(cmd["name"], None)
+        return None
+
+    def _apply_create_shard_group(self, cmd):
+        """Idempotent: returns the existing group if one covers t."""
+        db, t = cmd["db"], cmd["t"]
+        info = self.databases.get(db)
+        if info is None:
+            raise ValueError(f"database not found: {db}")
+        g = self.shard_group_for_time(db, t)
+        if g is not None:
+            return g.to_dict()
+        sd = info.shard_duration
+        start = t // sd * sd
+        shards = []
+        for pt in self.pts.get(db, []):
+            shards.append(ShardInfo(id=self.next_shard_id,
+                                    pt_id=pt.pt_id))
+            self.next_shard_id += 1
+        g = ShardGroupInfo(id=self.next_sg_id, start_time=start,
+                           end_time=start + sd, shards=shards)
+        self.next_sg_id += 1
+        info.shard_groups.append(g)
+        info.shard_groups.sort(key=lambda x: x.start_time)
+        return g.to_dict()
+
+    def _apply_delete_shard_group(self, cmd):
+        info = self.databases.get(cmd["db"])
+        if info is None:
+            return None
+        for g in info.shard_groups:
+            if g.id == cmd["sg_id"]:
+                g.deleted = True
+        return None
+
+    def _apply_move_pt(self, cmd):
+        """Reassign a partition to a new owner (migration commit —
+        reference migrate_state_machine.go assign/move events)."""
+        for pt in self.pts.get(cmd["db"], []):
+            if pt.pt_id == cmd["pt_id"]:
+                pt.owner = cmd["to_node"]
+                pt.status = cmd.get("status", PT_ONLINE)
+                return True
+        return False
+
+    def _apply_set_pt_status(self, cmd):
+        for pt in self.pts.get(cmd["db"], []):
+            if pt.pt_id == cmd["pt_id"]:
+                pt.status = cmd["status"]
+                return True
+        return False
+
+    # ---------------------------------------------------------- snapshot
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "nodes": [n.to_dict() for n in self.nodes.values()],
+            "databases": [d.to_dict() for d in self.databases.values()],
+            "pts": {db: [p.to_dict() for p in pts]
+                    for db, pts in self.pts.items()},
+            "next_node_id": self.next_node_id,
+            "next_shard_id": self.next_shard_id,
+            "next_sg_id": self.next_sg_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaData":
+        md = cls()
+        md.version = d["version"]
+        md.nodes = {n["id"]: DataNode.from_dict(n) for n in d["nodes"]}
+        md.databases = {x["name"]: DatabaseInfo.from_dict(x)
+                        for x in d["databases"]}
+        md.pts = {db: [PtInfo.from_dict(p) for p in pts]
+                  for db, pts in d["pts"].items()}
+        md.next_node_id = d["next_node_id"]
+        md.next_shard_id = d["next_shard_id"]
+        md.next_sg_id = d["next_sg_id"]
+        return md

@@ -1,0 +1,232 @@
+"""Meta node: raft-replicated catalog service + client library.
+
+Role of the reference's ts-meta store (app/ts-meta/meta/store.go,
+store_fsm.go — FSM applying typed commands to the Data model) and of
+the MetaClient used by sql/store nodes
+(lib/metaclient/meta_client.go:332 — cached Data snapshot, retry loops,
+leader redirects).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import get_logger
+from .meta_data import MetaData
+from .raft import NotLeader, RaftNode
+from .transport import RPCClient, RPCError, RPCServer
+
+log = get_logger(__name__)
+
+
+class MetaServer:
+    """One ts-meta voter: raft node whose FSM is a MetaData, plus the
+    client-facing RPC endpoint (meta.apply / meta.snapshot / meta.ping)."""
+
+    def __init__(self, node_id: str, raft_peers: dict[str, str],
+                 data_dir: str, host: str = "127.0.0.1",
+                 client_port: int = 0, raft_port: int = 0):
+        self.data = MetaData()
+        self._data_lock = threading.RLock()
+        self.raft = RaftNode(
+            node_id, raft_peers, data_dir,
+            fsm_apply=self._fsm_apply,
+            fsm_snapshot=self._fsm_snapshot,
+            fsm_restore=self._fsm_restore,
+            host=host, port=raft_port)
+        self.server = RPCServer(host=host, port=client_port,
+                                name=f"meta-{node_id}", handlers={
+                                    "meta.apply": self._on_apply,
+                                    "meta.snapshot": self._on_snapshot,
+                                    "meta.ping": lambda b: {"ok": True},
+                                })
+        self.addr = self.server.addr
+
+    # FSM hooks (called with raft's lock held — keep them fast)
+    def _fsm_apply(self, cmd):
+        with self._data_lock:
+            return self.data.apply(cmd)
+
+    def _fsm_snapshot(self):
+        with self._data_lock:
+            return self.data.to_dict()
+
+    def _fsm_restore(self, d):
+        with self._data_lock:
+            self.data = MetaData.from_dict(d)
+
+    # client-facing handlers
+    def _on_apply(self, body):
+        try:
+            res = self.raft.propose(body["cmd"])
+            with self._data_lock:
+                ver = self.data.version
+            return {"ok": True, "result": res, "version": ver}
+        except NotLeader as e:
+            return {"ok": False, "redirect": self._leader_client_addr(),
+                    "error": str(e)}
+        except (ValueError, KeyError) as e:
+            # deterministic FSM rejection: retrying elsewhere cannot help
+            return {"ok": False, "fatal": True,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def _leader_client_addr(self) -> str | None:
+        """Map the raft leader's raft addr to its client addr: by
+        convention peers dict values are raft addrs and the client addr
+        is carried in the snapshot exchange; for simplicity the client
+        retries its configured meta addr list on redirect."""
+        return None
+
+    def _on_snapshot(self, body):
+        # read raft state BEFORE taking _data_lock: raft paths acquire
+        # raft._lock → _data_lock (fsm hooks), so taking _data_lock first
+        # and then touching raft would invert the order and deadlock
+        is_leader = self.raft.is_leader
+        with self._data_lock:
+            return {"version": self.data.version,
+                    "data": self.data.to_dict(),
+                    "is_leader": is_leader}
+
+    def start(self):
+        self.raft.start()
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+        self.raft.stop()
+
+
+class MetaClient:
+    """Client to the meta cluster with a cached catalog snapshot.
+
+    Reference: lib/metaclient/meta_client.go:332 — all sql/store nodes
+    hold one; reads hit the local cache, writes go to the raft leader
+    (retrying across configured meta addresses)."""
+
+    def __init__(self, meta_addrs: list[str], refresh_s: float = 1.0):
+        self.addrs = list(meta_addrs)
+        self.refresh_s = refresh_s
+        self._clients = {a: RPCClient(a) for a in self.addrs}
+        self.cache = MetaData()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ plumbing
+
+    def apply(self, cmd: dict, timeout: float = 10.0):
+        """Run a catalog mutation through raft, trying each meta addr
+        until the leader accepts."""
+        last_err: Exception | None = None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for addr in self.addrs:
+                try:
+                    resp = self._clients[addr].call(
+                        "meta.apply", {"cmd": cmd}, timeout=5.0)
+                except RPCError as e:
+                    last_err = e
+                    continue
+                if resp.get("ok"):
+                    self.refresh(min_version=resp.get("version", 0))
+                    return resp.get("result")
+                if resp.get("fatal"):
+                    raise RPCError(resp.get("error", "rejected"))
+                last_err = RPCError(resp.get("error", "not leader"))
+            time.sleep(0.05)
+        raise last_err or RPCError("meta apply failed")
+
+    def refresh(self, min_version: int = 0,
+                timeout: float = 5.0) -> None:
+        """Pull a catalog snapshot at least min_version new, preferring
+        the leader's copy (followers lag one heartbeat behind commit)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            best = None
+            for addr in self.addrs:
+                try:
+                    resp = self._clients[addr].call("meta.snapshot", None,
+                                                    timeout=5.0)
+                except RPCError:
+                    continue
+                if best is None or resp["version"] > best["version"] \
+                        or (resp.get("is_leader")
+                            and resp["version"] >= best["version"]):
+                    best = resp
+                if resp.get("is_leader"):
+                    break
+            if best is not None and best["version"] >= min_version:
+                with self._lock:
+                    if best["version"] >= self.cache.version:
+                        self.cache = MetaData.from_dict(best["data"])
+                return
+            if time.monotonic() >= deadline:
+                return
+            time.sleep(0.05)
+
+    def start_watch(self) -> None:
+        """Poll-refresh the cached snapshot (role of the reference's meta
+        watch/callback channel)."""
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.refresh()
+                except Exception:
+                    pass
+                self._stop.wait(self.refresh_s)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="metaclient-watch")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        for c in self._clients.values():
+            c.close()
+
+    # ------------------------------------------------------- typed ops
+
+    def create_node(self, addr: str) -> int:
+        return self.apply({"op": "create_node", "addr": addr,
+                           "now": time.time_ns()})
+
+    def heartbeat(self, node_id: int) -> None:
+        self.apply({"op": "heartbeat", "node_id": node_id,
+                    "now": time.time_ns()})
+
+    def create_database(self, name: str, num_pts: int | None = None,
+                        replica_n: int = 1,
+                        shard_duration: int | None = None) -> None:
+        cmd = {"op": "create_database", "name": name,
+               "replica_n": replica_n}
+        if num_pts is not None:
+            cmd["num_pts"] = num_pts
+        if shard_duration is not None:
+            cmd["shard_duration"] = shard_duration
+        self.apply(cmd)
+
+    def drop_database(self, name: str) -> None:
+        self.apply({"op": "drop_database", "name": name})
+
+    def create_shard_group(self, db: str, t: int) -> dict:
+        return self.apply({"op": "create_shard_group", "db": db, "t": t})
+
+    def move_pt(self, db: str, pt_id: int, to_node: int) -> None:
+        self.apply({"op": "move_pt", "db": db, "pt_id": pt_id,
+                    "to_node": to_node})
+
+    def set_node_status(self, node_id: int, status: str) -> None:
+        self.apply({"op": "set_node_status", "node_id": node_id,
+                    "status": status})
+
+    # ------------------------------------------------------ cached reads
+
+    def data(self) -> MetaData:
+        with self._lock:
+            return self.cache
+
+    def database(self, name: str):
+        return self.data().db(name)
+
+    def shard_group_for_time(self, db: str, t: int):
+        return self.data().shard_group_for_time(db, t)

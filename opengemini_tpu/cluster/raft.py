@@ -1,0 +1,570 @@
+"""Raft consensus for the meta catalog (CPU-side, never touches devices).
+
+Role of the reference's hashicorp-raft wrapper for ts-meta
+(app/ts-meta/meta/raft_wrapper.go:23, store_fsm.go) — leader election,
+replicated log, FSM apply, snapshots. The survey's guidance (SURVEY §7
+hard parts) is to keep consensus boring and host-side; this is a direct,
+compact Raft:
+
+- randomized election timers, majority voting;
+- one persistent replicator thread per peer (woken on propose /
+  heartbeat tick — no per-tick thread churn);
+- conflict-checked log truncation (same-leader duplicate/reordered
+  appends never erase newer entries);
+- a no-op entry committed at the start of each term so prior-term
+  entries become committable immediately (Raft §5.4.2);
+- persisted term/vote + indexed JSONL log tolerant of a torn tail;
+- snapshot+truncate compaction, InstallSnapshot with staleness guard.
+
+Single-voter configurations commit immediately (the ts-server
+single-node deployment path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+from ..utils import get_logger
+from .transport import RPCClient, RPCError, RPCServer
+
+log = get_logger(__name__)
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+ELECTION_MIN = 0.15
+ELECTION_MAX = 0.30
+HEARTBEAT = 0.05
+SNAPSHOT_EVERY = 4096          # log entries between snapshots
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_hint: str | None):
+        super().__init__(f"not leader (leader={leader_hint})")
+        self.leader_hint = leader_hint
+
+
+class RaftNode:
+    """One raft voter.
+
+    fsm_apply(cmd) -> result     applies a committed command.
+    fsm_snapshot() -> dict       full FSM state.
+    fsm_restore(dict)            load FSM state (on snapshot install).
+    """
+
+    def __init__(self, node_id: str, peers: dict[str, str],
+                 data_dir: str, fsm_apply, fsm_snapshot, fsm_restore,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.id = node_id
+        self.peers = dict(peers)                  # id -> addr, incl self
+        self.dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.fsm_apply = fsm_apply
+        self.fsm_snapshot = fsm_snapshot
+        self.fsm_restore = fsm_restore
+
+        # persistent state
+        self.term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []          # {"idx", "term", "cmd"}
+        self.log_base = 0                  # last snapshot-covered index
+        self.base_term = 0
+        self._load_state()
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = self.log_base
+        self.last_applied = self.log_base
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._apply_results: dict[int, tuple] = {}
+        self._apply_events: dict[int, threading.Event] = {}
+
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._last_heard = time.monotonic()
+        self._clients: dict[str, RPCClient] = {}
+        self._repl_wake: dict[str, threading.Event] = {}
+
+        self.server = RPCServer(host=host, port=port, name=f"raft-{node_id}",
+                                handlers={
+                                    "raft.vote": self._on_request_vote,
+                                    "raft.append": self._on_append_entries,
+                                    "raft.snapshot": self._on_install_snapshot,
+                                })
+        self.addr = self.server.addr
+        if node_id in self.peers and self.peers[node_id] != self.addr:
+            self.peers[node_id] = self.addr
+
+    # ------------------------------------------------------- persistence
+
+    def _state_path(self):
+        return os.path.join(self.dir, "raft_state.json")
+
+    def _log_path(self):
+        return os.path.join(self.dir, "raft_log.jsonl")
+
+    def _snap_path(self):
+        return os.path.join(self.dir, "raft_snapshot.json")
+
+    def _persist_state(self):
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, self._state_path())
+
+    def _append_log_disk(self, entries: list[dict]):
+        with open(self._log_path(), "a") as f:
+            for e in entries:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+
+    def _rewrite_log_disk(self):
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "w") as f:
+            for e in self.log:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        os.replace(tmp, self._log_path())
+
+    def _load_state(self):
+        if os.path.exists(self._state_path()):
+            with open(self._state_path()) as f:
+                st = json.load(f)
+            self.term = st["term"]
+            self.voted_for = st.get("voted_for")
+        if os.path.exists(self._snap_path()):
+            with open(self._snap_path()) as f:
+                snap = json.load(f)
+            self.log_base = snap["last_index"]
+            self.base_term = snap["last_term"]
+            self.fsm_restore(snap["fsm"])
+        if os.path.exists(self._log_path()):
+            entries = []
+            with open(self._log_path()) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entries.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break   # torn tail from a crash mid-append
+            # entries carry explicit indexes: drop anything the snapshot
+            # already covers (crash between snapshot write and log
+            # rewrite leaves the old log file behind) and any duplicate
+            # indexes (keep the later write — it superseded the earlier)
+            by_idx: dict[int, dict] = {}
+            for e in entries:
+                by_idx[e["idx"]] = e
+            idx = self.log_base + 1
+            self.log = []
+            while idx in by_idx:
+                self.log.append(by_idx[idx])
+                idx += 1
+            if len(self.log) != len([i for i in by_idx
+                                     if i > self.log_base]):
+                self._rewrite_log_disk()
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self):
+        self.server.start()
+        for pid in self.peers:
+            if pid != self.id:
+                self._repl_wake[pid] = threading.Event()
+                threading.Thread(target=self._replicator, args=(pid,),
+                                 daemon=True,
+                                 name=f"raft-repl-{self.id}-{pid}").start()
+        threading.Thread(target=self._ticker, daemon=True,
+                         name=f"raft-tick-{self.id}").start()
+
+    def stop(self):
+        self._stop.set()
+        for ev in self._repl_wake.values():
+            ev.set()
+        self.server.stop()
+        for c in self._clients.values():
+            c.close()
+
+    def _client(self, peer_id: str) -> RPCClient:
+        c = self._clients.get(peer_id)
+        if c is None:
+            c = self._clients[peer_id] = RPCClient(
+                self.peers[peer_id], connect_timeout=1.0)
+        return c
+
+    # ------------------------------------------------------ index helpers
+
+    def _last_index(self) -> int:
+        return self.log_base + len(self.log)
+
+    def _term_at(self, idx: int) -> int:
+        if idx == self.log_base:
+            return self.base_term
+        return self.log[idx - self.log_base - 1]["term"]
+
+    def _entries_from(self, idx: int) -> list[dict]:
+        return self.log[idx - self.log_base - 1:]
+
+    # ----------------------------------------------------------- election
+
+    def _ticker(self):
+        while not self._stop.is_set():
+            time.sleep(0.01)
+            with self._lock:
+                state = self.state
+                elapsed = time.monotonic() - self._last_heard
+            if state == LEADER:
+                self._wake_replicators()
+                time.sleep(HEARTBEAT)
+            elif elapsed > random.uniform(ELECTION_MIN, ELECTION_MAX):
+                self._run_election()
+
+    def _wake_replicators(self):
+        for ev in self._repl_wake.values():
+            ev.set()
+
+    def _run_election(self):
+        with self._lock:
+            self.state = CANDIDATE
+            self.term += 1
+            self.voted_for = self.id
+            self._persist_state()
+            term = self.term
+            self._last_heard = time.monotonic()
+            last_idx = self._last_index()
+            last_term = self._term_at(last_idx)
+        votes = {self.id}
+        if len(self.peers) == 1:
+            self._become_leader(term)
+            return
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def ask(pid):
+            try:
+                resp = self._client(pid).call("raft.vote", {
+                    "term": term, "candidate": self.id,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }, timeout=1.0)
+            except RPCError:
+                return
+            with lock:
+                if resp and resp.get("granted"):
+                    votes.add(pid)
+                    if len(votes) * 2 > len(self.peers):
+                        done.set()
+                elif resp and resp.get("term", 0) > term:
+                    with self._lock:
+                        self._step_down(resp["term"])
+                    done.set()
+
+        for pid in self.peers:
+            if pid != self.id:
+                threading.Thread(target=ask, args=(pid,),
+                                 daemon=True).start()
+        done.wait(timeout=ELECTION_MIN)
+        with self._lock:
+            won = (self.state == CANDIDATE and self.term == term
+                   and len(votes) * 2 > len(self.peers))
+        if won:
+            self._become_leader(term)
+
+    def _become_leader(self, term: int):
+        with self._lock:
+            if self.term != term:
+                return
+            if self.state != CANDIDATE and len(self.peers) > 1:
+                return
+            self.state = LEADER
+            self.leader_id = self.id
+            nxt = self._last_index() + 1
+            self.next_index = {p: nxt for p in self.peers if p != self.id}
+            self.match_index = {p: 0 for p in self.peers if p != self.id}
+            log.info("raft %s became leader term=%d", self.id, term)
+            # commit a no-op so prior-term entries become committable
+            # now, not at the next client proposal (Raft §5.4.2)
+            self._append_entry(None)
+            if len(self.peers) == 1:
+                self._advance_commit(self._last_index())
+        self._wake_replicators()
+
+    def _step_down(self, term: int):
+        # caller holds lock
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_state()
+        self.state = FOLLOWER
+        self._last_heard = time.monotonic()
+
+    def _append_entry(self, cmd) -> int:
+        # caller holds lock
+        entry = {"idx": self._last_index() + 1, "term": self.term,
+                 "cmd": cmd}
+        self.log.append(entry)
+        self._append_log_disk([entry])
+        return entry["idx"]
+
+    # ---------------------------------------------------------- handlers
+
+    def _on_request_vote(self, body):
+        with self._lock:
+            if body["term"] > self.term:
+                self._step_down(body["term"])
+            granted = False
+            if body["term"] == self.term and \
+                    self.voted_for in (None, body["candidate"]):
+                my_last = self._last_index()
+                my_term = self._term_at(my_last)
+                up_to_date = (body["last_log_term"], body["last_log_index"]) \
+                    >= (my_term, my_last)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = body["candidate"]
+                    self._persist_state()
+                    self._last_heard = time.monotonic()
+            return {"term": self.term, "granted": granted}
+
+    def _on_append_entries(self, body):
+        with self._lock:
+            if body["term"] < self.term:
+                return {"term": self.term, "success": False}
+            if body["term"] > self.term or self.state != FOLLOWER:
+                self._step_down(body["term"])
+            self.leader_id = body["leader"]
+            self._last_heard = time.monotonic()
+            prev_idx = body["prev_log_index"]
+            if prev_idx > self._last_index() or prev_idx < self.log_base:
+                return {"term": self.term, "success": False,
+                        "hint": min(self._last_index() + 1,
+                                    self.log_base + 1)}
+            if self._term_at(prev_idx) != body["prev_log_term"]:
+                return {"term": self.term, "success": False,
+                        "hint": max(prev_idx, self.log_base + 1)}
+            # append with conflict check: truncate ONLY at a term
+            # mismatch — duplicate/reordered frames from the same leader
+            # must not erase newer entries (Raft §5.3)
+            new = []
+            truncated = False
+            for e in body["entries"]:
+                idx = e["idx"]
+                if idx <= self.log_base:
+                    continue
+                if not new and idx <= self._last_index():
+                    if self._term_at(idx) == e["term"]:
+                        continue         # identical entry already present
+                    self.log = self.log[:idx - self.log_base - 1]
+                    truncated = True
+                    new.append(e)
+                else:
+                    new.append(e)
+            if truncated:
+                self.log.extend(new)
+                self._rewrite_log_disk()
+            elif new:
+                self.log.extend(new)
+                self._append_log_disk(new)
+            if body["leader_commit"] > self.commit_index:
+                self._advance_commit(min(body["leader_commit"],
+                                         self._last_index()))
+            return {"term": self.term, "success": True}
+
+    def _on_install_snapshot(self, body):
+        with self._lock:
+            if body["term"] < self.term:
+                return {"term": self.term}
+            self._step_down(body["term"])
+            self.leader_id = body["leader"]
+            self._last_heard = time.monotonic()
+            snap = body["snapshot"]
+            # staleness guard: never rewind past what we've committed
+            if snap["last_index"] <= self.commit_index:
+                return {"term": self.term}
+            self.fsm_restore(snap["fsm"])
+            self.log = []
+            self.log_base = snap["last_index"]
+            self.base_term = snap["last_term"]
+            self.commit_index = self.log_base
+            self.last_applied = self.log_base
+            tmp = self._snap_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self._snap_path())
+            self._rewrite_log_disk()
+            return {"term": self.term}
+
+    # -------------------------------------------------------- replication
+
+    def _replicator(self, pid: str):
+        """Persistent per-peer replication loop: sleeps until woken by a
+        heartbeat tick or a proposal, then pushes whatever the peer is
+        missing. One in-flight RPC per peer at a time."""
+        ev = self._repl_wake[pid]
+        while not self._stop.is_set():
+            ev.wait(timeout=HEARTBEAT)
+            ev.clear()
+            if self._stop.is_set():
+                return
+            with self._lock:
+                if self.state != LEADER:
+                    continue
+            try:
+                again = True
+                while again and not self._stop.is_set():
+                    again = self._replicate_once(pid)
+            except RPCError:
+                continue
+
+    def _replicate_once(self, pid: str) -> bool:
+        """One append/snapshot exchange. Returns True when the peer still
+        lags (caller loops)."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            term = self.term
+            nxt = self.next_index.get(pid, self._last_index() + 1)
+            if nxt <= self.log_base:
+                body = {"term": term, "leader": self.id,
+                        "snapshot": {"last_index": self.log_base,
+                                     "last_term": self.base_term,
+                                     "fsm": self.fsm_snapshot()}}
+                kind = "raft.snapshot"
+            else:
+                prev = nxt - 1
+                entries = self._entries_from(nxt)
+                body = {"term": term, "leader": self.id,
+                        "prev_log_index": prev,
+                        "prev_log_term": self._term_at(prev),
+                        "entries": entries,
+                        "leader_commit": self.commit_index}
+                kind = "raft.append"
+        resp = self._client(pid).call(kind, body, timeout=5.0)
+        with self._lock:
+            if self.state != LEADER or self.term != term:
+                return False
+            if resp.get("term", 0) > self.term:
+                self._step_down(resp["term"])
+                return False
+            if kind == "raft.snapshot":
+                self.next_index[pid] = self.log_base + 1
+                self.match_index[pid] = self.log_base
+                return self.next_index[pid] <= self._last_index()
+            if resp.get("success"):
+                sent = body["entries"]
+                top = body["prev_log_index"] + len(sent)
+                self.match_index[pid] = max(self.match_index.get(pid, 0),
+                                            top)
+                self.next_index[pid] = self.match_index[pid] + 1
+                self._maybe_commit()
+                return self.next_index[pid] <= self._last_index()
+            self.next_index[pid] = resp.get(
+                "hint", max(nxt - 1, self.log_base + 1))
+            return True
+
+    def _maybe_commit(self):
+        # caller holds lock; commit the highest index replicated on a
+        # majority with an entry from the current term
+        for idx in range(self._last_index(), self.commit_index, -1):
+            if self._term_at(idx) != self.term:
+                break
+            count = 1 + sum(1 for m in self.match_index.values() if m >= idx)
+            if count * 2 > len(self.peers):
+                self._advance_commit(idx)
+                break
+
+    def _advance_commit(self, idx: int):
+        # caller holds lock
+        self.commit_index = idx
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied - self.log_base - 1]
+            if entry["cmd"] is None:                   # term-start no-op
+                outcome = (None, None)
+            else:
+                try:
+                    res = self.fsm_apply(entry["cmd"])
+                    outcome = (res, None)
+                except Exception as e:
+                    outcome = (None, e)
+            ev = self._apply_events.pop(self.last_applied, None)
+            if ev is not None:
+                self._apply_results[self.last_applied] = outcome
+                ev.set()
+        if len(self.log) >= SNAPSHOT_EVERY:
+            self._compact()
+
+    def _compact(self):
+        # caller holds lock; snapshot applied prefix, truncate log.
+        # Crash safety: the snapshot file lands atomically first; if we
+        # die before the log rewrite, _load_state drops covered/duplicate
+        # indexes via the per-entry idx fields.
+        applied_off = self.last_applied - self.log_base
+        if applied_off <= 0:
+            return
+        snap = {"last_index": self.last_applied,
+                "last_term": self._term_at(self.last_applied),
+                "fsm": self.fsm_snapshot()}
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, self._snap_path())
+        self.log = self.log[applied_off:]
+        self.log_base = snap["last_index"]
+        self.base_term = snap["last_term"]
+        self._rewrite_log_disk()
+
+    # -------------------------------------------------------------- API
+
+    def propose(self, cmd: dict, timeout: float = 10.0):
+        """Replicate one command; returns fsm_apply's result once
+        committed. Raises NotLeader with a redirect hint on followers."""
+        with self._lock:
+            if self.state != LEADER:
+                hint = self.peers.get(self.leader_id) \
+                    if self.leader_id else None
+                raise NotLeader(hint)
+            idx = self._append_entry(cmd)
+            ev = threading.Event()
+            self._apply_events[idx] = ev
+            if len(self.peers) == 1:
+                self._advance_commit(idx)
+        if len(self.peers) > 1:
+            self._wake_replicators()
+        if not ev.wait(timeout):
+            with self._lock:
+                self._apply_events.pop(idx, None)
+                # the commit may have raced the timeout: _advance_commit
+                # pops the event, stores the result, THEN sets it — so a
+                # stored result means the command actually applied
+                if idx in self._apply_results:
+                    res, err = self._apply_results.pop(idx)
+                    if err is not None:
+                        raise err
+                    return res
+            raise RPCError("raft commit timeout")
+        with self._lock:
+            res, err = self._apply_results.pop(idx)
+        if err is not None:
+            raise err
+        return res
+
+    def wait_leader(self, timeout: float = 5.0) -> str | None:
+        """Block until some node is leader; returns its id."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.state == LEADER:
+                    return self.id
+                if self.leader_id is not None:
+                    return self.leader_id
+            time.sleep(0.02)
+        return None
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER

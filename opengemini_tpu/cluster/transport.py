@@ -1,0 +1,384 @@
+"""Typed binary RPC transport between node roles.
+
+Role of the reference's spdy multiplexed RPC
+(engine/executor/spdy/multiplexed_connection.go:119,
+multiplexed_session.go) and the netstorage client
+(lib/netstorage/storage.go): many concurrent request/response (and
+streaming-response) exchanges multiplexed over one TCP connection,
+with typed messages.
+
+Wire format (one frame):
+
+    u32 frame_len | u32 header_len | header-json | array buffers...
+
+The header carries {"t": msg_type, "rid": request id, "seq": frame seq,
+"done": last-frame flag, "err": error string, "body": payload}. numpy
+arrays and bytes inside body are swapped for descriptors and shipped as
+raw little-endian buffers after the header (no base64, no pickling) —
+this is the data plane for partial aggregate states, so copies matter.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import uuid
+from queue import Empty, Queue
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+MAX_FRAME = 1 << 30
+
+
+class RPCError(Exception):
+    """Remote handler raised, or transport failed."""
+
+
+# ----------------------------------------------------------------- codec
+
+def _extract(obj, bufs: list):
+    """Replace ndarrays/bytes with descriptors, appending their buffers."""
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("<"))
+        bufs.append(memoryview(a).cast("B"))
+        return {"__nd__": len(bufs) - 1, "d": a.dtype.str, "s": list(a.shape)}
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        bufs.append(memoryview(bytes(obj)))
+        return {"__by__": len(bufs) - 1}
+    if isinstance(obj, dict):
+        return {k: _extract(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_extract(v, bufs) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    return obj
+
+
+def _restore(obj, bufs: list[bytes]):
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            buf = bufs[obj["__nd__"]]
+            return np.frombuffer(buf, dtype=np.dtype(obj["d"])) \
+                     .reshape(obj["s"]).copy()
+        if "__by__" in obj:
+            return bytes(bufs[obj["__by__"]])
+        return {k: _restore(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore(v, bufs) for v in obj]
+    return obj
+
+
+def encode_frame(header: dict, body) -> bytes:
+    bufs: list[memoryview] = []
+    header = dict(header)
+    header["body"] = _extract(body, bufs)
+    header["bl"] = [len(b) for b in bufs]
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    total = 4 + len(hj) + sum(len(b) for b in bufs)
+    out = bytearray(4 + total)
+    struct.pack_into("<II", out, 0, total, len(hj))
+    pos = 8
+    out[pos:pos + len(hj)] = hj
+    pos += len(hj)
+    for b in bufs:
+        out[pos:pos + len(b)] = b
+        pos += len(b)
+    return bytes(out)
+
+
+def decode_frame(payload: bytes) -> dict:
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    header = json.loads(payload[4:4 + hlen].decode())
+    pos = 4 + hlen
+    bufs = []
+    for n in header.get("bl", []):
+        bufs.append(payload[pos:pos + n])
+        pos += n
+    header["body"] = _restore(header.get("body"), bufs)
+    return header
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            raise ConnectionError("connection closed")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket) -> dict:
+    (flen,) = struct.unpack("<I", _read_exact(sock, 4))
+    if flen > MAX_FRAME:
+        raise RPCError(f"frame too large: {flen}")
+    return decode_frame(_read_exact(sock, flen))
+
+
+# ---------------------------------------------------------------- server
+
+class RPCServer:
+    """Threaded RPC server. Handlers: {msg_type: fn(body) -> body | generator}.
+    A generator handler streams frames (seq=0..n, done on last) — the analog
+    of the reference's chunk responser streaming partial results back over
+    spdy (app/ts-store/transport/handler/select.go)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 handlers: dict | None = None, name: str = "rpc"):
+        self.handlers = handlers or {}
+        self.name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, msg_type: str, fn) -> None:
+        self.handlers[msg_type] = fn
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"{self.name}-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name=f"{self.name}-conn", daemon=True)
+            t.start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                frame = read_frame(conn)
+                t = threading.Thread(
+                    target=self._dispatch, args=(conn, wlock, frame),
+                    daemon=True)
+                t.start()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, wlock, frame: dict) -> None:
+        rid = frame.get("rid")
+        mtype = frame.get("t")
+        fn = self.handlers.get(mtype)
+
+        def send(body, seq=0, done=True, err=None):
+            data = encode_frame(
+                {"t": mtype, "rid": rid, "seq": seq, "done": done,
+                 **({"err": err} if err else {})}, body)
+            with wlock:
+                conn.sendall(data)
+
+        if fn is None:
+            send(None, err=f"no handler for {mtype!r}")
+            return
+        try:
+            res = fn(frame.get("body"))
+            if hasattr(res, "__next__"):       # streaming handler
+                seq = 0
+                last = None
+                have = False
+                for item in res:
+                    if have:
+                        send(last, seq=seq, done=False)
+                        seq += 1
+                    last, have = item, True
+                send(last if have else None, seq=seq, done=True)
+            else:
+                send(res)
+        except Exception as e:   # handler errors travel to the caller
+            log.exception("%s handler %s failed", self.name, mtype)
+            try:
+                send(None, err=f"{type(e).__name__}: {e}")
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------- client
+
+class RPCClient:
+    """One multiplexed connection to a peer; thread-safe concurrent calls.
+    Reconnects lazily on failure (the connection-pool role of
+    spdy/multiplexed_session_pool.go is served by reconnect + one shared
+    multiplexed conn per peer)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 5.0):
+        host, port = addr.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.connect_timeout = connect_timeout
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()      # serializes frame writes
+        self._conn_lock = threading.Lock()  # serializes (re)connects —
+        # kept separate so a slow connect never blocks writers on a
+        # healthy socket or stacks callers behind a dead peer's timeout
+        self._pending: dict[str, Queue] = {}
+        self._plock = threading.Lock()
+        self._recv_thread: threading.Thread | None = None
+
+    def _ensure(self) -> socket.socket:
+        s = self._sock
+        if s is not None:
+            return s
+        with self._conn_lock:
+            if self._sock is not None:
+                return self._sock
+            s = socket.create_connection(self.addr,
+                                         timeout=self.connect_timeout)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._recv_thread = threading.Thread(
+                target=self._recv_loop, args=(s,), daemon=True)
+            self._recv_thread.start()
+            self._sock = s
+            return s
+
+    def _recv_loop(self, s: socket.socket) -> None:
+        try:
+            while True:
+                frame = read_frame(s)
+                with self._plock:
+                    entry = self._pending.get(frame.get("rid"))
+                if entry is not None:
+                    entry[1].put(frame)
+        except Exception:
+            # any receiver death (disconnect, oversized/corrupt frame)
+            # must fail this socket's callers and allow reconnect —
+            # a silently dead receiver would wedge the client forever
+            self._fail_pending("connection lost", sock=s)
+
+    def _fail_pending(self, why: str,
+                      sock: socket.socket | None = None) -> None:
+        """Fail calls in flight on `sock` (or all, when closing). Only
+        tears down the current connection if it IS `sock` — a caller
+        holding a stale socket must not kill a healthy reconnect."""
+        with self._conn_lock:
+            if sock is None or self._sock is sock:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+        with self._plock:
+            failed = [(rid, e) for rid, e in self._pending.items()
+                      if sock is None or e[0] is sock]
+            for rid, _ in failed:
+                del self._pending[rid]
+        for _, (_, q) in failed:
+            q.put({"err": why, "done": True, "body": None})
+
+    def call(self, msg_type: str, body=None, timeout: float = 60.0):
+        """Single request/response. Raises RPCError on handler error."""
+        frames = list(self.call_stream(msg_type, body, timeout))
+        return frames[-1] if frames else None
+
+    def call_stream(self, msg_type: str, body=None, timeout: float = 60.0):
+        """Request with streaming response: yields each frame's body."""
+        rid = uuid.uuid4().hex
+        q: Queue = Queue()
+        s = None
+        try:
+            s = self._ensure()
+            with self._plock:
+                self._pending[rid] = (s, q)
+            data = encode_frame({"t": msg_type, "rid": rid}, body)
+            with self._wlock:
+                if self._sock is not s:
+                    raise ConnectionError("connection lost")
+                s.sendall(data)
+            deadline = time.monotonic() + timeout
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RPCError(
+                        f"timeout waiting for {msg_type} from "
+                        f"{self.addr[0]}:{self.addr[1]}")
+                try:
+                    frame = q.get(timeout=min(left, 1.0))
+                except Empty:
+                    continue
+                if frame.get("err"):
+                    raise RPCError(frame["err"])
+                yield frame.get("body")
+                if frame.get("done", True):
+                    return
+        except (ConnectionError, OSError) as e:
+            self._fail_pending(str(e), sock=s)
+            raise RPCError(f"rpc to {self.addr}: {e}") from e
+        finally:
+            with self._plock:
+                self._pending.pop(rid, None)
+
+    def try_call(self, msg_type: str, body=None, timeout: float = 60.0,
+                 retries: int = 2, backoff: float = 0.2):
+        """call() with reconnect retries (transient failures)."""
+        err = None
+        for i in range(retries + 1):
+            try:
+                return self.call(msg_type, body, timeout)
+            except RPCError as e:
+                err = e
+                if i < retries:
+                    time.sleep(backoff * (2 ** i))
+        raise err
+
+    def close(self) -> None:
+        self._fail_pending("client closed")

@@ -16,7 +16,6 @@ import time
 import numpy as np
 
 from ..record import ColVal, DataType, Record, Schema
-from ..storage.tssp import TSSPWriter, TSSPReader
 from ..utils import get_logger
 from .base import Service
 
@@ -79,40 +78,13 @@ class DownsampleService(Service):
                  policy.interval_ns // 10**9)
 
     def _downsample_measurement(self, shard, mst, policy) -> None:
-        from ..storage.compact import iter_merged_series
+        from ..storage.compact import merge_and_swap
         with shard._lock:
             readers = list(shard._files.get(mst, ()))
         if not readers:
             return
-        with shard._lock:
-            shard._file_seq += 1
-            out_path = os.path.join(
-                shard.path, "tssp", f"{mst}_{shard._file_seq:06d}.tssp")
-        w = TSSPWriter(out_path, segment_size=shard.segment_size)
-        wrote = False
-        for sid, rec in iter_merged_series(readers):
-            ds = _downsample_record(rec, policy)
-            if ds.num_rows:
-                w.write_series(sid, ds)
-                wrote = True
-        if wrote:
-            w.finalize()
-            new_reader = TSSPReader(out_path)
-        else:
-            w.abort()
-            new_reader = None
-        drop = {id(r) for r in readers}
-        with shard._lock:
-            # keep any files flushed concurrently since the snapshot
-            current = shard._files.get(mst, [])
-            kept = [r for r in current if id(r) not in drop]
-            shard._files[mst] = (([new_reader] if new_reader else [])
-                                 + kept)
-        for r in readers:
-            try:
-                os.unlink(r.path)
-            except OSError:
-                pass
+        merge_and_swap(shard, mst, readers,
+                       transform=lambda rec: _downsample_record(rec, policy))
 
 
 def _downsample_record(rec: Record, policy) -> Record:

@@ -39,6 +39,74 @@ def iter_merged_series(readers):
             yield sid, rec
 
 
+def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
+    """Merge `readers` (a CONTIGUOUS, oldest→newest slice of the shard's
+    file list for `mst`) into one new TSSP file — optionally rewriting
+    each merged record through `transform(rec)` — then atomically swap it
+    into the file list at the position of the oldest input and unlink the
+    inputs. Shared by compaction and downsampling; the shard's table_lock
+    serializes all such whole-table rewrites so two services can never
+    merge overlapping file sets (one would resurrect data the other
+    replaced).
+
+    Returns the new file's path, or None when the merge produced no rows
+    (inputs are still removed — they contributed nothing).
+    """
+    with shard.table_lock:
+        # re-snapshot under the lock: a concurrent rewrite may have
+        # replaced some of the planned inputs
+        with shard._lock:
+            current = set(id(r) for r in shard._files.get(mst, ()))
+            readers = [r for r in readers if id(r) in current]
+            if not readers:
+                return None
+            shard._file_seq += 1
+            out_path = os.path.join(shard.path, "tssp",
+                                    f"{mst}_{shard._file_seq:06d}.tssp")
+        w = TSSPWriter(out_path, segment_size=shard.segment_size)
+        wrote = False
+        for sid, rec in iter_merged_series(readers):
+            if transform is not None:
+                rec = transform(rec)
+            if rec.num_rows:
+                w.write_series(sid, rec)
+                wrote = True
+        if wrote:
+            w.finalize()
+            new_reader = TSSPReader(out_path)
+        else:
+            w.abort()
+            new_reader = None
+        with shard._lock:
+            files = shard._files.get(mst, [])
+            drop = set(id(r) for r in readers)
+            # swap in at the position of the OLDEST input (the read path
+            # resolves duplicate timestamps by list order, later wins);
+            # files flushed concurrently since the snapshot are kept
+            new_list = []
+            inserted = new_reader is None
+            for r in files:
+                if id(r) in drop:
+                    if not inserted:
+                        new_list.append(new_reader)
+                        inserted = True
+                    continue
+                new_list.append(r)
+            if not inserted:
+                new_list.append(new_reader)
+            shard._files[mst] = new_list
+        # unlink but do NOT close: in-flight queries may still hold these
+        # readers (POSIX keeps the mapped data alive after unlink); the
+        # mmap closes when the last reference drops (TSSPReader.__del__)
+        for r in readers:
+            try:
+                os.unlink(r.path)
+            except OSError as e:
+                log.error("merge_and_swap: failed to remove %s: %s",
+                          r.path, e)
+        return out_path if new_reader is not None else None
+
+
 def file_level(path: str) -> int:
     sz = os.path.getsize(path)
     lvl = 0
@@ -85,48 +153,10 @@ class Compactor:
         """Merge `readers` (a CONTIGUOUS, oldest→newest slice of the
         shard's file list) into one new file; swap it in at the slice's
         position; delete inputs. Returns the new path."""
-        shard = self.shard
-        with shard._lock:
-            shard._file_seq += 1
-            out_path = os.path.join(shard.path, "tssp",
-                                    f"{mst}_{shard._file_seq:06d}.tssp")
-        w = TSSPWriter(out_path, segment_size=shard.segment_size)
-        wrote = False
-        for _sid, rec in iter_merged_series(readers):
-            w.write_series(_sid, rec)
-            wrote = True
-        if not wrote:
-            w.abort()
-            return None
-        w.finalize()
-        new_reader = TSSPReader(out_path)
-        with shard._lock:
-            files = shard._files.get(mst, [])
-            drop = set(id(r) for r in readers)
-            # replace the merged inputs with the output, preserving the
-            # position of the OLDEST input (merge order invariant)
-            new_list = []
-            inserted = False
-            for r in files:
-                if id(r) in drop:
-                    if not inserted:
-                        new_list.append(new_reader)
-                        inserted = True
-                    continue
-                new_list.append(r)
-            if not inserted:
-                new_list.append(new_reader)
-            shard._files[mst] = new_list
-        # unlink but do NOT close: in-flight queries may still hold these
-        # readers (POSIX keeps the mapped data alive after unlink); the
-        # mmap closes when the last reference drops (TSSPReader.__del__)
-        for r in readers:
-            try:
-                os.unlink(r.path)
-            except OSError as e:
-                log.error("compact: failed to remove %s: %s", r.path, e)
-        log.info("compacted %s: %d files -> %s", mst, len(readers),
-                 os.path.basename(out_path))
+        out_path = merge_and_swap(self.shard, mst, readers)
+        if out_path is not None:
+            log.info("compacted %s: %d files -> %s", mst, len(readers),
+                     os.path.basename(out_path))
         return out_path
 
     def run_once(self) -> int:

@@ -50,6 +50,10 @@ class Shard:
         self._files: dict[str, list[TSSPReader]] = {}
         self._file_seq = 0
         self._lock = threading.RLock()
+        # serializes whole-table file rewrites (compaction, downsample):
+        # two concurrent merges over overlapping file sets would each
+        # swap in their own output and resurrect replaced data
+        self.table_lock = threading.Lock()
         # durable measurement→field→type registry: memtable schemas reset at
         # flush, so type stability across flushes must be enforced here
         # (role of the reference's measurement schema in ts-meta)

@@ -1,0 +1,266 @@
+"""Cluster foundation tests: RPC transport, raft consensus, meta catalog.
+
+Modeled on the reference's meta tests driving the raft FSM directly
+(app/ts-meta/meta/store_test.go) plus spdy loopback server tests
+(engine/executor/spdy/rrcserver_test.go).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.cluster import (MetaData, RPCClient, RPCError, RPCServer,
+                                    fnv1a64, series_hash)
+from opengemini_tpu.cluster.meta_store import MetaClient, MetaServer
+from opengemini_tpu.cluster.transport import decode_frame, encode_frame
+
+
+# ------------------------------------------------------------------ codec
+
+def test_frame_codec_roundtrip():
+    body = {"a": 1, "s": "x", "arr": np.arange(5, dtype=np.float64),
+            "nested": [{"b": np.array([True, False])}, b"\x00\x01raw"],
+            "none": None}
+    raw = encode_frame({"t": "m", "rid": "r1"}, body)
+    frame = decode_frame(raw[4:])
+    assert frame["t"] == "m" and frame["rid"] == "r1"
+    out = frame["body"]
+    np.testing.assert_array_equal(out["arr"], body["arr"])
+    np.testing.assert_array_equal(out["nested"][0]["b"],
+                                  np.array([True, False]))
+    assert out["nested"][1] == b"\x00\x01raw"
+    assert out["a"] == 1 and out["s"] == "x" and out["none"] is None
+
+
+def test_hashing_stable():
+    assert fnv1a64(b"hello") == 0xA430D84680AABD0B
+    h1 = series_hash("cpu", {"host": "h1", "region": "eu"})
+    h2 = series_hash("cpu", {"region": "eu", "host": "h1"})
+    assert h1 == h2  # order-independent canonical key
+    assert series_hash("cpu", {"host": "h2"}) != h1
+
+
+# -------------------------------------------------------------------- rpc
+
+@pytest.fixture
+def rpc_server():
+    srv = RPCServer(handlers={
+        "echo": lambda b: b,
+        "double": lambda b: {"v": b["arr"] * 2},
+        "boom": lambda b: 1 / 0,
+        "stream": lambda b: ({"i": i} for i in range(b["n"])),
+    })
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_rpc_echo_and_arrays(rpc_server):
+    cli = RPCClient(rpc_server.addr)
+    assert cli.call("echo", {"x": 7})["x"] == 7
+    arr = np.arange(1000, dtype=np.int64)
+    out = cli.call("double", {"arr": arr})
+    np.testing.assert_array_equal(out["v"], arr * 2)
+    cli.close()
+
+
+def test_rpc_error_propagates(rpc_server):
+    cli = RPCClient(rpc_server.addr)
+    with pytest.raises(RPCError, match="ZeroDivisionError"):
+        cli.call("boom", {})
+    with pytest.raises(RPCError, match="no handler"):
+        cli.call("missing", {})
+    cli.close()
+
+
+def test_rpc_streaming(rpc_server):
+    cli = RPCClient(rpc_server.addr)
+    got = [f["i"] for f in cli.call_stream("stream", {"n": 5})]
+    assert got == [0, 1, 2, 3, 4]
+    cli.close()
+
+
+def test_rpc_concurrent_multiplexed(rpc_server):
+    cli = RPCClient(rpc_server.addr)
+    results = {}
+
+    def worker(i):
+        results[i] = cli.call("echo", {"i": i})["i"]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: i for i in range(16)}
+    cli.close()
+
+
+# ------------------------------------------------------------- meta model
+
+def test_meta_data_routing():
+    md = MetaData()
+    n1 = md.apply({"op": "create_node", "addr": "127.0.0.1:1001"})
+    n2 = md.apply({"op": "create_node", "addr": "127.0.0.1:1002"})
+    assert (n1, n2) == (1, 2)
+    md.apply({"op": "create_database", "name": "db", "num_pts": 4})
+    by_node = md.pts_by_node("db")
+    assert sorted(by_node) == [1, 2]
+    assert sum(len(v) for v in by_node.values()) == 4
+
+    sg = md.apply({"op": "create_shard_group", "db": "db",
+                   "t": 10**15})
+    assert len(sg["shards"]) == 4
+    # idempotent for same time slice
+    sg2 = md.apply({"op": "create_shard_group", "db": "db", "t": 10**15})
+    assert sg2["id"] == sg["id"]
+
+    g = md.shard_group_for_time("db", 10**15)
+    # hash routing is stable mod num shards
+    h = series_hash("cpu", {"host": "h9"})
+    assert g.shard_for(h).id == g.shards[h % 4].id
+
+    # node rejoin with same addr keeps id
+    again = md.apply({"op": "create_node", "addr": "127.0.0.1:1001"})
+    assert again == 1
+
+
+def test_meta_create_database_requires_nodes():
+    md = MetaData()
+    with pytest.raises(ValueError, match="no alive data nodes"):
+        md.apply({"op": "create_database", "name": "db"})
+
+
+def test_meta_data_snapshot_roundtrip():
+    md = MetaData()
+    md.apply({"op": "create_node", "addr": "a:1"})
+    md.apply({"op": "create_database", "name": "db", "num_pts": 2})
+    md.apply({"op": "create_shard_group", "db": "db", "t": 0})
+    md2 = MetaData.from_dict(md.to_dict())
+    assert md2.version == md.version
+    assert md2.db("db").num_pts == 2
+    assert len(md2.shard_groups_overlapping("db", 0, 10**18)) == 1
+
+
+def test_meta_move_pt():
+    md = MetaData()
+    md.apply({"op": "create_node", "addr": "a:1"})
+    md.apply({"op": "create_node", "addr": "a:2"})
+    md.apply({"op": "create_database", "name": "db", "num_pts": 2})
+    owners0 = {p.pt_id: p.owner for p in md.pts["db"]}
+    victim_pt = [pt for pt, owner in owners0.items() if owner == 1][0]
+    md.apply({"op": "move_pt", "db": "db", "pt_id": victim_pt,
+              "to_node": 2})
+    assert md.pt_owner("db", victim_pt).id == 2
+
+
+# ------------------------------------------------------------------- raft
+
+def _mk_meta_cluster(tmp_path, n):
+    """n-voter MetaServer cluster on loopback."""
+    # allocate raft ports first by binding servers lazily: construct
+    # each with port 0, then rewrite peer maps
+    servers = []
+    ids = [f"m{i}" for i in range(n)]
+    # first pass: create raft nodes to learn their ports
+    peers = {}
+    for nid in ids:
+        srv = MetaServer(nid, {nid: "127.0.0.1:0"},
+                         str(tmp_path / nid))
+        peers[nid] = srv.raft.addr
+        servers.append(srv)
+    # second pass: fix up peer maps (before start, single-process test)
+    for srv in servers:
+        srv.raft.peers = dict(peers)
+    for srv in servers:
+        srv.start()
+    return servers
+
+
+def test_raft_single_node_commit(tmp_path):
+    srv = MetaServer("m0", {"m0": "127.0.0.1:0"}, str(tmp_path / "m0"))
+    srv.start()
+    try:
+        assert srv.raft.wait_leader(5.0) == "m0"
+        cli = MetaClient([srv.addr])
+        nid = cli.create_node("127.0.0.1:9999")
+        assert nid == 1
+        cli.create_database("db", num_pts=2)
+        cli.refresh()
+        assert cli.database("db").num_pts == 2
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_raft_three_node_replication(tmp_path):
+    servers = _mk_meta_cluster(tmp_path, 3)
+    try:
+        leader_id = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and leader_id is None:
+            for s in servers:
+                if s.raft.is_leader:
+                    leader_id = s.raft.id
+            time.sleep(0.05)
+        assert leader_id is not None, "no leader elected"
+
+        cli = MetaClient([s.addr for s in servers])
+        cli.create_node("127.0.0.1:7001")
+        cli.create_database("repl", num_pts=3)
+        cli.refresh()
+        assert cli.database("repl") is not None
+
+        # every voter converges on the same state
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all("repl" in s.data.databases for s in servers):
+                break
+            time.sleep(0.05)
+        assert all("repl" in s.data.databases for s in servers)
+        cli.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_raft_leader_failover(tmp_path):
+    servers = _mk_meta_cluster(tmp_path, 3)
+    try:
+        deadline = time.monotonic() + 10
+        leader = None
+        while time.monotonic() < deadline and leader is None:
+            for s in servers:
+                if s.raft.is_leader:
+                    leader = s
+            time.sleep(0.05)
+        assert leader is not None
+
+        cli = MetaClient([s.addr for s in servers])
+        cli.create_node("127.0.0.1:7002")
+        cli.create_database("before", num_pts=1)
+
+        leader.stop()
+        rest = [s for s in servers if s is not leader]
+
+        deadline = time.monotonic() + 10
+        new_leader = None
+        while time.monotonic() < deadline and new_leader is None:
+            for s in rest:
+                if s.raft.is_leader:
+                    new_leader = s
+            time.sleep(0.05)
+        assert new_leader is not None, "no new leader after failover"
+
+        cli2 = MetaClient([s.addr for s in rest])
+        cli2.create_database("after", num_pts=1)
+        cli2.refresh()
+        assert cli2.database("before") is not None
+        assert cli2.database("after") is not None
+        cli.close()
+        cli2.close()
+    finally:
+        for s in servers:
+            s.stop()
