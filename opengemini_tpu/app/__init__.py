@@ -1,0 +1,6 @@
+"""Node-role apps (role of reference app/: ts-meta, ts-store, ts-sql,
+ts-server binaries, app/command.go run scaffolding)."""
+
+from .nodes import TsMeta, TsSql, TsStore, TsServer
+
+__all__ = ["TsMeta", "TsStore", "TsSql", "TsServer"]

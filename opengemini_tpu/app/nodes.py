@@ -1,0 +1,167 @@
+"""Cluster node roles, wired for in-process or standalone deployment.
+
+Reference mapping:
+- TsMeta   → app/ts-meta (raft catalog voter)
+- TsStore  → app/ts-store (engine + RPC service + heartbeats,
+             run/server.go:81)
+- TsSql    → app/ts-sql (HTTP frontend + coordinator,
+             sql/server.go:61-97)
+- TsServer → app/ts-server (all roles one process with the in-proc
+             storage shortcut, main.go:46-57 run.InitStorage — queries
+             bypass RPC and hit the local engine directly)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..cluster.meta_store import MetaClient, MetaServer
+from ..cluster.sql_node import ClusterFacade
+from ..cluster.store_node import StoreNode
+from ..http.server import HttpServer
+from ..storage.engine import Engine, EngineOptions
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+HEARTBEAT_S = 1.0
+
+
+class TsMeta:
+    """One meta voter. For a multi-voter deployment pass the full peer
+    map {node_id: raft_addr}."""
+
+    def __init__(self, node_id: str = "m0",
+                 peers: dict[str, str] | None = None,
+                 data_dir: str = "meta_data",
+                 host: str = "127.0.0.1", client_port: int = 0,
+                 raft_port: int = 0):
+        self.server = MetaServer(node_id,
+                                 peers or {node_id: "127.0.0.1:0"},
+                                 data_dir, host=host,
+                                 client_port=client_port,
+                                 raft_port=raft_port)
+        self.addr = self.server.addr
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+
+class TsStore:
+    """Storage node: engine + RPC service; registers itself with meta and
+    heartbeats (role of serf gossip membership — SURVEY §2.6: heartbeats
+    through the meta raft leader replace the gossip mesh)."""
+
+    def __init__(self, data_dir: str, meta_addrs: list[str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 opts: EngineOptions | None = None,
+                 heartbeat_s: float = HEARTBEAT_S):
+        self.node = StoreNode(data_dir, host=host, port=port, opts=opts)
+        self.meta = MetaClient(meta_addrs)
+        self.heartbeat_s = heartbeat_s
+        self._stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> str:
+        return self.node.addr
+
+    @property
+    def node_id(self) -> int | None:
+        return self.node.node_id
+
+    def start(self):
+        self.node.start()
+        self.node.node_id = self.meta.create_node(self.node.addr)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"store-hb-{self.node.node_id}")
+        self._hb_thread.start()
+        log.info("ts-store node %d @ %s ready", self.node.node_id,
+                 self.node.addr)
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.meta.heartbeat(self.node.node_id)
+            except Exception:
+                pass     # meta unreachable; keep trying
+
+    def stop(self):
+        self._stop.set()
+        self.node.stop()
+        self.meta.close()
+
+
+class TsSql:
+    """Stateless SQL/ingest frontend: HTTP API over the cluster facade."""
+
+    def __init__(self, meta_addrs: list[str], host: str = "127.0.0.1",
+                 http_port: int = 0):
+        self.meta = MetaClient(meta_addrs)
+        self.facade = ClusterFacade(self.meta)
+        self.http = HttpServer(self.facade, host=host, port=http_port,
+                               executor=self.facade.executor)
+
+    @property
+    def http_addr(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    def start(self):
+        self.meta.refresh()
+        self.meta.start_watch()
+        self.http.start()
+        log.info("ts-sql ready at %s", self.http_addr)
+
+    def stop(self):
+        self.http.stop()
+        self.facade.close()
+        self.meta.close()
+
+
+class TsServer:
+    """All-in-one single node: local engine + HTTP, no RPC hop (the
+    reference's localStorageForQuery shortcut). A meta voter still runs
+    so the node can later be joined by others."""
+
+    def __init__(self, data_dir: str, host: str = "127.0.0.1",
+                 http_port: int = 0, opts: EngineOptions | None = None,
+                 with_meta: bool = True):
+        self.engine = Engine(f"{data_dir}/store", opts)
+        self.http = HttpServer(self.engine, host=host, port=http_port)
+        self.ts_meta = (TsMeta(data_dir=f"{data_dir}/meta", host=host)
+                        if with_meta else None)
+        self.meta_client: MetaClient | None = None
+
+    @property
+    def http_addr(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    def start(self):
+        if self.ts_meta is not None:
+            self.ts_meta.start()
+            self.ts_meta.server.raft.wait_leader(10.0)
+            self.meta_client = MetaClient([self.ts_meta.addr])
+        self.http.start()
+        log.info("ts-server ready at %s", self.http_addr)
+
+    def stop(self):
+        self.http.stop()
+        if self.meta_client is not None:
+            self.meta_client.close()
+        if self.ts_meta is not None:
+            self.ts_meta.stop()
+        self.engine.close()
+
+
+def _wait(cond, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")

@@ -21,9 +21,24 @@ def fnv1a64(data: bytes) -> int:
     return h
 
 
+def _mix(h: int) -> int:
+    """splitmix64 finalizer. Raw FNV-1a's low bit is the XOR of all byte
+    low bits — keys differing in paired digits (host=h0,dc=dc0 vs
+    host=h1,dc=dc1) collide mod 2^k, which is exactly how shard routing
+    folds the hash. The avalanche makes every output bit depend on every
+    input bit."""
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & _MASK
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & _MASK
+    h ^= h >> 31
+    return h
+
+
 def series_hash(measurement: str, tags: dict[str, str]) -> int:
-    """Hash of the canonical series key (measurement + sorted tags)."""
+    """Routing hash of the canonical series key (measurement + sorted
+    tags): FNV-1a with an avalanche finalizer."""
     parts = [measurement]
     for k in sorted(tags):
         parts.append(f"{k}={tags[k]}")
-    return fnv1a64(",".join(parts).encode())
+    return _mix(fnv1a64(",".join(parts).encode()))

@@ -1,0 +1,143 @@
+"""PointsWriter: route rows to shards and fan out to store nodes.
+
+Role of the reference's coordinator PointsWriter
+(coordinator/points_writer.go:228 RetryWritePointRows → routeAndMap →
+writeShardMap → writeRowToShard): time → shard group (created on demand
+through meta raft), series hash → shard → partition → owner node; rows
+batch per (node, pt) and ship in parallel with retry-after-refresh on
+node failure.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..storage.rows import PointRow
+from ..utils import get_logger
+from ..utils.errors import GeminiError
+from .hashing import series_hash
+from .meta_store import MetaClient
+from .store_node import rows_to_wire
+from .transport import RPCClient, RPCError
+
+log = get_logger(__name__)
+
+
+class ErrPartialWrite(GeminiError):
+    def __init__(self, written: int, errors: list[str]):
+        super().__init__(
+            f"partial write: {written} written; errors: {'; '.join(errors)}")
+        self.written = written
+
+
+class PointsWriter:
+    def __init__(self, meta: MetaClient, auto_create_db: bool = True,
+                 max_retries: int = 2):
+        self.meta = meta
+        self.auto_create_db = auto_create_db
+        self.max_retries = max_retries
+        self._clients: dict[str, RPCClient] = {}
+        self._clients_lock = threading.Lock()
+
+    def _client(self, addr: str) -> RPCClient:
+        with self._clients_lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = RPCClient(addr)
+            return c
+
+    def close(self) -> None:
+        with self._clients_lock:
+            for c in self._clients.values():
+                c.close()
+
+    # ------------------------------------------------------------- routing
+
+    def _ensure_db(self, db: str):
+        info = self.meta.database(db)
+        if info is None:
+            if not self.auto_create_db:
+                raise GeminiError(f"database not found: {db}")
+            try:
+                self.meta.create_database(db)
+            except RPCError as e:
+                # a concurrent create elsewhere shows up as the db
+                # appearing on refresh; anything else is the root cause
+                self.meta.refresh()
+                if self.meta.database(db) is None:
+                    raise GeminiError(
+                        f"cannot create database {db}: {e}") from e
+            info = self.meta.database(db)
+            if info is None:
+                raise GeminiError(f"cannot create database: {db}")
+        return info
+
+    def _route(self, db: str, rows: list[PointRow]):
+        """rows → {(node_addr, pt_id): [rows]}; creates shard groups on
+        demand (points_writer.go:622 updateShardGroupAndShardKey)."""
+        md = self.meta.data()
+        info = md.db(db)
+        batches: dict[tuple[str, int], list[PointRow]] = {}
+        sg_cache: dict[int, object] = {}
+        for r in rows:
+            slot = r.time // info.shard_duration
+            sg = sg_cache.get(slot)
+            if sg is None:
+                sg = md.shard_group_for_time(db, r.time)
+                if sg is None:
+                    self.meta.create_shard_group(db, r.time)
+                    md = self.meta.data()
+                    info = md.db(db)
+                    sg = md.shard_group_for_time(db, r.time)
+                    if sg is None:
+                        raise GeminiError("failed to create shard group")
+                sg_cache[slot] = sg
+            shard = sg.shard_for(series_hash(r.measurement, r.tags))
+            owner = md.pt_owner(db, shard.pt_id)
+            if owner is None:
+                raise GeminiError(
+                    f"no owner node for {db} pt {shard.pt_id}")
+            batches.setdefault((owner.addr, shard.pt_id), []).append(r)
+        return batches
+
+    # -------------------------------------------------------------- write
+
+    def write_points(self, db: str, rows: list[PointRow]) -> int:
+        if not rows:
+            return 0
+        self._ensure_db(db)
+        batches = self._route(db, rows)
+        written = 0
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def send(addr: str, pt: int, batch: list[PointRow]):
+            nonlocal written
+            wire = {"db": db, "pt": pt, "rows": rows_to_wire(batch)}
+            last: Exception | None = None
+            for attempt in range(self.max_retries + 1):
+                try:
+                    resp = self._client(addr).call("store.write_rows", wire)
+                    with lock:
+                        written += resp["written"]
+                    return
+                except RPCError as e:
+                    last = e
+                    # partition may have moved: re-resolve the owner
+                    self.meta.refresh()
+                    md = self.meta.data()
+                    owner = md.pt_owner(db, pt)
+                    if owner is not None and owner.addr != addr:
+                        addr = owner.addr
+            with lock:
+                errors.append(f"pt {pt} @ {addr}: {last}")
+
+        threads = [threading.Thread(target=send, args=(a, p, b))
+                   for (a, p), b in batches.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise ErrPartialWrite(written, errors)
+        return written

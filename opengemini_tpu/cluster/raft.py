@@ -338,10 +338,12 @@ class RaftNode:
             self.leader_id = body["leader"]
             self._last_heard = time.monotonic()
             prev_idx = body["prev_log_index"]
-            if prev_idx > self._last_index() or prev_idx < self.log_base:
+            if prev_idx > self._last_index():
                 return {"term": self.term, "success": False,
-                        "hint": min(self._last_index() + 1,
-                                    self.log_base + 1)}
+                        "hint": self._last_index() + 1}
+            if prev_idx < self.log_base:
+                return {"term": self.term, "success": False,
+                        "hint": self.log_base + 1}
             if self._term_at(prev_idx) != body["prev_log_term"]:
                 return {"term": self.term, "success": False,
                         "hint": max(prev_idx, self.log_base + 1)}

@@ -1,0 +1,261 @@
+"""SQL node: cluster-aware query execution (scatter/gather).
+
+Role of the reference's sql-side coordinator: ClusterShardMapper
+(coordinator/shard_mapper.go:60 — sources + time range → per-node
+shard/pt sets), RemoteQuery fan-out (rpc_client.go), and the sql-side
+final transforms (HashMerge + fill/order/limit).
+
+ClusterExecutor speaks the same `execute(stmt, db) -> result dict`
+surface as the single-node QueryExecutor, so the HTTP layer works
+unchanged on top of either. ClusterFacade bundles it with a
+PointsWriter to present the Engine-ish write surface.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+
+from ..query.ast import (CreateDatabaseStatement, DropDatabaseStatement,
+                         SelectStatement, ShowStatement)
+from ..query.condition import MAX_TIME, MIN_TIME, analyze_condition
+from ..query.executor import AggItem, _classify_fields, finalize_partials
+from ..query.influxql import format_statement
+from ..utils import get_logger
+from ..utils.errors import ErrQueryError, GeminiError
+from .meta_store import MetaClient
+from .points_writer import PointsWriter
+from .transport import RPCClient, RPCError
+
+log = get_logger(__name__)
+
+
+class ClusterExecutor:
+    def __init__(self, meta: MetaClient):
+        self.meta = meta
+        self._clients: dict[str, RPCClient] = {}
+        self._lock = threading.Lock()
+
+    def _client(self, addr: str) -> RPCClient:
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = RPCClient(addr)
+            return c
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+
+    # ------------------------------------------------------------- mapping
+
+    def map_pts(self, db: str) -> dict[str, list[int]]:
+        """node addr → owned partition ids (shard_mapper.go:415 read
+        distribution: one owner per pt)."""
+        md = self.meta.data()
+        if md.db(db) is None:
+            self.meta.refresh()
+            md = self.meta.data()
+        info = md.db(db)
+        if info is None:
+            raise ErrQueryError(f"database not found: {db}")
+        out: dict[str, list[int]] = {}
+        for node_id, pts in md.pts_by_node(db).items():
+            node = md.nodes.get(node_id)
+            if node is None:
+                raise ErrQueryError(f"pt owner node {node_id} unknown")
+            out.setdefault(node.addr, []).extend(p.pt_id for p in pts)
+        return out
+
+    def _scatter(self, msg: str, db: str, body_extra: dict,
+                 timeout: float = 120.0) -> list:
+        """Send one request per store node owning pts of db; gather."""
+        per_node = self.map_pts(db)
+        results: list = [None] * len(per_node)
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def run(i: int, addr: str, pts: list[int]):
+            try:
+                body = {"db": db, "pts": pts, **body_extra}
+                results[i] = self._client(addr).call(msg, body,
+                                                     timeout=timeout)
+            except RPCError as e:
+                with lock:
+                    errors.append(f"{addr}: {e}")
+
+        threads = [threading.Thread(target=run, args=(i, a, p))
+                   for i, (a, p) in enumerate(per_node.items())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise ErrQueryError("; ".join(errors))
+        return [r for r in results if r is not None]
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, stmt, db: str | None = None) -> dict:
+        try:
+            if isinstance(stmt, SelectStatement):
+                return self._select(stmt, stmt.from_db or db)
+            if isinstance(stmt, ShowStatement):
+                return self._show(stmt, stmt.on_db or db)
+            if isinstance(stmt, CreateDatabaseStatement):
+                self.meta.create_database(stmt.name)
+                return {}
+            if isinstance(stmt, DropDatabaseStatement):
+                return self._drop_database(stmt.name)
+            return {"error":
+                    f"unsupported statement {type(stmt).__name__}"}
+        except (ErrQueryError, GeminiError, RPCError) as e:
+            return {"error": str(e)}
+
+    def _select(self, stmt: SelectStatement, db: str | None) -> dict:
+        if db is None:
+            return {"error": "database required"}
+        if stmt.from_subquery is not None:
+            return {"error": "subqueries not implemented yet"}
+        mst = stmt.from_measurement
+        aggs, raw_fields, has_wildcard = _classify_fields(stmt)
+        if aggs and raw_fields:
+            return {"error": "mixing aggregate and non-aggregate queries "
+                             "is not supported"}
+        q = format_statement(stmt)
+        if aggs:
+            resps = self._scatter("store.select_partial", db, {"q": q})
+            partials = [r["partial"] for r in resps]
+            return finalize_partials(stmt, mst, aggs, partials)
+        resps = self._scatter("store.select_raw", db, {"q": q})
+        return self._merge_raw(stmt, resps)
+
+    def _merge_raw(self, stmt: SelectStatement, resps: list) -> dict:
+        """Merge raw-select series lists from stores: group by (name,
+        tags), align columns (SELECT * may see different field sets per
+        partition), concatenate + time-sort rows, apply limits
+        globally."""
+        groups: dict[tuple, dict] = {}
+        for resp in resps:
+            for series_list in resp["series_lists"]:
+                for s in series_list:
+                    key = (s["name"],
+                           tuple(sorted((s.get("tags") or {}).items())))
+                    g = groups.get(key)
+                    if g is None:
+                        groups[key] = {"name": s["name"],
+                                       "tags": s.get("tags"),
+                                       "columns": list(s["columns"]),
+                                       "values": list(s["values"])}
+                        continue
+                    if s["columns"] == g["columns"]:
+                        g["values"].extend(s["values"])
+                        continue
+                    # column sets differ: widen to the union (sorted
+                    # after 'time', matching the wildcard field order)
+                    union = [g["columns"][0]] + sorted(
+                        set(g["columns"][1:]) | set(s["columns"][1:]))
+                    if union != g["columns"]:
+                        remap = [g["columns"].index(c)
+                                 if c in g["columns"] else None
+                                 for c in union]
+                        g["values"] = [
+                            [None if j is None else row[j] for j in remap]
+                            for row in g["values"]]
+                        g["columns"] = union
+                    remap = [s["columns"].index(c)
+                             if c in s["columns"] else None for c in union]
+                    g["values"].extend(
+                        [None if j is None else row[j] for j in remap]
+                        for row in s["values"])
+        series_out = []
+        for key in sorted(groups, key=lambda k: (k[0], k[1])):
+            g = groups[key]
+            rows = sorted(g["values"], key=lambda r: r[0],
+                          reverse=stmt.order_desc)
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[:stmt.limit]
+            if not rows:
+                continue
+            entry = {"name": g["name"], "columns": g["columns"],
+                     "values": rows}
+            if g["tags"]:
+                entry["tags"] = g["tags"]
+            series_out.append(entry)
+        if stmt.soffset:
+            series_out = series_out[stmt.soffset:]
+        if stmt.slimit:
+            series_out = series_out[:stmt.slimit]
+        return {"series": series_out} if series_out else {}
+
+    def _show(self, stmt: ShowStatement, db: str | None) -> dict:
+        if stmt.what == "databases":
+            names = sorted(self.meta.data().databases)
+            return {"series": [{"name": "databases", "columns": ["name"],
+                                "values": [[n] for n in names]}]}
+        if db is None or self.meta.database(db) is None:
+            self.meta.refresh()
+            if self.meta.database(db) is None:
+                return {"error": f"database not found: {db}"}
+        # ship without LIMIT/OFFSET — they apply once, after the union
+        q = format_statement(replace(stmt, limit=0, offset=0))
+        resps = self._scatter("store.show", db, {"q": q})
+        # union values per series name across stores
+        merged: dict[str, dict] = {}
+        for resp in resps:
+            for series_list in resp["series_lists"]:
+                for s in series_list:
+                    g = merged.get(s["name"])
+                    if g is None:
+                        merged[s["name"]] = {"columns": s["columns"],
+                                             "values": set(
+                                                 tuple(v) for v in
+                                                 s["values"])}
+                    else:
+                        g["values"].update(tuple(v) for v in s["values"])
+        series_out = [{"name": name, "columns": m["columns"],
+                       "values": [list(v) for v in sorted(m["values"])]}
+                      for name, m in sorted(merged.items())]
+        lo = stmt.offset
+        hi = lo + stmt.limit if stmt.limit else None
+        for s in series_out:
+            s["values"] = s["values"][lo:hi]
+        return {"series": series_out} if series_out else {}
+
+    def _drop_database(self, name: str) -> dict:
+        try:
+            self._scatter("store.drop_db", name, {})
+        except ErrQueryError:
+            pass                      # db may not exist on some stores
+        self.meta.drop_database(name)
+        return {}
+
+
+class ClusterFacade:
+    """Engine-shaped adapter for the HTTP layer in cluster mode: writes
+    route through PointsWriter, `databases` reads the meta cache."""
+
+    def __init__(self, meta: MetaClient):
+        self.meta = meta
+        self.writer = PointsWriter(meta)
+        self.executor = ClusterExecutor(meta)
+
+    @property
+    def databases(self):
+        return self.meta.data().databases
+
+    def write_points(self, db: str, rows, create_db: bool = True) -> int:
+        return self.writer.write_points(db, rows)
+
+    def create_database(self, name: str) -> None:
+        self.meta.create_database(name)
+
+    def drop_database(self, name: str) -> None:
+        self.executor._drop_database(name)
+
+    def close(self) -> None:
+        self.writer.close()
+        self.executor.close()

@@ -1,0 +1,181 @@
+"""Store node service: RPC handlers over a local storage Engine.
+
+Role of the reference's ts-store transport servers
+(app/ts-store/transport/server_insert.go:34 — InsertProcessor writes,
+app/ts-store/transport/server_select.go:52 — SelectProcessor queries,
+handler/select.go:129 executing the pushed-down sub-plan per shard).
+
+Partitions: each (database, pt) the node owns maps to one engine
+database named ``db@pt`` — partition data stays physically separate so
+a partition can be migrated wholesale (reference DBPTInfo,
+engine/partition.go).
+
+Query handlers return *partial aggregate states*
+(QueryExecutor.partial_agg wire format) — the sql node merges them, so
+the heavy reduction runs here, on-device, next to the data.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace
+
+from ..query.ast import SelectStatement, ShowStatement
+from ..query.condition import analyze_condition
+from ..query.executor import (QueryExecutor, _classify_fields,
+                              merge_partials)
+from ..query.influxql import parse_query
+from ..storage.engine import Engine, EngineOptions
+from ..storage.rows import PointRow
+from ..utils import get_logger
+from .transport import RPCServer
+
+log = get_logger(__name__)
+
+
+def db_key(db: str, pt: int) -> str:
+    """Engine-database name for one partition of a logical database."""
+    return f"{db}@{pt}"
+
+
+def rows_to_wire(rows: list[PointRow]) -> list:
+    return [[r.measurement, r.tags, r.fields, r.time] for r in rows]
+
+
+def rows_from_wire(wire: list) -> list[PointRow]:
+    return [PointRow(m, t, f, tm) for m, t, f, tm in wire]
+
+
+class StoreNode:
+    """One ts-store: engine + RPC service. Registration/heartbeat to the
+    meta cluster is handled by the app wrapper (app/nodes.py)."""
+
+    def __init__(self, data_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, opts: EngineOptions | None = None):
+        self.engine = Engine(data_dir, opts)
+        self.executor = QueryExecutor(self.engine)
+        self.node_id: int | None = None          # set after registration
+        self.server = RPCServer(host=host, port=port, name="store",
+                                handlers={
+                                    "store.ping": self._on_ping,
+                                    "store.write_rows": self._on_write,
+                                    "store.select_partial": self._on_select_partial,
+                                    "store.select_raw": self._on_select_raw,
+                                    "store.show": self._on_show,
+                                    "store.drop_db": self._on_drop_db,
+                                    "store.measurements": self._on_measurements,
+                                })
+        self.addr = self.server.addr
+        self._write_lock = threading.Lock()
+        self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.engine.close()
+
+    # ------------------------------------------------------------ handlers
+
+    def _on_ping(self, body):
+        return {"ok": True, "node_id": self.node_id,
+                "now": time.time_ns()}
+
+    def _on_write(self, body):
+        rows = rows_from_wire(body["rows"])
+        n = self.engine.write_points(db_key(body["db"], body["pt"]), rows)
+        self.stats["writes"] += 1
+        self.stats["rows_written"] += n
+        return {"written": n}
+
+    def _parse_select(self, q: str) -> SelectStatement:
+        stmts = parse_query(q)
+        if len(stmts) != 1 or not isinstance(stmts[0], SelectStatement):
+            raise ValueError("store.select expects one SELECT statement")
+        # the partition key (db@pt) is authoritative here — a db
+        # qualifier inside the statement must not override it
+        return replace(stmts[0], from_db=None, from_rp=None)
+
+    def _on_select_partial(self, body):
+        """Partial aggregation over this node's partitions of a db; the
+        per-pt partials merge locally first (intra-node exchange) so one
+        state grid travels back."""
+        stmt = self._parse_select(body["q"])
+        db, pts = body["db"], body["pts"]
+        mst = stmt.from_measurement
+        aggs, _raw, _wild = _classify_fields(stmt)
+        self.stats["selects"] += 1
+        partials = []
+        for pt in pts:
+            dbk = db_key(db, pt)
+            if dbk not in self.engine.databases:
+                continue
+            tag_keys = {k for s in self.engine.database(dbk).all_shards()
+                        for k in s.index.tag_keys(mst)}
+            cond = analyze_condition(stmt.condition, tag_keys)
+            p = self.executor.partial_agg(stmt, dbk, mst, aggs, cond,
+                                          tag_keys)
+            if p is not None:
+                partials.append(p)
+        return {"partial": merge_partials(partials)}
+
+    def _on_select_raw(self, body):
+        """Raw rows for non-aggregate selects. Row limits are applied at
+        the sql node after the global merge (a series group may span
+        partitions only when there is no GROUP BY) — but are pushed down
+        as a per-store cap when there is no OFFSET (reference
+        LimitPushdown rules, heu_rule.go)."""
+        stmt = self._parse_select(body["q"])
+        db, pts = body["db"], body["pts"]
+        self.stats["selects"] += 1
+        pushdown_limit = 0
+        if stmt.limit and not stmt.offset:
+            pushdown_limit = stmt.limit
+        sub = replace(stmt, limit=pushdown_limit, offset=0,
+                      slimit=0, soffset=0)
+        results = []
+        for pt in pts:
+            dbk = db_key(db, pt)
+            if dbk not in self.engine.databases:
+                continue
+            res = self.executor.execute(sub, dbk)
+            if "error" in res:
+                raise ValueError(res["error"])
+            if res.get("series"):
+                results.append(res["series"])
+        return {"series_lists": results}
+
+    def _on_show(self, body):
+        """SHOW fan-out: run against each owned partition, sql unions."""
+        stmts = parse_query(body["q"])
+        if len(stmts) != 1 or not isinstance(stmts[0], ShowStatement):
+            raise ValueError("store.show expects one SHOW statement")
+        stmt = replace(stmts[0], on_db=None)
+        out = []
+        for pt in body["pts"]:
+            dbk = db_key(body["db"], pt)
+            if dbk not in self.engine.databases:
+                continue
+            res = self.executor.execute(stmt, dbk)
+            if "error" in res:
+                raise ValueError(res["error"])
+            if res.get("series"):
+                out.append(res["series"])
+        return {"series_lists": out}
+
+    def _on_measurements(self, body):
+        out: set[str] = set()
+        for pt in body["pts"]:
+            dbk = db_key(body["db"], pt)
+            if dbk in self.engine.databases:
+                out.update(self.engine.measurements(dbk))
+        return {"measurements": sorted(out)}
+
+    def _on_drop_db(self, body):
+        db = body["db"]
+        for name in [n for n in self.engine.databases
+                     if n == db or n.startswith(db + "@")]:
+            self.engine.drop_database(name)
+        return {"ok": True}

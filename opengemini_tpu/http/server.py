@@ -37,11 +37,16 @@ log = get_logger(__name__)
 
 class HttpServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8086,
-                 prom_db: str = "prometheus"):
+                 prom_db: str = "prometheus", executor=None):
+        """`engine` needs write_points(); queries go through `executor`
+        (defaults to the single-node QueryExecutor; the cluster sql node
+        passes a ClusterExecutor). Prom endpoints need a local scanning
+        engine and disable themselves on a cluster facade."""
         from ..promql import PromEngine
         self.engine = engine
-        self.executor = QueryExecutor(engine)
-        self.prom = PromEngine(engine, prom_db)
+        self.executor = executor or QueryExecutor(engine)
+        self.prom = (PromEngine(engine, prom_db)
+                     if hasattr(engine, "scan_series") else None)
         self.prom_db = prom_db
         self.host = host
         self.port = port
@@ -150,6 +155,10 @@ class HttpServer:
         def err(code, etype, msg):
             return code, {"status": "error", "errorType": etype,
                           "error": msg}
+
+        if self.prom is None:
+            return err(501, "unavailable",
+                       "prom endpoints need a local storage engine")
 
         is_query = path in ("/api/v1/query", "/api/v1/query_range")
         if is_query:

@@ -200,6 +200,21 @@ class QueryExecutor:
 
     def _select_agg(self, stmt, db, mst, aggs: list[AggItem], cond,
                     tag_keys) -> dict:
+        partial = self.partial_agg(stmt, db, mst, aggs, cond, tag_keys)
+        return finalize_partials(stmt, mst, aggs, [partial])
+
+    def partial_agg(self, stmt, db, mst, aggs: list[AggItem], cond,
+                    tag_keys) -> dict | None:
+        """Store-side partial aggregation: scan this engine's shards and
+        reduce on device into per-(group, window) mergeable states.
+
+        This is the pushed-down partial-agg stage of the reference's
+        distributed plan (AggPushdownToReaderRule engine/executor/
+        heu_rule.go:346 executing inside ts-store); the returned dict is
+        the wire format the sql node merges with finalize_partials (the
+        exchange/HashMerge stage). All values are numpy/JSON — the RPC
+        codec ships them zero-copy.
+        """
         from ..ops import AggSpec, segment_aggregate, window_ids, pad_bucket
         from ..ops.segment_agg import pad_rows
 
@@ -228,7 +243,7 @@ class QueryExecutor:
             per_shard.append((s, pairs))
         G = len(global_groups)
         if G == 0:
-            return {}
+            return None
 
         # gather: flat arrays per needed field + times + group ids
         t_lo = None if not cond.has_time_range else t_min
@@ -251,7 +266,7 @@ class QueryExecutor:
                 data_tmax = max(data_tmax, rec.max_time)
                 chunks.append({"rec": rec, "gi": gi})
         if not chunks:
-            return {}
+            return None
 
         # window layout
         if interval:
@@ -322,90 +337,29 @@ class QueryExecutor:
                                     sorted_ids=seg_sorted)
             field_results[fname] = res
             field_types[fname] = ftype
-        # materialize output columns per agg item: (G, W) float arrays
-        out_cols: list[np.ndarray] = []
-        for a in aggs:
-            res = field_results[a.field]
-            arr = _finalize_agg(a.func, res, num_segments)
-            out_cols.append(np.asarray(arr).reshape(G, W))
-        # any data in window (across agg fields) → emit row
-        anyc = np.zeros((G, W), dtype=np.int64)
-        for a in aggs:
-            c = field_results[a.field].count
-            if c is not None:
-                anyc += np.asarray(c).reshape(G, W)
-            else:
-                anyc += 1
 
-        # build series in sorted tag order (deterministic, matches raw path)
         group_keys = [None] * G
         for key, gi in global_groups.items():
             group_keys[gi] = key
-        win_times = start + interval * np.arange(W) if interval else \
-            np.array([start], dtype=np.int64)
-
-        series_out = []
-        order = sorted(range(G), key=lambda gi: group_keys[gi])
-        for gi in order:
-            tags = dict(zip(group_tags, group_keys[gi]))
-            rows = []
-            prev = [None] * len(aggs)
-            for wi in range(W):
-                has = anyc[gi, wi] > 0
-                if not has:
-                    if not interval or stmt.fill_option == "none":
-                        continue
-                    if stmt.fill_option == "null":
-                        row = [int(win_times[wi])] + [None] * len(aggs)
-                        rows.append(row)
-                        continue
-                    if stmt.fill_option == "value":
-                        rows.append([int(win_times[wi])]
-                                    + [stmt.fill_value] * len(aggs))
-                        continue
-                    if stmt.fill_option == "previous":
-                        rows.append([int(win_times[wi])] + list(prev))
-                        continue
-                    continue
-                row = [int(win_times[wi])]
-                for ai, a in enumerate(aggs):
-                    v = out_cols[ai][gi, wi]
-                    cnt = np.asarray(
-                        field_results[a.field].count).reshape(G, W)[gi, wi]
-                    if cnt == 0:
-                        row.append(None)
-                        continue
-                    v = float(v)
-                    if a.func == "count":
-                        v = int(v)
-                    elif (field_types[a.field] == DataType.INTEGER
-                          and a.func in ("sum", "min", "max", "first",
-                                         "last", "spread")):
-                        v = int(v)
-                    row.append(v)
-                    prev[ai] = row[-1]
-                rows.append(row)
-            if not rows:
-                continue
-            if stmt.order_desc:
-                rows.reverse()
-            if stmt.offset:
-                rows = rows[stmt.offset:]
-            if stmt.limit:
-                rows = rows[:stmt.limit]
-            if not rows:
-                continue
-            entry = {"name": mst,
-                     "columns": ["time"] + [a.output for a in aggs],
-                     "values": rows}
-            if group_tags:
-                entry["tags"] = tags
-            series_out.append(entry)
-        if stmt.soffset:
-            series_out = series_out[stmt.soffset:]
-        if stmt.slimit:
-            series_out = series_out[:stmt.slimit]
-        return {"series": series_out} if series_out else {}
+        fields_out: dict[str, dict] = {}
+        for fname, res in field_results.items():
+            st: dict[str, np.ndarray] = {}
+            for k in ("count", "sum", "min", "max", "first", "last",
+                      "first_time", "last_time"):
+                v = getattr(res, k)
+                if v is not None:
+                    st[k] = np.asarray(v).reshape(G, W)
+            fields_out[fname] = st
+        return {
+            "group_tags": group_tags,
+            "group_keys": [list(k) for k in group_keys],
+            "interval": interval or 0,
+            "start": int(start),
+            "W": W,
+            "fields": fields_out,
+            "field_types": {f: _ftype_name(t)
+                            for f, t in field_types.items()},
+        }
 
     # ---- raw path --------------------------------------------------------
 
@@ -486,6 +440,203 @@ class QueryExecutor:
         return {"series": series_out} if series_out else {}
 
 
+# ---------------------------------------------------- partial-agg merge
+
+_I64MAX = np.iinfo(np.int64).max
+_I64MIN = np.iinfo(np.int64).min
+
+# identity elements per state key (for merge targets)
+_IDENT = {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf,
+          "first": np.nan, "last": np.nan,
+          "first_time": _I64MAX, "last_time": _I64MIN}
+
+
+def merge_partials(partials: list[dict | None]) -> dict | None:
+    """Merge partial aggregate states from several stores/partitions into
+    one global (G, W) state grid — the exchange-merge of the reference's
+    distributed plan (HashMerge/agg Merge() at the sql node,
+    engine/series_agg_reducer.gen.go). Groups align by tag-value key,
+    windows by absolute time (every store's grid is congruent mod
+    interval, so offsets are exact)."""
+    partials = [p for p in partials if p]
+    if not partials:
+        return None
+    if len(partials) == 1:
+        return partials[0]
+    interval = partials[0]["interval"]
+    # GROUP BY * resolves tag keys per store, so the tag universes can
+    # differ — align every partial's keys to the union (missing → "",
+    # matching how the single-node tagset grouping fills absent tags)
+    group_tags = sorted(set().union(*[p["group_tags"] for p in partials]))
+    key_to_gi: dict[tuple, int] = {}
+    aligned_keys: list[list[tuple]] = []
+    for p in partials:
+        pk = []
+        if list(p["group_tags"]) == group_tags:
+            pk = [tuple(k) for k in p["group_keys"]]
+        else:
+            pos = {t: i for i, t in enumerate(p["group_tags"])}
+            for k in p["group_keys"]:
+                pk.append(tuple(k[pos[t]] if t in pos else ""
+                                for t in group_tags))
+        aligned_keys.append(pk)
+        for k in pk:
+            key_to_gi.setdefault(k, len(key_to_gi))
+    G = len(key_to_gi)
+    start = min(p["start"] for p in partials)
+    if interval:
+        end = max(p["start"] + p["W"] * interval for p in partials)
+        W = int((end - start) // interval)
+    else:
+        W = 1
+
+    fnames = sorted(set().union(*[p["fields"].keys() for p in partials]))
+    merged_fields: dict[str, dict] = {}
+    field_types: dict[str, str] = {}
+    for fname in fnames:
+        keys = sorted(set().union(*[p["fields"][fname].keys()
+                                    for p in partials if fname in p["fields"]]))
+        tgt = {}
+        for k in keys:
+            dt = np.int64 if k in ("count", "first_time", "last_time") \
+                else np.float64
+            tgt[k] = np.full((G, W), _IDENT[k], dtype=dt)
+        for pi, p in enumerate(partials):
+            st = p["fields"].get(fname)
+            if st is None:
+                continue
+            rows = np.array([key_to_gi[k] for k in aligned_keys[pi]],
+                            dtype=np.int64)
+            off = int((p["start"] - start) // interval) if interval else 0
+            cols = np.arange(off, off + p["W"])
+            ix = np.ix_(rows, cols)
+            if "count" in tgt and "count" in st:
+                tgt["count"][ix] += st["count"]
+            if "sum" in tgt and "sum" in st:
+                tgt["sum"][ix] += st["sum"]
+            if "min" in tgt and "min" in st:
+                tgt["min"][ix] = np.minimum(tgt["min"][ix], st["min"])
+            if "max" in tgt and "max" in st:
+                tgt["max"][ix] = np.maximum(tgt["max"][ix], st["max"])
+            if "first" in tgt and "first" in st:
+                b_has = ~np.isnan(st["first"])
+                bt = np.where(b_has, st["first_time"], _I64MAX)
+                take_b = b_has & (bt < tgt["first_time"][ix])
+                tgt["first"][ix] = np.where(take_b, st["first"],
+                                            tgt["first"][ix])
+                tgt["first_time"][ix] = np.where(take_b, bt,
+                                                 tgt["first_time"][ix])
+            if "last" in tgt and "last" in st:
+                b_has = ~np.isnan(st["last"])
+                bt = np.where(b_has, st["last_time"], _I64MIN)
+                take_b = b_has & (bt >= tgt["last_time"][ix])
+                tgt["last"][ix] = np.where(take_b, st["last"],
+                                           tgt["last"][ix])
+                tgt["last_time"][ix] = np.where(take_b, bt,
+                                                tgt["last_time"][ix])
+        merged_fields[fname] = tgt
+        # integer only if every store that saw the field agrees
+        seen = [p["field_types"].get(fname) for p in partials
+                if fname in p.get("field_types", {})]
+        field_types[fname] = ("integer" if seen and
+                              all(t == "integer" for t in seen) else "float")
+
+    group_keys = [None] * G
+    for k, gi in key_to_gi.items():
+        group_keys[gi] = list(k)
+    return {"group_tags": group_tags, "group_keys": group_keys,
+            "interval": interval, "start": int(start), "W": W,
+            "fields": merged_fields, "field_types": field_types}
+
+
+def finalize_partials(stmt, mst: str, aggs: list[AggItem],
+                      partials: list[dict | None]) -> dict:
+    """Merge partials and build the influx-style result (the sql node's
+    final transforms: fill, order, limit, series assembly)."""
+    merged = merge_partials(partials)
+    if merged is None:
+        return {}
+    group_tags = merged["group_tags"]
+    group_keys = [tuple(k) for k in merged["group_keys"]]
+    interval = merged["interval"]
+    start = merged["start"]
+    W = merged["W"]
+    G = len(group_keys)
+    fields = merged["fields"]
+    field_types = merged["field_types"]
+
+    out_cols = [np.asarray(_finalize_agg(a.func, fields[a.field]))
+                for a in aggs]
+    anyc = np.zeros((G, W), dtype=np.int64)
+    for a in aggs:
+        c = fields[a.field].get("count")
+        anyc += c if c is not None else 1
+
+    win_times = start + interval * np.arange(W) if interval else \
+        np.array([start], dtype=np.int64)
+
+    series_out = []
+    order = sorted(range(G), key=lambda gi: group_keys[gi])
+    for gi in order:
+        tags = dict(zip(group_tags, group_keys[gi]))
+        rows = []
+        prev = [None] * len(aggs)
+        for wi in range(W):
+            has = anyc[gi, wi] > 0
+            if not has:
+                if not interval or stmt.fill_option == "none":
+                    continue
+                if stmt.fill_option == "null":
+                    rows.append([int(win_times[wi])] + [None] * len(aggs))
+                    continue
+                if stmt.fill_option == "value":
+                    rows.append([int(win_times[wi])]
+                                + [stmt.fill_value] * len(aggs))
+                    continue
+                if stmt.fill_option == "previous":
+                    rows.append([int(win_times[wi])] + list(prev))
+                    continue
+                continue
+            row = [int(win_times[wi])]
+            for ai, a in enumerate(aggs):
+                cnt_arr = fields[a.field].get("count")
+                cnt = cnt_arr[gi, wi] if cnt_arr is not None else 1
+                if cnt == 0:
+                    row.append(None)
+                    continue
+                v = float(out_cols[ai][gi, wi])
+                if a.func == "count":
+                    v = int(v)
+                elif (field_types.get(a.field) == "integer"
+                      and a.func in ("sum", "min", "max", "first",
+                                     "last", "spread")):
+                    v = int(v)
+                row.append(v)
+                prev[ai] = row[-1]
+            rows.append(row)
+        if not rows:
+            continue
+        if stmt.order_desc:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[:stmt.limit]
+        if not rows:
+            continue
+        entry = {"name": mst,
+                 "columns": ["time"] + [a.output for a in aggs],
+                 "values": rows}
+        if group_tags:
+            entry["tags"] = tags
+        series_out.append(entry)
+    if stmt.soffset:
+        series_out = series_out[stmt.soffset:]
+    if stmt.slimit:
+        series_out = series_out[:stmt.slimit]
+    return {"series": series_out} if series_out else {}
+
+
 # --------------------------------------------------------------- helpers
 
 def _series(name: str, columns: list[str], values: list) -> dict:
@@ -526,24 +677,16 @@ def _classify_fields(stmt: SelectStatement):
     return aggs, raw, has_wildcard
 
 
-def _finalize_agg(func: str, res, num_segments: int) -> np.ndarray:
-    count = np.asarray(res.count) if res.count is not None else None
+def _finalize_agg(func: str, st: dict) -> np.ndarray:
+    """Finalize one aggregate from a merged state dict of (G, W) arrays."""
     if func == "count":
-        return count.astype(np.float64)
+        return st["count"].astype(np.float64)
     if func == "sum":
-        return np.asarray(res.sum)
+        return st["sum"]
     if func == "mean":
-        s = np.asarray(res.sum)
-        c = np.maximum(count, 1)
-        return s / c
-    if func == "min":
-        return np.asarray(res.min)
-    if func == "max":
-        return np.asarray(res.max)
-    if func == "first":
-        return np.asarray(res.first)
-    if func == "last":
-        return np.asarray(res.last)
+        return st["sum"] / np.maximum(st["count"], 1)
+    if func in ("min", "max", "first", "last"):
+        return st[func]
     if func == "spread":
-        return np.asarray(res.max) - np.asarray(res.min)
+        return st["max"] - st["min"]
     raise ErrQueryError(f"unsupported aggregate {func}")

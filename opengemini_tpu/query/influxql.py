@@ -470,3 +470,108 @@ def parse_query(text: str, now_ns: int | None = None) -> list:
     if not stmts:
         raise ParseError("empty query")
     return stmts
+
+
+# ---------------------------------------------------------------- format
+
+def _fmt_ident(name: str) -> str:
+    if re.fullmatch(r"[a-z_][a-z0-9_]*", name):
+        return name
+    return '"' + name.replace('"', '\\"') + '"'
+
+
+def _fmt_string(s: str) -> str:
+    return "'" + s.replace("\\", "\\\\").replace("'", "\\'") + "'"
+
+
+def format_expr(e, regex_ctx: bool = False) -> str:
+    """AST → InfluxQL text. Inverse of the parser for the supported
+    surface (used to ship statements to store nodes — reference ships
+    serialized plan trees instead, logic_plan_codec.go; text is the
+    simpler wire form at this plan-shape count)."""
+    if isinstance(e, Literal):
+        v = e.value
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, str):
+            return "/" + v.replace("/", "\\/") + "/" if regex_ctx \
+                else _fmt_string(v)
+        if isinstance(v, float):
+            return repr(v)
+        return str(v)
+    if isinstance(e, FieldRef):
+        return _fmt_ident(e.name)
+    if isinstance(e, Wildcard):
+        return "*"
+    if isinstance(e, Call):
+        if e.func == "time" and e.args:
+            parts = [f"{int(a.value)}ns" for a in e.args]
+            return f"time({', '.join(parts)})"
+        return f"{e.func}({', '.join(format_expr(a) for a in e.args)})"
+    if isinstance(e, BinaryExpr):
+        rx = e.op in ("=~", "!~")
+        lhs = format_expr(e.lhs)
+        rhs = format_expr(e.rhs, regex_ctx=rx)
+        return f"({lhs} {e.op.upper() if e.op in ('and', 'or') else e.op} {rhs})"
+    raise ValueError(f"cannot format expression {e!r}")
+
+
+def format_statement(stmt) -> str:
+    """SelectStatement / ShowStatement → InfluxQL text (re-parseable)."""
+    if isinstance(stmt, SelectStatement):
+        parts = ["SELECT"]
+        flds = []
+        for sf in stmt.fields:
+            t = format_expr(sf.expr)
+            if sf.alias:
+                t += f" AS {_fmt_ident(sf.alias)}"
+            flds.append(t)
+        parts.append(", ".join(flds))
+        if stmt.into_measurement:
+            tgt = _fmt_ident(stmt.into_measurement)
+            if stmt.into_db:
+                tgt = f"{_fmt_ident(stmt.into_db)}..{tgt}"
+            parts.append(f"INTO {tgt}")
+        src = _fmt_ident(stmt.from_measurement)
+        if stmt.from_db:
+            rp = _fmt_ident(stmt.from_rp) if stmt.from_rp else ""
+            src = f"{_fmt_ident(stmt.from_db)}.{rp}.{src}"
+        elif stmt.from_rp:
+            src = f"{_fmt_ident(stmt.from_rp)}.{src}"
+        parts.append(f"FROM {src}")
+        if stmt.condition is not None:
+            parts.append(f"WHERE {format_expr(stmt.condition)}")
+        if stmt.dimensions:
+            dims = [format_expr(d.expr) for d in stmt.dimensions]
+            parts.append(f"GROUP BY {', '.join(dims)}")
+        if stmt.fill_option != "null":
+            fv = (str(stmt.fill_value) if stmt.fill_option == "value"
+                  else stmt.fill_option)
+            parts.append(f"fill({fv})")
+        if stmt.order_desc:
+            parts.append("ORDER BY time DESC")
+        if stmt.limit:
+            parts.append(f"LIMIT {stmt.limit}")
+        if stmt.offset:
+            parts.append(f"OFFSET {stmt.offset}")
+        if stmt.slimit:
+            parts.append(f"SLIMIT {stmt.slimit}")
+        if stmt.soffset:
+            parts.append(f"SOFFSET {stmt.soffset}")
+        return " ".join(parts)
+    if isinstance(stmt, ShowStatement):
+        parts = [f"SHOW {stmt.what.upper()}"]
+        if stmt.on_db:
+            parts.append(f"ON {_fmt_ident(stmt.on_db)}")
+        if stmt.from_measurement:
+            parts.append(f"FROM {_fmt_ident(stmt.from_measurement)}")
+        if stmt.key:
+            parts.append(f"WITH KEY = {_fmt_ident(stmt.key)}")
+        if stmt.condition is not None:
+            parts.append(f"WHERE {format_expr(stmt.condition)}")
+        if stmt.limit:
+            parts.append(f"LIMIT {stmt.limit}")
+        if stmt.offset:
+            parts.append(f"OFFSET {stmt.offset}")
+        return " ".join(parts)
+    raise ValueError(f"cannot format statement {type(stmt).__name__}")

@@ -1,0 +1,188 @@
+"""Distributed write/query path: meta + 2 stores + sql facade in-proc.
+
+Modeled on the reference's mock TSDB system executor tests
+(engine/executor/mock_tsdb_system_test.go) — full scatter/gather over
+real RPC on loopback, results compared against a single-node engine
+over identical data (the distribution must be invisible in results).
+"""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.app import TsMeta, TsStore, TsSql
+from opengemini_tpu.query.executor import QueryExecutor
+from opengemini_tpu.query.influxql import parse_query
+from opengemini_tpu.storage.engine import Engine, EngineOptions
+from opengemini_tpu.storage.rows import PointRow
+
+NS = 10**9
+MIN = 60 * NS
+
+
+def _mk_rows(n_hosts=6, n_points=50):
+    rows = []
+    rng = np.random.default_rng(7)
+    for h in range(n_hosts):
+        for i in range(n_points):
+            rows.append(PointRow(
+                "cpu", {"host": f"h{h}", "dc": f"dc{h % 2}"},
+                {"usage": float(np.round(rng.normal(50, 10), 3)),
+                 "cnt": int(rng.integers(0, 100))},
+                i * 10 * NS + h))
+    return rows
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cluster")
+    meta = TsMeta(data_dir=str(tmp / "meta"))
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    stores = [TsStore(str(tmp / f"store{i}"), [meta.addr],
+                      heartbeat_s=0.5) for i in range(2)]
+    for s in stores:
+        s.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    yield {"meta": meta, "stores": stores, "sql": sql}
+    sql.stop()
+    for s in stores:
+        s.stop()
+    meta.stop()
+
+
+@pytest.fixture(scope="module")
+def loaded(cluster, tmp_path_factory):
+    """Same rows written to the cluster AND to a reference single-node
+    engine."""
+    rows = _mk_rows()
+    n = cluster["sql"].facade.write_points("tsbs", rows)
+    assert n == len(rows)
+    ref_dir = tmp_path_factory.mktemp("ref_engine")
+    ref = Engine(str(ref_dir), EngineOptions())
+    ref.write_points("tsbs", rows)
+    yield {"rows": rows, "ref": ref, **cluster}
+    ref.close()
+
+
+def _cluster_result(loaded, q):
+    stmt = parse_query(q)[0]
+    return loaded["sql"].facade.executor.execute(stmt, "tsbs")
+
+
+def _ref_result(loaded, q):
+    stmt = parse_query(q)[0]
+    return QueryExecutor(loaded["ref"]).execute(stmt, "tsbs")
+
+
+def _approx_eq(a, b, path=""):
+    """Structural equality with float tolerance: a distributed sum adds
+    per-store partials in a different order than one flat pass, so the
+    last ulp may differ (floats are not associative)."""
+    if isinstance(a, float) or isinstance(b, float):
+        assert a == pytest.approx(b, rel=1e-12, abs=1e-12), path
+        return
+    if isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b), path
+        for k in a:
+            _approx_eq(a[k], b[k], f"{path}.{k}")
+        return
+    if isinstance(a, list):
+        assert isinstance(b, list) and len(a) == len(b), \
+            f"{path}: {len(a) if isinstance(a, list) else a} vs {len(b) if isinstance(b, list) else b}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _approx_eq(x, y, f"{path}[{i}]")
+        return
+    assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+def test_write_distributes_over_stores(loaded):
+    counts = [s.node.stats["rows_written"] for s in loaded["stores"]]
+    assert sum(counts) == len(loaded["rows"])
+    assert all(c > 0 for c in counts), f"skewed distribution: {counts}"
+
+
+@pytest.mark.parametrize("q", [
+    "SELECT mean(usage) FROM cpu GROUP BY time(1m), host",
+    "SELECT count(usage), sum(usage) FROM cpu GROUP BY time(1m)",
+    "SELECT min(usage), max(usage), first(usage), last(usage) FROM cpu "
+    "GROUP BY host",
+    "SELECT mean(usage) FROM cpu WHERE host = 'h1' GROUP BY time(2m)",
+    "SELECT spread(cnt) FROM cpu GROUP BY dc",
+    "SELECT mean(usage) FROM cpu WHERE usage > 50 GROUP BY dc, host",
+    "SELECT count(usage) FROM cpu",
+])
+def test_distributed_agg_matches_single_node(loaded, q):
+    _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q))
+
+
+@pytest.mark.parametrize("q", [
+    "SELECT usage FROM cpu WHERE host = 'h2'",
+    "SELECT usage, cnt FROM cpu GROUP BY host LIMIT 5",
+    "SELECT usage FROM cpu WHERE time >= 100000000000 LIMIT 7",
+    "SELECT * FROM cpu GROUP BY * SLIMIT 3",
+])
+def test_distributed_raw_matches_single_node(loaded, q):
+    _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q))
+
+
+@pytest.mark.parametrize("q", [
+    "SHOW MEASUREMENTS",
+    "SHOW TAG KEYS FROM cpu",
+    "SHOW TAG VALUES FROM cpu WITH KEY = host",
+    "SHOW FIELD KEYS FROM cpu",
+    "SHOW SERIES",
+])
+def test_distributed_show_matches_single_node(loaded, q):
+    assert _cluster_result(loaded, q) == _ref_result(loaded, q)
+
+
+def test_db_qualified_query(loaded):
+    """db qualifier inside the statement must not break partition
+    resolution on stores."""
+    res = _cluster_result(loaded, "SELECT usage FROM tsbs..cpu "
+                                  "WHERE host = 'h3' LIMIT 3")
+    assert "error" not in res
+    assert len(res["series"][0]["values"]) == 3
+
+
+def test_show_limit_applied_once(loaded):
+    full = _cluster_result(loaded, "SHOW TAG VALUES FROM cpu WITH KEY = host")
+    lim = _cluster_result(loaded,
+                          "SHOW TAG VALUES FROM cpu WITH KEY = host "
+                          "LIMIT 3 OFFSET 1")
+    assert lim["series"][0]["values"] == full["series"][0]["values"][1:4]
+
+
+def test_show_databases_lists_cluster_db(loaded):
+    res = _cluster_result(loaded, "SHOW DATABASES")
+    names = [v[0] for v in res["series"][0]["values"]]
+    assert "tsbs" in names
+
+
+def test_cluster_http_roundtrip(loaded):
+    import json
+    import urllib.request
+    addr = loaded["sql"].http_addr
+    body = b"mem,host=x used=1 1000000000\nmem,host=y used=3 2000000000"
+    req = urllib.request.Request(
+        f"http://{addr}/write?db=httpdb", data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 204
+    with urllib.request.urlopen(
+            f"http://{addr}/query?db=httpdb&q=SELECT+sum(used)+FROM+mem"
+    ) as r:
+        res = json.loads(r.read())
+    vals = res["results"][0]["series"][0]["values"]
+    assert vals[0][1] == 4.0
+
+
+def test_drop_database_cluster(loaded):
+    sql = loaded["sql"]
+    sql.facade.write_points(
+        "dropme", [PointRow("m", {"t": "1"}, {"v": 1.0}, 10 * NS)])
+    stmt = parse_query("DROP DATABASE dropme")[0]
+    res = sql.facade.executor.execute(stmt, None)
+    assert "error" not in res
+    sql.meta.refresh()
+    assert sql.meta.database("dropme") is None
