@@ -1,0 +1,183 @@
+// LZ4-block-format codec, written from scratch.
+//
+// Role of the reference's lifted LZ4 C code (lib/util/lifted/encoding/lz4/
+// lz4.c, cgo-gated in lz4_linux_amd64.go:19): fast byte-oriented block
+// compression for WAL records and string columns. This is an independent
+// implementation of the public LZ4 block format (token / literal run /
+// 16-bit offset / match run, min-match 4), greedy hash-table matcher.
+//
+// C ABI (ctypes-friendly):
+//   int64 og_lz4_max_compressed(int64 n)
+//   int64 og_lz4_compress  (const uint8* src, int64 n, uint8* dst, int64 cap)
+//   int64 og_lz4_decompress(const uint8* src, int64 n, uint8* dst, int64 cap)
+// Return value: bytes written, or -1 on error / insufficient capacity.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int MINMATCH = 4;
+constexpr int HASH_LOG = 14;
+constexpr int HASH_SIZE = 1 << HASH_LOG;
+// last 5 bytes must be literals; matches must not run into the last 12
+constexpr int LAST_LITERALS = 5;
+constexpr int MFLIMIT = 12;
+
+inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+inline uint32_t hash4(uint32_t x) {
+    return (x * 2654435761u) >> (32 - HASH_LOG);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t og_lz4_max_compressed(int64_t n) {
+    if (n < 0) return -1;
+    return n + n / 255 + 16;
+}
+
+int64_t og_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                        int64_t cap) {
+    if (n < 0 || cap < og_lz4_max_compressed(0)) return -1;
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    const uint8_t* anchor = src;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + cap;
+
+    if (n >= MFLIMIT) {
+        const uint8_t* const mflimit = iend - MFLIMIT;
+        int32_t table[HASH_SIZE];
+        std::memset(table, -1, sizeof(table));
+
+        while (ip <= mflimit) {
+            uint32_t h = hash4(read32(ip));
+            int32_t cand = table[h];
+            table[h] = static_cast<int32_t>(ip - src);
+            if (cand < 0 || ip - (src + cand) > 65535 ||
+                read32(src + cand) != read32(ip)) {
+                ++ip;
+                continue;
+            }
+            // extend the match forward
+            const uint8_t* match = src + cand;
+            const uint8_t* mip = ip + MINMATCH;
+            const uint8_t* mm = match + MINMATCH;
+            const uint8_t* const matchlimit = iend - LAST_LITERALS;
+            while (mip < matchlimit && *mip == *mm) { ++mip; ++mm; }
+            int64_t mlen = (mip - ip) - MINMATCH;
+            int64_t litlen = ip - anchor;
+
+            // token + extended literal length + literals
+            uint8_t* token = op++;
+            if (op + litlen + litlen / 255 + 8 > oend) return -1;
+            if (litlen >= 15) {
+                *token = 15 << 4;
+                int64_t l = litlen - 15;
+                for (; l >= 255; l -= 255) *op++ = 255;
+                *op++ = static_cast<uint8_t>(l);
+            } else {
+                *token = static_cast<uint8_t>(litlen) << 4;
+            }
+            std::memcpy(op, anchor, litlen);
+            op += litlen;
+
+            // offset + extended match length
+            uint16_t off = static_cast<uint16_t>(ip - match);
+            if (op + 2 + mlen / 255 + 1 > oend) return -1;
+            *op++ = static_cast<uint8_t>(off);
+            *op++ = static_cast<uint8_t>(off >> 8);
+            if (mlen >= 15) {
+                *token |= 15;
+                int64_t l = mlen - 15;
+                for (; l >= 255; l -= 255) *op++ = 255;
+                *op++ = static_cast<uint8_t>(l);
+            } else {
+                *token |= static_cast<uint8_t>(mlen);
+            }
+            ip = mip;
+            anchor = ip;
+            if (ip <= mflimit) table[hash4(read32(ip - 2))] =
+                static_cast<int32_t>(ip - 2 - src);
+        }
+    }
+
+    // trailing literals
+    int64_t litlen = iend - anchor;
+    if (op + 1 + litlen + litlen / 255 + 1 > oend) return -1;
+    uint8_t* token = op++;
+    if (litlen >= 15) {
+        *token = 15 << 4;
+        int64_t l = litlen - 15;
+        for (; l >= 255; l -= 255) *op++ = 255;
+        *op++ = static_cast<uint8_t>(l);
+    } else {
+        *token = static_cast<uint8_t>(litlen) << 4;
+    }
+    std::memcpy(op, anchor, litlen);
+    op += litlen;
+    return op - dst;
+}
+
+int64_t og_lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                          int64_t cap) {
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + cap;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        // literals
+        int64_t litlen = token >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                litlen += b;
+            } while (b == 255);
+        }
+        if (ip + litlen > iend || op + litlen > oend) return -1;
+        std::memcpy(op, ip, litlen);
+        ip += litlen;
+        op += litlen;
+        if (ip >= iend) break;  // last block: literals only
+
+        // match
+        if (ip + 2 > iend) return -1;
+        uint16_t off = static_cast<uint16_t>(ip[0] | (ip[1] << 8));
+        ip += 2;
+        if (off == 0 || op - dst < off) return -1;
+        int64_t mlen = (token & 15);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += MINMATCH;
+        if (op + mlen > oend) return -1;
+        const uint8_t* match = op - off;
+        // a match longer than its offset overlaps the output being written:
+        // copy must run forward byte-by-byte
+        if (off >= mlen) {
+            std::memcpy(op, match, mlen);
+        } else {
+            for (int64_t i = 0; i < mlen; ++i) op[i] = match[i];
+        }
+        op += mlen;
+    }
+    return op - dst;
+}
+
+}  // extern "C"

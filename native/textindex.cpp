@@ -1,0 +1,285 @@
+// Full-text inverted index builder + searcher.
+//
+// Role of the reference's C++ text index (engine/index/textindex/
+// FullTextIndex.cpp, mempool.cpp, textbuilder_c.cpp behind a cgo gate in
+// textbuilder_linux_amd64.go:17-20): tokenize string columns and build a
+// token -> posting-list (row ids) inverted index that serializes to one
+// contiguous blob, memory-pooled during the build.
+//
+// Blob layout (all little-endian):
+//   magic  u32 = 0x0671D301
+//   ntok   u32
+//   tokbytes u32        total size of the token-bytes region
+//   postbytes u32       total size of the postings region
+//   per-token table, ntok entries:
+//     tok_off u32   offset into token bytes
+//     tok_len u16
+//     doc_cnt u32
+//     post_off u32  offset into postings region
+//   token bytes (sorted ascending, so lookup is binary search)
+//   postings: per token, delta-varint-encoded ascending doc ids
+//
+// C ABI (opaque handles, ctypes-friendly):
+//   void* og_ti_builder_new()
+//   void  og_ti_builder_add(void*, uint32 doc, const char* text, int64 len)
+//   int64 og_ti_builder_finish(void*, uint8** out)  // malloc'd blob
+//   void  og_ti_builder_free(void*)
+//   void* og_ti_open(const uint8* blob, int64 len)  // copies blob
+//   int64 og_ti_search(void*, const char* token, int64 len,
+//                      uint32* out, int64 cap)      // -1 = absent
+//   void  og_ti_close(void*)
+//   void  og_ti_blob_free(uint8*)
+//   int64 og_tokenize(const char* text, int64 len, uint32* out_se, int64 cap)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t MAGIC = 0x0671D301u;
+constexpr size_t MAX_TOKEN = 64;
+
+inline bool is_tok(uint8_t c) {
+    return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
+           (c >= 'A' && c <= 'Z') || c == '_' || c >= 0x80;
+}
+inline uint8_t low(uint8_t c) {
+    return (c >= 'A' && c <= 'Z') ? c + 32 : c;
+}
+
+// Arena allocator for token keys (the reference's mempool.cpp analog):
+// tokens live for the whole build, so bump allocation with bulk free
+// beats per-string malloc.
+class Arena {
+public:
+    ~Arena() { for (auto* b : blocks_) std::free(b); }
+    const char* put(const char* s, size_t n) {
+        if (used_ + n > BLOCK) {
+            blocks_.push_back(static_cast<char*>(std::malloc(std::max(BLOCK, n))));
+            used_ = 0;
+        }
+        char* p = blocks_.back() + used_;
+        std::memcpy(p, s, n);
+        used_ += n;
+        return p;
+    }
+private:
+    static constexpr size_t BLOCK = 1 << 16;
+    std::vector<char*> blocks_{static_cast<char*>(std::malloc(BLOCK))};
+    size_t used_ = 0;
+};
+
+struct SV {
+    const char* p;
+    uint32_t n;
+    bool operator<(const SV& o) const {
+        int c = std::memcmp(p, o.p, std::min(n, o.n));
+        return c < 0 || (c == 0 && n < o.n);
+    }
+};
+
+struct Builder {
+    Arena arena;
+    std::map<SV, std::vector<uint32_t>> postings;
+    char tok[MAX_TOKEN];
+
+    void add(uint32_t doc, const char* text, int64_t len) {
+        const uint8_t* s = reinterpret_cast<const uint8_t*>(text);
+        int64_t i = 0;
+        while (i < len) {
+            while (i < len && !is_tok(s[i])) ++i;
+            size_t tl = 0;
+            while (i < len && is_tok(s[i])) {
+                if (tl < MAX_TOKEN) tok[tl++] = static_cast<char>(low(s[i]));
+                ++i;
+            }
+            if (!tl) continue;
+            SV key{tok, static_cast<uint32_t>(tl)};
+            auto it = postings.find(key);
+            if (it == postings.end()) {
+                key.p = arena.put(tok, tl);
+                it = postings.emplace(key, std::vector<uint32_t>{}).first;
+            }
+            if (it->second.empty() || it->second.back() != doc)
+                it->second.push_back(doc);
+        }
+    }
+};
+
+void put_varint(std::vector<uint8_t>& out, uint32_t v) {
+    while (v >= 0x80) {
+        out.push_back(static_cast<uint8_t>(v) | 0x80);
+        v >>= 7;
+    }
+    out.push_back(static_cast<uint8_t>(v));
+}
+
+struct Reader {
+    std::vector<uint8_t> blob;
+    uint32_t ntok = 0;
+    const uint8_t* table = nullptr;
+    const uint8_t* tokbytes = nullptr;
+    const uint8_t* posts = nullptr;
+
+    static constexpr size_t ENTRY = 14;  // u32 + u16 + u32 + u32
+
+    bool open() {
+        if (blob.size() < 16) return false;
+        uint32_t magic, tb, pb;
+        std::memcpy(&magic, blob.data(), 4);
+        std::memcpy(&ntok, blob.data() + 4, 4);
+        std::memcpy(&tb, blob.data() + 8, 4);
+        std::memcpy(&pb, blob.data() + 12, 4);
+        if (magic != MAGIC) return false;
+        size_t need = 16 + size_t(ntok) * ENTRY + tb + pb;
+        if (blob.size() < need) return false;
+        table = blob.data() + 16;
+        tokbytes = table + size_t(ntok) * ENTRY;
+        posts = tokbytes + tb;
+        return true;
+    }
+
+    void entry(uint32_t i, uint32_t* toff, uint16_t* tlen, uint32_t* cnt,
+               uint32_t* poff) const {
+        const uint8_t* e = table + size_t(i) * ENTRY;
+        std::memcpy(toff, e, 4);
+        std::memcpy(tlen, e + 4, 2);
+        std::memcpy(cnt, e + 6, 4);
+        std::memcpy(poff, e + 10, 4);
+    }
+
+    // binary search over the sorted token table
+    int64_t find(const char* token, int64_t len) const {
+        int64_t lo = 0, hi = int64_t(ntok) - 1;
+        while (lo <= hi) {
+            int64_t mid = (lo + hi) / 2;
+            uint32_t toff, cnt, poff;
+            uint16_t tlen;
+            entry(static_cast<uint32_t>(mid), &toff, &tlen, &cnt, &poff);
+            int c = std::memcmp(tokbytes + toff, token,
+                                std::min<int64_t>(tlen, len));
+            if (c == 0) c = (tlen < len) ? -1 : (tlen > len ? 1 : 0);
+            if (c == 0) return mid;
+            if (c < 0) lo = mid + 1; else hi = mid - 1;
+        }
+        return -1;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* og_ti_builder_new() { return new Builder(); }
+
+void og_ti_builder_add(void* h, uint32_t doc, const char* text, int64_t len) {
+    static_cast<Builder*>(h)->add(doc, text, len);
+}
+
+int64_t og_ti_builder_finish(void* h, uint8_t** out) {
+    Builder* b = static_cast<Builder*>(h);
+    std::vector<uint8_t> tokbytes, posts, tab;
+    tab.reserve(b->postings.size() * Reader::ENTRY);
+    for (auto& kv : b->postings) {
+        uint32_t toff = static_cast<uint32_t>(tokbytes.size());
+        uint16_t tlen = static_cast<uint16_t>(kv.first.n);
+        uint32_t cnt = static_cast<uint32_t>(kv.second.size());
+        uint32_t poff = static_cast<uint32_t>(posts.size());
+        tokbytes.insert(tokbytes.end(), kv.first.p, kv.first.p + kv.first.n);
+        uint32_t prev = 0;
+        for (uint32_t d : kv.second) {
+            put_varint(posts, d - prev);
+            prev = d;
+        }
+        uint8_t e[Reader::ENTRY];
+        std::memcpy(e, &toff, 4);
+        std::memcpy(e + 4, &tlen, 2);
+        std::memcpy(e + 6, &cnt, 4);
+        std::memcpy(e + 10, &poff, 4);
+        tab.insert(tab.end(), e, e + Reader::ENTRY);
+    }
+    uint32_t ntok = static_cast<uint32_t>(b->postings.size());
+    uint32_t tb = static_cast<uint32_t>(tokbytes.size());
+    uint32_t pb = static_cast<uint32_t>(posts.size());
+    int64_t total = 16 + int64_t(tab.size()) + tb + pb;
+    uint8_t* blob = static_cast<uint8_t*>(std::malloc(total));
+    if (!blob) return -1;
+    std::memcpy(blob, &MAGIC, 4);
+    std::memcpy(blob + 4, &ntok, 4);
+    std::memcpy(blob + 8, &tb, 4);
+    std::memcpy(blob + 12, &pb, 4);
+    std::memcpy(blob + 16, tab.data(), tab.size());
+    std::memcpy(blob + 16 + tab.size(), tokbytes.data(), tb);
+    std::memcpy(blob + 16 + tab.size() + tb, posts.data(), pb);
+    *out = blob;
+    return total;
+}
+
+void og_ti_builder_free(void* h) { delete static_cast<Builder*>(h); }
+void og_ti_blob_free(uint8_t* p) { std::free(p); }
+
+void* og_ti_open(const uint8_t* blob, int64_t len) {
+    Reader* r = new Reader();
+    r->blob.assign(blob, blob + len);
+    if (!r->open()) {
+        delete r;
+        return nullptr;
+    }
+    return r;
+}
+
+void og_ti_close(void* h) { delete static_cast<Reader*>(h); }
+
+int64_t og_ti_search(void* h, const char* token, int64_t len, uint32_t* out,
+                     int64_t cap) {
+    Reader* r = static_cast<Reader*>(h);
+    int64_t idx = r->find(token, len);
+    if (idx < 0) return -1;
+    uint32_t toff, cnt, poff;
+    uint16_t tlen;
+    r->entry(static_cast<uint32_t>(idx), &toff, &tlen, &cnt, &poff);
+    if (cnt > cap) return -2;  // caller retries with a bigger buffer
+    const uint8_t* p = r->posts + poff;
+    uint32_t doc = 0;
+    for (uint32_t i = 0; i < cnt; ++i) {
+        uint32_t d = 0;
+        int shift = 0;
+        while (true) {
+            uint8_t byte = *p++;
+            d |= uint32_t(byte & 0x7F) << shift;
+            if (!(byte & 0x80)) break;
+            shift += 7;
+        }
+        doc += d;
+        out[i] = doc;
+    }
+    return cnt;
+}
+
+// Tokenize into (start,end) u32 pairs; returns token count (for the Python
+// fallback to stay byte-identical with the native tokenizer).
+int64_t og_tokenize(const char* text, int64_t len, uint32_t* out_se,
+                    int64_t cap) {
+    const uint8_t* s = reinterpret_cast<const uint8_t*>(text);
+    int64_t i = 0, n = 0;
+    while (i < len) {
+        while (i < len && !is_tok(s[i])) ++i;
+        int64_t start = i;
+        while (i < len && is_tok(s[i])) ++i;
+        if (i > start) {
+            if (n < cap) {
+                out_se[2 * n] = static_cast<uint32_t>(start);
+                out_se[2 * n + 1] = static_cast<uint32_t>(i);
+            }
+            ++n;
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -18,8 +18,7 @@ from dataclasses import replace
 
 from ..query.ast import (CreateDatabaseStatement, DropDatabaseStatement,
                          SelectStatement, ShowStatement)
-from ..query.condition import MAX_TIME, MIN_TIME, analyze_condition
-from ..query.executor import AggItem, _classify_fields, finalize_partials
+from ..query.executor import _classify_fields, finalize_partials
 from ..query.influxql import format_statement
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
@@ -129,13 +128,18 @@ class ClusterExecutor:
             partials = [r["partial"] for r in resps]
             return finalize_partials(stmt, mst, aggs, partials)
         resps = self._scatter("store.select_raw", db, {"q": q})
-        return self._merge_raw(stmt, resps)
+        field_order = (None if has_wildcard
+                       else [alias or name for name, alias in raw_fields])
+        return self._merge_raw(stmt, resps, field_order)
 
-    def _merge_raw(self, stmt: SelectStatement, resps: list) -> dict:
+    def _merge_raw(self, stmt: SelectStatement, resps: list,
+                   field_order: list[str] | None = None) -> dict:
         """Merge raw-select series lists from stores: group by (name,
         tags), align columns (SELECT * may see different field sets per
         partition), concatenate + time-sort rows, apply limits
-        globally."""
+        globally. field_order preserves explicit SELECT order when
+        partitions expose different field subsets; None (wildcard) widens
+        to the sorted union."""
         groups: dict[tuple, dict] = {}
         for resp in resps:
             for series_list in resp["series_lists"]:
@@ -152,10 +156,16 @@ class ClusterExecutor:
                     if s["columns"] == g["columns"]:
                         g["values"].extend(s["values"])
                         continue
-                    # column sets differ: widen to the union (sorted
-                    # after 'time', matching the wildcard field order)
-                    union = [g["columns"][0]] + sorted(
-                        set(g["columns"][1:]) | set(s["columns"][1:]))
+                    # column sets differ: widen to the union — explicit
+                    # SELECT keeps the selection order, wildcard sorts
+                    # (matching the single-node wildcard field order)
+                    present = set(g["columns"][1:]) | set(s["columns"][1:])
+                    if field_order is not None:
+                        ordered = [c for c in field_order if c in present]
+                        ordered += sorted(present - set(ordered))
+                    else:
+                        ordered = sorted(present)
+                    union = [g["columns"][0]] + ordered
                     if union != g["columns"]:
                         remap = [g["columns"].index(c)
                                  if c in g["columns"] else None
@@ -238,16 +248,16 @@ class ClusterFacade:
     """Engine-shaped adapter for the HTTP layer in cluster mode: writes
     route through PointsWriter, `databases` reads the meta cache."""
 
-    def __init__(self, meta: MetaClient):
+    def __init__(self, meta: MetaClient, auto_create_db: bool = True):
         self.meta = meta
-        self.writer = PointsWriter(meta)
+        self.writer = PointsWriter(meta, auto_create_db=auto_create_db)
         self.executor = ClusterExecutor(meta)
 
     @property
     def databases(self):
         return self.meta.data().databases
 
-    def write_points(self, db: str, rows, create_db: bool = True) -> int:
+    def write_points(self, db: str, rows) -> int:
         return self.writer.write_points(db, rows)
 
     def create_database(self, name: str) -> None:

@@ -17,7 +17,6 @@ the heavy reduction runs here, on-device, next to the data.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import replace
 
@@ -67,7 +66,6 @@ class StoreNode:
                                     "store.measurements": self._on_measurements,
                                 })
         self.addr = self.server.addr
-        self._write_lock = threading.Lock()
         self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
 
     def start(self) -> None:

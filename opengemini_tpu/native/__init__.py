@@ -1,0 +1,347 @@
+"""Native (C++) components behind ctypes, with pure-Python fallbacks.
+
+Role of the reference's cgo-gated native code (SURVEY §2.7 native checklist):
+LZ4 block codec (lib/util/lifted/encoding/lz4/lz4.c behind
+lz4_linux_amd64.go:19) and the C++ full-text index (engine/index/textindex/
+FullTextIndex.cpp behind textbuilder_linux_amd64.go:17-20). Like the
+reference — which stubs both off linux/amd64 — every native entry point here
+has a pure-Python fallback producing byte-identical output, so the framework
+runs anywhere and the native path is a transparent accelerator.
+
+The shared library builds lazily on first import (g++ is in the image); a
+build failure downgrades to the fallbacks with a one-line warning.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libogn.so"))
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _load():
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build_attempted:
+            _build_attempted = True
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                    capture_output=True, timeout=120, check=True)
+            except Exception:
+                return None
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.og_lz4_max_compressed.restype = ctypes.c_int64
+        lib.og_lz4_max_compressed.argtypes = [ctypes.c_int64]
+        for fn in (lib.og_lz4_compress, lib.og_lz4_decompress):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                           ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.og_ti_builder_new.restype = ctypes.c_void_p
+        lib.og_ti_builder_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_int64]
+        lib.og_ti_builder_finish.restype = ctypes.c_int64
+        lib.og_ti_builder_finish.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.og_ti_builder_free.argtypes = [ctypes.c_void_p]
+        lib.og_ti_blob_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.og_ti_open.restype = ctypes.c_void_p
+        lib.og_ti_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.og_ti_close.argtypes = [ctypes.c_void_p]
+        lib.og_ti_search.restype = ctypes.c_int64
+        lib.og_ti_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------- LZ4
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_lz4_compress(data)
+    cap = lib.og_lz4_max_compressed(len(data))
+    dst = (ctypes.c_uint8 * cap)()
+    n = lib.og_lz4_compress(data, len(data), dst, cap)
+    if n < 0:
+        raise ValueError("lz4 compress failed")
+    return bytes(dst[:n])
+
+
+def lz4_decompress(data: bytes, decompressed_size: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_lz4_decompress(data, decompressed_size)
+    dst = (ctypes.c_uint8 * decompressed_size)()
+    n = lib.og_lz4_decompress(data, len(data), dst, decompressed_size)
+    if n != decompressed_size:
+        raise ValueError(
+            f"lz4 decompress: got {n}, want {decompressed_size}")
+    return bytes(dst[:n])
+
+
+# Pure-Python LZ4 block format (same format as native — interoperable).
+
+def _py_lz4_compress(data: bytes) -> bytes:
+    # literal-only stream: valid LZ4 blocks, no matching (fallback is about
+    # correctness + interop, not ratio)
+    out = bytearray()
+    n = len(data)
+    litlen = n
+    if litlen >= 15:
+        out.append(15 << 4)
+        rem = litlen - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    else:
+        out.append(litlen << 4)
+    out += data
+    return bytes(out)
+
+
+def _py_lz4_decompress(data: bytes, size: int) -> bytes:
+    out = bytearray()
+    i, n = 0, len(data)
+    while i < n:
+        token = data[i]
+        i += 1
+        litlen = token >> 4
+        if litlen == 15:
+            while True:
+                b = data[i]
+                i += 1
+                litlen += b
+                if b != 255:
+                    break
+        out += data[i:i + litlen]
+        i += litlen
+        if i >= n:
+            break
+        off = data[i] | (data[i + 1] << 8)
+        i += 2
+        mlen = token & 15
+        if mlen == 15:
+            while True:
+                b = data[i]
+                i += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        if off == 0 or off > len(out):
+            raise ValueError("corrupt lz4 block")
+        start = len(out) - off
+        for k in range(mlen):  # overlap-safe forward copy
+            out.append(out[start + k])
+    if len(out) != size:
+        raise ValueError(f"lz4: got {len(out)} bytes, want {size}")
+    return bytes(out)
+
+
+# ------------------------------------------------------------ text index
+
+_MAX_TOKEN = 64
+
+
+def tokenize(text: bytes) -> list[bytes]:
+    """Lowercased alnum/underscore/UTF-8 tokens, truncated to 64 bytes —
+    byte-identical with the native tokenizer (og_tokenize + low())."""
+    toks = []
+    i, n = 0, len(text)
+    while i < n:
+        while i < n and not _is_tok(text[i]):
+            i += 1
+        start = i
+        while i < n and _is_tok(text[i]):
+            i += 1
+        if i > start:
+            toks.append(text[start:i].lower()[:_MAX_TOKEN])
+    return toks
+
+
+def _is_tok(c: int) -> bool:
+    return (97 <= c <= 122 or 48 <= c <= 57 or 65 <= c <= 90
+            or c == 95 or c >= 0x80)
+
+
+class TextIndexBuilder:
+    """Builds the inverted-index blob; native-backed when available."""
+
+    def __init__(self):
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.og_ti_builder_new()
+        else:
+            self._postings: dict[bytes, list[int]] = {}
+
+    def add(self, doc_id: int, text: bytes | str) -> None:
+        if isinstance(text, str):
+            text = text.encode()
+        if self._lib is not None:
+            self._lib.og_ti_builder_add(self._h, doc_id, text, len(text))
+            return
+        for tok in tokenize(text):
+            lst = self._postings.setdefault(tok, [])
+            if not lst or lst[-1] != doc_id:
+                lst.append(doc_id)
+
+    def finish(self) -> bytes:
+        if self._lib is not None:
+            if self._h is None:
+                raise ValueError("finish() already called")
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.og_ti_builder_finish(self._h, ctypes.byref(out))
+            try:
+                if n < 0:
+                    raise MemoryError("text index build failed")
+                blob = ctypes.string_at(out, n)
+                self._lib.og_ti_blob_free(out)
+            finally:
+                self._lib.og_ti_builder_free(self._h)
+                self._h = None
+            return blob
+        return _py_ti_finish(self._postings)
+
+
+def _py_varint(out: bytearray, v: int) -> None:
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _py_ti_finish(postings: dict[bytes, list[int]]) -> bytes:
+    import struct
+    toks = sorted(postings)
+    tokbytes = bytearray()
+    posts = bytearray()
+    tab = bytearray()
+    for t in toks:
+        toff, poff = len(tokbytes), len(posts)
+        tokbytes += t
+        prev = 0
+        for d in postings[t]:
+            _py_varint(posts, d - prev)
+            prev = d
+        tab += struct.pack("<IHII", toff, len(t), len(postings[t]), poff)
+    return (struct.pack("<IIII", 0x0671D301, len(toks), len(tokbytes),
+                        len(posts)) + bytes(tab) + bytes(tokbytes)
+            + bytes(posts))
+
+
+class TextIndexReader:
+    """Searches a finished blob: token -> sorted doc-id array."""
+
+    def __init__(self, blob: bytes):
+        self._blob = blob
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.og_ti_open(blob, len(blob))
+            if not self._h:
+                raise ValueError("corrupt text index blob")
+        else:
+            self._open_py(blob)
+
+    def _open_py(self, blob: bytes) -> None:
+        import struct
+        magic, ntok, tb, pb = struct.unpack_from("<IIII", blob, 0)
+        if magic != 0x0671D301:
+            raise ValueError("corrupt text index blob")
+        self._entries = []
+        pos = 16
+        for _ in range(ntok):
+            self._entries.append(struct.unpack_from("<IHII", blob, pos))
+            pos += 14
+        self._tokbytes = blob[pos:pos + tb]
+        self._posts = blob[pos + tb:pos + tb + pb]
+
+    def search(self, token: bytes | str) -> np.ndarray:
+        """Doc ids containing the token (empty array if absent)."""
+        if isinstance(token, str):
+            token = token.encode()
+        token = token.lower()[:_MAX_TOKEN]
+        if self._lib is not None:
+            cap = 1024
+            while True:
+                out = np.empty(cap, dtype=np.uint32)
+                n = self._lib.og_ti_search(
+                    self._h, token, len(token),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                    cap)
+                if n == -2:
+                    cap *= 8
+                    continue
+                if n < 0:
+                    return np.empty(0, dtype=np.uint32)
+                return out[:n]
+        return self._search_py(token)
+
+    def _search_py(self, token: bytes) -> np.ndarray:
+        if not hasattr(self, "_entries"):
+            self._open_py(self._blob)
+        lo, hi = 0, len(self._entries) - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            toff, tlen, cnt, poff = self._entries[mid]
+            t = self._tokbytes[toff:toff + tlen]
+            if t == token:
+                out = np.empty(cnt, dtype=np.uint32)
+                doc = 0
+                p = poff
+                for i in range(cnt):
+                    d, shift = 0, 0
+                    while True:
+                        b = self._posts[p]
+                        p += 1
+                        d |= (b & 0x7F) << shift
+                        if not b & 0x80:
+                            break
+                        shift += 7
+                    doc += d
+                    out[i] = doc
+                return out
+            if t < token:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return np.empty(0, dtype=np.uint32)
+
+    def close(self) -> None:
+        if self._lib is not None and self._h:
+            self._lib.og_ti_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,7 +1,10 @@
 """Write-ahead log (role of reference engine/wal.go:111 — compressed
 records, rotation via Switch, replay on open).
 
-Frame format: [u32 len][u32 crc32 of compressed payload][zstd payload].
+Frame format: [u32 len][u32 crc32 of payload][payload], where payload is
+[codec u8][u32 raw size][compressed batch]. Codecs: zstd (default) or the
+native LZ4 block codec (the reference's WAL offers lz4/snappy,
+engine/wal.go:236 — lz4 here rides the C++ codec in native/lz4.cpp).
 Payload is a batch of rows serialized compactly (measurement, sid, time,
 fields). Replay validates crc and stops at the first torn frame.
 """
@@ -15,11 +18,13 @@ import zlib
 
 import zstandard
 
+from ..native import lz4_compress, lz4_decompress
 from ..utils import get_logger
 
 log = get_logger(__name__)
 
 _HDR = struct.Struct("<II")
+_ZSTD, _LZ4 = 1, 2
 
 
 def _pack_batch(rows: list[tuple[str, int, dict, int]]) -> bytes:
@@ -76,9 +81,13 @@ def _unpack_batch(buf: bytes) -> list[tuple[str, int, dict, int]]:
 
 
 class WAL:
-    def __init__(self, dir_path: str, sync: bool = False):
+    def __init__(self, dir_path: str, sync: bool = False,
+                 compression: str = "zstd"):
         self.dir = dir_path
         self.sync = sync
+        if compression not in ("zstd", "lz4"):
+            raise ValueError(f"unknown wal compression {compression!r}")
+        self.compression = compression
         os.makedirs(dir_path, exist_ok=True)
         self._lock = threading.Lock()
         self._seq = self._max_seq() + 1
@@ -99,7 +108,12 @@ class WAL:
         return mx
 
     def write(self, rows: list[tuple[str, int, dict, int]]) -> None:
-        payload = self._zc.compress(_pack_batch(rows))
+        raw = _pack_batch(rows)
+        if self.compression == "lz4":
+            codec, body = _LZ4, lz4_compress(raw)
+        else:
+            codec, body = _ZSTD, self._zc.compress(raw)
+        payload = struct.pack("<BI", codec, len(raw)) + body
         frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
             self._f.write(frame)
@@ -155,7 +169,18 @@ class WAL:
                 if zlib.crc32(payload) != crc:
                     log.warning("wal %06d: bad crc at %d", seq, pos)
                     break
-                yield _unpack_batch(zd.decompress(payload))
+                if len(payload) >= 5 and payload[0] in (_ZSTD, _LZ4):
+                    codec, rawlen = struct.unpack_from("<BI", payload, 0)
+                    body = payload[5:]
+                    if codec == _LZ4:
+                        raw = lz4_decompress(body, rawlen)
+                    else:
+                        raw = zd.decompress(body)
+                else:
+                    # legacy frame: bare zstd payload (zstd magic first byte
+                    # 0x28 cannot collide with the codec ids)
+                    raw = zd.decompress(payload)
+                yield _unpack_batch(raw)
                 pos += _HDR.size + ln
 
     def close(self) -> None:

@@ -1,0 +1,141 @@
+"""Native C++ layer: LZ4 block codec + full-text index (SURVEY §2.7 native
+checklist), including native↔Python-fallback interop."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu import native
+from opengemini_tpu.native import (TextIndexBuilder, TextIndexReader,
+                                   _py_lz4_compress, _py_lz4_decompress,
+                                   _py_ti_finish, lz4_compress,
+                                   lz4_decompress, tokenize)
+
+
+def _cases():
+    rng = np.random.default_rng(7)
+    return [
+        b"",
+        b"a",
+        b"hello world hello world hello world hello world",
+        bytes(rng.integers(0, 256, 10_000, dtype=np.uint8)),   # incompressible
+        bytes(rng.integers(0, 4, 50_000, dtype=np.uint8)),     # compressible
+        b"ab" * 40_000,                                        # tiny period
+        bytes(200_000),                                        # zeros
+    ]
+
+
+class TestLZ4:
+    def test_native_built(self):
+        assert native.native_available(), "native libogn.so failed to build"
+
+    @pytest.mark.parametrize("i", range(7))
+    def test_roundtrip(self, i):
+        data = _cases()[i]
+        comp = lz4_compress(data)
+        assert lz4_decompress(comp, len(data)) == data
+
+    def test_ratio_on_redundant_data(self):
+        data = b"cpu,host=server01 usage_user=42.5 " * 5000
+        comp = lz4_compress(data)
+        assert len(comp) < len(data) // 5
+
+    def test_python_fallback_roundtrip(self):
+        for data in _cases():
+            comp = _py_lz4_compress(data)
+            assert _py_lz4_decompress(comp, len(data)) == data
+
+    def test_native_decodes_python_blocks(self):
+        if not native.native_available():
+            pytest.skip("no native lib")
+        for data in _cases():
+            comp = _py_lz4_compress(data)
+            assert lz4_decompress(comp, len(data)) == data
+
+    def test_python_decodes_native_blocks(self):
+        for data in _cases():
+            comp = lz4_compress(data)
+            assert _py_lz4_decompress(comp, len(data)) == data
+
+    def test_corrupt_block_rejected(self):
+        comp = lz4_compress(b"some data worth compressing " * 100)
+        bad = bytes([comp[0] ^ 0xFF]) + comp[1:]
+        with pytest.raises(ValueError):
+            lz4_decompress(bad, 2800)
+
+
+class TestTokenizer:
+    def test_basic(self):
+        assert tokenize(b"GET /api/v1/query?x=1 HTTP 200") == [
+            b"get", b"api", b"v1", b"query", b"x", b"1", b"http", b"200"]
+
+    def test_underscore_and_truncation(self):
+        toks = tokenize(b"node_cpu_seconds_total " + b"x" * 100)
+        assert toks[0] == b"node_cpu_seconds_total"
+        assert len(toks[1]) == 64
+
+
+class TestTextIndex:
+    DOCS = [
+        (0, b"error: connection refused to host db-01"),
+        (1, b"GET /write 204 host=db-01"),
+        (2, b"slow query on measurement cpu duration=5s"),
+        (3, b"error timeout while flushing shard 7"),
+        (5, b"Error: DISK full on /data"),
+    ]
+
+    def _build(self):
+        b = TextIndexBuilder()
+        for doc, text in self.DOCS:
+            b.add(doc, text)
+        return b.finish()
+
+    def test_search(self):
+        r = TextIndexReader(self._build())
+        np.testing.assert_array_equal(r.search(b"error"), [0, 3, 5])
+        np.testing.assert_array_equal(r.search("ERROR"), [0, 3, 5])
+        np.testing.assert_array_equal(r.search(b"db"), [0, 1])
+        np.testing.assert_array_equal(r.search(b"cpu"), [2])
+        assert r.search(b"absent").size == 0
+        r.close()
+
+    def test_fallback_blob_identical(self):
+        """Python builder must produce the exact bytes the C++ builder does."""
+        postings = {}
+        for doc, text in self.DOCS:
+            for tok in tokenize(text):
+                lst = postings.setdefault(tok, [])
+                if not lst or lst[-1] != doc:
+                    lst.append(doc)
+        py_blob = _py_ti_finish(postings)
+        if native.native_available():
+            assert py_blob == self._build()
+        r = TextIndexReader(py_blob)
+        np.testing.assert_array_equal(r._search_py(b"error"), [0, 3, 5])
+
+    def test_large_posting_list(self):
+        b = TextIndexBuilder()
+        for doc in range(5000):
+            b.add(doc, b"common token here" if doc % 2 == 0 else b"other")
+        r = TextIndexReader(b.finish())
+        np.testing.assert_array_equal(r.search(b"common"),
+                                      np.arange(0, 5000, 2))
+        r.close()
+
+    def test_corrupt_blob_rejected(self):
+        with pytest.raises(ValueError):
+            TextIndexReader(b"\x00" * 32)
+
+
+class TestWALLz4:
+    def test_wal_lz4_roundtrip(self, tmp_path):
+        from opengemini_tpu.storage.wal import WAL
+        w = WAL(str(tmp_path), compression="lz4")
+        rows = [("cpu", 1, {"usage_user": 42.5, "core": 3}, 1000),
+                ("mem", 2, {"free": 123456789}, 2000)]
+        w.write(rows)
+        w.write(rows)
+        w.close()
+        w2 = WAL(str(tmp_path))
+        batches = list(w2.replay())
+        w2.close()
+        assert batches == [rows, rows]
