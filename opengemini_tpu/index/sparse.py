@@ -1,0 +1,322 @@
+"""Sparse (skip) indexes for the column-store engine.
+
+Role of reference engine/index/sparseindex/ — per-fragment block pruning so
+scans touch only fragments that can match the WHERE clause:
+- min-max index   (min_max_index.go)   : numeric/string range pruning
+- set index       (set_index.go)       : small-cardinality equality pruning
+- bloom filter    (bloom_filter_index.go): high-cardinality equality pruning
+- full-text bloom (bloom_filter_fulltext_index.go): token MATCH pruning,
+  sharing the native tokenizer with the C++ text index (native/textindex.cpp)
+
+TPU-first angle: pruning yields a boolean fragment mask on the host; only
+surviving fragments are decoded and DMA'd to the device, so the sparse
+index directly bounds HBM traffic. Fragments are fixed-size row blocks —
+the device block shape — making the mask a static-shape gather list.
+
+All indexes serialize to one blob per (column, file) with a common header,
+entries aligned by fragment ordinal.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..native import tokenize
+
+KIND_MINMAX = 1
+KIND_SET = 2
+KIND_BLOOM = 3
+KIND_TEXT_BLOOM = 4
+
+_SET_CARDINALITY_CAP = 64          # beyond this a set entry degrades to pass
+_BLOOM_BITS_PER_KEY = 10
+_BLOOM_HASHES = 7
+
+
+def _h64(b: bytes) -> int:
+    """Deterministic 64-bit hash (FNV-1a); stable across processes, unlike
+    Python's salted hash()."""
+    h = 0xCBF29CE484222325
+    for c in b:
+        h = ((h ^ c) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class Bloom:
+    """Double-hashing bloom filter over byte keys."""
+
+    def __init__(self, bits: np.ndarray):
+        self.bits = bits          # uint8 array, len multiple of 8 bits
+        self.m = len(bits) * 8
+
+    @classmethod
+    def build(cls, keys: list[bytes], bits_per_key: int = _BLOOM_BITS_PER_KEY
+              ) -> "Bloom":
+        m = max(64, 1 << int(np.ceil(np.log2(max(1, len(keys))
+                                             * bits_per_key))))
+        bits = np.zeros(m // 8, dtype=np.uint8)
+        for k in keys:
+            h1 = _h64(k)
+            h2 = zlib.crc32(k) | 1
+            for i in range(_BLOOM_HASHES):
+                pos = (h1 + i * h2) % m
+                bits[pos >> 3] |= 1 << (pos & 7)
+        return cls(bits)
+
+    def may_contain(self, key: bytes) -> bool:
+        h1 = _h64(key)
+        h2 = zlib.crc32(key) | 1
+        for i in range(_BLOOM_HASHES):
+            pos = (h1 + i * h2) % self.m
+            if not (self.bits[pos >> 3] >> (pos & 7)) & 1:
+                return False
+        return True
+
+
+@dataclass
+class FragmentEntry:
+    """Per-fragment index payload; exactly one of the fields is set,
+    matching the index kind."""
+    minmax: tuple | None = None            # (min, max) python scalars
+    values: frozenset | None = None        # set index (None => overflow)
+    bloom: Bloom | None = None
+
+
+class SparseIndexBuilder:
+    """Builds one sparse index (one kind, one column) across fragments."""
+
+    def __init__(self, kind: int, column: str):
+        if kind not in (KIND_MINMAX, KIND_SET, KIND_BLOOM, KIND_TEXT_BLOOM):
+            raise ValueError(f"bad sparse index kind {kind}")
+        self.kind = kind
+        self.column = column
+        self.entries: list[FragmentEntry] = []
+
+    def add_fragment(self, values: np.ndarray | list,
+                     valid: np.ndarray | None = None) -> None:
+        """values: the column's values within one fragment (decoded form:
+        numeric ndarray or list of str)."""
+        if valid is not None:
+            if isinstance(values, np.ndarray):
+                values = values[valid]
+            else:
+                values = [v for v, ok in zip(values, valid) if ok]
+        if self.kind == KIND_MINMAX:
+            if len(values) == 0:
+                self.entries.append(FragmentEntry(minmax=None))
+            elif isinstance(values, np.ndarray):
+                self.entries.append(FragmentEntry(
+                    minmax=(values.min().item(), values.max().item())))
+            else:
+                self.entries.append(FragmentEntry(
+                    minmax=(min(values), max(values))))
+        elif self.kind == KIND_SET:
+            s = frozenset(_as_key(v) for v in values)
+            self.entries.append(FragmentEntry(
+                values=None if len(s) > _SET_CARDINALITY_CAP else s))
+        elif self.kind == KIND_BLOOM:
+            keys = list({_as_key(v) for v in values})
+            self.entries.append(FragmentEntry(bloom=Bloom.build(keys)))
+        else:  # KIND_TEXT_BLOOM
+            toks = set()
+            for v in values:
+                b = v if isinstance(v, bytes) else str(v).encode()
+                toks.update(tokenize(b))
+            self.entries.append(FragmentEntry(bloom=Bloom.build(list(toks))))
+
+    def finish(self) -> "SparseIndex":
+        return SparseIndex(self.kind, self.column, self.entries)
+
+
+def _as_key(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, (bool, np.bool_)):
+        return b"\x01" if v else b"\x00"
+    if isinstance(v, (int, np.integer)):
+        return struct.pack("<q", int(v))
+    return struct.pack("<d", float(v))
+
+
+class SparseIndex:
+    """Finished index: prunes fragments given a predicate on its column."""
+
+    def __init__(self, kind: int, column: str,
+                 entries: list[FragmentEntry]):
+        self.kind = kind
+        self.column = column
+        self.entries = entries
+
+    @property
+    def n_fragments(self) -> int:
+        return len(self.entries)
+
+    # ---------------------------------------------------------- pruning
+
+    def prune_eq(self, value) -> np.ndarray:
+        """Mask of fragments that MAY contain value (False = skip)."""
+        out = np.ones(len(self.entries), dtype=bool)
+        for i, e in enumerate(self.entries):
+            if self.kind == KIND_MINMAX:
+                if e.minmax is None:
+                    out[i] = False
+                else:
+                    lo, hi = e.minmax
+                    out[i] = _cmp_le(lo, value) and _cmp_le(value, hi)
+            elif self.kind == KIND_SET:
+                if e.values is not None:
+                    out[i] = _as_key(value) in e.values
+            elif self.kind in (KIND_BLOOM, KIND_TEXT_BLOOM):
+                out[i] = e.bloom.may_contain(_as_key(value))
+        return out
+
+    def prune_range(self, lo=None, hi=None, lo_inc: bool = True,
+                    hi_inc: bool = True) -> np.ndarray:
+        """Mask for range predicates (min-max index only; other kinds
+        return all-pass)."""
+        out = np.ones(len(self.entries), dtype=bool)
+        if self.kind != KIND_MINMAX:
+            return out
+        for i, e in enumerate(self.entries):
+            if e.minmax is None:
+                out[i] = False
+                continue
+            fmin, fmax = e.minmax
+            ok = True
+            if lo is not None:
+                ok = _cmp_le(lo, fmax) if lo_inc else _cmp_lt(lo, fmax)
+            if ok and hi is not None:
+                ok = _cmp_le(fmin, hi) if hi_inc else _cmp_lt(fmin, hi)
+            out[i] = ok
+        return out
+
+    def prune_match(self, text: str | bytes) -> np.ndarray:
+        """Full-text MATCH: every token of the query must hit the fragment's
+        token bloom."""
+        if self.kind != KIND_TEXT_BLOOM:
+            return np.ones(len(self.entries), dtype=bool)
+        b = text if isinstance(text, bytes) else text.encode()
+        toks = tokenize(b)
+        out = np.ones(len(self.entries), dtype=bool)
+        for i, e in enumerate(self.entries):
+            out[i] = all(e.bloom.may_contain(t) for t in toks)
+        return out
+
+    # ---------------------------------------------------- serialization
+
+    def pack(self) -> bytes:
+        col = self.column.encode()
+        out = [struct.pack("<BHI", self.kind, len(col), len(self.entries)),
+               col]
+        for e in self.entries:
+            if self.kind == KIND_MINMAX:
+                out.append(_pack_minmax(e.minmax))
+            elif self.kind == KIND_SET:
+                if e.values is None:
+                    out.append(struct.pack("<i", -1))
+                else:
+                    out.append(struct.pack("<i", len(e.values)))
+                    for k in sorted(e.values):
+                        out.append(struct.pack("<H", len(k)) + k)
+            else:
+                out.append(struct.pack("<I", len(e.bloom.bits)))
+                out.append(e.bloom.bits.tobytes())
+        return b"".join(out)
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "SparseIndex":
+        kind, clen, n = struct.unpack_from("<BHI", buf, 0)
+        pos = 7
+        column = buf[pos:pos + clen].decode()
+        pos += clen
+        entries = []
+        for _ in range(n):
+            if kind == KIND_MINMAX:
+                mm, pos = _unpack_minmax(buf, pos)
+                entries.append(FragmentEntry(minmax=mm))
+            elif kind == KIND_SET:
+                (cnt,) = struct.unpack_from("<i", buf, pos)
+                pos += 4
+                if cnt < 0:
+                    entries.append(FragmentEntry(values=None))
+                else:
+                    vals = []
+                    for _ in range(cnt):
+                        (kl,) = struct.unpack_from("<H", buf, pos)
+                        pos += 2
+                        vals.append(buf[pos:pos + kl])
+                        pos += kl
+                    entries.append(FragmentEntry(values=frozenset(vals)))
+            else:
+                (nb,) = struct.unpack_from("<I", buf, pos)
+                pos += 4
+                bits = np.frombuffer(buf[pos:pos + nb],
+                                     dtype=np.uint8).copy()
+                pos += nb
+                entries.append(FragmentEntry(bloom=Bloom(bits)))
+        return cls(kind, column, entries)
+
+
+def _cmp_le(a, b) -> bool:
+    try:
+        return a <= b
+    except TypeError:
+        return str(a) <= str(b)
+
+
+def _cmp_lt(a, b) -> bool:
+    try:
+        return a < b
+    except TypeError:
+        return str(a) < str(b)
+
+
+# min/max payload: type tag + value (float/int/str)
+def _pack_minmax(mm) -> bytes:
+    if mm is None:
+        return bytes([0])
+    lo, hi = mm
+    out = [bytes([1])]
+    for v in (lo, hi):
+        if isinstance(v, (bool, np.bool_)):
+            out.append(b"b" + struct.pack("<?", bool(v)))
+        elif isinstance(v, (int, np.integer)):
+            out.append(b"i" + struct.pack("<q", int(v)))
+        elif isinstance(v, (float, np.floating)):
+            out.append(b"f" + struct.pack("<d", float(v)))
+        else:
+            b = v.encode() if isinstance(v, str) else bytes(v)
+            out.append(b"s" + struct.pack("<I", len(b)) + b)
+    return b"".join(out)
+
+
+def _unpack_minmax(buf: bytes, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == 0:
+        return None, pos
+    vals = []
+    for _ in range(2):
+        t = buf[pos:pos + 1]
+        pos += 1
+        if t == b"b":
+            vals.append(struct.unpack_from("<?", buf, pos)[0])
+            pos += 1
+        elif t == b"i":
+            vals.append(struct.unpack_from("<q", buf, pos)[0])
+            pos += 8
+        elif t == b"f":
+            vals.append(struct.unpack_from("<d", buf, pos)[0])
+            pos += 8
+        else:
+            (ln,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            vals.append(buf[pos:pos + ln].decode())
+            pos += ln
+    return (vals[0], vals[1]), pos

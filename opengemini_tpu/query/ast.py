@@ -136,6 +136,18 @@ class DropMeasurementStatement:
 
 
 @dataclass
+class CreateMeasurementStatement:
+    """CREATE MEASUREMENT m [ON db] WITH ENGINETYPE = COLUMNSTORE
+    PRIMARYKEY k1, k2 INDEX kind col[, col...] ... (reference DDL:
+    column-store measurements with PRIMARYKEY/INDEXTYPE)."""
+    name: str
+    on_db: str | None = None
+    engine_type: str = "tsstore"
+    primary_key: list = field(default_factory=list)
+    indexes: dict = field(default_factory=dict)   # col -> kind
+
+
+@dataclass
 class DeleteStatement:
     from_measurement: str | None = None
     condition: object | None = None

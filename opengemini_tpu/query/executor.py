@@ -24,8 +24,8 @@ from ..utils import get_logger
 from ..utils.errors import ErrQueryError
 from .ast import (BinaryExpr, Call, FieldRef, Literal, SelectStatement,
                   ShowStatement, Wildcard, CreateDatabaseStatement,
-                  DropDatabaseStatement, DropMeasurementStatement,
-                  DeleteStatement)
+                  CreateMeasurementStatement, DropDatabaseStatement,
+                  DropMeasurementStatement, DeleteStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 
 log = get_logger(__name__)
@@ -63,6 +63,14 @@ class QueryExecutor:
                 return {}
             if isinstance(stmt, DropDatabaseStatement):
                 self.engine.drop_database(stmt.name)
+                return {}
+            if isinstance(stmt, CreateMeasurementStatement):
+                cdb = stmt.on_db or db
+                if cdb is None:
+                    return {"error": "database required"}
+                if stmt.engine_type == "columnstore":
+                    self.engine.create_columnstore(
+                        cdb, stmt.name, stmt.primary_key, stmt.indexes)
                 return {}
             if isinstance(stmt, (DropMeasurementStatement, DeleteStatement)):
                 return {"error": "not implemented yet"}
@@ -230,42 +238,63 @@ class QueryExecutor:
         t_min, t_max = cond.t_min, cond.t_max
         shards = (db_obj.shards_overlapping(t_min, t_max)
                   if cond.has_time_range else db_obj.all_shards())
-
-        # global tagsets across shards, keyed by tag-value tuple
-        global_groups: dict[tuple, int] = {}
-        per_shard: list[tuple[object, list[tuple[int, int]]]] = []
-        for s in shards:
-            ts = s.index.group_by_tagsets(mst, group_tags, cond.tag_filters)
-            pairs = []
-            for key, sids in ts:
-                gi = global_groups.setdefault(key, len(global_groups))
-                pairs.extend((int(sid), gi) for sid in sids)
-            per_shard.append((s, pairs))
-        G = len(global_groups)
-        if G == 0:
-            return None
-
-        # gather: flat arrays per needed field + times + group ids
         t_lo = None if not cond.has_time_range else t_min
         t_hi = None if not cond.has_time_range else t_max
+
+        global_groups: dict[tuple, int] = {}
         chunks: list[dict] = []
         data_tmin = MAX_TIME
         data_tmax = MIN_TIME
-        for s, pairs in per_shard:
-            for sid, gi in pairs:
-                rec = s.read_series(mst, sid, needed_fields or None,
-                                    t_lo, t_hi)
+
+        if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
+            # column-store path: tags are columns; fragments pruned by
+            # sparse indexes, group ids computed vectorized from tag
+            # columns (ColumnStoreReader + sparse index scan)
+            cs_cond = analyze_condition(stmt.condition, set())
+            scan_cols = sorted(set(needed_fields) | set(group_tags)
+                               | cs_cond.residual_fields())
+            for s in shards:
+                rec = s.scan_columnstore(mst, stmt.condition, scan_cols,
+                                         t_lo, t_hi)
                 if rec is None or rec.num_rows == 0:
                     continue
-                if cond.residual is not None:
-                    mask = eval_residual(cond.residual, rec)
+                if cs_cond.residual is not None:
+                    mask = eval_residual(cs_cond.residual, rec)
                     if not mask.any():
                         continue
                     rec = rec.take(np.nonzero(mask)[0])
+                gi = _group_ids(rec, group_tags, global_groups)
                 data_tmin = min(data_tmin, rec.min_time)
                 data_tmax = max(data_tmax, rec.max_time)
                 chunks.append({"rec": rec, "gi": gi})
-        if not chunks:
+        else:
+            # row-store path: tagsets from the series index, one chunk
+            # per series
+            per_shard: list[tuple[object, list[tuple[int, int]]]] = []
+            for s in shards:
+                ts = s.index.group_by_tagsets(mst, group_tags,
+                                              cond.tag_filters)
+                pairs = []
+                for key, sids in ts:
+                    gi = global_groups.setdefault(key, len(global_groups))
+                    pairs.extend((int(sid), gi) for sid in sids)
+                per_shard.append((s, pairs))
+            for s, pairs in per_shard:
+                for sid, gi in pairs:
+                    rec = s.read_series(mst, sid, needed_fields or None,
+                                        t_lo, t_hi)
+                    if rec is None or rec.num_rows == 0:
+                        continue
+                    if cond.residual is not None:
+                        mask = eval_residual(cond.residual, rec)
+                        if not mask.any():
+                            continue
+                        rec = rec.take(np.nonzero(mask)[0])
+                    data_tmin = min(data_tmin, rec.min_time)
+                    data_tmax = max(data_tmax, rec.max_time)
+                    chunks.append({"rec": rec, "gi": gi})
+        G = len(global_groups)
+        if not chunks or G == 0:
             return None
 
         # window layout
@@ -392,20 +421,48 @@ class QueryExecutor:
         t_hi = None if not cond.has_time_range else t_max
 
         groups: dict[tuple, list] = {}
-        for s in shards:
-            for key, sids in s.index.group_by_tagsets(
-                    mst, group_tags, cond.tag_filters):
-                for sid in sids.tolist():
-                    rec = s.read_series(mst, sid, scan_names, t_lo, t_hi)
-                    if rec is None or rec.num_rows == 0:
+        if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
+            cs_cond = analyze_condition(stmt.condition, set())
+            scan_cols = sorted(set(scan_names) | set(group_tags)
+                               | set(n for n in sel_names if n in tag_keys)
+                               | cs_cond.residual_fields())
+            global_groups: dict[tuple, int] = {}
+            for s in shards:
+                rec = s.scan_columnstore(mst, stmt.condition, scan_cols,
+                                         t_lo, t_hi)
+                if rec is None or rec.num_rows == 0:
+                    continue
+                if cs_cond.residual is not None:
+                    mask = eval_residual(cs_cond.residual, rec)
+                    if not mask.any():
                         continue
-                    if cond.residual is not None:
-                        mask = eval_residual(cond.residual, rec)
-                        if not mask.any():
+                    rec = rec.take(np.nonzero(mask)[0])
+                gi = _group_ids(rec, group_tags, global_groups)
+                key_of = {gid: key for key, gid in global_groups.items()}
+                # one argsort pass splits rows into per-group runs
+                order = np.argsort(gi, kind="stable")
+                bounds = np.nonzero(np.diff(gi[order]))[0] + 1
+                for run in np.split(order, bounds):
+                    key = key_of[int(gi[run[0]])]
+                    sub = rec.take(run)
+                    tags = dict(zip(group_tags, key))
+                    groups.setdefault(key, []).append((tags, sub))
+        else:
+            for s in shards:
+                for key, sids in s.index.group_by_tagsets(
+                        mst, group_tags, cond.tag_filters):
+                    for sid in sids.tolist():
+                        rec = s.read_series(mst, sid, scan_names,
+                                            t_lo, t_hi)
+                        if rec is None or rec.num_rows == 0:
                             continue
-                        rec = rec.take(np.nonzero(mask)[0])
-                    groups.setdefault(key, []).append(
-                        (s.index.tags_of(sid), rec))
+                        if cond.residual is not None:
+                            mask = eval_residual(cond.residual, rec)
+                            if not mask.any():
+                                continue
+                            rec = rec.take(np.nonzero(mask)[0])
+                        groups.setdefault(key, []).append(
+                            (s.index.tags_of(sid), rec))
 
         series_out = []
         for key in sorted(groups):
@@ -415,10 +472,13 @@ class QueryExecutor:
                 for i in range(rec.num_rows):
                     row = [int(rec.times[i])]
                     for name in sel_names:
+                        col = rec.column(name)
                         if name in tag_keys:
-                            row.append(tags.get(name))
+                            # column-store records carry tags as columns;
+                            # row-store series fall back to the series tags
+                            row.append(col.get(i) if col is not None
+                                       else tags.get(name))
                         else:
-                            col = rec.column(name)
                             row.append(None if col is None else col.get(i))
                     rows.append(row)
             rows.sort(key=lambda r: r[0], reverse=stmt.order_desc)
@@ -638,6 +698,41 @@ def finalize_partials(stmt, mst: str, aggs: list[AggItem],
 
 
 # --------------------------------------------------------------- helpers
+
+def _group_ids(rec, group_tags: list[str],
+               global_groups: dict[tuple, int]) -> np.ndarray:
+    """Per-row group ids from tag COLUMNS (column-store group-by): each tag
+    column dictionary-encodes to codes, codes combine mixed-radix, unique
+    combined codes register in global_groups. This is the device-friendly
+    replacement of per-series tagset iteration — group keys become dense
+    int ids in one vectorized pass."""
+    n = rec.num_rows
+    if not group_tags:
+        gi = global_groups.setdefault((), 0)
+        return np.full(n, gi, dtype=np.int64)
+    per_col_vals = []
+    codes = None
+    for t in group_tags:
+        col = rec.column(t)
+        if col is None:
+            vals = np.full(n, "", dtype=object)
+        elif col.is_string_like():
+            vals = np.array([s if s is not None else ""
+                             for s in col.to_strings()], dtype=object)
+        else:
+            vals = np.array([str(v) for v in col.values], dtype=object)
+        per_col_vals.append(vals)
+        u, inv = np.unique(vals, return_inverse=True)
+        codes = inv if codes is None else codes * len(u) + inv
+    _, first_idx, inv2 = np.unique(codes, return_index=True,
+                                   return_inverse=True)
+    lut = np.empty(len(first_idx), dtype=np.int64)
+    for k, ri in enumerate(first_idx):
+        key = tuple(str(per_col_vals[j][ri])
+                    for j in range(len(group_tags)))
+        lut[k] = global_groups.setdefault(key, len(global_groups))
+    return lut[inv2]
+
 
 def _series(name: str, columns: list[str], values: list) -> dict:
     return {"series": [{"name": name, "columns": columns,

@@ -24,10 +24,11 @@ from __future__ import annotations
 import re
 from datetime import datetime, timezone
 
-from .ast import (BinaryExpr, Call, CreateDatabaseStatement, DeleteStatement,
-                  Dimension, DropDatabaseStatement, DropMeasurementStatement,
-                  FieldRef, Literal, SelectField, SelectStatement,
-                  ShowStatement, Wildcard)
+from .ast import (BinaryExpr, Call, CreateDatabaseStatement,
+                  CreateMeasurementStatement, DeleteStatement, Dimension,
+                  DropDatabaseStatement, DropMeasurementStatement, FieldRef,
+                  Literal, SelectField, SelectStatement, ShowStatement,
+                  Wildcard)
 
 
 class ParseError(Exception):
@@ -202,6 +203,8 @@ class Parser:
             return self.parse_show()
         if u == "CREATE":
             self.lx.next()
+            if self._kw("MEASUREMENT"):
+                return self._parse_create_measurement()
             self._expect_kw("DATABASE")
             return CreateDatabaseStatement(self._ident())
         if u == "DROP":
@@ -219,6 +222,25 @@ class Parser:
                 stmt.condition = self.parse_expr()
             return stmt
         raise ParseError(f"unsupported statement starting {v!r} at {p}")
+
+    def _parse_create_measurement(self):
+        stmt = CreateMeasurementStatement(self._ident())
+        if self._kw("ON"):
+            stmt.on_db = self._ident()
+        if self._kw("WITH"):
+            if self._kw("ENGINETYPE"):
+                self._expect_op("=")
+                stmt.engine_type = self._ident().lower()
+            if self._kw("PRIMARYKEY"):
+                stmt.primary_key.append(self._ident())
+                while self._op(","):
+                    stmt.primary_key.append(self._ident())
+            while self._kw("INDEX"):
+                kind = self._ident().lower()
+                stmt.indexes[self._ident()] = kind
+                while self._op(","):
+                    stmt.indexes[self._ident()] = kind
+        return stmt
 
     def parse_select(self) -> SelectStatement:
         self._expect_kw("SELECT")

@@ -1,0 +1,358 @@
+"""Column-store measurement files with sparse-index fragment pruning.
+
+Role of reference engine/immutable/colstore/ (primary-key files, per-block
+index, writer/reader) + engine/column_store_reader.go (fragment-pruned scan
+→ Record). The reference's column-store engine stores a whole measurement
+(tags materialized as columns) sorted by a user-declared primary key, in
+fixed-size row fragments, with sparse indexes selecting fragments at scan
+time (engine/index/sparseindex/).
+
+TPU-first deviations:
+- Fragments are the device block unit: fixed FRAGMENT_ROWS rows so pruned
+  scans produce statically-shaped padded batches for the segment-reduce
+  kernels (no ragged decode).
+- Tag columns are additionally dictionary-encoded at write time; the scan
+  can return int32 codes per tag column — group-by keys go to the device
+  as dense ids, never strings.
+- The primary-key "index" IS the min-max sparse index of the pk columns
+  (first-fragment-row files in the reference collapse into this).
+
+File layout ("OGCF"):
+  [magic u32 | version u32]
+  per fragment × column: [value block][validity block]  (encoding.blocks)
+  per indexed column: packed SparseIndex blob
+  footer JSON (schema, fragments, offsets, pk, dicts) | footer_len u32 | magic
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+import numpy as np
+
+from .. import encoding as enc
+from ..index.sparse import (KIND_BLOOM, KIND_MINMAX, KIND_SET,
+                            KIND_TEXT_BLOOM, SparseIndex, SparseIndexBuilder)
+from ..query.ast import BinaryExpr, Call, FieldRef, Literal
+from ..record import ColVal, DataType, Record, Schema
+
+MAGIC = 0x4F474346  # "OGCF"
+VERSION = 1
+FRAGMENT_ROWS = 4096
+
+_KIND_NAMES = {"minmax": KIND_MINMAX, "set": KIND_SET, "bloom": KIND_BLOOM,
+               "text": KIND_TEXT_BLOOM}
+
+
+def _encode_col_block(col: ColVal, lo: int, hi: int) -> bytes:
+    t = col.type
+    if t == DataType.TIME:
+        return enc.encode_time_block(col.values[lo:hi])
+    if t == DataType.INTEGER:
+        return enc.encode_integer_block(col.values[lo:hi])
+    if t == DataType.FLOAT:
+        return enc.encode_float_block(col.values[lo:hi])
+    if t == DataType.BOOLEAN:
+        return enc.encode_boolean_block(col.values[lo:hi])
+    sub = col.slice(lo, hi)
+    return enc.encode_string_block(sub.offsets, sub.data)
+
+
+def _decode_col_block(t: DataType, buf, n: int) -> ColVal:
+    if t == DataType.TIME:
+        return ColVal(t, enc.decode_time_block(buf, n))
+    if t == DataType.INTEGER:
+        return ColVal(t, enc.decode_integer_block(buf, n))
+    if t == DataType.FLOAT:
+        return ColVal(t, enc.decode_float_block(buf, n))
+    if t == DataType.BOOLEAN:
+        return ColVal(t, enc.decode_boolean_block(buf, n))
+    offsets, data = enc.decode_string_block(buf)
+    return ColVal(t, offsets=offsets, data=data)
+
+
+class ColumnStoreWriter:
+    """One measurement's data -> one immutable column-store file.
+
+    rec: full measurement Record (tag columns as STRING, fields, time).
+    primary_key: column names data is sorted by (time appended implicitly).
+    indexes: extra {column: kind} sparse indexes ('minmax'|'set'|'bloom'|
+    'text'); pk columns and time always get minmax.
+    """
+
+    def __init__(self, path: str, primary_key: list[str],
+                 indexes: dict[str, str] | None = None,
+                 fragment_rows: int = FRAGMENT_ROWS,
+                 tag_columns: list[str] | None = None):
+        self.path = path
+        self.primary_key = list(primary_key)
+        self.indexes = dict(indexes or {})
+        self.fragment_rows = fragment_rows
+        # which columns are tags (series identity): recorded in the footer
+        # so readers can dedup duplicate (tagset, time) rows across files
+        self.tag_columns = list(tag_columns or [])
+
+    def write(self, rec: Record) -> None:
+        n = rec.num_rows
+        if n == 0:
+            raise ValueError("empty record")
+        rec = _sort_by_pk(rec, self.primary_key)
+
+        index_cols: dict[str, int] = {"time": KIND_MINMAX}
+        for pk in self.primary_key:
+            index_cols[pk] = KIND_MINMAX
+        for c, kind in self.indexes.items():
+            k = _KIND_NAMES.get(kind)
+            if k is None:
+                raise ValueError(f"unknown sparse index kind {kind!r}")
+            index_cols[c] = k  # user kind wins over the pk default
+
+        builders = {}
+        for cname, kind in index_cols.items():
+            if rec.schema.field(cname) is None:
+                continue
+            builders[cname] = SparseIndexBuilder(kind, cname)
+
+        f = open(self.path + ".tmp", "wb")
+        try:
+            f.write(struct.pack("<II", MAGIC, VERSION))
+            pos = 8
+            frags = []
+            fr = self.fragment_rows
+            for lo in range(0, n, fr):
+                hi = min(lo + fr, n)
+                cols_meta = []
+                for fld, col in zip(rec.schema, rec.cols):
+                    data = _encode_col_block(col, lo, hi)
+                    vb = enc.encode_validity(col.valid[lo:hi])
+                    f.write(data)
+                    f.write(vb)
+                    cols_meta.append([pos, len(data), pos + len(data),
+                                      len(vb)])
+                    pos += len(data) + len(vb)
+                    b = builders.get(fld.name)
+                    if b is not None:
+                        b.add_fragment(_index_values(col, lo, hi),
+                                       col.valid[lo:hi])
+                frags.append({"rows": hi - lo, "cols": cols_meta})
+
+            index_meta = {}
+            for cname, b in builders.items():
+                blob = b.finish().pack()
+                f.write(blob)
+                index_meta[cname] = [pos, len(blob)]
+                pos += len(blob)
+
+            footer = {
+                "schema": [[fld.name, int(fld.type)] for fld in rec.schema],
+                "n_rows": n,
+                "fragment_rows": fr,
+                "fragments": frags,
+                "indexes": index_meta,
+                "primary_key": self.primary_key,
+                "tag_columns": self.tag_columns,
+            }
+            fb = json.dumps(footer, separators=(",", ":")).encode()
+            f.write(fb)
+            f.write(struct.pack("<II", len(fb), MAGIC))
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            os.replace(self.path + ".tmp", self.path)
+        except Exception:
+            f.close()
+            if os.path.exists(self.path + ".tmp"):
+                os.unlink(self.path + ".tmp")
+            raise
+
+
+def _index_values(col: ColVal, lo: int, hi: int):
+    if col.is_string_like():
+        return col.slice(lo, hi).to_strings()
+    return col.values[lo:hi]
+
+
+def _sort_by_pk(rec: Record, pk: list[str]) -> Record:
+    """Stable sort by (pk columns..., time)."""
+    keys = [rec.times]
+    for name in reversed(pk):
+        col = rec.column(name)
+        if col is None:
+            raise ValueError(f"primary key column {name!r} not in record")
+        if col.is_string_like():
+            keys.append(np.array(
+                [s if s is not None else "" for s in col.to_strings()]))
+        else:
+            keys.append(col.values)
+    order = np.lexsort(keys)
+    if (order == np.arange(len(order))).all():
+        return rec
+    return rec.take(order)
+
+
+class ColumnStoreReader:
+    """Fragment-pruned reads of one column-store file. The file is mmapped
+    so concurrent queries can read without a shared-seek race (the HTTP
+    layer is threaded)."""
+
+    def __init__(self, path: str):
+        import mmap
+        self.path = path
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        mm = self._mm
+        if len(mm) < 16:
+            raise ValueError(f"bad column-store file {path}")
+        data_magic, ver = struct.unpack_from("<II", mm, 0)
+        if data_magic != MAGIC or ver != VERSION:
+            raise ValueError(f"bad column-store file {path}")
+        flen, tail_magic = struct.unpack_from("<II", mm, len(mm) - 8)
+        if tail_magic != MAGIC:
+            raise ValueError(f"corrupt column-store trailer in {path}")
+        self.footer = json.loads(bytes(mm[len(mm) - 8 - flen:len(mm) - 8]))
+        self.schema = Schema([_mkfield(n, t)
+                              for n, t in self.footer["schema"]])
+        self._indexes: dict[str, SparseIndex] = {}
+        self._idx_lock = threading.Lock()
+
+    @property
+    def n_rows(self) -> int:
+        return self.footer["n_rows"]
+
+    @property
+    def n_fragments(self) -> int:
+        return len(self.footer["fragments"])
+
+    def index(self, column: str) -> SparseIndex | None:
+        with self._idx_lock:
+            idx = self._indexes.get(column)
+            if idx is None:
+                meta = self.footer["indexes"].get(column)
+                if meta is None:
+                    return None
+                off, size = meta
+                idx = self._indexes[column] = SparseIndex.unpack(
+                    self._mm[off:off + size])
+        return idx
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self, expr) -> np.ndarray:
+        """Fragment mask for an AND-connected condition tree. Conservative:
+        anything not understood prunes nothing."""
+        mask = np.ones(self.n_fragments, dtype=bool)
+        if expr is None:
+            return mask
+        for leaf in _and_leaves(expr):
+            mask &= self._prune_leaf(leaf)
+        return mask
+
+    def _prune_leaf(self, e) -> np.ndarray:
+        ones = np.ones(self.n_fragments, dtype=bool)
+        # match(col, 'text') full-text predicate
+        if (isinstance(e, Call) and e.func == "match" and len(e.args) == 2
+                and isinstance(e.args[0], FieldRef)
+                and isinstance(e.args[1], Literal)):
+            idx = self.index(e.args[0].name)
+            return idx.prune_match(e.args[1].value) if idx is not None \
+                else ones
+        if not isinstance(e, BinaryExpr):
+            return ones
+        lhs, op, rhs = e.lhs, e.op, e.rhs
+        if isinstance(rhs, FieldRef) and isinstance(lhs, Literal):
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if not (isinstance(lhs, FieldRef) and isinstance(rhs, Literal)):
+            return ones
+        if lhs.name == "time":
+            # time pruning happens via prune_range on the time index with
+            # integer nanoseconds (scan_columnstore) — a raw literal here
+            # may be an RFC3339 string that must not compare lexically
+            return ones
+        idx = self.index(lhs.name)
+        if idx is None:
+            return ones
+        v = rhs.value
+        if op == "=":
+            return idx.prune_eq(v)
+        if op == "<":
+            return idx.prune_range(hi=v, hi_inc=False)
+        if op == "<=":
+            return idx.prune_range(hi=v)
+        if op == ">":
+            return idx.prune_range(lo=v, lo_inc=False)
+        if op == ">=":
+            return idx.prune_range(lo=v)
+        return ones
+
+    # -------------------------------------------------------------- reads
+
+    def read(self, columns: list[str] | None = None,
+             mask: np.ndarray | None = None) -> Record:
+        """Decode surviving fragments, concatenated into one Record."""
+        names = ([f.name for f in self.schema] if columns is None
+                 else [c for c in columns if self.schema.field(c)])
+        if columns is not None and "time" not in names:
+            names.append("time")
+        col_idx = [self.schema.field_index(c) for c in names]
+        out_schema = Schema([self.schema.fields[i] for i in col_idx])
+        out_cols = [None] * len(col_idx)
+        frags = self.footer["fragments"]
+        sel = range(len(frags)) if mask is None else np.nonzero(mask)[0]
+        for fi in sel:
+            fr = frags[fi]
+            n = fr["rows"]
+            for oi, ci in enumerate(col_idx):
+                off, size, voff, vsize = fr["cols"][ci]
+                data = memoryview(self._mm)[off:off + size]
+                vb = memoryview(self._mm)[voff:voff + vsize]
+                cv = _decode_col_block(out_schema.fields[oi].type, data, n)
+                cv.valid = enc.decode_validity(vb, n)
+                if out_cols[oi] is None:
+                    out_cols[oi] = cv
+                else:
+                    out_cols[oi].append(cv)
+        if not len(sel):
+            return Record(out_schema,
+                          [_empty(f.type) for f in out_schema.fields])
+        return Record(out_schema, out_cols)
+
+    def scan(self, expr=None, columns: list[str] | None = None) -> Record:
+        """prune + read (the ColumnStoreReader transform's Work loop,
+        column_store_reader.go:346 — residual row filtering happens in the
+        executor, on device where possible)."""
+        return self.read(columns, self.prune(expr))
+
+    def close(self) -> None:
+        self._mm.close()
+        self._f.close()
+
+    def __del__(self):
+        try:
+            self._mm.close()
+            self._f.close()
+        except Exception:
+            pass
+
+
+def _empty(t: DataType) -> ColVal:
+    if t in (DataType.STRING,):
+        return ColVal(t, offsets=np.zeros(1, dtype=np.int32), data=b"")
+    return ColVal(t, np.empty(0, dtype=t.numpy_dtype),
+                  np.empty(0, dtype=np.bool_))
+
+
+def _mkfield(name: str, t: int):
+    from ..record.schema import Field
+    return Field(name, DataType(t))
+
+
+def _and_leaves(expr):
+    if isinstance(expr, BinaryExpr) and expr.op in ("and", "AND"):
+        yield from _and_leaves(expr.lhs)
+        yield from _and_leaves(expr.rhs)
+    else:
+        yield expr

@@ -20,7 +20,7 @@ import numpy as np
 from ..index import TagFilter
 from ..record import Record
 from ..utils import get_logger
-from ..utils.errors import ErrDatabaseNotFound
+from ..utils.errors import ErrDatabaseNotFound, ErrQueryError
 from .rows import PointRow
 from .shard import Shard
 from .tssp import SEGMENT_SIZE
@@ -36,6 +36,7 @@ class EngineOptions:
     shard_duration: int = DEFAULT_SHARD_DURATION
     flush_bytes: int = 256 * 1024 * 1024
     wal_sync: bool = False
+    wal_compression: str = "zstd"     # "zstd" | "lz4" (native codec)
     segment_size: int = SEGMENT_SIZE
 
 
@@ -47,7 +48,44 @@ class Database:
         self.shards: dict[int, Shard] = {}  # key: shard-group index
         self._lock = threading.RLock()
         os.makedirs(path, exist_ok=True)
+        # column-store measurement declarations, shared (by reference)
+        # with every shard of this db; persisted so reopen keeps the
+        # engine type (reference: measurement EngineType in ts-meta)
+        self._cs_path = os.path.join(path, "colstore.json")
+        self.cs_options: dict[str, dict] = {}
+        if os.path.exists(self._cs_path):
+            import json
+            with open(self._cs_path) as f:
+                self.cs_options.update(json.load(f))
         self._load()
+
+    def set_columnstore(self, mst: str, primary_key: list[str],
+                        indexes: dict[str, str] | None = None,
+                        fragment_rows: int = 4096) -> None:
+        """Declare a measurement column-store. Must happen before its
+        first flush: existing TSSP data is not converted, so the DDL is
+        rejected once row-store files exist (they would become invisible
+        to the column-store query path)."""
+        import json
+        with self._lock:
+            if mst not in self.cs_options:
+                for s in self.all_shards():
+                    if s._files.get(mst):
+                        raise ErrQueryError(
+                            f"measurement {mst!r} already has row-store "
+                            "data; cannot convert to columnstore")
+            self.cs_options[mst] = {
+                "primary_key": list(primary_key),
+                "indexes": dict(indexes or {}),
+                "fragment_rows": fragment_rows,
+            }
+            tmp = self._cs_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.cs_options, f)
+            os.replace(tmp, self._cs_path)
+
+    def is_columnstore(self, mst: str) -> bool:
+        return mst in self.cs_options
 
     def _load(self) -> None:
         for fn in sorted(os.listdir(self.path)):
@@ -63,7 +101,9 @@ class Database:
                      end_time=(gi + 1) * sd,
                      flush_bytes=self.opts.flush_bytes,
                      wal_sync=self.opts.wal_sync,
-                     segment_size=self.opts.segment_size)
+                     wal_compression=self.opts.wal_compression,
+                     segment_size=self.opts.segment_size,
+                     cs_options=self.cs_options)
 
     def shard_for_time(self, t: int, create: bool = True) -> Shard | None:
         gi = t // self.opts.shard_duration
@@ -141,6 +181,15 @@ class Engine:
         if db is None:
             raise ErrDatabaseNotFound(f"database not found: {name}")
         return db
+
+    def create_columnstore(self, db_name: str, mst: str,
+                           primary_key: list[str],
+                           indexes: dict[str, str] | None = None,
+                           fragment_rows: int = 4096) -> None:
+        """CREATE MEASUREMENT ... ENGINETYPE columnstore (reference DDL:
+        column-store measurements with PRIMARYKEY + INDEXES)."""
+        self.create_database(db_name).set_columnstore(
+            mst, primary_key, indexes, fragment_rows)
 
     # ---- writes (reference Engine.WriteRows engine/engine.go:881) --------
 

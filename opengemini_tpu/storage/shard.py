@@ -17,10 +17,11 @@ import threading
 import numpy as np
 
 from ..index import SeriesIndex, TagFilter
-from ..record import DataType, Record, merge_sorted_records
+from ..record import ColVal, DataType, Record, Schema, merge_sorted_records
 from ..utils import get_logger
 from ..utils.errors import ErrTypeConflict
-from .memtable import MemTables, field_type_of
+from .colstore import ColumnStoreReader, ColumnStoreWriter
+from .memtable import MemTable, MemTables, field_type_of
 from .rows import PointRow
 from .tssp import TSSPReader, TSSPWriter, SEGMENT_SIZE
 
@@ -34,20 +35,30 @@ class Shard:
                  start_time: int, end_time: int,
                  flush_bytes: int = DEFAULT_FLUSH_BYTES,
                  wal_sync: bool = False,
-                 segment_size: int = SEGMENT_SIZE):
+                 wal_compression: str = "zstd",
+                 segment_size: int = SEGMENT_SIZE,
+                 cs_options: dict | None = None):
         self.path = path
         self.shard_id = shard_id
         self.start_time = start_time
         self.end_time = end_time
         self.flush_bytes = flush_bytes
         self.segment_size = segment_size
+        # {measurement: {"primary_key": [...], "indexes": {col: kind},
+        #  "fragment_rows": int}} — shared dict owned by the Database
+        # (reference: column-store measurements declared in ts-meta,
+        # engine-type dispatch cs_storage.go:42)
+        self.cs_options = cs_options if cs_options is not None else {}
         os.makedirs(path, exist_ok=True)
         os.makedirs(os.path.join(path, "tssp"), exist_ok=True)
+        os.makedirs(os.path.join(path, "colstore"), exist_ok=True)
         self.index = SeriesIndex(os.path.join(path, "series.log"))
         from .wal import WAL
-        self.wal = WAL(os.path.join(path, "wal"), sync=wal_sync)
+        self.wal = WAL(os.path.join(path, "wal"), sync=wal_sync,
+                       compression=wal_compression)
         self.mem = MemTables()
         self._files: dict[str, list[TSSPReader]] = {}
+        self._cs_files: dict[str, list[ColumnStoreReader]] = {}
         self._file_seq = 0
         self._lock = threading.RLock()
         # serializes whole-table file rewrites (compaction, downsample):
@@ -119,6 +130,17 @@ class Shard:
                     TSSPReader(os.path.join(d, fn)))
             except (ValueError, _struct.error, OSError) as e:
                 log.error("skipping corrupt tssp %s: %s", fn, e)
+        cd = os.path.join(self.path, "colstore")
+        for fn in sorted(os.listdir(cd)):
+            if not fn.endswith(".ogcf"):
+                continue
+            mst, seq = fn[:-5].rsplit("_", 1)
+            self._file_seq = max(self._file_seq, int(seq))
+            try:
+                self._cs_files.setdefault(mst, []).append(
+                    ColumnStoreReader(os.path.join(cd, fn)))
+            except (ValueError, _struct.error, OSError, KeyError) as e:
+                log.error("skipping corrupt colstore %s: %s", fn, e)
 
     def _coerce(self, mst: str, fields: dict) -> dict:
         """int→float coercion for fields registered as FLOAT, so memtable
@@ -198,10 +220,27 @@ class Shard:
             snap = self.mem.begin_snapshot()
             try:
                 new_files: list[tuple[str, str]] = []
+                new_cs: list[tuple[str, str]] = []
                 for mst, mt in snap.items():
                     if not mt.series:
                         continue
                     self._file_seq += 1
+                    if mst in self.cs_options:
+                        opt = self.cs_options[mst]
+                        fn = os.path.join(
+                            self.path, "colstore",
+                            f"{mst}_{self._file_seq:06d}.ogcf")
+                        rec = self._materialize_measurement(mst, mt)
+                        if rec is not None and rec.num_rows:
+                            ColumnStoreWriter(
+                                fn, opt.get("primary_key", []),
+                                opt.get("indexes"),
+                                opt.get("fragment_rows") or 4096,
+                                tag_columns=sorted(
+                                    self.index.tag_keys(mst)),
+                            ).write(rec)
+                            new_cs.append((mst, fn))
+                        continue
                     fn = os.path.join(self.path, "tssp",
                                       f"{mst}_{self._file_seq:06d}.tssp")
                     w = TSSPWriter(fn, segment_size=self.segment_size)
@@ -213,6 +252,9 @@ class Shard:
                     new_files.append((mst, fn))
                 for mst, fn in new_files:
                     self._files.setdefault(mst, []).append(TSSPReader(fn))
+                for mst, fn in new_cs:
+                    self._cs_files.setdefault(mst, []).append(
+                        ColumnStoreReader(fn))
                 self.index.flush()
                 self.mem.commit_snapshot()
                 self.wal.remove_upto(sealed_wal)
@@ -224,7 +266,7 @@ class Shard:
 
     def measurements(self) -> list[str]:
         with self._lock:
-            msts = set(self._files)
+            msts = set(self._files) | set(self._cs_files)
         for tbl in self.mem.tables_for_read():
             msts.update(tbl.keys())
         return sorted(msts)
@@ -262,6 +304,104 @@ class Shard:
                     rec = part if rec is None else _merge_parts(rec, part)
         return rec
 
+    # ---- column store ----------------------------------------------------
+
+    def is_columnstore(self, mst: str) -> bool:
+        return mst in self.cs_options
+
+    def _materialize_measurement(self, mst: str,
+                                 mt: "MemTable") -> Record | None:
+        """Whole-measurement Record with tag columns materialized as
+        strings — the column-store flush shape (reference cs_table.go:
+        the cs memtable keeps tags as columns from the start; ours
+        joins them from the series index at flush)."""
+        parts: list[Record] = []
+        for sid in mt.sids():
+            rec = mt.series_record(sid)
+            if rec is None or rec.num_rows == 0:
+                continue
+            tags = self.index.tags_of(sid)
+            n = rec.num_rows
+            fields = list(rec.schema.fields)
+            cols = list(rec.cols)
+            for k in sorted(tags):
+                if rec.schema.field(k) is not None:
+                    raise ErrTypeConflict(
+                        f"tag {k!r} collides with a field name in {mst}")
+                fields.append(_mk_tag_field(k))
+                cols.append(ColVal.from_strings([tags[k]] * n))
+            order = sorted(range(len(fields)),
+                           key=lambda i: (fields[i].name == "time",
+                                          fields[i].name))
+            parts.append(Record(Schema([fields[i] for i in order]),
+                                [cols[i] for i in order]))
+        if not parts:
+            return None
+        return align_concat(parts)
+
+    def scan_columnstore(self, mst: str, expr=None,
+                         columns: list[str] | None = None,
+                         t_min: int | None = None,
+                         t_max: int | None = None) -> Record | None:
+        """Fragment-pruned scan over colstore files + unflushed memtable
+        rows (ColumnStoreReader transform, column_store_reader.go:346).
+        Row-level residual filtering is the caller's job; time range is
+        applied row-level here (fragments are pruned by the time index
+        first)."""
+        with self._lock:
+            files = list(self._cs_files.get(mst, ()))
+        tag_cols = set(self.index.tag_keys(mst))
+        for f in files:
+            tag_cols.update(f.footer.get("tag_columns", ()))
+        # tag columns always scanned: duplicate (tagset, time) rows across
+        # files/memtable must collapse with later-writes-win, like the
+        # row-store merge (_merge_parts)
+        scan_cols = (None if columns is None
+                     else sorted(set(columns) | tag_cols))
+        parts: list[Record] = []
+        for f in files:
+            mask = f.prune(expr)
+            tidx = f.index("time")
+            if tidx is not None and (t_min is not None or t_max is not None):
+                mask &= tidx.prune_range(lo=t_min, hi=t_max)
+            if not mask.any():
+                continue
+            rec = f.read(scan_cols, mask)
+            if rec.num_rows:
+                parts.append(rec)
+        for tbl in self.mem.tables_for_read()[::-1]:  # snapshot older first
+            mt = tbl.get(mst)
+            if mt is not None and mt.series:
+                rec = self._materialize_measurement(mst, mt)
+                if rec is not None and rec.num_rows:
+                    if scan_cols is not None:
+                        keep = [c for c in scan_cols
+                                if rec.schema.field(c) is not None]
+                        if "time" not in keep:
+                            keep.append("time")
+                        rec = _project(rec, keep)
+                    parts.append(rec)
+        if not parts:
+            return None
+        rec = align_concat(parts)
+        if len(parts) > 1:
+            rec = _dedup_last_wins(rec, sorted(tag_cols))
+        if t_min is not None or t_max is not None:
+            times = rec.times
+            m = np.ones(len(times), dtype=bool)
+            if t_min is not None:
+                m &= times >= t_min
+            if t_max is not None:
+                m &= times <= t_max
+            if not m.all():
+                rec = rec.take(np.nonzero(m)[0])
+        if columns is not None:
+            keep = [c for c in columns if rec.schema.field(c) is not None]
+            if "time" not in keep:
+                keep.append("time")
+            rec = _project(rec, keep)
+        return rec if rec.num_rows else None
+
     def close(self, close_files: bool = True) -> None:
         """close_files=False leaves TSSP mmaps open for in-flight queries
         (retention drop path); they close when the last reference drops."""
@@ -270,6 +410,9 @@ class Shard:
             self.index.close()
             if close_files:
                 for files in self._files.values():
+                    for f in files:
+                        f.close()
+                for files in self._cs_files.values():
                     for f in files:
                         f.close()
 
@@ -284,6 +427,76 @@ def _project(rec: Record, columns: list[str]) -> Record:
     fields.append(rec.schema.fields[ti])
     cols.append(rec.cols[ti])
     return Record(Schema(fields), cols)
+
+
+def _dedup_last_wins(rec: Record, tag_cols: list[str]) -> Record:
+    """Collapse duplicate (tagset, time) rows keeping the latest-appended
+    one (column-store analog of _merge_parts' newest-wins rule; parts are
+    appended oldest-file → newest-memtable)."""
+    n = rec.num_rows
+    codes = np.zeros(n, dtype=np.int64)
+    for t in tag_cols:
+        col = rec.column(t)
+        if col is None:
+            continue
+        vals = np.array([s if s is not None else ""
+                         for s in col.to_strings()], dtype=object)
+        _u, inv = np.unique(vals, return_inverse=True)
+        # re-compact after each column: keeps codes < n (no radix overflow)
+        codes = np.unique(codes * (inv.max() + 1) + inv,
+                          return_inverse=True)[1]
+    times = rec.times
+    order = np.lexsort((np.arange(n), times, codes))
+    same = ((codes[order][1:] == codes[order][:-1])
+            & (times[order][1:] == times[order][:-1]))
+    keep = np.concatenate([~same, [True]])
+    if keep.all():
+        return rec
+    return rec.take(np.sort(order[keep]))
+
+
+def _mk_tag_field(name: str):
+    from ..record.schema import Field
+    return Field(name, DataType.STRING)
+
+
+def align_concat(parts: list[Record]) -> Record:
+    """Concatenate Records with differing schemas: union of columns
+    (canonical order — sorted, time last), missing columns null-filled.
+    No time sort — callers window by absolute time or sort themselves."""
+    if len(parts) == 1:
+        return parts[0]
+    types: dict[str, DataType] = {}
+    for p in parts:
+        for f in p.schema:
+            if f.name == "time":
+                continue
+            cur = types.get(f.name)
+            if cur is None or (cur != f.type and f.type == DataType.FLOAT):
+                types[f.name] = f.type
+    schema = Schema.from_pairs(sorted(types.items()))
+    cols = []
+    for f in schema:
+        acc: ColVal | None = None
+        for p in parts:
+            src = p.column(f.name)
+            n = p.num_rows
+            if src is None or (f.name != "time" and src.type != f.type
+                               and not (f.type == DataType.FLOAT
+                                        and src.type == DataType.INTEGER)):
+                piece = ColVal.nulls(f.type, n)
+            elif f.type == DataType.FLOAT and src.type == DataType.INTEGER:
+                piece = ColVal(DataType.FLOAT,
+                               src.values.astype(np.float64),
+                               src.valid.copy())
+            else:
+                piece = src.slice(0, n)  # copy so append can't alias src
+            if acc is None:
+                acc = piece
+            else:
+                acc.append(piece)
+        cols.append(acc)
+    return Record(schema, cols)
 
 
 def _merge_parts(a: Record, b: Record) -> Record:

@@ -185,6 +185,8 @@ class WAL:
 
     def close(self) -> None:
         with self._lock:
+            if self._f.closed:
+                return
             self._f.flush()
             os.fsync(self._f.fileno())
             self._f.close()

@@ -1,0 +1,346 @@
+"""Column-store engine: sparse indexes, OGCF files, fragment pruning, and
+the end-to-end columnstore query path (SURVEY §2.1 colstore + sparseindex
+rows; reference engine/immutable/colstore/, engine/index/sparseindex/,
+engine/column_store_reader.go)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.index.sparse import (KIND_BLOOM, KIND_MINMAX, KIND_SET,
+                                         KIND_TEXT_BLOOM, SparseIndex,
+                                         SparseIndexBuilder)
+from opengemini_tpu.query.influxql import parse_query
+from opengemini_tpu.record import ColVal, DataType, Record, Schema
+from opengemini_tpu.storage.colstore import (ColumnStoreReader,
+                                             ColumnStoreWriter)
+
+
+def _mk_record(n=10_000, hosts=8):
+    rng = np.random.default_rng(3)
+    schema = Schema.from_pairs([("usage", DataType.FLOAT),
+                                ("region", DataType.STRING),
+                                ("host", DataType.STRING)])
+    host = [f"server{i % hosts:02d}" for i in range(n)]
+    region = ["east" if i % 2 == 0 else "west" for i in range(n)]
+    usage = rng.uniform(0, 100, n)
+    times = np.arange(n, dtype=np.int64) * 1_000_000_000
+    cols = []
+    for f in schema:
+        if f.name == "host":
+            cols.append(ColVal.from_strings(host))
+        elif f.name == "region":
+            cols.append(ColVal.from_strings(region))
+        elif f.name == "usage":
+            cols.append(ColVal(DataType.FLOAT, usage))
+        else:
+            cols.append(ColVal(DataType.TIME, times))
+    return Record(schema, cols), usage
+
+
+class TestSparseIndex:
+    def test_minmax_prune(self):
+        b = SparseIndexBuilder(KIND_MINMAX, "v")
+        b.add_fragment(np.array([1.0, 5.0]))
+        b.add_fragment(np.array([10.0, 20.0]))
+        b.add_fragment(np.array([]))
+        idx = b.finish()
+        np.testing.assert_array_equal(idx.prune_eq(4.0),
+                                      [True, False, False])
+        np.testing.assert_array_equal(idx.prune_range(lo=6.0),
+                                      [False, True, False])
+        np.testing.assert_array_equal(idx.prune_range(hi=5.0, hi_inc=False),
+                                      [True, False, False])
+
+    def test_set_prune_and_overflow(self):
+        b = SparseIndexBuilder(KIND_SET, "host")
+        b.add_fragment(["a", "b"])
+        b.add_fragment([f"h{i}" for i in range(500)])  # overflows cap
+        idx = b.finish()
+        np.testing.assert_array_equal(idx.prune_eq("a"), [True, True])
+        np.testing.assert_array_equal(idx.prune_eq("zz"), [False, True])
+
+    def test_bloom_prune(self):
+        b = SparseIndexBuilder(KIND_BLOOM, "host")
+        b.add_fragment([f"host{i}" for i in range(1000)])
+        b.add_fragment([f"other{i}" for i in range(1000)])
+        idx = b.finish()
+        assert idx.prune_eq("host500")[0]
+        # false-positive rate should keep most absent keys pruned
+        misses = sum(idx.prune_eq(f"absent{i}")[0] for i in range(200))
+        assert misses < 20
+
+    def test_text_bloom_match(self):
+        b = SparseIndexBuilder(KIND_TEXT_BLOOM, "msg")
+        b.add_fragment(["error: disk full", "GET /write 204"])
+        b.add_fragment(["all good here", "nothing to see"])
+        idx = b.finish()
+        np.testing.assert_array_equal(idx.prune_match("disk ERROR"),
+                                      [True, False])
+
+    @pytest.mark.parametrize("kind,data", [
+        (KIND_MINMAX, np.array([1.5, 2.5])),
+        (KIND_MINMAX, ["aa", "zz"]),
+        (KIND_SET, ["x", "y"]),
+        (KIND_BLOOM, ["k1", "k2"]),
+    ])
+    def test_pack_roundtrip(self, kind, data):
+        b = SparseIndexBuilder(kind, "c")
+        b.add_fragment(data)
+        idx = b.finish()
+        idx2 = SparseIndex.unpack(idx.pack())
+        assert idx2.kind == kind and idx2.column == "c"
+        first = data[0] if not isinstance(data, np.ndarray) else data[0]
+        np.testing.assert_array_equal(idx2.prune_eq(first),
+                                      idx.prune_eq(first))
+
+
+class TestColstoreFile:
+    def test_roundtrip_and_pk_sort(self, tmp_path):
+        rec, usage = _mk_record()
+        path = str(tmp_path / "m.ogcf")
+        ColumnStoreWriter(path, ["host"], {"region": "set"},
+                          fragment_rows=512).write(rec)
+        r = ColumnStoreReader(path)
+        assert r.n_rows == rec.num_rows
+        out = r.read()
+        # sorted by (host, time): host column must be non-decreasing
+        hosts = out.column("host").to_strings()
+        assert hosts == sorted(hosts)
+        # content preserved (sum invariant under permutation)
+        assert np.isclose(out.column("usage").values.sum(), usage.sum())
+        r.close()
+
+    def test_prune_by_pk(self, tmp_path):
+        rec, _ = _mk_record(n=8192, hosts=8)
+        path = str(tmp_path / "m.ogcf")
+        ColumnStoreWriter(path, ["host"], fragment_rows=1024).write(rec)
+        r = ColumnStoreReader(path)
+        expr = parse_query("SELECT v FROM m WHERE host = 'server03'"
+                           )[0].condition
+        mask = r.prune(expr)
+        # 8 hosts × 1024 rows each over 8 fragments sorted by host:
+        # exactly one fragment can contain server03
+        assert mask.sum() == 1
+        sub = r.read(["host", "usage"], mask)
+        hosts = set(sub.column("host").to_strings())
+        assert "server03" in hosts and len(hosts) <= 2
+        r.close()
+
+    def test_prune_time_and_field(self, tmp_path):
+        rec, _ = _mk_record(n=4096)
+        path = str(tmp_path / "m.ogcf")
+        ColumnStoreWriter(path, [], indexes={"usage": "minmax"},
+                          fragment_rows=256).write(rec)
+        r = ColumnStoreReader(path)
+        tidx = r.index("time")
+        m = tidx.prune_range(lo=0, hi=255 * 1_000_000_000)
+        assert m.sum() == 1
+        expr = parse_query("SELECT v FROM m WHERE usage > 200")[0].condition
+        assert r.prune(expr).sum() == 0  # usage max is 100
+        r.close()
+
+
+class TestColumnstoreEngine:
+    @pytest.fixture()
+    def engine(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        eng = Engine(str(tmp_path / "data"), EngineOptions())
+        yield eng
+        eng.close()
+
+    def _write(self, eng, n=3000):
+        from opengemini_tpu.storage.rows import PointRow
+        eng.create_columnstore("db", "cpu", ["hostname"],
+                               {"hostname": "bloom"})
+        rows = []
+        for i in range(n):
+            rows.append(PointRow(
+                "cpu", {"hostname": f"host_{i % 10}", "region": "r1"},
+                {"usage_user": float(i % 100), "usage_system": float(i % 7)},
+                i * 1_000_000_000))
+        eng.write_points("db", rows)
+        return rows
+
+    def test_flush_writes_ogcf(self, engine):
+        self._write(engine)
+        engine.flush_all()
+        shards = engine.database("db").all_shards()
+        csf = [f for s in shards for fl in s._cs_files.values() for f in fl]
+        assert csf, "flush produced no column-store files"
+        assert all(f.path.endswith(".ogcf") for f in csf)
+        # tags materialized as string columns
+        rec = csf[0].read()
+        assert rec.column("hostname") is not None
+        assert rec.column("usage_user") is not None
+
+    def test_query_agg_matches_rowstore(self, engine, tmp_path):
+        """The same data through columnstore and row-store paths must
+        produce identical aggregation results."""
+        from opengemini_tpu.query.executor import QueryExecutor
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        self._write(engine)
+        engine.flush_all()
+
+        eng2 = Engine(str(tmp_path / "data2"), EngineOptions())
+        from opengemini_tpu.storage.rows import PointRow
+        rows = []
+        for i in range(3000):
+            rows.append(PointRow(
+                "cpu", {"hostname": f"host_{i % 10}", "region": "r1"},
+                {"usage_user": float(i % 100), "usage_system": float(i % 7)},
+                i * 1_000_000_000))
+        eng2.write_points("db", rows)
+
+        q = ("SELECT mean(usage_user) FROM cpu WHERE time >= 0 AND "
+             "time < 3000000000000 GROUP BY time(5m), hostname")
+        stmt = parse_query(q)[0]
+        r_cs = QueryExecutor(engine).execute(stmt, "db")
+        r_rs = QueryExecutor(eng2).execute(stmt, "db")
+        eng2.close()
+        assert "series" in r_cs, r_cs
+        assert r_cs == r_rs
+
+    def test_query_spans_memtable_and_files(self, engine):
+        from opengemini_tpu.query.executor import QueryExecutor
+        from opengemini_tpu.storage.rows import PointRow
+        self._write(engine, n=1000)
+        engine.flush_all()
+        # more rows land in the memtable, unflushed
+        extra = [PointRow("cpu", {"hostname": "host_0", "region": "r1"},
+                          {"usage_user": 1000.0}, (1000 + i) * 1_000_000_000)
+                 for i in range(5)]
+        engine.write_points("db", extra)
+        r = QueryExecutor(engine).execute(
+            parse_query("SELECT count(usage_user) FROM cpu")[0], "db")
+        total = sum(v[1] for s in r["series"] for v in s["values"])
+        assert total == 1005
+
+    def test_raw_select_with_tag_filter(self, engine):
+        from opengemini_tpu.query.executor import QueryExecutor
+        self._write(engine, n=500)
+        engine.flush_all()
+        r = QueryExecutor(engine).execute(
+            parse_query("SELECT usage_user, hostname FROM cpu "
+                        "WHERE hostname = 'host_3' LIMIT 5")[0], "db")
+        assert "series" in r, r
+        vals = r["series"][0]["values"]
+        assert len(vals) == 5
+        assert all(v[2] == "host_3" for v in vals)
+
+    def test_reopen_preserves_columnstore(self, engine, tmp_path):
+        from opengemini_tpu.query.executor import QueryExecutor
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        self._write(engine, n=300)
+        engine.flush_all()
+        path = engine.path
+        engine.close()
+        eng2 = Engine(path, EngineOptions())
+        assert eng2.database("db").is_columnstore("cpu")
+        r = QueryExecutor(eng2).execute(
+            parse_query("SELECT count(usage_user) FROM cpu")[0], "db")
+        total = sum(v[1] for s in r["series"] for v in s["values"])
+        assert total == 300
+        eng2.close()
+
+    def test_ddl_statement(self, engine):
+        from opengemini_tpu.query.executor import QueryExecutor
+        ex = QueryExecutor(engine)
+        res = ex.execute(parse_query(
+            "CREATE MEASUREMENT logs WITH ENGINETYPE = columnstore "
+            "PRIMARYKEY service INDEX text message")[0], "db")
+        assert res == {}, res
+        assert engine.database("db").is_columnstore("logs")
+        assert engine.database("db").cs_options["logs"]["indexes"] == {
+            "message": "text"}
+
+
+class TestReviewRegressions:
+    """Regressions from review: dedup semantics, rfc3339 time pruning,
+    thread-safe reads, DDL guard."""
+
+    def test_duplicate_point_overwrites(self, tmp_path):
+        from opengemini_tpu.query.executor import QueryExecutor
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        from opengemini_tpu.storage.rows import PointRow
+        eng = Engine(str(tmp_path / "d"), EngineOptions())
+        eng.create_columnstore("db", "m", ["h"])
+        eng.write_points("db", [PointRow("m", {"h": "a"}, {"v": 1.0}, 1000)])
+        eng.flush_all()
+        eng.write_points("db", [PointRow("m", {"h": "a"}, {"v": 2.0}, 1000)])
+        eng.flush_all()
+        r = QueryExecutor(eng).execute(
+            parse_query("SELECT v FROM m")[0], "db")
+        assert r["series"][0]["values"] == [[1000, 2.0]]
+        r2 = QueryExecutor(eng).execute(
+            parse_query("SELECT mean(v) FROM m")[0], "db")
+        assert r2["series"][0]["values"][0][1] == 2.0
+        eng.close()
+
+    def test_rfc3339_time_literal_not_lexical(self, tmp_path):
+        from opengemini_tpu.query.executor import QueryExecutor
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        from opengemini_tpu.storage.rows import PointRow
+        eng = Engine(str(tmp_path / "d"), EngineOptions())
+        eng.create_columnstore("db", "m", [])
+        t0 = 1_566_086_400_000_000_000  # 2019-08-18T00:00:00Z
+        eng.write_points("db", [
+            PointRow("m", {"h": "a"}, {"v": float(i)}, t0 + i * 10**9)
+            for i in range(10)])
+        eng.flush_all()
+        r = QueryExecutor(eng).execute(parse_query(
+            "SELECT v FROM m WHERE time >= '2019-08-18T00:00:00Z'")[0],
+            "db")
+        assert len(r["series"][0]["values"]) == 10
+        eng.close()
+
+    def test_concurrent_reads(self, tmp_path):
+        import threading as th
+        rec, usage = _mk_record(n=4096)
+        path = str(tmp_path / "m.ogcf")
+        ColumnStoreWriter(path, ["host"], fragment_rows=256).write(rec)
+        r = ColumnStoreReader(path)
+        want = r.read().column("usage").values.sum()
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(10):
+                    got = r.read().column("usage").values.sum()
+                    assert got == want
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+        ts = [th.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        r.close()
+
+    def test_ddl_rejected_after_rowstore_flush(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        from opengemini_tpu.storage.rows import PointRow
+        from opengemini_tpu.utils.errors import ErrQueryError
+        eng = Engine(str(tmp_path / "d"), EngineOptions())
+        eng.write_points("db", [PointRow("m", {"h": "a"}, {"v": 1.0}, 0)])
+        eng.flush_all()
+        with pytest.raises(ErrQueryError):
+            eng.create_columnstore("db", "m", ["h"])
+        eng.close()
+
+    def test_wal_lz4_plumbed_through_engine(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine, EngineOptions
+        from opengemini_tpu.storage.rows import PointRow
+        eng = Engine(str(tmp_path / "d"),
+                     EngineOptions(wal_compression="lz4"))
+        eng.write_points("db", [PointRow("m", {"h": "a"}, {"v": 5.0}, 0)])
+        s = eng.database("db").all_shards()[0]
+        assert s.wal.compression == "lz4"
+        eng.close()
+        # crash-replay path decodes lz4 frames
+        eng2 = Engine(str(tmp_path / "d"), EngineOptions())
+        from opengemini_tpu.query.executor import QueryExecutor
+        r = QueryExecutor(eng2).execute(
+            parse_query("SELECT v FROM m")[0], "db")
+        assert r["series"][0]["values"] == [[0, 5.0]]
+        eng2.close()
