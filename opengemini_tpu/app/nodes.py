@@ -36,18 +36,42 @@ class TsMeta:
                  peers: dict[str, str] | None = None,
                  data_dir: str = "meta_data",
                  host: str = "127.0.0.1", client_port: int = 0,
-                 raft_port: int = 0):
+                 raft_port: int = 0,
+                 ha: bool = True,
+                 failure_timeout_s: float | None = None):
         self.server = MetaServer(node_id,
                                  peers or {node_id: "127.0.0.1:0"},
                                  data_dir, host=host,
                                  client_port=client_port,
                                  raft_port=raft_port)
         self.addr = self.server.addr
+        self.cluster_manager = None
+        self._ha = ha
+        self._failure_timeout_s = failure_timeout_s
+        self._meta_client = None
 
     def start(self):
         self.server.start()
+        if self._ha:
+            # every voter runs the detector but only the current raft
+            # leader sweeps (is_leader_fn gate) — takeover must not run
+            # concurrently from two voters
+            from ..cluster.ha import (ClusterManager,
+                                      DEFAULT_FAILURE_TIMEOUT_S)
+            from ..cluster.meta_store import MetaClient
+            self._meta_client = MetaClient([self.addr])
+            self.cluster_manager = ClusterManager(
+                self._meta_client,
+                failure_timeout_s=(self._failure_timeout_s
+                                   or DEFAULT_FAILURE_TIMEOUT_S),
+                is_leader_fn=lambda: self.server.raft.is_leader)
+            self.cluster_manager.start()
 
     def stop(self):
+        if self.cluster_manager is not None:
+            self.cluster_manager.stop()
+        if self._meta_client is not None:
+            self._meta_client.close()
         self.server.stop()
 
 

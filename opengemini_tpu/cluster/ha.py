@@ -1,0 +1,269 @@
+"""HA: failure detection, partition takeover, balancing.
+
+Role of the reference's meta-side HA plane (SURVEY §2.5/§3.5):
+- ClusterManager (app/ts-meta/meta/cluster_manager.go:65) — consumes
+  membership events; here membership is raft-replicated heartbeats
+  (the serf-gossip equivalent, SURVEY §2.6: "JAX distributed runtime
+  heartbeats + coordinator service"), swept periodically on the leader.
+- MigrateStateMachine (migrate_state_machine.go:40) — executes PT
+  assign/move events with retries: mark offline → target store loads the
+  partition → commit new ownership in the raft catalog.
+- Balancer (balance_manager.go) — background PT spread across alive
+  stores.
+
+Consensus and takeover stay strictly CPU-side; device state is never
+coupled to membership (SURVEY §7 hard-parts list).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils import get_logger
+from .meta_data import PT_OFFLINE, PT_ONLINE, STATUS_ALIVE, STATUS_FAILED
+from .transport import RPCClient, RPCError
+
+log = get_logger(__name__)
+
+DEFAULT_FAILURE_TIMEOUT_S = 10.0
+DEFAULT_SWEEP_S = 2.0
+
+
+@dataclass
+class MigrateEvent:
+    """One PT reassignment (reference assign_event.go / move_event.go)."""
+    db: str
+    pt_id: int
+    from_node: int
+    to_node: int
+    attempts: int = 0
+    done: threading.Event = field(default_factory=threading.Event)
+    error: str | None = None
+
+
+class MigrateStateMachine:
+    """Executes migrate events against the replicated catalog + stores.
+
+    Protocol per event (reference migrate_state_machine.go:66-197):
+      1. raft: set_pt_status(db, pt, OFFLINE)   — writes stop routing here
+      2. rpc:  target store.load_pt             — open partition engine
+      3. raft: move_pt(db, pt, to_node, ONLINE) — commit new owner
+    A failed step retries up to max_attempts, then the event parks the PT
+    offline (operator-visible) rather than flapping.
+    """
+
+    def __init__(self, meta_client, max_attempts: int = 3):
+        self.meta = meta_client
+        self.max_attempts = max_attempts
+        self._clients: dict[str, RPCClient] = {}
+        self._lock = threading.Lock()
+
+    def _client(self, addr: str) -> RPCClient:
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = RPCClient(addr)
+            return c
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+    def execute(self, ev: MigrateEvent) -> bool:
+        md = self.meta.data()
+        target = md.nodes.get(ev.to_node)
+        if target is None:
+            ev.error = f"target node {ev.to_node} unknown"
+            ev.done.set()
+            return False
+        while ev.attempts < self.max_attempts:
+            ev.attempts += 1
+            try:
+                self.meta.apply({"op": "set_pt_status", "db": ev.db,
+                                 "pt_id": ev.pt_id, "status": PT_OFFLINE})
+                self._client(target.addr).call(
+                    "store.load_pt", {"db": ev.db, "pt": ev.pt_id},
+                    timeout=30.0)
+                self.meta.apply({"op": "move_pt", "db": ev.db,
+                                 "pt_id": ev.pt_id, "to_node": ev.to_node,
+                                 "status": PT_ONLINE})
+                log.info("migrated %s/pt%d: node %d -> %d", ev.db,
+                         ev.pt_id, ev.from_node, ev.to_node)
+                ev.done.set()
+                return True
+            except (RPCError, OSError) as e:
+                ev.error = str(e)
+                log.warning("migrate %s/pt%d attempt %d failed: %s",
+                            ev.db, ev.pt_id, ev.attempts, e)
+        log.error("migrate %s/pt%d gave up after %d attempts (pt stays "
+                  "offline)", ev.db, ev.pt_id, ev.attempts)
+        ev.done.set()
+        return False
+
+
+class ClusterManager:
+    """Leader-side failure detector + takeover driver.
+
+    sweep(now) is the event pump (reference processEvent/processFailedDbPt
+    cluster_manager.go:323,482): nodes whose raft-replicated heartbeat is
+    stale beyond failure_timeout are marked FAILED and every PT they own
+    is migrated — replica nodes preferred, else the least-loaded alive
+    node.
+    """
+
+    def __init__(self, meta_client,
+                 failure_timeout_s: float = DEFAULT_FAILURE_TIMEOUT_S,
+                 sweep_s: float = DEFAULT_SWEEP_S,
+                 now_fn=time.time_ns,
+                 is_leader_fn=None):
+        self.meta = meta_client
+        self.failure_timeout_s = failure_timeout_s
+        self.sweep_s = sweep_s
+        self.now_fn = now_fn
+        # only the raft leader drives takeover — concurrent sweeps from
+        # several voters would double-migrate the same PT
+        self.is_leader_fn = is_leader_fn or (lambda: True)
+        self.msm = MigrateStateMachine(meta_client)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # no takeover until a full timeout has elapsed since this manager
+        # started: after leadership change / process resume, stores need
+        # one heartbeat round before their timestamps mean anything
+        self._grace_until_ns = now_fn() + int(failure_timeout_s * 1e9)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cluster-manager")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.msm.close()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.sweep_s):
+            if not self.is_leader_fn():
+                continue
+            try:
+                self.sweep(self.now_fn())
+            except Exception as e:   # noqa: BLE001 — keep the detector alive
+                log.error("cluster manager sweep failed: %s", e)
+
+    # ---------------------------------------------------------------- sweep
+
+    def sweep(self, now_ns: int) -> list[MigrateEvent]:
+        """One detection+takeover pass; returns the executed events.
+        now_ns: nanosecond clock, same unit as the raft-replicated
+        heartbeat timestamps."""
+        if now_ns < self._grace_until_ns:
+            return []
+        # heartbeat applies don't push snapshots to clients — pull a
+        # fresh catalog or every node looks stale
+        self.meta.refresh()
+        md = self.meta.data()
+        timeout_ns = int(self.failure_timeout_s * 1e9)
+        alive = [n for n in md.nodes.values() if n.status == STATUS_ALIVE]
+        stale = [n for n in alive
+                 if now_ns - n.last_heartbeat >= timeout_ns]
+        if not stale:
+            return []
+        # mass-staleness guard: when MOST nodes look dead at once, the
+        # likely fault is on OUR side (meta partition / suspended leader
+        # / stalled heartbeat processing) — cascading takeover would
+        # domino every PT onto dataless nodes. Hold off; a real mass
+        # outage still gets handled once some nodes heartbeat back in.
+        if len(stale) * 2 > len(alive):
+            log.error(
+                "%d/%d nodes stale at once — refusing takeover "
+                "(suspected meta-side fault)", len(stale), len(alive))
+            return []
+        events: list[MigrateEvent] = []
+        for node in stale:
+            log.warning("node %d (%s) heartbeat stale %.1fs -> FAILED",
+                        node.id, node.addr,
+                        (now_ns - node.last_heartbeat) / 1e9)
+            self.meta.apply({"op": "set_node_status", "node_id": node.id,
+                             "status": STATUS_FAILED})
+            events.extend(self._takeover(node.id))
+        return events
+
+    def _takeover(self, failed_node: int) -> list[MigrateEvent]:
+        self.meta.refresh()
+        md = self.meta.data()
+        alive = {n.id for n in md.alive_nodes()}
+        if not alive:
+            log.error("no alive nodes to take over PTs of node %d",
+                      failed_node)
+            return []
+        load = {nid: 0 for nid in alive}
+        for pts in md.pts.values():
+            for pt in pts:
+                if pt.owner in load:
+                    load[pt.owner] += 1
+        events = []
+        for db, pts in md.pts.items():
+            for pt in pts:
+                if pt.owner != failed_node:
+                    continue
+                # replica nodes first (with per-PT replication enabled
+                # they hold the data; without it takeover restores
+                # ROUTING only — the failed node's rows are unavailable
+                # until it rejoins), else least-loaded alive node
+                # (reference cluster_manager node choice :438)
+                cands = [r for r in pt.replicas if r in alive]
+                target = (cands[0] if cands
+                          else min(sorted(alive), key=lambda n: load[n]))
+                load[target] = load.get(target, 0) + 1
+                ev = MigrateEvent(db=db, pt_id=pt.pt_id,
+                                  from_node=failed_node, to_node=target)
+                self.msm.execute(ev)
+                events.append(ev)
+        return events
+
+
+class Balancer:
+    """Background PT balance (reference balance_manager.go): move PTs from
+    the most- to the least-loaded alive store while the spread exceeds
+    one."""
+
+    def __init__(self, meta_client, msm: MigrateStateMachine | None = None):
+        self.meta = meta_client
+        self.msm = msm or MigrateStateMachine(meta_client)
+
+    def plan(self) -> list[MigrateEvent]:
+        """Compute (but do not execute) the next round of balancing
+        moves: one move per overloaded node per round."""
+        md = self.meta.data()
+        alive = sorted(n.id for n in md.alive_nodes())
+        if len(alive) < 2:
+            return []
+        load: dict[int, list] = {nid: [] for nid in alive}
+        for db, pts in md.pts.items():
+            for pt in pts:
+                if pt.status == PT_ONLINE and pt.owner in load:
+                    load[pt.owner].append((db, pt.pt_id))
+        moves = []
+        while True:
+            hi = max(alive, key=lambda n: len(load[n]))
+            lo = min(alive, key=lambda n: len(load[n]))
+            if len(load[hi]) - len(load[lo]) <= 1:
+                break
+            db, pt_id = load[hi].pop()
+            load[lo].append((db, pt_id))
+            moves.append(MigrateEvent(db=db, pt_id=pt_id, from_node=hi,
+                                      to_node=lo))
+        return moves
+
+    def rebalance(self) -> list[MigrateEvent]:
+        moves = self.plan()
+        for ev in moves:
+            self.msm.execute(ev)
+        return moves

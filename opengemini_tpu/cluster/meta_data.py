@@ -163,11 +163,15 @@ class MetaData:
     def alive_nodes(self) -> list[DataNode]:
         return [n for n in self.nodes.values() if n.status == STATUS_ALIVE]
 
-    def pt_owner(self, db: str, pt_id: int) -> DataNode | None:
+    def pt(self, db: str, pt_id: int) -> PtInfo | None:
         for pt in self.pts.get(db, []):
             if pt.pt_id == pt_id:
-                return self.nodes.get(pt.owner)
+                return pt
         return None
+
+    def pt_owner(self, db: str, pt_id: int) -> DataNode | None:
+        pt = self.pt(db, pt_id)
+        return self.nodes.get(pt.owner) if pt is not None else None
 
     def shard_group_for_time(self, db: str, t: int) -> ShardGroupInfo | None:
         info = self.databases.get(db)

@@ -59,7 +59,14 @@ class MetaServer:
     # client-facing handlers
     def _on_apply(self, body):
         try:
-            res = self.raft.propose(body["cmd"])
+            cmd = body["cmd"]
+            if cmd.get("op") in ("heartbeat", "create_node"):
+                # stamp liveness with the RECEIVING side's clock: the
+                # failure sweep runs on this (leader) host, so cross-node
+                # clock skew must not enter the staleness arithmetic
+                # (reference uses meta-side receipt time)
+                cmd = dict(cmd, now=time.time_ns())
+            res = self.raft.propose(cmd)
             with self._data_lock:
                 ver = self.data.version
             return {"ok": True, "result": res, "version": ver}
@@ -115,9 +122,11 @@ class MetaClient:
 
     # ------------------------------------------------------------ plumbing
 
-    def apply(self, cmd: dict, timeout: float = 10.0):
+    def apply(self, cmd: dict, timeout: float = 10.0,
+              refresh: bool = True):
         """Run a catalog mutation through raft, trying each meta addr
-        until the leader accepts."""
+        until the leader accepts. refresh=False skips the follow-up
+        snapshot pull (fire-and-forget mutations like heartbeats)."""
         last_err: Exception | None = None
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -129,7 +138,8 @@ class MetaClient:
                     last_err = e
                     continue
                 if resp.get("ok"):
-                    self.refresh(min_version=resp.get("version", 0))
+                    if refresh:
+                        self.refresh(min_version=resp.get("version", 0))
                     return resp.get("result")
                 if resp.get("fatal"):
                     raise RPCError(resp.get("error", "rejected"))
@@ -192,7 +202,7 @@ class MetaClient:
 
     def heartbeat(self, node_id: int) -> None:
         self.apply({"op": "heartbeat", "node_id": node_id,
-                    "now": time.time_ns()})
+                    "now": time.time_ns()}, refresh=False)
 
     def create_database(self, name: str, num_pts: int | None = None,
                         replica_n: int = 1,

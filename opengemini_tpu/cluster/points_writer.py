@@ -93,11 +93,22 @@ class PointsWriter:
                         raise GeminiError("failed to create shard group")
                 sg_cache[slot] = sg
             shard = sg.shard_for(series_hash(r.measurement, r.tags))
-            owner = md.pt_owner(db, shard.pt_id)
-            if owner is None:
+            pt = md.pt(db, shard.pt_id)
+            if pt is None or md.nodes.get(pt.owner) is None:
                 raise GeminiError(
                     f"no owner node for {db} pt {shard.pt_id}")
-            batches.setdefault((owner.addr, shard.pt_id), []).append(r)
+            if pt.status != "online":
+                # transient during migration: one refresh, then fail
+                # loudly rather than ack rows into a parked partition
+                self.meta.refresh()
+                md = self.meta.data()
+                pt = md.pt(db, shard.pt_id)
+                if pt is None or pt.status != "online":
+                    raise GeminiError(
+                        f"{db} pt {shard.pt_id} is offline")
+            owner = md.nodes[pt.owner]
+            batches.setdefault((owner.addr, shard.pt_id, owner.id),
+                               []).append(r)
         return batches
 
     # -------------------------------------------------------------- write
@@ -111,11 +122,17 @@ class PointsWriter:
         errors: list[str] = []
         lock = threading.Lock()
 
-        def send(addr: str, pt: int, batch: list[PointRow]):
+        def send(addr: str, pt: int, owner_id: int,
+                 batch: list[PointRow]):
             nonlocal written
-            wire = {"db": db, "pt": pt, "rows": rows_to_wire(batch)}
             last: Exception | None = None
             for attempt in range(self.max_retries + 1):
+                # owner id travels with the batch: the store rejects
+                # writes for partitions it no longer owns, so a stale
+                # route can never silently ack rows into an orphaned
+                # engine db (they'd be invisible to queries)
+                wire = {"db": db, "pt": pt, "owner": owner_id,
+                        "rows": rows_to_wire(batch)}
                 try:
                     resp = self._client(addr).call("store.write_rows", wire)
                     with lock:
@@ -127,13 +144,13 @@ class PointsWriter:
                     self.meta.refresh()
                     md = self.meta.data()
                     owner = md.pt_owner(db, pt)
-                    if owner is not None and owner.addr != addr:
-                        addr = owner.addr
+                    if owner is not None:
+                        addr, owner_id = owner.addr, owner.id
             with lock:
                 errors.append(f"pt {pt} @ {addr}: {last}")
 
-        threads = [threading.Thread(target=send, args=(a, p, b))
-                   for (a, p), b in batches.items()]
+        threads = [threading.Thread(target=send, args=(a, p, o, b))
+                   for (a, p, o), b in batches.items()]
         for t in threads:
             t.start()
         for t in threads:

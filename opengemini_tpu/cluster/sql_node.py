@@ -59,6 +59,14 @@ class ClusterExecutor:
         info = md.db(db)
         if info is None:
             raise ErrQueryError(f"database not found: {db}")
+        offline = [p.pt_id for p in md.pts.get(db, [])
+                   if p.status != "online"]
+        if offline:
+            # a parked partition must fail the query loudly — silently
+            # omitting it would return partial results indistinguishable
+            # from correct ones
+            raise ErrQueryError(
+                f"partitions unavailable for {db}: {offline}")
         out: dict[str, list[int]] = {}
         for node_id, pts in md.pts_by_node(db).items():
             node = md.nodes.get(node_id)
@@ -69,30 +77,39 @@ class ClusterExecutor:
 
     def _scatter(self, msg: str, db: str, body_extra: dict,
                  timeout: float = 120.0) -> list:
-        """Send one request per store node owning pts of db; gather."""
-        per_node = self.map_pts(db)
-        results: list = [None] * len(per_node)
-        errors: list[str] = []
-        lock = threading.Lock()
+        """Send one request per store node owning pts of db; gather.
+        A store RPC failure refreshes the catalog and retries once —
+        after a PT takeover the stale cache still routes to the dead
+        node (reference metaclient retry loops, meta_client.go)."""
+        last_err = None
+        for attempt in range(2):
+            per_node = self.map_pts(db)
+            results: list = [None] * len(per_node)
+            errors: list[str] = []
+            lock = threading.Lock()
 
-        def run(i: int, addr: str, pts: list[int]):
-            try:
-                body = {"db": db, "pts": pts, **body_extra}
-                results[i] = self._client(addr).call(msg, body,
-                                                     timeout=timeout)
-            except RPCError as e:
-                with lock:
-                    errors.append(f"{addr}: {e}")
+            def run(i: int, addr: str, pts: list[int],
+                    results=results, errors=errors, lock=lock):
+                try:
+                    body = {"db": db, "pts": pts, **body_extra}
+                    results[i] = self._client(addr).call(msg, body,
+                                                         timeout=timeout)
+                except RPCError as e:
+                    with lock:
+                        errors.append(f"{addr}: {e}")
 
-        threads = [threading.Thread(target=run, args=(i, a, p))
-                   for i, (a, p) in enumerate(per_node.items())]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise ErrQueryError("; ".join(errors))
-        return [r for r in results if r is not None]
+            threads = [threading.Thread(target=run, args=(i, a, p))
+                       for i, (a, p) in enumerate(per_node.items())]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if not errors:
+                return [r for r in results if r is not None]
+            last_err = "; ".join(errors)
+            if attempt == 0:
+                self.meta.refresh()
+        raise ErrQueryError(last_err)
 
     # ------------------------------------------------------------- execute
 

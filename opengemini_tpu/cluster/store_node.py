@@ -64,6 +64,8 @@ class StoreNode:
                                     "store.show": self._on_show,
                                     "store.drop_db": self._on_drop_db,
                                     "store.measurements": self._on_measurements,
+                                    "store.load_pt": self._on_load_pt,
+                                    "store.drop_pt": self._on_drop_pt,
                                 })
         self.addr = self.server.addr
         self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
@@ -81,7 +83,32 @@ class StoreNode:
         return {"ok": True, "node_id": self.node_id,
                 "now": time.time_ns()}
 
+    def _on_load_pt(self, body):
+        """Open (or create) one partition's engine database — the target
+        side of PT migration (reference store PtProcessor,
+        app/ts-store/transport/handler/migration.go; engine preload
+        engine_ha.go). Creating the db opens shards + replays WAL."""
+        dbk = db_key(body["db"], body["pt"])
+        self.engine.create_database(dbk)
+        return {"loaded": dbk}
+
+    def _on_drop_pt(self, body):
+        """Release a migrated-away partition's local engine state."""
+        dbk = db_key(body["db"], body["pt"])
+        if dbk in self.engine.databases:
+            self.engine.drop_database(dbk)
+        return {"dropped": dbk}
+
     def _on_write(self, body):
+        owner = body.get("owner")
+        if (owner is not None and self.node_id is not None
+                and owner != self.node_id):
+            # stale route after a PT migration: reject so the writer
+            # refreshes its catalog instead of acking rows into an
+            # engine db queries no longer look at
+            raise ValueError(
+                f"not pt owner: write addressed to node {owner}, "
+                f"this is node {self.node_id}")
         rows = rows_from_wire(body["rows"])
         n = self.engine.write_points(db_key(body["db"], body["pt"]), rows)
         self.stats["writes"] += 1

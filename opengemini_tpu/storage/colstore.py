@@ -181,7 +181,10 @@ def _sort_by_pk(rec: Record, pk: list[str]) -> Record:
     for name in reversed(pk):
         col = rec.column(name)
         if col is None:
-            raise ValueError(f"primary key column {name!r} not in record")
+            # a batch can legitimately lack a declared pk column (tag not
+            # yet seen); it sorts as a constant — never an error, or the
+            # flush path would wedge on accepted rows
+            continue
         if col.is_string_like():
             keys.append(np.array(
                 [s if s is not None else "" for s in col.to_strings()]))

@@ -134,9 +134,9 @@ class Shard:
         for fn in sorted(os.listdir(cd)):
             if not fn.endswith(".ogcf"):
                 continue
-            mst, seq = fn[:-5].rsplit("_", 1)
-            self._file_seq = max(self._file_seq, int(seq))
             try:
+                mst, seq = fn[:-5].rsplit("_", 1)
+                self._file_seq = max(self._file_seq, int(seq))
                 self._cs_files.setdefault(mst, []).append(
                     ColumnStoreReader(os.path.join(cd, fn)))
             except (ValueError, _struct.error, OSError, KeyError) as e:
@@ -179,6 +179,17 @@ class Shard:
         batch = []
         created_sid = False
         for r in rows:
+            if r.measurement in self.cs_options:
+                # column-store measurements materialize tags as columns:
+                # a tag/field name collision must bounce HERE, before the
+                # row becomes durable — at flush time it would wedge the
+                # whole shard's snapshot loop forever
+                clash = set(r.tags) & set(r.fields)
+                if clash:
+                    raise ErrTypeConflict(
+                        f"tag names collide with field names in "
+                        f"column-store measurement {r.measurement!r}: "
+                        f"{sorted(clash)}")
             before = self.index.series_cardinality
             sid = self.index.get_or_create_sid(r.measurement, r.tags)
             created_sid |= self.index.series_cardinality != before
@@ -230,17 +241,27 @@ class Shard:
                         fn = os.path.join(
                             self.path, "colstore",
                             f"{mst}_{self._file_seq:06d}.ogcf")
-                        rec = self._materialize_measurement(mst, mt)
-                        if rec is not None and rec.num_rows:
-                            ColumnStoreWriter(
-                                fn, opt.get("primary_key", []),
-                                opt.get("indexes"),
-                                opt.get("fragment_rows") or 4096,
-                                tag_columns=sorted(
-                                    self.index.tag_keys(mst)),
-                            ).write(rec)
-                            new_cs.append((mst, fn))
-                        continue
+                        try:
+                            rec = self._materialize_measurement(mst, mt)
+                            if rec is not None and rec.num_rows:
+                                ColumnStoreWriter(
+                                    fn, opt.get("primary_key", []),
+                                    opt.get("indexes"),
+                                    opt.get("fragment_rows") or 4096,
+                                    tag_columns=sorted(
+                                        self.index.tag_keys(mst)),
+                                ).write(rec)
+                                new_cs.append((mst, fn))
+                            continue
+                        except (ErrTypeConflict, ValueError) as e:
+                            # one poisoned measurement must not wedge the
+                            # shard's snapshot loop forever: fall back to
+                            # a durable TSSP write (loudly — recoverable
+                            # by compaction/operator, invisible to the
+                            # cs query path until then)
+                            log.error(
+                                "colstore flush of %s failed (%s); "
+                                "falling back to row-store file", mst, e)
                     fn = os.path.join(self.path, "tssp",
                                       f"{mst}_{self._file_seq:06d}.tssp")
                     w = TSSPWriter(fn, segment_size=self.segment_size)

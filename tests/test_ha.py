@@ -1,0 +1,136 @@
+"""HA plane: failure detection, PT takeover, balancing (SURVEY §2.5/§3.5;
+reference cluster_manager.go, migrate_state_machine.go, balance_manager.go).
+Driven the way the reference tests drive the meta FSM: real raft +
+real store RPC on loopback, with a controllable clock for the sweep."""
+
+import time
+
+import pytest
+
+from opengemini_tpu.app import TsMeta, TsStore, TsSql
+from opengemini_tpu.cluster.ha import Balancer, ClusterManager, MigrateEvent
+from opengemini_tpu.cluster.meta_data import (PT_OFFLINE, PT_ONLINE,
+                                              STATUS_ALIVE, STATUS_FAILED)
+from opengemini_tpu.cluster.meta_store import MetaClient
+from opengemini_tpu.storage.rows import PointRow
+
+NS = 10**9
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    meta = TsMeta(data_dir=str(tmp_path / "meta"), ha=False)
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    stores = [TsStore(str(tmp_path / f"store{i}"), [meta.addr],
+                      heartbeat_s=0.2) for i in range(2)]
+    for s in stores:
+        s.start()
+    client = MetaClient([meta.addr])
+    yield {"meta": meta, "stores": stores, "client": client}
+    client.close()
+    for s in stores:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    meta.stop()
+
+
+class TestClusterManager:
+    def test_no_failure_while_heartbeating(self, cluster):
+        client = cluster["client"]
+        client.create_database("db")
+        cm = ClusterManager(client, failure_timeout_s=5.0)
+        events = cm.sweep(time.time_ns())
+        assert events == []
+        assert all(n.status == STATUS_ALIVE
+                   for n in client.data().nodes.values())
+        cm.msm.close()
+
+    def test_failed_node_pts_migrate(self, cluster):
+        client = cluster["client"]
+        s0, s1 = cluster["stores"]
+        client.create_database("db", num_pts=4)
+        # seed some rows so both stores own engine dbs
+        sql = TsSql([cluster["meta"].addr])
+        sql.start()
+        sql.facade.write_points("db", [
+            PointRow("m", {"h": f"h{i}"}, {"v": float(i)}, i * NS)
+            for i in range(20)])
+
+        dead_id = s1.node_id
+        s1.stop()                     # heartbeats stop; RPC goes away
+        # timeout must comfortably exceed worst-case heartbeat-apply
+        # latency (raft fsync on a 1-core box), like the 10s production
+        # default exceeds the 1s heartbeat period
+        cm = ClusterManager(client, failure_timeout_s=3.0)
+        deadline = time.time() + 20
+        events = []
+        while time.time() < deadline:
+            events = cm.sweep(time.time_ns())
+            if events:
+                break
+            time.sleep(0.3)
+        assert events, "sweep never detected the dead node"
+        client.refresh()
+        md = client.data()
+        assert md.nodes[dead_id].status == STATUS_FAILED
+        # every pt moved to the surviving node and is online again
+        for pt in md.pts["db"]:
+            assert pt.owner == s0.node_id
+            assert pt.status == PT_ONLINE
+        # queries still answered after takeover (data on surviving pts)
+        res = sql.facade.executor.execute(
+            __import__("opengemini_tpu.query.influxql",
+                       fromlist=["parse_query"]).parse_query(
+                           "SELECT count(v) FROM m")[0], "db")
+        assert "error" not in res
+        sql.stop()
+        cm.msm.close()
+
+    def test_unreachable_target_parks_pt_offline(self, cluster):
+        client = cluster["client"]
+        client.create_database("dbx", num_pts=1)
+        from opengemini_tpu.cluster.ha import MigrateStateMachine
+        msm = MigrateStateMachine(client, max_attempts=2)
+        # target node registered but nothing listens on its addr
+        ghost = client.create_node("127.0.0.1:1")
+        pt = client.data().pts["dbx"][0]
+        ev = MigrateEvent(db="dbx", pt_id=pt.pt_id, from_node=pt.owner,
+                          to_node=ghost)
+        ok = msm.execute(ev)
+        assert not ok and ev.attempts == 2
+        assert client.data().pts["dbx"][0].status == PT_OFFLINE
+        msm.close()
+
+
+class TestBalancer:
+    def test_plan_moves_from_loaded_to_idle(self, cluster):
+        client = cluster["client"]
+        s0, s1 = cluster["stores"]
+        client.create_database("bal", num_pts=6)
+        # force all pts onto store 0
+        for pt in client.data().pts["bal"]:
+            client.move_pt("bal", pt.pt_id, s0.node_id)
+        bal = Balancer(client)
+        moves = bal.plan()
+        assert len(moves) == 3
+        assert all(m.from_node == s0.node_id and m.to_node == s1.node_id
+                   for m in moves)
+
+    def test_rebalance_executes(self, cluster):
+        client = cluster["client"]
+        s0, s1 = cluster["stores"]
+        client.create_database("bal2", num_pts=4)
+        for pt in client.data().pts["bal2"]:
+            client.move_pt("bal2", pt.pt_id, s0.node_id)
+        bal = Balancer(client)
+        moved = bal.rebalance()
+        assert len(moved) == 2
+        owners = [pt.owner for pt in client.data().pts["bal2"]]
+        assert owners.count(s0.node_id) == 2
+        assert owners.count(s1.node_id) == 2
+        assert all(pt.status == PT_ONLINE
+                   for pt in client.data().pts["bal2"])
+        bal.msm.close()
