@@ -57,7 +57,9 @@ class RaftNode:
 
     def __init__(self, node_id: str, peers: dict[str, str],
                  data_dir: str, fsm_apply, fsm_snapshot, fsm_restore,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 server=None, msg_prefix: str = "raft",
+                 snapshot_every: int = SNAPSHOT_EVERY):
         self.id = node_id
         self.peers = dict(peers)                  # id -> addr, incl self
         self.dir = data_dir
@@ -65,6 +67,14 @@ class RaftNode:
         self.fsm_apply = fsm_apply
         self.fsm_snapshot = fsm_snapshot
         self.fsm_restore = fsm_restore
+        # embeddable mode: many raft groups (per-PT data replication,
+        # reference lib/raftconn one etcd-raft node per partition)
+        # multiplex over ONE shared RPCServer, disambiguated by message
+        # prefix — the spdy-multiplexing analog. The embedding owner
+        # manages the server lifecycle.
+        self.msg_prefix = msg_prefix
+        self.snapshot_every = snapshot_every
+        self._owns_server = server is None
 
         # persistent state
         self.term = 0
@@ -90,12 +100,21 @@ class RaftNode:
         self._clients: dict[str, RPCClient] = {}
         self._repl_wake: dict[str, threading.Event] = {}
 
-        self.server = RPCServer(host=host, port=port, name=f"raft-{node_id}",
-                                handlers={
-                                    "raft.vote": self._on_request_vote,
-                                    "raft.append": self._on_append_entries,
-                                    "raft.snapshot": self._on_install_snapshot,
-                                })
+        if server is None:
+            self.server = RPCServer(
+                host=host, port=port, name=f"raft-{node_id}",
+                handlers={
+                    f"{msg_prefix}.vote": self._on_request_vote,
+                    f"{msg_prefix}.append": self._on_append_entries,
+                    f"{msg_prefix}.snapshot": self._on_install_snapshot,
+                })
+        else:
+            self.server = server
+            server.register(f"{msg_prefix}.vote", self._on_request_vote)
+            server.register(f"{msg_prefix}.append",
+                            self._on_append_entries)
+            server.register(f"{msg_prefix}.snapshot",
+                            self._on_install_snapshot)
         self.addr = self.server.addr
         if node_id in self.peers and self.peers[node_id] != self.addr:
             self.peers[node_id] = self.addr
@@ -171,7 +190,8 @@ class RaftNode:
     # --------------------------------------------------------- lifecycle
 
     def start(self):
-        self.server.start()
+        if self._owns_server:
+            self.server.start()
         for pid in self.peers:
             if pid != self.id:
                 self._repl_wake[pid] = threading.Event()
@@ -185,7 +205,8 @@ class RaftNode:
         self._stop.set()
         for ev in self._repl_wake.values():
             ev.set()
-        self.server.stop()
+        if self._owns_server:
+            self.server.stop()
         for c in self._clients.values():
             c.close()
 
@@ -246,7 +267,7 @@ class RaftNode:
 
         def ask(pid):
             try:
-                resp = self._client(pid).call("raft.vote", {
+                resp = self._client(pid).call(f"{self.msg_prefix}.vote", {
                     "term": term, "candidate": self.id,
                     "last_log_index": last_idx, "last_log_term": last_term,
                 }, timeout=1.0)
@@ -434,7 +455,7 @@ class RaftNode:
                         "snapshot": {"last_index": self.log_base,
                                      "last_term": self.base_term,
                                      "fsm": self.fsm_snapshot()}}
-                kind = "raft.snapshot"
+                kind = f"{self.msg_prefix}.snapshot"
             else:
                 prev = nxt - 1
                 entries = self._entries_from(nxt)
@@ -443,7 +464,7 @@ class RaftNode:
                         "prev_log_term": self._term_at(prev),
                         "entries": entries,
                         "leader_commit": self.commit_index}
-                kind = "raft.append"
+                kind = f"{self.msg_prefix}.append"
         resp = self._client(pid).call(kind, body, timeout=5.0)
         with self._lock:
             if self.state != LEADER or self.term != term:
@@ -451,7 +472,7 @@ class RaftNode:
             if resp.get("term", 0) > self.term:
                 self._step_down(resp["term"])
                 return False
-            if kind == "raft.snapshot":
+            if kind == f"{self.msg_prefix}.snapshot":
                 self.next_index[pid] = self.log_base + 1
                 self.match_index[pid] = self.log_base
                 return self.next_index[pid] <= self._last_index()
@@ -496,7 +517,7 @@ class RaftNode:
             if ev is not None:
                 self._apply_results[self.last_applied] = outcome
                 ev.set()
-        if len(self.log) >= SNAPSHOT_EVERY:
+        if len(self.log) >= self.snapshot_every:
             self._compact()
 
     def _compact(self):

@@ -1,0 +1,198 @@
+"""Per-partition data replication via raft groups.
+
+Role of the reference's consistent-replication mode (SURVEY §2.6.8):
+per-PT etcd-raft groups replicating write batches between stores —
+engine/partition_raft.go + lib/raftconn/node.go:34 (one raft node per
+partition), raft messages multiplexed over the store transport
+(lib/netstorage/storage.go:523), selected per-db via replica_n
+(Client.RaftEnabledForDB meta_client.go:995).
+
+Design here: one RaftNode per (db, pt) this store participates in
+(owner or replica), all multiplexed over the store's single RPCServer
+with message prefix ``praft.<db>@<pt>`` — no extra ports. The FSM is
+"apply this write batch to the local engine db for the partition", so
+every member materializes identical partition state; after a takeover
+the replica promoted by the HA plane already holds the data.
+
+Raft log compaction is effectively disabled for data groups (the engine
+itself is the durable state; a far-behind member replays the log). The
+log is pruned externally via `truncate_applied` once members confirm
+application (the reference's snapshotter analog, lib/raftlog).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils import get_logger
+from .raft import NotLeader, RaftNode
+from .transport import RPCError
+
+log = get_logger(__name__)
+
+# practical ceiling before external truncation should kick in; data
+# raft groups snapshot only the applied-index marker (the engine holds
+# the data), so members joining from scratch replay the full log
+DATA_SNAPSHOT_EVERY = 1 << 30
+
+
+def group_key(db: str, pt: int) -> str:
+    return f"{db}@{pt}"
+
+
+class PartitionRaftGroup:
+    """One store's member of one partition's raft group."""
+
+    def __init__(self, db: str, pt: int, node_id: int,
+                 peers: dict[str, str], data_dir: str, server,
+                 apply_rows):
+        self.db = db
+        self.pt = pt
+        self.key = group_key(db, pt)
+        self._apply_rows = apply_rows
+        self.raft = RaftNode(
+            node_id=str(node_id), peers=peers,
+            data_dir=os.path.join(data_dir, "praft", self.key),
+            fsm_apply=self._fsm_apply,
+            fsm_snapshot=lambda: {},
+            fsm_restore=lambda d: None,
+            server=server,
+            msg_prefix=f"praft.{self.key}",
+            snapshot_every=DATA_SNAPSHOT_EVERY)
+
+    def _fsm_apply(self, cmd):
+        return self._apply_rows(self.db, self.pt, cmd["rows"])
+
+    def start(self):
+        self.raft.start()
+
+    def stop(self):
+        self.raft.stop()
+
+    def propose_rows(self, rows_wire, timeout: float = 30.0) -> int:
+        return self.raft.propose({"rows": rows_wire}, timeout=timeout)
+
+
+class ReplicationManager:
+    """All partition raft groups of one store node.
+
+    Group membership is resolved from the meta catalog: owner + replicas
+    of the PT, addressed by their store RPC addrs. Groups materialize
+    lazily — on first write (leader side) or on an ensure_group ping
+    from a peer — and are re-opened at startup from the on-disk praft/
+    directories so restarts rejoin their groups.
+    """
+
+    def __init__(self, store_node, meta_client, data_dir: str):
+        self.store = store_node
+        self.meta = meta_client
+        self.data_dir = data_dir
+        self.groups: dict[str, PartitionRaftGroup] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def reopen_local_groups(self) -> None:
+        """Rejoin groups persisted under praft/ (store restart)."""
+        root = os.path.join(self.data_dir, "praft")
+        if not os.path.isdir(root):
+            return
+        for key in sorted(os.listdir(root)):
+            if "@" not in key:
+                continue
+            db, pt = key.rsplit("@", 1)
+            try:
+                self.ensure_group(db, int(pt))
+            except (ValueError, RPCError) as e:
+                log.error("cannot rejoin replication group %s: %s", key, e)
+
+    def stop(self) -> None:
+        with self._lock:
+            for g in self.groups.values():
+                g.stop()
+            self.groups.clear()
+
+    # ------------------------------------------------------------- groups
+
+    def _members(self, db: str, pt_id: int) -> dict[str, str]:
+        """{node_id_str: store_addr} of the PT's raft members."""
+        self.meta.refresh()
+        md = self.meta.data()
+        pt = md.pt(db, pt_id)
+        if pt is None:
+            raise ValueError(f"unknown partition {db}/{pt_id}")
+        ids = [pt.owner] + list(pt.replicas)
+        peers = {}
+        for nid in ids:
+            node = md.nodes.get(nid)
+            if node is not None:
+                peers[str(nid)] = node.addr
+        return peers
+
+    def ensure_group(self, db: str, pt_id: int,
+                     fanout: bool = False) -> PartitionRaftGroup | None:
+        """Create (or return) this node's member of the PT group; with
+        fanout=True also pings the other members so they create theirs
+        (votes need a majority of live members)."""
+        key = group_key(db, pt_id)
+        with self._lock:
+            g = self.groups.get(key)
+        if g is None:
+            peers = self._members(db, pt_id)
+            me = str(self.store.node_id)
+            if me not in peers:
+                return None             # not a member of this group
+            with self._lock:
+                g = self.groups.get(key)
+                if g is None:
+                    g = PartitionRaftGroup(
+                        db, pt_id, self.store.node_id, peers,
+                        self.data_dir, self.store.server,
+                        self._apply_rows)
+                    self.groups[key] = g
+                    g.start()
+        if fanout:
+            peers = g.raft.peers
+            for nid, addr in peers.items():
+                if nid == str(self.store.node_id):
+                    continue
+                try:
+                    self.store.peer_call(addr, "store.ensure_group",
+                                         {"db": db, "pt": pt_id})
+                except RPCError as e:
+                    log.warning("ensure_group fanout to %s failed: %s",
+                                addr, e)
+        return g
+
+    def _apply_rows(self, db: str, pt: int, rows_wire) -> int:
+        """FSM apply — runs on every member when the entry commits."""
+        from .store_node import db_key, rows_from_wire
+        return self.store.engine.write_points(
+            db_key(db, pt), rows_from_wire(rows_wire))
+
+    # -------------------------------------------------------------- write
+
+    def write(self, db: str, pt_id: int, rows_wire) -> int:
+        """Replicated write: propose on the PT group; if this member is
+        not the group leader, forward the write to the leader member's
+        store (reference: raft messages routed between stores,
+        netstorage/storage.go:523)."""
+        g = self.ensure_group(db, pt_id, fanout=True)
+        if g is None:
+            raise ValueError(
+                f"node {self.store.node_id} is not a member of "
+                f"{db}/pt{pt_id}")
+        try:
+            return g.propose_rows(rows_wire)
+        except NotLeader:
+            leader = g.raft.wait_leader(5.0)
+            if leader is None or leader == str(self.store.node_id):
+                raise
+            addr = g.raft.peers.get(leader)
+            if addr is None:
+                raise
+            resp = self.store.peer_call(addr, "store.raft_write",
+                                        {"db": db, "pt": pt_id,
+                                         "rows": rows_wire})
+            return resp["written"]
