@@ -17,8 +17,10 @@ import threading
 from dataclasses import replace
 
 from ..query.ast import (CreateDatabaseStatement, DropDatabaseStatement,
-                         SelectStatement, ShowStatement)
-from ..query.executor import _classify_fields, finalize_partials
+                         FieldRef, SelectField, SelectStatement,
+                         ShowStatement)
+from ..query.executor import (classify_select, finalize_partials,
+                              transform_raw_result)
 from ..query.influxql import format_statement
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
@@ -135,19 +137,32 @@ class ClusterExecutor:
         if stmt.from_subquery is not None:
             return {"error": "subqueries not implemented yet"}
         mst = stmt.from_measurement
-        aggs, raw_fields, has_wildcard = _classify_fields(stmt)
-        if aggs and raw_fields:
-            return {"error": "mixing aggregate and non-aggregate queries "
-                             "is not supported"}
-        q = format_statement(stmt)
-        if aggs:
+        cs = classify_select(stmt)
+        if cs.mode == "agg":
+            q = format_statement(stmt)
             resps = self._scatter("store.select_partial", db, {"q": q})
             partials = [r["partial"] for r in resps]
-            return finalize_partials(stmt, mst, aggs, partials)
+            return finalize_partials(stmt, mst, cs, partials)
+        if cs.is_plain_raw:
+            q = format_statement(stmt)
+            resps = self._scatter("store.select_raw", db, {"q": q})
+            field_order = (None if cs.has_wildcard
+                           else [alias or name
+                                 for name, alias in cs.raw_fields])
+            return self._merge_raw(stmt, resps, field_order)
+        # expression / transform raw mode: ship a plain scan of the
+        # referenced fields (limits stripped — transforms change row
+        # counts), merge, then materialize at the sql node (the
+        # reference's sql-side Materialize/transform stage)
+        names = sorted(cs.raw_refs)
+        sub = replace(stmt,
+                      fields=[SelectField(FieldRef(n)) for n in names],
+                      limit=0, offset=0, slimit=0, soffset=0,
+                      order_desc=False)
+        q = format_statement(sub)
         resps = self._scatter("store.select_raw", db, {"q": q})
-        field_order = (None if has_wildcard
-                       else [alias or name for name, alias in raw_fields])
-        return self._merge_raw(stmt, resps, field_order)
+        merged = self._merge_raw(sub, resps, names)
+        return transform_raw_result(cs, stmt, merged)
 
     def _merge_raw(self, stmt: SelectStatement, resps: list,
                    field_order: list[str] | None = None) -> dict:

@@ -22,7 +22,7 @@ from dataclasses import replace
 
 from ..query.ast import SelectStatement, ShowStatement
 from ..query.condition import analyze_condition
-from ..query.executor import (QueryExecutor, _classify_fields,
+from ..query.executor import (QueryExecutor, classify_select,
                               merge_partials)
 from ..query.influxql import parse_query
 from ..storage.engine import Engine, EngineOptions
@@ -130,7 +130,7 @@ class StoreNode:
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
         mst = stmt.from_measurement
-        aggs, _raw, _wild = _classify_fields(stmt)
+        cs = classify_select(stmt)
         self.stats["selects"] += 1
         partials = []
         for pt in pts:
@@ -140,7 +140,7 @@ class StoreNode:
             tag_keys = {k for s in self.engine.database(dbk).all_shards()
                         for k in s.index.tag_keys(mst)}
             cond = analyze_condition(stmt.condition, tag_keys)
-            p = self.executor.partial_agg(stmt, dbk, mst, aggs, cond,
+            p = self.executor.partial_agg(stmt, dbk, mst, cs, cond,
                                           tag_keys)
             if p is not None:
                 partials.append(p)

@@ -41,7 +41,7 @@ _F64 = jnp.float64
 _I64 = jnp.int64
 
 # aggregates computed by the fused kernel
-ALL_AGGS = ("count", "sum", "min", "max", "first", "last")
+ALL_AGGS = ("count", "sum", "sumsq", "min", "max", "first", "last")
 
 
 class AggSpec(NamedTuple):
@@ -49,6 +49,7 @@ class AggSpec(NamedTuple):
     rest after fusion, but being explicit also skips gather setup)."""
     count: bool = True
     sum: bool = True
+    sumsq: bool = False
     min: bool = False
     max: bool = False
     first: bool = False
@@ -58,10 +59,16 @@ class AggSpec(NamedTuple):
     def of(cls, *names: str) -> "AggSpec":
         names_set = set(names)
         for n in names_set:
-            if n not in ALL_AGGS and n not in ("mean",):
+            if n not in ALL_AGGS and n not in ("mean", "stddev"):
                 raise ValueError(f"unknown aggregate {n}")
         if "mean" in names_set:
             names_set |= {"count", "sum"}
+        if "stddev" in names_set:
+            # stddev finalizes from the (count, sum, sumsq) mergeable state
+            # (the reference's FloatStddevReduce keeps raw slices instead —
+            # engine/series_agg_func.gen.go — but moment form is the
+            # device-friendly mergeable formulation)
+            names_set |= {"count", "sum", "sumsq"}
         return cls(**{k: (k in names_set) for k in ALL_AGGS})
 
 
@@ -73,6 +80,7 @@ class SegmentAggResult(NamedTuple):
     min/max, first/last pick by time)."""
     count: jax.Array | None = None
     sum: jax.Array | None = None
+    sumsq: jax.Array | None = None
     min: jax.Array | None = None
     max: jax.Array | None = None
     first: jax.Array | None = None        # value at earliest valid time
@@ -122,6 +130,10 @@ def _segment_all(values, valid, seg_ids, num_segments: int,
         s = jax.ops.segment_sum(vz, seg_ids, ns,
                                 indices_are_sorted=sorted_ids)
         res["sum"] = s[:num_segments]
+    if spec.sumsq:
+        sq = jax.ops.segment_sum(vz * vz, seg_ids, ns,
+                                 indices_are_sorted=sorted_ids)
+        res["sumsq"] = sq[:num_segments]
     if spec.min:
         vmin = jnp.where(valid, values, jnp.array(jnp.inf, fdt))
         res["min"] = jax.ops.segment_min(vmin, seg_ids, ns,
@@ -172,7 +184,7 @@ def segment_aggregate(values: jax.Array,
             last = jnp.where(has, values[safe], jnp.nan)
             last_t = jnp.where(has, times[safe], 0)
     return SegmentAggResult(
-        count=res.get("count"), sum=res.get("sum"),
+        count=res.get("count"), sum=res.get("sum"), sumsq=res.get("sumsq"),
         min=res.get("min"), max=res.get("max"),
         first=first, last=last, first_time=first_t, last_time=last_t)
 
@@ -189,6 +201,8 @@ def dense_window_aggregate(values: jax.Array,
     fdt = values.dtype
     vz = jnp.where(valid, values, jnp.zeros((), fdt))
     out = {"count": valid.sum(axis=1, dtype=_I64), "sum": vz.sum(axis=1)}
+    if spec.sumsq:
+        out["sumsq"] = (vz * vz).sum(axis=1)
     if spec.min:
         out["min"] = jnp.where(valid, values, jnp.array(jnp.inf, fdt)).min(axis=1)
     if spec.max:
@@ -216,7 +230,7 @@ def dense_window_aggregate(values: jax.Array,
                 last_t = jnp.where(has, jnp.take_along_axis(
                     times, safe[:, None], axis=1)[:, 0], 0)
     return SegmentAggResult(
-        count=out["count"], sum=out["sum"],
+        count=out["count"], sum=out["sum"], sumsq=out.get("sumsq"),
         min=out.get("min"), max=out.get("max"),
         first=first, last=last, first_time=first_t, last_time=last_t)
 
@@ -247,6 +261,7 @@ def merge_seg_results(a: SegmentAggResult,
     return SegmentAggResult(
         count=m(a.count, b.count, jnp.add),
         sum=m(a.sum, b.sum, jnp.add),
+        sumsq=m(a.sumsq, b.sumsq, jnp.add),
         min=m(a.min, b.min, jnp.minimum),
         max=m(a.max, b.max, jnp.maximum),
         first=first, last=last, first_time=first_t, last_time=last_t)

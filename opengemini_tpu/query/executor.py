@@ -7,39 +7,38 @@ transform DAG:
 
     IndexScan (tagsets)  →  Reader (shard scan + decode)  →
     WindowAgg on TPU (segment_aggregate — the aggregateCursor/series_agg_func
-    analog)  →  final merge/fill/limit on host (HashMerge/Fill/Limit
-    transforms analog)
+    analog)  →  final merge/materialize/fill/limit on host (HashMerge/
+    Materialize/Fill/Limit transforms analog)
 
-Raw (non-aggregate) selects skip the device stage.
+Raw (non-aggregate) selects skip the device stage. The select-list function
+surface (selectors, transforms, math) lives in functions.py — this module
+wires states through partial → merge → finalize.
 """
 
 from __future__ import annotations
-
-from dataclasses import dataclass
 
 import numpy as np
 
 from ..record import DataType
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError
-from .ast import (BinaryExpr, Call, FieldRef, Literal, SelectStatement,
-                  ShowStatement, Wildcard, CreateDatabaseStatement,
+from .ast import (SelectStatement, ShowStatement, CreateDatabaseStatement,
                   CreateMeasurementStatement, DropDatabaseStatement,
                   DropMeasurementStatement, DeleteStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
+from .functions import (AGG_FUNCS, MOMENT_AGGS, AggItem, AggRef, BinOp,
+                        ClassifiedSelect, MathExpr, Num, RawRef, Transform,
+                        apply_math, apply_window_transform, classify_select,
+                        eval_output_grid, finalize_moment, finalize_raw_agg,
+                        spec_names_for, topn_final, topn_partial)
 
 log = get_logger(__name__)
 
-AGG_FUNCS = {"count", "sum", "mean", "min", "max", "first", "last",
-             "spread"}
+__all__ = ["QueryExecutor", "classify_select", "merge_partials",
+           "finalize_partials", "transform_raw_result", "AGG_FUNCS",
+           "AggItem"]
+
 MAX_WINDOWS = 100_000
-
-
-@dataclass
-class AggItem:
-    func: str
-    field: str
-    output: str       # column name in result
 
 
 class QueryExecutor:
@@ -166,20 +165,15 @@ class QueryExecutor:
         if stmt.from_subquery is not None:
             return {"error": "subqueries not implemented yet"}
         mst = stmt.from_measurement
-        aggs, raw_fields, has_wildcard = _classify_fields(stmt)
-        if aggs and raw_fields:
-            return {"error":
-                    "mixing aggregate and non-aggregate queries is not "
-                    "supported"}
+        cs = classify_select(stmt)
         # tag key universe for condition analysis
         shards_all = self.engine.database(db).all_shards()
         tag_keys = {k for s in shards_all for k in s.index.tag_keys(mst)}
         cond = analyze_condition(stmt.condition, tag_keys)
-        if aggs:
-            res = self._select_agg(stmt, db, mst, aggs, cond, tag_keys)
+        if cs.mode == "agg":
+            res = self._select_agg(stmt, db, mst, cs, cond, tag_keys)
         else:
-            res = self._select_raw(stmt, db, mst, raw_fields, has_wildcard,
-                                   cond, tag_keys)
+            res = self._select_raw(stmt, db, mst, cs, cond, tag_keys)
         if stmt.into_measurement:
             return self._write_into(stmt, db, res)
         return res
@@ -206,12 +200,12 @@ class QueryExecutor:
 
     # ---- aggregate path --------------------------------------------------
 
-    def _select_agg(self, stmt, db, mst, aggs: list[AggItem], cond,
+    def _select_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
                     tag_keys) -> dict:
-        partial = self.partial_agg(stmt, db, mst, aggs, cond, tag_keys)
-        return finalize_partials(stmt, mst, aggs, [partial])
+        partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys)
+        return finalize_partials(stmt, mst, cs, [partial])
 
-    def partial_agg(self, stmt, db, mst, aggs: list[AggItem], cond,
+    def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
                     tag_keys) -> dict | None:
         """Store-side partial aggregation: scan this engine's shards and
         reduce on device into per-(group, window) mergeable states.
@@ -221,11 +215,15 @@ class QueryExecutor:
         heu_rule.go:346 executing inside ts-store); the returned dict is
         the wire format the sql node merges with finalize_partials (the
         exchange/HashMerge stage). All values are numpy/JSON — the RPC
-        codec ships them zero-copy.
+        codec ships them zero-copy. Moment aggregates travel as (G, W)
+        state grids; exact-semantics aggregates (percentile/mode/...)
+        travel as raw per-cell slices; top/bottom travel as capped
+        per-cell top-N (mergeable — engine/topn_linkedlist.go analog).
         """
         from ..ops import AggSpec, segment_aggregate, window_ids, pad_bucket
         from ..ops.segment_agg import pad_rows
 
+        aggs = cs.aggs
         interval = stmt.group_by_interval()
         offset = stmt.group_by_offset()
         group_tags = (sorted(tag_keys) if stmt.group_by_star
@@ -333,16 +331,17 @@ class QueryExecutor:
         # count is always computed: empty-window masking and fill need it
         spec_names = {"count"}
         for a in aggs:
-            if a.func in ("mean", "count", "sum"):
-                spec_names.update({"count", "sum"})
-            elif a.func in ("min", "max", "first", "last"):
-                spec_names.add(a.func)
-            elif a.func == "spread":
-                spec_names.update({"min", "max"})
+            spec_names |= spec_names_for(a)
         spec = AggSpec.of(*spec_names)
+
+        # fields whose raw per-(group, window) slices must ship
+        raw_fields = sorted({a.field for a in aggs if a.needs_raw}
+                            | {a.field for a in aggs
+                               if a.func in ("top", "bottom")})
 
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
+        raw_slices: dict[str, dict] = {}
         npad = pad_bucket(n_rows)
         seg_p, times_p = pad_rows([seg, times], npad, seg_fill=num_segments)
         for fname in needed_fields:
@@ -366,6 +365,9 @@ class QueryExecutor:
                                     sorted_ids=seg_sorted)
             field_results[fname] = res
             field_types[fname] = ftype
+            if fname in raw_fields:
+                raw_slices[fname] = _collect_raw_slices(
+                    seg, vals, valid, times, G, W)
 
         group_keys = [None] * G
         for key, gi in global_groups.items():
@@ -373,13 +375,13 @@ class QueryExecutor:
         fields_out: dict[str, dict] = {}
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
-            for k in ("count", "sum", "min", "max", "first", "last",
-                      "first_time", "last_time"):
+            for k in ("count", "sum", "sumsq", "min", "max", "first",
+                      "last", "first_time", "last_time"):
                 v = getattr(res, k)
                 if v is not None:
                     st[k] = np.asarray(v).reshape(G, W)
             fields_out[fname] = st
-        return {
+        partial = {
             "group_tags": group_tags,
             "group_keys": [list(k) for k in group_keys],
             "interval": interval or 0,
@@ -389,10 +391,37 @@ class QueryExecutor:
             "field_types": {f: _ftype_name(t)
                             for f, t in field_types.items()},
         }
+        # raw slices for exact-semantics aggregates
+        raw_need = {a.field for a in aggs if a.needs_raw}
+        if raw_need:
+            partial["raw"] = {f: raw_slices[f] for f in sorted(raw_need)}
+        # capped top/bottom partial state
+        tb = [a for a in aggs if a.func in ("top", "bottom")]
+        if tb:
+            item = tb[0]
+            n = int(item.arg)
+            largest = item.func == "top"
+            sl = raw_slices[item.field]
+            tvals = [[None] * W for _ in range(G)]
+            ttimes = [[None] * W for _ in range(G)]
+            for gi in range(G):
+                for wi in range(W):
+                    v = sl["vals"][gi][wi]
+                    if v is None or len(v) == 0:
+                        continue
+                    tv, tt = topn_partial(np.asarray(v),
+                                          np.asarray(sl["times"][gi][wi]),
+                                          n, largest)
+                    tvals[gi][wi] = tv
+                    ttimes[gi][wi] = tt
+            partial["topn"] = {"field": item.field, "n": n,
+                               "largest": largest,
+                               "vals": tvals, "times": ttimes}
+        return partial
 
     # ---- raw path --------------------------------------------------------
 
-    def _select_raw(self, stmt, db, mst, raw_fields, has_wildcard, cond,
+    def _select_raw(self, stmt, db, mst, cs: ClassifiedSelect, cond,
                     tag_keys) -> dict:
         db_obj = self.engine.database(db)
         t_min, t_max = cond.t_min, cond.t_max
@@ -400,19 +429,21 @@ class QueryExecutor:
                   if cond.has_time_range else db_obj.all_shards())
         group_tags = (sorted(tag_keys) if stmt.group_by_star
                       else stmt.group_by_tags())
+        plain = cs.is_plain_raw
 
         # field schema across shards
         all_fields: dict[str, DataType] = {}
         for s in shards:
             all_fields.update(s._schemas.get(mst, {}))
-        if has_wildcard:
+        if cs.has_wildcard:
             pairs = [(n, None) for n in sorted(all_fields)]
         else:
-            pairs = raw_fields
+            pairs = cs.raw_fields if plain else \
+                [(n, None) for n in sorted(cs.raw_refs)]
         sel_names = [n for n, _a in pairs]
         display = [a or n for n, a in pairs]
         field_names = [n for n in sel_names if n in all_fields]
-        if not field_names:
+        if not field_names and not any(n in tag_keys for n in sel_names):
             return {}
         # residual-predicate fields must be scanned even if not selected
         scan_names = sorted(set(field_names) | cond.residual_fields())
@@ -481,11 +512,13 @@ class QueryExecutor:
                         else:
                             row.append(None if col is None else col.get(i))
                     rows.append(row)
-            rows.sort(key=lambda r: r[0], reverse=stmt.order_desc)
-            if stmt.offset:
-                rows = rows[stmt.offset:]
-            if stmt.limit:
-                rows = rows[:stmt.limit]
+            rows.sort(key=lambda r: r[0], reverse=(plain
+                                                   and stmt.order_desc))
+            if plain:
+                if stmt.offset:
+                    rows = rows[stmt.offset:]
+                if stmt.limit:
+                    rows = rows[:stmt.limit]
             if not rows:
                 continue
             entry = {"name": mst, "columns": ["time"] + display,
@@ -493,11 +526,15 @@ class QueryExecutor:
             if group_tags:
                 entry["tags"] = dict(zip(group_tags, key))
             series_out.append(entry)
-        if stmt.soffset:
-            series_out = series_out[stmt.soffset:]
-        if stmt.slimit:
-            series_out = series_out[:stmt.slimit]
-        return {"series": series_out} if series_out else {}
+        if plain:
+            if stmt.soffset:
+                series_out = series_out[stmt.soffset:]
+            if stmt.slimit:
+                series_out = series_out[:stmt.slimit]
+        res = {"series": series_out} if series_out else {}
+        if not plain:
+            res = transform_raw_result(cs, stmt, res)
+        return res
 
 
 # ---------------------------------------------------- partial-agg merge
@@ -506,9 +543,33 @@ _I64MAX = np.iinfo(np.int64).max
 _I64MIN = np.iinfo(np.int64).min
 
 # identity elements per state key (for merge targets)
-_IDENT = {"count": 0, "sum": 0.0, "min": np.inf, "max": -np.inf,
+_IDENT = {"count": 0, "sum": 0.0, "sumsq": 0.0,
+          "min": np.inf, "max": -np.inf,
           "first": np.nan, "last": np.nan,
           "first_time": _I64MAX, "last_time": _I64MIN}
+
+
+def _collect_raw_slices(seg, vals, valid, times, G: int, W: int) -> dict:
+    """Split rows into per-(group, window) raw value/time slices — the
+    wire state of exact-semantics aggregates (the reference keeps raw
+    slices in its percentile/median reducers too)."""
+    keep = valid & (seg < G * W)
+    s = seg[keep]
+    v = vals[keep]
+    t = times[keep]
+    order = np.argsort(s, kind="stable")
+    s, v, t = s[order], v[order], t[order]
+    out_v = [[None] * W for _ in range(G)]
+    out_t = [[None] * W for _ in range(G)]
+    if len(s):
+        bounds = np.nonzero(np.diff(s))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(s)]])
+        for b, e in zip(starts, ends):
+            gi, wi = divmod(int(s[b]), W)
+            out_v[gi][wi] = v[b:e]
+            out_t[gi][wi] = t[b:e]
+    return {"vals": out_v, "times": out_t}
 
 
 def merge_partials(partials: list[dict | None]) -> dict | None:
@@ -570,10 +631,9 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
             off = int((p["start"] - start) // interval) if interval else 0
             cols = np.arange(off, off + p["W"])
             ix = np.ix_(rows, cols)
-            if "count" in tgt and "count" in st:
-                tgt["count"][ix] += st["count"]
-            if "sum" in tgt and "sum" in st:
-                tgt["sum"][ix] += st["sum"]
+            for k in ("count", "sum", "sumsq"):
+                if k in tgt and k in st:
+                    tgt[k][ix] += st[k]
             if "min" in tgt and "min" in st:
                 tgt["min"][ix] = np.minimum(tgt["min"][ix], st["min"])
             if "max" in tgt and "max" in st:
@@ -604,15 +664,86 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
     group_keys = [None] * G
     for k, gi in key_to_gi.items():
         group_keys[gi] = list(k)
-    return {"group_tags": group_tags, "group_keys": group_keys,
-            "interval": interval, "start": int(start), "W": W,
-            "fields": merged_fields, "field_types": field_types}
+    merged = {"group_tags": group_tags, "group_keys": group_keys,
+              "interval": interval, "start": int(start), "W": W,
+              "fields": merged_fields, "field_types": field_types}
+
+    # ---- raw slices: concatenate per-cell across partials
+    raw_names = sorted(set().union(*[p.get("raw", {}).keys()
+                                     for p in partials]))
+    if raw_names:
+        merged_raw = {}
+        for fname in raw_names:
+            acc_v = [[[] for _ in range(W)] for _ in range(G)]
+            acc_t = [[[] for _ in range(W)] for _ in range(G)]
+            for pi, p in enumerate(partials):
+                st = p.get("raw", {}).get(fname)
+                if st is None:
+                    continue
+                off = int((p["start"] - start) // interval) \
+                    if interval else 0
+                for lgi, gi in enumerate(
+                        key_to_gi[k] for k in aligned_keys[pi]):
+                    for wi in range(p["W"]):
+                        cell = st["vals"][lgi][wi]
+                        if cell is None or len(cell) == 0:
+                            continue
+                        acc_v[gi][off + wi].append(np.asarray(cell))
+                        acc_t[gi][off + wi].append(
+                            np.asarray(st["times"][lgi][wi]))
+            merged_raw[fname] = {
+                "vals": [[np.concatenate(c) if c else None for c in row]
+                         for row in acc_v],
+                "times": [[np.concatenate(c) if c else None for c in row]
+                          for row in acc_t]}
+        merged["raw"] = merged_raw
+
+    # ---- top/bottom: concat then re-cap (top-N of union == top-N of
+    # concatenated per-store top-Ns)
+    tps = [p["topn"] for p in partials if "topn" in p]
+    if tps:
+        n = tps[0]["n"]
+        largest = tps[0]["largest"]
+        acc_v = [[[] for _ in range(W)] for _ in range(G)]
+        acc_t = [[[] for _ in range(W)] for _ in range(G)]
+        for pi, p in enumerate(partials):
+            st = p.get("topn")
+            if st is None:
+                continue
+            off = int((p["start"] - start) // interval) if interval else 0
+            for lgi, gi in enumerate(
+                    key_to_gi[k] for k in aligned_keys[pi]):
+                for wi in range(p["W"]):
+                    cell = st["vals"][lgi][wi]
+                    if cell is None or len(cell) == 0:
+                        continue
+                    acc_v[gi][off + wi].append(np.asarray(cell))
+                    acc_t[gi][off + wi].append(
+                        np.asarray(st["times"][lgi][wi]))
+        tvals = [[None] * W for _ in range(G)]
+        ttimes = [[None] * W for _ in range(G)]
+        for gi in range(G):
+            for wi in range(W):
+                if not acc_v[gi][wi]:
+                    continue
+                v = np.concatenate(acc_v[gi][wi])
+                t = np.concatenate(acc_t[gi][wi])
+                tvals[gi][wi], ttimes[gi][wi] = topn_partial(
+                    v, t, n, largest)
+        merged["topn"] = {"field": tps[0]["field"], "n": n,
+                          "largest": largest, "vals": tvals,
+                          "times": ttimes}
+    return merged
 
 
-def finalize_partials(stmt, mst: str, aggs: list[AggItem],
-                      partials: list[dict | None]) -> dict:
-    """Merge partials and build the influx-style result (the sql node's
-    final transforms: fill, order, limit, series assembly)."""
+# -------------------------------------------------------------- finalize
+
+def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
+                      ) -> dict:
+    """Merge partials and build the influx-style result: evaluate the
+    select-list expressions on the merged state grids, apply fill, run
+    window transforms, assemble rows (the sql node's Materialize/Fill/
+    Order/Limit transforms)."""
     merged = merge_partials(partials)
     if merged is None:
         return {}
@@ -624,58 +755,125 @@ def finalize_partials(stmt, mst: str, aggs: list[AggItem],
     G = len(group_keys)
     fields = merged["fields"]
     field_types = merged["field_types"]
-
-    out_cols = [np.asarray(_finalize_agg(a.func, fields[a.field]))
-                for a in aggs]
-    anyc = np.zeros((G, W), dtype=np.int64)
-    for a in aggs:
-        c = fields[a.field].get("count")
-        anyc += c if c is not None else 1
+    aggs = cs.aggs
 
     win_times = start + interval * np.arange(W) if interval else \
         np.array([start], dtype=np.int64)
+
+    if cs.multirow is not None:
+        return _finalize_multirow(stmt, mst, cs, merged, win_times,
+                                  group_tags, group_keys)
+
+    # ---- base aggregate grids + per-agg presence
+    agg_grids: list[np.ndarray] = []
+    agg_present: list[np.ndarray] = []
+    for a in aggs:
+        st = fields.get(a.field, {})
+        cnt = st.get("count")
+        present = (cnt > 0) if cnt is not None \
+            else np.zeros((G, W), dtype=bool)
+        if a.func in MOMENT_AGGS:
+            grid = finalize_moment(a.func, st)
+        else:
+            raw = merged.get("raw", {}).get(a.field)
+            if raw is None:
+                grid = np.full((G, W), np.nan)
+            else:
+                grid = finalize_raw_agg(a, raw, G, W)
+        agg_grids.append(np.asarray(grid, dtype=np.float64))
+        agg_present.append(present)
+
+    anyc = np.zeros((G, W), dtype=bool)
+    for p in agg_present:
+        anyc |= p
+
+    # ---- output grids / transforms
+    out_specs = []        # (name, kind, payload)
+    for name, expr in cs.outputs:
+        if isinstance(expr, Transform):
+            out_specs.append((name, "transform", expr))
+        else:
+            grid = eval_output_grid(expr, agg_grids)
+            grid = np.broadcast_to(np.asarray(grid, dtype=np.float64),
+                                   (G, W))
+            pres = _expr_presence(expr, agg_present, G, W)
+            out_specs.append((name, "plain", (grid, pres)))
+    n_out = len(out_specs)
+    casts = [_output_cast(expr, aggs, field_types)
+             for _name, expr in cs.outputs]
 
     series_out = []
     order = sorted(range(G), key=lambda gi: group_keys[gi])
     for gi in order:
         tags = dict(zip(group_tags, group_keys[gi]))
-        rows = []
-        prev = [None] * len(aggs)
-        for wi in range(W):
-            has = anyc[gi, wi] > 0
-            if not has:
+        cells: dict[int, list] = {}    # time -> row cell list
+
+        def cell_row(t: int) -> list:
+            r = cells.get(t)
+            if r is None:
+                r = cells[t] = [None] * n_out
+            return r
+
+        prev = [None] * n_out
+        # linear fill precompute per plain output
+        lin = {}
+        if stmt.fill_option == "linear" and interval:
+            for oi, (_n, kind, payload) in enumerate(out_specs):
+                if kind != "plain":
+                    continue
+                grid, pres = payload
+                m = anyc[gi] & pres[gi] & ~np.isnan(grid[gi])
+                if m.sum() >= 2:
+                    idx = np.arange(W)
+                    lin[oi] = np.interp(idx, idx[m], grid[gi][m],
+                                        left=np.nan, right=np.nan)
+        have_plain = any(k == "plain" for _n, k, _p in out_specs)
+        if have_plain:
+            for wi in range(W):
+                t = int(win_times[wi])
+                if anyc[gi, wi]:
+                    row = cell_row(t)
+                    for oi, (_n, kind, payload) in enumerate(out_specs):
+                        if kind != "plain":
+                            continue
+                        grid, pres = payload
+                        v = grid[gi, wi]
+                        if pres[gi, wi] and not np.isnan(v) \
+                                and not np.isinf(v):
+                            row[oi] = casts[oi](v)
+                            prev[oi] = row[oi]
+                    continue
+                # empty window: fill
                 if not interval or stmt.fill_option == "none":
                     continue
-                if stmt.fill_option == "null":
-                    rows.append([int(win_times[wi])] + [None] * len(aggs))
-                    continue
-                if stmt.fill_option == "value":
-                    rows.append([int(win_times[wi])]
-                                + [stmt.fill_value] * len(aggs))
-                    continue
-                if stmt.fill_option == "previous":
-                    rows.append([int(win_times[wi])] + list(prev))
-                    continue
+                row = None
+                for oi, (_n, kind, payload) in enumerate(out_specs):
+                    if kind != "plain":
+                        continue
+                    if stmt.fill_option == "null":
+                        row = cell_row(t)
+                    elif stmt.fill_option == "value":
+                        cell_row(t)[oi] = casts[oi](stmt.fill_value)
+                    elif stmt.fill_option == "previous":
+                        cell_row(t)[oi] = prev[oi]
+                    elif stmt.fill_option == "linear":
+                        v = lin.get(oi, np.full(W, np.nan))[wi]
+                        cell_row(t)[oi] = None if np.isnan(v) \
+                            else casts[oi](v)
+        # transforms
+        for oi, (_n, kind, expr) in enumerate(out_specs):
+            if kind != "transform":
                 continue
-            row = [int(win_times[wi])]
-            for ai, a in enumerate(aggs):
-                cnt_arr = fields[a.field].get("count")
-                cnt = cnt_arr[gi, wi] if cnt_arr is not None else 1
-                if cnt == 0:
-                    row.append(None)
-                    continue
-                v = float(out_cols[ai][gi, wi])
-                if a.func == "count":
-                    v = int(v)
-                elif (field_types.get(a.field) == "integer"
-                      and a.func in ("sum", "min", "max", "first",
-                                     "last", "spread")):
-                    v = int(v)
-                row.append(v)
-                prev[ai] = row[-1]
-            rows.append(row)
-        if not rows:
+            t_ser, v_ser = _transform_series(
+                stmt, expr, agg_grids, agg_present, anyc, gi, win_times,
+                interval, W)
+            for t, v in zip(t_ser, v_ser):
+                if not (np.isnan(v) or np.isinf(v)):
+                    cell_row(int(t))[oi] = casts[oi](v)
+
+        if not cells:
             continue
+        rows = [[t] + cells[t] for t in sorted(cells)]
         if stmt.order_desc:
             rows.reverse()
         if stmt.offset:
@@ -685,7 +883,7 @@ def finalize_partials(stmt, mst: str, aggs: list[AggItem],
         if not rows:
             continue
         entry = {"name": mst,
-                 "columns": ["time"] + [a.output for a in aggs],
+                 "columns": ["time"] + [n for n, _k, _p in out_specs],
                  "values": rows}
         if group_tags:
             entry["tags"] = tags
@@ -695,6 +893,290 @@ def finalize_partials(stmt, mst: str, aggs: list[AggItem],
     if stmt.slimit:
         series_out = series_out[:stmt.slimit]
     return {"series": series_out} if series_out else {}
+
+
+def _transform_series(stmt, expr: Transform, agg_grids, agg_present,
+                      anyc, gi: int, win_times, interval: int, W: int):
+    """One group's window series → fill → window transform. Influx applies
+    fill before transforms (lib/util/lifted/influx/query select
+    semantics)."""
+    child_grid = np.broadcast_to(
+        np.asarray(eval_output_grid(expr.child, agg_grids),
+                   dtype=np.float64), anyc.shape)
+    pres = _expr_presence(expr.child, agg_present, *anyc.shape)
+    m = anyc[gi] & pres[gi] & ~np.isnan(child_grid[gi]) \
+        & ~np.isinf(child_grid[gi])
+    fill = stmt.fill_option
+    if fill in ("none", "null") or not interval:
+        times = win_times[m]
+        values = child_grid[gi][m]
+    elif fill == "value":
+        times = win_times
+        values = np.where(m, child_grid[gi], stmt.fill_value)
+    elif fill == "previous":
+        vals = child_grid[gi].copy()
+        seen = False
+        cur = np.nan
+        for wi in range(W):
+            if m[wi]:
+                cur = vals[wi]
+                seen = True
+            elif seen:
+                vals[wi] = cur
+            else:
+                vals[wi] = np.nan
+        keep = ~np.isnan(vals)
+        times = win_times[keep]
+        values = vals[keep]
+    elif fill == "linear":
+        idx = np.arange(W)
+        if m.sum() >= 2:
+            vals = np.interp(idx, idx[m], child_grid[gi][m],
+                             left=np.nan, right=np.nan)
+        else:
+            vals = np.where(m, child_grid[gi], np.nan)
+        keep = ~np.isnan(vals)
+        times = win_times[keep]
+        values = vals[keep]
+    else:
+        times = win_times[m]
+        values = child_grid[gi][m]
+    return apply_window_transform(expr.func, expr.params,
+                                  np.asarray(times, dtype=np.int64),
+                                  np.asarray(values, dtype=np.float64))
+
+
+def _finalize_multirow(stmt, mst: str, cs, merged, win_times,
+                       group_tags, group_keys) -> dict:
+    """top/bottom/distinct/sample: multiple rows per (group, window)."""
+    item = cs.multirow
+    out_name = cs.outputs[0][0]
+    G = len(group_keys)
+    W = merged["W"]
+    is_int = merged["field_types"].get(item.field) == "integer"
+
+    def cast(v: float):
+        return int(v) if is_int else float(v)
+
+    series_out = []
+    order = sorted(range(G), key=lambda gi: group_keys[gi])
+    rng = np.random.default_rng(0)
+    for gi in order:
+        rows = []
+        for wi in range(W):
+            if item.func in ("top", "bottom"):
+                st = merged.get("topn")
+                if st is None:
+                    continue
+                v = st["vals"][gi][wi]
+                if v is None or len(v) == 0:
+                    continue
+                t = st["times"][gi][wi]
+                for pt, pv in topn_final(np.asarray(v), np.asarray(t),
+                                         st["n"], st["largest"]):
+                    rows.append([pt, cast(pv)])
+            elif item.func == "distinct":
+                raw = merged.get("raw", {}).get(item.field)
+                if raw is None:
+                    continue
+                v = raw["vals"][gi][wi]
+                if v is None or len(v) == 0:
+                    continue
+                wt = int(win_times[wi])
+                for dv in np.unique(np.asarray(v)):
+                    rows.append([wt, cast(dv)])
+            elif item.func == "sample":
+                raw = merged.get("raw", {}).get(item.field)
+                if raw is None:
+                    continue
+                v = raw["vals"][gi][wi]
+                if v is None or len(v) == 0:
+                    continue
+                t = np.asarray(raw["times"][gi][wi])
+                v = np.asarray(v)
+                n = int(item.arg)
+                if len(v) > n:
+                    pick = rng.choice(len(v), size=n, replace=False)
+                else:
+                    pick = np.arange(len(v))
+                pick = pick[np.argsort(t[pick], kind="stable")]
+                for i in pick:
+                    rows.append([int(t[i]), cast(v[i])])
+        if stmt.order_desc:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[:stmt.limit]
+        if not rows:
+            continue
+        entry = {"name": mst, "columns": ["time", out_name],
+                 "values": rows}
+        if group_tags:
+            entry["tags"] = dict(zip(group_tags, group_keys[gi]))
+        series_out.append(entry)
+    if stmt.soffset:
+        series_out = series_out[stmt.soffset:]
+    if stmt.slimit:
+        series_out = series_out[:stmt.slimit]
+    return {"series": series_out} if series_out else {}
+
+
+def _expr_presence(expr, agg_present: list[np.ndarray], G: int, W: int
+                   ) -> np.ndarray:
+    """Cell present iff every referenced aggregate has data there."""
+    refs: list[int] = []
+
+    def walk(e):
+        if isinstance(e, AggRef):
+            refs.append(e.idx)
+        elif isinstance(e, MathExpr):
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, BinOp):
+            walk(e.lhs), walk(e.rhs)
+        elif isinstance(e, Transform):
+            walk(e.child)
+    walk(expr)
+    if not refs:
+        return np.ones((G, W), dtype=bool)
+    pres = np.ones((G, W), dtype=bool)
+    for i in refs:
+        pres &= agg_present[i]
+    return pres
+
+
+def _output_cast(expr, aggs: list[AggItem], field_types: dict):
+    """Result cell formatting: count-like → int; selector-like on integer
+    fields → int; computed expressions → float."""
+    if isinstance(expr, AggRef):
+        a = aggs[expr.idx]
+        if a.func in ("count", "count_distinct"):
+            return lambda v: int(v)
+        if (field_types.get(a.field) == "integer"
+                and a.func in ("sum", "min", "max", "first", "last",
+                               "spread", "mode", "percentile")):
+            return lambda v: int(v)
+    return lambda v: float(v)
+
+
+# -------------------------------------------- raw expression evaluation
+
+def transform_raw_result(cs: ClassifiedSelect, stmt, result: dict) -> dict:
+    """Evaluate raw-mode expression outputs (math / binops / per-series
+    transforms like derivative) over a merged plain raw result whose
+    columns are [time, <raw fields...>]. Applies order/offset/limit after
+    the transforms (transforms change row counts). This is the sql-side
+    Materialize/transform stage of the reference for raw queries."""
+    if "series" not in result:
+        return result
+    has_transform = cs.has_transform
+    out_series = []
+    for s in result["series"]:
+        cols = s["columns"]
+        vals = s["values"]
+        colidx = {c: i for i, c in enumerate(cols)}
+        times = np.array([r[0] for r in vals], dtype=np.int64)
+
+        def col_num(name):
+            i = colidx.get(name)
+            if i is None:
+                return np.full(len(vals), np.nan)
+            return np.array(
+                [r[i] if isinstance(r[i], (int, float))
+                 and not isinstance(r[i], bool) else np.nan
+                 for r in vals], dtype=np.float64)
+
+        def col_any(name):
+            i = colidx.get(name)
+            if i is None:
+                return [None] * len(vals)
+            return [r[i] for r in vals]
+
+        if not has_transform:
+            # row-aligned evaluation: output rows match input rows
+            out_cols = []
+            for _name, expr in cs.outputs:
+                if isinstance(expr, RawRef):
+                    out_cols.append(col_any(expr.name))
+                else:
+                    arr = _eval_rowwise(expr, col_num)
+                    out_cols.append([None if (isinstance(v, float)
+                                              and (np.isnan(v)
+                                                   or np.isinf(v)))
+                                     else float(v) for v in arr])
+            rows = [[int(t)] + [c[i] for c in out_cols]
+                    for i, t in enumerate(times)]
+            # drop rows where every output is null (e.g. math over a
+            # field absent on this series)
+            rows = [r for r in rows if any(c is not None for c in r[1:])]
+        else:
+            # per-series transforms: each output yields its own series
+            cells: dict[int, list] = {}
+            n_out = len(cs.outputs)
+            for oi, (_name, expr) in enumerate(cs.outputs):
+                if isinstance(expr, Transform):
+                    child = _eval_rowwise(expr.child, col_num)
+                    keep = ~(np.isnan(child) | np.isinf(child))
+                    t_ser, v_ser = apply_window_transform(
+                        expr.func, expr.params, times[keep], child[keep])
+                else:
+                    arr = _eval_rowwise(expr, col_num)
+                    keep = ~(np.isnan(arr) | np.isinf(arr))
+                    t_ser, v_ser = times[keep], arr[keep]
+                for t, v in zip(t_ser, v_ser):
+                    row = cells.setdefault(int(t), [None] * n_out)
+                    row[oi] = float(v)
+            rows = [[t] + cells[t] for t in sorted(cells)]
+        if stmt.order_desc:
+            rows.sort(key=lambda r: r[0], reverse=True)
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[:stmt.limit]
+        if not rows:
+            continue
+        entry = {"name": s["name"],
+                 "columns": ["time"] + [n for n, _e in cs.outputs],
+                 "values": rows}
+        if s.get("tags"):
+            entry["tags"] = s["tags"]
+        out_series.append(entry)
+    if stmt.soffset:
+        out_series = out_series[stmt.soffset:]
+    if stmt.slimit:
+        out_series = out_series[:stmt.slimit]
+    return {"series": out_series} if out_series else {}
+
+
+def _eval_rowwise(expr, col_num) -> np.ndarray:
+    """Evaluate a numeric expression per row; None → NaN."""
+    if isinstance(expr, RawRef):
+        return col_num(expr.name)
+    if isinstance(expr, Num):
+        return np.float64(expr.value)
+    if isinstance(expr, BinOp):
+        le = _eval_rowwise(expr.lhs, col_num)
+        re = _eval_rowwise(expr.rhs, col_num)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if expr.op == "+":
+                out = le + re
+            elif expr.op == "-":
+                out = le - re
+            elif expr.op == "*":
+                out = le * re
+            elif expr.op == "/":
+                out = np.divide(le, re)
+            elif expr.op == "%":
+                # truncated mod (Go math.Mod), not numpy's floored mod
+                out = np.fmod(le, re)
+            else:
+                raise ErrQueryError(f"unsupported operator {expr.op}")
+        return np.where(np.isinf(out), np.nan, out)
+    if isinstance(expr, MathExpr):
+        args = [_eval_rowwise(a, col_num) for a in expr.args]
+        return np.asarray(apply_math(expr.func, args), dtype=np.float64)
+    raise ErrQueryError(f"cannot evaluate {type(expr).__name__} here")
 
 
 # --------------------------------------------------------------- helpers
@@ -743,45 +1225,3 @@ def _ftype_name(t: DataType) -> str:
     return {DataType.FLOAT: "float", DataType.INTEGER: "integer",
             DataType.BOOLEAN: "boolean", DataType.STRING: "string"
             }.get(t, "unknown")
-
-
-def _classify_fields(stmt: SelectStatement):
-    """Split select list into agg items vs raw field refs."""
-    aggs: list[AggItem] = []
-    raw: list[tuple[str, str | None]] = []
-    has_wildcard = False
-
-    for sf in stmt.fields:
-        e = sf.expr
-        if isinstance(e, Wildcard):
-            has_wildcard = True
-            continue
-        if isinstance(e, Call):
-            func = e.func
-            if func not in AGG_FUNCS:
-                raise ErrQueryError(f"unsupported function {func}()")
-            if not e.args or not isinstance(e.args[0], FieldRef):
-                raise ErrQueryError(
-                    f"{func}() requires a named field argument")
-            aggs.append(AggItem(func, e.args[0].name, sf.alias or func))
-        elif isinstance(e, FieldRef):
-            raw.append((e.name, sf.alias))
-        else:
-            raise ErrQueryError(
-                f"unsupported select expression {e!r}")
-    return aggs, raw, has_wildcard
-
-
-def _finalize_agg(func: str, st: dict) -> np.ndarray:
-    """Finalize one aggregate from a merged state dict of (G, W) arrays."""
-    if func == "count":
-        return st["count"].astype(np.float64)
-    if func == "sum":
-        return st["sum"]
-    if func == "mean":
-        return st["sum"] / np.maximum(st["count"], 1)
-    if func in ("min", "max", "first", "last"):
-        return st[func]
-    if func == "spread":
-        return st["max"] - st["min"]
-    raise ErrQueryError(f"unsupported aggregate {func}")

@@ -1,0 +1,647 @@
+"""Query function surface: classification, state requirements, finalizers,
+window transforms.
+
+Role of the reference's sql-side function machinery:
+- agg registry / iterators: engine/executor/agg_factory.go, agg_func.go,
+  agg_iterator.gen.go
+- call processors (materialize/transform stage): engine/executor/
+  call_processor.go, materialize_transform.go
+- selector & transform semantics follow InfluxQL (top/bottom/percentile/
+  derivative/moving_average/holt_winters ... lib/util/lifted/influx/query)
+
+Design: every aggregate reduces to a small set of *mergeable states*
+computed on device by the segment kernel (ops/segment_agg.py) or shipped as
+raw per-(group, window) slices when exact semantics need them
+(percentile/mode/distinct/integral — the reference keeps raw slices for
+these too, e.g. FloatPercentileReduce). Window transforms (derivative,
+moving_average, holt_winters, ...) are *post-aggregation* host transforms
+over the (group, window) grid — the analog of the reference's sql-side
+transform processors that run after exchange-merge.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.errors import ErrQueryError
+from .ast import BinaryExpr, Call, FieldRef, Literal, Wildcard
+
+# aggregates finalized purely from device moment states
+MOMENT_AGGS = {"count", "sum", "mean", "min", "max", "first", "last",
+               "spread", "stddev"}
+# aggregates needing raw per-(group, window) value slices
+RAW_AGGS = {"percentile", "median", "mode", "distinct", "count_distinct",
+            "integral", "sample"}
+# selectors that emit multiple rows per window (must be the sole field)
+MULTIROW = {"top", "bottom", "distinct", "sample"}
+# post-aggregation / per-series window transforms
+TRANSFORMS = {"derivative", "non_negative_derivative", "difference",
+              "non_negative_difference", "cumulative_sum", "moving_average",
+              "elapsed", "holt_winters", "holt_winters_with_fit"}
+# elementwise math (unary unless noted)
+MATH_FUNCS = {"abs", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+              "exp", "ln", "log", "log2", "log10", "sqrt", "pow", "floor",
+              "ceil", "round"}
+
+AGG_FUNCS = MOMENT_AGGS | RAW_AGGS | {"top", "bottom"}
+
+_NS_PER_S = 1_000_000_000
+
+
+@dataclass
+class AggItem:
+    """One base aggregate state to compute (device or raw slice)."""
+    func: str
+    field: str
+    output: str
+    arg: float | None = None       # percentile p / top-bottom-sample N /
+
+    @property
+    def needs_raw(self) -> bool:
+        return self.func in RAW_AGGS
+
+    @property
+    def needs_raw_times(self) -> bool:
+        return self.func in ("integral", "sample")
+
+
+# ---- output expression tree (select list after classification) -----------
+
+@dataclass
+class AggRef:
+    idx: int                       # into ClassifiedSelect.aggs
+
+
+@dataclass
+class RawRef:
+    name: str                      # raw field (raw mode only)
+
+
+@dataclass
+class Num:
+    value: float
+
+
+@dataclass
+class MathExpr:
+    func: str
+    args: list
+
+
+@dataclass
+class BinOp:
+    op: str
+    lhs: object
+    rhs: object
+
+
+@dataclass
+class Transform:
+    func: str
+    child: object                  # expr over AggRef/RawRef
+    params: list = field(default_factory=list)
+
+
+@dataclass
+class ClassifiedSelect:
+    mode: str = "raw"              # "agg" | "raw"
+    aggs: list = field(default_factory=list)          # list[AggItem]
+    outputs: list = field(default_factory=list)       # list[(name, expr)]
+    multirow: AggItem | None = None
+    has_wildcard: bool = False
+    raw_fields: list = field(default_factory=list)    # [(name, alias)]
+    has_transform: bool = False
+
+    @property
+    def is_plain_raw(self) -> bool:
+        """Raw select with no expressions — rows pass through unchanged
+        (wildcard, or every output a bare field reference)."""
+        return self.has_wildcard or (
+            not self.has_transform
+            and all(isinstance(e, RawRef) for _n, e in self.outputs))
+
+    @property
+    def raw_refs(self) -> set:
+        names = set()
+
+        def walk(e):
+            if isinstance(e, RawRef):
+                names.add(e.name)
+            elif isinstance(e, MathExpr):
+                for a in e.args:
+                    walk(a)
+            elif isinstance(e, BinOp):
+                walk(e.lhs), walk(e.rhs)
+            elif isinstance(e, Transform):
+                walk(e.child)
+        for _n, e in self.outputs:
+            walk(e)
+        return names
+
+
+def _lit_num(e, what: str) -> float:
+    if isinstance(e, Literal) and isinstance(e.value, (int, float)) \
+            and not isinstance(e.value, bool):
+        return float(e.value)
+    raise ErrQueryError(f"{what} must be a number literal")
+
+
+def classify_select(stmt) -> ClassifiedSelect:
+    """Walk the select list into output expression trees, extracting base
+    aggregate states. Errors on unsupported mixes (matching InfluxQL:
+    mixing aggregate and raw fields is an error; multi-row selectors must
+    be alone)."""
+    cs = ClassifiedSelect()
+    has_agg = False
+    has_raw = False
+
+    def walk(e, top_level: bool):
+        nonlocal has_agg, has_raw
+        if isinstance(e, Wildcard):
+            raise ErrQueryError("wildcard inside expression")
+        if isinstance(e, Literal):
+            if isinstance(e.value, (int, float)) \
+                    and not isinstance(e.value, bool):
+                return Num(float(e.value))
+            raise ErrQueryError(f"unsupported literal {e.value!r} in select")
+        if isinstance(e, FieldRef):
+            has_raw = True
+            return RawRef(e.name)
+        if isinstance(e, BinaryExpr):
+            if e.op not in ("+", "-", "*", "/", "%"):
+                raise ErrQueryError(
+                    f"unsupported operator {e.op} in select list")
+            return BinOp(e.op, walk(e.lhs, False), walk(e.rhs, False))
+        if not isinstance(e, Call):
+            raise ErrQueryError(f"unsupported select expression {e!r}")
+
+        func = e.func
+        if func in ("top", "bottom", "sample"):
+            if not top_level:
+                raise ErrQueryError(f"{func}() must be the top-level field")
+            if len(e.args) != 2 or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError(f"{func}(field, N) expected")
+            n = int(_lit_num(e.args[1], f"{func}() N"))
+            if n <= 0:
+                raise ErrQueryError(f"{func}() N must be > 0")
+            has_agg = True
+            item = AggItem(func, e.args[0].name, func, float(n))
+            cs.aggs.append(item)
+            cs.multirow = item
+            return AggRef(len(cs.aggs) - 1)
+        if func == "distinct":
+            if not top_level:
+                raise ErrQueryError("distinct() must be the top-level "
+                                    "field or inside count()")
+            if len(e.args) != 1 or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError("distinct(field) expected")
+            has_agg = True
+            item = AggItem("distinct", e.args[0].name, "distinct")
+            cs.aggs.append(item)
+            cs.multirow = item
+            return AggRef(len(cs.aggs) - 1)
+        if func == "count" and len(e.args) == 1 \
+                and isinstance(e.args[0], Call) \
+                and e.args[0].func == "distinct":
+            inner = e.args[0]
+            if len(inner.args) != 1 or not isinstance(inner.args[0],
+                                                      FieldRef):
+                raise ErrQueryError("count(distinct(field)) expected")
+            has_agg = True
+            cs.aggs.append(AggItem("count_distinct", inner.args[0].name,
+                                   "count"))
+            return AggRef(len(cs.aggs) - 1)
+        if func == "percentile":
+            if len(e.args) != 2 or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError("percentile(field, p) expected")
+            p = _lit_num(e.args[1], "percentile() p")
+            if not 0 <= p <= 100:
+                raise ErrQueryError("percentile p must be in [0, 100]")
+            has_agg = True
+            cs.aggs.append(AggItem("percentile", e.args[0].name,
+                                   "percentile", p))
+            return AggRef(len(cs.aggs) - 1)
+        if func in MOMENT_AGGS or func in ("median", "mode", "integral"):
+            if not e.args or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError(
+                    f"{func}() requires a named field argument")
+            arg = None
+            if func == "integral":
+                arg = float(_NS_PER_S)
+                if len(e.args) > 1:
+                    arg = _lit_num(e.args[1], "integral() unit")
+            has_agg = True
+            cs.aggs.append(AggItem(func, e.args[0].name, func, arg))
+            return AggRef(len(cs.aggs) - 1)
+        if func in TRANSFORMS:
+            if not e.args:
+                raise ErrQueryError(f"{func}() requires an argument")
+            params = []
+            if func in ("derivative", "non_negative_derivative"):
+                unit = float(_NS_PER_S)
+                if len(e.args) > 1:
+                    unit = _lit_num(e.args[1], f"{func}() unit")
+                params = [unit]
+            elif func == "moving_average":
+                if len(e.args) != 2:
+                    raise ErrQueryError("moving_average(x, n) expected")
+                params = [int(_lit_num(e.args[1], "moving_average() n"))]
+                if params[0] <= 0:
+                    raise ErrQueryError("moving_average n must be > 0")
+            elif func == "elapsed":
+                unit = 1.0
+                if len(e.args) > 1:
+                    unit = _lit_num(e.args[1], "elapsed() unit")
+                params = [unit]
+            elif func in ("holt_winters", "holt_winters_with_fit"):
+                if len(e.args) != 3:
+                    raise ErrQueryError(f"{func}(x, N, S) expected")
+                params = [int(_lit_num(e.args[1], "holt_winters N")),
+                          int(_lit_num(e.args[2], "holt_winters S"))]
+            cs.has_transform = True
+            child = walk(e.args[0], False)
+            if func in ("holt_winters", "holt_winters_with_fit") \
+                    and not _expr_has_agg(child):
+                raise ErrQueryError(f"{func}() requires an aggregate "
+                                    "argument with GROUP BY time")
+            if func == "elapsed" and _expr_has_agg(child):
+                raise ErrQueryError("elapsed() works on raw fields")
+            return Transform(func, child, params)
+        if func in MATH_FUNCS:
+            want = 2 if func in ("atan2", "pow", "log") else 1
+            if len(e.args) != want:
+                raise ErrQueryError(f"{func}() takes {want} argument(s)")
+            return MathExpr(func, [walk(a, False) for a in e.args])
+        raise ErrQueryError(f"unsupported function {func}()")
+
+    for sf in stmt.fields:
+        e = sf.expr
+        if isinstance(e, Wildcard):
+            cs.has_wildcard = True
+            continue
+        if isinstance(e, FieldRef):
+            has_raw = True
+            cs.raw_fields.append((e.name, sf.alias))
+            cs.outputs.append((sf.alias or e.name, RawRef(e.name)))
+            continue
+        expr = walk(e, True)
+        name = sf.alias or _default_name(e)
+        cs.outputs.append((name, expr))
+
+    if has_agg and (has_raw or cs.has_wildcard):
+        raise ErrQueryError("mixing aggregate and non-aggregate queries "
+                            "is not supported")
+    if cs.multirow is not None and len(cs.outputs) != 1:
+        raise ErrQueryError(
+            f"{cs.multirow.func}() cannot be combined with other fields")
+    cs.mode = "agg" if has_agg else "raw"
+    if cs.multirow is not None and cs.multirow.arg is not None:
+        cs.multirow.output = cs.outputs[0][0]
+    return cs
+
+
+def _expr_has_agg(e) -> bool:
+    if isinstance(e, AggRef):
+        return True
+    if isinstance(e, MathExpr):
+        return any(_expr_has_agg(a) for a in e.args)
+    if isinstance(e, BinOp):
+        return _expr_has_agg(e.lhs) or _expr_has_agg(e.rhs)
+    if isinstance(e, Transform):
+        return _expr_has_agg(e.child)
+    return False
+
+
+def _default_name(e) -> str:
+    if isinstance(e, Call):
+        return e.func
+    if isinstance(e, BinaryExpr):
+        return _default_name(e.lhs)
+    if isinstance(e, FieldRef):
+        return e.name
+    return "expr"
+
+
+def spec_names_for(item: AggItem) -> set[str]:
+    """Device kernel states an AggItem needs (count always added by the
+    executor for presence masking)."""
+    f = item.func
+    if f in ("mean", "count", "sum"):
+        return {"count", "sum"}
+    if f == "stddev":
+        return {"count", "sum", "sumsq"}
+    if f == "spread":
+        return {"min", "max"}
+    if f in ("min", "max", "first", "last"):
+        return {f}
+    return set()      # raw aggs / top / bottom use raw slices
+
+
+# ------------------------------------------------------------ finalizers
+
+def finalize_moment(func: str, st: dict) -> np.ndarray:
+    """Finalize a moment aggregate from a merged state dict of (G, W)
+    arrays. NaN marks empty cells for float outputs."""
+    if func == "count":
+        return st["count"].astype(np.float64)
+    if func == "sum":
+        return st["sum"]
+    if func == "mean":
+        return st["sum"] / np.maximum(st["count"], 1)
+    if func in ("min", "max", "first", "last"):
+        return st[func]
+    if func == "spread":
+        return st["max"] - st["min"]
+    if func == "stddev":
+        # sample stddev; <2 points → NaN (influx returns null)
+        cnt = st["count"].astype(np.float64)
+        safe = np.maximum(cnt, 2)
+        var = (st["sumsq"] - st["sum"] * st["sum"] / safe) / (safe - 1)
+        var = np.maximum(var, 0.0)
+        return np.where(cnt >= 2, np.sqrt(var), np.nan)
+    raise ErrQueryError(f"unsupported aggregate {func}")
+
+
+def finalize_raw_agg(item: AggItem, raw: dict, G: int, W: int
+                     ) -> np.ndarray:
+    """Finalize a raw-slice aggregate → (G, W) float grid (NaN = empty).
+    raw: {"vals": [G][W] list of ndarray, "times": same or None}."""
+    out = np.full((G, W), np.nan)
+    vals = raw["vals"]
+    times = raw.get("times")
+    for gi in range(G):
+        for wi in range(W):
+            v = vals[gi][wi]
+            if v is None or len(v) == 0:
+                continue
+            v = np.asarray(v, dtype=np.float64)
+            if item.func == "percentile":
+                out[gi, wi] = _percentile_nearest_rank(v, item.arg)
+            elif item.func == "median":
+                out[gi, wi] = _median(v)
+            elif item.func == "mode":
+                out[gi, wi] = _mode(v)
+            elif item.func == "count_distinct":
+                out[gi, wi] = float(len(np.unique(v)))
+            elif item.func == "integral":
+                t = np.asarray(times[gi][wi], dtype=np.int64)
+                out[gi, wi] = _integral(v, t, item.arg)
+            else:
+                raise ErrQueryError(
+                    f"unsupported raw aggregate {item.func}")
+    return out
+
+
+def _percentile_nearest_rank(v: np.ndarray, p: float) -> float:
+    """InfluxQL percentile: nearest-rank on the sorted sample
+    (idx = floor(n * p/100 + 0.5) - 1, clamped)."""
+    s = np.sort(v)
+    n = len(s)
+    idx = int(math.floor(n * p / 100.0 + 0.5)) - 1
+    if idx < 0:
+        idx = 0
+    if idx >= n:
+        idx = n - 1
+    return float(s[idx])
+
+
+def _median(v: np.ndarray) -> float:
+    s = np.sort(v)
+    n = len(s)
+    if n % 2 == 1:
+        return float(s[n // 2])
+    return float((s[n // 2 - 1] + s[n // 2]) / 2.0)
+
+
+def _mode(v: np.ndarray) -> float:
+    u, c = np.unique(v, return_counts=True)
+    return float(u[np.argmax(c)])     # ties → smallest value (u sorted)
+
+
+def _integral(v: np.ndarray, t: np.ndarray, unit_ns: float) -> float:
+    """Trapezoidal integral of the series within its window, in `unit`
+    seconds-equivalents (influx integral(field, unit))."""
+    order = np.argsort(t, kind="stable")
+    t = t[order].astype(np.float64)
+    v = v[order]
+    if len(v) == 1:
+        return 0.0
+    dt = np.diff(t)
+    area = float(np.sum((v[1:] + v[:-1]) * 0.5 * dt))
+    return area / float(unit_ns)
+
+
+# ------------------------------------------------- expression evaluation
+
+def eval_output_grid(expr, agg_grids: list[np.ndarray]) -> np.ndarray:
+    """Evaluate an output expression over (G, W) grids. NaN propagates as
+    null (influx: any null operand → null; x/0 → null)."""
+    if isinstance(expr, AggRef):
+        return agg_grids[expr.idx]
+    if isinstance(expr, Num):
+        return np.float64(expr.value)
+    if isinstance(expr, BinOp):
+        le = eval_output_grid(expr.lhs, agg_grids)
+        re = eval_output_grid(expr.rhs, agg_grids)
+        return _apply_binop(expr.op, le, re)
+    if isinstance(expr, MathExpr):
+        args = [eval_output_grid(a, agg_grids) for a in expr.args]
+        return apply_math(expr.func, args)
+    raise ErrQueryError(f"cannot evaluate {type(expr).__name__} here")
+
+
+def _apply_binop(op: str, le, re):
+    with np.errstate(divide="ignore", invalid="ignore"):
+        if op == "+":
+            return le + re
+        if op == "-":
+            return le - re
+        if op == "*":
+            return le * re
+        if op == "/":
+            out = np.divide(le, re)
+            return np.where(np.isinf(out), np.nan, out)
+        if op == "%":
+            # truncated mod (Go math.Mod), not numpy's floored mod
+            return np.fmod(le, re)
+    raise ErrQueryError(f"unsupported operator {op}")
+
+
+def apply_math(func: str, args: list):
+    """Elementwise math; domain errors → NaN (null), matching influx."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        x = args[0]
+        if func == "abs":
+            return np.abs(x)
+        if func in ("sin", "cos", "tan", "exp", "sqrt", "floor", "ceil"):
+            return getattr(np, func)(x)
+        if func in ("asin", "acos"):
+            return getattr(np, {"asin": "arcsin", "acos": "arccos"}[func])(x)
+        if func == "atan":
+            return np.arctan(x)
+        if func == "atan2":
+            return np.arctan2(x, args[1])
+        if func == "ln":
+            return np.where(np.asarray(x) > 0, np.log(np.maximum(x, 1e-300)),
+                            np.nan)
+        if func == "log2":
+            return np.where(np.asarray(x) > 0,
+                            np.log2(np.maximum(x, 1e-300)), np.nan)
+        if func == "log10":
+            return np.where(np.asarray(x) > 0,
+                            np.log10(np.maximum(x, 1e-300)), np.nan)
+        if func == "log":
+            # influx log(field, base)
+            b = args[1]
+            return np.where(np.asarray(x) > 0,
+                            np.log(np.maximum(x, 1e-300))
+                            / np.log(np.maximum(b, 1e-300)), np.nan)
+        if func == "pow":
+            return np.power(x, args[1])
+        if func == "round":
+            # influx rounds half away from zero
+            return np.sign(x) * np.floor(np.abs(x) + 0.5)
+    raise ErrQueryError(f"unsupported math function {func}")
+
+
+# ---------------------------------------------------- window transforms
+
+def apply_window_transform(func: str, params: list,
+                           times: np.ndarray, values: np.ndarray
+                           ) -> tuple[np.ndarray, np.ndarray]:
+    """Apply a window transform to one group's series (times int64 ns,
+    values float with no NaNs — callers drop null windows first, matching
+    influx which skips nulls). Returns (times, values) of the transformed
+    series."""
+    n = len(values)
+    if func in ("derivative", "non_negative_derivative"):
+        if n < 2:
+            return times[:0], values[:0]
+        dv = np.diff(values)
+        dt = np.diff(times).astype(np.float64)
+        out = dv / dt * params[0]
+        t = times[1:]
+        if func == "non_negative_derivative":
+            keep = out >= 0
+            return t[keep], out[keep]
+        return t, out
+    if func in ("difference", "non_negative_difference"):
+        if n < 2:
+            return times[:0], values[:0]
+        out = np.diff(values)
+        t = times[1:]
+        if func == "non_negative_difference":
+            keep = out >= 0
+            return t[keep], out[keep]
+        return t, out
+    if func == "cumulative_sum":
+        return times, np.cumsum(values)
+    if func == "moving_average":
+        w = params[0]
+        if n < w:
+            return times[:0], values[:0]
+        c = np.cumsum(np.concatenate([[0.0], values]))
+        out = (c[w:] - c[:-w]) / w
+        return times[w - 1:], out
+    if func == "elapsed":
+        if n < 2:
+            return times[:0], values[:0]
+        unit = params[0] if params else 1.0
+        return times[1:], (np.diff(times) / unit).astype(np.float64)
+    if func in ("holt_winters", "holt_winters_with_fit"):
+        if n == 0:
+            return times[:0], values[:0]
+        n_pred, season = params
+        fit, fc = holt_winters_forecast(values, n_pred, season)
+        if len(times) >= 2:
+            step = int(times[-1] - times[-2])
+        else:
+            step = _NS_PER_S
+        future = times[-1] + step * np.arange(1, n_pred + 1) \
+            if n_pred else times[:0]
+        if func == "holt_winters_with_fit":
+            return (np.concatenate([times, future]),
+                    np.concatenate([fit, fc]))
+        return future.astype(np.int64), fc
+    raise ErrQueryError(f"unsupported transform {func}")
+
+
+def holt_winters_forecast(y: np.ndarray, n_pred: int, season: int
+                          ) -> tuple[np.ndarray, np.ndarray]:
+    """Additive Holt-Winters (triple exponential smoothing when season>1,
+    double otherwise). Smoothing parameters picked by coarse grid search on
+    in-sample SSE — the role of the reference's gonum-optimized fit
+    (engine/executor/ hw transform via influx holt_winters)."""
+    y = np.asarray(y, dtype=np.float64)
+    n = len(y)
+    if n < 2 or (season > 1 and n < 2 * season):
+        return y.copy(), np.full(n_pred, np.nan)
+
+    grid = np.linspace(0.1, 0.9, 5)
+
+    def run(alpha, beta, gamma):
+        if season > 1:
+            seas = np.zeros(season)
+            for i in range(season):
+                seas[i] = y[i] - y[:season].mean()
+            level = y[:season].mean()
+            trend = (y[season:2 * season].mean()
+                     - y[:season].mean()) / season
+        else:
+            seas = np.zeros(1)
+            level, trend = y[0], y[1] - y[0]
+        fit = np.empty(n)
+        for i in range(n):
+            s = seas[i % season] if season > 1 else 0.0
+            fit[i] = level + trend + s
+            prev_level = level
+            level = alpha * (y[i] - s) + (1 - alpha) * (level + trend)
+            trend = beta * (level - prev_level) + (1 - beta) * trend
+            if season > 1:
+                seas[i % season] = gamma * (y[i] - level) \
+                    + (1 - gamma) * s
+        fc = np.empty(n_pred)
+        for k in range(n_pred):
+            s = seas[(n + k) % season] if season > 1 else 0.0
+            fc[k] = level + (k + 1) * trend + s
+        sse = float(np.sum((fit - y) ** 2))
+        return sse, fit, fc
+
+    best = None
+    for a in grid:
+        for b in grid:
+            gs = grid if season > 1 else [0.0]
+            for g in gs:
+                sse, fit, fc = run(a, b, g)
+                if best is None or sse < best[0]:
+                    best = (sse, fit, fc)
+    return best[1], best[2]
+
+
+# ------------------------------------------------------- top/bottom state
+
+def topn_partial(vals: np.ndarray, times: np.ndarray, n: int,
+                 largest: bool) -> tuple[np.ndarray, np.ndarray]:
+    """Per-store partial top/bottom-N of one (group, window) slice — the
+    mergeable state (top-N of a union == top-N over concatenated per-store
+    top-Ns; analog of the reference's heap TopNLinkedList
+    engine/topn_linkedlist.go)."""
+    if len(vals) <= n:
+        return vals, times
+    # ties broken by earliest time, like influx: sort by (-v, t) / (v, t)
+    key = (-vals if largest else vals)
+    order = np.lexsort((times, key))[:n]
+    return vals[order], times[order]
+
+
+def topn_final(vals: np.ndarray, times: np.ndarray, n: int,
+               largest: bool) -> list[tuple[int, float]]:
+    """Final top/bottom rows for one (group, window): N points ordered by
+    time (influx output order)."""
+    key = (-vals if largest else vals)
+    order = np.lexsort((times, key))[:n]
+    pick = order[np.argsort(times[order], kind="stable")]
+    return [(int(times[i]), float(vals[i])) for i in pick]

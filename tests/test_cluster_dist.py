@@ -127,6 +127,31 @@ def test_distributed_raw_matches_single_node(loaded, q):
 
 
 @pytest.mark.parametrize("q", [
+    # raw-slice aggregates: per-store slices must merge exactly
+    "SELECT percentile(usage, 90) FROM cpu GROUP BY host",
+    "SELECT median(usage) FROM cpu GROUP BY time(1m), host",
+    "SELECT mode(cnt) FROM cpu GROUP BY dc",
+    "SELECT count(distinct(cnt)) FROM cpu",
+    # moment state stddev: (count, sum, sumsq) partial merge
+    "SELECT stddev(usage) FROM cpu GROUP BY time(2m), dc",
+    # capped top-N partial state (top-N of union == top-N of partials)
+    "SELECT top(usage, 3) FROM cpu GROUP BY host",
+    "SELECT bottom(cnt, 5) FROM cpu",
+    "SELECT distinct(cnt) FROM cpu GROUP BY dc",
+    # post-merge transforms & expression materialization at sql node
+    "SELECT derivative(mean(usage), 1m) FROM cpu GROUP BY time(1m), host",
+    "SELECT moving_average(mean(usage), 3) FROM cpu GROUP BY time(1m)",
+    "SELECT mean(usage) + mean(cnt) FROM cpu GROUP BY host",
+    "SELECT abs(mean(usage)) FROM cpu GROUP BY dc",
+    # raw-mode expressions: plain scan shipped, materialized at sql node
+    "SELECT usage * 2 + 1 FROM cpu WHERE host = 'h1' LIMIT 5",
+    "SELECT derivative(usage, 10s) FROM cpu WHERE host = 'h0' LIMIT 10",
+])
+def test_distributed_functions_match_single_node(loaded, q):
+    _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q))
+
+
+@pytest.mark.parametrize("q", [
     "SHOW MEASUREMENTS",
     "SHOW TAG KEYS FROM cpu",
     "SHOW TAG VALUES FROM cpu WITH KEY = host",

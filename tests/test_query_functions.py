@@ -1,0 +1,302 @@
+"""Extended query function surface: selectors (top/bottom/percentile/...),
+window transforms (derivative/moving_average/...), math functions, and
+select-list arithmetic (role of the reference's agg registry + call
+processors: engine/executor/agg_factory.go, call_processor.go)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp: str):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text: str, now_ns=None):
+    (stmt,) = parse_query(text, now_ns=now_ns)
+    return ex.execute(stmt, "db0")
+
+
+MIN = 60 * 10**9
+
+
+# ------------------------------------------------------------ moment aggs
+
+def test_stddev(db):
+    eng, ex = db
+    vals = [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0]
+    write(eng, "\n".join(f"m v={v} {i * 1000}"
+                         for i, v in enumerate(vals)))
+    res = q(ex, "SELECT stddev(v) FROM m")
+    got = res["series"][0]["values"][0][1]
+    assert got == pytest.approx(np.std(vals, ddof=1))
+
+
+def test_stddev_single_point_null(db):
+    eng, ex = db
+    write(eng, "m v=5 1000")
+    res = q(ex, "SELECT stddev(v) FROM m")
+    assert res["series"][0]["values"][0][1] is None
+
+
+def test_stddev_grouped_windows(db):
+    eng, ex = db
+    lines = []
+    for h in range(2):
+        for i in range(12):
+            lines.append(f"m,host=h{h} v={h * 100 + i * i} "
+                         f"{i * (MIN // 6)}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT stddev(v) FROM m WHERE time >= 0 AND time < 2m "
+                "GROUP BY time(1m), host")
+    s0 = [s for s in res["series"] if s["tags"] == {"host": "h1"}][0]
+    expect = np.std([100 + i * i for i in range(6)], ddof=1)
+    assert s0["values"][0][1] == pytest.approx(expect)
+
+
+# -------------------------------------------------------------- raw aggs
+
+def test_percentile_and_median(db):
+    eng, ex = db
+    vals = [10, 20, 30, 40, 50, 60, 70, 80, 90, 100]
+    write(eng, "\n".join(f"m v={v} {i * 1000}"
+                         for i, v in enumerate(vals)))
+    res = q(ex, "SELECT percentile(v, 90) FROM m")
+    # nearest-rank: floor(10*0.9+0.5)-1 = 8 → 90
+    assert res["series"][0]["values"][0][1] == 90.0
+    res = q(ex, "SELECT median(v) FROM m")
+    assert res["series"][0]["values"][0][1] == 55.0
+
+
+def test_mode_and_count_distinct(db):
+    eng, ex = db
+    vals = [1, 2, 2, 3, 3, 3, 4]
+    write(eng, "\n".join(f"m v={v}i {i * 1000}"
+                         for i, v in enumerate(vals)))
+    res = q(ex, "SELECT mode(v) FROM m")
+    assert res["series"][0]["values"][0][1] == 3
+    res = q(ex, "SELECT count(distinct(v)) FROM m")
+    assert res["series"][0]["values"][0][1] == 4
+
+
+def test_distinct_multirow(db):
+    eng, ex = db
+    write(eng, "m v=3 1000\nm v=1 2000\nm v=3 3000\nm v=2 4000")
+    res = q(ex, "SELECT distinct(v) FROM m")
+    got = [r[1] for r in res["series"][0]["values"]]
+    assert got == [1.0, 2.0, 3.0]
+
+
+def test_distinct_cannot_combine(db):
+    eng, ex = db
+    write(eng, "m v=1 1000")
+    res = q(ex, "SELECT distinct(v), mean(v) FROM m")
+    assert "error" in res
+
+
+def test_integral(db):
+    eng, ex = db
+    # v=10 flat for 3 seconds → integral = 10*3 = 30
+    write(eng, "m v=10 0\nm v=10 1000000000\nm v=10 2000000000\n"
+               "m v=10 3000000000")
+    res = q(ex, "SELECT integral(v) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(30.0)
+
+
+def test_sample(db):
+    eng, ex = db
+    write(eng, "\n".join(f"m v={i} {i * 1000}" for i in range(20)))
+    res = q(ex, "SELECT sample(v, 5) FROM m")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 5
+    ts = [r[0] for r in rows]
+    assert ts == sorted(ts)
+
+
+# ------------------------------------------------------------- selectors
+
+def test_top_bottom(db):
+    eng, ex = db
+    write(eng, "m v=5 1000\nm v=9 2000\nm v=1 3000\nm v=7 4000\n"
+               "m v=9 5000")
+    res = q(ex, "SELECT top(v, 2) FROM m")
+    rows = res["series"][0]["values"]
+    # two 9s, earliest-time tie-break; rows ordered by time
+    assert rows == [[2000, 9.0], [5000, 9.0]]
+    res = q(ex, "SELECT bottom(v, 2) FROM m")
+    rows = res["series"][0]["values"]
+    assert rows == [[1000, 5.0], [3000, 1.0]]
+
+
+def test_top_grouped_by_time(db):
+    eng, ex = db
+    lines = []
+    for i in range(12):
+        lines.append(f"m v={i % 6} {i * (MIN // 6)}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT top(v, 1) FROM m WHERE time >= 0 AND time < 2m "
+                "GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 2
+    assert [r[1] for r in rows] == [5.0, 5.0]
+
+
+def test_top_int_field(db):
+    eng, ex = db
+    write(eng, "m c=3i 1000\nm c=8i 2000")
+    res = q(ex, "SELECT top(c, 1) FROM m")
+    v = res["series"][0]["values"][0][1]
+    assert v == 8 and isinstance(v, int)
+
+
+# ------------------------------------------------------------ transforms
+
+def test_derivative_of_mean(db):
+    eng, ex = db
+    # mean per minute: 0, 60, 180 → derivative (per s): 1, 2
+    pts = [(0, 0.0), (MIN, 60.0), (2 * MIN, 180.0)]
+    write(eng, "\n".join(f"m v={v} {t}" for t, v in pts))
+    res = q(ex, "SELECT derivative(mean(v), 1s) FROM m WHERE time >= 0 "
+                "AND time < 3m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert rows == [[MIN, 1.0], [2 * MIN, 2.0]]
+
+
+def test_non_negative_derivative(db):
+    eng, ex = db
+    pts = [(0, 0.0), (MIN, 120.0), (2 * MIN, 60.0)]
+    write(eng, "\n".join(f"m v={v} {t}" for t, v in pts))
+    res = q(ex, "SELECT non_negative_derivative(mean(v), 1m) FROM m "
+                "WHERE time >= 0 AND time < 3m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 1
+    assert rows[0][0] == MIN and rows[0][1] == pytest.approx(120.0)
+
+
+def test_difference_and_cumulative_sum(db):
+    eng, ex = db
+    pts = [(0, 3.0), (MIN, 5.0), (2 * MIN, 4.0)]
+    write(eng, "\n".join(f"m v={v} {t}" for t, v in pts))
+    res = q(ex, "SELECT difference(sum(v)) FROM m WHERE time >= 0 AND "
+                "time < 3m GROUP BY time(1m)")
+    assert [r[1] for r in res["series"][0]["values"]] == [2.0, -1.0]
+    res = q(ex, "SELECT cumulative_sum(sum(v)) FROM m WHERE time >= 0 "
+                "AND time < 3m GROUP BY time(1m)")
+    assert [r[1] for r in res["series"][0]["values"]] == [3.0, 8.0, 12.0]
+
+
+def test_moving_average(db):
+    eng, ex = db
+    pts = [(i * MIN, float(v)) for i, v in enumerate([2, 4, 6, 8])]
+    write(eng, "\n".join(f"m v={v} {t}" for t, v in pts))
+    res = q(ex, "SELECT moving_average(mean(v), 2) FROM m WHERE time >= 0 "
+                "AND time < 4m GROUP BY time(1m)")
+    assert [r[1] for r in res["series"][0]["values"]] == [3.0, 5.0, 7.0]
+
+
+def test_derivative_raw_points(db):
+    eng, ex = db
+    write(eng, "m v=10 0\nm v=30 2000000000")
+    res = q(ex, "SELECT derivative(v, 1s) FROM m")
+    rows = res["series"][0]["values"]
+    assert rows == [[2000000000, 10.0]]
+
+
+def test_elapsed_raw(db):
+    eng, ex = db
+    write(eng, "m v=1 1000\nm v=1 4000\nm v=1 9000")
+    res = q(ex, "SELECT elapsed(v) FROM m")
+    assert [r[1] for r in res["series"][0]["values"]] == [3000.0, 5000.0]
+
+
+def test_holt_winters_forecast_rows(db):
+    eng, ex = db
+    # linear ramp → double exponential smoothing extrapolates it
+    pts = [(i * MIN, float(10 + 5 * i)) for i in range(8)]
+    write(eng, "\n".join(f"m v={v} {t}" for t, v in pts))
+    res = q(ex, "SELECT holt_winters(mean(v), 3, 0) FROM m WHERE "
+                "time >= 0 AND time < 8m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 3
+    assert rows[0][0] == 8 * MIN
+    # forecast should continue the ramp approximately
+    assert rows[0][1] == pytest.approx(50.0, abs=5.0)
+    assert rows[2][1] > rows[0][1]
+
+
+# -------------------------------------------------------- math & binops
+
+def test_select_arithmetic_on_aggs(db):
+    eng, ex = db
+    write(eng, "m a=10 1000\nm a=20 2000\nm b=1 1000\nm b=3 2000")
+    res = q(ex, "SELECT mean(a) + mean(b) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(17.0)
+    res = q(ex, "SELECT mean(a) * 2 FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(30.0)
+    res = q(ex, "SELECT mean(a) / mean(b) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(7.5)
+
+
+def test_math_on_agg(db):
+    eng, ex = db
+    write(eng, "m v=-4 1000\nm v=-16 2000")
+    res = q(ex, "SELECT abs(mean(v)) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(10.0)
+    res = q(ex, "SELECT sqrt(abs(sum(v))) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(
+        math.sqrt(20.0))
+
+
+def test_math_on_raw(db):
+    eng, ex = db
+    write(eng, "m v=4 1000\nm v=9 2000")
+    res = q(ex, "SELECT sqrt(v) FROM m")
+    assert [r[1] for r in res["series"][0]["values"]] == [2.0, 3.0]
+    res = q(ex, "SELECT v * 10 + 1 FROM m")
+    assert [r[1] for r in res["series"][0]["values"]] == [41.0, 91.0]
+    res = q(ex, "SELECT log(v, 2) FROM m WHERE time = 1000")
+    assert res["series"][0]["values"][0][1] == pytest.approx(2.0)
+    res = q(ex, "SELECT round(v / 2) FROM m")
+    assert [r[1] for r in res["series"][0]["values"]] == [2.0, 5.0]
+
+
+def test_math_domain_error_null(db):
+    eng, ex = db
+    write(eng, "m v=-1 1000\nm v=4 2000")
+    res = q(ex, "SELECT ln(v) FROM m")
+    rows = res["series"][0]["values"]
+    # ln(-1) → null row dropped (only valid rows remain)
+    assert [r[1] for r in rows if r[1] is not None] == \
+        [pytest.approx(math.log(4.0))]
+
+
+def test_division_by_zero_null(db):
+    eng, ex = db
+    write(eng, "m a=1,b=0 1000")
+    res = q(ex, "SELECT a / b FROM m")
+    rows = res.get("series", [{}])[0].get("values", []) if res else []
+    assert all(r[1] is None for r in rows)
+
+
+# ----------------------------------------------------------- fill linear
+
+def test_fill_linear(db):
+    eng, ex = db
+    write(eng, f"m v=10 0\nm v=40 {3 * MIN}")
+    res = q(ex, "SELECT mean(v) FROM m WHERE time >= 0 AND time < 4m "
+                "GROUP BY time(1m) fill(linear)")
+    vals = [r[1] for r in res["series"][0]["values"]]
+    assert vals == [10.0, 20.0, 30.0, 40.0]
