@@ -37,16 +37,32 @@ log = get_logger(__name__)
 
 class HttpServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8086,
-                 prom_db: str = "prometheus", executor=None):
+                 prom_db: str = "prometheus", executor=None, config=None):
         """`engine` needs write_points(); queries go through `executor`
         (defaults to the single-node QueryExecutor; the cluster sql node
         passes a ClusterExecutor). Prom endpoints need a local scanning
-        engine and disable themselves on a cluster facade."""
+        engine and disable themselves on a cluster facade. `config` is a
+        utils.config.Config wiring limits, slow-query threshold, stats."""
+        from collections import deque
+
         from ..promql import PromEngine
+        from ..query.manager import QueryManager
+        from ..utils.config import Config
+        from ..utils.resources import QueryResources
+        from ..utils.syscontrol import SysControl
         self.engine = engine
-        self.executor = executor or QueryExecutor(engine)
-        self.prom = (PromEngine(engine, prom_db)
-                     if hasattr(engine, "scan_series") else None)
+        self.config = config or Config()
+        local = hasattr(engine, "scan_series")
+        self.query_manager = QueryManager()
+        self.resources = QueryResources(
+            self.config.data.max_concurrent_queries,
+            self.config.data.max_queued_queries,
+            self.config.data.max_series_per_query)
+        self.executor = executor or QueryExecutor(
+            engine, query_manager=self.query_manager,
+            resources=self.resources)
+        self.sysctrl = SysControl(engine if local else None)
+        self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
         self.host = host
         self.port = port
@@ -54,8 +70,27 @@ class HttpServer:
         self._thread: threading.Thread | None = None
         self.stats = {"writes": 0, "points_written": 0, "queries": 0,
                       "write_errors": 0, "query_errors": 0,
+                      "slow_queries": 0,
                       "started_at": time.time()}
+        self.slow_log: "deque" = deque(maxlen=32)
         self._stats_lock = threading.Lock()
+        # statistics pusher (reference lib/statisticsPusher)
+        self.stats_pusher = None
+        if self.config.stats.enabled:
+            from ..utils.stats import (StatisticsPusher, engine_collector,
+                                       readcache_collector,
+                                       runtime_collector)
+            sp = StatisticsPusher(
+                interval_s=self.config.stats.interval_ns / 1e9,
+                push_path=self.config.stats.push_path,
+                engine=engine if local else None,
+                store_database=self.config.stats.store_database)
+            sp.register("runtime", runtime_collector)
+            sp.register("readcache", readcache_collector)
+            if local:
+                sp.register("engine", engine_collector(engine))
+            sp.register("httpd", lambda: dict(self.stats))
+            self.stats_pusher = sp
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
@@ -81,9 +116,13 @@ class HttpServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="httpd", daemon=True)
         self._thread.start()
+        if self.stats_pusher is not None:
+            self.stats_pusher.start()
         log.info("http listening on %s:%d", self.host, self.port)
 
     def stop(self) -> None:
+        if self.stats_pusher is not None:
+            self.stats_pusher.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -92,6 +131,9 @@ class HttpServer:
     # ----------------------------------------------------------- handlers
 
     def handle_write(self, params: dict, body: bytes) -> tuple[int, dict]:
+        if self.sysctrl.readonly:
+            self._bump("write_errors")
+            return 403, {"error": "server is in readonly mode"}
         db = params.get("db")
         if not db:
             return 400, {"error": "database is required"}

@@ -151,3 +151,16 @@ class CreateMeasurementStatement:
 class DeleteStatement:
     from_measurement: str | None = None
     condition: object | None = None
+
+
+@dataclass
+class ExplainStatement:
+    """EXPLAIN [ANALYZE] SELECT ... (reference executorBuilder.Analyze,
+    engine/executor/select.go:248-251)."""
+    select: SelectStatement = None
+    analyze: bool = False
+
+
+@dataclass
+class KillQueryStatement:
+    qid: int = 0

@@ -24,7 +24,8 @@ from ..utils import get_logger
 from ..utils.errors import ErrQueryError
 from .ast import (SelectStatement, ShowStatement, CreateDatabaseStatement,
                   CreateMeasurementStatement, DropDatabaseStatement,
-                  DropMeasurementStatement, DeleteStatement)
+                  DropMeasurementStatement, DeleteStatement,
+                  ExplainStatement, KillQueryStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from .functions import (AGG_FUNCS, MOMENT_AGGS, AggItem, AggRef, BinOp,
                         ClassifiedSelect, MathExpr, Num, RawRef, Transform,
@@ -34,6 +35,12 @@ from .functions import (AGG_FUNCS, MOMENT_AGGS, AggItem, AggRef, BinOp,
 
 log = get_logger(__name__)
 
+
+def _now_ns() -> int:
+    import time
+    return time.perf_counter_ns()
+
+
 __all__ = ["QueryExecutor", "classify_select", "merge_partials",
            "finalize_partials", "transform_raw_result", "AGG_FUNCS",
            "AggItem"]
@@ -42,19 +49,35 @@ MAX_WINDOWS = 100_000
 
 
 class QueryExecutor:
-    """Executes parsed statements against a storage Engine."""
+    """Executes parsed statements against a storage Engine.
 
-    def __init__(self, engine):
+    query_manager (optional QueryManager) powers SHOW QUERIES /
+    KILL QUERY; resources (optional QueryResources) enforces series
+    caps inside scans."""
+
+    def __init__(self, engine, query_manager=None, resources=None):
         self.engine = engine
+        self.query_manager = query_manager
+        self.resources = resources
 
     # ------------------------------------------------------------------ api
 
-    def execute(self, stmt, db: str | None = None) -> dict:
+    def execute(self, stmt, db: str | None = None, ctx=None,
+                span=None) -> dict:
         """Returns one influx-style result object: {"series": [...]} or
-        {"error": ...}."""
+        {"error": ...}. ctx: QueryContext kill handle; span: tracing Span
+        (EXPLAIN ANALYZE)."""
         try:
             if isinstance(stmt, SelectStatement):
-                return self._select(stmt, stmt.from_db or db)
+                return self._select(stmt, stmt.from_db or db, ctx=ctx,
+                                    span=span)
+            if isinstance(stmt, ExplainStatement):
+                return self._explain(stmt, db)
+            if isinstance(stmt, KillQueryStatement):
+                if self.query_manager is not None \
+                        and self.query_manager.kill(stmt.qid):
+                    return {}
+                return {"error": f"no such query id: {stmt.qid}"}
             if isinstance(stmt, ShowStatement):
                 return self._show(stmt, stmt.on_db or db)
             if isinstance(stmt, CreateDatabaseStatement):
@@ -93,6 +116,12 @@ class QueryExecutor:
         if stmt.condition is not None:
             return {"error":
                     f"WHERE on SHOW {stmt.what.upper()} not supported yet"}
+        if stmt.what == "queries":
+            qm = self.query_manager
+            rows = [[c.qid, c.text, c.db, f"{c.duration_s:.3f}s"]
+                    for c in qm.list()] if qm else []
+            return _series("queries",
+                           ["qid", "query", "database", "duration"], rows)
         if stmt.what == "databases":
             vals = [[n] for n in sorted(eng.databases)]
             return _series("databases", ["name"], vals)
@@ -157,7 +186,8 @@ class QueryExecutor:
 
     # --------------------------------------------------------------- SELECT
 
-    def _select(self, stmt: SelectStatement, db: str | None) -> dict:
+    def _select(self, stmt: SelectStatement, db: str | None, ctx=None,
+                span=None) -> dict:
         if db is None:
             return {"error": "database required"}
         if db not in self.engine.databases:
@@ -171,12 +201,50 @@ class QueryExecutor:
         tag_keys = {k for s in shards_all for k in s.index.tag_keys(mst)}
         cond = analyze_condition(stmt.condition, tag_keys)
         if cs.mode == "agg":
-            res = self._select_agg(stmt, db, mst, cs, cond, tag_keys)
+            res = self._select_agg(stmt, db, mst, cs, cond, tag_keys,
+                                   ctx=ctx, span=span)
         else:
-            res = self._select_raw(stmt, db, mst, cs, cond, tag_keys)
+            res = self._select_raw(stmt, db, mst, cs, cond, tag_keys,
+                                   ctx=ctx)
         if stmt.into_measurement:
             return self._write_into(stmt, db, res)
         return res
+
+    def _explain(self, stmt: ExplainStatement, db: str | None) -> dict:
+        """EXPLAIN: logical plan description; EXPLAIN ANALYZE: execute
+        with a trace attached and render the span tree (reference
+        executorBuilder.Analyze + lib/tracing tree rendering)."""
+        sel = stmt.select
+        if stmt.analyze:
+            from ..utils.tracing import new_trace
+            root = new_trace("query")
+            with root:
+                res = self._select(sel, sel.from_db or db, span=root)
+            if "error" in res:
+                return res
+            lines = root.render()
+            return _series("EXPLAIN ANALYZE", ["EXPLAIN ANALYZE"],
+                           [[ln] for ln in lines])
+        try:
+            cs = classify_select(sel)
+        except ErrQueryError as e:
+            return {"error": str(e)}
+        interval = sel.group_by_interval()
+        lines = ["HttpSender",
+                 f"  Materialize({', '.join(n for n, _e in cs.outputs)})"]
+        if cs.mode == "agg":
+            aggd = ", ".join(f"{a.func}({a.field})" for a in cs.aggs)
+            win = f" window={interval}ns" if interval else ""
+            lines += [f"    Fill({sel.fill_option})" if interval else
+                      "    Merge",
+                      f"      WindowAggTPU[{aggd}]{win} "
+                      "(segment_aggregate kernel)"]
+        else:
+            lines += ["    Merge",
+                      "      RawScan"]
+        lines += [f"        Reader({sel.from_measurement})",
+                  f"          IndexScan({sel.from_measurement})"]
+        return _series("EXPLAIN", ["QUERY PLAN"], [[ln] for ln in lines])
 
     def _write_into(self, stmt, db: str, res: dict) -> dict:
         """SELECT ... INTO: write result series back as points (the CQ /
@@ -201,12 +269,18 @@ class QueryExecutor:
     # ---- aggregate path --------------------------------------------------
 
     def _select_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
-                    tag_keys) -> dict:
-        partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys)
+                    tag_keys, ctx=None, span=None) -> dict:
+        partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
+                                   ctx=ctx, span=span)
+        if span is not None:
+            with span.child("finalize") as sp:
+                res = finalize_partials(stmt, mst, cs, [partial])
+                sp.add(series=len(res.get("series", [])))
+            return res
         return finalize_partials(stmt, mst, cs, [partial])
 
     def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
-                    tag_keys) -> dict | None:
+                    tag_keys, ctx=None, span=None) -> dict | None:
         """Store-side partial aggregation: scan this engine's shards and
         reduce on device into per-(group, window) mergeable states.
 
@@ -244,6 +318,10 @@ class QueryExecutor:
         data_tmin = MAX_TIME
         data_tmax = MIN_TIME
 
+        scan_sp = span.child("reader_scan") if span is not None else None
+        if scan_sp is not None:
+            scan_sp.start_ns = _now_ns()
+
         if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
             # column-store path: tags are columns; fragments pruned by
             # sparse indexes, group ids computed vectorized from tag
@@ -252,6 +330,8 @@ class QueryExecutor:
             scan_cols = sorted(set(needed_fields) | set(group_tags)
                                | cs_cond.residual_fields())
             for s in shards:
+                if ctx is not None:
+                    ctx.check()
                 rec = s.scan_columnstore(mst, stmt.condition, scan_cols,
                                          t_lo, t_hi)
                 if rec is None or rec.num_rows == 0:
@@ -277,8 +357,13 @@ class QueryExecutor:
                     gi = global_groups.setdefault(key, len(global_groups))
                     pairs.extend((int(sid), gi) for sid in sids)
                 per_shard.append((s, pairs))
+            if self.resources is not None:
+                self.resources.check_series(
+                    sum(len(p) for _s, p in per_shard))
             for s, pairs in per_shard:
                 for sid, gi in pairs:
+                    if ctx is not None:
+                        ctx.check()
                     rec = s.read_series(mst, sid, needed_fields or None,
                                         t_lo, t_hi)
                     if rec is None or rec.num_rows == 0:
@@ -292,6 +377,9 @@ class QueryExecutor:
                     data_tmax = max(data_tmax, rec.max_time)
                     chunks.append({"rec": rec, "gi": gi})
         G = len(global_groups)
+        if scan_sp is not None:
+            scan_sp.end_ns = _now_ns()
+            scan_sp.add(shards=len(shards), chunks=len(chunks), groups=G)
         if not chunks or G == 0:
             return None
 
@@ -342,6 +430,9 @@ class QueryExecutor:
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
         raw_slices: dict[str, dict] = {}
+        dev_sp = span.child("device_agg") if span is not None else None
+        if dev_sp is not None:
+            dev_sp.start_ns = _now_ns()
         npad = pad_bucket(n_rows)
         seg_p, times_p = pad_rows([seg, times], npad, seg_fill=num_segments)
         for fname in needed_fields:
@@ -368,6 +459,10 @@ class QueryExecutor:
             if fname in raw_fields:
                 raw_slices[fname] = _collect_raw_slices(
                     seg, vals, valid, times, G, W)
+        if dev_sp is not None:
+            dev_sp.end_ns = _now_ns()
+            dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,
+                       fields=len(needed_fields), windows=W)
 
         group_keys = [None] * G
         for key, gi in global_groups.items():
@@ -422,7 +517,7 @@ class QueryExecutor:
     # ---- raw path --------------------------------------------------------
 
     def _select_raw(self, stmt, db, mst, cs: ClassifiedSelect, cond,
-                    tag_keys) -> dict:
+                    tag_keys, ctx=None) -> dict:
         db_obj = self.engine.database(db)
         t_min, t_max = cond.t_min, cond.t_max
         shards = (db_obj.shards_overlapping(t_min, t_max)
@@ -483,6 +578,8 @@ class QueryExecutor:
                 for key, sids in s.index.group_by_tagsets(
                         mst, group_tags, cond.tag_filters):
                     for sid in sids.tolist():
+                        if ctx is not None:
+                            ctx.check()
                         rec = s.read_series(mst, sid, scan_names,
                                             t_lo, t_hi)
                         if rec is None or rec.num_rows == 0:

@@ -26,9 +26,9 @@ from datetime import datetime, timezone
 
 from .ast import (BinaryExpr, Call, CreateDatabaseStatement,
                   CreateMeasurementStatement, DeleteStatement, Dimension,
-                  DropDatabaseStatement, DropMeasurementStatement, FieldRef,
-                  Literal, SelectField, SelectStatement, ShowStatement,
-                  Wildcard)
+                  DropDatabaseStatement, DropMeasurementStatement,
+                  ExplainStatement, FieldRef, KillQueryStatement, Literal,
+                  SelectField, SelectStatement, ShowStatement, Wildcard)
 
 
 class ParseError(Exception):
@@ -221,6 +221,18 @@ class Parser:
             if self._kw("WHERE"):
                 stmt.condition = self.parse_expr()
             return stmt
+        if u == "EXPLAIN":
+            self.lx.next()
+            analyze = self._kw("ANALYZE")
+            return ExplainStatement(self.parse_select(), analyze)
+        if u == "KILL":
+            self.lx.next()
+            self._expect_kw("QUERY")
+            k2, v2, p2 = self.lx.next()
+            if k2 != "number" or not v2.isdigit():
+                raise ParseError(f"KILL QUERY requires a query id, "
+                                 f"got {v2!r} at {p2}")
+            return KillQueryStatement(int(v2))
         raise ParseError(f"unsupported statement starting {v!r} at {p}")
 
     def _parse_create_measurement(self):
@@ -337,6 +349,8 @@ class Parser:
         u = v.upper()
         if u == "DATABASES":
             return ShowStatement("databases")
+        if u == "QUERIES":
+            return ShowStatement("queries")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "SERIES":

@@ -1,0 +1,77 @@
+"""Running-query registry: SHOW QUERIES / KILL QUERY and kill-flag
+propagation into scans (role of the reference's task manager
+lib/util/lifted/influx/query/task_manager.go and the per-store query
+manager app/ts-store/transport/query/manager.go:34-169)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.errors import ErrQueryError
+
+
+class QueryKilled(ErrQueryError):
+    pass
+
+
+class QueryContext:
+    """Per-query handle: id, text, timing, kill flag. Scan loops call
+    check() at chunk boundaries (the reference aborts cursors via its
+    closed-signal channel)."""
+
+    def __init__(self, qid: int, text: str, db: str | None):
+        self.qid = qid
+        self.text = text
+        self.db = db or ""
+        self.start = time.monotonic()
+        self.start_wall = time.time()
+        self._killed = threading.Event()
+
+    def kill(self) -> None:
+        self._killed.set()
+
+    @property
+    def killed(self) -> bool:
+        return self._killed.is_set()
+
+    def check(self) -> None:
+        if self._killed.is_set():
+            raise QueryKilled(f"query {self.qid} killed")
+
+    @property
+    def duration_s(self) -> float:
+        return time.monotonic() - self.start
+
+
+class QueryManager:
+    """Thread-safe registry of in-flight queries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 1
+        self._running: dict[int, QueryContext] = {}
+
+    def attach(self, text: str, db: str | None) -> QueryContext:
+        with self._lock:
+            qid = self._next
+            self._next += 1
+            ctx = QueryContext(qid, text, db)
+            self._running[qid] = ctx
+        return ctx
+
+    def detach(self, ctx: QueryContext) -> None:
+        with self._lock:
+            self._running.pop(ctx.qid, None)
+
+    def kill(self, qid: int) -> bool:
+        with self._lock:
+            ctx = self._running.get(qid)
+        if ctx is None:
+            return False
+        ctx.kill()
+        return True
+
+    def list(self) -> list[QueryContext]:
+        with self._lock:
+            return sorted(self._running.values(), key=lambda c: c.qid)

@@ -14,12 +14,16 @@ log = get_logger(__name__)
 class CompactionService(Service):
     name = "compaction"
 
-    def __init__(self, engine, interval_s: float = 60, fanout: int = 4):
+    def __init__(self, engine, interval_s: float = 60, fanout: int = 4,
+                 sysctrl=None):
         super().__init__(interval_s)
         self.engine = engine
         self.fanout = fanout
+        self.sysctrl = sysctrl       # compaction on/off admin knob
 
     def run_once(self) -> int:
+        if self.sysctrl is not None and not self.sysctrl.compaction_enabled:
+            return 0
         n = 0
         for db in list(self.engine.databases.values()):
             for shard in db.all_shards():

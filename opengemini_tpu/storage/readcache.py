@@ -1,0 +1,83 @@
+"""Decoded-block LRU read cache (role of reference lib/readcache:
+blockcache.go + maplru.go — a byte-budgeted LRU over TSSP block reads).
+
+TPU-first deviation: the reference caches *compressed* file blocks; here
+the cache holds *decoded* ColVal segments, because the expensive step on
+this stack is decode (the mmap page cache already serves raw bytes) and
+decoded columns are what get shipped to the device. Keys are
+(file path, segment offset) — a file is immutable once written, and
+compaction produces new paths, so entries never go stale; dropped files
+just age out.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class BlockCache:
+    """Byte-accounted LRU. get/put are O(1); eviction pops oldest."""
+
+    def __init__(self, capacity_bytes: int = 256 * 1024 * 1024):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._map: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def put(self, key: tuple, value, nbytes: int) -> None:
+        if nbytes > self.capacity:
+            return
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._map[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.capacity and self._map:
+                _k, (_v, nb) = self._map.popitem(last=False)
+                self._bytes -= nb
+                self.evictions += 1
+
+    def purge(self) -> None:
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bytes": self._bytes, "capacity": self.capacity,
+                    "entries": len(self._map), "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}
+
+
+# process-wide cache; capacity reconfigured from DataConfig at startup
+_cache = BlockCache()
+_enabled = True
+
+
+def global_cache() -> BlockCache:
+    return _cache
+
+
+def configure(capacity_bytes: int) -> None:
+    global _cache, _enabled
+    _enabled = capacity_bytes > 0
+    _cache = BlockCache(max(capacity_bytes, 1))
+
+
+def enabled() -> bool:
+    return _enabled

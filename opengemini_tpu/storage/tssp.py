@@ -390,6 +390,25 @@ class TSSPReader:
     # ---- data access ----------------------------------------------------
 
     def read_segment(self, col: ColumnMeta, seg: Segment) -> ColVal:
+        from . import readcache
+        if readcache.enabled():
+            key = (self.path, seg.offset)
+            hit = readcache.global_cache().get(key)
+            if hit is not None:
+                return hit
+            out = self._decode_segment(col, seg)
+            nb = 0
+            if out.values is not None:
+                nb += out.values.nbytes
+            if out.valid is not None:
+                nb += out.valid.nbytes
+            if out.data is not None:
+                nb += len(out.data)
+            readcache.global_cache().put(key, out, nb + 64)
+            return out
+        return self._decode_segment(col, seg)
+
+    def _decode_segment(self, col: ColumnMeta, seg: Segment) -> ColVal:
         mm = self._mm
         raw = mm[seg.offset:seg.offset + seg.size]
         valid = enc.decode_validity(

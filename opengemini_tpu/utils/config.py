@@ -1,0 +1,292 @@
+"""Typed TOML configuration (role of the reference's config system:
+`lib/config/config.go:55` Config interface, `lib/config/store.go:78` TSStore,
+`lib/config/sql.go:72` TSSql, `lib/config/meta.go:72` TSMeta, and the
+section layout of `config/openGemini.conf`).
+
+One file configures any node role; each section is a dataclass with
+defaults, parsed with stdlib tomllib, validated on load. Durations accept
+either numbers (seconds) or influx duration strings ("10s", "1h").
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+from .errors import GeminiError
+
+NS = 10**9
+
+
+class ConfigError(GeminiError):
+    pass
+
+
+def _duration_ns(v, what: str) -> int:
+    """Accept seconds (int/float) or a duration string → ns."""
+    if isinstance(v, bool):
+        raise ConfigError(f"{what}: expected duration, got bool")
+    if isinstance(v, (int, float)):
+        return int(v * NS)
+    if isinstance(v, str):
+        from ..query.influxql import ParseError, parse_duration
+        try:
+            return parse_duration(v)
+        except ParseError as e:
+            raise ConfigError(f"{what}: {e}")
+    raise ConfigError(f"{what}: expected duration, got {type(v).__name__}")
+
+
+def _size_bytes(v, what: str) -> int:
+    """Accept bytes (int) or a size string ("256m", "4g", "512k")."""
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        s = v.strip().lower()
+        mult = 1
+        if s and s[-1] in "kmg":
+            mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[s[-1]]
+            s = s[:-1]
+        try:
+            return int(float(s) * mult)
+        except ValueError:
+            raise ConfigError(f"{what}: bad size {v!r}")
+    raise ConfigError(f"{what}: expected size, got {type(v).__name__}")
+
+
+@dataclass
+class CommonConfig:
+    """[common] — reference `config/openGemini.conf` [common]."""
+    meta_join: list[str] = field(default_factory=list)
+    node_id: str = ""
+    cpu_num: int = 0                      # 0 = auto
+
+
+@dataclass
+class HTTPConfig:
+    """[http] — reference [http] bind-address, auth, limits."""
+    bind_address: str = "127.0.0.1:8086"
+    auth_enabled: bool = False
+    max_body_size: int = 100 * 1024 * 1024
+    slow_query_threshold_ns: int = 10 * NS
+    flight_address: str = ""              # arrow-flight-style ingest
+
+    @property
+    def host(self) -> str:
+        return self.bind_address.rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.bind_address.rsplit(":", 1)[1])
+
+
+@dataclass
+class DataConfig:
+    """[data] — reference [data] store dirs, wal, compaction, cache."""
+    store_data_dir: str = "./data"
+    wal_sync: bool = False
+    wal_compression: str = "zstd"         # zstd | lz4
+    shard_duration_ns: int = 24 * 3600 * NS
+    flush_bytes: int = 256 * 1024 * 1024
+    segment_size: int = 8192
+    compact_enabled: bool = True
+    read_cache_bytes: int = 256 * 1024 * 1024
+    max_concurrent_queries: int = 0       # 0 = unlimited
+    max_queued_queries: int = 64
+    max_series_per_query: int = 0         # 0 = unlimited
+
+
+@dataclass
+class MetaConfig:
+    """[meta] — reference [meta] dirs and bind addresses."""
+    bind_address: str = "127.0.0.1:8091"
+    dir: str = "./meta"
+
+
+@dataclass
+class GossipConfig:
+    """[gossip] — reference [gossip]; heartbeats stand in for serf."""
+    enabled: bool = True
+    heartbeat_ns: int = 1 * NS
+    suspect_after_ns: int = 5 * NS
+
+
+@dataclass
+class LoggingConfig:
+    """[logging]."""
+    level: str = "info"
+    path: str = ""                        # empty = stderr
+
+
+@dataclass
+class RetentionConfig:
+    """[retention] — reference services/retention."""
+    enabled: bool = True
+    check_interval_ns: int = 30 * 60 * NS
+
+
+@dataclass
+class DownsampleConfig:
+    """[downsample] — reference services/downsample."""
+    enabled: bool = True
+    check_interval_ns: int = 60 * 60 * NS
+
+
+@dataclass
+class SherlockConfig:
+    """[sherlock] — reference lib/config/sherlock.go."""
+    enabled: bool = False
+    dump_path: str = "./sherlock"
+    cpu_threshold: float = 0.9
+    mem_threshold: float = 0.9
+    cooldown_ns: int = 5 * 60 * NS
+    check_interval_ns: int = 10 * NS
+
+
+@dataclass
+class IODetectorConfig:
+    """[io-detector] — reference lib/iodetector."""
+    enabled: bool = False
+    timeout_ns: int = 60 * NS
+    check_interval_ns: int = 10 * NS
+
+
+@dataclass
+class SpecLimitConfig:
+    """[spec-limit] — reference write/query guardrails."""
+    max_tag_value_len: int = 65536
+    max_fields_per_point: int = 1024
+    max_measurement_len: int = 1024
+
+
+@dataclass
+class StatsConfig:
+    """[monitor]/statistics — reference lib/statisticsPusher config."""
+    enabled: bool = False
+    interval_ns: int = 10 * NS
+    push_path: str = ""                   # file path; empty = in-memory
+    store_database: str = "_internal"     # write-back db ("" = off)
+
+
+@dataclass
+class Config:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    http: HTTPConfig = field(default_factory=HTTPConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    meta: MetaConfig = field(default_factory=MetaConfig)
+    gossip: GossipConfig = field(default_factory=GossipConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    retention: RetentionConfig = field(default_factory=RetentionConfig)
+    downsample: DownsampleConfig = field(default_factory=DownsampleConfig)
+    sherlock: SherlockConfig = field(default_factory=SherlockConfig)
+    iodetector: IODetectorConfig = field(default_factory=IODetectorConfig)
+    spec_limit: SpecLimitConfig = field(default_factory=SpecLimitConfig)
+    stats: StatsConfig = field(default_factory=StatsConfig)
+
+    def engine_options(self):
+        from ..storage.engine import EngineOptions
+        d = self.data
+        return EngineOptions(shard_duration=d.shard_duration_ns,
+                             flush_bytes=d.flush_bytes,
+                             wal_sync=d.wal_sync,
+                             wal_compression=d.wal_compression,
+                             segment_size=d.segment_size)
+
+    def validate(self) -> None:
+        if self.data.wal_compression not in ("zstd", "lz4", "none"):
+            raise ConfigError(
+                f"data.wal_compression: unknown codec "
+                f"{self.data.wal_compression!r}")
+        if self.data.segment_size <= 0:
+            raise ConfigError("data.segment_size must be > 0")
+        if self.data.shard_duration_ns <= 0:
+            raise ConfigError("data.shard_duration must be > 0")
+        for addr_name in ("http.bind_address", "meta.bind_address"):
+            sec, key = addr_name.split(".")
+            v = getattr(getattr(self, sec), key)
+            if ":" not in v:
+                raise ConfigError(f"{addr_name}: expected host:port, "
+                                  f"got {v!r}")
+            try:
+                int(v.rsplit(":", 1)[1])
+            except ValueError:
+                raise ConfigError(f"{addr_name}: bad port in {v!r}")
+        lvl = self.logging.level.lower()
+        if lvl not in ("debug", "info", "warning", "error"):
+            raise ConfigError(f"logging.level: unknown level {lvl!r}")
+
+
+# section name in TOML → (attr on Config, special-typed keys)
+_SECTIONS = {
+    "common": "common",
+    "http": "http",
+    "data": "data",
+    "meta": "meta",
+    "gossip": "gossip",
+    "logging": "logging",
+    "retention": "retention",
+    "downsample": "downsample",
+    "sherlock": "sherlock",
+    "io-detector": "iodetector",
+    "spec-limit": "spec_limit",
+    "monitor": "stats",
+}
+
+# keys parsed as durations (TOML key without the _ns suffix is accepted)
+_DURATION_SUFFIX = "_ns"
+_SIZE_KEYS = {"max_body_size", "flush_bytes", "read_cache_bytes"}
+
+
+def _apply_section(target, table: dict, section: str) -> None:
+    known = {f.name: f for f in fields(target)}
+    for key, value in table.items():
+        attr = key.replace("-", "_")
+        if attr in known:
+            pass
+        elif attr + _DURATION_SUFFIX in known:
+            attr = attr + _DURATION_SUFFIX
+        else:
+            raise ConfigError(f"[{section}] unknown key {key!r}")
+        f = known[attr]
+        if attr.endswith(_DURATION_SUFFIX):
+            value = _duration_ns(value, f"[{section}] {key}")
+        elif attr in _SIZE_KEYS:
+            value = _size_bytes(value, f"[{section}] {key}")
+        elif f.type in ("int", int) and isinstance(value, float):
+            value = int(value)
+        want = {"int": int, "float": float, "str": str, "bool": bool,
+                "list[str]": list}.get(f.type if isinstance(f.type, str)
+                                       else f.type.__name__)
+        if want is float and isinstance(value, int) \
+                and not isinstance(value, bool):
+            value = float(value)
+        if want is not None and not isinstance(value, want) \
+                or (want in (int, float) and isinstance(value, bool)):
+            raise ConfigError(
+                f"[{section}] {key}: expected {want.__name__}, "
+                f"got {type(value).__name__}")
+        setattr(target, attr, value)
+
+
+def load_config(path: str | None = None,
+                text: str | None = None) -> Config:
+    """Load and validate a TOML config; missing file → defaults."""
+    cfg = Config()
+    if text is None:
+        if path is None or not os.path.exists(path):
+            cfg.validate()
+            return cfg
+        with open(path, "rb") as fp:
+            data = tomllib.load(fp)
+    else:
+        data = tomllib.loads(text)
+    for section, table in data.items():
+        attr = _SECTIONS.get(section)
+        if attr is None:
+            raise ConfigError(f"unknown config section [{section}]")
+        if not isinstance(table, dict):
+            raise ConfigError(f"[{section}] must be a table")
+        _apply_section(getattr(cfg, attr), table, section)
+    cfg.validate()
+    return cfg

@@ -1,0 +1,75 @@
+"""Resource allocator: caps on concurrent queries and per-query series
+counts (role of reference lib/resourceallocator/resource_allocator.go,
+which meters series/shard parallelism resources per query type)."""
+
+from __future__ import annotations
+
+import threading
+
+from .errors import ErrQueryError
+
+
+class ResourceExhausted(ErrQueryError):
+    pass
+
+
+class BoundedGate:
+    """Counting semaphore with a bounded wait queue: at most `limit`
+    holders; at most `max_queued` waiters; waiters past the queue cap or
+    the timeout are rejected (the reference rejects rather than queues
+    unboundedly — resource_allocator.go)."""
+
+    def __init__(self, limit: int, max_queued: int = 64,
+                 timeout_s: float = 30.0):
+        self.limit = limit
+        self.max_queued = max_queued
+        self.timeout_s = timeout_s
+        self._sem = threading.BoundedSemaphore(limit) if limit > 0 else None
+        self._queued = 0
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        if self._sem is None:
+            return
+        with self._lock:
+            if self._queued >= self.max_queued:
+                raise ResourceExhausted(
+                    f"too many queued requests (> {self.max_queued})")
+            self._queued += 1
+        try:
+            if not self._sem.acquire(timeout=self.timeout_s):
+                raise ResourceExhausted(
+                    f"timed out waiting for a slot "
+                    f"({self.limit} concurrent)")
+        finally:
+            with self._lock:
+                self._queued -= 1
+
+    def release(self) -> None:
+        if self._sem is not None:
+            self._sem.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class QueryResources:
+    """Per-process limits wired from DataConfig: concurrent queries and
+    series touched by one query."""
+
+    def __init__(self, max_concurrent_queries: int = 0,
+                 max_queued_queries: int = 64,
+                 max_series_per_query: int = 0):
+        self.queries = BoundedGate(max_concurrent_queries,
+                                   max_queued_queries)
+        self.max_series_per_query = max_series_per_query
+
+    def check_series(self, n: int) -> None:
+        if self.max_series_per_query and n > self.max_series_per_query:
+            raise ResourceExhausted(
+                f"query touches {n} series > limit "
+                f"{self.max_series_per_query}")

@@ -1,0 +1,162 @@
+"""Statistics pusher (role of reference lib/statisticsPusher:
+statistics_pusher.go:38 interval loop + ~40 collector modules under
+lib/statisticsPusher/statistics/; pushers write to files or the internal
+monitoring database).
+
+Collectors are callables returning {metric: number}; the pusher samples
+them on an interval and emits line protocol to a file sink and/or writes
+points back into a database (the `_internal` analog). A bounded in-memory
+ring keeps the latest samples for /debug/vars.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import get_logger
+
+log = get_logger(__name__)
+
+
+class StatisticsPusher:
+    def __init__(self, interval_s: float = 10.0, push_path: str = "",
+                 engine=None, store_database: str = "_internal",
+                 node_tag: str = ""):
+        self.interval_s = interval_s
+        self.push_path = push_path
+        self.engine = engine
+        self.store_database = store_database
+        self.node_tag = node_tag
+        self._collectors: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.ring: deque = deque(maxlen=64)     # (ts, {name: metrics})
+
+    def register(self, name: str, fn) -> None:
+        """fn() -> dict[str, int|float]. Collector errors are logged and
+        skipped, never fatal (reference collectors are isolated too)."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    # ------------------------------------------------------------- sampling
+
+    def sample(self) -> dict[str, dict]:
+        out = {}
+        with self._lock:
+            items = list(self._collectors.items())
+        for name, fn in items:
+            try:
+                m = fn()
+                if m:
+                    out[name] = dict(m)
+            except Exception as e:
+                log.warning("stats collector %s failed: %s", name, e)
+        return out
+
+    def push_once(self) -> dict[str, dict]:
+        ts = time.time()
+        sample = self.sample()
+        self.ring.append((ts, sample))
+        if not sample:
+            return sample
+        lines = self._to_line_protocol(sample, int(ts * 1e9))
+        if self.push_path:
+            try:
+                with open(self.push_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+            except OSError as e:
+                log.warning("stats file push failed: %s", e)
+        if self.engine is not None and self.store_database:
+            try:
+                from ..utils.lineprotocol import parse_lines
+                self.engine.write_points(
+                    self.store_database,
+                    parse_lines("\n".join(lines)))
+            except Exception as e:
+                log.warning("stats write-back failed: %s", e)
+        return sample
+
+    def _to_line_protocol(self, sample: dict, ts_ns: int) -> list[str]:
+        tag = f",hostname={self.node_tag}" if self.node_tag else ""
+        lines = []
+        for name, metrics in sorted(sample.items()):
+            fields = ",".join(
+                f"{k}={v}" + ("i" if isinstance(v, int)
+                              and not isinstance(v, bool) else "")
+                for k, v in sorted(metrics.items())
+                if isinstance(v, (int, float)))
+            if fields:
+                lines.append(f"{name}{tag} {fields} {ts_ns}")
+        return lines
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="stats-pusher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.push_once()
+
+    def latest(self) -> dict:
+        if not self.ring:
+            return {}
+        ts, sample = self.ring[-1]
+        return {"ts": ts, "stats": sample}
+
+
+# ------------------------------------------------- standard collectors
+
+def runtime_collector():
+    """Process runtime metrics (reference statistics/runtime.go analog)."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return {
+        "rss_bytes": ru.ru_maxrss * 1024,
+        "user_cpu_s": ru.ru_utime,
+        "sys_cpu_s": ru.ru_stime,
+        "threads": threading.active_count(),
+    }
+
+
+def engine_collector(engine):
+    """Storage engine metrics (reference statistics/engine/immutable
+    collectors analog)."""
+    def collect():
+        dbs = list(engine.databases)
+        n_shards = 0
+        n_files = 0
+        for db in dbs:
+            try:
+                for s in engine.database(db).all_shards():
+                    n_shards += 1
+                    n_files += len(getattr(s, "_tables", {}) or {})
+            except KeyError:
+                continue
+        return {"databases": len(dbs), "shards": n_shards,
+                "tssp_tables": n_files}
+    return collect
+
+
+def readcache_collector():
+    from ..storage import readcache
+    return readcache.global_cache().stats()

@@ -1,0 +1,64 @@
+"""Runtime admin plane (role of reference lib/syscontrol/syscontrol.go +
+`/debug/ctrl` HTTP handler and engine/sysctrl.go: runtime knobs toggled
+over HTTP and consulted by the engine/services).
+
+Commands (query params: ?mod=<cmd>[&switchon=true|false]):
+    flush         — flush all memtables to TSSP now
+    snapshot      — alias of flush (reference snapshot ctrl)
+    readonly      — reject writes while on
+    compaction    — enable/disable background compaction
+    purgecache    — drop the decoded-block read cache
+    verbose       — debug logging on/off
+    stat          — return current flag states
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from . import get_logger
+
+log = get_logger(__name__)
+
+
+class SysControl:
+    def __init__(self, engine=None, stats_pusher=None):
+        self.engine = engine
+        self.stats_pusher = stats_pusher
+        self._lock = threading.Lock()
+        self.readonly = False
+        self.compaction_enabled = True
+        self.verbose = False
+
+    def _flag(self, params: dict) -> bool:
+        v = str(params.get("switchon", "true")).lower()
+        return v in ("1", "true", "on", "yes")
+
+    def handle(self, mod: str, params: dict) -> tuple[int, dict]:
+        with self._lock:
+            if mod in ("flush", "snapshot"):
+                if self.engine is None:
+                    return 400, {"error": "no local engine"}
+                self.engine.flush_all()
+                return 200, {"flush": "done"}
+            if mod == "readonly":
+                self.readonly = self._flag(params)
+                return 200, {"readonly": self.readonly}
+            if mod == "compaction":
+                self.compaction_enabled = self._flag(params)
+                return 200, {"compaction": self.compaction_enabled}
+            if mod == "purgecache":
+                from ..storage import readcache
+                readcache.global_cache().purge()
+                return 200, {"purgecache": "done"}
+            if mod == "verbose":
+                self.verbose = self._flag(params)
+                logging.getLogger("opengemini_tpu").setLevel(
+                    logging.DEBUG if self.verbose else logging.INFO)
+                return 200, {"verbose": self.verbose}
+            if mod == "stat":
+                return 200, {"readonly": self.readonly,
+                             "compaction": self.compaction_enabled,
+                             "verbose": self.verbose}
+            return 400, {"error": f"unknown syscontrol mod {mod!r}"}

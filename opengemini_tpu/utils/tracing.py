@@ -1,0 +1,68 @@
+"""Query tracing (role of reference lib/tracing: trace.go Span tree,
+tree.go rendering; spans threaded through cursors/transforms e.g.
+engine/aggregate_cursor.go:51,91-97 and select handler
+app/ts-store/transport/handler/select.go:279).
+
+A Trace is a tree of Spans with ns timestamps and free-form fields.
+EXPLAIN ANALYZE attaches one to the executor; kernels/stages wrap their
+work in `with span.child("..."):`. Rendering matches the reference's
+tree output shape (indented names with durations + fields).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start_ns: int = 0
+    end_ns: int = 0
+    fields: dict = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def child(self, name: str) -> "Span":
+        s = Span(name)
+        with self._lock:
+            self.children.append(s)
+        return s
+
+    def add(self, **kv) -> "Span":
+        with self._lock:
+            self.fields.update(kv)
+        return self
+
+    def __enter__(self) -> "Span":
+        self.start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end_ns = time.perf_counter_ns()
+
+    @property
+    def duration_ns(self) -> int:
+        return max(0, self.end_ns - self.start_ns)
+
+    def render(self, indent: int = 0) -> list[str]:
+        pad = "  " * indent
+        dur = self.duration_ns / 1e6
+        line = f"{pad}{self.name}: {dur:.3f}ms"
+        if self.fields:
+            kv = " ".join(f"{k}={v}" for k, v in sorted(
+                self.fields.items()))
+            line += f" [{kv}]"
+        out = [line]
+        for c in self.children:
+            out.extend(c.render(indent + 1))
+        return out
+
+
+def new_trace(name: str) -> Span:
+    s = Span(name)
+    s.start_ns = time.perf_counter_ns()
+    return s
