@@ -20,6 +20,7 @@ from ..query.ast import (CreateDatabaseStatement, DropDatabaseStatement,
                          FieldRef, SelectField, SelectStatement,
                          ShowStatement)
 from ..query.executor import (classify_select, finalize_partials,
+                              inherit_time_bounds, select_over_result,
                               transform_raw_result)
 from ..query.influxql import format_statement
 from ..utils import get_logger
@@ -135,7 +136,14 @@ class ClusterExecutor:
         if db is None:
             return {"error": "database required"}
         if stmt.from_subquery is not None:
-            return {"error": "subqueries not implemented yet"}
+            # scatter/gather the inner select, then run the outer locally
+            # over the materialized result (subquery results are already
+            # globally merged, so the outer stage is single-node work)
+            inner = inherit_time_bounds(stmt, stmt.from_subquery)
+            inner_res = self._select(inner, inner.from_db or db)
+            if "error" in inner_res:
+                return inner_res
+            return select_over_result(stmt, db, inner_res)
         mst = stmt.from_measurement
         cs = classify_select(stmt)
         if cs.mode == "agg":

@@ -193,19 +193,25 @@ class QueryExecutor:
         if db not in self.engine.databases:
             return {"error": f"database not found: {db}"}
         if stmt.from_subquery is not None:
-            return {"error": "subqueries not implemented yet"}
-        mst = stmt.from_measurement
-        cs = classify_select(stmt)
-        # tag key universe for condition analysis
-        shards_all = self.engine.database(db).all_shards()
-        tag_keys = {k for s in shards_all for k in s.index.tag_keys(mst)}
-        cond = analyze_condition(stmt.condition, tag_keys)
-        if cs.mode == "agg":
-            res = self._select_agg(stmt, db, mst, cs, cond, tag_keys,
-                                   ctx=ctx, span=span)
+            inner = inherit_time_bounds(stmt, stmt.from_subquery)
+            inner_res = self._select(inner, inner.from_db or db, ctx=ctx)
+            if "error" in inner_res:
+                return inner_res
+            res = select_over_result(stmt, db, inner_res)
         else:
-            res = self._select_raw(stmt, db, mst, cs, cond, tag_keys,
-                                   ctx=ctx)
+            mst = stmt.from_measurement
+            cs = classify_select(stmt)
+            # tag key universe for condition analysis
+            shards_all = self.engine.database(db).all_shards()
+            tag_keys = {k for s in shards_all
+                        for k in s.index.tag_keys(mst)}
+            cond = analyze_condition(stmt.condition, tag_keys)
+            if cs.mode == "agg":
+                res = self._select_agg(stmt, db, mst, cs, cond, tag_keys,
+                                       ctx=ctx, span=span)
+            else:
+                res = self._select_raw(stmt, db, mst, cs, cond, tag_keys,
+                                       ctx=ctx)
         if stmt.into_measurement:
             return self._write_into(stmt, db, res)
         return res
@@ -632,6 +638,87 @@ class QueryExecutor:
         if not plain:
             res = transform_raw_result(cs, stmt, res)
         return res
+
+
+# ------------------------------------------------------------ subqueries
+
+def inherit_time_bounds(stmt, inner):
+    """Influx subquery time semantics (lib/util/lifted/influx/query/
+    subquery.go): the inner statement runs over the INTERSECTION of its
+    own and the outer's time bounds — an outer `WHERE time ...` reaches
+    into a boundless subquery. Returns the (possibly rewritten) inner."""
+    from dataclasses import replace
+
+    from .ast import Literal
+    outer_c = analyze_condition(stmt.condition, set())
+    if not outer_c.has_time_range:
+        return inner
+    inner_c = analyze_condition(inner.condition, set())
+    t_min = max(inner_c.t_min, outer_c.t_min)
+    t_max = min(inner_c.t_max, outer_c.t_max)
+    if (t_min, t_max) == (inner_c.t_min, inner_c.t_max):
+        return inner
+    from .ast import BinaryExpr, FieldRef
+    cond = inner.condition
+    # appended bounds intersect with any existing ones in the analyzer,
+    # so duplicated time predicates are harmless
+    if t_min != MIN_TIME:
+        e = BinaryExpr(">=", FieldRef("time"), Literal(t_min))
+        cond = e if cond is None else BinaryExpr("and", cond, e)
+    if t_max != MAX_TIME:
+        e = BinaryExpr("<=", FieldRef("time"), Literal(t_max))
+        cond = e if cond is None else BinaryExpr("and", cond, e)
+    return replace(inner, condition=cond)
+
+
+def select_over_result(stmt, db: str, inner_res: dict) -> dict:
+    """FROM (subquery): materialize the inner result into a throwaway
+    engine and run the outer statement over it, once per inner
+    measurement (reference semantics lib/util/lifted/influx/query/
+    subquery.go: the inner emitter is the outer's source — inner series
+    tags stay tags, inner output columns become fields, each inner
+    measurement yields its own outer series)."""
+    import tempfile
+    from dataclasses import replace
+
+    from ..storage.engine import Engine, EngineOptions
+    from ..storage.rows import PointRow
+
+    if "series" not in inner_res:
+        return {}
+    import os
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(prefix="og-subquery-", dir=shm) as td:
+        # one giant shard: the derived dataset is small (it already fit
+        # in an HTTP result) and pre-pruned by the inner time bounds
+        eng = Engine(td, EngineOptions(shard_duration=1 << 62))
+        try:
+            eng.create_database(db)
+            rows = []
+            for s in inner_res["series"]:
+                tags = dict(s.get("tags") or {})
+                cols = s["columns"]
+                for v in s["values"]:
+                    fields = {c: val for c, val in zip(cols[1:], v[1:])
+                              if val is not None}
+                    if fields:
+                        rows.append(PointRow(s["name"], tags, fields,
+                                             int(v[0])))
+            if rows:
+                eng.write_points(db, rows)
+            ex = QueryExecutor(eng)
+            out: list = []
+            for mst in eng.measurements(db):
+                sub = replace(stmt, from_subquery=None,
+                              from_measurement=mst, from_db=None,
+                              into_measurement=None, into_db=None)
+                res = ex._select(sub, db)
+                if "error" in res:
+                    return res
+                out.extend(res.get("series", []))
+            return {"series": out} if out else {}
+        finally:
+            eng.close()
 
 
 # ---------------------------------------------------- partial-agg merge

@@ -146,6 +146,11 @@ def test_distributed_raw_matches_single_node(loaded, q):
     # raw-mode expressions: plain scan shipped, materialized at sql node
     "SELECT usage * 2 + 1 FROM cpu WHERE host = 'h1' LIMIT 5",
     "SELECT derivative(usage, 10s) FROM cpu WHERE host = 'h0' LIMIT 10",
+    # subqueries: inner scattered, outer over the materialized result
+    "SELECT max(m) FROM (SELECT mean(usage) AS m FROM cpu GROUP BY host)",
+    "SELECT mean(mx) FROM (SELECT max(usage) AS mx FROM cpu "
+    "GROUP BY time(1m), host) WHERE time >= 0 AND time < 10m "
+    "GROUP BY time(1m)",
 ])
 def test_distributed_functions_match_single_node(loaded, q):
     _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q))

@@ -231,3 +231,88 @@ def test_unknown_db_and_empty_result(db):
     (stmt,) = parse_query("SELECT v FROM nothing")
     res = ex.execute(stmt, "db0")
     assert res == {} or "series" not in res
+
+
+# ------------------------------------------------------------- subqueries
+
+def test_subquery_agg_over_agg(db):
+    eng, ex = db
+    seed_cpu(eng)
+    # max of the per-host per-minute means (h0: 2.5, h1: 12.5, h2: 22.5)
+    res = q(ex, "SELECT max(mean) FROM (SELECT mean(usage_user) FROM cpu "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m), host)")
+    assert res["series"][0]["columns"] == ["time", "max"]
+    assert res["series"][0]["values"][0][1] == 22.5
+
+
+def test_subquery_mean_of_maxes_group_by_time(db):
+    eng, ex = db
+    seed_cpu(eng)
+    # per-window max per host = h*10+5 → mean over hosts = 15
+    res = q(ex, "SELECT mean(mx) FROM (SELECT max(usage_user) AS mx "
+                "FROM cpu WHERE time >= 0 AND time < 4m "
+                "GROUP BY time(1m), host) "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m)")
+    vals = res["series"][0]["values"]
+    assert [r[1] for r in vals] == [15.0] * 4
+
+
+def test_subquery_tags_survive_group_by(db):
+    eng, ex = db
+    seed_cpu(eng)
+    # inner keeps host as a tag; outer groups by it
+    res = q(ex, "SELECT sum(mean) FROM (SELECT mean(usage_user) FROM cpu "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m), host) "
+                "GROUP BY host")
+    tags = sorted(s["tags"]["host"] for s in res["series"])
+    assert tags == ["h0", "h1", "h2"]
+    s0 = [s for s in res["series"] if s["tags"]["host"] == "h0"][0]
+    assert s0["values"][0][1] == 2.5 * 4
+
+
+def test_subquery_where_on_inner_output(db):
+    eng, ex = db
+    seed_cpu(eng)
+    res = q(ex, "SELECT count(mean) FROM (SELECT mean(usage_user) FROM "
+                "cpu WHERE time >= 0 AND time < 4m "
+                "GROUP BY time(1m), host) WHERE mean > 10")
+    # h1 (12.5) and h2 (22.5) qualify, 4 windows each
+    assert res["series"][0]["values"][0][1] == 8
+
+
+def test_subquery_raw_inner(db):
+    eng, ex = db
+    seed_cpu(eng)
+    res = q(ex, "SELECT mean(usage_user) FROM "
+                "(SELECT usage_user FROM cpu WHERE host = 'h0')")
+    assert res["series"][0]["values"][0][1] == 2.5
+
+
+def test_subquery_nested_two_levels(db):
+    eng, ex = db
+    seed_cpu(eng)
+    res = q(ex, "SELECT max(m2) FROM (SELECT mean(mx) AS m2 FROM "
+                "(SELECT max(usage_user) AS mx FROM cpu "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m), host) "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m))")
+    assert res["series"][0]["values"][0][1] == 15.0
+
+
+def test_subquery_empty_inner(db):
+    eng, ex = db
+    seed_cpu(eng)
+    res = q(ex, "SELECT mean(x) FROM (SELECT mean(nosuch) FROM cpu)")
+    assert res == {}
+
+
+def test_subquery_inherits_outer_time_bounds(db):
+    eng, ex = db
+    seed_cpu(eng)
+    # inner has no time bounds: outer WHERE time reaches in (influx
+    # subquery time inheritance); per-window max per host = h*10+5
+    res = q(ex, "SELECT mean(mx) FROM (SELECT max(usage_user) AS mx "
+                "FROM cpu GROUP BY time(1m), host) "
+                "WHERE time >= 2m AND time < 4m GROUP BY time(1m)")
+    vals = res["series"][0]["values"]
+    assert [r[0] for r in vals] == [2 * MIN, 3 * MIN]
+    assert [r[1] for r in vals] == [15.0, 15.0]
