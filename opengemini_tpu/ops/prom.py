@@ -43,14 +43,28 @@ class BucketState(NamedTuple):
     min: jax.Array
     max: jax.Array
     inc: jax.Array          # reset-corrected increase WITHIN the bucket
+    sumsq: jax.Array        # sum of squares (stddev/stdvar_over_time)
+    resets: jax.Array       # counter resets WITHIN the bucket
+    changes: jax.Array      # value changes WITHIN the bucket
+    sum_t: jax.Array        # sum of times (seconds, origin-relative)
+    sum_tv: jax.Array       # sum of time*value (deriv/predict_linear)
+    sum_t2: jax.Array       # sum of time^2
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments",))
 def bucket_states(values, valid, times, seg_ids, series_ids,
-                  num_segments: int) -> BucketState:
+                  num_segments: int, origin_t=0,
+                  value_anchor=0.0) -> BucketState:
     """One fused pass: rows (sorted by series, then time) → per-segment
     BucketState. seg_ids = series_index * num_buckets + bucket. series_ids
-    identify series-change boundaries for the reset correction."""
+    identify series-change boundaries for the reset correction. origin_t:
+    ns origin the regression time sums are taken relative to (keeps t^2
+    magnitudes small — epoch-relative seconds squared would eat half the
+    float64 mantissa). value_anchor: per-row value shift (typically each
+    series' first sample) applied to the second-order sums (sumsq,
+    sum_tv) for the same cancellation reason — a 1.7e9-magnitude gauge
+    has sumsq ulp ≈ 512, so un-anchored variance is rounding noise.
+    First-order state (sum/min/max/first/last/inc) stays unshifted."""
     ns = num_segments + 1
     n = values.shape[0]
     fdt = values.dtype
@@ -60,7 +74,10 @@ def bucket_states(values, valid, times, seg_ids, series_ids,
         return jax.ops.segment_sum(x, seg_ids, ns)[:num_segments]
 
     cnt = seg_sum(valid.astype(_I64))
-    ssum = seg_sum(jnp.where(valid, values, jnp.zeros((), fdt)))
+    vz = jnp.where(valid, values, jnp.zeros((), fdt))
+    va = jnp.where(valid, values - value_anchor, jnp.zeros((), fdt))
+    ssum = seg_sum(vz)
+    ssumsq = seg_sum(va * va)
     smin = jax.ops.segment_min(
         jnp.where(valid, values, jnp.array(jnp.inf, fdt)), seg_ids,
         ns)[:num_segments]
@@ -79,17 +96,26 @@ def bucket_states(values, valid, times, seg_ids, series_ids,
     last = jnp.where(li >= 0, values[lsafe], jnp.nan)
     last_t = jnp.where(li >= 0, times[lsafe], 0)
 
-    # reset-corrected within-bucket increase: for consecutive valid samples
-    # of the SAME series and bucket, step increase = cur - prev if cur>=prev
-    # else cur (counter reset); summed per segment
+    # linear-regression moments over origin-relative seconds and
+    # anchor-relative values
+    t_rel = jnp.where(valid, (times - origin_t).astype(fdt) / 1e9,
+                      jnp.zeros((), fdt))
+    sum_t = seg_sum(t_rel)
+    sum_tv = seg_sum(t_rel * va)
+    sum_t2 = seg_sum(t_rel * t_rel)
+
+    # pairwise stats over consecutive valid samples of the SAME series and
+    # bucket: reset-corrected increase, counter resets, value changes
     prev_v = jnp.roll(values, 1)
     same = (jnp.roll(seg_ids, 1) == seg_ids) & valid & jnp.roll(valid, 1)
     same = same.at[0].set(False)
     step_inc = jnp.where(values >= prev_v, values - prev_v, values)
     inc = seg_sum(jnp.where(same, step_inc, jnp.zeros((), fdt)))
+    resets = seg_sum((same & (values < prev_v)).astype(_I64))
+    changes = seg_sum((same & (values != prev_v)).astype(_I64))
 
     return BucketState(cnt, first, last, first_t, last_t, ssum, smin, smax,
-                       inc)
+                       inc, ssumsq, resets, changes, sum_t, sum_tv, sum_t2)
 
 
 def _merge(a: BucketState, b: BucketState) -> BucketState:
@@ -100,7 +126,7 @@ def _merge(a: BucketState, b: BucketState) -> BucketState:
     first_t = jnp.where(a_has, a.first_t, b.first_t)
     last = jnp.where(b_has, b.last, a.last)
     last_t = jnp.where(b_has, b.last_t, a.last_t)
-    # boundary reset correction between a.last and b.first
+    # boundary corrections between a.last and b.first
     both = a_has & b_has
     boundary = jnp.where(
         both,
@@ -108,13 +134,26 @@ def _merge(a: BucketState, b: BucketState) -> BucketState:
         0.0)
     inc = (jnp.where(a_has, a.inc, 0.0) + jnp.where(b_has, b.inc, 0.0)
            + boundary)
+    resets = (a.resets + b.resets
+              + (both & (b.first < a.last)).astype(a.resets.dtype))
+    changes = (a.changes + b.changes
+               + (both & (b.first != a.last)).astype(a.changes.dtype))
+
+    def add(x, y):
+        return jnp.where(a_has, x, 0.0) + jnp.where(b_has, y, 0.0)
+
     return BucketState(
         count=a.count + b.count,
         first=first, last=last, first_t=first_t, last_t=last_t,
-        sum=jnp.where(a_has, a.sum, 0.0) + jnp.where(b_has, b.sum, 0.0),
+        sum=add(a.sum, b.sum),
         min=jnp.minimum(a.min, b.min),
         max=jnp.maximum(a.max, b.max),
-        inc=inc)
+        inc=inc,
+        sumsq=add(a.sumsq, b.sumsq),
+        resets=resets, changes=changes,
+        sum_t=add(a.sum_t, b.sum_t),
+        sum_tv=add(a.sum_tv, b.sum_tv),
+        sum_t2=add(a.sum_t2, b.sum_t2))
 
 
 def _shift_right(s: BucketState, by: int) -> BucketState:
@@ -129,7 +168,10 @@ def _shift_right(s: BucketState, by: int) -> BucketState:
         last=sh(s.last, jnp.nan), first_t=sh(s.first_t, 0),
         last_t=sh(s.last_t, 0), sum=sh(s.sum, 0.0),
         min=sh(s.min, jnp.inf), max=sh(s.max, -jnp.inf),
-        inc=sh(s.inc, 0.0))
+        inc=sh(s.inc, 0.0), sumsq=sh(s.sumsq, 0.0),
+        resets=sh(s.resets, 0), changes=sh(s.changes, 0),
+        sum_t=sh(s.sum_t, 0.0), sum_tv=sh(s.sum_tv, 0.0),
+        sum_t2=sh(s.sum_t2, 0.0))
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -227,7 +269,10 @@ def prom_irate_value(last, prev, last_t, prev_t, cnt, kind: str = "irate"):
 
 
 # over_time family: direct from merged window states
-def over_time_value(win: BucketState, func: str):
+def over_time_value(win: BucketState, func: str, value_anchor=0.0):
+    """value_anchor: the per-series shift bucket_states applied to the
+    second-order sums — needed to reconstruct variance (shape must
+    broadcast against win arrays, e.g. (S, 1))."""
     has = win.count > 0
     if func == "avg_over_time":
         v = win.sum / jnp.maximum(win.count, 1)
@@ -243,6 +288,41 @@ def over_time_value(win: BucketState, func: str):
         v = win.last
     elif func == "first_over_time":
         v = win.first
+    elif func == "present_over_time":
+        v = jnp.ones_like(win.sum)
+    elif func in ("stddev_over_time", "stdvar_over_time"):
+        n = jnp.maximum(win.count, 1).astype(jnp.float64)
+        # sumsq is anchor-relative; var is shift-invariant
+        mean_a = win.sum / n - value_anchor
+        v = jnp.maximum(win.sumsq / n - mean_a * mean_a, 0.0)
+        if func == "stddev_over_time":
+            v = jnp.sqrt(v)
+    elif func == "resets":
+        v = win.resets.astype(jnp.float64)
+    elif func == "changes":
+        v = win.changes.astype(jnp.float64)
     else:
         raise ValueError(f"unsupported over_time func {func}")
     return jnp.where(has, v, jnp.nan)
+
+
+def prom_linreg(win: BucketState, end_rel_s, value_anchor=0.0):
+    """Least-squares fit over the window's samples (prom linearRegression,
+    promql/functions.go): returns (slope, intercept at the window end
+    time). end_rel_s: window end times in seconds relative to the same
+    origin bucket_states used for its regression moments; value_anchor:
+    the per-series value shift it applied to sum_tv (slope is
+    shift-invariant, the intercept un-shifts)."""
+    ok = win.count >= 2
+    n = jnp.maximum(win.count, 1).astype(jnp.float64)
+    mean_t = win.sum_t / n
+    mean_va = win.sum / n - value_anchor
+    # covariance/variance from raw moments (n-weighted, factors cancel)
+    cov = win.sum_tv - win.sum_t * mean_va
+    var = win.sum_t2 - win.sum_t * mean_t
+    # all samples at one timestamp → var 0 → undefined slope
+    ok = ok & (var > 0)
+    slope = cov / jnp.where(var > 0, var, 1.0)
+    intercept = mean_va + value_anchor + slope * (end_rel_s - mean_t)
+    return (jnp.where(ok, slope, jnp.nan),
+            jnp.where(ok, intercept, jnp.nan))

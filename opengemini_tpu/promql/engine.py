@@ -49,6 +49,13 @@ class SeriesMatrix:
         return SeriesMatrix(labels, self.values, True)
 
 
+@dataclass
+class ScalarSteps:
+    """A scalar that varies per eval step — prom 'scalar' type in a range
+    query (time(), scalar(v)). Plain python floats stay floats."""
+    values: np.ndarray            # (B,) float64
+
+
 class PromQLError(Exception):
     pass
 
@@ -65,6 +72,8 @@ class PromEngine:
         """Returns prom API 'vector' result list."""
         expr = parse_promql(text)
         res = self._eval(expr, t_ns, t_ns, 10**9, lookback_ns)
+        if isinstance(res, ScalarSteps):
+            res = float(res.values[-1])
         if isinstance(res, float):
             return [{"metric": {}, "value": [t_ns / 1e9, _fmt(res)]}]
         out = []
@@ -89,6 +98,11 @@ class PromEngine:
         if isinstance(res, float):
             return [{"metric": {},
                      "values": [[t, _fmt(res)] for t in ts]}]
+        if isinstance(res, ScalarSteps):
+            return [{"metric": {},
+                     "values": [[ts[i], _fmt(res.values[i])]
+                                for i in range(nsteps)
+                                if not np.isnan(res.values[i])]}]
         out = []
         for ls, row in zip(res.labels, res.values):
             vals = [[ts[i], _fmt(row[i])] for i in range(nsteps)
@@ -177,9 +191,21 @@ class PromEngine:
         if isinstance(expr, Aggregation):
             inner = self._eval(expr.expr, start_ns, end_ns, step_ns,
                                lookback_ns)
-            if isinstance(inner, float):
+            if isinstance(inner, (float, ScalarSteps)):
                 raise PromQLError(f"{expr.op} expects a vector")
-            return _aggregate(expr, inner)
+            nsteps = int((end_ns - start_ns) // step_ns) + 1
+            param = None
+            if expr.op in ("topk", "bottomk", "quantile"):
+                if expr.param is None:
+                    raise PromQLError(f"{expr.op} requires a parameter")
+                param = self._scalar_arg(expr.param, start_ns, end_ns,
+                                         step_ns, lookback_ns, nsteps)
+            elif expr.op == "count_values":
+                if not isinstance(expr.param, StringLit):
+                    raise PromQLError(
+                        "count_values requires a string label name")
+                param = expr.param.value
+            return _aggregate(expr, inner, param)
         if isinstance(expr, BinaryOp):
             return self._eval_binop(expr, start_ns, end_ns, step_ns,
                                     lookback_ns)
@@ -265,13 +291,18 @@ class PromEngine:
         labels, values, times, series = self._gather(vs, t_lo, t_hi)
         S = len(labels)
         if S == 0:
-            return [], None, None
+            return [], None, None, origin, None
+        # per-series value anchor (first sample) shifts the second-order
+        # sums in the kernel — large-magnitude gauges would otherwise
+        # cancel catastrophically in variance/regression
+        anchor = values[np.searchsorted(series, np.arange(S))]
         nb = k + (nsteps - 1) * stride
         bucket = (times - origin - 1) // bs
         seg = np.where((bucket >= 0) & (bucket < nb),
                        series * nb + bucket, S * nb)
         st = K.bucket_states(values, np.ones(len(values), bool), times,
-                             seg, series, S * nb)
+                             seg, series, S * nb, origin_t=origin,
+                             value_anchor=anchor[series])
         st = K.BucketState(*[np.asarray(x).reshape(S, nb) for x in st])
         win = K.fold_windows(st, int(k))
         # slice eval positions: indices k-1, k-1+stride, ...
@@ -279,11 +310,12 @@ class PromEngine:
         win = K.BucketState(*[np.asarray(x)[:, sel] for x in win])
         ends = (start_ns - off + step_ns * np.arange(nsteps)).astype(
             np.int64)
-        return labels, win, np.broadcast_to(ends, (S, nsteps))
+        return (labels, win, np.broadcast_to(ends, (S, nsteps)), origin,
+                anchor.reshape(S, 1))
 
     def _eval_selector_instant(self, vs, start_ns, end_ns, step_ns,
                                lookback_ns) -> SeriesMatrix:
-        labels, win, _ends = self._window_states(
+        labels, win, _ends, _origin, _anchor = self._window_states(
             vs, start_ns, end_ns, step_ns, lookback_ns)
         if win is None:
             return SeriesMatrix([], np.zeros((0, 1)))
@@ -292,87 +324,271 @@ class PromEngine:
 
     # ---- functions -------------------------------------------------------
 
+    def _scalar_arg(self, e, start_ns, end_ns, step_ns, lookback_ns,
+                    nsteps) -> np.ndarray:
+        """Evaluate an argument that must be a scalar → per-step row."""
+        v = self._eval(e, start_ns, end_ns, step_ns, lookback_ns)
+        if isinstance(v, float):
+            return np.full(nsteps, v)
+        if isinstance(v, ScalarSteps):
+            return v.values
+        raise PromQLError("expected a scalar argument")
+
     def _eval_func(self, fc: FuncCall, start_ns, end_ns, step_ns,
                    lookback_ns):
         f = fc.func
+        nsteps = int((end_ns - start_ns) // step_ns) + 1
+        step_ts = (start_ns + step_ns * np.arange(nsteps)) / 1e9
+
+        def scal(e):
+            return self._scalar_arg(e, start_ns, end_ns, step_ns,
+                                    lookback_ns, nsteps)
+
+        def vec(e) -> SeriesMatrix:
+            v = self._eval(e, start_ns, end_ns, step_ns, lookback_ns)
+            if isinstance(v, (float, ScalarSteps)):
+                raise PromQLError(f"{f}() expects an instant vector")
+            return v
+
         if f in RANGE_FUNCS:
-            if len(fc.args) != 1 or not isinstance(fc.args[0],
-                                                   VectorSelector):
-                raise PromQLError(f"{f}() expects a range vector selector")
-            vs = fc.args[0]
-            if not vs.range_ns:
-                raise PromQLError(f"{f}() expects a range like {f}(x[5m])")
-            labels, win, ends = self._window_states(
-                vs, start_ns, end_ns, step_ns, vs.range_ns)
-            if win is None:
-                return SeriesMatrix([], np.zeros((0, 1)))
-            if f in ("rate", "increase", "delta"):
-                kind = f if f != "increase" else "increase"
-                vals = np.asarray(K.prom_rate(win, ends, vs.range_ns,
-                                              kind))
-            elif f in ("irate", "idelta"):
-                labels, vals = self._irate(vs, start_ns, end_ns, step_ns, f)
-            elif f == "resets" or f == "changes":
-                raise PromQLError(f"{f}() not implemented yet")
-            else:
-                vals = np.asarray(K.over_time_value(win, f))
-            return SeriesMatrix(labels, vals).drop_metric()
+            return self._eval_range_func(fc, start_ns, end_ns, step_ns,
+                                         nsteps, lookback_ns)
+        if f == "time":
+            if fc.args:
+                raise PromQLError("time() takes no arguments")
+            return ScalarSteps(step_ts.copy())
+        if f == "pi":
+            return float(np.pi)
+        if f == "vector":
+            if len(fc.args) != 1:
+                raise PromQLError("vector() expects 1 argument")
+            row = scal(fc.args[0])
+            return SeriesMatrix([{}], row.reshape(1, -1), True)
         if f == "scalar":
             inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
                                lookback_ns)
             if isinstance(inner, float):
                 return inner
+            if isinstance(inner, ScalarSteps):
+                return inner
             if len(inner.labels) == 1:
-                m = inner.values[0]
-                return SeriesMatrix([{}], m.reshape(1, -1), True)
-            nsteps = int((end_ns - start_ns) // step_ns) + 1
-            return SeriesMatrix([{}], np.full((1, nsteps), np.nan), True)
-        if f in ("abs", "ceil", "floor", "exp", "ln", "log2", "log10",
-                 "sqrt", "round"):
+                return ScalarSteps(inner.values[0].copy())
+            return ScalarSteps(np.full(nsteps, np.nan))
+        if f in _ELEMENTWISE:
+            if len(fc.args) != 1:
+                raise PromQLError(f"{f}() expects 1 argument")
             inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
                                lookback_ns)
-            if isinstance(inner, float):
-                inner = SeriesMatrix([{}], np.array([[inner]]), True)
-            fn = {"abs": np.abs, "ceil": np.ceil, "floor": np.floor,
-                  "exp": np.exp, "ln": np.log, "log2": np.log2,
-                  "log10": np.log10, "sqrt": np.sqrt,
-                  "round": np.round}[f]
+            fn = _ELEMENTWISE[f]
             with np.errstate(all="ignore"):
+                if isinstance(inner, float):
+                    return float(fn(inner))
+                if isinstance(inner, ScalarSteps):
+                    return ScalarSteps(fn(inner.values))
                 return SeriesMatrix(inner.labels, fn(inner.values),
                                     inner.metric_dropped).drop_metric()
-        if f in ("clamp_min", "clamp_max"):
+        if f in ("clamp_min", "clamp_max", "clamp"):
+            inner = vec(fc.args[0])
+            with np.errstate(all="ignore"):
+                if f == "clamp":
+                    if len(fc.args) != 3:
+                        raise PromQLError("clamp(v, min, max) expected")
+                    lo, hi = scal(fc.args[1]), scal(fc.args[2])
+                    vals = np.clip(inner.values, lo, np.maximum(lo, hi))
+                    vals = np.where(lo <= hi, vals, np.nan)
+                else:
+                    lim = scal(fc.args[1])
+                    op = np.maximum if f == "clamp_min" else np.minimum
+                    vals = op(inner.values, lim)
+            return SeriesMatrix(inner.labels, vals,
+                                inner.metric_dropped).drop_metric()
+        if f in ("sort", "sort_desc"):
+            inner = vec(fc.args[0])
+            key = inner.values[:, -1] if inner.values.size else \
+                np.zeros(0)
+            key = np.where(np.isnan(key), -np.inf, key)
+            order = np.argsort(-key if f == "sort_desc" else key,
+                               kind="stable")
+            return SeriesMatrix([inner.labels[i] for i in order],
+                                inner.values[order],
+                                inner.metric_dropped)
+        if f == "timestamp":
+            arg = fc.args[0] if fc.args else None
+            if isinstance(arg, VectorSelector) and not arg.range_ns:
+                labels, win, _e, _o, _a = self._window_states(
+                    arg, start_ns, end_ns, step_ns, lookback_ns)
+                if win is None:
+                    return SeriesMatrix([], np.zeros((0, nsteps)), True)
+                vals = np.where(np.asarray(win.count) > 0,
+                                np.asarray(win.last_t) / 1e9, np.nan)
+                return SeriesMatrix(labels, vals).drop_metric()
+            inner = vec(arg)
+            vals = np.where(np.isnan(inner.values), np.nan, step_ts)
+            return SeriesMatrix(inner.labels, vals,
+                                inner.metric_dropped).drop_metric()
+        if f == "absent":
             inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
                                lookback_ns)
-            lim = self._eval(fc.args[1], start_ns, end_ns, step_ns,
-                             lookback_ns)
-            if not isinstance(lim, float):
-                raise PromQLError(f"{f} limit must be a scalar")
-            op = np.maximum if f == "clamp_min" else np.minimum
-            return SeriesMatrix(inner.labels, op(inner.values, lim),
+            if isinstance(inner, (float, ScalarSteps)):
+                raise PromQLError("absent() expects an instant vector")
+            present = (~np.isnan(inner.values)).any(axis=0) \
+                if inner.values.size else np.zeros(nsteps, bool)
+            vals = np.where(present, np.nan, 1.0).reshape(1, -1)
+            ls = _absent_labels(fc.args[0])
+            return SeriesMatrix([ls], vals, True)
+        if f == "histogram_quantile":
+            if len(fc.args) != 2:
+                raise PromQLError("histogram_quantile(φ, vector) expected")
+            q = scal(fc.args[0])
+            inner = vec(fc.args[1])
+            return _histogram_quantile(q, inner, nsteps)
+        if f == "label_replace":
+            if len(fc.args) != 5:
+                raise PromQLError("label_replace(v, dst, repl, src, "
+                                  "regex) expected")
+            inner = vec(fc.args[0])
+            dst, repl, src, regex = (_str_arg(a, f) for a in fc.args[1:])
+            return _label_replace(inner, dst, repl, src, regex)
+        if f == "label_join":
+            if len(fc.args) < 3:
+                raise PromQLError("label_join(v, dst, sep, src...) "
+                                  "expected")
+            inner = vec(fc.args[0])
+            dst, sep = _str_arg(fc.args[1], f), _str_arg(fc.args[2], f)
+            srcs = [_str_arg(a, f) for a in fc.args[3:]]
+            out = []
+            for ls in inner.labels:
+                ls = dict(ls)
+                val = sep.join(ls.get(s, "") for s in srcs)
+                if val:
+                    ls[dst] = val
+                else:
+                    ls.pop(dst, None)
+                out.append(ls)
+            return SeriesMatrix(out, inner.values, inner.metric_dropped)
+        if f in _TIME_COMPONENT:
+            if fc.args:
+                inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,
+                                   lookback_ns)
+            else:
+                inner = ScalarSteps(step_ts.copy())
+            comp = _TIME_COMPONENT[f]
+            if isinstance(inner, float):
+                return float(_calendar(np.array([inner]), comp)[0])
+            if isinstance(inner, ScalarSteps):
+                return SeriesMatrix([{}],
+                                    _calendar(inner.values,
+                                              comp).reshape(1, -1), True)
+            vals = _calendar(inner.values, comp)
+            return SeriesMatrix(inner.labels, vals,
                                 inner.metric_dropped).drop_metric()
         raise PromQLError(f"unsupported function {f}()")
+
+    def _eval_range_func(self, fc: FuncCall, start_ns, end_ns, step_ns,
+                         nsteps, lookback_ns):
+        f = fc.func
+        # locate the range-vector argument; side scalars per function
+        q_row = t_pred = None
+        if f == "quantile_over_time":
+            if len(fc.args) != 2:
+                raise PromQLError("quantile_over_time(φ, v[d]) expected")
+            q_row = self._scalar_arg(fc.args[0], start_ns, end_ns,
+                                     step_ns, lookback_ns, nsteps)
+            vs = fc.args[1]
+        elif f == "predict_linear":
+            if len(fc.args) != 2:
+                raise PromQLError("predict_linear(v[d], t) expected")
+            vs = fc.args[0]
+            t_pred = self._scalar_arg(fc.args[1], start_ns, end_ns,
+                                      step_ns, lookback_ns, nsteps)
+        else:
+            if len(fc.args) != 1:
+                raise PromQLError(f"{f}() expects a range vector selector")
+            vs = fc.args[0]
+        if not isinstance(vs, VectorSelector) or not vs.range_ns:
+            raise PromQLError(f"{f}() expects a range like {f}(x[5m])")
+
+        if f in ("irate", "idelta"):
+            labels, vals = self._irate(vs, start_ns, end_ns, step_ns, f)
+            return SeriesMatrix(labels, vals).drop_metric()
+        if f == "quantile_over_time":
+            labels, vals = self._quantile_over_time(
+                vs, q_row, start_ns, end_ns, step_ns, nsteps)
+            return SeriesMatrix(labels, vals).drop_metric()
+
+        labels, win, ends, origin, anchor = self._window_states(
+            vs, start_ns, end_ns, step_ns, vs.range_ns)
+        if win is None:
+            if f == "absent_over_time":
+                return SeriesMatrix([_absent_labels(vs)],
+                                    np.ones((1, nsteps)), True)
+            return SeriesMatrix([], np.zeros((0, nsteps)), True)
+        if f in ("rate", "increase", "delta"):
+            vals = np.asarray(K.prom_rate(win, ends, vs.range_ns, f))
+        elif f == "deriv":
+            end_rel = (ends - origin) / 1e9
+            slope, _ic = K.prom_linreg(win, end_rel, anchor)
+            vals = np.asarray(slope)
+        elif f == "predict_linear":
+            end_rel = (ends - origin) / 1e9
+            slope, icept = K.prom_linreg(win, end_rel, anchor)
+            # prom anchors the intercept at the EVAL timestamp, which for
+            # an offset selector is `offset` past the window end
+            vals = (np.asarray(icept)
+                    + np.asarray(slope) * (t_pred + vs.offset_ns / 1e9))
+        elif f == "absent_over_time":
+            present = (np.asarray(win.count) > 0).any(axis=0)
+            vals = np.where(present, np.nan, 1.0).reshape(1, -1)
+            return SeriesMatrix([_absent_labels(vs)], vals, True)
+        else:
+            vals = np.asarray(K.over_time_value(win, f, anchor))
+        return SeriesMatrix(labels, vals).drop_metric()
+
+    def _host_pass(self, vs: VectorSelector, start_ns, end_ns, step_ns,
+                   nsteps):
+        """Raw gather + per-step window masks, for functions whose state
+        is not monoid-able into fixed-size buckets (irate's last-two
+        samples, exact window quantiles). Window = (t_i - range, t_i],
+        offset-adjusted. Returns (labels, values, times, series, masks)
+        where masks yields (step index, row mask)."""
+        off = vs.offset_ns
+        labels, values, times, series = self._gather(
+            vs, start_ns - off - vs.range_ns + 1, end_ns - off)
+
+        def masks():
+            for i in range(nsteps):
+                t_i = start_ns - off + i * step_ns
+                m = (times > t_i - vs.range_ns) & (times <= t_i)
+                if m.any():
+                    yield i, m
+        return labels, values, times, series, masks
+
+    def _quantile_over_time(self, vs, q_row, start_ns, end_ns, step_ns,
+                            nsteps):
+        labels, values, times, series, masks = self._host_pass(
+            vs, start_ns, end_ns, step_ns, nsteps)
+        if not labels:
+            return [], np.zeros((0, nsteps))
+        S = len(labels)
+        out = np.full((S, nsteps), np.nan)
+        for i, m in masks():
+            q = q_row[i]
+            for si in np.unique(series[m]):
+                v = values[m & (series == si)]
+                out[si, i] = _prom_quantile(q, v)
+        return labels, out
 
     def _irate(self, vs, start_ns, end_ns, step_ns, f):
         """Dedicated per-eval-point last-two-samples pass (bucket
         granularity can't express 'previous sample')."""
         nsteps = int((end_ns - start_ns) // step_ns) + 1
-        off = vs.offset_ns
-        labels_all = None
-        cols = []
-        # evaluate per step: segments = (series, this one window)
-        t_los = [start_ns - off + i * step_ns - vs.range_ns
-                 for i in range(nsteps)]
-        labels, values, times, series = self._gather(
-            vs, min(t_los) + 1, end_ns - off)
+        labels, values, times, series, masks = self._host_pass(
+            vs, start_ns, end_ns, step_ns, nsteps)
         if not labels:
             return [], np.zeros((0, nsteps))
         S = len(labels)
         out = np.full((S, nsteps), np.nan)
-        for i in range(nsteps):
-            t_i = start_ns - off + i * step_ns
-            m = (times > t_i - vs.range_ns) & (times <= t_i)
-            if not m.any():
-                continue
+        for i, m in masks():
             seg = np.where(m, series, S)
             last, prev, lt, pt, cnt = K.irate_states(
                 values, m, times, seg, S)
@@ -388,25 +604,40 @@ class PromEngine:
                     lookback_ns):
         lhs = self._eval(b.lhs, start_ns, end_ns, step_ns, lookback_ns)
         rhs = self._eval(b.rhs, start_ns, end_ns, step_ns, lookback_ns)
-        if isinstance(lhs, float) and isinstance(rhs, float):
-            return _scalar_op(b.op, lhs, rhs)
-        if isinstance(lhs, float):
+        l_sc = isinstance(lhs, (float, ScalarSteps))
+        r_sc = isinstance(rhs, (float, ScalarSteps))
+        if b.op in ("and", "or", "unless"):
+            if l_sc or r_sc:
+                raise PromQLError(
+                    f"set operator {b.op} requires vector operands")
+            return _set_op(b.op, lhs, rhs)
+        if l_sc and r_sc:
+            if isinstance(lhs, float) and isinstance(rhs, float):
+                return _scalar_op(b.op, lhs, rhs)
+            lr = lhs.values if isinstance(lhs, ScalarSteps) else lhs
+            rr = rhs.values if isinstance(rhs, ScalarSteps) else rhs
+            with np.errstate(all="ignore"):
+                out = _vec_op(b.op, np.asarray(lr, dtype=np.float64),
+                              rr, True)  # scalar cmp is always 0/1
+            return ScalarSteps(np.broadcast_to(
+                out, np.broadcast_shapes(np.shape(lr), np.shape(rr))
+            ).astype(np.float64).reshape(-1))
+        if l_sc:
+            lv = lhs.values if isinstance(lhs, ScalarSteps) else lhs
             return SeriesMatrix(
-                rhs.labels, _vec_op(b.op, lhs, rhs.values, b.bool_mode,
+                rhs.labels, _vec_op(b.op, lv, rhs.values, b.bool_mode,
                                     scalar_left=True),
                 rhs.metric_dropped)._maybe_drop(b)
-        if isinstance(rhs, float):
+        if r_sc:
+            rv = rhs.values if isinstance(rhs, ScalarSteps) else rhs
             return SeriesMatrix(
-                lhs.labels, _vec_op(b.op, lhs.values, rhs, b.bool_mode),
+                lhs.labels, _vec_op(b.op, lhs.values, rv, b.bool_mode),
                 lhs.metric_dropped)._maybe_drop(b)
         # vector-vector: one-to-one on full label match (sans __name__)
-        def key(ls):
-            return tuple(sorted((k, v) for k, v in ls.items()
-                                if k != "__name__"))
-        rmap = {key(ls): i for i, ls in enumerate(rhs.labels)}
+        rmap = {_lkey(ls): i for i, ls in enumerate(rhs.labels)}
         labels, rows = [], []
         for i, ls in enumerate(lhs.labels):
-            j = rmap.get(key(ls))
+            j = rmap.get(_lkey(ls))
             if j is None:
                 continue
             rows.append(_vec_op(b.op, lhs.values[i:i+1],
@@ -416,6 +647,171 @@ class PromEngine:
             nsteps = lhs.values.shape[1] if lhs.values.size else 1
             return SeriesMatrix([], np.zeros((0, nsteps)), True)
         return SeriesMatrix(labels, np.vstack(rows), True)
+
+
+with np.errstate(all="ignore"):
+    _ELEMENTWISE = {
+        "abs": np.abs, "ceil": np.ceil, "floor": np.floor,
+        "exp": np.exp, "ln": np.log, "log2": np.log2,
+        "log10": np.log10, "sqrt": np.sqrt, "round": np.round,
+        "sgn": np.sign, "sin": np.sin, "cos": np.cos, "tan": np.tan,
+        "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+        "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+        "deg": np.degrees, "rad": np.radians,
+    }
+
+_TIME_COMPONENT = {"minute": "minute", "hour": "hour",
+                   "day_of_week": "dow", "day_of_month": "dom",
+                   "day_of_year": "doy", "month": "month",
+                   "year": "year", "days_in_month": "dim"}
+
+
+def _calendar(vals: np.ndarray, comp: str) -> np.ndarray:
+    """UTC calendar components of float-second timestamps (prom time
+    functions); NaN-preserving."""
+    out = np.full(vals.shape, np.nan)
+    ok = ~np.isnan(vals)
+    if not ok.any():
+        return out
+    secs = np.floor(vals[ok]).astype(np.int64)
+    if comp == "minute":
+        r = (secs // 60) % 60
+    elif comp == "hour":
+        r = (secs // 3600) % 24
+    elif comp == "dow":
+        r = (secs // 86400 + 4) % 7       # epoch was a Thursday
+    else:
+        d = secs.astype("datetime64[s]").astype("datetime64[D]")
+        M = d.astype("datetime64[M]")
+        Y = d.astype("datetime64[Y]")
+        if comp == "dom":
+            r = (d - M).astype(np.int64) + 1
+        elif comp == "doy":
+            r = (d - Y.astype("datetime64[D]")).astype(np.int64) + 1
+        elif comp == "month":
+            r = (M - Y).astype(np.int64) + 1
+        elif comp == "year":
+            r = Y.astype(np.int64) + 1970
+        else:  # days in month
+            r = ((M + 1).astype("datetime64[D]")
+                 - M.astype("datetime64[D]")).astype(np.int64)
+    out[ok] = r.astype(np.float64)
+    return out
+
+
+def _prom_quantile(q: float, vals: np.ndarray) -> float:
+    """Prom quantile semantics (promql/quantile.go): linear interpolation
+    between order statistics; out-of-range φ → ±Inf."""
+    if np.isnan(q):
+        return np.nan
+    if q < 0:
+        return -np.inf
+    if q > 1:
+        return np.inf
+    if len(vals) == 0:
+        return np.nan
+    return float(np.quantile(vals, q, method="linear"))
+
+
+def _absent_labels(e) -> dict:
+    """absent()/absent_over_time() result labels: the equality matchers
+    of the selector argument (metric name excluded)."""
+    if isinstance(e, VectorSelector):
+        return {m.name: m.value for m in e.matchers if m.op == "="}
+    return {}
+
+
+def _str_arg(e, fname: str) -> str:
+    if not isinstance(e, StringLit):
+        raise PromQLError(f"{fname}() expects a string literal here")
+    return e.value
+
+
+def _label_replace(inner: SeriesMatrix, dst: str, repl: str, src: str,
+                   regex: str) -> SeriesMatrix:
+    import re as _re
+    try:
+        pat = _re.compile(r"^(?:" + regex + r")$")
+    except _re.error as e:
+        raise PromQLError(f"label_replace: bad regex: {e}")
+    # $1 / ${name} → python backreferences
+    py_repl = _re.sub(r"\$(\d+)", r"\\\1", repl)
+    py_repl = _re.sub(r"\$\{(\w+)\}", r"\\g<\1>", py_repl)
+    out = []
+    for ls in inner.labels:
+        ls = dict(ls)
+        m = pat.match(ls.get(src, ""))
+        if m:
+            try:
+                val = m.expand(py_repl)
+            except _re.error as e:
+                raise PromQLError(f"label_replace: bad replacement: {e}")
+            if val:
+                ls[dst] = val
+            else:
+                ls.pop(dst, None)
+        out.append(ls)
+    return SeriesMatrix(out, inner.values, inner.metric_dropped)
+
+
+def _histogram_quantile(q_row: np.ndarray, inner: SeriesMatrix,
+                        nsteps: int) -> SeriesMatrix:
+    """promql/quantile.go bucketQuantile over le-labelled cumulative
+    buckets, grouped by the remaining labels."""
+    groups: dict[tuple, list[tuple[float, int]]] = {}
+    out_labels: dict[tuple, dict] = {}
+    for i, ls in enumerate(inner.labels):
+        le = ls.get("le")
+        if le is None:
+            continue
+        try:
+            ub = float("inf") if le in ("+Inf", "inf", "Inf") else float(le)
+        except ValueError:
+            continue
+        kept = {k: v for k, v in ls.items()
+                if k not in ("le", "__name__")}
+        key = tuple(sorted(kept.items()))
+        groups.setdefault(key, []).append((ub, i))
+        out_labels[key] = kept
+    keys = sorted(groups)
+    out = np.full((len(keys), nsteps), np.nan)
+    for gi, key in enumerate(keys):
+        blist = sorted(groups[key])
+        les = np.array([b[0] for b in blist])
+        if len(les) < 2 or not np.isinf(les[-1]):
+            continue  # prom requires an +Inf bucket
+        rows = inner.values[[b[1] for b in blist]]     # (NB, nsteps)
+        counts = np.maximum.accumulate(
+            np.nan_to_num(rows, nan=0.0), axis=0)      # enforce monotone
+        total = counts[-1]
+        for si in range(nsteps):
+            q = q_row[si]
+            if np.isnan(q) or total[si] <= 0 \
+                    or np.all(np.isnan(rows[:, si])):
+                continue
+            if q < 0:
+                out[gi, si] = -np.inf
+                continue
+            if q > 1:
+                out[gi, si] = np.inf
+                continue
+            rank = q * total[si]
+            b = int(np.argmax(counts[:, si] >= rank))
+            if b == len(les) - 1:
+                out[gi, si] = les[-2]
+                continue
+            if b == 0 and les[0] <= 0:
+                out[gi, si] = les[0]
+                continue
+            lo = 0.0 if b == 0 else les[b - 1]
+            hi = les[b]
+            prev = 0.0 if b == 0 else counts[b - 1, si]
+            cnt = counts[b, si] - prev
+            if cnt <= 0:
+                out[gi, si] = hi
+                continue
+            out[gi, si] = lo + (hi - lo) * (rank - prev) / cnt
+    return SeriesMatrix([out_labels[k] for k in keys], out, True)
 
 
 def _fmt(v: float) -> str:
@@ -468,7 +864,63 @@ SeriesMatrix._maybe_drop = lambda self, b: (
     or b.bool_mode else self)
 
 
-def _aggregate(agg: Aggregation, inner: SeriesMatrix) -> SeriesMatrix:
+def _lkey(ls: dict) -> tuple:
+    return tuple(sorted((k, v) for k, v in ls.items() if k != "__name__"))
+
+
+def _set_op(op: str, lhs: SeriesMatrix, rhs: SeriesMatrix) -> SeriesMatrix:
+    """Prom set operators: per-step sample-presence logic over full label
+    match (sans __name__). Labels of surviving series keep their metric
+    name (prom keeps lhs elements as-is)."""
+    rmap = {_lkey(ls): i for i, ls in enumerate(rhs.labels)}
+    labels: list[dict] = []
+    rows: list[np.ndarray] = []
+    if op == "and":
+        for i, ls in enumerate(lhs.labels):
+            j = rmap.get(_lkey(ls))
+            if j is None:
+                continue
+            labels.append(ls)
+            rows.append(np.where(~np.isnan(rhs.values[j]),
+                                 lhs.values[i], np.nan))
+    elif op == "unless":
+        for i, ls in enumerate(lhs.labels):
+            j = rmap.get(_lkey(ls))
+            if j is None:
+                labels.append(ls)
+                rows.append(lhs.values[i])
+            else:
+                labels.append(ls)
+                rows.append(np.where(np.isnan(rhs.values[j]),
+                                     lhs.values[i], np.nan))
+    else:  # or
+        lmap = {_lkey(ls): i for i, ls in enumerate(lhs.labels)}
+        for i, ls in enumerate(lhs.labels):
+            j = rmap.get(_lkey(ls))
+            if j is None:
+                labels.append(ls)
+                rows.append(lhs.values[i])
+            else:
+                # rhs fills the steps where lhs has no sample
+                labels.append(ls)
+                rows.append(np.where(~np.isnan(lhs.values[i]),
+                                     lhs.values[i], rhs.values[j]))
+        for j, ls in enumerate(rhs.labels):
+            if _lkey(ls) not in lmap:
+                labels.append(ls)
+                rows.append(rhs.values[j])
+    nsteps = (lhs.values.shape[1] if lhs.values.size else
+              (rhs.values.shape[1] if rhs.values.size else 1))
+    if not rows:
+        return SeriesMatrix([], np.zeros((0, nsteps)), True)
+    vals = np.vstack(rows)
+    keep = ~np.all(np.isnan(vals), axis=1)
+    return SeriesMatrix([ls for ls, k in zip(labels, keep) if k],
+                        vals[keep], lhs.metric_dropped)
+
+
+def _aggregate(agg: Aggregation, inner: SeriesMatrix,
+               param=None) -> SeriesMatrix:
     S, B = inner.values.shape if inner.values.size else (0, 1)
     if S == 0:
         return SeriesMatrix([], np.zeros((0, B)), True)
@@ -487,6 +939,56 @@ def _aggregate(agg: Aggregation, inner: SeriesMatrix) -> SeriesMatrix:
         out_labels[key] = kept
     keys = sorted(groups)
     vals = inner.values
+
+    if agg.op in ("topk", "bottomk"):
+        # per-step selection WITHIN each group; original series (and their
+        # metric names) survive — prom keeps input labels for topk/bottomk
+        out = np.full((S, B), np.nan)
+        sign = -1.0 if agg.op == "topk" else 1.0
+        for key in keys:
+            idx = np.array(groups[key])
+            sub = vals[idx]                       # (R, B)
+            rank = np.argsort(
+                np.argsort(np.where(np.isnan(sub), np.inf,
+                                    sign * sub), axis=0, kind="stable"),
+                axis=0)
+            k_row = np.maximum(np.nan_to_num(param, nan=0.0), 0)
+            keep = (rank < k_row[None, :]) & ~np.isnan(sub)
+            out[idx] = np.where(keep, sub, np.nan)
+        alive = ~np.all(np.isnan(out), axis=1)
+        return SeriesMatrix(
+            [ls for ls, a in zip(inner.labels, alive) if a],
+            out[alive], inner.metric_dropped)
+
+    if agg.op == "count_values":
+        # one output series per (group, distinct value); the value lands
+        # in the `param` label
+        rows_out: dict[tuple, np.ndarray] = {}
+        label_out: dict[tuple, dict] = {}
+        for key in keys:
+            sub = vals[groups[key]]
+            uniq = np.unique(sub[~np.isnan(sub)])
+            for u in uniq:
+                cnt = np.sum(sub == u, axis=0).astype(np.float64)
+                cnt = np.where(cnt > 0, cnt, np.nan)
+                ls = dict(out_labels[key])
+                ls[param] = _fmt(u)
+                k2 = tuple(sorted(ls.items()))
+                prev = rows_out.get(k2)
+                if prev is not None:
+                    # distinct groups can collapse onto one output label
+                    # set (param label shadows a grouped label): sum them
+                    tot = np.nansum(np.vstack([prev, cnt]), axis=0)
+                    cnt = np.where(np.isnan(prev) & np.isnan(cnt),
+                                   np.nan, tot)
+                rows_out[k2] = cnt
+                label_out[k2] = ls
+        ks = sorted(rows_out)
+        if not ks:
+            return SeriesMatrix([], np.zeros((0, B)), True)
+        return SeriesMatrix([label_out[k] for k in ks],
+                            np.vstack([rows_out[k] for k in ks]), True)
+
     out = np.full((len(keys), B), np.nan)
     for gi, key in enumerate(keys):
         rows = vals[groups[key]]
@@ -510,6 +1012,10 @@ def _aggregate(agg: Aggregation, inner: SeriesMatrix) -> SeriesMatrix:
                 r = np.nanvar(rows, axis=0)
                 if agg.op == "stddev":
                     r = np.sqrt(r)
+            elif agg.op == "quantile":
+                r = np.array([_prom_quantile(
+                    param[j], rows[~np.isnan(rows[:, j]), j])
+                    for j in range(B)])
             else:
                 raise PromQLError(f"unsupported aggregation {agg.op}")
         out[gi] = np.where(has, r, np.nan)

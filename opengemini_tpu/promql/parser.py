@@ -28,16 +28,24 @@ _DUR_NS = {"ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9,
            "y": 365 * 86400 * 10**9}
 
 AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk",
-           "group", "stddev", "stdvar"}
+           "group", "stddev", "stdvar", "quantile", "count_values"}
 
 RANGE_FUNCS = {"rate", "irate", "increase", "delta", "idelta",
                "avg_over_time", "sum_over_time", "min_over_time",
                "max_over_time", "count_over_time", "last_over_time",
-               "first_over_time", "resets", "changes"}
+               "first_over_time", "resets", "changes",
+               "stddev_over_time", "stdvar_over_time",
+               "present_over_time", "absent_over_time",
+               "quantile_over_time", "deriv", "predict_linear"}
 
 SCALAR_FUNCS = {"abs", "ceil", "floor", "round", "exp", "ln", "log2",
-                "log10", "sqrt", "clamp_min", "clamp_max", "scalar",
-                "timestamp"}
+                "log10", "sqrt", "clamp_min", "clamp_max", "clamp",
+                "scalar", "timestamp", "sgn", "sort", "sort_desc",
+                "absent", "vector", "time", "pi", "histogram_quantile",
+                "label_replace", "label_join", "minute", "hour",
+                "day_of_week", "day_of_month", "day_of_year", "month",
+                "year", "days_in_month", "sin", "cos", "tan", "asin",
+                "acos", "atan", "sinh", "cosh", "tanh", "deg", "rad"}
 
 
 @dataclass

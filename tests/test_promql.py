@@ -180,3 +180,235 @@ def test_lookback_staleness(prom):
     # within lookback → last value
     out = prom.query_instant("mem_used", 12 * M)
     np.testing.assert_allclose(float(out[0]["value"][1]), 140.0)
+
+
+# ---- extended function surface ---------------------------------------------
+
+def test_resets_and_changes(prom, tmp_path):
+    eng = Engine(str(tmp_path / "rc"))
+    rows = []
+    vals = [1.0, 3.0, 2.0, 2.0, 5.0, 1.0, 4.0]   # resets: 2, changes: 5
+    for i, v in enumerate(vals):
+        rows.append(PointRow("ctr", {"h": "a"}, {"value": v}, i * 15 * S))
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    out = pe.query_instant("resets(ctr[10m])", 100 * S)
+    assert float(out[0]["value"][1]) == 2.0
+    out = pe.query_instant("changes(ctr[10m])", 100 * S)
+    assert float(out[0]["value"][1]) == 5.0
+    eng.close()
+
+
+def test_stddev_over_time(prom):
+    # mem_used is 100..140 over 0..600s; window covers all 41 samples
+    out = prom.query_instant("stddev_over_time(mem_used[11m])", 601 * S)
+    expect = np.std(np.arange(100.0, 141.0))
+    np.testing.assert_allclose(float(out[0]["value"][1]), expect,
+                               rtol=1e-12)
+    out = prom.query_instant("stdvar_over_time(mem_used[11m])", 601 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), expect ** 2,
+                               rtol=1e-12)
+
+
+def test_present_and_absent(prom):
+    out = prom.query_instant("present_over_time(mem_used[5m])", 300 * S)
+    assert float(out[0]["value"][1]) == 1.0
+    out = prom.query_instant('absent(nope{job="x"})', 300 * S)
+    assert out[0]["metric"] == {"job": "x"}
+    assert float(out[0]["value"][1]) == 1.0
+    out = prom.query_instant("absent(mem_used)", 300 * S)
+    assert out == []
+    out = prom.query_instant("absent_over_time(nope[5m])", 300 * S)
+    assert float(out[0]["value"][1]) == 1.0
+
+
+def test_deriv_and_predict_linear(prom):
+    # mem_used rises 1 per 15s → deriv = 1/15 per second
+    out = prom.query_instant("deriv(mem_used[5m])", 600 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 1.0 / 15,
+                               rtol=1e-9)
+    # predict 150s ahead: last sample 140 at t=600 → 140 + 150/15 = 150
+    out = prom.query_instant("predict_linear(mem_used[5m], 150)", 600 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 150.0,
+                               rtol=1e-9)
+
+
+def test_quantile_over_time(prom):
+    out = prom.query_instant("quantile_over_time(0.5, mem_used[11m])",
+                             601 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 120.0,
+                               rtol=1e-12)
+
+
+def test_topk_bottomk(prom):
+    out = prom.query_instant("topk(1, http_requests_total)", 600 * S)
+    assert len(out) == 1 and out[0]["metric"]["host"] == "h1"
+    assert float(out[0]["value"][1]) == 82.0
+    out = prom.query_instant("bottomk(1, http_requests_total)", 600 * S)
+    assert out[0]["metric"]["host"] == "h0"
+    # metric name survives topk (prom semantics)
+    assert out[0]["metric"]["__name__"] == "http_requests_total"
+
+
+def test_quantile_aggregation(prom):
+    out = prom.query_instant("quantile(0.5, http_requests_total)", 600 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]),
+                               (41.0 + 82.0) / 2, rtol=1e-12)
+
+
+def test_count_values(prom, tmp_path):
+    eng = Engine(str(tmp_path / "cv"))
+    rows = [PointRow("ver", {"i": str(i)},
+                     {"value": 2.0 if i < 3 else 7.0}, 0)
+            for i in range(5)]
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    out = pe.query_instant('count_values("v", ver)', 60 * S)
+    got = {o["metric"]["v"]: float(o["value"][1]) for o in out}
+    assert got == {"2.0": 3.0, "7.0": 2.0}
+    eng.close()
+
+
+def test_set_ops(prom):
+    # and: both hosts present in both operands
+    out = prom.query_instant(
+        "http_requests_total and http_requests_total", 600 * S)
+    assert len(out) == 2
+    out = prom.query_instant(
+        'http_requests_total unless http_requests_total{host="h0"}',
+        600 * S)
+    assert len(out) == 1 and out[0]["metric"]["host"] == "h1"
+    out = prom.query_instant(
+        'http_requests_total{host="h0"} or http_requests_total', 600 * S)
+    assert len(out) == 2
+
+
+def test_clamp_and_sgn(prom):
+    out = prom.query_instant("clamp(mem_used, 0, 110)", 600 * S)
+    assert float(out[0]["value"][1]) == 110.0
+    out = prom.query_instant("sgn(mem_used - 1000)", 600 * S)
+    assert float(out[0]["value"][1]) == -1.0
+
+
+def test_sort_desc(prom):
+    out = prom.query_instant("sort_desc(http_requests_total)", 600 * S)
+    assert [o["metric"]["host"] for o in out] == ["h1", "h0"]
+
+
+def test_time_functions(prom):
+    # 2021-02-01T13:37:42Z = 1612186662
+    t = 1612186662 * S
+    assert float(prom.query_instant("minute(time())", t)[0]["value"][1]) \
+        == 37.0
+    assert float(prom.query_instant("hour(time())", t)[0]["value"][1]) \
+        == 13.0
+    assert float(prom.query_instant("month(time())", t)[0]["value"][1]) \
+        == 2.0
+    assert float(prom.query_instant("year(time())", t)[0]["value"][1]) \
+        == 2021.0
+    assert float(prom.query_instant(
+        "day_of_month(time())", t)[0]["value"][1]) == 1.0
+    assert float(prom.query_instant(
+        "day_of_week(time())", t)[0]["value"][1]) == 1.0  # Monday
+    assert float(prom.query_instant(
+        "days_in_month(time())", t)[0]["value"][1]) == 28.0
+
+
+def test_timestamp_function(prom):
+    out = prom.query_instant("timestamp(mem_used)", 600 * S)
+    assert float(out[0]["value"][1]) == 600.0
+
+
+def test_scalar_and_vector_funcs(prom):
+    out = prom.query_instant("vector(7)", 600 * S)
+    assert out[0]["metric"] == {} and float(out[0]["value"][1]) == 7.0
+    out = prom.query_instant("scalar(vector(3)) + 1", 600 * S)
+    assert float(out[0]["value"][1]) == 4.0
+
+
+def test_label_replace_and_join(prom):
+    out = prom.query_instant(
+        'label_replace(mem_used, "dc", "$1", "host", "h(.*)")', 600 * S)
+    assert out[0]["metric"]["dc"] == "0"
+    out = prom.query_instant(
+        'label_join(mem_used, "hj", "-", "host", "host")', 600 * S)
+    assert out[0]["metric"]["hj"] == "h0-h0"
+
+
+def test_histogram_quantile(prom, tmp_path):
+    eng = Engine(str(tmp_path / "hist"))
+    rows = []
+    # cumulative buckets: le=0.1:10, le=0.5:40, le=+Inf:50
+    for le, c in (("0.1", 10.0), ("0.5", 40.0), ("+Inf", 50.0)):
+        rows.append(PointRow("lat_bucket", {"le": le}, {"value": c}, 0))
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    out = pe.query_instant("histogram_quantile(0.5, lat_bucket)", 60 * S)
+    # rank 25 lands in (0.1, 0.5]: 0.1 + 0.4*(25-10)/30 = 0.3
+    np.testing.assert_allclose(float(out[0]["value"][1]), 0.3, rtol=1e-12)
+    eng.close()
+
+
+# ---- review regression tests -------------------------------------------
+
+def test_scalar_arg_from_selector(prom, tmp_path):
+    eng = Engine(str(tmp_path / "sc"))
+    rows = [PointRow("horizon", {}, {"value": 150.0}, i * 15 * S)
+            for i in range(41)]
+    for i in range(41):
+        rows.append(PointRow("gauge", {"h": "a"},
+                             {"value": 100.0 + i}, i * 15 * S))
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    # scalar() derived from a selector must see the real lookback
+    out = pe.query_instant(
+        "predict_linear(gauge[5m], scalar(horizon))", 600 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 150.0,
+                               rtol=1e-9)
+    out = pe.query_instant(
+        "quantile_over_time(scalar(horizon) / 300, gauge[11m])", 601 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 120.0,
+                               rtol=1e-12)
+    eng.close()
+
+
+def test_stddev_large_magnitude(prom, tmp_path):
+    # epoch-scale gauge: naive sumsq/n - mean^2 would be rounding noise
+    eng = Engine(str(tmp_path / "big"))
+    rows = [PointRow("big", {}, {"value": 1.7e9 + (i % 2)}, i * 15 * S)
+            for i in range(41)]
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    out = pe.query_instant("stddev_over_time(big[11m])", 601 * S)
+    expect = np.std([1.7e9 + (i % 2) for i in range(41)])
+    # naive (un-anchored) moments return exactly 0.0 here; anchored
+    # moments keep ~7 digits (the unshifted first-order sum still costs
+    # a few)
+    np.testing.assert_allclose(float(out[0]["value"][1]), expect,
+                               rtol=1e-6)
+    # deriv of a large-magnitude sawtooth stays finite/sane
+    out = pe.query_instant("deriv(big[11m])", 601 * S)
+    assert abs(float(out[0]["value"][1])) < 1.0
+    eng.close()
+
+
+def test_predict_linear_with_offset(prom):
+    # mem_used: 1/15s slope; eval at 600s with 2m offset → window ends
+    # at 480 (value 132); prom predicts from the EVAL time: value at
+    # 600+120=720s → 132 + 240/15 = 148
+    out = prom.query_instant(
+        "predict_linear(mem_used[2m] offset 2m, 120)", 600 * S)
+    np.testing.assert_allclose(float(out[0]["value"][1]), 148.0,
+                               rtol=1e-9)
+
+
+def test_count_values_group_collapse(prom, tmp_path):
+    eng = Engine(str(tmp_path / "cvc"))
+    rows = [PointRow("cv", {"g": "a"}, {"value": 2.0}, 0),
+            PointRow("cv", {"g": "b"}, {"value": 2.0}, 0)]
+    eng.write_points("prometheus", rows)
+    pe = PromEngine(eng)
+    out = pe.query_instant('count_values by (g) ("g", cv)', 60 * S)
+    assert len(out) == 1 and out[0]["metric"] == {"g": "2.0"}
+    assert float(out[0]["value"][1]) == 2.0
+    eng.close()
