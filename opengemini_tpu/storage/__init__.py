@@ -5,3 +5,5 @@ from .memtable import MemTable, MemTables
 from .wal import WAL
 from .shard import Shard
 from .engine import Engine, EngineOptions
+from .backup import (BackupError, create_backup, restore_backup,
+                     verify_backup)
