@@ -83,12 +83,22 @@ class TsStore:
     def __init__(self, data_dir: str, meta_addrs: list[str],
                  host: str = "127.0.0.1", port: int = 0,
                  opts: EngineOptions | None = None,
-                 heartbeat_s: float = HEARTBEAT_S):
+                 heartbeat_s: float = HEARTBEAT_S,
+                 diagnostics: bool = False):
         self.node = StoreNode(data_dir, host=host, port=port, opts=opts)
         self.meta = MetaClient(meta_addrs)
         self.heartbeat_s = heartbeat_s
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        # self-diagnosis plane (reference: sherlock + iodetector services
+        # started by ts-store run/server.go)
+        self.sherlock = None
+        self.iodetector = None
+        if diagnostics:
+            from ..services import IODetector, Sherlock, SherlockConfig
+            self.sherlock = Sherlock(
+                SherlockConfig(dump_dir=f"{data_dir}/sherlock-dumps"))
+            self.iodetector = IODetector(probe_dirs=(data_dir,))
 
     @property
     def addr(self) -> str:
@@ -105,6 +115,10 @@ class TsStore:
             target=self._heartbeat_loop, daemon=True,
             name=f"store-hb-{self.node.node_id}")
         self._hb_thread.start()
+        if self.sherlock is not None:
+            self.sherlock.start()
+        if self.iodetector is not None:
+            self.iodetector.start()
         log.info("ts-store node %d @ %s ready", self.node.node_id,
                  self.node.addr)
 
@@ -117,6 +131,10 @@ class TsStore:
 
     def stop(self):
         self._stop.set()
+        if self.sherlock is not None:
+            self.sherlock.stop()
+        if self.iodetector is not None:
+            self.iodetector.stop()
         self.node.stop()
         self.meta.close()
 

@@ -5,3 +5,5 @@ from .compaction import CompactionService
 from .continuous_query import ContinuousQueryService
 from .stream import StreamEngine
 from .subscriber import SubscriberService
+from .sherlock import Sherlock, SherlockConfig
+from .iodetector import IODetector

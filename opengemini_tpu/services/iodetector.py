@@ -1,0 +1,125 @@
+"""IO hang detector (role of reference lib/iodetector/iodetector.go:55-77).
+
+Two mechanisms, as in the reference:
+
+1. *Operation pinning*: IO call sites wrap their disk operations in
+   ``with detector.pin("wal-write")``; a background checker flags any
+   pinned operation older than ``timeout_s`` and invokes ``on_hung``
+   (the reference's response is suicide / flow-control; here the default
+   sets a read-only flag callers can consult, and the callback is
+   pluggable so a node app can escalate).
+
+2. *Probe writes*: the detector periodically writes+fsyncs a small probe
+   file in each watched directory and measures latency; a probe that
+   exceeds the timeout is a hung-disk signal even when no workload IO is
+   in flight.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+@dataclass
+class _Pinned:
+    name: str
+    start: float
+    thread: str
+
+
+class IODetector(Service):
+    name = "iodetector"
+
+    def __init__(self, timeout_s: float = 30.0, interval_s: float = 5.0,
+                 probe_dirs: tuple[str, ...] = (), on_hung=None):
+        super().__init__(interval_s)
+        self.timeout_s = timeout_s
+        self.probe_dirs = list(probe_dirs)
+        self.on_hung = on_hung or self._default_on_hung
+        self.read_only = False             # flow-control flag (default action)
+        self.hung_events = 0
+        self._pins: dict[int, _Pinned] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- pinning
+
+    @contextmanager
+    def pin(self, name: str):
+        """Mark an IO operation in flight (reference: timestamp registered
+        before each disk op, cleared after)."""
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            self._pins[pid] = _Pinned(name, time.monotonic(),
+                                      threading.current_thread().name)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._pins.pop(pid, None)
+
+    def check_pins(self) -> list[_Pinned]:
+        now = time.monotonic()
+        with self._lock:
+            stuck = [p for p in self._pins.values()
+                     if now - p.start > self.timeout_s]
+        for p in stuck:
+            self._report(f"io op '{p.name}' on thread {p.thread} stuck "
+                         f"{now - p.start:.1f}s (> {self.timeout_s}s)")
+        return stuck
+
+    # -------------------------------------------------------------- probes
+
+    def probe_once(self) -> dict[str, float]:
+        """Write+fsync a probe file per watched dir; returns latencies."""
+        out = {}
+        for d in self.probe_dirs:
+            path = os.path.join(d, ".io-probe")
+            t0 = time.monotonic()
+            try:
+                with open(path, "w") as f:
+                    f.write(str(time.time()))
+                    f.flush()
+                    os.fsync(f.fileno())
+                lat = time.monotonic() - t0
+            except OSError as e:
+                self._report(f"probe write failed in {d}: {e}")
+                continue
+            out[d] = lat
+            if lat > self.timeout_s:
+                self._report(f"probe write in {d} took {lat:.1f}s "
+                             f"(> {self.timeout_s}s)")
+        return out
+
+    # ------------------------------------------------------------ reaction
+
+    def _report(self, msg: str) -> None:
+        self.hung_events += 1
+        log.error("iodetector: %s", msg)
+        try:
+            self.on_hung(msg)
+        except Exception:
+            log.exception("iodetector on_hung callback failed")
+
+    def _default_on_hung(self, msg: str) -> None:
+        self.read_only = True
+
+    def run_once(self) -> None:
+        self.check_pins()
+        self.probe_once()
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            inflight = len(self._pins)
+        return {"hung_events": self.hung_events, "inflight_ops": inflight,
+                "read_only": int(self.read_only)}

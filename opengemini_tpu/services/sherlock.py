@@ -1,0 +1,216 @@
+"""Sherlock self-diagnosis (role of reference lib/sherlock/sherlock.go:29-101,
+circle.go, profiles.go + services/sherlock/service.go).
+
+Watches process CPU / memory / thread-count on an interval; when a
+dimension breaches its threshold — either an absolute ceiling or a sudden
+jump versus the recent moving average (the reference's "diff" trigger) —
+it dumps a diagnostic profile to disk, with a per-dimension cooldown and a
+bounded number of retained dumps.
+
+Python equivalents of the Go pprof dumps:
+  cpu     → multi-sample aggregated stack profile of all threads
+  memory  → tracemalloc top allocations (if tracing) + gc / rss summary
+  threads → full thread dump (the goroutine-dump analog)
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+DIMENSIONS = ("cpu", "memory", "threads")
+
+
+@dataclass
+class SherlockConfig:
+    """Thresholds mirror reference config lib/config/sherlock.go: per-dim
+    max (absolute trigger), diff ratio vs moving average, cooldown."""
+    dump_dir: str = "sherlock-dumps"
+    cpu_max_pct: float = 90.0
+    mem_max_bytes: int = 0              # 0 = disabled
+    threads_max: int = 2000
+    diff_ratio: float = 1.5             # jump trigger: value > ratio * avg
+    min_history: int = 5                # samples before jump trigger arms
+    cooldown_s: float = 60.0
+    keep_dumps: int = 8
+
+
+@dataclass
+class _DimState:
+    history: deque = field(default_factory=lambda: deque(maxlen=30))
+    last_dump_ts: float = 0.0
+    dumps: int = 0
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+class Sherlock(Service):
+    """Self-diagnosis watcher (reference sherlock.go monitor loop)."""
+
+    name = "sherlock"
+
+    def __init__(self, config: SherlockConfig | None = None,
+                 interval_s: float = 10.0):
+        super().__init__(interval_s)
+        self.config = config or SherlockConfig()
+        self._state = {d: _DimState() for d in DIMENSIONS}
+        self._last_cpu = self._cpu_clock()
+        self._last_wall = time.monotonic()
+
+    # ------------------------------------------------------------- sampling
+
+    @staticmethod
+    def _cpu_clock() -> float:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return ru.ru_utime + ru.ru_stime
+
+    def sample(self) -> dict[str, float]:
+        now_cpu, now_wall = self._cpu_clock(), time.monotonic()
+        dt = max(now_wall - self._last_wall, 1e-6)
+        cpu_pct = 100.0 * (now_cpu - self._last_cpu) / dt
+        self._last_cpu, self._last_wall = now_cpu, now_wall
+        return {"cpu": cpu_pct, "memory": float(_rss_bytes()),
+                "threads": float(threading.active_count())}
+
+    # ------------------------------------------------------------- triggers
+
+    def _limit(self, dim: str) -> float:
+        c = self.config
+        return {"cpu": c.cpu_max_pct, "memory": float(c.mem_max_bytes),
+                "threads": float(c.threads_max)}[dim]
+
+    def check_once(self) -> list[str]:
+        """One monitor tick: sample, evaluate triggers, dump. Returns the
+        list of dump paths written (for tests/ops visibility)."""
+        sample = self.sample()
+        written = []
+        for dim, value in sample.items():
+            st = self._state[dim]
+            reason = self._trigger_reason(dim, value, st)
+            st.history.append(value)
+            if reason is None:
+                continue
+            now = time.monotonic()
+            if now - st.last_dump_ts < self.config.cooldown_s:
+                continue                      # reference cooldown semantics
+            st.last_dump_ts = now
+            path = self._dump(dim, value, reason)
+            if path:
+                written.append(path)
+        return written
+
+    def _trigger_reason(self, dim: str, value: float,
+                        st: _DimState) -> str | None:
+        limit = self._limit(dim)
+        if limit > 0 and value > limit:
+            return f"abs value {value:.1f} > max {limit:.1f}"
+        if len(st.history) >= self.config.min_history:
+            avg = sum(st.history) / len(st.history)
+            if avg > 0 and value > self.config.diff_ratio * avg:
+                return (f"jump value {value:.1f} > "
+                        f"{self.config.diff_ratio:.2f}x avg {avg:.1f}")
+        return None
+
+    # ---------------------------------------------------------------- dumps
+
+    def _dump(self, dim: str, value: float, reason: str) -> str | None:
+        os.makedirs(self.config.dump_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%S")
+        path = os.path.join(self.config.dump_dir, f"{dim}-{ts}.prof.txt")
+        try:
+            with open(path, "w") as f:
+                f.write(f"# sherlock {dim} dump: {reason}\n"
+                        f"# value={value} time={time.time()}\n\n")
+                f.write(self._profile(dim))
+        except OSError as e:
+            log.warning("sherlock dump failed: %s", e)
+            return None
+        st = self._state[dim]
+        st.dumps += 1
+        log.warning("sherlock: %s anomaly (%s) → %s", dim, reason, path)
+        self._trim_dumps(dim)
+        return path
+
+    def _trim_dumps(self, dim: str) -> None:
+        d = self.config.dump_dir
+        try:
+            files = sorted(f for f in os.listdir(d)
+                           if f.startswith(dim + "-"))
+        except OSError:
+            return
+        for old in files[:-self.config.keep_dumps]:
+            try:
+                os.unlink(os.path.join(d, old))
+            except OSError:
+                pass
+
+    def _profile(self, dim: str) -> str:
+        if dim == "cpu":
+            return self._stack_profile(samples=20, interval_s=0.005)
+        if dim == "memory":
+            return self._memory_profile()
+        return self._thread_dump()
+
+    @staticmethod
+    def _thread_dump() -> str:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+            out.extend(s.rstrip() for s in traceback.format_stack(frame))
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _stack_profile(samples: int, interval_s: float) -> str:
+        """Sampling profile: aggregate innermost frames over N samples
+        (the cheap stand-in for a Go cpu pprof)."""
+        counts: dict[str, int] = {}
+        for _ in range(samples):
+            for frame in sys._current_frames().values():
+                key = (f"{frame.f_code.co_filename}:{frame.f_lineno} "
+                       f"{frame.f_code.co_name}")
+                counts[key] = counts.get(key, 0) + 1
+            time.sleep(interval_s)
+        lines = [f"{n:6d}  {k}" for k, n in
+                 sorted(counts.items(), key=lambda kv: -kv[1])]
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _memory_profile() -> str:
+        out = [f"rss_bytes {_rss_bytes()}", f"gc_objects {len(gc.get_objects())}"]
+        try:
+            import tracemalloc
+            if tracemalloc.is_tracing():
+                snap = tracemalloc.take_snapshot()
+                out.append("\n# top allocations")
+                out.extend(str(s) for s in snap.statistics("lineno")[:25])
+        except Exception:
+            pass
+        return "\n".join(out) + "\n"
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run_once(self) -> None:
+        self.check_once()
+
+    def stats(self) -> dict[str, int]:
+        return {f"{d}_dumps": self._state[d].dumps for d in DIMENSIONS}

@@ -1,0 +1,108 @@
+"""Sherlock self-diagnosis + IO detector (reference lib/sherlock,
+lib/iodetector)."""
+
+import os
+import threading
+import time
+
+from opengemini_tpu.services import IODetector, Sherlock, SherlockConfig
+
+
+def _mk(tmp_path, **kw):
+    cfg = SherlockConfig(dump_dir=str(tmp_path / "dumps"), **kw)
+    return Sherlock(cfg, interval_s=1000)
+
+
+class TestSherlock:
+    def test_no_dump_when_healthy(self, tmp_path):
+        s = _mk(tmp_path, cpu_max_pct=1e9, threads_max=10**6)
+        assert s.check_once() == []
+
+    def test_abs_threshold_dump(self, tmp_path):
+        s = _mk(tmp_path, threads_max=0.5, cpu_max_pct=1e9)  # always breached
+        paths = s.check_once()
+        assert len(paths) == 1 and "threads-" in paths[0]
+        assert "--- thread" in open(paths[0]).read()
+
+    def test_cooldown_suppresses_repeat(self, tmp_path):
+        s = _mk(tmp_path, threads_max=0.5, cooldown_s=60, cpu_max_pct=1e9)
+        assert len(s.check_once()) == 1
+        assert s.check_once() == []          # inside cooldown
+
+    def test_jump_trigger_vs_moving_average(self, tmp_path):
+        s = _mk(tmp_path, cpu_max_pct=0, threads_max=0, min_history=3,
+                diff_ratio=1.5, cooldown_s=0)
+        st = s._state["memory"]
+        for v in (100.0, 100.0, 100.0):
+            st.history.append(v)
+        assert s._trigger_reason("memory", 1000.0, st) is not None
+        assert s._trigger_reason("memory", 120.0, st) is None
+
+    def test_dump_retention_trims_old(self, tmp_path):
+        s = _mk(tmp_path, threads_max=0.5, cooldown_s=0, keep_dumps=2)
+        d = tmp_path / "dumps"
+        os.makedirs(d, exist_ok=True)
+        for i in range(4):
+            (d / f"threads-0000000{i}.prof.txt").write_text("old")
+        s.check_once()
+        kept = sorted(f for f in os.listdir(d) if f.startswith("threads-"))
+        assert len(kept) == 2
+
+    def test_memory_profile_contents(self, tmp_path):
+        s = _mk(tmp_path)
+        prof = s._profile("memory")
+        assert "rss_bytes" in prof and "gc_objects" in prof
+
+    def test_stats(self, tmp_path):
+        s = _mk(tmp_path, threads_max=0.5)
+        s.check_once()
+        assert s.stats()["threads_dumps"] == 1
+
+
+class TestIODetector:
+    def test_pin_completes_clean(self):
+        det = IODetector(timeout_s=10, interval_s=1000)
+        with det.pin("wal-write"):
+            pass
+        assert det.check_pins() == []
+        assert det.stats()["inflight_ops"] == 0
+
+    def test_stuck_pin_detected(self):
+        det = IODetector(timeout_s=0.01, interval_s=1000)
+        release = threading.Event()
+
+        def worker():
+            with det.pin("slow-flush"):
+                release.wait(5)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        stuck = det.check_pins()
+        assert len(stuck) == 1 and stuck[0].name == "slow-flush"
+        assert det.read_only is True       # default flow-control reaction
+        release.set()
+        t.join()
+
+    def test_custom_on_hung_callback(self):
+        events = []
+        det = IODetector(timeout_s=0.01, interval_s=1000,
+                         on_hung=events.append)
+        with det.pin("op"):
+            time.sleep(0.05)
+            det.check_pins()
+        assert events and "op" in events[0]
+        assert det.read_only is False      # custom callback replaced default
+
+    def test_probe_write(self, tmp_path):
+        det = IODetector(timeout_s=10, interval_s=1000,
+                         probe_dirs=(str(tmp_path),))
+        lat = det.probe_once()
+        assert str(tmp_path) in lat and lat[str(tmp_path)] < 10
+        assert det.hung_events == 0
+
+    def test_probe_missing_dir_reports(self, tmp_path):
+        det = IODetector(timeout_s=10, interval_s=1000,
+                         probe_dirs=(str(tmp_path / "nope"),))
+        det.probe_once()
+        assert det.hung_events == 1
