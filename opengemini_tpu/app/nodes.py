@@ -143,11 +143,19 @@ class TsSql:
     """Stateless SQL/ingest frontend: HTTP API over the cluster facade."""
 
     def __init__(self, meta_addrs: list[str], host: str = "127.0.0.1",
-                 http_port: int = 0):
+                 http_port: int = 0, flight_port: int | None = None,
+                 flight_users: dict[str, str] | None = None):
         self.meta = MetaClient(meta_addrs)
         self.facade = ClusterFacade(self.meta)
         self.http = HttpServer(self.facade, host=host, port=http_port,
                                executor=self.facade.executor)
+        # columnar ingest plane (reference: arrowflight service on ts-sql)
+        self.flight = None
+        if flight_port is not None:
+            from ..services.arrowflight import ArrowFlightService
+            self.flight = ArrowFlightService(self.facade, host=host,
+                                             port=flight_port,
+                                             users=flight_users)
 
     @property
     def http_addr(self) -> str:
@@ -157,9 +165,13 @@ class TsSql:
         self.meta.refresh()
         self.meta.start_watch()
         self.http.start()
+        if self.flight is not None:
+            self.flight.start()
         log.info("ts-sql ready at %s", self.http_addr)
 
     def stop(self):
+        if self.flight is not None:
+            self.flight.stop()
         self.http.stop()
         self.facade.close()
         self.meta.close()

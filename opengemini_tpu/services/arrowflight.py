@@ -1,0 +1,252 @@
+"""Arrow Flight ingest (role of reference services/arrowflight/service.go:65-131
++ coordinator/record_writer.go:79-326).
+
+High-throughput columnar write path: clients ship Arrow record batches over
+gRPC Flight ``DoPut``; the flight descriptor carries a JSON command
+``{"db": ..., "rp": ..., "measurement": ..., "tag_columns": [...]}``
+(the reference's descriptor carries db/rp/measurement the same way); an
+optional handshake token auth gates writes (reference authServer in
+service.go). Batches are converted columnar→rows and routed through the
+same write entry as the HTTP path (Engine.write_points or the cluster
+facade's PointsWriter — per-PT routing happens there).
+
+Columnar conversion rules (reference record_writer.go ArrowRecordToNative):
+  - "time" column: int64 ns or any arrow timestamp (normalised to ns);
+    missing → server receive time.
+  - tag columns: named in the descriptor, else every dictionary-encoded
+    string column.
+  - remaining columns: fields (float/int/bool/string); nulls are skipped
+    per row, matching line-protocol sparse-field semantics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import secrets
+import threading
+import time
+
+import numpy as np
+
+from ..storage.rows import PointRow
+from ..utils import get_logger
+from ..utils.errors import GeminiError
+
+log = get_logger(__name__)
+
+try:
+    import pyarrow as pa
+    import pyarrow.flight as flight
+    HAVE_FLIGHT = True
+except Exception:                                    # pragma: no cover
+    pa = flight = None
+    HAVE_FLIGHT = False
+
+
+# --------------------------------------------------------------- conversion
+
+def batch_to_rows(batch, measurement: str,
+                  tag_columns: list[str] | None = None,
+                  recv_time_ns: int | None = None) -> list[PointRow]:
+    """Arrow RecordBatch/Table → PointRow list (reference
+    record_writer.go:180 arrow → record.Record conversion)."""
+    names = batch.schema.names
+    if tag_columns is None:
+        tag_columns = [f.name for f in batch.schema
+                       if pa.types.is_dictionary(f.type)]
+    tag_set = set(tag_columns)
+    n = batch.num_rows
+
+    times = None
+    col_vals: dict[str, list] = {}
+    for name in names:
+        col = batch.column(names.index(name))
+        if name == "time":
+            t = col
+            if pa.types.is_timestamp(t.type):
+                t = t.cast(pa.int64())
+                unit = col.type.unit
+                scale = {"s": 10**9, "ms": 10**6, "us": 10**3, "ns": 1}[unit]
+                times = t.to_numpy(zero_copy_only=False) * scale
+            else:
+                times = t.cast(pa.int64()).to_numpy(zero_copy_only=False)
+            continue
+        col_vals[name] = col.to_pylist()
+
+    if times is None:
+        now = recv_time_ns if recv_time_ns is not None else time.time_ns()
+        times = np.full(n, now, dtype=np.int64)
+
+    rows = []
+    items = list(col_vals.items())
+    for i in range(n):
+        tags, fields = {}, {}
+        for name, vals in items:
+            v = vals[i]
+            if v is None:
+                continue
+            if name in tag_set:
+                tags[name] = str(v)
+            else:
+                fields[name] = v
+        if fields:
+            rows.append(PointRow(measurement, tags, fields, int(times[i])))
+    return rows
+
+
+# --------------------------------------------------------------------- auth
+
+class TokenAuthHandler(flight.ServerAuthHandler if HAVE_FLIGHT else object):
+    """Handshake auth (reference service.go authServer: user/password in,
+    HMAC token out; every later call presents the token)."""
+
+    def __init__(self, users: dict[str, str]):
+        if HAVE_FLIGHT:
+            super().__init__()
+        self.users = users
+        self._secret = secrets.token_bytes(16)
+
+    def _token(self, username: str) -> bytes:
+        mac = hmac.new(self._secret, username.encode(), hashlib.sha256)
+        return (username + ":" + mac.hexdigest()).encode()
+
+    def authenticate(self, outgoing, incoming):
+        payload = incoming.read()
+        try:
+            creds = json.loads(payload.decode())
+            user, pwd = creds["username"], creds["password"]
+        except Exception:
+            raise flight.FlightUnauthenticatedError("bad credentials payload")
+        if self.users.get(user) != pwd:
+            raise flight.FlightUnauthenticatedError("invalid username/password")
+        outgoing.write(self._token(user))
+
+    def is_valid(self, token):
+        if not token:
+            raise flight.FlightUnauthenticatedError("no token")
+        user = token.decode().split(":", 1)[0]
+        if not hmac.compare_digest(token, self._token(user)):
+            raise flight.FlightUnauthenticatedError("bad token")
+        return user.encode()
+
+
+# ------------------------------------------------------------------- server
+
+class ArrowFlightService((flight.FlightServerBase if HAVE_FLIGHT
+                          else object)):
+    """Flight ingest endpoint in front of any writer exposing
+    ``write_points(db, rows)`` (Engine or ClusterFacade)."""
+
+    def __init__(self, writer, host: str = "127.0.0.1", port: int = 0,
+                 users: dict[str, str] | None = None,
+                 max_rows_per_batch: int = 1_000_000):
+        if not HAVE_FLIGHT:                          # pragma: no cover
+            raise GeminiError("pyarrow.flight unavailable")
+        self.auth = TokenAuthHandler(users) if users else None
+        super().__init__(f"grpc://{host}:{port}", auth_handler=self.auth)
+        self.writer = writer
+        self.host = host
+        self.max_rows_per_batch = max_rows_per_batch
+        self.rows_written = 0
+        self.batches = 0
+        self.write_errors = 0
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def location(self) -> str:
+        return f"grpc://{self.host}:{self.port}"
+
+    # ---------------------------------------------------------- flight rpc
+
+    def do_put(self, context, descriptor, reader, writer):
+        try:
+            cmd = json.loads(descriptor.command.decode())
+            db = cmd["db"]
+            measurement = cmd.get("measurement") or cmd["mst"]
+        except Exception:
+            raise flight.FlightServerError(
+                "descriptor command must be JSON with db/measurement")
+        tag_columns = cmd.get("tag_columns")
+        recv = time.time_ns()
+        for chunk in reader:
+            batch = chunk.data
+            if batch.num_rows > self.max_rows_per_batch:
+                raise flight.FlightServerError("batch too large")
+            rows = batch_to_rows(batch, measurement, tag_columns, recv)
+            try:
+                self.writer.write_points(db, rows)
+            except Exception as e:
+                self.write_errors += 1
+                raise flight.FlightServerError(f"write failed: {e}")
+            self.rows_written += len(rows)
+            self.batches += 1
+
+    def list_flights(self, context, criteria):
+        return iter(())
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._serve_thread = threading.Thread(target=self.serve,
+                                              name="arrow-flight",
+                                              daemon=True)
+        self._serve_thread.start()
+        log.info("arrow flight ingest at %s", self.location)
+
+    def stop(self) -> None:
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+            self._serve_thread = None
+
+    def stats(self) -> dict[str, int]:
+        return {"rows_written": self.rows_written, "batches": self.batches,
+                "write_errors": self.write_errors}
+
+
+# ------------------------------------------------------------------- client
+
+class FlightWriter:
+    """Client helper (role of the reference's Java/Python flight client
+    examples): connects, optionally authenticates, ships tables."""
+
+    def __init__(self, location: str, username: str = "",
+                 password: str = ""):
+        if not HAVE_FLIGHT:                          # pragma: no cover
+            raise GeminiError("pyarrow.flight unavailable")
+        self.client = flight.FlightClient(location)
+        if username:
+            self.client.authenticate(
+                _ClientAuth(json.dumps({"username": username,
+                                        "password": password}).encode()))
+
+    def write_table(self, db: str, measurement: str, table,
+                    tag_columns: list[str] | None = None) -> None:
+        cmd = {"db": db, "measurement": measurement}
+        if tag_columns is not None:
+            cmd["tag_columns"] = tag_columns
+        descriptor = flight.FlightDescriptor.for_command(
+            json.dumps(cmd).encode())
+        writer, _ = self.client.do_put(descriptor, table.schema)
+        writer.write_table(table)
+        writer.close()
+
+    def close(self) -> None:
+        self.client.close()
+
+
+if HAVE_FLIGHT:
+    class _ClientAuth(flight.ClientAuthHandler):
+        def __init__(self, payload: bytes):
+            super().__init__()
+            self.payload = payload
+            self.token = b""
+
+        def authenticate(self, outgoing, incoming):
+            outgoing.write(self.payload)
+            self.token = incoming.read()
+
+        def get_token(self):
+            return self.token

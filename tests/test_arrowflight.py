@@ -1,0 +1,124 @@
+"""Arrow Flight ingest (reference services/arrowflight/service.go,
+coordinator/record_writer.go)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from opengemini_tpu.services.arrowflight import (ArrowFlightService,
+                                                 FlightWriter, batch_to_rows)
+from opengemini_tpu.storage.engine import Engine
+
+
+def _q(eng, text: str) -> dict:
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    (stmt,) = parse_query(text)
+    return QueryExecutor(eng).execute(stmt, "db0")
+
+
+def _table(n=8, with_time=True):
+    cols = {
+        "hostname": pa.array([f"host-{i % 2}" for i in range(n)]).dictionary_encode(),
+        "region": pa.array(["west"] * n).dictionary_encode(),
+        "usage_user": pa.array(np.linspace(1.0, n, n)),
+        "usage_system": pa.array([None if i == 3 else float(i)
+                                  for i in range(n)], type=pa.float64()),
+    }
+    if with_time:
+        cols["time"] = pa.array(
+            (np.arange(n, dtype=np.int64) + 1) * 1_000_000_000)
+    return pa.table(cols)
+
+
+class TestBatchToRows:
+    def test_dictionary_columns_become_tags(self):
+        rows = batch_to_rows(_table().to_batches()[0], "cpu")
+        assert len(rows) == 8
+        assert rows[0].tags == {"hostname": "host-0", "region": "west"}
+        assert rows[0].fields == {"usage_user": 1.0, "usage_system": 0.0}
+        assert rows[0].time == 1_000_000_000
+
+    def test_explicit_tag_columns(self):
+        t = pa.table({"host": pa.array(["a", "b"]),
+                      "v": pa.array([1.0, 2.0]),
+                      "time": pa.array([1, 2], type=pa.int64())})
+        rows = batch_to_rows(t.to_batches()[0], "m", tag_columns=["host"])
+        assert rows[0].tags == {"host": "a"} and rows[0].fields == {"v": 1.0}
+
+    def test_null_fields_skipped(self):
+        rows = batch_to_rows(_table().to_batches()[0], "cpu")
+        assert "usage_system" not in rows[3].fields
+        assert rows[3].fields == {"usage_user": 4.0}
+
+    def test_timestamp_column_normalised_to_ns(self):
+        t = pa.table({"v": pa.array([1.0]),
+                      "time": pa.array([5_000_000], type=pa.timestamp("ms"))})
+        rows = batch_to_rows(t.to_batches()[0], "m")
+        assert rows[0].time == 5_000_000 * 10**6
+
+    def test_missing_time_uses_receive_time(self):
+        t = pa.table({"v": pa.array([1.0, 2.0])})
+        rows = batch_to_rows(t.to_batches()[0], "m", recv_time_ns=42)
+        assert [r.time for r in rows] == [42, 42]
+
+
+@pytest.fixture
+def server(tmp_path):
+    eng = Engine(str(tmp_path / "store"))
+    svc = ArrowFlightService(eng)
+    svc.start()
+    yield svc, eng
+    svc.stop()
+    eng.close()
+
+
+class TestFlightIngest:
+    def test_do_put_roundtrip(self, server):
+        svc, eng = server
+        w = FlightWriter(svc.location)
+        w.write_table("db0", "cpu", _table(), tag_columns=["hostname", "region"])
+        w.close()
+        assert svc.stats()["rows_written"] == 8
+        res = _q(eng, "SELECT sum(usage_user) FROM cpu")
+        total = res["series"][0]["values"][0][1]
+        assert total == pytest.approx(np.linspace(1.0, 8, 8).sum())
+
+    def test_group_by_tag_after_flight_write(self, server):
+        svc, eng = server
+        w = FlightWriter(svc.location)
+        w.write_table("db0", "cpu", _table())
+        w.close()
+        res = _q(eng, "SELECT count(usage_user) FROM cpu GROUP BY hostname")
+        series = res["series"]
+        assert {s["tags"]["hostname"] for s in series} == {"host-0", "host-1"}
+
+    def test_bad_descriptor_rejected(self, server):
+        import pyarrow.flight as flight
+        svc, _ = server
+        client = flight.FlightClient(svc.location)
+        desc = flight.FlightDescriptor.for_command(b"not-json")
+        t = _table()
+        writer, _ = client.do_put(desc, t.schema)
+        with pytest.raises(flight.FlightError):
+            writer.write_table(t)
+            writer.close()
+        client.close()
+
+
+class TestFlightAuth:
+    def test_auth_required_and_accepted(self, tmp_path):
+        import pyarrow.flight as flight
+        eng = Engine(str(tmp_path / "store"))
+        svc = ArrowFlightService(eng, users={"admin": "pw"})
+        svc.start()
+        try:
+            w = FlightWriter(svc.location, username="admin", password="pw")
+            w.write_table("db0", "cpu", _table())
+            w.close()
+            assert svc.stats()["rows_written"] == 8
+            with pytest.raises(flight.FlightError):
+                FlightWriter(svc.location, username="admin",
+                             password="wrong")
+        finally:
+            svc.stop()
+            eng.close()
