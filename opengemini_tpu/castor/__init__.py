@@ -1,0 +1,10 @@
+"""Castor AI layer (role of reference services/castor + python/ts-udf):
+anomaly detection / model fit via Python workers over Arrow Flight, with
+an in-process fallback so single-node deployments need no worker fleet.
+"""
+
+from .algorithms import ALGORITHMS, detect, fit
+from .service import CastorService
+from .worker import CastorWorker
+
+__all__ = ["ALGORITHMS", "detect", "fit", "CastorService", "CastorWorker"]

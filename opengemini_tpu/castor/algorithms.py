@@ -1,0 +1,138 @@
+"""Castor detection/fit algorithms (role of reference
+python/ts-udf/server/{detect,fit}.py — ThresholdAD / ValueChangeAD /
+DIFFERENTIATEAD / IncrementalAD families).
+
+Pure-numpy detectors shared by the flight worker and the in-process
+fallback. Each detector maps (times, values, config, model?) → bool
+anomaly mask; ``fit`` produces a model dict that ``detect`` can reuse
+(the reference caches fitted models in the worker keyed by the query's
+model id; same contract here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.errors import GeminiError
+
+
+def _cfg(config: dict | None, key: str, default: float) -> float:
+    if not config or key not in config:
+        return default
+    return float(config[key])
+
+
+# ------------------------------------------------------------- detectors
+
+def _threshold(times, values, config, model):
+    upper = _cfg(config, "upper", np.inf)
+    lower = _cfg(config, "lower", -np.inf)
+    return (values > upper) | (values < lower)
+
+
+def _ksigma(times, values, config, model):
+    k = _cfg(config, "k", 3.0)
+    if model and "mean" in model:
+        mean, std = model["mean"], model["std"]
+    else:
+        mean, std = float(np.mean(values)), float(np.std(values))
+    if std == 0.0:
+        return np.zeros(len(values), dtype=bool)
+    return np.abs(values - mean) > k * std
+
+
+def _diff(times, values, config, model):
+    """ValueChangeAD / DIFFERENTIATEAD analog: anomalous step changes —
+    |Δv| beyond k·σ(Δv) (or an absolute delta if configured)."""
+    if len(values) < 2:
+        return np.zeros(len(values), dtype=bool)
+    d = np.diff(values)
+    delta = config.get("delta") if config else None
+    if delta is not None:
+        hit = np.abs(d) > float(delta)
+    else:
+        k = _cfg(config, "k", 3.0)
+        std = model["diff_std"] if model and "diff_std" in model \
+            else float(np.std(d))
+        if std == 0.0:
+            return np.zeros(len(values), dtype=bool)
+        hit = np.abs(d) > k * std
+    out = np.zeros(len(values), dtype=bool)
+    out[1:] = hit
+    return out
+
+
+def _iqr(times, values, config, model):
+    k = _cfg(config, "k", 1.5)
+    if model and "q1" in model:
+        q1, q3 = model["q1"], model["q3"]
+    else:
+        q1, q3 = np.percentile(values, [25, 75])
+    iqr = q3 - q1
+    return (values < q1 - k * iqr) | (values > q3 + k * iqr)
+
+
+def _incremental(times, values, config, model):
+    """IncrementalAD analog: rolling-window mean/std, flag points that
+    deviate k·σ from the trailing window (no lookahead)."""
+    k = _cfg(config, "k", 3.0)
+    w = int(_cfg(config, "window", 20))
+    n = len(values)
+    out = np.zeros(n, dtype=bool)
+    if n <= 2:
+        return out
+    csum = np.concatenate([[0.0], np.cumsum(values)])
+    csq = np.concatenate([[0.0], np.cumsum(values * values)])
+    idx = np.arange(n)
+    lo = np.maximum(idx - w, 0)
+    cnt = idx - lo
+    ok = cnt >= 2
+    s = csum[idx] - csum[lo]
+    sq = csq[idx] - csq[lo]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mean = s / cnt
+        var = np.maximum(sq / cnt - mean * mean, 0.0)
+        std = np.sqrt(var)
+        dev = np.abs(values - mean)
+        out[ok] = dev[ok] > k * np.where(std[ok] > 0, std[ok], np.inf)
+    return out
+
+
+ALGORITHMS = {
+    "threshold": _threshold,
+    "ksigma": _ksigma,
+    "diff": _diff,
+    "iqr": _iqr,
+    "incremental": _incremental,
+}
+
+
+# ------------------------------------------------------------ public api
+
+def detect(times: np.ndarray, values: np.ndarray, algo: str,
+           config: dict | None = None,
+           model: dict | None = None) -> np.ndarray:
+    fn = ALGORITHMS.get(algo)
+    if fn is None:
+        raise GeminiError(f"unknown castor algorithm: {algo}")
+    values = np.asarray(values, dtype=np.float64)
+    if len(values) == 0:
+        return np.zeros(0, dtype=bool)
+    return fn(np.asarray(times), values, config or {}, model)
+
+
+def fit(times: np.ndarray, values: np.ndarray, algo: str,
+        config: dict | None = None) -> dict:
+    """Train a model for later detect calls (reference fit.py)."""
+    if algo not in ALGORITHMS:
+        raise GeminiError(f"unknown castor algorithm: {algo}")
+    values = np.asarray(values, dtype=np.float64)
+    model: dict = {"algo": algo, "n": int(len(values))}
+    if len(values):
+        model.update(mean=float(np.mean(values)),
+                     std=float(np.std(values)))
+        q1, q3 = np.percentile(values, [25, 75])
+        model.update(q1=float(q1), q3=float(q3))
+    if len(values) > 1:
+        model["diff_std"] = float(np.std(np.diff(values)))
+    return model

@@ -1,0 +1,131 @@
+"""Castor service client (role of reference services/castor/service.go:32-343
++ client.go: connection pool over worker addresses, retries with
+failover, result dispatch).
+
+With no workers configured the service runs the algorithms in-process
+(single-node deployments; the reference requires a worker fleet, we keep
+the same flight contract but degrade gracefully).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import uuid
+
+import numpy as np
+
+from ..utils import get_logger
+from ..utils.errors import GeminiError
+from . import algorithms
+
+log = get_logger(__name__)
+
+
+class CastorService:
+    def __init__(self, worker_locations: list[str] | None = None,
+                 max_retries: int = 2):
+        self.locations = list(worker_locations or [])
+        self.max_retries = max_retries
+        self._clients: dict[str, object] = {}
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self.tasks = 0
+        self.failures = 0
+
+    # -------------------------------------------------------------- pool
+
+    def _client(self, loc: str):
+        import pyarrow.flight as flight
+        with self._lock:
+            c = self._clients.get(loc)
+            if c is None:
+                c = self._clients[loc] = flight.FlightClient(loc)
+            return c
+
+    def _pick_locations(self) -> list[str]:
+        """Round-robin start point, then failover through the rest."""
+        if not self.locations:
+            return []
+        start = next(self._rr) % len(self.locations)
+        return self.locations[start:] + self.locations[:start]
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+    # ---------------------------------------------------------------- api
+
+    def detect(self, times, values, algo: str, config: dict | None = None,
+               task: str = "detect", model_id: str | None = None):
+        """Returns (times, values, levels) of anomalous points."""
+        times = np.asarray(times, dtype=np.int64)
+        values = np.asarray(values, dtype=np.float64)
+        self.tasks += 1
+        if not self.locations:
+            model = None
+            if task == "fit_detect":
+                model = algorithms.fit(times, values, algo, config)
+            mask = algorithms.detect(times, values, algo, config, model)
+            idx = np.nonzero(mask)[0]
+            return times[idx], values[idx], np.ones(len(idx))
+        table = self._run_remote(times, values, algo, config, task,
+                                 model_id)
+        return (table.column("time").to_numpy(zero_copy_only=False),
+                table.column(table.column_names[1])
+                     .to_numpy(zero_copy_only=False),
+                table.column("anomaly_level")
+                     .to_numpy(zero_copy_only=False))
+
+    def fit(self, times, values, algo: str, config: dict | None = None,
+            model_id: str | None = None) -> dict:
+        times = np.asarray(times, dtype=np.int64)
+        values = np.asarray(values, dtype=np.float64)
+        self.tasks += 1
+        if not self.locations:
+            return algorithms.fit(times, values, algo, config)
+        table = self._run_remote(times, values, algo, config, "fit",
+                                 model_id)
+        return json.loads(table.column("model")[0].as_py())
+
+    # ------------------------------------------------------------- remote
+
+    def _run_remote(self, times, values, algo, config, task, model_id):
+        import pyarrow as pa
+        import pyarrow.flight as flight
+        cmd = {"id": uuid.uuid4().hex, "type": task, "algo": algo,
+               "config": config or {}}
+        if model_id:
+            cmd["model_id"] = model_id
+        body = pa.table({"time": pa.array(times, type=pa.int64()),
+                         "value": pa.array(values, type=pa.float64())})
+        last_err: Exception | None = None
+        tried = 0
+        for loc in self._pick_locations():
+            if tried > self.max_retries:
+                break
+            tried += 1
+            try:
+                client = self._client(loc)
+                desc = flight.FlightDescriptor.for_command(
+                    json.dumps(cmd).encode())
+                writer, _ = client.do_put(desc, body.schema)
+                writer.write_table(body)
+                writer.close()
+                reader = client.do_get(flight.Ticket(cmd["id"].encode()))
+                return reader.read_all()
+            except Exception as e:
+                last_err = e
+                with self._lock:
+                    self.failures += 1
+                log.warning("castor worker %s failed: %s", loc, e)
+                with self._lock:
+                    self._clients.pop(loc, None)
+        raise GeminiError(f"all castor workers failed: {last_err}")
+
+    def stats(self) -> dict[str, int]:
+        return {"tasks": self.tasks, "failures": self.failures,
+                "workers": len(self.locations)}

@@ -1,0 +1,131 @@
+"""Castor Python worker (role of reference python/ts-udf/server/server.py
++ handler.py: a Flight endpoint that receives series data, runs
+detect/fit, and hands results back; fitted models are cached in-process
+keyed by model id).
+
+Protocol (mirrors the reference's flight usage):
+  DoPut  descriptor command = JSON {"id", "type": "detect"|"fit"|
+         "fit_detect", "algo", "config"?, "model_id"?}
+         body = arrow table with "time" (int64 ns) + one value column.
+  DoGet  ticket = the same id → result table:
+         detect: rows (time, value, anomaly_level) for flagged points
+         fit:    single-row table with the serialized model JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from ..utils import get_logger
+from . import algorithms
+
+log = get_logger(__name__)
+
+try:
+    import pyarrow as pa
+    import pyarrow.flight as flight
+    HAVE_FLIGHT = True
+except Exception:                                    # pragma: no cover
+    pa = flight = None
+    HAVE_FLIGHT = False
+
+
+class CastorWorker((flight.FlightServerBase if HAVE_FLIGHT else object)):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 model_cache_size: int = 256):
+        super().__init__(f"grpc://{host}:{port}")
+        self.host = host
+        self.results: dict[str, object] = {}
+        self.models: dict[str, dict] = {}
+        self.model_cache_size = model_cache_size
+        self.tasks_done = 0
+        self._lock = threading.Lock()
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def location(self) -> str:
+        return f"grpc://{self.host}:{self.port}"
+
+    # ---------------------------------------------------------- flight rpc
+
+    def do_put(self, context, descriptor, reader, writer):
+        cmd = json.loads(descriptor.command.decode())
+        table = reader.read_all()
+        try:
+            result = self._run(cmd, table)
+        except Exception as e:
+            log.warning("castor task %s failed: %s", cmd.get("id"), e)
+            result = e
+        with self._lock:
+            # bound the result buffer: an orphaned result (client died
+            # between DoPut and DoGet, or failed over to another worker)
+            # must not leak its arrow table forever
+            while len(self.results) >= self.model_cache_size:
+                self.results.pop(next(iter(self.results)))
+            self.results[cmd["id"]] = result
+            self.tasks_done += 1
+
+    def do_get(self, context, ticket):
+        with self._lock:
+            result = self.results.pop(ticket.ticket.decode(), None)
+        if result is None:
+            raise flight.FlightServerError("unknown task id")
+        if isinstance(result, Exception):
+            raise flight.FlightServerError(f"task failed: {result}")
+        return flight.RecordBatchStream(result)
+
+    # ----------------------------------------------------------- task exec
+
+    def _run(self, cmd: dict, table):
+        task = cmd.get("type", "detect")
+        algo = cmd["algo"]
+        config = cmd.get("config") or {}
+        names = [n for n in table.column_names if n != "time"]
+        if not names:
+            raise ValueError("no value column")
+        times = table.column("time").to_numpy(zero_copy_only=False)
+        values = table.column(names[0]).to_numpy(zero_copy_only=False)
+
+        if task == "fit":
+            model = algorithms.fit(times, values, algo, config)
+            self._store_model(cmd.get("model_id") or cmd["id"], model)
+            return pa.table({"model": pa.array([json.dumps(model)])})
+
+        model = None
+        if task == "fit_detect":
+            model = algorithms.fit(times, values, algo, config)
+            self._store_model(cmd.get("model_id") or cmd["id"], model)
+        elif cmd.get("model_id"):
+            with self._lock:
+                model = self.models.get(cmd["model_id"])
+        mask = algorithms.detect(times, values, algo, config, model)
+        idx = np.nonzero(mask)[0]
+        return pa.table({
+            "time": pa.array(times[idx], type=pa.int64()),
+            names[0]: pa.array(values[idx], type=pa.float64()),
+            "anomaly_level": pa.array(np.ones(len(idx)), type=pa.float64()),
+        })
+
+    def _store_model(self, key: str, model: dict) -> None:
+        with self._lock:
+            if len(self.models) >= self.model_cache_size:
+                self.models.pop(next(iter(self.models)))
+            self.models[key] = model
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._serve_thread = threading.Thread(target=self.serve,
+                                              name="castor-worker",
+                                              daemon=True)
+        self._serve_thread.start()
+        log.info("castor worker at %s", self.location)
+
+    def stop(self) -> None:
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+            self._serve_thread = None

@@ -17,12 +17,15 @@ wires states through partial → merge → finalize.
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 from ..record import DataType
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError
-from .ast import (SelectStatement, ShowStatement, CreateDatabaseStatement,
+from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
+                  ShowStatement, CreateDatabaseStatement,
                   CreateMeasurementStatement, DropDatabaseStatement,
                   DropMeasurementStatement, DeleteStatement,
                   ExplainStatement, KillQueryStatement)
@@ -55,10 +58,12 @@ class QueryExecutor:
     KILL QUERY; resources (optional QueryResources) enforces series
     caps inside scans."""
 
-    def __init__(self, engine, query_manager=None, resources=None):
+    def __init__(self, engine, query_manager=None, resources=None,
+                 castor=None):
         self.engine = engine
         self.query_manager = query_manager
         self.resources = resources
+        self.castor = castor    # CastorService; lazily built if needed
 
     # ------------------------------------------------------------------ api
 
@@ -198,6 +203,8 @@ class QueryExecutor:
             if "error" in inner_res:
                 return inner_res
             res = select_over_result(stmt, db, inner_res)
+        elif self._is_castor(stmt):
+            res = self._select_castor(stmt, db, ctx=ctx)
         else:
             mst = stmt.from_measurement
             cs = classify_select(stmt)
@@ -215,6 +222,95 @@ class QueryExecutor:
         if stmt.into_measurement:
             return self._write_into(stmt, db, res)
         return res
+
+    # --------------------------------------------------------------- castor
+
+    @staticmethod
+    def _is_castor(stmt: SelectStatement) -> bool:
+        """SELECT castor(field, 'algo'[, 'conf'][, 'type']) FROM m — the
+        reference's CastorOp/udaf SQL surface (engine/op/,
+        engine/executor/udaf_functions.go)."""
+        return (len(stmt.fields) == 1
+                and isinstance(stmt.fields[0].expr, Call)
+                and stmt.fields[0].expr.func == "castor")
+
+    def _select_castor(self, stmt: SelectStatement, db: str,
+                       ctx=None) -> dict:
+        call = stmt.fields[0].expr
+        if not call.args or not isinstance(call.args[0], FieldRef):
+            return {"error": "castor(field, 'algorithm', ...) expected"}
+        field = call.args[0].name
+        strs = []
+        for a in call.args[1:]:
+            if not isinstance(a, Literal) or not isinstance(a.value, str):
+                return {"error": "castor() extra args must be strings"}
+            strs.append(a.value)
+        if not strs:
+            return {"error": "castor() requires an algorithm name"}
+        algo = strs[0]
+        config = {}
+        task = "detect"
+        for s in strs[1:]:
+            if s in ("detect", "fit", "fit_detect"):
+                task = s
+            else:
+                for part in s.split(","):
+                    if "=" in part:
+                        k, v = part.split("=", 1)
+                        try:
+                            config[k.strip()] = float(v)
+                        except ValueError:
+                            config[k.strip()] = v.strip()
+        if self.castor is None:
+            from ..castor import CastorService
+            self.castor = CastorService()
+
+        # run the underlying raw select, then detect per series
+        raw = SelectStatement(
+            fields=[SelectField(FieldRef(field))],
+            from_measurement=stmt.from_measurement, from_db=stmt.from_db,
+            condition=stmt.condition, dimensions=stmt.dimensions)
+        res = self._select(raw, db, ctx=ctx)
+        if "error" in res:
+            return res
+        out_series = []
+        for s in res.get("series", []):
+            cols = s["columns"]
+            ti, vi = cols.index("time"), cols.index(field)
+            times = np.array([r[ti] for r in s["values"]], dtype=np.int64)
+            try:
+                vals = np.array(
+                    [np.nan if r[vi] is None else float(r[vi])
+                     for r in s["values"]])
+            except (TypeError, ValueError):
+                return {"error":
+                        f"castor: field {field} is not numeric"}
+            ok = ~np.isnan(vals)
+            try:
+                if task == "fit":
+                    model = self.castor.fit(times[ok], vals[ok], algo,
+                                            config)
+                    out_series.append(
+                        {"name": s["name"], "tags": s.get("tags", {}),
+                         "columns": ["model"],
+                         "values": [[json.dumps(model)]]})
+                    continue
+                at, av, lv = self.castor.detect(times[ok], vals[ok], algo,
+                                                config, task=task)
+            except Exception as e:
+                return {"error": f"castor: {e}"}
+            vals = [[int(t), float(v), float(l)]
+                    for t, v, l in zip(at, av, lv)]
+            if stmt.order_desc:
+                vals.reverse()
+            lo = stmt.offset
+            hi = lo + stmt.limit if stmt.limit else None
+            out_series.append(
+                {"name": s["name"], "tags": s.get("tags", {}),
+                 "columns": ["time", field, "anomaly_level"],
+                 "values": vals[lo:hi] if (stmt.limit or stmt.offset)
+                 else vals})
+        return {"series": out_series}
 
     def _explain(self, stmt: ExplainStatement, db: str | None) -> dict:
         """EXPLAIN: logical plan description; EXPLAIN ANALYZE: execute

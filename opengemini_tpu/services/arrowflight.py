@@ -60,27 +60,29 @@ def batch_to_rows(batch, measurement: str,
     n = batch.num_rows
 
     times = None
-    col_vals: dict[str, list] = {}
-    for name in names:
-        col = batch.column(names.index(name))
+    col_vals: list[tuple[str, list]] = []
+    for name, col in zip(names, batch.columns):
         if name == "time":
-            t = col
-            if pa.types.is_timestamp(t.type):
-                t = t.cast(pa.int64())
-                unit = col.type.unit
-                scale = {"s": 10**9, "ms": 10**6, "us": 10**3, "ns": 1}[unit]
-                times = t.to_numpy(zero_copy_only=False) * scale
-            else:
-                times = t.cast(pa.int64()).to_numpy(zero_copy_only=False)
+            scale = 1
+            if pa.types.is_timestamp(col.type):
+                scale = {"s": 10**9, "ms": 10**6,
+                         "us": 10**3, "ns": 1}[col.type.unit]
+            times = col.cast(pa.int64()).to_numpy(zero_copy_only=False)
+            if times.dtype != np.int64:          # nulls → float64 + NaN
+                now = (recv_time_ns if recv_time_ns is not None
+                       else time.time_ns())
+                times = np.where(np.isnan(times), now / scale,
+                                 times).astype(np.int64)
+            times = times * scale
             continue
-        col_vals[name] = col.to_pylist()
+        col_vals.append((name, col.to_pylist()))
 
     if times is None:
         now = recv_time_ns if recv_time_ns is not None else time.time_ns()
         times = np.full(n, now, dtype=np.int64)
 
     rows = []
-    items = list(col_vals.items())
+    items = col_vals
     for i in range(n):
         tags, fields = {}, {}
         for name, vals in items:
@@ -126,7 +128,10 @@ class TokenAuthHandler(flight.ServerAuthHandler if HAVE_FLIGHT else object):
     def is_valid(self, token):
         if not token:
             raise flight.FlightUnauthenticatedError("no token")
-        user = token.decode().split(":", 1)[0]
+        try:
+            user = token.decode().split(":", 1)[0]
+        except UnicodeDecodeError:
+            raise flight.FlightUnauthenticatedError("bad token")
         if not hmac.compare_digest(token, self._token(user)):
             raise flight.FlightUnauthenticatedError("bad token")
         return user.encode()
@@ -152,6 +157,7 @@ class ArrowFlightService((flight.FlightServerBase if HAVE_FLIGHT
         self.rows_written = 0
         self.batches = 0
         self.write_errors = 0
+        self._stats_lock = threading.Lock()
         self._serve_thread: threading.Thread | None = None
 
     @property
@@ -178,10 +184,12 @@ class ArrowFlightService((flight.FlightServerBase if HAVE_FLIGHT
             try:
                 self.writer.write_points(db, rows)
             except Exception as e:
-                self.write_errors += 1
+                with self._stats_lock:
+                    self.write_errors += 1
                 raise flight.FlightServerError(f"write failed: {e}")
-            self.rows_written += len(rows)
-            self.batches += 1
+            with self._stats_lock:
+                self.rows_written += len(rows)
+                self.batches += 1
 
     def list_flights(self, context, criteria):
         return iter(())

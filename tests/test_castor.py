@@ -1,0 +1,152 @@
+"""Castor AI/UDF layer (reference services/castor + python/ts-udf)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.castor import (CastorService, CastorWorker, detect,
+                                   fit)
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+def _series(n=100, spikes=(30, 70)):
+    rng = np.random.default_rng(7)
+    times = np.arange(n, dtype=np.int64) * 10**9
+    values = rng.normal(10.0, 0.5, n)
+    for s in spikes:
+        values[s] = 100.0
+    return times, values
+
+
+class TestAlgorithms:
+    def test_threshold(self):
+        t, v = _series()
+        mask = detect(t, v, "threshold", {"upper": 50})
+        assert set(np.nonzero(mask)[0]) == {30, 70}
+
+    def test_ksigma_finds_spikes(self):
+        t, v = _series()
+        mask = detect(t, v, "ksigma", {"k": 3})
+        assert {30, 70} <= set(np.nonzero(mask)[0])
+
+    def test_diff_value_change(self):
+        t, v = _series()
+        mask = detect(t, v, "diff", {"delta": 50})
+        # spike entry and exit steps both flagged
+        assert {30, 31, 70, 71} == set(np.nonzero(mask)[0])
+
+    def test_iqr(self):
+        t, v = _series()
+        mask = detect(t, v, "iqr")
+        assert {30, 70} <= set(np.nonzero(mask)[0])
+
+    def test_incremental_no_lookahead(self):
+        t, v = _series(spikes=(50,))
+        mask = detect(t, v, "incremental", {"k": 5, "window": 20})
+        assert 50 in set(np.nonzero(mask)[0])
+
+    def test_fit_then_detect_uses_model(self):
+        t, v = _series(spikes=())
+        model = fit(t, v, "ksigma")
+        # new data shifted far from the trained mean: everything anomalous
+        mask = detect(t, v + 1000.0, "ksigma", {"k": 3}, model)
+        assert mask.all()
+
+    def test_unknown_algorithm(self):
+        from opengemini_tpu.utils.errors import GeminiError
+        with pytest.raises(GeminiError):
+            detect(np.array([1]), np.array([1.0]), "nope")
+
+    def test_empty_input(self):
+        assert detect(np.array([]), np.array([]), "ksigma").size == 0
+
+
+class TestWorkerAndService:
+    @pytest.fixture
+    def worker(self):
+        w = CastorWorker()
+        w.start()
+        yield w
+        w.stop()
+
+    def test_remote_detect(self, worker):
+        svc = CastorService([worker.location])
+        t, v = _series()
+        at, av, lv = svc.detect(t, v, "threshold", {"upper": 50})
+        assert list(at) == [t[30], t[70]]
+        assert list(av) == [100.0, 100.0]
+        assert worker.tasks_done == 1
+        svc.close()
+
+    def test_remote_fit_and_model_reuse(self, worker):
+        svc = CastorService([worker.location])
+        t, v = _series(spikes=())
+        model = svc.fit(t, v, "ksigma", model_id="m1")
+        assert model["algo"] == "ksigma" and "mean" in model
+        at, av, lv = svc.detect(t, v + 1000.0, "ksigma", {"k": 3},
+                                model_id="m1")
+        assert len(at) == len(t)       # all anomalous vs trained model
+        svc.close()
+
+    def test_failover_to_live_worker(self, worker):
+        # first location is dead; service retries onto the live one
+        svc = CastorService(["grpc://127.0.0.1:1", worker.location],
+                            max_retries=2)
+        t, v = _series()
+        at, _, _ = svc.detect(t, v, "threshold", {"upper": 50})
+        assert len(at) == 2
+        assert svc.failures >= 1
+        svc.close()
+
+    def test_all_workers_down(self):
+        from opengemini_tpu.utils.errors import GeminiError
+        svc = CastorService(["grpc://127.0.0.1:1"], max_retries=1)
+        with pytest.raises(GeminiError):
+            svc.detect(*_series(), "threshold")
+        svc.close()
+
+    def test_inproc_fallback(self):
+        svc = CastorService()
+        t, v = _series()
+        at, av, lv = svc.detect(t, v, "threshold", {"upper": 50})
+        assert len(at) == 2
+
+
+class TestCastorSQL:
+    @pytest.fixture
+    def db(self, tmp_path):
+        eng = Engine(str(tmp_path / "data"))
+        lines = []
+        for h in ("a", "b"):
+            for i in range(50):
+                v = 200.0 if i == 25 and h == "a" else 10.0 + i * 0.01
+                lines.append(f"cpu,host={h} usage={v} {i * 10**9}")
+        eng.write_points("db0", parse_lines("\n".join(lines)))
+        ex = QueryExecutor(eng)
+        yield ex
+        eng.close()
+
+    def test_castor_detect_sql(self, db):
+        (stmt,) = parse_query(
+            "SELECT castor(usage, 'threshold', 'upper=100') FROM cpu "
+            "GROUP BY host")
+        res = db.execute(stmt, "db0")
+        assert "error" not in res
+        by_host = {s["tags"]["host"]: s["values"] for s in res["series"]}
+        assert len(by_host["a"]) == 1
+        assert by_host["a"][0][0] == 25 * 10**9
+        assert by_host["a"][0][1] == 200.0
+        assert by_host["b"] == []
+
+    def test_castor_fit_sql(self, db):
+        (stmt,) = parse_query(
+            "SELECT castor(usage, 'ksigma', 'fit') FROM cpu GROUP BY host")
+        res = db.execute(stmt, "db0")
+        assert "error" not in res
+        assert all(s["columns"] == ["model"] for s in res["series"])
+
+    def test_castor_bad_algo_sql(self, db):
+        (stmt,) = parse_query("SELECT castor(usage, 'nope') FROM cpu")
+        res = db.execute(stmt, "db0")
+        assert "error" in res
