@@ -1,0 +1,277 @@
+"""ts-cli: interactive query shell + line-protocol import tool (role of
+reference app/ts-cli — geminicli/cli.go REPL with completer, import.go
+batch importer, cobra commands app/ts-cli/cmd/).
+
+Run: ``python -m opengemini_tpu.app.cli [--host H] [--port P]
+[--database DB] [--execute Q] [--import-file F] [--format column|json|csv]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import io
+import json
+import sys
+import time
+
+from .client import ClientError, HttpClient
+
+KEYWORDS = [
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "ORDER", "LIMIT", "OFFSET",
+    "SLIMIT", "SOFFSET", "INTO", "FILL", "AND", "OR", "AS", "DESC", "ASC",
+    "SHOW", "DATABASES", "MEASUREMENTS", "SERIES", "TAG", "FIELD", "KEYS",
+    "VALUES", "QUERIES", "CREATE", "DROP", "DATABASE", "MEASUREMENT",
+    "EXPLAIN", "ANALYZE", "KILL", "QUERY", "DELETE", "INSERT", "TIME",
+    "mean", "sum", "count", "min", "max", "first", "last", "median",
+    "spread", "stddev", "percentile", "top", "bottom", "distinct",
+    "derivative", "moving_average", "holt_winters", "castor", "rate",
+]
+COMMANDS = ["use", "format", "timing", "precision", "help", "exit", "quit",
+            "import", "insert"]
+
+
+class Cli:
+    def __init__(self, client: HttpClient, database: str = "",
+                 fmt: str = "column", precision: str | None = None,
+                 out=None):
+        self.client = client
+        self.database = database
+        self.format = fmt
+        self.precision = precision
+        self.timing = False
+        self.out = out or sys.stdout
+        self.last_error: str | None = None   # scripted callers' exit code
+
+    # ------------------------------------------------------------ commands
+
+    def run_line(self, line: str) -> bool:
+        """Execute one REPL line. Returns False when the loop should end."""
+        line = line.strip()
+        if not line:
+            return True
+        self.last_error = None
+        word0 = line.split()[0].lower()
+        if word0 in ("exit", "quit"):
+            return False
+        if word0 == "help":
+            self._print(self._help())
+        elif word0 == "use":
+            parts = line.split()
+            if len(parts) == 2:
+                self.database = parts[1].strip('"')
+                self._print(f"Using database {self.database}")
+            else:
+                self._print("usage: use <database>")
+        elif word0 == "format":
+            parts = line.split()
+            if len(parts) == 2 and parts[1] in ("column", "json", "csv"):
+                self.format = parts[1]
+            else:
+                self._print("usage: format column|json|csv")
+        elif word0 == "timing":
+            self.timing = not self.timing
+            self._print(f"Timing is {'on' if self.timing else 'off'}")
+        elif word0 == "precision":
+            parts = line.split()
+            self.precision = parts[1] if len(parts) == 2 else None
+        elif word0 == "insert":
+            self._insert(line[len("insert"):].strip())
+        elif word0 == "import":
+            parts = line.split(None, 1)
+            if len(parts) == 2:
+                self.import_file(parts[1])
+            else:
+                self._print("usage: import <path>")
+        else:
+            self._query(line)
+        return True
+
+    def _insert(self, lp: str) -> None:
+        if not self.database:
+            self._err("no database selected (use <db>)")
+            return
+        try:
+            self.client.write(lp, self.database, precision=self.precision)
+        except ClientError as e:
+            self._err(str(e))
+
+    def _query(self, q: str) -> None:
+        t0 = time.monotonic()
+        try:
+            res = self.client.query(q, db=self.database or None)
+        except ClientError as e:
+            self._err(str(e))
+            return
+        for result in res.get("results", []):
+            if "error" in result:
+                self.last_error = result["error"]
+        self._print(self.render(res))
+        if self.timing:
+            self._print(f"Elapsed: {time.monotonic() - t0:.3f}s")
+
+    # ----------------------------------------------------------- rendering
+
+    def render(self, res: dict) -> str:
+        if self.format == "json":
+            return json.dumps(res, indent=2)
+        out = []
+        for result in res.get("results", []):
+            if "error" in result:
+                out.append(f"ERR: {result['error']}")
+                continue
+            for s in result.get("series", []):
+                if self.format == "csv":
+                    out.append(self._csv(s))
+                else:
+                    out.append(self._columns(s))
+        return "\n".join(out) if out else "(empty result)"
+
+    @staticmethod
+    def _columns(s: dict) -> str:
+        head = f"name: {s.get('name', '')}"
+        if s.get("tags"):
+            head += " tags: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(s["tags"].items()))
+        cols = s.get("columns", [])
+        rows = [[("" if v is None else str(v)) for v in row]
+                for row in s.get("values", [])]
+        widths = [max([len(c)] + [len(r[i]) for r in rows])
+                  for i, c in enumerate(cols)]
+        lines = [head,
+                 "  ".join(c.ljust(w) for c, w in zip(cols, widths)),
+                 "  ".join("-" * w for w in widths)]
+        lines += ["  ".join(v.ljust(w) for v, w in zip(r, widths))
+                  for r in rows]
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _csv(s: dict) -> str:
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        tags = s.get("tags", {})
+        w.writerow(["name"] + list(tags.keys()) + s.get("columns", []))
+        for row in s.get("values", []):
+            w.writerow([s.get("name", "")] + list(tags.values()) + row)
+        return buf.getvalue()
+
+    # -------------------------------------------------------------- import
+
+    def import_file(self, path: str, batch_size: int = 5000) -> int:
+        """Line-protocol file import with batching (reference import.go).
+        Lines starting with '#' are comments; '# DML'/'# CONTEXT-DATABASE:'
+        directives select the target db as in influx importer format."""
+        db = self.database
+        n = 0
+        batch: list[str] = []
+
+        def flush():
+            nonlocal n
+            if batch:
+                self.client.write("\n".join(batch), db,
+                                  precision=self.precision)
+                n += len(batch)
+                batch.clear()
+
+        try:
+            with open(path) as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    if line.startswith("#"):
+                        d = line[1:].strip()
+                        if d.upper().startswith("CONTEXT-DATABASE:"):
+                            flush()
+                            db = d.split(":", 1)[1].strip()
+                        continue
+                    if not db:
+                        raise ClientError(
+                            "no database: use <db> or # CONTEXT-DATABASE:")
+                    batch.append(line)
+                    if len(batch) >= batch_size:
+                        flush()
+            flush()
+        except (OSError, ClientError) as e:
+            self._err(f"import: {e} ({n} points written)")
+            return n
+        self._print(f"Imported {n} points")
+        return n
+
+    # ----------------------------------------------------------- repl glue
+
+    def _print(self, s: str) -> None:
+        print(s, file=self.out)
+
+    def _err(self, msg: str) -> None:
+        self.last_error = msg
+        self._print(f"ERR: {msg}")
+
+    @staticmethod
+    def _help() -> str:
+        return ("Commands:\n"
+                "  use <db>            set target database\n"
+                "  format column|json|csv\n"
+                "  timing              toggle query timing\n"
+                "  precision <unit>    write precision (n,u,ms,s,m,h)\n"
+                "  insert <line-protocol>\n"
+                "  import <file>       import line-protocol file\n"
+                "  exit | quit\n"
+                "anything else is sent as a query.")
+
+    def completer(self, text: str, state: int):
+        cands = [w for w in KEYWORDS + COMMANDS
+                 if w.lower().startswith(text.lower())]
+        return cands[state] if state < len(cands) else None
+
+    def repl(self) -> None:
+        try:
+            import readline
+            readline.set_completer(self.completer)
+            readline.set_completer_delims(" \t\n,();=")
+            readline.parse_and_bind("tab: complete")
+        except ImportError:
+            pass
+        self._print("opengemini-tpu CLI (type 'help' for help)")
+        while True:
+            try:
+                line = input("> ")
+            except (EOFError, KeyboardInterrupt):
+                self._print("")
+                break
+            if not self.run_line(line):
+                break
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ts-cli",
+                                 description="opengemini-tpu CLI")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8086)
+    ap.add_argument("--database", default="")
+    ap.add_argument("--execute", help="run one query and exit")
+    ap.add_argument("--import-file", dest="import_file",
+                    help="import a line-protocol file and exit")
+    ap.add_argument("--format", default="column",
+                    choices=["column", "json", "csv"])
+    ap.add_argument("--precision", default=None)
+    args = ap.parse_args(argv)
+
+    cli = Cli(HttpClient(args.host, args.port), args.database,
+              args.format, args.precision)
+    if not cli.client.ping():
+        print(f"ERR: no server at {args.host}:{args.port}",
+              file=sys.stderr)
+        return 1
+    if args.import_file:
+        cli.import_file(args.import_file)
+        return 1 if cli.last_error else 0
+    if args.execute:
+        cli.run_line(args.execute)
+        return 1 if cli.last_error else 0
+    cli.repl()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Thin HTTP client for the InfluxDB-1.x-compatible API (role of the
+reference's client lib used by ts-cli — app/ts-cli/geminicli/cli.go talks
+to /query and /write the same way)."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+class ClientError(Exception):
+    pass
+
+
+class HttpClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8086,
+                 timeout_s: float = 30.0, gzip_writes: bool = False):
+        self.base = f"http://{host}:{port}"
+        self.timeout_s = timeout_s
+        self.gzip_writes = gzip_writes
+
+    def _do(self, method: str, path: str, body: bytes | None = None,
+            headers: dict | None = None) -> tuple[int, bytes]:
+        req = urllib.request.Request(self.base + path, data=body,
+                                     method=method,
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except OSError as e:
+            raise ClientError(f"cannot reach {self.base}: {e}")
+
+    def ping(self) -> bool:
+        try:
+            status, _ = self._do("GET", "/ping")
+        except ClientError:
+            return False
+        return status in (200, 204)
+
+    def query(self, q: str, db: str | None = None,
+              epoch: str | None = None) -> dict:
+        params = {"q": q}
+        if db:
+            params["db"] = db
+        if epoch:
+            params["epoch"] = epoch
+        status, body = self._do(
+            "GET", "/query?" + urllib.parse.urlencode(params))
+        try:
+            res = json.loads(body.decode())
+        except (ValueError, UnicodeDecodeError):
+            raise ClientError(f"bad response (HTTP {status}): {body[:200]!r}")
+        if status != 200:
+            raise ClientError(res.get("error", f"HTTP {status}"))
+        return res
+
+    def write(self, lines: str, db: str, rp: str | None = None,
+              precision: str | None = None) -> None:
+        params = {"db": db}
+        if rp:
+            params["rp"] = rp
+        if precision:
+            params["precision"] = precision
+        body = lines.encode()
+        headers = {}
+        if self.gzip_writes:
+            body = gzip.compress(body)
+            headers["Content-Encoding"] = "gzip"
+        status, resp = self._do(
+            "POST", "/write?" + urllib.parse.urlencode(params), body,
+            headers)
+        if status not in (200, 204):
+            try:
+                msg = json.loads(resp.decode()).get("error", "")
+            except (ValueError, UnicodeDecodeError):
+                msg = resp[:200]
+            raise ClientError(f"write failed (HTTP {status}): {msg}")

@@ -1,0 +1,212 @@
+"""ts-monitor: off-node monitoring agent (role of reference
+app/ts-monitor — collector/collect.go tails the components' pushed metric
+files, node_monitor.go samples node-level metrics, report.go ships both
+to a monitoring opengemini database over /write).
+
+The agent:
+  - tails line-protocol metric files written by StatisticsPusher
+    (``push_path``), forwarding new lines verbatim (rotation-aware);
+  - tails error logs, emitting ``errLogTotal`` counts per file;
+  - samples node metrics: cpu%, memory, disk usage of watched paths.
+
+Run: ``python -m opengemini_tpu.app.monitor --report-host H
+--report-db monitor --metric-file F --error-log F --disk-path D``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+from ..utils import get_logger
+from .client import ClientError, HttpClient
+
+log = get_logger(__name__)
+
+
+class _Tail:
+    """Offset-tracking tailer with rotation detection (size shrink or
+    inode change → start over)."""
+
+    def __init__(self, path: str, from_start: bool = False):
+        self.path = path
+        self.offset = 0
+        self.inode = -1
+        if not from_start:
+            # attach at end: a restart must not re-ship the whole history
+            try:
+                st = os.stat(path)
+                self.offset, self.inode = st.st_size, st.st_ino
+            except OSError:
+                pass
+
+    def read_new(self) -> list[str]:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        if st.st_ino != self.inode or st.st_size < self.offset:
+            self.inode = st.st_ino
+            self.offset = 0
+        if st.st_size == self.offset:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            chunk = f.read()
+        # only complete lines; partial tail re-read next tick
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return []
+        self.offset += nl + 1
+        return chunk[:nl].decode(errors="replace").splitlines()
+
+
+def _cpu_total():
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()[1:]
+        nums = [int(x) for x in parts]
+        idle = nums[3] + (nums[4] if len(nums) > 4 else 0)
+        return sum(nums), idle
+    except (OSError, ValueError, IndexError):
+        return 0, 0
+
+
+def _mem_info() -> dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                if k in ("MemTotal", "MemAvailable"):
+                    out[k] = int(v.split()[0]) * 1024
+    except (OSError, ValueError):
+        pass
+    return out
+
+
+class TsMonitor:
+    def __init__(self, client: HttpClient | None, report_db: str = "monitor",
+                 metric_files: list[str] = (),
+                 error_logs: list[str] = (),
+                 disk_paths: list[str] = (),
+                 hostname: str = "", interval_s: float = 10.0):
+        self.client = client
+        self.report_db = report_db
+        self.metric_tails = [_Tail(p) for p in metric_files]
+        self.error_tails = [_Tail(p) for p in error_logs]
+        self.disk_paths = list(disk_paths)
+        self.hostname = hostname or os.uname().nodename
+        self.interval_s = interval_s
+        self.err_counts = {p: 0 for p in error_logs}
+        self._last_cpu = _cpu_total()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.reported_lines = 0
+
+    # ------------------------------------------------------------ sampling
+
+    def node_metrics(self) -> dict[str, float]:
+        total, idle = _cpu_total()
+        ltotal, lidle = self._last_cpu
+        self._last_cpu = (total, idle)
+        dt, di = total - ltotal, idle - lidle
+        cpu_pct = 100.0 * (dt - di) / dt if dt > 0 else 0.0
+        m = _mem_info()
+        out = {"cpu_pct": round(cpu_pct, 2)}
+        if m:
+            out["mem_total_bytes"] = m.get("MemTotal", 0)
+            out["mem_available_bytes"] = m.get("MemAvailable", 0)
+        for p in self.disk_paths:
+            try:
+                st = os.statvfs(p)
+            except OSError:
+                continue
+            tag = p.strip("/").replace("/", "_") or "root"
+            out[f"disk_total_bytes_{tag}"] = st.f_frsize * st.f_blocks
+            out[f"disk_free_bytes_{tag}"] = st.f_frsize * st.f_bavail
+        return out
+
+    def collect_once(self) -> list[str]:
+        """One tick: gather forwarded metric lines + derived metrics as
+        line protocol; ship if a report client is configured."""
+        lines: list[str] = []
+        for t in self.metric_tails:
+            lines.extend(t.read_new())
+        ts = time.time_ns()
+        for t in self.error_tails:
+            new = [ln for ln in t.read_new()
+                   if "ERROR" in ln or "WARN" in ln]
+            if t.path in self.err_counts:
+                self.err_counts[t.path] += len(new)
+            else:
+                self.err_counts[t.path] = len(new)
+            base = os.path.basename(t.path).replace(" ", "_")
+            lines.append(
+                f"errLogTotal,hostname={self.hostname},log={base} "
+                f"total={self.err_counts[t.path]}i {ts}")
+        node = self.node_metrics()
+        fields = ",".join(
+            f"{k}={v}" + ("i" if isinstance(v, int) else "")
+            for k, v in sorted(node.items()))
+        lines.append(f"nodeMetrics,hostname={self.hostname} {fields} {ts}")
+        if self.client is not None and lines:
+            try:
+                self.client.write("\n".join(lines), self.report_db)
+                self.reported_lines += len(lines)
+            except ClientError as e:
+                log.warning("monitor report failed: %s", e)
+        return lines
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ts-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.collect_once()
+            except Exception:
+                log.exception("monitor tick failed")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ts-monitor",
+                                 description="monitoring agent")
+    ap.add_argument("--report-host", default="127.0.0.1")
+    ap.add_argument("--report-port", type=int, default=8086)
+    ap.add_argument("--report-db", default="monitor")
+    ap.add_argument("--metric-file", action="append", default=[])
+    ap.add_argument("--error-log", action="append", default=[])
+    ap.add_argument("--disk-path", action="append", default=[])
+    ap.add_argument("--interval", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    mon = TsMonitor(HttpClient(args.report_host, args.report_port),
+                    args.report_db, args.metric_file, args.error_log,
+                    args.disk_path, interval_s=args.interval)
+    mon.start()
+    print(f"ts-monitor reporting to {args.report_host}:{args.report_port} "
+          f"db={args.report_db} every {args.interval}s")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

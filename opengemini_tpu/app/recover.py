@@ -1,0 +1,46 @@
+"""ts-recover: restore a node's data directory from a backup set (role of
+reference app/ts-recover/recover/recover.go over lib/backup).
+
+Run: ``python -m opengemini_tpu.app.recover --backup <dir>
+--data <target-dir> [--verify-only]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..storage.backup import (BackupError, restore_backup, verify_backup)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ts-recover",
+                                 description="restore from backup")
+    ap.add_argument("--backup", required=True, help="backup set directory")
+    ap.add_argument("--data", help="target data directory")
+    ap.add_argument("--verify-only", action="store_true",
+                    help="check backup integrity, restore nothing")
+    args = ap.parse_args(argv)
+
+    problems = verify_backup(args.backup)
+    if problems:
+        for p in problems:
+            print(f"BAD: {p}", file=sys.stderr)
+        return 1
+    print(f"backup {args.backup}: integrity OK")
+    if args.verify_only:
+        return 0
+    if not args.data:
+        print("ERR: --data required to restore", file=sys.stderr)
+        return 2
+    try:
+        res = restore_backup(args.backup, args.data)
+    except BackupError as e:
+        print(f"ERR: {e}", file=sys.stderr)
+        return 1
+    print(f"restored {res['files']} files to {args.data}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

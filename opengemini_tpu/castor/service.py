@@ -31,6 +31,7 @@ class CastorService:
         self._clients: dict[str, object] = {}
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        self._local_models: dict[str, dict] = {}   # in-proc fallback cache
         self.tasks = 0
         self.failures = 0
 
@@ -64,11 +65,18 @@ class CastorService:
         """Returns (times, values, levels) of anomalous points."""
         times = np.asarray(times, dtype=np.int64)
         values = np.asarray(values, dtype=np.float64)
-        self.tasks += 1
+        with self._lock:
+            self.tasks += 1
         if not self.locations:
             model = None
             if task == "fit_detect":
                 model = algorithms.fit(times, values, algo, config)
+                if model_id:
+                    with self._lock:
+                        self._local_models[model_id] = model
+            elif model_id:
+                with self._lock:
+                    model = self._local_models.get(model_id)
             mask = algorithms.detect(times, values, algo, config, model)
             idx = np.nonzero(mask)[0]
             return times[idx], values[idx], np.ones(len(idx))
@@ -84,9 +92,14 @@ class CastorService:
             model_id: str | None = None) -> dict:
         times = np.asarray(times, dtype=np.int64)
         values = np.asarray(values, dtype=np.float64)
-        self.tasks += 1
+        with self._lock:
+            self.tasks += 1
         if not self.locations:
-            return algorithms.fit(times, values, algo, config)
+            model = algorithms.fit(times, values, algo, config)
+            if model_id:
+                with self._lock:
+                    self._local_models[model_id] = model
+            return model
         table = self._run_remote(times, values, algo, config, "fit",
                                  model_id)
         return json.loads(table.column("model")[0].as_py())
@@ -123,7 +136,12 @@ class CastorService:
                     self.failures += 1
                 log.warning("castor worker %s failed: %s", loc, e)
                 with self._lock:
-                    self._clients.pop(loc, None)
+                    dead = self._clients.pop(loc, None)
+                if dead is not None:
+                    try:
+                        dead.close()
+                    except Exception:
+                        pass
         raise GeminiError(f"all castor workers failed: {last_err}")
 
     def stats(self) -> dict[str, int]:

@@ -35,12 +35,14 @@ except Exception:                                    # pragma: no cover
 
 class CastorWorker((flight.FlightServerBase if HAVE_FLIGHT else object)):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 model_cache_size: int = 256):
+                 model_cache_size: int = 256,
+                 result_buffer_size: int = 4096):
         super().__init__(f"grpc://{host}:{port}")
         self.host = host
         self.results: dict[str, object] = {}
         self.models: dict[str, dict] = {}
-        self.model_cache_size = model_cache_size
+        self.model_cache_size = max(1, model_cache_size)
+        self.result_buffer_size = max(1, result_buffer_size)
         self.tasks_done = 0
         self._lock = threading.Lock()
         self._serve_thread: threading.Thread | None = None
@@ -63,7 +65,7 @@ class CastorWorker((flight.FlightServerBase if HAVE_FLIGHT else object)):
             # bound the result buffer: an orphaned result (client died
             # between DoPut and DoGet, or failed over to another worker)
             # must not leak its arrow table forever
-            while len(self.results) >= self.model_cache_size:
+            while len(self.results) >= self.result_buffer_size:
                 self.results.pop(next(iter(self.results)))
             self.results[cmd["id"]] = result
             self.tasks_done += 1

@@ -67,13 +67,15 @@ def batch_to_rows(batch, measurement: str,
             if pa.types.is_timestamp(col.type):
                 scale = {"s": 10**9, "ms": 10**6,
                          "us": 10**3, "ns": 1}[col.type.unit]
-            times = col.cast(pa.int64()).to_numpy(zero_copy_only=False)
-            if times.dtype != np.int64:          # nulls → float64 + NaN
+            t64 = col.cast(pa.int64())
+            if t64.null_count:
+                # fill nulls in arrow: going through float64 would round
+                # every ns timestamp in the batch to ~2^53 precision
+                import pyarrow.compute as pc
                 now = (recv_time_ns if recv_time_ns is not None
                        else time.time_ns())
-                times = np.where(np.isnan(times), now / scale,
-                                 times).astype(np.int64)
-            times = times * scale
+                t64 = pc.fill_null(t64, now // scale)
+            times = t64.to_numpy(zero_copy_only=False) * scale
             continue
         col_vals.append((name, col.to_pylist()))
 

@@ -1,0 +1,178 @@
+"""ts-cli / ts-recover / ts-monitor apps (reference app/ts-cli,
+app/ts-recover, app/ts-monitor)."""
+
+import io
+import json
+
+import pytest
+
+from opengemini_tpu.app.cli import Cli
+from opengemini_tpu.app.client import HttpClient
+from opengemini_tpu.app.monitor import TsMonitor, _Tail
+from opengemini_tpu.app.recover import main as recover_main
+from opengemini_tpu.http.server import HttpServer
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.storage.backup import create_backup
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def server(tmp_path):
+    eng = Engine(str(tmp_path / "store"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    yield srv, eng
+    srv.stop()
+    eng.close()
+
+
+def _cli(srv, **kw):
+    out = io.StringIO()
+    c = Cli(HttpClient(srv.host, srv.port), out=out, **kw)
+    return c, out
+
+
+class TestCli:
+    def test_ping_insert_query(self, server):
+        srv, _ = server
+        cli, out = _cli(srv, database="db0")
+        assert cli.client.ping()
+        cli.run_line("insert cpu,host=a usage=42 1000000000")
+        cli.run_line("SELECT usage FROM cpu")
+        text = out.getvalue()
+        assert "name: cpu" in text and "42" in text
+
+    def test_use_and_show(self, server):
+        srv, eng = server
+        eng.write_points("dbx", parse_lines("m v=1 1"))
+        cli, out = _cli(srv)
+        cli.run_line("use dbx")
+        cli.run_line("SHOW MEASUREMENTS")
+        assert "m" in out.getvalue()
+
+    def test_json_and_csv_formats(self, server):
+        srv, _ = server
+        cli, out = _cli(srv, database="db0")
+        cli.run_line("insert cpu,host=a usage=1 1000000000")
+        cli.run_line("format json")
+        cli.run_line("SELECT usage FROM cpu")
+        assert '"series"' in out.getvalue()
+        cli.run_line("format csv")
+        cli.run_line("SELECT usage FROM cpu")
+        assert "name,time,usage" in out.getvalue()
+
+    def test_query_error_rendered(self, server):
+        srv, _ = server
+        cli, out = _cli(srv, database="db0")
+        cli.run_line("SELECT bogus( FROM nothing")
+        assert "ERR" in out.getvalue()
+
+    def test_exit(self, server):
+        srv, _ = server
+        cli, _ = _cli(srv)
+        assert cli.run_line("exit") is False
+        assert cli.run_line("SELECT 1") is True  # errors don't end repl
+
+    def test_completer(self, server):
+        srv, _ = server
+        cli, _ = _cli(srv)
+        assert cli.completer("SEL", 0) == "SELECT"
+        assert cli.completer("zzz", 0) is None
+
+    def test_import_file(self, server, tmp_path):
+        srv, eng = server
+        f = tmp_path / "import.lp"
+        f.write_text("# comment line\n"
+                     "# CONTEXT-DATABASE: impdb\n"
+                     "cpu,host=a v=1 1000000000\n"
+                     "cpu,host=a v=2 2000000000\n"
+                     "\n"
+                     "cpu,host=b v=3 3000000000\n")
+        cli, out = _cli(srv)
+        n = cli.import_file(str(f), batch_size=2)
+        assert n == 3
+        assert "Imported 3 points" in out.getvalue()
+        assert "impdb" in eng.databases
+
+    def test_import_without_db_errors(self, server, tmp_path):
+        srv, _ = server
+        f = tmp_path / "x.lp"
+        f.write_text("cpu v=1 1\n")
+        cli, out = _cli(srv)
+        assert cli.import_file(str(f)) == 0
+        assert "ERR" in out.getvalue()
+
+
+class TestRecoverCli:
+    def test_verify_and_restore(self, tmp_path, capsys):
+        eng = Engine(str(tmp_path / "data"))
+        eng.write_points("db0", parse_lines("cpu v=1 1000000000"))
+        create_backup(eng, str(tmp_path / "bk"))
+        eng.close()
+
+        assert recover_main(["--backup", str(tmp_path / "bk"),
+                             "--verify-only"]) == 0
+        assert recover_main(["--backup", str(tmp_path / "bk"),
+                             "--data", str(tmp_path / "restored")]) == 0
+        eng2 = Engine(str(tmp_path / "restored"))
+        assert "db0" in eng2.databases
+        eng2.close()
+
+    def test_corrupt_backup_fails(self, tmp_path, capsys):
+        eng = Engine(str(tmp_path / "data"))
+        eng.write_points("db0", parse_lines("cpu v=1 1000000000"))
+        create_backup(eng, str(tmp_path / "bk"))
+        eng.close()
+        man = json.loads((tmp_path / "bk" / "manifest.json").read_text())
+        rel = next(iter(man["files"]))
+        (tmp_path / "bk" / "data" / rel).write_bytes(b"corrupt")
+        assert recover_main(["--backup", str(tmp_path / "bk"),
+                             "--verify-only"]) == 1
+
+
+class TestMonitor:
+    def test_tail_rotation(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("a\nb\n")
+        t = _Tail(str(p), from_start=True)
+        assert t.read_new() == ["a", "b"]
+        assert t.read_new() == []
+        with open(p, "a") as f:
+            f.write("c\npartial")
+        assert t.read_new() == ["c"]
+        p.write_text("new\n")          # shrink → rotation detected
+        assert t.read_new() == ["new"]
+
+    def test_collect_forwards_and_counts(self, tmp_path):
+        metrics = tmp_path / "stats.lp"
+        metrics.write_text("old history=1i 1\n")   # pre-attach: not re-shipped
+        errlog = tmp_path / "err.log"
+        errlog.touch()
+        mon = TsMonitor(None, metric_files=[str(metrics)],
+                        error_logs=[str(errlog)],
+                        disk_paths=[str(tmp_path)], hostname="n1")
+        with open(metrics, "a") as f:
+            f.write("engine shards=3i 100\n")
+        with open(errlog, "a") as f:
+            f.write("2026 INFO ok\n2026 ERROR boom\n")
+        lines = mon.collect_once()
+        assert not any(ln.startswith("old ") for ln in lines)
+        assert "engine shards=3i 100" in lines
+        assert any(ln.startswith("errLogTotal,hostname=n1")
+                   and "total=1i" in ln for ln in lines)
+        node = [ln for ln in lines if ln.startswith("nodeMetrics")]
+        assert node and "cpu_pct=" in node[0]
+        assert "disk_total_bytes" in node[0]
+
+    def test_monitor_reports_to_server(self, server, tmp_path):
+        srv, eng = server
+        metrics = tmp_path / "stats.lp"
+        metrics.touch()
+        mon = TsMonitor(HttpClient(srv.host, srv.port), "monitor",
+                        metric_files=[str(metrics)], hostname="n1")
+        with open(metrics, "a") as f:
+            f.write("svcmetric up=1i 1000000000\n")
+        mon.collect_once()
+        assert mon.reported_lines >= 2
+        assert "monitor" in eng.databases
+        assert "svcmetric" in eng.measurements("monitor")
