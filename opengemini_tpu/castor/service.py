@@ -135,13 +135,10 @@ class CastorService:
                 with self._lock:
                     self.failures += 1
                 log.warning("castor worker %s failed: %s", loc, e)
+                # pop but do NOT close: another thread may be mid-call on
+                # the shared client; the dropped reference closes on GC
                 with self._lock:
-                    dead = self._clients.pop(loc, None)
-                if dead is not None:
-                    try:
-                        dead.close()
-                    except Exception:
-                        pass
+                    self._clients.pop(loc, None)
         raise GeminiError(f"all castor workers failed: {last_err}")
 
     def stats(self) -> dict[str, int]:

@@ -5,5 +5,6 @@ from .compaction import CompactionService
 from .continuous_query import ContinuousQueryService
 from .stream import StreamEngine
 from .subscriber import SubscriberService
+from .hierarchical import HierarchicalStorageService
 from .sherlock import Sherlock, SherlockConfig
 from .iodetector import IODetector

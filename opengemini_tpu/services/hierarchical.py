@@ -1,0 +1,64 @@
+"""Hierarchical storage service (role of reference
+services/hierarchical/service.go:75-139: moves warm shards whose time
+range has aged past the policy to the cold object-storage tier; queries
+keep working through detached reads).
+
+A shard is eligible when its whole time range ended more than
+``cold_after_ns`` ago (so it no longer takes writes) and it still has
+local TSSP files. Memtables are flushed first so the move is complete.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils import get_logger
+from .base import Service
+
+log = get_logger(__name__)
+
+
+class HierarchicalStorageService(Service):
+    name = "hierarchical"
+
+    def __init__(self, engine, store, cold_after_ns: int,
+                 interval_s: float = 3600.0, now_ns=None):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.store = store
+        self.cold_after_ns = cold_after_ns
+        self.now_ns = now_ns or time.time_ns
+        self.files_moved = 0
+        self.shards_moved = 0
+
+    def run_once(self) -> dict:
+        cutoff = self.now_ns() - self.cold_after_ns
+        moved_files = moved_shards = 0
+        for db_name in list(self.engine.databases):
+            try:
+                db = self.engine.database(db_name)
+            except KeyError:
+                continue
+            for gi, shard in list(db.shards.items()):
+                if shard.end_time > cutoff:
+                    continue            # still warm
+                try:
+                    shard.flush()
+                    n = shard.detach_files(
+                        self.store, f"{db_name}/shard_{gi}")
+                except Exception:
+                    log.exception("hierarchical move of %s/shard_%s "
+                                  "failed", db_name, gi)
+                    continue
+                if n:
+                    moved_files += n
+                    moved_shards += 1
+                    log.info("moved %s/shard_%s to cold tier (%d files)",
+                             db_name, gi, n)
+        self.files_moved += moved_files
+        self.shards_moved += moved_shards
+        return {"files": moved_files, "shards": moved_shards}
+
+    def stats(self) -> dict[str, int]:
+        return {"files_moved": self.files_moved,
+                "shards_moved": self.shards_moved}

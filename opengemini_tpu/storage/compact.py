@@ -97,8 +97,22 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
             shard._files[mst] = new_list
         # unlink but do NOT close: in-flight queries may still hold these
         # readers (POSIX keeps the mapped data alive after unlink); the
-        # mmap closes when the last reference drops (TSSPReader.__del__)
+        # mmap closes when the last reference drops (TSSPReader.__del__).
+        # Detached inputs: drop the marker AND the object-store copy, or a
+        # restart would resurrect the pre-merge data through the stale
+        # marker.
         for r in readers:
+            if r.detached:
+                try:
+                    os.unlink(r.path + ".detached")
+                except OSError:
+                    pass
+                try:
+                    r._mm.store.delete(r._mm.key)
+                except Exception as e:
+                    log.error("merge_and_swap: failed to delete cold "
+                              "object for %s: %s", r.path, e)
+                continue
             try:
                 os.unlink(r.path)
             except OSError as e:
@@ -107,12 +121,21 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
         return out_path if new_reader is not None else None
 
 
-def file_level(path: str) -> int:
-    sz = os.path.getsize(path)
+def size_level(sz: int) -> int:
     lvl = 0
     while sz >= BASE_SIZE << (lvl + 1) and lvl < MAX_LEVEL:
         lvl += 1
     return lvl
+
+
+def file_level(path: str) -> int:
+    return size_level(os.path.getsize(path))
+
+
+def reader_level(r: TSSPReader) -> int:
+    """Level from the reader's view size — works for local mmaps and
+    detached object-store sources alike (the local path is gone)."""
+    return size_level(len(r._mm))
 
 
 class Compactor:
@@ -133,7 +156,7 @@ class Compactor:
             for mst, readers in self.shard._files.items():
                 if len(readers) < self.fanout:
                     continue
-                levels = [file_level(r.path) for r in readers]
+                levels = [reader_level(r) for r in readers]
                 best: list[TSSPReader] = []
                 i = 0
                 while i < len(readers):

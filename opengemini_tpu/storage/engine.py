@@ -38,6 +38,7 @@ class EngineOptions:
     wal_sync: bool = False
     wal_compression: str = "zstd"     # "zstd" | "lz4" (native codec)
     segment_size: int = SEGMENT_SIZE
+    obs_store: object | None = None   # hierarchical cold tier (obs.py)
 
 
 class Database:
@@ -103,7 +104,8 @@ class Database:
                      wal_sync=self.opts.wal_sync,
                      wal_compression=self.opts.wal_compression,
                      segment_size=self.opts.segment_size,
-                     cs_options=self.cs_options)
+                     cs_options=self.cs_options,
+                     obs_store=self.opts.obs_store)
 
     def shard_for_time(self, t: int, create: bool = True) -> Shard | None:
         gi = t // self.opts.shard_duration

@@ -1,0 +1,149 @@
+"""Object-storage tier (role of reference lib/obs/obs_options.go +
+lib/fileops/obs_fs.go: an OBS/S3-style store mounted as a filesystem, and
+engine/immutable/detached_*.go: TSSP files queried "detached" — metadata
+and data fetched lazily by byte range instead of a local mmap).
+
+``ObjectStore`` is the provider interface; ``LocalObjectStore`` is the
+bundled directory-backed implementation (the test/on-prem emulation —
+a real S3/OBS client plugs in by implementing the same five methods).
+``DetachedSource`` adapts a stored object to the byte-slice protocol the
+TSSP reader uses, with block-aligned range fetches and a small LRU so
+meta/bloom/trailer reads don't re-fetch per access.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from collections import OrderedDict
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_BLOCK = 256 * 1024
+
+
+class ObjectStore:
+    """Minimal blob-store interface (put/get_range/size/delete/list)."""
+
+    def put_file(self, key: str, path: str) -> None:
+        raise NotImplementedError
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def size(self, key: str) -> int:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-backed object store. Keys are '/'-separated; objects are
+    immutable once put (TSSP files are immutable, so overwrite = error)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key))
+        if not p.startswith(os.path.abspath(self.root) + os.sep) \
+                and p != os.path.abspath(self.root):
+            p2 = os.path.abspath(p)
+            if not p2.startswith(os.path.abspath(self.root) + os.sep):
+                raise ValueError(f"key escapes store root: {key}")
+        return p
+
+    def put_file(self, key: str, path: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".uploading"
+        shutil.copy2(path, tmp)
+        os.replace(tmp, dst)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        with open(self._path(key), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def size(self, key: str) -> int:
+        return os.path.getsize(self._path(key))
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> list[str]:
+        out = []
+        for r, _d, files in os.walk(self.root):
+            for f in files:
+                key = os.path.relpath(os.path.join(r, f), self.root)
+                key = key.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+
+class DetachedSource:
+    """Byte-slice view over a stored object (the lazy-load half of
+    detached_lazy_load_index_reader.go): ``src[a:b]`` fetches only the
+    blocks covering [a, b), caching them in a per-source LRU."""
+
+    def __init__(self, store: ObjectStore, key: str,
+                 block_size: int = DEFAULT_BLOCK, cache_blocks: int = 64):
+        self.store = store
+        self.key = key
+        self.block_size = block_size
+        self.cache_blocks = cache_blocks
+        self._len = store.size(key)
+        self._cache: OrderedDict[int, bytes] = OrderedDict()
+        self._lock = threading.Lock()
+        self.closed = False
+        self.fetches = 0           # range GETs issued (ops visibility)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _block(self, bi: int) -> bytes:
+        with self._lock:
+            b = self._cache.get(bi)
+            if b is not None:
+                self._cache.move_to_end(bi)
+                return b
+        off = bi * self.block_size
+        data = self.store.get_range(self.key, off,
+                                    min(self.block_size, self._len - off))
+        with self._lock:
+            self.fetches += 1
+            self._cache[bi] = data
+            while len(self._cache) > self.cache_blocks:
+                self._cache.popitem(last=False)
+        return data
+
+    def __getitem__(self, sl: slice) -> bytes:
+        start, stop, step = sl.indices(self._len)
+        if step != 1 or stop <= start:
+            return b""
+        bs = self.block_size
+        first, last = start // bs, (stop - 1) // bs
+        parts = []
+        for bi in range(first, last + 1):
+            blk = self._block(bi)
+            lo = start - bi * bs if bi == first else 0
+            hi = stop - bi * bs if bi == last else len(blk)
+            parts.append(blk[lo:hi])
+        return b"".join(parts)
+
+    def close(self) -> None:
+        self.closed = True
+        with self._lock:
+            self._cache.clear()

@@ -37,7 +37,8 @@ class Shard:
                  wal_sync: bool = False,
                  wal_compression: str = "zstd",
                  segment_size: int = SEGMENT_SIZE,
-                 cs_options: dict | None = None):
+                 cs_options: dict | None = None,
+                 obs_store=None):
         self.path = path
         self.shard_id = shard_id
         self.start_time = start_time
@@ -49,6 +50,9 @@ class Shard:
         # (reference: column-store measurements declared in ts-meta,
         # engine-type dispatch cs_storage.go:42)
         self.cs_options = cs_options if cs_options is not None else {}
+        # object-store tier for detached (cold) TSSP files (reference
+        # hierarchical storage + detached OBS reads, SURVEY §2.1/§2.7)
+        self.obs_store = obs_store
         os.makedirs(path, exist_ok=True)
         os.makedirs(os.path.join(path, "tssp"), exist_ok=True)
         os.makedirs(os.path.join(path, "colstore"), exist_ok=True)
@@ -121,6 +125,28 @@ class Shard:
         import struct as _struct
         d = os.path.join(self.path, "tssp")
         for fn in sorted(os.listdir(d)):
+            if fn.endswith(".tssp.detached"):
+                # cold file living in the object store (hierarchical tier)
+                import json as _json
+                base = fn[:-len(".detached")]
+                mst, seq = base[:-5].rsplit("_", 1)
+                self._file_seq = max(self._file_seq, int(seq))
+                if self.obs_store is None:
+                    log.error("detached file %s but no object store "
+                              "configured; data unavailable", base)
+                    continue
+                try:
+                    with open(os.path.join(d, fn)) as mf:
+                        key = _json.load(mf)["key"]
+                    from .obs import DetachedSource
+                    self._files.setdefault(mst, []).append(
+                        TSSPReader(os.path.join(d, base),
+                                   source=DetachedSource(self.obs_store,
+                                                         key)))
+                except (ValueError, KeyError, OSError,
+                        _struct.error) as e:
+                    log.error("skipping detached tssp %s: %s", fn, e)
+                continue
             if not fn.endswith(".tssp"):
                 continue
             mst, seq = fn[:-5].rsplit("_", 1)
@@ -282,6 +308,60 @@ class Shard:
             except Exception:
                 self.mem.abort_snapshot()
                 raise
+
+    # ---- hierarchical tier ----------------------------------------------
+
+    def detach_files(self, store, key_prefix: str) -> int:
+        """Move this shard's TSSP files to the object store (warm→cold:
+        reference services/hierarchical/service.go:75-139 + detached
+        reads): upload, persist a .detached marker, reopen the reader
+        through a DetachedSource, drop the local copy. Returns the number
+        of files moved. Readcache entries stay valid: the cache keys on
+        (path, offset) and the bytes are identical."""
+        import json as _json
+        from .obs import DetachedSource
+        with self._lock:
+            self.obs_store = store
+            snapshot = [(mst, r) for mst, rs in self._files.items()
+                        for r in rs if not r.detached]
+        moved = 0
+        for mst, r in snapshot:
+            fn = os.path.basename(r.path)
+            key = f"{key_prefix}/{fn}"
+            try:
+                # slow upload runs outside the locks: reads and writes
+                # must not stall behind object-store I/O
+                store.put_file(key, r.path)
+            except FileNotFoundError:
+                continue       # compacted away mid-pass; data lives on
+            with self.table_lock, self._lock:
+                readers = self._files.get(mst, [])
+                idx = next((i for i, x in enumerate(readers) if x is r),
+                           None)
+                if idx is None:           # replaced since the snapshot
+                    store.delete(key)
+                    continue
+                marker = r.path + ".detached"
+                tmp = marker + ".tmp"
+                with open(tmp, "w") as f:
+                    _json.dump({"key": key}, f)
+                os.replace(tmp, marker)
+                readers[idx] = TSSPReader(
+                    r.path, source=DetachedSource(store, key))
+                try:
+                    os.unlink(r.path)
+                except OSError:
+                    pass
+                # do NOT close r: in-flight queries may still hold it
+                # (same deferred-close convention as merge_and_swap)
+                moved += 1
+        return moved
+
+    @property
+    def detached_file_count(self) -> int:
+        with self._lock:
+            return sum(1 for rs in self._files.values()
+                       for r in rs if r.detached)
 
     # ---- reads -----------------------------------------------------------
 

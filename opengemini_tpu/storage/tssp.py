@@ -316,39 +316,52 @@ class TSSPReader:
     """mmap-backed reader with lazy chunk-meta decode via the meta index
     (analogs: immutable/reader.go, file_iterator.go, location_cursor.go)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, source=None):
+        """path: local file (mmap) — or, with ``source`` (a byte-slice
+        provider, e.g. obs.DetachedSource), a detached object-store read
+        path (reference detached_lazy_load_index_reader.go); ``path`` is
+        then only the cache identity."""
         self.path = path
-        self._file = open(path, "rb")
-        self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        self.detached = source is not None
+        if source is None:
+            self._file = open(path, "rb")
+            self._mm = mmap.mmap(self._file.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        else:
+            self._file = None
+            self._mm = source
         mm = self._mm
         if len(mm) < 16:
             raise ValueError(f"{path}: truncated TSSP file")
-        magic, version = struct.unpack_from("<II", mm, 0)
-        tsize, tail_magic = struct.unpack_from("<II", mm, len(mm) - 8)
+        magic, version = struct.unpack("<II", mm[0:8])
+        tsize, tail_magic = struct.unpack("<II", mm[len(mm) - 8:len(mm)])
         if magic != MAGIC or tail_magic != MAGIC:
             raise ValueError(f"{path}: bad TSSP magic")
         if version != VERSION:
             raise ValueError(f"{path}: unsupported version {version}")
-        tr = struct.unpack_from(_TRAILER_FMT, mm, len(mm) - 8 - tsize)
+        tr = struct.unpack(_TRAILER_FMT,
+                           mm[len(mm) - 8 - tsize:len(mm) - 8])
         (self.data_end, self.meta_off, self.meta_size, self.idx_off,
          self.idx_size, self.bloom_off, self.bloom_size,
          self.min_time, self.max_time, self.series_count) = tr
         # copy (not view) so the mmap can close while the bloom lives on
         self.bloom = SeriesBloom(np.frombuffer(
-            mm, dtype=np.uint8, count=self.bloom_size,
-            offset=self.bloom_off).copy())
-        # meta index
-        (n_groups,) = struct.unpack_from("<I", mm, self.idx_off)
-        pos = self.idx_off + 4
+            mm[self.bloom_off:self.bloom_off + self.bloom_size],
+            dtype=np.uint8).copy())
+        # meta index (one fetch: contiguous section)
+        idx_blob = mm[self.idx_off:self.idx_off + self.idx_size]
+        (n_groups,) = struct.unpack_from("<I", idx_blob, 0)
+        pos = 4
         self._index = []
         for _ in range(n_groups):
-            self._index.append(struct.unpack_from("<QQQII", mm, pos))
+            self._index.append(struct.unpack_from("<QQQII", idx_blob, pos))
             pos += struct.calcsize("<QQQII")
         self._meta_cache: dict[int, dict[int, ChunkMeta]] = {}
 
     def close(self) -> None:
         self._mm.close()
-        self._file.close()
+        if self._file is not None:
+            self._file.close()
 
     def __del__(self):  # deferred close for compacted-away files
         try:

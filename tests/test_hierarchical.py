@@ -1,0 +1,163 @@
+"""Hierarchical storage + detached OBS reads (reference
+services/hierarchical, lib/obs, engine/immutable/detached_*)."""
+
+import os
+
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.services import HierarchicalStorageService
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.storage.engine import EngineOptions
+from opengemini_tpu.storage.obs import DetachedSource, LocalObjectStore
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+HOUR = 3600 * 10**9
+
+
+def _q(eng, text, db="db0"):
+    (stmt,) = parse_query(text)
+    return QueryExecutor(eng).execute(stmt, db)
+
+
+class TestLocalObjectStore:
+    def test_put_get_roundtrip(self, tmp_path):
+        store = LocalObjectStore(str(tmp_path / "obs"))
+        src = tmp_path / "f.bin"
+        src.write_bytes(b"0123456789")
+        store.put_file("a/b/f.bin", str(src))
+        assert store.size("a/b/f.bin") == 10
+        assert store.get_range("a/b/f.bin", 2, 4) == b"2345"
+        assert store.list("a/") == ["a/b/f.bin"]
+        store.delete("a/b/f.bin")
+        assert store.list() == []
+
+    def test_key_escape_rejected(self, tmp_path):
+        store = LocalObjectStore(str(tmp_path / "obs"))
+        with pytest.raises(ValueError):
+            store.get_range("../../etc/passwd", 0, 10)
+
+
+class TestDetachedSource:
+    def test_range_reads_and_cache(self, tmp_path):
+        store = LocalObjectStore(str(tmp_path / "obs"))
+        src = tmp_path / "f.bin"
+        payload = bytes(range(256)) * 64        # 16 KiB
+        src.write_bytes(payload)
+        store.put_file("f", str(src))
+        ds = DetachedSource(store, "f", block_size=1024)
+        assert ds[0:10] == payload[0:10]
+        assert ds[1000:1100] == payload[1000:1100]   # crosses blocks
+        assert ds[-8:len(ds)] == payload[-8:]
+        fetches = ds.fetches
+        assert ds[0:10] == payload[0:10]             # cached
+        assert ds.fetches == fetches
+        assert len(ds) == len(payload)
+
+
+@pytest.fixture
+def cold_engine(tmp_path):
+    """Engine with data in an old shard + a recent shard."""
+    store = LocalObjectStore(str(tmp_path / "obs"))
+    opts = EngineOptions(shard_duration=24 * HOUR, obs_store=store)
+    eng = Engine(str(tmp_path / "data"), opts)
+    old = ["cpu,host=h%d usage=%d %d" % (i % 3, i, i * 10**9)
+           for i in range(100)]                      # t≈0 → old shard
+    now = 100 * 24 * HOUR
+    new = ["cpu,host=h0 usage=5 %d" % (now + i * 10**9) for i in range(10)]
+    eng.write_points("db0", parse_lines("\n".join(old + new)))
+    eng.flush_all()
+    yield eng, store, now, tmp_path
+    eng.close()
+
+
+class TestHierarchical:
+    def test_cold_shard_moves_and_queries(self, cold_engine):
+        eng, store, now, tmp_path = cold_engine
+        before = _q(eng, "SELECT sum(usage), count(usage) FROM cpu")
+        svc = HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now)
+        res = svc.run_once()
+        assert res["shards"] == 1 and res["files"] >= 1
+        # local tssp files for the old shard are gone; marker remains
+        old_shard = eng.database("db0").shards[0]
+        tdir = os.path.join(old_shard.path, "tssp")
+        assert not [f for f in os.listdir(tdir) if f.endswith(".tssp")]
+        assert [f for f in os.listdir(tdir) if f.endswith(".detached")]
+        assert store.list("db0/")
+        # queries read through the detached source, identical results
+        after = _q(eng, "SELECT sum(usage), count(usage) FROM cpu")
+        assert after == before
+
+    def test_warm_shard_untouched(self, cold_engine):
+        eng, store, now, _ = cold_engine
+        svc = HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now)
+        svc.run_once()
+        recent = eng.database("db0").shards[100]
+        assert recent.detached_file_count == 0
+
+    def test_idempotent(self, cold_engine):
+        eng, store, now, _ = cold_engine
+        svc = HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now)
+        assert svc.run_once()["files"] >= 1
+        assert svc.run_once() == {"files": 0, "shards": 0}
+
+    def test_reopen_loads_detached(self, cold_engine):
+        eng, store, now, tmp_path = cold_engine
+        before = _q(eng, "SELECT sum(usage), count(usage) FROM cpu")
+        svc = HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now)
+        svc.run_once()
+        eng.close()
+        opts = EngineOptions(shard_duration=24 * HOUR, obs_store=store)
+        eng2 = Engine(str(tmp_path / "data"), opts)
+        after = _q(eng2, "SELECT sum(usage), count(usage) FROM cpu")
+        assert after == before
+        assert eng2.database("db0").shards[0].detached_file_count >= 1
+        eng2.close()
+
+    def test_merge_over_detached_cleans_cold_object(self, cold_engine):
+        """merge_and_swap over detached inputs must remove the marker and
+        the object-store copy (or restart resurrects pre-merge data)."""
+        from opengemini_tpu.storage.compact import merge_and_swap
+        eng, store, now, tmp_path = cold_engine
+        before = _q(eng, "SELECT sum(usage), count(usage) FROM cpu")
+        HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now).run_once()
+        shard = eng.database("db0").shards[0]
+        readers = list(shard._files["cpu"])
+        assert all(r.detached for r in readers)
+        out = merge_and_swap(shard, "cpu", readers)
+        assert out is not None
+        tdir = os.path.join(shard.path, "tssp")
+        assert not [f for f in os.listdir(tdir)
+                    if f.endswith(".detached")]
+        assert store.list("db0/shard_0/") == []
+        assert _q(eng, "SELECT sum(usage), count(usage) FROM cpu") \
+            == before
+        # reload: no stale markers, data intact
+        eng.close()
+        eng2 = Engine(str(tmp_path / "data"),
+                      EngineOptions(shard_duration=24 * HOUR,
+                                    obs_store=store))
+        assert _q(eng2, "SELECT sum(usage), count(usage) FROM cpu") \
+            == before
+        eng2.close()
+
+    def test_group_by_over_detached(self, cold_engine):
+        eng, store, now, _ = cold_engine
+        before = _q(eng, "SELECT mean(usage) FROM cpu "
+                         "GROUP BY host, time(20s)")
+        HierarchicalStorageService(
+            eng, store, cold_after_ns=30 * 24 * HOUR,
+            interval_s=10**6, now_ns=lambda: now).run_once()
+        after = _q(eng, "SELECT mean(usage) FROM cpu "
+                        "GROUP BY host, time(20s)")
+        assert after == before
