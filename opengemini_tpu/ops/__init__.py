@@ -18,3 +18,4 @@ jax.config.update("jax_enable_x64", True)
 from .segment_agg import (  # noqa: E402
     AggSpec, SegmentAggResult, segment_aggregate, window_ids,
     dense_window_aggregate, pad_bucket)
+from .ogsketch import OGSketch  # noqa: E402

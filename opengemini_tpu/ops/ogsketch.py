@@ -1,0 +1,343 @@
+"""OGSketch — mergeable quantile sketch for approximate percentiles.
+
+Role of the reference's `engine/executor/ogsketch.go` (NewOGSketchImpl :125,
+processInsert :270, Percentile :188, Rank :213, delete path :323-430,
+EquiHeightHistogram :446, DemarcationHistogram :490): a t-digest-style
+centroid sketch on an arcsin scale function, supporting batch insert,
+sketch merge (the distributed partial-agg combine), decremental delete
+(sliding windows), interpolated percentile/rank, and the two histogram
+modes the SQL surface exposes.
+
+Design differences from the reference (which is pointer/sort.Sort based):
+centroids live in flat numpy arrays; inserts buffer in a list and compress
+via one vectorized sort + a bounded greedy merge pass (the merge loop is
+inherently sequential — the q-limit advances at cluster boundaries — but
+runs over at most sketch_size + buffer_size ≈ 10·c centroids, so it is
+O(c) per compression and amortized O(1) per point).
+
+The sketch is the partial state for `percentile_approx(field, p[, c])`:
+store nodes build per-(group, window) sketches (ogsketch_insert), the sql
+node merges them (ogsketch_merge) and finalizes with Percentile
+(ogsketch_percentile) — the three-phase split named in the reference's
+call_processor.go:37-41.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+DEFAULT_CLUSTERS = 100.0
+
+
+class OGSketch:
+    """Arcsin-scale centroid sketch. `clusters` bounds the compressed
+    sketch size (larger → more accurate, linearly more state)."""
+
+    __slots__ = ("c", "sketch_size", "buffer_size", "means", "weights",
+                 "all_weight", "delete_weight", "min_value", "max_value",
+                 "_buf_m", "_buf_w", "_acc", "_del")
+
+    def __init__(self, clusters: float = DEFAULT_CLUSTERS):
+        self.c = max(float(clusters), 1.0)
+        self.sketch_size = int(2 * math.ceil(self.c))
+        self.buffer_size = int(8 * math.ceil(self.c))
+        self.means = np.empty(0, dtype=np.float64)
+        self.weights = np.empty(0, dtype=np.float64)
+        self.all_weight = 0.0
+        self.delete_weight = 0.0
+        self.min_value = math.inf
+        self.max_value = -math.inf
+        self._buf_m: list = []
+        self._buf_w: list = []
+        self._acc: np.ndarray | None = None
+        self._del: dict[float, float] = {}
+
+    # ------------------------------------------------------------ insert
+
+    def insert(self, values, weights=None) -> None:
+        """Batch insert points (weights default 1). NaN values and
+        non-positive/NaN/inf weights are dropped, as in the reference."""
+        v = np.atleast_1d(np.asarray(values, dtype=np.float64))
+        if weights is None:
+            w = np.ones_like(v)
+        else:
+            w = np.broadcast_to(
+                np.asarray(weights, dtype=np.float64), v.shape)
+        keep = ~np.isnan(v) & (w > 0) & np.isfinite(w)
+        if not keep.all():
+            v, w = v[keep], w[keep]
+        if v.size == 0:
+            return
+        self.all_weight += float(w.sum())
+        self._buf_m.append(v)
+        self._buf_w.append(w)
+        if sum(b.size for b in self._buf_m) > self.buffer_size:
+            self._compress()
+
+    # ---------------------------------------------------------- compress
+
+    def _ruler(self, q: float) -> float:
+        return self.c * (math.asin(2.0 * q - 1.0) + math.pi / 2.0) / math.pi
+
+    def _reverse_ruler(self, k: float) -> float:
+        return (math.sin(min(k, self.c) * math.pi / self.c - math.pi / 2.0)
+                + 1.0) / 2.0
+
+    def _compress(self) -> None:
+        if not self._buf_m and len(self.means) <= self.sketch_size:
+            return
+        m = np.concatenate([self.means] + self._buf_m)
+        w = np.concatenate([self.weights] + self._buf_w)
+        self._buf_m, self._buf_w = [], []
+        order = np.argsort(m, kind="stable")
+        m, w = m[order], w[order]
+        if m.size == 0:
+            return
+        self.min_value = min(self.min_value, float(m[0]))
+        self.max_value = max(self.max_value, float(m[-1]))
+        if m.size < self.sketch_size:
+            self.means, self.weights = m, w
+            self._acc = None
+            return
+        # greedy scale-bounded merge (reference processInsert step2)
+        out_m = np.empty(m.size, dtype=np.float64)
+        out_w = np.empty(m.size, dtype=np.float64)
+        n_out = 0
+        total = self.all_weight
+        q0 = 0.0
+        qlimit = self._reverse_ruler(self._ruler(q0) + 1.0)
+        cur_m, cur_w = float(m[0]), float(w[0])
+        for i in range(1, m.size):
+            q = q0 + (cur_w + w[i]) / total
+            if q <= qlimit:
+                cur_m = (cur_m * cur_w + m[i] * w[i]) / (cur_w + w[i])
+                cur_w += w[i]
+            else:
+                out_m[n_out], out_w[n_out] = cur_m, cur_w
+                n_out += 1
+                q0 += cur_w / total
+                qlimit = self._reverse_ruler(self._ruler(q0) + 1.0)
+                cur_m, cur_w = float(m[i]), float(w[i])
+        out_m[n_out], out_w[n_out] = cur_m, cur_w
+        n_out += 1
+        self.means = out_m[:n_out].copy()
+        self.weights = out_w[:n_out].copy()
+        self._acc = None
+
+    def _settle(self) -> None:
+        self._compress()
+        self._process_delete()
+        if self._acc is None and len(self.means):
+            # accumulative half-weight midpoints (updateAccumulativeSum)
+            w = self.weights
+            acc = np.empty(len(w), dtype=np.float64)
+            acc[0] = w[0] / 2
+            if len(w) > 1:
+                acc[1:] = (w[1:] + w[:-1]) / 2
+                np.cumsum(acc, out=acc)
+            self._acc = acc
+
+    # ------------------------------------------------------------ delete
+
+    def delete(self, values, weights=None) -> None:
+        """Decremental delete (sliding-window support): deletions buffer
+        and are applied by carving weight out of the nearest centroids."""
+        v = np.atleast_1d(np.asarray(values, dtype=np.float64))
+        if weights is None:
+            w = np.ones_like(v)
+        else:
+            w = np.broadcast_to(
+                np.asarray(weights, dtype=np.float64), v.shape)
+        for m, ww in zip(v, w):
+            if np.isnan(m) or ww <= 0:
+                continue
+            self._del[float(m)] = self._del.get(float(m), 0.0) + float(ww)
+            self.delete_weight += float(ww)
+        if self.delete_weight >= self.all_weight:
+            self.reset()
+            return
+        if self.delete_weight > self.all_weight / 2:
+            self._compress()
+            self._process_delete()
+
+    def _process_delete(self) -> None:
+        if not self._del:
+            return
+        for key, val in self._del.items():
+            if not len(self.means):
+                break
+            if key <= self.means[0]:
+                self._delete_from(0, val, forward=True)
+            elif key >= self.means[-1]:
+                self._delete_from(len(self.means) - 1, val, forward=False)
+            else:
+                self._delete_between(key, val)
+        self.all_weight = max(self.all_weight - self.delete_weight, 0.0)
+        self.delete_weight = 0.0
+        self._del = {}
+        keep = self.weights > 0
+        self.means, self.weights = self.means[keep], self.weights[keep]
+        if len(self.means) == 0:
+            self.reset()
+        self._acc = None
+
+    def _delete_from(self, loc: int, val: float, forward: bool) -> float:
+        step = 1 if forward else -1
+        while 0 <= loc < len(self.weights) and val > 0:
+            if self.weights[loc] > val:
+                self.weights[loc] -= val
+                return 0.0
+            val -= float(self.weights[loc])
+            self.weights[loc] = 0.0
+            loc += step
+        return val
+
+    def _delete_between(self, key: float, val: float) -> None:
+        locr = int(np.searchsorted(self.means, key, side="left"))
+        locl = locr - 1
+        span = self.means[locr] - self.means[locl]
+        wr = val * (key - self.means[locl]) / span
+        wl = val * (self.means[locr] - key) / span
+        wl = self._delete_from(locl, wl, forward=False)
+        wr = self._delete_from(locr, wr, forward=True)
+        if wl > 0:
+            self._delete_from(locr, wl, forward=True)
+        if wr > 0:
+            self._delete_from(locl, wr, forward=False)
+
+    # ------------------------------------------------------------- merge
+
+    def merge(self, other: "OGSketch") -> None:
+        other._settle()
+        if other.all_weight <= 0:
+            return
+        self._buf_m.append(other.means.copy())
+        self._buf_w.append(other.weights.copy())
+        self.all_weight += other.all_weight
+        self.min_value = min(self.min_value, other.min_value)
+        self.max_value = max(self.max_value, other.max_value)
+        self._compress()
+
+    # ----------------------------------------------------------- queries
+
+    def __len__(self) -> int:
+        return len(self.means) + sum(b.size for b in self._buf_m)
+
+    def percentile(self, q: float) -> float:
+        """Interpolated quantile, q in [0, 1] (reference Percentile :188):
+        linear between min_value, centroid midpoints, and max_value."""
+        self._settle()
+        n = len(self.means)
+        if n == 0 or q < 0 or q > 1 or self.all_weight <= 0:
+            return math.nan
+        rank = q * self.all_weight
+        first_half = self.weights[0] / 2
+        last_half = self.weights[-1] / 2
+        if rank < first_half:
+            return self.min_value + rank / first_half * (
+                self.means[0] - self.min_value)
+        if rank >= self.all_weight - last_half:
+            return self.max_value - (self.all_weight - rank) / last_half * (
+                self.max_value - self.means[-1])
+        idx = int(np.searchsorted(self._acc, rank, side="right"))
+        idx = min(max(idx, 1), n - 1)
+        return float(self.means[idx - 1]
+                     + 2 * (rank - self._acc[idx - 1])
+                     / (self.weights[idx - 1] + self.weights[idx])
+                     * (self.means[idx] - self.means[idx - 1]))
+
+    def rank(self, x: float) -> int:
+        """Approximate count of points ≤ x (reference Rank :213)."""
+        self._settle()
+        n = len(self.means)
+        if n == 0:
+            return 0
+        if x >= self.max_value:
+            return int(self.all_weight)
+        if x <= self.min_value:
+            return 0
+        first_half = self.weights[0] / 2
+        last_half = self.weights[-1] / 2
+        if x < self.means[0]:
+            return int(first_half * (self.means[0] - x)
+                       / (self.means[0] - self.min_value))
+        if x >= self.means[-1]:
+            return int(self.all_weight - (self.max_value - x)
+                       / (self.max_value - self.means[-1]) * last_half)
+        idx = int(np.searchsorted(self.means, x, side="right"))
+        return int(self._acc[idx]
+                   - (self.means[idx] - x)
+                   / (self.means[idx] - self.means[idx - 1])
+                   * (self.weights[idx] + self.weights[idx - 1]) / 2)
+
+    def equi_height_histogram(self, bins: int, begin: float,
+                              end: float) -> np.ndarray:
+        """bins+1 quantile boundaries splitting [begin, end] into bins of
+        equal weight (reference EquiHeightHistogram :446)."""
+        self._settle()
+        if self.all_weight <= 0:
+            return np.full(bins + 1, math.nan)
+        p = self.rank(begin) / self.all_weight
+        step = (self.rank(end) - self.rank(begin)) / (
+            self.all_weight * bins)
+        return np.array([self.percentile(p + i * step)
+                         for i in range(bins + 1)])
+
+    def demarcation_histogram(self, begin: float, width: float,
+                              bins: int, bins_type: int = 0) -> np.ndarray:
+        """Per-bin counts over linear (bins_type 0) or exponential (1)
+        boundaries, with under/overflow bins at the ends (reference
+        DemarcationHistogram :490)."""
+        edges = [begin]
+        b, base = begin, width
+        for _ in range(bins):
+            if bins_type == 0:
+                b += width
+            else:
+                b += base
+                base *= width
+            edges.append(b)
+        ranks = [self.rank(e) for e in edges]
+        counts = [ranks[0]]
+        counts += [ranks[i] - ranks[i - 1] for i in range(1, len(ranks))]
+        counts.append(int(self.all_weight) - ranks[-1])
+        return np.array(counts, dtype=np.int64)
+
+    # ------------------------------------------------------------- state
+
+    def reset(self) -> None:
+        self.means = np.empty(0, dtype=np.float64)
+        self.weights = np.empty(0, dtype=np.float64)
+        self._buf_m, self._buf_w = [], []
+        self.all_weight = 0.0
+        self.delete_weight = 0.0
+        self.min_value = math.inf
+        self.max_value = -math.inf
+        self._acc = None
+        self._del = {}
+
+    def to_state(self) -> dict:
+        """Serializable partial-agg state (ships store → sql)."""
+        self._settle()
+        return {"c": self.c, "means": self.means.tolist(),
+                "weights": self.weights.tolist(),
+                "all_weight": self.all_weight,
+                "min": self.min_value, "max": self.max_value}
+
+    @classmethod
+    def from_state(cls, st: dict) -> "OGSketch":
+        s = cls(st["c"])
+        s.means = np.asarray(st["means"], dtype=np.float64)
+        s.weights = np.asarray(st["weights"], dtype=np.float64)
+        s.all_weight = float(st["all_weight"])
+        s.min_value = float(st["min"])
+        s.max_value = float(st["max"])
+        return s
+
+    @classmethod
+    def of(cls, values, clusters: float = DEFAULT_CLUSTERS) -> "OGSketch":
+        s = cls(clusters)
+        s.insert(values)
+        return s

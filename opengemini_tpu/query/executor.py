@@ -30,11 +30,14 @@ from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
                   DropMeasurementStatement, DeleteStatement,
                   ExplainStatement, KillQueryStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
-from .functions import (AGG_FUNCS, MOMENT_AGGS, AggItem, AggRef, BinOp,
-                        ClassifiedSelect, MathExpr, Num, RawRef, Transform,
-                        apply_math, apply_window_transform, classify_select,
+from ..ops.ogsketch import OGSketch
+from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
+                        AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
+                        RawRef, Transform, apply_math,
+                        apply_window_transform, classify_select,
                         eval_output_grid, finalize_moment, finalize_raw_agg,
-                        spec_names_for, topn_final, topn_partial)
+                        sliding_agg_series, spec_names_for, topn_final,
+                        topn_partial)
 
 log = get_logger(__name__)
 
@@ -524,10 +527,13 @@ class QueryExecutor:
             spec_names |= spec_names_for(a)
         spec = AggSpec.of(*spec_names)
 
-        # fields whose raw per-(group, window) slices must ship
+        # fields whose raw per-(group, window) slices must be collected
+        # locally (sketch fields fold raw values into OGSketch states
+        # before shipping — only the sketch leaves the store)
         raw_fields = sorted({a.field for a in aggs if a.needs_raw}
                             | {a.field for a in aggs
-                               if a.func in ("top", "bottom")})
+                               if a.func in ("top", "bottom")}
+                            | {a.field for a in aggs if a.needs_sketch})
 
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
@@ -592,6 +598,28 @@ class QueryExecutor:
         raw_need = {a.field for a in aggs if a.needs_raw}
         if raw_need:
             partial["raw"] = {f: raw_slices[f] for f in sorted(raw_need)}
+        # percentile_approx: fold raw cells into per-(group, window)
+        # OGSketch states (ogsketch_insert phase — only the sketch ships).
+        # One sketch per field; several calls on the same field share it
+        # at the LARGEST requested cluster count (accuracy dominates)
+        sk_items: dict[str, float] = {}
+        for a in aggs:
+            if a.needs_sketch:
+                c = a.arg2 or 100.0
+                sk_items[a.field] = max(sk_items.get(a.field, 0.0), c)
+        if sk_items:
+            partial["sketch"] = {}
+            for fname, clusters in sorted(sk_items.items()):
+                sl = raw_slices[fname]
+                cells = [[None] * W for _ in range(G)]
+                for gi in range(G):
+                    for wi in range(W):
+                        v = sl["vals"][gi][wi]
+                        if v is None or len(v) == 0:
+                            continue
+                        cells[gi][wi] = OGSketch.of(
+                            np.asarray(v), clusters).to_state()
+                partial["sketch"][fname] = {"c": clusters, "cells": cells}
         # capped top/bottom partial state
         tb = [a for a in aggs if a.func in ("top", "bottom")]
         if tb:
@@ -978,6 +1006,37 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                           for row in acc_t]}
         merged["raw"] = merged_raw
 
+    # ---- sketches: cell-wise OGSketch merge (ogsketch_merge phase)
+    sk_names = sorted(set().union(*[p.get("sketch", {}).keys()
+                                    for p in partials]))
+    if sk_names:
+        merged_sk = {}
+        for fname in sk_names:
+            clusters = next(p["sketch"][fname]["c"] for p in partials
+                            if fname in p.get("sketch", {}))
+            cells: list[list] = [[None] * W for _ in range(G)]
+            for pi, p in enumerate(partials):
+                st = p.get("sketch", {}).get(fname)
+                if st is None:
+                    continue
+                off = int((p["start"] - start) // interval) \
+                    if interval else 0
+                for lgi, gi in enumerate(
+                        key_to_gi[k] for k in aligned_keys[pi]):
+                    for wi in range(p["W"]):
+                        cell = st["cells"][lgi][wi]
+                        if cell is None:
+                            continue
+                        tgt_cell = cells[gi][off + wi]
+                        if tgt_cell is None:
+                            cells[gi][off + wi] = dict(cell)
+                        else:
+                            a = OGSketch.from_state(tgt_cell)
+                            a.merge(OGSketch.from_state(cell))
+                            cells[gi][off + wi] = a.to_state()
+            merged_sk[fname] = {"c": clusters, "cells": cells}
+        merged["sketch"] = merged_sk
+
     # ---- top/bottom: concat then re-cap (top-N of union == top-N of
     # concatenated per-store top-Ns)
     tps = [p["topn"] for p in partials if "topn" in p]
@@ -1054,6 +1113,18 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
             else np.zeros((G, W), dtype=bool)
         if a.func in MOMENT_AGGS:
             grid = finalize_moment(a.func, st)
+        elif a.func in SKETCH_AGGS:
+            # ogsketch_percentile phase: interpolated quantile per cell
+            sk = merged.get("sketch", {}).get(a.field)
+            grid = np.full((G, W), np.nan)
+            if sk is not None:
+                q = (a.arg or 0.0) / 100.0
+                for gi in range(G):
+                    for wi in range(W):
+                        cell = sk["cells"][gi][wi]
+                        if cell is not None:
+                            grid[gi, wi] = OGSketch.from_state(
+                                cell).percentile(q)
         else:
             raw = merged.get("raw", {}).get(a.field)
             if raw is None:
@@ -1146,7 +1217,7 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
                 continue
             t_ser, v_ser = _transform_series(
                 stmt, expr, agg_grids, agg_present, anyc, gi, win_times,
-                interval, W)
+                interval, W, cs=cs, merged=merged)
             for t, v in zip(t_ser, v_ser):
                 if not (np.isnan(v) or np.isinf(v)):
                     cell_row(int(t))[oi] = casts[oi](v)
@@ -1176,10 +1247,23 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
 
 
 def _transform_series(stmt, expr: Transform, agg_grids, agg_present,
-                      anyc, gi: int, win_times, interval: int, W: int):
+                      anyc, gi: int, win_times, interval: int, W: int,
+                      cs=None, merged=None):
     """One group's window series → fill → window transform. Influx applies
     fill before transforms (lib/util/lifted/influx/query select
     semantics)."""
+    if expr.func == "sliding_window":
+        # operates on the window PARTIAL STATES, not the finalized series
+        # (rolling merge is exact; see functions.sliding_agg_series)
+        if not interval:
+            raise ErrQueryError(
+                "sliding_window aggregate requires a GROUP BY interval")
+        item = cs.aggs[expr.child.idx]
+        st = merged["fields"].get(item.field, {})
+        if "count" not in st:
+            return win_times[:0], np.empty(0)
+        return sliding_agg_series(item.func, st, gi, win_times,
+                                  expr.params[0])
     child_grid = np.broadcast_to(
         np.asarray(eval_output_grid(expr.child, agg_grids),
                    dtype=np.float64), anyc.shape)

@@ -37,16 +37,25 @@ RAW_AGGS = {"percentile", "median", "mode", "distinct", "count_distinct",
             "integral", "sample"}
 # selectors that emit multiple rows per window (must be the sole field)
 MULTIROW = {"top", "bottom", "distinct", "sample"}
+# approximate aggregates carried as OGSketch partial states (the
+# reference's percentile_approx / percentile_ogsketch surface,
+# engine/executor/call_processor.go:37-41)
+SKETCH_AGGS = {"percentile_approx", "percentile_ogsketch"}
 # post-aggregation / per-series window transforms
 TRANSFORMS = {"derivative", "non_negative_derivative", "difference",
               "non_negative_difference", "cumulative_sum", "moving_average",
-              "elapsed", "holt_winters", "holt_winters_with_fit"}
+              "elapsed", "holt_winters", "holt_winters_with_fit",
+              "sliding_window"}
+# aggregates sliding_window() can combine exactly from window partial
+# states (rolling merge over the window axis)
+SLIDING_CHILD_AGGS = {"count", "sum", "mean", "min", "max", "stddev",
+                      "spread", "first", "last"}
 # elementwise math (unary unless noted)
 MATH_FUNCS = {"abs", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
               "exp", "ln", "log", "log2", "log10", "sqrt", "pow", "floor",
               "ceil", "round"}
 
-AGG_FUNCS = MOMENT_AGGS | RAW_AGGS | {"top", "bottom"}
+AGG_FUNCS = MOMENT_AGGS | RAW_AGGS | SKETCH_AGGS | {"top", "bottom"}
 
 _NS_PER_S = 1_000_000_000
 
@@ -58,10 +67,15 @@ class AggItem:
     field: str
     output: str
     arg: float | None = None       # percentile p / top-bottom-sample N /
+    arg2: float | None = None      # percentile_approx cluster count
 
     @property
     def needs_raw(self) -> bool:
         return self.func in RAW_AGGS
+
+    @property
+    def needs_sketch(self) -> bool:
+        return self.func in SKETCH_AGGS
 
     @property
     def needs_raw_times(self) -> bool:
@@ -224,6 +238,23 @@ def classify_select(stmt) -> ClassifiedSelect:
             cs.aggs.append(AggItem("percentile", e.args[0].name,
                                    "percentile", p))
             return AggRef(len(cs.aggs) - 1)
+        if func in SKETCH_AGGS:
+            if len(e.args) not in (2, 3) \
+                    or not isinstance(e.args[0], FieldRef):
+                raise ErrQueryError(
+                    f"{func}(field, p[, clusters]) expected")
+            p = _lit_num(e.args[1], f"{func}() p")
+            if not 0 <= p <= 100:
+                raise ErrQueryError(f"{func} p must be in [0, 100]")
+            clusters = 100.0
+            if len(e.args) == 3:
+                clusters = _lit_num(e.args[2], f"{func}() clusters")
+                if clusters <= 0:
+                    raise ErrQueryError(f"{func} clusters must be > 0")
+            has_agg = True
+            cs.aggs.append(AggItem(func, e.args[0].name, func, p,
+                                   clusters))
+            return AggRef(len(cs.aggs) - 1)
         if func in MOMENT_AGGS or func in ("median", "mode", "integral"):
             if not e.args or not isinstance(e.args[0], FieldRef):
                 raise ErrQueryError(
@@ -261,8 +292,22 @@ def classify_select(stmt) -> ClassifiedSelect:
                     raise ErrQueryError(f"{func}(x, N, S) expected")
                 params = [int(_lit_num(e.args[1], "holt_winters N")),
                           int(_lit_num(e.args[2], "holt_winters S"))]
+            elif func == "sliding_window":
+                if len(e.args) != 2:
+                    raise ErrQueryError("sliding_window(agg(x), n) "
+                                        "expected")
+                params = [int(_lit_num(e.args[1], "sliding_window n"))]
+                if params[0] <= 1:
+                    raise ErrQueryError(
+                        "sliding_window window must be greater than 1")
             cs.has_transform = True
             child = walk(e.args[0], False)
+            if func == "sliding_window":
+                if not (isinstance(child, AggRef)
+                        and cs.aggs[child.idx].func in SLIDING_CHILD_AGGS):
+                    raise ErrQueryError(
+                        "aggregate function required inside the call to "
+                        "sliding_window")
             if func in ("holt_winters", "holt_winters_with_fit") \
                     and not _expr_has_agg(child):
                 raise ErrQueryError(f"{func}() requires an aggregate "
@@ -567,6 +612,69 @@ def apply_window_transform(func: str, params: list,
                     np.concatenate([fit, fc]))
         return future.astype(np.int64), fc
     raise ErrQueryError(f"unsupported transform {func}")
+
+
+_I64MAXV = np.iinfo(np.int64).max
+_I64MINV = np.iinfo(np.int64).min
+
+
+def sliding_agg_series(func: str, st: dict, gi: int,
+                       win_times: np.ndarray, n: int
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """sliding_window(agg(f), n): aggregate over every n consecutive
+    GROUP BY time intervals (role of the reference's
+    engine/executor/sliding_window_transform.go:189-224). TPU-first
+    formulation: the per-window partial states the device kernel already
+    produced are combined with a rolling merge over the window axis —
+    exact for every supported child aggregate (rolling sum of sums IS the
+    sum over the union of raw points; likewise min/max/first/last), so no
+    raw re-scan is needed. Output window i covers intervals [i, i+n);
+    empty spans are dropped."""
+    from numpy.lib.stride_tricks import sliding_window_view as _swv
+    W = len(win_times)
+    if W < n:
+        return win_times[:0], np.empty(0)
+    cnt = _swv(st["count"][gi].astype(np.float64), n).sum(axis=1)
+    present = cnt > 0
+    if func == "count":
+        vals = cnt
+    elif func == "sum":
+        vals = _swv(st["sum"][gi], n).sum(axis=1)
+    elif func == "mean":
+        vals = _swv(st["sum"][gi], n).sum(axis=1) / np.maximum(cnt, 1)
+    elif func == "min":
+        # empty cells hold the +inf identity, so rolling min is exact
+        vals = _swv(st["min"][gi], n).min(axis=1)
+    elif func == "max":
+        vals = _swv(st["max"][gi], n).max(axis=1)
+    elif func == "spread":
+        vals = _swv(st["max"][gi], n).max(axis=1) \
+            - _swv(st["min"][gi], n).min(axis=1)
+    elif func == "stddev":
+        s = _swv(st["sum"][gi], n).sum(axis=1)
+        ss = _swv(st["sumsq"][gi], n).sum(axis=1)
+        safe = np.maximum(cnt, 2)
+        var = np.maximum((ss - s * s / safe) / (safe - 1), 0.0)
+        vals = np.where(cnt >= 2, np.sqrt(var), np.nan)
+    elif func == "first":
+        # empty cells carry a placeholder first_time — mask them to the
+        # +inf identity so they lose the rolling argmin
+        empty = st["count"][gi] == 0
+        ft = _swv(np.where(empty, _I64MAXV, st["first_time"][gi]), n)
+        pick = ft.argmin(axis=1)
+        vals = np.take_along_axis(_swv(st["first"][gi], n),
+                                  pick[:, None], axis=1)[:, 0]
+    elif func == "last":
+        empty = st["count"][gi] == 0
+        lt = _swv(np.where(empty, _I64MINV, st["last_time"][gi]), n)
+        pick = lt.argmax(axis=1)
+        vals = np.take_along_axis(_swv(st["last"][gi], n),
+                                  pick[:, None], axis=1)[:, 0]
+    else:
+        raise ErrQueryError(
+            f"sliding_window does not support {func}()")
+    times = win_times[:W - n + 1]
+    return times[present], np.asarray(vals, dtype=np.float64)[present]
 
 
 def holt_winters_forecast(y: np.ndarray, n_pred: int, season: int

@@ -44,14 +44,18 @@ def _col_arrays(recs_with_tags):
         n = rec.num_rows
         arrays["time"].append(pa.array(rec.times, type=pa.int64()))
         for k in all_tags:
-            arrays[k].append(pa.array([tags.get(k)] * n))
+            # explicit string type: an all-None chunk (series missing the
+            # tag) must not infer the null type or chunked_array fails
+            arrays[k].append(pa.array([tags.get(k)] * n,
+                                      type=pa.string()))
         for name, ty in all_fields.items():
             col = rec.column(name)
             if col is None:
                 arrays[name].append(pa.nulls(n, _pa_type(ty)))
                 continue
             if col.is_string_like():
-                arrays[name].append(pa.array(col.to_strings()))
+                arrays[name].append(pa.array(col.to_strings(),
+                                             type=pa.string()))
             else:
                 vals = col.values
                 mask = ~col.valid

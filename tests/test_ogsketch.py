@@ -1,0 +1,296 @@
+"""OGSketch quantile sketch + percentile_approx / sliding_window SQL
+surface (role of the reference's engine/executor/ogsketch.go,
+call_processor.go:37-41, sliding_window_transform.go)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops.ogsketch import OGSketch
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.query.executor import merge_partials
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp: str):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text: str):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, "db0")
+
+
+MIN = 60 * 10**9
+
+
+# ------------------------------------------------------------- sketch
+
+def test_sketch_small_exactish():
+    s = OGSketch(50)
+    s.insert([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert s.percentile(0.0) == pytest.approx(1.0)
+    assert s.percentile(1.0) == pytest.approx(5.0)
+    assert s.percentile(0.5) == pytest.approx(3.0, abs=0.5)
+
+
+def test_sketch_accuracy_uniform():
+    rng = np.random.default_rng(7)
+    data = rng.uniform(0, 1000, 50_000)
+    s = OGSketch(100)
+    s.insert(data)
+    assert len(s.means) <= s.sketch_size
+    for p in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99):
+        exact = np.quantile(data, p)
+        assert s.percentile(p) == pytest.approx(exact, abs=1000 * 0.02), p
+
+
+def test_sketch_accuracy_normal_tails():
+    rng = np.random.default_rng(11)
+    data = rng.normal(0, 1, 30_000)
+    s = OGSketch(100)
+    s.insert(data)
+    # t-digest-style sketches are tight in the tails
+    assert s.percentile(0.999) == pytest.approx(
+        np.quantile(data, 0.999), abs=0.2)
+    assert s.percentile(0.001) == pytest.approx(
+        np.quantile(data, 0.001), abs=0.2)
+
+
+def test_sketch_merge_matches_single():
+    rng = np.random.default_rng(3)
+    a, b = rng.exponential(5, 20_000), rng.exponential(5, 20_000)
+    s1, s2 = OGSketch.of(a), OGSketch.of(b)
+    s1.merge(s2)
+    both = np.concatenate([a, b])
+    assert s1.all_weight == pytest.approx(40_000)
+    for p in (0.1, 0.5, 0.9):
+        assert s1.percentile(p) == pytest.approx(
+            np.quantile(both, p), rel=0.05)
+
+
+def test_sketch_rank_and_histograms():
+    data = np.arange(10_000, dtype=np.float64)
+    s = OGSketch.of(data)
+    assert s.rank(-1) == 0
+    assert s.rank(10_000) == 10_000
+    r = s.rank(5000.0)
+    assert abs(r - 5000) < 200
+    bins = s.equi_height_histogram(4, 0.0, 9999.0)
+    assert len(bins) == 5
+    assert np.all(np.diff(bins) > 0)
+    counts = s.demarcation_histogram(0.0, 2500.0, 4)
+    assert counts.sum() == 10_000
+    # interior linear bins each hold ~2500
+    assert all(abs(c - 2500) < 300 for c in counts[1:5])
+
+
+def test_sketch_delete_decremental():
+    rng = np.random.default_rng(9)
+    keep = rng.uniform(0, 100, 5000)
+    drop = rng.uniform(0, 100, 5000)
+    s = OGSketch(100)
+    s.insert(np.concatenate([keep, drop]))
+    s.delete(drop)
+    # percentile settles pending deletes (the reference's processDelete)
+    assert s.percentile(0.5) == pytest.approx(
+        np.quantile(keep, 0.5), abs=8)
+    assert s.all_weight == pytest.approx(5000, rel=0.01)
+
+
+def test_sketch_nan_and_empty():
+    s = OGSketch(10)
+    s.insert([math.nan, math.nan])
+    assert math.isnan(s.percentile(0.5))
+    s.insert([1.0])
+    assert s.percentile(0.5) == pytest.approx(1.0)
+
+
+def test_sketch_state_roundtrip():
+    s = OGSketch.of(np.arange(1000.0), 50)
+    st = s.to_state()
+    s2 = OGSketch.from_state(st)
+    assert s2.percentile(0.5) == pytest.approx(s.percentile(0.5))
+
+
+# ------------------------------------------ percentile_approx SQL surface
+
+def test_percentile_approx_basic(db):
+    eng, ex = db
+    vals = np.arange(1, 1001, dtype=np.float64)
+    write(eng, "\n".join(f"m v={v} {i * 1000}"
+                         for i, v in enumerate(vals)))
+    res = q(ex, "SELECT percentile_approx(v, 50) FROM m")
+    assert res["series"][0]["columns"] == ["time", "percentile_approx"]
+    got = res["series"][0]["values"][0][1]
+    assert got == pytest.approx(500.5, abs=15)
+    # alias surface
+    res = q(ex, "SELECT percentile_ogsketch(v, 90, 64) FROM m")
+    assert res["series"][0]["values"][0][1] == pytest.approx(900, abs=25)
+
+
+def test_percentile_approx_grouped(db):
+    eng, ex = db
+    lines = []
+    for h in range(2):
+        for i in range(600):
+            lines.append(f"m,host=h{h} v={h * 1000 + i} "
+                         f"{i * (2 * MIN // 600)}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT percentile_approx(v, 50) FROM m "
+                "WHERE time >= 0 AND time < 2m GROUP BY time(1m), host")
+    s1 = [s for s in res["series"] if s["tags"] == {"host": "h1"}][0]
+    # h1 window 0: values 1000..1299 → median ≈ 1149.5
+    assert s1["values"][0][1] == pytest.approx(1149.5, abs=10)
+    assert s1["values"][1][1] == pytest.approx(1449.5, abs=10)
+
+
+def test_percentile_approx_distributed_merge(db):
+    """Sketch partial states merge across stores like any other agg."""
+    eng, ex = db
+    rng = np.random.default_rng(5)
+    all_vals = rng.uniform(0, 100, 2000)
+    write(eng, "\n".join(f"m v={v} {i * 1000}"
+                         for i, v in enumerate(all_vals[:1000])))
+    from opengemini_tpu.query.condition import analyze_condition
+    from opengemini_tpu.query.functions import classify_select
+    (stmt,) = parse_query("SELECT percentile_approx(v, 50) FROM m")
+    cs = classify_select(stmt)
+    cond = analyze_condition(stmt.condition, set())
+    p1 = ex.partial_agg(stmt, "db0", "m", cs, cond, set())
+    # second "store": a separate db on the same engine
+    eng.write_points("db1", parse_lines("\n".join(
+        f"m v={v} {(1000 + i) * 1000}"
+        for i, v in enumerate(all_vals[1000:]))))
+    p2 = ex.partial_agg(stmt, "db1", "m", cs, cond, set())
+    merged = merge_partials([p1, p2])
+    sk = merged["sketch"]["v"]["cells"][0][0]
+    got = OGSketch.from_state(sk).percentile(0.5)
+    assert got == pytest.approx(np.quantile(all_vals, 0.5), abs=3)
+
+
+def test_percentile_approx_validation(db):
+    eng, ex = db
+    write(eng, "m v=1 1000")
+    assert "error" in q(ex, "SELECT percentile_approx(v, 101) FROM m")
+    assert "error" in q(ex, "SELECT percentile_approx(v) FROM m")
+
+
+# ------------------------------------------------- sliding_window surface
+
+def test_sliding_window_mean(db):
+    eng, ex = db
+    # 6 one-minute windows, 2 points each: window means 0.5, 2.5, ...
+    lines = []
+    for w in range(6):
+        for j in range(2):
+            lines.append(f"m v={w * 2 + j} {w * MIN + j * 1000}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT sliding_window(mean(v), 3) FROM m "
+                "WHERE time >= 0 AND time < 6m GROUP BY time(1m)")
+    vals = res["series"][0]["values"]
+    # 4 sliding windows of 3 intervals; mean of 6 raw points
+    assert len(vals) == 4
+    expect = [np.mean([w * 2 + j for w in range(i, i + 3)
+                       for j in range(2)]) for i in range(4)]
+    for row, e in zip(vals, expect):
+        assert row[1] == pytest.approx(e)
+    # output times are the window starts
+    assert vals[1][0] == MIN
+
+
+def test_sliding_window_min_max_count(db):
+    eng, ex = db
+    lines = []
+    vals = [5, 1, 7, 3, 9, 2]
+    for w, v in enumerate(vals):
+        lines.append(f"m v={v} {w * MIN}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT sliding_window(max(v), 2), "
+                "sliding_window(min(v), 2), sliding_window(count(v), 2) "
+                "FROM m WHERE time >= 0 AND time < 6m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 5
+    assert [r[1] for r in rows] == [5, 7, 7, 9, 9]      # rolling max
+    assert [r[2] for r in rows] == [1, 1, 3, 3, 2]      # rolling min
+    assert [r[3] for r in rows] == [2, 2, 2, 2, 2]      # rolling count
+
+
+def test_sliding_window_with_gap(db):
+    eng, ex = db
+    # windows 0, 1 filled; 2, 3 empty; 4 filled
+    write(eng, "\n".join([f"m v=1 {0 * MIN}", f"m v=3 {1 * MIN}",
+                          f"m v=5 {4 * MIN}"]))
+    res = q(ex, "SELECT sliding_window(sum(v), 2) FROM m "
+                "WHERE time >= 0 AND time < 5m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    # spans: [0,1]=4, [1,2]=3, [2,3]=empty (dropped), [3,4]=5
+    assert [(r[0] // MIN, r[1]) for r in rows] == [(0, 4), (1, 3), (3, 5)]
+
+
+def test_sliding_window_first_last_stddev(db):
+    eng, ex = db
+    lines = []
+    for w in range(4):
+        for j in range(3):
+            lines.append(f"m v={w * 10 + j * 3} {w * MIN + j * 1000}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT sliding_window(first(v), 2), "
+                "sliding_window(last(v), 2), sliding_window(stddev(v), 2) "
+                "FROM m WHERE time >= 0 AND time < 4m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 3
+    for i, r in enumerate(rows):
+        span = [w * 10 + j * 3 for w in (i, i + 1) for j in range(3)]
+        assert r[1] == span[0]                       # first
+        assert r[2] == span[-1]                      # last
+        assert r[3] == pytest.approx(np.std(span, ddof=1))
+
+
+def test_sliding_window_first_last_with_gap(db):
+    """Empty intervals inside a span must not hijack first/last (their
+    placeholder chunk times must lose the rolling argmin/argmax)."""
+    eng, ex = db
+    write(eng, "\n".join([f"m v=1 {0 * MIN + 1000}",
+                          f"m v=5 {2 * MIN + 1000}",
+                          f"m v=7 {3 * MIN + 1000}"]))
+    res = q(ex, "SELECT sliding_window(first(v), 2), "
+                "sliding_window(last(v), 2) FROM m "
+                "WHERE time >= 0 AND time < 4m GROUP BY time(1m)")
+    rows = res["series"][0]["values"]
+    assert [(r[0] // MIN, r[1], r[2]) for r in rows] == [
+        (0, 1, 1), (1, 5, 5), (2, 5, 7)]
+
+
+def test_sliding_window_grouped_by_tag(db):
+    eng, ex = db
+    lines = []
+    for h in range(2):
+        for w in range(3):
+            lines.append(f"m,host=h{h} v={h * 100 + w} {w * MIN}")
+    write(eng, "\n".join(lines))
+    res = q(ex, "SELECT sliding_window(sum(v), 2) FROM m "
+                "WHERE time >= 0 AND time < 3m GROUP BY time(1m), host")
+    by_tag = {s["tags"]["host"]: s["values"] for s in res["series"]}
+    assert [r[1] for r in by_tag["h0"]] == [1, 3]
+    assert [r[1] for r in by_tag["h1"]] == [201, 203]
+
+
+def test_sliding_window_validation(db):
+    eng, ex = db
+    write(eng, "m v=1 1000")
+    assert "error" in q(
+        ex, "SELECT sliding_window(v, 3) FROM m GROUP BY time(1m)")
+    assert "error" in q(
+        ex, "SELECT sliding_window(mean(v), 1) FROM m GROUP BY time(1m)")
+    assert "error" in q(ex, "SELECT sliding_window(mean(v), 3) FROM m")

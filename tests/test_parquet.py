@@ -69,6 +69,20 @@ class TestParquetExport:
         assert export_measurement(e, "db0", "nope",
                                   str(tmp / "x.parquet")) == 0
 
+    def test_missing_tag_on_one_series(self, tmp_path):
+        """A series lacking a tag key must export as nulls, not crash
+        on a null-typed arrow chunk."""
+        e = Engine(str(tmp_path / "d3"))
+        e.write_points("db0", parse_lines(
+            "cpu,host=a,dc=west u=1 1000000000\n"
+            "cpu,host=b u=2 2000000000"))
+        e.flush_all()
+        path = str(tmp_path / "cpu.parquet")
+        export_measurement(e, "db0", "cpu", path)
+        t = pq.read_table(path)
+        assert set(t.column("dc").to_pylist()) == {"west", None}
+        e.close()
+
     def test_sparse_fields_null(self, tmp_path):
         e = Engine(str(tmp_path / "d2"))
         e.write_points("db0", parse_lines(
