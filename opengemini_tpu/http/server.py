@@ -163,6 +163,12 @@ class HttpServer:
             return 400, {"error": "missing required parameter \"q\""}
         db = params.get("db")
         epoch = params.get("epoch")
+        # incremental-aggregation polling (reference IncQuery/IterID)
+        inc_qid = params.get("inc_query_id")
+        try:
+            iter_id = int(params.get("iter_id", 0))
+        except ValueError:
+            return 400, {"error": "iter_id must be an integer"}
         self._bump("queries")
         try:
             stmts = parse_query(qtext)
@@ -172,7 +178,11 @@ class HttpServer:
         results = []
         for i, stmt in enumerate(stmts):
             try:
-                res = self.executor.execute(stmt, db)
+                # one cache slot per statement of a multi-statement query
+                stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
+                res = self.executor.execute(stmt, db,
+                                            inc_query_id=stmt_qid,
+                                            iter_id=iter_id)
             except Exception as e:  # an executor bug must not kill the conn
                 log.exception("query execution failed: %s", qtext)
                 res = {"error": f"internal error: {e}"}

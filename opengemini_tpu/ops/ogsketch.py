@@ -261,7 +261,7 @@ class OGSketch:
         first_half = self.weights[0] / 2
         last_half = self.weights[-1] / 2
         if x < self.means[0]:
-            return int(first_half * (self.means[0] - x)
+            return int(first_half * (x - self.min_value)
                        / (self.means[0] - self.min_value))
         if x >= self.means[-1]:
             return int(self.all_weight - (self.max_value - x)

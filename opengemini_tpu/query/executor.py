@@ -31,6 +31,7 @@ from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
                   ExplainStatement, KillQueryStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
+from .incremental import IncAggCache, complete_prefix
 from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
@@ -67,18 +68,22 @@ class QueryExecutor:
         self.query_manager = query_manager
         self.resources = resources
         self.castor = castor    # CastorService; lazily built if needed
+        self.inc_cache = IncAggCache()
 
     # ------------------------------------------------------------------ api
 
     def execute(self, stmt, db: str | None = None, ctx=None,
-                span=None) -> dict:
+                span=None, inc_query_id: str | None = None,
+                iter_id: int = 0) -> dict:
         """Returns one influx-style result object: {"series": [...]} or
         {"error": ...}. ctx: QueryContext kill handle; span: tracing Span
-        (EXPLAIN ANALYZE)."""
+        (EXPLAIN ANALYZE); inc_query_id/iter_id: incremental-aggregation
+        cache key (see incremental.py)."""
         try:
             if isinstance(stmt, SelectStatement):
                 return self._select(stmt, stmt.from_db or db, ctx=ctx,
-                                    span=span)
+                                    span=span, inc_query_id=inc_query_id,
+                                    iter_id=iter_id)
             if isinstance(stmt, ExplainStatement):
                 return self._explain(stmt, db)
             if isinstance(stmt, KillQueryStatement):
@@ -195,7 +200,8 @@ class QueryExecutor:
     # --------------------------------------------------------------- SELECT
 
     def _select(self, stmt: SelectStatement, db: str | None, ctx=None,
-                span=None) -> dict:
+                span=None, inc_query_id: str | None = None,
+                iter_id: int = 0) -> dict:
         if db is None:
             return {"error": "database required"}
         if db not in self.engine.databases:
@@ -218,7 +224,9 @@ class QueryExecutor:
             cond = analyze_condition(stmt.condition, tag_keys)
             if cs.mode == "agg":
                 res = self._select_agg(stmt, db, mst, cs, cond, tag_keys,
-                                       ctx=ctx, span=span)
+                                       ctx=ctx, span=span,
+                                       inc_query_id=inc_query_id,
+                                       iter_id=iter_id)
             else:
                 res = self._select_raw(stmt, db, mst, cs, cond, tag_keys,
                                        ctx=ctx)
@@ -374,15 +382,54 @@ class QueryExecutor:
     # ---- aggregate path --------------------------------------------------
 
     def _select_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
-                    tag_keys, ctx=None, span=None) -> dict:
-        partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
-                                   ctx=ctx, span=span)
+                    tag_keys, ctx=None, span=None,
+                    inc_query_id: str | None = None,
+                    iter_id: int = 0) -> dict:
+        if inc_query_id:
+            partial = self._partial_agg_incremental(
+                stmt, db, mst, cs, cond, tag_keys, inc_query_id, iter_id,
+                ctx=ctx, span=span)
+        else:
+            partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
+                                       ctx=ctx, span=span)
         if span is not None:
             with span.child("finalize") as sp:
                 res = finalize_partials(stmt, mst, cs, [partial])
                 sp.add(series=len(res.get("series", [])))
             return res
         return finalize_partials(stmt, mst, cs, [partial])
+
+    def _partial_agg_incremental(self, stmt, db, mst, cs, cond, tag_keys,
+                                 inc_query_id: str, iter_id: int,
+                                 ctx=None, span=None) -> dict | None:
+        """Incremental-query path (reference IncQuery/IterID options +
+        IncAggTransform): serve the complete-window prefix from the
+        IncAggCache and scan only from the watermark forward. See
+        incremental.py for semantics."""
+        import copy
+
+        interval = stmt.group_by_interval()
+        if not interval or not cond.has_time_range \
+                or cond.t_min == MIN_TIME or cond.t_max == MAX_TIME:
+            raise ErrQueryError(
+                "incremental queries require GROUP BY time() and an "
+                "explicit time range")
+        fp = f"{db}|{mst}|{stmt!r}"
+        cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
+        if cached is not None and cached.fingerprint == fp:
+            cond2 = copy.copy(cond)
+            cond2.t_min = max(cond.t_min, cached.watermark)
+            fresh = self.partial_agg(stmt, db, mst, cs, cond2, tag_keys,
+                                     ctx=ctx, span=span)
+            partial = merge_partials([cached.partial, fresh])
+        else:
+            partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
+                                       ctx=ctx, span=span)
+        trimmed, watermark = complete_prefix(partial)
+        if trimmed is not None:
+            self.inc_cache.put(inc_query_id, iter_id, fp, trimmed,
+                               watermark)
+        return partial
 
     def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
                     tag_keys, ctx=None, span=None) -> dict | None:

@@ -1,0 +1,141 @@
+"""Incremental aggregation for repeated dashboard queries.
+
+Role of the reference's incremental-query machinery: the ``IncQuery`` /
+``IterID`` processor options (lib/util/lifted/influx/query/executor.go:
+206-216) driving IncAggTransform / IncHashAggTransform
+(engine/executor/inc_agg_transform.go — iteration 0 builds the full
+interval chunk and caches it; iteration N fetches the cached chunk and
+folds in only new data).
+
+TPU-first formulation: the unit of caching is the mergeable per-(group,
+window) partial state the device kernel already produces (the same wire
+format the distributed exchange ships), NOT a result chunk. Iteration 0
+computes the full range, caches the state trimmed to *complete* windows
+(everything before the last data-bearing window — the tail window may
+still be filling), and records the trim point as a watermark. Iteration N
+re-scans only ``time >= watermark`` and merges the fresh partial with the
+cached one via the ordinary exchange merge (merge_partials) — the cost of
+a poll is O(new data), not O(range).
+
+Append-mostly semantics: late writes landing *before* the watermark are
+not re-observed until the cache entry expires (TTL) or the client restarts
+at iter_id=0 — the same trade the reference makes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["IncAggCache", "complete_prefix"]
+
+
+@dataclass
+class IncEntry:
+    iter_id: int
+    fingerprint: str
+    partial: dict
+    watermark: int                # ns; next iteration scans >= this
+    ts: float = field(default_factory=time.monotonic)
+
+
+class IncAggCache:
+    """TTL'd per-query-id cache of trimmed window partial states (role of
+    the reference's IncAggChunkCache / IncHashAggChunkCache)."""
+
+    def __init__(self, ttl_s: float = 600.0, max_entries: int = 128):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: dict[str, IncEntry] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, qid: str) -> IncEntry | None:
+        with self._lock:
+            e = self._entries.get(qid)
+            if e is None:
+                self.misses += 1
+                return None
+            if time.monotonic() - e.ts > self.ttl_s:
+                del self._entries[qid]
+                self.misses += 1
+                return None
+            self.hits += 1
+            return e
+
+    def put(self, qid: str, iter_id: int, fingerprint: str,
+            partial: dict, watermark: int) -> None:
+        with self._lock:
+            if len(self._entries) >= self.max_entries \
+                    and qid not in self._entries:
+                # drop the stalest entry (simple clock eviction)
+                oldest = min(self._entries, key=lambda k:
+                             self._entries[k].ts)
+                del self._entries[oldest]
+            self._entries[qid] = IncEntry(iter_id, fingerprint, partial,
+                                          watermark)
+
+    def drop(self, qid: str) -> None:
+        with self._lock:
+            self._entries.pop(qid, None)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def _slice_cells(rows: list[list], keep_w: int) -> list[list]:
+    return [row[:keep_w] for row in rows]
+
+
+def complete_prefix(partial: dict | None
+                    ) -> tuple[dict | None, int | None]:
+    """Trim a partial state to its complete-window prefix.
+
+    A window is complete if any window AFTER it holds data (append-mostly:
+    once newer data exists, older windows are closed). Returns the trimmed
+    copy and the watermark (start time of the first un-cached window), or
+    (None, None) when nothing is cacheable (no data, or all data in the
+    tail window)."""
+    if partial is None:
+        return None, None
+    if "raw" in partial:
+        # exact-semantics aggregates (median/percentile/mode/...) carry
+        # raw per-cell slices — caching them would pin the dataset itself
+        # in memory, so those queries always recompute
+        return None, None
+    interval = partial["interval"]
+    W = partial["W"]
+    if not interval or W <= 1:
+        return None, None
+    any_count = np.zeros(W, dtype=bool)
+    for st in partial["fields"].values():
+        cnt = st.get("count")
+        if cnt is not None:
+            any_count |= (cnt > 0).any(axis=0)
+    nz = np.nonzero(any_count)[0]
+    if len(nz) == 0:
+        return None, None
+    keep_w = int(nz[-1])          # exclusive: drop the tail data window
+    if keep_w == 0:
+        return None, None
+    out = dict(partial)
+    out["W"] = keep_w
+    out["fields"] = {
+        # .copy(): the cache must own its memory (kernel outputs are
+        # read-only numpy views of device buffers)
+        f: {k: v[:, :keep_w].copy() for k, v in st.items()}
+        for f, st in partial["fields"].items()}
+    if "sketch" in partial:
+        out["sketch"] = {
+            f: {"c": sk["c"], "cells": _slice_cells(sk["cells"], keep_w)}
+            for f, sk in partial["sketch"].items()}
+    if "topn" in partial:
+        tp = partial["topn"]
+        out["topn"] = dict(tp, vals=_slice_cells(tp["vals"], keep_w),
+                           times=_slice_cells(tp["times"], keep_w))
+    watermark = int(partial["start"] + keep_w * interval)
+    return out, watermark

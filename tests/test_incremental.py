@@ -1,0 +1,157 @@
+"""Incremental aggregation cache (role of the reference's
+IncAggTransform / IncHashAggTransform + IncQuery/IterID options,
+engine/executor/inc_agg_transform.go)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.query.incremental import IncAggCache, complete_prefix
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp: str):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text: str, **kw):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, "db0", **kw)
+
+
+QUERY = ("SELECT mean(v), count(v) FROM m WHERE time >= 0 AND "
+         "time < 10m GROUP BY time(1m), host")
+
+
+def rows_of(res):
+    return {s["tags"]["host"]: s["values"] for s in res["series"]}
+
+
+def test_inc_iter0_matches_plain(db):
+    eng, ex = db
+    for h in range(2):
+        write(eng, "\n".join(
+            f"m,host=h{h} v={h * 10 + w} {w * MIN + 5000}"
+            for w in range(4)))
+    plain = q(ex, QUERY)
+    inc = q(ex, QUERY, inc_query_id="dash1", iter_id=0)
+    assert inc == plain
+    assert len(ex.inc_cache) == 1
+
+
+def test_inc_iter_merges_new_windows(db):
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(3)))
+    r0 = q(ex, QUERY, inc_query_id="d2", iter_id=0)
+    assert [r[1] for r in rows_of(r0)["a"][:3]] == [0.0, 1.0, 2.0]
+    # new data lands in the tail window and two new windows
+    write(eng, "\n".join([f"m,host=a v=12 {2 * MIN + 1000}",
+                          f"m,host=a v=20 {3 * MIN}",
+                          f"m,host=a v=30 {4 * MIN}"]))
+    r1 = q(ex, QUERY, inc_query_id="d2", iter_id=1)
+    vals = rows_of(r1)["a"]
+    # tail window (w=2) was re-scanned: mean of [2, 12]
+    assert vals[2][1] == pytest.approx(7.0)
+    assert vals[3][1] == 20.0 and vals[4][1] == 30.0
+    assert vals[5][1] is None
+    # result identical to a fresh full query
+    assert r1 == q(ex, QUERY)
+
+
+def test_inc_iter_uses_cache_not_rescan(db):
+    """Cached complete windows are served even if their data is gone —
+    proof the prefix came from the cache, not a re-scan."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(3)))
+    q(ex, QUERY, inc_query_id="d3", iter_id=0)
+    entry = ex.inc_cache.get("d3")
+    assert entry is not None and entry.watermark == 2 * MIN
+    # poison the cached prefix to prove it is what iter 1 serves
+    entry.partial["fields"]["v"]["sum"][0, 0] = 999.0
+    r1 = q(ex, QUERY, inc_query_id="d3", iter_id=1)
+    assert rows_of(r1)["a"][0][1] == 999.0
+
+
+def test_inc_fingerprint_mismatch_recomputes(db):
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(3)))
+    q(ex, QUERY, inc_query_id="d4", iter_id=0)
+    other = ("SELECT mean(v) FROM m WHERE time >= 0 AND time < 10m "
+             "GROUP BY time(1m), host")
+    res = q(ex, other, inc_query_id="d4", iter_id=1)
+    assert rows_of(res)["a"][0][1] == 0.0
+
+
+def test_inc_requires_interval_and_range(db):
+    eng, ex = db
+    write(eng, "m v=1 1000")
+    res = q(ex, "SELECT mean(v) FROM m", inc_query_id="d5", iter_id=0)
+    assert "error" in res
+
+
+def test_inc_raw_query_unaffected(db):
+    eng, ex = db
+    write(eng, "m v=1 1000")
+    res = q(ex, "SELECT v FROM m", inc_query_id="d6", iter_id=0)
+    assert res["series"][0]["values"] == [[1000, 1.0]]
+
+
+def test_complete_prefix_trims_tail():
+    cnt = np.array([[2, 3, 0, 1]])
+    p = {"interval": MIN, "W": 4, "start": 0,
+         "group_tags": ["host"], "group_keys": [["a"]],
+         "fields": {"v": {"count": cnt,
+                          "sum": np.array([[4.0, 9.0, 0.0, 5.0]])}},
+         "field_types": {"v": "float"}}
+    trimmed, wm = complete_prefix(p)
+    assert wm == 3 * MIN
+    assert trimmed["W"] == 3
+    assert trimmed["fields"]["v"]["sum"].tolist() == [[4.0, 9.0, 0.0]]
+
+
+def test_complete_prefix_all_in_tail():
+    p = {"interval": MIN, "W": 2, "start": 0,
+         "group_tags": [], "group_keys": [[]],
+         "fields": {"v": {"count": np.array([[3, 0]])}},
+         "field_types": {"v": "float"}}
+    trimmed, wm = complete_prefix(p)
+    assert trimmed is None and wm is None
+
+
+def test_inc_raw_agg_not_cached(db):
+    """median() ships raw slices — those must never be pinned in the
+    cache (memory), so incremental median recomputes each poll."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(3)))
+    res = q(ex, "SELECT median(v) FROM m WHERE time >= 0 AND "
+                "time < 5m GROUP BY time(1m)",
+            inc_query_id="d7", iter_id=0)
+    assert "series" in res
+    assert ex.inc_cache.get("d7") is None
+    # still correct on iter 1 (full recompute fallback)
+    res = q(ex, "SELECT median(v) FROM m WHERE time >= 0 AND "
+                "time < 5m GROUP BY time(1m)",
+            inc_query_id="d7", iter_id=1)
+    assert res["series"][0]["values"][1][1] == 1.0
+
+
+def test_cache_ttl_and_eviction():
+    c = IncAggCache(ttl_s=0.0, max_entries=2)
+    c.put("a", 0, "f", {}, 0)
+    assert c.get("a") is None          # expired immediately
+    c2 = IncAggCache(max_entries=2)
+    c2.put("a", 0, "f", {}, 0)
+    c2.put("b", 0, "f", {}, 0)
+    c2.put("c", 0, "f", {}, 0)
+    assert len(c2) == 2 and c2.get("c") is not None

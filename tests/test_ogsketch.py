@@ -79,6 +79,18 @@ def test_sketch_merge_matches_single():
             np.quantile(both, p), rel=0.05)
 
 
+def test_sketch_rank_monotone_below_first_centroid():
+    rng = np.random.default_rng(21)
+    s = OGSketch(20)
+    s.insert(rng.uniform(0, 1000, 50_000))
+    s.percentile(0.5)   # settle
+    lo, hi = s.min_value, float(s.means[0])
+    xs = np.linspace(lo, hi, 8)
+    ranks = [s.rank(float(x)) for x in xs]
+    assert ranks == sorted(ranks)
+    assert ranks[0] <= 1
+
+
 def test_sketch_rank_and_histograms():
     data = np.arange(10_000, dtype=np.float64)
     s = OGSketch.of(data)
