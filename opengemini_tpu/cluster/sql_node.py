@@ -116,7 +116,11 @@ class ClusterExecutor:
 
     # ------------------------------------------------------------- execute
 
-    def execute(self, stmt, db: str | None = None) -> dict:
+    def execute(self, stmt, db: str | None = None,
+                inc_query_id: str | None = None, iter_id: int = 0) -> dict:
+        # inc_query_id/iter_id accepted for HTTP-surface parity; the
+        # cluster path always recomputes (the single-node IncAggCache
+        # lives in QueryExecutor — store-side partials are not yet cached)
         try:
             if isinstance(stmt, SelectStatement):
                 return self._select(stmt, stmt.from_db or db)

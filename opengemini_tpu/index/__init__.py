@@ -1,1 +1,3 @@
 from .tsi import SeriesIndex, TagFilter
+from .clv import CLVIndex, Analyzer, Collector, tokenize
+from .ski import ShardKeyIndex

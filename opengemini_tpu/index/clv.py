@@ -1,0 +1,308 @@
+"""CLV tokenized log-search index.
+
+Role of the reference's `engine/index/clv/` package: a learned-vocabulary
+inverted index for log text search —
+- `tokenizer.go` SimpleTokenizer: split-gram byte table tokenization
+  (DefaultSplitGram ``, '";=()[]{}?@&<>/:\\n\\t\\r``; bytes with the high
+  bit set are always token chars so UTF-8 passes through).
+- `analyzer.go` Analyzer/Collector: a dictionary of frequent multi-token
+  phrases (VTokens) learned from sample logs; analysis greedily maps the
+  token stream to the longest dictionary phrase, shrinking posting-list
+  count for repetitive logs.
+- `index.go`/`search.go` InvertIndex + Match/Match_Phrase/Fuzzy query
+  types returning per-series row filters (row id = timestamp).
+
+Design here: postings are dict[vtoken] → dict[sid] → (timestamps,
+positions) with numpy int64 arrays (sorted on seal); phrase match
+intersects position lists vectorially (np.isin on adjusted positions);
+fuzzy matches expand over the vocabulary with fnmatch. The learned
+dictionary is a plain trie of token tuples.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from collections import Counter
+from dataclasses import dataclass, field
+
+import numpy as np
+
+DEFAULT_SPLIT_GRAM = ", '\";=()[]{}?@&<>/:\n\t\r"
+MAX_PHRASE_TOKENS = 7            # reference clv MaxDicLen analog
+
+# query types (reference engine/index/clv/index.go:40-44)
+MATCH = 1
+MATCH_PHRASE = 2
+FUZZY = 3
+
+
+def make_split_table(split_gram: str = DEFAULT_SPLIT_GRAM) -> np.ndarray:
+    table = np.zeros(256, dtype=bool)
+    for ch in split_gram:
+        table[ord(ch)] = True
+    return table
+
+
+_DEFAULT_TABLE = make_split_table()
+
+
+def tokenize(text: str, table: np.ndarray = _DEFAULT_TABLE
+             ) -> list[tuple[str, int]]:
+    """Split text into (token, position) pairs. Position counts tokens,
+    not bytes (phrase adjacency = consecutive positions). Tokens are
+    lower-cased (reference tokenizer byte-normalizes case)."""
+    raw = text.encode("utf-8", "surrogateescape")
+    b = np.frombuffer(raw, dtype=np.uint8)
+    if b.size == 0:
+        return []
+    is_split = (b < 128) & table[b]
+    # token boundaries: starts where prev is split (or SOT), ends where
+    # next is split (or EOT)
+    prev_split = np.concatenate([[True], is_split[:-1]])
+    starts = np.nonzero(~is_split & prev_split)[0]
+    next_split = np.concatenate([is_split[1:], [True]])
+    ends = np.nonzero(~is_split & next_split)[0]
+    out = []
+    for pos, (s, e) in enumerate(zip(starts, ends)):
+        out.append((raw[s:e + 1].decode("utf-8", "surrogateescape")
+                    .lower(), pos))
+    return out
+
+
+# --------------------------------------------------------------- analyzer
+
+class Collector:
+    """Counts token n-grams from sample logs to learn the phrase
+    dictionary (reference collector.go)."""
+
+    def __init__(self, max_phrase: int = MAX_PHRASE_TOKENS):
+        self.max_phrase = max_phrase
+        self.counts: Counter = Counter()
+
+    def collect(self, text: str) -> None:
+        toks = [t for t, _p in tokenize(text)]
+        for n in range(2, self.max_phrase + 1):
+            for i in range(len(toks) - n + 1):
+                self.counts[tuple(toks[i:i + n])] += 1
+
+    def top_phrases(self, k: int, min_count: int = 2
+                    ) -> list[tuple[str, ...]]:
+        # prefer longer phrases on equal frequency (greedy-longest match
+        # then saves more postings)
+        cands = [(c, len(p), p) for p, c in self.counts.items()
+                 if c >= min_count]
+        cands.sort(key=lambda x: (-x[0], -x[1], x[2]))
+        return [p for _c, _l, p in cands[:k]]
+
+
+@dataclass
+class VToken:
+    text: str                     # phrase tokens joined by spaces
+    pos: int                      # token position of the phrase start
+    n: int = 1                    # tokens consumed
+
+
+class Analyzer:
+    """Maps a token stream to VTokens via greedy longest-match against a
+    learned phrase dictionary (reference analyzer.go:152 findLongestTokens;
+    version 0 = the default analyzer: every token is its own VToken)."""
+
+    def __init__(self, phrases: list[tuple[str, ...]] | None = None,
+                 version: int = 0):
+        self.version = version
+        self._trie: dict = {}
+        for p in phrases or []:
+            node = self._trie
+            for tok in p:
+                node = node.setdefault(tok, {})
+            node[None] = True     # terminal
+
+    @classmethod
+    def learn(cls, samples: list[str], dict_size: int = 256,
+              version: int = 1) -> "Analyzer":
+        coll = Collector()
+        for s in samples:
+            coll.collect(s)
+        return cls(coll.top_phrases(dict_size), version=version)
+
+    def analyze(self, text: str) -> list[VToken]:
+        toks = tokenize(text)
+        out: list[VToken] = []
+        i = 0
+        while i < len(toks):
+            best = 1
+            node = self._trie.get(toks[i][0])
+            j = i + 1
+            while node is not None:
+                if None in node:
+                    best = max(best, j - i)
+                if j >= len(toks):
+                    break
+                node = node.get(toks[j][0])
+                j += 1
+            out.append(VToken(" ".join(t for t, _p in toks[i:i + best]),
+                              toks[i][1], best))
+            i += best
+        return out
+
+
+# ------------------------------------------------------------------ index
+
+@dataclass
+class _Posting:
+    rowids: list = field(default_factory=list)    # int64 timestamps
+    positions: list = field(default_factory=list)
+
+
+class CLVIndex:
+    """One measurement+field's tokenized inverted index.
+
+    add(sid, timestamp, text) indexes a log line; match/match_phrase/
+    fuzzy return {sid: sorted int64 timestamp array} row filters
+    (reference RowFilter, index.go:46: "RowId is the timestamp")."""
+
+    def __init__(self, analyzer: Analyzer | None = None):
+        self.analyzer = analyzer or Analyzer()
+        self._postings: dict[str, dict[int, _Posting]] = {}
+        self.docs = 0
+
+    def add(self, sid: int, timestamp: int, text: str) -> None:
+        self.docs += 1
+        for vt in self.analyzer.analyze(text):
+            by_sid = self._postings.setdefault(vt.text, {})
+            p = by_sid.setdefault(sid, _Posting())
+            p.rowids.append(timestamp)
+            p.positions.append(vt.pos)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._postings)
+
+    # ---- search
+
+    def search(self, query: str, qtype: int = MATCH
+               ) -> dict[int, np.ndarray]:
+        if qtype == MATCH:
+            return self.match(query)
+        if qtype == MATCH_PHRASE:
+            return self.match_phrase(query)
+        if qtype == FUZZY:
+            return self.fuzzy(query)
+        raise ValueError(f"unknown clv query type {qtype}")
+
+    def _rows_for_vtoken(self, vt: str) -> dict[int, np.ndarray]:
+        out = {}
+        for sid, p in self._postings.get(vt, {}).items():
+            out[sid] = np.unique(np.asarray(p.rowids, dtype=np.int64))
+        return out
+
+    def _rows_for_token(self, tok: str) -> dict[int, np.ndarray]:
+        """A single query token also matches inside learned phrases —
+        scan vocabulary entries containing it."""
+        acc: dict[int, list] = {}
+        for vt in self._postings:
+            if tok == vt or (" " in vt and tok in vt.split(" ")):
+                for sid, rows in self._rows_for_vtoken(vt).items():
+                    acc.setdefault(sid, []).append(rows)
+        return {sid: np.unique(np.concatenate(rs))
+                for sid, rs in acc.items()}
+
+    def _positions_for_token(self, tok: str
+                             ) -> dict[int, tuple[np.ndarray, np.ndarray]]:
+        """(rowids, absolute token positions) of every occurrence of
+        `tok`, including inside learned phrases (a token at offset k of a
+        phrase posted at position P sits at absolute position P+k — the
+        reference's assembleId(id, offset) scheme, clv/index.go:179)."""
+        acc: dict[int, list] = {}
+        for vt, by_sid in self._postings.items():
+            toks = vt.split(" ") if " " in vt else [vt]
+            offs = [k for k, t in enumerate(toks) if t == tok]
+            if not offs:
+                continue
+            for sid, p in by_sid.items():
+                rows = np.asarray(p.rowids, dtype=np.int64)
+                pos = np.asarray(p.positions, dtype=np.int64)
+                for k in offs:
+                    acc.setdefault(sid, []).append((rows, pos + k))
+        return {sid: (np.concatenate([r for r, _p in parts]),
+                      np.concatenate([p for _r, p in parts]))
+                for sid, parts in acc.items()}
+
+    def match(self, query: str) -> dict[int, np.ndarray]:
+        """All query tokens appear in the log line (AND of postings,
+        intersected per (sid, rowid))."""
+        toks = [t for t, _p in tokenize(query)]
+        if not toks:
+            return {}
+        sets = [self._rows_for_token(t) for t in toks]
+        return _intersect_rowsets(sets)
+
+    def match_phrase(self, query: str) -> dict[int, np.ndarray]:
+        """Tokens adjacent and in order. Works at the TOKEN level (not
+        vtoken), so query phrases that are sub-phrases of — or straddle —
+        learned dictionary phrases still match: each query token k yields
+        (rowid, abs_pos - k) pairs, and the phrase hits are the pairs
+        common to every token."""
+        qtoks = [t for t, _p in tokenize(query)]
+        if not qtoks:
+            return {}
+        per_tok = [self._positions_for_token(t) for t in qtoks]
+        if any(not d for d in per_tok):
+            return {}
+        common = set.intersection(*[set(d) for d in per_tok])
+        out = {}
+        for sid in sorted(common):
+            rows0, pos0 = per_tok[0][sid]
+            pairs = _pair_view(rows0, pos0)
+            for k, d in enumerate(per_tok[1:], start=1):
+                rows_k, pos_k = d[sid]
+                pairs = np.intersect1d(
+                    pairs, _pair_view(rows_k, pos_k - k),
+                    assume_unique=False)
+                if not len(pairs):
+                    break
+            if len(pairs):
+                out[sid] = np.unique(pairs["r"])
+        return out
+
+    def fuzzy(self, pattern: str) -> dict[int, np.ndarray]:
+        """Wildcard match (* and ?) over the vocabulary, OR of postings
+        (reference Fuzzy via terms-index scan, search.go:85)."""
+        pat = pattern.lower()
+        acc: dict[int, list] = {}
+        for vt in self._postings:
+            toks = vt.split(" ") if " " in vt else [vt]
+            if any(fnmatch.fnmatchcase(t, pat) for t in toks):
+                for sid, rows in self._rows_for_vtoken(vt).items():
+                    acc.setdefault(sid, []).append(rows)
+        return {sid: np.unique(np.concatenate(rs))
+                for sid, rs in acc.items()}
+
+
+_PAIR_DT = np.dtype([("r", "<i8"), ("p", "<i8")])
+
+
+def _pair_view(rows: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    """(rowid, position) pairs as a structured array — set intersection
+    without packing both into one int (ns timestamps would overflow)."""
+    out = np.empty(len(rows), dtype=_PAIR_DT)
+    out["r"] = rows
+    out["p"] = pos
+    return out
+
+
+def _intersect_rowsets(sets: list[dict[int, np.ndarray]]
+                       ) -> dict[int, np.ndarray]:
+    if not sets:
+        return {}
+    common = set.intersection(*[set(s) for s in sets])
+    out = {}
+    for sid in sorted(common):
+        rows = sets[0][sid]
+        for s in sets[1:]:
+            rows = rows[np.isin(rows, s[sid])]
+            if not len(rows):
+                break
+        if len(rows):
+            out[sid] = rows
+    return out

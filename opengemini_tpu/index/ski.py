@@ -1,0 +1,135 @@
+"""Shard-key index (ski).
+
+Role of the reference's `engine/index/ski/shardkey_index.go`: maps
+(measurement, shard-key value) → series ids on a per-shard basis, tracks
+the shard's series count, and answers *split point* queries — the keys at
+which a range-sharded measurement should be cut so each resulting shard
+holds an even share of series (`GetSplitPointsWithSeriesCount` :188) or
+of rows (`GetSplitPointsByRowCount` :254). The split points feed shard
+splitting in range-sharding mode (Engine.GetShardSplitPoints,
+engine/engine.go:930).
+
+The reference builds this on a mergeset LSM with an LRU dedup cache;
+here the working set is a dict of sorted shard keys with numpy posting
+arrays plus an append-only persistence log (same pattern as tsi.py —
+key creation is rare relative to writes)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+_REC = struct.Struct("<IQ")      # key-bytes length, sid
+
+
+class ShardKeyIndex:
+    """Per-shard shard-key → series-id index with split-point queries."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.Lock()
+        # key (bytes "mst,k1=v1,k2=v2") → set of sids
+        self._keys: dict[bytes, set[int]] = {}
+        self._series_count = 0
+        self._fh = None
+        if path:
+            self._open()
+
+    def _open(self) -> None:
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + _REC.size <= len(data):
+                klen, sid = _REC.unpack_from(data, off)
+                off += _REC.size
+                key = data[off:off + klen]
+                off += klen
+                self._insert(key, sid)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "ab")
+
+    def _insert(self, key: bytes, sid: int) -> bool:
+        sids = self._keys.setdefault(key, set())
+        if sid in sids:
+            return False
+        sids.add(sid)
+        self._series_count += 1
+        return True
+
+    # ------------------------------------------------------------- write
+
+    def create_index(self, measurement: str, shard_key: str,
+                     sid: int) -> None:
+        """Register series `sid` under its shard-key value (reference
+        CreateIndex :103; dedup via the in-memory set, the reference's
+        LRU-cache-then-mergeset-lookup)."""
+        key = f"{measurement},{shard_key}".encode()
+        with self._lock:
+            if self._insert(key, sid) and self._fh is not None:
+                self._fh.write(_REC.pack(len(key), sid) + key)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def series_count(self) -> int:
+        return self._series_count
+
+    def series_for(self, measurement: str,
+                   shard_key: str) -> np.ndarray:
+        key = f"{measurement},{shard_key}".encode()
+        return np.array(sorted(self._keys.get(key, ())), dtype=np.int64)
+
+    def get_split_points(self, positions: list[int]) -> list[str]:
+        """Shard keys at the given cumulative-series-count positions, in
+        shard-key sort order (reference GetSplitPointsWithSeriesCount
+        :188). position i means: the key under which the i-th series (by
+        cumulative count over sorted keys) falls — the split boundary for
+        an even range split."""
+        return self._split(positions, lambda key, sids: len(sids))
+
+    def get_split_points_by_row_count(
+            self, positions: list[int], row_count_of) -> list[str]:
+        """Like get_split_points but weighting each key by data rows:
+        row_count_of(measurement, sid) → rows (reference
+        GetSplitPointsByRowCount :254)."""
+        def weight(key: bytes, sids: set[int]) -> int:
+            mst = key.split(b",", 1)[0].decode()
+            return sum(int(row_count_of(mst, sid)) for sid in sids)
+        return self._split(positions, weight)
+
+    def _split(self, positions: list[int], weight) -> list[str]:
+        with self._lock:
+            items = sorted(self._keys.items())
+        out = []
+        it = iter(sorted(positions))
+        want = next(it, None)
+        cum = 0
+        for key, sids in items:
+            cum += weight(key, sids)
+            while want is not None and cum > want:
+                out.append(key.split(b",", 1)[1].decode())
+                want = next(it, None)
+        if want is not None:
+            raise ValueError(
+                f"split position {want} beyond total weight {cum}")
+        return out

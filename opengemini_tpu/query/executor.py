@@ -31,7 +31,7 @@ from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
                   ExplainStatement, KillQueryStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
-from .incremental import IncAggCache, complete_prefix
+from .incremental import IncAggCache, complete_prefix, trim_left
 from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
@@ -414,21 +414,40 @@ class QueryExecutor:
             raise ErrQueryError(
                 "incremental queries require GROUP BY time() and an "
                 "explicit time range")
-        fp = f"{db}|{mst}|{stmt!r}"
+        # fingerprint must be invariant to the time range (dashboards
+        # poll now()-relative ranges), but pin everything else: select
+        # list, dimensions, fill, ordering, and the non-time predicates
+        fp = "|".join([
+            db, mst, repr(stmt.fields), repr(stmt.dimensions),
+            stmt.fill_option, repr(stmt.fill_value),
+            repr((stmt.order_desc, stmt.limit, stmt.offset, stmt.slimit,
+                  stmt.soffset)),
+            repr(sorted((f.key, f.op, f.value)
+                        for f in cond.tag_filters)),
+            repr(cond.residual)])
         cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
         if cached is not None and cached.fingerprint == fp:
+            # a now()-relative range slides: drop cached windows before
+            # the (window-aligned) new start; misaligned starts are a miss
+            cached_p = trim_left(cached.partial, cond.t_min)
+        else:
+            cached_p = None
+        if cached_p is not None:
             cond2 = copy.copy(cond)
             cond2.t_min = max(cond.t_min, cached.watermark)
             fresh = self.partial_agg(stmt, db, mst, cs, cond2, tag_keys,
                                      ctx=ctx, span=span)
-            partial = merge_partials([cached.partial, fresh])
+            if fresh is None:
+                # nothing at/after the watermark (tail data deleted):
+                # serve the cached prefix, leave the entry untouched
+                return cached_p
+            partial = merge_partials([cached_p, fresh])
         else:
             partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
                                        ctx=ctx, span=span)
         trimmed, watermark = complete_prefix(partial)
         if trimmed is not None:
-            self.inc_cache.put(inc_query_id, iter_id, fp, trimmed,
-                               watermark)
+            self.inc_cache.put(inc_query_id, fp, trimmed, watermark)
         return partial
 
     def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,

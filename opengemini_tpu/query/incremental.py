@@ -30,12 +30,11 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["IncAggCache", "complete_prefix"]
+__all__ = ["IncAggCache", "complete_prefix", "trim_left"]
 
 
 @dataclass
 class IncEntry:
-    iter_id: int
     fingerprint: str
     partial: dict
     watermark: int                # ns; next iteration scans >= this
@@ -67,8 +66,8 @@ class IncAggCache:
             self.hits += 1
             return e
 
-    def put(self, qid: str, iter_id: int, fingerprint: str,
-            partial: dict, watermark: int) -> None:
+    def put(self, qid: str, fingerprint: str, partial: dict,
+            watermark: int) -> None:
         with self._lock:
             if len(self._entries) >= self.max_entries \
                     and qid not in self._entries:
@@ -76,7 +75,7 @@ class IncAggCache:
                 oldest = min(self._entries, key=lambda k:
                              self._entries[k].ts)
                 del self._entries[oldest]
-            self._entries[qid] = IncEntry(iter_id, fingerprint, partial,
+            self._entries[qid] = IncEntry(fingerprint, partial,
                                           watermark)
 
     def drop(self, qid: str) -> None:
@@ -89,6 +88,38 @@ class IncAggCache:
 
 def _slice_cells(rows: list[list], keep_w: int) -> list[list]:
     return [row[:keep_w] for row in rows]
+
+
+def trim_left(partial: dict, new_t_min: int) -> dict | None:
+    """Drop cached windows before a (window-aligned) new range start — a
+    now()-relative dashboard slides its range forward each poll. Returns
+    None (cache miss) when the new start is misaligned with the cached
+    window grid (a straddling window would serve out-of-range points) or
+    nothing remains."""
+    interval = partial["interval"]
+    start, W = partial["start"], partial["W"]
+    if new_t_min <= start:
+        return partial
+    if (new_t_min - start) % interval != 0:
+        return None
+    k = int((new_t_min - start) // interval)
+    if k >= W:
+        return None
+    out = dict(partial)
+    out["start"] = start + k * interval
+    out["W"] = W - k
+    out["fields"] = {f: {n: v[:, k:] for n, v in st.items()}
+                     for f, st in partial["fields"].items()}
+    if "sketch" in partial:
+        out["sketch"] = {
+            f: {"c": sk["c"],
+                "cells": [row[k:] for row in sk["cells"]]}
+            for f, sk in partial["sketch"].items()}
+    if "topn" in partial:
+        tp = partial["topn"]
+        out["topn"] = dict(tp, vals=[row[k:] for row in tp["vals"]],
+                           times=[row[k:] for row in tp["times"]])
+    return out
 
 
 def complete_prefix(partial: dict | None

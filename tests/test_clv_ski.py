@@ -1,0 +1,195 @@
+"""CLV tokenized log index (reference engine/index/clv/) and shard-key
+index (reference engine/index/ski/shardkey_index.go)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.index.clv import (FUZZY, MATCH, MATCH_PHRASE, Analyzer,
+                                      CLVIndex, Collector, tokenize)
+from opengemini_tpu.index.ski import ShardKeyIndex
+
+
+# ---------------------------------------------------------------- tokenizer
+
+def test_tokenize_split_grams():
+    toks = tokenize('GET /api/v1/query?db=x "ok" [200]')
+    assert [t for t, _p in toks] == ["get", "api", "v1", "query", "db",
+                                     "x", "ok", "200"]
+    assert [p for _t, p in toks] == list(range(8))
+
+
+def test_tokenize_utf8_passthrough():
+    toks = tokenize("error: 写入失败 code=500")
+    assert ("写入失败", 1) in toks
+
+
+def test_tokenize_empty():
+    assert tokenize("") == []
+    assert tokenize(",,,") == []
+
+
+# ----------------------------------------------------------------- analyzer
+
+def test_default_analyzer_one_token_per_vtoken():
+    a = Analyzer()
+    vts = a.analyze("connection failed retry")
+    assert [(v.text, v.pos, v.n) for v in vts] == [
+        ("connection", 0, 1), ("failed", 1, 1), ("retry", 2, 1)]
+
+
+def test_learned_analyzer_greedy_longest():
+    samples = ["connection failed to host"] * 5 + ["failed to parse"] * 3
+    a = Analyzer.learn(samples, dict_size=8)
+    vts = a.analyze("connection failed to host now")
+    assert vts[0].text == "connection failed to host"
+    assert vts[0].n == 4
+    assert vts[1].text == "now" and vts[1].pos == 4
+
+
+def test_collector_prefers_frequent_then_longer():
+    c = Collector()
+    for _ in range(3):
+        c.collect("a b c")
+    top = c.top_phrases(2)
+    assert top[0] == ("a", "b", "c")    # longest among count-3 grams
+
+
+# -------------------------------------------------------------------- index
+
+@pytest.fixture
+def idx():
+    ix = CLVIndex()
+    ix.add(1, 1000, "connection failed to host db1")
+    ix.add(1, 2000, "connection established to host db1")
+    ix.add(2, 3000, "disk full on /var/data")
+    ix.add(2, 4000, "connection failed to host db2")
+    return ix
+
+
+def test_match_and_semantics(idx):
+    hits = idx.search("connection failed", MATCH)
+    assert set(hits) == {1, 2}
+    assert hits[1].tolist() == [1000]
+    assert hits[2].tolist() == [4000]
+
+
+def test_match_all_tokens_required(idx):
+    assert idx.search("connection disk", MATCH) == {}
+
+
+def test_match_phrase_adjacency(idx):
+    # "failed to host" is adjacent in rows 1000/4000 only
+    hits = idx.search("failed to host", MATCH_PHRASE)
+    assert {s: h.tolist() for s, h in hits.items()} == {
+        1: [1000], 2: [4000]}
+    # "connection host": both present but not adjacent → no phrase hit
+    assert idx.search("connection host", MATCH_PHRASE) == {}
+
+
+def test_fuzzy_wildcards(idx):
+    hits = idx.search("db?", FUZZY)
+    assert set(hits) == {1, 2}
+    hits = idx.search("estab*", FUZZY)
+    assert hits[1].tolist() == [2000]
+
+
+def test_match_with_learned_phrases():
+    samples = ["user login ok"] * 4
+    ix = CLVIndex(Analyzer.learn(samples, dict_size=4))
+    ix.add(7, 100, "user login ok from 10.0.0.1")
+    ix.add(7, 200, "user logout")
+    assert ix.vocab_size < 7        # phrases collapsed postings
+    hits = ix.search("user login ok", MATCH_PHRASE)
+    assert hits[7].tolist() == [100]
+    # single token inside a learned phrase still matches
+    hits = ix.search("login", MATCH)
+    assert hits[7].tolist() == [100]
+    hits = ix.search("user", MATCH)
+    assert hits[7].tolist() == [100, 200]
+
+
+def test_phrase_subphrase_of_learned(idx):
+    """Query phrases that are sub-phrases of — or straddle — learned
+    dictionary phrases must still match (token-level positions)."""
+    samples = ["connection refused error"] * 4
+    ix = CLVIndex(Analyzer.learn(samples, dict_size=4))
+    ix.add(3, 500, "connection refused error now")
+    assert ix.search("connection refused", MATCH_PHRASE)[3].tolist() \
+        == [500]
+    assert ix.search("error now", MATCH_PHRASE)[3].tolist() == [500]
+    assert ix.search("refused error now", MATCH_PHRASE)[3].tolist() \
+        == [500]
+    assert ix.search("error connection", MATCH_PHRASE) == {}
+
+
+def test_phrase_ns_timestamps_no_overflow():
+    """Rowids are ns epoch timestamps — position pairing must not pack
+    them into one int (overflow → false matches)."""
+    import warnings
+    ix = CLVIndex()
+    t0 = 1_700_000_000_000_000_000
+    ix.add(1, t0, "alpha beta")
+    ix.add(1, t0 + 18_446_744_073_710, "beta alpha")   # wrap-collision gap
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        hits = ix.search("alpha beta", MATCH_PHRASE)
+    assert hits[1].tolist() == [t0]
+
+
+def test_case_insensitive(idx):
+    assert set(idx.search("CONNECTION Failed", MATCH)) == {1, 2}
+
+
+# ---------------------------------------------------------------------- ski
+
+def test_ski_create_and_series_count(tmp_path):
+    ix = ShardKeyIndex(str(tmp_path / "ski.log"))
+    for sid in range(4):
+        ix.create_index("cpu", f"region=r{sid % 2}", sid)
+    ix.create_index("cpu", "region=r0", 0)     # dedup
+    assert ix.series_count == 4
+    assert ix.series_for("cpu", "region=r0").tolist() == [0, 2]
+    ix.close()
+
+
+def test_ski_persistence_roundtrip(tmp_path):
+    p = str(tmp_path / "ski.log")
+    ix = ShardKeyIndex(p)
+    ix.create_index("cpu", "host=a", 1)
+    ix.create_index("cpu", "host=b", 2)
+    ix.flush()
+    ix.close()
+    ix2 = ShardKeyIndex(p)
+    assert ix2.series_count == 2
+    assert ix2.series_for("cpu", "host=b").tolist() == [2]
+    ix2.close()
+
+
+def test_ski_split_points_by_series():
+    ix = ShardKeyIndex()
+    # keys sorted: k=a (3 series), k=b (3), k=c (3)
+    sid = 0
+    for kv in ("a", "b", "c"):
+        for _ in range(3):
+            ix.create_index("m", f"k={kv}", sid)
+            sid += 1
+    # cut at cumulative positions 3 and 6 → boundaries land in b and c
+    pts = ix.get_split_points([3, 6])
+    assert pts == ["k=b", "k=c"]
+
+
+def test_ski_split_points_by_rows():
+    ix = ShardKeyIndex()
+    ix.create_index("m", "k=a", 1)
+    ix.create_index("m", "k=b", 2)
+    rows = {1: 100, 2: 900}
+    pts = ix.get_split_points_by_row_count(
+        [500], lambda mst, sid: rows[sid])
+    assert pts == ["k=b"]
+
+
+def test_ski_split_beyond_total_raises():
+    ix = ShardKeyIndex()
+    ix.create_index("m", "k=a", 1)
+    with pytest.raises(ValueError):
+        ix.get_split_points([5])

@@ -148,10 +148,54 @@ def test_inc_raw_agg_not_cached(db):
 
 def test_cache_ttl_and_eviction():
     c = IncAggCache(ttl_s=0.0, max_entries=2)
-    c.put("a", 0, "f", {}, 0)
+    c.put("a", "f", {}, 0)
     assert c.get("a") is None          # expired immediately
     c2 = IncAggCache(max_entries=2)
-    c2.put("a", 0, "f", {}, 0)
-    c2.put("b", 0, "f", {}, 0)
-    c2.put("c", 0, "f", {}, 0)
+    c2.put("a", "f", {}, 0)
+    c2.put("b", "f", {}, 0)
+    c2.put("c", "f", {}, 0)
     assert len(c2) == 2 and c2.get("c") is not None
+
+
+def test_inc_sliding_range_reuses_cache(db):
+    """now()-relative dashboards slide the range; window-aligned starts
+    trim the cached prefix from the left instead of missing."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(4)))
+    q0 = ("SELECT mean(v) FROM m WHERE time >= 0 AND time < 6m "
+          "GROUP BY time(1m)")
+    q(ex, q0, inc_query_id="s1", iter_id=0)
+    entry = ex.inc_cache.get("s1")
+    assert entry.watermark == 3 * MIN
+    # poison a cached window that survives the slide (w=2)
+    entry.partial["fields"]["v"]["sum"][0, 2] = 77.0
+    # range slides forward by 2 aligned windows
+    q1 = ("SELECT mean(v) FROM m WHERE time >= 2m AND time < 8m "
+          "GROUP BY time(1m)")
+    r1 = q(ex, q1, inc_query_id="s1", iter_id=1)
+    vals = r1["series"][0]["values"]
+    assert vals[0][1] == 77.0           # served from trimmed cache
+    assert vals[1][1] == 3.0            # re-scanned tail
+    # misaligned slide → miss → correct full recompute
+    q2 = ("SELECT mean(v) FROM m WHERE time >= 90s AND time < 8m "
+          "GROUP BY time(1m)")
+    r2 = q(ex, q2, inc_query_id="s1", iter_id=2)
+    assert "series" in r2
+
+
+def test_inc_fresh_none_keeps_cache(db):
+    """No data at/after the watermark: serve the cached prefix and do
+    not regress the watermark."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(3)))
+    q(ex, QUERY, inc_query_id="w1", iter_id=0)
+    wm0 = ex.inc_cache.get("w1").watermark
+    # drop all data: fresh scan from the watermark finds nothing
+    eng.drop_database("db0")
+    eng.create_database("db0")
+    r1 = q(ex, QUERY, inc_query_id="w1", iter_id=1)
+    vals = rows_of(r1)["a"]
+    assert [v[1] for v in vals[:2]] == [0.0, 1.0]   # cached prefix
+    assert ex.inc_cache.get("w1").watermark == wm0  # no regression
+    r2 = q(ex, QUERY, inc_query_id="w1", iter_id=2)
+    assert rows_of(r2)["a"][0][1] == 0.0
