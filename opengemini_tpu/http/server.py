@@ -64,6 +64,10 @@ class HttpServer:
         self.sysctrl = SysControl(engine if local else None)
         self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
+        # logstore product mode (reference logkeeper; lazy — only pays
+        # when the repository/logstream APIs are used)
+        self._logstore = None
+        self._logstore_lock = threading.Lock()
         self.host = host
         self.port = port
         self._httpd: ThreadingHTTPServer | None = None
@@ -95,6 +99,126 @@ class HttpServer:
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    @property
+    def logstore(self):
+        if self._logstore is None:
+            with self._logstore_lock:
+                if self._logstore is None:
+                    import os
+
+                    from ..logstore import LogStore
+                    root = None
+                    data = getattr(self.engine, "data_path", None) \
+                        or getattr(self.engine, "path", None)
+                    if isinstance(data, str):
+                        root = os.path.join(data, "logstore")
+                    self._logstore = LogStore(root)
+        return self._logstore
+
+    # --------------------------------------------------- logstore endpoints
+
+    def handle_logstore(self, method: str, path: str, params: dict,
+                        body: bytes) -> tuple[int, dict]:
+        """Repository/logstream catalog + log ingest/query/consume APIs
+        (reference handler.go:382-459 route table; paths kept
+        compatible)."""
+        from ..logstore import decode_cursor, encode_cursor
+        ls = self.logstore
+        parts = [p for p in path.split("/") if p]
+        try:
+            # /api/v1/repository[/{repo}]
+            if parts[:3] == ["api", "v1", "repository"]:
+                if method == "GET" and len(parts) == 3:
+                    return 200, {"repositories": ls.list_repositories()}
+                repo = parts[3]
+                if method == "POST":
+                    ls.create_repository(repo)
+                    return 201, {"repository": repo}
+                if method == "DELETE":
+                    ls.delete_repository(repo)
+                    return 200, {}
+                if method == "GET":
+                    r = ls.repos.get(repo)
+                    if r is None:
+                        return 404, {"error": f"repository {repo} "
+                                     "not found"}
+                    return 200, {"repository": repo,
+                                 "logstreams": sorted(r.streams)}
+            # /api/v1/logstream/{repo}[/{stream}]
+            if parts[:3] == ["api", "v1", "logstream"]:
+                repo = parts[3]
+                if len(parts) == 4 and method == "GET":
+                    return 200, {"logstreams": ls.list_logstreams(repo)}
+                stream = parts[4]
+                if method == "POST":
+                    opts = json.loads(body or b"{}")
+                    ls.create_logstream(repo, stream,
+                                        ttl_days=float(
+                                            opts.get("ttl", 7)))
+                    return 201, {"logstream": stream}
+                if method == "DELETE":
+                    ls.delete_logstream(repo, stream)
+                    return 200, {}
+                if method == "PUT":
+                    opts = json.loads(body or b"{}")
+                    ls.update_logstream(repo, stream,
+                                        float(opts["ttl"]))
+                    return 200, {}
+                if method == "GET":
+                    return 200, ls.stream(repo, stream).stats()
+            # /repo/{r}/logstreams/{s}/<op>
+            if parts[0] == "repo" and len(parts) >= 4 \
+                    and parts[2] == "logstreams":
+                repo, stream_name = parts[1], parts[3]
+                op = "/".join(parts[4:])
+                stream = ls.stream(repo, stream_name)
+                if op == "records" and method == "POST":
+                    payload = json.loads(body or b"{}")
+                    logs = payload if isinstance(payload, list) \
+                        else payload.get("logs", [])
+                    n = stream.append(logs)
+                    return 200, {"success": True, "written": n}
+                t_min = int(params["from"]) if "from" in params else None
+                t_max = int(params["to"]) if "to" in params else None
+                if op == "logs":
+                    rows = stream.query(
+                        params.get("q", ""), t_min, t_max,
+                        limit=int(params.get("limit", 100)),
+                        reverse=params.get("reverse", "true") != "false",
+                        highlight=params.get("highlight") == "true")
+                    return 200, {"logs": rows, "count": len(rows)}
+                if op == "histogram":
+                    if t_min is None or t_max is None:
+                        return 400, {"error": "from and to required"}
+                    hist = stream.histogram(
+                        params.get("q", ""), t_min, t_max,
+                        interval=int(params.get(
+                            "interval", 60 * 10**9)))
+                    return 200, {"histograms": hist,
+                                 "count": sum(h["count"] for h in hist)}
+                if op == "context":
+                    cur = decode_cursor(params["cursor"])
+                    rows = stream.context(
+                        cur, before=int(params.get("before", 10)),
+                        after=int(params.get("after", 10)))
+                    return 200, {"logs": rows}
+                if op == "consume/logs":
+                    cur = decode_cursor(params["cursor"]) \
+                        if "cursor" in params else 0
+                    rows, nxt = stream.read_from(
+                        cur, count=int(params.get("count", 100)))
+                    return 200, {"logs": rows,
+                                 "cursor": encode_cursor(nxt)}
+                if op == "consume/cursor-time":
+                    seq = stream.cursor_at_time(int(params["time"]))
+                    return 200, {"cursor": encode_cursor(seq)}
+            return 404, {"error": f"not found: {method} {path}"}
+        except IndexError:
+            return 400, {"error": f"bad path: {path}"}
+        except (KeyError, ValueError) as e:
+            code = 404 if "not found" in str(e) else 400
+            return code, {"error": str(e)}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -392,12 +516,23 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = srv.handle_query(self._params())
             self._reply(code, payload)
             return
+        if self._is_logstore(path):
+            code, payload = srv.handle_logstore("GET", path,
+                                                self._params(), b"")
+            self._reply(code, payload)
+            return
         if path.startswith("/api/v1/"):
             code, payload = srv.handle_prom(path, self._params(),
                                             self._params_multi())
             self._reply(code, payload)
             return
         self._reply(404, {"error": f"not found: {path}"})
+
+    @staticmethod
+    def _is_logstore(path: str) -> bool:
+        return (path.startswith("/api/v1/repository")
+                or path.startswith("/api/v1/logstream")
+                or path.startswith("/repo/"))
 
     def do_POST(self):
         srv = self.server_ref
@@ -420,6 +555,16 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = srv.handle_query(params)
             self._reply(code, payload)
             return
+        if self._is_logstore(path):
+            try:
+                body = self._body()
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload = srv.handle_logstore("POST", path,
+                                                self._params(), body)
+            self._reply(code, payload)
+            return
         if path.startswith("/api/v1/"):
             try:
                 params = self._form_params(self._params())
@@ -428,6 +573,29 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             code, payload = srv.handle_prom(path, params,
                                             self._params_multi())
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": f"not found: {path}"})
+
+    def do_DELETE(self):
+        path = self._path()
+        if self._is_logstore(path):
+            code, payload = self.server_ref.handle_logstore(
+                "DELETE", path, self._params(), b"")
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": f"not found: {path}"})
+
+    def do_PUT(self):
+        path = self._path()
+        if self._is_logstore(path):
+            try:
+                body = self._body()
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload = self.server_ref.handle_logstore(
+                "PUT", path, self._params(), body)
             self._reply(code, payload)
             return
         self._reply(404, {"error": f"not found: {path}"})

@@ -164,12 +164,17 @@ class CLVIndex:
     def __init__(self, analyzer: Analyzer | None = None):
         self.analyzer = analyzer or Analyzer()
         self._postings: dict[str, dict[int, _Posting]] = {}
+        # multi-token vocabulary entries only — single tokens hit the
+        # postings dict directly; phrase entries (rare) are scanned
+        self._phrase_vts: set[str] = set()
         self.docs = 0
 
     def add(self, sid: int, timestamp: int, text: str) -> None:
         self.docs += 1
         for vt in self.analyzer.analyze(text):
             by_sid = self._postings.setdefault(vt.text, {})
+            if vt.n > 1:
+                self._phrase_vts.add(vt.text)
             p = by_sid.setdefault(sid, _Posting())
             p.rowids.append(timestamp)
             p.positions.append(vt.pos)
@@ -200,10 +205,12 @@ class CLVIndex:
         """A single query token also matches inside learned phrases —
         scan vocabulary entries containing it."""
         acc: dict[int, list] = {}
-        for vt in self._postings:
-            if tok == vt or (" " in vt and tok in vt.split(" ")):
-                for sid, rows in self._rows_for_vtoken(vt).items():
-                    acc.setdefault(sid, []).append(rows)
+        hits = [tok] if tok in self._postings else []
+        hits += [vt for vt in self._phrase_vts
+                 if tok in vt.split(" ")]
+        for vt in hits:
+            for sid, rows in self._rows_for_vtoken(vt).items():
+                acc.setdefault(sid, []).append(rows)
         return {sid: np.unique(np.concatenate(rs))
                 for sid, rs in acc.items()}
 
@@ -214,11 +221,12 @@ class CLVIndex:
         phrase posted at position P sits at absolute position P+k — the
         reference's assembleId(id, offset) scheme, clv/index.go:179)."""
         acc: dict[int, list] = {}
-        for vt, by_sid in self._postings.items():
+        cands = ([tok] if tok in self._postings else []) \
+            + [vt for vt in self._phrase_vts if tok in vt.split(" ")]
+        for vt in cands:
+            by_sid = self._postings[vt]
             toks = vt.split(" ") if " " in vt else [vt]
             offs = [k for k, t in enumerate(toks) if t == tok]
-            if not offs:
-                continue
             for sid, p in by_sid.items():
                 rows = np.asarray(p.rowids, dtype=np.int64)
                 pos = np.asarray(p.positions, dtype=np.int64)

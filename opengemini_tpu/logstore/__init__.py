@@ -1,0 +1,12 @@
+"""Logstore / logkeeper product mode.
+
+Role of the reference's log-storage stack (SURVEY.md §2.7): `lib/logstore/`
+(log blocks with per-block token bloom filters, block LRU caches, hot-data
+detector), the logstream/repository catalog (`handler_logstore.go`), the
+keyword/histogram/context query APIs (`handler_logstore_query.go`) and the
+cursor-based consume APIs (`handler_logstore_consume.go`).
+"""
+
+from .store import (LogStore, Repository, LogStream, LogRecord, Segment,
+                    BlockCache, HotDataDetector, parse_log_query)
+from .consume import encode_cursor, decode_cursor

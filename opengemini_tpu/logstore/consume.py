@@ -1,0 +1,28 @@
+"""Consume cursors (reference handler_logstore_consume.go).
+
+The reference encodes a multi-part cursor (shard/segment/offset + task
+state); here a cursor is the stream-monotonic record seq, wrapped in an
+opaque versioned token so clients cannot depend on its shape."""
+
+from __future__ import annotations
+
+import base64
+import struct
+
+_MAGIC = b"ogc1"
+_FMT = struct.Struct("<4sq")
+
+
+def encode_cursor(seq: int) -> str:
+    return base64.urlsafe_b64encode(_FMT.pack(_MAGIC, seq)).decode()
+
+
+def decode_cursor(token: str) -> int:
+    try:
+        raw = base64.urlsafe_b64decode(token.encode())
+        magic, seq = _FMT.unpack(raw)
+    except Exception:
+        raise ValueError(f"invalid cursor {token!r}")
+    if magic != _MAGIC:
+        raise ValueError(f"invalid cursor {token!r}")
+    return seq

@@ -1,0 +1,613 @@
+"""Log repositories, streams, and segment storage.
+
+Reference mapping:
+- Repository/LogStream catalog + TTL → `handler_logstore.go:198-489`
+  (serveCreateRepository/serveCreateLogstream; a logstream's `ttl` drives
+  retention like a shard-group duration).
+- Segment = the reference's log block (`lib/logstore/block_container.go`):
+  an append-sealed run of records with a per-block token **bloom filter**
+  (`lib/logstore/bloomfilter.go`) for query pruning, plus a per-segment
+  CLV inverted index (engine/index/clv) for token/phrase search.
+- BlockCache/HotDataDetector → `lib/logstore/block_cache.go`,
+  `lru_cache.go`, `hot_data_detector.go`: sealed segment payloads drop to
+  disk and reload through an LRU; repeatedly-hit segments are "hot" and
+  pinned.
+
+Records are addressed by a stream-monotonic int64 `seq` — the consume
+cursor (consume.py) and the CLV row id at the same time (unique, unlike
+timestamps). Segments own the seq range [base_seq, base_seq + n).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..index.clv import (FUZZY, MATCH, MATCH_PHRASE, Analyzer, CLVIndex,
+                         tokenize)
+from ..index.sparse import Bloom
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_SEGMENT_ROWS = 8192
+DEFAULT_TTL_DAYS = 7
+_NS_PER_DAY = 86400 * 10**9
+
+
+@dataclass
+class LogRecord:
+    seq: int
+    time: int                     # ns
+    content: str
+    tags: dict = field(default_factory=dict)
+
+    def to_obj(self, highlight: list[str] | None = None) -> dict:
+        o = {"cursor": self.seq, "timestamp": self.time,
+             "content": self.content, "tags": self.tags}
+        if highlight:
+            o["highlight"] = _highlight(self.content, highlight)
+        return o
+
+
+def _highlight(content: str, tokens: list[str]) -> list[dict]:
+    """Split content into {fragment, highlight} pieces around query-token
+    hits (reference getHighlightFragments, handler_logstore_query.go:482)."""
+    if not tokens:
+        return [{"fragment": content, "highlight": False}]
+    pat = "|".join(re.escape(t) for t in sorted(tokens, key=len,
+                                                reverse=True))
+    out = []
+    last = 0
+    for m in re.finditer(pat, content, re.IGNORECASE):
+        if m.start() > last:
+            out.append({"fragment": content[last:m.start()],
+                        "highlight": False})
+        out.append({"fragment": m.group(0), "highlight": True})
+        last = m.end()
+    if last < len(content):
+        out.append({"fragment": content[last:], "highlight": False})
+    return out
+
+
+# ------------------------------------------------------------------ segment
+
+class Segment:
+    """One sealed-or-active run of log records with its own CLV index and
+    (when sealed) a token bloom filter + on-disk payload."""
+
+    def __init__(self, seg_id: int, base_seq: int, path: str | None,
+                 analyzer: Analyzer | None = None):
+        self.seg_id = seg_id
+        self.base_seq = base_seq
+        self.path = path
+        self.n = 0
+        self.min_time = 2**63 - 1
+        self.max_time = -2**63
+        self.sealed = False
+        self.bloom: Bloom | None = None
+        self.index = CLVIndex(analyzer)
+        self._records: list[LogRecord] | None = []
+        self._tokens: set[str] = set()
+
+    # ---- write
+
+    def append(self, rec: LogRecord) -> None:
+        assert not self.sealed
+        self._records.append(rec)
+        self.n += 1
+        self.min_time = min(self.min_time, rec.time)
+        self.max_time = max(self.max_time, rec.time)
+        self.index.add(self.seg_id, rec.seq, rec.content)
+        for t, _p in tokenize(rec.content):
+            self._tokens.add(t)
+
+    def seal(self, rewrite: bool = True) -> None:
+        """Freeze: build the bloom filter, persist the payload, allow the
+        in-memory record list to be evicted. rewrite=False when the
+        payload file already holds exactly these records (recovery path —
+        avoids rewriting the whole dataset on startup)."""
+        if self.sealed:
+            return
+        self.bloom = Bloom.build([t.encode() for t in self._tokens]) \
+            if self._tokens else None
+        if self.path and rewrite:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                for r in self._records:
+                    f.write(json.dumps(
+                        {"seq": r.seq, "t": r.time, "c": r.content,
+                         "g": r.tags}) + "\n")
+            os.replace(tmp, self.path)
+        self.sealed = True
+        self._tokens = set()
+
+    def evict(self) -> bool:
+        """Drop the in-memory payload (sealed + persisted only)."""
+        if self.sealed and self.path and self._records is not None:
+            self._records = None
+            return True
+        return False
+
+    @property
+    def resident(self) -> bool:
+        return self._records is not None
+
+    # ---- read
+
+    def records(self) -> list[LogRecord]:
+        if self._records is None:
+            recs = []
+            with open(self.path) as f:
+                for line in f:
+                    o = json.loads(line)
+                    recs.append(LogRecord(o["seq"], o["t"], o["c"],
+                                          o.get("g", {})))
+            self._records = recs
+        return self._records
+
+    def record_by_seq(self, seq: int) -> LogRecord | None:
+        i = seq - self.base_seq
+        recs = self.records()
+        if 0 <= i < len(recs):
+            return recs[i]
+        return None
+
+    def may_match(self, tokens: list[str]) -> bool:
+        """Bloom prune: every plain query token must maybe-exist
+        (reference bloomfilter_cache_reader.go). Wildcards skip."""
+        if not self.sealed or self.bloom is None:
+            return True
+        for t in tokens:
+            if "*" in t or "?" in t:
+                continue
+            if not self.bloom.may_contain(t.encode()):
+                return False
+        return True
+
+    @classmethod
+    def load(cls, seg_id: int, path: str,
+             analyzer: Analyzer | None = None) -> "Segment":
+        """Rebuild a sealed segment from its payload file (open path)."""
+        with open(path) as f:
+            objs = [json.loads(line) for line in f]
+        base = objs[0]["seq"] if objs else 0
+        seg = cls(seg_id, base, path, analyzer)
+        for o in objs:
+            seg.append(LogRecord(o["seq"], o["t"], o["c"], o.get("g", {})))
+        seg.seal(rewrite=False)
+        return seg
+
+
+# ----------------------------------------------------- cache + hot detector
+
+class BlockCache:
+    """LRU bound on resident sealed-segment payloads (reference
+    lib/logstore/block_cache.go + lru_cache.go). Hot segments are exempt
+    from eviction."""
+
+    def __init__(self, max_resident: int = 16,
+                 detector: "HotDataDetector | None" = None):
+        self.max_resident = max_resident
+        self.detector = detector or HotDataDetector()
+        self._lru: OrderedDict[tuple, Segment] = OrderedDict()
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def touch(self, key: tuple, seg: Segment) -> None:
+        with self._lock:
+            self.detector.record(key)
+            self._lru[key] = seg
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.max_resident:
+                victim = None
+                for k in self._lru:       # oldest first
+                    if not self.detector.is_hot(k):
+                        victim = k
+                        break
+                if victim is None:        # everything hot: evict oldest
+                    victim = next(iter(self._lru))
+                seg = self._lru.pop(victim)
+                if seg.evict():
+                    self.evictions += 1
+
+
+class HotDataDetector:
+    """Flags blocks accessed ≥ `threshold` times inside `window_s`
+    (reference lib/logstore/hot_data_detector.go)."""
+
+    def __init__(self, threshold: int = 4, window_s: float = 60.0):
+        self.threshold = threshold
+        self.window_s = window_s
+        self._hits: dict[tuple, list[float]] = {}
+
+    def record(self, key: tuple, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        hits = self._hits.setdefault(key, [])
+        hits.append(now)
+        cutoff = now - self.window_s
+        while hits and hits[0] < cutoff:
+            hits.pop(0)
+
+    def is_hot(self, key: tuple, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        hits = self._hits.get(key, ())
+        return sum(1 for h in hits if h >= now - self.window_s) \
+            >= self.threshold
+
+
+# ------------------------------------------------------------- query parse
+
+def parse_log_query(q: str) -> list[tuple[int, str]]:
+    """Parse a keyword query into (qtype, term) clauses, all ANDed:
+    bare tokens → MATCH, "quoted strings" → MATCH_PHRASE, tokens with
+    * or ? → FUZZY. Empty query matches everything."""
+    clauses: list[tuple[int, str]] = []
+    for m in re.finditer(r'"([^"]*)"|(\S+)', q or ""):
+        if m.group(1) is not None:
+            if m.group(1).strip():
+                clauses.append((MATCH_PHRASE, m.group(1)))
+        else:
+            term = m.group(2)
+            if "*" in term or "?" in term:
+                clauses.append((FUZZY, term))
+            else:
+                clauses.append((MATCH, term))
+    return clauses
+
+
+# ------------------------------------------------------------------ stream
+
+class LogStream:
+    """One log stream: ordered segments + per-segment CLV/bloom search."""
+
+    def __init__(self, repo: str, name: str, dirpath: str | None,
+                 ttl_days: float = DEFAULT_TTL_DAYS,
+                 segment_rows: int = DEFAULT_SEGMENT_ROWS,
+                 cache: BlockCache | None = None):
+        self.repo = repo
+        self.name = name
+        self.dir = dirpath
+        self.ttl_days = ttl_days
+        self.segment_rows = segment_rows
+        self.cache = cache or BlockCache()
+        self._lock = threading.Lock()
+        self.segments: list[Segment] = []
+        self._active: Segment | None = None
+        self.next_seq = 0
+        self.total_records = 0
+        if dirpath:
+            os.makedirs(dirpath, exist_ok=True)
+            self._recover()
+
+    def _recover(self) -> None:
+        files = sorted(f for f in os.listdir(self.dir)
+                       if f.startswith("seg") and f.endswith(".log"))
+        for f in files:
+            seg_id = int(f[3:-4])
+            seg = Segment.load(seg_id, os.path.join(self.dir, f))
+            self.segments.append(seg)
+            self.next_seq = max(self.next_seq, seg.base_seq + seg.n)
+            self.total_records += seg.n
+
+    def _seg_path(self, seg_id: int) -> str | None:
+        return os.path.join(self.dir, f"seg{seg_id:08d}.log") \
+            if self.dir else None
+
+    # ---- write
+
+    def append(self, entries: list[dict]) -> int:
+        """entries: [{"content": str, "timestamp": ns, "tags": {...}}].
+        Returns count written (reference serveRecord ingest)."""
+        with self._lock:
+            for e in entries:
+                if self._active is None \
+                        or self._active.n >= self.segment_rows:
+                    self._roll()
+                rec = LogRecord(self.next_seq,
+                                int(e.get("timestamp",
+                                          time.time_ns())),
+                                str(e.get("content", "")),
+                                dict(e.get("tags", {})))
+                self._active.append(rec)
+                self.next_seq += 1
+                self.total_records += 1
+            return len(entries)
+
+    def _roll(self) -> None:
+        if self._active is not None:
+            self._active.seal()
+            self.cache.touch((self.repo, self.name,
+                              self._active.seg_id), self._active)
+        seg_id = self.segments[-1].seg_id + 1 if self.segments else 0
+        seg = Segment(seg_id, self.next_seq, self._seg_path(seg_id))
+        self.segments.append(seg)
+        self._active = seg
+
+    def seal_active(self) -> None:
+        with self._lock:
+            if self._active is not None:
+                self._active.seal()
+                self._active = None
+
+    # ---- search
+
+    def _matching_seqs(self, seg: Segment,
+                       clauses: list[tuple[int, str]]) -> np.ndarray:
+        """Seqs in one segment matching all clauses (AND)."""
+        if not clauses:
+            return seg.base_seq + np.arange(seg.n, dtype=np.int64)
+        acc: np.ndarray | None = None
+        for qtype, term in clauses:
+            hits = seg.index.search(term, qtype)
+            rows = hits.get(seg.seg_id, np.empty(0, dtype=np.int64))
+            acc = rows if acc is None else acc[np.isin(acc, rows)]
+            if not len(acc):
+                break
+        return acc
+
+    def query(self, q: str = "", t_min: int | None = None,
+              t_max: int | None = None, limit: int = 100,
+              reverse: bool = True, highlight: bool = False
+              ) -> list[dict]:
+        """Keyword search (reference serveQueryLog): time-pruned segments
+        → bloom prune → CLV search → records, newest first by default."""
+        clauses = parse_log_query(q)
+        plain = [t for ty, term in clauses if ty != FUZZY
+                 for t, _p in tokenize(term)]
+        out: list[LogRecord] = []
+        with self._lock:
+            segs = list(self.segments)
+        for seg in (reversed(segs) if reverse else segs):
+            if len(out) >= limit:
+                break
+            if seg.n == 0:
+                continue
+            if t_min is not None and seg.max_time < t_min:
+                continue
+            if t_max is not None and seg.min_time > t_max:
+                continue
+            if not seg.may_match(plain):
+                continue
+            seqs = self._matching_seqs(seg, clauses)
+            if not len(seqs):
+                continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
+            recs = [seg.record_by_seq(int(s)) for s in
+                    (seqs[::-1] if reverse else seqs)]
+            for r in recs:
+                if r is None:
+                    continue
+                if t_min is not None and r.time < t_min:
+                    continue
+                if t_max is not None and r.time > t_max:
+                    continue
+                out.append(r)
+                if len(out) >= limit:
+                    break
+        hl = [term for ty, term in clauses if ty != FUZZY] \
+            if highlight else None
+        hl_tokens = [t for term in hl or [] for t, _p in tokenize(term)]
+        return [r.to_obj(hl_tokens if highlight else None) for r in out]
+
+    def histogram(self, q: str = "", t_min: int = 0, t_max: int = 0,
+                  interval: int = 60 * 10**9) -> list[dict]:
+        """Per-time-bucket match counts (reference serveAggLogQuery /
+        getHistogramsForAggLog) — one vectorized bincount over matched
+        record times."""
+        clauses = parse_log_query(q)
+        plain = [t for ty, term in clauses if ty != FUZZY
+                 for t, _p in tokenize(term)]
+        n_buckets = max(int((t_max - t_min + interval - 1) // interval), 1)
+        counts = np.zeros(n_buckets, dtype=np.int64)
+        with self._lock:
+            segs = list(self.segments)
+        for seg in segs:
+            if seg.n == 0 or seg.max_time < t_min \
+                    or seg.min_time >= t_max or not seg.may_match(plain):
+                continue
+            seqs = self._matching_seqs(seg, clauses)
+            if not len(seqs):
+                continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
+            times = np.array([seg.record_by_seq(int(s)).time
+                              for s in seqs], dtype=np.int64)
+            keep = (times >= t_min) & (times < t_max)
+            if keep.any():
+                b = ((times[keep] - t_min) // interval).astype(np.int64)
+                counts += np.bincount(b, minlength=n_buckets)
+        return [{"from": int(t_min + i * interval),
+                 "to": int(min(t_min + (i + 1) * interval, t_max)),
+                 "count": int(c)} for i, c in enumerate(counts)]
+
+    def context(self, seq: int, before: int = 10, after: int = 10
+                ) -> list[dict]:
+        """Records around a cursor (reference serveContextQueryLog)."""
+        lo, hi = max(seq - before, 0), seq + after + 1
+        out = []
+        with self._lock:
+            segs = list(self.segments)
+        for seg in segs:
+            if seg.base_seq + seg.n <= lo or seg.base_seq >= hi:
+                continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
+            for s in range(max(lo, seg.base_seq),
+                           min(hi, seg.base_seq + seg.n)):
+                r = seg.record_by_seq(s)
+                if r is not None:
+                    out.append(r.to_obj())
+        return out
+
+    # ---- consume
+
+    def read_from(self, seq: int, count: int = 100
+                  ) -> tuple[list[dict], int]:
+        """Cursor tail-read: up to `count` records with seq >= cursor;
+        returns (records, next_cursor) (reference serveConsumeLogs)."""
+        out = []
+        with self._lock:
+            segs = list(self.segments)
+        for seg in segs:
+            if seg.base_seq + seg.n <= seq:
+                continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
+            for s in range(max(seq, seg.base_seq), seg.base_seq + seg.n):
+                out.append(seg.record_by_seq(s).to_obj())
+                if len(out) >= count:
+                    return out, int(out[-1]["cursor"]) + 1
+        next_cur = int(out[-1]["cursor"]) + 1 if out else seq
+        return out, next_cur
+
+    def cursor_at_time(self, t: int) -> int:
+        """Smallest seq with record time >= t (reference
+        serveConsumeCursorTime)."""
+        with self._lock:
+            segs = list(self.segments)
+        for seg in segs:
+            if seg.n == 0 or seg.max_time < t:
+                continue
+            for s in range(seg.base_seq, seg.base_seq + seg.n):
+                r = seg.record_by_seq(s)
+                if r.time >= t:
+                    return s
+        return self.next_seq
+
+    # ---- retention
+
+    def apply_retention(self, now_ns: int | None = None) -> int:
+        """Drop sealed segments entirely older than the TTL; returns
+        segments removed (reference logstream ttl + retention service)."""
+        now_ns = time.time_ns() if now_ns is None else now_ns
+        cutoff = now_ns - int(self.ttl_days * _NS_PER_DAY)
+        removed = 0
+        with self._lock:
+            keep = []
+            for seg in self.segments:
+                if seg.sealed and seg.max_time < cutoff:
+                    if seg.path and os.path.exists(seg.path):
+                        os.remove(seg.path)
+                    self.total_records -= seg.n
+                    removed += 1
+                else:
+                    keep.append(seg)
+            self.segments = keep
+        return removed
+
+    def stats(self) -> dict:
+        return {"records": self.total_records,
+                "segments": len(self.segments),
+                "resident": sum(1 for s in self.segments if s.resident),
+                "ttl_days": self.ttl_days}
+
+
+# ------------------------------------------------------------------- store
+
+class Repository:
+    def __init__(self, name: str, dirpath: str | None):
+        self.name = name
+        self.dir = dirpath
+        self.streams: dict[str, LogStream] = {}
+        self.props: dict = {}
+
+
+class LogStore:
+    """Repository/logstream catalog rooted at a directory (reference
+    repository≈database, logstream≈measurement with TTL)."""
+
+    def __init__(self, root: str | None = None):
+        self.root = root
+        self._lock = threading.Lock()
+        self.repos: dict[str, Repository] = {}
+        self.cache = BlockCache()
+        if root:
+            os.makedirs(root, exist_ok=True)
+            for rname in sorted(os.listdir(root)):
+                rdir = os.path.join(root, rname)
+                if not os.path.isdir(rdir):
+                    continue
+                repo = Repository(rname, rdir)
+                for sname in sorted(os.listdir(rdir)):
+                    sdir = os.path.join(rdir, sname)
+                    if os.path.isdir(sdir):
+                        repo.streams[sname] = LogStream(
+                            rname, sname, sdir, cache=self.cache)
+                self.repos[rname] = repo
+
+    # ---- repository CRUD (serveCreateRepository et al.)
+
+    def create_repository(self, name: str) -> None:
+        with self._lock:
+            if name in self.repos:
+                raise ValueError(f"repository {name} already exists")
+            rdir = os.path.join(self.root, name) if self.root else None
+            if rdir:
+                os.makedirs(rdir, exist_ok=True)
+            self.repos[name] = Repository(name, rdir)
+
+    def delete_repository(self, name: str) -> None:
+        with self._lock:
+            repo = self.repos.pop(name, None)
+            if repo is None:
+                raise KeyError(f"repository {name} not found")
+            if repo.dir and os.path.isdir(repo.dir):
+                import shutil
+                shutil.rmtree(repo.dir)
+
+    def list_repositories(self) -> list[str]:
+        return sorted(self.repos)
+
+    # ---- logstream CRUD (serveCreateLogstream et al.)
+
+    def create_logstream(self, repo: str, name: str,
+                         ttl_days: float = DEFAULT_TTL_DAYS) -> None:
+        with self._lock:
+            r = self._repo(repo)
+            if name in r.streams:
+                raise ValueError(f"logstream {name} already exists")
+            sdir = os.path.join(r.dir, name) if r.dir else None
+            r.streams[name] = LogStream(repo, name, sdir,
+                                        ttl_days=ttl_days,
+                                        cache=self.cache)
+
+    def delete_logstream(self, repo: str, name: str) -> None:
+        with self._lock:
+            r = self._repo(repo)
+            s = r.streams.pop(name, None)
+            if s is None:
+                raise KeyError(f"logstream {name} not found")
+            if s.dir and os.path.isdir(s.dir):
+                import shutil
+                shutil.rmtree(s.dir)
+
+    def list_logstreams(self, repo: str) -> list[str]:
+        return sorted(self._repo(repo).streams)
+
+    def update_logstream(self, repo: str, name: str,
+                         ttl_days: float) -> None:
+        self.stream(repo, name).ttl_days = ttl_days
+
+    def _repo(self, name: str) -> Repository:
+        r = self.repos.get(name)
+        if r is None:
+            raise KeyError(f"repository {name} not found")
+        return r
+
+    def stream(self, repo: str, name: str) -> LogStream:
+        s = self._repo(repo).streams.get(name)
+        if s is None:
+            raise KeyError(f"logstream {name} not found")
+        return s
+
+    def apply_retention(self, now_ns: int | None = None) -> int:
+        n = 0
+        for r in list(self.repos.values()):
+            for s in list(r.streams.values()):
+                n += s.apply_retention(now_ns)
+        return n

@@ -31,7 +31,8 @@ from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
                   ExplainStatement, KillQueryStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
-from .incremental import IncAggCache, complete_prefix, trim_left
+from .incremental import (IncAggCache, complete_prefix, trim_left,
+                          trim_right)
 from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
@@ -427,9 +428,11 @@ class QueryExecutor:
             repr(cond.residual)])
         cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
         if cached is not None and cached.fingerprint == fp:
-            # a now()-relative range slides: drop cached windows before
-            # the (window-aligned) new start; misaligned starts are a miss
+            # a now()-relative range slides: drop cached windows outside
+            # the (window-aligned) new bounds; misaligned edges are a miss
             cached_p = trim_left(cached.partial, cond.t_min)
+            if cached_p is not None:
+                cached_p = trim_right(cached_p, cond.t_max)
         else:
             cached_p = None
         if cached_p is not None:

@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["IncAggCache", "complete_prefix", "trim_left"]
+__all__ = ["IncAggCache", "complete_prefix", "trim_left", "trim_right"]
 
 
 @dataclass
@@ -88,6 +88,36 @@ class IncAggCache:
 
 def _slice_cells(rows: list[list], keep_w: int) -> list[list]:
     return [row[:keep_w] for row in rows]
+
+
+def trim_right(partial: dict, new_t_max: int) -> dict | None:
+    """Drop cached windows at/after an (aligned) shrunken range end —
+    symmetric to trim_left; serving a cached window past t_max would
+    return out-of-range rows. t_max is the inclusive ns bound (influx
+    `time < X` analyzes to t_max = X-1)."""
+    interval = partial["interval"]
+    start, W = partial["start"], partial["W"]
+    end_excl = new_t_max + 1
+    if end_excl >= start + W * interval:
+        return partial
+    if (end_excl - start) % interval != 0:
+        return None
+    keep = int((end_excl - start) // interval)
+    if keep <= 0:
+        return None
+    out = dict(partial)
+    out["W"] = keep
+    out["fields"] = {f: {n: v[:, :keep] for n, v in st.items()}
+                     for f, st in partial["fields"].items()}
+    if "sketch" in partial:
+        out["sketch"] = {
+            f: {"c": sk["c"], "cells": _slice_cells(sk["cells"], keep)}
+            for f, sk in partial["sketch"].items()}
+    if "topn" in partial:
+        tp = partial["topn"]
+        out["topn"] = dict(tp, vals=_slice_cells(tp["vals"], keep),
+                           times=_slice_cells(tp["times"], keep))
+    return out
 
 
 def trim_left(partial: dict, new_t_min: int) -> dict | None:

@@ -183,6 +183,22 @@ def test_inc_sliding_range_reuses_cache(db):
     assert "series" in r2
 
 
+def test_inc_shrunken_range_right_trim(db):
+    """Reusing an inc_query_id with a smaller t_max must not serve
+    cached windows beyond the new range."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={w} {w * MIN}" for w in range(6)))
+    q0 = ("SELECT mean(v) FROM m WHERE time >= 0 AND time < 6m "
+          "GROUP BY time(1m)")
+    q(ex, q0, inc_query_id="rt1", iter_id=0)
+    q1 = ("SELECT mean(v) FROM m WHERE time >= 1m AND time < 3m "
+          "GROUP BY time(1m)")
+    r1 = q(ex, q1, inc_query_id="rt1", iter_id=1)
+    plain = q(ex, q1)
+    assert r1 == plain
+    assert [v[0] // MIN for v in r1["series"][0]["values"]] == [1, 2]
+
+
 def test_inc_fresh_none_keeps_cache(db):
     """No data at/after the watermark: serve the cached prefix and do
     not regress the watermark."""

@@ -1,0 +1,328 @@
+"""Logstore subsystem: repositories/logstreams, segment seal + bloom,
+block cache/hot detector, keyword/histogram/context queries, consume
+cursors, retention, and the HTTP surface (reference lib/logstore/,
+handler_logstore*.go)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.logstore import (BlockCache, HotDataDetector, LogStore,
+                                     LogStream, Segment, decode_cursor,
+                                     encode_cursor, parse_log_query)
+from opengemini_tpu.index.clv import FUZZY, MATCH, MATCH_PHRASE
+
+SEC = 10**9
+MIN = 60 * SEC
+
+
+def fill(stream, n=10, t0=0, step=SEC, text="request {} ok"):
+    stream.append([{"content": text.format(i), "timestamp": t0 + i * step}
+                   for i in range(n)])
+
+
+# ---------------------------------------------------------------- catalog
+
+def test_repo_stream_crud(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("prod")
+    ls.create_logstream("prod", "nginx", ttl_days=3)
+    assert ls.list_repositories() == ["prod"]
+    assert ls.list_logstreams("prod") == ["nginx"]
+    with pytest.raises(ValueError):
+        ls.create_repository("prod")
+    with pytest.raises(KeyError):
+        ls.stream("prod", "nope")
+    ls.delete_logstream("prod", "nginx")
+    assert ls.list_logstreams("prod") == []
+    ls.delete_repository("prod")
+    assert ls.list_repositories() == []
+
+
+def test_store_recovery(tmp_path):
+    root = str(tmp_path / "ls")
+    ls = LogStore(root)
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    fill(st, 20)
+    st.seal_active()
+    ls2 = LogStore(root)
+    st2 = ls2.stream("r", "s")
+    assert st2.total_records == 20
+    assert st2.next_seq == 20
+    rows = st2.query("request", limit=5)
+    assert len(rows) == 5
+
+
+# ---------------------------------------------------------------- queries
+
+@pytest.fixture
+def stream(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "app")
+    st = ls.stream("r", "app")
+    st.append([
+        {"content": "GET /api/users 200 fast", "timestamp": 1 * MIN},
+        {"content": "GET /api/users 500 error timeout", "timestamp": 2 * MIN},
+        {"content": "POST /api/orders 201 created", "timestamp": 3 * MIN},
+        {"content": "connection refused error", "timestamp": 4 * MIN},
+        {"content": "GET /health 200", "timestamp": 5 * MIN},
+    ])
+    return st
+
+
+def test_query_keyword_and(stream):
+    rows = stream.query("error")
+    assert len(rows) == 2
+    assert rows[0]["timestamp"] == 4 * MIN       # newest first
+    rows = stream.query("error timeout")
+    assert len(rows) == 1 and "500" in rows[0]["content"]
+
+
+def test_query_phrase_and_fuzzy(stream):
+    rows = stream.query('"connection refused"')
+    assert len(rows) == 1
+    assert stream.query('"refused connection"') == []
+    rows = stream.query("time*")
+    assert len(rows) == 1 and "timeout" in rows[0]["content"]
+
+
+def test_query_time_range_and_order(stream):
+    rows = stream.query("", t_min=2 * MIN, t_max=4 * MIN, reverse=False)
+    assert [r["timestamp"] for r in rows] == [2 * MIN, 3 * MIN, 4 * MIN]
+
+
+def test_query_highlight(stream):
+    rows = stream.query("error", highlight=True, limit=1)
+    frags = rows[0]["highlight"]
+    assert any(f["highlight"] and f["fragment"].lower() == "error"
+               for f in frags)
+    # round trip: fragments reassemble the content
+    assert "".join(f["fragment"] for f in frags) == rows[0]["content"]
+
+
+def test_parse_log_query():
+    assert parse_log_query('foo "bar baz" qu?x') == [
+        (MATCH, "foo"), (MATCH_PHRASE, "bar baz"), (FUZZY, "qu?x")]
+    assert parse_log_query("") == []
+
+
+def test_histogram(stream):
+    hist = stream.histogram("", t_min=MIN, t_max=6 * MIN, interval=MIN)
+    assert [h["count"] for h in hist] == [1, 1, 1, 1, 1]
+    hist = stream.histogram("error", t_min=0, t_max=6 * MIN,
+                            interval=3 * MIN)
+    assert [h["count"] for h in hist] == [1, 1]
+
+
+def test_context(stream):
+    rows = stream.context(2, before=1, after=1)
+    assert [r["cursor"] for r in rows] == [1, 2, 3]
+
+
+# ---------------------------------------------------------------- consume
+
+def test_consume_cursor_tail(stream):
+    rows, cur = stream.read_from(0, count=3)
+    assert [r["cursor"] for r in rows] == [0, 1, 2]
+    rows, cur2 = stream.read_from(cur, count=10)
+    assert [r["cursor"] for r in rows] == [3, 4]
+    # nothing new: cursor stable
+    rows, cur3 = stream.read_from(cur2)
+    assert rows == [] and cur3 == cur2
+    # late append resumes from the same cursor
+    stream.append([{"content": "new line", "timestamp": 6 * MIN}])
+    rows, _ = stream.read_from(cur3)
+    assert len(rows) == 1 and rows[0]["content"] == "new line"
+
+
+def test_cursor_at_time(stream):
+    assert stream.cursor_at_time(3 * MIN) == 2
+    assert stream.cursor_at_time(0) == 0
+    assert stream.cursor_at_time(10 * MIN) == stream.next_seq
+
+
+def test_cursor_token_roundtrip():
+    tok = encode_cursor(12345)
+    assert decode_cursor(tok) == 12345
+    with pytest.raises(ValueError):
+        decode_cursor("garbage!")
+
+
+# ------------------------------------------------- segments, bloom, cache
+
+def test_segment_roll_and_bloom(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    st.segment_rows = 4
+    fill(st, 10, text="alpha {} beta")
+    assert len(st.segments) == 3
+    sealed = [s for s in st.segments if s.sealed]
+    assert len(sealed) == 2
+    assert all(s.bloom is not None for s in sealed)
+    assert sealed[0].may_match(["alpha"])
+    assert not sealed[0].may_match(["zzz_missing"])
+    # search spans sealed + active segments
+    assert len(st.query("alpha", limit=100)) == 10
+
+
+def test_block_cache_eviction(tmp_path):
+    cache = BlockCache(max_resident=1,
+                       detector=HotDataDetector(threshold=100))
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.cache = cache
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    st.cache = cache
+    st.segment_rows = 4
+    fill(st, 12)
+    sealed = [s for s in st.segments if s.sealed]
+    # queries touched segments; at most 1 sealed payload stays resident
+    st.query("request", limit=100)
+    assert sum(1 for s in sealed if s.resident) <= 1
+    assert cache.evictions > 0
+    # evicted segments transparently reload from disk
+    assert len(st.query("request", limit=100)) == 12
+
+
+def test_hot_detector():
+    d = HotDataDetector(threshold=2, window_s=10)
+    d.record(("k",), now=0.0)
+    assert not d.is_hot(("k",), now=0.0)
+    d.record(("k",), now=1.0)
+    assert d.is_hot(("k",), now=1.0)
+    assert not d.is_hot(("k",), now=20.0)    # aged out
+
+
+# -------------------------------------------------------------- retention
+
+def test_retention_drops_old_segments(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s", ttl_days=1)
+    st = ls.stream("r", "s")
+    st.segment_rows = 2
+    day = 86400 * SEC
+    now = 10 * day
+    st.append([{"content": "old", "timestamp": now - 5 * day},
+               {"content": "old2", "timestamp": now - 5 * day + 1}])
+    st.append([{"content": "new", "timestamp": now - 100}])
+    st.segments[0].seal()
+    removed = ls.apply_retention(now_ns=now)
+    assert removed == 1
+    assert st.total_records == 1
+    assert [r["content"] for r in st.query("")] == ["new"]
+
+
+# ------------------------------------------------------------------- HTTP
+
+@pytest.fixture
+def server(tmp_path):
+    from opengemini_tpu.http.server import HttpServer
+    from opengemini_tpu.storage import Engine
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    yield f"127.0.0.1:{srv.port}"
+    srv.stop()
+    eng.close()
+
+
+def _req(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_http_logstore_end_to_end(server):
+    base = f"http://{server}"
+    code, _ = _req("POST", f"{base}/api/v1/repository/prod")
+    assert code == 201
+    code, body = _req("GET", f"{base}/api/v1/repository")
+    assert body == {"repositories": ["prod"]}
+    code, _ = _req("POST", f"{base}/api/v1/logstream/prod/app",
+                   json.dumps({"ttl": 30}).encode())
+    assert code == 201
+    logs = {"logs": [
+        {"content": "login ok user=alice", "timestamp": 1 * MIN},
+        {"content": "login failed user=bob", "timestamp": 2 * MIN},
+        {"content": "logout user=alice", "timestamp": 3 * MIN}]}
+    code, body = _req("POST",
+                      f"{base}/repo/prod/logstreams/app/records",
+                      json.dumps(logs).encode())
+    assert code == 200 and body["written"] == 3
+    code, body = _req(
+        "GET", f"{base}/repo/prod/logstreams/app/logs?q=login&limit=10")
+    assert code == 200 and body["count"] == 2
+    code, body = _req(
+        "GET", f"{base}/repo/prod/logstreams/app/logs"
+               f"?q=user%3Dalice&highlight=true")
+    assert body["count"] == 2
+    code, body = _req(
+        "GET", f"{base}/repo/prod/logstreams/app/histogram"
+               f"?from=0&to={4 * MIN}&interval={2 * MIN}")
+    assert [h["count"] for h in body["histograms"]] == [1, 2]
+    # consume: start cursor at t=2m, read forward
+    code, body = _req(
+        "GET", f"{base}/repo/prod/logstreams/app/consume/cursor-time"
+               f"?time={2 * MIN}")
+    cur = body["cursor"]
+    code, body = _req(
+        "GET", f"{base}/repo/prod/logstreams/app/consume/logs"
+               f"?cursor={cur}&count=10")
+    assert [r["content"] for r in body["logs"]] == [
+        "login failed user=bob", "logout user=alice"]
+    # stream stats + delete
+    code, body = _req("GET", f"{base}/api/v1/logstream/prod/app")
+    assert body["records"] == 3
+    code, _ = _req("DELETE", f"{base}/api/v1/logstream/prod/app")
+    assert code == 200
+    code, body = _req("GET",
+                      f"{base}/repo/prod/logstreams/app/logs?q=x")
+    assert code == 404
+
+
+def test_http_records_json_array_body(server):
+    base = f"http://{server}"
+    _req("POST", f"{base}/api/v1/repository/r2")
+    _req("POST", f"{base}/api/v1/logstream/r2/s2")
+    code, body = _req(
+        "POST", f"{base}/repo/r2/logstreams/s2/records",
+        json.dumps([{"content": "bare array", "timestamp": MIN}]).encode())
+    assert code == 200 and body["written"] == 1
+
+
+def test_recovery_does_not_rewrite_segments(tmp_path):
+    import os
+    root = str(tmp_path / "ls")
+    ls = LogStore(root)
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    fill(st, 5)
+    st.seal_active()
+    seg_path = st.segments[0].path
+    mtime = os.path.getmtime(seg_path)
+    time.sleep(0.05)
+    ls2 = LogStore(root)
+    assert os.path.getmtime(seg_path) == mtime
+    assert ls2.stream("r", "s").total_records == 5
+
+
+def test_http_logstore_errors(server):
+    base = f"http://{server}"
+    code, _ = _req("POST", f"{base}/api/v1/logstream/missing/app")
+    assert code == 404
+    code, _ = _req("GET", f"{base}/repo/missing/logstreams/x/logs")
+    assert code == 404
