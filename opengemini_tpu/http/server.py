@@ -68,6 +68,9 @@ class HttpServer:
         # when the repository/logstream APIs are used)
         self._logstore = None
         self._logstore_lock = threading.Lock()
+        # plan cache (reference SqlPlanTemplate/GetPlanType pool)
+        from ..query.plancache import PlanCache
+        self.plan_cache = PlanCache()
         self.host = host
         self.port = port
         self._httpd: ThreadingHTTPServer | None = None
@@ -294,11 +297,16 @@ class HttpServer:
         except ValueError:
             return 400, {"error": "iter_id must be an integer"}
         self._bump("queries")
-        try:
-            stmts = parse_query(qtext)
-        except ParseError as e:
-            self._bump("query_errors")
-            return 400, {"error": f"error parsing query: {e}"}
+        plan = self.plan_cache.get(qtext)
+        if plan is not None:
+            stmts = plan.stmts
+        else:
+            try:
+                stmts = parse_query(qtext)
+            except ParseError as e:
+                self._bump("query_errors")
+                return 400, {"error": f"error parsing query: {e}"}
+            self.plan_cache.put(qtext, stmts)
         results = []
         for i, stmt in enumerate(stmts):
             try:

@@ -293,10 +293,11 @@ def parse_log_query(q: str) -> list[tuple[int, str]]:
 def _locked(fn):
     """Hold the stream lock for the whole call: readers walk the active
     segment's CLV postings, which append() mutates concurrently under
-    the ThreadingHTTPServer (the lock is an RLock, so the snapshot
-    acquisitions inside stay valid)."""
+    the ThreadingHTTPServer."""
     def wrap(self, *a, **k):
         with self._lock:
+            if self.deleted:
+                raise KeyError(f"logstream {self.name} not found")
             return fn(self, *a, **k)
     wrap.__name__ = fn.__name__
     wrap.__doc__ = fn.__doc__
@@ -317,6 +318,7 @@ class LogStream:
         self.segment_rows = segment_rows
         self.cache = cache or BlockCache()
         self._lock = threading.RLock()
+        self.deleted = False
         self.segments: list[Segment] = []
         self._active: Segment | None = None
         self.next_seq = 0
@@ -357,26 +359,35 @@ class LogStream:
 
     def append(self, entries: list[dict]) -> int:
         """entries: [{"content": str, "timestamp": ns, "tags": {...}}].
-        Returns count written (reference serveRecord ingest). Validates
-        every entry BEFORE writing any — no partial writes on bad input."""
-        for e in entries:
+        Returns count written (reference serveRecord ingest). Coerces and
+        validates every entry BEFORE writing any — no partial writes on
+        bad input."""
+        coerced = []
+        for i, e in enumerate(entries):
             if not isinstance(e, dict):
                 raise ValueError(
                     f"log entry must be an object, got {type(e).__name__}")
+            try:
+                ts = int(e.get("timestamp", time.time_ns()))
+                tags = e.get("tags", {})
+                if not isinstance(tags, dict):
+                    raise TypeError("tags must be an object")
+                coerced.append((ts, str(e.get("content", "")),
+                                dict(tags)))
+            except (TypeError, ValueError) as err:
+                raise ValueError(f"bad log entry {i}: {err}")
         with self._lock:
-            for e in entries:
+            if self.deleted:
+                raise KeyError(f"logstream {self.name} not found")
+            for ts, content, tags in coerced:
                 if self._active is None \
                         or self._active.n >= self.segment_rows:
                     self._roll()
-                rec = LogRecord(self.next_seq,
-                                int(e.get("timestamp",
-                                          time.time_ns())),
-                                str(e.get("content", "")),
-                                dict(e.get("tags", {})))
-                self._active.append(rec)
+                self._active.append(
+                    LogRecord(self.next_seq, ts, content, tags))
                 self.next_seq += 1
                 self.total_records += 1
-            return len(entries)
+            return len(coerced)
 
     def _roll(self) -> None:
         if self._active is not None:
@@ -421,8 +432,7 @@ class LogStream:
         plain = [t for ty, term in clauses if ty != FUZZY
                  for t, _p in tokenize(term)]
         out: list[LogRecord] = []
-        with self._lock:
-            segs = list(self.segments)
+        segs = self.segments
         for seg in (reversed(segs) if reverse else segs):
             if len(out) >= limit:
                 break
@@ -466,8 +476,7 @@ class LogStream:
                  for t, _p in tokenize(term)]
         n_buckets = max(int((t_max - t_min + interval - 1) // interval), 1)
         counts = np.zeros(n_buckets, dtype=np.int64)
-        with self._lock:
-            segs = list(self.segments)
+        segs = self.segments
         for seg in segs:
             if seg.n == 0 or seg.max_time < t_min \
                     or seg.min_time >= t_max or not seg.may_match(plain):
@@ -492,8 +501,7 @@ class LogStream:
         """Records around a cursor (reference serveContextQueryLog)."""
         lo, hi = max(seq - before, 0), seq + after + 1
         out = []
-        with self._lock:
-            segs = list(self.segments)
+        segs = self.segments
         for seg in segs:
             if seg.base_seq + seg.n <= lo or seg.base_seq >= hi:
                 continue
@@ -513,8 +521,7 @@ class LogStream:
         """Cursor tail-read: up to `count` records with seq >= cursor;
         returns (records, next_cursor) (reference serveConsumeLogs)."""
         out = []
-        with self._lock:
-            segs = list(self.segments)
+        segs = self.segments
         for seg in segs:
             if seg.base_seq + seg.n <= seq:
                 continue
@@ -530,8 +537,7 @@ class LogStream:
     def cursor_at_time(self, t: int) -> int:
         """Smallest seq with record time >= t (reference
         serveConsumeCursorTime)."""
-        with self._lock:
-            segs = list(self.segments)
+        segs = self.segments
         for seg in segs:
             if seg.n == 0 or seg.max_time < t:
                 continue
@@ -653,7 +659,12 @@ class LogStore:
             s = r.streams.pop(name, None)
             if s is None:
                 raise KeyError(f"logstream {name} not found")
-            s.forget_cached()
+            # take the stream lock: waits out in-flight reads/writes, and
+            # the deleted flag stops later ones from re-inserting cache
+            # entries or touching the removed files
+            with s._lock:
+                s.deleted = True
+                s.forget_cached()
             if s.dir and os.path.isdir(s.dir):
                 import shutil
                 shutil.rmtree(s.dir)

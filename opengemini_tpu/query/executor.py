@@ -343,8 +343,10 @@ class QueryExecutor:
             cs = classify_select(sel)
         except ErrQueryError as e:
             return {"error": str(e)}
+        from .plancache import plan_type
         interval = sel.group_by_interval()
-        lines = ["HttpSender",
+        lines = [f"PlanTemplate({plan_type(sel, cs)})",
+                 "HttpSender",
                  f"  Materialize({', '.join(n for n, _e in cs.outputs)})"]
         if cs.mode == "agg":
             aggd = ", ".join(f"{a.func}({a.field})" for a in cs.aggs)

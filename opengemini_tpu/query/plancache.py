@@ -1,0 +1,117 @@
+"""Plan templates and the query plan cache.
+
+Role of the reference's plan-template machinery: `GetPlanType` +
+`SqlPlanTemplate` (engine/executor/select.go:184-197, plan_type.go:101-
+154) recognize the handful of query shapes that serve ~90% of dashboard
+traffic (AGG_INTERVAL, AGG_INTERVAL_LIMIT, NO_AGG_NO_GROUP, AGG_GROUP,
+NO_AGG_NO_GROUP_LIMIT) and reuse canned plan trees, skipping the full
+planner.
+
+In this framework "planning" is parse + select-list classification; the
+cache keys on the exact query text and replays the parsed statements and
+their plan types. Queries containing now() are never cached — now() is
+resolved to an absolute literal at parse time (influxql.py), so a cached
+parse would freeze it. Statements are treated as immutable after parse
+(the executor classifies per execution; classification state is never
+shared across runs)."""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+# plan template types (reference plan_type.go:103-110)
+AGG_INTERVAL = "AGG_INTERVAL"
+AGG_INTERVAL_LIMIT = "AGG_INTERVAL_LIMIT"
+NO_AGG_NO_GROUP = "NO_AGG_NO_GROUP"
+AGG_GROUP = "AGG_GROUP"
+NO_AGG_NO_GROUP_LIMIT = "NO_AGG_NO_GROUP_LIMIT"
+UNKNOWN = "UNKNOWN"
+
+
+def plan_type(stmt, cs) -> str:
+    """Classify a SELECT into a plan-template type (reference
+    NormalGetPlanType). cs is the classify_select result."""
+    has_interval = stmt.group_by_interval() is not None
+    group_tags = [d for d in stmt.dimensions
+                  if not _is_time_dim(d)]
+    if cs.mode == "agg":
+        if has_interval:
+            return AGG_INTERVAL_LIMIT if stmt.limit else AGG_INTERVAL
+        if group_tags:
+            return AGG_GROUP
+        return AGG_INTERVAL        # single global window
+    if not group_tags and not has_interval:
+        return NO_AGG_NO_GROUP_LIMIT if stmt.limit else NO_AGG_NO_GROUP
+    return UNKNOWN
+
+
+def _is_time_dim(d) -> bool:
+    from .ast import Call
+    return isinstance(d.expr, Call) and d.expr.func == "time"
+
+
+_NOW_RE = re.compile(r"\bnow\s*\(", re.IGNORECASE)
+
+
+@dataclass
+class CachedPlan:
+    stmts: list                   # parsed statements
+
+    def plan_types(self) -> list[str]:
+        """Template type per statement ('' for non-SELECT) — computed on
+        demand (EXPLAIN/introspection), not on the query hot path."""
+        from .ast import SelectStatement
+        from .functions import classify_select
+        out = []
+        for s in self.stmts:
+            t = ""
+            if isinstance(s, SelectStatement):
+                try:
+                    t = plan_type(s, classify_select(s))
+                except Exception:
+                    t = UNKNOWN
+            out.append(t)
+        return out
+
+
+class PlanCache:
+    """LRU of parsed query plans keyed by query text (the SqlPlanTemplate
+    pool analog — repeated dashboard queries skip the parser)."""
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._lru: OrderedDict[str, CachedPlan] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def cacheable(qtext: str) -> bool:
+        return _NOW_RE.search(qtext) is None
+
+    def get(self, qtext: str) -> CachedPlan | None:
+        with self._lock:
+            plan = self._lru.get(qtext)
+            if plan is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(qtext)
+            self.hits += 1
+            return plan
+
+    def put(self, qtext: str, stmts: list) -> CachedPlan:
+        plan = CachedPlan(stmts)
+        if not self.cacheable(qtext):
+            return plan
+        with self._lock:
+            self._lru[qtext] = plan
+            while len(self._lru) > self.max_entries:
+                self._lru.popitem(last=False)
+        return plan
+
+    def stats(self) -> dict:
+        return {"entries": len(self._lru), "hits": self.hits,
+                "misses": self.misses}

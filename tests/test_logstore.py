@@ -221,7 +221,26 @@ def test_append_rejects_non_dict_entries(tmp_path):
     st = ls.stream("r", "s")
     with pytest.raises(ValueError):
         st.append([{"content": "ok"}, "oops"])
-    assert st.total_records == 0       # no partial write
+    with pytest.raises(ValueError):
+        st.append([{"content": "a"},
+                   {"content": "b", "timestamp": "noon"}])
+    with pytest.raises(ValueError):
+        st.append([{"content": "a", "tags": 5}])
+    assert st.total_records == 0       # no partial writes
+
+
+def test_deleted_stream_rejects_late_operations(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    st.append([{"content": "x", "timestamp": MIN}])
+    ls.delete_logstream("r", "s")
+    with pytest.raises(KeyError):
+        st.query("x")
+    with pytest.raises(KeyError):
+        st.append([{"content": "y"}])
+    assert not ls.cache._lru
 
 
 def test_cache_forget_on_retention_and_delete(tmp_path):

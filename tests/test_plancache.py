@@ -1,0 +1,97 @@
+"""Plan templates and the query plan cache (reference
+engine/executor/plan_type.go + SqlPlanTemplate, select.go:184-197)."""
+
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.query.functions import classify_select
+from opengemini_tpu.query.plancache import (AGG_GROUP, AGG_INTERVAL,
+                                            AGG_INTERVAL_LIMIT,
+                                            NO_AGG_NO_GROUP,
+                                            NO_AGG_NO_GROUP_LIMIT,
+                                            PlanCache, plan_type)
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+def ptype(q: str) -> str:
+    (stmt,) = parse_query(q)
+    return plan_type(stmt, classify_select(stmt))
+
+
+def test_plan_types():
+    assert ptype("SELECT mean(v) FROM m GROUP BY time(1m)") \
+        == AGG_INTERVAL
+    assert ptype("SELECT mean(v) FROM m GROUP BY time(1m) LIMIT 5") \
+        == AGG_INTERVAL_LIMIT
+    assert ptype("SELECT mean(v) FROM m GROUP BY host") == AGG_GROUP
+    assert ptype("SELECT v FROM m") == NO_AGG_NO_GROUP
+    assert ptype("SELECT v FROM m LIMIT 10") == NO_AGG_NO_GROUP_LIMIT
+    # TSBS double-groupby-1 hits the AGG_INTERVAL template
+    assert ptype("SELECT mean(usage_user) FROM cpu "
+                 "WHERE time >= 0 AND time < 1h "
+                 "GROUP BY time(1m), hostname") == AGG_INTERVAL
+
+
+def test_cache_hit_and_lru():
+    pc = PlanCache(max_entries=2)
+    q1 = "SELECT v FROM m"
+    assert pc.get(q1) is None
+    pc.put(q1, parse_query(q1))
+    assert pc.get(q1) is not None
+    assert pc.get(q1).plan_types() == [NO_AGG_NO_GROUP]
+    pc.put("SELECT v FROM m2", parse_query("SELECT v FROM m2"))
+    pc.put("SELECT v FROM m3", parse_query("SELECT v FROM m3"))
+    assert pc.get(q1) is None          # LRU-evicted
+    assert pc.stats()["entries"] == 2
+
+
+def test_now_queries_never_cached():
+    pc = PlanCache()
+    q = "SELECT v FROM m WHERE time > now() - 1h"
+    assert not pc.cacheable(q)
+    pc.put(q, parse_query(q))
+    assert pc.get(q) is None
+
+
+def test_cached_statements_replay_correctly(tmp_path):
+    """Executing a cached parse twice gives identical results — parsed
+    statements must behave as immutable."""
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db0", parse_lines(
+        "m,host=a v=1 1000\nm,host=a v=3 2000"))
+    ex = QueryExecutor(eng)
+    pc = PlanCache()
+    q = "SELECT mean(v) FROM m"
+    pc.put(q, parse_query(q))
+    (stmt,) = pc.get(q).stmts
+    r1 = ex.execute(stmt, "db0")
+    r2 = ex.execute(stmt, "db0")
+    assert r1 == r2
+    assert r1["series"][0]["values"][0][1] == 2.0
+    eng.close()
+
+
+def test_http_uses_plan_cache(tmp_path):
+    from opengemini_tpu.http.server import HttpServer
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db0", parse_lines("m v=5 1000"))
+    srv = HttpServer(eng, port=0)
+    q = {"q": "SELECT v FROM m", "db": "db0"}
+    code, r1 = srv.handle_query(dict(q))
+    code, r2 = srv.handle_query(dict(q))
+    assert r1 == r2
+    assert srv.plan_cache.hits == 1 and srv.plan_cache.misses == 1
+    eng.close()
+
+
+def test_explain_shows_plan_template(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db0", parse_lines("m v=5 1000"))
+    ex = QueryExecutor(eng)
+    (stmt,) = parse_query("EXPLAIN SELECT mean(v) FROM m "
+                          "GROUP BY time(1m)")
+    res = ex.execute(stmt, "db0")
+    lines = [row[0] for row in res["series"][0]["values"]]
+    assert lines[0] == "PlanTemplate(AGG_INTERVAL)"
+    eng.close()
