@@ -629,10 +629,20 @@ def main():
     srv = HttpServer(eng, args.host, args.port)
     srv.start()
     log.info("ts-server (single node) ready")
+
+    # graceful shutdown: SIGTERM must flush buffered WAL writes before
+    # exit (reference app/command.go signal handling) — without this a
+    # plain `kill` loses the unsynced WAL tail
+    import signal
+
+    def _term(_sig, _frm):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
     try:
         while True:
             time.sleep(3600)
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         pass
     finally:
         srv.stop()

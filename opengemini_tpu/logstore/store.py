@@ -659,15 +659,16 @@ class LogStore:
             s = r.streams.pop(name, None)
             if s is None:
                 raise KeyError(f"logstream {name} not found")
-            # take the stream lock: waits out in-flight reads/writes, and
-            # the deleted flag stops later ones from re-inserting cache
-            # entries or touching the removed files
-            with s._lock:
-                s.deleted = True
-                s.forget_cached()
-            if s.dir and os.path.isdir(s.dir):
-                import shutil
-                shutil.rmtree(s.dir)
+        # outside the store lock (a long scan holds the stream lock, and
+        # rmtree is slow — neither may stall unrelated repos): wait out
+        # in-flight reads/writes, then the deleted flag stops later ones
+        # from re-inserting cache entries or touching the removed files
+        with s._lock:
+            s.deleted = True
+            s.forget_cached()
+        if s.dir and os.path.isdir(s.dir):
+            import shutil
+            shutil.rmtree(s.dir)
 
     def list_logstreams(self, repo: str) -> list[str]:
         return sorted(self._repo(repo).streams)

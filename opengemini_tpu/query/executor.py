@@ -37,6 +37,7 @@ from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
                         apply_window_transform, classify_select,
+                        dedupe_name_list,
                         eval_output_grid, finalize_moment, finalize_raw_agg,
                         sliding_agg_series, spec_names_for, topn_final,
                         topn_partial)
@@ -571,6 +572,9 @@ class QueryExecutor:
                 raise ErrQueryError(
                     f"too many windows: {W} > {MAX_WINDOWS}")
         else:
+            # bucketing origin must cover all rows (negative timestamps
+            # included); the influx row-time convention (epoch 0 when the
+            # range is unbounded) applies only to the DISPLAYED time
             start = t_min if t_min != MIN_TIME else data_tmin
             W = 1
         interval_eff = interval if interval else MAX_TIME
@@ -665,6 +669,10 @@ class QueryExecutor:
             "field_types": {f: _ftype_name(t)
                             for f, t in field_types.items()},
         }
+        if not interval:
+            # influx shows epoch 0 on unbounded windowless aggregates
+            partial["display_start"] = \
+                int(t_min) if t_min != MIN_TIME else 0
         # raw slices for exact-semantics aggregates
         raw_need = {a.field for a in aggs if a.needs_raw}
         if raw_need:
@@ -737,7 +745,7 @@ class QueryExecutor:
             pairs = cs.raw_fields if plain else \
                 [(n, None) for n in sorted(cs.raw_refs)]
         sel_names = [n for n, _a in pairs]
-        display = [a or n for n, a in pairs]
+        display = dedupe_name_list([a or n for n, a in pairs])
         field_names = [n for n in sel_names if n in all_fields]
         if not field_names and not any(n in tag_keys for n in sel_names):
             return {}
@@ -1046,6 +1054,9 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
     merged = {"group_tags": group_tags, "group_keys": group_keys,
               "interval": interval, "start": int(start), "W": W,
               "fields": merged_fields, "field_types": field_types}
+    if not interval:
+        merged["display_start"] = min(
+            p.get("display_start", p["start"]) for p in partials)
 
     # ---- raw slices: concatenate per-cell across partials
     raw_names = sorted(set().union(*[p.get("raw", {}).keys()
@@ -1168,7 +1179,7 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     aggs = cs.aggs
 
     win_times = start + interval * np.arange(W) if interval else \
-        np.array([start], dtype=np.int64)
+        np.array([merged.get("display_start", start)], dtype=np.int64)
 
     if cs.multirow is not None:
         return _finalize_multirow(stmt, mst, cs, merged, win_times,

@@ -345,6 +345,7 @@ def classify_select(stmt) -> ClassifiedSelect:
     cs.mode = "agg" if has_agg else "raw"
     if cs.multirow is not None and cs.multirow.arg is not None:
         cs.multirow.output = cs.outputs[0][0]
+    dedupe_names(cs)
     return cs
 
 
@@ -364,10 +365,37 @@ def _default_name(e) -> str:
     if isinstance(e, Call):
         return e.func
     if isinstance(e, BinaryExpr):
-        return _default_name(e.lhs)
+        # influx joins operand names: `a + b` → column "a_b"
+        l = _default_name(e.lhs) if not isinstance(e.lhs, Literal) else ""
+        r = _default_name(e.rhs) if not isinstance(e.rhs, Literal) else ""
+        return "_".join(p for p in (l, r) if p) or "expr"
     if isinstance(e, FieldRef):
         return e.name
     return "expr"
+
+
+def dedupe_name_list(names: list[str]) -> list[str]:
+    """Influx-style duplicate column renaming: name, name_1, name_2…
+    Generated names are themselves reserved, so `v, v, v_1` yields
+    `v, v_1, v_1_1`, never two equal columns."""
+    seen: set[str] = set()
+    out = []
+    for name in names:
+        if name in seen:
+            n = 0
+            cand = name
+            while cand in seen:
+                n += 1
+                cand = f"{name}_{n}"
+            name = cand
+        seen.add(name)
+        out.append(name)
+    return out
+
+
+def dedupe_names(cs: "ClassifiedSelect") -> None:
+    fixed = dedupe_name_list([n for n, _e in cs.outputs])
+    cs.outputs = [(n, e) for n, (_old, e) in zip(fixed, cs.outputs)]
 
 
 def spec_names_for(item: AggItem) -> set[str]:
