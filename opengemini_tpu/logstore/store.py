@@ -654,11 +654,18 @@ class LogStore:
             r.streams[name] = st
 
     def delete_logstream(self, repo: str, name: str) -> None:
+        tomb = None
         with self._lock:
             r = self._repo(repo)
             s = r.streams.pop(name, None)
             if s is None:
                 raise KeyError(f"logstream {name} not found")
+            # rename to a tombstone under the lock (fast, atomic): a
+            # delete-then-recreate of the same name cannot collide with
+            # the slow rmtree below
+            if s.dir and os.path.isdir(s.dir):
+                tomb = s.dir + f".deleted.{id(s):x}"
+                os.rename(s.dir, tomb)
         # outside the store lock (a long scan holds the stream lock, and
         # rmtree is slow — neither may stall unrelated repos): wait out
         # in-flight reads/writes, then the deleted flag stops later ones
@@ -666,9 +673,9 @@ class LogStore:
         with s._lock:
             s.deleted = True
             s.forget_cached()
-        if s.dir and os.path.isdir(s.dir):
+        if tomb is not None:
             import shutil
-            shutil.rmtree(s.dir)
+            shutil.rmtree(tomb, ignore_errors=True)
 
     def list_logstreams(self, repo: str) -> list[str]:
         return sorted(self._repo(repo).streams)

@@ -41,12 +41,16 @@ _F64 = jnp.float64
 _I64 = jnp.int64
 
 # aggregates computed by the fused kernel
-ALL_AGGS = ("count", "sum", "sumsq", "min", "max", "first", "last")
+ALL_AGGS = ("count", "sum", "sumsq", "min", "max", "first", "last",
+            "min_time", "max_time")
 
 
 class AggSpec(NamedTuple):
     """Which aggregates a query needs (subset → XLA dead-code-eliminates the
-    rest after fusion, but being explicit also skips gather setup)."""
+    rest after fusion, but being explicit also skips gather setup).
+    min_time/max_time track the EARLIEST timestamp achieving the extremum
+    (influx selector row times: `SELECT max(v)` returns the max point's
+    time)."""
     count: bool = True
     sum: bool = True
     sumsq: bool = False
@@ -54,6 +58,8 @@ class AggSpec(NamedTuple):
     max: bool = False
     first: bool = False
     last: bool = False
+    min_time: bool = False
+    max_time: bool = False
 
     @classmethod
     def of(cls, *names: str) -> "AggSpec":
@@ -69,6 +75,10 @@ class AggSpec(NamedTuple):
             # engine/series_agg_func.gen.go — but moment form is the
             # device-friendly mergeable formulation)
             names_set |= {"count", "sum", "sumsq"}
+        if "min_time" in names_set:
+            names_set.add("min")
+        if "max_time" in names_set:
+            names_set.add("max")
         return cls(**{k: (k in names_set) for k in ALL_AGGS})
 
 
@@ -87,6 +97,8 @@ class SegmentAggResult(NamedTuple):
     last: jax.Array | None = None         # value at latest valid time
     first_time: jax.Array | None = None
     last_time: jax.Array | None = None
+    min_time: jax.Array | None = None     # earliest time achieving min
+    max_time: jax.Array | None = None     # earliest time achieving max
 
     def mean(self) -> jax.Array:
         cnt = jnp.maximum(self.count, 1)
@@ -112,6 +124,29 @@ def window_ids(times: jax.Array, start_time, interval, num_windows: int):
     detection inNextWindowWithInfo (engine/aggregate_cursor.go)."""
     w = (times - start_time) // interval
     return jnp.where((w >= 0) & (w < num_windows), w, num_windows).astype(_I64)
+
+
+def _extremum_time_dense(values, valid, times, extremum):
+    """Earliest time of a row's extremum point (dense (S, P) layout).
+    valid=None means every point valid."""
+    at = values == extremum[:, None]
+    if valid is not None:
+        at = valid & at
+    return jnp.where(at, times, jnp.iinfo(_I64).max).min(axis=1)
+
+
+def _extremum_time_segment(values, valid, times, seg_ids, ns,
+                           num_segments, sorted_ids, is_min: bool):
+    """Earliest time of each segment's extremum point (sparse layout).
+    XLA CSEs the recomputed extremum against the spec.min/max reduction."""
+    ident = jnp.array(jnp.inf if is_min else -jnp.inf, values.dtype)
+    seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
+    ext = seg_red(jnp.where(valid, values, ident), seg_ids, ns,
+                  indices_are_sorted=sorted_ids)
+    at = valid & (values == ext[seg_ids])
+    return jax.ops.segment_min(
+        jnp.where(at, times, jnp.iinfo(_I64).max), seg_ids, ns,
+        indices_are_sorted=sorted_ids)[:num_segments]
 
 
 def _segment_all(values, valid, seg_ids, num_segments: int,
@@ -163,6 +198,18 @@ def segment_aggregate(values: jax.Array,
     """
     res = _segment_all(values, valid, seg_ids, num_segments, spec, sorted_ids)
     ns = num_segments + 1
+    min_t = max_t = None
+    if spec.min_time or spec.max_time:
+        if times is None:
+            raise ValueError("min_time/max_time need times")
+        if spec.min_time:
+            min_t = _extremum_time_segment(
+                values, valid, times, seg_ids, ns, num_segments,
+                sorted_ids, is_min=True)
+        if spec.max_time:
+            max_t = _extremum_time_segment(
+                values, valid, times, seg_ids, ns, num_segments,
+                sorted_ids, is_min=False)
     first = last = first_t = last_t = None
     if spec.first or spec.last:
         if times is None:
@@ -186,7 +233,8 @@ def segment_aggregate(values: jax.Array,
     return SegmentAggResult(
         count=res.get("count"), sum=res.get("sum"), sumsq=res.get("sumsq"),
         min=res.get("min"), max=res.get("max"),
-        first=first, last=last, first_time=first_t, last_time=last_t)
+        first=first, last=last, first_time=first_t, last_time=last_t,
+        min_time=min_t, max_time=max_t)
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))
@@ -223,10 +271,17 @@ def dense_window_aggregate(values: jax.Array,
             last = values[:, -1]
             if times is not None:
                 last_t = times[:, -1]
+        if (spec.min_time or spec.max_time) and times is None:
+            raise ValueError("min_time/max_time need times")
+        min_t = _extremum_time_dense(values, None, times, out["min"]) \
+            if spec.min_time else None
+        max_t = _extremum_time_dense(values, None, times, out["max"]) \
+            if spec.max_time else None
         return SegmentAggResult(
             count=out["count"], sum=out["sum"], sumsq=out.get("sumsq"),
             min=out.get("min"), max=out.get("max"),
-            first=first, last=last, first_time=first_t, last_time=last_t)
+            first=first, last=last, first_time=first_t, last_time=last_t,
+            min_time=min_t, max_time=max_t)
     vz = jnp.where(valid, values, jnp.zeros((), fdt))
     out = {"count": valid.sum(axis=1, dtype=_I64), "sum": vz.sum(axis=1)}
     if spec.sumsq:
@@ -257,10 +312,17 @@ def dense_window_aggregate(values: jax.Array,
             if times is not None:
                 last_t = jnp.where(has, jnp.take_along_axis(
                     times, safe[:, None], axis=1)[:, 0], 0)
+    if (spec.min_time or spec.max_time) and times is None:
+        raise ValueError("min_time/max_time need times")
+    min_t = _extremum_time_dense(values, valid, times, out["min"]) \
+        if spec.min_time else None
+    max_t = _extremum_time_dense(values, valid, times, out["max"]) \
+        if spec.max_time else None
     return SegmentAggResult(
         count=out["count"], sum=out["sum"], sumsq=out.get("sumsq"),
         min=out.get("min"), max=out.get("max"),
-        first=first, last=last, first_time=first_t, last_time=last_t)
+        first=first, last=last, first_time=first_t, last_time=last_t,
+        min_time=min_t, max_time=max_t)
 
 
 def merge_seg_results(a: SegmentAggResult,
@@ -292,7 +354,16 @@ def merge_seg_results(a: SegmentAggResult,
         sumsq=m(a.sumsq, b.sumsq, jnp.add),
         min=m(a.min, b.min, jnp.minimum),
         max=m(a.max, b.max, jnp.maximum),
-        first=first, last=last, first_time=first_t, last_time=last_t)
+        first=first, last=last, first_time=first_t, last_time=last_t,
+        # extremum times: winner's time; ties pick the earlier point
+        min_time=None if a.min_time is None else jnp.where(
+            a.min < b.min, a.min_time,
+            jnp.where(b.min < a.min, b.min_time,
+                      jnp.minimum(a.min_time, b.min_time))),
+        max_time=None if a.max_time is None else jnp.where(
+            a.max > b.max, a.max_time,
+            jnp.where(b.max > a.max, b.max_time,
+                      jnp.minimum(a.max_time, b.max_time))))
 
 
 # ----------------------------------------------------------------- helpers

@@ -39,6 +39,7 @@ from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         apply_window_transform, classify_select,
                         dedupe_name_list,
                         eval_output_grid, finalize_moment, finalize_raw_agg,
+                        percentile_rank_index,
                         sliding_agg_series, spec_names_for, topn_final,
                         topn_partial)
 
@@ -600,6 +601,12 @@ class QueryExecutor:
         spec_names = {"count"}
         for a in aggs:
             spec_names |= spec_names_for(a)
+        # sole windowless selector: influx rows carry the selected
+        # point's timestamp, so min/max also track their extremum time
+        if (not interval and len(aggs) == 1 and len(cs.outputs) == 1
+                and isinstance(cs.outputs[0][1], AggRef)
+                and aggs[0].func in ("min", "max")):
+            spec_names.add(aggs[0].func + "_time")
         spec = AggSpec.of(*spec_names)
 
         # fields whose raw per-(group, window) slices must be collected
@@ -654,7 +661,8 @@ class QueryExecutor:
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
             for k in ("count", "sum", "sumsq", "min", "max", "first",
-                      "last", "first_time", "last_time"):
+                      "last", "first_time", "last_time", "min_time",
+                      "max_time"):
                 v = getattr(res, k)
                 if v is not None:
                     st[k] = np.asarray(v).reshape(G, W)
@@ -933,7 +941,8 @@ _I64MIN = np.iinfo(np.int64).min
 _IDENT = {"count": 0, "sum": 0.0, "sumsq": 0.0,
           "min": np.inf, "max": -np.inf,
           "first": np.nan, "last": np.nan,
-          "first_time": _I64MAX, "last_time": _I64MIN}
+          "first_time": _I64MAX, "last_time": _I64MIN,
+          "min_time": _I64MAX, "max_time": _I64MAX}
 
 
 def _collect_raw_slices(seg, vals, valid, times, G: int, W: int) -> dict:
@@ -1006,7 +1015,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                                     for p in partials if fname in p["fields"]]))
         tgt = {}
         for k in keys:
-            dt = np.int64 if k in ("count", "first_time", "last_time") \
+            dt = np.int64 if k in ("count", "first_time", "last_time",
+                                   "min_time", "max_time") \
                 else np.float64
             tgt[k] = np.full((G, W), _IDENT[k], dtype=dt)
         for pi, p in enumerate(partials):
@@ -1022,8 +1032,24 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                 if k in tgt and k in st:
                     tgt[k][ix] += st[k]
             if "min" in tgt and "min" in st:
+                if "min_time" in tgt and "min_time" in st:
+                    cur_v, cur_t = tgt["min"][ix], tgt["min_time"][ix]
+                    lower = st["min"] < cur_v
+                    tie = st["min"] == cur_v
+                    tgt["min_time"][ix] = np.where(
+                        lower, st["min_time"],
+                        np.where(tie, np.minimum(st["min_time"], cur_t),
+                                 cur_t))
                 tgt["min"][ix] = np.minimum(tgt["min"][ix], st["min"])
             if "max" in tgt and "max" in st:
+                if "max_time" in tgt and "max_time" in st:
+                    cur_v, cur_t = tgt["max"][ix], tgt["max_time"][ix]
+                    higher = st["max"] > cur_v
+                    tie = st["max"] == cur_v
+                    tgt["max_time"][ix] = np.where(
+                        higher, st["max_time"],
+                        np.where(tie, np.minimum(st["max_time"], cur_t),
+                                 cur_t))
                 tgt["max"][ix] = np.maximum(tgt["max"][ix], st["max"])
             if "first" in tgt and "first" in st:
                 b_has = ~np.isnan(st["first"])
@@ -1220,6 +1246,11 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     for p in agg_present:
         anyc |= p
 
+    # sole windowless selector: rows carry the selected point's time
+    # (influx selector semantics — `SELECT max(v) FROM m` returns the max
+    # point's timestamp, not the range start)
+    point_times = _selector_point_times(cs, aggs, fields, merged, interval)
+
     # ---- output grids / transforms
     out_specs = []        # (name, kind, payload)
     for name, expr in cs.outputs:
@@ -1264,6 +1295,8 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
         if have_plain:
             for wi in range(W):
                 t = int(win_times[wi])
+                if point_times is not None and anyc[gi, wi]:
+                    t = int(point_times[gi, wi])
                 if anyc[gi, wi]:
                     row = cell_row(t)
                     for oi, (_n, kind, payload) in enumerate(out_specs):
@@ -1326,6 +1359,41 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     if stmt.slimit:
         series_out = series_out[:stmt.slimit]
     return {"series": series_out} if series_out else {}
+
+
+def _selector_point_times(cs, aggs, fields, merged,
+                          interval) -> np.ndarray | None:
+    """(G, W) timestamps of the selected points for a sole windowless
+    selector query, else None. first/last/min/max come from the kernel's
+    *_time states; percentile finds its chosen point in the raw slices."""
+    if interval or len(aggs) != 1 or len(cs.outputs) != 1 \
+            or not isinstance(cs.outputs[0][1], AggRef):
+        return None
+    f = aggs[0].func
+    st = fields.get(aggs[0].field, {})
+    key = {"first": "first_time", "last": "last_time",
+           "min": "min_time", "max": "max_time"}.get(f)
+    if key is not None:
+        v = st.get(key)
+        return None if v is None else np.asarray(v)
+    if f == "percentile":
+        raw = merged.get("raw", {}).get(aggs[0].field)
+        if raw is None or raw.get("times") is None:
+            return None
+        G, W = len(merged["group_keys"]), merged["W"]
+        out = np.zeros((G, W), dtype=np.int64)
+        for gi in range(G):
+            for wi in range(W):
+                v = raw["vals"][gi][wi]
+                if v is None or len(v) == 0:
+                    continue
+                t = np.asarray(raw["times"][gi][wi], dtype=np.int64)
+                order = np.argsort(np.asarray(v, dtype=np.float64),
+                                   kind="stable")
+                idx = percentile_rank_index(len(order), aggs[0].arg)
+                out[gi, wi] = t[order[idx]]
+        return out
+    return None
 
 
 def _transform_series(stmt, expr: Transform, agg_grids, agg_present,

@@ -468,17 +468,16 @@ def finalize_raw_agg(item: AggItem, raw: dict, G: int, W: int
     return out
 
 
-def _percentile_nearest_rank(v: np.ndarray, p: float) -> float:
-    """InfluxQL percentile: nearest-rank on the sorted sample
-    (idx = floor(n * p/100 + 0.5) - 1, clamped)."""
-    s = np.sort(v)
-    n = len(s)
+def percentile_rank_index(n: int, p: float) -> int:
+    """InfluxQL nearest-rank index into the sorted sample:
+    floor(n * p/100 + 0.5) - 1, clamped to [0, n-1]."""
     idx = int(math.floor(n * p / 100.0 + 0.5)) - 1
-    if idx < 0:
-        idx = 0
-    if idx >= n:
-        idx = n - 1
-    return float(s[idx])
+    return min(max(idx, 0), n - 1)
+
+
+def _percentile_nearest_rank(v: np.ndarray, p: float) -> float:
+    s = np.sort(v)
+    return float(s[percentile_rank_index(len(s), p)])
 
 
 def _median(v: np.ndarray) -> float:

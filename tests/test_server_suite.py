@@ -93,7 +93,7 @@ SUITE = [
             ("SELECT sum(i) FROM m",
              ok(series("m", ["time", "sum"], [[0, 8]]))),
             ("SELECT max(i) FROM m",
-             ok(series("m", ["time", "max"], [[0, 5]]))),
+             ok(series("m", ["time", "max"], [[2000, 5]]))),
         ],
     },
     {
@@ -238,7 +238,7 @@ SUITE = [
                             for x in [10, 20, 30, 40, 50, 50]),
         "queries": [
             ("SELECT percentile(v, 50) FROM m",
-             ok(series("m", ["time", "percentile"], [[0, 30.0]]))),
+             ok(series("m", ["time", "percentile"], [[1030, 30.0]]))),
             ("SELECT median(v) FROM m",
              ok(series("m", ["time", "median"], [[0, 35.0]]))),
             ("SELECT mode(v) FROM m",
@@ -251,6 +251,32 @@ SUITE = [
         "queries": [
             ("SELECT v FROM m&epoch=s",
              ok(series("m", ["time", "v"], [[60, 1.0]]))),
+        ],
+    },
+    {
+        "name": "sole selector returns point timestamp",
+        "writes": "m v=2 1000\nm v=8 2000\nm v=4 3000\nm v=8 4000",
+        "queries": [
+            ("SELECT max(v) FROM m",
+             ok(series("m", ["time", "max"], [[2000, 8.0]]))),
+            ("SELECT min(v) FROM m",
+             ok(series("m", ["time", "min"], [[1000, 2.0]]))),
+            ("SELECT first(v) FROM m",
+             ok(series("m", ["time", "first"], [[1000, 2.0]]))),
+            ("SELECT last(v) FROM m",
+             ok(series("m", ["time", "last"], [[4000, 8.0]]))),
+            ("SELECT percentile(v, 50) FROM m",
+             ok(series("m", ["time", "percentile"], [[3000, 4.0]]))),
+        ],
+    },
+    {
+        "name": "sole selector point time per tag group",
+        "writes": "m,h=a v=1 1000\nm,h=a v=9 2000\nm,h=b v=5 7000",
+        "queries": [
+            ("SELECT max(v) FROM m GROUP BY h",
+             ok(series("m", ["time", "max"], [[2000, 9.0]], {"h": "a"}),
+                series("m", ["time", "max"], [[7000, 5.0]],
+                       {"h": "b"}))),
         ],
     },
     {
