@@ -13,7 +13,7 @@ from __future__ import annotations
 import threading
 
 from ..storage.rows import PointRow
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from ..utils.errors import GeminiError
 from .hashing import series_hash
 from .meta_store import MetaClient
@@ -114,6 +114,7 @@ class PointsWriter:
     # -------------------------------------------------------------- write
 
     def write_points(self, db: str, rows: list[PointRow]) -> int:
+        failpoint.inject("points_writer.write.err")
         if not rows:
             return 0
         self._ensure_db(db)

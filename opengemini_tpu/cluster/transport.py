@@ -30,7 +30,7 @@ from queue import Empty, Queue
 
 import numpy as np
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 
 log = get_logger(__name__)
 
@@ -335,6 +335,11 @@ class RPCClient:
         rid = uuid.uuid4().hex
         q: Queue = Queue()
         s = None
+        # fault injection: simulate a dropped/slow RPC (reference plants
+        # failpoints in the spdy transport, SURVEY.md §4)
+        if failpoint.inject("transport.send.drop"):
+            raise ConnectionError("failpoint: transport.send.drop")
+        failpoint.inject("transport.send.delay")
         try:
             s = self._ensure()
             with self._plock:

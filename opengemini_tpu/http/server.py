@@ -520,6 +520,11 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/debug/vars":
             self._reply(200, srv.stats)
             return
+        if path == "/debug/ctrl":
+            p = self._params()
+            code, payload = srv.sysctrl.handle(p.pop("mod", ""), p)
+            self._reply(code, payload)
+            return
         if path == "/query":
             code, payload = srv.handle_query(self._params())
             self._reply(code, payload)
@@ -561,6 +566,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
             code, payload = srv.handle_query(params)
+            self._reply(code, payload)
+            return
+        if path == "/debug/ctrl":
+            p = self._params()
+            code, payload = srv.sysctrl.handle(p.pop("mod", ""), p)
             self._reply(code, payload)
             return
         if self._is_logstore(path):

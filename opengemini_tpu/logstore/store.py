@@ -40,6 +40,7 @@ log = get_logger(__name__)
 DEFAULT_SEGMENT_ROWS = 8192
 DEFAULT_TTL_DAYS = 7
 _NS_PER_DAY = 86400 * 10**9
+_TOMBSTONE_SUFFIX = ".deleted"
 
 
 @dataclass
@@ -601,6 +602,7 @@ class LogStore:
         self._lock = threading.Lock()
         self.repos: dict[str, Repository] = {}
         self.cache = BlockCache()
+        self._deleting: set[tuple[str, str]] = set()
         if root:
             os.makedirs(root, exist_ok=True)
             for rname in sorted(os.listdir(root)):
@@ -610,9 +612,16 @@ class LogStore:
                 repo = Repository(rname, rdir)
                 for sname in sorted(os.listdir(rdir)):
                     sdir = os.path.join(rdir, sname)
-                    if os.path.isdir(sdir):
-                        repo.streams[sname] = LogStream(
-                            rname, sname, sdir, cache=self.cache)
+                    if not os.path.isdir(sdir):
+                        continue
+                    if _TOMBSTONE_SUFFIX in sname:
+                        # crash mid-delete: finish the job, never
+                        # resurrect the data as a live stream
+                        import shutil
+                        shutil.rmtree(sdir, ignore_errors=True)
+                        continue
+                    repo.streams[sname] = LogStream(
+                        rname, sname, sdir, cache=self.cache)
                 self.repos[rname] = repo
 
     # ---- repository CRUD (serveCreateRepository et al.)
@@ -647,6 +656,11 @@ class LogStore:
             r = self._repo(repo)
             if name in r.streams:
                 raise ValueError(f"logstream {name} already exists")
+            if (repo, name) in self._deleting:
+                raise ValueError(
+                    f"logstream {name} is being deleted, retry")
+            if _TOMBSTONE_SUFFIX in name:
+                raise ValueError(f"invalid logstream name {name!r}")
             sdir = os.path.join(r.dir, name) if r.dir else None
             st = LogStream(repo, name, sdir, ttl_days=ttl_days,
                            cache=self.cache)
@@ -654,28 +668,36 @@ class LogStore:
             r.streams[name] = st
 
     def delete_logstream(self, repo: str, name: str) -> None:
-        tomb = None
         with self._lock:
             r = self._repo(repo)
             s = r.streams.pop(name, None)
             if s is None:
                 raise KeyError(f"logstream {name} not found")
-            # rename to a tombstone under the lock (fast, atomic): a
-            # delete-then-recreate of the same name cannot collide with
-            # the slow rmtree below
+            # recreates of this name are refused until the files are gone
+            # (create_logstream checks _deleting) — so the slow file work
+            # below can run without any lock
+            self._deleting.add((repo, name))
+        try:
+            # wait out in-flight reads/writes (they hold s._lock for the
+            # whole op, so no file under the dir is open after this);
+            # the deleted flag stops later ops from re-inserting cache
+            # entries or touching the removed files
+            with s._lock:
+                s.deleted = True
+                s.forget_cached()
             if s.dir and os.path.isdir(s.dir):
-                tomb = s.dir + f".deleted.{id(s):x}"
+                import shutil
+
+                # tombstone-rename first: a crash mid-rmtree must not
+                # leave a half-deleted dir that recovery would resurrect
+                # (unique suffix: an earlier failed rmtree's tombstone
+                # must not block the rename)
+                tomb = s.dir + _TOMBSTONE_SUFFIX + f".{time.time_ns():x}"
                 os.rename(s.dir, tomb)
-        # outside the store lock (a long scan holds the stream lock, and
-        # rmtree is slow — neither may stall unrelated repos): wait out
-        # in-flight reads/writes, then the deleted flag stops later ones
-        # from re-inserting cache entries or touching the removed files
-        with s._lock:
-            s.deleted = True
-            s.forget_cached()
-        if tomb is not None:
-            import shutil
-            shutil.rmtree(tomb, ignore_errors=True)
+                shutil.rmtree(tomb, ignore_errors=True)
+        finally:
+            with self._lock:
+                self._deleting.discard((repo, name))
 
     def list_logstreams(self, repo: str) -> list[str]:
         return sorted(self._repo(repo).streams)

@@ -18,7 +18,7 @@ import numpy as np
 
 from ..index import SeriesIndex, TagFilter
 from ..record import ColVal, DataType, Record, Schema, merge_sorted_records
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from ..utils.errors import ErrTypeConflict
 from .colstore import ColumnStoreReader, ColumnStoreWriter
 from .memtable import MemTable, MemTables, field_type_of
@@ -250,6 +250,7 @@ class Shard:
     def flush(self) -> None:
         """Memtable snapshot → TSSP files → commit (reference
         commitSnapshot shard.go:867)."""
+        failpoint.inject("shard.flush.err")
         with self._lock:
             if not self.mem.active and self.mem.snapshot is None:
                 return

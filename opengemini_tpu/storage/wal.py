@@ -19,7 +19,7 @@ import zlib
 import zstandard
 
 from ..native import lz4_compress, lz4_decompress
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 
 log = get_logger(__name__)
 
@@ -108,6 +108,7 @@ class WAL:
         return mx
 
     def write(self, rows: list[tuple[str, int, dict, int]]) -> None:
+        failpoint.inject("wal.write.err")
         raw = _pack_batch(rows)
         if self.compression == "lz4":
             codec, body = _LZ4, lz4_compress(raw)

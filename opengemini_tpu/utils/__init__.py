@@ -1,2 +1,3 @@
 from .errors import GeminiError, ErrInvalidLineProtocol, ErrTypeConflict
 from .logger import get_logger
+from . import failpoint

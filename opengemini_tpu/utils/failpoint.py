@@ -1,0 +1,116 @@
+"""Failpoint fault injection.
+
+Role of the reference's pingcap **failpoint** usage (SURVEY.md §4:
+`go.mod:41`; injection sites via `failpoint.Inject` in engine/shard.go,
+engine/wal.go, coordinator/write_helper.go, spdy transport,
+ts-meta member_event_handler.go; `make gotest` toggles them on/off around
+the unit-test run). Production code plants named points with
+``failpoint.inject("name")``; tests and the syscontrol admin plane arm
+them with actions:
+
+    error[:message]   raise FailpointError(message)
+    sleep:<ms>        delay the call site
+    drop              return True (site-specific: caller drops the work)
+    call              invoke a python callable (tests)
+
+The disarmed fast path is one module-global bool check — safe to leave in
+hot loops."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["FailpointError", "enable", "disable", "disable_all",
+           "inject", "active", "Failpoint", "list_points"]
+
+
+class FailpointError(RuntimeError):
+    """Raised by an armed `error` failpoint."""
+
+
+_lock = threading.Lock()
+_points: dict[str, tuple[str, object]] = {}
+ACTIVE = False                    # fast-path gate (no lock on reads)
+_hits: dict[str, int] = {}
+
+
+def enable(name: str, action: str = "error", arg: object = None) -> None:
+    """Arm a failpoint. action: error | sleep | drop | call."""
+    global ACTIVE
+    if action not in ("error", "sleep", "drop", "call"):
+        raise ValueError(f"unknown failpoint action {action}")
+    if action == "call" and not callable(arg):
+        raise ValueError("action 'call' requires a callable arg")
+    with _lock:
+        _points[name] = (action, arg)
+        ACTIVE = True
+
+
+def disable(name: str) -> None:
+    global ACTIVE
+    with _lock:
+        _points.pop(name, None)
+        ACTIVE = bool(_points)
+
+
+def disable_all() -> None:
+    global ACTIVE
+    with _lock:
+        _points.clear()
+        _hits.clear()
+        ACTIVE = False
+
+
+def active(name: str) -> bool:
+    return ACTIVE and name in _points
+
+
+def list_points() -> dict:
+    with _lock:
+        return {n: {"action": a, "hits": _hits.get(n, 0)}
+                for n, (a, _arg) in _points.items()}
+
+
+def inject(name: str) -> bool:
+    """Call at an injection site. Returns True when the site should DROP
+    the work (action `drop`); raises FailpointError for `error`; sleeps
+    for `sleep`. Disarmed cost: one global bool check."""
+    if not ACTIVE:
+        return False
+    with _lock:
+        spec = _points.get(name)
+        if spec is None:
+            return False
+        _hits[name] = _hits.get(name, 0) + 1
+        action, arg = spec
+    if action == "error":
+        raise FailpointError(arg or f"failpoint {name}")
+    if action == "sleep":
+        time.sleep(float(arg or 0) / 1000.0)
+        return False
+    if action == "drop":
+        return True
+    if action == "call":
+        arg()
+        return False
+    return False
+
+
+class Failpoint:
+    """Context manager for tests:
+    ``with Failpoint("wal.write.err"): ...``"""
+
+    def __init__(self, name: str, action: str = "error",
+                 arg: object = None):
+        self.name = name
+        self.action = action
+        self.arg = arg
+
+    def __enter__(self):
+        enable(self.name, self.action, self.arg)
+        return self
+
+    def __exit__(self, *exc):
+        disable(self.name)
+        return False

@@ -61,4 +61,26 @@ class SysControl:
                 return 200, {"readonly": self.readonly,
                              "compaction": self.compaction_enabled,
                              "verbose": self.verbose}
+            if mod == "failpoint":
+                # arm/disarm fault-injection points (reference failpoint
+                # toggles over the syscontrol admin plane, SURVEY.md §5)
+                from . import failpoint as fp
+                point = params.get("point")
+                if not point:
+                    return 200, {"failpoints": fp.list_points()}
+                if not self._flag(params):
+                    fp.disable(point)
+                    return 200, {"failpoint": point, "enabled": False}
+                action = params.get("action", "error")
+                if action == "call":
+                    # call takes a python callable — tests-only, not
+                    # representable as an HTTP string param
+                    return 400, {"error":
+                                 "action 'call' is not available "
+                                 "over HTTP"}
+                try:
+                    fp.enable(point, action, params.get("arg"))
+                except ValueError as e:
+                    return 400, {"error": str(e)}
+                return 200, {"failpoint": point, "enabled": True}
             return 400, {"error": f"unknown syscontrol mod {mod!r}"}

@@ -1,0 +1,111 @@
+"""Failpoint fault injection (role of pingcap failpoint in the reference,
+SURVEY.md §4: injection sites in wal/shard/coordinator/transport, toggled
+per-test and via the syscontrol admin plane)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils import failpoint
+from opengemini_tpu.utils.failpoint import Failpoint as fp, FailpointError
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def test_enable_disable_and_fastpath():
+    assert not failpoint.ACTIVE
+    assert failpoint.inject("nope") is False
+    failpoint.enable("x", "error", "boom")
+    assert failpoint.active("x")
+    with pytest.raises(FailpointError, match="boom"):
+        failpoint.inject("x")
+    assert failpoint.list_points()["x"]["hits"] == 1
+    failpoint.disable("x")
+    assert not failpoint.ACTIVE
+
+
+def test_drop_sleep_call_actions():
+    failpoint.enable("d", "drop")
+    assert failpoint.inject("d") is True
+    calls = []
+    failpoint.enable("c", "call", lambda: calls.append(1))
+    failpoint.inject("c")
+    assert calls == [1]
+    failpoint.enable("s", "sleep", 1)
+    assert failpoint.inject("s") is False
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "explode")
+
+
+def test_wal_write_failpoint(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db0", parse_lines("m v=1 1000"))
+    with fp("wal.write.err", "error", "disk gone"):
+        with pytest.raises(FailpointError, match="disk gone"):
+            eng.write_points("db0", parse_lines("m v=2 2000"))
+    # disarmed again: writes succeed
+    eng.write_points("db0", parse_lines("m v=3 3000"))
+    eng.close()
+
+
+def test_shard_flush_failpoint(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db0", parse_lines("m v=1 1000"))
+    with fp("shard.flush.err"):
+        with pytest.raises(FailpointError):
+            eng.flush_all()
+    eng.flush_all()
+    eng.close()
+
+
+def test_transport_drop_failpoint():
+    from opengemini_tpu.cluster.transport import RPCClient, RPCServer
+    srv = RPCServer(handlers={"ping": lambda b: {"pong": True}})
+    srv.start()
+    cli = RPCClient(srv.addr)
+    assert cli.call("ping")["pong"] is True
+    with fp("transport.send.drop", "drop"):
+        with pytest.raises(ConnectionError):
+            cli.call("ping", timeout=2)
+    assert cli.call("ping")["pong"] is True
+    cli.close()
+    srv.stop()
+
+
+def test_syscontrol_http_toggle(tmp_path):
+    from opengemini_tpu.http import HttpServer
+    eng = Engine(str(tmp_path / "d"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def ctl(qs):
+        with urllib.request.urlopen(f"{base}/debug/ctrl?{qs}",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    assert ctl("mod=failpoint&point=wal.write.err&action=error"
+               )["enabled"] is True
+    req = urllib.request.Request(
+        f"{base}/write?db=x", data=b"m v=1 1000", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 500
+    listing = ctl("mod=failpoint")["failpoints"]
+    assert listing["wal.write.err"]["hits"] == 1
+    assert ctl("mod=failpoint&point=wal.write.err&switchon=false"
+               )["enabled"] is False
+    req = urllib.request.Request(
+        f"{base}/write?db=x", data=b"m v=1 1000", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    srv.stop()
+    eng.close()
