@@ -336,9 +336,11 @@ class RPCClient:
         q: Queue = Queue()
         s = None
         # fault injection: simulate a dropped/slow RPC (reference plants
-        # failpoints in the spdy transport, SURVEY.md §4)
+        # failpoints in the spdy transport, SURVEY.md §4). RPCError is
+        # what real transport failures surface as — the injected fault
+        # must exercise the same retry/failover paths
         if failpoint.inject("transport.send.drop"):
-            raise ConnectionError("failpoint: transport.send.drop")
+            raise RPCError("failpoint: transport.send.drop")
         failpoint.inject("transport.send.delay")
         try:
             s = self._ensure()

@@ -614,9 +614,11 @@ class LogStore:
                     sdir = os.path.join(rdir, sname)
                     if not os.path.isdir(sdir):
                         continue
-                    if _TOMBSTONE_SUFFIX in sname:
+                    if re.search(r"\.deleted\.[0-9a-f]+$", sname):
                         # crash mid-delete: finish the job, never
-                        # resurrect the data as a live stream
+                        # resurrect the data as a live stream (exact
+                        # tombstone pattern — a legacy stream merely
+                        # CONTAINING '.deleted' is not destroyed)
                         import shutil
                         shutil.rmtree(sdir, ignore_errors=True)
                         continue

@@ -208,14 +208,20 @@ def prom_rate(win: BucketState, window_end_t, range_ns: int,
         jnp.float64) / 1e9
     end_gap = (window_end_t - win.last_t).astype(jnp.float64) / 1e9
     avg_interval = dur / jnp.maximum(cnt - 1, 1).astype(jnp.float64)
-    extra_start = jnp.minimum(start_gap, avg_interval / 2)
-    extra_end = jnp.minimum(end_gap, avg_interval / 2)
+    # upstream extrapolatedRate: a boundary gap under 1.1×avg_interval is
+    # bridged completely (the series plausibly extends to the boundary);
+    # larger gaps extend by only half a sample interval
+    threshold = avg_interval * 1.1
     # counters can't go below zero: limit start extrapolation
     with np.errstate(divide="ignore", invalid="ignore"):
         zero_limit = jnp.where(
             (kind != "delta") & (delta > 0) & (win.first >= 0),
             win.first / jnp.maximum(delta / dur, 1e-30), jnp.inf)
-    extra_start = jnp.minimum(extra_start, zero_limit)
+    start_gap = jnp.minimum(start_gap, zero_limit)
+    extra_start = jnp.where(start_gap < threshold, start_gap,
+                            avg_interval / 2)
+    extra_end = jnp.where(end_gap < threshold, end_gap,
+                          avg_interval / 2)
     factor = (dur + extra_start + extra_end) / dur
     ext_delta = delta * factor
     if kind == "rate":

@@ -42,6 +42,11 @@ def enable(name: str, action: str = "error", arg: object = None) -> None:
         raise ValueError(f"unknown failpoint action {action}")
     if action == "call" and not callable(arg):
         raise ValueError("action 'call' requires a callable arg")
+    if action == "sleep":
+        try:
+            arg = float(arg or 0)
+        except (TypeError, ValueError):
+            raise ValueError("action 'sleep' requires a numeric ms arg")
     with _lock:
         _points[name] = (action, arg)
         ACTIVE = True
@@ -51,6 +56,7 @@ def disable(name: str) -> None:
     global ACTIVE
     with _lock:
         _points.pop(name, None)
+        _hits.pop(name, None)
         ACTIVE = bool(_points)
 
 

@@ -1,0 +1,159 @@
+"""Prometheus-format compliance test runner.
+
+Role of the reference's PromQL compliance harness (SURVEY.md §4:
+tests/prom_test.go + tests/prom_helpers.go replay upstream-Prometheus-
+style scripts like tests/testdata/aggregators.test). This runner executes
+the same declarative script format against our PromEngine:
+
+    load <step>
+      metric{l="v", ...} <start>(+|-)<inc>x<steps> | v0 v1 v2 ...
+    eval instant at <time> <query>
+      [metric]{l="v"} <value>
+    eval_fail instant at <time> <query>
+    clear
+
+`a+bxN` expands to N+1 samples a, a+b, …, a+N·b at t = 0, step, …, N·step
+(upstream notation). `_` skips a sample. The fixture scripts are authored
+for THIS suite — not copies of upstream files."""
+
+from __future__ import annotations
+
+import math
+import re
+
+from opengemini_tpu.promql import PromEngine
+from opengemini_tpu.storage import PointRow
+
+NS = {"ns": 1, "us": 10**3, "ms": 10**6, "s": 10**9,
+      "m": 60 * 10**9, "h": 3600 * 10**9, "d": 86400 * 10**9}
+
+_DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(ns|us|ms|s|m|h|d)$")
+_SERIES_RE = re.compile(r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)?"
+                        r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<vals>.+)$")
+_EXPAND_RE = re.compile(r"^(-?\d+(?:\.\d+)?)([+-]\d+(?:\.\d+)?)x(\d+)$")
+
+
+def parse_duration(s: str) -> int:
+    m = _DUR_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"bad duration {s!r}")
+    return int(float(m.group(1)) * NS[m.group(2)])
+
+
+def parse_labels(s: str | None) -> dict:
+    out = {}
+    if not s:
+        return out
+    for part in re.findall(r'(\w+)\s*=\s*"([^"]*)"', s):
+        out[part[0]] = part[1]
+    return out
+
+
+def expand_values(spec: str) -> list[float | None]:
+    """`0+10x3` → [0, 10, 20, 30]; literals space-split; `_` → None."""
+    vals: list[float | None] = []
+    for tok in spec.split():
+        m = _EXPAND_RE.match(tok)
+        if m:
+            a, b, n = float(m.group(1)), float(m.group(2)), int(m.group(3))
+            vals.extend(a + b * i for i in range(n + 1))
+        elif tok == "_":
+            vals.append(None)
+        else:
+            vals.append(float(tok))
+    return vals
+
+
+class PromScriptRunner:
+    """Executes one script against a fresh prom db on the given engine."""
+
+    def __init__(self, engine, db: str = "promtest"):
+        self.engine = engine
+        self.db = db
+        self.prom = PromEngine(engine, db)
+        self._gen = 0
+
+    def _clear(self):
+        # fresh db per `clear` (cheap; a db is just a namespace)
+        self._gen += 1
+        self.db = f"{self.db.split('@')[0]}@{self._gen}"
+        self.prom = PromEngine(self.engine, self.db)
+
+    def run(self, script: str) -> None:
+        lines = script.splitlines()
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if not line or line.startswith("#"):
+                i += 1
+                continue
+            if line == "clear":
+                self._clear()
+                i += 1
+                continue
+            if line.startswith("load "):
+                step = parse_duration(line[5:])
+                i += 1
+                rows = []
+                while i < len(lines) and lines[i].startswith("  ") \
+                        and lines[i].strip():
+                    m = _SERIES_RE.match(lines[i].strip())
+                    if not m:
+                        raise ValueError(f"bad series line: {lines[i]}")
+                    name = m.group("name") or "series"
+                    tags = parse_labels(m.group("labels"))
+                    for k, v in enumerate(expand_values(m.group("vals"))):
+                        if v is not None:
+                            rows.append(PointRow(name, tags,
+                                                 {"value": v}, k * step))
+                    i += 1
+                self.engine.write_points(self.db, rows)
+                continue
+            m = re.match(r"^(eval_fail|eval)\s+instant\s+at\s+(\S+)\s+"
+                         r"(.*)$", line)
+            if m:
+                kind, at, query = m.groups()
+                t_ns = parse_duration(at)
+                i += 1
+                expected = []
+                while i < len(lines) and lines[i].startswith("  ") \
+                        and lines[i].strip():
+                    expected.append(lines[i].strip())
+                    i += 1
+                self._eval(kind, t_ns, query, expected, line)
+                continue
+            raise ValueError(f"unrecognized script line: {line!r}")
+
+    def _eval(self, kind: str, t_ns: int, query: str,
+              expected: list[str], ctx: str) -> None:
+        if kind == "eval_fail":
+            try:
+                self.prom.query_instant(query, t_ns)
+            except Exception:
+                return
+            raise AssertionError(f"expected failure: {ctx}")
+        got = self.prom.query_instant(query, t_ns)
+        got_set = {}
+        for o in got:
+            labels = {k: v for k, v in o["metric"].items()}
+            key = tuple(sorted(labels.items()))
+            got_set[key] = float(o["value"][1])
+        exp_set = {}
+        for line in expected:
+            m = _SERIES_RE.match(line)
+            if not m:
+                raise ValueError(f"bad expected line {line!r} in {ctx}")
+            labels = parse_labels(m.group("labels"))
+            if m.group("name"):
+                labels["__name__"] = m.group("name")
+            exp_set[tuple(sorted(labels.items()))] = \
+                float(m.group("vals"))
+        assert set(got_set) == set(exp_set), (
+            f"{ctx}\n  got series:      {sorted(got_set)}\n"
+            f"  expected series: {sorted(exp_set)}")
+        for key, want in exp_set.items():
+            have = got_set[key]
+            ok = (math.isnan(want) and math.isnan(have)) or \
+                have == want or math.isclose(have, want, rel_tol=1e-9,
+                                             abs_tol=1e-9)
+            assert ok, f"{ctx}\n  {dict(key)}: got {have}, want {want}"

@@ -30,6 +30,9 @@ def test_enable_disable_and_fastpath():
     assert failpoint.list_points()["x"]["hits"] == 1
     failpoint.disable("x")
     assert not failpoint.ACTIVE
+    # hit counts reset across arm cycles
+    failpoint.enable("x", "drop")
+    assert failpoint.list_points()["x"]["hits"] == 0
 
 
 def test_drop_sleep_call_actions():
@@ -43,6 +46,10 @@ def test_drop_sleep_call_actions():
     assert failpoint.inject("s") is False
     with pytest.raises(ValueError):
         failpoint.enable("bad", "explode")
+    with pytest.raises(ValueError):
+        failpoint.enable("s2", "sleep", "abc")
+    with pytest.raises(ValueError):
+        failpoint.enable("c2", "call", None)
 
 
 def test_wal_write_failpoint(tmp_path):
@@ -72,8 +79,9 @@ def test_transport_drop_failpoint():
     srv.start()
     cli = RPCClient(srv.addr)
     assert cli.call("ping")["pong"] is True
+    from opengemini_tpu.cluster.transport import RPCError
     with fp("transport.send.drop", "drop"):
-        with pytest.raises(ConnectionError):
+        with pytest.raises(RPCError):
             cli.call("ping", timeout=2)
     assert cli.call("ping")["pong"] is True
     cli.close()

@@ -1,0 +1,47 @@
+"""PromQL compliance: replay Prometheus-format test scripts (reference
+tests/prom_test.go + testdata/aggregators.test model)."""
+
+import os
+
+import pytest
+
+from opengemini_tpu.storage import Engine
+
+from promtest_runner import (PromScriptRunner, expand_values,
+                             parse_duration, parse_labels)
+
+HERE = os.path.dirname(__file__)
+
+
+def test_expand_values():
+    assert expand_values("0+10x3") == [0, 10, 20, 30]
+    assert expand_values("100-5x2") == [100, 95, 90]
+    assert expand_values("1 _ 3") == [1, None, 3]
+
+
+def test_parse_helpers():
+    assert parse_duration("5m") == 300 * 10**9
+    assert parse_labels('a="x", b="y"') == {"a": "x", "b": "y"}
+
+
+def test_promql_suite_script(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    runner = PromScriptRunner(eng)
+    with open(os.path.join(HERE, "testdata", "promql_suite.test")) as f:
+        runner.run(f.read())
+    eng.close()
+
+
+def test_runner_reports_mismatch(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    runner = PromScriptRunner(eng, db="pm2")
+    script = """
+load 1m
+  m{a="1"} 1 2 3
+
+eval instant at 2m m
+  m{a="1"} 999
+"""
+    with pytest.raises(AssertionError):
+        runner.run(script)
+    eng.close()

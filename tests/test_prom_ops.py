@@ -28,11 +28,18 @@ def py_extrapolated_rate(samples, window_start, window_end, range_s,
     if dur <= 0:
         return None
     avg_iv = dur / (len(samples) - 1)
-    extra_start = min(ts[0] - window_start, avg_iv / 2)
-    extra_end = min(window_end - ts[-1], avg_iv / 2)
+    # upstream promql extrapolatedRate: bridge a boundary gap fully when
+    # it is under 1.1×avg interval, else extend by half an interval
+    thr = avg_iv * 1.1
+    extra_start = ts[0] - window_start
+    extra_end = window_end - ts[-1]
     if kind != "delta" and delta > 0 and vs[0] >= 0:
         zl = vs[0] / (delta / dur)
         extra_start = min(extra_start, zl)
+    if extra_start >= thr:
+        extra_start = avg_iv / 2
+    if extra_end >= thr:
+        extra_end = avg_iv / 2
     factor = (dur + extra_start + extra_end) / dur
     ext = delta * factor
     return ext / range_s if kind == "rate" else ext

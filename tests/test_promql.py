@@ -88,14 +88,14 @@ def test_instant_with_matcher(prom):
 
 
 def test_rate_range_query(prom):
-    # window (t-60, t] holds 4 samples (t-45..t): delta=3 steps over 45s,
-    # prom extrapolation adds half an interval at the start (7.5s capped)
-    # → rate = 3*(52.5/45)/60 = 3.5/60 (the well-known prom quirk)
+    # window (t-60, t] holds 4 samples (t-45..t): delta=3 steps over 45s;
+    # the 15s boundary gap is under 1.1×interval so upstream extrapolation
+    # bridges it fully → rate = 3*(60/45)/60 = 4/60 (the true slope)
     out = prom.query_range("rate(http_requests_total[1m])",
                            2 * M, 10 * M, M)
     assert len(out) == 2
     for o in out:
-        r = 3.5 / 60 if o["metric"]["host"] == "h0" else 7.0 / 60
+        r = 4.0 / 60 if o["metric"]["host"] == "h0" else 8.0 / 60
         for _t, v in o["values"]:
             np.testing.assert_allclose(float(v), r, rtol=1e-9)
         assert "__name__" not in o["metric"]
@@ -107,16 +107,16 @@ def test_sum_rate_by_job(prom):
     assert len(out) == 1
     assert out[0]["metric"] == {"job": "api"}
     for _t, v in out[0]["values"]:
-        np.testing.assert_allclose(float(v), 10.5 / 60, rtol=1e-9)
+        np.testing.assert_allclose(float(v), 12.0 / 60, rtol=1e-9)
 
 
 def test_increase(prom):
-    # extrapolated increase: delta 3 (resp. 6) × (52.5/45)
+    # extrapolated increase: delta 3 (resp. 6) × (60/45) — full bridge
     out = prom.query_range("increase(http_requests_total[1m])",
                            2 * M, 5 * M, M)
     m = {o["metric"]["host"]: float(o["values"][0][1]) for o in out}
-    np.testing.assert_allclose(m["h0"], 3.5, rtol=1e-9)
-    np.testing.assert_allclose(m["h1"], 7.0, rtol=1e-9)
+    np.testing.assert_allclose(m["h0"], 4.0, rtol=1e-9)
+    np.testing.assert_allclose(m["h1"], 8.0, rtol=1e-9)
 
 
 def test_gauge_functions(prom):
