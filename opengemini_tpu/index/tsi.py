@@ -88,6 +88,11 @@ class SeriesIndex:
             items = bytes(data[pos:pos + ln]).split(b"\x00")
             pos += ln
             measurement = items[0].decode()
+            if sid == 0:
+                # drop-measurement tombstone (sids are 1-based, so 0 is
+                # free to mark it)
+                self._drop_in_mem(measurement)
+                continue
             tags = dict(i.decode().split("=", 1) for i in items[1:])
             self._insert(measurement, tags, sid)
 
@@ -105,6 +110,31 @@ class SeriesIndex:
         self._mst_sids.setdefault(measurement, []).append(sid)
         for k, v in tags.items():
             self._postings.setdefault((measurement, k, v), []).append(sid)
+
+    def _drop_in_mem(self, measurement: str) -> None:
+        sids = self._mst_sids.pop(measurement, [])
+        for sid in sids:
+            tags = self._sid_to_tags[sid] or {}
+            self._key_to_sid.pop(series_key(measurement, tags), None)
+            self._sid_to_tags[sid] = None
+            self._sid_to_mst[sid] = None
+        for k in [k for k in self._postings if k[0] == measurement]:
+            del self._postings[k]
+
+    def drop_measurement(self, measurement: str) -> None:
+        """Remove every series of a measurement (DROP MEASUREMENT;
+        reference tsi DropMeasurement). Persisted as a sid=0 tombstone
+        record so replay reproduces the drop."""
+        with self._lock:
+            self._drop_in_mem(measurement)
+            if self._log is not None:
+                payload = measurement.encode()
+                self._log.write(struct.pack("<IQ", len(payload), 0)
+                                + payload)
+                # fsync: the data files are already gone — losing the
+                # tombstone would resurrect the series in the index
+                self._log.flush()
+                os.fsync(self._log.fileno())
 
     def get_or_create_sid(self, measurement: str,
                           tags: dict[str, str]) -> int:

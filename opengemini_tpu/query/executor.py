@@ -110,11 +110,45 @@ class QueryExecutor:
                     self.engine.create_columnstore(
                         cdb, stmt.name, stmt.primary_key, stmt.indexes)
                 return {}
-            if isinstance(stmt, (DropMeasurementStatement, DeleteStatement)):
-                return {"error": "not implemented yet"}
+            if isinstance(stmt, DropMeasurementStatement):
+                ddb = db
+                if ddb is None:
+                    return {"error": "database required"}
+                if ddb not in self.engine.databases:
+                    return {"error": f"database not found: {ddb}"}
+                self.engine.drop_measurement(ddb, stmt.name)
+                return {}
+            if isinstance(stmt, DeleteStatement):
+                return self._delete(stmt, db)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
         except ErrQueryError as e:
             return {"error": str(e)}
+
+    def _delete(self, stmt: DeleteStatement, db: str | None) -> dict:
+        """DELETE FROM m [WHERE time and/or tag predicates] (influx DELETE
+        semantics: no field predicates)."""
+        if db is None:
+            return {"error": "database required"}
+        if db not in self.engine.databases:
+            return {"error": f"database not found: {db}"}
+        mst = stmt.from_measurement
+        if not mst:
+            return {"error": "DELETE requires FROM <measurement>"}
+        db_obj = self.engine.database(db)
+        if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
+            return {"error": "DELETE is not supported on column-store "
+                             "measurements yet"}
+        tag_keys = {k for s in db_obj.all_shards()
+                    for k in s.index.tag_keys(mst)}
+        cond = analyze_condition(stmt.condition, tag_keys)
+        if cond.residual is not None:
+            return {"error": "DELETE supports only time and tag "
+                             "predicates"}
+        t_lo = None if cond.t_min == MIN_TIME else cond.t_min
+        t_hi = None if cond.t_max == MAX_TIME else cond.t_max
+        self.engine.delete_rows(db, mst, t_lo, t_hi,
+                                cond.tag_filters or None)
+        return {}
 
     # ----------------------------------------------------------------- SHOW
 

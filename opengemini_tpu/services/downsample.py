@@ -84,7 +84,8 @@ class DownsampleService(Service):
         if not readers:
             return
         merge_and_swap(shard, mst, readers,
-                       transform=lambda rec: _downsample_record(rec, policy))
+                       transform=lambda rec, _sid:
+                       _downsample_record(rec, policy))
 
 
 def _downsample_record(rec: Record, policy) -> Record:

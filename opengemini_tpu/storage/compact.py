@@ -42,7 +42,7 @@ def iter_merged_series(readers):
 def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
     """Merge `readers` (a CONTIGUOUS, oldest→newest slice of the shard's
     file list for `mst`) into one new TSSP file — optionally rewriting
-    each merged record through `transform(rec)` — then atomically swap it
+    each merged record through `transform(rec, sid)` — then atomically swap it
     into the file list at the position of the oldest input and unlink the
     inputs. Shared by compaction and downsampling; the shard's table_lock
     serializes all such whole-table rewrites so two services can never
@@ -67,7 +67,7 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
         wrote = False
         for sid, rec in iter_merged_series(readers):
             if transform is not None:
-                rec = transform(rec)
+                rec = transform(rec, sid)
             if rec.num_rows:
                 w.write_series(sid, rec)
                 wrote = True

@@ -259,6 +259,32 @@ class Engine:
             for s in db.all_shards():
                 s.flush()
 
+    def drop_measurement(self, db_name: str, mst: str) -> None:
+        """DROP MEASUREMENT across all shards (reference
+        Engine.DropMeasurement). Flush first: WAL replay must not
+        resurrect the dropped rows."""
+        db = self.database(db_name)
+        for s in db.all_shards():
+            s.flush()
+            s.drop_measurement(mst)
+
+    def delete_rows(self, db_name: str, mst: str,
+                    t_min: int | None = None, t_max: int | None = None,
+                    tag_filters=None) -> int:
+        """DELETE FROM mst [WHERE time/tag predicates] (reference
+        Engine delete path). Returns rows removed."""
+        db = self.database(db_name)
+        removed = 0
+        for s in db.all_shards():
+            s.flush()
+            sids = None
+            if tag_filters:
+                sids = s.index.series_ids(mst, tag_filters)
+                if len(sids) == 0:
+                    continue
+            removed += s.delete_rows(mst, t_min, t_max, sids)
+        return removed
+
     def close(self) -> None:
         for db in list(self.databases.values()):
             for s in db.all_shards():

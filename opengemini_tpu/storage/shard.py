@@ -86,9 +86,14 @@ class Shard:
         with open(self._schema_path, "r", encoding="utf-8") as f:
             for line in f:
                 parts = line.rstrip("\n").split("\t")
-                if len(parts) == 3:
-                    self._schemas.setdefault(parts[0], {})[parts[1]] = (
-                        DataType(int(parts[2])))
+                if len(parts) != 3:
+                    continue
+                if parts[1] == "__drop__":
+                    # drop-measurement tombstone (append-only registry)
+                    self._schemas.pop(parts[0], None)
+                    continue
+                self._schemas.setdefault(parts[0], {})[parts[1]] = (
+                    DataType(int(parts[2])))
 
     def _check_fields(self, staged: dict, mst: str, fields: dict) -> None:
         """Two-phase type check: validates fields against registry + already
@@ -311,6 +316,91 @@ class Shard:
                 raise
 
     # ---- hierarchical tier ----------------------------------------------
+
+    def drop_measurement(self, mst: str) -> None:
+        """Remove a measurement's data, files, series and schema (role of
+        the reference's DropMeasurement engine path). Callers flush first
+        so the WAL holds no rows that would resurrect it on replay.
+        table_lock serializes against compaction/downsample rewrites;
+        readers are unlinked but NOT closed (in-flight queries may hold
+        them — the mmap dies with the last reference, merge_and_swap
+        convention)."""
+        with self.table_lock:
+            with self._lock:
+                files = self._files.pop(mst, [])
+                cs_files = self._cs_files.pop(mst, [])
+                with self.mem._lock:
+                    self.mem.active.pop(mst, None)
+                    if self.mem.snapshot is not None:
+                        self.mem.snapshot.pop(mst, None)
+                self.index.drop_measurement(mst)
+                if mst in self._schemas:
+                    del self._schemas[mst]
+                    # append-only registry: tombstone line (type -1)
+                    self._persist_schema_lines(
+                        [f"{mst}\t__drop__\t-1\n"])
+            for r in files:
+                if r.detached:
+                    try:
+                        os.unlink(r.path + ".detached")
+                    except OSError:
+                        pass
+                    try:
+                        r._mm.store.delete(r._mm.key)
+                    except Exception as e:
+                        log.error("drop: failed to delete cold object "
+                                  "for %s: %s", r.path, e)
+                    continue
+                try:
+                    os.unlink(r.path)
+                except OSError:
+                    pass
+            for r in cs_files:
+                try:
+                    os.unlink(r.path)
+                except OSError:
+                    pass
+
+    def delete_rows(self, mst: str, t_min: int | None = None,
+                    t_max: int | None = None,
+                    sids: np.ndarray | None = None) -> int:
+        """DELETE FROM mst [WHERE time/tags]: rewrite the matching TSSP
+        files without the deleted rows (the reference deletes via engine
+        tombstones; a rewrite is simpler and this path is rare). Each
+        rewrite rides merge_and_swap, which owns the table_lock
+        serialization, swap ordering, deferred reader close, and detached
+        cleanup. Callers flush first so only files need rewriting.
+        sids=None deletes across all series; returns rows removed."""
+        del_sids = None if sids is None else {int(s) for s in sids}
+        removed = {"n": 0}
+
+        def transform(rec, sid):
+            if rec.num_rows == 0 or (del_sids is not None
+                                     and sid not in del_sids):
+                return rec
+            t = rec.times
+            drop = np.ones(rec.num_rows, dtype=bool)
+            if t_min is not None:
+                drop &= t >= t_min
+            if t_max is not None:
+                drop &= t <= t_max
+            if not drop.any():
+                return rec
+            removed["n"] += int(drop.sum())
+            return rec.take(np.nonzero(~drop)[0])
+
+        from .compact import merge_and_swap
+        with self._lock:
+            files = list(self._files.get(mst, ()))
+        for f in files:
+            if (t_min is not None and f.max_time < t_min) or \
+                    (t_max is not None and f.min_time > t_max):
+                continue
+            if del_sids is not None and not any(
+                    int(s) in del_sids for s in f.series_ids()):
+                continue
+            merge_and_swap(self, mst, [f], transform=transform)
+        return removed["n"]
 
     def detach_files(self, store, key_prefix: str) -> int:
         """Move this shard's TSSP files to the object store (warm→cold:

@@ -1,0 +1,123 @@
+"""DROP MEASUREMENT and DELETE (reference Engine.DropMeasurement +
+delete path; influx DELETE semantics: time and tag predicates only)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex, str(tmp_path / "data")
+    eng.close()
+
+
+def write(eng, lp):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, "db0")
+
+
+def seed(eng):
+    write(eng, "\n".join(
+        f"cpu,host=h{h} v={h * 10 + w} {w * MIN}"
+        for h in range(2) for w in range(4)))
+    write(eng, "mem m=1 1000")
+
+
+def test_drop_measurement(db):
+    eng, ex, _ = db
+    seed(eng)
+    assert q(ex, "DROP MEASUREMENT cpu") == {}
+    assert q(ex, "SELECT v FROM cpu") == {}
+    assert "series" in q(ex, "SELECT m FROM mem")      # others intact
+    res = q(ex, "SHOW MEASUREMENTS")
+    assert [r[0] for r in res["series"][0]["values"]] == ["mem"]
+
+
+def test_drop_survives_restart(db):
+    eng, ex, path = db
+    seed(eng)
+    eng.flush_all()
+    q(ex, "DROP MEASUREMENT cpu")
+    eng.close()
+    eng2 = Engine(path)
+    ex2 = QueryExecutor(eng2)
+    assert ex2.execute(parse_query("SELECT v FROM cpu")[0], "db0") == {}
+    res = ex2.execute(parse_query("SELECT m FROM mem")[0], "db0")
+    assert res["series"][0]["values"] == [[1000, 1.0]]
+    eng2.close()
+
+
+def test_drop_then_rewrite(db):
+    eng, ex, _ = db
+    seed(eng)
+    q(ex, "DROP MEASUREMENT cpu")
+    write(eng, "cpu,host=h9 v=99 1000")
+    res = q(ex, "SELECT v FROM cpu")
+    assert res["series"][0]["values"] == [[1000, 99.0]]
+
+
+def test_delete_time_range(db):
+    eng, ex, _ = db
+    seed(eng)
+    assert q(ex, "DELETE FROM cpu WHERE time >= 1m AND time < 3m") == {}
+    res = q(ex, "SELECT v FROM cpu WHERE host = 'h0'")
+    assert [r[0] // MIN for r in res["series"][0]["values"]] == [0, 3]
+
+
+def test_delete_with_tag_filter(db):
+    eng, ex, _ = db
+    seed(eng)
+    assert q(ex, "DELETE FROM cpu WHERE host = 'h1'") == {}
+    res = q(ex, "SELECT count(v) FROM cpu")
+    assert res["series"][0]["values"][0][1] == 4       # h0 rows remain
+    res = q(ex, "SELECT v FROM cpu WHERE host = 'h1'")
+    assert res == {}
+
+
+def test_delete_tag_and_time(db):
+    eng, ex, _ = db
+    seed(eng)
+    q(ex, "DELETE FROM cpu WHERE host = 'h1' AND time >= 2m")
+    res = q(ex, "SELECT count(v) FROM cpu WHERE host = 'h1'")
+    assert res["series"][0]["values"][0][1] == 2
+    res = q(ex, "SELECT count(v) FROM cpu WHERE host = 'h0'")
+    assert res["series"][0]["values"][0][1] == 4
+
+
+def test_delete_everything(db):
+    eng, ex, _ = db
+    seed(eng)
+    q(ex, "DELETE FROM cpu")
+    assert q(ex, "SELECT v FROM cpu") == {}
+
+
+def test_delete_survives_restart(db):
+    eng, ex, path = db
+    seed(eng)
+    q(ex, "DELETE FROM cpu WHERE time < 2m")
+    eng.close()
+    eng2 = Engine(path)
+    ex2 = QueryExecutor(eng2)
+    res = ex2.execute(
+        parse_query("SELECT count(v) FROM cpu")[0], "db0")
+    assert res["series"][0]["values"][0][1] == 4       # 2 hosts × 2 rows
+    eng2.close()
+
+
+def test_delete_rejects_field_predicates(db):
+    eng, ex, _ = db
+    seed(eng)
+    res = q(ex, "DELETE FROM cpu WHERE v > 5")
+    assert "error" in res
