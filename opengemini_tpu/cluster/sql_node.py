@@ -16,7 +16,8 @@ from __future__ import annotations
 import threading
 from dataclasses import replace
 
-from ..query.ast import (CreateDatabaseStatement, DropDatabaseStatement,
+from ..query.ast import (CreateDatabaseStatement, DeleteStatement,
+                         DropDatabaseStatement, DropMeasurementStatement,
                          FieldRef, SelectField, SelectStatement,
                          ShowStatement)
 from ..query.executor import (classify_select, finalize_partials,
@@ -131,6 +132,9 @@ class ClusterExecutor:
                 return {}
             if isinstance(stmt, DropDatabaseStatement):
                 return self._drop_database(stmt.name)
+            if isinstance(stmt, (DropMeasurementStatement,
+                                 DeleteStatement)):
+                return self._ddl(stmt, db)
             return {"error":
                     f"unsupported statement {type(stmt).__name__}"}
         except (ErrQueryError, GeminiError, RPCError) as e:
@@ -278,6 +282,21 @@ class ClusterExecutor:
         for s in series_out:
             s["values"] = s["values"][lo:hi]
         return {"series": series_out} if series_out else {}
+
+    def _ddl(self, stmt, db: str | None) -> dict:
+        """Scatter DROP MEASUREMENT / DELETE to every store owning PTs of
+        the db (reference netstorage DDL message fan-out)."""
+        if db is None:
+            return {"error": "database required"}
+        if self.meta.database(db) is None:
+            self.meta.refresh()
+            if self.meta.database(db) is None:
+                return {"error": f"database not found: {db}"}
+        q = format_statement(stmt)
+        resps = self._scatter("store.ddl", db, {"q": q})
+        errs = [r.get("error", "ddl failed") for r in resps
+                if r and not r.get("ok", True)]
+        return {"error": "; ".join(errs)} if errs else {}
 
     def _drop_database(self, name: str) -> dict:
         try:

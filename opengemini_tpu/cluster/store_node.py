@@ -63,6 +63,7 @@ class StoreNode:
                                     "store.select_raw": self._on_select_raw,
                                     "store.show": self._on_show,
                                     "store.drop_db": self._on_drop_db,
+                                    "store.ddl": self._on_ddl,
                                     "store.measurements": self._on_measurements,
                                     "store.load_pt": self._on_load_pt,
                                     "store.drop_pt": self._on_drop_pt,
@@ -197,6 +198,25 @@ class StoreNode:
             if dbk in self.engine.databases:
                 out.update(self.engine.measurements(dbk))
         return {"measurements": sorted(out)}
+
+    def _on_ddl(self, body):
+        """Execute a DDL/DML statement (DROP MEASUREMENT, DELETE) on each
+        local partition of the db — scattered from the sql node like the
+        reference's netstorage DDL messages (lib/netstorage/
+        message_types.go)."""
+        from ..query import parse_query
+        (stmt,) = parse_query(body["q"])
+        errs = []
+        for pt in body["pts"]:
+            dbk = db_key(body["db"], pt)
+            if dbk not in self.engine.databases:
+                continue
+            res = self.executor.execute(stmt, dbk)
+            if "error" in res:
+                errs.append(res["error"])
+        if errs:
+            return {"ok": False, "error": "; ".join(errs)}
+        return {"ok": True}
 
     def _on_drop_db(self, body):
         db = body["db"]

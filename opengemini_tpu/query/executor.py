@@ -138,6 +138,12 @@ class QueryExecutor:
         if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
             return {"error": "DELETE is not supported on column-store "
                              "measurements yet"}
+        if mst not in self.engine.measurements(db):
+            # nothing to delete here — vital in the cluster, where the
+            # scatter runs this on every PT and series hashing may have
+            # put no series of mst on this one (an unknown-tag-key
+            # predicate would otherwise misclassify as residual → error)
+            return {}
         tag_keys = {k for s in db_obj.all_shards()
                     for k in s.index.tag_keys(mst)}
         cond = analyze_condition(stmt.condition, tag_keys)

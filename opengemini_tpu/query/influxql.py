@@ -610,4 +610,11 @@ def format_statement(stmt) -> str:
         if stmt.offset:
             parts.append(f"OFFSET {stmt.offset}")
         return " ".join(parts)
+    if isinstance(stmt, DropMeasurementStatement):
+        return f"DROP MEASUREMENT {_fmt_ident(stmt.name)}"
+    if isinstance(stmt, DeleteStatement):
+        out = f"DELETE FROM {_fmt_ident(stmt.from_measurement)}"
+        if stmt.condition is not None:
+            out += f" WHERE {format_expr(stmt.condition)}"
+        return out
     raise ValueError(f"cannot format statement {type(stmt).__name__}")

@@ -39,6 +39,32 @@ def iter_merged_series(readers):
             yield sid, rec
 
 
+def remove_reader_files(readers) -> None:
+    """Unlink replaced TSSP inputs but do NOT close them: in-flight
+    queries may still hold the readers (POSIX keeps the mapped data alive
+    after unlink); the mmap closes when the last reference drops
+    (TSSPReader.__del__). Detached inputs: drop the marker AND the
+    object-store copy, or a restart would resurrect the pre-merge data
+    through the stale marker. Shared by compaction/downsample swaps and
+    DROP MEASUREMENT."""
+    for r in readers:
+        if r.detached:
+            try:
+                os.unlink(r.path + ".detached")
+            except OSError:
+                pass
+            try:
+                r._mm.store.delete(r._mm.key)
+            except Exception as e:
+                log.error("failed to delete cold object for %s: %s",
+                          r.path, e)
+            continue
+        try:
+            os.unlink(r.path)
+        except OSError as e:
+            log.error("failed to remove %s: %s", r.path, e)
+
+
 def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
     """Merge `readers` (a CONTIGUOUS, oldest→newest slice of the shard's
     file list for `mst`) into one new TSSP file — optionally rewriting
@@ -95,29 +121,7 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
             if not inserted:
                 new_list.append(new_reader)
             shard._files[mst] = new_list
-        # unlink but do NOT close: in-flight queries may still hold these
-        # readers (POSIX keeps the mapped data alive after unlink); the
-        # mmap closes when the last reference drops (TSSPReader.__del__).
-        # Detached inputs: drop the marker AND the object-store copy, or a
-        # restart would resurrect the pre-merge data through the stale
-        # marker.
-        for r in readers:
-            if r.detached:
-                try:
-                    os.unlink(r.path + ".detached")
-                except OSError:
-                    pass
-                try:
-                    r._mm.store.delete(r._mm.key)
-                except Exception as e:
-                    log.error("merge_and_swap: failed to delete cold "
-                              "object for %s: %s", r.path, e)
-                continue
-            try:
-                os.unlink(r.path)
-            except OSError as e:
-                log.error("merge_and_swap: failed to remove %s: %s",
-                          r.path, e)
+        remove_reader_files(readers)
         return out_path if new_reader is not None else None
 
 

@@ -65,10 +65,12 @@ class Shard:
         self._cs_files: dict[str, list[ColumnStoreReader]] = {}
         self._file_seq = 0
         self._lock = threading.RLock()
-        # serializes whole-table file rewrites (compaction, downsample):
-        # two concurrent merges over overlapping file sets would each
-        # swap in their own output and resurrect replaced data
-        self.table_lock = threading.Lock()
+        # serializes whole-table file rewrites (compaction, downsample,
+        # delete): two concurrent merges over overlapping file sets would
+        # each swap in their own output and resurrect replaced data.
+        # RLock: delete_rows holds it across its whole rewrite loop while
+        # each inner merge_and_swap re-acquires it
+        self.table_lock = threading.RLock()
         # durable measurement→field→type registry: memtable schemas reset at
         # flush, so type stability across flushes must be enforced here
         # (role of the reference's measurement schema in ts-meta)
@@ -88,8 +90,10 @@ class Shard:
                 parts = line.rstrip("\n").split("\t")
                 if len(parts) != 3:
                     continue
-                if parts[1] == "__drop__":
-                    # drop-measurement tombstone (append-only registry)
+                if parts[1] == "__drop__" and parts[2] == "-1":
+                    # drop-measurement tombstone (append-only registry);
+                    # type -1 disambiguates from a user field that is
+                    # literally named __drop__ (always a real DataType)
                     self._schemas.pop(parts[0], None)
                     continue
                 self._schemas.setdefault(parts[0], {})[parts[1]] = (
@@ -339,22 +343,8 @@ class Shard:
                     # append-only registry: tombstone line (type -1)
                     self._persist_schema_lines(
                         [f"{mst}\t__drop__\t-1\n"])
-            for r in files:
-                if r.detached:
-                    try:
-                        os.unlink(r.path + ".detached")
-                    except OSError:
-                        pass
-                    try:
-                        r._mm.store.delete(r._mm.key)
-                    except Exception as e:
-                        log.error("drop: failed to delete cold object "
-                                  "for %s: %s", r.path, e)
-                    continue
-                try:
-                    os.unlink(r.path)
-                except OSError:
-                    pass
+            from .compact import remove_reader_files
+            remove_reader_files(files)
             for r in cs_files:
                 try:
                     os.unlink(r.path)
@@ -390,16 +380,21 @@ class Shard:
             return rec.take(np.nonzero(~drop)[0])
 
         from .compact import merge_and_swap
-        with self._lock:
-            files = list(self._files.get(mst, ()))
-        for f in files:
-            if (t_min is not None and f.max_time < t_min) or \
-                    (t_max is not None and f.min_time > t_max):
-                continue
-            if del_sids is not None and not any(
-                    int(s) in del_sids for s in f.series_ids()):
-                continue
-            merge_and_swap(self, mst, [f], transform=transform)
+
+        # hold table_lock across snapshot AND rewrites: otherwise a
+        # concurrent compaction could replace a snapshotted file with a
+        # merged one the loop never visits (rows silently surviving)
+        with self.table_lock:
+            with self._lock:
+                files = list(self._files.get(mst, ()))
+            for f in files:
+                if (t_min is not None and f.max_time < t_min) or \
+                        (t_max is not None and f.min_time > t_max):
+                    continue
+                if del_sids is not None and not any(
+                        int(s) in del_sids for s in f.series_ids()):
+                    continue
+                merge_and_swap(self, mst, [f], transform=transform)
         return removed["n"]
 
     def detach_files(self, store, key_prefix: str) -> int:

@@ -216,3 +216,33 @@ def test_drop_database_cluster(loaded):
     assert "error" not in res
     sql.meta.refresh()
     assert sql.meta.database("dropme") is None
+
+
+def test_cluster_delete_and_drop(loaded):
+    """DELETE/DROP MEASUREMENT scatter to every store PT and match the
+    single-node engine's behavior (order matters: runs last — it
+    mutates the shared fixture data)."""
+    # use a dedicated measurement so earlier tests are unaffected
+    rows = [PointRow("ephem", {"host": f"h{h}"}, {"v": float(h * 10 + i)},
+                     i * MIN) for h in range(2) for i in range(4)]
+    loaded["sql"].facade.write_points("tsbs", rows)
+    r = _cluster_result(loaded,
+                        "DELETE FROM ephem WHERE time >= 1m AND time < 3m")
+    assert r == {}
+    res = _cluster_result(loaded, "SELECT count(v) FROM ephem")
+    assert res["series"][0]["values"][0][1] == 4      # 2 hosts × 2 rows
+    r = _cluster_result(loaded, "DROP MEASUREMENT ephem")
+    assert r == {}
+    assert _cluster_result(loaded, "SELECT v FROM ephem") == {}
+
+
+def test_cluster_delete_with_tag_predicate(loaded):
+    """Tag-filtered DELETE must succeed even on PTs holding no series of
+    the measurement (runs after the other DELETE test; own measurement)."""
+    rows = [PointRow("ephem2", {"host": f"h{h}"}, {"v": 1.0}, h * MIN)
+            for h in range(2)]
+    loaded["sql"].facade.write_points("tsbs", rows)
+    r = _cluster_result(loaded, "DELETE FROM ephem2 WHERE host = 'h1'")
+    assert r == {}
+    res = _cluster_result(loaded, "SELECT count(v) FROM ephem2")
+    assert res["series"][0]["values"][0][1] == 1

@@ -121,3 +121,20 @@ def test_delete_rejects_field_predicates(db):
     seed(eng)
     res = q(ex, "DELETE FROM cpu WHERE v > 5")
     assert "error" in res
+
+
+def test_field_named_drop_survives_restart(db):
+    """A user field literally named __drop__ must not be mistaken for the
+    schema tombstone on reload."""
+    eng, ex, path = db
+    write(eng, "weird __drop__=1,v=2.5 1000")
+    eng.flush_all()
+    eng.close()
+    eng2 = Engine(path)
+    ex2 = QueryExecutor(eng2)
+    res = ex2.execute(parse_query("SELECT v FROM weird")[0], "db0")
+    assert res["series"][0]["values"] == [[1000, 2.5]]
+    # type registry intact: conflicting write still rejected
+    with pytest.raises(Exception):
+        eng2.write_points("db0", parse_lines('weird v="s" 2000'))
+    eng2.close()
