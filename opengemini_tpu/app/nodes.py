@@ -190,6 +190,17 @@ class TsServer:
         self.ts_meta = (TsMeta(data_dir=f"{data_dir}/meta", host=host)
                         if with_meta else None)
         self.meta_client: MetaClient | None = None
+        # background retention: engine shards (infinite without a
+        # catalog policy) + per-logstream TTLs
+        from ..services.retention import RetentionService
+
+        class _NoPolicies:
+            def retention_policy(self, db):
+                raise KeyError(db)
+
+        self.retention = RetentionService(
+            self.engine, _NoPolicies(), interval_s=1800,
+            logstore=self.http.logstore)
 
     @property
     def http_addr(self) -> str:
@@ -201,9 +212,11 @@ class TsServer:
             self.ts_meta.server.raft.wait_leader(10.0)
             self.meta_client = MetaClient([self.ts_meta.addr])
         self.http.start()
+        self.retention.start()
         log.info("ts-server ready at %s", self.http_addr)
 
     def stop(self):
+        self.retention.stop()
         self.http.stop()
         if self.meta_client is not None:
             self.meta_client.close()

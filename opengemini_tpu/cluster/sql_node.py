@@ -286,6 +286,9 @@ class ClusterExecutor:
     def _ddl(self, stmt, db: str | None) -> dict:
         """Scatter DROP MEASUREMENT / DELETE to every store owning PTs of
         the db (reference netstorage DDL message fan-out)."""
+        if isinstance(stmt, DeleteStatement) \
+                and not stmt.from_measurement:
+            return {"error": "DELETE requires FROM <measurement>"}
         if db is None:
             return {"error": "database required"}
         if self.meta.database(db) is None:

@@ -58,9 +58,23 @@ class HttpServer:
             self.config.data.max_concurrent_queries,
             self.config.data.max_queued_queries,
             self.config.data.max_series_per_query)
+        # user catalog + auth (reference [http] auth-enabled + meta users)
+        import os as _os
+
+        from ..meta.users import UserStore
+        upath = getattr(config, "users_path", None) if config else None
+        data = getattr(engine, "data_path", None) \
+            or getattr(engine, "path", None)
+        if upath is None and isinstance(data, str):
+            upath = _os.path.join(data, "users.json")
+        self.user_store = UserStore(upath)
+        if self.config.http.auth_enabled and upath is None:
+            log.warning("auth enabled but no durable user path "
+                        "(cluster facade without data_dir): users are "
+                        "in-memory and lost on restart")
         self.executor = executor or QueryExecutor(
             engine, query_manager=self.query_manager,
-            resources=self.resources)
+            resources=self.resources, users=self.user_store)
         self.sysctrl = SysControl(engine if local else None)
         self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
@@ -102,6 +116,67 @@ class HttpServer:
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    @staticmethod
+    def _is_user_stmt(stmt) -> bool:
+        from ..query.ast import (CreateUserStatement, DropUserStatement,
+                                 SetPasswordStatement, ShowStatement)
+        return isinstance(stmt, (CreateUserStatement, DropUserStatement,
+                                 SetPasswordStatement)) or \
+            (isinstance(stmt, ShowStatement) and stmt.what == "users")
+
+    def _exec_user_stmt(self, stmt) -> dict:
+        from ..query.ast import (CreateUserStatement, DropUserStatement,
+                                 SetPasswordStatement)
+        try:
+            if isinstance(stmt, CreateUserStatement):
+                self.user_store.create_user(stmt.name, stmt.password,
+                                            stmt.admin)
+            elif isinstance(stmt, DropUserStatement):
+                self.user_store.drop_user(stmt.name)
+            elif isinstance(stmt, SetPasswordStatement):
+                self.user_store.set_password(stmt.name, stmt.password)
+            else:                              # SHOW USERS
+                return {"series": [
+                    {"name": "", "columns": ["user", "admin"],
+                     "values": [[u.name, u.admin]
+                                for u in self.user_store.users()]}]}
+        except ValueError as e:
+            return {"error": str(e)}
+        return {}
+
+    def _deny_privilege(self, stmt, user) -> str | None:
+        """Admin gate for destructive/user statements when auth is
+        enforced (reference httpd privilege checks). A non-admin may
+        still change their own password."""
+        if not self.auth_required():
+            return None
+        from ..query.ast import (CreateDatabaseStatement,
+                                 CreateMeasurementStatement,
+                                 CreateUserStatement, DeleteStatement,
+                                 DropDatabaseStatement,
+                                 DropMeasurementStatement,
+                                 DropUserStatement, KillQueryStatement,
+                                 SetPasswordStatement)
+        if isinstance(stmt, SetPasswordStatement) and user is not None \
+                and stmt.name == user.name:
+            return None
+        admin_only = (CreateUserStatement, DropUserStatement,
+                      SetPasswordStatement, CreateDatabaseStatement,
+                      CreateMeasurementStatement,
+                      DropDatabaseStatement, DropMeasurementStatement,
+                      DeleteStatement, KillQueryStatement)
+        if isinstance(stmt, admin_only) and (user is None
+                                             or not user.admin):
+            return "admin privilege required"
+        return None
+
+    def auth_required(self) -> bool:
+        """Enforce auth only when enabled AND at least one user exists
+        (influx 1.x bootstrap rule: the first admin is created over an
+        unauthenticated connection)."""
+        return bool(self.config.http.auth_enabled and
+                    len(self.user_store))
 
     @property
     def logstore(self):
@@ -284,7 +359,7 @@ class HttpServer:
         self._bump("points_written", n)
         return 204, {}
 
-    def handle_query(self, params: dict) -> tuple[int, dict]:
+    def handle_query(self, params: dict, user=None) -> tuple[int, dict]:
         qtext = params.get("q")
         if not qtext:
             return 400, {"error": "missing required parameter \"q\""}
@@ -310,11 +385,21 @@ class HttpServer:
         results = []
         for i, stmt in enumerate(stmts):
             try:
-                # one cache slot per statement of a multi-statement query
-                stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
-                res = self.executor.execute(stmt, db,
-                                            inc_query_id=stmt_qid,
-                                            iter_id=iter_id)
+                deny = self._deny_privilege(stmt, user)
+                if deny is not None:
+                    res = {"error": deny}
+                elif self._is_user_stmt(stmt):
+                    # executed against the server's own user catalog —
+                    # works identically over the cluster facade (whose
+                    # executor has no user branch)
+                    res = self._exec_user_stmt(stmt)
+                else:
+                    # one cache slot per statement of a multi-statement
+                    # query
+                    stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
+                    res = self.executor.execute(stmt, db,
+                                                inc_query_id=stmt_qid,
+                                                iter_id=iter_id)
             except Exception as e:  # an executor bug must not kill the conn
                 log.exception("query execution failed: %s", qtext)
                 res = {"error": f"internal error: {e}"}
@@ -483,11 +568,62 @@ class _Handler(BaseHTTPRequestHandler):
     def _path(self) -> str:
         return urllib.parse.urlparse(self.path).path
 
+    _AUTH_OPEN = {"/ping", "/health"}
+
+    def _auth(self):
+        """Returns (ok, user). When not ok, a 401 was already sent.
+        Credentials: Basic auth header or influx-style u/p params."""
+        srv = self.server_ref
+        if not srv.auth_required() or self._path() in self._AUTH_OPEN:
+            return True, None
+        import base64
+        u = p = None
+        hdr = self.headers.get("Authorization", "")
+        if hdr.startswith("Basic "):
+            try:
+                u, p = base64.b64decode(hdr[6:]).decode().split(":", 1)
+            except Exception:
+                pass
+        else:
+            params = self._params()
+            u, p = params.get("u"), params.get("p")
+            if u is None and "form-urlencoded" in \
+                    self.headers.get("Content-Type", ""):
+                # influx 1.x clients may POST u/p in the form body
+                try:
+                    form = {k: v[0] for k, v in urllib.parse.parse_qs(
+                        self._body().decode()).items()}
+                    u, p = form.get("u"), form.get("p")
+                except Exception:
+                    pass
+        user = srv.user_store.authenticate(u or "", p or "") \
+            if u is not None else None
+        if user is None:
+            # drain the unread body: replying without consuming it
+            # desyncs HTTP/1.1 keep-alive; close to be safe
+            try:
+                self._body()
+            except Exception:
+                pass
+            self.close_connection = True
+            self._reply(401, {"error": "authorization required"},
+                        headers={"WWW-Authenticate":
+                                 'Basic realm="opengemini"',
+                                 "Connection": "close"})
+            return False, None
+        return True, user
+
     def _body(self) -> bytes:
+        # cached: _auth may need form-body credentials before the route
+        # handler consumes the same body
+        cached = getattr(self, "_body_cache", None)
+        if cached is not None:
+            return cached
         ln = int(self.headers.get("Content-Length", 0) or 0)
         raw = self.rfile.read(ln) if ln else b""
         if self.headers.get("Content-Encoding") == "gzip":
             raw = gzip.decompress(raw)
+        self._body_cache = raw
         return raw
 
     def _reply(self, code: int, payload: dict | None = None,
@@ -510,6 +646,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv = self.server_ref
         path = self._path()
+        ok, user = self._auth()
+        if not ok:
+            return
         if path == "/ping":
             self._reply(204)
             return
@@ -526,7 +665,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, payload)
             return
         if path == "/query":
-            code, payload = srv.handle_query(self._params())
+            code, payload = srv.handle_query(self._params(), user=user)
             self._reply(code, payload)
             return
         if self._is_logstore(path):
@@ -550,6 +689,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         srv = self.server_ref
         path = self._path()
+        ok, user = self._auth()
+        if not ok:
+            return
         if path == "/write":
             try:
                 body = self._body()
@@ -565,7 +707,7 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # bad gzip / non-utf8 form body
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
-            code, payload = srv.handle_query(params)
+            code, payload = srv.handle_query(params, user=user)
             self._reply(code, payload)
             return
         if path == "/debug/ctrl":
@@ -597,6 +739,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         path = self._path()
+        ok, user = self._auth()
+        if not ok:
+            return
         if self._is_logstore(path):
             code, payload = self.server_ref.handle_logstore(
                 "DELETE", path, self._params(), b"")
@@ -606,6 +751,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         path = self._path()
+        ok, user = self._auth()
+        if not ok:
+            return
         if self._is_logstore(path):
             try:
                 body = self._body()

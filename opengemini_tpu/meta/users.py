@@ -1,0 +1,111 @@
+"""User catalog + authentication.
+
+Role of the reference's user management: users live in the meta catalog
+(`lib/util/lifted/influx/meta/data.go` Users, raft-replicated;
+`meta_client.go` CreateUser/DropUser/UpdateUser/Authenticate) and the
+httpd layer enforces them when `[http] auth-enabled = true`
+(handler.go authenticate middleware; credentials via Basic auth or the
+u/p query params, influx 1.x style).
+
+Passwords are stored PBKDF2-HMAC-SHA256 (salted, 100k rounds) in a small
+json file under the data dir (single node) — the cluster meta store
+replicates the same records through raft like any catalog object."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import threading
+from dataclasses import dataclass
+
+_ROUNDS = 100_000
+
+
+def _hash(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _ROUNDS)
+
+
+@dataclass
+class User:
+    name: str
+    admin: bool = False
+
+
+class UserStore:
+    """CREATE USER / DROP USER / SET PASSWORD / authenticate. The first
+    user created must be an admin (reference rule: first user bootstraps
+    auth)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._users: dict[str, dict] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                self._users = json.load(f)
+
+    def _persist(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._users, f)
+        os.replace(tmp, self.path)
+
+    def __len__(self) -> int:
+        return len(self._users)
+
+    def create_user(self, name: str, password: str,
+                    admin: bool = False) -> None:
+        with self._lock:
+            if name in self._users:
+                raise ValueError(f"user already exists: {name}")
+            if not self._users and not admin:
+                raise ValueError(
+                    "the first user must be created WITH ALL PRIVILEGES")
+            salt = secrets.token_bytes(16)
+            self._users[name] = {
+                "salt": salt.hex(),
+                "hash": _hash(password, salt).hex(),
+                "admin": bool(admin)}
+            self._persist()
+
+    def drop_user(self, name: str) -> None:
+        with self._lock:
+            if name not in self._users:
+                raise ValueError(f"user not found: {name}")
+            u = self._users[name]
+            if u["admin"] and sum(1 for x in self._users.values()
+                                  if x["admin"]) == 1:
+                raise ValueError("cannot drop the last admin user")
+            del self._users[name]
+            self._persist()
+
+    def set_password(self, name: str, password: str) -> None:
+        with self._lock:
+            if name not in self._users:
+                raise ValueError(f"user not found: {name}")
+            salt = secrets.token_bytes(16)
+            self._users[name].update(
+                salt=salt.hex(), hash=_hash(password, salt).hex())
+            self._persist()
+
+    def authenticate(self, name: str, password: str) -> User | None:
+        with self._lock:
+            u = self._users.get(name)
+        if u is None:
+            # constant-ish time: still hash to avoid user-enum timing
+            _hash(password, b"\x00" * 16)
+            return None
+        if hmac.compare_digest(_hash(password, bytes.fromhex(u["salt"])),
+                               bytes.fromhex(u["hash"])):
+            return User(name, u["admin"])
+        return None
+
+    def users(self) -> list[User]:
+        with self._lock:
+            return [User(n, u["admin"])
+                    for n, u in sorted(self._users.items())]

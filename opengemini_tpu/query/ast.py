@@ -148,6 +148,31 @@ class CreateMeasurementStatement:
 
 
 @dataclass
+class CreateUserStatement:
+    name: str
+    password: str
+    admin: bool = False
+
+    def __repr__(self):           # never leak the password into logs
+        return (f"CreateUserStatement(name={self.name!r}, "
+                f"password='***', admin={self.admin})")
+
+
+@dataclass
+class DropUserStatement:
+    name: str
+
+
+@dataclass
+class SetPasswordStatement:
+    name: str
+    password: str
+
+    def __repr__(self):
+        return f"SetPasswordStatement(name={self.name!r}, password='***')"
+
+
+@dataclass
 class DeleteStatement:
     from_measurement: str | None = None
     condition: object | None = None

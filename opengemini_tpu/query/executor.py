@@ -26,9 +26,10 @@ from ..utils import get_logger
 from ..utils.errors import ErrQueryError
 from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
                   ShowStatement, CreateDatabaseStatement,
-                  CreateMeasurementStatement, DropDatabaseStatement,
-                  DropMeasurementStatement, DeleteStatement,
-                  ExplainStatement, KillQueryStatement)
+                  CreateMeasurementStatement, CreateUserStatement,
+                  DropDatabaseStatement, DropMeasurementStatement,
+                  DropUserStatement, DeleteStatement, ExplainStatement,
+                  KillQueryStatement, SetPasswordStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
 from .incremental import (IncAggCache, complete_prefix, trim_left,
@@ -66,11 +67,12 @@ class QueryExecutor:
     caps inside scans."""
 
     def __init__(self, engine, query_manager=None, resources=None,
-                 castor=None):
+                 castor=None, users=None):
         self.engine = engine
         self.query_manager = query_manager
         self.resources = resources
         self.castor = castor    # CastorService; lazily built if needed
+        self.users = users      # meta.users.UserStore (auth statements)
         self.inc_cache = IncAggCache()
 
     # ------------------------------------------------------------------ api
@@ -120,9 +122,29 @@ class QueryExecutor:
                 return {}
             if isinstance(stmt, DeleteStatement):
                 return self._delete(stmt, db)
+            if isinstance(stmt, (CreateUserStatement, DropUserStatement,
+                                 SetPasswordStatement)):
+                return self._user_stmt(stmt)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
         except ErrQueryError as e:
             return {"error": str(e)}
+
+    def _user_stmt(self, stmt) -> dict:
+        """CREATE USER / DROP USER / SET PASSWORD (reference meta user
+        catalog, meta_client.go CreateUser/DropUser/UpdateUser)."""
+        if self.users is None:
+            return {"error": "user management is not available"}
+        try:
+            if isinstance(stmt, CreateUserStatement):
+                self.users.create_user(stmt.name, stmt.password,
+                                       stmt.admin)
+            elif isinstance(stmt, DropUserStatement):
+                self.users.drop_user(stmt.name)
+            else:
+                self.users.set_password(stmt.name, stmt.password)
+        except ValueError as e:
+            return {"error": str(e)}
+        return {}
 
     def _delete(self, stmt: DeleteStatement, db: str | None) -> dict:
         """DELETE FROM m [WHERE time and/or tag predicates] (influx DELETE
@@ -178,6 +200,10 @@ class QueryExecutor:
                     for c in qm.list()] if qm else []
             return _series("queries",
                            ["qid", "query", "database", "duration"], rows)
+        if stmt.what == "users":
+            rows = [[u.name, u.admin] for u in self.users.users()] \
+                if self.users is not None else []
+            return _series("", ["user", "admin"], rows)
         if stmt.what == "databases":
             vals = [[n] for n in sorted(eng.databases)]
             return _series("databases", ["name"], vals)

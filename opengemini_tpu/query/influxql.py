@@ -25,10 +25,12 @@ import re
 from datetime import datetime, timezone
 
 from .ast import (BinaryExpr, Call, CreateDatabaseStatement,
-                  CreateMeasurementStatement, DeleteStatement, Dimension,
-                  DropDatabaseStatement, DropMeasurementStatement,
+                  CreateMeasurementStatement, CreateUserStatement,
+                  DeleteStatement, Dimension, DropDatabaseStatement,
+                  DropMeasurementStatement, DropUserStatement,
                   ExplainStatement, FieldRef, KillQueryStatement, Literal,
-                  SelectField, SelectStatement, ShowStatement, Wildcard)
+                  SelectField, SelectStatement, SetPasswordStatement,
+                  ShowStatement, Wildcard)
 
 
 class ParseError(Exception):
@@ -205,14 +207,45 @@ class Parser:
             self.lx.next()
             if self._kw("MEASUREMENT"):
                 return self._parse_create_measurement()
+            if self._kw("USER"):
+                # CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES]
+                name = self._ident()
+                self._expect_kw("WITH")
+                self._expect_kw("PASSWORD")
+                k2, pw, p2 = self.lx.next()
+                if k2 != "string":
+                    raise ParseError(
+                        f"password must be a string at {p2}")
+                pw = re.sub(r"\\(.)", r"\1", pw[1:-1])
+                admin = False
+                if self._kw("WITH"):
+                    self._expect_kw("ALL")
+                    self._expect_kw("PRIVILEGES")
+                    admin = True
+                return CreateUserStatement(name, pw, admin)
             self._expect_kw("DATABASE")
             return CreateDatabaseStatement(self._ident())
         if u == "DROP":
             self.lx.next()
             if self._kw("DATABASE"):
                 return DropDatabaseStatement(self._ident())
+            if self._kw("USER"):
+                return DropUserStatement(self._ident())
             self._expect_kw("MEASUREMENT")
             return DropMeasurementStatement(self._ident())
+        if u == "SET":
+            self.lx.next()
+            self._expect_kw("PASSWORD")
+            self._expect_kw("FOR")
+            name = self._ident()
+            k2, v2, p2 = self.lx.next()
+            if v2 != "=":
+                raise ParseError(f"expected = at {p2}")
+            k3, pw, p3 = self.lx.next()
+            if k3 != "string":
+                raise ParseError(f"password must be a string at {p3}")
+            return SetPasswordStatement(
+                name, re.sub(r"\\(.)", r"\1", pw[1:-1]))
         if u == "DELETE":
             self.lx.next()
             stmt = DeleteStatement()
@@ -351,6 +384,8 @@ class Parser:
             return ShowStatement("databases")
         if u == "QUERIES":
             return ShowStatement("queries")
+        if u == "USERS":
+            return ShowStatement("users")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "SERIES":

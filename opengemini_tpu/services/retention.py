@@ -15,15 +15,21 @@ class RetentionService(Service):
     name = "retention"
 
     def __init__(self, engine, catalog, interval_s: float = 1800,
-                 now_fn=None):
+                 now_fn=None, logstore=None):
         super().__init__(interval_s)
         self.engine = engine
         self.catalog = catalog
+        self.logstore = logstore      # optional LogStore: per-stream TTLs
         self.now_fn = now_fn or (lambda: int(time.time() * 1e9))
 
     def run_once(self) -> int:
         now = self.now_fn()
         dropped = 0
+        if self.logstore is not None:
+            try:
+                dropped += self.logstore.apply_retention(now)
+            except Exception:
+                log.exception("logstore retention failed")
         for db_name in list(self.engine.databases):
             try:
                 rp = self.catalog.retention_policy(db_name)

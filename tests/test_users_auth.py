@@ -1,0 +1,212 @@
+"""User catalog + HTTP authentication (reference meta users +
+[http] auth-enabled, handler.go authenticate middleware)."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.http import HttpServer
+from opengemini_tpu.meta.users import UserStore
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.config import Config
+
+
+# ------------------------------------------------------------- user store
+
+def test_user_store_lifecycle(tmp_path):
+    p = str(tmp_path / "users.json")
+    us = UserStore(p)
+    with pytest.raises(ValueError):
+        us.create_user("bob", "pw")          # first must be admin
+    us.create_user("root", "secret", admin=True)
+    us.create_user("bob", "pw2")
+    assert us.authenticate("root", "secret").admin is True
+    assert us.authenticate("bob", "pw2").admin is False
+    assert us.authenticate("bob", "wrong") is None
+    assert us.authenticate("nobody", "x") is None
+    us.set_password("bob", "pw3")
+    assert us.authenticate("bob", "pw2") is None
+    assert us.authenticate("bob", "pw3") is not None
+    with pytest.raises(ValueError):
+        us.drop_user("root")                 # last admin protected
+    us.drop_user("bob")
+    # persisted
+    us2 = UserStore(p)
+    assert [u.name for u in us2.users()] == ["root"]
+
+
+def test_user_statements(tmp_path):
+    eng = Engine(str(tmp_path / "d"))
+    us = UserStore()
+    ex = QueryExecutor(eng, users=us)
+
+    def q(text):
+        (stmt,) = parse_query(text)
+        return ex.execute(stmt, "db0")
+
+    assert q("CREATE USER root WITH PASSWORD 'pw' "
+             "WITH ALL PRIVILEGES") == {}
+    assert q("CREATE USER alice WITH PASSWORD 'a1'") == {}
+    res = q("SHOW USERS")
+    assert res["series"][0]["values"] == [["alice", False],
+                                          ["root", True]]
+    assert q("SET PASSWORD FOR alice = 'a2'") == {}
+    assert us.authenticate("alice", "a2") is not None
+    assert q("DROP USER alice") == {}
+    assert "error" in q("DROP USER alice")
+    # password never leaks through statement repr
+    (stmt,) = parse_query("CREATE USER x WITH PASSWORD 'topsecret'")
+    assert "topsecret" not in repr(stmt)
+    eng.close()
+
+
+# ------------------------------------------------------------- HTTP auth
+
+@pytest.fixture
+def authed(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    cfg = Config()
+    cfg.http.auth_enabled = True
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    yield srv
+    srv.stop()
+    eng.close()
+
+
+def req(srv, path, method="GET", body=None, user=None, pw=None):
+    headers = {}
+    if user is not None:
+        tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+        headers["Authorization"] = f"Basic {tok}"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=body,
+        method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_http_auth_flow(authed):
+    srv = authed
+    # bootstrap: no users yet → open (influx rule), create admin
+    code, _ = req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+                       "+WITH+ALL+PRIVILEGES")
+    assert code == 200
+    # now auth is enforced
+    code, body = req(srv, "/query?q=SHOW+USERS")
+    assert code == 401
+    code, _ = req(srv, "/ping")
+    assert code == 204                        # ping stays open
+    code, body = req(srv, "/query?q=SHOW+USERS", user="root", pw="bad")
+    assert code == 401
+    code, body = req(srv, "/query?q=SHOW+USERS", user="root", pw="pw")
+    assert code == 200
+    assert body["results"][0]["series"][0]["values"] == [["root", True]]
+    # u/p query params work too (influx 1.x style)
+    code, _ = req(srv, "/query?q=SHOW+USERS&u=root&p=pw")
+    assert code == 200
+    # write requires auth
+    code, _ = req(srv, "/write?db=x", method="POST", body=b"m v=1 1")
+    assert code == 401
+    code, _ = req(srv, "/write?db=x&u=root&p=pw", method="POST",
+                  body=b"m v=1 1")
+    assert code == 204
+
+
+def test_http_admin_gating(authed):
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    code, _ = req(srv, "/query?q=CREATE+USER+bob+WITH+PASSWORD+%27b%27",
+                  user="root", pw="pw")
+    assert code == 200
+    # non-admin cannot run user/DDL statements
+    code, body = req(srv, "/query?q=DROP+DATABASE+x", user="bob", pw="b")
+    assert "admin privilege required" in json.dumps(body)
+    code, body = req(srv, "/query?q=CREATE+USER+eve+WITH+PASSWORD+%27e%27",
+                     user="bob", pw="b")
+    assert "admin privilege required" in json.dumps(body)
+    # ...but can change their own password
+    code, body = req(srv, "/query?q=SET+PASSWORD+FOR+bob+=+%27b2%27",
+                     user="bob", pw="b")
+    assert "error" not in json.dumps(body.get("results", [{}])[0])
+    code, _ = req(srv, "/query?q=SHOW+USERS", user="bob", pw="b2")
+    assert code == 200
+
+
+def test_auth_disabled_by_default(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    code, _ = req(srv, "/query?q=SHOW+DATABASES")
+    assert code == 200
+    srv.stop()
+    eng.close()
+
+
+def test_keepalive_survives_401(authed):
+    """A 401 must not desync the keep-alive connection (body drained)."""
+    import http.client
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request("POST", "/write?db=x", body=b"m v=1 1")
+    r1 = conn.getresponse()
+    assert r1.status == 401
+    r1.read()
+    # server closes after 401; a fresh connection must work normally
+    conn2 = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    tok = base64.b64encode(b"root:pw").decode()
+    conn2.request("POST", "/write?db=x", body=b"m v=1 1",
+                  headers={"Authorization": f"Basic {tok}"})
+    r2 = conn2.getresponse()
+    assert r2.status == 204
+    r2.read()
+    conn2.close()
+    conn.close()
+
+
+def test_form_body_credentials(authed):
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    body = b"q=SHOW+USERS&u=root&p=pw"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/query", data=body, method="POST",
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    with urllib.request.urlopen(r, timeout=10) as resp:
+        assert resp.status == 200
+
+
+def test_cluster_user_statements(tmp_path):
+    """User management works over the cluster facade (handled at the
+    HTTP layer, not the executor)."""
+    from opengemini_tpu.app import TsMeta, TsSql, TsStore
+    meta = TsMeta(data_dir=str(tmp_path / "meta"))
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    store = TsStore(str(tmp_path / "s0"), [meta.addr], heartbeat_s=0.5)
+    store.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    try:
+        code, body = req(sql.http,
+                         "/query?q=CREATE+USER+root+WITH+PASSWORD"
+                         "+%27pw%27+WITH+ALL+PRIVILEGES")
+        assert code == 200
+        assert "error" not in json.dumps(body)
+        code, body = req(sql.http, "/query?q=SHOW+USERS")
+        assert body["results"][0]["series"][0]["values"] == \
+            [["root", True]]
+    finally:
+        sql.stop()
+        store.stop()
+        meta.stop()
