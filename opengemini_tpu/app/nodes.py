@@ -190,17 +190,15 @@ class TsServer:
         self.ts_meta = (TsMeta(data_dir=f"{data_dir}/meta", host=host)
                         if with_meta else None)
         self.meta_client: MetaClient | None = None
-        # background retention: engine shards (infinite without a
-        # catalog policy) + per-logstream TTLs
+        # background services driven by the local catalog: retention
+        # (shard TTLs + per-logstream TTLs) and continuous queries
+        from ..services.continuous_query import ContinuousQueryService
         from ..services.retention import RetentionService
-
-        class _NoPolicies:
-            def retention_policy(self, db):
-                raise KeyError(db)
-
         self.retention = RetentionService(
-            self.engine, _NoPolicies(), interval_s=1800,
+            self.engine, self.http.catalog, interval_s=1800,
             logstore=self.http.logstore)
+        self.cq_service = ContinuousQueryService(
+            self.engine, self.http.catalog, interval_s=10)
 
     @property
     def http_addr(self) -> str:
@@ -213,9 +211,11 @@ class TsServer:
             self.meta_client = MetaClient([self.ts_meta.addr])
         self.http.start()
         self.retention.start()
+        self.cq_service.start()
         log.info("ts-server ready at %s", self.http_addr)
 
     def stop(self):
+        self.cq_service.stop()
         self.retention.stop()
         self.http.stop()
         if self.meta_client is not None:

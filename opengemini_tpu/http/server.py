@@ -72,9 +72,16 @@ class HttpServer:
             log.warning("auth enabled but no durable user path "
                         "(cluster facade without data_dir): users are "
                         "in-memory and lost on restart")
+        # local catalog (CQs, retention policies) for the single node;
+        # the cluster path keeps its catalog in the meta raft store
+        self.catalog = None
+        if local and isinstance(data, str):
+            from ..meta.catalog import Catalog
+            self.catalog = Catalog(_os.path.join(data, "catalog.json"))
         self.executor = executor or QueryExecutor(
             engine, query_manager=self.query_manager,
-            resources=self.resources, users=self.user_store)
+            resources=self.resources, users=self.user_store,
+            catalog=self.catalog)
         self.sysctrl = SysControl(engine if local else None)
         self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
@@ -126,44 +133,37 @@ class HttpServer:
             (isinstance(stmt, ShowStatement) and stmt.what == "users")
 
     def _exec_user_stmt(self, stmt) -> dict:
-        from ..query.ast import (CreateUserStatement, DropUserStatement,
-                                 SetPasswordStatement)
-        try:
-            if isinstance(stmt, CreateUserStatement):
-                self.user_store.create_user(stmt.name, stmt.password,
-                                            stmt.admin)
-            elif isinstance(stmt, DropUserStatement):
-                self.user_store.drop_user(stmt.name)
-            elif isinstance(stmt, SetPasswordStatement):
-                self.user_store.set_password(stmt.name, stmt.password)
-            else:                              # SHOW USERS
-                return {"series": [
-                    {"name": "", "columns": ["user", "admin"],
-                     "values": [[u.name, u.admin]
-                                for u in self.user_store.users()]}]}
-        except ValueError as e:
-            return {"error": str(e)}
-        return {}
+        from ..meta.users import execute_user_statement
+        return execute_user_statement(self.user_store, stmt)
 
     def _deny_privilege(self, stmt, user) -> str | None:
         """Admin gate for destructive/user statements when auth is
         enforced (reference httpd privilege checks). A non-admin may
         still change their own password."""
-        if not self.auth_required():
-            return None
-        from ..query.ast import (CreateDatabaseStatement,
+        from ..query.ast import (CreateCQStatement,
+                                 CreateDatabaseStatement,
                                  CreateMeasurementStatement,
                                  CreateUserStatement, DeleteStatement,
+                                 DropCQStatement,
                                  DropDatabaseStatement,
                                  DropMeasurementStatement,
                                  DropUserStatement, KillQueryStatement,
                                  SetPasswordStatement)
+        if self._bootstrap_only():
+            # zero users with auth on: only first-admin creation passes
+            if isinstance(stmt, CreateUserStatement) and stmt.admin:
+                return None
+            return ("create an admin user first: CREATE USER <name> "
+                    "WITH PASSWORD '<pw>' WITH ALL PRIVILEGES")
+        if not self.auth_required():
+            return None
         if isinstance(stmt, SetPasswordStatement) and user is not None \
                 and stmt.name == user.name:
             return None
         admin_only = (CreateUserStatement, DropUserStatement,
                       SetPasswordStatement, CreateDatabaseStatement,
-                      CreateMeasurementStatement,
+                      CreateMeasurementStatement, CreateCQStatement,
+                      DropCQStatement,
                       DropDatabaseStatement, DropMeasurementStatement,
                       DeleteStatement, KillQueryStatement)
         if isinstance(stmt, admin_only) and (user is None
@@ -172,11 +172,16 @@ class HttpServer:
         return None
 
     def auth_required(self) -> bool:
-        """Enforce auth only when enabled AND at least one user exists
-        (influx 1.x bootstrap rule: the first admin is created over an
-        unauthenticated connection)."""
+        """Credentials are demanded once any user exists. With auth
+        enabled but zero users the API is NOT open: only the bootstrap
+        CREATE USER ... WITH ALL PRIVILEGES statement is allowed (influx
+        1.x rule — see _bootstrap_only / _deny_privilege)."""
         return bool(self.config.http.auth_enabled and
                     len(self.user_store))
+
+    def _bootstrap_only(self) -> bool:
+        return bool(self.config.http.auth_enabled
+                    and len(self.user_store) == 0)
 
     @property
     def logstore(self):
@@ -574,7 +579,18 @@ class _Handler(BaseHTTPRequestHandler):
         """Returns (ok, user). When not ok, a 401 was already sent.
         Credentials: Basic auth header or influx-style u/p params."""
         srv = self.server_ref
-        if not srv.auth_required() or self._path() in self._AUTH_OPEN:
+        if self._path() in self._AUTH_OPEN:
+            return True, None
+        if srv._bootstrap_only():
+            # auth on, zero users: only /query is reachable, and the
+            # statement gate there only passes first-admin creation
+            if self._path() == "/query":
+                return True, None
+            self.close_connection = True
+            self._reply(401, {"error": "create an admin user first"},
+                        headers={"Connection": "close"})
+            return False, None
+        if not srv.auth_required():
             return True, None
         import base64
         u = p = None
@@ -587,12 +603,10 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             params = self._params()
             u, p = params.get("u"), params.get("p")
-            if u is None and "form-urlencoded" in \
-                    self.headers.get("Content-Type", ""):
+            if u is None:
                 # influx 1.x clients may POST u/p in the form body
                 try:
-                    form = {k: v[0] for k, v in urllib.parse.parse_qs(
-                        self._body().decode()).items()}
+                    form = self._form_params({})
                     u, p = form.get("u"), form.get("p")
                 except Exception:
                     pass

@@ -9,7 +9,15 @@ u/p query params, influx 1.x style).
 
 Passwords are stored PBKDF2-HMAC-SHA256 (salted, 100k rounds) in a small
 json file under the data dir (single node) — the cluster meta store
-replicates the same records through raft like any catalog object."""
+replicates the same records through raft like any catalog object.
+
+Division of labor vs meta/catalog.py's user records: THIS module is the
+node-local authentication engine behind the HTTP layer (hashing,
+verification cache, admin flag). The catalog's users/grant/authorized
+methods model raft-replicated per-database privileges (reference
+meta.Data user ACLs) consumed by cluster-side authorization — the two
+deliberately stay separate the way the reference splits httpd auth from
+meta ACL storage."""
 
 from __future__ import annotations
 
@@ -43,6 +51,7 @@ class UserStore:
         self.path = path
         self._lock = threading.Lock()
         self._users: dict[str, dict] = {}
+        self._verified: dict[str, bytes] = {}   # auth fast-path cache
         if path and os.path.exists(path):
             with open(path) as f:
                 self._users = json.load(f)
@@ -82,6 +91,7 @@ class UserStore:
                                   if x["admin"]) == 1:
                 raise ValueError("cannot drop the last admin user")
             del self._users[name]
+            self._verified.pop(name, None)
             self._persist()
 
     def set_password(self, name: str, password: str) -> None:
@@ -91,17 +101,28 @@ class UserStore:
             salt = secrets.token_bytes(16)
             self._users[name].update(
                 salt=salt.hex(), hash=_hash(password, salt).hex())
+            self._verified.pop(name, None)
             self._persist()
 
     def authenticate(self, name: str, password: str) -> User | None:
         with self._lock:
             u = self._users.get(name)
+            cached = self._verified.get(name)
         if u is None:
             # constant-ish time: still hash to avoid user-enum timing
             _hash(password, b"\x00" * 16)
             return None
+        # per-request PBKDF2 would burn ~50ms/request: after one full
+        # check, remember a fast digest of the presented password
+        # (invalidated on set_password/drop_user)
+        fast = hashlib.sha256(password.encode()
+                              + bytes.fromhex(u["salt"])).digest()
+        if cached is not None and hmac.compare_digest(cached, fast):
+            return User(name, u["admin"])
         if hmac.compare_digest(_hash(password, bytes.fromhex(u["salt"])),
                                bytes.fromhex(u["hash"])):
+            with self._lock:
+                self._verified[name] = fast
             return User(name, u["admin"])
         return None
 
@@ -109,3 +130,27 @@ class UserStore:
         with self._lock:
             return [User(n, u["admin"])
                     for n, u in sorted(self._users.items())]
+
+
+def execute_user_statement(store: "UserStore", stmt) -> dict:
+    """Shared executor for CREATE USER / DROP USER / SET PASSWORD /
+    SHOW USERS — the single implementation behind both the single-node
+    QueryExecutor and the HTTP layer's cluster-facade path."""
+    from ..query.ast import (CreateUserStatement, DropUserStatement,
+                             SetPasswordStatement)
+    if store is None:
+        return {"error": "user management is not available"}
+    try:
+        if isinstance(stmt, CreateUserStatement):
+            store.create_user(stmt.name, stmt.password, stmt.admin)
+        elif isinstance(stmt, DropUserStatement):
+            store.drop_user(stmt.name)
+        elif isinstance(stmt, SetPasswordStatement):
+            store.set_password(stmt.name, stmt.password)
+        else:                                  # SHOW USERS
+            return {"series": [
+                {"name": "", "columns": ["user", "admin"],
+                 "values": [[u.name, u.admin] for u in store.users()]}]}
+    except ValueError as e:
+        return {"error": str(e)}
+    return {}

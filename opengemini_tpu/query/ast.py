@@ -148,6 +148,21 @@ class CreateMeasurementStatement:
 
 
 @dataclass
+class CreateCQStatement:
+    name: str
+    db: str
+    query: str                    # canonical SELECT ... INTO ... text
+    every_ns: int
+    offset_ns: int = 0
+
+
+@dataclass
+class DropCQStatement:
+    name: str
+    db: str
+
+
+@dataclass
 class CreateUserStatement:
     name: str
     password: str

@@ -25,8 +25,9 @@ from ..record import DataType
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError
 from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
-                  ShowStatement, CreateDatabaseStatement,
-                  CreateMeasurementStatement, CreateUserStatement,
+                  ShowStatement, CreateCQStatement,
+                  CreateDatabaseStatement, CreateMeasurementStatement,
+                  CreateUserStatement, DropCQStatement,
                   DropDatabaseStatement, DropMeasurementStatement,
                   DropUserStatement, DeleteStatement, ExplainStatement,
                   KillQueryStatement, SetPasswordStatement)
@@ -67,12 +68,13 @@ class QueryExecutor:
     caps inside scans."""
 
     def __init__(self, engine, query_manager=None, resources=None,
-                 castor=None, users=None):
+                 castor=None, users=None, catalog=None):
         self.engine = engine
         self.query_manager = query_manager
         self.resources = resources
         self.castor = castor    # CastorService; lazily built if needed
         self.users = users      # meta.users.UserStore (auth statements)
+        self.catalog = catalog  # meta.catalog.Catalog (CQs, policies)
         self.inc_cache = IncAggCache()
 
     # ------------------------------------------------------------------ api
@@ -125,6 +127,8 @@ class QueryExecutor:
             if isinstance(stmt, (CreateUserStatement, DropUserStatement,
                                  SetPasswordStatement)):
                 return self._user_stmt(stmt)
+            if isinstance(stmt, (CreateCQStatement, DropCQStatement)):
+                return self._cq_stmt(stmt)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
         except ErrQueryError as e:
             return {"error": str(e)}
@@ -132,17 +136,35 @@ class QueryExecutor:
     def _user_stmt(self, stmt) -> dict:
         """CREATE USER / DROP USER / SET PASSWORD (reference meta user
         catalog, meta_client.go CreateUser/DropUser/UpdateUser)."""
-        if self.users is None:
-            return {"error": "user management is not available"}
+        from ..meta.users import execute_user_statement
+        return execute_user_statement(self.users, stmt)
+
+    def _cq_stmt(self, stmt) -> dict:
+        """CREATE/DROP CONTINUOUS QUERY → catalog registration (reference
+        meta CQ records + services/continuousquery lease scheduler)."""
+        if self.catalog is None:
+            return {"error": "continuous queries are not available "
+                             "(no catalog)"}
+        from ..meta.catalog import ContinuousQuery
+        from ..utils.errors import GeminiError
         try:
-            if isinstance(stmt, CreateUserStatement):
-                self.users.create_user(stmt.name, stmt.password,
-                                       stmt.admin)
-            elif isinstance(stmt, DropUserStatement):
-                self.users.drop_user(stmt.name)
+            self.catalog.database(stmt.db)
+        except GeminiError:
+            # catalog entry on demand (the engine creates dbs on write;
+            # the catalog only needs one for CQ/retention records)
+            self.catalog.create_database(stmt.db)
+        try:
+            if isinstance(stmt, CreateCQStatement):
+                if any(c.name == stmt.name
+                       for c in self.catalog.continuous_queries(stmt.db)):
+                    return {"error":
+                            f"continuous query {stmt.name} already "
+                            "exists"}
+                self.catalog.register_cq(stmt.db, ContinuousQuery(
+                    stmt.name, stmt.query, stmt.every_ns, stmt.offset_ns))
             else:
-                self.users.set_password(stmt.name, stmt.password)
-        except ValueError as e:
+                self.catalog.drop_cq(stmt.db, stmt.name)
+        except KeyError as e:
             return {"error": str(e)}
         return {}
 
@@ -204,6 +226,24 @@ class QueryExecutor:
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []
             return _series("", ["user", "admin"], rows)
+        if stmt.what == "continuous queries":
+            out = []
+            if self.catalog is not None:
+                # catalog, not engine, is the source of truth: a CQ may
+                # be registered before its db has any data
+                for dbn in sorted(self.catalog.databases):
+                    try:
+                        cqs = self.catalog.continuous_queries(dbn)
+                    except Exception:
+                        continue
+                    if not cqs:
+                        continue
+                    vals = [[c.name, c.query] for c in
+                            sorted(cqs, key=lambda c: c.name)]
+                    out.append({"name": dbn,
+                                "columns": ["name", "query"],
+                                "values": vals})
+            return {"series": out} if out else {}
         if stmt.what == "databases":
             vals = [[n] for n in sorted(eng.databases)]
             return _series("databases", ["name"], vals)

@@ -24,9 +24,10 @@ from __future__ import annotations
 import re
 from datetime import datetime, timezone
 
-from .ast import (BinaryExpr, Call, CreateDatabaseStatement,
-                  CreateMeasurementStatement, CreateUserStatement,
-                  DeleteStatement, Dimension, DropDatabaseStatement,
+from .ast import (BinaryExpr, Call, CreateCQStatement,
+                  CreateDatabaseStatement, CreateMeasurementStatement,
+                  CreateUserStatement, DeleteStatement, Dimension,
+                  DropCQStatement, DropDatabaseStatement,
                   DropMeasurementStatement, DropUserStatement,
                   ExplainStatement, FieldRef, KillQueryStatement, Literal,
                   SelectField, SelectStatement, SetPasswordStatement,
@@ -207,6 +208,36 @@ class Parser:
             self.lx.next()
             if self._kw("MEASUREMENT"):
                 return self._parse_create_measurement()
+            if self._kw("CONTINUOUS"):
+                # CREATE CONTINUOUS QUERY n ON db
+                #   [RESAMPLE EVERY <dur>] BEGIN <select> END
+                self._expect_kw("QUERY")
+                name = self._ident()
+                self._expect_kw("ON")
+                cdb = self._ident()
+                every = 0
+                if self._kw("RESAMPLE"):
+                    self._expect_kw("EVERY")
+                    k2, v2, p2 = self.lx.next()
+                    if k2 != "duration":
+                        raise ParseError(
+                            f"expected duration at {p2}, got {v2!r}")
+                    every = parse_duration(v2)
+                self._expect_kw("BEGIN")
+                sel = self.parse_select()
+                self._expect_kw("END")
+                if not sel.into_measurement:
+                    raise ParseError(
+                        "continuous query requires SELECT ... INTO")
+                interval = sel.group_by_interval()
+                if not every:
+                    if not interval:
+                        raise ParseError("continuous query requires "
+                                         "GROUP BY time() or RESAMPLE "
+                                         "EVERY")
+                    every = interval
+                return CreateCQStatement(name, cdb,
+                                         format_statement(sel), every)
             if self._kw("USER"):
                 # CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES]
                 name = self._ident()
@@ -231,6 +262,11 @@ class Parser:
                 return DropDatabaseStatement(self._ident())
             if self._kw("USER"):
                 return DropUserStatement(self._ident())
+            if self._kw("CONTINUOUS"):
+                self._expect_kw("QUERY")
+                name = self._ident()
+                self._expect_kw("ON")
+                return DropCQStatement(name, self._ident())
             self._expect_kw("MEASUREMENT")
             return DropMeasurementStatement(self._ident())
         if u == "SET":
@@ -386,6 +422,9 @@ class Parser:
             return ShowStatement("queries")
         if u == "USERS":
             return ShowStatement("users")
+        if u == "CONTINUOUS":
+            self._expect_kw("QUERIES")
+            return ShowStatement("continuous queries")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "SERIES":

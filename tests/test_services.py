@@ -230,3 +230,41 @@ def test_rows_to_lp_roundtrip():
     assert back[0].tags == {"ta g": "v=1"}
     assert back[0].fields == rows[0].fields
     assert back[0].time == 42
+
+
+def test_cq_sql_surface(tmp_path):
+    """CREATE/SHOW/DROP CONTINUOUS QUERY end to end: register via SQL,
+    scheduler materializes the target measurement."""
+    from opengemini_tpu.meta.catalog import Catalog
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.services.continuous_query import (
+        ContinuousQueryService)
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    ex = QueryExecutor(eng, catalog=cat)
+
+    def q(text):
+        (stmt,) = parse_query(text)
+        return ex.execute(stmt, "db0")
+
+    MINUTE = 60 * 10**9
+    eng.write_points("db0", parse_lines("\n".join(
+        f"m v={w} {w * MINUTE}" for w in range(5))))
+    assert q("CREATE CONTINUOUS QUERY cq1 ON db0 BEGIN "
+             "SELECT mean(v) INTO m_1m FROM m GROUP BY time(1m) "
+             "END") == {}
+    assert "error" in q("CREATE CONTINUOUS QUERY cq1 ON db0 BEGIN "
+                        "SELECT mean(v) INTO m_1m FROM m "
+                        "GROUP BY time(1m) END")
+    res = q("SHOW CONTINUOUS QUERIES")
+    assert res["series"][0]["values"][0][0] == "cq1"
+    svc = ContinuousQueryService(eng, cat, now_fn=lambda: 6 * MINUTE)
+    assert svc.run_once() == 1
+    res = q("SELECT mean FROM m_1m")
+    assert len(res["series"][0]["values"]) >= 4
+    assert q("DROP CONTINUOUS QUERY cq1 ON db0") == {}
+    res = q("SHOW CONTINUOUS QUERIES")
+    assert res == {}
+    eng.close()

@@ -210,3 +210,36 @@ def test_cluster_user_statements(tmp_path):
         sql.stop()
         store.stop()
         meta.stop()
+
+
+def test_bootstrap_lockdown(authed):
+    """auth on + zero users: everything except first-admin creation is
+    locked (influx bootstrap rule), not wide open."""
+    srv = authed
+    # writes rejected before any user exists
+    code, _ = req(srv, "/write?db=x", method="POST", body=b"m v=1 1")
+    assert code == 401
+    # non-admin-create statements rejected
+    code, body = req(srv, "/query?q=DROP+DATABASE+x")
+    assert "create an admin user first" in json.dumps(body)
+    code, body = req(srv, "/query?q=CREATE+USER+bob+WITH+PASSWORD+%27b%27")
+    assert "create an admin user first" in json.dumps(body)
+    # first-admin create passes, then auth fully enforced
+    code, _ = req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+                       "+WITH+ALL+PRIVILEGES")
+    assert code == 200
+    code, _ = req(srv, "/query?q=SHOW+USERS")
+    assert code == 401
+
+
+def test_cq_statements_admin_only(authed):
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    req(srv, "/query?q=CREATE+USER+bob+WITH+PASSWORD+%27b%27",
+        user="root", pw="pw")
+    code, body = req(
+        srv, "/query?q=CREATE+CONTINUOUS+QUERY+c+ON+d+BEGIN+SELECT"
+             "+mean(v)+INTO+t+FROM+m+GROUP+BY+time(1m)+END",
+        user="bob", pw="b")
+    assert "admin privilege required" in json.dumps(body)
