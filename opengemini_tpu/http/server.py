@@ -140,13 +140,15 @@ class HttpServer:
         """Admin gate for destructive/user statements when auth is
         enforced (reference httpd privilege checks). A non-admin may
         still change their own password."""
-        from ..query.ast import (CreateCQStatement,
+        from ..query.ast import (AlterRPStatement, CreateCQStatement,
                                  CreateDatabaseStatement,
                                  CreateMeasurementStatement,
+                                 CreateRPStatement,
                                  CreateUserStatement, DeleteStatement,
                                  DropCQStatement,
                                  DropDatabaseStatement,
                                  DropMeasurementStatement,
+                                 DropRPStatement,
                                  DropUserStatement, KillQueryStatement,
                                  SetPasswordStatement)
         if self._bootstrap_only():
@@ -163,7 +165,8 @@ class HttpServer:
         admin_only = (CreateUserStatement, DropUserStatement,
                       SetPasswordStatement, CreateDatabaseStatement,
                       CreateMeasurementStatement, CreateCQStatement,
-                      DropCQStatement,
+                      DropCQStatement, CreateRPStatement,
+                      AlterRPStatement, DropRPStatement,
                       DropDatabaseStatement, DropMeasurementStatement,
                       DeleteStatement, KillQueryStatement)
         if isinstance(stmt, admin_only) and (user is None

@@ -163,6 +163,7 @@ class Catalog:
     def alter_retention_policy(self, db: str, name: str, *,
                                duration_ns: int | None = None,
                                shard_group_duration_ns: int | None = None,
+                               replica_n: int | None = None,
                                make_default: bool = False) -> None:
         with self._lock:
             d = self.database(db)
@@ -174,6 +175,8 @@ class Catalog:
                 raw["duration_ns"] = duration_ns
             if shard_group_duration_ns is not None:
                 raw["shard_group_duration_ns"] = shard_group_duration_ns
+            if replica_n is not None:
+                raw["replica_n"] = replica_n
             if make_default:
                 d["default_rp"] = name
             self.save()

@@ -148,6 +148,32 @@ class CreateMeasurementStatement:
 
 
 @dataclass
+class CreateRPStatement:
+    name: str
+    db: str
+    duration_ns: int
+    replication: int = 1
+    shard_duration_ns: int | None = None
+    default: bool = False
+
+
+@dataclass
+class AlterRPStatement:
+    name: str
+    db: str
+    duration_ns: int | None = None
+    replication: int | None = None
+    shard_duration_ns: int | None = None
+    default: bool = False
+
+
+@dataclass
+class DropRPStatement:
+    name: str
+    db: str
+
+
+@dataclass
 class CreateCQStatement:
     name: str
     db: str

@@ -23,14 +23,15 @@ import numpy as np
 
 from ..record import DataType
 from ..utils import get_logger
-from ..utils.errors import ErrQueryError
-from .ast import (Call, FieldRef, Literal, SelectField, SelectStatement,
-                  ShowStatement, CreateCQStatement,
+from ..utils.errors import ErrQueryError, GeminiError
+from .ast import (AlterRPStatement, Call, FieldRef, Literal, SelectField,
+                  SelectStatement, ShowStatement, CreateCQStatement,
                   CreateDatabaseStatement, CreateMeasurementStatement,
-                  CreateUserStatement, DropCQStatement,
+                  CreateRPStatement, CreateUserStatement, DropCQStatement,
                   DropDatabaseStatement, DropMeasurementStatement,
-                  DropUserStatement, DeleteStatement, ExplainStatement,
-                  KillQueryStatement, SetPasswordStatement)
+                  DropRPStatement, DropUserStatement, DeleteStatement,
+                  ExplainStatement, KillQueryStatement,
+                  SetPasswordStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
 from .incremental import (IncAggCache, complete_prefix, trim_left,
@@ -129,6 +130,9 @@ class QueryExecutor:
                 return self._user_stmt(stmt)
             if isinstance(stmt, (CreateCQStatement, DropCQStatement)):
                 return self._cq_stmt(stmt)
+            if isinstance(stmt, (CreateRPStatement, AlterRPStatement,
+                                 DropRPStatement)):
+                return self._rp_stmt(stmt)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
         except ErrQueryError as e:
             return {"error": str(e)}
@@ -146,25 +150,65 @@ class QueryExecutor:
             return {"error": "continuous queries are not available "
                              "(no catalog)"}
         from ..meta.catalog import ContinuousQuery
-        from ..utils.errors import GeminiError
         try:
             self.catalog.database(stmt.db)
-        except GeminiError:
+        except GeminiError as e:
+            if not isinstance(stmt, CreateCQStatement):
+                # DROP on a mistyped db must NOT create a phantom entry
+                return {"error": str(e)}
             # catalog entry on demand (the engine creates dbs on write;
             # the catalog only needs one for CQ/retention records)
             self.catalog.create_database(stmt.db)
-        try:
-            if isinstance(stmt, CreateCQStatement):
-                if any(c.name == stmt.name
+        if isinstance(stmt, CreateCQStatement):
+            if any(c.name == stmt.name
+                   for c in self.catalog.continuous_queries(stmt.db)):
+                return {"error": f"continuous query {stmt.name} "
+                                 "already exists"}
+            self.catalog.register_cq(stmt.db, ContinuousQuery(
+                stmt.name, stmt.query, stmt.every_ns, stmt.offset_ns))
+        else:
+            if not any(c.name == stmt.name
                        for c in self.catalog.continuous_queries(stmt.db)):
-                    return {"error":
-                            f"continuous query {stmt.name} already "
-                            "exists"}
-                self.catalog.register_cq(stmt.db, ContinuousQuery(
-                    stmt.name, stmt.query, stmt.every_ns, stmt.offset_ns))
+                return {"error":
+                        f"continuous query not found: {stmt.name}"}
+            self.catalog.drop_cq(stmt.db, stmt.name)
+        return {}
+
+    def _rp_stmt(self, stmt) -> dict:
+        """CREATE/ALTER/DROP RETENTION POLICY → catalog records driving
+        the retention service (reference meta RPs + services/retention)."""
+        if self.catalog is None:
+            return {"error": "retention policies are not available "
+                             "(no catalog)"}
+        from ..meta.catalog import RetentionPolicy
+        try:
+            d = self.catalog.database(stmt.db)
+        except GeminiError as e:
+            if not isinstance(stmt, CreateRPStatement):
+                return {"error": str(e)}
+            self.catalog.create_database(stmt.db)
+            d = self.catalog.database(stmt.db)
+        try:
+            if isinstance(stmt, CreateRPStatement):
+                rp = RetentionPolicy(
+                    name=stmt.name, duration_ns=stmt.duration_ns,
+                    replica_n=stmt.replication, default=stmt.default)
+                if stmt.shard_duration_ns:
+                    rp.shard_group_duration_ns = stmt.shard_duration_ns
+                self.catalog.create_retention_policy(
+                    stmt.db, rp, make_default=stmt.default)
+            elif isinstance(stmt, AlterRPStatement):
+                self.catalog.alter_retention_policy(
+                    stmt.db, stmt.name, duration_ns=stmt.duration_ns,
+                    shard_group_duration_ns=stmt.shard_duration_ns,
+                    replica_n=stmt.replication,
+                    make_default=stmt.default)
             else:
-                self.catalog.drop_cq(stmt.db, stmt.name)
-        except KeyError as e:
+                if stmt.name not in d["retention_policies"]:
+                    return {"error":
+                            f"retention policy not found: {stmt.name}"}
+                self.catalog.drop_retention_policy(stmt.db, stmt.name)
+        except GeminiError as e:
             return {"error": str(e)}
         return {}
 
@@ -226,6 +270,26 @@ class QueryExecutor:
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []
             return _series("", ["user", "admin"], rows)
+        if stmt.what == "retention policies":
+            if self.catalog is None:
+                return {"error": "retention policies are not available "
+                                 "(no catalog)"}
+            rdb = stmt.on_db or db
+            if rdb is None:
+                return {"error": "database required"}
+            try:
+                d = self.catalog.database(rdb)
+            except GeminiError as e:
+                return {"error": str(e)}
+            rows = []
+            for name, raw in sorted(d["retention_policies"].items()):
+                rows.append([name, _fmt_dur(raw["duration_ns"]),
+                             _fmt_dur(raw["shard_group_duration_ns"]),
+                             raw["replica_n"],
+                             d["default_rp"] == name])
+            return _series("", ["name", "duration",
+                                "shardGroupDuration", "replicaN",
+                                "default"], rows)
         if stmt.what == "continuous queries":
             out = []
             if self.catalog is not None:
@@ -1834,6 +1898,14 @@ def _group_ids(rec, group_tags: list[str],
                     for j in range(len(group_tags)))
         lut[k] = global_groups.setdefault(key, len(global_groups))
     return lut[inv2]
+
+
+def _fmt_dur(ns: int) -> str:
+    """influx-style duration rendering: 168h0m0s; 0 = infinite."""
+    if ns <= 0:
+        return "0s"
+    s = ns // 10**9
+    return f"{s // 3600}h{(s % 3600) // 60}m{s % 60}s"
 
 
 def _series(name: str, columns: list[str], values: list) -> dict:

@@ -24,11 +24,12 @@ from __future__ import annotations
 import re
 from datetime import datetime, timezone
 
-from .ast import (BinaryExpr, Call, CreateCQStatement,
+from .ast import (AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
                   CreateDatabaseStatement, CreateMeasurementStatement,
-                  CreateUserStatement, DeleteStatement, Dimension,
-                  DropCQStatement, DropDatabaseStatement,
-                  DropMeasurementStatement, DropUserStatement,
+                  CreateRPStatement, CreateUserStatement, DeleteStatement,
+                  Dimension, DropCQStatement, DropDatabaseStatement,
+                  DropMeasurementStatement, DropRPStatement,
+                  DropUserStatement,
                   ExplainStatement, FieldRef, KillQueryStatement, Literal,
                   SelectField, SelectStatement, SetPasswordStatement,
                   ShowStatement, Wildcard)
@@ -175,6 +176,18 @@ class Parser:
             k, v, p = self.lx.peek()
             raise ParseError(f"expected {op!r}, got {v!r} at {p}")
 
+    def _rp_duration(self) -> int:
+        """Duration token, or INF/0 (influx: 0 and INF both mean
+        infinite retention)."""
+        k, v, p = self.lx.next()
+        if k == "duration":
+            return parse_duration(v)
+        if k == "ident" and v.upper() == "INF":
+            return 0
+        if k == "number" and v == "0":
+            return 0
+        raise ParseError(f"expected duration at {p}, got {v!r}")
+
     def _ident(self) -> str:
         k, v, p = self.lx.next()
         if k == "ident":
@@ -238,6 +251,26 @@ class Parser:
                     every = interval
                 return CreateCQStatement(name, cdb,
                                          format_statement(sel), every)
+            if self._kw("RETENTION"):
+                # CREATE RETENTION POLICY n ON db DURATION d
+                #   REPLICATION r [SHARD DURATION d] [DEFAULT]
+                self._expect_kw("POLICY")
+                name = self._ident()
+                self._expect_kw("ON")
+                rdb = self._ident()
+                self._expect_kw("DURATION")
+                dur = self._rp_duration()
+                self._expect_kw("REPLICATION")
+                k2, v2, p2 = self.lx.next()
+                if k2 != "number" or not v2.isdigit():
+                    raise ParseError(f"expected replica count at {p2}")
+                repl = int(v2)
+                shard_dur = None
+                if self._kw("SHARD"):
+                    self._expect_kw("DURATION")
+                    shard_dur = self._rp_duration()
+                return CreateRPStatement(name, rdb, dur, repl, shard_dur,
+                                         self._kw("DEFAULT"))
             if self._kw("USER"):
                 # CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES]
                 name = self._ident()
@@ -267,8 +300,38 @@ class Parser:
                 name = self._ident()
                 self._expect_kw("ON")
                 return DropCQStatement(name, self._ident())
+            if self._kw("RETENTION"):
+                self._expect_kw("POLICY")
+                name = self._ident()
+                self._expect_kw("ON")
+                return DropRPStatement(name, self._ident())
             self._expect_kw("MEASUREMENT")
             return DropMeasurementStatement(self._ident())
+        if u == "ALTER":
+            self.lx.next()
+            self._expect_kw("RETENTION")
+            self._expect_kw("POLICY")
+            name = self._ident()
+            self._expect_kw("ON")
+            adb = self._ident()
+            stmt = AlterRPStatement(name, adb)
+            while True:
+                if self._kw("DURATION"):
+                    stmt.duration_ns = self._rp_duration()
+                elif self._kw("REPLICATION"):
+                    k2, v2, p2 = self.lx.next()
+                    if k2 != "number" or not v2.isdigit():
+                        raise ParseError(
+                            f"expected replica count at {p2}")
+                    stmt.replication = int(v2)
+                elif self._kw("SHARD"):
+                    self._expect_kw("DURATION")
+                    stmt.shard_duration_ns = self._rp_duration()
+                elif self._kw("DEFAULT"):
+                    stmt.default = True
+                else:
+                    break
+            return stmt
         if u == "SET":
             self.lx.next()
             self._expect_kw("PASSWORD")

@@ -268,3 +268,74 @@ def test_cq_sql_surface(tmp_path):
     res = q("SHOW CONTINUOUS QUERIES")
     assert res == {}
     eng.close()
+
+
+def test_rp_sql_surface(tmp_path):
+    """CREATE/ALTER/DROP/SHOW RETENTION POLICY drive the catalog records
+    that the retention service consumes."""
+    from opengemini_tpu.meta.catalog import Catalog
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.services.retention import RetentionService
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    ex = QueryExecutor(eng, catalog=cat)
+
+    def q(t):
+        (s,) = parse_query(t)
+        return ex.execute(s, "db0")
+
+    assert q("CREATE RETENTION POLICY rp1 ON db0 DURATION 30d "
+             "REPLICATION 1 DEFAULT") == {}
+    res = q("SHOW RETENTION POLICIES ON db0")
+    rows = {r[0]: r for r in res["series"][0]["values"]}
+    assert rows["rp1"][1] == "720h0m0s" and rows["rp1"][4] is True
+    assert q("ALTER RETENTION POLICY rp1 ON db0 DURATION 1h") == {}
+    res = q("SHOW RETENTION POLICIES ON db0")
+    rows = {r[0]: r for r in res["series"][0]["values"]}
+    assert rows["rp1"][1] == "1h0m0s"
+    # retention service honors the altered policy
+    DAY = 86400 * 10**9
+    eng.write_points("db0", parse_lines("m v=1 1000"))
+    eng.flush_all()
+    svc = RetentionService(eng, cat, now_fn=lambda: 10 * DAY)
+    assert svc.run_once() >= 1                # 1h policy expired the shard
+    assert q("DROP RETENTION POLICY rp1 ON db0") == {}
+    res = q("SHOW RETENTION POLICIES ON db0")
+    assert "rp1" not in {r[0] for r in res["series"][0]["values"]}
+    eng.close()
+
+
+def test_rp_cq_not_found_and_no_phantom_db(tmp_path):
+    from opengemini_tpu.meta.catalog import Catalog
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    ex = QueryExecutor(eng, catalog=cat)
+
+    def q(t):
+        (s,) = parse_query(t)
+        return ex.execute(s, "db0")
+
+    # DROP on a mistyped db errors and creates no phantom entry
+    assert "error" in q("DROP RETENTION POLICY rp ON nope")
+    assert "error" in q("DROP CONTINUOUS QUERY cq ON nope")
+    assert "nope" not in cat.databases
+    # not-found errors on existing db
+    q("CREATE RETENTION POLICY rp1 ON db0 DURATION 1h REPLICATION 1")
+    assert "error" in q("DROP RETENTION POLICY ghost ON db0")
+    assert "error" in q("DROP CONTINUOUS QUERY ghost ON db0")
+    # ALTER REPLICATION is applied
+    assert q("ALTER RETENTION POLICY rp1 ON db0 REPLICATION 3") == {}
+    res = q("SHOW RETENTION POLICIES ON db0")
+    rows = {r[0]: r for r in res["series"][0]["values"]}
+    assert rows["rp1"][3] == 3
+    # bad replication count is a clean parse error
+    from opengemini_tpu.query import ParseError
+    import pytest as _pytest
+    with _pytest.raises(ParseError):
+        parse_query("CREATE RETENTION POLICY r ON d DURATION 1h "
+                    "REPLICATION 2.5")
+    eng.close()
