@@ -153,9 +153,13 @@ class QueryExecutor:
         try:
             self.catalog.database(stmt.db)
         except GeminiError as e:
-            if not isinstance(stmt, CreateCQStatement):
+            if not isinstance(stmt, CreateCQStatement) \
+                    and stmt.db not in self.engine.databases:
                 # DROP on a mistyped db must NOT create a phantom entry
                 return {"error": str(e)}
+            if not isinstance(stmt, CreateCQStatement):
+                return {"error":
+                        f"continuous query not found: {stmt.name}"}
             # catalog entry on demand (the engine creates dbs on write;
             # the catalog only needs one for CQ/retention records)
             self.catalog.create_database(stmt.db)
@@ -184,12 +188,19 @@ class QueryExecutor:
         try:
             d = self.catalog.database(stmt.db)
         except GeminiError as e:
-            if not isinstance(stmt, CreateRPStatement):
+            if isinstance(stmt, CreateRPStatement) \
+                    or stmt.db in self.engine.databases:
+                # engine dbs exist without a catalog entry until some
+                # catalog object is registered — materialize it
+                self.catalog.create_database(stmt.db)
+                d = self.catalog.database(stmt.db)
+            else:
                 return {"error": str(e)}
-            self.catalog.create_database(stmt.db)
-            d = self.catalog.database(stmt.db)
         try:
             if isinstance(stmt, CreateRPStatement):
+                if stmt.name in d["retention_policies"]:
+                    return {"error": f"retention policy {stmt.name} "
+                                     "already exists"}
                 rp = RetentionPolicy(
                     name=stmt.name, duration_ns=stmt.duration_ns,
                     replica_n=stmt.replication, default=stmt.default)
@@ -198,9 +209,13 @@ class QueryExecutor:
                 self.catalog.create_retention_policy(
                     stmt.db, rp, make_default=stmt.default)
             elif isinstance(stmt, AlterRPStatement):
+                shard = stmt.shard_duration_ns
+                if shard == 0:
+                    # influx: SHARD DURATION 0 resets to the default
+                    shard = RetentionPolicy().shard_group_duration_ns
                 self.catalog.alter_retention_policy(
                     stmt.db, stmt.name, duration_ns=stmt.duration_ns,
-                    shard_group_duration_ns=stmt.shard_duration_ns,
+                    shard_group_duration_ns=shard,
                     replica_n=stmt.replication,
                     make_default=stmt.default)
             else:
@@ -270,6 +285,30 @@ class QueryExecutor:
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []
             return _series("", ["user", "admin"], rows)
+        if stmt.what == "shards":
+            # reference SHOW SHARDS: shard layout per database
+            rows = []
+            for dbn in sorted(eng.databases):
+                for s in eng.database(dbn).all_shards():
+                    rows.append([s.shard_id, dbn, int(s.start_time),
+                                 int(s.end_time),
+                                 len(s.measurements())])
+            return _series("shards",
+                           ["id", "database", "start_time", "end_time",
+                            "measurements"], rows)
+        if stmt.what == "stats":
+            # reference SHOW STATS: per-module runtime statistics
+            from ..utils.stats import runtime_collector
+            out = [{"name": "runtime",
+                    "columns": ["metric", "value"],
+                    "values": [[k, v] for k, v in
+                               sorted(runtime_collector().items())]}]
+            if self.query_manager is not None:
+                out.append({"name": "queries",
+                            "columns": ["metric", "value"],
+                            "values": [["running",
+                                        len(self.query_manager.list())]]})
+            return {"series": out}
         if stmt.what == "retention policies":
             if self.catalog is None:
                 return {"error": "retention policies are not available "
@@ -280,7 +319,14 @@ class QueryExecutor:
             try:
                 d = self.catalog.database(rdb)
             except GeminiError as e:
-                return {"error": str(e)}
+                if rdb not in eng.databases:
+                    return {"error": str(e)}
+                # engine-only db: show the implicit default policy
+                from ..meta.catalog import RetentionPolicy
+                from dataclasses import asdict
+                rp = RetentionPolicy()
+                d = {"retention_policies": {rp.name: asdict(rp)},
+                     "default_rp": rp.name}
             rows = []
             for name, raw in sorted(d["retention_policies"].items()):
                 rows.append([name, _fmt_dur(raw["duration_ns"]),

@@ -6,12 +6,21 @@ grammar grows with the framework).
 Supported:
   SELECT <fields> FROM <source> [WHERE expr] [GROUP BY dims [fill(...)]]
       [ORDER BY time ASC|DESC] [LIMIT n] [OFFSET n] [SLIMIT n] [SOFFSET n]
-      [TZ('...')]
+      [TZ('...')] [INTO target]
   sources: measurement, "quoted", db..m, db.rp.m, (subquery)
   SHOW DATABASES / MEASUREMENTS / TAG KEYS / TAG VALUES WITH KEY = k /
-      FIELD KEYS / SERIES   [ON db] [FROM m] [WHERE ...] [LIMIT/OFFSET]
-  CREATE DATABASE name / DROP DATABASE name / DROP MEASUREMENT name
-  DELETE FROM m [WHERE ...]
+      FIELD KEYS / SERIES / QUERIES / USERS / CONTINUOUS QUERIES /
+      RETENTION POLICIES / SHARDS / STATS
+      [ON db] [FROM m] [WHERE ...] [LIMIT/OFFSET]
+  CREATE DATABASE / DROP DATABASE / CREATE MEASUREMENT /
+      DROP MEASUREMENT / DELETE FROM m [WHERE ...]
+  CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES] / DROP USER /
+      SET PASSWORD FOR n = 'p'
+  CREATE CONTINUOUS QUERY n ON db [RESAMPLE EVERY d] BEGIN sel END /
+      DROP CONTINUOUS QUERY n ON db
+  CREATE/ALTER RETENTION POLICY n ON db DURATION d REPLICATION r
+      [SHARD DURATION d] [DEFAULT] / DROP RETENTION POLICY n ON db
+  EXPLAIN [ANALYZE] SELECT ... / KILL QUERY id
   multiple statements separated by ';'
 
 Expressions: and/or, comparisons (= != < <= > >= =~ !~), arithmetic
@@ -488,6 +497,10 @@ class Parser:
         if u == "CONTINUOUS":
             self._expect_kw("QUERIES")
             return ShowStatement("continuous queries")
+        if u == "SHARDS":
+            return ShowStatement("shards")
+        if u == "STATS":
+            return ShowStatement("stats")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "SERIES":

@@ -344,3 +344,19 @@ def test_scenario(server, scenario):
     for q, expected in scenario["queries"]:
         got = _query(server, db, q)
         assert got["results"] == expected, f"{scenario['name']}: {q}"
+
+
+def test_show_shards_and_stats(server):
+    db = "suite_showmeta"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=b"m v=1 1000", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    got = _query(server, db, "SHOW SHARDS")
+    shards = got["results"][0]["series"][0]
+    assert shards["columns"][:2] == ["id", "database"]
+    assert any(row[1] == db for row in shards["values"])
+    got = _query(server, db, "SHOW STATS")
+    names = [s["name"] for s in got["results"][0]["series"]]
+    assert "runtime" in names

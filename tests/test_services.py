@@ -339,3 +339,39 @@ def test_rp_cq_not_found_and_no_phantom_db(tmp_path):
         parse_query("CREATE RETENTION POLICY r ON d DURATION 1h "
                     "REPLICATION 2.5")
     eng.close()
+
+
+def test_rp_edge_semantics(tmp_path):
+    from opengemini_tpu.meta.catalog import Catalog
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    ex = QueryExecutor(eng, catalog=cat)
+
+    def q(t):
+        (s,) = parse_query(t)
+        return ex.execute(s, "db0")
+
+    # engine-only db: SHOW RP shows the implicit default, no error
+    eng.write_points("db0", parse_lines("m v=1 1000"))
+    res = q("SHOW RETENTION POLICIES ON db0")
+    assert res["series"][0]["values"][0][0] == "autogen"
+    # engine-only db: DROP of a missing object says object-not-found
+    assert "retention policy not found" in \
+        q("DROP RETENTION POLICY ghost ON db0")["error"]
+    assert "continuous query not found" in \
+        q("DROP CONTINUOUS QUERY ghost ON db0")["error"]
+    # duplicate CREATE errors instead of silently replacing
+    assert q("CREATE RETENTION POLICY rp1 ON db0 DURATION 30d "
+             "REPLICATION 1") == {}
+    assert "already exists" in \
+        q("CREATE RETENTION POLICY rp1 ON db0 DURATION 1h "
+          "REPLICATION 1")["error"]
+    # ALTER SHARD DURATION 0 resets to the default, not literal zero
+    assert q("ALTER RETENTION POLICY rp1 ON db0 SHARD DURATION 0") == {}
+    res = q("SHOW RETENTION POLICIES ON db0")
+    rows = {r[0]: r for r in res["series"][0]["values"]}
+    assert rows["rp1"][2] == "168h0m0s"
+    eng.close()
