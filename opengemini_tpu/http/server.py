@@ -283,6 +283,12 @@ class HttpServer:
                             "interval", 60 * 10**9)))
                     return 200, {"histograms": hist,
                                  "count": sum(h["count"] for h in hist)}
+                if op == "analytics":
+                    res = stream.analytics(
+                        params.get("q", ""), t_min or 0, t_max or 0,
+                        group_by=params.get("group_by", ""),
+                        limit=int(params.get("limit", 10)))
+                    return 200, res
                 if op == "context":
                     cur = decode_cursor(params["cursor"])
                     rows = stream.context(

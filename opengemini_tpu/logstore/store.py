@@ -422,6 +422,42 @@ class LogStream:
                 break
         return acc
 
+    def _scan_matches(self, clauses, t_min: int | None,
+                      t_max: int | None, t_max_inclusive: bool,
+                      reverse: bool = False):
+        """Yield matching LogRecords: the shared time-prune → bloom-prune
+        → CLV-search → per-record time-filter pipeline behind query/
+        histogram/analytics. Callers hold the stream lock (@_locked)."""
+        plain = [t for ty, term in clauses if ty != FUZZY
+                 for t, _p in tokenize(term)]
+        segs = self.segments
+        for seg in (reversed(segs) if reverse else segs):
+            if seg.n == 0:
+                continue
+            if t_min is not None and seg.max_time < t_min:
+                continue
+            if t_max is not None and (
+                    seg.min_time > t_max if t_max_inclusive
+                    else seg.min_time >= t_max):
+                continue
+            if not seg.may_match(plain):
+                continue
+            seqs = self._matching_seqs(seg, clauses)
+            if not len(seqs):
+                continue
+            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
+            for s in (seqs[::-1] if reverse else seqs):
+                r = seg.record_by_seq(int(s))
+                if r is None:
+                    continue
+                if t_min is not None and r.time < t_min:
+                    continue
+                if t_max is not None and (
+                        r.time > t_max if t_max_inclusive
+                        else r.time >= t_max):
+                    continue
+                yield r
+
     @_locked
     def query(self, q: str = "", t_min: int | None = None,
               t_max: int | None = None, limit: int = 100,
@@ -430,37 +466,13 @@ class LogStream:
         """Keyword search (reference serveQueryLog): time-pruned segments
         → bloom prune → CLV search → records, newest first by default."""
         clauses = parse_log_query(q)
-        plain = [t for ty, term in clauses if ty != FUZZY
-                 for t, _p in tokenize(term)]
         out: list[LogRecord] = []
-        segs = self.segments
-        for seg in (reversed(segs) if reverse else segs):
+        for r in self._scan_matches(clauses, t_min, t_max,
+                                    t_max_inclusive=True,
+                                    reverse=reverse):
+            out.append(r)
             if len(out) >= limit:
                 break
-            if seg.n == 0:
-                continue
-            if t_min is not None and seg.max_time < t_min:
-                continue
-            if t_max is not None and seg.min_time > t_max:
-                continue
-            if not seg.may_match(plain):
-                continue
-            seqs = self._matching_seqs(seg, clauses)
-            if not len(seqs):
-                continue
-            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
-            recs = [seg.record_by_seq(int(s)) for s in
-                    (seqs[::-1] if reverse else seqs)]
-            for r in recs:
-                if r is None:
-                    continue
-                if t_min is not None and r.time < t_min:
-                    continue
-                if t_max is not None and r.time > t_max:
-                    continue
-                out.append(r)
-                if len(out) >= limit:
-                    break
         hl = [term for ty, term in clauses if ty != FUZZY] \
             if highlight else None
         hl_tokens = [t for term in hl or [] for t, _p in tokenize(term)]
@@ -470,31 +482,38 @@ class LogStream:
     def histogram(self, q: str = "", t_min: int = 0, t_max: int = 0,
                   interval: int = 60 * 10**9) -> list[dict]:
         """Per-time-bucket match counts (reference serveAggLogQuery /
-        getHistogramsForAggLog) — one vectorized bincount over matched
-        record times."""
+        getHistogramsForAggLog); window is [t_min, t_max)."""
         clauses = parse_log_query(q)
-        plain = [t for ty, term in clauses if ty != FUZZY
-                 for t, _p in tokenize(term)]
         n_buckets = max(int((t_max - t_min + interval - 1) // interval), 1)
         counts = np.zeros(n_buckets, dtype=np.int64)
-        segs = self.segments
-        for seg in segs:
-            if seg.n == 0 or seg.max_time < t_min \
-                    or seg.min_time >= t_max or not seg.may_match(plain):
-                continue
-            seqs = self._matching_seqs(seg, clauses)
-            if not len(seqs):
-                continue
-            self.cache.touch((self.repo, self.name, seg.seg_id), seg)
-            times = np.array([seg.record_by_seq(int(s)).time
-                              for s in seqs], dtype=np.int64)
-            keep = (times >= t_min) & (times < t_max)
-            if keep.any():
-                b = ((times[keep] - t_min) // interval).astype(np.int64)
-                counts += np.bincount(b, minlength=n_buckets)
+        for r in self._scan_matches(clauses, t_min, t_max,
+                                    t_max_inclusive=False):
+            counts[(r.time - t_min) // interval] += 1
         return [{"from": int(t_min + i * interval),
                  "to": int(min(t_min + (i + 1) * interval, t_max)),
                  "count": int(c)} for i, c in enumerate(counts)]
+
+    @_locked
+    def analytics(self, q: str = "", t_min: int = 0, t_max: int = 0,
+                  group_by: str = "", limit: int = 10) -> dict:
+        """Top tag values by matching-log count over a range (reference
+        serveAnalytics, handler_logstore_query.go:823 — the group-by
+        aggregation behind log analytics dashboards). Empty group_by
+        returns only the total."""
+        clauses = parse_log_query(q)
+        counts: dict[str, int] = {}
+        total = 0
+        for r in self._scan_matches(clauses, t_min or None,
+                                    t_max or None,
+                                    t_max_inclusive=False):
+            total += 1
+            if group_by:
+                v = r.tags.get(group_by, "")
+                counts[v] = counts.get(v, 0) + 1
+        groups = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        return {"total": total,
+                "groups": [{"value": v, "count": c}
+                           for v, c in groups[:limit]]}
 
     @_locked
     def context(self, seq: int, before: int = 10, after: int = 10

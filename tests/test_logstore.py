@@ -386,3 +386,47 @@ def test_http_logstore_errors(server):
     assert code == 404
     code, _ = _req("GET", f"{base}/repo/missing/logstreams/x/logs")
     assert code == 404
+
+
+def test_analytics_group_by_tag(tmp_path):
+    ls = LogStore(str(tmp_path / "ls"))
+    ls.create_repository("r")
+    ls.create_logstream("r", "s")
+    st = ls.stream("r", "s")
+    st.append([
+        {"content": "error timeout", "timestamp": 1 * MIN,
+         "tags": {"svc": "api"}},
+        {"content": "error refused", "timestamp": 2 * MIN,
+         "tags": {"svc": "api"}},
+        {"content": "error disk", "timestamp": 3 * MIN,
+         "tags": {"svc": "db"}},
+        {"content": "ok", "timestamp": 4 * MIN, "tags": {"svc": "api"}},
+    ])
+    res = st.analytics("error", group_by="svc")
+    assert res["total"] == 3
+    assert res["groups"] == [{"value": "api", "count": 2},
+                             {"value": "db", "count": 1}]
+    # time-bounded, no group_by → total only
+    res = st.analytics("error", t_min=2 * MIN, t_max=4 * MIN)
+    assert res["total"] == 2 and res["groups"] == []
+
+
+def test_http_analytics(server):
+    base = f"http://{server}"
+    _req("POST", f"{base}/api/v1/repository/ra")
+    _req("POST", f"{base}/api/v1/logstream/ra/sa")
+    logs = {"logs": [
+        {"content": "login fail", "timestamp": MIN,
+         "tags": {"user": "bob"}},
+        {"content": "login fail", "timestamp": 2 * MIN,
+         "tags": {"user": "bob"}},
+        {"content": "login ok", "timestamp": 3 * MIN,
+         "tags": {"user": "eve"}}]}
+    _req("POST", f"{base}/repo/ra/logstreams/sa/records",
+         json.dumps(logs).encode())
+    code, body = _req(
+        "GET", f"{base}/repo/ra/logstreams/sa/analytics"
+               f"?q=fail&group_by=user")
+    assert code == 200
+    assert body == {"total": 2,
+                    "groups": [{"value": "bob", "count": 2}]}
