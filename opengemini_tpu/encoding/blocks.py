@@ -143,6 +143,16 @@ def encode_float_block(values: np.ndarray, prefer: str = "auto") -> bytes:
     return bytes([RAW]) + raw
 
 
+def parse_rle_payload(payload) -> tuple[np.ndarray, np.ndarray]:
+    """RLE wire format → (run values f64, run lengths i64). Shared by the
+    CPU decoder and the device decoder (ops/device_decode.py)."""
+    runs = struct.unpack("<I", payload[:4])[0]
+    vals = np.frombuffer(payload[4:4 + 8 * runs], dtype=np.float64)
+    lens = np.frombuffer(payload[4 + 8 * runs:4 + 12 * runs],
+                         dtype=np.uint32).astype(np.int64)
+    return vals, lens
+
+
 def decode_float_block(buf: bytes | memoryview, n: int) -> np.ndarray:
     codec, payload = buf[0], memoryview(buf)[1:]
     if codec == RAW:
@@ -153,10 +163,7 @@ def decode_float_block(buf: bytes | memoryview, n: int) -> np.ndarray:
     if codec == CONST:
         return np.full(n, np.frombuffer(payload[:8], dtype=np.float64)[0])
     if codec == RLE:
-        runs = struct.unpack("<I", payload[:4])[0]
-        vals = np.frombuffer(payload[4:4 + 8 * runs], dtype=np.float64)
-        lens = np.frombuffer(payload[4 + 8 * runs:4 + 12 * runs],
-                             dtype=np.uint32).astype(np.int64)
+        vals, lens = parse_rle_payload(payload)
         return np.repeat(vals, lens)[:n]
     if codec == GORILLA:
         return gorilla.decode(bytes(payload), n)

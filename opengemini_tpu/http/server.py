@@ -285,7 +285,7 @@ class HttpServer:
                                  "count": sum(h["count"] for h in hist)}
                 if op == "analytics":
                     res = stream.analytics(
-                        params.get("q", ""), t_min or 0, t_max or 0,
+                        params.get("q", ""), t_min, t_max,
                         group_by=params.get("group_by", ""),
                         limit=int(params.get("limit", 10)))
                     return 200, res

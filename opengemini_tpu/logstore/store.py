@@ -485,30 +485,35 @@ class LogStream:
         getHistogramsForAggLog); window is [t_min, t_max)."""
         clauses = parse_log_query(q)
         n_buckets = max(int((t_max - t_min + interval - 1) // interval), 1)
-        counts = np.zeros(n_buckets, dtype=np.int64)
-        for r in self._scan_matches(clauses, t_min, t_max,
-                                    t_max_inclusive=False):
-            counts[(r.time - t_min) // interval] += 1
+        times = [r.time for r in self._scan_matches(
+            clauses, t_min, t_max, t_max_inclusive=False)]
+        if times:
+            b = ((np.asarray(times, dtype=np.int64) - t_min)
+                 // interval)
+            counts = np.bincount(b, minlength=n_buckets)
+        else:
+            counts = np.zeros(n_buckets, dtype=np.int64)
         return [{"from": int(t_min + i * interval),
                  "to": int(min(t_min + (i + 1) * interval, t_max)),
                  "count": int(c)} for i, c in enumerate(counts)]
 
     @_locked
-    def analytics(self, q: str = "", t_min: int = 0, t_max: int = 0,
+    def analytics(self, q: str = "", t_min: int | None = None,
+                  t_max: int | None = None,
                   group_by: str = "", limit: int = 10) -> dict:
-        """Top tag values by matching-log count over a range (reference
-        serveAnalytics, handler_logstore_query.go:823 — the group-by
-        aggregation behind log analytics dashboards). Empty group_by
-        returns only the total."""
+        """Top tag values by matching-log count over [t_min, t_max] —
+        INCLUSIVE bounds, same as query()/the /logs endpoint (reference
+        serveAnalytics, handler_logstore_query.go:823). Empty group_by
+        returns only the total; records lacking the group_by tag count
+        toward the total but form no group."""
         clauses = parse_log_query(q)
         counts: dict[str, int] = {}
         total = 0
-        for r in self._scan_matches(clauses, t_min or None,
-                                    t_max or None,
-                                    t_max_inclusive=False):
+        for r in self._scan_matches(clauses, t_min, t_max,
+                                    t_max_inclusive=True):
             total += 1
-            if group_by:
-                v = r.tags.get(group_by, "")
+            if group_by and group_by in r.tags:
+                v = r.tags[group_by]
                 counts[v] = counts.get(v, 0) + 1
         groups = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
         return {"total": total,

@@ -19,3 +19,6 @@ from .segment_agg import (  # noqa: E402
     AggSpec, SegmentAggResult, segment_aggregate, window_ids,
     dense_window_aggregate, pad_bucket)
 from .ogsketch import OGSketch  # noqa: E402
+from .device_decode import (  # noqa: E402
+    const_delta_expand, const_expand, device_decode_float_block,
+    device_decode_time_block, rle_expand)

@@ -1,0 +1,94 @@
+"""Device-side decode of cheap block codecs.
+
+SURVEY.md §7 hard parts: "Host↔device bandwidth: decode-on-CPU then DMA
+can starve the TPU; … decompress cheap codecs (RLE/delta) *in-kernel*."
+This module is that path: for the codecs whose decode is pure arithmetic
+(CONST, RLE, CONST_DELTA — encoding/blocks.py), the host ships the SMALL
+compressed payload (run values + lengths, or start + stride) and the
+expansion to a dense block happens on device, fused by XLA into whatever
+kernel consumes it. A run-heavy block of 64k floats moves a few hundred
+bytes over PCIe/DMA instead of 512KB.
+
+Expansion uses static output lengths (`total_repeat_length` /
+`jnp.arange(n)`) so everything stays jit-compatible; block sizes are
+already padded to fixed tiers by the TSSP layout (SEGMENT_SIZE), so the
+jit cache hits.
+
+Byte-codec blocks (gorilla/zstd/simple8b) stay CPU-decoded — bit-twiddly
+sequential decoders don't map to the VPU; `device_decode_float_block`
+returns None for them and the caller falls back to the numpy decoder.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..encoding.blocks import CONST, CONST_DELTA, RLE, parse_rle_payload
+
+__all__ = ["rle_expand", "const_expand", "const_delta_expand",
+           "device_decode_float_block", "device_decode_time_block"]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def rle_expand(values: jax.Array, lengths: jax.Array, n: int) -> jax.Array:
+    """Expand run-length pairs to a dense (n,) block on device. The runs
+    arrays are padded with zero-length runs to a fixed size by the caller
+    so the jit cache keys recur."""
+    return jnp.repeat(values, lengths, total_repeat_length=n)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def const_expand(value: jax.Array, n: int) -> jax.Array:
+    return jnp.full((n,), value)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def const_delta_expand(t0: jax.Array, step: jax.Array, n: int) -> jax.Array:
+    return t0 + step * jnp.arange(n, dtype=jnp.int64)
+
+
+def _pad_runs(vals: np.ndarray, lens: np.ndarray,
+              bucket: int = 256) -> tuple[np.ndarray, np.ndarray]:
+    """Pad run arrays to a bucketed length (zero-length runs expand to
+    nothing) so repeated decodes share one compiled kernel."""
+    r = len(vals)
+    padded = max(bucket, 1 << (r - 1).bit_length()) if r else bucket
+    if r == padded:
+        return vals, lens
+    pv = np.zeros(padded, dtype=vals.dtype)
+    pl = np.zeros(padded, dtype=np.int64)
+    pv[:r] = vals
+    pl[:r] = lens
+    return pv, pl
+
+
+def device_decode_float_block(buf, n: int) -> jax.Array | None:
+    """Decode a float block ON DEVICE when its codec is arithmetic;
+    returns None for byte codecs (caller falls back to the CPU decoder,
+    encoding/blocks.decode_float_block)."""
+    codec = buf[0]
+    payload = memoryview(buf)[1:]
+    if codec == CONST:
+        v = np.frombuffer(payload[:8], dtype=np.float64)[0]
+        return const_expand(jnp.asarray(v), n)
+    if codec == RLE:
+        vals, lens = parse_rle_payload(payload)
+        pv, pl = _pad_runs(vals, lens)
+        # ship ~runs*12 bytes instead of n*8
+        return rle_expand(jnp.asarray(pv), jnp.asarray(pl), n)
+    return None
+
+
+def device_decode_time_block(buf, n: int) -> jax.Array | None:
+    """Decode a CONST_DELTA time block on device (regular sampling — the
+    overwhelmingly common case — costs 16 bytes of transfer)."""
+    if buf[0] != CONST_DELTA:
+        return None
+    t0, step = struct.unpack("<qq", memoryview(buf)[1:17])
+    return const_delta_expand(jnp.asarray(t0, dtype=jnp.int64),
+                              jnp.asarray(step, dtype=jnp.int64), n)

@@ -1,0 +1,74 @@
+"""Device-side block decode (SURVEY §7: decompress cheap codecs
+in-kernel) — parity vs the CPU decoders and fusion into aggregation."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.encoding.blocks import (decode_float_block,
+                                            decode_time_block,
+                                            encode_float_block,
+                                            encode_time_block)
+from opengemini_tpu.ops import (AggSpec, device_decode_float_block,
+                                device_decode_time_block, rle_expand,
+                                segment_aggregate)
+
+
+def test_rle_block_device_parity():
+    v = np.repeat(np.array([1.5, -2.0, 7.25, 0.0]), [100, 3, 57, 40])
+    buf = encode_float_block(v)
+    assert buf[0] == 6                         # RLE picked
+    dev = device_decode_float_block(buf, len(v))
+    assert dev is not None
+    np.testing.assert_array_equal(np.asarray(dev),
+                                  decode_float_block(buf, len(v)))
+
+
+def test_const_block_device_parity():
+    v = np.full(64, 3.25)
+    buf = encode_float_block(v)
+    dev = device_decode_float_block(buf, 64)
+    np.testing.assert_array_equal(np.asarray(dev), v)
+
+
+def test_const_delta_time_device_parity():
+    t = 1_000_000 + 15_000 * np.arange(512, dtype=np.int64)
+    buf = encode_time_block(t)
+    dev = device_decode_time_block(buf, 512)
+    assert dev is not None
+    np.testing.assert_array_equal(np.asarray(dev),
+                                  decode_time_block(buf, 512))
+
+
+def test_byte_codecs_fall_back_to_cpu():
+    rng = np.random.default_rng(0)
+    v = rng.normal(0, 1, 256)                  # incompressible → zstd/raw
+    buf = encode_float_block(v)
+    assert device_decode_float_block(buf, 256) is None
+    t = rng.integers(0, 10**9, 64).astype(np.int64)   # irregular times
+    assert device_decode_time_block(encode_time_block(np.sort(t)),
+                                    64) is None
+
+
+def test_rle_expand_padded_runs_shared_compile():
+    # zero-length padding runs expand to nothing → same compiled kernel
+    import jax.numpy as jnp
+    out = rle_expand(jnp.asarray([5.0, 7.0, 0.0, 0.0]),
+                     jnp.asarray([3, 1, 0, 0]), 4)
+    np.testing.assert_array_equal(np.asarray(out), [5, 5, 5, 7])
+
+
+def test_aggregate_straight_from_encoded_blocks():
+    """End to end: compressed payload → device expand → segment reduce,
+    with no CPU-side dense materialization."""
+    v = np.repeat(np.array([10.0, 20.0]), [128, 128])
+    t = 1000 + 50 * np.arange(256, dtype=np.int64)
+    vbuf = encode_float_block(v)
+    tbuf = encode_time_block(t)
+    dv = device_decode_float_block(vbuf, 256)
+    dt = device_decode_time_block(tbuf, 256)
+    seg = np.repeat(np.arange(2, dtype=np.int64), 128)
+    res = segment_aggregate(dv, np.ones(256, bool), seg, dt, 2,
+                            AggSpec.of("sum", "first", "last"))
+    np.testing.assert_array_equal(np.asarray(res.sum), [1280.0, 2560.0])
+    assert np.asarray(res.first)[0] == 10.0
+    assert np.asarray(res.first_time)[1] == 1000 + 50 * 128

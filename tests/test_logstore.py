@@ -406,9 +406,14 @@ def test_analytics_group_by_tag(tmp_path):
     assert res["total"] == 3
     assert res["groups"] == [{"value": "api", "count": 2},
                              {"value": "db", "count": 1}]
-    # time-bounded, no group_by → total only
-    res = st.analytics("error", t_min=2 * MIN, t_max=4 * MIN)
+    # time-bounded (inclusive, like /logs), no group_by → total only
+    res = st.analytics("error", t_min=2 * MIN, t_max=3 * MIN)
     assert res["total"] == 2 and res["groups"] == []
+    # records lacking the tag count toward total but form no group
+    st.append([{"content": "error untagged", "timestamp": 5 * MIN}])
+    res = st.analytics("error", group_by="svc")
+    assert res["total"] == 4
+    assert sum(g["count"] for g in res["groups"]) == 3
 
 
 def test_http_analytics(server):
