@@ -285,6 +285,14 @@ class QueryExecutor:
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []
             return _series("", ["user", "admin"], rows)
+        if stmt.what == "series cardinality":
+            # reference SHOW SERIES CARDINALITY (the >1M-series
+            # engine's headline introspection)
+            total = sum(s.index.series_cardinality
+                        for s in eng.database(db).all_shards()) \
+                if db in eng.databases else 0
+            return _series("series cardinality",
+                           ["cardinality estimation"], [[total]])
         if stmt.what == "shards":
             # reference SHOW SHARDS: shard layout per database
             rows = []

@@ -504,7 +504,10 @@ class Parser:
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "SERIES":
-            stmt = ShowStatement("series")
+            if self._kw("CARDINALITY"):
+                stmt = ShowStatement("series cardinality")
+            else:
+                stmt = ShowStatement("series")
         elif u == "TAG":
             w = self.lx.next()[1].upper()
             stmt = ShowStatement("tag keys" if w == "KEYS" else "tag values")

@@ -360,3 +360,15 @@ def test_show_shards_and_stats(server):
     got = _query(server, db, "SHOW STATS")
     names = [s["name"] for s in got["results"][0]["series"]]
     assert "runtime" in names
+
+
+def test_show_series_cardinality(server):
+    db = "suite_card"
+    body = "\n".join(f"m,h=h{i} v=1 1000" for i in range(7)).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    got = _query(server, db, "SHOW SERIES CARDINALITY")
+    assert got["results"][0]["series"][0]["values"] == [[7]]
