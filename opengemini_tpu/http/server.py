@@ -302,6 +302,15 @@ class HttpServer:
                         cur, count=int(params.get("count", 100)))
                     return 200, {"logs": rows,
                                  "cursor": encode_cursor(nxt)}
+                if op == "consume/cursors":
+                    frm = decode_cursor(params["cursor"]) \
+                        if "cursor" in params else 0
+                    ranges = stream.consume_cursors(
+                        int(params.get("count", 1)), frm)
+                    return 200, {"cursors": [
+                        {"from": encode_cursor(r["from"]),
+                         "to": encode_cursor(r["to"]),
+                         "open": r["open"]} for r in ranges]}
                 if op == "consume/cursor-time":
                     seq = stream.cursor_at_time(int(params["time"]))
                     return 200, {"cursor": encode_cursor(seq)}

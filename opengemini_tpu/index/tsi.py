@@ -157,6 +157,16 @@ class SeriesIndex:
     def series_cardinality(self) -> int:
         return len(self._key_to_sid)
 
+    def series_keys(self, measurement: str | None = None) -> list[str]:
+        """All series keys (optionally one measurement's) — callers
+        union across shards for exact db-wide cardinality."""
+        with self._lock:
+            if measurement is None:
+                return list(self._key_to_sid)
+            prefix = measurement + ","
+            return [k for k in self._key_to_sid
+                    if k.startswith(prefix) or k == measurement]
+
     @property
     def max_sid(self) -> int:
         return len(self._sid_to_tags) - 1

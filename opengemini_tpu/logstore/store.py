@@ -559,6 +559,28 @@ class LogStream:
         return out, next_cur
 
     @_locked
+    def consume_cursors(self, n: int, from_seq: int = 0) -> list[dict]:
+        """Split the remaining stream into n contiguous ranges for
+        parallel consumers (reference serveGetConsumeCursors,
+        handler_logstore_consume.go — per-PT cursor fan-out). Each entry:
+        {"from": seq, "to": seq_exclusive}; the last range is open-ended
+        (consumers tail it with read_from)."""
+        n = max(int(n), 1)
+        # a stale/forged cursor past the stream end must not invert the
+        # open range (to < from)
+        end = max(self.next_seq, from_seq)
+        total = end - from_seq
+        step = total // n
+        out = []
+        pos = from_seq
+        for i in range(n):
+            hi = end if i == n - 1 else pos + step
+            out.append({"from": int(pos), "to": int(hi),
+                        "open": i == n - 1})
+            pos = hi
+        return out
+
+    @_locked
     def cursor_at_time(self, t: int) -> int:
         """Smallest seq with record time >= t (reference
         serveConsumeCursorTime)."""

@@ -285,14 +285,6 @@ class QueryExecutor:
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []
             return _series("", ["user", "admin"], rows)
-        if stmt.what == "series cardinality":
-            # reference SHOW SERIES CARDINALITY (the >1M-series
-            # engine's headline introspection)
-            total = sum(s.index.series_cardinality
-                        for s in eng.database(db).all_shards()) \
-                if db in eng.databases else 0
-            return _series("series cardinality",
-                           ["cardinality estimation"], [[total]])
         if stmt.what == "shards":
             # reference SHOW SHARDS: shard layout per database
             rows = []
@@ -367,6 +359,15 @@ class QueryExecutor:
             return _series("databases", ["name"], vals)
         if db is None or db not in eng.databases:
             return {"error": f"database not found: {db}"}
+        if stmt.what == "series cardinality":
+            # reference SHOW SERIES CARDINALITY (the >1M-series engine's
+            # headline introspection): exact union across shards — a
+            # series spanning several time-partitioned shards counts once
+            keys: set[str] = set()
+            for s in eng.database(db).all_shards():
+                keys.update(s.index.series_keys(stmt.from_measurement))
+            return _series("series cardinality",
+                           ["cardinality estimation"], [[len(keys)]])
         if stmt.what == "measurements":
             vals = [[m] for m in eng.measurements(db)]
             return _series("measurements", ["name"], vals)

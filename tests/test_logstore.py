@@ -435,3 +435,46 @@ def test_http_analytics(server):
     assert code == 200
     assert body == {"total": 2,
                     "groups": [{"value": "bob", "count": 2}]}
+
+
+def test_consume_cursors_split(stream):
+    ranges = stream.consume_cursors(2)
+    assert ranges == [{"from": 0, "to": 2, "open": False},
+                      {"from": 2, "to": 5, "open": True}]
+    # ranges partition the stream: reading each yields every record once
+    seen = []
+    for r in ranges:
+        cur = r["from"]
+        while cur < r["to"]:
+            rows, cur2 = stream.read_from(cur, count=1)
+            if not rows or rows[0]["cursor"] >= r["to"]:
+                break
+            seen.append(rows[0]["cursor"])
+            cur = cur2
+    assert seen == [0, 1, 2, 3, 4]
+    assert stream.consume_cursors(1) == [
+        {"from": 0, "to": 5, "open": True}]
+
+
+def test_http_consume_cursors(server):
+    base = f"http://{server}"
+    _req("POST", f"{base}/api/v1/repository/rc")
+    _req("POST", f"{base}/api/v1/logstream/rc/sc")
+    _req("POST", f"{base}/repo/rc/logstreams/sc/records",
+         json.dumps([{"content": f"l{i}", "timestamp": i * MIN}
+                     for i in range(4)]).encode())
+    code, body = _req(
+        "GET", f"{base}/repo/rc/logstreams/sc/consume/cursors?count=2")
+    assert code == 200 and len(body["cursors"]) == 2
+    # returned cursors feed consume/logs directly
+    c0 = body["cursors"][0]
+    code, logs = _req(
+        "GET", f"{base}/repo/rc/logstreams/sc/consume/logs"
+               f"?cursor={c0['from']}&count=100")
+    assert logs["logs"][0]["content"] == "l0"
+
+
+def test_consume_cursors_stale_cursor(stream):
+    ranges = stream.consume_cursors(2, from_seq=99)
+    assert ranges[-1]["from"] <= ranges[-1]["to"]
+    assert all(r["from"] <= r["to"] for r in ranges)

@@ -372,3 +372,23 @@ def test_show_series_cardinality(server):
         assert r.status == 204
     got = _query(server, db, "SHOW SERIES CARDINALITY")
     assert got["results"][0]["series"][0]["values"] == [[7]]
+
+
+def test_series_cardinality_dedupes_across_shards(server):
+    db = "suite_card2"
+    WEEK = 7 * 86400 * 10**9
+    # same series in two time-partitioned shards → counts once
+    body = (f"m,h=a v=1 1000\nm,h=a v=2 {2 * WEEK}\n"
+            f"m,h=b v=3 1000").encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    got = _query(server, db, "SHOW SERIES CARDINALITY")
+    assert got["results"][0]["series"][0]["values"] == [[2]]
+    # FROM filter + missing db error
+    got = _query(server, db, "SHOW SERIES CARDINALITY FROM m")
+    assert got["results"][0]["series"][0]["values"] == [[2]]
+    got = _query(server, "nope_db", "SHOW SERIES CARDINALITY")
+    assert "error" in got["results"][0]
