@@ -246,3 +246,24 @@ def test_cluster_delete_with_tag_predicate(loaded):
     assert r == {}
     res = _cluster_result(loaded, "SELECT count(v) FROM ephem2")
     assert res["series"][0]["values"][0][1] == 1
+
+
+def test_cluster_percentile_approx_and_sliding(loaded):
+    """Sketch partials and sliding-window state grids survive the RPC
+    exchange: cluster result matches the single-node reference (values
+    to float tolerance — partial-sum association differs across the
+    exchange, so the last ulp may too)."""
+    for q in ("SELECT percentile_approx(usage, 90) FROM cpu",
+              "SELECT sliding_window(mean(usage), 3) FROM cpu "
+              "WHERE time >= 0 AND time < 8m GROUP BY time(1m)",
+              "SELECT sliding_window(max(usage), 2) FROM cpu "
+              "WHERE time >= 0 AND time < 8m GROUP BY time(1m), host"):
+        got = _cluster_result(loaded, q)
+        ref = _ref_result(loaded, q)
+        assert len(got["series"]) == len(ref["series"]), q
+        for gs, rs in zip(got["series"], ref["series"]):
+            assert gs.get("tags") == rs.get("tags"), q
+            assert [r[0] for r in gs["values"]] ==                 [r[0] for r in rs["values"]], q
+            np.testing.assert_allclose(
+                [r[1] for r in gs["values"]],
+                [r[1] for r in rs["values"]], rtol=1e-12, err_msg=q)
