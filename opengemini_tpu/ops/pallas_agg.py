@@ -1,0 +1,88 @@
+"""Pallas TPU kernel for the dense window reduction (f32 fast mode).
+
+The f64 exact path (segment_agg.dense_window_aggregate) is what queries
+use by default — f64 is emulated on TPU, and XLA already fuses its
+reductions well. This kernel is the opt-in float32 fast mode for
+dashboards that trade the last ulp for throughput: one VMEM-tiled pass
+computes sum/min/max per (series, window) row of a dense (S, P) block,
+reading each element exactly once (the hot loop is HBM-bound, so the
+win is guaranteed single-pass locality and half the bytes of f64).
+
+Tiling: grid over row tiles of TILE_S=8 rows (the f32 sublane height);
+each program reduces a (8, P) VMEM block on the VPU. P must be a
+multiple of 128 (lane width) — TSSP segments are already padded to
+power-of-two sizes. Rows are padded to a multiple of 8 with zeros and
+the pad outputs sliced off.
+
+Falls back to `interpret=True` off-TPU (tests run on the CPU mesh)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+TILE_S = 8
+
+
+LANES = 128
+
+
+def _rowagg_kernel(x_ref, sum_ref, min_ref, max_ref):
+    # outputs are lane-broadcast (TILE_S, 128) blocks: Mosaic requires
+    # full-lane output tiles, so the per-row scalar repeats across lanes
+    # and the wrapper slices lane 0
+    x = x_ref[...]
+    shape = (TILE_S, LANES)
+    sum_ref[...] = jnp.broadcast_to(
+        jnp.sum(x, axis=1, keepdims=True), shape)
+    min_ref[...] = jnp.broadcast_to(
+        jnp.min(x, axis=1, keepdims=True), shape)
+    max_ref[...] = jnp.broadcast_to(
+        jnp.max(x, axis=1, keepdims=True), shape)
+
+
+def _rowagg_call(x, interpret: bool):
+    # x64 must be OFF around the pallas trace: the session enables
+    # jax_enable_x64 globally (ops/__init__) and Mosaic lowering of the
+    # x64-typed grid indices crashes the remote compile helper. The
+    # kernel itself is pure f32 either way.
+    S, P = x.shape
+    out = jax.ShapeDtypeStruct((S, LANES), jnp.float32)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _rowagg_kernel,
+            grid=(S // TILE_S,),
+            in_specs=[pl.BlockSpec((TILE_S, P), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((TILE_S, LANES),
+                                    lambda i: (i, 0))] * 3,
+            out_shape=[out, out, out],
+            interpret=interpret,
+        )(x)
+
+
+def pallas_dense_rowagg(values,
+                        interpret: bool | None = None
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(S, P) float32 block → per-row (sum, min, max), each (S,).
+    interpret=None auto-selects: real kernel on TPU, interpreter
+    elsewhere."""
+    x = np.asarray(values, dtype=np.float32)
+    S, P = x.shape
+    if P % 128 != 0:
+        raise ValueError(f"P must be a multiple of 128, got {P}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    pad = (-S) % TILE_S
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad, P), dtype=x.dtype)], axis=0)
+    s, mn, mx = _rowagg_call(x, interpret)
+    return s[:S, 0], mn[:S, 0], mx[:S, 0]   # lane 0 of the broadcast
+
+
+def pallas_dense_mean(values, interpret: bool | None = None) -> jax.Array:
+    """Fast-mode mean per row — the f32 TSBS double-groupby-1 kernel."""
+    s, _mn, _mx = pallas_dense_rowagg(values, interpret)
+    return s / np.float32(values.shape[1])
