@@ -258,12 +258,4 @@ def test_cluster_percentile_approx_and_sliding(loaded):
               "WHERE time >= 0 AND time < 8m GROUP BY time(1m)",
               "SELECT sliding_window(max(usage), 2) FROM cpu "
               "WHERE time >= 0 AND time < 8m GROUP BY time(1m), host"):
-        got = _cluster_result(loaded, q)
-        ref = _ref_result(loaded, q)
-        assert len(got["series"]) == len(ref["series"]), q
-        for gs, rs in zip(got["series"], ref["series"]):
-            assert gs.get("tags") == rs.get("tags"), q
-            assert [r[0] for r in gs["values"]] ==                 [r[0] for r in rs["values"]], q
-            np.testing.assert_allclose(
-                [r[1] for r in gs["values"]],
-                [r[1] for r in rs["values"]], rtol=1e-12, err_msg=q)
+        _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q), q)
