@@ -1,0 +1,142 @@
+// Gorilla XOR float64 codec — native implementation of the byte format
+// defined by opengemini_tpu/encoding/gorilla.py (role of the reference's
+// lib/encoding/float.go:27 gorilla path; this file is the "C++
+// implementation behind the same byte format" the Python module's
+// docstring reserves for the hot loop).
+//
+// Format (big-endian bit stream):
+//   first value raw (64 bits), then per value:
+//     0                                  -> same as previous
+//     10 + sig bits                      -> reuse previous leading/sig window
+//     11 + lead(5) + sig-1(6) + sig bits -> new window
+// Leading-zero count is clamped to 31.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+using u128 = unsigned __int128;
+
+struct BitWriter {
+    uint8_t* dst;
+    long cap;
+    long pos = 0;
+    u128 acc = 0;
+    int nbits = 0;
+    bool overflow = false;
+
+    void write(uint64_t value, int bits) {
+        u128 mask = bits >= 64 ? ~(u128)0 >> (128 - 64)
+                               : (((u128)1 << bits) - 1);
+        acc = (acc << bits) | ((u128)value & mask);
+        nbits += bits;
+        while (nbits >= 8) {
+            nbits -= 8;
+            if (pos >= cap) { overflow = true; return; }
+            dst[pos++] = (uint8_t)(acc >> nbits);
+        }
+        acc &= ((u128)1 << nbits) - 1;
+    }
+
+    long finish() {
+        if (nbits) {
+            if (pos >= cap) { overflow = true; return -1; }
+            dst[pos++] = (uint8_t)((acc << (8 - nbits)) & 0xFF);
+        }
+        return overflow ? -1 : pos;
+    }
+};
+
+struct BitReader {
+    const uint8_t* data;
+    long len;
+    long byte_pos = 0;
+    u128 acc = 0;
+    int nbits = 0;
+    bool underflow = false;
+
+    uint64_t read(int bits) {
+        while (nbits < bits) {
+            if (byte_pos >= len) { underflow = true; return 0; }
+            acc = (acc << 8) | data[byte_pos++];
+            nbits += 8;
+        }
+        nbits -= bits;
+        uint64_t out = (uint64_t)(acc >> nbits);
+        if (bits < 64) out &= (((uint64_t)1 << bits) - 1);
+        acc &= ((u128)1 << nbits) - 1;
+        return out;
+    }
+};
+
+inline int leading_zeros(uint64_t x) { return __builtin_clzll(x); }
+inline int trailing_zeros(uint64_t x) { return __builtin_ctzll(x); }
+
+}  // namespace
+
+extern "C" {
+
+// Encode n float64s; returns bytes written, or -1 when dst is too small.
+long og_gorilla_encode(const double* vals, long n, uint8_t* dst,
+                       long cap) {
+    if (n <= 0) return 0;
+    BitWriter w{dst, cap};
+    uint64_t prev;
+    std::memcpy(&prev, &vals[0], 8);
+    w.write(prev, 64);
+    int lead = -1, sig = -1;
+    for (long i = 1; i < n; i++) {
+        uint64_t cur;
+        std::memcpy(&cur, &vals[i], 8);
+        uint64_t x = cur ^ prev;
+        prev = cur;
+        if (x == 0) { w.write(0, 1); continue; }
+        int xl = leading_zeros(x);
+        int xt = trailing_zeros(x);
+        if (xl > 31) xl = 31;
+        if (lead >= 0 && xl >= lead && xt >= 64 - lead - sig) {
+            w.write(0b10, 2);
+            w.write(x >> (64 - lead - sig), sig);
+        } else {
+            lead = xl;
+            sig = 64 - xl - xt;
+            w.write(0b11, 2);
+            w.write((uint64_t)lead, 5);
+            w.write((uint64_t)(sig - 1), 6);
+            w.write(x >> xt, sig);
+        }
+        if (w.overflow) return -1;
+    }
+    return w.finish();
+}
+
+// Decode n float64s; returns 0 on success, -1 on truncated input.
+long og_gorilla_decode(const uint8_t* buf, long len, double* out,
+                       long n) {
+    if (n <= 0) return 0;
+    BitReader r{buf, len};
+    uint64_t prev = r.read(64);
+    std::memcpy(&out[0], &prev, 8);
+    int lead = 0, sig = 0;
+    for (long i = 1; i < n; i++) {
+        if (r.read(1) == 0) {
+            std::memcpy(&out[i], &prev, 8);
+            continue;
+        }
+        if (r.read(1) == 1) {
+            lead = (int)r.read(5);
+            sig = (int)r.read(6) + 1;
+            if (lead + sig > 64) return -2;  // corrupt header: a shift
+                                             // by a negative amount is UB
+        }
+        if (sig == 0) return -2;             // '10' before any '11'
+        uint64_t bits = r.read(sig);
+        prev ^= bits << (64 - lead - sig);
+        std::memcpy(&out[i], &prev, 8);
+        if (r.underflow) return -1;
+    }
+    return r.underflow ? -1 : 0;
+}
+
+}  // extern "C"

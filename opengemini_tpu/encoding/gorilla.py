@@ -54,6 +54,9 @@ class _BitReader:
 
     def read(self, bits: int) -> int:
         while self.nbits < bits:
+            if self.byte_pos >= len(self.data):
+                # same contract as the native decoder's truncated-input rc
+                raise ValueError("gorilla: truncated input")
             self.acc = (self.acc << 8) | self.data[self.byte_pos]
             self.byte_pos += 1
             self.nbits += 8
@@ -64,7 +67,13 @@ class _BitReader:
 
 
 def encode(values: np.ndarray) -> bytes:
-    """Encode float64 array; first value stored raw (64 bits)."""
+    """Encode float64 array; first value stored raw (64 bits). Uses the
+    native C++ codec (native/gorilla.cpp, byte-identical format) when the
+    shared library is available."""
+    from .. import native
+    out = native.gorilla_encode(values)
+    if out is not None:
+        return out
     u = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
     w = _BitWriter()
     if len(u) == 0:
@@ -97,6 +106,10 @@ def encode(values: np.ndarray) -> bytes:
 def decode(buf: bytes, n: int) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.float64)
+    from .. import native
+    out = native.gorilla_decode(buf, n)
+    if out is not None:
+        return out
     r = _BitReader(bytes(buf))
     out = np.empty(n, dtype=np.uint64)
     prev = r.read(64)

@@ -37,11 +37,22 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build_attempted:
+        # (re)build when missing OR stale vs any source (a new source
+        # file must trigger a rebuild of the existing .so)
+        def _stale() -> bool:
+            if not os.path.exists(_LIB_PATH):
+                return True
+            so_m = os.path.getmtime(_LIB_PATH)
+            nd = os.path.abspath(_NATIVE_DIR)
+            return any(
+                os.path.getmtime(os.path.join(nd, f)) > so_m
+                for f in os.listdir(nd)
+                if f.endswith((".cpp", ".h")) or f == "Makefile")
+        if _stale() and not _build_attempted:
             _build_attempted = True
             try:
                 subprocess.run(
-                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR), "-B"],
                     capture_output=True, timeout=120, check=True)
             except Exception:
                 return None
@@ -51,6 +62,17 @@ def _load():
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
+        try:
+            _bind(lib)
+        except AttributeError:
+            # stale .so missing newer symbols and rebuild unavailable:
+            # honor the documented downgrade-to-fallbacks contract
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib) -> None:
         lib.og_lz4_max_compressed.restype = ctypes.c_int64
         lib.og_lz4_max_compressed.argtypes = [ctypes.c_int64]
         for fn in (lib.og_lz4_compress, lib.og_lz4_decompress):
@@ -73,8 +95,14 @@ def _load():
         lib.og_ti_search.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
-        _lib = lib
-        return _lib
+        lib.og_gorilla_encode.restype = ctypes.c_int64
+        lib.og_gorilla_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.og_gorilla_decode.restype = ctypes.c_int64
+        lib.og_gorilla_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
 
 
 def native_available() -> bool:
@@ -345,3 +373,44 @@ class TextIndexReader:
             self.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------------------- gorilla
+
+def gorilla_encode(values: np.ndarray):
+    """Native gorilla XOR encode; returns None when the native library is
+    unavailable (caller falls back to the Python codec — byte-identical
+    output either way)."""
+    lib = _load()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    if len(v) == 0:
+        return b""
+    cap = 16 + 10 * len(v)
+    dst = (ctypes.c_uint8 * cap)()
+    n = lib.og_gorilla_encode(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(v), dst, cap)
+    if n < 0:
+        return None
+    return bytes(dst[:n])
+
+
+def gorilla_decode(buf, n: int):
+    """Native gorilla decode; None when unavailable. Raises ValueError on
+    truncated input (same contract as the Python reader running dry)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.float64)
+    if n == 0:
+        return out
+    raw = buf if isinstance(buf, bytes) else bytes(buf)
+    rc = lib.og_gorilla_decode(
+        raw, len(raw),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n)
+    if rc != 0:
+        raise ValueError("gorilla decode failed (truncated or corrupt "
+                         "input)")
+    return out

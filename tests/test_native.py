@@ -139,3 +139,65 @@ class TestWALLz4:
         batches = list(w2.replay())
         w2.close()
         assert batches == [rows, rows]
+
+
+class TestNativeGorilla:
+    def test_byte_identical_with_python(self, monkeypatch):
+        import opengemini_tpu.native as native
+        from opengemini_tpu.encoding import gorilla
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(3)
+        cases = [np.cumsum(rng.normal(0, 0.1, 5000)),
+                 np.full(100, 2.5),
+                 rng.normal(0, 1e9, 777),
+                 np.array([1.5]),
+                 np.array([0.0, -0.0, np.inf, -np.inf, 1e-308])]
+        for v in cases:
+            enc_native = native.gorilla_encode(v)
+            monkeypatch.setattr(native, "_load", lambda: None)
+            enc_py = gorilla.encode(v)
+            dec_py = gorilla.decode(enc_native, len(v))
+            monkeypatch.undo()
+            assert enc_native == enc_py
+            np.testing.assert_array_equal(dec_py, v)
+            np.testing.assert_array_equal(
+                native.gorilla_decode(enc_py, len(v)), v)
+
+    def test_truncated_input_raises(self):
+        import opengemini_tpu.native as native
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        enc = native.gorilla_encode(np.arange(100.0))
+        with pytest.raises(ValueError):
+            native.gorilla_decode(enc[:10], 100)
+
+    def test_empty(self):
+        import opengemini_tpu.native as native
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        assert native.gorilla_encode(np.empty(0)) == b""
+        assert len(native.gorilla_decode(b"", 0)) == 0
+
+    def test_python_fallback_truncated_also_valueerror(self, monkeypatch):
+        import opengemini_tpu.native as native
+        from opengemini_tpu.encoding import gorilla
+        enc = gorilla.encode(np.arange(100.0))
+        monkeypatch.setattr(native, "_load", lambda: None)
+        with pytest.raises(ValueError):
+            gorilla.decode(enc[:10], 100)
+
+    def test_corrupt_header_rejected(self):
+        import opengemini_tpu.native as native
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        # lead=31, sig=64 header: lead+sig > 64 must be rejected, not UB
+        from opengemini_tpu.encoding.gorilla import _BitWriter
+        w = _BitWriter()
+        w.write(0, 64)          # first value
+        w.write(0b11, 2)
+        w.write(31, 5)
+        w.write(63, 6)          # sig-1=63 → sig=64
+        w.write(0, 64)
+        with pytest.raises(ValueError):
+            native.gorilla_decode(w.finish(), 2)
