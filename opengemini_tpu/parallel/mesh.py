@@ -106,8 +106,27 @@ class DistributedAggregator:
                 self.mesh, v, m, s, ns),
             static_argnames=("ns",))
 
-    def shard_inputs(self, values, valid, seg_ids):
-        """Place host arrays onto the mesh with the canonical shardings."""
+    def shard_inputs(self, values, valid, seg_ids, times=None,
+                     by: str = "series"):
+        """Place host arrays onto the mesh with the canonical shardings.
+
+        by="series": rows in arbitrary (series-hash) order — the DP/shard
+        exchange analog. by="time" (requires `times`): rows sorted so
+        each device holds one contiguous TIME slice — the sequence-
+        parallel analog (ring-attention's time-axis split). Both produce
+        full-segment-space partials merged by the same psum/pmin/pmax
+        collectives, so the partition dimension changes data locality
+        (a time-bounded query touches fewer devices) without touching
+        the merge math."""
+        if by == "time":
+            if times is None:
+                raise ValueError("by='time' requires times")
+            order = np.argsort(np.asarray(times), kind="stable")
+            values = np.asarray(values)[:, order]
+            valid = np.asarray(valid)[:, order]
+            seg_ids = np.asarray(seg_ids)[order]
+        elif by != "series":
+            raise ValueError(f"unknown sharding axis {by!r}")
         sv = NamedSharding(self.mesh, P("field", "data"))
         ss = NamedSharding(self.mesh, P("data"))
         return (jax.device_put(values, sv), jax.device_put(valid, sv),
