@@ -21,8 +21,8 @@ from ..query.ast import (CreateDatabaseStatement, DeleteStatement,
                          FieldRef, SelectField, SelectStatement,
                          ShowStatement)
 from ..query.executor import (classify_select, finalize_partials,
-                              inherit_time_bounds, select_over_result,
-                              transform_raw_result)
+                              inherit_time_bounds, merge_partials,
+                              select_over_result, transform_raw_result)
 from ..query.influxql import format_statement
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
@@ -35,9 +35,11 @@ log = get_logger(__name__)
 
 class ClusterExecutor:
     def __init__(self, meta: MetaClient):
+        from ..query.incremental import IncAggCache
         self.meta = meta
         self._clients: dict[str, RPCClient] = {}
         self._lock = threading.Lock()
+        self.inc_cache = IncAggCache()
 
     def _client(self, addr: str) -> RPCClient:
         with self._lock:
@@ -119,12 +121,11 @@ class ClusterExecutor:
 
     def execute(self, stmt, db: str | None = None,
                 inc_query_id: str | None = None, iter_id: int = 0) -> dict:
-        # inc_query_id/iter_id accepted for HTTP-surface parity; the
-        # cluster path always recomputes (the single-node IncAggCache
-        # lives in QueryExecutor — store-side partials are not yet cached)
         try:
             if isinstance(stmt, SelectStatement):
-                return self._select(stmt, stmt.from_db or db)
+                return self._select(stmt, stmt.from_db or db,
+                                    inc_query_id=inc_query_id,
+                                    iter_id=iter_id)
             if isinstance(stmt, ShowStatement):
                 return self._show(stmt, stmt.on_db or db)
             if isinstance(stmt, CreateDatabaseStatement):
@@ -140,7 +141,9 @@ class ClusterExecutor:
         except (ErrQueryError, GeminiError, RPCError) as e:
             return {"error": str(e)}
 
-    def _select(self, stmt: SelectStatement, db: str | None) -> dict:
+    def _select(self, stmt: SelectStatement, db: str | None,
+                inc_query_id: str | None = None,
+                iter_id: int = 0) -> dict:
         if db is None:
             return {"error": "database required"}
         if stmt.from_subquery is not None:
@@ -155,6 +158,9 @@ class ClusterExecutor:
         mst = stmt.from_measurement
         cs = classify_select(stmt)
         if cs.mode == "agg":
+            if inc_query_id:
+                return self._select_agg_incremental(
+                    stmt, db, mst, cs, inc_query_id, iter_id)
             q = format_statement(stmt)
             resps = self._scatter("store.select_partial", db, {"q": q})
             partials = [r["partial"] for r in resps]
@@ -179,6 +185,57 @@ class ClusterExecutor:
         resps = self._scatter("store.select_raw", db, {"q": q})
         merged = self._merge_raw(sub, resps, names)
         return transform_raw_result(cs, stmt, merged)
+
+    def _select_agg_incremental(self, stmt, db, mst, cs,
+                                inc_query_id: str, iter_id: int) -> dict:
+        """Cluster incremental aggregation: the sql node caches the
+        globally-MERGED partial state (trimmed to complete windows) and
+        re-scatters only `time >= watermark` — the stores re-scan the
+        tail, everything older is served from the cache (same semantics
+        as QueryExecutor._partial_agg_incremental; see
+        query/incremental.py)."""
+        from ..query.ast import BinaryExpr, FieldRef, Literal
+        from ..query.condition import (MAX_TIME, MIN_TIME,
+                                       analyze_condition)
+        from ..query.incremental import (complete_prefix,
+                                         inc_fingerprint, trim_left,
+                                         trim_right)
+        interval = stmt.group_by_interval()
+        cond = analyze_condition(stmt.condition, set())
+        if not interval or not cond.has_time_range \
+                or cond.t_min == MIN_TIME or cond.t_max == MAX_TIME:
+            return {"error": "incremental queries require GROUP BY "
+                             "time() and an explicit time range"}
+        fp = inc_fingerprint(db, mst, stmt, cond)
+        cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
+        cached_p = None
+        if cached is not None and cached.fingerprint == fp:
+            cached_p = trim_left(cached.partial, cond.t_min)
+            if cached_p is not None:
+                cached_p = trim_right(cached_p, cond.t_max)
+
+        def scatter(s) -> list:
+            resps = self._scatter("store.select_partial", db,
+                                  {"q": format_statement(s)})
+            return [r["partial"] for r in resps]
+
+        if cached_p is not None:
+            tail = replace(stmt, condition=BinaryExpr(
+                "and", stmt.condition,
+                BinaryExpr(">=", FieldRef("time"),
+                           Literal(cached.watermark))))
+            fresh = [p for p in scatter(tail) if p is not None]
+            if not fresh:
+                # nothing at/after the watermark: serve the cached
+                # prefix, leave the entry untouched
+                return finalize_partials(stmt, mst, cs, [cached_p])
+            partial = merge_partials([cached_p] + fresh)
+        else:
+            partial = merge_partials(scatter(stmt))
+        trimmed, watermark = complete_prefix(partial)
+        if trimmed is not None:
+            self.inc_cache.put(inc_query_id, fp, trimmed, watermark)
+        return finalize_partials(stmt, mst, cs, [partial])
 
     def _merge_raw(self, stmt: SelectStatement, resps: list,
                    field_order: list[str] | None = None) -> dict:

@@ -34,8 +34,8 @@ from .ast import (AlterRPStatement, Call, FieldRef, Literal, SelectField,
                   SetPasswordStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
-from .incremental import (IncAggCache, complete_prefix, trim_left,
-                          trim_right)
+from .incremental import (IncAggCache, complete_prefix, inc_fingerprint,
+                          trim_left, trim_right)
 from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
@@ -644,17 +644,7 @@ class QueryExecutor:
             raise ErrQueryError(
                 "incremental queries require GROUP BY time() and an "
                 "explicit time range")
-        # fingerprint must be invariant to the time range (dashboards
-        # poll now()-relative ranges), but pin everything else: select
-        # list, dimensions, fill, ordering, and the non-time predicates
-        fp = "|".join([
-            db, mst, repr(stmt.fields), repr(stmt.dimensions),
-            stmt.fill_option, repr(stmt.fill_value),
-            repr((stmt.order_desc, stmt.limit, stmt.offset, stmt.slimit,
-                  stmt.soffset)),
-            repr(sorted((f.key, f.op, f.value)
-                        for f in cond.tag_filters)),
-            repr(cond.residual)])
+        fp = inc_fingerprint(db, mst, stmt, cond)
         cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
         if cached is not None and cached.fingerprint == fp:
             # a now()-relative range slides: drop cached windows outside

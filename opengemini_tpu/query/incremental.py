@@ -30,7 +30,22 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["IncAggCache", "complete_prefix", "trim_left", "trim_right"]
+__all__ = ["IncAggCache", "complete_prefix", "inc_fingerprint",
+           "trim_left", "trim_right"]
+
+
+def inc_fingerprint(db: str, mst: str, stmt, cond) -> str:
+    """Cache key, invariant to the TIME RANGE (dashboards poll
+    now()-relative ranges) but pinning everything else: select list,
+    dimensions, fill, ordering, and the non-time predicates. Shared by
+    the single-node executor and the cluster sql node."""
+    return "|".join([
+        db, mst, repr(stmt.fields), repr(stmt.dimensions),
+        stmt.fill_option, repr(stmt.fill_value),
+        repr((stmt.order_desc, stmt.limit, stmt.offset, stmt.slimit,
+              stmt.soffset)),
+        repr(sorted((f.key, f.op, f.value) for f in cond.tag_filters)),
+        repr(cond.residual)])
 
 
 @dataclass

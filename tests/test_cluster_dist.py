@@ -259,3 +259,30 @@ def test_cluster_percentile_approx_and_sliding(loaded):
               "SELECT sliding_window(max(usage), 2) FROM cpu "
               "WHERE time >= 0 AND time < 8m GROUP BY time(1m), host"):
         _approx_eq(_cluster_result(loaded, q), _ref_result(loaded, q), q)
+
+
+def test_cluster_incremental_agg(loaded):
+    """Cluster inc-agg: cached merged prefix + tail-only re-scatter."""
+    sqlex = loaded["sql"].facade.executor
+    q = ("SELECT count(usage) FROM cpu WHERE time >= 0 AND time < 10m "
+         "GROUP BY time(1m)")
+    stmt = parse_query(q)[0]
+    r0 = sqlex.execute(stmt, "tsbs", inc_query_id="cdash", iter_id=0)
+    plain = sqlex.execute(stmt, "tsbs")
+    assert r0 == plain
+    entry = sqlex.inc_cache.get("cdash")
+    assert entry is not None and entry.watermark > 0
+    # poison a cached complete window to prove iter 1 serves the cache
+    entry.partial["fields"]["usage"]["count"][0, 0] = 999
+    r1 = sqlex.execute(stmt, "tsbs", inc_query_id="cdash", iter_id=1)
+    assert r1["series"][0]["values"][0][1] == 999
+    # fingerprint mismatch recomputes cleanly
+    q2 = ("SELECT count(usage) FROM cpu WHERE time >= 0 AND time < 10m "
+          "GROUP BY time(1m), host")
+    r2 = sqlex.execute(parse_query(q2)[0], "tsbs",
+                       inc_query_id="cdash", iter_id=1)
+    assert "error" not in r2
+    # validation mirrors single node
+    bad = sqlex.execute(parse_query("SELECT count(usage) FROM cpu")[0],
+                        "tsbs", inc_query_id="x", iter_id=0)
+    assert "error" in bad
