@@ -16,13 +16,18 @@ from __future__ import annotations
 import threading
 from dataclasses import replace
 
-from ..query.ast import (CreateDatabaseStatement, DeleteStatement,
+from ..query.ast import (BinaryExpr, Literal,
+                         CreateDatabaseStatement, DeleteStatement,
                          DropDatabaseStatement, DropMeasurementStatement,
                          FieldRef, SelectField, SelectStatement,
                          ShowStatement)
+from ..query.condition import analyze_condition
 from ..query.executor import (classify_select, finalize_partials,
                               inherit_time_bounds, merge_partials,
                               select_over_result, transform_raw_result)
+from ..query.incremental import (IncAggCache, complete_prefix,
+                                 inc_fingerprint, inc_validate,
+                                 trim_left, trim_right)
 from ..query.influxql import format_statement
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
@@ -35,7 +40,6 @@ log = get_logger(__name__)
 
 class ClusterExecutor:
     def __init__(self, meta: MetaClient):
-        from ..query.incremental import IncAggCache
         self.meta = meta
         self._clients: dict[str, RPCClient] = {}
         self._lock = threading.Lock()
@@ -194,18 +198,10 @@ class ClusterExecutor:
         tail, everything older is served from the cache (same semantics
         as QueryExecutor._partial_agg_incremental; see
         query/incremental.py)."""
-        from ..query.ast import BinaryExpr, FieldRef, Literal
-        from ..query.condition import (MAX_TIME, MIN_TIME,
-                                       analyze_condition)
-        from ..query.incremental import (complete_prefix,
-                                         inc_fingerprint, trim_left,
-                                         trim_right)
-        interval = stmt.group_by_interval()
         cond = analyze_condition(stmt.condition, set())
-        if not interval or not cond.has_time_range \
-                or cond.t_min == MIN_TIME or cond.t_max == MAX_TIME:
-            return {"error": "incremental queries require GROUP BY "
-                             "time() and an explicit time range"}
+        err = inc_validate(stmt, cond)
+        if err is not None:
+            return {"error": err}
         fp = inc_fingerprint(db, mst, stmt, cond)
         cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
         cached_p = None

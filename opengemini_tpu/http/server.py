@@ -267,13 +267,20 @@ class HttpServer:
                     return 200, {"success": True, "written": n}
                 t_min = int(params["from"]) if "from" in params else None
                 t_max = int(params["to"]) if "to" in params else None
-                if op == "logs":
+                if op in ("logs", "logbycursor"):
+                    scroll = decode_cursor(params["cursor"]) \
+                        if "cursor" in params else None
                     rows = stream.query(
                         params.get("q", ""), t_min, t_max,
                         limit=int(params.get("limit", 100)),
                         reverse=params.get("reverse", "true") != "false",
-                        highlight=params.get("highlight") == "true")
-                    return 200, {"logs": rows, "count": len(rows)}
+                        highlight=params.get("highlight") == "true",
+                        scroll=scroll)
+                    out = {"logs": rows, "count": len(rows)}
+                    if rows:
+                        out["cursor"] = encode_cursor(
+                            int(rows[-1]["cursor"]))
+                    return 200, out
                 if op == "histogram":
                     if t_min is None or t_max is None:
                         return 400, {"error": "from and to required"}

@@ -424,15 +424,22 @@ class LogStream:
 
     def _scan_matches(self, clauses, t_min: int | None,
                       t_max: int | None, t_max_inclusive: bool,
-                      reverse: bool = False):
+                      reverse: bool = False, scroll: int | None = None):
         """Yield matching LogRecords: the shared time-prune → bloom-prune
         → CLV-search → per-record time-filter pipeline behind query/
-        histogram/analytics. Callers hold the stream lock (@_locked)."""
+        histogram/analytics. Callers hold the stream lock (@_locked).
+        `scroll` prunes to records strictly past that seq in scan
+        direction — whole segments out of seq range are skipped before
+        any index search or record decode."""
         plain = [t for ty, term in clauses if ty != FUZZY
                  for t, _p in tokenize(term)]
         segs = self.segments
         for seg in (reversed(segs) if reverse else segs):
             if seg.n == 0:
+                continue
+            if scroll is not None and (
+                    seg.base_seq >= scroll if reverse
+                    else seg.base_seq + seg.n <= scroll + 1):
                 continue
             if t_min is not None and seg.max_time < t_min:
                 continue
@@ -447,6 +454,9 @@ class LogStream:
                 continue
             self.cache.touch((self.repo, self.name, seg.seg_id), seg)
             for s in (seqs[::-1] if reverse else seqs):
+                if scroll is not None and (
+                        s >= scroll if reverse else s <= scroll):
+                    continue
                 r = seg.record_by_seq(int(s))
                 if r is None:
                     continue
@@ -461,15 +471,18 @@ class LogStream:
     @_locked
     def query(self, q: str = "", t_min: int | None = None,
               t_max: int | None = None, limit: int = 100,
-              reverse: bool = True, highlight: bool = False
-              ) -> list[dict]:
+              reverse: bool = True, highlight: bool = False,
+              scroll: int | None = None) -> list[dict]:
         """Keyword search (reference serveQueryLog): time-pruned segments
-        → bloom prune → CLV search → records, newest first by default."""
+        → bloom prune → CLV search → records, newest first by default.
+        `scroll` pages a search (reference serveQueryLogByCursor): only
+        records strictly past that seq in scan direction are returned —
+        pass the previous page's last cursor to continue."""
         clauses = parse_log_query(q)
         out: list[LogRecord] = []
         for r in self._scan_matches(clauses, t_min, t_max,
                                     t_max_inclusive=True,
-                                    reverse=reverse):
+                                    reverse=reverse, scroll=scroll):
             out.append(r)
             if len(out) >= limit:
                 break

@@ -35,7 +35,7 @@ from .ast import (AlterRPStatement, Call, FieldRef, Literal, SelectField,
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
 from ..ops.ogsketch import OGSketch
 from .incremental import (IncAggCache, complete_prefix, inc_fingerprint,
-                          trim_left, trim_right)
+                          inc_validate, trim_left, trim_right)
 from .functions import (AGG_FUNCS, MOMENT_AGGS, SKETCH_AGGS, AggItem,
                         AggRef, BinOp, ClassifiedSelect, MathExpr, Num,
                         RawRef, Transform, apply_math,
@@ -638,12 +638,9 @@ class QueryExecutor:
         incremental.py for semantics."""
         import copy
 
-        interval = stmt.group_by_interval()
-        if not interval or not cond.has_time_range \
-                or cond.t_min == MIN_TIME or cond.t_max == MAX_TIME:
-            raise ErrQueryError(
-                "incremental queries require GROUP BY time() and an "
-                "explicit time range")
+        err = inc_validate(stmt, cond)
+        if err is not None:
+            raise ErrQueryError(err)
         fp = inc_fingerprint(db, mst, stmt, cond)
         cached = self.inc_cache.get(inc_query_id) if iter_id > 0 else None
         if cached is not None and cached.fingerprint == fp:

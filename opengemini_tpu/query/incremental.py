@@ -31,7 +31,18 @@ from dataclasses import dataclass, field
 import numpy as np
 
 __all__ = ["IncAggCache", "complete_prefix", "inc_fingerprint",
-           "trim_left", "trim_right"]
+           "inc_validate", "trim_left", "trim_right"]
+
+
+def inc_validate(stmt, cond) -> str | None:
+    """Both executors require GROUP BY time() and explicit bounds for an
+    incremental query; returns the error message, or None when valid."""
+    from .condition import MAX_TIME, MIN_TIME
+    if not stmt.group_by_interval() or not cond.has_time_range \
+            or cond.t_min == MIN_TIME or cond.t_max == MAX_TIME:
+        return ("incremental queries require GROUP BY time() and an "
+                "explicit time range")
+    return None
 
 
 def inc_fingerprint(db: str, mst: str, stmt, cond) -> str:

@@ -478,3 +478,31 @@ def test_consume_cursors_stale_cursor(stream):
     ranges = stream.consume_cursors(2, from_seq=99)
     assert ranges[-1]["from"] <= ranges[-1]["to"]
     assert all(r["from"] <= r["to"] for r in ranges)
+
+
+def test_query_scroll_pagination(stream):
+    page1 = stream.query("", limit=2)                  # newest first
+    assert [r["cursor"] for r in page1] == [4, 3]
+    page2 = stream.query("", limit=2, scroll=page1[-1]["cursor"])
+    assert [r["cursor"] for r in page2] == [2, 1]
+    page3 = stream.query("", limit=2, scroll=page2[-1]["cursor"])
+    assert [r["cursor"] for r in page3] == [0]
+    # forward direction pages upward
+    fwd = stream.query("", limit=2, reverse=False, scroll=1)
+    assert [r["cursor"] for r in fwd] == [2, 3]
+
+
+def test_http_logbycursor(server):
+    base = f"http://{server}"
+    _req("POST", f"{base}/api/v1/repository/rp2")
+    _req("POST", f"{base}/api/v1/logstream/rp2/sp2")
+    _req("POST", f"{base}/repo/rp2/logstreams/sp2/records",
+         json.dumps([{"content": f"x{i}", "timestamp": i * MIN}
+                     for i in range(5)]).encode())
+    code, p1 = _req(
+        "GET", f"{base}/repo/rp2/logstreams/sp2/logbycursor?limit=2")
+    assert [r["content"] for r in p1["logs"]] == ["x4", "x3"]
+    code, p2 = _req(
+        "GET", f"{base}/repo/rp2/logstreams/sp2/logbycursor"
+               f"?limit=2&cursor={p1['cursor']}")
+    assert [r["content"] for r in p2["logs"]] == ["x2", "x1"]
