@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import re
 import threading
 import time
 import urllib.parse
@@ -33,6 +34,15 @@ from ..utils.errors import GeminiError
 from ..utils.lineprotocol import PRECISION_NS, parse_lines
 
 log = get_logger(__name__)
+
+_PASSWORD_RE = re.compile(
+    r"(password(?:\s+for\s+\S+\s*=)?\s*)'(?:[^']|'')*'", re.IGNORECASE)
+
+
+def _redact_passwords(qtext: str) -> str:
+    """WITH PASSWORD '...' / SET PASSWORD FOR u = '...' → '[REDACTED]'
+    before the query text reaches any log line."""
+    return _PASSWORD_RE.sub(r"\1'[REDACTED]'", qtext)
 
 
 class HttpServer:
@@ -411,7 +421,10 @@ class HttpServer:
             except ParseError as e:
                 self._bump("query_errors")
                 return 400, {"error": f"error parsing query: {e}"}
-            self.plan_cache.put(qtext, stmts)
+            # user statements carry plaintext passwords — never retain
+            # the raw text in the cache (reference redacts them too)
+            if not any(self._is_user_stmt(s) for s in stmts):
+                self.plan_cache.put(qtext, stmts)
         results = []
         for i, stmt in enumerate(stmts):
             try:
@@ -431,7 +444,8 @@ class HttpServer:
                                                 inc_query_id=stmt_qid,
                                                 iter_id=iter_id)
             except Exception as e:  # an executor bug must not kill the conn
-                log.exception("query execution failed: %s", qtext)
+                log.exception("query execution failed: %s",
+                              _redact_passwords(qtext))
                 res = {"error": f"internal error: {e}"}
             res = dict(res)
             res["statement_id"] = i
@@ -570,7 +584,15 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # route to our logger, not stderr
-        log.debug("%s " + fmt, self.address_string(), *args)
+        # request lines can carry URL-encoded passwords (GET /query with
+        # CREATE USER, or influx u/p params) — redact before logging
+        def _clean(a):
+            if not isinstance(a, str):
+                return a
+            a = _redact_passwords(urllib.parse.unquote_plus(a))
+            return re.sub(r"([?&]p=)[^& ]*", r"\1[REDACTED]", a)
+        log.debug("%s " + fmt, self.address_string(),
+                  *(_clean(a) for a in args))
 
     # ---- helpers ---------------------------------------------------------
 
@@ -652,6 +674,29 @@ class _Handler(BaseHTTPRequestHandler):
             return False, None
         return True, user
 
+    def _admin_gate(self, user) -> bool:
+        """403 unless auth is off or the user is admin — /debug/ctrl and
+        logstore catalog mutations mirror the admin_only statement list
+        (reference httpd privilege checks)."""
+        srv = self.server_ref
+        if not srv.auth_required() or (user is not None and user.admin):
+            return True
+        # drain any unread body and close: replying mid-body desyncs
+        # HTTP/1.1 keep-alive (same hazard handled in _auth's 401 path)
+        try:
+            self._body()
+        except Exception:
+            pass
+        self.close_connection = True
+        self._reply(403, {"error": "admin privilege required"},
+                    headers={"Connection": "close"})
+        return False
+
+    @staticmethod
+    def _is_logstore_catalog(path: str) -> bool:
+        return (path.startswith("/api/v1/repository")
+                or path.startswith("/api/v1/logstream"))
+
     def _body(self) -> bytes:
         # cached: _auth may need form-body credentials before the route
         # handler consumes the same body
@@ -699,6 +744,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, srv.stats)
             return
         if path == "/debug/ctrl":
+            if not self._admin_gate(user):
+                return
             p = self._params()
             code, payload = srv.sysctrl.handle(p.pop("mod", ""), p)
             self._reply(code, payload)
@@ -750,11 +797,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, payload)
             return
         if path == "/debug/ctrl":
+            if not self._admin_gate(user):
+                return
             p = self._params()
             code, payload = srv.sysctrl.handle(p.pop("mod", ""), p)
             self._reply(code, payload)
             return
         if self._is_logstore(path):
+            if self._is_logstore_catalog(path) \
+                    and not self._admin_gate(user):
+                return
             try:
                 body = self._body()
             except Exception as e:
@@ -782,6 +834,8 @@ class _Handler(BaseHTTPRequestHandler):
         if not ok:
             return
         if self._is_logstore(path):
+            if not self._admin_gate(user):
+                return
             code, payload = self.server_ref.handle_logstore(
                 "DELETE", path, self._params(), b"")
             self._reply(code, payload)
@@ -794,6 +848,8 @@ class _Handler(BaseHTTPRequestHandler):
         if not ok:
             return
         if self._is_logstore(path):
+            if not self._admin_gate(user):
+                return
             try:
                 body = self._body()
             except Exception as e:

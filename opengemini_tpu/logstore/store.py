@@ -652,6 +652,18 @@ class Repository:
         self.props: dict = {}
 
 
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _validate_name(kind: str, name: str) -> None:
+    """Repo/stream names become directory components under the logstore
+    root — reject anything that could traverse out of it ('..' resolves
+    to the engine data dir; a later DELETE would rmtree it)."""
+    if (not _NAME_RE.fullmatch(name) or name in (".", "..")
+            or os.sep in name or (os.altsep and os.altsep in name)):
+        raise ValueError(f"invalid {kind} name {name!r}")
+
+
 class LogStore:
     """Repository/logstream catalog rooted at a directory (reference
     repository≈database, logstream≈measurement with TTL)."""
@@ -688,6 +700,7 @@ class LogStore:
     # ---- repository CRUD (serveCreateRepository et al.)
 
     def create_repository(self, name: str) -> None:
+        _validate_name("repository", name)
         with self._lock:
             if name in self.repos:
                 raise ValueError(f"repository {name} already exists")
@@ -713,6 +726,7 @@ class LogStore:
 
     def create_logstream(self, repo: str, name: str,
                          ttl_days: float = DEFAULT_TTL_DAYS) -> None:
+        _validate_name("logstream", name)
         with self._lock:
             r = self._repo(repo)
             if name in r.streams:

