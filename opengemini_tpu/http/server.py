@@ -589,8 +589,10 @@ class _Handler(BaseHTTPRequestHandler):
         def _clean(a):
             if not isinstance(a, str):
                 return a
-            a = _redact_passwords(urllib.parse.unquote_plus(a))
-            return re.sub(r"([?&]p=)[^& ]*", r"\1[REDACTED]", a)
+            # redact p= BEFORE unquoting: an encoded '&'/'+' inside the
+            # password would otherwise split it and leak the tail
+            a = re.sub(r"([?&]p=)[^&\s]*", r"\1[REDACTED]", a)
+            return _redact_passwords(urllib.parse.unquote_plus(a))
         log.debug("%s " + fmt, self.address_string(),
                   *(_clean(a) for a in args))
 

@@ -686,6 +686,8 @@ class QueryExecutor:
         """
         from ..ops import AggSpec, segment_aggregate, window_ids, pad_bucket
         from ..ops.segment_agg import pad_rows
+        from .scan import (PREAGG_STATES, decode_pool, materialize_scan,
+                           plan_rowstore_scan)
 
         aggs = cs.aggs
         interval = stmt.group_by_interval()
@@ -735,9 +737,11 @@ class QueryExecutor:
                 data_tmin = min(data_tmin, rec.min_time)
                 data_tmax = max(data_tmax, rec.max_time)
                 chunks.append({"rec": rec, "gi": gi})
+            scan_plan = None
         else:
-            # row-store path: tagsets from the series index, one chunk
-            # per series
+            # row-store path: tagsets from the series index, then a
+            # batched chunk-meta plan (scan.py — the initGroupCursors /
+            # agg_tagset_cursor analog; no per-series Python loop)
             per_shard: list[tuple[object, list[tuple[int, int]]]] = []
             for s in shards:
                 ts = s.index.group_by_tagsets(mst, group_tags,
@@ -750,27 +754,17 @@ class QueryExecutor:
             if self.resources is not None:
                 self.resources.check_series(
                     sum(len(p) for _s, p in per_shard))
-            for s, pairs in per_shard:
-                for sid, gi in pairs:
-                    if ctx is not None:
-                        ctx.check()
-                    rec = s.read_series(mst, sid, needed_fields or None,
-                                        t_lo, t_hi)
-                    if rec is None or rec.num_rows == 0:
-                        continue
-                    if cond.residual is not None:
-                        mask = eval_residual(cond.residual, rec)
-                        if not mask.any():
-                            continue
-                        rec = rec.take(np.nonzero(mask)[0])
-                    data_tmin = min(data_tmin, rec.min_time)
-                    data_tmax = max(data_tmax, rec.max_time)
-                    chunks.append({"rec": rec, "gi": gi})
+            scan_plan = plan_rowstore_scan(per_shard, mst, t_lo, t_hi,
+                                           ctx=ctx)
+            if scan_plan.has_rows:
+                data_tmin = min(data_tmin, scan_plan.data_tmin)
+                data_tmax = max(data_tmax, scan_plan.data_tmax)
         G = len(global_groups)
-        if scan_sp is not None:
-            scan_sp.end_ns = _now_ns()
-            scan_sp.add(shards=len(shards), chunks=len(chunks), groups=G)
-        if not chunks or G == 0:
+        have_data = chunks or (scan_plan is not None and scan_plan.has_rows)
+        if not have_data or G == 0:
+            if scan_sp is not None:
+                scan_sp.end_ns = _now_ns()
+                scan_sp.add(shards=len(shards), groups=G)
             return None
 
         # window layout
@@ -792,23 +786,6 @@ class QueryExecutor:
             W = 1
         interval_eff = interval if interval else MAX_TIME
 
-        n_rows = sum(c["rec"].num_rows for c in chunks)
-        times = np.empty(n_rows, dtype=np.int64)
-        gids = np.empty(n_rows, dtype=np.int64)
-        pos = 0
-        for c in chunks:
-            n = c["rec"].num_rows
-            times[pos:pos + n] = c["rec"].times
-            gids[pos:pos + n] = c["gi"]
-            pos += n
-
-        w = np.asarray(window_ids(times, start, interval_eff, W))
-        seg = np.where(w < W, gids * W + w, G * W).astype(np.int64)
-        num_segments = G * W
-        # seg ids are NOT sorted in general (multi-shard/multi-series
-        # interleave); XLA's indices_are_sorted contract would be violated
-        seg_sorted = bool(np.all(seg[:-1] <= seg[1:])) if len(seg) else True
-
         # count is always computed: empty-window masking and fill need it
         spec_names = {"count"}
         for a in aggs:
@@ -829,6 +806,52 @@ class QueryExecutor:
                                if a.func in ("top", "bottom")}
                             | {a.field for a in aggs if a.needs_sketch})
 
+        scanres = None
+        if scan_plan is not None:
+            # pre-agg metadata answers whole segments only when the
+            # kernel states it carries suffice and no row-level filter
+            # or raw-slice collection needs the actual points (the
+            # agg_tagset_cursor fast path, agg_tagset_cursor.go:265)
+            allow_preagg = (cond.residual is None and not raw_fields
+                            and spec_names <= PREAGG_STATES)
+            scanres = materialize_scan(
+                scan_plan, mst, needed_fields, t_lo, t_hi,
+                int(start), int(interval_eff), W, G * W, allow_preagg,
+                ctx=ctx, pool=decode_pool())
+            if cond.residual is not None and scanres.n_rows:
+                mask = eval_residual(cond.residual, scanres.to_record())
+                if not mask.all():
+                    scanres.apply_mask(np.asarray(mask, dtype=bool))
+            times = scanres.times
+            gids = scanres.gids
+            n_rows = scanres.n_rows
+        else:
+            n_rows = sum(c["rec"].num_rows for c in chunks)
+            times = np.empty(n_rows, dtype=np.int64)
+            gids = np.empty(n_rows, dtype=np.int64)
+            pos = 0
+            for c in chunks:
+                n = c["rec"].num_rows
+                times[pos:pos + n] = c["rec"].times
+                gids[pos:pos + n] = c["gi"]
+                pos += n
+        if scan_sp is not None:
+            scan_sp.end_ns = _now_ns()
+            scan_sp.add(shards=len(shards), groups=G, rows=n_rows)
+            if scanres is not None:
+                sst = scanres.stats
+                scan_sp.add(preagg_segments=sst.preagg_segments,
+                            decoded_segments=sst.decoded_segments,
+                            merged_series=sst.merged_series,
+                            direct_series=sst.direct_series)
+
+        w = np.asarray(window_ids(times, start, interval_eff, W))
+        seg = np.where(w < W, gids * W + w, G * W).astype(np.int64)
+        num_segments = G * W
+        # seg ids are NOT sorted in general (multi-shard/multi-series
+        # interleave); XLA's indices_are_sorted contract would be violated
+        seg_sorted = bool(np.all(seg[:-1] <= seg[1:])) if len(seg) else True
+
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
         raw_slices: dict[str, dict] = {}
@@ -838,20 +861,30 @@ class QueryExecutor:
         npad = pad_bucket(n_rows)
         seg_p, times_p = pad_rows([seg, times], npad, seg_fill=num_segments)
         for fname in needed_fields:
-            vals = np.zeros(n_rows, dtype=np.float64)
-            valid = np.zeros(n_rows, dtype=np.bool_)
-            ftype = DataType.FLOAT
-            pos = 0
-            for c in chunks:
-                rec = c["rec"]
-                n = rec.num_rows
-                col = rec.column(fname)
-                if col is not None and col.values is not None:
-                    vals[pos:pos + n] = col.values.astype(np.float64)
-                    valid[pos:pos + n] = col.valid
-                    if col.type == DataType.INTEGER:
-                        ftype = DataType.INTEGER
-                pos += n
+            if scanres is not None:
+                got = scanres.fields.get(fname)
+                if got is None:       # string field (residual-only)
+                    vals = np.zeros(n_rows, dtype=np.float64)
+                    valid = np.zeros(n_rows, dtype=np.bool_)
+                else:
+                    vals, valid = got
+                    vals = vals.astype(np.float64, copy=False)
+                ftype = scanres.field_types.get(fname, DataType.FLOAT)
+            else:
+                vals = np.zeros(n_rows, dtype=np.float64)
+                valid = np.zeros(n_rows, dtype=np.bool_)
+                ftype = DataType.FLOAT
+                pos = 0
+                for c in chunks:
+                    rec = c["rec"]
+                    n = rec.num_rows
+                    col = rec.column(fname)
+                    if col is not None and col.values is not None:
+                        vals[pos:pos + n] = col.values.astype(np.float64)
+                        valid[pos:pos + n] = col.valid
+                        if col.type == DataType.INTEGER:
+                            ftype = DataType.INTEGER
+                    pos += n
             vals_p, valid_p = pad_rows([vals, valid], npad, seg_fill=0)
             res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
                                     num_segments, spec,
@@ -878,6 +911,25 @@ class QueryExecutor:
                 v = getattr(res, k)
                 if v is not None:
                     st[k] = np.asarray(v).reshape(G, W)
+            # fold in segments answered from pre-agg metadata (pre-agg
+            # mode guarantees st keys ⊆ {count, sum, min, max})
+            pg = (scanres.preagg.get(fname)
+                  if scanres is not None and scanres.preagg else None)
+            if pg is not None:
+                if "count" in st:
+                    st["count"] = st["count"] + \
+                        pg["count"][:G * W].reshape(G, W)
+                if "sum" in st:
+                    st["sum"] = st["sum"] + pg["sum"][:G * W].reshape(G, W)
+                if "min" in st:
+                    st["min"] = np.minimum(
+                        st["min"], pg["min"][:G * W].reshape(G, W))
+                if "max" in st:
+                    st["max"] = np.maximum(
+                        st["max"], pg["max"][:G * W].reshape(G, W))
+                ft = scanres.field_types.get(fname)
+                if ft is not None:
+                    field_types[fname] = ft
             fields_out[fname] = st
         partial = {
             "group_tags": group_tags,

@@ -1,0 +1,205 @@
+"""Batched row-store scan: pre-agg metadata fast path, overlap fallback,
+and equivalence with the per-series merge path (round-2 rework — the
+agg_tagset_cursor / initGroupCursors analog, VERDICT r1 items 1 & 5)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.query.scan import (materialize_scan,
+                                       plan_rowstore_scan)
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path):
+    # small segments so multi-segment chunks appear at test scale
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, "db0")
+
+
+def explain(ex, text):
+    (stmt,) = parse_query("EXPLAIN ANALYZE " + text)
+    return ex.execute(stmt, "db0")
+
+
+def seed_regular(eng, hosts=4, points=256, step=10 * 10**9, flush=True):
+    lines = []
+    rng = np.random.default_rng(7)
+    vals = rng.normal(50, 10, size=(hosts, points))
+    for h in range(hosts):
+        for i in range(points):
+            lines.append(f"cpu,host=h{h} usage={float(vals[h, i])!r},"
+                         f"c={i}i {i * step}")
+    write(eng, "\n".join(lines))
+    if flush:
+        for s in eng.database("db0").all_shards():
+            s.flush()
+    return vals
+
+
+def _span_text(res):
+    import json
+    return json.dumps(res)
+
+
+def test_preagg_path_fires_and_matches(db):
+    """count/sum/min/max/mean over flushed TSSP answer interior segments
+    from pre-agg metadata; result identical to the decoded path."""
+    eng, ex = db
+    vals = seed_regular(eng)
+    text = ("SELECT mean(usage), count(usage), sum(usage), min(usage), "
+            "max(usage) FROM cpu WHERE time >= 0 AND time < 2560s "
+            "GROUP BY host")
+    res = q(ex, text)
+    series = {tuple(s["tags"].items()): s["values"][0]
+              for s in res["series"]}
+    for h in range(4):
+        row = series[(("host", f"h{h}"),)]
+        v = vals[h]
+        assert row[2] == 256                       # count
+        assert np.isclose(row[1], v.mean())
+        assert np.isclose(row[3], v.sum())
+        assert row[4] == v.min()
+        assert row[5] == v.max()
+    # the fast path actually fired: EXPLAIN ANALYZE reader_scan span
+    ares = explain(ex, text)
+    txt = _span_text(ares)
+    assert "preagg_segments" in txt
+    import re
+    m = re.search(r'preagg_segments=(\d+)', txt)
+    assert m and int(m.group(1)) >= 4 * 4  # 4 hosts x 4 full segments
+
+
+def test_preagg_disabled_by_residual_and_selectors(db):
+    eng, ex = db
+    seed_regular(eng)
+    # residual predicate needs row values
+    ares = explain(ex, "SELECT count(usage) FROM cpu WHERE usage > 50")
+    import re
+    m = re.search(r'preagg_segments=(\d+)', _span_text(ares))
+    assert m is None or int(m.group(1)) == 0
+    # first() needs row values
+    ares = explain(ex, "SELECT first(usage) FROM cpu")
+    m = re.search(r'preagg_segments=(\d+)', _span_text(ares))
+    assert m is None or int(m.group(1)) == 0
+
+
+def test_window_grouping_equivalence(db):
+    """GROUP BY time(1m): segments spanning window boundaries decode,
+    interior single-window segments use pre-agg; totals must match the
+    plain numpy reference exactly for count and to fp tolerance for sum."""
+    eng, ex = db
+    vals = seed_regular(eng)  # 10s step, 256 pts → ~42.6 min span
+    res = q(ex, "SELECT count(usage), sum(usage) FROM cpu "
+               "WHERE time >= 0 AND time < 2560s GROUP BY time(1m), host")
+    for s in res["series"]:
+        h = int(s["tags"]["host"][1:])
+        per_min = {}
+        for i in range(256):
+            per_min.setdefault(i * 10 // 60, []).append(vals[h, i])
+        for row in s["values"]:
+            wi = row[0] // MIN
+            assert row[1] == len(per_min.get(wi, []))
+            assert np.isclose(row[2], sum(per_min.get(wi, [0.0])))
+
+
+def test_overlap_falls_back_to_merge(db):
+    """Duplicate timestamps across flush generations must keep
+    newest-wins semantics (merged read_series fallback)."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={i} {i * MIN}" for i in range(8)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    # overwrite the middle points in a second generation
+    write(eng, "\n".join(f"m,host=a v={100 + i} {i * MIN}"
+                         for i in range(3, 6)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT sum(v), count(v) FROM m")
+    total = sum(range(8)) - sum(range(3, 6)) + sum(100 + i
+                                                   for i in range(3, 6))
+    assert res["series"][0]["values"][0][1] == total
+    assert res["series"][0]["values"][0][2] == 8
+
+
+def test_memtable_and_file_mix(db):
+    """Unflushed rows merge with flushed segments (disjoint ranges →
+    direct path, no merge fallback)."""
+    eng, ex = db
+    write(eng, "\n".join(f"m,host=a v={i} {i * MIN}" for i in range(10)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    write(eng, "\n".join(f"m,host=a v={i} {i * MIN}"
+                         for i in range(10, 15)))
+    res = q(ex, "SELECT count(v), sum(v) FROM m")
+    assert res["series"][0]["values"][0][1] == 15
+    assert res["series"][0]["values"][0][2] == sum(range(15))
+
+
+def test_time_range_cuts_inside_segment(db):
+    eng, ex = db
+    seed_regular(eng, hosts=1, points=200)
+    # range cuts mid-segment (64-row segments, 10s step)
+    res = q(ex, "SELECT count(usage) FROM cpu "
+               "WHERE time >= 95s AND time <= 1005s")
+    # points at 100,110,...,1000s inclusive
+    assert res["series"][0]["values"][0][1] == 91
+
+
+def test_string_residual_over_scan(db):
+    eng, ex = db
+    write(eng, 'ev,host=a level="err",v=1 60000000000\n'
+               'ev,host=a level="ok",v=2 120000000000\n'
+               'ev,host=a level="err",v=3 180000000000')
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT count(v) FROM ev WHERE level = 'err'")
+    assert res["series"][0]["values"][0][1] == 2
+
+
+def test_plan_classifies_sources(db):
+    eng, ex = db
+    seed_regular(eng, hosts=2, points=100)
+    db_obj = eng.database("db0")
+    shards = db_obj.all_shards()
+    per_shard = []
+    for s in shards:
+        pairs = []
+        for key, sids in s.index.group_by_tagsets("cpu", ["host"], []):
+            for sid in sids.tolist():
+                pairs.append((sid, 0))
+        per_shard.append((s, pairs))
+    plan = plan_rowstore_scan(per_shard, "cpu", None, None)
+    assert plan.has_rows
+    assert plan.data_tmin == 0
+    assert plan.data_tmax == 99 * 10 * 10**9
+    assert all(not sp.merged for sp in plan.series)
+    out = materialize_scan(plan, "cpu", ["usage"], None, None,
+                           0, 1 << 62, 1, 2, True)
+    # windowless query, everything preagg-eligible except ragged tails
+    assert out.stats.preagg_segments > 0
+    assert out.preagg is not None
+
+
+def test_int_field_preagg_exact(db):
+    eng, ex = db
+    seed_regular(eng)
+    res = q(ex, "SELECT sum(c), count(c) FROM cpu GROUP BY host")
+    for s in res["series"]:
+        assert s["values"][0][1] == sum(range(256))
+        assert s["values"][0][2] == 256

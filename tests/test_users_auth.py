@@ -304,7 +304,6 @@ def test_password_redaction_and_no_plancache(tmp_path):
     assert "hunter2" not in _redact_passwords(q)
     q2 = "SET PASSWORD FOR bob = 'se''cret'"
     assert "cret" not in _redact_passwords(q2)
-    assert "\n" not in _redact_passwords("..\n") or True
     # user statements are never retained in the plan cache
     eng = Engine(str(tmp_path / "data"))
     srv = HttpServer(eng, port=0)
