@@ -589,10 +589,14 @@ class _Handler(BaseHTTPRequestHandler):
         def _clean(a):
             if not isinstance(a, str):
                 return a
-            # redact p= BEFORE unquoting: an encoded '&'/'+' inside the
-            # password would otherwise split it and leak the tail
+            # redact p= BEFORE unquoting (an encoded '&'/'+' inside the
+            # password would otherwise split it and leak the tail) AND
+            # after (an encoded parameter NAME '%70=' only becomes 'p='
+            # once unquoted)
             a = re.sub(r"([?&]p=)[^&\s]*", r"\1[REDACTED]", a)
-            return _redact_passwords(urllib.parse.unquote_plus(a))
+            a = urllib.parse.unquote_plus(a)
+            a = re.sub(r"([?&]p=)[^&\s]*", r"\1[REDACTED]", a)
+            return _redact_passwords(a)
         log.debug("%s " + fmt, self.address_string(),
                   *(_clean(a) for a in args))
 

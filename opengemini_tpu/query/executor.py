@@ -814,14 +814,25 @@ class QueryExecutor:
             # agg_tagset_cursor fast path, agg_tagset_cursor.go:265)
             allow_preagg = (cond.residual is None and not raw_fields
                             and spec_names <= PREAGG_STATES)
+            # dense blocks feed pure axis reductions — usable whenever
+            # no per-point state (first/last/extremum times) or row
+            # filter is needed
+            allow_dense = (cond.residual is None and not raw_fields
+                           and bool(interval)
+                           and spec_names <= PREAGG_STATES | {"sumsq"})
             scanres = materialize_scan(
                 scan_plan, mst, needed_fields, t_lo, t_hi,
                 int(start), int(interval_eff), W, G * W, allow_preagg,
-                ctx=ctx, pool=decode_pool())
+                allow_dense=allow_dense, ctx=ctx, pool=decode_pool())
             if cond.residual is not None and scanres.n_rows:
                 mask = eval_residual(cond.residual, scanres.to_record())
                 if not mask.all():
                     scanres.apply_mask(np.asarray(mask, dtype=bool))
+                if scanres.n_rows == 0:
+                    # every row filtered out → empty result, not a grid
+                    # of null windows (preagg/dense are disabled when a
+                    # residual exists, so nothing else contributes)
+                    return None
             times = scanres.times
             gids = scanres.gids
             n_rows = scanres.n_rows
@@ -842,6 +853,8 @@ class QueryExecutor:
                 sst = scanres.stats
                 scan_sp.add(preagg_segments=sst.preagg_segments,
                             decoded_segments=sst.decoded_segments,
+                            dense_segments=sst.dense_segments,
+                            dense_rows=sst.dense_rows,
                             merged_series=sst.merged_series,
                             direct_series=sst.direct_series)
 
@@ -894,6 +907,24 @@ class QueryExecutor:
             if fname in raw_fields:
                 raw_slices[fname] = _collect_raw_slices(
                     seg, vals, valid, times, G, W)
+        # dense groups: (S, P) axis reductions, results scattered into
+        # the state grids host-side (S is tiny — N/P)
+        dense_out: dict[str, list] = {}
+        if scanres is not None and scanres.dense:
+            from ..ops import dense_window_aggregate
+            for P, grp in sorted(scanres.dense.items()):
+                S = len(grp.cells)
+                Spad = pad_bucket(S, minimum=128)
+                for fname, (dvals, dvalid) in grp.fields.items():
+                    if Spad != S:
+                        dvals = np.concatenate(
+                            [dvals, np.zeros((Spad - S, P))])
+                        dvalid = np.concatenate(
+                            [dvalid, np.zeros((Spad - S, P), np.bool_)])
+                    res = dense_window_aggregate(dvals, dvalid, None,
+                                                 spec)
+                    dense_out.setdefault(fname, []).append(
+                        (grp.cells, S, res))
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,
@@ -927,6 +958,35 @@ class QueryExecutor:
                 if "max" in st:
                     st["max"] = np.maximum(
                         st["max"], pg["max"][:G * W].reshape(G, W))
+                ft = scanres.field_types.get(fname)
+                if ft is not None:
+                    field_types[fname] = ft
+            # fold in dense-kernel results (cells → grid scatter; dense
+            # mode guarantees st keys ⊆ {count,sum,sumsq,min,max})
+            for cells, S, dres in dense_out.get(fname, ()):
+                for k, combine in (("count", "add"), ("sum", "add"),
+                                   ("sumsq", "add"), ("min", "min"),
+                                   ("max", "max")):
+                    if k not in st:
+                        continue
+                    v = getattr(dres, k)
+                    if v is None:
+                        continue
+                    v = np.asarray(v)[:S]
+                    if combine == "add":
+                        acc = np.zeros(G * W + 1, dtype=st[k].dtype)
+                        np.add.at(acc, cells, v.astype(st[k].dtype))
+                        st[k] = st[k] + acc[:G * W].reshape(G, W)
+                    elif combine == "min":
+                        acc = np.full(G * W + 1, np.inf)
+                        np.minimum.at(acc, cells, v)
+                        st[k] = np.minimum(st[k],
+                                           acc[:G * W].reshape(G, W))
+                    else:
+                        acc = np.full(G * W + 1, -np.inf)
+                        np.maximum.at(acc, cells, v)
+                        st[k] = np.maximum(st[k],
+                                           acc[:G * W].reshape(G, W))
                 ft = scanres.field_types.get(fname)
                 if ft is not None:
                     field_types[fname] = ft

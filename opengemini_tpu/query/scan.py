@@ -85,9 +85,23 @@ class ScanStats:
     """Counters surfaced in EXPLAIN ANALYZE (reader_scan span)."""
     preagg_segments: int = 0
     decoded_segments: int = 0
+    dense_segments: int = 0
+    dense_rows: int = 0
     merged_series: int = 0
     direct_series: int = 0
     memtable_chunks: int = 0
+
+
+@dataclass
+class DenseGroup:
+    """Regular-sampling rows reshaped to (S, P): S window-blocks of
+    exactly P points each, mapping to grid cell ``cells[s]``. Feeds
+    dense_window_aggregate — pure axis reductions, no scatter (the TSBS
+    fast path; detected from CONST_DELTA time blocks as promised in
+    ops/segment_agg.py)."""
+    P: int
+    cells: np.ndarray                       # (S,) int64 in [0, G*W]
+    fields: dict[str, tuple[np.ndarray, np.ndarray]]  # (S,P) vals/valid
 
 
 @dataclass
@@ -102,6 +116,8 @@ class ScanResult:
     preagg: dict[str, dict[str, np.ndarray]] | None
     # row-aligned string columns (residual predicates over string fields)
     strings: dict[str, object] = dc_field(default_factory=dict)
+    # P → DenseGroup (regular-sampling blocks for the dense kernel)
+    dense: dict[int, DenseGroup] = dc_field(default_factory=dict)
     stats: ScanStats = dc_field(default_factory=ScanStats)
 
     @property
@@ -264,6 +280,97 @@ def _preagg_eligible(cm, needed: list[str], si: int, t_lo, t_hi,
     return int(w0)
 
 
+@dataclass
+class _DenseTask:
+    reader: object
+    cm: object
+    si: int
+    gid: int
+    a: int                 # time-trimmed row subrange [a, b) of the seg
+    b: int
+    lo: int                # dense rows [lo, lo + f*P)
+    f: int                 # number of full windows
+    P: int                 # points per window
+    w0: int                # first full window index
+    t0: int
+    step: int
+
+
+def _dense_probe(reader, seg):
+    """Read a time block's 17-byte header: (t0, step) for CONST_DELTA
+    blocks, None otherwise. No decode, no allocation."""
+    import struct as _struct
+    from ..encoding.blocks import CONST_DELTA
+    if seg.size < 17:
+        return None
+    head = bytes(reader._mm[seg.offset:seg.offset + 17])
+    if head[0] != CONST_DELTA:
+        return None
+    return _struct.unpack("<qq", head[1:17])
+
+
+def _dense_plan(t0: int, step: int, n: int, t_lo, t_hi,
+                start: int, interval: int, W: int):
+    """Window-partition an affine time segment t0 + i*step (i < n).
+    Returns (a, b, lo, f, P, w0): rows [a,b) are in the query range,
+    rows [lo, lo+f*P) cover f whole windows starting at window w0 with
+    exactly P points each; rows [a,lo) and [lo+f*P,b) are edge leftovers
+    for the sparse path. None when the shape doesn't fit."""
+    if step <= 0 or interval % step != 0:
+        return None
+    P = interval // step
+    a, b = 0, n
+    if t_lo is not None and t0 < t_lo:
+        a = -((t_lo - t0) // -step)            # ceil division
+    if t_hi is not None and t0 + (n - 1) * step > t_hi:
+        b = (t_hi - t0) // step + 1
+    if b - a < P:
+        return None
+    ta = t0 + a * step
+    w0 = (ta - start) // interval
+    # first row index (absolute) of window w0+1
+    nxt = a + (-((start + (w0 + 1) * interval - ta) // -step))
+    if nxt - a == P:
+        lo, wfull = a, w0                      # w0 itself is complete
+    else:
+        lo, wfull = nxt, w0 + 1
+    f = (b - lo) // P
+    if f < 1:
+        return None
+    if wfull < 0 or wfull + f > W:
+        return None
+    return a, b, lo, f, P, wfull
+
+
+def _run_dense(d: _DenseTask, needed: list[str], W: int):
+    """Decode one dense segment: (f, P) blocks per field + edge-leftover
+    flat parts. Times are affine — generated, never decoded."""
+    span = d.f * d.P
+    blocks: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    left_cols: list[dict] = [dict(), dict()]
+    ranges = [(d.a, d.lo), (d.lo + span, d.b)]
+    for name in needed:
+        colm = d.cm.column(name)
+        if colm is None or colm.type not in _NUMERIC:
+            continue
+        cv = d.reader.read_segment(colm, colm.segments[d.si])
+        vals = cv.values.astype(np.float64, copy=False)
+        blocks[name] = (vals[d.lo:d.lo + span].reshape(d.f, d.P),
+                        cv.valid[d.lo:d.lo + span].reshape(d.f, d.P),
+                        colm.type)
+        for k, (i0, i1) in enumerate(ranges):
+            if i1 > i0:
+                left_cols[k][name] = (cv.values[i0:i1], cv.valid[i0:i1],
+                                     colm.type)
+    cells = d.gid * W + np.arange(d.w0, d.w0 + d.f, dtype=np.int64)
+    leftovers = []
+    for k, (i0, i1) in enumerate(ranges):
+        if i1 > i0:
+            times = d.t0 + d.step * np.arange(i0, i1, dtype=np.int64)
+            leftovers.append((d.gid, times, left_cols[k], {}))
+    return d.P, cells, blocks, leftovers
+
+
 def _decode_chunk(reader, cm, needed: list[str], keep: list[int],
                   t_lo, t_hi):
     """Decode the selected time segments of one chunk. Returns
@@ -314,15 +421,18 @@ def _decode_chunk(reader, cm, needed: list[str], keep: list[int],
 def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                      t_lo, t_hi, start: int, interval: int, W: int,
                      num_cells: int, allow_preagg: bool,
+                     allow_dense: bool = False,
                      ctx=None, pool: ThreadPoolExecutor | None = None
                      ) -> ScanResult:
     """Phase 2: pre-agg classification + batched segment decode.
     ``num_cells`` = G*W; pre-agg grids are (num_cells+1,) so gid*W+w
-    indexes them directly."""
+    indexes them directly. allow_dense routes whole-window spans of
+    CONST_DELTA segments to (S, P) blocks for the dense kernel."""
     stats = ScanStats()
     preagg: dict[str, dict[str, np.ndarray]] = {}
     # per-chunk decode tasks: (gid, callable) — results row-aligned
     tasks = []
+    dense_tasks: list[_DenseTask] = []
     t_parts: list[np.ndarray] = []
     g_parts: list[int] = []          # gid per part (broadcast later)
     f_parts: list[dict] = []
@@ -389,6 +499,20 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                                 field_types[name] = DataType.FLOAT
                         stats.preagg_segments += 1
                         continue
+                if allow_dense and interval > 0:
+                    probe = _dense_probe(src.reader, tm.segments[si])
+                    if probe is not None:
+                        dp = _dense_plan(probe[0], probe[1],
+                                         tm.segments[si].rows,
+                                         t_lo, t_hi, start, interval, W)
+                        if dp is not None:
+                            a, b, lo, f, P, w0 = dp
+                            dense_tasks.append(_DenseTask(
+                                src.reader, cm, si, sp.gid, a, b,
+                                lo, f, P, w0, probe[0], probe[1]))
+                            stats.dense_segments += 1
+                            stats.dense_rows += f * P
+                            continue
                 keep.append(si)
             if keep:
                 stats.decoded_segments += len(keep)
@@ -422,10 +546,45 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                                           t_lo, t_hi)
         return gid, times, cols, strs
 
-    if pool is not None and len(tasks) > 1:
-        results = list(pool.map(run_one, tasks))
+    if pool is not None and (len(tasks) + len(dense_tasks)) > 1:
+        # one submission wave: dense decodes interleave with flat/merged
+        # ones instead of waiting for the first batch to drain
+        flat_futs = [pool.submit(run_one, t) for t in tasks]
+        dense_futs = [pool.submit(_run_dense, d, needed, W)
+                      for d in dense_tasks]
+        results = [f.result() for f in flat_futs]
+        dense_results = [f.result() for f in dense_futs]
     else:
         results = [run_one(t) for t in tasks]
+        dense_results = [_run_dense(d, needed, W) for d in dense_tasks]
+
+    # assemble (S, P) dense groups; edge leftovers join the flat rows
+    dense_groups: dict[int, DenseGroup] = {}
+    by_p: dict[int, list] = {}
+    for P, cells, blocks, leftovers in dense_results:
+        by_p.setdefault(P, []).append((cells, blocks))
+        results.extend(leftovers)
+    for P, entries in by_p.items():
+        cells = np.concatenate([c for c, _b in entries])
+        names = sorted(set().union(*[b.keys() for _c, b in entries]))
+        gfields: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for name in names:
+            vparts, mparts = [], []
+            for c, b in entries:
+                got = b.get(name)
+                if got is None:
+                    vparts.append(np.zeros((len(c), P)))
+                    mparts.append(np.zeros((len(c), P), dtype=np.bool_))
+                else:
+                    v, m, ft = got
+                    vparts.append(v)
+                    mparts.append(m)
+                    cur = field_types.get(name)
+                    if cur is None or ft == DataType.FLOAT:
+                        field_types[name] = ft
+            gfields[name] = (np.concatenate(vparts),
+                             np.concatenate(mparts))
+        dense_groups[P] = DenseGroup(P, cells, gfields)
 
     s_parts: list[dict] = []
     str_names: set[str] = set()
@@ -482,7 +641,8 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                 acc.append(piece)
         strings[name] = acc
     return ScanResult(times, gids, fields, field_types,
-                      preagg if preagg else None, strings, stats)
+                      preagg if preagg else None, strings,
+                      dense_groups, stats)
 
 
 _POOL: ThreadPoolExecutor | None = None

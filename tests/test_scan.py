@@ -203,3 +203,92 @@ def test_int_field_preagg_exact(db):
     for s in res["series"]:
         assert s["values"][0][1] == sum(range(256))
         assert s["values"][0][2] == 256
+
+
+def test_dense_path_fires_and_matches(db):
+    """Regular 10s sampling + 1m windows → CONST_DELTA segments route to
+    the dense (S, P) kernel; results identical to the sparse reference."""
+    eng, ex = db
+    vals = seed_regular(eng)   # 4 hosts, 256 pts, 10s step (64-row segs)
+    text = ("SELECT mean(usage), count(usage), min(usage), max(usage) "
+            "FROM cpu WHERE time >= 0 AND time < 2560s "
+            "GROUP BY time(1m), host")
+    import re
+    ares = explain(ex, text)
+    m = re.search(r'dense_segments=(\d+)', _span_text(ares))
+    assert m and int(m.group(1)) > 0
+    res = q(ex, text)
+    for s in res["series"]:
+        h = int(s["tags"]["host"][1:])
+        per_min = {}
+        for i in range(256):
+            per_min.setdefault(i * 10 // 60, []).append(vals[h, i])
+        for row in s["values"]:
+            wi = row[0] // MIN
+            cell = per_min.get(wi, [])
+            assert row[2] == len(cell)
+            if cell:
+                assert np.isclose(row[1], np.mean(cell))
+                assert row[3] == min(cell)
+                assert row[4] == max(cell)
+
+
+def test_dense_time_range_cut_midwindow(db):
+    """A range starting mid-window trims edge rows to the sparse path;
+    counts per window must match the row-level reference."""
+    eng, ex = db
+    seed_regular(eng, hosts=2)
+    res = q(ex, "SELECT count(usage) FROM cpu "
+               "WHERE time >= 95s AND time < 2000s "
+               "GROUP BY time(1m), host")
+    for s in res["series"]:
+        got = {row[0]: row[1] for row in s["values"]}
+        ref = {}
+        for i in range(256):
+            t = i * 10
+            if 95 <= t < 2000:
+                w = t // 60 * MIN
+                ref[w] = ref.get(w, 0) + 1
+        assert {k: v for k, v in got.items() if v} == ref
+
+
+def test_dense_with_stddev(db):
+    """stddev needs sumsq — dense-eligible, preagg-ineligible."""
+    eng, ex = db
+    vals = seed_regular(eng, hosts=1)
+    res = q(ex, "SELECT stddev(usage) FROM cpu "
+               "WHERE time >= 0 AND time < 640s GROUP BY time(1m)")
+    rows = {r[0]: r[1] for r in res["series"][0]["values"]}
+    for wi in range(10):
+        cell = [vals[0, i] for i in range(256) if wi * 60 <= i * 10 < (wi + 1) * 60]
+        if len(cell) > 1:
+            assert np.isclose(rows[wi * MIN], np.std(cell, ddof=1))
+
+
+def test_dense_missing_field_in_series(db):
+    """One series lacks the field entirely: dense blocks carry
+    valid=False and the group contributes count 0."""
+    eng, ex = db
+    lines = []
+    for i in range(128):
+        lines.append(f"m,host=a v={i % 5}.0 {i * 10 * 10**9}")
+        lines.append(f"m,host=b w=1.0 {i * 10 * 10**9}")
+    write(eng, "\n".join(lines))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT count(v) FROM m WHERE time >= 0 AND "
+               "time < 1280s GROUP BY time(1m), host")
+    by_host = {s["tags"]["host"]: s for s in res["series"]}
+    assert sum(r[1] for r in by_host["a"]["values"]) == 128
+    assert "b" not in by_host or \
+        sum(r[1] or 0 for r in by_host["b"]["values"]) == 0
+
+
+def test_residual_filtering_everything_returns_empty(db):
+    """A residual matching no rows yields an empty result, not a grid
+    of null windows (influx semantics)."""
+    eng, ex = db
+    seed_regular(eng, hosts=1)
+    res = q(ex, "SELECT count(usage) FROM cpu WHERE usage > 1e12 "
+               "GROUP BY time(1m)")
+    assert res.get("series") in (None, [])
