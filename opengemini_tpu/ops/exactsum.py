@@ -1,0 +1,193 @@
+"""Reproducible (bit-identical) float64 summation via binned integer limbs.
+
+The north star demands bit-identical aggregation across topologies: one
+shard, a multi-store cluster, and the host reference must produce the
+SAME f64 bits for sum/mean over the same data. Floating-point addition
+is not associative, so no ordering discipline survives distribution —
+the reference merges per-store partials in arrival order and silently
+accepts last-ulp drift. The TPU-native fix is to make the accumulation
+EXACT and therefore order-free (the Demmel–Nguyen reproducible-sum idea,
+specialised to integer limbs):
+
+    v  =  Σ_k  b_k · 2^(E - B(k+1))   + residual,   0 ≤ |b_k| < 2^B
+
+Each value decomposes into K=6 signed limbs of B=18 bits below a scale
+2^E (E a multiple of B, chosen per store from max|v|). Limb sums are
+exact integers (n·2^18 < 2^48 keeps them exact even in the TPU's
+float32-pair f64 emulation), so ANY summation order — per-segment
+scatter on device, bincount on host, cross-store merge — yields the
+same limb totals. A cell whose every contributing value decomposed with
+residual 0 is flagged EXACT: its final value is the correctly-rounded
+f64 of the exact integer total, identical in every topology and equal
+to math.fsum. Cells with >2^56 dynamic range (or non-finite values)
+fall back to the ordinary f64 state, flagged inexact.
+
+Partials with different E rebase by whole-limb shifts (exact integer
+shifts; dropped nonzero low limbs clear the exact flag).
+
+Known limitation: the guarantee covers sum/mean (and count/min/max when
+compared exactly on host). VALUE-returning selectors (first/last, and
+min/max computed through the device path) can lose low mantissa bits on
+platforms that emulate f64 as float32 pairs (axon): a value
+round-tripped through the device carries ~48-bit precision. Follow-up:
+return per-cell row indices from the device and gather exact values on
+host.
+
+No counterpart in the reference — it has no reproducible-sum machinery
+(engine/series_agg_reducer.gen.go merges f64 partials directly).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+LIMB_BITS = 18
+K_LIMBS = 6
+_RADIX = 1 << LIMB_BITS            # 262144
+SPAN_BITS = LIMB_BITS * K_LIMBS    # 108 bits captured below 2^E
+
+
+def pick_scale(max_abs: float) -> int:
+    """Smallest E (multiple of LIMB_BITS) with max_abs < 2^E."""
+    if not np.isfinite(max_abs) or max_abs <= 0:
+        return 0
+    e = int(np.ceil(np.log2(max_abs))) + 1
+    return int(np.ceil(e / LIMB_BITS)) * LIMB_BITS
+
+
+def limb_scales(E: int) -> np.ndarray:
+    """(K,) f64 powers 2^(E - B(k+1)) — exact (powers of two)."""
+    exps = E - LIMB_BITS * (np.arange(K_LIMBS) + 1)
+    return np.exp2(exps.astype(np.float64))
+
+
+def decompose(values: np.ndarray, E: int):
+    """values (N,) f64 → (limbs (N, K) f64-integers, residual (N,)).
+    Exact: Σ_k limbs[:,k]·scale_k + residual == values, bit for bit.
+    Non-finite values yield limbs 0 and residual NaN (→ inexact)."""
+    scales = limb_scales(E)
+    finite = np.isfinite(values)
+    a = np.abs(np.where(finite, values, 0.0))
+    sign = np.where(values < 0, -1.0, 1.0)
+    limbs = np.empty(values.shape + (K_LIMBS,), dtype=np.float64)
+    for k in range(K_LIMBS):
+        b = np.floor(a / scales[k])
+        # a may equal 2^E only through caller error; clamp defensively
+        np.minimum(b, float(_RADIX - 1), out=b)
+        a = a - b * scales[k]
+        limbs[..., k] = sign * b
+    residual = np.where(finite, sign * a, np.nan)
+    return limbs, residual
+
+
+def exact_segment_sum_host(values: np.ndarray, valid: np.ndarray,
+                           seg_ids: np.ndarray, num_segments: int,
+                           E: int):
+    """Host path: (limb sums (S, K) f64, inexact flags (S,) bool)."""
+    S = num_segments
+    keep = valid & (seg_ids < S)
+    v = values[keep]
+    s = seg_ids[keep]
+    limbs, res = decompose(v, E)
+    out = np.zeros((S, K_LIMBS), dtype=np.float64)
+    for k in range(K_LIMBS):
+        out[:, k] = np.bincount(s, weights=limbs[:, k], minlength=S)
+    bad = res != 0.0
+    bad |= ~np.isfinite(res)
+    inexact = np.zeros(S, dtype=bool)
+    np.logical_or.at(inexact, s[bad], True)
+    return out, inexact
+
+
+def host_limbs(values: np.ndarray, valid: np.ndarray | None, E: int):
+    """Decompose on HOST into int32 limb planes + per-row bad flags.
+
+    The decomposition MUST run in real IEEE f64: on TPU, f64 is emulated
+    as float32 pairs whose floor/divide are not exact, which silently
+    breaks the integer-limb invariant (measured: ~1e-16 relative drift).
+    Integer ADDS on device are exact, so the device path ships int32
+    limbs and reduces in int64."""
+    limbs, res = decompose(values, E)
+    bad = (res != 0.0) | ~np.isfinite(res)
+    if valid is not None:
+        limbs = np.where(valid[..., None], limbs, 0.0)
+        bad = bad & valid
+    return limbs.astype(np.int32), bad
+
+
+@functools.partial(
+    __import__("jax").jit, static_argnames=("num_segments", "sorted_ids"))
+def exact_segment_sum(limbs_i32, seg_ids, num_segments: int,
+                      sorted_ids: bool = False):
+    """Device sparse path: int64 segment sums of host-decomposed int32
+    limb planes — exact integer arithmetic on the device."""
+    import jax
+    import jax.numpy as jnp
+    ns = num_segments + 1
+    sums = jax.ops.segment_sum(limbs_i32.astype(jnp.int64), seg_ids, ns,
+                               indices_are_sorted=sorted_ids)
+    return sums[:num_segments]
+
+
+@functools.partial(__import__("jax").jit)
+def exact_dense_sum(limbs_i32):
+    """Device dense path: (S, P, K) int32 limbs → (S, K) int64 sums."""
+    import jax.numpy as jnp
+    return limbs_i32.astype(jnp.int64).sum(axis=1)
+
+
+def segment_bad_flags(bad: np.ndarray, seg_ids: np.ndarray,
+                      num_segments: int) -> np.ndarray:
+    """Host reduction of per-row inexact flags (cheap — bools)."""
+    out = np.zeros(num_segments, dtype=bool)
+    sel = bad & (seg_ids < num_segments)
+    np.logical_or.at(out, seg_ids[sel], True)
+    return out
+
+
+def rebase(limbs: np.ndarray, inexact: np.ndarray, e_from: int,
+           e_to: int):
+    """Shift limb grids from scale e_from to e_to ≥ e_from (whole-limb
+    shifts — exact). Dropped nonzero low limbs clear exactness."""
+    if e_to == e_from:
+        return limbs, inexact
+    shift = (e_to - e_from) // LIMB_BITS
+    if shift < 0:
+        raise ValueError("rebase target must be ≥ source scale")
+    out = np.zeros_like(limbs)
+    if shift < K_LIMBS:
+        out[..., shift:] = limbs[..., :K_LIMBS - shift]
+        dropped = limbs[..., K_LIMBS - shift:]
+    else:
+        dropped = limbs
+    inexact = inexact | (dropped != 0.0).any(axis=-1)
+    return out, inexact
+
+
+def merge_limbs(a_limbs, a_inexact, a_e, b_limbs, b_inexact, b_e):
+    """Combine two partial limb states → (limbs, inexact, E). Addition
+    of exact integers — order-free."""
+    E = max(a_e, b_e)
+    a_limbs, a_inexact = rebase(a_limbs, a_inexact, a_e, E)
+    b_limbs, b_inexact = rebase(b_limbs, b_inexact, b_e, E)
+    return a_limbs + b_limbs, a_inexact | b_inexact, E
+
+
+def finalize_exact(limbs: np.ndarray, E: int) -> np.ndarray:
+    """Correctly-rounded f64 of the exact integer totals. float(int) is
+    correctly rounded and the 2^(E-108) scaling is exact (power of two),
+    so the result equals math.fsum of the original values wherever the
+    exact flag held."""
+    flat = limbs.reshape(-1, K_LIMBS)
+    # big-int packing, vectorized over object dtype (limb sums are
+    # integers ≤ n·2^18 — far inside f64's exact-integer range)
+    total = flat[:, 0].astype(np.int64).astype(object)
+    for k in range(1, K_LIMBS):
+        total = total * _RADIX + flat[:, k].astype(np.int64).astype(object)
+    scale = 2.0 ** float(E - SPAN_BITS)
+    out = np.fromiter((float(t) for t in total), dtype=np.float64,
+                      count=len(total))
+    out *= scale
+    return out.reshape(limbs.shape[:-1])

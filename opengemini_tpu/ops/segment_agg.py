@@ -366,6 +366,81 @@ def merge_seg_results(a: SegmentAggResult,
                       jnp.minimum(a.max_time, b.max_time))))
 
 
+def segment_aggregate_host(values: np.ndarray,
+                           valid: np.ndarray,
+                           seg_ids: np.ndarray,
+                           times: np.ndarray | None,
+                           num_segments: int,
+                           spec: AggSpec = AggSpec()) -> SegmentAggResult:
+    """Numpy mirror of segment_aggregate for SMALL row counts: when the
+    sparse rows are a handful of window-edge leftovers (the dense/pre-agg
+    paths took the bulk), two device round-trips cost more than the
+    reduction itself — on a remote-attached TPU each call pays the full
+    tunnel latency. Same semantics, same state layout, numpy arrays."""
+    S = num_segments
+    keep = valid & (seg_ids < S)
+    s = seg_ids[keep]
+    v = values[keep]
+    n = len(values)
+    res: dict[str, np.ndarray | None] = {}
+    if spec.count or spec.sum:
+        res["count"] = np.bincount(s, minlength=S).astype(np.int64)
+    # bincount degenerates to int64 on EMPTY weights — force the device
+    # kernel's float64 state dtype or downstream merges would truncate
+    if spec.sum:
+        res["sum"] = np.bincount(s, weights=v, minlength=S).astype(
+            np.float64, copy=False)
+    if spec.sumsq:
+        res["sumsq"] = np.bincount(s, weights=v * v, minlength=S).astype(
+            np.float64, copy=False)
+    if spec.min:
+        mn = np.full(S, np.inf)
+        np.minimum.at(mn, s, v)
+        res["min"] = mn
+    if spec.max:
+        mx = np.full(S, -np.inf)
+        np.maximum.at(mx, s, v)
+        res["max"] = mx
+    min_t = max_t = None
+    if spec.min_time or spec.max_time:
+        if times is None:
+            raise ValueError("min_time/max_time need times")
+        t = times[keep]
+        imax = np.iinfo(np.int64).max
+        if spec.min_time:
+            at = v == res["min"][s]
+            min_t = np.full(S, imax, dtype=np.int64)
+            np.minimum.at(min_t, s[at], t[at])
+        if spec.max_time:
+            at = v == res["max"][s]
+            max_t = np.full(S, imax, dtype=np.int64)
+            np.minimum.at(max_t, s[at], t[at])
+    first = last = first_t = last_t = None
+    if spec.first or spec.last:
+        if times is None:
+            raise ValueError("first/last need times")
+        idx = np.nonzero(keep)[0]
+        if spec.first:
+            fi = np.full(S, n, dtype=np.int64)
+            np.minimum.at(fi, s, idx)
+            has = fi < n
+            safe = np.minimum(fi, max(n - 1, 0))
+            first = np.where(has, values[safe] if n else np.nan, np.nan)
+            first_t = np.where(has, times[safe] if n else 0, 0)
+        if spec.last:
+            li = np.full(S, -1, dtype=np.int64)
+            np.maximum.at(li, s, idx)
+            has = li >= 0
+            safe = np.maximum(li, 0)
+            last = np.where(has, values[safe] if n else np.nan, np.nan)
+            last_t = np.where(has, times[safe] if n else 0, 0)
+    return SegmentAggResult(
+        count=res.get("count"), sum=res.get("sum"),
+        sumsq=res.get("sumsq"), min=res.get("min"), max=res.get("max"),
+        first=first, last=last, first_time=first_t, last_time=last_t,
+        min_time=min_t, max_time=max_t)
+
+
 # ----------------------------------------------------------------- helpers
 
 def pad_rows(arrays: Sequence[np.ndarray], n_padded: int,

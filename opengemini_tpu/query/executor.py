@@ -60,6 +60,17 @@ __all__ = ["QueryExecutor", "classify_select", "merge_partials",
 
 MAX_WINDOWS = 100_000
 
+# sparse row counts at or below this reduce on host (numpy) instead of
+# paying device dispatch + result round-trips; the dense/pre-agg paths
+# carry the bulk of large scans either way
+HOST_AGG_THRESHOLD = int(
+    __import__("os").environ.get("OG_HOST_AGG_THRESHOLD", "32768"))
+
+# reproducible (bit-identical) f64 sums via binned integer limbs
+# (ops/exactsum.py) — the north star's bit-identical guarantee. Costs
+# ~6 extra fused reduction passes; OG_EXACT_SUM=0 disables.
+EXACT_SUM = __import__("os").environ.get("OG_EXACT_SUM", "1") != "0"
+
 
 class QueryExecutor:
     """Executes parsed statements against a storage Engine.
@@ -684,8 +695,8 @@ class QueryExecutor:
         travel as raw per-cell slices; top/bottom travel as capped
         per-cell top-N (mergeable — engine/topn_linkedlist.go analog).
         """
-        from ..ops import AggSpec, segment_aggregate, window_ids, pad_bucket
-        from ..ops.segment_agg import pad_rows
+        from ..ops import AggSpec, segment_aggregate, pad_bucket
+        from ..ops.segment_agg import pad_rows, segment_aggregate_host
         from .scan import (PREAGG_STATES, decode_pool, materialize_scan,
                            plan_rowstore_scan)
 
@@ -812,6 +823,11 @@ class QueryExecutor:
             # kernel states it carries suffice and no row-level filter
             # or raw-slice collection needs the actual points (the
             # agg_tagset_cursor fast path, agg_tagset_cursor.go:265)
+            # sum-consuming queries under exact mode require v2 pre-agg
+            # limb states per segment (need_limbs); v1 segments decode
+            sum_consumed = any(a.func in ("sum", "mean", "stddev")
+                               for a in aggs)
+            need_limbs = EXACT_SUM and sum_consumed
             allow_preagg = (cond.residual is None and not raw_fields
                             and spec_names <= PREAGG_STATES)
             # dense blocks feed pure axis reductions — usable whenever
@@ -823,7 +839,8 @@ class QueryExecutor:
             scanres = materialize_scan(
                 scan_plan, mst, needed_fields, t_lo, t_hi,
                 int(start), int(interval_eff), W, G * W, allow_preagg,
-                allow_dense=allow_dense, ctx=ctx, pool=decode_pool())
+                allow_dense=allow_dense, need_limbs=need_limbs,
+                ctx=ctx, pool=decode_pool())
             if cond.residual is not None and scanres.n_rows:
                 mask = eval_residual(cond.residual, scanres.to_record())
                 if not mask.all():
@@ -858,21 +875,40 @@ class QueryExecutor:
                             merged_series=sst.merged_series,
                             direct_series=sst.direct_series)
 
-        w = np.asarray(window_ids(times, start, interval_eff, W))
-        seg = np.where(w < W, gids * W + w, G * W).astype(np.int64)
         num_segments = G * W
+        if n_rows:
+            # window ids on host: the result is needed host-side anyway
+            # (raw slices, sortedness check) and a device call per query
+            # costs a full tunnel round-trip on remote-attached TPUs
+            w = (times - start) // interval_eff
+            w = np.where((w >= 0) & (w < W), w, W)
+            seg = np.where(w < W, gids * W + w, num_segments).astype(
+                np.int64)
+        else:
+            seg = np.empty(0, dtype=np.int64)
         # seg ids are NOT sorted in general (multi-shard/multi-series
         # interleave); XLA's indices_are_sorted contract would be violated
         seg_sorted = bool(np.all(seg[:-1] <= seg[1:])) if len(seg) else True
+        # tiny sparse leftovers (dense/pre-agg took the bulk) reduce on
+        # host — two device round-trips cost more than the arithmetic
+        use_host = n_rows <= HOST_AGG_THRESHOLD
 
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
         raw_slices: dict[str, dict] = {}
+        # reproducible sums: per-field limb states (ops/exactsum.py),
+        # computed only when an output reads the sum state
+        exact_on = EXACT_SUM and spec.sum and any(
+            a.func in ("sum", "mean", "stddev") for a in aggs)
+        exact_results: dict[str, tuple] = {}
+        exact_scales: dict[str, int] = {}
         dev_sp = span.child("device_agg") if span is not None else None
         if dev_sp is not None:
             dev_sp.start_ns = _now_ns()
         npad = pad_bucket(n_rows)
-        seg_p, times_p = pad_rows([seg, times], npad, seg_fill=num_segments)
+        if not use_host:
+            seg_p, times_p = pad_rows([seg, times], npad,
+                                      seg_fill=num_segments)
         for fname in needed_fields:
             if scanres is not None:
                 got = scanres.fields.get(fname)
@@ -898,10 +934,42 @@ class QueryExecutor:
                         if col.type == DataType.INTEGER:
                             ftype = DataType.INTEGER
                     pos += n
-            vals_p, valid_p = pad_rows([vals, valid], npad, seg_fill=0)
-            res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
-                                    num_segments, spec,
-                                    sorted_ids=seg_sorted)
+            if exact_on:
+                from ..ops import exactsum
+                mx = float(np.max(np.abs(vals[valid]))) if valid.any() \
+                    else 0.0
+                if scanres is not None:
+                    for grp in scanres.dense.values():
+                        dv, dm = grp.fields.get(fname, (None, None))
+                        if dv is not None and dm.any():
+                            mx = max(mx, float(np.max(
+                                np.abs(np.where(dm, dv, 0.0)))))
+                exact_scales[fname] = exactsum.pick_scale(mx)
+            if use_host:
+                res = segment_aggregate_host(vals, valid, seg, times,
+                                             num_segments, spec)
+                if exact_on:
+                    exact_results[fname] = \
+                        exactsum.exact_segment_sum_host(
+                            vals, valid, seg, num_segments,
+                            exact_scales[fname])
+            else:
+                vals_p, valid_p = pad_rows([vals, valid], npad,
+                                           seg_fill=0)
+                res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
+                                        num_segments, spec,
+                                        sorted_ids=seg_sorted)
+                if exact_on:
+                    # decompose on HOST (real f64 — exact), reduce in
+                    # int64 on device (exact integer adds)
+                    limbs_i32, bad = exactsum.host_limbs(
+                        vals_p, valid_p, exact_scales[fname])
+                    exact_results[fname] = (
+                        exactsum.exact_segment_sum(
+                            limbs_i32, seg_p, num_segments,
+                            sorted_ids=seg_sorted),
+                        exactsum.segment_bad_flags(bad, seg_p,
+                                                   num_segments))
             field_results[fname] = res
             field_types[fname] = ftype
             if fname in raw_fields:
@@ -910,8 +978,11 @@ class QueryExecutor:
         # dense groups: (S, P) axis reductions, results scattered into
         # the state grids host-side (S is tiny — N/P)
         dense_out: dict[str, list] = {}
+        dense_exact: dict[str, list] = {}
         if scanres is not None and scanres.dense:
             from ..ops import dense_window_aggregate
+            if exact_on:
+                from ..ops import exactsum
             for P, grp in sorted(scanres.dense.items()):
                 S = len(grp.cells)
                 Spad = pad_bucket(S, minimum=128)
@@ -925,6 +996,20 @@ class QueryExecutor:
                                                  spec)
                     dense_out.setdefault(fname, []).append(
                         (grp.cells, S, res))
+                    if exact_on:
+                        dl_i32, dbad = exactsum.host_limbs(
+                            dvals, dvalid, exact_scales.get(fname, 0))
+                        dense_exact.setdefault(fname, []).append(
+                            (grp.cells, S,
+                             (exactsum.exact_dense_sum(dl_i32),
+                              dbad.any(axis=1))))
+        if not use_host or dense_out:
+            # ONE batched D2H for every kernel output — per-array pulls
+            # each pay a full tunnel round-trip on remote-attached TPUs
+            import jax
+            field_results, dense_out, exact_results, dense_exact = \
+                jax.device_get((field_results, dense_out,
+                                exact_results, dense_exact))
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,
@@ -990,6 +1075,40 @@ class QueryExecutor:
                 ft = scanres.field_types.get(fname)
                 if ft is not None:
                     field_types[fname] = ft
+            # reproducible-sum limb states (sparse + dense + pre-agg)
+            if exact_on and (fname in exact_results
+                             or fname in dense_exact):
+                from ..ops.exactsum import K_LIMBS, rebase
+                lg = np.zeros((G * W + 1, K_LIMBS))
+                ixg = np.zeros(G * W + 1, dtype=bool)
+                er = exact_results.get(fname)
+                if er is not None:
+                    limbs, ix = er
+                    lg[:G * W] += np.asarray(limbs)
+                    ixg[:G * W] |= np.asarray(ix)
+                for cells, S, (dl, dbad) in dense_exact.get(fname, ()):
+                    np.add.at(lg, cells, np.asarray(dl)[:S])
+                    np.logical_or.at(ixg, cells, np.asarray(dbad)[:S])
+                e_final = exact_scales.get(fname, 0)
+                items = (pg or {}).get("limb_items", ())
+                if items:
+                    # v2 pre-agg limb contributions: rebase everything
+                    # to the max scale, then exact integer adds
+                    e_final = max([e_final] + [sc for _c, sc, _l
+                                               in items])
+                    lg2, ix2 = rebase(lg[:G * W], ixg[:G * W],
+                                      exact_scales.get(fname, 0),
+                                      e_final)
+                    lg[:G * W], ixg[:G * W] = lg2, ix2
+                    for cell, sc, lb in items:
+                        lb2, i2 = rebase(lb[None, :],
+                                         np.zeros(1, dtype=bool),
+                                         sc, e_final)
+                        lg[cell] += lb2[0]
+                        ixg[cell] |= i2[0]
+                    exact_scales[fname] = e_final
+                st["sum_limbs"] = lg[:G * W].reshape(G, W, K_LIMBS)
+                st["sum_inexact"] = ixg[:G * W].reshape(G, W)
             fields_out[fname] = st
         partial = {
             "group_tags": group_tags,
@@ -1001,6 +1120,8 @@ class QueryExecutor:
             "field_types": {f: _ftype_name(t)
                             for f, t in field_types.items()},
         }
+        if exact_scales:
+            partial["sum_scales"] = dict(exact_scales)
         if not interval:
             # influx shows epoch 0 on unbounded windowless aggregates
             partial["display_start"] = \
@@ -1334,9 +1455,16 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
     fnames = sorted(set().union(*[p["fields"].keys() for p in partials]))
     merged_fields: dict[str, dict] = {}
     field_types: dict[str, str] = {}
+    merged_scales: dict[str, int] = {}
     for fname in fnames:
         keys = sorted(set().union(*[p["fields"][fname].keys()
                                     for p in partials if fname in p["fields"]]))
+        # reproducible-sum limb states merge by exact integer addition
+        # (rebased to a common scale) — handled apart from the generic
+        # (G, W) float grids
+        has_limbs = [p for p in partials
+                     if "sum_limbs" in p["fields"].get(fname, {})]
+        keys = [k for k in keys if k not in ("sum_limbs", "sum_inexact")]
         tgt = {}
         for k in keys:
             dt = np.int64 if k in ("count", "first_time", "last_time",
@@ -1391,6 +1519,32 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                                            tgt["last"][ix])
                 tgt["last_time"][ix] = np.where(take_b, bt,
                                                 tgt["last_time"][ix])
+        # exact limbs survive the merge only if EVERY partial carrying a
+        # sum for this field carries limbs (mixed-capability stores
+        # degrade to the plain f64 sum)
+        sum_ps = [p for p in partials if "sum" in p["fields"].get(fname, {})]
+        if has_limbs and len(has_limbs) == len(sum_ps) and "sum" in tgt:
+            from ..ops.exactsum import K_LIMBS, rebase
+            e_t = max(p["sum_scales"][fname] for p in has_limbs)
+            lg = np.zeros((G, W, K_LIMBS))
+            ixg = np.zeros((G, W), dtype=bool)
+            for pi, p in enumerate(partials):
+                st = p["fields"].get(fname)
+                if st is None or "sum_limbs" not in st:
+                    continue
+                rows = np.array([key_to_gi[k] for k in aligned_keys[pi]],
+                                dtype=np.int64)
+                off = int((p["start"] - start) // interval) if interval \
+                    else 0
+                cols = np.arange(off, off + p["W"])
+                ix = np.ix_(rows, cols)
+                l2, i2 = rebase(st["sum_limbs"], st["sum_inexact"],
+                                p["sum_scales"][fname], e_t)
+                lg[ix] += l2
+                ixg[ix] |= i2
+            tgt["sum_limbs"] = lg
+            tgt["sum_inexact"] = ixg
+            merged_scales[fname] = e_t
         merged_fields[fname] = tgt
         # integer only if every store that saw the field agrees
         seen = [p["field_types"].get(fname) for p in partials
@@ -1404,6 +1558,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
     merged = {"group_tags": group_tags, "group_keys": group_keys,
               "interval": interval, "start": int(start), "W": W,
               "fields": merged_fields, "field_types": field_types}
+    if merged_scales:
+        merged["sum_scales"] = merged_scales
     if not interval:
         merged["display_start"] = min(
             p.get("display_start", p["start"]) for p in partials)
@@ -1524,9 +1680,21 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     start = merged["start"]
     W = merged["W"]
     G = len(group_keys)
-    fields = merged["fields"]
     field_types = merged["field_types"]
     aggs = cs.aggs
+
+    # reproducible sums: where the exact flag held, replace the f64 sum
+    # with the correctly-rounded exact total (bit-identical across
+    # topologies; == math.fsum of the contributing values)
+    fields = {}
+    for fname, st in merged["fields"].items():
+        if "sum_limbs" in st and "sum" in st:
+            from ..ops.exactsum import finalize_exact
+            ex = finalize_exact(st["sum_limbs"],
+                                merged.get("sum_scales", {}).get(fname, 0))
+            st = {**st,
+                  "sum": np.where(st["sum_inexact"], st["sum"], ex)}
+        fields[fname] = st
 
     win_times = start + interval * np.arange(W) if interval else \
         np.array([merged.get("display_start", start)], dtype=np.int64)
@@ -1590,8 +1758,26 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     casts = [_output_cast(expr, aggs, field_types)
              for _name, expr in cs.outputs]
 
-    series_out = []
     order = sorted(range(G), key=lambda gi: group_keys[gi])
+
+    # vectorized materialization for the dominant shape (plain outputs,
+    # fill none/null, window times): the reference's Materialize/HttpSender
+    # transforms are compiled Go — a per-cell Python loop here would
+    # dominate large result grids
+    if (point_times is None and stmt.fill_option in ("none", "null")
+            and all(k == "plain" for _n, k, _p in out_specs)):
+        kinds = [_output_cast_kind(expr, aggs, field_types)
+                 for _name, expr in cs.outputs]
+        series_out = _materialize_plain_fast(
+            stmt, mst, out_specs, kinds, anyc, win_times, interval,
+            group_tags, group_keys, order)
+        if stmt.soffset:
+            series_out = series_out[stmt.soffset:]
+        if stmt.slimit:
+            series_out = series_out[:stmt.slimit]
+        return {"series": series_out} if series_out else {}
+
+    series_out = []
     for gi in order:
         tags = dict(zip(group_tags, group_keys[gi]))
         cells: dict[int, list] = {}    # time -> row cell list
@@ -1683,6 +1869,58 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     if stmt.slimit:
         series_out = series_out[:stmt.slimit]
     return {"series": series_out} if series_out else {}
+
+
+def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
+                            win_times, interval, group_tags, group_keys,
+                            order) -> list:
+    """Row assembly without per-cell Python: per group, slice the output
+    grids with numpy, convert valid cells in C (`tolist`), and zip rows.
+    Semantics identical to the general loop for plain outputs with
+    fill none/null."""
+    n_out = len(out_specs)
+    cols_hdr = ["time"] + [n for n, _k, _p in out_specs]
+    W = len(win_times)
+    series_out = []
+    fill_null = stmt.fill_option == "null" and interval
+    for gi in order:
+        present = anyc[gi]
+        keep = np.ones(W, dtype=bool) if fill_null else present
+        if not present.any() and not fill_null:
+            continue
+        times_kept = win_times[keep].tolist()
+        out_cols = []
+        for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
+            row_vals = grid[gi][keep]
+            ok = (pres[gi] & present)[keep] & np.isfinite(row_vals)
+            if ok.all():
+                vs = (row_vals.astype(np.int64) if kinds[oi] == "int"
+                      else row_vals).tolist()
+                out_cols.append(vs)
+                continue
+            col = [None] * len(times_kept)
+            vals_ok = row_vals[ok]
+            vs = (vals_ok.astype(np.int64) if kinds[oi] == "int"
+                  else vals_ok).tolist()
+            for i, v in zip(np.nonzero(ok)[0].tolist(), vs):
+                col[i] = v
+            out_cols.append(col)
+        # (fill(null) differs only via `keep`: it emits a row per window,
+        # all-null rows included, matching influx)
+        rows = [list(r) for r in zip(times_kept, *out_cols)]
+        if stmt.order_desc:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[:stmt.limit]
+        if not rows:
+            continue
+        entry = {"name": mst, "columns": cols_hdr, "values": rows}
+        if group_tags:
+            entry["tags"] = dict(zip(group_tags, group_keys[gi]))
+        series_out.append(entry)
+    return series_out
 
 
 def _selector_point_times(cs, aggs, fields, merged,
@@ -1884,17 +2122,23 @@ def _expr_presence(expr, agg_present: list[np.ndarray], G: int, W: int
     return pres
 
 
-def _output_cast(expr, aggs: list[AggItem], field_types: dict):
+def _output_cast_kind(expr, aggs: list[AggItem], field_types: dict) -> str:
     """Result cell formatting: count-like → int; selector-like on integer
     fields → int; computed expressions → float."""
     if isinstance(expr, AggRef):
         a = aggs[expr.idx]
         if a.func in ("count", "count_distinct"):
-            return lambda v: int(v)
+            return "int"
         if (field_types.get(a.field) == "integer"
                 and a.func in ("sum", "min", "max", "first", "last",
                                "spread", "mode", "percentile")):
-            return lambda v: int(v)
+            return "int"
+    return "float"
+
+
+def _output_cast(expr, aggs: list[AggItem], field_types: dict):
+    if _output_cast_kind(expr, aggs, field_types) == "int":
+        return lambda v: int(v)
     return lambda v: float(v)
 
 

@@ -211,11 +211,13 @@ def plan_rowstore_scan(per_shard, mst: str, t_lo: int | None,
                     data_tmin = min(data_tmin, lo)
                     data_tmax = max(data_tmax, hi)
             # disjoint sources stream directly; overlapping time ranges
-            # may hold duplicate timestamps → newest-wins merge fallback
+            # may hold duplicate timestamps → newest-wins merge fallback.
+            # Keep time order (disjoint ⇒ min_time order is total): the
+            # kernel's first/last are position-based within a store
             ordered = sorted(sources, key=lambda c: c.min_time)
             merged = any(a.max_time >= b.min_time
                          for a, b in zip(ordered, ordered[1:]))
-            series.append(_SeriesPlan(sid, gid, s, sources, merged))
+            series.append(_SeriesPlan(sid, gid, s, ordered, merged))
     return ScanPlan(series, data_tmin, data_tmax, has_rows)
 
 
@@ -247,11 +249,14 @@ def _source_range_bounds(src: _ChunkSrc, t_lo, t_hi):
 
 
 def _preagg_eligible(cm, needed: list[str], si: int, t_lo, t_hi,
-                     start: int, interval: int, W: int):
+                     start: int, interval: int, W: int,
+                     need_limbs: bool = False):
     """Can time-segment ``si`` of this chunk be answered from metadata?
     Yes iff it lies fully inside the query time range, falls entirely in
     one window, and every needed field present in the chunk has pre-agg
-    on that segment. Returns the window index or None."""
+    on that segment. With need_limbs (exact-sum queries) the pre-agg
+    must also carry an exact limb state (v2 files). Returns the window
+    index or None."""
     tm = cm.column("time")
     seg = tm.segments[si]
     pa = seg.preagg
@@ -274,8 +279,12 @@ def _preagg_eligible(cm, needed: list[str], si: int, t_lo, t_hi,
         cpa = colm.segments[si].preagg
         if cpa is None:
             return None
+        if cpa.count == 0:
+            continue            # all-null segment contributes nothing
         if colm.type == DataType.INTEGER and abs(cpa.sum) >= 2.0 ** 52:
             # stored float sum may have rounded; decode to stay exact
+            return None
+        if need_limbs and (cpa.limbs is None or not cpa.exact):
             return None
     return int(w0)
 
@@ -422,6 +431,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                      t_lo, t_hi, start: int, interval: int, W: int,
                      num_cells: int, allow_preagg: bool,
                      allow_dense: bool = False,
+                     need_limbs: bool = False,
                      ctx=None, pool: ThreadPoolExecutor | None = None
                      ) -> ScanResult:
     """Phase 2: pre-agg classification + batched segment decode.
@@ -477,7 +487,8 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                         continue
                 if allow_preagg:
                     w = _preagg_eligible(cm, needed, si, t_lo, t_hi,
-                                         start, interval, W)
+                                         start, interval, W,
+                                         need_limbs=need_limbs)
                     if w is not None:
                         cell = sp.gid * W + w
                         for name in needed:
@@ -492,6 +503,11 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                             g["sum"][cell] += cpa.sum
                             g["min"][cell] = min(g["min"][cell], cpa.min)
                             g["max"][cell] = max(g["max"][cell], cpa.max)
+                            if need_limbs:
+                                g.setdefault("limb_items", []).append(
+                                    (cell, cpa.scale,
+                                     np.array(cpa.limbs,
+                                              dtype=np.float64)))
                             if colm.type == DataType.INTEGER:
                                 field_types.setdefault(name,
                                                        DataType.INTEGER)

@@ -38,7 +38,7 @@ from ..encoding import blocks as enc
 from ..record import ColVal, DataType, Field, Record, Schema
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
-VERSION = 1
+VERSION = 2                  # v2: PreAgg carries reproducible-sum limbs
 SEGMENT_SIZE = 4096          # rows per column segment == device block rows
 META_GROUP_SERIES = 256      # series per meta-index group
 
@@ -49,25 +49,47 @@ _TRAILER_FMT = "<QQQQQQQqqQ"  # data_end, meta_off, meta_size, idx_off,
 
 @dataclass
 class PreAgg:
-    """Per-segment pre-aggregation (reference pre_aggregation.go:38)."""
+    """Per-segment pre-aggregation (reference pre_aggregation.go:38).
+    v2 adds the reproducible-sum limb state (ops/exactsum.py): the exact
+    integer decomposition of the segment's sum, so sum/mean queries keep
+    the zero-decode metadata path under the bit-identical guarantee —
+    no counterpart in the reference, which stores only the f64 sum."""
     count: int = 0
     sum: float = 0.0          # float64 for FLOAT, int value for INTEGER
     min: float = 0.0
     max: float = 0.0
     min_time: int = 0
     max_time: int = 0
+    limbs: tuple | None = None    # K_LIMBS int limb sums
+    scale: int = 0                # limb scale E (multiple of LIMB_BITS)
+    exact: bool = False           # every value decomposed residual-free
 
     def pack(self) -> bytes:
-        return struct.pack("<qdddqq", self.count, float(self.sum),
+        head = struct.pack("<qdddqq", self.count, float(self.sum),
                            float(self.min), float(self.max),
                            self.min_time, self.max_time)
+        if self.limbs is None:
+            return head + struct.pack("<?", False)
+        return head + struct.pack("<?i?6q", True, self.scale,
+                                  self.exact, *self.limbs)
 
     @classmethod
-    def unpack(cls, b) -> "PreAgg":
-        c, s, mn, mx, mnt, mxt = struct.unpack("<qdddqq", b)
-        return cls(c, s, mn, mx, mnt, mxt)
+    def unpack_from(cls, buf, pos: int, version: int):
+        c, s, mn, mx, mnt, mxt = struct.unpack_from("<qdddqq", buf, pos)
+        pos += _PREAGG_HEAD
+        pa = cls(c, s, mn, mx, mnt, mxt)
+        if version < 2:
+            return pa, pos
+        (has_limbs,) = struct.unpack_from("<?", buf, pos)
+        pos += 1
+        if has_limbs:
+            vals = struct.unpack_from("<i?6q", buf, pos)
+            pos += struct.calcsize("<i?6q")
+            pa.scale, pa.exact = vals[0], vals[1]
+            pa.limbs = tuple(vals[2:])
+        return pa, pos
 
-PREAGG_SIZE = struct.calcsize("<qdddqq")
+_PREAGG_HEAD = struct.calcsize("<qdddqq")
 
 
 @dataclass
@@ -125,7 +147,8 @@ def _pack_chunk_meta(cm: ChunkMeta) -> bytes:
     return b"".join(out)
 
 
-def _unpack_chunk_meta(buf, pos: int) -> tuple[ChunkMeta, int]:
+def _unpack_chunk_meta(buf, pos: int,
+                       version: int = VERSION) -> tuple[ChunkMeta, int]:
     sid, mnt, mxt, rows, ncols, regular = struct.unpack_from("<QqqqH?", buf,
                                                              pos)
     pos += struct.calcsize("<QqqqH?")
@@ -142,8 +165,7 @@ def _unpack_chunk_meta(buf, pos: int) -> tuple[ChunkMeta, int]:
             pos += struct.calcsize("<QIIQI?")
             pa = None
             if has_pa:
-                pa = PreAgg.unpack(buf[pos:pos + PREAGG_SIZE])
-                pos += PREAGG_SIZE
+                pa, pos = PreAgg.unpack_from(buf, pos, version)
             col.segments.append(Segment(off, size, rws, voff, vsize, pa))
         cm.columns.append(col)
     return cm, pos
@@ -206,8 +228,22 @@ def _compute_preagg(col: ColVal, times: np.ndarray, lo: int,
         return PreAgg(0, 0.0, 0.0, 0.0, 0, 0)
     vm = v[m]
     tm = t[m]
-    return PreAgg(cnt, float(vm.sum(dtype=np.float64)), float(vm.min()),
-                  float(vm.max()), int(tm.min()), int(tm.max()))
+    pa = PreAgg(cnt, float(vm.sum(dtype=np.float64)), float(vm.min()),
+                float(vm.max()), int(tm.min()), int(tm.max()))
+    if col.type in (DataType.FLOAT, DataType.INTEGER):
+        # reproducible-sum limb state (v2): exact unless the segment's
+        # dynamic range exceeds the 108-bit limb span
+        from ..ops import exactsum
+        vf = vm.astype(np.float64, copy=False)
+        mx = float(np.max(np.abs(vf)))
+        if np.isfinite(mx):
+            E = exactsum.pick_scale(mx)
+            limbs, res = exactsum.decompose(vf, E)
+            pa.limbs = tuple(int(x) for x in
+                             limbs.sum(axis=0, dtype=np.float64))
+            pa.scale = E
+            pa.exact = bool(np.all(res == 0.0))
+    return pa
 
 
 class TSSPWriter:
@@ -337,8 +373,9 @@ class TSSPReader:
         tsize, tail_magic = struct.unpack("<II", mm[len(mm) - 8:len(mm)])
         if magic != MAGIC or tail_magic != MAGIC:
             raise ValueError(f"{path}: bad TSSP magic")
-        if version != VERSION:
+        if version not in (1, VERSION):
             raise ValueError(f"{path}: unsupported version {version}")
+        self.version = version
         tr = struct.unpack(_TRAILER_FMT,
                            mm[len(mm) - 8 - tsize:len(mm) - 8])
         (self.data_end, self.meta_off, self.meta_size, self.idx_off,
@@ -381,7 +418,7 @@ class TSSPReader:
         metas: dict[int, ChunkMeta] = {}
         pos = 0
         for _ in range(count):
-            cm, pos = _unpack_chunk_meta(blob, pos)
+            cm, pos = _unpack_chunk_meta(blob, pos, self.version)
             metas[cm.sid] = cm
         self._meta_cache[gi] = metas
         return metas

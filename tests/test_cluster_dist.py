@@ -286,3 +286,27 @@ def test_cluster_incremental_agg(loaded):
     bad = sqlex.execute(parse_query("SELECT count(usage) FROM cpu")[0],
                         "tsbs", inc_query_id="x", iter_id=0)
     assert "error" in bad
+
+
+def test_bit_identical_sum_mean_across_topologies(loaded):
+    """North-star gate (VERDICT r1 #3): non-integral f64 sums/means are
+    BIT-IDENTICAL between the 2-store cluster, the single-node engine,
+    and math.fsum of the raw rows — no tolerance."""
+    import math
+    q = ("SELECT sum(usage), mean(usage), count(usage) FROM cpu "
+         "WHERE time >= 0 AND time < 10m GROUP BY time(1m)")
+    cl = _cluster_result(loaded, q)
+    ref = _ref_result(loaded, q)
+    assert cl == ref                     # exact structural equality
+    # independent host reference: correctly-rounded exact sums
+    per_w: dict = {}
+    for r in loaded["rows"]:
+        if r.measurement == "cpu" and "usage" in r.fields \
+                and 0 <= r.time < 10 * MIN:
+            per_w.setdefault(r.time // MIN, []).append(r.fields["usage"])
+    got = {row[0] // MIN: row for row in cl["series"][0]["values"]}
+    for w, vals in per_w.items():
+        exact = math.fsum(vals)
+        assert got[w][1] == exact
+        assert got[w][2] == exact / len(vals)
+        assert got[w][3] == len(vals)

@@ -1,0 +1,142 @@
+"""Reproducible-sum limbs: exact decomposition, order-free merging,
+correctly-rounded finalization (the bit-identical north-star machinery)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops.exactsum import (K_LIMBS, LIMB_BITS, decompose,
+                                         exact_dense_sum,
+                                         exact_segment_sum,
+                                         exact_segment_sum_host,
+                                         finalize_exact, limb_scales,
+                                         merge_limbs, pick_scale, rebase)
+
+
+def test_decompose_is_exact():
+    rng = np.random.default_rng(0)
+    v = np.concatenate([
+        rng.normal(0, 1e3, 500),
+        rng.normal(0, 1e-3, 500),
+        np.array([0.0, -0.0, 1.0, -1.0, 0.1, -0.1, 1e6, 1e-6]),
+    ])
+    E = pick_scale(np.max(np.abs(v)))
+    limbs, res = decompose(v, E)
+    scales = limb_scales(E)
+    recon = (limbs * scales).sum(axis=1) + res
+    assert np.array_equal(recon, v)          # bit-exact reconstruction
+    assert np.all(np.abs(limbs) < (1 << LIMB_BITS))
+
+
+def test_residual_zero_within_span():
+    """Values whose mantissa fits inside the 108-bit span decompose with
+    residual exactly 0 (the exact-flag criterion)."""
+    rng = np.random.default_rng(1)
+    v = rng.normal(50, 10, 1000)             # ~2^6 dynamic range
+    E = pick_scale(np.max(np.abs(v)))
+    _limbs, res = decompose(v, E)
+    assert np.all(res == 0.0)
+    # huge dynamic range: small values lose bits → nonzero residual
+    v2 = np.array([1e20, 1e-18])
+    _l2, r2 = decompose(v2, pick_scale(1e20))
+    assert r2[1] != 0.0
+
+
+def test_host_sum_matches_fsum():
+    rng = np.random.default_rng(2)
+    n, S = 5000, 7
+    v = rng.normal(3.7, 2.1, n)
+    seg = rng.integers(0, S, n)
+    valid = rng.random(n) > 0.05
+    E = pick_scale(np.max(np.abs(v)))
+    limbs, inexact = exact_segment_sum_host(v, valid, seg, S, E)
+    assert not inexact.any()
+    out = finalize_exact(limbs, E)
+    for s in range(S):
+        ref = math.fsum(v[(seg == s) & valid])
+        assert out[s] == ref                  # correctly rounded == fsum
+
+
+def test_order_free_and_merge_identical():
+    """Any partition of the rows into partial sums (even with different
+    scales) merges to the same bits as the one-pass sum."""
+    rng = np.random.default_rng(3)
+    n, S = 4000, 5
+    v = rng.normal(0, 100, n)
+    seg = rng.integers(0, S, n)
+    valid = np.ones(n, dtype=bool)
+    E_all = pick_scale(np.max(np.abs(v)))
+    one, ix1 = exact_segment_sum_host(v, valid, seg, S, E_all)
+    ref = finalize_exact(one, E_all)
+
+    for cut in (1, 137, 2000, 3999):
+        a_v, b_v = v[:cut], v[cut:]
+        Ea = pick_scale(np.max(np.abs(a_v)) if cut else 0.0)
+        Eb = pick_scale(np.max(np.abs(b_v)) if cut < n else 0.0)
+        la, ia = exact_segment_sum_host(a_v, valid[:cut], seg[:cut], S, Ea)
+        lb, ib = exact_segment_sum_host(b_v, valid[cut:], seg[cut:], S, Eb)
+        lm, im, Em = merge_limbs(la, ia, Ea, lb, ib, Eb)
+        assert not im.any()
+        got = finalize_exact(lm, Em)
+        assert np.array_equal(got, ref)
+
+
+def test_device_paths_match_host():
+    from opengemini_tpu.ops.exactsum import host_limbs, segment_bad_flags
+    rng = np.random.default_rng(4)
+    n, S = 2048, 6
+    v = rng.normal(-7.3, 55.0, n)
+    seg = rng.integers(0, S, n).astype(np.int64)
+    valid = rng.random(n) > 0.1
+    E = pick_scale(np.max(np.abs(v)))
+    h, hix = exact_segment_sum_host(v, valid, seg, S, E)
+    limbs_i32, bad = host_limbs(v, valid, E)
+    d = exact_segment_sum(limbs_i32, seg, S)
+    dix = segment_bad_flags(bad, seg, S)
+    assert np.array_equal(np.asarray(d).astype(np.float64), h)
+    assert np.array_equal(dix, hix)
+    # dense: reshape into (S2, P)
+    v2 = v[:2000].reshape(100, 20)
+    m2 = valid[:2000].reshape(100, 20)
+    dl2, dbad = host_limbs(v2, m2, E)
+    dl = exact_dense_sum(dl2)
+    for i in range(100):
+        ref = math.fsum(v2[i][m2[i]])
+        assert finalize_exact(np.asarray(dl)[i].astype(np.float64),
+                              E) == ref
+    assert not dbad.any(axis=1).any()
+
+
+def test_nonfinite_marks_inexact():
+    v = np.array([1.0, np.inf, 2.0, np.nan])
+    seg = np.array([0, 0, 1, 1])
+    valid = np.ones(4, dtype=bool)
+    E = pick_scale(2.0)
+    _l, ix = exact_segment_sum_host(v, valid, seg, 2, E)
+    assert ix.tolist() == [True, True]
+
+
+def test_rebase_drops_flag_only_when_bits_lost():
+    v = np.array([1.5, 2.25])
+    E = pick_scale(4.0)
+    limbs, res = decompose(v, E)
+    tot = limbs.sum(axis=0)[None, :]
+    r1, ix1 = rebase(tot, np.zeros(1, bool), E, E + LIMB_BITS)
+    # 1.5+2.25=3.75 needs bits down to 2^-2; one-limb shift keeps span
+    # E+18-108 … still below 2^-2 → no loss
+    assert not ix1.any()
+    assert finalize_exact(r1, E + LIMB_BITS)[0] == 3.75
+    r2, ix2 = rebase(tot, np.zeros(1, bool), E, E + 6 * LIMB_BITS)
+    assert ix2.any()                          # everything shifted out
+
+
+def test_negative_and_cancellation():
+    v = np.array([1e15, 1.0, -1e15, 1e-8, 3.0, -4.0])
+    E = pick_scale(1e15)
+    limbs, res = decompose(v, E)
+    got = finalize_exact(limbs.sum(axis=0)[None, :], E)[0]
+    if np.all(res == 0.0):
+        assert got == math.fsum(v)
+    # catastrophic cancellation handled exactly either way
+    assert got == pytest.approx(math.fsum(v), abs=2 ** (E - 108))

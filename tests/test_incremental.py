@@ -13,6 +13,19 @@ from opengemini_tpu.utils.lineprotocol import parse_lines
 MIN = 60 * 10**9
 
 
+def _poison(partial, field, gi, wi, value):
+    """Overwrite a cached cell's sum with a sentinel — through the exact
+    limb state too, which finalize prefers over the f64 sum grid."""
+    st = partial["fields"][field]
+    st["sum"][gi, wi] = value
+    if "sum_limbs" in st:
+        from opengemini_tpu.ops.exactsum import decompose
+        E = partial["sum_scales"][field]
+        limbs, _res = decompose(__import__("numpy").array([value]), E)
+        st["sum_limbs"][gi, wi] = limbs[0]
+        st["sum_inexact"][gi, wi] = False
+
+
 @pytest.fixture
 def db(tmp_path):
     eng = Engine(str(tmp_path / "data"))
@@ -78,7 +91,7 @@ def test_inc_iter_uses_cache_not_rescan(db):
     entry = ex.inc_cache.get("d3")
     assert entry is not None and entry.watermark == 2 * MIN
     # poison the cached prefix to prove it is what iter 1 serves
-    entry.partial["fields"]["v"]["sum"][0, 0] = 999.0
+    _poison(entry.partial, "v", 0, 0, 999.0)
     r1 = q(ex, QUERY, inc_query_id="d3", iter_id=1)
     assert rows_of(r1)["a"][0][1] == 999.0
 
@@ -168,7 +181,7 @@ def test_inc_sliding_range_reuses_cache(db):
     entry = ex.inc_cache.get("s1")
     assert entry.watermark == 3 * MIN
     # poison a cached window that survives the slide (w=2)
-    entry.partial["fields"]["v"]["sum"][0, 2] = 77.0
+    _poison(entry.partial, "v", 0, 2, 77.0)
     # range slides forward by 2 aligned windows
     q1 = ("SELECT mean(v) FROM m WHERE time >= 2m AND time < 8m "
           "GROUP BY time(1m)")

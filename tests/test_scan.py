@@ -76,8 +76,12 @@ def test_preagg_path_fires_and_matches(db):
         assert np.isclose(row[3], v.sum())
         assert row[4] == v.min()
         assert row[5] == v.max()
-    # the fast path actually fired: EXPLAIN ANALYZE reader_scan span
-    ares = explain(ex, text)
+    # the fast path actually fired: EXPLAIN ANALYZE reader_scan span.
+    # (sum/mean need values while exact-sum mode is on, so the pre-agg
+    # probe uses count/min/max only)
+    ares = explain(ex, "SELECT count(usage), min(usage), max(usage) "
+                       "FROM cpu WHERE time >= 0 AND time < 2560s "
+                       "GROUP BY host")
     txt = _span_text(ares)
     assert "preagg_segments" in txt
     import re
@@ -292,3 +296,40 @@ def test_residual_filtering_everything_returns_empty(db):
     res = q(ex, "SELECT count(usage) FROM cpu WHERE usage > 1e12 "
                "GROUP BY time(1m)")
     assert res.get("series") in (None, [])
+
+
+def test_dense_fractional_sums_with_empty_sparse_residue(db):
+    """Regression: when ALL rows go dense (no sparse residue), the host
+    zero-state grids must stay float64 — an int64 sum grid would
+    truncate the dense kernel's fractional sums on merge."""
+    eng, ex = db
+    lines = []
+    for i in range(120):
+        lines.append(f"m,host=a v={i % 7}.125 {i * 10 * 10**9}")
+    write(eng, "\n".join(lines))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT sum(v) FROM m WHERE time >= 0 AND time < 1200s "
+               "GROUP BY time(1m)")
+    total = sum(r[1] for r in res["series"][0]["values"])
+    assert total == sum(i % 7 + 0.125 for i in range(120))
+
+
+def test_preagg_limbs_serve_exact_mean(db):
+    """v2 pre-agg limb states let sum/mean queries keep the zero-decode
+    metadata path AND stay bit-identical (== math.fsum)."""
+    import math
+    import re
+    eng, ex = db
+    vals = seed_regular(eng, hosts=2)
+    text = ("SELECT mean(usage), sum(usage) FROM cpu "
+            "WHERE time >= 0 AND time < 2560s GROUP BY host")
+    ares = explain(ex, text)
+    m = re.search(r'preagg_segments=(\d+)', _span_text(ares))
+    assert m and int(m.group(1)) >= 2 * 4
+    res = q(ex, text)
+    for s in res["series"]:
+        h = int(s["tags"]["host"][1:])
+        exact = math.fsum(vals[h])
+        assert s["values"][0][2] == exact
+        assert s["values"][0][1] == exact / 256
