@@ -458,6 +458,57 @@ class HttpServer:
 
     # --------------------------------------------------- prom endpoints
 
+    def handle_prom_remote(self, path: str, params: dict, body: bytes
+                           ) -> tuple[int, dict | None, bytes | None]:
+        """Prometheus remote write/read: snappy-block protobuf bodies
+        (reference handler_prom.go:54,146). Returns (code, json_payload,
+        raw_body) — raw_body set for the binary read response."""
+        from ..prom import (decode_read_request, decode_write_request,
+                            encode_read_response, handle_remote_read,
+                            rows_from_write_request)
+        # default to the PromQL engine's database so /api/v1/query sees
+        # remote-written samples
+        db = params.get("db") or (self.prom.db if self.prom is not None
+                                  else "prometheus")
+        if path.endswith("/write"):
+            if self.sysctrl.readonly:
+                self._bump("write_errors")
+                return 403, {"error": "server is in readonly mode"}, None
+            try:
+                rows = rows_from_write_request(decode_write_request(body))
+            except Exception as e:
+                self._bump("write_errors")
+                return 400, {"error": f"bad remote write body: {e}"}, None
+            try:
+                n = self.engine.write_points(db, rows)
+            except GeminiError as e:
+                self._bump("write_errors")
+                return 400, {"error": str(e)}, None
+            except Exception as e:  # engine bug must not kill the conn
+                log.exception("prom remote write failed")
+                self._bump("write_errors")
+                return 500, {"error": f"internal error: {e}"}, None
+            self._bump("writes")
+            self._bump("points_written", n)
+            return 204, {}, None
+        try:
+            req = decode_read_request(body)
+        except Exception as e:
+            return 400, {"error": f"bad remote read body: {e}"}, None
+        eng = self.engine
+        if not hasattr(eng, "database"):
+            # cluster facade: remote read runs store-side
+            eng = getattr(eng, "engine", None)
+            if eng is None:
+                return 501, {"error": "remote read not available "
+                             "on this node"}, None
+        try:
+            resp = handle_remote_read(eng, db, req)
+        except Exception as e:
+            log.exception("remote read failed")
+            return 500, {"error": f"internal error: {e}"}, None
+        return 200, None, encode_read_response(resp)
+
     def handle_prom(self, path: str, params: dict,
                     multi: dict | None = None) -> tuple[int, dict]:
         """Parse/format only — evaluation and metadata lookups live in
@@ -821,6 +872,24 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = srv.handle_logstore("POST", path,
                                                 self._params(), body)
             self._reply(code, payload)
+            return
+        if path in ("/api/v1/prom/write", "/api/v1/prom/read"):
+            try:
+                body = self._body()
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload, raw = srv.handle_prom_remote(
+                path, self._params(), body)
+            if raw is not None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/x-protobuf")
+                self.send_header("Content-Encoding", "snappy")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            self._reply(code, payload if code != 204 else None)
             return
         if path.startswith("/api/v1/"):
             try:

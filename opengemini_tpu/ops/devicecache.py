@@ -1,0 +1,99 @@
+"""Device-resident block cache — the readcache analog one tier up.
+
+Role of reference lib/readcache/blockcache.go, moved onto the device:
+the host readcache already skips DECODE for hot segments; this cache
+skips the host→device transfer, the (S, P) assembly, and the exact-sum
+limb decomposition for repeated queries over unchanged files (the
+dashboard steady state). Entries are jax Arrays keyed by a fingerprint
+of the immutable source segments (file path + offset + trim), so
+compaction — which writes new paths — naturally invalidates.
+
+Byte-budgeted LRU; OG_DEVICE_CACHE_MB sets the budget (0 disables).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+_MB = 1024 * 1024
+
+
+class DeviceBlockCache:
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._map: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _nbytes(arr) -> int:
+        try:
+            return int(arr.nbytes)
+        except Exception:
+            return 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def contains(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def put(self, key: tuple, arr) -> None:
+        nb = self._nbytes(arr) + 64
+        if nb > self.capacity:
+            return
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._map[key] = (arr, nb)
+            self._bytes += nb
+            while self._bytes > self.capacity and self._map:
+                # NO eager buf.delete(): an in-flight query may hold a
+                # pinned reference from get(); HBM frees when the last
+                # reference drops
+                _k, (_buf, nb) = self._map.popitem(last=False)
+                self._bytes -= nb
+                self.evictions += 1
+
+    def purge(self) -> None:
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._map), "bytes": self._bytes,
+                    "capacity": self.capacity, "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}
+
+
+_CACHE: DeviceBlockCache | None = None
+
+
+def capacity_bytes() -> int:
+    return int(os.environ.get("OG_DEVICE_CACHE_MB", "1024")) * _MB
+
+
+def enabled() -> bool:
+    return capacity_bytes() > 0
+
+
+def global_cache() -> DeviceBlockCache:
+    global _CACHE
+    if _CACHE is None:
+        _CACHE = DeviceBlockCache(capacity_bytes())
+    return _CACHE

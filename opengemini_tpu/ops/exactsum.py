@@ -117,25 +117,41 @@ def host_limbs(values: np.ndarray, valid: np.ndarray | None, E: int):
     return limbs.astype(np.int32), bad
 
 
-@functools.partial(
-    __import__("jax").jit, static_argnames=("num_segments", "sorted_ids"))
+_JITTED: dict = {}
+
+
 def exact_segment_sum(limbs_i32, seg_ids, num_segments: int,
                       sorted_ids: bool = False):
     """Device sparse path: int64 segment sums of host-decomposed int32
-    limb planes — exact integer arithmetic on the device."""
-    import jax
-    import jax.numpy as jnp
-    ns = num_segments + 1
-    sums = jax.ops.segment_sum(limbs_i32.astype(jnp.int64), seg_ids, ns,
-                               indices_are_sorted=sorted_ids)
-    return sums[:num_segments]
+    limb planes — exact integer arithmetic on the device. (jit built
+    lazily so importing this module never initializes a backend.)"""
+    fn = _JITTED.get("seg")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("num_segments", "sorted_ids"))
+        def _f(limbs_i32, seg_ids, num_segments, sorted_ids):
+            ns = num_segments + 1
+            sums = jax.ops.segment_sum(limbs_i32.astype(jnp.int64),
+                                       seg_ids, ns,
+                                       indices_are_sorted=sorted_ids)
+            return sums[:num_segments]
+        _JITTED["seg"] = fn = _f
+    return fn(limbs_i32, seg_ids, num_segments=num_segments,
+              sorted_ids=sorted_ids)
 
 
-@functools.partial(__import__("jax").jit)
 def exact_dense_sum(limbs_i32):
     """Device dense path: (S, P, K) int32 limbs → (S, K) int64 sums."""
-    import jax.numpy as jnp
-    return limbs_i32.astype(jnp.int64).sum(axis=1)
+    fn = _JITTED.get("dense")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        _JITTED["dense"] = fn = jax.jit(
+            lambda x: x.astype(jnp.int64).sum(axis=1))
+    return fn(limbs_i32)
 
 
 def segment_bad_flags(bad: np.ndarray, seg_ids: np.ndarray,
@@ -180,13 +196,13 @@ def finalize_exact(limbs: np.ndarray, E: int) -> np.ndarray:
     correctly rounded and the 2^(E-108) scaling is exact (power of two),
     so the result equals math.fsum of the original values wherever the
     exact flag held."""
-    flat = limbs.reshape(-1, K_LIMBS)
-    # big-int packing, vectorized over object dtype (limb sums are
-    # integers ≤ n·2^18 — far inside f64's exact-integer range)
-    total = flat[:, 0].astype(np.int64).astype(object)
-    for k in range(1, K_LIMBS):
-        total = total * _RADIX + flat[:, k].astype(np.int64).astype(object)
+    flat = limbs.reshape(-1, K_LIMBS).astype(np.int64)
     scale = 2.0 ** float(E - SPAN_BITS)
+    # big-int packing over object dtype (limb sums exceed int64 once
+    # packed: 6×18 bits plus carry headroom)
+    total = flat[:, 0].astype(object)
+    for k in range(1, K_LIMBS):
+        total = total * _RADIX + flat[:, k].astype(object)
     out = np.fromiter((float(t) for t in total), dtype=np.float64,
                       count=len(total))
     out *= scale

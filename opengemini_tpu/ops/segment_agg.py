@@ -139,7 +139,8 @@ def _extremum_time_segment(values, valid, times, seg_ids, ns,
                            num_segments, sorted_ids, is_min: bool):
     """Earliest time of each segment's extremum point (sparse layout).
     XLA CSEs the recomputed extremum against the spec.min/max reduction."""
-    ident = jnp.array(jnp.inf if is_min else -jnp.inf, values.dtype)
+    pos, neg = _minmax_idents(values.dtype)
+    ident = pos if is_min else neg
     seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
     ext = seg_red(jnp.where(valid, values, ident), seg_ids, ns,
                   indices_are_sorted=sorted_ids)
@@ -149,12 +150,23 @@ def _extremum_time_segment(values, valid, times, seg_ids, ns,
         indices_are_sorted=sorted_ids)[:num_segments]
 
 
+def _minmax_idents(dt):
+    """±identity for min/max masking, dtype-aware: integer columns run
+    typed kernels (int64 sums are exact AND order-free — the
+    bit-identical path for integers needs no limb machinery)."""
+    if jnp.issubdtype(dt, jnp.integer):
+        info = jnp.iinfo(dt)
+        return jnp.array(info.max, dt), jnp.array(info.min, dt)
+    return jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)
+
+
 def _segment_all(values, valid, seg_ids, num_segments: int,
                  spec: AggSpec, sorted_ids: bool):
     """Shared kernel body; num_segments includes NO trash segment — callers
     pass seg_ids already clipped to [0, num_segments]."""
     ns = num_segments + 1  # +1 trash segment for padding/out-of-range rows
     fdt = values.dtype
+    pos_ident, neg_ident = _minmax_idents(fdt)
     res = {}
     vz = jnp.where(valid, values, jnp.zeros((), fdt))
     if spec.count or spec.sum:
@@ -170,11 +182,11 @@ def _segment_all(values, valid, seg_ids, num_segments: int,
                                  indices_are_sorted=sorted_ids)
         res["sumsq"] = sq[:num_segments]
     if spec.min:
-        vmin = jnp.where(valid, values, jnp.array(jnp.inf, fdt))
+        vmin = jnp.where(valid, values, pos_ident)
         res["min"] = jax.ops.segment_min(vmin, seg_ids, ns,
                                          indices_are_sorted=sorted_ids)[:num_segments]
     if spec.max:
-        vmax = jnp.where(valid, values, jnp.array(-jnp.inf, fdt))
+        vmax = jnp.where(valid, values, neg_ident)
         res["max"] = jax.ops.segment_max(vmax, seg_ids, ns,
                                          indices_are_sorted=sorted_ids)[:num_segments]
     return res
@@ -221,14 +233,16 @@ def segment_aggregate(values: jax.Array,
                                      indices_are_sorted=sorted_ids)[:num_segments]
             safe = jnp.minimum(fi, n - 1)
             has = fi < n
-            first = jnp.where(has, values[safe], jnp.nan)
+            # first/last stay f64 even for typed integer columns: the
+            # merge protocol marks empty cells with NaN
+            first = jnp.where(has, values[safe].astype(_F64), jnp.nan)
             first_t = jnp.where(has, times[safe], 0)
         if spec.last:
             li = jax.ops.segment_max(jnp.where(valid, idx, -1), seg_ids, ns,
                                      indices_are_sorted=sorted_ids)[:num_segments]
             safe = jnp.maximum(li, 0)
             has = li >= 0
-            last = jnp.where(has, values[safe], jnp.nan)
+            last = jnp.where(has, values[safe].astype(_F64), jnp.nan)
             last_t = jnp.where(has, times[safe], 0)
     return SegmentAggResult(
         count=res.get("count"), sum=res.get("sum"), sumsq=res.get("sumsq"),
@@ -382,23 +396,34 @@ def segment_aggregate_host(values: np.ndarray,
     s = seg_ids[keep]
     v = values[keep]
     n = len(values)
+    is_int = np.issubdtype(values.dtype, np.integer)
     res: dict[str, np.ndarray | None] = {}
     if spec.count or spec.sum:
         res["count"] = np.bincount(s, minlength=S).astype(np.int64)
-    # bincount degenerates to int64 on EMPTY weights — force the device
-    # kernel's float64 state dtype or downstream merges would truncate
     if spec.sum:
-        res["sum"] = np.bincount(s, weights=v, minlength=S).astype(
-            np.float64, copy=False)
+        if is_int:
+            acc = np.zeros(S, dtype=np.int64)
+            np.add.at(acc, s, v)
+            res["sum"] = acc
+        else:
+            # bincount degenerates to int64 on EMPTY weights — force the
+            # device kernel's float64 state dtype or downstream merges
+            # would truncate
+            res["sum"] = np.bincount(s, weights=v, minlength=S).astype(
+                np.float64, copy=False)
     if spec.sumsq:
-        res["sumsq"] = np.bincount(s, weights=v * v, minlength=S).astype(
-            np.float64, copy=False)
+        vf = v.astype(np.float64, copy=False)   # square AFTER the cast:
+        res["sumsq"] = np.bincount(             # int64 squares wrap
+            s, weights=vf * vf,
+            minlength=S).astype(np.float64, copy=False)
     if spec.min:
-        mn = np.full(S, np.inf)
+        mn = np.full(S, np.iinfo(np.int64).max, dtype=np.int64) \
+            if is_int else np.full(S, np.inf)
         np.minimum.at(mn, s, v)
         res["min"] = mn
     if spec.max:
-        mx = np.full(S, -np.inf)
+        mx = np.full(S, np.iinfo(np.int64).min, dtype=np.int64) \
+            if is_int else np.full(S, -np.inf)
         np.maximum.at(mx, s, v)
         res["max"] = mx
     min_t = max_t = None
@@ -425,14 +450,16 @@ def segment_aggregate_host(values: np.ndarray,
             np.minimum.at(fi, s, idx)
             has = fi < n
             safe = np.minimum(fi, max(n - 1, 0))
-            first = np.where(has, values[safe] if n else np.nan, np.nan)
+            first = np.where(has, values[safe].astype(np.float64)
+                             if n else np.nan, np.nan)
             first_t = np.where(has, times[safe] if n else 0, 0)
         if spec.last:
             li = np.full(S, -1, dtype=np.int64)
             np.maximum.at(li, s, idx)
             has = li >= 0
             safe = np.maximum(li, 0)
-            last = np.where(has, values[safe] if n else np.nan, np.nan)
+            last = np.where(has, values[safe].astype(np.float64)
+                            if n else np.nan, np.nan)
             last_t = np.where(has, times[safe] if n else 0, 0)
     return SegmentAggResult(
         count=res.get("count"), sum=res.get("sum"),

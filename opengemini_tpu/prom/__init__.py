@@ -1,0 +1,17 @@
+"""Prometheus remote read/write (reference handler_prom.go:54 write,
+:146 read): snappy-block-compressed protobuf bodies on
+/api/v1/prom/write and /api/v1/prom/read.
+
+Mapping (same as the reference's prom ingest): metric name → measurement,
+labels → tags, the sample value → the ``value`` float field — exactly
+the shape promql/engine.py reads."""
+
+from .remote import (decode_read_request, decode_write_request,
+                     encode_read_response, handle_remote_read,
+                     rows_from_write_request, snappy_compress,
+                     snappy_decompress)
+
+__all__ = ["decode_write_request", "decode_read_request",
+           "encode_read_response", "handle_remote_read",
+           "rows_from_write_request", "snappy_compress",
+           "snappy_decompress"]

@@ -1,0 +1,168 @@
+"""Prometheus remote read/write codec + executor glue.
+
+Reference: lib/util/lifted/influx/httpd/handler_prom.go:54 (servePromWrite
+→ snappy.Decode → proto.Unmarshal → points), :146 (servePromRead →
+per-query series matching → QueryResult). The wire format is the public
+prompb protocol (remote.proto, compiled to remote_pb2.py with protoc).
+
+Snappy BLOCK format (not the framed stream) via pyarrow's bundled codec;
+the block's leading uvarint carries the uncompressed length pyarrow needs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage.rows import PointRow
+from ..utils import get_logger
+from . import remote_pb2 as pb
+
+log = get_logger(__name__)
+
+MS = 10**6                     # prom timestamps are ms; engine is ns
+VALUE_FIELD = "value"
+MAX_DECOMPRESSED = 1 << 30     # 1 GiB guard against decompression bombs
+
+
+def _uvarint(buf: bytes) -> tuple[int, int]:
+    x = s = 0
+    for i, b in enumerate(buf[:10]):
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i + 1
+        s += 7
+    raise ValueError("bad snappy length varint")
+
+
+def snappy_decompress(body: bytes) -> bytes:
+    import pyarrow as pa
+    n, _hdr = _uvarint(body)
+    if n > MAX_DECOMPRESSED:
+        raise ValueError(f"snappy payload too large: {n}")
+    return pa.decompress(body, decompressed_size=n, codec="snappy",
+                         asbytes=True)
+
+
+def snappy_compress(body: bytes) -> bytes:
+    import pyarrow as pa
+    return pa.compress(body, codec="snappy", asbytes=True)
+
+
+# ------------------------------------------------------------------ write
+
+def decode_write_request(body: bytes) -> "pb.WriteRequest":
+    return pb.WriteRequest.FromString(snappy_decompress(body))
+
+
+def rows_from_write_request(req: "pb.WriteRequest") -> list[PointRow]:
+    """WriteRequest → engine rows: __name__ → measurement, labels →
+    tags, value field carries the sample (promql/engine.py mapping).
+    NaN samples are prometheus stale markers — dropped."""
+    rows: list[PointRow] = []
+    for ts in req.timeseries:
+        name = None
+        tags: dict[str, str] = {}
+        for lb in ts.labels:
+            if lb.name == "__name__":
+                name = lb.value
+            else:
+                tags[lb.name] = lb.value
+        if not name:
+            continue
+        for s in ts.samples:
+            if s.value != s.value:          # NaN stale marker
+                continue
+            rows.append(PointRow(name, tags, {VALUE_FIELD: s.value},
+                                 int(s.timestamp) * MS))
+    return rows
+
+
+# ------------------------------------------------------------------- read
+
+def decode_read_request(body: bytes) -> "pb.ReadRequest":
+    return pb.ReadRequest.FromString(snappy_decompress(body))
+
+
+_MATCH_OPS = {pb.LabelMatcher.EQ: "=", pb.LabelMatcher.NEQ: "!=",
+              pb.LabelMatcher.RE: "=~", pb.LabelMatcher.NRE: "!~"}
+
+
+def _match_name(matchers, measurements: list[str]) -> list[str]:
+    """Resolve the __name__ matcher to measurements."""
+    import re
+    out = measurements
+    for m in matchers:
+        if m.name != "__name__":
+            continue
+        op = _MATCH_OPS[m.type]
+        if op == "=":
+            out = [n for n in out if n == m.value]
+        elif op == "!=":
+            out = [n for n in out if n != m.value]
+        else:
+            rx = re.compile(m.value)
+            keep = [n for n in out if rx.search(n)]
+            out = keep if op == "=~" else \
+                [n for n in out if n not in set(keep)]
+    return out
+
+
+def handle_remote_read(engine, db: str, req: "pb.ReadRequest"
+                       ) -> "pb.ReadResponse":
+    """Per query: match series via the tag index, stream raw samples in
+    the range (the reference's remote-read path returns raw series; any
+    PromQL evaluation — rate() etc. — happens in the client
+    prometheus)."""
+    from ..index import TagFilter
+
+    resp = pb.ReadResponse()
+    try:
+        db_obj = engine.database(db)
+    except KeyError:
+        for _q in req.queries:
+            resp.results.add()
+        return resp
+    for q in req.queries:
+        result = resp.results.add()
+        t_lo = int(q.start_timestamp_ms) * MS
+        t_hi = int(q.end_timestamp_ms) * MS
+        filters = [TagFilter(m.name, m.value, _MATCH_OPS[m.type])
+                   for m in q.matchers if m.name != "__name__"]
+        shards = db_obj.shards_overlapping(t_lo, t_hi)
+        msts = sorted({m for s in shards for m in s.measurements()})
+        # per (metric, labelset): samples merged across shards
+        out: dict[tuple, dict] = {}
+        for name in _match_name(q.matchers, msts):
+            for s in shards:
+                for sid in s.series_ids(name, filters).tolist():
+                    rec = s.read_series(name, sid, [VALUE_FIELD],
+                                        t_lo, t_hi)
+                    if rec is None or rec.num_rows == 0:
+                        continue
+                    col = rec.column(VALUE_FIELD)
+                    if col is None or col.values is None:
+                        continue
+                    tags = s.index.tags_of(sid)
+                    key = (name, tuple(sorted(tags.items())))
+                    ent = out.setdefault(key, {"t": [], "v": []})
+                    m = col.valid
+                    ent["t"].append(rec.times[m])
+                    ent["v"].append(
+                        col.values[m].astype(np.float64, copy=False))
+        for (name, tags), ent in sorted(out.items()):
+            ts = result.timeseries.add()
+            ts.labels.add(name="__name__", value=name)
+            for k, v in tags:
+                ts.labels.add(name=k, value=v)
+            t = np.concatenate(ent["t"])
+            v = np.concatenate(ent["v"])
+            order = np.argsort(t, kind="stable")
+            t_ms = (t[order] // MS).tolist()
+            vals = v[order].tolist()
+            for tm, vv in zip(t_ms, vals):
+                ts.samples.add(value=vv, timestamp=tm)
+    return resp
+
+
+def encode_read_response(resp: "pb.ReadResponse") -> bytes:
+    return snappy_compress(resp.SerializeToString())

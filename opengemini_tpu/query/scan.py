@@ -87,6 +87,7 @@ class ScanStats:
     decoded_segments: int = 0
     dense_segments: int = 0
     dense_rows: int = 0
+    dense_cache_hits: int = 0
     merged_series: int = 0
     direct_series: int = 0
     memtable_chunks: int = 0
@@ -98,10 +99,18 @@ class DenseGroup:
     exactly P points each, mapping to grid cell ``cells[s]``. Feeds
     dense_window_aggregate — pure axis reductions, no scatter (the TSBS
     fast path; detected from CONST_DELTA time blocks as promised in
-    ops/segment_agg.py)."""
+    ops/segment_agg.py).
+
+    ``fingerprint`` identifies the immutable source bytes (file paths +
+    segment offsets + trims, in assembly order) — the device block
+    cache's key. ``cached=True`` means the caller vouched the device
+    cache holds this group's blocks, so ``fields`` is left empty and no
+    host assembly happened."""
     P: int
     cells: np.ndarray                       # (S,) int64 in [0, G*W]
     fields: dict[str, tuple[np.ndarray, np.ndarray]]  # (S,P) vals/valid
+    fingerprint: str = ""
+    cached: bool = False
 
 
 @dataclass
@@ -351,33 +360,52 @@ def _dense_plan(t0: int, step: int, n: int, t_lo, t_hi,
     return a, b, lo, f, P, wfull
 
 
-def _run_dense(d: _DenseTask, needed: list[str], W: int):
+def _dense_fingerprint(tasks: list["_DenseTask"]) -> str:
+    """Identity of a dense group's source bytes in assembly order —
+    files are immutable and compaction writes new paths, so this is a
+    stable cache key for the assembled blocks."""
+    import hashlib
+    h = hashlib.sha1()
+    for d in tasks:
+        h.update(f"{d.reader.path}|{d.si}|{d.lo}|{d.f}|{d.P}"
+                 .encode())
+    return h.hexdigest()
+
+
+def _run_dense(d: _DenseTask, needed: list[str], W: int,
+               blocks_needed: bool = True):
     """Decode one dense segment: (f, P) blocks per field + edge-leftover
-    flat parts. Times are affine — generated, never decoded."""
+    flat parts. Times are affine — generated, never decoded. With
+    blocks_needed=False (device cache holds the blocks) only the edge
+    leftovers are produced — segments without leftovers skip decode
+    entirely."""
     span = d.f * d.P
     blocks: dict[str, tuple[np.ndarray, np.ndarray]] = {}
     left_cols: list[dict] = [dict(), dict()]
     ranges = [(d.a, d.lo), (d.lo + span, d.b)]
-    for name in needed:
-        colm = d.cm.column(name)
-        if colm is None or colm.type not in _NUMERIC:
-            continue
-        cv = d.reader.read_segment(colm, colm.segments[d.si])
-        vals = cv.values.astype(np.float64, copy=False)
-        blocks[name] = (vals[d.lo:d.lo + span].reshape(d.f, d.P),
-                        cv.valid[d.lo:d.lo + span].reshape(d.f, d.P),
-                        colm.type)
-        for k, (i0, i1) in enumerate(ranges):
-            if i1 > i0:
-                left_cols[k][name] = (cv.values[i0:i1], cv.valid[i0:i1],
-                                     colm.type)
-    cells = d.gid * W + np.arange(d.w0, d.w0 + d.f, dtype=np.int64)
+    has_left = any(i1 > i0 for i0, i1 in ranges)
+    if blocks_needed or has_left:
+        for name in needed:
+            colm = d.cm.column(name)
+            if colm is None or colm.type not in _NUMERIC:
+                continue
+            cv = d.reader.read_segment(colm, colm.segments[d.si])
+            if blocks_needed:
+                vals = cv.values.astype(np.float64, copy=False)
+                blocks[name] = (vals[d.lo:d.lo + span].reshape(d.f, d.P),
+                                cv.valid[d.lo:d.lo + span].reshape(d.f,
+                                                                   d.P),
+                                colm.type)
+            for k, (i0, i1) in enumerate(ranges):
+                if i1 > i0:
+                    left_cols[k][name] = (cv.values[i0:i1],
+                                          cv.valid[i0:i1], colm.type)
     leftovers = []
     for k, (i0, i1) in enumerate(ranges):
         if i1 > i0:
             times = d.t0 + d.step * np.arange(i0, i1, dtype=np.int64)
             leftovers.append((d.gid, times, left_cols[k], {}))
-    return d.P, cells, blocks, leftovers
+    return blocks if blocks_needed else None, leftovers
 
 
 def _decode_chunk(reader, cm, needed: list[str], keep: list[int],
@@ -432,6 +460,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                      num_cells: int, allow_preagg: bool,
                      allow_dense: bool = False,
                      need_limbs: bool = False,
+                     dense_cached=None,
                      ctx=None, pool: ThreadPoolExecutor | None = None
                      ) -> ScanResult:
     """Phase 2: pre-agg classification + batched segment decode.
@@ -562,35 +591,56 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                                           t_lo, t_hi)
         return gid, times, cols, strs
 
-    if pool is not None and (len(tasks) + len(dense_tasks)) > 1:
+    # group dense tasks by P and fingerprint each group BEFORE decode:
+    # a device-cache hit (dense_cached callback) skips host assembly
+    dense_by_p: dict[int, list[_DenseTask]] = {}
+    for d in dense_tasks:
+        dense_by_p.setdefault(d.P, []).append(d)
+    group_fp = {P: _dense_fingerprint(ts)
+                for P, ts in dense_by_p.items()}
+    group_hit = {P: bool(dense_cached and dense_cached(group_fp[P], P))
+                 for P in dense_by_p}
+    dense_jobs = [(P, d, not group_hit[P])
+                  for P, ts in dense_by_p.items() for d in ts]
+
+    if pool is not None and (len(tasks) + len(dense_jobs)) > 1:
         # one submission wave: dense decodes interleave with flat/merged
         # ones instead of waiting for the first batch to drain
         flat_futs = [pool.submit(run_one, t) for t in tasks]
-        dense_futs = [pool.submit(_run_dense, d, needed, W)
-                      for d in dense_tasks]
+        dense_futs = [pool.submit(_run_dense, d, needed, W, blocks)
+                      for _P, d, blocks in dense_jobs]
         results = [f.result() for f in flat_futs]
         dense_results = [f.result() for f in dense_futs]
     else:
         results = [run_one(t) for t in tasks]
-        dense_results = [_run_dense(d, needed, W) for d in dense_tasks]
+        dense_results = [_run_dense(d, needed, W, blocks)
+                         for _P, d, blocks in dense_jobs]
 
     # assemble (S, P) dense groups; edge leftovers join the flat rows
     dense_groups: dict[int, DenseGroup] = {}
     by_p: dict[int, list] = {}
-    for P, cells, blocks, leftovers in dense_results:
-        by_p.setdefault(P, []).append((cells, blocks))
+    for (P, d, _blk), (blocks, leftovers) in zip(dense_jobs,
+                                                 dense_results):
+        by_p.setdefault(P, []).append((d, blocks))
         results.extend(leftovers)
     for P, entries in by_p.items():
-        cells = np.concatenate([c for c, _b in entries])
-        names = sorted(set().union(*[b.keys() for _c, b in entries]))
+        cells = np.concatenate(
+            [d.gid * W + np.arange(d.w0, d.w0 + d.f, dtype=np.int64)
+             for d, _b in entries])
+        if group_hit[P]:
+            dense_groups[P] = DenseGroup(P, cells, {}, group_fp[P],
+                                         cached=True)
+            stats.dense_cache_hits += 1
+            continue
+        names = sorted(set().union(*[b.keys() for _d, b in entries]))
         gfields: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         for name in names:
             vparts, mparts = [], []
-            for c, b in entries:
+            for d, b in entries:
                 got = b.get(name)
                 if got is None:
-                    vparts.append(np.zeros((len(c), P)))
-                    mparts.append(np.zeros((len(c), P), dtype=np.bool_))
+                    vparts.append(np.zeros((d.f, P)))
+                    mparts.append(np.zeros((d.f, P), dtype=np.bool_))
                 else:
                     v, m, ft = got
                     vparts.append(v)
@@ -600,7 +650,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                         field_types[name] = ft
             gfields[name] = (np.concatenate(vparts),
                              np.concatenate(mparts))
-        dense_groups[P] = DenseGroup(P, cells, gfields)
+        dense_groups[P] = DenseGroup(P, cells, gfields, group_fp[P])
 
     s_parts: list[dict] = []
     str_names: set[str] = set()

