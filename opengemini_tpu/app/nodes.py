@@ -84,9 +84,11 @@ class TsStore:
                  host: str = "127.0.0.1", port: int = 0,
                  opts: EngineOptions | None = None,
                  heartbeat_s: float = HEARTBEAT_S,
-                 diagnostics: bool = False):
+                 diagnostics: bool = False,
+                 role: str = "both"):
         self.node = StoreNode(data_dir, host=host, port=port, opts=opts)
         self.meta = MetaClient(meta_addrs)
+        self.role = role
         self.heartbeat_s = heartbeat_s
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -110,7 +112,15 @@ class TsStore:
 
     def start(self):
         self.node.start()
-        self.node.node_id = self.meta.create_node(self.node.addr)
+        self.node.node_id = self.meta.create_node(self.node.addr,
+                                                  role=self.role)
+        # per-PT raft replication plane (reference partition_raft.go):
+        # groups materialize lazily on replicated writes; restarts
+        # rejoin persisted groups
+        from ..cluster.replication import ReplicationManager
+        self.node.replication = ReplicationManager(
+            self.node, self.meta, self.node.engine.path)
+        self.node.replication.reopen_local_groups()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name=f"store-hb-{self.node.node_id}")

@@ -8,6 +8,13 @@ route consistently between nodes).
 
 from __future__ import annotations
 
+
+def shard_key_of(tags: dict, shard_key: list[str]) -> str:
+    """Row's shard-key string: joined values of the key tags — the ONE
+    encoding shared by range routing (points_writer) and split-point
+    sampling (store_node); they must stay byte-identical."""
+    return "\x00".join(tags.get(k, "") for k in shard_key)
+
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
 _MASK = 0xFFFFFFFFFFFFFFFF

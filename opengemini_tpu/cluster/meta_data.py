@@ -32,19 +32,31 @@ PT_OFFLINE = "offline"
 PT_MIGRATING = "migrating"
 
 
+ROLE_BOTH = "both"
+ROLE_WRITER = "writer"
+ROLE_READER = "reader"
+
+
 @dataclass
 class DataNode:
     id: int
     addr: str                      # store RPC address host:port
     status: str = STATUS_ALIVE
     last_heartbeat: int = 0        # ns timestamp, maintained by meta
+    # read/write separation (reference AliveReadNodes
+    # lib/metaclient/meta_client.go:623 + shard_mapper.go:415-472):
+    # reader nodes serve queries from replicated partitions, writers
+    # take ingest; "both" does either
+    role: str = ROLE_BOTH
 
     def to_dict(self):
         return {"id": self.id, "addr": self.addr, "status": self.status,
-                "last_heartbeat": self.last_heartbeat}
+                "last_heartbeat": self.last_heartbeat, "role": self.role}
 
     @classmethod
     def from_dict(cls, d):
+        d = dict(d)
+        d.setdefault("role", ROLE_BOTH)
         return cls(**d)
 
 
@@ -93,6 +105,12 @@ class ShardGroupInfo:
         """Hash routing (reference ShardFor shardinfo.go:369-375)."""
         return self.shards[h % len(self.shards)]
 
+    @property
+    def ranged(self) -> bool:
+        """True when shard-key range bounds have been assigned (until
+        then key routing would dump everything into shard 0)."""
+        return any(s.min_key for s in self.shards)
+
     def dest_shard(self, shard_key: str) -> ShardInfo:
         """Range routing (reference DestShard shardinfo.go:359-366):
         shards ordered by min_key; pick the last whose min_key <= key."""
@@ -125,11 +143,18 @@ class DatabaseInfo:
     replica_n: int = 1
     shard_duration: int = DEFAULT_SHARD_DURATION
     shard_groups: list[ShardGroupInfo] = field(default_factory=list)
+    # range sharding (reference shardinfo.go:359 DestShard): tag names
+    # forming the shard key; range_bounds[i] = min_key of shard i,
+    # applied to every new shard group (bounds[0] is always "")
+    shard_key: list[str] = field(default_factory=list)
+    range_bounds: list[str] = field(default_factory=list)
 
     def to_dict(self):
         return {"name": self.name, "num_pts": self.num_pts,
                 "replica_n": self.replica_n,
                 "shard_duration": self.shard_duration,
+                "shard_key": self.shard_key,
+                "range_bounds": self.range_bounds,
                 "shard_groups": [g.to_dict() for g in self.shard_groups]}
 
     @classmethod
@@ -137,6 +162,8 @@ class DatabaseInfo:
         return cls(name=d["name"], num_pts=d["num_pts"],
                    replica_n=d.get("replica_n", 1),
                    shard_duration=d["shard_duration"],
+                   shard_key=list(d.get("shard_key", ())),
+                   range_bounds=list(d.get("range_bounds", ())),
                    shard_groups=[ShardGroupInfo.from_dict(g)
                                  for g in d["shard_groups"]])
 
@@ -214,14 +241,16 @@ class MetaData:
 
     def _apply_create_node(self, cmd):
         addr = cmd["addr"]
+        role = cmd.get("role", ROLE_BOTH)
         for n in self.nodes.values():
             if n.addr == addr:                      # re-join keeps the id
                 n.status = STATUS_ALIVE
                 n.last_heartbeat = cmd.get("now", 0)
+                n.role = role
                 return n.id
         nid = self.next_node_id
         self.next_node_id += 1
-        self.nodes[nid] = DataNode(id=nid, addr=addr,
+        self.nodes[nid] = DataNode(id=nid, addr=addr, role=role,
                                    last_heartbeat=cmd.get("now", 0))
         return nid
 
@@ -251,18 +280,23 @@ class MetaData:
             name=name, num_pts=num_pts,
             replica_n=cmd.get("replica_n", 1),
             shard_duration=cmd.get("shard_duration",
-                                   DEFAULT_SHARD_DURATION))
-        # assign PTs round-robin over alive nodes (data.go CreateDBPtView)
-        nodes = sorted(n.id for n in self.alive_nodes())
+                                   DEFAULT_SHARD_DURATION),
+            shard_key=list(cmd.get("shard_key", ())))
+        # assign PTs round-robin over alive WRITE-CAPABLE nodes (data.go
+        # CreateDBPtView; reference excludes reader nodes from ownership
+        # — owners take ingest). Readers join as replicas only.
+        alive = sorted(n.id for n in self.alive_nodes())
+        owners = sorted(n.id for n in self.alive_nodes()
+                        if n.role != ROLE_READER) or alive
         pts = []
         for i in range(num_pts):
-            owner = nodes[i % len(nodes)]
+            owner = owners[i % len(owners)]
             # distinct non-owner replicas, clamped to the node count
             reps = []
-            for r in range(1, len(nodes)):
+            for r in range(1, len(alive)):
                 if len(reps) >= cmd.get("replica_n", 1) - 1:
                     break
-                cand = nodes[(i + r) % len(nodes)]
+                cand = alive[(alive.index(owner) + r) % len(alive)]
                 if cand != owner and cand not in reps:
                     reps.append(cand)
             pts.append(PtInfo(db=name, pt_id=i, owner=owner,
@@ -291,12 +325,41 @@ class MetaData:
             shards.append(ShardInfo(id=self.next_shard_id,
                                     pt_id=pt.pt_id))
             self.next_shard_id += 1
+        if info.range_bounds and len(info.range_bounds) == len(shards):
+            for s, b in zip(shards, info.range_bounds):
+                s.min_key = b
+            for i, s in enumerate(shards[:-1]):
+                s.max_key = shards[i + 1].min_key
         g = ShardGroupInfo(id=self.next_sg_id, start_time=start,
                            end_time=start + sd, shards=shards)
         self.next_sg_id += 1
         info.shard_groups.append(g)
         info.shard_groups.sort(key=lambda x: x.start_time)
         return g.to_dict()
+
+    def _apply_set_shard_ranges(self, cmd):
+        """Assign shard-key range bounds (reference split points →
+        shardinfo ranges, engine/engine.go:930 GetShardSplitPoints):
+        applies to every live shard group AND to future ones via
+        DatabaseInfo.range_bounds. bounds[0] must be '' (open start)."""
+        info = self.databases.get(cmd["db"])
+        if info is None:
+            raise ValueError(f"database not found: {cmd['db']}")
+        bounds = list(cmd["bounds"])
+        if not bounds or bounds[0] != "":
+            raise ValueError("bounds[0] must be the open start ''")
+        if sorted(bounds) != bounds:
+            raise ValueError("bounds must be sorted")
+        info.range_bounds = bounds
+        for g in info.shard_groups:
+            if g.deleted or len(g.shards) != len(bounds):
+                continue
+            for s, b in zip(g.shards, bounds):
+                s.min_key = b
+            for i, s in enumerate(g.shards[:-1]):
+                s.max_key = g.shards[i + 1].min_key
+            g.shards[-1].max_key = ""
+        return True
 
     def _apply_delete_shard_group(self, cmd):
         info = self.databases.get(cmd["db"])

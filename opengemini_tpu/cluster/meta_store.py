@@ -196,9 +196,9 @@ class MetaClient:
 
     # ------------------------------------------------------- typed ops
 
-    def create_node(self, addr: str) -> int:
+    def create_node(self, addr: str, role: str = "both") -> int:
         return self.apply({"op": "create_node", "addr": addr,
-                           "now": time.time_ns()})
+                           "role": role, "now": time.time_ns()})
 
     def heartbeat(self, node_id: int) -> None:
         self.apply({"op": "heartbeat", "node_id": node_id,
@@ -206,14 +206,21 @@ class MetaClient:
 
     def create_database(self, name: str, num_pts: int | None = None,
                         replica_n: int = 1,
-                        shard_duration: int | None = None) -> None:
+                        shard_duration: int | None = None,
+                        shard_key: list[str] | None = None) -> None:
         cmd = {"op": "create_database", "name": name,
                "replica_n": replica_n}
         if num_pts is not None:
             cmd["num_pts"] = num_pts
         if shard_duration is not None:
             cmd["shard_duration"] = shard_duration
+        if shard_key:
+            cmd["shard_key"] = list(shard_key)
         self.apply(cmd)
+
+    def set_shard_ranges(self, db: str, bounds: list[str]) -> None:
+        self.apply({"op": "set_shard_ranges", "db": db,
+                    "bounds": list(bounds)})
 
     def drop_database(self, name: str) -> None:
         self.apply({"op": "drop_database", "name": name})

@@ -15,7 +15,7 @@ import threading
 from ..storage.rows import PointRow
 from ..utils import failpoint, get_logger
 from ..utils.errors import GeminiError
-from .hashing import series_hash
+from .hashing import series_hash, shard_key_of  # noqa: F401 (re-export)
 from .meta_store import MetaClient
 from .store_node import rows_to_wire
 from .transport import RPCClient, RPCError
@@ -92,7 +92,12 @@ class PointsWriter:
                     if sg is None:
                         raise GeminiError("failed to create shard group")
                 sg_cache[slot] = sg
-            shard = sg.shard_for(series_hash(r.measurement, r.tags))
+            if info.shard_key and sg.ranged:
+                # range routing (reference DestShard shardinfo.go:359)
+                shard = sg.dest_shard(shard_key_of(r.tags,
+                                                   info.shard_key))
+            else:
+                shard = sg.shard_for(series_hash(r.measurement, r.tags))
             pt = md.pt(db, shard.pt_id)
             if pt is None or md.nodes.get(pt.owner) is None:
                 raise GeminiError(

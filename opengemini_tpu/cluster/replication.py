@@ -115,6 +115,23 @@ class ReplicationManager:
 
     # ------------------------------------------------------------- groups
 
+    def replicated(self, db: str, pt_id: int) -> bool:
+        """True when the PT has replicas (replica_n > 1) — writes must
+        then commit through the raft group, not directly."""
+        key = group_key(db, pt_id)
+        with self._lock:
+            if key in self.groups:
+                return True
+        pt = self.meta.data().pt(db, pt_id)
+        if pt is None:
+            # store-side cache may lag the sql node's routing decision
+            try:
+                self.meta.refresh()
+            except RPCError:
+                return False
+            pt = self.meta.data().pt(db, pt_id)
+        return pt is not None and bool(pt.replicas)
+
     def _members(self, db: str, pt_id: int) -> dict[str, str]:
         """{node_id_str: store_addr} of the PT's raft members."""
         self.meta.refresh()

@@ -60,8 +60,12 @@ class ClusterExecutor:
     # ------------------------------------------------------------- mapping
 
     def map_pts(self, db: str) -> dict[str, list[int]]:
-        """node addr → owned partition ids (shard_mapper.go:415 read
-        distribution: one owner per pt)."""
+        """node addr → partition ids to query there (shard_mapper.go:
+        415-472 read distribution). Default: one owner per pt. With
+        read/write node roles, a pt whose candidate set (owner +
+        replicas) contains alive READER nodes is served by a reader —
+        replicas hold identical partition state via the per-PT raft
+        groups, so ingest (writers) and scans (readers) separate."""
         md = self.meta.data()
         if md.db(db) is None:
             self.meta.refresh()
@@ -78,11 +82,21 @@ class ClusterExecutor:
             raise ErrQueryError(
                 f"partitions unavailable for {db}: {offline}")
         out: dict[str, list[int]] = {}
-        for node_id, pts in md.pts_by_node(db).items():
-            node = md.nodes.get(node_id)
-            if node is None:
-                raise ErrQueryError(f"pt owner node {node_id} unknown")
-            out.setdefault(node.addr, []).extend(p.pt_id for p in pts)
+        for pt in md.pts.get(db, []):
+            cands = [pt.owner] + [r for r in pt.replicas
+                                  if r != pt.owner]
+            nodes = [md.nodes[c] for c in cands
+                     if c in md.nodes
+                     and md.nodes[c].status == "alive"]
+            readers = [n for n in nodes if n.role == "reader"]
+            if readers:
+                target = readers[pt.pt_id % len(readers)]
+            else:
+                target = md.nodes.get(pt.owner)
+                if target is None:
+                    raise ErrQueryError(
+                        f"pt owner node {pt.owner} unknown")
+            out.setdefault(target.addr, []).append(pt.pt_id)
         return out
 
     def _scatter(self, msg: str, db: str, body_extra: dict,
@@ -379,11 +393,45 @@ class ClusterFacade:
     def write_points(self, db: str, rows) -> int:
         return self.writer.write_points(db, rows)
 
-    def create_database(self, name: str) -> None:
-        self.meta.create_database(name)
+    def create_database(self, name: str, **kw) -> None:
+        self.meta.create_database(name, **kw)
 
     def drop_database(self, name: str) -> None:
         self.executor._drop_database(name)
+
+    # ---------------------------------------------- range sharding ops
+
+    def shard_split_points(self, db: str,
+                           measurement: str | None = None) -> list[str]:
+        """Balanced shard-key range bounds from store-side samples
+        (reference Engine.GetShardSplitPoints engine/engine.go:930 +
+        meta split points): one bound per partition, bounds[0] = ''."""
+        info = self.meta.database(db)
+        if info is None:
+            raise ErrQueryError(f"database not found: {db}")
+        if not info.shard_key:
+            raise ErrQueryError(
+                f"database {db} has no shard key configured")
+        resps = self.executor._scatter(
+            "store.split_points", db,
+            {"measurement": measurement, "shard_key": info.shard_key})
+        samples = sorted(s for r in resps for s in r.get("samples", ()))
+        n = info.num_pts
+        bounds = [""]
+        for i in range(1, n):
+            bounds.append(samples[i * len(samples) // n]
+                          if samples else "")
+        return bounds
+
+    def rebalance_shard_ranges(self, db: str,
+                               measurement: str | None = None
+                               ) -> list[str]:
+        """Compute split points and commit them as the db's shard-key
+        ranges (existing + future shard groups); writes start range-
+        routing once bounds are live. Returns the bounds."""
+        bounds = self.shard_split_points(db, measurement)
+        self.meta.set_shard_ranges(db, bounds)
+        return bounds
 
     def close(self) -> None:
         self.writer.close()

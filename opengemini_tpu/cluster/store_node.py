@@ -67,16 +67,43 @@ class StoreNode:
                                     "store.measurements": self._on_measurements,
                                     "store.load_pt": self._on_load_pt,
                                     "store.drop_pt": self._on_drop_pt,
+                                    "store.split_points":
+                                        self._on_split_points,
+                                    "store.ensure_group":
+                                        self._on_ensure_group,
+                                    "store.raft_write":
+                                        self._on_raft_write,
                                 })
         self.addr = self.server.addr
         self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
+        # per-PT raft replication (cluster/replication.py); wired by the
+        # app wrapper once the node is registered with meta
+        self.replication = None
+        self._peer_clients: dict[str, object] = {}
+        self._peer_lock = __import__("threading").Lock()
 
     def start(self) -> None:
         self.server.start()
 
     def stop(self) -> None:
+        if self.replication is not None:
+            self.replication.stop()
+        with self._peer_lock:
+            for c in self._peer_clients.values():
+                c.close()
+            self._peer_clients.clear()
         self.server.stop()
         self.engine.close()
+
+    def peer_call(self, addr: str, msg: str, body: dict,
+                  timeout: float = 30.0):
+        """Store→store RPC (raft write forwarding, group fanout)."""
+        from .transport import RPCClient
+        with self._peer_lock:
+            c = self._peer_clients.get(addr)
+            if c is None:
+                c = self._peer_clients[addr] = RPCClient(addr)
+        return c.call(msg, body, timeout=timeout)
 
     # ------------------------------------------------------------ handlers
 
@@ -100,6 +127,30 @@ class StoreNode:
             self.engine.drop_database(dbk)
         return {"dropped": dbk}
 
+    def _on_split_points(self, body):
+        """Sample shard-key values of this node's partitions (reference
+        Engine.GetShardSplitPoints engine/engine.go:930) — the sql node
+        merges samples across stores and derives balanced range bounds."""
+        db, pts = body["db"], body["pts"]
+        mst = body.get("measurement")
+        shard_key = body["shard_key"]
+        from .hashing import shard_key_of
+        cap = int(body.get("cap", 20000))
+        samples: list[str] = []
+        for pt in pts:
+            dbk = db_key(db, pt)
+            if dbk not in self.engine.databases:
+                continue
+            for s in self.engine.database(dbk).all_shards():
+                msts = [mst] if mst else s.measurements()
+                for m in msts:
+                    for sid in s.series_ids(m).tolist():
+                        tags = s.index.tags_of(sid)
+                        samples.append(shard_key_of(tags, shard_key))
+                        if len(samples) >= cap:
+                            return {"samples": sorted(samples)}
+        return {"samples": sorted(samples)}
+
     def _on_write(self, body):
         owner = body.get("owner")
         if (owner is not None and self.node_id is not None
@@ -110,10 +161,30 @@ class StoreNode:
             raise ValueError(
                 f"not pt owner: write addressed to node {owner}, "
                 f"this is node {self.node_id}")
-        rows = rows_from_wire(body["rows"])
-        n = self.engine.write_points(db_key(body["db"], body["pt"]), rows)
+        db, pt = body["db"], body["pt"]
+        if self.replication is not None \
+                and self.replication.replicated(db, pt):
+            # consistent-replication mode: the batch commits through the
+            # PT raft group; the FSM applies it to every member's engine
+            n = self.replication.write(db, pt, body["rows"])
+        else:
+            rows = rows_from_wire(body["rows"])
+            n = self.engine.write_points(db_key(db, pt), rows)
         self.stats["writes"] += 1
         self.stats["rows_written"] += n
+        return {"written": n}
+
+    def _on_ensure_group(self, body):
+        if self.replication is None:
+            raise ValueError("replication not enabled on this node")
+        g = self.replication.ensure_group(body["db"], body["pt"])
+        return {"member": g is not None}
+
+    def _on_raft_write(self, body):
+        """Leader-forwarded replicated write (netstorage raft routing)."""
+        if self.replication is None:
+            raise ValueError("replication not enabled on this node")
+        n = self.replication.write(body["db"], body["pt"], body["rows"])
         return {"written": n}
 
     def _parse_select(self, q: str) -> SelectStatement:

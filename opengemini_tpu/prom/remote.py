@@ -87,6 +87,12 @@ _MATCH_OPS = {pb.LabelMatcher.EQ: "=", pb.LabelMatcher.NEQ: "!=",
               pb.LabelMatcher.RE: "=~", pb.LabelMatcher.NRE: "!~"}
 
 
+def _anchor(pattern: str) -> str:
+    """Prometheus regex matchers are FULLY ANCHORED (m1 does not match
+    m10); the engine's tag filters use search semantics, so wrap."""
+    return r"\A(?:" + pattern + r")\Z"
+
+
 def _match_name(matchers, measurements: list[str]) -> list[str]:
     """Resolve the __name__ matcher to measurements."""
     import re
@@ -100,7 +106,7 @@ def _match_name(matchers, measurements: list[str]) -> list[str]:
         elif op == "!=":
             out = [n for n in out if n != m.value]
         else:
-            rx = re.compile(m.value)
+            rx = re.compile(_anchor(m.value))
             keep = [n for n in out if rx.search(n)]
             out = keep if op == "=~" else \
                 [n for n in out if n not in set(keep)]
@@ -126,7 +132,11 @@ def handle_remote_read(engine, db: str, req: "pb.ReadRequest"
         result = resp.results.add()
         t_lo = int(q.start_timestamp_ms) * MS
         t_hi = int(q.end_timestamp_ms) * MS
-        filters = [TagFilter(m.name, m.value, _MATCH_OPS[m.type])
+        filters = [TagFilter(m.name,
+                             _anchor(m.value)
+                             if _MATCH_OPS[m.type] in ("=~", "!~")
+                             else m.value,
+                             _MATCH_OPS[m.type])
                    for m in q.matchers if m.name != "__name__"]
         shards = db_obj.shards_overlapping(t_lo, t_hi)
         msts = sorted({m for s in shards for m in s.measurements()})

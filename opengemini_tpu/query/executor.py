@@ -949,12 +949,30 @@ class QueryExecutor:
                     vals, valid = got
                     if vals.dtype == np.int64:
                         # typed integer kernel (int64 sums are exact and
-                        # order-free) unless the sum could overflow or
-                        # sumsq is needed (squares overflow far earlier)
-                        mx_i = int(np.max(np.abs(vals[valid]))) \
-                            if valid.any() else 0
-                        if spec.sumsq or (mx_i
-                                          and n_rows * mx_i >= 2 ** 62):
+                        # order-free) unless the TOTAL could overflow —
+                        # dense-block and pre-agg contributions land in
+                        # the same int64 grid, so they count too. Python
+                        # ints avoid the np.abs(int64 min) wrap.
+                        mx_i = 0
+                        if valid.any():
+                            mx_i = max(abs(int(vals[valid].max())),
+                                       abs(int(vals[valid].min())))
+                        total_rows = n_rows
+                        if scanres is not None:
+                            total_rows += scanres.stats.dense_rows
+                            for grp in scanres.dense.values():
+                                dv, dm = grp.fields.get(fname,
+                                                        (None, None))
+                                if dv is not None and dm.any():
+                                    mg = np.abs(np.where(dm, dv, 0.0))
+                                    mx_i = max(mx_i, int(np.max(mg)))
+                            pgx = (scanres.preagg or {}).get(fname)
+                            if pgx is not None:
+                                total_rows += int(pgx["count"].sum())
+                                mx_i = max(mx_i, int(np.max(np.abs(
+                                    pgx["sum"]))))
+                        if spec.sumsq or (mx_i and (total_rows + 1)
+                                          * mx_i >= 2 ** 62):
                             vals = vals.astype(np.float64)
                     else:
                         vals = vals.astype(np.float64, copy=False)
@@ -1042,7 +1060,8 @@ class QueryExecutor:
                 if grp.cached:
                     pin = dense_pins.get(fp, {})
                     entries = [(nm, v, m, ft)
-                               for nm, (v, m, ft) in pin.items()]
+                               for nm, (v, m, ft) in pin.items()
+                               if nm in needed_fields]
                 else:
                     entries = []
                     for fname, (dvals, dvalid) in grp.fields.items():

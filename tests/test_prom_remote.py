@@ -153,3 +153,25 @@ def test_rate_over_remote_written_data(srv):
     assert res["status"] == "success"
     val = float(res["data"]["result"][0]["value"][1])
     assert val == pytest.approx(10.0 / 15.0)
+
+
+def test_remote_read_regex_is_anchored(srv):
+    """Prom regex matchers are fully anchored: m1 must not match m10."""
+    body = _write_req([
+        ({"__name__": "m1", "job": "api"}, [(1.0, 1000)]),
+        ({"__name__": "m10", "job": "api-backup"}, [(2.0, 1000)]),
+    ])
+    assert _post(srv, "/api/v1/prom/write?db=prometheus",
+                 body).status == 204
+    rr = pb.ReadRequest()
+    q = rr.queries.add()
+    q.start_timestamp_ms = 0
+    q.end_timestamp_ms = 10000
+    q.matchers.add(type=pb.LabelMatcher.RE, name="__name__", value="m1")
+    q.matchers.add(type=pb.LabelMatcher.RE, name="job", value="api")
+    r = _post(srv, "/api/v1/prom/read?db=prometheus",
+              snappy_compress(rr.SerializeToString()))
+    resp = pb.ReadResponse.FromString(snappy_decompress(r.read()))
+    tss = resp.results[0].timeseries
+    assert len(tss) == 1
+    assert {lb.name: lb.value for lb in tss[0].labels}["__name__"] == "m1"
