@@ -168,6 +168,16 @@ class DatabaseInfo:
                                  for g in d["shard_groups"]])
 
 
+def _assign_bounds(shards: list[ShardInfo], bounds: list[str]) -> None:
+    """Apply sorted range bounds to a shard list (min_key per shard,
+    max_key = next shard's min, last open)."""
+    for s, b in zip(shards, bounds):
+        s.min_key = b
+    for i, s in enumerate(shards[:-1]):
+        s.max_key = shards[i + 1].min_key
+    shards[-1].max_key = ""
+
+
 class MetaData:
     """The replicated catalog. Mutations happen ONLY through apply() —
     the raft FSM entry point — so every replica deterministically reaches
@@ -326,10 +336,7 @@ class MetaData:
                                     pt_id=pt.pt_id))
             self.next_shard_id += 1
         if info.range_bounds and len(info.range_bounds) == len(shards):
-            for s, b in zip(shards, info.range_bounds):
-                s.min_key = b
-            for i, s in enumerate(shards[:-1]):
-                s.max_key = shards[i + 1].min_key
+            _assign_bounds(shards, info.range_bounds)
         g = ShardGroupInfo(id=self.next_sg_id, start_time=start,
                            end_time=start + sd, shards=shards)
         self.next_sg_id += 1
@@ -354,11 +361,7 @@ class MetaData:
         for g in info.shard_groups:
             if g.deleted or len(g.shards) != len(bounds):
                 continue
-            for s, b in zip(g.shards, bounds):
-                s.min_key = b
-            for i, s in enumerate(g.shards[:-1]):
-                s.max_key = g.shards[i + 1].min_key
-            g.shards[-1].max_key = ""
+            _assign_bounds(g.shards, bounds)
         return True
 
     def _apply_delete_shard_group(self, cmd):

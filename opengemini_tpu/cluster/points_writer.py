@@ -18,7 +18,7 @@ from ..utils.errors import GeminiError
 from .hashing import series_hash, shard_key_of  # noqa: F401 (re-export)
 from .meta_store import MetaClient
 from .store_node import rows_to_wire
-from .transport import RPCClient, RPCError
+from .transport import ClientPool, RPCError
 
 log = get_logger(__name__)
 
@@ -36,20 +36,13 @@ class PointsWriter:
         self.meta = meta
         self.auto_create_db = auto_create_db
         self.max_retries = max_retries
-        self._clients: dict[str, RPCClient] = {}
-        self._clients_lock = threading.Lock()
+        self._pool = ClientPool()
 
-    def _client(self, addr: str) -> RPCClient:
-        with self._clients_lock:
-            c = self._clients.get(addr)
-            if c is None:
-                c = self._clients[addr] = RPCClient(addr)
-            return c
+    def _client(self, addr: str):
+        return self._pool.get(addr)
 
     def close(self) -> None:
-        with self._clients_lock:
-            for c in self._clients.values():
-                c.close()
+        self._pool.close()
 
     # ------------------------------------------------------------- routing
 

@@ -33,29 +33,26 @@ from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
 from .meta_store import MetaClient
 from .points_writer import PointsWriter
-from .transport import RPCClient, RPCError
+from .transport import ClientPool, RPCClient, RPCError
 
 log = get_logger(__name__)
+
+# reader-replica query routing (eventual consistency — see map_pts)
+READER_ROUTING = __import__("os").environ.get(
+    "OG_READER_ROUTING", "1") != "0"
 
 
 class ClusterExecutor:
     def __init__(self, meta: MetaClient):
         self.meta = meta
-        self._clients: dict[str, RPCClient] = {}
-        self._lock = threading.Lock()
+        self._pool = ClientPool()
         self.inc_cache = IncAggCache()
 
     def _client(self, addr: str) -> RPCClient:
-        with self._lock:
-            c = self._clients.get(addr)
-            if c is None:
-                c = self._clients[addr] = RPCClient(addr)
-            return c
+        return self._pool.get(addr)
 
     def close(self) -> None:
-        with self._lock:
-            for c in self._clients.values():
-                c.close()
+        self._pool.close()
 
     # ------------------------------------------------------------- mapping
 
@@ -65,7 +62,13 @@ class ClusterExecutor:
         read/write node roles, a pt whose candidate set (owner +
         replicas) contains alive READER nodes is served by a reader —
         replicas hold identical partition state via the per-PT raft
-        groups, so ingest (writers) and scans (readers) separate."""
+        groups, so ingest (writers) and scans (readers) separate.
+
+        Consistency note: replica apply is asynchronous, so reader
+        routing is read-committed-EVENTUAL — a client may not see its
+        own just-acked write on the very next query (the owner path
+        guarantees read-your-writes). OG_READER_ROUTING=0 disables
+        reader preference."""
         md = self.meta.data()
         if md.db(db) is None:
             self.meta.refresh()
@@ -88,7 +91,8 @@ class ClusterExecutor:
             nodes = [md.nodes[c] for c in cands
                      if c in md.nodes
                      and md.nodes[c].status == "alive"]
-            readers = [n for n in nodes if n.role == "reader"]
+            readers = [n for n in nodes if n.role == "reader"] \
+                if READER_ROUTING else []
             if readers:
                 target = readers[pt.pt_id % len(readers)]
             else:
@@ -141,6 +145,13 @@ class ClusterExecutor:
                 inc_query_id: str | None = None, iter_id: int = 0) -> dict:
         try:
             if isinstance(stmt, SelectStatement):
+                if stmt.join is not None:
+                    from ..query.join import execute_join
+                    return execute_join(self, stmt, stmt.from_db or db)
+                if stmt.extra_sources:
+                    from ..query.join import execute_multi_source
+                    return execute_multi_source(self, stmt,
+                                                stmt.from_db or db)
                 return self._select(stmt, stmt.from_db or db,
                                     inc_query_id=inc_query_id,
                                     iter_id=iter_id)

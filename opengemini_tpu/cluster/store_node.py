@@ -79,8 +79,8 @@ class StoreNode:
         # per-PT raft replication (cluster/replication.py); wired by the
         # app wrapper once the node is registered with meta
         self.replication = None
-        self._peer_clients: dict[str, object] = {}
-        self._peer_lock = __import__("threading").Lock()
+        from .transport import ClientPool
+        self._peers = ClientPool()
 
     def start(self) -> None:
         self.server.start()
@@ -88,22 +88,14 @@ class StoreNode:
     def stop(self) -> None:
         if self.replication is not None:
             self.replication.stop()
-        with self._peer_lock:
-            for c in self._peer_clients.values():
-                c.close()
-            self._peer_clients.clear()
+        self._peers.close()
         self.server.stop()
         self.engine.close()
 
     def peer_call(self, addr: str, msg: str, body: dict,
                   timeout: float = 30.0):
         """Store→store RPC (raft write forwarding, group fanout)."""
-        from .transport import RPCClient
-        with self._peer_lock:
-            c = self._peer_clients.get(addr)
-            if c is None:
-                c = self._peer_clients[addr] = RPCClient(addr)
-        return c.call(msg, body, timeout=timeout)
+        return self._peers.call(addr, msg, body, timeout=timeout)
 
     # ------------------------------------------------------------ handlers
 

@@ -389,3 +389,30 @@ class RPCClient:
 
     def close(self) -> None:
         self._fail_pending("client closed")
+
+
+class ClientPool:
+    """Shared addr→RPCClient cache (the one reconnect/close point for
+    PointsWriter, ClusterExecutor and store peer calls)."""
+
+    def __init__(self):
+        import threading
+        self._clients: dict[str, RPCClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: str) -> RPCClient:
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = RPCClient(addr)
+            return c
+
+    def call(self, addr: str, msg: str, body: dict,
+             timeout: float = 30.0):
+        return self.get(addr).call(msg, body, timeout=timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()

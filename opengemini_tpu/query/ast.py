@@ -79,6 +79,12 @@ class SelectStatement:
     # SELECT ... INTO target (continuous queries / downsampling)
     into_measurement: str | None = None
     into_db: str | None = None
+    # multi-source union: FROM m1, m2 (influx semantics — the statement
+    # runs per measurement, one series set each)
+    extra_sources: list[str] = field(default_factory=list)
+    # FROM (sub) AS a FULL JOIN (sub) AS b ON (a.tk = b.tk)
+    join: "JoinClause | None" = None
+
 
     @property
     def has_group_by_time(self) -> bool:
@@ -107,6 +113,20 @@ class SelectStatement:
     @property
     def group_by_star(self) -> bool:
         return any(isinstance(d.expr, Wildcard) for d in self.dimensions)
+
+
+@dataclass
+class JoinClause:
+    """Full outer join of two sub-selects on tag equality (reference
+    engine/executor/full_join_transform.go; SQL shape from the
+    reference's integration suite)."""
+    left: "SelectStatement"
+    left_alias: str
+    right: "SelectStatement"
+    right_alias: str
+    # [(left_tag, right_tag)] from the ON conjunction, normalized so
+    # the first element belongs to left_alias
+    on: list = field(default_factory=list)
 
 
 @dataclass

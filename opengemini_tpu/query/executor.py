@@ -100,6 +100,15 @@ class QueryExecutor:
         cache key (see incremental.py)."""
         try:
             if isinstance(stmt, SelectStatement):
+                if stmt.join is not None:
+                    from .join import execute_join
+                    return execute_join(self, stmt, stmt.from_db or db,
+                                        ctx=ctx)
+                if stmt.extra_sources:
+                    from .join import execute_multi_source
+                    return execute_multi_source(self, stmt,
+                                                stmt.from_db or db,
+                                                ctx=ctx)
                 return self._select(stmt, stmt.from_db or db, ctx=ctx,
                                     span=span, inc_query_id=inc_query_id,
                                     iter_id=iter_id)
@@ -961,6 +970,17 @@ class QueryExecutor:
                         if scanres is not None:
                             total_rows += scanres.stats.dense_rows
                             for grp in scanres.dense.values():
+                                if grp.cached:
+                                    # device-cached groups have no host
+                                    # arrays — use the pinned maxabs
+                                    cm_ = dcache.get((grp.fingerprint,
+                                                      fname, "maxabs"))
+                                    if cm_ is not None:
+                                        mx_i = max(mx_i, int(cm_))
+                                    else:
+                                        # unknown magnitude: stay safe
+                                        mx_i = 2 ** 62
+                                    continue
                                 dv, dm = grp.fields.get(fname,
                                                         (None, None))
                                 if dv is not None and dm.any():

@@ -407,9 +407,18 @@ class Parser:
         if self._op("("):
             stmt.from_subquery = self.parse_select()
             self._expect_op(")")
+            left_alias = self._ident() if self._kw("AS") else None
+            if self._kw("FULL"):
+                self._expect_kw("JOIN")
+                stmt.join = self._parse_join_tail(stmt.from_subquery,
+                                                  left_alias)
+                stmt.from_subquery = None
         else:
             (stmt.from_db, stmt.from_rp,
              stmt.from_measurement) = self._dotted_target()
+            while self._op(","):
+                # keep each source's db/rp qualifier
+                stmt.extra_sources.append(self._dotted_target())
         if self._kw("WHERE"):
             stmt.condition = self.parse_expr()
         if self._kw("GROUP"):
@@ -597,6 +606,42 @@ class Parser:
                 continue
             return lhs
 
+    def _parse_join_tail(self, left, left_alias):
+        """FULL JOIN (sub) AS b ON (a.tk = b.tk [AND ...]) — reference
+        full_join_transform SQL shape."""
+        from .ast import JoinClause
+        self._expect_op("(")
+        right = self.parse_select()
+        self._expect_op(")")
+        right_alias = self._ident() if self._kw("AS") else None
+        if not left_alias or not right_alias:
+            raise ParseError("FULL JOIN sources need AS aliases")
+        self._expect_kw("ON")
+        paren = self._op("(")
+        pairs = []
+        while True:
+            la, lt = self._qualified_tag()
+            self._expect_op("=")
+            ra, rt = self._qualified_tag()
+            if la == left_alias and ra == right_alias:
+                pairs.append((lt, rt))
+            elif la == right_alias and ra == left_alias:
+                pairs.append((rt, lt))
+            else:
+                raise ParseError(
+                    f"join condition references unknown alias "
+                    f"{la!r}/{ra!r}")
+            if not self._kw("AND"):
+                break
+        if paren:
+            self._expect_op(")")
+        return JoinClause(left, left_alias, right, right_alias, pairs)
+
+    def _qualified_tag(self):
+        alias = self._ident()
+        self._expect_op(".")
+        return alias, self._ident()
+
     def parse_primary(self):
         k, v, p = self.lx.peek()
         if k == "op" and v == "(":
@@ -648,6 +693,9 @@ class Parser:
             # type cast field::tag / field::field — consume and ignore
             if self._op("::"):
                 self.lx.next()
+            # qualified column (join outputs: alias.field)
+            if self._op("."):
+                name = name + "." + self._ident()
             return FieldRef(name)
         raise ParseError(f"unexpected token {v!r} at {p}")
 

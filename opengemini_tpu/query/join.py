@@ -1,0 +1,144 @@
+"""Multi-source FROM and full outer join (host-side merge transforms).
+
+Role of the reference's multi-measurement sources and
+engine/executor/full_join_transform.go: the join runs at the sql layer
+over the two sub-selects' RESULTS — the heavy scan/aggregate work stays
+pushed down (and on device); only the matched (tags, time) row merge
+happens here, exactly where the reference places its transform.
+
+Works identically over the single-node QueryExecutor and the cluster
+ClusterExecutor: both expose execute(stmt, db).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .ast import Dimension, FieldRef, SelectStatement, Wildcard
+
+
+def execute_multi_source(executor, stmt: SelectStatement,
+                         db: str | None, **kw) -> dict:
+    """FROM m1, m2, …: influx union semantics — run the statement per
+    measurement, concatenate the series (each keeps its own name and
+    its own db/rp qualifier)."""
+    out = []
+    sources = [(stmt.from_db, stmt.from_rp, stmt.from_measurement)]
+    for src in stmt.extra_sources:
+        sources.append(src if isinstance(src, tuple) else (None, None,
+                                                          src))
+    for sdb, srp, m in sources:
+        sub = replace(stmt, from_measurement=m, from_db=sdb,
+                      from_rp=srp, extra_sources=[])
+        res = executor.execute(sub, sdb or db, **kw)
+        if "error" in res:
+            return res
+        out.extend(res.get("series", []))
+    return {"series": out} if out else {}
+
+
+def _inject_group_tags(sub: SelectStatement,
+                       tags: list[str]) -> SelectStatement:
+    """Ensure the sub-select groups by the join tags so its result
+    series carry them (the join keys)."""
+    have = set(sub.group_by_tags())
+    dims = list(sub.dimensions)
+    for t in tags:
+        if t not in have:
+            dims.append(Dimension(FieldRef(t)))
+    return replace(sub, dimensions=dims)
+
+
+def execute_join(executor, stmt: SelectStatement, db: str | None,
+                 **kw) -> dict:
+    """FULL JOIN: evaluate both sides, match series on the ON tag
+    equalities, merge rows on time (full outer: union of keys and of
+    times; the absent side contributes nulls)."""
+    j = stmt.join
+    ltags = [lt for lt, _rt in j.on]
+    rtags = [rt for _lt, rt in j.on]
+    lres = executor.execute(_inject_group_tags(j.left, ltags), db, **kw)
+    if "error" in lres:
+        return lres
+    rres = executor.execute(_inject_group_tags(j.right, rtags), db, **kw)
+    if "error" in rres:
+        return rres
+
+    def index(res, tags):
+        out: dict[tuple, list] = {}
+        for s in res.get("series", []):
+            key = tuple(s.get("tags", {}).get(t) for t in tags)
+            out.setdefault(key, []).append(s)
+        return out
+
+    lser = index(lres, ltags)
+    rser = index(rres, rtags)
+
+    # resolve output columns: alias.col refs (or wildcard = all columns
+    # of both sides, qualified)
+    def side_columns(ser_map):
+        for ss in ser_map.values():
+            return [c for c in ss[0]["columns"] if c != "time"]
+        return []
+
+    want: list[tuple[str, str]] = []       # (alias, column)
+    wildcard = any(isinstance(f.expr, Wildcard) for f in stmt.fields)
+    if wildcard:
+        want = [(j.left_alias, c) for c in side_columns(lser)] + \
+               [(j.right_alias, c) for c in side_columns(rser)]
+    else:
+        for f in stmt.fields:
+            e = f.expr
+            if not isinstance(e, FieldRef) or "." not in e.name:
+                return {"error": "join outputs must be alias.field "
+                                 "references"}
+            alias, col = e.name.split(".", 1)
+            if alias not in (j.left_alias, j.right_alias):
+                return {"error": f"unknown join alias {alias!r}"}
+            want.append((alias, col))
+
+    cols_hdr = ["time"] + [f"{a}.{c}" for a, c in want]
+    name = f"{j.left_alias},{j.right_alias}"
+
+    series_out = []
+    for key in sorted(set(lser) | set(rser),
+                      key=lambda k: tuple(x or "" for x in k)):
+        # series beyond the join key (sub-selects grouped by extra
+        # tags) pair up as a cross product per key — one output series
+        # per (left, right) combination, full-outer on absent sides
+        for ls in lser.get(key) or [None]:
+            for rs in rser.get(key) or [None]:
+                sides = {j.left_alias: ls, j.right_alias: rs}
+                cells: dict[int, list] = {}
+                for alias, s in sides.items():
+                    if s is None:
+                        continue
+                    cidx = {c: i for i, c in enumerate(s["columns"])}
+                    for row in s["values"]:
+                        r = cells.setdefault(int(row[0]),
+                                             [None] * len(want))
+                        for oi, (a, c) in enumerate(want):
+                            if a == alias and c in cidx:
+                                r[oi] = row[cidx[c]]
+                if not cells:
+                    continue
+                rows = [[t] + cells[t] for t in sorted(cells)]
+                if stmt.order_desc:
+                    rows.reverse()
+                if stmt.offset:
+                    rows = rows[stmt.offset:]
+                if stmt.limit:
+                    rows = rows[:stmt.limit]
+                entry = {"name": name, "columns": cols_hdr,
+                         "values": rows}
+                # join-key tags (left names) + each side's extra tags
+                tags = {lt: v for lt, v in zip(ltags, key)
+                        if v is not None}
+                for s in (ls, rs):
+                    if s is not None:
+                        for k2, v2 in s.get("tags", {}).items():
+                            tags.setdefault(k2, v2)
+                if tags:
+                    entry["tags"] = tags
+                series_out.append(entry)
+    return {"series": series_out} if series_out else {}

@@ -1,0 +1,165 @@
+"""Multi-source FROM and FULL JOIN (VERDICT r1 missing #5; reference
+full_join_transform.go; SQL shape from the reference's server suite)."""
+
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def write(eng, lp):
+    eng.write_points("db0", parse_lines(lp))
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, "db0")
+
+
+def test_parse_multi_source_and_join():
+    (s,) = parse_query("SELECT mean(v) FROM m1, m2, db2..m3 "
+                       "GROUP BY time(1m)")
+    assert s.from_measurement == "m1"
+    assert [m for _d, _r, m in s.extra_sources] == ["m2", "m3"]
+    assert s.extra_sources[1][0] == "db2"   # qualifier preserved
+    (s,) = parse_query(
+        "select a.f1, b.f2 from (select f1 from m1) as a full join "
+        "(select f2 from m2) as b on (a.host = b.host) group by host")
+    assert s.join is not None
+    assert s.join.left_alias == "a" and s.join.right_alias == "b"
+    assert s.join.on == [("host", "host")]
+    # reversed alias order in ON normalizes
+    (s,) = parse_query(
+        "select a.f1 from (select f1 from m1) as a full join "
+        "(select f2 from m2) as b on b.h = a.h and a.dc = b.dc")
+    assert s.join.on == [("h", "h"), ("dc", "dc")]
+
+
+def test_multi_source_union(db):
+    eng, ex = db
+    write(eng, "m1,host=a v=1 60000000000\n"
+               "m1,host=a v=3 120000000000\n"
+               "m2,host=a v=10 60000000000")
+    res = q(ex, "SELECT sum(v) FROM m1, m2")
+    by_name = {s["name"]: s for s in res["series"]}
+    assert by_name["m1"]["values"][0][1] == 4.0
+    assert by_name["m2"]["values"][0][1] == 10.0
+
+
+def test_full_join_on_tag(db):
+    eng, ex = db
+    write(eng, "m1,host=a f1=1 60000000000\n"
+               "m1,host=b f1=2 60000000000\n"
+               "m2,host=a f2=10 60000000000\n"
+               "m2,host=c f2=30 60000000000")
+    res = q(ex, "select a.f1, b.f2 from (select f1 from m1) as a "
+               "full join (select f2 from m2) as b on (a.host = b.host) "
+               "group by host")
+    assert "series" in res
+    by_tag = {s["tags"]["host"]: s for s in res["series"]}
+    assert set(by_tag) == {"a", "b", "c"}          # full outer
+    assert by_tag["a"]["columns"] == ["time", "a.f1", "b.f2"]
+    assert by_tag["a"]["values"] == [[60000000000, 1.0, 10.0]]
+    assert by_tag["b"]["values"] == [[60000000000, 2.0, None]]
+    assert by_tag["c"]["values"] == [[60000000000, None, 30.0]]
+    assert by_tag["a"]["name"] == "a,b"
+
+
+def test_full_join_time_union(db):
+    """Rows join on time within a matched tag key; unmatched times get
+    nulls on the absent side."""
+    eng, ex = db
+    write(eng, "m1,host=a f1=1 60000000000\n"
+               "m1,host=a f1=2 120000000000\n"
+               "m2,host=a f2=10 120000000000\n"
+               "m2,host=a f2=20 180000000000")
+    res = q(ex, "select a.f1, b.f2 from (select f1 from m1) as a "
+               "full join (select f2 from m2) as b on a.host = b.host")
+    rows = res["series"][0]["values"]
+    assert rows == [[60000000000, 1.0, None],
+                    [120000000000, 2.0, 10.0],
+                    [180000000000, None, 20.0]]
+
+
+def test_full_join_aggregated_subqueries(db):
+    eng, ex = db
+    write(eng, "\n".join(
+        [f"cpu,host=h{i % 2} v={i} {i * MIN}" for i in range(6)]
+        + [f"mem,host=h{i % 2} u={i * 10} {i * MIN}" for i in range(6)]))
+    res = q(ex, "select c.mean, m.mean from "
+               "(select mean(v) from cpu group by host) as c full join "
+               "(select mean(u) from mem group by host) as m "
+               "on c.host = m.host")
+    by_tag = {s["tags"]["host"]: s for s in res["series"]}
+    assert by_tag["h0"]["values"][0][1] == pytest.approx((0 + 2 + 4) / 3)
+    assert by_tag["h0"]["values"][0][2] == pytest.approx(
+        (0 + 20 + 40) / 3)
+
+
+def test_join_error_on_bad_alias(db):
+    eng, ex = db
+    write(eng, "m1 f1=1 60000000000")
+    res = q(ex, "select zz.f1 from (select f1 from m1) as a full join "
+               "(select f1 from m1) as b on a.host = b.host")
+    assert "error" in res
+
+
+def test_cluster_multi_source_and_join(tmp_path):
+    from opengemini_tpu.app import TsMeta, TsSql, TsStore
+    from opengemini_tpu.storage.rows import PointRow
+    meta = TsMeta(data_dir=str(tmp_path / "meta"))
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    store = TsStore(str(tmp_path / "s"), [meta.addr], heartbeat_s=0.5)
+    store.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    try:
+        rows = [PointRow("m1", {"host": "a"}, {"f1": 1.0}, MIN),
+                PointRow("m2", {"host": "a"}, {"f2": 2.0}, MIN),
+                PointRow("m2", {"host": "b"}, {"f2": 3.0}, MIN)]
+        sql.facade.write_points("jdb", rows)
+        stmt = parse_query("SELECT sum(f1), sum(f2) FROM m1, m2")[0]
+        res = sql.facade.executor.execute(stmt, "jdb")
+        assert {s["name"] for s in res["series"]} == {"m1", "m2"}
+        stmt = parse_query(
+            "select a.f1, b.f2 from (select f1 from m1) as a full join "
+            "(select f2 from m2) as b on a.host = b.host")[0]
+        res = sql.facade.executor.execute(stmt, "jdb")
+        by_tag = {s["tags"]["host"]: s for s in res["series"]}
+        assert by_tag["a"]["values"] == [[MIN, 1.0, 2.0]]
+        assert by_tag["b"]["values"] == [[MIN, None, 3.0]]
+    finally:
+        sql.stop()
+        store.stop()
+        meta.stop()
+
+
+def test_join_cross_product_on_extra_tags(db):
+    """Regression (r2 review): sub-select series with tags beyond the
+    join key must all survive (cross product per key), not overwrite
+    each other."""
+    eng, ex = db
+    write(eng, "m1,host=a,dc=e f1=1 60000000000\n"
+               "m1,host=a,dc=w f1=2 60000000000\n"
+               "m2,host=a f2=10 60000000000")
+    res = q(ex, "select a.f1, b.f2 from "
+               "(select f1 from m1 group by host, dc) as a "
+               "full join (select f2 from m2) as b on a.host = b.host")
+    assert len(res["series"]) == 2
+    dcs = {s["tags"].get("dc") for s in res["series"]}
+    assert dcs == {"e", "w"}
+    for s in res["series"]:
+        (row,) = s["values"]
+        assert row[1] in (1.0, 2.0) and row[2] == 10.0
