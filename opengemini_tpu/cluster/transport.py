@@ -34,6 +34,10 @@ from ..utils import failpoint, get_logger
 
 log = get_logger(__name__)
 
+# cumulative transport metrics (reference statistics/spdy.go analog)
+RPC_STATS = {"requests": 0, "responses": 0, "errors": 0,
+             "bytes_in": 0, "bytes_out": 0}
+
 MAX_FRAME = 1 << 30
 
 
@@ -127,6 +131,8 @@ def read_frame(sock: socket.socket) -> dict:
     (flen,) = struct.unpack("<I", _read_exact(sock, 4))
     if flen > MAX_FRAME:
         raise RPCError(f"frame too large: {flen}")
+    from ..utils.stats import bump as _bump
+    _bump(RPC_STATS, "bytes_in", flen + 4)
     return decode_frame(_read_exact(sock, flen))
 
 
@@ -201,11 +207,17 @@ class RPCServer:
         rid = frame.get("rid")
         mtype = frame.get("t")
         fn = self.handlers.get(mtype)
+        from ..utils.stats import bump as _bump
+        _bump(RPC_STATS, "requests")
 
         def send(body, seq=0, done=True, err=None):
             data = encode_frame(
                 {"t": mtype, "rid": rid, "seq": seq, "done": done,
                  **({"err": err} if err else {})}, body)
+            _bump(RPC_STATS, "responses")
+            _bump(RPC_STATS, "bytes_out", len(data))
+            if err:
+                _bump(RPC_STATS, "errors")
             with wlock:
                 conn.sendall(data)
 

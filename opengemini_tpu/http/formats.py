@@ -1,0 +1,175 @@
+"""HTTP response formats: CSV, msgpack, chunked JSON.
+
+Role of the reference's ResponseWriter
+(lib/util/lifted/influx/httpd/response_writer.go): /query results
+render as JSON (default), CSV (Accept: application/csv | text/csv) or
+msgpack (Accept: application/x-msgpack); `chunked=true[&chunk_size=N]`
+streams one JSON object per chunk instead of a single document.
+
+The msgpack encoder is a minimal spec-complete writer for the JSON-ish
+value domain results live in (maps/arrays/str/bytes/int/float/bool/nil)
+— the runtime image carries no msgpack library.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator
+
+
+# ------------------------------------------------------------------ csv
+
+def results_to_csv(payload: dict) -> str:
+    """Reference CSV shape: header name,tags,time,<columns...>; tags
+    rendered as k=v comma-joined; one section per series."""
+    out: list[str] = []
+    for res in payload.get("results", []):
+        for s in res.get("series", []):
+            cols = s.get("columns", [])
+            out.append(",".join(["name", "tags"] + [_csv_escape(c)
+                                                    for c in cols]))
+            tags = ",".join(f"{k}={v}" for k, v in
+                            sorted(s.get("tags", {}).items()))
+            for row in s.get("values", []):
+                cells = [_csv_escape(s.get("name", "")),
+                         _csv_escape(tags)]
+                cells += ["" if v is None else
+                          (repr(v) if isinstance(v, float)
+                           else _csv_escape(v))
+                          for v in row]
+                out.append(",".join(cells))
+        if "error" in res:
+            out.append(f"error,{_csv_escape(res['error'])}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _csv_escape(v) -> str:
+    s = str(v)
+    if any(c in s for c in ",\"\n"):
+        return '"' + s.replace('"', '""') + '"'
+    return s
+
+
+# -------------------------------------------------------------- msgpack
+
+def msgpack_encode(obj) -> bytes:
+    buf = bytearray()
+    _mp(obj, buf)
+    return bytes(buf)
+
+
+def _mp(o, buf: bytearray) -> None:
+    if o is None:
+        buf.append(0xC0)
+    elif o is True:
+        buf.append(0xC3)
+    elif o is False:
+        buf.append(0xC2)
+    elif isinstance(o, int):
+        if 0 <= o < 128:
+            buf.append(o)
+        elif -32 <= o < 0:
+            buf.append(o & 0xFF)
+        elif -(1 << 63) <= o < (1 << 64):
+            if o >= 0:
+                buf.append(0xCF)
+                buf += struct.pack(">Q", o)
+            else:
+                buf.append(0xD3)
+                buf += struct.pack(">q", o)
+        else:
+            raise ValueError("int out of msgpack range")
+    elif isinstance(o, float):
+        buf.append(0xCB)
+        buf += struct.pack(">d", o)
+    elif isinstance(o, str):
+        b = o.encode()
+        n = len(b)
+        if n < 32:
+            buf.append(0xA0 | n)
+        elif n < 256:
+            buf += bytes([0xD9, n])
+        elif n < 65536:
+            buf.append(0xDA)
+            buf += struct.pack(">H", n)
+        else:
+            buf.append(0xDB)
+            buf += struct.pack(">I", n)
+        buf += b
+    elif isinstance(o, (bytes, bytearray)):
+        n = len(o)
+        if n < 256:
+            buf += bytes([0xC4, n])
+        elif n < 65536:
+            buf.append(0xC5)
+            buf += struct.pack(">H", n)
+        else:
+            buf.append(0xC6)
+            buf += struct.pack(">I", n)
+        buf += o
+    elif isinstance(o, (list, tuple)):
+        n = len(o)
+        if n < 16:
+            buf.append(0x90 | n)
+        elif n < 65536:
+            buf.append(0xDC)
+            buf += struct.pack(">H", n)
+        else:
+            buf.append(0xDD)
+            buf += struct.pack(">I", n)
+        for x in o:
+            _mp(x, buf)
+    elif isinstance(o, dict):
+        n = len(o)
+        if n < 16:
+            buf.append(0x80 | n)
+        elif n < 65536:
+            buf.append(0xDE)
+            buf += struct.pack(">H", n)
+        else:
+            buf.append(0xDF)
+            buf += struct.pack(">I", n)
+        for k, v in o.items():
+            _mp(str(k), buf)
+            _mp(v, buf)
+    else:
+        # numpy scalars etc: fall back on their python value
+        item = getattr(o, "item", None)
+        if item is not None:
+            _mp(item(), buf)
+        else:
+            raise TypeError(f"cannot msgpack {type(o)}")
+
+
+# -------------------------------------------------------------- chunked
+
+def chunk_results(payload: dict, chunk_size: int) -> Iterator[dict]:
+    """Split a /query result into a stream of per-series (and per-
+    chunk_size row block) partial result objects — reference
+    response_writer chunked mode. Each yielded object is a complete
+    {"results": [...]} document; all but the last carry "partial"."""
+    chunks: list[dict] = []
+    for res in payload.get("results", []):
+        sid = res.get("statement_id", 0)
+        series = res.get("series")
+        if not series:
+            chunks.append({"results": [dict(res)]})
+            continue
+        for s in series:
+            rows = s.get("values", [])
+            if not rows or chunk_size <= 0:
+                blocks = [rows]
+            else:
+                blocks = [rows[i:i + chunk_size]
+                          for i in range(0, len(rows), chunk_size)]
+            for bi, block in enumerate(blocks):
+                entry = {k: v for k, v in s.items() if k != "values"}
+                entry["values"] = block
+                chunks.append({"results": [
+                    {"statement_id": sid, "series": [entry]}]})
+    if not chunks:
+        chunks.append({"results": []})
+    for i, c in enumerate(chunks):
+        if i < len(chunks) - 1:
+            c["results"][0]["partial"] = True
+        yield c

@@ -123,8 +123,15 @@ class HttpServer:
                 push_path=self.config.stats.push_path,
                 engine=engine if local else None,
                 store_database=self.config.stats.store_database)
+            from ..utils.stats import (compaction_collector,
+                                       devicecache_collector,
+                                       executor_collector, rpc_collector)
             sp.register("runtime", runtime_collector)
             sp.register("readcache", readcache_collector)
+            sp.register("executor", executor_collector)
+            sp.register("devicecache", devicecache_collector)
+            sp.register("compaction", compaction_collector)
+            sp.register("rpc", rpc_collector)
             if local:
                 sp.register("engine", engine_collector(engine))
             sp.register("httpd", lambda: dict(self.stats))
@@ -767,6 +774,49 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_cache = raw
         return raw
 
+    def _reply_query(self, code: int, payload: dict,
+                     params: dict | None = None) -> None:
+        """/query responses honor Accept (csv/msgpack) and chunked
+        streaming (reference response_writer.go). ``params`` must be the
+        handler's MERGED params (URL + form body) so chunked=true in a
+        form-encoded POST body is honored too."""
+        if params is None:
+            params = self._params()
+        accept = self.headers.get("Accept", "")
+        if code == 200 and params.get("chunked") == "true":
+            from .formats import chunk_results
+            try:
+                chunk_size = int(params.get("chunk_size") or 10000)
+            except ValueError:
+                chunk_size = 10000
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for c in chunk_results(payload, chunk_size):
+                blob = json.dumps(c).encode() + b"\n"
+                self.wfile.write(f"{len(blob):x}\r\n".encode())
+                self.wfile.write(blob + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            return
+        if code == 200 and ("application/csv" in accept
+                            or "text/csv" in accept):
+            from .formats import results_to_csv
+            body = results_to_csv(payload).encode()
+            ctype = "text/csv"
+        elif "application/x-msgpack" in accept:
+            from .formats import msgpack_encode
+            body = msgpack_encode(payload)
+            ctype = "application/x-msgpack"
+        else:
+            self._reply(code, payload)
+            return
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _reply(self, code: int, payload: dict | None = None,
                headers: dict | None = None) -> None:
         body = (json.dumps(payload).encode() + b"\n") if payload is not None \
@@ -809,7 +859,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/query":
             code, payload = srv.handle_query(self._params(), user=user)
-            self._reply(code, payload)
+            self._reply_query(code, payload)
             return
         if self._is_logstore(path):
             code, payload = srv.handle_logstore("GET", path,
@@ -851,7 +901,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
             code, payload = srv.handle_query(params, user=user)
-            self._reply(code, payload)
+            self._reply_query(code, payload, params=params)
             return
         if path == "/debug/ctrl":
             if not self._admin_gate(user):

@@ -50,7 +50,11 @@ class DownsamplePolicy:
 @dataclass
 class StreamTask:
     """Ingest-time windowed aggregation (reference app/ts-store/stream
-    tag_task/time_task)."""
+    tag_task/time_task). Tasks without group_tags run the time-task fast
+    path (one accumulator per window); tasks with group_tags are the
+    tag-task shape. ``condition`` filters source rows (tag equality map,
+    reference task filters); late rows below the watermark are dropped
+    and counted (reference lateness policy)."""
     name: str
     src_measurement: str
     dest_measurement: str
@@ -58,6 +62,7 @@ class StreamTask:
     group_tags: list = field(default_factory=list)
     calls: dict = field(default_factory=dict)   # field -> agg func
     delay_ns: int = 0
+    condition: dict = field(default_factory=dict)   # tag -> required value
 
 
 @dataclass

@@ -71,6 +71,13 @@ HOST_AGG_THRESHOLD = int(
 # ~6 extra fused reduction passes; OG_EXACT_SUM=0 disables.
 EXACT_SUM = __import__("os").environ.get("OG_EXACT_SUM", "1") != "0"
 
+# cumulative scan-path metrics for the statistics pusher (reference
+# statistics/executor.go collectors)
+EXEC_STATS = {"agg_queries": 0, "rows_scanned": 0, "preagg_segments": 0,
+              "decoded_segments": 0, "dense_rows": 0,
+              "dense_cache_hits": 0, "merged_series": 0,
+              "host_reductions": 0, "device_reductions": 0}
+
 
 class QueryExecutor:
     """Executes parsed statements against a storage Engine.
@@ -901,6 +908,18 @@ class QueryExecutor:
                 times[pos:pos + n] = c["rec"].times
                 gids[pos:pos + n] = c["gi"]
                 pos += n
+        from ..utils.stats import bump as _bump_stat
+        _bump_stat(EXEC_STATS, "agg_queries")
+        _bump_stat(EXEC_STATS, "rows_scanned", n_rows)
+        if scanres is not None:
+            _s = scanres.stats
+            _bump_stat(EXEC_STATS, "preagg_segments", _s.preagg_segments)
+            _bump_stat(EXEC_STATS, "decoded_segments",
+                       _s.decoded_segments)
+            _bump_stat(EXEC_STATS, "dense_rows", _s.dense_rows)
+            _bump_stat(EXEC_STATS, "dense_cache_hits",
+                       _s.dense_cache_hits)
+            _bump_stat(EXEC_STATS, "merged_series", _s.merged_series)
         if scan_sp is not None:
             scan_sp.end_ns = _now_ns()
             scan_sp.add(shards=len(shards), groups=G, rows=n_rows)
@@ -931,6 +950,9 @@ class QueryExecutor:
         # tiny sparse leftovers (dense/pre-agg took the bulk) reduce on
         # host — two device round-trips cost more than the arithmetic
         use_host = n_rows <= HOST_AGG_THRESHOLD
+        from ..utils.stats import bump as _bump_r
+        _bump_r(EXEC_STATS, "host_reductions" if use_host
+                else "device_reductions")
 
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}

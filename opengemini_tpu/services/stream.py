@@ -10,6 +10,7 @@ destination measurement."""
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 
 from ..meta.catalog import StreamTask
 from ..storage.rows import PointRow
@@ -34,17 +35,80 @@ class _WindowCache:
         self.task = task
         self.windows: dict[tuple, dict] = {}
         self.max_event_time = 0
+        self.last_seen_event = -1        # ticker idle detection
+        # windows force-closed by the idle ticker: stragglers into them
+        # count late instead of double-emitting (bounded set)
+        self.flushed: "OrderedDict[tuple, None]" = OrderedDict()
+        # per-task counters (reference stream statistics)
+        self.rows_in = 0
+        self.rows_filtered = 0
+        self.rows_late = 0
+        self.windows_flushed = 0
+
+    def mark_flushed(self, key: tuple) -> None:
+        self.flushed[key] = None
+        while len(self.flushed) > 4096:
+            self.flushed.popitem(last=False)
 
 
 class StreamEngine:
-    """Registered on the engine's write hook; owns all tasks of all dbs."""
+    """Registered on the engine's write hook; owns all tasks of all dbs.
 
-    def __init__(self, engine, catalog):
+    flush_interval_s drives a background ticker that closes windows by
+    WALL clock when ingest pauses (reference stream.go flush ticker) —
+    without it the tail windows only flush at shutdown."""
+
+    def __init__(self, engine, catalog, flush_interval_s: float = 0.0):
         self.engine = engine
         self.catalog = catalog
         self._lock = threading.Lock()
         self._caches: dict[tuple, _WindowCache] = {}
         engine.write_hooks.append(self.on_write)
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+        if flush_interval_s > 0:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, args=(flush_interval_s,),
+                daemon=True, name="stream-flush")
+            self._ticker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+
+    def _tick_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            pending: list[tuple[str, list[PointRow]]] = []
+            with self._lock:
+                for (db, _n), cache in self._caches.items():
+                    # IDLE detection only — never advance the EVENT-time
+                    # watermark by wall clock (that would drop backfill/
+                    # replay ingest whose event times lag wall time as
+                    # 'late'). A stream whose event time hasn't moved
+                    # for a full tick has stalled: close its open
+                    # windows, marking them flushed so stragglers count
+                    # as late rather than double-emitting.
+                    if cache.windows and \
+                            cache.max_event_time == cache.last_seen_event:
+                        out = self._drain(cache, mark_flushed=True)
+                        if out:
+                            pending.append((db, out))
+                    cache.last_seen_event = cache.max_event_time
+            for db, out in pending:
+                try:
+                    self.engine.write_points(db, out)
+                except Exception:
+                    log.exception("stream flush write failed")
+
+    def task_stats(self) -> dict:
+        with self._lock:
+            return {f"{db}.{name}": {
+                "rows_in": c.rows_in, "rows_filtered": c.rows_filtered,
+                "rows_late": c.rows_late,
+                "windows_flushed": c.windows_flushed,
+                "open_windows": len(c.windows)}
+                for (db, name), c in self._caches.items()}
 
     # ---- task admin ------------------------------------------------------
 
@@ -85,14 +149,31 @@ class StreamEngine:
             if src in by_mst and src != cache.task.dest_measurement:
                 self._feed(key_db, cache, by_mst[src])
 
+    _EMPTY_KEY = ()
+
     def _feed(self, db: str, cache: _WindowCache,
               rows: list[PointRow]) -> None:
         t = cache.task
+        cond = t.condition
+        is_time_task = not t.group_tags     # time_task.go fast path
         out = []
         with self._lock:
+            watermark = cache.max_event_time - t.delay_ns
             for r in rows:
+                cache.rows_in += 1
+                if cond and any(r.tags.get(k) != v
+                                for k, v in cond.items()):
+                    cache.rows_filtered += 1
+                    continue
                 win = r.time // t.interval_ns * t.interval_ns
-                gkey = tuple(r.tags.get(k, "") for k in t.group_tags)
+                gkey = self._EMPTY_KEY if is_time_task else \
+                    tuple(r.tags.get(k, "") for k in t.group_tags)
+                if win + t.interval_ns <= watermark \
+                        or (win, gkey) in cache.flushed:
+                    # window already flushed — reference lateness
+                    # policy: drop and count, never rewrite history
+                    cache.rows_late += 1
+                    continue
                 acc = cache.windows.setdefault((win, gkey), {})
                 for fname, func in t.calls.items():
                     v = r.fields.get(fname)
@@ -105,7 +186,8 @@ class StreamEngine:
                         acc[outname] = (s + v, c + 1)
                     else:
                         acc[outname] = _AGGS[func](acc.get(outname), v)
-                cache.max_event_time = max(cache.max_event_time, r.time)
+                if r.time > cache.max_event_time:
+                    cache.max_event_time = r.time
             out = self._collect_closed(cache)
         if out:
             self.engine.write_points(db, out)
@@ -114,11 +196,20 @@ class StreamEngine:
         """Flush windows fully below the watermark."""
         t = cache.task
         watermark = cache.max_event_time - t.delay_ns
+        return self._drain(cache, below=watermark)
+
+    def _drain(self, cache: _WindowCache, below: int | None = None,
+               mark_flushed: bool = False) -> list[PointRow]:
+        """Pop + materialize windows (all of them, or those fully below
+        ``below``); optionally mark them flushed for lateness tracking."""
+        t = cache.task
         out = []
         for (win, gkey) in sorted(cache.windows):
-            if win + t.interval_ns > watermark:
+            if below is not None and win + t.interval_ns > below:
                 continue
             acc = cache.windows.pop((win, gkey))
+            if mark_flushed:
+                cache.mark_flushed((win, gkey))
             fields = {}
             for name, v in acc.items():
                 if isinstance(v, tuple):  # mean (sum, count)
@@ -126,6 +217,7 @@ class StreamEngine:
                 else:
                     fields[name] = float(v)
             if fields:
+                cache.windows_flushed += 1
                 tags = dict(zip(t.group_tags, gkey))
                 out.append(PointRow(t.dest_measurement, tags, fields, win))
         return out

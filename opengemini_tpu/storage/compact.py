@@ -18,6 +18,9 @@ from .tssp import TSSPReader, TSSPWriter
 
 log = get_logger(__name__)
 
+# cumulative metrics for the statistics pusher (statistics/compact.go)
+COMPACT_STATS = {"merges": 0, "files_merged": 0, "series_merged": 0}
+
 BASE_SIZE = 1 << 20       # 1 MiB → level 0
 DEFAULT_FANOUT = 4
 MAX_LEVEL = 6
@@ -78,6 +81,9 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
     Returns the new file's path, or None when the merge produced no rows
     (inputs are still removed — they contributed nothing).
     """
+    from ..utils.stats import bump as _bump
+    _bump(COMPACT_STATS, "merges")
+    _bump(COMPACT_STATS, "files_merged", len(readers))
     with shard.table_lock:
         # re-snapshot under the lock: a concurrent rewrite may have
         # replaced some of the planned inputs

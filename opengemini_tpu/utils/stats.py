@@ -126,6 +126,17 @@ class StatisticsPusher:
 
 # ------------------------------------------------- standard collectors
 
+COUNTER_LOCK = threading.Lock()
+
+
+def bump(counters: dict, key: str, n: int = 1) -> None:
+    """Locked increment for the module-level metric dicts — `d[k] += n`
+    is a non-atomic read-modify-write and drops counts under the
+    threaded HTTP/RPC servers."""
+    with COUNTER_LOCK:
+        counters[key] = counters.get(key, 0) + n
+
+
 def runtime_collector():
     """Process runtime metrics (reference statistics/runtime.go analog)."""
     import resource
@@ -160,3 +171,30 @@ def engine_collector(engine):
 def readcache_collector():
     from ..storage import readcache
     return readcache.global_cache().stats()
+
+
+def executor_collector():
+    """Query executor metrics (reference statistics/executor.go analog):
+    scan-path counters accumulated across queries."""
+    from ..query.executor import EXEC_STATS
+    return dict(EXEC_STATS)
+
+
+def devicecache_collector():
+    """Device block cache metrics (readcache analog, HBM tier)."""
+    from ..ops import devicecache
+    if not devicecache.enabled():
+        return {"enabled": 0}
+    return devicecache.global_cache().stats()
+
+
+def compaction_collector():
+    """Compaction/merge metrics (reference statistics/compact.go)."""
+    from ..storage.compact import COMPACT_STATS
+    return dict(COMPACT_STATS)
+
+
+def rpc_collector():
+    """Cluster transport metrics (reference statistics/spdy.go)."""
+    from ..cluster.transport import RPC_STATS
+    return dict(RPC_STATS)

@@ -375,3 +375,68 @@ def test_rp_edge_semantics(tmp_path):
     rows = {r[0]: r for r in res["series"][0]["values"]}
     assert rows["rp1"][2] == "168h0m0s"
     eng.close()
+
+
+def test_stream_condition_lateness_and_ticker(tmp_path):
+    """Round-2 stream depth: condition filters, late-row drops, wall
+    clock ticker flush, per-task stats (reference tag_task/time_task)."""
+    import time as _time
+    from opengemini_tpu.meta.catalog import Catalog, StreamTask
+    from opengemini_tpu.services.stream import StreamEngine
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.storage.rows import PointRow
+    MIN = 60 * 10**9
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "c.json"))
+    cat.create_database("db0")
+    stream = StreamEngine(eng, cat, flush_interval_s=0.2)
+    try:
+        eng.create_database("db0")
+        stream.register("db0", StreamTask(
+            name="t", src_measurement="m", dest_measurement="agg",
+            interval_ns=MIN, group_tags=["host"],
+            calls={"v": "sum"}, condition={"dc": "east"}))
+        rows = [PointRow("m", {"host": "a", "dc": "east"}, {"v": 1.0},
+                         0 * MIN + 1),
+                PointRow("m", {"host": "a", "dc": "west"}, {"v": 100.0},
+                         0 * MIN + 2),              # filtered out
+                PointRow("m", {"host": "a", "dc": "east"}, {"v": 2.0},
+                         5 * MIN)]                  # advances watermark
+        eng.write_points("db0", rows)
+        # window 0 closed by event-time watermark → flushed with only
+        # the dc=east row
+        res = None
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            shards = eng.database("db0").all_shards()
+            found = [s for s in shards if "agg" in s.measurements()]
+            if found:
+                rec = found[0].read_series(
+                    "agg", found[0].series_ids("agg")[0])
+                if rec is not None:
+                    res = rec
+                    break
+            _time.sleep(0.05)
+        assert res is not None
+        col = res.column("v_sum")
+        assert col.values[0] == 1.0
+        # a late row into the flushed window is dropped + counted
+        eng.write_points("db0", [PointRow(
+            "m", {"host": "a", "dc": "east"}, {"v": 50.0}, 0 * MIN + 3)])
+        st = stream.task_stats()["db0.t"]
+        assert st["rows_late"] == 1
+        assert st["rows_filtered"] == 1
+        assert st["windows_flushed"] >= 1
+        # wall-clock ticker eventually closes the tail window (5m) even
+        # with no further ingest
+        deadline = _time.monotonic() + 5
+        flushed = False
+        while _time.monotonic() < deadline:
+            if stream.task_stats()["db0.t"]["open_windows"] == 0:
+                flushed = True
+                break
+            _time.sleep(0.1)
+        assert flushed
+    finally:
+        stream.stop()
+        eng.close()
