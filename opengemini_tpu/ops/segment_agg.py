@@ -194,22 +194,32 @@ def _segment_all(values, valid, seg_ids, num_segments: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_segments", "spec", "sorted_ids"))
+    static_argnames=("num_segments", "spec", "sorted_ids",
+                     "host_gather"))
 def segment_aggregate(values: jax.Array,
                       valid: jax.Array,
                       seg_ids: jax.Array,
                       times: jax.Array | None,
                       num_segments: int,
                       spec: AggSpec = AggSpec(),
-                      sorted_ids: bool = True) -> SegmentAggResult:
+                      sorted_ids: bool = True,
+                      host_gather: bool = False) -> SegmentAggResult:
     """Sparse path: fused masked segment reductions.
 
     values: (N,) float; valid: (N,) bool; seg_ids: (N,) int in
     [0, num_segments] (num_segments = trash); times: (N,) int64, needed only
     for first/last.
+
+    host_gather=True returns ROW INDICES in the first/last/min/max
+    fields instead of gathered values (sentinels: n / -1 / n / n for
+    empty cells): on platforms whose f64 is emulated as float32 pairs
+    (axon), values round-tripped through the device lose low mantissa
+    bits — the caller gathers exact values host-side. Times (int64)
+    stay exact either way.
     """
     res = _segment_all(values, valid, seg_ids, num_segments, spec, sorted_ids)
     ns = num_segments + 1
+    n = values.shape[0]
     min_t = max_t = None
     if spec.min_time or spec.max_time:
         if times is None:
@@ -222,11 +232,31 @@ def segment_aggregate(values: jax.Array,
             max_t = _extremum_time_segment(
                 values, valid, times, seg_ids, ns, num_segments,
                 sorted_ids, is_min=False)
+    if host_gather and (spec.min or spec.max):
+        # earliest row index achieving the extremum (XLA CSEs the
+        # extremum reductions against _segment_all's)
+        idx = jnp.arange(n, dtype=_I64)
+        pos, neg = _minmax_idents(values.dtype)
+        if spec.min:
+            ext = jax.ops.segment_min(jnp.where(valid, values, pos),
+                                      seg_ids, ns,
+                                      indices_are_sorted=sorted_ids)
+            at = valid & (values == ext[seg_ids])
+            res["min"] = jax.ops.segment_min(
+                jnp.where(at, idx, n), seg_ids, ns,
+                indices_are_sorted=sorted_ids)[:num_segments]
+        if spec.max:
+            ext = jax.ops.segment_max(jnp.where(valid, values, neg),
+                                      seg_ids, ns,
+                                      indices_are_sorted=sorted_ids)
+            at = valid & (values == ext[seg_ids])
+            res["max"] = jax.ops.segment_min(
+                jnp.where(at, idx, n), seg_ids, ns,
+                indices_are_sorted=sorted_ids)[:num_segments]
     first = last = first_t = last_t = None
     if spec.first or spec.last:
         if times is None:
             raise ValueError("first/last need times")
-        n = values.shape[0]
         idx = jnp.arange(n, dtype=_I64)
         if spec.first:
             fi = jax.ops.segment_min(jnp.where(valid, idx, n), seg_ids, ns,
@@ -235,14 +265,16 @@ def segment_aggregate(values: jax.Array,
             has = fi < n
             # first/last stay f64 even for typed integer columns: the
             # merge protocol marks empty cells with NaN
-            first = jnp.where(has, values[safe].astype(_F64), jnp.nan)
+            first = fi if host_gather else \
+                jnp.where(has, values[safe].astype(_F64), jnp.nan)
             first_t = jnp.where(has, times[safe], 0)
         if spec.last:
             li = jax.ops.segment_max(jnp.where(valid, idx, -1), seg_ids, ns,
                                      indices_are_sorted=sorted_ids)[:num_segments]
             safe = jnp.maximum(li, 0)
             has = li >= 0
-            last = jnp.where(has, values[safe].astype(_F64), jnp.nan)
+            last = li if host_gather else \
+                jnp.where(has, values[safe].astype(_F64), jnp.nan)
             last_t = jnp.where(has, times[safe], 0)
     return SegmentAggResult(
         count=res.get("count"), sum=res.get("sum"), sumsq=res.get("sumsq"),
@@ -378,6 +410,38 @@ def merge_seg_results(a: SegmentAggResult,
             a.max > b.max, a.max_time,
             jnp.where(b.max > a.max, b.max_time,
                       jnp.minimum(a.max_time, b.max_time))))
+
+
+def dense_window_aggregate_host(values: np.ndarray,
+                                valid: np.ndarray,
+                                spec: AggSpec = AggSpec()
+                                ) -> SegmentAggResult:
+    """Numpy mirror of the dense (S, P) reductions for the scan's dense
+    groups. On remote-attached, f64-emulated TPUs this is the right
+    home for them: P is small (points per window), the result grid is
+    large (D2H at tens of MB/s), and emulated-f64 compare/gather loses
+    low mantissa bits — host numpy is faster AND exact. The device
+    dense kernel remains for device-resident pipelines (bench kernel
+    ceiling, block-resident path)."""
+    is_int = np.issubdtype(values.dtype, np.integer)
+    vz = np.where(valid, values, 0)
+    res: dict[str, np.ndarray | None] = {}
+    res["count"] = valid.sum(axis=1, dtype=np.int64)
+    if spec.sum:
+        res["sum"] = vz.sum(axis=1,
+                            dtype=np.int64 if is_int else np.float64)
+    if spec.sumsq:
+        vf = vz.astype(np.float64, copy=False)
+        res["sumsq"] = (vf * vf).sum(axis=1)
+    if spec.min:
+        ident = np.iinfo(np.int64).max if is_int else np.inf
+        res["min"] = np.where(valid, values, ident).min(axis=1)
+    if spec.max:
+        ident = np.iinfo(np.int64).min if is_int else -np.inf
+        res["max"] = np.where(valid, values, ident).max(axis=1)
+    return SegmentAggResult(
+        count=res.get("count"), sum=res.get("sum"),
+        sumsq=res.get("sumsq"), min=res.get("min"), max=res.get("max"))
 
 
 def segment_aggregate_host(values: np.ndarray,

@@ -963,6 +963,7 @@ class QueryExecutor:
             a.func in ("sum", "mean", "stddev") for a in aggs)
         exact_results: dict[str, tuple] = {}
         exact_scales: dict[str, int] = {}
+        sel_results: dict[str, tuple] = {}
         dev_sp = span.child("device_agg") if span is not None else None
         if dev_sp is not None:
             dev_sp.start_ns = _now_ns()
@@ -1066,9 +1067,17 @@ class QueryExecutor:
             else:
                 vals_p, valid_p = pad_rows([vals, valid], npad,
                                            seg_fill=0)
+                # host_gather: selector fields come back as ROW INDICES
+                # and the exact values gather host-side (emulated-f64
+                # platforms lose low mantissa bits on value round-trips)
+                gather = bool(spec.first or spec.last or spec.min
+                              or spec.max)
                 res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
                                         num_segments, spec,
-                                        sorted_ids=seg_sorted)
+                                        sorted_ids=seg_sorted,
+                                        host_gather=gather)
+                if gather:
+                    sel_results[fname] = vals_p
                 if field_exact:
                     # decompose on HOST (real f64 — exact), reduce in
                     # int64 on device (exact integer adds)
@@ -1090,15 +1099,12 @@ class QueryExecutor:
         dense_out: dict[str, list] = {}
         dense_exact: dict[str, list] = {}
         if scanres is not None and scanres.dense:
-            import jax
-            from ..ops import dense_window_aggregate
+            from ..ops.segment_agg import dense_window_aggregate_host
             if exact_on:
                 from ..ops import exactsum
             for P, grp in sorted(scanres.dense.items()):
                 S = len(grp.cells)
-                Spad = pad_bucket(S, minimum=128)
                 fp = grp.fingerprint
-                host_padded: dict[str, tuple] = {}
                 if grp.cached:
                     pin = dense_pins.get(fp, {})
                     entries = [(nm, v, m, ft)
@@ -1107,19 +1113,12 @@ class QueryExecutor:
                 else:
                     entries = []
                     for fname, (dvals, dvalid) in grp.fields.items():
-                        if Spad != S:
-                            dvals = np.concatenate(
-                                [dvals, np.zeros((Spad - S, P))])
-                            dvalid = np.concatenate(
-                                [dvalid,
-                                 np.zeros((Spad - S, P), np.bool_)])
                         ft = scanres.field_types.get(fname)
-                        host_padded[fname] = (dvals, dvalid)
                         if dcache is not None:
-                            # pin the padded blocks in HBM for repeat
-                            # queries (readcache analog, device tier)
-                            dvals = jax.device_put(dvals)
-                            dvalid = jax.device_put(dvalid)
+                            # pin the assembled blocks for repeat
+                            # queries (readcache analog; host arrays —
+                            # dense reductions run on host, see
+                            # dense_window_aggregate_host)
                             dcache.put((fp, fname, "vals"), dvals)
                             dcache.put((fp, fname, "valid"), dvalid)
                         entries.append((fname, dvals, dvalid, ft))
@@ -1127,28 +1126,27 @@ class QueryExecutor:
                     if grp.cached and fname not in \
                             (scanres.field_types or {}) and ft is not None:
                         field_types[fname] = ft
-                    res = dense_window_aggregate(dvals, dvalid, None,
-                                                 spec)
+                    rkey = (fp, fname, "dense_res", spec)
+                    res = dcache.get(rkey) if dcache else None
+                    if res is None:
+                        res = dense_window_aggregate_host(dvals, dvalid,
+                                                          spec)
+                        if dcache is not None:
+                            dcache.put(rkey, res)
                     dense_out.setdefault(fname, []).append(
                         (grp.cells, S, res))
                     if exact_on and fname in exact_scales:
-                        # dense exact sums reduce on HOST: (S, K) int64
-                        # sums are tiny, the reduction is a few numpy
-                        # passes, and the per-(group, scale) result is
-                        # cached — repeat queries pay nothing
+                        # dense exact sums: (S, K) int64 limb sums,
+                        # cached per (group, scale) — repeats pay
+                        # nothing
                         E = exact_scales[fname]
                         lkey = (fp, fname, "limbsum", E)
                         bkey = (fp, fname, "limb_bad", E)
                         lsum = dcache.get(lkey) if dcache else None
                         bad_rows = dcache.get(bkey) if dcache else None
                         if lsum is None or bad_rows is None:
-                            if grp.cached:
-                                # scale changed since the blocks were
-                                # cached: pull once, re-decompose
-                                hv, hm = jax.device_get((dvals, dvalid))
-                            else:
-                                hv, hm = host_padded[fname]
-                            dl_i32, dbad = exactsum.host_limbs(hv, hm, E)
+                            dl_i32, dbad = exactsum.host_limbs(
+                                dvals, dvalid, E)
                             bad_rows = dbad.any(axis=1)
                             lsum = dl_i32.astype(np.int64).sum(axis=1)
                             if dcache is not None:
@@ -1171,9 +1169,42 @@ class QueryExecutor:
             # ONE batched D2H for every kernel output — per-array pulls
             # each pay a full tunnel round-trip on remote-attached TPUs
             import jax
-            field_results, dense_out, exact_results, dense_exact = \
-                jax.device_get((field_results, dense_out,
-                                exact_results, dense_exact))
+            (field_results, dense_out, exact_results, dense_exact,
+             sel_results) = jax.device_get(
+                (field_results, dense_out, exact_results, dense_exact,
+                 sel_results))
+        # exact selector values: host gather from device row indices
+        for fname, vp in sel_results.items():
+            res = field_results[fname]
+            n_p = len(vp)
+            rep = {}
+            if spec.first and res.first is not None:
+                fi = np.asarray(res.first)
+                has = fi < n_p
+                rep["first"] = np.where(
+                    has, vp[np.minimum(fi, n_p - 1)].astype(np.float64),
+                    np.nan)
+            if spec.last and res.last is not None:
+                li = np.asarray(res.last)
+                has = li >= 0
+                rep["last"] = np.where(
+                    has, vp[np.maximum(li, 0)].astype(np.float64),
+                    np.nan)
+            if spec.min and res.min is not None:
+                mi = np.asarray(res.min)
+                has = mi < n_p
+                ident = np.iinfo(np.int64).max \
+                    if vp.dtype == np.int64 else np.inf
+                rep["min"] = np.where(has, vp[np.minimum(mi, n_p - 1)],
+                                      ident).astype(vp.dtype)
+            if spec.max and res.max is not None:
+                mi = np.asarray(res.max)
+                has = mi < n_p
+                ident = np.iinfo(np.int64).min \
+                    if vp.dtype == np.int64 else -np.inf
+                rep["max"] = np.where(has, vp[np.minimum(mi, n_p - 1)],
+                                      ident).astype(vp.dtype)
+            field_results[fname] = res._replace(**rep)
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,
@@ -1234,8 +1265,19 @@ class QueryExecutor:
                         continue
                     v = np.asarray(v)[:S]
                     if combine == "add":
-                        acc = np.zeros(G * W + 1, dtype=st[k].dtype)
-                        np.add.at(acc, cells, v.astype(st[k].dtype))
+                        if k == "count" or st[k].dtype == np.float64:
+                            # bincount is ~10× np.add.at; counts sum
+                            # below 2^53 so the float accumulation is
+                            # exact, and f64 sums are the approximate
+                            # fallback state anyway
+                            acc = np.bincount(
+                                cells, weights=v.astype(np.float64),
+                                minlength=G * W + 1)
+                            acc = acc.astype(st[k].dtype, copy=False) \
+                                if k == "count" else acc
+                        else:
+                            acc = np.zeros(G * W + 1, dtype=st[k].dtype)
+                            np.add.at(acc, cells, v.astype(st[k].dtype))
                         st[k] = st[k] + acc[:G * W].reshape(G, W)
                     elif combine == "min":
                         acc = np.full(G * W + 1, np.inf)
@@ -2089,31 +2131,58 @@ def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
     W = len(win_times)
     series_out = []
     fill_null = stmt.fill_option == "null" and interval
+    # grid-level precompute: ONE numpy pass + ONE C-level tolist per
+    # output instead of per-group slicing (256+ groups × small-array
+    # numpy overhead dominated large results)
+    times_all = win_times.tolist()
+    ok_grids = []
+    val_lists = []
+    for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
+        okg = pres & anyc & np.isfinite(grid)
+        ok_grids.append(okg)
+        if kinds[oi] == "int" and grid.dtype != np.int64:
+            with np.errstate(invalid="ignore"):
+                vg = np.where(okg, grid, 0.0).astype(np.int64)
+        elif kinds[oi] == "int":
+            vg = grid
+        else:
+            vg = grid
+        val_lists.append(vg.tolist())
+    any_rows = anyc.any(axis=1)
+    all_ok = [okg.all(axis=1) for okg in ok_grids]
     for gi in order:
-        present = anyc[gi]
-        keep = np.ones(W, dtype=bool) if fill_null else present
-        if not present.any() and not fill_null:
+        if not any_rows[gi] and not fill_null:
             continue
-        times_kept = win_times[keep].tolist()
+        keep = None if fill_null else anyc[gi]
+        full = fill_null or bool(keep.all())
+        keep_idx = None if full else np.nonzero(keep)[0].tolist()
+        times_kept = times_all if full else \
+            [times_all[i] for i in keep_idx]
         out_cols = []
-        for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
-            row_vals = grid[gi][keep]
-            ok = (pres[gi] & present)[keep] & np.isfinite(row_vals)
-            if ok.all():
-                vs = (row_vals.astype(np.int64) if kinds[oi] == "int"
-                      else row_vals).tolist()
-                out_cols.append(vs)
+        for oi in range(n_out):
+            col = val_lists[oi][gi]
+            ok_row = ok_grids[oi][gi]
+            if not full:
+                col = [col[i] for i in keep_idx]
+            if (all_ok[oi][gi] if full else bool(ok_row[keep].all())):
+                out_cols.append(col)
                 continue
-            col = [None] * len(times_kept)
-            vals_ok = row_vals[ok]
-            vs = (vals_ok.astype(np.int64) if kinds[oi] == "int"
-                  else vals_ok).tolist()
-            for i, v in zip(np.nonzero(ok)[0].tolist(), vs):
-                col[i] = v
+            col = list(col)
+            bad = np.nonzero(~(ok_row if full else ok_row[keep]))[0]
+            for i in bad.tolist():
+                col[i] = None
             out_cols.append(col)
-        # (fill(null) differs only via `keep`: it emits a row per window,
-        # all-null rows included, matching influx)
-        rows = [list(r) for r in zip(times_kept, *out_cols)]
+        # row assembly via an object ndarray: .tolist() builds the
+        # nested lists in C
+        n_rows_out = len(times_kept)
+        if n_rows_out > 512:
+            arr = np.empty((n_rows_out, 1 + n_out), dtype=object)
+            arr[:, 0] = times_kept
+            for oi, col in enumerate(out_cols):
+                arr[:, 1 + oi] = col
+            rows = arr.tolist()
+        else:
+            rows = [list(r) for r in zip(times_kept, *out_cols)]
         if stmt.order_desc:
             rows.reverse()
         if stmt.offset:

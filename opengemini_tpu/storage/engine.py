@@ -226,6 +226,42 @@ class Engine:
             raise err
         return n
 
+    def write_record(self, db_name: str, mst: str, tags: dict,
+                     times, fields: dict, create_db: bool = True) -> int:
+        """Bulk columnar write of one series (reference RecordWriter,
+        coordinator/record_writer.go:79 — the arrow-flight/high-
+        throughput ingest path): numpy time/value arrays, routed to
+        shards by time slice, no per-row Python objects. Write hooks
+        (streams, subscribers) are fed materialized rows only when any
+        are registered."""
+        import numpy as np
+        db = (self.create_database(db_name) if create_db
+              else self.database(db_name))
+        times = np.ascontiguousarray(times, dtype=np.int64)
+        sd = db.opts.shard_duration
+        slots = times // sd
+        n = 0
+        for gi in np.unique(slots):
+            m = slots == gi
+            sub_t = times[m]
+            sub_f = {k: np.asarray(v)[m] for k, v in fields.items()}
+            shard = db.shard_for_time(int(gi) * sd)
+            n += shard.write_columns(mst, tags, sub_t, sub_f)
+        if n and self.write_hooks:
+            from .rows import PointRow
+            np_fields = {k: np.asarray(v) for k, v in fields.items()}
+            rows = [PointRow(mst, tags,
+                             {k: v[i].item()
+                              for k, v in np_fields.items()},
+                             int(times[i]))
+                    for i in range(len(times))]
+            for hook in self.write_hooks:
+                try:
+                    hook(db_name, rows)
+                except Exception:
+                    log.exception("write hook failed")
+        return n
+
     # ---- reads -----------------------------------------------------------
 
     def measurements(self, db_name: str) -> list[str]:

@@ -59,6 +59,21 @@ class _SeriesBuf:
             if len(col) < len(self.times):
                 col.append(None)
 
+    def extend(self, times: list, fields: dict[str, list]) -> None:
+        """Bulk columnar append (record-writer path): every field list
+        is row-aligned with `times`."""
+        n0 = len(self.times)
+        self.times.extend(times)
+        total = len(self.times)
+        for k, vals in fields.items():
+            col = self.fields.get(k)
+            if col is None:
+                col = self.fields[k] = [None] * n0
+            col.extend(vals)
+        for k, col in self.fields.items():
+            if len(col) < total:
+                col.extend([None] * (total - len(col)))
+
 
 class MemTable:
     """One measurement's in-memory data across its series."""
@@ -94,6 +109,27 @@ class MemTable:
         buf.append(fields, time, self.schema)
         self.rows += 1
         self.approx_bytes += 24 + 16 * len(fields)
+
+    def write_columns(self, sid: int, times, fields: dict) -> None:
+        """Bulk columnar write: arrays are row-aligned, all-valid.
+        Types are validated ONCE per column (the per-row path validates
+        per row)."""
+        probe = {k: (v[0].item() if hasattr(v[0], "item") else v[0])
+                 for k, v in fields.items() if len(v)}
+        self.validate(probe)
+        for k, v in probe.items():
+            if k not in self.schema:
+                self.schema[k] = field_type_of(v)
+        buf = self.series.get(sid)
+        if buf is None:
+            buf = self.series[sid] = _SeriesBuf()
+        tl = times.tolist() if hasattr(times, "tolist") else list(times)
+        buf.extend(tl, {k: (v.tolist() if hasattr(v, "tolist")
+                            else list(v))
+                        for k, v in fields.items()})
+        n = len(tl)
+        self.rows += n
+        self.approx_bytes += n * (24 + 16 * len(fields))
 
     def record_schema(self) -> Schema:
         return Schema.from_pairs(sorted(self.schema.items()))
@@ -148,6 +184,14 @@ class MemTables:
             if mt is None:
                 mt = self.active[measurement] = MemTable(measurement)
             mt.write(sid, fields, time)
+
+    def write_columns(self, measurement: str, sid: int, times,
+                      fields: dict) -> None:
+        with self._lock:
+            mt = self.active.get(measurement)
+            if mt is None:
+                mt = self.active[measurement] = MemTable(measurement)
+            mt.write_columns(sid, times, fields)
 
     def validate(self, measurement: str, fields: dict) -> None:
         with self._lock:

@@ -194,6 +194,16 @@ class Shard:
     def _replay_wal(self) -> None:
         n = bad = 0
         for batch in self.wal.replay():
+            if isinstance(batch, tuple) and batch[0] == "cols":
+                for mst, sid, times, fields in batch[1]:
+                    try:
+                        self.mem.write_columns(mst, sid, times, fields)
+                        n += len(times)
+                    except Exception as e:
+                        bad += len(times)
+                        log.error("shard %d: dropping bad wal column "
+                                  "batch (%s): %s", self.shard_id, mst, e)
+                continue
             for mst, sid, fields, t in batch:
                 try:
                     self.mem.write(mst, sid, self._coerce(mst, fields), t)
@@ -253,6 +263,59 @@ class Shard:
         if self.mem.approx_bytes >= self.flush_bytes:
             self.flush()
         return len(batch)
+
+    def write_columns(self, mst: str, tags: dict[str, str],
+                      times, fields: dict) -> int:
+        """Bulk columnar write of ONE series (reference RecordWriter /
+        arrow-flight ingest path, coordinator/record_writer.go:79):
+        numpy arrays straight through WAL and memtable, no per-row
+        Python. Arrays are row-aligned and all-valid; int values land
+        as INTEGER unless the registry says FLOAT (coerced whole-column).
+        Returns rows written."""
+        import numpy as np
+        if mst in self.cs_options:
+            raise ErrTypeConflict(
+                "bulk columnar writes target row-store measurements")
+        n = len(times)
+        if n == 0:
+            return 0
+        times = np.ascontiguousarray(times, dtype=np.int64)
+        norm: dict[str, np.ndarray] = {}
+        probe: dict[str, object] = {}
+        for k, arr in fields.items():
+            a = np.asarray(arr)
+            if len(a) != n:
+                raise ValueError(f"field {k}: length {len(a)} != {n}")
+            if a.dtype == np.bool_:
+                pass
+            elif np.issubdtype(a.dtype, np.integer):
+                a = a.astype(np.int64, copy=False)
+            elif np.issubdtype(a.dtype, np.floating):
+                a = a.astype(np.float64, copy=False)
+            else:
+                raise ErrTypeConflict(
+                    f"field {k}: bulk writes are numeric/bool only")
+            norm[k] = a
+            probe[k] = a[0].item()
+        before = self.index.series_cardinality
+        sid = self.index.get_or_create_sid(mst, tags)
+        created = self.index.series_cardinality != before
+        with self._lock:
+            staged: dict = {}
+            self._check_fields(staged, mst, probe)
+            self._commit_fields(staged)
+            sch = self._schemas.get(mst, {})
+            for k in list(norm):
+                if sch.get(k) == DataType.FLOAT \
+                        and norm[k].dtype == np.int64:
+                    norm[k] = norm[k].astype(np.float64)
+            if created:
+                self.index.flush()
+            self.wal.write_cols([(mst, sid, times, norm)])
+            self.mem.write_columns(mst, sid, times, norm)
+        if self.mem.approx_bytes >= self.flush_bytes:
+            self.flush()
+        return n
 
     # ---- flush -----------------------------------------------------------
 

@@ -25,6 +25,8 @@ log = get_logger(__name__)
 
 _HDR = struct.Struct("<II")
 _ZSTD, _LZ4 = 1, 2
+# columnar frames (bulk record writes — reference record_writer.go path)
+_ZSTD_COLS, _LZ4_COLS = 3, 4
 
 
 def _pack_batch(rows: list[tuple[str, int, dict, int]]) -> bytes:
@@ -80,6 +82,59 @@ def _unpack_batch(buf: bytes) -> list[tuple[str, int, dict, int]]:
     return rows
 
 
+def _pack_cols(entries) -> bytes:
+    """Columnar batch: [(mst, sid, times i64 array, {field: array})…] —
+    numpy buffers serialized whole, no per-row Python."""
+    import numpy as np
+    out = [struct.pack("<I", len(entries))]
+    for mst, sid, times, fields in entries:
+        mb = mst.encode()
+        t = np.ascontiguousarray(times, dtype="<i8")
+        out.append(struct.pack("<HQIH", len(mb), sid, len(t),
+                               len(fields)))
+        out.append(mb)
+        out.append(t.tobytes())
+        for k, arr in fields.items():
+            kb = k.encode()
+            a = np.ascontiguousarray(arr)
+            if a.dtype.byteorder == ">":
+                a = a.astype(a.dtype.newbyteorder("<"))
+            dtb = a.dtype.str.encode()
+            out.append(struct.pack("<HB", len(kb), len(dtb)))
+            out.append(kb)
+            out.append(dtb)
+            out.append(a.tobytes())
+    return b"".join(out)
+
+
+def _unpack_cols(buf: bytes):
+    import numpy as np
+    (n,) = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    entries = []
+    for _ in range(n):
+        mlen, sid, rows, nf = struct.unpack_from("<HQIH", buf, pos)
+        pos += struct.calcsize("<HQIH")
+        mst = buf[pos:pos + mlen].decode()
+        pos += mlen
+        times = np.frombuffer(buf, dtype="<i8", count=rows,
+                              offset=pos).copy()
+        pos += rows * 8
+        fields = {}
+        for _ in range(nf):
+            klen, dlen = struct.unpack_from("<HB", buf, pos)
+            pos += struct.calcsize("<HB")
+            k = buf[pos:pos + klen].decode()
+            pos += klen
+            dt = np.dtype(buf[pos:pos + dlen].decode())
+            pos += dlen
+            fields[k] = np.frombuffer(buf, dtype=dt, count=rows,
+                                      offset=pos).copy()
+            pos += rows * dt.itemsize
+        entries.append((mst, sid, times, fields))
+    return entries
+
+
 class WAL:
     def __init__(self, dir_path: str, sync: bool = False,
                  compression: str = "zstd"):
@@ -114,6 +169,22 @@ class WAL:
             codec, body = _LZ4, lz4_compress(raw)
         else:
             codec, body = _ZSTD, self._zc.compress(raw)
+        payload = struct.pack("<BI", codec, len(raw)) + body
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._f.write(frame)
+            if self.sync:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+
+    def write_cols(self, entries) -> None:
+        """Columnar frame (bulk record write path)."""
+        failpoint.inject("wal.write.err")
+        raw = _pack_cols(entries)
+        if self.compression == "lz4":
+            codec, body = _LZ4_COLS, lz4_compress(raw)
+        else:
+            codec, body = _ZSTD_COLS, self._zc.compress(raw)
         payload = struct.pack("<BI", codec, len(raw)) + body
         frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
@@ -170,13 +241,18 @@ class WAL:
                 if zlib.crc32(payload) != crc:
                     log.warning("wal %06d: bad crc at %d", seq, pos)
                     break
-                if len(payload) >= 5 and payload[0] in (_ZSTD, _LZ4):
+                if len(payload) >= 5 and payload[0] in (
+                        _ZSTD, _LZ4, _ZSTD_COLS, _LZ4_COLS):
                     codec, rawlen = struct.unpack_from("<BI", payload, 0)
                     body = payload[5:]
-                    if codec == _LZ4:
+                    if codec in (_LZ4, _LZ4_COLS):
                         raw = lz4_decompress(body, rawlen)
                     else:
                         raw = zd.decompress(body)
+                    if codec in (_ZSTD_COLS, _LZ4_COLS):
+                        yield ("cols", _unpack_cols(raw))
+                        pos += _HDR.size + ln
+                        continue
                 else:
                     # legacy frame: bare zstd payload (zstd magic first byte
                     # 0x28 cannot collide with the codec ids)

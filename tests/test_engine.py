@@ -224,3 +224,74 @@ def test_flush_idempotent_empty(tmp_path):
     eng.create_database("db0")
     eng.flush_all()  # no data: no-op
     eng.close()
+
+
+# ---- bulk columnar writes (record-writer path, round 2) -----------------
+
+def test_write_record_equivalent_to_rows(tmp_path):
+    import numpy as np
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    MIN = 60 * 10**9
+    e1 = Engine(str(tmp_path / "a"))
+    e2 = Engine(str(tmp_path / "b"))
+    times = np.arange(10, dtype=np.int64) * MIN
+    vals = np.array([0.5 * i for i in range(10)])
+    cnts = np.arange(10, dtype=np.int64) * 3
+    e1.write_record("db0", "m", {"host": "x"}, times,
+                    {"v": vals, "c": cnts})
+    from opengemini_tpu.storage.rows import PointRow
+    e2.write_points("db0", [
+        PointRow("m", {"host": "x"}, {"v": float(vals[i]),
+                                      "c": int(cnts[i])}, int(times[i]))
+        for i in range(10)])
+    q = ("SELECT sum(v), count(v), sum(c), max(c) FROM m "
+         "WHERE time >= 0 AND time < 20m GROUP BY time(5m), host")
+    (stmt,) = parse_query(q)
+    r1 = QueryExecutor(e1).execute(stmt, "db0")
+    r2 = QueryExecutor(e2).execute(stmt, "db0")
+    assert r1 == r2
+    e1.close()
+    e2.close()
+
+
+def test_write_record_wal_replay(tmp_path):
+    import numpy as np
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    path = str(tmp_path / "d")
+    eng = Engine(path)
+    times = np.arange(100, dtype=np.int64) * 10**9
+    eng.write_record("db0", "m", {"h": "a"}, times,
+                     {"v": np.sqrt(np.arange(100.0))})
+    eng.close(flush=False) if "flush" in Engine.close.__code__.co_varnames \
+        else eng.close()
+    # reopen: columnar WAL frames replay into the memtable
+    eng2 = Engine(path)
+    (stmt,) = parse_query("SELECT count(v), sum(v) FROM m")
+    res = QueryExecutor(eng2).execute(stmt, "db0")
+    row = res["series"][0]["values"][0]
+    assert row[1] == 100
+    import math
+    assert row[2] == pytest.approx(
+        math.fsum(math.sqrt(i) for i in range(100)))
+    eng2.close()
+
+
+def test_write_record_type_coercion_and_conflict(tmp_path):
+    import numpy as np
+    from opengemini_tpu.utils.errors import ErrTypeConflict
+    eng = Engine(str(tmp_path / "d"))
+    t = np.array([1, 2], dtype=np.int64)
+    eng.write_record("db0", "m", {}, t, {"v": np.array([1.5, 2.5])})
+    # ints into a float-registered field coerce whole-column
+    eng.write_record("db0", "m", {}, t + 10,
+                     {"v": np.array([3, 4], dtype=np.int64)})
+    sh = eng.database("db0").all_shards()[0]
+    rec = sh.read_series("m", sh.series_ids("m")[0])
+    assert rec.column("v").values.dtype == np.float64
+    # float into an int-registered field conflicts
+    eng.write_record("db0", "m", {}, t + 20,
+                     {"c": np.array([1, 2], dtype=np.int64)})
+    with pytest.raises(ErrTypeConflict):
+        eng.write_record("db0", "m", {}, t + 30,
+                         {"c": np.array([1.5, 2.5])})
+    eng.close()

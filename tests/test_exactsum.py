@@ -140,3 +140,51 @@ def test_negative_and_cancellation():
         assert got == math.fsum(v)
     # catastrophic cancellation handled exactly either way
     assert got == pytest.approx(math.fsum(v), abs=2 ** (E - 108))
+
+
+def test_finalize_fast_path_matches_bigint():
+    """Property: the vectorized finalize equals the per-cell big-int
+    reference on random, adversarial, and cancellation-heavy grids."""
+    rng = np.random.default_rng(11)
+
+    def bigint_ref(limbs, E):
+        from opengemini_tpu.ops.exactsum import _RADIX, SPAN_BITS
+        flat = limbs.reshape(-1, 6).astype(np.int64)
+        out = np.empty(len(flat))
+        for i, row in enumerate(flat):
+            total = 0
+            for v in row:
+                total = total * _RADIX + int(v)
+            out[i] = float(total) * 2.0 ** (E - SPAN_BITS)
+        return out.reshape(limbs.shape[:-1])
+
+    for trial in range(30):
+        E = int(rng.integers(-5, 6)) * 18
+        kind = trial % 3
+        if kind == 0:
+            limbs = rng.integers(-(1 << 40), 1 << 40, (257, 6))
+        elif kind == 1:   # near-cancellation: large opposing top limbs
+            limbs = rng.integers(-(1 << 18), 1 << 18, (257, 6))
+            limbs[:, 0] = rng.integers(-2, 2, 257)
+        else:             # midpoint-ish: sparse low bits
+            limbs = np.zeros((257, 6), dtype=np.int64)
+            limbs[:, 0] = rng.integers(0, 1 << 18, 257)
+            limbs[:, 5] = rng.integers(0, 2, 257)
+        got = finalize_exact(limbs.astype(np.float64), E)
+        ref = bigint_ref(limbs.astype(np.float64), E)
+        assert np.array_equal(got, ref), (trial, E)
+
+
+def test_finalize_fast_path_sum_semantics():
+    """End-to-end: decompose → sum → finalize still equals fsum."""
+    import math
+    rng = np.random.default_rng(12)
+    v = rng.normal(0, 1000.0, 20000)
+    seg = rng.integers(0, 64, 20000)
+    E = pick_scale(float(np.max(np.abs(v))))
+    limbs, ix = exact_segment_sum_host(v, np.ones(20000, bool), seg,
+                                       64, E)
+    assert not ix.any()
+    out = finalize_exact(limbs, E)
+    for s in range(64):
+        assert out[s] == math.fsum(v[seg == s])

@@ -420,3 +420,27 @@ def test_stddev_on_large_ints_no_overflow(db):
     # stddev of an arithmetic progression with step 3)
     val = res["series"][0]["values"][0][1]
     assert val is not None and 0.0 <= val < 10.0
+
+
+def test_device_selector_values_exact(db, monkeypatch):
+    """Regression (r2 review / axon emulation): first/last/min/max VALUES
+    through the device path must equal the stored f64 bits — row indices
+    come off the device, values gather host-side."""
+    monkeypatch.setenv("OG_HOST_AGG_THRESHOLD", "0")   # force device
+    import importlib
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(E, "HOST_AGG_THRESHOLD", 0)
+    eng, ex = db
+    vals = [50.000000000000014, 49.99999999999999, 50.00000000000002,
+            12.345678901234567, 87.65432109876543]
+    write(eng, "\n".join(
+        f"m,host=a v={v!r} {i * MIN}" for i, v in enumerate(vals)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    res = q(ex, "SELECT first(v), last(v), min(v), max(v) FROM m "
+               "WHERE time >= 0 AND time < 10m GROUP BY time(10m)")
+    row = res["series"][0]["values"][0]
+    assert row[1] == vals[0]            # first — exact stored bits
+    assert row[2] == vals[-1]           # last
+    assert row[3] == min(vals)          # min
+    assert row[4] == max(vals)          # max
