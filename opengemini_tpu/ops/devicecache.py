@@ -85,7 +85,9 @@ _CACHE: DeviceBlockCache | None = None
 
 
 def capacity_bytes() -> int:
-    return int(os.environ.get("OG_DEVICE_CACHE_MB", "1024")) * _MB
+    # v5e HBM is 16 GiB; stacks + dense pins get a healthy share by
+    # default (the engine's host memory is not charged here)
+    return int(os.environ.get("OG_DEVICE_CACHE_MB", "6144")) * _MB
 
 
 def enabled() -> bool:

@@ -66,6 +66,14 @@ MAX_WINDOWS = 100_000
 HOST_AGG_THRESHOLD = int(
     __import__("os").environ.get("OG_HOST_AGG_THRESHOLD", "32768"))
 
+# block-path dispatch (ops/blockagg.py): result grids above this pull
+# too much over the slow D2H link; files whose rows/cells ratio is
+# below the minimum reduce faster on host
+BLOCK_MAX_CELLS = int(
+    __import__("os").environ.get("OG_BLOCK_MAX_CELLS", "250000"))
+BLOCK_MIN_RATIO = int(
+    __import__("os").environ.get("OG_BLOCK_MIN_RATIO", "16"))
+
 # reproducible (bit-identical) f64 sums via binned integer limbs
 # (ops/exactsum.py) — the north star's bit-identical guarantee. Costs
 # ~6 extra fused reduction passes; OG_EXACT_SUM=0 disables.
@@ -833,6 +841,77 @@ class QueryExecutor:
                                if a.func in ("top", "bottom")}
                             | {a.field for a in aggs if a.needs_sketch})
 
+        # ------------------------------------------------ block path
+        # HBM-resident segment stacks (ops/blockagg.py): whole files
+        # reduce ON DEVICE for any window/range/grouping; eligible when
+        # no row filter or per-point state is needed, sums stay exact
+        # (limb planes), and the result grid is small enough to pull
+        # against the slow D2H link
+        block_launches: list = []      # (fname, reader, stack, devout)
+        if scan_plan is not None:
+            from ..ops import devicecache as _dc
+            preagg_possible = (cond.residual is None and not raw_fields
+                               and spec_names <= PREAGG_STATES)
+            block_ok = (
+                _dc.enabled() and cond.residual is None
+                and not raw_fields
+                and spec_names <= {"count", "sum", "min", "max", "sumsq"}
+                and (EXACT_SUM or "sum" not in spec_names)
+                and G * W <= BLOCK_MAX_CELLS
+                # windowless queries are pre-agg's sweet spot: whole
+                # segments answer from metadata with no device work
+                and not (preagg_possible and not interval))
+            if block_ok:
+                from ..ops import blockagg
+                per_file: dict[int, list] = {}
+                for sp in scan_plan.series:
+                    if sp.merged:
+                        continue
+                    for src in sp.sources:
+                        if src.reader is None:
+                            continue
+                        ent = per_file.setdefault(
+                            id(src.reader), [src.reader, {}, [], 0])
+                        ent[1][sp.sid] = sp.gid
+                        ent[2].append((sp, src))
+                        ent[3] += src.meta.rows
+                want = tuple(k for k in ("sum", "sumsq", "min", "max")
+                             if getattr(spec, k))
+                cap = _dc.capacity_bytes()
+                for _rid, (reader, sid2gid, srcs, nrows) in \
+                        per_file.items():
+                    if nrows < BLOCK_MIN_RATIO * (G * W + 1):
+                        continue       # host paths win on tiny files
+                    if nrows * 48 * len(needed_fields) > 0.8 * cap:
+                        # the stack would thrash the HBM budget —
+                        # rebuilding it per query costs more than the
+                        # host paths
+                        continue
+                    stacks = {}
+                    for fname in needed_fields:
+                        sl = blockagg.get_stacks(reader, fname)
+                        if sl is None:
+                            stacks = None
+                            break
+                        stacks[fname] = sl
+                    if not stacks:
+                        continue
+                    any_slabs = next(iter(stacks.values()))
+                    gid_arr = np.concatenate(
+                        [np.array([sid2gid.get(int(s), -1)
+                                   for s in sl.block_sids],
+                                  dtype=np.int64)
+                         for sl in any_slabs])
+                    for fname, sl in stacks.items():
+                        out = blockagg.file_aggregate(
+                            sl, gid_arr, t_lo, t_hi, int(start),
+                            int(interval_eff), W, G * W, want)
+                        block_launches.append((fname, reader, sl, out))
+                    # consume the sources: flat/dense/preagg must not
+                    # double-count these chunks
+                    for sp, src in srcs:
+                        sp.sources.remove(src)
+
         scanres = None
         if scan_plan is not None:
             # pre-agg metadata answers whole segments only when the
@@ -923,6 +1002,11 @@ class QueryExecutor:
         if scan_sp is not None:
             scan_sp.end_ns = _now_ns()
             scan_sp.add(shards=len(shards), groups=G, rows=n_rows)
+            if block_launches:
+                scan_sp.add(block_kernels=len(block_launches),
+                            block_rows=sum(sl.n_rows for _f, _r, s, _o
+                                           in block_launches
+                                           for sl in s))
             if scanres is not None:
                 sst = scanres.stats
                 scan_sp.add(preagg_segments=sst.preagg_segments,
@@ -1165,14 +1249,17 @@ class QueryExecutor:
                                [(nm, scanres.field_types.get(nm))
                                 for nm in grp.fields])
                     dcache.put((fp, "needed"), set(needed_fields))
-        if not use_host or dense_out:
+        if not use_host or dense_out or block_launches:
             # ONE batched D2H for every kernel output — per-array pulls
             # each pay a full tunnel round-trip on remote-attached TPUs
             import jax
+            block_outs = [bo for _f, _r, _s, bo in block_launches]
             (field_results, dense_out, exact_results, dense_exact,
-             sel_results) = jax.device_get(
+             sel_results, block_outs) = jax.device_get(
                 (field_results, dense_out, exact_results, dense_exact,
-                 sel_results))
+                 sel_results, block_outs))
+            block_launches = [(f, r, s, bo) for (f, r, s, _), bo in
+                              zip(block_launches, block_outs)]
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
             res = field_results[fname]
@@ -1300,9 +1387,40 @@ class QueryExecutor:
                 ft = scanres.field_types.get(fname)
                 if ft is not None:
                     field_types[fname] = ft
-            # reproducible-sum limb states (sparse + dense + pre-agg)
+            # fold in device block-path grids (HBM-resident stacks):
+            # counts/sums add; min/max merge via host-gathered EXACT
+            # values (device f64 is emulation-rounded)
+            my_blocks = [(r, s, bo) for f, r, s, bo in block_launches
+                         if f == fname]
+            for reader_b, st_blk, bo in my_blocks:
+                if "count" in st:
+                    st["count"] = st["count"] + \
+                        np.asarray(bo["count"]).reshape(G, W)
+                if "sum" in st and "sum" in bo:
+                    st["sum"] = st["sum"] + np.asarray(
+                        bo["sum"]).reshape(G, W).astype(
+                            st["sum"].dtype, copy=False)
+                if "sumsq" in st and "sumsq" in bo:
+                    st["sumsq"] = st["sumsq"] + np.asarray(
+                        bo["sumsq"]).reshape(G, W)
+                if "min" in st and "min_idx" in bo:
+                    from ..ops import blockagg as _ba
+                    ve, has = _ba.gather_exact_values(
+                        st_blk, reader_b, np.asarray(bo["min_idx"]))
+                    st["min"] = np.minimum(
+                        st["min"],
+                        np.where(has, ve, np.inf).reshape(G, W))
+                if "max" in st and "max_idx" in bo:
+                    from ..ops import blockagg as _ba
+                    ve, has = _ba.gather_exact_values(
+                        st_blk, reader_b, np.asarray(bo["max_idx"]))
+                    st["max"] = np.maximum(
+                        st["max"],
+                        np.where(has, ve, -np.inf).reshape(G, W))
+            # reproducible-sum limb states (sparse + dense + pre-agg +
+            # block stacks)
             if exact_on and (fname in exact_results
-                             or fname in dense_exact):
+                             or fname in dense_exact or my_blocks):
                 from ..ops.exactsum import K_LIMBS, rebase
                 lg = np.zeros((G * W + 1, K_LIMBS))
                 ixg = np.zeros(G * W + 1, dtype=bool)
@@ -1316,11 +1434,15 @@ class QueryExecutor:
                     np.logical_or.at(ixg, cells, np.asarray(dbad)[:S])
                 e_final = exact_scales.get(fname, 0)
                 items = (pg or {}).get("limb_items", ())
-                if items:
-                    # v2 pre-agg limb contributions: rebase everything
-                    # to the max scale, then exact integer adds
-                    e_final = max([e_final] + [sc for _c, sc, _l
-                                               in items])
+                blocks_l = [(st_blk[0].E, bo)
+                            for _r, st_blk, bo in my_blocks
+                            if "limbs" in bo]
+                if items or blocks_l:
+                    # rebase everything to the max scale, then exact
+                    # integer adds (order-free)
+                    e_final = max([e_final]
+                                  + [sc for _c, sc, _l in items]
+                                  + [e for e, _bo in blocks_l])
                     lg2, ix2 = rebase(lg[:G * W], ixg[:G * W],
                                       exact_scales.get(fname, 0),
                                       e_final)
@@ -1331,6 +1453,12 @@ class QueryExecutor:
                                          sc, e_final)
                         lg[cell] += lb2[0]
                         ixg[cell] |= i2[0]
+                    for e_b, bo in blocks_l:
+                        bl, bix = rebase(
+                            np.asarray(bo["limbs"]).astype(np.float64),
+                            np.asarray(bo["bad"]), e_b, e_final)
+                        lg[:G * W] += bl
+                        ixg[:G * W] |= bix
                     exact_scales[fname] = e_final
                 st["sum_limbs"] = lg[:G * W].reshape(G, W, K_LIMBS)
                 st["sum_inexact"] = ixg[:G * W].reshape(G, W)
