@@ -276,9 +276,10 @@ def _combiner(want: tuple, n_slabs: int):
     def _c(outs):
         comb = {"count": sum(o["count"] for o in outs)}
         if "sum" in want:
+            # the kernel emits only the exact limb planes for sums (the
+            # f64 sum is finalized from limb totals by the caller)
             comb["limbs"] = sum(o["limbs"] for o in outs)
             comb["bad"] = jnp.stack([o["bad"] for o in outs]).any(0)
-            comb["sum"] = sum(o["sum"] for o in outs)
         if "sumsq" in want:
             comb["sumsq"] = sum(o["sumsq"] for o in outs)
         if "min" in want:

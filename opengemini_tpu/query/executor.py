@@ -1396,10 +1396,16 @@ class QueryExecutor:
                 if "count" in st:
                     st["count"] = st["count"] + \
                         np.asarray(bo["count"]).reshape(G, W)
-                if "sum" in st and "sum" in bo:
-                    st["sum"] = st["sum"] + np.asarray(
-                        bo["sum"]).reshape(G, W).astype(
-                            st["sum"].dtype, copy=False)
+                if "sum" in st and "limbs" in bo:
+                    # f64 fallback state for inexact cells: derive from
+                    # the limb totals (truncated-but-deterministic where
+                    # the exact flag failed; == the exact total where it
+                    # held). The authoritative exact path folds the raw
+                    # limbs separately below.
+                    from ..ops.exactsum import finalize_exact as _fe
+                    st["sum"] = st["sum"] + _fe(
+                        np.asarray(bo["limbs"]).astype(np.float64),
+                        st_blk[0].E).reshape(G, W)
                 if "sumsq" in st and "sumsq" in bo:
                     st["sumsq"] = st["sumsq"] + np.asarray(
                         bo["sumsq"]).reshape(G, W)
@@ -2377,8 +2383,9 @@ def _transform_series(stmt, expr: Transform, agg_grids, agg_present,
         st = merged["fields"].get(item.field, {})
         if "count" not in st:
             return win_times[:0], np.empty(0)
-        return sliding_agg_series(item.func, st, gi, win_times,
-                                  expr.params[0])
+        return sliding_agg_series(
+            item.func, st, gi, win_times, expr.params[0],
+            merged.get("sum_scales", {}).get(item.field, 0))
     child_grid = np.broadcast_to(
         np.asarray(eval_output_grid(expr.child, agg_grids),
                    dtype=np.float64), anyc.shape)

@@ -646,7 +646,8 @@ _I64MINV = np.iinfo(np.int64).min
 
 
 def sliding_agg_series(func: str, st: dict, gi: int,
-                       win_times: np.ndarray, n: int
+                       win_times: np.ndarray, n: int,
+                       sum_scale: int = 0
                        ) -> tuple[np.ndarray, np.ndarray]:
     """sliding_window(agg(f), n): aggregate over every n consecutive
     GROUP BY time intervals (role of the reference's
@@ -663,12 +664,34 @@ def sliding_agg_series(func: str, st: dict, gi: int,
         return win_times[:0], np.empty(0)
     cnt = _swv(st["count"][gi].astype(np.float64), n).sum(axis=1)
     present = cnt > 0
+
+    def _rolling_sum():
+        # reproducible sums: where exact limb states exist they are the
+        # AUTHORITATIVE sum (device paths leave st["sum"] zero for limb-
+        # carried cells). Rolling-add the integer limb planes (exact,
+        # order-free) then finalize once per output window; inexact
+        # cells fall back to the rolling f64 sum.
+        if "sum_limbs" not in st:
+            return _swv(st["sum"][gi], n).sum(axis=1)
+        from ..ops.exactsum import finalize_exact
+        lw = _swv(st["sum_limbs"][gi], n, axis=0).sum(axis=-1)
+        ex = finalize_exact(lw, sum_scale)
+        bad = _swv(st["sum_inexact"][gi], n).any(axis=1)
+        if not bad.any():
+            return ex
+        # windows touching a limb-overflow cell: mix per cell exactly
+        # like the non-sliding finalizer (inexact cells contribute their
+        # f64 fallback, exact cells their finalized total), then roll
+        cell = np.where(st["sum_inexact"][gi], st["sum"][gi],
+                        finalize_exact(st["sum_limbs"][gi], sum_scale))
+        return np.where(bad, _swv(cell, n).sum(axis=1), ex)
+
     if func == "count":
         vals = cnt
     elif func == "sum":
-        vals = _swv(st["sum"][gi], n).sum(axis=1)
+        vals = _rolling_sum()
     elif func == "mean":
-        vals = _swv(st["sum"][gi], n).sum(axis=1) / np.maximum(cnt, 1)
+        vals = _rolling_sum() / np.maximum(cnt, 1)
     elif func == "min":
         # empty cells hold the +inf identity, so rolling min is exact
         vals = _swv(st["min"][gi], n).min(axis=1)
@@ -678,7 +701,7 @@ def sliding_agg_series(func: str, st: dict, gi: int,
         vals = _swv(st["max"][gi], n).max(axis=1) \
             - _swv(st["min"][gi], n).min(axis=1)
     elif func == "stddev":
-        s = _swv(st["sum"][gi], n).sum(axis=1)
+        s = _rolling_sum()
         ss = _swv(st["sumsq"][gi], n).sum(axis=1)
         safe = np.maximum(cnt, 2)
         var = np.maximum((ss - s * s / safe) / (safe - 1), 0.0)
