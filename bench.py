@@ -31,7 +31,7 @@ import time
 
 import numpy as np
 
-HOSTS = int(os.environ.get("OG_BENCH_HOSTS", "4000"))
+HOSTS = int(os.environ.get("OG_BENCH_HOSTS", "16000"))
 HOURS = float(os.environ.get("OG_BENCH_HOURS", "12"))
 STEP_S = 10
 # TSBS double-groupby-1 (BASELINE config 2): mean of one metric over 12h
@@ -103,6 +103,17 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
                 n_cells += 1
         out[key] = {"best_s": min(times), "digest": dig.hexdigest(),
                     "cells": n_cells}
+    # per-phase wall times from EXPLAIN ANALYZE (VERDICT r2 next #2):
+    # plan / dispatch / kernel+pull / fold / finalize of the 1h shape
+    (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
+    res = ex.execute(est, "bench")
+    phases = {}
+    for row in res.get("series", [{}])[0].get("values", []):
+        line = row[0].strip()
+        name, _, rest = line.partition(":")
+        if "ms" in rest:
+            phases[name] = float(rest.split("ms")[0].strip())
+    out["phases_ms"] = phases
     eng.close()
     return out
 
@@ -212,7 +223,8 @@ def main():
                                 / tpu["1m"]["best_s"], 3),
         "bit_identical": True,
         "kernel_rows_per_sec": round(kernel_rps, 1),
-        "http_query_ms": round(http_ms, 1)}))
+        "http_query_ms": round(http_ms, 1),
+        "phases_ms": tpu.get("phases_ms", {})}))
 
 
 if __name__ == "__main__":

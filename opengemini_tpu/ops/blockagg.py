@@ -73,6 +73,8 @@ class BlockStack:
     times: object = None             # jax (B, SEG) i64
     limbs: object = None             # jax (B, SEG, K) i32
     bad: object = None               # jax (B, SEG) bool (limb residual)
+    block0_dev: object = None        # jax f64 scalar (= block0)
+    k0: int = 0                      # first resident limb plane
 
     @property
     def n_blocks(self) -> int:
@@ -117,8 +119,12 @@ def _file_layout(reader, field: str):
 
 
 def _build_slab(reader, field: str, metas, seg: int, E: int,
-                block0: int) -> BlockStack:
-    import jax
+                block0: int):
+    """Host-side slab assembly: decode + limb decompose. Upload happens
+    in get_stacks once the file-wide active limb-plane range is known
+    (most real columns use ≤4 of the 6 planes — a 52-bit mantissa spans
+    at most 4; skipping dead planes cuts H2D, kernel passes, and the
+    result pull alike)."""
     B = len(metas)
     vals = np.zeros((B, seg), dtype=np.float64)
     valid = np.zeros((B, seg), dtype=np.bool_)
@@ -139,12 +145,21 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
     limbs, bad = exactsum.host_limbs(vals, valid, E)
     st = BlockStack(reader.path, field, seg, E, sids, refs, n_rows,
                     block0)
+    # non-limb arrays upload immediately (host copies freed per slab);
+    # only the i32 limb planes wait for the file-wide k-range
+    import jax
     st.values = jax.device_put(vals)
     st.valid = jax.device_put(valid)
     st.times = jax.device_put(times)
-    st.limbs = jax.device_put(limbs.astype(np.int32))
     st.bad = jax.device_put(bad)
-    return st
+    st.block0_dev = jax.device_put(np.float64(block0))
+    return st, limbs
+
+
+def _upload_limbs(st: BlockStack, limbs, k0: int, k1: int) -> None:
+    import jax
+    st.k0 = k0
+    st.limbs = jax.device_put(np.ascontiguousarray(limbs[..., k0:k1]))
 
 
 class _TimeColMeta:
@@ -176,13 +191,30 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
         cache.put(key, _NO_STACK)
         return None
     metas, seg, E = layout
-    slabs = []
+    built = []
     block0 = 0
+    K = exactsum.K_LIMBS
+    k0, k1 = K, 0
     for i in range(0, len(metas), SLAB_BLOCKS):
-        sl = _build_slab(reader, field, metas[i:i + SLAB_BLOCKS], seg,
-                         E, block0)
-        slabs.append(sl)
-        block0 += sl.n_blocks
+        st, limbs = _build_slab(reader, field,
+                                metas[i:i + SLAB_BLOCKS], seg, E,
+                                block0)
+        # file-wide active limb-plane range (plane k is dead iff every
+        # row's k-th limb is 0 — dead planes sum to 0, so skipping
+        # them is exact)
+        for k in range(K):
+            if limbs[..., k].any():
+                k0 = min(k0, k)
+                k1 = max(k1, k + 1)
+        built.append((st, limbs))
+        block0 += st.n_blocks
+    if k0 >= k1:
+        k0, k1 = 0, 1        # all-zero column: keep one plane
+    slabs = []
+    for st, limbs in built:
+        _upload_limbs(st, limbs, k0, k1)
+        slabs.append(st)
+    built = None
     cache.put(key, slabs)
     with cache._lock:   # account real HBM footprint
         if key in cache._map:
@@ -201,123 +233,331 @@ _NO_STACK = _NoStack()
 
 _JITTED: dict = {}
 
+# windows per query above which the unrolled masked-pass kernel would
+# bloat the graph; those shapes fall back to the scatter kernel
+MASK_W_MAX = int(os.environ.get("OG_BLOCK_MASK_W", "64"))
 
-def _kernel(num_segments: int, want: tuple):
-    fn = _JITTED.get(("k", num_segments, want))
+# f64-exact sentinel for "no row" index planes (I64MAX is not exactly
+# representable in f64; 2^62 is, and no real flat index reaches it)
+IDX_SENTINEL = float(2 ** 62)
+
+
+def plane_layout(want: tuple, K: int) -> list[tuple[str, int]]:
+    """Static layout of the ONE packed (P, num_segments) f64 output:
+    every per-cell state is a plane so a query pulls a single array
+    over the slow D2H link (per-transfer latency ≈ 0.1-0.25s measured
+    on the tunnel-attached chip — leaf count, not bytes, dominates)."""
+    planes = [("count", 1)]
+    if "sum" in want:
+        planes += [("limbs", K), ("bad", 1)]
+    if "sumsq" in want:
+        planes.append(("sumsq", 1))
+    if "min" in want:
+        planes += [("min", 1), ("min_idx", 1)]
+    if "max" in want:
+        planes += [("max", 1), ("max_idx", 1)]
+    return planes
+
+
+def unpack_planes(packed: np.ndarray, want: tuple, K: int,
+                  k0: int = 0, K_full: int | None = None) -> dict:
+    """Host-side view of the pulled packed array as the bo dict the
+    executor folds (exact dtype restoration: counts/limbs are integer-
+    valued f64 < 2^53). K is the resident (active) plane count; the
+    limbs re-expand to K_full with zero dead planes."""
+    if K_full is None:
+        K_full = exactsum.K_LIMBS
+    out = {}
+    i = 0
+    for name, n in plane_layout(want, K):
+        pl = packed[i:i + n]
+        i += n
+        if name == "count":
+            out["count"] = pl[0].astype(np.int64)
+        elif name == "limbs":
+            full = np.zeros((pl.shape[1], K_full))
+            full[:, k0:k0 + K] = pl.T
+            out["limbs"] = full                        # (S, K_full) f64
+        elif name == "bad":
+            out["bad"] = pl[0] > 0
+        elif name in ("min_idx", "max_idx"):
+            # convert in int space: mixing I64MAX into a FLOAT where()
+            # would round it to 2^63 and overflow the int64 cast to
+            # I64MIN (negative → Python list indexing disaster)
+            p = pl[0]
+            real = np.isfinite(p) & (p < IDX_SENTINEL) & (p >= 0)
+            iv = np.where(real, p, 0.0).astype(np.int64)
+            out[name] = np.where(real, iv, I64MAX)
+        else:
+            out[name] = pl[0]
+    return out
+
+
+def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
+    """Per-slab reduction → ONE packed (P, num_segments) f64 array.
+
+    TPU-first formulation (the round-2 kernel used flat
+    jax.ops.segment_sum scatters — measured 8.2s over 12.7M rows on the
+    v5e because large unsorted scatters don't tile; the masked-pass
+    form below does the same reduction in 0.125s):
+      stage 1: for each window w (static unroll, W ≤ MASK_W_MAX), a
+        masked dense reduction over the segment axis → (B, W) partials.
+        Pure axis reductions — the same VPU mapping as
+        dense_window_aggregate, no scatter over the big axis.
+      stage 2: one tiny scatter of B*W partials onto the (G*W) grid.
+    Counts/limbs accumulate in f64: integer-valued, exact below 2^49
+    even on the f32-pair-emulated f64 path (stage-1 sums ≤ SEG*2^18,
+    stage-2 ≤ total rows * 2^18 — both far under), so bit-identity
+    with the host integer limb arithmetic is preserved.
+    """
+    key = ("k", num_segments, want, W, K, SEG)
+    fn = _JITTED.get(key)
     if fn is not None:
         return fn
     import jax
     import jax.numpy as jnp
 
+    ns = num_segments + 1
+    use_mask = W <= MASK_W_MAX
+
     @jax.jit
-    def _f(values, valid, times, limbs, bad, gids, block0, t_lo, t_hi,
-           start, interval, W):
-        B, SEG = values.shape
-        n = B * SEG
+    def _f(values, valid, times, limbs, bad, gids, block0, scalars):
+        t_lo, t_hi, start, interval = (scalars[0], scalars[1],
+                                       scalars[2], scalars[3])
+        B = values.shape[0]
+        m0 = (valid & (times >= t_lo) & (times <= t_hi)
+              & (gids >= 0)[:, None])
+        wid = (times - start) // interval
+        m0 = m0 & (wid >= 0) & (wid < W)
+        lbf = limbs.astype(jnp.float64) if "sum" in want else None
+        planes = []
+
+        if use_mask:
+            wid32 = wid.astype(jnp.int32)
+            gidx = (block0 * SEG
+                    + jnp.arange(B * SEG, dtype=jnp.float64).reshape(
+                        values.shape))
+            st1 = {k: [] for k in ("count", "limbs", "bad", "sumsq",
+                                   "min", "min_idx", "max", "max_idx")}
+            for w in range(W):
+                mw = m0 & (wid32 == w)
+                st1["count"].append(mw.sum(axis=1, dtype=jnp.float32)
+                                    .astype(jnp.float64))
+                if "sum" in want:
+                    st1["limbs"].append(jnp.where(
+                        mw[:, :, None], lbf, 0.0).sum(axis=1))
+                    st1["bad"].append((mw & bad).any(axis=1)
+                                      .astype(jnp.float64))
+                if "sumsq" in want:
+                    vz = jnp.where(mw, values, 0.0)
+                    st1["sumsq"].append((vz * vz).sum(axis=1))
+                if "min" in want:
+                    vm = jnp.where(mw, values, jnp.inf)
+                    mn = vm.min(axis=1)
+                    st1["min"].append(mn)
+                    ix = jnp.where(vm == mn[:, None], gidx,
+                                   IDX_SENTINEL).min(axis=1)
+                    st1["min_idx"].append(
+                        jnp.where(jnp.isfinite(mn), ix, IDX_SENTINEL))
+                if "max" in want:
+                    vm = jnp.where(mw, values, -jnp.inf)
+                    mx = vm.max(axis=1)
+                    st1["max"].append(mx)
+                    ix = jnp.where(vm == mx[:, None], gidx,
+                                   IDX_SENTINEL).min(axis=1)
+                    st1["max_idx"].append(
+                        jnp.where(jnp.isfinite(mx), ix, IDX_SENTINEL))
+            # stage 2: scatter (B*W) partials onto the cell grid
+            seg2 = (gids.astype(jnp.int32)[:, None] * W
+                    + jnp.arange(W, dtype=jnp.int32)[None, :])
+            seg2 = jnp.where(gids[:, None] >= 0, seg2,
+                             num_segments).reshape(-1)
+
+            def sc_sum(x):
+                return jax.ops.segment_sum(x, seg2, ns)[:num_segments]
+
+            def sc_min(x):
+                return jax.ops.segment_min(x, seg2, ns)[:num_segments]
+
+            def sc_max(x):
+                return jax.ops.segment_max(x, seg2, ns)[:num_segments]
+
+            def flat(name):
+                return jnp.stack(st1[name], axis=1).reshape(-1)
+
+            planes.append(sc_sum(flat("count")))
+            if "sum" in want:
+                lw = jnp.stack(st1["limbs"], axis=1).reshape(-1, K)
+                for k in range(K):
+                    planes.append(sc_sum(lw[:, k]))
+                planes.append(sc_max(flat("bad")))
+            if "sumsq" in want:
+                planes.append(sc_sum(flat("sumsq")))
+            if "min" in want:
+                mn = sc_min(flat("min"))
+                win = flat("min") == mn[seg2.reshape(gids.shape[0], W)
+                                        ].reshape(-1)
+                ix = sc_min(jnp.where(win, flat("min_idx"),
+                                      IDX_SENTINEL))
+                planes += [mn, ix]
+            if "max" in want:
+                mx = sc_max(flat("max"))
+                win = flat("max") == mx[seg2.reshape(gids.shape[0], W)
+                                        ].reshape(-1)
+                ix = sc_min(jnp.where(win, flat("max_idx"),
+                                      IDX_SENTINEL))
+                planes += [mx, ix]
+            return jnp.stack(planes)
+
+        # scatter fallback for wide windows (rare under the cell cap):
+        # i32 segment ids + f64 accumulators — the round-2 int64
+        # scatters hit the 64-bit emulation path and were ~60× slower
+        n = values.shape[0] * SEG
         v = values.reshape(n)
-        m = valid.reshape(n)
-        t = times.reshape(n)
-        lb = limbs.reshape(n, -1)
+        m = m0.reshape(n)
+        lb = limbs.reshape(n, K) if "sum" in want else None
         bd = bad.reshape(n)
-        g = jnp.repeat(gids, SEG)
-        m = m & (g >= 0) & (t >= t_lo) & (t <= t_hi)
-        w = (t - start) // interval
-        inwin = (w >= 0) & (w < W)
-        seg = jnp.where(m & inwin, g * W + w, num_segments)
-        seg = seg.astype(jnp.int64)
-        ns = num_segments + 1
-        out = {}
-        out["count"] = jax.ops.segment_sum(
-            m.astype(jnp.int64), seg, ns)[:num_segments]
+        g32 = jnp.repeat(gids.astype(jnp.int32), SEG)
+        seg = jnp.where(m, g32 * W + wid.reshape(n).astype(jnp.int32),
+                        num_segments)
+        planes.append(jax.ops.segment_sum(
+            m.astype(jnp.float64), seg, ns)[:num_segments])
         if "sum" in want:
-            # per-limb scatters: no (n, K) int64 temporary (that blew
-            # XLA's temp budget at large slabs). The f64 sum is NOT
-            # computed on device — the caller derives the fallback from
-            # the limb totals (exact when the flag holds, truncated-
-            # but-deterministic otherwise)
-            out["limbs"] = jnp.stack(
-                [jax.ops.segment_sum(
-                    jnp.where(m, lb[:, k], 0).astype(jnp.int64), seg,
-                    ns)[:num_segments]
-                 for k in range(lb.shape[1])], axis=-1)
-            out["bad"] = jax.ops.segment_max(
-                (m & bd).astype(jnp.int32), seg, ns)[:num_segments] > 0
+            for k in range(K):
+                planes.append(jax.ops.segment_sum(
+                    jnp.where(m, lb[:, k], 0).astype(jnp.float64),
+                    seg, ns)[:num_segments])
+            planes.append(jax.ops.segment_max(
+                (m & bd).astype(jnp.float32), seg, ns)[:num_segments]
+                .astype(jnp.float64))
         if "sumsq" in want:
             vz = jnp.where(m, v, 0.0)
-            out["sumsq"] = jax.ops.segment_sum(vz * vz, seg,
-                                               ns)[:num_segments]
-        # global flat row ids (slab offset folded in); sentinel I64MAX
-        gidx = jnp.arange(n, dtype=jnp.int64) + block0 * SEG
+            planes.append(jax.ops.segment_sum(vz * vz, seg,
+                                              ns)[:num_segments])
+        gidx = jnp.arange(n, dtype=jnp.float64) + block0 * SEG
         if "min" in want:
             ext = jax.ops.segment_min(jnp.where(m, v, jnp.inf), seg, ns)
-            out["min"] = ext[:num_segments]
             at = m & (v == ext[seg])
-            out["min_idx"] = jax.ops.segment_min(
-                jnp.where(at, gidx, I64MAX), seg, ns)[:num_segments]
+            planes += [ext[:num_segments],
+                       jax.ops.segment_min(
+                           jnp.where(at, gidx, IDX_SENTINEL), seg,
+                           ns)[:num_segments]]
         if "max" in want:
             ext = jax.ops.segment_max(jnp.where(m, v, -jnp.inf), seg, ns)
-            out["max"] = ext[:num_segments]
             at = m & (v == ext[seg])
-            out["max_idx"] = jax.ops.segment_min(
-                jnp.where(at, gidx, I64MAX), seg, ns)[:num_segments]
-        return out
-    _JITTED[("k", num_segments, want)] = _f
+            planes += [ext[:num_segments],
+                       jax.ops.segment_min(
+                           jnp.where(at, gidx, IDX_SENTINEL), seg,
+                           ns)[:num_segments]]
+        return jnp.stack(planes)
+
+    _JITTED[key] = _f
     return _f
 
 
-def _combiner(want: tuple, n_slabs: int):
-    fn = _JITTED.get(("c", want, n_slabs))
+def _pairwise_combine(want: tuple, K: int):
+    """Device combine of two packed plane arrays (same cell grid):
+    adds for count/limbs/sumsq, any for bad, min/max keep the winning
+    value's index (ties → the earlier operand, i.e. lower flat index
+    space first — matching the scatter kernel's segment_min tie rule)."""
+    key = ("pc", want, K)
+    fn = _JITTED.get(key)
     if fn is not None:
         return fn
     import jax
     import jax.numpy as jnp
 
+    layout = plane_layout(want, K)
+
     @jax.jit
-    def _c(outs):
-        comb = {"count": sum(o["count"] for o in outs)}
-        if "sum" in want:
-            # the kernel emits only the exact limb planes for sums (the
-            # f64 sum is finalized from limb totals by the caller)
-            comb["limbs"] = sum(o["limbs"] for o in outs)
-            comb["bad"] = jnp.stack([o["bad"] for o in outs]).any(0)
-        if "sumsq" in want:
-            comb["sumsq"] = sum(o["sumsq"] for o in outs)
-        if "min" in want:
-            ms = jnp.stack([o["min"] for o in outs])
-            k = jnp.argmin(ms, axis=0)
-            comb["min"] = jnp.take_along_axis(ms, k[None], 0)[0]
-            comb["min_idx"] = jnp.take_along_axis(
-                jnp.stack([o["min_idx"] for o in outs]), k[None], 0)[0]
-        if "max" in want:
-            ms = jnp.stack([o["max"] for o in outs])
-            k = jnp.argmax(ms, axis=0)
-            comb["max"] = jnp.take_along_axis(ms, k[None], 0)[0]
-            comb["max_idx"] = jnp.take_along_axis(
-                jnp.stack([o["max_idx"] for o in outs]), k[None], 0)[0]
-        return comb
-    _JITTED[("c", want, n_slabs)] = _c
+    def _c(a, b):
+        out = []
+        i = 0
+        for name, n in layout:
+            if name in ("min_idx", "max_idx"):
+                continue        # consumed with its value plane below
+            pa, pb = a[i:i + n], b[i:i + n]
+            i += n
+            if name in ("count", "limbs", "sumsq"):
+                out.append(pa + pb)
+            elif name == "bad":
+                out.append(jnp.maximum(pa, pb))
+            elif name in ("min", "max"):
+                better = (pb < pa) if name == "min" else (pb > pa)
+                out.append(jnp.where(better, pb, pa))
+                ia, ib = a[i:i + 1], b[i:i + 1]
+                i += 1
+                out.append(jnp.where(better, ib, ia))
+        return jnp.concatenate(out)
+
+    _JITTED[key] = _c
     return _c
+
+
+_SCALARS_CACHE: dict = {}
+
+
+def query_scalars(t_lo, t_hi, start: int, interval: int):
+    """ONE per-query H2D upload of the window parameters (each
+    device_put pays the full tunnel latency — ship them together).
+    Repeated warm queries (dashboards) hit the value-keyed cache and
+    upload nothing."""
+    import jax
+    key = (t_lo, t_hi, start, interval)
+    got = _SCALARS_CACHE.get(key)
+    if got is not None:
+        return got
+    if len(_SCALARS_CACHE) > 256:
+        _SCALARS_CACHE.clear()
+    dev = jax.device_put(np.array(
+        [t_lo if t_lo is not None else I64MIN,
+         t_hi if t_hi is not None else I64MAX,
+         start, interval], dtype=np.int64))
+    _SCALARS_CACHE[key] = dev
+    return dev
+
+
+def cached_gids(gid_arr: np.ndarray):
+    """Device copy of a query's block→group-id vector, keyed by content
+    in the device block cache: a warm repeat (same grouping/filters over
+    the same files) re-uses the resident vector — zero H2D."""
+    import jax
+    if not devicecache.enabled():
+        return jax.device_put(gid_arr)
+    import hashlib
+    h = hashlib.blake2b(gid_arr.tobytes(), digest_size=16).hexdigest()
+    cache = devicecache.global_cache()
+    key = ("gids", h, len(gid_arr))
+    got = cache.get(key)
+    if got is not None:
+        return got
+    dev = jax.device_put(gid_arr)
+    cache.put(key, dev)
+    return dev
 
 
 def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
                    t_lo, t_hi, start: int, interval: int, W: int,
-                   num_segments: int, want: tuple):
-    """Launch the kernel per slab and combine on device — one small
-    result dict crosses D2H (the caller batches the pull)."""
-    import jax.numpy as jnp
-    fn = _kernel(num_segments, want)
-    lo = jnp.int64(t_lo if t_lo is not None else I64MIN)
-    hi = jnp.int64(t_hi if t_hi is not None else I64MAX)
-    outs = []
+                   num_segments: int, want: tuple, scalars=None,
+                   gids_dev=None):
+    """Launch the kernel per slab and combine on device — ONE packed
+    plane array per file stays on device (the caller batches the pull
+    and unpacks with unpack_planes)."""
+    import jax
+    K = slabs[0].limbs.shape[-1]
+    if scalars is None:
+        scalars = query_scalars(t_lo, t_hi, start, interval)
+    if gids_dev is None:
+        gids_dev = jax.device_put(np.asarray(gids, dtype=np.int64))
+    out = None
+    comb = _pairwise_combine(want, K)
     for st in slabs:
-        g = gids[st.block0:st.block0 + st.n_blocks]
-        outs.append(fn(st.values, st.valid, st.times, st.limbs, st.bad,
-                       jnp.asarray(g, dtype=jnp.int64),
-                       jnp.int64(st.block0), lo, hi, jnp.int64(start),
-                       jnp.int64(interval), jnp.int64(W)))
-    if len(outs) == 1:
-        return outs[0]
-    return _combiner(want, len(outs))(outs)
+        fn = _kernel(num_segments, want, W, K, st.seg_rows)
+        g = gids_dev[st.block0:st.block0 + st.n_blocks]
+        o = fn(st.values, st.valid, st.times, st.limbs, st.bad, g,
+               st.block0_dev, scalars)
+        out = o if out is None else comb(out, o)
+    return out
 
 
 def gather_exact_values(slabs: list[BlockStack], reader,
@@ -329,7 +569,7 @@ def gather_exact_values(slabs: list[BlockStack], reader,
     total_blocks = slabs[-1].block0 + slabs[-1].n_blocks
     n = total_blocks * seg_rows
     idx = np.asarray(flat_idx, dtype=np.int64)
-    has = idx < n
+    has = (idx >= 0) & (idx < n)
     out = np.zeros(len(idx), dtype=np.float64)
     if not has.any():
         return out, has

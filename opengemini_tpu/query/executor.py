@@ -60,6 +60,11 @@ __all__ = ["QueryExecutor", "classify_select", "merge_partials",
 
 MAX_WINDOWS = 100_000
 
+# cross-file device-merged block-path entry: limb scale + resident
+# plane window (the slab lists are gone after the on-device combine)
+from collections import namedtuple as _nt
+_BlockMeta = _nt("_BlockMeta", "E k0 ka")
+
 # sparse row counts at or below this reduce on host (numpy) instead of
 # paying device dispatch + result round-trips; the dense/pre-agg paths
 # carry the bulk of large scans either way
@@ -103,6 +108,22 @@ class QueryExecutor:
         self.users = users      # meta.users.UserStore (auth statements)
         self.catalog = catalog  # meta.catalog.Catalog (CQs, policies)
         self.inc_cache = IncAggCache()
+        # warm-query scan-plan cache: tagset grouping + chunk-meta walk
+        # are pure functions of (measurement, filters, range, shard
+        # contents) — dashboards repeat them identically every refresh.
+        # Keyed by shard content versions (file reader identity + the
+        # memtable mutation counter), so any write/flush invalidates.
+        from collections import OrderedDict
+        self._plan_cache: OrderedDict = OrderedDict()
+        self._plan_lock = __import__("threading").Lock()
+
+    def _drop_plan_cache(self) -> None:
+        """Release cached scan plans: entries pin memtable snapshots
+        and (possibly unlinked) TSSP readers, so DDL/DELETE clears them
+        eagerly rather than waiting for LRU aging (the serial+mutation
+        cache key already guarantees correctness either way)."""
+        with self._plan_lock:
+            self._plan_cache.clear()
 
     # ------------------------------------------------------------------ api
 
@@ -141,6 +162,7 @@ class QueryExecutor:
                 return {}
             if isinstance(stmt, DropDatabaseStatement):
                 self.engine.drop_database(stmt.name)
+                self._drop_plan_cache()
                 return {}
             if isinstance(stmt, CreateMeasurementStatement):
                 cdb = stmt.on_db or db
@@ -157,9 +179,12 @@ class QueryExecutor:
                 if ddb not in self.engine.databases:
                     return {"error": f"database not found: {ddb}"}
                 self.engine.drop_measurement(ddb, stmt.name)
+                self._drop_plan_cache()
                 return {}
             if isinstance(stmt, DeleteStatement):
-                return self._delete(stmt, db)
+                res = self._delete(stmt, db)
+                self._drop_plan_cache()
+                return res
             if isinstance(stmt, (CreateUserStatement, DropUserStatement,
                                  SetPasswordStatement)):
                 return self._user_stmt(stmt)
@@ -777,20 +802,44 @@ class QueryExecutor:
             # row-store path: tagsets from the series index, then a
             # batched chunk-meta plan (scan.py — the initGroupCursors /
             # agg_tagset_cursor analog; no per-series Python loop)
-            per_shard: list[tuple[object, list[tuple[int, int]]]] = []
-            for s in shards:
-                ts = s.index.group_by_tagsets(mst, group_tags,
-                                              cond.tag_filters)
-                pairs = []
-                for key, sids in ts:
-                    gi = global_groups.setdefault(key, len(global_groups))
-                    pairs.extend((int(sid), gi) for sid in sids)
-                per_shard.append((s, pairs))
-            if self.resources is not None:
-                self.resources.check_series(
-                    sum(len(p) for _s, p in per_shard))
-            scan_plan = plan_rowstore_scan(per_shard, mst, t_lo, t_hi,
-                                           ctx=ctx)
+            plan_key = (
+                db, mst, tuple(group_tags), tuple(cond.tag_filters),
+                t_lo, t_hi,
+                tuple((s.serial,
+                       tuple(r.serial for r in s._files.get(mst, ())),
+                       s.mem.mutations) for s in shards))
+            with self._plan_lock:
+                hit = self._plan_cache.get(plan_key)
+                if hit is not None:
+                    self._plan_cache.move_to_end(plan_key)
+            if hit is not None:
+                groups_snap, scan_plan, n_series = hit
+                global_groups.update(groups_snap)
+                if self.resources is not None:
+                    self.resources.check_series(n_series)
+            else:
+                per_shard: list[tuple[object, list[tuple[int, int]]]] = []
+                for s in shards:
+                    ts = s.index.group_by_tagsets(mst, group_tags,
+                                                  cond.tag_filters)
+                    pairs = []
+                    for key, sids in ts:
+                        gi = global_groups.setdefault(
+                            key, len(global_groups))
+                        pairs.extend((int(sid), gi) for sid in sids)
+                    per_shard.append((s, pairs))
+                n_series = sum(len(p) for _s, p in per_shard)
+                if self.resources is not None:
+                    self.resources.check_series(n_series)
+                scan_plan = plan_rowstore_scan(per_shard, mst, t_lo,
+                                               t_hi, ctx=ctx)
+                with self._plan_lock:
+                    self._plan_cache[plan_key] = (dict(global_groups),
+                                                  scan_plan, n_series)
+                    # small cap: entries pin memtable snapshots and
+                    # (possibly unlinked) readers until they age out
+                    while len(self._plan_cache) > 16:
+                        self._plan_cache.popitem(last=False)
             if scan_plan.has_rows:
                 data_tmin = min(data_tmin, scan_plan.data_tmin)
                 data_tmax = max(data_tmax, scan_plan.data_tmax)
@@ -848,6 +897,8 @@ class QueryExecutor:
         # (limb planes), and the result grid is small enough to pull
         # against the slow D2H link
         block_launches: list = []      # (fname, reader, stack, devout)
+        block_rows_total = 0
+        block_skip: set[int] = set()   # id(_ChunkSrc) served on device
         if scan_plan is not None:
             from ..ops import devicecache as _dc
             preagg_possible = (cond.residual is None and not raw_fields
@@ -878,6 +929,7 @@ class QueryExecutor:
                 want = tuple(k for k in ("sum", "sumsq", "min", "max")
                              if getattr(spec, k))
                 cap = _dc.capacity_bytes()
+                jobs: list = []        # (reader, stacks, gid_arr, srcs)
                 for _rid, (reader, sid2gid, srcs, nrows) in \
                         per_file.items():
                     if nrows < BLOCK_MIN_RATIO * (G * W + 1):
@@ -896,21 +948,72 @@ class QueryExecutor:
                         stacks[fname] = sl
                     if not stacks:
                         continue
-                    any_slabs = next(iter(stacks.values()))
-                    gid_arr = np.concatenate(
-                        [np.array([sid2gid.get(int(s), -1)
-                                   for s in sl.block_sids],
-                                  dtype=np.int64)
-                         for sl in any_slabs])
-                    for fname, sl in stacks.items():
-                        out = blockagg.file_aggregate(
-                            sl, gid_arr, t_lo, t_hi, int(start),
-                            int(interval_eff), W, G * W, want)
-                        block_launches.append((fname, reader, sl, out))
-                    # consume the sources: flat/dense/preagg must not
-                    # double-count these chunks
-                    for sp, src in srcs:
-                        sp.sources.remove(src)
+                    # gid vectors are PER FIELD: fields may stack with
+                    # different block layouts (a field absent from some
+                    # series skips those blocks entirely)
+                    gids_by_field = {
+                        fname: np.concatenate(
+                            [np.array([sid2gid.get(int(s), -1)
+                                       for s in sl.block_sids],
+                                      dtype=np.int64)
+                             for sl in sls])
+                        for fname, sls in stacks.items()}
+                    jobs.append((reader, stacks, gids_by_field, srcs))
+                if jobs:
+                    import jax as _jax
+                    blk_sp = span.child("block_dispatch") \
+                        if span is not None else None
+                    if blk_sp is not None:
+                        blk_sp.start_ns = _now_ns()
+                    # ONE H2D for the query scalars; gid vectors are
+                    # content-keyed in the device cache, so identical
+                    # layouts across fields/files (and warm repeats)
+                    # upload once (each transfer pays the full tunnel
+                    # latency; bytes are almost free next to it)
+                    scalars = blockagg.query_scalars(
+                        t_lo, t_hi, int(start), int(interval_eff))
+                    # per (field, E): device-combined packed planes —
+                    # min/max need per-file row indices for the exact
+                    # host gather, so only value-free states combine
+                    can_merge = not ({"min", "max"} & set(want))
+                    merged_by: dict = {}
+                    for reader, stacks, gids_by_field, srcs in jobs:
+                        for fname, sl in stacks.items():
+                            gid_arr = gids_by_field[fname]
+                            out = blockagg.file_aggregate(
+                                sl, gid_arr, t_lo, t_hi, int(start),
+                                int(interval_eff), W, G * W, want,
+                                scalars=scalars,
+                                gids_dev=blockagg.cached_gids(gid_arr))
+                            if can_merge:
+                                key = (fname, sl[0].E, sl[0].k0,
+                                       sl[0].limbs.shape[-1])
+                                prev = merged_by.get(key)
+                                if prev is None:
+                                    merged_by[key] = out
+                                else:
+                                    comb = blockagg._pairwise_combine(
+                                        want, sl[0].limbs.shape[-1])
+                                    merged_by[key] = comb(prev, out)
+                            else:
+                                block_launches.append(
+                                    (fname, reader, sl, out))
+                        # consume the sources: flat/dense/preagg must
+                        # not double-count these chunks (the plan object
+                        # is cached across queries — never mutate it)
+                        for _sp, src in srcs:
+                            block_skip.add(id(src))
+                    for (fname, _E, _k0, _ka), out in merged_by.items():
+                        block_launches.append(
+                            (fname, None, _BlockMeta(_E, _k0, _ka), out))
+                    block_rows_total = sum(
+                        sl.n_rows for _r, stacks, _g, _s in jobs
+                        for sls in stacks.values() for sl in sls)
+                    if blk_sp is not None:
+                        blk_sp.end_ns = _now_ns()
+                        blk_sp.add(files=len(jobs),
+                                   launches=len(block_launches),
+                                   rows=block_rows_total)
 
         scanres = None
         if scan_plan is not None:
@@ -964,7 +1067,8 @@ class QueryExecutor:
                 scan_plan, mst, needed_fields, t_lo, t_hi,
                 int(start), int(interval_eff), W, G * W, allow_preagg,
                 allow_dense=allow_dense, need_limbs=need_limbs,
-                dense_cached=_dense_cached, ctx=ctx, pool=decode_pool())
+                dense_cached=_dense_cached, ctx=ctx, pool=decode_pool(),
+                skip_sources=block_skip)
             if cond.residual is not None and scanres.n_rows:
                 mask = eval_residual(cond.residual, scanres.to_record())
                 if not mask.all():
@@ -1004,9 +1108,11 @@ class QueryExecutor:
             scan_sp.add(shards=len(shards), groups=G, rows=n_rows)
             if block_launches:
                 scan_sp.add(block_kernels=len(block_launches),
-                            block_rows=sum(sl.n_rows for _f, _r, s, _o
-                                           in block_launches
-                                           for sl in s))
+                            block_rows=sum(
+                                sl.n_rows for _f, _r, s, _o
+                                in block_launches
+                                if not isinstance(s, _BlockMeta)
+                                for sl in s) or block_rows_total)
             if scanres is not None:
                 sst = scanres.stats
                 scan_sp.add(preagg_segments=sst.preagg_segments,
@@ -1253,13 +1359,37 @@ class QueryExecutor:
             # ONE batched D2H for every kernel output — per-array pulls
             # each pay a full tunnel round-trip on remote-attached TPUs
             import jax
+            pull_sp = span.child("device_pull") if span is not None \
+                else None
+            if pull_sp is not None:
+                pull_sp.start_ns = _now_ns()
             block_outs = [bo for _f, _r, _s, bo in block_launches]
             (field_results, dense_out, exact_results, dense_exact,
              sel_results, block_outs) = jax.device_get(
                 (field_results, dense_out, exact_results, dense_exact,
                  sel_results, block_outs))
-            block_launches = [(f, r, s, bo) for (f, r, s, _), bo in
-                              zip(block_launches, block_outs)]
+            if pull_sp is not None:
+                pull_sp.end_ns = _now_ns()
+                pull_sp.add(leaves=len(jax.tree_util.tree_leaves(
+                    (field_results, dense_out, exact_results,
+                     dense_exact, sel_results, block_outs))))
+            # packed plane arrays → host bo dicts (exact: counts/limbs
+            # are integer-valued f64 far below 2^53)
+            from ..ops import blockagg as _bagg
+            from ..ops.exactsum import K_LIMBS as _KL
+            _bw = tuple(k for k in ("sum", "sumsq", "min", "max")
+                        if getattr(spec, k))
+
+            def _ka_k0(sl):
+                if isinstance(sl, _BlockMeta):
+                    return sl.ka, sl.k0
+                return sl[0].limbs.shape[-1], sl[0].k0
+
+            block_launches = [
+                (f, r, s, _bagg.unpack_planes(
+                    np.asarray(bo), _bw, _ka_k0(s)[0], _ka_k0(s)[1],
+                    _KL))
+                for (f, r, s, _), bo in zip(block_launches, block_outs)]
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
             res = field_results[fname]
@@ -1300,6 +1430,9 @@ class QueryExecutor:
         group_keys = [None] * G
         for key, gi in global_groups.items():
             group_keys[gi] = key
+        fold_sp = span.child("grid_fold") if span is not None else None
+        if fold_sp is not None:
+            fold_sp.start_ns = _now_ns()
         fields_out: dict[str, dict] = {}
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
@@ -1393,6 +1526,10 @@ class QueryExecutor:
             my_blocks = [(r, s, bo) for f, r, s, bo in block_launches
                          if f == fname]
             for reader_b, st_blk, bo in my_blocks:
+                # merged cross-file entries carry the limb scale E in
+                # place of the slab list (no per-file rows remain)
+                _E_blk = st_blk.E if isinstance(st_blk, _BlockMeta) \
+                    else st_blk[0].E
                 if "count" in st:
                     st["count"] = st["count"] + \
                         np.asarray(bo["count"]).reshape(G, W)
@@ -1405,7 +1542,7 @@ class QueryExecutor:
                     from ..ops.exactsum import finalize_exact as _fe
                     st["sum"] = st["sum"] + _fe(
                         np.asarray(bo["limbs"]).astype(np.float64),
-                        st_blk[0].E).reshape(G, W)
+                        _E_blk).reshape(G, W)
                 if "sumsq" in st and "sumsq" in bo:
                     st["sumsq"] = st["sumsq"] + np.asarray(
                         bo["sumsq"]).reshape(G, W)
@@ -1440,7 +1577,8 @@ class QueryExecutor:
                     np.logical_or.at(ixg, cells, np.asarray(dbad)[:S])
                 e_final = exact_scales.get(fname, 0)
                 items = (pg or {}).get("limb_items", ())
-                blocks_l = [(st_blk[0].E, bo)
+                blocks_l = [(st_blk.E if isinstance(st_blk, _BlockMeta)
+                             else st_blk[0].E, bo)
                             for _r, st_blk, bo in my_blocks
                             if "limbs" in bo]
                 if items or blocks_l:
@@ -1469,6 +1607,9 @@ class QueryExecutor:
                 st["sum_limbs"] = lg[:G * W].reshape(G, W, K_LIMBS)
                 st["sum_inexact"] = ixg[:G * W].reshape(G, W)
             fields_out[fname] = st
+        if fold_sp is not None:
+            fold_sp.end_ns = _now_ns()
+            fold_sp.add(fields=len(fields_out), cells=G * W)
         partial = {
             "group_tags": group_tags,
             "group_keys": [list(k) for k in group_keys],
@@ -2284,8 +2425,34 @@ def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
         val_lists.append(vg.tolist())
     any_rows = anyc.any(axis=1)
     all_ok = [okg.all(axis=1) for okg in ok_grids]
+    # fully-dense fast path (every cell of every group present — the
+    # TSBS dashboard shape): ONE object-array build + ONE C tolist for
+    # the whole result, then per-group list slicing (no per-group numpy)
+    if (not stmt.order_desc and not stmt.offset and not stmt.limit
+            and bool(any_rows.all())
+            and all(bool(a.all()) for a in all_ok)):
+        G = anyc.shape[0]
+        arr = np.empty((G * W, 1 + n_out), dtype=object)
+        arr[:, 0] = times_all * G
+        for oi in range(n_out):
+            flat = []
+            for gi in range(G):
+                flat.extend(val_lists[oi][gi])
+            arr[:, 1 + oi] = flat
+        rows_all = arr.tolist()
+        for gi in order:
+            entry = {"name": mst, "columns": cols_hdr,
+                     "values": rows_all[gi * W:(gi + 1) * W]}
+            if group_tags:
+                entry["tags"] = dict(zip(group_tags, group_keys[gi]))
+            series_out.append(entry)
+        return series_out
     for gi in order:
-        if not any_rows[gi] and not fill_null:
+        # a group with NO data at all never materializes (influx emits
+        # groups from the data, not the index — fill only pads windows
+        # of groups that have at least one point; a tag value whose
+        # rows were all deleted must vanish from results)
+        if not any_rows[gi]:
             continue
         keep = None if fill_null else anyc[gi]
         full = fill_null or bool(keep.all())

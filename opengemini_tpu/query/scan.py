@@ -461,8 +461,8 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                      allow_dense: bool = False,
                      need_limbs: bool = False,
                      dense_cached=None,
-                     ctx=None, pool: ThreadPoolExecutor | None = None
-                     ) -> ScanResult:
+                     ctx=None, pool: ThreadPoolExecutor | None = None,
+                     skip_sources: set | None = None) -> ScanResult:
     """Phase 2: pre-agg classification + batched segment decode.
     ``num_cells`` = G*W; pre-agg grids are (num_cells+1,) so gid*W+w
     indexes them directly. allow_dense routes whole-window spans of
@@ -498,6 +498,8 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
             continue
         stats.direct_series += 1
         for src in sp.sources:
+            if skip_sources and id(src) in skip_sources:
+                continue       # served by the device block path
             if src.rec is not None:
                 stats.memtable_chunks += 1
                 tasks.append((sp.gid, None, src.rec))

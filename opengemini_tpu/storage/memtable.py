@@ -176,6 +176,10 @@ class MemTables:
         self._lock = threading.RLock()
         self.active: dict[str, MemTable] = {}
         self.snapshot: dict[str, MemTable] | None = None
+        # monotonically bumped on any visible change; consumed by the
+        # executor's scan-plan cache key (plans are pure functions of
+        # file set + memtable contents)
+        self.mutations = 0
 
     def write(self, measurement: str, sid: int, fields: dict,
               time: int) -> None:
@@ -184,6 +188,7 @@ class MemTables:
             if mt is None:
                 mt = self.active[measurement] = MemTable(measurement)
             mt.write(sid, fields, time)
+            self.mutations += 1
 
     def write_columns(self, measurement: str, sid: int, times,
                       fields: dict) -> None:
@@ -192,6 +197,7 @@ class MemTables:
             if mt is None:
                 mt = self.active[measurement] = MemTable(measurement)
             mt.write_columns(sid, times, fields)
+            self.mutations += 1
 
     def validate(self, measurement: str, fields: dict) -> None:
         with self._lock:
@@ -210,17 +216,20 @@ class MemTables:
                 raise RuntimeError("snapshot already in progress")
             self.snapshot = self.active
             self.active = {}
+            self.mutations += 1
             return self.snapshot
 
     def commit_snapshot(self) -> None:
         with self._lock:
             self.snapshot = None
+            self.mutations += 1
 
     def abort_snapshot(self) -> None:
         """Put the snapshot back (flush failed); merges with writes that
         arrived meanwhile by replaying the newer data on top."""
         with self._lock:
             snap, self.snapshot = self.snapshot, None
+            self.mutations += 1
             if not snap:
                 return
             newer = self.active

@@ -30,6 +30,9 @@ log = get_logger(__name__)
 DEFAULT_FLUSH_BYTES = 256 * 1024 * 1024
 
 
+_SHARD_SERIALS = __import__("itertools").count(1)
+
+
 class Shard:
     def __init__(self, path: str, shard_id: int,
                  start_time: int, end_time: int,
@@ -61,6 +64,7 @@ class Shard:
         self.wal = WAL(os.path.join(path, "wal"), sync=wal_sync,
                        compression=wal_compression)
         self.mem = MemTables()
+        self.serial = next(_SHARD_SERIALS)   # process-unique (vs id())
         self._files: dict[str, list[TSSPReader]] = {}
         self._cs_files: dict[str, list[ColumnStoreReader]] = {}
         self._file_seq = 0

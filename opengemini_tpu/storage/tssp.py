@@ -28,6 +28,7 @@ Layout:
 from __future__ import annotations
 
 import mmap
+import itertools
 import os
 import struct
 from dataclasses import dataclass, field
@@ -352,12 +353,17 @@ class TSSPReader:
     """mmap-backed reader with lazy chunk-meta decode via the meta index
     (analogs: immutable/reader.go, file_iterator.go, location_cursor.go)."""
 
+    _SERIALS = itertools.count(1)
+
     def __init__(self, path: str, source=None):
         """path: local file (mmap) — or, with ``source`` (a byte-slice
         provider, e.g. obs.DetachedSource), a detached object-store read
         path (reference detached_lazy_load_index_reader.go); ``path`` is
         then only the cache identity."""
         self.path = path
+        # process-unique identity for content-addressed caches (id()
+        # recycles after GC; serials never do)
+        self.serial = next(TSSPReader._SERIALS)
         self.detached = source is not None
         if source is None:
             self._file = open(path, "rb")
