@@ -43,10 +43,16 @@ READER_ROUTING = __import__("os").environ.get(
 
 
 class ClusterExecutor:
-    def __init__(self, meta: MetaClient):
+    def __init__(self, meta: MetaClient, mesh=None):
         self.meta = meta
         self._pool = ClientPool()
         self.inc_cache = IncAggCache()
+        # optional local device mesh: when set, grid-aligned per-store
+        # partials merge ON DEVICE (psum of exact limb/count grids over
+        # the data axis — parallel/meshquery.mesh_merge_partials)
+        # instead of host numpy; ragged shapes fall back to the host
+        # merge inside finalize_partials
+        self.mesh = mesh
 
     def _client(self, addr: str) -> RPCClient:
         return self._pool.get(addr)
@@ -193,6 +199,11 @@ class ClusterExecutor:
             q = format_statement(stmt)
             resps = self._scatter("store.select_partial", db, {"q": q})
             partials = [r["partial"] for r in resps]
+            if self.mesh is not None and len(partials) > 1:
+                from ..parallel.meshquery import mesh_merge_partials
+                merged = mesh_merge_partials(self.mesh, partials)
+                if merged is not None:
+                    partials = [merged]
             return finalize_partials(stmt, mst, cs, partials)
         if cs.is_plain_raw:
             q = format_statement(stmt)

@@ -351,22 +351,28 @@ def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
                 if "sumsq" in want:
                     vz = jnp.where(mw, values, 0.0)
                     st1["sumsq"].append((vz * vz).sum(axis=1))
+                has_rows = mw.any(axis=1)
                 if "min" in want:
                     vm = jnp.where(mw, values, jnp.inf)
                     mn = vm.min(axis=1)
                     st1["min"].append(mn)
-                    ix = jnp.where(vm == mn[:, None], gidx,
+                    # mask on row presence, not finiteness: a stored
+                    # +/-inf value is a REAL extremum whose index must
+                    # survive (only truly empty windows drop to the
+                    # sentinel); masked-out rows can't win the == test
+                    # because mw-false positions hold the identity
+                    ix = jnp.where(mw & (values == mn[:, None]), gidx,
                                    IDX_SENTINEL).min(axis=1)
                     st1["min_idx"].append(
-                        jnp.where(jnp.isfinite(mn), ix, IDX_SENTINEL))
+                        jnp.where(has_rows, ix, IDX_SENTINEL))
                 if "max" in want:
                     vm = jnp.where(mw, values, -jnp.inf)
                     mx = vm.max(axis=1)
                     st1["max"].append(mx)
-                    ix = jnp.where(vm == mx[:, None], gidx,
+                    ix = jnp.where(mw & (values == mx[:, None]), gidx,
                                    IDX_SENTINEL).min(axis=1)
                     st1["max_idx"].append(
-                        jnp.where(jnp.isfinite(mx), ix, IDX_SENTINEL))
+                        jnp.where(has_rows, ix, IDX_SENTINEL))
             # stage 2: scatter (B*W) partials onto the cell grid
             seg2 = (gids.astype(jnp.int32)[:, None] * W
                     + jnp.arange(W, dtype=jnp.int32)[None, :])

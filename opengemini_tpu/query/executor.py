@@ -2301,7 +2301,14 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
         return {"series": series_out} if series_out else {}
 
     series_out = []
+    any_rows_g = anyc.any(axis=1)
     for gi in order:
+        # groups come from the data, not the index: a tag value with
+        # no rows at all in range never materializes (fill only pads
+        # windows of groups that have at least one point) — matches
+        # _materialize_plain_fast
+        if not any_rows_g[gi]:
+            continue
         tags = dict(zip(group_tags, group_keys[gi]))
         cells: dict[int, list] = {}    # time -> row cell list
 

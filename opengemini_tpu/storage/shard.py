@@ -404,6 +404,9 @@ class Shard:
                     self.mem.active.pop(mst, None)
                     if self.mem.snapshot is not None:
                         self.mem.snapshot.pop(mst, None)
+                    # visible change: scan-plan cache keys (even in
+                    # OTHER executors) must stop matching
+                    self.mem.mutations += 1
                 self.index.drop_measurement(mst)
                 if mst in self._schemas:
                     del self._schemas[mst]
