@@ -144,9 +144,13 @@ class HttpServer:
     @staticmethod
     def _is_user_stmt(stmt) -> bool:
         from ..query.ast import (CreateUserStatement, DropUserStatement,
-                                 SetPasswordStatement, ShowStatement)
+                                 GrantStatement, RevokeStatement,
+                                 SetPasswordStatement,
+                                 ShowGrantsStatement, ShowStatement)
         return isinstance(stmt, (CreateUserStatement, DropUserStatement,
-                                 SetPasswordStatement)) or \
+                                 SetPasswordStatement, GrantStatement,
+                                 RevokeStatement,
+                                 ShowGrantsStatement)) or \
             (isinstance(stmt, ShowStatement) and stmt.what == "users")
 
     def _exec_user_stmt(self, stmt) -> dict:
@@ -179,16 +183,88 @@ class HttpServer:
         if isinstance(stmt, SetPasswordStatement) and user is not None \
                 and stmt.name == user.name:
             return None
+        from ..query.ast import (CreateDownsampleStatement,
+                                 CreateSubscriptionStatement,
+                                 DropDownsampleStatement,
+                                 DropSubscriptionStatement,
+                                 GrantStatement, RevokeStatement,
+                                 ShowGrantsStatement)
         admin_only = (CreateUserStatement, DropUserStatement,
                       SetPasswordStatement, CreateDatabaseStatement,
                       CreateMeasurementStatement, CreateCQStatement,
                       DropCQStatement, CreateRPStatement,
                       AlterRPStatement, DropRPStatement,
                       DropDatabaseStatement, DropMeasurementStatement,
-                      DeleteStatement, KillQueryStatement)
+                      DeleteStatement, KillQueryStatement,
+                      GrantStatement, RevokeStatement,
+                      ShowGrantsStatement, CreateSubscriptionStatement,
+                      DropSubscriptionStatement,
+                      CreateDownsampleStatement,
+                      DropDownsampleStatement)
         if isinstance(stmt, admin_only) and (user is None
                                              or not user.admin):
             return "admin privilege required"
+        return None
+
+    @staticmethod
+    def _select_read_dbs(sel, default_db, out: set) -> set:
+        """Every database a SELECT reads from, recursively: top-level
+        FROM, db-qualified extra sources, subqueries, join sides (a
+        db-qualified inner source must not bypass enforcement)."""
+        out.add(sel.from_db or default_db)
+        for src in sel.extra_sources:
+            if isinstance(src, tuple):
+                out.add(src[0] or default_db)
+        if sel.from_subquery is not None:
+            HttpServer._select_read_dbs(sel.from_subquery,
+                                        sel.from_db or default_db, out)
+        if sel.join is not None:
+            HttpServer._select_read_dbs(sel.join.left, default_db, out)
+            HttpServer._select_read_dbs(sel.join.right, default_db, out)
+        return out
+
+    def _deny_db_access(self, stmt, user, db) -> str | None:
+        """Per-database privilege enforcement for data statements
+        (reference GRANT semantics enforced in httpd): SELECT/SHOW need
+        READ on every database the statement touches (subqueries, join
+        sides and multi-source FROM included); SELECT ... INTO also
+        needs WRITE on the target db. Admin statements are separately
+        gated."""
+        from ..query.ast import (ExplainStatement, SelectStatement,
+                                 ShowStatement)
+        if not self.auth_required() or (user is not None and user.admin):
+            return None
+        sel = None
+        if isinstance(stmt, SelectStatement):
+            sel = stmt
+        elif isinstance(stmt, ExplainStatement):
+            sel = stmt.select
+        elif isinstance(stmt, ShowStatement):
+            if stmt.what in ("databases", "queries", "stats"):
+                return None
+            if stmt.what in ("subscriptions", "downsamples") \
+                    and not stmt.on_db:
+                # cross-database enumeration (destination URLs, policy
+                # details) is admin-only, matching the reference
+                return "admin privilege required"
+            tdb = stmt.on_db or db
+            if tdb and not self.user_store.authorized(user, tdb, "READ"):
+                return (f'"{getattr(user, "name", "")}" user is not '
+                        f'authorized to read from database "{tdb}"')
+            return None
+        if sel is None:
+            return None
+        for tdb in self._select_read_dbs(sel, db, set()):
+            if tdb and not self.user_store.authorized(user, tdb,
+                                                      "READ"):
+                return (f'"{getattr(user, "name", "")}" user is not '
+                        f'authorized to read from database "{tdb}"')
+        if sel.into_measurement:
+            wdb = sel.into_db or db
+            if wdb and not self.user_store.authorized(user, wdb,
+                                                      "WRITE"):
+                return (f'"{getattr(user, "name", "")}" user is not '
+                        f'authorized to write to database "{wdb}"')
         return None
 
     def auth_required(self) -> bool:
@@ -379,13 +455,20 @@ class HttpServer:
 
     # ----------------------------------------------------------- handlers
 
-    def handle_write(self, params: dict, body: bytes) -> tuple[int, dict]:
+    def handle_write(self, params: dict, body: bytes,
+                     user=None) -> tuple[int, dict]:
         if self.sysctrl.readonly:
             self._bump("write_errors")
             return 403, {"error": "server is in readonly mode"}
         db = params.get("db")
         if not db:
             return 400, {"error": "database is required"}
+        if self.auth_required() and not self.user_store.authorized(
+                user, db, "WRITE"):
+            self._bump("write_errors")
+            return 403, {"error": f'"{getattr(user, "name", "")}" user '
+                                  f'is not authorized to write to '
+                                  f'database "{db}"'}
         precision = params.get("precision", "ns")
         try:
             rows = parse_lines(body.decode("utf-8"),
@@ -435,7 +518,8 @@ class HttpServer:
         results = []
         for i, stmt in enumerate(stmts):
             try:
-                deny = self._deny_privilege(stmt, user)
+                deny = self._deny_privilege(stmt, user) \
+                    or self._deny_db_access(stmt, user, db)
                 if deny is not None:
                     res = {"error": deny}
                 elif self._is_user_stmt(stmt):
@@ -891,7 +975,8 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
-            code, payload = srv.handle_write(self._params(), body)
+            code, payload = srv.handle_write(self._params(), body,
+                                             user=user)
             self._reply(code, payload if code != 204 else None)
             return
         if path == "/query":

@@ -39,12 +39,14 @@ class RetentionPolicy:
 @dataclass
 class DownsamplePolicy:
     """Rewrite data older than `age_ns` at `interval_ns` resolution
-    (reference UpdateDownSampleInfo engine_downsample.go:120)."""
+    (reference UpdateDownSampleInfo engine_downsample.go:120; DDL shape
+    CreateDownSampleStatement influxql/ast.go:7745)."""
     rp: str
     age_ns: int
     interval_ns: int
     calls: dict = field(default_factory=lambda: {"float": "mean",
                                                  "integer": "sum"})
+    duration_ns: int = 0             # retention of downsampled data
 
 
 @dataclass
@@ -80,6 +82,7 @@ class Subscription:
     db: str
     mode: str               # ALL | ANY
     destinations: list = field(default_factory=list)
+    rp: str = "autogen"
 
 
 class Catalog:
@@ -205,6 +208,19 @@ class Catalog:
     def downsample_policies(self, db: str) -> list[DownsamplePolicy]:
         return [DownsamplePolicy(**p)
                 for p in self.database(db).get("downsample_policies", [])]
+
+    def drop_downsample_policies(self, db: str,
+                                 rp: str | None = None) -> int:
+        """DROP DOWNSAMPLE ON db[.rp]: remove all (or one rp's)
+        policies; returns how many were removed."""
+        with self._lock:
+            pols = self.database(db).get("downsample_policies", [])
+            keep = [p for p in pols
+                    if rp is not None and p.get("rp") != rp]
+            removed = len(pols) - len(keep)
+            self.database(db)["downsample_policies"] = keep
+            self.save()
+        return removed
 
     def register_stream(self, db: str, task: StreamTask) -> None:
         with self._lock:

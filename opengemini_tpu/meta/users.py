@@ -40,6 +40,7 @@ def _hash(password: str, salt: bytes) -> bytes:
 class User:
     name: str
     admin: bool = False
+    privileges: dict = None          # db -> READ | WRITE | ALL
 
 
 class UserStore:
@@ -79,7 +80,8 @@ class UserStore:
             self._users[name] = {
                 "salt": salt.hex(),
                 "hash": _hash(password, salt).hex(),
-                "admin": bool(admin)}
+                "admin": bool(admin),
+                "privileges": {}}
             self._persist()
 
     def drop_user(self, name: str) -> None:
@@ -128,8 +130,71 @@ class UserStore:
 
     def users(self) -> list[User]:
         with self._lock:
-            return [User(n, u["admin"])
+            return [User(n, u["admin"], dict(u.get("privileges", {})))
                     for n, u in sorted(self._users.items())]
+
+    # ---- per-database privileges (reference GRANT/REVOKE semantics:
+    # influxql/parser.go:636,715; enforced by httpd) -------------------
+
+    def grant(self, name: str, db: str | None, privilege: str) -> None:
+        """GRANT READ|WRITE|ALL ON db, or admin when db is None."""
+        with self._lock:
+            u = self._users.get(name)
+            if u is None:
+                raise ValueError(f"user not found: {name}")
+            if db is None:
+                u["admin"] = True
+            else:
+                u.setdefault("privileges", {})[db] = privilege.upper()
+            self._persist()
+
+    def revoke(self, name: str, db: str | None,
+               privilege: str) -> None:
+        """REVOKE on db narrows or removes the db privilege; with db
+        None (REVOKE ALL PRIVILEGES FROM u) clears admin (influx 1.x
+        rule: the user keeps per-db grants)."""
+        with self._lock:
+            u = self._users.get(name)
+            if u is None:
+                raise ValueError(f"user not found: {name}")
+            if db is None:
+                if u["admin"] and sum(1 for x in self._users.values()
+                                      if x["admin"]) == 1:
+                    raise ValueError(
+                        "cannot revoke admin from the last admin user")
+                u["admin"] = False
+            else:
+                privs = u.setdefault("privileges", {})
+                cur = privs.get(db)
+                want = privilege.upper()
+                if cur is None:
+                    pass
+                elif want == "ALL" or cur == want:
+                    privs.pop(db, None)
+                elif cur == "ALL":
+                    # ALL minus READ leaves WRITE and vice versa
+                    privs[db] = "WRITE" if want == "READ" else "READ"
+            self._persist()
+
+    def grants(self, name: str) -> dict:
+        with self._lock:
+            u = self._users.get(name)
+            if u is None:
+                raise ValueError(f"user not found: {name}")
+            return dict(u.get("privileges", {}))
+
+    def authorized(self, user, db: str, need: str) -> bool:
+        """Does `user` hold `need` (READ or WRITE) on `db`?"""
+        if user is None:
+            return False
+        if user.admin:
+            return True
+        with self._lock:
+            u = self._users.get(user.name)
+        if u is None:
+            return False
+        p = u.get("privileges", {}).get(db, "")
+        return p == "ALL" or p == need.upper()
 
 
 def execute_user_statement(store: "UserStore", stmt) -> dict:
@@ -137,7 +202,8 @@ def execute_user_statement(store: "UserStore", stmt) -> dict:
     SHOW USERS — the single implementation behind both the single-node
     QueryExecutor and the HTTP layer's cluster-facade path."""
     from ..query.ast import (CreateUserStatement, DropUserStatement,
-                             SetPasswordStatement)
+                             GrantStatement, RevokeStatement,
+                             SetPasswordStatement, ShowGrantsStatement)
     if store is None:
         return {"error": "user management is not available"}
     try:
@@ -147,6 +213,16 @@ def execute_user_statement(store: "UserStore", stmt) -> dict:
             store.drop_user(stmt.name)
         elif isinstance(stmt, SetPasswordStatement):
             store.set_password(stmt.name, stmt.password)
+        elif isinstance(stmt, GrantStatement):
+            store.grant(stmt.user, stmt.on_db, stmt.privilege)
+        elif isinstance(stmt, RevokeStatement):
+            store.revoke(stmt.user, stmt.on_db, stmt.privilege)
+        elif isinstance(stmt, ShowGrantsStatement):
+            rows = [[db, p] for db, p in
+                    sorted(store.grants(stmt.user).items())]
+            return {"series": [
+                {"name": "", "columns": ["database", "privilege"],
+                 "values": rows}]}
         else:                                  # SHOW USERS
             return {"series": [
                 {"name": "", "columns": ["user", "admin"],

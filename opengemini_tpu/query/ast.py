@@ -234,6 +234,70 @@ class SetPasswordStatement:
 
 
 @dataclass
+class GrantStatement:
+    """GRANT READ|WRITE|ALL ON db TO user, or GRANT ALL PRIVILEGES TO
+    user (admin grant) — reference influxql/parser.go:717
+    parseGrantStatement / parseGrantAdminStatement."""
+    privilege: str                   # READ | WRITE | ALL
+    user: str
+    on_db: str | None = None         # None → admin grant
+
+
+@dataclass
+class RevokeStatement:
+    """REVOKE ... ON db FROM user / REVOKE ALL PRIVILEGES FROM user
+    (reference influxql/parser.go:638 parseRevokeStatement)."""
+    privilege: str
+    user: str
+    on_db: str | None = None
+
+
+@dataclass
+class ShowGrantsStatement:
+    """SHOW GRANTS FOR user (reference influxql/parser.go:1755)."""
+    user: str
+
+
+@dataclass
+class CreateSubscriptionStatement:
+    """CREATE SUBSCRIPTION name ON db.rp DESTINATIONS ALL|ANY 'url'...
+    (reference influxql/parser.go:209)."""
+    name: str
+    db: str
+    rp: str
+    mode: str                        # ALL | ANY
+    destinations: list
+
+
+@dataclass
+class DropSubscriptionStatement:
+    name: str
+    db: str
+    rp: str
+
+
+@dataclass
+class CreateDownsampleStatement:
+    """CREATE DOWNSAMPLE ON db[.rp] (type(call), ...) WITH DURATION d
+    SAMPLEINTERVAL(d, ...) TIMEINTERVAL(t, ...) — reference
+    influxql/ast.go:7745 CreateDownSampleStatement. Each
+    sample_interval[i] pairs with time_interval[i]: data older than the
+    sample interval rewrites at that time resolution."""
+    db: str
+    rp: str | None = None
+    calls: dict = None               # value type -> agg func
+    duration_ns: int = 0
+    sample_intervals: list = None    # ages (ns)
+    time_intervals: list = None      # resolutions (ns)
+
+
+@dataclass
+class DropDownsampleStatement:
+    db: str
+    rp: str | None = None
+
+
+@dataclass
 class DeleteStatement:
     from_measurement: str | None = None
     condition: object | None = None

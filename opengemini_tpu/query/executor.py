@@ -117,6 +117,67 @@ class QueryExecutor:
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_lock = __import__("threading").Lock()
 
+    def _catalog_stmt(self, stmt, db: str | None) -> dict:
+        """Subscription + downsample-policy DDL against the meta
+        catalog (reference parser.go:208 subscriptions; downsample DDL
+        via the statement executor). The subscriber/downsample services
+        read the same catalog, so DDL takes effect on their next pass."""
+        from ..meta.catalog import DownsamplePolicy, Subscription
+        from .ast import (CreateDownsampleStatement,
+                          CreateSubscriptionStatement,
+                          DropDownsampleStatement,
+                          DropSubscriptionStatement)
+        if self.catalog is None:
+            return {"error": "meta catalog is not available"}
+        try:
+            if isinstance(stmt, CreateSubscriptionStatement):
+                if any(s2.name == stmt.name and s2.db == stmt.db
+                       for s2 in self.catalog.subscriptions.values()):
+                    return {"error":
+                            f"subscription already exists: {stmt.name}"}
+                self.catalog.create_subscription(Subscription(
+                    stmt.name, stmt.db, stmt.mode,
+                    list(stmt.destinations), stmt.rp))
+                return {}
+            if isinstance(stmt, DropSubscriptionStatement):
+                self.catalog.drop_subscription(stmt.db, stmt.name)
+                return {}
+            if isinstance(stmt, CreateDownsampleStatement):
+                ddb = stmt.db or db
+                if ddb is None:
+                    return {"error": "database required"}
+                if ddb not in self.catalog.databases:
+                    # databases born implicitly through /write exist in
+                    # the engine but not the catalog — register so the
+                    # policy has a home (mirrors CQ registration)
+                    if ddb in getattr(self.engine, "databases", {}):
+                        self.catalog.create_database(ddb)
+                    else:
+                        return {"error": f"database not found: {ddb}"}
+                rp_name = stmt.rp or "autogen"
+                if any(p.rp == rp_name for p in
+                       self.catalog.downsample_policies(ddb)):
+                    return {"error": "downsample policy already exists "
+                                     f"on {ddb}.{rp_name}"}
+                for age, res in zip(stmt.sample_intervals,
+                                    stmt.time_intervals):
+                    p = DownsamplePolicy(
+                        stmt.rp or "autogen", int(age), int(res),
+                        dict(stmt.calls) if stmt.calls else
+                        {"float": "mean", "integer": "sum"},
+                        int(stmt.duration_ns))
+                    self.catalog.add_downsample_policy(ddb, p)
+                return {}
+            if isinstance(stmt, DropDownsampleStatement):
+                ddb = stmt.db or db
+                if ddb is None:
+                    return {"error": "database required"}
+                self.catalog.drop_downsample_policies(ddb, stmt.rp)
+                return {}
+        except (GeminiError, KeyError) as e:
+            return {"error": str(e)}
+        return {"error": "unreachable"}
+
     def _drop_plan_cache(self) -> None:
         """Release cached scan plans: entries pin memtable snapshots
         and (possibly unlinked) TSSP readers, so DDL/DELETE clears them
@@ -188,6 +249,21 @@ class QueryExecutor:
             if isinstance(stmt, (CreateUserStatement, DropUserStatement,
                                  SetPasswordStatement)):
                 return self._user_stmt(stmt)
+            from .ast import (CreateDownsampleStatement,
+                              CreateSubscriptionStatement,
+                              DropDownsampleStatement,
+                              DropSubscriptionStatement,
+                              GrantStatement, RevokeStatement,
+                              ShowGrantsStatement)
+            if isinstance(stmt, (GrantStatement, RevokeStatement,
+                                 ShowGrantsStatement)):
+                from ..meta.users import execute_user_statement
+                return execute_user_statement(self.users, stmt)
+            if isinstance(stmt, (CreateSubscriptionStatement,
+                                 DropSubscriptionStatement,
+                                 CreateDownsampleStatement,
+                                 DropDownsampleStatement)):
+                return self._catalog_stmt(stmt, db)
             if isinstance(stmt, (CreateCQStatement, DropCQStatement)):
                 return self._cq_stmt(stmt)
             if isinstance(stmt, (CreateRPStatement, AlterRPStatement,
@@ -341,6 +417,40 @@ class QueryExecutor:
                     for c in qm.list()] if qm else []
             return _series("queries",
                            ["qid", "query", "database", "duration"], rows)
+        if stmt.what == "subscriptions":
+            if self.catalog is None:
+                return {"error": "meta catalog is not available"}
+            rows_by_db: dict = {}
+            for sub in self.catalog.subscriptions.values():
+                rows_by_db.setdefault(sub.db, []).append(
+                    [sub.rp, sub.name, sub.mode.upper(),
+                     list(sub.destinations)])
+            return {"series": [
+                {"name": dbn, "columns":
+                 ["retention_policy", "name", "mode", "destinations"],
+                 "values": sorted(rows)}
+                for dbn, rows in sorted(rows_by_db.items())]} \
+                if rows_by_db else {}
+        if stmt.what == "downsamples":
+            if self.catalog is None:
+                return {"error": "meta catalog is not available"}
+            dbs = [stmt.on_db] if stmt.on_db else \
+                sorted(self.catalog.databases)
+            rows = []
+            for dbn in dbs:
+                try:
+                    pols = self.catalog.downsample_policies(dbn)
+                except KeyError:
+                    continue
+                for p in pols:
+                    rows.append([dbn, p.rp, p.age_ns, p.interval_ns,
+                                 json.dumps(p.calls, sort_keys=True)])
+            if not rows:
+                return {}
+            return _series(
+                "downsamples",
+                ["database", "retention_policy", "sample_interval_ns",
+                 "time_interval_ns", "ops"], rows)
         if stmt.what == "users":
             rows = [[u.name, u.admin] for u in self.users.users()] \
                 if self.users is not None else []

@@ -33,7 +33,10 @@ from __future__ import annotations
 import re
 from datetime import datetime, timezone
 
-from .ast import (AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
+from .ast import (
+    CreateDownsampleStatement, CreateSubscriptionStatement,
+    DropDownsampleStatement, DropSubscriptionStatement,
+    GrantStatement, RevokeStatement, ShowGrantsStatement,AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
                   CreateDatabaseStatement, CreateMeasurementStatement,
                   CreateRPStatement, CreateUserStatement, DeleteStatement,
                   Dimension, DropCQStatement, DropDatabaseStatement,
@@ -280,6 +283,29 @@ class Parser:
                     shard_dur = self._rp_duration()
                 return CreateRPStatement(name, rdb, dur, repl, shard_dur,
                                          self._kw("DEFAULT"))
+            if self._kw("SUBSCRIPTION"):
+                # CREATE SUBSCRIPTION n ON db.rp DESTINATIONS ALL|ANY
+                #   'url'[, ...]   (reference parser.go:209)
+                name = self._ident()
+                self._expect_kw("ON")
+                sdb = self._ident()
+                self._expect_op(".")
+                rp = self._ident()
+                self._expect_kw("DESTINATIONS")
+                if self._kw("ALL"):
+                    mode = "ALL"
+                elif self._kw("ANY"):
+                    mode = "ANY"
+                else:
+                    raise ParseError("expected ALL or ANY after "
+                                     "DESTINATIONS")
+                dests = [self._string()]
+                while self._op(","):
+                    dests.append(self._string())
+                return CreateSubscriptionStatement(name, sdb, rp, mode,
+                                                   dests)
+            if self._kw("DOWNSAMPLE"):
+                return self._parse_create_downsample()
             if self._kw("USER"):
                 # CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES]
                 name = self._ident()
@@ -314,6 +340,20 @@ class Parser:
                 name = self._ident()
                 self._expect_kw("ON")
                 return DropRPStatement(name, self._ident())
+            if self._kw("SUBSCRIPTION"):
+                name = self._ident()
+                self._expect_kw("ON")
+                sdb = self._ident()
+                self._expect_op(".")
+                return DropSubscriptionStatement(name, sdb,
+                                                 self._ident())
+            if self._kw("DOWNSAMPLE"):
+                ddb = rp = None
+                if self._kw("ON"):
+                    ddb = self._ident()
+                    if self._op("."):
+                        rp = self._ident()
+                return DropDownsampleStatement(ddb, rp)
             self._expect_kw("MEASUREMENT")
             return DropMeasurementStatement(self._ident())
         if u == "ALTER":
@@ -354,6 +394,8 @@ class Parser:
                 raise ParseError(f"password must be a string at {p3}")
             return SetPasswordStatement(
                 name, re.sub(r"\\(.)", r"\1", pw[1:-1]))
+        if u == "GRANT" or u == "REVOKE":
+            return self._parse_grant_revoke(u)
         if u == "DELETE":
             self.lx.next()
             stmt = DeleteStatement()
@@ -375,6 +417,90 @@ class Parser:
                                  f"got {v2!r} at {p2}")
             return KillQueryStatement(int(v2))
         raise ParseError(f"unsupported statement starting {v!r} at {p}")
+
+    def _string(self) -> str:
+        k, v, p = self.lx.next()
+        if k != "string":
+            raise ParseError(f"expected string at {p}, got {v!r}")
+        return re.sub(r"\\(.)", r"\1", v[1:-1])
+
+    def _parse_grant_revoke(self, kw: str):
+        """GRANT/REVOKE [READ|WRITE|ALL [PRIVILEGES]] (ON db TO|FROM u |
+        TO|FROM u) — reference influxql/parser.go:636,715."""
+        self.lx.next()
+        priv = None
+        for cand in ("READ", "WRITE", "ALL"):
+            if self._kw(cand):
+                priv = cand
+                break
+        if priv is None:
+            raise ParseError("expected READ, WRITE or ALL after "
+                             + kw)
+        if priv == "ALL":
+            self._kw("PRIVILEGES")
+        cls = GrantStatement if kw == "GRANT" else RevokeStatement
+        link = "TO" if kw == "GRANT" else "FROM"
+        if self._kw("ON"):
+            dbn = self._ident()
+            self._expect_kw(link)
+            return cls(priv, self._ident(), dbn)
+        # admin form requires ALL PRIVILEGES (reference rule)
+        if priv != "ALL":
+            raise ParseError(f"{kw} {priv} requires ON <database>")
+        self._expect_kw(link)
+        return cls(priv, self._ident(), None)
+
+    def _parse_create_downsample(self):
+        """CREATE DOWNSAMPLE [ON db[.rp]] (type(call), ...) WITH
+        DURATION d SAMPLEINTERVAL(d,...) TIMEINTERVAL(t,...) —
+        reference influxql/ast.go:7745."""
+        ddb = rp = None
+        if self._kw("ON"):
+            ddb = self._ident()
+            if self._op("."):
+                rp = self._ident()
+        calls = {}
+        if self._op("("):
+            while True:
+                vtype = self._ident().lower()
+                if not self._op("("):
+                    raise ParseError("expected ( after downsample "
+                                     "value type")
+                calls[vtype] = self._ident().lower()
+                if not self._op(")"):
+                    raise ParseError("expected ) in downsample op")
+                if not self._op(","):
+                    break
+            if not self._op(")"):
+                raise ParseError("expected ) closing downsample ops")
+        self._expect_kw("WITH")
+        self._expect_kw("DURATION")
+        dur = self._duration_tok()
+        self._expect_kw("SAMPLEINTERVAL")
+        samples = self._duration_list()
+        self._expect_kw("TIMEINTERVAL")
+        times = self._duration_list()
+        if len(samples) != len(times):
+            raise ParseError("SAMPLEINTERVAL and TIMEINTERVAL must "
+                             "have the same length")
+        return CreateDownsampleStatement(ddb, rp, calls or None, dur,
+                                         samples, times)
+
+    def _duration_tok(self) -> int:
+        k, v, p = self.lx.next()
+        if k != "duration":
+            raise ParseError(f"expected duration at {p}, got {v!r}")
+        return parse_duration(v)
+
+    def _duration_list(self) -> list:
+        if not self._op("("):
+            raise ParseError("expected ( starting duration list")
+        out = [self._duration_tok()]
+        while self._op(","):
+            out.append(self._duration_tok())
+        if not self._op(")"):
+            raise ParseError("expected ) closing duration list")
+        return out
 
     def _parse_create_measurement(self):
         stmt = CreateMeasurementStatement(self._ident())
@@ -508,6 +634,16 @@ class Parser:
             return ShowStatement("continuous queries")
         if u == "SHARDS":
             return ShowStatement("shards")
+        if u == "GRANTS":
+            self._expect_kw("FOR")
+            return ShowGrantsStatement(self._ident())
+        if u == "SUBSCRIPTIONS":
+            return ShowStatement("subscriptions")
+        if u == "DOWNSAMPLES":
+            stmt = ShowStatement("downsamples")
+            if self._kw("ON"):
+                stmt.on_db = self._ident()
+            return stmt
         if u == "STATS":
             return ShowStatement("stats")
         if u == "MEASUREMENTS":

@@ -1,0 +1,228 @@
+"""GRANT/REVOKE/SHOW GRANTS with per-db enforcement in httpd auth,
+CREATE/DROP/SHOW SUBSCRIPTIONS wired to the subscriber service, and
+downsample-policy DDL wired to the downsample service — VERDICT r2
+missing #3 (reference influxql/parser.go:636,715,1755 privileges;
+parser.go:208 subscriptions; CreateDownSampleStatement ast.go:7745)."""
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.http import HttpServer
+from opengemini_tpu.storage import Engine
+from opengemini_tpu.utils.config import Config
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture()
+def auth_server(tmp_path):
+    cfg = Config()
+    cfg.http.auth_enabled = True
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    yield srv
+    srv.stop()
+    eng.close()
+
+
+def _q(srv, q, db=None, user=None, pw=None, expect_error=False):
+    url = f"http://127.0.0.1:{srv.port}/query?q=" + urllib.parse.quote(q)
+    if db:
+        url += f"&db={db}"
+    req = urllib.request.Request(url)
+    if user:
+        tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+        req.add_header("Authorization", f"Basic {tok}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if expect_error:
+            return json.loads(e.read())
+        raise
+
+
+def _w(srv, db, body, user=None, pw=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/write?db={db}",
+        data=body.encode(), method="POST")
+    if user:
+        tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+        req.add_header("Authorization", f"Basic {tok}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_grant_revoke_show_grants_enforced(auth_server):
+    srv = auth_server
+    # bootstrap admin, then a plain user
+    r = _q(srv, "CREATE USER root WITH PASSWORD 'r00t' "
+                "WITH ALL PRIVILEGES")
+    assert "error" not in r["results"][0]
+    A = dict(user="root", pw="r00t")
+    assert "error" not in _q(srv, "CREATE USER bob WITH PASSWORD 'pw1'",
+                             **A)["results"][0]
+    assert "error" not in _q(srv, "CREATE DATABASE d1", **A)["results"][0]
+    assert "error" not in _q(srv, "CREATE DATABASE d2", **A)["results"][0]
+    assert _w(srv, "d1", "m v=1 1000", **A) == 204
+    assert _w(srv, "d2", "m v=2 1000", **A) == 204
+
+    B = dict(user="bob", pw="pw1")
+    # no grants: bob can neither read nor write d1
+    r = _q(srv, "SELECT v FROM m", db="d1", **B)
+    assert "not authorized to read" in r["results"][0]["error"]
+    assert _w(srv, "d1", "m v=9 2000", **B) == 403
+
+    # GRANT READ ON d1: reads pass, writes still denied; d2 untouched
+    assert "error" not in _q(srv, "GRANT READ ON d1 TO bob",
+                             **A)["results"][0]
+    r = _q(srv, "SELECT v FROM m", db="d1", **B)
+    assert r["results"][0]["series"][0]["values"] == [[1000, 1.0]]
+    assert _w(srv, "d1", "m v=9 2000", **B) == 403
+    assert "not authorized" in _q(srv, "SELECT v FROM m", db="d2",
+                                  **B)["results"][0]["error"]
+
+    # GRANT WRITE upgrades; SHOW GRANTS reflects the change
+    assert "error" not in _q(srv, "GRANT WRITE ON d1 TO bob",
+                             **A)["results"][0]
+    assert _w(srv, "d1", "m v=9 2000", **B) == 204
+    g = _q(srv, "SHOW GRANTS FOR bob", **A)
+    assert g["results"][0]["series"][0]["values"] == [["d1", "WRITE"]]
+
+    # non-admin may not GRANT
+    r = _q(srv, "GRANT READ ON d2 TO bob", **B)
+    assert "admin privilege required" in r["results"][0]["error"]
+
+    # REVOKE removes the privilege
+    assert "error" not in _q(srv, "REVOKE WRITE ON d1 FROM bob",
+                             **A)["results"][0]
+    assert _w(srv, "d1", "m v=10 3000", **B) == 403
+    # ALL grant then partial revoke narrows (ALL − READ = WRITE)
+    _q(srv, "GRANT ALL ON d1 TO bob", **A)
+    _q(srv, "REVOKE READ ON d1 FROM bob", **A)
+    g = _q(srv, "SHOW GRANTS FOR bob", **A)
+    assert g["results"][0]["series"][0]["values"] == [["d1", "WRITE"]]
+
+    # admin grant / revoke via ALL PRIVILEGES TO/FROM
+    _q(srv, "GRANT ALL PRIVILEGES TO bob", **A)
+    r = _q(srv, "SELECT v FROM m", db="d2", **B)
+    assert "series" in r["results"][0]
+    _q(srv, "REVOKE ALL PRIVILEGES FROM bob", **A)
+    r = _q(srv, "SELECT v FROM m", db="d2", **B)
+    assert "not authorized" in r["results"][0]["error"]
+
+
+def test_subscription_ddl_roundtrip_and_delivery(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    # a sink server records deliveries
+    sink_eng = Engine(str(tmp_path / "sink"))
+    sink = HttpServer(sink_eng, port=0)
+    sink.start()
+    from opengemini_tpu.services.subscriber import SubscriberService
+    svc = SubscriberService(eng, srv.catalog)
+    svc.start()
+    try:
+        def q(text):
+            url = (f"http://127.0.0.1:{srv.port}/query?q="
+                   + urllib.parse.quote(text))
+            return json.loads(
+                urllib.request.urlopen(url, timeout=10).read())
+
+        assert "error" not in q("CREATE DATABASE sdb")["results"][0]
+        r = q("CREATE SUBSCRIPTION s0 ON sdb.autogen DESTINATIONS ALL "
+              f"'http://127.0.0.1:{sink.port}'")
+        assert "error" not in r["results"][0]
+        # duplicate rejected
+        r = q("CREATE SUBSCRIPTION s0 ON sdb.autogen DESTINATIONS ALL "
+              "'http://x'")
+        assert "already exists" in r["results"][0]["error"]
+        shown = q("SHOW SUBSCRIPTIONS")["results"][0]["series"]
+        assert shown[0]["name"] == "sdb"
+        assert shown[0]["values"][0][:3] == ["autogen", "s0", "ALL"]
+
+        # a write flows to the sink
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/write?db=sdb",
+            data=b"m v=42 1000", method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+        import time as _t
+        for _ in range(50):
+            res = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{sink.port}/query?db=sdb&q="
+                + urllib.parse.quote("SELECT v FROM m"),
+                timeout=10).read())
+            if "series" in res["results"][0]:
+                break
+            _t.sleep(0.1)
+        assert res["results"][0]["series"][0]["values"] == [[1000, 42.0]]
+
+        assert "error" not in q("DROP SUBSCRIPTION s0 ON sdb.autogen"
+                                )["results"][0]
+        assert q("SHOW SUBSCRIPTIONS")["results"][0] == \
+            {"statement_id": 0}
+    finally:
+        svc.stop()
+        sink.stop()
+        sink_eng.close()
+        srv.stop()
+        eng.close()
+
+
+def test_downsample_ddl_drives_service(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    try:
+        def q(text, db=None):
+            url = (f"http://127.0.0.1:{srv.port}/query?q="
+                   + urllib.parse.quote(text))
+            if db:
+                url += f"&db={db}"
+            return json.loads(
+                urllib.request.urlopen(url, timeout=10).read())
+
+        # minute-resolution raw data in ddb
+        body = "\n".join(f"cpu,host=a v={i}.5 {i * 10 * 10**9}"
+                         for i in range(180))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/write?db=ddb",
+            data=body.encode(), method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+
+        r = q("CREATE DOWNSAMPLE ON ddb (float(mean)) WITH DURATION 30d "
+              "SAMPLEINTERVAL(1h) TIMEINTERVAL(1m)")
+        assert "error" not in r["results"][0]
+        shown = q("SHOW DOWNSAMPLES ON ddb")["results"][0]["series"][0]
+        assert shown["values"][0][:4] == \
+            ["ddb", "autogen", 3600 * 10**9, 60 * 10**9]
+
+        # the downsample service consumes the SQL-created policy
+        from opengemini_tpu.services.downsample import DownsampleService
+        svc = DownsampleService(
+            eng, srv.catalog,
+            now_fn=lambda: 10**9 * 3600 * 24 * 365)
+        done = svc.run_once()
+        assert done >= 1
+        res = q("SELECT count(v) FROM cpu", db="ddb")
+        n = res["results"][0]["series"][0]["values"][0][1]
+        assert n == 30        # 180 rows @10s → 30 one-minute means
+
+        assert "error" not in q("DROP DOWNSAMPLE ON ddb")["results"][0]
+        assert q("SHOW DOWNSAMPLES ON ddb")["results"][0] == \
+            {"statement_id": 0}
+        assert svc.run_once() == 0
+    finally:
+        srv.stop()
+        eng.close()
