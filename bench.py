@@ -203,8 +203,17 @@ def main():
                     f"MISMATCH [{key}]: cpu {cpu[key]['digest'][:16]} "
                     f"!= tpu {tpu[key]['digest'][:16]}")
 
-        kernel_rps = kernel_micro()
-        http_ms = http_roundtrip(td)
+        # auxiliary metrics must never cost us the headline line
+        try:
+            kernel_rps = kernel_micro()
+        except Exception as e:
+            print(f"# kernel_micro failed: {e}", file=sys.stderr)
+            kernel_rps = 0.0
+        try:
+            http_ms = http_roundtrip(td)
+        except Exception as e:
+            print(f"# http_roundtrip failed: {e}", file=sys.stderr)
+            http_ms = 0.0
 
     e2e_rps = n_rows / tpu["1h"]["best_s"]
     print(json.dumps({

@@ -1,0 +1,126 @@
+"""1M-series index + range-vector query benchmark (BASELINE config 4:
+Prometheus `rate(node_cpu_seconds_total[5m])` over 1M series; the
+reference's >1M-series claim, README.md:40-42 / mergeset_index.go:261).
+
+Measures: series ingest rate into the columnar index, index core
+memory, tag-filter and tagset query latency at 1M series, and the full
+PromQL rate query end-to-end over stored data.
+
+Writes benchmarks/series_index_bench.json.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N_SERIES = int(os.environ.get("OG_SERIES_BENCH_N", "1000000"))
+POINTS = 6                      # 6 samples @30s → one 5m rate window
+NS = 10**9
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def bench_index() -> dict:
+    from opengemini_tpu.index.tsi import SeriesIndex, TagFilter
+    ix = SeriesIndex()
+    rss0 = rss_mb()
+    t0 = time.perf_counter()
+    for i in range(N_SERIES):
+        ix.get_or_create_sid("node_cpu_seconds_total",
+                             {"instance": f"host-{i >> 3}",
+                              "cpu": f"cpu{i & 7}", "mode": "user"})
+    t_ing = time.perf_counter() - t0
+    mc = ix._msts["node_cpu_seconds_total"]
+    core_mb = (mc.codes.nbytes + mc.sids.nbytes + ix._sid_mst.nbytes
+               + ix._sid_ord.nbytes) / 2**20
+
+    t0 = time.perf_counter()
+    sids = ix.series_ids("node_cpu_seconds_total",
+                         [TagFilter("cpu", "cpu3")])
+    t_filter = time.perf_counter() - t0
+    assert len(sids) == N_SERIES // 8
+
+    t0 = time.perf_counter()
+    ts = ix.group_by_tagsets("node_cpu_seconds_total", ["cpu"])
+    t_group = time.perf_counter() - t0
+    assert len(ts) == 8
+
+    return {"series": N_SERIES,
+            "ingest_series_per_sec": round(N_SERIES / t_ing, 1),
+            "index_core_mb": round(core_mb, 1),
+            "rss_delta_mb": round(rss_mb() - rss0, 1),
+            "tag_filter_ms": round(t_filter * 1e3, 2),
+            "tagset_group_ms": round(t_group * 1e3, 2)}
+
+
+def bench_prom_rate(n_series: int) -> dict:
+    """rate() over stored data through the native PromQL engine."""
+    import tempfile
+
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    td = tempfile.mkdtemp(prefix="og-sbench-",
+                          dir="/dev/shm" if os.path.isdir("/dev/shm")
+                          else None)
+    eng = Engine(td, EngineOptions(shard_duration=1 << 62))
+    eng.create_database("prom")
+    times = (np.arange(POINTS, dtype=np.int64) * 30 + 30) * NS
+    t0 = time.perf_counter()
+    counters = np.cumsum(
+        np.random.default_rng(0).random((POINTS,)) + 1.0)
+    for i in range(n_series):
+        eng.write_record("prom", "node_cpu_seconds_total",
+                         {"instance": f"host-{i >> 3}",
+                          "cpu": f"cpu{i & 7}", "mode": "user"},
+                         times, {"value": counters + i})
+    for s in eng.database("prom").all_shards():
+        s.flush()
+    t_ing = time.perf_counter() - t0
+
+    pe = PromEngine(eng, "prom")
+    t_cold = t_q = None
+    for _ in range(2):            # cold (compile) then warm
+        t0 = time.perf_counter()
+        res = pe.query_instant("rate(node_cpu_seconds_total[5m])",
+                               int(times[-1]))
+        t_q = time.perf_counter() - t0
+        if t_cold is None:
+            t_cold = t_q
+    n_out = len(res)
+    eng.close()
+    import shutil
+    shutil.rmtree(td, ignore_errors=True)
+    return {"prom_series": n_series,
+            "prom_rows": n_series * POINTS,
+            "prom_ingest_s": round(t_ing, 2),
+            "rate_query_cold_s": round(t_cold, 3),
+            "rate_query_s": round(t_q, 3),
+            "rate_series_out": n_out,
+            "rate_series_per_sec": round(n_out / t_q, 1)}
+
+
+def main():
+    out = {"metric": "series_index_1m", "unit": "mixed"}
+    out.update(bench_index())
+    prom_n = min(N_SERIES,
+                 int(os.environ.get("OG_SERIES_BENCH_PROM_N",
+                                    str(N_SERIES))))
+    out.update(bench_prom_rate(prom_n))
+    path = os.path.join(os.path.dirname(__file__),
+                        "series_index_bench.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

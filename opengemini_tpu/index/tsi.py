@@ -1,20 +1,39 @@
 """Inverted series index (role of the reference's tsi MergeSetIndex,
-engine/index/tsi/mergeset_index.go:261 over lib/util/lifted/vm/mergeset).
+engine/index/tsi/mergeset_index.go:261 over lib/util/lifted/vm/mergeset,
+built for the reference's >1M-series claim, README.md:40-42).
 
-Maps measurement → tag key → tag value → posting list of series ids, plus
-sid → (measurement, tags) reverse lookup for group-by. The reference builds
-this on a mergeset LSM; here the working set is dict/numpy-based in memory
-with an append-only persistence log (replayed on open) — series creation is
-rare relative to writes, and posting lists stay as sorted int64 arrays that
-feed straight into the TPU group-lut construction.
+TPU-first design: instead of an LSM of raw index items (the reference's
+mergeset) or per-series Python dicts (the round-2 working set), the
+index is COLUMNAR — per measurement, each tag key is a dictionary-
+encoded int32 code column over the series ordinals. That makes every
+index operation a vectorized numpy pass:
 
-Series ids are sequential per index (1-based), so a query's sid→group lookup
-table is a dense numpy array — the device gather for group assignment is a
-single vectorized indexing op.
+- tag filters:     mask = (col == code) / np.isin(col, regex-matched
+                   codes) — one compare over N series, no posting lists
+- group-by tagset: np.unique over the stacked group-key code rows —
+                   the grouping IS the codes, which then feed straight
+                   into the device kernels' sid→group lookup table
+- reverse lookup:  sid → (measurement ordinal) arrays, tags
+                   reconstructed from code columns on demand
+
+Memory is bounded: ~4 bytes per (series, tag key) for codes + the tag
+value dictionaries (cardinality-bound) + a 16-byte hashed key→sid map —
+two orders of magnitude below dict-of-dicts at 1M series.
+
+Durability: the append-only record log (unchanged format) is the WAL;
+a columnar SNAPSHOT (npz + json dictionaries) persists the working set
+with the log offset it covers, so re-open loads the snapshot and
+replays only the log tail (the mergeset-merge analog: snapshot = the
+merged sorted run, log tail = the in-memory part).
+
+Series ids are sequential per index (1-based), so a query's sid→group
+lookup table is a dense numpy array — the device gather for group
+assignment is a single vectorized indexing op.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import threading
@@ -25,6 +44,10 @@ import numpy as np
 from ..utils import get_logger
 
 log = get_logger(__name__)
+
+_HDR = struct.calcsize("<IQ")
+# snapshot when the un-snapshotted log tail exceeds this (bytes)
+SNAP_THRESHOLD = int(os.environ.get("OG_TSI_SNAP_BYTES", str(4 << 20)))
 
 
 @dataclass(frozen=True)
@@ -40,24 +63,168 @@ def series_key(measurement: str, tags: dict[str, str]) -> str:
         f"{k}={tags[k]}" for k in sorted(tags))
 
 
+def _key_hash(key: str) -> int:
+    import hashlib
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "little")
+
+
+class _MstCols:
+    """One measurement's columnar tag store."""
+
+    __slots__ = ("name", "tag_keys", "key_idx", "val_dicts", "val_codes",
+                 "codes", "sids", "n")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.tag_keys: list[str] = []          # column order
+        self.key_idx: dict[str, int] = {}
+        self.val_dicts: list[list[str]] = []   # per key: code -> value
+        self.val_codes: list[dict[str, int]] = []  # per key: value -> code
+        self.codes: np.ndarray = np.zeros((0, 64), dtype=np.int32)
+        self.sids: np.ndarray = np.zeros(64, dtype=np.int64)
+        self.n = 0
+
+    def _ensure_key(self, key: str) -> int:
+        ki = self.key_idx.get(key)
+        if ki is None:
+            ki = len(self.tag_keys)
+            self.tag_keys.append(key)
+            self.key_idx[key] = ki
+            # code 0 = KEY ABSENT (never a value: an explicit empty tag
+            # value 'host=' is distinct from no host tag at all and
+            # allocates its own code like any other string)
+            self.val_dicts.append([None])
+            self.val_codes.append({})
+            grown = np.zeros((ki + 1, self.codes.shape[1]),
+                             dtype=np.int32)
+            if ki:
+                grown[:ki] = self.codes
+            self.codes = grown
+        return ki
+
+    def _ensure_cap(self, want: int) -> None:
+        cap = self.codes.shape[1]
+        if want <= cap:
+            return
+        new = max(cap * 2, want, 64)
+        codes = np.zeros((self.codes.shape[0], new), dtype=np.int32)
+        codes[:, :cap] = self.codes
+        self.codes = codes
+        sids = np.zeros(new, dtype=np.int64)
+        sids[:cap] = self.sids
+        self.sids = sids
+
+    def add(self, tags: dict[str, str], sid: int) -> int:
+        """Append one series; returns its ordinal."""
+        for k in tags:
+            self._ensure_key(k)
+        self._ensure_cap(self.n + 1)
+        o = self.n
+        for ki, key in enumerate(self.tag_keys):
+            v = tags.get(key)
+            if v is None:
+                continue               # absent key keeps code 0
+            codes = self.val_codes[ki]
+            c = codes.get(v)
+            if c is None:
+                c = len(self.val_dicts[ki])
+                self.val_dicts[ki].append(v)
+                codes[v] = c
+            self.codes[ki, o] = c
+        self.sids[o] = sid
+        self.n += 1
+        return o
+
+    def tags_of_ordinal(self, o: int) -> dict[str, str]:
+        out = {}
+        for ki, key in enumerate(self.tag_keys):
+            c = int(self.codes[ki, o])
+            if c:
+                out[key] = self.val_dicts[ki][c]
+        return out
+
+    def key_of_ordinal(self, o: int) -> str:
+        return series_key(self.name, self.tags_of_ordinal(o))
+
+    def filter_mask(self, filters: list[TagFilter]) -> np.ndarray | None:
+        """AND of tag predicates → bool mask over ordinals (None =
+        measurement unknown/no rows)."""
+        import re
+        if self.n == 0:
+            return None
+        mask = np.ones(self.n, dtype=bool)
+        for f in filters or ():
+            ki = self.key_idx.get(f.key)
+            if ki is None:
+                # unknown tag key: '=' matches nothing, '!=' everything
+                if f.op in ("=", "=~"):
+                    return np.zeros(self.n, dtype=bool)
+                continue
+            col = self.codes[ki, :self.n]
+            empty_matches = False
+            if f.op in ("=", "!="):
+                c = self.val_codes[ki].get(f.value)
+                m = (col == c) if c is not None \
+                    else np.zeros(self.n, dtype=bool)
+                empty_matches = f.value == ""
+            else:
+                rx = re.compile(f.value)
+                match_codes = np.array(
+                    [c for c, v in enumerate(self.val_dicts[ki])
+                     if c and rx.search(v)], dtype=np.int32)
+                m = np.isin(col, match_codes)
+                empty_matches = bool(rx.search(""))
+            # influx/prom semantics: an absent key behaves as value ""
+            # (applied before inversion, so host != '' keeps exactly
+            # the series that HAVE a host tag, and host =~ ".*" matches
+            # series without one)
+            if empty_matches:
+                m |= col == 0
+            if f.op in ("!=", "!~"):
+                m = ~m
+            mask &= m
+        return mask
+
+
 class SeriesIndex:
     """Per-shard (or per-partition) series index."""
 
     def __init__(self, path: str | None = None):
         self.path = path
         self._lock = threading.RLock()
-        self._key_to_sid: dict[str, int] = {}
-        self._sid_to_tags: list[dict[str, str] | None] = [None]  # 1-based
-        self._sid_to_mst: list[str | None] = [None]
-        self._mst_sids: dict[str, list[int]] = {}
-        self._postings: dict[tuple[str, str, str], list[int]] = {}
+        self._msts: dict[str, _MstCols] = {}
+        self._mst_names: list[str] = []        # mst code -> name
+        self._mst_code: dict[str, int] = {}
+        # global sid → (measurement code, ordinal); -1 = dropped/unknown
+        self._sid_mst = np.full(64, -1, dtype=np.int32)
+        self._sid_ord = np.zeros(64, dtype=np.int64)
+        self._next_sid = 1                     # sids are 1-based
+        # hashed key → sid (16B/series); true 64-bit collisions fall
+        # back to the side dict
+        self._hash_sid: dict[int, int] = {}
+        self._collisions: dict[str, int] = {}
         self._log = None
+        self._log_size = 0
+        self._snap_covered = 0                 # log bytes in snapshot
         if path:
+            if os.path.exists(self._snap_path()):
+                try:
+                    self._load_snapshot()
+                except Exception as e:
+                    log.warning("series snapshot unreadable (%s); full "
+                                "log replay", e)
+                    self.__init__(None)
+                    self.path = path
             if os.path.exists(path):
-                self._replay()
+                self._replay(from_off=self._snap_covered)
             self._log = open(path, "ab")
+            self._log_size = self._log.tell()
 
     # ---- persistence -----------------------------------------------------
+
+    def _snap_path(self) -> str:
+        return self.path + ".snap"
 
     def _append_log(self, measurement: str, tags: dict[str, str],
                     sid: int) -> None:
@@ -66,24 +233,112 @@ class SeriesIndex:
         items = [measurement.encode()] + [
             f"{k}={v}".encode() for k, v in sorted(tags.items())]
         payload = b"\x00".join(items)
-        self._log.write(struct.pack("<IQ", len(payload), sid) + payload)
+        rec = struct.pack("<IQ", len(payload), sid) + payload
+        self._log.write(rec)
+        self._log_size += len(rec)
 
     def flush(self) -> None:
         with self._lock:
             if self._log is not None:
                 self._log.flush()
                 os.fsync(self._log.fileno())
+            if self._log_size - self._snap_covered > SNAP_THRESHOLD:
+                self._write_snapshot()
 
-    def _replay(self) -> None:
+    def _write_snapshot(self) -> None:
+        """Persist the columnar working set + covered log offset (the
+        mergeset 'merged run'). Atomic via rename."""
+        if not self.path:
+            return
+        meta = {
+            "covered": self._log_size,
+            "next_sid": self._next_sid,
+            "mst_names": self._mst_names,
+            "msts": {},
+        }
+        arrays = {
+            "sid_mst": self._sid_mst[:self._next_sid],
+            "sid_ord": self._sid_ord[:self._next_sid],
+            "hash_keys": np.fromiter(self._hash_sid.keys(),
+                                     dtype=np.uint64,
+                                     count=len(self._hash_sid)),
+            "hash_sids": np.fromiter(self._hash_sid.values(),
+                                     dtype=np.int64,
+                                     count=len(self._hash_sid)),
+        }
+        meta["collisions"] = self._collisions
+        for name, mc in self._msts.items():
+            mi = self._mst_code[name]
+            meta["msts"][name] = {
+                "tag_keys": mc.tag_keys,
+                "val_dicts": mc.val_dicts,
+                "n": mc.n,
+            }
+            arrays[f"codes_{mi}"] = mc.codes[:, :mc.n]
+            arrays[f"sids_{mi}"] = mc.sids[:mc.n]
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f, meta=np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8),
+                **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path())
+        self._snap_covered = self._log_size
+
+    def _load_snapshot(self) -> None:
+        with np.load(self._snap_path()) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            self._snap_covered = int(meta["covered"])
+            self._next_sid = int(meta["next_sid"])
+            self._mst_names = list(meta["mst_names"])
+            self._mst_code = {n: i for i, n in
+                              enumerate(self._mst_names)}
+            n = max(self._next_sid, 64)
+            self._sid_mst = np.full(n, -1, dtype=np.int32)
+            self._sid_ord = np.zeros(n, dtype=np.int64)
+            self._sid_mst[:self._next_sid] = z["sid_mst"]
+            self._sid_ord[:self._next_sid] = z["sid_ord"]
+            for name, m in meta["msts"].items():
+                mi = self._mst_code[name]
+                mc = _MstCols(name)
+                mc.tag_keys = list(m["tag_keys"])
+                mc.key_idx = {k: i for i, k in enumerate(mc.tag_keys)}
+                mc.val_dicts = [list(v) for v in m["val_dicts"]]
+                mc.val_codes = [{v: c for c, v in enumerate(vd) if c}
+                                for vd in mc.val_dicts]
+                mc.n = int(m["n"])
+                codes = np.array(z[f"codes_{mi}"], dtype=np.int32)
+                sids = np.array(z[f"sids_{mi}"], dtype=np.int64)
+                cap = max(mc.n, 64)
+                mc.codes = np.zeros((len(mc.tag_keys), cap),
+                                    dtype=np.int32)
+                if mc.n:
+                    mc.codes[:, :mc.n] = codes
+                mc.sids = np.zeros(cap, dtype=np.int64)
+                mc.sids[:mc.n] = sids
+                self._msts[name] = mc
+            # hashed key map restores from the snapshot directly (a
+            # per-series rebuild would cost ~1M string builds + hashes
+            # on open, defeating the snapshot)
+            self._hash_sid = dict(zip(z["hash_keys"].tolist(),
+                                      z["hash_sids"].tolist()))
+            self._collisions = dict(meta.get("collisions", {}))
+
+    def _replay(self, from_off: int = 0) -> None:
         with open(self.path, "rb") as f:
+            if from_off:
+                f.seek(from_off)
             data = f.read()
+        self._log_size = from_off + len(data)
         pos = 0
-        hdr = struct.calcsize("<IQ")
-        while pos + hdr <= len(data):
+        while pos + _HDR <= len(data):
             ln, sid = struct.unpack_from("<IQ", data, pos)
-            pos += hdr
+            pos += _HDR
             if pos + ln > len(data):
-                log.warning("series log truncated at %d; ignoring tail", pos)
+                log.warning("series log truncated at %d; ignoring tail",
+                            from_off + pos)
                 break
             items = bytes(data[pos:pos + ln]).split(b"\x00")
             pos += ln
@@ -98,28 +353,64 @@ class SeriesIndex:
 
     # ---- writes ----------------------------------------------------------
 
+    def _register_key(self, key: str, sid: int) -> None:
+        h = _key_hash(key)
+        cur = self._hash_sid.get(h)
+        if cur is None:
+            self._hash_sid[h] = sid
+        elif cur != sid:
+            self._collisions[key] = sid
+
+    def _lookup_key(self, key: str) -> int | None:
+        sid = self._collisions.get(key)
+        if sid is not None:
+            return sid
+        sid = self._hash_sid.get(_key_hash(key))
+        if sid is None:
+            return None
+        # verify against the reconstruction (hash collisions must not
+        # alias two different series)
+        mi = self._sid_mst[sid] if sid < len(self._sid_mst) else -1
+        if mi < 0:
+            return None
+        mc = self._msts.get(self._mst_names[mi])
+        if mc is None or mc.key_of_ordinal(int(self._sid_ord[sid])) != key:
+            return None
+        return sid
+
     def _insert(self, measurement: str, tags: dict[str, str],
                 sid: int) -> None:
-        key = series_key(measurement, tags)
-        self._key_to_sid[key] = sid
-        while len(self._sid_to_tags) <= sid:
-            self._sid_to_tags.append(None)
-            self._sid_to_mst.append(None)
-        self._sid_to_tags[sid] = tags
-        self._sid_to_mst[sid] = measurement
-        self._mst_sids.setdefault(measurement, []).append(sid)
-        for k, v in tags.items():
-            self._postings.setdefault((measurement, k, v), []).append(sid)
+        mc = self._msts.get(measurement)
+        if mc is None:
+            mc = self._msts[measurement] = _MstCols(measurement)
+            if measurement not in self._mst_code:
+                self._mst_code[measurement] = len(self._mst_names)
+                self._mst_names.append(measurement)
+        o = mc.add(tags, sid)
+        if sid >= len(self._sid_mst):
+            n = max(len(self._sid_mst) * 2, sid + 1)
+            sm = np.full(n, -1, dtype=np.int32)
+            sm[:len(self._sid_mst)] = self._sid_mst
+            self._sid_mst = sm
+            so = np.zeros(n, dtype=np.int64)
+            so[:len(self._sid_ord)] = self._sid_ord
+            self._sid_ord = so
+        self._sid_mst[sid] = self._mst_code[measurement]
+        self._sid_ord[sid] = o
+        self._next_sid = max(self._next_sid, sid + 1)
+        self._register_key(series_key(measurement, tags), sid)
 
     def _drop_in_mem(self, measurement: str) -> None:
-        sids = self._mst_sids.pop(measurement, [])
-        for sid in sids:
-            tags = self._sid_to_tags[sid] or {}
-            self._key_to_sid.pop(series_key(measurement, tags), None)
-            self._sid_to_tags[sid] = None
-            self._sid_to_mst[sid] = None
-        for k in [k for k in self._postings if k[0] == measurement]:
-            del self._postings[k]
+        mc = self._msts.pop(measurement, None)
+        if mc is None:
+            return
+        sids = mc.sids[:mc.n]
+        self._sid_mst[sids] = -1
+        # hash entries verify against _sid_mst, so stale hashes are
+        # harmless; collisions side-dict entries are purged
+        for k in [k for k in self._collisions
+                  if k.startswith(measurement + ",")]:
+            del self._collisions[k]
 
     def drop_measurement(self, measurement: str) -> None:
         """Remove every series of a measurement (DROP MEASUREMENT;
@@ -129,8 +420,9 @@ class SeriesIndex:
             self._drop_in_mem(measurement)
             if self._log is not None:
                 payload = measurement.encode()
-                self._log.write(struct.pack("<IQ", len(payload), 0)
-                                + payload)
+                rec = struct.pack("<IQ", len(payload), 0) + payload
+                self._log.write(rec)
+                self._log_size += len(rec)
                 # fsync: the data files are already gone — losing the
                 # tombstone would resurrect the series in the index
                 self._log.flush()
@@ -140,90 +432,84 @@ class SeriesIndex:
                           tags: dict[str, str]) -> int:
         key = series_key(measurement, tags)
         with self._lock:
-            sid = self._key_to_sid.get(key)
+            sid = self._lookup_key(key)
             if sid is not None:
                 return sid
-            sid = len(self._sid_to_tags)
+            sid = self._next_sid
             self._insert(measurement, tags, sid)
             self._append_log(measurement, tags, sid)
             return sid
 
     def get_sid(self, measurement: str, tags: dict[str, str]) -> int | None:
-        return self._key_to_sid.get(series_key(measurement, tags))
+        with self._lock:
+            return self._lookup_key(series_key(measurement, tags))
 
     # ---- queries ---------------------------------------------------------
 
     @property
     def series_cardinality(self) -> int:
-        return len(self._key_to_sid)
+        with self._lock:
+            return sum(mc.n for mc in self._msts.values())
 
     def series_keys(self, measurement: str | None = None) -> list[str]:
         """All series keys (optionally one measurement's) — callers
         union across shards for exact db-wide cardinality."""
         with self._lock:
-            if measurement is None:
-                return list(self._key_to_sid)
-            prefix = measurement + ","
-            return [k for k in self._key_to_sid
-                    if k.startswith(prefix) or k == measurement]
+            msts = [self._msts[measurement]] \
+                if measurement in self._msts else \
+                ([] if measurement is not None
+                 else list(self._msts.values()))
+            out = []
+            for mc in msts:
+                out.extend(mc.key_of_ordinal(o) for o in range(mc.n))
+            return out
 
     @property
     def max_sid(self) -> int:
-        return len(self._sid_to_tags) - 1
+        return self._next_sid - 1
 
     def measurements(self) -> list[str]:
-        return sorted(self._mst_sids)
+        with self._lock:
+            return sorted(self._msts)
 
     def tags_of(self, sid: int) -> dict[str, str]:
-        return self._sid_to_tags[sid] or {}
+        with self._lock:
+            if sid >= len(self._sid_mst) or self._sid_mst[sid] < 0:
+                return {}
+            mc = self._msts.get(self._mst_names[self._sid_mst[sid]])
+            if mc is None:
+                return {}
+            return mc.tags_of_ordinal(int(self._sid_ord[sid]))
 
     def tag_values(self, measurement: str, key: str) -> list[str]:
-        return sorted({v for (m, k, v) in self._postings
-                       if m == measurement and k == key})
+        with self._lock:
+            mc = self._msts.get(measurement)
+            if mc is None:
+                return []
+            ki = mc.key_idx.get(key)
+            if ki is None:
+                return []
+            # only values actually referenced by a live series
+            used = np.unique(mc.codes[ki, :mc.n])
+            return sorted(mc.val_dicts[ki][c] for c in used if c)
 
     def tag_keys(self, measurement: str) -> list[str]:
-        return sorted({k for (m, k, _v) in self._postings
-                       if m == measurement})
+        with self._lock:
+            mc = self._msts.get(measurement)
+            return sorted(mc.tag_keys) if mc is not None else []
 
     def series_ids(self, measurement: str,
                    filters: list[TagFilter] | None = None) -> np.ndarray:
         """AND of tag predicates → sorted sid array (the reference's
-        tag_filters.go search, simplified to the supported ops)."""
-        import re
+        tag_filters.go search, as one vectorized mask pass)."""
         with self._lock:
-            base = self._mst_sids.get(measurement)
-            if not base:
+            mc = self._msts.get(measurement)
+            if mc is None or mc.n == 0:
                 return np.empty(0, dtype=np.int64)
-            result: set[int] | None = None
-            negatives: list[TagFilter] = []
-            for f in filters or []:
-                if f.op in ("!=", "!~"):
-                    negatives.append(f)
-                    continue
-                if f.op == "=":
-                    sids = set(self._postings.get(
-                        (measurement, f.key, f.value), ()))
-                elif f.op == "=~":
-                    rx = re.compile(f.value)
-                    sids = set()
-                    for (m, k, v), lst in self._postings.items():
-                        if m == measurement and k == f.key and rx.search(v):
-                            sids.update(lst)
-                else:
-                    raise ValueError(f"bad tag filter op {f.op}")
-                result = sids if result is None else (result & sids)
-            if result is None:
-                result = set(base)
-            for f in negatives:
-                if f.op == "!=":
-                    result -= set(self._postings.get(
-                        (measurement, f.key, f.value), ()))
-                else:
-                    rx = re.compile(f.value)
-                    for (m, k, v), lst in self._postings.items():
-                        if m == measurement and k == f.key and rx.search(v):
-                            result -= set(lst)
-            return np.array(sorted(result), dtype=np.int64)
+            mask = mc.filter_mask(filters or [])
+            if mask is None:
+                return np.empty(0, dtype=np.int64)
+            return np.sort(mc.sids[:mc.n][mask])
 
     def group_by_tagsets(self, measurement: str,
                          group_keys: list[str],
@@ -231,16 +517,45 @@ class SeriesIndex:
                          ) -> list[tuple[tuple[str, ...], np.ndarray]]:
         """Partition matching series into tagsets by group_keys (the
         reference's tagset construction, engine/iterators.go:100 'Scan →
-        tagsets'). Returns [(tag values tuple, sorted sid array)], sorted by
-        tag values; series missing a group key get '' for it."""
-        sids = self.series_ids(measurement, filters)
-        groups: dict[tuple[str, ...], list[int]] = {}
-        for sid in sids.tolist():
-            tags = self._sid_to_tags[sid] or {}
-            key = tuple(tags.get(k, "") for k in group_keys)
-            groups.setdefault(key, []).append(sid)
-        return [(k, np.array(v, dtype=np.int64))
-                for k, v in sorted(groups.items())]
+        tagsets'), vectorized: one np.unique over the stacked group-key
+        code rows. Returns [(tag values tuple, sorted sid array)],
+        sorted by tag values; series missing a group key get ''."""
+        with self._lock:
+            mc = self._msts.get(measurement)
+            if mc is None or mc.n == 0:
+                return []
+            mask = mc.filter_mask(filters or [])
+            if mask is None or not mask.any():
+                return []
+            sel = np.nonzero(mask)[0]
+            sids = mc.sids[:mc.n][sel]
+            if not group_keys:
+                return [((), np.sort(sids))]
+            rows = []
+            for k in group_keys:
+                ki = mc.key_idx.get(k)
+                rows.append(mc.codes[ki, :mc.n][sel] if ki is not None
+                            else np.zeros(len(sel), dtype=np.int32))
+            stacked = np.stack(rows)                   # (K, S)
+            order = np.lexsort(stacked[::-1])
+            ss = stacked[:, order]
+            boundary = np.empty(ss.shape[1], dtype=bool)
+            boundary[0] = True
+            if ss.shape[1] > 1:
+                boundary[1:] = (ss[:, 1:] != ss[:, :-1]).any(axis=0)
+            starts = np.nonzero(boundary)[0]
+            ends = np.append(starts[1:], ss.shape[1])
+            out = []
+            sids_sorted = sids[order]
+            for s0, s1 in zip(starts, ends):
+                codes = ss[:, s0]
+                key = tuple(
+                    mc.val_dicts[mc.key_idx[k]][int(c)]
+                    if mc.key_idx.get(k) is not None else ""
+                    for k, c in zip(group_keys, codes))
+                out.append((key, np.sort(sids_sorted[s0:s1])))
+            out.sort(key=lambda kv: kv[0])
+            return out
 
     def group_lut(self, tagsets: list[tuple[tuple[str, ...], np.ndarray]]
                   ) -> np.ndarray:
@@ -255,5 +570,7 @@ class SeriesIndex:
         with self._lock:
             if self._log is not None:
                 self._log.flush()
+                if self._log_size - self._snap_covered > SNAP_THRESHOLD:
+                    self._write_snapshot()
                 self._log.close()
                 self._log = None

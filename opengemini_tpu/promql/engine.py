@@ -215,7 +215,13 @@ class PromEngine:
 
     def _gather(self, vs: VectorSelector, t_min: int, t_max: int):
         """Scan storage: matching series → flat sorted arrays + per-series
-        labels. Returns (labels, values, times, series_row_ids)."""
+        labels. Returns (labels, values, times, series_row_ids).
+
+        Batched: tagset grouping is one vectorized index pass (each
+        distinct label set is a group) and decode goes through the
+        row-store scan plan + pooled segment decode (query/scan.py) —
+        the round-2 per-series read_series loop cost ~170µs/series of
+        pure Python at 1M-series scale."""
         if not vs.name:
             raise PromQLError("selector requires a metric name")
         filters = [TagFilter(m.name, m.value, m.op) for m in vs.matchers]
@@ -225,41 +231,61 @@ class PromEngine:
             return [], np.zeros(0), np.zeros(0, np.int64), np.zeros(
                 0, np.int64)
         shards = db.shards_overlapping(t_min, t_max)
-        # label-set → row list (same series may span shards)
-        by_labels: dict[tuple, list] = {}
+        empty = ([], np.zeros(0), np.zeros(0, np.int64),
+                 np.zeros(0, np.int64))
+        tag_keys: list[str] = sorted(
+            {k for s in shards for k in s.index.tag_keys(vs.name)})
+        global_groups: dict[tuple, int] = {}
+        per_shard = []
         for s in shards:
-            for sid in s.index.series_ids(vs.name, filters).tolist():
-                rec = s.read_series(vs.name, sid, [VALUE_FIELD],
-                                    t_min, t_max)
-                if rec is None or rec.num_rows == 0:
-                    continue
-                col = rec.column(VALUE_FIELD)
-                if col is None or col.values is None:
-                    continue
-                tags = s.index.tags_of(sid)
-                key = tuple(sorted(tags.items()))
-                by_labels.setdefault(key, []).append(
-                    (rec.times, col.values.astype(np.float64), col.valid))
+            ts = s.index.group_by_tagsets(vs.name, tag_keys, filters)
+            pairs = []
+            for key, sids in ts:
+                gi = global_groups.setdefault(key, len(global_groups))
+                pairs.extend((int(sid), gi) for sid in sids)
+            per_shard.append((s, pairs))
+        G = len(global_groups)
+        if G == 0:
+            return empty
+        from ..query.scan import (decode_pool, materialize_scan,
+                                  plan_rowstore_scan)
+        plan = plan_rowstore_scan(per_shard, vs.name, t_min, t_max)
+        if not plan.has_rows:
+            return empty
+        scanres = materialize_scan(
+            plan, vs.name, [VALUE_FIELD], t_min, t_max, 0, 2**62, 1,
+            G, allow_preagg=False, allow_dense=False,
+            pool=decode_pool())
+        got = scanres.fields.get(VALUE_FIELD)
+        if got is None or scanres.n_rows == 0:
+            return empty
+        vals, valid = got
+        times = scanres.times
+        gids = scanres.gids
+        keep = valid
+        vals = vals.astype(np.float64, copy=False)[keep]
+        times = times[keep]
+        gids = gids[keep]
+        # drop label sets with no surviving rows and RENUMBER densely,
+        # labels sorted by label tuple (prom output order); the single
+        # lexsort below establishes the kernel's series-then-time order
+        present = np.zeros(G, dtype=bool)
+        present[gids] = True
+        key_of = [None] * G
+        for key, gi in global_groups.items():
+            key_of[gi] = key
+        order_g = sorted((gi for gi in range(G) if present[gi]),
+                         key=lambda gi: key_of[gi])
+        remap = np.full(G, -1, dtype=np.int64)
         labels = []
-        vparts, tparts, sparts = [], [], []
-        for si, (key, parts) in enumerate(sorted(by_labels.items())):
-            ls = dict(key)
+        for new_gi, gi in enumerate(order_g):
+            remap[gi] = new_gi
+            ls = {k: v for k, v in zip(tag_keys, key_of[gi]) if v}
             ls["__name__"] = vs.name
             labels.append(ls)
-            ts = np.concatenate([p[0] for p in parts])
-            v = np.concatenate([p[1] for p in parts])
-            m = np.concatenate([p[2] for p in parts])
-            order = np.argsort(ts, kind="stable")
-            ts, v, m = ts[order], v[order], m[order]
-            keep = m
-            vparts.append(v[keep])
-            tparts.append(ts[keep])
-            sparts.append(np.full(int(keep.sum()), si, dtype=np.int64))
-        if not labels:
-            return [], np.zeros(0), np.zeros(0, np.int64), np.zeros(
-                0, np.int64)
-        return (labels, np.concatenate(vparts), np.concatenate(tparts),
-                np.concatenate(sparts))
+        gids = remap[gids]
+        order = np.lexsort((times, gids))
+        return (labels, vals[order], times[order], gids[order])
 
     def _window_states(self, vs: VectorSelector, start_ns, end_ns, step_ns,
                        window_ns):
@@ -298,12 +324,32 @@ class PromEngine:
         anchor = values[np.searchsorted(series, np.arange(S))]
         nb = k + (nsteps - 1) * stride
         bucket = (times - origin - 1) // bs
+        # bucketed shapes: row count and series count both pad so the
+        # jit cache recurs across queries/data sizes (an unpadded 1M-
+        # series query would recompile the fused kernel per shape —
+        # measured 15s of XLA compile per distinct S)
+        from ..ops.segment_agg import pad_bucket
+        S_pad = pad_bucket(S, minimum=64)
         seg = np.where((bucket >= 0) & (bucket < nb),
-                       series * nb + bucket, S * nb)
-        st = K.bucket_states(values, np.ones(len(values), bool), times,
-                             seg, series, S * nb, origin_t=origin,
-                             value_anchor=anchor[series])
-        st = K.BucketState(*[np.asarray(x).reshape(S, nb) for x in st])
+                       series * nb + bucket, S_pad * nb)
+        n = len(values)
+        n_pad = pad_bucket(n)
+        valid = np.ones(n_pad, dtype=bool)
+        if n_pad != n:
+            valid[n:] = False
+            pad = n_pad - n
+            values = np.pad(values, (0, pad))
+            times = np.pad(times, (0, pad))
+            series = np.pad(series, (0, pad),
+                            constant_values=S_pad - 1)
+            seg = np.pad(seg, (0, pad), constant_values=S_pad * nb)
+        anchor_rows = np.pad(anchor[series[:n]], (0, n_pad - n)) \
+            if n_pad != n else anchor[series]
+        st = K.bucket_states(values, valid, times, seg, series,
+                             S_pad * nb, origin_t=origin,
+                             value_anchor=anchor_rows)
+        st = K.BucketState(*[np.asarray(x).reshape(S_pad, nb)[:S]
+                             for x in st])
         win = K.fold_windows(st, int(k))
         # slice eval positions: indices k-1, k-1+stride, ...
         sel = (k - 1) + stride * np.arange(nsteps)
@@ -542,6 +588,10 @@ class PromEngine:
             return SeriesMatrix([_absent_labels(vs)], vals, True)
         else:
             vals = np.asarray(K.over_time_value(win, f, anchor))
+        if f in ("last_over_time", "first_over_time"):
+            # upstream keeps the metric name for the value-selecting
+            # *_over_time functions (they return a raw sample)
+            return SeriesMatrix(labels, vals)
         return SeriesMatrix(labels, vals).drop_metric()
 
     def _host_pass(self, vs: VectorSelector, start_ns, end_ns, step_ns,
@@ -633,7 +683,12 @@ class PromEngine:
             return SeriesMatrix(
                 lhs.labels, _vec_op(b.op, lhs.values, rv, b.bool_mode),
                 lhs.metric_dropped)._maybe_drop(b)
-        # vector-vector: one-to-one on full label match (sans __name__)
+        # vector-vector: one-to-one on full label match (sans __name__).
+        # Filtering comparisons (no bool) pass LHS samples through
+        # UNCHANGED, metric name included (upstream semantics);
+        # arithmetic and bool-mode drop the name.
+        keep_name = b.op in ("==", "!=", ">", "<", ">=", "<=") \
+            and not b.bool_mode
         rmap = {_lkey(ls): i for i, ls in enumerate(rhs.labels)}
         labels, rows = [], []
         for i, ls in enumerate(lhs.labels):
@@ -642,11 +697,13 @@ class PromEngine:
                 continue
             rows.append(_vec_op(b.op, lhs.values[i:i+1],
                                 rhs.values[j:j+1], b.bool_mode))
-            labels.append({k: v for k, v in ls.items() if k != "__name__"})
+            labels.append(dict(ls) if keep_name else
+                          {k: v for k, v in ls.items()
+                           if k != "__name__"})
         if not rows:
             nsteps = lhs.values.shape[1] if lhs.values.size else 1
             return SeriesMatrix([], np.zeros((0, nsteps)), True)
-        return SeriesMatrix(labels, np.vstack(rows), True)
+        return SeriesMatrix(labels, np.vstack(rows), not keep_name)
 
 
 with np.errstate(all="ignore"):
@@ -819,7 +876,12 @@ def _fmt(v: float) -> str:
         return "NaN"
     if np.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
-    return repr(float(v))
+    v = float(v)
+    # upstream prints integral floats without the trailing .0 (the
+    # count_values label "300", not "300.0")
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
 
 
 def _scalar_op(op, a, b):

@@ -177,16 +177,22 @@ def plan_rowstore_scan(per_shard, mst: str, t_lo: int | None,
         with s._lock:
             files = list(s._files.get(mst, ()))
         mem_tables = s.mem.tables_for_read()
+        # time-pruned files, chunk metas fetched in ONE batched pass per
+        # file (one vectorized bloom probe + grouped meta loads — the
+        # per-sid Python probe cost ~10µs each at 10^5+ series)
+        live_files = [
+            f for f in files
+            if not (t_lo is not None and f.max_time < t_lo)
+            and not (t_hi is not None and f.min_time > t_hi)]
+        sid_arr = np.fromiter((sid for sid, _g in pairs), dtype=np.int64,
+                              count=len(pairs))
+        metas_by_file = [f.chunk_metas_many(sid_arr) for f in live_files]
         for sid, gid in pairs:
             if ctx is not None:
                 ctx.check()
             sources: list[_ChunkSrc] = []
-            for f in files:
-                if t_lo is not None and f.max_time < t_lo:
-                    continue
-                if t_hi is not None and f.min_time > t_hi:
-                    continue
-                cm = f.chunk_meta(sid)
+            for f, metas in zip(live_files, metas_by_file):
+                cm = metas.get(sid)
                 if cm is None:
                     continue
                 if t_lo is not None and cm.max_time < t_lo:

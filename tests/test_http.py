@@ -142,7 +142,7 @@ def test_prom_api(server):
                     "&step=60")
     body = json.loads(res)
     assert body["data"]["resultType"] == "matrix"
-    assert [v for _t, v in body["data"]["result"][0]["values"]] == ["3.0"] * 5
+    assert [v for _t, v in body["data"]["result"][0]["values"]] == ["3"] * 5
     code, res = req(server, "GET", "/api/v1/labels")
     assert "job" in json.loads(res)["data"]
     code, res = req(server, "GET", "/api/v1/label/__name__/values")

@@ -24,10 +24,12 @@ def test_parse_helpers():
     assert parse_labels('a="x", b="y"') == {"a": "x", "b": "y"}
 
 
-def test_promql_suite_script(tmp_path):
+@pytest.mark.parametrize("script", ["promql_suite.test",
+                                    "promql_suite2.test"])
+def test_promql_suite_script(tmp_path, script):
     eng = Engine(str(tmp_path / "data"))
     runner = PromScriptRunner(eng)
-    with open(os.path.join(HERE, "testdata", "promql_suite.test")) as f:
+    with open(os.path.join(HERE, "testdata", script)) as f:
         runner.run(f.read())
     eng.close()
 

@@ -265,7 +265,7 @@ def test_count_values(prom, tmp_path):
     pe = PromEngine(eng)
     out = pe.query_instant('count_values("v", ver)', 60 * S)
     got = {o["metric"]["v"]: float(o["value"][1]) for o in out}
-    assert got == {"2.0": 3.0, "7.0": 2.0}
+    assert got == {"2": 3.0, "7": 2.0}
     eng.close()
 
 
@@ -409,6 +409,6 @@ def test_count_values_group_collapse(prom, tmp_path):
     eng.write_points("prometheus", rows)
     pe = PromEngine(eng)
     out = pe.query_instant('count_values by (g) ("g", cv)', 60 * S)
-    assert len(out) == 1 and out[0]["metric"] == {"g": "2.0"}
+    assert len(out) == 1 and out[0]["metric"] == {"g": "2"}
     assert float(out[0]["value"][1]) == 2.0
     eng.close()
