@@ -118,6 +118,75 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
     return out
 
 
+CS_HOSTS = int(os.environ.get("OG_BENCH_CS_HOSTS", "2000"))
+CS_HOURS = 1.0
+
+
+def colstore_phase() -> dict:
+    """BASELINE config 3 (high-cpu-all shape): max() across 10 cpu
+    fields on the COLUMN-STORE engine, grouped hourly — exercises
+    storage/colstore.py + sparse-index scan (ColumnStoreReader role).
+    Same-code CPU-vs-TPU ratio is reported by the headline run; this
+    phase reports the columnstore e2e throughput."""
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    from opengemini_tpu.storage.rows import PointRow
+
+    fields = [f"usage_{k}" for k in
+              ("user", "system", "idle", "nice", "iowait", "irq",
+               "softirq", "steal", "guest", "guest_nice")]
+    points = int(CS_HOURS * 3600 / STEP_S)
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory(
+            prefix="og-csbench-",
+            dir="/dev/shm" if os.path.isdir("/dev/shm") else None) as td:
+        eng = Engine(td, EngineOptions(shard_duration=1 << 62))
+        eng.create_columnstore("bench", "cpu", ["hostname"],
+                               {"hostname": "bloom"})
+        t0 = time.perf_counter()
+        rows = []
+        n = 0
+        for h in range(CS_HOSTS):
+            vals = np.round(np.clip(
+                rng.normal(50, 15, (len(fields), points)), 0, 100), 2)
+            host = f"host_{h}"
+            for i in range(points):
+                rows.append(PointRow(
+                    "cpu", {"hostname": host},
+                    {f: float(vals[j, i])
+                     for j, f in enumerate(fields)},
+                    i * STEP_S * 10**9))
+            if len(rows) >= 100_000:
+                n += eng.write_points("bench", rows)
+                rows = []
+        if rows:
+            n += eng.write_points("bench", rows)
+        eng.flush_all()
+        t_ing = time.perf_counter() - t0
+
+        ex = QueryExecutor(eng)
+        sel = ", ".join(f"max({f})" for f in fields)
+        (stmt,) = parse_query(
+            f"SELECT {sel} FROM cpu WHERE time >= 0 AND "
+            f"time < {int(CS_HOURS * 3600)}s GROUP BY time(1h)")
+        res = ex.execute(stmt, "bench")
+        if "error" in res:
+            raise SystemExit(f"colstore query error: {res['error']}")
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = ex.execute(stmt, "bench")
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        cells = sum(len(s["values"]) for s in res.get("series", []))
+        eng.close()
+    return {"metric": "tsbs_high_cpu_all_colstore_rows_per_sec",
+            "value": round(n / best, 1), "unit": "rows/s",
+            "rows": n, "fields": len(fields), "hosts": CS_HOSTS,
+            "ingest_rows_per_sec": round(n / t_ing, 1),
+            "e2e_query_s": round(best, 4), "result_cells": cells}
+
+
 def kernel_micro() -> float:
     """Device-resident dense-kernel throughput (rows/s) — the
     steady-state ceiling when blocks live in the device column cache."""
@@ -204,6 +273,10 @@ def main():
                     f"!= tpu {tpu[key]['digest'][:16]}")
 
         # auxiliary metrics must never cost us the headline line
+        try:
+            print(json.dumps(colstore_phase()))   # BASELINE config 3
+        except Exception as e:
+            print(f"# colstore phase failed: {e}", file=sys.stderr)
         try:
             kernel_rps = kernel_micro()
         except Exception as e:

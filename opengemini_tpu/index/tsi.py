@@ -147,13 +147,29 @@ class _MstCols:
     def key_of_ordinal(self, o: int) -> str:
         return series_key(self.name, self.tags_of_ordinal(o))
 
-    def filter_mask(self, filters: list[TagFilter]) -> np.ndarray | None:
-        """AND of tag predicates → bool mask over ordinals (None =
-        measurement unknown/no rows)."""
+    def expr_mask(self, expr) -> np.ndarray:
+        """Vectorized evaluation of a pure-tag and/or predicate tree
+        (query/condition.py tag_exprs — e.g. h = 'a' OR h = 'b') over
+        the code columns."""
+        op = getattr(expr, "op", None)
+        if op == "and":
+            return self.expr_mask(expr.lhs) & self.expr_mask(expr.rhs)
+        if op == "or":
+            return self.expr_mask(expr.lhs) | self.expr_mask(expr.rhs)
+        tf = TagFilter(expr.lhs.name, expr.rhs.value, op)
+        m = self.filter_mask([tf])
+        return m if m is not None else np.zeros(self.n, dtype=bool)
+
+    def filter_mask(self, filters: list[TagFilter],
+                    tag_exprs: list | None = None) -> np.ndarray | None:
+        """AND of tag predicates (+ pure-tag and/or expression trees) →
+        bool mask over ordinals (None = measurement unknown/no rows)."""
         import re
         if self.n == 0:
             return None
         mask = np.ones(self.n, dtype=bool)
+        for e in tag_exprs or ():
+            mask &= self.expr_mask(e)
         for f in filters or ():
             ki = self.key_idx.get(f.key)
             if ki is None:
@@ -499,21 +515,23 @@ class SeriesIndex:
             return sorted(mc.tag_keys) if mc is not None else []
 
     def series_ids(self, measurement: str,
-                   filters: list[TagFilter] | None = None) -> np.ndarray:
+                   filters: list[TagFilter] | None = None,
+                   tag_exprs: list | None = None) -> np.ndarray:
         """AND of tag predicates → sorted sid array (the reference's
         tag_filters.go search, as one vectorized mask pass)."""
         with self._lock:
             mc = self._msts.get(measurement)
             if mc is None or mc.n == 0:
                 return np.empty(0, dtype=np.int64)
-            mask = mc.filter_mask(filters or [])
+            mask = mc.filter_mask(filters or [], tag_exprs)
             if mask is None:
                 return np.empty(0, dtype=np.int64)
             return np.sort(mc.sids[:mc.n][mask])
 
     def group_by_tagsets(self, measurement: str,
                          group_keys: list[str],
-                         filters: list[TagFilter] | None = None
+                         filters: list[TagFilter] | None = None,
+                         tag_exprs: list | None = None
                          ) -> list[tuple[tuple[str, ...], np.ndarray]]:
         """Partition matching series into tagsets by group_keys (the
         reference's tagset construction, engine/iterators.go:100 'Scan →
@@ -524,7 +542,7 @@ class SeriesIndex:
             mc = self._msts.get(measurement)
             if mc is None or mc.n == 0:
                 return []
-            mask = mc.filter_mask(filters or [])
+            mask = mc.filter_mask(filters or [], tag_exprs)
             if mask is None or not mask.any():
                 return []
             sel = np.nonzero(mask)[0]

@@ -128,7 +128,8 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
     global_groups: dict[tuple, int] = {}
     per_shard = []
     for s in shards:
-        ts = s.index.group_by_tagsets(mst, group_tags, cond.tag_filters)
+        ts = s.index.group_by_tagsets(mst, group_tags, cond.tag_filters,
+                                      cond.tag_exprs)
         pairs = []
         for key, sids in ts:
             gi = global_groups.setdefault(key, len(global_groups))

@@ -22,7 +22,24 @@ class Condition:
         self.t_min = MIN_TIME
         self.t_max = MAX_TIME
         self.tag_filters: list[TagFilter] = []
+        # pure-tag predicate subtrees that are NOT simple AND leaves
+        # (e.g. h = 'a' OR h = 'b'): evaluated vectorized over the
+        # series index's code columns, never as a row residual
+        self.tag_exprs: list = []
         self.residual = None  # field predicate expr or None
+
+    def index_key(self) -> tuple:
+        """Hashable identity for plan caching (tag_exprs are AST trees)."""
+        def fmt(e):
+            if isinstance(e, BinaryExpr):
+                return (e.op, fmt(e.lhs), fmt(e.rhs))
+            if isinstance(e, FieldRef):
+                return ("t", e.name)
+            if isinstance(e, Literal):
+                return ("l", e.value)
+            return ("?", repr(e))
+        return (tuple(self.tag_filters),
+                tuple(fmt(e) for e in self.tag_exprs))
 
     @property
     def has_time_range(self) -> bool:
@@ -80,11 +97,30 @@ def _time_value(e) -> int | None:
     return None
 
 
+def _pure_tag_expr(expr, tag_keys: set[str]) -> bool:
+    """True when every leaf is `tag op 'literal'` (ops =/!=/=~/!~) and
+    every interior node is and/or — evaluable on the series index."""
+    if isinstance(expr, BinaryExpr):
+        if expr.op in ("and", "or"):
+            return _pure_tag_expr(expr.lhs, tag_keys) \
+                and _pure_tag_expr(expr.rhs, tag_keys)
+        if expr.op in ("=", "!=", "=~", "!~"):
+            return (isinstance(expr.lhs, FieldRef)
+                    and expr.lhs.name in tag_keys
+                    and isinstance(expr.rhs, Literal)
+                    and isinstance(expr.rhs.value, str))
+    return False
+
+
 def _walk_and(expr, cond: Condition, residuals: list,
               tag_keys: set[str]) -> None:
     if isinstance(expr, BinaryExpr) and expr.op == "and":
         _walk_and(expr.lhs, cond, residuals, tag_keys)
         _walk_and(expr.rhs, cond, residuals, tag_keys)
+        return
+    if isinstance(expr, BinaryExpr) and expr.op == "or" \
+            and _pure_tag_expr(expr, tag_keys):
+        cond.tag_exprs.append(expr)
         return
     if isinstance(expr, BinaryExpr) and expr.op in ("=", "!=", "<", "<=",
                                                     ">", ">=", "=~", "!~"):

@@ -270,7 +270,10 @@ class QueryExecutor:
                                  DropRPStatement)):
                 return self._rp_stmt(stmt)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
-        except ErrQueryError as e:
+        except (ErrQueryError, GeminiError) as e:
+            # GeminiError covers storage-layer failures too (a cold-tier
+            # S3 outage mid-decode must answer as a query error, not
+            # kill the caller)
             return {"error": str(e)}
 
     def _user_stmt(self, stmt) -> dict:
@@ -392,7 +395,8 @@ class QueryExecutor:
         t_lo = None if cond.t_min == MIN_TIME else cond.t_min
         t_hi = None if cond.t_max == MAX_TIME else cond.t_max
         self.engine.delete_rows(db, mst, t_lo, t_hi,
-                                cond.tag_filters or None)
+                                cond.tag_filters or None,
+                                cond.tag_exprs or None)
         return {}
 
     # ----------------------------------------------------------------- SHOW
@@ -740,23 +744,14 @@ class QueryExecutor:
             cs = classify_select(sel)
         except ErrQueryError as e:
             return {"error": str(e)}
+        from .logical import plan_select
         from .plancache import plan_type
-        interval = sel.group_by_interval()
-        lines = [f"PlanTemplate({plan_type(sel, cs)})",
-                 "HttpSender",
-                 f"  Materialize({', '.join(n for n, _e in cs.outputs)})"]
-        if cs.mode == "agg":
-            aggd = ", ".join(f"{a.func}({a.field})" for a in cs.aggs)
-            win = f" window={interval}ns" if interval else ""
-            lines += [f"    Fill({sel.fill_option})" if interval else
-                      "    Merge",
-                      f"      WindowAggTPU[{aggd}]{win} "
-                      "(segment_aggregate kernel)"]
-        else:
-            lines += ["    Merge",
-                      "      RawScan"]
-        lines += [f"        Reader({sel.from_measurement})",
-                  f"          IndexScan({sel.from_measurement})"]
+        cluster = not hasattr(self.engine, "scan_series")
+        plan, fired = plan_select(sel, cluster=cluster)
+        lines = [f"PlanTemplate({plan_type(sel, cs)})", "HttpSender"]
+        lines += ["  " + ln for ln in plan.render()]
+        if fired:
+            lines.append("optimizer: " + ", ".join(dict.fromkeys(fired)))
         return _series("EXPLAIN", ["QUERY PLAN"], [[ln] for ln in lines])
 
     def _write_into(self, stmt, db: str, res: dict) -> dict:
@@ -913,7 +908,7 @@ class QueryExecutor:
             # batched chunk-meta plan (scan.py — the initGroupCursors /
             # agg_tagset_cursor analog; no per-series Python loop)
             plan_key = (
-                db, mst, tuple(group_tags), tuple(cond.tag_filters),
+                db, mst, tuple(group_tags), cond.index_key(),
                 t_lo, t_hi,
                 tuple((s.serial,
                        tuple(r.serial for r in s._files.get(mst, ())),
@@ -931,7 +926,8 @@ class QueryExecutor:
                 per_shard: list[tuple[object, list[tuple[int, int]]]] = []
                 for s in shards:
                     ts = s.index.group_by_tagsets(mst, group_tags,
-                                                  cond.tag_filters)
+                                                  cond.tag_filters,
+                                                  cond.tag_exprs)
                     pairs = []
                     for key, sids in ts:
                         gi = global_groups.setdefault(
@@ -1848,7 +1844,8 @@ class QueryExecutor:
         else:
             for s in shards:
                 for key, sids in s.index.group_by_tagsets(
-                        mst, group_tags, cond.tag_filters):
+                        mst, group_tags, cond.tag_filters,
+                        cond.tag_exprs):
                     for sid in sids.tolist():
                         if ctx is not None:
                             ctx.check()

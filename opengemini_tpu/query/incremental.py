@@ -56,6 +56,7 @@ def inc_fingerprint(db: str, mst: str, stmt, cond) -> str:
         repr((stmt.order_desc, stmt.limit, stmt.offset, stmt.slimit,
               stmt.soffset)),
         repr(sorted((f.key, f.op, f.value) for f in cond.tag_filters)),
+        repr(cond.index_key()[1]),      # pure-tag OR predicate trees
         repr(cond.residual)])
 
 

@@ -33,11 +33,11 @@ from __future__ import annotations
 import re
 from datetime import datetime, timezone
 
-from .ast import (
-    CreateDownsampleStatement, CreateSubscriptionStatement,
-    DropDownsampleStatement, DropSubscriptionStatement,
-    GrantStatement, RevokeStatement, ShowGrantsStatement,AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
-                  CreateDatabaseStatement, CreateMeasurementStatement,
+from .ast import (AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
+                  CreateDatabaseStatement, CreateDownsampleStatement,
+                  CreateMeasurementStatement, CreateSubscriptionStatement,
+                  DropDownsampleStatement, DropSubscriptionStatement,
+                  GrantStatement, RevokeStatement, ShowGrantsStatement,
                   CreateRPStatement, CreateUserStatement, DeleteStatement,
                   Dimension, DropCQStatement, DropDatabaseStatement,
                   DropMeasurementStatement, DropRPStatement,
@@ -554,6 +554,17 @@ class Parser:
                     stmt.dimensions.append(Dimension(Wildcard()))
                 else:
                     e = self.parse_primary()
+                    if isinstance(e, Call) and e.func == "time" \
+                            and e.args:
+                        iv = getattr(e.args[0], "value", None)
+                        if not isinstance(iv, (int, float)):
+                            raise ParseError(
+                                "GROUP BY time() requires a duration")
+                        if iv <= 0:
+                            # influx rejects zero/negative intervals at
+                            # parse (time dimension must be positive)
+                            raise ParseError(
+                                "GROUP BY time interval must be positive")
                     stmt.dimensions.append(Dimension(e))
                 if not self._op(","):
                     break

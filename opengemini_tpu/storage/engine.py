@@ -306,16 +306,17 @@ class Engine:
 
     def delete_rows(self, db_name: str, mst: str,
                     t_min: int | None = None, t_max: int | None = None,
-                    tag_filters=None) -> int:
+                    tag_filters=None, tag_exprs=None) -> int:
         """DELETE FROM mst [WHERE time/tag predicates] (reference
-        Engine delete path). Returns rows removed."""
+        Engine delete path). tag_exprs are pure-tag and/or predicate
+        trees (h = 'a' OR h = 'b'). Returns rows removed."""
         db = self.database(db_name)
         removed = 0
         for s in db.all_shards():
             s.flush()
             sids = None
-            if tag_filters:
-                sids = s.index.series_ids(mst, tag_filters)
+            if tag_filters or tag_exprs:
+                sids = s.index.series_ids(mst, tag_filters, tag_exprs)
                 if len(sids) == 0:
                     continue
             removed += s.delete_rows(mst, t_min, t_max, sids)

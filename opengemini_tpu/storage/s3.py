@@ -1,0 +1,302 @@
+"""S3-compatible ObjectStore client (role of reference
+lib/fileops/obs_fs.go — the OBS/S3 backend behind the detached/
+hierarchical tier; lib/obs/obs_options.go holds the endpoint/ak/sk
+config).
+
+Pure-stdlib implementation: AWS Signature V4 over urllib, path-style
+addressing (works against AWS, MinIO, Huawei OBS and the bundled mock
+server in tests). Plugs into storage/obs.py's five-method interface, so
+`services/hierarchical.py` and detached TSSP reads work unchanged on a
+real bucket.
+
+Credentials resolve from arguments or the standard environment
+(AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY / AWS_REGION).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from ..utils import get_logger
+from ..utils.errors import GeminiError
+from .obs import ObjectStore
+
+log = get_logger(__name__)
+
+_EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+
+class S3Error(GeminiError):
+    """Cold-tier failure: surfaces as a query error (the executor's
+    GeminiError boundary), not a connection-killing exception."""
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class S3ObjectStore(ObjectStore):
+    """put/get_range/size/delete/list against one bucket (+ optional key
+    prefix) on any S3-compatible endpoint."""
+
+    def __init__(self, endpoint: str, bucket: str,
+                 access_key: str | None = None,
+                 secret_key: str | None = None,
+                 region: str | None = None,
+                 prefix: str = "", timeout_s: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.access_key = access_key \
+            or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key \
+            or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        self.region = region or os.environ.get("AWS_REGION", "us-east-1")
+        self.timeout_s = timeout_s
+        u = urllib.parse.urlparse(self.endpoint)
+        self._host = u.netloc
+
+    # ---- SigV4 -----------------------------------------------------------
+
+    def _auth_headers(self, method: str, canon_uri: str,
+                      canon_query: str, payload_sha: str) -> dict:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        headers = {"host": self._host, "x-amz-date": amz_date,
+                   "x-amz-content-sha256": payload_sha}
+        signed = ";".join(sorted(headers))
+        canon_headers = "".join(f"{k}:{headers[k]}\n"
+                                for k in sorted(headers))
+        creq = "\n".join([method, canon_uri, canon_query, canon_headers,
+                          signed, payload_sha])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+        k = _sign(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _sign(k, self.region)
+        k = _sign(k, "s3")
+        k = _sign(k, "aws4_request")
+        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        out = {"x-amz-date": amz_date,
+               "x-amz-content-sha256": payload_sha,
+               "Authorization":
+                   f"AWS4-HMAC-SHA256 Credential={self.access_key}/"
+                   f"{scope}, SignedHeaders={signed}, Signature={sig}"}
+        return out
+
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _request(self, method: str, key: str | None,
+                 query: dict | None = None, body: bytes = b"",
+                 extra_headers: dict | None = None,
+                 ok=(200, 204, 206)):
+        canon_uri = "/" + urllib.parse.quote(self.bucket, safe="")
+        if key is not None:
+            canon_uri += "/" + urllib.parse.quote(self._key(key),
+                                                  safe="/~")
+        qitems = sorted((query or {}).items())
+        canon_query = "&".join(
+            f"{urllib.parse.quote(str(k), safe='~')}="
+            f"{urllib.parse.quote(str(v), safe='~')}"
+            for k, v in qitems)
+        payload_sha = hashlib.sha256(body).hexdigest() if body \
+            else _EMPTY_SHA
+        url = self.endpoint + canon_uri
+        if canon_query:
+            url += "?" + canon_query
+        headers = self._auth_headers(method, canon_uri, canon_query,
+                                     payload_sha)
+        headers.update(extra_headers or {})
+        req = urllib.request.Request(url, data=body or None,
+                                     method=method, headers=headers)
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code in ok:
+                return e
+            detail = e.read(512).decode(errors="replace")
+            raise S3Error(f"{method} {key or ''}: HTTP {e.code} "
+                          f"{detail}") from None
+        except urllib.error.URLError as e:
+            raise S3Error(f"{method} {key or ''}: {e}") from None
+        if resp.status not in ok:
+            raise S3Error(f"{method} {key or ''}: HTTP {resp.status}")
+        return resp
+
+    # ---- ObjectStore interface ------------------------------------------
+
+    def put_file(self, key: str, path: str) -> None:
+        with open(path, "rb") as f:
+            body = f.read()
+        self._request("PUT", key, body=body)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        resp = self._request(
+            "GET", key,
+            extra_headers={"Range":
+                           f"bytes={offset}-{offset + length - 1}"})
+        data = resp.read()
+        if resp.status == 200 and (offset or len(data) > length):
+            # endpoint/proxy ignored the Range header and sent the
+            # whole object: slice locally rather than decode bytes
+            # from the wrong offset
+            return data[offset:offset + length]
+        return data
+
+    def size(self, key: str) -> int:
+        resp = self._request("HEAD", key)
+        cl = resp.headers.get("Content-Length")
+        if cl is None:
+            raise S3Error(f"HEAD {key}: no Content-Length")
+        return int(cl)
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", key, ok=(200, 204, 404))
+
+    def list(self, prefix: str = "") -> list[str]:
+        """ListObjectsV2 with continuation; returns keys relative to the
+        store prefix."""
+        out: list[str] = []
+        token = None
+        strip = (self.prefix + "/") if self.prefix else ""
+        while True:
+            q = {"list-type": "2", "prefix": self._key(prefix)}
+            if token:
+                q["continuation-token"] = token
+            resp = self._request("GET", None, query=q)
+            root = ET.fromstring(resp.read())
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag.split("}")[0] + "}"
+            for c in root.findall(f"{ns}Contents"):
+                k = c.find(f"{ns}Key").text or ""
+                if strip and k.startswith(strip):
+                    k = k[len(strip):]
+                out.append(k)
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is None or trunc.text != "true":
+                break
+            nt = root.find(f"{ns}NextContinuationToken")
+            if nt is None:
+                break
+            token = nt.text
+        return sorted(out)
+
+
+class MockS3Server:
+    """In-process S3-compatible HTTP server (tests / local dev): PUT,
+    GET (with Range), HEAD, DELETE, ListObjectsV2 with path-style
+    addressing. Verifies nothing about signatures — it stands in for a
+    bucket, not for IAM."""
+
+    def __init__(self, port: int = 0, fail_get_ranges: bool = False):
+        import http.server
+        import threading
+
+        store: dict[str, bytes] = {}
+        self.objects = store
+        self.fail_get_ranges = fail_get_ranges
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _key(self):
+                path = urllib.parse.urlparse(self.path)
+                return urllib.parse.unquote(path.path.lstrip("/")), \
+                    urllib.parse.parse_qs(path.query)
+
+            def do_PUT(self):
+                key, _q = self._key()
+                ln = int(self.headers.get("Content-Length", 0))
+                store[key] = self.rfile.read(ln)
+                self.send_response(200)
+                self.send_header("ETag", '"x"')
+                self.end_headers()
+
+            def do_HEAD(self):
+                key, _q = self._key()
+                if key not in store:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(store[key])))
+                self.end_headers()
+
+            def do_GET(self):
+                key, q = self._key()
+                if "list-type" in q:
+                    prefix = q.get("prefix", [""])[0]
+                    bucket = key.split("/")[0]
+                    keys = sorted(
+                        k for k in store
+                        if k.startswith(bucket + "/")
+                        and k[len(bucket) + 1:].startswith(prefix))
+                    body = ["<ListBucketResult>"]
+                    for k in keys:
+                        body.append(
+                            f"<Contents><Key>{k[len(bucket) + 1:]}"
+                            f"</Key></Contents>")
+                    body.append("<IsTruncated>false</IsTruncated>"
+                                "</ListBucketResult>")
+                    data = "".join(body).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if key not in store:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = store[key]
+                rng = self.headers.get("Range")
+                code = 200
+                if rng and rng.startswith("bytes="):
+                    if outer.fail_get_ranges:
+                        self.send_response(500)
+                        self.end_headers()
+                        return
+                    a, b = rng[6:].split("-")
+                    data = data[int(a):int(b) + 1]
+                    code = 206
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_DELETE(self):
+                key, _q = self._key()
+                store.pop(key, None)
+                self.send_response(204)
+                self.end_headers()
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), H)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "MockS3Server":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

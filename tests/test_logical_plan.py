@@ -1,0 +1,111 @@
+"""Logical plan DAG + heuristic optimizer (query/logical.py — reference
+logic_plan.go node taxonomy + heu_rule.go rules + their consumption by
+EXPLAIN and the cluster exchange decision)."""
+
+from opengemini_tpu.query import parse_query
+from opengemini_tpu.query.logical import (LogicalAggregate,
+                                          LogicalExchange, LogicalJoin,
+                                          LogicalLimit, LogicalMerge,
+                                          LogicalReader, LogicalSubquery,
+                                          build_plan, optimize,
+                                          plan_select)
+
+
+def _plan(q, cluster=False):
+    return plan_select(parse_query(q)[0], cluster=cluster)
+
+
+def _find(plan, cls):
+    return [n for n in plan.walk() if isinstance(n, cls)]
+
+
+def test_agg_pushdown_splits_partial_final():
+    plan, fired = _plan("SELECT mean(v) FROM m GROUP BY time(1m), h",
+                        cluster=True)
+    aggs = _find(plan, LogicalAggregate)
+    assert [a.phase for a in aggs] == ["final", "partial"]
+    assert "agg_pushdown_to_exchange" in fired
+    ex = _find(plan, LogicalExchange)[0]
+    assert ex.payload == "partials" and ex.notes.get("agg_pushdown")
+    # the partial sits BELOW the exchange, the final above the merge
+    merge = _find(plan, LogicalMerge)[0]
+    assert isinstance(merge.children[0], LogicalAggregate)
+    assert merge.children[0].phase == "final"
+
+
+def test_single_node_has_no_exchange():
+    plan, _ = _plan("SELECT mean(v) FROM m GROUP BY time(1m)")
+    assert not _find(plan, LogicalExchange)
+    assert _find(plan, LogicalAggregate)[0].phase == "complete"
+
+
+def test_raw_limit_pushes_to_reader():
+    plan, fired = _plan("SELECT v FROM m LIMIT 3 OFFSET 2", cluster=True)
+    assert "limit_pushdown" in fired
+    rd = _find(plan, LogicalReader)[0]
+    assert rd.notes["limit_hint"] == 5
+    assert _find(plan, LogicalExchange)[0].payload == "raw"
+
+
+def test_agg_blocks_limit_pushdown():
+    plan, _fired = _plan(
+        "SELECT mean(v) FROM m GROUP BY time(1m) LIMIT 3")
+    rd = _find(plan, LogicalReader)[0]
+    assert "limit_hint" not in rd.notes
+    assert _find(plan, LogicalLimit)[0].limit == 3
+
+
+def test_fastpath_annotation():
+    plan, _ = _plan("SELECT sum(v), count(v) FROM m GROUP BY time(1m)")
+    agg = _find(plan, LogicalAggregate)[0]
+    assert agg.notes["fastpath"] == "preagg+dense+block"
+    plan, _ = _plan("SELECT percentile(v, 99) FROM m")
+    assert _find(plan, LogicalAggregate)[0].notes["fastpath"] == "decode"
+
+
+def test_subquery_nests_full_plan():
+    plan, _ = _plan("SELECT max(s) FROM (SELECT sum(v) AS s FROM m "
+                    "GROUP BY h)")
+    sub = _find(plan, LogicalSubquery)[0]
+    inner_aggs = _find(sub.children[0], LogicalAggregate)
+    assert inner_aggs and inner_aggs[0].calls == ["sum(v)"]
+    # three-deep nesting still builds
+    plan, _ = _plan("SELECT min(x) FROM (SELECT max(s) AS x FROM "
+                    "(SELECT sum(v) AS s FROM m GROUP BY h))")
+    assert len(_find(plan, LogicalSubquery)) == 2
+
+
+def test_join_plan():
+    q = ("SELECT a.s, b.s FROM (SELECT sum(v) AS s FROM m1 GROUP BY h) "
+         "AS a FULL JOIN (SELECT sum(v) AS s FROM m2 GROUP BY h) AS b "
+         "ON (a.h = b.h)")
+    plan, _ = _plan(q)
+    j = _find(plan, LogicalJoin)
+    assert j and len(j[0].children) == 2
+
+
+def test_optimize_is_fixpoint():
+    stmt = parse_query("SELECT mean(v) FROM m GROUP BY time(1m)",)[0]
+    plan = build_plan(stmt, cluster=True)
+    p1, f1 = optimize(plan)
+    n_before = len(list(p1.walk()))
+    p2, f2 = optimize(p1)
+    assert len(list(p2.walk())) == n_before   # no runaway growth
+    assert not f2 or all(f in ("preagg_eligibility", "field_prune")
+                         for f in f2) is False or f2 == []
+
+
+def test_explain_renders_plan(tmp_path):
+    from opengemini_tpu.query import QueryExecutor
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("db", parse_lines("m,h=a v=1 1000"))
+    ex = QueryExecutor(eng)
+    res = ex.execute(parse_query(
+        "EXPLAIN SELECT mean(v) FROM m GROUP BY time(1m), h")[0], "db")
+    text = "\n".join(r[0] for r in res["series"][0]["values"])
+    assert "Aggregate(mean(v)" in text
+    assert "IndexScan(m" in text
+    assert "optimizer:" in text
+    eng.close()

@@ -1,0 +1,128 @@
+"""S3-compatible ObjectStore client (storage/s3.py) against the bundled
+mock S3 server: the five-method contract, detached TSSP reads, the
+hierarchical move + cold-tier query path, and failure injection —
+VERDICT r2 missing #4 / next #10 (reference lib/fileops/obs_fs.go,
+engine/immutable/detached_*.go)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.storage.s3 import MockS3Server, S3Error, S3ObjectStore
+
+NS = 10**9
+
+
+@pytest.fixture()
+def s3():
+    srv = MockS3Server().start()
+    store = S3ObjectStore(srv.endpoint, "coldbucket",
+                          access_key="ak", secret_key="sk",
+                          region="us-east-1", prefix="tier")
+    yield srv, store
+    srv.stop()
+
+
+def test_object_contract(tmp_path, s3):
+    _srv, store = s3
+    p = tmp_path / "blob.bin"
+    payload = bytes(range(256)) * 40
+    p.write_bytes(payload)
+    store.put_file("a/b/file1", str(p))
+    store.put_file("a/c/file2", str(p))
+    assert store.size("a/b/file1") == len(payload)
+    assert store.get_range("a/b/file1", 0, 16) == payload[:16]
+    assert store.get_range("a/b/file1", 100, 50) == payload[100:150]
+    assert store.list("a/") == ["a/b/file1", "a/c/file2"]
+    assert store.list("a/b") == ["a/b/file1"]
+    store.delete("a/b/file1")
+    assert store.list("a/") == ["a/c/file2"]
+    store.delete("a/b/file1")          # idempotent
+    with pytest.raises(S3Error):
+        store.size("a/b/file1")
+
+
+def test_hierarchical_move_and_detached_query(tmp_path, s3):
+    """Warm→cold move onto the S3 store; queries keep answering through
+    ranged GETs (no local file)."""
+    import os
+
+    from opengemini_tpu.services.hierarchical import (
+        HierarchicalStorageService)
+    _srv, store = s3
+    eng = Engine(str(tmp_path / "data"),
+                 EngineOptions(shard_duration=3600 * NS))
+    ex = QueryExecutor(eng)
+    rng = np.random.default_rng(4)
+    times = np.arange(300, dtype=np.int64) * (10 * NS)
+    for h in range(4):
+        eng.write_record("cold", "cpu", {"host": f"h{h}"}, times,
+                         {"u": np.round(rng.normal(50, 10, 300), 3)})
+    for s in eng.database("cold").all_shards():
+        s.flush()
+
+    def q(text):
+        return ex.execute(parse_query(text)[0], "cold")
+
+    before = q("SELECT sum(u), count(u) FROM cpu GROUP BY host")
+
+    svc = HierarchicalStorageService(
+        eng, store, cold_after_ns=0, now_ns=lambda: 10**18)
+    res = svc.run_once()
+    assert res["files"] >= 1 and res["shards"] >= 1
+    # local tssp files replaced by .detached markers
+    shard = next(iter(eng.database("cold").all_shards()))
+    local = [f for f in os.listdir(os.path.join(shard.path, "tssp"))
+             if f.endswith(".tssp")]
+    assert local == [], local
+    assert store.list("cold/") != []
+
+    after = q("SELECT sum(u), count(u) FROM cpu GROUP BY host")
+    assert after == before
+    # rewrites (DELETE) pull from cold, write a fresh local file
+    q("DELETE FROM cpu WHERE host = 'h0'")
+    got = q("SELECT count(u) FROM cpu GROUP BY host")
+    assert len(got["series"]) == 3
+    eng.close()
+
+
+def test_detached_read_failure_surfaces(tmp_path, s3):
+    """A cold-tier outage mid-query fails loudly (failpoint analog via
+    the mock server's range-GET kill switch), and recovery works."""
+    srv, store = s3
+    eng = Engine(str(tmp_path / "data"),
+                 EngineOptions(shard_duration=1 << 62))
+    ex = QueryExecutor(eng)
+    n = 200_000          # incompressible → several fetch blocks
+    times = np.arange(n, dtype=np.int64) * (10 * NS)
+    vals = np.random.default_rng(0).random(n)
+    eng.write_record("cold", "cpu", {"host": "a"}, times, {"u": vals})
+    for s in eng.database("cold").all_shards():
+        s.flush()
+        s.detach_files(store, "cold/shard_0")
+
+    def q(text):
+        return ex.execute(parse_query(text)[0], "cold")
+
+    r = q("SELECT count(u) FROM cpu")
+    assert r["series"][0]["values"][0][1] == n
+
+    # sever the cold tier: fresh engine (no caches), ranged GETs fail
+    eng.close()
+    eng2 = Engine(str(tmp_path / "data"),
+                  EngineOptions(shard_duration=1 << 62,
+                                obs_store=store))
+    ex2 = QueryExecutor(eng2)
+    srv.fail_get_ranges = True
+    # metadata-answerable aggregates still work (pre-agg states were
+    # fetched at open); queries that must DECODE data blocks fail loudly
+    r = ex2.execute(parse_query("SELECT count(u) FROM cpu")[0], "cold")
+    assert r["series"][0]["values"][0][1] == n
+    r = ex2.execute(parse_query("SELECT percentile(u, 50) FROM cpu")[0],
+                    "cold")
+    assert "error" in r, r
+    srv.fail_get_ranges = False
+    r = ex2.execute(parse_query("SELECT mean(u) FROM cpu")[0], "cold")
+    assert "series" in r
+    eng2.close()
