@@ -9,17 +9,27 @@ reduces ON DEVICE with only a tiny per-query gid vector uploaded and a
 result grid pulled.
 
 Why this fits the hardware (measured on the axon-attached v5e):
-- H2D ≈ 0.7 GB/s but D2H ≈ 30 MB/s: ship raw data up once, pull only
-  result grids. The dispatcher (executor) uses this path when
-  rows/cells is large enough that the device reduction beats host
-  numpy AND the result grid is small enough to pull.
+- The kernel is a MASKED-PASS reduction: per window, a dense axis
+  reduction over the (blocks × segment) resident planes (pure VPU
+  work, the same mapping as dense_window_aggregate), then ONE tiny
+  scatter of per-block partials onto the (group × window) grid. The
+  round-2 design scattered 12.7M rows flat through segment_sum — 8.2s
+  on the v5e (large unsorted scatters don't tile; int64 scatters hit
+  the 64-bit emulation path); the masked-pass form does the same
+  reduction in 0.125s.
+- Transfers pay ~0.1-0.25s latency EACH on the tunnel-attached chip:
+  every per-cell state packs into ONE f64 plane array per file (same-E
+  files combine on device), window scalars and gid vectors are
+  content-keyed in the device cache, so a warm query uploads nothing
+  and pulls one array.
 - f64 is emulated as float32 pairs: float sums would drift, so the
-  AUTHORITATIVE sums are int32 limb-plane reductions — exact integer
-  arithmetic, bit-identical with every other path. min/max return row
-  INDICES; exact values gather host-side from the readcache.
-- Stacks are SLABBED (OG_BLOCK_SLAB blocks per kernel launch) to bound
-  the scatter temporaries; slab results combine on device and ONE grid
-  crosses D2H.
+  AUTHORITATIVE sums are integer limb-plane reductions (f64-held ints,
+  exact below 2^49) — bit-identical with every other path. Dead limb
+  planes (a 52-bit mantissa spans ≤4 of 6) are trimmed file-wide.
+  min/max return row INDICES; exact values gather host-side from the
+  readcache.
+- Stacks are SLABBED (OG_BLOCK_SLAB blocks per kernel launch); slab
+  results combine on device and ONE grid crosses D2H.
 
 Reference roles covered: lib/readcache/blockcache.go (block cache, HBM
 tier), engine/immutable/reader.go decode + series_agg_func reduce
