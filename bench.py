@@ -252,12 +252,17 @@ def main():
     with tempfile.TemporaryDirectory(prefix="og-bench-", dir=shm) as td:
         n_rows = build_dataset(td)
 
-        # CPU baseline: identical engine/code, JAX pinned to host CPU
+        # CPU baseline: identical engine/code, JAX pinned to host CPU.
+        # PALLAS_AXON_POOL_IPS must be ABSENT: the axon sitecustomize
+        # registers the TPU-tunnel PJRT plugin whenever it is set, even
+        # under JAX_PLATFORMS=cpu, and a concurrent tunnel handshake
+        # can wedge against the parent's live TPU session.
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--phase", "query",
              "--data", td, "--runs", str(args.runs)],
-            capture_output=True, text=True, env=env,
+            capture_output=True, text=True, env=env, timeout=5400,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         if out.returncode != 0:
             raise SystemExit(f"cpu phase failed: {out.stderr[-2000:]}")

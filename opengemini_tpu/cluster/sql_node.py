@@ -19,6 +19,7 @@ from dataclasses import replace
 from ..query.ast import (BinaryExpr, Literal,
                          CreateDatabaseStatement, DeleteStatement,
                          DropDatabaseStatement, DropMeasurementStatement,
+                         DropSeriesStatement, DropShardStatement,
                          FieldRef, SelectField, SelectStatement,
                          ShowStatement)
 from ..query.condition import analyze_condition
@@ -169,7 +170,8 @@ class ClusterExecutor:
             if isinstance(stmt, DropDatabaseStatement):
                 return self._drop_database(stmt.name)
             if isinstance(stmt, (DropMeasurementStatement,
-                                 DeleteStatement)):
+                                 DeleteStatement, DropSeriesStatement,
+                                 DropShardStatement)):
                 return self._ddl(stmt, db)
             return {"error":
                     f"unsupported statement {type(stmt).__name__}"}

@@ -186,6 +186,8 @@ class HttpServer:
         from ..query.ast import (CreateDownsampleStatement,
                                  CreateSubscriptionStatement,
                                  DropDownsampleStatement,
+                                 DropSeriesStatement,
+                                 DropShardStatement,
                                  DropSubscriptionStatement,
                                  GrantStatement, RevokeStatement,
                                  ShowGrantsStatement)
@@ -195,6 +197,7 @@ class HttpServer:
                       DropCQStatement, CreateRPStatement,
                       AlterRPStatement, DropRPStatement,
                       DropDatabaseStatement, DropMeasurementStatement,
+                      DropSeriesStatement, DropShardStatement,
                       DeleteStatement, KillQueryStatement,
                       GrantStatement, RevokeStatement,
                       ShowGrantsStatement, CreateSubscriptionStatement,
@@ -546,6 +549,60 @@ class HttpServer:
                 self._bump("query_errors")
             results.append(res)
         return 200, {"results": results}
+
+    # --------------------------------------------------- flux endpoint
+
+    def handle_flux(self, body: bytes, content_type: str,
+                    user=None) -> tuple[int, dict | None, str | None]:
+        """POST /api/v2/query — Flux pipeline queries (reference
+        flux-read route handler.go:484-496; openGemini's own
+        serveFluxQuery is a "not implementation" stub — here the
+        common subset executes by transpiling onto the SELECT path).
+        Returns (code, json_payload, csv_text): exactly one of the
+        last two is non-None."""
+        from ..query.flux import compile_flux, flux_csv
+        from ..query.influxql import ParseError
+        if not self.config.http.flux_enabled:
+            return 403, {"error":
+                         "Flux query service disabled. Verify "
+                         "flux-enabled=true in the [http] section of "
+                         "the config."}, None
+        if "json" in (content_type or ""):
+            try:
+                doc = json.loads(body.decode("utf-8"))
+            except Exception as e:
+                return 400, {"code": "invalid",
+                             "message": f"bad json body: {e}"}, None
+            qtext = doc.get("query", "")
+        else:
+            qtext = body.decode("utf-8", "replace")
+        if not qtext.strip():
+            return 400, {"code": "invalid",
+                         "message": "missing flux query"}, None
+        self._bump("queries")
+        try:
+            comp = compile_flux(qtext, time.time_ns())
+        except ParseError as e:     # FluxError subclasses ParseError,
+            # and compile_flux ends in parse_query of the generated
+            # InfluxQL — both must answer 400, not kill the connection
+            self._bump("query_errors")
+            return 400, {"code": "invalid", "message": str(e)}, None
+        deny = self._deny_db_access(comp.stmt, user, comp.db)
+        if deny is not None:
+            self._bump("query_errors")
+            return 403, {"code": "forbidden", "message": deny}, None
+        try:
+            res = self.executor.execute(comp.stmt, comp.db)
+        except Exception as e:
+            log.exception("flux execution failed")
+            self._bump("query_errors")
+            return 500, {"code": "internal error",
+                         "message": str(e)}, None
+        if "error" in res:
+            self._bump("query_errors")
+            return 400, {"code": "invalid",
+                         "message": res["error"]}, None
+        return 200, None, flux_csv(res, comp.shape)
 
     # --------------------------------------------------- prom endpoints
 
@@ -1006,6 +1063,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             code, payload = srv.handle_logstore("POST", path,
                                                 self._params(), body)
+            self._reply(code, payload)
+            return
+        if path == "/api/v2/query":
+            try:
+                body = self._body()
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            code, payload, csv_text = srv.handle_flux(
+                body, self.headers.get("Content-Type", ""), user=user)
+            if csv_text is not None:
+                data = csv_text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/csv; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._reply(code, payload)
             return
         if path in ("/api/v1/prom/write", "/api/v1/prom/read"):

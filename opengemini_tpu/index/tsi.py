@@ -360,9 +360,16 @@ class SeriesIndex:
             pos += ln
             measurement = items[0].decode()
             if sid == 0:
-                # drop-measurement tombstone (sids are 1-based, so 0 is
-                # free to mark it)
-                self._drop_in_mem(measurement)
+                # tombstones (sids are 1-based, so 0 is free to mark
+                # them): bare payload drops the measurement; a
+                # __drop_sids__ item drops specific series
+                if len(items) > 1 and \
+                        items[1].startswith(b"__drop_sids__="):
+                    dead = [int(x) for x in
+                            items[1].split(b"=", 1)[1].split(b",") if x]
+                    self._replay_drop_sids(measurement, dead)
+                else:
+                    self._drop_in_mem(measurement)
                 continue
             tags = dict(i.decode().split("=", 1) for i in items[1:])
             self._insert(measurement, tags, sid)
@@ -443,6 +450,61 @@ class SeriesIndex:
                 # tombstone would resurrect the series in the index
                 self._log.flush()
                 os.fsync(self._log.fileno())
+
+    def drop_series(self, measurement: str, sids) -> None:
+        """Remove specific series of a measurement (DROP SERIES;
+        reference tsi DropSeries). The measurement's columnar store is
+        rebuilt with the survivors (a DDL — O(series) is fine), and a
+        sid=0 tombstone with a __drop_sids__ payload makes replay
+        reproduce the drop."""
+        drop = {int(s) for s in np.asarray(sids).tolist()}
+        if not drop:
+            return
+        with self._lock:
+            if not self._replay_drop_sids(measurement, drop):
+                return
+            if self._log is not None:
+                items = [measurement.encode(),
+                         b"__drop_sids__=" + ",".join(
+                             str(s) for s in sorted(drop)).encode()]
+                payload = b"\x00".join(items)
+                rec = struct.pack("<IQ", len(payload), 0) + payload
+                self._log.write(rec)
+                self._log_size += len(rec)
+                self._log.flush()
+                os.fsync(self._log.fileno())
+
+    def _replay_drop_sids(self, measurement: str, drop) -> bool:
+        """In-memory part of drop_series (also the tombstone replay).
+        Returns True if anything was removed."""
+        drop = set(int(s) for s in drop)
+        mc = self._msts.get(measurement)
+        if mc is None:
+            return False
+        dead_keys = []
+        survivors = []
+        for o in range(mc.n):
+            sid = int(mc.sids[o])
+            if sid in drop:
+                dead_keys.append(mc.key_of_ordinal(o))
+            else:
+                survivors.append((mc.tags_of_ordinal(o), sid))
+        if len(survivors) == mc.n:
+            return False                    # nothing matched
+        for sid in drop:
+            if sid < len(self._sid_mst):
+                self._sid_mst[sid] = -1
+        for k in dead_keys:
+            self._collisions.pop(k, None)
+        if survivors:
+            new = _MstCols(measurement)
+            for tags, sid in survivors:
+                o = new.add(tags, sid)
+                self._sid_ord[sid] = o
+            self._msts[measurement] = new
+        else:
+            self._msts.pop(measurement, None)
+        return True
 
     def get_or_create_sid(self, measurement: str,
                           tags: dict[str, str]) -> int:

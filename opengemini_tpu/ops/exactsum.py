@@ -25,13 +25,17 @@ fall back to the ordinary f64 state, flagged inexact.
 Partials with different E rebase by whole-limb shifts (exact integer
 shifts; dropped nonzero low limbs clear the exact flag).
 
-Known limitation: the guarantee covers sum/mean (and count/min/max when
-compared exactly on host). VALUE-returning selectors (first/last, and
-min/max computed through the device path) can lose low mantissa bits on
-platforms that emulate f64 as float32 pairs (axon): a value
-round-tripped through the device carries ~48-bit precision. Follow-up:
-return per-cell row indices from the device and gather exact values on
-host.
+Selector values (first/last/min/max) never round-trip through the
+emulated-f64 device, so they keep full f64 precision everywhere:
+the sparse device path returns ROW INDICES (host_gather in
+query/executor.py) and gathers the exact values host-side; the
+block-resident path ships min/max row-index planes
+(ops/blockagg.py plane_layout) with the same host gather; dense
+groups reduce on host in real IEEE f64 (dense_window_aggregate_host).
+Remaining caveat: the multi-device mesh merge (parallel/meshquery.py)
+carries min/max through pmin/pmax as VALUES — exact on real-f64
+meshes (CPU/GPU/TPU-f64), ~48-bit on f32-pair-emulated single-chip
+setups, where the executor's host-gather paths are used instead.
 
 No counterpart in the reference — it has no reproducible-sum machinery
 (engine/series_agg_reducer.gen.go merges f64 partials directly).

@@ -304,6 +304,21 @@ class DeleteStatement:
 
 
 @dataclass
+class DropSeriesStatement:
+    """DROP SERIES [FROM m] [WHERE tag predicates] — like DELETE but
+    unbounded in time and rejecting time predicates (influx semantics;
+    reference influxql DropSeriesStatement)."""
+    from_measurement: str | None = None
+    condition: object | None = None
+
+
+@dataclass
+class DropShardStatement:
+    """DROP SHARD <id> (id as listed by SHOW SHARDS)."""
+    shard_id: int = 0
+
+
+@dataclass
 class ExplainStatement:
     """EXPLAIN [ANALYZE] SELECT ... (reference executorBuilder.Analyze,
     engine/executor/select.go:248-251)."""

@@ -29,7 +29,8 @@ from .ast import (AlterRPStatement, Call, FieldRef, Literal, SelectField,
                   CreateDatabaseStatement, CreateMeasurementStatement,
                   CreateRPStatement, CreateUserStatement, DropCQStatement,
                   DropDatabaseStatement, DropMeasurementStatement,
-                  DropRPStatement, DropUserStatement, DeleteStatement,
+                  DropRPStatement, DropSeriesStatement,
+                  DropShardStatement, DropUserStatement, DeleteStatement,
                   ExplainStatement, KillQueryStatement,
                   SetPasswordStatement)
 from .condition import MAX_TIME, MIN_TIME, analyze_condition, eval_residual
@@ -246,6 +247,14 @@ class QueryExecutor:
                 res = self._delete(stmt, db)
                 self._drop_plan_cache()
                 return res
+            if isinstance(stmt, DropSeriesStatement):
+                res = self._drop_series(stmt, db)
+                self._drop_plan_cache()
+                return res
+            if isinstance(stmt, DropShardStatement):
+                res = self._drop_shard(stmt, db)
+                self._drop_plan_cache()
+                return res
             if isinstance(stmt, (CreateUserStatement, DropUserStatement,
                                  SetPasswordStatement)):
                 return self._user_stmt(stmt)
@@ -399,6 +408,73 @@ class QueryExecutor:
                                 cond.tag_exprs or None)
         return {}
 
+    def _drop_series(self, stmt: DropSeriesStatement,
+                     db: str | None) -> dict:
+        """DROP SERIES [FROM m] [WHERE tag predicates]: removes matching
+        series (data + index) across all shards; time predicates are
+        rejected as in influx (reference influxql DropSeriesStatement
+        semantics)."""
+        if db is None:
+            return {"error": "database required"}
+        if stmt.from_measurement is None and stmt.condition is None:
+            return {"error": "DROP SERIES requires a FROM and/or "
+                             "WHERE clause"}
+        if db not in self.engine.databases:
+            return {"error": f"database not found: {db}"}
+        db_obj = self.engine.database(db)
+        existing = set(self.engine.measurements(db))
+        is_cs = getattr(db_obj, "is_columnstore", lambda m: False)
+        msts = ([stmt.from_measurement] if stmt.from_measurement
+                else sorted(existing))
+        # validate every target BEFORE mutating anything: a mid-loop
+        # rejection after earlier drops would be an irreversible
+        # partial delete reported as a hard error
+        todo: list[tuple] = []
+        for mst in msts:
+            if mst not in existing:
+                continue
+            if is_cs(mst):
+                return {"error": "DROP SERIES is not supported on "
+                                 "column-store measurements yet"}
+            tag_keys = {k for s in db_obj.all_shards()
+                        for k in s.index.tag_keys(mst)}
+            cond = analyze_condition(stmt.condition, tag_keys)
+            if cond.residual is not None:
+                if not stmt.from_measurement:
+                    # unnamed measurement without the referenced tag
+                    # key: none of its series match — skip (influx
+                    # DROP SERIES semantics), don't error
+                    continue
+                return {"error": "DROP SERIES supports only tag "
+                                 "predicates"}
+            if cond.has_time_range:
+                return {"error": "DROP SERIES doesn't support time in "
+                                 "WHERE clause"}
+            todo.append((mst, cond))
+        for mst, cond in todo:
+            self.engine.delete_rows(db, mst, None, None,
+                                    cond.tag_filters or None,
+                                    cond.tag_exprs or None,
+                                    drop_series=True)
+        return {}
+
+    def _drop_shard(self, stmt: DropShardStatement,
+                    db: str | None) -> dict:
+        """DROP SHARD <id> (ids as listed by SHOW SHARDS): drops the
+        time-group shard's data. Scoped to the request db when given,
+        else applied across all databases (influx shard ids are global;
+        ours are per-db time-group indexes). Unknown ids are a no-op,
+        matching influx."""
+        dbs = [db] if db else list(self.engine.databases)
+        for dbn in dbs:
+            if dbn not in self.engine.databases:
+                continue
+            dbo = self.engine.database(dbn)
+            for s in dbo.all_shards():
+                if s.shard_id == stmt.shard_id:
+                    dbo.drop_shard(s.shard_id)
+        return {}
+
     # ----------------------------------------------------------------- SHOW
 
     def _show(self, stmt: ShowStatement, db: str | None) -> dict:
@@ -542,6 +618,11 @@ class QueryExecutor:
                 keys.update(s.index.series_keys(stmt.from_measurement))
             return _series("series cardinality",
                            ["cardinality estimation"], [[len(keys)]])
+        if stmt.what == "measurement cardinality":
+            eng.database(db)        # missing db → query error
+            return _series("measurement cardinality",
+                           ["cardinality estimation"],
+                           [[len(eng.measurements(db))]])
         if stmt.what == "measurements":
             vals = [[m] for m in eng.measurements(db)]
             return _series("measurements", ["name"], vals)
@@ -556,6 +637,42 @@ class QueryExecutor:
                 if keys:
                     out.append({"name": m, "columns": ["tagKey"],
                                 "values": [[k] for k in keys]})
+            return {"series": out} if out else {}
+        if stmt.what == "tag key cardinality":
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                keys = {k for s in shards for k in s.index.tag_keys(m)}
+                if keys:
+                    out.append({"name": m, "columns": ["count"],
+                                "values": [[len(keys)]]})
+            return {"series": out} if out else {}
+        if stmt.what == "field key cardinality":
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                types: dict = {}
+                for s in shards:
+                    types.update(s._schemas.get(m, {}))
+                if types:
+                    out.append({"name": m, "columns": ["count"],
+                                "values": [[len(types)]]})
+            return {"series": out} if out else {}
+        if stmt.what == "tag values cardinality":
+            if not stmt.key:
+                return {"error": "SHOW TAG VALUES CARDINALITY requires "
+                                 "WITH KEY = <key>"}
+            out = []
+            msts = ([stmt.from_measurement] if stmt.from_measurement
+                    else eng.measurements(db))
+            for m in msts:
+                vals = {v for s in shards
+                        for v in s.index.tag_values(m, stmt.key)}
+                if vals:
+                    out.append({"name": m, "columns": ["count"],
+                                "values": [[len(vals)]]})
             return {"series": out} if out else {}
         if stmt.what == "tag values":
             if not stmt.key:

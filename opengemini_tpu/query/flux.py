@@ -1,0 +1,659 @@
+"""Flux query subset: parser + transpiler onto the native executor.
+
+Role of the reference's flux-read route
+(lib/util/lifted/influx/httpd/handler.go:484-496); openGemini ships
+`serveFluxQuery` as a stub that answers "not implementation"
+(handler.go:1739-1747).  Here the common dashboard pipeline subset is
+actually executed, by lowering Flux to an InfluxQL SELECT — the same
+transpile design the reference uses for PromQL
+(lib/util/lifted/promql2influxql/transpiler.go:43) — so the whole
+TPU-backed scan/aggregate path is reused unchanged.
+
+Supported pipeline stages::
+
+    from(bucket: "db[/rp]")
+    |> range(start: <dur|time|int>, [stop: ...])
+    |> filter(fn: (r) => <predicate>)           # any number, ANDed
+    |> aggregateWindow(every: 1m, fn: mean[, createEmpty: bool]
+                       [, timeSrc: "_start"|"_stop"])
+    |> mean()/sum()/count()/min()/max()/first()/last()  # bare aggregate
+    |> group([columns: ["tag", ...]])
+    |> sort(columns: ["_time"][, desc: true])
+    |> limit(n: N)
+    |> yield([name: "..."])
+
+Filter predicates may test ``r._measurement``, ``r._field``, tag
+columns, and ``r._value`` (single-field pipelines), with
+``== != =~ !~ < <= > >=``, ``and``/``or`` and parentheses.
+
+Results render as Flux annotated CSV (#datatype/#group/#default
+annotations, one table per series per field), matching the v2 API
+shape well enough for flux-speaking clients.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+from dataclasses import dataclass, field
+
+from .influxql import ParseError, parse_query
+
+NS = 1_000_000_000
+_DUR_UNITS = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": NS,
+              "m": 60 * NS, "h": 3600 * NS, "d": 86400 * NS,
+              "w": 7 * 86400 * NS, "mo": 30 * 86400 * NS,
+              "y": 365 * 86400 * NS}
+# aggregateWindow fns we can lower onto the executor's registry
+_AGG_FNS = {"mean", "sum", "count", "min", "max", "first", "last",
+            "median", "mode", "spread", "stddev"}
+
+
+class FluxError(ParseError):
+    """Flux parse/transpile error (subclass so HTTP maps it to 400)."""
+
+
+# ------------------------------------------------------------ tokenizer
+
+_TOKEN_RE = re.compile(r"""
+      (?P<ws>\s+|//[^\n]*)
+    | (?P<string>"(?:\\.|[^"\\])*")
+    | (?P<time>\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(?:\.\d+)?
+               (?:Z|[+-]\d{2}:\d{2})?)
+    | (?P<duration>-?(?:\d+(?:mo|ns|us|ms|[ywdhms]))+)
+    | (?P<number>-?\d+(?:\.\d+)?)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<op>\|>|=>|==|!=|=~|!~|<=|>=|[<>()\[\]{}:,.=])
+""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> list[tuple[str, str, int]]:
+    toks, i = [], 0
+    while i < len(text):
+        m = _TOKEN_RE.match(text, i)
+        if m is None:
+            raise FluxError(f"flux: bad character {text[i]!r} at {i}")
+        if m.lastgroup != "ws":
+            toks.append((m.lastgroup, m.group(), i))
+        i = m.end()
+    return toks
+
+
+# ---------------------------------------------------------------- model
+
+@dataclass
+class _Call:
+    name: str
+    args: dict
+
+
+@dataclass
+class FluxShape:
+    """How to render the executor result as annotated CSV."""
+    start_ns: int = 0
+    stop_ns: int = 0
+    every_ns: int | None = None       # aggregateWindow interval
+    create_empty: bool = True         # aggregateWindow createEmpty
+    time_src: str = "_stop"           # flux aggregateWindow default
+    bare_agg: bool = False            # windowless aggregate: no _time
+    fields: list[str] = field(default_factory=list)
+    result_name: str = "_result"      # |> yield(name:)
+
+
+@dataclass
+class FluxCompiled:
+    db: str
+    rp: str | None
+    influxql: str
+    stmt: object                      # parsed SelectStatement
+    shape: FluxShape
+
+
+# --------------------------------------------------------------- parser
+
+class _Parser:
+    """Recursive-descent over the token list: a pipeline is a `from()`
+    call followed by ``|> stage()`` calls; stage arguments are
+    ``name: value`` pairs where a value may be a scalar, an array, or
+    a single-parameter lambda."""
+
+    def __init__(self, text: str):
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    def _peek(self, k: int = 0):
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else ("eof", "", -1)
+
+    def _next(self):
+        t = self._peek()
+        self.i += 1
+        return t
+
+    def _expect(self, val: str):
+        t = self._next()
+        if t[1] != val:
+            raise FluxError(f"flux: expected {val!r}, got {t[1]!r}")
+        return t
+
+    def pipeline(self) -> list[_Call]:
+        calls = [self._call()]
+        while self._peek()[1] == "|>":
+            self._next()
+            calls.append(self._call())
+        if self._peek()[0] != "eof":
+            raise FluxError(
+                f"flux: trailing input at {self._peek()[1]!r} "
+                "(one pipeline per request)")
+        return calls
+
+    def _call(self) -> _Call:
+        kind, name, _ = self._next()
+        if kind != "ident":
+            raise FluxError(f"flux: expected function name, got {name!r}")
+        self._expect("(")
+        args = {}
+        while self._peek()[1] != ")":
+            k = self._next()
+            if k[0] != "ident":
+                raise FluxError(f"flux: expected argument name in "
+                                f"{name}(), got {k[1]!r}")
+            self._expect(":")
+            args[k[1]] = self._value()
+            if self._peek()[1] == ",":
+                self._next()
+        self._expect(")")
+        return _Call(name, args)
+
+    def _value(self):
+        kind, val, pos = self._peek()
+        if val == "(":                       # lambda (r) => expr
+            return self._lambda()
+        if val == "[":
+            self._next()
+            items = []
+            while self._peek()[1] != "]":
+                items.append(self._value())
+                if self._peek()[1] == ",":
+                    self._next()
+            self._expect("]")
+            return items
+        self._next()
+        if kind == "string":
+            return _unquote(val)
+        if kind == "number":
+            return float(val) if "." in val else int(val)
+        if kind == "duration":
+            return ("dur", _parse_dur(val))
+        if kind == "time":
+            return ("time", _parse_rfc3339(val))
+        if kind == "ident":
+            if val in ("true", "false"):
+                return val == "true"
+            if val == "now" and self._peek()[1] == "(":
+                self._next()
+                self._expect(")")
+                return ("now",)
+            return ("ident", val)
+        raise FluxError(f"flux: unexpected value {val!r} at {pos}")
+
+    # lambda and predicate expressions -----------------------------
+
+    def _lambda(self):
+        self._expect("(")
+        p = self._next()
+        if p[0] != "ident":
+            raise FluxError("flux: lambda parameter expected")
+        self._expect(")")
+        self._expect("=>")
+        return ("fn", p[1], self._or_expr(p[1]))
+
+    def _or_expr(self, rvar):
+        left = self._and_expr(rvar)
+        while self._peek()[1] == "or":
+            self._next()
+            left = ("or", left, self._and_expr(rvar))
+        return left
+
+    def _and_expr(self, rvar):
+        left = self._cmp_expr(rvar)
+        while self._peek()[1] == "and":
+            self._next()
+            left = ("and", left, self._cmp_expr(rvar))
+        return left
+
+    def _cmp_expr(self, rvar):
+        if self._peek()[1] == "(":
+            self._next()
+            inner = self._or_expr(rvar)
+            self._expect(")")
+            return inner
+        if self._peek()[1] == "not":
+            self._next()
+            return ("not", self._cmp_expr(rvar))
+        left = self._operand(rvar)
+        op = self._peek()[1]
+        if op in ("==", "!=", "=~", "!~", "<", "<=", ">", ">="):
+            self._next()
+            return ("cmp", op, left, self._operand(rvar))
+        # bare column reference (truthy boolean field) is not supported
+        raise FluxError(f"flux: expected comparison, got {op!r}")
+
+    def _operand(self, rvar):
+        kind, val, pos = self._peek()
+        if kind == "ident" and val == rvar:
+            self._next()
+            if self._peek()[1] == ".":
+                self._next()
+                col = self._next()
+                if col[0] != "ident":
+                    raise FluxError("flux: column name expected")
+                return ("col", col[1])
+            if self._peek()[1] == "[":
+                self._next()
+                col = self._next()
+                if col[0] != "string":
+                    raise FluxError("flux: r[\"col\"] expects a string")
+                self._expect("]")
+                return ("col", _unquote(col[1]))
+            raise FluxError("flux: expected column access on record")
+        if kind == "string":
+            self._next()
+            return ("lit", _unquote(val))
+        if kind == "number":
+            self._next()
+            return ("lit", float(val) if "." in val else int(val))
+        if kind == "duration":
+            self._next()
+            return ("lit", _parse_dur(val))
+        if kind == "ident" and val in ("true", "false"):
+            self._next()
+            return ("lit", val == "true")
+        raise FluxError(f"flux: unexpected operand {val!r} at {pos}")
+
+
+def _unquote(s: str) -> str:
+    out, i = [], 1
+    while i < len(s) - 1:
+        c = s[i]
+        if c == "\\":
+            i += 1
+            out.append({"n": "\n", "t": "\t", "r": "\r"}.get(s[i], s[i]))
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_dur(s: str) -> int:
+    sign = -1 if s.startswith("-") else 1
+    total = 0
+    for n, u in re.findall(r"(\d+)(mo|ns|us|ms|[ywdhms])", s):
+        total += int(n) * _DUR_UNITS[u]
+    return sign * total
+
+
+def _parse_rfc3339(s: str) -> int:
+    from datetime import datetime, timezone
+    frac_ns = 0
+    m = re.match(r"(.*T\d{2}:\d{2}:\d{2})(\.\d+)?(Z|[+-]\d{2}:\d{2})?$",
+                 s)
+    base, frac, tz = m.group(1), m.group(2), m.group(3)
+    if frac:
+        frac_ns = int(round(float(frac) * NS))
+    dt = datetime.fromisoformat(base + (tz or "").replace("Z", "+00:00"))
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp()) * NS + frac_ns
+
+
+# ----------------------------------------------------------- transpiler
+
+def _time_value(v, now_ns: int) -> int:
+    """range() argument → absolute ns. Ints are unix seconds (flux),
+    durations are now-relative, time literals absolute."""
+    if isinstance(v, tuple):
+        if v[0] == "dur":
+            return now_ns + v[1]
+        if v[0] == "time":
+            return v[1]
+        if v[0] == "now":
+            return now_ns
+        raise FluxError(f"flux: bad time value {v!r}")
+    if isinstance(v, (int, float)):
+        return int(v * NS)
+    raise FluxError(f"flux: bad time value {v!r}")
+
+
+def _quote_ident(name: str) -> str:
+    return '"' + name.replace('"', '\\"') + '"'
+
+
+def _quote_str(v: str) -> str:
+    return "'" + v.replace("\\", "\\\\").replace("'", "\\'") + "'"
+
+
+class _FilterSplit:
+    """Walks ANDed filter lambdas, separating _measurement and _field
+    equality groups from residual tag/value predicates (which lower to
+    the InfluxQL WHERE clause verbatim)."""
+
+    def __init__(self):
+        self.measurements: list[str] = []
+        self.fields: list[str] = []
+        self.residual: list[str] = []     # rendered InfluxQL fragments
+        self._single_field_value_use = False
+
+    def add(self, expr) -> None:
+        for conj in self._conjuncts(expr):
+            cols = set()
+            self._cols(conj, cols)
+            if cols == {"_measurement"}:
+                self.measurements.extend(self._eq_values(conj,
+                                                         "_measurement"))
+            elif cols == {"_field"}:
+                self.fields.extend(self._eq_values(conj, "_field"))
+            else:
+                self.residual.append(self._render(conj))
+
+    @staticmethod
+    def _conjuncts(e):
+        if e[0] == "and":
+            yield from _FilterSplit._conjuncts(e[1])
+            yield from _FilterSplit._conjuncts(e[2])
+        else:
+            yield e
+
+    @staticmethod
+    def _cols(e, out: set) -> None:
+        if e[0] in ("and", "or"):
+            _FilterSplit._cols(e[1], out)
+            _FilterSplit._cols(e[2], out)
+        elif e[0] == "not":
+            _FilterSplit._cols(e[1], out)
+        elif e[0] == "cmp":
+            for side in (e[2], e[3]):
+                if side[0] == "col":
+                    out.add(side[1])
+
+    def _eq_values(self, e, col: str) -> list[str]:
+        """An or-tree of `r.col == "v"` equalities → value list."""
+        if e[0] == "or":
+            return self._eq_values(e[1], col) + self._eq_values(e[2], col)
+        if (e[0] == "cmp" and e[1] == "==" and e[2] == ("col", col)
+                and e[3][0] == "lit" and isinstance(e[3][1], str)):
+            return [e[3][1]]
+        raise FluxError(
+            f"flux: only ==/or equality filters are supported on {col}")
+
+    def _render(self, e) -> str:
+        if e[0] == "and":
+            return f"({self._render(e[1])} AND {self._render(e[2])})"
+        if e[0] == "or":
+            return f"({self._render(e[1])} OR {self._render(e[2])})"
+        if e[0] == "not":
+            inner = e[1]
+            if inner[0] == "cmp":
+                flip = {"==": "!=", "!=": "==", "=~": "!~", "!~": "=~",
+                        "<": ">=", ">=": "<", ">": "<=", "<=": ">"}
+                return self._render(("cmp", flip[inner[1]],
+                                     inner[2], inner[3]))
+            raise FluxError("flux: unsupported not() shape")
+        if e[0] != "cmp":
+            raise FluxError("flux: unsupported predicate")
+        op, left, right = e[1], e[2], e[3]
+        if left[0] != "col":
+            if right[0] == "col":   # literal-first: flip
+                flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
+                return self._render(("cmp", flip.get(op, op),
+                                     right, left))
+            raise FluxError("flux: comparison needs a column side")
+        col = "__value__" if left[1] == "_value" else left[1]
+        if left[1] == "_value":
+            self._single_field_value_use = True
+        lhs = _quote_ident(col)
+        iop = "=" if op == "==" else op       # InfluxQL equality is '='
+        val = right[1] if right[0] == "lit" else None
+        if op in ("=~", "!~"):
+            if not isinstance(val, str):
+                raise FluxError("flux: regex compare needs a string")
+            return f"{lhs} {op} /{val.replace('/', chr(92) + '/')}/"
+        if isinstance(val, str):
+            return f"{lhs} {iop} {_quote_str(val)}"
+        if isinstance(val, bool):
+            return f"{lhs} {iop} {'true' if val else 'false'}"
+        if isinstance(val, (int, float)):
+            return f"{lhs} {iop} {val}"
+        raise FluxError(f"flux: unsupported literal {val!r}")
+
+
+def compile_flux(text: str, now_ns: int) -> FluxCompiled:
+    """Parse one Flux pipeline and lower it to an InfluxQL SELECT."""
+    calls = _Parser(text).pipeline()
+    if not calls or calls[0].name != "from":
+        raise FluxError("flux: pipeline must start with from(bucket:)")
+    bucket = calls[0].args.get("bucket")
+    if not isinstance(bucket, str) or not bucket:
+        raise FluxError("flux: from() requires bucket: \"db[/rp]\"")
+    db, _, rp = bucket.partition("/")
+
+    shape = FluxShape()
+    split = _FilterSplit()
+    window_fn = None
+    bare_fn = None
+    group_mode = "series"             # flux default: group by series key
+    group_cols: list[str] = []
+    limit_n = 0
+    desc = False
+    have_range = False
+
+    for c in calls[1:]:
+        if c.name == "range":
+            if "start" not in c.args:
+                raise FluxError("flux: range() requires start:")
+            shape.start_ns = _time_value(c.args["start"], now_ns)
+            shape.stop_ns = (_time_value(c.args["stop"], now_ns)
+                             if "stop" in c.args else now_ns)
+            have_range = True
+        elif c.name == "filter":
+            fn = c.args.get("fn")
+            if not (isinstance(fn, tuple) and fn[0] == "fn"):
+                raise FluxError("flux: filter() requires fn: (r) => ...")
+            split.add(fn[2])
+        elif c.name == "aggregateWindow":
+            if window_fn or bare_fn:
+                raise FluxError("flux: only one aggregation stage "
+                                "is supported")
+            every = c.args.get("every")
+            if not (isinstance(every, tuple) and every[0] == "dur"):
+                raise FluxError("flux: aggregateWindow(every:) must be "
+                                "a duration")
+            shape.every_ns = every[1]
+            fnv = c.args.get("fn")
+            window_fn = fnv[1] if isinstance(fnv, tuple) \
+                and fnv[0] == "ident" else fnv
+            if window_fn not in _AGG_FNS:
+                raise FluxError(f"flux: unsupported aggregateWindow fn "
+                                f"{window_fn!r}")
+            if c.args.get("createEmpty") is False:
+                shape.create_empty = False
+            ts = c.args.get("timeSrc")
+            if ts in ("_start", "_stop"):
+                shape.time_src = ts
+        elif c.name in _AGG_FNS:
+            if window_fn or bare_fn:
+                raise FluxError("flux: only one aggregation stage "
+                                "is supported")
+            bare_fn = c.name
+            shape.bare_agg = True
+        elif c.name == "group":
+            cols = c.args.get("columns", [])
+            if c.args.get("mode", "by") != "by":
+                raise FluxError("flux: only group(mode: \"by\") "
+                                "is supported")
+            group_cols = [x for x in cols if isinstance(x, str)]
+            group_mode = "by" if group_cols else "none"
+        elif c.name == "sort":
+            cols = c.args.get("columns", ["_value"])
+            if cols != ["_time"]:
+                raise FluxError("flux: sort() supports columns: "
+                                "[\"_time\"] only")
+            desc = bool(c.args.get("desc", False))
+        elif c.name == "limit":
+            n = c.args.get("n")
+            if not isinstance(n, int) or n <= 0:
+                raise FluxError("flux: limit(n:) must be a positive int")
+            limit_n = n
+        elif c.name == "yield":
+            name = c.args.get("name")
+            if isinstance(name, str) and name:
+                shape.result_name = name
+        elif c.name in ("drop", "keep", "rename", "map", "window",
+                        "pivot", "derivative", "distinct"):
+            raise FluxError(f"flux: stage {c.name}() is not supported")
+        else:
+            raise FluxError(f"flux: unknown stage {c.name}()")
+
+    if not have_range:
+        raise FluxError("flux: range() stage is required")
+    if not split.measurements:
+        raise FluxError("flux: a filter on r._measurement is required")
+    fields = list(dict.fromkeys(split.fields))
+    agg = window_fn or bare_fn
+    if agg and not fields:
+        raise FluxError("flux: aggregates require a filter on r._field")
+    if split._single_field_value_use and len(fields) != 1:
+        raise FluxError("flux: _value filters need exactly one _field")
+    shape.fields = fields
+
+    # ---- render the SELECT
+    if agg:
+        sel = ", ".join(f"{agg}({_quote_ident(f)}) AS {_quote_ident(f)}"
+                        for f in fields)
+    elif fields:
+        sel = ", ".join(_quote_ident(f) for f in fields)
+    else:
+        sel = "*"
+    sources = ", ".join(
+        (f"{_quote_ident(rp)}." if rp else "") + _quote_ident(m)
+        for m in dict.fromkeys(split.measurements))
+    where = [f"time >= {shape.start_ns}", f"time < {shape.stop_ns}"]
+    for frag in split.residual:
+        if shape.fields and "__value__" in frag:
+            frag = frag.replace('"__value__"',
+                                _quote_ident(shape.fields[0]))
+        where.append(frag)
+    q = f"SELECT {sel} FROM {sources} WHERE {' AND '.join(where)}"
+    dims = []
+    if window_fn:
+        dims.append(f"time({shape.every_ns}ns)")
+    if agg and group_mode == "series":
+        dims.append("*")
+    elif agg and group_mode == "by":
+        dims.extend(_quote_ident(cg) for cg in group_cols
+                    if not cg.startswith("_"))
+    if dims:
+        q += " GROUP BY " + ", ".join(dims)
+    if window_fn:
+        q += " fill(none)" if not shape.create_empty else " fill(null)"
+    if desc:
+        q += " ORDER BY time DESC"
+    if limit_n:
+        q += f" LIMIT {limit_n}"
+
+    (stmt,) = parse_query(q, now_ns=now_ns)
+    return FluxCompiled(db=db, rp=rp or None, influxql=q, stmt=stmt,
+                        shape=shape)
+
+
+# ---------------------------------------------------------- csv emitter
+
+def _rfc3339(ns: int) -> str:
+    from datetime import datetime, timezone
+    secs, rem = divmod(int(ns), NS)
+    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if rem:
+        base += f".{rem:09d}".rstrip("0")
+    return base + "Z"
+
+
+def _csv_val(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, str):
+        if any(ch in v for ch in ",\"\n\r"):
+            return '"' + v.replace('"', '""') + '"'
+        return v
+    return str(v)
+
+
+def flux_csv(result: dict, shape: FluxShape) -> str:
+    """Executor result → Flux annotated CSV. One output table per
+    (series, field); `table` ids are dense in emission order."""
+    out = io.StringIO()
+    series = result.get("series", [])
+    # stable table order: by tags then field
+    table_id = 0
+    start_s, stop_s = _rfc3339(shape.start_ns), _rfc3339(shape.stop_ns)
+    for s in sorted(series, key=lambda s: sorted(
+            (s.get("tags") or {}).items())):
+        cols = s.get("columns", [])
+        tags = dict(s.get("tags") or {})
+        tagkeys = sorted(tags)
+        has_time = bool(cols) and cols[0] == "time"
+        value_cols = [(i, c) for i, c in enumerate(cols)
+                      if c != "time"]
+        for ci, cname in value_cols:
+            field_name = cname
+            rows = s.get("values", [])
+            dtype = "double"
+            for r in rows:
+                v = r[ci]
+                if v is not None:
+                    if isinstance(v, bool):
+                        dtype = "boolean"
+                    elif isinstance(v, int):
+                        dtype = "long"
+                    elif isinstance(v, str):
+                        dtype = "string"
+                    break
+            time_cols = [] if shape.bare_agg else ["_time"]
+            header = (["result", "table", "_start", "_stop"]
+                      + time_cols + ["_value", "_field", "_measurement"]
+                      + tagkeys)
+            dtypes = (["string", "long", "dateTime:RFC3339",
+                       "dateTime:RFC3339"]
+                      + (["dateTime:RFC3339"] if time_cols else [])
+                      + [dtype, "string", "string"]
+                      + ["string"] * len(tagkeys))
+            groups = (["false", "false", "true", "true"]
+                      + (["false"] if time_cols else [])
+                      + ["false", "true", "true"]
+                      + ["true"] * len(tagkeys))
+            defaults = [shape.result_name] + [""] * (len(header) - 1)
+            out.write("#datatype," + ",".join(dtypes) + "\r\n")
+            out.write("#group," + ",".join(groups) + "\r\n")
+            out.write("#default," + ",".join(defaults) + "\r\n")
+            out.write("," + ",".join(header) + "\r\n")
+            for r in rows:
+                v = r[ci] if ci < len(r) else None
+                if v is None and shape.every_ns is None:
+                    continue
+                cells = ["", "", str(table_id), start_s, stop_s]
+                if time_cols:
+                    t = int(r[0]) if has_time else shape.start_ns
+                    if shape.every_ns and shape.time_src == "_stop":
+                        t += shape.every_ns
+                    cells.append(_rfc3339(t))
+                cells += [_csv_val(v), field_name,
+                          _csv_val(s.get("name", ""))]
+                cells += [_csv_val(tags.get(k, "")) for k in tagkeys]
+                out.write(",".join(cells) + "\r\n")
+            out.write("\r\n")
+            table_id += 1
+    return out.getvalue()

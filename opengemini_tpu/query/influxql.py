@@ -12,8 +12,11 @@ Supported:
       FIELD KEYS / SERIES / QUERIES / USERS / CONTINUOUS QUERIES /
       RETENTION POLICIES / SHARDS / STATS
       [ON db] [FROM m] [WHERE ...] [LIMIT/OFFSET]
+  SHOW MEASUREMENT / SERIES / TAG KEY / FIELD KEY / TAG VALUES
+      CARDINALITY [FROM m] [WITH KEY = k]
   CREATE DATABASE / DROP DATABASE / CREATE MEASUREMENT /
-      DROP MEASUREMENT / DELETE FROM m [WHERE ...]
+      DROP MEASUREMENT / DELETE FROM m [WHERE ...] /
+      DROP SERIES [FROM m] [WHERE tags] / DROP SHARD id
   CREATE USER n WITH PASSWORD 'p' [WITH ALL PRIVILEGES] / DROP USER /
       SET PASSWORD FOR n = 'p'
   CREATE CONTINUOUS QUERY n ON db [RESAMPLE EVERY d] BEGIN sel END /
@@ -41,6 +44,7 @@ from .ast import (AlterRPStatement, BinaryExpr, Call, CreateCQStatement,
                   CreateRPStatement, CreateUserStatement, DeleteStatement,
                   Dimension, DropCQStatement, DropDatabaseStatement,
                   DropMeasurementStatement, DropRPStatement,
+                  DropSeriesStatement, DropShardStatement,
                   DropUserStatement,
                   ExplainStatement, FieldRef, KillQueryStatement, Literal,
                   SelectField, SelectStatement, SetPasswordStatement,
@@ -354,6 +358,15 @@ class Parser:
                     if self._op("."):
                         rp = self._ident()
                 return DropDownsampleStatement(ddb, rp)
+            if self._kw("SERIES"):
+                stmt = DropSeriesStatement()
+                if self._kw("FROM"):
+                    stmt.from_measurement = self._ident()
+                if self._kw("WHERE"):
+                    stmt.condition = self.parse_expr()
+                return stmt
+            if self._kw("SHARD"):
+                return DropShardStatement(self._int_arg("DROP SHARD"))
             self._expect_kw("MEASUREMENT")
             return DropMeasurementStatement(self._ident())
         if u == "ALTER":
@@ -659,6 +672,9 @@ class Parser:
             return ShowStatement("stats")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
+        elif u == "MEASUREMENT":
+            self._expect_kw("CARDINALITY")
+            stmt = ShowStatement("measurement cardinality")
         elif u == "SERIES":
             if self._kw("CARDINALITY"):
                 stmt = ShowStatement("series cardinality")
@@ -666,10 +682,29 @@ class Parser:
                 stmt = ShowStatement("series")
         elif u == "TAG":
             w = self.lx.next()[1].upper()
-            stmt = ShowStatement("tag keys" if w == "KEYS" else "tag values")
+            if w == "KEY":
+                self._expect_kw("CARDINALITY")
+                stmt = ShowStatement("tag key cardinality")
+            elif w == "VALUES" and self._kw("CARDINALITY"):
+                stmt = ShowStatement("tag values cardinality")
+            elif w == "KEYS":
+                stmt = ShowStatement("tag keys")
+            elif w == "VALUES":
+                stmt = ShowStatement("tag values")
+            else:
+                raise ParseError(f"expected KEYS or VALUES after "
+                                 f"SHOW TAG, got {w!r}")
         elif u == "FIELD":
-            self._expect_kw("KEYS")
-            stmt = ShowStatement("field keys")
+            w = self.lx.next()[1].upper()
+            if w == "KEY":
+                self._expect_kw("CARDINALITY")
+                stmt = ShowStatement("field key cardinality")
+            elif w == "KEYS":
+                stmt = ShowStatement("field keys")
+            else:
+                raise ParseError(
+                    f"expected KEYS or KEY CARDINALITY after SHOW "
+                    f"FIELD, got {w!r}")
         elif u == "RETENTION":
             self._expect_kw("POLICIES")
             stmt = ShowStatement("retention policies")
@@ -965,4 +1000,13 @@ def format_statement(stmt) -> str:
         if stmt.condition is not None:
             out += f" WHERE {format_expr(stmt.condition)}"
         return out
+    if isinstance(stmt, DropSeriesStatement):
+        out = "DROP SERIES"
+        if stmt.from_measurement:
+            out += f" FROM {_fmt_ident(stmt.from_measurement)}"
+        if stmt.condition is not None:
+            out += f" WHERE {format_expr(stmt.condition)}"
+        return out
+    if isinstance(stmt, DropShardStatement):
+        return f"DROP SHARD {stmt.shard_id}"
     raise ValueError(f"cannot format statement {type(stmt).__name__}")

@@ -306,10 +306,15 @@ class Engine:
 
     def delete_rows(self, db_name: str, mst: str,
                     t_min: int | None = None, t_max: int | None = None,
-                    tag_filters=None, tag_exprs=None) -> int:
+                    tag_filters=None, tag_exprs=None,
+                    drop_series: bool = False) -> int:
         """DELETE FROM mst [WHERE time/tag predicates] (reference
         Engine delete path). tag_exprs are pure-tag and/or predicate
-        trees (h = 'a' OR h = 'b'). Returns rows removed."""
+        trees (h = 'a' OR h = 'b'). Returns rows removed.
+
+        drop_series=True additionally removes the matched series from
+        each shard's tsi index (DROP SERIES semantics — DELETE keeps
+        the series key visible, DROP SERIES does not)."""
         db = self.database(db_name)
         removed = 0
         for s in db.all_shards():
@@ -320,6 +325,11 @@ class Engine:
                 if len(sids) == 0:
                     continue
             removed += s.delete_rows(mst, t_min, t_max, sids)
+            if drop_series:
+                if sids is None:
+                    s.index.drop_measurement(mst)
+                else:
+                    s.index.drop_series(mst, sids)
         return removed
 
     def close(self) -> None:

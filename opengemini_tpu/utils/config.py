@@ -68,6 +68,7 @@ class HTTPConfig:
     """[http] — reference [http] bind-address, auth, limits."""
     bind_address: str = "127.0.0.1:8086"
     auth_enabled: bool = False
+    flux_enabled: bool = True             # reference: flux-enabled
     max_body_size: int = 100 * 1024 * 1024
     slow_query_threshold_ns: int = 10 * NS
     flight_address: str = ""              # arrow-flight-style ingest

@@ -138,3 +138,87 @@ def test_field_named_drop_survives_restart(db):
     with pytest.raises(Exception):
         eng2.write_points("db0", parse_lines('weird v="s" 2000'))
     eng2.close()
+
+
+# ---------------------------------------------------------- DROP SERIES
+
+def test_drop_series_with_tag_filter(db):
+    eng, ex, _ = db
+    seed(eng)
+    assert q(ex, "DROP SERIES FROM cpu WHERE host = 'h0'") == {}
+    res = q(ex, "SELECT count(v) FROM cpu GROUP BY host")
+    hosts = {s["tags"]["host"] for s in res["series"]}
+    assert hosts == {"h1"}
+    # index cleaned too
+    res = q(ex, "SHOW SERIES CARDINALITY FROM cpu")
+    assert res["series"][0]["values"] == [[1]]
+
+
+def test_drop_series_all_measurements(db):
+    eng, ex, _ = db
+    seed(eng)
+    assert q(ex, "DROP SERIES WHERE host = 'h1'") == {}
+    res = q(ex, "SELECT count(v) FROM cpu GROUP BY host")
+    assert {s["tags"]["host"] for s in res["series"]} == {"h0"}
+    assert "series" in q(ex, "SELECT m FROM mem")   # untagged unaffected
+
+
+def test_drop_series_rejects_time_and_fields(db):
+    eng, ex, _ = db
+    seed(eng)
+    res = q(ex, "DROP SERIES FROM cpu WHERE time > 0")
+    assert "time" in res["error"]
+    res = q(ex, "DROP SERIES FROM cpu WHERE v > 5")
+    assert "error" in res
+
+
+def test_drop_series_survives_restart(db):
+    eng, ex, path = db
+    seed(eng)
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    q(ex, "DROP SERIES FROM cpu WHERE host = 'h0'")
+    eng.close()
+    eng2 = Engine(path)
+    ex2 = QueryExecutor(eng2)
+    res = q(ex2, "SELECT count(v) FROM cpu GROUP BY host")
+    assert {s["tags"]["host"] for s in res["series"]} == {"h1"}
+    eng2.close()
+
+
+# ----------------------------------------------------------- DROP SHARD
+
+def test_drop_shard(db):
+    eng, ex, _ = db
+    WEEK = 7 * 86400 * 10**9
+    write(eng, f"m v=1 1000\nm v=2 {5 * WEEK}")
+    res = q(ex, "SHOW SHARDS")
+    rows = res["series"][0]["values"]
+    assert len(rows) == 2
+    sid = rows[0][0]
+    assert q(ex, f"DROP SHARD {sid}") == {}
+    res = q(ex, "SHOW SHARDS")
+    assert len(res["series"][0]["values"]) == 1
+    res = q(ex, "SELECT v FROM m")
+    vals = [r[1] for s in res["series"] for r in s["values"]]
+    assert vals == [2.0]
+    # unknown id: no-op success (influx semantics)
+    assert q(ex, "DROP SHARD 424242") == {}
+
+
+# ------------------------------------------------- SHOW ... CARDINALITY
+
+def test_show_cardinality_family(db):
+    eng, ex, _ = db
+    seed(eng)
+    res = q(ex, "SHOW MEASUREMENT CARDINALITY")
+    assert res["series"][0]["values"] == [[2]]
+    res = q(ex, "SHOW TAG KEY CARDINALITY FROM cpu")
+    assert res["series"][0] == {"name": "cpu", "columns": ["count"],
+                                "values": [[1]]}
+    res = q(ex, "SHOW FIELD KEY CARDINALITY FROM cpu")
+    assert res["series"][0]["values"] == [[1]]
+    res = q(ex, "SHOW TAG VALUES CARDINALITY FROM cpu WITH KEY = host")
+    assert res["series"][0]["values"] == [[2]]
+    res = q(ex, "SHOW TAG VALUES CARDINALITY FROM cpu")
+    assert "WITH KEY" in res["error"]

@@ -1,0 +1,227 @@
+"""Flux subset: parser/transpiler units + annotated-CSV HTTP round trip
+(reference flux-read route lib/util/lifted/influx/httpd/handler.go:484;
+openGemini's serveFluxQuery stub returns 400 "not implementation" —
+ours executes the common pipeline subset)."""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from opengemini_tpu.http import HttpServer
+from opengemini_tpu.query.flux import (FluxError, compile_flux, flux_csv,
+                                       NS)
+from opengemini_tpu.storage import Engine
+
+NOW = 10_000 * NS
+
+
+# ------------------------------------------------------------ transpile
+
+def test_transpile_aggregate_window():
+    c = compile_flux(
+        'from(bucket: "db0")'
+        ' |> range(start: 0, stop: 3600)'
+        ' |> filter(fn: (r) => r._measurement == "cpu")'
+        ' |> filter(fn: (r) => r._field == "usage_user")'
+        ' |> aggregateWindow(every: 1m, fn: mean)', NOW)
+    assert c.db == "db0" and c.rp is None
+    assert 'mean("usage_user")' in c.influxql
+    assert f"time < {3600 * NS}" in c.influxql
+    assert "GROUP BY time(60000000000ns), *" in c.influxql
+    assert c.shape.every_ns == 60 * NS and c.shape.time_src == "_stop"
+
+
+def test_transpile_relative_range_and_tags():
+    c = compile_flux(
+        'from(bucket: "db0/rp1") |> range(start: -1h)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        '    (r._field == "a" or r._field == "b") and r.host != "h9")'
+        ' |> aggregateWindow(every: 5m, fn: max, createEmpty: false)'
+        ' |> group(columns: ["host"]) |> limit(n: 10)', NOW)
+    assert c.rp == "rp1"
+    assert c.shape.start_ns == NOW - 3600 * NS
+    assert c.shape.stop_ns == NOW
+    assert '"host" != \'h9\'' in c.influxql
+    assert 'max("a") AS "a", max("b") AS "b"' in c.influxql
+    assert 'GROUP BY time(300000000000ns), "host"' in c.influxql
+    assert "fill(none)" in c.influxql
+    assert "LIMIT 10" in c.influxql
+
+
+def test_transpile_bare_agg_and_value_filter():
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        '    r._field == "v" and r._value > 1.5)'
+        ' |> group() |> mean()', NOW)
+    assert c.shape.bare_agg
+    assert '"v" > 1.5' in c.influxql
+    assert "GROUP BY" not in c.influxql
+
+
+def test_transpile_tag_equality_and_regex_slash():
+    # '==' must lower to InfluxQL '=' (the single most common filter);
+    # regex values with '/' must escape for the /.../ literal
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        '    r.host == "h0")', NOW)
+    assert '"host" = \'h0\'' in c.influxql
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        '    r.path =~ "api/v2")', NOW)
+    assert '"path" =~ /api\\/v2/' in c.influxql
+
+
+def test_transpile_regex_and_or_measurements():
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" or'
+        '    r._measurement == "mem")'
+        ' |> filter(fn: (r) => r.host =~ "^h[0-9]$")', NOW)
+    assert 'FROM "cpu", "mem"' in c.influxql
+    assert '"host" =~ /^h[0-9]$/' in c.influxql
+
+
+def test_transpile_errors():
+    with pytest.raises(FluxError):
+        compile_flux('from(bucket: "db0")', NOW)          # no range
+    with pytest.raises(FluxError):
+        compile_flux('range(start: 0)', NOW)              # no from
+    with pytest.raises(FluxError):                        # no measurement
+        compile_flux('from(bucket: "b") |> range(start: 0)'
+                     ' |> mean()', NOW)
+    with pytest.raises(FluxError):                        # agg needs field
+        compile_flux('from(bucket: "b") |> range(start: 0)'
+                     ' |> filter(fn: (r) => r._measurement == "m")'
+                     ' |> mean()', NOW)
+    with pytest.raises(FluxError):                        # unknown stage
+        compile_flux('from(bucket: "b") |> range(start: 0)'
+                     ' |> filter(fn: (r) => r._measurement == "m")'
+                     ' |> pivot(rowKey: ["_time"])', NOW)
+
+
+def test_rfc3339_range():
+    c = compile_flux(
+        'from(bucket: "b") |> range(start: 1970-01-01T00:00:10Z,'
+        ' stop: 1970-01-01T01:00:00Z)'
+        ' |> filter(fn: (r) => r._measurement == "m")', NOW)
+    assert c.shape.start_ns == 10 * NS
+    assert c.shape.stop_ns == 3600 * NS
+
+
+# ------------------------------------------------------------------ csv
+
+def test_flux_csv_shape():
+    from opengemini_tpu.query.flux import FluxShape
+    shape = FluxShape(start_ns=0, stop_ns=120 * NS, every_ns=60 * NS,
+                      fields=["v"])
+    res = {"series": [{"name": "cpu", "tags": {"host": "a"},
+                       "columns": ["time", "v"],
+                       "values": [[0, 1.5], [60 * NS, None]]}]}
+    text = flux_csv(res, shape)
+    lines = text.split("\r\n")
+    assert lines[0].startswith("#datatype,string,long,dateTime:RFC3339")
+    assert lines[3] == (",result,table,_start,_stop,_time,_value,"
+                       "_field,_measurement,host")
+    # timeSrc defaults to _stop: first window's _time = 0 + 1m
+    assert lines[4].split(",")[5] == "1970-01-01T00:01:00Z"
+    assert lines[4].split(",")[6] == "1.5"
+    # createEmpty windows keep their row with empty _value
+    assert lines[5].split(",")[6] == ""
+
+
+# ----------------------------------------------------------------- http
+
+@pytest.fixture
+def server(tmp_path):
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    eng.close()
+
+
+def post(srv, path, body, ctype):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    r = urllib.request.Request(url, data=body.encode(), method="POST",
+                               headers={"Content-Type": ctype})
+    try:
+        resp = urllib.request.urlopen(r, timeout=10)
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+def test_flux_http_roundtrip(server):
+    # h0: t=0 v=0.5, t=120s v=2.5;  h1: t=60s v=1.5, t=180s v=3.5
+    lp = "\n".join(f"cpu,host=h{i % 2} usage={i}.5 {i * 60 * NS}"
+                   for i in range(4))
+    url = f"http://127.0.0.1:{server.port}/write?db=db0"
+    r = urllib.request.Request(url, data=lp.encode(), method="POST")
+    assert urllib.request.urlopen(r, timeout=10).status == 204
+    flux = ('from(bucket: "db0") |> range(start: 0, stop: 240)'
+            ' |> filter(fn: (r) => r._measurement == "cpu" and'
+            ' r._field == "usage")'
+            ' |> aggregateWindow(every: 2m, fn: mean)')
+    code, ctype, body = post(server, "/api/v2/query", flux,
+                             "application/vnd.flux")
+    assert code == 200 and "text/csv" in ctype
+    text = body.decode()
+    assert "#datatype" in text and "_measurement" in text
+    rows = [ln for ln in text.split("\r\n")
+            if ln.startswith(",,")]
+    # 2 hosts x 2 windows
+    assert len(rows) == 4
+    by_host = {}
+    for ln in rows:
+        cells = ln.split(",")
+        by_host.setdefault(cells[-1], []).append(float(cells[6]))
+    # windows [0,2m) and [2m,4m): one point each per host
+    assert by_host["h0"] == [0.5, 2.5]
+    assert by_host["h1"] == [1.5, 3.5]
+
+
+def test_flux_http_json_body_and_errors(server):
+    code, _, body = post(server, "/api/v2/query",
+                         json.dumps({"query": "nonsense("}),
+                         "application/json")
+    assert code == 400
+    assert json.loads(body)["code"] == "invalid"
+    code, _, body = post(server, "/api/v2/query", "", "application/vnd.flux")
+    assert code == 400
+    # a transpile product that fails InfluxQL parsing must still answer
+    # 400 (not a dropped connection)
+    code, _, body = post(
+        server, "/api/v2/query",
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "m" and'
+        ' r.host == 5.5 and r.host < 2)'
+        ' |> group()',
+        "application/vnd.flux")
+    assert code in (200, 400)
+    assert body is not None
+
+
+def test_flux_disabled(tmp_path):
+    from opengemini_tpu.utils.config import Config
+    cfg = Config()
+    cfg.http.flux_enabled = False
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    try:
+        code, _, body = post(srv, "/api/v2/query",
+                             'from(bucket:"b") |> range(start: 0)'
+                             ' |> filter(fn: (r) =>'
+                             ' r._measurement == "m")',
+                             "application/vnd.flux")
+        assert code == 403
+        assert "flux-enabled" in json.loads(body)["error"]
+    finally:
+        srv.stop()
+        eng.close()

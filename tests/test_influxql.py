@@ -145,3 +145,36 @@ def test_parse_errors():
 def test_parse_alias_and_arith():
     (s,) = parse_query("SELECT mean(v) AS avg_v FROM m")
     assert s.fields[0].alias == "avg_v"
+
+
+def test_parse_drop_series_and_shard():
+    from opengemini_tpu.query.ast import (DropSeriesStatement,
+                                          DropShardStatement)
+    from opengemini_tpu.query.influxql import format_statement
+
+    (s,) = parse_query("DROP SERIES FROM cpu WHERE host = 'a'")
+    assert isinstance(s, DropSeriesStatement)
+    assert s.from_measurement == "cpu" and s.condition is not None
+    assert format_statement(s) == \
+        "DROP SERIES FROM cpu WHERE (host = 'a')"
+    (s,) = parse_query("DROP SERIES")
+    assert s.from_measurement is None and s.condition is None
+
+    (s,) = parse_query("DROP SHARD 7")
+    assert isinstance(s, DropShardStatement) and s.shard_id == 7
+    assert format_statement(s) == "DROP SHARD 7"
+    with pytest.raises(ParseError):
+        parse_query("DROP SHARD x")
+
+
+def test_parse_show_cardinality_family():
+    for text, what in [
+            ("SHOW MEASUREMENT CARDINALITY", "measurement cardinality"),
+            ("SHOW TAG KEY CARDINALITY", "tag key cardinality"),
+            ("SHOW FIELD KEY CARDINALITY", "field key cardinality"),
+            ("SHOW TAG VALUES CARDINALITY WITH KEY = host",
+             "tag values cardinality"),
+            ("SHOW TAG VALUES WITH KEY = host", "tag values"),
+            ("SHOW FIELD KEYS", "field keys")]:
+        (s,) = parse_query(text)
+        assert s.what == what, text

@@ -550,6 +550,81 @@ SUITE = [
              ok(series("m", ["time", "mean"], [[0, 2.0]]))),
         ],
     },
+    {
+        "name": "non negative derivative and difference",
+        "writes": f"m v=5 0\nm v=3 {MIN}\nm v=9 {2 * MIN}",
+        "queries": [
+            # per-second rate: (9-3)/60 = 0.1; the negative step drops
+            ("SELECT non_negative_derivative(v) FROM m",
+             ok(series("m", ["time", "non_negative_derivative"],
+                       [[2 * MIN, 0.1]]))),
+            ("SELECT non_negative_difference(v) FROM m",
+             ok(series("m", ["time", "non_negative_difference"],
+                       [[2 * MIN, 6.0]]))),
+            ("SELECT derivative(v, 60s) FROM m",
+             ok(series("m", ["time", "derivative"],
+                       [[MIN, -2.0], [2 * MIN, 6.0]]))),
+        ],
+    },
+    {
+        "name": "cumulative sum over raw points",
+        "writes": "m v=1 1000\nm v=2 2000\nm v=3 3000",
+        "queries": [
+            ("SELECT cumulative_sum(v) FROM m",
+             ok(series("m", ["time", "cumulative_sum"],
+                       [[1000, 1.0], [2000, 3.0], [3000, 6.0]]))),
+        ],
+    },
+    {
+        "name": "pow and log2 math",
+        "writes": "m v=8 1000",
+        "queries": [
+            ("SELECT pow(v, 2) FROM m",
+             ok(series("m", ["time", "pow"], [[1000, 64.0]]))),
+            ("SELECT log2(v) FROM m",
+             ok(series("m", ["time", "log2"], [[1000, 3.0]]))),
+            ("SELECT abs(v - 10) FROM m",
+             ok(series("m", ["time", "abs"], [[1000, 2.0]]))),
+        ],
+    },
+    {
+        "name": "sample returns all points when n exceeds count",
+        "writes": "m v=1 1000\nm v=2 2000",
+        "queries": [
+            ("SELECT sample(v, 5) FROM m",
+             ok(series("m", ["time", "sample"],
+                       [[1000, 1.0], [2000, 2.0]]))),
+        ],
+    },
+    {
+        "name": "quoted measurement with space",
+        "writes": "disk\\ io v=1.5 1000",
+        "queries": [
+            ('SELECT v FROM "disk io"',
+             ok(series("disk io", ["time", "v"], [[1000, 1.5]]))),
+        ],
+    },
+    {
+        "name": "aggregate of aggregate subquery",
+        "writes": f"m v=2 0\nm v=4 {MIN // 2}\nm v=6 {MIN}",
+        "queries": [
+            # sole selector: the row carries the max point's time
+            ("SELECT max(mv) FROM (SELECT mean(v) AS mv FROM m WHERE "
+             "time >= 0 AND time < 2m GROUP BY time(1m))",
+             ok(series("m", ["time", "max"], [[MIN, 6.0]]))),
+        ],
+    },
+    {
+        "name": "select into writes result rows",
+        "writes": "m v=1 1000\nm v=3 2000",
+        "single_only": True,
+        "queries": [
+            ("SELECT mean(v) INTO dst FROM m",
+             ok(series("result", ["time", "written"], [[0, 1]]))),
+            ("SELECT mean FROM dst",
+             ok(series("dst", ["time", "mean"], [[0, 2.0]]))),
+        ],
+    },
 ]
 
 
