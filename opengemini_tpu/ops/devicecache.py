@@ -82,12 +82,27 @@ class DeviceBlockCache:
 
 
 _CACHE: DeviceBlockCache | None = None
+_HOST_CACHE: DeviceBlockCache | None = None
 
 
 def capacity_bytes() -> int:
-    # v5e HBM is 16 GiB; stacks + dense pins get a healthy share by
+    # v5e HBM is 16 GiB; device block stacks get a healthy share by
     # default (the engine's host memory is not charged here)
     return int(os.environ.get("OG_DEVICE_CACHE_MB", "6144")) * _MB
+
+
+def host_capacity_bytes() -> int:
+    # separate budget for HOST-side pins (assembled dense blocks, limb
+    # sums, result grids — numpy arrays in host RAM). Sharing the HBM
+    # budget made the 1h query's device stacks evict the 1m query's
+    # host pins and vice versa: LRU thrash, every warm run recomputing
+    # decompose+reduce (measured 2x on the TSBS 1m shape).
+    # OG_DEVICE_CACHE_MB=0 stays the global kill switch: a deployment
+    # that disabled caching for memory headroom must not silently gain
+    # 4 GiB of host pins.
+    if not enabled():
+        return 0
+    return int(os.environ.get("OG_HOST_CACHE_MB", "4096")) * _MB
 
 
 def enabled() -> bool:
@@ -99,3 +114,10 @@ def global_cache() -> DeviceBlockCache:
     if _CACHE is None:
         _CACHE = DeviceBlockCache(capacity_bytes())
     return _CACHE
+
+
+def host_cache() -> DeviceBlockCache:
+    global _HOST_CACHE
+    if _HOST_CACHE is None:
+        _HOST_CACHE = DeviceBlockCache(host_capacity_bytes())
+    return _HOST_CACHE

@@ -1260,8 +1260,11 @@ class QueryExecutor:
             # device block cache probe: a hit means the assembled dense
             # blocks live in HBM — scan skips decode/assembly for them
             from ..ops import devicecache
-            dcache = (devicecache.global_cache()
-                      if devicecache.enabled() else None)
+            # HOST-side pin cache (assembled dense blocks, limb sums,
+            # result grids): its own budget, NOT the HBM one — see
+            # devicecache.host_capacity_bytes
+            dcache = (devicecache.host_cache()
+                      if devicecache.host_capacity_bytes() > 0 else None)
             dense_pins: dict[str, dict] = {}
 
             def _dense_cached(fp, P):
@@ -1796,8 +1799,25 @@ class QueryExecutor:
                     lg[:G * W] += np.asarray(limbs)
                     ixg[:G * W] |= np.asarray(ix)
                 for cells, S, (dl, dbad) in dense_exact.get(fname, ()):
-                    np.add.at(lg, cells, np.asarray(dl)[:S])
-                    np.logical_or.at(ixg, cells, np.asarray(dbad)[:S])
+                    nlg = lg.shape[0]
+                    if S < nlg // 8:
+                        # few rows into a big grid: touch only S cells
+                        np.add.at(lg, cells, np.asarray(dl)[:S])
+                        np.logical_or.at(ixg, cells,
+                                         np.asarray(dbad)[:S])
+                        continue
+                    # large scatters: bincount ≫ np.add.at; limb sums
+                    # are exact integers < 2^49 held in f64, so f64
+                    # bincount accumulation stays exact
+                    dla = np.asarray(dl)[:S].astype(np.float64)
+                    for k in range(K_LIMBS):
+                        lg[:, k] += np.bincount(
+                            cells, weights=dla[:, k],
+                            minlength=nlg)[:nlg]
+                    ixg |= np.bincount(
+                        cells,
+                        weights=np.asarray(dbad)[:S].astype(np.float64),
+                        minlength=nlg)[:nlg] > 0
                 e_final = exact_scales.get(fname, 0)
                 items = (pg or {}).get("limb_items", ())
                 blocks_l = [(st_blk.E if isinstance(st_blk, _BlockMeta)

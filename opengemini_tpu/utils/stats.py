@@ -181,11 +181,16 @@ def executor_collector():
 
 
 def devicecache_collector():
-    """Device block cache metrics (readcache analog, HBM tier)."""
+    """Device block cache metrics (readcache analog, HBM tier) plus
+    the host-side pin cache — flattened: the pusher's line-protocol
+    writer drops non-scalar fields."""
     from ..ops import devicecache
     if not devicecache.enabled():
         return {"enabled": 0}
-    return devicecache.global_cache().stats()
+    out = devicecache.global_cache().stats()
+    for k, v in devicecache.host_cache().stats().items():
+        out[f"host_{k}"] = v
+    return out
 
 
 def compaction_collector():

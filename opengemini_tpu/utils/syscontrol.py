@@ -49,8 +49,11 @@ class SysControl:
                 self.compaction_enabled = self._flag(params)
                 return 200, {"compaction": self.compaction_enabled}
             if mod == "purgecache":
+                from ..ops import devicecache
                 from ..storage import readcache
                 readcache.global_cache().purge()
+                devicecache.global_cache().purge()
+                devicecache.host_cache().purge()
                 return 200, {"purgecache": "done"}
             if mod == "verbose":
                 self.verbose = self._flag(params)

@@ -343,7 +343,9 @@ def test_device_block_cache_repeat_query(db, monkeypatch):
     import re
     import opengemini_tpu.ops.devicecache as dc
     monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
     monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
     eng, ex = db
     vals = seed_regular(eng, hosts=2)
     text = ("SELECT mean(usage), sum(usage) FROM cpu WHERE time >= 0 "
@@ -354,7 +356,8 @@ def test_device_block_cache_repeat_query(db, monkeypatch):
     assert m and int(m.group(1)) > 0
     r2 = q(ex, text)
     assert r1 == r2
-    st = dc.global_cache().stats()
+    # dense pins live in the HOST cache (own budget, not the HBM one)
+    st = dc.host_cache().stats()
     assert st["hits"] > 0 and st["entries"] > 0
     # exactness preserved through the cached path
     for s in r2["series"]:
@@ -386,7 +389,9 @@ def test_device_cache_different_field_not_poisoned(db, monkeypatch):
     must NOT satisfy a later query over field s."""
     import opengemini_tpu.ops.devicecache as dc
     monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
     monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
     eng, ex = db
     lines = []
     for i in range(128):
