@@ -124,6 +124,21 @@ def host_limbs(values: np.ndarray, valid: np.ndarray | None, E: int):
 _JITTED: dict = {}
 
 
+def exact_segment_sum_traced(limbs_i32, seg_ids, num_segments: int,
+                             sorted_ids: bool):
+    """Traceable body of the device limb reduction — the bit-identical
+    invariant lives HERE, shared by the jitted single-field path below
+    and the vmapped multi-field kernel (segment_agg._multi_segment_jit)
+    so the two can never drift apart."""
+    import jax
+    import jax.numpy as jnp
+    ns = num_segments + 1
+    sums = jax.ops.segment_sum(limbs_i32.astype(jnp.int64),
+                               seg_ids, ns,
+                               indices_are_sorted=sorted_ids)
+    return sums[:num_segments]
+
+
 def exact_segment_sum(limbs_i32, seg_ids, num_segments: int,
                       sorted_ids: bool = False):
     """Device sparse path: int64 segment sums of host-decomposed int32
@@ -132,17 +147,10 @@ def exact_segment_sum(limbs_i32, seg_ids, num_segments: int,
     fn = _JITTED.get("seg")
     if fn is None:
         import jax
-        import jax.numpy as jnp
 
-        @functools.partial(jax.jit,
-                           static_argnames=("num_segments", "sorted_ids"))
-        def _f(limbs_i32, seg_ids, num_segments, sorted_ids):
-            ns = num_segments + 1
-            sums = jax.ops.segment_sum(limbs_i32.astype(jnp.int64),
-                                       seg_ids, ns,
-                                       indices_are_sorted=sorted_ids)
-            return sums[:num_segments]
-        _JITTED["seg"] = fn = _f
+        _JITTED["seg"] = fn = functools.partial(
+            jax.jit, static_argnames=("num_segments", "sorted_ids"))(
+                exact_segment_sum_traced)
     return fn(limbs_i32, seg_ids, num_segments=num_segments,
               sorted_ids=sorted_ids)
 

@@ -80,6 +80,12 @@ BLOCK_MAX_CELLS = int(
 BLOCK_MIN_RATIO = int(
     __import__("os").environ.get("OG_BLOCK_MIN_RATIO", "16"))
 
+# multi-field device queries stack their inputs and upload ONCE per
+# kind (per-transfer latency dominates on remote-attached chips); the
+# stacks are host copies, so cap them to avoid doubling a huge scan
+BATCH_UPLOAD_BYTES = int(
+    __import__("os").environ.get("OG_BATCH_UPLOAD_MB", "512")) * (1 << 20)
+
 # reproducible (bit-identical) f64 sums via binned integer limbs
 # (ops/exactsum.py) — the north star's bit-identical guarantee. Costs
 # ~6 extra fused reduction passes; OG_EXACT_SUM=0 disables.
@@ -967,7 +973,8 @@ class QueryExecutor:
         per-cell top-N (mergeable — engine/topn_linkedlist.go analog).
         """
         from ..ops import AggSpec, segment_aggregate, pad_bucket
-        from ..ops.segment_agg import pad_rows, segment_aggregate_host
+        from ..ops.segment_agg import (SegmentAggResult, pad_rows,
+                                       segment_aggregate_host)
         from .scan import (PREAGG_STATES, decode_pool, materialize_scan,
                            plan_rowstore_scan)
 
@@ -1373,6 +1380,9 @@ class QueryExecutor:
         field_results: dict[str, object] = {}
         field_types: dict[str, DataType] = {}
         raw_slices: dict[str, dict] = {}
+        # pass 1 output: per-field host-side prep (dtype choice,
+        # padding, limb planes) — device inputs upload in one batch
+        field_prep: dict[str, dict] = {}
         # reproducible sums: per-field limb states (ops/exactsum.py),
         # computed only when an output reads the sum state
         exact_on = EXACT_SUM and spec.sum and any(
@@ -1472,10 +1482,102 @@ class QueryExecutor:
                                 np.abs(np.where(dm, dv, 0.0))))
                             mx = max(mx, mg)
                 exact_scales[fname] = exactsum.pick_scale(mx)
+            # references only — padded copies and limb planes are
+            # materialized lazily (pass 2a right before stacking, or
+            # pass 2b one field at a time) so peak host memory never
+            # holds every field's prep simultaneously
+            field_prep[fname] = {"vals": vals, "valid": valid,
+                                 "ftype": ftype,
+                                 "field_exact": field_exact}
+
+        # host_gather: selector fields come back as ROW INDICES and the
+        # exact values gather host-side (emulated-f64 platforms lose
+        # low mantissa bits on value round-trips)
+        gather = bool(spec.first or spec.last or spec.min or spec.max)
+
+        # ---- pass 2a: multi-field device batch. On remote-attached
+        # chips every jit call and every pull pays a full round trip
+        # (~100-300ms measured) — a 10-field query reduced field-by-
+        # field pays ~20 launches; batched it pays one launch and two
+        # pulls per dtype group. Stacks are host copies, so very large
+        # scans fall back to the per-field path.
+        multi_done: set[str] = set()
+        if not use_host and len(field_prep) > 1:
+            from ..ops import exactsum as _ex
+            # projected from SHAPES — nothing is materialized yet, so
+            # the cap really does bound peak memory (the stacks below
+            # are the first copies)
+            total_b = sum(
+                npad * (8 + 1)
+                + (npad * (_ex.K_LIMBS * 4 + 1)
+                   if q["field_exact"] else 0)
+                for q in field_prep.values())
+            if total_b <= BATCH_UPLOAD_BYTES:
+                from ..ops.segment_agg import multi_segment_aggregate
+                by_dt: dict[str, list] = {}
+                for fn2, q in field_prep.items():
+                    by_dt.setdefault(str(q["vals"].dtype),
+                                     []).append(fn2)
+                for names in by_dt.values():
+                    pads = {}
+                    for f in names:
+                        q = field_prep[f]
+                        pads[f] = pad_rows([q["vals"], q["valid"]],
+                                           npad, seg_fill=0)
+                    vstack = np.stack([pads[f][0] for f in names])
+                    mstack = np.stack([pads[f][1] for f in names])
+                    lstack = None
+                    bads = {}
+                    if all(field_prep[f]["field_exact"]
+                           for f in names):
+                        limb_list = []
+                        for f in names:
+                            li, bad = _ex.host_limbs(
+                                pads[f][0], pads[f][1],
+                                exact_scales[f])
+                            limb_list.append(li)
+                            bads[f] = bad
+                        lstack = np.stack(limb_list)
+                        limb_list = None
+                    if not gather:
+                        # padded values only needed for selector
+                        # host-gather — drop the copies otherwise
+                        pads = {f: (None, None) for f in names}
+                    mres, lsums = multi_segment_aggregate(
+                        vstack, mstack, lstack, seg_p, times_p,
+                        num_segments, spec, sorted_ids=seg_sorted,
+                        host_gather=gather)
+                    vstack = mstack = lstack = None
+                    for i, f in enumerate(names):
+                        field_results[f] = SegmentAggResult(
+                            **{k: (None if getattr(mres, k) is None
+                                   else getattr(mres, k)[i])
+                               for k in SegmentAggResult._fields})
+                        if gather:
+                            sel_results[f] = pads[f][0]
+                        if lsums is not None:
+                            exact_results[f] = (
+                                lsums[i],
+                                _ex.segment_bad_flags(
+                                    bads[f], seg_p, num_segments))
+                        field_types[f] = field_prep[f]["ftype"]
+                        multi_done.add(f)
+
+        # ---- pass 2b: per-field reductions (host path, single-field
+        # device queries, and the over-budget fallback)
+        for fname, p in field_prep.items():
+            vals, valid = p["vals"], p["valid"]
+            field_exact = p["field_exact"]
+            if fname in multi_done:
+                if fname in raw_fields:
+                    raw_slices[fname] = _collect_raw_slices(
+                        seg, vals, valid, times, G, W)
+                continue
             if use_host:
                 res = segment_aggregate_host(vals, valid, seg, times,
                                              num_segments, spec)
                 if field_exact:
+                    from ..ops import exactsum
                     exact_results[fname] = \
                         exactsum.exact_segment_sum_host(
                             vals, valid, seg, num_segments,
@@ -1483,20 +1585,17 @@ class QueryExecutor:
             else:
                 vals_p, valid_p = pad_rows([vals, valid], npad,
                                            seg_fill=0)
-                # host_gather: selector fields come back as ROW INDICES
-                # and the exact values gather host-side (emulated-f64
-                # platforms lose low mantissa bits on value round-trips)
-                gather = bool(spec.first or spec.last or spec.min
-                              or spec.max)
-                res = segment_aggregate(vals_p, valid_p, seg_p, times_p,
+                res = segment_aggregate(vals_p, valid_p,
+                                        seg_p, times_p,
                                         num_segments, spec,
                                         sorted_ids=seg_sorted,
                                         host_gather=gather)
                 if gather:
                     sel_results[fname] = vals_p
                 if field_exact:
-                    # decompose on HOST (real f64 — exact), reduce in
-                    # int64 on device (exact integer adds)
+                    from ..ops import exactsum
+                    # decompose on HOST (real f64 — exact); the device
+                    # reduces the planes in int64 (exact integer adds)
                     limbs_i32, bad = exactsum.host_limbs(
                         vals_p, valid_p, exact_scales[fname])
                     exact_results[fname] = (
@@ -1506,10 +1605,11 @@ class QueryExecutor:
                         exactsum.segment_bad_flags(bad, seg_p,
                                                    num_segments))
             field_results[fname] = res
-            field_types[fname] = ftype
+            field_types[fname] = p["ftype"]
             if fname in raw_fields:
                 raw_slices[fname] = _collect_raw_slices(
                     seg, vals, valid, times, G, W)
+        _batch_pull_results(field_results, exact_results)
         # dense groups: (S, P) axis reductions, results scattered into
         # the state grids host-side (S is tiny — N/P)
         dense_out: dict[str, list] = {}
@@ -2425,6 +2525,53 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
 
 
 # -------------------------------------------------------------- finalize
+
+def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
+    """Replace device-resident result leaves with host numpy using ONE
+    D2H transfer per (dtype, shape) group: on the tunnel-attached chip
+    every pull pays ~0.1-0.25s latency, so leaf COUNT dominates (a
+    10-field colstore max() paid 20 sequential pulls = 0.66s; batched
+    it is 2). Device arrays of the same dtype+shape stack on device
+    (one eager op) and cross once."""
+    dev_leaves: list[tuple[tuple, object]] = []
+    for fname, res in field_results.items():
+        if not hasattr(res, "_fields"):
+            continue
+        for k in res._fields:
+            v = getattr(res, k)
+            if v is not None and not isinstance(v, np.ndarray) \
+                    and hasattr(v, "dtype"):
+                dev_leaves.append((("f", fname, k), v))
+    for fname, er in exact_results.items():
+        v = er[0]
+        if not isinstance(v, np.ndarray) and hasattr(v, "dtype"):
+            dev_leaves.append((("e", fname), v))
+    if not dev_leaves:
+        return
+    import jax.numpy as jnp
+    groups: dict[tuple, list] = {}
+    for ref, v in dev_leaves:
+        groups.setdefault((str(v.dtype), tuple(v.shape)),
+                          []).append((ref, v))
+    pulled: dict[tuple, np.ndarray] = {}
+    for kvs in groups.values():
+        if len(kvs) == 1:
+            pulled[kvs[0][0]] = np.asarray(kvs[0][1])
+        else:
+            arr = np.asarray(jnp.stack([v for _r, v in kvs]))
+            for i, (ref, _v) in enumerate(kvs):
+                pulled[ref] = arr[i]
+    for fname, res in list(field_results.items()):
+        if not hasattr(res, "_fields"):
+            continue
+        rep = {k: pulled[("f", fname, k)] for k in res._fields
+               if ("f", fname, k) in pulled}
+        if rep:
+            field_results[fname] = res._replace(**rep)
+    for fname, er in list(exact_results.items()):
+        if ("e", fname) in pulled:
+            exact_results[fname] = (pulled[("e", fname)], er[1])
+
 
 def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
                       ) -> dict:
