@@ -349,6 +349,32 @@ class ClusterExecutor:
             self.meta.refresh()
             if self.meta.database(db) is None:
                 return {"error": f"database not found: {db}"}
+        # cardinality over the cluster: counts cannot merge by union —
+        # scatter the LISTING form, dedup keys globally, then count
+        # (exact, like the single-node path; reference SHOW ...
+        # CARDINALITY exact mode)
+        card_src = {"series cardinality": "series",
+                    "measurement cardinality": "measurements",
+                    "tag key cardinality": "tag keys",
+                    "tag values cardinality": "tag values",
+                    "field key cardinality": "field keys"}
+        if stmt.what in card_src:
+            inner = replace(stmt, what=card_src[stmt.what],
+                            limit=0, offset=0)
+            res = self._show(inner, db)
+            if "error" in res:
+                return res
+            sers = res.get("series", [])
+            if stmt.what in ("series cardinality",
+                             "measurement cardinality"):
+                n = sum(len(s["values"]) for s in sers)
+                return {"series": [{
+                    "name": stmt.what,
+                    "columns": ["cardinality estimation"],
+                    "values": [[n]]}]}
+            out = [{"name": s["name"], "columns": ["count"],
+                    "values": [[len(s["values"])]]} for s in sers]
+            return {"series": out} if out else {}
         # ship without LIMIT/OFFSET — they apply once, after the union
         q = format_statement(replace(stmt, limit=0, offset=0))
         resps = self._scatter("store.show", db, {"q": q})

@@ -26,8 +26,38 @@ from ..index import TagFilter
 from ..utils import get_logger
 from ..ops import prom as K
 from .parser import (Aggregation, BinaryOp, FuncCall, Matcher, NumberLit,
-                     PromParseError, StringLit, VectorSelector,
+                     PromParseError, StringLit, Subquery, VectorSelector,
                      RANGE_FUNCS, parse_promql)
+
+# subquery default resolution when [range:] omits the step — upstream
+# promqltest's default evaluation interval
+DEFAULT_SUBQUERY_STEP_NS = 60 * 10**9
+
+
+def _pin_at_anchors(expr, start_ns: int, end_ns: int) -> None:
+    """Resolve `@ start()` / `@ end()` anchors against the TOP-LEVEL
+    query range, in place, before evaluation (upstream semantics: the
+    anchors always mean the outer query bounds, even on selectors
+    nested inside subqueries, whose inner evaluation runs on its own
+    sample grid)."""
+    if isinstance(expr, (VectorSelector, Subquery)):
+        if expr.at_anchor == "start":
+            expr.at_ns, expr.at_anchor = start_ns, None
+        elif expr.at_anchor == "end":
+            expr.at_ns, expr.at_anchor = end_ns, None
+        if isinstance(expr, Subquery):
+            _pin_at_anchors(expr.expr, start_ns, end_ns)
+        return
+    if isinstance(expr, FuncCall):
+        for a in expr.args:
+            _pin_at_anchors(a, start_ns, end_ns)
+    elif isinstance(expr, Aggregation):
+        _pin_at_anchors(expr.expr, start_ns, end_ns)
+        if expr.param is not None:
+            _pin_at_anchors(expr.param, start_ns, end_ns)
+    elif isinstance(expr, BinaryOp):
+        _pin_at_anchors(expr.lhs, start_ns, end_ns)
+        _pin_at_anchors(expr.rhs, start_ns, end_ns)
 
 log = get_logger(__name__)
 
@@ -71,6 +101,7 @@ class PromEngine:
                       lookback_ns: int = DEFAULT_LOOKBACK_NS) -> list[dict]:
         """Returns prom API 'vector' result list."""
         expr = parse_promql(text)
+        _pin_at_anchors(expr, t_ns, t_ns)
         res = self._eval(expr, t_ns, t_ns, 10**9, lookback_ns)
         if isinstance(res, ScalarSteps):
             res = float(res.values[-1])
@@ -93,6 +124,7 @@ class PromEngine:
         nsteps = int((end_ns - start_ns) // step_ns) + 1
         if nsteps > 11000:
             raise PromQLError("exceeded maximum resolution of 11,000 points")
+        _pin_at_anchors(expr, start_ns, end_ns)
         res = self._eval(expr, start_ns, end_ns, step_ns, lookback_ns)
         ts = [(start_ns + i * step_ns) / 1e9 for i in range(nsteps)]
         if isinstance(res, float):
@@ -179,6 +211,9 @@ class PromEngine:
         if isinstance(expr, StringLit):
             raise PromQLError("string literal is not a valid expression "
                               "result")
+        if isinstance(expr, Subquery):
+            raise PromQLError(
+                "subquery result must be wrapped in a range function")
         if isinstance(expr, VectorSelector):
             if expr.range_ns:
                 raise PromQLError(
@@ -212,6 +247,43 @@ class PromEngine:
         raise PromQLError(f"unsupported expression {type(expr).__name__}")
 
     # ---- selectors -------------------------------------------------------
+
+    def _subquery_samples(self, sq: Subquery, t_lo: int, t_hi: int,
+                          lookback_ns: int = DEFAULT_LOOKBACK_NS):
+        """Evaluate a subquery's inner expression on its own step grid
+        and flatten the result into the same (labels, values, times,
+        series_row_ids) shape `_gather` produces — everything
+        downstream (bucket fold, rate extrapolation, host passes) is
+        source-agnostic. Sample times sit on absolute multiples of the
+        subquery step (upstream alignment semantics)."""
+        sub_step = sq.step_ns or DEFAULT_SUBQUERY_STEP_NS
+        first = -(-t_lo // sub_step) * sub_step          # ceil
+        last = (t_hi // sub_step) * sub_step
+        empty = ([], np.zeros(0), np.zeros(0, np.int64),
+                 np.zeros(0, np.int64))
+        if last < first:
+            return empty
+        inner = self._eval(sq.expr, first, last, sub_step, lookback_ns)
+        if isinstance(inner, (float, ScalarSteps)):
+            raise PromQLError("subquery requires an instant-vector "
+                              "inner expression")
+        if not inner.labels:
+            return empty
+        vm = np.asarray(inner.values, dtype=np.float64)
+        m = vm.shape[1]
+        tgrid = first + sub_step * np.arange(m, dtype=np.int64)
+        present = ~np.isnan(vm)
+        # drop series with no samples in range (downstream anchors
+        # index the first sample of every series)
+        keep = present.any(axis=1)
+        if not keep.any():
+            return empty
+        vm = vm[keep]
+        present = present[keep]
+        labels = [ls for ls, k in zip(inner.labels, keep) if k]
+        sidx, col = np.nonzero(present)        # row-major: sorted by
+        return (labels, vm[sidx, col],         # (series, time)
+                tgrid[col], sidx.astype(np.int64))
 
     def _gather(self, vs: VectorSelector, t_min: int, t_max: int):
         """Scan storage: matching series → flat sorted arrays + per-series
@@ -288,10 +360,25 @@ class PromEngine:
         return (labels, vals[order], times[order], gids[order])
 
     def _window_states(self, vs: VectorSelector, start_ns, end_ns, step_ns,
-                       window_ns):
+                       window_ns, lookback_ns=DEFAULT_LOOKBACK_NS):
         """Shared selector machinery: (labels, BucketState (S, nsteps),
         window_end_times (nsteps,)). Window = (t_i - window, t_i]."""
         nsteps = int((end_ns - start_ns) // step_ns) + 1
+        if vs.at_ns is not None:
+            # @-pinned selector: ONE evaluation at the pinned time,
+            # tiled across the query grid. Pinning here (not at the
+            # function level) keeps sibling scalar arguments on the
+            # outer grid.
+            from dataclasses import replace as _rep
+            labels, win, ends, origin, anchor = self._window_states(
+                _rep(vs, at_ns=None), vs.at_ns, vs.at_ns, step_ns,
+                window_ns, lookback_ns)
+            if win is None or nsteps == 1:
+                return labels, win, ends, origin, anchor
+            win = K.BucketState(*[np.repeat(np.asarray(x), nsteps,
+                                            axis=1) for x in win])
+            return (labels, win, np.repeat(ends, nsteps, axis=1),
+                    origin, anchor)
         off = vs.offset_ns
         if nsteps == 1:
             # single eval point: one bucket of exactly the window width
@@ -314,7 +401,11 @@ class PromEngine:
         origin = start_ns - off - (k * bs)
         t_lo = origin + 1
         t_hi = end_ns - off
-        labels, values, times, series = self._gather(vs, t_lo, t_hi)
+        if isinstance(vs, Subquery):
+            labels, values, times, series = self._subquery_samples(
+                vs, t_lo, t_hi, lookback_ns)
+        else:
+            labels, values, times, series = self._gather(vs, t_lo, t_hi)
         S = len(labels)
         if S == 0:
             return [], None, None, origin, None
@@ -361,6 +452,7 @@ class PromEngine:
 
     def _eval_selector_instant(self, vs, start_ns, end_ns, step_ns,
                                lookback_ns) -> SeriesMatrix:
+        # @-pinning happens inside _window_states (selector level)
         labels, win, _ends, _origin, _anchor = self._window_states(
             vs, start_ns, end_ns, step_ns, lookback_ns)
         if win is None:
@@ -551,19 +643,22 @@ class PromEngine:
             if len(fc.args) != 1:
                 raise PromQLError(f"{f}() expects a range vector selector")
             vs = fc.args[0]
-        if not isinstance(vs, VectorSelector) or not vs.range_ns:
+        if not isinstance(vs, (VectorSelector, Subquery)) \
+                or not vs.range_ns:
             raise PromQLError(f"{f}() expects a range like {f}(x[5m])")
 
         if f in ("irate", "idelta"):
-            labels, vals = self._irate(vs, start_ns, end_ns, step_ns, f)
+            labels, vals = self._irate(vs, start_ns, end_ns, step_ns, f,
+                                       lookback_ns)
             return SeriesMatrix(labels, vals).drop_metric()
         if f == "quantile_over_time":
             labels, vals = self._quantile_over_time(
-                vs, q_row, start_ns, end_ns, step_ns, nsteps)
+                vs, q_row, start_ns, end_ns, step_ns, nsteps,
+                lookback_ns)
             return SeriesMatrix(labels, vals).drop_metric()
 
         labels, win, ends, origin, anchor = self._window_states(
-            vs, start_ns, end_ns, step_ns, vs.range_ns)
+            vs, start_ns, end_ns, step_ns, vs.range_ns, lookback_ns)
         if win is None:
             if f == "absent_over_time":
                 return SeriesMatrix([_absent_labels(vs)],
@@ -595,15 +690,34 @@ class PromEngine:
         return SeriesMatrix(labels, vals).drop_metric()
 
     def _host_pass(self, vs: VectorSelector, start_ns, end_ns, step_ns,
-                   nsteps):
+                   nsteps, lookback_ns=DEFAULT_LOOKBACK_NS):
         """Raw gather + per-step window masks, for functions whose state
         is not monoid-able into fixed-size buckets (irate's last-two
         samples, exact window quantiles). Window = (t_i - range, t_i],
         offset-adjusted. Returns (labels, values, times, series, masks)
         where masks yields (step index, row mask)."""
+        if vs.at_ns is not None:
+            # @-pinned: every step evaluates at the pinned time
+            from dataclasses import replace as _rep
+            at = vs.at_ns
+            labels, values, times, series, _m = self._host_pass(
+                _rep(vs, at_ns=None), at, at, step_ns, 1, lookback_ns)
+            off = vs.offset_ns
+            mask = (times > at - off - vs.range_ns) & (times <= at - off)
+
+            def masks_pinned():
+                if mask.any():
+                    for i in range(nsteps):
+                        yield i, mask
+            return labels, values, times, series, masks_pinned
         off = vs.offset_ns
-        labels, values, times, series = self._gather(
-            vs, start_ns - off - vs.range_ns + 1, end_ns - off)
+        if isinstance(vs, Subquery):
+            labels, values, times, series = self._subquery_samples(
+                vs, start_ns - off - vs.range_ns + 1, end_ns - off,
+                lookback_ns)
+        else:
+            labels, values, times, series = self._gather(
+                vs, start_ns - off - vs.range_ns + 1, end_ns - off)
 
         def masks():
             for i in range(nsteps):
@@ -614,9 +728,9 @@ class PromEngine:
         return labels, values, times, series, masks
 
     def _quantile_over_time(self, vs, q_row, start_ns, end_ns, step_ns,
-                            nsteps):
+                            nsteps, lookback_ns=DEFAULT_LOOKBACK_NS):
         labels, values, times, series, masks = self._host_pass(
-            vs, start_ns, end_ns, step_ns, nsteps)
+            vs, start_ns, end_ns, step_ns, nsteps, lookback_ns)
         if not labels:
             return [], np.zeros((0, nsteps))
         S = len(labels)
@@ -628,12 +742,13 @@ class PromEngine:
                 out[si, i] = _prom_quantile(q, v)
         return labels, out
 
-    def _irate(self, vs, start_ns, end_ns, step_ns, f):
+    def _irate(self, vs, start_ns, end_ns, step_ns, f,
+               lookback_ns=DEFAULT_LOOKBACK_NS):
         """Dedicated per-eval-point last-two-samples pass (bucket
         granularity can't express 'previous sample')."""
         nsteps = int((end_ns - start_ns) // step_ns) + 1
         labels, values, times, series, masks = self._host_pass(
-            vs, start_ns, end_ns, step_ns, nsteps)
+            vs, start_ns, end_ns, step_ns, nsteps, lookback_ns)
         if not labels:
             return [], np.zeros((0, nsteps))
         S = len(labels)

@@ -71,6 +71,32 @@ class VectorSelector:
     matchers: list[Matcher] = field(default_factory=list)
     range_ns: int = 0          # 0 = instant selector
     offset_ns: int = 0
+    # @-modifier: pin evaluation to an absolute time (unix-seconds
+    # literal) or to the query range bound (`@ start()` / `@ end()`)
+    at_ns: int | None = None
+    at_anchor: str | None = None     # "start" | "end"
+
+
+@dataclass
+class Subquery:
+    """<expr>[range:step] — evaluate the inner expression as a range
+    vector at `step` resolution (0 = engine default, matching the
+    upstream promqltest 1m interval); consumable by every range
+    function. Reference: PromSubquery/PromSubCalls
+    (engine/executor/logic_plan.go PromSubquery,
+    lib/util/lifted/promql2influxql range-function transpile).
+
+    Known divergence from upstream: an inner expression step that
+    evaluates to NaN (0/0, sqrt of a negative, …) is treated as AN
+    ABSENT SAMPLE, not a NaN-valued sample — the engine's SeriesMatrix
+    uses NaN as its missing marker. count_over_time over such steps
+    undercounts relative to Prometheus."""
+    expr: object = None
+    range_ns: int = 0
+    step_ns: int = 0
+    offset_ns: int = 0
+    at_ns: int | None = None
+    at_anchor: str | None = None
 
 
 @dataclass
@@ -222,17 +248,51 @@ class _P:
         while True:
             self.ws()
             if self.peek() == "[":
+                self.expect("[")
+                rng = self.duration_tok()
+                self.ws()
+                if self.peek() == ":":
+                    # subquery: <expr>[range:step]
+                    self.expect(":")
+                    self.ws()
+                    sstep = 0
+                    if self.peek() != "]":
+                        sstep = self.duration_tok()
+                    self.expect("]")
+                    e = Subquery(expr=e, range_ns=rng, step_ns=sstep)
+                    continue
                 if not isinstance(e, VectorSelector) or e.range_ns:
                     raise PromParseError("range on non-selector")
-                self.expect("[")
-                e.range_ns = self.duration_tok()
+                e.range_ns = rng
                 self.expect("]")
                 continue
             if self.s.startswith("offset", self.i):
                 self.i += len("offset")
-                if not isinstance(e, VectorSelector):
+                if not isinstance(e, (VectorSelector, Subquery)):
                     raise PromParseError("offset on non-selector")
                 e.offset_ns = self.duration_tok()
+                continue
+            if self.peek() == "@":
+                self.expect("@")
+                if not isinstance(e, (VectorSelector, Subquery)):
+                    raise PromParseError("@ modifier on non-selector")
+                self.ws()
+                if self.s.startswith("start()", self.i):
+                    self.i += len("start()")
+                    e.at_anchor = "start"
+                elif self.s.startswith("end()", self.i):
+                    self.i += len("end()")
+                    e.at_anchor = "end"
+                else:
+                    m = re.match(
+                        r"-?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?",
+                        self.s[self.i:])
+                    if not m:
+                        raise PromParseError(
+                            "@ expects a unix timestamp, start() or "
+                            "end()")
+                    self.i += m.end()
+                    e.at_ns = int(round(float(m.group()) * 1e9))
                 continue
             return e
 

@@ -492,11 +492,51 @@ class QueryExecutor:
                 s["values"] = s["values"][lo:hi]
         return res
 
+    @staticmethod
+    def _matching_series_tags(shards, m: str, condition,
+                              named: bool = True) -> list[dict]:
+        """Tag dicts of series matching a pure-tag WHERE (reference
+        SHOW ... WHERE via tag_filters.go). Deduped across
+        time-partitioned shards; raises on time predicates, and on
+        field predicates only when the measurement was named with FROM
+        — an UNNAMED measurement that simply lacks the referenced tag
+        key matches nothing (heterogeneous schemas must not error the
+        whole statement)."""
+        all_keys = {k for s in shards for k in s.index.tag_keys(m)}
+        cond = analyze_condition(condition, all_keys)
+        if cond.residual is not None:
+            if not named:
+                return []
+            raise ErrQueryError(
+                "SHOW ... WHERE supports tag predicates only")
+        if cond.has_time_range:
+            raise ErrQueryError(
+                "SHOW ... WHERE does not support time predicates")
+        seen: set = set()
+        out = []
+        for s in shards:
+            idx = s.index
+            for sid in idx.series_ids(m, cond.tag_filters or None,
+                                      cond.tag_exprs or None).tolist():
+                tags = idx.tags_of(sid)
+                key = tuple(sorted(tags.items()))
+                if key not in seen:
+                    seen.add(key)
+                    out.append(tags)
+        return out
+
+    # SHOW statements whose WHERE clause filters by tag predicates
+    # (reference SHOW TAG VALUES/SERIES/... WHERE host = '...')
+    _SHOW_WHERE_OK = ("tag values", "tag keys", "series",
+                      "series cardinality", "tag values cardinality",
+                      "tag key cardinality")
+
     def _show_inner(self, stmt: ShowStatement, db: str | None) -> dict:
         eng = self.engine
-        if stmt.condition is not None:
+        if stmt.condition is not None \
+                and stmt.what not in self._SHOW_WHERE_OK:
             return {"error":
-                    f"WHERE on SHOW {stmt.what.upper()} not supported yet"}
+                    f"WHERE on SHOW {stmt.what.upper()} not supported"}
         if stmt.what == "queries":
             qm = self.query_manager
             rows = [[c.qid, c.text, c.db, f"{c.duration_s:.3f}s"]
@@ -619,6 +659,15 @@ class QueryExecutor:
             # reference SHOW SERIES CARDINALITY (the >1M-series engine's
             # headline introspection): exact union across shards — a
             # series spanning several time-partitioned shards counts once
+            if stmt.condition is not None:
+                sh = eng.database(db).all_shards()
+                msts = ([stmt.from_measurement] if stmt.from_measurement
+                        else eng.measurements(db))
+                n = sum(len(self._matching_series_tags(
+                    sh, m, stmt.condition,
+                    named=bool(stmt.from_measurement))) for m in msts)
+                return _series("series cardinality",
+                               ["cardinality estimation"], [[n]])
             keys: set[str] = set()
             for s in eng.database(db).all_shards():
                 keys.update(s.index.series_keys(stmt.from_measurement))
@@ -633,13 +682,27 @@ class QueryExecutor:
             vals = [[m] for m in eng.measurements(db)]
             return _series("measurements", ["name"], vals)
         shards = eng.database(db).all_shards()
+
+        def _mtags(m):
+            """Matching series' tag dicts under WHERE, or None when
+            unfiltered (callers then use the cheap index unions)."""
+            if stmt.condition is None:
+                return None
+            return self._matching_series_tags(
+                shards, m, stmt.condition,
+                named=bool(stmt.from_measurement))
+
         if stmt.what == "tag keys":
             out = []
             msts = ([stmt.from_measurement] if stmt.from_measurement
                     else eng.measurements(db))
             for m in msts:
-                keys = sorted({k for s in shards
-                               for k in s.index.tag_keys(m)})
+                mt = _mtags(m)
+                if mt is None:
+                    keys = sorted({k for s in shards
+                                   for k in s.index.tag_keys(m)})
+                else:
+                    keys = sorted({k for t in mt for k in t})
                 if keys:
                     out.append({"name": m, "columns": ["tagKey"],
                                 "values": [[k] for k in keys]})
@@ -649,7 +712,12 @@ class QueryExecutor:
             msts = ([stmt.from_measurement] if stmt.from_measurement
                     else eng.measurements(db))
             for m in msts:
-                keys = {k for s in shards for k in s.index.tag_keys(m)}
+                mt = _mtags(m)
+                if mt is None:
+                    keys = {k for s in shards
+                            for k in s.index.tag_keys(m)}
+                else:
+                    keys = {k for t in mt for k in t}
                 if keys:
                     out.append({"name": m, "columns": ["count"],
                                 "values": [[len(keys)]]})
@@ -674,8 +742,12 @@ class QueryExecutor:
             msts = ([stmt.from_measurement] if stmt.from_measurement
                     else eng.measurements(db))
             for m in msts:
-                vals = {v for s in shards
-                        for v in s.index.tag_values(m, stmt.key)}
+                mt = _mtags(m)
+                if mt is None:
+                    vals = {v for s in shards
+                            for v in s.index.tag_values(m, stmt.key)}
+                else:
+                    vals = {t[stmt.key] for t in mt if stmt.key in t}
                 if vals:
                     out.append({"name": m, "columns": ["count"],
                                 "values": [[len(vals)]]})
@@ -687,8 +759,14 @@ class QueryExecutor:
             msts = ([stmt.from_measurement] if stmt.from_measurement
                     else eng.measurements(db))
             for m in msts:
-                vals = sorted({v for s in shards
-                               for v in s.index.tag_values(m, stmt.key)})
+                mt = _mtags(m)
+                if mt is None:
+                    vals = sorted({v for s in shards
+                                   for v in s.index.tag_values(
+                                       m, stmt.key)})
+                else:
+                    vals = sorted({t[stmt.key] for t in mt
+                                   if stmt.key in t})
                 if vals:
                     out.append({"name": m, "columns": ["key", "value"],
                                 "values": [[stmt.key, v] for v in vals]})
@@ -712,12 +790,13 @@ class QueryExecutor:
             msts = ([stmt.from_measurement] if stmt.from_measurement
                     else eng.measurements(db))
             for m in msts:
-                for s in shards:
-                    for sid in s.index.series_ids(m).tolist():
-                        tags = s.index.tags_of(sid)
-                        key = m + "," + ",".join(
-                            f"{k}={v}" for k, v in sorted(tags.items()))
-                        out.append(key)
+                mt = _mtags(m)
+                if mt is None:
+                    mt = [s.index.tags_of(sid) for s in shards
+                          for sid in s.index.series_ids(m).tolist()]
+                for tags in mt:
+                    out.append(m + "," + ",".join(
+                        f"{k}={v}" for k, v in sorted(tags.items())))
             vals = [[k] for k in sorted(set(out))]
             return _series("series", ["key"], vals) if vals else {}
         return {"error": f"unsupported SHOW {stmt.what}"}
@@ -1371,8 +1450,13 @@ class QueryExecutor:
         # interleave); XLA's indices_are_sorted contract would be violated
         seg_sorted = bool(np.all(seg[:-1] <= seg[1:])) if len(seg) else True
         # tiny sparse leftovers (dense/pre-agg took the bulk) reduce on
-        # host — two device round-trips cost more than the arithmetic
-        use_host = n_rows <= HOST_AGG_THRESHOLD
+        # host — two device round-trips cost more than the arithmetic.
+        # Same when the segment grid dwarfs the row count: a scatter
+        # whose OUTPUT is bigger than its input doesn't tile (measured:
+        # 96k residue rows into an 11.5M-cell grid = 48.9s on device,
+        # ~0.2s as host bincount)
+        use_host = (n_rows <= HOST_AGG_THRESHOLD
+                    or n_rows < num_segments)
         from ..utils.stats import bump as _bump_r
         _bump_r(EXEC_STATS, "host_reductions" if use_host
                 else "device_reductions")

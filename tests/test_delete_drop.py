@@ -222,3 +222,46 @@ def test_show_cardinality_family(db):
     assert res["series"][0]["values"] == [[2]]
     res = q(ex, "SHOW TAG VALUES CARDINALITY FROM cpu")
     assert "WITH KEY" in res["error"]
+
+
+# --------------------------------------------------- SHOW ... WHERE
+
+def test_show_where_tag_predicates(db):
+    eng, ex, _ = db
+    # heterogeneous schemas: mem has no 'host'/'dc' tags — unnamed
+    # SHOW ... WHERE must skip it, not error (influx semantics)
+    write(eng, "cpu,host=h0,dc=a v=1 1000\ncpu,host=h1,dc=a v=2 1000\n"
+               "cpu,host=h2,dc=b v=3 1000\ncpu,other=x v=4 1000\n"
+               "mem,region=r m=1 1000")
+    res = q(ex, "SHOW TAG VALUES FROM cpu WITH KEY = host "
+                "WHERE dc = 'a'")
+    vals = [r[1] for r in res["series"][0]["values"]]
+    assert vals == ["h0", "h1"]
+    res = q(ex, "SHOW SERIES WHERE host = 'h0'")
+    assert res["series"][0]["values"] == [["cpu,dc=a,host=h0"]]
+    res = q(ex, "SHOW SERIES CARDINALITY WHERE dc = 'a'")
+    assert res["series"][0]["values"] == [[2]]
+    res = q(ex, "SHOW TAG KEYS FROM cpu WHERE other = 'x'")
+    assert [r[0] for r in res["series"][0]["values"]] == ["other"]
+    res = q(ex, "SHOW TAG VALUES CARDINALITY FROM cpu WITH KEY = host "
+                "WHERE dc =~ /a|b/")
+    assert res["series"][0]["values"] == [[3]]
+    # OR across tags
+    res = q(ex, "SHOW SERIES WHERE host = 'h0' OR host = 'h2'")
+    assert len(res["series"][0]["values"]) == 2
+
+
+def test_show_where_rejects_fields_and_time(db):
+    eng, ex, _ = db
+    write(eng, "cpu,host=h0 v=1 1000")
+    # field predicate with an explicit FROM: hard error
+    res = q(ex, "SHOW SERIES FROM cpu WHERE v > 5")
+    assert "tag predicates" in res["error"]
+    # without FROM, a non-tag term just matches nothing (heterogeneous
+    # schemas would otherwise error on every unrelated measurement)
+    res = q(ex, "SHOW SERIES WHERE v > 5")
+    assert res == {}
+    res = q(ex, "SHOW SERIES WHERE time > 0")
+    assert "time" in res["error"]
+    res = q(ex, "SHOW MEASUREMENTS WHERE host = 'h0'")
+    assert "not supported" in res["error"]

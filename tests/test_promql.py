@@ -412,3 +412,76 @@ def test_count_values_group_collapse(prom, tmp_path):
     assert len(out) == 1 and out[0]["metric"] == {"g": "2"}
     assert float(out[0]["value"][1]) == 2.0
     eng.close()
+
+
+def test_at_modifier_parse():
+    e = parse_promql("mem_used @ 300")
+    assert e.at_ns == 300 * S
+    e = parse_promql("mem_used @ start()")
+    assert e.at_anchor == "start"
+    e = parse_promql("rate(http_requests_total[5m] @ end()) ")
+    assert e.args[0].at_anchor == "end"
+    with pytest.raises(PromParseError):
+        parse_promql("sum(mem_used) @ 60")
+
+
+def test_at_modifier_instant(prom):
+    # mem_used at t is 100 + t/15s; pinned @150s -> 110 regardless of
+    # the query eval time
+    out = prom.query_instant("mem_used @ 150", 600 * S)
+    assert float(out[0]["value"][1]) == 110.0
+    out = prom.query_instant("mem_used @ end()", 300 * S)
+    assert float(out[0]["value"][1]) == 120.0
+
+
+def test_at_modifier_range_pins_every_step(prom):
+    # range query: every step sees the pinned instant vector
+    out = prom.query_range("mem_used @ 150", 0, 600 * S, 60 * S)
+    vals = {float(v) for _t, v in out[0]["values"]}
+    assert vals == {110.0}
+    assert len(out[0]["values"]) == 11
+    # range-function form: count_over_time pinned at 600s
+    out = prom.query_range("count_over_time(mem_used[1m] @ 600)",
+                           0, 300 * S, 60 * S)
+    vals = {float(v) for _t, v in out[0]["values"]}
+    assert vals == {4.0}    # (540,600]: samples at 555,570,585,600
+
+
+def test_subquery_parse():
+    from opengemini_tpu.promql.parser import Subquery
+    e = parse_promql("max_over_time(rate(http_requests_total[1m])[5m:1m])")
+    assert e.func == "max_over_time"
+    sq = e.args[0]
+    assert isinstance(sq, Subquery)
+    assert sq.range_ns == 5 * M and sq.step_ns == M
+    assert isinstance(sq.expr, FuncCall) and sq.expr.func == "rate"
+    # default step + offset + @
+    e = parse_promql("sum_over_time(mem_used[10m:] offset 1m)")
+    sq = e.args[0]
+    assert sq.step_ns == 0 and sq.offset_ns == M
+    e = parse_promql("sum_over_time(mem_used[10m:2m] @ 300)")
+    assert e.args[0].at_ns == 300 * S
+    with pytest.raises(PromParseError):
+        parse_promql("mem_used[5m:1m]1")
+
+
+def test_subquery_eval(prom):
+    # mem_used(t) = 100 + t/15s; [5m:1m] at 600s → sub-samples at
+    # 360..600s
+    out = prom.query_instant("max_over_time(mem_used[5m:1m])", 600 * S)
+    assert float(out[0]["value"][1]) == 140.0
+    out = prom.query_instant("min_over_time(mem_used[5m:1m])", 600 * S)
+    assert float(out[0]["value"][1]) == 124.0
+    # nested range function: constant-rate counter
+    out = prom.query_instant(
+        "avg_over_time(rate(http_requests_total[1m])[4m:1m])", 600 * S)
+    m = {o["metric"]["host"]: float(o["value"][1]) for o in out}
+    np.testing.assert_allclose(m["h0"], 1 / 15, rtol=1e-9)
+    np.testing.assert_allclose(m["h1"], 2 / 15, rtol=1e-9)
+    # bare subquery is not a valid top-level result
+    out_err = None
+    try:
+        prom.query_instant("mem_used[5m:1m]", 600 * S)
+    except Exception as e:
+        out_err = str(e)
+    assert out_err and "range function" in out_err

@@ -615,6 +615,21 @@ SUITE = [
         ],
     },
     {
+        "name": "show cardinality and filtered tag values",
+        "writes": "cs,host=a,dc=x v=1 1000\ncs,host=b,dc=x v=2 1000\n"
+                  "cs,host=c,dc=y v=3 1000",
+        "queries": [
+            ("SHOW SERIES CARDINALITY",
+             ok(series("series cardinality",
+                       ["cardinality estimation"], [[3]]))),
+            ("SHOW TAG VALUES FROM cs WITH KEY = host WHERE dc = 'x'",
+             ok(series("cs", ["key", "value"],
+                       [["host", "a"], ["host", "b"]]))),
+            ("SHOW TAG KEY CARDINALITY FROM cs",
+             ok(series("cs", ["count"], [[2]]))),
+        ],
+    },
+    {
         "name": "select into writes result rows",
         "writes": "m v=1 1000\nm v=3 2000",
         "single_only": True,
