@@ -775,7 +775,10 @@ class PromEngine:
             if l_sc or r_sc:
                 raise PromQLError(
                     f"set operator {b.op} requires vector operands")
-            return _set_op(b.op, lhs, rhs)
+            if b.group_side is not None:
+                raise PromQLError(
+                    "no grouping allowed for set operations")
+            return _set_op(b.op, lhs, rhs, _binop_key(b))
         if l_sc and r_sc:
             if isinstance(lhs, float) and isinstance(rhs, float):
                 return _scalar_op(b.op, lhs, rhs)
@@ -798,26 +801,93 @@ class PromEngine:
             return SeriesMatrix(
                 lhs.labels, _vec_op(b.op, lhs.values, rv, b.bool_mode),
                 lhs.metric_dropped)._maybe_drop(b)
-        # vector-vector: one-to-one on full label match (sans __name__).
-        # Filtering comparisons (no bool) pass LHS samples through
-        # UNCHANGED, metric name included (upstream semantics);
-        # arithmetic and bool-mode drop the name.
+        # vector-vector matching: one-to-one on the match key (full
+        # label set, or on()/ignoring()); many-to-one with
+        # group_left/group_right. Filtering comparisons (no bool) pass
+        # LHS samples through UNCHANGED, metric name included (upstream
+        # semantics); arithmetic and bool-mode drop the name.
+        keyf = _binop_key(b)
         keep_name = b.op in ("==", "!=", ">", "<", ">=", "<=") \
             and not b.bool_mode
-        rmap = {_lkey(ls): i for i, ls in enumerate(rhs.labels)}
-        labels, rows = [], []
-        for i, ls in enumerate(lhs.labels):
-            j = rmap.get(_lkey(ls))
-            if j is None:
-                continue
-            rows.append(_vec_op(b.op, lhs.values[i:i+1],
-                                rhs.values[j:j+1], b.bool_mode))
-            labels.append(dict(ls) if keep_name else
+        nsteps_out = lhs.values.shape[1] if lhs.values.size else (
+            rhs.values.shape[1] if rhs.values.size else 1)
+        if b.group_side is not None:
+            many, one = ((lhs, rhs) if b.group_side == "left"
+                         else (rhs, lhs))
+            # filtering comparisons (no bool) keep the many side's
+            # samples and metric name (upstream filter semantics; for
+            # group_right the compared lhs value is the 'one' side,
+            # so the name drops)
+            keep_name = keep_name and b.group_side == "left"
+            omap: dict = {}
+            for j, ls in enumerate(one.labels):
+                k = keyf(ls)
+                if k in omap:
+                    raise PromQLError(
+                        "many-to-one matching: duplicate series on "
+                        "the 'one' side of the match")
+                omap[k] = j
+            labels, rows = [], []
+            seen_out: set = set()
+            for i, ls in enumerate(many.labels):
+                j = omap.get(keyf(ls))
+                if j is None:
+                    continue
+                mrow = many.values[i:i + 1]
+                orow = one.values[j:j + 1]
+                lv, rv = ((mrow, orow) if b.group_side == "left"
+                          else (orow, mrow))
+                rows.append(_vec_op(b.op, lv, rv, b.bool_mode))
+                out_ls = (dict(ls) if keep_name else
                           {k: v for k, v in ls.items()
                            if k != "__name__"})
+                for g in b.group_labels:
+                    if g in one.labels[j]:
+                        out_ls[g] = one.labels[j][g]
+                    else:
+                        out_ls.pop(g, None)
+                okey = tuple(sorted(out_ls.items()))
+                if okey in seen_out:
+                    raise PromQLError(
+                        "multiple matches for labels: grouped labels "
+                        "must ensure unique output series")
+                seen_out.add(okey)
+                labels.append(out_ls)
+            if not rows:
+                return SeriesMatrix([], np.zeros((0, nsteps_out)), True)
+            return SeriesMatrix(labels, np.vstack(rows), not keep_name)
+        rmap: dict = {}
+        for j, ls in enumerate(rhs.labels):
+            k = keyf(ls)
+            if k in rmap and b.match_on is not None:
+                raise PromQLError(
+                    "found duplicate series for the match group on "
+                    "the right side; use group_left/group_right")
+            rmap[k] = j
+        seen_l: set = set()
+        labels, rows = [], []
+        for i, ls in enumerate(lhs.labels):
+            k = keyf(ls)
+            j = rmap.get(k)
+            if j is None:
+                continue
+            if k in seen_l:
+                raise PromQLError(
+                    "found duplicate series for the match group on "
+                    "the left side; use group_left/group_right")
+            seen_l.add(k)
+            rows.append(_vec_op(b.op, lhs.values[i:i+1],
+                                rhs.values[j:j+1], b.bool_mode))
+            if keep_name:
+                labels.append(dict(ls))
+            elif b.match_on is None:
+                labels.append({k2: v for k2, v in ls.items()
+                               if k2 != "__name__"})
+            else:
+                # on()/ignoring(): result carries the match-group labels
+                labels.append(dict(k))
         if not rows:
-            nsteps = lhs.values.shape[1] if lhs.values.size else 1
-            return SeriesMatrix([], np.zeros((0, nsteps)), True)
+            return SeriesMatrix([], np.zeros((0, nsteps_out)), True)
         return SeriesMatrix(labels, np.vstack(rows), not keep_name)
 
 
@@ -1045,47 +1115,81 @@ def _lkey(ls: dict) -> tuple:
     return tuple(sorted((k, v) for k, v in ls.items() if k != "__name__"))
 
 
-def _set_op(op: str, lhs: SeriesMatrix, rhs: SeriesMatrix) -> SeriesMatrix:
-    """Prom set operators: per-step sample-presence logic over full label
-    match (sans __name__). Labels of surviving series keep their metric
-    name (prom keeps lhs elements as-is)."""
-    rmap = {_lkey(ls): i for i, ls in enumerate(rhs.labels)}
+def _binop_key(b):
+    """Match-key function for a binary op: full label set (sans
+    __name__), on(...) labels only, or all-but-ignoring(...)."""
+    if b.match_on is None:
+        return _lkey
+    if b.match_ignoring:
+        drop = set(b.match_on) | {"__name__"}
+        return lambda ls: tuple(sorted((k, v) for k, v in ls.items()
+                                       if k not in drop))
+    want = set(b.match_on)
+    return lambda ls: tuple(sorted((k, v) for k, v in ls.items()
+                                   if k in want))
+
+
+def _set_op(op: str, lhs: SeriesMatrix, rhs: SeriesMatrix,
+            key=_lkey) -> SeriesMatrix:
+    """Prom set operators: per-step sample-presence logic over the
+    match key (full label set sans __name__, or on()/ignoring()).
+    Set ops are MANY-TO-MANY: presence on the other side is the OR
+    over every series sharing the key. Labels of surviving series keep
+    their metric name (prom keeps lhs elements as-is)."""
+    rgroups: dict[tuple, list[int]] = {}
+    for j, ls in enumerate(rhs.labels):
+        rgroups.setdefault(key(ls), []).append(j)
+
+    def r_present(k):
+        """(nsteps,) bool: any rhs series with this key has a sample."""
+        js = rgroups.get(k)
+        if not js:
+            return None
+        return ~np.isnan(rhs.values[js]).all(axis=0)
+
     labels: list[dict] = []
     rows: list[np.ndarray] = []
     if op == "and":
         for i, ls in enumerate(lhs.labels):
-            j = rmap.get(_lkey(ls))
-            if j is None:
+            pres = r_present(key(ls))
+            if pres is None:
                 continue
             labels.append(ls)
-            rows.append(np.where(~np.isnan(rhs.values[j]),
-                                 lhs.values[i], np.nan))
+            rows.append(np.where(pres, lhs.values[i], np.nan))
     elif op == "unless":
         for i, ls in enumerate(lhs.labels):
-            j = rmap.get(_lkey(ls))
-            if j is None:
-                labels.append(ls)
-                rows.append(lhs.values[i])
-            else:
-                labels.append(ls)
-                rows.append(np.where(np.isnan(rhs.values[j]),
-                                     lhs.values[i], np.nan))
+            pres = r_present(key(ls))
+            labels.append(ls)
+            rows.append(lhs.values[i] if pres is None else
+                        np.where(pres, np.nan, lhs.values[i]))
     else:  # or
-        lmap = {_lkey(ls): i for i, ls in enumerate(lhs.labels)}
+        lgroups: dict[tuple, list[int]] = {}
         for i, ls in enumerate(lhs.labels):
-            j = rmap.get(_lkey(ls))
-            if j is None:
-                labels.append(ls)
-                rows.append(lhs.values[i])
-            else:
-                # rhs fills the steps where lhs has no sample
-                labels.append(ls)
-                rows.append(np.where(~np.isnan(lhs.values[i]),
-                                     lhs.values[i], rhs.values[j]))
+            lgroups.setdefault(key(ls), []).append(i)
+        for i, ls in enumerate(lhs.labels):
+            labels.append(ls)
+            rows.append(lhs.values[i])
+        lfull = {_lkey(ls): i for i, ls in enumerate(lhs.labels)}
         for j, ls in enumerate(rhs.labels):
-            if _lkey(ls) not in lmap:
+            li = lgroups.get(key(ls))
+            if li is None:
                 labels.append(ls)
                 rows.append(rhs.values[j])
+                continue
+            # per-step: the rhs element appears only at steps where NO
+            # lhs element with the same key has a sample
+            lhs_present = ~np.isnan(lhs.values[li]).all(axis=0)
+            masked = np.where(lhs_present, np.nan, rhs.values[j])
+            fi = lfull.get(_lkey(ls))
+            if fi is not None and len(li) == 1 and li[0] == fi:
+                # identical full label set: merge into the lhs row
+                # (one series per label set in the output; lhs rows
+                # occupy indices 0..S_lhs-1 in emission order)
+                rows[fi] = np.where(np.isnan(rows[fi]), masked,
+                                    rows[fi])
+            elif not np.all(np.isnan(masked)):
+                labels.append(ls)
+                rows.append(masked)
     nsteps = (lhs.values.shape[1] if lhs.values.size else
               (rhs.values.shape[1] if rhs.values.size else 1))
     if not rows:

@@ -120,6 +120,13 @@ class BinaryOp:
     lhs: object = None
     rhs: object = None
     bool_mode: bool = False
+    # vector matching: on(l…)/ignoring(l…) restrict the match key;
+    # group_left/group_right allow many-to-one with extra labels
+    # copied from the "one" side
+    match_on: list[str] | None = None    # None = full label match
+    match_ignoring: bool = False
+    group_side: str | None = None        # "left" | "right"
+    group_labels: list[str] = field(default_factory=list)
 
 
 def parse_duration(s: str) -> int:
@@ -224,13 +231,73 @@ class _P:
             self.i += len(op)
             bool_mode = False
             self.ws()
-            if self.s.startswith("bool", self.i):
+            if self._kw_at("bool"):
                 self.i += 4
                 bool_mode = True
+            match_on = None
+            match_ignoring = False
+            group_side = None
+            group_labels: list[str] = []
+            self.ws()
+            for kw in ("ignoring", "on"):
+                if self._modifier_at(kw):
+                    self.i += len(kw)
+                    match_on = self._label_list()
+                    match_ignoring = kw == "ignoring"
+                    break
+            self.ws()
+            for kw in ("group_left", "group_right"):
+                if self._kw_at(kw):
+                    self.i += len(kw)
+                    group_side = kw[len("group_"):]
+                    self.ws()
+                    if self.peek() == "(":
+                        group_labels = self._label_list()
+                    break
+            if group_side and match_on is None:
+                raise PromParseError(
+                    f"group_{group_side} requires on() or ignoring()")
             # ^ is right-assoc, others left
             nxt = PREC[op] + (0 if op == "^" else 1)
             rhs = self.parse_expr(nxt)
-            lhs = BinaryOp(op, lhs, rhs, bool_mode)
+            lhs = BinaryOp(op, lhs, rhs, bool_mode,
+                           match_on=match_on,
+                           match_ignoring=match_ignoring,
+                           group_side=group_side,
+                           group_labels=group_labels)
+
+    def _kw_at(self, kw: str) -> bool:
+        """True if `kw` sits at the cursor with a word boundary after
+        it (shared by every keyword/modifier scan)."""
+        if not self.s.startswith(kw, self.i):
+            return False
+        j = self.i + len(kw)
+        return j >= len(self.s) or not (self.s[j].isalnum()
+                                        or self.s[j] == "_")
+
+    def _modifier_at(self, kw: str) -> bool:
+        """True if `kw` sits at the cursor followed by '(' (so a
+        metric named `on` is still usable as an operand)."""
+        if not self.s.startswith(kw, self.i):
+            return False
+        j = self.i + len(kw)
+        while j < len(self.s) and self.s[j].isspace():
+            j += 1
+        return j < len(self.s) and self.s[j] == "("
+
+    def _label_list(self) -> list[str]:
+        self.ws()
+        self.expect("(")
+        out: list[str] = []
+        self.ws()
+        while self.peek() != ")":
+            out.append(self.ident())
+            self.ws()
+            if self.peek() == ",":
+                self.expect(",")
+                self.ws()
+        self.expect(")")
+        return out
 
     def parse_unary(self):
         self.ws()

@@ -485,3 +485,62 @@ def test_subquery_eval(prom):
     except Exception as e:
         out_err = str(e)
     assert out_err and "range function" in out_err
+
+
+def test_vector_matching_on_ignoring(prom):
+    # http_requests_total{host, job} vs mem_used{host}: full-label match
+    # finds nothing; on(host) matches h0
+    out = prom.query_instant(
+        "http_requests_total / on(host) mem_used", 600 * S)
+    assert len(out) == 1
+    assert out[0]["metric"] == {"host": "h0"}
+    np.testing.assert_allclose(float(out[0]["value"][1]), 41.0 / 140.0)
+    out2 = prom.query_instant(
+        "http_requests_total / ignoring(job) mem_used", 600 * S)
+    assert out == out2
+    # group_left keeps the many side's full labels
+    out = prom.query_instant(
+        "http_requests_total * on(host) group_left mem_used", 600 * S)
+    assert out[0]["metric"] == {"host": "h0", "job": "api"}
+    np.testing.assert_allclose(float(out[0]["value"][1]), 41.0 * 140.0)
+    # group_right flips the many side
+    out2 = prom.query_instant(
+        "mem_used * on(host) group_right http_requests_total", 600 * S)
+    assert out == out2
+    # duplicate match-group without group_* errors
+    try:
+        prom.query_instant(
+            "http_requests_total + on(job) http_requests_total", 600 * S)
+        raise AssertionError("expected duplicate-series error")
+    except Exception as e:
+        assert "duplicate series" in str(e)
+
+
+def test_vector_matching_set_ops(prom):
+    out = prom.query_instant(
+        "http_requests_total and on(host) mem_used", 600 * S)
+    hosts = {o["metric"]["host"] for o in out}
+    assert hosts == {"h0"}
+    assert out[0]["metric"]["__name__"] == "http_requests_total"
+    out = prom.query_instant(
+        "http_requests_total unless on(host) mem_used", 600 * S)
+    assert {o["metric"]["host"] for o in out} == {"h1"}
+
+
+def test_vector_matching_edge_semantics(prom):
+    # set ops reject grouping (upstream parse error)
+    try:
+        prom.query_instant(
+            "http_requests_total and on(host) group_left mem_used",
+            600 * S)
+        raise AssertionError("expected grouping error")
+    except Exception as e:
+        assert "no grouping" in str(e)
+    # filtering comparison with group_left keeps many-side samples
+    # (h0: 41 < 140 passes; h1 has no mem_used match)
+    out = prom.query_instant(
+        "http_requests_total < on(host) group_left mem_used", 600 * S)
+    assert len(out) == 1
+    assert out[0]["metric"] == {"__name__": "http_requests_total",
+                                "host": "h0", "job": "api"}
+    assert float(out[0]["value"][1]) == 41.0
