@@ -1,6 +1,6 @@
 """Node-role apps (role of reference app/: ts-meta, ts-store, ts-sql,
 ts-server binaries, app/command.go run scaffolding)."""
 
-from .nodes import TsMeta, TsSql, TsStore, TsServer
+from .nodes import TsData, TsMeta, TsSql, TsStore, TsServer
 
-__all__ = ["TsMeta", "TsStore", "TsSql", "TsServer"]
+__all__ = ["TsData", "TsMeta", "TsStore", "TsSql", "TsServer"]

@@ -235,6 +235,45 @@ class TsServer:
         self.engine.close()
 
 
+class TsData:
+    """sql + store combined in one process against an EXTERNAL meta
+    cluster (reference app/ts-data/main.go:27 — the data-node role for
+    deployments that separate compute+storage from metadata). The
+    store registers and heartbeats like a standalone ts-store; the sql
+    frontend scatters over the whole cluster, including this node."""
+
+    def __init__(self, data_dir: str, meta_addrs: list[str],
+                 host: str = "127.0.0.1", http_port: int = 0,
+                 opts: EngineOptions | None = None,
+                 heartbeat_s: float = HEARTBEAT_S, role: str = "both"):
+        self.store = TsStore(data_dir, meta_addrs, host=host,
+                             opts=opts, heartbeat_s=heartbeat_s,
+                             role=role)
+        self.sql = TsSql(meta_addrs, host=host, http_port=http_port)
+
+    @property
+    def http(self):
+        return self.sql.http
+
+    @property
+    def http_addr(self) -> str:
+        return self.sql.http_addr
+
+    @property
+    def addr(self) -> str:
+        return self.store.addr
+
+    def start(self):
+        self.store.start()
+        self.sql.start()
+        log.info("ts-data ready: store %s, http %s", self.store.addr,
+                 self.http_addr)
+
+    def stop(self):
+        self.sql.stop()
+        self.store.stop()
+
+
 def _wait(cond, timeout: float, what: str):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:

@@ -245,6 +245,10 @@ class HttpServer:
         elif isinstance(stmt, ShowStatement):
             if stmt.what in ("databases", "queries", "stats"):
                 return None
+            if stmt.what == "diagnostics":
+                # build/system facts (paths, executables) — admin-only,
+                # matching the reference ShowDiagnosticsStatement
+                return "admin privilege required"
             if stmt.what in ("subscriptions", "downsamples") \
                     and not stmt.on_db:
                 # cross-database enumeration (destination URLs, policy
@@ -549,6 +553,37 @@ class HttpServer:
                 self._bump("query_errors")
             results.append(res)
         return 200, {"results": results}
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the internal collectors
+        (reference httpd serveMetrics, handler.go /metrics route)."""
+        from ..utils.stats import (compaction_collector,
+                                   devicecache_collector,
+                                   engine_collector, executor_collector,
+                                   readcache_collector, rpc_collector,
+                                   runtime_collector)
+        groups = {"runtime": runtime_collector(),
+                  "readcache": readcache_collector(),
+                  "executor": executor_collector(),
+                  "devicecache": devicecache_collector(),
+                  "compaction": compaction_collector(),
+                  "rpc": rpc_collector(),
+                  "httpd": dict(self.stats)}
+        if hasattr(self.engine, "scan_series"):
+            try:
+                groups["engine"] = engine_collector(self.engine)()
+            except Exception:
+                pass
+        lines = []
+        for grp, vals in groups.items():
+            for k, v in sorted(vals.items()):
+                if isinstance(v, bool) or not isinstance(v,
+                                                         (int, float)):
+                    continue
+                name = f"opengemini_{grp}_{k}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v}")
+        return "\n".join(lines) + "\n"
 
     # --------------------------------------------------- flux endpoint
 
@@ -933,6 +968,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("Access-Control-Allow-Origin", "*")
             self.end_headers()
             for c in chunk_results(payload, chunk_size):
                 blob = json.dumps(c).encode() + b"\n"
@@ -954,6 +990,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self.send_response(code)
         self.send_header("Content-Type", ctype)
+        self.send_header("Access-Control-Allow-Origin", "*")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -966,6 +1003,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("X-Influxdb-Version", "1.8-opengemini-tpu-"
                          + __version__)
+        # the OPTIONS preflight advertises CORS; actual responses must
+        # carry the origin header too or browsers block the body
+        self.send_header("Access-Control-Allow-Origin", "*")
         self.send_header("Content-Length", str(len(body)))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
@@ -981,12 +1021,24 @@ class _Handler(BaseHTTPRequestHandler):
         ok, user = self._auth()
         if not ok:
             return
-        if path == "/ping":
+        if path in ("/ping", "/status"):
             self._reply(204)
             return
         if path == "/health":
             self._reply(200, {"name": "opengemini-tpu", "status": "pass",
                               "version": __version__})
+            return
+        if path == "/metrics":
+            # Prometheus text exposition of the internal collectors
+            # (reference serveMetrics)
+            body = srv.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         if path == "/debug/vars":
             self._reply(200, srv.stats)
@@ -1052,6 +1104,30 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = srv.sysctrl.handle(p.pop("mod", ""), p)
             self._reply(code, payload)
             return
+        if path == "/failpoint":
+            # direct failpoint toggle endpoint (reference handler.go
+            # POST /failpoint) — a JSON front-end over the same
+            # syscontrol handler as /debug/ctrl?mod=failpoint, so
+            # validation and error text cannot drift between the two
+            if not self._admin_gate(user):
+                return
+            try:
+                doc = json.loads(self._body() or b"{}")
+            except Exception as e:
+                self._reply(400, {"error": f"bad body: {e}"})
+                return
+            params = {"point": doc.get("name", ""),
+                      "switchon": str(doc.get("enable", True)).lower(),
+                      "action": doc.get("action", "error")}
+            if doc.get("arg") is not None:
+                params["arg"] = doc["arg"]
+            code, payload = srv.sysctrl.handle("failpoint", params)
+            if code == 200 and params["point"]:
+                from ..utils import failpoint as fp
+                payload = dict(payload, ok=True,
+                               failpoints=fp.list_points())
+            self._reply(code, payload)
+            return
         if self._is_logstore(path):
             if self._is_logstore_catalog(path) \
                     and not self._admin_gate(user):
@@ -1078,6 +1154,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_response(code)
                 self.send_header("Content-Type",
                                  "text/csv; charset=utf-8")
+                self.send_header("Access-Control-Allow-Origin", "*")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -1148,10 +1225,23 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(404, {"error": f"not found: {path}"})
 
     def do_HEAD(self):
-        if self._path() == "/ping":
+        if self._path() in ("/ping", "/status"):
             self._reply(204)
         else:
             self._reply(404)
+
+    def do_OPTIONS(self):
+        """CORS preflight (reference serveOptions on /query and
+        /write)."""
+        self.send_response(204)
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Access-Control-Allow-Methods",
+                         "GET, POST, HEAD, OPTIONS, DELETE, PUT")
+        self.send_header("Access-Control-Allow-Headers",
+                         "Accept, Authorization, Content-Type, "
+                         "X-Requested-With")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
 
 def main():

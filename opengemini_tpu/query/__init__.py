@@ -2,3 +2,4 @@ from .influxql import parse_query, ParseError
 from .ast import (SelectStatement, ShowStatement, Call, FieldRef, Literal,
                   BinaryExpr, Wildcard)
 from .executor import QueryExecutor
+from .flux import FluxError, compile_flux, flux_csv

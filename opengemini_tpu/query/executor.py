@@ -605,6 +605,26 @@ class QueryExecutor:
                             "values": [["running",
                                         len(self.query_manager.list())]]})
             return {"series": out}
+        if stmt.what == "diagnostics":
+            # reference SHOW DIAGNOSTICS: build/system facts
+            import platform
+            import sys as _sys
+            import jax as _jax
+            from .. import __version__ as _ver
+            build = [["Version", _ver],
+                     ["Python", platform.python_version()],
+                     ["JAX", _jax.__version__],
+                     ["Backend", _jax.default_backend()],
+                     ["Devices", len(_jax.devices())]]
+            system = [["os", platform.system().lower()],
+                      ["arch", platform.machine()],
+                      ["executable", _sys.executable],
+                      ["dataPath", getattr(eng, "path", "")]]
+            return {"series": [
+                {"name": "build", "columns": ["name", "value"],
+                 "values": build},
+                {"name": "system", "columns": ["name", "value"],
+                 "values": system}]}
         if stmt.what == "retention policies":
             if self.catalog is None:
                 return {"error": "retention policies are not available "

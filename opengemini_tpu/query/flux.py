@@ -17,6 +17,7 @@ Supported pipeline stages::
     |> aggregateWindow(every: 1m, fn: mean[, createEmpty: bool]
                        [, timeSrc: "_start"|"_stop"])
     |> mean()/sum()/count()/min()/max()/first()/last()  # bare aggregate
+    |> derivative([unit: 1s][, nonNegative: bool])
     |> group([columns: ["tag", ...]])
     |> sort(columns: ["_time"][, desc: true])
     |> limit(n: N)
@@ -441,6 +442,7 @@ def compile_flux(text: str, now_ns: int) -> FluxCompiled:
     split = _FilterSplit()
     window_fn = None
     bare_fn = None
+    deriv: tuple | None = None        # (unit_ns, non_negative)
     group_mode = "series"             # flux default: group by series key
     group_cols: list[str] = []
     limit_n = 0
@@ -464,6 +466,11 @@ def compile_flux(text: str, now_ns: int) -> FluxCompiled:
             if window_fn or bare_fn:
                 raise FluxError("flux: only one aggregation stage "
                                 "is supported")
+            if deriv is not None:
+                raise FluxError(
+                    "flux: derivative() before the aggregation stage "
+                    "is not supported (the lowering computes the "
+                    "derivative OF the aggregate)")
             every = c.args.get("every")
             if not (isinstance(every, tuple) and every[0] == "dur"):
                 raise FluxError("flux: aggregateWindow(every:) must be "
@@ -484,6 +491,11 @@ def compile_flux(text: str, now_ns: int) -> FluxCompiled:
             if window_fn or bare_fn:
                 raise FluxError("flux: only one aggregation stage "
                                 "is supported")
+            if deriv is not None:
+                raise FluxError(
+                    "flux: derivative() before the aggregation stage "
+                    "is not supported (the lowering computes the "
+                    "derivative OF the aggregate)")
             bare_fn = c.name
             shape.bare_agg = True
         elif c.name == "group":
@@ -508,8 +520,18 @@ def compile_flux(text: str, now_ns: int) -> FluxCompiled:
             name = c.args.get("name")
             if isinstance(name, str) and name:
                 shape.result_name = name
+        elif c.name == "derivative":
+            if deriv is not None:
+                raise FluxError("flux: only one derivative() stage "
+                                "is supported")
+            unit = c.args.get("unit", ("dur", NS))
+            if not (isinstance(unit, tuple) and unit[0] == "dur"):
+                raise FluxError("flux: derivative(unit:) must be a "
+                                "duration")
+            # flux stdlib default: nonNegative: false (signed rates)
+            deriv = (unit[1], c.args.get("nonNegative", False))
         elif c.name in ("drop", "keep", "rename", "map", "window",
-                        "pivot", "derivative", "distinct"):
+                        "pivot", "distinct"):
             raise FluxError(f"flux: stage {c.name}() is not supported")
         else:
             raise FluxError(f"flux: unknown stage {c.name}()")
@@ -527,8 +549,19 @@ def compile_flux(text: str, now_ns: int) -> FluxCompiled:
     shape.fields = fields
 
     # ---- render the SELECT
-    if agg:
-        sel = ", ".join(f"{agg}({_quote_ident(f)}) AS {_quote_ident(f)}"
+    def _col(f: str) -> str:
+        inner = f"{agg}({_quote_ident(f)})" if agg else _quote_ident(f)
+        if deriv is not None:
+            dfn = ("non_negative_derivative" if deriv[1]
+                   else "derivative")
+            inner = f"{dfn}({inner}, {deriv[0]}ns)"
+        return inner
+
+    if agg or deriv:
+        if not fields:
+            raise FluxError("flux: derivative() requires a filter "
+                            "on r._field")
+        sel = ", ".join(f"{_col(f)} AS {_quote_ident(f)}"
                         for f in fields)
     elif fields:
         sel = ", ".join(_quote_ident(f) for f in fields)

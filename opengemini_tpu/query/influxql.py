@@ -670,6 +670,8 @@ class Parser:
             return stmt
         if u == "STATS":
             return ShowStatement("stats")
+        if u == "DIAGNOSTICS":
+            return ShowStatement("diagnostics")
         if u == "MEASUREMENTS":
             stmt = ShowStatement("measurements")
         elif u == "MEASUREMENT":

@@ -176,3 +176,38 @@ class TestMonitor:
         assert mon.reported_lines >= 2
         assert "monitor" in eng.databases
         assert "svcmetric" in eng.measurements("monitor")
+
+
+class TestTsData:
+    def test_ts_data_node_roundtrip(self, tmp_path):
+        """ts-data (sql+store in one process, external meta): write and
+        query through its own HTTP frontend (reference
+        app/ts-data/main.go)."""
+        import json
+        import urllib.parse
+        import urllib.request
+
+        from opengemini_tpu.app import TsData, TsMeta
+
+        meta = TsMeta(data_dir=str(tmp_path / "meta"))
+        meta.start()
+        meta.server.raft.wait_leader(10.0)
+        node = TsData(str(tmp_path / "data"), [meta.addr],
+                      heartbeat_s=0.5)
+        node.start()
+        try:
+            base = f"http://{node.http_addr}"
+            req = urllib.request.Request(
+                base + "/write?db=d0",
+                data=b"m,host=a v=1.5 1000\nm,host=b v=2.5 2000",
+                method="POST")
+            assert urllib.request.urlopen(req, timeout=10).status == 204
+            url = (base + "/query?db=d0&q="
+                   + urllib.parse.quote("SELECT sum(v) FROM m"))
+            res = json.loads(
+                urllib.request.urlopen(url, timeout=10).read())
+            s = res["results"][0]["series"][0]
+            assert s["values"][0][1] == 4.0
+        finally:
+            node.stop()
+            meta.stop()

@@ -265,3 +265,13 @@ def test_show_where_rejects_fields_and_time(db):
     assert "time" in res["error"]
     res = q(ex, "SHOW MEASUREMENTS WHERE host = 'h0'")
     assert "not supported" in res["error"]
+
+
+def test_show_diagnostics(db):
+    eng, ex, _ = db
+    res = q(ex, "SHOW DIAGNOSTICS")
+    names = {s["name"] for s in res["series"]}
+    assert names == {"build", "system"}
+    build = {r[0]: r[1] for s in res["series"] if s["name"] == "build"
+             for r in s["values"]}
+    assert build["Version"] and "JAX" in build

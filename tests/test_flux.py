@@ -76,6 +76,34 @@ def test_transpile_tag_equality_and_regex_slash():
     assert '"path" =~ /api\\/v2/' in c.influxql
 
 
+def test_transpile_derivative():
+    # flux stdlib default is nonNegative: false (signed rates)
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        ' r._field == "v")'
+        ' |> aggregateWindow(every: 1m, fn: mean)'
+        ' |> derivative(unit: 1s)', NOW)
+    assert ('derivative(mean("v"), 1000000000ns) AS "v"'
+            in c.influxql)
+    c = compile_flux(
+        'from(bucket: "db0") |> range(start: 0)'
+        ' |> filter(fn: (r) => r._measurement == "cpu" and'
+        ' r._field == "v")'
+        ' |> derivative(unit: 1m, nonNegative: true)', NOW)
+    assert ('non_negative_derivative("v", 60000000000ns) AS "v"'
+            in c.influxql)
+    # derivative before the aggregation stage is rejected, not
+    # silently reordered
+    with pytest.raises(FluxError):
+        compile_flux(
+            'from(bucket: "db0") |> range(start: 0)'
+            ' |> filter(fn: (r) => r._measurement == "cpu" and'
+            ' r._field == "v")'
+            ' |> derivative(unit: 1s)'
+            ' |> aggregateWindow(every: 1m, fn: mean)', NOW)
+
+
 def test_transpile_regex_and_or_measurements():
     c = compile_flux(
         'from(bucket: "db0") |> range(start: 0)'

@@ -171,3 +171,49 @@ def test_prom_api(server):
     code, res = req(server, "GET",
                     "/api/v1/series?match[]=" + _q('{job="api"}'))
     assert len(json.loads(res)["data"]) == 3  # 2×up + 1×down
+
+
+def test_status_metrics_options(server):
+    # GET/HEAD /status ping-like (reference serveStatus)
+    code, _ = req(server, "GET", "/status")
+    assert code == 204
+    code, _ = req(server, "HEAD", "/status")
+    assert code == 204
+    # prometheus text exposition (reference serveMetrics)
+    code, body = req(server, "GET", "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert "# TYPE opengemini_httpd_queries gauge" in text
+    assert "opengemini_runtime_" in text
+    # CORS preflight
+    import urllib.request
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/query", method="OPTIONS")
+    resp = urllib.request.urlopen(r, timeout=10)
+    assert resp.status == 204
+    assert resp.headers["Access-Control-Allow-Origin"] == "*"
+
+
+def test_failpoint_endpoint(server):
+    import json as _json
+
+    from opengemini_tpu.utils import failpoint as fp
+    try:
+        code, body = req(server, "POST", "/failpoint",
+                         body=_json.dumps({"name": "wal.write.err",
+                                           "action": "error"}).encode())
+        assert code == 200 and _json.loads(body)["ok"]
+        assert "wal.write.err" in _json.loads(body)["failpoints"]
+        # write now fails through the armed failpoint
+        code, body = write_lp(server, "m v=1 1000")
+        assert code != 204
+        code, body = req(server, "POST", "/failpoint",
+                         body=_json.dumps({"name": "wal.write.err",
+                                           "enable": False}).encode())
+        assert code == 200
+        code, _ = write_lp(server, "m v=1 1000")
+        assert code == 204
+    finally:
+        # the registry is process-global: never leak an armed point
+        # into later tests
+        fp.disable_all()
