@@ -68,9 +68,17 @@ _BlockMeta = _nt("_BlockMeta", "E k0 ka")
 
 # sparse row counts at or below this reduce on host (numpy) instead of
 # paying device dispatch + result round-trips; the dense/pre-agg paths
-# carry the bulk of large scans either way
+# carry the bulk of large scans either way.
+# The SPARSE path uploads its rows every query (unlike the HBM block
+# path, which is resident): on the tunnel-attached chip the upload +
+# launch + pull latency is a ~0.5-1s fixed cost, while host numpy
+# reduces ~100M rows/s — measured 0.86s device vs 0.109s host for a
+# 10-field 180k-row colstore max(). Host wins until tens of millions
+# of rows, so the default threshold sits at 16M (tune with
+# OG_HOST_AGG_THRESHOLD on directly-attached hardware, where the
+# break-even is far lower).
 HOST_AGG_THRESHOLD = int(
-    __import__("os").environ.get("OG_HOST_AGG_THRESHOLD", "32768"))
+    __import__("os").environ.get("OG_HOST_AGG_THRESHOLD", "16000000"))
 
 # block-path dispatch (ops/blockagg.py): result grids above this pull
 # too much over the slow D2H link; files whose rows/cells ratio is
