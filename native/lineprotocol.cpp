@@ -1,0 +1,233 @@
+// Line-protocol lexer — the ingest hot loop, native.
+//
+// Role of the reference's optimized zero-copy parser
+// (lib/util/lifted/vm/protoparser/influx/parser.go; the Python
+// fallback mirrors opengemini_tpu/utils/lineprotocol.py). One pass
+// over the raw buffer producing flat columnar output:
+//   per line:  series-key byte range (raw, escapes preserved — the
+//              caller parses each UNIQUE key once), timestamp, and a
+//              [lo, lo+n) slice into the fields table
+//   per field: interned name id (names are deduped in-call with a
+//              linear memcmp table — payloads carry few distinct
+//              names), type, numeric value or raw string byte range
+// The caller groups lines by series key bytes and bulk-writes columnar
+// arrays; no per-row objects are built on either side of the ABI.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct NameTab {
+    // interned field names: offsets into the input buffer
+    static const int kMax = 256;
+    int64_t off[kMax];
+    int32_t len[kMax];
+    int n = 0;
+
+    int intern(const char* buf, int64_t o, int32_t l) {
+        for (int i = 0; i < n; i++) {
+            if (len[i] == l && memcmp(buf + off[i], buf + o, l) == 0)
+                return i;
+        }
+        if (n >= kMax) return -1;
+        off[n] = o;
+        len[n] = l;
+        return n++;
+    }
+};
+
+inline bool is_ws(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+
+}  // namespace
+
+extern "C" {
+
+// Returns number of lines lexed (>= 0), or:
+//   -1 line capacity exceeded, -2 field capacity exceeded,
+//   -3 parse error (*err_pos = byte offset), -4 name table overflow.
+// Missing timestamps set has_ts=0 (ts undefined there).
+int64_t og_lp_lex(const char* buf, int64_t n,
+                  // per line (capacity cap_lines):
+                  int64_t* series_off, int32_t* series_len,
+                  int64_t* ts, uint8_t* has_ts,
+                  int64_t* field_lo, int32_t* field_n,
+                  int64_t cap_lines,
+                  // fields table (capacity cap_fields):
+                  int32_t* fname_id, uint8_t* ftype,  // 0 f64, 1 i64,
+                  double* fval, int64_t* ival,        // 2 bool, 3 str
+                  int64_t* sval_off, int32_t* sval_len,
+                  int64_t cap_fields,
+                  // interned names (capacity 256):
+                  int64_t* name_off, int32_t* name_len,
+                  int64_t* n_names,
+                  int64_t* err_pos) {
+    NameTab names;
+    int64_t nl = 0, nf = 0;
+    int64_t i = 0;
+    while (i < n) {
+        while (i < n && (buf[i] == '\n' || is_ws(buf[i]))) i++;
+        if (i >= n) break;
+        if (buf[i] == '#') {  // comment line
+            while (i < n && buf[i] != '\n') i++;
+            continue;
+        }
+        if (nl >= cap_lines) return -1;
+        // ---- series key: to first unescaped space
+        int64_t s0 = i;
+        while (i < n && buf[i] != ' ' && buf[i] != '\n') {
+            if (buf[i] == '\\' && i + 1 < n) i += 2; else i++;
+        }
+        if (i >= n || buf[i] != ' ') { *err_pos = s0; return -3; }
+        series_off[nl] = s0;
+        series_len[nl] = (int32_t)(i - s0);
+        while (i < n && buf[i] == ' ') i++;
+        // ---- fields
+        field_lo[nl] = nf;
+        int32_t nfields = 0;
+        for (;;) {
+            if (nf >= cap_fields) return -2;
+            // name: to unescaped '='
+            int64_t f0 = i;
+            while (i < n && buf[i] != '=' && buf[i] != '\n'
+                   && buf[i] != ' ') {
+                if (buf[i] == '\\' && i + 1 < n) i += 2; else i++;
+            }
+            if (i >= n || buf[i] != '=' || i == f0) {
+                *err_pos = f0;
+                return -3;
+            }
+            int id = names.intern(buf, f0, (int32_t)(i - f0));
+            if (id < 0) return -4;
+            fname_id[nf] = id;
+            i++;  // '='
+            if (i < n && buf[i] == '"') {
+                // quoted string value
+                i++;
+                int64_t v0 = i;
+                while (i < n && buf[i] != '"') {
+                    if (buf[i] == '\\' && i + 1 < n) i += 2; else i++;
+                }
+                if (i >= n) { *err_pos = v0; return -3; }
+                ftype[nf] = 3;
+                sval_off[nf] = v0;
+                sval_len[nf] = (int32_t)(i - v0);
+                i++;  // closing quote
+            } else {
+                int64_t v0 = i;
+                while (i < n && buf[i] != ',' && buf[i] != ' '
+                       && buf[i] != '\n' && buf[i] != '\r') i++;
+                int64_t vlen = i - v0;
+                if (vlen <= 0) { *err_pos = v0; return -3; }
+                char last = buf[i - 1];
+                char c0 = buf[v0];
+                if ((last == 'i' || last == 'u') && vlen > 1) {
+                    char tmp[32];
+                    if (vlen - 1 >= (int64_t)sizeof(tmp)) {
+                        *err_pos = v0;
+                        return -3;
+                    }
+                    memcpy(tmp, buf + v0, vlen - 1);
+                    tmp[vlen - 1] = 0;
+                    char* end = nullptr;
+                    errno = 0;
+                    long long v = strtoll(tmp, &end, 10);
+                    if (end == nullptr || *end != 0 || errno == ERANGE) {
+                        // out-of-range ints must REJECT (the python
+                        // fallback's arbitrary-precision int errors in
+                        // the engine), not clamp to INT64_MAX
+                        *err_pos = v0;
+                        return -3;
+                    }
+                    ftype[nf] = 1;
+                    ival[nf] = (int64_t)v;
+                } else if (c0 == 't' || c0 == 'T' || c0 == 'f'
+                           || c0 == 'F') {
+                    bool tv = (c0 == 't' || c0 == 'T');
+                    bool ok =
+                        vlen == 1
+                        || (tv && vlen == 4
+                            && (memcmp(buf + v0 + 1, "rue", 3) == 0
+                                || memcmp(buf + v0 + 1, "RUE", 3) == 0))
+                        || (!tv && vlen == 5
+                            && (memcmp(buf + v0 + 1, "alse", 4) == 0
+                                || memcmp(buf + v0 + 1, "ALSE", 4)
+                                       == 0));
+                    if (!ok) { *err_pos = v0; return -3; }
+                    ftype[nf] = 2;
+                    ival[nf] = tv ? 1 : 0;
+                } else {
+                    char tmp[64];
+                    if (vlen >= (int64_t)sizeof(tmp)) {
+                        *err_pos = v0;
+                        return -3;
+                    }
+                    // strtod accepts hex floats ("0x10") that the
+                    // python parser rejects — acceptance must not
+                    // depend on whether the native lib loaded
+                    for (int64_t q = 0; q < vlen; q++) {
+                        char cq = buf[v0 + q];
+                        if (cq == 'x' || cq == 'X') {
+                            *err_pos = v0;
+                            return -3;
+                        }
+                    }
+                    memcpy(tmp, buf + v0, vlen);
+                    tmp[vlen] = 0;
+                    char* end = nullptr;
+                    double v = strtod(tmp, &end);
+                    if (end == nullptr || *end != 0) {
+                        *err_pos = v0;
+                        return -3;
+                    }
+                    ftype[nf] = 0;
+                    fval[nf] = v;
+                }
+            }
+            nf++;
+            nfields++;
+            if (i < n && buf[i] == ',') { i++; continue; }
+            break;
+        }
+        field_n[nl] = nfields;
+        // ---- optional timestamp
+        while (i < n && buf[i] == ' ') i++;
+        if (i < n && buf[i] != '\n' && buf[i] != '\r') {
+            int64_t t0 = i;
+            char tmp[32];
+            while (i < n && buf[i] != '\n' && buf[i] != '\r'
+                   && buf[i] != ' ')
+                i++;
+            int64_t tlen = i - t0;
+            if (tlen >= (int64_t)sizeof(tmp)) { *err_pos = t0; return -3; }
+            memcpy(tmp, buf + t0, tlen);
+            tmp[tlen] = 0;
+            char* end = nullptr;
+            errno = 0;
+            long long tv = strtoll(tmp, &end, 10);
+            if (end == nullptr || *end != 0 || errno == ERANGE) {
+                *err_pos = t0;
+                return -3;
+            }
+            ts[nl] = (int64_t)tv;
+            has_ts[nl] = 1;
+            // only whitespace may follow
+            while (i < n && is_ws(buf[i])) i++;
+            if (i < n && buf[i] != '\n') { *err_pos = i; return -3; }
+        } else {
+            ts[nl] = 0;
+            has_ts[nl] = 0;
+        }
+        nl++;
+    }
+    for (int k = 0; k < names.n; k++) {
+        name_off[k] = names.off[k];
+        name_len[k] = names.len[k];
+    }
+    *n_names = names.n;
+    return nl;
+}
+
+}  // extern "C"

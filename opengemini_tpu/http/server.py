@@ -31,7 +31,7 @@ from .. import __version__
 from ..query import QueryExecutor, ParseError, parse_query
 from ..utils import get_logger
 from ..utils.errors import GeminiError
-from ..utils.lineprotocol import PRECISION_NS, parse_lines
+from ..utils.lineprotocol import PRECISION_NS
 
 log = get_logger(__name__)
 
@@ -478,10 +478,13 @@ class HttpServer:
                                   f'database "{db}"'}
         precision = params.get("precision", "ns")
         try:
-            rows = parse_lines(body.decode("utf-8"),
-                               default_time_ns=int(time.time() * 1e9),
-                               precision=precision)
-            n = self.engine.write_points(db, rows)
+            # decode ONCE: the utf-8 gate and the fallback parser share
+            # this str; the fast path lexes the raw bytes
+            body_text = body.decode("utf-8")
+            from ..utils.lineprotocol import ingest_lines
+            n = ingest_lines(self.engine, db, body,
+                             default_time_ns=int(time.time() * 1e9),
+                             precision=precision, text=body_text)
         except GeminiError as e:
             self._bump("write_errors")
             return 400, {"error": str(e)}

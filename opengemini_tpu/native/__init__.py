@@ -103,6 +103,16 @@ def _bind(lib) -> None:
         lib.og_gorilla_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+        _i64p = ctypes.POINTER(ctypes.c_int64)
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        _u8p = ctypes.POINTER(ctypes.c_uint8)
+        _f64p = ctypes.POINTER(ctypes.c_double)
+        lib.og_lp_lex.restype = ctypes.c_int64
+        lib.og_lp_lex.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            _i64p, _i32p, _i64p, _u8p, _i64p, _i32p, ctypes.c_int64,
+            _i32p, _u8p, _f64p, _i64p, _i64p, _i32p, ctypes.c_int64,
+            _i64p, _i32p, _i64p, _i64p]
 
 
 def native_available() -> bool:
@@ -414,3 +424,90 @@ def gorilla_decode(buf, n: int):
         raise ValueError("gorilla decode failed (truncated or corrupt "
                          "input)")
     return out
+
+
+# --------------------------------------------------------- line protocol
+
+class LpLex:
+    """Flat columnar lex of a line-protocol buffer (see
+    native/lineprotocol.cpp). All arrays are trimmed views."""
+
+    __slots__ = ("n_lines", "series_off", "series_len", "ts", "has_ts",
+                 "field_lo", "field_n", "fname_id", "ftype", "fval",
+                 "ival", "sval_off", "sval_len", "names")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class LpParseError(ValueError):
+    def __init__(self, pos: int):
+        super().__init__(f"line protocol parse error at byte {pos}")
+        self.pos = pos
+
+
+def lp_lex(data: bytes):
+    """Lex a line-protocol payload natively. Returns LpLex, raises
+    LpParseError on malformed input (caller falls back to the Python
+    parser for its richer error messages), or returns None when the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(data)
+    cap_lines = max(64, n // 16)
+    cap_fields = max(64, n // 8)
+    while True:
+        so = np.empty(cap_lines, dtype=np.int64)
+        sl = np.empty(cap_lines, dtype=np.int32)
+        ts = np.empty(cap_lines, dtype=np.int64)
+        ht = np.empty(cap_lines, dtype=np.uint8)
+        flo = np.empty(cap_lines, dtype=np.int64)
+        fn = np.empty(cap_lines, dtype=np.int32)
+        fid = np.empty(cap_fields, dtype=np.int32)
+        fty = np.empty(cap_fields, dtype=np.uint8)
+        fv = np.empty(cap_fields, dtype=np.float64)
+        iv = np.empty(cap_fields, dtype=np.int64)
+        svo = np.empty(cap_fields, dtype=np.int64)
+        svl = np.empty(cap_fields, dtype=np.int32)
+        no = np.empty(256, dtype=np.int64)
+        nl_ = np.empty(256, dtype=np.int32)
+        nn = ctypes.c_int64(0)
+        err = ctypes.c_int64(0)
+
+        def p(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        rc = lib.og_lp_lex(
+            data, n,
+            p(so, ctypes.c_int64), p(sl, ctypes.c_int32),
+            p(ts, ctypes.c_int64), p(ht, ctypes.c_uint8),
+            p(flo, ctypes.c_int64), p(fn, ctypes.c_int32), cap_lines,
+            p(fid, ctypes.c_int32), p(fty, ctypes.c_uint8),
+            p(fv, ctypes.c_double), p(iv, ctypes.c_int64),
+            p(svo, ctypes.c_int64), p(svl, ctypes.c_int32), cap_fields,
+            p(no, ctypes.c_int64), p(nl_, ctypes.c_int32),
+            ctypes.byref(nn), ctypes.byref(err))
+        if rc == -1:
+            cap_lines *= 2
+            continue
+        if rc == -2:
+            cap_fields *= 2
+            continue
+        if rc == -3:
+            raise LpParseError(int(err.value))
+        if rc == -4:
+            return None          # >256 distinct names: python path
+        nlines = int(rc)
+        nfields = int(flo[nlines - 1] + fn[nlines - 1]) if nlines else 0
+        names = [data[int(o):int(o) + int(ln)]
+                 for o, ln in zip(no[:nn.value], nl_[:nn.value])]
+        return LpLex(
+            n_lines=nlines, series_off=so[:nlines],
+            series_len=sl[:nlines], ts=ts[:nlines], has_ts=ht[:nlines],
+            field_lo=flo[:nlines], field_n=fn[:nlines],
+            fname_id=fid[:nfields], ftype=fty[:nfields],
+            fval=fv[:nfields], ival=iv[:nfields],
+            sval_off=svo[:nfields], sval_len=svl[:nfields],
+            names=names)

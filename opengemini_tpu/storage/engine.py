@@ -234,32 +234,58 @@ class Engine:
         shards by time slice, no per-row Python objects. Write hooks
         (streams, subscribers) are fed materialized rows only when any
         are registered."""
+        return self.write_record_batch(
+            db_name, [(mst, tags, times, fields)], create_db=create_db)
+
+    def write_record_batch(self, db_name: str, batches,
+                           create_db: bool = True) -> int:
+        """Multi-series bulk ingest: [(mst, tags, times, fields)] —
+        one index fsync + one WAL frame per shard for the WHOLE batch
+        (shard.write_columns_batch; the per-series write_record path
+        pays an index fsync per new series)."""
         import numpy as np
         db = (self.create_database(db_name) if create_db
               else self.database(db_name))
-        times = np.ascontiguousarray(times, dtype=np.int64)
         sd = db.opts.shard_duration
-        slots = times // sd
+        per_shard: dict[int, list] = {}
+        for mst, tags, times, fields in batches:
+            times = np.ascontiguousarray(times, dtype=np.int64)
+            slots = times // sd
+            for gi in np.unique(slots):
+                m = slots == gi
+                per_shard.setdefault(int(gi), []).append(
+                    (mst, tags, times[m],
+                     {k: np.asarray(v)[m] for k, v in fields.items()}))
         n = 0
-        for gi in np.unique(slots):
-            m = slots == gi
-            sub_t = times[m]
-            sub_f = {k: np.asarray(v)[m] for k, v in fields.items()}
-            shard = db.shard_for_time(int(gi) * sd)
-            n += shard.write_columns(mst, tags, sub_t, sub_f)
-        if n and self.write_hooks:
+        written: list = []
+        err: Exception | None = None
+        for gi, ents in sorted(per_shard.items()):
+            try:
+                shard = db.shard_for_time(gi * sd)
+                n += shard.write_columns_batch(ents)
+                written.extend(ents)
+            except Exception as e:
+                # keep going like write_points: hooks must see every
+                # row that WAS stored even when a later shard fails
+                err = e
+        if written and self.write_hooks:
             from .rows import PointRow
-            np_fields = {k: np.asarray(v) for k, v in fields.items()}
-            rows = [PointRow(mst, tags,
+            rows = []
+            for mst, tags, times, fields in written:
+                np_fields = {k: np.asarray(v) for k, v in fields.items()}
+                rows.extend(
+                    PointRow(mst, tags,
                              {k: v[i].item()
                               for k, v in np_fields.items()},
                              int(times[i]))
-                    for i in range(len(times))]
+                    for i in range(len(times)))
             for hook in self.write_hooks:
                 try:
                     hook(db_name, rows)
                 except Exception:
                     log.exception("write hook failed")
+        if err is not None:
+            raise err
         return n
 
     # ---- reads -----------------------------------------------------------

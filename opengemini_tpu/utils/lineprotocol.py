@@ -102,16 +102,7 @@ def _parse_line(line: str, default_time: int, mult: int) -> PointRow:
     if len(parts) < 2 or len(parts) > 3:
         raise ErrInvalidLineProtocol(f"malformed line: {line!r}")
 
-    head = _split_unescaped(parts[0], ",")
-    measurement = _unescape(head[0])
-    if not measurement:
-        raise ErrInvalidLineProtocol(f"empty measurement: {line!r}")
-    tags = {}
-    for t in head[1:]:
-        kv = _split_unescaped(t, "=")
-        if len(kv) != 2 or not kv[0]:
-            raise ErrInvalidLineProtocol(f"bad tag {t!r} in {line!r}")
-        tags[_unescape(kv[0])] = _unescape(kv[1])
+    measurement, tags = parse_series_key(parts[0])
 
     fields: dict = {}
     for fpart in _split_fields(parts[1]):
@@ -198,3 +189,126 @@ def _parse_value(v: str, line: str):
         return float(v)
     except ValueError:
         raise ErrInvalidLineProtocol(f"bad value {v!r} in {line!r}")
+
+
+# ------------------------------------------------- columnar fast ingest
+
+def parse_series_key(key: str) -> tuple[str, dict]:
+    """'measurement[,tag=val...]' (escapes preserved) → (name, tags)."""
+    head = _split_unescaped(key, ",")
+    measurement = _unescape(head[0])
+    if not measurement:
+        raise ErrInvalidLineProtocol(f"empty measurement in {key!r}")
+    tags = {}
+    for t in head[1:]:
+        kv = _split_unescaped(t, "=")
+        if len(kv) != 2 or not kv[0]:
+            raise ErrInvalidLineProtocol(f"bad tag {t!r} in {key!r}")
+        tags[_unescape(kv[0])] = _unescape(kv[1])
+    return measurement, tags
+
+
+def ingest_lines(engine, db_name: str, data: bytes,
+                 default_time_ns: int = 0,
+                 precision: str = "ns",
+                 text: str | None = None) -> int:
+    """Columnar fast-path ingest: the native lexer
+    (native/lineprotocol.cpp — the role of the reference's optimized
+    protoparser, lib/util/lifted/vm/protoparser/influx/parser.go)
+    produces flat arrays; lines group by raw series-key bytes, series
+    keys parse ONCE per unique key, and values reach the engine as
+    numpy arrays via write_record — no per-row Python objects.
+
+    Falls back to parse_lines + write_points whenever the payload needs
+    richer handling: native lib unavailable, parse errors (for the
+    Python parser's error messages), string/bool fields, >256 distinct
+    field names, or lines of one series with differing field sets."""
+    import numpy as np
+
+    mult = PRECISION_NS.get(precision)
+    if mult is None:
+        raise ErrInvalidLineProtocol(f"bad precision {precision}")
+    if isinstance(data, str):
+        data = data.encode()
+
+    def slow() -> int:
+        t = (text if text is not None
+             else data.decode("utf-8", errors="replace"))
+        rows = parse_lines(t, default_time_ns, precision)
+        return engine.write_points(db_name, rows)
+
+    if not hasattr(engine, "write_record"):
+        return slow()
+    from ..native import LpParseError, lp_lex
+    try:
+        lex = lp_lex(data)
+    except LpParseError:
+        return slow()                 # python path's error messages
+    if lex is None or lex.n_lines == 0:
+        return slow()
+    if lex.ftype.size and int(lex.ftype.max()) >= 2:
+        return slow()                 # strings/bools: schema-rich path
+    names = []
+    for nb in lex.names:
+        s = nb.decode("utf-8", errors="replace")
+        names.append(_unescape(s) if "\\" in s else s)
+
+    ts = np.where(lex.has_ts.astype(bool),
+                  lex.ts * mult, default_time_ns)
+    # group lines by raw series-key bytes
+    mv = memoryview(data)
+    gids = np.empty(lex.n_lines, dtype=np.int64)
+    gmap: dict[bytes, int] = {}
+    key_list: list[bytes] = []
+    so, sl = lex.series_off, lex.series_len
+    for i in range(lex.n_lines):
+        k = bytes(mv[so[i]:so[i] + sl[i]])
+        gi = gmap.get(k)
+        if gi is None:
+            gi = gmap[k] = len(key_list)
+            key_list.append(k)
+        gids[i] = gi
+
+    line_of_field = np.repeat(np.arange(lex.n_lines), lex.field_n)
+    gid_f = gids[line_of_field]
+    order = np.lexsort((lex.fname_id, gid_f))
+    sgid = gid_f[order]
+    sfid = lex.fname_id[order]
+    glo = np.searchsorted(sgid, np.arange(len(key_list)))
+    ghi = np.searchsorted(sgid, np.arange(1, len(key_list) + 1))
+    group_sizes = np.bincount(gids, minlength=len(key_list))
+    # validate and assemble EVERY group before writing anything — a
+    # mid-loop fallback after a partial write would double-ingest
+    batches = []
+    for gi, key in enumerate(key_list):
+        seg = order[glo[gi]:ghi[gi]]
+        fids_g = sfid[glo[gi]:ghi[gi]]
+        n_lines_g = int(group_sizes[gi])
+        fields: dict = {}
+        times_g = None
+        for fid in np.unique(fids_g):
+            rows_f = seg[fids_g == fid]
+            if len(rows_f) != n_lines_g:
+                return slow()         # sparse field sets: per-row path
+            ity = lex.ftype[rows_f]
+            if int(ity.min()) != int(ity.max()):
+                return slow()         # mixed types within one field
+            tg = ts[line_of_field[rows_f]]
+            if times_g is None:
+                times_g = tg
+            elif not np.array_equal(times_g, tg):
+                return slow()         # field/time misalignment
+            fields[names[int(fid)]] = (lex.ival[rows_f]
+                                       if int(ity[0]) == 1
+                                       else lex.fval[rows_f])
+        if not fields:
+            return slow()
+        mst, tags = parse_series_key(key.decode("utf-8",
+                                                errors="replace"))
+        batches.append((mst, tags, times_g, fields))
+    if hasattr(engine, "write_record_batch"):
+        return engine.write_record_batch(db_name, batches)
+    n = 0
+    for mst, tags, times_g, fields in batches:
+        n += engine.write_record(db_name, mst, tags, times_g, fields)
+    return n

@@ -201,3 +201,110 @@ class TestNativeGorilla:
         w.write(0, 64)
         with pytest.raises(ValueError):
             native.gorilla_decode(w.finish(), 2)
+
+
+# ------------------------------------------------- line protocol lexer
+
+class TestLineProtocolNative:
+    def test_lex_basic(self):
+        from opengemini_tpu.native import lp_lex
+        lex = lp_lex(b"cpu,h=a u=1.5,c=3i 1000\nmem v=t\n")
+        if lex is None:
+            import pytest
+            pytest.skip("native lib unavailable")
+        assert lex.n_lines == 2
+        assert bytes(b"cpu,h=a") == b"cpu,h=a"
+        data = b"cpu,h=a u=1.5,c=3i 1000\nmem v=t\n"
+        s0 = data[lex.series_off[0]:lex.series_off[0]+lex.series_len[0]]
+        assert s0 == b"cpu,h=a"
+        assert lex.ts[0] == 1000 and lex.has_ts[0] == 1
+        assert lex.has_ts[1] == 0
+        assert [n for n in lex.names] == [b"u", b"c", b"v"]
+        assert list(lex.ftype[:3]) == [0, 1, 2]
+        assert lex.fval[0] == 1.5 and lex.ival[1] == 3
+        assert lex.ival[2] == 1          # t -> true
+
+    def test_lex_strings_and_escapes(self):
+        from opengemini_tpu.native import lp_lex
+        data = b'm,t=a\\ b s="x,\\" y",f=2 5\n'
+        lex = lp_lex(data)
+        if lex is None:
+            import pytest
+            pytest.skip("native lib unavailable")
+        assert lex.n_lines == 1
+        s0 = data[lex.series_off[0]:lex.series_off[0]+lex.series_len[0]]
+        assert s0 == b"m,t=a\\ b"
+        assert lex.ftype[0] == 3        # string
+        sv = data[lex.sval_off[0]:lex.sval_off[0]+lex.sval_len[0]]
+        assert sv == b'x,\\" y'
+
+    def test_lex_errors(self):
+        import pytest
+        from opengemini_tpu.native import LpParseError, lp_lex
+        if lp_lex(b"m v=1 1\n") is None:
+            pytest.skip("native lib unavailable")
+        with pytest.raises(LpParseError):
+            lp_lex(b"m v=abc 1\n")
+        with pytest.raises(LpParseError):
+            lp_lex(b"justameasurement\n")
+        with pytest.raises(LpParseError):
+            lp_lex(b"m v=1 123 trailing\n")
+
+
+class TestIngestLines:
+    def _both(self, tmp_path, payload, q):
+        """Run payload through the fast path and the row path; compare
+        query results."""
+        from opengemini_tpu.query import QueryExecutor, parse_query
+        from opengemini_tpu.storage import Engine
+        from opengemini_tpu.utils.lineprotocol import (ingest_lines,
+                                                       parse_lines)
+        e1 = Engine(str(tmp_path / "a"))
+        e2 = Engine(str(tmp_path / "b"))
+        try:
+            n1 = ingest_lines(e1, "d", payload.encode(),
+                              default_time_ns=777)
+            n2 = e2.write_points("d", parse_lines(payload,
+                                                  default_time_ns=777))
+            assert n1 == n2
+            r1 = QueryExecutor(e1).execute(parse_query(q)[0], "d")
+            r2 = QueryExecutor(e2).execute(parse_query(q)[0], "d")
+            assert r1 == r2
+            return r1
+        finally:
+            e1.close()
+            e2.close()
+
+    def test_equivalence_numeric(self, tmp_path):
+        payload = "\n".join(
+            f"cpu,h=h{i % 5},r=r{i % 2} u={i}.25,c={i}i {i * 1000}"
+            for i in range(500))
+        self._both(tmp_path, payload,
+                   "SELECT sum(u), sum(c), count(u) FROM cpu GROUP BY h")
+
+    def test_fallback_shapes(self, tmp_path):
+        # strings, bools, sparse field sets, missing timestamps: all
+        # must produce identical results via the fallback
+        payload = ("m,h=a s=\"txt\",v=1 1000\n"
+                   "m,h=a v=2 2000\n"            # sparse (no s)
+                   "m,h=b b=true,v=3 3000\n"
+                   "m,h=c v=4\n")                # default time
+        self._both(tmp_path, payload, "SELECT count(v) FROM m GROUP BY h")
+
+    def test_precision_and_duplicates(self, tmp_path):
+        payload = ("cpu,h=a v=1 1\n"
+                   "cpu,h=a v=2 1\n"             # duplicate timestamp
+                   "cpu,h=a v=3 2\n")
+        from opengemini_tpu.query import QueryExecutor, parse_query
+        from opengemini_tpu.storage import Engine
+        from opengemini_tpu.utils.lineprotocol import ingest_lines
+        eng = Engine(str(tmp_path / "p"))
+        try:
+            n = ingest_lines(eng, "d", payload.encode(), precision="s")
+            assert n == 3
+            r = QueryExecutor(eng).execute(
+                parse_query("SELECT v FROM cpu")[0], "d")
+            times = [row[0] for row in r["series"][0]["values"]]
+            assert times[-1] == 2 * 10**9   # seconds scaled to ns
+        finally:
+            eng.close()
