@@ -130,7 +130,6 @@ def colstore_phase() -> dict:
     phase reports the columnstore e2e throughput."""
     from opengemini_tpu.query import QueryExecutor, parse_query
     from opengemini_tpu.storage import Engine, EngineOptions
-    from opengemini_tpu.storage.rows import PointRow
 
     fields = [f"usage_{k}" for k in
               ("user", "system", "idle", "nice", "iowait", "irq",
@@ -144,23 +143,19 @@ def colstore_phase() -> dict:
         eng.create_columnstore("bench", "cpu", ["hostname"],
                                {"hostname": "bloom"})
         t0 = time.perf_counter()
-        rows = []
         n = 0
+        times = np.arange(points, dtype=np.int64) * (STEP_S * 10**9)
+        batch = []
         for h in range(CS_HOSTS):
             vals = np.round(np.clip(
                 rng.normal(50, 15, (len(fields), points)), 0, 100), 2)
-            host = f"host_{h}"
-            for i in range(points):
-                rows.append(PointRow(
-                    "cpu", {"hostname": host},
-                    {f: float(vals[j, i])
-                     for j, f in enumerate(fields)},
-                    i * STEP_S * 10**9))
-            if len(rows) >= 100_000:
-                n += eng.write_points("bench", rows)
-                rows = []
-        if rows:
-            n += eng.write_points("bench", rows)
+            batch.append(("cpu", {"hostname": f"host_{h}"}, times,
+                          {f: vals[j] for j, f in enumerate(fields)}))
+            if len(batch) >= 500:
+                n += eng.write_record_batch("bench", batch)
+                batch = []
+        if batch:
+            n += eng.write_record_batch("bench", batch)
         eng.flush_all()
         t_ing = time.perf_counter() - t0
 

@@ -228,17 +228,7 @@ class Shard:
         batch = []
         created_sid = False
         for r in rows:
-            if r.measurement in self.cs_options:
-                # column-store measurements materialize tags as columns:
-                # a tag/field name collision must bounce HERE, before the
-                # row becomes durable — at flush time it would wedge the
-                # whole shard's snapshot loop forever
-                clash = set(r.tags) & set(r.fields)
-                if clash:
-                    raise ErrTypeConflict(
-                        f"tag names collide with field names in "
-                        f"column-store measurement {r.measurement!r}: "
-                        f"{sorted(clash)}")
+            self._check_cs_collision(r.measurement, r.tags, r.fields)
             before = self.index.series_cardinality
             sid = self.index.get_or_create_sid(r.measurement, r.tags)
             created_sid |= self.index.series_cardinality != before
@@ -278,6 +268,21 @@ class Shard:
         Returns rows written."""
         return self.write_columns_batch([(mst, tags, times, fields)])
 
+    def _check_cs_collision(self, mst: str, tags: dict,
+                            fields: dict) -> None:
+        """Column-store measurements materialize tags as columns at
+        flush: a tag/field name collision must bounce BEFORE the rows
+        become durable — at flush time it would wedge the whole
+        shard's snapshot loop forever. Shared by the row and bulk
+        write paths."""
+        if mst not in self.cs_options:
+            return
+        clash = set(tags) & set(fields)
+        if clash:
+            raise ErrTypeConflict(
+                f"tag names collide with field names in "
+                f"column-store measurement {mst!r}: {sorted(clash)}")
+
     @staticmethod
     def _normalize_cols(fields: dict, n: int):
         """Shared column normalization of the bulk write paths: numeric
@@ -315,9 +320,7 @@ class Shard:
         prepared = []
         created_any = False
         for mst, tags, times, fields in entries:
-            if mst in self.cs_options:
-                raise ErrTypeConflict(
-                    "bulk columnar writes target row-store measurements")
+            self._check_cs_collision(mst, tags, fields)
             n1 = len(times)
             if n1 == 0:
                 continue
