@@ -630,6 +630,63 @@ SUITE = [
         ],
     },
     {
+        "name": "delete with tag predicate then query",
+        "writes": "dm,host=a v=1 1000\ndm,host=a v=2 2000\n"
+                  "dm,host=b v=3 1000",
+        "queries": [
+            ("DELETE FROM dm WHERE host = 'a'",
+             [{"statement_id": 0}]),
+            ("SELECT count(v) FROM dm GROUP BY host",
+             ok(series("dm", ["time", "count"], [[0, 1]],
+                       {"host": "b"}))),
+        ],
+    },
+    {
+        "name": "drop series scatters across the cluster",
+        "writes": "ds,host=a v=1 1000\nds,host=b v=2 1000\n"
+                  "ds,host=c v=3 1000",
+        "queries": [
+            ("DROP SERIES FROM ds WHERE host = 'b'",
+             [{"statement_id": 0}]),
+            ("SHOW SERIES CARDINALITY FROM ds",
+             ok(series("series cardinality",
+                       ["cardinality estimation"], [[2]]))),
+            ("SELECT sum(v) FROM ds",
+             ok(series("ds", ["time", "sum"], [[0, 4.0]]))),
+        ],
+    },
+    {
+        "name": "string field equality predicate",
+        "writes": 'ev,h=a level="warn",v=1 1000\n'
+                  'ev,h=a level="error",v=2 2000\n'
+                  'ev,h=b level="error",v=3 3000',
+        "queries": [
+            ("SELECT count(v) FROM ev WHERE level = 'error'",
+             ok(series("ev", ["time", "count"], [[0, 2]]))),
+            ("SELECT v FROM ev WHERE level != 'error'",
+             ok(series("ev", ["time", "v"], [[1000, 1.0]]))),
+        ],
+    },
+    {
+        "name": "percentile nearest rank with point time",
+        "writes": "pi v=1i 1000\npi v=2i 2000\npi v=3i 3000\n"
+                  "pi v=4i 4000",
+        "queries": [
+            ("SELECT percentile(v, 50) FROM pi",
+             ok(series("pi", ["time", "percentile"], [[2000, 2]]))),
+        ],
+    },
+    {
+        "name": "slimit with group by star",
+        "writes": "sg,h=a v=1 1000\nsg,h=b v=2 1000\nsg,h=c v=3 1000",
+        "queries": [
+            ("SELECT sum(v) FROM sg GROUP BY * SLIMIT 2",
+             ok(series("sg", ["time", "sum"], [[0, 1.0]], {"h": "a"}),
+                series("sg", ["time", "sum"], [[0, 2.0]],
+                       {"h": "b"}))),
+        ],
+    },
+    {
         "name": "select into writes result rows",
         "writes": "m v=1 1000\nm v=3 2000",
         "single_only": True,
@@ -771,3 +828,19 @@ def test_parse_error_returns_400_body(server):
         assert e.code == 400
         body = json.loads(e.read())
         assert "GROUP BY time interval must be positive" in body["error"]
+
+
+def test_percentile_integer_type_preserved(server):
+    """The generic runner's == cannot distinguish 2 from 2.0 — assert
+    the serialized TYPE explicitly (int fields must not come back as
+    floats)."""
+    db = "suite_ptype"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=b"pi v=1i 1000\npi v=2i 2000\npi v=3i 3000",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    got = _query(server, db, "SELECT percentile(v, 50) FROM pi")
+    val = got["results"][0]["series"][0]["values"][0][1]
+    assert isinstance(val, int) and not isinstance(val, bool), val
