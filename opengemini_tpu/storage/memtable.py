@@ -38,41 +38,66 @@ def field_type_of(v) -> DataType:
 
 
 class _SeriesBuf:
-    """Column builders for one series: parallel python lists per field."""
+    """Column builders for one series, stored as CHUNK ENTRIES so the
+    bulk columnar path keeps its numpy arrays untouched (the previous
+    list-based builders converted every value through .tolist() —
+    measured as the ingest floor once the WAL/index syncs were
+    amortized). Two entry kinds interleave freely:
 
-    __slots__ = ("times", "fields")
+      ["list", start_row, times_list, {field: list-with-None-backfill}]
+          — per-row appends accumulate in the trailing list entry
+      ["np",   start_row, times_i64,  {field: ndarray}]
+          — bulk appends land as-is, all rows valid
+
+    Rows align by global row index; series_record() sorts by time at
+    materialization, so entry order never matters semantically."""
+
+    __slots__ = ("n", "entries")
 
     def __init__(self):
-        self.times: list[int] = []
-        self.fields: dict[str, list] = {}
+        self.n = 0
+        self.entries: list = []
 
     def append(self, fields: dict, time: int, schema: dict[str, DataType]):
-        n = len(self.times)
-        self.times.append(time)
-        for k, v in fields.items():
-            col = self.fields.get(k)
+        e = self.entries[-1] if self.entries else None
+        if e is None or e[0] != "list":
+            e = ["list", self.n, [], {}]
+            self.entries.append(e)
+        tl, fd = e[2], e[3]
+        k = len(tl)
+        tl.append(time)
+        for key, v in fields.items():
+            col = fd.get(key)
             if col is None:
-                col = self.fields[k] = [None] * n
+                col = fd[key] = [None] * k
             col.append(v)
         # backfill fields not present in this row
-        for k, col in self.fields.items():
-            if len(col) < len(self.times):
+        for col in fd.values():
+            if len(col) < len(tl):
                 col.append(None)
+        self.n += 1
 
-    def extend(self, times: list, fields: dict[str, list]) -> None:
-        """Bulk columnar append (record-writer path): every field list
-        is row-aligned with `times`."""
-        n0 = len(self.times)
-        self.times.extend(times)
-        total = len(self.times)
-        for k, vals in fields.items():
-            col = self.fields.get(k)
-            if col is None:
-                col = self.fields[k] = [None] * n0
-            col.extend(vals)
-        for k, col in self.fields.items():
-            if len(col) < total:
-                col.extend([None] * (total - len(col)))
+    def extend_arrays(self, times: np.ndarray,
+                      fields: dict[str, np.ndarray]) -> None:
+        """Bulk columnar append: row-aligned, all-valid arrays stored
+        with zero per-value conversion."""
+        self.entries.append(["np", self.n, times, fields])
+        self.n += len(times)
+
+    def entry_views(self):
+        """Consistent-prefix snapshot of the entries: rows beyond the
+        n captured HERE are excluded, so a concurrent append to the
+        trailing list entry cannot misalign or overflow a reader's
+        arrays (lock-free read contract of tables_for_read)."""
+        n = self.n
+        out = []
+        for e in self.entries[:]:
+            kind, start, tl, fd = e
+            if start >= n:
+                break
+            ln = min(len(tl), n - start)
+            out.append((kind, start, tl, fd, ln))
+        return n, out
 
 
 class MemTable:
@@ -113,7 +138,7 @@ class MemTable:
     def write_columns(self, sid: int, times, fields: dict) -> None:
         """Bulk columnar write: arrays are row-aligned, all-valid.
         Types are validated ONCE per column (the per-row path validates
-        per row)."""
+        per row); the arrays land in the buffer untouched."""
         probe = {k: (v[0].item() if hasattr(v[0], "item") else v[0])
                  for k, v in fields.items() if len(v)}
         self.validate(probe)
@@ -123,11 +148,10 @@ class MemTable:
         buf = self.series.get(sid)
         if buf is None:
             buf = self.series[sid] = _SeriesBuf()
-        tl = times.tolist() if hasattr(times, "tolist") else list(times)
-        buf.extend(tl, {k: (v.tolist() if hasattr(v, "tolist")
-                            else list(v))
-                        for k, v in fields.items()})
-        n = len(tl)
+        buf.extend_arrays(
+            np.ascontiguousarray(times, dtype=np.int64),
+            {k: np.asarray(v) for k, v in fields.items()})
+        n = len(times)
         self.rows += n
         self.approx_bytes += n * (24 + 16 * len(fields))
 
@@ -138,29 +162,57 @@ class MemTable:
         """Materialize one series as a time-sorted Record over the full
         measurement schema (missing fields → null)."""
         buf = self.series.get(sid)
-        if buf is None or not buf.times:
+        if buf is None or buf.n == 0:
             return None
-        n = len(buf.times)
+        n, views = buf.entry_views()
+        if n == 0:
+            return None
         schema = self.record_schema()
+        times = np.empty(n, dtype=np.int64)
+        for _kind, start, tl, _fd, ln in views:
+            times[start:start + ln] = tl[:ln]
         cols = []
         for f in schema:
             if f.name == "time":
-                cols.append(ColVal(DataType.TIME,
-                                   np.array(buf.times, dtype=np.int64)))
+                cols.append(ColVal(DataType.TIME, times))
                 continue
-            raw = buf.fields.get(f.name)
-            if raw is None:
-                cols.append(ColVal.nulls(f.type, n))
-                continue
-            valid = np.array([x is not None for x in raw], dtype=np.bool_)
             if f.type.is_numeric:
-                vals = np.array(
-                    [x if x is not None else 0 for x in raw],
-                    dtype=f.type.numpy_dtype)
-                cols.append(ColVal(f.type, vals, valid))
-            else:
-                cols.append(ColVal.from_strings(
-                    [x if x is not None else None for x in raw], f.type))
+                vals = np.zeros(n, dtype=f.type.numpy_dtype)
+                valid = np.zeros(n, dtype=np.bool_)
+                seen = False
+                for kind, start, tl, fd, ln in views:
+                    raw = fd.get(f.name)
+                    if raw is None:
+                        continue
+                    seen = True
+                    if kind == "np":
+                        vals[start:start + ln] = raw[:ln]
+                        valid[start:start + ln] = True
+                    else:
+                        # a concurrent row append fills tl before the
+                        # field columns — pad the not-yet-backfilled
+                        # tail as null
+                        sub = [raw[i] if i < len(raw) else None
+                               for i in range(ln)]
+                        vals[start:start + ln] = [
+                            x if x is not None else 0 for x in sub]
+                        valid[start:start + ln] = [
+                            x is not None for x in sub]
+                cols.append(ColVal(f.type, vals, valid)
+                            if seen else ColVal.nulls(f.type, n))
+                continue
+            # strings: assemble a python list view
+            raw_all: list = [None] * n
+            seen = False
+            for _kind, start, tl, fd, ln in views:
+                raw = fd.get(f.name)
+                if raw is None:
+                    continue
+                seen = True
+                for i in range(min(ln, len(raw))):
+                    raw_all[start + i] = raw[i]
+            cols.append(ColVal.from_strings(raw_all, f.type)
+                        if seen else ColVal.nulls(f.type, n))
         return Record(schema, cols).sort_by_time()
 
     def sids(self) -> list[int]:
@@ -236,10 +288,19 @@ class MemTables:
             self.active = snap
             for mst, mt in newer.items():
                 for sid, buf in mt.series.items():
-                    for i, t in enumerate(buf.times):
-                        fields = {k: col[i] for k, col in buf.fields.items()
-                                  if col[i] is not None}
-                        self.write(mst, sid, fields, t)
+                    # bulk chunks re-extend wholesale (replaying a
+                    # 1M-row burst per value would hold the lock for
+                    # the exact conversion this layout avoids)
+                    for kind, _start, tl, fd in buf.entries:
+                        if kind == "np":
+                            self.write_columns(mst, sid, tl, fd)
+                            continue
+                        for i in range(len(tl)):
+                            fields = {k: col[i]
+                                      for k, col in fd.items()
+                                      if i < len(col)
+                                      and col[i] is not None}
+                            self.write(mst, sid, fields, tl[i])
 
     def tables_for_read(self) -> list[dict[str, MemTable]]:
         """Active + in-flight snapshot (reads must see both)."""
