@@ -134,3 +134,80 @@ class TestBalancer:
         assert all(pt.status == PT_ONLINE
                    for pt in client.data().pts["bal2"])
         bal.msm.close()
+
+
+def test_replica_failover_preserves_results(cluster, tmp_path):
+    """replica_n=2: after the PT owner dies, the surviving replica is
+    promoted and serves IDENTICAL query results — the role of the
+    reference's replica-consistency suite (tests/consistency_test.go;
+    failover path cluster_manager.go:482 processFailedDbPt choosing a
+    replica owner)."""
+    from opengemini_tpu.query import parse_query
+
+    client = cluster["client"]
+    stores = cluster["stores"]
+    sql = TsSql([cluster["meta"].addr])
+    sql.start()
+    cm = None
+    try:
+        client.create_database("cons", num_pts=1, replica_n=2)
+        n = sql.facade.write_points("cons", [
+            PointRow("m", {"h": f"h{i % 4}"}, {"v": i * 1.25}, i * NS)
+            for i in range(64)])
+        assert n == 64
+
+        stmt = parse_query(
+            "SELECT count(v), sum(v), min(v), max(v) FROM m "
+            "GROUP BY h")[0]
+
+        def canon(res):
+            return sorted((tuple(sorted((s2.get("tags") or {}).items())),
+                           s2["values"]) for s2 in res["series"])
+
+        client.refresh()
+        pt = client.data().pts["cons"][0]
+        owner_store = next(s for s in stores if s.node_id == pt.owner)
+        replica_store = next(s for s in stores
+                             if s.node_id != pt.owner)
+
+        def replica_row_count():
+            """ACTUAL applied rows on the replica (not series count —
+            a chunked raft apply must not fool the wait)."""
+            total = 0
+            eng = replica_store.node.engine
+            for dbk in list(eng.databases):
+                res = replica_store.node.executor.execute(
+                    parse_query("SELECT count(v) FROM m")[0], dbk)
+                for s2 in res.get("series", []):
+                    total += s2["values"][0][1]
+            return total
+
+        deadline = time.time() + 15
+        while time.time() < deadline and replica_row_count() < 64:
+            time.sleep(0.1)
+        assert replica_row_count() == 64, "replica never caught up"
+
+        baseline = sql.facade.executor.execute(stmt, "cons")
+        assert "error" not in baseline
+
+        owner_store.stop()
+        cm = ClusterManager(client, failure_timeout_s=3.0)
+        deadline = time.time() + 25
+        promoted = False
+        while time.time() < deadline and not promoted:
+            cm.sweep(time.time_ns())
+            client.refresh()
+            pt = client.data().pts["cons"][0]
+            promoted = (pt.owner == replica_store.node_id
+                        and pt.status == PT_ONLINE)
+            if not promoted:
+                time.sleep(0.3)
+        assert promoted, "PT never promoted to the replica"
+
+        after = sql.facade.executor.execute(stmt, "cons")
+        assert "error" not in after, after
+        assert canon(after) == canon(baseline), "failover lost rows"
+    finally:
+        if cm is not None:
+            cm.msm.close()
+        sql.stop()
