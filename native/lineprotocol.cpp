@@ -53,6 +53,7 @@ int64_t og_lp_lex(const char* buf, int64_t n,
                   // per line (capacity cap_lines):
                   int64_t* series_off, int32_t* series_len,
                   int64_t* ts, uint8_t* has_ts,
+                  int64_t* line_end,  // offset just past the line
                   int64_t* field_lo, int32_t* field_n,
                   int64_t cap_lines,
                   // fields table (capacity cap_fields):
@@ -220,6 +221,7 @@ int64_t og_lp_lex(const char* buf, int64_t n,
             ts[nl] = 0;
             has_ts[nl] = 0;
         }
+        line_end[nl] = i;
         nl++;
     }
     for (int k = 0; k < names.n; k++) {

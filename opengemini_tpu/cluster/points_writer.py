@@ -66,48 +66,60 @@ class PointsWriter:
         return info
 
     def _route(self, db: str, rows: list[PointRow]):
-        """rows → {(node_addr, pt_id): [rows]}; creates shard groups on
-        demand (points_writer.go:622 updateShardGroupAndShardKey)."""
-        md = self.meta.data()
-        info = md.db(db)
-        batches: dict[tuple[str, int], list[PointRow]] = {}
-        sg_cache: dict[int, object] = {}
+        """rows → {(node_addr, pt_id, owner_id): [rows]}; creates shard
+        groups on demand (points_writer.go:622
+        updateShardGroupAndShardKey)."""
+        rt = _Router(self, db)
+        batches: dict[tuple, list[PointRow]] = {}
         for r in rows:
-            slot = r.time // info.shard_duration
-            sg = sg_cache.get(slot)
-            if sg is None:
-                sg = md.shard_group_for_time(db, r.time)
-                if sg is None:
-                    self.meta.create_shard_group(db, r.time)
-                    md = self.meta.data()
-                    info = md.db(db)
-                    sg = md.shard_group_for_time(db, r.time)
-                    if sg is None:
-                        raise GeminiError("failed to create shard group")
-                sg_cache[slot] = sg
-            if info.shard_key and sg.ranged:
-                # range routing (reference DestShard shardinfo.go:359)
-                shard = sg.dest_shard(shard_key_of(r.tags,
-                                                   info.shard_key))
-            else:
-                shard = sg.shard_for(series_hash(r.measurement, r.tags))
-            pt = md.pt(db, shard.pt_id)
-            if pt is None or md.nodes.get(pt.owner) is None:
-                raise GeminiError(
-                    f"no owner node for {db} pt {shard.pt_id}")
-            if pt.status != "online":
-                # transient during migration: one refresh, then fail
-                # loudly rather than ack rows into a parked partition
-                self.meta.refresh()
-                md = self.meta.data()
-                pt = md.pt(db, shard.pt_id)
-                if pt is None or pt.status != "online":
-                    raise GeminiError(
-                        f"{db} pt {shard.pt_id} is offline")
-            owner = md.nodes[pt.owner]
-            batches.setdefault((owner.addr, shard.pt_id, owner.id),
-                               []).append(r)
+            batches.setdefault(
+                rt.target(r.time, series_hash(r.measurement, r.tags),
+                          r.tags), []).append(r)
         return batches
+
+    def _scatter_send(self, db: str, items: dict, msg: str,
+                      make_wire) -> int:
+        """Ship one payload per (addr, pt, owner) concurrently with
+        refresh-and-retry (shared by the row and line-bytes writers —
+        the subtle owner re-resolution lives ONCE). Raises
+        ErrPartialWrite when any target exhausts its retries."""
+        written = 0
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def send(addr: str, pt: int, owner_id: int, src):
+            nonlocal written
+            last: Exception | None = None
+            for _attempt in range(self.max_retries + 1):
+                # owner id travels with the batch: the store rejects
+                # writes for partitions it no longer owns, so a stale
+                # route can never silently ack rows into an orphaned
+                # engine db (they'd be invisible to queries)
+                wire = make_wire(pt, owner_id, src)
+                try:
+                    resp = self._client(addr).call(msg, wire)
+                    with lock:
+                        written += resp["written"]
+                    return
+                except RPCError as e:
+                    last = e
+                    # partition may have moved: re-resolve the owner
+                    self.meta.refresh()
+                    owner = self.meta.data().pt_owner(db, pt)
+                    if owner is not None:
+                        addr, owner_id = owner.addr, owner.id
+            with lock:
+                errors.append(f"pt {pt} @ {addr}: {last}")
+
+        threads = [threading.Thread(target=send, args=(a, p, o, src))
+                   for (a, p, o), src in items.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise ErrPartialWrite(written, errors)
+        return written
 
     # -------------------------------------------------------------- write
 
@@ -117,43 +129,130 @@ class PointsWriter:
             return 0
         self._ensure_db(db)
         batches = self._route(db, rows)
-        written = 0
-        errors: list[str] = []
-        lock = threading.Lock()
+        return self._scatter_send(
+            db, batches, "store.write_rows",
+            lambda pt, owner, batch: {"db": db, "pt": pt,
+                                      "owner": owner,
+                                      "rows": rows_to_wire(batch)})
 
-        def send(addr: str, pt: int, owner_id: int,
-                 batch: list[PointRow]):
-            nonlocal written
-            last: Exception | None = None
-            for attempt in range(self.max_retries + 1):
-                # owner id travels with the batch: the store rejects
-                # writes for partitions it no longer owns, so a stale
-                # route can never silently ack rows into an orphaned
-                # engine db (they'd be invisible to queries)
-                wire = {"db": db, "pt": pt, "owner": owner_id,
-                        "rows": rows_to_wire(batch)}
-                try:
-                    resp = self._client(addr).call("store.write_rows", wire)
-                    with lock:
-                        written += resp["written"]
-                    return
-                except RPCError as e:
-                    last = e
-                    # partition may have moved: re-resolve the owner
-                    self.meta.refresh()
-                    md = self.meta.data()
-                    owner = md.pt_owner(db, pt)
-                    if owner is not None:
-                        addr, owner_id = owner.addr, owner.id
-            with lock:
-                errors.append(f"pt {pt} @ {addr}: {last}")
+    def write_lines(self, db: str, data: bytes,
+                    default_time_ns: int = 0,
+                    precision: str = "ns") -> int:
+        """Columnar cluster ingest: lex the line-protocol payload ONCE,
+        route every line by (time slot, series hash) with series keys
+        parsed once per unique key, and scatter RAW LINE BYTES per
+        partition; each store runs its local columnar fast path
+        (`utils.lineprotocol.ingest_lines`). The role of the
+        reference's RecordWriter scatter (coordinator/
+        record_writer.go:79 — typed columns per PT queue), done at the
+        line-bytes level. Falls back to the per-row path for exotic
+        payloads or when the native lexer is unavailable."""
+        import numpy as np
 
-        threads = [threading.Thread(target=send, args=(a, p, o, b))
-                   for (a, p, o), b in batches.items()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise ErrPartialWrite(written, errors)
-        return written
+        from ..native import LpParseError, lp_lex
+        from ..utils.lineprotocol import (PRECISION_NS, parse_lines,
+                                          parse_series_key)
+        failpoint.inject("points_writer.write.err")
+        mult = PRECISION_NS.get(precision)
+        if mult is None:
+            from ..utils.errors import ErrInvalidLineProtocol
+            raise ErrInvalidLineProtocol(f"bad precision {precision}")
+        if isinstance(data, str):
+            data = data.encode()
+
+        def slow() -> int:
+            rows = parse_lines(data.decode("utf-8", errors="replace"),
+                               default_time_ns, precision)
+            return self.write_points(db, rows)
+
+        try:
+            lex = lp_lex(data)
+        except LpParseError:
+            return slow()
+        if lex is None or lex.n_lines == 0:
+            return slow()
+        self._ensure_db(db)
+        rt = _Router(self, db)
+        ts = np.where(lex.has_ts.astype(bool), lex.ts * mult,
+                      default_time_ns)
+        mv = memoryview(data)
+        key_cache: dict[bytes, tuple] = {}
+        spans: dict[tuple, list[int]] = {}
+        for i in range(lex.n_lines):
+            so = lex.series_off[i]
+            k = bytes(mv[so:so + lex.series_len[i]])
+            ent = key_cache.get(k)
+            if ent is None:
+                mstr, tags = parse_series_key(
+                    k.decode("utf-8", errors="replace"))
+                ent = key_cache[k] = (series_hash(mstr, tags), tags)
+            spans.setdefault(
+                rt.target(int(ts[i]), ent[0], ent[1]), []).append(i)
+        payloads = {
+            tgt: b"\n".join(bytes(mv[lex.series_off[i]:lex.line_end[i]])
+                            for i in idxs)
+            for tgt, idxs in spans.items()}
+        return self._scatter_send(
+            db, payloads, "store.write_lines",
+            lambda pt, owner, payload: {
+                "db": db, "pt": pt, "owner": owner, "data": payload,
+                "default_time_ns": default_time_ns,
+                "precision": precision})
+
+
+class _Router:
+    """Per-write routing context shared by the row and line paths:
+    shard groups cache per time slot (created on demand through meta
+    raft) and (slot, pt) targets cache so a million-line payload pays
+    two dict hits per line, not a catalog walk."""
+
+    def __init__(self, pw: PointsWriter, db: str):
+        self.pw = pw
+        self.db = db
+        self.md = pw.meta.data()
+        self.info = self.md.db(db)
+        self.sg_cache: dict[int, object] = {}
+        self.tgt_cache: dict[tuple, tuple] = {}
+
+    def target(self, t: int, h: int, tags: dict) -> tuple:
+        """(addr, pt_id, owner_id) for a row at time t with series
+        hash h (range-sharded dbs route by shard key instead)."""
+        slot = t // self.info.shard_duration
+        sg = self.sg_cache.get(slot)
+        if sg is None:
+            sg = self.md.shard_group_for_time(self.db, t)
+            if sg is None:
+                self.pw.meta.create_shard_group(self.db, t)
+                self.md = self.pw.meta.data()
+                self.info = self.md.db(self.db)
+                sg = self.md.shard_group_for_time(self.db, t)
+                if sg is None:
+                    raise GeminiError("failed to create shard group")
+            self.sg_cache[slot] = sg
+        if self.info.shard_key and sg.ranged:
+            # range routing (reference DestShard shardinfo.go:359)
+            shard = sg.dest_shard(shard_key_of(tags,
+                                               self.info.shard_key))
+        else:
+            shard = sg.shard_for(h)
+        key = (slot, shard.pt_id)
+        tgt = self.tgt_cache.get(key)
+        if tgt is not None:
+            return tgt
+        pt = self.md.pt(self.db, shard.pt_id)
+        if pt is None or self.md.nodes.get(pt.owner) is None:
+            raise GeminiError(
+                f"no owner node for {self.db} pt {shard.pt_id}")
+        if pt.status != "online":
+            # transient during migration: one refresh, then fail
+            # loudly rather than ack rows into a parked partition
+            self.pw.meta.refresh()
+            self.md = self.pw.meta.data()
+            pt = self.md.pt(self.db, shard.pt_id)
+            if pt is None or pt.status != "online":
+                raise GeminiError(
+                    f"{self.db} pt {shard.pt_id} is offline")
+        owner = self.md.nodes[pt.owner]
+        tgt = (owner.addr, shard.pt_id, owner.id)
+        self.tgt_cache[key] = tgt
+        return tgt

@@ -190,6 +190,58 @@ class ReplicationManager:
 
     # -------------------------------------------------------------- write
 
+    def read_barrier(self, db: str, pt_id: int,
+                     timeout: float = 5.0) -> None:
+        """Follower-read barrier (raft read-index): before scanning a
+        replicated partition, wait until this member has applied
+        everything the group had COMMITTED at barrier time. The write
+        path acks at the group leader's apply, so without this a scan
+        routed to a follower PT owner can miss an acked write — the
+        read-your-writes contract map_pts documents (sql_node.py)."""
+        import time as _time
+
+        key = group_key(db, pt_id)
+        with self._lock:
+            g = self.groups.get(key)
+        if g is None:
+            return
+        r = g.raft
+        target = None
+        if not r.is_leader:
+            leader = r.wait_leader(1.0)
+            addr = r.peers.get(leader) if leader else None
+            if addr is not None and leader != str(self.store.node_id):
+                try:
+                    resp = self.store.peer_call(
+                        addr, "store.raft_commit",
+                        {"db": db, "pt": pt_id})
+                    target = resp["commit"]
+                except Exception:
+                    target = None     # degraded: local commit below
+        if target is None:
+            target = r.commit_index
+        deadline = _time.monotonic() + timeout
+        while r.last_applied < target \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        if r.last_applied < target:
+            # serve the scan anyway, but LOUDLY: a silent stale read
+            # is indistinguishable from a correct one
+            log.warning(
+                "read barrier timeout on %s/pt%d: applied=%d < "
+                "commit=%d — scan may miss recent writes",
+                db, pt_id, r.last_applied, target)
+
+    def has_group(self, db: str, pt_id: int) -> bool:
+        with self._lock:
+            return group_key(db, pt_id) in self.groups
+
+    def commit_index(self, db: str, pt_id: int) -> int:
+        key = group_key(db, pt_id)
+        with self._lock:
+            g = self.groups.get(key)
+        return g.raft.commit_index if g is not None else 0
+
     def write(self, db: str, pt_id: int, rows_wire) -> int:
         """Replicated write: propose on the PT group; if this member is
         not the group leader, forward the write to the leader member's

@@ -443,6 +443,14 @@ class ClusterFacade:
     def write_points(self, db: str, rows) -> int:
         return self.writer.write_points(db, rows)
 
+    def write_lines(self, db: str, data: bytes,
+                    default_time_ns: int = 0,
+                    precision: str = "ns") -> int:
+        """Columnar line-protocol scatter (points_writer._write_lines)."""
+        return self.writer.write_lines(db, data,
+                                       default_time_ns=default_time_ns,
+                                       precision=precision)
+
     def create_database(self, name: str, **kw) -> None:
         self.meta.create_database(name, **kw)
 

@@ -17,6 +17,7 @@ the heavy reduction runs here, on-device, next to the data.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import replace
 
@@ -59,6 +60,8 @@ class StoreNode:
                                 handlers={
                                     "store.ping": self._on_ping,
                                     "store.write_rows": self._on_write,
+                                    "store.write_lines":
+                                        self._on_write_lines,
                                     "store.select_partial": self._on_select_partial,
                                     "store.select_raw": self._on_select_raw,
                                     "store.show": self._on_show,
@@ -73,6 +76,8 @@ class StoreNode:
                                         self._on_ensure_group,
                                     "store.raft_write":
                                         self._on_raft_write,
+                                    "store.raft_commit":
+                                        self._on_raft_commit,
                                 })
         self.addr = self.server.addr
         self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
@@ -166,6 +171,36 @@ class StoreNode:
         self.stats["rows_written"] += n
         return {"written": n}
 
+    def _on_write_lines(self, body):
+        """Raw line-protocol bytes for ONE partition (the sql node's
+        columnar scatter, points_writer._write_lines): the local
+        columnar fast path ingests them; replicated partitions parse
+        to rows and commit through the PT raft group so the FSM
+        semantics stay row-based."""
+        owner = body.get("owner")
+        if (owner is not None and self.node_id is not None
+                and owner != self.node_id):
+            raise ValueError(
+                f"not pt owner: write addressed to node {owner}, "
+                f"this is node {self.node_id}")
+        db, pt = body["db"], body["pt"]
+        if self.replication is not None \
+                and self.replication.replicated(db, pt):
+            from ..utils.lineprotocol import parse_lines
+            rows = parse_lines(
+                body["data"].decode("utf-8", errors="replace"),
+                body.get("default_time_ns", 0),
+                body.get("precision", "ns"))
+            n = self.replication.write(db, pt, rows_to_wire(rows))
+        else:
+            from ..utils.lineprotocol import ingest_lines
+            n = ingest_lines(self.engine, db_key(db, pt), body["data"],
+                             body.get("default_time_ns", 0),
+                             body.get("precision", "ns"))
+        self.stats["writes"] += 1
+        self.stats["rows_written"] += n
+        return {"written": n}
+
     def _on_ensure_group(self, body):
         if self.replication is None:
             raise ValueError("replication not enabled on this node")
@@ -187,12 +222,42 @@ class StoreNode:
         # qualifier inside the statement must not override it
         return replace(stmts[0], from_db=None, from_rp=None)
 
+    def _on_raft_commit(self, body):
+        """Group commit index for a peer's follower-read barrier."""
+        if self.replication is None:
+            return {"commit": 0}
+        return {"commit":
+                self.replication.commit_index(body["db"], body["pt"])}
+
+    def _read_barrier(self, db: str, pts: list[int]) -> None:
+        """Replicated partitions: apply-catch-up before scanning
+        (replication.read_barrier — read-your-writes on follower
+        owners). Barriers run in parallel: a leaderless group must
+        not serialize its wait in front of the other partitions."""
+        if self.replication is None:
+            return
+        live = [pt for pt in pts
+                if self.replication.has_group(db, pt)]
+        if not live:
+            return
+        if len(live) == 1:
+            self.replication.read_barrier(db, live[0])
+            return
+        threads = [threading.Thread(
+            target=self.replication.read_barrier, args=(db, pt))
+            for pt in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
     def _on_select_partial(self, body):
         """Partial aggregation over this node's partitions of a db; the
         per-pt partials merge locally first (intra-node exchange) so one
         state grid travels back."""
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
+        self._read_barrier(db, pts)
         mst = stmt.from_measurement
         cs = classify_select(stmt)
         self.stats["selects"] += 1
@@ -218,6 +283,7 @@ class StoreNode:
         LimitPushdown rules, heu_rule.go)."""
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
+        self._read_barrier(db, pts)
         self.stats["selects"] += 1
         pushdown_limit = 0
         if stmt.limit and not stmt.offset:

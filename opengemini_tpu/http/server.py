@@ -479,12 +479,19 @@ class HttpServer:
         precision = params.get("precision", "ns")
         try:
             # decode ONCE: the utf-8 gate and the fallback parser share
-            # this str; the fast path lexes the raw bytes
+            # this str; the fast paths lex the raw bytes
             body_text = body.decode("utf-8")
-            from ..utils.lineprotocol import ingest_lines
-            n = ingest_lines(self.engine, db, body,
-                             default_time_ns=int(time.time() * 1e9),
-                             precision=precision, text=body_text)
+            if hasattr(self.engine, "write_lines"):
+                # cluster facade: lex once, scatter raw line bytes per
+                # partition (points_writer._write_lines)
+                n = self.engine.write_lines(
+                    db, body, default_time_ns=int(time.time() * 1e9),
+                    precision=precision)
+            else:
+                from ..utils.lineprotocol import ingest_lines
+                n = ingest_lines(self.engine, db, body,
+                                 default_time_ns=int(time.time() * 1e9),
+                                 precision=precision, text=body_text)
         except GeminiError as e:
             self._bump("write_errors")
             return 400, {"error": str(e)}

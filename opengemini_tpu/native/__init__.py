@@ -110,7 +110,8 @@ def _bind(lib) -> None:
         lib.og_lp_lex.restype = ctypes.c_int64
         lib.og_lp_lex.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
-            _i64p, _i32p, _i64p, _u8p, _i64p, _i32p, ctypes.c_int64,
+            _i64p, _i32p, _i64p, _u8p, _i64p, _i64p, _i32p,
+            ctypes.c_int64,
             _i32p, _u8p, _f64p, _i64p, _i64p, _i32p, ctypes.c_int64,
             _i64p, _i32p, _i64p, _i64p]
 
@@ -433,8 +434,8 @@ class LpLex:
     native/lineprotocol.cpp). All arrays are trimmed views."""
 
     __slots__ = ("n_lines", "series_off", "series_len", "ts", "has_ts",
-                 "field_lo", "field_n", "fname_id", "ftype", "fval",
-                 "ival", "sval_off", "sval_len", "names")
+                 "line_end", "field_lo", "field_n", "fname_id", "ftype",
+                 "fval", "ival", "sval_off", "sval_len", "names")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -463,6 +464,7 @@ def lp_lex(data: bytes):
         sl = np.empty(cap_lines, dtype=np.int32)
         ts = np.empty(cap_lines, dtype=np.int64)
         ht = np.empty(cap_lines, dtype=np.uint8)
+        lend = np.empty(cap_lines, dtype=np.int64)
         flo = np.empty(cap_lines, dtype=np.int64)
         fn = np.empty(cap_lines, dtype=np.int32)
         fid = np.empty(cap_fields, dtype=np.int32)
@@ -483,6 +485,7 @@ def lp_lex(data: bytes):
             data, n,
             p(so, ctypes.c_int64), p(sl, ctypes.c_int32),
             p(ts, ctypes.c_int64), p(ht, ctypes.c_uint8),
+            p(lend, ctypes.c_int64),
             p(flo, ctypes.c_int64), p(fn, ctypes.c_int32), cap_lines,
             p(fid, ctypes.c_int32), p(fty, ctypes.c_uint8),
             p(fv, ctypes.c_double), p(iv, ctypes.c_int64),
@@ -506,6 +509,7 @@ def lp_lex(data: bytes):
         return LpLex(
             n_lines=nlines, series_off=so[:nlines],
             series_len=sl[:nlines], ts=ts[:nlines], has_ts=ht[:nlines],
+            line_end=lend[:nlines],
             field_lo=flo[:nlines], field_n=fn[:nlines],
             fname_id=fid[:nfields], ftype=fty[:nfields],
             fval=fv[:nfields], ival=iv[:nfields],

@@ -192,3 +192,48 @@ def test_replicated_writes_and_reader_role(tmp_path):
         writer.stop()
         reader.stop()
         meta.stop()
+
+
+def test_cluster_write_lines_columnar_scatter(tmp_path):
+    """write_lines (lex once at sql, scatter raw line bytes per PT)
+    matches write_points results, including over a REPLICATED db where
+    the store parses back to rows for the raft FSM; the read barrier
+    guarantees the follower-owner scan sees the acked write."""
+    from opengemini_tpu.query import parse_query
+
+    meta = TsMeta(data_dir=str(tmp_path / "meta"))
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    stores = [TsStore(str(tmp_path / f"s{i}"), [meta.addr],
+                      heartbeat_s=0.5) for i in range(2)]
+    for s in stores:
+        s.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    try:
+        # plain db, hash sharding over 2 pts
+        sql.facade.meta.create_database("lw", num_pts=2)
+        lp = "\n".join(
+            f"cpu,host=h{i % 8} v={i}.5,c={i}i {i * 10**9}"
+            for i in range(256)).encode()
+        n = sql.facade.write_lines("lw", lp)
+        assert n == 256
+        stmt = parse_query(
+            "SELECT count(v), sum(v), sum(c) FROM cpu")[0]
+        res = sql.facade.executor.execute(stmt, "lw")
+        row = res["series"][0]["values"][0]
+        assert row[1] == 256
+        assert row[2] == sum(i + 0.5 for i in range(256))
+        assert row[3] == sum(range(256))
+
+        # replicated db: write_lines → store parses to rows → raft FSM
+        sql.facade.meta.create_database("lwr", num_pts=1, replica_n=2)
+        n = sql.facade.write_lines("lwr", lp)
+        assert n == 256
+        res = sql.facade.executor.execute(stmt, "lwr")
+        assert res["series"][0]["values"][0][1] == 256
+    finally:
+        sql.stop()
+        for s in stores:
+            s.stop()
+        meta.stop()
