@@ -5,6 +5,7 @@ validated on a virtual CPU mesh (xla_force_host_platform_device_count), the
 same trick the driver's dryrun uses.
 """
 
+import contextlib
 import os
 
 # The box presets JAX_PLATFORMS=axon (real TPU) and the axon plugin overrides
@@ -29,3 +30,32 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+@contextlib.contextmanager
+def small_cluster(tmp_path, n_stores: int = 2, heartbeat_s: float = 0.5):
+    """Shared 1-meta + N-store + sql bootstrap (the sequence otherwise
+    copy-pasted across the cluster test files — new tests should use
+    this; existing ones migrate opportunistically)."""
+    from opengemini_tpu.app import TsMeta, TsSql, TsStore
+
+    meta = TsMeta(data_dir=str(tmp_path / "meta"))
+    meta.start()
+    assert meta.server.raft.wait_leader(10.0) is not None
+    stores = [TsStore(str(tmp_path / f"s{i}"), [meta.addr],
+                      heartbeat_s=heartbeat_s)
+              for i in range(n_stores)]
+    for s in stores:
+        s.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    try:
+        yield meta, stores, sql
+    finally:
+        sql.stop()
+        for s in stores:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        meta.stop()

@@ -253,3 +253,33 @@ def test_flux_disabled(tmp_path):
     finally:
         srv.stop()
         eng.close()
+
+
+def test_flux_over_cluster(tmp_path):
+    """The flux endpoint transpiles onto the executor, so it must work
+    identically through the cluster facade (scatter + merge)."""
+    from tests.conftest import small_cluster
+
+    with small_cluster(tmp_path) as (_meta, _stores, sql):
+        base = f"http://{sql.http_addr}"
+        lp = "\n".join(f"cpu,host=h{i % 4} usage={i}.25 {i * 60 * NS}"
+                       for i in range(32)).encode()
+        r = urllib.request.Request(base + "/write?db=fc", data=lp,
+                                   method="POST")
+        assert urllib.request.urlopen(r, timeout=15).status == 204
+        flux = ('from(bucket: "fc") |> range(start: 0, stop: 1920)'
+                ' |> filter(fn: (r) => r._measurement == "cpu" and'
+                ' r._field == "usage")'
+                ' |> aggregateWindow(every: 16m, fn: mean)'
+                ' |> group(columns: ["host"])')
+        req = urllib.request.Request(
+            base + "/api/v2/query", data=flux.encode(), method="POST",
+            headers={"Content-Type": "application/vnd.flux"})
+        body = urllib.request.urlopen(req, timeout=30).read().decode()
+        rows = [ln for ln in body.split("\r\n") if ln.startswith(",,")]
+        # 4 hosts x 2 windows
+        assert len(rows) == 8, body[:400]
+        total = sum(float(ln.split(",")[6]) for ln in rows)
+        # mean over each (host, window) of 4 samples; sum of all means
+        # = sum of all values / 4
+        assert abs(total - sum(i + 0.25 for i in range(32)) / 4) < 1e-9
