@@ -151,7 +151,7 @@ class PointsWriter:
 
         from ..native import LpParseError, lp_lex
         from ..utils.lineprotocol import (PRECISION_NS, parse_lines,
-                                          parse_series_key)
+                                          parse_series_key, ts_overflows)
         failpoint.inject("points_writer.write.err")
         mult = PRECISION_NS.get(precision)
         if mult is None:
@@ -171,6 +171,8 @@ class PointsWriter:
             return slow()
         if lex is None or lex.n_lines == 0:
             return slow()
+        if ts_overflows(lex.ts, mult):
+            return slow()             # int64 overflow: loud python path
         self._ensure_db(db)
         rt = _Router(self, db)
         ts = np.where(lex.has_ts.astype(bool), lex.ts * mult,

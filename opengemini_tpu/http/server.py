@@ -108,7 +108,7 @@ class HttpServer:
         self._thread: threading.Thread | None = None
         self.stats = {"writes": 0, "points_written": 0, "queries": 0,
                       "write_errors": 0, "query_errors": 0,
-                      "slow_queries": 0,
+                      "slow_queries": 0, "auth_failures": 0,
                       "started_at": time.time()}
         self.slow_log: "deque" = deque(maxlen=32)
         self._stats_lock = threading.Lock()
@@ -273,6 +273,16 @@ class HttpServer:
                 return (f'"{getattr(user, "name", "")}" user is not '
                         f'authorized to write to database "{wdb}"')
         return None
+
+    def _deny_db_op(self, user, db: str, need: str) -> str | None:
+        """Per-db grant gate shared by the write and prom-remote
+        endpoints; returns the 403 message, or None when allowed."""
+        if not self.auth_required() or self.user_store.authorized(
+                user, db, need):
+            return None
+        verb = "write to" if need == "WRITE" else "read from"
+        return (f'"{getattr(user, "name", "")}" user is not '
+                f'authorized to {verb} database "{db}"')
 
     def auth_required(self) -> bool:
         """Credentials are demanded once any user exists. With auth
@@ -470,12 +480,10 @@ class HttpServer:
         db = params.get("db")
         if not db:
             return 400, {"error": "database is required"}
-        if self.auth_required() and not self.user_store.authorized(
-                user, db, "WRITE"):
+        deny = self._deny_db_op(user, db, "WRITE")
+        if deny:
             self._bump("write_errors")
-            return 403, {"error": f'"{getattr(user, "name", "")}" user '
-                                  f'is not authorized to write to '
-                                  f'database "{db}"'}
+            return 403, {"error": deny}
         precision = params.get("precision", "ns")
         try:
             # decode ONCE: the utf-8 gate and the fallback parser share
@@ -651,7 +659,8 @@ class HttpServer:
 
     # --------------------------------------------------- prom endpoints
 
-    def handle_prom_remote(self, path: str, params: dict, body: bytes
+    def handle_prom_remote(self, path: str, params: dict, body: bytes,
+                           user=None
                            ) -> tuple[int, dict | None, bytes | None]:
         """Prometheus remote write/read: snappy-block protobuf bodies
         (reference handler_prom.go:54,146). Returns (code, json_payload,
@@ -663,6 +672,11 @@ class HttpServer:
         # remote-written samples
         db = params.get("db") or (self.prom.db if self.prom is not None
                                   else "prometheus")
+        need = "WRITE" if path.endswith("/write") else "READ"
+        deny = self._deny_db_op(user, db, need)
+        if deny:
+            self._bump("auth_failures")
+            return 403, {"error": deny}, None
         if path.endswith("/write"):
             if self.sysctrl.readonly:
                 self._bump("write_errors")
@@ -1178,7 +1192,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
             code, payload, raw = srv.handle_prom_remote(
-                path, self._params(), body)
+                path, self._params(), body, user=user)
             if raw is not None:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/x-protobuf")

@@ -173,8 +173,15 @@ class _MstCols:
         for f in filters or ():
             ki = self.key_idx.get(f.key)
             if ki is None:
-                # unknown tag key: '=' matches nothing, '!=' everything
-                if f.op in ("=", "=~"):
+                # unknown tag key: every series behaves as having value
+                # "" (same absent-key semantics as the known-key branch)
+                if f.op in ("=", "!="):
+                    hit = f.value == ""
+                else:
+                    hit = bool(re.compile(f.value).search(""))
+                if f.op in ("!=", "!~"):
+                    hit = not hit
+                if not hit:
                     return np.zeros(self.n, dtype=bool)
                 continue
             col = self.codes[ki, :self.n]
@@ -631,7 +638,7 @@ class SeriesIndex:
                 codes = ss[:, s0]
                 key = tuple(
                     mc.val_dicts[mc.key_idx[k]][int(c)]
-                    if mc.key_idx.get(k) is not None else ""
+                    if c and mc.key_idx.get(k) is not None else ""
                     for k, c in zip(group_keys, codes))
                 out.append((key, np.sort(sids_sorted[s0:s1])))
             out.sort(key=lambda kv: kv[0])

@@ -18,6 +18,17 @@ PRECISION_NS = {"ns": 1, "u": 1000, "µ": 1000, "ms": 10**6,
                 "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
 
 
+def ts_overflows(ts, mult: int) -> bool:
+    """True if any lexed int64 timestamp would wrap when scaled to ns.
+    Asymmetric bounds: int64 min is a valid lexed value, and abs() of
+    it wraps, so compare against floor/ceil of the range instead."""
+    if mult == 1 or not getattr(ts, "size", 0):
+        return False
+    hi = (2 ** 63 - 1) // mult
+    lo = -(2 ** 63 // mult)
+    return bool(((ts > hi) | (ts < lo)).any())
+
+
 def parse_lines(data: str, default_time_ns: int = 0,
                 precision: str = "ns") -> list[PointRow]:
     mult = PRECISION_NS.get(precision)
@@ -118,6 +129,9 @@ def _parse_line(line: str, default_time: int, mult: int) -> PointRow:
             ts = int(parts[2]) * mult
         except ValueError:
             raise ErrInvalidLineProtocol(f"bad timestamp in {line!r}")
+        if not -2**63 <= ts < 2**63:
+            raise ErrInvalidLineProtocol(
+                f"timestamp out of int64 ns range in {line!r}")
     else:
         ts = default_time
     return PointRow(measurement, tags, fields, ts)
@@ -253,6 +267,8 @@ def ingest_lines(engine, db_name: str, data: bytes,
         s = nb.decode("utf-8", errors="replace")
         names.append(_unescape(s) if "\\" in s else s)
 
+    if ts_overflows(lex.ts, mult):
+        return slow()                 # int64 overflow: loud python path
     ts = np.where(lex.has_ts.astype(bool),
                   lex.ts * mult, default_time_ns)
     # group lines by raw series-key bytes

@@ -308,3 +308,40 @@ class TestIngestLines:
             assert times[-1] == 2 * 10**9   # seconds scaled to ns
         finally:
             eng.close()
+
+
+def test_coarse_precision_timestamp_overflow_is_loud(tmp_path):
+    """ADVICE r3: ts * mult overflowing int64 on the columnar fast path
+    must not silently wrap — both paths raise ErrInvalidLineProtocol."""
+    import pytest
+
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import (ErrInvalidLineProtocol,
+                                                   ingest_lines)
+    eng = Engine(str(tmp_path / "ovf"))
+    try:
+        big = 2 ** 62                    # * 1e9 wraps int64
+        with pytest.raises(ErrInvalidLineProtocol):
+            ingest_lines(eng, "d", f"m v=1 {big}".encode(),
+                         precision="s")
+        # in-range coarse timestamps still take the fast path
+        assert ingest_lines(eng, "d", b"m v=1 1000", precision="s") == 1
+    finally:
+        eng.close()
+
+
+def test_int64_min_timestamp_is_loud(tmp_path):
+    """Review r4: abs(int64 min) wraps negative, so the overflow guard
+    must use asymmetric bounds; int64-min ts must raise, not ingest 0."""
+    import pytest
+
+    from opengemini_tpu.storage import Engine
+    from opengemini_tpu.utils.lineprotocol import (ErrInvalidLineProtocol,
+                                                   ingest_lines)
+    eng = Engine(str(tmp_path / "ovfmin"))
+    try:
+        with pytest.raises(ErrInvalidLineProtocol):
+            ingest_lines(eng, "d", b"m v=1 -9223372036854775808",
+                         precision="s")
+    finally:
+        eng.close()

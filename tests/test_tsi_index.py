@@ -127,3 +127,25 @@ def test_memory_bounded_at_scale():
     ts = ix.group_by_tagsets("cpu", ["cpu"])
     assert len(ts) == 8
     assert sum(len(v) for _k, v in ts) == N
+
+
+def test_heterogeneous_label_sets_group_and_filter():
+    """ADVICE r3: series that lack one of the group keys (tag code 0)
+    must group under '' — not crash on a None key — and unknown tag
+    keys must follow absent-key-behaves-as-'' filter semantics."""
+    ix = SeriesIndex()
+    ix.get_or_create_sid("cpu", {"host": "a", "rack": "r1"})
+    ix.get_or_create_sid("cpu", {"host": "b"})          # no rack tag
+    ix.get_or_create_sid("cpu", {"host": "c", "rack": "r2"})
+    ts = ix.group_by_tagsets("cpu", ["rack"])
+    assert [k for k, _ in ts] == [("",), ("r1",), ("r2",)]
+    assert len(ts[0][1]) == 1
+    # multi-key grouping where one key is absent for some series
+    ts = ix.group_by_tagsets("cpu", ["host", "rack"])
+    assert ("b", "") in [k for k, _ in ts]
+    # unknown key behaves as '' for every series
+    assert len(ix.series_ids("cpu", [TagFilter("zone", "")])) == 3
+    assert len(ix.series_ids("cpu", [TagFilter("zone", "", "!=")])) == 0
+    assert len(ix.series_ids("cpu", [TagFilter("zone", ".*", "=~")])) == 3
+    assert len(ix.series_ids("cpu", [TagFilter("zone", "x.+", "=~")])) == 0
+    assert len(ix.series_ids("cpu", [TagFilter("zone", "x.+", "!~")])) == 3

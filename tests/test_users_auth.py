@@ -320,3 +320,46 @@ def test_logstore_name_rejects_trailing_newline(tmp_path):
     ls = LogStore(str(tmp_path / "ls"))
     with pytest.raises(ValueError):
         ls.create_repository("..\n")
+
+
+def test_prom_remote_endpoints_enforce_grants(authed):
+    """ADVICE r3: /api/v1/prom/write|read must honor per-db grants —
+    a non-admin without privileges on the db gets 403, a granted user
+    passes (reference handler_prom.go auth middleware)."""
+    from opengemini_tpu.prom import remote_pb2 as pb
+    from opengemini_tpu.prom import snappy_compress
+    srv = authed
+    req(srv, "/query?q=CREATE+USER+root+WITH+PASSWORD+%27pw%27"
+             "+WITH+ALL+PRIVILEGES")
+    req(srv, "/query?q=CREATE+USER+bob+WITH+PASSWORD+%27b%27",
+        user="root", pw="pw")
+    w = pb.WriteRequest()
+    ts = w.timeseries.add()
+    ts.labels.add(name="__name__", value="up")
+    ts.samples.add(value=1.0, timestamp=1000)
+    body = snappy_compress(w.SerializeToString())
+    # unauthenticated → 401; non-admin without grant → 403
+    code, _ = req(srv, "/api/v1/prom/write?db=pdb", method="POST",
+                  body=body)
+    assert code == 401
+    code, payload = req(srv, "/api/v1/prom/write?db=pdb", method="POST",
+                        body=body, user="bob", pw="b")
+    assert code == 403 and "not authorized" in json.dumps(payload)
+    code, _ = req(srv, "/api/v1/prom/read?db=pdb", method="POST",
+                  body=body, user="bob", pw="b")
+    assert code == 403
+    # grant WRITE → write passes, read still denied
+    req(srv, "/query?q=CREATE+DATABASE+pdb", user="root", pw="pw")
+    code, _ = req(srv, '/query?q=GRANT+WRITE+ON+pdb+TO+bob',
+                  user="root", pw="pw")
+    assert code == 200
+    code, _ = req(srv, "/api/v1/prom/write?db=pdb", method="POST",
+                  body=body, user="bob", pw="b")
+    assert code == 204
+    code, _ = req(srv, "/api/v1/prom/read?db=pdb", method="POST",
+                  body=body, user="bob", pw="b")
+    assert code == 403
+    # admin passes everywhere
+    code, _ = req(srv, "/api/v1/prom/write?db=pdb", method="POST",
+                  body=body, user="root", pw="pw")
+    assert code == 204
