@@ -255,23 +255,20 @@ class HttpServer:
                 # details) is admin-only, matching the reference
                 return "admin privilege required"
             tdb = stmt.on_db or db
-            if tdb and not self.user_store.authorized(user, tdb, "READ"):
-                return (f'"{getattr(user, "name", "")}" user is not '
-                        f'authorized to read from database "{tdb}"')
+            if tdb:
+                return self._deny_db_op(user, tdb, "READ")
             return None
         if sel is None:
             return None
         for tdb in self._select_read_dbs(sel, db, set()):
-            if tdb and not self.user_store.authorized(user, tdb,
-                                                      "READ"):
-                return (f'"{getattr(user, "name", "")}" user is not '
-                        f'authorized to read from database "{tdb}"')
+            if tdb:
+                deny = self._deny_db_op(user, tdb, "READ")
+                if deny:
+                    return deny
         if sel.into_measurement:
             wdb = sel.into_db or db
-            if wdb and not self.user_store.authorized(user, wdb,
-                                                      "WRITE"):
-                return (f'"{getattr(user, "name", "")}" user is not '
-                        f'authorized to write to database "{wdb}"')
+            if wdb:
+                return self._deny_db_op(user, wdb, "WRITE")
         return None
 
     def _deny_db_op(self, user, db: str, need: str) -> str | None:
