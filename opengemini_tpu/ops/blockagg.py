@@ -471,6 +471,180 @@ def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
     return _f
 
 
+PACK = os.environ.get("OG_BLOCK_PACK", "1") != "0"
+_U32M = np.int64(0xFFFFFFFF)
+IDX_U32_SENTINEL = np.int64(0xFFFFFFFF)
+
+
+def packed_u32_planes(want: tuple, K: int) -> int:
+    """Plane count of the uint32 packed pull for (want, K)."""
+    n = 1                                        # count
+    if "sum" in want:
+        n += 1 + (18 * K + 31) // 32             # top + digit words
+    if "min" in want:
+        n += 1                                   # min_idx
+    if "max" in want:
+        n += 1                                   # max_idx
+    return n
+
+
+def _pack_kernel(want: tuple, K: int):
+    """jit epilogue: the f64 plane grid → (uint32 planes, uint32 bad
+    bitmask[, f64 extras]) — the D2H transport form.
+
+    Rationale (measured on the tunnel-attached v5e): D2H tops out near
+    30 MB/s, so the pull IS the query wall for big grids (BENCH_r03:
+    device_pull 1666ms of 1959ms). The f64 plane layout spends 8 bytes
+    per state; this epilogue losslessly re-encodes on device in exact
+    integer arithmetic (int64 elementwise is int-emulated on TPU —
+    exact, unlike the f32-pair f64 emulation):
+      * limb sums carry-normalize into 18-bit digits [0, 2^18) plus a
+        signed top carry, then bit-pack into ceil(18K/32) uint32 words
+        (+1 top word) — 16B vs 8(K+1)B for K active planes;
+      * counts are < 2^28 (guarded) → one uint32 plane;
+      * bad flags bit-pack 32 cells/word;
+      * min/max row-index planes → uint32 (sentinel 0xffffffff); the
+        min/max VALUE planes are dropped entirely — the executor's
+        fold only consumes indices (exact host gather).
+    The host unpack reconstructs limb planes holding the SAME integer
+    totals (top merges into the high limb), so every downstream
+    consumer (rebase/merge/finalize_exact) is unchanged — bit-identical
+    by construction, and the CPU baseline runs this same path.
+    """
+    key = ("pack", want, K)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    Wn = (18 * K + 31) // 32
+    layout = plane_layout(want, K)
+
+    @jax.jit
+    def _p(planes):
+        S = planes.shape[1]
+        u32, f64 = [], []
+        bits = jnp.zeros(0, dtype=jnp.uint32)
+        i = 0
+        for name, n in layout:
+            pl = planes[i:i + n]
+            i += n
+            if name == "count":
+                u32.append((pl[0].astype(jnp.int64) & _U32M)
+                           .astype(jnp.uint32))
+            elif name == "limbs":
+                ds = [pl[k].astype(jnp.int64) for k in range(K)]
+                for k in range(K - 1, 0, -1):
+                    c = ds[k] >> 18          # arithmetic = floor
+                    ds[k] = ds[k] - (c << 18)
+                    ds[k - 1] = ds[k - 1] + c
+                top = ds[0] >> 18
+                ds[0] = ds[0] - (top << 18)
+                u32.append(((top & _U32M)).astype(jnp.uint32))
+                # digit stream Σ d_k·2^(18(K-1-k)) sliced into 32-bit
+                # words, high word first; each word overlaps ≤3 digits
+                for j in range(Wn):
+                    w = jnp.zeros(S, dtype=jnp.int64)
+                    for k in range(K):
+                        sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
+                        if -18 < sh < 32:
+                            t = (ds[k] << sh) if sh >= 0 \
+                                else (ds[k] >> (-sh))
+                            w = w | (t & _U32M)
+                    u32.append(w.astype(jnp.uint32))
+            elif name == "bad":
+                b = (pl[0] > 0).astype(jnp.uint32)
+                pad = (-S) % 32
+                if pad:
+                    b = jnp.concatenate(
+                        [b, jnp.zeros(pad, dtype=jnp.uint32)])
+                bits = (b.reshape(-1, 32)
+                        << jnp.arange(32, dtype=jnp.uint32)[None, :]
+                        ).sum(axis=1, dtype=jnp.uint32)
+            elif name == "sumsq":
+                f64.append(pl[0])
+            elif name in ("min", "max"):
+                pass                     # host fold never reads values
+            elif name in ("min_idx", "max_idx"):
+                p = pl[0]
+                real = (p >= 0) & (p < IDX_SENTINEL)
+                iv = jnp.where(real, p, 0.0).astype(jnp.int64)
+                u32.append(jnp.where(real, iv, IDX_U32_SENTINEL)
+                           .astype(jnp.uint32))
+        out = (jnp.stack(u32), bits)
+        if f64:
+            out = out + (jnp.stack(f64),)
+        return out
+
+    _JITTED[key] = _p
+    return _p
+
+
+def pack_grid(out, want: tuple, K: int, n_rows: int, flat_n: int):
+    """Device-side packed transport of a final plane grid, or the
+    legacy f64 grid when out of the packed encoding's ranges:
+      * counts/top need n_rows < 2^28 (top ≤ K·n_rows, count ≤ n_rows)
+      * row-index planes need flat_n < 2^32-1 (uint32 + sentinel)
+    Returns ("p", u32, bits[, f64]) or ("l", planes)."""
+    idx_wanted = ("min" in want) or ("max" in want)
+    if (not PACK or n_rows >= (1 << 28)
+            or (idx_wanted and flat_n >= _U32M)):
+        return ("l", out)
+    return ("p",) + tuple(_pack_kernel(want, K)(out))
+
+
+def unpack_packed(u32: np.ndarray, bits: np.ndarray, want: tuple,
+                  K: int, k0: int = 0, K_full: int | None = None,
+                  f64_extra: np.ndarray | None = None) -> dict:
+    """Host inverse of _pack_kernel → the same bo dict as
+    unpack_planes. The digit planes reassemble into limb planes whose
+    integer totals equal the kernel's limb sums (top folds into the
+    high limb — limb magnitudes may differ from the legacy path, the
+    represented value cannot)."""
+    if K_full is None:
+        K_full = exactsum.K_LIMBS
+    Wn = (18 * K + 31) // 32
+    S = u32.shape[1]
+    out = {}
+    i = 0
+    a = u32.astype(np.int64)
+    out["count"] = a[0]
+    i = 1
+    if "sum" in want:
+        top = a[i]
+        top = np.where(top >= (1 << 31), top - (1 << 32), top)
+        words = a[i + 1:i + 1 + Wn]
+        i += 1 + Wn
+        digits = np.zeros((K, S), dtype=np.int64)
+        for k in range(K):
+            for j in range(Wn):
+                # mirror of the pack shifts: digit k's low bit sits at
+                # word-bit sh of word j (negative sh: its upper bits)
+                sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
+                if -18 < sh < 32:
+                    w = words[j]
+                    part = (w >> sh) if sh >= 0 else (w << (-sh))
+                    digits[k] |= part & ((1 << 18) - 1)
+        digits[0] += top << 18
+        full = np.zeros((S, K_full))
+        full[:, k0:k0 + K] = digits.T.astype(np.float64)
+        out["limbs"] = full
+        nb = bits.shape[0]
+        lanes = ((bits[:, None].astype(np.uint32)
+                  >> np.arange(32, dtype=np.uint32)[None, :]) & 1)
+        out["bad"] = lanes.reshape(nb * 32)[:S].astype(bool)
+    if "sumsq" in want:
+        out["sumsq"] = np.asarray(f64_extra)[0]
+    for name in ("min", "max"):
+        if name in want:
+            p = a[i]
+            i += 1
+            out[f"{name}_idx"] = np.where(p == IDX_U32_SENTINEL,
+                                          I64MAX, p)
+    return out
+
+
 def _pairwise_combine(want: tuple, K: int):
     """Device combine of two packed plane arrays (same cell grid):
     adds for count/limbs/sumsq, any for bad, min/max keep the winning

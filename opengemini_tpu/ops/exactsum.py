@@ -175,15 +175,33 @@ def segment_bad_flags(bad: np.ndarray, seg_ids: np.ndarray,
     return out
 
 
+def canonicalize(limbs: np.ndarray) -> np.ndarray:
+    """Carry-normalize limb planes to the canonical representation:
+    digits in [0, 2^18) with the signed top carry folded into the high
+    limb. Value-preserving (exact integer arithmetic). Needed wherever
+    a decision depends on limb MAGNITUDES rather than the represented
+    value — different but equal-valued representations (e.g. the packed
+    device transport vs raw kernel sums) must decide identically."""
+    d = limbs.astype(np.int64)
+    for k in range(K_LIMBS - 1, 0, -1):
+        c = d[..., k] >> LIMB_BITS          # floor (sign-safe)
+        d[..., k] -= c << LIMB_BITS
+        d[..., k - 1] += c
+    return d.astype(np.float64)
+
+
 def rebase(limbs: np.ndarray, inexact: np.ndarray, e_from: int,
            e_to: int):
     """Shift limb grids from scale e_from to e_to ≥ e_from (whole-limb
-    shifts — exact). Dropped nonzero low limbs clear exactness."""
+    shifts — exact). Dropped nonzero low limbs clear exactness; the
+    drop check runs on the canonical representation so equal-valued
+    limb encodings rebase identically."""
     if e_to == e_from:
         return limbs, inexact
     shift = (e_to - e_from) // LIMB_BITS
     if shift < 0:
         raise ValueError("rebase target must be ≥ source scale")
+    limbs = canonicalize(limbs)
     out = np.zeros_like(limbs)
     if shift < K_LIMBS:
         out[..., shift:] = limbs[..., :K_LIMBS - shift]

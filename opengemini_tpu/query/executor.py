@@ -82,9 +82,11 @@ HOST_AGG_THRESHOLD = int(
 
 # block-path dispatch (ops/blockagg.py): result grids above this pull
 # too much over the slow D2H link; files whose rows/cells ratio is
-# below the minimum reduce faster on host
+# below the minimum reduce faster on host. The packed uint32 transport
+# (~20B/cell for mean vs ~88B f64) moved the break-even from 250k to
+# ~1M cells on the measured 10-30MB/s tunnel link
 BLOCK_MAX_CELLS = int(
-    __import__("os").environ.get("OG_BLOCK_MAX_CELLS", "250000"))
+    __import__("os").environ.get("OG_BLOCK_MAX_CELLS", "1000000"))
 BLOCK_MIN_RATIO = int(
     __import__("os").environ.get("OG_BLOCK_MIN_RATIO", "16"))
 
@@ -1237,15 +1239,22 @@ class QueryExecutor:
         block_rows_total = 0
         block_skip: set[int] = set()   # id(_ChunkSrc) served on device
         if scan_plan is not None:
+            from ..ops import blockagg as _ba_cap
             from ..ops import devicecache as _dc
             preagg_possible = (cond.residual is None and not raw_fields
                                and spec_names <= PREAGG_STATES)
+            # the 1M-cell ceiling assumes the packed uint32 transport;
+            # legacy f64 planes are ~4x the bytes, so keep the old cap
+            cells_cap = (BLOCK_MAX_CELLS if _ba_cap.PACK
+                         else min(BLOCK_MAX_CELLS, 250000))
             block_ok = (
                 _dc.enabled() and cond.residual is None
                 and not raw_fields
-                and spec_names <= {"count", "sum", "min", "max", "sumsq"}
+                # no sumsq: device f64 emulation would break the
+                # cross-backend stddev digest (no limb state for v²)
+                and spec_names <= {"count", "sum", "min", "max"}
                 and (EXACT_SUM or "sum" not in spec_names)
-                and G * W <= BLOCK_MAX_CELLS
+                and G * W <= cells_cap
                 # windowless queries are pre-agg's sweet spot: whole
                 # segments answer from metadata with no device work
                 and not (preagg_possible and not interval))
@@ -1314,6 +1323,7 @@ class QueryExecutor:
                     # host gather, so only value-free states combine
                     can_merge = not ({"min", "max"} & set(want))
                     merged_by: dict = {}
+                    merged_rows: dict = {}
                     for reader, stacks, gids_by_field, srcs in jobs:
                         for fname, sl in stacks.items():
                             gid_arr = gids_by_field[fname]
@@ -1326,6 +1336,9 @@ class QueryExecutor:
                                 key = (fname, sl[0].E, sl[0].k0,
                                        sl[0].limbs.shape[-1])
                                 prev = merged_by.get(key)
+                                merged_rows[key] = (
+                                    merged_rows.get(key, 0)
+                                    + sum(st.n_rows for st in sl))
                                 if prev is None:
                                     merged_by[key] = out
                                 else:
@@ -1333,8 +1346,19 @@ class QueryExecutor:
                                         want, sl[0].limbs.shape[-1])
                                     merged_by[key] = comb(prev, out)
                             else:
+                                # packed transport (device epilogue):
+                                # the pull, not the kernel, is the
+                                # query wall on tunnel-attached chips
+                                n_rows_f = sum(st.n_rows for st in sl)
+                                flat_n = ((sl[-1].block0
+                                           + sl[-1].n_blocks)
+                                          * sl[0].seg_rows)
                                 block_launches.append(
-                                    (fname, reader, sl, out))
+                                    (fname, reader, sl,
+                                     blockagg.pack_grid(
+                                         out, want,
+                                         sl[0].limbs.shape[-1],
+                                         n_rows_f, flat_n)))
                         # consume the sources: flat/dense/preagg must
                         # not double-count these chunks (the plan object
                         # is cached across queries — never mutate it)
@@ -1342,7 +1366,11 @@ class QueryExecutor:
                             block_skip.add(id(src))
                     for (fname, _E, _k0, _ka), out in merged_by.items():
                         block_launches.append(
-                            (fname, None, _BlockMeta(_E, _k0, _ka), out))
+                            (fname, None, _BlockMeta(_E, _k0, _ka),
+                             blockagg.pack_grid(
+                                 out, want, _ka,
+                                 merged_rows[(fname, _E, _k0, _ka)],
+                                 0)))
                     block_rows_total = sum(
                         sl.n_rows for _r, stacks, _g, _s in jobs
                         for sls in stacks.values() for sl in sls)
@@ -1483,8 +1511,12 @@ class QueryExecutor:
         # whose OUTPUT is bigger than its input doesn't tile (measured:
         # 96k residue rows into an 11.5M-cell grid = 48.9s on device,
         # ~0.2s as host bincount)
+        # sumsq (stddev/spread) has no exact-limb state: device f64 is
+        # f32-pair emulated, so a device sumsq diverges from the same
+        # engine pinned to CPU — keep those reductions on host for
+        # cross-backend bit-identity
         use_host = (n_rows <= HOST_AGG_THRESHOLD
-                    or n_rows < num_segments)
+                    or n_rows < num_segments or spec.sumsq)
         from ..utils.stats import bump as _bump_r
         _bump_r(EXEC_STATS, "host_reductions" if use_host
                 else "device_reductions")
@@ -1801,7 +1833,8 @@ class QueryExecutor:
                 else None
             if pull_sp is not None:
                 pull_sp.start_ns = _now_ns()
-            block_outs = [bo for _f, _r, _s, bo in block_launches]
+            block_fmt = [bo[0] for _f, _r, _s, bo in block_launches]
+            block_outs = [bo[1:] for _f, _r, _s, bo in block_launches]
             (field_results, dense_out, exact_results, dense_exact,
              sel_results, block_outs) = jax.device_get(
                 (field_results, dense_out, exact_results, dense_exact,
@@ -1823,11 +1856,21 @@ class QueryExecutor:
                     return sl.ka, sl.k0
                 return sl[0].limbs.shape[-1], sl[0].k0
 
+            def _unpack(fmt, arrs, s):
+                ka, k0 = _ka_k0(s)
+                if fmt == "p":
+                    f64x = (np.asarray(arrs[2]) if len(arrs) > 2
+                            else None)
+                    return _bagg.unpack_packed(
+                        np.asarray(arrs[0]), np.asarray(arrs[1]),
+                        _bw, ka, k0, _KL, f64x)
+                return _bagg.unpack_planes(np.asarray(arrs[0]), _bw,
+                                           ka, k0, _KL)
+
             block_launches = [
-                (f, r, s, _bagg.unpack_planes(
-                    np.asarray(bo), _bw, _ka_k0(s)[0], _ka_k0(s)[1],
-                    _KL))
-                for (f, r, s, _), bo in zip(block_launches, block_outs)]
+                (f, r, s, _unpack(fmt, arrs, s))
+                for (f, r, s, _), fmt, arrs in
+                zip(block_launches, block_fmt, block_outs)]
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
             res = field_results[fname]

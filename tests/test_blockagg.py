@@ -157,3 +157,81 @@ def test_slabbed_stacks_combine(db, monkeypatch):
             assert row[1] == math.fsum(cell)
             assert row[2] == min(cell) and row[3] == max(cell)
             assert row[4] == len(cell)
+
+
+def test_packed_pull_roundtrip_property():
+    """The uint32 packed transport (pack_grid/unpack_packed) is a
+    lossless re-encoding of the f64 plane grid: counts/idx/bad equal
+    bit for bit, limb planes carry the same exact integer totals."""
+    from opengemini_tpu.ops import blockagg as BA
+    from opengemini_tpu.ops import exactsum
+
+    rng = np.random.default_rng(7)
+    R = 1 << 18
+    wants = [("sum",), ("sum", "min"), ("sum", "min", "max"),
+             ("min", "max"), ("sum", "sumsq"), ()]
+    for trial in range(12):
+        K = int(rng.integers(1, 7))
+        S = int(rng.integers(1, 300))
+        want = wants[trial % len(wants)]
+        layout = BA.plane_layout(want, K)
+        planes = np.zeros((sum(n for _, n in layout), S))
+        n_rows = int(rng.integers(1, 1 << 27))
+        flat_n = int(rng.integers(1, (1 << 32) - 1))
+        i = 0
+        for name, n in layout:
+            if name == "count":
+                planes[i] = rng.integers(0, n_rows, S)
+            elif name == "limbs":
+                planes[i:i + n] = (
+                    rng.integers(-n_rows, n_rows, (n, S))
+                    * rng.integers(1, R, (n, S))).astype(float)
+            elif name == "bad":
+                planes[i] = rng.integers(0, 2, S).astype(float)
+            elif name == "sumsq":
+                planes[i] = rng.random(S) * 1e6
+            elif name in ("min", "max"):
+                planes[i] = rng.normal(0, 100, S)
+            else:                        # idx planes with sentinels
+                v = rng.integers(0, flat_n, S).astype(float)
+                planes[i] = np.where(rng.random(S) < 0.2,
+                                     BA.IDX_SENTINEL, v)
+            i += n
+        fmt, *arrs = BA.pack_grid(planes, want, K, n_rows, flat_n)
+        assert fmt == "p"
+        assert arrs[0].shape[0] == BA.packed_u32_planes(want, K)
+        f64x = np.asarray(arrs[2]) if len(arrs) > 2 else None
+        bo = BA.unpack_packed(np.asarray(arrs[0]), np.asarray(arrs[1]),
+                              want, K, 0, exactsum.K_LIMBS, f64x)
+        ref = BA.unpack_planes(planes, want, K, 0, exactsum.K_LIMBS)
+        assert set(bo) == {k for k in ref if k not in ("min", "max")}
+        for key in bo:
+            if key == "limbs":
+                for s in range(S):
+                    ta = sum(int(ref[key][s, k]) * R ** (5 - k)
+                             for k in range(6))
+                    tb = sum(int(bo[key][s, k]) * R ** (5 - k)
+                             for k in range(6))
+                    assert ta == tb, (trial, s)
+            else:
+                assert np.array_equal(ref[key], bo[key]), (trial, key)
+    # out-of-range guards drop to the legacy f64 transport
+    pl = np.zeros((3, 4))
+    assert BA.pack_grid(pl, (), 0, 1 << 28, 0)[0] == "l"
+    assert BA.pack_grid(np.zeros((4, 4)), ("min",), 0, 8,
+                        (1 << 32) - 1)[0] == "l"
+
+
+def test_packed_and_legacy_paths_agree(db, monkeypatch):
+    """Same query, packed vs legacy transport: identical output."""
+    from opengemini_tpu.ops import blockagg as BA
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT sum(u), mean(u), count(u), min(u), max(u) FROM cpu "
+            "WHERE time >= 0 AND time < 3000s GROUP BY time(5m), host")
+    monkeypatch.setattr(BA, "PACK", True)
+    packed = q(ex, text)
+    monkeypatch.setattr(BA, "PACK", False)
+    legacy = q(ex, text)
+    assert "error" not in packed and "error" not in legacy
+    assert packed == legacy

@@ -188,3 +188,31 @@ def test_finalize_fast_path_sum_semantics():
     out = finalize_exact(limbs, E)
     for s in range(64):
         assert out[s] == math.fsum(v[seg == s])
+
+
+def test_rebase_is_representation_independent():
+    """Review r4: equal-valued limb encodings (raw kernel sums vs the
+    packed transport's carry-normalized digits) must rebase to the
+    same totals AND the same inexact flags — the dropped-limb check
+    runs on canonical digits."""
+    import numpy as np
+
+    from opengemini_tpu.ops.exactsum import (K_LIMBS, LIMB_BITS,
+                                             canonicalize, rebase)
+    R = 1 << LIMB_BITS
+    # value (2^18 - 1) at the lowest plane, written two ways:
+    # raw [.., 1, -1] vs canonical [.., 0, R-1]
+    a = np.zeros((1, K_LIMBS)); a[0, -2], a[0, -1] = 1, -1
+    b = np.zeros((1, K_LIMBS)); b[0, -1] = R - 1
+    assert np.array_equal(canonicalize(a), canonicalize(b))
+    no = np.zeros(1, dtype=bool)
+    ra, ia = rebase(a, no, 0, LIMB_BITS)
+    rb, ib = rebase(b, no, 0, LIMB_BITS)
+    assert np.array_equal(ra, rb) and np.array_equal(ia, ib)
+    assert ia[0]                       # nonzero low digit dropped
+    # an exactly-representable shift stays exact in both encodings
+    c = np.zeros((1, K_LIMBS)); c[0, 0], c[0, -1] = R, 0
+    d = np.zeros((1, K_LIMBS)); d[0, 1] = R * R  # same value, low rep
+    rc, ic = rebase(c, no, 0, LIMB_BITS)
+    rd, idx = rebase(d, no, 0, LIMB_BITS)
+    assert np.array_equal(rc, rd) and not ic[0] and not idx[0]
