@@ -77,6 +77,8 @@ class BlockStack:
     block_sids: np.ndarray           # (B,) int64
     seg_refs: list                   # (B,) [(colmeta, segment)] host
     n_rows: int                      # real rows (un-padded)
+    t_min: np.ndarray = None         # (B,) int64 host time bounds
+    t_max: np.ndarray = None
     block0: int = 0
     values: object = None            # jax (B, SEG) f64
     valid: object = None             # jax (B, SEG) bool
@@ -138,8 +140,14 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
     B = len(metas)
     vals = np.zeros((B, seg), dtype=np.float64)
     valid = np.zeros((B, seg), dtype=np.bool_)
-    times = np.zeros((B, seg), dtype=np.int64)
+    # padded tails hold I64MAX, NOT 0: the prefix kernel binary-
+    # searches window ids along the row axis, so per-block times must
+    # stay nondecreasing through the padding (padded rows are
+    # valid=False everywhere, so no kernel can read them as data)
+    times = np.full((B, seg), I64MAX, dtype=np.int64)
     sids = np.empty(B, dtype=np.int64)
+    tmin = np.full(B, I64MAX, dtype=np.int64)
+    tmax = np.full(B, I64MIN, dtype=np.int64)
     refs: list = []
     n_rows = 0
     for b, (sid, colm, s, tseg) in enumerate(metas):
@@ -149,12 +157,15 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
         vals[b, :r] = cv.values.astype(np.float64, copy=False)
         valid[b, :r] = cv.valid
         times[b, :r] = tv.values
+        if r:
+            tmin[b] = tv.values[0]
+            tmax[b] = tv.values[r - 1]
         sids[b] = sid
         refs.append((colm, s))
         n_rows += r
     limbs, bad = exactsum.host_limbs(vals, valid, E)
     st = BlockStack(reader.path, field, seg, E, sids, refs, n_rows,
-                    block0)
+                    tmin, tmax, block0)
     # non-limb arrays upload immediately (host copies freed per slab);
     # only the i32 limb planes wait for the file-wide k-range
     import jax
@@ -581,15 +592,22 @@ def _pack_kernel(want: tuple, K: int):
     return _p
 
 
-def pack_grid(out, want: tuple, K: int, n_rows: int, flat_n: int):
-    """Device-side packed transport of a final plane grid, or the
-    legacy f64 grid when out of the packed encoding's ranges:
+def pack_eligible(want: tuple, n_rows: int, flat_n: int) -> bool:
+    """Will pack_grid use the packed transport for these ranges?
       * counts/top need n_rows < 2^28 (top ≤ K·n_rows, count ≤ n_rows)
       * row-index planes need flat_n < 2^32-1 (uint32 + sentinel)
-    Returns ("p", u32, bits[, f64]) or ("l", planes)."""
+    The executor consults this up front: grids above the legacy cell
+    cap must not dispatch at all when the pull would be f64 planes."""
     idx_wanted = ("min" in want) or ("max" in want)
-    if (not PACK or n_rows >= (1 << 28)
-            or (idx_wanted and flat_n >= _U32M)):
+    return (PACK and n_rows < (1 << 28)
+            and not (idx_wanted and flat_n >= _U32M))
+
+
+def pack_grid(out, want: tuple, K: int, n_rows: int, flat_n: int):
+    """Device-side packed transport of a final plane grid, or the
+    legacy f64 grid when out of the packed encoding's ranges (see
+    pack_eligible). Returns ("p", u32, bits[, f64]) or ("l", planes)."""
+    if not pack_eligible(want, n_rows, flat_n):
         return ("l", out)
     return ("p",) + tuple(_pack_kernel(want, K)(out))
 
@@ -684,6 +702,144 @@ def _pairwise_combine(want: tuple, K: int):
     return _c
 
 
+def _kernel_prefix(num_segments: int, want: tuple, W: int, K: int,
+                   SEG: int, WLmax: int, Cmax: int):
+    """Wide-window reduction WITHOUT scatters (W > MASK_W_MAX would
+    need W unrolled masked passes, and flat f64 segment_sum scatters
+    cost ~0.7s per plane per 9M rows on the v5e's emulated f64):
+
+      stage 1: per-plane EXCLUSIVE CUMSUM along the row axis in int32
+        (exact: limb cumsums ≤ SEG·2^18 < 2^31, counts ≤ SEG) — one
+        O(N) pass per plane, no W factor;
+      stage 2: per block, the window boundaries are positions in the
+        (sorted) per-row window ids — vmapped binary search over
+        WLmax+1 query windows; window sums are boundary differences of
+        the cumsums (exact int32 diffs → f64);
+      stage 3: the (B·WLmax) partial lattice maps onto the cell grid by
+        a HOST-BUILT gather index (each cell gathers its ≤Cmax
+        contributing block-windows) — dense gathers + axis sums, zero
+        scatters. f64 sums of integers < 2^49 — exact, order-fixed.
+
+    min/max are not prefix-decomposable and take the scatter fallback;
+    the executor's eligibility keeps them off this path. Reference
+    role: the same aggregate_cursor.go:90 windowing, restructured for
+    the TPU's tiling rules instead of translated.
+    """
+    key = ("kp", num_segments, want, W, K, SEG, WLmax, Cmax)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _f(values, valid, times, limbs, bad, gids, scalars,
+           w0, gather_idx):
+        t_lo, t_hi, start, interval = (scalars[0], scalars[1],
+                                       scalars[2], scalars[3])
+        B = values.shape[0]
+        m0 = (valid & (times >= t_lo) & (times <= t_hi)
+              & (gids >= 0)[:, None])
+        # int64-overflow-safe window ids, monotone per block (times
+        # are sorted and padded tails hold I64MAX)
+        span = W * interval
+        tcl = jnp.clip(times, start, start + span)
+        wid = jnp.clip((tcl - start) // interval, 0, W).astype(
+            jnp.int32)
+        in_w = (times >= start) & (times < start + span)
+        m0 = m0 & in_w
+
+        def ecs(delta_i32):
+            c = jnp.cumsum(delta_i32, axis=1, dtype=jnp.int32)
+            return jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.int32), c], axis=1)
+
+        planes_cs = [ecs(m0.astype(jnp.int32))]
+        if "sum" in want:
+            lz = jnp.where(m0[:, :, None], limbs, 0)
+            for k in range(K):
+                planes_cs.append(ecs(lz[:, :, k]))
+            planes_cs.append(ecs((m0 & bad).astype(jnp.int32)))
+        # boundary positions of windows w0+0 .. w0+WLmax (B, WLmax+1)
+        wq = w0[:, None] + jnp.arange(WLmax + 1, dtype=jnp.int32)[None]
+        pos = jax.vmap(
+            lambda a, v: jnp.searchsorted(a, v, side="left"))(wid, wq)
+        lo, hi = pos[:, :-1], pos[:, 1:]
+        out = []
+        for cs in planes_cs:
+            p = (jnp.take_along_axis(cs, hi, axis=1)
+                 - jnp.take_along_axis(cs, lo, axis=1))  # (B, WLmax)
+            flat = jnp.concatenate(
+                [p.reshape(-1), jnp.zeros(1, jnp.int32)])
+            cells = flat[gather_idx].astype(jnp.float64).sum(axis=1)
+            out.append(cells)
+        return jnp.stack(out)
+
+    _JITTED[key] = _f
+    return _f
+
+
+def _round_up(x: int, step: int) -> int:
+    return ((x + step - 1) // step) * step
+
+
+# host/device budget for one slab's stage-3 plan: the partial lattice
+# (B·WLmax entries) and the (cells, Cmax) gather index
+PLAN_MAX_ENTRIES = int(os.environ.get("OG_PREFIX_PLAN_MAX_ENTRIES",
+                                      str(64 * 1024 * 1024)))
+
+
+def _prefix_spans(st: BlockStack, gids: np.ndarray, start: int,
+                  interval: int, W: int):
+    """Cheap per-block window spans (no lattice materialized): (w0,
+    wl, WLmax) — the sizing inputs for the guards AND the plan."""
+    B = st.n_blocks
+    g = np.asarray(gids, dtype=np.int64)
+    t0 = np.clip(st.t_min, start, None)
+    w0 = np.clip((t0 - start) // interval, 0, W - 1)
+    w1b = np.clip((np.clip(st.t_max, None,
+                           start + W * interval - 1) - start)
+                  // interval, 0, W - 1)
+    live = (g >= 0) & (st.t_max >= start) & \
+        (st.t_min < start + W * interval) & (st.t_min <= st.t_max)
+    wl = np.where(live, w1b - w0 + 1, 0).astype(np.int64)
+    WLmax = _round_up(max(1, int(wl.max()) if B else 1), 32)
+    return w0, wl, WLmax
+
+
+def prefix_plan(st: BlockStack, gids: np.ndarray, start: int,
+                interval: int, W: int, num_segments: int):
+    """Host-side stage-3 plan for one slab: per-block first window w0,
+    and the (cells, Cmax) gather index mapping the (B·WLmax) partial
+    lattice onto the cell grid (pad slot = B·WLmax → the kernel's
+    appended zero). WLmax/Cmax round up to buckets so jit keys repeat
+    across similar shapes."""
+    B = st.n_blocks
+    g = np.asarray(gids, dtype=np.int64)
+    w0, wl, WLmax = _prefix_spans(st, gids, start, interval, W)
+    pad = B * WLmax
+    # entry per (block, local window): cell = gid·W + w0 + wl
+    nb = np.nonzero(wl > 0)[0]
+    reps = wl[nb]
+    blk = np.repeat(nb, reps)
+    local = np.concatenate([np.arange(n, dtype=np.int64)
+                            for n in reps]) if len(nb) else \
+        np.zeros(0, dtype=np.int64)
+    cell = g[blk] * W + w0[blk] + local
+    flat = blk * WLmax + local
+    counts = np.bincount(cell, minlength=num_segments)
+    Cmax = _round_up(max(1, int(counts.max()) if counts.size else 1),
+                     4)
+    idx = np.full((num_segments, Cmax), pad, dtype=np.int64)
+    order = np.argsort(cell, kind="stable")
+    sc, sf = cell[order], flat[order]
+    starts = np.zeros(num_segments + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    rank = np.arange(len(sc)) - starts[sc]
+    idx[sc, rank] = sf
+    return (np.asarray(w0, dtype=np.int32), idx, WLmax, Cmax)
+
+
 _SCALARS_CACHE: dict = {}
 
 
@@ -726,26 +882,110 @@ def cached_gids(gid_arr: np.ndarray):
     return dev
 
 
+class _NoPlan:
+    nbytes = 0
+
+
+_NO_PLAN = _NoPlan()
+
+
+def _prefix_dev_plan(st: BlockStack, gid_slice: np.ndarray,
+                     start: int, interval: int, W: int,
+                     num_segments: int):
+    """Device copies of one slab's stage-3 plan, content-keyed in the
+    device cache so warm repeats upload nothing. Size guards run on
+    the cheap per-block spans BEFORE the lattice/index materialize;
+    rejected shapes cache the verdict so every repeat doesn't redo the
+    sizing, and accepted entries charge their true HBM bytes to the
+    cache budget."""
+    import jax
+    cache = devicecache.global_cache() if devicecache.enabled() \
+        else None
+    key = None
+    if cache is not None:
+        import hashlib
+        h = hashlib.blake2b(gid_slice.tobytes(),
+                            digest_size=16).hexdigest()
+        key = ("pplan", st.path, st.field, st.block0, h, start,
+               interval, W, num_segments)
+        got = cache.get(key)
+        if got is _NO_PLAN:
+            return None
+        if got is not None:
+            return got
+
+    def reject():
+        if cache is not None:
+            cache.put(key, _NO_PLAN)
+        return None
+
+    _w0, wl, WLmax = _prefix_spans(st, gid_slice, start, interval, W)
+    entries = int(wl.sum())
+    if (st.n_blocks * WLmax + 1 >= (1 << 31)     # int32 gather index
+            or entries > PLAN_MAX_ENTRIES):      # lattice/host budget
+        return reject()
+    # Cmax ≤ max blocks sharing one gid — a cheap upper bound on the
+    # (cells, Cmax) index before it materializes
+    g = np.asarray(gid_slice, dtype=np.int64)
+    live = g[(g >= 0) & (wl > 0)]
+    cmax_bound = int(np.bincount(live).max()) if live.size else 1
+    if num_segments * _round_up(cmax_bound, 4) > PLAN_MAX_ENTRIES:
+        return reject()
+    w0, idx, WLmax, Cmax = prefix_plan(st, gid_slice, start, interval,
+                                       W, num_segments)
+    ent = (jax.device_put(w0),
+           jax.device_put(idx.astype(np.int32)), WLmax, Cmax)
+    if cache is not None:
+        cache.put(key, ent)
+        with cache._lock:            # account real HBM footprint
+            if key in cache._map:
+                nb = int(ent[0].nbytes + ent[1].nbytes) + 64
+                cache._map[key] = (ent, nb)
+                cache._bytes += nb - 64
+    return ent
+
+
 def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
                    t_lo, t_hi, start: int, interval: int, W: int,
                    num_segments: int, want: tuple, scalars=None,
                    gids_dev=None):
     """Launch the kernel per slab and combine on device — ONE packed
     plane array per file stays on device (the caller batches the pull
-    and unpacks with unpack_planes)."""
+    and unpacks with unpack_planes). Window width picks the kernel:
+    masked-pass unroll up to MASK_W_MAX, the scatter-free prefix
+    kernel for wider grids (min/max shapes keep the scatter
+    fallback — extrema are not prefix-decomposable)."""
     import jax
     K = slabs[0].limbs.shape[-1]
     if scalars is None:
         scalars = query_scalars(t_lo, t_hi, start, interval)
     if gids_dev is None:
         gids_dev = jax.device_put(np.asarray(gids, dtype=np.int64))
+    # int32 limb cumsums stay exact while SEG·(2^18-1) < 2^31
+    use_prefix = (W > MASK_W_MAX and interval > 0
+                  and not ({"min", "max", "sumsq"} & set(want))
+                  and slabs[0].seg_rows <= (1 << 13)
+                  and slabs[0].t_min is not None)
     out = None
     comb = _pairwise_combine(want, K)
     for st in slabs:
-        fn = _kernel(num_segments, want, W, K, st.seg_rows)
         g = gids_dev[st.block0:st.block0 + st.n_blocks]
-        o = fn(st.values, st.valid, st.times, st.limbs, st.bad, g,
-               st.block0_dev, scalars)
+        o = None
+        if use_prefix:
+            plan = _prefix_dev_plan(
+                st, np.asarray(gids[st.block0:st.block0 + st.n_blocks],
+                               dtype=np.int64),
+                int(start), int(interval), W, num_segments)
+            if plan is not None:
+                w0_dev, idx_dev, WLmax, Cmax = plan
+                fn = _kernel_prefix(num_segments, want, W, K,
+                                    st.seg_rows, WLmax, Cmax)
+                o = fn(st.values, st.valid, st.times, st.limbs,
+                       st.bad, g, scalars, w0_dev, idx_dev)
+        if o is None:
+            fn = _kernel(num_segments, want, W, K, st.seg_rows)
+            o = fn(st.values, st.valid, st.times, st.limbs, st.bad, g,
+                   st.block0_dev, scalars)
         out = o if out is None else comb(out, o)
     return out
 

@@ -1294,6 +1294,16 @@ class QueryExecutor:
                         stacks[fname] = sl
                     if not stacks:
                         continue
+                    if G * W > 250000 and not all(
+                            blockagg.pack_eligible(
+                                want, nrows,
+                                (sl[-1].block0 + sl[-1].n_blocks)
+                                * sl[0].seg_rows)
+                            for sl in stacks.values()):
+                        # above the legacy cap the pull must be the
+                        # packed transport; ranges that force the f64
+                        # fallback route this file to the host paths
+                        continue
                     # gid vectors are PER FIELD: fields may stack with
                     # different block layouts (a field absent from some
                     # series skips those blocks entirely)

@@ -235,3 +235,43 @@ def test_packed_and_legacy_paths_agree(db, monkeypatch):
     legacy = q(ex, text)
     assert "error" not in packed and "error" not in legacy
     assert packed == legacy
+
+
+def test_wide_window_prefix_kernel_matches_host(db, monkeypatch):
+    """W > MASK_W_MAX routes to the scatter-free prefix kernel
+    (cumsum + boundary search + host-built gather index); results must
+    equal the pure host path bit for bit, including ragged series with
+    holes and offset time ranges."""
+    import os
+
+    from opengemini_tpu.ops import blockagg as BA
+    eng, ex = db
+    rng = np.random.default_rng(5)
+    lines = []
+    for h in range(4):
+        n = int(rng.integers(400, 1200))
+        for i in range(n):
+            if rng.random() < 0.1:
+                continue                     # holes
+            t = i * 10**10 + int(rng.integers(0, 3)) * 10**9
+            lines.append(f"cpu,host=h{h} u={float(rng.normal(40, 9))!r}"
+                         f" {t}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    for text in (
+        "SELECT mean(u), sum(u), count(u) FROM cpu WHERE time >= 0 "
+        "AND time < 12000s GROUP BY time(75s)",
+        "SELECT sum(u) FROM cpu WHERE time >= 120s AND time < 11000s "
+        "GROUP BY time(90s), host",
+    ):
+        dev = q(ex, text)
+        assert "error" not in dev, dev
+        os.environ["OG_DEVICE_CACHE_MB"] = "0"
+        try:
+            host = q(ex, text)
+        finally:
+            os.environ["OG_DEVICE_CACHE_MB"] = "256"
+        assert dev == host
+    assert any(k[0] == "kp" for k in BA._JITTED), \
+        "prefix kernel never fired"
