@@ -77,11 +77,19 @@ def bench_prom_rate(n_series: int) -> dict:
     t0 = time.perf_counter()
     counters = np.cumsum(
         np.random.default_rng(0).random((POINTS,)) + 1.0)
+    # batched columnar ingest — the prom remote-write handler's path
+    # (write_record_batch → bulk frames → vectorized flush)
+    batch = []
     for i in range(n_series):
-        eng.write_record("prom", "node_cpu_seconds_total",
-                         {"instance": f"host-{i >> 3}",
-                          "cpu": f"cpu{i & 7}", "mode": "user"},
-                         times, {"value": counters + i})
+        batch.append(("node_cpu_seconds_total",
+                      {"instance": f"host-{i >> 3}",
+                       "cpu": f"cpu{i & 7}", "mode": "user"},
+                      times, {"value": counters + i}))
+        if len(batch) == 4000:
+            eng.write_record_batch("prom", batch)
+            batch = []
+    if batch:
+        eng.write_record_batch("prom", batch)
     for s in eng.database("prom").all_shards():
         s.flush()
     t_ing = time.perf_counter() - t0

@@ -664,6 +664,7 @@ class HttpServer:
         raw_body) — raw_body set for the binary read response."""
         from ..prom import (decode_read_request, decode_write_request,
                             encode_read_response, handle_remote_read,
+                            records_from_write_request,
                             rows_from_write_request)
         # default to the PromQL engine's database so /api/v1/query sees
         # remote-written samples
@@ -679,12 +680,20 @@ class HttpServer:
                 self._bump("write_errors")
                 return 403, {"error": "server is in readonly mode"}, None
             try:
-                rows = rows_from_write_request(decode_write_request(body))
+                wr = decode_write_request(body)
+                use_bulk = hasattr(self.engine, "write_record_batch")
+                if use_bulk:
+                    recs = records_from_write_request(wr)
+                else:
+                    rows = rows_from_write_request(wr)
             except Exception as e:
                 self._bump("write_errors")
                 return 400, {"error": f"bad remote write body: {e}"}, None
             try:
-                n = self.engine.write_points(db, rows)
+                # columnar bulk path: arrays per series, engine bulk
+                # frames (the row path builds a PointRow per sample)
+                n = (self.engine.write_record_batch(db, recs)
+                     if use_bulk else self.engine.write_points(db, rows))
             except GeminiError as e:
                 self._bump("write_errors")
                 return 400, {"error": str(e)}, None

@@ -118,21 +118,23 @@ def bucket_states(values, valid, times, seg_ids, series_ids,
                        inc, ssumsq, resets, changes, sum_t, sum_tv, sum_t2)
 
 
-def _merge(a: BucketState, b: BucketState) -> BucketState:
-    """Merge chronologically adjacent states (a earlier than b)."""
+def _merge(a: BucketState, b: BucketState, xp=jnp) -> BucketState:
+    """Merge chronologically adjacent states (a earlier than b).
+    ``xp`` picks the array module: jnp inside the jitted device fold,
+    np for the host fold — one body, no drift."""
     a_has = a.count > 0
     b_has = b.count > 0
-    first = jnp.where(a_has, a.first, b.first)
-    first_t = jnp.where(a_has, a.first_t, b.first_t)
-    last = jnp.where(b_has, b.last, a.last)
-    last_t = jnp.where(b_has, b.last_t, a.last_t)
+    first = xp.where(a_has, a.first, b.first)
+    first_t = xp.where(a_has, a.first_t, b.first_t)
+    last = xp.where(b_has, b.last, a.last)
+    last_t = xp.where(b_has, b.last_t, a.last_t)
     # boundary corrections between a.last and b.first
     both = a_has & b_has
-    boundary = jnp.where(
+    boundary = xp.where(
         both,
-        jnp.where(b.first >= a.last, b.first - a.last, b.first),
+        xp.where(b.first >= a.last, b.first - a.last, b.first),
         0.0)
-    inc = (jnp.where(a_has, a.inc, 0.0) + jnp.where(b_has, b.inc, 0.0)
+    inc = (xp.where(a_has, a.inc, 0.0) + xp.where(b_has, b.inc, 0.0)
            + boundary)
     resets = (a.resets + b.resets
               + (both & (b.first < a.last)).astype(a.resets.dtype))
@@ -140,14 +142,14 @@ def _merge(a: BucketState, b: BucketState) -> BucketState:
                + (both & (b.first != a.last)).astype(a.changes.dtype))
 
     def add(x, y):
-        return jnp.where(a_has, x, 0.0) + jnp.where(b_has, y, 0.0)
+        return xp.where(a_has, x, 0.0) + xp.where(b_has, y, 0.0)
 
     return BucketState(
         count=a.count + b.count,
         first=first, last=last, first_t=first_t, last_t=last_t,
         sum=add(a.sum, b.sum),
-        min=jnp.minimum(a.min, b.min),
-        max=jnp.maximum(a.max, b.max),
+        min=xp.minimum(a.min, b.min),
+        max=xp.maximum(a.max, b.max),
         inc=inc,
         sumsq=add(a.sumsq, b.sumsq),
         resets=resets, changes=changes,
@@ -156,22 +158,29 @@ def _merge(a: BucketState, b: BucketState) -> BucketState:
         sum_t2=add(a.sum_t2, b.sum_t2))
 
 
-def _shift_right(s: BucketState, by: int) -> BucketState:
+def _shift_right(s: BucketState, by: int, xp=jnp) -> BucketState:
     """Shift bucket axis (last axis) right by `by` (earlier buckets move
     toward the eval position); vacated slots become empty states."""
     def sh(x, fill):
-        y = jnp.roll(x, by, axis=-1)
-        mask_idx = jnp.arange(x.shape[-1]) < by
-        return jnp.where(mask_idx, jnp.asarray(fill, y.dtype), y)
+        y = xp.roll(x, by, axis=-1)
+        mask_idx = xp.arange(x.shape[-1]) < by
+        return xp.where(mask_idx, xp.asarray(fill).astype(y.dtype), y)
     return BucketState(
-        count=sh(s.count, 0), first=sh(s.first, jnp.nan),
-        last=sh(s.last, jnp.nan), first_t=sh(s.first_t, 0),
+        count=sh(s.count, 0), first=sh(s.first, xp.nan),
+        last=sh(s.last, xp.nan), first_t=sh(s.first_t, 0),
         last_t=sh(s.last_t, 0), sum=sh(s.sum, 0.0),
-        min=sh(s.min, jnp.inf), max=sh(s.max, -jnp.inf),
+        min=sh(s.min, xp.inf), max=sh(s.max, -xp.inf),
         inc=sh(s.inc, 0.0), sumsq=sh(s.sumsq, 0.0),
         resets=sh(s.resets, 0), changes=sh(s.changes, 0),
         sum_t=sh(s.sum_t, 0.0), sum_tv=sh(s.sum_tv, 0.0),
         sum_t2=sh(s.sum_t2, 0.0))
+
+
+def _fold_windows_body(states: BucketState, k: int, xp) -> BucketState:
+    acc = _shift_right(states, k - 1, xp)
+    for i in range(k - 2, -1, -1):
+        acc = _merge(acc, _shift_right(states, i, xp), xp)
+    return acc
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -180,13 +189,156 @@ def fold_windows(states: BucketState, k: int) -> BucketState:
     merged state of buckets (b-k, b] — the range window ending at bucket b.
     Fold over k shifted copies, earliest first (log(k) merges possible;
     linear fold keeps the reset-correction order exact)."""
-    acc = _shift_right(states, k - 1)
-    for i in range(k - 2, -1, -1):
-        acc = _merge(acc, _shift_right(states, i))
-    return acc
+    return _fold_windows_body(states, k, jnp)
+
+
+def fold_windows_host(states: BucketState, k: int) -> BucketState:
+    """Host fold over numpy states — same body as the jitted fold."""
+    return _fold_windows_body(states, k, np)
+
+
+def _seg_reduce_sorted(seg, n_out, arrays_min, arrays_max):
+    """Sorted-run reduceat helper: seg must be nondecreasing. Returns
+    per-output (min…, max…) arrays with identity fills for empty
+    segments. arrays_* are (values, identity) pairs."""
+    present = seg < n_out
+    starts = np.flatnonzero(np.diff(seg, prepend=-1))
+    run_seg = seg[starts]
+    keep = run_seg < n_out
+    outs = []
+    for vals, ident in arrays_min:
+        o = np.full(n_out, ident, dtype=vals.dtype)
+        if starts.size:
+            r = np.minimum.reduceat(vals, starts)
+            o[run_seg[keep]] = r[keep]
+        outs.append(o)
+    for vals, ident in arrays_max:
+        o = np.full(n_out, ident, dtype=vals.dtype)
+        if starts.size:
+            r = np.maximum.reduceat(vals, starts)
+            o[run_seg[keep]] = r[keep]
+        outs.append(o)
+    del present
+    return outs
+
+
+def bucket_states_host(values, valid, times, seg_ids, series_ids,
+                       num_segments: int, origin_t=0,
+                       value_anchor=0.0) -> BucketState:
+    """Host mirror of bucket_states: numpy bincount/reduceat instead of
+    device segment ops. On tunnel-attached TPUs the device kernel pays
+    a ~0.1-0.25s transfer per pulled state array (15 of them), so
+    realistic prom shapes (millions of rows, huge series counts) fold
+    faster on host; the engine routes by size (PROM_DEVICE_MIN_ROWS).
+    Semantics mirror the jitted kernel field for field."""
+    ns = num_segments + 1
+    n = len(values)
+    values = np.asarray(values, dtype=np.float64)
+    valid = np.asarray(valid, dtype=bool)
+    times = np.asarray(times, dtype=np.int64)
+    seg_ids = np.minimum(np.asarray(seg_ids, dtype=np.int64),
+                         num_segments)
+    fdt = values.dtype
+    idx = np.arange(n, dtype=np.int64)
+
+    def seg_sum(x):
+        return np.bincount(seg_ids, weights=x,
+                           minlength=ns)[:num_segments]
+
+    cnt = seg_sum(valid.astype(np.float64)).astype(np.int64)
+    vz = np.where(valid, values, 0.0)
+    va = np.where(valid, values - value_anchor, 0.0)
+    ssum = seg_sum(vz)
+    ssumsq = seg_sum(va * va)
+    # min/max/first/last need ordered runs: one stable sort by segment
+    if n and not (np.diff(seg_ids) >= 0).all():
+        order = np.argsort(seg_ids, kind="stable")
+        seg_s = seg_ids[order]
+        val_s, valid_s, idx_s = values[order], valid[order], idx[order]
+    else:
+        seg_s, val_s, valid_s, idx_s = seg_ids, values, valid, idx
+    smin, fi, smax, li = _seg_reduce_sorted(
+        seg_s, num_segments,
+        [(np.where(valid_s, val_s, np.inf), np.inf),
+         (np.where(valid_s, idx_s, n), n)],
+        [(np.where(valid_s, val_s, -np.inf), -np.inf),
+         (np.where(valid_s, idx_s, -1), -1)])
+    fsafe = np.minimum(fi, n - 1) if n else np.zeros_like(fi)
+    lsafe = np.maximum(li, 0)
+    has_f = fi < n
+    first = np.where(has_f, values[fsafe] if n else np.nan, np.nan)
+    first_t = np.where(has_f, times[fsafe] if n else 0, 0)
+    last = np.where(li >= 0, values[lsafe] if n else np.nan, np.nan)
+    last_t = np.where(li >= 0, times[lsafe] if n else 0, 0)
+
+    t_rel = np.where(valid, (times - origin_t).astype(fdt) / 1e9, 0.0)
+    sum_t = seg_sum(t_rel)
+    sum_tv = seg_sum(t_rel * va)
+    sum_t2 = seg_sum(t_rel * t_rel)
+
+    prev_v = np.roll(values, 1)
+    same = (np.roll(seg_ids, 1) == seg_ids) & valid & np.roll(valid, 1)
+    if n:
+        same[0] = False
+    step_inc = np.where(values >= prev_v, values - prev_v, values)
+    inc = seg_sum(np.where(same, step_inc, 0.0))
+    resets = seg_sum((same & (values < prev_v)).astype(
+        np.float64)).astype(np.int64)
+    changes = seg_sum((same & (values != prev_v)).astype(
+        np.float64)).astype(np.int64)
+
+    return BucketState(cnt, first, last, first_t, last_t, ssum, smin,
+                       smax, inc, ssumsq, resets, changes, sum_t,
+                       sum_tv, sum_t2)
+
+
+def irate_states_host(values, valid, times, seg_ids,
+                      num_segments: int):
+    """Host mirror of irate_states (last two samples per segment)."""
+    n = len(values)
+    values = np.asarray(values, dtype=np.float64)
+    valid = np.asarray(valid, dtype=bool)
+    times = np.asarray(times, dtype=np.int64)
+    seg_ids = np.minimum(np.asarray(seg_ids, dtype=np.int64),
+                         num_segments)
+    idx = np.arange(n, dtype=np.int64)
+    if n and not (np.diff(seg_ids) >= 0).all():
+        order = np.argsort(seg_ids, kind="stable")
+        seg_s, valid_s, idx_s = (seg_ids[order], valid[order],
+                                 idx[order])
+    else:
+        seg_s, valid_s, idx_s = seg_ids, valid, idx
+    # reduce over ns = num_segments+1 so rows routed to the pad
+    # segment stay indexable through li_full[seg_ids] (the device
+    # kernel trims AFTER the gather for the same reason)
+    (li_full,) = _seg_reduce_sorted(
+        seg_s, num_segments + 1, [],
+        [(np.where(valid_s, idx_s, -1), -1)])
+    li = li_full[:num_segments]
+    is_last = valid & (li_full[seg_ids] == idx) if n else valid
+    masked = np.where(valid_s & ~is_last[idx_s], idx_s, -1) \
+        if n else idx_s
+    (pi_full,) = _seg_reduce_sorted(seg_s, num_segments + 1, [],
+                                    [(masked, -1)])
+    pi = pi_full[:num_segments]
+    lsafe = np.maximum(li, 0)
+    psafe = np.maximum(pi, 0)
+    cnt = (li >= 0).astype(np.int64) + (pi >= 0).astype(np.int64)
+    return (np.where(li >= 0, values[lsafe] if n else np.nan, np.nan),
+            np.where(pi >= 0, values[psafe] if n else np.nan, np.nan),
+            np.where(li >= 0, times[lsafe] if n else 0, 0),
+            np.where(pi >= 0, times[psafe] if n else 0, 0),
+            cnt)
 
 
 # ---------------------------------------------------------------- functions
+
+def _xp_of(x):
+    """np for host (numpy) states, jnp for device arrays — the finalize
+    functions below are not jitted, so eager jnp on numpy inputs would
+    bounce every op through the (possibly tunnel-attached) device."""
+    return np if isinstance(x, np.ndarray) else jnp
+
 
 def prom_rate(win: BucketState, window_end_t, range_ns: int,
               kind: str = "rate"):
@@ -194,6 +346,7 @@ def prom_rate(win: BucketState, window_end_t, range_ns: int,
     states (promql extrapolatedRate semantics: extrapolate the sampled
     slope to the window boundaries, limited to half a sample interval /
     zero-crossing)."""
+    jnp = _xp_of(win.count)  # noqa: shadows module alias on purpose
     cnt = win.count
     ok = cnt >= 2
     dur = (win.last_t - win.first_t).astype(jnp.float64) / 1e9
@@ -263,6 +416,7 @@ def irate_states(values, valid, times, seg_ids, num_segments: int):
 
 
 def prom_irate_value(last, prev, last_t, prev_t, cnt, kind: str = "irate"):
+    jnp = _xp_of(cnt)
     ok = cnt >= 2
     dt = (last_t - prev_t).astype(jnp.float64) / 1e9
     dt = jnp.maximum(dt, 1e-12)
@@ -279,6 +433,7 @@ def over_time_value(win: BucketState, func: str, value_anchor=0.0):
     """value_anchor: the per-series shift bucket_states applied to the
     second-order sums — needed to reconstruct variance (shape must
     broadcast against win arrays, e.g. (S, 1))."""
+    jnp = _xp_of(win.count)
     has = win.count > 0
     if func == "avg_over_time":
         v = win.sum / jnp.maximum(win.count, 1)
@@ -319,6 +474,7 @@ def prom_linreg(win: BucketState, end_rel_s, value_anchor=0.0):
     origin bucket_states used for its regression moments; value_anchor:
     the per-series value shift it applied to sum_tv (slope is
     shift-invariant, the intercept un-shifts)."""
+    jnp = _xp_of(win.count)
     ok = win.count >= 2
     n = jnp.maximum(win.count, 1).astype(jnp.float64)
     mean_t = win.sum_t / n

@@ -77,6 +77,40 @@ def rows_from_write_request(req: "pb.WriteRequest") -> list[PointRow]:
     return rows
 
 
+def records_from_write_request(req: "pb.WriteRequest") -> list[tuple]:
+    """WriteRequest → columnar write_record_batch entries
+    [(mst, tags, times ns i64, {value: f64})] — the high-cardinality
+    remote-write fast path (rows_from_write_request builds a PointRow
+    per SAMPLE; this builds two numpy arrays per SERIES and lets the
+    engine's bulk frame path take it from there). NaN stale markers
+    drop per sample."""
+    import numpy as np
+    out: list[tuple] = []
+    for ts in req.timeseries:
+        name = None
+        tags: dict[str, str] = {}
+        for lb in ts.labels:
+            if lb.name == "__name__":
+                name = lb.value
+            else:
+                tags[lb.name] = lb.value
+        if not name or not ts.samples:
+            continue
+        n = len(ts.samples)
+        times = np.empty(n, dtype=np.int64)
+        vals = np.empty(n, dtype=np.float64)
+        for i, s in enumerate(ts.samples):
+            times[i] = s.timestamp
+            vals[i] = s.value
+        keep = vals == vals                 # drop NaN stale markers
+        if not keep.all():
+            times, vals = times[keep], vals[keep]
+            if not len(times):
+                continue
+        out.append((name, tags, times * MS, {VALUE_FIELD: vals}))
+    return out
+
+
 # ------------------------------------------------------------------- read
 
 def decode_read_request(body: bytes) -> "pb.ReadRequest":

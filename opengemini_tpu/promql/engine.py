@@ -63,6 +63,12 @@ log = get_logger(__name__)
 
 DEFAULT_LOOKBACK_NS = 5 * 60 * 10**9
 _MAX_FOLD = 128
+
+# rows below this fold on host (numpy): the device bucket kernel pulls
+# 15 state arrays, each paying a full transfer round trip on tunnel-
+# attached chips — raise/lower for directly-attached hardware
+PROM_DEVICE_MIN_ROWS = int(__import__("os").environ.get(
+    "OG_PROM_DEVICE_MIN_ROWS", "16000000"))
 VALUE_FIELD = "value"
 
 
@@ -94,6 +100,35 @@ class PromEngine:
     def __init__(self, engine, db: str = "prometheus"):
         self.engine = engine
         self.db = db
+        from collections import OrderedDict
+        self._plan_cache: OrderedDict = OrderedDict()
+
+    def _flat_residues(self, ft, mst: str, t_min, t_max):
+        """Generic decode of the bulk scan's residues: memtable records
+        and merged (overlapping-source) series."""
+        times_l, vals_l, valid_l, gid_l = [], [], [], []
+
+        def add(gid, rec):
+            c = rec.column(VALUE_FIELD)
+            if c is None or c.values is None or rec.num_rows == 0:
+                return
+            times_l.append(rec.times)
+            vals_l.append(c.values.astype(np.float64, copy=False))
+            valid_l.append(c.valid)
+            gid_l.append(np.full(rec.num_rows, gid, dtype=np.int64))
+
+        for gid, rec in ft.mem:
+            add(gid, rec)
+        for gid, _r, sp, _x in ft.slow:
+            rec = sp.shard.read_series(mst, sp.sid, [VALUE_FIELD],
+                                       t_min, t_max)
+            if rec is not None:
+                add(gid, rec)
+        if not times_l:
+            z = np.zeros(0, dtype=np.int64)
+            return z, np.zeros(0), np.zeros(0, bool), z
+        return (np.concatenate(times_l), np.concatenate(vals_l),
+                np.concatenate(valid_l), np.concatenate(gid_l))
 
     # ---------------------------------------------------------------- api
 
@@ -307,37 +342,68 @@ class PromEngine:
                  np.zeros(0, np.int64))
         tag_keys: list[str] = sorted(
             {k for s in shards for k in s.index.tag_keys(vs.name)})
-        global_groups: dict[tuple, int] = {}
-        per_shard = []
-        for s in shards:
-            ts = s.index.group_by_tagsets(vs.name, tag_keys, filters)
-            pairs = []
-            for key, sids in ts:
-                gi = global_groups.setdefault(key, len(global_groups))
-                pairs.extend((int(sid), gi) for sid in sids)
-            per_shard.append((s, pairs))
+        from ..query.scan import (bulk_flat_scan, decode_pool,
+                                  materialize_scan, plan_rowstore_scan)
+        # content-keyed plan cache (executor-style): warm dashboards
+        # skip tagset grouping AND the chunk-meta walk — at 1M series
+        # those cost ~26s of Python per query
+        filt_key = tuple(sorted((m.name, m.op, m.value)
+                                for m in vs.matchers))
+        plan_key = (vs.name, filt_key, t_min, t_max,
+                    tuple((s.serial,
+                           tuple(r.serial
+                                 for r in s._files.get(vs.name, ())),
+                           s.mem.mutations) for s in shards))
+        hit = self._plan_cache.get(plan_key)
+        if hit is not None:
+            self._plan_cache.move_to_end(plan_key)
+            global_groups, plan = hit
+        else:
+            global_groups = {}
+            per_shard = []
+            for s in shards:
+                ts = s.index.group_by_tagsets(vs.name, tag_keys,
+                                              filters)
+                pairs = []
+                for key, sids in ts:
+                    gi = global_groups.setdefault(key,
+                                                  len(global_groups))
+                    pairs.extend((int(sid), gi) for sid in sids)
+                per_shard.append((s, pairs))
+            plan = plan_rowstore_scan(per_shard, vs.name, t_min, t_max)
+            self._plan_cache[plan_key] = (global_groups, plan)
+            while len(self._plan_cache) > 8:
+                self._plan_cache.popitem(last=False)
         G = len(global_groups)
-        if G == 0:
+        if G == 0 or not plan.has_rows:
             return empty
-        from ..query.scan import (decode_pool, materialize_scan,
-                                  plan_rowstore_scan)
-        plan = plan_rowstore_scan(per_shard, vs.name, t_min, t_max)
-        if not plan.has_rows:
+        flat = bulk_flat_scan(
+            plan, vs.name, VALUE_FIELD, t_min, t_max,
+            decode_fallback=lambda ft: self._flat_residues(
+                ft, vs.name, t_min, t_max))
+        if flat is not None:
+            times, vals, valid, gids = flat
+            keep = valid
+            vals = vals[keep]
+            times = times[keep]
+            gids = gids[keep]
+        else:
+            scanres = materialize_scan(
+                plan, vs.name, [VALUE_FIELD], t_min, t_max, 0, 2**62,
+                1, G, allow_preagg=False, allow_dense=False,
+                pool=decode_pool())
+            got = scanres.fields.get(VALUE_FIELD)
+            if got is None or scanres.n_rows == 0:
+                return empty
+            vals, valid = got
+            times = scanres.times
+            gids = scanres.gids
+            keep = valid
+            vals = vals.astype(np.float64, copy=False)[keep]
+            times = times[keep]
+            gids = gids[keep]
+        if len(vals) == 0:
             return empty
-        scanres = materialize_scan(
-            plan, vs.name, [VALUE_FIELD], t_min, t_max, 0, 2**62, 1,
-            G, allow_preagg=False, allow_dense=False,
-            pool=decode_pool())
-        got = scanres.fields.get(VALUE_FIELD)
-        if got is None or scanres.n_rows == 0:
-            return empty
-        vals, valid = got
-        times = scanres.times
-        gids = scanres.gids
-        keep = valid
-        vals = vals.astype(np.float64, copy=False)[keep]
-        times = times[keep]
-        gids = gids[keep]
         # drop label sets with no surviving rows and RENUMBER densely,
         # labels sorted by label tuple (prom output order); the single
         # lexsort below establishes the kernel's series-then-time order
@@ -436,12 +502,24 @@ class PromEngine:
             seg = np.pad(seg, (0, pad), constant_values=S_pad * nb)
         anchor_rows = np.pad(anchor[series[:n]], (0, n_pad - n)) \
             if n_pad != n else anchor[series]
-        st = K.bucket_states(values, valid, times, seg, series,
-                             S_pad * nb, origin_t=origin,
-                             value_anchor=anchor_rows)
+        if n_pad < PROM_DEVICE_MIN_ROWS:
+            # host fold: on tunnel-attached chips the device kernel's
+            # 15 pulled state arrays each pay a full transfer round
+            # trip; realistic prom shapes (high cardinality, few rows
+            # per series) fold faster in numpy
+            st = K.bucket_states_host(values, valid, times, seg,
+                                      series, S_pad * nb,
+                                      origin_t=origin,
+                                      value_anchor=anchor_rows)
+        else:
+            import jax
+            st = K.bucket_states(values, valid, times, seg, series,
+                                 S_pad * nb, origin_t=origin,
+                                 value_anchor=anchor_rows)
+            st = K.BucketState(*jax.device_get(tuple(st)))  # ONE pull
         st = K.BucketState(*[np.asarray(x).reshape(S_pad, nb)[:S]
                              for x in st])
-        win = K.fold_windows(st, int(k))
+        win = K.fold_windows_host(st, int(k))
         # slice eval positions: indices k-1, k-1+stride, ...
         sel = (k - 1) + stride * np.arange(nsteps)
         win = K.BucketState(*[np.asarray(x)[:, sel] for x in win])
@@ -755,8 +833,10 @@ class PromEngine:
         out = np.full((S, nsteps), np.nan)
         for i, m in masks():
             seg = np.where(m, series, S)
-            last, prev, lt, pt, cnt = K.irate_states(
-                values, m, times, seg, S)
+            last, prev, lt, pt, cnt = (
+                K.irate_states_host(values, m, times, seg, S)
+                if len(values) < PROM_DEVICE_MIN_ROWS
+                else K.irate_states(values, m, times, seg, S))
             out[:, i] = np.asarray(K.prom_irate_value(
                 np.asarray(last), np.asarray(prev), np.asarray(lt),
                 np.asarray(pt), np.asarray(cnt),

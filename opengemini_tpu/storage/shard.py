@@ -208,6 +208,17 @@ class Shard:
                         log.error("shard %d: dropping bad wal column "
                                   "batch (%s): %s", self.shard_id, mst, e)
                 continue
+            if isinstance(batch, tuple) and batch[0] == "colsb":
+                mst, sids, offsets, times_cat, fields_cat = batch[1]
+                try:
+                    self.mem.write_columns_bulk(mst, sids, offsets,
+                                                times_cat, fields_cat)
+                    n += len(times_cat)
+                except Exception as e:
+                    bad += len(times_cat)
+                    log.error("shard %d: dropping bad wal bulk frame "
+                              "(%s): %s", self.shard_id, mst, e)
+                continue
             for mst, sid, fields, t in batch:
                 try:
                     self.mem.write(mst, sid, self._coerce(mst, fields), t)
@@ -308,6 +319,65 @@ class Shard:
             probe[k] = a[0].item()
         return norm, probe
 
+    def write_columns_bulk(self, mst: str, tags_list: list,
+                           times_list: list, fields_list: list) -> int:
+        """Many-tiny-series bulk write, one measurement, shared field
+        names: per-series cost collapses to one index insert + one
+        buffer append (the per-entry write_columns_batch pays
+        normalize/WAL-pack/schema work per series — ~130µs at 6-row
+        prom series; this path measures ~15µs). Durability order
+        matches write_columns_batch: index fsync → WAL frame →
+        memtable."""
+        import numpy as np
+        if not tags_list:
+            return 0
+        names = list(fields_list[0])
+        self._check_cs_collision(
+            mst, {k: "" for e in tags_list for k in e},
+            fields_list[0])
+        before = self.index.series_cardinality
+        sids = self.index.get_or_create_sids(mst, tags_list)
+        if self.index.series_cardinality != before:
+            self.index.flush()
+        counts = np.fromiter((len(t) for t in times_list), np.int64,
+                             len(times_list))
+        offsets = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        times_cat = (np.concatenate(times_list)
+                     .astype(np.int64, copy=False))
+        fields_cat = {}
+        probe = {}
+        for k in names:
+            cat = np.concatenate([np.asarray(f[k]) for f in fields_list])
+            if cat.dtype == np.bool_:
+                pass
+            elif np.issubdtype(cat.dtype, np.integer):
+                cat = cat.astype(np.int64, copy=False)
+            elif np.issubdtype(cat.dtype, np.floating):
+                cat = cat.astype(np.float64, copy=False)
+            else:
+                raise ErrTypeConflict(
+                    f"field {k}: bulk writes are numeric/bool only")
+            fields_cat[k] = cat
+            probe[k] = cat[0].item()
+        n = int(offsets[-1])
+        with self._lock:
+            staged: dict = {}
+            self._check_fields(staged, mst, probe)
+            self._commit_fields(staged)
+            sch = self._schemas.get(mst, {})
+            for k in names:
+                if sch.get(k) == DataType.FLOAT \
+                        and fields_cat[k].dtype == np.int64:
+                    fields_cat[k] = fields_cat[k].astype(np.float64)
+            self.wal.write_cols_bulk(mst, sids, offsets, times_cat,
+                                     fields_cat)
+            self.mem.write_columns_bulk(mst, sids, offsets, times_cat,
+                                        fields_cat)
+        if self.mem.approx_bytes >= self.flush_bytes:
+            self.flush()
+        return n
+
     def write_columns_batch(self, entries) -> int:
         """Multi-series bulk write: [(mst, tags, times, fields)] land
         with ONE index fsync for all new series and ONE WAL frame for
@@ -373,7 +443,7 @@ class Shard:
                 new_files: list[tuple[str, str]] = []
                 new_cs: list[tuple[str, str]] = []
                 for mst, mt in snap.items():
-                    if not mt.series:
+                    if mt.rows == 0:
                         continue
                     self._file_seq += 1
                     if mst in self.cs_options:
@@ -405,10 +475,22 @@ class Shard:
                     fn = os.path.join(self.path, "tssp",
                                       f"{mst}_{self._file_seq:06d}.tssp")
                     w = TSSPWriter(fn, segment_size=self.segment_size)
-                    for sid in mt.sids():
-                        rec = mt.series_record(sid)
-                        if rec is not None:
-                            w.write_series(sid, rec)
+                    bulk = None
+                    if mt.bulk_frames and not mt.series:
+                        bulk = mt.consolidate_bulk()
+                        if bulk is not None and not all(
+                                c.dtype == np.float64
+                                for c in bulk[3].values()):
+                            bulk = None
+                    if bulk is not None:
+                        # many-tiny-series fast path: vectorized
+                        # encode + metas, no per-series Python
+                        w.write_series_bulk(*bulk)
+                    else:
+                        for sid in mt.sids():
+                            rec = mt.series_record(sid)
+                            if rec is not None:
+                                w.write_series(sid, rec)
                     w.finalize()
                     new_files.append((mst, fn))
                 for mst, fn in new_files:
@@ -665,7 +747,7 @@ class Shard:
                 parts.append(rec)
         for tbl in self.mem.tables_for_read()[::-1]:  # snapshot older first
             mt = tbl.get(mst)
-            if mt is not None and mt.series:
+            if mt is not None and mt.rows:
                 rec = self._materialize_measurement(mst, mt)
                 if rec is not None and rec.num_rows:
                     if scan_cols is not None:

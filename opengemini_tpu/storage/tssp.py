@@ -319,34 +319,300 @@ class TSSPWriter:
                               _compute_preagg(col, times, lo, hi))
                 colmeta.segments.append(seg)
             cm.columns.append(colmeta)
-        self._metas.append(cm)
+        self._metas.append(("one", sid, _pack_chunk_meta(cm)))
+
+    def write_series_bulk(self, sids: np.ndarray, offsets: np.ndarray,
+                          times_cat: np.ndarray,
+                          cols: dict[str, np.ndarray]) -> None:
+        """Vectorized many-tiny-series write (the high-cardinality
+        flush path — reference's >1M-series claim, README.md:40-42).
+        All columns float64, all rows valid, series i owns rows
+        [offsets[i], offsets[i+1]), sids ascending. Data encodes RAW
+        (+CONST_DELTA times) in ONE buffer write per (run, rows)
+        group, pre-aggregation (incl. exact limb sums) computes with
+        reduceat spans, and chunk metas pack as fixed-size records in
+        a numpy matrix — no per-series Python objects. Series the
+        vector form can't express (non-uniform timestamps, non-finite
+        values, rows > segment_size) fall back to write_series inline,
+        preserving sid order."""
+        from ..ops import exactsum
+        S = len(sids)
+        if S == 0:
+            return
+        names = sorted(cols)
+        starts = offsets[:-1].astype(np.int64)
+        ends = offsets[1:].astype(np.int64)
+        r_all = ends - starts
+        total = int(offsets[-1])
+        t0 = times_cat[starts]
+        t_last = times_cat[ends - 1]
+        d = np.diff(times_cat)
+        step = np.where(
+            r_all > 1,
+            d[np.minimum(starts, max(total - 2, 0))] if total > 1
+            else 0, 0)
+        within = (np.arange(total, dtype=np.int64)
+                  - np.repeat(starts, r_all))
+        predicted = (np.repeat(t0, r_all)
+                     + np.repeat(step, r_all) * within)
+        ok = (np.logical_and.reduceat(times_cat == predicted, starts)
+              & (r_all <= self.segment_size) & (step >= 0))
+        for k in names:
+            ok &= np.logical_and.reduceat(np.isfinite(cols[k]), starts)
+
+        def spans_reduce(ufunc, arr, st, en):
+            idx = np.empty(2 * len(st), dtype=np.int64)
+            idx[0::2] = st
+            idx[1::2] = en
+            if idx[-1] >= len(arr):
+                idx = idx[:-1]
+            out = ufunc.reduceat(arr, idx)[0::2]
+            return out
+
+        i = 0
+        while i < S:
+            if not ok[i]:
+                lo, hi = int(starts[i]), int(ends[i])
+                # canonical schema shape: fields sorted, time LAST
+                fields = ([Field(k, DataType.FLOAT) for k in names]
+                          + [Field("time", DataType.TIME)])
+                rcols = ([ColVal(DataType.FLOAT, cols[k][lo:hi])
+                          for k in names]
+                         + [ColVal(DataType.TIME, times_cat[lo:hi])])
+                self.write_series(int(sids[i]),
+                                  Record(Schema(fields), rcols))
+                i += 1
+                continue
+            j = i
+            while j < S and ok[j]:
+                j += 1
+            self._write_bulk_run(
+                sids[i:j], starts[i:j], ends[i:j], r_all[i:j],
+                t0[i:j], t_last[i:j], step[i:j], times_cat, cols,
+                names, spans_reduce, exactsum)
+            i = j
+
+    def _write_bulk_run(self, sids, starts, ends, r_run, t0, t_last,
+                        step, times_cat, cols, names, spans_reduce,
+                        exactsum) -> None:
+        Sr = len(sids)
+        F = len(names)
+        if self._last_sid >= int(sids[0]):
+            raise ValueError("series ids must be written in ascending "
+                             "order")
+        self._last_sid = int(sids[-1])
+        # ---- data: one buffer write per rows-group ----
+        data_off = np.empty(Sr, dtype=np.int64)
+        u8 = np.uint8
+        for r in np.unique(r_run):
+            g = np.nonzero(r_run == r)[0]
+            r = int(r)
+            stride = 18 + F * (2 + 8 * r)
+            M = np.zeros((len(g), stride), dtype=u8)
+            M[:, 0] = enc.CONST_DELTA
+            M[:, 1:9] = t0[g].astype("<i8").view(u8).reshape(-1, 8)
+            M[:, 9:17] = step[g].astype("<i8").view(u8).reshape(-1, 8)
+            M[:, 17] = enc.CONST          # validity: all-valid marker
+            row_idx = (starts[g][:, None]
+                       + np.arange(r, dtype=np.int64)[None, :])
+            cb = 18
+            for k in names:
+                M[:, cb] = enc.RAW
+                M[:, cb + 1:cb + 1 + 8 * r] = (
+                    cols[k][row_idx].astype("<f8").view(u8)
+                    .reshape(-1, 8 * r))
+                M[:, cb + 1 + 8 * r] = enc.CONST
+                cb += 2 + 8 * r
+            base = self._pos
+            self._f.write(M.tobytes())
+            self._pos += len(g) * stride
+            data_off[g] = base + np.arange(len(g),
+                                           dtype=np.int64) * stride
+        # ---- per-field preagg stats (vectorized spans) ----
+        stats = {}
+        for k in names:
+            v = cols[k]
+            ssum = spans_reduce(np.add, v, starts, ends)
+            smin = spans_reduce(np.minimum, v, starts, ends)
+            smax = spans_reduce(np.maximum, v, starts, ends)
+            mx = np.maximum(np.abs(smin), np.abs(smax))
+            # vectorized pick_scale (mirrors exactsum.pick_scale)
+            with np.errstate(divide="ignore"):
+                e = np.where(mx > 0,
+                             np.ceil(np.log2(np.maximum(mx, 1e-300)))
+                             + 1, 0)
+            E = (np.ceil(e / exactsum.LIMB_BITS)
+                 * exactsum.LIMB_BITS).astype(np.int64)
+            E[mx <= 0] = 0
+            limbs = np.zeros((Sr, exactsum.K_LIMBS))
+            exact = np.zeros(Sr, dtype=bool)
+            for Ev in np.unique(E):
+                gi = np.nonzero(E == Ev)[0]
+                # absolute row indices of the member series (starts/
+                # ends index the FULL concatenated array, not the run)
+                reps = r_run[gi]
+                lstarts = np.zeros(len(gi), dtype=np.int64)
+                np.cumsum(reps[:-1], out=lstarts[1:])
+                within = (np.arange(int(reps.sum()), dtype=np.int64)
+                          - np.repeat(lstarts, reps))
+                rows = np.repeat(starts[gi], reps) + within
+                lb, res = exactsum.decompose(v[rows], int(Ev))
+                lends = lstarts + reps
+                for kk in range(exactsum.K_LIMBS):
+                    limbs[gi, kk] = spans_reduce(np.add, lb[:, kk],
+                                                 lstarts, lends)
+                exact[gi] = spans_reduce(np.logical_and, res == 0.0,
+                                         lstarts, lends)
+            stats[k] = (ssum, smin, smax, E, limbs, exact)
+        # ---- meta records: fixed-size numpy matrix ----
+        REC_T = 5 + 4 + 29 + 49          # time column block
+        REC_F = {k: 5 + len(k.encode()) + 29 + 102 for k in names}
+        recsize = 35 + REC_T + sum(REC_F.values())
+        M = np.zeros((Sr, recsize), dtype=u8)
+
+        def put(sl, arr, dt):
+            a = np.asarray(arr).astype(dt)
+            M[:, sl] = a.view(u8).reshape(Sr, a.dtype.itemsize)
+
+        put(slice(0, 8), sids, "<u8")
+        put(slice(8, 16), t0, "<i8")
+        put(slice(16, 24), t_last, "<i8")
+        put(slice(24, 32), r_run, "<i8")
+        M[:, 32:34] = np.frombuffer(
+            struct.pack("<H", F + 1), dtype=u8)
+        M[:, 34] = 1                     # regular (const-delta times)
+        p = 35
+        # time column meta
+        M[:, p:p + 5] = np.frombuffer(
+            struct.pack("<HBH", 4, int(DataType.TIME), 1), dtype=u8)
+        M[:, p + 5:p + 9] = np.frombuffer(b"time", dtype=u8)
+        p += 9
+        put(slice(p, p + 8), data_off, "<u8")
+        M[:, p + 8:p + 12] = np.frombuffer(
+            struct.pack("<I", 17), dtype=u8)
+        put(slice(p + 12, p + 16), r_run, "<u4")
+        put(slice(p + 16, p + 24), data_off + 17, "<u8")
+        M[:, p + 24:p + 28] = np.frombuffer(
+            struct.pack("<I", 1), dtype=u8)
+        M[:, p + 28] = 1                 # has preagg
+        p += 29
+        # time preagg (no limbs)
+        put(slice(p, p + 8), r_run, "<i8")
+        tsum = spans_reduce(np.add, times_cat.astype(np.float64),
+                            starts, ends)
+        put(slice(p + 8, p + 16), tsum, "<f8")
+        put(slice(p + 16, p + 24), t0.astype(np.float64), "<f8")
+        put(slice(p + 24, p + 32), t_last.astype(np.float64), "<f8")
+        put(slice(p + 32, p + 40), t0, "<i8")
+        put(slice(p + 40, p + 48), t_last, "<i8")
+        # has_limbs byte stays 0
+        p += 49
+        fb = 18                          # per-series field data base
+        for k in names:
+            kb = k.encode()
+            ssum, smin, smax, E, limbs, exact = stats[k]
+            hdr = struct.pack("<HBH", len(kb), int(DataType.FLOAT), 1)
+            M[:, p:p + 5] = np.frombuffer(hdr, dtype=u8)
+            M[:, p + 5:p + 5 + len(kb)] = np.frombuffer(kb, dtype=u8)
+            p += 5 + len(kb)
+            vsize = 1 + 8 * r_run
+            put(slice(p, p + 8), data_off + fb, "<u8")
+            put(slice(p + 8, p + 12), vsize, "<u4")
+            put(slice(p + 12, p + 16), r_run, "<u4")
+            put(slice(p + 16, p + 24), data_off + fb + vsize, "<u8")
+            M[:, p + 24:p + 28] = np.frombuffer(
+                struct.pack("<I", 1), dtype=u8)
+            M[:, p + 28] = 1
+            p += 29
+            put(slice(p, p + 8), r_run, "<i8")
+            put(slice(p + 8, p + 16), ssum, "<f8")
+            put(slice(p + 16, p + 24), smin, "<f8")
+            put(slice(p + 24, p + 32), smax, "<f8")
+            put(slice(p + 32, p + 40), t0, "<i8")
+            put(slice(p + 40, p + 48), t_last, "<i8")
+            M[:, p + 48] = 1             # has_limbs
+            put(slice(p + 49, p + 53), E, "<i4")
+            M[:, p + 53] = exact.astype(u8)
+            for kk in range(6):
+                put(slice(p + 54 + 8 * kk, p + 62 + 8 * kk),
+                    limbs[:, kk], "<i8")
+            p += 102
+            fb += 2 + 8 * r_run          # varies per series
+        self._metas.append(("grpb", np.asarray(sids, dtype=np.int64),
+                            M.tobytes(), recsize))
+        mn, mx = int(t0.min()), int(t_last.max())
+        self._min_time = mn if self._min_time is None \
+            else min(self._min_time, mn)
+        self._max_time = mx if self._max_time is None \
+            else max(self._max_time, mx)
+
+    def _meta_groups(self):
+        """Iterate ((first_sid, last_sid, count), blob_bytes) meta
+        groups across singles and vectorized bulk entries (entries are
+        sid-ordered, non-overlapping by construction). Consecutive
+        singles batch up to META_GROUP_SERIES as the object-based
+        finalize always did — one index entry and one zstd blob per
+        group, not per series."""
+        run_sids: list[int] = []
+        run_blobs: list[bytes] = []
+
+        def flush_run(final: bool):
+            while len(run_sids) >= META_GROUP_SERIES or (final
+                                                        and run_sids):
+                n = min(META_GROUP_SERIES, len(run_sids))
+                yield ((run_sids[0], run_sids[n - 1], n),
+                       b"".join(run_blobs[:n]))
+                del run_sids[:n], run_blobs[:n]
+
+        for ent in self._metas:
+            if ent[0] == "one":
+                run_sids.append(ent[1])
+                run_blobs.append(ent[2])
+                yield from flush_run(False)
+                continue
+            # sid order is global: drain any partial single-run before
+            # a bulk entry's sid range starts
+            yield from flush_run(True)
+            _k, sids, blob, rs = ent
+            for g in range(0, len(sids), META_GROUP_SERIES):
+                hi = min(g + META_GROUP_SERIES, len(sids))
+                yield ((int(sids[g]), int(sids[hi - 1]), hi - g),
+                       blob[g * rs:hi * rs])
+        yield from flush_run(True)
+
+    def _all_sids(self) -> np.ndarray:
+        parts = []
+        for ent in self._metas:
+            if ent[0] == "one":
+                parts.append(np.array([ent[1]], dtype=np.uint64))
+            else:
+                parts.append(ent[1].astype(np.uint64))
+        return (np.concatenate(parts) if parts
+                else np.zeros(0, dtype=np.uint64))
 
     def finalize(self) -> None:
         data_end = self._pos
         # chunk metas in sid order, grouped for the meta index
         idx_entries = []
         meta_off = self._pos
-        for g in range(0, len(self._metas), META_GROUP_SERIES):
-            group = self._metas[g:g + META_GROUP_SERIES]
-            blob = enc._zstd_c(b"".join(_pack_chunk_meta(m) for m in group))
+        for (s0, s1, cnt), raw in self._meta_groups():
+            blob = enc._zstd_c(raw)
             off, size = self._append(blob)
-            idx_entries.append((group[0].sid, group[-1].sid, off, size,
-                                len(group)))
+            idx_entries.append((s0, s1, off, size, cnt))
         meta_size = self._pos - meta_off
         idx_off = self._pos
         self._append(struct.pack("<I", len(idx_entries)))
         for e in idx_entries:
             self._append(struct.pack("<QQQII", *e))
         idx_size = self._pos - idx_off
-        bloom = SeriesBloom.build(
-            np.array([m.sid for m in self._metas], dtype=np.uint64))
+        bloom = SeriesBloom.build(self._all_sids())
         bloom_off, bloom_size = self._append(bloom.bits.tobytes())
         trailer = struct.pack(
             _TRAILER_FMT, data_end, meta_off, meta_size, idx_off, idx_size,
             bloom_off, bloom_size,
             self._min_time if self._min_time is not None else 0,
             self._max_time if self._max_time is not None else 0,
-            len(self._metas))
+            len(self._all_sids()))
         self._append(trailer)
         self._append(struct.pack("<II", len(trailer), MAGIC))
         self._f.flush()

@@ -27,6 +27,10 @@ _HDR = struct.Struct("<II")
 _ZSTD, _LZ4 = 1, 2
 # columnar frames (bulk record writes — reference record_writer.go path)
 _ZSTD_COLS, _LZ4_COLS = 3, 4
+# multi-series bulk frame: one measurement, concatenated column arrays
+# with per-series row offsets (the per-entry cols frame costs ~5.6µs
+# of pack per tiny series; this packs the batch in O(fields))
+_ZSTD_COLSB, _LZ4_COLSB = 5, 6
 
 
 def _pack_batch(rows: list[tuple[str, int, dict, int]]) -> bytes:
@@ -105,6 +109,57 @@ def _pack_cols(entries) -> bytes:
             out.append(dtb)
             out.append(a.tobytes())
     return b"".join(out)
+
+
+def _pack_cols_bulk(mst: str, sids, offsets, times_cat,
+                    fields_cat) -> bytes:
+    import numpy as np
+    mb = mst.encode()
+    out = [struct.pack("<HIQH", len(mb), len(sids), len(times_cat),
+                       len(fields_cat)),
+           mb,
+           np.ascontiguousarray(sids, dtype="<i8").tobytes(),
+           np.ascontiguousarray(offsets, dtype="<i8").tobytes(),
+           np.ascontiguousarray(times_cat, dtype="<i8").tobytes()]
+    for k, arr in fields_cat.items():
+        kb = k.encode()
+        a = np.ascontiguousarray(arr)
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("<"))
+        dtb = a.dtype.str.encode()
+        out.append(struct.pack("<HB", len(kb), len(dtb)))
+        out.append(kb)
+        out.append(dtb)
+        out.append(a.tobytes())
+    return b"".join(out)
+
+
+def _unpack_cols_bulk(buf: bytes):
+    import numpy as np
+    mlen, ns, rows, nf = struct.unpack_from("<HIQH", buf, 0)
+    pos = struct.calcsize("<HIQH")
+    mst = buf[pos:pos + mlen].decode()
+    pos += mlen
+    sids = np.frombuffer(buf, dtype="<i8", count=ns, offset=pos).copy()
+    pos += ns * 8
+    offsets = np.frombuffer(buf, dtype="<i8", count=ns + 1,
+                            offset=pos).copy()
+    pos += (ns + 1) * 8
+    times_cat = np.frombuffer(buf, dtype="<i8", count=rows,
+                              offset=pos).copy()
+    pos += rows * 8
+    fields = {}
+    for _ in range(nf):
+        klen, dlen = struct.unpack_from("<HB", buf, pos)
+        pos += struct.calcsize("<HB")
+        k = buf[pos:pos + klen].decode()
+        pos += klen
+        dt = np.dtype(buf[pos:pos + dlen].decode())
+        pos += dlen
+        fields[k] = np.frombuffer(buf, dtype=dt, count=rows,
+                                  offset=pos).copy()
+        pos += rows * dt.itemsize
+    return mst, sids, offsets, times_cat, fields
 
 
 def _unpack_cols(buf: bytes):
@@ -193,6 +248,23 @@ class WAL:
                 self._f.flush()
                 os.fsync(self._f.fileno())
 
+    def write_cols_bulk(self, mst: str, sids, offsets, times_cat,
+                        fields_cat) -> None:
+        """Multi-series concatenated columnar frame (bulk ingest)."""
+        failpoint.inject("wal.write.err")
+        raw = _pack_cols_bulk(mst, sids, offsets, times_cat, fields_cat)
+        if self.compression == "lz4":
+            codec, body = _LZ4_COLSB, lz4_compress(raw)
+        else:
+            codec, body = _ZSTD_COLSB, self._zc.compress(raw)
+        payload = struct.pack("<BI", codec, len(raw)) + body
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._f.write(frame)
+            if self.sync:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+
     def switch(self) -> int:
         """Rotate to a new segment; returns the sealed segment's seq
         (reference WAL.Switch). The sealed file is removed by
@@ -242,15 +314,20 @@ class WAL:
                     log.warning("wal %06d: bad crc at %d", seq, pos)
                     break
                 if len(payload) >= 5 and payload[0] in (
-                        _ZSTD, _LZ4, _ZSTD_COLS, _LZ4_COLS):
+                        _ZSTD, _LZ4, _ZSTD_COLS, _LZ4_COLS,
+                        _ZSTD_COLSB, _LZ4_COLSB):
                     codec, rawlen = struct.unpack_from("<BI", payload, 0)
                     body = payload[5:]
-                    if codec in (_LZ4, _LZ4_COLS):
+                    if codec in (_LZ4, _LZ4_COLS, _LZ4_COLSB):
                         raw = lz4_decompress(body, rawlen)
                     else:
                         raw = zd.decompress(body)
                     if codec in (_ZSTD_COLS, _LZ4_COLS):
                         yield ("cols", _unpack_cols(raw))
+                        pos += _HDR.size + ln
+                        continue
+                    if codec in (_ZSTD_COLSB, _LZ4_COLSB):
+                        yield ("colsb", _unpack_cols_bulk(raw))
                         pos += _HDR.size + ln
                         continue
                 else:
