@@ -129,20 +129,52 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
 
 CS_HOSTS = int(os.environ.get("OG_BENCH_CS_HOSTS", "2000"))
 CS_HOURS = 1.0
+CS_FIELDS = [f"usage_{k}" for k in
+             ("user", "system", "idle", "nice", "iowait", "irq",
+              "softirq", "steal", "guest", "guest_nice")]
+CS_QUERY = ("SELECT " + ", ".join(f"max(f)".replace("f", f)
+                                  for f in CS_FIELDS)
+            + f" FROM cpu WHERE time >= 0 AND "
+              f"time < {int(CS_HOURS * 3600)}s GROUP BY time(1h)")
+
+
+def colstore_query_phase(data_dir: str, runs: int) -> dict:
+    """Query loop over a built colstore dataset (runs in-process for
+    the TPU pass and in a JAX_PLATFORMS=cpu subprocess for the
+    baseline — identical code both ways)."""
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    eng = Engine(data_dir, EngineOptions(shard_duration=1 << 62))
+    ex = QueryExecutor(eng)
+    (stmt,) = parse_query(CS_QUERY)
+    res = ex.execute(stmt, "bench")
+    if "error" in res:
+        raise SystemExit(f"colstore query error: {res['error']}")
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        res = ex.execute(stmt, "bench")
+        times.append(time.perf_counter() - t0)
+    dig = hashlib.sha256()
+    for s in sorted(res.get("series", []),
+                    key=lambda s: json.dumps(s.get("tags", {}),
+                                             sort_keys=True)):
+        for r in s["values"]:
+            dig.update(repr(tuple(r)).encode())
+    cells = sum(len(s["values"]) for s in res.get("series", []))
+    eng.close()
+    return {"best_s": min(times), "digest": dig.hexdigest(),
+            "cells": cells}
 
 
 def colstore_phase() -> dict:
     """BASELINE config 3 (high-cpu-all shape): max() across 10 cpu
     fields on the COLUMN-STORE engine, grouped hourly — exercises
     storage/colstore.py + sparse-index scan (ColumnStoreReader role).
-    Same-code CPU-vs-TPU ratio is reported by the headline run; this
-    phase reports the columnstore e2e throughput."""
-    from opengemini_tpu.query import QueryExecutor, parse_query
+    Reports e2e throughput AND vs_baseline (same engine pinned to
+    CPU, digests compared)."""
     from opengemini_tpu.storage import Engine, EngineOptions
 
-    fields = [f"usage_{k}" for k in
-              ("user", "system", "idle", "nice", "iowait", "irq",
-               "softirq", "steal", "guest", "guest_nice")]
     points = int(CS_HOURS * 3600 / STEP_S)
     rng = np.random.default_rng(7)
     with tempfile.TemporaryDirectory(
@@ -157,38 +189,45 @@ def colstore_phase() -> dict:
         batch = []
         for h in range(CS_HOSTS):
             vals = np.round(np.clip(
-                rng.normal(50, 15, (len(fields), points)), 0, 100), 2)
+                rng.normal(50, 15, (len(CS_FIELDS), points)), 0, 100),
+                2)
             batch.append(("cpu", {"hostname": f"host_{h}"}, times,
-                          {f: vals[j] for j, f in enumerate(fields)}))
+                          {f: vals[j]
+                           for j, f in enumerate(CS_FIELDS)}))
             if len(batch) >= 500:
                 n += eng.write_record_batch("bench", batch)
                 batch = []
         if batch:
             n += eng.write_record_batch("bench", batch)
         eng.flush_all()
+        eng.close()
         t_ing = time.perf_counter() - t0
 
-        ex = QueryExecutor(eng)
-        sel = ", ".join(f"max({f})" for f in fields)
-        (stmt,) = parse_query(
-            f"SELECT {sel} FROM cpu WHERE time >= 0 AND "
-            f"time < {int(CS_HOURS * 3600)}s GROUP BY time(1h)")
-        res = ex.execute(stmt, "bench")
-        if "error" in res:
-            raise SystemExit(f"colstore query error: {res['error']}")
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            res = ex.execute(stmt, "bench")
-            times.append(time.perf_counter() - t0)
-        best = min(times)
-        cells = sum(len(s["values"]) for s in res.get("series", []))
-        eng.close()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase",
+             "csquery", "--data", td, "--runs", "3"],
+            capture_output=True, text=True, env=env, timeout=1800,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if out.returncode != 0:
+            raise SystemExit(
+                f"cs cpu phase failed: {out.stderr[-1500:]}")
+        cpu = json.loads(out.stdout.strip().splitlines()[-1])
+        tpu = colstore_query_phase(td, 3)
+        if cpu["digest"] != tpu["digest"]:
+            raise SystemExit(
+                f"COLSTORE MISMATCH: {cpu['digest'][:16]} != "
+                f"{tpu['digest'][:16]}")
     return {"metric": "tsbs_high_cpu_all_colstore_rows_per_sec",
-            "value": round(n / best, 1), "unit": "rows/s",
-            "rows": n, "fields": len(fields), "hosts": CS_HOSTS,
+            "value": round(n / tpu["best_s"], 1), "unit": "rows/s",
+            "rows": n, "fields": len(CS_FIELDS), "hosts": CS_HOSTS,
             "ingest_rows_per_sec": round(n / t_ing, 1),
-            "e2e_query_s": round(best, 4), "result_cells": cells}
+            "e2e_query_s": round(tpu["best_s"], 4),
+            "cpu_query_s": round(cpu["best_s"], 4),
+            "vs_baseline": round(cpu["best_s"] / tpu["best_s"], 3),
+            "bit_identical": True,
+            "result_cells": tpu["cells"]}
 
 
 def kernel_micro() -> float:
@@ -243,13 +282,17 @@ def http_roundtrip(data_dir: str) -> float:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--phase", choices=["query"], default=None)
+    ap.add_argument("--phase", choices=["query", "csquery"],
+                    default=None)
     ap.add_argument("--data", default=None)
     ap.add_argument("--runs", type=int, default=3)
     args = ap.parse_args()
 
     if args.phase == "query":
         print(json.dumps(run_query_phase(args.data, args.runs)))
+        return
+    if args.phase == "csquery":
+        print(json.dumps(colstore_query_phase(args.data, args.runs)))
         return
 
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None

@@ -201,7 +201,6 @@ def _seg_reduce_sorted(seg, n_out, arrays_min, arrays_max):
     """Sorted-run reduceat helper: seg must be nondecreasing. Returns
     per-output (min…, max…) arrays with identity fills for empty
     segments. arrays_* are (values, identity) pairs."""
-    present = seg < n_out
     starts = np.flatnonzero(np.diff(seg, prepend=-1))
     run_seg = seg[starts]
     keep = run_seg < n_out
@@ -218,7 +217,6 @@ def _seg_reduce_sorted(seg, n_out, arrays_min, arrays_max):
             r = np.maximum.reduceat(vals, starts)
             o[run_seg[keep]] = r[keep]
         outs.append(o)
-    del present
     return outs
 
 

@@ -18,6 +18,7 @@ approximation).
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -67,7 +68,7 @@ _MAX_FOLD = 128
 # rows below this fold on host (numpy): the device bucket kernel pulls
 # 15 state arrays, each paying a full transfer round trip on tunnel-
 # attached chips — raise/lower for directly-attached hardware
-PROM_DEVICE_MIN_ROWS = int(__import__("os").environ.get(
+PROM_DEVICE_MIN_ROWS = int(os.environ.get(
     "OG_PROM_DEVICE_MIN_ROWS", "16000000"))
 VALUE_FIELD = "value"
 

@@ -302,9 +302,12 @@ class ColumnStoreReader:
             names.append("time")
         col_idx = [self.schema.field_index(c) for c in names]
         out_schema = Schema([self.schema.fields[i] for i in col_idx])
-        out_cols = [None] * len(col_idx)
         frags = self.footer["fragments"]
         sel = range(len(frags)) if mask is None else np.nonzero(mask)[0]
+        # per-column fragment PARTS concatenate once at the end:
+        # incremental ColVal.append reallocates per fragment (measured
+        # 0.74s of a 1.17s warm 176-fragment query)
+        parts: list[list] = [[] for _ in col_idx]
         for fi in sel:
             fr = frags[fi]
             n = fr["rows"]
@@ -314,13 +317,34 @@ class ColumnStoreReader:
                 vb = memoryview(self._mm)[voff:voff + vsize]
                 cv = _decode_col_block(out_schema.fields[oi].type, data, n)
                 cv.valid = enc.decode_validity(vb, n)
-                if out_cols[oi] is None:
-                    out_cols[oi] = cv
-                else:
-                    out_cols[oi].append(cv)
+                parts[oi].append(cv)
         if not len(sel):
             return Record(out_schema,
                           [_empty(f.type) for f in out_schema.fields])
+        out_cols = []
+        for oi, ps in enumerate(parts):
+            t = out_schema.fields[oi].type
+            if len(ps) == 1:
+                out_cols.append(ps[0])
+            elif t.is_numeric:
+                out_cols.append(ColVal(
+                    t, np.concatenate([p.values for p in ps]),
+                    np.concatenate([p.valid for p in ps])))
+            else:
+                # strings: shift offsets once, join data once (the
+                # append loop recopies all prior bytes per fragment)
+                offs = [np.asarray(ps[0].offsets)]
+                shift = int(offs[0][-1])
+                datas = [bytes(ps[0].data)]
+                for p in ps[1:]:
+                    po = np.asarray(p.offsets)
+                    offs.append(po[1:] + shift)
+                    shift += int(po[-1])
+                    datas.append(bytes(p.data))
+                out_cols.append(ColVal(
+                    t, valid=np.concatenate([p.valid for p in ps]),
+                    offsets=np.concatenate(offs).astype(np.int32),
+                    data=b"".join(datas)))
         return Record(out_schema, out_cols)
 
     def scan(self, expr=None, columns: list[str] | None = None) -> Record:

@@ -109,8 +109,10 @@ class MemTable:
         self.series: dict[int, _SeriesBuf] = {}
         # bulk ingest frames: (sids, offsets, times_cat, {field: cat})
         self.bulk_frames: list = []
-        self._bulk_index: dict | None = None
-        self._bulk_indexed = 0
+        # (frames_indexed, {sid: [(frame, lo, hi)]}) published as ONE
+        # tuple: lock-free readers must never observe a fresh index
+        # with a stale counter (re-appending duplicates rows)
+        self._bulk_index: tuple | None = None
         self.rows = 0
         self.approx_bytes = 0
 
@@ -176,7 +178,6 @@ class MemTable:
         self.bulk_frames.append((np.asarray(sids, dtype=np.int64),
                                  np.asarray(offsets, dtype=np.int64),
                                  times_cat, fields_cat))
-        self._bulk_index = None       # rebuilt lazily on next read
         n = len(times_cat)
         self.rows += n
         self.approx_bytes += n * (24 + 16 * len(fields_cat))
@@ -185,27 +186,25 @@ class MemTable:
         """[(frame_idx, lo, hi)] for one sid across bulk frames."""
         if not self.bulk_frames:
             return ()
-        ix = self._bulk_index
-        if ix is None or self._bulk_indexed < len(self.bulk_frames):
+        ent = self._bulk_index
+        if ent is None or ent[0] < len(self.bulk_frames):
             frames = self.bulk_frames[:]
-            if ix is None:
-                ix = {}
-                start = 0
+            if ent is None:
+                ix, start = {}, 0
             else:
                 # deep-copy the per-sid lists: the read path is lock-
                 # free, so two concurrent rebuilds must never append
                 # into a shared list (duplicated rows)
-                ix = {k: v[:] for k, v in ix.items()}
-                start = self._bulk_indexed
+                ix = {k: v[:] for k, v in ent[1].items()}
+                start = ent[0]
             for fi in range(start, len(frames)):
                 sids, offs, _t, _f = frames[fi]
                 for j, s in enumerate(sids.tolist()):
                     lo, hi = int(offs[j]), int(offs[j + 1])
                     if hi > lo:
                         ix.setdefault(s, []).append((fi, lo, hi))
-            self._bulk_index = ix
-            self._bulk_indexed = len(frames)
-        return ix.get(sid, ())
+            self._bulk_index = ent = (len(frames), ix)
+        return ent[1].get(sid, ())
 
     def consolidate_bulk(self):
         """All bulk frames → (sids ascending, offsets, times_cat

@@ -167,3 +167,60 @@ def test_empty_windows_nan():
     ends = np.array([[10**9, 2 * 10**9, 3 * 10**9]])
     out = np.asarray(P.prom_rate(win, ends, 10**9))
     assert np.isnan(out[0, 1]) and np.isnan(out[0, 2])
+
+
+def test_host_and_device_kernel_parity(tmp_path, monkeypatch):
+    """Review r4: the host numpy mirrors (bucket_states_host,
+    fold_windows_host, irate_states_host) and the jitted device
+    kernels must produce identical query output — exercised by
+    forcing the device branch via PROM_DEVICE_MIN_ROWS=0."""
+    import numpy as np
+
+    import opengemini_tpu.promql.engine as PE
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    NS = 10**9
+    eng = Engine(str(tmp_path / "d"), EngineOptions(shard_duration=1 << 62))
+    eng.create_database("prom")
+    t = (np.arange(8, dtype=np.int64) * 30 + 30) * NS
+    rng = np.random.default_rng(4)
+    for i in range(6):
+        # integer-valued floats: bincount vs segment_sum accumulation
+        # order cannot differ in the last ulp
+        vals = np.cumsum(rng.integers(1, 9, 8)).astype(np.float64)
+        if i == 2:
+            vals[4] = 1.0                        # counter reset
+        eng.write_record("prom", "m", {"h": f"x{i}"}, t,
+                         {"value": vals})
+    for s in eng.database("prom").all_shards():
+        s.flush()
+    pe = PromEngine(eng, "prom")
+    queries = [
+        ("rate(m[1m])", True),
+        ("increase(m[2m])", True),
+        ("irate(m[1m])", True),
+        ("sum_over_time(m[2m])", True),
+        ("resets(m[2m])", True),
+        # deriv sums fractional time moments — accumulation order
+        # (bincount vs segment_sum) may differ in the last ulp
+        ("deriv(m[2m])", False),
+    ]
+    outs = {}
+    for dev in (False, True):
+        monkeypatch.setattr(PE, "PROM_DEVICE_MIN_ROWS",
+                            0 if dev else 10**9)
+        pe2 = PromEngine(eng, "prom")
+        outs[dev] = [
+            (q, pe2.query_range(q, 60 * NS, 240 * NS, 30 * NS))
+            for q, _ in queries]
+    for (q, strict), a, b in zip(queries, outs[False], outs[True]):
+        if strict:
+            assert a == b, q
+        else:
+            for sa, sb in zip(a[1], b[1]):
+                assert sa["metric"] == sb["metric"]
+                va = [float(v) for _t, v in sa["values"]]
+                vb = [float(v) for _t, v in sb["values"]]
+                np.testing.assert_allclose(va, vb, rtol=1e-12)
+    eng.close()
