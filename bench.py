@@ -132,8 +132,7 @@ CS_HOURS = 1.0
 CS_FIELDS = [f"usage_{k}" for k in
              ("user", "system", "idle", "nice", "iowait", "irq",
               "softirq", "steal", "guest", "guest_nice")]
-CS_QUERY = ("SELECT " + ", ".join(f"max(f)".replace("f", f)
-                                  for f in CS_FIELDS)
+CS_QUERY = ("SELECT " + ", ".join(f"max({f})" for f in CS_FIELDS)
             + f" FROM cpu WHERE time >= 0 AND "
               f"time < {int(CS_HOURS * 3600)}s GROUP BY time(1h)")
 

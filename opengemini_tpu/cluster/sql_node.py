@@ -194,7 +194,12 @@ class ClusterExecutor:
             return select_over_result(stmt, db, inner_res)
         mst = stmt.from_measurement
         cs = classify_select(stmt)
-        if cs.mode == "agg":
+        # the optimized plan's Exchange node picks the scatter payload
+        # ('partials' vs 'raw') — the reference's NODE_EXCHANGE
+        # consumption (select.go:209-212); classify_select still
+        # supplies the field/agg details within that choice
+        from ..query.logical import exchange_payload
+        if exchange_payload(stmt) == "partials" and cs.mode == "agg":
             if inc_query_id:
                 return self._select_agg_incremental(
                     stmt, db, mst, cs, inc_query_id, iter_id)
@@ -207,6 +212,21 @@ class ClusterExecutor:
                 if merged is not None:
                     partials = [merged]
             return finalize_partials(stmt, mst, cs, partials)
+        if cs.mode == "agg":
+            # plan chose a RAW exchange for an aggregate (degradation /
+            # rule override): scatter plain scans of the aggregate's
+            # input fields and run the full aggregation locally over
+            # the merged rows — slower, still exact
+            names = sorted({a.field for a in cs.aggs} | cs.raw_refs)
+            sub = replace(stmt,
+                          fields=[SelectField(FieldRef(n))
+                                  for n in names],
+                          limit=0, offset=0, slimit=0, soffset=0,
+                          order_desc=False)
+            q = format_statement(sub)
+            resps = self._scatter("store.select_raw", db, {"q": q})
+            merged = self._merge_raw(sub, resps, names)
+            return select_over_result(stmt, db, merged)
         if cs.is_plain_raw:
             q = format_statement(stmt)
             resps = self._scatter("store.select_raw", db, {"q": q})

@@ -1084,9 +1084,15 @@ class QueryExecutor:
         from ..ops import AggSpec, segment_aggregate, pad_bucket
         from ..ops.segment_agg import (SegmentAggResult, pad_rows,
                                        segment_aggregate_host)
+        from .logical import agg_fastpath
         from .scan import (PREAGG_STATES, decode_pool, materialize_scan,
                            plan_rowstore_scan)
 
+        # the optimized logical plan GATES the store fast paths (the
+        # runtime checks below only refine within what the plan
+        # allows) — disabling PreAggEligibilityRule observably forces
+        # the decode path (see tests/test_logical_plan.py)
+        plan_fast = agg_fastpath(stmt)
         aggs = cs.aggs
         interval = stmt.group_by_interval()
         offset = stmt.group_by_offset()
@@ -1241,14 +1247,17 @@ class QueryExecutor:
         if scan_plan is not None:
             from ..ops import blockagg as _ba_cap
             from ..ops import devicecache as _dc
-            preagg_possible = (cond.residual is None and not raw_fields
+            preagg_possible = (plan_fast == "preagg+dense+block"
+                               and cond.residual is None
+                               and not raw_fields
                                and spec_names <= PREAGG_STATES)
             # the 1M-cell ceiling assumes the packed uint32 transport;
             # legacy f64 planes are ~4x the bytes, so keep the old cap
             cells_cap = (BLOCK_MAX_CELLS if _ba_cap.PACK
                          else min(BLOCK_MAX_CELLS, 250000))
             block_ok = (
-                _dc.enabled() and cond.residual is None
+                plan_fast == "preagg+dense+block"
+                and _dc.enabled() and cond.residual is None
                 and not raw_fields
                 # no sumsq: device f64 emulation would break the
                 # cross-backend stddev digest (no limb state for v²)
@@ -1401,12 +1410,14 @@ class QueryExecutor:
             sum_consumed = any(a.func in ("sum", "mean", "stddev")
                                for a in aggs)
             need_limbs = EXACT_SUM and sum_consumed
-            allow_preagg = (cond.residual is None and not raw_fields
+            allow_preagg = (plan_fast == "preagg+dense+block"
+                            and cond.residual is None and not raw_fields
                             and spec_names <= PREAGG_STATES)
             # dense blocks feed pure axis reductions — usable whenever
             # no per-point state (first/last/extremum times) or row
             # filter is needed
-            allow_dense = (cond.residual is None and not raw_fields
+            allow_dense = (plan_fast in ("preagg+dense+block", "dense")
+                           and cond.residual is None and not raw_fields
                            and bool(interval)
                            and spec_names <= PREAGG_STATES | {"sumsq"})
             # device block cache probe: a hit means the assembled dense

@@ -10,12 +10,14 @@ rewrites it (pushdown/spread/prune decisions carried as node
 annotations), and the plan drives real execution choices —
 
 - EXPLAIN renders the optimized DAG with the fired rules,
-- the cluster executor consults the Exchange node to pick partial-agg
-  scatter vs raw scatter vs local short-circuit (the reference's
-  NODE_EXCHANGE removal, engine/executor/select.go:209-212),
-- the store/TPU execution strategy annotations (pre-agg eligibility,
-  dense/block-path candidacy, field pruning) are decided HERE and
-  observable, instead of living implicitly inside partial_agg.
+- the cluster executor consults the Exchange node's payload to pick
+  partial-agg scatter vs raw scatter (exchange_payload →
+  cluster/sql_node.py; the reference's NODE_EXCHANGE consumption,
+  engine/executor/select.go:209-212),
+- partial_agg consults the Aggregate node's fastpath annotation
+  (agg_fastpath) to GATE the pre-agg/dense/block fast paths — the
+  runtime checks only refine within what the plan allows, and
+  disabling PreAggEligibilityRule observably forces the decode path.
 
 Composite shapes (nested subqueries with mixed aggregates, binop trees
 over differently-grouped inner selects, joins) nest as plans: a
@@ -340,11 +342,19 @@ class PreAggEligibilityRule(HeuRule):
                 raw_needed |= fn in RAW_AGGS | SKETCH_AGGS \
                     | {"top", "bottom"}
                 states |= spec_names_for(AggItem(fn, "f", "o"))
-            eligible = not raw_needed and states <= PREAGG_STATES
+            if raw_needed:
+                fast = "decode"
+            elif states <= PREAGG_STATES:
+                fast = "preagg+dense+block"
+            elif states <= PREAGG_STATES | {"sumsq"}:
+                # stddev/spread: dense axis reductions apply, but the
+                # metadata/block tiers lack a sumsq state
+                fast = "dense"
+            else:
+                fast = "decode"
         except Exception:
-            eligible = False
-        node.notes["fastpath"] = (
-            "preagg+dense+block" if eligible else "decode")
+            fast = "decode"
+        node.notes["fastpath"] = fast
         return True
 
 
@@ -424,3 +434,29 @@ def plan_select(stmt: SelectStatement, cluster: bool = False
                 ) -> tuple[PlanNode, list]:
     """Build + optimize in one step (the EXPLAIN/executor entry)."""
     return optimize(build_plan(stmt, cluster))
+
+
+def agg_fastpath(stmt: SelectStatement) -> str:
+    """Executor entry: the optimized plan's fast-path annotation for
+    the aggregate — 'preagg+dense+block', 'dense', or 'decode'.
+    partial_agg consults THIS — the plan gates the store fast paths,
+    runtime re-checks only refine within them (reference: the
+    ExecutorBuilder consuming heu_planner output,
+    engine/executor/select.go:209-216)."""
+    plan, _ = plan_select(stmt)
+    for node in plan.walk():
+        if isinstance(node, LogicalAggregate):
+            return node.notes.get("fastpath", "decode")
+    return "decode"
+
+
+def exchange_payload(stmt: SelectStatement) -> str:
+    """Cluster entry: the Exchange node's payload kind — 'partials'
+    (scatter partial aggregation, merge exactly) or 'raw' (scatter row
+    scans). The cluster executor consults THIS instead of re-deriving
+    the mode (reference NODE_EXCHANGE consumption, select.go:209-212)."""
+    plan, _ = plan_select(stmt, cluster=True)
+    for node in plan.walk():
+        if isinstance(node, LogicalExchange):
+            return node.payload
+    return "raw"

@@ -310,3 +310,33 @@ def test_bit_identical_sum_mean_across_topologies(loaded):
         assert got[w][1] == exact
         assert got[w][2] == exact / len(vals)
         assert got[w][3] == len(vals)
+
+
+def test_exchange_payload_drives_cluster_scatter(loaded, monkeypatch):
+    """VERDICT r3 #4: the cluster scatter mode follows the plan's
+    Exchange payload — forcing 'raw' on an aggregate query routes it
+    through the raw-scan RPC instead of store.select_partial."""
+    import opengemini_tpu.query.logical as L
+
+    ex = loaded["sql"].facade.executor
+    calls = []
+    orig = ex._scatter
+
+    def spy(msg, db, body, **kw):
+        calls.append(msg)
+        return orig(msg, db, body, **kw)
+
+    monkeypatch.setattr(ex, "_scatter", spy)
+    stmt = parse_query("SELECT sum(usage) FROM cpu")[0]
+    res = ex.execute(stmt, "tsbs")
+    assert "error" not in res
+    assert "store.select_partial" in calls
+    calls.clear()
+    # the plan now says raw: the partial path must not run, and the
+    # degraded path must still return the SAME exact answer
+    monkeypatch.setattr(L, "exchange_payload", lambda s: "raw")
+    res2 = ex.execute(stmt, "tsbs")
+    assert "store.select_partial" not in calls
+    assert any("select_raw" in c for c in calls)
+    assert "error" not in res2, res2
+    assert res2 == res

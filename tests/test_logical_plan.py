@@ -109,3 +109,48 @@ def test_explain_renders_plan(tmp_path):
     assert "IndexScan(m" in text
     assert "optimizer:" in text
     eng.close()
+
+
+def test_plan_gates_execution_fastpath(tmp_path, monkeypatch):
+    """VERDICT r3 #4: the plan is load-bearing — removing
+    PreAggEligibilityRule from the rule set forces partial_agg onto
+    the decode path (observable via EXPLAIN ANALYZE scan counters),
+    while results stay identical."""
+    import json
+    import re
+
+    import numpy as np
+
+    import opengemini_tpu.query.logical as L
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    eng = Engine(str(tmp_path / "d"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    rng = np.random.default_rng(2)
+    t = np.arange(600, dtype=np.int64) * 10**10
+    for h in range(3):
+        eng.write_record("d", "cpu", {"host": f"h{h}"}, t,
+                         {"u": np.round(rng.normal(40, 9, 600), 3)})
+    for s in eng.database("d").all_shards():
+        s.flush()
+    text = ("SELECT count(u), sum(u) FROM cpu WHERE time >= 0 AND "
+            "time < 6000s")
+
+    def explain_counters(q):
+        (stmt,) = parse_query("EXPLAIN ANALYZE " + q)
+        blob = json.dumps(ex.execute(stmt, "d"))
+        m = re.search(r"preagg_segments=(\d+)", blob)
+        return int(m.group(1)) if m else 0
+
+    (stmt,) = parse_query(text)
+    with_rule = ex.execute(stmt, "d")
+    assert explain_counters(text) > 0          # metadata fast path on
+
+    monkeypatch.setattr(L, "DEFAULT_RULES", [
+        r for r in L.DEFAULT_RULES
+        if r.name != "preagg_eligibility"])
+    without = ex.execute(stmt, "d")
+    assert explain_counters(text) == 0         # decode path forced
+    assert with_rule == without                # same answer either way
+    eng.close()
