@@ -199,7 +199,7 @@ class ClusterExecutor:
         # consumption (select.go:209-212); classify_select still
         # supplies the field/agg details within that choice
         from ..query.logical import exchange_payload
-        if exchange_payload(stmt) == "partials" and cs.mode == "agg":
+        if cs.mode == "agg" and exchange_payload(stmt) == "partials":
             if inc_query_id:
                 return self._select_agg_incremental(
                     stmt, db, mst, cs, inc_query_id, iter_id)

@@ -52,14 +52,20 @@ def _shard_pad(mesh, arrs, axis_rows: int):
 
 
 def mesh_exact_aggregate(mesh, values, valid, seg_ids, limbs,
-                         num_segments: int):
+                         num_segments: int, times=None):
     """Distributed windowed aggregation with exact limb sums.
 
     Row-sharded inputs on the ``data`` axis: values/valid (N,), seg_ids
-    (N,) int32, limbs (N, K) i32. Each device reduces its slice into a
-    full (num_segments,) grid; grids merge with psum (count/limbs —
-    exact integer addition, order-free) and pmin/pmax. Output grids are
-    replicated across the mesh."""
+    (N,) int32, limbs (N, K) i32, times (N,) i64 (optional — enables
+    the first/last lattice). Each device reduces its slice into a full
+    (num_segments,) grid; grids merge with psum (count/limbs — exact
+    integer addition, order-free) and pmin/pmax. first/last merge as a
+    (time, value) lattice: pmin/pmax over the per-cell extreme TIME,
+    then a second collective picks the value among the global time
+    winners (min value for first, max for last, on the rare duplicate-
+    timestamp tie — order-free by construction, the shipped values
+    cross the mesh whole so f64 bits survive the emulated backend).
+    Output grids are replicated across the mesh."""
     import jax
     import jax.numpy as jnp
     try:
@@ -70,14 +76,22 @@ def mesh_exact_aggregate(mesh, values, valid, seg_ids, limbs,
 
     ns = num_segments + 1
     K = limbs.shape[-1]
+    I64MAX = np.iinfo(np.int64).max
+    I64MIN = np.iinfo(np.int64).min
+    with_fl = times is not None
+
+    in_specs = [P("data"), P("data"), P("data"), P("data", None)]
+    out_specs = {"count": P(None), "limbs": P(None, None),
+                 "min": P(None), "max": P(None)}
+    if with_fl:
+        in_specs.append(P("data"))
+        out_specs.update({"first": P(None), "first_time": P(None),
+                          "last": P(None), "last_time": P(None)})
 
     @jax.jit
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P("data"), P("data"), P("data"), P("data", None)),
-        out_specs={"count": P(None), "limbs": P(None, None),
-                   "min": P(None), "max": P(None)})
-    def step(v, m, seg, lb):
+    @functools.partial(shard_map, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=out_specs)
+    def step(v, m, seg, lb, *rest):
         seg = jnp.where(m, seg, num_segments)
         cnt = jax.ops.segment_sum(m.astype(jnp.int64), seg,
                                   ns)[:num_segments]
@@ -89,12 +103,38 @@ def mesh_exact_aggregate(mesh, values, valid, seg_ids, limbs,
                                  ns)[:num_segments]
         mx = jax.ops.segment_max(jnp.where(m, v, -jnp.inf), seg,
                                  ns)[:num_segments]
-        return {"count": jax.lax.psum(cnt, "data"),
-                "limbs": jax.lax.psum(lsum, "data"),
-                "min": jax.lax.pmin(mn, "data"),
-                "max": jax.lax.pmax(mx, "data")}
+        out = {"count": jax.lax.psum(cnt, "data"),
+               "limbs": jax.lax.psum(lsum, "data"),
+               "min": jax.lax.pmin(mn, "data"),
+               "max": jax.lax.pmax(mx, "data")}
+        if with_fl:
+            (t,) = rest
+            tf_loc = jax.ops.segment_min(
+                jnp.where(m, t, I64MAX), seg, ns)[:num_segments]
+            tl_loc = jax.ops.segment_max(
+                jnp.where(m, t, I64MIN), seg, ns)[:num_segments]
+            t_first = jax.lax.pmin(tf_loc, "data")
+            t_last = jax.lax.pmax(tl_loc, "data")
+            win_f = m & (t == t_first[jnp.minimum(seg,
+                                                  num_segments - 1)]
+                         ) & (seg < num_segments)
+            win_l = m & (t == t_last[jnp.minimum(seg,
+                                                 num_segments - 1)]
+                         ) & (seg < num_segments)
+            vf = jax.lax.pmin(jax.ops.segment_min(
+                jnp.where(win_f, v, jnp.inf), seg, ns)[:num_segments],
+                "data")
+            vl = jax.lax.pmax(jax.ops.segment_max(
+                jnp.where(win_l, v, -jnp.inf), seg, ns)[:num_segments],
+                "data")
+            out.update({"first": vf, "first_time": t_first,
+                        "last": vl, "last_time": t_last})
+        return out
 
-    return step(values, valid, seg_ids, limbs)
+    args = (values, valid, seg_ids, limbs)
+    if with_fl:
+        args = args + (times,)
+    return step(*args)
 
 
 def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
@@ -110,7 +150,7 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
     from ..query.condition import analyze_condition
     from ..query.functions import classify_select
     from ..query.scan import materialize_scan, plan_rowstore_scan
-    from ..query.executor import finalize_partials
+    from ..query.executor import _collect_raw_slices, finalize_partials
 
     mst = stmt.from_measurement
     cs = classify_select(stmt)
@@ -157,7 +197,9 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
     else:
         start = t0
         W = 1
+    raw_need = sorted({a.field for a in cs.aggs if a.needs_raw})
     needed = sorted({a.field for a in cs.aggs})
+    want_fl = any(a.func in ("first", "last") for a in cs.aggs)
     scanres = materialize_scan(plan, mst, needed, t_lo, t_hi,
                                int(start), int(interval or 2**63), W,
                                G * W, allow_preagg=False,
@@ -171,8 +213,11 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
         w = np.zeros(len(times), dtype=np.int64)
     seg = np.where(w < W, gids * W + w, G * W).astype(np.int32)
 
+    I64MAX = np.iinfo(np.int64).max
+    I64MIN = np.iinfo(np.int64).min
     fields_out = {}
     sum_scales = {}
+    raw_out = {}
     for fname in needed:
         vals, valid = scanres.fields[fname]
         vals = vals.astype(np.float64, copy=False)
@@ -180,9 +225,13 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
             float(np.abs(np.where(valid, vals, 0.0)).max())
             if len(vals) else 0.0)
         limbs, bad = exactsum.host_limbs(vals, valid, E)
-        (dv, dm, ds, dl), _ = _shard_pad(
-            mesh, [vals, valid, seg, limbs], len(vals))
-        out = mesh_exact_aggregate(mesh, dv, dm, ds, dl, G * W)
+        arrs = [vals, valid, seg, limbs]
+        if want_fl:
+            arrs.append(times)
+        sharded, _ = _shard_pad(mesh, arrs, len(vals))
+        out = mesh_exact_aggregate(
+            mesh, *sharded[:4], G * W,
+            times=sharded[4] if want_fl else None)
         cnt = np.asarray(out["count"]).reshape(G, W)
         lg = np.asarray(out["limbs"]).astype(np.float64)
         mn = np.asarray(out["min"]).reshape(G, W)
@@ -195,8 +244,24 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
               "min": mn, "max": mx,
               "sum_limbs": lg.reshape(G, W, exactsum.K_LIMBS),
               "sum_inexact": inex.reshape(G, W)}
+        if want_fl:
+            has = cnt > 0
+            st["first"] = np.where(
+                has, np.asarray(out["first"]).reshape(G, W), np.nan)
+            st["first_time"] = np.where(
+                has, np.asarray(out["first_time"]).reshape(G, W),
+                I64MAX).astype(np.int64)
+            st["last"] = np.where(
+                has, np.asarray(out["last"]).reshape(G, W), np.nan)
+            st["last_time"] = np.where(
+                has, np.asarray(out["last_time"]).reshape(G, W),
+                I64MIN).astype(np.int64)
         fields_out[fname] = st
         sum_scales[fname] = E
+        if fname in raw_need:
+            raw_out[fname] = _collect_raw_slices(
+                np.asarray(seg, dtype=np.int64), vals, valid, times,
+                G, W)
 
     group_keys = [None] * G
     for key, gi in global_groups.items():
@@ -207,6 +272,8 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
                "fields": fields_out,
                "field_types": {f: "float" for f in needed},
                "sum_scales": sum_scales}
+    if raw_out:
+        partial["raw"] = raw_out
     return finalize_partials(stmt, mst, cs, [partial])
 
 
@@ -239,14 +306,17 @@ def mesh_merge_partials(mesh, partials: list[dict]) -> dict | None:
             return None
     fnames = sorted(first["fields"])
     mergeable = {"count", "sum", "sumsq", "min", "max",
-                 "sum_limbs", "sum_inexact"}
+                 "min_time", "max_time", "first", "first_time",
+                 "last", "last_time", "sum_limbs", "sum_inexact"}
     for p in partials:
+        if "raw" in p or "sketch" in p or "topn" in p:
+            return None          # variable-size states stay host-side
         for f in fnames:
             st = p["fields"][f]
             if "sum_limbs" not in st or "count" not in st:
                 return None
             if not set(st) <= mergeable:
-                return None      # positional states (first/last/…)
+                return None
             if p.get("sum_scales", {}).get(f) != \
                     first.get("sum_scales", {}).get(f):
                 return None
@@ -291,13 +361,12 @@ def mesh_merge_partials(mesh, partials: list[dict]) -> dict | None:
               "sum_limbs": lg,
               "sum_inexact": np.logical_or.reduce(
                   [s["sum_inexact"] for s in sts])}
-        for k, how in (("min", np.minimum), ("max", np.maximum),
-                       ("sumsq", np.add)):
-            if all(k in s for s in sts):
-                g = sts[0][k]
-                for s2 in sts[1:]:
-                    g = how(g, s2[k])
-                st[k] = g
+        # positional states (min/max times, first/last lattices,
+        # sumsq) merge with the SHARED host exchange rules — one
+        # source of truth, uniform identity seeding (an empty cell in
+        # one partial never blocks another's real value)
+        from ..query.executor import merge_aligned_positionals
+        st.update(merge_aligned_positionals(sts))
         st["sum_inexact"] = np.asarray(st["sum_inexact"])
         out_fields[f] = st
     merged["fields"] = out_fields

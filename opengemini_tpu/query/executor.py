@@ -2433,6 +2433,63 @@ def _collect_raw_slices(seg, vals, valid, times, G: int, W: int) -> dict:
     return {"vals": out_v, "times": out_t}
 
 
+def merge_aligned_positionals(sts: list[dict]) -> dict:
+    """Aligned-grid merge of the positional exchange states (min/max
+    with extremum times, first/last lattices, sumsq) across partial
+    state dicts covering the SAME (G, W) grid. One source of truth for
+    the tie/identity rules shared by the host exchange merge below and
+    the mesh merge plane (parallel/meshquery.py) — every partial is
+    processed uniformly against identity-seeded targets, so empty
+    cells (NaN value, time 0 from the store kernels) never block a
+    later partial's real value."""
+    out: dict = {}
+    shape = sts[0]["count"].shape
+    if all("sumsq" in s for s in sts):
+        out["sumsq"] = np.sum([s["sumsq"] for s in sts], axis=0)
+    for k, better in (("min", np.less), ("max", np.greater)):
+        if not all(k in s for s in sts):
+            continue
+        ident = np.inf if k == "min" else -np.inf
+        cur = np.full(shape, ident)
+        curt = np.full(shape, _I64MAX, dtype=np.int64)
+        has_t = all((k + "_time") in s for s in sts)
+        for s in sts:
+            v2 = np.asarray(s[k], dtype=np.float64)
+            if has_t:
+                t2 = s[k + "_time"]
+                b = better(v2, cur)
+                tie = v2 == cur
+                curt = np.where(b, t2,
+                                np.where(tie, np.minimum(t2, curt),
+                                         curt))
+            cur = (np.minimum(cur, v2) if k == "min"
+                   else np.maximum(cur, v2))
+        out[k] = cur
+        if has_t:
+            out[k + "_time"] = curt
+    if all("first" in s for s in sts):
+        fv = np.full(shape, np.nan)
+        ft = np.full(shape, _I64MAX, dtype=np.int64)
+        for s in sts:
+            b_has = ~np.isnan(s["first"])
+            bt = np.where(b_has, s["first_time"], _I64MAX)
+            take = b_has & (bt < ft)
+            fv = np.where(take, s["first"], fv)
+            ft = np.where(take, bt, ft).astype(np.int64)
+        out["first"], out["first_time"] = fv, ft
+    if all("last" in s for s in sts):
+        lv = np.full(shape, np.nan)
+        lt = np.full(shape, _I64MIN, dtype=np.int64)
+        for s in sts:
+            b_has = ~np.isnan(s["last"])
+            bt = np.where(b_has, s["last_time"], _I64MIN)
+            take = b_has & (bt >= lt)
+            lv = np.where(take, s["last"], lv)
+            lt = np.where(take, bt, lt).astype(np.int64)
+        out["last"], out["last_time"] = lv, lt
+    return out
+
+
 def merge_partials(partials: list[dict | None]) -> dict | None:
     """Merge partial aggregate states from several stores/partitions into
     one global (G, W) state grid — the exchange-merge of the reference's

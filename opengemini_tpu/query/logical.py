@@ -442,12 +442,23 @@ def agg_fastpath(stmt: SelectStatement) -> str:
     partial_agg consults THIS — the plan gates the store fast paths,
     runtime re-checks only refine within them (reference: the
     ExecutorBuilder consuming heu_planner output,
-    engine/executor/select.go:209-216)."""
+    engine/executor/select.go:209-216). Memoized on the statement
+    object: the incremental path re-enters partial_agg with the same
+    statement per tail re-scan."""
+    cached = getattr(stmt, "_plan_fastpath", None)
+    if cached is not None:
+        return cached
     plan, _ = plan_select(stmt)
+    fast = "decode"
     for node in plan.walk():
         if isinstance(node, LogicalAggregate):
-            return node.notes.get("fastpath", "decode")
-    return "decode"
+            fast = node.notes.get("fastpath", "decode")
+            break
+    try:
+        stmt._plan_fastpath = fast
+    except Exception:
+        pass
+    return fast
 
 
 def exchange_payload(stmt: SelectStatement) -> str:
