@@ -192,6 +192,25 @@ class ClusterExecutor:
             if "error" in inner_res:
                 return inner_res
             return select_over_result(stmt, db, inner_res)
+        if stmt.from_regex is not None:
+            # FROM /regex/: expand against the union of store-side
+            # measurement catalogs, then run as a multi-source union
+            # (per-measurement series sets, like FROM m1, m2)
+            import re as _re
+            rx = _re.compile(stmt.from_regex)
+            names: set = set()
+            for r in self._scatter("store.measurements", db, {}):
+                names.update(r.get("measurements", ()))
+            matched = sorted(n for n in names if rx.search(n))
+            if not matched:
+                return {}
+            stmt = replace(stmt, from_regex=None,
+                           from_measurement=matched[0],
+                           extra_sources=list(stmt.extra_sources)
+                           + matched[1:])
+            if stmt.extra_sources:
+                from ..query.join import execute_multi_source
+                return execute_multi_source(self, stmt, db)
         mst = stmt.from_measurement
         cs = classify_select(stmt)
         # the optimized plan's Exchange node picks the scatter payload

@@ -258,18 +258,28 @@ class StoreNode:
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
         self._read_barrier(db, pts)
-        mst = stmt.from_measurement
-        cs = classify_select(stmt)
         self.stats["selects"] += 1
         partials = []
         for pt in pts:
             dbk = db_key(db, pt)
             if dbk not in self.engine.databases:
                 continue
+            # regex sources/dimensions expand against THIS node's
+            # schema (the sql node ships them verbatim; an unexpanded
+            # RegexDim would drop the group tags from the partial)
+            st = stmt
+            from ..query.ast import RegexDim
+            if st.from_regex is not None or any(
+                    isinstance(d.expr, RegexDim) for d in st.dimensions):
+                st = self.executor._expand_regexes(st, dbk)
+                if st is None:
+                    continue
+            mst = st.from_measurement
+            cs = classify_select(st)
             tag_keys = {k for s in self.engine.database(dbk).all_shards()
                         for k in s.index.tag_keys(mst)}
-            cond = analyze_condition(stmt.condition, tag_keys)
-            p = self.executor.partial_agg(stmt, dbk, mst, cs, cond,
+            cond = analyze_condition(st.condition, tag_keys)
+            p = self.executor.partial_agg(st, dbk, mst, cs, cond,
                                           tag_keys)
             if p is not None:
                 partials.append(p)

@@ -187,6 +187,9 @@ def mesh_partial_agg(engine, db: str, stmt, mesh) -> dict:
     # (incl. GROUP BY time(i, offset) and the start-coverage step) —
     # bit-identity requires identical bucket boundaries
     offset = stmt.group_by_offset()
+    if stmt.tz and interval:
+        from ..query.executor import tz_bucket_offset
+        offset += tz_bucket_offset(stmt.tz, interval)
     t0 = t_lo if t_lo is not None else plan.data_tmin
     if interval:
         start = (t0 - offset) // interval * interval + offset

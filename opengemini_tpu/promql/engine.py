@@ -331,7 +331,50 @@ class PromEngine:
         the round-2 per-series read_series loop cost ~170µs/series of
         pure Python at 1M-series scale."""
         if not vs.name:
-            raise PromQLError("selector requires a metric name")
+            # bare selector with __name__ matchers: expand to the union
+            # of matching measurements (upstream {__name__=~"..."}).
+            name_ms = [m for m in vs.matchers if m.name == "__name__"]
+            if not name_ms:
+                raise PromQLError("selector requires a metric name")
+            import re as _re
+            from dataclasses import replace as _rep
+            rest = [m for m in vs.matchers if m.name != "__name__"]
+            db = self._db_obj()
+            msts: set = set()
+            if db:
+                for s in db.all_shards():
+                    msts.update(s.measurements())
+
+            def name_ok(nm: str) -> bool:
+                for m in name_ms:
+                    if m.op == "=":
+                        ok = nm == m.value
+                    elif m.op == "!=":
+                        ok = nm != m.value
+                    elif m.op == "=~":
+                        ok = _re.fullmatch(m.value, nm) is not None
+                    else:
+                        ok = _re.fullmatch(m.value, nm) is None
+                    if not ok:
+                        return False
+                return True
+
+            parts = [self._gather(_rep(vs, name=nm, matchers=rest),
+                                  t_min, t_max)
+                     for nm in sorted(msts) if name_ok(nm)]
+            parts = [p for p in parts if p[0]]
+            if not parts:
+                return ([], np.zeros(0), np.zeros(0, np.int64),
+                        np.zeros(0, np.int64))
+            labels: list = []
+            va, ta, ga = [], [], []
+            for ls, v, t, g in parts:
+                ga.append(g + len(labels))
+                labels.extend(ls)
+                va.append(v)
+                ta.append(t)
+            return (labels, np.concatenate(va), np.concatenate(ta),
+                    np.concatenate(ga))
         filters = [TagFilter(m.name, m.value, m.op) for m in vs.matchers]
         try:
             db = self.engine.database(self.db)
@@ -592,6 +635,28 @@ class PromEngine:
                 return ScalarSteps(inner.values[0].copy())
             return ScalarSteps(np.full(nsteps, np.nan))
         if f in _ELEMENTWISE:
+            if f == "round" and len(fc.args) == 2:
+                # round(v, to_nearest): round to the nearest multiple
+                # (upstream promql round's optional second argument)
+                near = scal(fc.args[1])
+                inner = self._eval(fc.args[0], start_ns, end_ns,
+                                   step_ns, lookback_ns)
+                with np.errstate(all="ignore"):
+                    fn2 = (lambda x: np.floor(
+                        np.asarray(x) / near + 0.5) * near)
+                    if isinstance(inner, float):
+                        out = fn2(inner)
+                        # `near` may vary per step (range query):
+                        # a scalar inner then yields per-step scalars
+                        return (float(out) if np.ndim(out) == 0
+                                else ScalarSteps(np.asarray(out)))
+                    if isinstance(inner, ScalarSteps):
+                        return ScalarSteps(fn2(inner.values))
+                    return SeriesMatrix(
+                        [{k: v for k, v in ls.items()
+                          if k != "__name__"}
+                         for ls in inner.labels],
+                        fn2(inner.values), True)
             if len(fc.args) != 1:
                 raise PromQLError(f"{f}() expects 1 argument")
             inner = self._eval(fc.args[0], start_ns, end_ns, step_ns,

@@ -302,7 +302,10 @@ class _P:
     def parse_unary(self):
         self.ws()
         if self.eat("-"):
-            e = self.parse_unary()
+            # upstream precedence: ^ binds TIGHTER than unary minus
+            # (-2^2 == -(2^2) == -4), so the operand parses at the
+            # power level
+            e = self.parse_expr(6)
             if isinstance(e, NumberLit):
                 return NumberLit(-e.value)
             return BinaryOp("*", NumberLit(-1.0), e)
@@ -387,8 +390,12 @@ class _P:
             return vs
         name = self.ident()
         self.ws()
-        if name in AGG_OPS:
-            return self._aggregation(name)
+        # aggregation operators are case-insensitive keywords upstream
+        # (functions stay case-sensitive); `SUM(...)` must aggregate,
+        # but a bare `SUM` with no parens is a metric selector
+        if name.lower() in AGG_OPS and self.peek() in ("(", "b", "w",
+                                                       "B", "W"):
+            return self._aggregation(name.lower())
         if self.peek() == "(":
             self.expect("(")
             args = []
@@ -431,11 +438,21 @@ class _P:
     def _aggregation(self, op: str) -> Aggregation:
         agg = Aggregation(op)
         self.ws()
+
+        def _grp_kw():
+            # BY/WITHOUT are case-insensitive keywords upstream
+            low = self.s[self.i:self.i + 7].lower()
+            if low.startswith("without"):
+                return "without"
+            if low.startswith("by"):
+                return "by"
+            return None
+
         # prefix grouping: sum by (a,b) (expr)
-        if self.s.startswith("by", self.i) or self.s.startswith("without",
-                                                                self.i):
-            agg.without = self.s.startswith("without", self.i)
-            self.i += 7 if agg.without else 2
+        kw = _grp_kw()
+        if kw:
+            agg.without = kw == "without"
+            self.i += len(kw)
             agg.grouping = self._label_list()
         self.expect("(")
         first = self.parse_expr()
@@ -447,10 +464,10 @@ class _P:
         self.expect(")")
         # suffix grouping
         self.ws()
-        if self.s.startswith("by", self.i) or self.s.startswith("without",
-                                                                self.i):
-            agg.without = self.s.startswith("without", self.i)
-            self.i += 7 if agg.without else 2
+        kw = _grp_kw()
+        if kw:
+            agg.without = kw == "without"
+            self.i += len(kw)
             agg.grouping = self._label_list()
         return agg
 

@@ -54,8 +54,16 @@ class SelectField:
 
 @dataclass
 class Dimension:
-    """GROUP BY entry: tag name, time(interval[, offset]) call, or *."""
+    """GROUP BY entry: tag name, time(interval[, offset]) call, regex,
+    or *."""
     expr: object
+
+
+@dataclass
+class RegexDim:
+    """GROUP BY /pattern/: expands to every matching tag key at
+    execution (influx GROUP BY regex)."""
+    pattern: str
 
 
 @dataclass
@@ -82,6 +90,8 @@ class SelectStatement:
     # multi-source union: FROM m1, m2 (influx semantics — the statement
     # runs per measurement, one series set each)
     extra_sources: list[str] = field(default_factory=list)
+    # FROM /regex/: expands to matching measurements at execution
+    from_regex: str | None = None
     # FROM (sub) AS a FULL JOIN (sub) AS b ON (a.tk = b.tk)
     join: "JoinClause | None" = None
 
@@ -135,6 +145,9 @@ class ShowStatement:
     on_db: str | None = None
     from_measurement: str | None = None
     key: str | None = None         # for SHOW TAG VALUES WITH KEY = x
+    # SHOW MEASUREMENTS WITH MEASUREMENT = m / =~ /re/
+    with_measurement: str | None = None
+    with_measurement_op: str = "="
     condition: object | None = None
     limit: int = 0
     offset: int = 0

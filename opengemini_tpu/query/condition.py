@@ -238,3 +238,24 @@ def eval_residual(expr, rec) -> np.ndarray:
             mask = mask & valid
         return mask
     return np.broadcast_to(np.asarray(res, dtype=bool), (n,)).copy()
+
+
+def record_with_tag_cols(rec, tags: dict, names) -> object:
+    """Record + per-row constant STRING columns for the given tag
+    names (absent tag → "", influx semantics) — lets eval_residual see
+    tag predicates on per-series records (mixed tag/field OR)."""
+    from ..record import ColVal, DataType, Field, Record, Schema
+    add = [n for n in names if rec.schema.field(n) is None]
+    if not add:
+        return rec
+    n = rec.num_rows
+    fields = [f for f in rec.schema.fields if f.name != "time"]
+    cols = [c for f, c in zip(rec.schema.fields, rec.cols)
+            if f.name != "time"]
+    for k in add:
+        fields.append(Field(k, DataType.STRING))
+        cols.append(ColVal.from_strings([tags.get(k, "")] * n))
+    ti = rec.schema.time_index
+    fields.append(rec.schema.fields[ti])
+    cols.append(rec.cols[ti])
+    return Record(Schema(fields), cols)

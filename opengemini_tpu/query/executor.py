@@ -24,7 +24,8 @@ import numpy as np
 from ..record import DataType
 from ..utils import get_logger
 from ..utils.errors import ErrQueryError, GeminiError
-from .ast import (AlterRPStatement, Call, FieldRef, Literal, SelectField,
+from .ast import (AlterRPStatement, Call, FieldRef, Literal, RegexDim,
+                  SelectField,
                   SelectStatement, ShowStatement, CreateCQStatement,
                   CreateDatabaseStatement, CreateMeasurementStatement,
                   CreateRPStatement, CreateUserStatement, DropCQStatement,
@@ -214,6 +215,12 @@ class QueryExecutor:
         cache key (see incremental.py)."""
         try:
             if isinstance(stmt, SelectStatement):
+                if stmt.from_regex is not None or any(
+                        isinstance(d.expr, RegexDim)
+                        for d in stmt.dimensions):
+                    stmt = self._expand_regexes(stmt, db)
+                    if stmt is None:
+                        return {}
                 if stmt.join is not None:
                     from .join import execute_join
                     return execute_join(self, stmt, stmt.from_db or db,
@@ -709,7 +716,16 @@ class QueryExecutor:
                            ["cardinality estimation"],
                            [[len(eng.measurements(db))]])
         if stmt.what == "measurements":
-            vals = [[m] for m in eng.measurements(db)]
+            names = eng.measurements(db)
+            if stmt.with_measurement is not None:
+                if stmt.with_measurement_op == "=~":
+                    import re as _re
+                    rx = _re.compile(stmt.with_measurement)
+                    names = [m for m in names if rx.search(m)]
+                else:
+                    names = [m for m in names
+                             if m == stmt.with_measurement]
+            vals = [[m] for m in names]
             return _series("measurements", ["name"], vals)
         shards = eng.database(db).all_shards()
 
@@ -957,6 +973,48 @@ class QueryExecutor:
                  else vals})
         return {"series": out_series}
 
+    def _expand_regexes(self, stmt, db: str | None):
+        """FROM /re/ → matching measurements (multi-source union);
+        GROUP BY /re/ → matching tag keys (influx regex sources,
+        lib/util/lifted/influx/influxql measurement regex). Returns a
+        rewritten copy, or None when no measurement matches."""
+        import re as _re
+        from dataclasses import replace as _rep
+
+        from .ast import Dimension, FieldRef as _FR
+        db2 = stmt.from_db or db
+        if stmt.from_regex is not None:
+            rx = _re.compile(stmt.from_regex)
+            names = sorted(m for m in self.engine.measurements(db2)
+                           if rx.search(m))
+            if not names:
+                return None
+            stmt = _rep(stmt, from_regex=None,
+                        from_measurement=names[0],
+                        extra_sources=list(stmt.extra_sources)
+                        + names[1:])
+        if any(isinstance(d.expr, RegexDim) for d in stmt.dimensions):
+            msts = [stmt.from_measurement] + [
+                s[2] if isinstance(s, tuple) else s
+                for s in stmt.extra_sources]
+            keys: set = set()
+            try:
+                for s in self.engine.database(db2).all_shards():
+                    for m in msts:
+                        keys.update(s.index.tag_keys(m))
+            except Exception:
+                keys = set()
+            dims = []
+            for d in stmt.dimensions:
+                if isinstance(d.expr, RegexDim):
+                    rx = _re.compile(d.expr.pattern)
+                    dims.extend(Dimension(_FR(k))
+                                for k in sorted(keys) if rx.search(k))
+                else:
+                    dims.append(d)
+            stmt = _rep(stmt, dimensions=dims)
+        return stmt
+
     def _explain(self, stmt: ExplainStatement, db: str | None) -> dict:
         """EXPLAIN: logical plan description; EXPLAIN ANALYZE: execute
         with a trace attached and render the span tree (reference
@@ -1096,6 +1154,8 @@ class QueryExecutor:
         aggs = cs.aggs
         interval = stmt.group_by_interval()
         offset = stmt.group_by_offset()
+        if stmt.tz and interval:
+            offset += tz_bucket_offset(stmt.tz, interval)
         group_tags = (sorted(tag_keys) if stmt.group_by_star
                       else stmt.group_by_tags())
         # residual-predicate fields must be scanned even if not aggregated
@@ -1452,12 +1512,15 @@ class QueryExecutor:
                 dense_pins[fp] = got
                 return True
 
+            res_tag_cols = (sorted(cond.residual_fields()
+                                   & set(tag_keys))
+                            if cond.residual is not None else None)
             scanres = materialize_scan(
                 scan_plan, mst, needed_fields, t_lo, t_hi,
                 int(start), int(interval_eff), W, G * W, allow_preagg,
                 allow_dense=allow_dense, need_limbs=need_limbs,
                 dense_cached=_dense_cached, ctx=ctx, pool=decode_pool(),
-                skip_sources=block_skip)
+                skip_sources=block_skip, tag_cols=res_tag_cols)
             if cond.residual is not None and scanres.n_rows:
                 mask = eval_residual(cond.residual, scanres.to_record())
                 if not mask.all():
@@ -2267,7 +2330,13 @@ class QueryExecutor:
                         if rec is None or rec.num_rows == 0:
                             continue
                         if cond.residual is not None:
-                            mask = eval_residual(cond.residual, rec)
+                            from .condition import record_with_tag_cols
+                            need_t = (cond.residual_fields()
+                                      & set(tag_keys))
+                            rec_ev = record_with_tag_cols(
+                                rec, s.index.tags_of(sid), need_t) \
+                                if need_t else rec
+                            mask = eval_residual(cond.residual, rec_ev)
                             if not mask.any():
                                 continue
                             rec = rec.take(np.nonzero(mask)[0])
@@ -2431,6 +2500,27 @@ def _collect_raw_slices(seg, vals, valid, times, G: int, W: int) -> dict:
             out_v[gi][wi] = v[b:e]
             out_t[gi][wi] = t[b:e]
     return {"vals": out_v, "times": out_t}
+
+
+def tz_bucket_offset(tz_name: str, interval: int) -> int:
+    """GROUP BY time(...) TZ('zone'): shift window alignment so bucket
+    edges land on zone-local boundaries (influx TZ semantics). Uses the
+    zone's standard (non-DST) UTC offset — the reference aligns per
+    window including DST transitions; fixed-offset alignment covers
+    the dominant cases (documented deviation for DST-crossing ranges).
+    Only intervals ≥ 1h can be affected by a zone offset."""
+    if interval < 3600 * 10**9:
+        return 0
+    try:
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        z = ZoneInfo(tz_name)
+        # January 1st: standard offset in the northern-hemisphere DST
+        # zones; close enough for alignment in the southern ones
+        off = datetime(2024, 1, 1, tzinfo=z).utcoffset()
+        return -int(off.total_seconds() * 10**9)
+    except Exception:
+        return 0
 
 
 def merge_aligned_positionals(sts: list[dict]) -> dict:

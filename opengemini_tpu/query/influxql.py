@@ -553,11 +553,15 @@ class Parser:
                                                   left_alias)
                 stmt.from_subquery = None
         else:
-            (stmt.from_db, stmt.from_rp,
-             stmt.from_measurement) = self._dotted_target()
-            while self._op(","):
-                # keep each source's db/rp qualifier
-                stmt.extra_sources.append(self._dotted_target())
+            rx = self.lx.try_regex()
+            if rx is not None:
+                stmt.from_regex = rx
+            else:
+                (stmt.from_db, stmt.from_rp,
+                 stmt.from_measurement) = self._dotted_target()
+                while self._op(","):
+                    # keep each source's db/rp qualifier
+                    stmt.extra_sources.append(self._dotted_target())
         if self._kw("WHERE"):
             stmt.condition = self.parse_expr()
         if self._kw("GROUP"):
@@ -565,6 +569,12 @@ class Parser:
             while True:
                 if self._op("*"):
                     stmt.dimensions.append(Dimension(Wildcard()))
+                elif (rxd := self.lx.try_regex()) is not None:
+                    from .ast import RegexDim
+                    stmt.dimensions.append(Dimension(RegexDim(rxd)))
+                    if not self._op(","):
+                        break
+                    continue
                 else:
                     e = self.parse_primary()
                     if isinstance(e, Call) and e.func == "time" \
@@ -717,9 +727,21 @@ class Parser:
         if self._kw("FROM"):
             stmt.from_measurement = self._ident()
         if self._kw("WITH"):
-            self._expect_kw("KEY")
-            self._expect_op("=")
-            stmt.key = self._ident()
+            if stmt.what == "measurements" \
+                    and self._kw("MEASUREMENT"):
+                if self._op("=~"):
+                    rx = self.lx.try_regex()
+                    if rx is None:
+                        raise ParseError("expected /regex/ after =~")
+                    stmt.with_measurement = rx
+                    stmt.with_measurement_op = "=~"
+                else:
+                    self._expect_op("=")
+                    stmt.with_measurement = self._ident()
+            else:
+                self._expect_kw("KEY")
+                self._expect_op("=")
+                stmt.key = self._ident()
         if self._kw("WHERE"):
             stmt.condition = self.parse_expr()
         if self._kw("LIMIT"):
@@ -953,17 +975,23 @@ def format_statement(stmt) -> str:
             if stmt.into_db:
                 tgt = f"{_fmt_ident(stmt.into_db)}..{tgt}"
             parts.append(f"INTO {tgt}")
-        src = _fmt_ident(stmt.from_measurement)
-        if stmt.from_db:
-            rp = _fmt_ident(stmt.from_rp) if stmt.from_rp else ""
-            src = f"{_fmt_ident(stmt.from_db)}.{rp}.{src}"
-        elif stmt.from_rp:
-            src = f"{_fmt_ident(stmt.from_rp)}.{src}"
+        if stmt.from_regex is not None:
+            src = "/" + stmt.from_regex.replace("/", "\\/") + "/"
+        else:
+            src = _fmt_ident(stmt.from_measurement)
+            if stmt.from_db:
+                rp = _fmt_ident(stmt.from_rp) if stmt.from_rp else ""
+                src = f"{_fmt_ident(stmt.from_db)}.{rp}.{src}"
+            elif stmt.from_rp:
+                src = f"{_fmt_ident(stmt.from_rp)}.{src}"
         parts.append(f"FROM {src}")
         if stmt.condition is not None:
             parts.append(f"WHERE {format_expr(stmt.condition)}")
         if stmt.dimensions:
-            dims = [format_expr(d.expr) for d in stmt.dimensions]
+            from .ast import RegexDim as _RD
+            dims = ["/" + d.expr.pattern.replace("/", "\\/") + "/"
+                    if isinstance(d.expr, _RD) else format_expr(d.expr)
+                    for d in stmt.dimensions]
             parts.append(f"GROUP BY {', '.join(dims)}")
         if stmt.fill_option != "null":
             fv = (str(stmt.fill_value) if stmt.fill_option == "value"
@@ -979,6 +1007,9 @@ def format_statement(stmt) -> str:
             parts.append(f"SLIMIT {stmt.slimit}")
         if stmt.soffset:
             parts.append(f"SOFFSET {stmt.soffset}")
+        if stmt.tz:
+            # LAST: the parser accepts TZ only after SLIMIT/SOFFSET
+            parts.append(f"TZ('{stmt.tz}')")
         return " ".join(parts)
     if isinstance(stmt, ShowStatement):
         parts = [f"SHOW {stmt.what.upper()}"]
@@ -986,6 +1017,14 @@ def format_statement(stmt) -> str:
             parts.append(f"ON {_fmt_ident(stmt.on_db)}")
         if stmt.from_measurement:
             parts.append(f"FROM {_fmt_ident(stmt.from_measurement)}")
+        if stmt.with_measurement is not None:
+            if stmt.with_measurement_op == "=~":
+                parts.append("WITH MEASUREMENT =~ /"
+                             + stmt.with_measurement.replace("/", "\\/")
+                             + "/")
+            else:
+                parts.append("WITH MEASUREMENT = "
+                             + _fmt_ident(stmt.with_measurement))
         if stmt.key:
             parts.append(f"WITH KEY = {_fmt_ident(stmt.key)}")
         if stmt.condition is not None:

@@ -468,16 +468,27 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                      need_limbs: bool = False,
                      dense_cached=None,
                      ctx=None, pool: ThreadPoolExecutor | None = None,
-                     skip_sources: set | None = None) -> ScanResult:
+                     skip_sources: set | None = None,
+                     tag_cols: list[str] | None = None) -> ScanResult:
     """Phase 2: pre-agg classification + batched segment decode.
     ``num_cells`` = G*W; pre-agg grids are (num_cells+1,) so gid*W+w
     indexes them directly. allow_dense routes whole-window spans of
-    CONST_DELTA segments to (S, P) blocks for the dense kernel."""
+    CONST_DELTA segments to (S, P) blocks for the dense kernel.
+    tag_cols: tag keys the caller's residual predicate references —
+    materialized as per-row string columns (series-constant; absent
+    tags become "" per influx semantics)."""
     stats = ScanStats()
     preagg: dict[str, dict[str, np.ndarray]] = {}
     # per-chunk decode tasks: (gid, callable) — results row-aligned
     tasks = []
+    task_tags: list[dict | None] = []   # aligned with tasks
     dense_tasks: list[_DenseTask] = []
+
+    def _sp_tags(sp):
+        if not tag_cols:
+            return None
+        tg = sp.shard.index.tags_of(sp.sid)
+        return {k: tg.get(k, "") for k in tag_cols}
     t_parts: list[np.ndarray] = []
     g_parts: list[int] = []          # gid per part (broadcast later)
     f_parts: list[dict] = []
@@ -501,6 +512,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
             # defer to the decode pool (run_one) so merged reads
             # parallelize alongside segment decodes
             tasks.append((sp.gid, None, (sp.shard, sp.sid)))
+            task_tags.append(_sp_tags(sp))
             continue
         stats.direct_series += 1
         for src in sp.sources:
@@ -509,6 +521,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
             if src.rec is not None:
                 stats.memtable_chunks += 1
                 tasks.append((sp.gid, None, src.rec))
+                task_tags.append(_sp_tags(sp))
                 continue
             cm = src.meta
             tm = cm.column("time")
@@ -570,6 +583,7 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
             if keep:
                 stats.decoded_segments += len(keep)
                 tasks.append((sp.gid, (src.reader, cm, keep), None))
+                task_tags.append(_sp_tags(sp))
 
     # ---- decode (thread pool: zstd + numpy release the GIL) ----------
     _EMPTY = (np.empty(0, dtype=np.int64), {}, {})
@@ -623,6 +637,14 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
         results = [run_one(t) for t in tasks]
         dense_results = [_run_dense(d, needed, W, blocks)
                          for _P, d, blocks in dense_jobs]
+    if tag_cols:
+        from ..record import ColVal
+        for (gid, times, cols, strs), tg in zip(results, task_tags):
+            if tg is None or not len(times):
+                continue
+            for k, v in tg.items():
+                if k not in strs and k not in cols:
+                    strs[k] = ColVal.from_strings([v] * len(times))
 
     # assemble (S, P) dense groups; edge leftovers join the flat rows
     dense_groups: dict[int, DenseGroup] = {}

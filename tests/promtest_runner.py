@@ -27,17 +27,29 @@ from opengemini_tpu.storage import PointRow
 NS = {"ns": 1, "us": 10**3, "ms": 10**6, "s": 10**9,
       "m": 60 * 10**9, "h": 3600 * 10**9, "d": 86400 * 10**9}
 
-_DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(ns|us|ms|s|m|h|d)$")
+_DUR_PART = re.compile(r"(\d+(?:\.\d+)?)(ns|us|ms|s|m|h|d)")
 _SERIES_RE = re.compile(r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)?"
                         r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<vals>.+)$")
 _EXPAND_RE = re.compile(r"^(-?\d+(?:\.\d+)?)([+-]\d+(?:\.\d+)?)x(\d+)$")
 
 
 def parse_duration(s: str) -> int:
-    m = _DUR_RE.match(s.strip())
-    if not m:
+    """Single or compound upstream durations: 5m, 1h30m, 2m30s; bare
+    `0` is a valid zero duration (upstream `from 0`)."""
+    s = s.strip()
+    if s == "0":
+        return 0
+    total = 0
+    pos = 0
+    while pos < len(s):
+        m = _DUR_PART.match(s, pos)
+        if not m:
+            raise ValueError(f"bad duration {s!r}")
+        total += int(float(m.group(1)) * NS[m.group(2)])
+        pos = m.end()
+    if pos == 0:
         raise ValueError(f"bad duration {s!r}")
-    return int(float(m.group(1)) * NS[m.group(2)])
+    return total
 
 
 def parse_labels(s: str | None) -> dict:
@@ -50,13 +62,20 @@ def parse_labels(s: str | None) -> dict:
 
 
 def expand_values(spec: str) -> list[float | None]:
-    """`0+10x3` → [0, 10, 20, 30]; literals space-split; `_` → None."""
+    """`0+10x3` → [0, 10, 20, 30]; literals space-split; `_` → None;
+    `Inf+0x3` / `NaN+0x3` repeat the non-finite value (upstream
+    notation for constant special-value series)."""
     vals: list[float | None] = []
     for tok in spec.split():
         m = _EXPAND_RE.match(tok)
+        sp = re.match(r"^(-?Inf|NaN)(?:[+-]0x(\d+))?$", tok)
         if m:
             a, b, n = float(m.group(1)), float(m.group(2)), int(m.group(3))
             vals.extend(a + b * i for i in range(n + 1))
+        elif sp:
+            v = float(sp.group(1).replace("Inf", "inf"))
+            n = int(sp.group(2)) if sp.group(2) else 0
+            vals.extend([v] * (n + 1))
         elif tok == "_":
             vals.append(None)
         else:
@@ -122,7 +141,64 @@ class PromScriptRunner:
                     i += 1
                 self._eval(kind, t_ns, query, expected, line)
                 continue
+            m = re.match(r"^eval\s+range\s+from\s+(\S+)\s+to\s+(\S+)"
+                         r"\s+step\s+(\S+)\s+(.*)$", line)
+            if m:
+                frm, to, stp, query = m.groups()
+                i += 1
+                expected = []
+                while i < len(lines) and lines[i].startswith("  ") \
+                        and lines[i].strip():
+                    expected.append(lines[i].strip())
+                    i += 1
+                self._eval_range(parse_duration(frm), parse_duration(to),
+                                 parse_duration(stp), query, expected,
+                                 line)
+                continue
             raise ValueError(f"unrecognized script line: {line!r}")
+
+    def _eval_range(self, start_ns: int, end_ns: int, step_ns: int,
+                    query: str, expected: list[str], ctx: str) -> None:
+        """`eval range from A to B step S <q>` — expected lines carry
+        one value per step (upstream promqltest matrix notation,
+        `_` for absent steps)."""
+        got = self.prom.query_range(query, start_ns, end_ns, step_ns)
+        nsteps = int((end_ns - start_ns) // step_ns) + 1
+        got_set = {}
+        for o in got:
+            labels = {k: v for k, v in o["metric"].items()}
+            per_t = {round(t, 9): float(v) for t, v in o["values"]}
+            row = [per_t.get(round((start_ns + i * step_ns) / 1e9, 9))
+                   for i in range(nsteps)]
+            got_set[tuple(sorted(labels.items()))] = row
+        exp_set = {}
+        for line in expected:
+            m = _SERIES_RE.match(line)
+            if not m:
+                raise ValueError(f"bad expected line {line!r} in {ctx}")
+            labels = parse_labels(m.group("labels"))
+            if m.group("name"):
+                labels["__name__"] = m.group("name")
+            exp_set[tuple(sorted(labels.items()))] = \
+                expand_values(m.group("vals"))
+        assert set(got_set) == set(exp_set), (
+            f"{ctx}\n  got series:      {sorted(got_set)}\n"
+            f"  expected series: {sorted(exp_set)}")
+        for key, want_row in exp_set.items():
+            have_row = got_set[key]
+            assert len(have_row) == len(want_row), (
+                f"{ctx} {dict(key)}: {len(have_row)} steps, "
+                f"want {len(want_row)}")
+            for i, (want, have) in enumerate(zip(want_row, have_row)):
+                if want is None and have is None:
+                    continue
+                ok = (want is not None and have is not None) and (
+                    (math.isnan(want) and math.isnan(have))
+                    or have == want
+                    or (want != 0 and abs(have - want)
+                        / abs(want) < 1e-9))
+                assert ok, (f"{ctx}\n  {dict(key)} step {i}: "
+                            f"got {have}, want {want}")
 
     def _eval(self, kind: str, t_ns: int, query: str,
               expected: list[str], ctx: str) -> None:

@@ -25,7 +25,11 @@ def test_parse_helpers():
 
 
 @pytest.mark.parametrize("script", ["promql_suite.test",
-                                    "promql_suite2.test"])
+                                    "promql_suite2.test",
+                                    "promql_suite3.test",
+                                    "promql_suite4.test",
+                                    "promql_suite5.test",
+                                    "promql_suite6.test"])
 def test_promql_suite_script(tmp_path, script):
     eng = Engine(str(tmp_path / "data"))
     runner = PromScriptRunner(eng)
@@ -46,4 +50,21 @@ eval instant at 2m m
 """
     with pytest.raises(AssertionError):
         runner.run(script)
+    eng.close()
+
+
+def test_uppercase_grouping_keywords(tmp_path):
+    """Review r4: BY/WITHOUT are case-insensitive keywords."""
+    from opengemini_tpu.promql import PromEngine
+    from opengemini_tpu.storage import Engine, PointRow
+    eng = Engine(str(tmp_path / "d"))
+    eng.write_points("p", [PointRow("m", {"k": "a"}, {"value": 2.0}, 10**9),
+                           PointRow("m", {"k": "b"}, {"value": 3.0}, 10**9)])
+    pe = PromEngine(eng, "p")
+    for q in ("SUM BY (k) (m)", "sum BY (k) (m)", "Sum Without () (m)"):
+        out = pe.query_instant(q, 2 * 10**9)
+        assert len(out) == 2, (q, out)
+    # round with per-step nearest over a scalar inner (range query)
+    out = pe.query_range("round(3.4, 0.5)", 0, 60 * 10**9, 30 * 10**9)
+    assert [v for _t, v in out[0]["values"]] == ["3.5"] * 3
     eng.close()

@@ -1,0 +1,748 @@
+"""Declarative black-box server suite, part 2 (VERDICT r3 #8: the
+reference's server_suite.go tables at breadth — epoch params, fill
+variants, ORDER/LIMIT/OFFSET, derivative family, regex sources,
+multi-statement requests, error bodies, timezone edges).
+
+Same harness as test_server_suite.py: each scenario writes line
+protocol through the real HTTP server and asserts exact response
+bodies against both the single-node server and a 3-node cluster."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from test_server_suite import MIN, ok, series, server  # noqa: F401
+
+SEC = 10**9
+
+
+def _q(srv, db, q, extra=""):
+    url = (f"http://127.0.0.1:{srv.port}/query?db={db}"
+           f"&q={urllib.parse.quote(q)}{extra}")
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+WAVE = "\n".join(f"w v={val} {i * MIN}"
+                 for i, val in enumerate([10, 20, 15, 25, 30, 5]))
+
+GAPPY = ("g u=1 0\n"
+         f"g u=3 {2 * MIN}\n"
+         f"g u=9 {5 * MIN}")
+
+TYPED = ("t f=1.5,i=10i,s=\"a\",b=true 60000000000\n"
+         "t f=2.5,i=20i,s=\"b\",b=false 120000000000")
+
+SUITE2 = [
+    {
+        "name": "epoch parameter scales timestamps",
+        "writes": "e v=7 60000000000",
+        "queries": [
+            ("SELECT v FROM e&epoch=s",
+             ok(series("e", ["time", "v"], [[60, 7.0]]))),
+            ("SELECT v FROM e&epoch=ms",
+             ok(series("e", ["time", "v"], [[60000, 7.0]]))),
+            ("SELECT v FROM e&epoch=u",
+             ok(series("e", ["time", "v"], [[60000000, 7.0]]))),
+            ("SELECT v FROM e&epoch=m",
+             ok(series("e", ["time", "v"], [[1, 7.0]]))),
+            ("SELECT v FROM e&epoch=ns",
+             ok(series("e", ["time", "v"], [[60000000000, 7.0]]))),
+        ],
+    },
+    {
+        "name": "fill variants",
+        "writes": GAPPY,
+        "queries": [
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(null)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [MIN, None], [2 * MIN, 3.0],
+                        [3 * MIN, None], [4 * MIN, None],
+                        [5 * MIN, 9.0]]))),
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(0)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [MIN, 0.0], [2 * MIN, 3.0],
+                        [3 * MIN, 0.0], [4 * MIN, 0.0],
+                        [5 * MIN, 9.0]]))),
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(none)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [2 * MIN, 3.0], [5 * MIN, 9.0]]))),
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(previous)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [MIN, 1.0], [2 * MIN, 3.0],
+                        [3 * MIN, 3.0], [4 * MIN, 3.0],
+                        [5 * MIN, 9.0]]))),
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(linear)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [MIN, 2.0], [2 * MIN, 3.0],
+                        [3 * MIN, 5.0], [4 * MIN, 7.0],
+                        [5 * MIN, 9.0]]))),
+            ("SELECT sum(u) FROM g WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(1m) fill(42)",
+             ok(series("g", ["time", "sum"],
+                       [[0, 1.0], [MIN, 42.0], [2 * MIN, 3.0],
+                        [3 * MIN, 42.0], [4 * MIN, 42.0],
+                        [5 * MIN, 9.0]]))),
+        ],
+    },
+    {
+        "name": "order by time desc and limits",
+        "writes": WAVE,
+        "queries": [
+            ("SELECT v FROM w ORDER BY time DESC LIMIT 2",
+             ok(series("w", ["time", "v"],
+                       [[5 * MIN, 5.0], [4 * MIN, 30.0]]))),
+            ("SELECT v FROM w LIMIT 2 OFFSET 2",
+             ok(series("w", ["time", "v"],
+                       [[2 * MIN, 15.0], [3 * MIN, 25.0]]))),
+            ("SELECT v FROM w ORDER BY time DESC LIMIT 1 OFFSET 1",
+             ok(series("w", ["time", "v"], [[4 * MIN, 30.0]]))),
+            ("SELECT v FROM w WHERE time >= 1m AND time <= 3m "
+             "ORDER BY time DESC",
+             ok(series("w", ["time", "v"],
+                       [[3 * MIN, 25.0], [2 * MIN, 15.0],
+                        [MIN, 20.0]]))),
+        ],
+    },
+    {
+        "name": "derivative family",
+        "writes": WAVE,
+        "queries": [
+            ("SELECT derivative(v, 1m) FROM w WHERE time >= 0 AND "
+             "time < 4m",
+             ok(series("w", ["time", "derivative"],
+                       [[MIN, 10.0], [2 * MIN, -5.0],
+                        [3 * MIN, 10.0]]))),
+            ("SELECT non_negative_derivative(v, 1m) FROM w WHERE "
+             "time >= 0 AND time < 4m",
+             ok(series("w", ["time", "non_negative_derivative"],
+                       [[MIN, 10.0], [3 * MIN, 10.0]]))),
+            ("SELECT difference(v) FROM w WHERE time >= 0 AND "
+             "time < 4m",
+             ok(series("w", ["time", "difference"],
+                       [[MIN, 10.0], [2 * MIN, -5.0],
+                        [3 * MIN, 10.0]]))),
+            ("SELECT non_negative_difference(v) FROM w WHERE "
+             "time >= 0 AND time < 4m",
+             ok(series("w", ["time", "non_negative_difference"],
+                       [[MIN, 10.0], [3 * MIN, 10.0]]))),
+            ("SELECT elapsed(v, 1m) FROM w WHERE time >= 0 AND "
+             "time < 3m",
+             ok(series("w", ["time", "elapsed"],
+                       [[MIN, 1], [2 * MIN, 1]]))),
+            ("SELECT cumulative_sum(v) FROM w WHERE time >= 0 AND "
+             "time < 4m",
+             ok(series("w", ["time", "cumulative_sum"],
+                       [[0, 10.0], [MIN, 30.0], [2 * MIN, 45.0],
+                        [3 * MIN, 70.0]]))),
+            ("SELECT moving_average(v, 2) FROM w WHERE time >= 0 AND "
+             "time < 4m",
+             ok(series("w", ["time", "moving_average"],
+                       [[MIN, 15.0], [2 * MIN, 17.5],
+                        [3 * MIN, 20.0]]))),
+        ],
+    },
+    {
+        "name": "math on fields in select",
+        "writes": "m a=10,b=4 1000",
+        "queries": [
+            ("SELECT a + b FROM m",
+             ok(series("m", ["time", "a_b"], [[1000, 14.0]]))),
+            ("SELECT a - b FROM m",
+             ok(series("m", ["time", "a_b"], [[1000, 6.0]]))),
+            ("SELECT a * b FROM m",
+             ok(series("m", ["time", "a_b"], [[1000, 40.0]]))),
+            ("SELECT a / b FROM m",
+             ok(series("m", ["time", "a_b"], [[1000, 2.5]]))),
+            ("SELECT a + b AS s FROM m",
+             ok(series("m", ["time", "s"], [[1000, 14.0]]))),
+            ("SELECT abs(a - 14) FROM m",
+             ok(series("m", ["time", "abs"], [[1000, 4.0]]))),
+            ("SELECT pow(b, 2) FROM m",
+             ok(series("m", ["time", "pow"], [[1000, 16.0]]))),
+            ("SELECT sqrt(a - 1) FROM m",
+             ok(series("m", ["time", "sqrt"], [[1000, 3.0]]))),
+        ],
+    },
+    {
+        "name": "multi statement request",
+        "writes": "ms v=1 1000\nms v=3 2000",
+        "queries": [
+            ("SELECT count(v) FROM ms; SELECT sum(v) FROM ms",
+             [{"series": [series("ms", ["time", "count"], [[0, 2]])],
+               "statement_id": 0},
+              {"series": [series("ms", ["time", "sum"], [[0, 4.0]])],
+               "statement_id": 1}]),
+        ],
+    },
+    {
+        "name": "regex measurement and field wildcard",
+        "writes": ("ra v=1 1000\n"
+                   "rb v=2 1000\n"
+                   "rc w=9 1000"),
+        "queries": [
+            ("SELECT v FROM /r[ab]/",
+             [{"series": [
+                 series("ra", ["time", "v"], [[1000, 1.0]]),
+                 series("rb", ["time", "v"], [[1000, 2.0]])],
+               "statement_id": 0}]),
+            ("SELECT * FROM rc",
+             ok(series("rc", ["time", "w"], [[1000, 9.0]]))),
+        ],
+    },
+    {
+        "name": "group by all tags wildcard",
+        "writes": ("cpu,host=a,dc=x u=1 1000\n"
+                   "cpu,host=b,dc=x u=5 1000"),
+        "queries": [
+            ("SELECT sum(u) FROM cpu GROUP BY *",
+             [{"series": [
+                 series("cpu", ["time", "sum"], [[0, 1.0]],
+                        {"dc": "x", "host": "a"}),
+                 series("cpu", ["time", "sum"], [[0, 5.0]],
+                        {"dc": "x", "host": "b"})],
+               "statement_id": 0}]),
+            ("SELECT sum(u) FROM cpu GROUP BY /d/",
+             [{"series": [
+                 series("cpu", ["time", "sum"], [[0, 6.0]],
+                        {"dc": "x"})],
+               "statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "tag filters with or and regex",
+        "writes": ("f,h=a,r=w u=1 1000\n"
+                   "f,h=b,r=w u=2 1000\n"
+                   "f,h=c,r=e u=4 1000"),
+        "queries": [
+            ("SELECT sum(u) FROM f WHERE h = 'a' OR h = 'c'",
+             ok(series("f", ["time", "sum"], [[0, 5.0]]))),
+            ("SELECT sum(u) FROM f WHERE h =~ /[ab]/",
+             ok(series("f", ["time", "sum"], [[0, 3.0]]))),
+            ("SELECT sum(u) FROM f WHERE h !~ /[ab]/",
+             ok(series("f", ["time", "sum"], [[0, 4.0]]))),
+            ("SELECT sum(u) FROM f WHERE r = 'w' AND h != 'a'",
+             ok(series("f", ["time", "sum"], [[0, 2.0]]))),
+        ],
+    },
+    {
+        "name": "field comparison predicates",
+        "writes": ("p v=5,okf=true 1000\n"
+                   "p v=15,okf=false 2000\n"
+                   "p v=25,okf=true 3000"),
+        "queries": [
+            ("SELECT v FROM p WHERE v > 10",
+             ok(series("p", ["time", "v"],
+                       [[2000, 15.0], [3000, 25.0]]))),
+            ("SELECT v FROM p WHERE v >= 15 AND v < 25",
+             ok(series("p", ["time", "v"], [[2000, 15.0]]))),
+            ("SELECT v FROM p WHERE okf = true",
+             ok(series("p", ["time", "v"],
+                       [[1000, 5.0], [3000, 25.0]]))),
+            ("SELECT count(v) FROM p WHERE v > 100", [
+                {"statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "subquery aggregation",
+        "writes": ("sq,h=a u=2 60000000000\n"
+                   "sq,h=a u=4 120000000000\n"
+                   "sq,h=b u=10 60000000000\n"
+                   "sq,h=b u=20 120000000000"),
+        "queries": [
+            ("SELECT sum(m) FROM (SELECT max(u) AS m FROM sq WHERE "
+             "time >= 1m AND time <= 2m GROUP BY h)",
+             ok(series("sq", ["time", "sum"], [[0, 24.0]]))),
+            ("SELECT mean(m) FROM (SELECT mean(u) AS m FROM sq WHERE "
+             "time >= 1m AND time <= 2m GROUP BY h)",
+             ok(series("sq", ["time", "mean"], [[0, 9.0]]))),
+        ],
+    },
+    {
+        "name": "distinct and mode",
+        "writes": ("dm v=1 1000\ndm v=1 2000\ndm v=3 3000\n"
+                   "dm v=3 4000\ndm v=3 5000"),
+        "queries": [
+            ("SELECT distinct(v) FROM dm",
+             ok(series("dm", ["time", "distinct"],
+                       [[0, 1.0], [0, 3.0]]))),
+            ("SELECT mode(v) FROM dm",
+             ok(series("dm", ["time", "mode"], [[0, 3.0]]))),
+            ("SELECT count(distinct(v)) FROM dm",
+             ok(series("dm", ["time", "count"], [[0, 2]]))),
+        ],
+    },
+    {
+        "name": "percentile and median",
+        "writes": "\n".join(f"pc v={i * 10} {i * 1000}"
+                            for i in range(1, 11)),
+        "queries": [
+            ("SELECT percentile(v, 50) FROM pc",
+             ok(series("pc", ["time", "percentile"], [[5000, 50.0]]))),
+            ("SELECT percentile(v, 90) FROM pc",
+             ok(series("pc", ["time", "percentile"], [[9000, 90.0]]))),
+            ("SELECT median(v) FROM pc",
+             ok(series("pc", ["time", "median"], [[0, 55.0]]))),
+        ],
+    },
+    {
+        "name": "typed fields survive the whole stack",
+        "writes": TYPED,
+        "queries": [
+            ("SELECT i FROM t",
+             ok(series("t", ["time", "i"],
+                       [[60000000000, 10], [120000000000, 20]]))),
+            ("SELECT sum(i) FROM t",
+             ok(series("t", ["time", "sum"], [[0, 30]]))),
+            ("SELECT s FROM t WHERE s = 'b'",
+             ok(series("t", ["time", "s"], [[120000000000, "b"]]))),
+            ("SELECT b FROM t WHERE b = false",
+             ok(series("t", ["time", "b"], [[120000000000, False]]))),
+            ("SELECT max(i) FROM t",
+             ok(series("t", ["time", "max"], [[120000000000, 20]]))),
+        ],
+    },
+    {
+        "name": "tag values show queries",
+        "writes": ("sv,host=a,dc=x u=1 1000\n"
+                   "sv,host=b,dc=y u=2 1000"),
+        "queries": [
+            ("SHOW TAG KEYS FROM sv",
+             ok(series("sv", ["tagKey"], [["dc"], ["host"]]))),
+            ("SHOW TAG VALUES FROM sv WITH KEY = \"host\"",
+             ok(series("sv", ["key", "value"],
+                       [["host", "a"], ["host", "b"]]))),
+            ("SHOW FIELD KEYS FROM sv",
+             ok(series("sv", ["fieldKey", "fieldType"],
+                       [["u", "float"]]))),
+        ],
+    },
+]
+
+
+@pytest.mark.parametrize("scenario", SUITE2,
+                         ids=[s["name"].replace(" ", "_")
+                              for s in SUITE2])
+def test_scenario2(server, scenario):
+    db = "suite2_" + scenario["name"].replace(" ", "_")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=scenario["writes"].encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    for q, expected in scenario["queries"]:
+        extra = ""
+        if "&" in q:
+            q, e = q.split("&", 1)
+            extra = "&" + e
+        got = _q(server, db, q, extra)
+        assert got["results"] == expected, f"{scenario['name']}: {q}"
+
+
+NOISY = "\n".join(
+    f"ns,h=h{h} u={h * 7 + i},x={i * 2} {i * MIN}"
+    for h in range(3) for i in range(5))
+
+
+SUITE2B = [
+    {
+        "name": "integral and spread",
+        "writes": "\n".join(f"ig v={v} {i * MIN}"
+                            for i, v in enumerate([10, 10, 10, 10])),
+        "queries": [
+            # constant 10 over 3 minutes = 10 * 180 unit-seconds
+            ("SELECT integral(v) FROM ig",
+             ok(series("ig", ["time", "integral"], [[0, 1800.0]]))),
+            ("SELECT integral(v, 1m) FROM ig",
+             ok(series("ig", ["time", "integral"], [[0, 30.0]]))),
+            ("SELECT spread(v) FROM ig",
+             ok(series("ig", ["time", "spread"], [[0, 0.0]]))),
+        ],
+    },
+    {
+        "name": "slimit and soffset",
+        "writes": NOISY,
+        "queries": [
+            ("SELECT sum(u) FROM ns GROUP BY h SLIMIT 2",
+             [{"series": [
+                 series("ns", ["time", "sum"], [[0, 10.0]],
+                        {"h": "h0"}),
+                 series("ns", ["time", "sum"], [[0, 45.0]],
+                        {"h": "h1"})],
+               "statement_id": 0}]),
+            ("SELECT sum(u) FROM ns GROUP BY h SLIMIT 1 SOFFSET 2",
+             [{"series": [
+                 series("ns", ["time", "sum"], [[0, 80.0]],
+                        {"h": "h2"})],
+               "statement_id": 0}]),
+            ("SELECT sum(u) FROM ns GROUP BY h SLIMIT 1 SOFFSET 9",
+             [{"statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "aggregate with tag filter and grouping",
+        "writes": NOISY,
+        "queries": [
+            ("SELECT mean(u) FROM ns WHERE h != 'h1' GROUP BY h",
+             [{"series": [
+                 series("ns", ["time", "mean"], [[0, 2.0]],
+                        {"h": "h0"}),
+                 series("ns", ["time", "mean"], [[0, 16.0]],
+                        {"h": "h2"})],
+               "statement_id": 0}]),
+            ("SELECT max(u) FROM ns GROUP BY time(2m), h",
+             [{"series": [
+                 series("ns", ["time", "max"],
+                        [[0, 1.0], [2 * MIN, 3.0], [4 * MIN, 4.0]],
+                        {"h": "h0"}),
+                 series("ns", ["time", "max"],
+                        [[0, 8.0], [2 * MIN, 10.0], [4 * MIN, 11.0]],
+                        {"h": "h1"}),
+                 series("ns", ["time", "max"],
+                        [[0, 15.0], [2 * MIN, 17.0], [4 * MIN, 18.0]],
+                        {"h": "h2"})],
+               "statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "holt winters and sample shapes",
+        "writes": "\n".join(f"hw v={i * 10} {i * MIN}"
+                            for i in range(8)),
+        "queries": [
+            # holt-winters fits alpha/beta by optimization, so even
+            # linear data projects approximately (deterministic values
+            # pinned here; influx's own fit is approximate too)
+            ("SELECT holt_winters(first(v), 2, 0) FROM hw WHERE "
+             "time >= 0 AND time < 8m GROUP BY time(1m)",
+             ok(series("hw", ["time", "holt_winters"],
+                       [[8 * MIN, 79.45262779660371],
+                        [9 * MIN, 88.9661603167614]]))),
+            # sample(v, N) with N >= rows returns every point
+            ("SELECT sample(v, 100) FROM hw WHERE time < 3m",
+             ok(series("hw", ["time", "sample"],
+                       [[0, 0.0], [MIN, 10.0], [2 * MIN, 20.0]]))),
+        ],
+    },
+    {
+        "name": "error bodies",
+        "writes": "eb v=1 1000",
+        "queries": [],
+        "errors": [
+            ("SELECT FROM eb", 400, "expected"),
+            ("SELECT v FROM", 400, "expected"),
+            ("SELECT mean() FROM eb", 400, "mean"),
+            ("SELECT percentile(v) FROM eb", 400, "percentile"),
+            ("NOT A QUERY", 400, "parsing"),
+            ("SELECT v FROM eb GROUP BY time(0s)", 400, "positive"),
+            ("SELECT v FROM eb; DROP JUNK", 400, "parsing"),
+        ],
+    },
+    {
+        "name": "delete and drop behaviors",
+        "writes": ("dd,h=a v=1 1000\ndd,h=a v=2 2000\n"
+                   "dd,h=b v=3 3000\nkeep v=9 1000"),
+        "queries": [
+            ("DELETE FROM dd WHERE time <= 2000", [{"statement_id": 0}]),
+            ("SELECT v FROM dd",
+             ok(series("dd", ["time", "v"], [[3000, 3.0]]))),
+            ("DROP MEASUREMENT dd", [{"statement_id": 0}]),
+            ("SELECT v FROM dd", [{"statement_id": 0}]),
+            ("SELECT v FROM keep",
+             ok(series("keep", ["time", "v"], [[1000, 9.0]]))),
+        ],
+    },
+    {
+        "name": "show queries surface",
+        "writes": "sq2 v=1 1000",
+        "queries": [
+            ("SHOW MEASUREMENTS",
+             ok(series("measurements", ["name"], [["sq2"]]))),
+            ("SHOW MEASUREMENTS WITH MEASUREMENT =~ /sq/",
+             ok(series("measurements", ["name"], [["sq2"]]))),
+            ("SHOW MEASUREMENTS WITH MEASUREMENT =~ /nope/",
+             ok(series("measurements", ["name"], []))),
+        ],
+    },
+    {
+        "name": "into clause materializes",
+        "writes": "src1 v=5 1000\nsrc1 v=7 2000",
+        "single_only": True,
+        "queries": [
+            ("SELECT sum(v) INTO dst1 FROM src1", 
+             ok(series("result", ["time", "written"], [[0, 1]]))),
+            ("SELECT sum FROM dst1",
+             ok(series("dst1", ["time", "sum"], [[0, 12.0]]))),
+        ],
+    },
+    {
+        "name": "group by time offset and division",
+        "writes": "\n".join(f"go v={i * 4} {i * MIN}"
+                            for i in range(6)),
+        "queries": [
+            # offset windows: time(2m, 1m) shifts bucket edges by 1m
+            ("SELECT sum(v) FROM go WHERE time >= 0 AND time < 6m "
+             "GROUP BY time(2m, 1m)",
+             ok(series("go", ["time", "sum"],
+                       [[-MIN, 0.0], [MIN, 12.0], [3 * MIN, 28.0],
+                        [5 * MIN, 20.0]]))),
+            ("SELECT sum(v) / 4 FROM go WHERE time < 6m",
+             ok(series("go", ["time", "sum"], [[0, 15.0]]))),
+            ("SELECT mean(v) * 2 + 1 FROM go WHERE time < 6m",
+             ok(series("go", ["time", "mean"], [[0, 21.0]]))),
+        ],
+    },
+]
+
+
+@pytest.mark.parametrize("scenario", SUITE2B,
+                         ids=[s["name"].replace(" ", "_")
+                              for s in SUITE2B])
+def test_scenario2b(server, scenario):
+    if scenario.get("single_only") and not hasattr(server.engine,
+                                                   "scan_series"):
+        pytest.skip("single-node-only scenario")
+    db = "suite2b_" + scenario["name"].replace(" ", "_")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=scenario["writes"].encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    for q, expected in scenario["queries"]:
+        got = _q(server, db, q)
+        assert got["results"] == expected, f"{scenario['name']}: {q}"
+    for q, code, frag in scenario.get("errors", []):
+        url = (f"http://127.0.0.1:{server.port}/query?db={db}"
+               f"&q={urllib.parse.quote(q)}")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = json.loads(r.read())
+                # some semantic errors come back 200 with an error
+                # result object (influx behavior)
+                blob = json.dumps(body)
+                assert "error" in blob and frag in blob, \
+                    f"{scenario['name']}: {q} -> {blob[:200]}"
+        except urllib.error.HTTPError as e:
+            assert e.code == code, f"{scenario['name']}: {q}: {e.code}"
+            blob = json.dumps(json.loads(e.read() or b"{}"))
+            assert frag in blob, f"{scenario['name']}: {q} -> {blob}"
+
+
+DAYS = 86400 * 10**9
+
+SUITE2C = [
+    {
+        "name": "time string literals in where",
+        "writes": ("ts v=1 0\n"
+                   f"ts v=2 {30 * MIN}\n"
+                   f"ts v=4 {60 * MIN}"),
+        "queries": [
+            ("SELECT sum(v) FROM ts WHERE "
+             "time >= '1970-01-01T00:30:00Z'",
+             ok(series("ts", ["time", "sum"],
+                       [[30 * MIN, 6.0]]))),
+            ("SELECT sum(v) FROM ts WHERE "
+             "time > '1970-01-01T00:30:00Z'",
+             ok(series("ts", ["time", "sum"],
+                       [[30 * MIN + 1, 4.0]]))),
+            ("SELECT v FROM ts WHERE time = '1970-01-01T00:30:00Z'",
+             ok(series("ts", ["time", "v"], [[30 * MIN, 2.0]]))),
+            ("SELECT sum(v) FROM ts WHERE "
+             "time < '1970-01-01T00:00:01Z'",
+             ok(series("ts", ["time", "sum"], [[0, 1.0]]))),
+        ],
+    },
+    {
+        "name": "timezone shifts daily buckets",
+        "writes": (f"tzd v=1 {2 * 3600 * 10**9}\n"
+                   f"tzd v=2 {26 * 3600 * 10**9}"),
+        "queries": [
+            # UTC days: both samples in separate UTC days
+            ("SELECT sum(v) FROM tzd WHERE time >= 0 AND time < 2d "
+             "GROUP BY time(1d)",
+             ok(series("tzd", ["time", "sum"],
+                       [[0, 1.0], [DAYS, 2.0]]))),
+            # America/New_York (UTC-5): local midnight = 05:00Z, so
+            # the local day containing 02:00Z starts at 1969-12-31
+            # 05:00Z = -19h; the next at +5h; the requested range end
+            # (48h) falls into one more (null-filled) local day
+            ("SELECT sum(v) FROM tzd WHERE time >= 0 AND time < 2d "
+             "GROUP BY time(1d) TZ('America/New_York')",
+             ok(series("tzd", ["time", "sum"],
+                       [[-19 * 3600 * 10**9, 1.0],
+                        [5 * 3600 * 10**9, 2.0],
+                        [29 * 3600 * 10**9, None]]))),
+        ],
+    },
+    {
+        "name": "cardinality family",
+        "writes": ("cf,h=a,r=x u=1,w=2 1000\n"
+                   "cf,h=b,r=x u=2 1000\n"
+                   "cg,h=a u=3 1000"),
+        "queries": [
+            ("SHOW SERIES CARDINALITY",
+             ok(series("series cardinality", ["cardinality estimation"],
+                       [[3]]))),
+            ("SHOW MEASUREMENT CARDINALITY",
+             ok(series("measurement cardinality",
+                       ["cardinality estimation"], [[2]]))),
+            ("SHOW TAG KEY CARDINALITY FROM cf",
+             ok(series("cf", ["count"], [[2]]))),
+            ("SHOW FIELD KEY CARDINALITY FROM cf",
+             ok(series("cf", ["count"], [[2]]))),
+        ],
+    },
+    {
+        "name": "show series and field keys breadth",
+        "writes": ("sb,h=a,r=x u=1 1000\n"
+                   "sb,h=b u=2,s=\"t\" 1000"),
+        "queries": [
+            ("SHOW SERIES",
+             ok(series("series", ["key"],
+                       [["sb,h=a,r=x"], ["sb,h=b"]]))),
+            ("SHOW FIELD KEYS",
+             ok(series("sb", ["fieldKey", "fieldType"],
+                       [["s", "string"], ["u", "float"]]))),
+            ("SHOW TAG VALUES FROM sb WITH KEY = \"r\"",
+             ok(series("sb", ["key", "value"], [["r", "x"]]))),
+        ],
+    },
+    {
+        "name": "group by time with limit",
+        "writes": "\n".join(f"gl v={i} {i * MIN}" for i in range(8)),
+        "queries": [
+            ("SELECT sum(v) FROM gl WHERE time >= 0 AND time < 8m "
+             "GROUP BY time(2m) LIMIT 2",
+             ok(series("gl", ["time", "sum"],
+                       [[0, 1.0], [2 * MIN, 5.0]]))),
+            ("SELECT sum(v) FROM gl WHERE time >= 0 AND time < 8m "
+             "GROUP BY time(2m) LIMIT 2 OFFSET 1",
+             ok(series("gl", ["time", "sum"],
+                       [[2 * MIN, 5.0], [4 * MIN, 9.0]]))),
+            ("SELECT first(v), last(v) FROM gl WHERE time >= 0 AND "
+             "time < 4m GROUP BY time(2m)",
+             ok(series("gl", ["time", "first", "last"],
+                       [[0, 0.0, 1.0], [2 * MIN, 2.0, 3.0]]))),
+        ],
+    },
+    {
+        "name": "negative and float edge values",
+        "writes": ("nv v=-1.5 1000\nnv v=-0.25 2000\n"
+                   "nv v=0.75 3000"),
+        "queries": [
+            ("SELECT sum(v) FROM nv",
+             ok(series("nv", ["time", "sum"], [[0, -1.0]]))),
+            ("SELECT min(v), max(v) FROM nv",
+             ok(series("nv", ["time", "min", "max"],
+                       [[0, -1.5, 0.75]]))),
+            ("SELECT abs(v) FROM nv WHERE v < -1",
+             ok(series("nv", ["time", "abs"], [[1000, 1.5]]))),
+            ("SELECT sum(v) FROM nv WHERE v >= -0.25",
+             ok(series("nv", ["time", "sum"], [[0, 0.5]]))),
+        ],
+    },
+    {
+        "name": "where on tag and field together",
+        "writes": ("wt,h=a v=5,u=1 1000\nwt,h=a v=15,u=2 2000\n"
+                   "wt,h=b v=25,u=3 1000"),
+        "queries": [
+            ("SELECT v FROM wt WHERE h = 'a' AND v > 10",
+             ok(series("wt", ["time", "v"], [[2000, 15.0]]))),
+            ("SELECT sum(u) FROM wt WHERE h = 'a' OR v > 20",
+             ok(series("wt", ["time", "sum"], [[0, 6.0]]))),
+            ("SELECT count(v) FROM wt WHERE h = 'b' AND v < 10", [
+                {"statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "mean of integers stays float",
+        "writes": "mi c=3i 1000\nmi c=4i 2000",
+        "queries": [
+            ("SELECT mean(c) FROM mi",
+             ok(series("mi", ["time", "mean"], [[0, 3.5]]))),
+            ("SELECT sum(c) FROM mi",
+             ok(series("mi", ["time", "sum"], [[0, 7]]))),
+            ("SELECT min(c), max(c) FROM mi",
+             ok(series("mi", ["time", "min", "max"], [[0, 3, 4]]))),
+        ],
+    },
+]
+
+
+@pytest.mark.parametrize("scenario", SUITE2C,
+                         ids=[s["name"].replace(" ", "_")
+                              for s in SUITE2C])
+def test_scenario2c(server, scenario):
+    if scenario.get("single_only") and not hasattr(server.engine,
+                                                   "scan_series"):
+        pytest.skip("single-node-only scenario")
+    db = "suite2c_" + scenario["name"].replace(" ", "_")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=scenario["writes"].encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    for q, expected in scenario["queries"]:
+        got = _q(server, db, q)
+        assert got["results"] == expected, f"{scenario['name']}: {q}"
+
+
+def test_chunked_response_lines(server):
+    """chunked=true streams one JSON object per chunk_size rows
+    (reference httpd chunked responses)."""
+    db = "suite2_chunked"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=b"ch v=1 1000\nch v=2 2000\nch v=3 3000", method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    url = (f"http://127.0.0.1:{server.port}/query?db={db}"
+           f"&q={urllib.parse.quote('SELECT v FROM ch')}"
+           "&chunked=true&chunk_size=1")
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    chunks = [json.loads(line) for line in body.splitlines() if line]
+    assert len(chunks) == 3
+    rows = [row for c in chunks
+            for s in c["results"][0]["series"] for row in s["values"]]
+    assert rows == [[1000, 1.0], [2000, 2.0], [3000, 3.0]]
+    assert all(c["results"][0].get("partial") in (True, None)
+               for c in chunks)
+
+
+def test_regex_from_aggregate_cluster(server):
+    """Review r4: FROM /regex/ with an aggregate must union per
+    measurement on the cluster too (was: first match only, unnamed)."""
+    db = "suite2_rxagg"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=b"ra v=1 1000\nra v=3 2000\nrb v=10 1000", method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    got = _q(server, db, "SELECT sum(v) FROM /r[ab]/")
+    assert got["results"] == [{"series": [
+        series("ra", ["time", "sum"], [[0, 4.0]]),
+        series("rb", ["time", "sum"], [[0, 10.0]])],
+        "statement_id": 0}]
+
+
+def test_tz_roundtrips_through_cluster_scatter(server):
+    """Review r4: TZ('zone') must survive format_statement →
+    store-side re-parse (was: serialized in a position the parser
+    rejects, erroring cluster-wide)."""
+    db = "suite2_tzrt"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}",
+        data=f"tzq v=1 {7200 * 10**9}".encode(), method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    got = _q(server, db,
+             "SELECT sum(v) FROM tzq WHERE time >= 0 AND time < 1d "
+             "GROUP BY time(1d) ORDER BY time DESC LIMIT 5 "
+             "TZ('America/New_York')")
+    rows = got["results"][0]["series"][0]["values"]
+    assert any(v == 1.0 for _t, v in rows), got
