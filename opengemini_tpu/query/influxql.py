@@ -906,10 +906,33 @@ class Parser:
         raise ParseError(f"unexpected token {v!r} at {p}")
 
 
+def _position_message(msg: str, text: str) -> str:
+    """Reference-style parse errors (influxql/parser.go): char offsets
+    become `at line N, char M`, and `expected X, got 'y'` flips to
+    `found y, expected X` — the form the black-box suite's error-body
+    assertions match against."""
+    m = re.search(r" at (\d+)$", msg)
+    if m is None:
+        return msg
+    pos = min(int(m.group(1)), len(text))
+    line = text.count("\n", 0, pos) + 1
+    col = pos - (text.rfind("\n", 0, pos) + 1) + 1
+    core = msg[:m.start()]
+    gm = re.match(r"expected (.+?), got '(.*)'$", core) \
+        or re.match(r"expected (.+?), got \"(.*)\"$", core)
+    if gm:
+        found = gm.group(2) or "EOF"
+        core = f"found {found}, expected {gm.group(1)}"
+    return f"{core} at line {line}, char {col}"
+
+
 def parse_query(text: str, now_ns: int | None = None) -> list:
     """Parse one or more ';'-separated statements."""
     p = Parser(text, now_ns)
-    stmts = p.parse_statements()
+    try:
+        stmts = p.parse_statements()
+    except ParseError as e:
+        raise ParseError(_position_message(str(e), text)) from None
     if not stmts:
         raise ParseError("empty query")
     return stmts

@@ -746,3 +746,29 @@ def test_tz_roundtrips_through_cluster_scatter(server):
              "TZ('America/New_York')")
     rows = got["results"][0]["series"][0]["values"]
     assert any(v == 1.0 for _t, v in rows), got
+
+
+@pytest.mark.parametrize("q,frag", [
+    ("SELECT FROM eb2", "found eb2, expected FROM at line 1, char 13"),
+    ("SELECT v FRM eb2", "found FRM, expected FROM at line 1, char 10"),
+    ("SELECT v FROM eb2\nGROUP time(1m)",
+     "found time, expected BY at line 2, char 7"),
+    ("SELECT v FROM eb2 LIMIT x",
+     "LIMIT requires a non-negative integer, got 'x' at line 1, "
+     "char 25"),
+    ("CREATE DATABSE d",
+     "found DATABSE, expected DATABASE at line 1, char 8"),
+])
+def test_parse_error_positions(server, q, frag):
+    """VERDICT r3 #10: reference-style position-accurate parse errors
+    (found X, expected Y at line N, char M) in HTTP error bodies."""
+    db = "suite2_errpos"
+    url = (f"http://127.0.0.1:{server.port}/query?db={db}"
+           f"&q={urllib.parse.quote(q)}")
+    try:
+        urllib.request.urlopen(url, timeout=10)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        body = json.loads(e.read())
+        assert frag in body["error"], body
