@@ -772,3 +772,134 @@ def test_parse_error_positions(server, q, frag):
         assert e.code == 400
         body = json.loads(e.read())
         assert frag in body["error"], body
+
+
+SUITE2D = [
+    {
+        "name": "nested functions and expressions",
+        "writes": "\n".join(f"nf v={i * 3} {i * MIN}" for i in range(6)),
+        "queries": [
+            ("SELECT ceil(mean(v)) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "ceil"], [[0, 8.0]]))),
+            ("SELECT floor(mean(v)) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "floor"], [[0, 7.0]]))),
+            ("SELECT round(mean(v)) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "round"], [[0, 8.0]]))),
+            ("SELECT sum(v) + count(v) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "sum_count"], [[0, 51.0]]))),
+            ("SELECT max(v) - min(v) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "max_min"], [[0, 15.0]]))),
+            ("SELECT mean(v) * mean(v) FROM nf WHERE time < 6m",
+             ok(series("nf", ["time", "mean_mean"], [[0, 56.25]]))),
+        ],
+    },
+    {
+        "name": "write precision parameter",
+        "writes": "wp v=1 100&precision=s",
+        "queries": [
+            ("SELECT v FROM wp",
+             ok(series("wp", ["time", "v"], [[100 * SEC, 1.0]]))),
+        ],
+    },
+    {
+        "name": "field type conflict rejected",
+        "writes": "tc v=1.5 1000",
+        "queries": [],
+        "write_errors": [
+            ("tc v=\"str\" 2000", 400, "conflict"),
+        ],
+    },
+    {
+        "name": "group by time desc ordering",
+        "writes": "\n".join(f"gd v={i} {i * MIN}" for i in range(4)),
+        "queries": [
+            ("SELECT sum(v) FROM gd WHERE time >= 0 AND time < 4m "
+             "GROUP BY time(1m) ORDER BY time DESC",
+             ok(series("gd", ["time", "sum"],
+                       [[3 * MIN, 3.0], [2 * MIN, 2.0],
+                        [MIN, 1.0], [0, 0.0]]))),
+            ("SELECT first(v) FROM gd WHERE time >= 0 AND time < 4m "
+             "GROUP BY time(2m) ORDER BY time DESC",
+             ok(series("gd", ["time", "first"],
+                       [[2 * MIN, 2.0], [0, 0.0]]))),
+        ],
+    },
+    {
+        "name": "chained subqueries",
+        "writes": "\n".join(f"cs,h=h{i % 2} v={i + 1} {i * MIN}"
+                            for i in range(6)),
+        "queries": [
+            ("SELECT max(s) FROM (SELECT sum(v) AS s FROM "
+             "(SELECT v FROM cs WHERE time < 6m) GROUP BY h)",
+             ok(series("cs", ["time", "max"], [[0, 12.0]]))),
+            ("SELECT count(m) FROM (SELECT mean(v) AS m FROM cs "
+             "WHERE time < 6m GROUP BY time(2m), h)",
+             ok(series("cs", ["time", "count"], [[0, 6]]))),
+        ],
+    },
+    {
+        "name": "select tag alongside field",
+        "writes": ("st,h=a v=1 1000\nst,h=b v=2 2000"),
+        "queries": [
+            ("SELECT v, h FROM st",
+             ok(series("st", ["time", "v", "h"],
+                       [[1000, 1.0, "a"], [2000, 2.0, "b"]]))),
+            ("SELECT v FROM st WHERE h = 'b'",
+             ok(series("st", ["time", "v"], [[2000, 2.0]]))),
+        ],
+    },
+    {
+        "name": "empty and missing measurement responses",
+        "writes": "em v=1 1000",
+        "queries": [
+            ("SELECT v FROM nothere", [{"statement_id": 0}]),
+            ("SELECT count(v) FROM nothere", [{"statement_id": 0}]),
+            ("SELECT v FROM em WHERE time > 5000",
+             [{"statement_id": 0}]),
+            ("SHOW TAG KEYS FROM nothere", [{"statement_id": 0}]),
+        ],
+    },
+    {
+        "name": "boolean field filters and aggregates",
+        "writes": ("bf ok=true,v=1 1000\nbf ok=false,v=2 2000\n"
+                   "bf ok=true,v=4 3000"),
+        "queries": [
+            ("SELECT count(ok) FROM bf",
+             ok(series("bf", ["time", "count"], [[0, 3]]))),
+            ("SELECT v FROM bf WHERE ok = true AND v > 2",
+             ok(series("bf", ["time", "v"], [[3000, 4.0]]))),
+            ("SELECT ok FROM bf WHERE v = 2",
+             ok(series("bf", ["time", "ok"], [[2000, False]]))),
+        ],
+    },
+]
+
+
+@pytest.mark.parametrize("scenario", SUITE2D,
+                         ids=[s["name"].replace(" ", "_")
+                              for s in SUITE2D])
+def test_scenario2d(server, scenario):
+    db = "suite2d_" + scenario["name"].replace(" ", "_")
+    writes = scenario["writes"]
+    extra = ""
+    if "&" in writes:
+        writes, e = writes.split("&", 1)
+        extra = "&" + e
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/write?db={db}{extra}",
+        data=writes.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    for q, expected in scenario["queries"]:
+        got = _q(server, db, q)
+        assert got["results"] == expected, f"{scenario['name']}: {q}"
+    for data, code, frag in scenario.get("write_errors", []):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/write?db={db}",
+            data=data.encode(), method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected write error")
+        except urllib.error.HTTPError as e:
+            assert e.code == code
+            assert frag in (e.read() or b"").decode()
