@@ -423,16 +423,20 @@ def main():
     # observed RESOURCE_EXHAUSTED when scale ran after the headline)
     scale_line = None
     if SCALE_ROWS > 0:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--phase",
-             "scalefull"],
-            capture_output=True, text=True, timeout=5400,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        if out.returncode == 0:
-            scale_line = out.stdout.strip().splitlines()[-1]
-        else:
-            print(f"# scale phase failed: {out.stderr[-800:]}",
-                  file=sys.stderr)
+        # auxiliary metric: never let it cost the headline line
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--phase",
+                 "scalefull"],
+                capture_output=True, text=True, timeout=5400,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if out.returncode == 0 and out.stdout.strip():
+                scale_line = out.stdout.strip().splitlines()[-1]
+            else:
+                print(f"# scale phase failed: {out.stderr[-800:]}",
+                      file=sys.stderr)
+        except Exception as e:
+            print(f"# scale phase failed: {e!r}", file=sys.stderr)
     with tempfile.TemporaryDirectory(prefix="og-bench-", dir=shm) as td:
         n_rows = build_dataset(td)
 

@@ -24,7 +24,8 @@ from ..query.ast import (BinaryExpr, Literal,
                          ShowStatement)
 from ..query.condition import analyze_condition
 from ..query.executor import (classify_select, finalize_partials,
-                              inherit_time_bounds, merge_partials,
+                              inherit_dimensions, inherit_time_bounds,
+                              merge_partials,
                               select_over_result, transform_raw_result)
 from ..query.incremental import (IncAggCache, complete_prefix,
                                  inc_fingerprint, inc_validate,
@@ -188,6 +189,7 @@ class ClusterExecutor:
             # over the materialized result (subquery results are already
             # globally merged, so the outer stage is single-node work)
             inner = inherit_time_bounds(stmt, stmt.from_subquery)
+            inner = inherit_dimensions(stmt, inner)
             inner_res = self._select(inner, inner.from_db or db)
             if "error" in inner_res:
                 return inner_res

@@ -215,9 +215,16 @@ class QueryExecutor:
         cache key (see incremental.py)."""
         try:
             if isinstance(stmt, SelectStatement):
-                if stmt.from_regex is not None or any(
-                        isinstance(d.expr, RegexDim)
-                        for d in stmt.dimensions):
+                # regex GROUP BY dims on a subquery statement are left
+                # intact here: inherit_dimensions pushes them into the
+                # inner statement and _select expands them where the
+                # source measurement (and so the tag-key universe) is
+                # real — the materialized throwaway engine for the
+                # outer stage, the true measurement for the inner
+                if stmt.from_regex is not None or (
+                        stmt.from_subquery is None and any(
+                            isinstance(d.expr, RegexDim)
+                            for d in stmt.dimensions)):
                     stmt = self._expand_regexes(stmt, db)
                     if stmt is None:
                         return {}
@@ -858,6 +865,7 @@ class QueryExecutor:
             return {"error": f"database not found: {db}"}
         if stmt.from_subquery is not None:
             inner = inherit_time_bounds(stmt, stmt.from_subquery)
+            inner = inherit_dimensions(stmt, inner)
             inner_res = self._select(inner, inner.from_db or db, ctx=ctx)
             if "error" in inner_res:
                 return inner_res
@@ -865,6 +873,10 @@ class QueryExecutor:
         elif self._is_castor(stmt):
             res = self._select_castor(stmt, db, ctx=ctx)
         else:
+            if stmt.from_regex is None and any(
+                    isinstance(d.expr, RegexDim)
+                    for d in stmt.dimensions):
+                stmt = self._expand_regexes(stmt, db)
             mst = stmt.from_measurement
             cs = classify_select(stmt)
             # tag key universe for condition analysis
@@ -2414,6 +2426,42 @@ def inherit_time_bounds(stmt, inner):
         e = BinaryExpr("<=", FieldRef("time"), Literal(t_max))
         cond = e if cond is None else BinaryExpr("and", cond, e)
     return replace(inner, condition=cond)
+
+
+def inherit_dimensions(stmt, inner):
+    """Influx subquery dimension semantics (lib/util/lifted/influx/query/
+    subquery.go buildSubquery: subOpt.Dimensions inherits the outer's):
+    outer tag/wildcard GROUP BY entries are pushed into the inner
+    statement so its output series carry the tags the outer groups on.
+    time() dims stay outer-only. Returns the (possibly rewritten)
+    inner."""
+    from dataclasses import replace
+
+    from .ast import Dimension, FieldRef, RegexDim, Wildcard
+    push = []
+    have = {d.expr.name for d in inner.dimensions
+            if isinstance(d.expr, FieldRef)}
+    inner_wild = any(isinstance(d.expr, Wildcard) for d in inner.dimensions)
+    have_rx = {d.expr.pattern for d in inner.dimensions
+               if isinstance(d.expr, RegexDim)}
+    for d in stmt.dimensions:
+        e = d.expr
+        if inner_wild:
+            break
+        if isinstance(e, FieldRef) and e.name not in have:
+            push.append(Dimension(FieldRef(e.name)))
+            have.add(e.name)
+        elif isinstance(e, RegexDim) and e.pattern not in have_rx:
+            # shipped verbatim; expanded against the real tag-key
+            # universe at the level that owns a concrete measurement
+            push.append(Dimension(RegexDim(e.pattern)))
+            have_rx.add(e.pattern)
+        elif isinstance(e, Wildcard):
+            push.append(Dimension(Wildcard()))
+            inner_wild = True
+    if not push:
+        return inner
+    return replace(inner, dimensions=list(inner.dimensions) + push)
 
 
 def select_over_result(stmt, db: str, inner_res: dict) -> dict:

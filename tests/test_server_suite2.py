@@ -838,6 +838,26 @@ SUITE2D = [
         ],
     },
     {
+        # outer GROUP BY dims (tag and regex) push into the inner
+        # statement — influx subquery.go inherit-dimensions semantics
+        "name": "subquery dim inheritance",
+        "writes": ("sq,h=a,r=x v=1 0\nsq,h=a,r=y v=3 60000000000"),
+        "queries": [
+            ("SELECT max(m) FROM (SELECT mean(v) AS m FROM sq) "
+             "GROUP BY h",
+             ok(series("sq", ["time", "max"], [[0, 2.0]],
+                       tags={"h": "a"}))),
+            ("SELECT max(m) FROM (SELECT mean(v) AS m FROM sq) "
+             "GROUP BY /^h$/",
+             ok(series("sq", ["time", "max"], [[0, 2.0]],
+                       tags={"h": "a"}))),
+            ("SELECT max(m) FROM (SELECT mean(v) AS m FROM sq "
+             "GROUP BY h, r) GROUP BY /^h$/",
+             ok(series("sq", ["time", "max"], [[0, 3.0]],
+                       tags={"h": "a"}))),
+        ],
+    },
+    {
         "name": "select tag alongside field",
         "writes": ("st,h=a v=1 1000\nst,h=b v=2 2000"),
         "queries": [
