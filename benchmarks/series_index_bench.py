@@ -77,19 +77,20 @@ def bench_prom_rate(n_series: int) -> dict:
     t0 = time.perf_counter()
     counters = np.cumsum(
         np.random.default_rng(0).random((POINTS,)) + 1.0)
-    # batched columnar ingest — the prom remote-write handler's path
-    # (write_record_batch → bulk frames → vectorized flush)
-    batch = []
-    for i in range(n_series):
-        batch.append(("node_cpu_seconds_total",
-                      {"instance": f"host-{i >> 3}",
-                       "cpu": f"cpu{i & 7}", "mode": "user"},
-                      times, {"value": counters + i}))
-        if len(batch) == 4000:
-            eng.write_record_batch("prom", batch)
-            batch = []
-    if batch:
-        eng.write_record_batch("prom", batch)
+    # matrix ingest — the prom remote-write handler's aligned-scrape
+    # path (matrices_from_write_request → write_series_matrix:
+    # columnar index create + tiled WAL/memtable frames)
+    keys = ["cpu", "instance", "mode"]
+    CH = 250000
+    for lo in range(0, n_series, CH):
+        hi = min(lo + CH, n_series)
+        idx = np.arange(lo, hi)
+        cols = [np.array([f"cpu{i & 7}" for i in idx]),
+                np.array([f"host-{i >> 3}" for i in idx]),
+                np.full(hi - lo, "user")]
+        vals = counters[None, :] + idx[:, None]
+        eng.write_series_matrix("prom", "node_cpu_seconds_total",
+                                keys, cols, times, {"value": vals})
     for s in eng.database("prom").all_shards():
         s.flush()
     t_ing = time.perf_counter() - t0

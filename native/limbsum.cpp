@@ -1,0 +1,54 @@
+// Fused exact-sum limb decomposition + per-series reduction for the
+// bulk flush path. Role: ops/exactsum.decompose + np.add.reduceat in
+// storage/tssp.py _write_bulk_run — the numpy form materializes an
+// (N, K) limb matrix and walks it K more times; this computes each
+// value's limbs and accumulates them into its series' sums in one
+// pass. Bit-identical to the numpy path: every operation (divide by a
+// power of two, floor, multiply, subtract, add in span order) is the
+// same IEEE-754 double sequence.
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// values: full concatenated row array; series i owns rows
+// [starts[i], ends[i]). E[i]: limb scale exponent (multiple of
+// limb_bits; 0 means all-zero values — limbs stay 0, exact iff every
+// value is exactly 0). out_limbs: (n_series, k_limbs) row-major,
+// zeroed by the caller. out_exact: per-series 1/0.
+void og_limb_sums(const double* values, const int64_t* starts,
+                  const int64_t* ends, const int64_t* E,
+                  int64_t n_series, int64_t k_limbs, int64_t limb_bits,
+                  double* out_limbs, uint8_t* out_exact) {
+    const double radix_max = (double)((1LL << limb_bits) - 1);
+    for (int64_t s = 0; s < n_series; s++) {
+        double scales[16];  // k_limbs <= 16 by construction (K_LIMBS=6)
+        double invs[16];    // scales are powers of two, so dividing by
+                            // one equals multiplying by its reciprocal
+                            // bit for bit — and multiplies pipeline
+        for (int64_t k = 0; k < k_limbs && k < 16; k++) {
+            int e = (int)(E[s] - limb_bits * (k + 1));
+            scales[k] = std::ldexp(1.0, e);
+            invs[k] = std::ldexp(1.0, -e);
+        }
+        double* limbs = out_limbs + s * k_limbs;
+        bool exact = true;
+        for (int64_t r = starts[s]; r < ends[s]; r++) {
+            double v = values[r];
+            bool finite = std::isfinite(v);
+            double a = finite ? std::fabs(v) : 0.0;
+            double sign = v < 0 ? -1.0 : 1.0;
+            for (int64_t k = 0; k < k_limbs; k++) {
+                double b = std::floor(a * invs[k]);
+                if (b > radix_max) b = radix_max;
+                a = a - b * scales[k];
+                limbs[k] += sign * b;
+            }
+            exact = exact && finite && (sign * a == 0.0);
+        }
+        out_exact[s] = exact ? 1 : 0;
+    }
+}
+
+}  // extern "C"

@@ -681,19 +681,33 @@ class HttpServer:
                 return 403, {"error": "server is in readonly mode"}, None
             try:
                 wr = decode_write_request(body)
+                use_mat = hasattr(self.engine, "write_series_matrix")
                 use_bulk = hasattr(self.engine, "write_record_batch")
-                if use_bulk:
-                    recs = records_from_write_request(wr)
+                if use_mat:
+                    from ..prom import matrices_from_write_request
+                    mats, recs = matrices_from_write_request(wr)
+                elif use_bulk:
+                    mats, recs = (), records_from_write_request(wr)
                 else:
                     rows = rows_from_write_request(wr)
             except Exception as e:
                 self._bump("write_errors")
                 return 400, {"error": f"bad remote write body: {e}"}, None
             try:
-                # columnar bulk path: arrays per series, engine bulk
-                # frames (the row path builds a PointRow per sample)
-                n = (self.engine.write_record_batch(db, recs)
-                     if use_bulk else self.engine.write_points(db, rows))
+                # matrix path for aligned scrape groups, columnar bulk
+                # frames for the rest (the row path builds a PointRow
+                # per sample)
+                if use_mat or use_bulk:
+                    from ..prom.remote import VALUE_FIELD
+                    n = 0
+                    for mst, keys, cols, times, vals in mats:
+                        n += self.engine.write_series_matrix(
+                            db, mst, keys, cols, times,
+                            {VALUE_FIELD: vals})
+                    if recs:
+                        n += self.engine.write_record_batch(db, recs)
+                else:
+                    n = self.engine.write_points(db, rows)
             except GeminiError as e:
                 self._bump("write_errors")
                 return 400, {"error": str(e)}, None

@@ -8,12 +8,14 @@ the shape promql/engine.py reads."""
 
 from .remote import (decode_read_request, decode_write_request,
                      encode_read_response, handle_remote_read,
+                     matrices_from_write_request,
                      records_from_write_request,
                      rows_from_write_request, snappy_compress,
                      snappy_decompress)
 
 __all__ = ["decode_write_request", "decode_read_request",
            "encode_read_response", "handle_remote_read",
+           "matrices_from_write_request",
            "records_from_write_request",
            "rows_from_write_request", "snappy_compress",
            "snappy_decompress"]

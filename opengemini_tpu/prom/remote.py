@@ -111,6 +111,66 @@ def records_from_write_request(req: "pb.WriteRequest") -> list[tuple]:
     return out
 
 
+def matrices_from_write_request(req, min_group: int = 64):
+    """WriteRequest → aligned-series MATRICES + leftover columnar
+    records. Scrape batches overwhelmingly share one timestamp vector
+    per (metric, label-key-set); those groups land as
+    (mst, keys, tag_cols, times ns, values (S, P)) for
+    Engine.write_series_matrix — zero per-series work downstream
+    (index tag columns, tiled WAL/memtable frames). Groups smaller
+    than min_group and ragged series fall out as
+    records_from_write_request-shaped entries."""
+    import numpy as np
+    groups: dict = {}
+    rest: list[tuple] = []
+    for ts in req.timeseries:
+        name = None
+        keys: list = []
+        vals: list = []
+        for lb in ts.labels:
+            if lb.name == "__name__":
+                name = lb.value
+            else:
+                keys.append(lb.name)
+                vals.append(lb.value)
+        if not name or not ts.samples:
+            continue
+        n = len(ts.samples)
+        times = np.empty(n, dtype=np.int64)
+        sam = np.empty(n, dtype=np.float64)
+        for i, s in enumerate(ts.samples):
+            times[i] = s.timestamp
+            sam[i] = s.value
+        keep = sam == sam                  # drop NaN stale markers
+        if not keep.all():
+            times, sam = times[keep], sam[keep]
+            if not len(times):
+                continue
+        if keys and not all(keys[i] < keys[i + 1]
+                            for i in range(len(keys) - 1)):
+            order = sorted(range(len(keys)), key=keys.__getitem__)
+            keys = [keys[i] for i in order]
+            vals = [vals[i] for i in order]
+        g = groups.get((name, tuple(keys), times.tobytes()))
+        if g is None:
+            g = groups[(name, tuple(keys), times.tobytes())] = (
+                [[] for _ in keys], [], times)
+        for j, v in enumerate(vals):
+            g[0][j].append(v)
+        g[1].append(sam)
+    mats = []
+    for (name, keys, _tb), (cols, rows, times) in groups.items():
+        if len(rows) >= min_group:
+            mats.append((name, list(keys), cols, times * MS,
+                         np.vstack(rows)))
+        else:
+            rest.extend(
+                (name, dict(zip(keys, (c[i] for c in cols))),
+                 times * MS, {VALUE_FIELD: rows[i]})
+                for i in range(len(rows)))
+    return mats, rest
+
+
 # ------------------------------------------------------------------- read
 
 def decode_read_request(body: bytes) -> "pb.ReadRequest":

@@ -321,6 +321,41 @@ class Engine:
             raise err
         return n
 
+    def write_series_matrix(self, db_name: str, mst: str, keys: list,
+                            tag_cols: list, times, fields: dict,
+                            create_db: bool = True) -> int:
+        """Aligned-series matrix ingest: S series × one (P,) timestamp
+        vector, fields as (S, P) matrices (the scrape / prom
+        remote-write shape — every per-series cost is a numpy slice;
+        see Shard.write_series_matrix). Rows split across shard groups
+        by TIME COLUMN only (all series share it)."""
+        db = (self.create_database(db_name) if create_db
+              else self.database(db_name))
+        sd = db.opts.shard_duration
+        times = np.ascontiguousarray(times, dtype=np.int64)
+        slots = times // sd
+        n = 0
+        for gi in np.unique(slots):
+            m = slots == gi
+            shard = db.shard_for_time(int(gi) * sd)
+            n += shard.write_series_matrix(
+                mst, keys, tag_cols, times[m],
+                {k: np.asarray(v)[:, m] for k, v in fields.items()})
+        if self.write_hooks:
+            from .rows import PointRow
+            rows = [PointRow(mst, dict(zip(keys, vals)),
+                             {k: np.asarray(v)[si, pi].item()
+                              for k, v in fields.items()},
+                             int(times[pi]))
+                    for si, vals in enumerate(zip(*tag_cols))
+                    for pi in range(len(times))]
+            for hook in self.write_hooks:
+                try:
+                    hook(db_name, rows)
+                except Exception:
+                    log.exception("write hook failed")
+        return n
+
     # ---- reads -----------------------------------------------------------
 
     def measurements(self, db_name: str) -> list[str]:

@@ -258,7 +258,7 @@ class Shard:
                 # sid allocations must be durable before rows referencing
                 # them: otherwise crash replay could reassign those sids to
                 # different tag sets and merge unrelated series
-                self.index.flush()
+                self.index.flush(snapshot=False)
             # lock spans wal.write + mem.write so a concurrent flush cannot
             # seal the WAL segment between them (which would let commit
             # delete the only durable copy of these rows)
@@ -338,7 +338,7 @@ class Shard:
         before = self.index.series_cardinality
         sids = self.index.get_or_create_sids(mst, tags_list)
         if self.index.series_cardinality != before:
-            self.index.flush()
+            self.index.flush(snapshot=False)
         counts = np.fromiter((len(t) for t in times_list), np.int64,
                              len(times_list))
         offsets = np.zeros(len(counts) + 1, dtype=np.int64)
@@ -378,6 +378,63 @@ class Shard:
             self.flush()
         return n
 
+    def write_series_matrix(self, mst: str, keys: list, tag_cols: list,
+                            times, fields: dict) -> int:
+        """Aligned-series MATRIX write: S series sharing one tag-key
+        set and one (P,) timestamp vector, each field an (S, P) value
+        matrix — the scrape/remote-write shape. Per-series Python is
+        zero: the index takes the tag COLUMNS (get_or_create_sids_cols),
+        and the row stream for WAL + memtable is np.tile/ravel of the
+        matrices. Durability order matches write_columns_bulk: index
+        fsync → WAL frame → memtable."""
+        import numpy as np
+        S = len(tag_cols[0]) if tag_cols else 0
+        times = np.ascontiguousarray(times, dtype=np.int64)
+        P = len(times)
+        if S == 0 or P == 0:
+            return 0
+        names = sorted(fields)
+        self._check_cs_collision(mst, dict.fromkeys(keys, ""),
+                                 fields)
+        before = self.index.series_cardinality
+        sids = self.index.get_or_create_sids_cols(mst, keys, tag_cols)
+        if self.index.series_cardinality != before:
+            self.index.flush(snapshot=False)
+        offsets = np.arange(S + 1, dtype=np.int64) * P
+        times_cat = np.tile(times, S)
+        fields_cat = {}
+        probe = {}
+        for k in names:
+            m = np.asarray(fields[k])
+            if m.shape != (S, P):
+                raise ValueError(
+                    f"field {k}: want shape ({S}, {P}), got {m.shape}")
+            if np.issubdtype(m.dtype, np.integer):
+                m = m.astype(np.int64, copy=False)
+            elif np.issubdtype(m.dtype, np.floating):
+                m = m.astype(np.float64, copy=False)
+            elif m.dtype != np.bool_:
+                raise ErrTypeConflict(
+                    f"field {k}: matrix writes are numeric/bool only")
+            fields_cat[k] = m.reshape(-1)
+            probe[k] = m.flat[0].item()
+        with self._lock:
+            staged: dict = {}
+            self._check_fields(staged, mst, probe)
+            self._commit_fields(staged)
+            sch = self._schemas.get(mst, {})
+            for k in names:
+                if sch.get(k) == DataType.FLOAT \
+                        and fields_cat[k].dtype == np.int64:
+                    fields_cat[k] = fields_cat[k].astype(np.float64)
+            self.wal.write_cols_bulk(mst, sids, offsets, times_cat,
+                                     fields_cat)
+            self.mem.write_columns_bulk(mst, sids, offsets, times_cat,
+                                        fields_cat)
+        if self.mem.approx_bytes >= self.flush_bytes:
+            self.flush()
+        return S * P
+
     def write_columns_batch(self, entries) -> int:
         """Multi-series bulk write: [(mst, tags, times, fields)] land
         with ONE index fsync for all new series and ONE WAL frame for
@@ -403,7 +460,7 @@ class Shard:
         if not prepared:
             return 0
         if created_any:
-            self.index.flush()
+            self.index.flush(snapshot=False)
         n = 0
         with self._lock:
             # two-phase across the WHOLE batch: any type conflict

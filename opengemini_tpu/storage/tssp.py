@@ -37,6 +37,7 @@ import numpy as np
 
 from ..encoding import blocks as enc
 from ..record import ColVal, DataType, Field, Record, Schema
+from .. import native as _native
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
 VERSION = 2                  # v2: PreAgg carries reproducible-sum limbs
@@ -444,6 +445,11 @@ class TSSPWriter:
             E = (np.ceil(e / exactsum.LIMB_BITS)
                  * exactsum.LIMB_BITS).astype(np.int64)
             E[mx <= 0] = 0
+            ns = _native.limb_sums(v, starts, ends, E,
+                                   exactsum.K_LIMBS, exactsum.LIMB_BITS)
+            if ns is not None:
+                stats[k] = (ssum, smin, smax, E, ns[0], ns[1])
+                continue
             limbs = np.zeros((Sr, exactsum.K_LIMBS))
             exact = np.zeros(Sr, dtype=bool)
             for Ev in np.unique(E):
@@ -464,80 +470,84 @@ class TSSPWriter:
                 exact[gi] = spans_reduce(np.logical_and, res == 0.0,
                                          lstarts, lends)
             stats[k] = (ssum, smin, smax, E, limbs, exact)
-        # ---- meta records: fixed-size numpy matrix ----
+        # ---- meta records: one constant template row + a single
+        # record-major native scatter of the variable fields (the
+        # per-field strided form pays ~30 cache-hostile passes over the
+        # whole matrix; fallback below keeps it as exact behavior) ----
         REC_T = 5 + 4 + 29 + 49          # time column block
         REC_F = {k: 5 + len(k.encode()) + 29 + 102 for k in names}
         recsize = 35 + REC_T + sum(REC_F.values())
-        M = np.zeros((Sr, recsize), dtype=u8)
+        tmpl = np.zeros(recsize, dtype=u8)
+        spec: list = []                  # (record offset, (Sr, w) u8)
 
-        def put(sl, arr, dt):
+        def putc(off, b: bytes):
+            tmpl[off:off + len(b)] = np.frombuffer(b, dtype=u8)
+
+        def put(off, arr, dt):
             a = np.asarray(arr).astype(dt)
-            M[:, sl] = a.view(u8).reshape(Sr, a.dtype.itemsize)
+            spec.append((off, a.view(u8).reshape(Sr, -1)))
 
-        put(slice(0, 8), sids, "<u8")
-        put(slice(8, 16), t0, "<i8")
-        put(slice(16, 24), t_last, "<i8")
-        put(slice(24, 32), r_run, "<i8")
-        M[:, 32:34] = np.frombuffer(
-            struct.pack("<H", F + 1), dtype=u8)
-        M[:, 34] = 1                     # regular (const-delta times)
+        put(0, sids, "<u8")
+        put(8, t0, "<i8")
+        put(16, t_last, "<i8")
+        put(24, r_run, "<i8")
+        putc(32, struct.pack("<H", F + 1))
+        putc(34, b"\x01")                # regular (const-delta times)
         p = 35
         # time column meta
-        M[:, p:p + 5] = np.frombuffer(
-            struct.pack("<HBH", 4, int(DataType.TIME), 1), dtype=u8)
-        M[:, p + 5:p + 9] = np.frombuffer(b"time", dtype=u8)
+        putc(p, struct.pack("<HBH", 4, int(DataType.TIME), 1))
+        putc(p + 5, b"time")
         p += 9
-        put(slice(p, p + 8), data_off, "<u8")
-        M[:, p + 8:p + 12] = np.frombuffer(
-            struct.pack("<I", 17), dtype=u8)
-        put(slice(p + 12, p + 16), r_run, "<u4")
-        put(slice(p + 16, p + 24), data_off + 17, "<u8")
-        M[:, p + 24:p + 28] = np.frombuffer(
-            struct.pack("<I", 1), dtype=u8)
-        M[:, p + 28] = 1                 # has preagg
+        put(p, data_off, "<u8")
+        putc(p + 8, struct.pack("<I", 17))
+        put(p + 12, r_run, "<u4")
+        put(p + 16, data_off + 17, "<u8")
+        putc(p + 24, struct.pack("<I", 1))
+        putc(p + 28, b"\x01")            # has preagg
         p += 29
         # time preagg (no limbs)
-        put(slice(p, p + 8), r_run, "<i8")
+        put(p, r_run, "<i8")
         tsum = spans_reduce(np.add, times_cat.astype(np.float64),
                             starts, ends)
-        put(slice(p + 8, p + 16), tsum, "<f8")
-        put(slice(p + 16, p + 24), t0.astype(np.float64), "<f8")
-        put(slice(p + 24, p + 32), t_last.astype(np.float64), "<f8")
-        put(slice(p + 32, p + 40), t0, "<i8")
-        put(slice(p + 40, p + 48), t_last, "<i8")
+        put(p + 8, tsum, "<f8")
+        put(p + 16, t0.astype(np.float64), "<f8")
+        put(p + 24, t_last.astype(np.float64), "<f8")
+        put(p + 32, t0, "<i8")
+        put(p + 40, t_last, "<i8")
         # has_limbs byte stays 0
         p += 49
         fb = 18                          # per-series field data base
         for k in names:
             kb = k.encode()
             ssum, smin, smax, E, limbs, exact = stats[k]
-            hdr = struct.pack("<HBH", len(kb), int(DataType.FLOAT), 1)
-            M[:, p:p + 5] = np.frombuffer(hdr, dtype=u8)
-            M[:, p + 5:p + 5 + len(kb)] = np.frombuffer(kb, dtype=u8)
+            putc(p, struct.pack("<HBH", len(kb), int(DataType.FLOAT), 1))
+            putc(p + 5, kb)
             p += 5 + len(kb)
             vsize = 1 + 8 * r_run
-            put(slice(p, p + 8), data_off + fb, "<u8")
-            put(slice(p + 8, p + 12), vsize, "<u4")
-            put(slice(p + 12, p + 16), r_run, "<u4")
-            put(slice(p + 16, p + 24), data_off + fb + vsize, "<u8")
-            M[:, p + 24:p + 28] = np.frombuffer(
-                struct.pack("<I", 1), dtype=u8)
-            M[:, p + 28] = 1
+            put(p, data_off + fb, "<u8")
+            put(p + 8, vsize, "<u4")
+            put(p + 12, r_run, "<u4")
+            put(p + 16, data_off + fb + vsize, "<u8")
+            putc(p + 24, struct.pack("<I", 1))
+            putc(p + 28, b"\x01")
             p += 29
-            put(slice(p, p + 8), r_run, "<i8")
-            put(slice(p + 8, p + 16), ssum, "<f8")
-            put(slice(p + 16, p + 24), smin, "<f8")
-            put(slice(p + 24, p + 32), smax, "<f8")
-            put(slice(p + 32, p + 40), t0, "<i8")
-            put(slice(p + 40, p + 48), t_last, "<i8")
-            M[:, p + 48] = 1             # has_limbs
-            put(slice(p + 49, p + 53), E, "<i4")
-            M[:, p + 53] = exact.astype(u8)
-            for kk in range(6):
-                put(slice(p + 54 + 8 * kk, p + 62 + 8 * kk),
-                    limbs[:, kk], "<i8")
+            put(p, r_run, "<i8")
+            put(p + 8, ssum, "<f8")
+            put(p + 16, smin, "<f8")
+            put(p + 24, smax, "<f8")
+            put(p + 32, t0, "<i8")
+            put(p + 40, t_last, "<i8")
+            putc(p + 48, b"\x01")        # has_limbs
+            put(p + 49, E, "<i4")
+            put(p + 53, exact, u8)
+            put(p + 54, limbs.astype("<i8"), "<i8")   # (Sr, 6) block
             p += 102
             fb += 2 + 8 * r_run          # varies per series
+        M = np.empty((Sr, recsize), dtype=u8)
+        M[:] = tmpl
+        if not _native.scatter_fields(M, spec):
+            for off, mat in spec:
+                M[:, off:off + mat.shape[1]] = mat
         self._metas.append(("grpb", np.asarray(sids, dtype=np.int64),
                             M.tobytes(), recsize))
         mn, mx = int(t0.min()), int(t_last.max())

@@ -345,3 +345,240 @@ def test_int64_min_timestamp_is_loud(tmp_path):
                          precision="s")
     finally:
         eng.close()
+
+
+# ---------------------------------------------- series-index native core
+
+def test_blake2b8_batch_matches_hashlib():
+    import hashlib
+
+    import numpy as np
+
+    from opengemini_tpu import native
+    keys = [f"m,host=h{i},cpu=cpu{i % 8}".encode() for i in range(500)]
+    keys.append(b"")                       # empty row
+    keys.append(bytes(range(256)) * 2)     # multi-block (>128B)
+    buf = b"".join(keys)
+    offs = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=offs[1:])
+    got = native.blake2b8_batch(buf, offs)
+    want = np.array([int.from_bytes(
+        hashlib.blake2b(k, digest_size=8).digest(), "little")
+        for k in keys], dtype=np.uint64)
+    assert (got == want).all()
+
+
+def test_limb_sums_matches_numpy_decompose():
+    import numpy as np
+
+    from opengemini_tpu import native
+    from opengemini_tpu.ops import exactsum
+    if not native.native_available():
+        assert native.limb_sums(np.zeros(1), np.zeros(1, np.int64),
+                                np.ones(1, np.int64),
+                                np.zeros(1, np.int64), 6, 18) is None
+        return
+    rng = np.random.default_rng(7)
+    v = rng.normal(50, 10, 4000)
+    v[::101] = np.inf
+    v[::113] = -0.0
+    starts = np.arange(40, dtype=np.int64) * 100
+    ends = starts + 100
+    E = np.empty(40, dtype=np.int64)
+    for i in range(40):
+        w = v[starts[i]:ends[i]]
+        mx = np.max(np.abs(np.where(np.isfinite(w), w, 0)))
+        E[i] = exactsum.pick_scale(mx)
+    limbs, exact = native.limb_sums(v, starts, ends, E,
+                                    exactsum.K_LIMBS,
+                                    exactsum.LIMB_BITS)
+    for i in range(40):
+        lb, r = exactsum.decompose(v[starts[i]:ends[i]], int(E[i]))
+        assert np.array_equal(limbs[i], lb.sum(axis=0))
+        assert exact[i] == bool(np.all(r == 0.0))
+
+
+def test_sidmap_probe_and_items():
+    import numpy as np
+
+    from opengemini_tpu import native
+    m = native.SidMap()
+    m.put(5, 100)
+    sids, isnew, nxt = m.probe(
+        np.array([5, 7, 7, 9], dtype=np.uint64), 200)
+    assert sids.tolist() == [100, 200, 200, 201]
+    assert isnew.tolist() == [False, True, False, True]
+    assert nxt == 202 and len(m) == 3 and m.get(9) == 201
+    ks, vs = m.items_arrays()
+    assert dict(zip(ks.tolist(), vs.tolist())) == {5: 100, 7: 200,
+                                                   9: 201}
+    m2 = native.SidMap()
+    m2.put_batch(ks, vs)
+    assert m2.get(7) == 200
+    # growth under load keeps every assignment stable
+    big = np.random.default_rng(0).integers(
+        0, 2 ** 63, 100000).astype(np.uint64)
+    s1, _n1, nx = m2.probe(big, 1000)
+    s2, n2, nx2 = m2.probe(big, nx)
+    assert (s1 == s2).all() and not n2.any() and nx2 == nx
+
+
+def test_build_keys_and_log_pack():
+    import struct
+
+    import numpy as np
+
+    from opengemini_tpu import native
+    if not native.native_available():
+        assert native.build_keys([np.array([b"a"])], [b"m,k="]) is None
+        return
+    cols = [np.array([b"host-1", b"host-22"], dtype="S7"),
+            np.array([b"cpu0", b"cpu1"], dtype="S4")]
+    buf, offs = native.build_keys(cols, [b"m,instance=", b",cpu="])
+    rows = [bytes(buf[offs[i]:offs[i + 1]]) for i in range(2)]
+    assert rows == [b"m,instance=host-1,cpu=cpu0",
+                    b"m,instance=host-22,cpu=cpu1"]
+    stream = native.log_pack(buf, offs,
+                             np.array([3, 4], dtype=np.int64))
+    pos = 0
+    seen = []
+    while pos < len(stream):
+        ln, sid = struct.unpack_from("<IQ", stream, pos)
+        seen.append((sid, stream[pos + 12:pos + 12 + ln]))
+        pos += 12 + ln
+    assert seen == [(3, rows[0]), (4, rows[1])]
+
+
+def test_scatter_fields_matches_strided():
+    import numpy as np
+
+    from opengemini_tpu import native
+    n, recsize = 257, 37
+    rng = np.random.default_rng(1)
+    spec = [(0, rng.integers(0, 255, (n, 8), dtype=np.uint8)),
+            (11, rng.integers(0, 255, (n, 4), dtype=np.uint8)),
+            (36, rng.integers(0, 255, (n, 1), dtype=np.uint8))]
+    M1 = np.zeros((n, recsize), dtype=np.uint8)
+    ok = native.scatter_fields(M1, spec)
+    M2 = np.zeros((n, recsize), dtype=np.uint8)
+    for off, mat in spec:
+        M2[:, off:off + mat.shape[1]] = mat
+    if ok:
+        assert np.array_equal(M1, M2)
+
+
+def test_columnar_index_equivalence():
+    """get_or_create_sids_cols must assign the same sids, interop with
+    the row path, and survive snapshot+replay."""
+    import numpy as np
+
+    from opengemini_tpu.index.tsi import SeriesIndex
+    N = 3000
+    keys = ["instance", "cpu", "mode"]
+    cols = [[f"host-{i >> 3}" for i in range(N)],
+            [f"cpu{i & 7}" for i in range(N)], ["user"] * N]
+    tags_list = [dict(zip(keys, (cols[0][i], cols[1][i], cols[2][i])))
+                 for i in range(N)]
+    ixa = SeriesIndex()
+    sa = ixa.get_or_create_sids("m", tags_list)
+    ixb = SeriesIndex()
+    sb = ixb.get_or_create_sids_cols("m", keys, cols)
+    assert np.array_equal(sa, sb)
+    assert np.array_equal(ixb.get_or_create_sids("m", tags_list), sb)
+    assert np.array_equal(ixa.get_or_create_sids_cols("m", keys, cols),
+                          sa)
+    assert ixb.tags_of(int(sb[5])) == tags_list[5]
+    dup = ixb.get_or_create_sids_cols(
+        "m", keys, [["d", "d"], ["c", "c"], ["x", "x"]])
+    assert dup[0] == dup[1]
+
+
+def test_columnar_index_snapshot_roundtrip(tmp_path):
+    import numpy as np
+
+    from opengemini_tpu.index.tsi import SeriesIndex
+    p = str(tmp_path / "series.log")
+    N = 500
+    keys = ["h", "c"]
+    cols = [[f"h{i}" for i in range(N)], [f"c{i % 5}" for i in range(N)]]
+    ix = SeriesIndex(p)
+    s1 = ix.get_or_create_sids_cols("m", keys, cols)
+    ix._write_snapshot()
+    s_extra = ix.get_or_create_sids_cols("m", keys,
+                                         [["hx"], ["cx"]])  # log tail
+    del ix
+    ix2 = SeriesIndex(p)
+    assert np.array_equal(
+        ix2.get_or_create_sids_cols("m", keys, cols), s1)
+    assert ix2.get_or_create_sids_cols(
+        "m", keys, [["hx"], ["cx"]])[0] == s_extra[0]
+    assert ix2.tags_of(int(s1[3])) == {"h": "h3", "c": "c3"}
+
+
+def test_write_series_matrix_matches_record_batch(tmp_path):
+    import numpy as np
+
+    from opengemini_tpu.query.executor import QueryExecutor
+    from opengemini_tpu.query.influxql import parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    POINTS = 6
+    times = (np.arange(POINTS, dtype=np.int64) * 30 + 30) * 10 ** 9
+    N = 500
+    vals = (np.arange(POINTS, dtype=np.float64)[None, :]
+            + np.arange(N)[:, None])
+    keys = ["cpu", "host"]
+    cols = [np.array([f"c{i % 4}" for i in range(N)]),
+            np.array([f"h{i >> 2}" for i in range(N)])]
+    e1 = Engine(str(tmp_path / "a"),
+                EngineOptions(shard_duration=1 << 62))
+    e1.create_database("d")
+    e1.write_series_matrix("d", "m", keys, cols, times,
+                           {"value": vals})
+    e2 = Engine(str(tmp_path / "b"),
+                EngineOptions(shard_duration=1 << 62))
+    e2.create_database("d")
+    e2.write_record_batch("d", [
+        ("m", {"cpu": f"c{i % 4}", "host": f"h{i >> 2}"}, times,
+         {"value": vals[i]}) for i in range(N)])
+    for e in (e1, e2):
+        for s in e.database("d").all_shards():
+            s.flush()
+    for q in ("SELECT sum(value), count(value), max(value) FROM m",
+              "SELECT mean(value) FROM m GROUP BY cpu",
+              "SELECT first(value), last(value) FROM m GROUP BY host"):
+        (stmt,) = parse_query(q)
+        r1 = QueryExecutor(e1).execute(stmt, "d")
+        r2 = QueryExecutor(e2).execute(stmt, "d")
+        assert r1 == r2, q
+    e1.close()
+    e2.close()
+
+
+def test_prom_matrices_from_write_request():
+    import numpy as np
+
+    from opengemini_tpu.prom import (matrices_from_write_request,
+                                     remote_pb2 as pb)
+    req = pb.WriteRequest()
+    for i in range(80):
+        ts = req.timeseries.add()
+        ts.labels.add(name="__name__", value="met")
+        ts.labels.add(name="host", value=f"h{i}")
+        for j in range(3):
+            ts.samples.add(value=float(i + j), timestamp=1000 + j)
+    # one ragged series (different timestamps) and one NaN marker
+    ts = req.timeseries.add()
+    ts.labels.add(name="__name__", value="met")
+    ts.labels.add(name="host", value="ragged")
+    ts.samples.add(value=1.0, timestamp=999)
+    ts = req.timeseries.add()
+    ts.labels.add(name="__name__", value="met")
+    ts.labels.add(name="host", value="stale")
+    ts.samples.add(value=float("nan"), timestamp=1000)
+    mats, rest = matrices_from_write_request(req, min_group=64)
+    assert len(mats) == 1
+    mst, keys, cols, times, vals = mats[0]
+    assert mst == "met" and keys == ["host"]
+    assert vals.shape == (80, 3)
+    assert times.tolist() == [(1000 + j) * 10 ** 6 for j in (0, 1, 2)]
+    assert len(rest) == 1 and rest[0][1] == {"host": "ragged"}
