@@ -230,3 +230,24 @@ void og_scatter_fields(uint8_t* M, int64_t recsize, int64_t n,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// One-call get-or-insert: returns the existing value, or -1 after
+// inserting val (saves a second FFI round trip on the scalar path).
+int64_t og_map_put_if_absent(void* h, uint64_t key, int64_t val) {
+    OgMap* m = (OgMap*)h;
+    og_map_grow(m, (uint64_t)m->count + 1);
+    uint64_t i = mix(key) & m->mask;
+    while (m->used[i]) {
+        if (m->keys[i] == key) return m->vals[i];
+        i = (i + 1) & m->mask;
+    }
+    m->used[i] = 1;
+    m->keys[i] = key;
+    m->vals[i] = val;
+    m->count++;
+    return -1;
+}
+
+}  // extern "C"

@@ -412,10 +412,8 @@ class SeriesIndex:
 
     def _register_key(self, key: str, sid: int) -> None:
         h = _key_hash(key)
-        cur = self._hash_sid.get(h)
-        if cur is None:
-            self._hash_sid.put(h, sid)
-        elif cur != sid:
+        cur = self._hash_sid.put_if_absent(h, sid)
+        if cur is not None and cur != sid:
             self._collisions[key] = sid
 
     def _lookup_key(self, key: str) -> int | None:
@@ -554,7 +552,32 @@ class SeriesIndex:
 
     def get_or_create_sids(self, measurement: str,
                            tags_list) -> np.ndarray:
-        """Bulk get_or_create_sid: one lock, one capacity grow, one
+        """Bulk get_or_create_sid over tag DICTS: rows group by key
+        set and run through the COLUMNAR path (scrape/TSBS batches
+        have exactly one key set, so this is one
+        get_or_create_sids_cols call; keyless rows keep the
+        row-at-a-time loop). ~4.5us/series vs ~26 for the loop."""
+        nb = len(tags_list)
+        if nb == 0:
+            return np.empty(0, dtype=np.int64)
+        groups: dict[tuple, list] = {}
+        for i, tags in enumerate(tags_list):
+            groups.setdefault(tuple(sorted(tags)), []).append(i)
+        out = np.empty(nb, dtype=np.int64)
+        for keys, idxs in groups.items():
+            if not keys:
+                sids = self._get_or_create_sids_rows(
+                    measurement, [tags_list[i] for i in idxs])
+            else:
+                cols = [[tags_list[i][k] for i in idxs] for k in keys]
+                sids = self.get_or_create_sids_cols(
+                    measurement, list(keys), cols)
+            out[idxs] = sids
+        return out
+
+    def _get_or_create_sids_rows(self, measurement: str,
+                                 tags_list) -> np.ndarray:
+        """Row-at-a-time bulk create: one lock, one capacity grow, one
         log write for the whole batch. The per-call path costs ~47µs
         of Python per series (measured at 1M-series prom ingest);
         this loop shares every lookup structure and defers all
@@ -674,7 +697,7 @@ class SeriesIndex:
         get_or_create_sids, including log format and hash map state."""
         nb = 0 if not cols else len(cols[0])
         if not keys or nb == 0:
-            return self.get_or_create_sids(
+            return self._get_or_create_sids_rows(
                 measurement,
                 [dict(zip(keys, vals)) for vals in zip(*cols)]
                 if nb else [])
@@ -686,7 +709,7 @@ class SeriesIndex:
             mname_b = measurement.encode("ascii")
             keys_b = [k.encode("ascii") for k in keys_s]
         except UnicodeEncodeError:
-            return self.get_or_create_sids(
+            return self._get_or_create_sids_rows(
                 measurement,
                 [dict(zip(keys, vals)) for vals in zip(*cols)])
         with self._lock:

@@ -610,6 +610,10 @@ def _bind_map(lib) -> None:
     lib.og_map_put.restype = None
     lib.og_map_put.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                ctypes.c_int64]
+    lib.og_map_put_if_absent.restype = ctypes.c_int64
+    lib.og_map_put_if_absent.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_uint64,
+                                         ctypes.c_int64]
     lib.og_map_put_batch.restype = None
     lib.og_map_put_batch.argtypes = [ctypes.c_void_p, _u64p, _i64p,
                                      ctypes.c_int64]
@@ -663,6 +667,15 @@ class SidMap:
             self._d[h] = sid
         else:
             _lib.og_map_put(self._h, h, sid)
+
+    def put_if_absent(self, h: int, sid: int):
+        """Insert h->sid if missing (returns None); otherwise return
+        the existing sid untouched — one native call."""
+        if self._d is not None:
+            cur = self._d.setdefault(h, sid)
+            return None if cur == sid else cur
+        v = _lib.og_map_put_if_absent(self._h, h, sid)
+        return None if v == -1 else int(v)
 
     def probe(self, hashes: np.ndarray, next_sid: int):
         """(sids (n,) i64, isnew (n,) bool, advanced next_sid); misses

@@ -87,6 +87,14 @@ class BlockStack:
     bad: object = None               # jax (B, SEG) bool (limb residual)
     block0_dev: object = None        # jax f64 scalar (= block0)
     k0: int = 0                      # first resident limb plane
+    # const-delta time structure (arithmetic-boundary prefix kernel):
+    # every real block of a bulk-written file has affine times
+    # t0 + i*step; all_const gates the searchsorted-free kernel
+    t_rows: np.ndarray = None        # (B,) int64 host real row counts
+    all_const: bool = False
+    t0_dev: object = None            # jax (B,) i64 first time
+    step_dev: object = None          # jax (B,) i64 delta (1 if rows<2)
+    rows_dev: object = None          # jax (B,) i32 real rows
 
     @property
     def n_blocks(self) -> int:
@@ -96,7 +104,8 @@ class BlockStack:
     def nbytes(self) -> int:
         return sum(int(getattr(a, "nbytes", 0)) for a in
                    (self.values, self.valid, self.times, self.limbs,
-                    self.bad))
+                    self.bad, self.t0_dev, self.step_dev,
+                    self.rows_dev))
 
 
 def _file_layout(reader, field: str):
@@ -148,6 +157,9 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
     sids = np.empty(B, dtype=np.int64)
     tmin = np.full(B, I64MAX, dtype=np.int64)
     tmax = np.full(B, I64MIN, dtype=np.int64)
+    steps = np.ones(B, dtype=np.int64)
+    rows_arr = np.zeros(B, dtype=np.int64)
+    all_const = True
     refs: list = []
     n_rows = 0
     for b, (sid, colm, s, tseg) in enumerate(metas):
@@ -160,6 +172,13 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
         if r:
             tmin[b] = tv.values[0]
             tmax[b] = tv.values[r - 1]
+        if r > 1:
+            d = int(tv.values[1]) - int(tv.values[0])
+            if d > 0 and np.all(np.diff(tv.values) == d):
+                steps[b] = d
+            else:
+                all_const = False
+        rows_arr[b] = r
         sids[b] = sid
         refs.append((colm, s))
         n_rows += r
@@ -174,6 +193,15 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
     st.times = jax.device_put(times)
     st.bad = jax.device_put(bad)
     st.block0_dev = jax.device_put(np.float64(block0))
+    st.t_rows = rows_arr
+    st.all_const = all_const
+    # affine time structure for the arithmetic-boundary wide-window
+    # kernel: empty/single-row blocks get step 1 (the clip produces
+    # the right 0/rows boundary either way); t0 of an empty block is
+    # I64MAX so every boundary clips to 0
+    st.t0_dev = jax.device_put(tmin)
+    st.step_dev = jax.device_put(steps)
+    st.rows_dev = jax.device_put(rows_arr.astype(np.int32))
     return st, limbs
 
 
@@ -779,6 +807,86 @@ def _kernel_prefix(num_segments: int, want: tuple, W: int, K: int,
     return _f
 
 
+def _kernel_prefix_arith(num_segments: int, want: tuple, W: int,
+                         K: int, SEG: int, G: int):
+    """Wide-window reduction for CONST-DELTA blocks: no searchsorted,
+    no gather plan. Blocks of a bulk-written file have affine times
+    t0 + i·step, so the boundary position of window j is pure
+    arithmetic: pos = clip(ceil((start + j·interval - t0)/step), 0,
+    rows). Stages:
+      1. per-plane exclusive int32 cumsum along rows (as the search
+         kernel — exact while SEG·(2^18-1) < 2^31);
+      2. (B, W+1) boundary positions — elementwise int64 arithmetic;
+      3. window sums = cumsum diffs at boundaries (two gathers of
+         (B, W) — the only gathers left);
+      4. cell fold: G == 1 sums the block axis outright; small G folds
+         through 12-bit digit-split one-hot matmuls on the MXU
+         (HIGHEST precision; each digit product ≤ 4095, partial sums
+         ≤ B·4095 ≤ 2^24 with B ≤ 4096 — exact in f32, recombined in
+         f64). Replaces the vmapped binary search + (cells, Cmax)
+         gather of _kernel_prefix, measured ~2x the whole kernel's
+         wall on the tunnel-attached v5e.
+    """
+    key = ("kpa", num_segments, want, W, K, SEG, G)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _f(valid, times, limbs, bad, gids, scalars, t0v, stepv, rowsv):
+        t_lo, t_hi = scalars[0], scalars[1]
+        start, interval = scalars[2], scalars[3]
+        B = valid.shape[0]
+        m0 = (valid & (times >= t_lo) & (times <= t_hi)
+              & (gids >= 0)[:, None])
+
+        def ecs(d):
+            c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+            return jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.int32), c], axis=1)
+
+        planes = [ecs(m0.astype(jnp.int32))]
+        if "sum" in want:
+            lz = jnp.where(m0[:, :, None], limbs, 0)
+            for k in range(K):
+                planes.append(ecs(lz[:, :, k]))
+            planes.append(ecs((m0 & bad).astype(jnp.int32)))
+        bounds = start + jnp.arange(W + 1, dtype=jnp.int64) * interval
+        num = bounds[None, :] - t0v[:, None]
+        pos = jnp.clip(
+            (num + stepv[:, None] - 1) // stepv[:, None],
+            0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
+        # flat 1D take: ~9x faster than 2D take_along_axis on the
+        # v5e's gather lowering (measured 37ms vs 340ms per slab)
+        P = len(planes)
+        cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
+        fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
+                + pos).reshape(-1)
+        g = jnp.take(cs, fidx, axis=1).reshape(P, B, W + 1)
+        d = g[:, :, 1:] - g[:, :, :-1]                # (P, B, W) i32
+        if G == 1:
+            return d.astype(jnp.float64).sum(axis=1)
+        oh = (gids[:, None]
+              == jnp.arange(G, dtype=gids.dtype)[None, :]
+              ).astype(jnp.float32)                   # (B, G)
+        hp = jax.lax.Precision.HIGHEST
+        d0 = (d & 0xFFF).astype(jnp.float32)
+        d1 = ((d >> 12) & 0xFFF).astype(jnp.float32)
+        d2 = (d >> 24).astype(jnp.float32)            # signed top
+        g0 = jnp.einsum("bg,pbw->pgw", oh, d0, precision=hp)
+        g1 = jnp.einsum("bg,pbw->pgw", oh, d1, precision=hp)
+        g2 = jnp.einsum("bg,pbw->pgw", oh, d2, precision=hp)
+        cells = (g2.astype(jnp.float64) * 16777216.0
+                 + g1.astype(jnp.float64) * 4096.0
+                 + g0.astype(jnp.float64))
+        return cells.reshape(P, num_segments)
+
+    _JITTED[key] = _f
+    return _f
+
+
 def _round_up(x: int, step: int) -> int:
     return ((x + step - 1) // step) * step
 
@@ -972,16 +1080,29 @@ def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
         g = gids_dev[st.block0:st.block0 + st.n_blocks]
         o = None
         if use_prefix:
-            plan = _prefix_dev_plan(
-                st, np.asarray(gids[st.block0:st.block0 + st.n_blocks],
+            G = num_segments // W
+            # B <= 4096 keeps the digit-split matmul partial sums
+            # under 2^24 (f32-exact); bigger slabs (OG_BLOCK_SLAB
+            # override) take the searchsorted/gather-plan kernel
+            if (st.all_const and st.t0_dev is not None
+                    and st.n_blocks <= 4096
+                    and G * W == num_segments):
+                fn = _kernel_prefix_arith(num_segments, want, W, K,
+                                          st.seg_rows, G)
+                o = fn(st.valid, st.times, st.limbs, st.bad, g,
+                       scalars, st.t0_dev, st.step_dev, st.rows_dev)
+            if o is None:
+                plan = _prefix_dev_plan(
+                    st,
+                    np.asarray(gids[st.block0:st.block0 + st.n_blocks],
                                dtype=np.int64),
-                int(start), int(interval), W, num_segments)
-            if plan is not None:
-                w0_dev, idx_dev, WLmax, Cmax = plan
-                fn = _kernel_prefix(num_segments, want, W, K,
-                                    st.seg_rows, WLmax, Cmax)
-                o = fn(st.values, st.valid, st.times, st.limbs,
-                       st.bad, g, scalars, w0_dev, idx_dev)
+                    int(start), int(interval), W, num_segments)
+                if plan is not None:
+                    w0_dev, idx_dev, WLmax, Cmax = plan
+                    fn = _kernel_prefix(num_segments, want, W, K,
+                                        st.seg_rows, WLmax, Cmax)
+                    o = fn(st.values, st.valid, st.times, st.limbs,
+                           st.bad, g, scalars, w0_dev, idx_dev)
         if o is None:
             fn = _kernel(num_segments, want, W, K, st.seg_rows)
             o = fn(st.values, st.valid, st.times, st.limbs, st.bad, g,

@@ -160,7 +160,9 @@ def matrices_from_write_request(req, min_group: int = 64):
         g[1].append(sam)
     mats = []
     for (name, keys, _tb), (cols, rows, times) in groups.items():
-        if len(rows) >= min_group:
+        # label-less series have no tag columns to key a matrix on —
+        # write_series_matrix would drop them (S == 0); row path
+        if keys and len(rows) >= min_group:
             mats.append((name, list(keys), cols, times * MS,
                          np.vstack(rows)))
         else:

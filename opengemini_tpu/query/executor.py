@@ -1611,8 +1611,14 @@ class QueryExecutor:
         # f32-pair emulated, so a device sumsq diverges from the same
         # engine pinned to CPU — keep those reductions on host for
         # cross-backend bit-identity
+        # grids past the block path's cell ceiling also stay on host:
+        # the device scatter's OUTPUT would cross the slow D2H link
+        # (measured: the 11.5M-cell time(1m),hostname shape took 45s
+        # as a device scatter vs ~25s host — and the CPU-pinned
+        # baseline runs the same host code, so parity is the floor)
         use_host = (n_rows <= HOST_AGG_THRESHOLD
-                    or n_rows < num_segments or spec.sumsq)
+                    or n_rows < num_segments or spec.sumsq
+                    or num_segments > BLOCK_MAX_CELLS)
         from ..utils.stats import bump as _bump_r
         _bump_r(EXEC_STATS, "host_reductions" if use_host
                 else "device_reductions")

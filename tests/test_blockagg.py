@@ -275,3 +275,45 @@ def test_wide_window_prefix_kernel_matches_host(db, monkeypatch):
         assert dev == host
     assert any(k[0] == "kp" for k in BA._JITTED), \
         "prefix kernel never fired"
+
+
+def test_wide_window_arith_kernel_matches_host(db):
+    """Const-delta blocks route W > MASK_W_MAX to the arithmetic-
+    boundary kernel (no searchsorted, no gather plan): G == 1 folds by
+    axis sum, G > 1 through the digit-split one-hot matmul. Both must
+    equal the pure host path bit for bit."""
+    import os
+
+    from opengemini_tpu.ops import blockagg as BA
+    eng, ex = db
+    rng = np.random.default_rng(9)
+    lines = []
+    for h in range(6):
+        # regular 10s cadence, per-series phase offsets (blocks start
+        # mid-window, exercising the boundary clip)
+        off = h * 7 * 10**9
+        for i in range(900):
+            v = float(np.round(rng.normal(50, 12), 2))
+            lines.append(f"cpu,host=h{h} u={v!r} {off + i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    BA._JITTED.clear()
+    for text in (
+        # G == 1: pure axis-sum fold
+        "SELECT mean(u), sum(u), count(u) FROM cpu WHERE time >= 0 "
+        "AND time < 9100s GROUP BY time(70s)",
+        # G > 1: one-hot MXU fold
+        "SELECT sum(u), count(u) FROM cpu WHERE time >= 130s AND "
+        "time < 8700s GROUP BY time(80s), host",
+    ):
+        dev = q(ex, text)
+        assert "error" not in dev, dev
+        os.environ["OG_DEVICE_CACHE_MB"] = "0"
+        try:
+            host = q(ex, text)
+        finally:
+            os.environ["OG_DEVICE_CACHE_MB"] = "256"
+        assert dev == host
+    assert any(k[0] == "kpa" for k in BA._JITTED), \
+        "arithmetic-boundary kernel never fired"
