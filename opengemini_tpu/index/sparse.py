@@ -109,8 +109,17 @@ class SparseIndexBuilder:
             if len(values) == 0:
                 self.entries.append(FragmentEntry(minmax=None))
             elif isinstance(values, np.ndarray):
-                self.entries.append(FragmentEntry(
-                    minmax=(values.min().item(), values.max().item())))
+                if (np.issubdtype(values.dtype, np.floating)
+                        and np.isnan(values).any()):
+                    # NaN is unordered: a (nan, nan) entry means "this
+                    # fragment's content cannot be ranged" — pruning
+                    # passes it, the extrema path decodes it
+                    self.entries.append(FragmentEntry(
+                        minmax=(float("nan"), float("nan"))))
+                else:
+                    self.entries.append(FragmentEntry(
+                        minmax=(values.min().item(),
+                                values.max().item())))
             else:
                 self.entries.append(FragmentEntry(
                     minmax=(min(values), max(values))))
@@ -168,7 +177,11 @@ class SparseIndex:
                     out[i] = False
                 else:
                     lo, hi = e.minmax
-                    out[i] = _cmp_le(lo, value) and _cmp_le(value, hi)
+                    if lo != lo:          # NaN bounds: cannot prune
+                        out[i] = True
+                    else:
+                        out[i] = (_cmp_le(lo, value)
+                                  and _cmp_le(value, hi))
             elif self.kind == KIND_SET:
                 if e.values is not None:
                     out[i] = _as_key(value) in e.values
@@ -188,6 +201,8 @@ class SparseIndex:
                 out[i] = False
                 continue
             fmin, fmax = e.minmax
+            if fmin != fmin:              # NaN bounds: cannot prune
+                continue
             ok = True
             if lo is not None:
                 ok = _cmp_le(lo, fmax) if lo_inc else _cmp_lt(lo, fmax)

@@ -1197,11 +1197,27 @@ class QueryExecutor:
             cs_cond = analyze_condition(stmt.condition, set())
             scan_cols = sorted(set(needed_fields) | set(group_tags)
                                | cs_cond.residual_fields())
+            # extrema metadata fast path: pure min/max windowed
+            # queries answer from per-fragment minmax ranges, decoding
+            # only window-straddling fragments (candidate-row scan,
+            # Shard.scan_columnstore_extrema)
+            extrema_ok = (plan_fast == "preagg+dense+block"
+                          and bool(interval) and not group_tags
+                          and cs_cond.residual is None
+                          and bool(aggs)
+                          and all(a.func in ("min", "max")
+                                  for a in aggs))
             for s in shards:
                 if ctx is not None:
                     ctx.check()
-                rec = s.scan_columnstore(mst, stmt.condition, scan_cols,
-                                         t_lo, t_hi)
+                rec = None
+                if extrema_ok:
+                    rec = s.scan_columnstore_extrema(
+                        mst, sorted({a.field for a in aggs}),
+                        int(offset), int(interval), t_lo, t_hi)
+                if rec is None:
+                    rec = s.scan_columnstore(mst, stmt.condition,
+                                             scan_cols, t_lo, t_hi)
                 if rec is None or rec.num_rows == 0:
                     continue
                 if cs_cond.residual is not None:
@@ -1937,10 +1953,17 @@ class QueryExecutor:
                 pull_sp.start_ns = _now_ns()
             block_fmt = [bo[0] for _f, _r, _s, bo in block_launches]
             block_outs = [bo[1:] for _f, _r, _s, bo in block_launches]
+            tree = (field_results, dense_out, exact_results,
+                    dense_exact, sel_results, block_outs)
+            # drain the dispatch queue BEFORE the transfer: device_get
+            # on in-flight arrays takes the tunnel's slow synchronous
+            # fetch path (measured 6x the post-completion transfer)
+            try:
+                jax.block_until_ready(tree)
+            except Exception:
+                pass
             (field_results, dense_out, exact_results, dense_exact,
-             sel_results, block_outs) = jax.device_get(
-                (field_results, dense_out, exact_results, dense_exact,
-                 sel_results, block_outs))
+             sel_results, block_outs) = jax.device_get(tree)
             if pull_sp is not None:
                 pull_sp.end_ns = _now_ns()
                 pull_sp.add(leaves=len(jax.tree_util.tree_leaves(

@@ -104,6 +104,13 @@ class ColumnStoreWriter:
         index_cols: dict[str, int] = {"time": KIND_MINMAX}
         for pk in self.primary_key:
             index_cols[pk] = KIND_MINMAX
+        # every numeric column carries per-fragment min/max ranges
+        # (16B/fragment): the reference colstore's fragment ranges —
+        # range pruning AND the extrema (min/max) metadata fast path
+        # (column_store_reader.go:42 + sparse-index roles)
+        for fld in rec.schema:
+            if fld.type in (DataType.FLOAT, DataType.INTEGER):
+                index_cols.setdefault(fld.name, KIND_MINMAX)
         for c, kind in self.indexes.items():
             k = _KIND_NAMES.get(kind)
             if k is None:

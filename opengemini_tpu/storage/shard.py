@@ -17,7 +17,8 @@ import threading
 import numpy as np
 
 from ..index import SeriesIndex, TagFilter
-from ..record import ColVal, DataType, Record, Schema, merge_sorted_records
+from ..record import (ColVal, DataType, Field, Record, Schema,
+                      merge_sorted_records)
 from ..utils import failpoint, get_logger
 from ..utils.errors import ErrTypeConflict
 from .colstore import ColumnStoreReader, ColumnStoreWriter
@@ -770,6 +771,124 @@ class Shard:
                                 [cols[i] for i in order]))
         if not parts:
             return None
+        return align_concat(parts)
+
+    def scan_columnstore_extrema(self, mst: str, fields: list[str],
+                                 offset: int, interval: int,
+                                 t_min: int | None,
+                                 t_max: int | None):
+        """Metadata answer for pure min/max windowed colstore queries:
+        every numeric column carries per-fragment minmax ranges
+        (colstore.py writer), so a fragment wholly inside one window
+        and inside the time range contributes two CANDIDATE rows (its
+        mins at one timestamp, its maxes at another) instead of
+        decoding — max of fragment maxes equals max of rows. Boundary
+        fragments decode normally and join the candidates. Returns
+        None when ineligible (unflushed rows, overlapping files,
+        missing indexes — the caller runs the full scan); an empty
+        Record when eligible but nothing is in range. Role of the
+        reference's fragment-range pre-agg consumption in
+        column_store_reader.go:42."""
+        with self._lock:
+            files = list(self._cs_files.get(mst, ()))
+            # unflushed rows may overwrite file rows (last-wins dedup
+            # needs real rows); candidates cannot see overwrites
+            for tbl in self.mem.tables_for_read():
+                mt = tbl.get(mst)
+                if mt is not None and mt.rows:
+                    return None
+        if not files:
+            return Record(Schema([Field("time", DataType.TIME)]), [
+                ColVal(DataType.TIME, np.zeros(0, dtype=np.int64))])
+        from ..index.sparse import KIND_MINMAX
+        spans = []
+        per_file = []
+        for f in files:
+            tidx = f.index("time")
+            if (tidx is None or not tidx.entries
+                    or tidx.kind != KIND_MINMAX):
+                return None
+            fr = np.array([e.minmax if e.minmax else (0, -1)
+                           for e in tidx.entries], dtype=np.int64)
+            vidx = {}
+            for name in fields:
+                ix = f.index(name)
+                if (ix is None or ix.kind != KIND_MINMAX
+                        or len(ix.entries) != len(fr)):
+                    return None
+                vidx[name] = ix
+            live = fr[:, 0] <= fr[:, 1]
+            if live.any():
+                spans.append((int(fr[live, 0].min()),
+                              int(fr[live, 1].max())))
+            per_file.append((f, fr, vidx, live))
+        spans.sort()
+        for a, b in zip(spans, spans[1:]):
+            if b[0] <= a[1]:
+                return None        # overlapping files: dedup required
+        parts: list[Record] = []
+        names = sorted(fields)
+        for f, fr, vidx, live in per_file:
+            lo, hi = fr[:, 0], fr[:, 1]
+            in_range = live.copy()
+            if t_min is not None:
+                in_range &= lo >= t_min
+            if t_max is not None:
+                in_range &= hi <= t_max
+            one_window = ((lo - offset) // interval
+                          == (hi - offset) // interval)
+            # a fragment whose range is unordered (NaN content) or
+            # absent for any requested field must decode — its
+            # candidate rows could not reproduce the decode result
+            rangeable = np.ones(len(lo), dtype=bool)
+            for name in fields:
+                ent = vidx[name].entries
+                for fi in range(len(ent)):
+                    mm = ent[fi].minmax
+                    if mm is not None and mm[0] != mm[0]:
+                        rangeable[fi] = False
+            cand = in_range & one_window & rangeable
+            rest = live & ~cand
+            if t_min is not None:
+                rest &= hi >= t_min
+            if t_max is not None:
+                rest &= lo <= t_max
+            ci = np.nonzero(cand)[0]
+            if len(ci):
+                F = len(ci)
+                times = np.repeat(lo[ci], 2)
+                cols = []
+                for name in names:
+                    ent = vidx[name].entries
+                    vals = np.zeros(2 * F, dtype=np.float64)
+                    ok = np.zeros(2 * F, dtype=np.bool_)
+                    for j, fi in enumerate(ci.tolist()):
+                        mm = ent[fi].minmax
+                        if mm is not None:
+                            vals[2 * j] = mm[0]
+                            vals[2 * j + 1] = mm[1]
+                            ok[2 * j] = ok[2 * j + 1] = True
+                    cols.append(ColVal(DataType.FLOAT, vals, ok))
+                cols.append(ColVal(DataType.TIME, times))
+                parts.append(Record(
+                    Schema([Field(n, DataType.FLOAT) for n in names]
+                           + [Field("time", DataType.TIME)]), cols))
+            if rest.any():
+                rec = f.read(names, rest)
+                if rec.num_rows:
+                    tv = rec.times
+                    m = np.ones(len(tv), dtype=bool)
+                    if t_min is not None:
+                        m &= tv >= t_min
+                    if t_max is not None:
+                        m &= tv <= t_max
+                    if not m.all():
+                        rec = rec.take(np.nonzero(m)[0])
+                    if rec.num_rows:
+                        parts.append(rec)
+        if not parts:
+            return Record(Schema([Field("time", DataType.TIME)]), [
+                ColVal(DataType.TIME, np.zeros(0, dtype=np.int64))])
         return align_concat(parts)
 
     def scan_columnstore(self, mst: str, expr=None,

@@ -385,3 +385,118 @@ def test_colstore_bulk_write_equivalence(tmp_path):
                         {"u": np.ones(1)})
     e1.close()
     e2.close()
+
+
+def test_extrema_metadata_fast_path(tmp_path):
+    """Pure min/max windowed colstore queries answer from per-fragment
+    minmax ranges (candidate rows); results must equal the full-decode
+    path, including window-straddling fragments, partial time ranges,
+    and the unflushed-rows fallback."""
+    import numpy as np
+
+    import opengemini_tpu.storage.shard as sm
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    eng = Engine(str(tmp_path / "cs"),
+                 EngineOptions(shard_duration=1 << 62))
+    eng.create_columnstore("b", "cpu", ["hostname"],
+                           {"hostname": "bloom"})
+    rng = np.random.default_rng(3)
+    times = np.arange(240, dtype=np.int64) * (10 * 10**9)
+    batch = [("cpu", {"hostname": f"h{h}"}, times,
+              {"u": np.round(rng.normal(50, 15, 240), 2),
+               "s": np.round(rng.normal(10, 5, 240), 2)})
+             for h in range(40)]
+    eng.write_record_batch("b", batch)
+    eng.flush_all()
+    ex = QueryExecutor(eng)
+    queries = [
+        "SELECT max(u), min(s) FROM cpu WHERE time >= 0 AND "
+        "time < 2400s GROUP BY time(10m)",
+        "SELECT min(u) FROM cpu WHERE time >= 130s AND "
+        "time < 2000s GROUP BY time(7m)",
+    ]
+    orig = sm.Shard.scan_columnstore_extrema
+    calls = []
+
+    def spy(self, *a, **k):
+        r = orig(self, *a, **k)
+        calls.append(r is not None)
+        return r
+
+    try:
+        for q in queries:
+            (stmt,) = parse_query(q)
+            sm.Shard.scan_columnstore_extrema = spy
+            fast = ex.execute(stmt, "b")
+            sm.Shard.scan_columnstore_extrema = \
+                lambda *a, **k: None
+            slow = ex.execute(stmt, "b")
+            assert fast == slow, q
+    finally:
+        sm.Shard.scan_columnstore_extrema = orig
+    assert any(calls), "extrema path never engaged"
+    # unflushed rows force the full scan (last-wins overwrites)
+    eng.write_record_batch("b", [("cpu", {"hostname": "h0"},
+                                  times[:1], {"u": np.array([999.0])})])
+    (stmt,) = parse_query(queries[0])
+    res = ex.execute(stmt, "b")
+    assert res["series"][0]["values"][0][1] == 999.0
+    eng.close()
+
+
+def test_extrema_index_kind_and_nan_guards(tmp_path):
+    """Review r4: (a) a user-declared bloom index on a numeric field
+    must not feed the extrema path (its entries have no ranges);
+    (b) NaN-containing fragments get unordered (nan, nan) ranges —
+    never pruned by value predicates, always decoded by extrema."""
+    import numpy as np
+
+    import opengemini_tpu.storage.shard as sm
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    eng = Engine(str(tmp_path / "a"),
+                 EngineOptions(shard_duration=1 << 62))
+    eng.create_columnstore("b", "m", ["h"], {"u": "bloom"},
+                           fragment_rows=16)
+    times = np.arange(240, dtype=np.int64) * 10**9
+    eng.write_record_batch("b", [("m", {"h": "a"}, times,
+                                  {"u": np.arange(240,
+                                                  dtype=np.float64)})])
+    eng.flush_all()
+    ex = QueryExecutor(eng)
+    (stmt,) = parse_query("SELECT max(u) FROM m WHERE time >= 0 AND "
+                          "time < 240s GROUP BY time(20m)")
+    fast = ex.execute(stmt, "b")
+    orig = sm.Shard.scan_columnstore_extrema
+    sm.Shard.scan_columnstore_extrema = lambda *a, **k: None
+    try:
+        slow = ex.execute(stmt, "b")
+    finally:
+        sm.Shard.scan_columnstore_extrema = orig
+    assert fast == slow
+    eng.close()
+
+    e2 = Engine(str(tmp_path / "b"),
+                EngineOptions(shard_duration=1 << 62))
+    e2.create_columnstore("b", "m", ["h"], {}, fragment_rows=16)
+    vals = np.arange(32, dtype=np.float64)
+    vals[3] = np.nan
+    e2.write_record_batch("b", [("m", {"h": "a"},
+                                 np.arange(32, dtype=np.int64) * 10**9,
+                                 {"u": vals})])
+    e2.flush_all()
+    ex2 = QueryExecutor(e2)
+    (s2,) = parse_query("SELECT u FROM m WHERE u > 5")
+    r = ex2.execute(s2, "b")
+    assert len(r["series"][0]["values"]) == 26
+    (s3,) = parse_query("SELECT max(u) FROM m WHERE time >= 0 AND "
+                        "time < 32s GROUP BY time(16s)")
+    f3 = ex2.execute(s3, "b")
+    sm.Shard.scan_columnstore_extrema = lambda *a, **k: None
+    try:
+        s3r = ex2.execute(s3, "b")
+    finally:
+        sm.Shard.scan_columnstore_extrema = orig
+    assert f3 == s3r
+    e2.close()
