@@ -245,7 +245,7 @@ def bucket_states_host(values, valid, times, seg_ids, series_ids,
 
     cnt = seg_sum(valid.astype(np.float64)).astype(np.int64)
     vz = np.where(valid, values, 0.0)
-    va = np.where(valid, values - value_anchor, 0.0)
+    va = np.where(valid, vz - value_anchor, 0.0)
     ssum = seg_sum(vz)
     ssumsq = seg_sum(va * va)
     # min/max/first/last need ordered runs: one stable sort by segment
@@ -274,15 +274,19 @@ def bucket_states_host(values, valid, times, seg_ids, series_ids,
     sum_tv = seg_sum(t_rel * va)
     sum_t2 = seg_sum(t_rel * t_rel)
 
-    prev_v = np.roll(values, 1)
+    # mask BEFORE the subtract: invalid lanes can hold non-finite
+    # placeholders, and adjacent Inf lanes make the unmasked
+    # `values - prev_v` compute inf-inf (RuntimeWarning); `same` gates
+    # the RESULT but not the arithmetic, so use the zeroed vz here
+    prev_v = np.roll(vz, 1)
     same = (np.roll(seg_ids, 1) == seg_ids) & valid & np.roll(valid, 1)
     if n:
         same[0] = False
-    step_inc = np.where(values >= prev_v, values - prev_v, values)
+    step_inc = np.where(vz >= prev_v, vz - prev_v, vz)
     inc = seg_sum(np.where(same, step_inc, 0.0))
-    resets = seg_sum((same & (values < prev_v)).astype(
+    resets = seg_sum((same & (vz < prev_v)).astype(
         np.float64)).astype(np.int64)
-    changes = seg_sum((same & (values != prev_v)).astype(
+    changes = seg_sum((same & (vz != prev_v)).astype(
         np.float64)).astype(np.int64)
 
     return BucketState(cnt, first, last, first_t, last_t, ssum, smin,

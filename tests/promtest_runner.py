@@ -13,8 +13,13 @@ the same declarative script format against our PromEngine:
     clear
 
 `a+bxN` expands to N+1 samples a, a+b, …, a+N·b at t = 0, step, …, N·step
-(upstream notation). `_` skips a sample. The fixture scripts are authored
-for THIS suite — not copies of upstream files."""
+(upstream notation). `_` skips a sample. Fixture provenance: suite 1 in
+tests/testdata/promql_suite.test is DERIVED from the upstream Prometheus
+aggregators fixture (the same one the reference ships as
+tests/testdata/aggregators.test) with renamed metrics/labels — a
+compliance corpus intentionally matching upstream semantics. Suites 3-6
+are original (closed-form arithmetic data, hand-derivable
+expectations)."""
 
 from __future__ import annotations
 

@@ -224,3 +224,32 @@ def test_host_and_device_kernel_parity(tmp_path, monkeypatch):
                 vb = [float(v) for _t, v in sb["values"]]
                 np.testing.assert_allclose(va, vb, rtol=1e-12)
     eng.close()
+
+
+def test_invalid_inf_lanes_masked_before_arithmetic():
+    """Review r4 weak #9: invalid rows may carry non-finite placeholder
+    values (±Inf); bucket_states_host must mask them BEFORE the
+    adjacent-pair subtract. Two adjacent invalid +Inf lanes make the
+    unmasked `values - prev_v` compute inf-inf -> RuntimeWarning
+    "invalid value encountered in subtract" (NaN lanes are quiet on
+    numpy >= 1.25, Inf lanes are not). Asserts exact inc/resets/changes
+    so reverting the mask also fails on the warning."""
+    import warnings
+
+    NS = 10**9
+    v = np.array([5.0, 8.0, np.inf, np.inf, 11.0, 2.0, 6.0, 9.0])
+    valid = np.isfinite(v)
+    t = (np.arange(8, dtype=np.int64) * 15 + 15) * NS
+    seg = np.zeros(8, dtype=np.int64)
+    sid = np.zeros(8, dtype=np.int64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        st = P.bucket_states_host(v, valid, t, seg, sid, 1)
+    # valid adjacent pairs: (5,8)+3, (11,2) reset so +2, (2,6)+4,
+    # (6,9)+3; the invalid lanes break the (8,...,11) chain (staleness
+    # splits a series upstream too)
+    assert st.count[0] == 6
+    assert st.sum[0] == pytest.approx(41.0)
+    assert st.inc[0] == pytest.approx(12.0)
+    assert st.resets[0] == 1
+    assert st.changes[0] == 4
