@@ -52,3 +52,94 @@ void og_limb_sums(const double* values, const int64_t* starts,
 }
 
 }  // extern "C"
+
+// Correctly-rounded f64 finalization of exact limb totals. Role:
+// ops/exactsum.finalize_exact — the numpy form makes ~25 full-array
+// passes (carry loop, component packing, TwoSum cascade) over the
+// (n, K) grid; this is one cache-friendly pass. The arithmetic is the
+// SAME IEEE-754 double sequence, so results are bit-identical to the
+// numpy path. Cells the fast path cannot prove correctly rounded
+// (|top| >= 2^17 or a rounded error track) are reported in hazard_idx
+// and recomputed by the caller via exact big-int conversion; their
+// `out` entries are unspecified. K is fixed at 6 (three packed
+// components); callers with other K use the numpy path.
+extern "C"
+void og_finalize_exact(const double* limbs, int64_t n,
+                       int64_t limb_bits, int64_t E, double* out,
+                       int64_t* hazard_idx, int64_t* n_hazard) {
+    const int64_t K = 6;
+    const int64_t B = limb_bits;
+    const double scale_lo = std::ldexp(1.0, (int)(E - B * K));
+    const double s72 = scale_lo * std::ldexp(1.0, 72);
+    const double s36 = scale_lo * std::ldexp(1.0, 36);
+    const double radix = std::ldexp(1.0, (int)B);
+    int64_t nh = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const double* row = limbs + i * K;
+        int64_t d[6];
+        for (int64_t k = 0; k < K; k++) d[k] = (int64_t)row[k];
+        for (int64_t k = K - 1; k > 0; k--) {
+            int64_t c = d[k] >> B;  // arithmetic shift = floor
+            d[k] -= c << B;
+            d[k - 1] += c;
+        }
+        int64_t top = d[0] >> B;
+        int64_t d0 = d[0] - (top << B);
+        // unsigned packing: |top| >= 2^17 rows are redone exactly by
+        // the caller, so int64 wraparound here (UB if signed) is moot
+        uint64_t p0_u = ((uint64_t)top * (uint64_t)(1LL << B)
+                         + (uint64_t)d0) * (uint64_t)(1LL << B)
+                        + (uint64_t)d[1];
+        double p0 = (double)(int64_t)p0_u;
+        double p1 = (double)d[2] * radix + (double)d[3];
+        double p2 = (double)d[4] * radix + (double)d[5];
+        double t0 = p0 * s72, t1 = p1 * s36, t2 = p2 * scale_lo;
+        // Knuth TwoSum cascade (magnitude-order-free)
+        double r1 = t0 + t1;
+        double bv1 = r1 - t0;
+        double e1 = (t0 - (r1 - bv1)) + (t1 - bv1);
+        double r2 = r1 + t2;
+        double bv2 = r2 - r1;
+        double e2 = (r1 - (r2 - bv2)) + (t2 - bv2);
+        double err = e1 + e2;
+        double bv3 = err - e1;
+        double ee = (e1 - (err - bv3)) + (e2 - bv3);
+        out[i] = r2 + err;
+        if (top >= (1LL << 17) || top <= -(1LL << 17) || ee != 0.0)
+            hazard_idx[nh++] = i;
+    }
+    *n_hazard = nh;
+}
+
+// Host inverse of the packed uint32 device transport (ops/blockagg.py
+// _pack_kernel): per cell, reassemble K 18-bit digits from the bit-
+// packed word planes, fold the signed top carry into the high digit,
+// and write the (S, K_full) f64 limb grid (zeros outside [k0, k0+K)).
+// One cache-friendly pass vs ~24 full-plane numpy passes. u32 is the
+// row-major (P, S) plane stack; top_row/words_row index into it.
+extern "C"
+void og_unpack_limbs(const uint32_t* u32, int64_t S, int64_t top_row,
+                     int64_t words_row, int64_t K, int64_t k0,
+                     int64_t K_full, double* out) {
+    const int64_t Wn = (18 * K + 31) / 32;
+    for (int64_t s = 0; s < S; s++) {
+        int64_t top = (int64_t)(int32_t)u32[top_row * S + s];
+        int64_t digits[16] = {0};
+        for (int64_t k = 0; k < K && k < 16; k++) {
+            for (int64_t j = 0; j < Wn; j++) {
+                int64_t sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j);
+                if (sh > -18 && sh < 32) {
+                    uint64_t w = u32[(words_row + j) * S + s];
+                    uint64_t part = sh >= 0 ? (w >> sh)
+                                            : (w << (uint64_t)(-sh));
+                    digits[k] |= (int64_t)(part & 0x3FFFFULL);
+                }
+            }
+        }
+        digits[0] += top << 18;
+        double* row = out + s * K_full;
+        for (int64_t k = 0; k < K_full; k++) row[k] = 0.0;
+        for (int64_t k = 0; k < K && k + k0 < K_full; k++)
+            row[k0 + k] = (double)digits[k];
+    }
+}

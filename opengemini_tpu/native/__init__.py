@@ -122,6 +122,15 @@ def _bind(lib) -> None:
         lib.og_limb_sums.argtypes = [
             _f64p, _i64p, _i64p, _i64p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, _f64p, _u8p]
+        lib.og_finalize_exact.restype = None
+        lib.og_finalize_exact.argtypes = [
+            _f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _f64p, _i64p, _i64p]
+        _u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.og_unpack_limbs.restype = None
+        lib.og_unpack_limbs.argtypes = [
+            _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _f64p]
 
 
 def native_available() -> bool:
@@ -590,6 +599,108 @@ def limb_sums(values: np.ndarray, starts: np.ndarray, ends: np.ndarray,
                      _p(limbs, ctypes.c_double),
                      _p(exact, ctypes.c_uint8))
     return limbs, exact.astype(bool)
+
+
+def unpack_limbs_fast(u32: np.ndarray, top_row: int, words_row: int,
+                      K: int, k0: int, K_full: int):
+    """One-pass reassembly of the packed uint32 transport into the
+    (S, K_full) f64 limb grid (ops/blockagg.unpack_packed digit loop).
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None or K > 16:
+        return None
+    u32 = np.ascontiguousarray(u32, dtype=np.uint32)
+    S = u32.shape[1]
+    out = np.empty((S, K_full), dtype=np.float64)
+    lib.og_unpack_limbs(_p(u32, ctypes.c_uint32), S, top_row,
+                        words_row, K, k0, K_full,
+                        _p(out, ctypes.c_double))
+    return out
+
+
+def finalize_exact_fast(limbs: np.ndarray, limb_bits: int, E: int):
+    """Single-pass correctly-rounded finalization of (n, 6) limb
+    totals: (out (n,) f64, hazard_idx (nh,) int64) — hazard cells need
+    the caller's exact big-int fallback (their out entries are
+    unspecified). None when the native library is unavailable or
+    K != 6 (caller runs the numpy path)."""
+    lib = _load()
+    # the C kernel hardcodes the K=6 / B=18 component layout (72/36
+    # scale split, 2^17 hazard bound); any other geometry must take
+    # the numpy path
+    if lib is None or limbs.shape[-1] != 6 or limb_bits != 18:
+        return None
+    flat = np.ascontiguousarray(limbs.reshape(-1, 6), dtype=np.float64)
+    n = len(flat)
+    out = np.empty(n, dtype=np.float64)
+    hazard = np.empty(n, dtype=np.int64)
+    nh = np.zeros(1, dtype=np.int64)
+    lib.og_finalize_exact(_p(flat, ctypes.c_double), n, limb_bits, E,
+                          _p(out, ctypes.c_double),
+                          _p(hazard, ctypes.c_int64),
+                          _p(nh, ctypes.c_int64))
+    return out, hazard[:int(nh[0])]
+
+
+# ------------------------------------------------------ row materializer
+
+_pyrows = None
+_pyrows_attempted = False
+
+
+def _load_pyrows():
+    """CPython row-builder extension (native/pyrows.cpp); builds with
+    the shared library. None → caller uses the numpy/Python path."""
+    global _pyrows, _pyrows_attempted
+    if _pyrows is not None or _pyrows_attempted:
+        return _pyrows
+    _pyrows_attempted = True
+    if _load() is None:        # triggers the make that also builds it
+        return None
+    path = os.path.abspath(os.path.join(_NATIVE_DIR, "ogpyrows.so"))
+    if not os.path.exists(path):
+        return None
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ogpyrows", path)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        _pyrows = m
+    except Exception:
+        _pyrows = None
+    return _pyrows
+
+
+def build_rows(times: np.ndarray, cols: list, masks: list,
+               G: int, W: int):
+    """C-speed assembly of the flat [t, v0, v1, ...] row list for a
+    dense (G, W) result grid. cols: list of (G*W,) arrays (float64 or
+    int64); masks: parallel list of (G*W,) uint8 arrays or None (0 →
+    cell becomes None). Returns the flat list of G*W rows, or None when
+    the extension is unavailable."""
+    m = _load_pyrows()
+    if m is None or len(cols) > 64:
+        return None
+    t = np.ascontiguousarray(times, dtype=np.int64)
+    prep_c, prep_m, keep = [], [], [t]
+    for c, mk in zip(cols, masks):
+        if c.dtype == np.int64:
+            kind = 1
+        elif c.dtype == np.float64:
+            kind = 0
+        else:
+            return None
+        c = np.ascontiguousarray(c)
+        keep.append(c)
+        prep_c.append((c.ctypes.data, kind))
+        if mk is None:
+            prep_m.append(0)
+        else:
+            mk = np.ascontiguousarray(mk, dtype=np.uint8)
+            keep.append(mk)
+            prep_m.append(mk.ctypes.data)
+    return m.build_rows(t.ctypes.data, tuple(prep_c), tuple(prep_m),
+                        G, W)
 
 
 # ------------------------------------------------------- series sid map

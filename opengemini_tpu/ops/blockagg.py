@@ -653,28 +653,33 @@ def unpack_packed(u32: np.ndarray, bits: np.ndarray, want: tuple,
     Wn = (18 * K + 31) // 32
     S = u32.shape[1]
     out = {}
-    i = 0
-    a = u32.astype(np.int64)
-    out["count"] = a[0]
+    # per-row astypes, not a full-stack copy: the sum section's native
+    # path reads the uint32 planes directly
+    a = u32
+    out["count"] = u32[0].astype(np.int64)
     i = 1
     if "sum" in want:
-        top = a[i]
-        top = np.where(top >= (1 << 31), top - (1 << 32), top)
-        words = a[i + 1:i + 1 + Wn]
+        from .. import native as _native
+        full = _native.unpack_limbs_fast(u32, i, i + 1, K, k0, K_full)
+        if full is None:
+            top = u32[i].astype(np.int64)
+            top = np.where(top >= (1 << 31), top - (1 << 32), top)
+            words = u32[i + 1:i + 1 + Wn].astype(np.int64)
+            digits = np.zeros((K, S), dtype=np.int64)
+            for k in range(K):
+                for j in range(Wn):
+                    # mirror of the pack shifts: digit k's low bit
+                    # sits at word-bit sh of word j (negative sh: its
+                    # upper bits)
+                    sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
+                    if -18 < sh < 32:
+                        w = words[j]
+                        part = (w >> sh) if sh >= 0 else (w << (-sh))
+                        digits[k] |= part & ((1 << 18) - 1)
+            digits[0] += top << 18
+            full = np.zeros((S, K_full))
+            full[:, k0:k0 + K] = digits.T.astype(np.float64)
         i += 1 + Wn
-        digits = np.zeros((K, S), dtype=np.int64)
-        for k in range(K):
-            for j in range(Wn):
-                # mirror of the pack shifts: digit k's low bit sits at
-                # word-bit sh of word j (negative sh: its upper bits)
-                sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
-                if -18 < sh < 32:
-                    w = words[j]
-                    part = (w >> sh) if sh >= 0 else (w << (-sh))
-                    digits[k] |= part & ((1 << 18) - 1)
-        digits[0] += top << 18
-        full = np.zeros((S, K_full))
-        full[:, k0:k0 + K] = digits.T.astype(np.float64)
         out["limbs"] = full
         nb = bits.shape[0]
         lanes = ((bits[:, None].astype(np.uint32)
@@ -684,7 +689,7 @@ def unpack_packed(u32: np.ndarray, bits: np.ndarray, want: tuple,
         out["sumsq"] = np.asarray(f64_extra)[0]
     for name in ("min", "max"):
         if name in want:
-            p = a[i]
+            p = a[i].astype(np.int64)
             i += 1
             out[f"{name}_idx"] = np.where(p == IDX_U32_SENTINEL,
                                           I64MAX, p)
@@ -895,6 +900,9 @@ def _round_up(x: int, step: int) -> int:
 # (B·WLmax entries) and the (cells, Cmax) gather index
 PLAN_MAX_ENTRIES = int(os.environ.get("OG_PREFIX_PLAN_MAX_ENTRIES",
                                       str(64 * 1024 * 1024)))
+# group-count ceiling for the one-hot matmul cell fold (flops scale
+# with G); wider groupings use the searchsorted/gather-plan kernel
+ARITH_G_MAX = int(os.environ.get("OG_ARITH_G_MAX", "256"))
 
 
 def _prefix_spans(st: BlockStack, gids: np.ndarray, start: int,
@@ -938,6 +946,11 @@ def prefix_plan(st: BlockStack, gids: np.ndarray, start: int,
     counts = np.bincount(cell, minlength=num_segments)
     Cmax = _round_up(max(1, int(counts.max()) if counts.size else 1),
                      4)
+    # TRUE Cmax guard (the caller's per-gid bound is loose — a
+    # per-host grid with 5 blocks/host bounds at 8 where the real
+    # overlap is 2): reject only when the actual index over-budgets
+    if num_segments * Cmax > PLAN_MAX_ENTRIES:
+        return None
     idx = np.full((num_segments, Cmax), pad, dtype=np.int64)
     order = np.argsort(cell, kind="stable")
     sc, sf = cell[order], flat[order]
@@ -1032,15 +1045,10 @@ def _prefix_dev_plan(st: BlockStack, gid_slice: np.ndarray,
     if (st.n_blocks * WLmax + 1 >= (1 << 31)     # int32 gather index
             or entries > PLAN_MAX_ENTRIES):      # lattice/host budget
         return reject()
-    # Cmax ≤ max blocks sharing one gid — a cheap upper bound on the
-    # (cells, Cmax) index before it materializes
-    g = np.asarray(gid_slice, dtype=np.int64)
-    live = g[(g >= 0) & (wl > 0)]
-    cmax_bound = int(np.bincount(live).max()) if live.size else 1
-    if num_segments * _round_up(cmax_bound, 4) > PLAN_MAX_ENTRIES:
+    plan = prefix_plan(st, gid_slice, start, interval, W, num_segments)
+    if plan is None:                 # true (cells, Cmax) over budget
         return reject()
-    w0, idx, WLmax, Cmax = prefix_plan(st, gid_slice, start, interval,
-                                       W, num_segments)
+    w0, idx, WLmax, Cmax = plan
     ent = (jax.device_put(w0),
            jax.device_put(idx.astype(np.int32)), WLmax, Cmax)
     if cache is not None:
@@ -1083,9 +1091,14 @@ def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
             G = num_segments // W
             # B <= 4096 keeps the digit-split matmul partial sums
             # under 2^24 (f32-exact); bigger slabs (OG_BLOCK_SLAB
-            # override) take the searchsorted/gather-plan kernel
+            # override) take the searchsorted/gather-plan kernel.
+            # G is capped: the one-hot einsum is P·B·G·W flops —
+            # fine for per-query group counts, catastrophic for
+            # per-host grids (G=16k measured ~12s/slab); wide-G
+            # shapes route to the gather-plan kernel instead
             if (st.all_const and st.t0_dev is not None
                     and st.n_blocks <= 4096
+                    and G <= ARITH_G_MAX
                     and G * W == num_segments):
                 fn = _kernel_prefix_arith(num_segments, want, W, K,
                                           st.seg_rows, G)

@@ -231,11 +231,22 @@ def finalize_exact(limbs: np.ndarray, E: int) -> np.ndarray:
     whose residual error could straddle a rounding boundary (double-
     rounding hazard) fall back to the per-cell big-int path — measured
     ~0 cells on real data, but the guarantee needs the check."""
-    flat = limbs.reshape(-1, K_LIMBS).astype(np.int64)
-    n = len(flat)
     scale_lo = 2.0 ** float(E - SPAN_BITS)
+    n = int(np.prod(limbs.shape[:-1], dtype=np.int64))
     if n == 0:
         return np.zeros(limbs.shape[:-1])
+    # native single-pass path (same IEEE sequence — bit-identical);
+    # hazard cells fall through to the shared big-int loop below
+    from .. import native as _native
+    nf = _native.finalize_exact_fast(limbs, LIMB_BITS, E)
+    if nf is not None:
+        out, sus = nf
+        if len(sus):
+            flat_h = limbs.reshape(-1, K_LIMBS)
+            for i in sus.tolist():
+                out[i] = _bigint_cell(flat_h, i, scale_lo)
+        return out.reshape(limbs.shape[:-1])
+    flat = limbs.reshape(-1, K_LIMBS).astype(np.int64)
     # signed carry-normalization: digits in [0, R), top carry signed
     d = flat.copy()
     for k in range(K_LIMBS - 1, 0, -1):
@@ -278,8 +289,15 @@ def finalize_exact(limbs: np.ndarray, E: int) -> np.ndarray:
     # correctly rounded by construction.
     sus = np.nonzero((np.abs(top) >= (1 << 17)) | (ee != 0.0))[0]
     for i in sus.tolist():
-        total = int(flat[i, 0])
-        for k in range(1, K_LIMBS):
-            total = total * _RADIX + int(flat[i, k])
-        out[i] = float(total) * scale_lo
+        out[i] = _bigint_cell(flat, i, scale_lo)
     return out.reshape(limbs.shape[:-1])
+
+
+def _bigint_cell(flat: np.ndarray, i: int, scale_lo: float) -> float:
+    """Exact big-int evaluation of one cell's limb row — the shared
+    hazard backstop for the native and numpy finalize paths (Python
+    ints are arbitrary precision; float() is correctly rounded)."""
+    total = int(flat[i, 0])
+    for k in range(1, K_LIMBS):
+        total = total * _RADIX + int(flat[i, k])
+    return float(total) * scale_lo

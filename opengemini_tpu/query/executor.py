@@ -84,12 +84,20 @@ HOST_AGG_THRESHOLD = int(
 # block-path dispatch (ops/blockagg.py): result grids above this pull
 # too much over the slow D2H link; files whose rows/cells ratio is
 # below the minimum reduce faster on host. The packed uint32 transport
-# (~20B/cell for mean vs ~88B f64) moved the break-even from 250k to
-# ~1M cells on the measured 10-30MB/s tunnel link
+# (~20B/cell for mean vs ~88B f64) plus the chunked threaded pull
+# (measured ~70MB/s vs 30) moved the break-even: packed grids are
+# worth dispatching up to ~16M cells when TOTAL dispatched rows /
+# cells >= 4 (device cost ~ cells*20B/70MBps vs host ~ rows*80ns),
+# while the legacy f64 transport keeps the old conservative caps
 BLOCK_MAX_CELLS = int(
     __import__("os").environ.get("OG_BLOCK_MAX_CELLS", "1000000"))
+BLOCK_PACKED_MAX_CELLS = int(
+    __import__("os").environ.get("OG_BLOCK_MAX_CELLS_PACKED",
+                                 "16000000"))
 BLOCK_MIN_RATIO = int(
     __import__("os").environ.get("OG_BLOCK_MIN_RATIO", "16"))
+BLOCK_MIN_RATIO_PACKED = int(
+    __import__("os").environ.get("OG_BLOCK_MIN_RATIO_PACKED", "4"))
 
 # multi-field device queries stack their inputs and upload ONCE per
 # kind (per-transfer latency dominates on remote-attached chips); the
@@ -213,6 +221,22 @@ class QueryExecutor:
         {"error": ...}. ctx: QueryContext kill handle; span: tracing Span
         (EXPLAIN ANALYZE); inc_query_id/iter_id: incremental-aggregation
         cache key (see incremental.py)."""
+        # cyclic GC paused for the query: large results allocate
+        # millions of row containers and generational collections
+        # re-scan them mid-query (measured: 4.7s of a 13.9s 11.5M-cell
+        # query was GC). Queries create no reference cycles. Depth-
+        # counted so concurrent/nested queries can't re-enable GC
+        # under each other
+        _gc_pause()
+        try:
+            return self._execute_inner(stmt, db, ctx, span,
+                                       inc_query_id, iter_id)
+        finally:
+            _gc_resume()
+
+    def _execute_inner(self, stmt, db: str | None = None, ctx=None,
+                       span=None, inc_query_id: str | None = None,
+                       iter_id: int = 0) -> dict:
         try:
             if isinstance(stmt, SelectStatement):
                 # regex GROUP BY dims on a subquery statement are left
@@ -1339,10 +1363,16 @@ class QueryExecutor:
                                and cond.residual is None
                                and not raw_fields
                                and spec_names <= PREAGG_STATES)
-            # the 1M-cell ceiling assumes the packed uint32 transport;
-            # legacy f64 planes are ~4x the bytes, so keep the old cap
-            cells_cap = (BLOCK_MAX_CELLS if _ba_cap.PACK
-                         else min(BLOCK_MAX_CELLS, 250000))
+            # the multi-M-cell ceiling assumes the packed uint32
+            # transport AND value-free states (sum/count merge across
+            # files into one device grid); min/max ship value+idx
+            # planes with per-file pulls — they keep the legacy cap.
+            # Legacy f64 planes are ~4x the bytes: old conservative cap
+            has_extrema = bool({"min", "max"} & spec_names)
+            cells_cap = (BLOCK_PACKED_MAX_CELLS
+                         if _ba_cap.PACK and not has_extrema
+                         else min(BLOCK_MAX_CELLS, 250000)
+                         if not _ba_cap.PACK else BLOCK_MAX_CELLS)
             block_ok = (
                 plan_fast == "preagg+dense+block"
                 and _dc.enabled() and cond.residual is None
@@ -1371,11 +1401,27 @@ class QueryExecutor:
                         ent[3] += src.meta.rows
                 want = tuple(k for k in ("sum", "sumsq", "min", "max")
                              if getattr(spec, k))
+                # big-grid packed regime (> legacy cell cap): the pull
+                # is ONE device-combined grid for all files (value-free
+                # states merge on device), so the economics gate on
+                # TOTAL rows at a lower ratio; the classic per-file
+                # gate is unchanged for small grids (min/max shapes
+                # never enter the big regime — cells_cap check above
+                # keeps them under the legacy cap)
+                big_grid = (G * W > BLOCK_MAX_CELLS and _ba_cap.PACK
+                            and not ({"min", "max"} & set(want)))
+                total_file_rows = sum(
+                    ent[3] for ent in per_file.values())
                 cap = _dc.capacity_bytes()
                 jobs: list = []        # (reader, stacks, gid_arr, srcs)
                 for _rid, (reader, sid2gid, srcs, nrows) in \
                         per_file.items():
-                    if nrows < BLOCK_MIN_RATIO * (G * W + 1):
+                    if big_grid:
+                        if (total_file_rows
+                                < BLOCK_MIN_RATIO_PACKED * (G * W + 1)
+                                or nrows < (G * W) // 8):
+                            continue
+                    elif nrows < BLOCK_MIN_RATIO * (G * W + 1):
                         continue       # host paths win on tiny files
                     if nrows * 48 * len(needed_fields) > 0.8 * cap:
                         # the stack would thrash the HBM budget —
@@ -1963,7 +2009,7 @@ class QueryExecutor:
             except Exception:
                 pass
             (field_results, dense_out, exact_results, dense_exact,
-             sel_results, block_outs) = jax.device_get(tree)
+             sel_results, block_outs) = _device_get_parallel(tree)
             if pull_sp is not None:
                 pull_sp.end_ns = _now_ns()
                 pull_sp.add(leaves=len(jax.tree_util.tree_leaves(
@@ -2973,6 +3019,93 @@ def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
             exact_results[fname] = (pulled[("e", fname)], er[1])
 
 
+_GC_LOCK = __import__("threading").Lock()
+_GC_DEPTH = 0
+_GC_WAS_ENABLED = False
+
+
+def _gc_pause() -> None:
+    """Depth-counted process-wide GC pause (see execute()): the first
+    pauser records whether GC was on; the last resumer restores it."""
+    import gc
+    global _GC_DEPTH, _GC_WAS_ENABLED
+    with _GC_LOCK:
+        if _GC_DEPTH == 0:
+            _GC_WAS_ENABLED = gc.isenabled()
+            if _GC_WAS_ENABLED:
+                gc.disable()
+        _GC_DEPTH += 1
+
+
+def _gc_resume() -> None:
+    import gc
+    global _GC_DEPTH
+    with _GC_LOCK:
+        _GC_DEPTH -= 1
+        if _GC_DEPTH == 0 and _GC_WAS_ENABLED:
+            gc.enable()
+
+
+def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):
+    """device_get with per-leaf thread parallelism and chunked fetches
+    of large leaves. The tunnel-attached link serializes transfers and
+    pays a full round trip per pull; concurrent streams overlap that
+    latency and lift large-transfer bandwidth ~54 → ~70 MB/s
+    (measured, 4 streams). Non-device leaves pass through untouched.
+    Role of the reference's streaming chunk return
+    (engine/executor/chunk_codec.gen.go) — results cross the wire in
+    bounded pieces rather than one monolithic transfer."""
+    import concurrent.futures as cf
+
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts: list = [None] * len(leaves)
+    jobs: list = []                     # (leaf_idx, chunk_idx, buf)
+    for i, x in enumerate(leaves):
+        if not isinstance(x, jax.Array):
+            parts[i] = x
+            continue
+        nb = x.size * x.dtype.itemsize
+        if x.ndim == 0 or nb <= chunk_bytes:
+            jobs.append((i, None, x))
+            continue
+        ax = int(np.argmax(x.shape))
+        n = x.shape[ax]
+        k = min(-(-nb // chunk_bytes), 8)
+        bounds = [n * j // k for j in range(k + 1)]
+        parts[i] = ["chunks", ax, [None] * k]
+        for j in range(k):
+            jobs.append((i, j, (x, ax, bounds[j], bounds[j + 1])))
+    if jobs:
+        def _fetch(t):
+            # slice lazily IN the worker: an eager device-side copy of
+            # every chunk up front would double peak HBM for the
+            # result set before any D2H happened
+            i, j, b = t
+            if isinstance(b, tuple):
+                x, ax, lo, hi = b
+                idx = [slice(None)] * x.ndim
+                idx[ax] = slice(lo, hi)
+                b = x[tuple(idx)]
+            return (i, j, np.asarray(b))
+
+        if len(jobs) == 1:
+            jobs_out = [_fetch(jobs[0])]
+        else:
+            with cf.ThreadPoolExecutor(min(threads, len(jobs))) as pool:
+                jobs_out = list(pool.map(_fetch, jobs))
+        for i, j, arr in jobs_out:
+            if j is None:
+                parts[i] = arr
+            else:
+                parts[i][2][j] = arr
+    out = [np.concatenate(p[2], axis=p[1])
+           if isinstance(p, list) and p and p[0] == "chunks" else p
+           for p in parts]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
                       ) -> dict:
     """Merge partials and build the influx-style result: evaluate the
@@ -3209,35 +3342,51 @@ def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
     # numpy overhead dominated large results)
     times_all = win_times.tolist()
     ok_grids = []
-    val_lists = []
+    val_grids = []
     for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
         okg = pres & anyc & np.isfinite(grid)
         ok_grids.append(okg)
         if kinds[oi] == "int" and grid.dtype != np.int64:
             with np.errstate(invalid="ignore"):
                 vg = np.where(okg, grid, 0.0).astype(np.int64)
-        elif kinds[oi] == "int":
-            vg = grid
         else:
             vg = grid
-        val_lists.append(vg.tolist())
+        val_grids.append(vg)
     any_rows = anyc.any(axis=1)
     all_ok = [okg.all(axis=1) for okg in ok_grids]
-    # fully-dense fast path (every cell of every group present — the
-    # TSBS dashboard shape): ONE object-array build + ONE C tolist for
-    # the whole result, then per-group list slicing (no per-group numpy)
+    # full-grid fast path (every group has rows, and either every cell
+    # is present — the TSBS dashboard shape — or fill(null) pads the
+    # holes with None): ONE C-level build of all G*W rows, then
+    # per-group list slicing. Native row builder when available
+    # (4s → ~1.3s at 11.5M cells); object-ndarray otherwise.
+    dense_all = all(bool(a.all()) for a in all_ok)
     if (not stmt.order_desc and not stmt.offset and not stmt.limit
-            and bool(any_rows.all())
-            and all(bool(a.all()) for a in all_ok)):
+            and bool(any_rows.all()) and (dense_all or fill_null)):
         G = anyc.shape[0]
-        arr = np.empty((G * W, 1 + n_out), dtype=object)
-        arr[:, 0] = times_all * G
-        for oi in range(n_out):
-            flat = []
-            for gi in range(G):
-                flat.extend(val_lists[oi][gi])
-            arr[:, 1 + oi] = flat
-        rows_all = arr.tolist()
+        rows_all = None
+        from .. import native as _native
+        cols_flat = [np.ascontiguousarray(vg.reshape(-1))
+                     for vg in val_grids]
+        masks = [None if bool(all_ok[oi].all())
+                 else ok_grids[oi].reshape(-1)
+                 for oi in range(n_out)]
+        _gc_pause()            # 23M container allocs; no cycles made
+        try:
+            rows_all = _native.build_rows(win_times, cols_flat, masks,
+                                          G, W)
+            if rows_all is None:
+                arr = np.empty((G * W, 1 + n_out), dtype=object)
+                arr[:, 0] = times_all * G
+                for oi in range(n_out):
+                    flat = cols_flat[oi].tolist()
+                    if masks[oi] is not None:
+                        mk = masks[oi]
+                        flat = [v if ok else None
+                                for v, ok in zip(flat, mk.tolist())]
+                    arr[:, 1 + oi] = flat
+                rows_all = arr.tolist()
+        finally:
+            _gc_resume()
         for gi in order:
             entry = {"name": mst, "columns": cols_hdr,
                      "values": rows_all[gi * W:(gi + 1) * W]}
@@ -3245,6 +3394,7 @@ def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
                 entry["tags"] = dict(zip(group_tags, group_keys[gi]))
             series_out.append(entry)
         return series_out
+    val_lists = [vg.tolist() for vg in val_grids]
     for gi in order:
         # a group with NO data at all never materializes (influx emits
         # groups from the data, not the index — fill only pads windows
