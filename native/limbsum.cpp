@@ -143,3 +143,42 @@ void og_unpack_limbs(const uint32_t* u32, int64_t S, int64_t top_row,
             row[k0 + k] = (double)digits[k];
     }
 }
+
+// Host-side scatter of a pulled window lattice (ops/blockagg.py
+// _kernel_lattice output) into the flat cell grids. Slim transport:
+// counts int8 (B, WL), limbs int32 (K, B, WL), bad uint8 (B, WL) —
+// limbs/bad NULL when K == 0 (count-only queries). A zero count
+// implies every limb/bad entry is zero (the kernel masks all planes
+// with the same m0), so empty entries cost one byte read. Accumulates
+// in place — callers share the grids across slabs.
+extern "C"
+void og_fold_lattice(const int8_t* c8, const int32_t* l32,
+                     const uint8_t* b8, int64_t B, int64_t WL,
+                     const int64_t* gids, const int64_t* w0,
+                     int64_t W, int64_t ns, int64_t k0, int64_t K,
+                     int64_t K_full, double* counts, double* limbs,
+                     uint8_t* bad) {
+    const int64_t plane = B * WL;
+    for (int64_t b = 0; b < B; b++) {
+        int64_t g = gids[b];
+        if (g < 0) continue;
+        int64_t base = g * W + w0[b];
+        int64_t jmax = WL;
+        if (w0[b] + jmax > W) jmax = W - w0[b];
+        const int8_t* crow = c8 + b * WL;
+        for (int64_t j = 0; j < jmax; j++) {
+            int8_t c = crow[j];
+            if (c == 0) continue;
+            int64_t cell = base + j;
+            if (cell >= ns) break;
+            counts[cell] += (double)c;
+            if (K > 0) {
+                double* lrow = limbs + cell * K_full;
+                for (int64_t k = 0; k < K; k++)
+                    lrow[k0 + k] +=
+                        (double)l32[k * plane + b * WL + j];
+                if (b8[b * WL + j]) bad[cell] = 1;
+            }
+        }
+    }
+}

@@ -131,6 +131,13 @@ def _bind(lib) -> None:
         lib.og_unpack_limbs.argtypes = [
             _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _f64p]
+        _i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.og_fold_lattice.restype = None
+        lib.og_fold_lattice.argtypes = [
+            _i8p, _i32p, _u8p, ctypes.c_int64, ctypes.c_int64,
+            _i64p, _i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _f64p, _f64p, _u8p]
 
 
 def native_available() -> bool:
@@ -616,6 +623,32 @@ def unpack_limbs_fast(u32: np.ndarray, top_row: int, words_row: int,
                         words_row, K, k0, K_full,
                         _p(out, ctypes.c_double))
     return out
+
+
+def fold_lattice(c8: np.ndarray, l32, b8, gids: np.ndarray,
+                 w0: np.ndarray, W: int, ns: int, k0: int, K: int,
+                 K_full: int, counts: np.ndarray, limbs, bad) -> bool:
+    """Accumulate one slab's window lattice (c8 (B, WL) int8 counts,
+    l32 (K, B, WL) int32 limb partials, b8 (B, WL) uint8 bad flags)
+    into the flat cell grids in place (ops/blockagg.fold_lattices).
+    K=0 folds the count plane only; limb plane k lands at column k0+k.
+    False → caller runs the numpy fallback."""
+    lib = _load()
+    if lib is None:
+        return False
+    B, WL = c8.shape[0], c8.shape[1]
+    _null_f64 = ctypes.cast(0, ctypes.POINTER(ctypes.c_double))
+    _null_u8 = ctypes.cast(0, ctypes.POINTER(ctypes.c_uint8))
+    _null_i32 = ctypes.cast(0, ctypes.POINTER(ctypes.c_int32))
+    lib.og_fold_lattice(
+        _p(c8, ctypes.c_int8),
+        _p(l32, ctypes.c_int32) if l32 is not None else _null_i32,
+        _p(b8, ctypes.c_uint8) if b8 is not None else _null_u8,
+        B, WL, _p(gids, ctypes.c_int64), _p(w0, ctypes.c_int64),
+        W, ns, k0, K, K_full, _p(counts, ctypes.c_double),
+        _p(limbs, ctypes.c_double) if limbs is not None else _null_f64,
+        _p(bad, ctypes.c_uint8) if bad is not None else _null_u8)
+    return True
 
 
 def finalize_exact_fast(limbs: np.ndarray, limb_bits: int, E: int):

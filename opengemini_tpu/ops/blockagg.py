@@ -904,6 +904,206 @@ PLAN_MAX_ENTRIES = int(os.environ.get("OG_PREFIX_PLAN_MAX_ENTRIES",
 # with G); wider groupings use the searchsorted/gather-plan kernel
 ARITH_G_MAX = int(os.environ.get("OG_ARITH_G_MAX", "256"))
 
+# per-slab byte cap for the pulled window lattice (P·B·WL·4)
+LATTICE_MAX_BYTES = int(os.environ.get("OG_LATTICE_MAX_MB",
+                                       "256")) * (1 << 20)
+
+
+def _kernel_lattice(want: tuple, K: int, SEG: int, WL: int, W: int):
+    """Big-grid reduction WITHOUT any device-side cell fold: emit the
+    compact per-block window lattice d (P, B, WL) int32 and let the
+    HOST scatter it into the (G·W) grid (native/limbsum.cpp
+    og_fold_lattice — memory-speed, no device scatter, no einsum, no
+    per-slab gather plans).
+
+    Stages (const-delta blocks only — bulk-written files):
+      1. per-plane exclusive int32 cumsum along rows (exact while
+         SEG·(2^18-1) < 2^31);
+      2. per-block window boundaries by ARITHMETIC: block b's first
+         window w0 = clip((max(t0_b, start) - start)/interval, 0,
+         W-1); boundary j sits at row ceil((start + min(w0+j, W)·
+         interval - t0_b)/step) — windows past W collapse to zero-
+         width (d = 0);
+      3. window sums = boundary diffs of the cumsums — (P, B, WL)
+         int32, the pulled transport (~P·4 bytes per LIVE window vs
+         ~20B/cell of the packed grid, and lattice entries ≈ cells).
+
+    Rationale vs the gather-plan kernel at multi-M cells: the plan's
+    (cells, Cmax) index is grid-sized PER SLAB (measured 184MB × 10
+    slabs — evicted the stacks and forced 3.3GB re-uploads per query);
+    the lattice needs no plan at all. Reference role: the same
+    aggregate_cursor.go:90 windowing, restructured for the tunnel-
+    attached TPU's transfer economics."""
+    key = ("kl", want, K, SEG, WL, W)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _f(valid, times, limbs, bad, gids, scalars, t0v, stepv, rowsv):
+        t_lo, t_hi = scalars[0], scalars[1]
+        start, interval = scalars[2], scalars[3]
+        B = valid.shape[0]
+        m0 = (valid & (times >= t_lo) & (times <= t_hi)
+              & (gids >= 0)[:, None])
+
+        def ecs(d):
+            c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+            return jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.int32), c], axis=1)
+
+        planes = [ecs(m0.astype(jnp.int32))]
+        if "sum" in want:
+            lz = jnp.where(m0[:, :, None], limbs, 0)
+            for k in range(K):
+                planes.append(ecs(lz[:, :, k]))
+            planes.append(ecs((m0 & bad).astype(jnp.int32)))
+        # same formula as the host fold's w0 (fold_lattices)
+        w0 = jnp.clip((jnp.maximum(t0v, start) - start) // interval,
+                      0, W - 1)
+        wj = jnp.minimum(
+            w0[:, None] + jnp.arange(WL + 1, dtype=jnp.int64)[None, :],
+            W)
+        bounds = start + wj * interval
+        num = bounds - t0v[:, None]
+        pos = jnp.clip(
+            (num + stepv[:, None] - 1) // stepv[:, None],
+            0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
+        P = len(planes)
+        cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
+        fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
+                + pos).reshape(-1)
+        g = jnp.take(cs, fidx, axis=1).reshape(P, B, WL + 1)
+        d = g[:, :, 1:] - g[:, :, :-1]
+        # slim transport: counts fit int8 (<= rows/window, guarded by
+        # lattice_eligible's R bound), bad bits fit bool — 32B/entry
+        # -> 4K+2 bytes (the pull IS the wall on the tunnel link)
+        if "sum" in want:
+            return (d[0].astype(jnp.int8), d[1:1 + K],
+                    (d[1 + K] != 0))
+        return (d[0].astype(jnp.int8),)
+
+    _JITTED[key] = _f
+    return _f
+
+
+def lattice_eligible(slabs: list, gids: np.ndarray, start: int,
+                     interval: int, W: int, want: tuple) -> bool:
+    """Cheap pre-check (no launches): every slab const-delta with a
+    lattice under the byte cap, cumsums int32-exact, per-window row
+    counts under the int8 transport bound, sum-only states."""
+    if interval <= 0 or ({"min", "max", "sumsq"} & set(want)):
+        return False
+    K = slabs[0].limbs.shape[-1]
+    bpe = 1 + (K * 4 + 1 if "sum" in want else 0)
+    for st in slabs:
+        if not (st.all_const and st.t0_dev is not None
+                and st.seg_rows <= (1 << 13)):
+            return False
+        if _lattice_row_bound(st, interval) > 127:
+            return False               # int8 count plane
+        _w0, _wl, WL = _prefix_spans(
+            st, gids[st.block0:st.block0 + st.n_blocks], start,
+            interval, W)
+        if bpe * st.n_blocks * WL > LATTICE_MAX_BYTES:
+            return False
+    return True
+
+
+def _lattice_row_bound(st: BlockStack, interval: int) -> int:
+    """Max rows any single window of this slab can hold (const-delta
+    blocks: ceil(interval/step) + 1). Sizes the int8 count plane."""
+    rows = np.asarray(st.t_rows, dtype=np.int64)
+    live = rows > 1
+    if not live.any():
+        return 1
+    t0 = np.asarray(st.t_min, dtype=np.int64)[live]
+    t1 = np.asarray(st.t_max, dtype=np.int64)[live]
+    step = np.maximum((t1 - t0) // np.maximum(rows[live] - 1, 1), 1)
+    return int((-(-interval // step.min())) + 1)
+
+
+def file_lattice(slabs: list, gids: np.ndarray, t_lo, t_hi,
+                 start: int, interval: int, W: int, want: tuple,
+                 scalars=None, gids_dev=None) -> list:
+    """Launch the lattice kernel per slab; returns [(slab, d_dev, WL)]
+    with d still ON DEVICE (the executor batches the pull). Caller
+    must have passed lattice_eligible first."""
+    import jax
+    K = slabs[0].limbs.shape[-1]
+    if scalars is None:
+        scalars = query_scalars(t_lo, t_hi, start, interval)
+    if gids_dev is None:
+        gids_dev = jax.device_put(np.asarray(gids, dtype=np.int64))
+    outs = []
+    for st in slabs:
+        g = gids_dev[st.block0:st.block0 + st.n_blocks]
+        _w0, _wl, WL = _prefix_spans(
+            st, gids[st.block0:st.block0 + st.n_blocks], start,
+            interval, W)
+        fn = _kernel_lattice(want, K, st.seg_rows, WL, W)
+        d = fn(st.valid, st.times, st.limbs, st.bad, g, scalars,
+               st.t0_dev, st.step_dev, st.rows_dev)
+        outs.append((st, d, WL))
+    return outs
+
+
+def fold_lattices(entries: list, gids_by_entry: list, start: int,
+                  interval: int, W: int, num_segments: int,
+                  want: tuple, K_full: int) -> dict:
+    """HOST fold of pulled lattices into one bo dict (count/limbs/bad
+    grids shared across all slabs of a (field, scale) group). Native
+    single pass when available; vectorized bincount fallback."""
+    from .. import native
+    ns = num_segments
+    counts = np.zeros(ns, dtype=np.float64)
+    with_sum = "sum" in want
+    st0 = entries[0][0]
+    K = st0.limbs.shape[-1]
+    k0 = st0.k0
+    limbs = np.zeros((ns, K_full), dtype=np.float64) if with_sum \
+        else None
+    badg = np.zeros(ns, dtype=np.uint8) if with_sum else None
+    for (st, d, WL), g in zip(entries, gids_by_entry):
+        c8 = np.ascontiguousarray(d[0], dtype=np.int8)
+        l32 = (np.ascontiguousarray(d[1], dtype=np.int32)
+               if with_sum else None)
+        b8 = (np.ascontiguousarray(d[2], dtype=np.uint8)
+              if with_sum else None)
+        g = np.ascontiguousarray(g, dtype=np.int64)
+        # host w0: MUST mirror the kernel's formula
+        t0 = np.asarray(st.t_min, dtype=np.int64)
+        w0 = np.clip((np.maximum(t0, start) - start) // interval,
+                     0, W - 1).astype(np.int64)
+        if native.fold_lattice(c8, l32, b8, g, w0, W, ns, k0,
+                               K if with_sum else 0, K_full, counts,
+                               limbs, badg):
+            continue
+        # numpy fallback: flat bincount per plane over live entries
+        B = len(g)
+        wloc = np.arange(WL, dtype=np.int64)
+        wabs = w0[:, None] + wloc[None, :]
+        live = (g[:, None] >= 0) & (wabs < W)
+        cells = (g[:, None] * W + wabs)[live]
+        counts += np.bincount(
+            cells, weights=c8[live].astype(np.float64),
+            minlength=ns)[:ns]
+        if with_sum:
+            for k in range(K):
+                limbs[:, k0 + k] += np.bincount(
+                    cells, weights=l32[k][live].astype(np.float64),
+                    minlength=ns)[:ns]
+            badg |= (np.bincount(
+                cells, weights=(b8[live] != 0).astype(np.float64),
+                minlength=ns)[:ns] > 0).astype(np.uint8)
+    bo = {"count": counts}
+    if with_sum:
+        bo["limbs"] = limbs
+        bo["bad"] = badg.astype(bool)
+    return bo
+
 
 def _prefix_spans(st: BlockStack, gids: np.ndarray, start: int,
                   interval: int, W: int):

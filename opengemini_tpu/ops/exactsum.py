@@ -96,8 +96,14 @@ def exact_segment_sum_host(values: np.ndarray, valid: np.ndarray,
     s = seg_ids[keep]
     limbs, res = decompose(v, E)
     out = np.zeros((S, K_LIMBS), dtype=np.float64)
-    for k in range(K_LIMBS):
-        out[:, k] = np.bincount(s, weights=limbs[:, k], minlength=S)
+    if len(v) * 8 < S:
+        # sparse residue into a huge grid: scattered adds touch only
+        # the live cells; K bincounts would each alloc+walk S
+        np.add.at(out, s, limbs)
+    else:
+        for k in range(K_LIMBS):
+            out[:, k] = np.bincount(s, weights=limbs[:, k],
+                                    minlength=S)
     bad = res != 0.0
     bad |= ~np.isfinite(res)
     inexact = np.zeros(S, dtype=bool)

@@ -1478,6 +1478,35 @@ class QueryExecutor:
                     merged_by: dict = {}
                     merged_rows: dict = {}
                     for reader, stacks, gids_by_field, srcs in jobs:
+                        if big_grid:
+                            # multi-M-cell grids: compact window
+                            # lattices pulled raw, folded on host in C
+                            # (no device cell scatter, no grid-sized
+                            # plans). Ineligible files (non-const
+                            # blocks) stay on the host paths — their
+                            # sources are NOT consumed
+                            if not all(
+                                    blockagg.lattice_eligible(
+                                        sl, gids_by_field[f],
+                                        int(start), int(interval_eff),
+                                        W, want)
+                                    for f, sl in stacks.items()):
+                                continue
+                            for fname, sl in stacks.items():
+                                gid_arr = gids_by_field[fname]
+                                for st_l, d_l, WL_l in \
+                                        blockagg.file_lattice(
+                                        sl, gid_arr, t_lo, t_hi,
+                                        int(start), int(interval_eff),
+                                        W, want, scalars=scalars,
+                                        gids_dev=blockagg.cached_gids(
+                                            gid_arr)):
+                                    block_launches.append(
+                                        (fname, reader, st_l,
+                                         ("t", d_l, WL_l, gid_arr)))
+                            for _sp, src in srcs:
+                                block_skip.add(id(src))
+                            continue
                         for fname, sl in stacks.items():
                             gid_arr = gids_by_field[fname]
                             out = blockagg.file_aggregate(
@@ -1638,7 +1667,9 @@ class QueryExecutor:
                                 sl.n_rows for _f, _r, s, _o
                                 in block_launches
                                 if not isinstance(s, _BlockMeta)
-                                for sl in s) or block_rows_total)
+                                for sl in (s if isinstance(s, list)
+                                           else [s]))
+                            or block_rows_total)
             if scanres is not None:
                 sst = scanres.stats
                 scan_sp.add(preagg_segments=sst.preagg_segments,
@@ -1790,6 +1821,16 @@ class QueryExecutor:
                                 np.abs(np.where(dm, dv, 0.0))))
                             mx = max(mx, mg)
                 exact_scales[fname] = exactsum.pick_scale(mx)
+                # align to the block stacks' file-wide scale: a higher
+                # block E would otherwise force a full-grid limb
+                # rebase (canonicalize over 11.5M x 6 int64 — measured
+                # ~8s) at merge time; decomposing the sparse residue
+                # at the block scale up front makes the merge pure adds
+                for f2, _r2, s2, _o2 in block_launches:
+                    if f2 == fname:
+                        e_b = s2[0].E if isinstance(s2, list) else s2.E
+                        exact_scales[fname] = max(
+                            exact_scales[fname], e_b)
             # references only — padded copies and limb planes are
             # materialized lazily (pass 2a right before stacking, or
             # pass 2b one field at a time) so peak host memory never
@@ -2038,10 +2079,30 @@ class QueryExecutor:
                 return _bagg.unpack_planes(np.asarray(arrs[0]), _bw,
                                            ka, k0, _KL)
 
-            block_launches = [
-                (f, r, s, _unpack(fmt, arrs, s))
-                for (f, r, s, _), fmt, arrs in
-                zip(block_launches, block_fmt, block_outs)]
+            # lattice launches ("t") fold on host into ONE bo per
+            # (field, scale) group — per-slab bo dicts would cost a
+            # grid-sized limb array each
+            new_launches = []
+            lat_groups: dict = {}
+            for (f, r, s, _), fmt, arrs in zip(
+                    block_launches, block_fmt, block_outs):
+                if fmt == "t":
+                    lat_groups.setdefault(
+                        (f, s.E, s.k0, s.limbs.shape[-1]),
+                        []).append((s, arrs))
+                else:
+                    new_launches.append((f, r, s,
+                                         _unpack(fmt, arrs, s)))
+            for (f, E_l, k0_l, ka_l), ents in lat_groups.items():
+                bo = _bagg.fold_lattices(
+                    [(s2, a[0], a[1]) for s2, a in ents],
+                    [a[2][s2.block0:s2.block0 + s2.n_blocks]
+                     for s2, a in ents],
+                    int(start), int(interval_eff), W, G * W, _bw,
+                    _KL)
+                new_launches.append(
+                    (f, None, _BlockMeta(E_l, k0_l, ka_l), bo))
+            block_launches = new_launches
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
             res = field_results[fname]
@@ -2177,6 +2238,47 @@ class QueryExecutor:
             # values (device f64 is emulation-rounded)
             my_blocks = [(r, s, bo) for f, r, s, bo in block_launches
                          if f == fname]
+            # the f64 fallback sum grid is read ONLY at cells whose
+            # MERGED inexact flag (OR over every source) is set; if no
+            # source flags any cell, the per-bo full-grid finalizes
+            # below are never consumed — skip them. The flag must look
+            # at ALL sources: a residue/dense bad cell still reads
+            # st["sum"], which then needs every block's contribution
+            fb_needed = False
+            if my_blocks and exact_on:
+                er0 = exact_results.get(fname)
+                if er0 is not None and bool(np.asarray(er0[1]).any()):
+                    fb_needed = True
+                if not fb_needed:
+                    for _c2, _S2, (_dl2, dbad2) in \
+                            dense_exact.get(fname, ()):
+                        if bool(np.asarray(dbad2)[:_S2].any()):
+                            fb_needed = True
+                            break
+                pg0 = (scanres.preagg.get(fname)
+                       if scanres is not None and scanres.preagg
+                       else None)
+                if not fb_needed and (pg0 or {}).get("limb_items"):
+                    fb_needed = True
+                if not fb_needed:
+                    for _r2, _s2, bo2 in my_blocks:
+                        if "bad" in bo2 and bool(
+                                np.asarray(bo2["bad"]).any()):
+                            fb_needed = True
+                            break
+                if not fb_needed:
+                    # mixed limb scales can DROP nonzero low limbs at
+                    # rebase time, flagging new inexact cells after
+                    # this check — keep the fallback in that case
+                    es = ({exact_scales[fname]}
+                          if fname in exact_scales else set())
+                    for _r2, s2, _bo2 in my_blocks:
+                        es.add(s2[0].E if isinstance(s2, list)
+                               else s2.E)
+                    if len(es) > 1:
+                        fb_needed = True
+            elif my_blocks:
+                fb_needed = True       # no exact machinery: f64 only
             for reader_b, st_blk, bo in my_blocks:
                 # merged cross-file entries carry the limb scale E in
                 # place of the slab list (no per-file rows remain)
@@ -2185,7 +2287,7 @@ class QueryExecutor:
                 if "count" in st:
                     st["count"] = st["count"] + \
                         np.asarray(bo["count"]).reshape(G, W)
-                if "sum" in st and "limbs" in bo:
+                if "sum" in st and "limbs" in bo and fb_needed:
                     # f64 fallback state for inexact cells: derive from
                     # the limb totals (truncated-but-deterministic where
                     # the exact flag failed; == the exact total where it
@@ -2193,7 +2295,8 @@ class QueryExecutor:
                     # limbs separately below.
                     from ..ops.exactsum import finalize_exact as _fe
                     st["sum"] = st["sum"] + _fe(
-                        np.asarray(bo["limbs"]).astype(np.float64),
+                        np.asarray(bo["limbs"]).astype(np.float64,
+                                                       copy=False),
                         _E_blk).reshape(G, W)
                 if "sumsq" in st and "sumsq" in bo:
                     st["sumsq"] = st["sumsq"] + np.asarray(
@@ -2268,7 +2371,8 @@ class QueryExecutor:
                         ixg[cell] |= i2[0]
                     for e_b, bo in blocks_l:
                         bl, bix = rebase(
-                            np.asarray(bo["limbs"]).astype(np.float64),
+                            np.asarray(bo["limbs"]).astype(np.float64,
+                                                           copy=False),
                             np.asarray(bo["bad"]), e_b, e_final)
                         lg[:G * W] += bl
                         ixg[:G * W] |= bix
@@ -3022,28 +3126,43 @@ def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
 _GC_LOCK = __import__("threading").Lock()
 _GC_DEPTH = 0
 _GC_WAS_ENABLED = False
+_GC_LAST_COLLECT = 0.0
+# under sustained overlapping queries the depth never reaches 0; run
+# an explicit collection at most this often so cyclic garbage (e.g.
+# handled-exception frame cycles) stays bounded
+_GC_MAX_PAUSE_S = float(
+    __import__("os").environ.get("OG_GC_MAX_PAUSE_S", "60"))
 
 
 def _gc_pause() -> None:
     """Depth-counted process-wide GC pause (see execute()): the first
     pauser records whether GC was on; the last resumer restores it."""
     import gc
-    global _GC_DEPTH, _GC_WAS_ENABLED
+    global _GC_DEPTH, _GC_WAS_ENABLED, _GC_LAST_COLLECT
     with _GC_LOCK:
         if _GC_DEPTH == 0:
             _GC_WAS_ENABLED = gc.isenabled()
             if _GC_WAS_ENABLED:
                 gc.disable()
+                _GC_LAST_COLLECT = __import__("time").monotonic()
         _GC_DEPTH += 1
 
 
 def _gc_resume() -> None:
     import gc
-    global _GC_DEPTH
+    import time as _t
+    global _GC_DEPTH, _GC_LAST_COLLECT
+    run_collect = False
     with _GC_LOCK:
         _GC_DEPTH -= 1
         if _GC_DEPTH == 0 and _GC_WAS_ENABLED:
             gc.enable()
+        elif (_GC_DEPTH > 0 and _GC_WAS_ENABLED
+              and _t.monotonic() - _GC_LAST_COLLECT > _GC_MAX_PAUSE_S):
+            _GC_LAST_COLLECT = _t.monotonic()
+            run_collect = True
+    if run_collect:
+        gc.collect()          # works while disabled; bounds cycles
 
 
 def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):

@@ -317,3 +317,29 @@ def test_wide_window_arith_kernel_matches_host(db):
         assert dev == host
     assert any(k[0] == "kpa" for k in BA._JITTED), \
         "arithmetic-boundary kernel never fired"
+
+
+def test_big_grid_lattice_path_matches_host(db, monkeypatch):
+    """The multi-M-cell lattice route (compact per-block window
+    lattices pulled raw + host C fold) must produce exactly the same
+    result as the ordinary paths. Forced by shrinking the legacy cell
+    cap so G*W counts as a big grid."""
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    seed(eng, hosts=6, points=512)
+    text = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE "
+            "time >= 0 AND time < 5120s GROUP BY time(1m), host")
+    base = q(ex, text)                     # normal routing
+    monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+    from opengemini_tpu.ops import devicecache
+    devicecache.global_cache().clear() if hasattr(
+        devicecache.global_cache(), "clear") else None
+    lat = q(ex, text)                      # lattice routing
+    assert lat == base
+    # EXPLAIN shows the block kernels fired on the lattice route
+    import json
+    import re
+    ares = explain(ex, text)
+    m = re.search(r'block_kernels=(\d+)', json.dumps(ares))
+    assert m and int(m.group(1)) >= 1
