@@ -167,7 +167,14 @@ class PromEngine:
         if nsteps > 11000:
             raise PromQLError("exceeded maximum resolution of 11,000 points")
         _pin_at_anchors(expr, start_ns, end_ns)
+        import time as _time
+        _t0 = _time.perf_counter()
         res = self._eval(expr, start_ns, end_ns, step_ns, lookback_ns)
+        # phase record for observability/bench (scan+fold+eval vs the
+        # matrix formatting below)
+        self.last_phases = {"eval_s": round(_time.perf_counter() - _t0,
+                                            4)}
+        _t0 = _time.perf_counter()
         ts = [(start_ns + i * step_ns) / 1e9 for i in range(nsteps)]
         if isinstance(res, float):
             return [{"metric": {},
@@ -185,6 +192,8 @@ class PromEngine:
                     for i in np.nonzero(m)[0].tolist()]
             if vals:
                 out.append({"metric": ls, "values": vals})
+        self.last_phases["format_s"] = round(
+            _time.perf_counter() - _t0, 4)
         return out
 
     # ---------------------------------------------------- metadata api
