@@ -91,6 +91,9 @@ class RaftNode:
         self.leader_id: str | None = None
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
+        # per-peer last successful append-ack time (leader lease: see
+        # leadership_held)
+        self.ack_times: dict[str, float] = {}
         self._apply_results: dict[int, tuple] = {}
         self._apply_events: dict[int, threading.Event] = {}
 
@@ -482,6 +485,7 @@ class RaftNode:
                 self.match_index[pid] = max(self.match_index.get(pid, 0),
                                             top)
                 self.next_index[pid] = self.match_index[pid] + 1
+                self.ack_times[pid] = time.monotonic()
                 self._maybe_commit()
                 return self.next_index[pid] <= self._last_index()
             self.next_index[pid] = resp.get(
@@ -547,6 +551,24 @@ class RaftNode:
         self._rewrite_log_disk()
 
     # -------------------------------------------------------------- API
+
+    def leadership_held(self) -> bool:
+        """Leader-lease check: True when a MAJORITY of peers acked an
+        append within the last ELECTION_MIN·0.8. A peer that acked at
+        time t cannot grant a vote to a challenger before t +
+        ELECTION_MIN (its election timer was just reset), so within
+        this window no other node can have been elected — the local
+        commit_index is safe to serve as a read-index without an RPC
+        round. The 0.8 margin absorbs scheduler latency between the
+        ack's timestamping and this check."""
+        if self.state != LEADER:
+            return False
+        if len(self.peers) == 1:
+            return True
+        now = time.monotonic()
+        fresh = 1 + sum(1 for t in self.ack_times.values()
+                        if now - t < ELECTION_MIN * 0.8)
+        return fresh * 2 > len(self.peers)
 
     def propose(self, cmd: dict, timeout: float = 10.0):
         """Replicate one command; returns fsm_apply's result once

@@ -124,12 +124,14 @@ class HttpServer:
                 engine=engine if local else None,
                 store_database=self.config.stats.store_database)
             from ..utils.stats import (compaction_collector,
+                                       device_collector,
                                        devicecache_collector,
                                        executor_collector, rpc_collector)
             sp.register("runtime", runtime_collector)
             sp.register("readcache", readcache_collector)
             sp.register("executor", executor_collector)
             sp.register("devicecache", devicecache_collector)
+            sp.register("device", device_collector)
             sp.register("compaction", compaction_collector)
             sp.register("rpc", rpc_collector)
             if local:
@@ -573,6 +575,7 @@ class HttpServer:
         """Prometheus text exposition of the internal collectors
         (reference httpd serveMetrics, handler.go /metrics route)."""
         from ..utils.stats import (compaction_collector,
+                                   device_collector,
                                    devicecache_collector,
                                    engine_collector, executor_collector,
                                    readcache_collector, rpc_collector,
@@ -581,6 +584,7 @@ class HttpServer:
                   "readcache": readcache_collector(),
                   "executor": executor_collector(),
                   "devicecache": devicecache_collector(),
+                  "device": device_collector(),
                   "compaction": compaction_collector(),
                   "rpc": rpc_collector(),
                   "httpd": dict(self.stats)}

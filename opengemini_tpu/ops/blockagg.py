@@ -207,8 +207,12 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
 
 def _upload_limbs(st: BlockStack, limbs, k0: int, k1: int) -> None:
     import jax
+
+    from . import devstats
     st.k0 = k0
     st.limbs = jax.device_put(np.ascontiguousarray(limbs[..., k0:k1]))
+    devstats.bump("h2d_bytes", int(st.limbs.nbytes))
+    devstats.bump("h2d_uploads")
 
 
 class _TimeColMeta:
@@ -270,6 +274,9 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
             nb = sum(s.nbytes for s in slabs) + 64
             cache._map[key] = (slabs, nb)
             cache._bytes += nb - 64
+    from . import devstats
+    devstats.bump("slabs_built", len(slabs))
+    devstats.bump("slab_bytes", sum(s.nbytes for s in slabs))
     return slabs
 
 
@@ -1046,6 +1053,8 @@ def file_lattice(slabs: list, gids: np.ndarray, t_lo, t_hi,
         fn = _kernel_lattice(want, K, st.seg_rows, WL, W)
         d = fn(st.valid, st.times, st.limbs, st.bad, g, scalars,
                st.t0_dev, st.step_dev, st.rows_dev)
+        from . import devstats
+        devstats.bump("kernel_launches")
         outs.append((st, d, WL))
     return outs
 
@@ -1199,6 +1208,9 @@ def cached_gids(gid_arr: np.ndarray):
     if got is not None:
         return got
     dev = jax.device_put(gid_arr)
+    from . import devstats
+    devstats.bump("h2d_bytes", int(dev.nbytes))
+    devstats.bump("h2d_uploads")
     cache.put(key, dev)
     return dev
 
@@ -1320,6 +1332,8 @@ def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
             fn = _kernel(num_segments, want, W, K, st.seg_rows)
             o = fn(st.values, st.valid, st.times, st.limbs, st.bad, g,
                    st.block0_dev, scalars)
+        from . import devstats
+        devstats.bump("kernel_launches")
         out = o if out is None else comb(out, o)
     return out
 

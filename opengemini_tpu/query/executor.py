@@ -3103,14 +3103,22 @@ def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
     for ref, v in dev_leaves:
         groups.setdefault((str(v.dtype), tuple(v.shape)),
                           []).append((ref, v))
+    from ..ops import devstats as _ds
+    _t0 = _now_ns()
     pulled: dict[tuple, np.ndarray] = {}
+    n_b = 0
     for kvs in groups.values():
         if len(kvs) == 1:
             pulled[kvs[0][0]] = np.asarray(kvs[0][1])
+            n_b += pulled[kvs[0][0]].nbytes
         else:
             arr = np.asarray(jnp.stack([v for _r, v in kvs]))
+            n_b += arr.nbytes
             for i, (ref, _v) in enumerate(kvs):
                 pulled[ref] = arr[i]
+    _ds.bump("d2h_bytes", n_b)
+    _ds.bump("d2h_pulls", len(groups))
+    _ds.bump("d2h_wait_ns", _now_ns() - _t0)
     for fname, res in list(field_results.items()):
         if not hasattr(res, "_fields"):
             continue
@@ -3178,13 +3186,17 @@ def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):
 
     import jax
 
+    from ..ops import devstats as _ds
+    _t_pull0 = _now_ns()
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     parts: list = [None] * len(leaves)
     jobs: list = []                     # (leaf_idx, chunk_idx, buf)
+    total_b = 0
     for i, x in enumerate(leaves):
         if not isinstance(x, jax.Array):
             parts[i] = x
             continue
+        total_b += x.size * x.dtype.itemsize
         nb = x.size * x.dtype.itemsize
         if x.ndim == 0 or nb <= chunk_bytes:
             jobs.append((i, None, x))
@@ -3222,6 +3234,9 @@ def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):
     out = [np.concatenate(p[2], axis=p[1])
            if isinstance(p, list) and p and p[0] == "chunks" else p
            for p in parts]
+    _ds.bump("d2h_bytes", total_b)
+    _ds.bump("d2h_pulls", len(jobs))
+    _ds.bump("d2h_wait_ns", _now_ns() - _t_pull0)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

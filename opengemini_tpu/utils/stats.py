@@ -203,3 +203,12 @@ def rpc_collector():
     """Cluster transport metrics (reference statistics/spdy.go)."""
     from ..cluster.transport import RPC_STATS
     return dict(RPC_STATS)
+
+
+def device_collector():
+    """Device-plane metrics (ops/devstats): D2H/H2D bytes, pull wait,
+    kernel launches, HBM slab footprint — the numbers that decide query
+    latency on a tunnel-attached TPU (no reference counterpart: PCIe
+    GPUs never made transfer volume the bottleneck)."""
+    from ..ops.devstats import device_collector as _dc
+    return _dc()
