@@ -337,6 +337,17 @@ class RaftNode:
 
     def _on_request_vote(self, body):
         with self._lock:
+            # leader stickiness (raft §6 / etcd CheckQuorum): refuse to
+            # vote while a live leader was heard within ELECTION_MIN.
+            # This is ALSO the premise of the leader lease
+            # (leadership_held): a follower that just acked an append
+            # must not be able to elect a challenger inside the lease
+            # window
+            if (self.state == FOLLOWER and self.leader_id is not None
+                    and body["term"] > self.term
+                    and time.monotonic() - self._last_heard
+                    < ELECTION_MIN):
+                return {"term": self.term, "granted": False}
             if body["term"] > self.term:
                 self._step_down(body["term"])
             granted = False
@@ -468,6 +479,7 @@ class RaftNode:
                         "entries": entries,
                         "leader_commit": self.commit_index}
                 kind = f"{self.msg_prefix}.append"
+        t_sent = time.monotonic()
         resp = self._client(pid).call(kind, body, timeout=5.0)
         with self._lock:
             if self.state != LEADER or self.term != term:
@@ -485,7 +497,10 @@ class RaftNode:
                 self.match_index[pid] = max(self.match_index.get(pid, 0),
                                             top)
                 self.next_index[pid] = self.match_index[pid] + 1
-                self.ack_times[pid] = time.monotonic()
+                # lease anchor = SEND time: the peer's election timer
+                # reset happened no earlier than the request left, so
+                # response latency cannot stretch the lease window
+                self.ack_times[pid] = t_sent
                 self._maybe_commit()
                 return self.next_index[pid] <= self._last_index()
             self.next_index[pid] = resp.get(
@@ -561,14 +576,15 @@ class RaftNode:
         commit_index is safe to serve as a read-index without an RPC
         round. The 0.8 margin absorbs scheduler latency between the
         ack's timestamping and this check."""
-        if self.state != LEADER:
-            return False
-        if len(self.peers) == 1:
-            return True
-        now = time.monotonic()
-        fresh = 1 + sum(1 for t in self.ack_times.values()
-                        if now - t < ELECTION_MIN * 0.8)
-        return fresh * 2 > len(self.peers)
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            if len(self.peers) == 1:
+                return True
+            now = time.monotonic()
+            fresh = 1 + sum(1 for t in self.ack_times.values()
+                            if now - t < ELECTION_MIN * 0.8)
+            return fresh * 2 > len(self.peers)
 
     def propose(self, cmd: dict, timeout: float = 10.0):
         """Replicate one command; returns fsm_apply's result once

@@ -249,6 +249,7 @@ class ReplicationManager:
             except Exception:
                 pass
 
+        rounds = 0
         while _time.monotonic() < deadline:
             missing = [(pid, addr) for pid, addr in others.items()
                        if pid not in commits]
@@ -260,10 +261,19 @@ class ReplicationManager:
                 t.start()
             for t in ts:
                 t.join(max(0.05, deadline - _time.monotonic()))
+            rounds += 1
             with lock:
                 if len(commits) >= n_members:
                     break
-            _time.sleep(0.05)
+                # availability valve: after a full round, a majority
+                # that includes the believed leader is accepted (with
+                # the degraded warning below) instead of stalling every
+                # read for the whole deadline behind one dead member
+                if (rounds >= 1 and len(commits) >= quorum
+                        and r.leader_id is not None
+                        and str(r.leader_id) in commits):
+                    break
+            _time.sleep(0.25)
         with lock:
             target = max(commits.values())
             n_got = len(commits)

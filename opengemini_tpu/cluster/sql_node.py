@@ -219,7 +219,7 @@ class ClusterExecutor:
         # ('partials' vs 'raw') — the reference's NODE_EXCHANGE
         # consumption (select.go:209-212); classify_select still
         # supplies the field/agg details within that choice
-        from ..query.logical import exchange_payload
+        from ..query.logical import exchange_payload, plan_hints
         if cs.mode == "agg" and exchange_payload(stmt) == "partials":
             if inc_query_id:
                 return self._select_agg_incremental(
@@ -232,7 +232,8 @@ class ClusterExecutor:
                 merged = mesh_merge_partials(self.mesh, partials)
                 if merged is not None:
                     partials = [merged]
-            return finalize_partials(stmt, mst, cs, partials)
+            return finalize_partials(stmt, mst, cs, partials,
+                                     plan=plan_hints(stmt))
         if cs.mode == "agg":
             # plan chose a RAW exchange for an aggregate (degradation /
             # rule override): scatter plain scans of the aggregate's

@@ -1276,7 +1276,7 @@ def _prefix_dev_plan(st: BlockStack, gid_slice: np.ndarray,
 def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
                    t_lo, t_hi, start: int, interval: int, W: int,
                    num_segments: int, want: tuple, scalars=None,
-                   gids_dev=None):
+                   gids_dev=None, route: str | None = None):
     """Launch the kernel per slab and combine on device — ONE packed
     plane array per file stays on device (the caller batches the pull
     and unpacks with unpack_planes). Window width picks the kernel:
@@ -1289,8 +1289,12 @@ def file_aggregate(slabs: list[BlockStack], gids: np.ndarray,
         scalars = query_scalars(t_lo, t_hi, start, interval)
     if gids_dev is None:
         gids_dev = jax.device_put(np.asarray(gids, dtype=np.int64))
-    # int32 limb cumsums stay exact while SEG·(2^18-1) < 2^31
-    use_prefix = (W > MASK_W_MAX and interval > 0
+    # int32 limb cumsums stay exact while SEG·(2^18-1) < 2^31.
+    # `route` is the PLAN's windowing-family choice (WindowKernelRule:
+    # "mask" unrolls masked passes, "prefix" takes the scatter-free
+    # cumsum kernels); without a plan the W threshold decides locally
+    wide = (W > MASK_W_MAX) if route is None else (route == "prefix")
+    use_prefix = (wide and interval > 0
                   and not ({"min", "max", "sumsq"} & set(want))
                   and slabs[0].seg_rows <= (1 << 13)
                   and slabs[0].t_min is not None)

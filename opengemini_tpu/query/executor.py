@@ -1106,19 +1106,22 @@ class QueryExecutor:
                     tag_keys, ctx=None, span=None,
                     inc_query_id: str | None = None,
                     iter_id: int = 0) -> dict:
+        from .logical import plan_hints
+        hints = plan_hints(stmt)
         if inc_query_id:
             partial = self._partial_agg_incremental(
                 stmt, db, mst, cs, cond, tag_keys, inc_query_id, iter_id,
                 ctx=ctx, span=span)
         else:
             partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
-                                       ctx=ctx, span=span)
+                                       ctx=ctx, span=span, plan=hints)
         if span is not None:
             with span.child("finalize") as sp:
-                res = finalize_partials(stmt, mst, cs, [partial])
+                res = finalize_partials(stmt, mst, cs, [partial],
+                                        plan=hints)
                 sp.add(series=len(res.get("series", [])))
             return res
-        return finalize_partials(stmt, mst, cs, [partial])
+        return finalize_partials(stmt, mst, cs, [partial], plan=hints)
 
     def _partial_agg_incremental(self, stmt, db, mst, cs, cond, tag_keys,
                                  inc_query_id: str, iter_id: int,
@@ -1161,7 +1164,8 @@ class QueryExecutor:
         return partial
 
     def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
-                    tag_keys, ctx=None, span=None) -> dict | None:
+                    tag_keys, ctx=None, span=None,
+                    plan: dict | None = None) -> dict | None:
         """Store-side partial aggregation: scan this engine's shards and
         reduce on device into per-(group, window) mergeable states.
 
@@ -1178,15 +1182,20 @@ class QueryExecutor:
         from ..ops import AggSpec, segment_aggregate, pad_bucket
         from ..ops.segment_agg import (SegmentAggResult, pad_rows,
                                        segment_aggregate_host)
-        from .logical import agg_fastpath
         from .scan import (PREAGG_STATES, decode_pool, materialize_scan,
                            plan_rowstore_scan)
 
         # the optimized logical plan GATES the store fast paths (the
         # runtime checks below only refine within what the plan
         # allows) — disabling PreAggEligibilityRule observably forces
-        # the decode path (see tests/test_logical_plan.py)
-        plan_fast = agg_fastpath(stmt)
+        # the decode path (see tests/test_logical_plan.py). Store-side
+        # RPC entry builds its own hints (the sql node ships the
+        # statement, not the plan)
+        if plan is None:
+            from .logical import plan_hints
+            plan = plan_hints(stmt)
+        plan_fast = plan["fastpath"]
+        window_route = plan.get("window_route")
         aggs = cs.aggs
         interval = stmt.group_by_interval()
         offset = stmt.group_by_offset()
@@ -1513,7 +1522,8 @@ class QueryExecutor:
                                 sl, gid_arr, t_lo, t_hi, int(start),
                                 int(interval_eff), W, G * W, want,
                                 scalars=scalars,
-                                gids_dev=blockagg.cached_gids(gid_arr))
+                                gids_dev=blockagg.cached_gids(gid_arr),
+                                route=window_route)
                             if can_merge:
                                 key = (fname, sl[0].E, sl[0].k0,
                                        sl[0].limbs.shape[-1])
@@ -3240,12 +3250,28 @@ def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
-                      ) -> dict:
+def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
+                      plan: dict | None = None) -> dict:
     """Merge partials and build the influx-style result: evaluate the
     select-list expressions on the merged state grids, apply fill, run
     window transforms, assemble rows (the sql node's Materialize/Fill/
-    Order/Limit transforms)."""
+    Order/Limit transforms).
+
+    ``plan`` (query.logical.plan_hints) DRIVES which stages run: a
+    pruned Fill node means no hole padding, an absent Limit node means
+    no slicing, and the Materialize node's vector annotation gates the
+    native fast row assembly — the executed path follows the optimized
+    plan, not a re-reading of the statement."""
+    vector_ok = True
+    if plan is not None:
+        from dataclasses import replace as _rp
+        vector_ok = plan.get("vector", True)
+        if not plan.get("fill", True) and stmt.fill_option != "none":
+            stmt = _rp(stmt, fill_option="none")
+        if not plan.get("limit", True) and (
+                stmt.limit or stmt.offset or stmt.slimit
+                or stmt.soffset):
+            stmt = _rp(stmt, limit=0, offset=0, slimit=0, soffset=0)
     merged = merge_partials(partials)
     if merged is None:
         return {}
@@ -3345,7 +3371,8 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None]
     # fill none/null, window times): the reference's Materialize/HttpSender
     # transforms are compiled Go — a per-cell Python loop here would
     # dominate large result grids
-    if (point_times is None and stmt.fill_option in ("none", "null")
+    if (vector_ok and point_times is None
+            and stmt.fill_option in ("none", "null")
             and all(k == "plain" for _n, k, _p in out_specs)):
         kinds = [_output_cast_kind(expr, aggs, field_types)
                  for _name, expr in cs.outputs]

@@ -154,3 +154,167 @@ def test_plan_gates_execution_fastpath(tmp_path, monkeypatch):
     assert explain_counters(text) == 0         # decode path forced
     assert with_rule == without                # same answer either way
     eng.close()
+
+
+def test_all_eight_rules_fire():
+    """The default rule set (>= 8, reference heu_rule.go tier) all fire
+    on representative shapes."""
+    from opengemini_tpu.query.logical import DEFAULT_RULES
+    names = {r.name for r in DEFAULT_RULES}
+    assert len(names) >= 8
+    fired = set()
+    for q, cluster in [
+        ("SELECT mean(v) FROM m WHERE time >= 0 AND time < 2h "
+         "GROUP BY time(1m) fill(none)", True),
+        ("SELECT v FROM m LIMIT 5", True),
+        ("SELECT mean(v) FROM m GROUP BY time(1m)", False),
+    ]:
+        _p, f = _plan(q, cluster=cluster)
+        fired |= set(f)
+    assert names <= fired, names - fired
+
+
+def test_fill_prune_rule_removes_node():
+    from opengemini_tpu.query.logical import LogicalFill
+    p, f = _plan("SELECT mean(v) FROM m GROUP BY time(1m) fill(none)")
+    assert "fill_prune" in f
+    assert not _find(p, LogicalFill)
+    p2, _f2 = _plan("SELECT mean(v) FROM m GROUP BY time(1m) "
+                    "fill(null)")
+    assert _find(p2, LogicalFill)
+
+
+def test_agg_spread_decides_exchange_payload(monkeypatch):
+    """The Exchange payload is a RULE decision: with the rule, partial
+    states scatter; without it the raw degradation ships rows."""
+    import opengemini_tpu.query.logical as L
+    (stmt,) = parse_query("SELECT mean(v) FROM m GROUP BY time(1m)")
+    assert L.exchange_payload(stmt) == "partials"
+    monkeypatch.setattr(L, "DEFAULT_RULES", [
+        r for r in L.DEFAULT_RULES
+        if r.name != "agg_spread_to_exchange"])
+    (stmt2,) = parse_query("SELECT mean(v) FROM m GROUP BY time(1m)")
+    assert L.exchange_payload(stmt2) == "raw"
+
+
+def test_window_kernel_route_by_width():
+    from opengemini_tpu.query.logical import LogicalAggregate
+    p, f = _plan("SELECT mean(v) FROM m WHERE time >= 0 AND "
+                 "time < 30m GROUP BY time(1m)")
+    agg = _find(p, LogicalAggregate)[0]
+    assert agg.notes["window_route"] == "mask"       # 30 windows
+    p2, _ = _plan("SELECT mean(v) FROM m WHERE time >= 0 AND "
+                  "time < 12h GROUP BY time(1m)")
+    agg2 = _find(p2, LogicalAggregate)[0]
+    assert agg2.notes["window_route"] == "prefix"    # 720 windows
+    assert "window_kernel" in f
+
+
+def test_materialize_vector_annotation():
+    from opengemini_tpu.query.logical import LogicalMaterialize
+    p, _ = _plan("SELECT mean(v) FROM m GROUP BY time(1m)")
+    assert _find(p, LogicalMaterialize)[0].notes["vector"] is True
+    p2, _ = _plan("SELECT derivative(mean(v)) FROM m "
+                  "GROUP BY time(1m)")
+    assert _find(p2, LogicalMaterialize)[0].notes["vector"] is False
+
+
+def test_plan_hints_drive_fill_and_limit(tmp_path):
+    """finalize_partials executes the PLAN's stages: lying hints that
+    claim no Fill / no Limit observably change the output — the stage
+    set comes from the plan, not from re-reading the statement."""
+    import numpy as np
+
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.query.executor import finalize_partials
+    from opengemini_tpu.query.functions import classify_select
+    from opengemini_tpu.query.logical import plan_hints
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    eng = Engine(str(tmp_path / "d"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    # a hole at minute 1: fill(null) pads it, fill-less plans don't
+    t = np.array([0, 5, 125, 130], dtype=np.int64) * 10**9
+    eng.write_record("d", "cpu", {"host": "a"}, t,
+                     {"u": np.array([1.0, 2.0, 3.0, 4.0])})
+    for s in eng.database("d").all_shards():
+        s.flush()
+    q = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 180s "
+         "GROUP BY time(1m) fill(null) LIMIT 2")
+    (stmt,) = parse_query(q)
+    cs = classify_select(stmt)
+    from opengemini_tpu.query.condition import analyze_condition
+    cond = analyze_condition(stmt.condition, {"host"})
+    partial = ex.partial_agg(stmt, "d", "cpu", cs, cond, {"host"})
+
+    honest = plan_hints(stmt)
+    assert honest["fill"] and honest["limit"]
+    res = finalize_partials(stmt, "cpu", cs, [partial], plan=honest)
+    rows = res["series"][0]["values"]
+    assert len(rows) == 2 and rows[1][1] is None     # padded + limited
+
+    lying = dict(honest, fill=False, limit=False)
+    res2 = finalize_partials(stmt, "cpu", cs, [partial], plan=lying)
+    rows2 = res2["series"][0]["values"]
+    # no Fill node -> the empty window vanishes; no Limit -> all rows
+    assert [r[1] for r in rows2] == [1.5, 3.5]
+    eng.close()
+
+
+def test_window_route_consumed_by_block_kernels(tmp_path, monkeypatch):
+    """partial_agg threads the plan's window_route into
+    blockagg.file_aggregate: forcing 'prefix' on a narrow-window query
+    invokes the prefix kernels (and the answer is unchanged)."""
+    import numpy as np
+
+    import opengemini_tpu.ops.blockagg as B
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    eng = Engine(str(tmp_path / "d"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    rng = np.random.default_rng(5)
+    t = np.arange(512, dtype=np.int64) * 10**10
+    for h in range(4):
+        eng.write_record("d", "cpu", {"host": f"h{h}"}, t,
+                         {"u": np.round(rng.normal(40, 9, 512), 3)})
+    for s in eng.database("d").all_shards():
+        s.flush()
+    q = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 5120s "
+         "GROUP BY time(10m), host")                  # ~9 windows
+    (stmt,) = parse_query(q)
+    base = ex.execute(stmt, "d")
+
+    calls = {"prefix": 0}
+    orig_arith = B._kernel_prefix_arith
+    orig_search = B._kernel_prefix
+
+    def count_arith(*a, **k):
+        calls["prefix"] += 1
+        return orig_arith(*a, **k)
+
+    def count_search(*a, **k):
+        calls["prefix"] += 1
+        return orig_search(*a, **k)
+
+    monkeypatch.setattr(B, "_kernel_prefix_arith", count_arith)
+    monkeypatch.setattr(B, "_kernel_prefix", count_search)
+    # plan says mask (9 windows) -> prefix kernels untouched
+    (s1,) = parse_query(q)
+    r1 = ex.execute(s1, "d")
+    assert calls["prefix"] == 0
+    # force the prefix family through the PLAN hint
+    from opengemini_tpu.query.logical import plan_hints
+    (s2,) = parse_query(q)
+    h = dict(plan_hints(s2))
+    h["window_route"] = "prefix"
+    s2._plan_hints = h
+    r2 = ex.execute(s2, "d")
+    assert calls["prefix"] >= 1
+    assert r1 == r2 == base
+    eng.close()
