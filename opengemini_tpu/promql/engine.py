@@ -70,6 +70,11 @@ _MAX_FOLD = 128
 # attached chips — raise/lower for directly-attached hardware
 PROM_DEVICE_MIN_ROWS = int(os.environ.get(
     "OG_PROM_DEVICE_MIN_ROWS", "16000000"))
+# rows per device launch in the chunked fold: bounds the kernel's
+# working set (inputs + 15-plane segment grid); an unchunked 60M-row
+# launch crashed the tunnel-attached v5e's worker
+PROM_DEVICE_CHUNK_ROWS = int(os.environ.get(
+    "OG_PROM_DEVICE_CHUNK_ROWS", "16000000"))
 VALUE_FIELD = "value"
 
 
@@ -558,38 +563,54 @@ class PromEngine:
         # measured 15s of XLA compile per distinct S)
         from ..ops.segment_agg import pad_bucket
         S_pad = pad_bucket(S, minimum=64)
-        seg = np.where((bucket >= 0) & (bucket < nb),
-                       series * nb + bucket, S_pad * nb)
         n = len(values)
         n_pad = pad_bucket(n)
-        valid = np.ones(n_pad, dtype=bool)
-        if n_pad != n:
-            valid[n:] = False
-            pad = n_pad - n
-            values = np.pad(values, (0, pad))
-            times = np.pad(times, (0, pad))
-            series = np.pad(series, (0, pad),
-                            constant_values=S_pad - 1)
-            seg = np.pad(seg, (0, pad), constant_values=S_pad * nb)
-        anchor_rows = np.pad(anchor[series[:n]], (0, n_pad - n)) \
-            if n_pad != n else anchor[series]
-        if n_pad < PROM_DEVICE_MIN_ROWS:
-            # host fold: on tunnel-attached chips the device kernel's
-            # 15 pulled state arrays each pay a full transfer round
-            # trip; realistic prom shapes (high cardinality, few rows
-            # per series) fold faster in numpy
-            st = K.bucket_states_host(values, valid, times, seg,
-                                      series, S_pad * nb,
-                                      origin_t=origin,
-                                      value_anchor=anchor_rows)
+        if (n_pad >= PROM_DEVICE_MIN_ROWS
+                and n_pad > PROM_DEVICE_CHUNK_ROWS):
+            # very large folds run in SERIES CHUNKS before any full-
+            # length padding is built: until aggregation every state is
+            # per-series, so chunk states concatenate exactly. One
+            # unchunked 60M-row launch allocated input copies + a
+            # 15-plane segment grid past the tunnel-attached chip's
+            # HBM and CRASHED the TPU worker (observed at 1M series)
+            st = self._bucket_states_chunked(
+                values, times, series, bucket, n, nb, S, origin,
+                anchor)
         else:
-            import jax
-            st = K.bucket_states(values, valid, times, seg, series,
-                                 S_pad * nb, origin_t=origin,
-                                 value_anchor=anchor_rows)
-            st = K.BucketState(*jax.device_get(tuple(st)))  # ONE pull
-        st = K.BucketState(*[np.asarray(x).reshape(S_pad, nb)[:S]
-                             for x in st])
+            seg = np.where((bucket >= 0) & (bucket < nb),
+                           series * nb + bucket, S_pad * nb)
+            valid = np.ones(n_pad, dtype=bool)
+            if n_pad != n:
+                valid[n:] = False
+                pad = n_pad - n
+                values = np.pad(values, (0, pad))
+                times = np.pad(times, (0, pad))
+                series = np.pad(series, (0, pad),
+                                constant_values=S_pad - 1)
+                seg = np.pad(seg, (0, pad),
+                             constant_values=S_pad * nb)
+            anchor_rows = np.pad(anchor[series[:n]], (0, n_pad - n)) \
+                if n_pad != n else anchor[series]
+            if n_pad < PROM_DEVICE_MIN_ROWS:
+                # host fold: on tunnel-attached chips the device
+                # kernel's 15 pulled state arrays each pay a full
+                # transfer round trip; realistic prom shapes (high
+                # cardinality, few rows per series) fold faster in
+                # numpy
+                st = K.bucket_states_host(values, valid, times, seg,
+                                          series, S_pad * nb,
+                                          origin_t=origin,
+                                          value_anchor=anchor_rows)
+            else:
+                import jax
+                st = K.bucket_states(values, valid, times, seg,
+                                     series, S_pad * nb,
+                                     origin_t=origin,
+                                     value_anchor=anchor_rows)
+                st = K.BucketState(
+                    *jax.device_get(tuple(st)))    # ONE pull
+            st = K.BucketState(*[np.asarray(x).reshape(S_pad, nb)[:S]
+                                 for x in st])
         win = K.fold_windows_host(st, int(k))
         # slice eval positions: indices k-1, k-1+stride, ...
         sel = (k - 1) + stride * np.arange(nsteps)
@@ -598,6 +619,68 @@ class PromEngine:
             np.int64)
         return (labels, win, np.broadcast_to(ends, (S, nsteps)), origin,
                 anchor.reshape(S, 1))
+
+    def _bucket_states_chunked(self, values, times, series, bucket,
+                               n: int, nb: int, S: int, origin: int,
+                               anchor) -> "K.BucketState":
+        """Device bucket-state fold in bounded series chunks (rows are
+        series-sorted from _gather): each chunk re-bases series ids to
+        a local range, runs the same jitted kernel on a bounded
+        segment grid, and the per-chunk states concatenate along the
+        series axis — identical to the one-launch result. ``n`` is the
+        TRUE row count (callers may hand padded arrays; pad rows are
+        never sliced — each chunk re-pads itself)."""
+        import jax
+
+        from ..ops.segment_agg import pad_bucket
+        rows_cap = PROM_DEVICE_CHUNK_ROWS
+        # chunk boundaries on series edges (first row of each series);
+        # the sentinel n entry lets the search return S for the final
+        # chunk instead of always splitting the last series off
+        firsts = np.concatenate([
+            np.searchsorted(series[:n], np.arange(S)),
+            np.array([n], dtype=np.int64)])
+        spans: list = []
+        s0 = 0
+        while s0 < S:
+            s1 = int(np.searchsorted(
+                firsts, firsts[s0] + rows_cap, side="right")) - 1
+            s1 = min(max(s1, s0 + 1), S)
+            spans.append((s0, s1, int(firsts[s0]), int(firsts[s1])))
+            s0 = s1
+        # UNIFORM padded shapes across chunks: one jit compile serves
+        # every launch (per-chunk shapes cost ~15s of XLA compile each)
+        sc_pad = pad_bucket(max(s1 - s0 for s0, s1, _r0, _r1 in spans),
+                            minimum=64)
+        nc_pad = pad_bucket(max(r1 - r0 for _s0, _s1, r0, r1 in spans))
+        parts: list = []
+        for s0, s1, r0, r1 in spans:
+            sc, nc = s1 - s0, r1 - r0
+            pad = nc_pad - nc
+            vals_c = np.pad(values[r0:r1], (0, pad))
+            times_c = np.pad(times[r0:r1], (0, pad))
+            ser_c = np.pad(series[r0:r1] - s0, (0, pad),
+                           constant_values=sc_pad - 1)
+            bkt_c = bucket[r0:r1]
+            seg_c = np.pad(
+                np.where((bkt_c >= 0) & (bkt_c < nb),
+                         (series[r0:r1] - s0) * nb + bkt_c,
+                         sc_pad * nb),
+                (0, pad), constant_values=sc_pad * nb)
+            valid_c = np.ones(nc_pad, dtype=bool)
+            if pad:
+                valid_c[nc:] = False
+            anchor_c = np.pad(anchor[s0:s1][ser_c[:nc]], (0, pad))
+            stc = K.bucket_states(vals_c, valid_c, times_c, seg_c,
+                                  ser_c, sc_pad * nb, origin_t=origin,
+                                  value_anchor=anchor_c)
+            stc = K.BucketState(*jax.device_get(tuple(stc)))
+            parts.append(K.BucketState(
+                *[np.asarray(x).reshape(sc_pad, nb)[:sc]
+                  for x in stc]))
+        return K.BucketState(*[np.concatenate(
+            [getattr(p, f) for p in parts], axis=0)
+            for f in K.BucketState._fields])
 
     def _eval_selector_instant(self, vs, start_ns, end_ns, step_ns,
                                lookback_ns) -> SeriesMatrix:

@@ -3960,28 +3960,68 @@ def _group_ids(rec, group_tags: list[str],
     if not group_tags:
         gi = global_groups.setdefault((), 0)
         return np.full(n, gi, dtype=np.int64)
-    per_col_vals = []
+    per_col = []                   # (inverse codes, unique strings)
     codes = None
     for t in group_tags:
         col = rec.column(t)
         if col is None:
-            vals = np.full(n, "", dtype=object)
+            inv, u_str = np.zeros(n, dtype=np.int64), [""]
         elif col.is_string_like():
-            vals = np.array([s if s is not None else ""
-                             for s in col.to_strings()], dtype=object)
+            # vectorized dictionary encode: rows pack into a fixed-
+            # width byte matrix and np.unique runs in C — the per-row
+            # get_string() path decoded 720k python strings per query
+            # (measured 1.5s of a 2.4s colstore scan)
+            inv, u_str = _string_col_codes(col, n)
         else:
-            vals = np.array([str(v) for v in col.values], dtype=object)
-        per_col_vals.append(vals)
-        u, inv = np.unique(vals, return_inverse=True)
-        codes = inv if codes is None else codes * len(u) + inv
+            u, inv = np.unique(col.values, return_inverse=True)
+            u_str = [str(v) for v in u]
+        per_col.append((inv, u_str))
+        codes = inv if codes is None else codes * len(u_str) + inv
     _, first_idx, inv2 = np.unique(codes, return_index=True,
                                    return_inverse=True)
     lut = np.empty(len(first_idx), dtype=np.int64)
     for k, ri in enumerate(first_idx):
-        key = tuple(str(per_col_vals[j][ri])
-                    for j in range(len(group_tags)))
+        key = tuple(u_str[inv_j[ri]]
+                    for inv_j, u_str in per_col)
         lut[k] = global_groups.setdefault(key, len(global_groups))
     return lut[inv2]
+
+
+def _string_col_codes(col, n: int):
+    """(inverse codes (n,), unique strings) for a string ColVal without
+    materializing per-row python strings. Invalid rows encode as ''.
+    A 2-byte length suffix keeps values that differ only by trailing
+    NULs distinct (numpy S-dtype comparison ignores trailing NULs).
+    Columns with very long values fall back to the row loop — the
+    dense (n, m) matrix scales with the longest value."""
+    offs = np.asarray(col.offsets, dtype=np.int64)
+    lens = np.diff(offs)
+    valid = np.asarray(col.valid, dtype=bool)
+    m = int(lens.max()) if n else 0
+    src = np.frombuffer(col.data, dtype=np.uint8)
+    if m == 0 or len(src) == 0:
+        return np.zeros(n, dtype=np.int64), [""]
+    if m > 256:
+        vals = np.array([s if s is not None else ""
+                         for s in col.to_strings()], dtype=object)
+        u, inv = np.unique(vals, return_inverse=True)
+        return inv.astype(np.int64), [str(s) for s in u]
+    lens_eff = np.where(valid, lens, 0)
+    pos = offs[:-1, None] + np.arange(m, dtype=np.int64)[None, :]
+    mask = (np.arange(m)[None, :] < lens_eff[:, None])
+    mat = np.zeros((n, m + 2), dtype=np.uint8)
+    np.copyto(mat[:, :m], src[np.minimum(pos, len(src) - 1)],
+              where=mask)
+    mat[:, m] = (lens_eff & 0xFF).astype(np.uint8)
+    mat[:, m + 1] = ((lens_eff >> 8) & 0xFF).astype(np.uint8)
+    arr = mat.view(f"S{m + 2}").ravel()
+    u, inv = np.unique(arr, return_inverse=True)
+    u_str = []
+    for b in u:
+        raw = b.ljust(m + 2, b"\x00")     # S-dtype strips trailing NULs
+        ln = raw[m] | (raw[m + 1] << 8)
+        u_str.append(raw[:ln].decode("utf-8"))
+    return inv.astype(np.int64), u_str
 
 
 def _fmt_dur(ns: int) -> str:

@@ -544,3 +544,40 @@ def test_vector_matching_edge_semantics(prom):
     assert out[0]["metric"] == {"__name__": "http_requests_total",
                                 "host": "h0", "job": "api"}
     assert float(out[0]["value"][1]) == 41.0
+
+
+def test_chunked_device_fold_matches_host(tmp_path, monkeypatch):
+    """The series-chunked device fold (large prom queries) must equal
+    the single-launch and host folds exactly — chunk states
+    concatenate along the series axis."""
+    import numpy as np
+
+    import opengemini_tpu.promql.engine as PE
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    NS = 10**9
+    eng = Engine(str(tmp_path / "d"),
+                 EngineOptions(shard_duration=1 << 62))
+    eng.create_database("prom")
+    rng = np.random.default_rng(8)
+    t = (np.arange(24, dtype=np.int64) * 15 + 15) * NS
+    for i in range(40):
+        vals = np.cumsum(rng.integers(1, 7, 24)).astype(np.float64)
+        if i % 11 == 0:
+            vals[12:] -= vals[12] - 0.5          # counter reset
+        eng.write_record("prom", "m", {"h": f"x{i}"}, t,
+                         {"value": vals})
+    for s in eng.database("prom").all_shards():
+        s.flush()
+    q = "rate(m[1m])"
+    args = (q, 120 * NS, 360 * NS, 60 * NS)
+
+    pe = PromEngine(eng, "prom")
+    host = pe.query_range(*args)
+    # force the chunked path with tiny chunks (several series chunks)
+    monkeypatch.setattr(PE, "PROM_DEVICE_MIN_ROWS", 0)
+    monkeypatch.setattr(PE, "PROM_DEVICE_CHUNK_ROWS", 128)
+    chunked = PromEngine(eng, "prom").query_range(*args)
+    assert chunked == host
+    eng.close()
