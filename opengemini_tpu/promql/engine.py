@@ -565,6 +565,7 @@ class PromEngine:
         S_pad = pad_bucket(S, minimum=64)
         n = len(values)
         n_pad = pad_bucket(n)
+        st = None
         if (n_pad >= PROM_DEVICE_MIN_ROWS
                 and n_pad > PROM_DEVICE_CHUNK_ROWS):
             # very large folds run in SERIES CHUNKS before any full-
@@ -572,11 +573,14 @@ class PromEngine:
             # per-series, so chunk states concatenate exactly. One
             # unchunked 60M-row launch allocated input copies + a
             # 15-plane segment grid past the tunnel-attached chip's
-            # HBM and CRASHED the TPU worker (observed at 1M series)
+            # HBM and CRASHED the TPU worker (observed at 1M series).
+            # None → a single series exceeds the chunk cap (cannot
+            # split: states for one series would need merging, not
+            # concatenation) — the host fold below handles any size
             st = self._bucket_states_chunked(
                 values, times, series, bucket, n, nb, S, origin,
                 anchor)
-        else:
+        if st is None:
             seg = np.where((bucket >= 0) & (bucket < nb),
                            series * nb + bucket, S_pad * nb)
             valid = np.ones(n_pad, dtype=bool)
@@ -591,12 +595,14 @@ class PromEngine:
                              constant_values=S_pad * nb)
             anchor_rows = np.pad(anchor[series[:n]], (0, n_pad - n)) \
                 if n_pad != n else anchor[series]
-            if n_pad < PROM_DEVICE_MIN_ROWS:
+            if (n_pad < PROM_DEVICE_MIN_ROWS
+                    or n_pad > PROM_DEVICE_CHUNK_ROWS):
                 # host fold: on tunnel-attached chips the device
                 # kernel's 15 pulled state arrays each pay a full
                 # transfer round trip; realistic prom shapes (high
                 # cardinality, few rows per series) fold faster in
-                # numpy
+                # numpy. Also the safety net for folds too big to
+                # launch whole and unchunkable (one giant series)
                 st = K.bucket_states_host(values, valid, times, seg,
                                           series, S_pad * nb,
                                           origin_t=origin,
@@ -629,7 +635,8 @@ class PromEngine:
         segment grid, and the per-chunk states concatenate along the
         series axis — identical to the one-launch result. ``n`` is the
         TRUE row count (callers may hand padded arrays; pad rows are
-        never sliced — each chunk re-pads itself)."""
+        never sliced — each chunk re-pads itself). Returns None when a
+        single series exceeds the chunk cap (caller: host fold)."""
         import jax
 
         from ..ops.segment_agg import pad_bucket
@@ -646,6 +653,11 @@ class PromEngine:
             s1 = int(np.searchsorted(
                 firsts, firsts[s0] + rows_cap, side="right")) - 1
             s1 = min(max(s1, s0 + 1), S)
+            if int(firsts[s1]) - int(firsts[s0]) > rows_cap:
+                # a single series wider than the cap cannot chunk
+                # (its states would need merging, not concatenation):
+                # signal the caller to take the host fold
+                return None
             spans.append((s0, s1, int(firsts[s0]), int(firsts[s1])))
             s0 = s1
         # UNIFORM padded shapes across chunks: one jit compile serves

@@ -4007,14 +4007,25 @@ def _string_col_codes(col, n: int):
         u, inv = np.unique(vals, return_inverse=True)
         return inv.astype(np.int64), [str(s) for s in u]
     lens_eff = np.where(valid, lens, 0)
-    pos = offs[:-1, None] + np.arange(m, dtype=np.int64)[None, :]
-    mask = (np.arange(m)[None, :] < lens_eff[:, None])
-    mat = np.zeros((n, m + 2), dtype=np.uint8)
-    np.copyto(mat[:, :m], src[np.minimum(pos, len(src) - 1)],
-              where=mask)
-    mat[:, m] = (lens_eff & 0xFF).astype(np.uint8)
-    mat[:, m + 1] = ((lens_eff >> 8) & 0xFF).astype(np.uint8)
-    arr = mat.view(f"S{m + 2}").ravel()
+    # fill the fixed-width matrix in bounded row chunks: the (rows, m)
+    # position/mask temporaries would otherwise be O(n*m) int64
+    # (multi-GB at 720k rows x 256B values); the final packed array is
+    # only n*(m+2) bytes
+    arr = np.empty(n, dtype=f"S{m + 2}")
+    mat_all = arr.view(np.uint8).reshape(n, m + 2)
+    CH = 65536
+    steps = np.arange(m, dtype=np.int32)[None, :]
+    for r0 in range(0, n, CH):
+        r1 = min(r0 + CH, n)
+        pos = (offs[r0:r1, None].astype(np.int64) + steps)
+        mask = steps < lens_eff[r0:r1, None]
+        blk = mat_all[r0:r1]
+        blk[:] = 0
+        np.copyto(blk[:, :m], src[np.minimum(pos, len(src) - 1)],
+                  where=mask)
+        blk[:, m] = (lens_eff[r0:r1] & 0xFF).astype(np.uint8)
+        blk[:, m + 1] = ((lens_eff[r0:r1] >> 8) & 0xFF).astype(
+            np.uint8)
     u, inv = np.unique(arr, return_inverse=True)
     u_str = []
     for b in u:

@@ -19,25 +19,34 @@ from .tssp import TSSPReader, TSSPWriter
 log = get_logger(__name__)
 
 # cumulative metrics for the statistics pusher (statistics/compact.go)
-COMPACT_STATS = {"merges": 0, "files_merged": 0, "series_merged": 0}
+COMPACT_STATS = {"merges": 0, "files_merged": 0, "series_merged": 0,
+                 "series_streamed": 0, "series_decoded": 0}
 
 BASE_SIZE = 1 << 20       # 1 MiB → level 0
 DEFAULT_FANOUT = 4
 MAX_LEVEL = 6
 
 
-def iter_merged_series(readers):
-    """Yield (sid, merged Record) over the union of series in `readers`,
-    merging oldest→newest with the read path's last-write-wins semantics.
-    Shared by compaction and downsampling."""
+def merge_series(readers, sid: int):
+    """One series' merged Record across `readers` (oldest→newest, the
+    read path's last-write-wins semantics) — the single definition of
+    the decode-merge fold shared by compaction, the stream-compaction
+    fallback, and downsampling."""
     from .shard import _merge_parts
+    rec = None
+    for r in readers:
+        part = r.read_series(sid)
+        if part is not None:
+            rec = part if rec is None else _merge_parts(rec, part)
+    return rec
+
+
+def iter_merged_series(readers):
+    """Yield (sid, merged Record) over the union of series in `readers`.
+    Shared by compaction and downsampling."""
     sids = sorted({sid for r in readers for sid in r.series_ids()})
     for sid in sids:
-        rec = None
-        for r in readers:
-            part = r.read_series(sid)
-            if part is not None:
-                rec = part if rec is None else _merge_parts(rec, part)
+        rec = merge_series(readers, sid)
         if rec is not None and rec.num_rows:
             yield sid, rec
 
@@ -97,12 +106,35 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
                                     f"{mst}_{shard._file_seq:06d}.tssp")
         w = TSSPWriter(out_path, segment_size=shard.segment_size)
         wrote = False
-        for sid, rec in iter_merged_series(readers):
-            if transform is not None:
+        if transform is None:
+            # STREAM COMPACTION (reference stream_compact.go +
+            # merge_tool.go): series whose inputs don't overlap in time
+            # copy their encoded segments verbatim — no decode, no
+            # re-encode; only genuinely overlapping series take the
+            # ordered decode-merge. Typical level merges are
+            # time-disjoint flushes, so most bytes stream through.
+            sids = sorted({sid for r in readers
+                           for sid in r.series_ids()})
+            for sid in sids:
+                holders = [(cm, r) for r in readers
+                           for cm in (r.chunk_meta(sid),)
+                           if cm is not None]
+                holders.sort(key=lambda h: h[0].min_time)
+                if w.write_series_raw(sid, holders):
+                    _bump(COMPACT_STATS, "series_streamed")
+                    wrote = True
+                    continue
+                rec = merge_series(readers, sid)
+                if rec is not None and rec.num_rows:
+                    _bump(COMPACT_STATS, "series_decoded")
+                    w.write_series(sid, rec)
+                    wrote = True
+        else:
+            for sid, rec in iter_merged_series(readers):
                 rec = transform(rec, sid)
-            if rec.num_rows:
-                w.write_series(sid, rec)
-                wrote = True
+                if rec.num_rows:
+                    w.write_series(sid, rec)
+                    wrote = True
         if wrote:
             w.finalize()
             new_reader = TSSPReader(out_path)

@@ -322,6 +322,55 @@ class TSSPWriter:
             cm.columns.append(colmeta)
         self._metas.append(("one", sid, _pack_chunk_meta(cm)))
 
+    def write_series_raw(self, sid: int, holders: list) -> bool:
+        """STREAM-COMPACTION path (role of the reference's
+        engine/immutable/stream_compact.go + merge_tool.go self-merge):
+        copy a series' already-encoded segments verbatim — no decode,
+        no re-encode — rewriting only the byte offsets in the chunk
+        meta. ``holders`` is [(ChunkMeta, TSSPReader)] oldest→newest;
+        more than one holder streams as a CONCATENATION, which is only
+        correct when the holders' time ranges are strictly disjoint in
+        order and their column sets match — returns False (write
+        nothing) when those conditions fail and the caller must take
+        the decode-merge path."""
+        if sid <= self._last_sid:
+            raise ValueError("series ids must be written in ascending "
+                             "order")
+        if not holders:
+            return False
+        cms = [cm for cm, _r in holders]
+        for a, b in zip(cms, cms[1:]):
+            if a.max_time >= b.min_time:
+                return False              # overlap: decode-merge
+        sig0 = sorted((c.name, c.type) for c in cms[0].columns)
+        if any(sorted((c.name, c.type) for c in cm.columns) != sig0
+               for cm in cms[1:]):
+            return False                  # ragged schema: decode-merge
+        out = ChunkMeta(sid, cms[0].min_time, cms[-1].max_time,
+                        sum(cm.rows for cm in cms),
+                        regular=all(cm.regular for cm in cms))
+        for colm0 in cms[0].columns:
+            nc = ColumnMeta(colm0.name, colm0.type)
+            for cm, r in holders:
+                colm = cm.column(colm0.name)
+                mm = r._mm
+                for s in colm.segments:
+                    off, size = self._append(
+                        mm[s.offset:s.offset + s.size])
+                    voff, vsize = self._append(
+                        mm[s.valid_offset:s.valid_offset
+                           + s.valid_size])
+                    nc.segments.append(Segment(off, size, s.rows,
+                                               voff, vsize, s.preagg))
+            out.columns.append(nc)
+        self._min_time = (out.min_time if self._min_time is None
+                          else min(self._min_time, out.min_time))
+        self._max_time = (out.max_time if self._max_time is None
+                          else max(self._max_time, out.max_time))
+        self._metas.append(("one", sid, _pack_chunk_meta(out)))
+        self._last_sid = sid
+        return True
+
     def write_series_bulk(self, sids: np.ndarray, offsets: np.ndarray,
                           times_cat: np.ndarray,
                           cols: dict[str, np.ndarray]) -> None:
