@@ -88,6 +88,19 @@ struct Builder {
     std::map<SV, std::vector<uint32_t>> postings;
     char tok[MAX_TOKEN];
 
+    // the ONE posting-insert (dedup contract shared by the default and
+    // delimiter tokenizers — og_ti_builder_add/add2 both land here)
+    void insert(const char* t, size_t tl, uint32_t doc) {
+        SV key{t, static_cast<uint32_t>(tl)};
+        auto it = postings.find(key);
+        if (it == postings.end()) {
+            key.p = arena.put(t, tl);
+            it = postings.emplace(key, std::vector<uint32_t>{}).first;
+        }
+        if (it->second.empty() || it->second.back() != doc)
+            it->second.push_back(doc);
+    }
+
     void add(uint32_t doc, const char* text, int64_t len) {
         const uint8_t* s = reinterpret_cast<const uint8_t*>(text);
         int64_t i = 0;
@@ -98,15 +111,7 @@ struct Builder {
                 if (tl < MAX_TOKEN) tok[tl++] = static_cast<char>(low(s[i]));
                 ++i;
             }
-            if (!tl) continue;
-            SV key{tok, static_cast<uint32_t>(tl)};
-            auto it = postings.find(key);
-            if (it == postings.end()) {
-                key.p = arena.put(tok, tl);
-                it = postings.emplace(key, std::vector<uint32_t>{}).first;
-            }
-            if (it->second.empty() || it->second.back() != doc)
-                it->second.push_back(doc);
+            if (tl) insert(tok, tl, doc);
         }
     }
 };
@@ -280,6 +285,156 @@ int64_t og_tokenize(const char* text, int64_t len, uint32_t* out_se,
         }
     }
     return n;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------ round-5 depth additions
+// Prefix search, conjunctive (all-tokens) search, and delimiter-set
+// tokenization — the remaining feature surface of the reference's
+// FullTextIndex.cpp (prefix/phrase queries, per-field tokenizer
+// config). Phrase verification happens a layer up (CLV index carries
+// positions); here "match all tokens" supplies the phrase candidates.
+
+namespace {
+
+// decode one posting list into a sorted doc vector
+void decode_postings(const Reader* r, uint32_t idx,
+                     std::vector<uint32_t>* out) {
+    uint32_t toff, cnt, poff;
+    uint16_t tlen;
+    r->entry(idx, &toff, &tlen, &cnt, &poff);
+    const uint8_t* p = r->posts + poff;
+    uint32_t doc = 0;
+    out->reserve(out->size() + cnt);
+    for (uint32_t i = 0; i < cnt; ++i) {
+        uint32_t d = 0;
+        int sh = 0;
+        while (*p & 0x80) { d |= uint32_t(*p++ & 0x7F) << sh; sh += 7; }
+        d |= uint32_t(*p++) << sh;
+        doc += d;
+        out->push_back(doc);
+    }
+}
+
+// first table index whose token is >= (token, len); ntok if none
+int64_t lower_bound_tok(const Reader* r, const char* token, int64_t len) {
+    int64_t lo = 0, hi = int64_t(r->ntok);
+    while (lo < hi) {
+        int64_t mid = (lo + hi) / 2;
+        uint32_t toff, cnt, poff;
+        uint16_t tlen;
+        r->entry(static_cast<uint32_t>(mid), &toff, &tlen, &cnt, &poff);
+        int c = std::memcmp(r->tokbytes + toff, token,
+                            std::min<int64_t>(tlen, len));
+        if (c == 0) c = (tlen < len) ? -1 : (tlen > len ? 1 : 0);
+        if (c < 0) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+
+// tokenize with an optional delimiter set: delims==nullptr uses the
+// default token-character classes; otherwise tokens are maximal runs
+// of bytes NOT in delims (lowercased, truncated to MAX_TOKEN)
+template <typename F>
+void for_tokens(const char* text, int64_t len, const char* delims,
+                int64_t dlen, F&& fn) {
+    bool dset[256] = {false};
+    if (delims) {
+        for (int64_t i = 0; i < dlen; ++i)
+            dset[static_cast<uint8_t>(delims[i])] = true;
+    }
+    const uint8_t* s = reinterpret_cast<const uint8_t*>(text);
+    char tok[MAX_TOKEN];
+    int64_t i = 0;
+    auto is_sep = [&](uint8_t c) {
+        return delims ? dset[c] : !is_tok(c);
+    };
+    while (i < len) {
+        while (i < len && is_sep(s[i])) ++i;
+        size_t tl = 0;
+        while (i < len && !is_sep(s[i])) {
+            if (tl < MAX_TOKEN) tok[tl++] = static_cast<char>(low(s[i]));
+            ++i;
+        }
+        if (tl) fn(tok, static_cast<int64_t>(tl));
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// doc ids whose tokens start with `prefix` (union over the matching
+// token range). Returns count, -2 when cap is too small.
+int64_t og_ti_search_prefix(void* h, const char* prefix, int64_t len,
+                            uint32_t* out, int64_t cap) {
+    Reader* r = static_cast<Reader*>(h);
+    std::vector<uint32_t> docs;
+    for (int64_t i = lower_bound_tok(r, prefix, len);
+         i < int64_t(r->ntok); ++i) {
+        uint32_t toff, cnt, poff;
+        uint16_t tlen;
+        r->entry(static_cast<uint32_t>(i), &toff, &tlen, &cnt, &poff);
+        if (tlen < len ||
+            std::memcmp(r->tokbytes + toff, prefix, len) != 0)
+            break;
+        decode_postings(r, static_cast<uint32_t>(i), &docs);
+    }
+    std::sort(docs.begin(), docs.end());
+    docs.erase(std::unique(docs.begin(), docs.end()), docs.end());
+    if (int64_t(docs.size()) > cap) return -2;
+    std::memcpy(out, docs.data(), docs.size() * 4);
+    return int64_t(docs.size());
+}
+
+// doc ids containing EVERY token of `text` (tokenized with the same
+// rules as the build; delims optional as in og_ti_builder_add2).
+// Returns count (0 when any token is absent), -2 when cap too small.
+int64_t og_ti_search_all(void* h, const char* text, int64_t len,
+                         const char* delims, int64_t dlen,
+                         uint32_t* out, int64_t cap) {
+    Reader* r = static_cast<Reader*>(h);
+    std::vector<std::vector<uint32_t>> lists;
+    bool missing = false;
+    for_tokens(text, len, delims, dlen,
+               [&](const char* tok, int64_t tl) {
+                   if (missing) return;
+                   int64_t idx = r->find(tok, tl);
+                   if (idx < 0) { missing = true; return; }
+                   lists.emplace_back();
+                   decode_postings(r, static_cast<uint32_t>(idx),
+                                   &lists.back());
+               });
+    if (missing || lists.empty()) return 0;
+    // intersect smallest-first
+    std::sort(lists.begin(), lists.end(),
+              [](const auto& a, const auto& b) {
+                  return a.size() < b.size();
+              });
+    std::vector<uint32_t> acc = lists[0];
+    for (size_t k = 1; k < lists.size() && !acc.empty(); ++k) {
+        std::vector<uint32_t> nxt;
+        std::set_intersection(acc.begin(), acc.end(),
+                              lists[k].begin(), lists[k].end(),
+                              std::back_inserter(nxt));
+        acc.swap(nxt);
+    }
+    if (int64_t(acc.size()) > cap) return -2;
+    std::memcpy(out, acc.data(), acc.size() * 4);
+    return int64_t(acc.size());
+}
+
+// builder add with a custom delimiter set (per-field tokenizer config,
+// reference textindex tokenizer options): tokens are runs of bytes NOT
+// in `delims`. Queries must pass the same delims to og_ti_search_all.
+void og_ti_builder_add2(void* h, uint32_t doc, const char* text,
+                        int64_t len, const char* delims, int64_t dlen) {
+    Builder* b = static_cast<Builder*>(h);
+    for_tokens(text, len, delims, dlen,
+               [&](const char* tok, int64_t tl) {
+                   b->insert(tok, static_cast<size_t>(tl), doc);
+               });
 }
 
 }  // extern "C"

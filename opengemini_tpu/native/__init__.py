@@ -95,6 +95,19 @@ def _bind(lib) -> None:
         lib.og_ti_search.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        lib.og_ti_search_prefix.restype = ctypes.c_int64
+        lib.og_ti_search_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        lib.og_ti_search_all.restype = ctypes.c_int64
+        lib.og_ti_search_all.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        lib.og_ti_builder_add2.restype = None
+        lib.og_ti_builder_add2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
         lib.og_gorilla_encode.restype = ctypes.c_int64
         lib.og_gorilla_encode.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
@@ -273,13 +286,25 @@ class TextIndexBuilder:
         else:
             self._postings: dict[bytes, list[int]] = {}
 
-    def add(self, doc_id: int, text: bytes | str) -> None:
+    def add(self, doc_id: int, text: bytes | str,
+            delims: bytes | None = None) -> None:
+        """`delims` configures the tokenizer for this document (tokens
+        = runs NOT containing any delim byte); queries must pass the
+        same set to search_all. Default: alnum/underscore/UTF-8."""
         if isinstance(text, str):
             text = text.encode()
         if self._lib is not None:
-            self._lib.og_ti_builder_add(self._h, doc_id, text, len(text))
+            if delims is None:
+                self._lib.og_ti_builder_add(self._h, doc_id, text,
+                                            len(text))
+            else:
+                self._lib.og_ti_builder_add2(self._h, doc_id, text,
+                                             len(text), delims,
+                                             len(delims))
             return
-        for tok in tokenize(text):
+        toks = (tokenize(text) if delims is None
+                else tokenize_delims(text, delims))
+        for tok in toks:
             lst = self._postings.setdefault(tok, [])
             if not lst or lst[-1] != doc_id:
                 lst.append(doc_id)
@@ -384,26 +409,102 @@ class TextIndexReader:
             toff, tlen, cnt, poff = self._entries[mid]
             t = self._tokbytes[toff:toff + tlen]
             if t == token:
-                out = np.empty(cnt, dtype=np.uint32)
-                doc = 0
-                p = poff
-                for i in range(cnt):
-                    d, shift = 0, 0
-                    while True:
-                        b = self._posts[p]
-                        p += 1
-                        d |= (b & 0x7F) << shift
-                        if not b & 0x80:
-                            break
-                        shift += 7
-                    doc += d
-                    out[i] = doc
-                return out
+                return self._decode_at(mid)
             if t < token:
                 lo = mid + 1
             else:
                 hi = mid - 1
         return np.empty(0, dtype=np.uint32)
+
+    def _decode_at(self, mid: int) -> np.ndarray:
+        toff, tlen, cnt, poff = self._entries[mid]
+        out = np.empty(cnt, dtype=np.uint32)
+        doc = 0
+        p = poff
+        for i in range(cnt):
+            d, shift = 0, 0
+            while True:
+                b = self._posts[p]
+                p += 1
+                d |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            doc += d
+            out[i] = doc
+        return out
+
+    def search_prefix(self, prefix: bytes | str) -> np.ndarray:
+        """Doc ids whose tokens START WITH `prefix` (sorted, deduped) —
+        the reference text index's prefix-query surface."""
+        if isinstance(prefix, str):
+            prefix = prefix.encode()
+        prefix = prefix.lower()[:_MAX_TOKEN]
+        if self._lib is not None:
+            cap = 4096
+            while True:
+                out = np.empty(cap, dtype=np.uint32)
+                n = self._lib.og_ti_search_prefix(
+                    self._h, prefix, len(prefix),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                    cap)
+                if n == -2:
+                    cap *= 8
+                    continue
+                return out[:max(n, 0)]
+        if not hasattr(self, "_entries"):
+            self._open_py(self._blob)
+        # binary lower bound, then the matching CONTIGUOUS range
+        # (tokens are sorted — mirrors the native lower_bound_tok)
+        lo, hi = 0, len(self._entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            toff, tlen, _c, _p = self._entries[mid]
+            if self._tokbytes[toff:toff + tlen] < prefix:
+                lo = mid + 1
+            else:
+                hi = mid
+        docs: list = []
+        for mid in range(lo, len(self._entries)):
+            toff, tlen, _c, _p = self._entries[mid]
+            if not self._tokbytes[toff:toff + tlen].startswith(prefix):
+                break
+            docs.append(self._decode_at(mid))
+        if not docs:
+            return np.empty(0, dtype=np.uint32)
+        return np.unique(np.concatenate(docs))
+
+    def search_all(self, text: bytes | str,
+                   delims: bytes | None = None) -> np.ndarray:
+        """Doc ids containing EVERY token of `text` (conjunctive
+        search — the phrase-candidate set; CLV carries positions for
+        exact phrase verification). `delims` must match the builder's
+        tokenizer configuration."""
+        if isinstance(text, str):
+            text = text.encode()
+        if self._lib is not None:
+            cap = 4096
+            while True:
+                out = np.empty(cap, dtype=np.uint32)
+                n = self._lib.og_ti_search_all(
+                    self._h, text, len(text),
+                    delims, len(delims) if delims else 0,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                    cap)
+                if n == -2:
+                    cap *= 8
+                    continue
+                return out[:max(n, 0)]
+        toks = (tokenize(text) if delims is None
+                else tokenize_delims(text, delims))
+        acc = None
+        for t in toks:
+            docs = self.search(t)
+            if len(docs) == 0:
+                return np.empty(0, dtype=np.uint32)
+            acc = docs if acc is None else \
+                np.intersect1d(acc, docs, assume_unique=True)
+        return acc if acc is not None else np.empty(0, dtype=np.uint32)
 
     def close(self) -> None:
         if self._lib is not None and self._h:
@@ -415,6 +516,25 @@ class TextIndexReader:
             self.close()
         except Exception:
             pass
+
+
+def tokenize_delims(text: bytes, delims: bytes) -> list[bytes]:
+    """Delimiter-set tokenizer (per-field tokenizer config, reference
+    textindex option): tokens are maximal runs of bytes NOT in
+    `delims`, lowercased, truncated — byte-identical with the native
+    for_tokens(delims)."""
+    dset = set(delims)
+    toks = []
+    i, n = 0, len(text)
+    while i < n:
+        while i < n and text[i] in dset:
+            i += 1
+        start = i
+        while i < n and text[i] not in dset:
+            i += 1
+        if i > start:
+            toks.append(text[start:i].lower()[:_MAX_TOKEN])
+    return toks
 
 
 # --------------------------------------------------------------- gorilla

@@ -582,3 +582,71 @@ def test_prom_matrices_from_write_request():
     assert vals.shape == (80, 3)
     assert times.tolist() == [(1000 + j) * 10 ** 6 for j in (0, 1, 2)]
     assert len(rest) == 1 and rest[0][1] == {"host": "ragged"}
+
+
+def test_text_index_prefix_and_conjunctive_search():
+    """Round-5 depth (reference FullTextIndex prefix/phrase surface):
+    prefix search unions matching token ranges; search_all intersects
+    posting lists (phrase-candidate set); native and python fallbacks
+    agree."""
+    import numpy as np
+
+    from opengemini_tpu import native as N
+
+    docs = {
+        0: b"error connecting to database primary",
+        1: b"connection reset by peer",
+        2: b"database error: timeout connecting",
+        3: b"all good here",
+        4: b"Connection pool exhausted for database",
+    }
+    b = N.TextIndexBuilder()
+    for d, t in docs.items():
+        b.add(d, t)
+    blob = b.finish()
+    r = N.TextIndexReader(blob)
+    # prefix: connect* -> {0, 2} (connecting), connection -> {1, 4}
+    assert list(r.search_prefix(b"connecting")) == [0, 2]
+    assert sorted(r.search_prefix(b"connect")) == [0, 1, 2, 4]
+    assert list(r.search_prefix(b"zzz")) == []
+    # conjunctive: database AND connecting -> {0, 2}
+    assert sorted(r.search_all(b"database connecting")) == [0, 2]
+    assert list(r.search_all(b"database nothere")) == []
+    assert sorted(r.search_all(b"Database")) == [0, 2, 4]
+
+    # python fallback parity on the same blob
+    r2 = N.TextIndexReader(blob)
+    r2._lib = None
+    for q in (b"connect", b"connecting", b"zzz"):
+        assert list(r2.search_prefix(q)) == list(r.search_prefix(q))
+    for q in (b"database connecting", b"database nothere", b"error"):
+        assert list(r2.search_all(q)) == list(r.search_all(q))
+    r.close()
+    r2.close()
+
+
+def test_text_index_delimiter_tokenizer():
+    """Per-field tokenizer config: tokens split on a custom delimiter
+    set at build AND query time (reference tokenizer options)."""
+    from opengemini_tpu import native as N
+
+    b = N.TextIndexBuilder()
+    # '/' and ',' delimiters: path components become tokens
+    b.add(0, b"/var/log/app,ERROR", delims=b"/,")
+    b.add(1, b"/var/run/db,OK", delims=b"/,")
+    blob = b.finish()
+    r = N.TextIndexReader(blob)
+    assert list(r.search(b"log")) == [0]
+    assert sorted(r.search_all(b"var,error", delims=b"/,")) == [0]
+    assert sorted(r.search_prefix(b"va")) == [0, 1]
+    # python fallback parity
+    b2 = N.TextIndexBuilder()
+    b2._lib = None
+    b2._postings = {}
+    b2.add(0, b"/var/log/app,ERROR", delims=b"/,")
+    b2.add(1, b"/var/run/db,OK", delims=b"/,")
+    r2 = N.TextIndexReader(b2.finish())
+    r2._lib = None
+    assert sorted(r2.search_all(b"var,error", delims=b"/,")) == [0]
+    r.close()
+    r2.close()
