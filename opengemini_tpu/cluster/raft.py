@@ -41,6 +41,12 @@ HEARTBEAT = 0.05
 SNAPSHOT_EVERY = 4096          # log entries between snapshots
 
 
+# cumulative metrics for the statistics pusher (reference raft/meta
+# statistics analog)
+RAFT_STATS = {"elections_won": 0, "step_downs": 0, "snapshots": 0,
+              "proposes": 0}
+
+
 class NotLeader(Exception):
     def __init__(self, leader_hint: str | None):
         super().__init__(f"not leader (leader={leader_hint})")
@@ -309,6 +315,8 @@ class RaftNode:
             self.next_index = {p: nxt for p in self.peers if p != self.id}
             self.match_index = {p: 0 for p in self.peers if p != self.id}
             log.info("raft %s became leader term=%d", self.id, term)
+            from ..utils.stats import bump as _bump
+            _bump(RAFT_STATS, "elections_won")
             # commit a no-op so prior-term entries become committable
             # now, not at the next client proposal (Raft §5.4.2)
             self._append_entry(None)
@@ -317,6 +325,8 @@ class RaftNode:
         self._wake_replicators()
 
     def _step_down(self, term: int):
+        from ..utils.stats import bump as _bump
+        _bump(RAFT_STATS, "step_downs")
         # caller holds lock
         if term > self.term:
             self.term = term
@@ -553,6 +563,8 @@ class RaftNode:
         applied_off = self.last_applied - self.log_base
         if applied_off <= 0:
             return
+        from ..utils.stats import bump as _bump
+        _bump(RAFT_STATS, "snapshots")
         snap = {"last_index": self.last_applied,
                 "last_term": self._term_at(self.last_applied),
                 "fsm": self.fsm_snapshot()}
@@ -589,6 +601,8 @@ class RaftNode:
     def propose(self, cmd: dict, timeout: float = 10.0):
         """Replicate one command; returns fsm_apply's result once
         committed. Raises NotLeader with a redirect hint on followers."""
+        from ..utils.stats import bump as _bump
+        _bump(RAFT_STATS, "proposes")
         with self._lock:
             if self.state != LEADER:
                 hint = self.peers.get(self.leader_id) \

@@ -126,12 +126,15 @@ class HttpServer:
             from ..utils.stats import (compaction_collector,
                                        device_collector,
                                        devicecache_collector,
-                                       executor_collector, rpc_collector)
+                                       executor_collector, raft_collector,
+                                       rpc_collector, wal_collector)
             sp.register("runtime", runtime_collector)
             sp.register("readcache", readcache_collector)
             sp.register("executor", executor_collector)
             sp.register("devicecache", devicecache_collector)
             sp.register("device", device_collector)
+            sp.register("wal", wal_collector)
+            sp.register("raft", raft_collector)
             sp.register("compaction", compaction_collector)
             sp.register("rpc", rpc_collector)
             if local:
@@ -578,13 +581,16 @@ class HttpServer:
                                    device_collector,
                                    devicecache_collector,
                                    engine_collector, executor_collector,
-                                   readcache_collector, rpc_collector,
-                                   runtime_collector)
+                                   raft_collector, readcache_collector,
+                                   rpc_collector, runtime_collector,
+                                   wal_collector)
         groups = {"runtime": runtime_collector(),
                   "readcache": readcache_collector(),
                   "executor": executor_collector(),
                   "devicecache": devicecache_collector(),
                   "device": device_collector(),
+                  "wal": wal_collector(),
+                  "raft": raft_collector(),
                   "compaction": compaction_collector(),
                   "rpc": rpc_collector(),
                   "httpd": dict(self.stats)}

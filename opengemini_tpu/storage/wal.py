@@ -190,6 +190,12 @@ def _unpack_cols(buf: bytes):
     return entries
 
 
+# cumulative metrics for the statistics pusher (reference
+# statistics/wal.go analog)
+WAL_STATS = {"writes": 0, "bytes_written": 0, "switches": 0,
+             "replayed_batches": 0}
+
+
 class WAL:
     def __init__(self, dir_path: str, sync: bool = False,
                  compression: str = "zstd"):
@@ -231,6 +237,9 @@ class WAL:
             if self.sync:
                 self._f.flush()
                 os.fsync(self._f.fileno())
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "writes")
+        _bump(WAL_STATS, "bytes_written", len(frame))
 
     def write_cols(self, entries) -> None:
         """Columnar frame (bulk record write path)."""
@@ -247,6 +256,9 @@ class WAL:
             if self.sync:
                 self._f.flush()
                 os.fsync(self._f.fileno())
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "writes")
+        _bump(WAL_STATS, "bytes_written", len(frame))
 
     def write_cols_bulk(self, mst: str, sids, offsets, times_cat,
                         fields_cat) -> None:
@@ -264,6 +276,9 @@ class WAL:
             if self.sync:
                 self._f.flush()
                 os.fsync(self._f.fileno())
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "writes")
+        _bump(WAL_STATS, "bytes_written", len(frame))
 
     def switch(self) -> int:
         """Rotate to a new segment; returns the sealed segment's seq
@@ -276,7 +291,9 @@ class WAL:
             sealed = self._seq
             self._seq += 1
             self._f = open(self._path(self._seq), "ab")
-            return sealed
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "switches")
+        return sealed
 
     def remove_upto(self, seq: int) -> None:
         for fn in sorted(os.listdir(self.dir)):

@@ -212,3 +212,15 @@ def device_collector():
     GPUs never made transfer volume the bottleneck)."""
     from ..ops.devstats import device_collector as _dc
     return _dc()
+
+
+def wal_collector():
+    """WAL metrics (reference statistics/wal analog)."""
+    from ..storage.wal import WAL_STATS
+    return dict(WAL_STATS)
+
+
+def raft_collector():
+    """Replication raft metrics (elections, snapshots, proposes)."""
+    from ..cluster.raft import RAFT_STATS
+    return dict(RAFT_STATS)
