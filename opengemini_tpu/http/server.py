@@ -127,7 +127,8 @@ class HttpServer:
                                        device_collector,
                                        devicecache_collector,
                                        executor_collector, raft_collector,
-                                       rpc_collector, wal_collector)
+                                       rpc_collector, subscriber_collector,
+                                       wal_collector)
             sp.register("runtime", runtime_collector)
             sp.register("readcache", readcache_collector)
             sp.register("executor", executor_collector)
@@ -135,6 +136,7 @@ class HttpServer:
             sp.register("device", device_collector)
             sp.register("wal", wal_collector)
             sp.register("raft", raft_collector)
+            sp.register("subscriber", subscriber_collector)
             sp.register("compaction", compaction_collector)
             sp.register("rpc", rpc_collector)
             if local:
@@ -583,7 +585,7 @@ class HttpServer:
                                    engine_collector, executor_collector,
                                    raft_collector, readcache_collector,
                                    rpc_collector, runtime_collector,
-                                   wal_collector)
+                                   subscriber_collector, wal_collector)
         groups = {"runtime": runtime_collector(),
                   "readcache": readcache_collector(),
                   "executor": executor_collector(),
@@ -591,6 +593,7 @@ class HttpServer:
                   "device": device_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
+                  "subscriber": subscriber_collector(),
                   "compaction": compaction_collector(),
                   "rpc": rpc_collector(),
                   "httpd": dict(self.stats)}

@@ -1,17 +1,29 @@
 """Subscriber service: forward written points to subscriber endpoints
-(role of reference coordinator/subscriber.go:200-373 — per-db writers,
-ALL = every destination, ANY = round-robin)."""
+(role of reference coordinator/subscriber.go:200-373 — per-destination
+writer pools, configurable retry attempts, ALL = every destination,
+ANY = round-robin).
+
+Each destination owns a bounded queue and a small worker pool; a send
+retries with exponential backoff before counting a drop. Backpressure
+drops at the queue with a log line + counter — the reference behaves
+the same (BalanceWriter drops on full channels)."""
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 import urllib.request
 
 from ..storage.rows import PointRow
 from ..utils import get_logger
 
 log = get_logger(__name__)
+
+# cumulative metrics for the statistics pusher
+# (reference statistics/subscriber.go analog)
+SUB_STATS = {"queued": 0, "sent": 0, "failed": 0, "dropped": 0,
+             "retries": 0}
 
 
 def rows_to_lp(rows: list[PointRow]) -> str:
@@ -41,63 +53,165 @@ def rows_to_lp(rows: list[PointRow]) -> str:
     return "\n".join(out)
 
 
-class SubscriberService:
-    """Hooks engine writes; ships line protocol to destinations
-    asynchronously (bounded queue, drops with a log on overflow — the
-    reference behaves the same under backpressure)."""
+class _DestWriter:
+    """One destination's bounded queue + worker pool with retry
+    (reference subscriber.go writer goroutines)."""
 
-    def __init__(self, engine, catalog, max_queue: int = 1000):
+    def __init__(self, dest: str, workers: int, max_queue: int,
+                 attempts: int, backoff_s: float,
+                 send_fn=None):
+        self.dest = dest
+        self.attempts = attempts
+        self.backoff_s = backoff_s
+        self._send_fn = send_fn or self._http_send
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"subscriber-{dest}-{i}")
+            for i in range(max(1, workers))]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, db: str, body: bytes) -> bool:
+        from ..utils.stats import bump
+        try:
+            self._q.put_nowait((db, body))
+            bump(SUB_STATS, "queued")
+            return True
+        except queue.Full:
+            bump(SUB_STATS, "dropped")
+            log.warning("subscriber queue full for %s; dropping batch",
+                        self.dest)
+            return False
+
+    def _run(self) -> None:
+        from ..utils.stats import bump
+        while True:
+            try:
+                # timed get: a full queue can swallow shutdown
+                # sentinels, so workers also poll the stop flag
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            db, body = item
+            delay = self.backoff_s
+            for attempt in range(self.attempts):
+                try:
+                    self._send_fn(self.dest, db, body)
+                    bump(SUB_STATS, "sent")
+                    break
+                except Exception as e:
+                    if attempt + 1 >= self.attempts:
+                        bump(SUB_STATS, "failed")
+                        log.warning(
+                            "subscriber push to %s failed after %d "
+                            "attempts: %s", self.dest, self.attempts, e)
+                    else:
+                        bump(SUB_STATS, "retries")
+                        if self._stop.wait(delay):
+                            return
+                        delay *= 2
+
+    @staticmethod
+    def _http_send(dest: str, db: str, body: bytes) -> None:
+        url = f"{dest.rstrip('/')}/write?db={db}"
+        req = urllib.request.Request(url, data=body, method="POST")
+        urllib.request.urlopen(req, timeout=10)
+
+    def stop(self) -> None:
+        self._stop.set()          # workers exit via the timed get
+        for _ in self._threads:
+            try:
+                self._q.put_nowait(None)   # fast path when not full
+            except queue.Full:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+class SubscriberService:
+    """Hooks engine writes; lazily builds one _DestWriter per
+    (destination) and routes ALL/ANY per subscription."""
+
+    def __init__(self, engine, catalog, max_queue: int = 1000,
+                 workers_per_dest: int = 2, attempts: int = 3,
+                 backoff_s: float = 0.1, send_fn=None):
         self.engine = engine
         self.catalog = catalog
-        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
-        self._rr = 0
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self.max_queue = max_queue
+        self.workers_per_dest = workers_per_dest
+        self.attempts = attempts
+        self.backoff_s = backoff_s
+        self._send_fn = send_fn
+        self._writers: dict[str, _DestWriter] = {}
+        self._rr: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._started = False
         engine.write_hooks.append(self.on_write)
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._drain,
-                                        name="subscriber", daemon=True)
-        self._thread.start()
+        self._started = True
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread:
-            self._q.put(None)
-            self._thread.join(timeout=5)
+        with self._lock:
+            # _started flips under the lock so a racing on_write can
+            # never create a writer AFTER the teardown snapshot
+            self._started = False
+            writers = list(self._writers.values())
+            self._writers.clear()
+        for w in writers:
+            w.stop()
+
+    def _writer(self, dest: str) -> _DestWriter | None:
+        with self._lock:
+            if not self._started:
+                return None
+            w = self._writers.get(dest)
+            if w is None:
+                w = _DestWriter(dest, self.workers_per_dest,
+                                self.max_queue, self.attempts,
+                                self.backoff_s, send_fn=self._send_fn)
+                self._writers[dest] = w
+            return w
+
+    def _prune_writers(self) -> None:
+        """Reap pools for destinations no subscription references
+        anymore (subscription churn must not leak worker threads)."""
+        try:
+            live = {d for s in self.catalog.subscriptions.values()
+                    for d in s.destinations}
+        except Exception:
+            return
+        with self._lock:
+            dead = [d for d in self._writers if d not in live]
+            stale = [self._writers.pop(d) for d in dead]
+        for w in stale:
+            w.stop()
 
     def on_write(self, db: str, rows: list[PointRow]) -> None:
+        if not self._started:
+            return
         subs = self.catalog.subscriptions_for(db)
         if not subs:
             return
-        try:
-            self._q.put_nowait((db, rows))
-        except queue.Full:
-            log.warning("subscriber queue full; dropping %d rows",
-                        len(rows))
-
-    def _drain(self) -> None:
-        while not self._stop.is_set():
-            item = self._q.get()
-            if item is None:
-                return
-            db, rows = item
-            body = rows_to_lp(rows).encode()
-            for sub in self.catalog.subscriptions_for(db):
-                dests = sub.destinations
-                if not dests:
-                    continue
-                if sub.mode.upper() == "ANY":
-                    dests = [dests[self._rr % len(dests)]]
-                    self._rr += 1
-                for d in dests:
-                    self._send(d, db, body)
-
-    @staticmethod
-    def _send(dest: str, db: str, body: bytes) -> None:
-        url = f"{dest.rstrip('/')}/write?db={db}"
-        try:
-            req = urllib.request.Request(url, data=body, method="POST")
-            urllib.request.urlopen(req, timeout=10)
-        except Exception as e:
-            log.warning("subscriber push to %s failed: %s", dest, e)
+        body = rows_to_lp(rows).encode()
+        for sub in subs:
+            dests = sub.destinations
+            if not dests:
+                continue
+            if sub.mode.upper() == "ANY":
+                key = f"{db}:{sub.name}"     # catalog's namespacing
+                with self._lock:             # hooks run concurrently
+                    i = self._rr.get(key, 0)
+                    self._rr[key] = i + 1
+                dests = [dests[i % len(dests)]]
+            for d in dests:
+                w = self._writer(d)
+                if w is not None:
+                    w.submit(db, body)
+        self._prune_writers()

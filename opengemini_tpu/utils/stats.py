@@ -224,3 +224,9 @@ def raft_collector():
     """Replication raft metrics (elections, snapshots, proposes)."""
     from ..cluster.raft import RAFT_STATS
     return dict(RAFT_STATS)
+
+
+def subscriber_collector():
+    """Subscription forwarding metrics (statistics/subscriber analog)."""
+    from ..services.subscriber import SUB_STATS
+    return dict(SUB_STATS)

@@ -491,3 +491,69 @@ def test_stream_compaction_copies_encoded_segments(tmp_path):
     # overwrite applied (newest wins) on the overlapping series
     assert any(d.get(25000) == 99.5 for d in before.values())
     eng.close()
+
+
+def test_subscriber_pools_retry_and_modes(tmp_path):
+    """Per-destination writer pools with retry/backoff (reference
+    subscriber.go:200-373): a flaky destination succeeds on retry, ANY
+    round-robins across destinations, ALL fans out to every one."""
+    import threading
+    import time as _t
+
+    from opengemini_tpu.meta.catalog import Catalog, Subscription
+    from opengemini_tpu.services.subscriber import (SUB_STATS,
+                                                    SubscriberService)
+    from opengemini_tpu.storage import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    cat = Catalog(str(tmp_path / "meta.json"))
+    cat.create_database("db0")
+    sent: dict = {}
+    fails = {"n": 0}
+    lock = threading.Lock()
+
+    def fake_send(dest, db, body):
+        with lock:
+            if dest == "flaky" and fails["n"] < 2:
+                fails["n"] += 1
+                raise OSError("transient")
+            sent.setdefault(dest, []).append(body)
+
+    before = dict(SUB_STATS)
+    svc = SubscriberService(eng, cat, attempts=3, backoff_s=0.01,
+                            send_fn=fake_send)
+    svc.start()
+    try:
+        cat.create_subscription(Subscription(
+            "s_all", "db0", "ALL", ["a", "flaky"]))
+        eng.write_points("db0", [
+            PointRow("m", {}, {"v": 1.0}, 1)])
+        for _ in range(100):
+            with lock:
+                if len(sent.get("a", [])) >= 1 \
+                        and len(sent.get("flaky", [])) >= 1:
+                    break
+            _t.sleep(0.02)
+        with lock:
+            assert len(sent["a"]) == 1          # ALL fans out
+            assert len(sent["flaky"]) == 1      # retried to success
+        assert SUB_STATS["retries"] - before["retries"] >= 2
+        assert SUB_STATS["sent"] - before["sent"] >= 2
+
+        cat.drop_subscription("db0", "s_all")
+        cat.create_subscription(Subscription(
+            "s_any", "db0", "ANY", ["x", "y"]))
+        for i in range(4):
+            eng.write_points("db0", [
+                PointRow("m", {}, {"v": float(i)}, 10 + i)])
+        for _ in range(100):
+            with lock:
+                if (len(sent.get("x", [])) + len(sent.get("y", []))
+                        >= 4):
+                    break
+            _t.sleep(0.02)
+        with lock:
+            assert len(sent["x"]) == 2 and len(sent["y"]) == 2  # RR
+    finally:
+        svc.stop()
+        eng.close()
