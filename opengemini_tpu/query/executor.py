@@ -903,8 +903,14 @@ class QueryExecutor:
                 stmt = self._expand_regexes(stmt, db)
             mst = stmt.from_measurement
             cs = classify_select(stmt)
-            # tag key universe for condition analysis
-            shards_all = self.engine.database(db).all_shards()
+            # tag key universe for condition analysis — from the
+            # shards the TIME RANGE can touch, so a bounded query on a
+            # many-shard db never materializes cold lazy shards just
+            # to learn tag keys (time bounds don't need them)
+            db_obj = self.engine.database(db)
+            tb = analyze_condition(stmt.condition, set())
+            shards_all = (db_obj.shards_overlapping(tb.t_min, tb.t_max)
+                          if tb.has_time_range else db_obj.all_shards())
             tag_keys = {k for s in shards_all
                         for k in s.index.tag_keys(mst)}
             cond = analyze_condition(stmt.condition, tag_keys)

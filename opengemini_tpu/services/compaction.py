@@ -26,6 +26,8 @@ class CompactionService(Service):
             return 0
         n = 0
         for db in list(self.engine.databases.values()):
-            for shard in db.all_shards():
+            # opened shards only: cold lazy shards have no fresh
+            # flushes; they join the plan once a query opens them
+            for shard in db.opened_shards():
                 n += Compactor(shard, self.fanout).run_once()
         return n

@@ -39,8 +39,16 @@ class HierarchicalStorageService(Service):
                 db = self.engine.database(db_name)
             except KeyError:
                 continue
-            for gi, shard in list(db.shards.items()):
-                if shard.end_time > cutoff:
+            # end_time is derivable from the group index — only
+            # shards COLD ENOUGH to move materialize (they must open
+            # for the detach anyway); warm shards stay lazy
+            sd = db.opts.shard_duration
+            with db._lock:
+                move_gis = [gi for gi in sorted(db.shards)
+                            if (gi + 1) * sd <= cutoff]
+            for gi in move_gis:
+                shard = db.shard_for_time(gi * sd, create=False)
+                if shard is None or shard.end_time > cutoff:
                     continue            # still warm
                 try:
                     shard.flush()

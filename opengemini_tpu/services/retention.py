@@ -39,11 +39,16 @@ class RetentionService(Service):
                 continue
             cutoff = now - rp.duration_ns
             db = self.engine.databases[db_name]
-            for shard in db.all_shards():
-                if shard.end_time <= cutoff:
+            # end_time derives from the group index — expired shards
+            # drop WITHOUT materializing (lazy open stays lazy)
+            sd = db.opts.shard_duration
+            with db._lock:
+                gis = sorted(db.shards)
+            for gi in gis:
+                if (gi + 1) * sd <= cutoff:
                     log.info("retention: dropping shard %d of %s "
-                             "(end %d <= cutoff %d)", shard.shard_id,
-                             db_name, shard.end_time, cutoff)
-                    db.drop_shard(shard.shard_id)
+                             "(end %d <= cutoff %d)", gi, db_name,
+                             (gi + 1) * sd, cutoff)
+                    db.drop_shard(gi)
                     dropped += 1
         return dropped

@@ -73,10 +73,13 @@ class _DestWriter:
         for t in self._threads:
             t.start()
 
-    def submit(self, db: str, body: bytes) -> bool:
+    def submit(self, db: str, batch: "_Batch") -> bool:
         from ..utils.stats import bump
+        if self._stop.is_set():
+            bump(SUB_STATS, "dropped")     # racing prune/stop: counted
+            return False
         try:
-            self._q.put_nowait((db, body))
+            self._q.put_nowait((db, batch))
             bump(SUB_STATS, "queued")
             return True
         except queue.Full:
@@ -98,7 +101,8 @@ class _DestWriter:
                 continue
             if item is None:
                 return
-            db, body = item
+            db, batch = item
+            body = batch.body()      # encode once, in a worker
             delay = self.backoff_s
             for attempt in range(self.attempts):
                 try:
@@ -124,6 +128,7 @@ class _DestWriter:
         urllib.request.urlopen(req, timeout=10)
 
     def stop(self) -> None:
+        from ..utils.stats import bump
         self._stop.set()          # workers exit via the timed get
         for _ in self._threads:
             try:
@@ -132,15 +137,49 @@ class _DestWriter:
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        # leftover items will never send: account them as drops
+        leftover = 0
+        try:
+            while True:
+                if self._q.get_nowait() is not None:
+                    leftover += 1
+        except queue.Empty:
+            pass
+        if leftover:
+            bump(SUB_STATS, "dropped", leftover)
+
+
+class _Batch:
+    """One write batch with LAZY line-protocol encoding: the hot write
+    path queues rows untouched; the FIRST worker to need the body
+    encodes it (shared across all destinations of the batch)."""
+
+    __slots__ = ("db", "rows", "_body", "_lock")
+
+    def __init__(self, db: str, rows: list):
+        self.db = db
+        self.rows = rows
+        self._body = None
+        self._lock = threading.Lock()
+
+    def body(self) -> bytes:
+        with self._lock:
+            if self._body is None:
+                self._body = rows_to_lp(self.rows).encode()
+                self.rows = None
+            return self._body
 
 
 class SubscriberService:
     """Hooks engine writes; lazily builds one _DestWriter per
-    (destination) and routes ALL/ANY per subscription."""
+    (destination) and routes ALL/ANY per subscription. A janitor
+    thread reaps pools for destinations no subscription references
+    (prune must not depend on further writes arriving)."""
 
     def __init__(self, engine, catalog, max_queue: int = 1000,
                  workers_per_dest: int = 2, attempts: int = 3,
-                 backoff_s: float = 0.1, send_fn=None):
+                 backoff_s: float = 0.1, send_fn=None,
+                 prune_interval_s: float = 5.0):
         self.engine = engine
         self.catalog = catalog
         self.max_queue = max_queue
@@ -148,6 +187,8 @@ class SubscriberService:
         self.attempts = attempts
         self.backoff_s = backoff_s
         self._send_fn = send_fn
+        self.prune_interval_s = prune_interval_s
+        self._janitor = None
         self._writers: dict[str, _DestWriter] = {}
         self._rr: dict[str, int] = {}
         self._lock = threading.Lock()
@@ -156,6 +197,16 @@ class SubscriberService:
 
     def start(self) -> None:
         self._started = True
+        self._janitor = threading.Thread(target=self._janitor_loop,
+                                         name="subscriber-janitor",
+                                         daemon=True)
+        self._janitor.start()
+
+    def _janitor_loop(self) -> None:
+        while self._started:
+            time.sleep(self.prune_interval_s)
+            if self._started:
+                self._prune_writers()
 
     def stop(self) -> None:
         with self._lock:
@@ -199,7 +250,7 @@ class SubscriberService:
         subs = self.catalog.subscriptions_for(db)
         if not subs:
             return
-        body = rows_to_lp(rows).encode()
+        batch = _Batch(db, rows)
         for sub in subs:
             dests = sub.destinations
             if not dests:
@@ -213,5 +264,4 @@ class SubscriberService:
             for d in dests:
                 w = self._writer(d)
                 if w is not None:
-                    w.submit(db, body)
-        self._prune_writers()
+                    w.submit(db, batch)

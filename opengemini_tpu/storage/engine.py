@@ -39,6 +39,12 @@ class EngineOptions:
     wal_compression: str = "zstd"     # "zstd" | "lz4" (native codec)
     segment_size: int = SEGMENT_SIZE
     obs_store: object | None = None   # hierarchical cold tier (obs.py)
+    # lazy shard open (reference engine.go:780 openShardLazy): startup
+    # discovers shard dirs without replaying their WALs / loading their
+    # indexes; a shard materializes on first access. The NEWEST
+    # `preload_shards` open eagerly — the warm tier dashboards hit
+    lazy_shard_open: bool = True
+    preload_shards: int = 2
 
 
 class Database:
@@ -93,7 +99,25 @@ class Database:
             m = re.fullmatch(r"shard_(-?\d+)", fn)
             if m:
                 gi = int(m.group(1))
+                # placeholder: WAL replay + index load deferred to
+                # first access (lazy open, engine.go:780 role)
+                self.shards[gi] = None
+        if not self.opts.lazy_shard_open:
+            for gi in list(self.shards):
                 self.shards[gi] = self._open_shard(gi)
+            return
+        # warm tier: the newest shards preload eagerly
+        n_pre = max(self.opts.preload_shards, 0)
+        if n_pre:
+            for gi in sorted(self.shards)[-n_pre:]:
+                self.shards[gi] = self._open_shard(gi)
+
+    def _shard(self, gi: int) -> Shard:
+        """Materialize a lazily-discovered shard (idempotent)."""
+        s = self.shards.get(gi)
+        if s is None:
+            s = self.shards[gi] = self._open_shard(gi)
+        return s
 
     def _open_shard(self, gi: int) -> Shard:
         sd = self.opts.shard_duration
@@ -110,9 +134,11 @@ class Database:
     def shard_for_time(self, t: int, create: bool = True) -> Shard | None:
         gi = t // self.opts.shard_duration
         with self._lock:
-            s = self.shards.get(gi)
-            if s is None and create:
-                s = self.shards[gi] = self._open_shard(gi)
+            if gi in self.shards:
+                return self._shard(gi)
+            if not create:
+                return None
+            s = self.shards[gi] = self._open_shard(gi)
             return s
 
     def drop_shard(self, gi: int) -> None:
@@ -120,12 +146,17 @@ class Database:
         with self._lock:
             # pop + rmtree under the lock so shard_for_time cannot recreate
             # the directory mid-delete (a later write re-creates it fresh)
+            present = gi in self.shards
             s = self.shards.pop(gi, None)
             if s is not None:
                 # keep TSSP mmaps open: in-flight queries may still hold the
                 # readers; they close via GC (unlinked data stays readable)
                 s.close(close_files=False)
                 shutil.rmtree(s.path, ignore_errors=True)
+            elif present:
+                # lazily-discovered, never materialized: remove the dir
+                shutil.rmtree(os.path.join(self.path, f"shard_{gi}"),
+                              ignore_errors=True)
 
     def shards_overlapping(self, t_min: int, t_max: int) -> list[Shard]:
         """Time-pruned shard selection (reference shard_mapper.go:74-117)."""
@@ -133,12 +164,40 @@ class Database:
         lo = t_min // sd
         hi = t_max // sd
         with self._lock:
-            return [self.shards[gi] for gi in sorted(self.shards)
-                    if lo <= gi <= hi]
+            gis = [gi for gi in sorted(self.shards) if lo <= gi <= hi]
+        out = []
+        for gi in gis:                    # per-shard lock granularity
+            with self._lock:
+                if gi in self.shards:
+                    out.append(self._shard(gi))
+        return out
 
     def all_shards(self) -> list[Shard]:
+        # snapshot ids under the lock, materialize per shard so each
+        # cold open (WAL replay + index load) holds the lock alone —
+        # concurrent writes/queries interleave between opens
         with self._lock:
-            return [self.shards[gi] for gi in sorted(self.shards)]
+            gis = sorted(self.shards)
+        out = []
+        for gi in gis:
+            with self._lock:
+                if gi in self.shards:      # racing drop_shard
+                    out.append(self._shard(gi))
+        return out
+
+    def opened_shards(self) -> list[Shard]:
+        """Materialized shards only — for periodic services and stats
+        that must not defeat lazy open by touching cold shards."""
+        with self._lock:
+            return [s for _gi, s in sorted(self.shards.items())
+                    if s is not None]
+
+    def discovered_shards(self) -> list[tuple[int, bool]]:
+        """(shard group index, opened) without materializing anything —
+        observability for the lazy tier."""
+        with self._lock:
+            return [(gi, self.shards[gi] is not None)
+                    for gi in sorted(self.shards)]
 
 
 class Engine:
@@ -428,5 +487,8 @@ class Engine:
 
     def close(self) -> None:
         for db in list(self.databases.values()):
-            for s in db.all_shards():
+            with db._lock:
+                opened = [s for s in db.shards.values()
+                          if s is not None]
+            for s in opened:     # never materialize a shard to close it
                 s.close()

@@ -158,8 +158,9 @@ def engine_collector(engine):
         n_files = 0
         for db in dbs:
             try:
-                for s in engine.database(db).all_shards():
-                    n_shards += 1
+                dbo = engine.database(db)
+                n_shards += len(dbo.discovered_shards())
+                for s in dbo.opened_shards():
                     n_files += len(getattr(s, "_tables", {}) or {})
             except KeyError:
                 continue

@@ -295,3 +295,60 @@ def test_write_record_type_coercion_and_conflict(tmp_path):
         eng.write_record("db0", "m", {}, t + 30,
                          {"c": np.array([1.5, 2.5])})
     eng.close()
+
+
+def test_lazy_shard_open_and_warm_preload(tmp_path):
+    """Reopen discovers shard dirs without materializing them
+    (engine.go:780 openShardLazy role); the newest preload_shards open
+    eagerly; a query materializes exactly the overlapping shards; drop
+    of a never-opened shard removes its directory."""
+    import os
+
+    import numpy as np
+
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    H = 3600 * 10**9
+    opts = EngineOptions(shard_duration=H, preload_shards=1)
+    eng = Engine(str(tmp_path / "d"), opts)
+    eng.create_database("db0")
+    for h in range(4):                      # four shard groups
+        t = np.array([h * H + 1], dtype=np.int64)
+        eng.write_record("db0", "m", {"k": "a"}, t,
+                         {"v": np.array([float(h)])})
+    eng.flush_all()
+    eng.close()
+
+    eng = Engine(str(tmp_path / "d"), opts)
+    db = eng.database("db0")
+    states = dict(db.discovered_shards())
+    assert len(states) == 4
+    assert states[3] is True                # warm tier preloaded
+    assert [gi for gi, opened in states.items() if not opened] \
+        == [0, 1, 2]
+    # a bounded query materializes only the overlapping shard
+    shards = db.shards_overlapping(1 * H, 2 * H - 1)
+    assert [s.shard_id for s in shards] == [1]
+    states = dict(db.discovered_shards())
+    assert states[1] is True and states[0] is False
+    # data correct through the lazy open
+    res = eng.scan_series("db0", "m")
+    vals = sorted(float(rec.column("v").get(0))
+                  for _s, _sid, rec in res)
+    assert vals == [0.0, 1.0, 2.0, 3.0]
+    # drop of a never-opened shard removes its directory
+    eng2 = Engine(str(tmp_path / "d2"), opts)
+    eng2.create_database("db0")
+    for h in range(3):
+        t = np.array([h * H + 1], dtype=np.int64)
+        eng2.write_record("db0", "m", {"k": "a"}, t,
+                          {"v": np.array([1.0])})
+    eng2.flush_all()
+    eng2.close()
+    eng2 = Engine(str(tmp_path / "d2"), opts)
+    db2 = eng2.database("db0")
+    assert dict(db2.discovered_shards())[0] is False
+    db2.drop_shard(0)
+    assert not os.path.isdir(str(tmp_path / "d2" / "db0" / "shard_0"))
+    eng.close()
+    eng2.close()
