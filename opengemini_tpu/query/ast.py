@@ -28,6 +28,13 @@ class Wildcard:
 
 
 @dataclass
+class RegexLit:
+    """Regex literal as a call argument — `mean(/usage.*/)` expands to
+    one call per matching field (influx regex field selection)."""
+    pattern: str
+
+
+@dataclass
 class Call:
     func: str
     args: list = field(default_factory=list)

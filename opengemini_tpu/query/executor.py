@@ -901,6 +901,10 @@ class QueryExecutor:
                     isinstance(d.expr, RegexDim)
                     for d in stmt.dimensions):
                 stmt = self._expand_regexes(stmt, db)
+            if self._has_call_field_patterns(stmt):
+                stmt = self._expand_call_fields(stmt, db)
+                if stmt is None:
+                    return {}
             mst = stmt.from_measurement
             cs = classify_select(stmt)
             # tag key universe for condition analysis — from the
@@ -1014,6 +1018,63 @@ class QueryExecutor:
                  "values": vals[lo:hi] if (stmt.limit or stmt.offset)
                  else vals})
         return {"series": out_series}
+
+    @staticmethod
+    def _has_call_field_patterns(stmt) -> bool:
+        from .ast import Call, RegexLit, Wildcard
+        return any(
+            isinstance(sf.expr, Call) and any(
+                isinstance(a, (Wildcard, RegexLit))
+                for a in sf.expr.args)
+            for sf in stmt.fields)
+
+    def _expand_call_fields(self, stmt, db: str | None):
+        """mean(*) / mean(/re/) → one call per matching NUMERIC field,
+        columns named <func>_<field> (influx wildcard/regex field
+        selection in calls). Returns the rewritten statement, or the
+        original when nothing expands."""
+        import re as _re
+        from dataclasses import replace as _rep
+
+        from ..record import DataType
+        from .ast import Call, FieldRef, RegexLit, SelectField, Wildcard
+        db2 = stmt.from_db or db
+        msts = [stmt.from_measurement] + [
+            s[2] if isinstance(s, tuple) else s
+            for s in stmt.extra_sources]
+        types: dict = {}
+        try:
+            for s in self.engine.database(db2).all_shards():
+                for m in msts:
+                    if m:
+                        types.update(s._schemas.get(m, {}))
+        except Exception:
+            types = {}
+        numeric = [k for k, t in sorted(types.items())
+                   if t in (DataType.FLOAT, DataType.INTEGER)]
+        fields = []
+        for sf in stmt.fields:
+            e = sf.expr
+            if not (isinstance(e, Call) and any(
+                    isinstance(a, (Wildcard, RegexLit))
+                    for a in e.args)):
+                fields.append(sf)
+                continue
+            pat = next(a for a in e.args
+                       if isinstance(a, (Wildcard, RegexLit)))
+            if isinstance(pat, RegexLit):
+                rx = _re.compile(pat.pattern)
+                names = [k for k in numeric if rx.search(k)]
+            else:
+                names = numeric
+            rest = [a for a in e.args if a is not pat]
+            for k in names:
+                fields.append(SelectField(
+                    Call(e.func, [FieldRef(k)] + list(rest)),
+                    sf.alias or f"{e.func}_{k}"))
+        if not fields:
+            return None
+        return _rep(stmt, fields=fields)
 
     def _expand_regexes(self, stmt, db: str | None):
         """FROM /re/ → matching measurements (multi-source union);

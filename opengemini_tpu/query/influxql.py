@@ -858,6 +858,13 @@ class Parser:
         if k == "op" and v == "*":
             self.lx.next()
             return Wildcard()
+        if k == "op" and v == "/":
+            # /regex/ as an expression (field-selecting call argument:
+            # mean(/usage.*/) — influx regex field selection)
+            rx = self.lx.try_regex()
+            if rx is not None:
+                from .ast import RegexLit
+                return RegexLit(rx)
         if k == "op" and v == "-":
             self.lx.next()
             e = self.parse_primary()

@@ -178,3 +178,47 @@ def test_parse_show_cardinality_family():
             ("SHOW FIELD KEYS", "field keys")]:
         (s,) = parse_query(text)
         assert s.what == what, text
+
+
+def test_wildcard_and_regex_call_expansion(tmp_path):
+    """mean(*) / mean(/re/) expand to one call per matching NUMERIC
+    field with influx's <func>_<field> column naming (regex field
+    selection in calls)."""
+    import numpy as np
+
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("d")
+    t = np.arange(4, dtype=np.int64) * 10**9
+    eng.write_record("d", "m", {"h": "a"},
+                     t, {"usage_user": np.arange(4.0),
+                         "usage_sys": np.arange(4.0) * 2})
+    for s in eng.database("d").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+
+    def run(q):
+        (stmt,) = parse_query(q)
+        r = ex.execute(stmt, "d")
+        s0 = r["series"][0]
+        return s0["columns"], s0["values"]
+
+    cols, vals = run("SELECT mean(*) FROM m")
+    assert cols == ["time", "mean_usage_sys", "mean_usage_user"]
+    assert vals == [[0, 3.0, 1.5]]
+    cols, vals = run("SELECT max(/user/) FROM m")
+    # sole windowless selector: the row carries the selected
+    # point's timestamp (influx selector semantics)
+    assert cols == ["time", "max_usage_user"]
+    assert vals == [[3 * 10**9, 3.0]]
+    cols, vals = run("SELECT percentile(/usage.*/, 50) FROM m")
+    assert cols == ["time", "percentile_usage_sys",
+                    "percentile_usage_user"]
+    # windowed expansion
+    cols, vals = run("SELECT mean(/sys/) FROM m WHERE time >= 0 AND "
+                     "time < 4s GROUP BY time(2s)")
+    assert cols == ["time", "mean_usage_sys"]
+    assert vals == [[0, 1.0], [2 * 10**9, 5.0]]
+    eng.close()
