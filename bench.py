@@ -734,8 +734,11 @@ def main():
         line = run_phase(name, timeout=remaining() - 90)
         if line:
             print(line, flush=True)
+            # the driver parses the LAST JSON line: re-assert the
+            # headline after every auxiliary so a kill at ANY point
+            # leaves the headline last on stdout
+            print(headline, flush=True)
 
-    # the driver parses the LAST JSON line: always the headline
     print(headline, flush=True)
 
 
