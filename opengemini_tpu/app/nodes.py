@@ -112,14 +112,17 @@ class TsStore:
 
     def start(self):
         self.node.start()
-        self.node.node_id = self.meta.create_node(self.node.addr,
-                                                  role=self.role)
         # per-PT raft replication plane (reference partition_raft.go):
         # groups materialize lazily on replicated writes; restarts
-        # rejoin persisted groups
+        # rejoin persisted groups. Attached BEFORE the node registers
+        # with meta: once registered it can be routed to, and a scan
+        # served with replication=None would skip the read-barrier
+        # soundness check and could return unflagged stale data
         from ..cluster.replication import ReplicationManager
         self.node.replication = ReplicationManager(
             self.node, self.meta, self.node.engine.path)
+        self.node.node_id = self.meta.create_node(self.node.addr,
+                                                  role=self.role)
         self.node.replication.reopen_local_groups()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
@@ -154,11 +157,15 @@ class TsSql:
 
     def __init__(self, meta_addrs: list[str], host: str = "127.0.0.1",
                  http_port: int = 0, flight_port: int | None = None,
-                 flight_users: dict[str, str] | None = None):
+                 flight_users: dict[str, str] | None = None,
+                 config=None):
         self.meta = MetaClient(meta_addrs)
         self.facade = ClusterFacade(self.meta)
+        # config (utils.config.Config) wires the [data] request budgets
+        # and max_failed_stores tolerance into the HTTP layer/executor
         self.http = HttpServer(self.facade, host=host, port=http_port,
-                               executor=self.facade.executor)
+                               executor=self.facade.executor,
+                               config=config)
         # columnar ingest plane (reference: arrowflight service on ts-sql)
         self.flight = None
         if flight_port is not None:
@@ -194,9 +201,10 @@ class TsServer:
 
     def __init__(self, data_dir: str, host: str = "127.0.0.1",
                  http_port: int = 0, opts: EngineOptions | None = None,
-                 with_meta: bool = True):
+                 with_meta: bool = True, config=None):
         self.engine = Engine(f"{data_dir}/store", opts)
-        self.http = HttpServer(self.engine, host=host, port=http_port)
+        self.http = HttpServer(self.engine, host=host, port=http_port,
+                               config=config)
         self.ts_meta = (TsMeta(data_dir=f"{data_dir}/meta", host=host)
                         if with_meta else None)
         self.meta_client: MetaClient | None = None
@@ -245,11 +253,13 @@ class TsData:
     def __init__(self, data_dir: str, meta_addrs: list[str],
                  host: str = "127.0.0.1", http_port: int = 0,
                  opts: EngineOptions | None = None,
-                 heartbeat_s: float = HEARTBEAT_S, role: str = "both"):
+                 heartbeat_s: float = HEARTBEAT_S, role: str = "both",
+                 config=None):
         self.store = TsStore(data_dir, meta_addrs, host=host,
                              opts=opts, heartbeat_s=heartbeat_s,
                              role=role)
-        self.sql = TsSql(meta_addrs, host=host, http_port=http_port)
+        self.sql = TsSql(meta_addrs, host=host, http_port=http_port,
+                         config=config)
 
     @property
     def http(self):

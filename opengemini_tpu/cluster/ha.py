@@ -21,7 +21,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .meta_data import PT_OFFLINE, PT_ONLINE, STATUS_ALIVE, STATUS_FAILED
 from .transport import RPCClient, RPCError
 
@@ -54,9 +54,14 @@ class MigrateStateMachine:
     offline (operator-visible) rather than flapping.
     """
 
-    def __init__(self, meta_client, max_attempts: int = 3):
+    def __init__(self, meta_client, max_attempts: int = 3,
+                 retry_pause_s: float = 0.5):
         self.meta = meta_client
         self.max_attempts = max_attempts
+        # pause between attempts: an instantaneous burst can be eaten
+        # whole by a target's open circuit breaker before its next
+        # probe; half a second lets the probe happen
+        self.retry_pause_s = retry_pause_s
         self._clients: dict[str, RPCClient] = {}
         self._lock = threading.Lock()
 
@@ -83,6 +88,10 @@ class MigrateStateMachine:
         while ev.attempts < self.max_attempts:
             ev.attempts += 1
             try:
+                # fault injection: a migrate step fails inside the retry
+                # loop — with maxhits=N the event recovers on attempt
+                # N+1; without, the PT parks offline (operator-visible)
+                failpoint.inject("ha.migrate.err")
                 self.meta.apply({"op": "set_pt_status", "db": ev.db,
                                  "pt_id": ev.pt_id, "status": PT_OFFLINE})
                 self._client(target.addr).call(
@@ -95,10 +104,12 @@ class MigrateStateMachine:
                          ev.pt_id, ev.from_node, ev.to_node)
                 ev.done.set()
                 return True
-            except (RPCError, OSError) as e:
+            except (RPCError, OSError, failpoint.FailpointError) as e:
                 ev.error = str(e)
                 log.warning("migrate %s/pt%d attempt %d failed: %s",
                             ev.db, ev.pt_id, ev.attempts, e)
+                if ev.attempts < self.max_attempts:
+                    time.sleep(self.retry_pause_s)
         log.error("migrate %s/pt%d gave up after %d attempts (pt stays "
                   "offline)", ev.db, ev.pt_id, ev.attempts)
         ev.done.set()
@@ -134,6 +145,11 @@ class ClusterManager:
         # started: after leadership change / process resume, stores need
         # one heartbeat round before their timestamps mean anything
         self._grace_until_ns = now_fn() + int(failure_timeout_s * 1e9)
+        # per-PT redrive backoff: a parked PT whose retry keeps failing
+        # (e.g. load_pt hangs on a disk fault) must not block every
+        # sweep — each PT gets one migrate burst per backoff window
+        self._redrive_after: dict[tuple, float] = {}
+        self.redrive_backoff_s = 10.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -165,6 +181,9 @@ class ClusterManager:
         heartbeat timestamps."""
         if now_ns < self._grace_until_ns:
             return []
+        # fault injection: a failed sweep pass must never kill the
+        # detector loop (_loop catches and logs, like any sweep error)
+        failpoint.inject("ha.sweep.err")
         # heartbeat applies don't push snapshots to clients — pull a
         # fresh catalog or every node looks stale
         self.meta.refresh()
@@ -174,7 +193,11 @@ class ClusterManager:
         stale = [n for n in alive
                  if now_ns - n.last_heartbeat >= timeout_ns]
         if not stale:
-            return []
+            # no new failures: re-drive parked partitions (reference
+            # processFailedDbPt retry, cluster_manager.go:482) — a PT
+            # left OFFLINE by an exhausted migrate (its target was dead
+            # too) comes back once its owner or a replica rejoins
+            return self._redrive_parked(md, {n.id for n in alive})
         # mass-staleness guard: when MOST nodes look dead at once, the
         # likely fault is on OUR side (meta partition / suspended leader
         # / stalled heartbeat processing) — cascading takeover would
@@ -195,7 +218,40 @@ class ClusterManager:
             events.extend(self._takeover(node.id))
         return events
 
+    def _redrive_parked(self, md, alive_ids: set) -> list[MigrateEvent]:
+        """Retry OFFLINE partitions whose owner or a replica is alive
+        again. Safe to run every sweep: migrations execute synchronously
+        in this (leader-only) sweep thread, so a PT can never be seen
+        OFFLINE here while a takeover for it is still in flight."""
+        events: list[MigrateEvent] = []
+        now = time.monotonic()
+        for db, pts in md.pts.items():
+            for pt in pts:
+                if pt.status == PT_ONLINE:
+                    continue
+                key = (db, pt.pt_id)
+                if now < self._redrive_after.get(key, 0.0):
+                    continue
+                cands = [pt.owner] + [r for r in pt.replicas
+                                      if r != pt.owner]
+                target = next((c for c in cands if c in alive_ids), None)
+                if target is None:
+                    continue
+                log.warning("re-driving parked %s/pt%d -> node %d",
+                            db, pt.pt_id, target)
+                ev = MigrateEvent(db=db, pt_id=pt.pt_id,
+                                  from_node=pt.owner, to_node=target)
+                if self.msm.execute(ev):
+                    self._redrive_after.pop(key, None)
+                else:
+                    self._redrive_after[key] = \
+                        time.monotonic() + self.redrive_backoff_s
+                events.append(ev)
+        return events
+
     def _takeover(self, failed_node: int) -> list[MigrateEvent]:
+        # fault injection: stall takeover (slow-failover chaos window)
+        failpoint.inject("ha.takeover.delay")
         self.meta.refresh()
         md = self.meta.data()
         alive = {n.id for n in md.alive_nodes()}
@@ -219,8 +275,24 @@ class ClusterManager:
                 # until it rejoins), else least-loaded alive node
                 # (reference cluster_manager node choice :438)
                 cands = [r for r in pt.replicas if r in alive]
-                target = (cands[0] if cands
-                          else min(sorted(alive), key=lambda n: load[n]))
+                if cands:
+                    target = cands[0]
+                elif pt.replicas:
+                    # REPLICATED pt with no live data member: park it
+                    # OFFLINE (typed "partitions unavailable" errors)
+                    # rather than hand routing to a non-member whose
+                    # empty engine would serve silently-wrong results;
+                    # _redrive_parked restores it when a member rejoins
+                    log.error(
+                        "%s/pt%d: no live replica to take over — "
+                        "parking offline until a data member rejoins",
+                        db, pt.pt_id)
+                    self.meta.apply({"op": "set_pt_status", "db": db,
+                                     "pt_id": pt.pt_id,
+                                     "status": PT_OFFLINE})
+                    continue
+                else:
+                    target = min(sorted(alive), key=lambda n: load[n])
                 load[target] = load.get(target, 0) + 1
                 ev = MigrateEvent(db=db, pt_id=pt.pt_id,
                                   from_node=failed_node, to_node=target)

@@ -378,7 +378,19 @@ class MetaData:
         reference migrate_state_machine.go assign/move events)."""
         for pt in self.pts.get(cmd["db"], []):
             if pt.pt_id == cmd["pt_id"]:
+                old = pt.owner
                 pt.owner = cmd["to_node"]
+                if old != pt.owner and pt.owner in pt.replicas:
+                    # replica promotion keeps the DATA-MEMBERSHIP set
+                    # (owner + replicas) stable: the displaced owner
+                    # takes the promoted replica's slot. Without this,
+                    # a takeover shrinks the raft group's member view
+                    # to {new owner} and the old owner can never
+                    # rejoin after restart — the group stays below
+                    # quorum and replicated writes to the PT hang
+                    # forever instead of healing
+                    pt.replicas = [old if r == pt.owner else r
+                                   for r in pt.replicas]
                 pt.status = cmd.get("status", PT_ONLINE)
                 return True
         return False

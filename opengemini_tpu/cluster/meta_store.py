@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .meta_data import MetaData
 from .raft import NotLeader, RaftNode
 from .transport import RPCClient, RPCError, RPCServer
@@ -58,6 +58,9 @@ class MetaServer:
 
     # client-facing handlers
     def _on_apply(self, body):
+        # fault injection: this voter rejects the mutation (the client's
+        # meta-addr retry loop must route around it)
+        failpoint.inject("meta.apply.err")
         try:
             cmd = body["cmd"]
             if cmd.get("op") in ("heartbeat", "create_node"):
@@ -86,6 +89,8 @@ class MetaServer:
         return None
 
     def _on_snapshot(self, body):
+        # fault injection: slow catalog pulls (stale-cache chaos window)
+        failpoint.inject("meta.snapshot.delay")
         # read raft state BEFORE taking _data_lock: raft paths acquire
         # raft._lock → _data_lock (fsm hooks), so taking _data_lock first
         # and then touching raft would invert the order and deadlock

@@ -13,8 +13,8 @@ from __future__ import annotations
 import threading
 
 from ..storage.rows import PointRow
-from ..utils import failpoint, get_logger
-from ..utils.errors import GeminiError
+from ..utils import deadline, failpoint, get_logger
+from ..utils.errors import ErrQueryTimeout, GeminiError
 from .hashing import series_hash, shard_key_of  # noqa: F401 (re-export)
 from .meta_store import MetaClient
 from .store_node import rows_to_wire
@@ -82,10 +82,14 @@ class PointsWriter:
         """Ship one payload per (addr, pt, owner) concurrently with
         refresh-and-retry (shared by the row and line-bytes writers —
         the subtle owner re-resolution lives ONCE). Raises
-        ErrPartialWrite when any target exhausts its retries."""
+        ErrPartialWrite when any target exhausts its retries. The
+        per-batch RPC timeout is clamped by the write budget bound in
+        the dispatching thread (utils.deadline): retries spend the
+        REMAINING budget, never a fresh timeout each attempt."""
         written = 0
         errors: list[str] = []
         lock = threading.Lock()
+        dl = deadline.current()   # capture BEFORE the thread fan-out
 
         def send(addr: str, pt: int, owner_id: int, src):
             nonlocal written
@@ -97,17 +101,29 @@ class PointsWriter:
                 # engine db (they'd be invisible to queries)
                 wire = make_wire(pt, owner_id, src)
                 try:
-                    resp = self._client(addr).call(msg, wire)
+                    t = dl.clamp(60.0) if dl is not None else 60.0
+                    resp = self._client(addr).call(msg, wire, timeout=t)
                     with lock:
                         written += resp["written"]
                     return
+                except ErrQueryTimeout as e:
+                    last = e
+                    break             # budget gone: retrying cannot help
                 except RPCError as e:
                     last = e
+                    if dl is not None and dl.expired:
+                        break
                     # partition may have moved: re-resolve the owner
                     self.meta.refresh()
                     owner = self.meta.data().pt_owner(db, pt)
                     if owner is not None:
                         addr, owner_id = owner.addr, owner.id
+                except Exception as e:  # noqa: BLE001 — a dying worker
+                    # (e.g. a failpoint armed with action=error) must
+                    # land in `errors`: a thread that vanishes before
+                    # errors.append would turn lost rows into a 204 ack
+                    last = e
+                    break
             with lock:
                 errors.append(f"pt {pt} @ {addr}: {last}")
 

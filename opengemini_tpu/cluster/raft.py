@@ -28,7 +28,7 @@ import random
 import threading
 import time
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .transport import RPCClient, RPCError, RPCServer
 
 log = get_logger(__name__)
@@ -106,6 +106,13 @@ class RaftNode:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._last_heard = time.monotonic()
+        # startup fence for the leader lease (ADVICE r5): leadership_held
+        # assumes a peer that recently acked cannot vote for a
+        # challenger, but a RESTARTED peer loses its leader_id and
+        # _last_heard, so the stickiness check alone cannot protect the
+        # old leader's lease. Votes are refused for ELECTION_MIN after
+        # startup regardless of leader_id (see _on_request_vote).
+        self._started_at = time.monotonic()
         self._clients: dict[str, RPCClient] = {}
         self._repl_wake: dict[str, threading.Event] = {}
 
@@ -258,6 +265,8 @@ class RaftNode:
             ev.set()
 
     def _run_election(self):
+        # fault injection: stall candidacy (split-vote / slow-CPU chaos)
+        failpoint.inject("raft.election.delay")
         with self._lock:
             self.state = CANDIDATE
             self.term += 1
@@ -356,6 +365,17 @@ class RaftNode:
             if (self.state == FOLLOWER and self.leader_id is not None
                     and body["term"] > self.term
                     and time.monotonic() - self._last_heard
+                    < ELECTION_MIN):
+                return {"term": self.term, "granted": False}
+            # restart lease hole (ADVICE r5): a freshly-(re)started node
+            # has leader_id None, so the stickiness check above cannot
+            # protect a live leader's lease — yet that leader may hold a
+            # lease anchored on THIS node's pre-restart ack. Refuse all
+            # votes for ELECTION_MIN after startup, regardless of
+            # leader_id; at worst a cold cluster's first election slips
+            # one timeout.
+            if (body["term"] > self.term
+                    and time.monotonic() - self._started_at
                     < ELECTION_MIN):
                 return {"term": self.term, "granted": False}
             if body["term"] > self.term:
@@ -489,6 +509,11 @@ class RaftNode:
                         "entries": entries,
                         "leader_commit": self.commit_index}
                 kind = f"{self.msg_prefix}.append"
+        # fault injection: lose this replication exchange (the peer
+        # simply lags and the replicator retries — same as a dropped
+        # frame on the wire)
+        if failpoint.inject("raft.replicate.drop"):
+            raise RPCError("failpoint: raft.replicate.drop")
         t_sent = time.monotonic()
         resp = self._client(pid).call(kind, body, timeout=5.0)
         with self._lock:
@@ -560,6 +585,17 @@ class RaftNode:
         # Crash safety: the snapshot file lands atomically first; if we
         # die before the log rewrite, _load_state drops covered/duplicate
         # indexes via the per-entry idx fields.
+        # fault injection BEFORE any mutation: a failed compaction
+        # leaves log + snapshot exactly as they were and is NON-fatal —
+        # the commit that triggered it already applied; compaction
+        # simply retries at the next commit (a real snapshot-write
+        # failure behaves the same way)
+        try:
+            failpoint.inject("raft.snapshot.err")
+        except failpoint.FailpointError as e:
+            log.warning("raft %s: snapshot compaction failed "
+                        "(injected): %s", self.id, e)
+            return
         applied_off = self.last_applied - self.log_base
         if applied_off <= 0:
             return
@@ -603,6 +639,9 @@ class RaftNode:
         committed. Raises NotLeader with a redirect hint on followers."""
         from ..utils.stats import bump as _bump
         _bump(RAFT_STATS, "proposes")
+        # fault injection: proposal rejected before touching the log
+        # (callers see the same surface as a leaderless/failed propose)
+        failpoint.inject("raft.propose.err")
         with self._lock:
             if self.state != LEADER:
                 hint = self.peers.get(self.leader_id) \

@@ -25,7 +25,7 @@ from __future__ import annotations
 import os
 import threading
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .raft import NotLeader, RaftNode
 from .transport import RPCError
 
@@ -117,7 +117,13 @@ class ReplicationManager:
 
     def replicated(self, db: str, pt_id: int) -> bool:
         """True when the PT has replicas (replica_n > 1) — writes must
-        then commit through the raft group, not directly."""
+        then commit through the raft group, not directly.
+
+        FAIL-SAFE: when the partition is unknown even after a catalog
+        refresh (stale cache + meta unreachable), this RAISES instead
+        of answering False — a False here silently bypasses
+        replication, acking rows into one engine only; a takeover then
+        loses them with no flag (the worst failure mode there is)."""
         key = group_key(db, pt_id)
         with self._lock:
             if key in self.groups:
@@ -128,9 +134,14 @@ class ReplicationManager:
             try:
                 self.meta.refresh()
             except RPCError:
-                return False
+                pass        # refresh also degrades silently; re-check
             pt = self.meta.data().pt(db, pt_id)
-        return pt is not None and bool(pt.replicas)
+            if pt is None:
+                raise ValueError(
+                    f"unknown partition {db}/{pt_id}: catalog "
+                    f"unavailable — refusing to guess replication "
+                    f"membership")
+        return bool(pt.replicas)
 
     def _members(self, db: str, pt_id: int) -> dict[str, str]:
         """{node_id_str: store_addr} of the PT's raft members."""
@@ -184,6 +195,10 @@ class ReplicationManager:
 
     def _apply_rows(self, db: str, pt: int, rows_wire) -> int:
         """FSM apply — runs on every member when the entry commits."""
+        # fault injection: the committed batch fails to apply on THIS
+        # member's engine (the proposer sees the error; other members
+        # still applied — the divergence a real apply fault causes)
+        failpoint.inject("replication.apply.err")
         from .store_node import db_key, rows_from_wire
         return self.store.engine.write_points(
             db_key(db, pt), rows_from_wire(rows_wire))
@@ -191,20 +206,28 @@ class ReplicationManager:
     # -------------------------------------------------------------- write
 
     def read_barrier(self, db: str, pt_id: int,
-                     timeout: float = 5.0) -> None:
+                     timeout: float = 5.0) -> bool:
         """Follower-read barrier (raft read-index): before scanning a
         replicated partition, wait until this member has applied
         everything the group had COMMITTED at barrier time. The write
         path acks at the group leader's apply, so without this a scan
         routed to a follower PT owner can miss an acked write — the
-        read-your-writes contract map_pts documents (sql_node.py)."""
+        read-your-writes contract map_pts documents (sql_node.py).
+
+        Returns True when the barrier is SOUND (every member answered
+        and this member applied up to the group's max commit). False
+        means the scan may miss acked writes; callers must surface that
+        to the client as an explicit partial/degraded response — a log
+        line alone leaves silently-wrong data on the wire."""
         import time as _time
 
+        # fault injection: stall the barrier (stale-read chaos window)
+        failpoint.inject("replication.barrier.delay")
         key = group_key(db, pt_id)
         with self._lock:
             g = self.groups.get(key)
         if g is None:
-            return
+            return True
         r = g.raft
         deadline = _time.monotonic() + timeout
         # barrier target: MAX commit index over the group members.
@@ -230,7 +253,7 @@ class ReplicationManager:
             while r.last_applied < target_fast \
                     and _time.monotonic() < deadline:
                 _time.sleep(0.005)
-            return
+            return r.last_applied >= target_fast
         me = str(self.store.node_id)
         others = {pid: addr for pid, addr in r.peers.items()
                   if pid != me}                    # peers incl self
@@ -273,15 +296,24 @@ class ReplicationManager:
                         and r.leader_id is not None
                         and str(r.leader_id) in commits):
                     break
+            if rounds >= 3:
+                # members stayed unreachable across three ask rounds
+                # (e.g. a 2-member group whose peer died: quorum can
+                # NEVER be met) — degrade now, loudly, instead of
+                # burning the caller's whole budget re-asking a dead
+                # peer until the barrier deadline
+                break
             _time.sleep(0.25)
         with lock:
             target = max(commits.values())
             n_got = len(commits)
-        if n_got < n_members:
+        sound = n_got >= n_members
+        if not sound:
             # hearing from EVERY member is the only fully sound
             # majority-free condition (a locally-believed leader_id
             # can itself be stale); fewer responders means the true
             # leader may be among the unreachable — serve, but LOUDLY
+            # and flagged (the caller stamps the response degraded)
             log.warning(
                 "read barrier degraded on %s/pt%d: %d/%d members "
                 "reachable (believed leader %s) — scan may miss "
@@ -297,6 +329,8 @@ class ReplicationManager:
                 "read barrier timeout on %s/pt%d: applied=%d < "
                 "commit=%d — scan may miss recent writes",
                 db, pt_id, r.last_applied, target)
+            sound = False
+        return sound
 
     def has_group(self, db: str, pt_id: int) -> bool:
         with self._lock:
@@ -308,11 +342,23 @@ class ReplicationManager:
             g = self.groups.get(key)
         return g.raft.commit_index if g is not None else 0
 
-    def write(self, db: str, pt_id: int, rows_wire) -> int:
+    def write(self, db: str, pt_id: int, rows_wire,
+              forward: bool = True) -> int:
         """Replicated write: propose on the PT group; if this member is
         not the group leader, forward the write to the leader member's
         store (reference: raft messages routed between stores,
-        netstorage/storage.go:523)."""
+        netstorage/storage.go:523).
+
+        forward=False (the store.raft_write handler) bounds the chain
+        to ONE hop: under leadership flapping, two members that each
+        believe the other leads would otherwise forward back and forth
+        — every hop blocking a thread up to wait_leader's 5s — until
+        the caller's timeout, starving the box and prolonging the very
+        flapping that caused it. One hop, then a typed error the
+        writer retries."""
+        # fault injection: replicated-write path rejects the batch
+        # before the group propose (writer retry/refresh must handle)
+        failpoint.inject("replication.propose.err")
         g = self.ensure_group(db, pt_id, fanout=True)
         if g is None:
             raise ValueError(
@@ -321,6 +367,8 @@ class ReplicationManager:
         try:
             return g.propose_rows(rows_wire)
         except NotLeader:
+            if not forward:
+                raise
             leader = g.raft.wait_leader(5.0)
             if leader is None or leader == str(self.store.node_id):
                 raise

@@ -31,8 +31,8 @@ from ..query.incremental import (IncAggCache, complete_prefix,
                                  inc_fingerprint, inc_validate,
                                  trim_left, trim_right)
 from ..query.influxql import format_statement
-from ..utils import get_logger
-from ..utils.errors import ErrQueryError, GeminiError
+from ..utils import deadline, failpoint, get_logger
+from ..utils.errors import ErrQueryError, ErrQueryTimeout, GeminiError
 from .meta_store import MetaClient
 from .points_writer import PointsWriter
 from .transport import ClientPool, RPCClient, RPCError
@@ -43,12 +43,55 @@ log = get_logger(__name__)
 READER_ROUTING = __import__("os").environ.get(
     "OG_READER_ROUTING", "1") != "0"
 
+# how many store failures a scatter tolerates by default before the
+# query errors instead of degrading to a flagged partial result
+# (config: [data] max_failed_stores; influx partial-series analog)
+MAX_FAILED_STORES = int(__import__("os").environ.get(
+    "OG_MAX_FAILED_STORES", "0"))
+
+
+class ScatterResult(list):
+    """Gathered per-store responses. `failed` lists the stores whose
+    partitions are MISSING from the gather (tolerated failures): any
+    result built from a ScatterResult with failures must carry an
+    explicit `partial` flag — a silent partial is indistinguishable
+    from a complete result."""
+
+    def __init__(self, it=(), failed: list[str] | None = None):
+        super().__init__(it)
+        self.failed = list(failed or ())
+
+
+def _tag_partial(res: dict, *scatters, degraded: bool = False) -> dict:
+    """Stamp `partial: true` onto a result assembled from degraded
+    scatters (InfluxDB partial-response semantics, surfaced through
+    the HTTP layer untouched). Degradation is EITHER a tolerated
+    store failure (ScatterResult.failed), a store that answered but
+    with an unsound read barrier (response `degraded` flag — the scan
+    may miss acked writes), or a caller-known condition passed via
+    the `degraded` keyword."""
+    failed = [f for s in scatters for f in getattr(s, "failed", ())]
+    degraded = degraded or any(isinstance(r, dict) and r.get("degraded")
+                               for s in scatters for r in s)
+    if (failed or degraded) and isinstance(res, dict) \
+            and "error" not in res:
+        res = dict(res)
+        res["partial"] = True
+    return res
+
 
 class ClusterExecutor:
-    def __init__(self, meta: MetaClient, mesh=None):
+    def __init__(self, meta: MetaClient, mesh=None,
+                 max_failed_stores: int | None = None):
         self.meta = meta
         self._pool = ClientPool()
         self.inc_cache = IncAggCache()
+        # partial-result tolerance: scatter degrades (with an explicit
+        # partial flag) instead of failing when at most this many
+        # stores are down; 0 = fail cleanly (default)
+        self.max_failed_stores = (MAX_FAILED_STORES
+                                  if max_failed_stores is None
+                                  else max_failed_stores)
         # optional local device mesh: when set, grid-aligned per-store
         # partials merge ON DEVICE (psum of exact limb/count grids over
         # the data axis — parallel/meshquery.mesh_merge_partials)
@@ -112,27 +155,68 @@ class ClusterExecutor:
         return out
 
     def _scatter(self, msg: str, db: str, body_extra: dict,
-                 timeout: float = 120.0) -> list:
+                 timeout: float = 120.0,
+                 max_failed: int | None = None) -> ScatterResult:
         """Send one request per store node owning pts of db; gather.
         A store RPC failure refreshes the catalog and retries once —
         after a PT takeover the stale cache still routes to the dead
-        node (reference metaclient retry loops, meta_client.go)."""
+        node (reference metaclient retry loops, meta_client.go).
+
+        Deadline: the per-RPC timeout is clamped by the request budget
+        bound in the dispatching thread (utils.deadline) — a slow store
+        consumes the REMAINING budget, never a fresh `timeout` per hop;
+        an exhausted budget raises the typed ErrQueryTimeout.
+
+        Partial results: with max_failed > 0 (default: this executor's
+        max_failed_stores), up to that many stores may stay down after
+        the refresh+retry — their partitions are omitted and the
+        ScatterResult's `failed` list is non-empty, which callers MUST
+        surface as an explicit `partial` flag."""
+        if max_failed is None:
+            max_failed = self.max_failed_stores
+        dl = deadline.current()   # capture BEFORE the thread fan-out
         last_err = None
         for attempt in range(2):
+            if dl is not None:
+                dl.check("scatter")
             per_node = self.map_pts(db)
             results: list = [None] * len(per_node)
+            ok = [False] * len(per_node)
             errors: list[str] = []
+            timed_out: list[str] = []
             lock = threading.Lock()
 
             def run(i: int, addr: str, pts: list[int],
-                    results=results, errors=errors, lock=lock):
+                    results=results, ok=ok, errors=errors,
+                    timed_out=timed_out, lock=lock):
                 try:
+                    failpoint.inject("sql.scatter.delay")
+                    if failpoint.inject("sql.scatter.drop"):
+                        raise RPCError("failpoint: sql.scatter.drop")
+                    t = dl.clamp(timeout) if dl is not None else timeout
                     body = {"db": db, "pts": pts, **body_extra}
                     results[i] = self._client(addr).call(msg, body,
-                                                         timeout=timeout)
-                except RPCError as e:
+                                                         timeout=t)
+                    ok[i] = True
+                except ErrQueryTimeout as e:
                     with lock:
-                        errors.append(f"{addr}: {e}")
+                        timed_out.append(str(e))
+                except RPCError as e:
+                    # a store that ran out the request budget is a
+                    # deadline problem, not a failed-store problem —
+                    # partial tolerance must not mask it
+                    with lock:
+                        if dl is not None and dl.expired:
+                            timed_out.append(f"{addr}: {e}")
+                        else:
+                            errors.append(f"{addr}: {e}")
+                except Exception as e:  # noqa: BLE001 — a dying worker
+                    # (e.g. a failpoint armed with action=error) must
+                    # surface as a failed store, never as a silent
+                    # omission the gather would mistake for success
+                    with lock:
+                        errors.append(
+                            f"{addr}: {type(e).__name__}: {e}")
 
             threads = [threading.Thread(target=run, args=(i, a, p))
                        for i, (a, p) in enumerate(per_node.items())]
@@ -140,11 +224,25 @@ class ClusterExecutor:
                 t.start()
             for t in threads:
                 t.join()
+            if timed_out:
+                raise ErrQueryTimeout(
+                    "query deadline exceeded in scatter: "
+                    + "; ".join(timed_out[:3]))
             if not errors:
-                return [r for r in results if r is not None]
+                return ScatterResult(
+                    (r for i, r in enumerate(results)
+                     if ok[i] and r is not None))
             last_err = "; ".join(errors)
             if attempt == 0:
                 self.meta.refresh()
+        if any(ok) and len(errors) <= max_failed:
+            log.warning("scatter %s on %s degraded: tolerating %d "
+                        "failed store(s): %s", msg, db, len(errors),
+                        last_err)
+            return ScatterResult(
+                (r for i, r in enumerate(results)
+                 if ok[i] and r is not None),
+                failed=errors)
         raise ErrQueryError(last_err)
 
     # ------------------------------------------------------------- execute
@@ -201,7 +299,10 @@ class ClusterExecutor:
             import re as _re
             rx = _re.compile(stmt.from_regex)
             names: set = set()
-            for r in self._scatter("store.measurements", db, {}):
+            # regex expansion must see EVERY store's catalog — a
+            # partial union would silently drop whole measurements
+            for r in self._scatter("store.measurements", db, {},
+                                   max_failed=0):
                 names.update(r.get("measurements", ()))
             matched = sorted(n for n in names if rx.search(n))
             if not matched:
@@ -232,8 +333,9 @@ class ClusterExecutor:
                 merged = mesh_merge_partials(self.mesh, partials)
                 if merged is not None:
                     partials = [merged]
-            return finalize_partials(stmt, mst, cs, partials,
-                                     plan=plan_hints(stmt))
+            return _tag_partial(
+                finalize_partials(stmt, mst, cs, partials,
+                                  plan=plan_hints(stmt)), resps)
         if cs.mode == "agg":
             # plan chose a RAW exchange for an aggregate (degradation /
             # rule override): scatter plain scans of the aggregate's
@@ -248,14 +350,16 @@ class ClusterExecutor:
             q = format_statement(sub)
             resps = self._scatter("store.select_raw", db, {"q": q})
             merged = self._merge_raw(sub, resps, names)
-            return select_over_result(stmt, db, merged)
+            return _tag_partial(select_over_result(stmt, db, merged),
+                                resps)
         if cs.is_plain_raw:
             q = format_statement(stmt)
             resps = self._scatter("store.select_raw", db, {"q": q})
             field_order = (None if cs.has_wildcard
                            else [alias or name
                                  for name, alias in cs.raw_fields])
-            return self._merge_raw(stmt, resps, field_order)
+            return _tag_partial(self._merge_raw(stmt, resps, field_order),
+                                resps)
         # expression / transform raw mode: ship a plain scan of the
         # referenced fields (limits stripped — transforms change row
         # counts), merge, then materialize at the sql node (the
@@ -268,7 +372,8 @@ class ClusterExecutor:
         q = format_statement(sub)
         resps = self._scatter("store.select_raw", db, {"q": q})
         merged = self._merge_raw(sub, resps, names)
-        return transform_raw_result(cs, stmt, merged)
+        return _tag_partial(transform_raw_result(cs, stmt, merged),
+                            resps)
 
     def _select_agg_incremental(self, stmt, db, mst, cs,
                                 inc_query_id: str, iter_id: int) -> dict:
@@ -290,9 +395,14 @@ class ClusterExecutor:
             if cached_p is not None:
                 cached_p = trim_right(cached_p, cond.t_max)
 
+        degraded = False
+
         def scatter(s) -> list:
+            nonlocal degraded
             resps = self._scatter("store.select_partial", db,
                                   {"q": format_statement(s)})
+            if resps.failed or any(r.get("degraded") for r in resps):
+                degraded = True
             return [r["partial"] for r in resps]
 
         if cached_p is not None:
@@ -304,14 +414,20 @@ class ClusterExecutor:
             if not fresh:
                 # nothing at/after the watermark: serve the cached
                 # prefix, leave the entry untouched
-                return finalize_partials(stmt, mst, cs, [cached_p])
+                return _tag_partial(
+                    finalize_partials(stmt, mst, cs, [cached_p]),
+                    degraded=degraded)
             partial = merge_partials([cached_p] + fresh)
         else:
             partial = merge_partials(scatter(stmt))
         trimmed, watermark = complete_prefix(partial)
-        if trimmed is not None:
+        if trimmed is not None and not degraded:
+            # a degraded scatter must NEVER seed the incremental cache:
+            # the missing stores' windows would be served as "complete"
+            # forever after
             self.inc_cache.put(inc_query_id, fp, trimmed, watermark)
-        return finalize_partials(stmt, mst, cs, [partial])
+        return _tag_partial(finalize_partials(stmt, mst, cs, [partial]),
+                            degraded=degraded)
 
     def _merge_raw(self, stmt: SelectStatement, resps: list,
                    field_order: list[str] | None = None) -> dict:
@@ -407,19 +523,23 @@ class ClusterExecutor:
             if "error" in res:
                 return res
             sers = res.get("series", [])
+            # a degraded listing yields a degraded count — keep the flag
+            inner_partial = bool(res.get("partial"))
             if stmt.what in ("series cardinality",
                              "measurement cardinality"):
                 n = sum(len(s["values"]) for s in sers)
-                return {"series": [{
+                return _tag_partial({"series": [{
                     "name": stmt.what,
                     "columns": ["cardinality estimation"],
-                    "values": [[n]]}]}
+                    "values": [[n]]}]}, degraded=inner_partial)
             out = [{"name": s["name"], "columns": ["count"],
                     "values": [[len(s["values"])]]} for s in sers]
-            return {"series": out} if out else {}
+            return _tag_partial({"series": out} if out else {},
+                                degraded=inner_partial)
         # ship without LIMIT/OFFSET — they apply once, after the union
         q = format_statement(replace(stmt, limit=0, offset=0))
         resps = self._scatter("store.show", db, {"q": q})
+        show_partial = bool(resps.failed)
         # union values per series name across stores
         merged: dict[str, dict] = {}
         for resp in resps:
@@ -440,7 +560,8 @@ class ClusterExecutor:
         hi = lo + stmt.limit if stmt.limit else None
         for s in series_out:
             s["values"] = s["values"][lo:hi]
-        return {"series": series_out} if series_out else {}
+        out = {"series": series_out} if series_out else {}
+        return _tag_partial(out, degraded=show_partial)
 
     def _ddl(self, stmt, db: str | None) -> dict:
         """Scatter DROP MEASUREMENT / DELETE to every store owning PTs of
@@ -455,14 +576,15 @@ class ClusterExecutor:
             if self.meta.database(db) is None:
                 return {"error": f"database not found: {db}"}
         q = format_statement(stmt)
-        resps = self._scatter("store.ddl", db, {"q": q})
+        # DDL is all-or-error: a "partial DROP" would leave zombie data
+        resps = self._scatter("store.ddl", db, {"q": q}, max_failed=0)
         errs = [r.get("error", "ddl failed") for r in resps
                 if r and not r.get("ok", True)]
         return {"error": "; ".join(errs)} if errs else {}
 
     def _drop_database(self, name: str) -> dict:
         try:
-            self._scatter("store.drop_db", name, {})
+            self._scatter("store.drop_db", name, {}, max_failed=0)
         except ErrQueryError:
             pass                      # db may not exist on some stores
         self.meta.drop_database(name)
@@ -512,9 +634,12 @@ class ClusterFacade:
         if not info.shard_key:
             raise ErrQueryError(
                 f"database {db} has no shard key configured")
+        # bounds from a partial sample set would skew the ranges —
+        # require every store
         resps = self.executor._scatter(
             "store.split_points", db,
-            {"measurement": measurement, "shard_key": info.shard_key})
+            {"measurement": measurement, "shard_key": info.shard_key},
+            max_failed=0)
         samples = sorted(s for r in resps for s in r.get("samples", ()))
         n = info.num_pts
         bounds = [""]

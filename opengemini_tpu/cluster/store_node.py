@@ -28,7 +28,7 @@ from ..query.executor import (QueryExecutor, classify_select,
 from ..query.influxql import parse_query
 from ..storage.engine import Engine, EngineOptions
 from ..storage.rows import PointRow
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .transport import RPCServer
 
 log = get_logger(__name__)
@@ -91,11 +91,21 @@ class StoreNode:
         self.server.start()
 
     def stop(self) -> None:
-        if self.replication is not None:
-            self.replication.stop()
-        self._peers.close()
-        self.server.stop()
-        self.engine.close()
+        # shutdown is exception-safe stage by stage: a failure tearing
+        # down replication/peers must NEVER leave the listener bound
+        # (a restart on the same port would then fail EADDRINUSE) or
+        # the engine open
+        try:
+            if self.replication is not None:
+                self.replication.stop()
+        finally:
+            try:
+                self._peers.close()
+            finally:
+                try:
+                    self.server.stop()
+                finally:
+                    self.engine.close()
 
     def peer_call(self, addr: str, msg: str, body: dict,
                   timeout: float = 30.0):
@@ -149,6 +159,9 @@ class StoreNode:
         return {"samples": sorted(samples)}
 
     def _on_write(self, body):
+        # fault injection: store-side write failure AFTER transport
+        # succeeded (exercises writer retry with a healthy connection)
+        failpoint.inject("store.write.err")
         owner = body.get("owner")
         if (owner is not None and self.node_id is not None
                 and owner != self.node_id):
@@ -177,6 +190,8 @@ class StoreNode:
         columnar fast path ingests them; replicated partitions parse
         to rows and commit through the PT raft group so the FSM
         semantics stay row-based."""
+        failpoint.inject("store.write.err")   # same site as _on_write:
+        # one logical fault covers both store-side write planes
         owner = body.get("owner")
         if (owner is not None and self.node_id is not None
                 and owner != self.node_id):
@@ -208,10 +223,14 @@ class StoreNode:
         return {"member": g is not None}
 
     def _on_raft_write(self, body):
-        """Leader-forwarded replicated write (netstorage raft routing)."""
+        """Leader-forwarded replicated write (netstorage raft routing).
+        forward=False: one hop only — a deposed leader answers
+        NotLeader instead of bouncing the batch back (see
+        replication.write)."""
         if self.replication is None:
             raise ValueError("replication not enabled on this node")
-        n = self.replication.write(body["db"], body["pt"], body["rows"])
+        n = self.replication.write(body["db"], body["pt"], body["rows"],
+                                   forward=False)
         return {"written": n}
 
     def _parse_select(self, q: str) -> SelectStatement:
@@ -229,35 +248,55 @@ class StoreNode:
         return {"commit":
                 self.replication.commit_index(body["db"], body["pt"])}
 
-    def _read_barrier(self, db: str, pts: list[int]) -> None:
+    def _read_barrier(self, db: str, pts: list[int]) -> bool:
         """Replicated partitions: apply-catch-up before scanning
         (replication.read_barrier — read-your-writes on follower
         owners). Barriers run in parallel: a leaderless group must
-        not serialize its wait in front of the other partitions."""
+        not serialize its wait in front of the other partitions.
+        Returns True when EVERY barrier was sound; False means the
+        scan may miss acked writes and the response must say so."""
         if self.replication is None:
-            return
-        live = [pt for pt in pts
-                if self.replication.has_group(db, pt)]
+            return True
+        live = []
+        member_hole = False
+        for pt in pts:
+            if self.replication.has_group(db, pt):
+                live.append(pt)
+            elif db_key(db, pt) in self.engine.databases \
+                    and self.replication.replicated(db, pt):
+                # this store holds an engine db and the ROUTE for a
+                # replicated pt but is no raft member of it (stale
+                # routing / takeover races): it cannot prove the scan
+                # complete — flag rather than serve silently
+                member_hole = True
         if not live:
-            return
+            return not member_hole
         if len(live) == 1:
-            self.replication.read_barrier(db, live[0])
-            return
-        threads = [threading.Thread(
-            target=self.replication.read_barrier, args=(db, pt))
-            for pt in live]
+            return self.replication.read_barrier(db, live[0]) \
+                and not member_hole
+        sound = [True] * len(live)
+
+        def one(i: int, pt: int):
+            sound[i] = self.replication.read_barrier(db, pt)
+
+        threads = [threading.Thread(target=one, args=(i, pt))
+                   for i, pt in enumerate(live)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        return all(sound) and not member_hole
 
     def _on_select_partial(self, body):
         """Partial aggregation over this node's partitions of a db; the
         per-pt partials merge locally first (intra-node exchange) so one
         state grid travels back."""
+        # fault injection: a slow/failing store select — the sql node's
+        # deadline clamp (not a fresh per-hop timeout) bounds the wait
+        failpoint.inject("store.select.delay")
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
-        self._read_barrier(db, pts)
+        barrier_sound = self._read_barrier(db, pts)
         self.stats["selects"] += 1
         partials = []
         for pt in pts:
@@ -283,7 +322,13 @@ class StoreNode:
                                           tag_keys)
             if p is not None:
                 partials.append(p)
-        return {"partial": merge_partials(partials)}
+        out = {"partial": merge_partials(partials)}
+        if not barrier_sound:
+            # degraded barrier: the sql node must flag the merged
+            # result partial — a silent maybe-stale aggregate is
+            # indistinguishable from a correct one
+            out["degraded"] = True
+        return out
 
     def _on_select_raw(self, body):
         """Raw rows for non-aggregate selects. Row limits are applied at
@@ -291,9 +336,10 @@ class StoreNode:
         partitions only when there is no GROUP BY) — but are pushed down
         as a per-store cap when there is no OFFSET (reference
         LimitPushdown rules, heu_rule.go)."""
+        failpoint.inject("store.select.delay")
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
-        self._read_barrier(db, pts)
+        barrier_sound = self._read_barrier(db, pts)
         self.stats["selects"] += 1
         pushdown_limit = 0
         if stmt.limit and not stmt.offset:
@@ -310,7 +356,10 @@ class StoreNode:
                 raise ValueError(res["error"])
             if res.get("series"):
                 results.append(res["series"])
-        return {"series_lists": results}
+        out = {"series_lists": results}
+        if not barrier_sound:
+            out["degraded"] = True
+        return out
 
     def _on_show(self, body):
         """SHOW fan-out: run against each owned partition, sql unions."""

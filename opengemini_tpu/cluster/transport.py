@@ -21,6 +21,7 @@ this is the data plane for partial aggregate states, so copies matter.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
@@ -30,19 +31,170 @@ from queue import Empty, Queue
 
 import numpy as np
 
-from ..utils import failpoint, get_logger
+from ..utils import deadline, failpoint, get_logger
 
 log = get_logger(__name__)
 
 # cumulative transport metrics (reference statistics/spdy.go analog)
 RPC_STATS = {"requests": 0, "responses": 0, "errors": 0,
-             "bytes_in": 0, "bytes_out": 0}
+             "bytes_in": 0, "bytes_out": 0,
+             "breaker_trips": 0, "breaker_fast_fails": 0}
 
 MAX_FRAME = 1 << 30
 
 
 class RPCError(Exception):
     """Remote handler raised, or transport failed."""
+
+
+class CircuitOpenError(RPCError):
+    """Fast failure: the peer's circuit breaker is open. Raised without
+    touching the socket, so a dead peer costs callers microseconds, not
+    a connect timeout."""
+
+
+# ------------------------------------------------------- circuit breaker
+
+class CircuitBreaker:
+    """Per-peer circuit breaker (reference pattern: fail fast on a dead
+    store instead of stacking every caller behind connect timeouts).
+
+    closed → N consecutive transport failures → open. While open, calls
+    raise CircuitOpenError immediately until the cooldown elapses; then
+    ONE caller becomes the half-open probe. Probe success closes the
+    breaker; probe failure re-opens it with the cooldown doubled
+    (jittered exponential backoff, capped), so a long-dead peer is
+    probed ever more lazily but recovery is still automatic.
+
+    Only transport-level failures count (connect refused/timeout,
+    connection lost, response timeout) — a handler exception proves the
+    peer alive and RESETS the failure count.
+    """
+
+    fail_threshold = 3
+    base_cooldown_s = 0.5
+    # probes are one cheap connect attempt — cap the backoff low so a
+    # peer that comes BACK is rediscovered within seconds (a 30s cap
+    # starved HA migrate retries against freshly-restarted stores)
+    max_cooldown_s = 5.0
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._lock = threading.Lock()
+        self.state = "closed"          # closed | open | half_open
+        self.failures = 0              # consecutive transport failures
+        self.open_cycles = 0           # consecutive trips (backoff exp)
+        self.probe_at = 0.0            # monotonic time of next probe
+        self.trips = 0
+        self.fast_fails = 0
+        self.probes = 0
+        self._probe_t = 0.0            # when the current probe started
+
+    def allow(self) -> bool:
+        """Gate one call. Returns True when this call is the half-open
+        probe; raises CircuitOpenError when the breaker is open."""
+        with self._lock:
+            if self.state == "closed":
+                return False
+            now = time.monotonic()
+            if self.state == "open" and now >= self.probe_at:
+                self.state = "half_open"
+                self.probes += 1
+                self._probe_t = now
+                return True
+            if self.state == "half_open" \
+                    and now - self._probe_t > self.max_cooldown_s * 2:
+                # the in-flight probe never reported back (caller died
+                # mid-call) — a stuck half-open must not fast-fail
+                # forever; promote this caller to a fresh probe
+                self.probes += 1
+                self._probe_t = now
+                return True
+            # open before cooldown, or a probe is already in flight
+            self.fast_fails += 1
+            from ..utils.stats import bump as _bump
+            _bump(RPC_STATS, "breaker_fast_fails")
+            raise CircuitOpenError(
+                f"circuit open to {self.addr} "
+                f"({self.failures} consecutive failures; "
+                f"next probe in {max(0.0, self.probe_at - time.monotonic()):.2f}s)")
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+            self.open_cycles = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.state == "half_open" \
+                    or self.failures >= self.fail_threshold:
+                self._trip_locked()
+
+    def _trip_locked(self) -> None:
+        self.state = "open"
+        self.trips += 1
+        from ..utils.stats import bump as _bump
+        _bump(RPC_STATS, "breaker_trips")
+        # exponent capped: open_cycles grows without bound on a
+        # long-dead peer and 2**N overflows float past ~1024 cycles
+        cool = min(self.base_cooldown_s
+                   * (2 ** min(self.open_cycles, 16)),
+                   self.max_cooldown_s)
+        # full jitter band 0.5x..1.5x: simultaneous trips across callers
+        # must not re-probe a struggling peer in lockstep
+        cool *= 0.5 + random.random()
+        self.open_cycles += 1
+        self.probe_at = time.monotonic() + cool
+
+    def force(self, opened: bool) -> None:
+        """Operator override (/debug/ctrl): trip or reset the breaker."""
+        with self._lock:
+            if opened:
+                self.failures = max(self.failures, self.fail_threshold)
+                self._trip_locked()
+            else:
+                self.state = "closed"
+                self.failures = 0
+                self.open_cycles = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            d = {"state": self.state, "failures": self.failures,
+                 "trips": self.trips, "fast_fails": self.fast_fails,
+                 "probes": self.probes}
+            if self.state == "open":
+                d["probe_in_s"] = round(
+                    max(0.0, self.probe_at - time.monotonic()), 3)
+            return d
+
+
+# one breaker per peer ADDRESS, shared by every RPCClient/pool in the
+# process — all callers benefit from (and feed) the same dead-peer signal
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+BREAKERS_ENABLED = True
+
+
+def breaker_for(addr: str) -> CircuitBreaker:
+    with _breakers_lock:
+        b = _breakers.get(addr)
+        if b is None:
+            b = _breakers[addr] = CircuitBreaker(addr)
+        return b
+
+
+def breaker_stats() -> dict[str, dict]:
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {addr: b.snapshot() for addr, b in items}
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (tests; operator full-reset)."""
+    with _breakers_lock:
+        _breakers.clear()
 
 
 # ----------------------------------------------------------------- codec
@@ -275,6 +427,7 @@ class RPCClient:
     def __init__(self, addr: str, connect_timeout: float = 5.0):
         host, port = addr.rsplit(":", 1)
         self.addr = (host, int(port))
+        self.addr_str = f"{host}:{int(port)}"
         self.connect_timeout = connect_timeout
         self._sock: socket.socket | None = None
         self._wlock = threading.Lock()      # serializes frame writes
@@ -292,6 +445,13 @@ class RPCClient:
         with self._conn_lock:
             if self._sock is not None:
                 return self._sock
+            try:
+                # injected connect failure surfaces as the refused
+                # connection it simulates (breaker + retry paths see
+                # the same exception type as the real fault)
+                failpoint.inject("transport.connect.err")
+            except failpoint.FailpointError as e:
+                raise ConnectionError(str(e)) from e
             s = socket.create_connection(self.addr,
                                          timeout=self.connect_timeout)
             s.settimeout(None)
@@ -335,7 +495,10 @@ class RPCClient:
             for rid, _ in failed:
                 del self._pending[rid]
         for _, (_, q) in failed:
-            q.put({"err": why, "done": True, "body": None})
+            # "xport" marks a synthetic transport-failure frame so the
+            # circuit breaker can tell it from a remote handler error
+            # (which proves the peer alive)
+            q.put({"err": why, "done": True, "body": None, "xport": True})
 
     def call(self, msg_type: str, body=None, timeout: float = 60.0):
         """Single request/response. Raises RPCError on handler error."""
@@ -343,17 +506,31 @@ class RPCClient:
         return frames[-1] if frames else None
 
     def call_stream(self, msg_type: str, body=None, timeout: float = 60.0):
-        """Request with streaming response: yields each frame's body."""
+        """Request with streaming response: yields each frame's body.
+        Consults the peer's circuit breaker (fail-fast on dead peers)
+        and clamps the wait by any deadline bound in this thread."""
         rid = uuid.uuid4().hex
         q: Queue = Queue()
         s = None
+        br = breaker_for(self.addr_str) if BREAKERS_ENABLED else None
         # fault injection: simulate a dropped/slow RPC (reference plants
         # failpoints in the spdy transport, SURVEY.md §4). RPCError is
         # what real transport failures surface as — the injected fault
-        # must exercise the same retry/failover paths
+        # must exercise the same retry/failover/breaker paths
         if failpoint.inject("transport.send.drop"):
+            if br is not None:
+                br.record_failure()
             raise RPCError("failpoint: transport.send.drop")
         failpoint.inject("transport.send.delay")
+        # clamp BEFORE consulting the breaker: an exhausted budget must
+        # not claim the half-open probe slot and then bail without ever
+        # reporting back (that would fast-fail every caller until the
+        # stale-probe promotion window)
+        requested_timeout = timeout
+        timeout = deadline.clamp(timeout)
+        curtailed = timeout < requested_timeout
+        if br is not None:
+            br.allow()                  # raises CircuitOpenError if open
         try:
             s = self._ensure()
             with self._plock:
@@ -363,10 +540,16 @@ class RPCClient:
                 if self._sock is not s:
                     raise ConnectionError("connection lost")
                 s.sendall(data)
-            deadline = time.monotonic() + timeout
+            limit = time.monotonic() + timeout
             while True:
-                left = deadline - time.monotonic()
+                left = limit - time.monotonic()
                 if left <= 0:
+                    # a timeout on a deadline-CURTAILED wait is
+                    # caller-side evidence (tight budget), not
+                    # peer-death evidence — it must not trip the
+                    # process-wide breaker for a healthy-but-slow peer
+                    if br is not None and not curtailed:
+                        br.record_failure()
                     raise RPCError(
                         f"timeout waiting for {msg_type} from "
                         f"{self.addr[0]}:{self.addr[1]}")
@@ -375,11 +558,21 @@ class RPCClient:
                 except Empty:
                     continue
                 if frame.get("err"):
+                    if br is not None:
+                        if frame.get("xport"):
+                            br.record_failure()
+                        else:
+                            # a handler error is PROOF the peer is alive
+                            br.record_success()
                     raise RPCError(frame["err"])
                 yield frame.get("body")
                 if frame.get("done", True):
+                    if br is not None:
+                        br.record_success()
                     return
         except (ConnectionError, OSError) as e:
+            if br is not None:
+                br.record_failure()
             self._fail_pending(str(e), sock=s)
             raise RPCError(f"rpc to {self.addr}: {e}") from e
         finally:
@@ -388,15 +581,30 @@ class RPCClient:
 
     def try_call(self, msg_type: str, body=None, timeout: float = 60.0,
                  retries: int = 2, backoff: float = 0.2):
-        """call() with reconnect retries (transient failures)."""
+        """call() with reconnect retries (transient failures) and
+        jittered exponential backoff. An open circuit breaker or an
+        exhausted deadline short-circuits the remaining retries — both
+        mean waiting longer cannot help this call."""
+        from ..utils.errors import ErrQueryTimeout
         err = None
+        dl = deadline.current()
         for i in range(retries + 1):
             try:
                 return self.call(msg_type, body, timeout)
+            except CircuitOpenError:
+                raise                    # retrying now is the stacking
+                # behavior the breaker exists to prevent
+            except ErrQueryTimeout:
+                raise                    # budget gone: stop immediately
             except RPCError as e:
                 err = e
                 if i < retries:
-                    time.sleep(backoff * (2 ** i))
+                    pause = backoff * (2 ** i) * (0.5 + random.random())
+                    if dl is not None:
+                        left = dl.remaining()
+                        if left <= pause:
+                            break
+                    time.sleep(pause)
         raise err
 
     def close(self) -> None:

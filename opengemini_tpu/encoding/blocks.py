@@ -22,7 +22,8 @@ import struct
 import threading
 
 import numpy as np
-import zstandard
+
+from ..utils.zstd_compat import zstandard
 
 from . import gorilla, simple8b
 from .bitpack import zigzag_decode, zigzag_encode

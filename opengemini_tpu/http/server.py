@@ -29,7 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__
 from ..query import QueryExecutor, ParseError, parse_query
-from ..utils import get_logger
+from ..utils import deadline, get_logger
 from ..utils.errors import GeminiError
 from ..utils.lineprotocol import PRECISION_NS
 
@@ -92,6 +92,12 @@ class HttpServer:
             engine, query_manager=self.query_manager,
             resources=self.resources, users=self.user_store,
             catalog=self.catalog)
+        if config is not None \
+                and hasattr(self.executor, "max_failed_stores"):
+            # cluster executor: config sets the scatter degradation
+            # tolerance ([data] max_failed_stores)
+            self.executor.max_failed_stores = \
+                config.data.max_failed_stores
         self.sysctrl = SysControl(engine if local else None)
         self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
@@ -147,6 +153,21 @@ class HttpServer:
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    def _request_budget(self, params: dict, cfg_ns: int) -> float | None:
+        """Effective request budget in seconds: the configured ceiling,
+        optionally LOWERED by a client ?timeout= param (a client may ask
+        for less patience, never more). None = unbounded."""
+        ceil_s = cfg_ns / 1e9 if cfg_ns else None
+        req = params.get("timeout")
+        if req:
+            try:
+                req_s = float(req)
+            except ValueError:
+                req_s = 0.0
+            if req_s > 0:
+                return min(req_s, ceil_s) if ceil_s else req_s
+        return ceil_s
 
     @staticmethod
     def _is_user_stmt(stmt) -> bool:
@@ -489,21 +510,28 @@ class HttpServer:
             self._bump("write_errors")
             return 403, {"error": deny}
         precision = params.get("precision", "ns")
+        budget = self._request_budget(params,
+                                      self.config.data.write_timeout_ns)
         try:
             # decode ONCE: the utf-8 gate and the fallback parser share
             # this str; the fast paths lex the raw bytes
             body_text = body.decode("utf-8")
-            if hasattr(self.engine, "write_lines"):
-                # cluster facade: lex once, scatter raw line bytes per
-                # partition (points_writer._write_lines)
-                n = self.engine.write_lines(
-                    db, body, default_time_ns=int(time.time() * 1e9),
-                    precision=precision)
-            else:
-                from ..utils.lineprotocol import ingest_lines
-                n = ingest_lines(self.engine, db, body,
-                                 default_time_ns=int(time.time() * 1e9),
-                                 precision=precision, text=body_text)
+            # one write budget end-to-end: the points-writer fan-out and
+            # its retries consume the remainder (utils.deadline)
+            with deadline.bind(budget, what="write"):
+                if hasattr(self.engine, "write_lines"):
+                    # cluster facade: lex once, scatter raw line bytes
+                    # per partition (points_writer._write_lines)
+                    n = self.engine.write_lines(
+                        db, body,
+                        default_time_ns=int(time.time() * 1e9),
+                        precision=precision)
+                else:
+                    from ..utils.lineprotocol import ingest_lines
+                    n = ingest_lines(
+                        self.engine, db, body,
+                        default_time_ns=int(time.time() * 1e9),
+                        precision=precision, text=body_text)
         except GeminiError as e:
             self._bump("write_errors")
             return 400, {"error": str(e)}
@@ -545,35 +573,46 @@ class HttpServer:
             if not any(self._is_user_stmt(s) for s in stmts):
                 self.plan_cache.put(qtext, stmts)
         results = []
-        for i, stmt in enumerate(stmts):
-            try:
-                deny = self._deny_privilege(stmt, user) \
-                    or self._deny_db_access(stmt, user, db)
-                if deny is not None:
-                    res = {"error": deny}
-                elif self._is_user_stmt(stmt):
-                    # executed against the server's own user catalog —
-                    # works identically over the cluster facade (whose
-                    # executor has no user branch)
-                    res = self._exec_user_stmt(stmt)
-                else:
-                    # one cache slot per statement of a multi-statement
-                    # query
-                    stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
-                    res = self.executor.execute(stmt, db,
-                                                inc_query_id=stmt_qid,
-                                                iter_id=iter_id)
-            except Exception as e:  # an executor bug must not kill the conn
-                log.exception("query execution failed: %s",
-                              _redact_passwords(qtext))
-                res = {"error": f"internal error: {e}"}
-            res = dict(res)
-            res["statement_id"] = i
-            if epoch and "series" in res:
-                _convert_epoch(res["series"], epoch)
-            if "error" in res:
-                self._bump("query_errors")
-            results.append(res)
+        budget = self._request_budget(params,
+                                      self.config.data.query_timeout_ns)
+        # ONE budget covers the whole request (all statements): every
+        # scatter hop, RPC retry and store wait below consumes the
+        # remainder — a slow store can never stack fresh per-hop
+        # timeouts past this point (utils.deadline)
+        with deadline.bind(budget, what="query"):
+            for i, stmt in enumerate(stmts):
+                try:
+                    deny = self._deny_privilege(stmt, user) \
+                        or self._deny_db_access(stmt, user, db)
+                    if deny is not None:
+                        res = {"error": deny}
+                    elif self._is_user_stmt(stmt):
+                        # executed against the server's own user catalog
+                        # — works identically over the cluster facade
+                        # (whose executor has no user branch)
+                        res = self._exec_user_stmt(stmt)
+                    else:
+                        # one cache slot per statement of a
+                        # multi-statement query
+                        stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
+                        res = self.executor.execute(stmt, db,
+                                                    inc_query_id=stmt_qid,
+                                                    iter_id=iter_id)
+                except GeminiError as e:
+                    # typed budget/engine errors (ErrQueryTimeout et al)
+                    res = {"error": str(e)}
+                except Exception as e:  # an executor bug must not kill
+                    # the connection
+                    log.exception("query execution failed: %s",
+                                  _redact_passwords(qtext))
+                    res = {"error": f"internal error: {e}"}
+                res = dict(res)
+                res["statement_id"] = i
+                if epoch and "series" in res:
+                    _convert_epoch(res["series"], epoch)
+                if "error" in res:
+                    self._bump("query_errors")
+                results.append(res)
         return 200, {"results": results}
 
     def metrics_text(self) -> str:
@@ -1176,8 +1215,9 @@ class _Handler(BaseHTTPRequestHandler):
             params = {"point": doc.get("name", ""),
                       "switchon": str(doc.get("enable", True)).lower(),
                       "action": doc.get("action", "error")}
-            if doc.get("arg") is not None:
-                params["arg"] = doc["arg"]
+            for k in ("arg", "maxhits", "pct"):
+                if doc.get(k) is not None:
+                    params[k] = doc[k]
             code, payload = srv.sysctrl.handle("failpoint", params)
             if code == 200 and params["point"]:
                 from ..utils import failpoint as fp

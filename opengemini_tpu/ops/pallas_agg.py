@@ -48,9 +48,11 @@ def _rowagg_call(x, interpret: bool):
     # jax_enable_x64 globally (ops/__init__) and Mosaic lowering of the
     # x64-typed grid indices crashes the remote compile helper. The
     # kernel itself is pure f32 either way.
+    from jax.experimental import enable_x64   # jax.enable_x64 alias
+    # was removed in newer jax releases; the experimental home remains
     S, P = x.shape
     out = jax.ShapeDtypeStruct((S, LANES), jnp.float32)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         return pl.pallas_call(
             _rowagg_kernel,
             grid=(S // TILE_S,),

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import os
 
-from ..utils import get_logger
+from ..utils import failpoint, get_logger
 from .tssp import TSSPReader, TSSPWriter
 
 log = get_logger(__name__)
@@ -90,6 +90,9 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
     Returns the new file's path, or None when the merge produced no rows
     (inputs are still removed — they contributed nothing).
     """
+    # fault injection BEFORE the lock/plan: a failed merge leaves the
+    # input files exactly as they were (compaction retries next round)
+    failpoint.inject("compact.merge.err")
     from ..utils.stats import bump as _bump
     _bump(COMPACT_STATS, "merges")
     _bump(COMPACT_STATS, "files_merged", len(readers))

@@ -37,6 +37,7 @@ import numpy as np
 
 from ..encoding import blocks as enc
 from ..record import ColVal, DataType, Field, Record, Schema
+from ..utils import failpoint
 from .. import native as _native
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
@@ -650,6 +651,10 @@ class TSSPWriter:
                 else np.zeros(0, dtype=np.uint64))
 
     def finalize(self) -> None:
+        # fault injection: die before the trailer/rename — the .tmp is
+        # orphaned and the durable file set is untouched (torn-flush
+        # crash semantics)
+        failpoint.inject("tssp.write.err")
         data_end = self._pos
         # chunk metas in sid order, grouped for the meta index
         idx_entries = []
@@ -697,6 +702,9 @@ class TSSPReader:
         provider, e.g. obs.DetachedSource), a detached object-store read
         path (reference detached_lazy_load_index_reader.go); ``path`` is
         then only the cache identity."""
+        # fault injection: unreadable file (media fault at open — the
+        # query path surfaces it as a store-side error, never a hang)
+        failpoint.inject("tssp.read.err")
         self.path = path
         # process-unique identity for content-addressed caches (id()
         # recycles after GC; serials never do)

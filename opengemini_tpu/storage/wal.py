@@ -16,9 +16,8 @@ import struct
 import threading
 import zlib
 
-import zstandard
-
 from ..native import lz4_compress, lz4_decompress
+from ..utils.zstd_compat import zstandard
 from ..utils import failpoint, get_logger
 
 log = get_logger(__name__)

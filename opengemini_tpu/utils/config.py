@@ -11,7 +11,11 @@ either numbers (seconds) or influx duration strings ("10s", "1h").
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib                       # 3.11+
+except ModuleNotFoundError:              # 3.10: the tomllib backport
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 
 from .errors import GeminiError
@@ -96,6 +100,14 @@ class DataConfig:
     max_concurrent_queries: int = 0       # 0 = unlimited
     max_queued_queries: int = 64
     max_series_per_query: int = 0         # 0 = unlimited
+    # end-to-end request budgets (utils.deadline): one budget per HTTP
+    # query/write, consumed across every scatter hop and retry — a slow
+    # store spends the remainder, never a fresh per-call timeout
+    query_timeout_ns: int = 60 * NS       # 0 = unbounded
+    write_timeout_ns: int = 30 * NS       # 0 = unbounded
+    # scatter-gather degradation: how many dead stores a query may
+    # tolerate, returning a `partial`-flagged result (0 = fail cleanly)
+    max_failed_stores: int = 0
 
 
 @dataclass
@@ -203,6 +215,11 @@ class Config:
             raise ConfigError("data.segment_size must be > 0")
         if self.data.shard_duration_ns <= 0:
             raise ConfigError("data.shard_duration must be > 0")
+        if self.data.query_timeout_ns < 0 or self.data.write_timeout_ns < 0:
+            raise ConfigError("data.query_timeout/write_timeout must "
+                              "be >= 0 (0 disables the budget)")
+        if self.data.max_failed_stores < 0:
+            raise ConfigError("data.max_failed_stores must be >= 0")
         for addr_name in ("http.bind_address", "meta.bind_address"):
             sec, key = addr_name.split(".")
             v = getattr(getattr(self, sec), key)

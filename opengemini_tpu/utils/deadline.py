@@ -1,0 +1,110 @@
+"""Per-request deadline propagation.
+
+A query or write gets ONE time budget at the HTTP boundary; every hop it
+fans out through (sql-node scatter, points-writer fan-out, transport RPC
+retries) consumes the REMAINING budget instead of starting a fresh
+per-call timeout — so a slow store can never stack `n_hops x 60s` of
+waiting behind one client request (the role of context deadlines in the
+reference's Go coordinator paths).
+
+Usage:
+
+    with deadline.bind(budget_s):          # HTTP boundary
+        ...                                # same-thread call chain
+
+    dl = deadline.current()                # capture BEFORE fan-out
+    rpc_timeout = dl.clamp(60.0) if dl else 60.0
+
+``bind`` stores the deadline in a contextvar, which does NOT propagate
+into worker threads — fan-out paths must capture ``current()`` in the
+dispatching thread and close over it (see sql_node._scatter,
+points_writer._scatter_send).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+
+from .errors import ErrQueryTimeout
+
+__all__ = ["Deadline", "bind", "current", "clamp", "check"]
+
+
+class Deadline:
+    """Absolute monotonic deadline for one request."""
+
+    __slots__ = ("at", "budget_s", "what")
+
+    def __init__(self, budget_s: float, what: str = "request"):
+        self.budget_s = float(budget_s)
+        self.at = time.monotonic() + self.budget_s
+        self.what = what
+
+    def remaining(self) -> float:
+        """Seconds left (may be negative once expired)."""
+        return self.at - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def check(self, where: str = "") -> None:
+        """Raise the typed budget-exhausted error when expired."""
+        if self.expired:
+            raise ErrQueryTimeout(self._msg(where))
+
+    def clamp(self, timeout: float) -> float:
+        """min(timeout, remaining); raises when the budget is gone so a
+        caller never issues an RPC it cannot wait for."""
+        left = self.remaining()
+        if left <= 0:
+            raise ErrQueryTimeout(self._msg("clamp"))
+        return min(timeout, left)
+
+    def _msg(self, where: str) -> str:
+        w = f" at {where}" if where else ""
+        return (f"{self.what} deadline exceeded "
+                f"(budget {self.budget_s:.3g}s){w}")
+
+
+_current: contextvars.ContextVar[Deadline | None] = \
+    contextvars.ContextVar("og_deadline", default=None)
+
+
+def current() -> Deadline | None:
+    """The calling thread's bound deadline (None when unbounded)."""
+    return _current.get()
+
+
+class bind:
+    """Context manager binding a deadline for the with-block's call
+    chain. budget_s None or <= 0 binds nothing (unbounded)."""
+
+    def __init__(self, budget_s: float | None, what: str = "request"):
+        self.deadline = (Deadline(budget_s, what)
+                         if budget_s is not None and budget_s > 0
+                         else None)
+        self._tok = None
+
+    def __enter__(self) -> Deadline | None:
+        if self.deadline is not None:
+            self._tok = _current.set(self.deadline)
+        return self.deadline
+
+    def __exit__(self, *exc):
+        if self._tok is not None:
+            _current.reset(self._tok)
+        return False
+
+
+def clamp(timeout: float) -> float:
+    """Clamp a per-call timeout by the bound deadline, if any."""
+    dl = current()
+    return dl.clamp(timeout) if dl is not None else timeout
+
+
+def check(where: str = "") -> None:
+    dl = current()
+    if dl is not None:
+        dl.check(where)

@@ -13,11 +13,21 @@ them with actions:
     drop              return True (site-specific: caller drops the work)
     call              invoke a python callable (tests)
 
+Arming modifiers (pingcap term-expression analogs ``3*return`` /
+``10%return``):
+
+    maxhits=N         fire at most N times, then auto-disarm
+    pct=P             each pass fires with probability P (0..100)
+
+Site naming convention: ``<module>.<operation>.<fault>`` — e.g.
+``wal.write.err``, ``transport.send.drop``, ``raft.replicate.drop``.
+
 The disarmed fast path is one module-global bool check — safe to leave in
 hot loops."""
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -29,14 +39,36 @@ class FailpointError(RuntimeError):
     """Raised by an armed `error` failpoint."""
 
 
+class _Spec:
+    __slots__ = ("action", "arg", "maxhits", "pct")
+
+    def __init__(self, action, arg, maxhits, pct):
+        self.action = action
+        self.arg = arg
+        self.maxhits = maxhits
+        self.pct = pct
+
+
 _lock = threading.Lock()
-_points: dict[str, tuple[str, object]] = {}
+_points: dict[str, _Spec] = {}
 ACTIVE = False                    # fast-path gate (no lock on reads)
 _hits: dict[str, int] = {}
+# probabilistic (pct) arming draws from a dedicated generator so chaos
+# schedules can make a whole run reproducible without touching the
+# global random state
+_rng = random.Random()
 
 
-def enable(name: str, action: str = "error", arg: object = None) -> None:
-    """Arm a failpoint. action: error | sleep | drop | call."""
+def seed(n) -> None:
+    """Seed the pct-draw generator (deterministic chaos schedules)."""
+    _rng.seed(n)
+
+
+def enable(name: str, action: str = "error", arg: object = None,
+           maxhits: int | None = None, pct: float | None = None) -> None:
+    """Arm a failpoint. action: error | sleep | drop | call.
+    maxhits=N auto-disarms the point after N fires (one-shot: N=1);
+    pct=P fires each pass with probability P percent."""
     global ACTIVE
     if action not in ("error", "sleep", "drop", "call"):
         raise ValueError(f"unknown failpoint action {action}")
@@ -47,8 +79,23 @@ def enable(name: str, action: str = "error", arg: object = None) -> None:
             arg = float(arg or 0)
         except (TypeError, ValueError):
             raise ValueError("action 'sleep' requires a numeric ms arg")
+    if maxhits is not None:
+        try:
+            maxhits = int(maxhits)
+        except (TypeError, ValueError):
+            raise ValueError("maxhits must be an integer")
+        if maxhits <= 0:
+            raise ValueError("maxhits must be > 0")
+    if pct is not None:
+        try:
+            pct = float(pct)
+        except (TypeError, ValueError):
+            raise ValueError("pct must be a number (0..100)")
+        if not 0 <= pct <= 100:
+            raise ValueError("pct must be within 0..100")
     with _lock:
-        _points[name] = (action, arg)
+        _points[name] = _Spec(action, arg, maxhits, pct)
+        _hits.pop(name, None)      # hit counts reset on (re)arm
         ACTIVE = True
 
 
@@ -69,27 +116,39 @@ def disable_all() -> None:
 
 
 def active(name: str) -> bool:
-    return ACTIVE and name in _points
+    if not ACTIVE:                 # disarmed fast path: one bool check
+        return False
+    with _lock:                    # armed: consistent read of _points
+        return name in _points
 
 
 def list_points() -> dict:
     with _lock:
-        return {n: {"action": a, "hits": _hits.get(n, 0)}
-                for n, (a, _arg) in _points.items()}
+        return {n: {"action": s.action, "hits": _hits.get(n, 0),
+                    **({"maxhits": s.maxhits}
+                       if s.maxhits is not None else {}),
+                    **({"pct": s.pct} if s.pct is not None else {})}
+                for n, s in _points.items()}
 
 
 def inject(name: str) -> bool:
     """Call at an injection site. Returns True when the site should DROP
     the work (action `drop`); raises FailpointError for `error`; sleeps
     for `sleep`. Disarmed cost: one global bool check."""
+    global ACTIVE
     if not ACTIVE:
         return False
     with _lock:
         spec = _points.get(name)
         if spec is None:
             return False
+        if spec.pct is not None and _rng.random() * 100.0 >= spec.pct:
+            return False           # armed but this pass doesn't fire
         _hits[name] = _hits.get(name, 0) + 1
-        action, arg = spec
+        if spec.maxhits is not None and _hits[name] >= spec.maxhits:
+            _points.pop(name, None)        # one-shot/N-shot: auto-disarm
+            ACTIVE = bool(_points)
+        action, arg = spec.action, spec.arg
     if action == "error":
         raise FailpointError(arg or f"failpoint {name}")
     if action == "sleep":
@@ -108,13 +167,17 @@ class Failpoint:
     ``with Failpoint("wal.write.err"): ...``"""
 
     def __init__(self, name: str, action: str = "error",
-                 arg: object = None):
+                 arg: object = None, maxhits: int | None = None,
+                 pct: float | None = None):
         self.name = name
         self.action = action
         self.arg = arg
+        self.maxhits = maxhits
+        self.pct = pct
 
     def __enter__(self):
-        enable(self.name, self.action, self.arg)
+        enable(self.name, self.action, self.arg,
+               maxhits=self.maxhits, pct=self.pct)
         return self
 
     def __exit__(self, *exc):

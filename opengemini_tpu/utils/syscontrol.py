@@ -3,13 +3,17 @@
 over HTTP and consulted by the engine/services).
 
 Commands (query params: ?mod=<cmd>[&switchon=true|false]):
-    flush         — flush all memtables to TSSP now
-    snapshot      — alias of flush (reference snapshot ctrl)
-    readonly      — reject writes while on
-    compaction    — enable/disable background compaction
-    purgecache    — drop the decoded-block read cache
-    verbose       — debug logging on/off
-    stat          — return current flag states
+    flush          — flush all memtables to TSSP now
+    snapshot       — alias of flush (reference snapshot ctrl)
+    readonly       — reject writes while on
+    compaction     — enable/disable background compaction
+    purgecache     — drop the decoded-block read cache
+    verbose        — debug logging on/off
+    stat           — return current flag states
+    failpoint      — arm/disarm fault injection (&point=&action=
+                     [&arg=][&maxhits=N][&pct=P]); no point: list
+    circuitbreaker — per-peer breaker states; &addr=<host:port>
+                     &switchon=true trips it, =false resets it
 """
 
 from __future__ import annotations
@@ -61,9 +65,31 @@ class SysControl:
                     logging.DEBUG if self.verbose else logging.INFO)
                 return 200, {"verbose": self.verbose}
             if mod == "stat":
+                from ..cluster import transport
                 return 200, {"readonly": self.readonly,
                              "compaction": self.compaction_enabled,
-                             "verbose": self.verbose}
+                             "verbose": self.verbose,
+                             "circuit_breakers":
+                                 transport.breaker_stats()}
+            if mod == "circuitbreaker":
+                # per-peer breaker visibility + operator override
+                # (tripping drains a peer; resetting re-probes it now).
+                # The override requires an EXPLICIT switchon param —
+                # addr alone is a read and must not mutate state
+                from ..cluster import transport
+                addr = params.get("addr")
+                if not addr:
+                    return 200, {"circuit_breakers":
+                                 transport.breaker_stats()}
+                if "switchon" not in params:
+                    snap = transport.breaker_stats().get(addr)
+                    if snap is None:
+                        return 404, {"error":
+                                     f"no breaker for {addr!r}"}
+                    return 200, {"addr": addr, **snap}
+                br = transport.breaker_for(addr)
+                br.force(self._flag(params))
+                return 200, {"addr": addr, **br.snapshot()}
             if mod == "failpoint":
                 # arm/disarm fault-injection points (reference failpoint
                 # toggles over the syscontrol admin plane, SURVEY.md §5)
@@ -82,7 +108,9 @@ class SysControl:
                                  "action 'call' is not available "
                                  "over HTTP"}
                 try:
-                    fp.enable(point, action, params.get("arg"))
+                    fp.enable(point, action, params.get("arg"),
+                              maxhits=params.get("maxhits"),
+                              pct=params.get("pct"))
                 except ValueError as e:
                     return 400, {"error": str(e)}
                 return 200, {"failpoint": point, "enabled": True}

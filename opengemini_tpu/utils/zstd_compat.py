@@ -1,0 +1,78 @@
+"""zstandard import gate.
+
+The WAL and block codecs want python-zstandard; some deployment images
+ship without it. Importing `zstandard` from this module returns the real
+package when installed, else a zlib-backed shim covering the API subset
+the codebase uses (ZstdCompressor.compress, ZstdDecompressor.decompress
+with max_output_size, get_frame_parameters().content_size).
+
+The shim's frames are NOT zstd frames (they carry a ``ZSZL`` magic +
+declared size + a zlib stream), so data written under one codec is
+unreadable under the other — but every writer AND reader in this
+codebase routes through this module, so any single deployment stays
+self-consistent. Mixed fleets must install python-zstandard everywhere.
+"""
+
+from __future__ import annotations
+
+try:
+    import zstandard                               # noqa: F401
+except ModuleNotFoundError:                        # pragma: no cover gate
+    import struct
+    import types
+    import zlib
+
+    _MAGIC = b"ZSZL"
+    _HDR = struct.Struct("<4sQ")
+
+    class ZstdError(Exception):
+        pass
+
+    class _FrameParams:
+        __slots__ = ("content_size",)
+
+        def __init__(self, content_size: int):
+            self.content_size = content_size
+
+    class ZstdCompressor:
+        def __init__(self, level: int = 3):
+            # zstd levels reach 22; clamp into zlib's 1..9
+            self._level = max(1, min(int(level), 9))
+
+        def compress(self, data) -> bytes:
+            raw = bytes(data)
+            return _HDR.pack(_MAGIC, len(raw)) \
+                + zlib.compress(raw, self._level)
+
+    class ZstdDecompressor:
+        def decompress(self, data, max_output_size: int = 0) -> bytes:
+            b = bytes(data)
+            if len(b) < _HDR.size or b[:4] != _MAGIC:
+                raise ZstdError("invalid frame (zlib-shim codec)")
+            (_, size) = _HDR.unpack_from(b)
+            if max_output_size and size > max_output_size:
+                raise ZstdError(
+                    f"frame declares {size} bytes > cap {max_output_size}")
+            try:
+                out = zlib.decompress(b[_HDR.size:])
+            except zlib.error as e:
+                raise ZstdError(str(e)) from e
+            if max_output_size and len(out) > max_output_size:
+                raise ZstdError("decompressed past max_output_size")
+            return out
+
+    def get_frame_parameters(data) -> _FrameParams:
+        b = bytes(data[:_HDR.size])
+        if len(b) == _HDR.size and b[:4] == _MAGIC:
+            return _FrameParams(_HDR.unpack_from(b)[1])
+        return _FrameParams(0)
+
+    zstandard = types.SimpleNamespace(
+        ZstdCompressor=ZstdCompressor,
+        ZstdDecompressor=ZstdDecompressor,
+        ZstdError=ZstdError,
+        get_frame_parameters=get_frame_parameters,
+        __shim__="zlib",
+    )
+
+__all__ = ["zstandard"]

@@ -1,0 +1,302 @@
+"""Chaos harness: seeded kill/restart/delay/drop schedules against an
+in-process cluster, with invariant checks.
+
+Role of the reference's failpoint-driven `make gotest` runs plus the
+HA integration suites (SURVEY §4): instead of hand-written one-fault
+tests, a schedule drives randomized faults from a SEED (fully
+reproducible: the op sequence, the pct-failpoint draws and the fault
+parameters all derive from it) and asserts the cluster's failure
+CONTRACT after every step:
+
+  I1  bounded time  — an HTTP query with budget B returns in <= B + 1s.
+  I2  typed errors  — a degraded query yields a non-empty error string
+      (never an ``internal error:`` crash surface, never a hang).
+  I3  flagged partials — a successful response that omits data carries
+      ``partial: true``; an UNflagged success must contain every acked
+      write (silently-wrong data is the one unforgivable failure).
+  I4  acked durability — once the cluster heals, every write acked with
+      204 is queryable (replica takeover included).
+
+Not a pytest module itself — tests/test_chaos.py drives it.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from opengemini_tpu.app import TsMeta, TsSql, TsStore
+from opengemini_tpu.utils import failpoint
+
+DB = "chaos"
+MST = "m"
+
+
+_PORT_BASE = 10100   # below the ephemeral range (net.ipv4.
+# ip_local_port_range low end is 16000 here): a dead store's fixed
+# port must not be squattable by some client's outbound socket while
+# the store is down, or its restart fails EADDRINUSE
+_port_cursor = random.Random().randrange(0, 4000)
+
+
+def _free_port() -> int:
+    global _port_cursor
+    for _ in range(4000):
+        _port_cursor = (_port_cursor + 1) % 4000
+        port = _PORT_BASE + _port_cursor
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", port))
+        except OSError:
+            continue
+        finally:
+            s.close()
+        return port
+    raise RuntimeError("no free port below the ephemeral range")
+
+
+class ChaosCluster:
+    """1 meta + N stores + 1 sql, with kill/restart by store index.
+    Stores keep FIXED ports so a restart re-joins as the same node id
+    (meta_data._apply_create_node re-join-by-addr)."""
+
+    def __init__(self, root, n_stores: int = 3, replica_n: int = 2,
+                 num_pts: int = 4, failure_timeout_s: float = 2.0,
+                 heartbeat_s: float = 0.3, query_budget_s: float = 5.0,
+                 max_failed_stores: int = 1):
+        self.root = root
+        self.query_budget_s = query_budget_s
+        self.meta = TsMeta(data_dir=str(root / "meta"),
+                           failure_timeout_s=failure_timeout_s)
+        self.meta.start()
+        assert self.meta.server.raft.wait_leader(10.0) is not None
+        self.ports = [_free_port() for _ in range(n_stores)]
+        self.stores: list[TsStore | None] = [None] * n_stores
+        self.heartbeat_s = heartbeat_s
+        for i in range(n_stores):
+            self.start_store(i)
+        self.sql = TsSql([self.meta.addr])
+        # scatter degradation tolerance: dead stores yield FLAGGED
+        # partials instead of errors (the contract I3 exercises)
+        self.sql.facade.executor.max_failed_stores = max_failed_stores
+        self.sql.start()
+        self.base = f"http://{self.sql.http_addr}"
+        self.sql.meta.create_database(DB, num_pts=num_pts,
+                                      replica_n=replica_n)
+        self.acked: set[int] = set()     # v= values acked with 204
+        self._seq = 0
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start_store(self, i: int, retries: int = 3) -> bool:
+        """(Re)start store i. Under active fault windows registration
+        with meta can fail (drops / open breakers) — retry like a
+        supervisor would; on exhaustion the store stays dead and the
+        schedule carries on."""
+        for attempt in range(retries):
+            s = None
+            try:
+                # constructor binds the port — inside the try: the
+                # bind itself can transiently fail
+                s = TsStore(str(self.root / f"s{i}"), [self.meta.addr],
+                            port=self.ports[i],
+                            heartbeat_s=self.heartbeat_s)
+                s.start()
+                self.stores[i] = s
+                return True
+            except Exception:             # noqa: BLE001
+                if s is not None:
+                    try:
+                        s.stop()          # release the port + engine
+                    except Exception:     # noqa: BLE001
+                        pass
+                if attempt < retries - 1:
+                    time.sleep(1.0)
+        return False
+
+    def kill_store(self, i: int) -> None:
+        s = self.stores[i]
+        if s is not None:
+            try:
+                s.stop()
+            except Exception:
+                pass
+            self.stores[i] = None
+
+    def alive(self) -> list[int]:
+        return [i for i, s in enumerate(self.stores) if s is not None]
+
+    def dead(self) -> list[int]:
+        return [i for i, s in enumerate(self.stores) if s is None]
+
+    def store_addr(self, i: int) -> str:
+        return f"127.0.0.1:{self.ports[i]}"
+
+    def close(self) -> None:
+        failpoint.disable_all()
+        try:
+            self.sql.stop()
+        finally:
+            for i in self.alive():
+                self.kill_store(i)
+            self.meta.stop()
+
+    # ---------------------------------------------------------------- http
+
+    def write(self, n_rows: int = 5, timeout_s: float = 10.0) -> bool:
+        """One /write batch of fresh unique rows; True (and rows
+        recorded as acked) only on a full 204 ack."""
+        lines = []
+        vals = []
+        for _ in range(n_rows):
+            self._seq += 1
+            vals.append(self._seq)
+            lines.append(f"{MST},k=w{self._seq % 7} v={self._seq}i "
+                         f"{self._seq * 1_000_000}")
+        body = "\n".join(lines).encode()
+        req = urllib.request.Request(
+            f"{self.base}/write?db={DB}&timeout={timeout_s}",
+            data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s + 15):
+                pass
+        except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+            return False
+        self.acked.update(vals)
+        return True
+
+    def query(self, q: str = f"SELECT v FROM {MST}",
+              budget_s: float | None = None) -> tuple[float, dict]:
+        """One /query with an explicit budget; returns (elapsed_s,
+        first statement result dict)."""
+        budget = self.query_budget_s if budget_s is None else budget_s
+        url = (f"{self.base}/query?db={DB}&timeout={budget}"
+               f"&q={urllib.parse.quote(q)}")
+        t0 = time.monotonic()
+        with urllib.request.urlopen(url, timeout=budget + 30) as r:
+            doc = json.loads(r.read())
+        return time.monotonic() - t0, doc["results"][0]
+
+    def result_values(self, res: dict) -> set[int]:
+        out: set[int] = set()
+        for s in res.get("series", ()):
+            vi = s["columns"].index("v")
+            out.update(int(row[vi]) for row in s["values"]
+                       if row[vi] is not None)
+        return out
+
+    # ----------------------------------------------------------- invariants
+
+    def check_query_contract(self, budget_s: float | None = None) -> dict:
+        """Run one query and assert I1-I3. Returns the result dict."""
+        budget = self.query_budget_s if budget_s is None else budget_s
+        elapsed, res = self.query(budget_s=budget)
+        assert elapsed <= budget + 1.0, (
+            f"I1 violated: query took {elapsed:.2f}s "
+            f"with budget {budget}s")
+        if "error" in res:
+            assert isinstance(res["error"], str) and res["error"], \
+                "I2 violated: untyped empty error"
+            assert not res["error"].startswith("internal error"), \
+                f"I2 violated: crash surfaced as error: {res['error']}"
+        elif not res.get("partial"):
+            got = self.result_values(res)
+            missing = self.acked - got
+            assert not missing, (
+                f"I3 violated: UNflagged success missing acked rows "
+                f"{sorted(missing)[:10]} (of {len(missing)})")
+        return res
+
+    def heal(self, timeout_s: float = 45.0) -> None:
+        """Disarm faults, restart every dead store, then wait for the
+        cluster to serve a complete, unflagged result (I4)."""
+        failpoint.disable_all()
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            for i in self.dead():
+                self.start_store(i, retries=1)
+            try:
+                _, res = self.query()
+            except Exception as e:        # noqa: BLE001 — keep polling
+                last = str(e)
+                time.sleep(0.5)
+                continue
+            if "error" in res or res.get("partial"):
+                last = res.get("error", "partial")
+                time.sleep(0.5)
+                continue
+            got = self.result_values(res)
+            if self.acked <= got:
+                return
+            last = f"missing {sorted(self.acked - got)[:10]}"
+            time.sleep(0.5)
+        raise AssertionError(
+            f"I4 violated: acked writes not durable after heal "
+            f"({timeout_s}s): {last}")
+
+
+# ------------------------------------------------------------- schedules
+
+def run_schedule(root, seed: int, steps: int = 8,
+                 n_stores: int = 3) -> dict:
+    """One seeded schedule: random faults, contract checked every step,
+    full durability checked after healing. Returns run stats."""
+    rng = random.Random(seed)
+    failpoint.seed(seed)
+    stats = {"seed": seed, "ops": [], "writes": 0, "acked": 0,
+             "queries": 0, "partials": 0, "errors": 0}
+    c = ChaosCluster(root, n_stores=n_stores)
+    try:
+        # seed data so queries always have something to return
+        assert c.write(n_rows=10), "initial write must ack"
+        for _ in range(steps):
+            op = rng.choice(["kill", "restart", "delay", "drop",
+                             "calm", "calm"])
+            if op == "kill" and len(c.alive()) > 1:
+                c.kill_store(rng.choice(c.alive()))
+            elif op == "restart" and c.dead():
+                c.start_store(rng.choice(c.dead()))
+            elif op == "delay":
+                failpoint.enable("transport.send.delay", "sleep",
+                                 rng.choice([50, 150, 400]),
+                                 pct=rng.choice([20, 50]))
+            elif op == "drop":
+                failpoint.enable("transport.send.drop", "drop",
+                                 pct=rng.choice([5, 15]))
+            else:
+                failpoint.disable("transport.send.delay")
+                failpoint.disable("transport.send.drop")
+            stats["ops"].append(op)
+            time.sleep(rng.uniform(0.1, 0.6))
+            for _ in range(2):
+                stats["writes"] += 1
+                if c.write(n_rows=3):
+                    stats["acked"] += 1
+            for _ in range(2):
+                stats["queries"] += 1
+                res = c.check_query_contract()
+                if res.get("partial"):
+                    stats["partials"] += 1
+                if "error" in res:
+                    stats["errors"] += 1
+        c.heal()
+        # a healed cluster must accept writes again (group re-elections
+        # and breaker probes may need a few rounds)
+        ack_deadline = time.monotonic() + 45.0
+        healed_ack = False
+        while time.monotonic() < ack_deadline:
+            if c.write(n_rows=3):
+                healed_ack = True
+                break
+            time.sleep(0.5)
+        assert healed_ack, "writes do not ack after heal"
+        stats["acked"] += 1
+        return stats
+    finally:
+        c.close()

@@ -24,6 +24,25 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _failpoint_hygiene():
+    """Failpoint leak guard: a point armed by one test must NEVER bleed
+    into an unrelated test (an inherited `error` point would fail it
+    with a baffling message). Teardown disarms everything FIRST so one
+    leak cannot cascade, then fails the leaking test by name. Also
+    resets per-peer circuit breakers — an OS-recycled port must not
+    inherit another test's open breaker."""
+    from opengemini_tpu.cluster.transport import reset_breakers
+    from opengemini_tpu.utils import failpoint
+    yield
+    leaked = failpoint.list_points()
+    failpoint.disable_all()
+    reset_breakers()
+    assert not leaked, (
+        f"test leaked armed failpoints {sorted(leaked)} — disarm via "
+        f"Failpoint context manager or failpoint.disable/disable_all")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

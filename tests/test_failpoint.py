@@ -52,6 +52,46 @@ def test_drop_sleep_call_actions():
         failpoint.enable("c2", "call", None)
 
 
+def test_maxhits_one_shot_and_n_shot():
+    # one-shot: fires once, then auto-disarms
+    failpoint.enable("once", "error", "boom", maxhits=1)
+    with pytest.raises(FailpointError):
+        failpoint.inject("once")
+    assert failpoint.inject("once") is False
+    assert "once" not in failpoint.list_points()
+    # N-shot drop
+    failpoint.enable("thrice", "drop", maxhits=3)
+    assert [failpoint.inject("thrice") for _ in range(5)] \
+        == [True, True, True, False, False]
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "drop", maxhits=0)
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "drop", maxhits="x")
+
+
+def test_pct_probabilistic_arming():
+    failpoint.seed(7)
+    failpoint.enable("p0", "drop", pct=0)
+    assert not any(failpoint.inject("p0") for _ in range(50))
+    failpoint.enable("p100", "drop", pct=100)
+    assert all(failpoint.inject("p100") for _ in range(50))
+    failpoint.enable("p50", "drop", pct=50)
+    fired = sum(failpoint.inject("p50") for _ in range(400))
+    assert 100 < fired < 300          # seeded, loose band
+    # hits count only actual fires
+    assert failpoint.list_points()["p50"]["hits"] == fired
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "drop", pct=101)
+
+
+def test_pct_maxhits_compose():
+    """pct gates the draw; maxhits caps actual fires."""
+    failpoint.seed(11)
+    failpoint.enable("combo", "drop", pct=100, maxhits=2)
+    assert [failpoint.inject("combo") for _ in range(4)] \
+        == [True, True, False, False]
+
+
 def test_wal_write_failpoint(tmp_path):
     eng = Engine(str(tmp_path / "d"))
     eng.write_points("db0", parse_lines("m v=1 1000"))

@@ -1,0 +1,248 @@
+"""Unit tests for the cluster fault-hardening layer: per-peer circuit
+breakers, deadline propagation, partial-result tagging, and the raft
+restart lease fence (ADVICE r5)."""
+
+import threading
+import time
+
+import pytest
+
+from opengemini_tpu.cluster.transport import (CircuitBreaker,
+                                              CircuitOpenError,
+                                              RPCClient, RPCError,
+                                              RPCServer, breaker_for,
+                                              breaker_stats,
+                                              reset_breakers)
+from opengemini_tpu.utils import deadline
+from opengemini_tpu.utils.errors import ErrQueryTimeout
+
+
+# ------------------------------------------------------ circuit breaker
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_fast_fails(self):
+        br = CircuitBreaker("x:1")
+        for _ in range(br.fail_threshold - 1):
+            br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            br.allow()
+        assert time.monotonic() - t0 < 0.05
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker("x:1")
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_probe_recovers_and_backoff_grows(self):
+        br = CircuitBreaker("x:1")
+        br.base_cooldown_s = 0.01
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == "open"
+        first_probe_at = br.probe_at
+        time.sleep(0.02)
+        # cooldown over: one caller becomes the probe...
+        assert br.allow() is True
+        # ...others fail fast while it is in flight
+        with pytest.raises(CircuitOpenError):
+            br.allow()
+        # probe failure re-opens with a LONGER (jittered 2x) cooldown
+        br.record_failure()
+        assert br.state == "open" and br.open_cycles == 2
+        assert br.probe_at > first_probe_at
+        # eventual probe success closes fully
+        time.sleep(0.05)
+        assert br.allow() is True
+        br.record_success()
+        assert br.state == "closed" and br.open_cycles == 0
+
+    def test_backoff_exponent_capped(self):
+        br = CircuitBreaker("x:1")
+        br.open_cycles = 10_000       # long-dead peer must not overflow
+        br.record_failure()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        assert br.probe_at - time.monotonic() <= br.max_cooldown_s * 1.5
+
+    def test_force_and_snapshot(self):
+        br = CircuitBreaker("x:1")
+        br.force(True)
+        assert br.state == "open" and br.snapshot()["state"] == "open"
+        br.force(False)
+        assert br.state == "closed"
+
+    def test_registry_shared_and_resettable(self):
+        reset_breakers()
+        a = breaker_for("h:9")
+        assert breaker_for("h:9") is a
+        a.record_failure()
+        assert breaker_stats()["h:9"]["failures"] == 1
+        reset_breakers()
+        assert "h:9" not in breaker_stats()
+
+
+def test_breaker_integration_dead_peer_fast_fail():
+    """Transport-level: a dead peer trips the shared breaker; further
+    calls (any client to that addr) fail in <50ms; a live handler error
+    does NOT count as a transport failure."""
+    reset_breakers()
+    srv = RPCServer(handlers={"boom": lambda b: 1 / 0})
+    srv.start()
+    addr = srv.addr
+    live = RPCClient(addr)
+    for _ in range(5):
+        with pytest.raises(RPCError):
+            live.call("boom", timeout=5.0)
+    assert breaker_for(addr).state == "closed"   # peer alive: no trip
+    live.close()
+    srv.stop()
+    # now the port is dead: consecutive connect failures trip it
+    cli = RPCClient(addr, connect_timeout=0.5)
+    for _ in range(4):
+        with pytest.raises(RPCError):
+            cli.call("ping", timeout=1.0)
+    assert breaker_for(addr).state == "open"
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        cli.call("ping", timeout=1.0)
+    assert time.monotonic() - t0 < 0.05
+    cli.close()
+    reset_breakers()
+
+
+# ------------------------------------------------------------- deadline
+
+class TestDeadline:
+    def test_clamp_and_expiry(self):
+        dl = deadline.Deadline(0.05, what="query")
+        assert 0 < dl.clamp(60.0) <= 0.05
+        assert dl.clamp(0.01) <= 0.01
+        time.sleep(0.06)
+        assert dl.expired
+        with pytest.raises(ErrQueryTimeout, match="deadline exceeded"):
+            dl.clamp(60.0)
+        with pytest.raises(ErrQueryTimeout):
+            dl.check("here")
+
+    def test_bind_scopes_to_thread_context(self):
+        assert deadline.current() is None
+        with deadline.bind(5.0) as dl:
+            assert deadline.current() is dl
+            assert deadline.clamp(60.0) <= 5.0
+            # worker threads do NOT inherit the contextvar — fan-out
+            # code must capture current() before spawning
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(deadline.current()))
+            t.start()
+            t.join()
+            assert seen == [None]
+        assert deadline.current() is None
+
+    def test_bind_none_is_unbounded(self):
+        with deadline.bind(None) as dl:
+            assert dl is None and deadline.current() is None
+            assert deadline.clamp(60.0) == 60.0
+
+    def test_rpc_timeout_clamped_by_deadline(self):
+        """A 60s RPC wait inside a 0.3s budget returns (typed) within
+        the budget, not the per-call timeout."""
+        srv = RPCServer(
+            handlers={"slow": lambda b: time.sleep(5) or {}})
+        srv.start()
+        cli = RPCClient(srv.addr)
+        t0 = time.monotonic()
+        with deadline.bind(0.3, what="query"):
+            with pytest.raises(RPCError):
+                cli.call("slow", timeout=60.0)
+        assert time.monotonic() - t0 < 1.5
+        cli.close()
+        srv.stop()
+
+    def test_try_call_stops_on_exhausted_budget(self):
+        cli = RPCClient("127.0.0.1:1", connect_timeout=0.2)
+        t0 = time.monotonic()
+        with deadline.bind(0.4, what="write"):
+            with pytest.raises(RPCError):
+                cli.try_call("ping", timeout=1.0, retries=10,
+                             backoff=0.3)
+        assert time.monotonic() - t0 < 2.0
+        cli.close()
+        reset_breakers()
+
+
+# -------------------------------------------------- partial-result tags
+
+class TestPartialTagging:
+    def test_tag_partial(self):
+        from opengemini_tpu.cluster.sql_node import (ScatterResult,
+                                                     _tag_partial)
+        clean = ScatterResult([{"a": 1}])
+        degraded = ScatterResult([{"a": 1}], failed=["s1: down"])
+        assert "partial" not in _tag_partial({"series": []}, clean)
+        out = _tag_partial({"series": []}, degraded)
+        assert out["partial"] is True
+        # error results are not double-tagged
+        err = _tag_partial({"error": "x"}, degraded)
+        assert "partial" not in err
+        # caller-known degradation via the keyword (no sentinel lists)
+        assert _tag_partial({"series": []}, clean,
+                            degraded=True)["partial"] is True
+        # store responses with an unsound read barrier flag propagate
+        barrier = ScatterResult([{"series_lists": [], "degraded": True}])
+        assert _tag_partial({"series": []}, barrier)["partial"] is True
+
+    def test_syscontrol_breaker_mod_read_vs_force(self):
+        from opengemini_tpu.utils.syscontrol import SysControl
+        sc = SysControl()
+        reset_breakers()
+        # addr without switchon is a READ: unknown addr -> 404, and no
+        # registry entry is created for it
+        code, _ = sc.handle("circuitbreaker", {"addr": "h:1"})
+        assert code == 404 and "h:1" not in breaker_stats()
+        # explicit switchon=true force-trips; reading it back shows open
+        code, doc = sc.handle("circuitbreaker",
+                              {"addr": "h:1", "switchon": "true"})
+        assert code == 200 and doc["state"] == "open"
+        code, doc = sc.handle("circuitbreaker", {"addr": "h:1"})
+        assert code == 200 and doc["state"] == "open"
+        code, doc = sc.handle("circuitbreaker",
+                              {"addr": "h:1", "switchon": "false"})
+        assert doc["state"] == "closed"
+        reset_breakers()
+
+    def test_scatter_result_is_a_list(self):
+        from opengemini_tpu.cluster.sql_node import ScatterResult
+        r = ScatterResult([1, 2], failed=["a"])
+        assert list(r) == [1, 2] and r.failed == ["a"]
+
+
+# ------------------------------------------------- raft restart fence
+
+def test_raft_restart_refuses_votes_inside_lease_window(tmp_path):
+    """ADVICE r5: a freshly-started raft node (leader_id None) must
+    refuse votes for ELECTION_MIN after startup so a challenger cannot
+    be elected inside a live leader's lease window."""
+    from opengemini_tpu.cluster.raft import ELECTION_MIN, RaftNode
+
+    n = RaftNode("a", {"a": "127.0.0.1:0", "b": "127.0.0.1:1",
+                       "c": "127.0.0.1:2"}, str(tmp_path / "a"),
+                 fsm_apply=lambda c: None,
+                 fsm_snapshot=lambda: {},
+                 fsm_restore=lambda d: None)
+    req = {"term": 99, "candidate": "b",
+           "last_log_index": 10, "last_log_term": 9}
+    # inside the startup window: refused even with leader_id None
+    assert n._on_request_vote(dict(req))["granted"] is False
+    # after the window: granted (candidate log is up to date)
+    n._started_at = time.monotonic() - ELECTION_MIN * 1.1
+    assert n._on_request_vote(dict(req))["granted"] is True
+    n.server.stop()
