@@ -127,6 +127,15 @@ def run_child(args: list, timeout: float, env=None) -> tuple:
         _CHILDREN.remove(p)
 
 
+def _pipeline_depth() -> int:
+    """The depth the executor will actually use — same parser as
+    opengemini_tpu/ops/pipeline.py, so the benchmark artifact cannot
+    claim a path the queries didn't take (a raw int() here diverged on
+    malformed values)."""
+    from opengemini_tpu.ops.pipeline import pipeline_depth
+    return pipeline_depth()
+
+
 def _cpu_env() -> dict:
     # identical engine/code, JAX pinned to host CPU. The axon
     # sitecustomize registers the TPU-tunnel PJRT plugin whenever
@@ -205,17 +214,42 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
         out[key] = {"best_s": min(times), "digest": dig,
                     "cells": n_cells}
     # per-phase wall times from EXPLAIN ANALYZE: plan / dispatch /
-    # kernel+pull / fold / finalize of the 1h shape
+    # kernel+pull / fold / finalize of the 1h shape. With the streaming
+    # pipeline the device_pull span OVERLAPS the others (it opens at
+    # the first background pull), so sum(phases) > query wall is the
+    # overlap proof, and pull_bytes / pull wall gives the effective
+    # link throughput next to it
     (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
     res = ex.execute(est, "bench")
+    out.update(_parse_phases(res))
+    eng.close()
+    return out
+
+
+def _parse_phases(res: dict) -> dict:
+    import re
     phases = {}
+    pull_bytes = 0
+    streamed = 0
     for row in res.get("series", [{}])[0].get("values", []):
         line = row[0].strip()
         name, _, rest = line.partition(":")
         if "ms" in rest:
             phases[name] = float(rest.split("ms")[0].strip())
-    out["phases_ms"] = phases
-    eng.close()
+        if name == "device_pull":
+            m = re.search(r"pull_bytes=(\d+)", rest)
+            if m:
+                pull_bytes = int(m.group(1))
+            m = re.search(r"streamed=(\d+)", rest)
+            if m:
+                streamed = int(m.group(1))
+    out = {"phases_ms": phases, "pull_bytes": pull_bytes,
+           "streamed_launches": streamed}
+    pull_ms = phases.get("device_pull", 0.0)
+    out["pull_gbps"] = round(pull_bytes / 1e9 / (pull_ms / 1e3), 3) \
+        if pull_ms > 0 else 0.0
+    # overlap proof: children phase wall vs the root query span
+    out["phase_sum_ms"] = round(sum(phases.values()), 3)
     return out
 
 
@@ -325,7 +359,12 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         "bit_identical": True,
         "kernel_rows_per_sec": round(kernel_rps, 1),
         "http_query_ms": round(http_ms, 1),
-        "phases_ms": tpu.get("phases_ms", {})}
+        "phases_ms": tpu.get("phases_ms", {}),
+        "phase_sum_ms": tpu.get("phase_sum_ms", 0.0),
+        "pull_bytes": tpu.get("pull_bytes", 0),
+        "pull_gbps": tpu.get("pull_gbps", 0.0),
+        "streamed_launches": tpu.get("streamed_launches", 0),
+        "pipeline_depth": _pipeline_depth()}
 
 
 # ------------------------------------------- colstore (config 3)
@@ -647,6 +686,74 @@ def scale_phase(cpu_timeout: float) -> dict:
             "result_cells": tpu["cells"]}
 
 
+# -------------------------------------------------- perf smoke (CPU)
+
+def smoke_phase() -> dict:
+    """CPU streaming-equivalence gate (scripts/perf_smoke.sh): a tiny
+    dataset runs every query shape through the streaming pipeline AND
+    the single-barrier fallback, on both lattice fold routes (device /
+    host) with the lattice route force-enabled — any result-cell
+    disagreement is fatal. Phase output (phases_ms, pull_bytes) prints
+    alongside so CI logs show the pipeline working."""
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    checked = 0
+    with tempfile.TemporaryDirectory(prefix="og-smoke-", dir=shm) as td:
+        _register_tmp(td)
+        n_rows = build_dataset(td)
+        eng = Engine(td, EngineOptions(shard_duration=1 << 62))
+        ex = QueryExecutor(eng)
+
+        def run(qtext):
+            (stmt,) = parse_query(qtext)
+            res = ex.execute(stmt, "bench")
+            if "error" in res:
+                raise SystemExit(f"smoke query error: {res['error']}")
+            return _digest_series(res)
+
+        configs = [("stream", {"OG_PIPELINE_DEPTH": "4"}),
+                   ("barrier", {"OG_PIPELINE_DEPTH": "0"}),
+                   ("stream-hostfold", {"OG_PIPELINE_DEPTH": "4",
+                                        "OG_LATTICE_DEVICE_FOLD": "0"}),
+                   ("barrier-hostfold", {"OG_PIPELINE_DEPTH": "0",
+                                         "OG_LATTICE_DEVICE_FOLD": "0"})]
+        # force the block path + lattice route so the smoke covers the
+        # shapes the streaming pipeline actually rewires
+        E.BLOCK_MIN_RATIO = 0
+        for forced_lattice in (False, True):
+            if forced_lattice:
+                E.BLOCK_MAX_CELLS = 8
+                E.BLOCK_MIN_RATIO_PACKED = 0
+            for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
+                               ("cfg1", QUERY_CFG1)):
+                ref = None
+                for cname, env in configs:
+                    for k, v in env.items():
+                        os.environ[k] = v
+                    dig, cells = run(qtext)
+                    checked += cells
+                    if ref is None:
+                        ref = (cname, dig)
+                    elif dig != ref[1]:
+                        raise SystemExit(
+                            f"SMOKE MISMATCH [{key} lattice="
+                            f"{forced_lattice}]: {cname} {dig[:16]} != "
+                            f"{ref[0]} {ref[1][:16]}")
+                    for k in env:
+                        os.environ.pop(k, None)
+        (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
+        phases = _parse_phases(ex.execute(est, "bench"))
+        eng.close()
+    return {"metric": "perf_smoke_streaming_equivalence",
+            "value": 1, "unit": "pass", "rows": n_rows,
+            "cells_checked": checked,
+            "configs": [c for c, _e in configs],
+            **phases}
+
+
 # --------------------------------------------------------------- main
 
 # conservative wall-clock estimates (s) used to gate auxiliaries; a
@@ -666,7 +773,7 @@ def main():
     ap.add_argument("--phase",
                     choices=["query", "csquery", "promquery",
                              "scalequery", "headline", "csfull",
-                             "promfull", "scalefull"],
+                             "promfull", "scalefull", "smoke"],
                     default=None)
     ap.add_argument("--data", default=None)
     ap.add_argument("--runs", type=int, default=3)
@@ -688,6 +795,9 @@ def main():
         return
     if args.phase == "scalequery":
         print(json.dumps(scale_query_phase(args.data, args.runs)))
+        return
+    if args.phase == "smoke":
+        print(json.dumps(smoke_phase()))
         return
     if args.phase == "headline":
         print(json.dumps(headline_phase(

@@ -140,6 +140,8 @@ class HttpServer:
             sp.register("executor", executor_collector)
             sp.register("devicecache", devicecache_collector)
             sp.register("device", device_collector)
+            from ..ops.devstats import phase_collector
+            sp.register("query_phases", phase_collector)
             sp.register("wal", wal_collector)
             sp.register("raft", raft_collector)
             sp.register("subscriber", subscriber_collector)
@@ -625,11 +627,13 @@ class HttpServer:
                                    raft_collector, readcache_collector,
                                    rpc_collector, runtime_collector,
                                    subscriber_collector, wal_collector)
+        from ..ops.devstats import phase_collector
         groups = {"runtime": runtime_collector(),
                   "readcache": readcache_collector(),
                   "executor": executor_collector(),
                   "devicecache": devicecache_collector(),
                   "device": device_collector(),
+                  "query_phases": phase_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
@@ -1137,7 +1141,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if path == "/debug/vars":
-            self._reply(200, srv.stats)
+            # httpd counters stay top-level (compat); the device plane,
+            # cache-tier, and per-phase groups nest below so an
+            # operator can read transfer volumes, DeviceBlockCache
+            # hit/miss/eviction, and the executor phase split without
+            # attaching EXPLAIN ANALYZE
+            from ..ops.devstats import device_collector, phase_collector
+            from ..utils.stats import devicecache_collector
+            out = dict(srv.stats)
+            out["device"] = device_collector()
+            out["devicecache"] = devicecache_collector()
+            out["query_phases"] = phase_collector()
+            self._reply(200, out)
             return
         if path == "/debug/ctrl":
             if not self._admin_gate(user):

@@ -1059,59 +1059,215 @@ def file_lattice(slabs: list, gids: np.ndarray, t_lo, t_hi,
     return outs
 
 
+def new_lattice_acc(num_segments: int, want: tuple, K_full: int):
+    """Fresh host fold accumulators [counts, limbs|None, badg|None] for
+    fold_lattice_into — shared across all slabs of one (field, scale)
+    group, fillable in ANY order (every op is an exact integer add or a
+    flag OR, so the streaming pipeline's arrival-order folds are
+    bit-identical to the grouped fold)."""
+    with_sum = "sum" in want
+    return [np.zeros(num_segments, dtype=np.float64),
+            np.zeros((num_segments, K_full), dtype=np.float64)
+            if with_sum else None,
+            np.zeros(num_segments, dtype=np.uint8) if with_sum
+            else None]
+
+
+def fold_lattice_into(acc: list, st: BlockStack, d, WL: int,
+                      gids: np.ndarray, start: int, interval: int,
+                      W: int, num_segments: int, want: tuple,
+                      K_full: int) -> None:
+    """Fold ONE pulled slab lattice into shared accumulators (see
+    new_lattice_acc). Native single pass when available; vectorized
+    bincount fallback. NOT thread-safe per accumulator — callers
+    folding concurrently hold their own lock."""
+    from .. import native
+    ns = num_segments
+    counts, limbs, badg = acc
+    with_sum = "sum" in want
+    K = st.limbs.shape[-1]
+    k0 = st.k0
+    c8 = np.ascontiguousarray(d[0], dtype=np.int8)
+    l32 = (np.ascontiguousarray(d[1], dtype=np.int32)
+           if with_sum else None)
+    b8 = (np.ascontiguousarray(d[2], dtype=np.uint8)
+          if with_sum else None)
+    g = np.ascontiguousarray(gids, dtype=np.int64)
+    # host w0: MUST mirror the kernel's formula
+    t0 = np.asarray(st.t_min, dtype=np.int64)
+    w0 = np.clip((np.maximum(t0, start) - start) // interval,
+                 0, W - 1).astype(np.int64)
+    if native.fold_lattice(c8, l32, b8, g, w0, W, ns, k0,
+                           K if with_sum else 0, K_full, counts,
+                           limbs, badg):
+        return
+    # numpy fallback: flat bincount per plane over live entries
+    wloc = np.arange(WL, dtype=np.int64)
+    wabs = w0[:, None] + wloc[None, :]
+    live = (g[:, None] >= 0) & (wabs < W)
+    cells = (g[:, None] * W + wabs)[live]
+    counts += np.bincount(
+        cells, weights=c8[live].astype(np.float64),
+        minlength=ns)[:ns]
+    if with_sum:
+        for k in range(K):
+            limbs[:, k0 + k] += np.bincount(
+                cells, weights=l32[k][live].astype(np.float64),
+                minlength=ns)[:ns]
+        badg |= (np.bincount(
+            cells, weights=(b8[live] != 0).astype(np.float64),
+            minlength=ns)[:ns] > 0).astype(np.uint8)
+
+
+def lattice_acc_bo(acc: list, want: tuple) -> dict:
+    """Accumulators → the bo dict the executor folds."""
+    counts, limbs, badg = acc
+    bo = {"count": counts}
+    if "sum" in want:
+        bo["limbs"] = limbs
+        bo["bad"] = badg.astype(bool)
+    return bo
+
+
 def fold_lattices(entries: list, gids_by_entry: list, start: int,
                   interval: int, W: int, num_segments: int,
                   want: tuple, K_full: int) -> dict:
     """HOST fold of pulled lattices into one bo dict (count/limbs/bad
-    grids shared across all slabs of a (field, scale) group). Native
-    single pass when available; vectorized bincount fallback."""
-    from .. import native
-    ns = num_segments
-    counts = np.zeros(ns, dtype=np.float64)
-    with_sum = "sum" in want
-    st0 = entries[0][0]
-    K = st0.limbs.shape[-1]
-    k0 = st0.k0
-    limbs = np.zeros((ns, K_full), dtype=np.float64) if with_sum \
-        else None
-    badg = np.zeros(ns, dtype=np.uint8) if with_sum else None
+    grids shared across all slabs of a (field, scale) group)."""
+    acc = new_lattice_acc(num_segments, want, K_full)
     for (st, d, WL), g in zip(entries, gids_by_entry):
-        c8 = np.ascontiguousarray(d[0], dtype=np.int8)
-        l32 = (np.ascontiguousarray(d[1], dtype=np.int32)
-               if with_sum else None)
-        b8 = (np.ascontiguousarray(d[2], dtype=np.uint8)
-              if with_sum else None)
-        g = np.ascontiguousarray(g, dtype=np.int64)
-        # host w0: MUST mirror the kernel's formula
-        t0 = np.asarray(st.t_min, dtype=np.int64)
-        w0 = np.clip((np.maximum(t0, start) - start) // interval,
-                     0, W - 1).astype(np.int64)
-        if native.fold_lattice(c8, l32, b8, g, w0, W, ns, k0,
-                               K if with_sum else 0, K_full, counts,
-                               limbs, badg):
-            continue
-        # numpy fallback: flat bincount per plane over live entries
-        B = len(g)
-        wloc = np.arange(WL, dtype=np.int64)
-        wabs = w0[:, None] + wloc[None, :]
-        live = (g[:, None] >= 0) & (wabs < W)
-        cells = (g[:, None] * W + wabs)[live]
-        counts += np.bincount(
-            cells, weights=c8[live].astype(np.float64),
-            minlength=ns)[:ns]
+        fold_lattice_into(acc, st, d, WL, g, start, interval, W,
+                          num_segments, want, K_full)
+    return lattice_acc_bo(acc, want)
+
+
+# -------------------------------------------- on-device lattice fold
+
+def lattice_fold_on_device() -> bool:
+    """Gate for folding window lattices ON DEVICE before the pull
+    (OG_LATTICE_DEVICE_FOLD, default on): lattice entries ≥ result
+    cells (several blocks of a group contribute to the same window), so
+    reducing to ONE (G, W) plane-set per (field, scale) group — then
+    shipping it through the packed uint32 transport — only shrinks the
+    bytes crossing the slow D2H link. Read dynamically (perf_smoke
+    compares both routes cell for cell)."""
+    return os.environ.get("OG_LATTICE_DEVICE_FOLD", "1") != "0"
+
+
+def _lattice_cells(st: BlockStack, gids: np.ndarray, start: int,
+                   interval: int, W: int, WL: int,
+                   num_segments: int) -> np.ndarray:
+    """Host-built flat cell index of one slab's (B, WL) lattice: entry
+    (b, j) lands in cell gids[b]·W + w0[b] + j; dead entries (filtered
+    block, window past W) land in the trash segment. MUST mirror the
+    lattice kernel's w0 formula (and fold_lattice_into's)."""
+    g = np.asarray(gids, dtype=np.int64)
+    t0 = np.asarray(st.t_min, dtype=np.int64)
+    w0 = np.clip((np.maximum(t0, start) - start) // interval,
+                 0, W - 1).astype(np.int64)
+    wabs = w0[:, None] + np.arange(WL, dtype=np.int64)[None, :]
+    cells = g[:, None] * W + wabs
+    dead = (g[:, None] < 0) | (wabs >= W)
+    return np.where(dead, num_segments, cells).reshape(-1).astype(
+        np.int32)
+
+
+def cached_cells(cells: np.ndarray):
+    """Device copy of a lattice cell index, content-keyed in the device
+    cache (the per-(slab, grouping, window) index repeats across warm
+    dashboard queries — zero H2D on repeats)."""
+    import jax
+    if not devicecache.enabled():
+        return jax.device_put(cells)
+    import hashlib
+    h = hashlib.blake2b(cells.tobytes(), digest_size=16).hexdigest()
+    cache = devicecache.global_cache()
+    key = ("latcells", h, len(cells))
+    got = cache.get(key)
+    if got is not None:
+        return got
+    dev = jax.device_put(cells)
+    from . import devstats
+    devstats.bump("h2d_bytes", int(dev.nbytes))
+    devstats.bump("h2d_uploads")
+    cache.put_sized(key, dev, int(dev.nbytes))
+    return dev
+
+
+def _kernel_lattice_fold(num_segments: int, want: tuple, K: int,
+                         sorted_cells: bool):
+    """jit: one slab's lattice (the _kernel_lattice output) scattered
+    onto the (num_segments) cell grid as a plane_layout-ordered f64
+    plane grid — ONE fused (N, P) segment_sum of exact integers (every
+    plane value is an int < 2^31 and every cell total < 2^49, so the
+    f64 adds are exact and order-free: bit-identical to the host C
+    fold). The output composes with _pairwise_combine (cross-slab /
+    cross-file merge on device) and pack_grid (uint32 transport), so a
+    whole (field, scale) group crosses D2H as one packed grid. The
+    `bad` plane carries the COUNT of bad contributions — every
+    consumer (pack kernel, unpack_planes, combine) only tests > 0."""
+    key = ("klf", num_segments, want, K, sorted_cells)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    ns = num_segments + 1
+    with_sum = "sum" in want
+
+    @jax.jit
+    def _f(c8, l32, b8, cells):
+        parts = [c8.astype(jnp.float64).reshape(-1)]
         if with_sum:
-            for k in range(K):
-                limbs[:, k0 + k] += np.bincount(
-                    cells, weights=l32[k][live].astype(np.float64),
-                    minlength=ns)[:ns]
-            badg |= (np.bincount(
-                cells, weights=(b8[live] != 0).astype(np.float64),
-                minlength=ns)[:ns] > 0).astype(np.uint8)
-    bo = {"count": counts}
-    if with_sum:
-        bo["limbs"] = limbs
-        bo["bad"] = badg.astype(bool)
-    return bo
+            lf = l32.astype(jnp.float64).reshape(K, -1)
+            parts += [lf[k] for k in range(K)]
+            parts.append(b8.astype(jnp.float64).reshape(-1))
+        data = jnp.stack(parts, axis=1)              # (B·WL, P)
+        out = jax.ops.segment_sum(data, cells, ns,
+                                  indices_are_sorted=sorted_cells)
+        return out[:num_segments].T                  # (P, S)
+
+    _JITTED[key] = _f
+    return _f
+
+
+def file_lattice_fold(slabs: list, gids: np.ndarray, t_lo, t_hi,
+                      start: int, interval: int, W: int,
+                      num_segments: int, want: tuple, scalars=None,
+                      gids_dev=None):
+    """Lattice kernel per slab + ON-DEVICE fold + on-device combine:
+    one (P, num_segments) plane grid for the whole file-field, still
+    resident (the caller merges across files with _pairwise_combine and
+    packs ONE transport grid per (field, scale) group). Caller must
+    have passed lattice_eligible first."""
+    import jax
+    K = slabs[0].limbs.shape[-1]
+    if scalars is None:
+        scalars = query_scalars(t_lo, t_hi, start, interval)
+    if gids_dev is None:
+        gids_dev = jax.device_put(np.asarray(gids, dtype=np.int64))
+    out = None
+    comb = _pairwise_combine(want, K)
+    from . import devstats
+    for st in slabs:
+        g = gids_dev[st.block0:st.block0 + st.n_blocks]
+        gh = np.asarray(gids[st.block0:st.block0 + st.n_blocks],
+                        dtype=np.int64)
+        _w0, _wl, WL = _prefix_spans(st, gh, start, interval, W)
+        fn = _kernel_lattice(want, K, st.seg_rows, WL, W)
+        d = fn(st.valid, st.times, st.limbs, st.bad, g, scalars,
+               st.t0_dev, st.step_dev, st.rows_dev)
+        cells = _lattice_cells(st, gh, start, interval, W, WL,
+                               num_segments)
+        srt = bool(np.all(cells[:-1] <= cells[1:])) if len(cells) \
+            else True
+        ffn = _kernel_lattice_fold(num_segments, want, K, srt)
+        o = ffn(d[0], d[1] if len(d) > 1 else None,
+                d[2] if len(d) > 2 else None, cached_cells(cells))
+        devstats.bump("kernel_launches", 2)
+        out = o if out is None else comb(out, o)
+    return out
 
 
 def _prefix_spans(st: BlockStack, gids: np.ndarray, start: int,

@@ -8,6 +8,18 @@ dashboard steady state). Entries are jax Arrays keyed by a fingerprint
 of the immutable source segments (file path + offset + trim), so
 compaction — which writes new paths — naturally invalidates.
 
+Three tiers share the machinery:
+- HBM block-slab tier (``global_cache``): whole-file segment stacks for
+  ops/blockagg.py, plus content-keyed gid/cell vectors.
+- Host pin tier (``host_cache``): assembled dense blocks, limb sums and
+  result grids as numpy arrays — its own budget (OG_HOST_CACHE_MB).
+- Decoded-plane tier (``get_decoded_planes``/``put_decoded_planes``):
+  the assembled (S, P) dense value/valid planes AND their exact-sum
+  limb planes as DEVICE arrays, keyed by the dense group's fragment
+  fingerprint. A hit means a repeat (dashboard) query skips decode
+  (host pins), H2D, and limb decomposition entirely — the device
+  dense path (OG_DENSE_DEVICE) reduces straight from residency.
+
 Byte-budgeted LRU; OG_DEVICE_CACHE_MB sets the budget (0 disables).
 """
 
@@ -52,7 +64,13 @@ class DeviceBlockCache:
             return key in self._map
 
     def put(self, key: tuple, arr) -> None:
-        nb = self._nbytes(arr) + 64
+        self.put_sized(key, arr, self._nbytes(arr))
+
+    def put_sized(self, key: tuple, arr, nbytes: int) -> None:
+        """put with an explicit byte charge — for entries whose cost
+        the generic ``.nbytes`` probe can't see (tuples of device
+        arrays, slab lists)."""
+        nb = int(nbytes) + 64
         if nb > self.capacity:
             return
         with self._lock:
@@ -121,3 +139,111 @@ def host_cache() -> DeviceBlockCache:
     if _HOST_CACHE is None:
         _HOST_CACHE = DeviceBlockCache(host_capacity_bytes())
     return _HOST_CACHE
+
+
+# ------------------------------------------------ decoded-plane tier
+
+class _NoPlanes:
+    """Negative marker: this (fragment, field, scale) has limb residue
+    rows, so the device dense path must not claim it (the f64 fallback
+    state would have to reproduce the host's summation order)."""
+    nbytes = 0
+
+
+NO_PLANES = _NoPlanes()
+
+# tier-local counters (surfaced via devicecache_collector → /debug/vars
+# and /metrics): a dashboard repeat hitting this tier is the proof that
+# decode+H2D were skipped, so the counters are the acceptance signal
+PLANE_STATS: dict = {"plane_hits": 0, "plane_misses": 0,
+                     "plane_puts": 0, "plane_put_bytes": 0,
+                     "plane_negative": 0}
+
+
+def _bump_plane(key: str, n: int = 1) -> None:
+    from ..utils.stats import bump as _b
+    _b(PLANE_STATS, key, n)
+
+
+def _vals_key(fp: str, field: str) -> tuple:
+    # the (S, P) value/valid planes are scale-independent — one entry
+    # serves every query shape over the group
+    return ("dplanes", fp, field)
+
+
+def _limb_key(fp: str, field: str, E) -> tuple:
+    # limb planes decomposed at scale E are only additive against
+    # grids at the same scale, so E is part of THEIR identity only
+    return ("dlimbs", fp, field, E)
+
+
+def get_decoded_planes(fp: str, field: str, E):
+    """Device-resident (vals, valid, limbs|None) planes for one dense
+    group's field, or NO_PLANES (negative marker: limb residue rows at
+    this scale), or None (miss). E None means the query needs no exact
+    sums — the shared value/valid entry alone satisfies it."""
+    if not enabled():
+        return None
+    cache = global_cache()
+    base = cache.get(_vals_key(fp, field))
+    if base is None:
+        _bump_plane("plane_misses")
+        return None
+    if E is None:
+        _bump_plane("plane_hits")
+        return (base[0], base[1], None)
+    lb = cache.get(_limb_key(fp, field, E))
+    if lb is NO_PLANES:
+        return NO_PLANES
+    if lb is None:
+        _bump_plane("plane_misses")
+        return None
+    _bump_plane("plane_hits")
+    return (base[0], base[1], lb)
+
+
+def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
+    """Stake one dense group's decoded (S, P) planes (and the (S, P, K)
+    limb planes when the query needs exact sums) into HBM, keyed by the
+    group fingerprint. The value/valid pair is shared across scales —
+    an exact-sum query following a count/min-only one uploads ONLY the
+    limb planes. Returns the device entry (usable immediately even
+    when the cache is disabled or over budget)."""
+    import jax
+
+    from . import devstats
+    cache = global_cache() if enabled() else None
+    base = cache.get(_vals_key(fp, field)) if cache is not None \
+        else None
+    nb = 0
+    if base is None:
+        dv = jax.device_put(vals)
+        dm = jax.device_put(valid)
+        nb += int(dv.nbytes + dm.nbytes)
+        base = (dv, dm)
+        if cache is not None:
+            cache.put_sized(_vals_key(fp, field), base,
+                            int(dv.nbytes + dm.nbytes))
+    dl = None
+    if limbs is not None:
+        dl = jax.device_put(limbs)
+        nb += int(dl.nbytes)
+        if cache is not None:
+            cache.put_sized(_limb_key(fp, field, E), dl,
+                            int(dl.nbytes))
+    if nb:
+        devstats.bump("h2d_bytes", nb)
+        devstats.bump("h2d_uploads")
+    if cache is not None:
+        _bump_plane("plane_puts")
+        _bump_plane("plane_put_bytes", nb)
+    return (base[0], base[1], dl)
+
+
+def put_no_planes(fp: str, field: str, E) -> None:
+    """Mark (group, field, scale) as undecomposable (residue rows):
+    the bad flags depend on E, so the marker lives on the limb key and
+    the shared value/valid entry stays usable for non-exact queries."""
+    if enabled():
+        global_cache().put(_limb_key(fp, field, E), NO_PLANES)
+        _bump_plane("plane_negative")

@@ -24,6 +24,28 @@ DEVICE_STATS: dict = {
     "kernel_launches": 0,    # block/lattice/pack/sparse dispatches
     "slabs_built": 0,        # HBM block stacks assembled
     "slab_bytes": 0,         # bytes of stacks uploaded at build time
+    "stream_launches": 0,    # launches routed through the pipeline
+    "stream_queries": 0,     # queries that used the streaming path
+    # gauges (last completed query, not cumulative): the numbers an
+    # operator needs to judge whether the pull or the kernel is the
+    # current wall without attaching EXPLAIN ANALYZE
+    "last_query_d2h_bytes": 0,
+    "last_query_pull_ms": 0,
+}
+
+# cumulative wall time per executor phase (ns), across ALL queries —
+# the span tree only exists under EXPLAIN ANALYZE, but capacity
+# planning needs the steady-state split (reader_scan vs device_agg vs
+# device_pull vs grid_fold vs finalize). With the streaming pipeline
+# the phases OVERLAP, so their sum exceeding wall clock is the design
+# working, not double counting.
+QUERY_PHASE_NS: dict = {
+    "reader_scan_ns": 0,
+    "device_agg_ns": 0,
+    "device_pull_ns": 0,
+    "grid_fold_ns": 0,
+    "finalize_ns": 0,
+    "queries": 0,
 }
 
 
@@ -32,9 +54,39 @@ def bump(key: str, n: int = 1) -> None:
     _b(DEVICE_STATS, key, n)
 
 
+def gauge(key: str, v: int) -> None:
+    """Set a last-value gauge (locked: writers run under the threaded
+    HTTP servers)."""
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        DEVICE_STATS[key] = int(v)
+
+
+def bump_phase(name: str, ns: int) -> None:
+    from ..utils.stats import bump as _b
+    _b(QUERY_PHASE_NS, name + "_ns", int(ns))
+
+
+def count_query() -> None:
+    from ..utils.stats import bump as _b
+    _b(QUERY_PHASE_NS, "queries")
+
+
 def device_collector() -> dict:
     """utils.stats collector: snapshot of the device-plane counters
     (ns accumulate losslessly; ms is derived for readability)."""
     out = dict(DEVICE_STATS)
     out["d2h_wait_ms"] = out.pop("d2h_wait_ns") // 1_000_000
+    return out
+
+
+def phase_collector() -> dict:
+    """utils.stats collector: cumulative per-phase executor wall (ms)
+    plus the query count, for /debug/vars and /metrics."""
+    out = {}
+    for k, v in dict(QUERY_PHASE_NS).items():
+        if k.endswith("_ns"):
+            out[k[:-3] + "_ms"] = v // 1_000_000
+        else:
+            out[k] = v
     return out

@@ -1,0 +1,232 @@
+"""Streaming device pipeline: overlap dispatch, D2H, and host fold.
+
+BENCH_r05 showed the headline query spending 647ms of 789ms blocked in
+one monolithic `device_pull`: every kernel was dispatched, then ONE
+barrier drained the device, then ONE giant transfer crossed the slow
+tunnel link, then the host unpacked — strictly serialized phases. The
+accelerated-analytics literature makes the same diagnosis (PAPERS:
+*GPU Acceleration of SQL Analytics on Compressed Data*; *Tailwind*):
+decode/transfer must overlap compute, and reductions belong on the
+accelerator so only final cells cross the link.
+
+This module is the overlap half of that program:
+
+- ``device_get_parallel`` — the chunked multi-stream fetch (moved from
+  query/executor.py so ops-layer callers can batch their own pulls):
+  per-leaf thread parallelism lifts the tunnel link's large-transfer
+  bandwidth ~54 → ~70 MB/s (measured, 4 streams), chunking bounds the
+  latency of any single fetch.
+- ``StreamingPipeline`` — a bounded-depth launch→pull→host-fold
+  pipeline. The executor submits each launch's device outputs as soon
+  as the launch is issued; a background puller waits for THAT launch's
+  readiness, starts its D2H immediately, and runs the host-side
+  unpack/fold callback — all while later launches are still computing
+  and the scan threads are still decoding. ``OG_PIPELINE_DEPTH`` bounds
+  how many launches may be in flight ahead of their pulls (submit
+  blocks when the window is full, so dispatch proceeds in bounded
+  batches); depth 0 disables streaming entirely and the executor takes
+  the classic single-barrier path.
+
+Bit-identity: the pipeline changes WHEN results cross and WHO folds
+them, never the arithmetic. Host folds that run concurrently are
+restricted to order-free exact operations (integer adds, flag ORs), so
+arrival order cannot change a single output bit — the perf_smoke gate
+(scripts/perf_smoke.sh) asserts streaming == single-barrier cell for
+cell.
+
+Reference role: the streaming chunk return of the reference's executor
+(engine/executor/chunk_codec.gen.go) — results cross the wire in
+bounded pieces concurrently with upstream work, not as one monolithic
+transfer after a global barrier.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def _now_ns() -> int:
+    import time
+    return time.perf_counter_ns()
+
+
+def pipeline_depth() -> int:
+    """Launch window of the streaming pipeline (0 disables). Read
+    dynamically so tests and operators can flip it per query."""
+    try:
+        return int(os.environ.get("OG_PIPELINE_DEPTH", "4"))
+    except ValueError:
+        return 4
+
+
+def pull_threads() -> int:
+    try:
+        return max(1, int(os.environ.get("OG_PIPELINE_THREADS", "4")))
+    except ValueError:
+        return 4
+
+
+def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
+                        stats: dict | None = None):
+    """device_get with per-leaf thread parallelism and chunked fetches
+    of large leaves. The tunnel-attached link serializes transfers and
+    pays a full round trip per pull; concurrent streams overlap that
+    latency and lift large-transfer bandwidth ~54 → ~70 MB/s
+    (measured, 4 streams). Non-device leaves pass through untouched.
+    ``stats`` (optional dict) receives bytes/leaves/pulls of this call
+    so per-query accounting doesn't race the global counters."""
+    import concurrent.futures as cf
+
+    import jax
+
+    from . import devstats as _ds
+    _t_pull0 = _now_ns()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts: list = [None] * len(leaves)
+    jobs: list = []                     # (leaf_idx, chunk_idx, buf)
+    total_b = 0
+    n_dev = 0
+    for i, x in enumerate(leaves):
+        if not isinstance(x, jax.Array):
+            parts[i] = x
+            continue
+        n_dev += 1
+        total_b += x.size * x.dtype.itemsize
+        nb = x.size * x.dtype.itemsize
+        if x.ndim == 0 or nb <= chunk_bytes:
+            jobs.append((i, None, x))
+            continue
+        ax = int(np.argmax(x.shape))
+        n = x.shape[ax]
+        k = min(-(-nb // chunk_bytes), 8)
+        bounds = [n * j // k for j in range(k + 1)]
+        parts[i] = ["chunks", ax, [None] * k]
+        for j in range(k):
+            jobs.append((i, j, (x, ax, bounds[j], bounds[j + 1])))
+    if jobs:
+        def _fetch(t):
+            # slice lazily IN the worker: an eager device-side copy of
+            # every chunk up front would double peak HBM for the
+            # result set before any D2H happened
+            i, j, b = t
+            if isinstance(b, tuple):
+                x, ax, lo, hi = b
+                idx = [slice(None)] * x.ndim
+                idx[ax] = slice(lo, hi)
+                b = x[tuple(idx)]
+            return (i, j, np.asarray(b))
+
+        if len(jobs) == 1 or threads <= 1:
+            jobs_out = [_fetch(j) for j in jobs]
+        else:
+            with cf.ThreadPoolExecutor(min(threads, len(jobs))) as pool:
+                jobs_out = list(pool.map(_fetch, jobs))
+        for i, j, arr in jobs_out:
+            if j is None:
+                parts[i] = arr
+            else:
+                parts[i][2][j] = arr
+    out = [np.concatenate(p[2], axis=p[1])
+           if isinstance(p, list) and p and p[0] == "chunks" else p
+           for p in parts]
+    _ds.bump("d2h_bytes", total_b)
+    _ds.bump("d2h_pulls", len(jobs))
+    _ds.bump("d2h_wait_ns", _now_ns() - _t_pull0)
+    if stats is not None:
+        stats["bytes"] = stats.get("bytes", 0) + total_b
+        stats["leaves"] = stats.get("leaves", 0) + n_dev
+        stats["pulls"] = stats.get("pulls", 0) + len(jobs)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+_PULL_POOL: ThreadPoolExecutor | None = None
+_PULL_POOL_LOCK = threading.Lock()
+
+
+def _pull_pool() -> ThreadPoolExecutor:
+    """Shared daemon puller pool: pull threads spend their lives
+    blocked in the PJRT transfer (GIL released), so a small process-
+    wide pool serves every concurrent query."""
+    global _PULL_POOL
+    with _PULL_POOL_LOCK:
+        if _PULL_POOL is None:
+            _PULL_POOL = ThreadPoolExecutor(
+                max_workers=pull_threads(),
+                thread_name_prefix="og-pipe")
+        return _PULL_POOL
+
+
+class StreamingPipeline:
+    """Bounded-depth launch→pull→host-fold pipeline for one query.
+
+    submit() registers one launch's device output tree right after
+    dispatch; a puller thread waits for that launch's readiness
+    (per-leaf, not a global barrier), starts its D2H immediately with
+    the chunked multi-stream fetch, then runs the optional host
+    ``post`` callback (unpack_packed / lattice fold) — concurrently
+    with later launches still computing on device and the scan pool
+    still decoding on host. submit() blocks while ``depth`` launches
+    are already in flight, so dispatch proceeds in bounded batches and
+    result HBM never exceeds depth × launch output size.
+
+    collect() joins everything and returns {key: post_result}; worker
+    exceptions re-raise there (the executor's normal error path)."""
+
+    def __init__(self, depth: int | None = None):
+        self.depth = depth if depth is not None else pipeline_depth()
+        self._sem = threading.BoundedSemaphore(max(1, self.depth))
+        self._futs: dict = {}
+        self._lock = threading.Lock()
+        self.launches = 0
+        self.first_ns: int | None = None    # first pull start
+        self.last_ns: int | None = None     # last pull/fold end
+        self.bytes = 0
+        self.leaves = 0
+
+    def submit(self, key, tree, post=None) -> None:
+        self._sem.acquire()
+        try:
+            fut = _pull_pool().submit(self._run, tree, post)
+        except BaseException:
+            self._sem.release()
+            raise
+        with self._lock:
+            self.launches += 1
+            self._futs[key] = fut
+
+    def _run(self, tree, post):
+        import jax
+        try:
+            t0 = _now_ns()
+            try:
+                # drain THIS launch only: device_get on in-flight
+                # arrays takes the tunnel's slow synchronous fetch path
+                # (measured 6x the post-completion transfer)
+                jax.block_until_ready(tree)
+            except Exception:
+                pass
+            st: dict = {}
+            host = device_get_parallel(tree, stats=st)
+            out = post(host) if post is not None else host
+            t1 = _now_ns()
+            with self._lock:
+                if self.first_ns is None or t0 < self.first_ns:
+                    self.first_ns = t0
+                if self.last_ns is None or t1 > self.last_ns:
+                    self.last_ns = t1
+                self.bytes += st.get("bytes", 0)
+                self.leaves += st.get("leaves", 0)
+            return out
+        finally:
+            self._sem.release()
+
+    def collect(self) -> dict:
+        """Wait for every submitted pull+fold; first worker exception
+        re-raises here. Safe to call with zero submissions."""
+        with self._lock:
+            futs = dict(self._futs)
+        return {k: f.result() for k, f in futs.items()}

@@ -348,14 +348,28 @@ def multi_segment_aggregate(values_f, valid_f, limbs_f, seg_ids, times,
     i64_keys = [k for k in res._fields
                 if getattr(res, k) is not None
                 and getattr(res, k).dtype != jnp.float64]
+    # ONE readiness wait + ONE parallel chunked fetch for BOTH packed
+    # stacks: the old sequential np.asarray pair paid two full
+    # round-trips on the tunnel link (the second blocked on the first's
+    # completion before its transfer even started)
+    if f64p is not None or i64p is not None:
+        import jax
+
+        from .pipeline import device_get_parallel
+        try:
+            jax.block_until_ready((f64p, i64p))
+        except Exception:
+            pass
+        f64h, i64h = device_get_parallel((f64p, i64p))
+    else:
+        f64h = i64h = None
     rep: dict = {}
-    if f64p is not None:
-        arr = np.asarray(f64p)                # pull 1
+    if f64h is not None:
         for i, k in enumerate(f64_keys):
-            rep[k] = arr[i]
+            rep[k] = f64h[i]
     lsum_np = None
-    if i64p is not None:
-        arr = np.asarray(i64p)                # pull 2
+    if i64h is not None:
+        arr = i64h
         for i, k in enumerate(i64_keys):
             rep[k] = arr[i]
         if lsum is not None:
@@ -526,6 +540,37 @@ def dense_window_aggregate_host(values: np.ndarray,
     return SegmentAggResult(
         count=res.get("count"), sum=res.get("sum"),
         sumsq=res.get("sumsq"), min=res.get("min"), max=res.get("max"))
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "with_limbs"))
+def dense_device_reduce(values: jax.Array, valid: jax.Array,
+                        limbs: jax.Array | None, spec: AggSpec,
+                        with_limbs: bool) -> dict:
+    """Device dense (S, P) reduction of the EXACT-representable states
+    only — the decoded-plane-cache path (ops/devicecache.py decoded
+    tier, OG_DENSE_DEVICE). The f64 value sum is deliberately ABSENT:
+    XLA's reduction order differs from numpy's pairwise order, so a
+    device f64 sum would diverge from the host/CPU-baseline bit
+    pattern. What this kernel returns is order-free:
+      * count — integer sum of the valid mask;
+      * min/max — comparisons never round;
+      * lsum — (S, K) int64 limb-plane sums (exact integer adds; the
+        executor derives the f64 fallback sum from these with
+        finalize_exact, deterministic regardless of platform).
+    """
+    out = {"count": valid.sum(axis=1, dtype=_I64)}
+    # dense blocks assemble as f64 today, but identities stay
+    # dtype-aware (as in the host mirror) so a future typed-int plane
+    # cannot trace jnp.inf into an integer dtype
+    pos_ident, neg_ident = _minmax_idents(values.dtype)
+    if spec.min:
+        out["min"] = jnp.where(valid, values, pos_ident).min(axis=1)
+    if spec.max:
+        out["max"] = jnp.where(valid, values, neg_ident).max(axis=1)
+    if with_limbs:
+        lz = jnp.where(valid[:, :, None], limbs, 0)
+        out["lsum"] = lz.astype(_I64).sum(axis=1)
+    return out
 
 
 def segment_aggregate_host(values: np.ndarray,

@@ -67,6 +67,79 @@ MAX_WINDOWS = 100_000
 from collections import namedtuple as _nt
 _BlockMeta = _nt("_BlockMeta", "E k0 ka")
 
+
+def _ka_k0_of(sl):
+    if isinstance(sl, _BlockMeta):
+        return sl.ka, sl.k0
+    return sl[0].limbs.shape[-1], sl[0].k0
+
+
+def _unpack_block_out(fmt: str, arrs, stack, want: tuple) -> dict:
+    """Packed block-path transport → the host bo dict the executor
+    folds (exact dtype restoration: counts/limbs are integer-valued
+    f64 far below 2^53). Shared by the single-barrier path and the
+    streaming pipeline's background unpack workers."""
+    from ..ops import blockagg as _bagg
+    from ..ops.exactsum import K_LIMBS as _KLu
+    ka, k0 = _ka_k0_of(stack)
+    if fmt == "p":
+        f64x = np.asarray(arrs[2]) if len(arrs) > 2 else None
+        return _bagg.unpack_packed(np.asarray(arrs[0]),
+                                   np.asarray(arrs[1]), want, ka, k0,
+                                   _KLu, f64x)
+    return _bagg.unpack_planes(np.asarray(arrs[0]), want, ka, k0,
+                               _KLu)
+
+
+def _dense_device_on() -> bool:
+    """Dense (S, P) groups reduce ON DEVICE from decoded-plane-cache
+    residency (ops/devicecache.py decoded tier) when OG_DENSE_DEVICE=1.
+    Off by default: the host dense fold is both faster and exactly the
+    CPU baseline's code on tunnel-attached, f64-emulated chips. On
+    directly-attached hardware the device path skips decode AND H2D on
+    warm repeats; it computes only order-free exact states (count,
+    min/max, limb sums) so results stay bit-identical except the f64
+    fallback sum at cells some OTHER source flagged inexact (derived
+    from exact limb totals instead of numpy's pairwise rounding)."""
+    return __import__("os").environ.get("OG_DENSE_DEVICE", "0") == "1"
+
+
+def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
+                      want_exact):
+    """Device dense path for one (group, field). Returns
+    ("res", (res, exact), rkey) on a host-result-cache hit,
+    ("dev", (res_tree, lsum_dev), rkey) when a device launch was
+    issued (caller batches/streams the pull), or None to take the host
+    path (limb residue rows — the f64 fallback state would have to
+    reproduce the host's summation order bit for bit)."""
+    from ..ops import devicecache as _dc
+    e_key = E if want_exact else None
+    rkey = (fp, fname, "ddense_res", spec, e_key)
+    if dcache is not None:
+        got = dcache.get(rkey)
+        if got is not None:
+            return ("res", got, rkey)
+    ent = _dc.get_decoded_planes(fp, fname, e_key)
+    if ent is _dc.NO_PLANES:
+        return None
+    if ent is None:
+        limbs = None
+        if want_exact:
+            from ..ops import exactsum
+            limbs, bad = exactsum.host_limbs(dvals, dvalid, E)
+            if bad.any():
+                _dc.put_no_planes(fp, fname, e_key)
+                return None
+        ent = _dc.put_decoded_planes(fp, fname, e_key, dvals, dvalid,
+                                     limbs)
+    from ..ops.segment_agg import (SegmentAggResult,
+                                   dense_device_reduce)
+    outs = dense_device_reduce(ent[0], ent[1], ent[2], spec,
+                               ent[2] is not None)
+    res_t = SegmentAggResult(count=outs["count"], min=outs.get("min"),
+                             max=outs.get("max"))
+    return ("dev", (res_t, outs.get("lsum")), rkey)
+
 # sparse row counts at or below this reduce on host (numpy) instead of
 # paying device dispatch + result round-trips; the dense/pre-agg paths
 # carry the bulk of large scans either way.
@@ -1182,13 +1255,19 @@ class QueryExecutor:
         else:
             partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
                                        ctx=ctx, span=span, plan=hints)
+        from ..ops import devstats as _dstat
+        _t_fin0 = _now_ns()
         if span is not None:
             with span.child("finalize") as sp:
                 res = finalize_partials(stmt, mst, cs, [partial],
                                         plan=hints)
                 sp.add(series=len(res.get("series", [])))
-            return res
-        return finalize_partials(stmt, mst, cs, [partial], plan=hints)
+        else:
+            res = finalize_partials(stmt, mst, cs, [partial],
+                                    plan=hints)
+        _dstat.bump_phase("finalize", _now_ns() - _t_fin0)
+        _dstat.count_query()
+        return res
 
     def _partial_agg_incremental(self, stmt, db, mst, cs, cond, tag_keys,
                                  inc_query_id: str, iter_id: int,
@@ -1287,8 +1366,29 @@ class QueryExecutor:
         data_tmax = MIN_TIME
 
         scan_sp = span.child("reader_scan") if span is not None else None
+        _t_scan0 = _now_ns()
         if scan_sp is not None:
-            scan_sp.start_ns = _now_ns()
+            scan_sp.start_ns = _t_scan0
+        from ..ops import devstats as _dstat
+        from ..ops import pipeline as _pl
+        # streaming pipeline (tentpole): device launches stream their
+        # D2H + host unpack/fold through background workers while later
+        # launches still compute and the scan pool still decodes;
+        # OG_PIPELINE_DEPTH bounds in-flight launches, 0 restores the
+        # single-barrier path (bit-identical either way — enforced by
+        # scripts/perf_smoke.sh)
+        pipe = _pl.StreamingPipeline() if _pl.pipeline_depth() > 0 \
+            else None
+        n_stream = 0          # streamed packed-grid launches
+        n_lat_stream = 0      # streamed lattice launches (fold in post)
+        lat_host_acc: dict = {}   # (field,E,k0,ka) → host fold acc
+        lat_dev_acc: dict = {}    # (field,E,k0,ka) → device plane grid
+        lat_dev_rows: dict = {}
+        dense_dev_pending: list = []   # device dense-path launches
+        # per-QUERY pull accounting (the global counters cross-
+        # contaminate under concurrent queries; ops-internal pulls like
+        # the multi-field stacked fetch still only show in the globals)
+        _q_pull: dict = {}
 
         if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
             # column-store path: tags are columns; fragments pruned by
@@ -1553,12 +1653,70 @@ class QueryExecutor:
                     can_merge = not ({"min", "max"} & set(want))
                     merged_by: dict = {}
                     merged_rows: dict = {}
+                    lat_dev_fold = blockagg.lattice_fold_on_device()
+                    from ..ops.exactsum import K_LIMBS as _KLq
+                    lat_lock = __import__("threading").Lock()
+
+                    def _lat_post(lkey, st_l, WL_l, gid_arr):
+                        # background fold of ONE pulled lattice into
+                        # the group's shared grids: exact integer adds
+                        # are order-free, so arrival order cannot
+                        # change a bit vs the grouped fold. The
+                        # accumulator itself is created in the MAIN
+                        # thread (dispatch-encounter order) so the
+                        # group EMISSION order at collection matches
+                        # the single-barrier path deterministically —
+                        # the downstream f64 fallback-sum fold is
+                        # order-sensitive across groups.
+                        g_sl = gid_arr[st_l.block0:
+                                       st_l.block0 + st_l.n_blocks]
+                        if lkey not in lat_host_acc:
+                            lat_host_acc[lkey] = \
+                                blockagg.new_lattice_acc(G * W, want,
+                                                         _KLq)
+                        acc = lat_host_acc[lkey]
+
+                        def post(d_host):
+                            with lat_lock:
+                                blockagg.fold_lattice_into(
+                                    acc, st_l, d_host, WL_l, g_sl,
+                                    int(start), int(interval_eff), W,
+                                    G * W, want, _KLq)
+                            return None
+                        return post
+
+                    def _unpack_post(fmt, stck):
+                        def post(arrs):
+                            return _unpack_block_out(fmt, arrs, stck,
+                                                     want)
+                        return post
+
+                    def _emit(fname_e, reader_e, stack_e, packed):
+                        # route one packed transport grid: streamed
+                        # (pull + unpack run in the background while
+                        # later launches compute) or deferred to the
+                        # single-barrier pull
+                        nonlocal n_stream
+                        if pipe is not None:
+                            n_stream += 1
+                            pipe.submit(("blk", n_stream), packed[1:],
+                                        post=_unpack_post(packed[0],
+                                                          stack_e))
+                            block_launches.append(
+                                (fname_e, reader_e, stack_e,
+                                 ("s", n_stream)))
+                        else:
+                            block_launches.append(
+                                (fname_e, reader_e, stack_e, packed))
+
                     for reader, stacks, gids_by_field, srcs in jobs:
                         if big_grid:
                             # multi-M-cell grids: compact window
-                            # lattices pulled raw, folded on host in C
-                            # (no device cell scatter, no grid-sized
-                            # plans). Ineligible files (non-const
+                            # lattices, folded ON DEVICE to one (G, W)
+                            # plane-set per (field, scale) group before
+                            # the pull (default — only final cells
+                            # cross the link), or pulled raw and folded
+                            # on host in C. Ineligible files (non-const
                             # blocks) stay on the host paths — their
                             # sources are NOT consumed
                             if not all(
@@ -1570,6 +1728,29 @@ class QueryExecutor:
                                 continue
                             for fname, sl in stacks.items():
                                 gid_arr = gids_by_field[fname]
+                                lkey = (fname, sl[0].E, sl[0].k0,
+                                        sl[0].limbs.shape[-1])
+                                if lat_dev_fold:
+                                    folded = \
+                                        blockagg.file_lattice_fold(
+                                            sl, gid_arr, t_lo, t_hi,
+                                            int(start),
+                                            int(interval_eff),
+                                            W, G * W, want,
+                                            scalars=scalars,
+                                            gids_dev=
+                                            blockagg.cached_gids(
+                                                gid_arr))
+                                    prev = lat_dev_acc.get(lkey)
+                                    lat_dev_acc[lkey] = folded \
+                                        if prev is None else \
+                                        blockagg._pairwise_combine(
+                                            want, lkey[3])(prev,
+                                                           folded)
+                                    lat_dev_rows[lkey] = (
+                                        lat_dev_rows.get(lkey, 0)
+                                        + sum(st.n_rows for st in sl))
+                                    continue
                                 for st_l, d_l, WL_l in \
                                         blockagg.file_lattice(
                                         sl, gid_arr, t_lo, t_hi,
@@ -1577,9 +1758,19 @@ class QueryExecutor:
                                         W, want, scalars=scalars,
                                         gids_dev=blockagg.cached_gids(
                                             gid_arr)):
-                                    block_launches.append(
-                                        (fname, reader, st_l,
-                                         ("t", d_l, WL_l, gid_arr)))
+                                    if pipe is not None:
+                                        n_lat_stream += 1
+                                        pipe.submit(
+                                            ("lat", n_lat_stream),
+                                            d_l,
+                                            post=_lat_post(
+                                                lkey, st_l, WL_l,
+                                                gid_arr))
+                                    else:
+                                        block_launches.append(
+                                            (fname, reader, st_l,
+                                             ("t", d_l, WL_l,
+                                              gid_arr)))
                             for _sp, src in srcs:
                                 block_skip.add(id(src))
                             continue
@@ -1612,31 +1803,40 @@ class QueryExecutor:
                                 flat_n = ((sl[-1].block0
                                            + sl[-1].n_blocks)
                                           * sl[0].seg_rows)
-                                block_launches.append(
-                                    (fname, reader, sl,
-                                     blockagg.pack_grid(
-                                         out, want,
-                                         sl[0].limbs.shape[-1],
-                                         n_rows_f, flat_n)))
+                                _emit(fname, reader, sl,
+                                      blockagg.pack_grid(
+                                          out, want,
+                                          sl[0].limbs.shape[-1],
+                                          n_rows_f, flat_n))
                         # consume the sources: flat/dense/preagg must
                         # not double-count these chunks (the plan object
                         # is cached across queries — never mutate it)
                         for _sp, src in srcs:
                             block_skip.add(id(src))
                     for (fname, _E, _k0, _ka), out in merged_by.items():
-                        block_launches.append(
-                            (fname, None, _BlockMeta(_E, _k0, _ka),
-                             blockagg.pack_grid(
-                                 out, want, _ka,
-                                 merged_rows[(fname, _E, _k0, _ka)],
-                                 0)))
+                        _emit(fname, None, _BlockMeta(_E, _k0, _ka),
+                              blockagg.pack_grid(
+                                  out, want, _ka,
+                                  merged_rows[(fname, _E, _k0, _ka)],
+                                  0))
+                    # device-folded lattice groups: ONE packed grid per
+                    # (field, scale) group crosses the link
+                    for (fname, _E, _k0, _ka), out in \
+                            lat_dev_acc.items():
+                        _emit(fname, None, _BlockMeta(_E, _k0, _ka),
+                              blockagg.pack_grid(
+                                  out, want, _ka,
+                                  lat_dev_rows[(fname, _E, _k0, _ka)],
+                                  0))
                     block_rows_total = sum(
                         sl.n_rows for _r, stacks, _g, _s in jobs
                         for sls in stacks.values() for sl in sls)
                     if blk_sp is not None:
                         blk_sp.end_ns = _now_ns()
                         blk_sp.add(files=len(jobs),
-                                   launches=len(block_launches),
+                                   launches=len(block_launches)
+                                   + n_lat_stream,
+                                   streamed=n_stream + n_lat_stream,
                                    rows=block_rows_total)
 
         scanres = None
@@ -1735,11 +1935,13 @@ class QueryExecutor:
             _bump_stat(EXEC_STATS, "dense_cache_hits",
                        _s.dense_cache_hits)
             _bump_stat(EXEC_STATS, "merged_series", _s.merged_series)
+        _dstat.bump_phase("reader_scan", _now_ns() - _t_scan0)
         if scan_sp is not None:
             scan_sp.end_ns = _now_ns()
             scan_sp.add(shards=len(shards), groups=G, rows=n_rows)
-            if block_launches:
-                scan_sp.add(block_kernels=len(block_launches),
+            if block_launches or n_lat_stream:
+                scan_sp.add(block_kernels=len(block_launches)
+                            + n_lat_stream,
                             block_rows=sum(
                                 sl.n_rows for _f, _r, s, _o
                                 in block_launches
@@ -1807,8 +2009,9 @@ class QueryExecutor:
         exact_scales: dict[str, int] = {}
         sel_results: dict[str, tuple] = {}
         dev_sp = span.child("device_agg") if span is not None else None
+        _t_dev0 = _now_ns()
         if dev_sp is not None:
-            dev_sp.start_ns = _now_ns()
+            dev_sp.start_ns = _t_dev0
         npad = pad_bucket(n_rows)
         if not use_host:
             seg_p, times_p = pad_rows([seg, times], npad,
@@ -2035,7 +2238,7 @@ class QueryExecutor:
             if fname in raw_fields:
                 raw_slices[fname] = _collect_raw_slices(
                     seg, vals, valid, times, G, W)
-        _batch_pull_results(field_results, exact_results)
+        _batch_pull_results(field_results, exact_results, stats=_q_pull)
         # dense groups: (S, P) axis reductions, results scattered into
         # the state grids host-side (S is tiny — N/P)
         dense_out: dict[str, list] = {}
@@ -2044,6 +2247,11 @@ class QueryExecutor:
             from ..ops.segment_agg import dense_window_aggregate_host
             if exact_on:
                 from ..ops import exactsum
+            # device dense path (decoded-plane device cache): only
+            # order-free exact states compute on device, so a field is
+            # eligible when no sumsq is needed and any consumed sum has
+            # the limb machinery behind it
+            use_ddev = _dense_device_on()
             for P, grp in sorted(scanres.dense.items()):
                 S = len(grp.cells)
                 fp = grp.fingerprint
@@ -2068,6 +2276,37 @@ class QueryExecutor:
                     if grp.cached and fname not in \
                             (scanres.field_types or {}) and ft is not None:
                         field_types[fname] = ft
+                    if use_ddev and not spec.sumsq and (
+                            not spec.sum
+                            or (exact_on and fname in exact_scales)):
+                        got = _dense_device_try(
+                            dcache, fp, fname, dvals, dvalid, spec,
+                            exact_scales.get(fname, 0),
+                            exact_on and fname in exact_scales)
+                        if got is not None:
+                            kind, payload, rkey2 = got
+                            if kind == "res":
+                                res_h, ex_h = payload
+                                dense_out.setdefault(fname, []).append(
+                                    (grp.cells, S, res_h))
+                                if ex_h is not None:
+                                    dense_exact.setdefault(
+                                        fname, []).append(
+                                            (grp.cells, S, ex_h))
+                            else:
+                                res_t, lsum_d = payload
+                                idx_d = len(dense_dev_pending)
+                                dense_dev_pending.append(
+                                    (fname, grp.cells, S,
+                                     np.zeros(S, dtype=bool),
+                                     exact_scales.get(fname, 0),
+                                     rkey2, res_t, lsum_d))
+                                if pipe is not None:
+                                    # stream the result pull alongside
+                                    # the block-path pulls
+                                    pipe.submit(("dense", idx_d),
+                                                (res_t, lsum_d))
+                            continue
                     rkey = (fp, fname, "dense_res", spec)
                     res = dcache.get(rkey) if dcache else None
                     if res is None:
@@ -2107,78 +2346,158 @@ class QueryExecutor:
                                [(nm, scanres.field_types.get(nm))
                                 for nm in grp.fields])
                     dcache.put((fp, "needed"), set(needed_fields))
-        if not use_host or dense_out or block_launches:
-            # ONE batched D2H for every kernel output — per-array pulls
-            # each pay a full tunnel round-trip on remote-attached TPUs
+        dense_dev_meta = [e[:6] for e in dense_dev_pending]
+        ddev_trees = [(e[6], e[7]) for e in dense_dev_pending]
+        if (not use_host or dense_out or block_launches
+                or dense_dev_pending
+                or (pipe is not None and pipe.launches)):
+            # ONE batched D2H for every kernel output on the fallback
+            # path — per-array pulls each pay a full tunnel round-trip
+            # on remote-attached TPUs. On the streaming path the
+            # block/dense launches were pulled (and unpacked/folded) by
+            # the background workers while later batches were still
+            # computing and the scan pool was still decoding; only the
+            # (mostly already-host) segment results drain here.
             import jax
             pull_sp = span.child("device_pull") if span is not None \
                 else None
+            _t_pull0 = _now_ns()
+            _pre_pull_b = _q_pull.get("bytes", 0)
+            streamed: dict = {}
+            if pipe is None:
+                block_fmt = [bo[0] for _f, _r, _s, bo in block_launches]
+                block_outs = [bo[1:] for _f, _r, _s, bo
+                              in block_launches]
+                tree = (field_results, dense_out, exact_results,
+                        dense_exact, sel_results, block_outs,
+                        ddev_trees)
+                # drain the dispatch queue BEFORE the transfer:
+                # device_get on in-flight arrays takes the tunnel's
+                # slow synchronous fetch path (measured 6x the
+                # post-completion transfer)
+                try:
+                    jax.block_until_ready(tree)
+                except Exception:
+                    pass
+                (field_results, dense_out, exact_results, dense_exact,
+                 sel_results, block_outs, ddev_trees) = \
+                    _device_get_parallel(tree, stats=_q_pull)
+            else:
+                block_fmt = block_outs = None
+                tree = (field_results, dense_out, exact_results,
+                        dense_exact, sel_results)
+                try:
+                    jax.block_until_ready(tree)
+                except Exception:
+                    pass
+                (field_results, dense_out, exact_results, dense_exact,
+                 sel_results) = _device_get_parallel(tree,
+                                                     stats=_q_pull)
+                streamed = pipe.collect()
+                ddev_trees = [streamed[("dense", i)]
+                              for i in range(len(dense_dev_pending))]
+            # dense device-path results join the host-dense fold lists
+            for (fname, cells, S, bad_rows, E_d, rkey2), got in zip(
+                    dense_dev_meta, ddev_trees):
+                res_h, lsum_h = got
+                ex_h = None
+                if lsum_h is not None:
+                    from ..ops.exactsum import finalize_exact as _fe0
+                    lsum_h = np.asarray(lsum_h)
+                    # deterministic f64 fallback state derived from the
+                    # exact limb totals (no residue rows by eligibility)
+                    res_h = res_h._replace(sum=_fe0(
+                        lsum_h.astype(np.float64), E_d))
+                    ex_h = (lsum_h, bad_rows)
+                    dense_exact.setdefault(fname, []).append(
+                        (cells, S, ex_h))
+                dense_out.setdefault(fname, []).append(
+                    (cells, S, res_h))
+                if dcache is not None:
+                    dcache.put(rkey2, (res_h, ex_h))
+            _t_pull1 = _now_ns()
+            # per-query accounting (NOT a delta of the process-global
+            # counters — concurrent queries contaminate those). The
+            # span's pull_bytes covers only transfers whose wall the
+            # span actually times (drain + background pipeline pulls),
+            # so bench's effective GB/s is honest; the batched segment
+            # pulls that ran BEFORE the window count toward the
+            # per-query total gauge but not the throughput figure.
+            pipe_b = pipe.bytes if pipe is not None else 0
+            span_b = int(_q_pull.get("bytes", 0) - _pre_pull_b
+                         + pipe_b)
+            total_b = int(_q_pull.get("bytes", 0) + pipe_b)
+            _pull_open = (min(pipe.first_ns, _t_pull0)
+                          if pipe is not None
+                          and pipe.first_ns is not None else _t_pull0)
+            _dstat.bump_phase("device_pull", _t_pull1 - _pull_open)
+            _dstat.gauge("last_query_d2h_bytes", total_b)
+            _dstat.gauge("last_query_pull_ms",
+                         (_t_pull1 - _pull_open) // 1_000_000)
+            if pipe is not None and pipe.launches:
+                _dstat.bump("stream_launches", pipe.launches)
+                _dstat.bump("stream_queries")
             if pull_sp is not None:
-                pull_sp.start_ns = _now_ns()
-            block_fmt = [bo[0] for _f, _r, _s, bo in block_launches]
-            block_outs = [bo[1:] for _f, _r, _s, bo in block_launches]
-            tree = (field_results, dense_out, exact_results,
-                    dense_exact, sel_results, block_outs)
-            # drain the dispatch queue BEFORE the transfer: device_get
-            # on in-flight arrays takes the tunnel's slow synchronous
-            # fetch path (measured 6x the post-completion transfer)
-            try:
-                jax.block_until_ready(tree)
-            except Exception:
-                pass
-            (field_results, dense_out, exact_results, dense_exact,
-             sel_results, block_outs) = _device_get_parallel(tree)
-            if pull_sp is not None:
-                pull_sp.end_ns = _now_ns()
-                pull_sp.add(leaves=len(jax.tree_util.tree_leaves(
-                    (field_results, dense_out, exact_results,
-                     dense_exact, sel_results, block_outs))))
+                # streaming: the span opens at the FIRST background
+                # pull, usually long before this drain point — it
+                # overlaps reader_scan/device_agg, so the children's
+                # summed wall exceeding the query span is the proof of
+                # overlap, not an accounting bug
+                pull_sp.start_ns = _pull_open
+                pull_sp.end_ns = _t_pull1
+                pull_sp.add(
+                    leaves=len(jax.tree_util.tree_leaves(
+                        (field_results, dense_out, exact_results,
+                         dense_exact, sel_results))),
+                    pull_bytes=span_b,
+                    query_d2h_bytes=total_b,
+                    streamed=(pipe.launches if pipe is not None
+                              else 0),
+                    pipeline_depth=(pipe.depth if pipe is not None
+                                    else 0))
             # packed plane arrays → host bo dicts (exact: counts/limbs
             # are integer-valued f64 far below 2^53)
             from ..ops import blockagg as _bagg
             from ..ops.exactsum import K_LIMBS as _KL
             _bw = tuple(k for k in ("sum", "sumsq", "min", "max")
                         if getattr(spec, k))
-
-            def _ka_k0(sl):
-                if isinstance(sl, _BlockMeta):
-                    return sl.ka, sl.k0
-                return sl[0].limbs.shape[-1], sl[0].k0
-
-            def _unpack(fmt, arrs, s):
-                ka, k0 = _ka_k0(s)
-                if fmt == "p":
-                    f64x = (np.asarray(arrs[2]) if len(arrs) > 2
-                            else None)
-                    return _bagg.unpack_packed(
-                        np.asarray(arrs[0]), np.asarray(arrs[1]),
-                        _bw, ka, k0, _KL, f64x)
-                return _bagg.unpack_planes(np.asarray(arrs[0]), _bw,
-                                           ka, k0, _KL)
-
-            # lattice launches ("t") fold on host into ONE bo per
-            # (field, scale) group — per-slab bo dicts would cost a
-            # grid-sized limb array each
             new_launches = []
-            lat_groups: dict = {}
-            for (f, r, s, _), fmt, arrs in zip(
-                    block_launches, block_fmt, block_outs):
-                if fmt == "t":
-                    lat_groups.setdefault(
-                        (f, s.E, s.k0, s.limbs.shape[-1]),
-                        []).append((s, arrs))
-                else:
-                    new_launches.append((f, r, s,
-                                         _unpack(fmt, arrs, s)))
-            for (f, E_l, k0_l, ka_l), ents in lat_groups.items():
-                bo = _bagg.fold_lattices(
-                    [(s2, a[0], a[1]) for s2, a in ents],
-                    [a[2][s2.block0:s2.block0 + s2.n_blocks]
-                     for s2, a in ents],
-                    int(start), int(interval_eff), W, G * W, _bw,
-                    _KL)
-                new_launches.append(
-                    (f, None, _BlockMeta(E_l, k0_l, ka_l), bo))
+            if pipe is None:
+                # lattice launches ("t") fold on host into ONE bo per
+                # (field, scale) group — per-slab bo dicts would cost a
+                # grid-sized limb array each
+                lat_groups: dict = {}
+                for (f, r, s, _), fmt, arrs in zip(
+                        block_launches, block_fmt, block_outs):
+                    if fmt == "t":
+                        lat_groups.setdefault(
+                            (f, s.E, s.k0, s.limbs.shape[-1]),
+                            []).append((s, arrs))
+                    else:
+                        new_launches.append(
+                            (f, r, s,
+                             _unpack_block_out(fmt, arrs, s, _bw)))
+                for (f, E_l, k0_l, ka_l), ents in lat_groups.items():
+                    bo = _bagg.fold_lattices(
+                        [(s2, a[0], a[1]) for s2, a in ents],
+                        [a[2][s2.block0:s2.block0 + s2.n_blocks]
+                         for s2, a in ents],
+                        int(start), int(interval_eff), W, G * W, _bw,
+                        _KL)
+                    new_launches.append(
+                        (f, None, _BlockMeta(E_l, k0_l, ka_l), bo))
+            else:
+                # streamed launches arrive pre-unpacked (the background
+                # workers ran unpack_packed/unpack_planes concurrently
+                # with later compute); streamed lattices arrive
+                # pre-folded in the shared group accumulators
+                for f, r, s, out in block_launches:
+                    new_launches.append(
+                        (f, r, s, streamed[("blk", out[1])]))
+                for (f, E_l, k0_l, ka_l), acc in lat_host_acc.items():
+                    new_launches.append(
+                        (f, None, _BlockMeta(E_l, k0_l, ka_l),
+                         _bagg.lattice_acc_bo(acc, _bw)))
             block_launches = new_launches
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
@@ -2212,6 +2531,7 @@ class QueryExecutor:
                 rep["max"] = np.where(has, vp[np.minimum(mi, n_p - 1)],
                                       ident).astype(vp.dtype)
             field_results[fname] = res._replace(**rep)
+        _dstat.bump_phase("device_agg", _now_ns() - _t_dev0)
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,
@@ -2221,8 +2541,9 @@ class QueryExecutor:
         for key, gi in global_groups.items():
             group_keys[gi] = key
         fold_sp = span.child("grid_fold") if span is not None else None
+        _t_fold0 = _now_ns()
         if fold_sp is not None:
-            fold_sp.start_ns = _now_ns()
+            fold_sp.start_ns = _t_fold0
         fields_out: dict[str, dict] = {}
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
@@ -2457,6 +2778,7 @@ class QueryExecutor:
                 st["sum_limbs"] = lg[:G * W].reshape(G, W, K_LIMBS)
                 st["sum_inexact"] = ixg[:G * W].reshape(G, W)
             fields_out[fname] = st
+        _dstat.bump_phase("grid_fold", _now_ns() - _t_fold0)
         if fold_sp is not None:
             fold_sp.end_ns = _now_ns()
             fold_sp.add(fields=len(fields_out), cells=G * W)
@@ -3153,7 +3475,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
 
 # -------------------------------------------------------------- finalize
 
-def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
+def _batch_pull_results(field_results: dict, exact_results: dict,
+                        stats: dict | None = None) -> None:
     """Replace device-resident result leaves with host numpy using ONE
     D2H transfer per (dtype, shape) group: on the tunnel-attached chip
     every pull pays ~0.1-0.25s latency, so leaf COUNT dominates (a
@@ -3196,6 +3519,8 @@ def _batch_pull_results(field_results: dict, exact_results: dict) -> None:
     _ds.bump("d2h_bytes", n_b)
     _ds.bump("d2h_pulls", len(groups))
     _ds.bump("d2h_wait_ns", _now_ns() - _t0)
+    if stats is not None:
+        stats["bytes"] = stats.get("bytes", 0) + n_b
     for fname, res in list(field_results.items()):
         if not hasattr(res, "_fields"):
             continue
@@ -3250,71 +3575,11 @@ def _gc_resume() -> None:
         gc.collect()          # works while disabled; bounds cycles
 
 
-def _device_get_parallel(tree, chunk_bytes=32 << 20, threads=6):
-    """device_get with per-leaf thread parallelism and chunked fetches
-    of large leaves. The tunnel-attached link serializes transfers and
-    pays a full round trip per pull; concurrent streams overlap that
-    latency and lift large-transfer bandwidth ~54 → ~70 MB/s
-    (measured, 4 streams). Non-device leaves pass through untouched.
-    Role of the reference's streaming chunk return
-    (engine/executor/chunk_codec.gen.go) — results cross the wire in
-    bounded pieces rather than one monolithic transfer."""
-    import concurrent.futures as cf
-
-    import jax
-
-    from ..ops import devstats as _ds
-    _t_pull0 = _now_ns()
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    parts: list = [None] * len(leaves)
-    jobs: list = []                     # (leaf_idx, chunk_idx, buf)
-    total_b = 0
-    for i, x in enumerate(leaves):
-        if not isinstance(x, jax.Array):
-            parts[i] = x
-            continue
-        total_b += x.size * x.dtype.itemsize
-        nb = x.size * x.dtype.itemsize
-        if x.ndim == 0 or nb <= chunk_bytes:
-            jobs.append((i, None, x))
-            continue
-        ax = int(np.argmax(x.shape))
-        n = x.shape[ax]
-        k = min(-(-nb // chunk_bytes), 8)
-        bounds = [n * j // k for j in range(k + 1)]
-        parts[i] = ["chunks", ax, [None] * k]
-        for j in range(k):
-            jobs.append((i, j, (x, ax, bounds[j], bounds[j + 1])))
-    if jobs:
-        def _fetch(t):
-            # slice lazily IN the worker: an eager device-side copy of
-            # every chunk up front would double peak HBM for the
-            # result set before any D2H happened
-            i, j, b = t
-            if isinstance(b, tuple):
-                x, ax, lo, hi = b
-                idx = [slice(None)] * x.ndim
-                idx[ax] = slice(lo, hi)
-                b = x[tuple(idx)]
-            return (i, j, np.asarray(b))
-
-        if len(jobs) == 1:
-            jobs_out = [_fetch(jobs[0])]
-        else:
-            with cf.ThreadPoolExecutor(min(threads, len(jobs))) as pool:
-                jobs_out = list(pool.map(_fetch, jobs))
-        for i, j, arr in jobs_out:
-            if j is None:
-                parts[i] = arr
-            else:
-                parts[i][2][j] = arr
-    out = [np.concatenate(p[2], axis=p[1])
-           if isinstance(p, list) and p and p[0] == "chunks" else p
-           for p in parts]
-    _ds.bump("d2h_bytes", total_b)
-    _ds.bump("d2h_pulls", len(jobs))
-    _ds.bump("d2h_wait_ns", _now_ns() - _t_pull0)
-    return jax.tree_util.tree_unflatten(treedef, out)
+# moved to ops/pipeline.py so ops-layer callers (segment_agg's batched
+# multi-field pull, the streaming pipeline workers) share one chunked
+# multi-stream fetch; re-exported under the old name for callers/tests
+from ..ops.pipeline import (  # noqa: E402
+    device_get_parallel as _device_get_parallel)
 
 
 def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],

@@ -626,11 +626,15 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                   for P, ts in dense_by_p.items() for d in ts]
 
     if pool is not None and (len(tasks) + len(dense_jobs)) > 1:
-        # one submission wave: dense decodes interleave with flat/merged
-        # ones instead of waiting for the first batch to drain
-        flat_futs = [pool.submit(run_one, t) for t in tasks]
+        # one submission wave, DENSE FIRST: dense groups feed device
+        # launches (dense kernels, decoded-plane staking), so their
+        # decodes front-run the flat ones — the streaming pipeline can
+        # start pulling device results while flat rows still decode.
+        # Collection stays list-ordered, so row/group order (and hence
+        # positional first/last semantics) is unchanged.
         dense_futs = [pool.submit(_run_dense, d, needed, W, blocks)
                       for _P, d, blocks in dense_jobs]
+        flat_futs = [pool.submit(run_one, t) for t in tasks]
         results = [f.result() for f in flat_futs]
         dense_results = [f.result() for f in dense_futs]
     else:

@@ -183,14 +183,15 @@ def executor_collector():
 
 def devicecache_collector():
     """Device block cache metrics (readcache analog, HBM tier) plus
-    the host-side pin cache — flattened: the pusher's line-protocol
-    writer drops non-scalar fields."""
+    the host-side pin cache and the decoded-plane tier — flattened:
+    the pusher's line-protocol writer drops non-scalar fields."""
     from ..ops import devicecache
     if not devicecache.enabled():
         return {"enabled": 0}
     out = devicecache.global_cache().stats()
     for k, v in devicecache.host_cache().stats().items():
         out[f"host_{k}"] = v
+    out.update(devicecache.PLANE_STATS)
     return out
 
 

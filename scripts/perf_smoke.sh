@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CPU perf smoke: the streaming device pipeline and the single-barrier
+# fallback must agree on EVERY result cell, across both lattice fold
+# routes (device / host), on every bench query shape. Runs a scaled-
+# down bench dataset on the CPU backend with per-phase output — CI-safe
+# (no accelerator needed, a few minutes of wall).
+#
+# Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
+#        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]
+#
+# Exit nonzero on any cell disagreement (bench.py --phase smoke raises
+# SMOKE MISMATCH) or on a query error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
+# small-scale bench config: ~48 hosts x 1h keeps the full pipeline
+# (block stacks, lattice route, dense groups, packed transport) alive
+# while finishing in CI time
+export OG_BENCH_HOSTS="${OG_BENCH_HOSTS:-48}"
+export OG_BENCH_HOURS="${OG_BENCH_HOURS:-1}"
+
+timeout -k 10 "${OG_SMOKE_TIMEOUT_S:-900}" \
+    python bench.py --phase smoke | tee /tmp/og_perf_smoke.json
+
+# the phase line must exist and report a pass
+python - <<'EOF'
+import json
+last = open("/tmp/og_perf_smoke.json").read().strip().splitlines()[-1]
+r = json.loads(last)
+assert r.get("metric") == "perf_smoke_streaming_equivalence", r
+assert r.get("value") == 1, r
+assert r.get("cells_checked", 0) > 0, r
+print(f"perf smoke OK: {r['cells_checked']} cells checked, "
+      f"phases {r.get('phases_ms', {})}")
+EOF

@@ -1,0 +1,433 @@
+"""Streaming device pipeline (ops/pipeline.py): chunked parallel
+pulls, bounded-depth launch→pull→fold overlap, transfer hygiene of the
+dense dispatch loop, and the decoded-plane device cache tier."""
+
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops.pipeline import (StreamingPipeline,
+                                         device_get_parallel)
+
+# ----------------------------------------- device_get_parallel edges
+
+
+def test_pull_leaf_larger_than_chunk():
+    """A leaf bigger than chunk_bytes splits along its longest axis and
+    reassembles exactly."""
+    x = np.arange(64 * 1024, dtype=np.float64).reshape(64, 1024)
+    dx = jax.device_put(x)
+    (out,) = device_get_parallel((dx,), chunk_bytes=4096)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, x)
+    # 1-D leaf too (argmax axis 0)
+    y = np.arange(100_000, dtype=np.int64)
+    (out,) = device_get_parallel((jax.device_put(y),), chunk_bytes=1024)
+    np.testing.assert_array_equal(out, y)
+
+
+def test_pull_empty_and_scalar_trees():
+    assert device_get_parallel(()) == ()
+    assert device_get_parallel([]) == []
+    assert device_get_parallel({"a": []}) == {"a": []}
+    s = jax.device_put(np.float64(2.5))
+    (out,) = device_get_parallel((s,))
+    assert float(out) == 2.5
+
+
+def test_pull_mixed_numpy_jax_leaves():
+    """Non-device leaves pass through untouched (same object), device
+    leaves come back as numpy."""
+    host = np.arange(10)
+    dev = jax.device_put(np.arange(5, dtype=np.float64))
+    tree = {"h": host, "d": dev, "n": None, "i": 7, "s": "x"}
+    out = device_get_parallel(tree)
+    assert out["h"] is host
+    assert out["i"] == 7 and out["s"] == "x" and out["n"] is None
+    assert isinstance(out["d"], np.ndarray)
+    np.testing.assert_array_equal(out["d"], np.arange(5.0))
+
+
+def test_pull_threads_one_equivalent():
+    """threads=1 (serial) must return exactly what the parallel path
+    returns, chunked leaves included."""
+    rng = np.random.default_rng(3)
+    tree = [jax.device_put(rng.normal(size=(8, 2048))),
+            jax.device_put(np.arange(9000, dtype=np.int64)),
+            np.ones(3)]
+    a = device_get_parallel(tree, chunk_bytes=4096, threads=1)
+    b = device_get_parallel(tree, chunk_bytes=4096, threads=6)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_pull_stats_out():
+    st = {}
+    x = jax.device_put(np.zeros(1000, dtype=np.float64))
+    device_get_parallel((x, np.ones(5)), stats=st)
+    assert st["bytes"] == 8000 and st["leaves"] == 1
+
+
+# -------------------------------------------------- StreamingPipeline
+
+
+def test_pipeline_results_and_posts():
+    pipe = StreamingPipeline(depth=2)
+    for i in range(6):
+        dx = jax.device_put(np.full(4, float(i)))
+        pipe.submit(("k", i), (dx,),
+                    post=(lambda h, i=i: float(h[0][0]) + 100 * i))
+    got = pipe.collect()
+    assert got == {("k", i): i + 100 * i for i in range(6)}
+    assert pipe.launches == 6 and pipe.bytes == 6 * 32
+    assert pipe.first_ns is not None and pipe.last_ns >= pipe.first_ns
+
+
+def test_pipeline_bounds_in_flight():
+    """submit() blocks while `depth` launches are in flight: with
+    depth=1 and a gated post, the second submit cannot return until the
+    first pull+fold releases its slot."""
+    pipe = StreamingPipeline(depth=1)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_post(_h):
+        started.set()
+        gate.wait(10)
+        return "done"
+
+    pipe.submit("a", (jax.device_put(np.zeros(2)),), post=slow_post)
+    assert started.wait(10)
+    state = {"second": False}
+
+    def second():
+        pipe.submit("b", (jax.device_put(np.ones(2)),))
+        state["second"] = True
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    t.join(0.3)
+    assert not state["second"], "depth=1 should have blocked submit #2"
+    gate.set()
+    t.join(10)
+    assert state["second"]
+    out = pipe.collect()
+    assert out["a"] == "done"
+
+
+def test_pipeline_post_error_surfaces_at_collect():
+    pipe = StreamingPipeline(depth=4)
+
+    def bad(_h):
+        raise ValueError("fold exploded")
+
+    pipe.submit("x", (jax.device_put(np.zeros(2)),), post=bad)
+    with pytest.raises(ValueError, match="fold exploded"):
+        pipe.collect()
+
+
+def test_pipeline_collect_empty():
+    assert StreamingPipeline(depth=3).collect() == {}
+
+
+# ------------------------------------- transfer-guard regression gate
+
+
+def test_dense_dispatch_no_implicit_transfers():
+    """The dense aggregate hot path must not trigger IMPLICIT host
+    syncs mid-dispatch: an accidental numpy operand inside the loop
+    re-serializes the streaming pipeline on real hardware. Warm the jit
+    caches first (compile-time constant transfers are fine), then run
+    the steady-state dispatch under jax.transfer_guard("disallow")."""
+    from opengemini_tpu.ops import AggSpec, dense_window_aggregate
+    from opengemini_tpu.ops.segment_agg import dense_device_reduce
+
+    rng = np.random.default_rng(11)
+    spec = AggSpec.of("mean", "min", "max")
+    vals = jax.device_put(rng.normal(50, 10, (32, 16)))
+    valid = jax.device_put(np.ones((32, 16), dtype=bool))
+    limbs = jax.device_put(
+        rng.integers(0, 100, (32, 16, 4)).astype(np.int32))
+    # warmup: compile outside the guard
+    jax.block_until_ready(dense_window_aggregate(vals, valid, None,
+                                                 spec))
+    jax.block_until_ready(dense_device_reduce(vals, valid, limbs,
+                                              spec, True))
+    with jax.transfer_guard("disallow"):
+        r1 = dense_window_aggregate(vals, valid, None, spec)
+        r2 = dense_device_reduce(vals, valid, limbs, spec, True)
+    # pulls happen OUTSIDE the guard (they are explicit in production:
+    # device_get_parallel / the streaming pullers)
+    assert np.asarray(r1.count).sum() == 32 * 16
+    assert np.asarray(r2["lsum"]).shape == (32, 4)
+    # the guard itself must fire on a genuinely implicit transfer, or
+    # this test is vacuous
+    f = jax.jit(lambda a: a * 2)
+    f(np.ones(4))                       # compile with committed input
+    with pytest.raises(Exception):
+        with jax.transfer_guard("disallow"):
+            f(np.ones(4))
+
+
+def test_block_kernel_dispatch_no_implicit_transfers():
+    """Same guard over the block-path masked-pass kernel: everything it
+    consumes (stack planes, gids, scalars) is device-resident."""
+    from opengemini_tpu.ops import blockagg
+
+    B, SEG, K, W, ns = 4, 32, 2, 4, 9
+    rng = np.random.default_rng(5)
+    vals = jax.device_put(rng.normal(0, 1, (B, SEG)))
+    valid = jax.device_put(np.ones((B, SEG), dtype=bool))
+    times = jax.device_put(
+        np.arange(B * SEG, dtype=np.int64).reshape(B, SEG))
+    limbs = jax.device_put(
+        rng.integers(0, 50, (B, SEG, K)).astype(np.int32))
+    bad = jax.device_put(np.zeros((B, SEG), dtype=bool))
+    gids = jax.device_put(np.array([0, 0, 1, 1], dtype=np.int64))
+    block0 = jax.device_put(np.float64(0))
+    scalars = jax.device_put(np.array([0, 1 << 40, 0, 32], np.int64))
+    fn = blockagg._kernel(ns - 1, ("sum",), W, K, SEG)
+    jax.block_until_ready(fn(vals, valid, times, limbs, bad, gids,
+                             block0, scalars))              # warm
+    with jax.transfer_guard("disallow"):
+        out = fn(vals, valid, times, limbs, bad, gids, block0, scalars)
+    assert np.asarray(out).shape[1] == ns - 1
+
+
+# ------------------------------- executor: streaming == single barrier
+
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor
+    from opengemini_tpu.storage import Engine, EngineOptions
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def seed(eng, hosts=5, points=480):
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    rng = np.random.default_rng(17)
+    vals = rng.normal(40.0, 9.0, (hosts, points))
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            lines.append(
+                f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    return vals
+
+
+def q(ex, text):
+    from opengemini_tpu.query import parse_query
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+TEXT = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE time >= 0 "
+        "AND time < 4800s GROUP BY time(1m), host")
+TEXT_MM = ("SELECT min(u), max(u), count(u) FROM cpu WHERE time >= 0 "
+           "AND time < 4800s GROUP BY time(1m), host")
+
+
+def test_streaming_matches_single_barrier(db, monkeypatch):
+    """The streaming pipeline must produce bit-identical results to the
+    single-barrier fallback on the packed block path, the min/max
+    (non-mergeable) path, and a repeat (cache-warm) run."""
+    eng, ex = db
+    seed(eng)
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "0")
+    base = (q(ex, TEXT), q(ex, TEXT_MM))
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "2")
+    stream = (q(ex, TEXT), q(ex, TEXT_MM))
+    assert stream == base
+    assert (q(ex, TEXT), q(ex, TEXT_MM)) == base     # warm repeat
+
+
+def test_streaming_matches_on_lattice_route(db, monkeypatch):
+    """Big-grid lattice route: every combination of {device fold, host
+    fold} × {streaming, barrier} agrees cell for cell."""
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    seed(eng, hosts=6, points=512)
+    text = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE "
+            "time >= 0 AND time < 5120s GROUP BY time(1m), host")
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "0")
+    monkeypatch.setenv("OG_LATTICE_DEVICE_FOLD", "0")
+    base = q(ex, text)
+    monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+    for fold in ("0", "1"):
+        for depth in ("0", "3"):
+            monkeypatch.setenv("OG_LATTICE_DEVICE_FOLD", fold)
+            monkeypatch.setenv("OG_PIPELINE_DEPTH", depth)
+            assert q(ex, text) == base, (fold, depth)
+
+
+def test_streaming_span_reports_overlap_fields(db, monkeypatch):
+    """EXPLAIN ANALYZE's device_pull span carries the streaming
+    telemetry (pull_bytes, streamed launch count, pipeline depth) that
+    bench.py records next to phases_ms."""
+    import json
+    import re
+    from opengemini_tpu.query import parse_query
+    eng, ex = db
+    seed(eng)
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "2")
+    (stmt,) = parse_query("EXPLAIN ANALYZE " + TEXT)
+    res = ex.execute(stmt, "db0")
+    txt = json.dumps(res)
+    m = re.search(r'device_pull:.*?pull_bytes=(\d+).*?streamed=(\d+)',
+                  txt)
+    assert m, txt
+    assert int(m.group(2)) >= 1          # launches actually streamed
+    assert "pipeline_depth=2" in txt
+
+
+def test_phase_and_plane_counters_exported(db, monkeypatch):
+    """Satellite: per-phase timings, per-query D2H bytes, and the
+    DeviceBlockCache tiers all surface through the collectors that back
+    /debug/vars and /metrics."""
+    from opengemini_tpu.ops.devstats import (DEVICE_STATS,
+                                             phase_collector)
+    from opengemini_tpu.utils.stats import devicecache_collector
+    eng, ex = db
+    seed(eng)
+    before = dict(phase_collector())
+    q(ex, TEXT)
+    after = phase_collector()
+    assert after["queries"] == before["queries"] + 1
+    for k in ("reader_scan_ms", "device_agg_ms", "device_pull_ms",
+              "grid_fold_ms", "finalize_ms"):
+        assert k in after
+    assert DEVICE_STATS["last_query_d2h_bytes"] > 0
+    dcc = devicecache_collector()
+    for k in ("hits", "misses", "evictions", "host_hits",
+              "plane_hits", "plane_misses"):
+        assert k in dcc
+
+
+def test_debug_vars_exposes_device_groups(db, monkeypatch):
+    """/debug/vars nests device, devicecache, and query_phases groups
+    while keeping the httpd counters top-level."""
+    import json
+    import urllib.request
+    from opengemini_tpu.http.server import HttpServer
+    eng, ex = db
+    seed(eng, hosts=2, points=128)
+    q(ex, TEXT)
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    try:
+        body = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/vars", timeout=30))
+    finally:
+        srv.stop()
+    assert "queries" in body                      # httpd compat
+    assert "d2h_bytes" in body["device"]
+    assert "plane_hits" in body["devicecache"]
+    assert "device_pull_ms" in body["query_phases"]
+
+
+# ------------------------------------------ decoded-plane device tier
+
+
+def test_dense_device_cache_skips_decode_and_h2d(db, monkeypatch):
+    """OG_DENSE_DEVICE: first query stakes the decoded (S, P) planes
+    (plane_puts), a repeat answers without re-decoding (EXPLAIN shows
+    decoded_segments=0 via the dense route) or re-uploading
+    (h2d_bytes unchanged), and a host-tier eviction still hits the
+    device planes (plane_hits) — results identical to the host path
+    throughout."""
+    import json
+    import re
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    from opengemini_tpu.query import parse_query
+    eng, ex = db
+    # keep the block path out of the way so the dense route carries all
+    # file rows
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 1 << 40)
+    seed(eng, hosts=3, points=360)
+    text = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE "
+            "time >= 0 AND time < 3600s GROUP BY time(1m), host")
+    host_res = q(ex, text)                      # host dense reference
+    monkeypatch.setenv("OG_DENSE_DEVICE", "1")
+    p0 = dict(dc.PLANE_STATS)
+    r1 = q(ex, text)
+    assert r1 == host_res
+    p1 = dict(dc.PLANE_STATS)
+    assert p1["plane_puts"] > p0["plane_puts"]          # staked
+    h2d_after_put = DEVICE_STATS["h2d_bytes"]
+    r2 = q(ex, text)
+    assert r2 == host_res
+    assert DEVICE_STATS["h2d_bytes"] == h2d_after_put   # no re-upload
+    assert dc.PLANE_STATS["plane_puts"] == p1["plane_puts"]
+    (stmt,) = parse_query("EXPLAIN ANALYZE " + text)
+    txt = json.dumps(ex.execute(stmt, "db0"))
+    m = re.search(r'decoded_segments=(\d+)', txt)
+    # the dense route + caches leave nothing to decode on repeats
+    assert m is None or int(m.group(1)) == 0
+    # host-tier eviction: device planes still answer (H2D skipped)
+    dc.host_cache().purge()
+    r3 = q(ex, text)
+    assert r3 == host_res
+    assert dc.PLANE_STATS["plane_hits"] > p1["plane_hits"]
+    assert dc.PLANE_STATS["plane_puts"] == p1["plane_puts"]
+
+
+def test_dense_device_disabled_by_default(db, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 1 << 40)
+    monkeypatch.delenv("OG_DENSE_DEVICE", raising=False)
+    seed(eng, hosts=2, points=240)
+    p0 = dict(dc.PLANE_STATS)
+    q(ex, TEXT.replace("4800s", "2400s"))
+    assert dc.PLANE_STATS["plane_puts"] == p0["plane_puts"]
+
+
+def test_multi_field_single_pull(db, monkeypatch):
+    """Satellite: the multi-field batched reduction fetches both packed
+    stacks with ONE readiness wait + parallel chunked pull (not two
+    sequential np.asarray round-trips) and stays correct."""
+    from opengemini_tpu.ops.segment_agg import (AggSpec,
+                                                multi_segment_aggregate)
+    rng = np.random.default_rng(9)
+    F, N, S = 3, 4096, 16
+    vals = rng.normal(10, 2, (F, N))
+    valid = rng.random((F, N)) > 0.1
+    seg = np.sort(rng.integers(0, S, N)).astype(np.int64)
+    times = np.arange(N, dtype=np.int64)
+    spec = AggSpec.of("mean", "min", "max", "first", "last")
+    res, lsum = multi_segment_aggregate(vals, valid, None, seg, times,
+                                        S, spec, sorted_ids=True)
+    assert lsum is None
+    for f in range(F):
+        for s in range(S):
+            m = valid[f] & (seg == s)
+            assert res.count[f][s] == m.sum()
+            if m.any():
+                assert res.min[f][s] == vals[f][m].min()
+                assert res.max[f][s] == vals[f][m].max()
