@@ -112,9 +112,105 @@ fail_times:
     return nullptr;
 }
 
+// Shared column-pointer parse for the group builder below.
+static int parse_cols(PyObject* cols_obj, PyObject* masks_obj,
+                      const void** col_ptr, const uint8_t** mask_ptr,
+                      int* col_is_int, Py_ssize_t* n_out_p) {
+    Py_ssize_t n_out = PyTuple_GET_SIZE(cols_obj);
+    if (PyTuple_GET_SIZE(masks_obj) != n_out) {
+        PyErr_SetString(PyExc_ValueError, "masks/cols length mismatch");
+        return -1;
+    }
+    if (n_out > 64) {
+        PyErr_SetString(PyExc_ValueError, "too many output columns");
+        return -1;
+    }
+    for (Py_ssize_t i = 0; i < n_out; i++) {
+        PyObject* c = PyTuple_GET_ITEM(cols_obj, i);
+        unsigned long long addr =
+            PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(c, 0));
+        long kind = PyLong_AsLong(PyTuple_GET_ITEM(c, 1));
+        if (PyErr_Occurred()) return -1;
+        col_ptr[i] = reinterpret_cast<const void*>(
+            static_cast<uintptr_t>(addr));
+        col_is_int[i] = (int)kind;
+        unsigned long long maddr =
+            PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(masks_obj, i));
+        if (PyErr_Occurred()) return -1;
+        mask_ptr[i] = reinterpret_cast<const uint8_t*>(
+            static_cast<uintptr_t>(maddr));
+    }
+    *n_out_p = n_out;
+    return 0;
+}
+
+// build_group_rows(times, cols, masks, keep, W, desc, offset, limit)
+//   One GROUP's row assembly for the grouped-interval result shapes:
+//   times (W,) int64; cols/masks as build_rows but pointing at this
+//   group's W-cell slice; keep (W,) uint8 (0 addr = every window
+//   emits a row — the fill-padded shapes); rows ordered ascending,
+//   reversed when desc, then offset/limit sliced (limit 0 = no cap).
+//   Output types match the Python fallback exactly.
+static PyObject* build_group_rows(PyObject*, PyObject* args) {
+    PyObject *cols_obj, *masks_obj;
+    unsigned long long times_addr, keep_addr;
+    Py_ssize_t W, offset, limit;
+    int desc;
+    if (!PyArg_ParseTuple(args, "KOOKninn", &times_addr, &cols_obj,
+                          &masks_obj, &keep_addr, &W, &desc, &offset,
+                          &limit))
+        return nullptr;
+    const int64_t* times = reinterpret_cast<const int64_t*>(
+        static_cast<uintptr_t>(times_addr));
+    const uint8_t* keep = reinterpret_cast<const uint8_t*>(
+        static_cast<uintptr_t>(keep_addr));
+    const void* col_ptr[64];
+    const uint8_t* mask_ptr[64];
+    int col_is_int[64];
+    Py_ssize_t n_out = 0;
+    if (parse_cols(cols_obj, masks_obj, col_ptr, mask_ptr, col_is_int,
+                   &n_out) < 0)
+        return nullptr;
+    PyObject* out = PyList_New(0);
+    if (!out) return nullptr;
+    Py_ssize_t emitted = 0, skipped = 0;
+    for (Py_ssize_t step = 0; step < W; step++) {
+        Py_ssize_t w = desc ? (W - 1 - step) : step;
+        if (keep && !keep[w]) continue;
+        if (skipped < offset) { skipped++; continue; }
+        if (limit > 0 && emitted >= limit) break;
+        PyObject* row = PyList_New(1 + n_out);
+        if (!row) { Py_DECREF(out); return nullptr; }
+        PyObject* t = PyLong_FromLongLong(times[w]);
+        if (!t) { Py_DECREF(row); Py_DECREF(out); return nullptr; }
+        PyList_SET_ITEM(row, 0, t);
+        for (Py_ssize_t i = 0; i < n_out; i++) {
+            PyObject* v;
+            if (mask_ptr[i] && !mask_ptr[i][w]) {
+                Py_INCREF(Py_None);
+                v = Py_None;
+            } else if (col_is_int[i]) {
+                v = PyLong_FromLongLong(((const int64_t*)col_ptr[i])[w]);
+            } else {
+                v = PyFloat_FromDouble(((const double*)col_ptr[i])[w]);
+            }
+            if (!v) { Py_DECREF(row); Py_DECREF(out); return nullptr; }
+            PyList_SET_ITEM(row, 1 + i, v);
+        }
+        if (PyList_Append(out, row) < 0) {
+            Py_DECREF(row); Py_DECREF(out); return nullptr;
+        }
+        Py_DECREF(row);
+        emitted++;
+    }
+    return out;
+}
+
 static PyMethodDef Methods[] = {
     {"build_rows", build_rows, METH_VARARGS,
      "Assemble [time, v...] row lists from raw column buffers."},
+    {"build_group_rows", build_group_rows, METH_VARARGS,
+     "Assemble one group's [time, v...] rows with keep/desc/slicing."},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "ogpyrows",

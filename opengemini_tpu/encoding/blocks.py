@@ -51,6 +51,18 @@ def _zstd_c(b: bytes) -> bytes:
     return c.compress(b)
 
 
+def _zstd_c_fast(b: bytes) -> bytes:
+    """Speed-tier compressor for NUMERIC raw payloads (level 1 — the
+    zlib-shim routes it to the native LZ4 block codec): f64/int64
+    mantissa bytes barely reward zlib's extra search, while encode AND
+    decode speed feed the flush and scan paths directly. Strings keep
+    the ratio tier (repetitive tags compress 2-5× better there)."""
+    c = getattr(_tls, "zcf", None)
+    if c is None:
+        c = _tls.zcf = zstandard.ZstdCompressor(level=1)
+    return c.compress(b)
+
+
 # cap on a single decompressed block: segments are <=64k values of 8 bytes
 # plus headers, so anything claiming more is corrupt or hostile
 _MAX_BLOCK_BYTES = 64 * 1024 * 1024
@@ -92,7 +104,7 @@ def encode_integer_block(values: np.ndarray) -> bytes:
         if len(payload) < 8 * n:
             return bytes([S8B]) + payload
     raw = v.tobytes()
-    z = _zstd_c(raw)
+    z = _zstd_c_fast(raw)
     if len(z) < len(raw):
         return bytes([ZSTD]) + z
     return bytes([RAW]) + raw
@@ -138,7 +150,7 @@ def encode_float_block(values: np.ndarray, prefer: str = "auto") -> bytes:
     if prefer == "gorilla":
         return bytes([GORILLA]) + gorilla.encode(v)
     raw = v.tobytes()
-    z = _zstd_c(raw)
+    z = _zstd_c_fast(raw)
     if len(z) < len(raw):
         return bytes([ZSTD]) + z
     return bytes([RAW]) + raw

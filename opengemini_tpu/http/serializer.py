@@ -1,0 +1,222 @@
+"""Streaming /query result serialization.
+
+Role of the reference's ResponseWriter emit path
+(lib/util/lifted/influx/httpd/response_writer.go): the default JSON
+route built ONE giant document string (`json.dumps` of an 11.5M-cell
+result is ~380MB and seconds of wall) while the socket sat idle, and
+the whole document lived in memory at once. Here the envelope streams
+per SERIES ENTRY:
+
+  * ``iter_results_json`` yields byte pieces whose concatenation is
+    BYTE-IDENTICAL to ``json.dumps(payload).encode()`` (golden-tested)
+    — each piece is at most one series entry plus envelope glue, so
+    peak memory is one entry, not the document;
+  * ``stream_chunks`` runs the encoder on a background thread behind a
+    small bounded queue (OG_STREAM_QUEUE, default 8 pieces), so JSON
+    encoding of entry k overlaps the socket write of entry k-1 — and
+    when the ``series`` value is a lazy iterable (finalize-pool chunk
+    emission), serialization overlaps result finalization itself;
+  * ``iter_results_csv`` is the same streaming shape for the CSV
+    Accept route (concatenation == formats.results_to_csv).
+
+The HTTP layer gates the route behind OG_STREAM_JSON (default on) and
+accounts the wall as the ``serialize`` query phase (ops/devstats), so
+BENCH and /debug/vars attribute emit cost separately from finalize.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterable, Iterator
+
+_COALESCE = 256 * 1024          # target piece size handed to the socket
+
+
+def stream_queue_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("OG_STREAM_QUEUE", "8")))
+    except ValueError:
+        return 8
+
+
+def stream_json_enabled() -> bool:
+    return os.environ.get("OG_STREAM_JSON", "1") != "0"
+
+
+# -------------------------------------------------------------- encoder
+
+def _iter_value(o) -> Iterator[bytes]:
+    """Stream one JSON value; dicts/lists recurse so a huge ``series``
+    list (or any nested row payload) never materializes as one string.
+    Scalar leaves and ROWS encode with json.dumps — separators match
+    its defaults (", ", ": ") so the concatenation is byte-identical."""
+    if isinstance(o, dict):
+        if not o or not all(isinstance(k, str) for k in o):
+            # non-str keys take json.dumps' coercion rules — rare and
+            # small (never the series envelope); emit in one piece
+            yield json.dumps(o).encode()
+            return
+        yield b"{"
+        first = True
+        for k, v in o.items():
+            head = b"" if first else b", "
+            first = False
+            yield head + json.dumps(k).encode() + b": "
+            if isinstance(v, dict) or _is_stream_list(k, v):
+                yield from _iter_value(v)
+            else:
+                yield json.dumps(v).encode()
+        yield b"}"
+        return
+    if isinstance(o, (list, tuple)) or _is_lazy_iter(o):
+        yield b"["
+        first = True
+        for item in o:
+            if not first:
+                yield b", "
+            first = False
+            if isinstance(item, dict):
+                yield from _iter_value(item)
+            else:
+                yield json.dumps(item).encode()
+        yield b"]"
+        return
+    yield json.dumps(o).encode()
+
+
+def _is_stream_list(key: str, v) -> bool:
+    """Container values worth streaming element-wise: the results /
+    series envelopes (one series entry per piece). Row lists inside an
+    entry stay on json.dumps — per-row pieces would drown the pipe in
+    tiny yields."""
+    return key in ("results", "series") and (
+        isinstance(v, (list, tuple)) or _is_lazy_iter(v))
+
+
+def _is_lazy_iter(v) -> bool:
+    return (not isinstance(v, (str, bytes, dict, list, tuple))
+            and hasattr(v, "__iter__"))
+
+
+def iter_results_json(payload: dict,
+                      tail: bytes = b"\n") -> Iterator[bytes]:
+    """Byte pieces of the /query JSON body, coalesced to ~256KB for
+    the socket; b"".join(...) == json.dumps(payload).encode() + tail.
+    A series entry is encoded only when the iterator reaches it, so a
+    lazy ``series`` iterable streams as it is produced."""
+    buf = bytearray()
+    for piece in _iter_value(payload):
+        buf += piece
+        if len(buf) >= _COALESCE:
+            yield bytes(buf)
+            buf.clear()
+    buf += tail
+    if buf:
+        yield bytes(buf)
+
+
+# ------------------------------------------------------------------ csv
+
+def iter_results_csv(payload: dict) -> Iterator[bytes]:
+    """Streaming twin of formats.results_to_csv: concatenation is
+    byte-identical, pieces are bounded (one row block per series)."""
+    from .formats import _csv_escape
+    buf = bytearray()
+    any_out = False
+    for res in payload.get("results", []):
+        for s in res.get("series", []):
+            any_out = True
+            cols = s.get("columns", [])
+            buf += (",".join(["name", "tags"]
+                             + [_csv_escape(c) for c in cols])
+                    + "\n").encode()
+            tags = ",".join(f"{k}={v}" for k, v in
+                            sorted(s.get("tags", {}).items()))
+            head = _csv_escape(s.get("name", "")) + "," \
+                + _csv_escape(tags)
+            for row in s.get("values", []):
+                cells = [head]
+                cells += ["" if v is None else
+                          (repr(v) if isinstance(v, float)
+                           else _csv_escape(v))
+                          for v in row]
+                buf += (",".join(cells) + "\n").encode()
+                if len(buf) >= _COALESCE:
+                    yield bytes(buf)
+                    buf.clear()
+        if "error" in res:
+            any_out = True
+            buf += (f"error,{_csv_escape(res['error'])}" + "\n").encode()
+    if not any_out:
+        # results_to_csv returns "" for empty output (no trailing \n)
+        if buf:
+            yield bytes(buf)
+        return
+    if buf:
+        yield bytes(buf)
+
+
+# ------------------------------------------------- bounded-queue overlap
+
+_END = object()
+
+
+def stream_chunks(pieces: Iterable[bytes],
+                  depth: int | None = None) -> Iterator[bytes]:
+    """Re-yield ``pieces`` produced on a BACKGROUND thread through a
+    bounded queue: the producer (JSON/CSV encoding — and, behind a
+    lazy series iterable, finalize itself) runs ahead of the consumer
+    (socket writes) by at most ``depth`` pieces. An encoder exception
+    re-raises in the consumer after the in-flight pieces drain.
+
+    Abandonment-safe: when the consumer drops the generator mid-stream
+    (client disconnect → BrokenPipeError in the socket writer), the
+    ``finally`` sets the stop flag and drains the queue, so the
+    producer's bounded put can never block forever holding the encoded
+    document alive (the leak would be one thread + up to the full
+    result per aborted request)."""
+    import queue
+    q: "queue.Queue" = queue.Queue(maxsize=depth or stream_queue_depth())
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.25)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for p in pieces:
+                if not _put(p):
+                    return
+        except BaseException as e:   # noqa: BLE001 — re-raised below
+            err.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="og-serialize")
+    t.start()
+    try:
+        while True:
+            p = q.get()
+            if p is _END:
+                break
+            yield p
+    finally:
+        stop.set()
+        while True:               # release a blocked producer put
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5)
+    if err:
+        raise err[0]

@@ -1076,8 +1076,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(blob + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
             return
-        if code == 200 and ("application/csv" in accept
-                            or "text/csv" in accept):
+        want_csv = ("application/csv" in accept
+                    or "text/csv" in accept)
+        from .serializer import stream_json_enabled
+        if (code == 200 and stream_json_enabled()
+                and "application/x-msgpack" not in accept
+                and any(s.get("values")
+                        for r in payload.get("results", [])
+                        for s in (r.get("series") or ()))):
+            # result-bearing responses stream: series entries encode
+            # behind a bounded queue while this thread writes the
+            # socket — the 380MB-document json.dumps stall is gone
+            # (OG_STREAM_JSON=0 restores the buffered route)
+            self._stream_query(payload, csv=want_csv)
+            return
+        if code == 200 and want_csv:
             from .formats import results_to_csv
             body = results_to_csv(payload).encode()
             ctype = "text/csv"
@@ -1094,6 +1107,40 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _stream_query(self, payload: dict, csv: bool) -> None:
+        """Chunked-transfer emit of a /query result (streaming
+        serialization tentpole): pieces encode on a background thread
+        behind a small bounded queue while THIS thread writes the
+        socket, so JSON/CSV encoding overlaps the send — and when the
+        executor hands a lazy series iterable, overlaps finalize too.
+        Body bytes are identical to the buffered route (golden-tested);
+        only the transfer framing changes. Wall is accounted as the
+        ``serialize`` query phase."""
+        from ..ops import devstats
+        from .serializer import (iter_results_csv, iter_results_json,
+                                 stream_chunks)
+        t0 = time.perf_counter_ns()
+        pieces = iter_results_csv(payload) if csv else \
+            iter_results_json(payload)
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/csv" if csv else "application/json")
+        if not csv:
+            self.send_header("X-Influxdb-Version",
+                             "1.8-opengemini-tpu-" + __version__)
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        w = self.wfile
+        for p in stream_chunks(pieces):
+            if not p:
+                continue
+            w.write(f"{len(p):x}\r\n".encode())
+            w.write(p)
+            w.write(b"\r\n")
+        w.write(b"0\r\n\r\n")
+        devstats.bump_phase("serialize", time.perf_counter_ns() - t0)
 
     def _reply(self, code: int, payload: dict | None = None,
                headers: dict | None = None) -> None:

@@ -856,6 +856,50 @@ def build_rows(times: np.ndarray, cols: list, masks: list,
                         G, W)
 
 
+def build_group_rows(times: np.ndarray, cols: list, masks: list,
+                     keep, desc: bool, offset: int, limit: int):
+    """C-speed assembly of ONE group's [t, v0, ...] rows for the
+    grouped-interval shapes the dense build_rows can't express:
+    `keep` ((W,) bool/uint8 or None) selects which windows emit rows
+    (fill-none sparsity), rows reverse under `desc`, then
+    offset/limit slice (limit 0 = uncapped). cols: list of (W,)
+    float64/int64 arrays for THIS group; masks: parallel (W,)
+    uint8/bool arrays or None (0 → cell becomes None). Returns the
+    row list, or None when the extension is unavailable."""
+    m = _load_pyrows()
+    if m is None or len(cols) > 64 \
+            or not hasattr(m, "build_group_rows"):
+        return None
+    t = np.ascontiguousarray(times, dtype=np.int64)
+    prep_c, prep_m, alive = [], [], [t]
+    for c, mk in zip(cols, masks):
+        if c.dtype == np.int64:
+            kind = 1
+        elif c.dtype == np.float64:
+            kind = 0
+        else:
+            return None
+        c = np.ascontiguousarray(c)
+        alive.append(c)
+        prep_c.append((c.ctypes.data, kind))
+        if mk is None:
+            prep_m.append(0)
+        else:
+            mk = np.ascontiguousarray(mk, dtype=np.uint8)
+            alive.append(mk)
+            prep_m.append(mk.ctypes.data)
+    if keep is None:
+        keep_addr = 0
+    else:
+        keep = np.ascontiguousarray(keep, dtype=np.uint8)
+        alive.append(keep)
+        keep_addr = keep.ctypes.data
+    return m.build_group_rows(t.ctypes.data, tuple(prep_c),
+                              tuple(prep_m), keep_addr, len(t),
+                              1 if desc else 0, int(offset),
+                              int(limit))
+
+
 # ------------------------------------------------------- series sid map
 
 def _bind_map(lib) -> None:

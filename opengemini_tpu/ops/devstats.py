@@ -44,7 +44,12 @@ QUERY_PHASE_NS: dict = {
     "device_agg_ns": 0,
     "device_pull_ns": 0,
     "grid_fold_ns": 0,
+    # merge is NESTED inside finalize (exchange-merge of partials);
+    # serialize is the HTTP-layer streaming JSON/CSV emit, outside the
+    # executor span — so merge ⊂ finalize and serialize is additive
+    "merge_ns": 0,
     "finalize_ns": 0,
+    "serialize_ns": 0,
     "queries": 0,
 }
 

@@ -341,3 +341,86 @@ class OGSketch:
         s = cls(clusters)
         s.insert(values)
         return s
+
+
+def batch_percentile(states: list, q: float) -> np.ndarray:
+    """Vectorized `OGSketch.from_state(st).percentile(q)` over a flat
+    list of state dicts (None entries → NaN). One padded (N, L) pass
+    replaces N per-cell object constructions + settles — the
+    ogsketch_percentile finalize at high cardinality (G·W cells) was a
+    literal per-cell Python loop. Bit-identical to the scalar path:
+    the accumulative-midpoint cumsum runs in the same order per lane,
+    and every interpolation formula is applied elementwise with the
+    same operand order. Cells whose serialized sketch would trigger a
+    re-compression in _settle (means longer than sketch_size — not
+    produced by to_state, but tolerated) fall back to the scalar
+    object path."""
+    N = len(states)
+    out = np.full(N, np.nan)
+    live: list[int] = []
+    for i, st in enumerate(states):
+        if st is None:
+            continue
+        n_m = len(st["means"])
+        if n_m == 0 or float(st["all_weight"]) <= 0:
+            continue
+        if n_m > int(2 * math.ceil(max(float(st["c"]), 1.0))):
+            # would re-compress in _settle: keep scalar semantics
+            out[i] = OGSketch.from_state(st).percentile(q)
+            continue
+        live.append(i)
+    if not live or q < 0 or q > 1:
+        return out
+    L = max(len(states[i]["means"]) for i in live)
+    n_live = len(live)
+    m = np.zeros((n_live, L))
+    w = np.zeros((n_live, L))
+    n_arr = np.empty(n_live, dtype=np.int64)
+    aw = np.empty(n_live)
+    mn = np.empty(n_live)
+    mx = np.empty(n_live)
+    for j, i in enumerate(live):
+        st = states[i]
+        k = len(st["means"])
+        n_arr[j] = k
+        m[j, :k] = st["means"]
+        w[j, :k] = st["weights"]
+        aw[j] = float(st["all_weight"])
+        mn[j] = float(st["min"])
+        mx[j] = float(st["max"])
+    last = n_arr - 1
+    cols = np.arange(L)[None, :]
+    # accumulative half-weight midpoints (same add order as _settle)
+    acc = np.empty_like(w)
+    acc[:, 0] = w[:, 0] / 2
+    if L > 1:
+        acc[:, 1:] = (w[:, 1:] + w[:, :-1]) / 2
+        np.cumsum(acc, axis=1, out=acc)
+    rank = q * aw
+    m0 = m[:, 0]
+    w0h = w[:, 0] / 2
+    mlast = np.take_along_axis(m, last[:, None], axis=1)[:, 0]
+    wlasth = np.take_along_axis(w, last[:, None], axis=1)[:, 0] / 2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        low = mn + rank / w0h * (m0 - mn)
+        high = mx - (aw - rank) / wlasth * (mx - mlast)
+        # searchsorted(acc[:n], rank, side="right") per lane: count of
+        # acc entries <= rank among the first n (acc is nondecreasing)
+        idx = ((acc <= rank[:, None]) & (cols < n_arr[:, None])).sum(
+            axis=1)
+        idx = np.minimum(np.maximum(idx, 1), np.maximum(last, 1))
+        ilo = np.minimum(idx - 1, last)[:, None]
+        ihi = np.minimum(idx, last)[:, None]
+        m_lo = np.take_along_axis(m, ilo, axis=1)[:, 0]
+        m_hi = np.take_along_axis(m, ihi, axis=1)[:, 0]
+        w_lo = np.take_along_axis(w, ilo, axis=1)[:, 0]
+        w_hi = np.take_along_axis(w, ihi, axis=1)[:, 0]
+        a_lo = np.take_along_axis(acc, ilo, axis=1)[:, 0]
+        mid = m_lo + 2 * (rank - a_lo) / (w_lo + w_hi) * (m_hi - m_lo)
+        # single-centroid lanes: the scalar path's clamped index wraps
+        # to the sole centroid and the slope term vanishes → exactly m0
+        mid = np.where(last == 0, m0, mid)
+        vals = np.where(rank < w0h, low,
+                        np.where(rank >= aw - wlasth, high, mid))
+    out[np.asarray(live, dtype=np.int64)] = vals
+    return out

@@ -991,6 +991,23 @@ class QueryExecutor:
             tag_keys = {k for s in shards_all
                         for k in s.index.tag_keys(mst)}
             cond = analyze_condition(stmt.condition, tag_keys)
+            if cond.residual is not None and tb.has_time_range:
+                # a tag key present in the db but absent from every
+                # shard in the queried window must still classify as a
+                # TAG (influx: a missing tag compares as '', so
+                # `tag != 'x'` matches). Only names that are neither a
+                # window tag NOR a window field can be such ghosts —
+                # ordinary field predicates (the hot dashboard shape)
+                # must NOT pay a db-wide cold-shard walk here
+                known_fields = {k for s in shards_all
+                                for k in s._schemas.get(mst, {})}
+                if cond.residual_fields() - known_fields - tag_keys:
+                    all_keys = {k for s in db_obj.all_shards()
+                                for k in s.index.tag_keys(mst)}
+                    if not all_keys <= tag_keys:
+                        tag_keys = tag_keys | all_keys
+                        cond = analyze_condition(stmt.condition,
+                                                 tag_keys)
             if cs.mode == "agg":
                 res = self._select_agg(stmt, db, mst, cs, cond, tag_keys,
                                        ctx=ctx, span=span,
@@ -1142,9 +1159,12 @@ class QueryExecutor:
                 names = numeric
             rest = [a for a in e.args if a is not pat]
             for k in names:
+                # alias'd expansions name per-field (influx alias_field
+                # naming) — a bare alias would emit duplicate columns
                 fields.append(SelectField(
                     Call(e.func, [FieldRef(k)] + list(rest)),
-                    sf.alias or f"{e.func}_{k}"))
+                    f"{sf.alias}_{k}" if sf.alias else
+                    f"{e.func}_{k}"))
         if not fields:
             return None
         return _rep(stmt, fields=fields)
@@ -1260,7 +1280,7 @@ class QueryExecutor:
         if span is not None:
             with span.child("finalize") as sp:
                 res = finalize_partials(stmt, mst, cs, [partial],
-                                        plan=hints)
+                                        plan=hints, span=sp)
                 sp.add(series=len(res.get("series", [])))
         else:
             res = finalize_partials(stmt, mst, cs, [partial],
@@ -2545,6 +2565,7 @@ class QueryExecutor:
         if fold_sp is not None:
             fold_sp.start_ns = _t_fold0
         fields_out: dict[str, dict] = {}
+        fb_omitted: list[str] = []
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
             for k in ("count", "sum", "sumsq", "min", "max", "first",
@@ -2777,6 +2798,15 @@ class QueryExecutor:
                     exact_scales[fname] = e_final
                 st["sum_limbs"] = lg[:G * W].reshape(G, W, K_LIMBS)
                 st["sum_inexact"] = ixg[:G * W].reshape(G, W)
+            if my_blocks and not fb_needed and "sum" in st and any(
+                    "limbs" in bo for _r2, _s3, bo in my_blocks):
+                # the f64 fallback st["sum"] omitted these blocks'
+                # contributions (fb_needed said no LOCAL source reads
+                # it) — flag the field so an exchange merge with
+                # remote partials (whose inexact cells DO read the
+                # merged fallback) substitutes the limb-derived sum
+                # for this partial instead of the incomplete grid
+                fb_omitted.append(fname)
             fields_out[fname] = st
         _dstat.bump_phase("grid_fold", _now_ns() - _t_fold0)
         if fold_sp is not None:
@@ -2794,6 +2824,8 @@ class QueryExecutor:
         }
         if exact_scales:
             partial["sum_scales"] = dict(exact_scales)
+        if fb_omitted:
+            partial["fb_omitted"] = fb_omitted
         if not interval:
             # influx shows epoch 0 on unbounded windowless aggregates
             partial["display_start"] = \
@@ -3245,6 +3277,23 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
     else:
         W = 1
 
+    # per-partial grid placement, hoisted OUT of the per-field loop:
+    # the aligned-key lookup and np.ix_ build are pure functions of the
+    # partial, and the old per-(field, partial) recomputation was
+    # O(F·P·G) Python at high cardinality
+    p_rows: list[np.ndarray] = []
+    p_off: list[int] = []
+    p_ix: list[tuple] = []
+    p_fbom: list[frozenset] = []
+    for pi, p in enumerate(partials):
+        rows = np.array([key_to_gi[k] for k in aligned_keys[pi]],
+                        dtype=np.int64)
+        off = int((p["start"] - start) // interval) if interval else 0
+        p_rows.append(rows)
+        p_off.append(off)
+        p_ix.append(np.ix_(rows, np.arange(off, off + p["W"])))
+        p_fbom.append(frozenset(p.get("fb_omitted", ())))
+
     fnames = sorted(set().union(*[p["fields"].keys() for p in partials]))
     merged_fields: dict[str, dict] = {}
     field_types: dict[str, str] = {}
@@ -3285,14 +3334,23 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
             st = p["fields"].get(fname)
             if st is None:
                 continue
-            rows = np.array([key_to_gi[k] for k in aligned_keys[pi]],
-                            dtype=np.int64)
-            off = int((p["start"] - start) // interval) if interval else 0
-            cols = np.arange(off, off + p["W"])
-            ix = np.ix_(rows, cols)
+            ix = p_ix[pi]
             for k in ("count", "sum", "sumsq"):
                 if k in tgt and k in st:
-                    tgt[k][ix] += st[k]
+                    src = st[k]
+                    if k == "sum" and fname in p_fbom[pi] \
+                            and "sum_limbs" in st:
+                        # this partial's f64 fallback sum omitted its
+                        # block contributions (fb_omitted); its limbs
+                        # are complete — substitute the limb-derived
+                        # total so a cell another partial flags
+                        # inexact never reads a sum missing whole
+                        # files (ADVICE r5 medium)
+                        from ..ops.exactsum import finalize_exact
+                        src = finalize_exact(
+                            st["sum_limbs"],
+                            p.get("sum_scales", {}).get(fname, 0))
+                    tgt[k][ix] += src
             if "min" in tgt and "min" in st:
                 if "min_time" in tgt and "min_time" in st:
                     cur_v, cur_t = tgt["min"][ix], tgt["min_time"][ix]
@@ -3342,12 +3400,7 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                 st = p["fields"].get(fname)
                 if st is None or "sum_limbs" not in st:
                     continue
-                rows = np.array([key_to_gi[k] for k in aligned_keys[pi]],
-                                dtype=np.int64)
-                off = int((p["start"] - start) // interval) if interval \
-                    else 0
-                cols = np.arange(off, off + p["W"])
-                ix = np.ix_(rows, cols)
+                ix = p_ix[pi]
                 l2, i2 = rebase(st["sum_limbs"], st["sum_inexact"],
                                 p["sum_scales"][fname], e_t)
                 lg[ix] += l2
@@ -3386,10 +3439,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                 st = p.get("raw", {}).get(fname)
                 if st is None:
                     continue
-                off = int((p["start"] - start) // interval) \
-                    if interval else 0
-                for lgi, gi in enumerate(
-                        key_to_gi[k] for k in aligned_keys[pi]):
+                off = p_off[pi]
+                for lgi, gi in enumerate(p_rows[pi].tolist()):
                     for wi in range(p["W"]):
                         cell = st["vals"][lgi][wi]
                         if cell is None or len(cell) == 0:
@@ -3417,10 +3468,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
                 st = p.get("sketch", {}).get(fname)
                 if st is None:
                     continue
-                off = int((p["start"] - start) // interval) \
-                    if interval else 0
-                for lgi, gi in enumerate(
-                        key_to_gi[k] for k in aligned_keys[pi]):
+                off = p_off[pi]
+                for lgi, gi in enumerate(p_rows[pi].tolist()):
                     for wi in range(p["W"]):
                         cell = st["cells"][lgi][wi]
                         if cell is None:
@@ -3447,9 +3496,8 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
             st = p.get("topn")
             if st is None:
                 continue
-            off = int((p["start"] - start) // interval) if interval else 0
-            for lgi, gi in enumerate(
-                    key_to_gi[k] for k in aligned_keys[pi]):
+            off = p_off[pi]
+            for lgi, gi in enumerate(p_rows[pi].tolist()):
                 for wi in range(p["W"]):
                     cell = st["vals"][lgi][wi]
                     if cell is None or len(cell) == 0:
@@ -3582,8 +3630,69 @@ from ..ops.pipeline import (  # noqa: E402
     device_get_parallel as _device_get_parallel)
 
 
+# ------------------------------------------------- finalize worker pool
+
+_FIN_POOLS: dict = {}
+_FIN_POOL_LOCK = __import__("threading").Lock()
+
+
+def finalize_workers(default: int | None = None) -> int:
+    """Worker count for the group-sharded finalize stages
+    (OG_FINALIZE_WORKERS; 0/1 = serial; unset = per-stage default).
+    Stages pick their own default by what bounds them: the sketch
+    percentile finalize is padded-numpy work (GIL-released — measured
+    1.4× at 8 workers) and defaults to min(8, cpus); the row-assembly
+    stages build millions of PyObjects under the GIL, where threads
+    only add handoff convoy (measured 3.7s serial vs 4.9s pooled at
+    11.5M cells) and default to serial. The env knob overrides every
+    stage — equivalence across ALL settings is enforced by tests and
+    scripts/perf_smoke.sh."""
+    import os
+    raw = os.environ.get("OG_FINALIZE_WORKERS", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = -1
+    if n >= 0:
+        return n
+    if default is not None:
+        return default
+    return min(8, os.cpu_count() or 1)
+
+
+def _fin_pool(n: int):
+    from concurrent.futures import ThreadPoolExecutor
+    with _FIN_POOL_LOCK:
+        p = _FIN_POOLS.get(n)
+        if p is None:
+            p = _FIN_POOLS[n] = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="og-finalize")
+        return p
+
+
+def _run_chunked(fn, n_items: int, min_chunk: int,
+                 default_workers: int | None = None) -> None:
+    """Run fn(lo, hi) over [0, n_items) in contiguous chunks, on the
+    finalize pool when enabled. fn writes into caller-owned disjoint
+    slices, so chunk boundaries and worker count cannot change the
+    result — OG_FINALIZE_WORKERS=1 is bit-identical to N (enforced by
+    tests and scripts/perf_smoke.sh)."""
+    if n_items <= 0:
+        return
+    w = finalize_workers(default_workers)
+    chunk = max(min_chunk, 1, -(-n_items // max(4 * w, 1)))
+    if w <= 1 or chunk >= n_items:
+        fn(0, n_items)
+        return
+    bounds = [(lo, min(lo + chunk, n_items))
+              for lo in range(0, n_items, chunk)]
+    pool = _fin_pool(w)
+    # list() propagates the first worker exception to the caller
+    list(pool.map(lambda b: fn(*b), bounds))
+
+
 def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
-                      plan: dict | None = None) -> dict:
+                      plan: dict | None = None, span=None) -> dict:
     """Merge partials and build the influx-style result: evaluate the
     select-list expressions on the merged state grids, apply fill, run
     window transforms, assemble rows (the sql node's Materialize/Fill/
@@ -3604,7 +3713,19 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
                 stmt.limit or stmt.offset or stmt.slimit
                 or stmt.soffset):
             stmt = _rp(stmt, limit=0, offset=0, slimit=0, soffset=0)
+    from ..ops import devstats as _dstat
+    _t_m0 = _now_ns()
     merged = merge_partials(partials)
+    _t_m1 = _now_ns()
+    # exchange-merge accounting: nested under finalize in the span
+    # tree AND its own cumulative phase, so a regressing cluster merge
+    # is attributable separately from expression/row assembly
+    _dstat.bump_phase("merge", _t_m1 - _t_m0)
+    if span is not None:
+        msp = span.child("merge")
+        msp.start_ns = _t_m0
+        msp.end_ns = _t_m1
+        msp.add(partials=len([p for p in partials if p]))
     if merged is None:
         return {}
     group_tags = merged["group_tags"]
@@ -3647,17 +3768,26 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
         if a.func in MOMENT_AGGS:
             grid = finalize_moment(a.func, st)
         elif a.func in SKETCH_AGGS:
-            # ogsketch_percentile phase: interpolated quantile per cell
+            # ogsketch_percentile phase: interpolated quantile per
+            # cell — vectorized over whole group rows (ogsketch.
+            # batch_percentile) and sharded across the finalize pool;
+            # the per-cell object loop was G·W Python at 11.5M cells
             sk = merged.get("sketch", {}).get(a.field)
             grid = np.full((G, W), np.nan)
             if sk is not None:
+                from ..ops.ogsketch import batch_percentile
                 q = (a.arg or 0.0) / 100.0
-                for gi in range(G):
-                    for wi in range(W):
-                        cell = sk["cells"][gi][wi]
-                        if cell is not None:
-                            grid[gi, wi] = OGSketch.from_state(
-                                cell).percentile(q)
+                cells = sk["cells"]
+
+                def _sk_chunk(lo, hi, _c=cells, _q=q, _g=grid):
+                    flat = [cell for row in _c[lo:hi] for cell in row]
+                    _g[lo:hi] = batch_percentile(flat, _q).reshape(
+                        hi - lo, W)
+                import os as _os
+                _run_chunked(_sk_chunk, G,
+                             max(1, 4096 // max(W, 1)),
+                             default_workers=min(
+                                 8, _os.cpu_count() or 1))
         else:
             raw = merged.get("raw", {}).get(a.field)
             if raw is None:
@@ -3699,12 +3829,15 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
 
     order = sorted(range(G), key=lambda gi: group_keys[gi])
 
-    # vectorized materialization for the dominant shape (plain outputs,
-    # fill none/null, window times): the reference's Materialize/HttpSender
-    # transforms are compiled Go — a per-cell Python loop here would
-    # dominate large result grids
+    # vectorized materialization for the dominant shapes (plain
+    # outputs, fill none/null/value/previous, window times): the
+    # reference's Materialize/HttpSender transforms are compiled Go —
+    # a per-cell Python loop here would dominate large result grids.
+    # fill(value/previous) resolve as grid-level transforms inside
+    # _materialize_plain_fast; linear stays on the general loop
     if (vector_ok and point_times is None
-            and stmt.fill_option in ("none", "null")
+            and stmt.fill_option in ("none", "null", "value",
+                                     "previous")
             and all(k == "plain" for _n, k, _p in out_specs)):
         kinds = [_output_cast_kind(expr, aggs, field_types)
                  for _name, expr in cs.outputs]
@@ -3717,100 +3850,109 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
             series_out = series_out[:stmt.slimit]
         return {"series": series_out} if series_out else {}
 
-    series_out = []
     any_rows_g = anyc.any(axis=1)
-    for gi in order:
-        # groups come from the data, not the index: a tag value with
-        # no rows at all in range never materializes (fill only pads
-        # windows of groups that have at least one point) — matches
-        # _materialize_plain_fast
-        if not any_rows_g[gi]:
-            continue
-        tags = dict(zip(group_tags, group_keys[gi]))
-        cells: dict[int, list] = {}    # time -> row cell list
+    entries: list = [None] * G
+    cols_hdr = ["time"] + [n for n, _k, _p in out_specs]
 
-        def cell_row(t: int) -> list:
-            r = cells.get(t)
-            if r is None:
-                r = cells[t] = [None] * n_out
-            return r
+    def _general_chunk(lo: int, hi: int) -> None:
+        for gi in range(lo, hi):
+            # groups come from the data, not the index: a tag value
+            # with no rows at all in range never materializes (fill
+            # only pads windows of groups that have at least one
+            # point) — matches _materialize_plain_fast
+            if not any_rows_g[gi]:
+                continue
+            cells: dict[int, list] = {}    # time -> row cell list
 
-        prev = [None] * n_out
-        # linear fill precompute per plain output
-        lin = {}
-        if stmt.fill_option == "linear" and interval:
-            for oi, (_n, kind, payload) in enumerate(out_specs):
-                if kind != "plain":
-                    continue
-                grid, pres = payload
-                m = anyc[gi] & pres[gi] & ~np.isnan(grid[gi])
-                if m.sum() >= 2:
-                    idx = np.arange(W)
-                    lin[oi] = np.interp(idx, idx[m], grid[gi][m],
-                                        left=np.nan, right=np.nan)
-        have_plain = any(k == "plain" for _n, k, _p in out_specs)
-        if have_plain:
-            for wi in range(W):
-                t = int(win_times[wi])
-                if point_times is not None and anyc[gi, wi]:
-                    t = int(point_times[gi, wi])
-                if anyc[gi, wi]:
-                    row = cell_row(t)
-                    for oi, (_n, kind, payload) in enumerate(out_specs):
-                        if kind != "plain":
-                            continue
-                        grid, pres = payload
-                        v = grid[gi, wi]
-                        if pres[gi, wi] and not np.isnan(v) \
-                                and not np.isinf(v):
-                            row[oi] = casts[oi](v)
-                            prev[oi] = row[oi]
-                    continue
-                # empty window: fill
-                if not interval or stmt.fill_option == "none":
-                    continue
-                row = None
+            def cell_row(t: int) -> list:
+                r = cells.get(t)
+                if r is None:
+                    r = cells[t] = [None] * n_out
+                return r
+
+            prev = [None] * n_out
+            # linear fill precompute per plain output
+            lin = {}
+            if stmt.fill_option == "linear" and interval:
                 for oi, (_n, kind, payload) in enumerate(out_specs):
                     if kind != "plain":
                         continue
-                    if stmt.fill_option == "null":
+                    grid, pres = payload
+                    m = anyc[gi] & pres[gi] & ~np.isnan(grid[gi])
+                    if m.sum() >= 2:
+                        idx = np.arange(W)
+                        lin[oi] = np.interp(idx, idx[m], grid[gi][m],
+                                            left=np.nan, right=np.nan)
+            have_plain = any(k == "plain" for _n, k, _p in out_specs)
+            if have_plain:
+                for wi in range(W):
+                    t = int(win_times[wi])
+                    if point_times is not None and anyc[gi, wi]:
+                        t = int(point_times[gi, wi])
+                    if anyc[gi, wi]:
                         row = cell_row(t)
-                    elif stmt.fill_option == "value":
-                        cell_row(t)[oi] = casts[oi](stmt.fill_value)
-                    elif stmt.fill_option == "previous":
-                        cell_row(t)[oi] = prev[oi]
-                    elif stmt.fill_option == "linear":
-                        v = lin.get(oi, np.full(W, np.nan))[wi]
-                        cell_row(t)[oi] = None if np.isnan(v) \
-                            else casts[oi](v)
-        # transforms
-        for oi, (_n, kind, expr) in enumerate(out_specs):
-            if kind != "transform":
-                continue
-            t_ser, v_ser = _transform_series(
-                stmt, expr, agg_grids, agg_present, anyc, gi, win_times,
-                interval, W, cs=cs, merged=merged)
-            for t, v in zip(t_ser, v_ser):
-                if not (np.isnan(v) or np.isinf(v)):
-                    cell_row(int(t))[oi] = casts[oi](v)
+                        for oi, (_n, kind, payload) in enumerate(
+                                out_specs):
+                            if kind != "plain":
+                                continue
+                            grid, pres = payload
+                            v = grid[gi, wi]
+                            if pres[gi, wi] and not np.isnan(v) \
+                                    and not np.isinf(v):
+                                row[oi] = casts[oi](v)
+                                prev[oi] = row[oi]
+                        continue
+                    # empty window: fill
+                    if not interval or stmt.fill_option == "none":
+                        continue
+                    for oi, (_n, kind, payload) in enumerate(out_specs):
+                        if kind != "plain":
+                            continue
+                        if stmt.fill_option == "null":
+                            cell_row(t)
+                        elif stmt.fill_option == "value":
+                            cell_row(t)[oi] = casts[oi](stmt.fill_value)
+                        elif stmt.fill_option == "previous":
+                            cell_row(t)[oi] = prev[oi]
+                        elif stmt.fill_option == "linear":
+                            v = lin.get(oi, np.full(W, np.nan))[wi]
+                            cell_row(t)[oi] = None if np.isnan(v) \
+                                else casts[oi](v)
+            # transforms
+            for oi, (_n, kind, expr) in enumerate(out_specs):
+                if kind != "transform":
+                    continue
+                t_ser, v_ser = _transform_series(
+                    stmt, expr, agg_grids, agg_present, anyc, gi,
+                    win_times, interval, W, cs=cs, merged=merged)
+                for t, v in zip(t_ser, v_ser):
+                    if not (np.isnan(v) or np.isinf(v)):
+                        cell_row(int(t))[oi] = casts[oi](v)
 
-        if not cells:
-            continue
-        rows = [[t] + cells[t] for t in sorted(cells)]
-        if stmt.order_desc:
-            rows.reverse()
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit:
-            rows = rows[:stmt.limit]
-        if not rows:
-            continue
-        entry = {"name": mst,
-                 "columns": ["time"] + [n for n, _k, _p in out_specs],
-                 "values": rows}
-        if group_tags:
-            entry["tags"] = tags
-        series_out.append(entry)
+            if not cells:
+                continue
+            rows = [[t] + cells[t] for t in sorted(cells)]
+            if stmt.order_desc:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[:stmt.limit]
+            if not rows:
+                continue
+            entry = {"name": mst, "columns": cols_hdr, "values": rows}
+            if group_tags:
+                entry["tags"] = dict(zip(group_tags, group_keys[gi]))
+            entries[gi] = entry
+
+    # group-sharded assembly: every group's rows are independent,
+    # entries re-emit in key order below, so worker count cannot
+    # reorder or change output. Default serial — the body is
+    # GIL-bound object construction (see finalize_workers)
+    _run_chunked(_general_chunk, G, max(1, (1 << 16) // max(W, 1)),
+                 default_workers=0)
+    series_out = [entries[gi] for gi in order
+                  if entries[gi] is not None]
     if stmt.soffset:
         series_out = series_out[stmt.soffset:]
     if stmt.slimit:
@@ -3821,123 +3963,183 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
 def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
                             win_times, interval, group_tags, group_keys,
                             order) -> list:
-    """Row assembly without per-cell Python: per group, slice the output
-    grids with numpy, convert valid cells in C (`tolist`), and zip rows.
-    Semantics identical to the general loop for plain outputs with
-    fill none/null."""
+    """Row assembly without per-cell Python, sharded over the finalize
+    pool: grid-level numpy passes compute per-column value/validity
+    grids (fill null/value/previous resolve as vectorized grid
+    transforms), then rows build in C — the dense chunk builder
+    (native build_rows, W time objects INCREF-shared per chunk) for
+    fully-padded spans, the per-group builder (native
+    build_group_rows) for sparse/sliced groups — with object-ndarray
+    `tolist` fallbacks kept bit-identical when the extension is
+    unavailable. Semantics identical to the general loop for plain
+    outputs with fill none/null/value/previous."""
     n_out = len(out_specs)
     cols_hdr = ["time"] + [n for n, _k, _p in out_specs]
     W = len(win_times)
-    series_out = []
-    fill_null = stmt.fill_option == "null" and interval
-    # grid-level precompute: ONE numpy pass + ONE C-level tolist per
-    # output instead of per-group slicing (256+ groups × small-array
-    # numpy overhead dominated large results)
-    times_all = win_times.tolist()
-    ok_grids = []
-    val_grids = []
-    for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
-        okg = pres & anyc & np.isfinite(grid)
-        ok_grids.append(okg)
-        if kinds[oi] == "int" and grid.dtype != np.int64:
-            with np.errstate(invalid="ignore"):
-                vg = np.where(okg, grid, 0.0).astype(np.int64)
-        else:
-            vg = grid
-        val_grids.append(vg)
+    G = anyc.shape[0]
+    fill = stmt.fill_option if interval else "none"
+    pad = fill in ("null", "value", "previous")
     any_rows = anyc.any(axis=1)
-    all_ok = [okg.all(axis=1) for okg in ok_grids]
-    # full-grid fast path (every group has rows, and either every cell
-    # is present — the TSBS dashboard shape — or fill(null) pads the
-    # holes with None): ONE C-level build of all G*W rows, then
-    # per-group list slicing. Native row builder when available
-    # (4s → ~1.3s at 11.5M cells); object-ndarray otherwise.
-    dense_all = all(bool(a.all()) for a in all_ok)
-    if (not stmt.order_desc and not stmt.offset and not stmt.limit
-            and bool(any_rows.all()) and (dense_all or fill_null)):
-        G = anyc.shape[0]
-        rows_all = None
-        from .. import native as _native
-        cols_flat = [np.ascontiguousarray(vg.reshape(-1))
-                     for vg in val_grids]
-        masks = [None if bool(all_ok[oi].all())
-                 else ok_grids[oi].reshape(-1)
-                 for oi in range(n_out)]
-        _gc_pause()            # 23M container allocs; no cycles made
-        try:
+    times_all = win_times.tolist()
+    slicing = bool(stmt.order_desc or stmt.offset or stmt.limit)
+    entries: list = [None] * G
+    from .. import native as _native
+
+    def _prep_chunk(lo: int, hi: int):
+        """Per-chunk value/validity grids (ONE numpy pass per output
+        over the chunk's rows — fill null/value/previous resolve here
+        as vectorized row-independent transforms). Running inside the
+        chunk keeps the heavy numpy on the worker pool."""
+        anyc_c = anyc[lo:hi]
+        ok_grids = []
+        val_grids = []
+        for oi, (_n2, _k, (grid, pres)) in enumerate(out_specs):
+            gc = grid[lo:hi]
+            okg = pres[lo:hi] & anyc_c & np.isfinite(gc)
+            if kinds[oi] == "int" and gc.dtype != np.int64:
+                with np.errstate(invalid="ignore"):
+                    vg = np.where(okg, gc, 0.0).astype(np.int64)
+            else:
+                vg = gc
+            if fill == "value":
+                # empty windows emit cast(fill_value) in every column;
+                # present-but-invalid cells stay None (general-loop
+                # rule)
+                if vg.dtype == np.int64:
+                    vg = np.where(okg | anyc_c, vg,
+                                  np.int64(int(stmt.fill_value)))
+                else:
+                    vg = np.where(okg | anyc_c, vg,
+                                  np.float64(float(stmt.fill_value)))
+                okg = okg | ~anyc_c
+            elif fill == "previous":
+                # forward-fill from the last VALID cell of this
+                # output; empty windows before the first valid cell
+                # stay None
+                idxp = np.maximum.accumulate(
+                    np.where(okg, np.arange(W)[None, :], -1), axis=1)
+                hasp = idxp >= 0
+                fvg = np.take_along_axis(vg, np.maximum(idxp, 0),
+                                         axis=1)
+                vg = np.where(okg, vg, fvg)
+                okg = okg | (~anyc_c & hasp)
+            ok_grids.append(np.ascontiguousarray(okg))
+            val_grids.append(np.ascontiguousarray(vg))
+        all_ok = [okg.all(axis=1) for okg in ok_grids]
+        return ok_grids, val_grids, all_ok
+
+    def _build_chunk(lo: int, hi: int) -> None:
+        Gc = hi - lo
+        ok_grids, val_grids, all_ok = _prep_chunk(lo, hi)
+        # dense sub-path: every group in [lo, hi) emits a row at every
+        # window → ONE builder call for the whole chunk (the TSBS
+        # dashboard shape; 4s → ~1.3s at 11.5M cells via the native
+        # builder, and chunks build concurrently on the pool)
+        if (not slicing and bool(any_rows[lo:hi].all())
+                and (pad or bool(anyc[lo:hi].all()))):
+            cols_flat = [vg.reshape(-1) for vg in val_grids]
+            masks = [None if bool(all_ok[oi].all())
+                     else ok_grids[oi].reshape(-1)
+                     for oi in range(n_out)]
             rows_all = _native.build_rows(win_times, cols_flat, masks,
-                                          G, W)
+                                          Gc, W)
             if rows_all is None:
-                arr = np.empty((G * W, 1 + n_out), dtype=object)
-                arr[:, 0] = times_all * G
+                arr = np.empty((Gc * W, 1 + n_out), dtype=object)
+                arr[:, 0] = times_all * Gc
                 for oi in range(n_out):
                     flat = cols_flat[oi].tolist()
                     if masks[oi] is not None:
-                        mk = masks[oi]
-                        flat = [v if ok else None
-                                for v, ok in zip(flat, mk.tolist())]
+                        flat = [v if ok else None for v, ok in
+                                zip(flat, masks[oi].tolist())]
                     arr[:, 1 + oi] = flat
                 rows_all = arr.tolist()
-        finally:
-            _gc_resume()
-        for gi in order:
-            entry = {"name": mst, "columns": cols_hdr,
-                     "values": rows_all[gi * W:(gi + 1) * W]}
+            for gi in range(lo, hi):
+                entry = {"name": mst, "columns": cols_hdr,
+                         "values": rows_all[(gi - lo) * W:
+                                            (gi - lo + 1) * W]}
+                if group_tags:
+                    entry["tags"] = dict(zip(group_tags,
+                                             group_keys[gi]))
+                entries[gi] = entry
+            return
+        for gi in range(lo, hi):
+            # a group with NO data never materializes (influx emits
+            # groups from the data, not the index — fill only pads
+            # windows of groups that have at least one point)
+            if not any_rows[gi]:
+                continue
+            li = gi - lo
+            keep = None if pad else anyc[gi]
+            masks = [None if bool(all_ok[oi][li]) else ok_grids[oi][li]
+                     for oi in range(n_out)]
+            rows = _native.build_group_rows(
+                win_times, [vg[li] for vg in val_grids], masks, keep,
+                bool(stmt.order_desc), stmt.offset or 0,
+                stmt.limit or 0)
+            if rows is None:
+                rows = _py_group_rows(stmt, times_all, val_grids,
+                                      ok_grids, all_ok, li, keep,
+                                      n_out)
+            if not rows:
+                continue
+            entry = {"name": mst, "columns": cols_hdr, "values": rows}
             if group_tags:
                 entry["tags"] = dict(zip(group_tags, group_keys[gi]))
-            series_out.append(entry)
-        return series_out
-    val_lists = [vg.tolist() for vg in val_grids]
-    for gi in order:
-        # a group with NO data at all never materializes (influx emits
-        # groups from the data, not the index — fill only pads windows
-        # of groups that have at least one point; a tag value whose
-        # rows were all deleted must vanish from results)
-        if not any_rows[gi]:
-            continue
-        keep = None if fill_null else anyc[gi]
-        full = fill_null or bool(keep.all())
-        keep_idx = None if full else np.nonzero(keep)[0].tolist()
-        times_kept = times_all if full else \
-            [times_all[i] for i in keep_idx]
-        out_cols = []
-        for oi in range(n_out):
-            col = val_lists[oi][gi]
-            ok_row = ok_grids[oi][gi]
-            if not full:
-                col = [col[i] for i in keep_idx]
-            if (all_ok[oi][gi] if full else bool(ok_row[keep].all())):
-                out_cols.append(col)
-                continue
-            col = list(col)
-            bad = np.nonzero(~(ok_row if full else ok_row[keep]))[0]
-            for i in bad.tolist():
-                col[i] = None
+            entries[gi] = entry
+
+    _gc_pause()            # millions of container allocs; no cycles
+    try:
+        # default serial: the C row builders hold the GIL (PyObject
+        # creation), so threads only add handoff convoy here — the
+        # chunk structure still bounds peak memory and honors the
+        # OG_FINALIZE_WORKERS override (see finalize_workers)
+        _run_chunked(_build_chunk, G, max(1, (1 << 18) // max(W, 1)),
+                     default_workers=0)
+    finally:
+        _gc_resume()
+    return [entries[gi] for gi in order if entries[gi] is not None]
+
+
+def _py_group_rows(stmt, times_all, val_grids, ok_grids, all_ok, gi,
+                   keep, n_out) -> list:
+    """Python fallback of native.build_group_rows — bit-identical row
+    lists (the parity suite pins the two together)."""
+    full = keep is None or bool(keep.all())
+    keep_idx = None if full else np.nonzero(keep)[0].tolist()
+    times_kept = times_all if full else \
+        [times_all[i] for i in keep_idx]
+    out_cols = []
+    for oi in range(n_out):
+        col = val_grids[oi][gi].tolist()
+        ok_row = ok_grids[oi][gi]
+        if not full:
+            col = [col[i] for i in keep_idx]
+        if (bool(all_ok[oi][gi]) if full
+                else bool(ok_row[keep].all())):
             out_cols.append(col)
-        # row assembly via an object ndarray: .tolist() builds the
-        # nested lists in C
-        n_rows_out = len(times_kept)
-        if n_rows_out > 512:
-            arr = np.empty((n_rows_out, 1 + n_out), dtype=object)
-            arr[:, 0] = times_kept
-            for oi, col in enumerate(out_cols):
-                arr[:, 1 + oi] = col
-            rows = arr.tolist()
-        else:
-            rows = [list(r) for r in zip(times_kept, *out_cols)]
-        if stmt.order_desc:
-            rows.reverse()
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit:
-            rows = rows[:stmt.limit]
-        if not rows:
             continue
-        entry = {"name": mst, "columns": cols_hdr, "values": rows}
-        if group_tags:
-            entry["tags"] = dict(zip(group_tags, group_keys[gi]))
-        series_out.append(entry)
-    return series_out
+        bad = np.nonzero(~(ok_row if full else ok_row[keep]))[0]
+        for i in bad.tolist():
+            col[i] = None
+        out_cols.append(col)
+    # row assembly via an object ndarray: .tolist() builds the nested
+    # lists in C
+    n_rows_out = len(times_kept)
+    if n_rows_out > 512:
+        arr = np.empty((n_rows_out, 1 + n_out), dtype=object)
+        arr[:, 0] = times_kept
+        for oi, col in enumerate(out_cols):
+            arr[:, 1 + oi] = col
+        rows = arr.tolist()
+    else:
+        rows = [list(r) for r in zip(times_kept, *out_cols)]
+    if stmt.order_desc:
+        rows.reverse()
+    if stmt.offset:
+        rows = rows[stmt.offset:]
+    if stmt.limit:
+        rows = rows[:stmt.limit]
+    return rows
 
 
 def _selector_point_times(cs, aggs, fields, merged,

@@ -545,10 +545,14 @@ class Shard:
                         # encode + metas, no per-series Python
                         w.write_series_bulk(*bulk)
                     else:
-                        for sid in mt.sids():
-                            rec = mt.series_record(sid)
-                            if rec is not None:
-                                w.write_series(sid, rec)
+                        # encode-parallel flush: block encoders run on
+                        # the OG_ENCODE_WORKERS pool, appends stay
+                        # ordered on this thread (bytes identical to
+                        # the serial loop)
+                        w.write_series_stream(
+                            (sid, rec) for sid in mt.sids()
+                            for rec in (mt.series_record(sid),)
+                            if rec is not None)
                     w.finalize()
                     new_files.append((mst, fn))
                 for mst, fn in new_files:

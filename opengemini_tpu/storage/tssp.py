@@ -41,6 +41,27 @@ from ..utils import failpoint
 from .. import native as _native
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
+
+
+def encode_workers() -> int:
+    """Worker count for the flush encode pool (OG_ENCODE_WORKERS;
+    0/1 = serial, the default). The pool keeps file bytes identical
+    (encode stage is pure; appends stay ordered on the caller's
+    thread), but measured on the TSBS flush shape the GIL handoff
+    storm around the many small numpy ops made 2-8 threads 2-4×
+    SLOWER than serial — the serial path is already dominated by
+    GIL-releasing native codecs (gorilla, LZ4, og_limb_sums). The
+    knob exists for compression-heavy deployments (real zstandard at
+    high levels, string-block-heavy schemas) where the C share is
+    large enough to pay; measure before enabling."""
+    raw = os.environ.get("OG_ENCODE_WORKERS", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = -1
+    if n >= 0:
+        return n
+    return 0
 VERSION = 2                  # v2: PreAgg carries reproducible-sum limbs
 SEGMENT_SIZE = 4096          # rows per column segment == device block rows
 META_GROUP_SERIES = 256      # series per meta-index group
@@ -249,15 +270,28 @@ def _compute_preagg(col: ColVal, times: np.ndarray, lo: int,
         # reproducible-sum limb state (v2): exact unless the segment's
         # dynamic range exceeds the 108-bit limb span
         from ..ops import exactsum
-        vf = vm.astype(np.float64, copy=False)
+        vf = np.ascontiguousarray(vm, dtype=np.float64)
         mx = float(np.max(np.abs(vf)))
         if np.isfinite(mx):
             E = exactsum.pick_scale(mx)
-            limbs, res = exactsum.decompose(vf, E)
-            pa.limbs = tuple(int(x) for x in
-                             limbs.sum(axis=0, dtype=np.float64))
-            pa.scale = E
-            pa.exact = bool(np.all(res == 0.0))
+            # fused native pass (og_limb_sums — GIL-releasing, one
+            # walk) when built; limb sums are exact integers, so the
+            # span-order accumulation equals numpy's pairwise sum
+            ns = _native.limb_sums(
+                vf, np.zeros(1, dtype=np.int64),
+                np.array([len(vf)], dtype=np.int64),
+                np.array([E], dtype=np.int64),
+                exactsum.K_LIMBS, exactsum.LIMB_BITS)
+            if ns is not None:
+                pa.limbs = tuple(int(x) for x in ns[0][0])
+                pa.scale = E
+                pa.exact = bool(ns[1][0])
+            else:
+                limbs, res = exactsum.decompose(vf, E)
+                pa.limbs = tuple(int(x) for x in
+                                 limbs.sum(axis=0, dtype=np.float64))
+                pa.scale = E
+                pa.exact = bool(np.all(res == 0.0))
     return pa
 
 
@@ -283,28 +317,28 @@ class TSSPWriter:
         return off, len(b)
 
     def write_series(self, sid: int, rec: Record) -> None:
-        if sid <= self._last_sid:
-            raise ValueError("series ids must be written in ascending order")
-        self._last_sid = sid
+        self._append_encoded(sid, self._encode_series(rec))
+
+    def _encode_series(self, rec: Record):
+        """Pure encode stage of write_series: record → per-column
+        segment payloads + pre-agg, NO writer state touched — safe to
+        run on the encode worker pool (the native gorilla/LZ4/zstd
+        codecs release the GIL inside their C calls)."""
         rec = rec.sort_by_time()
         times = rec.times
         n = rec.num_rows
         if n == 0:
-            return
-        cm = ChunkMeta(sid, int(times[0]), int(times[-1]), n, regular=True)
-        self._min_time = (int(times[0]) if self._min_time is None
-                          else min(self._min_time, int(times[0])))
-        self._max_time = (int(times[-1]) if self._max_time is None
-                          else max(self._max_time, int(times[-1])))
+            return None
         ss = self.segment_size
+        cols_enc = []
         for f, col in zip(rec.schema, rec.cols):
-            colmeta = ColumnMeta(f.name, f.type)
+            segs = []
             for lo in range(0, n, ss):
                 hi = min(lo + ss, n)
+                time_regular = True
                 if f.type == DataType.TIME:
                     data = enc.encode_time_block(col.values[lo:hi])
-                    if data[0] != enc.CONST_DELTA:
-                        cm.regular = False
+                    time_regular = data[0] == enc.CONST_DELTA
                 elif f.type == DataType.INTEGER:
                     data = enc.encode_integer_block(col.values[lo:hi])
                 elif f.type == DataType.FLOAT:
@@ -313,15 +347,83 @@ class TSSPWriter:
                     data = enc.encode_boolean_block(col.values[lo:hi])
                 else:
                     sub = col.slice(lo, hi)
-                    data = enc.encode_string_block(sub.offsets, sub.data)
+                    data = enc.encode_string_block(sub.offsets,
+                                                   sub.data)
+                segs.append((data,
+                             enc.encode_validity(col.valid[lo:hi]),
+                             hi - lo,
+                             _compute_preagg(col, times, lo, hi),
+                             time_regular))
+            cols_enc.append((f.name, f.type, segs))
+        return (int(times[0]), int(times[-1]), n, cols_enc)
+
+    def _append_encoded(self, sid: int, encoded) -> None:
+        """Ordered append stage of write_series (file offsets + chunk
+        meta) — runs on the writer's thread only."""
+        if sid <= self._last_sid:
+            raise ValueError("series ids must be written in ascending order")
+        self._last_sid = sid
+        if encoded is None:
+            return
+        t0, t1, n, cols_enc = encoded
+        cm = ChunkMeta(sid, t0, t1, n, regular=True)
+        self._min_time = (t0 if self._min_time is None
+                          else min(self._min_time, t0))
+        self._max_time = (t1 if self._max_time is None
+                          else max(self._max_time, t1))
+        for name, ftype, segs in cols_enc:
+            colmeta = ColumnMeta(name, ftype)
+            for data, vdata, rows, preagg, time_regular in segs:
+                if not time_regular:
+                    cm.regular = False
                 off, size = self._append(data)
-                voff, vsize = self._append(
-                    enc.encode_validity(col.valid[lo:hi]))
-                seg = Segment(off, size, hi - lo, voff, vsize,
-                              _compute_preagg(col, times, lo, hi))
-                colmeta.segments.append(seg)
+                voff, vsize = self._append(vdata)
+                colmeta.segments.append(
+                    Segment(off, size, rows, voff, vsize, preagg))
             cm.columns.append(colmeta)
         self._metas.append(("one", sid, _pack_chunk_meta(cm)))
+
+    def write_series_stream(self, pairs) -> None:
+        """Encode-parallel write of many (sid, Record) pairs (ascending
+        sids): OG_ENCODE_WORKERS threads run the pure encode stage
+        while THIS thread appends results strictly in submission order
+        — the file bytes are identical to serial write_series calls.
+        The in-flight window is bounded (4 per worker) so a 69M-row
+        flush never holds more than a few dozen encoded series in
+        memory. The flush path uses this for the bench's 16k-series
+        ingest; 0/1 workers = the serial loop."""
+        w = encode_workers()
+        if w <= 1:
+            for sid, rec in pairs:
+                self.write_series(sid, rec)
+            return
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        def encode_batch(batch):
+            return [(sid, self._encode_series(rec))
+                    for sid, rec in batch]
+
+        pending: deque = deque()
+        batch: list = []
+
+        def drain_one():
+            for psid, encoded in pending.popleft().result():
+                self._append_encoded(psid, encoded)
+
+        with ThreadPoolExecutor(max_workers=w,
+                                thread_name_prefix="og-encode") as pool:
+            for pair in pairs:
+                batch.append(pair)
+                if len(batch) >= 32:   # amortize future overhead
+                    pending.append(pool.submit(encode_batch, batch))
+                    batch = []
+                    if len(pending) >= 2 * w:
+                        drain_one()
+            if batch:
+                pending.append(pool.submit(encode_batch, batch))
+            while pending:
+                drain_one()
 
     def write_series_raw(self, sid: int, holders: list) -> bool:
         """STREAM-COMPACTION path (role of the reference's

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # CPU perf smoke: the streaming device pipeline and the single-barrier
 # fallback must agree on EVERY result cell, across both lattice fold
-# routes (device / host), on every bench query shape. Runs a scaled-
-# down bench dataset on the CPU backend with per-phase output — CI-safe
-# (no accelerator needed, a few minutes of wall).
+# routes (device / host), on every bench query shape — and (PR 3) the
+# parallel finalize pool (OG_FINALIZE_WORKERS=8) must agree with the
+# serial path (=0) on every cell of every shape incl. the 1m one,
+# while the streaming JSON serializer must emit bytes identical to
+# json.dumps. Runs a scaled-down bench dataset on the CPU backend with
+# per-phase output — CI-safe (no accelerator needed, minutes of wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]

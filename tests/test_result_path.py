@@ -1,0 +1,423 @@
+"""High-cardinality result-path suite (PR 3).
+
+Covers the parallel/vectorized finalize + native columnar row assembly
++ streaming serialization tentpole and its satellites:
+
+  * parity: native row builders ≡ numpy fallback ≡ the general
+    per-group loop across fill modes, int64 fields, desc/limit/offset/
+    slimit and multirow selectors;
+  * finalize-pool determinism: OG_FINALIZE_WORKERS=0 ≡ =N bit for bit;
+  * chunked-serializer golden: streaming JSON/CSV emit is
+    byte-identical to the buffered json.dumps / results_to_csv;
+  * vectorized OGSketch batch percentile ≡ the scalar object path;
+  * vectorized finalize_raw_agg ≡ the scalar per-cell reference;
+  * merge_partials fb_omitted substitution (ADVICE r5 medium);
+  * window-absent tag-key classification (ADVICE r5);
+  * alias'd wildcard call expansion naming (ADVICE r5);
+  * flush encode pool byte-identity (OG_ENCODE_WORKERS).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+
+NS = 10**9
+
+
+@pytest.fixture()
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "data"),
+                 EngineOptions(shard_duration=1 << 62))
+    eng.create_database("db")
+    rng = np.random.default_rng(11)
+    for h in range(6):
+        n = int(rng.integers(8, 60))
+        t = np.sort(rng.choice(np.arange(0, 600, 2), size=n,
+                               replace=False)).astype(np.int64) * NS
+        eng.write_record(
+            "db", "m", {"host": f"h{h}", "dc": "a" if h % 2 else "b"},
+            t, {"fv": np.round(rng.normal(10, 5, n), 2),
+                "iv": rng.integers(-50, 50, n)})
+    for s in eng.database("db").all_shards():
+        s.flush()
+    yield eng
+    eng.close()
+
+
+def _run(eng, q):
+    (stmt,) = parse_query(q)
+    res = QueryExecutor(eng).execute(stmt, "db")
+    assert "error" not in res, (q, res)
+    return repr(res)
+
+
+PARITY_QUERIES = [
+    f"SELECT {sel} FROM m WHERE time >= 0 AND time < 600s "
+    f"GROUP BY time(37s), host {fill} {mod}"
+    for sel in ("mean(fv)", "sum(iv)", "count(fv), max(iv), min(fv)",
+                "first(fv), last(iv)")
+    for fill in ("fill(none)", "fill(null)", "fill(7)",
+                 "fill(previous)", "fill(linear)")
+    for mod in ("", "ORDER BY time DESC", "LIMIT 5",
+                "LIMIT 4 OFFSET 2", "SLIMIT 2 SOFFSET 1")
+] + [
+    "SELECT mean(fv) FROM m GROUP BY time(1m), *",
+    "SELECT percentile(fv, 90) FROM m GROUP BY time(50s), host "
+    "fill(null)",
+    "SELECT median(iv), mode(fv) FROM m GROUP BY time(80s), host",
+    "SELECT percentile_approx(fv, 95) FROM m GROUP BY time(60s), host",
+    "SELECT top(fv, 3) FROM m GROUP BY time(100s), host",
+    "SELECT distinct(iv) FROM m GROUP BY time(200s)",
+    "SELECT sample(fv, 2) FROM m GROUP BY time(150s), host",
+    "SELECT max(fv) FROM m",
+    "SELECT count(fv) FROM m GROUP BY host ORDER BY time DESC",
+]
+
+
+def test_native_vs_python_rows_parity(db, monkeypatch):
+    """Native row builders and the numpy/python fallbacks must emit
+    identical results across every covered shape."""
+    import opengemini_tpu.native as N
+    base = [_run(db, q) for q in PARITY_QUERIES]
+    monkeypatch.setattr(N, "build_rows", lambda *a, **k: None)
+    monkeypatch.setattr(N, "build_group_rows", lambda *a, **k: None)
+    fb = [_run(db, q) for q in PARITY_QUERIES]
+    assert base == fb
+
+
+def test_finalize_pool_determinism(db, monkeypatch):
+    """OG_FINALIZE_WORKERS=0 (serial) vs =6 must be bit-identical."""
+    monkeypatch.setenv("OG_FINALIZE_WORKERS", "0")
+    ser = [_run(db, q) for q in PARITY_QUERIES]
+    monkeypatch.setenv("OG_FINALIZE_WORKERS", "6")
+    par = [_run(db, q) for q in PARITY_QUERIES]
+    assert ser == par
+
+
+def test_fast_path_vs_general_loop(db, monkeypatch):
+    """The widened vectorized fast path (fill value/previous included)
+    must match the general per-group loop (vector hint off)."""
+    import opengemini_tpu.query.logical as L
+    qs = [q for q in PARITY_QUERIES if "fill(linear)" not in q]
+    fast = [_run(db, q) for q in qs]
+    orig = L.plan_hints
+
+    def no_vector(stmt, **kw):
+        h = dict(orig(stmt, **kw))
+        h["vector"] = False
+        return h
+
+    monkeypatch.setattr(L, "plan_hints", no_vector)
+    slow = [_run(db, q) for q in qs]
+    assert fast == slow
+
+
+# ------------------------------------------------------------ serializer
+
+SER_PAYLOADS = [
+    {"results": []},
+    {"results": [{"statement_id": 0}]},
+    {"results": [{"statement_id": 0, "error": 'boom, "q"'}]},
+    {"results": [
+        {"statement_id": 0, "series": [
+            {"name": "cpu", "tags": {"h": "a,b"},
+             "columns": ["time", "v"],
+             "values": [[1, 1.5], [2, None], [3, -7]]},
+            {"name": "cpü", "columns": ["time", "iv"],
+             "values": [[1, 2**60]]}],
+         "partial": True},
+        {"statement_id": 1, "series": []}]},
+]
+
+
+def test_serializer_json_golden():
+    from opengemini_tpu.http.serializer import (iter_results_json,
+                                                stream_chunks)
+    for p in SER_PAYLOADS:
+        want = json.dumps(p).encode() + b"\n"
+        assert b"".join(iter_results_json(p)) == want
+        assert b"".join(stream_chunks(iter_results_json(p))) == want
+
+
+def test_serializer_csv_golden():
+    from opengemini_tpu.http.formats import results_to_csv
+    from opengemini_tpu.http.serializer import iter_results_csv
+    for p in SER_PAYLOADS:
+        assert b"".join(iter_results_csv(p)) == \
+            results_to_csv(p).encode()
+
+
+def test_serializer_lazy_series_overlap():
+    """A lazy series iterable streams without materializing, and the
+    bytes match the eager document."""
+    from opengemini_tpu.http.serializer import (iter_results_json,
+                                                stream_chunks)
+    entries = [{"name": "m", "columns": ["time", "v"],
+                "values": [[i, float(i)]]} for i in range(50)]
+    eager = {"results": [{"statement_id": 0, "series": entries}]}
+    lazy = {"results": [{"statement_id": 0,
+                         "series": iter(list(entries))}]}
+    assert b"".join(stream_chunks(iter_results_json(lazy))) == \
+        json.dumps(eager).encode() + b"\n"
+
+
+def test_stream_chunks_abandonment_stops_producer():
+    """Dropping the generator mid-stream (client disconnect) must not
+    leave the producer thread blocked on the bounded queue."""
+    import threading
+    import time
+    from opengemini_tpu.http.serializer import stream_chunks
+
+    def pieces():
+        for _ in range(1000):
+            yield b"x" * 1024
+
+    g = stream_chunks(pieces(), depth=2)
+    next(g)
+    g.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not any(t.name == "og-serialize"
+                   for t in threading.enumerate()):
+            return
+        time.sleep(0.05)
+    raise AssertionError("producer thread leaked after abandonment")
+
+
+def test_stream_chunks_propagates_errors():
+    from opengemini_tpu.http.serializer import stream_chunks
+
+    def boom():
+        yield b"x"
+        raise RuntimeError("encoder died")
+
+    with pytest.raises(RuntimeError, match="encoder died"):
+        list(stream_chunks(boom()))
+
+
+def test_http_streams_query_response(db):
+    """End-to-end: the HTTP layer streams a result-bearing /query and
+    the JSON body equals the buffered route's."""
+    import urllib.parse
+    import urllib.request
+    from opengemini_tpu.http.server import HttpServer
+    srv = HttpServer(db, port=0)
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/query?db=db&q="
+               + urllib.parse.quote(
+                   "SELECT mean(fv) FROM m GROUP BY time(1m), host"))
+        body = urllib.request.urlopen(url, timeout=60).read()
+        os.environ["OG_STREAM_JSON"] = "0"
+        try:
+            body2 = urllib.request.urlopen(url, timeout=60).read()
+        finally:
+            os.environ.pop("OG_STREAM_JSON", None)
+        assert body == body2
+        assert json.loads(body)["results"][0]["series"]
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------- vectorized kernels
+
+def test_batch_percentile_matches_scalar():
+    from opengemini_tpu.ops.ogsketch import OGSketch, batch_percentile
+    rng = np.random.default_rng(0)
+    states = [None]
+    for i in range(60):
+        s = OGSketch.of(rng.normal(0, 10, int(rng.integers(1, 800))),
+                        float(rng.choice([5, 50, 100])))
+        states.append(s.to_state())
+    for q in (0.0, 0.01, 0.5, 0.95, 1.0):
+        ref = np.array([np.nan if st is None
+                        else OGSketch.from_state(st).percentile(q)
+                        for st in states])
+        got = batch_percentile(states, q)
+        assert ((np.isnan(ref) & np.isnan(got)) | (ref == got)).all()
+
+
+def test_finalize_raw_agg_matches_scalar():
+    from opengemini_tpu.query.functions import (AggItem,
+                                                finalize_raw_agg,
+                                                finalize_raw_agg_cell)
+    rng = np.random.default_rng(1)
+    G, W = 7, 5
+    vals = [[None] * W for _ in range(G)]
+    times = [[None] * W for _ in range(G)]
+    for gi in range(G):
+        for wi in range(W):
+            if rng.random() < 0.3:
+                continue
+            n = int(rng.integers(1, 30))
+            vals[gi][wi] = rng.integers(0, 6, n).astype(float)
+            times[gi][wi] = np.sort(rng.integers(0, 10**9, n))
+    raw = {"vals": vals, "times": times}
+    for func, arg in (("percentile", 37.5), ("median", None),
+                      ("mode", None), ("count_distinct", None),
+                      ("integral", 1e9)):
+        item = AggItem(func, "f", func, arg)
+        got = finalize_raw_agg(item, raw, G, W)
+        for gi in range(G):
+            for wi in range(W):
+                v = vals[gi][wi]
+                if v is None:
+                    assert np.isnan(got[gi, wi])
+                    continue
+                ref = finalize_raw_agg_cell(item, v, times[gi][wi])
+                assert got[gi, wi] == ref, (func, gi, wi)
+
+
+# ------------------------------------------------- fb_omitted merge fix
+
+def test_merge_substitutes_limb_sums_for_fb_omitted():
+    """A partial whose f64 fallback sum omitted its block
+    contributions (fb_omitted) must contribute its LIMB-derived sum to
+    the merged fallback grid — a cell another store flags inexact
+    would otherwise read a sum missing whole files (ADVICE r5)."""
+    from opengemini_tpu.ops import exactsum
+    from opengemini_tpu.query.executor import merge_partials
+
+    def mk_partial(vals, inexact, omit):
+        G, W = 1, 2
+        E = exactsum.pick_scale(float(np.max(np.abs(vals))))
+        limbs, _res = exactsum.decompose(np.asarray(vals, float), E)
+        lg = limbs.sum(axis=0)[None, None, :].repeat(W, axis=1)
+        p = {"group_tags": ["h"], "group_keys": [["a"]],
+             "interval": 1000, "start": 0, "W": W,
+             "fields": {"v": {
+                 "count": np.full((G, W), len(vals), dtype=np.int64),
+                 # the f64 fallback grid DELIBERATELY omits the block
+                 # contribution when omit=True (models fb_needed skip)
+                 "sum": np.zeros((G, W)) if omit
+                 else np.full((G, W), float(np.sum(vals))),
+                 "sum_limbs": lg,
+                 "sum_inexact": np.full((G, W), inexact, dtype=bool)}},
+             "field_types": {"v": "float"},
+             "sum_scales": {"v": E}}
+        if omit:
+            p["fb_omitted"] = ["v"]
+        return p
+
+    a = mk_partial([1.5, 2.25], inexact=False, omit=True)
+    b = mk_partial([4.0], inexact=True, omit=False)
+    merged = merge_partials([a, b])
+    st = merged["fields"]["v"]
+    # merged fallback sum must include A's (limb-derived) 3.75, not 0
+    exp_a = exactsum.finalize_exact(
+        a["fields"]["v"]["sum_limbs"], a["sum_scales"]["v"])
+    assert np.allclose(st["sum"], exp_a + 4.0)
+    assert st["sum_inexact"].all()
+
+    # control: without the flag the omitted grid silently under-counts
+    a2 = mk_partial([1.5, 2.25], inexact=False, omit=True)
+    del a2["fb_omitted"]
+    st2 = merge_partials([a2, mk_partial([4.0], True, False)])[
+        "fields"]["v"]
+    assert np.allclose(st2["sum"], 4.0)
+
+
+# -------------------------------------------- tag classification / alias
+
+def test_window_absent_tag_still_classifies_as_tag(tmp_path):
+    eng = Engine(str(tmp_path / "d"),
+                 EngineOptions(shard_duration=100 * NS))
+    eng.create_database("db")
+    eng.write_record("db", "m", {"host": "a", "dc": "east"},
+                     np.array([5 * NS]), {"v": np.array([1.0])})
+    eng.write_record("db", "m", {"host": "a"},
+                     np.array([150 * NS]), {"v": np.array([2.0])})
+    for s in eng.database("db").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    # dc absent from the queried window: missing tag compares as ''
+    # → != 'x' matches (influx), = 'east' does not
+    (stmt,) = parse_query("SELECT v FROM m WHERE time >= 100s AND "
+                          "time < 200s AND dc != 'x'")
+    res = ex.execute(stmt, "db")
+    assert res["series"][0]["values"] == [[150 * NS, 2.0]]
+    (stmt,) = parse_query("SELECT v FROM m WHERE time >= 100s AND "
+                          "time < 200s AND dc = 'east'")
+    assert ex.execute(stmt, "db") == {}
+    eng.close()
+
+
+def test_field_residual_skips_dbwide_tag_walk(tmp_path, monkeypatch):
+    """The ghost-tag reclassification must NOT fire for ordinary field
+    predicates — the hot dashboard shape would otherwise open every
+    cold shard in the database on every query."""
+    eng = Engine(str(tmp_path / "d"),
+                 EngineOptions(shard_duration=100 * NS))
+    eng.create_database("db")
+    eng.write_record("db", "m", {"host": "a"},
+                     np.array([5 * NS]), {"v": np.array([1.0])})
+    eng.write_record("db", "m", {"host": "a"},
+                     np.array([150 * NS]), {"v": np.array([5.0])})
+    for s in eng.database("db").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    db_obj = eng.database("db")
+    calls = []
+    orig = db_obj.all_shards
+    monkeypatch.setattr(db_obj, "all_shards",
+                        lambda: calls.append(1) or orig())
+    (stmt,) = parse_query("SELECT v FROM m WHERE time >= 100s AND "
+                          "time < 200s AND v > 2")
+    res = ex.execute(stmt, "db")
+    assert res["series"][0]["values"] == [[150 * NS, 5.0]]
+    assert not calls, "field residual walked the db-wide shard set"
+    eng.close()
+
+
+def test_alias_wildcard_call_expansion_names(db):
+    (stmt,) = parse_query("SELECT mean(*) AS m2 FROM m")
+    res = QueryExecutor(db).execute(stmt, "db")
+    assert res["series"][0]["columns"] == ["time", "m2_fv", "m2_iv"]
+
+
+# ------------------------------------------------------ ingest encode
+
+def test_encode_pool_byte_identity(tmp_path, monkeypatch):
+    import glob
+    import hashlib
+
+    def build(sub, workers):
+        monkeypatch.setenv("OG_ENCODE_WORKERS", str(workers))
+        eng = Engine(str(tmp_path / sub),
+                     EngineOptions(shard_duration=1 << 62))
+        eng.create_database("db")
+        rng = np.random.default_rng(2)
+        t = np.arange(300, dtype=np.int64) * NS
+        for h in range(40):
+            eng.write_record(
+                "db", "m", {"h": f"h{h}"}, t,
+                {"fv": np.round(rng.normal(0, 9, 300), 3),
+                 "iv": rng.integers(0, 99, 300)})
+        for s in eng.database("db").all_shards():
+            s.flush()
+        eng.close()
+        dig = hashlib.sha256()
+        for fn in sorted(glob.glob(str(tmp_path / sub) +
+                                   "/**/*.tssp", recursive=True)):
+            dig.update(open(fn, "rb").read())
+        return dig.hexdigest()
+
+    assert build("w0", 0) == build("w6", 6)
+
+
+def test_zstd_shim_lz4_roundtrip():
+    from opengemini_tpu.utils.zstd_compat import zstandard as z
+    for data in (b"", b"x", b"abc" * 5000, bytes(range(256)) * 33):
+        for lvl in (1, 3, 9):
+            c = z.ZstdCompressor(level=lvl).compress(data)
+            d = z.ZstdDecompressor().decompress(
+                c, max_output_size=max(len(data), 1))
+            assert d == data
+            if getattr(z, "__shim__", None):
+                assert z.get_frame_parameters(c).content_size == \
+                    len(data)
