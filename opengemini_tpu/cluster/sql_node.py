@@ -247,9 +247,14 @@ class ClusterExecutor:
 
     # ------------------------------------------------------------- execute
 
-    def execute(self, stmt, db: str | None = None,
+    def execute(self, stmt, db: str | None = None, ctx=None,
                 inc_query_id: str | None = None, iter_id: int = 0) -> dict:
+        # ctx (QueryContext): accepted for HTTP-layer parity with the
+        # single-node executor; scatter hops check it at the statement
+        # boundary (store-side kill propagation is the RPC's concern)
         try:
+            if ctx is not None and getattr(ctx, "killed", False):
+                return {"error": f"query {ctx.qid} killed"}
             if isinstance(stmt, SelectStatement):
                 if stmt.join is not None:
                     from ..query.join import execute_join

@@ -31,6 +31,7 @@ from .. import __version__
 from ..query import QueryExecutor, ParseError, parse_query
 from ..utils import deadline, get_logger
 from ..utils.errors import GeminiError
+from ..utils.resources import ResourceExhausted
 from ..utils.lineprotocol import PRECISION_NS
 
 log = get_logger(__name__)
@@ -99,6 +100,12 @@ class HttpServer:
             self.executor.max_failed_stores = \
                 config.data.max_failed_stores
         self.sysctrl = SysControl(engine if local else None)
+        # device query scheduler (query/scheduler.py): wire the config
+        # limits; env (OG_SCHED_SLOTS et al) overrides inside configure
+        from ..query import scheduler as _qsched
+        _qsched.get_scheduler().configure(
+            max_concurrent=self.config.data.max_concurrent_queries,
+            max_queued=self.config.data.max_queued_queries)
         self.prom = PromEngine(engine, prom_db) if local else None
         self.prom_db = prom_db
         # logstore product mode (reference logkeeper; lazy — only pays
@@ -142,6 +149,8 @@ class HttpServer:
             sp.register("device", device_collector)
             from ..ops.devstats import phase_collector
             sp.register("query_phases", phase_collector)
+            from ..utils.stats import scheduler_collector
+            sp.register("scheduler", scheduler_collector)
             sp.register("wal", wal_collector)
             sp.register("raft", raft_collector)
             sp.register("subscriber", subscriber_collector)
@@ -548,6 +557,32 @@ class HttpServer:
         self._bump("points_written", n)
         return 204, {}
 
+    def _admit_query(self, stmts, db, ctx):
+        """Shared admission for every SELECT-bearing request (/query
+        and flux): scheduler weighted-fair slot when OG_SCHED is on,
+        the legacy counting gate otherwise. Returns (ticket,
+        gate_held) — exactly one is set; raises SchedShed /
+        ResourceExhausted / GeminiError (killed or out of budget while
+        queued) for the caller to map onto its response shape."""
+        from ..query import scheduler as _qsched
+        if _qsched.enabled():
+            sch = _qsched.get_scheduler()
+            # the plan-derived estimate probes shard indexes — skip it
+            # when nothing consumes it (unlimited slots AND no cell
+            # budget: admission instant-grants either way)
+            if sch.max_concurrent > 0 or sch.max_cells > 0:
+                cost = _qsched.estimate_request_cost(self.executor,
+                                                     stmts, db)
+            else:
+                cost = _qsched.QueryCost(0)
+            if ctx is not None:
+                ctx.cost_cells = cost.cells
+            return sch.admit(ctx=ctx, cost=cost), False
+        # OG_SCHED=0 fallback: no-op unless max_concurrent_queries is
+        # configured — today's path, byte for byte
+        self.resources.queries.acquire(ctx=ctx)
+        return None, True
+
     def handle_query(self, params: dict, user=None) -> tuple[int, dict]:
         qtext = params.get("q")
         if not qtext:
@@ -577,44 +612,83 @@ class HttpServer:
         results = []
         budget = self._request_budget(params,
                                       self.config.data.query_timeout_ns)
-        # ONE budget covers the whole request (all statements): every
-        # scatter hop, RPC retry and store wait below consumes the
-        # remainder — a slow store can never stack fresh per-hop
-        # timeouts past this point (utils.deadline)
-        with deadline.bind(budget, what="query"):
-            for i, stmt in enumerate(stmts):
-                try:
-                    deny = self._deny_privilege(stmt, user) \
-                        or self._deny_db_access(stmt, user, db)
-                    if deny is not None:
-                        res = {"error": deny}
-                    elif self._is_user_stmt(stmt):
-                        # executed against the server's own user catalog
-                        # — works identically over the cluster facade
-                        # (whose executor has no user branch)
-                        res = self._exec_user_stmt(stmt)
-                    else:
-                        # one cache slot per statement of a
-                        # multi-statement query
-                        stmt_qid = f"{inc_qid}#{i}" if inc_qid else None
-                        res = self.executor.execute(stmt, db,
-                                                    inc_query_id=stmt_qid,
-                                                    iter_id=iter_id)
-                except GeminiError as e:
-                    # typed budget/engine errors (ErrQueryTimeout et al)
-                    res = {"error": str(e)}
-                except Exception as e:  # an executor bug must not kill
-                    # the connection
-                    log.exception("query execution failed: %s",
-                                  _redact_passwords(qtext))
-                    res = {"error": f"internal error: {e}"}
-                res = dict(res)
-                res["statement_id"] = i
-                if epoch and "series" in res:
-                    _convert_epoch(res["series"], epoch)
-                if "error" in res:
-                    self._bump("query_errors")
-                results.append(res)
+        from ..query import scheduler as _qsched
+        from ..query.ast import SelectStatement
+        # register at ENQUEUE time: a queued query is visible to SHOW
+        # QUERIES (status "queued") and killable before admission
+        ctx = self.query_manager.attach(qtext, db) \
+            if self.query_manager is not None else None
+        ticket = None
+        gate_held = False
+        try:
+            # ONE budget covers the whole request (all statements):
+            # admission wait, every scatter hop, RPC retry and store
+            # wait below consume the remainder — a slow store can never
+            # stack fresh per-hop timeouts past this point
+            # (utils.deadline)
+            with deadline.bind(budget, what="query"):
+                if any(isinstance(s, SelectStatement) for s in stmts):
+                    try:
+                        ticket, gate_held = self._admit_query(
+                            stmts, db, ctx)
+                    except _qsched.SchedShed as e:
+                        self._bump("query_errors")
+                        return e.http_code, {
+                            "error": str(e),
+                            "retry_after": round(e.retry_after_s, 3)}
+                    except ResourceExhausted as e:
+                        self._bump("query_errors")
+                        return 503, {"error": str(e)}
+                    except GeminiError as e:
+                        # killed or out of budget while queued: an
+                        # ordinary query error, never a dead connection
+                        self._bump("query_errors")
+                        return 200, {"results": [
+                            {"statement_id": 0, "error": str(e)}]}
+                for i, stmt in enumerate(stmts):
+                    try:
+                        deny = self._deny_privilege(stmt, user) \
+                            or self._deny_db_access(stmt, user, db)
+                        if deny is not None:
+                            res = {"error": deny}
+                        elif self._is_user_stmt(stmt):
+                            # executed against the server's own user
+                            # catalog — works identically over the
+                            # cluster facade (whose executor has no
+                            # user branch)
+                            res = self._exec_user_stmt(stmt)
+                        else:
+                            # one cache slot per statement of a
+                            # multi-statement query
+                            stmt_qid = f"{inc_qid}#{i}" if inc_qid \
+                                else None
+                            res = self.executor.execute(
+                                stmt, db, ctx=ctx,
+                                inc_query_id=stmt_qid,
+                                iter_id=iter_id)
+                    except GeminiError as e:
+                        # typed budget/engine errors (ErrQueryTimeout
+                        # et al)
+                        res = {"error": str(e)}
+                    except Exception as e:  # an executor bug must not
+                        # kill the connection
+                        log.exception("query execution failed: %s",
+                                      _redact_passwords(qtext))
+                        res = {"error": f"internal error: {e}"}
+                    res = dict(res)
+                    res["statement_id"] = i
+                    if epoch and "series" in res:
+                        _convert_epoch(res["series"], epoch)
+                    if "error" in res:
+                        self._bump("query_errors")
+                    results.append(res)
+        finally:
+            if ticket is not None:
+                ticket.release()
+            if gate_held:
+                self.resources.queries.release()
+            if ctx is not None:
+                self.query_manager.detach(ctx)
         return 200, {"results": results}
 
     def metrics_text(self) -> str:
@@ -626,6 +700,7 @@ class HttpServer:
                                    engine_collector, executor_collector,
                                    raft_collector, readcache_collector,
                                    rpc_collector, runtime_collector,
+                                   scheduler_collector,
                                    subscriber_collector, wal_collector)
         from ..ops.devstats import phase_collector
         groups = {"runtime": runtime_collector(),
@@ -634,6 +709,7 @@ class HttpServer:
                   "devicecache": devicecache_collector(),
                   "device": device_collector(),
                   "query_phases": phase_collector(),
+                  "scheduler": scheduler_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
@@ -697,13 +773,56 @@ class HttpServer:
         if deny is not None:
             self._bump("query_errors")
             return 403, {"code": "forbidden", "message": deny}, None
+        # flux selects go through the same serving runtime as /query:
+        # admission (weighted-fair slot + shed), SHOW QUERIES
+        # registration and killability — a monster must not bypass the
+        # scheduler by arriving in flux clothing
+        from ..query import scheduler as _qsched
+        ctx = self.query_manager.attach(qtext, comp.db) \
+            if self.query_manager is not None else None
+        ticket = None
+        gate_held = False
+        budget = self.config.data.query_timeout_ns / 1e9 \
+            if self.config.data.query_timeout_ns else None
         try:
-            res = self.executor.execute(comp.stmt, comp.db)
-        except Exception as e:
-            log.exception("flux execution failed")
-            self._bump("query_errors")
-            return 500, {"code": "internal error",
-                         "message": str(e)}, None
+            with deadline.bind(budget, what="query"):
+                try:
+                    ticket, gate_held = self._admit_query(
+                        [comp.stmt], comp.db, ctx)
+                except _qsched.SchedShed as e:
+                    self._bump("query_errors")
+                    return e.http_code, {
+                        "code": ("unavailable" if e.http_code == 503
+                                 else "too many requests"),
+                        "message": str(e),
+                        "retry_after": round(e.retry_after_s, 3)}, None
+                except ResourceExhausted as e:
+                    self._bump("query_errors")
+                    return 503, {"code": "unavailable",
+                                 "message": str(e)}, None
+                except GeminiError as e:
+                    self._bump("query_errors")
+                    return 400, {"code": "invalid",
+                                 "message": str(e)}, None
+                try:
+                    res = self.executor.execute(comp.stmt, comp.db,
+                                                ctx=ctx)
+                except GeminiError as e:
+                    self._bump("query_errors")
+                    return 400, {"code": "invalid",
+                                 "message": str(e)}, None
+                except Exception as e:
+                    log.exception("flux execution failed")
+                    self._bump("query_errors")
+                    return 500, {"code": "internal error",
+                                 "message": str(e)}, None
+        finally:
+            if ticket is not None:
+                ticket.release()
+            if gate_held:
+                self.resources.queries.release()
+            if ctx is not None:
+                self.query_manager.detach(ctx)
         if "error" in res:
             self._bump("query_errors")
             return 400, {"code": "invalid",
@@ -1058,6 +1177,15 @@ class _Handler(BaseHTTPRequestHandler):
         form-encoded POST body is honored too."""
         if params is None:
             params = self._params()
+        if code in (429, 503) and isinstance(payload, dict) \
+                and "retry_after" in payload:
+            # admission shed (scheduler 429 / paused 503): the body
+            # carries retry_after seconds and the header mirrors it so
+            # plain HTTP clients can back off without parsing JSON
+            self._reply(code, payload, headers={
+                "Retry-After":
+                    str(max(1, int(round(payload["retry_after"]))))})
+            return
         accept = self.headers.get("Accept", "")
         if code == 200 and params.get("chunked") == "true":
             from .formats import chunk_results
@@ -1194,11 +1322,13 @@ class _Handler(BaseHTTPRequestHandler):
             # hit/miss/eviction, and the executor phase split without
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
-            from ..utils.stats import devicecache_collector
+            from ..utils.stats import (devicecache_collector,
+                                       scheduler_collector)
             out = dict(srv.stats)
             out["device"] = device_collector()
             out["devicecache"] = devicecache_collector()
             out["query_phases"] = phase_collector()
+            out["scheduler"] = scheduler_collector()
             self._reply(200, out)
             return
         if path == "/debug/ctrl":
@@ -1318,7 +1448,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            self._reply(code, payload)
+            hdrs = None
+            if code in (429, 503) and isinstance(payload, dict) \
+                    and "retry_after" in payload:
+                # admission sheds mirror the wait hint in the header,
+                # same as /query (plain clients back off without
+                # parsing the body)
+                hdrs = {"Retry-After": str(max(1, int(round(
+                    payload["retry_after"]))))}
+            self._reply(code, payload, headers=hdrs)
             return
         if path in ("/api/v1/prom/write", "/api/v1/prom/read"):
             try:

@@ -202,6 +202,20 @@ def get_decoded_planes(fp: str, field: str, E):
     return (base[0], base[1], lb)
 
 
+# base-plane fill serialization: the scheduler single-flights fills
+# per (fp, field, E), but two DIFFERENT scales share the value/valid
+# base entry — without a per-(fp, field) lock both leaders would
+# device_put the base planes and one upload (plus its HBM) is wasted.
+# STRIPED locks (fixed pool, key-hashed): no eviction means no
+# evicted-while-handed-out race; a stripe collision merely serializes
+# two unrelated fills, which is harmless
+_BASE_FILL_LOCKS = [threading.Lock() for _ in range(64)]
+
+
+def _base_fill_lock(fp: str, field: str) -> threading.Lock:
+    return _BASE_FILL_LOCKS[hash((fp, field)) % len(_BASE_FILL_LOCKS)]
+
+
 def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
     """Stake one dense group's decoded (S, P) planes (and the (S, P, K)
     limb planes when the query needs exact sums) into HBM, keyed by the
@@ -213,17 +227,18 @@ def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
 
     from . import devstats
     cache = global_cache() if enabled() else None
-    base = cache.get(_vals_key(fp, field)) if cache is not None \
-        else None
     nb = 0
-    if base is None:
-        dv = jax.device_put(vals)
-        dm = jax.device_put(valid)
-        nb += int(dv.nbytes + dm.nbytes)
-        base = (dv, dm)
-        if cache is not None:
-            cache.put_sized(_vals_key(fp, field), base,
-                            int(dv.nbytes + dm.nbytes))
+    with _base_fill_lock(fp, field):
+        base = cache.get(_vals_key(fp, field)) if cache is not None \
+            else None
+        if base is None:
+            dv = jax.device_put(vals)
+            dm = jax.device_put(valid)
+            nb += int(dv.nbytes + dm.nbytes)
+            base = (dv, dm)
+            if cache is not None:
+                cache.put_sized(_vals_key(fp, field), base,
+                                int(dv.nbytes + dm.nbytes))
     dl = None
     if limbs is not None:
         dl = jax.device_put(limbs)

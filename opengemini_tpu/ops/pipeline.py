@@ -174,11 +174,17 @@ class StreamingPipeline:
     result HBM never exceeds depth × launch output size.
 
     collect() joins everything and returns {key: post_result}; worker
-    exceptions re-raise there (the executor's normal error path)."""
+    exceptions re-raise there (the executor's normal error path).
 
-    def __init__(self, depth: int | None = None):
+    ``gate`` (optional semaphore) is the query scheduler's GLOBAL
+    in-flight bound: per-query ``depth`` caps one query's result HBM,
+    the shared gate caps the sum across concurrent queries (without it
+    N queries × depth launches could all be in flight at once)."""
+
+    def __init__(self, depth: int | None = None, gate=None):
         self.depth = depth if depth is not None else pipeline_depth()
         self._sem = threading.BoundedSemaphore(max(1, self.depth))
+        self.gate = gate
         self._futs: dict = {}
         self._lock = threading.Lock()
         self.launches = 0
@@ -189,9 +195,17 @@ class StreamingPipeline:
 
     def submit(self, key, tree, post=None) -> None:
         self._sem.acquire()
+        if self.gate is not None:
+            try:
+                self.gate.acquire()
+            except BaseException:
+                self._sem.release()
+                raise
         try:
             fut = _pull_pool().submit(self._run, tree, post)
         except BaseException:
+            if self.gate is not None:
+                self.gate.release()
             self._sem.release()
             raise
         with self._lock:
@@ -222,6 +236,8 @@ class StreamingPipeline:
                 self.leaves += st.get("leaves", 0)
             return out
         finally:
+            if self.gate is not None:
+                self.gate.release()
             self._sem.release()
 
     def collect(self) -> dict:

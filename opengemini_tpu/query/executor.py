@@ -91,6 +91,24 @@ def _unpack_block_out(fmt: str, arrs, stack, want: tuple) -> dict:
                                _KLu)
 
 
+def _sched_launch(kind: str, fn):
+    """Route one device-launch thunk through the global query
+    scheduler's dispatcher thread (single launch-ordering owner,
+    cross-query coalescing of same-kind launches) when OG_SCHED is on;
+    inline — byte-identical to the pre-scheduler path — otherwise."""
+    from .scheduler import enabled as _sen, get_scheduler
+    if not _sen():
+        return fn()
+    return get_scheduler().launch(kind, fn)
+
+
+def _sched_gate():
+    """Global streamed-launch semaphore shared across queries (None
+    when the scheduler is off: per-query depth alone, as before)."""
+    from .scheduler import enabled as _sen, get_scheduler
+    return get_scheduler().pipeline_gate() if _sen() else None
+
+
 def _dense_device_on() -> bool:
     """Dense (S, P) groups reduce ON DEVICE from decoded-plane-cache
     residency (ops/devicecache.py decoded tier) when OG_DENSE_DEVICE=1.
@@ -105,7 +123,7 @@ def _dense_device_on() -> bool:
 
 
 def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
-                      want_exact):
+                      want_exact, ctx=None):
     """Device dense path for one (group, field). Returns
     ("res", (res, exact), rkey) on a host-result-cache hit,
     ("dev", (res_tree, lsum_dev), rkey) when a device launch was
@@ -123,19 +141,38 @@ def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
     if ent is _dc.NO_PLANES:
         return None
     if ent is None:
-        limbs = None
-        if want_exact:
-            from ..ops import exactsum
-            limbs, bad = exactsum.host_limbs(dvals, dvalid, E)
-            if bad.any():
-                _dc.put_no_planes(fp, fname, e_key)
-                return None
-        ent = _dc.put_decoded_planes(fp, fname, e_key, dvals, dvalid,
-                                     limbs)
+        def _fill():
+            # re-probe inside the flight: a leader that just finished
+            # may have staked the planes between our miss and now
+            got2 = _dc.get_decoded_planes(fp, fname, e_key)
+            if got2 is not None:
+                return got2
+            limbs = None
+            if want_exact:
+                from ..ops import exactsum
+                limbs, bad = exactsum.host_limbs(dvals, dvalid, E)
+                if bad.any():
+                    _dc.put_no_planes(fp, fname, e_key)
+                    return _dc.NO_PLANES
+            return _dc.put_decoded_planes(fp, fname, e_key, dvals,
+                                          dvalid, limbs)
+        from .scheduler import enabled as _sen, get_scheduler
+        if _sen():
+            # single-flight the decode+H2D: 50 identical dashboard
+            # queries racing a cold cache upload the planes ONCE.
+            # ctx keeps a FOLLOWER killable while it waits out the
+            # leader's fill
+            ent = get_scheduler().singleflight(
+                ("planes", fp, fname, e_key), _fill, ctx=ctx)
+        else:
+            ent = _fill()
+        if ent is _dc.NO_PLANES:
+            return None
     from ..ops.segment_agg import (SegmentAggResult,
                                    dense_device_reduce)
-    outs = dense_device_reduce(ent[0], ent[1], ent[2], spec,
-                               ent[2] is not None)
+    outs = _sched_launch(
+        "dense", lambda: dense_device_reduce(ent[0], ent[1], ent[2],
+                                             spec, ent[2] is not None))
     res_t = SegmentAggResult(count=outs["count"], min=outs.get("min"),
                              max=outs.get("max"))
     return ("dev", (res_t, outs.get("lsum")), rkey)
@@ -659,11 +696,18 @@ class QueryExecutor:
             return {"error":
                     f"WHERE on SHOW {stmt.what.upper()} not supported"}
         if stmt.what == "queries":
+            # queued-but-unadmitted queries are listed too (status
+            # "queued"): they registered at enqueue time so they are
+            # visible and killable before winning a scheduler slot
             qm = self.query_manager
-            rows = [[c.qid, c.text, c.db, f"{c.duration_s:.3f}s"]
+            rows = [[c.qid, c.text, c.db, f"{c.duration_s:.3f}s",
+                     getattr(c, "state", "running"),
+                     round(getattr(c, "queue_ns", 0) / 1e6, 3),
+                     round(getattr(c, "device_ns", 0) / 1e6, 3)]
                     for c in qm.list()] if qm else []
             return _series("queries",
-                           ["qid", "query", "database", "duration"], rows)
+                           ["qid", "query", "database", "duration",
+                            "status", "queue_ms", "device_ms"], rows)
         if stmt.what == "subscriptions":
             if self.catalog is None:
                 return {"error": "meta catalog is not available"}
@@ -1397,8 +1441,8 @@ class QueryExecutor:
         # OG_PIPELINE_DEPTH bounds in-flight launches, 0 restores the
         # single-barrier path (bit-identical either way — enforced by
         # scripts/perf_smoke.sh)
-        pipe = _pl.StreamingPipeline() if _pl.pipeline_depth() > 0 \
-            else None
+        pipe = _pl.StreamingPipeline(gate=_sched_gate()) \
+            if _pl.pipeline_depth() > 0 else None
         n_stream = 0          # streamed packed-grid launches
         n_lat_stream = 0      # streamed lattice launches (fold in post)
         lat_host_acc: dict = {}   # (field,E,k0,ka) → host fold acc
@@ -1470,29 +1514,53 @@ class QueryExecutor:
                 if self.resources is not None:
                     self.resources.check_series(n_series)
             else:
-                per_shard: list[tuple[object, list[tuple[int, int]]]] = []
-                for s in shards:
-                    ts = s.index.group_by_tagsets(mst, group_tags,
-                                                  cond.tag_filters,
-                                                  cond.tag_exprs)
-                    pairs = []
-                    for key, sids in ts:
-                        gi = global_groups.setdefault(
-                            key, len(global_groups))
-                        pairs.extend((int(sid), gi) for sid in sids)
-                    per_shard.append((s, pairs))
-                n_series = sum(len(p) for _s, p in per_shard)
+                def _build_plan():
+                    # re-probe under the flight: the leader may have
+                    # populated the cache while we queued behind it
+                    with self._plan_lock:
+                        got = self._plan_cache.get(plan_key)
+                        if got is not None:
+                            self._plan_cache.move_to_end(plan_key)
+                            return got
+                    groups_l: dict[tuple, int] = {}
+                    per_shard: list = []
+                    for s in shards:
+                        ts = s.index.group_by_tagsets(mst, group_tags,
+                                                      cond.tag_filters,
+                                                      cond.tag_exprs)
+                        pairs = []
+                        for key, sids in ts:
+                            gi = groups_l.setdefault(key,
+                                                     len(groups_l))
+                            pairs.extend((int(sid), gi)
+                                         for sid in sids)
+                        per_shard.append((s, pairs))
+                    ns_l = sum(len(p) for _s, p in per_shard)
+                    if self.resources is not None:
+                        self.resources.check_series(ns_l)
+                    sp_l = plan_rowstore_scan(per_shard, mst, t_lo,
+                                              t_hi, ctx=ctx)
+                    with self._plan_lock:
+                        self._plan_cache[plan_key] = (groups_l, sp_l,
+                                                      ns_l)
+                        # small cap: entries pin memtable snapshots and
+                        # (possibly unlinked) readers until they age out
+                        while len(self._plan_cache) > 16:
+                            self._plan_cache.popitem(last=False)
+                    return groups_l, sp_l, ns_l
+
+                from .scheduler import enabled as _sen, get_scheduler
+                if _sen():
+                    # single-flight the tagset walk + chunk-meta plan:
+                    # N identical cold dashboard queries plan once
+                    groups_snap, scan_plan, n_series = \
+                        get_scheduler().singleflight(
+                            ("plan", plan_key), _build_plan, ctx=ctx)
+                else:
+                    groups_snap, scan_plan, n_series = _build_plan()
+                global_groups.update(groups_snap)
                 if self.resources is not None:
                     self.resources.check_series(n_series)
-                scan_plan = plan_rowstore_scan(per_shard, mst, t_lo,
-                                               t_hi, ctx=ctx)
-                with self._plan_lock:
-                    self._plan_cache[plan_key] = (dict(global_groups),
-                                                  scan_plan, n_series)
-                    # small cap: entries pin memtable snapshots and
-                    # (possibly unlinked) readers until they age out
-                    while len(self._plan_cache) > 16:
-                        self._plan_cache.popitem(last=False)
             if scan_plan.has_rows:
                 data_tmin = min(data_tmin, scan_plan.data_tmin)
                 data_tmax = max(data_tmax, scan_plan.data_tmax)
@@ -1751,7 +1819,9 @@ class QueryExecutor:
                                 lkey = (fname, sl[0].E, sl[0].k0,
                                         sl[0].limbs.shape[-1])
                                 if lat_dev_fold:
-                                    folded = \
+                                    folded = _sched_launch(
+                                        "lattice",
+                                        lambda sl=sl, gid_arr=gid_arr:
                                         blockagg.file_lattice_fold(
                                             sl, gid_arr, t_lo, t_hi,
                                             int(start),
@@ -1760,7 +1830,7 @@ class QueryExecutor:
                                             scalars=scalars,
                                             gids_dev=
                                             blockagg.cached_gids(
-                                                gid_arr))
+                                                gid_arr)))
                                     prev = lat_dev_acc.get(lkey)
                                     lat_dev_acc[lkey] = folded \
                                         if prev is None else \
@@ -1771,13 +1841,17 @@ class QueryExecutor:
                                         lat_dev_rows.get(lkey, 0)
                                         + sum(st.n_rows for st in sl))
                                     continue
-                                for st_l, d_l, WL_l in \
+                                for st_l, d_l, WL_l in _sched_launch(
+                                        "lattice",
+                                        lambda sl=sl, gid_arr=gid_arr:
                                         blockagg.file_lattice(
-                                        sl, gid_arr, t_lo, t_hi,
-                                        int(start), int(interval_eff),
-                                        W, want, scalars=scalars,
-                                        gids_dev=blockagg.cached_gids(
-                                            gid_arr)):
+                                            sl, gid_arr, t_lo, t_hi,
+                                            int(start),
+                                            int(interval_eff),
+                                            W, want, scalars=scalars,
+                                            gids_dev=
+                                            blockagg.cached_gids(
+                                                gid_arr))):
                                     if pipe is not None:
                                         n_lat_stream += 1
                                         pipe.submit(
@@ -1796,12 +1870,16 @@ class QueryExecutor:
                             continue
                         for fname, sl in stacks.items():
                             gid_arr = gids_by_field[fname]
-                            out = blockagg.file_aggregate(
-                                sl, gid_arr, t_lo, t_hi, int(start),
-                                int(interval_eff), W, G * W, want,
-                                scalars=scalars,
-                                gids_dev=blockagg.cached_gids(gid_arr),
-                                route=window_route)
+                            out = _sched_launch(
+                                "block",
+                                lambda sl=sl, gid_arr=gid_arr:
+                                blockagg.file_aggregate(
+                                    sl, gid_arr, t_lo, t_hi,
+                                    int(start), int(interval_eff),
+                                    W, G * W, want, scalars=scalars,
+                                    gids_dev=blockagg.cached_gids(
+                                        gid_arr),
+                                    route=window_route))
                             if can_merge:
                                 key = (fname, sl[0].E, sl[0].k0,
                                        sl[0].limbs.shape[-1])
@@ -2192,10 +2270,13 @@ class QueryExecutor:
                         # padded values only needed for selector
                         # host-gather — drop the copies otherwise
                         pads = {f: (None, None) for f in names}
-                    mres, lsums = multi_segment_aggregate(
-                        vstack, mstack, lstack, seg_p, times_p,
-                        num_segments, spec, sorted_ids=seg_sorted,
-                        host_gather=gather)
+                    mres, lsums = _sched_launch(
+                        "segagg",
+                        lambda vstack=vstack, mstack=mstack,
+                        lstack=lstack: multi_segment_aggregate(
+                            vstack, mstack, lstack, seg_p, times_p,
+                            num_segments, spec, sorted_ids=seg_sorted,
+                            host_gather=gather))
                     vstack = mstack = lstack = None
                     for i, f in enumerate(names):
                         field_results[f] = SegmentAggResult(
@@ -2234,11 +2315,14 @@ class QueryExecutor:
             else:
                 vals_p, valid_p = pad_rows([vals, valid], npad,
                                            seg_fill=0)
-                res = segment_aggregate(vals_p, valid_p,
-                                        seg_p, times_p,
-                                        num_segments, spec,
-                                        sorted_ids=seg_sorted,
-                                        host_gather=gather)
+                res = _sched_launch(
+                    "segagg",
+                    lambda vals_p=vals_p, valid_p=valid_p:
+                    segment_aggregate(vals_p, valid_p,
+                                      seg_p, times_p,
+                                      num_segments, spec,
+                                      sorted_ids=seg_sorted,
+                                      host_gather=gather))
                 if gather:
                     sel_results[fname] = vals_p
                 if field_exact:
@@ -2302,7 +2386,8 @@ class QueryExecutor:
                         got = _dense_device_try(
                             dcache, fp, fname, dvals, dvalid, spec,
                             exact_scales.get(fname, 0),
-                            exact_on and fname in exact_scales)
+                            exact_on and fname in exact_scales,
+                            ctx=ctx)
                         if got is not None:
                             kind, payload, rkey2 = got
                             if kind == "res":
@@ -2552,6 +2637,10 @@ class QueryExecutor:
                                       ident).astype(vp.dtype)
             field_results[fname] = res._replace(**rep)
         _dstat.bump_phase("device_agg", _now_ns() - _t_dev0)
+        if ctx is not None and hasattr(ctx, "add_device_ns"):
+            # per-query device wall (dispatch through pull) for SHOW
+            # QUERIES' device_ms column
+            ctx.add_device_ns(_now_ns() - _t_dev0)
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,

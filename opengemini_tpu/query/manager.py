@@ -18,7 +18,13 @@ class QueryKilled(ErrQueryError):
 class QueryContext:
     """Per-query handle: id, text, timing, kill flag. Scan loops call
     check() at chunk boundaries (the reference aborts cursors via its
-    closed-signal channel)."""
+    closed-signal channel).
+
+    Queries register HERE at ENQUEUE time (http.handle_query attaches
+    before scheduler admission), so a queued query is visible to SHOW
+    QUERIES (state "queued") and killable before it ever gets a slot —
+    the scheduler's admit loop watches the kill flag. queue_ns/
+    device_ns are the per-query serving phases SHOW QUERIES reports."""
 
     def __init__(self, qid: int, text: str, db: str | None):
         self.qid = qid
@@ -26,7 +32,27 @@ class QueryContext:
         self.db = db or ""
         self.start = time.monotonic()
         self.start_wall = time.time()
+        self.state = "running"      # "queued" while awaiting admission
+        self.queue_ns = 0           # wall spent awaiting a slot
+        self.device_ns = 0          # wall inside device dispatch+pull
+        self.cost_cells = 0         # admission cost estimate
         self._killed = threading.Event()
+
+    def mark_queued(self) -> None:
+        self.state = "queued"
+
+    def mark_running(self, queue_ns: int) -> None:
+        self.state = "running"
+        self.queue_ns = int(queue_ns)
+
+    def add_device_ns(self, ns: int) -> None:
+        # benign data race tolerated elsewhere; keep it exact — the
+        # executor may add from the query thread and pull workers
+        with self._dev_lock:
+            self.device_ns += int(ns)
+
+    _dev_lock = threading.Lock()    # class-level: contexts are short-
+    # lived and the add is rare (a few per query)
 
     def kill(self) -> None:
         self._killed.set()

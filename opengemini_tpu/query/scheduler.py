@@ -1,0 +1,624 @@
+"""Device query scheduler: multi-query serving runtime for the one TPU.
+
+Concurrent `/query` requests used to be plain ThreadingHTTPServer
+threads behind a counting semaphore (utils/resources.BoundedGate):
+FIFO-ish, deadline-blind, kill-blind — and past the gate every query
+independently contended for the device through the executor's plan
+lock, the streaming pipeline and the device cache. One 11.5M-cell
+monster query could starve hundreds of cheap dashboard queries
+(Tailwind's framing: many analytic queries must be *scheduled* onto a
+shared accelerator, not raced).
+
+This module is the serving-runtime layer that replaces that:
+
+- **Admission control** (``QueryScheduler.admit``): plan-derived cost
+  estimates (result cells, estimated pull bytes, HBM footprint —
+  ``estimate_request_cost``) feed a deadline-aware weighted-fair queue.
+  Grant order is by virtual finish time with log-scaled cost, so a
+  cheap dashboard query arriving behind a monster scan jumps ahead of
+  it while completed work still advances the monster toward its turn
+  (start-time-fair queuing; no starvation either way). Queued entries
+  honor the PR-1 deadline budget (they wait ``min(remaining_deadline,
+  timeout)``) and KILL QUERY ejects them immediately. Over-budget or
+  over-queue requests shed EARLY with HTTP 429 + Retry-After
+  (``SchedShed``); a paused/draining scheduler sheds with 503.
+
+- **Cross-query device multiplexing**: a single dispatcher thread owns
+  device-launch ordering (``launch``) — the executor routes its block/
+  lattice/segment/dense kernel dispatches through it, and consecutive
+  compatible launches (same kind, any query) coalesce into one
+  dispatch window instead of interleaving arbitrarily. A global
+  pipeline gate (``pipeline_gate``) bounds TOTAL in-flight streamed
+  launches across queries (the per-query OG_PIPELINE_DEPTH bound kept
+  HBM per query; concurrency multiplied it). ``singleflight``
+  de-duplicates identical expensive fills — decoded-plane device-cache
+  uploads and scan-plan builds — so 50 identical dashboard queries
+  decode/upload/plan once and 49 wait for the result.
+
+- **Observability + controls**: counters (admitted / shed / coalesced
+  / singleflight hits) surface through utils.stats.scheduler_collector
+  → /metrics and /debug/vars; per-query queue_ms / device_ms ride the
+  QueryContext into SHOW QUERIES; /debug/ctrl?mod=scheduler pauses,
+  resumes and drains; ``OG_SCHED=0`` disables the whole subsystem and
+  the executor/HTTP layers fall back byte-identically to the legacy
+  path (enforced by scripts/perf_smoke.sh's concurrency gate).
+
+Reference role: the reference meters per-query series/shard resources
+(lib/resourceallocator) but has no cross-query device scheduler — GPUs
+on PCIe never made a single accelerator the shared bottleneck the way
+a tunnel-attached TPU is.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from ..utils import deadline as _deadline
+from ..utils.errors import ErrQueryError, ErrQueryTimeout
+
+__all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
+           "get_scheduler", "estimate_request_cost", "sched_collector"]
+
+
+def enabled() -> bool:
+    """OG_SCHED=0 disables the scheduler everywhere (admission falls
+    back to the legacy BoundedGate, device launches dispatch inline,
+    cache fills race as before). Read dynamically: tests and the bench
+    concurrency gate flip it per run."""
+    return os.environ.get("OG_SCHED", "1") != "0"
+
+
+class SchedShed(ErrQueryError):
+    """Admission rejection: the request was shed BEFORE consuming any
+    device time. ``http_code`` 429 (over budget / queue full / queued
+    too long → client should back off and retry) or 503 (scheduler
+    paused or draining); ``retry_after_s`` feeds the Retry-After
+    header."""
+
+    def __init__(self, msg: str, http_code: int = 429,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.http_code = http_code
+        self.retry_after_s = float(retry_after_s)
+
+
+class QueryCost:
+    """Plan-derived cost estimate for one request (summed over its
+    SELECT statements). Cells drive the fair-queue weight; pull/HBM
+    bytes are the admission budget dimensions."""
+
+    __slots__ = ("cells", "pull_bytes", "hbm_bytes")
+
+    def __init__(self, cells: int = 0, pull_bytes: int = 0,
+                 hbm_bytes: int = 0):
+        self.cells = int(cells)
+        self.pull_bytes = int(pull_bytes)
+        self.hbm_bytes = int(hbm_bytes)
+
+    @property
+    def norm(self) -> float:
+        """Virtual-time charge: sqrt-scaled cells. Raw cells would park
+        an 11.5M-cell monster behind ~16k dashboard completions
+        (starvation in practice); a log scale advances virtual time so
+        fast the monster re-enters after ~2 cheap completions (measured
+        in the bench concurrent phase — FIFO-equivalent p99). sqrt puts
+        the monster behind roughly √(monster/dash) ≈ tens of cheap
+        completions: bursts of dashboards overtake it, sustained load
+        still reaches it."""
+        return math.sqrt(max(0, self.cells) + 1.0)
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"QueryCost(cells={self.cells}, "
+                f"pull_bytes={self.pull_bytes}, "
+                f"hbm_bytes={self.hbm_bytes})")
+
+
+# packed-transport bytes/cell (executor block path) and worst-case f64
+# state bytes/cell — the same constants the dispatch economics use
+_PULL_BYTES_PER_CELL = 20
+_HBM_BYTES_PER_CELL = 88
+_DEFAULT_CELLS = 10_000       # unknown plans admit at dashboard weight
+
+# scheduler counters (utils.stats.scheduler_collector → /metrics,
+# /debug/vars). Writers use utils.stats.bump (threaded HTTP server).
+SCHED_STATS: dict = {
+    "admitted": 0,             # granted a slot (incl. instant grants)
+    "queued_total": 0,         # had to wait for a slot (cumulative —
+    # the LIVE queue depth is the 'queued' gauge in snapshot())
+    "shed": 0,                 # all SchedShed rejections
+    "shed_queue_full": 0,
+    "shed_deadline": 0,        # bound request budget spent while queued
+    "shed_timeout": 0,         # plain slot-wait timeout (no budget)
+    "shed_paused": 0,
+    "shed_over_budget": 0,     # cost estimate above OG_SCHED_MAX_CELLS
+    "ejected_killed": 0,       # KILL QUERY removed a queued entry
+    "queue_wait_ms": 0,        # cumulative wait of granted entries
+    "dispatched_launches": 0,  # launches routed through the dispatcher
+    "coalesced_launches": 0,   # launches that rode a shared window
+    "coalesced_dispatches": 0,  # multi-launch dispatch windows
+    "singleflight_leaders": 0,
+    "singleflight_hits": 0,    # followers served by a leader's fill
+}
+
+
+def _bump(key: str, n: int = 1) -> None:
+    from ..utils.stats import bump as _b
+    _b(SCHED_STATS, key, n)
+
+
+class _Entry:
+    __slots__ = ("vft", "seq", "cost", "ctx", "event", "granted",
+                 "cancelled", "enq_ns")
+
+    def __init__(self, vft: float, seq: int, cost: QueryCost, ctx):
+        self.vft = vft
+        self.seq = seq
+        self.cost = cost
+        self.ctx = ctx
+        self.event = threading.Event()
+        self.granted = False
+        self.cancelled = False
+        self.enq_ns = time.perf_counter_ns()
+
+    def __lt__(self, other):       # heapq ordering: fair-queue key
+        return (self.vft, self.seq) < (other.vft, other.seq)
+
+
+class _Ticket:
+    """Held admission slot; release() returns it (context-manager too).
+    Idempotent — the HTTP finally-path may race a handler error."""
+
+    def __init__(self, sched: "QueryScheduler", cost: QueryCost):
+        self._sched = sched
+        self._cost = cost
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._sched._release(self._cost)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class QueryScheduler:
+    """One per process (``get_scheduler``); owns admission and device
+    launch ordering for every concurrently-executing query."""
+
+    # safety valve: never batch more launches than this into a single
+    # dispatch window (a window blocks kills/deadlines of its members)
+    MAX_COALESCE = 16
+
+    def __init__(self, max_concurrent: int = 0, max_queued: int = 64,
+                 timeout_s: float = 30.0, max_cells: int = 0,
+                 global_depth: int | None = None):
+        self.max_concurrent = int(max_concurrent)   # 0 = unlimited
+        self.max_queued = int(max_queued)
+        self.timeout_s = float(timeout_s)
+        self.max_cells = int(max_cells)             # 0 = no budget cap
+        self._lock = threading.Lock()
+        self._active = 0
+        self._heap: list[_Entry] = []
+        self._seq = 0
+        self._vtime = 0.0
+        self.paused = False
+        self.draining = False
+        # launch dispatcher (lazy thread)
+        self._dq: deque = deque()
+        self._dcv = threading.Condition(self._lock)
+        self._disp_thread: threading.Thread | None = None
+        # singleflight: key → [event, result, None] in-flight table
+        self._sf: dict = {}
+        self._pipe_gate: threading.BoundedSemaphore | None = None
+
+    # ------------------------------------------------------- admission
+
+    def configure(self, max_concurrent: int | None = None,
+                  max_queued: int | None = None,
+                  timeout_s: float | None = None,
+                  max_cells: int | None = None) -> None:
+        """Wire config/env limits (HttpServer init). Env overrides win
+        so a bench/operator can tighten slots without a config file."""
+        with self._lock:
+            if max_concurrent is not None:
+                self.max_concurrent = int(max_concurrent)
+            if max_queued is not None:
+                self.max_queued = int(max_queued)
+            if timeout_s is not None:
+                self.timeout_s = float(timeout_s)
+            if max_cells is not None:
+                self.max_cells = int(max_cells)
+            env = os.environ
+            if env.get("OG_SCHED_SLOTS"):
+                self.max_concurrent = int(env["OG_SCHED_SLOTS"])
+            if env.get("OG_SCHED_QUEUE"):
+                self.max_queued = int(env["OG_SCHED_QUEUE"])
+            if env.get("OG_SCHED_MAX_CELLS"):
+                self.max_cells = int(env["OG_SCHED_MAX_CELLS"])
+        self._pump()
+
+    def _retry_after(self) -> float:
+        """Crude wait hint: half a queue of average charges at one
+        slot-second each, floored to 1s — a backoff signal, not a
+        promise. Lock-free (callers may hold the scheduler lock; a
+        racy length read cannot mislead a backoff hint)."""
+        n = len(self._heap) + self._active
+        return max(1.0, 0.5 * n)
+
+    def admit(self, ctx=None, cost: QueryCost | None = None,
+              timeout_s: float | None = None) -> _Ticket:
+        """Admit one request. Returns a _Ticket (release when the
+        request finishes). Raises SchedShed (429/503), ErrQueryTimeout
+        (deadline spent while queued) or the ctx's kill error."""
+        cost = cost or QueryCost(_DEFAULT_CELLS)
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        dl = _deadline.current()
+        if dl is not None:
+            # honor the bound budget while queued; shed immediately if
+            # it is already gone (the wait cannot possibly pay off)
+            dl.check("scheduler admit")
+            timeout = min(timeout, _deadline.remaining(timeout))
+        if self.max_cells and cost.cells > self.max_cells:
+            _bump("shed")
+            _bump("shed_over_budget")
+            raise SchedShed(
+                f"query estimated at {cost.cells} result cells exceeds "
+                f"the admission budget ({self.max_cells}); narrow the "
+                "time range or grouping", http_code=429,
+                retry_after_s=self._retry_after())
+        with self._lock:
+            if self.paused or self.draining:
+                _bump("shed")
+                _bump("shed_paused")
+                raise SchedShed(
+                    "scheduler is " + ("draining" if self.draining
+                                       else "paused"),
+                    http_code=503, retry_after_s=self._retry_after())
+            if self.max_concurrent <= 0 or (
+                    self._active < self.max_concurrent
+                    and not self._heap):
+                self._active += 1
+                _bump("admitted")
+                if ctx is not None and hasattr(ctx, "mark_running"):
+                    ctx.mark_running(0)
+                return _Ticket(self, cost)
+            if len(self._heap) >= self.max_queued:
+                _bump("shed")
+                _bump("shed_queue_full")
+                raise SchedShed(
+                    f"too many queued queries (> {self.max_queued})",
+                    http_code=429, retry_after_s=self._retry_after())
+            self._seq += 1
+            ent = _Entry(self._vtime + cost.norm, self._seq, cost, ctx)
+            heapq.heappush(self._heap, ent)
+            _bump("queued_total")
+            if ctx is not None and hasattr(ctx, "mark_queued"):
+                ctx.mark_queued()
+        return self._wait(ent, timeout)
+
+    def _wait(self, ent: _Entry, timeout: float) -> _Ticket:
+        t0 = time.monotonic()
+        dl = _deadline.current()
+        while True:
+            if ent.event.wait(0.05):
+                wait_ns = time.perf_counter_ns() - ent.enq_ns
+                _bump("queue_wait_ms", wait_ns // 1_000_000)
+                if ent.ctx is not None and hasattr(ent.ctx,
+                                                   "mark_running"):
+                    ent.ctx.mark_running(wait_ns)
+                return _Ticket(self, ent.cost)
+            if ent.ctx is not None and getattr(ent.ctx, "killed", False):
+                if self._cancel(ent):
+                    _bump("ejected_killed")
+                    from .manager import QueryKilled
+                    raise QueryKilled(
+                        f"query {getattr(ent.ctx, 'qid', '?')} killed "
+                        "while queued")
+                continue        # granted in the race — take the slot
+            if dl is not None and dl.expired:
+                if self._cancel(ent):
+                    _bump("shed")
+                    _bump("shed_deadline")
+                    raise ErrQueryTimeout(
+                        "query deadline exceeded while queued "
+                        f"(budget {dl.budget_s:.3g}s)")
+                continue
+            if time.monotonic() - t0 > timeout:
+                if self._cancel(ent):
+                    _bump("shed")
+                    _bump("shed_timeout")
+                    raise SchedShed(
+                        f"timed out waiting for a query slot "
+                        f"({self.max_concurrent} concurrent)",
+                        http_code=429,
+                        retry_after_s=self._retry_after())
+                continue
+
+    def _cancel(self, ent: _Entry) -> bool:
+        """Remove a queued entry; False when a grant won the race (the
+        caller must then consume the slot it was handed). The heap is
+        compacted eagerly: a cancelled ghost must not count toward the
+        queue-full cap or suppress the instant-grant fast path."""
+        with self._lock:
+            if ent.granted:
+                return False
+            ent.cancelled = True
+            self._heap = [e for e in self._heap if not e.cancelled]
+            heapq.heapify(self._heap)
+        return True
+
+    def _release(self, cost: QueryCost) -> None:
+        with self._lock:
+            self._active -= 1
+            # virtual time advances by COMPLETED work, so a parked
+            # monster's finish tag is eventually reached (no starvation)
+            self._vtime += cost.norm
+        self._pump()
+
+    def _pump(self) -> None:
+        """Grant queued entries while slots are free, cheapest virtual
+        finish time first."""
+        granted = []
+        with self._lock:
+            if self.paused:
+                return
+            while self._heap and (self.max_concurrent <= 0
+                                  or self._active < self.max_concurrent):
+                ent = heapq.heappop(self._heap)
+                if ent.cancelled:
+                    continue
+                ent.granted = True
+                self._active += 1
+                granted.append(ent)
+        for ent in granted:
+            _bump("admitted")
+            ent.event.set()
+
+    # ------------------------------------------------ pause/drain ctl
+
+    def pause(self) -> None:
+        """Stop granting slots: running queries finish (their device
+        launches keep dispatching), queued ones wait, new arrivals shed
+        503."""
+        with self._lock:
+            self.paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self.paused = False
+            self._dcv.notify_all()
+        self._pump()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Shed new arrivals and wait until every admitted query has
+        released its slot and the launch queue is empty."""
+        with self._lock:
+            self.draining = True
+        t0 = time.monotonic()
+        try:
+            while time.monotonic() - t0 < timeout_s:
+                with self._lock:
+                    if self._active == 0 and not self._dq \
+                            and not self._heap:
+                        return True
+                time.sleep(0.02)
+            return False
+        finally:
+            with self._lock:
+                self.draining = False
+
+    # ------------------------------------------- device launch plane
+
+    def pipeline_gate(self) -> threading.BoundedSemaphore:
+        """Global streamed-launch bound shared by every query's
+        StreamingPipeline: per-query depth bounds one query's result
+        HBM, this bounds the sum (OG_SCHED_DEPTH)."""
+        with self._lock:
+            if self._pipe_gate is None:
+                try:
+                    depth = int(os.environ.get("OG_SCHED_DEPTH", "8"))
+                except ValueError:
+                    depth = 8
+                self._pipe_gate = threading.BoundedSemaphore(
+                    max(1, depth))
+            return self._pipe_gate
+
+    def launch(self, kind: str, fn):
+        """Run one device-launch thunk on the dispatcher thread, which
+        owns launch ordering across all queries. Consecutive queued
+        launches of the same ``kind`` (from ANY query) run back-to-back
+        in one dispatch window — the cross-query coalescing that keeps
+        50 small dashboard launches from interleaving with a monster's.
+        Blocks until the thunk ran; exceptions re-raise here."""
+        if threading.current_thread() is self._disp_thread:
+            return fn()        # re-entrant (a launch spawning a launch)
+        fut: Future = Future()
+        with self._lock:
+            self._dq.append((kind, fn, fut))
+            if self._disp_thread is None or \
+                    not self._disp_thread.is_alive():
+                self._disp_thread = threading.Thread(
+                    target=self._dispatch_loop, daemon=True,
+                    name="og-sched-dispatch")
+                self._disp_thread.start()
+            self._dcv.notify()
+        return fut.result()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                # NOTE: launches keep flowing while paused — pause
+                # stops NEW admissions only. Freezing the launch queue
+                # would wedge already-admitted queries inside
+                # fut.result() (kill- and deadline-blind) and drain
+                # could then never reach active == 0.
+                while not self._dq:
+                    self._dcv.wait(timeout=1.0)
+                kind0 = self._dq[0][0]
+                batch = [self._dq.popleft()]
+                while (self._dq and self._dq[0][0] == kind0
+                       and len(batch) < self.MAX_COALESCE):
+                    batch.append(self._dq.popleft())
+            _bump("dispatched_launches", len(batch))
+            if len(batch) > 1:
+                _bump("coalesced_launches", len(batch) - 1)
+                _bump("coalesced_dispatches")
+            for _k, fn, fut in batch:
+                try:
+                    fut.set_result(fn())
+                except BaseException as e:      # noqa: BLE001 — the
+                    # submitting query owns the error
+                    fut.set_exception(e)
+
+    # ------------------------------------------------- singleflight
+
+    def singleflight(self, key, fn, ctx=None):
+        """De-duplicate one expensive fill across concurrent queries:
+        the first caller (leader) runs ``fn``; followers wait (honoring
+        kill + deadline) and share the leader's result. On leader
+        failure followers fall back to running ``fn`` themselves (the
+        leader's error is its own — a follower's query must not die of
+        it)."""
+        with self._lock:
+            ent = self._sf.get(key)
+            if ent is None:
+                ent = [threading.Event(), None, False]   # evt, res, ok
+                self._sf[key] = ent
+                leader = True
+            else:
+                leader = False
+        if leader:
+            _bump("singleflight_leaders")
+            try:
+                ent[1] = fn()
+                ent[2] = True
+            finally:
+                with self._lock:
+                    self._sf.pop(key, None)
+                ent[0].set()
+            return ent[1]
+        while not ent[0].wait(0.05):
+            if ctx is not None and getattr(ctx, "killed", False):
+                from .manager import QueryKilled
+                raise QueryKilled(
+                    f"query {getattr(ctx, 'qid', '?')} killed")
+            _deadline.check("singleflight wait")
+        if not ent[2]:
+            return fn()
+        _bump("singleflight_hits")
+        return ent[1]
+
+    # ------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        out = dict(SCHED_STATS)
+        with self._lock:
+            # live gauges AFTER the counter copy: the cumulative
+            # 'queued_total' counter must not clobber the live depth
+            out.update({"active": self._active,
+                        "queued": len(self._heap),
+                        "launch_queue": len(self._dq),
+                        "max_concurrent": self.max_concurrent,
+                        "max_queued": self.max_queued,
+                        "max_cells": self.max_cells,
+                        "paused": self.paused,
+                        "draining": self.draining,
+                        "vtime": round(self._vtime, 3)})
+        return out
+
+
+# ------------------------------------------------------ cost estimate
+
+def estimate_request_cost(executor, stmts, db: str | None) -> QueryCost:
+    """Plan-derived cost of one HTTP request: for each SELECT, estimate
+    the result grid (series-cardinality × windows from the statement's
+    own GROUP BY/time range — the same quantities the dispatch
+    economics use), then derive pull bytes (packed transport) and HBM
+    footprint. Estimation must never fail admission: any error falls
+    back to the default dashboard-class cost."""
+    from .ast import SelectStatement
+    cells = 0
+    seen_select = False
+    for stmt in stmts:
+        if not isinstance(stmt, SelectStatement):
+            continue
+        seen_select = True
+        try:
+            cells += _estimate_select_cells(executor, stmt, db)
+        except Exception:
+            cells += _DEFAULT_CELLS
+    if not seen_select:
+        return QueryCost(0, 0, 0)
+    return QueryCost(cells, cells * _PULL_BYTES_PER_CELL,
+                     cells * _HBM_BYTES_PER_CELL)
+
+
+def _estimate_select_cells(executor, stmt, db: str | None) -> int:
+    from .condition import MAX_TIME, MIN_TIME, analyze_condition
+    db2 = stmt.from_db or db
+    mst = stmt.from_measurement
+    engine = getattr(executor, "engine", None)
+    if db2 is None or mst is None or engine is None \
+            or not hasattr(engine, "database"):
+        return _DEFAULT_CELLS
+    if db2 not in getattr(engine, "databases", ()):  # vanishes as error
+        return _DEFAULT_CELLS
+    cond = analyze_condition(stmt.condition, set())
+    interval = stmt.group_by_interval()
+    if interval:
+        if cond.t_min != MIN_TIME and cond.t_max != MAX_TIME:
+            W = max(1, int((cond.t_max - cond.t_min) // interval) + 1)
+        else:
+            W = 1000           # unbounded windowed range: assume wide
+    else:
+        W = 1
+    G = 1
+    if stmt.group_by_star or stmt.group_by_tags():
+        db_obj = engine.database(db2)
+        shards = (db_obj.shards_overlapping(cond.t_min, cond.t_max)
+                  if cond.has_time_range else db_obj.all_shards())
+        n = 0
+        for s in list(shards)[:8]:  # cap the probe: estimate, not scan
+            try:
+                n += len(s.index.series_ids(mst))
+            except Exception:
+                pass
+        G = max(1, n)
+    return G * W
+
+
+# ------------------------------------------------------ global handle
+
+_SCHED: QueryScheduler | None = None
+_SCHED_LOCK = threading.Lock()
+
+
+def get_scheduler() -> QueryScheduler:
+    """Process-wide scheduler (one device, one launch owner)."""
+    global _SCHED
+    with _SCHED_LOCK:
+        if _SCHED is None:
+            _SCHED = QueryScheduler()
+            _SCHED.configure()       # pick up env overrides
+        return _SCHED
+
+
+def sched_collector() -> dict:
+    """utils.stats collector: counters + live gauges for /metrics and
+    /debug/vars (creates the scheduler lazily — cheap, no threads)."""
+    out = get_scheduler().snapshot()
+    out["enabled"] = 1 if enabled() else 0
+    # booleans don't survive the line-protocol writer; flatten them
+    out["paused"] = 1 if out["paused"] else 0
+    out["draining"] = 1 if out["draining"] else 0
+    return out

@@ -28,7 +28,8 @@ import time
 
 from .errors import ErrQueryTimeout
 
-__all__ = ["Deadline", "bind", "current", "clamp", "check"]
+__all__ = ["Deadline", "bind", "current", "clamp", "check",
+           "remaining"]
 
 
 class Deadline:
@@ -108,3 +109,12 @@ def check(where: str = "") -> None:
     dl = current()
     if dl is not None:
         dl.check(where)
+
+
+def remaining(default: float | None = None) -> float | None:
+    """Seconds left on the bound deadline (may be <= 0 once spent), or
+    ``default`` when unbounded. The admission paths (query scheduler,
+    BoundedGate) clamp their queue waits with this so a parked request
+    never outsleeps its own budget."""
+    dl = current()
+    return dl.remaining() if dl is not None else default

@@ -5,8 +5,10 @@ which meters series/shard parallelism resources per query type)."""
 from __future__ import annotations
 
 import threading
+import time
 
-from .errors import ErrQueryError
+from . import deadline as _deadline
+from .errors import ErrQueryError, ErrQueryTimeout
 
 
 class ResourceExhausted(ErrQueryError):
@@ -17,7 +19,14 @@ class BoundedGate:
     """Counting semaphore with a bounded wait queue: at most `limit`
     holders; at most `max_queued` waiters; waiters past the queue cap or
     the timeout are rejected (the reference rejects rather than queues
-    unboundedly — resource_allocator.go)."""
+    unboundedly — resource_allocator.go).
+
+    A queued waiter is no longer deaf while parked: it waits
+    ``min(remaining_deadline, timeout_s)`` instead of a fixed 30s, and
+    an optional ``ctx`` (QueryContext) is polled so KILL QUERY ejects a
+    QUEUED query immediately (it used to be unkillable until it won a
+    slot). The query/scheduler subsystem replaces this gate when
+    OG_SCHED is on; this stays as the OG_SCHED=0 fallback."""
 
     def __init__(self, limit: int, max_queued: int = 64,
                  timeout_s: float = 30.0):
@@ -28,7 +37,7 @@ class BoundedGate:
         self._queued = 0
         self._lock = threading.Lock()
 
-    def acquire(self) -> None:
+    def acquire(self, ctx=None) -> None:
         if self._sem is None:
             return
         with self._lock:
@@ -36,11 +45,42 @@ class BoundedGate:
                 raise ResourceExhausted(
                     f"too many queued requests (> {self.max_queued})")
             self._queued += 1
+        if ctx is not None and hasattr(ctx, "mark_queued"):
+            ctx.mark_queued()
+        dl = _deadline.current()
+        left = _deadline.remaining()
+        if left is not None and left <= 0:
+            with self._lock:
+                self._queued -= 1
+            raise ErrQueryTimeout(
+                "query deadline exceeded while queued")
+        budget = self.timeout_s if left is None \
+            else min(self.timeout_s, left)
+        t0 = time.monotonic()
+        enq_ns = time.perf_counter_ns()
         try:
-            if not self._sem.acquire(timeout=self.timeout_s):
-                raise ResourceExhausted(
-                    f"timed out waiting for a slot "
-                    f"({self.limit} concurrent)")
+            # poll in short slices so a queued query stays killable and
+            # deadline-honoring (a blocking 30s semaphore wait was both
+            # kill- and deadline-blind)
+            while True:
+                left = budget - (time.monotonic() - t0)
+                if left <= 0:
+                    if dl is not None and dl.expired:
+                        raise ErrQueryTimeout(
+                            "query deadline exceeded while queued "
+                            f"(budget {dl.budget_s:.3g}s)")
+                    raise ResourceExhausted(
+                        f"timed out waiting for a slot "
+                        f"({self.limit} concurrent)")
+                if self._sem.acquire(timeout=min(0.05, left)):
+                    if ctx is not None and hasattr(ctx, "mark_running"):
+                        ctx.mark_running(
+                            time.perf_counter_ns() - enq_ns)
+                    return
+                if ctx is not None and getattr(ctx, "killed", False):
+                    raise ErrQueryError(
+                        f"query {getattr(ctx, 'qid', '?')} killed "
+                        "while queued")
         finally:
             with self._lock:
                 self._queued -= 1

@@ -216,6 +216,15 @@ def device_collector():
     return _dc()
 
 
+def scheduler_collector():
+    """Device query scheduler metrics (query/scheduler.py): admission
+    counters (admitted/shed/queued), dispatcher coalescing, singleflight
+    hits, plus live active/queued gauges — the serving-runtime signals
+    for /metrics, /debug/vars and the pusher."""
+    from ..query.scheduler import sched_collector
+    return sched_collector()
+
+
 def wal_collector():
     """WAL metrics (reference statistics/wal analog)."""
     from ..storage.wal import WAL_STATS

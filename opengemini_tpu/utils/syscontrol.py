@@ -14,6 +14,10 @@ Commands (query params: ?mod=<cmd>[&switchon=true|false]):
                      [&arg=][&maxhits=N][&pct=P]); no point: list
     circuitbreaker — per-peer breaker states; &addr=<host:port>
                      &switchon=true trips it, =false resets it
+    scheduler      — device query scheduler: no action returns the
+                     counters; &action=pause|resume|drain[&timeout=S]
+                     (pause stops granting slots — running queries
+                     finish; drain waits until in-flight work ends)
 """
 
 from __future__ import annotations
@@ -90,6 +94,29 @@ class SysControl:
                 br = transport.breaker_for(addr)
                 br.force(self._flag(params))
                 return 200, {"addr": addr, **br.snapshot()}
+            if mod == "scheduler":
+                # serving-runtime admin plane (query/scheduler.py):
+                # stats snapshot, pause/resume of slot grants + launch
+                # dispatch, drain-to-idle for maintenance windows
+                from ..query import scheduler as qs
+                sch = qs.get_scheduler()
+                action = params.get("action", "")
+                out = {"enabled": qs.enabled()}
+                if action == "pause":
+                    sch.pause()
+                elif action == "resume":
+                    sch.resume()
+                elif action == "drain":
+                    try:
+                        t = float(params.get("timeout", "30"))
+                    except ValueError:
+                        t = 30.0
+                    out["drained"] = sch.drain(t)
+                elif action:
+                    return 400, {"error":
+                                 f"unknown scheduler action {action!r}"}
+                out["scheduler"] = sch.snapshot()
+                return 200, out
             if mod == "failpoint":
                 # arm/disarm fault-injection points (reference failpoint
                 # toggles over the syscontrol admin plane, SURVEY.md §5)

@@ -38,3 +38,22 @@ assert r.get("cells_checked", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 EOF
+
+# concurrency gate (device query scheduler): 16 dashboard + 1 heavy
+# query through the full HTTP path, scheduler-on AND OG_SCHED=0 —
+# every response must be bit-identical to the serial reference across
+# all bench shapes (the phase raises CONCURRENT MISMATCH otherwise)
+timeout -k 10 "${OG_SMOKE_TIMEOUT_S:-900}" \
+    python bench.py --phase concurrent | tee /tmp/og_conc_smoke.json
+
+python - <<'EOF'
+import json
+last = open("/tmp/og_conc_smoke.json").read().strip().splitlines()[-1]
+r = json.loads(last)
+assert r.get("metric") == "concurrent_serving_dashboard_p99_ms", r
+assert r.get("bit_identical") is True, r
+assert r.get("p99_ms", 0) > 0 and r.get("baseline_p99_ms", 0) > 0, r
+print(f"concurrency gate OK: sched p99 {r['p99_ms']}ms "
+      f"(qps {r['concurrent_qps']}) vs OG_SCHED=0 p99 "
+      f"{r['baseline_p99_ms']}ms (qps {r['baseline_qps']})")
+EOF

@@ -25,6 +25,25 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _stackdump_watchdog():
+    """Deadlock visibility: a test that wedges (a scheduler admission
+    or singleflight wait gone wrong) must PRINT every thread's stack
+    instead of silently hanging tier-1 until the outer kill. Re-armed
+    per test; exit=False so a slow-but-alive test merely logs.
+    OG_TEST_STACKDUMP_S=0 disables."""
+    import faulthandler
+    try:
+        timeout = float(os.environ.get("OG_TEST_STACKDUMP_S", "300"))
+    except ValueError:
+        timeout = 300.0
+    if timeout > 0:
+        faulthandler.dump_traceback_later(timeout, exit=False)
+    yield
+    if timeout > 0:
+        faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(autouse=True)
 def _failpoint_hygiene():
     """Failpoint leak guard: a point armed by one test must NEVER bleed
     into an unrelated test (an inherited `error` point would fail it

@@ -1,0 +1,669 @@
+"""Device query scheduler (query/scheduler.py): admission control
+(weighted-fair ordering, shed/429, pause/503, kill + deadline of QUEUED
+entries), cross-query coalescing + singleflight, the fixed BoundedGate
+fallback, and the concurrent-execution parity suite (N threads × mixed
+query shapes — every result cell bit-identical to serial)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query.manager import QueryContext, QueryKilled
+from opengemini_tpu.query.scheduler import (QueryCost, QueryScheduler,
+                                            SCHED_STATS, SchedShed,
+                                            estimate_request_cost,
+                                            get_scheduler)
+from opengemini_tpu.utils import deadline
+from opengemini_tpu.utils.errors import ErrQueryError, ErrQueryTimeout
+from opengemini_tpu.utils.resources import (BoundedGate,
+                                            ResourceExhausted)
+
+
+@pytest.fixture(autouse=True)
+def _sched_env(monkeypatch):
+    """Fresh global scheduler per test (counters are process-global and
+    fine; the instance holds limits/queues that must not leak)."""
+    import opengemini_tpu.query.scheduler as S
+    monkeypatch.setattr(S, "_SCHED", None)
+    monkeypatch.setenv("OG_SCHED", "1")
+    for k in ("OG_SCHED_SLOTS", "OG_SCHED_QUEUE", "OG_SCHED_MAX_CELLS",
+              "OG_SCHED_DEPTH"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+    monkeypatch.setattr(S, "_SCHED", None)
+
+
+# ------------------------------------------------------ admission unit
+
+
+def test_admit_instant_when_unlimited():
+    s = QueryScheduler(max_concurrent=0)
+    t = s.admit(cost=QueryCost(10))
+    assert s.snapshot()["active"] == 1
+    t.release()
+    assert s.snapshot()["active"] == 0
+
+
+def test_wfq_cheap_jumps_queued_monster():
+    """With one slot held, a cheap dashboard query enqueued AFTER a
+    monster scan must be granted BEFORE it (weighted-fair by cost) —
+    and the monster still runs once the cheap work is done."""
+    s = QueryScheduler(max_concurrent=1)
+    first = s.admit(cost=QueryCost(100))
+    order = []
+    done = threading.Event()
+
+    def run(name, cells):
+        t = s.admit(cost=QueryCost(cells), timeout_s=30)
+        order.append(name)
+        t.release()
+        if len(order) == 2:
+            done.set()
+
+    heavy = threading.Thread(target=run, args=("heavy", 11_500_000))
+    heavy.start()
+    time.sleep(0.2)                      # heavy is parked first
+    cheap = threading.Thread(target=run, args=("cheap", 720))
+    cheap.start()
+    time.sleep(0.2)
+    first.release()
+    assert done.wait(10)
+    heavy.join(10)
+    cheap.join(10)
+    assert order == ["cheap", "heavy"]
+
+
+def test_queue_full_sheds_429():
+    s = QueryScheduler(max_concurrent=1, max_queued=0)
+    hold = s.admit(cost=QueryCost(1))
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(1))
+    assert ei.value.http_code == 429
+    assert ei.value.retry_after_s >= 1.0
+    hold.release()
+
+
+def test_over_budget_sheds_429():
+    s = QueryScheduler(max_concurrent=0, max_cells=1000)
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(10_000))
+    assert ei.value.http_code == 429
+    # under-budget admits fine
+    s.admit(cost=QueryCost(999)).release()
+
+
+def test_paused_sheds_503_and_resume():
+    s = QueryScheduler(max_concurrent=1)
+    s.pause()
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(1))
+    assert ei.value.http_code == 503
+    s.resume()
+    s.admit(cost=QueryCost(1)).release()
+
+
+def test_killed_while_queued_ejects():
+    s = QueryScheduler(max_concurrent=1)
+    hold = s.admit(cost=QueryCost(1))
+    ctx = QueryContext(7, "SELECT 1", "db")
+    err = []
+
+    def wait():
+        try:
+            s.admit(ctx=ctx, cost=QueryCost(1))
+        except QueryKilled as e:
+            err.append(str(e))
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.2)
+    assert ctx.state == "queued"         # visible as queued pre-grant
+    ctx.kill()
+    t.join(10)
+    assert not t.is_alive() and err      # ejected promptly, not at 30s
+    hold.release()
+
+
+def test_deadline_honored_while_queued():
+    s = QueryScheduler(max_concurrent=1)
+    hold = s.admit(cost=QueryCost(1))
+    t0 = time.monotonic()
+    with deadline.bind(0.3, what="query"):
+        with pytest.raises(ErrQueryTimeout):
+            s.admit(cost=QueryCost(1))
+    assert time.monotonic() - t0 < 5     # not the fixed 30s wait
+    hold.release()
+
+
+def test_queue_timeout_sheds_with_retry_after():
+    s = QueryScheduler(max_concurrent=1)
+    hold = s.admit(cost=QueryCost(1))
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(1), timeout_s=0.2)
+    assert ei.value.http_code == 429
+    hold.release()
+
+
+def test_drain_waits_for_active():
+    s = QueryScheduler(max_concurrent=2)
+    hold = s.admit(cost=QueryCost(1))
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(ok=s.drain(timeout_s=10)))
+    t.start()
+    time.sleep(0.2)
+    assert "ok" not in out               # still draining
+    # draining sheds new arrivals with 503
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(1))
+    assert ei.value.http_code == 503
+    hold.release()
+    t.join(10)
+    assert out.get("ok") is True
+
+
+# --------------------------------------------- dispatcher/singleflight
+
+
+def test_launch_runs_and_propagates_errors():
+    s = QueryScheduler()
+    assert s.launch("k", lambda: 5) == 5
+    with pytest.raises(ValueError, match="boom"):
+        s.launch("k", lambda: (_ for _ in ()).throw(ValueError("boom")))
+
+
+def test_launch_coalesces_same_kind():
+    """While the dispatcher is busy with one launch, same-kind launches
+    from other queries accumulate and run back-to-back in ONE dispatch
+    window (coalesced counters move)."""
+    s = QueryScheduler()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        gate.wait(10)
+        return "slow"
+
+    c0 = dict(SCHED_STATS)
+    results = []
+    t0 = threading.Thread(target=lambda: results.append(
+        s.launch("blk", slow)))
+    t0.start()
+    assert started.wait(10)
+    ts = [threading.Thread(target=lambda i=i: results.append(
+        s.launch("blk", lambda: i))) for i in range(3)]
+    for t in ts:
+        t.start()
+    time.sleep(0.2)                      # let them enqueue
+    gate.set()
+    t0.join(10)
+    for t in ts:
+        t.join(10)
+    assert sorted(r for r in results if r != "slow") == [0, 1, 2]
+    assert SCHED_STATS["coalesced_dispatches"] \
+        > c0["coalesced_dispatches"]
+    assert SCHED_STATS["dispatched_launches"] \
+        >= c0["dispatched_launches"] + 4
+
+
+def test_singleflight_dedups_concurrent_fills():
+    s = QueryScheduler()
+    calls = []
+    lk = threading.Lock()
+
+    def build():
+        with lk:
+            calls.append(1)
+        time.sleep(0.3)
+        return "planes"
+
+    c0 = dict(SCHED_STATS)
+    out = []
+    ts = [threading.Thread(target=lambda: out.append(
+        s.singleflight(("fill", 1), build))) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert out == ["planes"] * 8
+    assert len(calls) == 1               # decoded/uploaded ONCE
+    assert SCHED_STATS["singleflight_hits"] \
+        == c0["singleflight_hits"] + 7
+
+
+def test_singleflight_leader_failure_falls_back():
+    s = QueryScheduler()
+    n = {"calls": 0}
+    start = threading.Event()
+
+    def build():
+        n["calls"] += 1
+        if n["calls"] == 1:
+            start.set()
+            time.sleep(0.2)
+            raise RuntimeError("leader died")
+        return "ok"
+
+    out = []
+
+    def leader():
+        with pytest.raises(RuntimeError):
+            s.singleflight("k", build)
+
+    t1 = threading.Thread(target=leader)
+    t1.start()
+    assert start.wait(5)
+    t2 = threading.Thread(
+        target=lambda: out.append(s.singleflight("k", build)))
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    assert out == ["ok"]                 # follower re-ran the fill
+
+
+# ------------------------------------------------- BoundedGate fallback
+
+
+def test_gate_honors_deadline_not_fixed_30s():
+    g = BoundedGate(limit=1, timeout_s=30.0)
+    g.acquire()
+    t0 = time.monotonic()
+    with deadline.bind(0.25, what="query"):
+        with pytest.raises(ErrQueryTimeout):
+            g.acquire()
+    assert time.monotonic() - t0 < 5
+    g.release()
+
+
+def test_gate_kill_ejects_queued():
+    g = BoundedGate(limit=1, timeout_s=30.0)
+    g.acquire()
+    ctx = QueryContext(3, "q", None)
+    err = []
+
+    def wait():
+        try:
+            g.acquire(ctx=ctx)
+        except ErrQueryError as e:
+            err.append(str(e))
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.2)
+    assert ctx.state == "queued"
+    ctx.kill()
+    t.join(10)
+    assert not t.is_alive()
+    assert err and "killed" in err[0]
+    g.release()
+
+
+def test_gate_queue_cap_rejects():
+    g = BoundedGate(limit=1, max_queued=1)
+    g.acquire()
+    t = threading.Thread(target=g.acquire)
+    t.start()                            # fills the one queue slot
+    time.sleep(0.2)
+    with pytest.raises(ResourceExhausted):
+        g.acquire()                      # past the cap: rejected
+    g.release()
+    t.join(10)
+
+
+def test_gate_records_queue_wait_in_ctx():
+    g = BoundedGate(limit=1, timeout_s=5.0)
+    g.acquire()
+    ctx = QueryContext(5, "q", None)
+    got = []
+    t = threading.Thread(target=lambda: got.append(g.acquire(ctx=ctx)))
+    t.start()
+    time.sleep(0.2)
+    g.release()
+    t.join(10)
+    assert ctx.state == "running" and ctx.queue_ns > 0
+    g.release()
+
+
+# ------------------------------------------ executor parity under load
+
+
+MIN = 60 * 10**9
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor
+    from opengemini_tpu.storage import Engine, EngineOptions
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def seed(eng, hosts=5, points=480):
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    rng = np.random.default_rng(17)
+    vals = rng.normal(40.0, 9.0, (hosts, points))
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            lines.append(
+                f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+
+
+def q(ex, text):
+    from opengemini_tpu.query import parse_query
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+# mixed shapes: cfg1-like (no tag grouping), high-cardinality (per-host
+# windows — the block/lattice routes), and a min/max selector shape
+Q_CFG1 = ("SELECT mean(u), count(u) FROM cpu WHERE time >= 0 AND "
+          "time < 4800s GROUP BY time(1m)")
+Q_HIGH = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE time >= 0 "
+          "AND time < 4800s GROUP BY time(1m), host")
+Q_MM = ("SELECT min(u), max(u) FROM cpu WHERE time >= 0 AND "
+        "time < 4800s GROUP BY time(1m), host")
+
+
+def test_concurrent_parity_bit_identical(db, monkeypatch):
+    """Parity suite: N threads × mixed cfg1/high-cardinality queries,
+    scheduler on — every result cell bit-identical to the serial
+    reference (and to the OG_SCHED=0 path)."""
+    eng, ex = db
+    seed(eng)
+    monkeypatch.setenv("OG_SCHED", "0")
+    ref = {t: q(ex, t) for t in (Q_CFG1, Q_HIGH, Q_MM)}
+    monkeypatch.setenv("OG_SCHED", "1")
+    assert {t: q(ex, t) for t in (Q_CFG1, Q_HIGH, Q_MM)} == ref
+
+    errs = []
+
+    def worker(i):
+        try:
+            for t in (Q_CFG1, Q_HIGH, Q_MM, Q_HIGH):
+                if q(ex, t) != ref[t]:
+                    errs.append(f"thread {i}: mismatch on {t!r}")
+        except Exception as e:            # noqa: BLE001
+            errs.append(f"thread {i}: {e!r}")
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not errs, errs[:3]
+
+
+def test_hammer_plan_and_device_cache_fills(db, monkeypatch):
+    """Cold-cache hammer: 8 threads race the SAME query — the scan-plan
+    build single-flights (one plan-cache entry, followers served by the
+    leader) and results stay identical."""
+    eng, ex = db
+    seed(eng)
+    monkeypatch.setenv("OG_SCHED", "0")
+    ref = q(ex, Q_HIGH)
+    # fresh executor: cold plan cache, same engine
+    from opengemini_tpu.query import QueryExecutor
+    ex2 = QueryExecutor(eng)
+    monkeypatch.setenv("OG_SCHED", "1")
+    c0 = dict(SCHED_STATS)
+    errs = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        try:
+            barrier.wait(10)
+            if q(ex2, Q_HIGH) != ref:
+                errs.append("mismatch")
+        except Exception as e:            # noqa: BLE001
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not errs, errs[:3]
+    assert len(ex2._plan_cache) == 1     # built once, shared
+    assert SCHED_STATS["singleflight_leaders"] \
+        > c0["singleflight_leaders"]
+
+
+def test_device_block_cache_hammer():
+    """DeviceBlockCache integrity under parallel fills/reads: byte
+    accounting stays within capacity and get/put never corrupt."""
+    from opengemini_tpu.ops.devicecache import DeviceBlockCache
+    cache = DeviceBlockCache(capacity_bytes=64 * 1024)
+    errs = []
+
+    def worker(i):
+        rng = np.random.default_rng(i)
+        try:
+            for j in range(200):
+                k = ("k", int(rng.integers(0, 32)))
+                arr = np.full(int(rng.integers(1, 512)), i,
+                              dtype=np.int64)
+                cache.put(k, arr)
+                got = cache.get(("k", int(rng.integers(0, 32))))
+                if got is not None and got[0] not in range(8):
+                    errs.append("corrupt value")
+        except Exception as e:            # noqa: BLE001
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs[:3]
+    st = cache.stats()
+    assert 0 <= st["bytes"] <= st["capacity"]
+    assert st["hits"] + st["misses"] > 0
+
+
+def test_transfer_guard_disallow_under_concurrency():
+    """The dense device kernels stay implicit-transfer-free when driven
+    from many threads at once (each thread's own guard is thread-local,
+    matching how request threads run)."""
+    import jax
+    from opengemini_tpu.ops import AggSpec, dense_window_aggregate
+    from opengemini_tpu.ops.segment_agg import dense_device_reduce
+
+    rng = np.random.default_rng(11)
+    spec = AggSpec.of("mean", "min", "max")
+    vals = jax.device_put(rng.normal(50, 10, (32, 16)))
+    valid = jax.device_put(np.ones((32, 16), dtype=bool))
+    limbs = jax.device_put(
+        rng.integers(0, 100, (32, 16, 4)).astype(np.int32))
+    # warm/compile outside any guard
+    jax.block_until_ready(dense_window_aggregate(vals, valid, None,
+                                                 spec))
+    jax.block_until_ready(dense_device_reduce(vals, valid, limbs, spec,
+                                              True))
+    errs = []
+
+    def worker():
+        try:
+            with jax.transfer_guard("disallow"):
+                for _ in range(5):
+                    dense_window_aggregate(vals, valid, None, spec)
+                    dense_device_reduce(vals, valid, limbs, spec, True)
+        except Exception as e:            # noqa: BLE001
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs[:3]
+
+
+# --------------------------------------------------- cost estimation
+
+
+def test_estimate_cost_orders_heavy_above_dashboard(db):
+    from opengemini_tpu.query import parse_query
+    eng, ex = db
+    seed(eng)
+    dash = estimate_request_cost(ex, parse_query(Q_CFG1), "db0")
+    heavy = estimate_request_cost(ex, parse_query(Q_HIGH), "db0")
+    assert heavy.cells > dash.cells
+    assert heavy.pull_bytes > dash.pull_bytes > 0
+    assert heavy.norm > dash.norm
+    # non-select requests cost nothing
+    none = estimate_request_cost(ex, parse_query("SHOW DATABASES"),
+                                 "db0")
+    assert none.cells == 0
+
+
+# ------------------------------------------------------- HTTP serving
+
+
+@pytest.fixture
+def server(db, monkeypatch):
+    from opengemini_tpu.http.server import HttpServer
+    from opengemini_tpu.utils.config import Config
+    eng, ex = db
+    seed(eng, hosts=3, points=120)
+    cfg = Config()
+    cfg.data.max_concurrent_queries = 1
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30)
+
+
+def _query(srv, qtext, db="db0"):
+    return _get(srv, "/query?db=" + db + "&q="
+                + urllib.parse.quote(qtext))
+
+
+def test_http_queued_query_visible_and_killable(server):
+    """Satellite: a queued query registers at enqueue (SHOW QUERIES
+    status "queued") and KILL QUERY ejects it before it wins a slot."""
+    sched = get_scheduler()
+    hold = sched.admit(cost=QueryCost(1))       # occupy the one slot
+    out = {}
+
+    def bg():
+        try:
+            out["body"] = json.loads(_query(server, Q_CFG1).read())
+        except Exception as e:                  # noqa: BLE001
+            out["err"] = repr(e)
+
+    t = threading.Thread(target=bg)
+    t.start()
+    qid = None
+    for _ in range(100):                        # ≤5s: find it queued
+        queued = [c for c in server.query_manager.list()
+                  if c.state == "queued"]
+        if queued:
+            qid = queued[0].qid
+            break
+        time.sleep(0.05)
+    assert qid is not None, "queued query never showed up"
+    assert server.query_manager.kill(qid)
+    t.join(15)
+    assert not t.is_alive()
+    hold.release()
+    assert "body" in out, out
+    err = out["body"]["results"][0].get("error", "")
+    assert "killed" in err
+
+
+def test_http_shed_429_with_retry_after(server):
+    sched = get_scheduler()
+    sched.configure(max_queued=0)
+    hold = sched.admit(cost=QueryCost(1))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _query(server, Q_CFG1)
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    body = json.loads(ei.value.read())
+    assert body["retry_after"] >= 1
+    hold.release()
+    sched.configure(max_queued=64)
+    # slot free again: the same query serves
+    body = json.loads(_query(server, Q_CFG1).read())
+    assert "series" in body["results"][0]
+
+
+def test_http_scheduler_pause_503_and_ctrl(server):
+    body = json.loads(_get(
+        server, "/debug/ctrl?mod=scheduler&action=pause").read())
+    assert body["scheduler"]["paused"] is True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _query(server, Q_CFG1)
+    assert ei.value.code == 503
+    assert "Retry-After" in ei.value.headers
+    body = json.loads(_get(
+        server, "/debug/ctrl?mod=scheduler&action=resume").read())
+    assert body["scheduler"]["paused"] is False
+    assert "admitted" in body["scheduler"]
+    ok = json.loads(_query(server, Q_CFG1).read())
+    assert "series" in ok["results"][0]
+
+
+def test_http_sched_off_still_serves(server, monkeypatch):
+    monkeypatch.setenv("OG_SCHED", "0")
+    body = json.loads(_query(server, Q_CFG1).read())
+    assert "series" in body["results"][0]
+
+
+def test_metrics_and_debug_vars_export_scheduler(server):
+    body = json.loads(_query(server, Q_CFG1).read())
+    assert "series" in body["results"][0]
+    text = _get(server, "/metrics").read().decode()
+    assert "opengemini_scheduler_admitted" in text
+    assert "opengemini_scheduler_singleflight_hits" in text
+    dv = json.loads(_get(server, "/debug/vars").read())
+    assert "admitted" in dv["scheduler"]
+    assert "coalesced_dispatches" in dv["scheduler"]
+
+
+def test_show_queries_reports_phases(db):
+    """SHOW QUERIES carries the serving-phase columns; the in-flight
+    SHOW itself reports status running."""
+    eng, ex = db
+    seed(eng, hosts=2, points=60)
+    from opengemini_tpu.query import parse_query
+    from opengemini_tpu.query.manager import QueryManager
+    from opengemini_tpu.query import QueryExecutor
+    qm = QueryManager()
+    ex2 = QueryExecutor(eng, query_manager=qm)
+    ctx = qm.attach("SHOW QUERIES", "db0")
+    (stmt,) = parse_query("SHOW QUERIES")
+    res = ex2.execute(stmt, "db0", ctx=ctx)
+    qm.detach(ctx)
+    s = res["series"][0]
+    assert s["columns"] == ["qid", "query", "database", "duration",
+                            "status", "queue_ms", "device_ms"]
+    row = s["values"][0]
+    assert row[4] == "running" and row[5] >= 0 and row[6] >= 0
