@@ -749,7 +749,19 @@ def smoke_phase() -> dict:
                    ("finalize-serial", {"OG_PIPELINE_DEPTH": "4",
                                         "OG_FINALIZE_WORKERS": "0"}),
                    ("finalize-pool", {"OG_PIPELINE_DEPTH": "4",
-                                      "OG_FINALIZE_WORKERS": "8"})]
+                                      "OG_FINALIZE_WORKERS": "8"}),
+                   # D2H diet gate: the device finalize epilogue +
+                   # op-aware plane pruning (default on in the configs
+                   # above) vs the byte-identical legacy transport
+                   # (OG_DEVICE_FINALIZE=0) — every cell of every
+                   # shape, streamed AND single-barrier, including the
+                   # scaled-down 1m heavy shape and (second sweep) the
+                   # forced lattice route
+                   ("devfinal-off", {"OG_PIPELINE_DEPTH": "4",
+                                     "OG_DEVICE_FINALIZE": "0"}),
+                   ("devfinal-off-barrier",
+                    {"OG_PIPELINE_DEPTH": "0",
+                     "OG_DEVICE_FINALIZE": "0"})]
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires
         E.BLOCK_MIN_RATIO = 0

@@ -315,17 +315,30 @@ def plane_layout(want: tuple, K: int) -> list[tuple[str, int]]:
     return planes
 
 
+def pruned_layout(want: tuple, K: int) -> list[tuple[str, int]]:
+    """plane_layout minus the min/max VALUE planes — the op-aware diet
+    of the legacy f64 transport (the executor's fold only ever reads
+    the row-INDEX planes; exact values gather host-side), applied when
+    OG_DEVICE_FINALIZE is on. The full layout stays the =0 wire
+    format, byte for byte."""
+    return [(name, n) for name, n in plane_layout(want, K)
+            if name not in ("min", "max")]
+
+
 def unpack_planes(packed: np.ndarray, want: tuple, K: int,
-                  k0: int = 0, K_full: int | None = None) -> dict:
+                  k0: int = 0, K_full: int | None = None,
+                  pruned: bool = False) -> dict:
     """Host-side view of the pulled packed array as the bo dict the
     executor folds (exact dtype restoration: counts/limbs are integer-
     valued f64 < 2^53). K is the resident (active) plane count; the
-    limbs re-expand to K_full with zero dead planes."""
+    limbs re-expand to K_full with zero dead planes. ``pruned`` reads
+    the op-aware pruned_layout (no min/max value planes)."""
     if K_full is None:
         K_full = exactsum.K_LIMBS
     out = {}
     i = 0
-    for name, n in plane_layout(want, K):
+    layout = pruned_layout(want, K) if pruned else plane_layout(want, K)
+    for name, n in layout:
         pl = packed[i:i + n]
         i += n
         if name == "count":
@@ -638,11 +651,46 @@ def pack_eligible(want: tuple, n_rows: int, flat_n: int) -> bool:
             and not (idx_wanted and flat_n >= _U32M))
 
 
-def pack_grid(out, want: tuple, K: int, n_rows: int, flat_n: int):
+def _prune_kernel(want: tuple, K: int):
+    """jit row-select dropping the min/max VALUE planes from a legacy
+    f64 grid before the pull (pruned_layout) — the host fold reads only
+    the index planes, so shipping the values was pure D2H waste."""
+    key = ("prune", want, K)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    # derive the kept rows FROM pruned_layout so the device row-select
+    # and the host unpack_planes(pruned=True) can never skew
+    kept = {name for name, _n in pruned_layout(want, K)}
+    keep: list[int] = []
+    i = 0
+    for name, n in plane_layout(want, K):
+        if name in kept:
+            keep.extend(range(i, i + n))
+        i += n
+    idx = np.asarray(keep, dtype=np.int32)
+
+    @jax.jit
+    def _p(planes):
+        return jnp.take(planes, idx, axis=0)
+
+    _JITTED[key] = _p
+    return _p
+
+
+def pack_grid(out, want: tuple, K: int, n_rows: int, flat_n: int,
+              prune_legacy: bool = False):
     """Device-side packed transport of a final plane grid, or the
     legacy f64 grid when out of the packed encoding's ranges (see
-    pack_eligible). Returns ("p", u32, bits[, f64]) or ("l", planes)."""
+    pack_eligible). Returns ("p", u32, bits[, f64]), ("l", planes), or
+    — when ``prune_legacy`` (OG_DEVICE_FINALIZE on) and the fallback
+    would carry dead min/max value planes — ("lp", pruned_planes)."""
     if not pack_eligible(want, n_rows, flat_n):
+        if prune_legacy and (("min" in want) or ("max" in want)):
+            return ("lp", _prune_kernel(want, K)(out))
         return ("l", out)
     return ("p",) + tuple(_pack_kernel(want, K)(out))
 
@@ -688,10 +736,7 @@ def unpack_packed(u32: np.ndarray, bits: np.ndarray, want: tuple,
             full[:, k0:k0 + K] = digits.T.astype(np.float64)
         i += 1 + Wn
         out["limbs"] = full
-        nb = bits.shape[0]
-        lanes = ((bits[:, None].astype(np.uint32)
-                  >> np.arange(32, dtype=np.uint32)[None, :]) & 1)
-        out["bad"] = lanes.reshape(nb * 32)[:S].astype(bool)
+        out["bad"] = expand_bits(bits, S)
     if "sumsq" in want:
         out["sumsq"] = np.asarray(f64_extra)[0]
     for name in ("min", "max"):
@@ -701,6 +746,244 @@ def unpack_packed(u32: np.ndarray, bits: np.ndarray, want: tuple,
             out[f"{name}_idx"] = np.where(p == IDX_U32_SENTINEL,
                                           I64MAX, p)
     return out
+
+
+# --------------------------------------- on-device finalize epilogue
+
+_REAL_F64: bool | None = None
+
+
+def _backend_real_f64() -> bool:
+    """Does the default backend compute f64 natively? TPUs emulate f64
+    as float32 pairs (see the module header): the finalize cascade's
+    TwoSum error terms — and therefore its own hazard test — drift
+    there, so the epilogue must not trust them. ALLOWLIST of known
+    real-f64 platforms, failing CLOSED on anything unrecognized (a
+    TPU-tunnel PJRT plugin may report its own platform name, not
+    "tpu"). Probed once."""
+    global _REAL_F64
+    if _REAL_F64 is None:
+        try:
+            import jax
+            _REAL_F64 = jax.devices()[0].platform in (
+                "cpu", "gpu", "cuda", "rocm")
+        except Exception:
+            _REAL_F64 = False
+    return _REAL_F64
+
+
+def plane_diet_on() -> bool:
+    """Gate for the op-aware plane PRUNING half of the D2H diet
+    (per-field want sets, pruned legacy transport): pure plane
+    selection, bit-identical on ANY backend — so unlike the finalize
+    epilogue below it needs no real-f64 gate and stays on for TPUs.
+    OG_DEVICE_FINALIZE=0 switches it off together with the epilogue
+    (the byte-identical legacy wire form)."""
+    return os.environ.get("OG_DEVICE_FINALIZE", "1") != "0"
+
+
+def device_finalize_on() -> bool:
+    """Gate for the device finalize epilogue — the f64-SENSITIVE half
+    of the D2H diet (OG_DEVICE_FINALIZE, default on; 0 = byte-identical
+    legacy transport). Read dynamically so perf_smoke can flip it per
+    query.
+
+    On f32-pair-emulated-f64 backends (TPU) the epilogue auto-gates
+    OFF regardless of the default: finalize_exact_traced needs
+    correctly-rounded IEEE f64 and its hazard flag is computed in the
+    same arithmetic, so drifting cells would not even be repaired.
+    ``OG_DEVICE_FINALIZE=force`` overrides the backend gate for
+    experimentation on hardware whose f64 emulation has been verified.
+
+    What it buys (the "reduce before you move" rule — SURVEY §2-3's
+    series_agg_reducer ships FINAL values up the cursor stack): a
+    terminal query's device-merged (field, scale) grid converts to
+    answer-sized planes ON DEVICE — exact limb→f64 reconstruction,
+    mean = sum/count, count — so one f64 plane per selected op crosses
+    the slow D2H link instead of the packed limb/count grid (~8-12
+    B/cell vs ~20 B/cell for a mean at K=4 active planes). Cells the
+    device cannot PROVE correctly rounded (the finalize hazard test)
+    plus limb-residue cells are flagged in an on-device bitmask and
+    pulled sparsely for host repair. The cluster/merge wire format is
+    untouched — only terminal partials (no merge pending) finalize."""
+    v = os.environ.get("OG_DEVICE_FINALIZE", "1")
+    if v == "0":
+        return False
+    if v == "force":
+        return True
+    return _backend_real_f64()
+
+
+def finalize_fops(ops: set) -> tuple | None:
+    """Transport recipe (dev_mean, ship_sum, need_count) for a field's
+    SELECTED ops, or None when the op set can't finalize on device
+    (extrema need the per-file index+host-gather path; sumsq/raw ops
+    never reach the merged block grid).
+
+    - mean-only queries divide ON DEVICE (one f64 mean plane + a
+      presence bitmask — the heavy dashboard shape's 2.5× diet);
+    - once real counts must ship anyway ("count" selected, or mean
+      next to sum), the division stays on host over the answer-sized
+      grid (same bytes, one shared code path with the legacy fold)."""
+    if not ops or not ops <= {"count", "sum", "mean"}:
+        return None
+    dev_mean = "mean" in ops and not ({"sum", "count"} & ops)
+    ship_sum = ("sum" in ops) or ("mean" in ops and not dev_mean)
+    need_count = ("count" in ops) or ("mean" in ops and not dev_mean)
+    return (dev_mean, ship_sum, need_count)
+
+
+def _bits_of(b, S: int):
+    """Traced 32-cells/word bitpack of a bool (S,) vector (same lane
+    order as the packed transport's bad bitmask)."""
+    import jax.numpy as jnp
+    x = b.astype(jnp.uint32)
+    pad = (-S) % 32
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros(pad, dtype=jnp.uint32)])
+    return (x.reshape(-1, 32)
+            << jnp.arange(32, dtype=jnp.uint32)[None, :]
+            ).sum(axis=1, dtype=jnp.uint32)
+
+
+def expand_bits(bits: np.ndarray, S: int) -> np.ndarray:
+    """Host inverse of _bits_of → bool (S,)."""
+    lanes = ((np.asarray(bits)[:, None].astype(np.uint32)
+              >> np.arange(32, dtype=np.uint32)[None, :]) & 1)
+    return lanes.reshape(-1)[:S].astype(bool)
+
+
+def _finalize_kernel(want: tuple, K: int, k0: int,
+                     dev_mean: bool, ship_sum: bool, need_count: bool):
+    """jit finalize epilogue: the device-merged f64 plane grid → the
+    answer-sized transport (u32 count-or-presence, hazard/residue flag
+    bitmask, f64 answer planes). The sum reconstruction is
+    exactsum.finalize_exact_traced — the SAME IEEE sequence as the
+    host fast path, so non-flagged cells are bit-identical by
+    construction; flagged cells (hazard ∪ limb-residue) are repaired
+    host-side from a sparse pull (unpack_finalized). The limb scale
+    enters as the traced ``scale_lo`` operand, so one compiled kernel
+    serves every E."""
+    key = ("fin", want, K, k0, dev_mean, ship_sum, need_count)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    with_sum = ("sum" in want) and (ship_sum or dev_mean)
+
+    @jax.jit
+    def _f(planes, scale_lo):
+        S = planes.shape[1]
+        cnt = planes[0]
+        u32 = []
+        if need_count:
+            u32.append((cnt.astype(jnp.int64) & _U32M)
+                       .astype(jnp.uint32))
+        pres = None if need_count else _bits_of(cnt > 0, S)
+        flag = None
+        f64 = []
+        if with_sum:
+            full = []
+            for j in range(exactsum.K_LIMBS):
+                full.append(planes[1 + (j - k0)].astype(jnp.int64)
+                            if k0 <= j < k0 + K
+                            else jnp.zeros(S, dtype=jnp.int64))
+            out, hazard = exactsum.finalize_exact_traced(full,
+                                                         scale_lo)
+            bad = planes[1 + K] > 0
+            flag = _bits_of(hazard | bad, S)
+            if ship_sum:
+                f64.append(out)
+            if dev_mean:
+                # same operand values as the host finalize_moment
+                # (sum / max(count, 1)) — identical IEEE division
+                f64.append(out / jnp.maximum(cnt, 1.0))
+        return (jnp.stack(u32) if u32 else None, pres, flag,
+                jnp.stack(f64) if f64 else None)
+
+    _JITTED[key] = _f
+    return _f
+
+
+def finalize_grid(out, want: tuple, ops: set, K: int, k0: int, E: int,
+                  n_rows: int):
+    """Device finalize epilogue over a device-merged plane grid.
+    Returns (("f", u32, pres_bits, flag_bits, f64), recipe) — the
+    answer-sized transport plus the (dev_mean, ship_sum, need_count)
+    recipe the kernel packed with, which the caller MUST thread to
+    unpack_finalized (one derivation, no wire-format skew) — or None
+    when the op set is ineligible or the count range guard trips (same
+    n_rows < 2^28 bound as the packed transport's u32 counts). Caller
+    keeps ``out`` resident for the sparse repair pull."""
+    rec = finalize_fops(ops)
+    if rec is None or n_rows >= (1 << 28):
+        return None
+    dev_mean, ship_sum, need_count = rec
+    fn = _finalize_kernel(want, K, k0, dev_mean, ship_sum, need_count)
+    from . import devstats
+    devstats.bump("kernel_launches")
+    scale_lo = np.float64(2.0 ** float(E - exactsum.SPAN_BITS))
+    return (("f",) + tuple(fn(out, scale_lo)), rec)
+
+
+def unpack_finalized(arrs, planes_dev, K: int, k0: int,
+                     E: int, dev_mean: bool, ship_sum: bool,
+                     need_count: bool, S: int) -> dict:
+    """Pulled finalized transport → the bo dict the executor folds:
+    {"final": True, "count": int64 counts-or-presence[, "sum" f64
+    exact][, "mean" f64]}. The transport recipe (dev_mean/ship_sum/
+    need_count) fully determines the decode — no want tuple involved.
+    Flagged cells (finalize hazard ∪ limb residue) repair HERE: their
+    limb/count rows gather from the still-resident pre-finalize grid
+    in ONE sparse pull and re-finalize through the host finalize_exact
+    (big-int backstop included) — the only extra transfer the epilogue
+    ever makes; its byte count returns to the caller via the
+    "_repair_nbytes" entry for per-query accounting."""
+    import time as _time
+    u32, pres, flag, f64 = arrs
+    bo: dict = {"final": True}
+    if need_count:
+        bo["count"] = np.asarray(u32[0]).astype(np.int64)
+    else:
+        bo["count"] = expand_bits(pres, S).astype(np.int64)
+    sum_p = mean_p = None
+    if f64 is not None:
+        fa = np.asarray(f64)
+        i = 0
+        if ship_sum:
+            sum_p = np.array(fa[i], dtype=np.float64)
+            i += 1
+        if dev_mean:
+            mean_p = np.array(fa[i], dtype=np.float64)
+    if flag is not None:
+        flagged = np.nonzero(expand_bits(flag, S))[0]
+        if len(flagged):
+            from . import devstats
+            t0 = _time.perf_counter_ns()
+            sub = np.asarray(planes_dev[:, flagged])   # sparse repair
+            devstats.bump("d2h_bytes", int(sub.nbytes))
+            devstats.bump("d2h_pulls")
+            # the per-transport (d2h_bytes_finalized) share is booked
+            # by the caller from _repair_nbytes — bumping it here too
+            # would double-count the repair
+            bo["_repair_nbytes"] = int(sub.nbytes)
+            full = np.zeros((len(flagged), exactsum.K_LIMBS))
+            full[:, k0:k0 + K] = sub[1:1 + K].T
+            sums = exactsum.finalize_exact(full, E)
+            if sum_p is not None:
+                sum_p[flagged] = sums
+            if mean_p is not None:
+                cnt_f = sub[0].astype(np.int64)
+                mean_p[flagged] = sums / np.maximum(cnt_f, 1)
+            devstats.bump_phase("device_finalize",
+                                _time.perf_counter_ns() - t0)
+    if sum_p is not None:
+        bo["sum"] = sum_p
+    if mean_p is not None:
+        bo["mean"] = mean_p
+    return bo
 
 
 def _pairwise_combine(want: tuple, K: int):

@@ -26,11 +26,24 @@ DEVICE_STATS: dict = {
     "slab_bytes": 0,         # bytes of stacks uploaded at build time
     "stream_launches": 0,    # launches routed through the pipeline
     "stream_queries": 0,     # queries that used the streaming path
+    # per-transport D2H split of the block-path grid pulls, so
+    # pull_gbps/bytes stay attributable for EVERY transport form:
+    # packed uint32 | legacy f64 planes (incl. the op-pruned variant)
+    # | finalized answer planes (+ their sparse repair pulls) |
+    # window lattices. pull_bytes_saved = bytes the packed/pruned/
+    # finalized transports avoided vs the full legacy f64 plane grid.
+    "d2h_bytes_packed": 0,
+    "d2h_bytes_legacy": 0,
+    "d2h_bytes_finalized": 0,
+    "d2h_bytes_lattice": 0,
+    "pull_bytes_saved": 0,
     # gauges (last completed query, not cumulative): the numbers an
     # operator needs to judge whether the pull or the kernel is the
     # current wall without attaching EXPLAIN ANALYZE
     "last_query_d2h_bytes": 0,
     "last_query_pull_ms": 0,
+    "last_query_planes": 0,       # transport planes pulled (block path)
+    "last_query_pull_saved": 0,   # bytes saved vs legacy f64 planes
 }
 
 # cumulative wall time per executor phase (ns), across ALL queries —
@@ -43,6 +56,9 @@ QUERY_PHASE_NS: dict = {
     "reader_scan_ns": 0,
     "device_agg_ns": 0,
     "device_pull_ns": 0,
+    # finalize epilogue: the on-device answer-plane conversion launches
+    # plus any host-side sparse repairs (OG_DEVICE_FINALIZE)
+    "device_finalize_ns": 0,
     "grid_fold_ns": 0,
     # merge is NESTED inside finalize (exchange-merge of partials);
     # serialize is the HTTP-layer streaming JSON/CSV emit, outside the

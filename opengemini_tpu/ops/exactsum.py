@@ -299,6 +299,57 @@ def finalize_exact(limbs: np.ndarray, E: int) -> np.ndarray:
     return out.reshape(limbs.shape[:-1])
 
 
+def finalize_exact_traced(limb_planes: list, scale_lo):
+    """Traceable (jnp) twin of finalize_exact's vectorized fast path —
+    the device half of the finalize epilogue (ops/blockagg.py
+    ``_finalize_kernel``). ``limb_planes`` is a list of K_LIMBS int64
+    (S,) arrays (dead planes as zeros); ``scale_lo`` is 2^(E −
+    SPAN_BITS) as an f64 scalar — passed as a TRACED operand so one
+    compiled kernel serves every limb scale (all the scale products
+    below are power-of-two multiplies: exact whether constant-folded
+    or computed on device). Returns ``(out, hazard)``:
+
+    - ``out`` is the SAME IEEE f64 sequence as the host fast path
+      (carry-normalize → three exact components → full-Knuth TwoSum
+      cascade), so on a real-f64 backend every non-hazard cell is
+      bit-identical to finalize_exact by construction;
+    - ``hazard`` mirrors the host's suspicion test (|top| ≥ 2^17 or a
+      rounded error track) — flagged cells must be repaired on HOST
+      (the big-int backstop); the caller pulls their limb rows
+      sparsely. On f32-pair-emulated-f64 backends the fast path itself
+      drifts, which is why the epilogue stays host-gated there (see
+      blockagg.device_finalize_on)."""
+    import jax.numpy as jnp
+    R = _RADIX
+    d = [p.astype(jnp.int64) for p in limb_planes]
+    for k in range(K_LIMBS - 1, 0, -1):
+        c = d[k] >> LIMB_BITS              # arithmetic shift = floor
+        d[k] = d[k] - (c << LIMB_BITS)
+        d[k - 1] = d[k - 1] + c
+    top = d[0] >> LIMB_BITS
+    d0 = d[0] - (top << LIMB_BITS)
+    # hazard on `top` BEFORE packing, exactly as the host path: an
+    # int64 wraparound in p0 can't hide under the threshold
+    p0 = ((top * R + d0) * R + d[1]).astype(jnp.float64)
+    p1 = (d[2] * R + d[3]).astype(jnp.float64)
+    p2 = (d[4] * R + d[5]).astype(jnp.float64)
+    t0 = p0 * (scale_lo * float(1 << 72))
+    t1 = p1 * (scale_lo * float(1 << 36))
+    t2 = p2 * scale_lo
+
+    def two_sum(a, b):
+        s = a + b
+        bv = s - a
+        return s, (a - (s - bv)) + (b - bv)
+
+    r1, e1 = two_sum(t0, t1)
+    r2, e2 = two_sum(r1, t2)
+    err, ee = two_sum(e1, e2)
+    out = r2 + err
+    hazard = (jnp.abs(top) >= (1 << 17)) | (ee != 0.0)
+    return out, hazard
+
+
 def _bigint_cell(flat: np.ndarray, i: int, scale_lo: float) -> float:
     """Exact big-int evaluation of one cell's limb row — the shared
     hazard backstop for the native and numpy finalize paths (Python

@@ -192,8 +192,13 @@ class StreamingPipeline:
         self.last_ns: int | None = None     # last pull/fold end
         self.bytes = 0
         self.leaves = 0
+        # per-transport D2H split (op-aware plane diet accounting):
+        # the executor labels each submit (packed/legacy/finalized/
+        # lattice/dense) so the pull telemetry stays attributable when
+        # a query mixes transport forms
+        self.bytes_by: dict = {}
 
-    def submit(self, key, tree, post=None) -> None:
+    def submit(self, key, tree, post=None, transport=None) -> None:
         self._sem.acquire()
         if self.gate is not None:
             try:
@@ -202,7 +207,7 @@ class StreamingPipeline:
                 self._sem.release()
                 raise
         try:
-            fut = _pull_pool().submit(self._run, tree, post)
+            fut = _pull_pool().submit(self._run, tree, post, transport)
         except BaseException:
             if self.gate is not None:
                 self.gate.release()
@@ -212,7 +217,7 @@ class StreamingPipeline:
             self.launches += 1
             self._futs[key] = fut
 
-    def _run(self, tree, post):
+    def _run(self, tree, post, transport=None):
         import jax
         try:
             t0 = _now_ns()
@@ -234,6 +239,10 @@ class StreamingPipeline:
                     self.last_ns = t1
                 self.bytes += st.get("bytes", 0)
                 self.leaves += st.get("leaves", 0)
+                if transport is not None:
+                    self.bytes_by[transport] = (
+                        self.bytes_by.get(transport, 0)
+                        + st.get("bytes", 0))
             return out
         finally:
             if self.gate is not None:

@@ -67,28 +67,79 @@ MAX_WINDOWS = 100_000
 from collections import namedtuple as _nt
 _BlockMeta = _nt("_BlockMeta", "E k0 ka")
 
+# device-finalized entry (OG_DEVICE_FINALIZE): same identity fields
+# plus the transport recipe and the still-resident pre-finalize plane
+# grid the sparse repair pull gathers from. S = result cells (G·W).
+_FinMeta = _nt("_FinMeta",
+               "E k0 ka dev_mean ship_sum need_count S planes_dev")
+
 
 def _ka_k0_of(sl):
-    if isinstance(sl, _BlockMeta):
+    if hasattr(sl, "ka"):                 # _BlockMeta / _FinMeta
         return sl.ka, sl.k0
     return sl[0].limbs.shape[-1], sl[0].k0
 
 
-def _unpack_block_out(fmt: str, arrs, stack, want: tuple) -> dict:
-    """Packed block-path transport → the host bo dict the executor
-    folds (exact dtype restoration: counts/limbs are integer-valued
-    f64 far below 2^53). Shared by the single-barrier path and the
-    streaming pipeline's background unpack workers."""
+def _unpack_block_out(fmt: str, arrs, stack, want: tuple,
+                      tx: dict | None = None,
+                      want_legacy: tuple | None = None) -> dict:
+    """Block-path transport → the host bo dict the executor folds
+    (exact dtype restoration: counts/limbs are integer-valued f64 far
+    below 2^53). Shared by the single-barrier path and the streaming
+    pipeline's background unpack workers, for every transport form:
+    "p" packed uint32, "l" legacy f64 planes, "lp" op-pruned legacy,
+    "f" device-finalized answer planes.
+
+    Also the per-transport accounting funnel (devstats
+    d2h_bytes_{packed,legacy,finalized} + pull_bytes_saved vs the full
+    legacy f64 plane grid); ``tx`` (optional per-query dict, caller-
+    locked via its "lock" entry) accumulates planes/saved for the
+    last_query_* gauges."""
     from ..ops import blockagg as _bagg
+    from ..ops import devstats as _ds
     from ..ops.exactsum import K_LIMBS as _KLu
     ka, k0 = _ka_k0_of(stack)
-    if fmt == "p":
+    repair_b = 0
+    if fmt == "f":
+        bo = _bagg.unpack_finalized(arrs, stack.planes_dev, ka,
+                                    k0, stack.E, stack.dev_mean,
+                                    stack.ship_sum, stack.need_count,
+                                    stack.S)
+        repair_b = bo.pop("_repair_nbytes", 0)
+    elif fmt == "p":
         f64x = np.asarray(arrs[2]) if len(arrs) > 2 else None
-        return _bagg.unpack_packed(np.asarray(arrs[0]),
-                                   np.asarray(arrs[1]), want, ka, k0,
-                                   _KLu, f64x)
-    return _bagg.unpack_planes(np.asarray(arrs[0]), want, ka, k0,
-                               _KLu)
+        bo = _bagg.unpack_packed(np.asarray(arrs[0]),
+                                 np.asarray(arrs[1]), want, ka, k0,
+                                 _KLu, f64x)
+    else:
+        bo = _bagg.unpack_planes(np.asarray(arrs[0]), want, ka, k0,
+                                 _KLu, pruned=(fmt == "lp"))
+    got_b = repair_b          # sparse repair rides this transport too
+    n_planes = 0
+    for a in (arrs if isinstance(arrs, (tuple, list)) else (arrs,)):
+        if a is None:
+            continue
+        a = np.asarray(a)
+        got_b += int(a.nbytes)
+        n_planes += int(a.shape[0]) if a.ndim == 2 else 0
+    S = int(np.asarray(bo["count"]).shape[0])
+    # savings baseline = what OG_DEVICE_FINALIZE=0 would have shipped:
+    # the QUERY-WIDE legacy f64 plane grid, not the already-pruned
+    # per-field layout (else this PR's own diet never shows up in the
+    # counter built to measure it)
+    legacy_b = sum(n for _nm, n in
+                   _bagg.plane_layout(want_legacy or want, ka)) * 8 * S
+    saved = max(0, legacy_b - got_b)
+    _ds.bump({"f": "d2h_bytes_finalized", "p": "d2h_bytes_packed"}
+             .get(fmt, "d2h_bytes_legacy"), got_b)
+    if saved:
+        _ds.bump("pull_bytes_saved", saved)
+    if tx is not None:
+        with tx["lock"]:
+            tx["planes"] = tx.get("planes", 0) + n_planes
+            tx["saved"] = tx.get("saved", 0) + saved
+            tx["repair"] = tx.get("repair", 0) + repair_b
+    return bo
 
 
 def _sched_launch(kind: str, fn):
@@ -1317,8 +1368,13 @@ class QueryExecutor:
                 stmt, db, mst, cs, cond, tag_keys, inc_query_id, iter_id,
                 ctx=ctx, span=span)
         else:
+            # terminal=True: this partial goes straight to the local
+            # finalize — no cluster/incremental merge pending — so the
+            # block path may finalize grids ON DEVICE and ship answer
+            # planes instead of the mergeable limb wire format
             partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
-                                       ctx=ctx, span=span, plan=hints)
+                                       ctx=ctx, span=span, plan=hints,
+                                       terminal=True)
         from ..ops import devstats as _dstat
         _t_fin0 = _now_ns()
         if span is not None:
@@ -1375,9 +1431,17 @@ class QueryExecutor:
 
     def partial_agg(self, stmt, db, mst, cs: ClassifiedSelect, cond,
                     tag_keys, ctx=None, span=None,
-                    plan: dict | None = None) -> dict | None:
+                    plan: dict | None = None,
+                    terminal: bool = False) -> dict | None:
         """Store-side partial aggregation: scan this engine's shards and
         reduce on device into per-(group, window) mergeable states.
+
+        ``terminal`` marks a partial that feeds the LOCAL finalize with
+        no merge pending (single node, non-incremental): only then may
+        the block path run the device finalize epilogue
+        (OG_DEVICE_FINALIZE) and ship answer-sized planes — store-RPC,
+        mesh, and incremental callers keep the mergeable limb wire
+        format untouched.
 
         This is the pushed-down partial-agg stage of the reference's
         distributed plan (AggPushdownToReaderRule engine/executor/
@@ -1453,6 +1517,10 @@ class QueryExecutor:
         # contaminate under concurrent queries; ops-internal pulls like
         # the multi-field stacked fetch still only show in the globals)
         _q_pull: dict = {}
+        # per-query transport accounting (planes pulled / bytes saved
+        # vs the legacy f64 plane grid) — written by the background
+        # unpack workers, hence its own lock
+        _q_tx: dict = {"lock": __import__("threading").Lock()}
 
         if getattr(db_obj, "is_columnstore", lambda m: False)(mst):
             # column-store path: tags are columns; fragments pruned by
@@ -1611,6 +1679,44 @@ class QueryExecutor:
                                if a.func in ("top", "bottom")}
                             | {a.field for a in aggs if a.needs_sketch})
 
+        # block-path kernel states, query-wide (the legacy wire form)
+        want = tuple(k for k in ("sum", "sumsq", "min", "max")
+                     if getattr(spec, k))
+        # op-aware plane diet (OG_DEVICE_FINALIZE): each field
+        # computes/packs/pulls ONLY the states its own selected ops
+        # consume, instead of the query-wide spec union — a count-only
+        # field drops the limb planes entirely, a mean field never
+        # carries another field's idx planes. Pure plane selection
+        # (backend-independent): gated by plane_diet_on so =0 stays
+        # the byte-identical legacy transport, while the f64-sensitive
+        # finalize epilogue has its own backend-aware gate below.
+        from ..ops.blockagg import plane_diet_on as _pdo
+        fin_gate = _pdo()
+        field_ops: dict[str, set] = {}
+        for a in aggs:
+            if a.field:
+                field_ops.setdefault(a.field, set()).add(a.func)
+        # kernel states per SELECTED op (unlike spec_names_for, count
+        # and mean don't drag the whole sum bundle along)
+        _OPS_STATES = {"count": (), "sum": ("sum",), "mean": ("sum",),
+                       "min": ("min",), "max": ("max",),
+                       "spread": ("min", "max")}
+        _want_cache: dict = {}
+
+        def want_of(fname):
+            if not fin_gate:
+                return want
+            got = _want_cache.get(fname)
+            if got is None:
+                names: set = set()
+                for op in field_ops.get(fname, ()):
+                    st_ = _OPS_STATES.get(op)
+                    names.update(want if st_ is None else st_)
+                got = _want_cache[fname] = tuple(
+                    k for k in ("sum", "sumsq", "min", "max")
+                    if k in names)
+            return got
+
         # ------------------------------------------------ block path
         # HBM-resident segment stacks (ops/blockagg.py): whole files
         # reduce ON DEVICE for any window/range/grouping; eligible when
@@ -1663,8 +1769,6 @@ class QueryExecutor:
                         ent[1][sp.sid] = sp.gid
                         ent[2].append((sp, src))
                         ent[3] += src.meta.rows
-                want = tuple(k for k in ("sum", "sumsq", "min", "max")
-                             if getattr(spec, k))
                 # big-grid packed regime (> legacy cell cap): the pull
                 # is ONE device-combined grid for all files (value-free
                 # states merge on device), so the economics gate on
@@ -1703,10 +1807,10 @@ class QueryExecutor:
                         continue
                     if G * W > 250000 and not all(
                             blockagg.pack_eligible(
-                                want, nrows,
+                                want_of(f2), nrows,
                                 (sl[-1].block0 + sl[-1].n_blocks)
                                 * sl[0].seg_rows)
-                            for sl in stacks.values()):
+                            for f2, sl in stacks.items()):
                         # above the legacy cap the pull must be the
                         # packed transport; ranges that force the f64
                         # fallback route this file to the host paths
@@ -1738,9 +1842,10 @@ class QueryExecutor:
                     # per (field, E): device-combined packed planes —
                     # min/max need per-file row indices for the exact
                     # host gather, so only value-free states combine
-                    can_merge = not ({"min", "max"} & set(want))
+                    # (decided PER FIELD under the op-aware diet)
                     merged_by: dict = {}
                     merged_rows: dict = {}
+                    fields_perfile: set = set()   # per-file emissions
                     lat_dev_fold = blockagg.lattice_fold_on_device()
                     from ..ops.exactsum import K_LIMBS as _KLq
                     lat_lock = __import__("threading").Lock()
@@ -1758,25 +1863,31 @@ class QueryExecutor:
                         # order-sensitive across groups.
                         g_sl = gid_arr[st_l.block0:
                                        st_l.block0 + st_l.n_blocks]
+                        wf_l = want_of(lkey[0])
                         if lkey not in lat_host_acc:
                             lat_host_acc[lkey] = \
-                                blockagg.new_lattice_acc(G * W, want,
+                                blockagg.new_lattice_acc(G * W, wf_l,
                                                          _KLq)
                         acc = lat_host_acc[lkey]
 
                         def post(d_host):
+                            nb_l = sum(
+                                int(np.asarray(a).nbytes)
+                                for a in d_host if a is not None)
+                            _dstat.bump("d2h_bytes_lattice", nb_l)
                             with lat_lock:
                                 blockagg.fold_lattice_into(
                                     acc, st_l, d_host, WL_l, g_sl,
                                     int(start), int(interval_eff), W,
-                                    G * W, want, _KLq)
+                                    G * W, wf_l, _KLq)
                             return None
                         return post
 
-                    def _unpack_post(fmt, stck):
+                    def _unpack_post(fmt, stck, wf):
                         def post(arrs):
                             return _unpack_block_out(fmt, arrs, stck,
-                                                     want)
+                                                     wf, tx=_q_tx,
+                                                     want_legacy=want)
                         return post
 
                     def _emit(fname_e, reader_e, stack_e, packed):
@@ -1787,9 +1898,13 @@ class QueryExecutor:
                         nonlocal n_stream
                         if pipe is not None:
                             n_stream += 1
+                            _txn = {"f": "finalized", "p": "packed",
+                                    "l": "legacy", "lp": "legacy"}
                             pipe.submit(("blk", n_stream), packed[1:],
-                                        post=_unpack_post(packed[0],
-                                                          stack_e))
+                                        post=_unpack_post(
+                                            packed[0], stack_e,
+                                            want_of(fname_e)),
+                                        transport=_txn[packed[0]])
                             block_launches.append(
                                 (fname_e, reader_e, stack_e,
                                  ("s", n_stream)))
@@ -1811,22 +1926,24 @@ class QueryExecutor:
                                     blockagg.lattice_eligible(
                                         sl, gids_by_field[f],
                                         int(start), int(interval_eff),
-                                        W, want)
+                                        W, want_of(f))
                                     for f, sl in stacks.items()):
                                 continue
                             for fname, sl in stacks.items():
                                 gid_arr = gids_by_field[fname]
+                                wf = want_of(fname)
                                 lkey = (fname, sl[0].E, sl[0].k0,
                                         sl[0].limbs.shape[-1])
                                 if lat_dev_fold:
                                     folded = _sched_launch(
                                         "lattice",
-                                        lambda sl=sl, gid_arr=gid_arr:
+                                        lambda sl=sl, gid_arr=gid_arr,
+                                        wf=wf:
                                         blockagg.file_lattice_fold(
                                             sl, gid_arr, t_lo, t_hi,
                                             int(start),
                                             int(interval_eff),
-                                            W, G * W, want,
+                                            W, G * W, wf,
                                             scalars=scalars,
                                             gids_dev=
                                             blockagg.cached_gids(
@@ -1835,20 +1952,21 @@ class QueryExecutor:
                                     lat_dev_acc[lkey] = folded \
                                         if prev is None else \
                                         blockagg._pairwise_combine(
-                                            want, lkey[3])(prev,
-                                                           folded)
+                                            wf, lkey[3])(prev,
+                                                         folded)
                                     lat_dev_rows[lkey] = (
                                         lat_dev_rows.get(lkey, 0)
                                         + sum(st.n_rows for st in sl))
                                     continue
                                 for st_l, d_l, WL_l in _sched_launch(
                                         "lattice",
-                                        lambda sl=sl, gid_arr=gid_arr:
+                                        lambda sl=sl, gid_arr=gid_arr,
+                                        wf=wf:
                                         blockagg.file_lattice(
                                             sl, gid_arr, t_lo, t_hi,
                                             int(start),
                                             int(interval_eff),
-                                            W, want, scalars=scalars,
+                                            W, wf, scalars=scalars,
                                             gids_dev=
                                             blockagg.cached_gids(
                                                 gid_arr))):
@@ -1859,7 +1977,8 @@ class QueryExecutor:
                                             d_l,
                                             post=_lat_post(
                                                 lkey, st_l, WL_l,
-                                                gid_arr))
+                                                gid_arr),
+                                            transport="lattice")
                                     else:
                                         block_launches.append(
                                             (fname, reader, st_l,
@@ -1870,17 +1989,18 @@ class QueryExecutor:
                             continue
                         for fname, sl in stacks.items():
                             gid_arr = gids_by_field[fname]
+                            wf = want_of(fname)
                             out = _sched_launch(
                                 "block",
-                                lambda sl=sl, gid_arr=gid_arr:
+                                lambda sl=sl, gid_arr=gid_arr, wf=wf:
                                 blockagg.file_aggregate(
                                     sl, gid_arr, t_lo, t_hi,
                                     int(start), int(interval_eff),
-                                    W, G * W, want, scalars=scalars,
+                                    W, G * W, wf, scalars=scalars,
                                     gids_dev=blockagg.cached_gids(
                                         gid_arr),
                                     route=window_route))
-                            if can_merge:
+                            if not ({"min", "max"} & set(wf)):
                                 key = (fname, sl[0].E, sl[0].k0,
                                        sl[0].limbs.shape[-1])
                                 prev = merged_by.get(key)
@@ -1891,41 +2011,121 @@ class QueryExecutor:
                                     merged_by[key] = out
                                 else:
                                     comb = blockagg._pairwise_combine(
-                                        want, sl[0].limbs.shape[-1])
+                                        wf, sl[0].limbs.shape[-1])
                                     merged_by[key] = comb(prev, out)
                             else:
                                 # packed transport (device epilogue):
                                 # the pull, not the kernel, is the
                                 # query wall on tunnel-attached chips
+                                fields_perfile.add(fname)
                                 n_rows_f = sum(st.n_rows for st in sl)
                                 flat_n = ((sl[-1].block0
                                            + sl[-1].n_blocks)
                                           * sl[0].seg_rows)
                                 _emit(fname, reader, sl,
                                       blockagg.pack_grid(
-                                          out, want,
+                                          out, wf,
                                           sl[0].limbs.shape[-1],
-                                          n_rows_f, flat_n))
+                                          n_rows_f, flat_n,
+                                          prune_legacy=fin_gate))
                         # consume the sources: flat/dense/preagg must
                         # not double-count these chunks (the plan object
                         # is cached across queries — never mutate it)
                         for _sp, src in srcs:
                             block_skip.add(id(src))
-                    for (fname, _E, _k0, _ka), out in merged_by.items():
-                        _emit(fname, None, _BlockMeta(_E, _k0, _ka),
-                              blockagg.pack_grid(
-                                  out, want, _ka,
-                                  merged_rows[(fname, _E, _k0, _ka)],
-                                  0))
-                    # device-folded lattice groups: ONE packed grid per
+                    # device-finalize eligibility (the D2H diet
+                    # tentpole): only a TERMINAL partial whose scan
+                    # plan was consumed WHOLLY by the block path may
+                    # convert its grids to answer planes on device —
+                    # any leftover source (small file, memtable,
+                    # merged series) contributes limbs that must fold
+                    # BEFORE finalize, and cluster/incremental merges
+                    # keep the mergeable limb wire format untouched.
+                    fin_ok = (terminal
+                              and blockagg.device_finalize_on()
+                              and cs.multirow is None and not chunks)
+                    if fin_ok:
+                        for sp2 in scan_plan.series:
+                            if sp2.merged:
+                                fin_ok = False
+                                break
+                            for src in sp2.sources:
+                                if id(src) in block_skip:
+                                    continue
+                                # a leftover source blocks finalize
+                                # only if it CAN contribute to a
+                                # needed field: a chunk whose meta has
+                                # no column for any of them (a file of
+                                # other fields) scans to nothing on
+                                # every path. Memtable sources
+                                # (reader None) always block.
+                                if src.reader is None or any(
+                                        src.meta.column(f) is not None
+                                        for f in needed_fields):
+                                    fin_ok = False
+                                    break
+                            if not fin_ok:
+                                break
+                    field_nkeys: dict = {}
+                    for (fname, _E, _k0, _ka) in (list(merged_by)
+                                                  + list(lat_dev_acc)):
+                        field_nkeys[fname] = \
+                            field_nkeys.get(fname, 0) + 1
+                    _t_fdev0 = _now_ns()
+                    n_fin = 0
+                    fin_ns = 0       # finalize-kernel dispatch only —
+                    # the _emit that follows can block on pipeline
+                    # backpressure, which belongs to device_pull
+
+                    def _emit_merged(fname, _E, _k0, _ka, out, nrows):
+                        nonlocal n_fin, fin_ns
+                        fin = None
+                        if (fin_ok and fname not in fields_perfile
+                                and field_nkeys.get(fname) == 1):
+                            # a single (scale, plane-window) group: the
+                            # grid IS the field's whole answer; mixed
+                            # scales must rebase on host and keep limbs
+                            _t_k0 = _now_ns()
+                            fin = blockagg.finalize_grid(
+                                out, want_of(fname),
+                                field_ops.get(fname, set()), _ka,
+                                _k0, _E, nrows)
+                            fin_ns += _now_ns() - _t_k0
+                        if fin is not None:
+                            n_fin += 1
+                            # the decode recipe comes FROM the pack
+                            # call — one derivation, no skew
+                            fin, (dm, ss, nc) = fin
+                            _emit(fname, None,
+                                  _FinMeta(_E, _k0, _ka, dm, ss, nc,
+                                           G * W, out), fin)
+                        else:
+                            _emit(fname, None,
+                                  _BlockMeta(_E, _k0, _ka),
+                                  blockagg.pack_grid(
+                                      out, want_of(fname), _ka,
+                                      nrows, 0,
+                                      prune_legacy=fin_gate))
+
+                    for (fname, _E, _k0, _ka), out in \
+                            merged_by.items():
+                        _emit_merged(fname, _E, _k0, _ka, out,
+                                     merged_rows[(fname, _E, _k0,
+                                                  _ka)])
+                    # device-folded lattice groups: ONE grid per
                     # (field, scale) group crosses the link
                     for (fname, _E, _k0, _ka), out in \
                             lat_dev_acc.items():
-                        _emit(fname, None, _BlockMeta(_E, _k0, _ka),
-                              blockagg.pack_grid(
-                                  out, want, _ka,
-                                  lat_dev_rows[(fname, _E, _k0, _ka)],
-                                  0))
+                        _emit_merged(fname, _E, _k0, _ka, out,
+                                     lat_dev_rows[(fname, _E, _k0,
+                                                   _ka)])
+                    if n_fin:
+                        _dstat.bump_phase("device_finalize", fin_ns)
+                        if span is not None:
+                            fsp = span.child("device_finalize")
+                            fsp.start_ns = _t_fdev0
+                            fsp.end_ns = _t_fdev0 + fin_ns
+                            fsp.add(grids=n_fin)
                     block_rows_total = sum(
                         sl.n_rows for _r, stacks, _g, _s in jobs
                         for sls in stacks.values() for sl in sls)
@@ -1935,6 +2135,7 @@ class QueryExecutor:
                                    launches=len(block_launches)
                                    + n_lat_stream,
                                    streamed=n_stream + n_lat_stream,
+                                   finalized=n_fin,
                                    rows=block_rows_total)
 
         scanres = None
@@ -2043,7 +2244,7 @@ class QueryExecutor:
                             block_rows=sum(
                                 sl.n_rows for _f, _r, s, _o
                                 in block_launches
-                                if not isinstance(s, _BlockMeta)
+                                if not hasattr(s, "ka")
                                 for sl in (s if isinstance(s, list)
                                            else [s]))
                             or block_rows_total)
@@ -2564,8 +2765,6 @@ class QueryExecutor:
             # are integer-valued f64 far below 2^53)
             from ..ops import blockagg as _bagg
             from ..ops.exactsum import K_LIMBS as _KL
-            _bw = tuple(k for k in ("sum", "sumsq", "min", "max")
-                        if getattr(spec, k))
             new_launches = []
             if pipe is None:
                 # lattice launches ("t") fold on host into ONE bo per
@@ -2575,20 +2774,25 @@ class QueryExecutor:
                 for (f, r, s, _), fmt, arrs in zip(
                         block_launches, block_fmt, block_outs):
                     if fmt == "t":
+                        _dstat.bump("d2h_bytes_lattice", sum(
+                            int(np.asarray(a).nbytes)
+                            for a in arrs[0] if a is not None))
                         lat_groups.setdefault(
                             (f, s.E, s.k0, s.limbs.shape[-1]),
                             []).append((s, arrs))
                     else:
                         new_launches.append(
                             (f, r, s,
-                             _unpack_block_out(fmt, arrs, s, _bw)))
+                             _unpack_block_out(fmt, arrs, s,
+                                               want_of(f), tx=_q_tx,
+                                               want_legacy=want)))
                 for (f, E_l, k0_l, ka_l), ents in lat_groups.items():
                     bo = _bagg.fold_lattices(
                         [(s2, a[0], a[1]) for s2, a in ents],
                         [a[2][s2.block0:s2.block0 + s2.n_blocks]
                          for s2, a in ents],
-                        int(start), int(interval_eff), W, G * W, _bw,
-                        _KL)
+                        int(start), int(interval_eff), W, G * W,
+                        want_of(f), _KL)
                     new_launches.append(
                         (f, None, _BlockMeta(E_l, k0_l, ka_l), bo))
             else:
@@ -2602,7 +2806,28 @@ class QueryExecutor:
                 for (f, E_l, k0_l, ka_l), acc in lat_host_acc.items():
                     new_launches.append(
                         (f, None, _BlockMeta(E_l, k0_l, ka_l),
-                         _bagg.lattice_acc_bo(acc, _bw)))
+                         _bagg.lattice_acc_bo(acc, want_of(f))))
+            # transport gauges AFTER the unpack (the barrier path only
+            # fills _q_tx here); sparse repair pulls count into the
+            # per-query D2H total like every other block transfer
+            with _q_tx["lock"]:
+                _rep_b = _q_tx.get("repair", 0)
+                _dstat.gauge("last_query_planes",
+                             _q_tx.get("planes", 0))
+                _dstat.gauge("last_query_pull_saved",
+                             _q_tx.get("saved", 0))
+            if _rep_b:
+                total_b += _rep_b
+                _dstat.gauge("last_query_d2h_bytes", total_b)
+            if pull_sp is not None:
+                pull_sp.add(pull_saved=_q_tx.get("saved", 0),
+                            repair_bytes=_rep_b)
+                if pipe is not None:
+                    # per-transport split of the streamed pulls
+                    # (StreamingPipeline books bytes under the label
+                    # each submit carried)
+                    for _t, _b in sorted(pipe.bytes_by.items()):
+                        pull_sp.add(**{f"pull_{_t}_bytes": _b})
             block_launches = new_launches
         # exact selector values: host gather from device row indices
         for fname, vp in sel_results.items():
@@ -2788,6 +3013,27 @@ class QueryExecutor:
             elif my_blocks:
                 fb_needed = True       # no exact machinery: f64 only
             for reader_b, st_blk, bo in my_blocks:
+                if bo.get("final"):
+                    # device-finalized transport: answer planes land
+                    # straight in the output states — eligibility
+                    # guaranteed this field has NO other contribution
+                    # (all sources block-consumed, single scale), so
+                    # the adds below are onto zero grids. "count" may
+                    # be a presence 0/1 grid when no selected op
+                    # consumes real counts (present = count > 0 is all
+                    # the downstream reads).
+                    st["count"] = st["count"] + \
+                        np.asarray(bo["count"]).reshape(G, W)
+                    if "sum" in bo and "sum" in st:
+                        st["sum"] = st["sum"] + \
+                            np.asarray(bo["sum"]).reshape(G, W)
+                    if "mean" in bo:
+                        # device-divided mean (mean-only fields):
+                        # finalize_partials consumes this grid in
+                        # place of finalize_moment's sum/count split
+                        st["mean_final"] = \
+                            np.asarray(bo["mean"]).reshape(G, W)
+                    continue
                 # merged cross-file entries carry the limb scale E in
                 # place of the slab list (no per-file rows remain)
                 _E_blk = st_blk.E if isinstance(st_blk, _BlockMeta) \
@@ -2824,9 +3070,16 @@ class QueryExecutor:
                         st["max"],
                         np.where(has, ve, -np.inf).reshape(G, W))
             # reproducible-sum limb states (sparse + dense + pre-agg +
-            # block stacks)
-            if exact_on and (fname in exact_results
-                             or fname in dense_exact or my_blocks):
+            # block stacks). Device-finalized fields carry NO limb
+            # state by design — their sums are already final (exact
+            # reconstruction + sparse host repair), and eligibility
+            # proved no other source contributes; building a zero limb
+            # grid here would overwrite the finalized sum downstream.
+            has_fin = any(bo.get("final")
+                          for _r3, _s3, bo in my_blocks)
+            if exact_on and not has_fin and (
+                    fname in exact_results
+                    or fname in dense_exact or my_blocks):
                 from ..ops.exactsum import K_LIMBS, rebase
                 lg = np.zeros((G * W + 1, K_LIMBS))
                 ixg = np.zeros(G * W + 1, dtype=bool)
@@ -3395,7 +3648,11 @@ def merge_partials(partials: list[dict | None]) -> dict | None:
         # (G, W) float grids
         has_limbs = [p for p in partials
                      if "sum_limbs" in p["fields"].get(fname, {})]
-        keys = [k for k in keys if k not in ("sum_limbs", "sum_inexact")]
+        # mean_final only ever exists on TERMINAL partials (device
+        # finalize) — a real exchange merge drops it (it could not be
+        # merged anyway; non-terminal partials never carry it)
+        keys = [k for k in keys if k not in ("sum_limbs", "sum_inexact",
+                                             "mean_final")]
         tgt = {}
         for k in keys:
             if k in ("count", "first_time", "last_time",
@@ -3854,7 +4111,13 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
         cnt = st.get("count")
         present = (cnt > 0) if cnt is not None \
             else np.zeros((G, W), dtype=bool)
-        if a.func in MOMENT_AGGS:
+        if a.func == "mean" and "mean_final" in st:
+            # device-divided mean (finalize epilogue, mean-only
+            # fields): same operands as finalize_moment's sum/count
+            # division, computed on device; flagged cells were
+            # host-repaired at unpack
+            grid = st["mean_final"]
+        elif a.func in MOMENT_AGGS:
             grid = finalize_moment(a.func, st)
         elif a.func in SKETCH_AGGS:
             # ogsketch_percentile phase: interpolated quantile per

@@ -63,7 +63,8 @@ from ..utils import deadline as _deadline
 from ..utils.errors import ErrQueryError, ErrQueryTimeout
 
 __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
-           "get_scheduler", "estimate_request_cost", "sched_collector"]
+           "get_scheduler", "estimate_request_cost",
+           "pull_bytes_per_cell", "sched_collector"]
 
 
 def enabled() -> bool:
@@ -122,8 +123,28 @@ class QueryCost:
 # packed-transport bytes/cell (executor block path) and worst-case f64
 # state bytes/cell — the same constants the dispatch economics use
 _PULL_BYTES_PER_CELL = 20
+# device-finalize transport (OG_DEVICE_FINALIZE): one f64 answer plane
+# + a u32 count/presence plane per cell instead of the packed limb
+# grid — admission must not overcharge cheap dashboards in the
+# weighted-fair queue when the diet is on
+_PULL_BYTES_PER_CELL_FINALIZED = 12
 _HBM_BYTES_PER_CELL = 88
 _DEFAULT_CELLS = 10_000       # unknown plans admit at dashboard weight
+
+
+def pull_bytes_per_cell() -> int:
+    """Admission-estimate D2H bytes per result cell, matching the
+    transport the executor will actually use: the finalized answer
+    planes when the device-finalize epilogue is on, the packed uint32
+    grid otherwise. Read dynamically — perf_smoke and operators flip
+    OG_DEVICE_FINALIZE per run."""
+    try:
+        from ..ops.blockagg import device_finalize_on
+        if device_finalize_on():
+            return _PULL_BYTES_PER_CELL_FINALIZED
+    except Exception:
+        pass
+    return _PULL_BYTES_PER_CELL
 
 # scheduler counters (utils.stats.scheduler_collector → /metrics,
 # /debug/vars). Writers use utils.stats.bump (threaded HTTP server).
@@ -548,19 +569,53 @@ def estimate_request_cost(executor, stmts, db: str | None) -> QueryCost:
     back to the default dashboard-class cost."""
     from .ast import SelectStatement
     cells = 0
+    pull_b = 0
     seen_select = False
     for stmt in stmts:
         if not isinstance(stmt, SelectStatement):
             continue
         seen_select = True
         try:
-            cells += _estimate_select_cells(executor, stmt, db)
+            c = _estimate_select_cells(executor, stmt, db)
         except Exception:
-            cells += _DEFAULT_CELLS
+            c = _DEFAULT_CELLS
+        cells += c
+        pull_b += c * _stmt_pull_rate(stmt)
     if not seen_select:
         return QueryCost(0, 0, 0)
-    return QueryCost(cells, cells * _PULL_BYTES_PER_CELL,
-                     cells * _HBM_BYTES_PER_CELL)
+    return QueryCost(cells, pull_b, cells * _HBM_BYTES_PER_CELL)
+
+
+def _stmt_pull_rate(stmt) -> int:
+    """Per-statement pull rate: the finalized answer-plane rate applies
+    only to op sets the finalize epilogue can actually serve
+    (count/sum/mean — blockagg.finalize_fops); extrema/sketch/raw
+    shapes ship the packed limb grid either way, and must not be
+    under-reserved in the admission budget."""
+    names: set = set()
+
+    def walk(e):
+        if e is None:
+            return
+        fn = getattr(e, "func", None)
+        if isinstance(fn, str):
+            names.add(fn)
+        for attr in ("args", "lhs", "rhs", "left", "right", "expr"):
+            v = getattr(e, attr, None)
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    walk(x)
+            elif v is not None and hasattr(v, "__dict__"):
+                walk(v)
+
+    try:
+        for f in getattr(stmt, "fields", ()) or ():
+            walk(getattr(f, "expr", None))
+    except Exception:
+        names = set()
+    if names and names <= {"count", "sum", "mean"}:
+        return pull_bytes_per_cell()
+    return _PULL_BYTES_PER_CELL
 
 
 def _estimate_select_cells(executor, stmt, db: str | None) -> int:

@@ -5,8 +5,13 @@
 # parallel finalize pool (OG_FINALIZE_WORKERS=8) must agree with the
 # serial path (=0) on every cell of every shape incl. the 1m one,
 # while the streaming JSON serializer must emit bytes identical to
-# json.dumps. Runs a scaled-down bench dataset on the CPU backend with
-# per-phase output — CI-safe (no accelerator needed, minutes of wall).
+# json.dumps. The D2H-diet gate (this PR) additionally runs every
+# shape — including the scaled-down 1m heavy shape and the forced
+# lattice route — with OG_DEVICE_FINALIZE=0 (legacy limb transport)
+# and =1 (on-device finalize + op-aware plane pruning, the default):
+# any cell mismatch between the two is fatal. Runs a scaled-down
+# bench dataset on the CPU backend with per-phase output — CI-safe
+# (no accelerator needed, minutes of wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]

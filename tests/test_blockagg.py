@@ -222,6 +222,79 @@ def test_packed_pull_roundtrip_property():
                         (1 << 32) - 1)[0] == "l"
 
 
+def test_pack_grid_range_guards_bit_identical():
+    """The packed-transport range guards, tested ON both sides of each
+    threshold: counts ≥ 2^28 and (with idx planes) flat_n ≥ 2^32−1
+    must drop to the legacy f64 transport, and the unpacked bo dicts
+    must be bit-identical across the boundary either way."""
+    from opengemini_tpu.ops import blockagg as BA
+    from opengemini_tpu.ops import exactsum
+
+    rng = np.random.default_rng(13)
+    R = 1 << 18
+
+    def unpack_any(fmt, arrs, want, K):
+        if fmt == "p":
+            f64x = np.asarray(arrs[2]) if len(arrs) > 2 else None
+            return BA.unpack_packed(np.asarray(arrs[0]),
+                                    np.asarray(arrs[1]), want, K, 0,
+                                    exactsum.K_LIMBS, f64x)
+        return BA.unpack_planes(np.asarray(arrs[0]), want, K, 0,
+                                exactsum.K_LIMBS)
+
+    def norm(bo):
+        # limb representations may differ (carry-normalized vs raw);
+        # compare the represented integer totals + everything else,
+        # dropping the value planes the packed transport never ships
+        out = {}
+        for k, v in bo.items():
+            if k == "limbs":
+                out[k] = [sum(int(v[s, j]) * R ** (5 - j)
+                              for j in range(6))
+                          for s in range(v.shape[0])]
+            elif k in ("min", "max"):
+                continue
+            else:
+                out[k] = np.asarray(v).tolist()
+        return out
+
+    # --- count guard at n_rows = 2^28 (counts ≤ n_rows by contract)
+    want, K, S = ("sum",), 2, 37
+    layout = BA.plane_layout(want, K)
+    planes = np.zeros((sum(n for _, n in layout), S))
+    planes[0] = rng.integers(0, (1 << 28) - 1, S).astype(float)
+    planes[0, 0] = float((1 << 28) - 1)          # extreme real count
+    planes[1:1 + K] = rng.integers(-(1 << 27), 1 << 27,
+                                   (K, S)).astype(float)
+    below = BA.pack_grid(planes, want, K, (1 << 28) - 1, 0)
+    at = BA.pack_grid(planes, want, K, 1 << 28, 0)
+    assert below[0] == "p" and at[0] == "l"
+    assert norm(unpack_any(below[0], below[1:], want, K)) == \
+        norm(unpack_any(at[0], at[1:], want, K))
+
+    # --- flat_n guard at 2^32−1 (uint32 idx planes need the sentinel)
+    want2 = ("min", "max")
+    layout2 = BA.plane_layout(want2, 0)
+    planes2 = np.zeros((sum(n for _, n in layout2), S))
+    planes2[0] = rng.integers(0, 1000, S).astype(float)
+    i = 1
+    for name, n in layout2[1:]:
+        if name in ("min", "max"):
+            planes2[i] = rng.normal(0, 50, S)
+        else:
+            v = rng.integers(0, (1 << 32) - 2, S).astype(float)
+            planes2[i] = np.where(rng.random(S) < 0.25,
+                                  BA.IDX_SENTINEL, v)
+        i += n
+    below2 = BA.pack_grid(planes2, want2, 0, 1000, (1 << 32) - 2)
+    at2 = BA.pack_grid(planes2, want2, 0, 1000, (1 << 32) - 1)
+    assert below2[0] == "p" and at2[0] == "l"
+    assert norm(unpack_any(below2[0], below2[1:], want2, 0)) == \
+        norm(unpack_any(at2[0], at2[1:], want2, 0))
+    # idx-free wants ignore flat_n entirely
+    assert BA.pack_grid(planes, want, K, 1000, (1 << 32) - 1)[0] == "p"
+
+
 def test_packed_and_legacy_paths_agree(db, monkeypatch):
     """Same query, packed vs legacy transport: identical output."""
     from opengemini_tpu.ops import blockagg as BA

@@ -1,0 +1,421 @@
+"""Device finalize epilogue (OG_DEVICE_FINALIZE): terminal block-path
+grids convert to answer-sized planes ON DEVICE — exact limb→f64
+reconstruction, mean = sum/count, count/presence — and only flagged
+cells (finalize hazard ∪ limb residue) pull sparsely for host repair.
+Everything must be bit-identical to the =0 legacy transport, and the
+cluster/incremental wire format must keep its mergeable limbs."""
+
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)   # force the path
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def seed(eng, hosts=4, points=360, nil_every=0, residue_every=0,
+         seed_=11):
+    """Float gauge rows; optional nil holes and residue rows (values
+    far below the limb span of the file scale → inexact cells)."""
+    rng = np.random.default_rng(seed_)
+    vals = np.round(np.clip(rng.normal(50.0, 15.0, (hosts, points)),
+                            0, 100), 2)
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            if nil_every and (h + i) % nil_every == 0:
+                continue
+            v = vals[h, i]
+            if residue_every and i % residue_every == 0:
+                v = 1e-30          # below 2^(E-108): nonzero residual
+            lines.append(f"cpu,host=h{h} u={float(v)!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    return vals
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+# ------------------------------------------------ kernel-level parity
+
+
+def _mk_planes(rng, want, K, S, huge=False):
+    from opengemini_tpu.ops import blockagg as BA
+    layout = BA.plane_layout(want, K)
+    planes = np.zeros((sum(n for _, n in layout), S))
+    i = 0
+    for name, n in layout:
+        if name == "count":
+            planes[i] = rng.integers(0, 1 << 20, S)
+        elif name == "limbs":
+            hi = (1 << 40) if huge else (1 << 28)
+            planes[i:i + n] = rng.integers(-hi, hi, (n, S)).astype(
+                float)
+        elif name == "bad":
+            planes[i] = (rng.random(S) < 0.1).astype(float)
+        i += n
+    return planes
+
+
+@pytest.mark.parametrize("ops", [{"mean"}, {"sum"}, {"count"},
+                                 {"mean", "sum"}, {"mean", "count"},
+                                 {"sum", "count", "mean"}])
+@pytest.mark.parametrize("huge", [False, True])
+def test_finalize_kernel_parity(ops, huge):
+    """finalize_grid + unpack_finalized ≡ host unpack_planes →
+    finalize_exact → mean division, bit for bit — including hazard
+    cells (huge limb totals) that route through the sparse repair."""
+    from opengemini_tpu.ops import blockagg as BA
+    from opengemini_tpu.ops import exactsum
+
+    rng = np.random.default_rng(3)
+    want = ("sum",) if ({"sum", "mean"} & ops) else ()
+    K, k0, E, S = 3, 1, 36, 257
+    planes = _mk_planes(rng, want, K, S, huge=huge)
+    got = BA.finalize_grid(planes, want, ops, K, k0, E,
+                           n_rows=1 << 20)
+    assert got is not None
+    fin, (dm, ss, nc) = got
+    assert fin[0] == "f"
+    host_arrs = tuple(None if a is None else np.asarray(a)
+                      for a in fin[1:])
+    bo = BA.unpack_finalized(host_arrs, jax.device_put(planes),
+                             K, k0, E, dm, ss, nc, S)
+    bo.pop("_repair_nbytes", None)
+    # host reference: full-limb expansion → finalize_exact
+    ref = BA.unpack_planes(planes, want, K, k0, exactsum.K_LIMBS)
+    assert np.array_equal(
+        np.asarray(bo["count"]),
+        ref["count"] if nc else (ref["count"] > 0).astype(np.int64))
+    if ss or dm:
+        ref_sum = exactsum.finalize_exact(ref["limbs"], E)
+        if ss:
+            assert np.array_equal(bo["sum"], ref_sum)
+        if dm:
+            ref_mean = ref_sum / np.maximum(ref["count"], 1)
+            assert np.array_equal(bo["mean"], ref_mean)
+
+
+def test_finalize_grid_ineligible_ops_and_range_guard():
+    from opengemini_tpu.ops import blockagg as BA
+    planes = np.zeros((1, 8))
+    planes[0] = 3.0
+    # extrema / raw ops can't finalize on device
+    assert BA.finalize_grid(planes, (), {"min"}, 0, 0, 0, 10) is None
+    assert BA.finalize_grid(planes, (), set(), 0, 0, 0, 10) is None
+    # count range guard: same 2^28 bound as the packed transport
+    assert BA.finalize_grid(planes, (), {"count"}, 0, 0, 0,
+                            1 << 28) is None
+    assert BA.finalize_grid(planes, (), {"count"}, 0, 0, 0,
+                            (1 << 28) - 1) is not None
+
+
+def test_transfer_guard_sparse_repair_is_only_transfer():
+    """With no flagged cells, unpack_finalized runs transfer-free
+    (everything it needs was already pulled); with flagged cells it
+    makes EXACTLY ONE extra device pull — the sparse repair gather."""
+    from opengemini_tpu.ops import blockagg as BA
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+
+    rng = np.random.default_rng(5)
+    want, K, k0, E, S = ("sum",), 2, 0, 18, 64
+    ops = {"mean", "sum", "count"}
+    clean = _mk_planes(rng, want, K, S, huge=False)
+    clean[1 + K] = 0.0                       # no residue → no flags
+    dirty = clean.copy()
+    dirty[1 + K, ::7] = 1.0                  # residue rows → flagged
+    dm, ss, nc = BA.finalize_fops(ops)
+    for planes, flagged in ((clean, False), (dirty, True)):
+        dev = jax.device_put(planes)
+        fin, _rec = BA.finalize_grid(np.asarray(dev), want, ops, K,
+                                     k0, E, n_rows=1 << 20)
+        host_arrs = tuple(None if a is None else np.asarray(a)
+                          for a in fin[1:])
+        pulls0 = DEVICE_STATS["d2h_pulls"]
+        if not flagged:
+            with jax.transfer_guard("disallow"):
+                bo = BA.unpack_finalized(host_arrs, dev, K, k0,
+                                         E, dm, ss, nc, S)
+            assert DEVICE_STATS["d2h_pulls"] == pulls0
+        else:
+            bo = BA.unpack_finalized(host_arrs, dev, K, k0, E,
+                                     dm, ss, nc, S)
+            assert DEVICE_STATS["d2h_pulls"] == pulls0 + 1
+        assert "sum" in bo and "count" in bo
+
+
+# --------------------------------------------------- end-to-end parity
+
+
+OPS_QUERIES = [
+    # mean-only: the device-division + presence-bitmask diet
+    "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host",
+    "SELECT sum(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host",
+    "SELECT count(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(2m), host",
+    "SELECT mean(u), count(u), sum(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(1m), host",
+    # extrema keep the per-file index+host-gather path (carve-out)
+    "SELECT min(u), max(u), mean(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(1m), host",
+    # non-block fallback ops: finalize must not engage or corrupt
+    "SELECT first(u), last(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(2m), host",
+    "SELECT percentile(u, 90) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(5m), host",
+    # windowless + math over aggs
+    "SELECT mean(u) * 2 + count(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY host",
+]
+
+
+@pytest.mark.parametrize("shape", ["plain", "nils", "residue"])
+def test_device_finalize_matches_legacy_all_ops(db, monkeypatch,
+                                                shape):
+    """Every op × nil pattern × residue flag: OG_DEVICE_FINALIZE=1
+    (cold + warm) must equal =0 bit for bit."""
+    eng, ex = db
+    seed(eng,
+         nil_every=7 if shape == "nils" else 0,
+         residue_every=13 if shape == "residue" else 0)
+    for text in OPS_QUERIES:
+        monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+        ref = q(ex, text)
+        monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+        assert q(ex, text) == ref, text          # cold
+        assert q(ex, text) == ref, text          # warm repeat
+
+
+def test_device_finalize_on_lattice_routes(db, monkeypatch):
+    """Big-grid lattice route (device AND host fold): finalize on/off
+    agree on every cell."""
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    seed(eng, hosts=6, points=512)
+    text = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE "
+            "time >= 0 AND time < 5120s GROUP BY time(1m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    ref = q(ex, text)
+    monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+    for fold in ("1", "0"):
+        monkeypatch.setenv("OG_LATTICE_DEVICE_FOLD", fold)
+        for fin in ("0", "1"):
+            monkeypatch.setenv("OG_DEVICE_FINALIZE", fin)
+            assert q(ex, text) == ref, (fold, fin)
+
+
+def test_int_fields_and_exact_sum_off(db, monkeypatch):
+    """Integer fields never stack (typed int64 host path) and
+    OG_EXACT_SUM=0 queries skip the limb machinery — the finalize flag
+    must be a no-op on both."""
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    lines = []
+    for h in range(2):
+        for i in range(200):
+            lines.append(f"cpu,host=h{h} n={(h * 37 + i) % 91}i "
+                         f"{i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    text = ("SELECT sum(n), mean(n), count(n) FROM cpu WHERE "
+            "time >= 0 AND time < 2000s GROUP BY time(2m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    ref = q(ex, text)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    assert q(ex, text) == ref
+    monkeypatch.setattr(E, "EXACT_SUM", False)
+    a = q(ex, text)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    assert q(ex, text) == a
+
+
+def test_memtable_leftover_disables_finalize_but_matches(db,
+                                                         monkeypatch):
+    """Unflushed rows are a non-block source: the terminal partial must
+    keep the mergeable limb states (finalize ineligible) and results
+    must equal the legacy path regardless."""
+    eng, ex = db
+    seed(eng, hosts=2, points=240)
+    eng.write_points("db0", parse_lines("\n".join(
+        f"cpu,host=h0 u={i}.25 {(240 + i) * 10**10}"
+        for i in range(7))))                    # memtable only
+    text = ("SELECT mean(u), sum(u) FROM cpu WHERE time >= 0 AND "
+            "time < 2470s GROUP BY time(2m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    ref = q(ex, text)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    assert q(ex, text) == ref
+    # the partial still carries limb states (wire format untouched)
+    from opengemini_tpu.query.functions import classify_select
+    from opengemini_tpu.query.condition import analyze_condition
+    (stmt,) = parse_query(text)
+    cs = classify_select(stmt)
+    cond = analyze_condition(stmt.condition, set())
+    p = ex.partial_agg(stmt, "db0", "cpu", cs, cond, {"host"},
+                       terminal=True)
+    assert "sum_limbs" in p["fields"]["u"]
+    assert "mean_final" not in p["fields"]["u"]
+
+
+def test_cluster_wire_format_unchanged(db, monkeypatch):
+    """Non-terminal partials (store RPC / incremental / mesh) NEVER
+    device-finalize: limb states ship, no answer planes."""
+    eng, ex = db
+    vals = seed(eng, hosts=3, points=300)
+    text = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 3000s GROUP BY time(5m), host")
+    from opengemini_tpu.query.condition import analyze_condition
+    from opengemini_tpu.query.executor import finalize_partials
+    from opengemini_tpu.query.functions import classify_select
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    (stmt,) = parse_query(text)
+    cs = classify_select(stmt)
+    cond = analyze_condition(stmt.condition, set())
+    p_wire = ex.partial_agg(stmt, "db0", "cpu", cs, cond, {"host"})
+    assert "sum_limbs" in p_wire["fields"]["u"]
+    assert "mean_final" not in p_wire["fields"]["u"]
+    p_term = ex.partial_agg(stmt, "db0", "cpu", cs, cond, {"host"},
+                            terminal=True)
+    assert "mean_final" in p_term["fields"]["u"]
+    assert "sum_limbs" not in p_term["fields"]["u"]
+    # both finalize to the same rows — and to the exact fsum means
+    r_wire = finalize_partials(stmt, "cpu", cs, [p_wire])
+    r_term = finalize_partials(stmt, "cpu", cs, [p_term])
+    assert r_wire == r_term
+    for s in r_term["series"]:
+        h = int(s["tags"]["host"][1:])
+        for row in s["values"]:
+            w = row[0] // (300 * 10**9)
+            cell = [vals[h, i] for i in range(300)
+                    if w * 30 <= i < (w + 1) * 30]
+            if cell:
+                assert row[1] == math.fsum(cell) / len(cell)
+
+
+def test_other_field_files_dont_block_finalize(db, monkeypatch):
+    """A file that carries NONE of the query's fields scans to nothing
+    — it must not block the finalize epilogue (the leftover-source
+    check consults chunk metas, not raw source membership). Shape: the
+    field appears only in the SECOND time slice (added later), so the
+    first file's chunks are in-plan, unmerged, and unstackable."""
+    rng = np.random.default_rng(23)
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    # file 1: [0, 300) — only `other`
+    lines = []
+    for h in range(3):
+        for i in range(300):
+            lines.append(f"cpu,host=h{h} other={i}.5 {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    # file 2: [300, 600) — `u` (disjoint time range → not merged)
+    lines = []
+    for h in range(3):
+        for i in range(300, 600):
+            v = float(np.round(rng.normal(50, 15), 2))
+            lines.append(f"cpu,host=h{h} u={v!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    text = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 6000s GROUP BY time(1m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    ref = q(ex, text)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    fin0 = DEVICE_STATS["d2h_bytes_finalized"]
+    assert q(ex, text) == ref
+    assert DEVICE_STATS["d2h_bytes_finalized"] > fin0
+
+
+def test_plane_diet_counters_and_phase(db, monkeypatch):
+    """Satellite: per-transport D2H bytes, pull_bytes_saved, the
+    per-query plane/saved gauges, and the device_finalize phase all
+    surface through the collectors behind /metrics and /debug/vars."""
+    from opengemini_tpu.ops.devstats import (DEVICE_STATS,
+                                             device_collector,
+                                             phase_collector)
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(1m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    fin0 = DEVICE_STATS["d2h_bytes_finalized"]
+    saved0 = DEVICE_STATS["pull_bytes_saved"]
+    q(ex, text)
+    assert DEVICE_STATS["d2h_bytes_finalized"] > fin0
+    assert DEVICE_STATS["pull_bytes_saved"] > saved0
+    assert DEVICE_STATS["last_query_planes"] >= 1
+    assert DEVICE_STATS["last_query_pull_saved"] > 0
+    assert "device_finalize_ms" in phase_collector()
+    for k in ("d2h_bytes_packed", "d2h_bytes_legacy",
+              "d2h_bytes_finalized", "d2h_bytes_lattice",
+              "pull_bytes_saved"):
+        assert k in device_collector()
+    # packed transport books under its own counter when finalize is off
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    p0 = DEVICE_STATS["d2h_bytes_packed"]
+    q(ex, text)
+    assert DEVICE_STATS["d2h_bytes_packed"] > p0
+
+
+def test_finalized_pull_is_smaller(db, monkeypatch):
+    """Acceptance direction: the mean-only block shape must pull at
+    least 2× fewer bytes with the finalize epilogue on."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, hosts=6, points=512)
+    text = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 5120s GROUP BY time(1m), host")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    ref = q(ex, text)
+    off_b = DEVICE_STATS["last_query_d2h_bytes"]
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    assert q(ex, text) == ref
+    on_b = DEVICE_STATS["last_query_d2h_bytes"]
+    assert on_b * 2 <= off_b, (off_b, on_b)
+
+
+def test_pruned_legacy_transport_matches(db, monkeypatch):
+    """PACK=0 forces the legacy f64 planes; with the diet on, the
+    min/max VALUE planes are pruned on device ("lp") — results must
+    stay identical to the full legacy grid."""
+    from opengemini_tpu.ops import blockagg as BA
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT min(u), max(u), mean(u), count(u) FROM cpu WHERE "
+            "time >= 0 AND time < 3600s GROUP BY time(5m), host")
+    monkeypatch.setattr(BA, "PACK", False)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    full = q(ex, text)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    assert q(ex, text) == full

@@ -538,6 +538,36 @@ def test_estimate_cost_orders_heavy_above_dashboard(db):
     assert none.cells == 0
 
 
+def test_estimate_cost_uses_finalized_plane_count(db, monkeypatch):
+    """Satellite: admission pull-byte estimates must track the
+    transport the executor will use — the finalized answer planes
+    (~12 B/cell) when OG_DEVICE_FINALIZE is on, the packed limb grid
+    (~20 B/cell) when it's off — so cheap dashboards aren't
+    overcharged in the weighted-fair queue."""
+    from opengemini_tpu.query import parse_query
+    from opengemini_tpu.query.scheduler import pull_bytes_per_cell
+    eng, ex = db
+    seed(eng)
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    assert pull_bytes_per_cell() == 12
+    fin = estimate_request_cost(ex, parse_query(Q_HIGH), "db0")
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "0")
+    assert pull_bytes_per_cell() == 20
+    legacy = estimate_request_cost(ex, parse_query(Q_HIGH), "db0")
+    assert fin.cells == legacy.cells
+    assert fin.pull_bytes == fin.cells * 12
+    assert legacy.pull_bytes == legacy.cells * 20
+    # the fair-queue weight (cells) is transport-independent
+    assert fin.norm == legacy.norm
+    # extrema shapes never use the finalized transport — admission
+    # must keep charging the packed rate even with the diet on
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "1")
+    q_mm = ("SELECT min(u), max(u) FROM cpu WHERE time >= 0 AND "
+            "time < 2400s GROUP BY time(1m), host")
+    mm = estimate_request_cost(ex, parse_query(q_mm), "db0")
+    assert mm.pull_bytes == mm.cells * 20
+
+
 # ------------------------------------------------------- HTTP serving
 
 
