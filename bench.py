@@ -43,8 +43,10 @@ import time
 
 import numpy as np
 
-HOSTS = int(os.environ.get("OG_BENCH_HOSTS", "16000"))
-HOURS = float(os.environ.get("OG_BENCH_HOURS", "12"))
+from opengemini_tpu.utils import knobs
+
+HOSTS = int(knobs.get("OG_BENCH_HOSTS"))
+HOURS = float(knobs.get("OG_BENCH_HOURS"))
 STEP_S = 10
 # TSBS double-groupby-1 (BASELINE config 2): mean of one metric over 12h
 # GROUP BY time(1h), hostname — the headline shape
@@ -389,7 +391,7 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
 
 # ------------------------------------------- colstore (config 3)
 
-CS_HOSTS = int(os.environ.get("OG_BENCH_CS_HOSTS", "2000"))
+CS_HOSTS = int(knobs.get("OG_BENCH_CS_HOSTS"))
 CS_HOURS = 1.0
 CS_FIELDS = [f"usage_{k}" for k in
              ("user", "system", "idle", "nice", "iowait", "irq",
@@ -484,7 +486,7 @@ def colstore_phase(cpu_timeout: float) -> dict:
 
 # ----------------------------------------------- prom rate (config 4)
 
-PROM_SERIES = int(os.environ.get("OG_BENCH_PROM_SERIES", "1000000"))
+PROM_SERIES = int(knobs.get("OG_BENCH_PROM_SERIES"))
 PROM_MINUTES = 10
 
 
@@ -563,7 +565,7 @@ def prom_phase(cpu_timeout: float) -> dict:
     # (the digest gate caught it at 1M series), so BOTH sides pin the
     # host fold — the measurement is the end-to-end prom path
     # (scan, fold, eval, format), not a device kernel
-    os.environ["OG_PROM_DEVICE_MIN_ROWS"] = str(1 << 62)
+    knobs.set_env("OG_PROM_DEVICE_MIN_ROWS", 1 << 62)
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
     with tempfile.TemporaryDirectory(prefix="og-prom-", dir=shm) as td:
         _register_tmp(td)
@@ -605,7 +607,7 @@ def prom_phase(cpu_timeout: float) -> dict:
 
 # -------------------------------------------------- scale (≥500M pts)
 
-SCALE_ROWS = int(os.environ.get("OG_BENCH_SCALE_ROWS", "500000000"))
+SCALE_ROWS = int(knobs.get("OG_BENCH_SCALE_ROWS"))
 SCALE_WINDOW_H = 12
 
 
@@ -811,8 +813,7 @@ def smoke_phase() -> dict:
 
 # the concurrent phase serves from a smaller host count than the
 # headline: admission ORDER is what's measured, not scan throughput
-CONC_HOSTS = int(os.environ.get("OG_BENCH_CONC_HOSTS",
-                                str(min(HOSTS, 1000))))
+CONC_HOSTS = int(knobs.get_raw("OG_BENCH_CONC_HOSTS") or min(HOSTS, 1000))
 CONC_DASH = 16
 
 
@@ -849,7 +850,7 @@ def concurrent_phase() -> dict:
             serial[key] = _digest_series(res)[0]
 
         def run_mode(sched_on: bool) -> dict:
-            os.environ["OG_SCHED"] = "1" if sched_on else "0"
+            knobs.set_env("OG_SCHED", "1" if sched_on else "0")
             cfg = Config()
             cfg.data.max_concurrent_queries = 1
             cfg.data.max_queued_queries = 64
@@ -933,7 +934,7 @@ def concurrent_phase() -> dict:
                         "wall_s": round(wall, 2)}
             finally:
                 srv.stop()
-                os.environ.pop("OG_SCHED", None)
+                knobs.del_env("OG_SCHED")
 
         sched = run_mode(True)
         base = run_mode(False)
@@ -957,20 +958,20 @@ def concurrent_phase() -> dict:
 
 # conservative wall-clock estimates (s) used to gate auxiliaries; a
 # phase only starts if the remaining budget covers its estimate
-EST_PROM = int(os.environ.get("OG_BENCH_EST_PROM", "1300"))
-EST_CS = int(os.environ.get("OG_BENCH_EST_CS", "420"))
-EST_CONC = int(os.environ.get("OG_BENCH_EST_CONC", "420"))
+EST_PROM = int(knobs.get("OG_BENCH_EST_PROM"))
+EST_CS = int(knobs.get("OG_BENCH_EST_CS"))
+EST_CONC = int(knobs.get("OG_BENCH_EST_CONC"))
 # measured at full 500M rows: ingest 211s + a CPU-pinned baseline
 # pass that alone exceeds 35 minutes — the phase needs ~50 min and
 # only runs under a generous driver budget (the gate skips it
 # honestly otherwise; OG_BENCH_SCALE_ROWS shrinks it for smoke runs)
-EST_SCALE = int(os.environ.get("OG_BENCH_EST_SCALE", "3000"))
+EST_SCALE = int(knobs.get("OG_BENCH_EST_SCALE"))
 # r04/r05 hit the DRIVER's external kill (rc 124) with the old 3300s
 # budget: the orchestrator's own gating only bounds phase STARTS, so
 # the total can overshoot the budget by a phase. 1800s keeps headline
 # + one auxiliary comfortably inside typical external timeouts; raise
 # OG_BENCH_BUDGET_S under a generous driver
-BUDGET_S = float(os.environ.get("OG_BENCH_BUDGET_S", "1800"))
+BUDGET_S = float(knobs.get("OG_BENCH_BUDGET_S"))
 
 
 def main():

@@ -19,7 +19,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-N_SERIES = int(os.environ.get("OG_SERIES_BENCH_N", "1000000"))
+from opengemini_tpu.utils import knobs  # noqa: E402
+
+N_SERIES = int(knobs.get("OG_SERIES_BENCH_N"))
 POINTS = 6                      # 6 samples @30s → one 5m rate window
 NS = 10**9
 
@@ -121,8 +123,8 @@ def main():
     out = {"metric": "series_index_1m", "unit": "mixed"}
     out.update(bench_index())
     prom_n = min(N_SERIES,
-                 int(os.environ.get("OG_SERIES_BENCH_PROM_N",
-                                    str(N_SERIES))))
+                 int(knobs.get_raw("OG_SERIES_BENCH_PROM_N")
+                     or N_SERIES))
     out.update(bench_prom_rate(prom_n))
     path = os.path.join(os.path.dirname(__file__),
                         "series_index_bench.json")

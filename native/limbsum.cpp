@@ -78,13 +78,17 @@ void og_finalize_exact(const double* limbs, int64_t n,
         const double* row = limbs + i * K;
         int64_t d[6];
         for (int64_t k = 0; k < K; k++) d[k] = (int64_t)row[k];
+        // left shifts of the (possibly negative) carries run in
+        // uint64: signed<<B is UB in C++17 (UBSan shift-base); the
+        // unsigned wrap is two's complement, so the cast round-trip
+        // is bit-identical to the old signed shift on every target
         for (int64_t k = K - 1; k > 0; k--) {
             int64_t c = d[k] >> B;  // arithmetic shift = floor
-            d[k] -= c << B;
+            d[k] -= (int64_t)((uint64_t)c << B);
             d[k - 1] += c;
         }
         int64_t top = d[0] >> B;
-        int64_t d0 = d[0] - (top << B);
+        int64_t d0 = d[0] - (int64_t)((uint64_t)top << B);
         // unsigned packing: |top| >= 2^17 rows are redone exactly by
         // the caller, so int64 wraparound here (UB if signed) is moot
         uint64_t p0_u = ((uint64_t)top * (uint64_t)(1LL << B)
@@ -136,7 +140,9 @@ void og_unpack_limbs(const uint32_t* u32, int64_t S, int64_t top_row,
                 }
             }
         }
-        digits[0] += top << 18;
+        // top may be negative: shift in uint64 (signed<<18 is UB,
+        // UBSan shift-base); two's-complement wrap == old behavior
+        digits[0] += (int64_t)((uint64_t)top << 18);
         double* row = out + s * K_full;
         for (int64_t k = 0; k < K_full; k++) row[k] = 0.0;
         for (int64_t k = 0; k < K && k + k0 < K_full; k++)

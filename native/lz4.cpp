@@ -76,8 +76,12 @@ int64_t og_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
             int64_t litlen = ip - anchor;
 
             // token + extended literal length + literals
+            if (op >= oend) return -1;  // token byte itself
             uint8_t* token = op++;
-            if (op + litlen + litlen / 255 + 8 > oend) return -1;
+            // capacity checks subtract (oend - op) instead of
+            // forming op+N: a pointer past one-past-the-end is UB
+            // (UBSan pointer-overflow) even when only compared
+            if (litlen + litlen / 255 + 8 > oend - op) return -1;
             if (litlen >= 15) {
                 *token = 15 << 4;
                 int64_t l = litlen - 15;
@@ -91,7 +95,7 @@ int64_t og_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
 
             // offset + extended match length
             uint16_t off = static_cast<uint16_t>(ip - match);
-            if (op + 2 + mlen / 255 + 1 > oend) return -1;
+            if (2 + mlen / 255 + 1 > oend - op) return -1;
             *op++ = static_cast<uint8_t>(off);
             *op++ = static_cast<uint8_t>(off >> 8);
             if (mlen >= 15) {
@@ -111,7 +115,8 @@ int64_t og_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
 
     // trailing literals
     int64_t litlen = iend - anchor;
-    if (op + 1 + litlen + litlen / 255 + 1 > oend) return -1;
+    if (op >= oend) return -1;
+    if (1 + litlen + litlen / 255 + 1 > oend - op) return -1;
     uint8_t* token = op++;
     if (litlen >= 15) {
         *token = 15 << 4;
@@ -145,14 +150,14 @@ int64_t og_lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
                 litlen += b;
             } while (b == 255);
         }
-        if (ip + litlen > iend || op + litlen > oend) return -1;
+        if (litlen > iend - ip || litlen > oend - op) return -1;
         std::memcpy(op, ip, litlen);
         ip += litlen;
         op += litlen;
         if (ip >= iend) break;  // last block: literals only
 
         // match
-        if (ip + 2 > iend) return -1;
+        if (2 > iend - ip) return -1;
         uint16_t off = static_cast<uint16_t>(ip[0] | (ip[1] << 8));
         ip += 2;
         if (off == 0 || op - dst < off) return -1;
@@ -166,7 +171,7 @@ int64_t og_lz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
             } while (b == 255);
         }
         mlen += MINMATCH;
-        if (op + mlen > oend) return -1;
+        if (mlen > oend - op) return -1;
         const uint8_t* match = op - off;
         // a match longer than its offset overlaps the output being written:
         // copy must run forward byte-by-byte

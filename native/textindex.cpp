@@ -52,6 +52,13 @@ inline uint8_t low(uint8_t c) {
     return (c >= 'A' && c <= 'Z') ? c + 32 : c;
 }
 
+// memcpy with a null-tolerant source: an empty std::vector's data()
+// may be nullptr, and memcpy's pointer args are declared nonnull —
+// UBSan (nonnull-attribute) rejects the zero-length call
+inline void copy_out(void* dst, const void* src, size_t n) {
+    if (n) std::memcpy(dst, src, n);
+}
+
 // Arena allocator for token keys (the reference's mempool.cpp analog):
 // tokens live for the whole build, so bump allocation with bulk free
 // beats per-string malloc.
@@ -218,9 +225,9 @@ int64_t og_ti_builder_finish(void* h, uint8_t** out) {
     std::memcpy(blob + 4, &ntok, 4);
     std::memcpy(blob + 8, &tb, 4);
     std::memcpy(blob + 12, &pb, 4);
-    std::memcpy(blob + 16, tab.data(), tab.size());
-    std::memcpy(blob + 16 + tab.size(), tokbytes.data(), tb);
-    std::memcpy(blob + 16 + tab.size() + tb, posts.data(), pb);
+    copy_out(blob + 16, tab.data(), tab.size());
+    copy_out(blob + 16 + tab.size(), tokbytes.data(), tb);
+    copy_out(blob + 16 + tab.size() + tb, posts.data(), pb);
     *out = blob;
     return total;
 }
@@ -384,7 +391,7 @@ int64_t og_ti_search_prefix(void* h, const char* prefix, int64_t len,
     std::sort(docs.begin(), docs.end());
     docs.erase(std::unique(docs.begin(), docs.end()), docs.end());
     if (int64_t(docs.size()) > cap) return -2;
-    std::memcpy(out, docs.data(), docs.size() * 4);
+    copy_out(out, docs.data(), docs.size() * 4);
     return int64_t(docs.size());
 }
 
@@ -421,7 +428,7 @@ int64_t og_ti_search_all(void* h, const char* text, int64_t len,
         acc.swap(nxt);
     }
     if (int64_t(acc.size()) > cap) return -2;
-    std::memcpy(out, acc.data(), acc.size() * 4);
+    copy_out(out, acc.data(), acc.size() * 4);
     return int64_t(acc.size());
 }
 

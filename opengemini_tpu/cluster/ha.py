@@ -94,9 +94,12 @@ class MigrateStateMachine:
                 failpoint.inject("ha.migrate.err")
                 self.meta.apply({"op": "set_pt_status", "db": ev.db,
                                  "pt_id": ev.pt_id, "status": PT_OFFLINE})
+                # background migration driver: bounded by
+                # max_attempts, never request-scoped — a deadline
+                # raise would escape the RPCError retry handler
                 self._client(target.addr).call(
                     "store.load_pt", {"db": ev.db, "pt": ev.pt_id},
-                    timeout=30.0)
+                    timeout=30.0)  # oglint: disable=R301
                 self.meta.apply({"op": "move_pt", "db": ev.db,
                                  "pt_id": ev.pt_id, "to_node": ev.to_node,
                                  "status": PT_ONLINE})

@@ -13,6 +13,7 @@ import threading
 import time
 
 from ..utils import failpoint, get_logger
+from ..utils.deadline import clamp as _dl_clamp
 from .meta_data import MetaData
 from .raft import NotLeader, RaftNode
 from .transport import RPCClient, RPCError, RPCServer
@@ -138,7 +139,8 @@ class MetaClient:
             for addr in self.addrs:
                 try:
                     resp = self._clients[addr].call(
-                        "meta.apply", {"cmd": cmd}, timeout=5.0)
+                        "meta.apply", {"cmd": cmd},
+                        timeout=_dl_clamp(5.0))
                 except RPCError as e:
                     last_err = e
                     continue
@@ -161,8 +163,9 @@ class MetaClient:
             best = None
             for addr in self.addrs:
                 try:
-                    resp = self._clients[addr].call("meta.snapshot", None,
-                                                    timeout=5.0)
+                    resp = self._clients[addr].call(
+                        "meta.snapshot", None,
+                        timeout=_dl_clamp(5.0))
                 except RPCError:
                     continue
                 if best is None or resp["version"] > best["version"] \

@@ -43,8 +43,11 @@ SNAPSHOT_EVERY = 4096          # log entries between snapshots
 
 # cumulative metrics for the statistics pusher (reference raft/meta
 # statistics analog)
-RAFT_STATS = {"elections_won": 0, "step_downs": 0, "snapshots": 0,
-              "proposes": 0}
+from ..utils.stats import register_counters
+
+RAFT_STATS = register_counters("raft", {
+    "elections_won": 0, "step_downs": 0, "snapshots": 0,
+    "proposes": 0})
 
 
 class NotLeader(Exception):
@@ -288,7 +291,8 @@ class RaftNode:
                 resp = self._client(pid).call(f"{self.msg_prefix}.vote", {
                     "term": term, "candidate": self.id,
                     "last_log_index": last_idx, "last_log_term": last_term,
-                }, timeout=1.0)
+                }, timeout=1.0)  # oglint: disable=R301 — election thread,
+                # never request-scoped (see replicate above)
             except RPCError:
                 return
             with lock:
@@ -515,7 +519,12 @@ class RaftNode:
         if failpoint.inject("raft.replicate.drop"):
             raise RPCError("failpoint: raft.replicate.drop")
         t_sent = time.monotonic()
-        resp = self._client(pid).call(kind, body, timeout=5.0)
+        # consensus-internal traffic: replicator threads are never
+        # request-scoped (contextvars do not cross threads), and the
+        # loop's `except RPCError` must stay the only exit — a
+        # deadline raise here would kill the peer's replication
+        resp = self._client(pid).call(
+            kind, body, timeout=5.0)  # oglint: disable=R301
         with self._lock:
             if self.state != LEADER or self.term != term:
                 return False

@@ -31,7 +31,7 @@ from ..query.incremental import (IncAggCache, complete_prefix,
                                  inc_fingerprint, inc_validate,
                                  trim_left, trim_right)
 from ..query.influxql import format_statement
-from ..utils import deadline, failpoint, get_logger
+from ..utils import deadline, failpoint, get_logger, knobs
 from ..utils.errors import ErrQueryError, ErrQueryTimeout, GeminiError
 from .meta_store import MetaClient
 from .points_writer import PointsWriter
@@ -40,14 +40,12 @@ from .transport import ClientPool, RPCClient, RPCError
 log = get_logger(__name__)
 
 # reader-replica query routing (eventual consistency — see map_pts)
-READER_ROUTING = __import__("os").environ.get(
-    "OG_READER_ROUTING", "1") != "0"
+READER_ROUTING = bool(knobs.get("OG_READER_ROUTING"))
 
 # how many store failures a scatter tolerates by default before the
 # query errors instead of degrading to a flagged partial result
 # (config: [data] max_failed_stores; influx partial-series analog)
-MAX_FAILED_STORES = int(__import__("os").environ.get(
-    "OG_MAX_FAILED_STORES", "0"))
+MAX_FAILED_STORES = int(knobs.get("OG_MAX_FAILED_STORES"))
 
 
 class ScatterResult(list):

@@ -27,6 +27,7 @@ from ..query.executor import (QueryExecutor, classify_select,
                               merge_partials)
 from ..query.influxql import parse_query
 from ..storage.engine import Engine, EngineOptions
+from ..utils.stats import bump as _bump_stat
 from ..storage.rows import PointRow
 from ..utils import failpoint, get_logger
 from .transport import RPCServer
@@ -80,6 +81,9 @@ class StoreNode:
                                         self._on_raft_commit,
                                 })
         self.addr = self.server.addr
+        # bumped from the RPC server's per-connection threads — a bare
+        # `+=` here is the unlocked read-modify-write oglint R6 exists
+        # to catch (utils.stats.bump holds the shared counter lock)
         self.stats = {"writes": 0, "rows_written": 0, "selects": 0}
         # per-PT raft replication (cluster/replication.py); wired by the
         # app wrapper once the node is registered with meta
@@ -180,8 +184,8 @@ class StoreNode:
         else:
             rows = rows_from_wire(body["rows"])
             n = self.engine.write_points(db_key(db, pt), rows)
-        self.stats["writes"] += 1
-        self.stats["rows_written"] += n
+        _bump_stat(self.stats, "writes")
+        _bump_stat(self.stats, "rows_written", n)
         return {"written": n}
 
     def _on_write_lines(self, body):
@@ -212,8 +216,8 @@ class StoreNode:
             n = ingest_lines(self.engine, db_key(db, pt), body["data"],
                              body.get("default_time_ns", 0),
                              body.get("precision", "ns"))
-        self.stats["writes"] += 1
-        self.stats["rows_written"] += n
+        _bump_stat(self.stats, "writes")
+        _bump_stat(self.stats, "rows_written", n)
         return {"written": n}
 
     def _on_ensure_group(self, body):
@@ -297,7 +301,7 @@ class StoreNode:
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
         barrier_sound = self._read_barrier(db, pts)
-        self.stats["selects"] += 1
+        _bump_stat(self.stats, "selects")
         partials = []
         for pt in pts:
             dbk = db_key(db, pt)
@@ -340,7 +344,7 @@ class StoreNode:
         stmt = self._parse_select(body["q"])
         db, pts = body["db"], body["pts"]
         barrier_sound = self._read_barrier(db, pts)
-        self.stats["selects"] += 1
+        _bump_stat(self.stats, "selects")
         pushdown_limit = 0
         if stmt.limit and not stmt.offset:
             pushdown_limit = stmt.limit

@@ -36,9 +36,12 @@ from ..utils import deadline, failpoint, get_logger
 log = get_logger(__name__)
 
 # cumulative transport metrics (reference statistics/spdy.go analog)
-RPC_STATS = {"requests": 0, "responses": 0, "errors": 0,
-             "bytes_in": 0, "bytes_out": 0,
-             "breaker_trips": 0, "breaker_fast_fails": 0}
+from ..utils.stats import register_counters
+
+RPC_STATS = register_counters("rpc", {
+    "requests": 0, "responses": 0, "errors": 0,
+    "bytes_in": 0, "bytes_out": 0,
+    "breaker_trips": 0, "breaker_fast_fails": 0})
 
 MAX_FRAME = 1 << 30
 

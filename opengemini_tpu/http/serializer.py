@@ -27,22 +27,20 @@ BENCH and /debug/vars attribute emit cost separately from finalize.
 from __future__ import annotations
 
 import json
-import os
 import threading
 from typing import Iterable, Iterator
+
+from ..utils import knobs
 
 _COALESCE = 256 * 1024          # target piece size handed to the socket
 
 
 def stream_queue_depth() -> int:
-    try:
-        return max(1, int(os.environ.get("OG_STREAM_QUEUE", "8")))
-    except ValueError:
-        return 8
+    return max(1, int(knobs.get("OG_STREAM_QUEUE")))
 
 
 def stream_json_enabled() -> bool:
-    return os.environ.get("OG_STREAM_JSON", "1") != "0"
+    return bool(knobs.get("OG_STREAM_JSON"))
 
 
 # -------------------------------------------------------------- encoder

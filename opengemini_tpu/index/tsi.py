@@ -41,14 +41,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..utils import get_logger
+from ..utils import get_logger, knobs
 from .. import native as _native
 
 log = get_logger(__name__)
 
 _HDR = struct.calcsize("<IQ")
 # snapshot when the un-snapshotted log tail exceeds this (bytes)
-SNAP_THRESHOLD = int(os.environ.get("OG_TSI_SNAP_BYTES", str(4 << 20)))
+SNAP_THRESHOLD = int(knobs.get("OG_TSI_SNAP_BYTES"))
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,39 @@
+"""oglint — repo-specific AST invariant linter (tier-1 gate).
+
+Six rule classes enforce the conventions the device hot path's
+correctness rests on (see each rule module for the full contract):
+
+- R1 transfer discipline (``transfer_rule``): D2H pulls in hot-path
+  modules ride ``ops.pipeline.device_get_parallel`` or an explicitly
+  accounted transport — never bare ``jax.device_get``/implicit
+  ``np.asarray`` on device values — so the devstats D2H byte counters
+  stay truthful.
+- R2 knob registry (``knob_rule``): every ``OG_*`` environment read
+  goes through ``utils.knobs``; raw ``os.environ`` reads, unregistered
+  knob names and README knob-table drift are errors.
+- R3 deadline propagation (``deadline_rule``): cluster RPC call sites
+  thread the PR-1 deadline context (``deadline.clamp``) instead of
+  hard-coding timeouts; raw sockets live in transport.py only.
+- R4 lock ranks (``lockrank_rule``): static half of utils/lockrank.py —
+  no blocking calls inside ranked critical sections, no nested
+  acquisitions that contradict the declared ranks.
+- R5 trace purity (``trace_rule``): functions reachable from
+  ``jax.jit`` roots touch no env vars, locks, RNG, wall clocks or
+  module state — host-side control flow must stay out of traced code.
+- R6 counter hygiene (``counter_rule``): metric names come from the
+  ``utils.stats.register_counters`` registry and shared-counter
+  read-modify-writes hold the stats lock.
+
+Run: ``python scripts/oglint.py`` (or ``python -m opengemini_tpu.lint``).
+Suppressions: a trailing ``# oglint: disable=R103`` comment disables
+named rules (or rule classes, e.g. ``R1``) for that line; self-tests
+cover every rule with failing and passing fixtures
+(tests/test_oglint.py + tests/lint_fixtures/).
+"""
+
+from __future__ import annotations
+
+from .core import Violation, run_lint  # noqa: F401
+from .__main__ import main  # noqa: F401
+
+__all__ = ["Violation", "run_lint", "main"]

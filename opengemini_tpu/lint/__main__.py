@@ -1,0 +1,94 @@
+"""oglint CLI: ``python -m opengemini_tpu.lint`` / scripts/oglint.py.
+
+Modes:
+- default: run all six rule classes over the repo, print violations,
+  exit 1 if any (the tier-1/CI gate).
+- ``--rules R1,R4``: restrict to named rule classes.
+- ``--knob-table``: print the generated README knob table and exit.
+- ``--fix-readme``: rewrite the README's generated knob block in
+  place from the registry.
+- ``--list``: print rule ids + codes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+
+def _repo_root() -> str:
+    return os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        "..", ".."))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="oglint", description="repo-specific invariant linter")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: whole repo)")
+    ap.add_argument("--root", default=_repo_root())
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids (R1..R6)")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the generated README knob table")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="rewrite README's knob table from the registry")
+    ap.add_argument("--list", action="store_true", dest="list_rules")
+    args = ap.parse_args(argv)
+
+    from ..utils import knobs
+    from .core import default_rules, run_lint
+    from .knob_rule import README_BEGIN, README_END
+
+    if args.knob_table:
+        print(README_BEGIN)
+        print(knobs.knob_table_md())
+        print(README_END)
+        return 0
+
+    if args.fix_readme:
+        path = os.path.join(args.root, "README.md")
+        text = open(path, encoding="utf-8").read()
+        block = (README_BEGIN + "\n" + knobs.knob_table_md()
+                 + "\n" + README_END)
+        if README_BEGIN in text:
+            text = re.sub(re.escape(README_BEGIN) + r".*?"
+                          + re.escape(README_END), block, text,
+                          flags=re.S)
+        else:
+            text = text.rstrip("\n") + "\n\n" + block + "\n"
+        open(path, "w", encoding="utf-8").write(text)
+        print(f"README knob table rewritten ({path})")
+        return 0
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(r.rule_id, type(r).__name__)
+            for code, desc in r.codes.items():
+                print(f"  {code}: {desc}")
+        return 0
+
+    if args.rules:
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.rule_id in want]
+        if not rules:
+            print(f"no rules match {args.rules!r}", file=sys.stderr)
+            return 2
+
+    vs = run_lint(args.root, rules=rules, paths=args.paths or None)
+    for v in vs:
+        print(v)
+    ran = ",".join(r.rule_id for r in rules)
+    if vs:
+        print(f"\noglint: {len(vs)} violation(s) [{ran}]",
+              file=sys.stderr)
+        return 1
+    print(f"oglint: clean [{ran}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""oglint engine: file walking, pragma suppression, rule protocol.
+
+Rules are module-level objects with ``rule_id`` ("R1".."R6"), a
+``codes`` doc map and ``check(ctx) -> list[Violation]``. Each gets a
+``FileCtx`` per scanned file (parsed AST + source + per-line pragma
+set) plus, after all files are parsed, one ``finish(repo)`` pass for
+cross-file rules (counter registries, README drift).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# directories never scanned (tests are exercised code, not hot-path
+# invariant surface — and the lint fixtures live there on purpose)
+_SKIP_DIRS = {".git", "__pycache__", "tests", ".claude", "node_modules",
+              "related"}
+
+_PRAGMA_RE = re.compile(r"#\s*oglint:\s*(disable=([A-Za-z0-9_,]+)"
+                        r"|skip-file)")
+
+
+@dataclass(order=True)
+class Violation:
+    path: str
+    line: int
+    code: str
+    msg: str = field(compare=False)
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+
+class FileCtx:
+    """One parsed file: AST, raw source and pragma suppressions."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path                       # repo-relative, posix
+        self.abspath = os.path.join(root, path)
+        with open(self.abspath, "rb") as f:
+            raw = f.read()
+        self.source = raw.decode("utf-8", errors="replace")
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self.skip_file = False
+        # line → set of disabled rule prefixes ("R1", "R103", ...)
+        self.disabled: dict[int, set] = {}
+        self._scan_pragmas(raw)
+
+    def _scan_pragmas(self, raw: bytes) -> None:
+        """Tokenize for comments (string literals containing 'oglint:'
+        must not suppress anything)."""
+        import io
+        try:
+            toks = tokenize.tokenize(io.BytesIO(raw).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if not m:
+                    continue
+                if m.group(1) == "skip-file":
+                    self.skip_file = True
+                    continue
+                rules = {r.strip().upper()
+                         for r in m.group(2).split(",") if r.strip()}
+                self.disabled.setdefault(tok.start[0], set()).update(
+                    rules)
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, line: int, code: str) -> bool:
+        dis = self.disabled.get(line)
+        if not dis:
+            return False
+        # "R1" disables every R1xx code; "R103" only itself
+        return any(code.startswith(d) for d in dis)
+
+
+class Rule:
+    rule_id = "R?"
+    codes: dict[str, str] = {}
+
+    def check(self, ctx: FileCtx) -> list[Violation]:  # per file
+        return []
+
+    def finish(self, repo: "Repo") -> list[Violation]:  # cross-file
+        return []
+
+
+class Repo:
+    """All parsed files plus shared lookups rules build during check()
+    and consume in finish()."""
+
+    def __init__(self, root: str, ctxs: list[FileCtx]):
+        self.root = root
+        self.ctxs = ctxs
+        self.shared: dict = {}
+
+
+def collect_files(root: str, paths: list[str] | None = None) -> list[str]:
+    """Repo-relative paths of every scannable .py file. ``paths``
+    restricts to explicit files/dirs (still repo-relative)."""
+    if paths:
+        out = []
+        for p in paths:
+            a = os.path.join(root, p)
+            if os.path.isdir(a):
+                out.extend(collect_files(root, [
+                    os.path.join(p, f) for f in sorted(os.listdir(a))]))
+            elif p.endswith(".py"):
+                out.append(p.replace(os.sep, "/"))
+        return out
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+        rel = os.path.relpath(dirpath, root)
+        for f in sorted(filenames):
+            if not f.endswith(".py"):
+                continue
+            p = f if rel == "." else os.path.join(rel, f)
+            out.append(p.replace(os.sep, "/"))
+    return out
+
+
+def default_rules() -> list[Rule]:
+    from .counter_rule import CounterRule
+    from .deadline_rule import DeadlineRule
+    from .knob_rule import KnobRule
+    from .lockrank_rule import LockRankRule
+    from .trace_rule import TraceRule
+    from .transfer_rule import TransferRule
+    return [TransferRule(), KnobRule(), DeadlineRule(),
+            LockRankRule(), TraceRule(), CounterRule()]
+
+
+def run_lint(root: str, rules: list[Rule] | None = None,
+             paths: list[str] | None = None) -> list[Violation]:
+    """Run ``rules`` (default: all six classes) over the repo at
+    ``root``; returns sorted, pragma-filtered violations."""
+    rules = rules if rules is not None else default_rules()
+    ctxs = []
+    violations: list[Violation] = []
+    for p in collect_files(root, paths):
+        try:
+            ctx = FileCtx(root, p)
+        except (SyntaxError, OSError) as e:
+            violations.append(Violation(p, getattr(e, "lineno", 0) or 0,
+                                        "R000", f"unparseable: {e}"))
+            continue
+        if ctx.skip_file:
+            continue
+        ctxs.append(ctx)
+    repo = Repo(root, ctxs)
+    for ctx in ctxs:
+        for rule in rules:
+            for v in rule.check(ctx):
+                if not ctx.suppressed(v.line, v.code):
+                    violations.append(v)
+    for rule in rules:
+        for v in rule.finish(repo):
+            ctx = next((c for c in ctxs if c.path == v.path), None)
+            if ctx is None or not ctx.suppressed(v.line, v.code):
+                violations.append(v)
+    return sorted(violations)
+
+
+# ------------------------------------------------------- AST helpers
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        # __import__("os").environ
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "__import__" \
+                and node.args and isinstance(node.args[0], ast.Constant):
+            parts.append(str(node.args[0].value))
+            return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node

@@ -1,0 +1,263 @@
+"""R6 — counter hygiene: one metric registry, locked increments.
+
+The metric surface (/metrics, /debug/vars, the stats pusher) is built
+from module-level counter dicts. Two invariants keep it trustworthy:
+
+1. **One registry.** Every shared counter dict is declared through
+   ``utils.stats.register_counters`` and every metric NAME written at
+   a bump site must exist in the dict's literal declaration — a typo'd
+   key would silently mint a new metric that no dashboard watches
+   while the real one stays flat.
+2. **Locked read-modify-write.** ``d[k] += n`` on a shared dict is a
+   lost-update race under the threaded HTTP/RPC servers and the pull
+   pool (PR 4 measured real drops); increments go through
+   ``utils.stats.bump`` (which holds COUNTER_LOCK) or hold a lock at
+   the site.
+
+Codes:
+- R601: module-level ``*_STATS`` dict not registered via
+  register_counters.
+- R602: bump with a metric name missing from the dict's declaration
+  (checked through module-local wrappers and cross-module aliases —
+  ``devstats.bump("d2h_bytez")`` is caught).
+- R603: unlocked ``+=``/read-modify-write on a registered counter
+  dict or a ``self.stats`` attribute.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileCtx, Repo, Rule, Violation, const_str, dotted
+
+_STATS_NAME = re.compile(r"(_STATS|_PHASE_NS)$")
+_BUMP_FNS = {"bump", "_b", "_bump", "_bump_stat", "_bump_r",
+             "_bump_plane"}
+
+
+def _dict_literal_keys(node: ast.AST) -> set[str] | None:
+    if isinstance(node, ast.Call) and node.args:
+        # register_counters("name", {...})
+        d = dotted(node.func)
+        if d.endswith("register_counters") and len(node.args) >= 2:
+            node = node.args[1]
+    if isinstance(node, ast.Dict):
+        keys = set()
+        for k in node.keys:
+            s = const_str(k)
+            if s is None:
+                return None          # computed key: can't verify
+            keys.add(s)
+        return keys
+    return None
+
+
+class _ModuleInfo:
+    """Per-file facts gathered in check(), joined in finish()."""
+
+    def __init__(self):
+        self.counter_keys: dict[str, set] = {}   # dict name -> keys
+        self.registered: set = set()             # dict names registered
+        # wrapper name -> (dict name, key suffix) for one-arg bumpers
+        self.wrappers: dict[str, tuple[str, str]] = {}
+        # alias -> module basename for `from . import devstats as _ds`
+        self.mod_aliases: dict[str, str] = {}
+        self.pending: list = []    # (line, alias, fnname, key)
+
+
+class CounterRule(Rule):
+    rule_id = "R6"
+    codes = {
+        "R601": "counter dict not registered via register_counters",
+        "R602": "bump key missing from the counter declaration",
+        "R603": "unlocked read-modify-write on a shared counter",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not ctx.path.startswith("opengemini_tpu/"):
+            return []
+        info = _ModuleInfo()
+        out: list[Violation] = []
+        self._collect_decls(ctx, info, out)
+        self._collect_wrappers(ctx, info)
+        self._collect_aliases(ctx, info)
+        self._check_bumps(ctx, info, out)
+        self._check_rmw(ctx, info, out)
+        repo_key = "counter_rule.modules"
+        # stash for the cross-module finish pass
+        ctx_mod = ctx.path.rsplit("/", 1)[-1][:-3]
+        self._repo_stash.setdefault(repo_key, {})[ctx_mod] = info
+        return out
+
+    # check() instances are fresh per run_lint (default_rules()), so
+    # instance state is a safe stash between check() and finish()
+    def __init__(self):
+        self._repo_stash: dict = {}
+
+    # ------------------------------------------------- declarations
+
+    def _collect_decls(self, ctx, info, out) -> None:
+        for node in ctx.tree.body:
+            tgt = None
+            val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, val = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                tgt, val = node.target.id, node.value
+            if tgt is None or val is None:
+                continue
+            if not _STATS_NAME.search(tgt):
+                continue
+            keys = _dict_literal_keys(val)
+            if keys is None:
+                continue
+            info.counter_keys[tgt] = keys
+            is_reg = isinstance(val, ast.Call) and dotted(
+                val.func).endswith("register_counters")
+            if is_reg:
+                info.registered.add(tgt)
+            else:
+                out.append(Violation(
+                    ctx.path, node.lineno, "R601",
+                    f"counter dict {tgt} must be declared through "
+                    "utils.stats.register_counters() so the metric "
+                    "namespace has one registry"))
+
+    def _collect_wrappers(self, ctx, info) -> None:
+        """def bump(key, n=1): _b(DICT, key [+ '_sfx'], n) wrappers."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.FunctionDef) or not node.args.args:
+                continue
+            param = node.args.args[0].arg
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or len(sub.args) < 2:
+                    continue
+                if dotted(sub.func).split(".")[-1] not in ("bump", "_b"):
+                    continue
+                if not isinstance(sub.args[0], ast.Name):
+                    continue
+                dname = sub.args[0].id
+                if dname not in info.counter_keys:
+                    continue
+                karg = sub.args[1]
+                if isinstance(karg, ast.Name) and karg.id == param:
+                    info.wrappers[node.name] = (dname, "")
+                elif isinstance(karg, ast.BinOp) \
+                        and isinstance(karg.op, ast.Add) \
+                        and isinstance(karg.left, ast.Name) \
+                        and karg.left.id == param \
+                        and const_str(karg.right) is not None:
+                    info.wrappers[node.name] = (dname,
+                                                const_str(karg.right))
+
+    def _collect_aliases(self, ctx, info) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and not node.level == 0:
+                for a in node.names:
+                    info.mod_aliases[a.asname or a.name] = \
+                        node.module.rsplit(".", 1)[-1] \
+                        if a.name == "*" else a.name
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    info.mod_aliases[a.asname or a.name] = a.name
+
+    # ------------------------------------------------------- bumps
+
+    def _check_bumps(self, ctx, info, out) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            base = d.split(".")[-1] if d else ""
+            # two-arg form: bump(DICT, "key")
+            if base in _BUMP_FNS and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Name):
+                dname = node.args[0].id
+                key = const_str(node.args[1])
+                keys = info.counter_keys.get(dname)
+                if keys is not None and key is not None \
+                        and key not in keys:
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R602",
+                        f"metric {key!r} is not declared in {dname} — "
+                        "typo'd counter names mint unwatched metrics"))
+            # one-arg wrapper in the same module: bump("key")
+            elif base in info.wrappers and node.args:
+                key = const_str(node.args[0])
+                if key is None:
+                    continue
+                dname, sfx = info.wrappers[base]
+                if key + sfx not in info.counter_keys[dname]:
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R602",
+                        f"metric {key + sfx!r} is not declared in "
+                        f"{dname}"))
+            # cross-module: alias.bump("key") — resolved in finish()
+            elif "." in d and node.args:
+                alias, fnname = d.rsplit(".", 1)
+                key = const_str(node.args[0])
+                if fnname in _BUMP_FNS and key is not None \
+                        and "." not in alias:
+                    mod = info.mod_aliases.get(alias, alias)
+                    info.pending.append(
+                        (ctx.path, node.lineno, mod, fnname, key))
+
+    def finish(self, repo: Repo) -> list[Violation]:
+        mods = self._repo_stash.get("counter_rule.modules", {})
+        out = []
+        for info in mods.values():
+            for path, line, mod, fnname, key in info.pending:
+                target = mods.get(mod)
+                if target is None:
+                    continue
+                wrap = target.wrappers.get(fnname)
+                if wrap is None:
+                    continue
+                dname, sfx = wrap
+                if key + sfx not in target.counter_keys.get(dname, ()):
+                    out.append(Violation(
+                        path, line, "R602",
+                        f"metric {key + sfx!r} is not declared in "
+                        f"{mod}.{dname}"))
+        return out
+
+    # ------------------------------------------------------- RMW
+
+    def _check_rmw(self, ctx, info, out) -> None:
+        lock_depth = [0]
+
+        def walk(node, in_lock: bool):
+            if isinstance(node, ast.With):
+                held = in_lock or any(
+                    "lock" in dotted(i.context_expr).lower()
+                    or "LOCK" in dotted(i.context_expr)
+                    for i in node.items)
+                for child in node.body:
+                    walk(child, held)
+                return
+            if isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Subscript):
+                tv = node.target.value
+                shared = (isinstance(tv, ast.Name)
+                          and tv.id in info.counter_keys) or \
+                         (dotted(tv) == "self.stats")
+                if shared and not in_lock:
+                    nm = dotted(tv) or getattr(tv, "id", "?")
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R603",
+                        f"unlocked read-modify-write on shared "
+                        f"counter {nm}[...] — use utils.stats.bump "
+                        "(lost updates under the threaded servers)"))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk(child, False)
+                else:
+                    walk(child, in_lock)
+
+        walk(ctx.tree, False)
+        del lock_depth

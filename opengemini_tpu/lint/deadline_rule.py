@@ -1,0 +1,78 @@
+"""R3 — deadline propagation through the cluster RPC plane.
+
+PR 1 introduced the end-to-end deadline context
+(``utils.deadline``): the HTTP layer binds a budget and every wait on
+the request path clamps by what remains. The transport's own
+``call_stream`` clamps internally, but a cluster-layer call site that
+hard-codes ``timeout=30.0`` re-introduces a wait the budget cannot
+curtail — a dead store node then burns 30s of a 5s request.
+
+Scope: ``opengemini_tpu/cluster/*`` (transport.py is the
+implementation and owns its raw sockets/timeouts).
+
+Codes:
+- R301: RPC call (``.call``/``.try_call``/``.call_stream``) passing a
+  numeric-literal ``timeout=`` — wrap it in ``deadline.clamp(...)``
+  (a no-op when no deadline is bound, the curtailed wait otherwise).
+- R302: raw ``socket`` use outside transport.py — all wire I/O goes
+  through the transport so breakers, stats and deadline clamping
+  cannot be bypassed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+
+_SCOPE = "opengemini_tpu/cluster/"
+_IMPL = "opengemini_tpu/cluster/transport.py"
+_RPC_METHODS = {"call", "try_call", "call_stream"}
+
+
+class DeadlineRule(Rule):
+    rule_id = "R3"
+    codes = {
+        "R301": "literal RPC timeout not clamped by the deadline",
+        "R302": "raw socket use outside transport.py",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not ctx.path.startswith(_SCOPE) or ctx.path == _IMPL:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _RPC_METHODS:
+                    for kw in node.keywords:
+                        if kw.arg == "timeout" and isinstance(
+                                kw.value, ast.Constant) and isinstance(
+                                kw.value.value, (int, float)):
+                            # anchor to the timeout= line itself so a
+                            # site pragma sits next to the literal it
+                            # excuses (multi-line calls)
+                            out.append(Violation(
+                                ctx.path, kw.value.lineno, "R301",
+                                f"RPC {f.attr}() with literal timeout="
+                                f"{kw.value.value}: wrap in "
+                                "deadline.clamp(...) so the PR-1 "
+                                "request budget curtails the wait"))
+            d = dotted(node) if isinstance(
+                node, (ast.Attribute, ast.Name)) else ""
+            if d == "socket" or d.startswith("socket."):
+                out.append(Violation(
+                    ctx.path, node.lineno, "R302",
+                    "raw socket use outside cluster/transport.py — "
+                    "wire I/O must ride the transport (breakers, "
+                    "RPC_STATS, deadline clamping)"))
+        # de-dup attribute-chain hits on the same line
+        seen = set()
+        uniq = []
+        for v in out:
+            key = (v.line, v.code)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(v)
+        return uniq

@@ -1,0 +1,146 @@
+"""R2 — OG_* knob registry discipline.
+
+All ``OG_*`` environment knobs are declared once in
+``opengemini_tpu/utils/knobs.py`` (name, type, default, doc, scope)
+and read through it. A raw ``os.environ`` read scattered in a module
+is exactly how the pre-registry tree ended up with per-launch env
+parses in dispatch loops and ~50 undocumented knobs; a knob name not
+in the registry is a typo waiting to steer the hot path to a default.
+
+Codes:
+- R201: raw environment READ of an OG_* name outside utils/knobs.py
+  (os.environ.get / os.getenv / os.environ[...] — including the
+  ``__import__("os")`` spelling).
+- R202: raw environment WRITE of an OG_* name (os.environ[...] = /
+  .pop/.setdefault) — use knobs.set_env/del_env, which keep the
+  hot-path parse memo coherent.
+- R203: knob-name string passed to knobs.get/get_raw/set_env/del_env
+  that is not registered.
+- R204: README knob table drifted from the registry (finish pass;
+  regenerate with ``python -m opengemini_tpu.lint --knob-table``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import FileCtx, Repo, Rule, Violation, const_str, dotted
+
+_EXEMPT = ("opengemini_tpu/utils/knobs.py",)
+
+_KNOB_FNS = {"get", "get_raw", "set_env", "del_env", "is_registered",
+             "invalidate"}
+
+README_BEGIN = "<!-- OGLINT-KNOBS-BEGIN (generated: python -m opengemini_tpu.lint --knob-table) -->"
+README_END = "<!-- OGLINT-KNOBS-END -->"
+
+
+def _og_name(node: ast.AST) -> str | None:
+    s = const_str(node)
+    if s is not None and s.startswith("OG_"):
+        return s
+    return None
+
+
+class KnobRule(Rule):
+    rule_id = "R2"
+    codes = {
+        "R201": "raw os.environ read of an OG_* knob",
+        "R202": "raw os.environ write of an OG_* knob",
+        "R203": "unregistered knob name",
+        "R204": "README knob table drift",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if ctx.path in _EXEMPT:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            out.extend(self._check_node(ctx, node))
+        return out
+
+    def _check_node(self, ctx, node) -> list[Violation]:
+        out = []
+        # reads: os.environ.get("OG_X") / os.getenv("OG_X")
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d.endswith(("os.environ.get", "environ.get", "os.getenv")) \
+                    and node.args:
+                n = _og_name(node.args[0])
+                if n:
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R201",
+                        f"raw environment read of {n}: use "
+                        "opengemini_tpu.utils.knobs.get()"))
+            if d.endswith(("os.environ.pop", "environ.pop",
+                           "os.environ.setdefault")) and node.args:
+                n = _og_name(node.args[0])
+                if n:
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R202",
+                        f"raw environment write of {n}: use "
+                        "knobs.del_env()/set_env()"))
+            # unregistered names through the registry API
+            if d.startswith("knobs.") or d.startswith("_knobs."):
+                fn = d.split(".", 1)[1]
+                if fn in _KNOB_FNS and node.args:
+                    n = _og_name(node.args[0])
+                    if n and not self._registered(n):
+                        out.append(Violation(
+                            ctx.path, node.lineno, "R203",
+                            f"knob {n} is not declared in "
+                            "utils/knobs.py"))
+        # subscript read/write: os.environ["OG_X"]
+        if isinstance(node, ast.Subscript):
+            d = dotted(node.value)
+            if d.endswith("os.environ") or d == "environ":
+                n = _og_name(node.slice)
+                if n:
+                    is_store = isinstance(getattr(node, "ctx", None),
+                                          (ast.Store, ast.Del))
+                    out.append(Violation(
+                        ctx.path, node.lineno,
+                        "R202" if is_store else "R201",
+                        f"raw environment "
+                        f"{'write' if is_store else 'read'} of {n}: "
+                        "use knobs."
+                        f"{'set_env()' if is_store else 'get()'}"))
+        return out
+
+    @staticmethod
+    def _registered(name: str) -> bool:
+        from ..utils import knobs
+        return knobs.is_registered(name)
+
+    # ---------------------------------------------- README drift pass
+
+    def finish(self, repo: Repo) -> list[Violation]:
+        readme = os.path.join(repo.root, "README.md")
+        if not os.path.exists(readme):
+            return []
+        text = open(readme, encoding="utf-8").read()
+        if README_BEGIN not in text:
+            return [Violation(
+                "README.md", 1, "R204",
+                "README has no generated knob table (expected the "
+                f"marker {README_BEGIN!r}); append one via "
+                "python -m opengemini_tpu.lint --knob-table")]
+        m = re.search(re.escape(README_BEGIN) + r"\n(.*?)"
+                      + re.escape(README_END), text, re.S)
+        if not m:
+            return [Violation("README.md", 1, "R204",
+                              "knob table BEGIN marker without END")]
+        from ..utils import knobs
+        want = knobs.knob_table_md().strip()
+        got = m.group(1).strip()
+        if want != got:
+            line = text[:m.start()].count("\n") + 1
+            return [Violation(
+                "README.md", line, "R204",
+                "README knob table drifted from utils/knobs.py — "
+                "regenerate: python -m opengemini_tpu.lint "
+                "--knob-table > (paste between markers), or "
+                "scripts/oglint.py --fix-readme")]
+        return []

@@ -1,0 +1,136 @@
+"""R4 — static lock-rank and no-blocking-under-lock scan.
+
+Static half of ``utils/lockrank.py`` (the runtime checker catches what
+crosses function boundaries; this pass catches what is visible in one
+function body — before any test has to hit the interleaving):
+
+- R401: a blocking call inside a ``with``-block on a ranked lock —
+  ``time.sleep``, ``Future.result``, ``Thread.join``, ``Event.wait``,
+  ``device_get_parallel``, ``block_until_ready``, subprocess/socket
+  waits. A ranked critical section on the dispatcher thread that
+  sleeps or pulls wedges every queued launch behind it. A Condition
+  built ON the held lock is exempt (``wait`` releases it).
+- R402: lexically nested ``with`` acquisitions whose declared ranks do
+  not strictly increase inward.
+
+The lock-name → rank map mirrors utils/lockrank.py; attribute locks
+(``self._lock``) are ranked per owning module. Files outside the lock
+web's modules are not scanned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+
+# per-file rank of `self._lock` (mirrors the RankedLock declarations)
+_SELF_LOCK_RANK = {
+    "opengemini_tpu/query/scheduler.py": 10,
+    "opengemini_tpu/ops/devicecache.py": 20,
+    "opengemini_tpu/ops/pipeline.py": 30,
+}
+
+# module-level lock names → rank, valid in any scanned file
+_NAMED_RANK = {
+    "_SCHED_LOCK": 5,
+    "_BASE_FILL_LOCKS": 15,
+    "_PULL_POOL_LOCK": 25,
+    "COUNTER_LOCK": 40,
+    "_stats_lock": 41,       # http server's own stats lock (leaf)
+}
+
+# Condition variables constructed on the ranked lock they guard:
+# cond.wait() RELEASES the lock, so it is not a blocking call under it
+_COND_ON_LOCK = {"_dcv"}
+
+_BLOCKING = ("time.sleep", "sleep")
+_BLOCKING_ATTRS = {"result", "join", "wait", "block_until_ready",
+                   "device_get_parallel", "check_output", "run",
+                   "communicate", "recv", "accept", "get"}
+# .get() on dicts/caches is ubiquitous and non-blocking; only flag the
+# queue-flavored receivers
+_GET_RECEIVERS = {"queue", "q", "_dq"}
+
+
+def _lock_rank(path: str, expr: ast.AST) -> tuple[str, int] | None:
+    """(name, rank) when ``with <expr>`` acquires a ranked lock."""
+    d = dotted(expr)
+    if d == "self._lock" and path in _SELF_LOCK_RANK:
+        return d, _SELF_LOCK_RANK[path]
+    base = d.split(".")[-1] if d else ""
+    if base in _NAMED_RANK:
+        return base, _NAMED_RANK[base]
+    # _base_fill_lock(...) helper returns a ranked stripe
+    if isinstance(expr, ast.Call):
+        fd = dotted(expr.func)
+        if fd.endswith("_base_fill_lock"):
+            return "_BASE_FILL_LOCKS", 15
+    return None
+
+
+class LockRankRule(Rule):
+    rule_id = "R4"
+    codes = {
+        "R401": "blocking call while holding a ranked lock",
+        "R402": "nested lock acquisition violates declared ranks",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if ctx.path not in _SELF_LOCK_RANK and not any(
+                n in ctx.source for n in _NAMED_RANK):
+            return []
+        out: list[Violation] = []
+        self._walk(ctx, ctx.tree, [], out)
+        return out
+
+    def _walk(self, ctx, node, held: list, out: list) -> None:
+        """DFS carrying the stack of lexically-held ranked locks."""
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                lk = _lock_rank(ctx.path, item.context_expr)
+                if lk is not None:
+                    if held and lk[1] <= held[-1][1]:
+                        out.append(Violation(
+                            ctx.path, node.lineno, "R402",
+                            f"acquires {lk[0]!r} (rank {lk[1]}) while "
+                            f"holding {held[-1][0]!r} (rank "
+                            f"{held[-1][1]}) — ranks must strictly "
+                            "increase inward (utils/lockrank.py)"))
+                    acquired.append(lk)
+            held = held + acquired
+            for child in node.body:
+                self._walk(ctx, child, held, out)
+            return
+        if isinstance(node, ast.Call) and held:
+            self._check_blocking(ctx, node, held, out)
+        # don't descend into nested function definitions: their bodies
+        # run later, not under this lock
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                self._walk(ctx, child, [], out)
+            else:
+                self._walk(ctx, child, held, out)
+
+    def _check_blocking(self, ctx, node, held, out) -> None:
+        d = dotted(node.func)
+        blocking = d in _BLOCKING
+        if not blocking and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_ATTRS:
+                recv = dotted(node.func.value)
+                base = recv.split(".")[-1] if recv else ""
+                if attr == "get" and base not in _GET_RECEIVERS:
+                    return
+                if base in _COND_ON_LOCK:
+                    return          # cond.wait releases the held lock
+                blocking = True
+        if blocking:
+            out.append(Violation(
+                ctx.path, node.lineno, "R401",
+                f"blocking call {d or node.func.attr!r} while holding "
+                f"ranked lock {held[-1][0]!r} — move it outside the "
+                "critical section (a wedged dispatcher blocks every "
+                "queued launch)"))

@@ -1,0 +1,158 @@
+"""R5 — trace purity: no host state inside jit-traced code.
+
+Functions reachable from a ``jax.jit`` site execute at TRACE time:
+an ``os.environ`` read there bakes the value of the first trace into
+the compiled kernel forever (a flipped knob silently does nothing —
+or worse, does something on the next cache miss); a lock acquire or
+RNG call runs once per compilation, not per execution, which is
+near-impossible to reason about. The "Control Flow Duplication for
+Columnar Arrays" reference (PAPERS.md) makes the same demand of
+columnar kernels: host-side control flow stays OUT of the kernel.
+
+Detection: jit roots are functions decorated ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)`` or passed to a ``jax.jit(...)``
+call by name; the rule then walks every function lexically defined
+inside a root plus same-module functions a root calls by name
+(one-module transitive closure — cross-module helpers are ops-layer
+jnp code in practice).
+
+Code R501 flags, inside traced code: environment reads (including
+``knobs.get``), lock use (``threading.*``/``.acquire``), RNG
+(``random``/``np.random``), wall clocks (``time.*``), I/O
+(``open``/``print``), and writes to module-level state
+(``global`` declarations, subscript stores on module-level names).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+
+_SCOPE = ("opengemini_tpu/",)
+
+_BANNED_PREFIXES = ("os.environ", "os.getenv", "knobs.", "_knobs.",
+                    "threading.", "random.", "np.random.",
+                    "numpy.random.", "time.")
+_BANNED_ATTRS = {"acquire", "release"}
+_BANNED_NAMES = {"open", "print", "input"}
+
+
+def _is_jit_deco(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fd = dotted(dec.func)
+        if fd in ("jax.jit", "jit"):
+            return True
+        if fd in ("functools.partial", "partial") and dec.args:
+            return dotted(dec.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+class TraceRule(Rule):
+    rule_id = "R5"
+    codes = {"R501": "host state touched inside jit-traced code"}
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not ctx.path.startswith(_SCOPE):
+            return []
+        if "jax" not in ctx.source:
+            return []
+        roots: list[ast.FunctionDef] = []
+        by_name: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, node)
+                if any(_is_jit_deco(d) for d in node.decorator_list):
+                    roots.append(node)
+        # inline jax.jit(f) / jax.jit(partial(f, ...)) roots
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func) in ("jax.jit", "jit") \
+                    and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Call):     # partial(f, ...)
+                    a = a.args[0] if a.args else a
+                nm = dotted(a)
+                if nm in by_name:
+                    roots.append(by_name[nm])
+        if not roots:
+            return []
+        # one-module transitive closure over called local functions
+        traced: dict[str, ast.FunctionDef] = {}
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn.name in traced:
+                continue
+            traced[fn.name] = fn
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    nm = dotted(sub.func)
+                    if nm in by_name and nm not in traced:
+                        work.append(by_name[nm])
+        module_names = {t.id for n in ctx.tree.body
+                        if isinstance(n, ast.Assign)
+                        for t in n.targets if isinstance(t, ast.Name)}
+        out = []
+        for fn in traced.values():
+            out.extend(self._check_fn(ctx, fn, module_names))
+        return out
+
+    def _check_fn(self, ctx, fn, module_names) -> list[Violation]:
+        out = []
+        for node in ast.walk(fn):
+            d = ""
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                d = dotted(node)
+            if d and any(d.startswith(p) for p in _BANNED_PREFIXES):
+                out.append(self._v(ctx, node, fn, d))
+            elif isinstance(node, ast.Call):
+                cd = dotted(node.func)
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in _BANNED_NAMES:
+                    out.append(self._v(ctx, node, fn, node.func.id))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _BANNED_ATTRS \
+                        and not cd.startswith(("jnp.", "jax.", "lax.")):
+                    out.append(self._v(ctx, node, fn, cd or
+                                       node.func.attr))
+            elif isinstance(node, ast.Global):
+                out.append(self._v(ctx, node, fn,
+                                   f"global {', '.join(node.names)}"))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    cd = dotted(item.context_expr)
+                    if isinstance(item.context_expr, ast.Call):
+                        cd = dotted(item.context_expr.func)
+                    if "lock" in cd.lower():
+                        out.append(self._v(ctx, node, fn,
+                                           f"lock {cd!r} held"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in module_names:
+                        out.append(self._v(
+                            ctx, node, fn,
+                            f"write to module state "
+                            f"{t.value.id!r}"))
+        # de-dup per line
+        seen, uniq = set(), []
+        for v in out:
+            if v.line not in seen:
+                seen.add(v.line)
+                uniq.append(v)
+        return uniq
+
+    @staticmethod
+    def _v(ctx, node, fn, what) -> Violation:
+        return Violation(
+            ctx.path, node.lineno, "R501",
+            f"{what} inside jit-traced {fn.name}() — traced code "
+            "runs at compile time; hoist host state out of the "
+            "kernel (see lint/trace_rule.py)")

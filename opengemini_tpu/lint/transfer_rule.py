@@ -1,0 +1,115 @@
+"""R1 — transfer discipline on the device hot path.
+
+Every D2H byte must ride an ACCOUNTED transport: the chunked
+multi-stream fetch ``ops.pipeline.device_get_parallel`` (which bumps
+the devstats d2h counters) or a site that books its own bytes and says
+so with a pragma. A bare ``jax.device_get`` or an implicit
+``np.asarray`` on a device value silently moves bytes the /metrics
+``d2h_bytes`` counter never sees — on a tunnel-attached TPU that
+counter IS the capacity-planning ground truth (BENCH r05 attributed
+82% of the query phase to pulls from exactly these numbers).
+
+Scope: the hot-path modules (``opengemini_tpu/ops/*`` and
+``query/executor.py``), excluding the accounted transport itself
+(ops/pipeline.py) and the counter module (ops/devstats.py).
+
+Codes:
+- R101: ``jax.device_get(...)`` — use device_get_parallel.
+- R102: ``np.asarray``/``np.array`` over an expression containing a
+  ``jnp.*``/``jax.*`` call — an implicit device→host transfer fused
+  into host code.
+- R103: ``np.asarray``/``np.array`` over an expression mentioning a
+  device-named value (``*_dev``, ``dev_*``, ``*_device``…) — the
+  naming convention the hot path uses for device residents. A site
+  that truly accounts its own bytes carries
+  ``# oglint: disable=R103`` next to its devstats bump.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileCtx, Rule, Violation, dotted
+
+_HOT_DIRS = ("opengemini_tpu/ops/",)
+_HOT_FILES = ("opengemini_tpu/query/executor.py",)
+_EXEMPT = ("opengemini_tpu/ops/pipeline.py",
+           "opengemini_tpu/ops/devstats.py")
+
+_DEVICE_NAME = re.compile(r"(^|_)dev(ice)?(_|$)")
+_PULLERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _in_scope(path: str) -> bool:
+    if path in _EXEMPT:
+        return False
+    return path in _HOT_FILES or any(path.startswith(d)
+                                     for d in _HOT_DIRS)
+
+
+class TransferRule(Rule):
+    rule_id = "R1"
+    codes = {
+        "R101": "bare jax.device_get (unaccounted D2H)",
+        "R102": "np.asarray/np.array over a jax/jnp expression",
+        "R103": "np.asarray/np.array over a device-named value",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not _in_scope(ctx.path):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in ("jax.device_get",):
+                out.append(Violation(
+                    ctx.path, node.lineno, "R101",
+                    "bare jax.device_get: route the pull through "
+                    "ops.pipeline.device_get_parallel so d2h_bytes "
+                    "stays truthful"))
+                continue
+            if name not in _PULLERS or not node.args:
+                continue
+            arg = node.args[0]
+            jaxcall = self._jax_call_in(arg)
+            if jaxcall:
+                out.append(Violation(
+                    ctx.path, node.lineno, "R102",
+                    f"implicit transfer: {name}() over device "
+                    f"expression {jaxcall}(...) — pull via "
+                    "device_get_parallel, then convert on host"))
+                continue
+            dev = self._device_name_in(arg)
+            if dev:
+                out.append(Violation(
+                    ctx.path, node.lineno, "R103",
+                    f"{name}() over device-named value {dev!r} looks "
+                    "like an unaccounted D2H pull — use "
+                    "device_get_parallel, or book the bytes into "
+                    "devstats and mark the site "
+                    "'# oglint: disable=R103'"))
+        return out
+
+    @staticmethod
+    def _jax_call_in(arg: ast.AST) -> str | None:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                if d.startswith(("jnp.", "jax.")) \
+                        and d != "jax.device_put":
+                    return d
+        return None
+
+    @staticmethod
+    def _device_name_in(arg: ast.AST) -> str | None:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and \
+                    _DEVICE_NAME.search(sub.id):
+                return sub.id
+            if isinstance(sub, ast.Attribute) and \
+                    _DEVICE_NAME.search(sub.attr):
+                return sub.attr
+        return None

@@ -22,7 +22,23 @@ import threading
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libogn.so"))
+
+
+def _knobs_get(name: str):
+    from ..utils import knobs
+    return knobs.get(name)
+
+
+def _lib_path() -> str:
+    """Path of the shared library: OG_NATIVE_LIB overrides (the
+    sanitizer runner points this at the ASan/UBSan build so the
+    regular parity suites replay against instrumented codecs).
+    Resolved at LOAD time, not import time."""
+    override = _knobs_get("OG_NATIVE_LIB")
+    if override:
+        return os.path.abspath(override)
+    return os.path.abspath(os.path.join(_NATIVE_DIR, "libogn.so"))
+
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -37,18 +53,28 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
+        # resolve AT LOAD TIME so an OG_NATIVE_LIB set after import
+        # (test/harness ordering) still selects the override — the
+        # rebuild-skip and the CDLL must agree on one path
+        lib_path = _lib_path()
+        overridden = bool(_knobs_get("OG_NATIVE_LIB"))
+
         # (re)build when missing OR stale vs any source (a new source
         # file must trigger a rebuild of the existing .so)
         def _stale() -> bool:
-            if not os.path.exists(_LIB_PATH):
+            if not os.path.exists(lib_path):
                 return True
-            so_m = os.path.getmtime(_LIB_PATH)
+            so_m = os.path.getmtime(lib_path)
             nd = os.path.abspath(_NATIVE_DIR)
             return any(
                 os.path.getmtime(os.path.join(nd, f)) > so_m
                 for f in os.listdir(nd)
                 if f.endswith((".cpp", ".h")) or f == "Makefile")
-        if _stale() and not _build_attempted:
+        if overridden:
+            # explicit library override (sanitizer runs): load it
+            # as-is, never rebuild over it
+            pass
+        elif _stale() and not _build_attempted:
             _build_attempted = True
             try:
                 subprocess.run(
@@ -56,10 +82,10 @@ def _load():
                     capture_output=True, timeout=120, check=True)
             except Exception:
                 return None
-        if not os.path.exists(_LIB_PATH):
+        if not os.path.exists(lib_path):
             return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
             return None
         try:

@@ -39,12 +39,11 @@ kernels (fused here), aggregateCursor windowing (in-kernel window ids).
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
-from ..utils import get_logger
+from ..utils import get_logger, knobs
 from . import devicecache, exactsum
 
 log = get_logger(__name__)
@@ -56,7 +55,7 @@ I64MIN = np.iinfo(np.int64).min
 # XLA scatter temporaries) of one launch to SLAB × SEG rows. Each
 # launch pays a full dispatch round-trip on tunnel-attached devices, so
 # bigger is better until the temporaries stop fitting
-SLAB_BLOCKS = int(os.environ.get("OG_BLOCK_SLAB", "4096"))
+SLAB_BLOCKS = int(knobs.get("OG_BLOCK_SLAB"))
 
 
 @dataclass
@@ -291,7 +290,7 @@ _JITTED: dict = {}
 
 # windows per query above which the unrolled masked-pass kernel would
 # bloat the graph; those shapes fall back to the scatter kernel
-MASK_W_MAX = int(os.environ.get("OG_BLOCK_MASK_W", "64"))
+MASK_W_MAX = int(knobs.get("OG_BLOCK_MASK_W"))
 
 # f64-exact sentinel for "no row" index planes (I64MAX is not exactly
 # representable in f64; 2^62 is, and no real flat index reaches it)
@@ -530,7 +529,7 @@ def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
     return _f
 
 
-PACK = os.environ.get("OG_BLOCK_PACK", "1") != "0"
+PACK = bool(knobs.get("OG_BLOCK_PACK"))
 _U32M = np.int64(0xFFFFFFFF)
 IDX_U32_SENTINEL = np.int64(0xFFFFFFFF)
 
@@ -779,7 +778,7 @@ def plane_diet_on() -> bool:
     epilogue below it needs no real-f64 gate and stays on for TPUs.
     OG_DEVICE_FINALIZE=0 switches it off together with the epilogue
     (the byte-identical legacy wire form)."""
-    return os.environ.get("OG_DEVICE_FINALIZE", "1") != "0"
+    return knobs.get_raw("OG_DEVICE_FINALIZE") != "0"
 
 
 def device_finalize_on() -> bool:
@@ -806,7 +805,7 @@ def device_finalize_on() -> bool:
     plus limb-residue cells are flagged in an on-device bitmask and
     pulled sparsely for host repair. The cluster/merge wire format is
     untouched — only terminal partials (no merge pending) finalize."""
-    v = os.environ.get("OG_DEVICE_FINALIZE", "1")
+    v = knobs.get_raw("OG_DEVICE_FINALIZE")
     if v == "0":
         return False
     if v == "force":
@@ -962,7 +961,9 @@ def unpack_finalized(arrs, planes_dev, K: int, k0: int,
         if len(flagged):
             from . import devstats
             t0 = _time.perf_counter_ns()
-            sub = np.asarray(planes_dev[:, flagged])   # sparse repair
+            # sparse repair pull — manually accounted (d2h bumps just
+            # below), so exempt from the R1 transport rule
+            sub = np.asarray(planes_dev[:, flagged])  # oglint: disable=R103
             devstats.bump("d2h_bytes", int(sub.nbytes))
             devstats.bump("d2h_pulls")
             # the per-transport (d2h_bytes_finalized) share is booked
@@ -1188,15 +1189,13 @@ def _round_up(x: int, step: int) -> int:
 
 # host/device budget for one slab's stage-3 plan: the partial lattice
 # (B·WLmax entries) and the (cells, Cmax) gather index
-PLAN_MAX_ENTRIES = int(os.environ.get("OG_PREFIX_PLAN_MAX_ENTRIES",
-                                      str(64 * 1024 * 1024)))
+PLAN_MAX_ENTRIES = int(knobs.get("OG_PREFIX_PLAN_MAX_ENTRIES"))
 # group-count ceiling for the one-hot matmul cell fold (flops scale
 # with G); wider groupings use the searchsorted/gather-plan kernel
-ARITH_G_MAX = int(os.environ.get("OG_ARITH_G_MAX", "256"))
+ARITH_G_MAX = int(knobs.get("OG_ARITH_G_MAX"))
 
 # per-slab byte cap for the pulled window lattice (P·B·WL·4)
-LATTICE_MAX_BYTES = int(os.environ.get("OG_LATTICE_MAX_MB",
-                                       "256")) * (1 << 20)
+LATTICE_MAX_BYTES = int(knobs.get("OG_LATTICE_MAX_MB")) * (1 << 20)
 
 
 def _kernel_lattice(want: tuple, K: int, SEG: int, WL: int, W: int):
@@ -1434,7 +1433,7 @@ def lattice_fold_on_device() -> bool:
     shipping it through the packed uint32 transport — only shrinks the
     bytes crossing the slow D2H link. Read dynamically (perf_smoke
     compares both routes cell for cell)."""
-    return os.environ.get("OG_LATTICE_DEVICE_FOLD", "1") != "0"
+    return bool(knobs.get("OG_LATTICE_DEVICE_FOLD"))
 
 
 def _lattice_cells(st: BlockStack, gids: np.ndarray, start: int,

@@ -25,9 +25,11 @@ Byte-budgeted LRU; OG_DEVICE_CACHE_MB sets the budget (0 disables).
 
 from __future__ import annotations
 
-import os
-import threading
 from collections import OrderedDict
+
+from ..utils import knobs
+from ..utils.lockrank import (RANK_DEVCACHE, RANK_DEVCACHE_FILL,
+                              RankedLock)
 
 _MB = 1024 * 1024
 
@@ -35,7 +37,7 @@ _MB = 1024 * 1024
 class DeviceBlockCache:
     def __init__(self, capacity_bytes: int):
         self.capacity = capacity_bytes
-        self._lock = threading.Lock()
+        self._lock = RankedLock("devicecache", RANK_DEVCACHE)
         self._map: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self._bytes = 0
         self.hits = 0
@@ -105,8 +107,12 @@ _HOST_CACHE: DeviceBlockCache | None = None
 
 def capacity_bytes() -> int:
     # v5e HBM is 16 GiB; device block stacks get a healthy share by
-    # default (the engine's host memory is not charged here)
-    return int(os.environ.get("OG_DEVICE_CACHE_MB", "6144")) * _MB
+    # default (the engine's host memory is not charged here).
+    # OG_DEVICE_CACHE_MB is a knob-cached read: enabled() runs on the
+    # per-slab dispatch path, and the raw env read + int() parse it
+    # used to do there was the hot-loop read oglint R2 exists to catch
+    # (flip at runtime via knobs.set_env, which tests use).
+    return knobs.get("OG_DEVICE_CACHE_MB") * _MB
 
 
 def host_capacity_bytes() -> int:
@@ -120,7 +126,7 @@ def host_capacity_bytes() -> int:
     # 4 GiB of host pins.
     if not enabled():
         return 0
-    return int(os.environ.get("OG_HOST_CACHE_MB", "4096")) * _MB
+    return knobs.get("OG_HOST_CACHE_MB") * _MB
 
 
 def enabled() -> bool:
@@ -155,9 +161,12 @@ NO_PLANES = _NoPlanes()
 # tier-local counters (surfaced via devicecache_collector → /debug/vars
 # and /metrics): a dashboard repeat hitting this tier is the proof that
 # decode+H2D were skipped, so the counters are the acceptance signal
-PLANE_STATS: dict = {"plane_hits": 0, "plane_misses": 0,
-                     "plane_puts": 0, "plane_put_bytes": 0,
-                     "plane_negative": 0}
+from ..utils.stats import register_counters  # noqa: E402
+
+PLANE_STATS: dict = register_counters("devicecache_planes", {
+    "plane_hits": 0, "plane_misses": 0,
+    "plane_puts": 0, "plane_put_bytes": 0,
+    "plane_negative": 0})
 
 
 def _bump_plane(key: str, n: int = 1) -> None:
@@ -208,11 +217,14 @@ def get_decoded_planes(fp: str, field: str, E):
 # device_put the base planes and one upload (plus its HBM) is wasted.
 # STRIPED locks (fixed pool, key-hashed): no eviction means no
 # evicted-while-handed-out race; a stripe collision merely serializes
-# two unrelated fills, which is harmless
-_BASE_FILL_LOCKS = [threading.Lock() for _ in range(64)]
+# two unrelated fills, which is harmless. Ranked OUTSIDE the cache
+# lock (fills call cache.get/put_sized while holding their stripe).
+_BASE_FILL_LOCKS = [
+    RankedLock(f"devicecache.fill[{i}]", RANK_DEVCACHE_FILL)
+    for i in range(64)]
 
 
-def _base_fill_lock(fp: str, field: str) -> threading.Lock:
+def _base_fill_lock(fp: str, field: str) -> RankedLock:
     return _BASE_FILL_LOCKS[hash((fp, field)) % len(_BASE_FILL_LOCKS)]
 
 

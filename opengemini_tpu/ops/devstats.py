@@ -15,7 +15,9 @@ run under the threaded HTTP/RPC servers and the parallel pull pool.
 
 from __future__ import annotations
 
-DEVICE_STATS: dict = {
+from ..utils.stats import register_counters
+
+DEVICE_STATS: dict = register_counters("device", {
     "d2h_bytes": 0,          # device→host result/lattice pulls
     "d2h_pulls": 0,          # individual fetch operations (chunks)
     "d2h_wait_ns": 0,        # wall time blocked on pulls
@@ -44,7 +46,7 @@ DEVICE_STATS: dict = {
     "last_query_pull_ms": 0,
     "last_query_planes": 0,       # transport planes pulled (block path)
     "last_query_pull_saved": 0,   # bytes saved vs legacy f64 planes
-}
+})
 
 # cumulative wall time per executor phase (ns), across ALL queries —
 # the span tree only exists under EXPLAIN ANALYZE, but capacity
@@ -52,7 +54,7 @@ DEVICE_STATS: dict = {
 # device_pull vs grid_fold vs finalize). With the streaming pipeline
 # the phases OVERLAP, so their sum exceeding wall clock is the design
 # working, not double counting.
-QUERY_PHASE_NS: dict = {
+QUERY_PHASE_NS: dict = register_counters("query_phase", {
     "reader_scan_ns": 0,
     "device_agg_ns": 0,
     "device_pull_ns": 0,
@@ -67,7 +69,7 @@ QUERY_PHASE_NS: dict = {
     "finalize_ns": 0,
     "serialize_ns": 0,
     "queries": 0,
-}
+})
 
 
 def bump(key: str, n: int = 1) -> None:

@@ -42,11 +42,14 @@ transfer after a global barrier.
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
+
+from ..utils import knobs
+from ..utils.lockrank import (RANK_PIPELINE, RANK_PIPELINE_POOL,
+                              RankedLock)
 
 
 def _now_ns() -> int:
@@ -57,17 +60,11 @@ def _now_ns() -> int:
 def pipeline_depth() -> int:
     """Launch window of the streaming pipeline (0 disables). Read
     dynamically so tests and operators can flip it per query."""
-    try:
-        return int(os.environ.get("OG_PIPELINE_DEPTH", "4"))
-    except ValueError:
-        return 4
+    return int(knobs.get("OG_PIPELINE_DEPTH"))
 
 
 def pull_threads() -> int:
-    try:
-        return max(1, int(os.environ.get("OG_PIPELINE_THREADS", "4")))
-    except ValueError:
-        return 4
+    return max(1, int(knobs.get("OG_PIPELINE_THREADS")))
 
 
 def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
@@ -144,7 +141,7 @@ def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
 
 
 _PULL_POOL: ThreadPoolExecutor | None = None
-_PULL_POOL_LOCK = threading.Lock()
+_PULL_POOL_LOCK = RankedLock("pipeline.pool", RANK_PIPELINE_POOL)
 
 
 def _pull_pool() -> ThreadPoolExecutor:
@@ -186,7 +183,7 @@ class StreamingPipeline:
         self._sem = threading.BoundedSemaphore(max(1, self.depth))
         self.gate = gate
         self._futs: dict = {}
-        self._lock = threading.Lock()
+        self._lock = RankedLock("pipeline", RANK_PIPELINE)
         self.launches = 0
         self.first_ns: int | None = None    # first pull start
         self.last_ns: int | None = None     # last pull/fold end

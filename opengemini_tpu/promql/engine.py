@@ -18,13 +18,12 @@ approximation).
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..index import TagFilter
-from ..utils import get_logger
+from ..utils import get_logger, knobs
 from ..ops import prom as K
 from .parser import (Aggregation, BinaryOp, FuncCall, Matcher, NumberLit,
                      PromParseError, StringLit, Subquery, VectorSelector,
@@ -68,13 +67,11 @@ _MAX_FOLD = 128
 # rows below this fold on host (numpy): the device bucket kernel pulls
 # 15 state arrays, each paying a full transfer round trip on tunnel-
 # attached chips — raise/lower for directly-attached hardware
-PROM_DEVICE_MIN_ROWS = int(os.environ.get(
-    "OG_PROM_DEVICE_MIN_ROWS", "16000000"))
+PROM_DEVICE_MIN_ROWS = int(knobs.get("OG_PROM_DEVICE_MIN_ROWS"))
 # rows per device launch in the chunked fold: bounds the kernel's
 # working set (inputs + 15-plane segment grid); an unchunked 60M-row
 # launch crashed the tunnel-attached v5e's worker
-PROM_DEVICE_CHUNK_ROWS = int(os.environ.get(
-    "OG_PROM_DEVICE_CHUNK_ROWS", "16000000"))
+PROM_DEVICE_CHUNK_ROWS = int(knobs.get("OG_PROM_DEVICE_CHUNK_ROWS"))
 VALUE_FIELD = "value"
 
 

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..record import DataType
 from ..utils import get_logger
+from ..utils import knobs as _knobs
 from ..utils.errors import ErrQueryError, GeminiError
 from .ast import (AlterRPStatement, Call, FieldRef, Literal, RegexDim,
                   SelectField,
@@ -170,7 +171,7 @@ def _dense_device_on() -> bool:
     min/max, limb sums) so results stay bit-identical except the f64
     fallback sum at cells some OTHER source flagged inexact (derived
     from exact limb totals instead of numpy's pairwise rounding)."""
-    return __import__("os").environ.get("OG_DENSE_DEVICE", "0") == "1"
+    return bool(_knobs.get("OG_DENSE_DEVICE"))
 
 
 def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
@@ -239,8 +240,7 @@ def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
 # of rows, so the default threshold sits at 16M (tune with
 # OG_HOST_AGG_THRESHOLD on directly-attached hardware, where the
 # break-even is far lower).
-HOST_AGG_THRESHOLD = int(
-    __import__("os").environ.get("OG_HOST_AGG_THRESHOLD", "16000000"))
+HOST_AGG_THRESHOLD = int(_knobs.get("OG_HOST_AGG_THRESHOLD"))
 
 # block-path dispatch (ops/blockagg.py): result grids above this pull
 # too much over the slow D2H link; files whose rows/cells ratio is
@@ -250,33 +250,30 @@ HOST_AGG_THRESHOLD = int(
 # worth dispatching up to ~16M cells when TOTAL dispatched rows /
 # cells >= 4 (device cost ~ cells*20B/70MBps vs host ~ rows*80ns),
 # while the legacy f64 transport keeps the old conservative caps
-BLOCK_MAX_CELLS = int(
-    __import__("os").environ.get("OG_BLOCK_MAX_CELLS", "1000000"))
-BLOCK_PACKED_MAX_CELLS = int(
-    __import__("os").environ.get("OG_BLOCK_MAX_CELLS_PACKED",
-                                 "16000000"))
-BLOCK_MIN_RATIO = int(
-    __import__("os").environ.get("OG_BLOCK_MIN_RATIO", "16"))
-BLOCK_MIN_RATIO_PACKED = int(
-    __import__("os").environ.get("OG_BLOCK_MIN_RATIO_PACKED", "4"))
+BLOCK_MAX_CELLS = int(_knobs.get("OG_BLOCK_MAX_CELLS"))
+BLOCK_PACKED_MAX_CELLS = int(_knobs.get("OG_BLOCK_MAX_CELLS_PACKED"))
+BLOCK_MIN_RATIO = int(_knobs.get("OG_BLOCK_MIN_RATIO"))
+BLOCK_MIN_RATIO_PACKED = int(_knobs.get("OG_BLOCK_MIN_RATIO_PACKED"))
 
 # multi-field device queries stack their inputs and upload ONCE per
 # kind (per-transfer latency dominates on remote-attached chips); the
 # stacks are host copies, so cap them to avoid doubling a huge scan
-BATCH_UPLOAD_BYTES = int(
-    __import__("os").environ.get("OG_BATCH_UPLOAD_MB", "512")) * (1 << 20)
+BATCH_UPLOAD_BYTES = int(_knobs.get("OG_BATCH_UPLOAD_MB")) * (1 << 20)
 
 # reproducible (bit-identical) f64 sums via binned integer limbs
 # (ops/exactsum.py) — the north star's bit-identical guarantee. Costs
 # ~6 extra fused reduction passes; OG_EXACT_SUM=0 disables.
-EXACT_SUM = __import__("os").environ.get("OG_EXACT_SUM", "1") != "0"
+EXACT_SUM = bool(_knobs.get("OG_EXACT_SUM"))
 
 # cumulative scan-path metrics for the statistics pusher (reference
 # statistics/executor.go collectors)
-EXEC_STATS = {"agg_queries": 0, "rows_scanned": 0, "preagg_segments": 0,
-              "decoded_segments": 0, "dense_rows": 0,
-              "dense_cache_hits": 0, "merged_series": 0,
-              "host_reductions": 0, "device_reductions": 0}
+from ..utils.stats import register_counters as _register_counters  # noqa: E402
+
+EXEC_STATS = _register_counters("executor", {
+    "agg_queries": 0, "rows_scanned": 0, "preagg_segments": 0,
+    "decoded_segments": 0, "dense_rows": 0,
+    "dense_cache_hits": 0, "merged_series": 0,
+    "host_reductions": 0, "device_reductions": 0})
 
 
 class QueryExecutor:
@@ -3897,24 +3894,24 @@ def _batch_pull_results(field_results: dict, exact_results: dict,
     for ref, v in dev_leaves:
         groups.setdefault((str(v.dtype), tuple(v.shape)),
                           []).append((ref, v))
-    from ..ops import devstats as _ds
-    _t0 = _now_ns()
+    # one accounted pull for the whole leaf set (oglint R1): stack
+    # same-shape leaves into one device array per group, then fetch
+    # everything through the chunked multi-stream transport — which
+    # also books d2h_bytes/pulls/wait, so no manual bumps here
+    stacked = [kvs[0][1] if len(kvs) == 1
+               else jnp.stack([v for _r, v in kvs])
+               for kvs in groups.values()]
+    st: dict = {}
+    hosts = _device_get_parallel(stacked, stats=st)
     pulled: dict[tuple, np.ndarray] = {}
-    n_b = 0
-    for kvs in groups.values():
+    for kvs, arr in zip(groups.values(), hosts):
         if len(kvs) == 1:
-            pulled[kvs[0][0]] = np.asarray(kvs[0][1])
-            n_b += pulled[kvs[0][0]].nbytes
+            pulled[kvs[0][0]] = arr
         else:
-            arr = np.asarray(jnp.stack([v for _r, v in kvs]))
-            n_b += arr.nbytes
             for i, (ref, _v) in enumerate(kvs):
                 pulled[ref] = arr[i]
-    _ds.bump("d2h_bytes", n_b)
-    _ds.bump("d2h_pulls", len(groups))
-    _ds.bump("d2h_wait_ns", _now_ns() - _t0)
     if stats is not None:
-        stats["bytes"] = stats.get("bytes", 0) + n_b
+        stats["bytes"] = stats.get("bytes", 0) + st.get("bytes", 0)
     for fname, res in list(field_results.items()):
         if not hasattr(res, "_fields"):
             continue
@@ -3934,8 +3931,7 @@ _GC_LAST_COLLECT = 0.0
 # under sustained overlapping queries the depth never reaches 0; run
 # an explicit collection at most this often so cyclic garbage (e.g.
 # handled-exception frame cycles) stays bounded
-_GC_MAX_PAUSE_S = float(
-    __import__("os").environ.get("OG_GC_MAX_PAUSE_S", "60"))
+_GC_MAX_PAUSE_S = float(_knobs.get("OG_GC_MAX_PAUSE_S"))
 
 
 def _gc_pause() -> None:
@@ -3994,7 +3990,7 @@ def finalize_workers(default: int | None = None) -> int:
     stage — equivalence across ALL settings is enforced by tests and
     scripts/perf_smoke.sh."""
     import os
-    raw = os.environ.get("OG_FINALIZE_WORKERS", "")
+    raw = _knobs.get_raw("OG_FINALIZE_WORKERS") or ""
     try:
         n = int(raw)
     except ValueError:

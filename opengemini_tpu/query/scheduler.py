@@ -53,14 +53,16 @@ from __future__ import annotations
 
 import heapq
 import math
-import os
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 
 from ..utils import deadline as _deadline
+from ..utils import knobs
 from ..utils.errors import ErrQueryError, ErrQueryTimeout
+from ..utils.lockrank import (RANK_SCHED, RANK_SCHED_HANDLE,
+                              RankedLock)
 
 __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
            "get_scheduler", "estimate_request_cost",
@@ -70,9 +72,11 @@ __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
 def enabled() -> bool:
     """OG_SCHED=0 disables the scheduler everywhere (admission falls
     back to the legacy BoundedGate, device launches dispatch inline,
-    cache fills race as before). Read dynamically: tests and the bench
-    concurrency gate flip it per run."""
-    return os.environ.get("OG_SCHED", "1") != "0"
+    cache fills race as before). This check runs on EVERY device
+    launch (executor._sched_launch), so the knob is registry-cached —
+    tests and the bench concurrency gate flip it per run via
+    knobs.set_env, which invalidates the cache."""
+    return bool(knobs.get("OG_SCHED"))
 
 
 class SchedShed(ErrQueryError):
@@ -148,7 +152,9 @@ def pull_bytes_per_cell() -> int:
 
 # scheduler counters (utils.stats.scheduler_collector → /metrics,
 # /debug/vars). Writers use utils.stats.bump (threaded HTTP server).
-SCHED_STATS: dict = {
+from ..utils.stats import register_counters  # noqa: E402
+
+SCHED_STATS: dict = register_counters("scheduler", {
     "admitted": 0,             # granted a slot (incl. instant grants)
     "queued_total": 0,         # had to wait for a slot (cumulative —
     # the LIVE queue depth is the 'queued' gauge in snapshot())
@@ -165,7 +171,7 @@ SCHED_STATS: dict = {
     "coalesced_dispatches": 0,  # multi-launch dispatch windows
     "singleflight_leaders": 0,
     "singleflight_hits": 0,    # followers served by a leader's fill
-}
+})
 
 
 def _bump(key: str, n: int = 1) -> None:
@@ -227,7 +233,7 @@ class QueryScheduler:
         self.max_queued = int(max_queued)
         self.timeout_s = float(timeout_s)
         self.max_cells = int(max_cells)             # 0 = no budget cap
-        self._lock = threading.Lock()
+        self._lock = RankedLock("scheduler", RANK_SCHED)
         self._active = 0
         self._heap: list[_Entry] = []
         self._seq = 0
@@ -259,13 +265,12 @@ class QueryScheduler:
                 self.timeout_s = float(timeout_s)
             if max_cells is not None:
                 self.max_cells = int(max_cells)
-            env = os.environ
-            if env.get("OG_SCHED_SLOTS"):
-                self.max_concurrent = int(env["OG_SCHED_SLOTS"])
-            if env.get("OG_SCHED_QUEUE"):
-                self.max_queued = int(env["OG_SCHED_QUEUE"])
-            if env.get("OG_SCHED_MAX_CELLS"):
-                self.max_cells = int(env["OG_SCHED_MAX_CELLS"])
+            if knobs.get_raw("OG_SCHED_SLOTS"):
+                self.max_concurrent = int(knobs.get_raw("OG_SCHED_SLOTS"))
+            if knobs.get_raw("OG_SCHED_QUEUE"):
+                self.max_queued = int(knobs.get_raw("OG_SCHED_QUEUE"))
+            if knobs.get_raw("OG_SCHED_MAX_CELLS"):
+                self.max_cells = int(knobs.get_raw("OG_SCHED_MAX_CELLS"))
         self._pump()
 
     def _retry_after(self) -> float:
@@ -446,10 +451,7 @@ class QueryScheduler:
         HBM, this bounds the sum (OG_SCHED_DEPTH)."""
         with self._lock:
             if self._pipe_gate is None:
-                try:
-                    depth = int(os.environ.get("OG_SCHED_DEPTH", "8"))
-                except ValueError:
-                    depth = 8
+                depth = int(knobs.get("OG_SCHED_DEPTH"))
                 self._pipe_gate = threading.BoundedSemaphore(
                     max(1, depth))
             return self._pipe_gate
@@ -655,7 +657,7 @@ def _estimate_select_cells(executor, stmt, db: str | None) -> int:
 # ------------------------------------------------------ global handle
 
 _SCHED: QueryScheduler | None = None
-_SCHED_LOCK = threading.Lock()
+_SCHED_LOCK = RankedLock("scheduler.handle", RANK_SCHED_HANDLE)
 
 
 def get_scheduler() -> QueryScheduler:

@@ -22,8 +22,11 @@ log = get_logger(__name__)
 
 # cumulative metrics for the statistics pusher
 # (reference statistics/subscriber.go analog)
-SUB_STATS = {"queued": 0, "sent": 0, "failed": 0, "dropped": 0,
-             "retries": 0}
+from ..utils.stats import register_counters
+
+SUB_STATS = register_counters("subscriber", {
+    "queued": 0, "sent": 0, "failed": 0, "dropped": 0,
+    "retries": 0})
 
 
 def rows_to_lp(rows: list[PointRow]) -> str:

@@ -19,8 +19,11 @@ from .tssp import TSSPReader, TSSPWriter
 log = get_logger(__name__)
 
 # cumulative metrics for the statistics pusher (statistics/compact.go)
-COMPACT_STATS = {"merges": 0, "files_merged": 0, "series_merged": 0,
-                 "series_streamed": 0, "series_decoded": 0}
+from ..utils.stats import register_counters
+
+COMPACT_STATS = register_counters("compaction", {
+    "merges": 0, "files_merged": 0, "series_merged": 0,
+    "series_streamed": 0, "series_decoded": 0})
 
 BASE_SIZE = 1 << 20       # 1 MiB → level 0
 DEFAULT_FANOUT = 4

@@ -37,7 +37,7 @@ import numpy as np
 
 from ..encoding import blocks as enc
 from ..record import ColVal, DataType, Field, Record, Schema
-from ..utils import failpoint
+from ..utils import failpoint, knobs
 from .. import native as _native
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
@@ -54,7 +54,7 @@ def encode_workers() -> int:
     knob exists for compression-heavy deployments (real zstandard at
     high levels, string-block-heavy schemas) where the C share is
     large enough to pay; measure before enabling."""
-    raw = os.environ.get("OG_ENCODE_WORKERS", "")
+    raw = knobs.get_raw("OG_ENCODE_WORKERS") or ""
     try:
         n = int(raw)
     except ValueError:

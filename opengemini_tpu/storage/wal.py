@@ -191,8 +191,11 @@ def _unpack_cols(buf: bytes):
 
 # cumulative metrics for the statistics pusher (reference
 # statistics/wal.go analog)
-WAL_STATS = {"writes": 0, "bytes_written": 0, "switches": 0,
-             "replayed_batches": 0}
+from ..utils.stats import register_counters
+
+WAL_STATS = register_counters("wal", {
+    "writes": 0, "bytes_written": 0, "switches": 0,
+    "replayed_batches": 0})
 
 
 class WAL:

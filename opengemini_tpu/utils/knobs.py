@@ -1,0 +1,352 @@
+"""Central registry for every ``OG_*`` environment knob.
+
+Five PRs in, ~50 env knobs steer the device hot path, the scheduler,
+the caches and the bench harness — and every one of them was a raw
+``os.environ.get`` scattered across the tree: no single place to see
+what exists, no types, no docs, and a few reads sat INSIDE dispatch
+loops (OG_SCHED per device launch, OG_DEVICE_CACHE_MB per slab).
+
+This module is the one place a knob may be declared and read:
+
+- ``register()`` declares name, type, default, doc and a *scope*
+  describing when the value is sampled:
+
+  * ``dynamic``      — read from the environment on every ``get()``
+    (tests and perf_smoke flip these per query/run);
+  * ``module-init``  — sampled once when the owning module imports
+    (the value lands in a module constant; changing the env var later
+    requires a re-import, as before the registry);
+  * ``cached``       — hot-path knob: ``get()`` memoizes the PARSED
+    value keyed on the raw environment string, so the per-launch /
+    per-slab reads these knobs serve (scheduler.enabled per device
+    launch, devicecache.enabled per slab) cost two dict hits and no
+    int()/try parsing. Environment flips stay visible immediately —
+    only the parse is cached, never the raw read — so tests and the
+    bench may still flip them per run (``set_env`` is the tidy way).
+
+- oglint rule R2 (opengemini_tpu/lint/knob_rule.py) forbids raw
+  ``os.environ``/``os.getenv`` reads of ``OG_*`` names anywhere else,
+  and fails when the README's generated knob table drifts from this
+  registry (``python -m opengemini_tpu.lint --knob-table``).
+
+Bool parsing preserves both historical conventions ("!= '0'" with
+default on; "== '1'" with default off): unset → default, "0" → False,
+"1" → True, anything else → default. Parse failures on int/float
+knobs fall back to the declared default (never raise on a typo'd
+environment), matching the defensive reads they replaced.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["Knob", "register", "get", "get_raw", "set_env", "del_env",
+           "invalidate", "all_knobs", "knob_table_md", "is_registered"]
+
+_SCOPES = ("dynamic", "module-init", "cached")
+
+
+class Knob:
+    __slots__ = ("name", "ktype", "default", "doc", "scope")
+
+    def __init__(self, name: str, ktype: type, default, doc: str,
+                 scope: str):
+        self.name = name
+        self.ktype = ktype
+        self.default = default
+        self.doc = doc
+        self.scope = scope
+
+    def parse(self, raw: str | None):
+        if raw is None:
+            return self.default
+        if self.ktype is bool:
+            if raw == "0":
+                return False
+            if raw == "1":
+                return True
+            return self.default
+        try:
+            return self.ktype(raw)
+        except (TypeError, ValueError):
+            return self.default
+
+
+_REGISTRY: dict[str, Knob] = {}
+_CACHE: dict[str, object] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def register(name: str, ktype: type, default, doc: str,
+             scope: str = "dynamic") -> Knob:
+    if not name.startswith("OG_"):
+        raise ValueError(f"knob {name!r} must start with OG_")
+    if scope not in _SCOPES:
+        raise ValueError(f"knob {name}: scope {scope!r} not in {_SCOPES}")
+    if ktype not in (str, int, float, bool):
+        raise ValueError(f"knob {name}: unsupported type {ktype!r}")
+    existing = _REGISTRY.get(name)
+    if existing is not None:
+        return existing
+    k = Knob(name, ktype, default, doc, scope)
+    _REGISTRY[name] = k
+    return k
+
+
+def is_registered(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def _knob(name: str) -> Knob:
+    k = _REGISTRY.get(name)
+    if k is None:
+        raise KeyError(
+            f"unregistered knob {name!r} — declare it in "
+            "opengemini_tpu/utils/knobs.py (oglint R2 enforces this)")
+    return k
+
+
+def get(name: str):
+    """Typed value of one registered knob (see module doc for scope
+    semantics)."""
+    k = _knob(name)
+    raw = os.environ.get(name)
+    if k.scope == "cached":
+        key = (name, raw)
+        got = _CACHE.get(key, _CACHE)
+        if got is not _CACHE:
+            return got
+        val = k.parse(raw)
+        with _CACHE_LOCK:
+            _CACHE[key] = val
+        return val
+    return k.parse(raw)
+
+
+def get_raw(name: str) -> str | None:
+    """Uninterpreted environment string of a registered knob (None =
+    unset) — for knobs whose raw form is tri-state (OG_DEVICE_FINALIZE
+    '0'/'1'/'force') or empty-means-default (OG_FINALIZE_WORKERS)."""
+    _knob(name)
+    return os.environ.get(name)
+
+
+def set_env(name: str, value) -> None:
+    """Set a knob in the process environment AND drop any memoized
+    value — the only sanctioned way to flip a ``cached`` knob at
+    runtime (bench phases, tests). Values are normalized to the
+    knob's declared type: a Python bool becomes "1"/"0" (str(False)
+    would read back as the DEFAULT, silently un-flipping the knob)."""
+    k = _knob(name)
+    if isinstance(value, bool):
+        if k.ktype is not bool:
+            raise TypeError(
+                f"knob {name} is {k.ktype.__name__}-typed; got bool")
+        value = "1" if value else "0"
+    os.environ[name] = str(value)
+    invalidate(name)
+
+
+def del_env(name: str) -> None:
+    _knob(name)
+    os.environ.pop(name, None)
+    invalidate(name)
+
+
+def invalidate(name: str | None = None) -> None:
+    """Forget memoized parses of ``cached`` knobs (all of them when
+    ``name`` is None) — hygiene only, since the memo is keyed on the
+    raw string and can never serve a stale environment."""
+    with _CACHE_LOCK:
+        if name is None:
+            _CACHE.clear()
+        else:
+            for key in [k for k in _CACHE if k[0] == name]:
+                _CACHE.pop(key, None)
+
+
+def all_knobs() -> list[Knob]:
+    return [v for _k, v in sorted(_REGISTRY.items())]
+
+
+def knob_table_md() -> str:
+    """The README's knob table, generated (``python -m
+    opengemini_tpu.lint --knob-table``). oglint R2 fails when the
+    README block drifts from this output."""
+    lines = ["| knob | type | default | scope | meaning |",
+             "|---|---|---|---|---|"]
+    for k in all_knobs():
+        d = k.default
+        if k.ktype is bool:
+            d = "on" if d else "off"
+        elif d == "":
+            d = "(unset)"
+        lines.append(f"| `{k.name}` | {k.ktype.__name__} | `{d}` "
+                     f"| {k.scope} | {k.doc} |")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------- registry
+#
+# Declared centrally (not at call sites) so the table is complete even
+# when an owning module was never imported. Grouped by subsystem.
+
+# --- device pipeline / transfer plane (ops/)
+register("OG_PIPELINE_DEPTH", int, 4,
+         "streaming pipeline launch window per query; 0 disables "
+         "streaming (classic single-barrier pull)")
+register("OG_PIPELINE_THREADS", int, 4,
+         "puller threads in the shared D2H pool")
+register("OG_DEVICE_FINALIZE", str, "1",
+         "tri-state D2H diet gate: `0` = byte-identical legacy "
+         "transport, `1` = on-device finalize + op-aware plane "
+         "pruning (epilogue auto-gates off on f64-emulated backends), "
+         "`force` = override the backend gate")
+register("OG_LATTICE_DEVICE_FOLD", bool, True,
+         "fold window lattices on device (one packed grid per "
+         "field×scale crosses D2H); 0 = host C fold")
+register("OG_DENSE_DEVICE", bool, False,
+         "dense (S,P) groups reduce on device from decoded-plane "
+         "cache residency")
+register("OG_EXACT_SUM", bool, True,
+         "bit-identical f64 sums via binned integer limbs; 0 "
+         "disables (plain pairwise summation)")
+register("OG_FINALIZE_WORKERS", str, "",
+         "worker count for group-sharded finalize stages; 0/1 = "
+         "serial, unset = per-stage default")
+
+# --- block aggregation kernels (ops/blockagg.py; module-init: the
+#     values land in module constants at import)
+register("OG_BLOCK_SLAB", int, 4096,
+         "blocks per kernel launch (slab size)", scope="module-init")
+register("OG_BLOCK_MASK_W", int, 64,
+         "widest per-window bitmask the mask kernel packs",
+         scope="module-init")
+register("OG_BLOCK_PACK", bool, True,
+         "packed uint32 result transport for the block path",
+         scope="module-init")
+register("OG_PREFIX_PLAN_MAX_ENTRIES", int, 64 * 1024 * 1024,
+         "host/device budget for one slab's stage-3 gather plan",
+         scope="module-init")
+register("OG_ARITH_G_MAX", int, 256,
+         "group-count ceiling for the one-hot matmul cell fold",
+         scope="module-init")
+register("OG_LATTICE_MAX_MB", int, 256,
+         "per-slab byte cap for the pulled window lattice",
+         scope="module-init")
+
+# --- executor dispatch economics (query/executor.py; module-init)
+register("OG_HOST_AGG_THRESHOLD", int, 16_000_000,
+         "sparse rows at/below this reduce on host numpy instead of "
+         "paying device dispatch latency", scope="module-init")
+register("OG_BLOCK_MAX_CELLS", int, 1_000_000,
+         "legacy-transport result-grid cell cap for block dispatch",
+         scope="module-init")
+register("OG_BLOCK_MAX_CELLS_PACKED", int, 16_000_000,
+         "packed-transport result-grid cell cap", scope="module-init")
+register("OG_BLOCK_MIN_RATIO", int, 16,
+         "min rows/cells ratio for legacy-transport block dispatch",
+         scope="module-init")
+register("OG_BLOCK_MIN_RATIO_PACKED", int, 4,
+         "min rows/cells ratio for packed-transport block dispatch",
+         scope="module-init")
+register("OG_BATCH_UPLOAD_MB", int, 512,
+         "cap on the stacked multi-field upload batch",
+         scope="module-init")
+register("OG_GC_MAX_PAUSE_S", float, 60.0,
+         "max seconds between explicit GC collections while queries "
+         "hold the GC pause", scope="module-init")
+
+# --- device/host caches (ops/devicecache.py; cached: enabled() runs
+#     per slab on the dispatch path)
+register("OG_DEVICE_CACHE_MB", int, 6144,
+         "HBM block/plane cache budget; 0 disables ALL cache tiers",
+         scope="cached")
+register("OG_HOST_CACHE_MB", int, 4096,
+         "host pin-cache budget (assembled dense blocks, limb sums, "
+         "result grids)", scope="cached")
+
+# --- query scheduler (query/scheduler.py; OG_SCHED cached: checked on
+#     every device launch)
+register("OG_SCHED", bool, True,
+         "device query scheduler; 0 = legacy counting gate + inline "
+         "launches (byte-identical)", scope="cached")
+register("OG_SCHED_SLOTS", str, "",
+         "concurrent query slots (overrides config; 0 = unlimited)")
+register("OG_SCHED_QUEUE", str, "",
+         "admission waiting-room cap (overrides config)")
+register("OG_SCHED_MAX_CELLS", str, "",
+         "early-shed budget: estimated result cells above this are "
+         "rejected with 429 (overrides config)")
+register("OG_SCHED_DEPTH", int, 8,
+         "global in-flight streamed-launch bound across all queries")
+
+# --- HTTP result path (http/serializer.py)
+register("OG_STREAM_JSON", bool, True,
+         "chunked streaming JSON/CSV responses (byte-identical to "
+         "the buffered route)")
+register("OG_STREAM_QUEUE", int, 8,
+         "bounded piece queue between serializer and socket writer")
+
+# --- PromQL device path (promql/engine.py; module-init)
+register("OG_PROM_DEVICE_MIN_ROWS", int, 16_000_000,
+         "rows below this fold on host numpy (device bucket kernel "
+         "pays 15 transfer round trips)", scope="module-init")
+register("OG_PROM_DEVICE_CHUNK_ROWS", int, 16_000_000,
+         "rows per device launch in the chunked PromQL fold",
+         scope="module-init")
+
+# --- storage / index / ingest
+register("OG_ENCODE_WORKERS", str, "",
+         "TSSP flush encode pool size; unset = serial")
+register("OG_TSI_SNAP_BYTES", int, 4 << 20,
+         "TSI log-size threshold that triggers an index snapshot",
+         scope="module-init")
+
+# --- cluster
+register("OG_READER_ROUTING", bool, True,
+         "replica-aware reader routing; 0 = primary-only reads",
+         scope="module-init")
+register("OG_MAX_FAILED_STORES", int, 0,
+         "write fan-out tolerates this many failed stores before the "
+         "write errors", scope="module-init")
+
+# --- native loader
+register("OG_NATIVE_LIB", str, "",
+         "override path of the native libogn.so (sanitizer builds: "
+         "scripts/sanitize_tests.sh points this at libogn-san.so)")
+
+# --- test harness
+register("OG_TEST_STACKDUMP_S", float, 300.0,
+         "per-test watchdog that dumps all thread stacks on a hang; "
+         "0 disables")
+register("OG_LOCKRANK", str, "",
+         "lock-rank runtime checker: `1` force-on, `0` force-off, "
+         "unset = on under pytest only (tests/conftest.py)")
+
+# --- bench harness (bench.py, benchmarks/, __graft_entry__.py)
+register("OG_BENCH_HOSTS", int, 16000, "bench: TSBS host count")
+register("OG_BENCH_HOURS", float, 12.0, "bench: hours of data")
+register("OG_BENCH_CS_HOSTS", int, 2000,
+         "bench: colstore phase host count")
+register("OG_BENCH_PROM_SERIES", int, 1_000_000,
+         "bench: PromQL remote-read series count")
+register("OG_BENCH_SCALE_ROWS", int, 500_000_000,
+         "bench: synthetic scale phase row count")
+register("OG_BENCH_CONC_HOSTS", str, "",
+         "bench: concurrent phase host count (unset = min(hosts, "
+         "1000))")
+register("OG_BENCH_EST_PROM", int, 1300, "bench: prom phase budget s")
+register("OG_BENCH_EST_CS", int, 420, "bench: colstore budget s")
+register("OG_BENCH_EST_CONC", int, 420, "bench: concurrent budget s")
+register("OG_BENCH_EST_SCALE", int, 3000, "bench: scale budget s")
+register("OG_BENCH_BUDGET_S", float, 1800.0,
+         "bench: total wall budget the orchestrator sub-divides")
+register("OG_SERIES_BENCH_N", int, 1_000_000,
+         "series-index microbench: series count")
+register("OG_SERIES_BENCH_PROM_N", str, "",
+         "series-index microbench: prom series count (unset = all)")
+register("OG_DRYRUN_SERIES", int, 100_000,
+         "driver dryrun: series count")
+register("OG_DRYRUN_POINTS", int, 104, "driver dryrun: points/series")

@@ -1,0 +1,191 @@
+"""Lock-rank checker for the device hot path's lock web.
+
+The dispatcher thread, the pull pool, the HTTP handler threads and the
+stats pusher all meet in four locks: the scheduler lock
+(query/scheduler.py), the device/host cache locks (ops/devicecache.py),
+the pipeline bookkeeping locks (ops/pipeline.py) and the stats counter
+lock (utils/stats.py). Today their nesting is deadlock-free by
+convention only — e.g. ``bump()`` (stats) runs inside ``with
+self._lock`` blocks of the scheduler, so stats must stay INNERMOST
+forever. This module turns the convention into a checked invariant:
+
+- Every lock in the web is a ``RankedLock``/``RankedRLock`` with an
+  explicit rank. Outer locks get LOW ranks; a thread may only acquire
+  a lock whose rank is STRICTLY greater than the highest rank it
+  holds. Any cycle in lock acquisition would need a rank inversion
+  somewhere, so rank-clean runs are deadlock-free by construction.
+- The checker is OFF in production (a pass-through around
+  threading.Lock — one attribute hop per acquire) and enabled under
+  tests (tests/conftest.py) or via OG_LOCKRANK=1. Violations raise
+  ``LockRankError`` with both lock names — a deterministic test
+  failure instead of a wedged tier-1 run.
+- A *blocking re-acquire of a non-reentrant lock by its owner* — the
+  classic self-deadlock — raises immediately instead of hanging.
+- oglint rule R4 (opengemini_tpu/lint/lockrank_rule.py) is the static
+  half: it scans ``with``-blocks on ranked locks for blocking calls
+  (time.sleep, Future.result, device pulls) and for nested
+  acquisitions that contradict the declared ranks.
+
+Ranks (gaps left for future locks):
+    SCHED_HANDLE(5) < SCHED(10) < DEVCACHE_FILL(15) < DEVCACHE(20)
+    < PIPELINE_POOL(25) < PIPELINE(30) < STATS(40)
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["RANK_SCHED_HANDLE", "RANK_SCHED", "RANK_DEVCACHE_FILL",
+           "RANK_DEVCACHE", "RANK_PIPELINE_POOL", "RANK_PIPELINE",
+           "RANK_STATS", "LockRankError", "RankedLock", "RankedRLock",
+           "enable", "enabled", "held_ranks"]
+
+RANK_SCHED_HANDLE = 5     # scheduler singleton construction
+RANK_SCHED = 10           # QueryScheduler._lock (admission + dispatch)
+RANK_DEVCACHE_FILL = 15   # decoded-plane base-fill stripes
+RANK_DEVCACHE = 20        # DeviceBlockCache._lock (HBM + host tiers)
+RANK_PIPELINE_POOL = 25   # shared pull-pool construction
+RANK_PIPELINE = 30        # StreamingPipeline._lock (per-query)
+RANK_STATS = 40           # utils.stats.COUNTER_LOCK — innermost
+
+
+class LockRankError(RuntimeError):
+    """A lock acquisition violated the declared rank order (or an
+    owner blocked on its own non-reentrant lock)."""
+
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def held_ranks() -> list[tuple[int, str]]:
+    """(rank, name) of locks the calling thread holds, outermost
+    first — diagnostic surface for tests and the static scan's
+    fixtures."""
+    return [(lk.rank, lk.name) for lk in _held()]
+
+
+from . import knobs as _knobs  # noqa: E402  (leaf module, no cycle)
+
+_enabled = _knobs.get_raw("OG_LOCKRANK") == "1"
+
+
+def enable(on: bool = True) -> None:
+    """Flip the runtime checker process-wide (tests/conftest.py turns
+    it on for the whole tier-1 run)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class RankedLock:
+    """threading.Lock with a declared rank, checked when the runtime
+    checker is enabled. Supports the Condition protocol (Condition
+    re-enters through acquire/release, which keeps the held-stack
+    accurate across ``wait``)."""
+
+    _reentrant = False
+
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = int(rank)
+        self._lock = self._make_lock()
+        self._owner: int | None = None
+        self._depth = 0
+
+    def _make_lock(self):
+        return threading.Lock()
+
+    # -- checking ------------------------------------------------------
+
+    def _check(self, blocking: bool) -> None:
+        if not blocking:
+            # try-acquire cannot deadlock — and Condition._is_owned
+            # probes owned locks with acquire(False), which must stay
+            # a plain False, not an error
+            return
+        me = threading.get_ident()
+        if self._owner == me:
+            # only the owner can observe its own ident here, so this
+            # read is race-free for the thread it matters to
+            if self._reentrant:
+                return     # owner re-entry is legal at ANY stack depth
+            raise LockRankError(
+                f"re-acquire of non-reentrant lock {self.name!r} "
+                "(rank {}) by its owner thread — guaranteed "
+                "self-deadlock".format(self.rank))
+        held = _held()
+        if held:
+            top = held[-1]
+            if self.rank <= top.rank:
+                raise LockRankError(
+                    f"lock rank violation: acquiring {self.name!r} "
+                    f"(rank {self.rank}) while holding {top.name!r} "
+                    f"(rank {top.rank}) — ranks must strictly "
+                    "increase inward")
+
+    # -- lock protocol -------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _enabled:
+            self._check(blocking)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            self._depth += 1
+            if _enabled:
+                _held().append(self)
+        return got
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth <= 0:
+            self._owner = None
+        # pop UNCONDITIONALLY: a lock acquired while the checker was
+        # enabled but released after enable(False) must not leave a
+        # phantom held-entry that poisons the thread with spurious
+        # rank errors once the checker comes back on
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self.name} rank={self.rank}>"
+
+
+class RankedRLock(RankedLock):
+    """Reentrant variant: the owner may re-acquire freely (no rank
+    check against itself); distinct-lock rank order still applies."""
+
+    _reentrant = True
+
+    def _make_lock(self):
+        return threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no .locked() pre-3.12
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+            return False
+        return True

@@ -126,7 +126,31 @@ class StatisticsPusher:
 
 # ------------------------------------------------- standard collectors
 
-COUNTER_LOCK = threading.Lock()
+# Innermost lock of the hot path's lock web (utils/lockrank.py):
+# bump() runs inside scheduler/devicecache/pipeline critical sections,
+# so the stats lock must out-rank them all and never wrap a blocking
+# call.
+from .lockrank import RANK_STATS, RankedLock  # noqa: E402
+
+COUNTER_LOCK = RankedLock("stats.counter", RANK_STATS)
+
+# Registry of every shared counter dict (oglint rule R6): a metric
+# name is legal only if it appears in the registered dict's literal
+# declaration, and read-modify-write increments must go through
+# bump()/COUNTER_LOCK. Modules register at import:
+#     MY_STATS = register_counters("subsystem", {...})
+COUNTER_REGISTRY: dict[str, dict] = {}
+
+
+def register_counters(name: str, counters: dict) -> dict:
+    """Register one subsystem's counter dict under the shared metric
+    registry (idempotent per name; re-registration must pass the same
+    dict — a second dict would fork the metric namespace)."""
+    old = COUNTER_REGISTRY.get(name)
+    if old is not None and old is not counters:
+        raise ValueError(f"counter registry {name!r} already bound")
+    COUNTER_REGISTRY[name] = counters
+    return counters
 
 
 def bump(counters: dict, key: str, n: int = 1) -> None:

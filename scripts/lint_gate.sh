@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Static-analysis + sanitizer gate (CI / tier-1 wrapper):
+#   1. scripts/oglint.py — the six repo-specific invariant rule
+#      classes (transfer discipline, knob registry + README drift,
+#      deadline propagation, lock ranks, trace purity, counter
+#      hygiene) over the whole tree; any violation fails the gate.
+#   2. when a sanitizer-capable C++ toolchain is present:
+#      make -C native sanitize (ASan+UBSan libogn) and
+#      scripts/sanitize_tests.sh (native-touching pytest suites
+#      against the instrumented library). sanitize_tests.sh documents
+#      its own skip when the toolchain can't build sanitizers.
+#
+# Called by scripts/perf_smoke.sh before the perf equivalence phases;
+# also a standalone CI step: scripts/lint_gate.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint_gate: oglint (R1-R6) =="
+python scripts/oglint.py
+
+echo "== lint_gate: native sanitizers =="
+scripts/sanitize_tests.sh
+
+echo "lint_gate: PASS"

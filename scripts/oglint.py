@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""CI/tier-1 entry point for the repo-specific invariant linter.
+
+Equivalent to ``python -m opengemini_tpu.lint``; exists so the gate
+scripts and CI need no package install or PYTHONPATH juggling:
+
+    python scripts/oglint.py               # full repo, all rules
+    python scripts/oglint.py --rules R2    # knob registry only
+    python scripts/oglint.py --knob-table  # print the README block
+    python scripts/oglint.py --fix-readme  # rewrite the README block
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..")))
+
+from opengemini_tpu.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

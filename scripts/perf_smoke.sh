@@ -21,6 +21,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# static-analysis + sanitizer gate first (scripts/lint_gate.sh):
+# oglint R1-R6 over the tree, then — when the toolchain can build
+# sanitizers — the ASan/UBSan native pass. Cheap relative to the perf
+# phases, and a lint/UB regression should fail before minutes of
+# bench run, not after. OG_SKIP_LINT_GATE=1 skips for bisection.
+if [ "${OG_SKIP_LINT_GATE:-0}" != "1" ]; then
+    scripts/lint_gate.sh
+fi
+
 export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 # small-scale bench config: ~48 hosts x 1h keeps the full pipeline

@@ -23,6 +23,26 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from opengemini_tpu.utils import knobs, lockrank  # noqa: E402
+
+# Run the whole tier-1 suite with the lock-rank runtime checker on
+# (utils/lockrank.py): any rank inversion in the scheduler/devicecache/
+# pipeline/stats lock web fails deterministically instead of deadlocking
+# a CI run. OG_LOCKRANK=0 force-disables for bisection.
+if knobs.get_raw("OG_LOCKRANK") != "0":
+    lockrank.enable(True)
+
+
+@pytest.fixture(autouse=True)
+def _knob_cache_hygiene():
+    """Registry-cached knobs (OG_SCHED, OG_DEVICE_CACHE_MB…) memoize
+    their parsed value; a test that monkeypatches the environment gets
+    a fresh read, and its value cannot leak into the next test.
+    Mid-test env flips must go through knobs.set_env/del_env."""
+    knobs.invalidate()
+    yield
+    knobs.invalidate()
+
 
 @pytest.fixture(autouse=True)
 def _stackdump_watchdog():
@@ -32,10 +52,7 @@ def _stackdump_watchdog():
     per test; exit=False so a slow-but-alive test merely logs.
     OG_TEST_STACKDUMP_S=0 disables."""
     import faulthandler
-    try:
-        timeout = float(os.environ.get("OG_TEST_STACKDUMP_S", "300"))
-    except ValueError:
-        timeout = 300.0
+    timeout = float(knobs.get("OG_TEST_STACKDUMP_S"))
     if timeout > 0:
         faulthandler.dump_traceback_later(timeout, exit=False)
     yield

@@ -1,0 +1,15 @@
+"""R3 failing fixture: literal RPC timeouts and raw sockets in the
+cluster layer."""
+import socket
+
+
+def hardcoded_timeout(client, body):
+    return client.call("store.write_rows", body, timeout=30.0)   # R301
+
+
+def hardcoded_stream(client, body):
+    return client.call_stream("store.scan", body, timeout=5)     # R301
+
+
+def raw_socket(addr):
+    return socket.create_connection(addr, timeout=5.0)           # R302

@@ -1,0 +1,11 @@
+"""R3 passing fixture: deadline-clamped timeouts and computed waits."""
+from opengemini_tpu.utils import deadline
+
+
+def clamped(client, body):
+    return client.call("store.write_rows", body,
+                       timeout=deadline.clamp(30.0))
+
+
+def computed(client, body, budget_s):
+    return client.try_call("store.scan", body, timeout=budget_s)

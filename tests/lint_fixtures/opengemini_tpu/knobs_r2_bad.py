@@ -1,0 +1,18 @@
+"""R2 failing fixture: raw OG_* environment access + unregistered
+knob names."""
+import os
+
+from opengemini_tpu.utils import knobs
+
+DEPTH = int(os.environ.get("OG_PIPELINE_DEPTH", "4"))       # R201
+ALSO = os.getenv("OG_SCHED")                                # R201
+SUB = os.environ["OG_BLOCK_SLAB"]                           # R201
+
+
+def flip():
+    os.environ["OG_SCHED"] = "0"                            # R202
+    os.environ.pop("OG_SCHED", None)                        # R202
+
+
+def typo():
+    return knobs.get("OG_TOTALLY_UNREGISTERED_KNOB")        # R203

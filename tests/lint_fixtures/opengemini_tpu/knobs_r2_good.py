@@ -1,0 +1,14 @@
+"""R2 passing fixture: registry reads, non-OG env vars, sanctioned
+flips."""
+import os
+
+from opengemini_tpu.utils import knobs
+
+DEPTH = int(knobs.get("OG_PIPELINE_DEPTH"))
+RAW = knobs.get_raw("OG_DEVICE_FINALIZE")
+OTHER = os.environ.get("XLA_FLAGS", "")     # not an OG_ knob
+
+
+def flip():
+    knobs.set_env("OG_SCHED", "0")
+    knobs.del_env("OG_SCHED")

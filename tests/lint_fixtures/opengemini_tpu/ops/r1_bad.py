@@ -1,0 +1,18 @@
+"""R1 failing fixture: every unaccounted-transfer shape the rule
+catches (lives under a fake opengemini_tpu/ops/ so the hot-path scope
+applies)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bare_device_get(tree):
+    return jax.device_get(tree)                      # R101
+
+
+def implicit_transfer(vals):
+    return np.asarray(jnp.stack(vals))               # R102
+
+
+def device_named_pull(planes_dev):
+    return np.asarray(planes_dev[:, :4])             # R103

@@ -1,0 +1,26 @@
+"""R1 passing fixture: accounted transports and host-side conversions
+the rule must NOT flag."""
+import jax
+import numpy as np
+
+from opengemini_tpu.ops.pipeline import device_get_parallel
+
+
+def accounted_pull(tree):
+    st = {}
+    return device_get_parallel(tree, stats=st)
+
+
+def host_conversion(rows):
+    # dtype-coercing host conversion: not a transfer
+    return np.asarray(rows, dtype=np.int64)
+
+
+def upload(x):
+    return jax.device_put(x)        # H2D is not a pull
+
+
+def annotated_sparse_repair(planes_dev, flagged, devstats):
+    sub = np.asarray(planes_dev[:, flagged])  # oglint: disable=R103
+    devstats.bump("d2h_bytes", int(sub.nbytes))
+    return sub

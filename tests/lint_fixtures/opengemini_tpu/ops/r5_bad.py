@@ -1,0 +1,44 @@
+"""R5 failing fixture: host state inside jit-traced code."""
+import functools
+import os
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from opengemini_tpu.utils import knobs
+
+_LOCK = threading.Lock()
+_STATE = {"calls": 0}
+
+
+@jax.jit
+def env_in_trace(x):
+    if os.environ.get("OG_EXACT_SUM") == "0":        # R501
+        return x
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def knob_in_trace(x, n):
+    scale = knobs.get("OG_BLOCK_SLAB")               # R501
+    return x * scale + n
+
+
+def _helper(x):
+    _STATE["calls"] += 1                             # R501 (via root)
+    return x * random.random()                       # R501
+
+
+@jax.jit
+def helper_caller(x):
+    return _helper(x) + jnp.sum(x)
+
+
+def lock_in_trace(x):
+    with _LOCK:                                      # R501 (acquire)
+        return x + 1
+
+
+_jitted = jax.jit(lock_in_trace)

@@ -1,0 +1,32 @@
+"""R5 passing fixture: pure traced kernels; host state stays in the
+un-traced dispatch wrapper."""
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from opengemini_tpu.utils import knobs
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def pure_kernel(x, n):
+    return jnp.cumsum(x) * n
+
+
+def _traced_helper(x):
+    return jnp.where(x > 0, x, 0)
+
+
+@jax.jit
+def pure_with_helper(x):
+    return _traced_helper(x) + 1
+
+
+def dispatch(x):
+    # host-side wrapper: knob reads HERE are fine — the value passes
+    # into the trace as a static argument
+    n = int(knobs.get("OG_BLOCK_SLAB"))
+    flag = os.environ.get("XLA_FLAGS", "")
+    del flag
+    return pure_kernel(x, n)

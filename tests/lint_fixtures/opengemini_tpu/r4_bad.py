@@ -1,0 +1,26 @@
+"""R4 failing fixture: blocking under a ranked lock + rank-order
+inversion (COUNTER_LOCK rank 40 must be innermost)."""
+import time
+
+from opengemini_tpu.utils.lockrank import (RANK_SCHED_HANDLE,
+                                           RANK_STATS, RankedLock)
+
+COUNTER_LOCK = RankedLock("stats.counter", RANK_STATS)
+_SCHED_LOCK = RankedLock("scheduler.handle", RANK_SCHED_HANDLE)
+
+
+def sleep_under_lock(counters):
+    with COUNTER_LOCK:
+        time.sleep(0.1)                     # R401
+        counters["x"] = counters.get("x", 0) + 1
+
+
+def wait_on_future_under_lock(fut):
+    with COUNTER_LOCK:
+        return fut.result(timeout=5)        # R401
+
+
+def inverted_nesting():
+    with COUNTER_LOCK:                      # rank 40 outer...
+        with _SCHED_LOCK:                   # R402: rank 5 inner
+            pass

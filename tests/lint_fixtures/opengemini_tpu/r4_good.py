@@ -1,0 +1,29 @@
+"""R4 passing fixture: rank-ordered nesting, blocking work outside
+the critical section."""
+import time
+
+from opengemini_tpu.utils.lockrank import (RANK_SCHED_HANDLE,
+                                           RANK_STATS, RankedLock)
+
+COUNTER_LOCK = RankedLock("stats.counter", RANK_STATS)
+_SCHED_LOCK = RankedLock("scheduler.handle", RANK_SCHED_HANDLE)
+
+
+def proper_nesting(counters):
+    with _SCHED_LOCK:                       # rank 5 outer
+        with COUNTER_LOCK:                  # rank 40 inner: fine
+            counters["x"] = counters.get("x", 0) + 1
+
+
+def sleep_outside(counters):
+    with COUNTER_LOCK:
+        counters["x"] = counters.get("x", 0) + 1
+    time.sleep(0.1)
+
+
+def deferred_blocking(fut):
+    def later():
+        return fut.result(timeout=5)        # runs outside the lock
+    with COUNTER_LOCK:
+        cb = later
+    return cb

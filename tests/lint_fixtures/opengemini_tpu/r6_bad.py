@@ -1,0 +1,21 @@
+"""R6 failing fixture: unregistered counter dict, typo'd bump key,
+unlocked read-modify-write."""
+from opengemini_tpu.utils.stats import bump
+
+ROGUE_STATS = {"hits": 0, "misses": 0}               # R601
+
+
+def typo_key():
+    bump(ROGUE_STATS, "hitz")                        # R602
+
+
+def unlocked_rmw(key):
+    ROGUE_STATS[key] += 1                            # R603
+
+
+class Node:
+    def __init__(self):
+        self.stats = {"writes": 0}
+
+    def write(self):
+        self.stats["writes"] += 1                    # R603

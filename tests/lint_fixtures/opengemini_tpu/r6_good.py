@@ -1,0 +1,19 @@
+"""R6 passing fixture: registered dict, declared keys, locked RMW."""
+import threading
+
+from opengemini_tpu.utils.stats import bump, register_counters
+
+GOOD_STATS = register_counters("fixture_good", {"hits": 0, "misses": 0})
+
+_local_lock = threading.Lock()
+
+
+def declared_key():
+    bump(GOOD_STATS, "hits")
+
+
+def locked_rmw(d):
+    with _local_lock:
+        GOOD_STATS["misses"] += 1
+    # local dicts are not shared counters
+    d["anything"] = d.get("anything", 0) + 1

@@ -1,0 +1,136 @@
+"""utils/knobs.py registry: parsing conventions, cache-on-raw
+semantics, set_env/del_env, table generation, and wiring regressions
+for the migrated hot-path readers."""
+
+import pytest
+
+from opengemini_tpu.utils import knobs
+
+
+def test_unset_returns_default():
+    knobs.del_env("OG_PIPELINE_DEPTH")
+    assert knobs.get("OG_PIPELINE_DEPTH") == 4
+
+
+def test_int_parse_and_garbage_falls_back(monkeypatch):
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "9")
+    assert knobs.get("OG_PIPELINE_DEPTH") == 9
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "not-a-number")
+    assert knobs.get("OG_PIPELINE_DEPTH") == 4
+
+
+def test_bool_conventions(monkeypatch):
+    # default-on knob: unset/1 → True, 0 → False, junk → default
+    monkeypatch.delenv("OG_SCHED", raising=False)
+    knobs.invalidate()
+    assert knobs.get("OG_SCHED") is True
+    monkeypatch.setenv("OG_SCHED", "0")
+    assert knobs.get("OG_SCHED") is False
+    monkeypatch.setenv("OG_SCHED", "2")
+    assert knobs.get("OG_SCHED") is True
+    # default-off knob keeps the == "1" convention
+    monkeypatch.setenv("OG_DENSE_DEVICE", "2")
+    assert knobs.get("OG_DENSE_DEVICE") is False
+    monkeypatch.setenv("OG_DENSE_DEVICE", "1")
+    assert knobs.get("OG_DENSE_DEVICE") is True
+
+
+def test_cached_knob_sees_env_flips_immediately(monkeypatch):
+    """The hot-path memo is keyed on the raw string: a raw env flip
+    (monkeypatch, not set_env) must still be visible on the next
+    read — no stale-cache hazard."""
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    from opengemini_tpu.ops import devicecache
+    assert devicecache.capacity_bytes() == 64 * 1024 * 1024
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "0")
+    assert devicecache.capacity_bytes() == 0
+    assert devicecache.enabled() is False
+
+
+def test_set_env_del_env_roundtrip():
+    knobs.set_env("OG_SCHED_DEPTH", 3)
+    assert knobs.get("OG_SCHED_DEPTH") == 3
+    knobs.del_env("OG_SCHED_DEPTH")
+    assert knobs.get("OG_SCHED_DEPTH") == 8
+
+
+def test_set_env_normalizes_python_bools():
+    """set_env(name, False) must actually turn a bool knob off —
+    str(False) would read back as the default (silently ON)."""
+    knobs.set_env("OG_SCHED", False)
+    assert knobs.get("OG_SCHED") is False
+    knobs.set_env("OG_SCHED", True)
+    assert knobs.get("OG_SCHED") is True
+    knobs.del_env("OG_SCHED")
+    with pytest.raises(TypeError):
+        knobs.set_env("OG_SCHED_DEPTH", True)   # int knob, bool value
+
+
+def test_native_lib_override_resolved_at_load_time(monkeypatch,
+                                                   tmp_path):
+    """OG_NATIVE_LIB set AFTER the native module imports still routes
+    the load to the override path (resolution is per _load, not
+    import-time)."""
+    from opengemini_tpu import native
+    missing = tmp_path / "nope-libogn.so"
+    monkeypatch.setenv("OG_NATIVE_LIB", str(missing))
+    monkeypatch.setattr(native, "_lib", None)
+    assert native._lib_path() == str(missing)
+    assert native._load() is None      # override missing → honest None
+
+
+def test_get_raw_tristate(monkeypatch):
+    monkeypatch.delenv("OG_DEVICE_FINALIZE", raising=False)
+    assert knobs.get_raw("OG_DEVICE_FINALIZE") is None
+    monkeypatch.setenv("OG_DEVICE_FINALIZE", "force")
+    assert knobs.get_raw("OG_DEVICE_FINALIZE") == "force"
+
+
+def test_unregistered_knob_raises():
+    with pytest.raises(KeyError):
+        knobs.get("OG_NO_SUCH_KNOB")
+    with pytest.raises(KeyError):
+        knobs.set_env("OG_NO_SUCH_KNOB", 1)
+    with pytest.raises(ValueError):
+        knobs.register("NOT_PREFIXED", int, 0, "x")
+
+
+def test_register_idempotent():
+    a = knobs.register("OG_PIPELINE_DEPTH", int, 4, "dup")
+    assert a is knobs._REGISTRY["OG_PIPELINE_DEPTH"]
+    assert a.doc != "dup"      # first declaration wins
+
+
+def test_knob_table_covers_registry():
+    md = knobs.knob_table_md()
+    for k in knobs.all_knobs():
+        assert f"`{k.name}`" in md
+    assert md.splitlines()[0].startswith("| knob ")
+
+
+def test_every_knob_the_code_reads_is_documented():
+    """Each registered knob has a non-empty doc and a sane scope."""
+    for k in knobs.all_knobs():
+        assert k.doc.strip(), k.name
+        assert k.scope in ("dynamic", "module-init", "cached"), k.name
+
+
+def test_migrated_readers_follow_the_registry(monkeypatch):
+    """Wiring regressions for the hot-loop satellites: the per-launch
+    and per-query readers go through knobs (flip → behavior change,
+    no import juggling)."""
+    from opengemini_tpu.ops import pipeline
+    from opengemini_tpu.query import scheduler
+    monkeypatch.setenv("OG_SCHED", "0")
+    assert scheduler.enabled() is False
+    monkeypatch.setenv("OG_SCHED", "1")
+    assert scheduler.enabled() is True
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "0")
+    assert pipeline.pipeline_depth() == 0
+    monkeypatch.setenv("OG_PIPELINE_DEPTH", "6")
+    assert pipeline.pipeline_depth() == 6
+    from opengemini_tpu.http import serializer
+    monkeypatch.setenv("OG_STREAM_JSON", "0")
+    assert serializer.stream_json_enabled() is False
+    monkeypatch.delenv("OG_STREAM_JSON", raising=False)
+    assert serializer.stream_json_enabled() is True

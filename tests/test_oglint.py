@@ -1,0 +1,208 @@
+"""oglint self-tests: every rule class proves itself on a failing AND
+a passing fixture (tests/lint_fixtures/ mirrors the hot-path layout so
+path-scoped rules apply), then the real repo is asserted clean — which
+is what makes oglint a tier-1 gate, not an optional script."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from opengemini_tpu.lint import run_lint
+from opengemini_tpu.lint.core import FileCtx, collect_files
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def codes_for(path: str) -> set:
+    """All violation codes oglint reports for one fixture file."""
+    vs = run_lint(FIXTURES, paths=[path])
+    return {v.code for v in vs}
+
+
+# ---------------------------------------------------- per-rule fixtures
+
+def test_r1_transfer_bad_fixture():
+    got = codes_for("opengemini_tpu/ops/r1_bad.py")
+    assert {"R101", "R102", "R103"} <= got, got
+
+
+def test_r1_transfer_good_fixture():
+    got = codes_for("opengemini_tpu/ops/r1_good.py")
+    assert not {c for c in got if c.startswith("R1")}, got
+
+
+def test_r2_knobs_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/knobs_r2_bad.py"])
+    got = {v.code for v in vs}
+    assert {"R201", "R202", "R203"} <= got, got
+    # three distinct raw reads are each reported
+    assert sum(1 for v in vs if v.code == "R201") == 3, vs
+
+
+def test_r2_knobs_good_fixture():
+    got = codes_for("opengemini_tpu/knobs_r2_good.py")
+    assert not {c for c in got if c.startswith("R2")}, got
+
+
+def test_r3_deadline_bad_fixture():
+    got = codes_for("opengemini_tpu/cluster/r3_bad.py")
+    assert {"R301", "R302"} <= got, got
+
+
+def test_r3_deadline_good_fixture():
+    got = codes_for("opengemini_tpu/cluster/r3_good.py")
+    assert not {c for c in got if c.startswith("R3")}, got
+
+
+def test_r4_lockrank_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/r4_bad.py"])
+    got = {v.code for v in vs}
+    assert {"R401", "R402"} <= got, got
+    assert sum(1 for v in vs if v.code == "R401") == 2, vs
+
+
+def test_r4_lockrank_good_fixture():
+    got = codes_for("opengemini_tpu/r4_good.py")
+    assert not {c for c in got if c.startswith("R4")}, got
+
+
+def test_r5_trace_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/ops/r5_bad.py"])
+    r5 = [v for v in vs if v.code == "R501"]
+    # env read, knob read, helper's module-state write + RNG, and the
+    # lock held inside an inline-jitted function
+    assert len(r5) >= 4, vs
+    lines = {v.line for v in r5}
+    assert len(lines) >= 4, r5
+
+
+def test_r5_trace_good_fixture():
+    got = codes_for("opengemini_tpu/ops/r5_good.py")
+    assert "R501" not in got, got
+
+
+def test_r6_counters_bad_fixture():
+    got = codes_for("opengemini_tpu/r6_bad.py")
+    assert {"R601", "R602", "R603"} <= got, got
+
+
+def test_r6_counters_good_fixture():
+    got = codes_for("opengemini_tpu/r6_good.py")
+    assert not {c for c in got if c.startswith("R6")}, got
+
+
+# ------------------------------------------------------- machinery
+
+def test_pragma_suppression(tmp_path):
+    bad = tmp_path / "opengemini_tpu" / "ops"
+    bad.mkdir(parents=True)
+    (bad / "suppressed.py").write_text(
+        "import jax\n"
+        "def f(t):\n"
+        "    return jax.device_get(t)  # oglint: disable=R101\n")
+    vs = run_lint(str(tmp_path))
+    assert vs == [], vs
+
+
+def test_pragma_rule_class_prefix(tmp_path):
+    bad = tmp_path / "opengemini_tpu" / "ops"
+    bad.mkdir(parents=True)
+    (bad / "suppressed.py").write_text(
+        "import jax\n"
+        "def f(t):\n"
+        "    return jax.device_get(t)  # oglint: disable=R1\n")
+    assert run_lint(str(tmp_path)) == []
+
+
+def test_skip_file_pragma(tmp_path):
+    bad = tmp_path / "opengemini_tpu" / "ops"
+    bad.mkdir(parents=True)
+    (bad / "skipped.py").write_text(
+        "# oglint: skip-file\n"
+        "import jax\n"
+        "def f(t):\n"
+        "    return jax.device_get(t)\n")
+    assert run_lint(str(tmp_path)) == []
+
+
+def test_unparseable_file_reported(tmp_path):
+    pkg = tmp_path / "opengemini_tpu"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def broken(:\n")
+    vs = run_lint(str(tmp_path))
+    assert [v.code for v in vs] == ["R000"], vs
+
+
+def test_collect_skips_tests_and_hidden():
+    files = collect_files(REPO)
+    assert not any(p.startswith(("tests/", ".")) for p in files), \
+        [p for p in files if p.startswith("tests/")][:3]
+    assert "opengemini_tpu/lint/core.py" in files
+
+
+def test_string_literal_pragma_is_inert(tmp_path):
+    pkg = tmp_path / "opengemini_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "s.py").write_text(
+        'import jax\n'
+        'NOTE = "# oglint: disable=R101"\n'
+        'def f(t):\n'
+        '    return jax.device_get(t)\n')
+    vs = run_lint(str(tmp_path))
+    assert [v.code for v in vs] == ["R101"], vs
+
+
+def test_filectx_parses_real_module():
+    ctx = FileCtx(REPO, "opengemini_tpu/utils/knobs.py")
+    assert ctx.tree is not None and not ctx.skip_file
+
+
+# --------------------------------------------------- repo-wide gate
+
+def test_repo_is_lint_clean():
+    """The tier-1 gate itself: all six rule classes, whole repo."""
+    vs = run_lint(REPO)
+    assert vs == [], "\n".join(str(v) for v in vs)
+
+
+def test_cli_knob_table_and_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "oglint.py"),
+         "--knob-table"], capture_output=True, text=True, env=env,
+        timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "OG_PIPELINE_DEPTH" in out.stdout
+    assert "OGLINT-KNOBS-BEGIN" in out.stdout
+
+    bad = tmp_path / "opengemini_tpu" / "ops"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text(
+        "import jax\n"
+        "def f(t):\n"
+        "    return jax.device_get(t)\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "oglint.py"),
+         "--root", str(tmp_path), "--rules", "R1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "R101" in out.stdout
+
+
+def test_readme_drift_detection(tmp_path):
+    """R204 fires when the README block disagrees with the registry."""
+    pkg = tmp_path / "opengemini_tpu"
+    pkg.mkdir()
+    from opengemini_tpu.lint.knob_rule import README_BEGIN, README_END
+    (tmp_path / "README.md").write_text(
+        f"# x\n\n{README_BEGIN}\n| stale | table |\n{README_END}\n")
+    vs = run_lint(str(tmp_path))
+    assert [v.code for v in vs] == ["R204"], vs
+
+    from opengemini_tpu.utils import knobs
+    (tmp_path / "README.md").write_text(
+        f"# x\n\n{README_BEGIN}\n{knobs.knob_table_md()}\n{README_END}\n")
+    assert run_lint(str(tmp_path)) == []
