@@ -40,6 +40,7 @@ import sys
 import tempfile
 import threading
 import time
+import uuid
 
 import numpy as np
 
@@ -242,6 +243,16 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
     out.setdefault("phases_ms", {})["serialize"] = round(
         (time.perf_counter() - t0) * 1e3, 3)
     out["serialized_bytes"] = n_ser
+    # histogram-derived tails (flight-recorder histograms): the timing
+    # loop above fed the per-phase and D2H-pull distributions — p50/p99
+    # say what the counters' means hide (one bad pull vs a slow link)
+    from opengemini_tpu.utils.stats import histogram_summaries
+    hs = histogram_summaries()
+    out["hist_p50_p99"] = {
+        grp + "." + k[:-4]: [g[k], g[k[:-4] + "_p99"]]
+        for grp in ("query_phase", "device")
+        for g in [hs.get(grp, {})]
+        for k in sorted(g) if k.endswith("_p50")}
     eng.close()
     return out
 
@@ -301,8 +312,14 @@ def kernel_micro() -> float:
     return G * W * P * K / best
 
 
-def http_roundtrip(data_dir: str) -> float:
-    """One warm query over HTTP (ms)."""
+def http_roundtrip(data_dir: str) -> tuple:
+    """One warm query over HTTP. Returns (ms, trace_info): the timed
+    request rides the flight recorder (X-OG-Trace forces the sample
+    WITHOUT touching OG_TRACE_SAMPLE, so the timed run itself stays on
+    the default path) and trace_info carries the merged tree's id, the
+    Chrome trace-event export path, and the span names seen — the
+    headline JSON's proof that HTTP → scheduler → executor phases →
+    pipeline lanes landed in ONE tree."""
     import urllib.request
     import urllib.parse
     from opengemini_tpu.http.server import HttpServer
@@ -311,13 +328,46 @@ def http_roundtrip(data_dir: str) -> float:
     eng = Engine(data_dir, EngineOptions(shard_duration=1 << 62))
     srv = HttpServer(eng, port=0)
     srv.start()
+    trace_info = {}
     try:
         url = (f"http://127.0.0.1:{srv.port}/query?db=bench&q="
                + urllib.parse.quote(QUERY))
         urllib.request.urlopen(url, timeout=600).read()   # warm
         t0 = time.perf_counter()
         urllib.request.urlopen(url, timeout=600).read()
-        return (time.perf_counter() - t0) * 1000
+        ms = (time.perf_counter() - t0) * 1000
+        # traced replay of the same warm query (forced sample), then
+        # pull its tree + Chrome export back out of the recorder
+        req = urllib.request.Request(url, headers={
+            "X-OG-Trace": uuid.uuid4().hex[:16]})
+        resp = urllib.request.urlopen(req, timeout=600)
+        resp.read()
+        tid = resp.headers.get("X-OG-Trace-Id", "")
+        if tid:
+            base = f"http://127.0.0.1:{srv.port}/debug/trace?id={tid}"
+            tree = json.loads(urllib.request.urlopen(
+                base, timeout=60).read())
+            chrome = urllib.request.urlopen(
+                base + "&format=chrome", timeout=60).read()
+            path = os.path.join(tempfile.gettempdir(),
+                                f"og_trace_{tid}.json")
+            with open(path, "wb") as f:
+                f.write(chrome)
+
+            def _names(d, acc):
+                acc.add(d["name"])
+                for c in d["children"]:
+                    _names(c, acc)
+                return acc
+
+            trace_info = {
+                "trace_id": tid, "trace_path": path,
+                "trace_span_names":
+                    sorted(_names(tree.get("spans", {
+                        "name": "?", "children": []}), set())),
+                "trace_overlap_ns": tree.get("spans", {}).get(
+                    "fields", {}).get("overlap_ns", 0)}
+        return ms, trace_info
     finally:
         srv.stop()
         eng.close()
@@ -350,10 +400,10 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
             print(f"# kernel_micro failed: {e}", file=sys.stderr)
             kernel_rps = 0.0
         try:
-            http_ms = http_roundtrip(td)
+            http_ms, trace_info = http_roundtrip(td)
         except Exception as e:
             print(f"# http_roundtrip failed: {e}", file=sys.stderr)
-            http_ms = 0.0
+            http_ms, trace_info = 0.0, {}
     e2e_rps = n_rows / tpu["1h"]["best_s"]
     return {
         "metric": "tsbs_double_groupby1_mean_e2e_rows_per_sec",
@@ -386,7 +436,12 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         "pull_bytes": tpu.get("pull_bytes", 0),
         "pull_gbps": tpu.get("pull_gbps", 0.0),
         "streamed_launches": tpu.get("streamed_launches", 0),
-        "pipeline_depth": _pipeline_depth()}
+        "pipeline_depth": _pipeline_depth(),
+        # flight recorder (PR 7): histogram-derived [p50, p99] per
+        # phase/D2H metric, plus the headline query's recorded trace
+        # (id + exported Chrome timeline path + merged span names)
+        "hist_p50_p99": tpu.get("hist_p50_p99", {}),
+        **trace_info}
 
 
 # ------------------------------------------- colstore (config 3)
@@ -733,7 +788,20 @@ def smoke_phase() -> dict:
 
         def run(qtext):
             (stmt,) = parse_query(qtext)
-            res = ex.execute(stmt, "bench")
+            # the trace-on config executes with a live span tree bound
+            # (what the HTTP layer does for a sampled request) — the
+            # digest compare below is the "results byte-identical with
+            # tracing on vs off" gate
+            if knobs.get_raw("OG_TRACE_SAMPLE") == "1":
+                from opengemini_tpu.utils import tracing
+                root = tracing.new_trace("query")
+                with tracing.bind(root, tracing.new_trace_id()):
+                    res = ex.execute(stmt, "bench", span=root)
+                root.end_ns = time.perf_counter_ns()
+                tracing.annotate_overlap(root)
+                last_res["root"] = root
+            else:
+                res = ex.execute(stmt, "bench")
             if "error" in res:
                 raise SystemExit(f"smoke query error: {res['error']}")
             last_res["res"] = res
@@ -763,7 +831,15 @@ def smoke_phase() -> dict:
                                      "OG_DEVICE_FINALIZE": "0"}),
                    ("devfinal-off-barrier",
                     {"OG_PIPELINE_DEPTH": "0",
-                     "OG_DEVICE_FINALIZE": "0"})]
+                     "OG_DEVICE_FINALIZE": "0"}),
+                   # tracing gate (PR 7): a sampled query carries a
+                   # full span tree through the executor + pipeline —
+                   # every result cell must match the untraced runs,
+                   # on the streamed AND single-barrier routes
+                   ("trace-on", {"OG_PIPELINE_DEPTH": "4",
+                                 "OG_TRACE_SAMPLE": "1"}),
+                   ("trace-on-barrier", {"OG_PIPELINE_DEPTH": "0",
+                                         "OG_TRACE_SAMPLE": "1"})]
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires
         E.BLOCK_MIN_RATIO = 0
@@ -799,6 +875,49 @@ def smoke_phase() -> dict:
         if got != want:
             raise SystemExit("SMOKE MISMATCH: streaming serializer "
                              "diverged from json.dumps")
+        # the last trace-on run's tree must export as loadable Chrome
+        # trace-event JSON with sane (non-negative, in-root) timestamps
+        from opengemini_tpu.utils import tracing
+        trec = tracing.TraceRecord(
+            trace_id="smoke", kind="query", text=QUERY, db="bench",
+            start_wall=time.time(), duration_ns=0,
+            root=last_res["root"])
+        cdoc = json.loads(tracing.chrome_json(trec))
+        xs = [e for e in cdoc["traceEvents"] if e["ph"] == "X"]
+        if not xs or any(e["ts"] < 0 or e["dur"] < 0 for e in xs):
+            raise SystemExit("SMOKE MISMATCH: chrome trace export "
+                             "empty or non-monotonic")
+        # tracing overhead gate: best-of-N wall of the 1h shape with a
+        # live span tree vs without must stay within
+        # OG_SMOKE_TRACE_OVERHEAD_PCT (default 3%) — with a small
+        # absolute slack so a sub-ms CI jitter can't flap the gate
+        (stmt_1h,) = parse_query(QUERY)
+        n_overhead = 7
+
+        def best_wall(span_on):
+            best = float("inf")
+            for _ in range(n_overhead):
+                t0 = time.perf_counter()
+                if span_on:
+                    root = tracing.new_trace("query")
+                    with tracing.bind(root, tracing.new_trace_id()):
+                        ex.execute(stmt_1h, "bench", span=root)
+                    root.end_ns = time.perf_counter_ns()
+                else:
+                    ex.execute(stmt_1h, "bench")
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        best_wall(False)                     # warm both code paths
+        t_off = best_wall(False)
+        t_on = best_wall(True)
+        overhead_pct = (t_on - t_off) / max(t_off, 1e-9) * 100
+        limit = float(knobs.get("OG_SMOKE_TRACE_OVERHEAD_PCT"))
+        if overhead_pct > limit and (t_on - t_off) > 2e-3:
+            raise SystemExit(
+                f"SMOKE MISMATCH: tracing overhead {overhead_pct:.2f}%"
+                f" (on {t_on * 1e3:.2f}ms vs off {t_off * 1e3:.2f}ms)"
+                f" exceeds {limit}%")
         (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
         phases = _parse_phases(ex.execute(est, "bench"))
         eng.close()
@@ -806,6 +925,9 @@ def smoke_phase() -> dict:
             "value": 1, "unit": "pass", "rows": n_rows,
             "cells_checked": checked,
             "configs": [c for c, _e in configs],
+            "trace_overhead_pct": round(overhead_pct, 2),
+            "trace_e2e_off_ms": round(t_off * 1e3, 2),
+            "trace_e2e_on_ms": round(t_on * 1e3, 2),
             **phases}
 
 

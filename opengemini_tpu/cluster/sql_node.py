@@ -173,6 +173,13 @@ class ClusterExecutor:
         if max_failed is None:
             max_failed = self.max_failed_stores
         dl = deadline.current()   # capture BEFORE the thread fan-out
+        # trace context: thread-locals don't cross the fan-out threads,
+        # so capture the parent span here and re-bind a per-store
+        # "scatter" child inside each worker — the RPC client then
+        # ships the context and grafts the store-side tree under it
+        from ..utils import tracing as _tracing
+        parent_sp = _tracing.current_span()
+        parent_tid = _tracing.current_trace_id()
         last_err = None
         for attempt in range(2):
             if dl is not None:
@@ -187,14 +194,23 @@ class ClusterExecutor:
             def run(i: int, addr: str, pts: list[int],
                     results=results, ok=ok, errors=errors,
                     timed_out=timed_out, lock=lock):
+                sc_sp = None
+                if parent_sp is not None:
+                    sc_sp = parent_sp.child("scatter")
+                    sc_sp.add(addr=addr, msg=msg, pts=len(pts))
                 try:
                     failpoint.inject("sql.scatter.delay")
                     if failpoint.inject("sql.scatter.drop"):
                         raise RPCError("failpoint: sql.scatter.drop")
                     t = dl.clamp(timeout) if dl is not None else timeout
                     body = {"db": db, "pts": pts, **body_extra}
-                    results[i] = self._client(addr).call(msg, body,
-                                                         timeout=t)
+                    if sc_sp is not None:
+                        with sc_sp, _tracing.bind(sc_sp, parent_tid):
+                            results[i] = self._client(addr).call(
+                                msg, body, timeout=t)
+                    else:
+                        results[i] = self._client(addr).call(
+                            msg, body, timeout=t)
                     ok[i] = True
                 except ErrQueryTimeout as e:
                     with lock:
@@ -246,10 +262,15 @@ class ClusterExecutor:
     # ------------------------------------------------------------- execute
 
     def execute(self, stmt, db: str | None = None, ctx=None,
-                inc_query_id: str | None = None, iter_id: int = 0) -> dict:
+                span=None, inc_query_id: str | None = None,
+                iter_id: int = 0) -> dict:
         # ctx (QueryContext): accepted for HTTP-layer parity with the
         # single-node executor; scatter hops check it at the statement
-        # boundary (store-side kill propagation is the RPC's concern)
+        # boundary (store-side kill propagation is the RPC's concern).
+        # span: the HTTP layer's per-statement trace span — scatter
+        # workers pick it up via the thread-local context the HTTP
+        # layer binds (utils.tracing.bind), so it is accepted here
+        # only for signature parity with QueryExecutor.execute
         try:
             if ctx is not None and getattr(ctx, "killed", False):
                 return {"error": f"query {ctx.qid} killed"}

@@ -302,6 +302,12 @@ class StoreNode:
         db, pts = body["db"], body["pts"]
         barrier_sound = self._read_barrier(db, pts)
         _bump_stat(self.stats, "selects")
+        # sampled sql→store traces: the RPC server bound a store-side
+        # root span for this hop (transport._dispatch) — thread it
+        # into partial_agg so the store's reader_scan/device_agg/
+        # device_pull phases ride back to the sql node's merged tree
+        from ..utils import tracing as _tracing
+        hop_span = _tracing.current_span()
         partials = []
         for pt in pts:
             dbk = db_key(db, pt)
@@ -323,7 +329,7 @@ class StoreNode:
                         for k in s.index.tag_keys(mst)}
             cond = analyze_condition(st.condition, tag_keys)
             p = self.executor.partial_agg(st, dbk, mst, cs, cond,
-                                          tag_keys)
+                                          tag_keys, span=hop_span)
             if p is not None:
                 partials.append(p)
         out = {"partial": merge_partials(partials)}

@@ -20,6 +20,7 @@ this is the data plane for partial aggregate states, so copies matter.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import random
 import socket
@@ -365,10 +366,11 @@ class RPCServer:
         from ..utils.stats import bump as _bump
         _bump(RPC_STATS, "requests")
 
-        def send(body, seq=0, done=True, err=None):
+        def send(body, seq=0, done=True, err=None, extra=None):
             data = encode_frame(
                 {"t": mtype, "rid": rid, "seq": seq, "done": done,
-                 **({"err": err} if err else {})}, body)
+                 **({"err": err} if err else {}),
+                 **(extra or {})}, body)
             _bump(RPC_STATS, "responses")
             _bump(RPC_STATS, "bytes_out", len(data))
             if err:
@@ -379,24 +381,55 @@ class RPCServer:
         if fn is None:
             send(None, err=f"no handler for {mtype!r}")
             return
+        # trace-context propagation (utils/tracing flight recorder):
+        # a sampled caller ships {"tc": {"tid": ...}} — run the handler
+        # under a server-side root span (thread-local bind, this
+        # dispatch owns its thread) and return the finished tree on the
+        # final frame so the sql node merges sql→store into ONE tree
+        tc = frame.get("tc")
+        srv_sp = None
+        if isinstance(tc, dict):
+            from ..utils import tracing as _tracing
+            srv_sp = _tracing.Span(f"store:{mtype}")
+            srv_sp.start_ns = time.perf_counter_ns()
+            srv_sp.add(node=self.name)
+
+        def _done_extra():
+            if srv_sp is None:
+                return None
+            srv_sp.end_ns = time.perf_counter_ns()
+            return {"tspan": srv_sp.to_dict()}
+
+        if srv_sp is not None:
+            from ..utils import tracing as _tracing
+            cm = _tracing.bind(srv_sp, (tc or {}).get("tid"))
+        else:
+            cm = contextlib.nullcontext()
         try:
-            res = fn(frame.get("body"))
-            if hasattr(res, "__next__"):       # streaming handler
-                seq = 0
-                last = None
-                have = False
-                for item in res:
-                    if have:
-                        send(last, seq=seq, done=False)
-                        seq += 1
-                    last, have = item, True
-                send(last if have else None, seq=seq, done=True)
-            else:
-                send(res)
+            # the whole dispatch — handler call AND streaming drain —
+            # runs inside the bound context: generator handlers create
+            # spans at next() time, and frames still go out one by one
+            # (a traced request must not buffer the stream in memory)
+            with cm:
+                res = fn(frame.get("body"))
+                if hasattr(res, "__next__"):   # streaming handler
+                    seq = 0
+                    last = None
+                    have = False
+                    for item in res:
+                        if have:
+                            send(last, seq=seq, done=False)
+                            seq += 1
+                        last, have = item, True
+                    send(last if have else None, seq=seq, done=True,
+                         extra=_done_extra())
+                else:
+                    send(res, extra=_done_extra())
         except Exception as e:   # handler errors travel to the caller
             log.exception("%s handler %s failed", self.name, mtype)
             try:
-                send(None, err=f"{type(e).__name__}: {e}")
+                send(None, err=f"{type(e).__name__}: {e}",
+                     extra=_done_extra())
             except OSError:
                 pass
 
@@ -511,11 +544,24 @@ class RPCClient:
     def call_stream(self, msg_type: str, body=None, timeout: float = 60.0):
         """Request with streaming response: yields each frame's body.
         Consults the peer's circuit breaker (fail-fast on dead peers)
-        and clamps the wait by any deadline bound in this thread."""
+        and clamps the wait by any deadline bound in this thread.
+
+        Trace propagation (utils/tracing): when a span context is
+        bound in this thread, the frame header carries the trace id
+        (``tc``) and a child span ``rpc:<msg>`` wraps the exchange;
+        the peer's span tree (final-frame ``tspan`` header) grafts
+        under it — the sql→store fan-out merges into one tree."""
         rid = uuid.uuid4().hex
         q: Queue = Queue()
         s = None
         br = breaker_for(self.addr_str) if BREAKERS_ENABLED else None
+        from ..utils import tracing as _tracing
+        parent_sp = _tracing.current_span()
+        rpc_sp = None
+        if parent_sp is not None:
+            rpc_sp = parent_sp.child(f"rpc:{msg_type}")
+            rpc_sp.start_ns = time.perf_counter_ns()
+            rpc_sp.add(peer=self.addr_str)
         # fault injection: simulate a dropped/slow RPC (reference plants
         # failpoints in the spdy transport, SURVEY.md §4). RPCError is
         # what real transport failures surface as — the injected fault
@@ -538,7 +584,11 @@ class RPCClient:
             s = self._ensure()
             with self._plock:
                 self._pending[rid] = (s, q)
-            data = encode_frame({"t": msg_type, "rid": rid}, body)
+            header = {"t": msg_type, "rid": rid}
+            if rpc_sp is not None:
+                header["tc"] = {"tid": _tracing.current_trace_id()
+                                or ""}
+            data = encode_frame(header, body)
             with self._wlock:
                 if self._sock is not s:
                     raise ConnectionError("connection lost")
@@ -560,6 +610,17 @@ class RPCClient:
                     frame = q.get(timeout=min(left, 1.0))
                 except Empty:
                     continue
+                if rpc_sp is not None and frame.get("tspan"):
+                    try:
+                        # rebase: the peer's clock base is only
+                        # comparable when it shares this process;
+                        # otherwise the tree shifts rigidly into this
+                        # RPC's local window (final frame ≈ rpc end)
+                        rpc_sp.attach(_tracing.rebase_into(
+                            _tracing.Span.from_dict(frame["tspan"]),
+                            rpc_sp.start_ns, time.perf_counter_ns()))
+                    except Exception:   # a malformed remote tree must
+                        pass            # never fail the data path
                 if frame.get("err"):
                     if br is not None:
                         if frame.get("xport"):
@@ -581,6 +642,8 @@ class RPCClient:
         finally:
             with self._plock:
                 self._pending.pop(rid, None)
+            if rpc_sp is not None:
+                rpc_sp.end_ns = time.perf_counter_ns()
 
     def try_call(self, msg_type: str, body=None, timeout: float = 60.0,
                  retries: int = 2, backoff: float = 0.2):

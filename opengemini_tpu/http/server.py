@@ -29,12 +29,44 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__
 from ..query import QueryExecutor, ParseError, parse_query
-from ..utils import deadline, get_logger
+from ..utils import deadline, get_logger, knobs, tracing
 from ..utils.errors import GeminiError
 from ..utils.resources import ResourceExhausted
 from ..utils.lineprotocol import PRECISION_NS
 
 log = get_logger(__name__)
+
+# per-request latency distributions (flight-recorder tentpole): the
+# monotonic httpd counters say HOW MANY, these say HOW SLOW — p50/p99
+# surface in /debug/vars and the stats pusher, full bucket vectors in
+# Prometheus histogram form on /metrics
+from ..utils.stats import Histogram, exp_bounds  # noqa: E402
+from ..utils.stats import observe as _observe  # noqa: E402
+from ..utils.stats import register_histograms  # noqa: E402
+
+HTTP_HIST: dict = register_histograms("httpd", {
+    # end-to-end /query and /write handler wall
+    "query_latency_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    "write_latency_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    # per-route request wall (transport framing included)
+    "route_query_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    "route_write_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    "route_api_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    "route_debug_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+    "route_other_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+})
+
+
+def _route_class(path: str) -> str:
+    if path == "/query":
+        return "query"
+    if path == "/write":
+        return "write"
+    if path.startswith("/api/"):
+        return "api"
+    if path.startswith("/debug") or path == "/metrics":
+        return "debug"
+    return "other"
 
 _PASSWORD_RE = re.compile(
     r"(password(?:\s+for\s+\S+\s*=)?\s*)'(?:[^']|'')*'", re.IGNORECASE)
@@ -151,6 +183,8 @@ class HttpServer:
             sp.register("query_phases", phase_collector)
             from ..utils.stats import scheduler_collector
             sp.register("scheduler", scheduler_collector)
+            from ..utils.stats import latency_collector
+            sp.register("latency", latency_collector)
             sp.register("wal", wal_collector)
             sp.register("raft", raft_collector)
             sp.register("subscriber", subscriber_collector)
@@ -508,8 +542,103 @@ class HttpServer:
 
     # ----------------------------------------------------------- handlers
 
-    def handle_write(self, params: dict, body: bytes,
-                     user=None) -> tuple[int, dict]:
+    # ------------------------------------------------ flight recorder
+
+    def _slow_threshold_ns(self) -> int:
+        """Slow-query threshold: OG_SLOW_QUERY_MS when set (> 0), else
+        the [http] slow_query_threshold config (previously declared
+        and never read); 0 disables slow detection."""
+        ms = float(knobs.get("OG_SLOW_QUERY_MS"))
+        if ms > 0:
+            return int(ms * 1e6)
+        return int(self.config.http.slow_query_threshold_ns)
+
+    def _trace_begin(self, kind: str, headers=None):
+        """(trace_id, root_span | None, sampled): head-sample roll for
+        one request. A client-supplied X-OG-Trace header forces the
+        sample and fixes the trace id (cross-service correlation)."""
+        hdr_tid = None
+        if headers is not None:
+            try:
+                hdr_tid = headers.get("X-OG-Trace")
+            except Exception:
+                hdr_tid = None
+        sampled = bool(hdr_tid) or tracing.should_sample()
+        trace_id = (hdr_tid or tracing.new_trace_id())[:32]
+        root = tracing.new_trace(kind) if sampled else None
+        return trace_id, root, sampled
+
+    def _finish_trace(self, kind: str, text: str, db: str | None,
+                      t0_ns: int, trace_id: str, root, sampled: bool,
+                      tstat: dict, meta: dict | None = None) -> None:
+        """Close one request's trace: classify (ok/error/shed/killed/
+        slow), log + ring-retain slow queries (the now-wired
+        slow_query_threshold), record into the flight recorder. A
+        sampled-out OK request records NOTHING (overhead guard)."""
+        dur_ns = time.perf_counter_ns() - t0_ns
+        status = tstat.get("status", "ok")
+        thresh = self._slow_threshold_ns()
+        slow = thresh > 0 and dur_ns >= thresh and kind == "query"
+        if status == "ok" and slow:
+            status = "slow"
+        text = _redact_passwords(text)
+        phases = {}
+        if root is not None:
+            root.end_ns = time.perf_counter_ns()
+            tracing.annotate_overlap(root)
+            from ..ops.devstats import PHASE_NAMES
+            for s in root.walk():
+                if s.name in PHASE_NAMES:
+                    phases[s.name] = round(
+                        phases.get(s.name, 0.0)
+                        + s.duration_ns / 1e6, 3)
+        if slow:
+            self._bump("slow_queries")
+            entry = {"trace_id": trace_id, "query": text,
+                     "db": db or "", "at": time.time(),
+                     "duration_ms": round(dur_ns / 1e6, 3),
+                     "phases_ms": phases}
+            with self._stats_lock:
+                self.slow_log.append(entry)
+            log.warning(
+                "slow query (%.1fms > %.1fms) db=%s trace_id=%s "
+                "phases_ms=%s: %s", dur_ns / 1e6, thresh / 1e6,
+                db or "", trace_id, phases, text)
+        if sampled or status != "ok":
+            tracing.recorder().record(tracing.TraceRecord(
+                trace_id=trace_id, kind=kind, text=text, db=db or "",
+                start_wall=time.time() - dur_ns / 1e9,
+                duration_ns=int(dur_ns), status=status,
+                error=tstat.get("error", ""), sampled=sampled,
+                root=root))
+            if meta is not None:
+                meta["trace_id"] = trace_id
+
+    def handle_write(self, params: dict, body: bytes, user=None,
+                     headers=None,
+                     meta: dict | None = None) -> tuple[int, dict]:
+        """Tracing front of the write path: every write rolls the head
+        sample (X-OG-Trace forces it and pins the id, like /query);
+        failed writes are retained in the slow/error ring and the
+        recorded trace id rides back via ``meta`` → X-OG-Trace-Id."""
+        t0 = time.perf_counter_ns()
+        trace_id, root, sampled = self._trace_begin("write", headers)
+        code, payload = self._handle_write_inner(params, body,
+                                                 user=user)
+        _observe(HTTP_HIST, "write_latency_ms",
+                 (time.perf_counter_ns() - t0) / 1e6)
+        tstat = {"status": "ok" if code < 400 else "error",
+                 "error": (payload or {}).get("error", "")}
+        if root is not None:
+            root.add(db=params.get("db") or "", code=code)
+        self._finish_trace("write",
+                           f"POST /write db={params.get('db') or ''}",
+                           params.get("db"), t0, trace_id, root,
+                           sampled, tstat, meta)
+        return code, payload
+
+    def _handle_write_inner(self, params: dict, body: bytes,
+                            user=None) -> tuple[int, dict]:
         if self.sysctrl.readonly:
             self._bump("write_errors")
             return 403, {"error": "server is in readonly mode"}
@@ -583,7 +712,8 @@ class HttpServer:
         self.resources.queries.acquire(ctx=ctx)
         return None, True
 
-    def handle_query(self, params: dict, user=None) -> tuple[int, dict]:
+    def handle_query(self, params: dict, user=None, headers=None,
+                     meta: dict | None = None) -> tuple[int, dict]:
         qtext = params.get("q")
         if not qtext:
             return 400, {"error": "missing required parameter \"q\""}
@@ -612,12 +742,25 @@ class HttpServer:
         results = []
         budget = self._request_budget(params,
                                       self.config.data.query_timeout_ns)
+        from ..ops import devstats as _dstat
         from ..query import scheduler as _qsched
         from ..query.ast import SelectStatement
+        # flight recorder (tentpole): head-sample roll; sampled
+        # requests carry a span tree end to end, sampled-out requests
+        # see span=None everywhere (the pre-PR-7 hot path, no span
+        # allocations) but are still retained in the slow/error ring
+        # when they fail or run slow
+        t_q0 = time.perf_counter_ns()
+        trace_id, root, sampled = self._trace_begin("query", headers)
+        if root is not None:
+            root.add(db=db or "", statements=len(stmts))
+        tstat = {"status": "ok", "error": ""}
         # register at ENQUEUE time: a queued query is visible to SHOW
         # QUERIES (status "queued") and killable before admission
         ctx = self.query_manager.attach(qtext, db) \
             if self.query_manager is not None else None
+        if ctx is not None:
+            ctx.trace_id = trace_id
         ticket = None
         gate_held = False
         try:
@@ -628,23 +771,43 @@ class HttpServer:
             # (utils.deadline)
             with deadline.bind(budget, what="query"):
                 if any(isinstance(s, SelectStatement) for s in stmts):
+                    adm_sp = root.child("sched_queue") \
+                        if root is not None else None
+                    if adm_sp is not None:
+                        adm_sp.start_ns = time.perf_counter_ns()
                     try:
                         ticket, gate_held = self._admit_query(
                             stmts, db, ctx)
                     except _qsched.SchedShed as e:
                         self._bump("query_errors")
+                        tstat.update(status="shed", error=str(e))
                         return e.http_code, {
                             "error": str(e),
                             "retry_after": round(e.retry_after_s, 3)}
                     except ResourceExhausted as e:
                         self._bump("query_errors")
+                        tstat.update(status="shed", error=str(e))
                         return 503, {"error": str(e)}
                     except GeminiError as e:
                         # killed or out of budget while queued: an
                         # ordinary query error, never a dead connection
                         self._bump("query_errors")
+                        tstat.update(
+                            status=("killed" if ctx is not None
+                                    and ctx.killed else "error"),
+                            error=str(e))
                         return 200, {"results": [
                             {"statement_id": 0, "error": str(e)}]}
+                    finally:
+                        if adm_sp is not None:
+                            adm_sp.end_ns = time.perf_counter_ns()
+                            adm_sp.add(queued=bool(
+                                ctx is not None and ctx.queue_ns))
+                    # admission wait joins the cumulative phase split
+                    # (and its histogram) even when it was ~0
+                    _dstat.bump_phase(
+                        "sched_queue",
+                        ctx.queue_ns if ctx is not None else 0)
                 for i, stmt in enumerate(stmts):
                     try:
                         deny = self._deny_privilege(stmt, user) \
@@ -662,10 +825,28 @@ class HttpServer:
                             # multi-statement query
                             stmt_qid = f"{inc_qid}#{i}" if inc_qid \
                                 else None
-                            res = self.executor.execute(
-                                stmt, db, ctx=ctx,
-                                inc_query_id=stmt_qid,
-                                iter_id=iter_id)
+                            if root is not None:
+                                # per-statement span, bound as the
+                                # thread's trace context so cluster
+                                # scatter hops propagate it over RPC
+                                ssp = root.child("statement")
+                                ssp.start_ns = time.perf_counter_ns()
+                                ssp.add(statement_id=i)
+                                try:
+                                    with tracing.bind(ssp, trace_id):
+                                        res = self.executor.execute(
+                                            stmt, db, ctx=ctx,
+                                            span=ssp,
+                                            inc_query_id=stmt_qid,
+                                            iter_id=iter_id)
+                                finally:
+                                    ssp.end_ns = \
+                                        time.perf_counter_ns()
+                            else:
+                                res = self.executor.execute(
+                                    stmt, db, ctx=ctx,
+                                    inc_query_id=stmt_qid,
+                                    iter_id=iter_id)
                     except GeminiError as e:
                         # typed budget/engine errors (ErrQueryTimeout
                         # et al)
@@ -681,6 +862,11 @@ class HttpServer:
                         _convert_epoch(res["series"], epoch)
                     if "error" in res:
                         self._bump("query_errors")
+                        if tstat["status"] == "ok":
+                            tstat.update(
+                                status=("killed" if ctx is not None
+                                        and ctx.killed else "error"),
+                                error=res["error"])
                     results.append(res)
         finally:
             if ticket is not None:
@@ -689,6 +875,10 @@ class HttpServer:
                 self.resources.queries.release()
             if ctx is not None:
                 self.query_manager.detach(ctx)
+            _observe(HTTP_HIST, "query_latency_ms",
+                     (time.perf_counter_ns() - t_q0) / 1e6)
+            self._finish_trace("query", qtext, db, t_q0, trace_id,
+                               root, sampled, tstat, meta)
         return 200, {"results": results}
 
     def metrics_text(self) -> str:
@@ -730,6 +920,11 @@ class HttpServer:
                 name = f"opengemini_{grp}_{k}"
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {v}")
+        # registered latency/size histograms (query latency, queue
+        # wait, D2H bytes, phases, routes) in native Prometheus
+        # histogram exposition — _bucket{le=}/_sum/_count
+        from ..utils.stats import histograms_prometheus
+        lines.extend(histograms_prometheus())
         return "\n".join(lines) + "\n"
 
     # --------------------------------------------------- flux endpoint
@@ -1170,11 +1365,13 @@ class _Handler(BaseHTTPRequestHandler):
         return raw
 
     def _reply_query(self, code: int, payload: dict,
-                     params: dict | None = None) -> None:
+                     params: dict | None = None,
+                     extra_headers: dict | None = None) -> None:
         """/query responses honor Accept (csv/msgpack) and chunked
         streaming (reference response_writer.go). ``params`` must be the
         handler's MERGED params (URL + form body) so chunked=true in a
-        form-encoded POST body is honored too."""
+        form-encoded POST body is honored too. ``extra_headers`` rides
+        every branch (X-OG-Trace-Id of a recorded trace)."""
         if params is None:
             params = self._params()
         if code in (429, 503) and isinstance(payload, dict) \
@@ -1184,7 +1381,8 @@ class _Handler(BaseHTTPRequestHandler):
             # plain HTTP clients can back off without parsing JSON
             self._reply(code, payload, headers={
                 "Retry-After":
-                    str(max(1, int(round(payload["retry_after"]))))})
+                    str(max(1, int(round(payload["retry_after"])))),
+                **(extra_headers or {})})
             return
         accept = self.headers.get("Accept", "")
         if code == 200 and params.get("chunked") == "true":
@@ -1197,6 +1395,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("Access-Control-Allow-Origin", "*")
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             for c in chunk_results(payload, chunk_size):
                 blob = json.dumps(c).encode() + b"\n"
@@ -1216,7 +1416,8 @@ class _Handler(BaseHTTPRequestHandler):
             # behind a bounded queue while this thread writes the
             # socket — the 380MB-document json.dumps stall is gone
             # (OG_STREAM_JSON=0 restores the buffered route)
-            self._stream_query(payload, csv=want_csv)
+            self._stream_query(payload, csv=want_csv,
+                               extra_headers=extra_headers)
             return
         if code == 200 and want_csv:
             from .formats import results_to_csv
@@ -1227,16 +1428,19 @@ class _Handler(BaseHTTPRequestHandler):
             body = msgpack_encode(payload)
             ctype = "application/x-msgpack"
         else:
-            self._reply(code, payload)
+            self._reply(code, payload, headers=extra_headers)
             return
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Access-Control-Allow-Origin", "*")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _stream_query(self, payload: dict, csv: bool) -> None:
+    def _stream_query(self, payload: dict, csv: bool,
+                      extra_headers: dict | None = None) -> None:
         """Chunked-transfer emit of a /query result (streaming
         serialization tentpole): pieces encode on a background thread
         behind a small bounded queue while THIS thread writes the
@@ -1259,6 +1463,8 @@ class _Handler(BaseHTTPRequestHandler):
                              "1.8-opengemini-tpu-" + __version__)
         self.send_header("Access-Control-Allow-Origin", "*")
         self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         w = self.wfile
         for p in stream_chunks(pieces):
@@ -1291,6 +1497,24 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- methods ---------------------------------------------------------
 
     def do_GET(self):
+        t0 = time.perf_counter_ns()
+        try:
+            self._do_GET()
+        finally:
+            _observe(HTTP_HIST,
+                     f"route_{_route_class(self._path())}_ms",
+                     (time.perf_counter_ns() - t0) / 1e6)
+
+    def do_POST(self):
+        t0 = time.perf_counter_ns()
+        try:
+            self._do_POST()
+        finally:
+            _observe(HTTP_HIST,
+                     f"route_{_route_class(self._path())}_ms",
+                     (time.perf_counter_ns() - t0) / 1e6)
+
+    def _do_GET(self):
         srv = self.server_ref
         path = self._path()
         ok, user = self._auth()
@@ -1323,12 +1547,48 @@ class _Handler(BaseHTTPRequestHandler):
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
             from ..utils.stats import (devicecache_collector,
+                                       histogram_summaries,
                                        scheduler_collector)
             out = dict(srv.stats)
             out["device"] = device_collector()
             out["devicecache"] = devicecache_collector()
             out["query_phases"] = phase_collector()
             out["scheduler"] = scheduler_collector()
+            # p50/p95/p99 summaries of every registered histogram
+            # (query/write latency, queue wait, phases, D2H pulls)
+            out["latency"] = histogram_summaries()
+            out["slow_log"] = list(srv.slow_log)
+            self._reply(200, out)
+            return
+        if path == "/debug/requests":
+            # flight-recorder summary: the last N completed traces
+            # plus the always-kept slow/error ring (query text is
+            # password-redacted before it ever reaches a record)
+            self._reply(200, tracing.recorder().summaries())
+            return
+        if path == "/debug/trace":
+            p = self._params()
+            tid = p.get("id", "")
+            rec = tracing.recorder().get(tid) if tid else None
+            if rec is None:
+                self._reply(404, {"error": f"no trace {tid!r} in the "
+                                  "flight recorder (see "
+                                  "/debug/requests)"})
+                return
+            if p.get("format") == "chrome":
+                # Chrome trace-event / Perfetto timeline export
+                body = tracing.chrome_json(rec).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            out = rec.summary()
+            if rec.root is not None:
+                out["tree"] = rec.root.render()
+                out["spans"] = rec.root.to_dict()
             self._reply(200, out)
             return
         if path == "/debug/ctrl":
@@ -1339,8 +1599,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, payload)
             return
         if path == "/query":
-            code, payload = srv.handle_query(self._params(), user=user)
-            self._reply_query(code, payload)
+            meta: dict = {}
+            code, payload = srv.handle_query(
+                self._params(), user=user, headers=self.headers,
+                meta=meta)
+            self._reply_query(code, payload,
+                              extra_headers=self._trace_headers(meta))
             return
         if self._is_logstore(path):
             code, payload = srv.handle_logstore("GET", path,
@@ -1360,7 +1624,15 @@ class _Handler(BaseHTTPRequestHandler):
                 or path.startswith("/api/v1/logstream")
                 or path.startswith("/repo/"))
 
-    def do_POST(self):
+    @staticmethod
+    def _trace_headers(meta: dict) -> dict | None:
+        """X-OG-Trace-Id response header when the request landed in
+        the flight recorder (sampled, or retained as slow/failed)."""
+        if meta.get("trace_id"):
+            return {"X-OG-Trace-Id": meta["trace_id"]}
+        return None
+
+    def _do_POST(self):
         srv = self.server_ref
         path = self._path()
         ok, user = self._auth()
@@ -1372,9 +1644,13 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
+            wmeta: dict = {}
             code, payload = srv.handle_write(self._params(), body,
-                                             user=user)
-            self._reply(code, payload if code != 204 else None)
+                                             user=user,
+                                             headers=self.headers,
+                                             meta=wmeta)
+            self._reply(code, payload if code != 204 else None,
+                        headers=self._trace_headers(wmeta))
             return
         if path == "/query":
             try:
@@ -1382,8 +1658,12 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # bad gzip / non-utf8 form body
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
-            code, payload = srv.handle_query(params, user=user)
-            self._reply_query(code, payload, params=params)
+            meta: dict = {}
+            code, payload = srv.handle_query(params, user=user,
+                                             headers=self.headers,
+                                             meta=meta)
+            self._reply_query(code, payload, params=params,
+                              extra_headers=self._trace_headers(meta))
             return
         if path == "/debug/ctrl":
             if not self._admin_gate(user):

@@ -22,6 +22,13 @@ Codes:
   ``devstats.bump("d2h_bytez")`` is caught).
 - R603: unlocked ``+=``/read-modify-write on a registered counter
   dict or a ``self.stats`` attribute.
+- R604: module-level ``*_HIST`` histogram dict not registered via
+  utils.stats.register_histograms (the flight-recorder histograms
+  share the counter registry's one-namespace rule).
+- R605: observe() with a bucket/metric label missing from the
+  histogram dict's declaration (same wrapper + cross-module alias
+  resolution as R602 — a typo'd label would mint an unwatched
+  latency series while the dashboards stay flat).
 """
 
 from __future__ import annotations
@@ -32,15 +39,19 @@ import re
 from .core import FileCtx, Repo, Rule, Violation, const_str, dotted
 
 _STATS_NAME = re.compile(r"(_STATS|_PHASE_NS)$")
+_HIST_NAME = re.compile(r"_HIST$")
 _BUMP_FNS = {"bump", "_b", "_bump", "_bump_stat", "_bump_r",
              "_bump_plane"}
+_OBSERVE_FNS = {"observe", "_observe", "hobserve"}
 
 
 def _dict_literal_keys(node: ast.AST) -> set[str] | None:
     if isinstance(node, ast.Call) and node.args:
-        # register_counters("name", {...})
+        # register_counters("name", {...}) / register_histograms(...)
         d = dotted(node.func)
-        if d.endswith("register_counters") and len(node.args) >= 2:
+        if (d.endswith("register_counters")
+                or d.endswith("register_histograms")) \
+                and len(node.args) >= 2:
             node = node.args[1]
     if isinstance(node, ast.Dict):
         keys = set()
@@ -59,6 +70,7 @@ class _ModuleInfo:
     def __init__(self):
         self.counter_keys: dict[str, set] = {}   # dict name -> keys
         self.registered: set = set()             # dict names registered
+        self.hist_dicts: set = set()             # *_HIST dict names
         # wrapper name -> (dict name, key suffix) for one-arg bumpers
         self.wrappers: dict[str, tuple[str, str]] = {}
         # alias -> module basename for `from . import devstats as _ds`
@@ -72,6 +84,10 @@ class CounterRule(Rule):
         "R601": "counter dict not registered via register_counters",
         "R602": "bump key missing from the counter declaration",
         "R603": "unlocked read-modify-write on a shared counter",
+        "R604": "histogram dict not registered via "
+                "register_histograms",
+        "R605": "observe label missing from the histogram "
+                "declaration",
     }
 
     def check(self, ctx: FileCtx) -> list[Violation]:
@@ -109,7 +125,27 @@ class CounterRule(Rule):
                 tgt, val = node.target.id, node.value
             if tgt is None or val is None:
                 continue
-            if not _STATS_NAME.search(tgt):
+            is_hist = bool(_HIST_NAME.search(tgt))
+            if not is_hist and not _STATS_NAME.search(tgt):
+                continue
+            if is_hist:
+                # a *_HIST dict must register even when its keys are
+                # computed (dict comprehension) — check before the
+                # literal-keys gate below
+                info.hist_dicts.add(tgt)
+                is_reg = isinstance(val, ast.Call) and dotted(
+                    val.func).endswith("register_histograms")
+                if is_reg:
+                    info.registered.add(tgt)
+                else:
+                    out.append(Violation(
+                        ctx.path, node.lineno, "R604",
+                        f"histogram dict {tgt} must be declared "
+                        "through utils.stats.register_histograms() "
+                        "so the metric namespace has one registry"))
+                keys = _dict_literal_keys(val)
+                if keys is not None:
+                    info.counter_keys[tgt] = keys
                 continue
             keys = _dict_literal_keys(val)
             if keys is None:
@@ -127,7 +163,8 @@ class CounterRule(Rule):
                     "namespace has one registry"))
 
     def _collect_wrappers(self, ctx, info) -> None:
-        """def bump(key, n=1): _b(DICT, key [+ '_sfx'], n) wrappers."""
+        """def bump(key, n=1): _b(DICT, key [+ '_sfx'], n) wrappers —
+        and their histogram twins (observe/_observe)."""
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.FunctionDef) or not node.args.args:
                 continue
@@ -135,7 +172,8 @@ class CounterRule(Rule):
             for sub in ast.walk(node):
                 if not isinstance(sub, ast.Call) or len(sub.args) < 2:
                     continue
-                if dotted(sub.func).split(".")[-1] not in ("bump", "_b"):
+                if dotted(sub.func).split(".")[-1] not in (
+                        "bump", "_b", "observe", "_observe"):
                     continue
                 if not isinstance(sub.args[0], ast.Name):
                     continue
@@ -173,18 +211,23 @@ class CounterRule(Rule):
                 continue
             d = dotted(node.func)
             base = d.split(".")[-1] if d else ""
-            # two-arg form: bump(DICT, "key")
-            if base in _BUMP_FNS and len(node.args) >= 2 \
+            # two-arg form: bump(DICT, "key") / observe(DICT, "key", v)
+            if base in (_BUMP_FNS | _OBSERVE_FNS) \
+                    and len(node.args) >= 2 \
                     and isinstance(node.args[0], ast.Name):
                 dname = node.args[0].id
                 key = const_str(node.args[1])
                 keys = info.counter_keys.get(dname)
                 if keys is not None and key is not None \
                         and key not in keys:
+                    hist = dname in info.hist_dicts
                     out.append(Violation(
-                        ctx.path, node.lineno, "R602",
-                        f"metric {key!r} is not declared in {dname} — "
-                        "typo'd counter names mint unwatched metrics"))
+                        ctx.path, node.lineno,
+                        "R605" if hist else "R602",
+                        (f"{'label' if hist else 'metric'} {key!r} is "
+                         f"not declared in {dname} — typo'd "
+                         f"{'histogram labels' if hist else 'counter names'}"
+                         " mint unwatched metrics")))
             # one-arg wrapper in the same module: bump("key")
             elif base in info.wrappers and node.args:
                 key = const_str(node.args[0])
@@ -193,15 +236,16 @@ class CounterRule(Rule):
                 dname, sfx = info.wrappers[base]
                 if key + sfx not in info.counter_keys[dname]:
                     out.append(Violation(
-                        ctx.path, node.lineno, "R602",
+                        ctx.path, node.lineno,
+                        "R605" if dname in info.hist_dicts else "R602",
                         f"metric {key + sfx!r} is not declared in "
                         f"{dname}"))
             # cross-module: alias.bump("key") — resolved in finish()
             elif "." in d and node.args:
                 alias, fnname = d.rsplit(".", 1)
                 key = const_str(node.args[0])
-                if fnname in _BUMP_FNS and key is not None \
-                        and "." not in alias:
+                if fnname in (_BUMP_FNS | _OBSERVE_FNS) \
+                        and key is not None and "." not in alias:
                     mod = info.mod_aliases.get(alias, alias)
                     info.pending.append(
                         (ctx.path, node.lineno, mod, fnname, key))
@@ -220,7 +264,9 @@ class CounterRule(Rule):
                 dname, sfx = wrap
                 if key + sfx not in target.counter_keys.get(dname, ()):
                     out.append(Violation(
-                        path, line, "R602",
+                        path, line,
+                        "R605" if dname in target.hist_dicts
+                        else "R602",
                         f"metric {key + sfx!r} is not declared in "
                         f"{mod}.{dname}"))
         return out

@@ -49,13 +49,16 @@ DEVICE_STATS: dict = register_counters("device", {
 })
 
 # cumulative wall time per executor phase (ns), across ALL queries —
-# the span tree only exists under EXPLAIN ANALYZE, but capacity
-# planning needs the steady-state split (reader_scan vs device_agg vs
-# device_pull vs grid_fold vs finalize). With the streaming pipeline
-# the phases OVERLAP, so their sum exceeding wall clock is the design
-# working, not double counting.
+# span trees exist per sampled query (utils/tracing flight recorder),
+# but capacity planning needs the steady-state split (reader_scan vs
+# device_agg vs device_pull vs grid_fold vs finalize). With the
+# streaming pipeline the phases OVERLAP, so their sum exceeding wall
+# clock is the design working, not double counting — sampled query
+# spans carry an explicit overlap_ns marker (tracing.annotate_overlap).
 QUERY_PHASE_NS: dict = register_counters("query_phase", {
     "reader_scan_ns": 0,
+    # block-path dispatch window inside the scan (stack/upload/launch)
+    "block_dispatch_ns": 0,
     "device_agg_ns": 0,
     "device_pull_ns": 0,
     # finalize epilogue: the on-device answer-plane conversion launches
@@ -68,7 +71,36 @@ QUERY_PHASE_NS: dict = register_counters("query_phase", {
     "merge_ns": 0,
     "finalize_ns": 0,
     "serialize_ns": 0,
+    # scheduler admission wait (http layer, before the executor runs)
+    "sched_queue_ns": 0,
     "queries": 0,
+})
+
+# Stable phase names: the contract between the phases_ms aggregation
+# and the span tree — a span measuring one of these phases MUST use
+# the same name (tests/test_tracing.py::test_phase_span_drift).
+PHASE_NAMES = frozenset(k[:-3] for k in QUERY_PHASE_NS
+                        if k.endswith("_ns"))
+
+# latency/size distributions of the device plane (flight-recorder
+# tentpole): p50/p99 per phase and bytes-per-pull percentiles — the
+# monotonic counters above cannot answer "what does a bad pull look
+# like". Exported as Prometheus histograms via /metrics and summarized
+# in /debug/vars (utils.stats.histogram_summaries).
+from ..utils.stats import Histogram, exp_bounds  # noqa: E402
+from ..utils.stats import observe as _observe  # noqa: E402
+from ..utils.stats import register_histograms  # noqa: E402
+
+DEVICE_HIST: dict = register_histograms("device", {
+    # bytes per device_get_parallel call (one batched D2H)
+    "d2h_pull_bytes": Histogram(exp_bounds(1024, 1 << 32)),
+    # wall per pull call, ms
+    "d2h_pull_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+})
+
+PHASE_HIST: dict = register_histograms("query_phase", {
+    name + "_ms": Histogram(exp_bounds(0.25, 1 << 20))
+    for name in sorted(PHASE_NAMES)
 })
 
 
@@ -88,6 +120,13 @@ def gauge(key: str, v: int) -> None:
 def bump_phase(name: str, ns: int) -> None:
     from ..utils.stats import bump as _b
     _b(QUERY_PHASE_NS, name + "_ns", int(ns))
+    _observe(PHASE_HIST, name + "_ms", int(ns) / 1e6)
+
+
+def observe_pull(nbytes: int, ns: int) -> None:
+    """Per-call D2H distribution (device_get_parallel)."""
+    _observe(DEVICE_HIST, "d2h_pull_bytes", int(nbytes))
+    _observe(DEVICE_HIST, "d2h_pull_ms", int(ns) / 1e6)
 
 
 def count_query() -> None:

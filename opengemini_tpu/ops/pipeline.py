@@ -133,6 +133,10 @@ def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
     _ds.bump("d2h_bytes", total_b)
     _ds.bump("d2h_pulls", len(jobs))
     _ds.bump("d2h_wait_ns", _now_ns() - _t_pull0)
+    if n_dev:
+        # per-call distribution (flight-recorder histograms): bytes and
+        # wall of ONE batched pull — the p99 the tunnel link lives by
+        _ds.observe_pull(total_b, _now_ns() - _t_pull0)
     if stats is not None:
         stats["bytes"] = stats.get("bytes", 0) + total_b
         stats["leaves"] = stats.get("leaves", 0) + n_dev
@@ -178,10 +182,16 @@ class StreamingPipeline:
     the shared gate caps the sum across concurrent queries (without it
     N queries × depth launches could all be in flight at once)."""
 
-    def __init__(self, depth: int | None = None, gate=None):
+    def __init__(self, depth: int | None = None, gate=None, span=None):
         self.depth = depth if depth is not None else pipeline_depth()
         self._sem = threading.BoundedSemaphore(max(1, self.depth))
         self.gate = gate
+        # sampled-query tracing (utils/tracing): each launch's pull +
+        # host fold gets a span on its puller thread's lane, so the
+        # Chrome timeline export shows the launch/pull/unpack overlap
+        # that phase sums can only hint at. None (sampled out) costs
+        # nothing on the hot path.
+        self.span = span
         self._futs: dict = {}
         self._lock = RankedLock("pipeline", RANK_PIPELINE)
         self.launches = 0
@@ -225,9 +235,27 @@ class StreamingPipeline:
                 jax.block_until_ready(tree)
             except Exception:
                 pass
+            pull_sp = None
+            if self.span is not None:
+                pull_sp = self.span.child("pipeline.pull")
+                pull_sp.start_ns = t0
+                pull_sp.add(lane=threading.current_thread().name)
             st: dict = {}
             host = device_get_parallel(tree, stats=st)
+            if pull_sp is not None:
+                pull_sp.end_ns = _now_ns()
+                pull_sp.add(bytes=st.get("bytes", 0),
+                            **({"transport": transport}
+                               if transport else {}))
+                unpack_sp = None
+                if post is not None:
+                    unpack_sp = self.span.child("pipeline.unpack")
+                    unpack_sp.start_ns = _now_ns()
+                    unpack_sp.add(
+                        lane=threading.current_thread().name)
             out = post(host) if post is not None else host
+            if pull_sp is not None and post is not None:
+                unpack_sp.end_ns = _now_ns()
             t1 = _now_ns()
             with self._lock:
                 if self.first_ns is None or t0 < self.first_ns:

@@ -1309,12 +1309,15 @@ class QueryExecutor:
         executorBuilder.Analyze + lib/tracing tree rendering)."""
         sel = stmt.select
         if stmt.analyze:
-            from ..utils.tracing import new_trace
+            from ..utils.tracing import annotate_overlap, new_trace
             root = new_trace("query")
             with root:
                 res = self._select(sel, sel.from_db or db, span=root)
             if "error" in res:
                 return res
+            # phase spans overlap under the streaming pipeline:
+            # overlap_ns makes phase-sum > span self-describing
+            annotate_overlap(root)
             lines = root.render()
             return _series("EXPLAIN ANALYZE", ["EXPLAIN ANALYZE"],
                            [[ln] for ln in lines])
@@ -1502,7 +1505,7 @@ class QueryExecutor:
         # OG_PIPELINE_DEPTH bounds in-flight launches, 0 restores the
         # single-barrier path (bit-identical either way — enforced by
         # scripts/perf_smoke.sh)
-        pipe = _pl.StreamingPipeline(gate=_sched_gate()) \
+        pipe = _pl.StreamingPipeline(gate=_sched_gate(), span=span) \
             if _pl.pipeline_depth() > 0 else None
         n_stream = 0          # streamed packed-grid launches
         n_lat_stream = 0      # streamed lattice launches (fold in post)
@@ -1827,8 +1830,9 @@ class QueryExecutor:
                     import jax as _jax
                     blk_sp = span.child("block_dispatch") \
                         if span is not None else None
+                    _t_blk0 = _now_ns()
                     if blk_sp is not None:
-                        blk_sp.start_ns = _now_ns()
+                        blk_sp.start_ns = _t_blk0
                     # ONE H2D for the query scalars; gid vectors are
                     # content-keyed in the device cache, so identical
                     # layouts across fields/files (and warm repeats)
@@ -2126,6 +2130,8 @@ class QueryExecutor:
                     block_rows_total = sum(
                         sl.n_rows for _r, stacks, _g, _s in jobs
                         for sls in stacks.values() for sl in sls)
+                    _dstat.bump_phase("block_dispatch",
+                                      _now_ns() - _t_blk0)
                     if blk_sp is not None:
                         blk_sp.end_ns = _now_ns()
                         blk_sp.add(files=len(jobs),
@@ -2758,6 +2764,14 @@ class QueryExecutor:
                               else 0),
                     pipeline_depth=(pipe.depth if pipe is not None
                                     else 0))
+                if pipe is not None and pipe.bytes_by:
+                    # per-transport D2H split (packed/legacy/
+                    # finalized/lattice/dense) as span fields — the
+                    # byte annotations the Chrome timeline lanes
+                    # carry. collect() already joined the workers, so
+                    # the dict is quiescent here
+                    pull_sp.add(**{f"bytes_{t}": int(b) for t, b
+                                   in dict(pipe.bytes_by).items()})
             # packed plane arrays → host bo dicts (exact: counts/limbs
             # are integer-valued f64 far below 2^53)
             from ..ops import blockagg as _bagg

@@ -179,6 +179,19 @@ def _bump(key: str, n: int = 1) -> None:
     _b(SCHED_STATS, key, n)
 
 
+# queue-wait distribution (flight-recorder tentpole): the cumulative
+# queue_wait_ms counter cannot answer "what does admission feel like
+# at p99" — the histogram can, and /metrics exports it in Prometheus
+# histogram form next to the counters
+from ..utils.stats import Histogram, exp_bounds  # noqa: E402
+from ..utils.stats import observe as _observe  # noqa: E402
+from ..utils.stats import register_histograms  # noqa: E402
+
+SCHED_HIST: dict = register_histograms("scheduler", {
+    "queue_wait_ms": Histogram(exp_bounds(0.25, 1 << 20)),
+})
+
+
 class _Entry:
     __slots__ = ("vft", "seq", "cost", "ctx", "event", "granted",
                  "cancelled", "enq_ns")
@@ -317,6 +330,7 @@ class QueryScheduler:
                 _bump("admitted")
                 if ctx is not None and hasattr(ctx, "mark_running"):
                     ctx.mark_running(0)
+                _observe(SCHED_HIST, "queue_wait_ms", 0.0)
                 return _Ticket(self, cost)
             if len(self._heap) >= self.max_queued:
                 _bump("shed")
@@ -339,6 +353,7 @@ class QueryScheduler:
             if ent.event.wait(0.05):
                 wait_ns = time.perf_counter_ns() - ent.enq_ns
                 _bump("queue_wait_ms", wait_ns // 1_000_000)
+                _observe(SCHED_HIST, "queue_wait_ms", wait_ns / 1e6)
                 if ent.ctx is not None and hasattr(ent.ctx,
                                                    "mark_running"):
                     ent.ctx.mark_running(wait_ns)

@@ -74,6 +74,9 @@ class HTTPConfig:
     auth_enabled: bool = False
     flux_enabled: bool = True             # reference: flux-enabled
     max_body_size: int = 100 * 1024 * 1024
+    # slow-query threshold: queries over this wall are logged, kept in
+    # /debug/vars slow_log and retained in the flight recorder's slow
+    # ring (http/server._slow_threshold_ns; OG_SLOW_QUERY_MS overrides)
     slow_query_threshold_ns: int = 10 * NS
     flight_address: str = ""              # arrow-flight-style ingest
 

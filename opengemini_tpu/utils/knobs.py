@@ -282,6 +282,22 @@ register("OG_SCHED_MAX_CELLS", str, "",
 register("OG_SCHED_DEPTH", int, 8,
          "global in-flight streamed-launch bound across all queries")
 
+# --- flight recorder / tracing (utils/tracing.py, http/server.py)
+register("OG_TRACE_SAMPLE", float, 0.05,
+         "head-sampling probability for the query/write flight "
+         "recorder (1 = trace everything, 0 = off; slow/failed/shed/"
+         "killed requests are retained in the slow ring regardless)")
+register("OG_TRACE_RING", int, 64,
+         "completed traces kept in the flight-recorder recent ring "
+         "(/debug/requests, /debug/trace?id=)", scope="module-init")
+register("OG_SMOKE_TRACE_OVERHEAD_PCT", float, 3.0,
+         "perf_smoke tracing gate: max e2e overhead (percent) of a "
+         "live span tree vs untraced on the 1h shape")
+register("OG_SLOW_QUERY_MS", float, 0.0,
+         "slow-query threshold in ms (logged + kept in the slow "
+         "trace ring); 0 = use [http] slow_query_threshold from "
+         "the config (default 10s)")
+
 # --- HTTP result path (http/serializer.py)
 register("OG_STREAM_JSON", bool, True,
          "chunked streaming JSON/CSV responses (byte-identical to "

@@ -144,11 +144,17 @@ COUNTER_REGISTRY: dict[str, dict] = {}
 
 def register_counters(name: str, counters: dict) -> dict:
     """Register one subsystem's counter dict under the shared metric
-    registry (idempotent per name; re-registration must pass the same
-    dict — a second dict would fork the metric namespace)."""
+    registry (idempotent per name). A re-registration with the SAME
+    declared keys adopts and returns the existing dict — that is a
+    module loaded twice (``python -m`` runs it as __main__ while the
+    package import loads it again) and both copies must share one set
+    of live counters. Different keys mean a genuine namespace fork:
+    loud error."""
     old = COUNTER_REGISTRY.get(name)
     if old is not None and old is not counters:
-        raise ValueError(f"counter registry {name!r} already bound")
+        if set(old) != set(counters):
+            raise ValueError(f"counter registry {name!r} already bound")
+        return old
     COUNTER_REGISTRY[name] = counters
     return counters
 
@@ -159,6 +165,177 @@ def bump(counters: dict, key: str, n: int = 1) -> None:
     threaded HTTP/RPC servers."""
     with COUNTER_LOCK:
         counters[key] = counters.get(key, 0) + n
+
+
+# ------------------------------------------------------- histograms
+
+def exp_bounds(lo: float, hi: float, factor: float = 2.0) -> tuple:
+    """Fixed exponential bucket bounds lo, lo*f, ... up to >= hi."""
+    out = [float(lo)]
+    while out[-1] < hi:
+        out.append(out[-1] * factor)
+    return tuple(out)
+
+
+class Histogram:
+    """Fixed exponential-bucket latency/size histogram.
+
+    Lock-striped: observe() picks a stripe by thread id, so the hot
+    HTTP/pull threads never contend on one lock (the COUNTER_LOCK
+    pattern is right for rare bumps, wrong for per-request observes);
+    snapshot() merges the stripes under all stripe locks. Counts are
+    cumulative like Prometheus buckets are NOT — snapshot() returns
+    per-bucket counts and the exporter accumulates the `le` form.
+    """
+
+    N_STRIPES = 8
+    __slots__ = ("bounds", "_stripes")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(self.bounds) or not self.bounds:
+            raise ValueError("histogram bounds must ascend")
+        nb = len(self.bounds) + 1                 # + overflow bucket
+        self._stripes = [
+            {"lock": threading.Lock(), "counts": [0] * nb,
+             "sum": 0.0, "count": 0}
+            for _ in range(self.N_STRIPES)]
+
+    def _bucket(self, v: float) -> int:
+        from bisect import bisect_left
+        return bisect_left(self.bounds, v)
+
+    def observe(self, v) -> None:
+        v = float(v)
+        i = self._bucket(v)
+        # get_ident() on Linux is a pthread struct address, 64-byte
+        # aligned — the low bits are ALWAYS zero, so a plain modulo
+        # maps every thread to stripe 0 and the striping is theater.
+        # Shift the alignment bits off first.
+        st = self._stripes[(threading.get_ident() >> 6)
+                           % self.N_STRIPES]
+        with st["lock"]:
+            st["counts"][i] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    def snapshot(self) -> dict:
+        nb = len(self.bounds) + 1
+        counts = [0] * nb
+        total = 0
+        vsum = 0.0
+        for st in self._stripes:
+            with st["lock"]:
+                for i in range(nb):
+                    counts[i] += st["counts"][i]
+                total += st["count"]
+                vsum += st["sum"]
+        return {"counts": counts, "count": total, "sum": vsum}
+
+    def quantile(self, q: float, snap: dict | None = None) -> float:
+        """Bucket-interpolated quantile (0..1); 0.0 when empty. The
+        overflow bucket reports its lower bound (no upper edge)."""
+        s = snap or self.snapshot()
+        if s["count"] == 0:
+            return 0.0
+        target = q * s["count"]
+        seen = 0
+        for i, c in enumerate(s["counts"]):
+            if seen + c >= target and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else lo
+                frac = (target - seen) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.bounds[-1]
+
+    def reset(self) -> None:
+        for st in self._stripes:
+            with st["lock"]:
+                st["counts"] = [0] * (len(self.bounds) + 1)
+                st["sum"] = 0.0
+                st["count"] = 0
+
+
+# Registry of every shared histogram dict, parallel to
+# COUNTER_REGISTRY (oglint R6: an observe() against an unregistered
+# dict or an undeclared metric key fails lint). Modules register at
+# import:
+#     MY_HIST = register_histograms("subsystem", {"latency_ms": ...})
+HISTOGRAM_REGISTRY: dict[str, dict] = {}
+
+
+def register_histograms(name: str, histos: dict) -> dict:
+    """Register one subsystem's histogram dict (idempotent per name).
+    Same-keyed re-registration adopts the existing dict (a module
+    double-loaded as __main__ + package import must observe into ONE
+    set of live histograms); different keys are a namespace fork and
+    raise."""
+    old = HISTOGRAM_REGISTRY.get(name)
+    if old is not None and old is not histos:
+        if set(old) != set(histos):
+            raise ValueError(f"histogram registry {name!r} "
+                             "already bound")
+        return old
+    HISTOGRAM_REGISTRY[name] = histos
+    return histos
+
+
+def observe(histos: dict, key: str, v) -> None:
+    """Record one observation into a registered histogram dict —
+    KeyError on an undeclared metric name (the runtime twin of oglint
+    R605: a typo'd key must fail loudly, not mint a hidden series)."""
+    histos[key].observe(v)
+
+
+def histograms_prometheus(prefix: str = "opengemini") -> list[str]:
+    """Prometheus histogram text exposition of every registered
+    histogram: `_bucket{le=...}` (cumulative), `_sum`, `_count`."""
+    lines: list[str] = []
+    for grp in sorted(HISTOGRAM_REGISTRY):
+        for key in sorted(HISTOGRAM_REGISTRY[grp]):
+            h = HISTOGRAM_REGISTRY[grp][key]
+            s = h.snapshot()
+            name = f"{prefix}_{grp}_{key}"
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for b, c in zip(h.bounds, s["counts"]):
+                cum += c
+                le = f"{b:g}"
+                lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {s["count"]}')
+            lines.append(f'{name}_sum {s["sum"]:g}')
+            lines.append(f'{name}_count {s["count"]}')
+    return lines
+
+
+def histogram_summaries() -> dict:
+    """p50/p95/p99 + count per registered histogram, for /debug/vars
+    and the stats pusher (quantiles are bucket-interpolated — good
+    enough for SLO dashboards, cheap enough for a 10s pusher loop)."""
+    out: dict[str, dict] = {}
+    for grp, histos in HISTOGRAM_REGISTRY.items():
+        g: dict = {}
+        for key, h in histos.items():
+            s = h.snapshot()
+            g[f"{key}_count"] = s["count"]
+            if s["count"]:
+                g[f"{key}_p50"] = round(h.quantile(0.50, s), 3)
+                g[f"{key}_p95"] = round(h.quantile(0.95, s), 3)
+                g[f"{key}_p99"] = round(h.quantile(0.99, s), 3)
+        if g:
+            out[grp] = g
+    return out
+
+
+def latency_collector():
+    """utils.stats collector: flattened histogram summaries (the
+    line-protocol writer drops nested dicts)."""
+    out = {}
+    for grp, g in histogram_summaries().items():
+        for k, v in g.items():
+            out[f"{grp}_{k}"] = v
+    return out
 
 
 def runtime_collector():

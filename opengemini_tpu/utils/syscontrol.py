@@ -18,6 +18,12 @@ Commands (query params: ?mod=<cmd>[&switchon=true|false]):
                      counters; &action=pause|resume|drain[&timeout=S]
                      (pause stops granting slots — running queries
                      finish; drain waits until in-flight work ends)
+    profile        — one-shot jax.profiler device capture:
+                     &action=start[&dir=/path] opens a trace,
+                     &action=stop closes it (the deep-dive companion
+                     of the always-on flight recorder: sampled traces
+                     show WHICH pull was slow, the profiler shows why
+                     at the device level)
 """
 
 from __future__ import annotations
@@ -38,6 +44,7 @@ class SysControl:
         self.readonly = False
         self.compaction_enabled = True
         self.verbose = False
+        self.profile_dir: str | None = None   # live jax.profiler dir
 
     def _flag(self, params: dict) -> bool:
         v = str(params.get("switchon", "true")).lower()
@@ -117,6 +124,44 @@ class SysControl:
                                  f"unknown scheduler action {action!r}"}
                 out["scheduler"] = sch.snapshot()
                 return 200, out
+            if mod == "profile":
+                # one-shot device-level capture (jax.profiler): the
+                # flight recorder's deep-dive hook. start/stop are
+                # idempotent-checked so a crashed client can't wedge
+                # the profiler in a half-open state silently
+                action = params.get("action", "start")
+                if action == "start":
+                    if self.profile_dir is not None:
+                        return 400, {"error": "profiler already "
+                                     "capturing to "
+                                     f"{self.profile_dir!r}; stop it "
+                                     "first"}
+                    pdir = params.get("dir") or "/tmp/og_profile"
+                    try:
+                        import jax
+                        jax.profiler.start_trace(pdir)
+                    except Exception as e:
+                        return 400, {"error":
+                                     f"profiler start failed: {e}"}
+                    self.profile_dir = pdir
+                    return 200, {"profile": "started", "dir": pdir}
+                if action == "stop":
+                    if self.profile_dir is None:
+                        return 400, {"error": "no capture in flight"}
+                    pdir, self.profile_dir = self.profile_dir, None
+                    try:
+                        import jax
+                        jax.profiler.stop_trace()
+                    except Exception as e:
+                        return 400, {"error":
+                                     f"profiler stop failed: {e}"}
+                    return 200, {"profile": "stopped", "dir": pdir}
+                if action == "stat":
+                    return 200, {"capturing": self.profile_dir
+                                 is not None,
+                                 "dir": self.profile_dir}
+                return 400, {"error":
+                             f"unknown profile action {action!r}"}
             if mod == "failpoint":
                 # arm/disarm fault-injection points (reference failpoint
                 # toggles over the syscontrol admin plane, SURVEY.md §5)

@@ -1,19 +1,60 @@
 """Query tracing (role of reference lib/tracing: trace.go Span tree,
 tree.go rendering; spans threaded through cursors/transforms e.g.
-engine/aggregate_cursor.go:51,91-97 and select handler
+engine/aggregate_cursor.go:51,91-97 and the store select handler
 app/ts-store/transport/handler/select.go:279).
 
 A Trace is a tree of Spans with ns timestamps and free-form fields.
-EXPLAIN ANALYZE attaches one to the executor; kernels/stages wrap their
-work in `with span.child("..."):`. Rendering matches the reference's
-tree output shape (indented names with durations + fields).
+Through PR 6 the only consumer was EXPLAIN ANALYZE; this module is now
+the always-on **flight recorder**:
+
+- **Head sampling** (``OG_TRACE_SAMPLE``): every HTTP query/write rolls
+  a deterministic 1-in-N sample at arrival. Sampled requests carry a
+  full span tree through the executor, the streaming pipeline and the
+  scheduler; sampled-out requests allocate NO span objects (the hot
+  path sees ``span is None``, exactly the pre-PR-7 behavior).
+- **Trace context propagation**: ``bind()`` parks the active span +
+  trace id in a thread-local; ``cluster/transport.py`` ships the
+  context on RPC frames (header key ``tc``) and returns the store-side
+  span tree on the final frame (header key ``tspan``), so a sql→store
+  scatter merges into ONE tree under the HTTP root span.
+- **Flight recorder rings**: the last N completed traces
+  (``OG_TRACE_RING``) plus an always-kept slow/error ring (slow,
+  failed, shed and killed queries are retained even when their sample
+  roll missed — they get a span-less record). Exposed at
+  ``/debug/requests`` and ``/debug/trace?id=`` (http/server.py).
+- **Chrome trace-event export**: ``chrome_events()`` lays the span
+  tree on a per-lane timeline (HTTP/scheduler lane, executor lane, one
+  lane per pipeline pull worker) loadable in Perfetto / chrome://tracing.
+
+Span names that measure an executor phase use the SAME stable names as
+the ``phases_ms`` aggregation (ops/devstats.QUERY_PHASE_NS); every
+other emitted name must be declared in STRUCTURAL_SPANS — the tier-1
+phase-drift test (tests/test_tracing.py) enforces both.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+import uuid
+from collections import deque
 from dataclasses import dataclass, field
+
+from . import knobs
+
+# Span names that are NOT phases: structure of the request (roots,
+# per-statement containers, RPC hops, pipeline lanes). Everything an
+# executor/pipeline/scheduler trace emits is either one of these, a
+# prefix-match ("rpc:", "store:"), or a phase name shared with
+# ops/devstats.QUERY_PHASE_NS — tests/test_tracing.py fails on drift.
+STRUCTURAL_SPANS = {"query", "write", "statement", "scatter",
+                    "pipeline.pull", "pipeline.unpack"}
+STRUCTURAL_PREFIXES = ("rpc:", "store:")
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
 
 
 @dataclass
@@ -37,6 +78,12 @@ class Span:
             self.fields.update(kv)
         return self
 
+    def attach(self, child: "Span") -> "Span":
+        """Graft an already-built span (a deserialized remote tree)."""
+        with self._lock:
+            self.children.append(child)
+        return child
+
     def __enter__(self) -> "Span":
         self.start_ns = time.perf_counter_ns()
         return self
@@ -47,6 +94,11 @@ class Span:
     @property
     def duration_ns(self) -> int:
         return max(0, self.end_ns - self.start_ns)
+
+    def walk(self):
+        yield self
+        for c in list(self.children):
+            yield from c.walk()
 
     def render(self, indent: int = 0) -> list[str]:
         pad = "  " * indent
@@ -61,8 +113,302 @@ class Span:
             out.extend(c.render(indent + 1))
         return out
 
+    # ------------------------------------------------- serialization
+
+    def to_dict(self) -> dict:
+        """JSON-safe tree (RPC ``tspan`` header, /debug/trace JSON).
+        Non-scalar field values degrade to str — the tree must always
+        survive json.dumps."""
+        fields = {}
+        for k, v in self.fields.items():
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                fields[k] = v
+            else:
+                fields[k] = str(v)
+        return {"name": self.name, "start_ns": int(self.start_ns),
+                "end_ns": int(self.end_ns), "fields": fields,
+                "children": [c.to_dict() for c in self.children]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        s = cls(str(d.get("name", "?")),
+                start_ns=int(d.get("start_ns", 0)),
+                end_ns=int(d.get("end_ns", 0)),
+                fields=dict(d.get("fields") or {}))
+        s.children = [cls.from_dict(c) for c in d.get("children", ())]
+        return s
+
 
 def new_trace(name: str) -> Span:
     s = Span(name)
     s.start_ns = time.perf_counter_ns()
     return s
+
+
+def rebase_into(root: Span, lo_ns: int, hi_ns: int) -> Span:
+    """Shift a deserialized REMOTE span tree into the local clock
+    window [lo_ns, hi_ns] (the client-side RPC span). Span timestamps
+    are perf_counter_ns, whose base is per-process/per-host — a tree
+    from another machine lands at a garbage offset in the merged view.
+    A tree already inside the window (same-process transport, tests)
+    is left untouched so real same-clock timing survives; otherwise
+    the whole tree shifts rigidly (durations and relative offsets are
+    clock-rate-true either way) to sit centered in the RPC window and
+    the root is marked ``clock_rebased`` so the view is honest."""
+    if lo_ns <= root.start_ns and root.end_ns <= hi_ns:
+        return root
+    slack = max(0, (hi_ns - lo_ns) - root.duration_ns)
+    shift = (lo_ns + slack // 2) - root.start_ns
+    for s in root.walk():
+        if s.start_ns:
+            s.start_ns += shift
+        if s.end_ns:
+            s.end_ns += shift
+    root.add(clock_rebased=True)
+    return root
+
+
+def annotate_overlap(root: Span, phase_names=None) -> int:
+    """Record ``phase_sum_ns``/``overlap_ns`` on a finished root span:
+    with the streaming pipeline the phase spans OVERLAP, so their sum
+    exceeding the root is the design working — the explicit marker
+    makes phase-sum > span self-describing (BENCH_r05 showed
+    device_agg 671ms next to device_pull 647ms with no marker)."""
+    if phase_names is None:
+        from ..ops.devstats import PHASE_NAMES
+        phase_names = PHASE_NAMES
+    phase_sum = sum(s.duration_ns for s in root.walk()
+                    if s is not root and s.name in phase_names)
+    overlap = max(0, phase_sum - root.duration_ns)
+    root.add(phase_sum_ns=int(phase_sum), overlap_ns=int(overlap))
+    return overlap
+
+
+# ------------------------------------------------- thread-local context
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_CTX = _Ctx()
+
+
+class bind:
+    """Bind (span, trace_id) as the thread's active trace context —
+    transport.call_stream ships it on RPC frames, the streaming
+    pipeline and scatter workers re-bind it on their own threads."""
+
+    def __init__(self, span: Span | None, trace_id: str | None = None):
+        self.span = span
+        self.trace_id = trace_id
+
+    def __enter__(self):
+        _CTX.stack.append((self.span, self.trace_id))
+        return self.span
+
+    def __exit__(self, *exc):
+        _CTX.stack.pop()
+
+
+def current_span() -> Span | None:
+    return _CTX.stack[-1][0] if _CTX.stack else None
+
+
+def current_trace_id() -> str | None:
+    return _CTX.stack[-1][1] if _CTX.stack else None
+
+
+# ----------------------------------------------------------- sampling
+
+_SAMPLE_LOCK = threading.Lock()
+_SAMPLE_ACC = 0.0
+
+
+def should_sample() -> bool:
+    """Deterministic head sample: OG_TRACE_SAMPLE is a probability
+    (>= 1 always, <= 0 never). A fractional accumulator fires exactly
+    rate×N times over any N requests — deterministic (tests and the
+    perf gate are exact) and honest for EVERY rate, where a
+    1-in-round(1/rate) counter silently turned 0.7 into 1.0 and 0.4
+    into 0.5."""
+    rate = float(knobs.get("OG_TRACE_SAMPLE"))
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    global _SAMPLE_ACC
+    with _SAMPLE_LOCK:
+        _SAMPLE_ACC += rate
+        if _SAMPLE_ACC >= 1.0:
+            _SAMPLE_ACC -= 1.0
+            return True
+        return False
+
+
+# ----------------------------------------------------- flight recorder
+
+@dataclass
+class TraceRecord:
+    """One completed request in the recorder. ``root`` is None for a
+    sampled-out request retained only because it was slow/failed
+    (the overhead guarantee: no span tree unless the head sample
+    hit)."""
+    trace_id: str
+    kind: str                      # "query" | "write"
+    text: str                      # redacted statement text
+    db: str
+    start_wall: float              # unix seconds
+    duration_ns: int
+    status: str = "ok"             # ok|error|slow|shed|killed
+    error: str = ""
+    sampled: bool = True
+    root: Span | None = None
+
+    def summary(self) -> dict:
+        txt = self.text
+        if len(txt) > 160:
+            txt = txt[:157] + "..."
+        return {"trace_id": self.trace_id, "kind": self.kind,
+                "query": txt, "db": self.db,
+                "start": self.start_wall,
+                "duration_ms": round(self.duration_ns / 1e6, 3),
+                "status": self.status, "sampled": self.sampled,
+                **({"error": self.error} if self.error else {})}
+
+
+class FlightRecorder:
+    """Bounded rings of completed traces: ``recent`` keeps the last N
+    sampled traces of any status; ``slow`` always keeps slow / error /
+    shed / killed requests (span-less when their sample roll missed),
+    driven by the now-wired slow_query_threshold_ns."""
+
+    def __init__(self, recent_cap: int | None = None,
+                 slow_cap: int = 64):
+        if recent_cap is None:
+            recent_cap = max(1, int(knobs.get("OG_TRACE_RING")))
+        self._lock = threading.Lock()
+        self.recent: deque = deque(maxlen=recent_cap)
+        self.slow: deque = deque(maxlen=slow_cap)
+        self._by_id: dict[str, TraceRecord] = {}
+
+    def record(self, rec: TraceRecord) -> None:
+        with self._lock:
+            if rec.sampled:
+                self._evict(self.recent)
+                self.recent.append(rec)
+                self._by_id[rec.trace_id] = rec
+            if rec.status != "ok":
+                self._evict(self.slow)
+                self.slow.append(rec)
+                self._by_id[rec.trace_id] = rec
+
+    def _evict(self, ring: deque) -> None:
+        """Drop the id-index entry a full ring is about to push out —
+        unless the other ring still holds the record, or the index
+        already points at a NEWER record under the same id (a client
+        can force-reuse a trace id via X-OG-Trace; evicting the old
+        record must not orphan the live one)."""
+        if len(ring) == ring.maxlen:
+            old = ring[0]
+            if self._by_id.get(old.trace_id) is not old:
+                return
+            other = self.slow if ring is self.recent else self.recent
+            if not any(r is old for r in other):
+                self._by_id.pop(old.trace_id, None)
+
+    def get(self, trace_id: str) -> TraceRecord | None:
+        with self._lock:
+            return self._by_id.get(trace_id)
+
+    def summaries(self) -> dict:
+        with self._lock:
+            return {"recent": [r.summary() for r in
+                               reversed(self.recent)],
+                    "slow": [r.summary() for r in reversed(self.slow)],
+                    "recent_cap": self.recent.maxlen,
+                    "slow_cap": self.slow.maxlen}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.recent.clear()
+            self.slow.clear()
+            self._by_id.clear()
+
+
+_RECORDER: FlightRecorder | None = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+# ------------------------------------------------ chrome trace export
+
+def _lane_of(span: Span, parent_lane: str) -> str:
+    lane = span.fields.get("lane")
+    if lane:
+        return str(lane)
+    if span.name in ("query", "write", "statement"):
+        return "http"
+    if span.name == "sched_queue":
+        return "scheduler"
+    if span.name.startswith(STRUCTURAL_PREFIXES) \
+            or span.name == "scatter":
+        return "rpc"
+    if span.name.startswith("pipeline."):
+        return "pipeline"
+    if parent_lane in ("http", "scheduler"):
+        return "executor"
+    return parent_lane
+
+
+def chrome_events(rec: TraceRecord) -> list[dict]:
+    """Chrome trace-event (Perfetto-loadable) view of one trace: spans
+    become complete ("X") events laid out per lane — HTTP/scheduler,
+    executor, RPC hops, and one lane per pipeline pull worker — with
+    span fields (D2H bytes, transport labels) as event args."""
+    if rec.root is None:
+        return []
+    lanes: dict[str, int] = {}
+    events: list[dict] = []
+    t0 = rec.root.start_ns
+
+    def tid_of(lane: str) -> int:
+        if lane not in lanes:
+            lanes[lane] = len(lanes) + 1
+        return lanes[lane]
+
+    def emit(span: Span, parent_lane: str):
+        lane = _lane_of(span, parent_lane)
+        start = span.start_ns or t0
+        end = max(span.end_ns, start)
+        args = {k: v for k, v in span.fields.items()
+                if isinstance(v, (int, float, str, bool))}
+        events.append({"name": span.name, "ph": "X", "pid": 1,
+                       "tid": tid_of(lane),
+                       "ts": (start - t0) / 1e3,
+                       "dur": (end - start) / 1e3,
+                       "cat": rec.kind, "args": args})
+        for c in list(span.children):
+            emit(c, lane)
+
+    emit(rec.root, "http")
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": lane}}
+            for lane, tid in sorted(lanes.items(), key=lambda kv: kv[1])]
+    meta.append({"name": "process_name", "ph": "M", "pid": 1,
+                 "args": {"name": f"trace {rec.trace_id} "
+                                  f"({rec.status})"}})
+    return meta + events
+
+
+def chrome_json(rec: TraceRecord) -> str:
+    return json.dumps({"traceEvents": chrome_events(rec),
+                       "displayTimeUnit": "ms",
+                       "otherData": rec.summary()})

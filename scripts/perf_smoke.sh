@@ -41,7 +41,12 @@ export OG_BENCH_HOURS="${OG_BENCH_HOURS:-1}"
 timeout -k 10 "${OG_SMOKE_TIMEOUT_S:-900}" \
     python bench.py --phase smoke | tee /tmp/og_perf_smoke.json
 
-# the phase line must exist and report a pass
+# the phase line must exist and report a pass. The smoke phase itself
+# already dies on any mismatch, including the tracing gate (PR 7):
+# trace-on/trace-on-barrier configs must produce byte-identical cells
+# on every shape, the Chrome trace export must be loadable with
+# monotonic timestamps, and e2e overhead with a live span tree must
+# stay under OG_SMOKE_TRACE_OVERHEAD_PCT (default 3%).
 python - <<'EOF'
 import json
 last = open("/tmp/og_perf_smoke.json").read().strip().splitlines()[-1]
@@ -49,8 +54,12 @@ r = json.loads(last)
 assert r.get("metric") == "perf_smoke_streaming_equivalence", r
 assert r.get("value") == 1, r
 assert r.get("cells_checked", 0) > 0, r
+assert "trace-on" in r.get("configs", []), r
+assert "trace_overhead_pct" in r, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
+print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
+      f"(on {r['trace_e2e_on_ms']}ms vs off {r['trace_e2e_off_ms']}ms)")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

@@ -1,0 +1,17 @@
+"""R6 histogram failing fixture: unregistered *_HIST dict, typo'd
+observe label (direct and through a module-local wrapper)."""
+from opengemini_tpu.utils.stats import Histogram, exp_bounds, observe
+
+ROGUE_HIST = {"lat_ms": Histogram(exp_bounds(1, 1024))}      # R604
+
+
+def typo_label():
+    observe(ROGUE_HIST, "lat_mz", 1.0)                       # R605
+
+
+def hobserve(key, v):
+    observe(ROGUE_HIST, key, v)
+
+
+def typo_wrapper():
+    hobserve("lat_typo", 3.0)                                # R605

@@ -1,0 +1,20 @@
+"""R6 histogram passing fixture: registered dict, declared labels."""
+from opengemini_tpu.utils.stats import (Histogram, exp_bounds, observe,
+                                        register_histograms)
+
+GOOD_HIST = register_histograms("fixture_hist_good", {
+    "lat_ms": Histogram(exp_bounds(1, 1024)),
+    "bytes": Histogram(exp_bounds(1024, 1 << 30)),
+})
+
+
+def declared_label():
+    observe(GOOD_HIST, "lat_ms", 2.5)
+
+
+def hobserve(key, v):
+    observe(GOOD_HIST, key, v)
+
+
+def declared_wrapper():
+    hobserve("bytes", 4096)

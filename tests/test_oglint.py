@@ -94,6 +94,19 @@ def test_r6_counters_good_fixture():
     assert not {c for c in got if c.startswith("R6")}, got
 
 
+def test_r6_histograms_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/r6_hist_bad.py"])
+    got = {v.code for v in vs}
+    assert {"R604", "R605"} <= got, got
+    # both the direct typo'd observe and the wrapper one are reported
+    assert sum(1 for v in vs if v.code == "R605") == 2, vs
+
+
+def test_r6_histograms_good_fixture():
+    got = codes_for("opengemini_tpu/r6_hist_good.py")
+    assert not {c for c in got if c.startswith("R6")}, got
+
+
 # ------------------------------------------------------- machinery
 
 def test_pragma_suppression(tmp_path):

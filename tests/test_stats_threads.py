@@ -108,9 +108,16 @@ def test_counter_registry_contents():
         assert isinstance(COUNTER_REGISTRY[name], dict)
 
 
-def test_reregistration_same_dict_ok_different_dict_rejected():
+def test_reregistration_adopts_twin_rejects_fork():
+    """Same dict: idempotent. Same-KEYED twin: adopted — that is a
+    module double-loaded as __main__ + package import (e.g. ``python
+    -m opengemini_tpu.http.server``) and both copies must share one
+    set of live counters. Different keys: a namespace fork, loud."""
     import pytest
     d = register_counters("stats_threads_fixture", {"a": 0})
     assert register_counters("stats_threads_fixture", d) is d
+    d["a"] = 7
+    twin = register_counters("stats_threads_fixture", {"a": 0})
+    assert twin is d and twin["a"] == 7      # live counts preserved
     with pytest.raises(ValueError):
-        register_counters("stats_threads_fixture", {"a": 0})
+        register_counters("stats_threads_fixture", {"b": 0})

@@ -1,0 +1,722 @@
+"""Flight recorder (utils/tracing) + latency histograms (utils/stats).
+
+Covers the PR-7 observability spine:
+- phase/span name drift: every span literal emitted by the executor /
+  pipeline / scheduler / transport must be a ``phases_ms`` phase name
+  (ops.devstats.QUERY_PHASE_NS) or a declared structural span.
+- Histogram: exact totals under an N-thread hammer (lock striping),
+  quantiles, Prometheus exposition, registry hygiene.
+- Head sampling determinism; sampled-out queries allocate NO span
+  tree (overhead guard).
+- FlightRecorder ring bounds + id-index eviction, incl. under an
+  N-thread hammer with no cross-query span leakage.
+- Trace context round-trip over a simulated sql→store RPC hop.
+- Chrome trace-event export: valid JSON, non-negative monotonic ts,
+  lane metadata, D2H byte args.
+- HTTP integration: /debug/requests, /debug/trace?id= (+chrome),
+  X-OG-Trace force-sample header, X-OG-Trace-Id response header,
+  slow-query wiring (OG_SLOW_QUERY_MS), histograms on /metrics.
+"""
+
+import ast
+import json
+import os
+import threading
+import time
+import urllib.request
+import urllib.error
+from urllib.parse import quote
+
+import pytest
+
+from opengemini_tpu.ops.devstats import PHASE_NAMES
+from opengemini_tpu.utils import knobs, tracing
+from opengemini_tpu.utils.stats import (Histogram, exp_bounds,
+                                        HISTOGRAM_REGISTRY,
+                                        histograms_prometheus,
+                                        histogram_summaries, observe,
+                                        register_histograms)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "opengemini_tpu")
+
+
+@pytest.fixture
+def knob(request):
+    """Set OG_* knobs for one test, restoring the prior env after."""
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = os.environ.get(name)
+        knobs.set_env(name, value)
+
+    yield set_
+    for name, old in saved.items():
+        if old is None:
+            knobs.del_env(name)
+        else:
+            knobs.set_env(name, old)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    tracing.recorder().reset()
+    yield
+    tracing.recorder().reset()
+
+
+# ------------------------------------------------ span-name drift gate
+
+def _emitted_span_names():
+    """Every string (or f-string prefix) passed to Span()/child()/
+    new_trace() anywhere in the package: (path, lineno, name,
+    is_prefix)."""
+    out = []
+    for dirpath, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:     # pragma: no cover
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = ""
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname not in ("child", "new_trace", "Span"):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    out.append((path, node.lineno, arg.value, False))
+                elif isinstance(arg, ast.JoinedStr) and arg.values \
+                        and isinstance(arg.values[0], ast.Constant):
+                    out.append((path, node.lineno,
+                                str(arg.values[0].value), True))
+    return out
+
+
+def test_phase_span_drift():
+    """The contract behind ``phases_ms``: a span measuring an executor
+    phase must reuse the phase's stable name, and every other emitted
+    span name must be declared structural — so the /debug/trace tree,
+    the Chrome lanes and the cumulative phase split can never name the
+    same work two different ways."""
+    names = _emitted_span_names()
+    assert names, "span-name scan found nothing — scan broken?"
+    legal = PHASE_NAMES | tracing.STRUCTURAL_SPANS
+    bad = []
+    for path, line, name, is_prefix in names:
+        if is_prefix:
+            if not name.startswith(tracing.STRUCTURAL_PREFIXES):
+                bad.append(f"{path}:{line}: f-string span "
+                           f"prefix {name!r}")
+        elif name not in legal:
+            bad.append(f"{path}:{line}: span {name!r} is neither a "
+                       "phases_ms phase nor in STRUCTURAL_SPANS")
+    assert not bad, "\n".join(bad)
+    # and the executor's phase spans genuinely overlap with the
+    # phases_ms keys (the aggregation the README documents)
+    assert {"device_pull", "reader_scan", "sched_queue"} <= PHASE_NAMES
+
+
+def test_structural_spans_all_emitted():
+    """No dead declarations: every STRUCTURAL_SPANS entry is actually
+    emitted somewhere (a stale declaration would quietly weaken the
+    drift gate)."""
+    emitted = {n for _p, _l, n, pre in _emitted_span_names() if not pre}
+    missing = tracing.STRUCTURAL_SPANS - emitted - {"write"}
+    # "write" is the root span name handed to new_trace(kind) by the
+    # HTTP layer via a variable, so the static scan can't see it
+    assert not missing, missing
+
+
+# ------------------------------------------------------------ histogram
+
+def test_histogram_counts_and_quantiles():
+    h = Histogram(exp_bounds(1, 1024))
+    assert h.bounds[0] == 1 and h.bounds[-1] >= 1024
+    for v in (0.5, 1.0, 3.0, 100.0, 1 << 20):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 5
+    assert abs(s["sum"] - (0.5 + 1.0 + 3.0 + 100.0 + (1 << 20))) < 1e-6
+    assert sum(s["counts"]) == 5
+    # overflow bucket caught the 1<<20
+    assert s["counts"][-1] == 1
+    assert 0.0 < h.quantile(0.5) <= 128.0
+    assert h.quantile(0.0, {"counts": [0], "count": 0, "sum": 0}) == 0.0
+
+
+def test_histogram_bad_bounds():
+    with pytest.raises(ValueError):
+        Histogram([])
+    with pytest.raises(ValueError):
+        Histogram([4, 2, 1])
+
+
+def test_histogram_thread_hammer():
+    """Lock striping must lose nothing: N threads × M observes give an
+    exact total in snapshot()."""
+    h = Histogram(exp_bounds(1, 1 << 20))
+    N, M = 8, 2000
+
+    def work(i):
+        for j in range(M):
+            h.observe((i * M + j) % 4096 + 0.5)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = h.snapshot()
+    assert s["count"] == N * M
+    assert sum(s["counts"]) == N * M
+
+
+def test_histogram_registry_and_prometheus():
+    histos = {"lat_ms": Histogram(exp_bounds(1, 64))}
+    try:
+        got = register_histograms("test_tracing_reg", histos)
+        assert got is histos
+        # re-register of the same dict is idempotent; a same-KEYED
+        # twin (module double-loaded as __main__ + package import,
+        # e.g. `python -m opengemini_tpu.http.server`) adopts the
+        # live dict; different keys are a namespace fork and fail
+        register_histograms("test_tracing_reg", histos)
+        twin = {"lat_ms": Histogram(exp_bounds(1, 64))}
+        assert register_histograms("test_tracing_reg", twin) is histos
+        with pytest.raises(ValueError):
+            register_histograms("test_tracing_reg", {})
+        observe(histos, "lat_ms", 3.0)
+        observe(histos, "lat_ms", 300.0)
+        with pytest.raises(KeyError):
+            observe(histos, "lat_mz", 1.0)      # typo'd label: loud
+        lines = histograms_prometheus()
+        name = "opengemini_test_tracing_reg_lat_ms"
+        assert f"# TYPE {name} histogram" in lines
+        buckets = [ln for ln in lines
+                   if ln.startswith(f"{name}_bucket")]
+        # cumulative le buckets, +Inf last and equal to _count
+        assert buckets[-1] == f'{name}_bucket{{le="+Inf"}} 2'
+        cums = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        assert cums == sorted(cums)
+        assert f"{name}_count 2" in lines
+        summ = histogram_summaries()["test_tracing_reg"]
+        assert summ["lat_ms_count"] == 2
+        assert summ["lat_ms_p50"] > 0
+    finally:
+        HISTOGRAM_REGISTRY.pop("test_tracing_reg", None)
+
+
+# ------------------------------------------------------------- sampling
+
+def test_should_sample_edges(knob):
+    knob("OG_TRACE_SAMPLE", 1)
+    assert all(tracing.should_sample() for _ in range(5))
+    knob("OG_TRACE_SAMPLE", 0)
+    assert not any(tracing.should_sample() for _ in range(5))
+    # the fractional accumulator fires exactly rate×N times over any
+    # N rolls, whatever phase the process-global accumulator is in
+    knob("OG_TRACE_SAMPLE", 0.25)
+    hits = sum(tracing.should_sample() for _ in range(400))
+    assert hits == 100
+    # rates above 2/3 must NOT collapse to always-on (the old
+    # 1-in-round(1/rate) counter sampled 100% for any rate > ~0.67)
+    knob("OG_TRACE_SAMPLE", 0.75)
+    hits = sum(tracing.should_sample() for _ in range(400))
+    assert hits == 300
+
+
+# ------------------------------------------------------ flight recorder
+
+def _rec(i, status="ok", sampled=True, root=None):
+    return tracing.TraceRecord(
+        trace_id=f"t{i:08x}", kind="query", text=f"SELECT {i}",
+        db="db0", start_wall=0.0, duration_ns=1000 + i,
+        status=status, sampled=sampled, root=root)
+
+
+def test_recorder_ring_bounds_and_eviction():
+    fr = tracing.FlightRecorder(recent_cap=4, slow_cap=2)
+    for i in range(10):
+        fr.record(_rec(i))
+    s = fr.summaries()
+    assert len(s["recent"]) == 4
+    assert [r["trace_id"] for r in s["recent"]] == \
+        ["t00000009", "t00000008", "t00000007", "t00000006"]
+    # evicted ids are gone from the index, survivors resolvable
+    assert fr.get("t00000001") is None
+    assert fr.get("t00000009") is not None
+    # errors land in the slow ring even when sampled out
+    for i in (90, 91, 92):
+        fr.record(_rec(i, status="error", sampled=False))
+    s = fr.summaries()
+    assert len(s["slow"]) == 2
+    assert len(s["recent"]) == 4      # span-less errors don't displace
+    assert fr.get("t0000005c") is not None        # 92
+    assert fr.get("t0000005a") is None            # 90 evicted
+
+
+def test_recorder_thread_hammer():
+    """N writer threads: ring bounds hold, the id index only holds live
+    ring members, and every surviving record still owns exactly its own
+    span tree (no cross-query leakage)."""
+    fr = tracing.FlightRecorder(recent_cap=16, slow_cap=8)
+    N, M = 8, 200
+
+    def work(w):
+        for i in range(M):
+            root = tracing.new_trace("query")
+            root.child("reader_scan").add(worker=w, i=i)
+            root.end_ns = root.start_ns + 1
+            fr.record(tracing.TraceRecord(
+                trace_id=f"w{w}-{i}", kind="query",
+                text=f"SELECT {w}/{i}", db="db0", start_wall=0.0,
+                duration_ns=1, status="ok" if i % 7 else "error",
+                root=root))
+
+    ts = [threading.Thread(target=work, args=(w,)) for w in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = fr.summaries()
+    assert len(s["recent"]) == 16 and len(s["slow"]) == 8
+    with fr._lock:
+        live = list(fr.recent) + list(fr.slow)
+        assert set(fr._by_id) == {r.trace_id for r in live}
+    for r in live:
+        w, i = r.trace_id[1:].split("-")
+        fields = r.root.children[0].fields
+        assert (fields["worker"], fields["i"]) == (int(w), int(i)), \
+            "span tree leaked across queries"
+
+
+def test_recorder_duplicate_forced_id_survives_eviction():
+    """A client can force-reuse a trace id (X-OG-Trace): evicting the
+    OLDER record under a shared id must not orphan the newer one in
+    the id index."""
+    fr = tracing.FlightRecorder(recent_cap=3, slow_cap=2)
+    old = _rec(1)
+    new = _rec(2)
+    old.trace_id = new.trace_id = "shared01"
+    fr.record(old)
+    fr.record(new)
+    assert fr.get("shared01") is new
+    for i in (10, 11):           # push `old` out of the recent ring
+        fr.record(_rec(i))
+    assert fr.get("shared01") is new, \
+        "evicting the old duplicate orphaned the live record"
+
+
+def test_rebase_into():
+    """A remote tree with an alien perf_counter base shifts rigidly
+    into the local RPC window; a same-clock tree is left untouched."""
+    lo, hi = 1_000_000, 2_000_000
+    # alien base: started "before" the local epoch entirely
+    remote = tracing.Span("store:select", start_ns=50, end_ns=450)
+    c = remote.child("reader_scan")
+    c.start_ns, c.end_ns = 100, 300
+    out = tracing.rebase_into(remote, lo, hi)
+    assert lo <= out.start_ns and out.end_ns <= hi
+    assert out.duration_ns == 400                 # durations rigid
+    assert out.children[0].start_ns - out.start_ns == 50
+    assert out.fields["clock_rebased"] is True
+    # same-clock tree already inside the window: untouched
+    local = tracing.Span("store:select", start_ns=lo + 10,
+                         end_ns=lo + 20)
+    assert tracing.rebase_into(local, lo, hi) is local
+    assert local.start_ns == lo + 10
+    assert "clock_rebased" not in local.fields
+
+
+def test_transport_traced_streaming_handler():
+    """A traced streaming RPC still streams (no full-drain buffering)
+    and the store tree — including spans created mid-stream — grafts
+    on the final frame."""
+    from opengemini_tpu.cluster.transport import RPCClient, RPCServer
+
+    def handler(body):
+        sp = tracing.current_span()
+        for i in range(3):
+            c = sp.child("reader_scan")
+            c.start_ns = time.perf_counter_ns()
+            c.add(i=i)
+            c.end_ns = time.perf_counter_ns()
+            yield {"i": i}
+
+    srv = RPCServer(handlers={"scan": handler})
+    srv.start()
+    cli = RPCClient(srv.addr)
+    try:
+        root = tracing.new_trace("query")
+        with tracing.bind(root, "feedbeef"):
+            frames = list(cli.call_stream("scan", {}))
+        assert [f["i"] for f in frames] == [0, 1, 2]
+        (rpc_sp,) = root.children
+        (store_sp,) = rpc_sp.children
+        assert [c.fields["i"] for c in store_sp.children] == [0, 1, 2]
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_overlap_annotation():
+    root = tracing.new_trace("query")
+    t0 = root.start_ns
+    for name, a, b in (("device_agg", 0, 80), ("device_pull", 10, 90)):
+        c = root.child(name)
+        c.start_ns, c.end_ns = t0 + a, t0 + b
+    root.end_ns = t0 + 100
+    overlap = tracing.annotate_overlap(root)
+    assert root.fields["phase_sum_ns"] == 160
+    assert overlap == 60 and root.fields["overlap_ns"] == 60
+
+
+def test_span_serialization_roundtrip():
+    root = tracing.new_trace("query")
+    c = root.child("reader_scan")
+    c.add(files=3, note={"not": "scalar"})
+    c.start_ns, c.end_ns = 1, 2
+    root.end_ns = root.start_ns + 10
+    d = root.to_dict()
+    json.dumps(d)                        # must always be JSON-safe
+    back = tracing.Span.from_dict(d)
+    assert back.children[0].name == "reader_scan"
+    assert back.children[0].fields["files"] == 3
+    assert isinstance(back.children[0].fields["note"], str)
+
+
+# ------------------------------------------- transport context round-trip
+
+def test_transport_trace_roundtrip():
+    """Simulated sql→store hop: the client ships the bound context on
+    the frame header, the server runs the handler under a store-side
+    root span, and the finished store tree grafts back under the
+    client's rpc:* child — one merged tree."""
+    from opengemini_tpu.cluster.transport import RPCClient, RPCServer
+
+    seen = {}
+
+    def handler(body):
+        sp = tracing.current_span()
+        seen["tid"] = tracing.current_trace_id()
+        assert sp is not None
+        child = sp.child("reader_scan")
+        child.start_ns = time.perf_counter_ns()
+        child.add(pts=len(body.get("pts", ())))
+        child.end_ns = time.perf_counter_ns()
+        return {"ok": True}
+
+    srv = RPCServer(handlers={"select": handler})
+    srv.start()
+    cli = RPCClient(srv.addr)
+    try:
+        root = tracing.new_trace("query")
+        with tracing.bind(root, "cafe0123"):
+            out = cli.call("select", {"pts": [1, 2]})
+        root.end_ns = time.perf_counter_ns()
+        assert out == {"ok": True}
+        assert seen["tid"] == "cafe0123"
+        (rpc_sp,) = root.children
+        assert rpc_sp.name == "rpc:select"
+        (store_sp,) = rpc_sp.children
+        assert store_sp.name == "store:select"
+        (scan_sp,) = store_sp.children
+        assert scan_sp.name == "reader_scan"
+        assert scan_sp.fields["pts"] == 2
+        assert store_sp.end_ns >= store_sp.start_ns > 0
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_transport_no_context_no_overhead():
+    """An unbound caller ships no tc header and the server builds no
+    span — the RPC fast path is untouched when tracing is off."""
+    from opengemini_tpu.cluster.transport import RPCClient, RPCServer
+
+    seen = {}
+
+    def handler(body):
+        seen["span"] = tracing.current_span()
+        return {"ok": True}
+
+    srv = RPCServer(handlers={"ping": handler})
+    srv.start()
+    cli = RPCClient(srv.addr)
+    try:
+        assert cli.call("ping")["ok"] is True
+        assert seen["span"] is None
+    finally:
+        cli.close()
+        srv.stop()
+
+
+# ------------------------------------------------------- chrome export
+
+def _demo_record():
+    root = tracing.new_trace("query")
+    t0 = root.start_ns
+    st = root.child("statement")
+    st.start_ns, st.end_ns = t0 + 10, t0 + 900
+    scan = st.child("reader_scan")
+    scan.start_ns, scan.end_ns = t0 + 20, t0 + 400
+    pull = st.child("device_pull")
+    pull.start_ns, pull.end_ns = t0 + 100, t0 + 800
+    lane = pull.child("pipeline.pull")
+    lane.start_ns, lane.end_ns = t0 + 120, t0 + 700
+    lane.add(lane="pull-0", bytes=4096)
+    root.end_ns = t0 + 1000
+    return tracing.TraceRecord(
+        trace_id="feed0042", kind="query", text="SELECT 1", db="db0",
+        start_wall=0.0, duration_ns=1000, root=root)
+
+
+def test_chrome_export_valid_and_monotonic():
+    rec = _demo_record()
+    doc = json.loads(tracing.chrome_json(rec))
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert xs and metas
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert e["ts"] + e["dur"] <= 1.0 + 1e-9   # inside the root (us→ms)
+    # children start at-or-after their ancestors (monotonic ts)
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["statement"]["ts"] >= by_name["query"]["ts"]
+    assert by_name["pipeline.pull"]["ts"] >= by_name["device_pull"]["ts"]
+    # the pull lane got its own named thread and carries byte args
+    lanes = {m["args"]["name"] for m in metas
+             if m["name"] == "thread_name"}
+    assert "pull-0" in lanes and "http" in lanes
+    assert by_name["pipeline.pull"]["args"]["bytes"] == 4096
+
+
+def test_chrome_export_spanless_record_is_empty():
+    rec = tracing.TraceRecord(
+        trace_id="beef", kind="query", text="q", db="", start_wall=0.0,
+        duration_ns=5, status="error", sampled=False, root=None)
+    assert tracing.chrome_events(rec) == []
+    json.loads(tracing.chrome_json(rec))
+
+
+# ------------------------------------------------------ HTTP integration
+
+@pytest.fixture
+def server(tmp_path):
+    from opengemini_tpu.http import HttpServer
+    from opengemini_tpu.storage import Engine
+    eng = Engine(str(tmp_path / "data"))
+    srv = HttpServer(eng, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    eng.close()
+
+
+def _req(srv, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    r = urllib.request.Request(url, data=body, method=method,
+                               headers=headers or {})
+    try:
+        resp = urllib.request.urlopen(r, timeout=30)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _seed(srv):
+    code, _h, body = _req(
+        srv, "POST", "/write?db=db0",
+        body=b"cpu,host=a v=1 60000000000\ncpu,host=b v=2 120000000000")
+    assert code == 204, body
+
+
+def _query(srv, q, headers=None, extra=""):
+    return _req(srv, "GET",
+                f"/query?db=db0&q={quote(q)}{extra}", headers=headers)
+
+
+QB = "SELECT mean(v) FROM cpu WHERE time >= 0 AND time < 3m " \
+     "GROUP BY time(1m), host"
+
+
+def test_http_sampled_query_end_to_end(server, knob):
+    knob("OG_TRACE_SAMPLE", 1)
+    _seed(server)
+    code, hdrs, body = _query(server, QB)
+    assert code == 200
+    tid = hdrs.get("X-OG-Trace-Id")
+    assert tid, "sampled query must return its trace id"
+    # /debug/requests lists it
+    code, _h, body = _req(server, "GET", "/debug/requests")
+    summ = json.loads(body)
+    assert any(r["trace_id"] == tid for r in summ["recent"])
+    # /debug/trace renders one merged tree: root query → sched_queue /
+    # statement → executor phases
+    code, _h, body = _req(server, "GET", f"/debug/trace?id={tid}")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["status"] == "ok" and doc["trace_id"] == tid
+    names = set()
+
+    def walk(d):
+        names.add(d["name"])
+        for c in d["children"]:
+            walk(c)
+
+    walk(doc["spans"])
+    assert "query" in names and "statement" in names
+    assert "sched_queue" in names
+    assert names & PHASE_NAMES & {"reader_scan", "device_agg",
+                                  "device_pull", "finalize", "merge"}
+    assert any("query" in ln for ln in doc["tree"])
+    # the root span self-describes pipeline overlap
+    assert "phase_sum_ns" in doc["spans"]["fields"]
+    assert "overlap_ns" in doc["spans"]["fields"]
+    # chrome export: valid JSON, named lanes, sane timestamps
+    code, _h, body = _req(server, "GET",
+                          f"/debug/trace?id={tid}&format=chrome")
+    cdoc = json.loads(body)
+    xs = [e for e in cdoc["traceEvents"] if e["ph"] == "X"]
+    assert xs and all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    assert any(e["ph"] == "M" for e in cdoc["traceEvents"])
+
+
+def test_http_sampled_out_allocates_nothing(server, knob, monkeypatch):
+    """Overhead guard: OG_TRACE_SAMPLE=0 builds no span tree at all
+    for OK queries and records nothing in the recorder."""
+    knob("OG_TRACE_SAMPLE", 0)
+    _seed(server)
+    calls = []
+    real = tracing.new_trace
+    monkeypatch.setattr(tracing, "new_trace",
+                        lambda name: calls.append(name) or real(name))
+    for _ in range(3):
+        code, hdrs, _b = _query(server, QB)
+        assert code == 200
+        assert "X-OG-Trace-Id" not in hdrs
+    assert not calls, "sampled-out query allocated a span tree"
+    summ = tracing.recorder().summaries()
+    assert summ["recent"] == [] and summ["slow"] == []
+
+
+def test_http_forced_trace_header(server, knob):
+    """X-OG-Trace forces the sample even at rate 0 and pins the id
+    (cross-service correlation)."""
+    knob("OG_TRACE_SAMPLE", 0)
+    _seed(server)
+    code, hdrs, _b = _query(server, QB,
+                            headers={"X-OG-Trace": "0123456789abcdef"})
+    assert code == 200
+    assert hdrs.get("X-OG-Trace-Id") == "0123456789abcdef"
+    rec = tracing.recorder().get("0123456789abcdef")
+    assert rec is not None and rec.root is not None
+
+
+def test_http_error_query_retained(server, knob):
+    """Failed statements are kept in the slow/error ring even when the
+    sample roll missed — span-less, but attributable."""
+    knob("OG_TRACE_SAMPLE", 0)
+    _seed(server)
+    code, _h, body = _query(server, "SELECT nosuchfn(v) FROM cpu")
+    assert code == 200
+    summ = tracing.recorder().summaries()
+    errs = [r for r in summ["slow"] if r["status"] == "error"]
+    assert errs and errs[0]["sampled"] is False
+    rec = tracing.recorder().get(errs[0]["trace_id"])
+    assert rec.root is None
+
+
+def test_http_slow_query_wiring(server, knob):
+    """The previously-dead slow_query_threshold: OG_SLOW_QUERY_MS
+    classifies, logs and ring-retains slow queries with their phase
+    split and trace id."""
+    knob("OG_TRACE_SAMPLE", 0)
+    knob("OG_SLOW_QUERY_MS", 0.0001)
+    _seed(server)
+    code, hdrs, _b = _query(server, QB)
+    assert code == 200
+    tid = hdrs.get("X-OG-Trace-Id")
+    assert tid, "slow query must be retained + announced"
+    rec = tracing.recorder().get(tid)
+    assert rec.status == "slow" and rec.root is None
+    code, _h, body = _req(server, "GET", "/debug/vars")
+    vars_ = json.loads(body)
+    entry = [e for e in vars_["slow_log"] if e["trace_id"] == tid]
+    assert entry and entry[0]["duration_ms"] > 0
+    assert vars_["slow_queries"] >= 1
+    # a sampled slow query additionally carries its phase split
+    knob("OG_TRACE_SAMPLE", 1)
+    code, hdrs, _b = _query(server, QB)
+    rec = tracing.recorder().get(hdrs["X-OG-Trace-Id"])
+    assert rec.status == "slow" and rec.root is not None
+    last = json.loads(_req(server, "GET", "/debug/vars")[2])["slow_log"][-1]
+    assert last["phases_ms"], "sampled slow entry must carry phases"
+
+
+def test_http_trace_missing_404(server):
+    code, _h, body = _req(server, "GET", "/debug/trace?id=deadbeef")
+    assert code == 404
+    assert "flight recorder" in json.loads(body)["error"]
+
+
+def test_http_metrics_histograms(server, knob):
+    knob("OG_TRACE_SAMPLE", 0)
+    _seed(server)
+    assert _query(server, QB)[0] == 200
+    code, _h, body = _req(server, "GET", "/metrics")
+    text = body.decode()
+    # Prometheus histogram exposition for the tentpole trio: query
+    # latency, scheduler queue wait, D2H pull bytes — plus routes
+    for name in ("opengemini_httpd_query_latency_ms",
+                 "opengemini_scheduler_queue_wait_ms",
+                 "opengemini_device_d2h_pull_bytes",
+                 "opengemini_httpd_route_query_ms"):
+        assert f"# TYPE {name} histogram" in text, name
+        assert f'{name}_bucket{{le="+Inf"}}' in text, name
+        assert f"{name}_count" in text, name
+    # /debug/vars summarizes p50/p95/p99 of the same registry
+    vars_ = json.loads(_req(server, "GET", "/debug/vars")[2])
+    lat = vars_["latency"]
+    assert lat["httpd"]["query_latency_ms_count"] >= 1
+    assert lat["httpd"]["query_latency_ms_p99"] > 0
+
+
+def test_http_write_trace(server, knob):
+    knob("OG_TRACE_SAMPLE", 1)
+    code, hdrs, body = _req(server, "POST", "/write?db=db0",
+                            body=b"cpu,host=w v=9 1")
+    assert code == 204, body
+    assert hdrs.get("X-OG-Trace-Id"), \
+        "recorded write must announce its trace id"
+    summ = tracing.recorder().summaries()
+    ws = [r for r in summ["recent"] if r["kind"] == "write"]
+    assert ws and ws[0]["status"] == "ok"
+    # X-OG-Trace forces + pins the id on writes too
+    knob("OG_TRACE_SAMPLE", 0)
+    code, hdrs, _b = _req(server, "POST", "/write?db=db0",
+                          body=b"cpu,host=w v=10 2",
+                          headers={"X-OG-Trace": "fade0000feed0001"})
+    assert code == 204
+    assert hdrs.get("X-OG-Trace-Id") == "fade0000feed0001"
+    assert tracing.recorder().get("fade0000feed0001") is not None
+    # failed writes land in the error ring even sampled-out
+    knob("OG_TRACE_SAMPLE", 0)
+    code, _h, _b = _req(server, "POST", "/write?db=db0",
+                        body=b"not line protocol !!!")
+    assert code == 400
+    assert any(r["kind"] == "write" and r["status"] == "error"
+               for r in tracing.recorder().summaries()["slow"])
