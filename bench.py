@@ -207,6 +207,10 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
     ex = QueryExecutor(eng)
     out = {}
     big = None
+    est_err = {}
+    from opengemini_tpu.query import scheduler as qsched
+    from opengemini_tpu.query.manager import QueryManager
+    qm = QueryManager()
     for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
                        ("cfg1", QUERY_CFG1)):
         (stmt,) = parse_query(qtext)
@@ -223,6 +227,28 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
                     "cells": n_cells}
         if key == "1m":
             big = res        # reused by the serialize measurement
+        # device observatory: grade the admission estimator against a
+        # measured (ctx-instrumented, untimed) run of the same shape —
+        # feeds the scheduler's estimate-error histograms + per-class
+        # EWMA bias, and the per-shape ratios land in the headline JSON
+        cost = qsched.estimate_request_cost(ex, [stmt], "bench")
+        cctx = qm.attach(qtext, "bench")
+        t0 = time.perf_counter()
+        ex.execute(stmt, "bench", ctx=cctx)
+        dev_ms = (time.perf_counter() - t0) * 1e3
+        qm.detach(cctx)
+        qsched.get_scheduler().record_actual(
+            cost, cells=cctx.actual_cells, pull_bytes=cctx.d2h_bytes,
+            device_ms=cctx.device_ns / 1e6 or dev_ms,
+            hbm_peak=cctx.hbm_peak)
+        est_err[key] = {
+            "est_cells": cost.cells,
+            "actual_cells": cctx.actual_cells,
+            "cells_ratio": round(cctx.actual_cells
+                                 / max(1, cost.cells), 4),
+            "est_pull_bytes": cost.pull_bytes,
+            "actual_pull_bytes": cctx.d2h_bytes,
+            "hbm_peak_bytes": cctx.hbm_peak}
     # per-phase wall times from EXPLAIN ANALYZE: plan / dispatch /
     # kernel+pull / fold / finalize of the 1h shape. With the streaming
     # pipeline the device_pull span OVERLAPS the others (it opens at
@@ -253,6 +279,19 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
         for grp in ("query_phase", "device")
         for g in [hs.get(grp, {})]
         for k in sorted(g) if k.endswith("_p50")}
+    # device observatory: process-wide tracked-HBM high-watermark
+    # (device cache + host mirror + in-flight pipeline buffers) and
+    # the calibration state the instrumented runs above produced —
+    # estimate-error ratios per shape + the learned per-class bias
+    from opengemini_tpu.ops import hbm as _hbm
+    out["hbm_peak_mb"] = round(
+        _hbm.LEDGER.snapshot(events=False)["total_hwm_bytes"] / 1e6, 3)
+    calib = qsched.get_scheduler().calibration_snapshot()
+    out["estimate_error"] = {
+        "shapes": est_err,
+        "classes": {n: c for n, c in calib["classes"].items()
+                    if c["n"] > 0},
+        "error_hist": calib["error_hist"]}
     eng.close()
     return out
 
@@ -441,6 +480,10 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         # phase/D2H metric, plus the headline query's recorded trace
         # (id + exported Chrome timeline path + merged span names)
         "hist_p50_p99": tpu.get("hist_p50_p99", {}),
+        # device observatory (PR 8): tracked-HBM high-watermark and
+        # the admission estimator graded against measured actuals
+        "hbm_peak_mb": tpu.get("hbm_peak_mb", 0.0),
+        "estimate_error": tpu.get("estimate_error", {}),
         **trace_info}
 
 
@@ -839,7 +882,17 @@ def smoke_phase() -> dict:
                    ("trace-on", {"OG_PIPELINE_DEPTH": "4",
                                  "OG_TRACE_SAMPLE": "1"}),
                    ("trace-on-barrier", {"OG_PIPELINE_DEPTH": "0",
-                                         "OG_TRACE_SAMPLE": "1"})]
+                                         "OG_TRACE_SAMPLE": "1"}),
+                   # device observatory gate (PR 8): with the
+                   # utilization sampler ticking fast in the
+                   # background (the ledger itself is always on),
+                   # every result cell must match the untraced runs —
+                   # streamed AND single-barrier
+                   ("observatory", {"OG_PIPELINE_DEPTH": "4",
+                                    "OG_DEVUTIL_MS": "10"}),
+                   ("observatory-barrier", {"OG_PIPELINE_DEPTH": "0",
+                                            "OG_DEVUTIL_MS": "10"})]
+        from opengemini_tpu.ops import hbm as _hbm
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires
         E.BLOCK_MIN_RATIO = 0
@@ -853,7 +906,13 @@ def smoke_phase() -> dict:
                 for cname, env in configs:
                     for k, v in env.items():
                         os.environ[k] = v
-                    dig, cells = run(qtext)
+                    if "OG_DEVUTIL_MS" in env:
+                        _hbm.sampler().start()
+                    try:
+                        dig, cells = run(qtext)
+                    finally:
+                        if "OG_DEVUTIL_MS" in env:
+                            _hbm.sampler().stop()
                     checked += cells
                     if ref is None:
                         ref = (cname, dig)
@@ -864,6 +923,17 @@ def smoke_phase() -> dict:
                             f"{ref[0]} {ref[1][:16]}")
                     for k in env:
                         os.environ.pop(k, None)
+        # the observatory sweep must leave the HBM ledger exactly
+        # reconciled with the caches it mirrors, with the utilization
+        # ring populated from the background sampler
+        cross = _hbm.cross_check()
+        if not cross["ok"]:
+            raise SystemExit(f"SMOKE MISMATCH: HBM ledger diverged "
+                             f"from its sources: {cross}")
+        n_samples = len(_hbm.sampler().samples())
+        if n_samples == 0:
+            raise SystemExit("SMOKE MISMATCH: utilization sampler "
+                             "produced no samples at OG_DEVUTIL_MS=10")
         # streaming-serializer golden gate: the chunked emit (with the
         # bounded-queue overlap thread) must be byte-identical to
         # json.dumps of the same document
@@ -918,6 +988,45 @@ def smoke_phase() -> dict:
                 f"SMOKE MISMATCH: tracing overhead {overhead_pct:.2f}%"
                 f" (on {t_on * 1e3:.2f}ms vs off {t_off * 1e3:.2f}ms)"
                 f" exceeds {limit}%")
+        # observatory overhead gate (PR 8): fast-ticking utilization
+        # sampler + per-query ctx attribution + calibration recording
+        # vs the plain path, same best-of-N + pct/2ms-slack mechanism
+        # as the tracing gate above (t_off is the same plain baseline)
+        from opengemini_tpu.query import scheduler as qsched
+        from opengemini_tpu.query.manager import QueryManager
+        qm_oh = QueryManager()
+        cost_oh = qsched.estimate_request_cost(ex, [stmt_1h], "bench")
+
+        def best_wall_obs():
+            best = float("inf")
+            for _ in range(n_overhead):
+                t0 = time.perf_counter()
+                cctx = qm_oh.attach(QUERY, "bench")
+                ex.execute(stmt_1h, "bench", ctx=cctx)
+                qm_oh.detach(cctx)
+                qsched.get_scheduler().record_actual(
+                    cost_oh, cells=cctx.actual_cells,
+                    pull_bytes=cctx.d2h_bytes,
+                    device_ms=cctx.device_ns / 1e6,
+                    hbm_peak=cctx.hbm_peak)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        knobs.set_env("OG_DEVUTIL_MS", "10")
+        _hbm.sampler().start()
+        try:
+            best_wall_obs()                  # warm the observatory path
+            t_obs = best_wall_obs()
+        finally:
+            _hbm.sampler().stop()
+            knobs.del_env("OG_DEVUTIL_MS")
+        obs_pct = (t_obs - t_off) / max(t_off, 1e-9) * 100
+        obs_limit = float(knobs.get("OG_SMOKE_OBS_OVERHEAD_PCT"))
+        if obs_pct > obs_limit and (t_obs - t_off) > 2e-3:
+            raise SystemExit(
+                f"SMOKE MISMATCH: observatory overhead {obs_pct:.2f}%"
+                f" (on {t_obs * 1e3:.2f}ms vs off {t_off * 1e3:.2f}ms)"
+                f" exceeds {obs_limit}%")
         (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
         phases = _parse_phases(ex.execute(est, "bench"))
         eng.close()
@@ -928,6 +1037,10 @@ def smoke_phase() -> dict:
             "trace_overhead_pct": round(overhead_pct, 2),
             "trace_e2e_off_ms": round(t_off * 1e3, 2),
             "trace_e2e_on_ms": round(t_on * 1e3, 2),
+            "obs_overhead_pct": round(obs_pct, 2),
+            "obs_e2e_on_ms": round(t_obs * 1e3, 2),
+            "obs_ledger_reconciled": 1 if cross["ok"] else 0,
+            "obs_util_samples": n_samples,
             **phases}
 
 

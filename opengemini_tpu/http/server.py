@@ -183,6 +183,8 @@ class HttpServer:
             sp.register("query_phases", phase_collector)
             from ..utils.stats import scheduler_collector
             sp.register("scheduler", scheduler_collector)
+            from ..utils.stats import hbm_collector
+            sp.register("hbm", hbm_collector)
             from ..utils.stats import latency_collector
             sp.register("latency", latency_collector)
             sp.register("wal", wal_collector)
@@ -530,9 +532,16 @@ class HttpServer:
         self._thread.start()
         if self.stats_pusher is not None:
             self.stats_pusher.start()
+        # device utilization timeline (ops/hbm.py): background sampler
+        # feeding /debug/device; OG_DEVUTIL_MS <= 0 disables
+        if float(knobs.get("OG_DEVUTIL_MS")) > 0:
+            from ..ops import hbm as _hbm
+            _hbm.sampler().start()
         log.info("http listening on %s:%d", self.host, self.port)
 
     def stop(self) -> None:
+        from ..ops import hbm as _hbm
+        _hbm.sampler().stop()
         if self.stats_pusher is not None:
             self.stats_pusher.stop()
         if self._httpd:
@@ -626,7 +635,8 @@ class HttpServer:
         code, payload = self._handle_write_inner(params, body,
                                                  user=user)
         _observe(HTTP_HIST, "write_latency_ms",
-                 (time.perf_counter_ns() - t0) / 1e6)
+                 (time.perf_counter_ns() - t0) / 1e6,
+                 trace_id=trace_id if sampled else None)
         tstat = {"status": "ok" if code < 400 else "error",
                  "error": (payload or {}).get("error", "")}
         if root is not None:
@@ -870,25 +880,36 @@ class HttpServer:
                     results.append(res)
         finally:
             if ticket is not None:
+                # cost-model calibration (device observatory): grade
+                # the admission estimate against this query's measured
+                # actuals. No-op when OG_SCHED_CALIB=0 (the PR 4
+                # byte-identity gate).
+                _qsched.get_scheduler().record_ctx(ticket, ctx)
                 ticket.release()
             if gate_held:
                 self.resources.queries.release()
             if ctx is not None:
                 self.query_manager.detach(ctx)
             _observe(HTTP_HIST, "query_latency_ms",
-                     (time.perf_counter_ns() - t_q0) / 1e6)
+                     (time.perf_counter_ns() - t_q0) / 1e6,
+                     trace_id=trace_id if sampled else None)
             self._finish_trace("query", qtext, db, t_q0, trace_id,
                                root, sampled, tstat, meta)
         return 200, {"results": results}
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, fmt: str = "prometheus") -> str:
         """Prometheus text exposition of the internal collectors
-        (reference httpd serveMetrics, handler.go /metrics route)."""
+        (reference httpd serveMetrics, handler.go /metrics route).
+        ``fmt="openmetrics"`` emits the OpenMetrics 1.0 dialect
+        instead: same families, plus flight-recorder trace-id
+        exemplars on the histogram buckets and the mandatory ``# EOF``
+        terminator — slow buckets link straight to /debug/trace?id=."""
         from ..utils.stats import (compaction_collector,
                                    device_collector,
                                    devicecache_collector,
                                    engine_collector, executor_collector,
-                                   raft_collector, readcache_collector,
+                                   hbm_collector, raft_collector,
+                                   readcache_collector,
                                    rpc_collector, runtime_collector,
                                    scheduler_collector,
                                    subscriber_collector, wal_collector)
@@ -900,6 +921,7 @@ class HttpServer:
                   "device": device_collector(),
                   "query_phases": phase_collector(),
                   "scheduler": scheduler_collector(),
+                  "hbm": hbm_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
@@ -911,6 +933,7 @@ class HttpServer:
                 groups["engine"] = engine_collector(self.engine)()
             except Exception:
                 pass
+        om = fmt == "openmetrics"
         lines = []
         for grp, vals in groups.items():
             for k, v in sorted(vals.items()):
@@ -918,13 +941,18 @@ class HttpServer:
                                                          (int, float)):
                     continue
                 name = f"opengemini_{grp}_{k}"
+                lines.append(f"# HELP {name} {grp} collector "
+                             f"metric {k}")
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {v}")
         # registered latency/size histograms (query latency, queue
-        # wait, D2H bytes, phases, routes) in native Prometheus
-        # histogram exposition — _bucket{le=}/_sum/_count
+        # wait, D2H bytes, phases, routes, estimate-error ratios) in
+        # native histogram exposition — _bucket{le=}/_sum/_count, with
+        # exemplars in the OpenMetrics dialect
         from ..utils.stats import histograms_prometheus
-        lines.extend(histograms_prometheus())
+        lines.extend(histograms_prometheus(openmetrics=om))
+        if om:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     # --------------------------------------------------- flux endpoint
@@ -1013,6 +1041,9 @@ class HttpServer:
                                  "message": str(e)}, None
         finally:
             if ticket is not None:
+                # same estimate-vs-actual grading as /query — a flux
+                # monster must not dodge calibration either
+                _qsched.get_scheduler().record_ctx(ticket, ctx)
                 ticket.release()
             if gate_held:
                 self.resources.queries.release()
@@ -1529,10 +1560,18 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/metrics":
             # Prometheus text exposition of the internal collectors
-            # (reference serveMetrics)
-            body = srv.metrics_text().encode()
+            # (reference serveMetrics); ?format=openmetrics (or an
+            # OpenMetrics Accept header) switches to the exemplar-
+            # bearing OpenMetrics 1.0 dialect
+            om = (self._params().get("format") == "openmetrics"
+                  or "application/openmetrics-text"
+                  in (self.headers.get("Accept") or ""))
+            fmt = "openmetrics" if om else "prometheus"
+            body = srv.metrics_text(fmt).encode()
             self.send_response(200)
             self.send_header("Content-Type",
+                             "application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8" if om else
                              "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Content-Length", str(len(body)))
@@ -1547,6 +1586,7 @@ class _Handler(BaseHTTPRequestHandler):
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
             from ..utils.stats import (devicecache_collector,
+                                       hbm_collector,
                                        histogram_summaries,
                                        scheduler_collector)
             out = dict(srv.stats)
@@ -1554,6 +1594,7 @@ class _Handler(BaseHTTPRequestHandler):
             out["devicecache"] = devicecache_collector()
             out["query_phases"] = phase_collector()
             out["scheduler"] = scheduler_collector()
+            out["hbm"] = hbm_collector()
             # p50/p95/p99 summaries of every registered histogram
             # (query/write latency, queue wait, phases, D2H pulls)
             out["latency"] = histogram_summaries()
@@ -1590,6 +1631,60 @@ class _Handler(BaseHTTPRequestHandler):
                 out["tree"] = rec.root.render()
                 out["spans"] = rec.root.to_dict()
             self._reply(200, out)
+            return
+        if path == "/debug/device":
+            # device resource observatory: HBM ledger (per-tier bytes,
+            # high-watermarks, pressure events), exact cross-check
+            # against the caches, backend reconciliation, and the
+            # utilization timeline ring; ?format=chrome exports the
+            # timeline as a Perfetto counter track that lays next to
+            # the /debug/trace span export
+            from ..ops import hbm as _hbm
+            p = self._params()
+            smp = _hbm.sampler()
+            samples = smp.samples()
+            if not samples:
+                # sampler disabled or not yet ticked: take one sample
+                # on demand so the endpoint is never empty (NOT
+                # recorded — a read must not fabricate timeline
+                # entries at request times)
+                samples = [smp.sample_once(record=False)]
+            if p.get("format") == "chrome":
+                try:
+                    base_ns = int(p["base_ns"]) if "base_ns" in p \
+                        else None
+                except ValueError:
+                    base_ns = None
+                body = json.dumps({
+                    "traceEvents": _hbm.chrome_counter_events(
+                        samples, base_ns=base_ns),
+                    "displayTimeUnit": "ms"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self._reply(200, {
+                "ledger": _hbm.LEDGER.snapshot(),
+                "cross_check": _hbm.cross_check(),
+                "reconcile": _hbm.reconcile(),
+                "timeline": {
+                    "sampler_running": smp.running(),
+                    "interval_ms": float(knobs.get("OG_DEVUTIL_MS")),
+                    "samples": samples}})
+            return
+        if path == "/debug/scheduler":
+            # serving-runtime view: admission counters/gauges plus the
+            # cost-model calibration state (per-class learned bias,
+            # recent estimate-vs-actual records, error-histogram tails)
+            from ..query import scheduler as _qs
+            sch = _qs.get_scheduler()
+            self._reply(200, {"enabled": _qs.enabled(),
+                              "scheduler": sch.snapshot(),
+                              "calibration":
+                                  sch.calibration_snapshot()})
             return
         if path == "/debug/ctrl":
             if not self._admin_gate(user):

@@ -268,11 +268,10 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
         slabs.append(st)
     built = None
     cache.put(key, slabs)
-    with cache._lock:   # account real HBM footprint
-        if key in cache._map:
-            nb = sum(s.nbytes for s in slabs) + 64
-            cache._map[key] = (slabs, nb)
-            cache._bytes += nb - 64
+    # account the real HBM footprint (a slab LIST has no .nbytes, so
+    # put() staked a 64-byte placeholder) — reprice mirrors the charge
+    # into the HBM ledger too (ops/hbm.py)
+    cache.reprice(key, sum(s.nbytes for s in slabs))
     from . import devstats
     devstats.bump("slabs_built", len(slabs))
     devstats.bump("slab_bytes", sum(s.nbytes for s in slabs))
@@ -1702,12 +1701,11 @@ def _prefix_dev_plan(st: BlockStack, gid_slice: np.ndarray,
     ent = (jax.device_put(w0),
            jax.device_put(idx.astype(np.int32)), WLmax, Cmax)
     if cache is not None:
+        # a tuple has no .nbytes, so put() stakes a 64-byte
+        # placeholder — reprice with the real device footprint,
+        # mirrored into the HBM ledger (ops/hbm.py)
         cache.put(key, ent)
-        with cache._lock:            # account real HBM footprint
-            if key in cache._map:
-                nb = int(ent[0].nbytes + ent[1].nbytes) + 64
-                cache._map[key] = (ent, nb)
-                cache._bytes += nb - 64
+        cache.reprice(key, int(ent[0].nbytes + ent[1].nbytes))
     return ent
 
 

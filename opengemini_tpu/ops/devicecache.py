@@ -35,14 +35,30 @@ _MB = 1024 * 1024
 
 
 class DeviceBlockCache:
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, tier: str | None = None,
+                 ledger=None):
+        """``tier`` names this cache's HBM-ledger tier (ops/hbm.py);
+        only the process singletons (global_cache / host_cache) pass
+        one — ad-hoc instances (tests, tools) stay unledgered so they
+        cannot skew the device accounting. ``ledger`` overrides the
+        module LEDGER (unit tests)."""
         self.capacity = capacity_bytes
+        self.tier = tier
+        self._ledger = ledger
         self._lock = RankedLock("devicecache", RANK_DEVCACHE)
         self._map: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    def _led(self):
+        if self.tier is None:
+            return None
+        if self._ledger is None:
+            from . import hbm
+            self._ledger = hbm.LEDGER
+        return self._ledger
 
     @staticmethod
     def _nbytes(arr) -> int:
@@ -71,28 +87,79 @@ class DeviceBlockCache:
     def put_sized(self, key: tuple, arr, nbytes: int) -> None:
         """put with an explicit byte charge — for entries whose cost
         the generic ``.nbytes`` probe can't see (tuples of device
-        arrays, slab lists)."""
+        arrays, slab lists). Charges/evictions mirror into the HBM
+        ledger (ops/hbm.py) when this cache owns a tier."""
+        led = self._led()
         nb = int(nbytes) + 64
         if nb > self.capacity:
+            if led is not None:
+                # admission failure IS pressure: the entry was built
+                # (decode + maybe H2D happened) and could not stay
+                led.pressure(self.tier, nb, "over_capacity")
             return
+        replaced = 0
+        evicted = 0
+        n_evicted = 0
         with self._lock:
             old = self._map.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
+                replaced = old[1]
             self._map[key] = (arr, nb)
             self._bytes += nb
             while self._bytes > self.capacity and self._map:
                 # NO eager buf.delete(): an in-flight query may hold a
                 # pinned reference from get(); HBM frees when the last
                 # reference drops
-                _k, (_buf, nb) = self._map.popitem(last=False)
-                self._bytes -= nb
+                _k, (_buf, enb) = self._map.popitem(last=False)
+                self._bytes -= enb
                 self.evictions += 1
+                evicted += enb
+                n_evicted += 1
+            # mirror INSIDE the cache lock: were it outside, thread
+            # B's release of an entry thread A charged could land
+            # before A's account — the ledger's underflow clamp would
+            # eat the bytes and the exact cross_check would drift
+            # forever (rank DEVCACHE 20 < HBM 35 allows the nesting;
+            # the ledger lock never blocks)
+            if led is not None:
+                led.account(self.tier, nb)
+                if replaced:
+                    led.release(self.tier, replaced)
+                if n_evicted:
+                    led.release(self.tier, evicted, n=n_evicted)
+        if led is not None and n_evicted:
+            led.pressure(self.tier, evicted, "lru_eviction")
+
+    def reprice(self, key: tuple, nbytes: int) -> None:
+        """Re-charge an existing entry with its REAL byte cost (block
+        slab lists stake a placeholder via put(), then account their
+        uploaded footprint once built — ops/blockagg.get_stacks).
+        Deliberately does not evict: the slabs are already resident."""
+        led = self._led()
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is None:
+                return
+            nb = int(nbytes) + 64
+            delta = nb - ent[1]
+            self._map[key] = (ent[0], nb)
+            self._bytes += delta
+            if led is not None and delta:
+                if delta > 0:
+                    led.account(self.tier, delta, n=0)
+                else:
+                    led.release(self.tier, -delta, n=0)
 
     def purge(self) -> None:
+        led = self._led()
         with self._lock:
+            freed = self._bytes
+            n = len(self._map)
             self._map.clear()
             self._bytes = 0
+            if led is not None and n:
+                led.release(self.tier, freed, n=n)
 
     def stats(self) -> dict:
         with self._lock:
@@ -136,14 +203,16 @@ def enabled() -> bool:
 def global_cache() -> DeviceBlockCache:
     global _CACHE
     if _CACHE is None:
-        _CACHE = DeviceBlockCache(capacity_bytes())
+        _CACHE = DeviceBlockCache(capacity_bytes(),
+                                  tier="device_cache")
     return _CACHE
 
 
 def host_cache() -> DeviceBlockCache:
     global _HOST_CACHE
     if _HOST_CACHE is None:
-        _HOST_CACHE = DeviceBlockCache(host_capacity_bytes())
+        _HOST_CACHE = DeviceBlockCache(host_capacity_bytes(),
+                                       tier="host_cache")
     return _HOST_CACHE
 
 

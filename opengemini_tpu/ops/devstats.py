@@ -117,16 +117,28 @@ def gauge(key: str, v: int) -> None:
         DEVICE_STATS[key] = int(v)
 
 
+def _trace_exemplar() -> str | None:
+    """Flight-recorder trace id of the current request, when sampled —
+    phase/D2H histogram observations carry it as an OpenMetrics
+    exemplar so a slow bucket links to /debug/trace?id=. The tracing
+    context is a plain thread-local list read; sampled-out requests
+    bind nothing and return None (no overhead beyond the call)."""
+    from ..utils.tracing import current_trace_id
+    return current_trace_id()
+
+
 def bump_phase(name: str, ns: int) -> None:
     from ..utils.stats import bump as _b
     _b(QUERY_PHASE_NS, name + "_ns", int(ns))
-    _observe(PHASE_HIST, name + "_ms", int(ns) / 1e6)
+    _observe(PHASE_HIST, name + "_ms", int(ns) / 1e6,
+             trace_id=_trace_exemplar())
 
 
 def observe_pull(nbytes: int, ns: int) -> None:
     """Per-call D2H distribution (device_get_parallel)."""
-    _observe(DEVICE_HIST, "d2h_pull_bytes", int(nbytes))
-    _observe(DEVICE_HIST, "d2h_pull_ms", int(ns) / 1e6)
+    tid = _trace_exemplar()
+    _observe(DEVICE_HIST, "d2h_pull_bytes", int(nbytes), trace_id=tid)
+    _observe(DEVICE_HIST, "d2h_pull_ms", int(ns) / 1e6, trace_id=tid)
 
 
 def count_query() -> None:

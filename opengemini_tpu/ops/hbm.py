@@ -1,0 +1,423 @@
+"""Device resource observatory: HBM ledger + utilization timeline.
+
+PR 7 made *requests* observable (flight recorder, latency histograms);
+the device resource side stayed dark: HBM usage was self-reported
+per-cache ``nbytes`` with no global view, and the single dispatcher /
+gate utilization was invisible between stats-pusher samples. Tailwind's
+framing (PAPERS.md) is that an accelerator-pool scheduler is only as
+good as its resource telemetry; Taurus NDP motivates accounting bytes
+*at the device boundary*. This module is that telemetry spine:
+
+- **HBM ledger** (``HBMLedger`` / module-level ``LEDGER``): a
+  tier-tagged byte accountant. Tiers mirror the real residency owners:
+  ``device_cache`` (HBM block-slab + decoded-plane tiers of
+  ops/devicecache.py), ``host_cache`` (the host pin mirror), and
+  ``pipeline`` (in-flight StreamingPipeline launch/pull result
+  buffers). Every tier keeps live bytes, entry count, a high-watermark
+  and cumulative account/release totals; eviction-pressure events land
+  in a bounded ring (``OG_HBM_EVENTS``). The per-QUERY working set is
+  attributed separately via the query ctx (QueryContext.hbm_peak —
+  SHOW QUERIES' ``hbm_peak_mb`` column), not a global tier: queries
+  overlap and their sum is exactly the ``pipeline`` tier.
+- **Reconciliation** (``reconcile``): where the backend exposes
+  ``device.memory_stats()`` (TPU runtimes do; the CPU backend does
+  not), compare backend-reported ``bytes_in_use`` against the
+  device-resident tracked bytes and flag drift beyond a tolerance —
+  the "are we lying to ourselves" check a byte accountant needs.
+  ``cross_check`` is the exact half: ledger tier bytes must equal what
+  the caches themselves report, byte for byte (tier-1 tested under
+  jax.transfer_guard).
+- **Utilization timeline** (``UtilizationSampler``): a background
+  thread (``OG_DEVUTIL_MS``; 0 disables) snapshots in-flight pulls,
+  the OG_SCHED_DEPTH gate occupancy, WFQ queue depth and per-tier
+  ledger bytes into a bounded ring (``OG_DEVUTIL_RING``) — exposed at
+  ``/debug/device`` as JSON and as a Chrome trace-event *counter
+  track* (``?format=chrome``) that lays next to the PR 7 Perfetto
+  span timeline (pass ``base_ns`` from a span export to share its
+  clock zero; both use perf_counter_ns).
+
+Locking: the ledger is called from inside devicecache (rank 20) and
+pipeline bookkeeping paths, so its lock ranks between PIPELINE (30)
+and STATS (40) — account/release may nest inside any hot-path lock
+and may still bump the innermost stats counters (oglint R4 checks the
+static half; utils/lockrank.py the runtime half).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..utils import knobs
+from ..utils.lockrank import RANK_HBM, RankedLock
+from ..utils.stats import register_counters
+
+__all__ = ["HBMLedger", "LEDGER", "account", "release", "pressure",
+           "reconcile", "cross_check", "UtilizationSampler", "sampler",
+           "chrome_counter_events", "collector", "HBM_STATS"]
+
+TIERS = ("device_cache", "host_cache", "pipeline")
+
+# event counters + collector-refreshed gauges (utils.stats registry —
+# oglint R6 covers every bump key; the per-tier live numbers live in
+# the ledger itself and flatten through collector()).
+HBM_STATS: dict = register_counters("hbm", {
+    "pressure_events": 0,      # evictions / over-capacity rejections
+    "underflow_clamps": 0,     # release without a matching account
+    "reconcile_runs": 0,
+    "reconcile_flagged": 0,    # drift beyond tolerance
+    # gauges (refreshed by collector()): global tracked footprint
+    "tracked_bytes": 0,
+    "tracked_hwm_bytes": 0,
+})
+
+
+def _bump(key: str, n: int = 1) -> None:
+    from ..utils.stats import bump as _b
+    _b(HBM_STATS, key, n)
+
+
+def _gauge(key: str, v: int) -> None:
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        HBM_STATS[key] = int(v)
+
+
+class HBMLedger:
+    """Tier-tagged byte accountant with high-watermarks and an
+    eviction-pressure event ring. All methods are thread-safe; the
+    lock never wraps a blocking call (rank 35 — see module doc)."""
+
+    def __init__(self, event_cap: int | None = None):
+        if event_cap is None:
+            event_cap = max(16, int(knobs.get("OG_HBM_EVENTS")))
+        self._lock = RankedLock("hbm.ledger", RANK_HBM)
+        self._tiers: dict[str, dict] = {
+            t: {"bytes": 0, "n": 0, "hwm_bytes": 0,
+                "accounted_bytes": 0, "released_bytes": 0}
+            for t in TIERS}
+        self._events: deque = deque(maxlen=event_cap)
+        self._hwm_total = 0
+
+    def _tier(self, tier: str) -> dict:
+        t = self._tiers.get(tier)
+        if t is None:
+            raise KeyError(f"unknown HBM ledger tier {tier!r} "
+                           f"(declared: {TIERS})")
+        return t
+
+    def account(self, tier: str, nbytes: int, n: int = 1) -> None:
+        nbytes = int(nbytes)
+        if nbytes < 0:
+            raise ValueError("account() takes non-negative bytes")
+        with self._lock:
+            t = self._tier(tier)
+            t["bytes"] += nbytes
+            t["n"] += n
+            t["accounted_bytes"] += nbytes
+            if t["bytes"] > t["hwm_bytes"]:
+                t["hwm_bytes"] = t["bytes"]
+            total = sum(x["bytes"] for x in self._tiers.values())
+            if total > self._hwm_total:
+                self._hwm_total = total
+
+    def release(self, tier: str, nbytes: int, n: int = 1) -> None:
+        nbytes = int(nbytes)
+        clamped = False
+        with self._lock:
+            t = self._tier(tier)
+            t["released_bytes"] += nbytes
+            t["bytes"] -= nbytes
+            t["n"] -= n
+            if t["bytes"] < 0 or t["n"] < 0:
+                # double release / release-without-account: clamp and
+                # count loudly — a silently negative tier would poison
+                # the reconcile math forever
+                clamped = True
+                t["bytes"] = max(0, t["bytes"])
+                t["n"] = max(0, t["n"])
+        if clamped:
+            _bump("underflow_clamps")
+
+    def pressure(self, tier: str, nbytes: int, reason: str) -> None:
+        """Record one eviction-pressure event (LRU eviction, an
+        over-capacity put rejection, reconcile drift…)."""
+        ev = {"ts": time.time(), "tier": tier, "bytes": int(nbytes),
+              "reason": str(reason)}
+        with self._lock:
+            self._events.append(ev)
+        _bump("pressure_events")
+
+    def snapshot(self, events: bool = True) -> dict:
+        with self._lock:
+            tiers = {t: dict(v) for t, v in self._tiers.items()}
+            out = {
+                "tiers": tiers,
+                "total_bytes": sum(v["bytes"] for v in tiers.values()),
+                "total_hwm_bytes": self._hwm_total,
+            }
+            if events:
+                out["events"] = list(self._events)
+        return out
+
+    def tier_bytes(self, tier: str) -> int:
+        with self._lock:
+            return self._tier(tier)["bytes"]
+
+    def tier_count(self, tier: str) -> int:
+        with self._lock:
+            return self._tier(tier)["n"]
+
+    def reset(self) -> None:
+        """Zero every tier and drop events (tests; never the serving
+        path — live caches would instantly drift from a zeroed ledger)."""
+        with self._lock:
+            for t in self._tiers.values():
+                for k in t:
+                    t[k] = 0
+            self._events.clear()
+            self._hwm_total = 0
+
+
+LEDGER = HBMLedger()
+
+
+def account(tier: str, nbytes: int, n: int = 1) -> None:
+    LEDGER.account(tier, nbytes, n)
+
+
+def release(tier: str, nbytes: int, n: int = 1) -> None:
+    LEDGER.release(tier, nbytes, n)
+
+
+def pressure(tier: str, nbytes: int, reason: str) -> None:
+    LEDGER.pressure(tier, nbytes, reason)
+
+
+# --------------------------------------------------- reconciliation
+
+def reconcile() -> dict:
+    """Compare the ledger's device-resident tracked bytes
+    (device_cache + pipeline tiers) against what the backend itself
+    reports via ``device.memory_stats()``. TPU runtimes expose
+    ``bytes_in_use``; the CPU backend returns None/raises — then the
+    result says so instead of inventing numbers. Drift beyond
+    max(64 MiB, OG_HBM_DRIFT_PCT%) flags (the backend legitimately
+    holds MORE than the ledger: jit executables, scratch, the
+    framework's own pools — the tolerance absorbs that floor, the flag
+    catches a leak growing past it)."""
+    _bump("reconcile_runs")
+    snap = LEDGER.snapshot(events=False)
+    tracked = (snap["tiers"]["device_cache"]["bytes"]
+               + snap["tiers"]["pipeline"]["bytes"])
+    out: dict = {"tracked_device_bytes": int(tracked),
+                 "backend": "unavailable", "flagged": False}
+    per_dev = []
+    try:
+        import jax
+        for d in jax.devices():
+            ms_fn = getattr(d, "memory_stats", None)
+            ms = ms_fn() if callable(ms_fn) else None
+            if ms and "bytes_in_use" in ms:
+                per_dev.append(
+                    {"device": str(d),
+                     "bytes_in_use": int(ms["bytes_in_use"]),
+                     "bytes_limit": int(ms.get("bytes_limit", 0))})
+    except Exception as e:  # backend probe must never fail the caller
+        out["backend_error"] = str(e)
+    if per_dev:
+        backend_b = sum(d["bytes_in_use"] for d in per_dev)
+        drift = backend_b - tracked
+        pct = float(knobs.get("OG_HBM_DRIFT_PCT"))
+        tol = max(64 << 20, int(pct / 100.0 * max(backend_b, tracked)))
+        flagged = abs(drift) > tol
+        out.update(backend="memory_stats", devices=per_dev,
+                   backend_bytes=int(backend_b), drift_bytes=int(drift),
+                   tolerance_bytes=int(tol), flagged=flagged)
+        if flagged:
+            _bump("reconcile_flagged")
+            LEDGER.pressure("device_cache", abs(drift),
+                            "reconcile_drift")
+    return out
+
+
+def cross_check() -> dict:
+    """Exact reconciliation against the sources the ledger mirrors:
+    each cache tier's ledger bytes must EQUAL what the cache itself
+    reports (the ledger is double-entry, not an estimate). The
+    pipeline tier has no independent source — quiescent it must be 0.
+    Returns per-tier {ledger, source, match}."""
+    from . import devicecache as _dc
+    snap = LEDGER.snapshot(events=False)
+    out: dict = {}
+    for tier, cache in (("device_cache", _dc.global_cache()),
+                        ("host_cache", _dc.host_cache())):
+        src = cache.stats()["bytes"]
+        led = snap["tiers"][tier]["bytes"]
+        out[tier] = {"ledger": led, "source": src,
+                     "match": led == src}
+    pl = snap["tiers"]["pipeline"]
+    out["pipeline"] = {"ledger": pl["bytes"], "in_flight": pl["n"],
+                       "match": True}
+    out["ok"] = all(v.get("match", True) for v in out.values()
+                    if isinstance(v, dict))
+    return out
+
+
+def collector() -> dict:
+    """utils.stats collector: flattened ledger + event counters for
+    /metrics, /debug/vars and the stats pusher (ts-monitor ships these
+    into the monitor db)."""
+    snap = LEDGER.snapshot(events=False)
+    _gauge("tracked_bytes", snap["total_bytes"])
+    _gauge("tracked_hwm_bytes", snap["total_hwm_bytes"])
+    out = {}
+    for tier, v in snap["tiers"].items():
+        out[f"{tier}_bytes"] = v["bytes"]
+        out[f"{tier}_hwm_bytes"] = v["hwm_bytes"]
+        out[f"{tier}_entries"] = v["n"]
+    out["total_bytes"] = snap["total_bytes"]
+    out["total_hwm_bytes"] = snap["total_hwm_bytes"]
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        for k, v in HBM_STATS.items():
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------ utilization timeline
+
+def _tree_device_bytes(tree) -> int:
+    """Byte estimate of the device arrays in a pytree (a launch's
+    in-flight result buffers). Metadata only — no transfer, no sync."""
+    import jax
+    tot = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        if isinstance(x, jax.Array):
+            try:
+                tot += int(x.size) * int(x.dtype.itemsize)
+            except Exception:
+                pass
+    return tot
+
+
+class UtilizationSampler:
+    """Background sampler of the device serving plane: per-tier ledger
+    bytes, in-flight streamed pulls, scheduler gate/queue occupancy.
+    Bounded ring (``OG_DEVUTIL_RING``); interval ``OG_DEVUTIL_MS`` is
+    re-read every tick so operators can retune a live server; <= 0
+    parks the thread (it wakes at 1s to re-check)."""
+
+    def __init__(self, ring: int | None = None):
+        if ring is None:
+            ring = max(8, int(knobs.get("OG_DEVUTIL_RING")))
+        self.ring: deque = deque(maxlen=ring)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._tlock = threading.Lock()   # thread start/stop only
+
+    # ------------------------------------------------------- sampling
+
+    def sample_once(self, record: bool = True) -> dict:
+        """One snapshot; ``record=False`` leaves the ring untouched —
+        the on-demand /debug/device fallback must not inject
+        request-time samples into the sampler's timeline."""
+        led = LEDGER.snapshot(events=False)
+        out = {
+            "ts": time.time(),
+            "perf_ns": time.perf_counter_ns(),
+            "tier_bytes": {t: v["bytes"]
+                           for t, v in led["tiers"].items()},
+            "total_bytes": led["total_bytes"],
+            "inflight_pulls": led["tiers"]["pipeline"]["n"],
+        }
+        try:
+            from ..query import scheduler as _qs
+            if _qs.enabled():
+                out.update(_qs.get_scheduler().util_gauges())
+        except Exception:
+            pass
+        if record:
+            self.ring.append(out)
+        return out
+
+    def samples(self) -> list[dict]:
+        return list(self.ring)
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        with self._tlock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="og-devutil")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._tlock:
+            self._stop.set()
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=5)
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _loop(self) -> None:
+        while True:
+            ms = float(knobs.get("OG_DEVUTIL_MS"))
+            wait_s = ms / 1e3 if ms > 0 else 1.0
+            if self._stop.wait(wait_s):
+                return
+            if ms > 0:
+                try:
+                    self.sample_once()
+                except Exception:   # a torn gauge must not kill the
+                    pass            # sampler thread
+
+
+_SAMPLER: UtilizationSampler | None = None
+_SAMPLER_LOCK = threading.Lock()
+
+
+def sampler() -> UtilizationSampler:
+    """Process-wide sampler (one device plane per process). Created
+    lazily; http/server.py starts it when OG_DEVUTIL_MS > 0."""
+    global _SAMPLER
+    with _SAMPLER_LOCK:
+        if _SAMPLER is None:
+            _SAMPLER = UtilizationSampler()
+        return _SAMPLER
+
+
+def chrome_counter_events(samples: list[dict],
+                          base_ns: int | None = None) -> list[dict]:
+    """Chrome trace-event counter track ("ph": "C") of the utilization
+    timeline — loads in Perfetto next to the PR 7 span export. Both
+    clock on perf_counter_ns: pass the span root's start_ns as
+    ``base_ns`` to share its zero; default zero is the first sample."""
+    if not samples:
+        return []
+    t0 = base_ns if base_ns is not None else samples[0]["perf_ns"]
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 2,
+         "args": {"name": "device observatory"}}]
+    for s in samples:
+        ts = (s["perf_ns"] - t0) / 1e3
+        events.append({"name": "hbm_bytes", "ph": "C", "pid": 2,
+                       "ts": ts,
+                       "args": {**s["tier_bytes"],
+                                "total": s["total_bytes"]}})
+        util = {"inflight_pulls": s.get("inflight_pulls", 0)}
+        for k in ("sched_active", "wfq_queued", "launch_queue",
+                  "gate_in_use"):
+            if k in s:
+                util[k] = s[k]
+        events.append({"name": "device_util", "ph": "C", "pid": 2,
+                       "ts": ts, "args": util})
+    return events

@@ -182,10 +182,15 @@ class StreamingPipeline:
     the shared gate caps the sum across concurrent queries (without it
     N queries × depth launches could all be in flight at once)."""
 
-    def __init__(self, depth: int | None = None, gate=None, span=None):
+    def __init__(self, depth: int | None = None, gate=None, span=None,
+                 ctx=None):
         self.depth = depth if depth is not None else pipeline_depth()
         self._sem = threading.BoundedSemaphore(max(1, self.depth))
         self.gate = gate
+        # per-query working-set attribution (device observatory): the
+        # submitting query's ctx carries live/peak in-flight result
+        # bytes (SHOW QUERIES hbm_peak_mb, scheduler calibration)
+        self.ctx = ctx
         # sampled-query tracing (utils/tracing): each launch's pull +
         # host fold gets a span on its puller thread's lane, so the
         # Chrome timeline export shows the launch/pull/unpack overlap
@@ -213,9 +218,21 @@ class StreamingPipeline:
             except BaseException:
                 self._sem.release()
                 raise
+        # HBM ledger (ops/hbm.py): this launch's device result buffers
+        # are in flight from submit until its pull/fold completes —
+        # the 'pipeline' tier is the live sum across ALL queries, the
+        # ctx attribution is this query's share (metadata-only byte
+        # estimate; no transfer, no sync)
+        from . import hbm as _hbm
+        est_b = _hbm._tree_device_bytes(tree)
+        _hbm.account("pipeline", est_b)
+        if self.ctx is not None and hasattr(self.ctx, "add_hbm"):
+            self.ctx.add_hbm(est_b)
         try:
-            fut = _pull_pool().submit(self._run, tree, post, transport)
+            fut = _pull_pool().submit(self._run, tree, post, transport,
+                                      est_b)
         except BaseException:
+            self._account_done(est_b)
             if self.gate is not None:
                 self.gate.release()
             self._sem.release()
@@ -224,7 +241,13 @@ class StreamingPipeline:
             self.launches += 1
             self._futs[key] = fut
 
-    def _run(self, tree, post, transport=None):
+    def _account_done(self, est_b: int) -> None:
+        from . import hbm as _hbm
+        _hbm.release("pipeline", est_b)
+        if self.ctx is not None and hasattr(self.ctx, "sub_hbm"):
+            self.ctx.sub_hbm(est_b)
+
+    def _run(self, tree, post, transport=None, est_b: int = 0):
         import jax
         try:
             t0 = _now_ns()
@@ -270,6 +293,7 @@ class StreamingPipeline:
                         + st.get("bytes", 0))
             return out
         finally:
+            self._account_done(est_b)
             if self.gate is not None:
                 self.gate.release()
             self._sem.release()

@@ -751,11 +751,16 @@ class QueryExecutor:
             rows = [[c.qid, c.text, c.db, f"{c.duration_s:.3f}s",
                      getattr(c, "state", "running"),
                      round(getattr(c, "queue_ns", 0) / 1e6, 3),
-                     round(getattr(c, "device_ns", 0) / 1e6, 3)]
+                     round(getattr(c, "device_ns", 0) / 1e6, 3),
+                     # measured device-resource columns (observatory):
+                     # shed/kill decisions can cite measured-vs-budget
+                     round(getattr(c, "hbm_peak", 0) / 1e6, 3),
+                     round(getattr(c, "d2h_bytes", 0) / 1e6, 3)]
                     for c in qm.list()] if qm else []
             return _series("queries",
                            ["qid", "query", "database", "duration",
-                            "status", "queue_ms", "device_ms"], rows)
+                            "status", "queue_ms", "device_ms",
+                            "hbm_peak_mb", "d2h_mb"], rows)
         if stmt.what == "subscriptions":
             if self.catalog is None:
                 return {"error": "meta catalog is not available"}
@@ -1505,7 +1510,8 @@ class QueryExecutor:
         # OG_PIPELINE_DEPTH bounds in-flight launches, 0 restores the
         # single-barrier path (bit-identical either way — enforced by
         # scripts/perf_smoke.sh)
-        pipe = _pl.StreamingPipeline(gate=_sched_gate(), span=span) \
+        pipe = _pl.StreamingPipeline(gate=_sched_gate(), span=span,
+                                     ctx=ctx) \
             if _pl.pipeline_depth() > 0 else None
         n_stream = 0          # streamed packed-grid launches
         n_lat_stream = 0      # streamed lattice launches (fold in post)
@@ -2875,8 +2881,21 @@ class QueryExecutor:
         _dstat.bump_phase("device_agg", _now_ns() - _t_dev0)
         if ctx is not None and hasattr(ctx, "add_device_ns"):
             # per-query device wall (dispatch through pull) for SHOW
-            # QUERIES' device_ms column
+            # QUERIES' device_ms column, plus measured D2H bytes and
+            # result cells for the observatory columns + scheduler
+            # estimate-vs-actual calibration
             ctx.add_device_ns(_now_ns() - _t_dev0)
+            if hasattr(ctx, "add_d2h"):
+                # _q_pull covers the batched/barrier pulls, pipe.bytes
+                # the streamed ones, repair rides _q_tx — the same sum
+                # the last_query_d2h_bytes gauge reports
+                with _q_tx["lock"]:
+                    _rep = _q_tx.get("repair", 0)
+                ctx.add_d2h(int(_q_pull.get("bytes", 0))
+                            + (pipe.bytes if pipe is not None else 0)
+                            + _rep)
+            if hasattr(ctx, "add_cells"):
+                ctx.add_cells(G * W)
         if dev_sp is not None:
             dev_sp.end_ns = _now_ns()
             dev_sp.add(rows=n_rows, padded=npad, segments=num_segments,

@@ -36,6 +36,15 @@ class QueryContext:
         self.queue_ns = 0           # wall spent awaiting a slot
         self.device_ns = 0          # wall inside device dispatch+pull
         self.cost_cells = 0         # admission cost estimate
+        # measured device-resource actuals (device observatory): the
+        # streaming pipeline attributes in-flight result bytes here
+        # (live/peak) and the executor books per-query D2H bytes and
+        # result cells — SHOW QUERIES' hbm_peak_mb/d2h_mb columns and
+        # the scheduler's estimate-vs-actual calibration read these
+        self.hbm_live = 0           # in-flight launch-buffer bytes
+        self.hbm_peak = 0           # high-watermark of hbm_live
+        self.d2h_bytes = 0          # measured device→host pull bytes
+        self.actual_cells = 0       # measured result-grid cells
         self._killed = threading.Event()
 
     def mark_queued(self) -> None:
@@ -50,6 +59,25 @@ class QueryContext:
         # executor may add from the query thread and pull workers
         with self._dev_lock:
             self.device_ns += int(ns)
+
+    def add_hbm(self, nbytes: int) -> None:
+        """Pipeline submit: this query's in-flight launch buffers."""
+        with self._dev_lock:
+            self.hbm_live += int(nbytes)
+            if self.hbm_live > self.hbm_peak:
+                self.hbm_peak = self.hbm_live
+
+    def sub_hbm(self, nbytes: int) -> None:
+        with self._dev_lock:
+            self.hbm_live = max(0, self.hbm_live - int(nbytes))
+
+    def add_d2h(self, nbytes: int) -> None:
+        with self._dev_lock:
+            self.d2h_bytes += int(nbytes)
+
+    def add_cells(self, n: int) -> None:
+        with self._dev_lock:
+            self.actual_cells += int(n)
 
     _dev_lock = threading.Lock()    # class-level: contexts are short-
     # lived and the add is rare (a few per query)

@@ -59,14 +59,17 @@ from collections import deque
 from concurrent.futures import Future
 
 from ..utils import deadline as _deadline
-from ..utils import knobs
+from ..utils import get_logger, knobs
 from ..utils.errors import ErrQueryError, ErrQueryTimeout
 from ..utils.lockrank import (RANK_SCHED, RANK_SCHED_HANDLE,
                               RankedLock)
 
+log = get_logger(__name__)
+
 __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
            "get_scheduler", "estimate_request_cost",
-           "pull_bytes_per_cell", "sched_collector"]
+           "pull_bytes_per_cell", "sched_collector", "calib_mode",
+           "calib_record", "calib_apply"]
 
 
 def enabled() -> bool:
@@ -171,6 +174,12 @@ SCHED_STATS: dict = register_counters("scheduler", {
     "coalesced_dispatches": 0,  # multi-launch dispatch windows
     "singleflight_leaders": 0,
     "singleflight_hits": 0,    # followers served by a leader's fill
+    # cost-model calibration (device observatory): silent estimate
+    # failures are now counted+logged, and completed queries feed
+    # estimate-vs-actual records (OG_SCHED_CALIB)
+    "estimate_failed": 0,      # _estimate_select_cells raised
+    "calib_records": 0,        # estimate-vs-actual records taken
+    "calib_applied": 0,        # admissions that used a learned bias
 })
 
 
@@ -190,6 +199,57 @@ from ..utils.stats import register_histograms  # noqa: E402
 SCHED_HIST: dict = register_histograms("scheduler", {
     "queue_wait_ms": Histogram(exp_bounds(0.25, 1 << 20)),
 })
+
+# estimate-error distributions (cost-model calibration): actual/estimate
+# ratios per admission dimension — 1.0 is a perfect model, the tails
+# say how wrong admission charges get. device_ms_per_mcell is the
+# implicit service-time model (wall per million result cells) the
+# retry hints and future placement decisions can read.
+CALIB_HIST: dict = register_histograms("sched_calib", {
+    "cells_ratio": Histogram(exp_bounds(1.0 / 64, 64.0)),
+    "pull_bytes_ratio": Histogram(exp_bounds(1.0 / 64, 64.0)),
+    "hbm_ratio": Histogram(exp_bounds(1.0 / 64, 64.0)),
+    "device_ms_per_mcell": Histogram(exp_bounds(0.25, 1 << 20)),
+})
+
+
+def calib_mode() -> str:
+    """OG_SCHED_CALIB tri-state: '0' off (PR 4 byte-identical),
+    'record' estimate-vs-actual recording only (default), '1' record
+    AND apply the learned per-class bias to admission charges."""
+    raw = str(knobs.get("OG_SCHED_CALIB")).strip().lower()
+    if raw in ("0", "off", "false"):
+        return "0"
+    if raw in ("1", "on", "true", "apply"):
+        return "1"
+    return "record"
+
+
+def calib_record() -> bool:
+    return calib_mode() != "0"
+
+
+def calib_apply() -> bool:
+    return calib_mode() == "1"
+
+
+# cost classes: estimate-error bias is learned PER CLASS because the
+# model is wrong in class-specific ways (dashboards over-estimate via
+# the windowed-W guess; monsters under-estimate pull bytes when the
+# finalize diet is off). Bounds are estimated result cells.
+_CALIB_CLASSES = (("dash", 100_000), ("mid", 2_000_000),
+                  ("heavy", None))
+
+
+def _cost_class(cells: int) -> str:
+    for name, hi in _CALIB_CLASSES:
+        if hi is None or cells < hi:
+            return name
+    return _CALIB_CLASSES[-1][0]
+
+
+_CALIB_EWMA_ALPHA = 0.2          # ~5-sample memory
+_CALIB_BIAS_CLAMP = 4.0          # |log2 bias| cap: 1/16x .. 16x
 
 
 class _Entry:
@@ -214,15 +274,22 @@ class _Ticket:
     """Held admission slot; release() returns it (context-manager too).
     Idempotent — the HTTP finally-path may race a handler error."""
 
-    def __init__(self, sched: "QueryScheduler", cost: QueryCost):
+    def __init__(self, sched: "QueryScheduler", cost: QueryCost,
+                 raw_cost: QueryCost | None = None):
         self._sched = sched
-        self._cost = cost
+        self.cost = cost           # granted charge — release() must
+        # return exactly what admission took
+        # raw (pre-correction) estimate: calibration grades actuals
+        # against THIS. Grading against the corrected charge would
+        # learn log2(actual/corrected) — the bias would then chase
+        # sqrt of the true error and oscillate instead of converging.
+        self.raw_cost = raw_cost if raw_cost is not None else cost
         self._done = False
 
     def release(self) -> None:
         if not self._done:
             self._done = True
-            self._sched._release(self._cost)
+            self._sched._release(self.cost)
 
     def __enter__(self):
         return self
@@ -260,6 +327,14 @@ class QueryScheduler:
         # singleflight: key → [event, result, None] in-flight table
         self._sf: dict = {}
         self._pipe_gate: threading.BoundedSemaphore | None = None
+        self._pipe_depth = 0
+        # cost-model calibration: per-class EWMA of log2(actual/est)
+        # plus a bounded ring of recent records (/debug/scheduler)
+        self._calib: dict[str, dict] = {
+            name: {"n": 0, "ewma_log2_cells": 0.0,
+                   "ewma_log2_pull": 0.0}
+            for name, _hi in _CALIB_CLASSES}
+        self._calib_ring: deque = deque(maxlen=32)
 
     # ------------------------------------------------------- admission
 
@@ -300,6 +375,13 @@ class QueryScheduler:
         request finishes). Raises SchedShed (429/503), ErrQueryTimeout
         (deadline spent while queued) or the ctx's kill error."""
         cost = cost or QueryCost(_DEFAULT_CELLS)
+        raw_cost = cost
+        raw_cells = cost.cells
+        if calib_apply():
+            # learned estimate-error bias scales the admission charge
+            # (OG_SCHED_CALIB=1; '0'/'record' leave charges exactly as
+            # PR 4 computed them)
+            cost = self.corrected_cost(cost)
         timeout = self.timeout_s if timeout_s is None else timeout_s
         dl = _deadline.current()
         if dl is not None:
@@ -310,10 +392,16 @@ class QueryScheduler:
         if self.max_cells and cost.cells > self.max_cells:
             _bump("shed")
             _bump("shed_over_budget")
+            calib_note = ""
+            if cost.cells != raw_cells:
+                calib_note = (f" (raw estimate {raw_cells}, learned "
+                              f"bias x{cost.cells / max(1, raw_cells):.2f}"
+                              " from measured actuals)")
             raise SchedShed(
-                f"query estimated at {cost.cells} result cells exceeds "
-                f"the admission budget ({self.max_cells}); narrow the "
-                "time range or grouping", http_code=429,
+                f"query estimated at {cost.cells} result cells"
+                f"{calib_note} exceeds the admission budget "
+                f"({self.max_cells}); narrow the time range or "
+                "grouping", http_code=429,
                 retry_after_s=self._retry_after())
         with self._lock:
             if self.paused or self.draining:
@@ -331,7 +419,7 @@ class QueryScheduler:
                 if ctx is not None and hasattr(ctx, "mark_running"):
                     ctx.mark_running(0)
                 _observe(SCHED_HIST, "queue_wait_ms", 0.0)
-                return _Ticket(self, cost)
+                return _Ticket(self, cost, raw_cost)
             if len(self._heap) >= self.max_queued:
                 _bump("shed")
                 _bump("shed_queue_full")
@@ -344,9 +432,10 @@ class QueryScheduler:
             _bump("queued_total")
             if ctx is not None and hasattr(ctx, "mark_queued"):
                 ctx.mark_queued()
-        return self._wait(ent, timeout)
+        return self._wait(ent, timeout, raw_cost)
 
-    def _wait(self, ent: _Entry, timeout: float) -> _Ticket:
+    def _wait(self, ent: _Entry, timeout: float,
+              raw_cost: QueryCost | None = None) -> _Ticket:
         t0 = time.monotonic()
         dl = _deadline.current()
         while True:
@@ -357,7 +446,7 @@ class QueryScheduler:
                 if ent.ctx is not None and hasattr(ent.ctx,
                                                    "mark_running"):
                     ent.ctx.mark_running(wait_ns)
-                return _Ticket(self, ent.cost)
+                return _Ticket(self, ent.cost, raw_cost)
             if ent.ctx is not None and getattr(ent.ctx, "killed", False):
                 if self._cancel(ent):
                     _bump("ejected_killed")
@@ -466,9 +555,10 @@ class QueryScheduler:
         HBM, this bounds the sum (OG_SCHED_DEPTH)."""
         with self._lock:
             if self._pipe_gate is None:
-                depth = int(knobs.get("OG_SCHED_DEPTH"))
+                self._pipe_depth = max(
+                    1, int(knobs.get("OG_SCHED_DEPTH")))
                 self._pipe_gate = threading.BoundedSemaphore(
-                    max(1, depth))
+                    self._pipe_depth)
             return self._pipe_gate
 
     def launch(self, kind: str, fn):
@@ -574,6 +664,157 @@ class QueryScheduler:
                         "vtime": round(self._vtime, 3)})
         return out
 
+    def util_gauges(self) -> dict:
+        """Light live gauges for the utilization sampler (ops/hbm.py):
+        active/queued/launch-queue depth plus the OG_SCHED_DEPTH gate
+        occupancy — cheaper than snapshot() (no counter copy) because
+        it runs every OG_DEVUTIL_MS."""
+        with self._lock:
+            out = {"sched_active": self._active,
+                   "wfq_queued": len(self._heap),
+                   "launch_queue": len(self._dq)}
+            gate, depth = self._pipe_gate, self._pipe_depth
+        if gate is not None:
+            # _value is a racy read — fine for a gauge: a sample may
+            # be one permit stale, never torn
+            out["gate_in_use"] = max(0, depth - gate._value)
+            out["gate_depth"] = depth
+        return out
+
+    # ------------------------------------------ cost-model calibration
+
+    def record_actual(self, cost: QueryCost | None, cells: int = 0,
+                      pull_bytes: int = 0, device_ms: float = 0.0,
+                      hbm_peak: int = 0) -> None:
+        """Feed one completed query's measured actuals back against
+        its admission estimate: estimate-error histograms (CALIB_HIST)
+        and the per-class EWMA bias OG_SCHED_CALIB=1 applies to future
+        admission charges. No-op when OG_SCHED_CALIB=0 (the PR 4
+        byte-identity gate) or when there was no estimate to grade."""
+        if cost is None or calib_mode() == "0":
+            return
+        est_cells = int(cost.cells)
+        rec = {"ts": time.time(), "est_cells": est_cells,
+               "actual_cells": int(cells),
+               "est_pull_bytes": int(cost.pull_bytes),
+               "actual_pull_bytes": int(pull_bytes),
+               "est_hbm_bytes": int(cost.hbm_bytes),
+               "actual_hbm_bytes": int(hbm_peak),
+               "device_ms": round(float(device_ms), 3)}
+        if est_cells <= 0 or cells <= 0:
+            # nothing to grade (non-SELECT, unknown plan, host-only
+            # path that never built a grid) — keep the ring honest
+            # about it but leave the model alone
+            rec["graded"] = False
+            with self._lock:
+                self._calib_ring.append(rec)
+            return
+        rec["graded"] = True
+        cls = _cost_class(est_cells)
+        rec["cls"] = cls
+        r_cells = cells / est_cells
+        _observe(CALIB_HIST, "cells_ratio", r_cells)
+        if cost.pull_bytes > 0 and pull_bytes > 0:
+            _observe(CALIB_HIST, "pull_bytes_ratio",
+                     pull_bytes / cost.pull_bytes)
+        if cost.hbm_bytes > 0 and hbm_peak > 0:
+            _observe(CALIB_HIST, "hbm_ratio",
+                     hbm_peak / cost.hbm_bytes)
+        if device_ms > 0:
+            _observe(CALIB_HIST, "device_ms_per_mcell",
+                     device_ms / (cells / 1e6))
+        lg_cells = max(-_CALIB_BIAS_CLAMP,
+                       min(_CALIB_BIAS_CLAMP, math.log2(r_cells)))
+        lg_pull = None
+        if cost.pull_bytes > 0 and pull_bytes > 0:
+            lg_pull = max(-_CALIB_BIAS_CLAMP,
+                          min(_CALIB_BIAS_CLAMP,
+                              math.log2(pull_bytes
+                                        / cost.pull_bytes)))
+        with self._lock:
+            c = self._calib[cls]
+            a = _CALIB_EWMA_ALPHA
+            c["n"] += 1
+            c["ewma_log2_cells"] += a * (lg_cells
+                                         - c["ewma_log2_cells"])
+            if lg_pull is not None:
+                c["ewma_log2_pull"] += a * (lg_pull
+                                            - c["ewma_log2_pull"])
+            self._calib_ring.append(rec)
+        _bump("calib_records")
+
+    def record_ctx(self, ticket: _Ticket | None, ctx) -> None:
+        """Grade one completed request's ctx-measured actuals against
+        its ticket's RAW admission estimate — the shared completion
+        hook of the /query and flux paths. Never raises into the
+        caller's finally block; no-op when nothing was admitted, no
+        ctx was attached, or OG_SCHED_CALIB=0."""
+        if ticket is None or ctx is None:
+            return
+        try:
+            self.record_actual(ticket.raw_cost,
+                               cells=ctx.actual_cells,
+                               pull_bytes=ctx.d2h_bytes,
+                               device_ms=ctx.device_ns / 1e6,
+                               hbm_peak=ctx.hbm_peak)
+        except Exception:
+            log.exception("calibration record failed")
+
+    def calib_factor(self, cells: int) -> float:
+        """Learned multiplicative bias for an estimate of ``cells``
+        result cells (1.0 until that class has records)."""
+        cls = _cost_class(int(cells))
+        with self._lock:
+            c = self._calib[cls]
+            if c["n"] == 0:
+                return 1.0
+            return float(2.0 ** c["ewma_log2_cells"])
+
+    def corrected_cost(self, cost: QueryCost) -> QueryCost:
+        """Bias-corrected admission charge (OG_SCHED_CALIB=1). The
+        correction is per cost class and clamped (1/16x..16x); a class
+        with no records passes through unchanged."""
+        if cost.cells <= 0:
+            return cost
+        cls = _cost_class(cost.cells)
+        with self._lock:
+            c = self._calib[cls]
+            if c["n"] == 0:
+                return cost
+            f_cells = float(2.0 ** c["ewma_log2_cells"])
+            f_pull = float(2.0 ** c["ewma_log2_pull"])
+        if abs(f_cells - 1.0) < 1e-9 and abs(f_pull - 1.0) < 1e-9:
+            return cost
+        _bump("calib_applied")
+        return QueryCost(int(round(cost.cells * f_cells)),
+                         int(round(cost.pull_bytes * f_pull)),
+                         int(round(cost.hbm_bytes * f_cells)))
+
+    def calibration_snapshot(self) -> dict:
+        """Cost-model calibration state for /debug/scheduler: mode,
+        per-class bias, recent estimate-vs-actual records and the
+        estimate-error histogram tails."""
+        with self._lock:
+            classes = {
+                name: {"n": c["n"],
+                       "bias_cells_x": round(
+                           2.0 ** c["ewma_log2_cells"], 4),
+                       "bias_pull_x": round(
+                           2.0 ** c["ewma_log2_pull"], 4),
+                       "ewma_log2_cells": round(
+                           c["ewma_log2_cells"], 4)}
+                for name, c in self._calib.items()}
+            recent = list(self._calib_ring)
+        hists = {}
+        for key, h in CALIB_HIST.items():
+            s = h.snapshot()
+            hists[key] = {"count": s["count"]}
+            if s["count"]:
+                hists[key]["p50"] = round(h.quantile(0.5, s), 4)
+                hists[key]["p99"] = round(h.quantile(0.99, s), 4)
+        return {"mode": calib_mode(), "classes": classes,
+                "recent": recent, "error_hist": hists}
+
 
 # ------------------------------------------------------ cost estimate
 
@@ -594,7 +835,18 @@ def estimate_request_cost(executor, stmts, db: str | None) -> QueryCost:
         seen_select = True
         try:
             c = _estimate_select_cells(executor, stmt, db)
-        except Exception:
+        except Exception as e:
+            # estimation must never fail admission — but a silent
+            # fallback to dashboard weight let a broken estimator park
+            # monsters at the front of the WFQ for months unnoticed;
+            # count it and name the statement
+            _bump("estimate_failed")
+            log.debug(
+                "estimate_request_cost failed (db=%s, measurement=%s,"
+                " stmt=%.200r): %s — admitting at the default "
+                "dashboard cost (%d cells)", db,
+                getattr(stmt, "from_measurement", "?"), stmt, e,
+                _DEFAULT_CELLS, exc_info=True)
             c = _DEFAULT_CELLS
         cells += c
         pull_b += c * _stmt_pull_rate(stmt)

@@ -282,6 +282,23 @@ register("OG_SCHED_MAX_CELLS", str, "",
 register("OG_SCHED_DEPTH", int, 8,
          "global in-flight streamed-launch bound across all queries")
 
+# --- device resource observatory (ops/hbm.py, query/scheduler.py)
+register("OG_DEVUTIL_MS", float, 1000.0,
+         "utilization-timeline sampler interval (ms) for the device "
+         "observatory (/debug/device); <= 0 disables sampling")
+register("OG_DEVUTIL_RING", int, 512,
+         "samples kept in the utilization-timeline ring")
+register("OG_HBM_EVENTS", int, 256,
+         "eviction-pressure events kept in the HBM ledger ring")
+register("OG_HBM_DRIFT_PCT", float, 25.0,
+         "reconcile tolerance: tracked-vs-backend HBM drift beyond "
+         "max(64MiB, this percent) flags and counts")
+register("OG_SCHED_CALIB", str, "record",
+         "scheduler cost-model calibration: `0` = off (PR 4 "
+         "byte-identical), `record` = record estimate-vs-actual "
+         "only, `1` = also apply the learned per-class bias to "
+         "admission charges")
+
 # --- flight recorder / tracing (utils/tracing.py, http/server.py)
 register("OG_TRACE_SAMPLE", float, 0.05,
          "head-sampling probability for the query/write flight "
@@ -293,6 +310,10 @@ register("OG_TRACE_RING", int, 64,
 register("OG_SMOKE_TRACE_OVERHEAD_PCT", float, 3.0,
          "perf_smoke tracing gate: max e2e overhead (percent) of a "
          "live span tree vs untraced on the 1h shape")
+register("OG_SMOKE_OBS_OVERHEAD_PCT", float, 3.0,
+         "perf_smoke observatory gate: max e2e overhead (percent) of "
+         "the fast-ticking utilization sampler + ctx attribution + "
+         "calibration recording vs the plain path on the 1h shape")
 register("OG_SLOW_QUERY_MS", float, 0.0,
          "slow-query threshold in ms (logged + kept in the slow "
          "trace ring); 0 = use [http] slow_query_threshold from "

@@ -28,7 +28,7 @@ forever. This module turns the convention into a checked invariant:
 
 Ranks (gaps left for future locks):
     SCHED_HANDLE(5) < SCHED(10) < DEVCACHE_FILL(15) < DEVCACHE(20)
-    < PIPELINE_POOL(25) < PIPELINE(30) < STATS(40)
+    < PIPELINE_POOL(25) < PIPELINE(30) < HBM(35) < STATS(40)
 """
 
 from __future__ import annotations
@@ -37,8 +37,8 @@ import threading
 
 __all__ = ["RANK_SCHED_HANDLE", "RANK_SCHED", "RANK_DEVCACHE_FILL",
            "RANK_DEVCACHE", "RANK_PIPELINE_POOL", "RANK_PIPELINE",
-           "RANK_STATS", "LockRankError", "RankedLock", "RankedRLock",
-           "enable", "enabled", "held_ranks"]
+           "RANK_HBM", "RANK_STATS", "LockRankError", "RankedLock",
+           "RankedRLock", "enable", "enabled", "held_ranks"]
 
 RANK_SCHED_HANDLE = 5     # scheduler singleton construction
 RANK_SCHED = 10           # QueryScheduler._lock (admission + dispatch)
@@ -46,6 +46,8 @@ RANK_DEVCACHE_FILL = 15   # decoded-plane base-fill stripes
 RANK_DEVCACHE = 20        # DeviceBlockCache._lock (HBM + host tiers)
 RANK_PIPELINE_POOL = 25   # shared pull-pool construction
 RANK_PIPELINE = 30        # StreamingPipeline._lock (per-query)
+RANK_HBM = 35             # ops/hbm.py HBMLedger (called from cache/
+# pipeline critical sections; may still bump the innermost stats)
 RANK_STATS = 40           # utils.stats.COUNTER_LOCK — innermost
 
 

@@ -186,10 +186,17 @@ class Histogram:
     snapshot() merges the stripes under all stripe locks. Counts are
     cumulative like Prometheus buckets are NOT — snapshot() returns
     per-bucket counts and the exporter accumulates the `le` form.
+
+    Exemplars: a flight-recorder-sampled observation may carry its
+    trace id; the last one lands per bucket (value, trace_id, unix ts)
+    and the OpenMetrics exposition attaches it to that bucket line —
+    a slow bucket links straight to /debug/trace?id=<trace_id>. Only
+    sampled requests pay the (single-lock) exemplar write; the hot
+    unsampled path is untouched.
     """
 
     N_STRIPES = 8
-    __slots__ = ("bounds", "_stripes")
+    __slots__ = ("bounds", "_stripes", "_ex_lock", "_exemplars")
 
     def __init__(self, bounds):
         self.bounds = tuple(float(b) for b in bounds)
@@ -200,12 +207,14 @@ class Histogram:
             {"lock": threading.Lock(), "counts": [0] * nb,
              "sum": 0.0, "count": 0}
             for _ in range(self.N_STRIPES)]
+        self._ex_lock = threading.Lock()
+        self._exemplars: dict[int, tuple] = {}    # bucket → (v, tid, ts)
 
     def _bucket(self, v: float) -> int:
         from bisect import bisect_left
         return bisect_left(self.bounds, v)
 
-    def observe(self, v) -> None:
+    def observe(self, v, trace_id: str | None = None) -> None:
         v = float(v)
         i = self._bucket(v)
         # get_ident() on Linux is a pthread struct address, 64-byte
@@ -218,6 +227,21 @@ class Histogram:
             st["counts"][i] += 1
             st["sum"] += v
             st["count"] += 1
+        if trace_id:
+            # in-bucket by construction (stored per bucket index), as
+            # the OpenMetrics spec wants histogram exemplars to be.
+            # Trace ids are client-forceable (X-OG-Trace): restrict to
+            # a label-safe charset HERE so a hostile id can never
+            # forge or break exposition lines downstream.
+            import re
+            tid = re.sub(r"[^A-Za-z0-9_.:-]", "_",
+                         str(trace_id))[:64]
+            with self._ex_lock:
+                self._exemplars[i] = (v, tid, time.time())
+
+    def exemplars(self) -> dict[int, tuple]:
+        with self._ex_lock:
+            return dict(self._exemplars)
 
     def snapshot(self) -> dict:
         nb = len(self.bounds) + 1
@@ -255,6 +279,8 @@ class Histogram:
                 st["counts"] = [0] * (len(self.bounds) + 1)
                 st["sum"] = 0.0
                 st["count"] = 0
+        with self._ex_lock:
+            self._exemplars.clear()
 
 
 # Registry of every shared histogram dict, parallel to
@@ -281,29 +307,50 @@ def register_histograms(name: str, histos: dict) -> dict:
     return histos
 
 
-def observe(histos: dict, key: str, v) -> None:
+def observe(histos: dict, key: str, v,
+            trace_id: str | None = None) -> None:
     """Record one observation into a registered histogram dict —
     KeyError on an undeclared metric name (the runtime twin of oglint
-    R605: a typo'd key must fail loudly, not mint a hidden series)."""
-    histos[key].observe(v)
+    R605: a typo'd key must fail loudly, not mint a hidden series).
+    ``trace_id`` attaches a flight-recorder exemplar (OpenMetrics
+    exposition links the bucket to /debug/trace?id=)."""
+    histos[key].observe(v, trace_id=trace_id)
 
 
-def histograms_prometheus(prefix: str = "opengemini") -> list[str]:
-    """Prometheus histogram text exposition of every registered
-    histogram: `_bucket{le=...}` (cumulative), `_sum`, `_count`."""
+def _exemplar_suffix(ex: tuple | None) -> str:
+    """OpenMetrics exemplar clause for one bucket line:
+    ` # {trace_id="…"} value timestamp`."""
+    if ex is None:
+        return ""
+    v, tid, ts = ex
+    return f' # {{trace_id="{tid}"}} {v:g} {ts:.3f}'
+
+
+def histograms_prometheus(prefix: str = "opengemini",
+                          openmetrics: bool = False) -> list[str]:
+    """Histogram text exposition of every registered histogram:
+    `_bucket{le=...}` (cumulative), `_sum`, `_count`, each family with
+    a HELP/TYPE pair. ``openmetrics=True`` emits the OpenMetrics 1.0
+    dialect: trace-id exemplars ride the bucket lines (the classic
+    Prometheus text format has no exemplar syntax — they are only
+    emitted here)."""
     lines: list[str] = []
     for grp in sorted(HISTOGRAM_REGISTRY):
         for key in sorted(HISTOGRAM_REGISTRY[grp]):
             h = HISTOGRAM_REGISTRY[grp][key]
             s = h.snapshot()
+            exs = h.exemplars() if openmetrics else {}
             name = f"{prefix}_{grp}_{key}"
+            lines.append(f"# HELP {name} {grp} {key} distribution")
             lines.append(f"# TYPE {name} histogram")
             cum = 0
-            for b, c in zip(h.bounds, s["counts"]):
+            for i, (b, c) in enumerate(zip(h.bounds, s["counts"])):
                 cum += c
                 le = f"{b:g}"
-                lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {s["count"]}')
+                lines.append(f'{name}_bucket{{le="{le}"}} {cum}'
+                             + _exemplar_suffix(exs.get(i)))
+            lines.append(f'{name}_bucket{{le="+Inf"}} {s["count"]}'
+                         + _exemplar_suffix(exs.get(len(h.bounds))))
             lines.append(f'{name}_sum {s["sum"]:g}')
             lines.append(f'{name}_count {s["count"]}')
     return lines
@@ -424,6 +471,15 @@ def scheduler_collector():
     for /metrics, /debug/vars and the pusher."""
     from ..query.scheduler import sched_collector
     return sched_collector()
+
+
+def hbm_collector():
+    """Device resource observatory metrics (ops/hbm.py): per-tier HBM
+    ledger bytes / high-watermarks / entry counts plus pressure and
+    reconcile counters — the global device-residency view next to the
+    per-cache devicecache stats."""
+    from ..ops.hbm import collector
+    return collector()
 
 
 def wal_collector():

@@ -56,10 +56,20 @@ assert r.get("value") == 1, r
 assert r.get("cells_checked", 0) > 0, r
 assert "trace-on" in r.get("configs", []), r
 assert "trace_overhead_pct" in r, r
+# device observatory gate (PR 8): byte-identical digests with the
+# ledger+sampler live, exact ledger reconciliation, a populated
+# utilization ring, and a bounded e2e overhead
+assert "observatory" in r.get("configs", []), r
+assert r.get("obs_ledger_reconciled") == 1, r
+assert r.get("obs_util_samples", 0) > 0, r
+assert "obs_overhead_pct" in r, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
       f"(on {r['trace_e2e_on_ms']}ms vs off {r['trace_e2e_off_ms']}ms)")
+print(f"observatory gate OK: overhead {r['obs_overhead_pct']}% "
+      f"(on {r['obs_e2e_on_ms']}ms), ledger reconciled, "
+      f"{r['obs_util_samples']} util samples")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

@@ -1,0 +1,784 @@
+"""Device resource observatory (ops/hbm.py + scheduler calibration):
+HBM ledger double-entry accounting mirrored from the device caches and
+the streaming pipeline (exact cross-check under jax.transfer_guard),
+backend reconciliation, the utilization-timeline sampler + Chrome
+counter export, scheduler cost-model calibration (estimate-vs-actual
+recording, per-class bias, OG_SCHED_CALIB tri-state byte-identity),
+the estimate_failed satellite, /metrics + OpenMetrics conformance
+(TYPE/HELP pairing, bucket monotonicity, exemplars), and the
+ts-monitor round-trip of the new ledger gauges."""
+
+import json
+import math
+import re
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import hbm
+from opengemini_tpu.ops.devicecache import DeviceBlockCache
+from opengemini_tpu.ops.hbm import HBMLedger, UtilizationSampler
+from opengemini_tpu.query.scheduler import (CALIB_HIST, QueryCost,
+                                            QueryScheduler, SCHED_STATS,
+                                            SchedShed,
+                                            estimate_request_cost)
+from opengemini_tpu.utils.stats import (Histogram, exp_bounds,
+                                        histograms_prometheus)
+
+MIN = 60 * 10**9
+
+
+# ---------------------------------------------------- ledger unit
+
+
+def test_ledger_account_release_hwm():
+    led = HBMLedger(event_cap=16)
+    led.account("device_cache", 1000)
+    led.account("device_cache", 500)
+    led.account("pipeline", 200)
+    s = led.snapshot()
+    t = s["tiers"]["device_cache"]
+    assert t["bytes"] == 1500 and t["n"] == 2
+    assert t["hwm_bytes"] == 1500 and t["accounted_bytes"] == 1500
+    assert s["total_bytes"] == 1700 and s["total_hwm_bytes"] == 1700
+    led.release("device_cache", 1500, n=2)
+    led.release("pipeline", 200)
+    s = led.snapshot()
+    assert s["total_bytes"] == 0
+    # high-watermarks survive the release
+    assert s["tiers"]["device_cache"]["hwm_bytes"] == 1500
+    assert s["total_hwm_bytes"] == 1700
+
+
+def test_ledger_unknown_tier_raises():
+    led = HBMLedger(event_cap=16)
+    with pytest.raises(KeyError, match="unknown HBM ledger tier"):
+        led.account("nope", 1)
+
+
+def test_ledger_underflow_clamps_and_counts():
+    led = HBMLedger(event_cap=16)
+    before = dict(hbm.HBM_STATS)
+    led.account("pipeline", 100)
+    led.release("pipeline", 999)        # double-release analog
+    assert led.tier_bytes("pipeline") == 0
+    assert led.tier_count("pipeline") == 0
+    assert hbm.HBM_STATS["underflow_clamps"] \
+        == before["underflow_clamps"] + 1
+
+
+def test_ledger_pressure_ring_bounded():
+    led = HBMLedger(event_cap=16)
+    for i in range(40):
+        led.pressure("device_cache", i, "lru_eviction")
+    evs = led.snapshot()["events"]
+    assert len(evs) == 16
+    assert evs[-1]["bytes"] == 39 and evs[-1]["reason"] == "lru_eviction"
+    assert all(e["tier"] == "device_cache" for e in evs)
+
+
+# ------------------------------------ cache mirroring (double entry)
+
+
+def _mirrored_cache(cap=10_000):
+    led = HBMLedger(event_cap=64)
+    c = DeviceBlockCache(cap, tier="device_cache", ledger=led)
+    return c, led
+
+
+def _in_sync(c, led):
+    return led.tier_bytes("device_cache") == c.stats()["bytes"] \
+        and led.tier_count("device_cache") == c.stats()["entries"]
+
+
+def test_cache_put_evict_purge_mirror_exactly():
+    c, led = _mirrored_cache(cap=1000)
+    c.put_sized(("a",), object(), 400)          # 464 charged
+    c.put_sized(("b",), object(), 400)
+    assert _in_sync(c, led)
+    # third entry evicts the LRU one and logs pressure
+    c.put_sized(("c",), object(), 400)
+    assert c.stats()["evictions"] >= 1
+    assert _in_sync(c, led)
+    evs = led.snapshot()["events"]
+    assert any(e["reason"] == "lru_eviction" for e in evs)
+    # replacement releases the old charge
+    c.put_sized(("c",), object(), 100)
+    assert _in_sync(c, led)
+    c.purge()
+    assert c.stats()["bytes"] == 0 and _in_sync(c, led)
+
+
+def test_cache_over_capacity_put_is_pressure_not_leak():
+    c, led = _mirrored_cache(cap=100)
+    c.put_sized(("big",), object(), 10_000)     # rejected at admission
+    assert c.stats()["bytes"] == 0 and _in_sync(c, led)
+    assert any(e["reason"] == "over_capacity"
+               for e in led.snapshot()["events"])
+
+
+def test_cache_reprice_mirrors_both_directions():
+    c, led = _mirrored_cache(cap=100_000)
+    c.put(("slabs",), [1, 2, 3])                # 64-byte placeholder
+    assert _in_sync(c, led)
+    c.reprice(("slabs",), 5000)                 # grow to real cost
+    assert c.stats()["bytes"] == 5064 and _in_sync(c, led)
+    c.reprice(("slabs",), 100)                  # shrink
+    assert c.stats()["bytes"] == 164 and _in_sync(c, led)
+    c.reprice(("missing",), 777)                # no entry: no-op
+    assert _in_sync(c, led)
+
+
+def test_unledgered_cache_stays_out_of_ledger():
+    """Ad-hoc caches (no tier) must not skew the device accounting."""
+    before = hbm.LEDGER.snapshot(events=False)["total_bytes"]
+    c = DeviceBlockCache(10_000)
+    c.put_sized(("x",), object(), 500)
+    assert hbm.LEDGER.snapshot(events=False)["total_bytes"] == before
+
+
+def test_cache_mirror_survives_threads():
+    c, led = _mirrored_cache(cap=4096)
+
+    def worker(i):
+        for j in range(50):
+            c.put_sized((i, j % 7), object(), 100 + (j % 5) * 64)
+            if j % 11 == 0:
+                c.reprice((i, j % 7), 300)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert _in_sync(c, led)
+
+
+# ------------------------- executor integration (transfer_guard gate)
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    """Fresh engine + executor with fresh global caches AND a zeroed
+    global ledger (the two must reset together — the ledger mirrors
+    the live cache singletons)."""
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor
+    from opengemini_tpu.storage import Engine, EngineOptions
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    hbm.LEDGER.reset()
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+    hbm.LEDGER.reset()
+
+
+def seed(eng, hosts=4, points=240):
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    rng = np.random.default_rng(23)
+    vals = rng.normal(40.0, 9.0, (hosts, points))
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            lines.append(
+                f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+
+
+Q_HIGH = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE time >= 0 "
+          "AND time < 2400s GROUP BY time(1m), host")
+
+
+def _exec(ex, text, ctx=None):
+    from opengemini_tpu.query import parse_query
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0", ctx=ctx)
+    assert "error" not in res, res
+    return res
+
+
+def test_ledger_reconciles_exactly_under_transfer_guard(db):
+    """Acceptance gate: after real dispatches (cold, then warm under
+    jax.transfer_guard) the ledger's device_cache/host_cache tiers
+    EQUAL the caches' own byte counts and the pipeline tier has fully
+    drained — double-entry, not an estimate."""
+    import jax
+    eng, ex = db
+    seed(eng)
+    _exec(ex, Q_HIGH)                   # cold: decode + upload + pulls
+    import opengemini_tpu.ops.devicecache as dc
+    if dc.enabled():
+        assert dc.global_cache().stats()["bytes"] > 0
+    with jax.transfer_guard("disallow"):
+        cross = hbm.cross_check()
+        assert cross["ok"], cross
+        assert cross["pipeline"]["ledger"] == 0
+        assert cross["pipeline"]["in_flight"] == 0
+    # warm replay must also leave the books balanced
+    _exec(ex, Q_HIGH)
+    cross = hbm.cross_check()
+    assert cross["ok"], cross
+    led = hbm.LEDGER.snapshot(events=False)
+    assert led["tiers"]["device_cache"]["bytes"] \
+        == dc.global_cache().stats()["bytes"]
+    assert led["tiers"]["host_cache"]["bytes"] \
+        == dc.host_cache().stats()["bytes"]
+
+
+def test_query_ctx_attribution_and_pipeline_drain(db):
+    """The query ctx carries measured actuals (D2H bytes, result
+    cells, in-flight HBM peak) and the pipeline tier returns to zero
+    when the query completes — the per-query share of the 'pipeline'
+    tier is exactly what SHOW QUERIES' hbm_peak_mb/d2h_mb report."""
+    from opengemini_tpu.query.manager import QueryManager
+    eng, ex = db
+    seed(eng)
+    qm = QueryManager()
+    ctx = qm.attach(Q_HIGH, "db0")
+    _exec(ex, Q_HIGH, ctx=ctx)
+    qm.detach(ctx)
+    assert ctx.actual_cells > 0
+    assert ctx.d2h_bytes > 0
+    assert ctx.hbm_peak >= 0 and ctx.hbm_live == 0
+    assert hbm.LEDGER.tier_bytes("pipeline") == 0
+    assert hbm.LEDGER.tier_count("pipeline") == 0
+
+
+# ------------------------------------------------------ reconciliation
+
+
+class _FakeDev:
+    def __init__(self, in_use):
+        self._b = in_use
+
+    def memory_stats(self):
+        return {"bytes_in_use": self._b, "bytes_limit": 1 << 34}
+
+    def __str__(self):
+        return "FakeTPU:0"
+
+
+def test_reconcile_flags_drift_beyond_tolerance(monkeypatch):
+    import jax
+    hbm.LEDGER.reset()
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev(5 << 30)])
+    before = dict(hbm.HBM_STATS)
+    out = hbm.reconcile()
+    assert out["backend"] == "memory_stats"
+    assert out["backend_bytes"] == 5 << 30
+    assert out["flagged"] is True
+    assert hbm.HBM_STATS["reconcile_flagged"] \
+        == before["reconcile_flagged"] + 1
+    assert hbm.HBM_STATS["reconcile_runs"] \
+        == before["reconcile_runs"] + 1
+    # drift lands in the pressure ring too
+    assert any(e["reason"] == "reconcile_drift"
+               for e in hbm.LEDGER.snapshot()["events"])
+    hbm.LEDGER.reset()
+
+
+def test_reconcile_in_tolerance_not_flagged(monkeypatch):
+    import jax
+    hbm.LEDGER.reset()
+    hbm.LEDGER.account("device_cache", 5 << 30)
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev(5 << 30)])
+    out = hbm.reconcile()
+    assert out["backend"] == "memory_stats"
+    assert out["drift_bytes"] == 0 and out["flagged"] is False
+    hbm.LEDGER.reset()
+
+
+def test_reconcile_without_backend_stats_says_so():
+    """CPU backend (no memory_stats): reconcile must answer honestly,
+    not invent numbers, and never raise."""
+    out = hbm.reconcile()
+    assert "tracked_device_bytes" in out
+    assert out["backend"] in ("unavailable", "memory_stats")
+
+
+# ------------------------------------------------ utilization sampler
+
+
+def test_sampler_ring_bounded_and_fields():
+    s = UtilizationSampler(ring=4)
+    for _ in range(9):
+        s.sample_once()
+    out = s.samples()
+    assert len(out) == 4
+    for smp in out:
+        assert set(smp) >= {"ts", "perf_ns", "tier_bytes",
+                            "total_bytes", "inflight_pulls"}
+        assert set(smp["tier_bytes"]) == set(hbm.TIERS)
+
+
+def test_sampler_thread_lifecycle(monkeypatch):
+    monkeypatch.setenv("OG_DEVUTIL_MS", "10")
+    s = UtilizationSampler(ring=64)
+    s.start()
+    assert s.running()
+    deadline = time.monotonic() + 5
+    while len(s.samples()) < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    s.stop()
+    assert not s.running()
+    n = len(s.samples())
+    assert n >= 3
+    time.sleep(0.05)
+    assert len(s.samples()) == n        # really stopped
+
+
+def test_sampler_includes_scheduler_gauges(monkeypatch):
+    import opengemini_tpu.query.scheduler as S
+    monkeypatch.setenv("OG_SCHED", "1")
+    monkeypatch.setattr(S, "_SCHED", None)
+    t = S.get_scheduler().admit(cost=QueryCost(10))
+    try:
+        smp = UtilizationSampler(ring=4).sample_once()
+        assert smp["sched_active"] == 1
+        assert smp["wfq_queued"] == 0
+    finally:
+        t.release()
+    monkeypatch.setattr(S, "_SCHED", None)
+
+
+def test_chrome_counter_export():
+    s = UtilizationSampler(ring=16)
+    for _ in range(3):
+        s.sample_once()
+        time.sleep(0.002)
+    evs = hbm.chrome_counter_events(s.samples())
+    assert evs[0]["ph"] == "M"          # process_name metadata
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert len(counters) == 6           # 2 tracks × 3 samples
+    ts = [e["ts"] for e in counters]
+    assert all(t >= 0 for t in ts) and ts == sorted(ts)
+    hbm_tracks = [e for e in counters if e["name"] == "hbm_bytes"]
+    assert all(set(e["args"]) >= set(hbm.TIERS) for e in hbm_tracks)
+    # a span-export base_ns shifts the shared clock zero
+    base = s.samples()[0]["perf_ns"] - 5_000
+    evs2 = hbm.chrome_counter_events(s.samples(), base_ns=base)
+    assert min(e["ts"] for e in evs2 if e["ph"] == "C") == \
+        pytest.approx(5.0, abs=0.001)
+    assert hbm.chrome_counter_events([]) == []
+
+
+# ------------------------------------------- cost-model calibration
+
+
+@pytest.fixture(autouse=True)
+def _calib_env(monkeypatch):
+    monkeypatch.delenv("OG_SCHED_CALIB", raising=False)
+    yield
+
+
+def test_record_actual_feeds_histograms_and_bias():
+    s = QueryScheduler(max_concurrent=0)
+    c0 = CALIB_HIST["cells_ratio"].snapshot()["count"]
+    n0 = SCHED_STATS["calib_records"]
+    # estimates 4x low on cells, 2x low on pull bytes
+    for _ in range(8):
+        s.record_actual(QueryCost(1000, pull_bytes=100, hbm_bytes=50),
+                        cells=4000, pull_bytes=200, device_ms=12.0,
+                        hbm_peak=100)
+    assert CALIB_HIST["cells_ratio"].snapshot()["count"] == c0 + 8
+    assert SCHED_STATS["calib_records"] == n0 + 8
+    snap = s.calibration_snapshot()
+    assert snap["mode"] == "record"
+    cls = snap["classes"]["dash"]
+    assert cls["n"] == 8
+    # EWMA converges toward the true 4x / 2x bias
+    assert 2.0 < cls["bias_cells_x"] <= 4.0
+    assert 1.4 < cls["bias_pull_x"] <= 2.0
+    assert len(snap["recent"]) == 8
+    assert snap["recent"][-1]["graded"] is True
+    assert snap["error_hist"]["cells_ratio"]["count"] >= 8
+    # the learned factor applies per class
+    assert s.calib_factor(1000) == pytest.approx(
+        cls["bias_cells_x"], rel=1e-3)
+    assert s.calib_factor(50_000_000) == 1.0    # heavy class: no data
+
+
+def test_record_actual_ungraded_when_no_estimate():
+    s = QueryScheduler(max_concurrent=0)
+    s.record_actual(QueryCost(0), cells=500)    # nothing to grade
+    s.record_actual(QueryCost(100), cells=0)    # host-only path
+    snap = s.calibration_snapshot()
+    assert [r["graded"] for r in snap["recent"][-2:]] == [False, False]
+    assert all(c["n"] == 0 for c in snap["classes"].values())
+
+
+def test_bias_clamped():
+    s = QueryScheduler(max_concurrent=0)
+    for _ in range(100):
+        s.record_actual(QueryCost(1), cells=10**9)  # absurd ratio
+    # |log2 bias| caps at 4 → factor at most 16x
+    assert s.calib_factor(1) <= 16.0 + 1e-9
+
+
+def _poisoned(max_cells=1000):
+    """Scheduler whose 'dash' class learned a 8x under-estimate."""
+    s = QueryScheduler(max_concurrent=0, max_cells=max_cells)
+    for _ in range(50):
+        s.record_actual(QueryCost(500), cells=4000)
+    return s
+
+
+def test_calib_tristate_admission(monkeypatch):
+    # OG_SCHED_CALIB=0: raw charges, no recording — PR 4 byte-identity
+    monkeypatch.setenv("OG_SCHED_CALIB", "0")
+    s = _poisoned()
+    assert s.calibration_snapshot()["mode"] == "0"
+    assert len(s.calibration_snapshot()["recent"]) == 0  # no records
+    s.admit(cost=QueryCost(500)).release()      # 500 < 1000: admitted
+    # record (default): estimates graded but charges still raw
+    monkeypatch.delenv("OG_SCHED_CALIB", raising=False)
+    s = _poisoned()
+    assert len(s.calibration_snapshot()["recent"]) > 0
+    s.admit(cost=QueryCost(500)).release()
+    # OG_SCHED_CALIB=1: learned ~8x bias applies → 500 becomes ~4000
+    # which exceeds the 1000-cell budget and sheds citing the bias
+    monkeypatch.setenv("OG_SCHED_CALIB", "1")
+    s = _poisoned()
+    a0 = SCHED_STATS["calib_applied"]
+    with pytest.raises(SchedShed) as ei:
+        s.admit(cost=QueryCost(500))
+    assert "learned bias" in str(ei.value)
+    assert SCHED_STATS["calib_applied"] == a0 + 1
+    # an unbiased class passes through unchanged even in apply mode
+    # (mid class has no records, so no correction applies)
+    assert s.corrected_cost(QueryCost(150_000)).cells == 150_000
+
+
+def test_ticket_keeps_raw_estimate_for_grading(monkeypatch):
+    """Under OG_SCHED_CALIB=1 the ticket's charge is bias-corrected
+    but grading must run against the RAW estimate — grading against
+    the corrected charge would chase sqrt(bias) and oscillate."""
+    monkeypatch.setenv("OG_SCHED_CALIB", "1")
+    s = _poisoned(max_cells=0)          # dash class learned ~8x
+    t = s.admit(cost=QueryCost(500))
+    assert t.raw_cost.cells == 500
+    assert t.cost.cells > 2000          # charge carries the bias
+    # record_ctx grades the raw estimate: a 4000-cell actual keeps
+    # the learned ~8x bias stable (ratio 8 again), it does NOT decay
+    bias_before = s.calib_factor(500)
+
+    class _Ctx:
+        actual_cells = 4000
+        d2h_bytes = 0
+        device_ns = 0
+        hbm_peak = 0
+
+    s.record_ctx(t, _Ctx())
+    t.release()
+    assert s.calib_factor(500) == pytest.approx(bias_before, rel=0.25)
+    rec = s.calibration_snapshot()["recent"][-1]
+    assert rec["est_cells"] == 500      # raw, not corrected
+
+
+def test_hostile_trace_id_cannot_forge_exposition():
+    """X-OG-Trace is client-controlled: a quote/space-bearing id must
+    be sanitized before it can break or forge OpenMetrics lines."""
+    h = Histogram(exp_bounds(1, 8))
+    h.observe(2.0, trace_id='a"} 1 1\ninjected')
+    (v, tid, _ts), = h.exemplars().values()
+    assert '"' not in tid and " " not in tid and "\n" not in tid
+    from opengemini_tpu.utils.stats import _exemplar_suffix
+    line = f'x_bucket{{le="2"}} 1{_exemplar_suffix((v, tid, 1.0))}'
+    assert _SAMPLE_RE.match(line), line
+
+
+def test_on_demand_sample_does_not_pollute_ring():
+    s = UtilizationSampler(ring=8)
+    out = s.sample_once(record=False)
+    assert "tier_bytes" in out
+    assert s.samples() == []            # a read fabricates nothing
+
+
+def test_corrected_cost_scales_all_dimensions(monkeypatch):
+    monkeypatch.setenv("OG_SCHED_CALIB", "1")
+    s = _poisoned(max_cells=0)
+    c = s.corrected_cost(QueryCost(500, pull_bytes=1000,
+                                   hbm_bytes=2000))
+    f = s.calib_factor(500)
+    assert c.cells == int(round(500 * f))
+    assert c.hbm_bytes == int(round(2000 * f))
+
+
+# ------------------------------------ estimate_failed (satellite fix)
+
+
+def test_estimate_failure_counted_and_logged(db, monkeypatch, caplog):
+    import logging
+
+    import opengemini_tpu.query.scheduler as S
+    eng, ex = db
+    seed(eng, hosts=2, points=30)
+    from opengemini_tpu.query import parse_query
+    stmts = parse_query(Q_HIGH)
+
+    def boom(*a, **k):
+        raise RuntimeError("broken estimator")
+
+    monkeypatch.setattr(S, "_estimate_select_cells", boom)
+    n0 = SCHED_STATS["estimate_failed"]
+    with caplog.at_level(logging.DEBUG,
+                         logger="opengemini_tpu.query.scheduler"):
+        cost = estimate_request_cost(ex, stmts, "db0")
+    assert SCHED_STATS["estimate_failed"] == n0 + 1
+    assert cost.cells == S._DEFAULT_CELLS   # admits, never fails
+    rec = [r for r in caplog.records
+           if "estimate_request_cost failed" in r.message]
+    assert rec, "estimator failure must be logged with the statement"
+    assert "broken estimator" in rec[0].message
+
+
+# ----------------------------- /metrics exposition conformance gate
+
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\\n]|\\["\\n])*",?)*)\})?'
+    r' (?P<value>[^ ]+)'
+    r'(?P<exemplar> # \{trace_id="[^"]*"\} [^ ]+ [^ ]+)?$')
+
+
+def _check_exposition(text: str, openmetrics: bool):
+    """Parse EVERY line: comments must be well-formed HELP/TYPE (or
+    the OpenMetrics EOF), samples must match the grammar, every sample
+    must belong to a family with a HELP+TYPE pair, histogram buckets
+    must be cumulative-monotone with +Inf == _count, and exemplars are
+    OpenMetrics-only, bucket-only, in-bucket."""
+    helps: dict = {}
+    types: dict = {}
+    buckets: dict = {}
+    counts: dict = {}
+    n_samples = 0
+    lines = text.splitlines()
+    assert lines, "empty exposition"
+    for ln in lines:
+        if not ln:
+            continue
+        if ln.startswith("#"):
+            if ln == "# EOF":
+                assert openmetrics, "# EOF in the classic format"
+                assert ln == lines[-1], "# EOF must be terminal"
+                continue
+            m = re.match(r"^# (HELP|TYPE) (\S+) (.+)$", ln)
+            assert m, f"malformed comment: {ln!r}"
+            kind, fam, rest = m.groups()
+            if kind == "HELP":
+                helps[fam] = rest
+            else:
+                assert rest in ("gauge", "histogram"), ln
+                types[fam] = rest
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        n_samples += 1
+        name = m.group("name")
+        float(m.group("value"))          # must parse
+        fam = re.sub(r"_(bucket|sum|count)$", "", name) \
+            if re.search(r"_(bucket|sum|count)$", name) \
+            and re.sub(r"_(bucket|sum|count)$", "", name) in types \
+            else name
+        assert fam in types, f"sample {name} has no TYPE"
+        assert fam in helps, f"sample {name} has no HELP"
+        if m.group("exemplar"):
+            assert openmetrics, f"exemplar in classic format: {ln!r}"
+            assert name.endswith("_bucket"), \
+                f"exemplar on a non-bucket line: {ln!r}"
+        if name.endswith("_bucket") and types.get(fam) == "histogram":
+            lm = re.search(r'le="([^"]+)"', m.group("labels") or "")
+            assert lm, f"bucket without le: {ln!r}"
+            le = math.inf if lm.group(1) == "+Inf" \
+                else float(lm.group(1))
+            cum = float(m.group("value"))
+            buckets.setdefault(fam, []).append((le, cum))
+            if m.group("exemplar"):
+                em = re.match(r' # \{trace_id="([^"]+)"\} '
+                              r'([^ ]+) ([^ ]+)$', m.group("exemplar"))
+                assert em, f"malformed exemplar: {ln!r}"
+                assert float(em.group(2)) <= le, \
+                    f"exemplar value outside its bucket: {ln!r}"
+                float(em.group(3))       # timestamp parses
+        elif name.endswith("_count") and types.get(fam) == "histogram":
+            counts[fam] = float(m.group("value"))
+    if openmetrics:
+        assert lines[-1] == "# EOF", "OpenMetrics must end with # EOF"
+    for fam, bs in buckets.items():
+        les = [le for le, _ in bs]
+        cums = [c for _, c in bs]
+        assert les == sorted(les), f"{fam}: le not ascending"
+        assert les[-1] == math.inf, f"{fam}: missing +Inf bucket"
+        assert cums == sorted(cums), f"{fam}: buckets not cumulative"
+        assert cums[-1] == counts.get(fam), \
+            f"{fam}: +Inf bucket != _count"
+    assert n_samples > 0
+    return buckets
+
+
+@pytest.fixture
+def server(db, monkeypatch, tmp_path):
+    from opengemini_tpu.http.server import HttpServer
+    from opengemini_tpu.utils.config import Config
+    eng, ex = db
+    seed(eng, hosts=2, points=60)
+    cfg = Config()
+    cfg.stats.enabled = True
+    cfg.stats.push_path = str(tmp_path / "stats.lp")
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    yield srv, eng
+    srv.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30)
+
+
+def test_metrics_conformance_both_formats(server):
+    srv, _eng = server
+    # traffic first: histograms + exemplars need observations, and
+    # the forced trace id must surface as an exemplar
+    _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/query?db=db0&q="
+        + urllib.parse.quote(Q_HIGH),
+        headers={"X-OG-Trace": "exemplar00t1"})
+    urllib.request.urlopen(req, timeout=30).read()
+    r = _get(srv, "/metrics")
+    assert "text/plain" in r.headers["Content-Type"]
+    classic = r.read().decode()
+    _check_exposition(classic, openmetrics=False)
+    assert " # {" not in classic        # no exemplars in classic
+    r = _get(srv, "/metrics?format=openmetrics")
+    assert "application/openmetrics-text" in r.headers["Content-Type"]
+    om = r.read().decode()
+    _check_exposition(om, openmetrics=True)
+    assert 'trace_id="exemplar00t1"' in om
+    # the ledger gauges ride both expositions
+    for text in (classic, om):
+        assert "opengemini_hbm_tracked_bytes" in text
+        assert "opengemini_hbm_device_cache_bytes" in text
+    # Accept-header negotiation picks OpenMetrics too
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/metrics",
+        headers={"Accept": "application/openmetrics-text;"
+                 "version=1.0.0"})
+    body = urllib.request.urlopen(req, timeout=30).read().decode()
+    assert body.rstrip().endswith("# EOF")
+
+
+def test_histogram_exemplar_unit():
+    h = Histogram(exp_bounds(1, 64))
+    h.observe(3.0)                       # unsampled: no exemplar
+    assert h.exemplars() == {}
+    h.observe(3.0, trace_id="tid1")
+    h.observe(40.0, trace_id="tid2")
+    exs = h.exemplars()
+    assert len(exs) == 2
+    for i, (v, tid, ts) in exs.items():
+        assert v in (3.0, 40.0) and tid in ("tid1", "tid2")
+        assert ts > 0
+    h.observe(3.5, trace_id="tid3")      # same bucket: last wins
+    i35 = h._bucket(3.5)
+    assert h.exemplars()[i35][1] == "tid3"
+    h.reset()
+    assert h.exemplars() == {} and h.snapshot()["count"] == 0
+
+
+# ---------------------------------- /debug/device + /debug/scheduler
+
+
+def test_debug_device_endpoint_populated(server, monkeypatch):
+    srv, _eng = server
+    _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
+    dev = json.loads(_get(srv, "/debug/device").read())
+    assert set(dev["ledger"]["tiers"]) == set(hbm.TIERS)
+    assert dev["cross_check"]["ok"] is True
+    assert "tracked_device_bytes" in dev["reconcile"]
+    tl = dev["timeline"]
+    assert tl["samples"], "utilization timeline must be populated"
+    assert {"ts", "perf_ns", "tier_bytes"} <= set(tl["samples"][0])
+    ch = json.loads(_get(srv, "/debug/device?format=chrome").read())
+    assert any(e.get("ph") == "C" for e in ch["traceEvents"])
+
+
+def test_debug_scheduler_endpoint(server):
+    srv, _eng = server
+    _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
+    out = json.loads(_get(srv, "/debug/scheduler").read())
+    assert set(out) == {"enabled", "scheduler", "calibration"}
+    assert out["calibration"]["mode"] in ("0", "record", "1")
+    assert set(out["calibration"]["classes"]) == \
+        {"dash", "mid", "heavy"}
+    # /debug/vars carries the hbm group alongside
+    dv = json.loads(_get(srv, "/debug/vars").read())
+    assert "tracked_bytes" in dv["hbm"]
+    assert "pressure_events" in dv["hbm"]
+
+
+def test_show_queries_resource_columns_over_http(server):
+    srv, _eng = server
+    _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
+    body = json.loads(_get(
+        srv, "/query?db=db0&q=" + urllib.parse.quote("SHOW QUERIES")
+    ).read())
+    s = body["results"][0]["series"][0]
+    assert s["columns"][-2:] == ["hbm_peak_mb", "d2h_mb"]
+    # the in-flight SHOW itself: both columns present + non-negative
+    assert all(row[-1] >= 0 and row[-2] >= 0 for row in s["values"])
+
+
+# ------------------------------------------- ts-monitor round-trip
+
+
+def test_monitor_roundtrip_ships_ledger_gauges(server, tmp_path):
+    """Satellite: a ts-monitor tick against an in-process server tails
+    the pusher's metric file and ships the new hbm ledger gauges and
+    the histogram p50/p99 summaries into the monitor db — and they
+    come back queryable over the same server."""
+    from opengemini_tpu.app.client import HttpClient
+    from opengemini_tpu.app.monitor import TsMonitor
+    srv, eng = server
+    # traffic so the latency histograms have samples
+    _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
+    push = srv.stats_pusher.push_path
+    open(push, "a").close()
+    mon = TsMonitor(HttpClient(srv.host, srv.port), "monitor",
+                    metric_files=[push], hostname="n1")
+    srv.stats_pusher.push_once()         # pusher writes AFTER attach
+    lines = mon.collect_once()           # monitor tails + ships
+    hbm_lines = [ln for ln in lines if ln.startswith("hbm")]
+    assert hbm_lines and "tracked_bytes=" in hbm_lines[0]
+    assert any(ln.startswith("latency")
+               and "query_latency_ms_p50=" in ln for ln in lines)
+    assert "monitor" in eng.databases
+    meas = eng.measurements("monitor")
+    assert "hbm" in meas and "latency" in meas
+    body = json.loads(_get(
+        srv, "/query?db=monitor&q=" + urllib.parse.quote(
+            "SELECT last(tracked_bytes), last(device_cache_bytes) "
+            "FROM hbm")).read())
+    s = body["results"][0]["series"][0]
+    assert s["values"][0][1] is not None
+    body = json.loads(_get(
+        srv, "/query?db=monitor&q=" + urllib.parse.quote(
+            "SELECT last(httpd_query_latency_ms_p50), "
+            "last(httpd_query_latency_ms_p99) FROM latency")).read())
+    s = body["results"][0]["series"][0]
+    assert s["values"][0][1] > 0 and s["values"][0][2] > 0

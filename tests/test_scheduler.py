@@ -694,6 +694,10 @@ def test_show_queries_reports_phases(db):
     qm.detach(ctx)
     s = res["series"][0]
     assert s["columns"] == ["qid", "query", "database", "duration",
-                            "status", "queue_ms", "device_ms"]
+                            "status", "queue_ms", "device_ms",
+                            "hbm_peak_mb", "d2h_mb"]
     row = s["values"][0]
     assert row[4] == "running" and row[5] >= 0 and row[6] >= 0
+    # measured device-resource columns (observatory): present and
+    # non-negative even for a query that never touched the device
+    assert row[7] >= 0 and row[8] >= 0
