@@ -894,8 +894,12 @@ def smoke_phase() -> dict:
                                             "OG_DEVUTIL_MS": "10"})]
         from opengemini_tpu.ops import hbm as _hbm
         # force the block path + lattice route so the smoke covers the
-        # shapes the streaming pipeline actually rewires
+        # shapes the streaming pipeline actually rewires (originals
+        # saved: the chaos gate below needs the BLOCK route back after
+        # the forced-lattice sweep clobbers these)
         E.BLOCK_MIN_RATIO = 0
+        _blk_cells0 = E.BLOCK_MAX_CELLS
+        _blk_packed0 = E.BLOCK_MIN_RATIO_PACKED
         for forced_lattice in (False, True):
             if forced_lattice:
                 E.BLOCK_MAX_CELLS = 8
@@ -1027,6 +1031,128 @@ def smoke_phase() -> dict:
                 f"SMOKE MISMATCH: observatory overhead {obs_pct:.2f}%"
                 f" (on {t_obs * 1e3:.2f}ms vs off {t_off * 1e3:.2f}ms)"
                 f" exceeds {obs_limit}%")
+        # ------------------------------------------------ chaos gate
+        # device fault domain (PR 9): one seeded device-fault schedule
+        # per bench shape — OOM + transient + hang injections across
+        # the launch/pull/fill sites — must leave every digest equal
+        # to its fault-free reference and the HBM ledger exactly
+        # reconciled (zero drift), with the breakers healed after
+        from opengemini_tpu.ops import devicefault as _df
+        from opengemini_tpu.utils import failpoint as _fp
+        _df.reset_breakers()
+        chaos_injected = 0
+        knobs.set_env("OG_DEVICE_HANG_S", "0.5")
+        knobs.set_env("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+        knobs.set_env("OG_DEVICE_BREAKER_COOLDOWN_S", "0.05")
+        _CHAOS_SCHEDULE = [
+            ("device.block.launch", "oom"),
+            ("device.block.launch", "transient"),
+            ("device.lattice.launch", "transient"),
+            ("device.finalize.launch", "oom"),
+            ("pipeline.submit", "transient"),
+            ("pipeline.pull", "oom"),
+            ("pipeline.pull", "hang"),
+            ("pipeline.unpack", "transient"),
+            ("blockagg.lattice_fold", "oom"),
+        ]
+        try:
+            _fp.seed(9)
+            # the forced-lattice sweep left BLOCK_MAX_CELLS=8 — put
+            # the block route back or its launch sites never fire and
+            # the recovery cycle below can never trip the breaker
+            E.BLOCK_MAX_CELLS = _blk_cells0
+            E.BLOCK_MIN_RATIO_PACKED = _blk_packed0
+            led_before = {
+                t: v["bytes"] for t, v in _hbm.LEDGER.snapshot(
+                    events=False)["tiers"].items()}
+            # one seeded schedule per shape: the 9-entry site/mode
+            # matrix rotates across the 3 shapes (3 injections each,
+            # every site exercised once per smoke) — an OOM rung
+            # evicts the WHOLE device-cache tier by design, so running
+            # all 9 on every shape would triple the cold-rebuild cost
+            # for no added coverage. The cfg1 slice carries both
+            # lattice sites, so that shape runs under the forced
+            # lattice route; EVERY injection must actually fire
+            for si, (key, qtext) in enumerate((
+                    ("1h", QUERY), ("1m", QUERY_1M),
+                    ("cfg1", QUERY_CFG1))):
+                if key == "cfg1":
+                    E.BLOCK_MAX_CELLS = 8
+                    E.BLOCK_MIN_RATIO_PACKED = 0
+                ref, _cells = run(qtext)
+                for site, mode in _CHAOS_SCHEDULE[si::3]:
+                    arg = 700 if mode == "hang" else None
+                    _fp.enable(site, mode, arg, maxhits=1)
+                    dig, cells = run(qtext)
+                    fired = not _fp.active(site)
+                    _fp.disable(site)
+                    if not fired:
+                        raise SystemExit(
+                            f"CHAOS MISMATCH [{key}]: failpoint "
+                            f"{site} never fired — the fault schedule "
+                            "no longer reaches its device route")
+                    chaos_injected += 1
+                    if dig != ref:
+                        raise SystemExit(
+                            f"CHAOS MISMATCH [{key}]: {site}/{mode} "
+                            f"changed bytes: {dig[:16]} != {ref[:16]}")
+                cross = _hbm.cross_check()
+                if not cross["ok"]:
+                    raise SystemExit(
+                        f"CHAOS MISMATCH [{key}]: ledger diverged "
+                        f"after the fault schedule: {cross}")
+            E.BLOCK_MAX_CELLS = _blk_cells0
+            E.BLOCK_MIN_RATIO_PACKED = _blk_packed0
+            led_after = {
+                t: v["bytes"] for t, v in _hbm.LEDGER.snapshot(
+                    events=False)["tiers"].items()}
+            if led_after["pipeline"] != led_before["pipeline"]:
+                raise SystemExit(
+                    f"CHAOS MISMATCH: pipeline-tier ledger drifted "
+                    f"{led_before['pipeline']} -> "
+                    f"{led_after['pipeline']} across the storms")
+            # fault_recovery_ms: the breaker-trip → half-open probe →
+            # restore cycle, measured end to end on the 1h shape (a
+            # persistent fault trips the 'block' route to its host
+            # fallback; disarming lets the next query probe it closed)
+            knobs.set_env("OG_DEVICE_RETRY", "0")
+            _fp.enable("device.block.launch", "oom")
+            t_trip0 = time.perf_counter()
+            for _ in range(50):
+                run(QUERY)          # host-fallback answers, breaker
+                if _df.breaker_for("block").is_open:
+                    break
+            else:
+                raise SystemExit(
+                    "CHAOS MISMATCH: persistent device.block.launch "
+                    "OOM never tripped the block breaker (route not "
+                    "exercised?)")
+            _fp.disable("device.block.launch")
+            for _ in range(200):
+                time.sleep(0.01)    # cooldown, then the probe query
+                run(QUERY)
+                if not _df.breaker_for("block").is_open:
+                    break
+            else:
+                raise SystemExit(
+                    "CHAOS MISMATCH: block breaker never recovered "
+                    "after the fault cleared")
+            fault_recovery_ms = (time.perf_counter() - t_trip0) * 1e3
+            knobs.del_env("OG_DEVICE_RETRY")
+            dfc = _df.devicefault_collector()
+            if not (dfc["breaker_trips"] >= 1
+                    and dfc["breaker_recoveries"] >= 1
+                    and dfc["route_fallbacks"] >= 1):
+                raise SystemExit(
+                    f"CHAOS MISMATCH: recovery cycle not observable "
+                    f"in the fault counters: {dfc}")
+        finally:
+            _fp.disable_all()
+            _df.reset_breakers()
+            for k in ("OG_DEVICE_HANG_S", "OG_DEVICE_RETRY_BACKOFF_MS",
+                      "OG_DEVICE_BREAKER_COOLDOWN_S",
+                      "OG_DEVICE_RETRY"):
+                knobs.del_env(k)
         (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
         phases = _parse_phases(ex.execute(est, "bench"))
         eng.close()
@@ -1041,6 +1167,10 @@ def smoke_phase() -> dict:
             "obs_e2e_on_ms": round(t_obs * 1e3, 2),
             "obs_ledger_reconciled": 1 if cross["ok"] else 0,
             "obs_util_samples": n_samples,
+            # device fault domain gate (PR 9)
+            "chaos_injections": chaos_injected,
+            "chaos_ledger_ok": 1,
+            "fault_recovery_ms": round(fault_recovery_ms, 1),
             **phases}
 
 

@@ -185,6 +185,8 @@ class HttpServer:
             sp.register("scheduler", scheduler_collector)
             from ..utils.stats import hbm_collector
             sp.register("hbm", hbm_collector)
+            from ..utils.stats import devicefault_collector
+            sp.register("devicefault", devicefault_collector)
             from ..utils.stats import latency_collector
             sp.register("latency", latency_collector)
             sp.register("wal", wal_collector)
@@ -791,9 +793,12 @@ class HttpServer:
                     except _qsched.SchedShed as e:
                         self._bump("query_errors")
                         tstat.update(status="shed", error=str(e))
-                        return e.http_code, {
+                        payload = {
                             "error": str(e),
                             "retry_after": round(e.retry_after_s, 3)}
+                        if e.reason:
+                            payload["reason"] = e.reason
+                        return e.http_code, payload
                     except ResourceExhausted as e:
                         self._bump("query_errors")
                         tstat.update(status="shed", error=str(e))
@@ -907,6 +912,7 @@ class HttpServer:
         from ..utils.stats import (compaction_collector,
                                    device_collector,
                                    devicecache_collector,
+                                   devicefault_collector,
                                    engine_collector, executor_collector,
                                    hbm_collector, raft_collector,
                                    readcache_collector,
@@ -922,6 +928,7 @@ class HttpServer:
                   "query_phases": phase_collector(),
                   "scheduler": scheduler_collector(),
                   "hbm": hbm_collector(),
+                  "devicefault": devicefault_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
@@ -1014,11 +1021,14 @@ class HttpServer:
                         [comp.stmt], comp.db, ctx)
                 except _qsched.SchedShed as e:
                     self._bump("query_errors")
-                    return e.http_code, {
+                    payload = {
                         "code": ("unavailable" if e.http_code == 503
                                  else "too many requests"),
                         "message": str(e),
-                        "retry_after": round(e.retry_after_s, 3)}, None
+                        "retry_after": round(e.retry_after_s, 3)}
+                    if e.reason:
+                        payload["reason"] = e.reason
+                    return e.http_code, payload, None
                 except ResourceExhausted as e:
                     self._bump("query_errors")
                     return 503, {"code": "unavailable",
@@ -1586,6 +1596,7 @@ class _Handler(BaseHTTPRequestHandler):
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
             from ..utils.stats import (devicecache_collector,
+                                       devicefault_collector,
                                        hbm_collector,
                                        histogram_summaries,
                                        scheduler_collector)
@@ -1595,6 +1606,7 @@ class _Handler(BaseHTTPRequestHandler):
             out["query_phases"] = phase_collector()
             out["scheduler"] = scheduler_collector()
             out["hbm"] = hbm_collector()
+            out["devicefault"] = devicefault_collector()
             # p50/p95/p99 summaries of every registered histogram
             # (query/write latency, queue wait, phases, D2H pulls)
             out["latency"] = histogram_summaries()

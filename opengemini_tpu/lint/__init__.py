@@ -1,6 +1,6 @@
 """oglint — repo-specific AST invariant linter (tier-1 gate).
 
-Six rule classes enforce the conventions the device hot path's
+Seven rule classes enforce the conventions the device hot path's
 correctness rests on (see each rule module for the full contract):
 
 - R1 transfer discipline (``transfer_rule``): D2H pulls in hot-path
@@ -23,6 +23,11 @@ correctness rests on (see each rule module for the full contract):
 - R6 counter hygiene (``counter_rule``): metric names come from the
   ``utils.stats.register_counters`` registry and shared-counter
   read-modify-writes hold the stats lock.
+- R7 fault classification (``fault_rule``): broad ``except Exception``
+  around device launch/pull/fill sites in ``ops/`` must route through
+  ``ops.devicefault.classify`` (or re-raise, or carry a reviewed
+  pragma) — a swallowed device fault never retries, never relieves
+  HBM pressure and never charges a route breaker.
 
 Run: ``python scripts/oglint.py`` (or ``python -m opengemini_tpu.lint``).
 Suppressions: a trailing ``# oglint: disable=R103`` comment disables

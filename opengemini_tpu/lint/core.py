@@ -133,17 +133,19 @@ def collect_files(root: str, paths: list[str] | None = None) -> list[str]:
 def default_rules() -> list[Rule]:
     from .counter_rule import CounterRule
     from .deadline_rule import DeadlineRule
+    from .fault_rule import FaultRule
     from .knob_rule import KnobRule
     from .lockrank_rule import LockRankRule
     from .trace_rule import TraceRule
     from .transfer_rule import TransferRule
     return [TransferRule(), KnobRule(), DeadlineRule(),
-            LockRankRule(), TraceRule(), CounterRule()]
+            LockRankRule(), TraceRule(), CounterRule(),
+            FaultRule()]
 
 
 def run_lint(root: str, rules: list[Rule] | None = None,
              paths: list[str] | None = None) -> list[Violation]:
-    """Run ``rules`` (default: all six classes) over the repo at
+    """Run ``rules`` (default: all seven classes) over the repo at
     ``root``; returns sorted, pragma-filtered violations."""
     rules = rules if rules is not None else default_rules()
     ctxs = []

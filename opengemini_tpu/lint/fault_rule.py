@@ -1,0 +1,120 @@
+"""R7 — device-fault classification discipline in ``ops/``.
+
+The device fault domain (ops/devicefault.py) only works if device
+errors actually REACH its classifier: a ``RESOURCE_EXHAUSTED`` or
+``XlaRuntimeError`` swallowed by a bare ``except Exception: pass``
+never retries, never runs the HBM-pressure ladder, never charges a
+route breaker — the query silently degrades (or worse, succeeds with
+a hole) and the serving plane learns nothing. PR 9's audit routed the
+real offenders (the pipeline drain, the multi-field readiness wait)
+through ``devicefault.classify``; this rule keeps new code honest.
+
+Scope: ``opengemini_tpu/ops/`` — the device hot path. A ``try`` body
+counts as a *device site* when it performs a launch/pull/fill: any
+``jax.*``/``jnp.*`` call, or a call whose dotted name mentions
+``device_put`` / ``device_get`` / ``block_until_ready`` /
+``put_decoded_planes``.
+
+Codes:
+- R701: broad ``except Exception`` (or bare ``except:``) around a
+  device launch/pull/fill whose handler neither consults
+  ``devicefault.classify`` nor re-raises. Fix: classify and re-raise
+  device-classed errors (the pipeline drain idiom), or — when
+  swallowing is genuinely correct (fail-closed probes, read-only
+  diagnostics) — carry a reviewed ``# oglint: disable=R701`` pragma
+  saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+
+_SCOPE = ("opengemini_tpu/ops/",)
+
+# dotted-name substrings that mark a try body as a device
+# launch/pull/fill site
+_DEVICE_MARKERS = ("device_put", "device_get", "block_until_ready",
+                   "put_decoded_planes")
+_DEVICE_PREFIXES = ("jax.", "jnp.")
+
+
+def _is_device_call(name: str) -> bool:
+    if not name:
+        return False
+    if name.startswith(_DEVICE_PREFIXES) or name in ("jax", "jnp"):
+        return True
+    return any(m in name for m in _DEVICE_MARKERS)
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    """``except:``, ``except Exception`` or ``except BaseException``
+    (bare or aliased, alone or inside a tuple)."""
+    t = h.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted(e) for e in t.elts]
+    else:
+        names = [dotted(t)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handler_classifies(h: ast.ExceptHandler) -> bool:
+    """Handler consults the classifier or re-raises: either keeps the
+    fault ladder in the loop."""
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name.endswith("classify") or "devicefault" in name:
+                return True
+    return False
+
+
+class FaultRule(Rule):
+    rule_id = "R7"
+    codes = {
+        "R701": "broad except around a device launch/pull/fill "
+                "swallows faults the classifier must see",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not any(ctx.path.startswith(d) for d in _SCOPE):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            # device site: any launch/pull/fill call inside the TRY
+            # BODY (not the handlers — a handler's own cleanup call
+            # does not make the guarded region a device site)
+            site = None
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        name = dotted(sub.func)
+                        if _is_device_call(name):
+                            site = name
+                            break
+                if site:
+                    break
+            if not site:
+                continue
+            for h in node.handlers:
+                if not _broad_handler(h):
+                    continue
+                if _handler_classifies(h):
+                    continue
+                out.append(Violation(
+                    ctx.path, h.lineno, "R701",
+                    f"broad except around device site {site}(...) "
+                    "swallows device faults: route through "
+                    "ops.devicefault.classify (re-raise classified "
+                    "errors so the retry/pressure/breaker ladder "
+                    "runs), or carry a reviewed "
+                    "'# oglint: disable=R701' pragma"))
+        return out

@@ -43,7 +43,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
-from ..utils import get_logger, knobs
+from ..utils import failpoint, get_logger, knobs
 from . import devicecache, exactsum
 
 log = get_logger(__name__)
@@ -765,7 +765,9 @@ def _backend_real_f64() -> bool:
             import jax
             _REAL_F64 = jax.devices()[0].platform in (
                 "cpu", "gpu", "cuda", "rocm")
-        except Exception:
+        except Exception:  # oglint: disable=R701 — reviewed: platform
+            # probe fails CLOSED (epilogue off) — the safe default on
+            # any backend we cannot identify
             _REAL_F64 = False
     return _REAL_F64
 
@@ -1523,6 +1525,12 @@ def file_lattice_fold(slabs: list, gids: np.ndarray, t_lo, t_hi,
     packs ONE transport grid per (field, scale) group). Caller must
     have passed lattice_eligible first."""
     import jax
+
+    # device fault domain: the fold kernel's launch sequence is a
+    # distinct failure site from the generic device.lattice.launch
+    # wrapper (it issues 2 launches per slab) — chaos schedules arm it
+    # to fail the fold mid-file
+    failpoint.inject("blockagg.lattice_fold")
     K = slabs[0].limbs.shape[-1]
     if scalars is None:
         scalars = query_scalars(t_lo, t_hi, start, interval)

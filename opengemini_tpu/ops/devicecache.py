@@ -151,6 +151,30 @@ class DeviceBlockCache:
                 else:
                     led.release(self.tier, -delta, n=0)
 
+    def evict_bytes(self, nbytes: int | None = None,
+                    reason: str = "oom_relief") -> int:
+        """Evict LRU entries until ``nbytes`` are freed (None = the
+        whole cache) — the device fault domain's HBM-pressure rung
+        (ops/devicefault.hbm_pressure_relief). Ledger release happens
+        INSIDE the cache lock (same torn-mirror argument as put_sized);
+        the pressure event lands in the HBM ring so the observatory
+        timeline shows the ladder firing. Returns bytes freed."""
+        led = self._led()
+        freed = 0
+        n = 0
+        with self._lock:
+            while self._map and (nbytes is None or freed < nbytes):
+                _k, (_buf, enb) = self._map.popitem(last=False)
+                self._bytes -= enb
+                self.evictions += 1
+                freed += enb
+                n += 1
+            if led is not None and n:
+                led.release(self.tier, freed, n=n)
+        if led is not None and n:
+            led.pressure(self.tier, freed, reason)
+        return freed
+
     def purge(self) -> None:
         led = self._led()
         with self._lock:
@@ -200,9 +224,24 @@ def enabled() -> bool:
     return capacity_bytes() > 0
 
 
+def _rebind_tier(tier: str) -> None:
+    """A fresh singleton is taking over ``tier``: drain whatever the
+    PREVIOUS instance left booked in the HBM ledger. In production the
+    singleton is created once against an empty tier (no-op); tests
+    that swap ``_CACHE``/``_HOST_CACHE`` for isolation used to strand
+    the old instance's bytes, silently breaking the exact
+    ``hbm.cross_check()`` reconciliation for everything after them."""
+    from . import hbm
+    resid_b = hbm.LEDGER.tier_bytes(tier)
+    resid_n = hbm.LEDGER.tier_count(tier)
+    if resid_b or resid_n:
+        hbm.LEDGER.release(tier, resid_b, n=resid_n)
+
+
 def global_cache() -> DeviceBlockCache:
     global _CACHE
     if _CACHE is None:
+        _rebind_tier("device_cache")
         _CACHE = DeviceBlockCache(capacity_bytes(),
                                   tier="device_cache")
     return _CACHE
@@ -211,6 +250,7 @@ def global_cache() -> DeviceBlockCache:
 def host_cache() -> DeviceBlockCache:
     global _HOST_CACHE
     if _HOST_CACHE is None:
+        _rebind_tier("host_cache")
         _HOST_CACHE = DeviceBlockCache(host_capacity_bytes(),
                                        tier="host_cache")
     return _HOST_CACHE
@@ -306,7 +346,12 @@ def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
     when the cache is disabled or over budget)."""
     import jax
 
+    from ..utils import failpoint
     from . import devstats
+    # device fault domain: the decoded-plane H2D upload is a classic
+    # OOM site — injection here drives the cache-fill rung of the
+    # chaos schedules (tests/chaos.py device storms)
+    failpoint.inject("devicecache.fill")
     cache = global_cache() if enabled() else None
     nb = 0
     with _base_fill_lock(fp, field):

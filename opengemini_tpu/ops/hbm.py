@@ -207,6 +207,12 @@ def reconcile() -> dict:
     holds MORE than the ledger: jit executables, scratch, the
     framework's own pools — the tolerance absorbs that floor, the flag
     catches a leak growing past it)."""
+    from ..utils import failpoint
+
+    # device fault domain: chaos schedules fail the reconcile itself
+    # (it runs from /debug/device and the perf_smoke observatory gate —
+    # a throwing reconcile must surface typed, never corrupt the ledger)
+    failpoint.inject("hbm.reconcile")
     _bump("reconcile_runs")
     snap = LEDGER.snapshot(events=False)
     tracked = (snap["tiers"]["device_cache"]["bytes"]
@@ -224,7 +230,9 @@ def reconcile() -> dict:
                     {"device": str(d),
                      "bytes_in_use": int(ms["bytes_in_use"]),
                      "bytes_limit": int(ms.get("bytes_limit", 0))})
-    except Exception as e:  # backend probe must never fail the caller
+    except Exception as e:  # oglint: disable=R701 — reviewed: backend
+        # memory_stats probe is read-only diagnostics; a throwing
+        # backend must degrade to "unavailable", not fail /debug/device
         out["backend_error"] = str(e)
     if per_dev:
         backend_b = sum(d["bytes_in_use"] for d in per_dev)
@@ -240,6 +248,23 @@ def reconcile() -> dict:
             LEDGER.pressure("device_cache", abs(drift),
                             "reconcile_drift")
     return out
+
+
+def rebase_cache_tiers() -> None:
+    """Force the cache tiers to exactly mirror the LIVE cache
+    singletons. The ledger is double-entry against one mirror per
+    tier; when test isolation swaps the singletons around (monkeypatch
+    install + restore) the tier can end up tracking a dead instance's
+    bytes in either direction. Production never needs this — the
+    singletons are created once and mirrored move for move."""
+    from . import devicecache as _dc
+    for tier, cache in (("device_cache", _dc.global_cache()),
+                        ("host_cache", _dc.host_cache())):
+        st = cache.stats()
+        with LEDGER._lock:
+            t = LEDGER._tier(tier)
+            t["bytes"] = int(st["bytes"])
+            t["n"] = int(st["entries"])
 
 
 def cross_check() -> dict:

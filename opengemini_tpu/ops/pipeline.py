@@ -43,11 +43,14 @@ transfer after a global barrier.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
-from ..utils import knobs
+from ..utils import failpoint, knobs
+from ..utils import deadline as _deadline
 from ..utils.lockrank import (RANK_PIPELINE, RANK_PIPELINE_POOL,
                               RankedLock)
 
@@ -148,6 +151,87 @@ _PULL_POOL: ThreadPoolExecutor | None = None
 _PULL_POOL_LOCK = RankedLock("pipeline.pool", RANK_PIPELINE_POOL)
 
 
+class _Pull:
+    """One in-flight submission's resource record: the gate slot,
+    depth permit, pipeline-tier ledger bytes and ctx attribution it
+    holds. ``release()`` is once-only under a lock — the puller
+    thread's finally and the watchdog/abandon reclaim race, exactly
+    one side wins (a double BoundedSemaphore release raises; a missed
+    one leaks the OG_SCHED_DEPTH slot forever)."""
+
+    __slots__ = ("pipe", "est_b", "route", "key", "fut", "_done",
+                 "_lock")
+
+    def __init__(self, pipe: "StreamingPipeline", est_b: int,
+                 route: str):
+        self.pipe = pipe
+        self.est_b = est_b
+        self.route = route
+        self.key = None
+        self.fut = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def release(self) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+        from . import hbm as _hbm
+        _hbm.release("pipeline", self.est_b)
+        pipe = self.pipe
+        if pipe.ctx is not None and hasattr(pipe.ctx, "sub_hbm"):
+            pipe.ctx.sub_hbm(self.est_b)
+        if pipe.gate is not None:
+            try:
+                pipe.gate.release()
+            except ValueError:
+                pass               # gate rebuilt under us (tests)
+        try:
+            pipe._sem.release()
+        except ValueError:
+            pass
+        return True
+
+
+# per-request-thread registry of live pipelines: the executor's
+# execute() finally calls reap_thread_pipes() so ANY exception path
+# out of the dispatch loop (kill, deadline, device fault, plain bug)
+# reclaims in-flight submissions instead of leaking gate slots and
+# pipeline-tier ledger bytes (the PR 9 KILL QUERY leak fix)
+_TLS = threading.local()
+
+
+def _tls_pipes() -> list:
+    got = getattr(_TLS, "pipes", None)
+    if got is None:
+        got = _TLS.pipes = []
+    return got
+
+
+def _tls_remove(pipe) -> None:
+    got = getattr(_TLS, "pipes", None)
+    if got is not None:
+        try:
+            got.remove(pipe)
+        except ValueError:
+            pass
+
+
+def reap_thread_pipes() -> int:
+    """Abandon every pipeline this thread created and never collected
+    (error paths out of the executor). No-op on the happy path —
+    collect() deregisters. Returns submissions reclaimed."""
+    got = getattr(_TLS, "pipes", None)
+    if not got:
+        return 0
+    n = 0
+    for pipe in list(got):
+        n += pipe.abandon("reap")
+    got.clear()
+    return n
+
+
 def _pull_pool() -> ThreadPoolExecutor:
     """Shared daemon puller pool: pull threads spend their lives
     blocked in the PJRT transfer (GIL released), so a small process-
@@ -187,6 +271,15 @@ class StreamingPipeline:
         self.depth = depth if depth is not None else pipeline_depth()
         self._sem = threading.BoundedSemaphore(max(1, self.depth))
         self.gate = gate
+        # device fault domain: every submission owns a _Pull record
+        # whose resource release (gate slot, depth permit, HBM ledger
+        # bytes, ctx attribution) is IDEMPOTENT — the puller thread's
+        # finally and the hang-watchdog/abandon reclaim may race, and
+        # exactly one of them must win (a double gate.release would
+        # raise; a missed one wedged OG_SCHED_DEPTH forever)
+        self._pulls: list[_Pull] = []
+        self._abandoned = False
+        _tls_pipes().append(self)
         # per-query working-set attribution (device observatory): the
         # submitting query's ctx carries live/peak in-flight result
         # bytes (SHOW QUERIES hbm_peak_mb, scheduler calibration)
@@ -210,11 +303,39 @@ class StreamingPipeline:
         # a query mixes transport forms
         self.bytes_by: dict = {}
 
-    def submit(self, key, tree, post=None, transport=None) -> None:
-        self._sem.acquire()
+    def _acquire_slice(self, sem) -> None:
+        """Deadline/kill-aware acquire: the old blocking acquire was
+        the gate-wedge half of the PR 9 leak — a killed query (or one
+        whose budget was already gone) sat in gate.acquire() forever
+        while holding its depth permit."""
+        while not sem.acquire(timeout=0.05):
+            if self.ctx is not None \
+                    and getattr(self.ctx, "killed", False):
+                self.ctx.check()       # raises QueryKilled
+            _deadline.check("pipeline submit")
+
+    def submit(self, key, tree, post=None, transport=None,
+               route=None) -> None:
+        try:
+            failpoint.inject("pipeline.submit")
+        except BaseException as e:
+            # a device-classified submit failure (injected or real —
+            # e.g. the launch handle itself reporting OOM) enters the
+            # fault domain as a route failure: the statement-level
+            # wrapper re-runs against the host fallback. Non-device
+            # exceptions propagate untouched
+            from . import devicefault as _df
+            cls = _df.classify(e)
+            if cls is None:
+                raise
+            r = route or (transport or "pipeline")
+            _df._bump_class(cls)
+            _df.breaker_for(r).record_failure()
+            raise _df.DeviceRouteDown(r, e) from e
+        self._acquire_slice(self._sem)
         if self.gate is not None:
             try:
-                self.gate.acquire()
+                self._acquire_slice(self.gate)
             except BaseException:
                 self._sem.release()
                 raise
@@ -228,36 +349,37 @@ class StreamingPipeline:
         _hbm.account("pipeline", est_b)
         if self.ctx is not None and hasattr(self.ctx, "add_hbm"):
             self.ctx.add_hbm(est_b)
+        pull = _Pull(self, est_b, route or (transport or "pipeline"))
         try:
             fut = _pull_pool().submit(self._run, tree, post, transport,
-                                      est_b)
+                                      pull)
         except BaseException:
-            self._account_done(est_b)
-            if self.gate is not None:
-                self.gate.release()
-            self._sem.release()
+            pull.release()
             raise
+        pull.fut = fut
         with self._lock:
             self.launches += 1
             self._futs[key] = fut
+            self._pulls.append(pull)
+            pull.key = key
 
-    def _account_done(self, est_b: int) -> None:
-        from . import hbm as _hbm
-        _hbm.release("pipeline", est_b)
-        if self.ctx is not None and hasattr(self.ctx, "sub_hbm"):
-            self.ctx.sub_hbm(est_b)
-
-    def _run(self, tree, post, transport=None, est_b: int = 0):
+    def _run(self, tree, post, transport=None, pull=None):
         import jax
         try:
             t0 = _now_ns()
+            failpoint.inject("pipeline.pull")
             try:
                 # drain THIS launch only: device_get on in-flight
                 # arrays takes the tunnel's slow synchronous fetch path
                 # (measured 6x the post-completion transfer)
                 jax.block_until_ready(tree)
-            except Exception:
-                pass
+            except Exception as e:
+                # a failed drain used to be swallowed whole; device-
+                # classified failures (OOM mid-compute, backend death)
+                # now surface so collect() can classify and fall back
+                from . import devicefault as _df
+                if _df.classify(e) is not None:
+                    raise
             pull_sp = None
             if self.span is not None:
                 pull_sp = self.span.child("pipeline.pull")
@@ -276,6 +398,8 @@ class StreamingPipeline:
                     unpack_sp.start_ns = _now_ns()
                     unpack_sp.add(
                         lane=threading.current_thread().name)
+            if post is not None:
+                failpoint.inject("pipeline.unpack")
             out = post(host) if post is not None else host
             if pull_sp is not None and post is not None:
                 unpack_sp.end_ns = _now_ns()
@@ -293,14 +417,95 @@ class StreamingPipeline:
                         + st.get("bytes", 0))
             return out
         finally:
-            self._account_done(est_b)
-            if self.gate is not None:
-                self.gate.release()
-            self._sem.release()
+            if pull is not None:
+                pull.release()
 
     def collect(self) -> dict:
         """Wait for every submitted pull+fold; first worker exception
-        re-raises here. Safe to call with zero submissions."""
+        re-raises here (device-classified failures charge the
+        submission's route breaker and re-raise as DeviceRouteDown so
+        the statement-level wrapper falls back). Safe to call with
+        zero submissions.
+
+        Hung-launch watchdog: each wait is bounded by the request
+        deadline and OG_DEVICE_HANG_S — a pull stuck past the bound is
+        ABANDONED (its gate slot, depth permit and pipeline-tier
+        ledger bytes reclaimed now; the wedged thread's own release
+        later no-ops) instead of holding the serving plane hostage."""
+        from . import devicefault as _df
         with self._lock:
             futs = dict(self._futs)
-        return {k: f.result() for k, f in futs.items()}
+            pulls = {p.key: p for p in self._pulls}
+        hang_s = float(knobs.get("OG_DEVICE_HANG_S"))
+        out = {}
+        for k, f in futs.items():
+            t0 = time.monotonic()
+            while True:
+                try:
+                    out[k] = f.result(timeout=0.05)
+                    break
+                except FuturesTimeout:
+                    if self.ctx is not None \
+                            and getattr(self.ctx, "killed", False):
+                        self.abandon("killed")
+                        self.ctx.check()
+                    dl = _deadline.current()
+                    if dl is not None and dl.expired:
+                        self.abandon("deadline")
+                        dl.check("pipeline collect")
+                    if 0 < hang_s <= time.monotonic() - t0:
+                        # the launch is wedged but the request still
+                        # has budget: reclaim + charge the route and
+                        # let the statement retry on the host path
+                        pull = pulls.get(k)
+                        route = pull.route if pull is not None \
+                            else "pipeline"
+                        _df._bump("watchdog_expired")
+                        _df.breaker_for(route).record_failure()
+                        self.abandon("watchdog")
+                        raise _df.DeviceRouteDown(
+                            route, TimeoutError(
+                                f"background pull {k!r} hung > "
+                                f"{hang_s:g}s"))
+                except BaseException as e:
+                    cls = _df.classify(e)
+                    if cls is None:
+                        raise
+                    pull = pulls.get(k)
+                    route = pull.route if pull is not None \
+                        else "pipeline"
+                    _df._bump_class(cls)
+                    _df.breaker_for(route).record_failure()
+                    self.abandon(f"pull-{cls}")
+                    raise _df.DeviceRouteDown(route, e) from e
+        with self._lock:
+            self._pulls.clear()
+        _tls_remove(self)
+        return out
+
+    def abandon(self, reason: str = "error") -> int:
+        """Reclaim the resources of every submission that has not
+        finished: gate slot, depth permit, pipeline-tier ledger bytes,
+        ctx attribution. Idempotent per submission (the wedged puller
+        thread's own finally no-ops afterwards) and a no-op after a
+        clean collect(). This is the KILL QUERY / deadline-expiry leak
+        fix: nothing stays booked after the query is gone."""
+        with self._lock:
+            pulls = list(self._pulls)
+            already = self._abandoned
+            self._abandoned = True
+            # break the pipe<->_Pull reference cycle here too (the
+            # clean-collect path clears it in collect()): the executor
+            # pauses cyclic GC during queries, so an abandoned pipe
+            # must not keep its pulled buffers reachable only via a
+            # cycle until the next GC window
+            self._pulls.clear()
+        n = 0
+        for p in pulls:
+            if p.release():
+                n += 1
+        if n and not already:
+            from . import devicefault as _df
+            _df._bump("abandoned_pulls", n)
+        _tls_remove(self)
+        return n

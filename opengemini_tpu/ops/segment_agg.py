@@ -358,8 +358,15 @@ def multi_segment_aggregate(values_f, valid_f, limbs_f, seg_ids, times,
         from .pipeline import device_get_parallel
         try:
             jax.block_until_ready((f64p, i64p))
-        except Exception:
-            pass
+        except Exception as e:
+            # the readiness wait is only an optimization (the fetch
+            # below re-synchronizes) — but a device-classified failure
+            # (OOM mid-reduce, backend death) must surface so the
+            # fault ladder can retry/fall back instead of the fetch
+            # hitting the same corpse with a worse error
+            from . import devicefault as _df
+            if _df.classify(e) is not None:
+                raise
         f64h, i64h = device_get_parallel((f64p, i64p))
     else:
         f64h = i64h = None

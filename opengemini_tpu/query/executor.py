@@ -143,15 +143,29 @@ def _unpack_block_out(fmt: str, arrs, stack, want: tuple,
     return bo
 
 
-def _sched_launch(kind: str, fn):
+def _sched_launch(kind: str, fn, route: str | None = None, ctx=None,
+                  span=None):
     """Route one device-launch thunk through the global query
     scheduler's dispatcher thread (single launch-ordering owner,
     cross-query coalescing of same-kind launches) when OG_SCHED is on;
-    inline — byte-identical to the pre-scheduler path — otherwise."""
+    inline — byte-identical to the pre-scheduler path — otherwise.
+
+    Every launch additionally runs under the device fault ladder
+    (ops/devicefault.guarded_launch): transient errors retry with
+    backoff, OOM runs the HBM-pressure ladder then retries once, and
+    exhaustion/fatal charges the per-route breaker and raises
+    DeviceRouteDown for the statement-level fallback wrapper. ``route``
+    defaults to the launch kind."""
+    from ..ops.devicefault import guarded_launch
     from .scheduler import enabled as _sen, get_scheduler
-    if not _sen():
-        return fn()
-    return get_scheduler().launch(kind, fn)
+
+    def _dispatch():
+        if not _sen():
+            return fn()
+        return get_scheduler().launch(kind, fn)
+
+    return guarded_launch(route or kind, _dispatch, ctx=ctx,
+                          span=span)
 
 
 def _sched_gate():
@@ -170,8 +184,15 @@ def _dense_device_on() -> bool:
     warm repeats; it computes only order-free exact states (count,
     min/max, limb sums) so results stay bit-identical except the f64
     fallback sum at cells some OTHER source flagged inexact (derived
-    from exact limb totals instead of numpy's pairwise rounding)."""
-    return bool(_knobs.get("OG_DENSE_DEVICE"))
+    from exact limb totals instead of numpy's pairwise rounding).
+
+    An open "dense" route breaker (device fault domain) steers dense
+    groups to the host fold — the byte-identical default path — until
+    the half-open probe recovers the route."""
+    if not bool(_knobs.get("OG_DENSE_DEVICE")):
+        return False
+    from ..ops.devicefault import route_on as _route_on
+    return _route_on("dense")
 
 
 def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
@@ -208,23 +229,30 @@ def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
                     return _dc.NO_PLANES
             return _dc.put_decoded_planes(fp, fname, e_key, dvals,
                                           dvalid, limbs)
+        from ..ops.devicefault import guarded_launch
         from .scheduler import enabled as _sen, get_scheduler
         if _sen():
             # single-flight the decode+H2D: 50 identical dashboard
             # queries racing a cold cache upload the planes ONCE.
             # ctx keeps a FOLLOWER killable while it waits out the
-            # leader's fill
-            ent = get_scheduler().singleflight(
-                ("planes", fp, fname, e_key), _fill, ctx=ctx)
+            # leader's fill. The fill's device_put is a classic OOM
+            # site — it rides the fault ladder under route "dense"
+            # (host dense fold is the byte-identical fallback).
+            ent = guarded_launch(
+                "dense",
+                lambda: get_scheduler().singleflight(
+                    ("planes", fp, fname, e_key), _fill, ctx=ctx),
+                ctx=ctx)
         else:
-            ent = _fill()
+            ent = guarded_launch("dense", _fill, ctx=ctx)
         if ent is _dc.NO_PLANES:
             return None
     from ..ops.segment_agg import (SegmentAggResult,
                                    dense_device_reduce)
     outs = _sched_launch(
         "dense", lambda: dense_device_reduce(ent[0], ent[1], ent[2],
-                                             spec, ent[2] is not None))
+                                             spec, ent[2] is not None),
+        ctx=ctx)
     res_t = SegmentAggResult(count=outs["count"], min=outs.get("min"),
                              max=outs.get("max"))
     return ("dev", (res_t, outs.get("lsum")), rkey)
@@ -385,11 +413,53 @@ class QueryExecutor:
         # query was GC). Queries create no reference cycles. Depth-
         # counted so concurrent/nested queries can't re-enable GC
         # under each other
+        from ..ops import pipeline as _pl
+        from ..ops.devicefault import DeviceRouteDown, note_fallback
+        from ..utils import deadline as _dl
         _gc_pause()
         try:
-            return self._execute_inner(stmt, db, ctx, span,
-                                       inc_query_id, iter_id)
+            # statement-level device fallback (ops/devicefault.py): a
+            # route whose fault ladder exhausted raises DeviceRouteDown
+            # — the statement re-runs and the route gates steer it to
+            # the byte-identical host path (breaker open) or back onto
+            # a recovered device. SELECTs are read-only and every
+            # per-run accumulator is function-local, so the re-run is
+            # safe by construction. Bounded: a persistent fault needs
+            # breaker_threshold runs per route to open that breaker.
+            attempts = 0
+            while True:
+                try:
+                    return self._execute_inner(stmt, db, ctx, span,
+                                               inc_query_id, iter_id)
+                except DeviceRouteDown as e:
+                    # reclaim THIS run's in-flight submissions before
+                    # the re-run (gate slots, pipeline-tier HBM bytes)
+                    _pl.reap_thread_pipes()
+                    attempts += 1
+                    from ..utils import knobs as _kn
+                    from ..ops.devicefault import ROUTES as _rts
+                    max_attempts = (max(1, int(_kn.get(
+                        "OG_DEVICE_BREAKER_THRESHOLD")))
+                        * len(_rts) + 2)
+                    dl = _dl.current()
+                    if (attempts > max_attempts
+                            or (ctx is not None
+                                and getattr(ctx, "killed", False))
+                            or (dl is not None and dl.expired)):
+                        return {"error": str(e)}
+                    note_fallback(e.route)
+                    if span is not None:
+                        span.add(device_fallbacks=attempts,
+                                 device_fallback_route=e.route)
+                    log.warning(
+                        "device route %s down — re-running statement "
+                        "on the fallback path (attempt %d)", e.route,
+                        attempts)
         finally:
+            # ANY exit path (error, kill, deadline, fallback loop
+            # exhaustion) must leave zero in-flight submissions booked
+            # to this thread — the KILL QUERY gate/ledger leak fix
+            _pl.reap_thread_pipes()
             _gc_resume()
 
     def _execute_inner(self, stmt, db: str | None = None, ctx=None,
@@ -492,6 +562,12 @@ class QueryExecutor:
                 return self._rp_stmt(stmt)
             return {"error": f"unsupported statement {type(stmt).__name__}"}
         except (ErrQueryError, GeminiError) as e:
+            from ..ops.devicefault import DeviceRouteDown
+            if isinstance(e, DeviceRouteDown):
+                # the statement-level fallback wrapper in execute()
+                # owns this one — it re-runs the statement against the
+                # host path instead of answering with an error
+                raise
             # GeminiError covers storage-layer failures too (a cold-tier
             # S3 outage mid-decode must answer as a query error, not
             # kill the caller)
@@ -1749,6 +1825,16 @@ class QueryExecutor:
                          if _ba_cap.PACK and not has_extrema
                          else min(BLOCK_MAX_CELLS, 250000)
                          if not _ba_cap.PACK else BLOCK_MAX_CELLS)
+            # device fault domain: an open "block" route breaker steers
+            # the whole block/lattice family to the host scan paths
+            # (byte-identical — the same fallback OG_DEVICE_CACHE_MB=0
+            # always provided); the breaker's half-open probe re-tries
+            # the device after the cooldown. route_on() must be the
+            # LAST term: allow() consumes the half-open probe, so a
+            # query some OTHER condition vetoes must not spend it (the
+            # probe would never report and the route would stay parked
+            # on the fallback until the stale-probe promotion)
+            from ..ops.devicefault import route_on as _route_on
             block_ok = (
                 plan_fast == "preagg+dense+block"
                 and _dc.enabled() and cond.residual is None
@@ -1760,7 +1846,8 @@ class QueryExecutor:
                 and G * W <= cells_cap
                 # windowless queries are pre-agg's sweet spot: whole
                 # segments answer from metadata with no device work
-                and not (preagg_possible and not interval))
+                and not (preagg_possible and not interval)
+                and _route_on("block"))
             if block_ok:
                 from ..ops import blockagg
                 per_file: dict[int, list] = {}
@@ -1853,7 +1940,22 @@ class QueryExecutor:
                     merged_by: dict = {}
                     merged_rows: dict = {}
                     fields_perfile: set = set()   # per-file emissions
-                    lat_dev_fold = blockagg.lattice_fold_on_device()
+                    # an open "lattice" breaker = the byte-identical
+                    # OG_LATTICE_DEVICE_FOLD=0 fallback (host C fold
+                    # of per-file lattices); the file_lattice launches
+                    # themselves ride route "block". Memoized and
+                    # consulted only when a lattice fold is actually
+                    # about to launch: route_on()'s allow() consumes
+                    # the half-open probe, and most block dispatches
+                    # carry zero lattice slabs
+                    _lat_fold_memo: list = []
+
+                    def lat_dev_fold() -> bool:
+                        if not _lat_fold_memo:
+                            _lat_fold_memo.append(
+                                blockagg.lattice_fold_on_device()
+                                and _route_on("lattice"))
+                        return _lat_fold_memo[0]
                     from ..ops.exactsum import K_LIMBS as _KLq
                     lat_lock = __import__("threading").Lock()
 
@@ -1911,7 +2013,8 @@ class QueryExecutor:
                                         post=_unpack_post(
                                             packed[0], stack_e,
                                             want_of(fname_e)),
-                                        transport=_txn[packed[0]])
+                                        transport=_txn[packed[0]],
+                                        route="block")
                             block_launches.append(
                                 (fname_e, reader_e, stack_e,
                                  ("s", n_stream)))
@@ -1941,7 +2044,7 @@ class QueryExecutor:
                                 wf = want_of(fname)
                                 lkey = (fname, sl[0].E, sl[0].k0,
                                         sl[0].limbs.shape[-1])
-                                if lat_dev_fold:
+                                if lat_dev_fold():
                                     folded = _sched_launch(
                                         "lattice",
                                         lambda sl=sl, gid_arr=gid_arr,
@@ -1954,7 +2057,8 @@ class QueryExecutor:
                                             scalars=scalars,
                                             gids_dev=
                                             blockagg.cached_gids(
-                                                gid_arr)))
+                                                gid_arr)),
+                                        ctx=ctx, span=span)
                                     prev = lat_dev_acc.get(lkey)
                                     lat_dev_acc[lkey] = folded \
                                         if prev is None else \
@@ -1976,7 +2080,8 @@ class QueryExecutor:
                                             W, wf, scalars=scalars,
                                             gids_dev=
                                             blockagg.cached_gids(
-                                                gid_arr))):
+                                                gid_arr)),
+                                        ctx=ctx, span=span):
                                     if pipe is not None:
                                         n_lat_stream += 1
                                         pipe.submit(
@@ -1985,7 +2090,8 @@ class QueryExecutor:
                                             post=_lat_post(
                                                 lkey, st_l, WL_l,
                                                 gid_arr),
-                                            transport="lattice")
+                                            transport="lattice",
+                                            route="lattice")
                                     else:
                                         block_launches.append(
                                             (fname, reader, st_l,
@@ -2006,7 +2112,8 @@ class QueryExecutor:
                                     W, G * W, wf, scalars=scalars,
                                     gids_dev=blockagg.cached_gids(
                                         gid_arr),
-                                    route=window_route))
+                                    route=window_route),
+                                ctx=ctx, span=span)
                             if not ({"min", "max"} & set(wf)):
                                 key = (fname, sl[0].E, sl[0].k0,
                                        sl[0].limbs.shape[-1])
@@ -2048,6 +2155,10 @@ class QueryExecutor:
                     # merged series) contributes limbs that must fold
                     # BEFORE finalize, and cluster/incremental merges
                     # keep the mergeable limb wire format untouched.
+                    # an open "finalize" breaker keeps the mergeable
+                    # packed transport (OG_DEVICE_FINALIZE=0's
+                    # byte-identical wire form) instead of the device
+                    # finalize epilogue
                     fin_ok = (terminal
                               and blockagg.device_finalize_on()
                               and cs.multirow is None and not chunks)
@@ -2073,6 +2184,12 @@ class QueryExecutor:
                                     break
                             if not fin_ok:
                                 break
+                    # breaker consult LAST (after the leftover-source
+                    # scan): allow() consumes the half-open probe, so
+                    # only a launch that will actually happen may
+                    # spend it
+                    if fin_ok:
+                        fin_ok = _route_on("finalize")
                     field_nkeys: dict = {}
                     for (fname, _E, _k0, _ka) in (list(merged_by)
                                                   + list(lat_dev_acc)):
@@ -2093,10 +2210,14 @@ class QueryExecutor:
                             # grid IS the field's whole answer; mixed
                             # scales must rebase on host and keep limbs
                             _t_k0 = _now_ns()
-                            fin = blockagg.finalize_grid(
-                                out, want_of(fname),
-                                field_ops.get(fname, set()), _ka,
-                                _k0, _E, nrows)
+                            fin = _sched_launch(
+                                "finalize",
+                                lambda out=out, fname=fname:
+                                blockagg.finalize_grid(
+                                    out, want_of(fname),
+                                    field_ops.get(fname, set()), _ka,
+                                    _k0, _E, nrows),
+                                ctx=ctx, span=span)
                             fin_ns += _now_ns() - _t_k0
                         if fin is not None:
                             n_fin += 1
@@ -2296,9 +2417,14 @@ class QueryExecutor:
         # (measured: the 11.5M-cell time(1m),hostname shape took 45s
         # as a device scatter vs ~25s host — and the CPU-pinned
         # baseline runs the same host code, so parity is the floor)
+        # an open "segagg" route breaker steers the segment reductions
+        # to segment_aggregate_host — the byte-identical path small
+        # grids always take (device fault domain, ops/devicefault.py)
+        from ..ops.devicefault import route_on as _seg_route_on
         use_host = (n_rows <= HOST_AGG_THRESHOLD
                     or n_rows < num_segments or spec.sumsq
-                    or num_segments > BLOCK_MAX_CELLS)
+                    or num_segments > BLOCK_MAX_CELLS
+                    or not _seg_route_on("segagg"))
         from ..utils.stats import bump as _bump_r
         _bump_r(EXEC_STATS, "host_reductions" if use_host
                 else "device_reductions")
@@ -2486,7 +2612,8 @@ class QueryExecutor:
                         lstack=lstack: multi_segment_aggregate(
                             vstack, mstack, lstack, seg_p, times_p,
                             num_segments, spec, sorted_ids=seg_sorted,
-                            host_gather=gather))
+                            host_gather=gather),
+                        ctx=ctx, span=span)
                     vstack = mstack = lstack = None
                     for i, f in enumerate(names):
                         field_results[f] = SegmentAggResult(
@@ -2532,7 +2659,8 @@ class QueryExecutor:
                                       seg_p, times_p,
                                       num_segments, spec,
                                       sorted_ids=seg_sorted,
-                                      host_gather=gather))
+                                      host_gather=gather),
+                    ctx=ctx, span=span)
                 if gather:
                     sel_results[fname] = vals_p
                 if field_exact:
@@ -2620,7 +2748,8 @@ class QueryExecutor:
                                     # stream the result pull alongside
                                     # the block-path pulls
                                     pipe.submit(("dense", idx_d),
-                                                (res_t, lsum_d))
+                                                (res_t, lsum_d),
+                                                route="dense")
                             continue
                     rkey = (fp, fname, "dense_res", spec)
                     res = dcache.get(rkey) if dcache else None

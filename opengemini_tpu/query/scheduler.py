@@ -87,13 +87,17 @@ class SchedShed(ErrQueryError):
     device time. ``http_code`` 429 (over budget / queue full / queued
     too long → client should back off and retry) or 503 (scheduler
     paused or draining); ``retry_after_s`` feeds the Retry-After
-    header."""
+    header. ``reason`` is a stable machine-readable tag (e.g.
+    ``hbm_pressure``) surfaced in the HTTP error payload so clients
+    and dashboards can distinguish WHY they were shed without parsing
+    prose."""
 
     def __init__(self, msg: str, http_code: int = 429,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0, reason: str = ""):
         super().__init__(msg)
         self.http_code = http_code
         self.retry_after_s = float(retry_after_s)
+        self.reason = reason
 
 
 class QueryCost:
@@ -167,6 +171,9 @@ SCHED_STATS: dict = register_counters("scheduler", {
     "shed_timeout": 0,         # plain slot-wait timeout (no budget)
     "shed_paused": 0,
     "shed_over_budget": 0,     # cost estimate above OG_SCHED_MAX_CELLS
+    "shed_hbm_pressure": 0,    # live ledger bytes + estimate over the
+    # OG_HBM_PRESSURE_MB limit (device fault domain: queued monsters
+    # shed 429 instead of OOMing post-admission)
     "ejected_killed": 0,       # KILL QUERY removed a queued entry
     "queue_wait_ms": 0,        # cumulative wait of granted entries
     "dispatched_launches": 0,  # launches routed through the dispatcher
@@ -402,7 +409,29 @@ class QueryScheduler:
                 f"{calib_note} exceeds the admission budget "
                 f"({self.max_cells}); narrow the time range or "
                 "grouping", http_code=429,
-                retry_after_s=self._retry_after())
+                retry_after_s=self._retry_after(),
+                reason="over_budget")
+        limit_mb = int(knobs.get("OG_HBM_PRESSURE_MB"))
+        if limit_mb > 0:
+            # live-pressure coupling (device fault domain): admission
+            # consults the LIVE HBM ledger — what is actually resident
+            # on device right now (cache tiers + in-flight pipeline
+            # buffers) — not just this query's plan estimate, so a
+            # queued monster sheds 429 here instead of OOMing after
+            # admission and riding the pressure ladder
+            from ..ops import hbm as _hbm
+            live = (_hbm.LEDGER.tier_bytes("device_cache")
+                    + _hbm.LEDGER.tier_bytes("pipeline"))
+            if live + cost.hbm_bytes > limit_mb << 20:
+                _bump("shed")
+                _bump("shed_hbm_pressure")
+                raise SchedShed(
+                    f"device HBM pressure: {live >> 20} MB tracked "
+                    f"live + {cost.hbm_bytes >> 20} MB estimated for "
+                    f"this query exceeds OG_HBM_PRESSURE_MB="
+                    f"{limit_mb}; retry after in-flight work drains",
+                    http_code=429, reason="hbm_pressure",
+                    retry_after_s=self._retry_after())
         with self._lock:
             if self.paused or self.draining:
                 _bump("shed")

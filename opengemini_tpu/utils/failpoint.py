@@ -12,6 +12,15 @@ them with actions:
     sleep:<ms>        delay the call site
     drop              return True (site-specific: caller drops the work)
     call              invoke a python callable (tests)
+    oom               raise FailpointOOM — message carries
+                      RESOURCE_EXHAUSTED so the device-error classifier
+                      (ops/devicefault.py) takes its real OOM path
+    transient         raise FailpointTransient — message carries
+                      UNAVAILABLE (the classifier's transient path)
+    hang              sleep arg ms (default 60000) in small slices,
+                      waking early when disable()/disable_all() runs —
+                      models a hung device launch the pull watchdog
+                      must bound without wedging test teardown
 
 Arming modifiers (pingcap term-expression analogs ``3*return`` /
 ``10%return``):
@@ -31,12 +40,25 @@ import random
 import threading
 import time
 
-__all__ = ["FailpointError", "enable", "disable", "disable_all",
+__all__ = ["FailpointError", "FailpointOOM", "FailpointTransient",
+           "enable", "disable", "disable_all",
            "inject", "active", "Failpoint", "list_points"]
 
 
 class FailpointError(RuntimeError):
     """Raised by an armed `error` failpoint."""
+
+
+class FailpointOOM(FailpointError):
+    """Injected device OOM. The message deliberately carries the
+    backend's RESOURCE_EXHAUSTED marker so the classifier in
+    ops/devicefault.py exercises the same string patterns a real
+    XlaRuntimeError would hit."""
+
+
+class FailpointTransient(FailpointError):
+    """Injected transient device/launch failure (UNAVAILABLE marker —
+    see FailpointOOM)."""
 
 
 class _Spec:
@@ -57,6 +79,10 @@ _hits: dict[str, int] = {}
 # schedules can make a whole run reproducible without touching the
 # global random state
 _rng = random.Random()
+# disarm epoch: `hang` sleeps poll this so disable()/disable_all()
+# (the conftest leak guard, a chaos heal) wakes a hung site instead of
+# leaving a background thread asleep for the full arg duration
+_EPOCH = 0
 
 
 def seed(n) -> None:
@@ -70,15 +96,18 @@ def enable(name: str, action: str = "error", arg: object = None,
     maxhits=N auto-disarms the point after N fires (one-shot: N=1);
     pct=P fires each pass with probability P percent."""
     global ACTIVE
-    if action not in ("error", "sleep", "drop", "call"):
+    if action not in ("error", "sleep", "drop", "call", "oom",
+                      "transient", "hang"):
         raise ValueError(f"unknown failpoint action {action}")
     if action == "call" and not callable(arg):
         raise ValueError("action 'call' requires a callable arg")
-    if action == "sleep":
+    if action in ("sleep", "hang"):
         try:
-            arg = float(arg or 0)
+            arg = float(arg) if arg is not None else \
+                (60_000.0 if action == "hang" else 0.0)
         except (TypeError, ValueError):
-            raise ValueError("action 'sleep' requires a numeric ms arg")
+            raise ValueError(
+                f"action {action!r} requires a numeric ms arg")
     if maxhits is not None:
         try:
             maxhits = int(maxhits)
@@ -100,19 +129,21 @@ def enable(name: str, action: str = "error", arg: object = None,
 
 
 def disable(name: str) -> None:
-    global ACTIVE
+    global ACTIVE, _EPOCH
     with _lock:
         _points.pop(name, None)
         _hits.pop(name, None)
         ACTIVE = bool(_points)
+        _EPOCH += 1
 
 
 def disable_all() -> None:
-    global ACTIVE
+    global ACTIVE, _EPOCH
     with _lock:
         _points.clear()
         _hits.clear()
         ACTIVE = False
+        _EPOCH += 1
 
 
 def active(name: str) -> bool:
@@ -151,8 +182,27 @@ def inject(name: str) -> bool:
         action, arg = spec.action, spec.arg
     if action == "error":
         raise FailpointError(arg or f"failpoint {name}")
+    if action == "oom":
+        raise FailpointOOM(
+            f"RESOURCE_EXHAUSTED: injected device OOM "
+            f"(failpoint {name})")
+    if action == "transient":
+        raise FailpointTransient(
+            f"UNAVAILABLE: injected transient device failure "
+            f"(failpoint {name})")
     if action == "sleep":
         time.sleep(float(arg or 0) / 1000.0)
+        return False
+    if action == "hang":
+        # bounded hang, woken early by any disarm — the site stays
+        # blocked the way a wedged launch would, but test teardown
+        # (disable_all) never inherits a sleeping background thread
+        epoch0 = _EPOCH
+        end = time.monotonic() + float(arg or 0) / 1000.0
+        while time.monotonic() < end:
+            if _EPOCH != epoch0:
+                break
+            time.sleep(0.05)
         return False
     if action == "drop":
         return True

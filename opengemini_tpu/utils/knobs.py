@@ -299,6 +299,39 @@ register("OG_SCHED_CALIB", str, "record",
          "only, `1` = also apply the learned per-class bias to "
          "admission charges")
 
+# --- device fault domain (ops/devicefault.py, ops/pipeline.py)
+register("OG_DEVICE_RETRY", int, 2,
+         "bounded retries for TRANSIENT-classified device launch "
+         "errors (0 disables retry; OOM gets its pressure-ladder "
+         "retry regardless)")
+register("OG_DEVICE_RETRY_BACKOFF_MS", float, 25.0,
+         "base backoff between transient device retries (jittered "
+         "exponential, deadline-clamped)")
+register("OG_DEVICE_BREAKER", bool, True,
+         "per-route device circuit breakers; 0 = classify/retry only, "
+         "never trip a route to its host fallback", scope="cached")
+register("OG_DEVICE_BREAKER_THRESHOLD", int, 3,
+         "consecutive classified device failures on one route before "
+         "its breaker opens (route falls back to the byte-identical "
+         "host path)")
+register("OG_DEVICE_BREAKER_COOLDOWN_S", float, 5.0,
+         "base breaker cooldown before a half-open probe re-tries the "
+         "device route (doubles per consecutive trip, capped 8x)")
+register("OG_DEVICE_HANG_S", float, 30.0,
+         "hung-launch watchdog: a streamed background pull stuck "
+         "longer than this (and past any tighter request deadline) is "
+         "abandoned — gate slot + pipeline HBM bytes reclaimed, route "
+         "breaker charged; <= 0 disables the bound")
+register("OG_HBM_PRESSURE_MB", int, 0,
+         "admission HBM-pressure limit: estimated query HBM plus live "
+         "tracked device bytes (ledger device_cache+pipeline tiers) "
+         "above this sheds 429 `hbm_pressure` with Retry-After; "
+         "0 disables the check")
+register("OG_HBM_PRESSURE_EVICT", bool, True,
+         "OOM pressure ladder may evict the device-cache tier (ledger-"
+         "mirrored) before the post-relief retry; 0 = shrink the "
+         "in-flight gate only")
+
 # --- flight recorder / tracing (utils/tracing.py, http/server.py)
 register("OG_TRACE_SAMPLE", float, 0.05,
          "head-sampling probability for the query/write flight "

@@ -482,6 +482,16 @@ def hbm_collector():
     return collector()
 
 
+def devicefault_collector():
+    """Device fault domain metrics (ops/devicefault.py): classified
+    error counts, retry/pressure-ladder/fallback counters, per-route
+    breaker state codes and trip counts, and confiscated in-flight
+    gate permits — the signals that say the TPU hot path is degrading
+    to host rather than failing."""
+    from ..ops.devicefault import devicefault_collector as _dfc
+    return _dfc()
+
+
 def wal_collector():
     """WAL metrics (reference statistics/wal analog)."""
     from ..storage.wal import WAL_STATS

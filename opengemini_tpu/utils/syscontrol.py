@@ -14,6 +14,13 @@ Commands (query params: ?mod=<cmd>[&switchon=true|false]):
                      [&arg=][&maxhits=N][&pct=P]); no point: list
     circuitbreaker — per-peer breaker states; &addr=<host:port>
                      &switchon=true trips it, =false resets it
+    devicebreaker  — per-route DEVICE breaker states (device fault
+                     domain, ops/devicefault.py) + confiscated gate
+                     permits; &route=<block|lattice|dense|segagg|
+                     finalize|pipeline> &switchon=true force-opens it
+                     (route serves from its host fallback), =false
+                     closes it; &action=reset drops all breaker state
+                     and returns gate permits
     scheduler      — device query scheduler: no action returns the
                      counters; &action=pause|resume|drain[&timeout=S]
                      (pause stops granting slots — running queries
@@ -101,6 +108,32 @@ class SysControl:
                 br = transport.breaker_for(addr)
                 br.force(self._flag(params))
                 return 200, {"addr": addr, **br.snapshot()}
+            if mod == "devicebreaker":
+                # per-route device breaker visibility + operator
+                # override (forcing open parks the route on its byte-
+                # identical host fallback; closing re-probes the
+                # device now). Same explicit-switchon contract as the
+                # per-peer transport breakers above
+                from ..ops import devicefault as df
+                route = params.get("route")
+                if params.get("action") == "reset":
+                    df.reset_breakers()
+                    return 200, {"devicebreaker": "reset"}
+                if not route:
+                    return 200, {"device_breakers":
+                                 df.breaker_snapshot(),
+                                 "gate_permits_shrunk":
+                                 df.shrunk_permits()}
+                if route not in df.ROUTES:
+                    return 404, {"error": f"unknown device route "
+                                 f"{route!r} (routes: "
+                                 f"{', '.join(df.ROUTES)})"}
+                if "switchon" not in params:
+                    return 200, {"route": route,
+                                 **df.breaker_for(route).snapshot()}
+                br = df.breaker_for(route)
+                br.force(self._flag(params))
+                return 200, {"route": route, **br.snapshot()}
             if mod == "scheduler":
                 # serving-runtime admin plane (query/scheduler.py):
                 # stats snapshot, pause/resume of slot grants + launch

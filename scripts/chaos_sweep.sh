@@ -2,8 +2,12 @@
 # Chaos sweep: run N seeded fault schedules (tests/test_chaos.py
 # slow schedules) and print a per-seed pass/fail table.
 #
-#   scripts/chaos_sweep.sh [N] [BASE_SEED]
+#   scripts/chaos_sweep.sh [--device] [N] [BASE_SEED]
 #
+#   --device   run the DEVICE-fault storms (test_device_chaos_schedule:
+#              OOM / transient / hang across the device dispatch routes,
+#              digest + ledger + breaker-heal contract) instead of the
+#              cluster kill/restart/delay/drop schedules
 #   N          number of seeds to run (default 5)
 #   BASE_SEED  first seed (default 1); seeds are BASE..BASE+N-1
 #
@@ -12,6 +16,13 @@
 #   CHAOS_SEEDS=<seed> python -m pytest tests/test_chaos.py -m slow -q
 set -u
 
+TEST=test_chaos_schedule
+LABEL=cluster
+if [ "${1:-}" = "--device" ]; then
+    TEST=test_device_chaos_schedule
+    LABEL=device
+    shift
+fi
 N=${1:-5}
 BASE=${2:-1}
 TIMEOUT=${CHAOS_TIMEOUT:-600}
@@ -25,8 +36,8 @@ for ((i = 0; i < N; i++)); do
     seed=$((BASE + i))
     t0=$SECONDS
     if timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu CHAOS_SEEDS=$seed \
-        python -m pytest tests/test_chaos.py::test_chaos_schedule \
-        -q -m slow -p no:cacheprovider >"/tmp/chaos_seed_$seed.log" 2>&1
+        python -m pytest "tests/test_chaos.py::$TEST" \
+        -q -m slow -p no:cacheprovider >"/tmp/chaos_${LABEL}_seed_$seed.log" 2>&1
     then
         res=PASS; pass=$((pass + 1))
     else
@@ -37,5 +48,6 @@ for ((i = 0; i < N; i++)); do
     rows="$rows $seed:$res"
 done
 echo "----"
-echo "chaos sweep: $pass passed, $fail failed (logs: /tmp/chaos_seed_<seed>.log)"
+echo "$LABEL chaos sweep: $pass passed, $fail failed" \
+     "(logs: /tmp/chaos_${LABEL}_seed_<seed>.log)"
 [ "$fail" -eq 0 ]

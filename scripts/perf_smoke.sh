@@ -9,9 +9,13 @@
 # shape — including the scaled-down 1m heavy shape and the forced
 # lattice route — with OG_DEVICE_FINALIZE=0 (legacy limb transport)
 # and =1 (on-device finalize + op-aware plane pruning, the default):
-# any cell mismatch between the two is fatal. Runs a scaled-down
-# bench dataset on the CPU backend with per-phase output — CI-safe
-# (no accelerator needed, minutes of wall).
+# any cell mismatch between the two is fatal. The device fault domain
+# (PR 9) adds a chaos gate: one seeded OOM/transient/hang schedule per
+# bench shape must keep digests equal to the fault-free references
+# with zero HBM-ledger drift, and the breaker trip->half-open->restore
+# cycle reports fault_recovery_ms. Runs a scaled-down bench dataset on
+# the CPU backend with per-phase output — CI-safe (no accelerator
+# needed, minutes of wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]
@@ -63,6 +67,14 @@ assert "observatory" in r.get("configs", []), r
 assert r.get("obs_ledger_reconciled") == 1, r
 assert r.get("obs_util_samples", 0) > 0, r
 assert "obs_overhead_pct" in r, r
+# device fault domain chaos gate (PR 9): seeded OOM/transient/hang
+# schedules on every shape must fire (injections > 0), keep digests
+# equal to the fault-free references, leave zero ledger drift, and
+# the breaker trip -> half-open -> restore cycle must complete with
+# a measured fault_recovery_ms
+assert r.get("chaos_injections", 0) > 0, r
+assert r.get("chaos_ledger_ok") == 1, r
+assert r.get("fault_recovery_ms", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -70,6 +82,9 @@ print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
 print(f"observatory gate OK: overhead {r['obs_overhead_pct']}% "
       f"(on {r['obs_e2e_on_ms']}ms), ledger reconciled, "
       f"{r['obs_util_samples']} util samples")
+print(f"chaos gate OK: {r['chaos_injections']} device faults "
+      f"injected, zero ledger drift, breaker recovery "
+      f"{r['fault_recovery_ms']}ms")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

@@ -17,12 +17,22 @@ CONTRACT after every step:
   I4  acked durability — once the cluster heals, every write acked with
       204 is queryable (replica takeover included).
 
+PR 9 extended the harness to the DEVICE stack: ``run_device_schedule``
+storms the TPU hot path (OOM / transient / hang injections across the
+block / lattice / finalize routes and the streaming pipeline — the
+shapes it runs always take the block family; the dense / segagg
+routes and the device-cache fill get per-injection parity coverage
+with fired-verification in tests/test_device_faults.py instead) and
+asserts the device contract D1–D3 documented next to
+``DEVICE_FAULT_SITES`` below.
+
 Not a pytest module itself — tests/test_chaos.py drives it.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
 import socket
 import time
@@ -300,3 +310,171 @@ def run_schedule(root, seed: int, steps: int = 8,
         return stats
     finally:
         c.close()
+
+
+# ------------------------------------------- device-fault schedules
+
+# The PR 9 device fault domain turned the chaos harness into a
+# device-stack tool: seeded storms drive OOM / transient / hang
+# injections across the device dispatch routes the storm shapes
+# actually execute (block / lattice / finalize) and the streaming
+# pipeline, asserting the DEVICE failure contract after every step.
+# The dense and segagg routes (plus devicecache.fill, which only
+# fires with OG_DENSE_DEVICE on) are stormed per-injection in
+# tests/test_device_faults.py's parity matrix, which verifies each
+# site FIRED — sites this harness cannot drive are excluded here
+# rather than armed as dead weight:
+#
+#   D1 byte identity — results under any injected device fault are
+#      bit-identical to the fault-free digest (faults change latency,
+#      never bytes: retry / HBM-pressure ladder / breaker fallback).
+#   D2 exact ledger — hbm.cross_check() reconciles exactly after every
+#      storm (no pipeline-tier bytes or cache mirrors leak).
+#   D3 clean heal — after disarm + recovery no route breaker stays
+#      open and no confiscated OG_SCHED_DEPTH gate permit is held.
+
+DEVICE_FAULT_SITES = [
+    # (failpoint site, modes worth injecting there)
+    ("device.block.launch", ("oom", "transient", "hang")),
+    ("device.lattice.launch", ("oom", "transient")),
+    ("device.finalize.launch", ("oom", "transient")),
+    ("pipeline.submit", ("oom", "transient")),
+    ("pipeline.pull", ("oom", "transient", "hang")),
+    ("pipeline.unpack", ("transient",)),
+    ("blockagg.lattice_fold", ("oom",)),
+]
+
+
+def _device_digest(res: dict) -> str:
+    import hashlib
+    dig = hashlib.sha256()
+    for s in sorted(res.get("series", []),
+                    key=lambda s: json.dumps(s.get("tags", {}),
+                                             sort_keys=True)):
+        dig.update(json.dumps(s.get("tags", {}),
+                              sort_keys=True).encode())
+        for r in s["values"]:
+            dig.update(repr(tuple(r)).encode())
+    return dig.hexdigest()
+
+
+def run_device_schedule(root, seed: int, steps: int = 6,
+                        queries_per_step: int = 2) -> dict:
+    """One seeded device-fault storm against an in-process engine +
+    executor (the device stack needs no cluster): every step arms a
+    random site/mode from DEVICE_FAULT_SITES (short hangs, pct- or
+    maxhits-armed), runs queries on both the block and forced-lattice
+    shapes, and asserts D1–D3. Returns run stats."""
+    import numpy as np
+
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.ops import devicefault as df
+    from opengemini_tpu.ops import hbm
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.storage import Engine, EngineOptions
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+
+    rng = random.Random(seed)
+    failpoint.seed(seed)
+    stats = {"seed": seed, "ops": [], "queries": 0, "retries": 0,
+             "fallbacks": 0, "breaker_trips": 0}
+    eng = Engine(str(root / "devchaos"),
+                 EngineOptions(shard_duration=1 << 62))
+    vrng = np.random.default_rng(seed)
+    vals = np.round(vrng.normal(50.0, 12.0, (4, 240)), 2)
+    lines = [f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}"
+             for h in range(4) for i in range(240)]
+    eng.write_points("devchaos", parse_lines("\n".join(lines)))
+    for s in eng.database("devchaos").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    qtext = ("SELECT mean(u), sum(u), count(u) FROM cpu WHERE "
+             "time >= 0 AND time < 2400000000000 "
+             "GROUP BY time(1m), host")
+    (stmt,) = parse_query(qtext)
+    ratio0, cells0 = E.BLOCK_MIN_RATIO, E.BLOCK_MAX_CELLS
+    packed0 = E.BLOCK_MIN_RATIO_PACKED
+    # hangs must trip the watchdog inside the step, not stall the run
+    os.environ["OG_DEVICE_HANG_S"] = "0.3"
+    os.environ["OG_DEVICE_RETRY_BACKOFF_MS"] = "1"
+    os.environ["OG_DEVICE_BREAKER_COOLDOWN_S"] = "0.05"
+    df.reset_breakers()
+    try:
+        E.BLOCK_MIN_RATIO = 0
+
+        def run_shape(forced_lattice: bool) -> str:
+            if forced_lattice:
+                E.BLOCK_MAX_CELLS = 8
+                E.BLOCK_MIN_RATIO_PACKED = 0
+            else:
+                E.BLOCK_MAX_CELLS = cells0
+                E.BLOCK_MIN_RATIO_PACKED = packed0
+            res = ex.execute(stmt, "devchaos")
+            assert "error" not in res, (
+                f"D1 violated: device fault surfaced as a query "
+                f"error: {res.get('error')}")
+            return _device_digest(res)
+
+        refs = {fl: run_shape(fl) for fl in (False, True)}
+        c0 = df.devicefault_collector()
+        for _ in range(steps):
+            site, modes = rng.choice(DEVICE_FAULT_SITES)
+            mode = rng.choice(list(modes))
+            arming = rng.choice(["maxhits", "pct"])
+            arg = 600 if mode == "hang" else None
+            if arming == "maxhits":
+                failpoint.enable(site, mode, arg,
+                                 maxhits=rng.choice([1, 2]))
+            else:
+                failpoint.enable(site, mode, arg,
+                                 pct=rng.choice([25, 50]))
+            stats["ops"].append(f"{site}:{mode}:{arming}")
+            for _q in range(queries_per_step):
+                fl = rng.random() < 0.5
+                stats["queries"] += 1
+                got = run_shape(fl)
+                assert got == refs[fl], (
+                    f"D1 violated: {site}/{mode} changed bytes on "
+                    f"shape lattice={fl}")
+            failpoint.disable(site)
+            cross = hbm.cross_check()
+            assert cross["ok"], (
+                f"D2 violated after {site}/{mode}: {cross}")
+        # heal: faults gone — probe the routes back closed, then the
+        # no-leak contract
+        failpoint.disable_all()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            for fl in (False, True):
+                assert run_shape(fl) == refs[fl]
+            open_routes = [r for r, s in
+                           df.breaker_snapshot().items()
+                           if s["state"] != "closed"]
+            if not open_routes:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"D3 violated: routes never recovered: "
+                f"{df.breaker_snapshot()}")
+        c1 = df.devicefault_collector()
+        stats["retries"] = c1["retries"] - c0["retries"]
+        stats["fallbacks"] = (c1["route_fallbacks"]
+                              - c0["route_fallbacks"])
+        stats["breaker_trips"] = (c1["breaker_trips"]
+                                  - c0["breaker_trips"])
+        cross = hbm.cross_check()
+        assert cross["ok"], f"D2 violated after heal: {cross}"
+        df.reset_breakers()
+        assert df.shrunk_permits() == 0, "D3 violated: gate permits"
+        return stats
+    finally:
+        E.BLOCK_MIN_RATIO = ratio0
+        E.BLOCK_MAX_CELLS = cells0
+        E.BLOCK_MIN_RATIO_PACKED = packed0
+        for k in ("OG_DEVICE_HANG_S", "OG_DEVICE_RETRY_BACKOFF_MS",
+                  "OG_DEVICE_BREAKER_COOLDOWN_S"):
+            os.environ.pop(k, None)
+        failpoint.disable_all()
+        df.reset_breakers()
+        eng.close()

@@ -67,16 +67,32 @@ def _failpoint_hygiene():
     with a baffling message). Teardown disarms everything FIRST so one
     leak cannot cascade, then fails the leaking test by name. Also
     resets per-peer circuit breakers — an OS-recycled port must not
-    inherit another test's open breaker."""
+    inherit another test's open breaker — and (device fault domain)
+    the per-route DEVICE breakers + confiscated OG_SCHED_DEPTH gate
+    permits: an open "block" breaker or a shrunk gate left behind by
+    one injection test would silently reroute every later test onto
+    host fallbacks."""
     from opengemini_tpu.cluster.transport import reset_breakers
+    from opengemini_tpu.ops import devicefault
     from opengemini_tpu.utils import failpoint
     yield
     leaked = failpoint.list_points()
     failpoint.disable_all()
     reset_breakers()
+    leaked_permits = devicefault.shrunk_permits()
+    open_routes = [r for r, s in devicefault.breaker_snapshot().items()
+                   if s["state"] != "closed"]
+    devicefault.reset_breakers()      # also restores gate permits
     assert not leaked, (
         f"test leaked armed failpoints {sorted(leaked)} — disarm via "
         f"Failpoint context manager or failpoint.disable/disable_all")
+    assert not open_routes, (
+        f"test leaked open device route breakers {open_routes} — "
+        "reset via devicefault.reset_breakers() (or close with "
+        "record_success) before returning")
+    assert leaked_permits == 0, (
+        f"test leaked {leaked_permits} confiscated gate permit(s) — "
+        "call devicefault.restore_gate_permits()")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,29 @@
+"""R7 failing fixture: broad excepts around device launch/pull/fill
+sites that swallow faults the classifier must see."""
+import jax
+
+
+def swallowed_drain(tree):
+    # R701: pass-swallows a pull failure — OOM/backend death never
+    # reaches the fault ladder
+    try:
+        jax.block_until_ready(tree)
+    except Exception:
+        pass
+
+
+def swallowed_fill(cache, fp, field, e_key, vals, valid, limbs):
+    # R701: the H2D cache fill (classic OOM site) degrades silently
+    try:
+        return cache.put_decoded_planes(fp, field, e_key, vals, valid,
+                                        limbs)
+    except Exception:
+        return None
+
+
+def swallowed_bare(x):
+    # R701: bare except is broader still
+    try:
+        return jax.device_put(x)
+    except:  # noqa: E722
+        return None

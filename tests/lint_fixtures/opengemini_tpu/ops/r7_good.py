@@ -1,0 +1,40 @@
+"""R7 passing fixture: broad excepts the rule must NOT flag — the
+handler classifies, re-raises, carries a reviewed pragma, or the try
+body is not a device site at all."""
+import jax
+
+from opengemini_tpu.ops import devicefault
+
+
+def classified_drain(tree):
+    # handler consults the classifier and re-raises device classes:
+    # the pipeline drain idiom
+    try:
+        jax.block_until_ready(tree)
+    except Exception as e:
+        if devicefault.classify(e) is not None:
+            raise
+
+
+def reraising_launch(fn):
+    # handler re-raises after local cleanup — the fault still travels
+    try:
+        return fn(jax.device_put(0))
+    except Exception:
+        raise
+
+
+def reviewed_probe():
+    # fail-closed backend probe: swallowing is the reviewed contract
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # oglint: disable=R701 — reviewed: fails closed
+        return None
+
+
+def not_a_device_site(rows):
+    # broad except around pure host code: out of scope
+    try:
+        return sum(int(r) for r in rows)
+    except Exception:
+        return 0

@@ -164,3 +164,30 @@ def test_chaos_schedule(tmp_path, seed):
     # run_schedule itself asserts the contract (I1-I4) per step and
     # that a healed cluster acks writes again
     assert stats["queries"] > 0
+
+
+# ---------------------------------------- device-fault storms (PR 9)
+
+
+def test_device_chaos_smoke(tmp_path):
+    """Tier-1 smoke: one seeded device-fault storm (OOM / transient /
+    hang across the device dispatch routes and the streaming
+    pipeline). run_device_schedule asserts the device contract per
+    step: bit-identical digests vs the fault-free runs (D1), exact
+    HBM cross_check after every storm (D2), breakers closed + zero
+    confiscated gate permits after heal (D3)."""
+    from chaos import run_device_schedule
+    stats = run_device_schedule(tmp_path, seed=42, steps=4)
+    assert stats["queries"] > 0
+    assert stats["ops"], stats
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_device_chaos_schedule(tmp_path, seed):
+    """Longer randomized device-fault storms (scripts/chaos_sweep.sh
+    --device). Reproduce with CHAOS_SEEDS=<seed>."""
+    from chaos import run_device_schedule
+    stats = run_device_schedule(tmp_path, seed, steps=10,
+                                queries_per_step=3)
+    assert stats["queries"] > 0

@@ -1,0 +1,753 @@
+"""Device fault domain (ops/devicefault.py): classifier, per-route
+breakers, retry/HBM-pressure ladder, hung-pull watchdog, KILL-leak
+reclaim, HBM-pressure admission — and the parity contract: every
+injection mode × device route must produce results bit-identical to
+the fault-free run (injected faults change latency, never bytes)."""
+
+import hashlib
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import devicefault as df
+from opengemini_tpu.ops import hbm
+from opengemini_tpu.ops.devicefault import (DeviceRouteDown,
+                                            RouteBreaker, classify,
+                                            guarded_launch)
+from opengemini_tpu.utils import failpoint
+from opengemini_tpu.utils.failpoint import (FailpointError,
+                                            FailpointOOM,
+                                            FailpointTransient)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with closed breakers, no armed
+    points and no confiscated gate permits (the conftest leak guard
+    would fail the test otherwise — this keeps intra-file ordering
+    honest too)."""
+    df.reset_breakers()
+    yield
+    failpoint.disable_all()
+    df.reset_breakers()
+
+
+# ------------------------------------------------------- classifier
+
+
+def test_classify_oom_markers():
+    assert classify(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1g")) == "oom"
+    assert classify(RuntimeError("Failed to allocate 8.0G")) == "oom"
+    assert classify(MemoryError()) == "oom"
+    assert classify(FailpointOOM(
+        "RESOURCE_EXHAUSTED: injected device OOM")) == "oom"
+
+
+def test_classify_transient_markers():
+    assert classify(RuntimeError("UNAVAILABLE: socket closed")) \
+        == "transient"
+    assert classify(ConnectionResetError("peer reset")) == "transient"
+    assert classify(FailpointTransient(
+        "UNAVAILABLE: injected transient device failure")) \
+        == "transient"
+
+
+def test_classify_fatal_markers():
+    assert classify(RuntimeError(
+        "FAILED_PRECONDITION: device halted")) == "backend-fatal"
+    assert classify(RuntimeError("DATA_LOSS: corrupt")) \
+        == "backend-fatal"
+
+
+def test_classify_oom_wins_over_wrapped_internal():
+    # backends wrap: RESOURCE_EXHAUSTED must win the classification
+    assert classify(RuntimeError(
+        "INTERNAL: program failed: RESOURCE_EXHAUSTED while "
+        "allocating")) == "oom"
+
+
+def test_classify_unnamed_xla_error_is_transient():
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert classify(XlaRuntimeError("something opaque")) == "transient"
+
+
+def test_classify_never_touches_engine_errors():
+    """Typed query/engine errors own their meaning — even when a
+    backend-looking string leaks into the message."""
+    from opengemini_tpu.query.manager import QueryKilled
+    from opengemini_tpu.utils.errors import ErrQueryTimeout, GeminiError
+    assert classify(QueryKilled("killed: RESOURCE_EXHAUSTED talk")) \
+        is None
+    assert classify(ErrQueryTimeout("deadline UNAVAILABLE")) is None
+    assert classify(GeminiError("whatever")) is None
+    assert classify(ValueError("plain bug")) is None
+    assert classify(DeviceRouteDown("block")) is None
+
+
+# ---------------------------------------------------- route breaker
+
+
+def test_breaker_trips_after_threshold(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "3")
+    br = RouteBreaker("block")
+    for _ in range(2):
+        br.record_failure()
+        assert br.allow()                      # still closed
+    br.record_failure()
+    assert br.is_open and not br.allow()
+    snap = br.snapshot()
+    assert snap["state"] == "open" and snap["trips"] == 1
+    assert snap["probe_in_s"] >= 0
+
+
+def test_breaker_half_open_probe_recovers(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_COOLDOWN_S", "0.05")
+    br = RouteBreaker("lattice")
+    br.record_failure()
+    assert not br.allow()
+    time.sleep(0.12)                            # > jittered cooldown
+    assert br.allow()                           # THE half-open probe
+    assert br.snapshot()["state"] == "half_open"
+    assert not br.allow()                       # only one probe
+    br.record_success()
+    snap = br.snapshot()
+    assert snap["state"] == "closed" and snap["recoveries"] == 1
+    assert br.allow()
+
+
+def test_breaker_probe_failure_reopens_longer(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_COOLDOWN_S", "0.05")
+    br = RouteBreaker("dense")
+    br.record_failure()
+    time.sleep(0.12)
+    assert br.allow()
+    br.record_failure()                         # probe lost
+    snap = br.snapshot()
+    assert snap["state"] == "open" and snap["trips"] == 2
+    assert br.open_cycles == 2                  # cooldown doubled
+
+
+def test_breaker_force_and_disable_knob(monkeypatch):
+    br = RouteBreaker("segagg")
+    br.force(True)
+    assert not br.allow()
+    monkeypatch.setenv("OG_DEVICE_BREAKER", "0")
+    assert br.allow()                           # knob bypasses gating
+    monkeypatch.delenv("OG_DEVICE_BREAKER")
+    br.force(False)
+    assert br.allow() and not br.is_open
+
+
+def test_route_on_and_snapshot_roundtrip():
+    assert df.route_on("block")
+    df.breaker_for("block").force(True)
+    assert not df.route_on("block")
+    snap = df.breaker_snapshot()
+    assert snap["block"]["state"] == "open"
+    df.reset_breakers()
+    assert df.route_on("block")
+
+
+# ------------------------------------------------- guarded_launch
+
+
+def test_guarded_launch_transient_retries_then_succeeds(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_RETRY", "2")
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: transfer failed")
+        return "ok"
+
+    assert guarded_launch("block", fn) == "ok"
+    assert len(calls) == 3
+    assert not df.breaker_for("block").is_open
+
+
+def test_guarded_launch_retry_budget_exhaustion(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_RETRY", "1")
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+
+    def fn():
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    with pytest.raises(DeviceRouteDown) as ei:
+        guarded_launch("lattice", fn)
+    assert ei.value.route == "lattice"
+    assert df.breaker_for("lattice").is_open
+
+
+def test_guarded_launch_oom_runs_ladder_then_retry(monkeypatch):
+    monkeypatch.setenv("OG_HBM_PRESSURE_EVICT", "1")
+    relief_ran = []
+    monkeypatch.setattr(
+        df, "hbm_pressure_relief",
+        lambda route, nbytes_hint=0: relief_ran.append(route) or 0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: OOM")
+        return 42
+
+    assert guarded_launch("dense", fn) == 42
+    assert relief_ran == ["dense"]              # one ladder run
+    assert len(calls) == 2                      # exactly one retry
+
+
+def test_guarded_launch_oom_exhaustion_trips(monkeypatch):
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    monkeypatch.setattr(df, "hbm_pressure_relief",
+                        lambda route, nbytes_hint=0: 0)
+
+    def fn():
+        raise RuntimeError("RESOURCE_EXHAUSTED: still OOM")
+
+    with pytest.raises(DeviceRouteDown):
+        guarded_launch("finalize", fn)
+    assert df.breaker_for("finalize").is_open
+
+
+def test_guarded_launch_never_masks_logic_bugs():
+    def fn():
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        guarded_launch("block", fn)
+    assert not df.breaker_for("block").is_open  # not charged
+
+
+def test_guarded_launch_failpoint_site(monkeypatch):
+    """The device.<route>.launch failpoint drives the real ladder:
+    maxhits=1 transient costs one retry, then the launch succeeds."""
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    failpoint.enable("device.block.launch", "transient", maxhits=1)
+    assert guarded_launch("block", lambda: "v") == "v"
+    failpoint.disable("device.block.launch")
+
+
+def test_guarded_launch_gives_up_for_killed_ctx(monkeypatch):
+    """Retrying for a dead request burns device for nothing: a killed
+    ctx short-circuits the ladder with the original error."""
+    monkeypatch.setenv("OG_DEVICE_RETRY", "5")
+
+    class Ctx:
+        killed = True
+
+        def check(self):
+            raise AssertionError("not reached on the raise path")
+
+    with pytest.raises(RuntimeError):
+        guarded_launch("block",
+                       lambda: (_ for _ in ()).throw(
+                           RuntimeError("UNAVAILABLE: flaky")),
+                       ctx=Ctx())
+
+
+# ------------------------------------------- HBM pressure ladder
+
+
+def test_pressure_relief_evicts_device_cache(monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    monkeypatch.setenv("OG_HBM_PRESSURE_EVICT", "1")
+    cache = dc.global_cache()
+    before_dev = hbm.LEDGER.tier_bytes("device_cache")
+    cache.put_sized(("df", 1), np.zeros(8), 1000)
+    cache.put_sized(("df", 2), np.zeros(8), 2000)
+    booked = cache.stats()["bytes"]             # incl. +64/entry
+    assert hbm.LEDGER.tier_bytes("device_cache") == before_dev + booked
+    freed = df.hbm_pressure_relief("block")
+    assert freed == booked
+    assert cache.stats()["bytes"] == 0
+    assert hbm.LEDGER.tier_bytes("device_cache") == before_dev
+    # the eviction lands in the pressure-event ring with its reason
+    evs = [e for e in hbm.LEDGER.snapshot()["events"]
+           if e["reason"] == "oom_relief"]
+    assert evs and evs[-1]["bytes"] == booked
+    assert hbm.cross_check()["ok"]
+    monkeypatch.setattr(dc, "_CACHE", None)
+
+
+def test_pressure_relief_evict_knob_off(monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "64")
+    monkeypatch.setenv("OG_HBM_PRESSURE_EVICT", "0")
+    cache = dc.global_cache()
+    cache.put_sized(("keep", 1), np.zeros(8), 512)
+    booked = cache.stats()["bytes"]
+    try:
+        assert df.hbm_pressure_relief("block") == 0
+        assert cache.stats()["bytes"] == booked  # untouched
+    finally:
+        cache.purge()
+        monkeypatch.setattr(dc, "_CACHE", None)
+
+
+def test_evict_bytes_partial_and_full(monkeypatch):
+    from opengemini_tpu.ops.devicecache import DeviceBlockCache
+    led = hbm.HBMLedger()
+    c = DeviceBlockCache(1 << 20, tier="device_cache", ledger=led)
+    for i in range(4):
+        c.put_sized(("k", i), np.zeros(4), 100)
+    per = 100 + 64                              # +64/entry overhead
+    assert c.evict_bytes(per + 1) == 2 * per    # LRU pair out
+    assert c.stats()["bytes"] == 2 * per
+    assert led.tier_bytes("device_cache") == 2 * per
+    assert c.evict_bytes(None) == 2 * per       # rest
+    assert led.tier_bytes("device_cache") == 0
+
+
+# --------------------------------------- pipeline watchdog + reclaim
+
+
+def _ledger_pipeline_bytes() -> int:
+    return hbm.LEDGER.tier_bytes("pipeline")
+
+
+def test_watchdog_abandons_hung_pull(monkeypatch):
+    """A pull hung past OG_DEVICE_HANG_S is abandoned: collect raises
+    DeviceRouteDown, the depth permit + gate slot + pipeline-tier
+    ledger bytes come back NOW, and the wedged thread's own release
+    later is a no-op (idempotent _Pull)."""
+    from opengemini_tpu.ops.pipeline import StreamingPipeline
+    monkeypatch.setenv("OG_DEVICE_HANG_S", "0.2")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "99")
+    base = _ledger_pipeline_bytes()
+    gate = threading.BoundedSemaphore(2)
+    pipe = StreamingPipeline(depth=2, gate=gate)
+    failpoint.enable("pipeline.pull", "hang", 30_000)
+    pipe.submit(("k", 0), (jax.device_put(np.zeros(64)),),
+                route="block")
+    with pytest.raises(DeviceRouteDown) as ei:
+        pipe.collect()
+    assert ei.value.route == "block"
+    assert _ledger_pipeline_bytes() == base     # bytes reclaimed
+    assert gate.acquire(blocking=False)         # slot reclaimed
+    gate.release()
+    failpoint.disable_all()                     # wakes the hung sleep
+    time.sleep(0.15)                            # thread finishes: its
+    assert _ledger_pipeline_bytes() == base     # release must no-op
+    from opengemini_tpu.ops.pipeline import reap_thread_pipes
+    reap_thread_pipes()
+
+
+def test_collect_classifies_pull_failure(monkeypatch):
+    """A device-classified failure on the puller thread charges the
+    submission's route breaker and resurfaces as DeviceRouteDown."""
+    from opengemini_tpu.ops.pipeline import StreamingPipeline
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    base = _ledger_pipeline_bytes()
+    pipe = StreamingPipeline(depth=2)
+    failpoint.enable("pipeline.pull", "oom", maxhits=1)
+    pipe.submit(("k", 0), (jax.device_put(np.zeros(8)),),
+                route="lattice")
+    with pytest.raises(DeviceRouteDown) as ei:
+        pipe.collect()
+    assert ei.value.route == "lattice"
+    assert df.breaker_for("lattice").is_open
+    assert _ledger_pipeline_bytes() == base
+
+
+def test_submit_failure_enters_fault_domain(monkeypatch):
+    from opengemini_tpu.ops.pipeline import StreamingPipeline
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    pipe = StreamingPipeline(depth=2)
+    failpoint.enable("pipeline.submit", "oom", maxhits=1)
+    with pytest.raises(DeviceRouteDown) as ei:
+        pipe.submit(("k", 0), (jax.device_put(np.zeros(8)),),
+                    route="dense")
+    assert ei.value.route == "dense"
+    assert df.breaker_for("dense").is_open
+    from opengemini_tpu.ops.pipeline import reap_thread_pipes
+    assert reap_thread_pipes() == 0             # nothing in flight
+
+
+def test_kill_during_collect_reclaims_everything():
+    """The PR 9 leak fix: KILL QUERY mid-pull must leave zero gate
+    slots held and zero pipeline-tier ledger bytes booked."""
+    from opengemini_tpu.query.manager import QueryKilled, QueryManager
+    from opengemini_tpu.ops.pipeline import StreamingPipeline
+    base = _ledger_pipeline_bytes()
+    qm = QueryManager()
+    ctx = qm.attach("SELECT 1", "db0")
+    gate = threading.BoundedSemaphore(1)
+    pipe = StreamingPipeline(depth=1, gate=gate, ctx=ctx)
+    failpoint.enable("pipeline.pull", "hang", 30_000)
+    pipe.submit(("k", 0), (jax.device_put(np.zeros(128)),),
+                route="block")
+    assert _ledger_pipeline_bytes() > base
+    ctx.kill()
+    with pytest.raises(QueryKilled):
+        pipe.collect()
+    assert _ledger_pipeline_bytes() == base
+    assert gate.acquire(blocking=False)         # slot came back
+    gate.release()
+    assert ctx.hbm_live == 0                    # ctx attribution too
+    failpoint.disable_all()
+    qm.detach(ctx)
+
+
+def test_deadline_expiry_during_collect_reclaims():
+    from opengemini_tpu.ops.pipeline import StreamingPipeline
+    from opengemini_tpu.utils import deadline
+    from opengemini_tpu.utils.errors import ErrQueryTimeout
+    base = _ledger_pipeline_bytes()
+    pipe = StreamingPipeline(depth=1)
+    failpoint.enable("pipeline.pull", "hang", 30_000)
+    with deadline.bind(0.15, what="query"):
+        pipe.submit(("k", 0), (jax.device_put(np.zeros(64)),),
+                    route="block")
+        with pytest.raises(ErrQueryTimeout):
+            pipe.collect()
+    assert _ledger_pipeline_bytes() == base
+    failpoint.disable_all()
+
+
+def test_reap_thread_pipes_on_error_paths():
+    """An exception that skips collect() entirely (a bug mid-dispatch)
+    still reclaims via the executor's finally → reap_thread_pipes."""
+    from opengemini_tpu.ops.pipeline import (StreamingPipeline,
+                                             reap_thread_pipes)
+    base = _ledger_pipeline_bytes()
+    failpoint.enable("pipeline.pull", "hang", 30_000)
+    pipe = StreamingPipeline(depth=2)
+    pipe.submit(("k", 0), (jax.device_put(np.zeros(32)),),
+                route="block")
+    assert _ledger_pipeline_bytes() > base
+    assert reap_thread_pipes() == 1
+    assert _ledger_pipeline_bytes() == base
+    failpoint.disable_all()
+    assert reap_thread_pipes() == 0             # idempotent
+
+
+def test_hang_action_wakes_on_disarm():
+    """The hang failpoint must not outlive its disarm: teardown can't
+    inherit a thread asleep for the full 60s default."""
+    failpoint.enable("x.hang", "hang", 60_000)
+    done = threading.Event()
+
+    def run():
+        failpoint.inject("x.hang")
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not done.is_set()
+    failpoint.disable_all()
+    assert done.wait(2.0), "hang did not wake on disarm"
+
+
+# ------------------------------------------------ admission pressure
+
+
+def test_admission_sheds_hbm_pressure(monkeypatch):
+    from opengemini_tpu.query.scheduler import (QueryCost,
+                                                QueryScheduler,
+                                                SchedShed)
+    monkeypatch.setenv("OG_HBM_PRESSURE_MB", "1")
+    s = QueryScheduler(max_concurrent=4)
+    booked = 900 << 10                          # 900 KB live
+    hbm.account("pipeline", booked)
+    try:
+        # small query fits under the 1 MB limit
+        t = s.admit(cost=QueryCost(10, hbm_bytes=64 << 10))
+        t.release()
+        # the monster would blow the limit → shed with the typed
+        # reason + Retry-After, BEFORE consuming a slot
+        with pytest.raises(SchedShed) as ei:
+            s.admit(cost=QueryCost(10, hbm_bytes=256 << 10))
+        assert ei.value.http_code == 429
+        assert ei.value.reason == "hbm_pressure"
+        assert ei.value.retry_after_s >= 1.0
+        from opengemini_tpu.query.scheduler import SCHED_STATS
+        assert SCHED_STATS["shed_hbm_pressure"] >= 1
+    finally:
+        hbm.release("pipeline", booked)
+
+
+def test_admission_pressure_disabled_by_default(monkeypatch):
+    from opengemini_tpu.query.scheduler import QueryCost, QueryScheduler
+    monkeypatch.delenv("OG_HBM_PRESSURE_MB", raising=False)
+    booked = 10 << 20
+    hbm.account("pipeline", booked)
+    try:
+        s = QueryScheduler(max_concurrent=4)
+        t = s.admit(cost=QueryCost(10, hbm_bytes=1 << 30))
+        t.release()                             # 0 disables the check
+    finally:
+        hbm.release("pipeline", booked)
+
+
+# --------------------------------------------------- observability
+
+
+def test_devicefault_collector_shape():
+    df.breaker_for("block").force(True)
+    out = df.devicefault_collector()
+    assert out["breaker_block_state"] == 2      # open
+    assert "breaker_trips" in out and "route_fallbacks" in out
+    assert out["gate_permits_shrunk"] == 0
+    df.reset_breakers()
+    out = df.devicefault_collector()
+    assert out.get("breaker_block_state", 0) in (0, None) \
+        or "breaker_block_state" not in out
+
+
+def test_syscontrol_devicebreaker_mod():
+    from opengemini_tpu.utils.syscontrol import SysControl
+    sc = SysControl()
+    code, out = sc.handle("devicebreaker", {})
+    assert code == 200 and "device_breakers" in out
+    code, out = sc.handle("devicebreaker", {"route": "nope"})
+    assert code == 404
+    code, out = sc.handle("devicebreaker",
+                          {"route": "block", "switchon": "true"})
+    assert code == 200 and out["state"] == "open"
+    assert not df.route_on("block")
+    code, out = sc.handle("devicebreaker", {"route": "block"})
+    assert code == 200 and out["state"] == "open"   # read, no mutate
+    code, out = sc.handle("devicebreaker",
+                          {"route": "block", "switchon": "false"})
+    assert code == 200 and out["state"] == "closed"
+    code, out = sc.handle("devicebreaker", {"action": "reset"})
+    assert code == 200
+
+
+# --------------------------------------------- end-to-end parity
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    from opengemini_tpu.query import QueryExecutor
+    from opengemini_tpu.storage import Engine, EngineOptions
+    # purge the session caches BEFORE swapping fresh ones in, and the
+    # fixture's own caches after — the HBM ledger mirrors whichever
+    # instance owns the tier, and stale booked bytes would break the
+    # exact cross_check the parity tests assert. Tests elsewhere that
+    # swap _CACHE without purging strand tier bytes; drain any residue
+    # so the exact-reconciliation assertions here start from truth
+    dc.global_cache().purge()
+    dc.host_cache().purge()
+    for tier in ("device_cache", "host_cache"):
+        resid = hbm.LEDGER.tier_bytes(tier)
+        if resid:
+            hbm.LEDGER.release(tier, resid,
+                               n=hbm.LEDGER.tier_count(tier))
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_COOLDOWN_S", "0.05")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)    # force block path
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    from opengemini_tpu.utils.lineprotocol import parse_lines
+    rng = np.random.default_rng(5)
+    vals = np.round(rng.normal(50.0, 12.0, (4, 240)), 2)
+    # "cpu": regular 10s sampling (block / lattice / dense routes);
+    # "jit": jittered timestamps — dense-ineligible, so the sparse
+    # segment-reduction (segagg route) carries the rows
+    lines = [f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}"
+             for h in range(4) for i in range(240)]
+    lines += [f"jit,host=h{h} u={float(vals[h, i])!r} "
+              f"{i * 10**10 + (i % 7) * 10**8}"
+              for h in range(4) for i in range(240)]
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    dc.global_cache().purge()
+    dc.host_cache().purge()
+    eng.close()
+
+
+QTEXT = ("SELECT mean(u), sum(u), count(u) FROM cpu "
+         "WHERE time >= 0 AND time < 2400000000000 "
+         "GROUP BY time(1m), host")
+
+
+def _run(ex, text=QTEXT):
+    from opengemini_tpu.query import parse_query
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+def _digest(res) -> str:
+    dig = hashlib.sha256()
+    for s in sorted(res.get("series", []),
+                    key=lambda s: json.dumps(s.get("tags", {}),
+                                             sort_keys=True)):
+        dig.update(json.dumps(s.get("tags", {}),
+                              sort_keys=True).encode())
+        for r in s["values"]:
+            dig.update(repr(tuple(r)).encode())
+    return dig.hexdigest()
+
+
+def _apply_route_config(route_cfg, monkeypatch):
+    """Steer the fixture query onto the named device route family so
+    its failpoint sites actually fire (verified below via the maxhits
+    auto-disarm). Returns the query text for the config."""
+    import opengemini_tpu.query.executor as E
+    if route_cfg == "lattice":
+        monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
+        monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+    elif route_cfg == "segagg":
+        # the jittered measurement is dense-ineligible: its rows ride
+        # the sparse segment reduction, forced onto device
+        monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 1 << 40)
+        monkeypatch.setattr(E, "HOST_AGG_THRESHOLD", 0)
+        return QTEXT.replace("FROM cpu", "FROM jit")
+    elif route_cfg == "dense":
+        monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 1 << 40)
+        monkeypatch.setenv("OG_DENSE_DEVICE", "1")
+    return QTEXT
+
+
+# (site, mode, route config) matrix over the device-stack failpoints:
+# each must be absorbed (retry / pressure ladder / statement fallback)
+# and leave results byte-identical to the fault-free run on the SAME
+# route config
+FAULT_MATRIX = [
+    ("device.block.launch", "transient", "block"),
+    ("device.block.launch", "oom", "block"),
+    ("device.finalize.launch", "transient", "block"),
+    ("device.finalize.launch", "oom", "block"),
+    ("pipeline.submit", "transient", "block"),
+    ("pipeline.pull", "transient", "block"),
+    ("pipeline.pull", "oom", "block"),
+    ("pipeline.unpack", "transient", "block"),
+    ("device.lattice.launch", "transient", "lattice"),
+    ("device.lattice.launch", "oom", "lattice"),
+    ("blockagg.lattice_fold", "oom", "lattice"),
+    ("device.segagg.launch", "transient", "segagg"),
+    ("device.segagg.launch", "oom", "segagg"),
+    ("device.dense.launch", "transient", "dense"),
+    ("devicecache.fill", "oom", "dense"),
+]
+
+
+@pytest.mark.parametrize("site,mode,route_cfg", FAULT_MATRIX)
+def test_injection_parity(db, monkeypatch, site, mode, route_cfg):
+    import opengemini_tpu.ops.devicecache as dc
+    _eng, ex = db
+    text = _apply_route_config(route_cfg, monkeypatch)
+
+    def cold_run():
+        if route_cfg == "dense":
+            # the decoded-plane tier and the dense result cache answer
+            # warm repeats without touching the fill/launch sites —
+            # parity must compare two COLD runs
+            dc.global_cache().purge()
+            dc.host_cache().purge()
+        return _digest(_run(ex, text))
+
+    ref = cold_run()
+    failpoint.seed(7)
+    failpoint.enable(site, mode, maxhits=1)
+    try:
+        got = cold_run()
+        fired = not failpoint.active(site)      # maxhits auto-disarm
+    finally:
+        failpoint.disable(site)
+    assert fired, f"{site} never fired on route config {route_cfg!r}"
+    assert got == ref, f"{site}/{mode} changed bytes"
+    assert hbm.cross_check()["ok"]
+    df.reset_breakers()
+
+
+def test_persistent_fault_falls_back_and_recovers(db, monkeypatch):
+    """A fault that never clears: the statement re-runs until the
+    route breaker opens, the host path answers byte-identically, and
+    after the cooldown the half-open probe restores the device route
+    — observable in the collector counters."""
+    _eng, ex = db
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("OG_DEVICE_RETRY", "0")
+    ref = _digest(_run(ex))
+    failpoint.enable("device.block.launch", "oom")   # persistent
+    try:
+        got = _digest(_run(ex))
+        assert got == ref                      # host fallback answer
+        assert df.breaker_for("block").is_open
+        c = df.devicefault_collector()
+        assert c["route_fallbacks"] >= 1 and c["breaker_trips"] >= 1
+    finally:
+        failpoint.disable("device.block.launch")
+    # recovery: fault gone, cooldown tiny → one query is the probe
+    time.sleep(0.15)
+    got = _digest(_run(ex))
+    assert got == ref
+    assert not df.breaker_for("block").is_open
+    assert df.devicefault_collector()["breaker_recoveries"] >= 1
+    assert hbm.cross_check()["ok"]
+
+
+def test_open_breaker_routes_host_without_injection(db):
+    """Forcing every route breaker open must leave results untouched:
+    the host fallbacks ARE the byte-identical reference paths."""
+    _eng, ex = db
+    ref = _digest(_run(ex))
+    for r in df.ROUTES:
+        df.breaker_for(r).force(True)
+    try:
+        assert _digest(_run(ex)) == ref
+    finally:
+        df.reset_breakers()
+
+
+def test_kill_storm_leaves_ledger_clean(db, monkeypatch):
+    """Kill storms against in-flight streamed queries: whatever the
+    interleaving, the gate and the pipeline ledger tier end clean
+    (exact cross_check) — the regression test for the PR 9 leak."""
+    from opengemini_tpu.query import parse_query
+    from opengemini_tpu.query.manager import QueryKilled, QueryManager
+    _eng, ex = db
+    qm = QueryManager()
+    (stmt,) = parse_query(QTEXT)
+    base = hbm.LEDGER.tier_bytes("pipeline")
+    for i in range(6):
+        ctx = qm.attach(QTEXT, "db0")
+        if i % 2 == 0:
+            # kill at a random point mid-flight via a delayed thread
+            failpoint.enable("pipeline.pull", "sleep", 30)
+            t = threading.Timer(0.01 * (i + 1), ctx.kill)
+            t.start()
+            try:
+                res = ex.execute(stmt, "db0", ctx=ctx)
+                # a kill that lands mid-flight surfaces as the typed
+                # error dict; one that lands after completion doesn't
+                assert "error" not in res \
+                    or "killed" in res["error"], res
+            except QueryKilled:
+                pass
+            t.cancel()
+            failpoint.disable("pipeline.pull")
+        else:
+            res = ex.execute(stmt, "db0", ctx=ctx)
+            assert "error" not in res
+        qm.detach(ctx)
+    assert hbm.LEDGER.tier_bytes("pipeline") == base
+    assert hbm.cross_check()["ok"]
+    df.reset_breakers()

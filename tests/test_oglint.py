@@ -109,6 +109,18 @@ def test_r6_histograms_good_fixture():
 
 # ------------------------------------------------------- machinery
 
+def test_r7_fault_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/ops/r7_bad.py"])
+    r7 = [v for v in vs if v.code == "R701"]
+    # pass-swallowed drain, silent cache fill, bare except
+    assert len(r7) == 3, vs
+
+
+def test_r7_fault_good_fixture():
+    got = codes_for("opengemini_tpu/ops/r7_good.py")
+    assert not {c for c in got if c.startswith("R7")}, got
+
+
 def test_pragma_suppression(tmp_path):
     bad = tmp_path / "opengemini_tpu" / "ops"
     bad.mkdir(parents=True)
