@@ -34,6 +34,7 @@ import hashlib
 import json
 import math
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -120,14 +121,17 @@ def run_child(args: list, timeout: float, env=None) -> tuple:
         return p.returncode, out, err
     except subprocess.TimeoutExpired:
         # graceful: the child's own SIGTERM handler cleans its
-        # /dev/shm tempdirs; SIGKILL would leak them
+        # /dev/shm tempdirs; SIGKILL would leak them. rc 124 (the
+        # shell `timeout` convention) — NOT a signal number, so the
+        # crash gate can tell a parent-imposed timeout apart from a
+        # child that genuinely died to its own SIGKILL failpoint
         p.terminate()
         try:
             out, err = p.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             p.kill()
             out, err = p.communicate()
-        return -9, out, err
+        return 124, out, err
     finally:
         _CHILDREN.remove(p)
 
@@ -167,17 +171,23 @@ def _digest_series(res: dict) -> tuple:
 
 # ---------------------------------------------------- headline (1-2)
 
-def build_dataset(data_dir: str, hosts: int = None) -> tuple:
+def build_dataset(data_dir: str, hosts: int = None,
+                  wal_sync: bool = False) -> tuple:
     """Ingest TSBS devops-cpu-shaped data (HOSTS hosts ≙ BASELINE
     config 2, double-groupby-1) through the bulk record-writer path and
-    flush to TSSP files. Returns (rows written, ingest seconds)."""
+    flush to TSSP files. Returns (rows written, ingest seconds).
+    ``wal_sync=True`` makes every ingest batch fsync-acknowledged —
+    the crash gate's child uses it so a SIGKILL mid-flush may lose
+    NOTHING (the dataset is fully deterministic, so the post-restart
+    digest must equal the no-crash reference bit for bit)."""
     from opengemini_tpu.storage import Engine, EngineOptions
 
     if hosts is None:
         hosts = HOSTS
     points = int(HOURS * 3600 / STEP_S)
     rng = np.random.default_rng(42)
-    eng = Engine(data_dir, EngineOptions(shard_duration=1 << 62))
+    eng = Engine(data_dir, EngineOptions(shard_duration=1 << 62,
+                                         wal_sync=wal_sync))
     eng.create_database("bench")
     n = 0
     t0 = time.perf_counter()
@@ -420,6 +430,15 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
     with tempfile.TemporaryDirectory(prefix="og-bench-", dir=shm) as td:
         _register_tmp(td)
         n_rows, t_ing = build_dataset(td)
+        # restart-to-serving cost (PR 10): reopen the freshly built
+        # data dir with eager shard open — orphan sweep, schema and
+        # file loads (v3 checksum verification included), WAL replay
+        # — the recovery_ms headline
+        from opengemini_tpu.storage import Engine, EngineOptions
+        t_r0 = time.perf_counter()
+        Engine(td, EngineOptions(shard_duration=1 << 62,
+                                 lazy_shard_open=False)).close()
+        recovery_ms = (time.perf_counter() - t_r0) * 1e3
         rc, out, err = run_child(
             [sys.executable, os.path.abspath(__file__), "--phase",
              "query", "--data", td, "--runs", str(runs)],
@@ -468,6 +487,10 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         "bit_identical": True,
         "ingest_rows_per_sec": round(n_rows / max(t_ing, 1e-9), 1),
         "ingest_s": round(t_ing, 1),
+        # storage crash consistency (PR 10): cold restart of the
+        # built data dir to first-query-serving (recovery contract
+        # work: orphan sweep + open-time verification + WAL replay)
+        "recovery_ms": round(recovery_ms, 1),
         "kernel_rows_per_sec": round(kernel_rps, 1),
         "http_query_ms": round(http_ms, 1),
         "phases_ms": tpu.get("phases_ms", {}),
@@ -808,6 +831,22 @@ def scale_phase(cpu_timeout: float) -> dict:
 
 # -------------------------------------------------- perf smoke (CPU)
 
+def crash_child_phase(data_dir: str, site: str, skip: int) -> None:
+    """perf_smoke crash-gate CHILD: rebuild the deterministic bench
+    dataset with fsync-acknowledged (wal_sync) ingest while ONE
+    ``crash``-action failpoint is armed at a storage durability
+    boundary — the SIGKILL lands mid-flush, and the parent then
+    proves the restarted engine serves the no-crash digest. Requires
+    OG_CRASH_OK=1 in the environment."""
+    from opengemini_tpu.utils import failpoint
+
+    failpoint.enable(site, "crash", skip=skip)
+    build_dataset(data_dir, wal_sync=True)
+    # reaching here means the site never fired — the parent treats
+    # any exit other than death-by-SIGKILL as a gate failure
+    raise SystemExit(7)
+
+
 def smoke_phase() -> dict:
     """CPU streaming-equivalence gate (scripts/perf_smoke.sh): a tiny
     dataset runs every query shape through the streaming pipeline AND
@@ -900,6 +939,7 @@ def smoke_phase() -> dict:
         E.BLOCK_MIN_RATIO = 0
         _blk_cells0 = E.BLOCK_MAX_CELLS
         _blk_packed0 = E.BLOCK_MIN_RATIO_PACKED
+        shape_refs = {}          # no-crash digests for the crash gate
         for forced_lattice in (False, True):
             if forced_lattice:
                 E.BLOCK_MAX_CELLS = 8
@@ -927,6 +967,8 @@ def smoke_phase() -> dict:
                             f"{ref[0]} {ref[1][:16]}")
                     for k in env:
                         os.environ.pop(k, None)
+                if not forced_lattice:
+                    shape_refs[key] = ref[1]
         # the observatory sweep must leave the HBM ledger exactly
         # reconciled with the caches it mirrors, with the utilization
         # ring populated from the background sampler
@@ -1153,6 +1195,64 @@ def smoke_phase() -> dict:
                       "OG_DEVICE_BREAKER_COOLDOWN_S",
                       "OG_DEVICE_RETRY"):
                 knobs.del_env(k)
+        # ------------------------------------------------ crash gate
+        # storage crash consistency (PR 10): one SIGKILL/restart cycle
+        # per bench shape — a crashchild subprocess rebuilds the
+        # deterministic dataset with fsync-acked ingest and dies
+        # MID-FLUSH at a rotating durability boundary; the restarted
+        # engine (eager open = orphan sweep + WAL replay, then a flush
+        # to steady state) must serve the shape's digest bit-identical
+        # to the no-crash reference, with zero orphan .tmp files,
+        # across TWO restarts
+        crash_cycles = 0
+        crash_recovery_ms = 0.0
+        for key, qtext, site in (
+                ("1h", QUERY, "tssp.finalize.crash_pre_rename"),
+                ("1m", QUERY_1M, "shard.flush.crash_commit"),
+                ("cfg1", QUERY_CFG1, "wal.switch.crash")):
+            cdir = os.path.join(td, f"crash_{key}")
+            cenv = dict(os.environ)
+            cenv["OG_CRASH_OK"] = "1"
+            rc, _out, err = run_child(
+                [sys.executable, os.path.abspath(__file__), "--phase",
+                 "crashchild", "--data", cdir, "--crash-site", site],
+                timeout=300, env=cenv)
+            if rc != -signal.SIGKILL:
+                raise SystemExit(
+                    f"CRASH GATE [{key}]: child armed at {site} "
+                    f"exited rc={rc} instead of dying to SIGKILL: "
+                    f"{err[-1500:]}")
+            for restart in (1, 2):
+                t_r0 = time.perf_counter()
+                eng_c = Engine(cdir, EngineOptions(
+                    shard_duration=1 << 62, lazy_shard_open=False))
+                rec_ms = (time.perf_counter() - t_r0) * 1e3
+                eng_c.flush_all()
+                (stmt_c,) = parse_query(qtext)
+                res_c = QueryExecutor(eng_c).execute(stmt_c, "bench")
+                eng_c.close()
+                if "error" in res_c:
+                    raise SystemExit(
+                        f"CRASH GATE [{key}]: post-restart query "
+                        f"error: {res_c['error']}")
+                dig_c, _cells_c = _digest_series(res_c)
+                if dig_c != shape_refs[key]:
+                    raise SystemExit(
+                        f"CRASH GATE [{key}]: restart #{restart} "
+                        f"after {site} serves {dig_c[:16]} != "
+                        f"no-crash reference "
+                        f"{shape_refs[key][:16]}")
+                orphans = [os.path.join(dp, fn)
+                           for dp, _dn, fns in os.walk(cdir)
+                           for fn in fns if fn.endswith(".tmp")]
+                if orphans:
+                    raise SystemExit(
+                        f"CRASH GATE [{key}]: orphan .tmp survived "
+                        f"restart #{restart}: {orphans}")
+                if restart == 1:
+                    crash_recovery_ms = max(crash_recovery_ms, rec_ms)
+            crash_cycles += 1
+            shutil.rmtree(cdir, ignore_errors=True)
         (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
         phases = _parse_phases(ex.execute(est, "bench"))
         eng.close()
@@ -1171,6 +1271,11 @@ def smoke_phase() -> dict:
             "chaos_injections": chaos_injected,
             "chaos_ledger_ok": 1,
             "fault_recovery_ms": round(fault_recovery_ms, 1),
+            # storage crash gate (PR 10)
+            "crash_cycles": crash_cycles,
+            "crash_digest_ok": 1,
+            "crash_orphans": 0,
+            "crash_recovery_ms": round(crash_recovery_ms, 1),
             **phases}
 
 
@@ -1345,10 +1450,14 @@ def main():
                     choices=["query", "csquery", "promquery",
                              "scalequery", "headline", "csfull",
                              "promfull", "scalefull", "smoke",
-                             "concurrent"],
+                             "concurrent", "crashchild"],
                     default=None)
     ap.add_argument("--data", default=None)
     ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--crash-site", default=None,
+                    help="crashchild: failpoint site to arm as crash")
+    ap.add_argument("--crash-skip", type=int, default=0,
+                    help="crashchild: passes to let through unfired")
     args = ap.parse_args()
 
     signal.signal(signal.SIGTERM, _on_signal)
@@ -1370,6 +1479,9 @@ def main():
         return
     if args.phase == "smoke":
         print(json.dumps(smoke_phase()))
+        return
+    if args.phase == "crashchild":
+        crash_child_phase(args.data, args.crash_site, args.crash_skip)
         return
     if args.phase == "concurrent":
         print(json.dumps(concurrent_phase()))

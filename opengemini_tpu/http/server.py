@@ -1595,11 +1595,13 @@ class _Handler(BaseHTTPRequestHandler):
             # hit/miss/eviction, and the executor phase split without
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
+            from ..storage.wal import recovery_summary
             from ..utils.stats import (devicecache_collector,
                                        devicefault_collector,
                                        hbm_collector,
                                        histogram_summaries,
-                                       scheduler_collector)
+                                       scheduler_collector,
+                                       wal_collector)
             out = dict(srv.stats)
             out["device"] = device_collector()
             out["devicecache"] = devicecache_collector()
@@ -1607,6 +1609,11 @@ class _Handler(BaseHTTPRequestHandler):
             out["scheduler"] = scheduler_collector()
             out["hbm"] = hbm_collector()
             out["devicefault"] = devicefault_collector()
+            out["wal"] = wal_collector()
+            # startup recovery report: cumulative replay/salvage/
+            # quarantine counters plus the recent per-shard reports
+            # ring — what the last restart actually recovered
+            out["recovery"] = recovery_summary()
             # p50/p95/p99 summaries of every registered histogram
             # (query/write latency, queue wait, phases, D2H pulls)
             out["latency"] = histogram_summaries()

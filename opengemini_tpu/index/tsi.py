@@ -41,7 +41,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..utils import get_logger, knobs
+from ..utils import failpoint, fileops, get_logger, knobs
 from .. import native as _native
 
 log = get_logger(__name__)
@@ -270,6 +270,11 @@ class SeriesIndex:
             if self._log is not None:
                 self._log.flush()
                 os.fsync(self._log.fileno())
+                # crash here: the sid log is durable but the caller's
+                # commit (WAL frame referencing the sids, or the
+                # memtable flush) never happened — replay must find
+                # every sid a surviving WAL frame references
+                failpoint.inject("tsi.flush.crash")
             # amortized trigger: a snapshot rewrites the WHOLE working
             # set, so it must only fire when the un-snapshotted tail is
             # a constant fraction of it — a fixed threshold makes bulk
@@ -321,7 +326,7 @@ class SeriesIndex:
             f.write(b"OGSN1" + struct.pack("<Q", len(raw)) + comp)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path())
+        fileops.durable_replace(tmp, self._snap_path())
         self._snap_covered = self._log_size
 
     def _open_snapshot(self):

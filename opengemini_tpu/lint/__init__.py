@@ -1,6 +1,6 @@
 """oglint — repo-specific AST invariant linter (tier-1 gate).
 
-Seven rule classes enforce the conventions the device hot path's
+Eight rule classes enforce the conventions the device hot path's
 correctness rests on (see each rule module for the full contract):
 
 - R1 transfer discipline (``transfer_rule``): D2H pulls in hot-path
@@ -28,6 +28,11 @@ correctness rests on (see each rule module for the full contract):
   ``ops.devicefault.classify`` (or re-raise, or carry a reviewed
   pragma) — a swallowed device fault never retries, never relieves
   HBM pressure and never charges a route breaker.
+- R8 rename durability (``durability_rule``): ``os.replace``/
+  ``os.rename`` in ``storage/`` must ride
+  ``utils.fileops.durable_replace`` (file fsync → rename → parent-dir
+  fsync) — a bare rename can roll back after a crash, silently
+  unpublishing a TSSP file, manifest or marker.
 
 Run: ``python scripts/oglint.py`` (or ``python -m opengemini_tpu.lint``).
 Suppressions: a trailing ``# oglint: disable=R103`` comment disables

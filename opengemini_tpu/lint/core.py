@@ -133,6 +133,7 @@ def collect_files(root: str, paths: list[str] | None = None) -> list[str]:
 def default_rules() -> list[Rule]:
     from .counter_rule import CounterRule
     from .deadline_rule import DeadlineRule
+    from .durability_rule import DurabilityRule
     from .fault_rule import FaultRule
     from .knob_rule import KnobRule
     from .lockrank_rule import LockRankRule
@@ -140,7 +141,7 @@ def default_rules() -> list[Rule]:
     from .transfer_rule import TransferRule
     return [TransferRule(), KnobRule(), DeadlineRule(),
             LockRankRule(), TraceRule(), CounterRule(),
-            FaultRule()]
+            FaultRule(), DurabilityRule()]
 
 
 def run_lint(root: str, rules: list[Rule] | None = None,

@@ -1,0 +1,59 @@
+"""R8 — rename durability discipline in ``storage/``.
+
+``os.replace``/``os.rename`` alone is not durable on Linux: the rename
+is a directory mutation, and until the parent directory is fsynced a
+crash can roll it back — a "published" TSSP file, colstore file,
+backup manifest or detach marker silently vanishes on restart even
+though its bytes were fsynced. PR 10's crash harness
+(tests/crashharness.py) SIGKILLs processes at exactly these
+boundaries; every publish-by-rename in ``storage/`` must therefore
+ride ``utils.fileops.durable_replace`` (file fsync → rename → parent
+directory fsync), which is also where the fileops counters live.
+
+Scope: ``opengemini_tpu/storage/`` (plus any future file under it).
+Other trees (cluster raft state, logstore, meta) adopt the helper
+opportunistically but are not gated — their durability contracts are
+weaker by design.
+
+Codes:
+- R801: direct ``os.replace``/``os.rename`` call. Fix: route through
+  ``utils.fileops.durable_replace`` (or ``durable_write`` for whole
+  small files), or — where rename durability is genuinely not needed
+  (scratch files inside a directory that is itself swept at open) —
+  carry a reviewed ``# oglint: disable=R801`` pragma saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+
+_SCOPE = ("opengemini_tpu/storage/",)
+_BANNED = ("os.replace", "os.rename", "os.renames")
+
+
+class DurabilityRule(Rule):
+    rule_id = "R8"
+    codes = {
+        "R801": "direct os.replace/os.rename in storage/ is not "
+                "crash-durable; ride utils.fileops.durable_replace",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not any(ctx.path.startswith(d) for d in _SCOPE):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in _BANNED:
+                out.append(Violation(
+                    ctx.path, node.lineno, "R801",
+                    f"{name}(...) publishes by rename without parent-"
+                    "directory fsync — a crash can roll the rename "
+                    "back after restart. Use utils.fileops."
+                    "durable_replace (or durable_write), or carry a "
+                    "reviewed '# oglint: disable=R801' pragma"))
+        return out

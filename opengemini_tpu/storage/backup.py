@@ -21,7 +21,7 @@ import os
 import shutil
 import time
 
-from ..utils import get_logger
+from ..utils import failpoint, fileops, get_logger
 
 log = get_logger(__name__)
 
@@ -142,7 +142,13 @@ def create_backup(engine, backup_dir: str, base_dir: str | None = None,
     tmp = os.path.join(backup_dir, MANIFEST + ".tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
-    os.replace(tmp, os.path.join(backup_dir, MANIFEST))
+        f.flush()
+        os.fsync(f.fileno())
+    # crash here: copied data files but no manifest — the dir is "not
+    # a backup" to restore/verify (loud BackupError), never a silently
+    # short one; the manifest rename IS the backup's commit point
+    failpoint.inject("backup.manifest.crash")
+    fileops.durable_replace(tmp, os.path.join(backup_dir, MANIFEST))
     log.info("backup %s: %d files (%d copied, %d referenced)",
              backup_dir, len(files), copied, len(files) - copied)
     return {"files": len(files), "copied": copied}

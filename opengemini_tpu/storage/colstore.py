@@ -36,6 +36,7 @@ import numpy as np
 from .. import encoding as enc
 from ..index.sparse import (KIND_BLOOM, KIND_MINMAX, KIND_SET,
                             KIND_TEXT_BLOOM, SparseIndex, SparseIndexBuilder)
+from ..utils import failpoint, fileops
 from ..query.ast import BinaryExpr, Call, FieldRef, Literal
 from ..record import ColVal, DataType, Record, Schema
 
@@ -168,7 +169,11 @@ class ColumnStoreWriter:
             f.flush()
             os.fsync(f.fileno())
             f.close()
-            os.replace(self.path + ".tmp", self.path)
+            # crash here: complete-but-unpublished .tmp — swept at
+            # restart; the rows still live in the sealed WAL segment
+            # (the shard removes it only after this publish commits)
+            failpoint.inject("colstore.publish.crash")
+            fileops.durable_replace(self.path + ".tmp", self.path)
         except Exception:
             f.close()
             if os.path.exists(self.path + ".tmp"):
@@ -222,7 +227,15 @@ class ColumnStoreReader:
         flen, tail_magic = struct.unpack_from("<II", mm, len(mm) - 8)
         if tail_magic != MAGIC:
             raise ValueError(f"corrupt column-store trailer in {path}")
-        self.footer = json.loads(bytes(mm[len(mm) - 8 - flen:len(mm) - 8]))
+        if flen > len(mm) - 16:
+            raise ValueError(f"corrupt column-store footer length in "
+                             f"{path}")
+        try:
+            self.footer = json.loads(
+                bytes(mm[len(mm) - 8 - flen:len(mm) - 8]))
+        except ValueError as e:
+            raise ValueError(
+                f"corrupt column-store footer in {path}: {e}") from e
         self.schema = Schema([_mkfield(n, t)
                               for n, t in self.footer["schema"]])
         self._indexes: dict[str, SparseIndex] = {}

@@ -143,6 +143,12 @@ def merge_and_swap(shard, mst: str, readers, transform=None) -> str | None:
                     wrote = True
         if wrote:
             w.finalize()
+            # crash here: merged output published, inputs still on
+            # disk — restart loads BOTH; duplicate (series, time) rows
+            # carry identical values and the read path's last-wins
+            # merge collapses them, so the swap is crash-idempotent
+            # (the next compaction round re-plans and re-merges)
+            failpoint.inject("compact.swap.crash")
             new_reader = TSSPReader(out_path)
         else:
             w.abort()

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..index import TagFilter
 from ..record import Record
-from ..utils import get_logger
+from ..utils import fileops, get_logger
 from ..utils.errors import ErrDatabaseNotFound, ErrQueryError
 from .rows import PointRow
 from .shard import Shard
@@ -89,19 +89,36 @@ class Database:
             tmp = self._cs_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(self.cs_options, f)
-            os.replace(tmp, self._cs_path)
+                f.flush()
+                os.fsync(f.fileno())
+            fileops.durable_replace(tmp, self._cs_path)
 
     def is_columnstore(self, mst: str) -> bool:
         return mst in self.cs_options
 
     def _load(self) -> None:
+        swept = 0
         for fn in sorted(os.listdir(self.path)):
+            # crash leftovers at the db level (colstore.json.tmp):
+            # unpublished by construction — sweep before anything opens
+            if fn.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.path, fn))
+                    swept += 1
+                except OSError:
+                    pass
+                continue
             m = re.fullmatch(r"shard_(-?\d+)", fn)
             if m:
                 gi = int(m.group(1))
                 # placeholder: WAL replay + index load deferred to
                 # first access (lazy open, engine.go:780 role)
                 self.shards[gi] = None
+        if swept:
+            # the unlinks themselves must survive a crash, or the
+            # orphan reappears on the next restart (same discipline
+            # as Shard._sweep_orphans)
+            fileops.fsync_dir(self.path)
         if not self.opts.lazy_shard_open:
             for gi in list(self.shards):
                 self.shards[gi] = self._open_shard(gi)

@@ -18,7 +18,7 @@ import shutil
 import threading
 from collections import OrderedDict
 
-from ..utils import get_logger
+from ..utils import fileops, get_logger
 
 log = get_logger(__name__)
 
@@ -65,8 +65,8 @@ class LocalObjectStore(ObjectStore):
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         tmp = dst + ".uploading"
-        shutil.copy2(path, tmp)
-        os.replace(tmp, dst)
+        shutil.copy2(path, tmp)       # copy2 never fsyncs
+        fileops.durable_replace(tmp, dst, sync_src=True)
 
     def get_range(self, key: str, offset: int, length: int) -> bytes:
         with open(self._path(key), "rb") as f:

@@ -19,7 +19,7 @@ import numpy as np
 from ..index import SeriesIndex, TagFilter
 from ..record import (ColVal, DataType, Field, Record, Schema,
                       merge_sorted_records)
-from ..utils import failpoint, get_logger
+from ..utils import failpoint, fileops, get_logger, knobs
 from ..utils.errors import ErrTypeConflict
 from .colstore import ColumnStoreReader, ColumnStoreWriter
 from .memtable import MemTable, MemTables, field_type_of
@@ -81,11 +81,72 @@ class Shard:
         # (role of the reference's measurement schema in ts-meta)
         self._schema_path = os.path.join(path, "fields.idx")
         self._schemas: dict[str, dict[str, DataType]] = {}
+        # startup recovery report for this shard (WAL replay tallies,
+        # quarantined files, orphans removed, recovery_ms) — recorded
+        # into storage.wal's process-wide ring for /debug/vars
+        self.recovery: dict = {"shard": shard_id, "path": path}
+        self._sweep_orphans()
         self._load_schemas()
         self._load_files()
         self._replay_wal()
+        from .wal import record_recovery
+        record_recovery(self.recovery)
 
     # ---- open ------------------------------------------------------------
+
+    def _sweep_orphans(self) -> None:
+        """Remove crash leftovers before anything loads: a ``.tmp``
+        file is by construction unpublished work (TSSP finalize,
+        colstore publish, index snapshot and detach markers all write
+        ``<name>.tmp`` and rename only after fsync) — after a crash it
+        is garbage that must not survive the restart, let alone two
+        (the crash-harness orphan contract)."""
+        from .wal import WAL_STATS
+        from ..utils.stats import bump as _bump
+        n = 0
+        for d in (self.path, os.path.join(self.path, "tssp"),
+                  os.path.join(self.path, "colstore"),
+                  os.path.join(self.path, "wal")):
+            if not os.path.isdir(d):
+                continue
+            removed_here = 0
+            for fn in os.listdir(d):
+                if fn.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(d, fn))
+                        removed_here += 1
+                    except OSError:
+                        pass
+            if removed_here:          # fsync only mutated directories
+                n += removed_here
+                fileops.fsync_dir(d)
+        if n:
+            log.info("shard %d: removed %d orphan .tmp file(s) at "
+                     "open", self.shard_id, n)
+            _bump(WAL_STATS, "orphans_removed", n)
+            self.recovery["orphans_removed"] = n
+
+    def _quarantine_file(self, path: str, why) -> None:
+        """Quarantine-and-continue for an unreadable immutable file:
+        rename to ``<name>.corrupt`` (durable) so the open proceeds
+        without it and a second restart doesn't re-trip; off-switch
+        OG_STORAGE_QUARANTINE=0 restores the log-only behavior."""
+        from .wal import WAL_STATS
+        from ..utils.stats import bump as _bump
+        if not knobs.get("OG_STORAGE_QUARANTINE"):
+            log.error("skipping corrupt %s: %s", path, why)
+            return
+        try:
+            size = os.path.getsize(path)
+            fileops.durable_replace(path, path + ".corrupt")
+        except OSError as e:
+            log.error("failed to quarantine %s: %s", path, e)
+            return
+        log.error("quarantined corrupt %s -> .corrupt (%s)", path, why)
+        _bump(WAL_STATS, "quarantined_files")
+        _bump(WAL_STATS, "quarantined_bytes", size)
+        self.recovery["quarantined_files"] = (
+            self.recovery.get("quarantined_files", 0) + 1)
 
     def _load_schemas(self) -> None:
         if not os.path.exists(self._schema_path):
@@ -169,7 +230,10 @@ class Shard:
                 self._files.setdefault(mst, []).append(
                     TSSPReader(os.path.join(d, fn)))
             except (ValueError, _struct.error, OSError) as e:
-                log.error("skipping corrupt tssp %s: %s", fn, e)
+                # open-time verification failed (bad magic/trailer
+                # bounds/meta checksum): quarantine and serve the rest
+                # — a restart must never crash-loop on one bad file
+                self._quarantine_file(os.path.join(d, fn), e)
         cd = os.path.join(self.path, "colstore")
         for fn in sorted(os.listdir(cd)):
             if not fn.endswith(".ogcf"):
@@ -180,7 +244,7 @@ class Shard:
                 self._cs_files.setdefault(mst, []).append(
                     ColumnStoreReader(os.path.join(cd, fn)))
             except (ValueError, _struct.error, OSError, KeyError) as e:
-                log.error("skipping corrupt colstore %s: %s", fn, e)
+                self._quarantine_file(os.path.join(cd, fn), e)
 
     def _coerce(self, mst: str, fields: dict) -> dict:
         """int→float coercion for fields registered as FLOAT, so memtable
@@ -197,8 +261,10 @@ class Shard:
         return out if out is not None else fields
 
     def _replay_wal(self) -> None:
+        import time as _time
+        t0 = _time.perf_counter()
         n = bad = 0
-        for batch in self.wal.replay():
+        for batch in self.wal.replay(report=self.recovery):
             if isinstance(batch, tuple) and batch[0] == "cols":
                 for mst, sid, times, fields in batch[1]:
                     try:
@@ -228,9 +294,20 @@ class Shard:
                     bad += 1
                     log.error("shard %d: dropping bad wal row (%s %s): %s",
                               self.shard_id, mst, fields, e)
-        if n or bad:
-            log.info("shard %d: replayed %d rows from wal (%d dropped)",
-                     self.shard_id, n, bad)
+        ms = int((_time.perf_counter() - t0) * 1e3)
+        self.recovery["rows_replayed"] = n
+        self.recovery["rows_dropped"] = bad
+        self.recovery["recovery_ms"] = ms
+        from .wal import WAL_STATS
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "recovery_ms", ms)
+        if n or bad or self.recovery.get("segments"):
+            anomalous = sum(
+                1 for s in self.recovery.get("segments", ())
+                if s["torn"] or s["bad_crc"] or s["decode_errors"])
+            log.info("shard %d: replayed %d rows from wal in %dms "
+                     "(%d dropped; %d segment(s) with anomalies)",
+                     self.shard_id, n, ms, bad, anomalous)
 
     # ---- writes ----------------------------------------------------------
 
@@ -562,6 +639,12 @@ class Shard:
                         ColumnStoreReader(fn))
                 self.index.flush()
                 self.mem.commit_snapshot()
+                # crash here: TSSP files published AND the sealed WAL
+                # still present — restart replays the sealed segment
+                # over data the files already hold; the last-wins
+                # merge on identical rows makes that idempotent (the
+                # crash harness proves no duplication)
+                failpoint.inject("shard.flush.crash_commit")
                 self.wal.remove_upto(sealed_wal)
             except Exception:
                 self.mem.abort_snapshot()
@@ -682,7 +765,12 @@ class Shard:
                 tmp = marker + ".tmp"
                 with open(tmp, "w") as f:
                     _json.dump({"key": key}, f)
-                os.replace(tmp, marker)
+                    f.flush()
+                    os.fsync(f.fileno())
+                # marker must survive the crash or the restart loses
+                # the only pointer to the cold copy while the local
+                # file is already unlinked below
+                fileops.durable_replace(tmp, marker)
                 readers[idx] = TSSPReader(
                     r.path, source=DetachedSource(store, key))
                 try:

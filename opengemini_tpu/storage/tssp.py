@@ -37,7 +37,7 @@ import numpy as np
 
 from ..encoding import blocks as enc
 from ..record import ColVal, DataType, Field, Record, Schema
-from ..utils import failpoint, knobs
+from ..utils import failpoint, fileops, knobs
 from .. import native as _native
 
 MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
@@ -62,13 +62,20 @@ def encode_workers() -> int:
     if n >= 0:
         return n
     return 0
-VERSION = 2                  # v2: PreAgg carries reproducible-sum limbs
+VERSION = 3                  # v2: PreAgg carries reproducible-sum limbs
+#                              v3: trailer carries a CRC32 over the
+#                              meta/index/bloom sections, verified at
+#                              open (crash-consistency round: a torn
+#                              or bit-flipped metadata region is
+#                              caught before it mis-routes reads)
 SEGMENT_SIZE = 4096          # rows per column segment == device block rows
 META_GROUP_SERIES = 256      # series per meta-index group
 
 _TRAILER_FMT = "<QQQQQQQqqQ"  # data_end, meta_off, meta_size, idx_off,
 #                               idx_size, bloom_off, bloom_size,
 #                               min_time, max_time, series_count
+_TRAILER_FMT_V3 = _TRAILER_FMT + "I"   # + meta_crc (crc32 of
+#                               [meta_off, bloom_off + bloom_size))
 
 
 @dataclass
@@ -757,34 +764,53 @@ class TSSPWriter:
         # orphaned and the durable file set is untouched (torn-flush
         # crash semantics)
         failpoint.inject("tssp.write.err")
+        import zlib as _zlib
         data_end = self._pos
-        # chunk metas in sid order, grouped for the meta index
+        # chunk metas in sid order, grouped for the meta index; the
+        # running CRC over everything after the data section is the
+        # v3 open-time verification
+        meta_crc = 0
         idx_entries = []
         meta_off = self._pos
         for (s0, s1, cnt), raw in self._meta_groups():
             blob = enc._zstd_c(raw)
             off, size = self._append(blob)
+            meta_crc = _zlib.crc32(blob, meta_crc)
             idx_entries.append((s0, s1, off, size, cnt))
         meta_size = self._pos - meta_off
         idx_off = self._pos
-        self._append(struct.pack("<I", len(idx_entries)))
+        b = struct.pack("<I", len(idx_entries))
+        self._append(b)
+        meta_crc = _zlib.crc32(b, meta_crc)
         for e in idx_entries:
-            self._append(struct.pack("<QQQII", *e))
+            b = struct.pack("<QQQII", *e)
+            self._append(b)
+            meta_crc = _zlib.crc32(b, meta_crc)
         idx_size = self._pos - idx_off
         bloom = SeriesBloom.build(self._all_sids())
-        bloom_off, bloom_size = self._append(bloom.bits.tobytes())
+        bb = bloom.bits.tobytes()
+        bloom_off, bloom_size = self._append(bb)
+        meta_crc = _zlib.crc32(bb, meta_crc)
         trailer = struct.pack(
-            _TRAILER_FMT, data_end, meta_off, meta_size, idx_off, idx_size,
-            bloom_off, bloom_size,
+            _TRAILER_FMT_V3, data_end, meta_off, meta_size, idx_off,
+            idx_size, bloom_off, bloom_size,
             self._min_time if self._min_time is not None else 0,
             self._max_time if self._max_time is not None else 0,
-            len(self._all_sids()))
+            len(self._all_sids()), meta_crc)
         self._append(trailer)
         self._append(struct.pack("<II", len(trailer), MAGIC))
+        # crash points bracket each durability boundary of the atomic
+        # publish: pre_sync → a torn .tmp (swept at restart, durable
+        # set untouched); pre_rename → a COMPLETE .tmp that was never
+        # published (also swept: publication is the rename, nothing
+        # else); post_rename → published and durable, restart serves it
+        failpoint.inject("tssp.finalize.crash_pre_sync")
         self._f.flush()
         os.fsync(self._f.fileno())
         self._f.close()
-        os.replace(self.path + ".tmp", self.path)
+        failpoint.inject("tssp.finalize.crash_pre_rename")
+        fileops.durable_replace(self.path + ".tmp", self.path)
+        failpoint.inject("tssp.finalize.crash_post_rename")
 
     def abort(self) -> None:
         self._f.close()
@@ -826,14 +852,40 @@ class TSSPReader:
         tsize, tail_magic = struct.unpack("<II", mm[len(mm) - 8:len(mm)])
         if magic != MAGIC or tail_magic != MAGIC:
             raise ValueError(f"{path}: bad TSSP magic")
-        if version not in (1, VERSION):
+        if version not in (1, 2, VERSION):
             raise ValueError(f"{path}: unsupported version {version}")
         self.version = version
-        tr = struct.unpack(_TRAILER_FMT,
-                           mm[len(mm) - 8 - tsize:len(mm) - 8])
+        fmt = _TRAILER_FMT_V3 if version >= 3 else _TRAILER_FMT
+        if tsize != struct.calcsize(fmt) or len(mm) < 16 + tsize:
+            raise ValueError(f"{path}: truncated TSSP trailer")
+        tr = struct.unpack(fmt, mm[len(mm) - 8 - tsize:len(mm) - 8])
         (self.data_end, self.meta_off, self.meta_size, self.idx_off,
          self.idx_size, self.bloom_off, self.bloom_size,
-         self.min_time, self.max_time, self.series_count) = tr
+         self.min_time, self.max_time, self.series_count) = tr[:10]
+        # open-time verification (crash-consistency contract): the
+        # trailer's section layout must be internally consistent and
+        # inside the file, and — v3 — the metadata bytes must match
+        # their recorded CRC. A failure raises ValueError; the shard
+        # loader quarantines the file and keeps serving the rest.
+        end = len(mm) - 8 - tsize
+        if not (8 <= self.data_end <= self.meta_off
+                and self.meta_off + self.meta_size == self.idx_off
+                and self.idx_off + self.idx_size == self.bloom_off
+                and self.bloom_off + self.bloom_size <= end):
+            raise ValueError(f"{path}: inconsistent TSSP trailer "
+                             "section layout")
+        if version >= 3 and source is None:
+            # local files verify the metadata CRC at open; detached
+            # sources stay lazy (integrity there is the object store's
+            # contract — forcing the whole meta section through ranged
+            # GETs at open would defeat detached_lazy_load)
+            import zlib as _zlib
+            got = _zlib.crc32(
+                mm[self.meta_off:self.bloom_off + self.bloom_size])
+            if got != tr[10]:
+                raise ValueError(
+                    f"{path}: TSSP metadata checksum mismatch "
+                    f"(crc {got:#x} != recorded {tr[10]:#x})")
         # copy (not view) so the mmap can close while the bloom lives on
         self.bloom = SeriesBloom(np.frombuffer(
             mm[self.bloom_off:self.bloom_off + self.bloom_size],

@@ -18,7 +18,7 @@ import zlib
 
 from ..native import lz4_compress, lz4_decompress
 from ..utils.zstd_compat import zstandard
-from ..utils import failpoint, get_logger
+from ..utils import failpoint, fileops, get_logger, knobs
 
 log = get_logger(__name__)
 
@@ -190,12 +190,59 @@ def _unpack_cols(buf: bytes):
 
 
 # cumulative metrics for the statistics pusher (reference
-# statistics/wal.go analog)
+# statistics/wal.go analog). The recovery counters are the /metrics
+# face of the structured recovery report below: every restart's replay
+# adds its frame/torn/salvage/quarantine tallies here.
 from ..utils.stats import register_counters
 
 WAL_STATS = register_counters("wal", {
     "writes": 0, "bytes_written": 0, "switches": 0,
-    "replayed_batches": 0})
+    "replayed_batches": 0, "replayed_frames": 0,
+    "torn_frames": 0, "bad_crc_frames": 0, "decode_error_frames": 0,
+    "salvaged_frames": 0, "quarantined_files": 0,
+    "quarantined_bytes": 0, "truncated_segments": 0,
+    "orphans_removed": 0, "recovery_ms": 0})
+
+
+# ---------------------------------------------------- recovery report
+#
+# Structured startup-recovery summaries (reference engine/wal.go:562
+# replay bookkeeping): each shard's replay appends one report; the
+# bounded ring plus the process-wide totals surface through
+# /debug/vars ("recovery"), /metrics (WAL_STATS counters) and the
+# stats pusher. A report says what a restart actually did — frames
+# replayed, bytes salvaged, files quarantined, recovery_ms — which is
+# the difference between "it came back" and "it came back WITH the
+# acknowledged data".
+
+from collections import deque as _deque
+
+_RECOVERY_LOCK = threading.Lock()
+_RECOVERY_REPORTS: "_deque[dict]" = _deque(maxlen=32)
+
+
+def record_recovery(report: dict) -> None:
+    with _RECOVERY_LOCK:
+        _RECOVERY_REPORTS.append(dict(report))
+
+
+def recovery_reports() -> list[dict]:
+    with _RECOVERY_LOCK:
+        return [dict(r) for r in _RECOVERY_REPORTS]
+
+
+def recovery_summary() -> dict:
+    """Process-wide recovery view for /debug/vars: cumulative counters
+    plus the recent per-shard reports ring."""
+    # replayed_batches (the pre-PR-10 pusher counter, kept for
+    # dashboard compat) is a synonym of replayed_frames here — the
+    # report exports one name only
+    keys = ("replayed_frames", "torn_frames",
+            "bad_crc_frames", "decode_error_frames", "salvaged_frames",
+            "quarantined_files", "quarantined_bytes",
+            "truncated_segments", "orphans_removed", "recovery_ms")
+    return {**{k: WAL_STATS.get(k, 0) for k in keys},
+            "shards": recovery_reports()}
 
 
 class WAL:
@@ -210,6 +257,10 @@ class WAL:
         self._lock = threading.Lock()
         self._seq = self._max_seq() + 1
         self._f = open(self._path(self._seq), "ab")
+        # the segment's DIRECTORY ENTRY must survive a crash, or every
+        # fsynced frame in it is unreachable after restart (file fsync
+        # persists bytes, not the name)
+        fileops.fsync_dir(self.dir)
         self._zc = zstandard.ZstdCompressor(level=1)
 
     def _path(self, seq: int) -> str:
@@ -225,6 +276,25 @@ class WAL:
                     pass
         return mx
 
+    def _emit(self, payload: bytes) -> None:
+        """Append one framed payload. Crash points bracket the fsync —
+        the durability boundary the crash harness proves: a kill at
+        ``pre_sync`` may tear the frame (the write is unacknowledged,
+        replay must drop it whole); a kill at ``post_sync`` leaves a
+        durable frame the caller never acked (replay must surface it,
+        idempotently)."""
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._f.write(frame)
+            failpoint.inject("wal.append.crash_pre_sync")
+            if self.sync:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            failpoint.inject("wal.append.crash_post_sync")
+        from ..utils.stats import bump as _bump
+        _bump(WAL_STATS, "writes")
+        _bump(WAL_STATS, "bytes_written", len(frame))
+
     def write(self, rows: list[tuple[str, int, dict, int]]) -> None:
         failpoint.inject("wal.write.err")
         raw = _pack_batch(rows)
@@ -232,16 +302,7 @@ class WAL:
             codec, body = _LZ4, lz4_compress(raw)
         else:
             codec, body = _ZSTD, self._zc.compress(raw)
-        payload = struct.pack("<BI", codec, len(raw)) + body
-        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
-            self._f.write(frame)
-            if self.sync:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-        from ..utils.stats import bump as _bump
-        _bump(WAL_STATS, "writes")
-        _bump(WAL_STATS, "bytes_written", len(frame))
+        self._emit(struct.pack("<BI", codec, len(raw)) + body)
 
     def write_cols(self, entries) -> None:
         """Columnar frame (bulk record write path)."""
@@ -251,16 +312,7 @@ class WAL:
             codec, body = _LZ4_COLS, lz4_compress(raw)
         else:
             codec, body = _ZSTD_COLS, self._zc.compress(raw)
-        payload = struct.pack("<BI", codec, len(raw)) + body
-        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
-            self._f.write(frame)
-            if self.sync:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-        from ..utils.stats import bump as _bump
-        _bump(WAL_STATS, "writes")
-        _bump(WAL_STATS, "bytes_written", len(frame))
+        self._emit(struct.pack("<BI", codec, len(raw)) + body)
 
     def write_cols_bulk(self, mst: str, sids, offsets, times_cat,
                         fields_cat) -> None:
@@ -271,33 +323,33 @@ class WAL:
             codec, body = _LZ4_COLSB, lz4_compress(raw)
         else:
             codec, body = _ZSTD_COLSB, self._zc.compress(raw)
-        payload = struct.pack("<BI", codec, len(raw)) + body
-        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
-            self._f.write(frame)
-            if self.sync:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-        from ..utils.stats import bump as _bump
-        _bump(WAL_STATS, "writes")
-        _bump(WAL_STATS, "bytes_written", len(frame))
+        self._emit(struct.pack("<BI", codec, len(raw)) + body)
 
     def switch(self) -> int:
         """Rotate to a new segment; returns the sealed segment's seq
         (reference WAL.Switch). The sealed file is removed by
-        remove_sealed() after the matching memtable flush commits."""
+        remove_upto() after the matching memtable flush commits."""
         with self._lock:
             self._f.flush()
             os.fsync(self._f.fileno())
+            # crash here: sealed segment durable, successor not yet
+            # created — restart replays the sealed segment and opens a
+            # fresh one (same seq the successor would have taken).
+            # BEFORE the close(): the admin plane can arm this site
+            # with a non-crash action (error), and raising after the
+            # close would leave _f unusable for every later write
+            failpoint.inject("wal.switch.crash")
             self._f.close()
             sealed = self._seq
             self._seq += 1
             self._f = open(self._path(self._seq), "ab")
+            fileops.fsync_dir(self.dir)
         from ..utils.stats import bump as _bump
         _bump(WAL_STATS, "switches")
         return sealed
 
     def remove_upto(self, seq: int) -> None:
+        removed = False
         for fn in sorted(os.listdir(self.dir)):
             if fn.endswith(".wal"):
                 try:
@@ -306,55 +358,187 @@ class WAL:
                     continue
                 if s <= seq:
                     os.unlink(os.path.join(self.dir, fn))
+                    if not removed:
+                        removed = True
+                        # crash window: some retired segments gone,
+                        # some surviving — replay of a survivor whose
+                        # rows already live in TSSP files must be
+                        # idempotent (last-wins merge on identical
+                        # rows), which the crash harness proves
+                        failpoint.inject("wal.remove_upto.crash")
+        if removed:
+            fileops.fsync_dir(self.dir)
 
-    def replay(self):
-        """Yield row batches from all segments in order; stops at torn/corrupt
-        frames (reference engine/wal.go:562 parallel replay — ours is
-        sequential, one core)."""
+    @staticmethod
+    def _scan_next_frame(data: bytes, start: int) -> int | None:
+        """Salvage scan: first offset > ``start`` where a whole frame
+        parses (plausible length + CRC match). A CRC over the actual
+        payload makes false positives ~2^-32; recovery-path cost only
+        — and BOUNDED: candidate offsets whose random length field
+        happens to land in-bounds each cost a CRC over up to the
+        remaining segment, so a multi-MB garbage region could
+        otherwise turn one restart into hours of checksumming. A
+        fixed work budget (bytes CRC'd) degrades to the no-salvage
+        behavior (quarantine the tail) instead of hanging recovery."""
+        n = len(data)
+        q = start + 1
+        budget = 1 << 28                  # ~256MB of CRC work
+        while q + _HDR.size <= n:
+            ln, crc = _HDR.unpack_from(data, q)
+            end = q + _HDR.size + ln
+            if 0 < ln <= n - q - _HDR.size:
+                if zlib.crc32(data[q + _HDR.size:end]) == crc:
+                    return q
+                budget -= ln
+                if budget <= 0:
+                    log.warning(
+                        "wal salvage scan exhausted its work budget "
+                        "at offset %d; treating the tail as "
+                        "unsalvageable", q)
+                    return None
+            q += 1
+        return None
+
+    def _quarantine(self, path: str, data: bytes, regions: list,
+                    seg_rep: dict) -> None:
+        """Preserve the bad byte regions of one segment to
+        ``<seg>.corrupt`` (create-once — a second restart re-scanning
+        the same damage must not rewrite it) and truncate the segment
+        to its valid prefix when the damage reaches EOF, so the NEXT
+        restart replays a clean file instead of re-tripping."""
+        from ..utils.stats import bump as _bump
+        if not knobs.get("OG_STORAGE_QUARANTINE") or not regions:
+            return
+        cpath = path + ".corrupt"
+        blob = b"".join(data[a:b] for a, b in regions)
+        if not os.path.exists(cpath):
+            fileops.durable_write(cpath, blob)
+            _bump(WAL_STATS, "quarantined_files")
+            _bump(WAL_STATS, "quarantined_bytes", len(blob))
+            seg_rep["quarantined_bytes"] = len(blob)
+        if regions[-1][1] >= len(data) and regions[-1][0] < len(data):
+            with open(path, "r+b") as tf:
+                tf.truncate(regions[-1][0])
+                tf.flush()
+                os.fsync(tf.fileno())
+            _bump(WAL_STATS, "truncated_segments")
+            seg_rep["truncated_at"] = regions[-1][0]
+
+    def replay(self, report: dict | None = None):
+        """Yield row batches from all segments in order, recovering
+        past damage instead of silently dropping it (reference
+        engine/wal.go:562 replay + torn-frame handling):
+
+        - a torn/bad-CRC frame stops the segment at its valid prefix;
+          the corrupt tail is preserved to ``<seg>.corrupt`` and the
+          segment truncated (OG_STORAGE_QUARANTINE), so restart #2
+          replays clean;
+        - with OG_WAL_SALVAGE=1 the scan continues past the bad region
+          to the next CRC-valid frame and keeps replaying (counted as
+          salvaged);
+        - a frame whose boundary is sound but whose payload fails to
+          decompress/unpack is skipped individually (boundary is
+          CRC-proven, so later frames are safe) and quarantined.
+
+        Every anomaly lands in WAL_STATS and, when ``report`` is
+        given, in ``report["segments"]`` — the structured recovery
+        report /debug/vars serves."""
+        from ..utils.stats import bump as _bump
         zd = zstandard.ZstdDecompressor()
+        salvage = bool(knobs.get("OG_WAL_SALVAGE"))
         with self._lock:
             seqs = sorted(
                 int(fn[:-4]) for fn in os.listdir(self.dir)
                 if fn.endswith(".wal") and fn[:-4].isdigit())
         for seq in seqs:
+            path = self._path(seq)
             try:
-                with open(self._path(seq), "rb") as f:
+                with open(path, "rb") as f:
                     data = f.read()
             except FileNotFoundError:
                 continue
+            seg_rep = {"seq": seq, "frames": 0, "torn": 0,
+                       "bad_crc": 0, "decode_errors": 0, "salvaged": 0}
+            bad_regions: list[tuple[int, int]] = []
             pos = 0
+            salvaged_run = False
             while pos + _HDR.size <= len(data):
                 ln, crc = _HDR.unpack_from(data, pos)
-                if pos + _HDR.size + ln > len(data):
-                    log.warning("wal %06d: torn frame at %d", seq, pos)
-                    break
-                payload = data[pos + _HDR.size:pos + _HDR.size + ln]
-                if zlib.crc32(payload) != crc:
-                    log.warning("wal %06d: bad crc at %d", seq, pos)
-                    break
-                if len(payload) >= 5 and payload[0] in (
-                        _ZSTD, _LZ4, _ZSTD_COLS, _LZ4_COLS,
-                        _ZSTD_COLSB, _LZ4_COLSB):
-                    codec, rawlen = struct.unpack_from("<BI", payload, 0)
-                    body = payload[5:]
-                    if codec in (_LZ4, _LZ4_COLS, _LZ4_COLSB):
-                        raw = lz4_decompress(body, rawlen)
+                end = pos + _HDR.size + ln
+                bad_kind = None
+                if end > len(data):
+                    bad_kind = "torn"
+                elif zlib.crc32(data[pos + _HDR.size:end]) != crc:
+                    bad_kind = "bad_crc"
+                if bad_kind is not None:
+                    key = "torn_frames" if bad_kind == "torn" \
+                        else "bad_crc_frames"
+                    _bump(WAL_STATS, key)
+                    seg_rep["torn" if bad_kind == "torn"
+                            else "bad_crc"] += 1
+                    nxt = self._scan_next_frame(data, pos) \
+                        if salvage else None
+                    if nxt is None:
+                        log.warning(
+                            "wal %06d: %s frame at %d; quarantining "
+                            "%d tail bytes", seq, bad_kind, pos,
+                            len(data) - pos)
+                        bad_regions.append((pos, len(data)))
+                        pos = len(data)
+                        break
+                    log.warning(
+                        "wal %06d: %s frame at %d; salvage resumes "
+                        "at %d", seq, bad_kind, pos, nxt)
+                    bad_regions.append((pos, nxt))
+                    pos = nxt
+                    salvaged_run = True
+                    continue
+                payload = data[pos + _HDR.size:end]
+                parsed = None
+                try:
+                    if len(payload) >= 5 and payload[0] in (
+                            _ZSTD, _LZ4, _ZSTD_COLS, _LZ4_COLS,
+                            _ZSTD_COLSB, _LZ4_COLSB):
+                        codec, rawlen = struct.unpack_from(
+                            "<BI", payload, 0)
+                        body = payload[5:]
+                        if codec in (_LZ4, _LZ4_COLS, _LZ4_COLSB):
+                            raw = lz4_decompress(body, rawlen)
+                        else:
+                            raw = zd.decompress(body)
+                        if codec in (_ZSTD_COLS, _LZ4_COLS):
+                            parsed = ("cols", _unpack_cols(raw))
+                        elif codec in (_ZSTD_COLSB, _LZ4_COLSB):
+                            parsed = ("colsb", _unpack_cols_bulk(raw))
+                        else:
+                            parsed = _unpack_batch(raw)
                     else:
-                        raw = zd.decompress(body)
-                    if codec in (_ZSTD_COLS, _LZ4_COLS):
-                        yield ("cols", _unpack_cols(raw))
-                        pos += _HDR.size + ln
-                        continue
-                    if codec in (_ZSTD_COLSB, _LZ4_COLSB):
-                        yield ("colsb", _unpack_cols_bulk(raw))
-                        pos += _HDR.size + ln
-                        continue
-                else:
-                    # legacy frame: bare zstd payload (zstd magic first byte
-                    # 0x28 cannot collide with the codec ids)
-                    raw = zd.decompress(payload)
-                yield _unpack_batch(raw)
-                pos += _HDR.size + ln
+                        # legacy frame: bare zstd payload (zstd magic
+                        # first byte 0x28 cannot collide with the
+                        # codec ids)
+                        parsed = _unpack_batch(zd.decompress(payload))
+                except Exception as e:
+                    # boundary is CRC-proven: skip exactly this frame,
+                    # keep the later ones (no salvage scan needed)
+                    log.warning("wal %06d: frame at %d fails to "
+                                "decode (%s); quarantined", seq, pos, e)
+                    _bump(WAL_STATS, "decode_error_frames")
+                    seg_rep["decode_errors"] += 1
+                    bad_regions.append((pos, end))
+                    pos = end
+                    continue
+                if salvaged_run:
+                    _bump(WAL_STATS, "salvaged_frames")
+                    seg_rep["salvaged"] += 1
+                _bump(WAL_STATS, "replayed_frames")
+                _bump(WAL_STATS, "replayed_batches")
+                seg_rep["frames"] += 1
+                yield parsed
+                pos = end
+            self._quarantine(path, data, bad_regions, seg_rep)
+            if report is not None and (
+                    seg_rep["frames"] or bad_regions):
+                report.setdefault("segments", []).append(seg_rep)
 
     def close(self) -> None:
         with self._lock:

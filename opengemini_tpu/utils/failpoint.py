@@ -21,12 +21,22 @@ them with actions:
                       waking early when disable()/disable_all() runs —
                       models a hung device launch the pull watchdog
                       must bound without wedging test teardown
+    crash             SIGKILL the current process at the site — the
+                      storage crash-consistency harness's kill switch
+                      (tests/crashharness.py): no atexit, no buffer
+                      flush, no finally blocks, exactly what a power
+                      cut leaves behind. Arming requires OG_CRASH_OK=1
+                      in the environment so a stray schedule can never
+                      take down a pytest runner or a serving process
 
 Arming modifiers (pingcap term-expression analogs ``3*return`` /
 ``10%return``):
 
     maxhits=N         fire at most N times, then auto-disarm
     pct=P             each pass fires with probability P (0..100)
+    skip=K            let the first K passes through unfired (a crash
+                      schedule lands the kill on the K+1-th append /
+                      flush / publish instead of always the first)
 
 Site naming convention: ``<module>.<operation>.<fault>`` — e.g.
 ``wal.write.err``, ``transport.send.drop``, ``raft.replicate.drop``.
@@ -36,6 +46,7 @@ hot loops."""
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -62,13 +73,14 @@ class FailpointTransient(FailpointError):
 
 
 class _Spec:
-    __slots__ = ("action", "arg", "maxhits", "pct")
+    __slots__ = ("action", "arg", "maxhits", "pct", "skip")
 
-    def __init__(self, action, arg, maxhits, pct):
+    def __init__(self, action, arg, maxhits, pct, skip=0):
         self.action = action
         self.arg = arg
         self.maxhits = maxhits
         self.pct = pct
+        self.skip = skip
 
 
 _lock = threading.Lock()
@@ -91,14 +103,27 @@ def seed(n) -> None:
 
 
 def enable(name: str, action: str = "error", arg: object = None,
-           maxhits: int | None = None, pct: float | None = None) -> None:
-    """Arm a failpoint. action: error | sleep | drop | call.
+           maxhits: int | None = None, pct: float | None = None,
+           skip: int = 0) -> None:
+    """Arm a failpoint. action: error | sleep | drop | call | oom |
+    transient | hang | crash (see the module docstring for semantics;
+    crash requires OG_CRASH_OK=1 in the environment).
     maxhits=N auto-disarms the point after N fires (one-shot: N=1);
-    pct=P fires each pass with probability P percent."""
+    pct=P fires each pass with probability P percent; skip=K lets the
+    first K passes through unfired (crash schedules use it to land the
+    kill on the K+1-th WAL append / flush instead of always the first
+    — maxhits counts only actual fires, after the skips)."""
     global ACTIVE
     if action not in ("error", "sleep", "drop", "call", "oom",
-                      "transient", "hang"):
+                      "transient", "hang", "crash"):
         raise ValueError(f"unknown failpoint action {action}")
+    if action == "crash":
+        from . import knobs
+        if not knobs.get("OG_CRASH_OK"):
+            raise ValueError(
+                "refusing to arm a 'crash' failpoint without "
+                "OG_CRASH_OK=1 — it SIGKILLs the whole process "
+                "(crash-harness subprocesses only)")
     if action == "call" and not callable(arg):
         raise ValueError("action 'call' requires a callable arg")
     if action in ("sleep", "hang"):
@@ -122,8 +147,14 @@ def enable(name: str, action: str = "error", arg: object = None,
             raise ValueError("pct must be a number (0..100)")
         if not 0 <= pct <= 100:
             raise ValueError("pct must be within 0..100")
+    try:
+        skip = int(skip)
+    except (TypeError, ValueError):
+        raise ValueError("skip must be an integer")
+    if skip < 0:
+        raise ValueError("skip must be >= 0")
     with _lock:
-        _points[name] = _Spec(action, arg, maxhits, pct)
+        _points[name] = _Spec(action, arg, maxhits, pct, skip)
         _hits.pop(name, None)      # hit counts reset on (re)arm
         ACTIVE = True
 
@@ -158,7 +189,8 @@ def list_points() -> dict:
         return {n: {"action": s.action, "hits": _hits.get(n, 0),
                     **({"maxhits": s.maxhits}
                        if s.maxhits is not None else {}),
-                    **({"pct": s.pct} if s.pct is not None else {})}
+                    **({"pct": s.pct} if s.pct is not None else {}),
+                    **({"skip": s.skip} if s.skip else {})}
                 for n, s in _points.items()}
 
 
@@ -176,10 +208,19 @@ def inject(name: str) -> bool:
         if spec.pct is not None and _rng.random() * 100.0 >= spec.pct:
             return False           # armed but this pass doesn't fire
         _hits[name] = _hits.get(name, 0) + 1
-        if spec.maxhits is not None and _hits[name] >= spec.maxhits:
+        if _hits[name] <= spec.skip:
+            return False           # armed but still in the skip window
+        if spec.maxhits is not None and \
+                _hits[name] - spec.skip >= spec.maxhits:
             _points.pop(name, None)        # one-shot/N-shot: auto-disarm
             ACTIVE = bool(_points)
         action, arg = spec.action, spec.arg
+    if action == "crash":
+        # a real crash persists nothing: no flush, no atexit, no
+        # finally. SIGKILL is the closest a process can get to a
+        # power cut (the kernel reaps it mid-instruction).
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
     if action == "error":
         raise FailpointError(arg or f"failpoint {name}")
     if action == "oom":

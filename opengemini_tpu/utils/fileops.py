@@ -1,0 +1,87 @@
+"""Durable file operations (role of the reference's lib/fileops/
+fsync discipline around rename-publish: engine/immutable writers fsync
+the file AND the directory before a .tmp swap becomes the published
+name).
+
+``os.replace`` alone is NOT durable on Linux: the rename is a
+directory mutation, and until the parent directory is fsynced a crash
+can roll it back — the published file vanishes (or the pre-rename
+name reappears) after restart, even though the file's own bytes were
+fsynced.  Every publish-by-rename in ``storage/`` must ride
+``durable_replace`` (oglint rule R8 enforces this); the same applies
+to newly created WAL segments, whose directory entry is what makes an
+fsynced frame findable after a crash (``fsync_dir``).
+
+The helpers are deliberately tiny and dependency-free: storage-layer
+modules import them at the top of their publish paths, and the crash
+harness (tests/crashharness.py) SIGKILLs processes between these calls
+to prove the recovery contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .stats import register_counters, bump
+
+FILEOPS_STATS = register_counters("fileops", {
+    "durable_replaces": 0, "dir_fsyncs": 0, "dir_fsync_errors": 0,
+    "file_fsyncs": 0})
+
+
+def fsync_dir(path: str) -> bool:
+    """fsync a DIRECTORY so renames/creates/unlinks inside it survive a
+    crash. Best-effort: some filesystems (and non-POSIX platforms)
+    refuse O_RDONLY opens of directories — counted, never fatal (the
+    caller's data-file fsync already happened; losing the rename is
+    the pre-PR-10 behavior, not a new failure mode)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        bump(FILEOPS_STATS, "dir_fsync_errors")
+        return False
+    try:
+        os.fsync(fd)
+        bump(FILEOPS_STATS, "dir_fsyncs")
+        return True
+    except OSError:
+        bump(FILEOPS_STATS, "dir_fsync_errors")
+        return False
+    finally:
+        os.close(fd)
+
+
+def fsync_file(path: str) -> None:
+    """fsync an existing file by path (for copies made via shutil,
+    which never sync)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+        bump(FILEOPS_STATS, "file_fsyncs")
+    finally:
+        os.close(fd)
+
+
+def durable_replace(src: str, dst: str, sync_src: bool = False) -> None:
+    """``os.replace(src, dst)`` with rename durability: optionally
+    fsync ``src`` first (callers that already fsynced before closing
+    skip it), then fsync ``dst``'s parent directory so the rename
+    itself survives a crash. The one sanctioned rename-publish in
+    ``storage/`` (oglint R8)."""
+    if sync_src:
+        fsync_file(src)
+    os.replace(src, dst)  # oglint: disable=R801
+    bump(FILEOPS_STATS, "durable_replaces")
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def durable_write(path: str, data: bytes) -> None:
+    """Atomically publish ``data`` at ``path``: write to ``path.tmp``,
+    fsync the file, durable-rename into place. Used for small metadata
+    files (quarantine markers, recovery artifacts)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    durable_replace(tmp, path)

@@ -374,6 +374,24 @@ register("OG_TSI_SNAP_BYTES", int, 4 << 20,
          "TSI log-size threshold that triggers an index snapshot",
          scope="module-init")
 
+# --- storage crash consistency (storage/wal.py, tests/crashharness.py)
+register("OG_WAL_SALVAGE", bool, False,
+         "WAL replay scans forward past a bad-CRC frame to the next "
+         "valid frame instead of stopping the segment (the bad region "
+         "is still quarantined); off = stop at the first bad frame "
+         "(the corrupt tail is quarantined and the segment truncated "
+         "to its valid prefix)")
+register("OG_STORAGE_QUARANTINE", bool, True,
+         "quarantine corrupt storage artifacts (WAL tails, unreadable "
+         "TSSP/colstore files) to <name>.corrupt instead of leaving "
+         "them in place; 0 = log-only (pre-PR-10 behavior)")
+register("OG_CRASH_OK", bool, False,
+         "arming guard for the `crash` failpoint action (SIGKILLs the "
+         "process): only crash-harness subprocesses set it")
+register("OG_CRASH_HARNESS_S", float, 120.0,
+         "crash harness: wall budget per crash-cycle subprocess "
+         "before the parent declares it hung and fails the cycle")
+
 # --- cluster
 register("OG_READER_ROUTING", bool, True,
          "replica-aware reader routing; 0 = primary-only reads",

@@ -2,12 +2,16 @@
 # Chaos sweep: run N seeded fault schedules (tests/test_chaos.py
 # slow schedules) and print a per-seed pass/fail table.
 #
-#   scripts/chaos_sweep.sh [--device] [N] [BASE_SEED]
+#   scripts/chaos_sweep.sh [--device|--crash] [N] [BASE_SEED]
 #
 #   --device   run the DEVICE-fault storms (test_device_chaos_schedule:
 #              OOM / transient / hang across the device dispatch routes,
 #              digest + ledger + breaker-heal contract) instead of the
 #              cluster kill/restart/delay/drop schedules
+#   --crash    run the STORAGE crash-consistency sweeps
+#              (test_crash_chaos_schedule: one seeded SIGKILL/restart
+#              cycle per crash-point site through tests/crashharness.py,
+#              recovery contract C1-C5 per cycle)
 #   N          number of seeds to run (default 5)
 #   BASE_SEED  first seed (default 1); seeds are BASE..BASE+N-1
 #
@@ -21,6 +25,10 @@ LABEL=cluster
 if [ "${1:-}" = "--device" ]; then
     TEST=test_device_chaos_schedule
     LABEL=device
+    shift
+elif [ "${1:-}" = "--crash" ]; then
+    TEST=test_crash_chaos_schedule
+    LABEL=crash
     shift
 fi
 N=${1:-5}

@@ -13,9 +13,14 @@
 # (PR 9) adds a chaos gate: one seeded OOM/transient/hang schedule per
 # bench shape must keep digests equal to the fault-free references
 # with zero HBM-ledger drift, and the breaker trip->half-open->restore
-# cycle reports fault_recovery_ms. Runs a scaled-down bench dataset on
-# the CPU backend with per-phase output — CI-safe (no accelerator
-# needed, minutes of wall).
+# cycle reports fault_recovery_ms. The storage crash gate (PR 10) adds
+# one SIGKILL/restart cycle per bench shape: a child rebuilds the
+# dataset with fsync-acked ingest and dies mid-flush at a rotating
+# durability boundary; the restarted engine must serve each shape's
+# digest bit-identical to the no-crash reference with zero orphan
+# .tmp files, and reports crash_recovery_ms. Runs a scaled-down bench
+# dataset on the CPU backend with per-phase output — CI-safe (no
+# accelerator needed, minutes of wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]
@@ -75,6 +80,13 @@ assert "obs_overhead_pct" in r, r
 assert r.get("chaos_injections", 0) > 0, r
 assert r.get("chaos_ledger_ok") == 1, r
 assert r.get("fault_recovery_ms", 0) > 0, r
+# storage crash gate (PR 10): every per-shape SIGKILL/restart cycle
+# recovered to the no-crash digest with zero orphans, and the cold
+# restart cost is measured
+assert r.get("crash_cycles", 0) >= 3, r
+assert r.get("crash_digest_ok") == 1, r
+assert r.get("crash_orphans") == 0, r
+assert r.get("crash_recovery_ms", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -85,6 +97,9 @@ print(f"observatory gate OK: overhead {r['obs_overhead_pct']}% "
 print(f"chaos gate OK: {r['chaos_injections']} device faults "
       f"injected, zero ledger drift, breaker recovery "
       f"{r['fault_recovery_ms']}ms")
+print(f"crash gate OK: {r['crash_cycles']} SIGKILL/restart cycles, "
+      f"digests bit-identical, zero orphans, cold restart "
+      f"{r['crash_recovery_ms']}ms")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

@@ -26,7 +26,17 @@ with fired-verification in tests/test_device_faults.py instead) and
 asserts the device contract D1–D3 documented next to
 ``DEVICE_FAULT_SITES`` below.
 
-Not a pytest module itself — tests/test_chaos.py drives it.
+PR 10 completes the fault-domain triad with the STORAGE crash domain:
+``run_crash_schedule`` drives seeded SIGKILL/restart cycles through
+tests/crashharness.py across the crash-point sites at every
+durability boundary (WAL append/switch/remove, TSSP atomic publish,
+flush commit, compaction swap, colstore/backup manifest publish,
+index fsync), asserting the recovery contract C1–C5 documented there
+(acked data bit-identical, frames whole, replay idempotent, no
+orphans, loud backups).
+
+Not a pytest module itself — tests/test_chaos.py and
+tests/test_crash_recovery.py drive it.
 """
 
 from __future__ import annotations
@@ -356,6 +366,44 @@ def _device_digest(res: dict) -> str:
         for r in s["values"]:
             dig.update(repr(tuple(r)).encode())
     return dig.hexdigest()
+
+
+def run_crash_schedule(root, seed: int, sites: list[str] | None = None,
+                       cycles_per_site: int = 1) -> dict:
+    """Seeded storage crash-consistency schedule: one (or more)
+    crashharness cycle per crash-point site, with seeds/skips derived
+    from the master seed. Every cycle must FIRE its kill and pass the
+    full recovery contract (crashharness.run_crash_cycle raises on
+    any violation); a cycle that never fires is an arming bug and
+    fails the schedule. Returns aggregate stats."""
+    import random
+
+    import crashharness as ch
+
+    rng = random.Random(seed)
+    sites = list(ch.CRASH_SITES) if sites is None else list(sites)
+    stats = {"seed": seed, "cycles": 0, "fired": 0,
+             "recovery_ms": [], "sites": {}}
+    for site in sites:
+        for c in range(cycles_per_site):
+            sub = rng.randrange(1 << 30)
+            wd = os.path.join(
+                str(root), f"{site.replace('.', '_')}_{c}")
+            s = ch.run_crash_cycle(wd, site, sub)
+            stats["cycles"] += 1
+            assert s["fired"], (
+                f"crash point {site} never fired (seed={sub} "
+                f"skip={s['skip']}) — the schedule no longer reaches "
+                f"its durability boundary")
+            stats["fired"] += 1
+            stats["recovery_ms"].append(s["recovery_open_ms"])
+            stats["sites"][f"{site}#{c}"] = {
+                "seed": sub, "skip": s["skip"],
+                "acked_batches": s["acked_batches"],
+                "rows": s["rows"], "digest": s["digest"][:16],
+                "backup": s["backup"],
+                "quarantined": len(s["quarantined"])}
+    return stats
 
 
 def run_device_schedule(root, seed: int, steps: int = 6,

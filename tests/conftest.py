@@ -78,6 +78,23 @@ def _failpoint_hygiene():
     yield
     leaked = failpoint.list_points()
     failpoint.disable_all()
+    # crash-action points are deadlier than other leaks: a later test
+    # walking the same code path would SIGKILL the whole pytest
+    # runner (no report, no teardown). They are subprocess-only
+    # (crashharness children) — armed here means a harness test
+    # escaped its sandbox. Same for the OG_CRASH_OK arming guard: a
+    # leaked env flip would let any stray schedule arm one.
+    crash_armed = {n for n, s in leaked.items()
+                   if s["action"] == "crash"}
+    crash_ok_leaked = os.environ.get("OG_CRASH_OK")
+    os.environ.pop("OG_CRASH_OK", None)
+    assert not crash_armed, (
+        f"test leaked ARMED CRASH failpoints {sorted(crash_armed)} — "
+        "crash actions may only be armed inside crashharness child "
+        "subprocesses, never in the pytest process")
+    assert not crash_ok_leaked, (
+        "test leaked OG_CRASH_OK=1 into the pytest environment — "
+        "pass it via the crash child's subprocess env only")
     reset_breakers()
     leaked_permits = devicefault.shrunk_permits()
     open_routes = [r for r, s in devicefault.breaker_snapshot().items()

@@ -1,0 +1,447 @@
+"""Subprocess crash harness: prove the storage engine's recovery
+contract at every durability boundary.
+
+A CYCLE is one seeded crash/restart experiment against a fresh data
+dir, in three subprocess acts (a real process death and a real
+restart — in-process "crashes" can't lose user-space buffers or
+unflushed Python file objects the way SIGKILL does):
+
+  child    ingests deterministic batches through a wal_sync engine
+           (write_points returning == the frame is fsynced == the
+           batch is ACKED; acks are themselves fsynced to acks.log
+           AFTER the write returns, so acks.log ⊆ durable-set always
+           holds), flushing / compacting / backing up on a fixed
+           schedule, with ONE ``crash``-action failpoint armed at the
+           cycle's crash-point site (seeded ``skip`` varies which
+           pass takes the kill). The failpoint SIGKILLs the process
+           mid-operation: no flush, no atexit, no finally.
+
+  verify   a fresh process opens the same data dir (WAL replay =
+           the recovery under test) and asserts the RECOVERY
+           CONTRACT:
+             C1  every acked batch is queryable bit-identically
+                 (exact float equality against the regenerated
+                 batch content);
+             C2  every row served belongs to some generated batch
+                 with its exact value, and unacked batches are
+                 absent or WHOLE (a WAL frame is atomic: torn ⇒
+                 dropped entirely, durable ⇒ replayed entirely);
+             C3  per-series times are strictly increasing — replay
+                 over rows that already reached TSSP files (the
+                 remove_upto crash window) must not duplicate rows;
+             C4  no orphan ``*.tmp`` survives anywhere under the
+                 data dir once the engine finished opening;
+             C5  a crashed backup dir is loudly unusable (no
+                 manifest ⇒ BackupError) or fully verifiable —
+                 never a silently short backup.
+
+  verify#2 runs the identical checks again (restart-after-restart):
+           its digest must equal verify #1's — recovery is
+           idempotent and quarantine/truncation converge (a second
+           restart re-scans no damage and re-drops no data).
+
+Fired-verification: the child's exit status IS the proof the site
+fired (SIGKILL ⇒ returncode -9). A child that completes its schedule
+exits 0 and the cycle reports ``fired=False`` — callers decide
+whether that's an arming bug (matrix tests assert fired for every
+site).
+
+Run one cycle standalone:
+
+    python tests/crashharness.py cycle /tmp/cc wal.switch.crash 7
+
+Not a pytest module — tests/test_crash_recovery.py and
+tests/chaos.py:run_crash_schedule drive it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# runnable as a bare script (the child/verify subprocesses are):
+# the repo root must be importable regardless of the caller's cwd
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+DB = "crashdb"
+MST = "m"                 # row-store measurement
+CS_MST = "cs"             # columnstore measurement (publish boundary)
+HOSTS = 4
+RPB = 6                   # rows per batch (per measurement)
+T_STEP = 10**9
+ROUNDS = 5                # child schedule: rounds of (ingest, flush, …)
+BATCHES_PER_ROUND = 3
+MAX_BATCHES = ROUNDS * BATCHES_PER_ROUND
+
+# crash-point site -> max seeded `skip` (how many passes the child's
+# schedule can afford to let through and still reach the site again).
+# Once-per-run phases (compact, backup) must take the first pass.
+CRASH_SITES: dict[str, int] = {
+    "wal.append.crash_pre_sync": 5,
+    "wal.append.crash_post_sync": 5,
+    "tsi.flush.crash": 2,
+    "wal.switch.crash": 2,
+    "tssp.finalize.crash_pre_sync": 2,
+    "tssp.finalize.crash_pre_rename": 2,
+    "tssp.finalize.crash_post_rename": 2,
+    "shard.flush.crash_commit": 2,
+    "wal.remove_upto.crash": 2,
+    "colstore.publish.crash": 2,
+    "compact.swap.crash": 0,
+    "backup.manifest.crash": 0,
+}
+
+
+# ------------------------------------------------- deterministic data
+#
+# Batch content derives from the batch id alone, so the verifier can
+# regenerate the EXPECTED bytes of any batch without trusting anything
+# the dead child wrote besides the acked ids. Times are globally
+# unique across batches (duplication after a replay-over-files crash
+# is therefore observable), values are exact small binary floats
+# (bit-identity is plain ==).
+
+def batch_times(i: int) -> list[int]:
+    return [(i * RPB + j) * T_STEP for j in range(RPB)]
+
+
+def batch_host(i: int, j: int) -> str:
+    return f"h{(i + j) % HOSTS}"
+
+
+def batch_value(i: int, j: int) -> float:
+    return float(i * 100003 + j * 17) / 8.0
+
+
+def locate_row(t: int) -> tuple[int, int]:
+    """Inverse of batch_times: time -> (batch id, row index)."""
+    k = t // T_STEP
+    return int(k // RPB), int(k % RPB)
+
+
+def _mk_rows(i: int):
+    from opengemini_tpu.storage import PointRow
+    rows = []
+    for j in range(RPB):
+        t = (i * RPB + j) * T_STEP
+        host, v = batch_host(i, j), batch_value(i, j)
+        rows.append(PointRow(MST, {"host": host}, {"v": v}, t))
+        rows.append(PointRow(CS_MST, {"host": host}, {"v": v}, t))
+    return rows
+
+
+def _open_engine(data_dir: str):
+    from opengemini_tpu.storage import Engine, EngineOptions
+    return Engine(data_dir, EngineOptions(
+        wal_sync=True,               # returning == fsync-acknowledged
+        shard_duration=1 << 62,      # one shard: deterministic layout
+        lazy_shard_open=False))      # open == full recovery, no lazy
+
+
+def _paths(workdir: str) -> dict:
+    return {"data": os.path.join(workdir, "data"),
+            "backup": os.path.join(workdir, "backup"),
+            "acks": os.path.join(workdir, "acks.log")}
+
+
+# --------------------------------------------------------- child role
+
+def child_main(workdir: str, site: str, seed: int, skip: int) -> int:
+    """Ingest/flush/compact/backup until the armed crash point
+    SIGKILLs us. Exits 0 (with a NOFIRE marker on stdout) only if the
+    whole schedule completes without the site firing."""
+    import random
+
+    from opengemini_tpu.storage.compact import Compactor
+    from opengemini_tpu.storage.backup import create_backup
+    from opengemini_tpu.utils import failpoint
+
+    p = _paths(workdir)
+    rng = random.Random(seed)
+    failpoint.seed(seed)
+    eng = _open_engine(p["data"])
+    eng.create_columnstore(DB, CS_MST, primary_key=["host"])
+    ack_f = open(p["acks"], "ab")
+
+    # armed BEFORE the workload: every act of the schedule runs with
+    # the kill switch live (refuses without OG_CRASH_OK=1 in env)
+    failpoint.enable(site, "crash", skip=skip)
+
+    batch = 0
+    for r in range(ROUNDS):
+        for _ in range(BATCHES_PER_ROUND):
+            eng.write_points(DB, _mk_rows(batch))
+            # the write returned: frame fsynced, batch is acked. The
+            # ack record must itself be durable before it counts —
+            # a crash between write and ack-fsync leaves the batch
+            # durable-but-unacked, which the contract allows.
+            ack_f.write(f"{batch}\n".encode())
+            ack_f.flush()
+            os.fsync(ack_f.fileno())
+            batch += 1
+        eng.flush_all()
+        if r in (1, 3):
+            for sh in eng.database(DB).all_shards():
+                Compactor(sh, fanout=2).run_once()
+        if r == 2:
+            create_backup(eng, p["backup"])
+        # tiny seeded jitter keeps schedules from being phase-locked
+        # to the failpoint's hit counter across sites
+        time.sleep(rng.uniform(0, 0.01))
+
+    failpoint.disable_all()
+    eng.close()
+    ack_f.close()
+    print("NOFIRE")                   # schedule exhausted, site silent
+    return 0
+
+
+# -------------------------------------------------------- verify role
+
+def _scan_all(eng) -> dict[str, dict[int, tuple[str, float]]]:
+    """Read back EVERYTHING the engine serves for both measurements:
+    {mst: {time: (host, value)}}. Asserts C3 (strictly increasing,
+    duplicate-free times per series) along the way."""
+    from opengemini_tpu.index import TagFilter
+
+    got: dict[str, dict[int, tuple[str, float]]] = {MST: {}, CS_MST: {}}
+    for h in range(HOSTS):
+        host = f"h{h}"
+        for _sh, _sid, rec in eng.scan_series(
+                DB, MST, filters=[TagFilter("host", host)]):
+            times = list(rec.times)
+            assert all(a < b for a, b in zip(times, times[1:])), (
+                f"C3 violated: {MST}/{host} times not strictly "
+                f"increasing (replay duplicated rows?): {times[:20]}")
+            vals = list(rec.column("v").values)
+            for t, v in zip(times, vals):
+                assert t not in got[MST], (
+                    f"C3 violated: time {t} served twice for {MST}")
+                got[MST][int(t)] = (host, float(v))
+    for sh in eng.database(DB).all_shards():
+        rec = sh.scan_columnstore(CS_MST, columns=["host", "v"])
+        if rec is None:
+            continue
+        times = list(rec.times)
+        hcol, vcol = rec.column("host"), rec.column("v")
+        for i, t in enumerate(times):
+            host = hcol.get(i)      # STRING ColVals have no .values
+            host = host.decode() if isinstance(host, bytes) else str(host)
+            assert t not in got[CS_MST], (
+                f"C3 violated: time {t} served twice for {CS_MST}")
+            got[CS_MST][int(t)] = (host, float(vcol.get(i)))
+    return got
+
+
+def _check_contract(got: dict, acked: list[int]) -> None:
+    for mst in (MST, CS_MST):
+        rows = got[mst]
+        # C1: acked ⊆ served, bit-identically
+        for i in acked:
+            for j, t in enumerate(batch_times(i)):
+                exp = (batch_host(i, j), batch_value(i, j))
+                assert rows.get(t) == exp, (
+                    f"C1 violated: acked batch {i} row {j} of {mst} "
+                    f"expected {exp} at t={t}, served {rows.get(t)}")
+        # C2: served ⊆ generated universe (exact values), and any
+        # unacked batch present is present WHOLE
+        present: dict[int, int] = {}
+        for t, (host, v) in rows.items():
+            i, j = locate_row(t)
+            assert 0 <= i < MAX_BATCHES and t == batch_times(i)[j], (
+                f"C2 violated: {mst} serves alien row t={t}")
+            exp = (batch_host(i, j), batch_value(i, j))
+            assert (host, v) == exp, (
+                f"C2 violated: {mst} batch {i} row {j} corrupt: "
+                f"served {(host, v)}, generated {exp}")
+            present[i] = present.get(i, 0) + 1
+        for i, n in present.items():
+            assert n == RPB, (
+                f"C2 violated: batch {i} of {mst} is PARTIAL "
+                f"({n}/{RPB} rows) — a WAL frame must replay whole "
+                f"or not at all")
+
+
+def _sweep_tmp(root: str) -> list[str]:
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        out.extend(os.path.join(dirpath, fn)
+                   for fn in files if fn.endswith(".tmp"))
+    return sorted(out)
+
+
+def _check_backup(bdir: str) -> str:
+    """C5: a backup dir either restores cleanly or refuses loudly."""
+    from opengemini_tpu.storage.backup import (BackupError, MANIFEST,
+                                               restore_backup,
+                                               verify_backup)
+    if not os.path.isdir(bdir):
+        return "absent"
+    if os.path.exists(os.path.join(bdir, MANIFEST)):
+        problems = verify_backup(bdir)
+        assert not problems, (
+            f"C5 violated: manifest published but backup broken: "
+            f"{problems}")
+        return "verified"
+    # manifest never published (the crash landed before the rename):
+    # the dir must be LOUDLY not-a-backup — verify names the missing
+    # manifest and restore refuses — never a silently short restore
+    problems = verify_backup(bdir)
+    assert problems and "not a backup dir" in problems[0], (
+        f"C5 violated: manifest-less backup dir verifies as "
+        f"{problems!r}")
+    try:
+        restore_backup(bdir, bdir + ".restore-probe")
+    except BackupError:
+        return "refused"            # loud — the contract's good case
+    raise AssertionError(
+        "C5 violated: restore from a manifest-less backup dir did "
+        "not raise BackupError")
+
+
+def verify_main(workdir: str, out_path: str) -> int:
+    """One restart + full contract check; writes a result JSON with
+    the digest, recovery report and orphan census."""
+    from opengemini_tpu.storage.wal import recovery_summary
+
+    p = _paths(workdir)
+    acked = []
+    if os.path.exists(p["acks"]):
+        with open(p["acks"], "rb") as f:
+            for line in f.read().splitlines():
+                try:                 # a SIGKILL can tear the last line
+                    acked.append(int(line))
+                except ValueError:
+                    pass
+    t0 = time.perf_counter()
+    eng = _open_engine(p["data"])
+    recovery_open_ms = (time.perf_counter() - t0) * 1e3
+    try:
+        got = _scan_all(eng)
+        _check_contract(got, acked)
+        orphans = _sweep_tmp(p["data"])
+        assert not orphans, (
+            f"C4 violated: orphan .tmp files survived restart: "
+            f"{orphans}")
+        backup_state = _check_backup(p["backup"])
+        dig = hashlib.sha256()
+        for mst in (MST, CS_MST):
+            for t in sorted(got[mst]):
+                host, v = got[mst][t]
+                dig.update(f"{mst}|{host}|{t}|{v!r}\n".encode())
+        corrupt = []
+        for dirpath, _dirs, files in os.walk(p["data"]):
+            corrupt.extend(os.path.join(dirpath, fn)
+                           for fn in files if fn.endswith(".corrupt"))
+        result = {
+            "digest": dig.hexdigest(),
+            "rows": {m: len(got[m]) for m in got},
+            "acked_batches": len(acked),
+            "orphans": 0,
+            "quarantined": sorted(corrupt),
+            "backup": backup_state,
+            "recovery_open_ms": round(recovery_open_ms, 3),
+            "recovery": recovery_summary(),
+        }
+    finally:
+        eng.close()
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+# ------------------------------------------------------- parent driver
+
+def _harness_cmd(*args: str) -> list[str]:
+    return [sys.executable, os.path.abspath(__file__), *args]
+
+
+def _run(cmd: list[str], env: dict, timeout_s: float):
+    return subprocess.run(
+        cmd, env=env, timeout=timeout_s,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def run_crash_cycle(workdir: str, site: str, seed: int,
+                    skip: int | None = None) -> dict:
+    """One full crash/restart/verify cycle. Returns cycle stats;
+    raises AssertionError on any recovery-contract violation."""
+    import random
+
+    from opengemini_tpu.utils import knobs
+
+    if site not in CRASH_SITES:
+        raise ValueError(f"unknown crash site {site!r} "
+                         f"(see CRASH_SITES)")
+    os.makedirs(workdir, exist_ok=True)
+    timeout_s = float(knobs.get("OG_CRASH_HARNESS_S"))
+    if skip is None:
+        skip = random.Random(seed).randint(0, CRASH_SITES[site])
+
+    env = dict(os.environ)
+    env["OG_CRASH_OK"] = "1"         # the child, and ONLY the child
+    env.pop("OG_WAL_SALVAGE", None)  # contract is proven on defaults
+    child = _run(_harness_cmd("child", workdir, site, str(seed),
+                              str(skip)), env, timeout_s)
+    if child.returncode == -signal.SIGKILL:
+        fired = True
+    elif child.returncode == 0 and b"NOFIRE" in child.stdout:
+        fired = False
+    else:
+        raise RuntimeError(
+            f"crash child for {site} died unexpectedly "
+            f"(rc={child.returncode}):\n"
+            f"{child.stdout.decode(errors='replace')[-4000:]}")
+
+    venv = dict(os.environ)
+    venv.pop("OG_CRASH_OK", None)    # a verifier must never crash
+    results = []
+    for k in (1, 2):
+        out = os.path.join(workdir, f"verify{k}.json")
+        v = _run(_harness_cmd("verify", workdir, out), venv, timeout_s)
+        if v.returncode != 0:
+            raise AssertionError(
+                f"recovery contract violated at {site} "
+                f"(seed={seed} skip={skip}, restart #{k}):\n"
+                f"{v.stdout.decode(errors='replace')[-4000:]}")
+        with open(out) as f:
+            results.append(json.load(f))
+    assert results[0]["digest"] == results[1]["digest"], (
+        f"restart #2 served different bytes than restart #1 at "
+        f"{site} (seed={seed} skip={skip}): recovery is not "
+        f"idempotent")
+    assert results[0]["quarantined"] == results[1]["quarantined"], (
+        f"quarantine did not converge across restarts at {site}: "
+        f"{results[0]['quarantined']} vs {results[1]['quarantined']}")
+    return {"site": site, "seed": seed, "skip": skip, "fired": fired,
+            "digest": results[0]["digest"],
+            "rows": results[0]["rows"],
+            "acked_batches": results[0]["acked_batches"],
+            "quarantined": results[0]["quarantined"],
+            "backup": results[0]["backup"],
+            "recovery_open_ms": results[0]["recovery_open_ms"],
+            "recovery": results[0]["recovery"]}
+
+
+def main(argv: list[str]) -> int:
+    role = argv[0]
+    if role == "child":
+        return child_main(argv[1], argv[2], int(argv[3]), int(argv[4]))
+    if role == "verify":
+        return verify_main(argv[1], argv[2])
+    if role == "cycle":
+        stats = run_crash_cycle(argv[1], argv[2], int(argv[3]))
+        print(json.dumps(stats, indent=1))
+        return 0
+    raise SystemExit(f"unknown role {role!r} (child|verify|cycle)")
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,11 @@
+"""R8 failing fixture: bare renames at storage publish points."""
+
+import os
+
+
+def publish(path: str) -> None:
+    os.replace(path + ".tmp", path)          # R801
+
+
+def rotate(path: str) -> None:
+    os.rename(path, path + ".old")           # R801

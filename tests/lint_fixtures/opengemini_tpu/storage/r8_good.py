@@ -1,0 +1,14 @@
+"""R8 passing fixture: durable publishes (helper or reviewed pragma)."""
+
+import os
+
+from opengemini_tpu.utils import fileops
+
+
+def publish(path: str) -> None:
+    fileops.durable_replace(path + ".tmp", path)
+
+
+def scratch_rotate(path: str) -> None:
+    # scratch file inside a dir swept at open: durability not needed
+    os.rename(path, path + ".old")  # oglint: disable=R801

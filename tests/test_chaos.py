@@ -191,3 +191,33 @@ def test_device_chaos_schedule(tmp_path, seed):
     stats = run_device_schedule(tmp_path, seed, steps=10,
                                 queries_per_step=3)
     assert stats["queries"] > 0
+
+
+# ------------------------------------- storage crash cycles (PR 10)
+
+
+def test_crash_chaos_smoke(tmp_path):
+    """Tier-1 smoke: two seeded SIGKILL/restart cycles through the
+    subprocess crash harness — one mid-WAL-append, one mid-TSSP-
+    publish. The full 12-site matrix runs in
+    tests/test_crash_recovery.py; the seeded all-site schedules via
+    scripts/chaos_sweep.sh --crash."""
+    from chaos import run_crash_schedule
+    stats = run_crash_schedule(
+        tmp_path, seed=42,
+        sites=["wal.append.crash_post_sync",
+               "tssp.finalize.crash_pre_rename"])
+    assert stats["fired"] == stats["cycles"] == 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_crash_chaos_schedule(tmp_path, seed):
+    """Seeded crash/restart sweep over EVERY crash-point site
+    (scripts/chaos_sweep.sh --crash). run_crash_schedule asserts the
+    recovery contract C1–C5 per cycle and that every kill fired.
+    Reproduce with CHAOS_SEEDS=<seed>."""
+    from chaos import run_crash_schedule
+    from crashharness import CRASH_SITES
+    stats = run_crash_schedule(tmp_path, seed)
+    assert stats["fired"] == stats["cycles"] == len(CRASH_SITES)

@@ -1,0 +1,375 @@
+"""Storage crash-consistency tests (PR 10).
+
+Two layers:
+
+- the SUBPROCESS crash matrix: every crash-point site in
+  crashharness.CRASH_SITES gets a real SIGKILL mid-operation and two
+  real restarts, with the recovery contract C1–C5 (see
+  tests/crashharness.py) asserted by a fresh verifier process —
+  fired-verification is the child's -SIGKILL exit status;
+
+- IN-PROCESS recovery units for the damage the harness flushes out:
+  WAL torn / bit-flipped / undecodable frames (counter bookkeeping,
+  quarantine-and-truncate convergence, OG_WAL_SALVAGE scan-forward),
+  replay idempotency when a retired segment survives remove_upto,
+  orphan-``.tmp`` sweeps, TSSP metadata-checksum and colstore-footer
+  quarantine, and the recovery report's /debug/vars surface.
+"""
+
+import json
+import os
+import shutil
+import struct
+import zlib
+
+import pytest
+
+from crashharness import CRASH_SITES, run_crash_cycle
+from opengemini_tpu.storage import Engine, EngineOptions, PointRow
+from opengemini_tpu.storage.wal import (WAL, WAL_STATS,
+                                        recovery_summary)
+from opengemini_tpu.utils import failpoint
+
+OPTS = dict(shard_duration=1 << 62, lazy_shard_open=False)
+
+
+# ------------------------------------------------ subprocess matrix
+
+@pytest.mark.parametrize("site", sorted(CRASH_SITES))
+def test_crash_matrix(site, tmp_path):
+    """One seeded SIGKILL at the site's durability boundary, two
+    restarts, full recovery contract. The kill must actually fire —
+    a silent cycle means the workload no longer reaches the site."""
+    stats = run_crash_cycle(str(tmp_path), site,
+                            seed=0xC0FFEE ^ zlib.crc32(site.encode()))
+    assert stats["fired"], (
+        f"crash point {site} never fired — its durability boundary "
+        f"is no longer on the harness workload's path")
+
+
+# The seeded all-site schedules live in tests/test_chaos.py
+# (test_crash_chaos_schedule, CHAOS_SEEDS-parametrized) so
+# scripts/chaos_sweep.sh --crash drives them like the cluster and
+# device storms.
+
+
+# ----------------------------------------------- WAL frame damage
+
+def _mk_wal(path, batches):
+    w = WAL(str(path), sync=True)
+    for b in batches:
+        w.write(b)
+    w.close()
+    return os.path.join(str(path), "000001.wal")
+
+
+def _frame_offsets(seg):
+    with open(seg, "rb") as f:
+        data = f.read()
+    offs, pos = [], 0
+    while pos + 8 <= len(data):
+        ln, _crc = struct.unpack_from("<II", data, pos)
+        offs.append((pos, 8 + ln))
+        pos += 8 + ln
+    return offs, data
+
+
+def _batch(i):
+    return [("m", 1, {"v": float(i * 10 + j)}, i * 100 + j)
+            for j in range(3)]
+
+
+def _flip_byte(seg, off):
+    with open(seg, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_wal_bad_crc_mid_segment_counters_and_quarantine(tmp_path):
+    """Regression for the silent-truncate era: a bit-flipped MIDDLE
+    frame must bump the bad_crc counter, land in the recovery report,
+    quarantine the damaged tail to <seg>.corrupt and truncate the
+    segment so the second restart replays clean — pre-PR-10 this was
+    one log.warning and every later frame silently vanished."""
+    seg = _mk_wal(tmp_path, [_batch(0), _batch(1), _batch(2)])
+    offs, _data = _frame_offsets(seg)
+    assert len(offs) == 3
+    _flip_byte(seg, offs[1][0] + 8 + 2)      # payload of frame #2
+    c0 = WAL_STATS["bad_crc_frames"]
+    q0 = WAL_STATS["quarantined_files"]
+    rep = {}
+    got = list(WAL(str(tmp_path)).replay(report=rep))
+    # default (no salvage): valid prefix only — but COUNTED, reported,
+    # quarantined, truncated
+    assert got == [_batch(0)]
+    assert WAL_STATS["bad_crc_frames"] == c0 + 1
+    assert WAL_STATS["quarantined_files"] == q0 + 1
+    assert os.path.exists(seg + ".corrupt")
+    (seg_rep,) = rep["segments"]
+    assert seg_rep["bad_crc"] == 1 and seg_rep["frames"] == 1
+    assert seg_rep["truncated_at"] == offs[1][0]
+    assert os.path.getsize(seg) == offs[1][0]
+    # restart #2: the truncated segment replays clean — same rows, no
+    # new damage counted, quarantine file untouched (create-once)
+    sz = os.path.getsize(seg + ".corrupt")
+    rep2 = {}
+    got2 = list(WAL(str(tmp_path)).replay(report=rep2))
+    assert got2 == [_batch(0)]
+    assert WAL_STATS["bad_crc_frames"] == c0 + 1
+    assert os.path.getsize(seg + ".corrupt") == sz
+
+
+def test_wal_salvage_scans_past_bad_frame(tmp_path, monkeypatch):
+    """OG_WAL_SALVAGE=1: the scan resumes at the next CRC-valid frame
+    — the two frames after the flipped one survive, counted as
+    salvaged, and the bad region still quarantines."""
+    monkeypatch.setenv("OG_WAL_SALVAGE", "1")
+    seg = _mk_wal(tmp_path, [_batch(i) for i in range(4)])
+    offs, _ = _frame_offsets(seg)
+    _flip_byte(seg, offs[1][0] + 8 + 2)
+    s0 = WAL_STATS["salvaged_frames"]
+    rep = {}
+    got = list(WAL(str(tmp_path)).replay(report=rep))
+    assert got == [_batch(0), _batch(2), _batch(3)]
+    assert WAL_STATS["salvaged_frames"] == s0 + 2
+    (seg_rep,) = rep["segments"]
+    assert seg_rep["salvaged"] == 2 and seg_rep["bad_crc"] == 1
+    assert os.path.exists(seg + ".corrupt")
+    # mid-file damage does not truncate (the tail is live data)
+    assert "truncated_at" not in seg_rep
+    # replay is deterministic on the damaged file: same result again
+    assert list(WAL(str(tmp_path)).replay()) == got
+
+
+def test_wal_torn_tail_counted_and_truncated(tmp_path):
+    """A frame torn at EOF (the pre-fsync crash shape) counts as torn,
+    quarantines and truncates to the valid prefix."""
+    seg = _mk_wal(tmp_path, [_batch(0), _batch(1)])
+    offs, data = _frame_offsets(seg)
+    with open(seg, "r+b") as f:             # tear the last frame
+        f.truncate(offs[1][0] + 10)
+    t0 = WAL_STATS["torn_frames"]
+    got = list(WAL(str(tmp_path)).replay())
+    assert got == [_batch(0)]
+    assert WAL_STATS["torn_frames"] == t0 + 1
+    assert os.path.getsize(seg) == offs[1][0]
+    assert list(WAL(str(tmp_path)).replay()) == [_batch(0)]
+
+
+def test_wal_decode_error_skips_one_frame_only(tmp_path):
+    """A frame whose boundary CRC is sound but whose payload fails to
+    decompress is skipped INDIVIDUALLY (boundary proven ⇒ later
+    frames are safe without any salvage scan) and counted."""
+    seg = _mk_wal(tmp_path, [_batch(0), _batch(1)])
+    offs, data = _frame_offsets(seg)
+    payload = struct.pack("<BI", 1, 64) + b"\x00not-zstd\x00" * 3
+    frame = struct.pack("<II", len(payload),
+                        zlib.crc32(payload)) + payload
+    patched = (data[:offs[1][0]] + frame + data[offs[1][0]:])
+    with open(seg, "wb") as f:
+        f.write(patched)
+    d0 = WAL_STATS["decode_error_frames"]
+    rep = {}
+    got = list(WAL(str(tmp_path)).replay(report=rep))
+    assert got == [_batch(0), _batch(1)]     # later frame SURVIVES
+    assert WAL_STATS["decode_error_frames"] == d0 + 1
+    assert rep["segments"][0]["decode_errors"] == 1
+    assert os.path.exists(seg + ".corrupt")
+
+
+def test_wal_quarantine_off_is_log_only(tmp_path, monkeypatch):
+    monkeypatch.setenv("OG_STORAGE_QUARANTINE", "0")
+    seg = _mk_wal(tmp_path, [_batch(0), _batch(1), _batch(2)])
+    offs, _ = _frame_offsets(seg)
+    _flip_byte(seg, offs[1][0] + 8 + 2)
+    size0 = os.path.getsize(seg)
+    got = list(WAL(str(tmp_path)).replay())
+    assert got == [_batch(0)]
+    assert not os.path.exists(seg + ".corrupt")
+    assert os.path.getsize(seg) == size0     # no truncation either
+
+
+# ------------------------------------- replay idempotency (satellite)
+
+def test_replay_idempotent_when_retired_segment_survives(tmp_path):
+    """The remove_upto crash window: a retired WAL segment whose rows
+    already reached TSSP files survives the crash. Double-replay of
+    the same frames must not duplicate rows or change values."""
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    rows = [PointRow("m", {"host": "a"}, {"v": float(i)}, i * 10**9)
+            for i in range(8)]
+    eng.write_points("db", rows)
+    sh = eng.database("db").all_shards()[0]
+    wal_dir = os.path.join(sh.path, "wal")
+    keep = {fn: open(os.path.join(wal_dir, fn), "rb").read()
+            for fn in os.listdir(wal_dir) if fn.endswith(".wal")}
+    sh.flush()                    # publishes TSSP, retires the segment
+    eng.close()
+    for fn, blob in keep.items():            # the segment "survives"
+        with open(os.path.join(wal_dir, fn), "wb") as f:
+            f.write(blob)
+    eng2 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    (res,) = eng2.scan_series("db", "m")
+    rec = res[2]
+    times = list(rec.times)
+    assert times == [i * 10**9 for i in range(8)]      # no duplicates
+    assert list(rec.column("v").values) == [float(i) for i in range(8)]
+    # and AGAIN (restart #2 replays the same segment over the same
+    # files): still exactly one row per time
+    eng2.close()
+    eng3 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    (res3,) = eng3.scan_series("db", "m")
+    assert list(res3[2].times) == times
+    eng3.close()
+
+
+# ------------------------------------------------- orphan sweep
+
+def test_orphan_tmp_swept_at_open(tmp_path):
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    eng.write_points("db", [PointRow("m", {}, {"v": 1.0}, 10**9)])
+    eng.flush_all()
+    sh = eng.database("db").all_shards()[0]
+    planted = [os.path.join(sh.path, "tssp", "m_000099.tssp.tmp"),
+               os.path.join(sh.path, "colstore", "x.ogcf.tmp"),
+               os.path.join(sh.path, "snapshot.tmp"),
+               os.path.join(str(tmp_path / "d"), "db",
+                            "colstore.json.tmp")]
+    eng.close()
+    for p in planted:
+        with open(p, "wb") as f:
+            f.write(b"torn crash leftovers")
+    o0 = WAL_STATS["orphans_removed"]
+    eng2 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    sh2 = eng2.database("db").all_shards()[0]
+    for p in planted:
+        assert not os.path.exists(p), f"orphan survived open: {p}"
+    assert WAL_STATS["orphans_removed"] >= o0 + 3   # shard-dir sweeps
+    assert sh2.recovery.get("orphans_removed", 0) >= 3
+    eng2.close()
+
+
+# ------------------------------- open-time verification + quarantine
+
+def _tssp_meta_off(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    tsize, magic = struct.unpack("<II", data[-8:])
+    tr = struct.unpack("<QQQQQQQqqQI", data[-8 - tsize:-8])
+    return tr[1]                               # meta_off
+
+
+def test_tssp_checksum_mismatch_quarantined_and_served_around(
+        tmp_path):
+    """A bit-flip in a TSSP file's metadata section is caught by the
+    v3 open-time checksum; the file quarantines to .corrupt and the
+    shard keeps serving its other files — restart never crash-loops
+    on one bad artifact."""
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    eng.write_points("db", [PointRow("m", {"host": "a"},
+                                     {"v": 1.5}, 10**9)])
+    eng.flush_all()
+    eng.write_points("db", [PointRow("m", {"host": "a"},
+                                     {"v": 2.5}, 2 * 10**9)])
+    eng.flush_all()
+    sh = eng.database("db").all_shards()[0]
+    tdir = os.path.join(sh.path, "tssp")
+    victim, survivor = sorted(
+        fn for fn in os.listdir(tdir) if fn.endswith(".tssp"))
+    eng.close()
+    vpath = os.path.join(tdir, victim)
+    _flip_byte(vpath, _tssp_meta_off(vpath) + 1)
+    q0 = WAL_STATS["quarantined_files"]
+    eng2 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    sh2 = eng2.database("db").all_shards()[0]
+    assert not os.path.exists(vpath)
+    assert os.path.exists(vpath + ".corrupt")
+    assert WAL_STATS["quarantined_files"] == q0 + 1
+    assert sh2.recovery.get("quarantined_files") == 1
+    # the survivor file still serves
+    (res,) = eng2.scan_series("db", "m")
+    assert list(res[2].times) == [2 * 10**9]
+    eng2.close()
+    # restart #2: quarantine converged, nothing new to re-trip
+    eng3 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    assert WAL_STATS["quarantined_files"] == q0 + 1
+    eng3.close()
+
+
+def test_colstore_corrupt_footer_quarantined(tmp_path):
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    eng.create_columnstore("db", "cs", primary_key=["host"])
+    eng.write_points("db", [PointRow("cs", {"host": "a"},
+                                     {"v": 1.5}, 10**9)])
+    eng.flush_all()
+    sh = eng.database("db").all_shards()[0]
+    cdir = os.path.join(sh.path, "colstore")
+    (fn,) = [f for f in os.listdir(cdir) if f.endswith(".ogcf")]
+    eng.close()
+    _flip_byte(os.path.join(cdir, fn), os.path.getsize(
+        os.path.join(cdir, fn)) - 12)          # inside the footer json
+    eng2 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    assert os.path.exists(os.path.join(cdir, fn + ".corrupt"))
+    assert not os.path.exists(os.path.join(cdir, fn))
+    eng2.close()
+
+
+# ------------------------------------------------ report surfaces
+
+def test_recovery_summary_shape_and_debug_vars(tmp_path):
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    eng.write_points("db", [PointRow("m", {}, {"v": 1.0}, 10**9)])
+    eng.close()
+    eng2 = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    summ = recovery_summary()
+    for k in ("replayed_frames", "torn_frames", "bad_crc_frames",
+              "salvaged_frames", "quarantined_files",
+              "quarantined_bytes", "recovery_ms", "shards"):
+        assert k in summ, f"recovery summary lost {k!r}"
+    shard_reports = [r for r in summ["shards"]
+                     if r["path"].startswith(str(tmp_path))]
+    assert shard_reports and shard_reports[-1]["rows_replayed"] == 1
+    # /metrics: the recovery counters ride the wal collector group
+    from opengemini_tpu.http import HttpServer
+    srv = HttpServer(eng2, port=0)
+    text = srv.metrics_text()
+    for m in ("wal_torn_frames", "wal_salvaged_frames",
+              "wal_quarantined_files", "wal_recovery_ms"):
+        assert m in text, f"/metrics lost {m}"
+    eng2.close()
+
+
+def test_wal_switch_error_action_does_not_wedge(tmp_path):
+    """The wal.switch.crash site sits BEFORE the sealed segment's
+    close: the admin plane can arm any site with a non-crash action
+    (error needs no OG_CRASH_OK), and raising after the close would
+    leave the WAL's file handle unusable for every later write."""
+    eng = Engine(str(tmp_path / "d"), EngineOptions(**OPTS))
+    eng.write_points("db", [PointRow("m", {}, {"v": 1.0}, 10**9)])
+    failpoint.enable("wal.switch.crash", "error", maxhits=1)
+    with pytest.raises(Exception):
+        eng.flush_all()
+    # the WAL still accepts writes and a clean flush afterwards
+    eng.write_points("db", [PointRow("m", {}, {"v": 2.0}, 2 * 10**9)])
+    eng.flush_all()
+    (res,) = eng.scan_series("db", "m")
+    assert list(res[2].times) == [10**9, 2 * 10**9]
+    eng.close()
+
+
+def test_crash_action_requires_explicit_optin(monkeypatch):
+    """The SIGKILL action must be impossible to arm by accident — a
+    leaked crash schedule must never take down a pytest runner."""
+    monkeypatch.delenv("OG_CRASH_OK", raising=False)
+    with pytest.raises(ValueError, match="OG_CRASH_OK"):
+        failpoint.enable("wal.append.crash_pre_sync", "crash")
+    monkeypatch.setenv("OG_CRASH_OK", "1")
+    failpoint.enable("wal.append.crash_pre_sync", "crash", skip=10**9)
+    assert failpoint.list_points()[
+        "wal.append.crash_pre_sync"]["action"] == "crash"
+    # clean up eagerly: the conftest hygiene guard treats BOTH a
+    # leaked crash-armed point and a leaked OG_CRASH_OK as failures
+    failpoint.disable_all()
+    monkeypatch.delenv("OG_CRASH_OK")

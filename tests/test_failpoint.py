@@ -92,6 +92,25 @@ def test_pct_maxhits_compose():
         == [True, True, False, False]
 
 
+def test_skip_window_defers_firing():
+    """skip=K lets the first K passes through unfired (crash
+    schedules land the kill on a LATER append/flush); maxhits counts
+    only post-skip fires."""
+    failpoint.enable("deferred", "drop", skip=2)
+    assert [failpoint.inject("deferred") for _ in range(4)] \
+        == [False, False, True, True]
+    failpoint.disable("deferred")
+    # skip + maxhits: 1 skip, then exactly 2 fires, then auto-disarm
+    failpoint.enable("window", "drop", skip=1, maxhits=2)
+    assert [failpoint.inject("window") for _ in range(5)] \
+        == [False, True, True, False, False]
+    assert "window" not in failpoint.list_points()
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "drop", skip=-1)
+    with pytest.raises(ValueError):
+        failpoint.enable("bad", "drop", skip="x")
+
+
 def test_wal_write_failpoint(tmp_path):
     eng = Engine(str(tmp_path / "d"))
     eng.write_points("db0", parse_lines("m v=1 1000"))

@@ -107,6 +107,29 @@ def test_r6_histograms_good_fixture():
     assert not {c for c in got if c.startswith("R6")}, got
 
 
+def test_r8_durability_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/storage/r8_bad.py"])
+    r8 = [v for v in vs if v.code == "R801"]
+    # both the replace-publish and the rename are reported
+    assert len(r8) == 2, vs
+
+
+def test_r8_durability_good_fixture():
+    got = codes_for("opengemini_tpu/storage/r8_good.py")
+    assert not {c for c in got if c.startswith("R8")}, got
+
+
+def test_r8_scope_is_storage_only(tmp_path):
+    """A bare os.replace OUTSIDE storage/ is not R8's business."""
+    from opengemini_tpu.lint import run_lint as rl
+    d = tmp_path / "opengemini_tpu" / "services"
+    d.mkdir(parents=True)
+    (d / "x.py").write_text("import os\n"
+                            "def f(p):\n"
+                            "    os.replace(p + '.tmp', p)\n")
+    assert not [v for v in rl(str(tmp_path)) if v.code == "R801"]
+
+
 # ------------------------------------------------------- machinery
 
 def test_r7_fault_bad_fixture():
