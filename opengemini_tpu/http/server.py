@@ -187,6 +187,10 @@ class HttpServer:
             sp.register("hbm", hbm_collector)
             from ..utils.stats import devicefault_collector
             sp.register("devicefault", devicefault_collector)
+            from ..utils.stats import (compileaudit_collector,
+                                       xfer_collector)
+            sp.register("compileaudit", compileaudit_collector)
+            sp.register("xfer", xfer_collector)
             from ..utils.stats import latency_collector
             sp.register("latency", latency_collector)
             sp.register("wal", wal_collector)
@@ -910,6 +914,7 @@ class HttpServer:
         exemplars on the histogram buckets and the mandatory ``# EOF``
         terminator — slow buckets link straight to /debug/trace?id=."""
         from ..utils.stats import (compaction_collector,
+                                   compileaudit_collector,
                                    device_collector,
                                    devicecache_collector,
                                    devicefault_collector,
@@ -918,7 +923,8 @@ class HttpServer:
                                    readcache_collector,
                                    rpc_collector, runtime_collector,
                                    scheduler_collector,
-                                   subscriber_collector, wal_collector)
+                                   subscriber_collector, wal_collector,
+                                   xfer_collector)
         from ..ops.devstats import phase_collector
         groups = {"runtime": runtime_collector(),
                   "readcache": readcache_collector(),
@@ -929,6 +935,8 @@ class HttpServer:
                   "scheduler": scheduler_collector(),
                   "hbm": hbm_collector(),
                   "devicefault": devicefault_collector(),
+                  "compileaudit": compileaudit_collector(),
+                  "xfer": xfer_collector(),
                   "wal": wal_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
@@ -1609,6 +1617,14 @@ class _Handler(BaseHTTPRequestHandler):
             out["scheduler"] = scheduler_collector()
             out["hbm"] = hbm_collector()
             out["devicefault"] = devicefault_collector()
+            # compile-cache + transfer audit layer (ops/compileaudit):
+            # per-kernel compile log with shape signatures, the jaxpr
+            # audits, and the per-site transfer manifest with its
+            # ledger cross-check counters
+            from ..ops.compileaudit import (audit_snapshot,
+                                            manifest_snapshot)
+            out["compileaudit"] = audit_snapshot()
+            out["xfer"] = manifest_snapshot()
             out["wal"] = wal_collector()
             # startup recovery report: cumulative replay/salvage/
             # quarantine counters plus the recent per-shard reports

@@ -1,7 +1,8 @@
 """oglint — repo-specific AST invariant linter (tier-1 gate).
 
-Eight rule classes enforce the conventions the device hot path's
-correctness rests on (see each rule module for the full contract):
+Ten rule classes enforce the conventions the device hot path's
+correctness AND performance rest on (see each rule module for the
+full contract):
 
 - R1 transfer discipline (``transfer_rule``): D2H pulls in hot-path
   modules ride ``ops.pipeline.device_get_parallel`` or an explicitly
@@ -33,6 +34,17 @@ correctness rests on (see each rule module for the full contract):
   ``utils.fileops.durable_replace`` (file fsync → rename → parent-dir
   fsync) — a bare rename can roll back after a crash, silently
   unpublishing a TSSP file, manifest or marker.
+- R9 jit-boundary hygiene (``jit_rule``): trace-reachable code must
+  not host-sync traced values (``.item()``, ``float()``, implicit
+  bool, ``np.asarray``), jit roots must declare shape-deriving Python
+  args static (a non-static one re-compiles per value), and the f32
+  fast paths must not silently promote to emulated f64. Shares R5's
+  reachability walker (``jitwalk``); the runtime half is the compile
+  auditor (ops/compileaudit.py).
+- R10 launch hygiene (``launch_rule``): ``jax.device_put`` / eager
+  ``jnp.asarray`` uploads in the hot path must book their bytes
+  (``compileaudit.record_h2d`` / ``h2d_bytes``) — the H2D twin of R1,
+  cross-checked at runtime by the transfer-manifest audit gate.
 
 Run: ``python scripts/oglint.py`` (or ``python -m opengemini_tpu.lint``).
 Suppressions: a trailing ``# oglint: disable=R103`` comment disables

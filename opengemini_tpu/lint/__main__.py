@@ -1,7 +1,7 @@
 """oglint CLI: ``python -m opengemini_tpu.lint`` / scripts/oglint.py.
 
 Modes:
-- default: run all six rule classes over the repo, print violations,
+- default: run all ten rule classes over the repo, print violations,
   exit 1 if any (the tier-1/CI gate).
 - ``--rules R1,R4``: restrict to named rule classes.
 - ``--knob-table``: print the generated README knob table and exit.
@@ -30,7 +30,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="files/dirs to lint (default: whole repo)")
     ap.add_argument("--root", default=_repo_root())
     ap.add_argument("--rules", default="",
-                    help="comma-separated rule ids (R1..R6)")
+                    help="comma-separated rule ids (R1..R10)")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the generated README knob table")
     ap.add_argument("--fix-readme", action="store_true",

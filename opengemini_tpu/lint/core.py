@@ -135,18 +135,20 @@ def default_rules() -> list[Rule]:
     from .deadline_rule import DeadlineRule
     from .durability_rule import DurabilityRule
     from .fault_rule import FaultRule
+    from .jit_rule import JitRule
     from .knob_rule import KnobRule
+    from .launch_rule import LaunchRule
     from .lockrank_rule import LockRankRule
     from .trace_rule import TraceRule
     from .transfer_rule import TransferRule
     return [TransferRule(), KnobRule(), DeadlineRule(),
             LockRankRule(), TraceRule(), CounterRule(),
-            FaultRule(), DurabilityRule()]
+            FaultRule(), DurabilityRule(), JitRule(), LaunchRule()]
 
 
 def run_lint(root: str, rules: list[Rule] | None = None,
              paths: list[str] | None = None) -> list[Violation]:
-    """Run ``rules`` (default: all seven classes) over the repo at
+    """Run ``rules`` (default: all ten classes) over the repo at
     ``root``; returns sorted, pragma-filtered violations."""
     rules = rules if rules is not None else default_rules()
     ctxs = []

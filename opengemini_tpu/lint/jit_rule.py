@@ -1,0 +1,277 @@
+"""R9 — jit-boundary hygiene: silent recompiles, host syncs and dtype
+promotion inside trace-reachable code.
+
+R5 keeps *host state* out of traced code; R9 polices the three
+performance hazards that survive R5 — each one erases a device win
+without changing a single result bit:
+
+1. **Silent recompile** (R902): a jit root that uses a plain Python
+   parameter in a SHAPE position (``range(n)``, ``jnp.zeros(n)``,
+   ``x.reshape(n)``, ``jnp.arange(n)``…) without declaring it in
+   ``static_argnums``/``static_argnames``. jax hashes traced-array
+   *shapes* but Python scalars by *value* only when static — a
+   non-static shape-deriving arg re-traces and re-compiles the kernel
+   per distinct value (the window-count-per-batch retrace class the
+   runtime compile auditor, ops/compileaudit.py, catches dynamically).
+2. **Host sync** (R901): ``.item()`` / ``.tolist()``, ``float()`` /
+   ``int()`` / ``bool()`` over a traced parameter, ``np.asarray`` /
+   ``np.array`` over a traced parameter, or an implicit bool (``if
+   param:`` / ``while param:``) — each forces the device to drain and
+   the value to cross D2H mid-trace (or throws ConcretizationError at
+   the worst time). Static parameters are exempt: they are Python
+   values at trace time by declaration.
+3. **Silent dtype promotion** (R903): in the f32-capable paths (the
+   Pallas fast tier, ops/pallas_agg.py, and any function whose name
+   carries ``f32``), a dtype-less ``jnp.array``/``jnp.asarray``/
+   ``np.array`` literal or an explicit float64 (``jnp.float64``,
+   ``astype(float64)``, ``dtype=np.float64``) silently promotes the
+   whole kernel to emulated f64 — the session runs jax_enable_x64, so
+   a bare array literal is STRONG f64 and poisons every downstream op
+   (weak Python scalars are safe; materialized arrays are not).
+
+Scope: everything under ``opengemini_tpu/`` that mentions jax, same
+as R5 — the two rules share the reachability walker
+(``lint/jitwalk.py``). Suppress a reviewed site with
+``# oglint: disable=R90x``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+from .jitwalk import TracedFn, traced_functions
+
+_SCOPE = ("opengemini_tpu/",)
+
+# shape-position callables → positional args that ARE shapes (None =
+# every positional arg): a non-static Python param flowing in here
+# re-traces per value
+_SHAPE_FNS = {"range": None, "jnp.arange": None,
+              "jnp.zeros": (0,), "jnp.ones": (0,), "jnp.full": (0,),
+              "jnp.empty": (0,), "jnp.eye": (0, 1),
+              "jnp.linspace": (2,), "jnp.broadcast_to": (1,),
+              "jax.ShapeDtypeStruct": (0,)}
+_SHAPE_METHODS = {"reshape", "broadcast_to"}
+
+_SYNC_CASTS = {"float", "int", "bool", "complex"}
+_HOST_PULLERS = {"np.asarray", "np.array", "numpy.asarray",
+                 "numpy.array"}
+
+# f64-promoting constructs banned in f32-scoped traced code
+_F64_NAMES = {"jnp.float64", "np.float64", "numpy.float64"}
+_ARRAY_CTORS = {"jnp.array", "jnp.asarray", "np.array", "np.asarray",
+                "numpy.array", "numpy.asarray"}
+
+
+def _param_names(fn: ast.FunctionDef) -> set:
+    a = fn.args
+    out = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    return out
+
+
+# array metadata that is STATIC under trace: float(x.shape[0]) is a
+# Python int at trace time, not a host sync
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize",
+                 "weak_type"}
+
+
+def _traced_names(node: ast.AST) -> set:
+    """Names reachable in an expression without crossing a STATIC
+    metadata attribute: ``x.sum()`` yields x (traced), ``x.shape[0]``
+    yields nothing (static under trace)."""
+    out: set = set()
+
+    def walk(n):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    walk(node)
+    return out
+
+
+def _is_f32_scope(ctx: FileCtx, tf: TracedFn) -> bool:
+    return ("pallas_agg" in ctx.path or "f32" in tf.fn.name
+            or tf.pallas)
+
+
+class JitRule(Rule):
+    rule_id = "R9"
+    codes = {
+        "R901": "host sync of a traced value inside jit-traced code",
+        "R902": "shape-deriving Python arg without static_argnums",
+        "R903": "f64 literal / dtype promotion in an f32 traced path",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not ctx.path.startswith(_SCOPE):
+            return []
+        if "jax" not in ctx.source:
+            return []
+        traced = traced_functions(ctx.tree)
+        out: list[Violation] = []
+        for tf in traced.values():
+            # traced params: everything not declared static. Closure
+            # helpers keep the conservative view (all params traced) —
+            # they receive traced operands from their root callers.
+            params = _param_names(tf.fn) - tf.static
+            out.extend(self._check_sync(ctx, tf, params))
+            if tf.root and not tf.pallas:
+                out.extend(self._check_static(ctx, tf, params))
+            if _is_f32_scope(ctx, tf):
+                out.extend(self._check_f64(ctx, tf))
+        # de-dup per (line, code)
+        seen, uniq = set(), []
+        for v in sorted(out):
+            if (v.line, v.code) not in seen:
+                seen.add((v.line, v.code))
+                uniq.append(v)
+        return uniq
+
+    # ------------------------------------------------- R901 host sync
+
+    def _check_sync(self, ctx, tf: TracedFn, params: set) -> list:
+        out = []
+        fn = tf.fn
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("item", "tolist") \
+                        and _traced_names(node.func.value) & params:
+                    out.append(self._v(
+                        ctx, node, "R901",
+                        f".{node.func.attr}() on a traced value in "
+                        f"{fn.name}() drains the device mid-trace — "
+                        "return the array and convert on host"))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in _SYNC_CASTS and node.args \
+                        and _traced_names(node.args[0]) & params:
+                    out.append(self._v(
+                        ctx, node, "R901",
+                        f"{node.func.id}() over a traced value in "
+                        f"{fn.name}() host-syncs (or throws "
+                        "ConcretizationError) — keep it an array, or "
+                        "declare the arg static"))
+                elif d in _HOST_PULLERS and node.args \
+                        and _traced_names(node.args[0]) & params:
+                    out.append(self._v(
+                        ctx, node, "R901",
+                        f"{d}() over a traced value in {fn.name}() is "
+                        "an implicit D2H sync inside the trace — use "
+                        "jnp, or pull after the jit boundary"))
+            elif isinstance(node, (ast.If, ast.While)):
+                t = node.test
+                # bare `if param:` / `if param[i]:` / `if not param:`
+                # — implicit bool of a traced value. Attribute chains
+                # (x.ndim, x.shape) are static under trace and exempt.
+                if isinstance(t, ast.UnaryOp) \
+                        and isinstance(t.op, ast.Not):
+                    t = t.operand
+                if (isinstance(t, ast.Name) and t.id in params) or \
+                        (isinstance(t, ast.Subscript)
+                         and isinstance(t.value, ast.Name)
+                         and t.value.id in params):
+                    out.append(self._v(
+                        ctx, node, "R901",
+                        f"implicit bool of traced value in "
+                        f"{fn.name}() — use jnp.where/lax.cond, or "
+                        "declare the arg static"))
+        return out
+
+    # --------------------------------------------- R902 static hygiene
+
+    def _check_static(self, ctx, tf: TracedFn, params: set) -> list:
+        out = []
+        fn = tf.fn
+        flagged: set = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            names: set = set()
+            if d in _SHAPE_FNS:
+                idxs = _SHAPE_FNS[d]
+                for i, a in enumerate(node.args):
+                    if idxs is not None and i not in idxs:
+                        continue
+                    names |= {n for n in _direct_names(a)
+                              if n in params}
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SHAPE_METHODS:
+                for a in node.args:
+                    names |= {n for n in _direct_names(a)
+                              if n in params}
+            for nm in names - flagged:
+                flagged.add(nm)
+                out.append(self._v(
+                    ctx, node, "R902",
+                    f"param {nm!r} of jit root {fn.name}() derives a "
+                    f"shape in {d or node.func.attr}() but is not in "
+                    "static_argnums/static_argnames — every distinct "
+                    "value re-traces AND re-compiles the kernel"))
+        return out
+
+    # ----------------------------------------------- R903 f64 in f32
+
+    def _check_f64(self, ctx, tf: TracedFn) -> list:
+        out = []
+        fn = tf.fn
+        for node in ast.walk(fn):
+            d = dotted(node)
+            if d in _F64_NAMES:
+                out.append(self._v(
+                    ctx, node, "R903",
+                    f"float64 in f32 traced path {fn.name}() — the "
+                    "fast tier pays emulated-f64 throughput for every "
+                    "op downstream of this value"))
+            elif isinstance(node, ast.Call):
+                cd = dotted(node.func)
+                if cd in _ARRAY_CTORS \
+                        and not any(kw.arg == "dtype"
+                                    for kw in node.keywords):
+                    out.append(self._v(
+                        ctx, node, "R903",
+                        f"dtype-less {cd}() in f32 traced path "
+                        f"{fn.name}() materializes STRONG f64 under "
+                        "jax_enable_x64 and promotes the kernel — "
+                        "pass dtype=jnp.float32"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args \
+                        and dotted(node.args[0]) in _F64_NAMES:
+                    out.append(self._v(
+                        ctx, node, "R903",
+                        f"astype(float64) in f32 traced path "
+                        f"{fn.name}()"))
+        return out
+
+    @staticmethod
+    def _v(ctx, node, code, msg) -> Violation:
+        return Violation(ctx.path, node.lineno, code,
+                         msg + " (see lint/jit_rule.py)")
+
+
+def _direct_names(node: ast.AST) -> set:
+    """Names reachable in an expression WITHOUT crossing an attribute
+    access: ``n``, ``n + 1``, ``(a, b)`` yield names; ``x.shape[0]``
+    yields nothing (shapes are static under trace)."""
+    out: set = set()
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    elif isinstance(node, (ast.BinOp,)):
+        out |= _direct_names(node.left) | _direct_names(node.right)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            out |= _direct_names(e)
+    elif isinstance(node, ast.UnaryOp):
+        out |= _direct_names(node.operand)
+    return out

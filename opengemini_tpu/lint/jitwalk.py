@@ -1,0 +1,172 @@
+"""Shared jax.jit reachability walker for the trace-time rule family.
+
+R5 (trace purity) and R9 (jit-boundary hygiene) police the same code
+region: every function whose body executes at TRACE time. Both rules
+need the same discovery — which functions are jit roots, what the
+one-module transitive closure of trace-reachable helpers is, and which
+parameters a root declared static — so the walker lives here once
+instead of drifting apart in two rule modules.
+
+Roots recognized:
+- ``@jax.jit`` / ``@jit`` decorated functions;
+- ``@functools.partial(jax.jit, static_argnums=... /
+  static_argnames=...)`` decorated functions;
+- functions passed by name to an inline ``jax.jit(f, ...)`` /
+  ``jax.jit(partial(f, ...))`` call;
+- Pallas kernels passed to ``pl.pallas_call(kernel, ...)`` — the
+  kernel body is traced exactly like jit code (ops/pallas_agg.py is
+  the f32 fast tier this matters for).
+
+Closure: every function lexically reachable from a root by same-module
+call-by-name (cross-module helpers are ops-layer jnp code in
+practice — the historical R5 contract, unchanged).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import dotted
+
+# _named_jit is ops/blockagg.py's attribution-preserving jit wrapper
+# (renames the kernel for the compile auditor, then jax.jit's it) —
+# functions passed to it are roots exactly like jax.jit(f)
+_JIT_NAMES = ("jax.jit", "jit", "_named_jit")
+_PALLAS_CALL = ("pl.pallas_call", "pallas.pallas_call", "pallas_call",
+                "jax.experimental.pallas.pallas_call")
+
+
+@dataclass
+class TracedFn:
+    """One trace-time function: the AST node, whether it is itself a
+    jit/pallas root, and the parameter names the root declared static
+    (trace-time Python values, exempt from traced-value rules)."""
+    fn: ast.FunctionDef
+    root: bool = False
+    pallas: bool = False
+    static: set = field(default_factory=set)
+
+
+def is_jit_deco(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fd = dotted(dec.func)
+        if fd in _JIT_NAMES:
+            return True
+        if fd in ("functools.partial", "partial") and dec.args:
+            return dotted(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _static_params(fn: ast.FunctionDef, call: ast.Call | None) -> set:
+    """Parameter names declared static on a jit root: static_argnames
+    (string/tuple-of-strings) and static_argnums (ints mapped onto the
+    positional parameter list)."""
+    out: set = set()
+    if call is None:
+        return out
+    params = [a.arg for a in fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in _const_strs(kw.value):
+                out.add(n)
+        elif kw.arg == "static_argnums":
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    out.add(params[i])
+    return out
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _jit_call_of(fn: ast.FunctionDef) -> ast.Call | None:
+    """The decorator Call carrying static_arg* for a decorated root
+    (``functools.partial(jax.jit, ...)`` or ``jax.jit(...)``)."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and is_jit_deco(dec):
+            return dec
+    return None
+
+
+def traced_functions(tree: ast.AST) -> dict[str, TracedFn]:
+    """name → TracedFn for every function in ``tree`` that executes at
+    trace time: jit/pallas roots plus the one-module transitive closure
+    of functions a traced body calls by name."""
+    by_name: dict[str, ast.FunctionDef] = {}
+    roots: list[TracedFn] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+            if any(is_jit_deco(d) for d in node.decorator_list):
+                roots.append(TracedFn(
+                    node, root=True,
+                    static=_static_params(node, _jit_call_of(node))))
+    # inline jax.jit(f, ...) / jax.jit(partial(f, ...)) and
+    # pl.pallas_call(kernel, ...) roots
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fd = dotted(node.func)
+        if fd in _JIT_NAMES:
+            a = node.args[0]
+            if isinstance(a, ast.Call):          # partial(f, ...)
+                a = a.args[0] if a.args else a
+            nm = dotted(a)
+            if nm in by_name:
+                roots.append(TracedFn(
+                    by_name[nm], root=True,
+                    static=_static_params(by_name[nm], node)))
+        elif fd in _PALLAS_CALL:
+            nm = dotted(node.args[0])
+            if nm in by_name:
+                roots.append(TracedFn(by_name[nm], root=True,
+                                      pallas=True))
+    if not roots:
+        return {}
+    traced: dict[str, TracedFn] = {}
+    work = list(roots)
+    while work:
+        tf = work.pop()
+        got = traced.get(tf.fn.name)
+        if got is not None:
+            # a helper later discovered to be a root keeps root status
+            got.root = got.root or tf.root
+            got.pallas = got.pallas or tf.pallas
+            got.static |= tf.static
+            continue
+        traced[tf.fn.name] = tf
+        for sub in ast.walk(tf.fn):
+            if isinstance(sub, ast.Call):
+                nm = dotted(sub.func)
+                if nm in by_name and nm not in traced:
+                    work.append(TracedFn(by_name[nm]))
+    return traced
+
+
+def module_assign_names(tree: ast.AST) -> set:
+    """Names bound by module-level assignments (shared mutable state a
+    traced body must not write)."""
+    return {t.id for n in tree.body
+            if isinstance(n, ast.Assign)
+            for t in n.targets if isinstance(t, ast.Name)}

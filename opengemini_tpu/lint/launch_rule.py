@@ -1,0 +1,139 @@
+"""R10 — launch hygiene: every H2D upload books its bytes.
+
+The H2D twin of R1's transfer discipline. R1 keeps D2H pulls on the
+accounted transport; R10 keeps *uploads* accountable: a bare
+``jax.device_put`` / eager ``jnp.asarray`` in the hot path silently
+moves bytes the ``h2d_bytes`` counter (and the per-site transfer
+manifest, ops/compileaudit.py) never sees — and the transfer-manifest
+audit gate cross-checks those counters against the HBM ledger, so an
+unbooked upload is not just dark telemetry, it FAILS the runtime gate.
+This rule catches the site statically, before a bench run has to.
+
+Contract: a function in scope that uploads
+(``jax.device_put(...)``, eager ``jnp.asarray``/``jnp.array`` over
+host data) must, in the same function body, book the bytes —
+``compileaudit.record_h2d(site, nbytes)`` (the manifest funnel,
+preferred), a ``bump("h2d_bytes"|"slab_bytes", ...)`` call, or an HBM
+ledger ``account(...)`` — or carry a reviewed
+``# oglint: disable=R1001`` pragma next to wherever the booking
+actually happens.
+
+Traced functions are exempt (``jnp.asarray`` inside jit code is a
+trace op, not a transfer — lint/jitwalk.py decides reachability), as
+are the accounted transports themselves (ops/pipeline.py,
+ops/devstats.py, ops/compileaudit.py).
+
+Scope: ``opengemini_tpu/ops/*`` + ``query/executor.py`` — the same
+hot-path surface as R1.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Rule, Violation, dotted
+from .jitwalk import traced_functions
+
+_HOT_DIRS = ("opengemini_tpu/ops/",)
+_HOT_FILES = ("opengemini_tpu/query/executor.py",)
+_EXEMPT = ("opengemini_tpu/ops/pipeline.py",
+           "opengemini_tpu/ops/devstats.py",
+           "opengemini_tpu/ops/compileaudit.py")
+
+_UPLOADERS = {"jax.device_put", "jnp.asarray", "jnp.array"}
+_BOOK_KEYS = {"h2d_bytes", "slab_bytes"}
+# the manifest funnel only — an HBM-ledger `account()` books
+# RESIDENCY, not transfer, and must not satisfy this rule
+_BOOK_FNS = {"record_h2d"}
+
+
+def _in_scope(path: str) -> bool:
+    if path in _EXEMPT:
+        return False
+    return path in _HOT_FILES or any(path.startswith(d)
+                                     for d in _HOT_DIRS)
+
+
+def _books(fn: ast.AST) -> bool:
+    """Does this function body contain an H2D booking call?"""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        base = d.split(".")[-1] if d else ""
+        if base in _BOOK_FNS:
+            return True
+        if base in ("bump", "_b", "_bump") and node.args:
+            for a in node.args:
+                if isinstance(a, ast.Constant) and a.value in _BOOK_KEYS:
+                    return True
+    return False
+
+
+class LaunchRule(Rule):
+    rule_id = "R10"
+    codes = {
+        "R1001": "unbooked H2D upload (device_put/jnp.asarray without "
+                 "h2d byte accounting)",
+    }
+
+    def check(self, ctx: FileCtx) -> list[Violation]:
+        if not _in_scope(ctx.path):
+            return []
+        traced = set(traced_functions(ctx.tree))
+        # map every node to its enclosing function (innermost)
+        out = []
+        for fn in self._functions(ctx.tree):
+            if fn is not None and fn.name in traced:
+                continue
+            body = fn if fn is not None else ctx.tree
+            for node in self._own_nodes(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func) not in _UPLOADERS:
+                    continue
+                if fn is not None and _books(fn):
+                    continue
+                where = f"{fn.name}()" if fn is not None \
+                    else "module scope"
+                out.append(Violation(
+                    ctx.path, node.lineno, "R1001",
+                    f"{dotted(node.func)} in {where} uploads without "
+                    "booking: call compileaudit.record_h2d(site, "
+                    "nbytes) (or bump h2d_bytes) in the same function "
+                    "so the transfer manifest and h2d counters stay "
+                    "truthful — the runtime audit gate cross-checks "
+                    "them against the HBM ledger"))
+        return out
+
+    @staticmethod
+    def _functions(tree: ast.AST):
+        """Every FunctionDef plus None for module scope."""
+        yield None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _own_nodes(body: ast.AST):
+        """Nodes belonging to ``body`` but not to a nested function
+        (those are visited as their own scope)."""
+        skip_roots = []
+        for node in ast.walk(body):
+            if node is body:
+                continue
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                skip_roots.append(node)
+        skipped = set()
+        for r in skip_roots:
+            for n in ast.walk(r):
+                if n is not r:
+                    skipped.add(id(n))
+        for node in ast.walk(body):
+            if node is body or isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(node) not in skipped:
+                yield node

@@ -9,10 +9,11 @@ near-impossible to reason about. The "Control Flow Duplication for
 Columnar Arrays" reference (PAPERS.md) makes the same demand of
 columnar kernels: host-side control flow stays OUT of the kernel.
 
-Detection: jit roots are functions decorated ``@jax.jit`` /
-``@functools.partial(jax.jit, ...)`` or passed to a ``jax.jit(...)``
-call by name; the rule then walks every function lexically defined
-inside a root plus same-module functions a root calls by name
+Detection rides the shared reachability walker (``lint/jitwalk.py``,
+also used by R9): jit roots are functions decorated ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)``, passed to a ``jax.jit(...)``
+call by name, or Pallas kernels passed to ``pl.pallas_call(...)``;
+the rule then walks same-module functions a root calls by name
 (one-module transitive closure — cross-module helpers are ops-layer
 jnp code in practice).
 
@@ -28,6 +29,7 @@ from __future__ import annotations
 import ast
 
 from .core import FileCtx, Rule, Violation, dotted
+from .jitwalk import module_assign_names, traced_functions
 
 _SCOPE = ("opengemini_tpu/",)
 
@@ -36,19 +38,6 @@ _BANNED_PREFIXES = ("os.environ", "os.getenv", "knobs.", "_knobs.",
                     "numpy.random.", "time.")
 _BANNED_ATTRS = {"acquire", "release"}
 _BANNED_NAMES = {"open", "print", "input"}
-
-
-def _is_jit_deco(dec: ast.AST) -> bool:
-    d = dotted(dec)
-    if d in ("jax.jit", "jit"):
-        return True
-    if isinstance(dec, ast.Call):
-        fd = dotted(dec.func)
-        if fd in ("jax.jit", "jit"):
-            return True
-        if fd in ("functools.partial", "partial") and dec.args:
-            return dotted(dec.args[0]) in ("jax.jit", "jit")
-    return False
 
 
 class TraceRule(Rule):
@@ -60,45 +49,13 @@ class TraceRule(Rule):
             return []
         if "jax" not in ctx.source:
             return []
-        roots: list[ast.FunctionDef] = []
-        by_name: dict[str, ast.FunctionDef] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef):
-                by_name.setdefault(node.name, node)
-                if any(_is_jit_deco(d) for d in node.decorator_list):
-                    roots.append(node)
-        # inline jax.jit(f) / jax.jit(partial(f, ...)) roots
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Call) \
-                    and dotted(node.func) in ("jax.jit", "jit") \
-                    and node.args:
-                a = node.args[0]
-                if isinstance(a, ast.Call):     # partial(f, ...)
-                    a = a.args[0] if a.args else a
-                nm = dotted(a)
-                if nm in by_name:
-                    roots.append(by_name[nm])
-        if not roots:
+        traced = traced_functions(ctx.tree)
+        if not traced:
             return []
-        # one-module transitive closure over called local functions
-        traced: dict[str, ast.FunctionDef] = {}
-        work = list(roots)
-        while work:
-            fn = work.pop()
-            if fn.name in traced:
-                continue
-            traced[fn.name] = fn
-            for sub in ast.walk(fn):
-                if isinstance(sub, ast.Call):
-                    nm = dotted(sub.func)
-                    if nm in by_name and nm not in traced:
-                        work.append(by_name[nm])
-        module_names = {t.id for n in ctx.tree.body
-                        if isinstance(n, ast.Assign)
-                        for t in n.targets if isinstance(t, ast.Name)}
+        module_names = module_assign_names(ctx.tree)
         out = []
-        for fn in traced.values():
-            out.extend(self._check_fn(ctx, fn, module_names))
+        for tf in traced.values():
+            out.extend(self._check_fn(ctx, tf.fn, module_names))
         return out
 
     def _check_fn(self, ctx, fn, module_names) -> list[Violation]:

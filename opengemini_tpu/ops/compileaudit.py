@@ -1,0 +1,466 @@
+"""Runtime compile-cache + transfer audit layer (oglint R9/R10's
+dynamic half).
+
+Static rules catch the *patterns* that cause silent recompiles and
+unaccounted transfers; this module catches the *events* — so a hazard
+the AST can't see (a shape class that churns per batch, a cache
+dropped by a stray re-wrap, a transfer path that dodges the counters)
+still fails a gate instead of quietly eating the device win.
+
+**Compile auditor** (``CompileAuditor`` / module ``AUDITOR``): jax
+logs every XLA compile ("Compiling <name> with global shapes and
+types [...]") and every retrace through its module loggers at DEBUG —
+install() raises those loggers to DEBUG and attaches a parsing
+handler, so the auditor sees each (kernel, shape-signature) compile
+with zero hot-path cost (compiles are rare by definition; steady
+state emits nothing). Per kernel it keeps compile counts and the
+distinct shape signatures; a compile of a (kernel, signature) pair
+seen before is a ``duplicate_compile`` — the smoking gun for a jit
+cache being dropped or re-wrapped per call, and its budget is ZERO.
+``mark()``/``since()`` bound audit windows: the perf_smoke gate runs
+every bench shape cold (compiles ≤ the declared budget,
+``utils.knobs.RECOMPILE_BUDGETS``) then warm (ZERO new compiles — a
+warm-loop recompile is exactly the hazard class that erased the
+BENCH r05 1m win).
+
+**Transfer manifest**: every accounted H2D/D2H byte rides ONE funnel
+— ``record_h2d(site, nbytes)`` / ``record_d2h(site, nbytes)`` — which
+books the devstats totals AND a per-site manifest counter (declared
+sites only; an unknown site raises). ``manifest_cross_check()`` then
+has real teeth: manifest-vs-devstats totals must match to the byte
+(an unfunneled bump diverges them), and the streaming pipeline
+cross-checks each pull's ACTUAL bytes against the HBM-ledger booking
+its submit staked (``ledger_check`` — est != actual means the PR 8
+ledger is lying about in-flight HBM). perf_smoke fails on any
+mismatch; /debug/vars exposes the manifest under ``xfer`` and the
+compile log under ``compileaudit``.
+
+**jaxpr stats** (``jaxpr_stats`` / ``audit_kernel``): op counts,
+transfer ops and output dtypes of a traced callable — the "what did
+this kernel actually lower to" numbers (f64 outputs on an f32 path,
+unexpected transfer ops) for /debug/vars and the pallas/bench smokes.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from collections import deque
+
+from ..utils import knobs
+from ..utils.stats import register_counters
+
+__all__ = ["CompileAuditor", "AUDITOR", "ensure_installed",
+           "record_h2d", "record_d2h", "ledger_check",
+           "manifest_cross_check", "manifest_snapshot",
+           "jaxpr_stats", "audit_kernel", "audit_snapshot",
+           "compileaudit_collector", "xfer_collector",
+           "H2D_SITES", "D2H_SITES"]
+
+# ------------------------------------------------- transfer manifest
+
+# Declared transfer sites — the manifest's whole point is that every
+# byte names its mover, so the set is CLOSED (an unknown site raises;
+# add it here AND at the call site in one reviewed change).
+H2D_SITES = ("slab", "limbs", "planes", "gids", "latcells", "scalars",
+             "pplan", "decode", "mesh", "other")
+D2H_SITES = ("stream", "batch", "segagg", "finalize", "repair",
+             "other")
+
+XFER_STATS: dict = register_counters("xfer", {
+    **{f"h2d_{s}_bytes": 0 for s in H2D_SITES},
+    **{f"h2d_{s}_events": 0 for s in H2D_SITES},
+    **{f"d2h_{s}_bytes": 0 for s in D2H_SITES},
+    **{f"d2h_{s}_events": 0 for s in D2H_SITES},
+    # pipeline est-vs-actual ledger cross-check (ops/pipeline.py):
+    # every streamed pull compares its actual pulled bytes against the
+    # HBM-ledger bytes its submit accounted
+    "ledger_checks": 0,
+    "ledger_mismatches": 0,
+    "ledger_mismatch_bytes": 0,
+})
+
+
+def record_h2d(site: str, nbytes: int, events: int = 1) -> None:
+    """Book one H2D upload: devstats ``h2d_bytes``/``h2d_uploads``
+    plus the per-site manifest counter. THE funnel — oglint R10 wants
+    every hot-path upload to pass through here (or bump h2d_bytes
+    itself, in which case the manifest cross-check will fail until it
+    is converted)."""
+    if site not in H2D_SITES:
+        raise KeyError(f"undeclared H2D manifest site {site!r} "
+                       f"(declared: {H2D_SITES})")
+    from ..utils.stats import bump as _b
+    from . import devstats
+    nbytes = int(nbytes)
+    devstats.bump("h2d_bytes", nbytes)
+    devstats.bump("h2d_uploads", events)
+    _b(XFER_STATS, f"h2d_{site}_bytes", nbytes)
+    _b(XFER_STATS, f"h2d_{site}_events", events)
+
+
+def record_d2h(site: str, nbytes: int, pulls: int = 1) -> None:
+    """Book one D2H pull batch: devstats ``d2h_bytes``/``d2h_pulls``
+    plus the per-site manifest counter. Called by the accounted
+    transport (``device_get_parallel``, labelled by its caller) and
+    the manually-accounted sparse repair pull."""
+    if site not in D2H_SITES:
+        raise KeyError(f"undeclared D2H manifest site {site!r} "
+                       f"(declared: {D2H_SITES})")
+    from ..utils.stats import bump as _b
+    from . import devstats
+    nbytes = int(nbytes)
+    devstats.bump("d2h_bytes", nbytes)
+    if pulls:
+        devstats.bump("d2h_pulls", pulls)
+    _b(XFER_STATS, f"d2h_{site}_bytes", nbytes)
+    _b(XFER_STATS, f"d2h_{site}_events", 1)
+
+
+def ledger_check(est_bytes: int, actual_bytes: int) -> None:
+    """Pipeline est-vs-actual: the bytes a submit accounted into the
+    HBM ledger's pipeline tier vs the bytes its pull actually moved.
+    Equality is exact by construction (both sides sum the same device
+    leaves); a mismatch means in-flight HBM attribution is wrong."""
+    from ..utils.stats import bump as _b
+    _b(XFER_STATS, "ledger_checks")
+    if int(est_bytes) != int(actual_bytes):
+        _b(XFER_STATS, "ledger_mismatches")
+        _b(XFER_STATS, "ledger_mismatch_bytes",
+           abs(int(est_bytes) - int(actual_bytes)))
+
+
+def manifest_snapshot() -> dict:
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        return dict(XFER_STATS)
+
+
+def manifest_cross_check() -> dict:
+    """Exact attribution audit: the manifest's per-site H2D/D2H byte
+    sums must EQUAL the devstats totals (every byte the counters saw
+    names a site), and the pipeline ledger cross-checks must all have
+    matched. Any new transfer path that books devstats directly —
+    or moves bytes without booking at all while a manifest site books
+    them — diverges the two and fails the perf_smoke gate."""
+    from ..utils.stats import COUNTER_LOCK
+    from .devstats import DEVICE_STATS
+    with COUNTER_LOCK:
+        xf = dict(XFER_STATS)
+        dv = dict(DEVICE_STATS)
+    man_h2d = sum(xf[f"h2d_{s}_bytes"] for s in H2D_SITES)
+    man_d2h = sum(xf[f"d2h_{s}_bytes"] for s in D2H_SITES)
+    out = {
+        "h2d": {"manifest": man_h2d, "devstats": dv["h2d_bytes"],
+                "match": man_h2d == dv["h2d_bytes"]},
+        "d2h": {"manifest": man_d2h, "devstats": dv["d2h_bytes"],
+                "match": man_d2h == dv["d2h_bytes"]},
+        "ledger": {"checks": xf["ledger_checks"],
+                   "mismatches": xf["ledger_mismatches"],
+                   "mismatch_bytes": xf["ledger_mismatch_bytes"],
+                   "match": xf["ledger_mismatches"] == 0},
+    }
+    out["ok"] = all(v["match"] for v in out.values())
+    return out
+
+
+# ------------------------------------------------- compile auditor
+
+COMPILE_STATS: dict = register_counters("compileaudit", {
+    "compiles_total": 0,       # XLA backend compiles observed
+    "traces_total": 0,         # jaxpr retraces observed
+    "duplicate_compiles": 0,   # same (kernel, signature) compiled again
+    "budget_breaches": 0,      # recompile-budget gate failures
+})
+
+# "Compiling <name> with global shapes and types [sig]. Argument ..."
+# — the signature capture must be GREEDY to the aval list's closing
+# bracket ("]. Argument"): a lazy match stops at the first ']' inside
+# "float64[4,4]" and collapses distinct signatures into one
+_COMPILE_RE = re.compile(
+    r"Compiling ([^\s]+)"
+    r"(?: with global shapes and types (\[.*\])\. Argument mapping)?",
+    re.S)
+_TRACE_RE = re.compile(r"Finished tracing \+ transforming ([^\s]+) ")
+
+_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+
+class _AuditHandler(logging.Handler):
+    """Parses the two jax compile-log messages; everything else is
+    ignored. While the auditor holds a logger at DEBUG it also owns
+    propagation (install() turns it off so the raised level cannot
+    flood the root handlers with per-op trace lines) — records at the
+    logger's ORIGINAL threshold are re-dispatched to the root logger
+    here, so a genuine jax warning still reaches the operator."""
+
+    def __init__(self, auditor: "CompileAuditor"):
+        super().__init__(level=logging.DEBUG)
+        self.auditor = auditor
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if msg.startswith("Compiling "):
+            m = _COMPILE_RE.match(msg)
+            if m:
+                self.auditor._on_compile(m.group(1),
+                                         m.group(2) or "")
+        elif msg.startswith("Finished tracing"):
+            m = _TRACE_RE.match(msg)
+            if m:
+                self.auditor._on_trace(m.group(1))
+        orig = self.auditor._saved_levels.get(record.name)
+        if orig is not None \
+                and record.levelno >= max(orig, logging.WARNING):
+            logging.getLogger().handle(record)
+
+
+class CompileAuditor:
+    """Process-wide compile-event recorder. ``install()`` is
+    idempotent and cheap (a logging handler + two logger levels);
+    events only flow when something actually compiles. NOT a sampler:
+    every compile in the process is recorded, which is what lets the
+    warm-window gate assert an exact zero."""
+
+    def __init__(self, ring: int = 512):
+        self._lock = threading.Lock()
+        self._handler: _AuditHandler | None = None
+        self._saved_levels: dict[str, int] = {}
+        self._saved_raw: dict[str, int] = {}
+        self._saved_prop: dict[str, bool] = {}
+        # kernel -> {"compiles": int, "sigs": {sig: count}}
+        self.kernels: dict[str, dict] = {}
+        self.events: deque = deque(maxlen=ring)
+        self._gen = 0                      # bumps on every compile
+
+    # ------------------------------------------------------ lifecycle
+
+    def install(self) -> None:
+        with self._lock:
+            if self._handler is not None:
+                return
+            self._handler = _AuditHandler(self)
+            for name in _LOGGERS:
+                lg = logging.getLogger(name)
+                # effective level decides what the operator WOULD have
+                # seen (re-dispatch threshold); raw level is what
+                # uninstall must restore
+                self._saved_levels[name] = lg.getEffectiveLevel()
+                self._saved_raw[name] = lg.level
+                self._saved_prop[name] = lg.propagate
+                # the compile messages are emitted at DEBUG when
+                # jax_log_compiles is off; raising only these two
+                # loggers keeps the rest of jax quiet and costs
+                # nothing between compiles. Propagation is cut while
+                # the level is raised (the handler re-dispatches
+                # WARNING+ records to root) so the DEBUG flood never
+                # reaches the root handlers.
+                lg.setLevel(logging.DEBUG)
+                lg.propagate = False
+                lg.addHandler(self._handler)
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if self._handler is None:
+                return
+            for name in _LOGGERS:
+                lg = logging.getLogger(name)
+                lg.removeHandler(self._handler)
+                lg.setLevel(self._saved_raw.get(name, 0))
+                lg.propagate = self._saved_prop.get(name, True)
+            self._handler = None
+            self._saved_levels.clear()
+            self._saved_raw.clear()
+            self._saved_prop.clear()
+
+    def installed(self) -> bool:
+        return self._handler is not None
+
+    # ------------------------------------------------------ recording
+
+    def _on_compile(self, kernel: str, sig: str) -> None:
+        from ..utils.stats import bump as _b
+        dup = False
+        with self._lock:
+            k = self.kernels.setdefault(
+                kernel, {"compiles": 0, "sigs": {}})
+            k["compiles"] += 1
+            k["sigs"][sig] = k["sigs"].get(sig, 0) + 1
+            # duplicate = same (kernel, input signature) compiled
+            # again. Scoped to the repo's NAMED kernels ("og_" —
+            # blockagg's _named_jit factories and the test fixtures):
+            # jax's eager primitive wrappers are shape-polymorphic in
+            # their OUTPUT (broadcast_in_dim for jnp.zeros of two
+            # sizes logs identical input avals; iota logs an empty
+            # list) and would false-positive forever. The warm/cold
+            # window gates still cover every kernel regardless of
+            # name.
+            dup = (k["sigs"][sig] > 1 and kernel.startswith("og_")
+                   and "ShapedArray" in sig)
+            self._gen += 1
+            self.events.append(
+                {"ts": time.time(), "kernel": kernel, "sig": sig,
+                 "dup": dup})
+        _b(COMPILE_STATS, "compiles_total")
+        if dup:
+            _b(COMPILE_STATS, "duplicate_compiles")
+
+    def _on_trace(self, kernel: str) -> None:
+        from ..utils.stats import bump as _b
+        _b(COMPILE_STATS, "traces_total")
+
+    # ------------------------------------------------------- windows
+
+    def mark(self) -> dict:
+        """Snapshot token for a budget window: per-kernel compile
+        counts at this instant."""
+        with self._lock:
+            return {k: v["compiles"] for k, v in self.kernels.items()}
+
+    def since(self, mark: dict) -> dict:
+        """Per-kernel compiles since ``mark`` (kernels with zero new
+        compiles are omitted)."""
+        out = {}
+        with self._lock:
+            for k, v in self.kernels.items():
+                d = v["compiles"] - mark.get(k, 0)
+                if d > 0:
+                    out[k] = d
+        return out
+
+    def total_since(self, mark: dict) -> int:
+        return sum(self.since(mark).values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "installed": self._handler is not None,
+                "kernels": {k: {"compiles": v["compiles"],
+                                "distinct_sigs": len(v["sigs"])}
+                            for k, v in self.kernels.items()},
+                "recent": list(self.events)[-32:],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.kernels.clear()
+            self.events.clear()
+            self._gen = 0
+
+
+AUDITOR = CompileAuditor()
+
+
+def ensure_installed() -> bool:
+    """Install the process-wide auditor when ``OG_COMPILE_AUDIT`` is
+    on (the default). Called from the executor at construction and
+    from the gates; safe to call repeatedly."""
+    if not bool(knobs.get("OG_COMPILE_AUDIT")):
+        return False
+    AUDITOR.install()
+    return True
+
+
+def check_recompile_budget(label: str, compiles: int,
+                           budgets: dict | None = None) -> dict:
+    """Grade one window against the declared per-bench-shape budget
+    (``utils.knobs.RECOMPILE_BUDGETS``). Returns a report; a breach
+    also bumps ``budget_breaches`` so dashboards see drift even when
+    nobody reads the gate output."""
+    from ..utils.knobs import RECOMPILE_BUDGETS
+    from ..utils.stats import bump as _b
+    budgets = budgets if budgets is not None else RECOMPILE_BUDGETS
+    budget = budgets.get(label, budgets.get("default", 0))
+    ok = compiles <= budget
+    if not ok:
+        _b(COMPILE_STATS, "budget_breaches")
+    return {"label": label, "compiles": int(compiles),
+            "budget": int(budget), "ok": ok}
+
+
+# --------------------------------------------------- jaxpr/HLO stats
+
+# audited-kernel reports for /debug/vars (bounded: keyed by name,
+# written by audit_kernel from the bench/smoke/tests)
+_JAXPR_AUDITS: dict[str, dict] = {}
+_JAXPR_LOCK = threading.Lock()
+
+
+def jaxpr_stats(fn, *args, static_argnums=(), **kwargs) -> dict:
+    """Trace ``fn`` and report what it lowers to: equation count,
+    per-primitive op counts, transfer ops (device_put / host
+    callbacks), and output dtypes (an f64 output on an f32 path is
+    the R903 hazard showing up at runtime)."""
+    import jax
+    jpr = jax.make_jaxpr(fn, static_argnums=static_argnums)(
+        *args, **kwargs)
+    ops: dict[str, int] = {}
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            ops[eqn.primitive.name] = ops.get(eqn.primitive.name,
+                                              0) + 1
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    walk(inner)
+
+    walk(jpr.jaxpr)
+    transfer = sum(n for p, n in ops.items()
+                   if p in ("device_put", "copy",
+                            "convert_element_type_device"))
+    out_dtypes = [str(v.aval.dtype) for v in jpr.jaxpr.outvars
+                  if hasattr(v.aval, "dtype")]
+    return {"eqns": sum(ops.values()), "ops": ops,
+            "transfer_ops": transfer, "out_dtypes": out_dtypes,
+            "f64_outputs": sum(1 for d in out_dtypes
+                               if d == "float64")}
+
+
+def audit_kernel(name: str, fn, *args, **kwargs) -> dict:
+    """jaxpr-audit one kernel and file the report under ``name`` for
+    /debug/vars (``compileaudit.jaxpr``)."""
+    st = jaxpr_stats(fn, *args, **kwargs)
+    # keep the report JSON-small: top ops only
+    slim = dict(st)
+    slim["ops"] = dict(sorted(st["ops"].items(),
+                              key=lambda kv: -kv[1])[:12])
+    with _JAXPR_LOCK:
+        _JAXPR_AUDITS[name] = slim
+    return st
+
+
+def audit_snapshot() -> dict:
+    """The /debug/vars ``compileaudit`` section: compile-log state,
+    cumulative counters and the jaxpr audits."""
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        counters = dict(COMPILE_STATS)
+    with _JAXPR_LOCK:
+        jaxprs = {k: dict(v) for k, v in _JAXPR_AUDITS.items()}
+    return {**AUDITOR.snapshot(), "counters": counters,
+            "jaxpr": jaxprs}
+
+
+# ------------------------------------------------------- collectors
+
+def compileaudit_collector() -> dict:
+    """utils.stats collector (flat numbers for the pusher/metrics):
+    compile/trace totals plus the distinct-kernel gauge."""
+    from ..utils.stats import COUNTER_LOCK
+    with COUNTER_LOCK:
+        out = dict(COMPILE_STATS)
+    with AUDITOR._lock:
+        out["kernels_distinct"] = len(AUDITOR.kernels)
+        out["installed"] = 1 if AUDITOR._handler is not None else 0
+    return out
+
+
+def xfer_collector() -> dict:
+    """utils.stats collector: the per-site transfer manifest."""
+    return manifest_snapshot()

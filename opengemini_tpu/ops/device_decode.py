@@ -70,25 +70,36 @@ def _pad_runs(vals: np.ndarray, lens: np.ndarray,
 def device_decode_float_block(buf, n: int) -> jax.Array | None:
     """Decode a float block ON DEVICE when its codec is arithmetic;
     returns None for byte codecs (caller falls back to the CPU decoder,
-    encoding/blocks.decode_float_block)."""
+    encoding/blocks.decode_float_block). The compressed payload is the
+    only H2D traffic — booked per upload into the transfer manifest
+    (ops/compileaudit.py, site ``decode``)."""
+    from . import compileaudit
     codec = buf[0]
     payload = memoryview(buf)[1:]
     if codec == CONST:
         v = np.frombuffer(payload[:8], dtype=np.float64)[0]
-        return const_expand(jnp.asarray(v), n)
+        vd = jnp.asarray(v)
+        compileaudit.record_h2d("decode", int(vd.nbytes))
+        return const_expand(vd, n)
     if codec == RLE:
         vals, lens = parse_rle_payload(payload)
         pv, pl = _pad_runs(vals, lens)
         # ship ~runs*12 bytes instead of n*8
-        return rle_expand(jnp.asarray(pv), jnp.asarray(pl), n)
+        pvd, pld = jnp.asarray(pv), jnp.asarray(pl)
+        compileaudit.record_h2d("decode",
+                                int(pvd.nbytes + pld.nbytes))
+        return rle_expand(pvd, pld, n)
     return None
 
 
 def device_decode_time_block(buf, n: int) -> jax.Array | None:
     """Decode a CONST_DELTA time block on device (regular sampling — the
     overwhelmingly common case — costs 16 bytes of transfer)."""
+    from . import compileaudit
     if buf[0] != CONST_DELTA:
         return None
     t0, step = struct.unpack("<qq", memoryview(buf)[1:17])
-    return const_delta_expand(jnp.asarray(t0, dtype=jnp.int64),
-                              jnp.asarray(step, dtype=jnp.int64), n)
+    t0d = jnp.asarray(t0, dtype=jnp.int64)
+    stepd = jnp.asarray(step, dtype=jnp.int64)
+    compileaudit.record_h2d("decode", int(t0d.nbytes + stepd.nbytes))
+    return const_delta_expand(t0d, stepd, n)

@@ -373,8 +373,8 @@ def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
             cache.put_sized(_limb_key(fp, field, E), dl,
                             int(dl.nbytes))
     if nb:
-        devstats.bump("h2d_bytes", nb)
-        devstats.bump("h2d_uploads")
+        from . import compileaudit
+        compileaudit.record_h2d("planes", nb)
     if cache is not None:
         _bump_plane("plane_puts")
         _bump_plane("plane_put_bytes", nb)

@@ -71,14 +71,18 @@ def pull_threads() -> int:
 
 
 def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
-                        stats: dict | None = None):
+                        stats: dict | None = None,
+                        site: str = "other"):
     """device_get with per-leaf thread parallelism and chunked fetches
     of large leaves. The tunnel-attached link serializes transfers and
     pays a full round trip per pull; concurrent streams overlap that
     latency and lift large-transfer bandwidth ~54 → ~70 MB/s
     (measured, 4 streams). Non-device leaves pass through untouched.
     ``stats`` (optional dict) receives bytes/leaves/pulls of this call
-    so per-query accounting doesn't race the global counters."""
+    so per-query accounting doesn't race the global counters.
+    ``site`` labels the pull in the per-site transfer manifest
+    (ops/compileaudit.py — callers name their lane so every D2H byte
+    stays attributable)."""
     import concurrent.futures as cf
 
     import jax
@@ -133,8 +137,11 @@ def device_get_parallel(tree, chunk_bytes=32 << 20, threads=6,
     out = [np.concatenate(p[2], axis=p[1])
            if isinstance(p, list) and p and p[0] == "chunks" else p
            for p in parts]
-    _ds.bump("d2h_bytes", total_b)
-    _ds.bump("d2h_pulls", len(jobs))
+    if n_dev:
+        # manifest booking only when device bytes actually moved — an
+        # all-host tree must not mint a phantom pull event
+        from . import compileaudit as _ca
+        _ca.record_d2h(site, total_b, pulls=len(jobs))
     _ds.bump("d2h_wait_ns", _now_ns() - _t_pull0)
     if n_dev:
         # per-call distribution (flight-recorder histograms): bytes and
@@ -386,7 +393,13 @@ class StreamingPipeline:
                 pull_sp.start_ns = t0
                 pull_sp.add(lane=threading.current_thread().name)
             st: dict = {}
-            host = device_get_parallel(tree, stats=st)
+            host = device_get_parallel(tree, stats=st, site="stream")
+            if pull is not None:
+                # transfer-manifest-vs-HBM-ledger exact cross-check:
+                # the bytes this pull moved must equal the bytes its
+                # submit accounted into the pipeline tier
+                from . import compileaudit as _ca
+                _ca.ledger_check(pull.est_b, st.get("bytes", 0))
             if pull_sp is not None:
                 pull_sp.end_ns = _now_ns()
                 pull_sp.add(bytes=st.get("bytes", 0),

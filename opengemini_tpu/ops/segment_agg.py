@@ -367,7 +367,8 @@ def multi_segment_aggregate(values_f, valid_f, limbs_f, seg_ids, times,
             from . import devicefault as _df
             if _df.classify(e) is not None:
                 raise
-        f64h, i64h = device_get_parallel((f64p, i64p))
+        f64h, i64h = device_get_parallel((f64p, i64p),
+                                         site="segagg")
     else:
         f64h = i64h = None
     rep: dict = {}

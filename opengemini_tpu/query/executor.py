@@ -328,6 +328,12 @@ class QueryExecutor:
         from collections import OrderedDict
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_lock = __import__("threading").Lock()
+        # runtime compile auditor (ops/compileaudit.py): record every
+        # XLA compile this process triggers so the recompile-budget
+        # gate and /debug/vars see hot-loop retraces; OG_COMPILE_AUDIT
+        # gates the (one-time, cheap) logging hook
+        from ..ops import compileaudit as _compileaudit
+        _compileaudit.ensure_installed()
 
     def _catalog_stmt(self, stmt, db: str | None) -> dict:
         """Subscription + downsample-policy DDL against the meta
@@ -2825,7 +2831,8 @@ class QueryExecutor:
                     pass
                 (field_results, dense_out, exact_results, dense_exact,
                  sel_results, block_outs, ddev_trees) = \
-                    _device_get_parallel(tree, stats=_q_pull)
+                    _device_get_parallel(tree, stats=_q_pull,
+                                         site="batch")
             else:
                 block_fmt = block_outs = None
                 tree = (field_results, dense_out, exact_results,
@@ -2836,7 +2843,8 @@ class QueryExecutor:
                     pass
                 (field_results, dense_out, exact_results, dense_exact,
                  sel_results) = _device_get_parallel(tree,
-                                                     stats=_q_pull)
+                                                     stats=_q_pull,
+                                                     site="batch")
                 streamed = pipe.collect()
                 ddev_trees = [streamed[("dense", i)]
                               for i in range(len(dense_dev_pending))]
@@ -4064,7 +4072,7 @@ def _batch_pull_results(field_results: dict, exact_results: dict,
                else jnp.stack([v for _r, v in kvs])
                for kvs in groups.values()]
     st: dict = {}
-    hosts = _device_get_parallel(stacked, stats=st)
+    hosts = _device_get_parallel(stacked, stats=st, site="batch")
     pulled: dict[tuple, np.ndarray] = {}
     for kvs, arr in zip(groups.values(), hosts):
         if len(kvs) == 1:

@@ -332,6 +332,36 @@ register("OG_HBM_PRESSURE_EVICT", bool, True,
          "mirrored) before the post-relief retry; 0 = shrink the "
          "in-flight gate only")
 
+# --- compile-cache / transfer audit (ops/compileaudit.py)
+register("OG_COMPILE_AUDIT", bool, True,
+         "runtime compile auditor: record every XLA compile (kernel + "
+         "shape signature) off jax's compile log for the recompile-"
+         "budget and /debug/vars compile surfaces; 0 = no hook",
+         scope="cached")
+
+# Per-bench-shape recompile budgets (ops/compileaudit.py gate, run by
+# bench.py --phase smoke and scripts/perf_smoke.sh): COLD = compiles a
+# first run of the shape may trigger (every kernel compiles once per
+# shape class — plan/lattice/pack/finalize variants included); WARM is
+# always ZERO (a repeat of the same shape re-compiling ANYTHING is the
+# hot-loop retrace class that erased the r05 1m win). Declared here,
+# next to the knob registry, so perf knobs and perf budgets live on
+# one page; drift (a new kernel variant pushing a shape over budget)
+# fails the gate and is either a hazard to fix or a reviewed bump of
+# this table in the same change.
+RECOMPILE_BUDGETS: dict = {
+    # smoke shapes (48 hosts x 1h, scripts/perf_smoke.sh): measured 2
+    # cold compiles per shape (the shape's block kernel + the finalize
+    # epilogue; first shape also pays the tiny-op first-touch
+    # compiles). 16 leaves room for route variants (prefix/lattice/
+    # pack) on other datasets/backends while still catching the
+    # failure mode that matters: a per-value shape-class explosion
+    # compiles O(slabs) kernels and blows straight past this.
+    "1h": 16, "1m": 16, "cfg1": 16,
+    # any undeclared window label: strict by default
+    "default": 0,
+}
+
 # --- flight recorder / tracing (utils/tracing.py, http/server.py)
 register("OG_TRACE_SAMPLE", float, 0.05,
          "head-sampling probability for the query/write flight "

@@ -498,6 +498,23 @@ def wal_collector():
     return dict(WAL_STATS)
 
 
+def compileaudit_collector():
+    """Compile-cache audit metrics (ops/compileaudit.py): XLA compile
+    / retrace totals, duplicate (kernel, signature) compiles — the
+    hot-loop retrace smoking gun — and recompile-budget breaches."""
+    from ..ops.compileaudit import compileaudit_collector as _cc
+    return _cc()
+
+
+def xfer_collector():
+    """Per-site transfer manifest (ops/compileaudit.py): H2D/D2H
+    bytes and events by declared mover site, plus the pipeline
+    est-vs-actual ledger cross-check counters — every byte that
+    crosses the accelerator link names who moved it."""
+    from ..ops.compileaudit import xfer_collector as _xc
+    return _xc()
+
+
 def raft_collector():
     """Replication raft metrics (elections, snapshots, proposes)."""
     from ..cluster.raft import RAFT_STATS

@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
 # Static-analysis + sanitizer gate (CI / tier-1 wrapper):
-#   1. scripts/oglint.py — the six repo-specific invariant rule
+#   1. scripts/oglint.py — the ten repo-specific invariant rule
 #      classes (transfer discipline, knob registry + README drift,
 #      deadline propagation, lock ranks, trace purity, counter
-#      hygiene) over the whole tree; any violation fails the gate.
+#      hygiene, fault classification, rename durability, jit-boundary
+#      hygiene R9, launch hygiene R10) over the whole tree; any
+#      violation fails the gate. The runtime half of R9/R10 — the
+#      recompile-budget and transfer-manifest gates — runs in
+#      scripts/perf_smoke.sh (bench.py --phase smoke).
 #   2. when a sanitizer-capable C++ toolchain is present:
 #      make -C native sanitize (ASan+UBSan libogn) and
 #      scripts/sanitize_tests.sh (native-touching pytest suites
@@ -15,7 +19,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint_gate: oglint (R1-R6) =="
+echo "== lint_gate: oglint (R1-R10) =="
 python scripts/oglint.py
 
 echo "== lint_gate: native sanitizers =="

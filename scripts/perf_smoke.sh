@@ -87,6 +87,18 @@ assert r.get("crash_cycles", 0) >= 3, r
 assert r.get("crash_digest_ok") == 1, r
 assert r.get("crash_orphans") == 0, r
 assert r.get("crash_recovery_ms", 0) > 0, r
+# compile-cache + transfer audit gates (PR 11): every bench shape
+# fits its declared cold recompile budget (utils/knobs.py
+# RECOMPILE_BUDGETS), warm repeats compile NOTHING, no (kernel,
+# signature) compiled twice anywhere in the smoke, and the per-site
+# transfer manifest matches the devstats totals byte for byte with
+# every streamed pull cross-checked against its HBM-ledger booking
+assert r.get("recompile_budget_ok") == 1, r
+assert r.get("warm_compiles") == 0, r
+assert r.get("duplicate_compiles") == 0, r
+assert r.get("compiles_total", 0) > 0, r
+assert r.get("xfer_manifest_ok") == 1, r
+assert r.get("xfer_ledger_checks", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -100,6 +112,11 @@ print(f"chaos gate OK: {r['chaos_injections']} device faults "
 print(f"crash gate OK: {r['crash_cycles']} SIGKILL/restart cycles, "
       f"digests bit-identical, zero orphans, cold restart "
       f"{r['crash_recovery_ms']}ms")
+print(f"compile audit OK: {r['compiles_total']} compiles, budgets "
+      f"{r['recompile_budget']}, 0 warm, 0 duplicate")
+print(f"transfer manifest OK: h2d {r['xfer_h2d_bytes']}B / d2h "
+      f"{r['xfer_d2h_bytes']}B attributed, "
+      f"{r['xfer_ledger_checks']} ledger checks, 0 mismatches")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

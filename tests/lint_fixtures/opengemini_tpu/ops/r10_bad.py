@@ -1,0 +1,17 @@
+"""R10 failing fixture: unbooked H2D uploads in the hot path — a bare
+device_put, an eager jnp.asarray over host data, and a module-level
+upload."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOOKUP = jax.device_put(np.arange(16))              # R1001
+
+
+def upload_stack(vals):
+    return jax.device_put(vals)                      # R1001
+
+
+def eager_asarray(host_rows):
+    dev = jnp.asarray(host_rows)                     # R1001
+    return dev * 2

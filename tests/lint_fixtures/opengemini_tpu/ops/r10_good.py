@@ -1,0 +1,32 @@
+"""R10 passing fixture: booked uploads (manifest funnel or h2d bump),
+traced jnp.asarray (a trace op, not a transfer), and a reviewed
+pragma site."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opengemini_tpu.ops import compileaudit, devstats
+
+
+def booked_upload(vals):
+    dev = jax.device_put(vals)
+    compileaudit.record_h2d("other", int(dev.nbytes))
+    return dev
+
+
+def legacy_booked_upload(vals):
+    dev = jax.device_put(vals)
+    devstats.bump("h2d_bytes", int(dev.nbytes))
+    return dev
+
+
+@jax.jit
+def traced_asarray(x):
+    # inside traced code jnp.asarray is a trace op — no transfer
+    return jnp.asarray(x) + 1
+
+
+def reviewed_upload(tiny_scalar):
+    # 8 bytes, measured irrelevant — reviewed suppression
+    return jax.device_put(  # oglint: disable=R1001
+        np.float64(tiny_scalar))

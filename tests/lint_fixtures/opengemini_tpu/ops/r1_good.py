@@ -3,6 +3,7 @@ the rule must NOT flag."""
 import jax
 import numpy as np
 
+from opengemini_tpu.ops import compileaudit
 from opengemini_tpu.ops.pipeline import device_get_parallel
 
 
@@ -17,7 +18,11 @@ def host_conversion(rows):
 
 
 def upload(x):
-    return jax.device_put(x)        # H2D is not a pull
+    # H2D is not a pull (R1's business) — and it books its bytes
+    # through the manifest funnel (R10's business)
+    dev = jax.device_put(x)
+    compileaudit.record_h2d("other", int(dev.nbytes))
+    return dev
 
 
 def annotated_sparse_repair(planes_dev, flagged, devstats):

@@ -1,0 +1,44 @@
+"""R9 failing fixture: every jit-boundary hazard the rule catches —
+host syncs of traced values, a shape-deriving Python arg without
+static marking, and f64 promotion in an f32 traced path."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def shape_from_python(x, n):
+    return x + jnp.arange(n)                         # R902 (n not static)
+
+
+@jax.jit
+def item_sync(x):
+    return x.sum().item() + x[0].item()              # R901
+
+
+@jax.jit
+def cast_sync(x):
+    s = float(x.sum())                               # R901
+    return x / s
+
+
+@jax.jit
+def asarray_sync(x):
+    h = np.asarray(x)                                # R901
+    return jnp.asarray(h.sum())
+
+
+@jax.jit
+def implicit_bool(x):
+    if x[0]:                                         # R901
+        return x * 2
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f32_promote_f32(x, n):
+    scale = jnp.array([1.5, 2.5])                    # R903 (strong f64)
+    bias = jnp.float64(0.5)                          # R903
+    return x[:n] * scale[0] + bias

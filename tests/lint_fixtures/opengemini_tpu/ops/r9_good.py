@@ -1,0 +1,52 @@
+"""R9 passing fixture: static shape args, host syncs outside the jit
+boundary, f32-typed literals in the f32 path."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def shape_static(x, n):
+    # n is declared static: range/arange over it trace once per value
+    # BY DESIGN (shape classes, not silent churn)
+    return x + jnp.arange(n)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def shape_static_by_num(x, n):
+    return x.reshape(n, -1)
+
+
+@jax.jit
+def shape_from_arg_shape(x):
+    # x.shape is static under trace: deriving shapes from it is free
+    n = x.shape[0]
+    return x + jnp.arange(n)
+
+
+@jax.jit
+def static_metadata_casts(x):
+    # float()/int() over shape/dtype metadata is a trace-time Python
+    # value, NOT a host sync — R901 must stay quiet here
+    scale = float(x.shape[0]) * int(x.ndim)
+    return x / scale
+
+
+@jax.jit
+def pure_kernel(x):
+    return jnp.where(x > 0, x, 0).sum()
+
+
+def dispatch(x):
+    # host-side wrapper: syncs HERE are fine — the jit boundary is
+    # exactly where the device drains
+    out = pure_kernel(x)
+    return float(np.asarray(out))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f32_typed_literals(x, n):
+    scale = jnp.array([1.5, 2.5], dtype=jnp.float32)
+    return x[:n] * scale[0] + np.float32(0.5)

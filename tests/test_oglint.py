@@ -130,6 +130,66 @@ def test_r8_scope_is_storage_only(tmp_path):
     assert not [v for v in rl(str(tmp_path)) if v.code == "R801"]
 
 
+def test_r9_jit_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/ops/r9_bad.py"])
+    by = {}
+    for v in vs:
+        by.setdefault(v.code, []).append(v)
+    # host syncs: .item(), float(), np.asarray, implicit bool
+    assert len(by.get("R901", [])) >= 4, vs
+    # non-static shape-deriving arg
+    assert len(by.get("R902", [])) == 1, vs
+    # f64 literal + dtype-less array ctor in the f32-named kernel
+    assert len(by.get("R903", [])) >= 2, vs
+
+
+def test_r9_jit_good_fixture():
+    got = codes_for("opengemini_tpu/ops/r9_good.py")
+    assert not {c for c in got if c.startswith("R9")}, got
+
+
+def test_r10_launch_bad_fixture():
+    vs = run_lint(FIXTURES, paths=["opengemini_tpu/ops/r10_bad.py"])
+    r10 = [v for v in vs if v.code == "R1001"]
+    # module-level upload, bare device_put, eager jnp.asarray
+    assert len(r10) == 3, vs
+
+
+def test_r10_launch_good_fixture():
+    got = codes_for("opengemini_tpu/ops/r10_good.py")
+    assert "R1001" not in got, got
+
+
+def test_r10_scope_is_hot_path_only(tmp_path):
+    """A bare device_put OUTSIDE ops/ + executor is not R10's
+    business (mesh dryruns, app tooling)."""
+    d = tmp_path / "opengemini_tpu" / "parallel"
+    d.mkdir(parents=True)
+    (d / "x.py").write_text("import jax\n"
+                            "def f(v):\n"
+                            "    return jax.device_put(v)\n")
+    assert not [v for v in run_lint(str(tmp_path))
+                if v.code == "R1001"]
+
+
+def test_r5_walker_covers_pallas_kernels(tmp_path):
+    """pl.pallas_call kernels are traced roots for the shared walker:
+    host state inside one is an R501 exactly like jit code."""
+    d = tmp_path / "opengemini_tpu" / "ops"
+    d.mkdir(parents=True)
+    (d / "pk.py").write_text(
+        "import os\n"
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "def _kern(x_ref, o_ref):\n"
+        "    if os.environ.get('OG_X'):\n"
+        "        o_ref[...] = x_ref[...]\n"
+        "def run(x):\n"
+        "    return pl.pallas_call(_kern, out_shape=None)(x)\n")
+    vs = run_lint(str(tmp_path))
+    assert any(v.code == "R501" for v in vs), vs
+
+
 # ------------------------------------------------------- machinery
 
 def test_r7_fault_bad_fixture():
