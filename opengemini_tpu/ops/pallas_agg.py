@@ -18,6 +18,8 @@ Falls back to `interpret=True` off-TPU (tests run on the CPU mesh)."""
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -43,6 +45,27 @@ def _rowagg_kernel(x_ref, sum_ref, min_ref, max_ref):
         jnp.max(x, axis=1, keepdims=True), shape)
 
 
+@functools.lru_cache(maxsize=None)
+def _rowagg_fn(S: int, P: int, interpret: bool):
+    """Memoized pallas_call callable per (S, P) shape class. A fresh
+    ``pl.pallas_call(...)`` per invocation re-traces AND re-compiles
+    its wrapper on EVERY call (the compile auditor flagged the warm
+    path at 2 compiles/call — the hot-loop recompile class); building
+    the callable once per shape class lets the jit cache serve warm
+    dashboard traffic. Shape classes are bounded: S pads to TILE_S
+    multiples and P to power-of-two segment tiers."""
+    out = jax.ShapeDtypeStruct((S, LANES), jnp.float32)
+    return pl.pallas_call(
+        _rowagg_kernel,
+        grid=(S // TILE_S,),
+        in_specs=[pl.BlockSpec((TILE_S, P), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((TILE_S, LANES),
+                                lambda i: (i, 0))] * 3,
+        out_shape=[out, out, out],
+        interpret=interpret,
+    )
+
+
 def _rowagg_call(x, interpret: bool):
     # x64 must be OFF around the pallas trace: the session enables
     # jax_enable_x64 globally (ops/__init__) and Mosaic lowering of the
@@ -51,17 +74,8 @@ def _rowagg_call(x, interpret: bool):
     from jax.experimental import enable_x64   # jax.enable_x64 alias
     # was removed in newer jax releases; the experimental home remains
     S, P = x.shape
-    out = jax.ShapeDtypeStruct((S, LANES), jnp.float32)
     with enable_x64(False):
-        return pl.pallas_call(
-            _rowagg_kernel,
-            grid=(S // TILE_S,),
-            in_specs=[pl.BlockSpec((TILE_S, P), lambda i: (i, 0))],
-            out_specs=[pl.BlockSpec((TILE_S, LANES),
-                                    lambda i: (i, 0))] * 3,
-            out_shape=[out, out, out],
-            interpret=interpret,
-        )(x)
+        return _rowagg_fn(S, P, interpret)(x)
 
 
 def pallas_dense_rowagg(values,
