@@ -70,6 +70,14 @@ QUERY_1M = ("SELECT mean(usage_user) FROM cpu WHERE time >= 0 AND "
 # Wide windows route to the scatter-free prefix kernel
 QUERY_CFG1 = ("SELECT mean(usage_user) FROM cpu WHERE time >= 0 AND "
               f"time < {int(HOURS * 3600)}s GROUP BY time(1m)")
+# answer-sized D2H shapes (PR 12): the heavy grid with ORDER BY+LIMIT
+# — the device top-k cut ships only k×groups winner cells instead of
+# the 11.5M-cell grid — and the percentile shape, finalized as order
+# statistics over device-resident sorted-sample planes
+QUERY_1M_TOPK = QUERY_1M + " ORDER BY time DESC LIMIT 5"
+QUERY_PCTL = ("SELECT percentile(usage_user, 95) FROM cpu WHERE "
+              f"time >= 0 AND time < {int(HOURS * 3600)}s "
+              "GROUP BY time(5m), hostname")
 
 # ---------------------------------------------------------------- util
 
@@ -207,7 +215,8 @@ def build_dataset(data_dir: str, hosts: int = None,
     return n, t_ing
 
 
-def run_query_phase(data_dir: str, runs: int) -> dict:
+def run_query_phase(data_dir: str, runs: int,
+                    extras: bool = True) -> dict:
     """Open the stored dataset, run all three query shapes end-to-end
     `runs` times (after warmup), return best wall times + digests."""
     from opengemini_tpu.query import QueryExecutor, parse_query
@@ -224,7 +233,9 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
     from opengemini_tpu.ops import compileaudit as _ca
     warm_compiles = {}
     for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
-                       ("cfg1", QUERY_CFG1)):
+                       ("cfg1", QUERY_CFG1),
+                       ("1m-topk", QUERY_1M_TOPK),
+                       ("pctl", QUERY_PCTL)):
         (stmt,) = parse_query(qtext)
         res = ex.execute(stmt, "bench")      # warmup: compile + caches
         if "error" in res:
@@ -266,6 +277,45 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
             "est_pull_bytes": cost.pull_bytes,
             "actual_pull_bytes": cctx.d2h_bytes,
             "hbm_peak_bytes": cctx.hbm_peak}
+    # answer-sized D2H (PR 12): the device top-k cut must shrink the
+    # heavy shape's pull to winner cells ONLY, bit-identical to the
+    # full-grid escape hatch — measured per-query gauge, not a guess —
+    # and the percentile shape must route through the device
+    # order-statistic finalize (counter-proven). All figures are
+    # per-query deltas/gauges, not cumulative process counters.
+    if not extras:
+        eng.close()
+        return out
+    from opengemini_tpu.ops.devstats import DEVICE_STATS as _DSTK
+    (stmt_tk,) = parse_query(QUERY_1M_TOPK)
+    knobs.set_env("OG_DEVICE_TOPK", "0")
+    try:
+        ref_tk = ex.execute(stmt_tk, "bench")
+        tk_off_b = _DSTK["last_query_d2h_bytes"]
+    finally:
+        knobs.del_env("OG_DEVICE_TOPK")
+    tk_c0 = _DSTK["topk_cells_pulled"]
+    got_tk = ex.execute(stmt_tk, "bench")
+    tk_on_b = _DSTK["last_query_d2h_bytes"]
+    (stmt_pc,) = parse_query(QUERY_PCTL)
+    knobs.set_env("OG_DEVICE_SKETCH", "0")
+    try:
+        ref_pc = ex.execute(stmt_pc, "bench")
+    finally:
+        knobs.del_env("OG_DEVICE_SKETCH")
+    sk0 = _DSTK["sketch_dev_grids"]
+    sk_h0 = _DSTK["sketch_plane_hits"]
+    got_pc = ex.execute(stmt_pc, "bench")
+    out["answer_sized_d2h"] = {
+        "topk_bit_identical": got_tk == ref_tk,
+        "topk_d2h_bytes_off": int(tk_off_b),
+        "topk_d2h_bytes_on": int(tk_on_b),
+        "topk_d2h_shrink_x": round(tk_off_b / max(tk_on_b, 1), 1),
+        "topk_cells_pulled": int(_DSTK["topk_cells_pulled"] - tk_c0),
+        "pctl_bit_identical": got_pc == ref_pc,
+        "sketch_dev_grids": int(_DSTK["sketch_dev_grids"] - sk0),
+        "sketch_plane_hits": int(_DSTK["sketch_plane_hits"] - sk_h0),
+    }
     # per-phase wall times from EXPLAIN ANALYZE: plan / dispatch /
     # kernel+pull / fold / finalize of the 1h shape. With the streaming
     # pipeline the device_pull span OVERLAPS the others (it opens at
@@ -275,6 +325,16 @@ def run_query_phase(data_dir: str, runs: int) -> dict:
     (est,) = parse_query("EXPLAIN ANALYZE " + QUERY)
     res = ex.execute(est, "bench")
     out.update(_parse_phases(res))
+    # heavy-shape phases: the ORDER BY+LIMIT variant carries the new
+    # device_finalize/device_topk sub-phases (both declared in
+    # devstats.QUERY_PHASE_NS, so the PR 7 phase-drift gate covers
+    # their span names) — reported separately so the answer-sized cut
+    # is attributable next to the full-grid phases above
+    (est_h,) = parse_query("EXPLAIN ANALYZE " + QUERY_1M_TOPK)
+    res_h = ex.execute(est_h, "bench")
+    ph_h = _parse_phases(res_h)
+    out["phases_ms_heavy"] = ph_h.get("phases_ms", {})
+    out["pull_bytes_heavy"] = ph_h.get("pull_bytes", 0)
     # serialize phase: stream the 11.5M-cell 1m result (kept from the
     # timing loop — no extra execution) through the chunked encoder
     # (http/serializer — what the HTTP layer emits); measured here
@@ -471,7 +531,7 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
             raise SystemExit(f"cpu phase failed rc={rc}: {err[-2000:]}")
         cpu = json.loads(out.strip().splitlines()[-1])
         tpu = run_query_phase(td, runs)
-        for key in ("1h", "1m", "cfg1"):
+        for key in ("1h", "1m", "cfg1", "1m-topk", "pctl"):
             if cpu[key]["digest"] != tpu[key]["digest"]:
                 raise SystemExit(
                     f"MISMATCH [{key}]: cpu {cpu[key]['digest'][:16]} "
@@ -508,6 +568,19 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         "cpu_cfg1_s": round(cpu["cfg1"]["best_s"], 4),
         "vs_baseline_cfg1": round(cpu["cfg1"]["best_s"]
                                   / tpu["cfg1"]["best_s"], 3),
+        # answer-sized D2H (PR 12): ORDER BY+LIMIT heavy shape (device
+        # top-k cut) and the percentile shape (device order-statistic
+        # finalize), each digest-gated against the CPU baseline above
+        "e2e_1m_topk_s": round(tpu["1m-topk"]["best_s"], 4),
+        "cpu_1m_topk_s": round(cpu["1m-topk"]["best_s"], 4),
+        "vs_baseline_1m_topk": round(cpu["1m-topk"]["best_s"]
+                                     / tpu["1m-topk"]["best_s"], 3),
+        "e2e_pctl_s": round(tpu["pctl"]["best_s"], 4),
+        "cpu_pctl_s": round(cpu["pctl"]["best_s"], 4),
+        "vs_baseline_pctl": round(cpu["pctl"]["best_s"]
+                                  / tpu["pctl"]["best_s"], 3),
+        "answer_sized_d2h": tpu.get("answer_sized_d2h", {}),
+        "phases_ms_heavy": tpu.get("phases_ms_heavy", {}),
         "bit_identical": True,
         "ingest_rows_per_sec": round(n_rows / max(t_ing, 1e-9), 1),
         "ingest_s": round(t_ing, 1),
@@ -932,7 +1005,9 @@ def smoke_phase() -> dict:
                              "smoke environment?)")
         recompile_report = {}
         for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
-                           ("cfg1", QUERY_CFG1)):
+                           ("cfg1", QUERY_CFG1),
+                           ("1m-topk", QUERY_1M_TOPK),
+                           ("pctl", QUERY_PCTL)):
             mark = _ca.AUDITOR.mark()
             run(qtext)
             cold = _ca.AUDITOR.since(mark)
@@ -1000,7 +1075,20 @@ def smoke_phase() -> dict:
                    ("observatory", {"OG_PIPELINE_DEPTH": "4",
                                     "OG_DEVUTIL_MS": "10"}),
                    ("observatory-barrier", {"OG_PIPELINE_DEPTH": "0",
-                                            "OG_DEVUTIL_MS": "10"})]
+                                            "OG_DEVUTIL_MS": "10"}),
+                   # answer-sized D2H gate (PR 12): the device ORDER
+                   # BY/LIMIT cut and the order-statistic finalize
+                   # (default on in every config above) vs their
+                   # byte-identical escape hatches — every cell of
+                   # every shape, streamed AND single-barrier
+                   ("topk-off", {"OG_PIPELINE_DEPTH": "4",
+                                 "OG_DEVICE_TOPK": "0"}),
+                   ("sketch-off", {"OG_PIPELINE_DEPTH": "4",
+                                   "OG_DEVICE_SKETCH": "0"}),
+                   ("topk-sketch-off-barrier",
+                    {"OG_PIPELINE_DEPTH": "0",
+                     "OG_DEVICE_TOPK": "0",
+                     "OG_DEVICE_SKETCH": "0"})]
         from opengemini_tpu.ops import hbm as _hbm
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires (originals
@@ -1015,7 +1103,9 @@ def smoke_phase() -> dict:
                 E.BLOCK_MAX_CELLS = 8
                 E.BLOCK_MIN_RATIO_PACKED = 0
             for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
-                               ("cfg1", QUERY_CFG1)):
+                               ("cfg1", QUERY_CFG1),
+                               ("1m-topk", QUERY_1M_TOPK),
+                               ("pctl", QUERY_PCTL)):
                 ref = None
                 for cname, env in configs:
                     for k, v in env.items():
@@ -1050,6 +1140,93 @@ def smoke_phase() -> dict:
         if n_samples == 0:
             raise SystemExit("SMOKE MISMATCH: utilization sampler "
                              "produced no samples at OG_DEVUTIL_MS=10")
+        # ------------------------------- answer-sized D2H gate (PR 12)
+        # the forced-lattice sweep left the tiny cell cap — restore
+        # the block route so the shrink measurement reflects it
+        E.BLOCK_MAX_CELLS = _blk_cells0
+        E.BLOCK_MIN_RATIO_PACKED = _blk_packed0
+        from opengemini_tpu.ops.devstats import DEVICE_STATS as _DSM
+        knobs.set_env("OG_DEVICE_TOPK", "0")
+        try:
+            run(QUERY_1M_TOPK)
+            tk_off_b = _DSM["last_query_d2h_bytes"]
+        finally:
+            knobs.del_env("OG_DEVICE_TOPK")
+        run(QUERY_1M_TOPK)
+        tk_on_b = _DSM["last_query_d2h_bytes"]
+        topk_shrink = tk_off_b / max(tk_on_b, 1)
+        if topk_shrink < 2.0:
+            raise SystemExit(
+                f"SMOKE MISMATCH: device topk cut shrank D2H only "
+                f"{topk_shrink:.2f}x ({tk_off_b}B -> {tk_on_b}B) — "
+                "the winner cut is not engaging on the heavy shape")
+        sk_g0 = _DSM["sketch_dev_grids"]
+        run(QUERY_PCTL)
+        sketch_grids = _DSM["sketch_dev_grids"] - sk_g0
+        if sketch_grids <= 0:
+            raise SystemExit(
+                "SMOKE MISMATCH: percentile shape did not route "
+                "through the device order-statistic finalize "
+                "(sketch_dev_grids unchanged)")
+        # f32 fast tier (OG_F32_TIER): NOT bit-identical by design —
+        # gated on tolerance against the f64 path, on the dense-window
+        # route (block cache off so dense groups actually form), and
+        # the Pallas kernel must actually have run
+        def _series_cells(res):
+            out = {}
+            for se in res.get("series", []):
+                key = json.dumps(se.get("tags", {}), sort_keys=True)
+                out[key] = se["values"]
+            return out
+        # block cache off so the scan DECODES; the 1m windows
+        # straddle segments, so pre-agg metadata can't answer and the
+        # decoded segments assemble into dense (S, P) groups — the
+        # dashboard-class route the tier serves
+        knobs.set_env("OG_DEVICE_CACHE_MB", "0")
+        f32_max_err = 0.0
+        f32_cells = 0
+        try:
+            run(QUERY_1M)
+            ref_f = _series_cells(last_res["res"])
+            knobs.set_env("OG_F32_TIER", "1")
+            f32_l0 = _DSM["f32_tier_launches"]
+            run(QUERY_1M)
+            got_f = _series_cells(last_res["res"])
+            f32_launches = _DSM["f32_tier_launches"] - f32_l0
+        finally:
+            knobs.del_env("OG_F32_TIER")
+            knobs.del_env("OG_DEVICE_CACHE_MB")
+        if f32_launches <= 0:
+            raise SystemExit("SMOKE MISMATCH: OG_F32_TIER=1 ran zero "
+                             "Pallas fast-tier launches on the dense "
+                             "1m shape")
+        if set(ref_f) != set(got_f):
+            raise SystemExit("SMOKE MISMATCH: f32 tier changed the "
+                             "series set")
+        for key, rrows in ref_f.items():
+            grows = got_f[key]
+            if len(rrows) != len(grows):
+                raise SystemExit(
+                    f"SMOKE MISMATCH: f32 tier changed row count for "
+                    f"{key}: {len(rrows)} != {len(grows)}")
+            for rr, gr in zip(rrows, grows):
+                if rr[0] != gr[0]:
+                    raise SystemExit("SMOKE MISMATCH: f32 tier moved "
+                                     f"a row time: {rr} vs {gr}")
+                for a, b in zip(rr[1:], gr[1:]):
+                    if (a is None) != (b is None):
+                        raise SystemExit(
+                            f"SMOKE MISMATCH: f32 tier changed cell "
+                            f"presence: {rr} vs {gr}")
+                    if a is None:
+                        continue
+                    err = abs(a - b) / max(abs(a), 1e-9)
+                    f32_max_err = max(f32_max_err, err)
+                    f32_cells += 1
+                    if err > 1e-4:
+                        raise SystemExit(
+                            f"SMOKE MISMATCH: f32 tier drifted "
+                            f"{err:.2e} > 1e-4 at {key} {rr} vs {gr}")
         # streaming-serializer golden gate: the chunked emit (with the
         # bounded-queue overlap thread) must be byte-identical to
         # json.dumps of the same document
@@ -1371,6 +1548,14 @@ def smoke_phase() -> dict:
             "crash_digest_ok": 1,
             "crash_orphans": 0,
             "crash_recovery_ms": round(crash_recovery_ms, 1),
+            # answer-sized D2H gate (PR 12)
+            "topk_d2h_shrink_x": round(topk_shrink, 1),
+            "topk_d2h_bytes_on": int(tk_on_b),
+            "topk_d2h_bytes_off": int(tk_off_b),
+            "sketch_dev_grids": int(sketch_grids),
+            "f32_tier_launches": int(f32_launches),
+            "f32_max_rel_err": float(f"{f32_max_err:.3e}"),
+            "f32_checked_cells": int(f32_cells),
             # compile-cache + transfer audit gates (PR 11)
             "recompile_budget_ok": 1,
             "recompile_budget": recompile_report,
@@ -1571,7 +1756,11 @@ def main():
     atexit.register(_cleanup)
 
     if args.phase == "query":
-        print(json.dumps(run_query_phase(args.data, args.runs)))
+        # CPU-baseline child: digests + best_s only — the answer-sized
+        # D2H measurement block and the EXPLAIN sweeps run once, in
+        # the in-process (device) run whose JSON actually reports them
+        print(json.dumps(run_query_phase(args.data, args.runs,
+                                         extras=False)))
         return
     if args.phase == "csquery":
         print(json.dumps(colstore_query_phase(args.data, args.runs)))

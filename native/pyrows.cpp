@@ -206,11 +206,82 @@ static PyObject* build_group_rows(PyObject*, PyObject* args) {
     return out;
 }
 
+// build_topk_rows(times, cols, masks, nwin, emit, G, k)
+//   Batched winner-row assembly for the device ORDER BY/LIMIT cut:
+//   every array is (G, k) C-contiguous (times int64; cols as
+//   build_rows; masks uint8, REQUIRED — 0 maps the cell to None);
+//   nwin (G,) int64 = winner rows per group, already in output row
+//   order (desc/offset/limit were applied on device); emit (G,)
+//   uint8 gates whether a group materializes at all. Returns a list
+//   of G entries — each a row list, or None for non-emitting groups.
+static PyObject* build_topk_rows(PyObject*, PyObject* args) {
+    PyObject *cols_obj, *masks_obj;
+    unsigned long long times_addr, nwin_addr, emit_addr;
+    Py_ssize_t G, k;
+    if (!PyArg_ParseTuple(args, "KOOKKnn", &times_addr, &cols_obj,
+                          &masks_obj, &nwin_addr, &emit_addr, &G, &k))
+        return nullptr;
+    const int64_t* times = reinterpret_cast<const int64_t*>(
+        static_cast<uintptr_t>(times_addr));
+    const int64_t* nwin = reinterpret_cast<const int64_t*>(
+        static_cast<uintptr_t>(nwin_addr));
+    const uint8_t* emit = reinterpret_cast<const uint8_t*>(
+        static_cast<uintptr_t>(emit_addr));
+    const void* col_ptr[64];
+    const uint8_t* mask_ptr[64];
+    int col_is_int[64];
+    Py_ssize_t n_out = 0;
+    if (parse_cols(cols_obj, masks_obj, col_ptr, mask_ptr, col_is_int,
+                   &n_out) < 0)
+        return nullptr;
+    PyObject* out = PyList_New(G);
+    if (!out) return nullptr;
+    for (Py_ssize_t g = 0; g < G; g++) {
+        if (!emit[g]) {
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(out, g, Py_None);
+            continue;
+        }
+        Py_ssize_t n = nwin[g];
+        if (n > k) n = k;
+        PyObject* rows = PyList_New(n);
+        if (!rows) { Py_DECREF(out); return nullptr; }
+        PyList_SET_ITEM(out, g, rows);
+        for (Py_ssize_t j = 0; j < n; j++) {
+            Py_ssize_t cell = g * k + j;
+            PyObject* row = PyList_New(1 + n_out);
+            if (!row) { Py_DECREF(out); return nullptr; }
+            PyList_SET_ITEM(rows, j, row);
+            PyObject* t = PyLong_FromLongLong(times[cell]);
+            if (!t) { Py_DECREF(out); return nullptr; }
+            PyList_SET_ITEM(row, 0, t);
+            for (Py_ssize_t i = 0; i < n_out; i++) {
+                PyObject* v;
+                if (mask_ptr[i] && !mask_ptr[i][cell]) {
+                    Py_INCREF(Py_None);
+                    v = Py_None;
+                } else if (col_is_int[i]) {
+                    v = PyLong_FromLongLong(
+                        ((const int64_t*)col_ptr[i])[cell]);
+                } else {
+                    v = PyFloat_FromDouble(
+                        ((const double*)col_ptr[i])[cell]);
+                }
+                if (!v) { Py_DECREF(out); return nullptr; }
+                PyList_SET_ITEM(row, 1 + i, v);
+            }
+        }
+    }
+    return out;
+}
+
 static PyMethodDef Methods[] = {
     {"build_rows", build_rows, METH_VARARGS,
      "Assemble [time, v...] row lists from raw column buffers."},
     {"build_group_rows", build_group_rows, METH_VARARGS,
      "Assemble one group's [time, v...] rows with keep/desc/slicing."},
+    {"build_topk_rows", build_topk_rows, METH_VARARGS,
+     "Assemble winner rows for the device ORDER BY/LIMIT cut."},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "ogpyrows",

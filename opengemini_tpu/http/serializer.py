@@ -64,6 +64,8 @@ def _iter_value(o) -> Iterator[bytes]:
             yield head + json.dumps(k).encode() + b": "
             if isinstance(v, dict) or _is_stream_list(k, v):
                 yield from _iter_value(v)
+            elif k == "values" and isinstance(v, list):
+                yield from _iter_rows(v)
             else:
                 yield json.dumps(v).encode()
         yield b"}"
@@ -82,6 +84,32 @@ def _iter_value(o) -> Iterator[bytes]:
         yield b"]"
         return
     yield json.dumps(o).encode()
+
+
+_ROWS_CHUNK = 4096
+
+
+def _iter_rows(rows: list) -> Iterator[bytes]:
+    """Chunked emit of one entry's row list: json.dumps per ~4K-row
+    slice, concatenation byte-identical to json.dumps(rows) (slice
+    bodies join with the same ", " separator the C encoder uses). A
+    single-series heavy result used to encode as ONE dumps piece — at
+    11.5M rows that is a ~380MB resident string, the exact whole-
+    document problem the streaming envelope was built to kill, one
+    level down. Per-row dumps calls would drown the pipe instead;
+    slices keep the C encoder's throughput."""
+    if len(rows) <= _ROWS_CHUNK:
+        yield json.dumps(rows).encode()
+        return
+    yield b"["
+    first = True
+    for lo in range(0, len(rows), _ROWS_CHUNK):
+        piece = json.dumps(rows[lo:lo + _ROWS_CHUNK]).encode()
+        if not first:
+            yield b", "
+        first = False
+        yield piece[1:-1]
+    yield b"]"
 
 
 def _is_stream_list(key: str, v) -> bool:

@@ -138,10 +138,21 @@ def traced_functions(tree: ast.AST) -> dict[str, TracedFn]:
                     by_name[nm], root=True,
                     static=_static_params(by_name[nm], node)))
         elif fd in _PALLAS_CALL:
-            nm = dotted(node.args[0])
+            arg0 = node.args[0]
+            nm = dotted(arg0)
+            static: set = set()
+            if not nm and isinstance(arg0, ast.Call) and \
+                    dotted(arg0.func) in ("functools.partial",
+                                          "partial") and arg0.args:
+                # pl.pallas_call(functools.partial(kernel, P=...)):
+                # the bound kwargs are trace-time Python values —
+                # static, like jit static_argnames
+                nm = dotted(arg0.args[0])
+                static = {kw.arg for kw in arg0.keywords
+                          if kw.arg is not None}
             if nm in by_name:
                 roots.append(TracedFn(by_name[nm], root=True,
-                                      pallas=True))
+                                      pallas=True, static=static))
     if not roots:
         return {}
     traced: dict[str, TracedFn] = {}

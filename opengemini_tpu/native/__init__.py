@@ -926,6 +926,41 @@ def build_group_rows(times: np.ndarray, cols: list, masks: list,
                               int(limit))
 
 
+def build_topk_rows(times: np.ndarray, cols: list, oks: list,
+                    nwin: np.ndarray, emit: np.ndarray):
+    """C-speed batched winner-row assembly for the device ORDER BY/
+    LIMIT cut: times (G, k) int64, cols (G, k) float64/int64, oks
+    parallel (G, k) bool (False → None cell), nwin (G,) winner counts
+    in output row order, emit (G,) group gate. Returns a list of G
+    entries (row list or None), or None when the extension is
+    unavailable (caller uses the Python fallback)."""
+    m = _load_pyrows()
+    if m is None or len(cols) > 64 \
+            or not hasattr(m, "build_topk_rows"):
+        return None
+    G, k = times.shape
+    t = np.ascontiguousarray(times, dtype=np.int64)
+    nw = np.ascontiguousarray(nwin, dtype=np.int64)
+    em = np.ascontiguousarray(emit, dtype=np.uint8)
+    prep_c, prep_m, alive = [], [], [t, nw, em]
+    for c, mk in zip(cols, oks):
+        if c.dtype == np.int64:
+            kind = 1
+        elif c.dtype == np.float64:
+            kind = 0
+        else:
+            return None
+        c = np.ascontiguousarray(c)
+        alive.append(c)
+        prep_c.append((c.ctypes.data, kind))
+        mk = np.ascontiguousarray(mk, dtype=np.uint8)
+        alive.append(mk)
+        prep_m.append(mk.ctypes.data)
+    return m.build_topk_rows(t.ctypes.data, tuple(prep_c),
+                             tuple(prep_m), nw.ctypes.data,
+                             em.ctypes.data, G, k)
+
+
 # ------------------------------------------------------- series sid map
 
 def _bind_map(lib) -> None:

@@ -1856,3 +1856,353 @@ def gather_exact_values(slabs: list[BlockStack], reader,
         m = b == blk
         out[sel[m]] = cv.values[off[m]]
     return out, has
+
+
+# ----------------------- device order-statistic (sketch) finalize
+
+def device_sketch_on() -> bool:
+    """Gate for the device order-statistic finalize of raw-slice
+    aggregates (percentile/median/mode) over HBM-resident sorted-
+    sample planes (OG_DEVICE_SKETCH, default on). Selection-based
+    finalizers return INPUT values — backend-independent — but the
+    even-length median averages the two midpoints in one IEEE f64
+    add+halve, which drifts on f32-pair-emulated backends: the gate
+    rides the same real-f64 allowlist as the finalize epilogue
+    (OG_DEVICE_FINALIZE=force overrides it for verified hardware),
+    and OG_DEVICE_FINALIZE=0 switches this path off together with the
+    epilogue — ONE escape hatch restores the whole legacy transport."""
+    v = knobs.get_raw("OG_DEVICE_FINALIZE")
+    if v == "0" or not bool(knobs.get("OG_DEVICE_SKETCH")):
+        return False
+    return True if v == "force" else _backend_real_f64()
+
+
+def device_topk_on() -> bool:
+    """Gate for the device ORDER BY/LIMIT cut over finalized answer
+    planes (OG_DEVICE_TOPK, default on; 0 = byte-identical full-grid
+    pull + host slicing). Pure selection over planes the finalize
+    epilogue already produced, so it needs no extra backend gate —
+    it can only engage where device_finalize_on() already did."""
+    return bool(knobs.get("OG_DEVICE_TOPK"))
+
+
+def _kernel_cellsort(num_segments: int, N: int):
+    """jit: flat scan rows → cell-sorted sample planes. Rows that are
+    invalid or outside the cell grid collapse into the trash segment
+    (sorted last). The (sv, sid) pair IS the device-resident 'sketch'
+    state: every order-statistic finalizer below is a gather over it,
+    and the lexsort matches np.lexsort bit for bit (stable, NaN-last,
+    ±0.0 order-preserving) so host/device selections cannot skew."""
+    key = ("cs", num_segments, N)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax.numpy as jnp
+
+    ns = num_segments
+
+    def _f(vals, valid, seg):
+        sid = jnp.where(valid & (seg >= 0) & (seg < ns), seg,
+                        ns).astype(jnp.int32)
+        order = jnp.lexsort((vals, sid))
+        return vals[order], sid[order]
+
+    _f = _named_jit(_f, key)
+    _JITTED[key] = _f
+    return _f
+
+
+def sketch_sorted_planes(vals, valid, seg, num_segments: int,
+                         cache_key: tuple | None = None):
+    """Device-resident sorted-sample planes for one field's scan rows
+    — (sv_dev, sid_dev), cell-sorted. Content lives in the HBM sketch
+    tier (devicecache.sketch_cache, ledger tier "sketch", evicted by
+    the OOM relief ladder before the block slabs) keyed by the scan
+    plan identity, so a warm dashboard repeat skips the upload AND the
+    sort. The upload books H2D site "sketch" (oglint R10)."""
+    import jax
+
+    from . import compileaudit, devstats
+    cache = None
+    if cache_key is not None and devicecache.sketch_capacity_bytes() > 0:
+        cache = devicecache.sketch_cache()
+        got = cache.get(("sksort",) + cache_key)
+        if got is not None:
+            devstats.bump("sketch_plane_hits")
+            return got
+    failpoint.inject("blockagg.sketch_fill")
+    v = np.ascontiguousarray(vals, dtype=np.float64)
+    m = np.ascontiguousarray(valid, dtype=np.bool_)
+    s = np.ascontiguousarray(seg, dtype=np.int64)
+    dv = jax.device_put(v)
+    dm = jax.device_put(m)
+    ds = jax.device_put(s)
+    compileaudit.record_h2d("sketch",
+                            int(dv.nbytes + dm.nbytes + ds.nbytes))
+    fn = _kernel_cellsort(num_segments, len(v))
+    sv, sid = fn(dv, dm, ds)
+    devstats.bump("kernel_launches")
+    devstats.bump("sketch_dev_rows", len(v))
+    if cache is not None:
+        cache.put_sized(("sksort",) + cache_key, (sv, sid),
+                        int(sv.nbytes + sid.nbytes))
+    return sv, sid
+
+
+def _kernel_rawfin(num_segments: int, n_pct: int, with_median: bool,
+                   with_mode: bool, N: int):
+    """jit order-statistic finalize over cell-sorted planes → stacked
+    (n_ops, S) answer grids (NaN = empty cell). Mirrors the host
+    finalize_raw_agg formulas operand for operand:
+      percentile: value at floor(len·p/100 + 0.5) − 1, clamped;
+      median: midpoint value (odd) or the IEEE mean of the two
+        middles (even — why this path needs real f64);
+      mode: smallest value among the equal-value runs reaching the
+        cell's max run length (the host 'first run' rule — runs are
+        value-sorted, so first ≡ smallest)."""
+    key = ("rf", num_segments, n_pct, with_median, with_mode, N)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    ns = num_segments
+
+    def _f(sv, sid, ps):
+        starts = jnp.searchsorted(sid, jnp.arange(ns, dtype=sid.dtype),
+                                  side="left")
+        ends = jnp.searchsorted(sid, jnp.arange(ns, dtype=sid.dtype),
+                                side="right")
+        lens = (ends - starts).astype(jnp.int64)
+        has = lens > 0
+        grids = []
+
+        def at(idx):
+            return sv[jnp.clip(starts + idx, 0, N - 1)]
+
+        for j in range(n_pct):
+            idx = jnp.floor(lens.astype(jnp.float64) * ps[j] / 100.0
+                            + 0.5).astype(jnp.int64) - 1
+            idx = jnp.clip(idx, 0, jnp.maximum(lens - 1, 0))
+            grids.append(jnp.where(has, at(idx), jnp.nan))
+        if with_median:
+            hi = at(lens // 2)
+            lo = at(jnp.maximum(lens // 2 - 1, 0))
+            med = jnp.where(lens % 2 == 1, hi, (lo + hi) / 2.0)
+            grids.append(jnp.where(has, med, jnp.nan))
+        if with_mode:
+            pos = jnp.arange(N, dtype=jnp.int64)
+            newrun = jnp.concatenate([
+                jnp.ones(1, dtype=bool),
+                (sv[1:] != sv[:-1]) | (sid[1:] != sid[:-1])])
+            rs = jax.lax.cummax(jnp.where(newrun, pos, 0))
+            nxt = jnp.concatenate([
+                jnp.where(newrun, pos, N)[1:],
+                jnp.full(1, N, dtype=jnp.int64)])
+            ne = jax.lax.cummin(nxt[::-1])[::-1]
+            rcnt = ne - rs
+            maxc = jax.ops.segment_max(rcnt, sid, ns + 1,
+                                       indices_are_sorted=True)
+            win = rcnt == maxc[sid]
+            winner = jax.ops.segment_min(
+                jnp.where(win, sv, jnp.inf), sid, ns + 1,
+                indices_are_sorted=True)[:ns]
+            grids.append(jnp.where(has, winner, jnp.nan))
+        return jnp.stack(grids)
+
+    _f = _named_jit(_f, key)
+    _JITTED[key] = _f
+    return _f
+
+
+def rawfin_grids(sv_dev, sid_dev, num_segments: int,
+                 pcts: list, with_median: bool, with_mode: bool):
+    """Launch the order-statistic finalize over resident sorted-sample
+    planes. Returns the DEVICE (n_ops, S) grid stack (answer-sized —
+    the caller pulls it batched); row order is pcts..., median?,
+    mode?. Percentile args travel as a traced vector so one compiled
+    kernel serves every p."""
+    from . import devstats
+    ps = np.asarray(pcts if pcts else [0.0], dtype=np.float64)
+    fn = _kernel_rawfin(num_segments, len(pcts), with_median,
+                        with_mode, int(sv_dev.shape[0]))
+    out = fn(sv_dev, sid_dev, ps)
+    devstats.bump("kernel_launches")
+    devstats.bump("sketch_dev_grids")
+    return out
+
+
+# ------------------------------------ device ORDER BY / LIMIT cut
+
+def _unbits_of(bits, S: int):
+    """Traced inverse of _bits_of → bool (S,)."""
+    import jax.numpy as jnp
+    lanes = ((bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :])
+             & 1)
+    return lanes.reshape(-1)[:S].astype(bool)
+
+
+def _kernel_topk(G: int, W: int, kk: int, desc: bool, offset: int,
+                 null_fill: bool, need_count: bool, has_flag: bool,
+                 n_f64: int):
+    """jit segmented top-k over a finalized answer grid: per group,
+    select the first ``kk`` ROW-EMITTING windows in output order
+    (ascending, or descending under ORDER BY time DESC) after
+    skipping ``offset`` — exactly the native build_group_rows walk —
+    and compact every shipped plane to the (G, kk) winner cells.
+
+    fill=none ranks only PRESENT windows (count > 0); fill=null emits
+    a row per window, so the cut is a static slice with per-winner
+    presence shipped for the None cells. The transport is winner-
+    sized AND winner-shaped: window ids ship as uint16 when W fits,
+    presence/flag/group-has masks bit-pack 32 cells per word, and the
+    winner mask itself is never shipped (winners are a rank prefix —
+    row j of group g is live iff j < nwin[g])."""
+    key = ("tk", G, W, kk, desc, offset, null_fill, need_count,
+           has_flag, n_f64)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    import jax.numpy as jnp
+
+    S = G * W
+    BIG = W + kk + 2
+    wdt = jnp.uint16 if W <= 0xFFFF else jnp.int32
+
+    def _f(u32, pres_bits, flag_bits, f64):
+        if need_count:
+            cnt = u32[0].astype(jnp.int64)
+            present = (cnt > 0).reshape(G, W)
+        else:
+            present = _unbits_of(pres_bits, S).reshape(G, W)
+        emit = jnp.ones((G, W), dtype=bool) if null_fill else present
+        if desc:
+            # suffix count: the highest emitting window ranks 1
+            rank = jnp.cumsum(emit[:, ::-1], axis=1)[:, ::-1]
+            rank = jnp.where(emit, rank, 0)
+        else:
+            rank = jnp.where(emit, jnp.cumsum(emit, axis=1), 0)
+        keyv = jnp.where(emit & (rank > offset)
+                         & (rank <= offset + kk),
+                         rank - offset, BIG).astype(jnp.int32)
+        order = jnp.argsort(keyv, axis=1, stable=True)[:, :kk]
+        kw = jnp.take_along_axis(keyv, order, axis=1)
+        win = kw <= kk                       # rank prefix per group
+        widx = jnp.where(win, order, 0).astype(wdt)
+        safe = jnp.maximum(order, 0)
+        nwin = win.sum(axis=1).astype(jnp.int32)
+        wpres = jnp.take_along_axis(present, safe, axis=1) & win
+        outs = [widx, nwin]
+        if null_fill:
+            # fill=null emits rows for empty windows, so winner
+            # presence and the group-has-any-data gate must ship
+            # (fill=none winners are present by construction)
+            outs.append(_bits_of(wpres.reshape(-1), G * kk))
+            outs.append(_bits_of(present.any(axis=1), G))
+        if need_count:
+            outs.append(jnp.where(
+                wpres, jnp.take_along_axis(cnt.reshape(G, W), safe,
+                                           axis=1), 0)
+                .astype(jnp.uint32))
+        if has_flag:
+            flags = _unbits_of(flag_bits, S).reshape(G, W)
+            wf = jnp.take_along_axis(flags, safe, axis=1) & wpres
+            outs.append(_bits_of(wf.reshape(-1), G * kk))
+        if n_f64:
+            fw = [jnp.take_along_axis(f64[i].reshape(G, W), safe,
+                                      axis=1) for i in range(n_f64)]
+            outs.append(jnp.stack(fw))
+        return tuple(outs)
+
+    _f = _named_jit(_f, key)
+    _JITTED[key] = _f
+    return _f
+
+
+def topk_cut(fin_arrs, G: int, W: int, kk: int, desc: bool,
+             offset: int, null_fill: bool):
+    """Run the segmented top-k kernel over a finalize-epilogue
+    transport tuple (u32, pres_bits, flag_bits, f64 — finalize_grid's
+    device outputs). Returns the device winner tuple for _emit; the
+    host inverse is unpack_topk."""
+    from . import devstats
+    u32, pres, flag, f64 = fin_arrs
+    need_count = u32 is not None
+    has_flag = flag is not None
+    n_f64 = 0 if f64 is None else int(f64.shape[0])
+    fn = _kernel_topk(G, W, kk, desc, offset, null_fill, need_count,
+                      has_flag, n_f64)
+    devstats.bump("kernel_launches")
+    devstats.bump("topk_grids")
+    return fn(u32, pres, flag, f64)
+
+
+def unpack_topk(arrs, planes_dev, K: int, k0: int, E: int,
+                dev_mean: bool, ship_sum: bool, need_count: bool,
+                G: int, W: int, kk: int,
+                null_fill: bool) -> dict:
+    """Pulled winner tuple → the topk bo the executor threads into the
+    partial: widx/nwin (winners are the rank prefix j < nwin[g]) plus
+    per-op winner planes, presence expanded from the bit transport.
+    Flagged winner cells (finalize hazard ∪ limb residue) repair here
+    exactly like unpack_finalized — ONE sparse gather of the
+    still-resident pre-finalize rows, restricted to winners (the only
+    cells that will ever be read)."""
+    import time as _time
+    arrs = [None if a is None else np.asarray(a) for a in arrs]
+    i = 0
+    widx = arrs[i].astype(np.int64); i += 1
+    nwin = arrs[i].astype(np.int64); i += 1
+    win = (np.arange(kk)[None, :] < nwin[:, None])
+    if null_fill:
+        wpres = expand_bits(arrs[i], G * kk).reshape(G, kk) & win
+        i += 1
+        group_has = expand_bits(arrs[i], G)[:G]
+        i += 1
+    else:
+        wpres = win
+        group_has = nwin > 0
+    bo: dict = {"widx": widx, "nwin": nwin, "group_has": group_has,
+                "pres": wpres}
+    wflag = None
+    if need_count:
+        bo["count"] = arrs[i].astype(np.int64); i += 1
+    sum_p = mean_p = None
+    if ship_sum or dev_mean:
+        # a sum-bearing recipe always ships the hazard/residue flag
+        # bits and then the f64 answer planes (finalize kernel layout)
+        wflag = expand_bits(arrs[i], G * kk).reshape(G, kk)
+        i += 1
+        f64w = arrs[i]
+        j = 0
+        if ship_sum:
+            sum_p = np.array(f64w[j], dtype=np.float64); j += 1
+        if dev_mean:
+            mean_p = np.array(f64w[j], dtype=np.float64)
+    if wflag is not None:
+        hit = np.nonzero(win & wflag)
+        if len(hit[0]):
+            from . import compileaudit, devstats
+            t0 = _time.perf_counter_ns()
+            cells = (hit[0] * W + widx[hit]).astype(np.int64)
+            # sparse winner repair — manifest-booked below, exempt
+            # from the R1 transport rule like the finalize repair
+            sub = np.asarray(planes_dev[:, cells])  # oglint: disable=R103
+            compileaudit.record_d2h("repair", int(sub.nbytes))
+            bo["_repair_nbytes"] = int(sub.nbytes)
+            full = np.zeros((len(cells), exactsum.K_LIMBS))
+            full[:, k0:k0 + K] = sub[1:1 + K].T
+            sums = exactsum.finalize_exact(full, E)
+            if sum_p is not None:
+                sum_p[hit] = sums
+            if mean_p is not None:
+                cnt_f = sub[0].astype(np.int64)
+                mean_p[hit] = sums / np.maximum(cnt_f, 1)
+            devstats.bump_phase("device_topk",
+                                _time.perf_counter_ns() - t0)
+    if sum_p is not None:
+        bo["sum"] = sum_p
+    if mean_p is not None:
+        bo["mean"] = mean_p
+    return {"topk": bo}

@@ -65,9 +65,9 @@ __all__ = ["CompileAuditor", "AUDITOR", "ensure_installed",
 # byte names its mover, so the set is CLOSED (an unknown site raises;
 # add it here AND at the call site in one reviewed change).
 H2D_SITES = ("slab", "limbs", "planes", "gids", "latcells", "scalars",
-             "pplan", "decode", "mesh", "other")
+             "pplan", "decode", "mesh", "sketch", "other")
 D2H_SITES = ("stream", "batch", "segagg", "finalize", "repair",
-             "other")
+             "topk", "other")
 
 XFER_STATS: dict = register_counters("xfer", {
     **{f"h2d_{s}_bytes": 0 for s in H2D_SITES},

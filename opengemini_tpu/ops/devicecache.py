@@ -194,6 +194,8 @@ class DeviceBlockCache:
 
 _CACHE: DeviceBlockCache | None = None
 _HOST_CACHE: DeviceBlockCache | None = None
+_SKETCH_CACHE: DeviceBlockCache | None = None
+_SKETCH_OWNER: DeviceBlockCache | None = None
 
 
 def capacity_bytes() -> int:
@@ -254,6 +256,36 @@ def host_cache() -> DeviceBlockCache:
         _HOST_CACHE = DeviceBlockCache(host_capacity_bytes(),
                                        tier="host_cache")
     return _HOST_CACHE
+
+
+def sketch_capacity_bytes() -> int:
+    """HBM budget of the sorted-sample sketch tier (device-resident
+    cell-sorted value/cell-id planes for the order-statistic finalize,
+    ops/blockagg.sketch_sorted_planes). Its own budget — sharing the
+    block-stack budget would let one percentile dashboard evict the
+    resident segment stacks it reads next to. OG_DEVICE_CACHE_MB=0
+    stays the global kill switch (same rule as the host pin tier)."""
+    if not enabled():
+        return 0
+    return knobs.get("OG_SKETCH_HBM_MB") * _MB
+
+
+def sketch_cache() -> DeviceBlockCache:
+    """Singleton for the HBM sketch tier (ledger tier \"sketch\" —
+    evictable by the OOM relief ladder like the block/decoded tiers,
+    ops/devicefault.hbm_pressure_relief). Lifetime is pinned to the
+    block-cache singleton: test isolation resets ``_CACHE`` (and the
+    ledger) without knowing about this tier, so a sketch cache that
+    outlived its sibling would hold entries the zeroed ledger no
+    longer mirrors and break the exact cross_check forever after."""
+    global _SKETCH_CACHE, _SKETCH_OWNER
+    owner = global_cache() if enabled() else None
+    if _SKETCH_CACHE is None or _SKETCH_OWNER is not owner:
+        _rebind_tier("sketch")
+        _SKETCH_CACHE = DeviceBlockCache(sketch_capacity_bytes(),
+                                         tier="sketch")
+        _SKETCH_OWNER = owner
+    return _SKETCH_CACHE
 
 
 # ------------------------------------------------ decoded-plane tier

@@ -412,7 +412,12 @@ def hbm_pressure_relief(route: str, nbytes_hint: int = 0) -> int:
             from . import devicecache as _dc
             failpoint.inject("devicecache.evict")
             if _dc.enabled():
-                freed = _dc.global_cache().evict_bytes(
+                # sketch tier first: sorted-sample planes are pure
+                # derived state (one cellsort kernel rebuilds them),
+                # while block slabs cost a full decode + H2D to restake
+                freed = _dc.sketch_cache().evict_bytes(
+                    None, reason="oom_relief")
+                freed += _dc.global_cache().evict_bytes(
                     None, reason="oom_relief")
         except Exception as e:
             cls = classify(e)

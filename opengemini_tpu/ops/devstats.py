@@ -38,7 +38,20 @@ DEVICE_STATS: dict = register_counters("device", {
     "d2h_bytes_legacy": 0,
     "d2h_bytes_finalized": 0,
     "d2h_bytes_lattice": 0,
+    "d2h_bytes_topk": 0,
     "pull_bytes_saved": 0,
+    # answer-sized D2H (PR 12): device order-statistic finalize of
+    # percentile/median/mode (the acceptance counter proving the
+    # route), the HBM sorted-sample tier's reuse, the device ORDER
+    # BY/LIMIT cut, and the opt-in f32 fast tier
+    "sketch_dev_grids": 0,     # (field, query) grids finalized on dev
+    "sketch_dev_rows": 0,      # rows the cellsort kernel consumed
+    "sketch_plane_hits": 0,    # warm queries served from the HBM tier
+    "sketch_host_fallbacks": 0,  # breaker/fault heals to host slices
+    "topk_grids": 0,           # finalized grids cut to winners on dev
+    "topk_cells_pulled": 0,    # k x groups winner cells that crossed
+    "f32_tier_launches": 0,    # pallas dense-window fast-tier calls
+    "f32_tier_rows": 0,
     # gauges (last completed query, not cumulative): the numbers an
     # operator needs to judge whether the pull or the kernel is the
     # current wall without attaching EXPLAIN ANALYZE
@@ -62,8 +75,13 @@ QUERY_PHASE_NS: dict = register_counters("query_phase", {
     "device_agg_ns": 0,
     "device_pull_ns": 0,
     # finalize epilogue: the on-device answer-plane conversion launches
-    # plus any host-side sparse repairs (OG_DEVICE_FINALIZE)
+    # plus any host-side sparse repairs (OG_DEVICE_FINALIZE) — the
+    # order-statistic (percentile/median/mode) finalize rides this
+    # phase too
     "device_finalize_ns": 0,
+    # device ORDER BY/LIMIT cut (OG_DEVICE_TOPK): the segmented top-k
+    # kernel over finalized planes + the winner-cell unpack/repair
+    "device_topk_ns": 0,
     "grid_fold_ns": 0,
     # merge is NESTED inside finalize (exchange-merge of partials);
     # serialize is the HTTP-layer streaming JSON/CSV emit, outside the

@@ -57,7 +57,7 @@ __all__ = ["HBMLedger", "LEDGER", "account", "release", "pressure",
            "reconcile", "cross_check", "UtilizationSampler", "sampler",
            "chrome_counter_events", "collector", "HBM_STATS"]
 
-TIERS = ("device_cache", "host_cache", "pipeline")
+TIERS = ("device_cache", "host_cache", "pipeline", "sketch")
 
 # event counters + collector-refreshed gauges (utils.stats registry —
 # oglint R6 covers every bump key; the per-tier live numbers live in
@@ -259,7 +259,8 @@ def rebase_cache_tiers() -> None:
     singletons are created once and mirrored move for move."""
     from . import devicecache as _dc
     for tier, cache in (("device_cache", _dc.global_cache()),
-                        ("host_cache", _dc.host_cache())):
+                        ("host_cache", _dc.host_cache()),
+                        ("sketch", _dc.sketch_cache())):
         st = cache.stats()
         with LEDGER._lock:
             t = LEDGER._tier(tier)
@@ -277,7 +278,8 @@ def cross_check() -> dict:
     snap = LEDGER.snapshot(events=False)
     out: dict = {}
     for tier, cache in (("device_cache", _dc.global_cache()),
-                        ("host_cache", _dc.host_cache())):
+                        ("host_cache", _dc.host_cache()),
+                        ("sketch", _dc.sketch_cache())):
         src = cache.stats()["bytes"]
         led = snap["tiers"][tier]["bytes"]
         out[tier] = {"ledger": led, "source": src,

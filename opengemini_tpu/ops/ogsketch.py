@@ -424,3 +424,42 @@ def batch_percentile(states: list, q: float) -> np.ndarray:
                         np.where(rank >= aw - wlasth, high, mid))
     out[np.asarray(live, dtype=np.int64)] = vals
     return out
+
+
+def batch_of_states(sv: np.ndarray, starts: np.ndarray,
+                    lens: np.ndarray,
+                    clusters: float) -> list[dict]:
+    """``OGSketch.of(cell_values).to_state()`` over many cells at
+    once, given one NaN-free value stream sorted by (cell, value):
+    cell i's values are ``sv[starts[i]:starts[i]+lens[i]]``.
+
+    Bit-identical to the per-cell object path by construction: a cell
+    whose count stays under ``sketch_size`` never runs the greedy
+    merge — ``_compress`` stable-sorts the buffer (the identity on a
+    pre-sorted stream, and equal values are interchangeable) and
+    keeps it verbatim, so its state IS the sorted values with unit
+    weights. Bigger cells fall back to the scalar object on the
+    sorted slice, which ``_compress``'s own stable argsort makes
+    order-equivalent to the row-order insert. Replaces the
+    G·W-object construction loop that dominated high-cardinality
+    ``percentile_approx`` partials (one OGSketch + compress per cell
+    at 11.5M cells)."""
+    c_eff = max(float(clusters), 1.0)
+    sk_size = int(2 * math.ceil(c_eff))
+    out: list[dict] = []
+    svl = sv.tolist()
+    for st, ln in zip(starts.tolist(), lens.tolist()):
+        if ln == 0:
+            out.append({"c": c_eff, "means": [], "weights": [],
+                        "all_weight": 0.0, "min": math.inf,
+                        "max": -math.inf})
+        elif ln < sk_size:
+            vals = svl[st:st + ln]
+            out.append({"c": c_eff, "means": vals,
+                        "weights": [1.0] * ln,
+                        "all_weight": float(ln),
+                        "min": vals[0], "max": vals[-1]})
+        else:
+            out.append(OGSketch.of(sv[st:st + ln],
+                                   clusters).to_state())
+    return out

@@ -31,22 +31,34 @@ TILE_S = 8
 LANES = 128
 
 
-def _rowagg_kernel(x_ref, sum_ref, min_ref, max_ref):
+def _rowagg_kernel(x_ref, sum_ref, min_ref, max_ref, *, P_real):
     # outputs are lane-broadcast (TILE_S, 128) blocks: Mosaic requires
     # full-lane output tiles, so the per-row scalar repeats across lanes
-    # and the wrapper slices lane 0
+    # and the wrapper slices lane 0. Columns >= P_real are lane padding
+    # (the caller pads P up to the 128-lane width): each reduction
+    # masks them with its identity, so any real P is served without a
+    # per-P shape-class explosion beyond the padded tiers
     x = x_ref[...]
     shape = (TILE_S, LANES)
+    P_pad = x.shape[1]
+    if P_real != P_pad:
+        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        live = lane < P_real
+        xs = jnp.where(live, x, jnp.float32(0.0))
+        xmn = jnp.where(live, x, jnp.float32(jnp.inf))
+        xmx = jnp.where(live, x, jnp.float32(-jnp.inf))
+    else:
+        xs = xmn = xmx = x
     sum_ref[...] = jnp.broadcast_to(
-        jnp.sum(x, axis=1, keepdims=True), shape)
+        jnp.sum(xs, axis=1, keepdims=True), shape)
     min_ref[...] = jnp.broadcast_to(
-        jnp.min(x, axis=1, keepdims=True), shape)
+        jnp.min(xmn, axis=1, keepdims=True), shape)
     max_ref[...] = jnp.broadcast_to(
-        jnp.max(x, axis=1, keepdims=True), shape)
+        jnp.max(xmx, axis=1, keepdims=True), shape)
 
 
 @functools.lru_cache(maxsize=None)
-def _rowagg_fn(S: int, P: int, interpret: bool):
+def _rowagg_fn(S: int, P: int, P_real: int, interpret: bool):
     """Memoized pallas_call callable per (S, P) shape class. A fresh
     ``pl.pallas_call(...)`` per invocation re-traces AND re-compiles
     its wrapper on EVERY call (the compile auditor flagged the warm
@@ -56,7 +68,7 @@ def _rowagg_fn(S: int, P: int, interpret: bool):
     multiples and P to power-of-two segment tiers."""
     out = jax.ShapeDtypeStruct((S, LANES), jnp.float32)
     return pl.pallas_call(
-        _rowagg_kernel,
+        functools.partial(_rowagg_kernel, P_real=P_real),
         grid=(S // TILE_S,),
         in_specs=[pl.BlockSpec((TILE_S, P), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((TILE_S, LANES),
@@ -66,7 +78,7 @@ def _rowagg_fn(S: int, P: int, interpret: bool):
     )
 
 
-def _rowagg_call(x, interpret: bool):
+def _rowagg_call(x, P_real: int, interpret: bool):
     # x64 must be OFF around the pallas trace: the session enables
     # jax_enable_x64 globally (ops/__init__) and Mosaic lowering of the
     # x64-typed grid indices crashes the remote compile helper. The
@@ -75,26 +87,31 @@ def _rowagg_call(x, interpret: bool):
     # was removed in newer jax releases; the experimental home remains
     S, P = x.shape
     with enable_x64(False):
-        return _rowagg_fn(S, P, interpret)(x)
+        return _rowagg_fn(S, P, P_real, interpret)(x)
 
 
 def pallas_dense_rowagg(values,
                         interpret: bool | None = None
                         ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(S, P) float32 block → per-row (sum, min, max), each (S,).
-    interpret=None auto-selects: real kernel on TPU, interpreter
-    elsewhere."""
+    P pads internally to the 128-lane width (masked with reduction
+    identities), so any dense-window P is served. interpret=None
+    auto-selects: real kernel on TPU, interpreter elsewhere."""
     x = np.asarray(values, dtype=np.float32)
     S, P = x.shape
-    if P % 128 != 0:
-        raise ValueError(f"P must be a multiple of 128, got {P}")
+    lane_pad = (-P) % 128
+    if lane_pad:
+        # pad the lane axis up to the 128-wide tile; the kernel masks
+        # the tail with each reduction's identity
+        x = np.concatenate(
+            [x, np.zeros((S, lane_pad), dtype=x.dtype)], axis=1)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     pad = (-S) % TILE_S
     if pad:
         x = np.concatenate(
-            [x, np.zeros((pad, P), dtype=x.dtype)], axis=0)
-    s, mn, mx = _rowagg_call(x, interpret)
+            [x, np.zeros((pad, P + lane_pad), dtype=x.dtype)], axis=0)
+    s, mn, mx = _rowagg_call(x, P, interpret)
     return s[:S, 0], mn[:S, 0], mx[:S, 0]   # lane 0 of the broadcast
 
 

@@ -74,6 +74,13 @@ _BlockMeta = _nt("_BlockMeta", "E k0 ka")
 _FinMeta = _nt("_FinMeta",
                "E k0 ka dev_mean ship_sum need_count S planes_dev")
 
+# device top-k entry (OG_DEVICE_TOPK): the finalize recipe plus the
+# ORDER BY/LIMIT cut spec the kernel applied — only k×G winner cells
+# crossed D2H; the pre-finalize grid stays resident for winner repair
+_TopkMeta = _nt("_TopkMeta",
+                "E k0 ka dev_mean ship_sum need_count G W planes_dev "
+                "kk desc offset null_fill")
+
 
 def _ka_k0_of(sl):
     if hasattr(sl, "ka"):                 # _BlockMeta / _FinMeta
@@ -101,7 +108,15 @@ def _unpack_block_out(fmt: str, arrs, stack, want: tuple,
     from ..ops.exactsum import K_LIMBS as _KLu
     ka, k0 = _ka_k0_of(stack)
     repair_b = 0
-    if fmt == "f":
+    if fmt == "k":
+        bo = _bagg.unpack_topk(arrs, stack.planes_dev, ka, k0,
+                               stack.E, stack.dev_mean,
+                               stack.ship_sum, stack.need_count,
+                               stack.G, stack.W, stack.kk,
+                               stack.null_fill)
+        repair_b = bo["topk"].pop("_repair_nbytes", 0)
+        _ds.bump("topk_cells_pulled", stack.G * stack.kk)
+    elif fmt == "f":
         bo = _bagg.unpack_finalized(arrs, stack.planes_dev, ka,
                                     k0, stack.E, stack.dev_mean,
                                     stack.ship_sum, stack.need_count,
@@ -123,7 +138,8 @@ def _unpack_block_out(fmt: str, arrs, stack, want: tuple,
         a = np.asarray(a)
         got_b += int(a.nbytes)
         n_planes += int(a.shape[0]) if a.ndim == 2 else 0
-    S = int(np.asarray(bo["count"]).shape[0])
+    S = (stack.G * stack.W if fmt == "k"
+         else int(np.asarray(bo["count"]).shape[0]))
     # savings baseline = what OG_DEVICE_FINALIZE=0 would have shipped:
     # the QUERY-WIDE legacy f64 plane grid, not the already-pruned
     # per-field layout (else this PR's own diet never shows up in the
@@ -131,7 +147,8 @@ def _unpack_block_out(fmt: str, arrs, stack, want: tuple,
     legacy_b = sum(n for _nm, n in
                    _bagg.plane_layout(want_legacy or want, ka)) * 8 * S
     saved = max(0, legacy_b - got_b)
-    _ds.bump({"f": "d2h_bytes_finalized", "p": "d2h_bytes_packed"}
+    _ds.bump({"f": "d2h_bytes_finalized", "p": "d2h_bytes_packed",
+              "k": "d2h_bytes_topk"}
              .get(fmt, "d2h_bytes_legacy"), got_b)
     if saved:
         _ds.bump("pull_bytes_saved", saved)
@@ -256,6 +273,47 @@ def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
     res_t = SegmentAggResult(count=outs["count"], min=outs.get("min"),
                              max=outs.get("max"))
     return ("dev", (res_t, outs.get("lsum")), rkey)
+
+
+# f32 fast-tier dense result: the subset of states the Pallas row-agg
+# kernel produces (sumsq None keeps the dense fold's getattr contract)
+_F32Res = _nt("_F32Res", "count sum sumsq min max")
+
+
+def _f32_dense_rowagg(dcache, fp, fname, dvals, spec, ctx=None,
+                      span=None):
+    """Opt-in f32 fast tier (OG_F32_TIER): one VMEM-tiled Pallas pass
+    (ops/pallas_agg.pallas_dense_rowagg) computes per-row sum/min/max
+    of a FULLY-VALID dense (S, P) block in float32 — trading the last
+    ulp for single-pass locality and half the HBM bytes of f64. Counts
+    are exact (every row is fully valid ⇒ count = P). Returns None on
+    any fault (the ladder's host fallback is the default f64 path)."""
+    from ..ops import devstats as _f32_ds
+    rkey = (fp, fname, "f32res", spec)
+    if dcache is not None:
+        got = dcache.get(rkey)
+        if got is not None:
+            return got
+    from ..ops.devicefault import DeviceRouteDown
+    from ..ops.pallas_agg import pallas_dense_rowagg
+    S, P = dvals.shape
+    try:
+        s, mn, mx = _sched_launch(
+            "dense", lambda: pallas_dense_rowagg(dvals), ctx=ctx,
+            span=span)
+    except DeviceRouteDown:
+        return None
+    res = _F32Res(
+        count=np.full(S, P, dtype=np.int64),
+        sum=np.asarray(s, dtype=np.float64) if spec.sum else None,
+        sumsq=None,
+        min=np.asarray(mn, dtype=np.float64) if spec.min else None,
+        max=np.asarray(mx, dtype=np.float64) if spec.max else None)
+    _f32_ds.bump("f32_tier_launches")
+    _f32_ds.bump("f32_tier_rows", S * P)
+    if dcache is not None:
+        dcache.put(rkey, res)
+    return res
 
 # sparse row counts at or below this reduce on host (numpy) instead of
 # paying device dispatch + result round-trips; the dense/pre-agg paths
@@ -1805,6 +1863,44 @@ class QueryExecutor:
                     if k in names)
             return got
 
+        # ---- answer-sized raw finalize routing (OG_DEVICE_SKETCH):
+        # percentile/median/mode on a TERMINAL plan finalize as order
+        # statistics over device-resident cell-sorted sample planes —
+        # only the (n_ops, G·W) answer grids cross D2H and the
+        # per-cell Python slice lists never build. Sketch-only fields
+        # (percentile_approx) always skip slice collection too: their
+        # OGSketch states now build from one host lexsort stream
+        # (ogsketch.batch_of_states — bit-identical to the per-cell
+        # object path). Everything else keeps the raw-slice path.
+        RAWFIN_FUNCS = ("percentile", "median", "mode")
+        rawfin_fields: dict[str, dict] = {}
+        sketch_stream_fields: set[str] = set()
+        if cs.multirow is None and raw_fields:
+            from ..ops.blockagg import device_sketch_on as _dsk_on
+            # sole windowless percentile selector rows carry the
+            # chosen POINT's timestamp — that needs the raw times
+            pt_sel = (not interval and len(aggs) == 1
+                      and len(cs.outputs) == 1
+                      and isinstance(cs.outputs[0][1], AggRef)
+                      and aggs[0].func == "percentile")
+            dev_ok = terminal and not pt_sel and _dsk_on()
+            for fname in raw_fields:
+                cons = [a for a in aggs if a.field == fname
+                        and (a.needs_raw or a.needs_sketch
+                             or a.func in ("top", "bottom"))]
+                if all(a.needs_sketch for a in cons):
+                    sketch_stream_fields.add(fname)
+                    continue
+                if dev_ok and all(a.func in RAWFIN_FUNCS
+                                  or a.needs_sketch for a in cons):
+                    rawfin_fields[fname] = {
+                        "pcts": [float(a.arg or 0.0) for a in cons
+                                 if a.func == "percentile"],
+                        "median": any(a.func == "median"
+                                      for a in cons),
+                        "mode": any(a.func == "mode" for a in cons)}
+        _slices_skip = sketch_stream_fields | set(rawfin_fields)
+
         # ------------------------------------------------ block path
         # HBM-resident segment stacks (ops/blockagg.py): whole files
         # reduce ON DEVICE for any window/range/grouping; eligible when
@@ -2014,7 +2110,8 @@ class QueryExecutor:
                         if pipe is not None:
                             n_stream += 1
                             _txn = {"f": "finalized", "p": "packed",
-                                    "l": "legacy", "lp": "legacy"}
+                                    "l": "legacy", "lp": "legacy",
+                                    "k": "topk"}
                             pipe.submit(("blk", n_stream), packed[1:],
                                         post=_unpack_post(
                                             packed[0], stack_e,
@@ -2201,14 +2298,45 @@ class QueryExecutor:
                                                   + list(lat_dev_acc)):
                         field_nkeys[fname] = \
                             field_nkeys.get(fname, 0) + 1
+                    # device ORDER BY/LIMIT cut (OG_DEVICE_TOPK): when
+                    # the statement carries ORDER BY time + LIMIT and
+                    # the SINGLE finalized grid holds the whole answer
+                    # (one field, plain AggRef outputs, fill none/
+                    # null), the finalize epilogue chains into the
+                    # segmented top-k kernel and only the k×G winner
+                    # cells ever cross D2H. The fill/limit semantics
+                    # come from the PLAN (same contract finalize
+                    # follows), so =0 is byte-identical by mirroring
+                    # build_group_rows' walk on device.
+                    topk_spec = None
+                    _eff_fill = (stmt.fill_option
+                                 if plan.get("fill", True) else "none")
+                    if (fin_ok and interval and stmt.limit > 0
+                            and plan.get("limit", True)
+                            and blockagg.device_topk_on()
+                            and _eff_fill in ("none", "null")
+                            and len(merged_by) + len(lat_dev_acc) == 1
+                            and not fields_perfile
+                            and all(a.field is not None
+                                    for a in aggs)
+                            and len({a.field for a in aggs}) == 1
+                            and all(isinstance(e, AggRef)
+                                    for _n, e in cs.outputs)
+                            and min(stmt.limit, W) >= 1):
+                        topk_spec = {"kk": min(int(stmt.limit), W),
+                                     "desc": bool(stmt.order_desc),
+                                     "offset": int(stmt.offset or 0),
+                                     "null_fill": _eff_fill == "null"}
                     _t_fdev0 = _now_ns()
                     n_fin = 0
+                    n_tk = 0
                     fin_ns = 0       # finalize-kernel dispatch only —
+                    tk_ns = 0
                     # the _emit that follows can block on pipeline
                     # backpressure, which belongs to device_pull
 
                     def _emit_merged(fname, _E, _k0, _ka, out, nrows):
-                        nonlocal n_fin, fin_ns
+                        nonlocal n_fin, n_tk, fin_ns, tk_ns
                         fin = None
                         if (fin_ok and fname not in fields_perfile
                                 and field_nkeys.get(fname) == 1):
@@ -2230,6 +2358,30 @@ class QueryExecutor:
                             # the decode recipe comes FROM the pack
                             # call — one derivation, no skew
                             fin, (dm, ss, nc) = fin
+                            if topk_spec is not None:
+                                _t_tk = _now_ns()
+                                tk = _sched_launch(
+                                    "finalize",
+                                    lambda fin=fin:
+                                    blockagg.topk_cut(
+                                        fin[1:], G, W,
+                                        topk_spec["kk"],
+                                        topk_spec["desc"],
+                                        topk_spec["offset"],
+                                        topk_spec["null_fill"]),
+                                    ctx=ctx, span=span)
+                                tk_ns += _now_ns() - _t_tk
+                                n_tk += 1
+                                _emit(fname, None,
+                                      _TopkMeta(_E, _k0, _ka, dm, ss,
+                                                nc, G, W, out,
+                                                topk_spec["kk"],
+                                                topk_spec["desc"],
+                                                topk_spec["offset"],
+                                                topk_spec[
+                                                    "null_fill"]),
+                                      ("k",) + tk)
+                                return
                             _emit(fname, None,
                                   _FinMeta(_E, _k0, _ka, dm, ss, nc,
                                            G * W, out), fin)
@@ -2260,6 +2412,15 @@ class QueryExecutor:
                             fsp.start_ns = _t_fdev0
                             fsp.end_ns = _t_fdev0 + fin_ns
                             fsp.add(grids=n_fin)
+                    if n_tk:
+                        _dstat.bump_phase("device_topk", tk_ns)
+                        if span is not None:
+                            tsp = span.child("device_topk")
+                            tsp.start_ns = _t_fdev0 + fin_ns
+                            tsp.end_ns = _t_fdev0 + fin_ns + tk_ns
+                            tsp.add(grids=n_tk,
+                                    winner_cells=G * (topk_spec or
+                                                      {}).get("kk", 0))
                     block_rows_total = sum(
                         sl.n_rows for _r, stacks, _g, _s in jobs
                         for sls in stacks.values() for sl in sls)
@@ -2445,6 +2606,17 @@ class QueryExecutor:
         # computed only when an output reads the sum state
         exact_on = EXACT_SUM and spec.sum and any(
             a.func in ("sum", "mean", "stddev") for a in aggs)
+        # opt-in f32 fast tier (OG_F32_TIER, default off): dashboard-
+        # class dense-window reductions ride the VMEM-tiled Pallas
+        # kernel in float32 — NOT bit-identical (perf_smoke gates it
+        # on tolerance, not digests). Eligible only for pure moment
+        # queries the kernel covers; fields it actually serves skip
+        # the exact-limb machinery (their sums are f32-derived).
+        f32_query_ok = (bool(_knobs.get("OG_F32_TIER"))
+                        and not spec.sumsq
+                        and spec_names <= {"count", "sum", "min",
+                                           "max"})
+        f32_used: set[str] = set()
         exact_results: dict[str, tuple] = {}
         exact_scales: dict[str, int] = {}
         sel_results: dict[str, tuple] = {}
@@ -2642,7 +2814,7 @@ class QueryExecutor:
             vals, valid = p["vals"], p["valid"]
             field_exact = p["field_exact"]
             if fname in multi_done:
-                if fname in raw_fields:
+                if fname in raw_fields and fname not in _slices_skip:
                     raw_slices[fname] = _collect_raw_slices(
                         seg, vals, valid, times, G, W)
                 continue
@@ -2683,9 +2855,78 @@ class QueryExecutor:
                                                    num_segments))
             field_results[fname] = res
             field_types[fname] = p["ftype"]
-            if fname in raw_fields:
+            if fname in raw_fields and fname not in _slices_skip:
                 raw_slices[fname] = _collect_raw_slices(
                     seg, vals, valid, times, G, W)
+
+        # ---- device order-statistic finalize (answer-sized D2H):
+        # upload-or-hit the cell-sorted sample planes (HBM sketch
+        # tier) and launch ONE rawfin kernel per served field; only
+        # the (n_ops, G·W) grids come back — pulled with the batch
+        # below. Any fault (breaker open, OOM exhaustion) heals to
+        # the byte-identical host raw-slice path for the field.
+        rawfin_dev: dict[str, object] = {}
+        if rawfin_fields:
+            from ..ops import blockagg as _bsk
+            from ..ops.devicefault import (DeviceRouteDown,
+                                           route_on as _rf_route_on)
+            _t_rf0 = _now_ns()
+            n_rf = 0
+            for fname, spec_rf in list(rawfin_fields.items()):
+                p = field_prep[fname]
+                v_f = p["vals"].astype(np.float64, copy=False)
+                has_nan = bool(np.isnan(v_f[p["valid"]]).any()) \
+                    if p["valid"].any() else False
+                # breaker consult LAST (half-open probe discipline);
+                # stored NaN values keep host semantics (the device
+                # run-length mode would have to reproduce NaN != NaN
+                # ordering through segment_min)
+                if has_nan or not _rf_route_on("finalize"):
+                    rawfin_fields.pop(fname)
+                    raw_slices[fname] = _collect_raw_slices(
+                        seg, p["vals"], p["valid"], times, G, W)
+                    _dstat.bump("sketch_host_fallbacks")
+                    continue
+                # sorted-plane cache identity: the rowstore plan key
+                # already pins shard serials + memtable mutations, so
+                # content changes invalidate; residual filters mask
+                # rows after the scan and stay uncached
+                ck = None
+                if scan_plan is not None and cond.residual is None:
+                    ck = (hash(plan_key), fname, int(start),
+                          int(interval_eff), W, int(npad))
+                try:
+                    v_p, m_p = pad_rows([v_f, p["valid"]], npad,
+                                        seg_fill=0)
+                    s_p, = pad_rows([seg], npad,
+                                    seg_fill=num_segments)
+                    rawfin_dev[fname] = _sched_launch(
+                        "finalize",
+                        lambda v_p=v_p, m_p=m_p, s_p=s_p, ck=ck,
+                        spec_rf=spec_rf: _bsk.rawfin_grids(
+                            *_bsk.sketch_sorted_planes(
+                                v_p, m_p, s_p, num_segments,
+                                cache_key=ck),
+                            num_segments, spec_rf["pcts"],
+                            spec_rf["median"], spec_rf["mode"]),
+                        ctx=ctx, span=span)
+                    n_rf += 1
+                except DeviceRouteDown:
+                    # route exhausted: heal THIS statement locally —
+                    # exact host finalize from freshly collected
+                    # slices (cheaper than the statement-level rerun)
+                    rawfin_fields.pop(fname)
+                    raw_slices[fname] = _collect_raw_slices(
+                        seg, p["vals"], p["valid"], times, G, W)
+                    _dstat.bump("sketch_host_fallbacks")
+            if n_rf:
+                _rf_ns = _now_ns() - _t_rf0
+                _dstat.bump_phase("device_finalize", _rf_ns)
+                if span is not None:
+                    rsp = span.child("device_finalize")
+                    rsp.start_ns = _t_rf0
+                    rsp.end_ns = _t_rf0 + _rf_ns
+                    rsp.add(rawfin_fields=n_rf)
         _batch_pull_results(field_results, exact_results, stats=_q_pull)
         # dense groups: (S, P) axis reductions, results scattered into
         # the state grids host-side (S is tiny — N/P)
@@ -2724,9 +2965,26 @@ class QueryExecutor:
                     if grp.cached and fname not in \
                             (scanres.field_types or {}) and ft is not None:
                         field_types[fname] = ft
-                    if use_ddev and not spec.sumsq and (
+                    if (f32_query_ok and dvals is not None
+                            and dvals.dtype == np.float64
+                            and bool(dvalid.all())):
+                        res_f = _f32_dense_rowagg(dcache, fp, fname,
+                                                  dvals, spec,
+                                                  ctx=ctx, span=span)
+                        if res_f is not None:
+                            f32_used.add(fname)
+                            dense_out.setdefault(fname, []).append(
+                                (grp.cells, S, res_f))
+                            continue
+                    if use_ddev and not f32_query_ok \
+                            and not spec.sumsq and (
                             not spec.sum
                             or (exact_on and fname in exact_scales)):
+                        # (f32 tier active: the device-dense route's
+                        # sums exist ONLY as exact limb state, which
+                        # f32-served fields skip — groups the tier
+                        # can't serve take the host fold, whose f64
+                        # sums land in st["sum"] directly)
                         got = _dense_device_try(
                             dcache, fp, fname, dvals, dvalid, spec,
                             exact_scales.get(fname, 0),
@@ -2799,7 +3057,7 @@ class QueryExecutor:
         dense_dev_meta = [e[:6] for e in dense_dev_pending]
         ddev_trees = [(e[6], e[7]) for e in dense_dev_pending]
         if (not use_host or dense_out or block_launches
-                or dense_dev_pending
+                or dense_dev_pending or rawfin_dev
                 or (pipe is not None and pipe.launches)):
             # ONE batched D2H for every kernel output on the fallback
             # path — per-array pulls each pay a full tunnel round-trip
@@ -2820,7 +3078,7 @@ class QueryExecutor:
                               in block_launches]
                 tree = (field_results, dense_out, exact_results,
                         dense_exact, sel_results, block_outs,
-                        ddev_trees)
+                        ddev_trees, rawfin_dev)
                 # drain the dispatch queue BEFORE the transfer:
                 # device_get on in-flight arrays takes the tunnel's
                 # slow synchronous fetch path (measured 6x the
@@ -2830,21 +3088,20 @@ class QueryExecutor:
                 except Exception:
                     pass
                 (field_results, dense_out, exact_results, dense_exact,
-                 sel_results, block_outs, ddev_trees) = \
+                 sel_results, block_outs, ddev_trees, rawfin_dev) = \
                     _device_get_parallel(tree, stats=_q_pull,
                                          site="batch")
             else:
                 block_fmt = block_outs = None
                 tree = (field_results, dense_out, exact_results,
-                        dense_exact, sel_results)
+                        dense_exact, sel_results, rawfin_dev)
                 try:
                     jax.block_until_ready(tree)
                 except Exception:
                     pass
                 (field_results, dense_out, exact_results, dense_exact,
-                 sel_results) = _device_get_parallel(tree,
-                                                     stats=_q_pull,
-                                                     site="batch")
+                 sel_results, rawfin_dev) = _device_get_parallel(
+                    tree, stats=_q_pull, site="batch")
                 streamed = pipe.collect()
                 ddev_trees = [streamed[("dense", i)]
                               for i in range(len(dense_dev_pending))]
@@ -3046,6 +3303,7 @@ class QueryExecutor:
         if fold_sp is not None:
             fold_sp.start_ns = _t_fold0
         fields_out: dict[str, dict] = {}
+        topk_partial: dict | None = None
         fb_omitted: list[str] = []
         for fname, res in field_results.items():
             st: dict[str, np.ndarray] = {}
@@ -3180,6 +3438,17 @@ class QueryExecutor:
             elif my_blocks:
                 fb_needed = True       # no exact machinery: f64 only
             for reader_b, st_blk, bo in my_blocks:
+                if "topk" in bo:
+                    # device ORDER BY/LIMIT cut: only winner cells
+                    # came back — the partial carries them verbatim
+                    # and finalize takes the _materialize_topk path
+                    # (the field's state grids stay zero and unread)
+                    topk_partial = {
+                        **bo["topk"], "field": fname,
+                        "kk": st_blk.kk, "desc": st_blk.desc,
+                        "offset": st_blk.offset,
+                        "null_fill": st_blk.null_fill}
+                    continue
                 if bo.get("final"):
                     # device-finalized transport: answer planes land
                     # straight in the output states — eligibility
@@ -3242,11 +3511,11 @@ class QueryExecutor:
             # reconstruction + sparse host repair), and eligibility
             # proved no other source contributes; building a zero limb
             # grid here would overwrite the finalized sum downstream.
-            has_fin = any(bo.get("final")
+            has_fin = any(bo.get("final") or "topk" in bo
                           for _r3, _s3, bo in my_blocks)
-            if exact_on and not has_fin and (
-                    fname in exact_results
-                    or fname in dense_exact or my_blocks):
+            if exact_on and not has_fin and fname not in f32_used \
+                    and (fname in exact_results
+                         or fname in dense_exact or my_blocks):
                 from ..ops.exactsum import K_LIMBS, rebase
                 lg = np.zeros((G * W + 1, K_LIMBS))
                 ixg = np.zeros(G * W + 1, dtype=bool)
@@ -3339,32 +3608,65 @@ class QueryExecutor:
             # influx shows epoch 0 on unbounded windowless aggregates
             partial["display_start"] = \
                 int(t_min) if t_min != MIN_TIME else 0
-        # raw slices for exact-semantics aggregates
+        if topk_partial is not None:
+            partial["topk"] = topk_partial
+        # device-finalized order statistics (answer-sized D2H): the
+        # pulled (n_ops, S) grids keyed so finalize_partials matches
+        # them to their AggItems without re-deriving the op order
+        if rawfin_dev:
+            partial["rawfin"] = {}
+            for fname, grids in rawfin_dev.items():
+                spec_rf = rawfin_fields[fname]
+                keys = [f"percentile:{p}" for p in spec_rf["pcts"]]
+                if spec_rf["median"]:
+                    keys.append("median:None")
+                if spec_rf["mode"]:
+                    keys.append("mode:None")
+                ga = np.asarray(grids)
+                partial["rawfin"][fname] = {
+                    k: ga[i] for i, k in enumerate(keys)}
+        # raw slices for exact-semantics aggregates (fields served by
+        # the device order-statistic finalize or the sketch stream
+        # never collected them)
         raw_need = {a.field for a in aggs if a.needs_raw}
-        if raw_need:
-            partial["raw"] = {f: raw_slices[f] for f in sorted(raw_need)}
+        if raw_need and any(f in raw_slices for f in raw_need):
+            partial["raw"] = {f: raw_slices[f]
+                              for f in sorted(raw_need)
+                              if f in raw_slices}
         # percentile_approx: fold raw cells into per-(group, window)
         # OGSketch states (ogsketch_insert phase — only the sketch ships).
         # One sketch per field; several calls on the same field share it
-        # at the LARGEST requested cluster count (accuracy dominates)
+        # at the LARGEST requested cluster count (accuracy dominates).
+        # States build from ONE lexsorted value stream
+        # (ogsketch.batch_of_states — bit-identical to the per-cell
+        # OGSketch.of loop it replaced, which built G·W Python objects)
         sk_items: dict[str, float] = {}
         for a in aggs:
             if a.needs_sketch:
                 c = a.arg2 or 100.0
                 sk_items[a.field] = max(sk_items.get(a.field, 0.0), c)
         if sk_items:
+            from ..ops.ogsketch import batch_of_states
             partial["sketch"] = {}
             for fname, clusters in sorted(sk_items.items()):
-                sl = raw_slices[fname]
+                p_sk = field_prep[fname]
+                v_sk = p_sk["vals"].astype(np.float64, copy=False)
+                keep = (p_sk["valid"] & (seg < num_segments)
+                        & ~np.isnan(v_sk))
+                s_sk = seg[keep]
+                v_sk = v_sk[keep]
+                order = np.lexsort((v_sk, s_sk))
+                s_sk, v_sk = s_sk[order], v_sk[order]
                 cells = [[None] * W for _ in range(G)]
-                for gi in range(G):
-                    for wi in range(W):
-                        v = sl["vals"][gi][wi]
-                        if v is None or len(v) == 0:
-                            continue
-                        cells[gi][wi] = OGSketch.of(
-                            np.asarray(v), clusters).to_state()
-                partial["sketch"][fname] = {"c": clusters, "cells": cells}
+                if len(s_sk):
+                    ucells, starts_sk, lens_sk = np.unique(
+                        s_sk, return_index=True, return_counts=True)
+                    states = batch_of_states(v_sk, starts_sk, lens_sk,
+                                             clusters)
+                    for cid, st_sk in zip(ucells.tolist(), states):
+                        cells[cid // W][cid % W] = st_sk
+                partial["sketch"][fname] = {"c": clusters,
+                                            "cells": cells}
         # capped top/bottom partial state
         tb = [a for a in aggs if a.func in ("top", "bottom")]
         if tb:
@@ -4269,6 +4571,14 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
         return _finalize_multirow(stmt, mst, cs, merged, win_times,
                                   group_tags, group_keys)
 
+    # device ORDER BY/LIMIT cut (OG_DEVICE_TOPK): the partial carries
+    # only the k×G winner cells — rows build straight from the winner
+    # planes (native build_topk_rows), no (G, W) grids and no per-cell
+    # Python between the D2H pull and the serializer
+    if merged.get("topk") is not None:
+        return _materialize_topk(stmt, mst, cs, merged, interval,
+                                 group_tags, group_keys)
+
     # ---- base aggregate grids + per-agg presence
     agg_grids: list[np.ndarray] = []
     agg_present: list[np.ndarray] = []
@@ -4307,11 +4617,20 @@ def finalize_partials(stmt, mst: str, cs, partials: list[dict | None],
                              default_workers=min(
                                  8, _os.cpu_count() or 1))
         else:
-            raw = merged.get("raw", {}).get(a.field)
-            if raw is None:
-                grid = np.full((G, W), np.nan)
+            # device-finalized order statistics land as answer grids
+            # (partial["rawfin"]); anything else falls back to the
+            # host raw-slice finalizer
+            rf = merged.get("rawfin", {}).get(a.field)
+            rf_key = f"{a.func}:{a.arg}" if a.func != "percentile" \
+                else f"percentile:{float(a.arg or 0.0)}"
+            if rf is not None and rf_key in rf:
+                grid = np.asarray(rf[rf_key]).reshape(G, W)
             else:
-                grid = finalize_raw_agg(a, raw, G, W)
+                raw = merged.get("raw", {}).get(a.field)
+                if raw is None:
+                    grid = np.full((G, W), np.nan)
+                else:
+                    grid = finalize_raw_agg(a, raw, G, W)
         grid = np.asarray(grid)
         if not np.issubdtype(grid.dtype, np.integer):
             # typed int64 grids stay integer — a float64 pass would
@@ -4616,6 +4935,96 @@ def _materialize_plain_fast(stmt, mst: str, out_specs, kinds, anyc,
     finally:
         _gc_resume()
     return [entries[gi] for gi in order if entries[gi] is not None]
+
+
+def _materialize_topk(stmt, mst: str, cs, merged, interval,
+                      group_tags, group_keys) -> dict:
+    """Row assembly for the device ORDER BY/LIMIT cut: the partial
+    carries only the (G, k) winner planes (window ids, presence,
+    count/sum/mean), already in output row order with desc/offset/
+    limit applied ON DEVICE. Rows build straight from those planes in
+    C (native.build_topk_rows; tolist fallback bit-identical) — no
+    (G, W) grids, no per-cell Python — and must match the full-grid
+    path byte for byte (tests/test_device_topk.py pins them)."""
+    tk = merged["topk"]
+    G = len(group_keys)
+    start = merged["start"]
+    aggs = cs.aggs
+    field_types = merged["field_types"]
+    widx = np.asarray(tk["widx"], dtype=np.int64)
+    nwin = np.asarray(tk["nwin"], dtype=np.int64)
+    group_has = np.asarray(tk["group_has"], dtype=bool)
+    pres = np.asarray(tk["pres"], dtype=bool)
+    times = (start + interval * np.maximum(widx, 0)).astype(np.int64)
+    cnt = np.asarray(tk["count"]) if "count" in tk else None
+    sum_p = np.asarray(tk["sum"]) if "sum" in tk else None
+    mean_p = np.asarray(tk["mean"]) if "mean" in tk else None
+    cols: list = []
+    oks: list = []
+    for _name, expr in cs.outputs:
+        a = aggs[expr.idx]
+        kind = _output_cast_kind(expr, aggs, field_types)
+        if a.func == "count":
+            v = cnt.astype(np.float64)
+        elif a.func == "sum":
+            v = sum_p
+        elif a.func == "mean":
+            # same operand values as finalize_moment's division when
+            # the recipe shipped sum+count instead of a device mean
+            v = mean_p if mean_p is not None \
+                else sum_p / np.maximum(cnt, 1)
+        else:                  # unreachable: emit-side eligibility
+            raise ErrQueryError(
+                f"device topk cannot materialize {a.func}")
+        ok = pres & np.isfinite(v)
+        if kind == "int" and v.dtype != np.int64:
+            with np.errstate(invalid="ignore"):
+                v = np.where(ok, v, 0.0).astype(np.int64)
+        cols.append(np.ascontiguousarray(v))
+        oks.append(np.ascontiguousarray(ok))
+    emit = (nwin > 0) & group_has
+    from .. import native as _native
+    rows_by_g = _native.build_topk_rows(times, cols, oks, nwin, emit)
+    if rows_by_g is None:
+        rows_by_g = _py_topk_rows(times, cols, oks, nwin, emit)
+    cols_hdr = ["time"] + [n for n, _e in cs.outputs]
+    order = sorted(range(G), key=lambda gi: group_keys[gi])
+    series_out = []
+    for gi in order:
+        rows = rows_by_g[gi]
+        if not rows:
+            continue
+        entry = {"name": mst, "columns": cols_hdr, "values": rows}
+        if group_tags:
+            entry["tags"] = dict(zip(group_tags, group_keys[gi]))
+        series_out.append(entry)
+    if stmt.soffset:
+        series_out = series_out[stmt.soffset:]
+    if stmt.slimit:
+        series_out = series_out[:stmt.slimit]
+    return {"series": series_out} if series_out else {}
+
+
+def _py_topk_rows(times, cols, oks, nwin, emit) -> list:
+    """Python fallback of native.build_topk_rows — bit-identical row
+    lists (tests pin the two together)."""
+    G = len(nwin)
+    out: list = [None] * G
+    for gi in range(G):
+        if not emit[gi]:
+            continue
+        n = int(nwin[gi])
+        trow = times[gi, :n].tolist()
+        cvals = []
+        for col, ok in zip(cols, oks):
+            cv = col[gi, :n].tolist()
+            okr = ok[gi, :n]
+            if not bool(okr.all()):
+                for j in np.nonzero(~okr)[0].tolist():
+                    cv[j] = None
+            cvals.append(cv)
+        out[gi] = [list(r) for r in zip(trow, *cvals)]
+    return out
 
 
 def _py_group_rows(stmt, times_all, val_grids, ok_grids, all_ok, gi,

@@ -206,6 +206,23 @@ register("OG_DEVICE_FINALIZE", str, "1",
 register("OG_LATTICE_DEVICE_FOLD", bool, True,
          "fold window lattices on device (one packed grid per "
          "field×scale crosses D2H); 0 = host C fold")
+register("OG_DEVICE_TOPK", bool, True,
+         "device-side ORDER BY/LIMIT cut over finalized answer "
+         "planes: only the k×groups winner cells cross D2H; 0 = "
+         "byte-identical full-grid pull + host slicing")
+register("OG_DEVICE_SKETCH", bool, True,
+         "device order-statistic finalize for percentile/median/mode "
+         "over HBM-resident sorted-sample planes (terminal plans, "
+         "real-f64 backends); 0 = byte-identical host raw-slice path")
+register("OG_SKETCH_HBM_MB", int, 256,
+         "HBM budget for the sorted-sample sketch tier (device-"
+         "resident per-(field, window-layout) cell-sorted planes); "
+         "0 disables the tier (planes rebuilt per query)")
+register("OG_F32_TIER", bool, False,
+         "opt-in f32 fast tier: dashboard-class dense-window "
+         "reductions ride the VMEM-tiled Pallas kernel "
+         "(ops/pallas_agg.py) in float32 — NOT bit-identical; "
+         "digest-tolerance gated in perf_smoke")
 register("OG_DENSE_DEVICE", bool, False,
          "dense (S,P) groups reduce on device from decoded-plane "
          "cache residency")
@@ -358,6 +375,11 @@ RECOMPILE_BUDGETS: dict = {
     # failure mode that matters: a per-value shape-class explosion
     # compiles O(slabs) kernels and blows straight past this.
     "1h": 16, "1m": 16, "cfg1": 16,
+    # answer-sized D2H shapes (PR 12): the ORDER BY+LIMIT heavy shape
+    # pays the finalize epilogue + topk cut kernels on top of the
+    # lattice/block variants; the percentile shape pays the cellsort +
+    # order-stat finalize pair. Same 16 headroom rule as above.
+    "1m-topk": 16, "pctl": 16,
     # any undeclared window label: strict by default
     "default": 0,
 }

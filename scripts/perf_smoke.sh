@@ -18,9 +18,16 @@
 # dataset with fsync-acked ingest and dies mid-flush at a rotating
 # durability boundary; the restarted engine must serve each shape's
 # digest bit-identical to the no-crash reference with zero orphan
-# .tmp files, and reports crash_recovery_ms. Runs a scaled-down bench
-# dataset on the CPU backend with per-phase output — CI-safe (no
-# accelerator needed, minutes of wall).
+# .tmp files, and reports crash_recovery_ms. The answer-sized D2H
+# gate (PR 12) adds topk-off / sketch-off / topk-sketch-off-barrier
+# configs (byte-identical escape hatches of the device ORDER BY/LIMIT
+# cut and the order-statistic finalize) over every shape incl. the
+# new 1m-topk and pctl shapes, a measured winner-cell D2H shrink, a
+# routing proof for the device percentile finalize, and the opt-in
+# f32 fast tier gated on TOLERANCE (not digests) with zero warm
+# recompiles. Runs a scaled-down bench dataset on the CPU backend
+# with per-phase output — CI-safe (no accelerator needed, minutes of
+# wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]
@@ -99,6 +106,19 @@ assert r.get("duplicate_compiles") == 0, r
 assert r.get("compiles_total", 0) > 0, r
 assert r.get("xfer_manifest_ok") == 1, r
 assert r.get("xfer_ledger_checks", 0) > 0, r
+# answer-sized D2H gate (PR 12): topk-off / sketch-off configs ran
+# byte-identical on every shape (the sweep above), the device ORDER
+# BY/LIMIT cut measurably shrank the heavy pull to winner cells, the
+# percentile shape routed through the device order-statistic
+# finalize, and the opt-in f32 fast tier ran within tolerance with
+# zero warm recompiles (the warm gate above covers the new kernels)
+assert "topk-off" in r.get("configs", []), r
+assert "sketch-off" in r.get("configs", []), r
+assert r.get("topk_d2h_shrink_x", 0) >= 2.0, r
+assert r.get("sketch_dev_grids", 0) > 0, r
+assert r.get("f32_tier_launches", 0) > 0, r
+assert r.get("f32_checked_cells", 0) > 0, r
+assert r.get("f32_max_rel_err", 1.0) < 1e-4, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -117,6 +137,11 @@ print(f"compile audit OK: {r['compiles_total']} compiles, budgets "
 print(f"transfer manifest OK: h2d {r['xfer_h2d_bytes']}B / d2h "
       f"{r['xfer_d2h_bytes']}B attributed, "
       f"{r['xfer_ledger_checks']} ledger checks, 0 mismatches")
+print(f"answer-sized D2H OK: topk cut {r['topk_d2h_shrink_x']}x "
+      f"({r['topk_d2h_bytes_off']}B -> {r['topk_d2h_bytes_on']}B), "
+      f"{r['sketch_dev_grids']} device order-stat grids, f32 tier "
+      f"{r['f32_tier_launches']} launches max rel err "
+      f"{r['f32_max_rel_err']} over {r['f32_checked_cells']} cells")
 EOF
 
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy

@@ -1,0 +1,362 @@
+"""Answer-sized D2H (PR 12): the device ORDER BY/LIMIT cut
+(OG_DEVICE_TOPK) and the device order-statistic finalize of
+percentile/median/mode over HBM-resident sorted-sample planes
+(OG_DEVICE_SKETCH). Both default on; =0 must be byte-identical, only
+winner cells may cross D2H on the topk path, and any device fault
+must heal to the exact host path with the HBM ledger balanced."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils import failpoint
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)   # force the path
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def seed(eng, hosts=4, points=360, nil_every=0, ties=False, seed_=11):
+    """Float gauge rows; optional nil holes; ``ties`` writes stepped
+    values so percentile/mode selection hits equal-value runs."""
+    rng = np.random.default_rng(seed_)
+    vals = np.round(np.clip(rng.normal(50.0, 15.0, (hosts, points)),
+                            0, 100), 2)
+    if ties:
+        vals = np.round(vals / 5.0) * 5.0      # heavy duplicate runs
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            if nil_every and (h + i) % nil_every == 0:
+                continue
+            lines.append(
+                f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    return vals
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+# --------------------------------------------- topk e2e parity matrix
+
+TOPK_QUERIES = [
+    "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host ORDER BY time DESC LIMIT 5",
+    "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host LIMIT 3 OFFSET 2",
+    "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host fill(none) ORDER BY time DESC "
+    "LIMIT 4 OFFSET 1",
+    "SELECT mean(u), count(u), sum(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(1m), host LIMIT 2",
+    "SELECT count(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(2m), host ORDER BY time DESC LIMIT 3",
+    "SELECT sum(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(1m), host fill(none) LIMIT 1",
+    # limit deeper than the window count: the cut degenerates to the
+    # full (tiny) result — still must match
+    "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(30m), host ORDER BY time DESC LIMIT 500",
+]
+
+
+@pytest.mark.parametrize("shape", ["plain", "nils", "ties"])
+def test_topk_matches_host_slicing(db, monkeypatch, shape):
+    """asc/desc × LIMIT/OFFSET × fill none/null × nil presence ×
+    tie-heavy data: OG_DEVICE_TOPK=1 (cold + warm) ≡ =0 bit for bit,
+    and the cut actually engaged (devstats counter)."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, nil_every=7 if shape == "nils" else 0,
+         ties=shape == "ties")
+    for text in TOPK_QUERIES:
+        monkeypatch.setenv("OG_DEVICE_TOPK", "0")
+        ref = q(ex, text)
+        monkeypatch.delenv("OG_DEVICE_TOPK")
+        n0 = DEVICE_STATS["topk_grids"]
+        assert q(ex, text) == ref, text          # cold
+        assert q(ex, text) == ref, text          # warm repeat
+        assert DEVICE_STATS["topk_grids"] > n0, text
+
+
+def test_topk_winner_pull_is_answer_sized(db, monkeypatch):
+    """Only k×groups winner cells cross D2H: the on-path per-query
+    pull is a small fraction of the full-grid escape hatch, and the
+    winner-cell counter advances by exactly G·k."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, hosts=6, points=360)
+    text = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(1m), host "
+            "ORDER BY time DESC LIMIT 2")
+    monkeypatch.setenv("OG_DEVICE_TOPK", "0")
+    ref = q(ex, text)
+    off_b = DEVICE_STATS["last_query_d2h_bytes"]
+    monkeypatch.delenv("OG_DEVICE_TOPK")
+    c0 = DEVICE_STATS["topk_cells_pulled"]
+    got = q(ex, text)
+    on_b = DEVICE_STATS["last_query_d2h_bytes"]
+    assert got == ref
+    assert DEVICE_STATS["topk_cells_pulled"] - c0 == 6 * 2
+    # 60 windows cut to 2: the winner transport must be several times
+    # smaller than the finalized-plane grid it replaced
+    assert on_b * 4 < off_b, (on_b, off_b)
+
+
+def test_topk_kernel_transfer_guard_no_flags():
+    """Kernel-level: with no hazard/residue flags the winner unpack is
+    transfer-free — everything it needs was already pulled."""
+    from opengemini_tpu.ops import blockagg as BA
+    rng = np.random.default_rng(5)
+    G, W, kk = 3, 8, 2
+    want, K, k0, E = ("sum",), 2, 0, 18
+    planes = np.zeros((sum(n for _, n in BA.plane_layout(want, K)),
+                       G * W))
+    planes[0] = rng.integers(1, 5, G * W)
+    planes[1:1 + K] = rng.integers(-(1 << 20), 1 << 20,
+                                   (K, G * W)).astype(float)
+    fin, (dm, ss, nc) = BA.finalize_grid(
+        planes, want, {"mean"}, K, k0, E, n_rows=1 << 20)
+    tk = BA.topk_cut(fin[1:], G, W, kk, True, 0, True)
+    host = [None if a is None else np.asarray(a) for a in tk]
+    dev = jax.device_put(planes)
+    with jax.transfer_guard("disallow"):
+        bo = BA.unpack_topk(host, dev, K, k0, E, dm, ss, nc,
+                            G, W, kk, True)["topk"]
+    assert bo["nwin"].tolist() == [kk] * G
+
+
+def test_top_bottom_calls_unaffected(db, monkeypatch):
+    """top/bottom are MULTIROW selectors — the device cut must not
+    engage or corrupt them, with the knob on or off."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, nil_every=5)
+    for text in (
+            "SELECT top(u, 3) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(10m), host",
+            "SELECT bottom(u, 2) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(10m), host LIMIT 4"):
+        monkeypatch.setenv("OG_DEVICE_TOPK", "0")
+        ref = q(ex, text)
+        monkeypatch.delenv("OG_DEVICE_TOPK")
+        n0 = DEVICE_STATS["topk_grids"]
+        assert q(ex, text) == ref, text
+        assert DEVICE_STATS["topk_grids"] == n0   # never engaged
+
+
+def test_topk_ineligible_shapes_fall_back(db, monkeypatch):
+    """fill(previous/value), transforms, multi-field selects and
+    windowless limits keep the host path — identical with the knob on
+    and off, zero topk grids."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, nil_every=6)
+    for text in (
+            "SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(1m), host fill(previous) "
+            "LIMIT 3",
+            "SELECT mean(u) * 2 FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(1m), host LIMIT 3",
+            "SELECT derivative(mean(u)) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(1m), host LIMIT 3"):
+        monkeypatch.setenv("OG_DEVICE_TOPK", "0")
+        ref = q(ex, text)
+        monkeypatch.delenv("OG_DEVICE_TOPK")
+        n0 = DEVICE_STATS["topk_grids"]
+        assert q(ex, text) == ref, text
+        assert DEVICE_STATS["topk_grids"] == n0, text
+
+
+def test_build_topk_rows_native_matches_python():
+    from opengemini_tpu import native
+    from opengemini_tpu.query.executor import _py_topk_rows
+    rng = np.random.default_rng(3)
+    G, k = 5, 3
+    times = rng.integers(0, 1 << 40, (G, k)).astype(np.int64)
+    colf = rng.normal(0, 10, (G, k))
+    coli = rng.integers(-5, 99, (G, k)).astype(np.int64)
+    oks = [rng.random((G, k)) > 0.3, rng.random((G, k)) > 0.1]
+    nwin = np.array([3, 0, 1, 2, 3], dtype=np.int64)
+    emit = np.array([1, 0, 1, 1, 0], dtype=bool)
+    ref = _py_topk_rows(times, [colf, coli], oks, nwin, emit)
+    got = native.build_topk_rows(times, [colf, coli], oks, nwin, emit)
+    if got is None:
+        pytest.skip("native extension unavailable")
+    assert got == ref
+    # types match the row contract: int64 -> int, float64 -> float
+    assert isinstance(got[0][0][0], int)
+
+
+# --------------------------------- device order-statistic finalize
+
+RAWFIN_QUERIES = [
+    "SELECT percentile(u, 90) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(5m), host",
+    "SELECT percentile(u, 50), percentile(u, 99.9) FROM cpu WHERE "
+    "time >= 0 AND time < 3600s GROUP BY time(2m), host",
+    "SELECT median(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(5m), host",
+    "SELECT mode(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY time(5m), host",
+    "SELECT median(u), mode(u), percentile(u, 10) FROM cpu WHERE "
+    "time >= 0 AND time < 3600s GROUP BY time(10m), host",
+    # mixed with moment aggs on the same field
+    "SELECT percentile(u, 95), mean(u) FROM cpu WHERE time >= 0 AND "
+    "time < 3600s GROUP BY time(5m), host",
+    # windowless grouping
+    "SELECT median(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+    "GROUP BY host",
+]
+
+
+@pytest.mark.parametrize("shape", ["plain", "nils", "ties"])
+def test_rawfin_matches_host_oracle(db, monkeypatch, shape):
+    """percentile/median/mode × nil × tie-heavy data: the device
+    order-statistic finalize ≡ the host raw-slice path bit for bit
+    (cold + warm), and the acceptance counter proves routing."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng, nil_every=7 if shape == "nils" else 0,
+         ties=shape == "ties")
+    for text in RAWFIN_QUERIES:
+        monkeypatch.setenv("OG_DEVICE_SKETCH", "0")
+        ref = q(ex, text)
+        monkeypatch.delenv("OG_DEVICE_SKETCH")
+        n0 = DEVICE_STATS["sketch_dev_grids"]
+        assert q(ex, text) == ref, text          # cold
+        assert q(ex, text) == ref, text          # warm (plane cache)
+        assert DEVICE_STATS["sketch_dev_grids"] > n0, text
+
+
+def test_rawfin_windowless_percentile_selector_keeps_host_path(
+        db, monkeypatch):
+    """The sole windowless percentile selector carries the chosen
+    POINT's timestamp — raw times stay host-side, no device grids."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT percentile(u, 75) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s")
+    monkeypatch.setenv("OG_DEVICE_SKETCH", "0")
+    ref = q(ex, text)
+    monkeypatch.delenv("OG_DEVICE_SKETCH")
+    n0 = DEVICE_STATS["sketch_dev_grids"]
+    assert q(ex, text) == ref
+    assert DEVICE_STATS["sketch_dev_grids"] == n0
+
+
+def test_sketch_plane_tier_hits_and_relief_eviction(db, monkeypatch):
+    """Warm repeats serve the cell-sorted planes from the HBM sketch
+    tier; the OOM relief ladder evicts the tier and the books stay
+    exactly balanced."""
+    from opengemini_tpu.ops import devicecache as dc
+    from opengemini_tpu.ops import devicefault as df
+    from opengemini_tpu.ops import hbm
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT percentile(u, 90) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(5m), host")
+    q(ex, text)
+    assert dc.sketch_cache().stats()["bytes"] > 0
+    h0 = DEVICE_STATS["sketch_plane_hits"]
+    q(ex, text)
+    assert DEVICE_STATS["sketch_plane_hits"] > h0
+    assert hbm.cross_check()["ok"]
+    monkeypatch.setenv("OG_HBM_PRESSURE_EVICT", "1")
+    df.hbm_pressure_relief("finalize")
+    try:
+        assert dc.sketch_cache().stats()["bytes"] == 0
+        assert hbm.LEDGER.tier_bytes("sketch") == 0
+        assert hbm.cross_check()["ok"]
+        # and the next query recomputes + restakes, still exact
+        q(ex, text)
+        assert hbm.cross_check()["ok"]
+    finally:
+        df.restore_gate_permits()
+
+
+def test_oom_during_sketch_fill_heals_to_host(db, monkeypatch):
+    """Regression (satellite): an OOM thrown inside the sketch-plane
+    fill runs the relief ladder and retries; when the route exhausts
+    (breaker threshold 1 + zero retries), the statement heals to the
+    byte-identical host raw-slice path and hbm.cross_check() stays
+    exact."""
+    from opengemini_tpu.ops import devicefault as df
+    from opengemini_tpu.ops import hbm
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    eng, ex = db
+    seed(eng)
+    text = ("SELECT percentile(u, 90) FROM cpu WHERE time >= 0 AND "
+            "time < 3600s GROUP BY time(5m), host")
+    monkeypatch.setenv("OG_DEVICE_SKETCH", "0")
+    ref = q(ex, text)
+    monkeypatch.delenv("OG_DEVICE_SKETCH")
+    # one OOM: ladder evicts + retries within the same launch
+    failpoint.enable("blockagg.sketch_fill", "oom", maxhits=1)
+    try:
+        assert q(ex, text) == ref
+    finally:
+        failpoint.disable("blockagg.sketch_fill")
+        df.restore_gate_permits()
+    assert hbm.cross_check()["ok"]
+    # exhaustion: breaker trips, the field falls back to host slices.
+    # Purge the sketch tier first — a warm plane hit returns before
+    # the fill failpoint and nothing would fault
+    from opengemini_tpu.ops import devicecache as dc
+    dc.sketch_cache().purge()
+    monkeypatch.setenv("OG_DEVICE_RETRY", "0")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_THRESHOLD", "1")
+    fb0 = DEVICE_STATS["sketch_host_fallbacks"]
+    failpoint.enable("blockagg.sketch_fill", "oom", maxhits=4)
+    try:
+        assert q(ex, text) == ref       # DeviceRouteDown -> host heal
+        assert q(ex, text) == ref       # breaker open -> host heal
+    finally:
+        failpoint.disable("blockagg.sketch_fill")
+        df.reset_breakers()
+        df.restore_gate_permits()
+    assert DEVICE_STATS["sketch_host_fallbacks"] > fb0
+    assert hbm.cross_check()["ok"]
+
+
+def test_sketch_stream_states_match_per_cell_oracle(db, monkeypatch):
+    """percentile_approx partials now build OGSketch states from one
+    lexsorted stream — results must equal the per-cell object path
+    (the =0 escape hatch shares it end to end)."""
+    eng, ex = db
+    seed(eng, nil_every=9, ties=True)
+    for text in (
+            "SELECT percentile_approx(u, 95) FROM cpu WHERE "
+            "time >= 0 AND time < 3600s GROUP BY time(10m), host",
+            "SELECT percentile_approx(u, 50, 30) FROM cpu WHERE "
+            "time >= 0 AND time < 3600s GROUP BY time(2m), host"):
+        monkeypatch.setenv("OG_DEVICE_SKETCH", "0")
+        a = q(ex, text)
+        monkeypatch.delenv("OG_DEVICE_SKETCH")
+        b = q(ex, text)
+        assert a == b, text

@@ -306,3 +306,30 @@ def test_sliding_window_validation(db):
     assert "error" in q(
         ex, "SELECT sliding_window(mean(v), 1) FROM m GROUP BY time(1m)")
     assert "error" in q(ex, "SELECT sliding_window(mean(v), 3) FROM m")
+
+
+def test_batch_of_states_matches_per_cell_oracle():
+    """batch_of_states over a (cell, value)-sorted stream must equal
+    OGSketch.of(cell_values).to_state() per cell — small cells (no
+    greedy merge), compress-boundary sizes, big cells (scalar
+    fallback), and duplicate-heavy data."""
+    from opengemini_tpu.ops.ogsketch import OGSketch, batch_of_states
+    rng = np.random.default_rng(7)
+    cells = [rng.normal(50, 9, n) for n in
+             (1, 5, 150, 199, 200, 201, 450, 2000)]
+    cells.append(np.repeat([1.0, 2.0, 2.0, 3.0], 60))
+    lens = np.array([len(c) for c in cells])
+    starts = np.concatenate([[0], np.cumsum(lens[:-1])])
+    sv = np.concatenate([np.sort(c, kind="stable") for c in cells])
+    for clusters in (100.0, 20.0):
+        got = batch_of_states(sv, starts, lens, clusters)
+        for i, c in enumerate(cells):
+            ref = OGSketch.of(c, clusters).to_state()
+            assert got[i] == ref, (i, len(c), clusters)
+
+
+def test_batch_of_states_empty_cell():
+    from opengemini_tpu.ops.ogsketch import batch_of_states
+    out = batch_of_states(np.empty(0), np.array([0]), np.array([0]),
+                          100.0)
+    assert out[0]["all_weight"] == 0.0 and out[0]["means"] == []

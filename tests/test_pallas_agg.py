@@ -32,9 +32,19 @@ def test_mean_fast_mode():
     np.testing.assert_allclose(np.asarray(m), v.mean(axis=1), rtol=1e-5)
 
 
-def test_lane_width_validated():
-    with pytest.raises(ValueError):
-        pallas_dense_rowagg(np.zeros((8, 100), dtype=np.float32))
+def test_lane_tail_masked():
+    """Non-128-multiple widths pad to the lane tile and mask the tail
+    with each reduction's identity — any dense-window P is served
+    (the f32 tier's dashboard shapes are rarely lane-aligned)."""
+    rng = np.random.default_rng(3)
+    for P in (1, 100, 130, 255):
+        v = rng.normal(10, 5, (8, P)).astype(np.float32)
+        s, mn, mx = pallas_dense_rowagg(v)
+        np.testing.assert_allclose(np.asarray(s),
+                                   v.astype(np.float64).sum(axis=1),
+                                   rtol=1e-5)
+        assert np.array_equal(np.asarray(mn), v.min(axis=1))
+        assert np.array_equal(np.asarray(mx), v.max(axis=1))
 
 
 def test_kernel_is_lint_traced():
@@ -70,7 +80,7 @@ def test_compile_smoke_and_jaxpr_audit():
     # pads/casts on host first)
     st = ca.audit_kernel(
         "pallas_dense_rowagg",
-        lambda x: _rowagg_call(x, True), v)
+        lambda x: _rowagg_call(x, 128, True), v)
     assert st["out_dtypes"] and all(d == "float32"
                                     for d in st["out_dtypes"]), st
     assert st["f64_outputs"] == 0
