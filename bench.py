@@ -335,6 +335,57 @@ def run_query_phase(data_dir: str, runs: int,
     ph_h = _parse_phases(res_h)
     out["phases_ms_heavy"] = ph_h.get("phases_ms", {})
     out["pull_bytes_heavy"] = ph_h.get("pull_bytes", 0)
+    # compressed-domain execution (round 14): the H2D diet on the 1m
+    # heavy shape — cold slab build with the device decode stage
+    # (compressed DFOR payloads cross the link, expansion + limb
+    # decomposition run in-kernel) vs the OG_DEVICE_DECODE=0 host
+    # build (dense f64 planes cross). Per-query deltas off the
+    # transfer manifest, not cumulative counters; the warm repeat
+    # after evicting ONLY the decoded tier proves the compressed HBM
+    # tier rebuild (zero slab-site H2D).
+    import opengemini_tpu.ops.devicecache as _dcq
+    from opengemini_tpu.ops import compileaudit as _caq
+    from opengemini_tpu.ops.device_decode import DECODE_STATS as _DDQ
+    from opengemini_tpu.ops.devstats import QUERY_PHASE_NS as _QPN
+    (stmt_1m,) = parse_query(QUERY_1M)
+
+    res_off, cd_off_b = _cold_build_h2d(
+        lambda: ex.execute(stmt_1m, "bench"), decode_on=False)
+    d0 = _QPN["device_decode_ns"]
+    res_on, cd_on_b = _cold_build_h2d(
+        lambda: ex.execute(stmt_1m, "bench"), decode_on=True)
+    cd_decode_ms = (_QPN["device_decode_ns"] - d0) / 1e6
+    comp_bytes = _dcq.compressed_cache().stats()["bytes"]
+    slab_bytes = _dcq.global_cache().stats()["bytes"]
+    # warm rebuild from the compressed tier: decoded planes evicted
+    # (the relief ladder's first rung), payloads stay resident
+    hits0 = _DDQ["compressed_hits"]
+    _dcq.global_cache().purge()
+    _dcq.host_cache().purge()
+    m0 = _caq.manifest_snapshot()
+    res_rb = ex.execute(stmt_1m, "bench")
+    m1 = _caq.manifest_snapshot()
+    rb_slab_b = sum(m1[f"h2d_{s}_bytes"] - m0[f"h2d_{s}_bytes"]
+                    for s in ("slab", "limbs", "dfor", "payload"))
+    dig_on, _c = _digest_series(res_on)
+    dig_off, _c = _digest_series(res_off)
+    dig_rb, _c = _digest_series(res_rb)
+    out["compressed_domain"] = {
+        "h2d_bytes_on": int(cd_on_b),
+        "h2d_bytes_off": int(cd_off_b),
+        "h2d_shrink_x": round(cd_off_b / max(cd_on_b, 1), 1),
+        "bit_identical": dig_on == dig_off == dig_rb,
+        "device_decode_ms": round(cd_decode_ms, 3),
+        "compressed_tier_bytes": int(comp_bytes),
+        "decoded_slab_bytes": int(slab_bytes),
+        "residency_density_x": round(slab_bytes / max(comp_bytes, 1),
+                                     1),
+        "compressed_rebuild_hits": int(_DDQ["compressed_hits"]
+                                       - hits0),
+        "rebuild_slab_h2d_bytes": int(rb_slab_b),
+        "dfor_blocks": int(_DDQ["dfor_blocks"]),
+        "host_heals": int(_DDQ["host_heals"]),
+    }
     # serialize phase: stream the 11.5M-cell 1m result (kept from the
     # timing loop — no extra execution) through the chunked encoder
     # (http/serializer — what the HTTP layer emits); measured here
@@ -388,6 +439,35 @@ def run_query_phase(data_dir: str, runs: int,
         "d2h_bytes": xman["d2h"]["manifest"]}
     eng.close()
     return out
+
+
+def _manifest_h2d_total() -> int:
+    """Total H2D bytes across every transfer-manifest site."""
+    from opengemini_tpu.ops import compileaudit
+    m = compileaudit.manifest_snapshot()
+    return sum(v for k, v in m.items()
+               if k.startswith("h2d_") and k.endswith("_bytes"))
+
+
+def _cold_build_h2d(runner, decode_on: bool):
+    """The compressed-domain measurement protocol, shared by the
+    headline ``compressed_domain`` block and the smoke gate so the
+    two can never measure different things: purge the decoded AND
+    compressed device tiers, run ``runner`` cold (with
+    OG_DEVICE_DECODE pinned off when requested), return (runner
+    result, exact H2D byte delta off the transfer manifest)."""
+    import opengemini_tpu.ops.devicecache as _dch
+    _dch.global_cache().purge()
+    _dch.compressed_cache().purge()
+    if not decode_on:
+        knobs.set_env("OG_DEVICE_DECODE", "0")
+    b0 = _manifest_h2d_total()
+    try:
+        out = runner()
+    finally:
+        if not decode_on:
+            knobs.del_env("OG_DEVICE_DECODE")
+    return out, _manifest_h2d_total() - b0
 
 
 def _parse_phases(res: dict) -> dict:
@@ -580,6 +660,10 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         "vs_baseline_pctl": round(cpu["pctl"]["best_s"]
                                   / tpu["pctl"]["best_s"], 3),
         "answer_sized_d2h": tpu.get("answer_sized_d2h", {}),
+        # compressed-domain execution (round 14): the H2D diet on the
+        # 1m heavy shape — device decode on vs off, compressed HBM
+        # tier residency/rebuild, decode-stage wall split
+        "compressed_domain": tpu.get("compressed_domain", {}),
         "phases_ms_heavy": tpu.get("phases_ms_heavy", {}),
         "bit_identical": True,
         "ingest_rows_per_sec": round(n_rows / max(t_ing, 1e-9), 1),
@@ -1088,7 +1172,19 @@ def smoke_phase() -> dict:
                    ("topk-sketch-off-barrier",
                     {"OG_PIPELINE_DEPTH": "0",
                      "OG_DEVICE_TOPK": "0",
-                     "OG_DEVICE_SKETCH": "0"})]
+                     "OG_DEVICE_SKETCH": "0"}),
+                   # compressed-domain gate (round 14): device decode
+                   # of DFOR/CONST slab payloads vs the byte-identical
+                   # host-decode escape hatch (OG_DEVICE_DECODE=0) —
+                   # every cell of every shape, streamed AND single-
+                   # barrier. The sweep loop purges the device+
+                   # compressed caches for these configs so the host
+                   # path actually REBUILDS the slabs it compares
+                   ("device-decode-off", {"OG_PIPELINE_DEPTH": "4",
+                                          "OG_DEVICE_DECODE": "0"}),
+                   ("device-decode-off-barrier",
+                    {"OG_PIPELINE_DEPTH": "0",
+                     "OG_DEVICE_DECODE": "0"})]
         from opengemini_tpu.ops import hbm as _hbm
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires (originals
@@ -1110,6 +1206,13 @@ def smoke_phase() -> dict:
                 for cname, env in configs:
                     for k, v in env.items():
                         os.environ[k] = v
+                    if "OG_DEVICE_DECODE" in env:
+                        # force a cold host-stage rebuild: warm slabs
+                        # (device-decoded by the earlier configs)
+                        # would mask a decode-stage divergence
+                        import opengemini_tpu.ops.devicecache as _dcp
+                        _dcp.global_cache().purge()
+                        _dcp.compressed_cache().purge()
                     if "OG_DEVUTIL_MS" in env:
                         _hbm.sampler().start()
                     try:
@@ -1168,6 +1271,59 @@ def smoke_phase() -> dict:
                 "SMOKE MISMATCH: percentile shape did not route "
                 "through the device order-statistic finalize "
                 "(sketch_dev_grids unchanged)")
+        # --------------------------- compressed-domain gate (round 14)
+        # measured H2D diet on the heavy shape: cold slab build with
+        # device decode (compressed payloads cross the link) vs the
+        # OG_DEVICE_DECODE=0 host build (dense planes cross) — the
+        # manifest attributes every byte, so the ratio is exact
+        import opengemini_tpu.ops.devicecache as _dcs
+        from opengemini_tpu.ops.device_decode import (
+            DECODE_STATS as _DDS)
+
+        (dd_dig_off, _c1), dd_off_b = _cold_build_h2d(
+            lambda: run(QUERY_1M), decode_on=False)
+        (dd_dig_on, _c2), dd_on_b = _cold_build_h2d(
+            lambda: run(QUERY_1M), decode_on=True)
+        if dd_dig_on != dd_dig_off:
+            raise SystemExit("SMOKE MISMATCH: device decode changed "
+                             "heavy-shape bytes")
+        dd_shrink = dd_off_b / max(dd_on_b, 1)
+        if dd_shrink < 3.0:
+            raise SystemExit(
+                f"SMOKE MISMATCH: device decode shrank cold-build "
+                f"H2D only {dd_shrink:.2f}x ({dd_off_b}B -> "
+                f"{dd_on_b}B) — the compressed-domain stage is not "
+                "engaging on the heavy shape")
+        # seeded OOM + transient at the new device.decode.launch
+        # failpoint: the ladder must heal PER BLOCK through the host
+        # stage — digests unchanged, heal counter proven, ledger exact
+        from opengemini_tpu.utils import failpoint as _fpd
+        dd_heals0 = _DDS["host_heals"]
+        for _mode, _hits in (("oom", 2), ("transient", 3)):
+            _dcs.global_cache().purge()
+            _dcs.compressed_cache().purge()
+            _fpd.seed(13)
+            _fpd.enable("device.decode.launch", _mode, maxhits=_hits)
+            try:
+                dig, _cells = run(QUERY_1M)
+            finally:
+                _fpd.disable("device.decode.launch")
+            if dig != dd_dig_on:
+                raise SystemExit(
+                    f"SMOKE MISMATCH: decode-launch {_mode} "
+                    "injection changed heavy-shape bytes")
+        dd_heals = _DDS["host_heals"] - dd_heals0
+        if dd_heals <= 0:
+            raise SystemExit(
+                "SMOKE MISMATCH: decode-launch injections never "
+                "reached the per-block host heal")
+        cross = _hbm.cross_check()
+        if not cross["ok"]:
+            raise SystemExit(
+                f"SMOKE MISMATCH: HBM ledger diverged after the "
+                f"decode-heal gate: {cross}")
+        from opengemini_tpu.ops import devicefault as _dfd
+        _dfd.reset_breakers()
         # f32 fast tier (OG_F32_TIER): NOT bit-identical by design —
         # gated on tolerance against the f64 path, on the dense-window
         # route (block cache off so dense groups actually form), and
@@ -1548,6 +1704,11 @@ def smoke_phase() -> dict:
             "crash_digest_ok": 1,
             "crash_orphans": 0,
             "crash_recovery_ms": round(crash_recovery_ms, 1),
+            # compressed-domain gate (round 14)
+            "dd_h2d_shrink_x": round(dd_shrink, 1),
+            "dd_h2d_bytes_on": int(dd_on_b),
+            "dd_h2d_bytes_off": int(dd_off_b),
+            "dd_decode_heals": int(dd_heals),
             # answer-sized D2H gate (PR 12)
             "topk_d2h_shrink_x": round(topk_shrink, 1),
             "topk_d2h_bytes_on": int(tk_on_b),

@@ -25,7 +25,7 @@ import numpy as np
 
 from ..utils.zstd_compat import zstandard
 
-from . import gorilla, simple8b
+from . import dfor, gorilla, simple8b
 from .bitpack import zigzag_decode, zigzag_encode
 
 # codec ids (shared namespace across column types)
@@ -38,6 +38,19 @@ S8B = 5
 RLE = 6
 GORILLA = 7
 BITPACK = 8
+# device-friendly frame-of-reference bit-packed layout (dfor.py):
+# fixed-width u32 lanes whose decode is shifts+masks — the codec tier
+# ops/device_decode.dfor_expand expands IN-KERNEL so compressed bytes
+# (not dense f64 planes) cross the H2D link
+DFOR = 9
+
+
+def _device_layout_on() -> bool:
+    """Gate for EMITTING the DFOR tier (OG_WRITE_DEVICE_LAYOUT,
+    default on). Decoders dispatch on the codec byte regardless, so
+    flipping the knob never strands written data."""
+    from ..utils import knobs
+    return bool(knobs.get("OG_WRITE_DEVICE_LAYOUT"))
 
 # zstandard (de)compressor objects are not safe for concurrent use from
 # multiple threads; keep one pair per thread (flush/compaction run parallel)
@@ -105,6 +118,14 @@ def encode_integer_block(values: np.ndarray) -> bytes:
             return bytes([S8B]) + payload
     raw = v.tobytes()
     z = _zstd_c_fast(raw)
+    # DFOR replaces the opaque byte tier for ints (delta-friendly data
+    # already took the s8b exits above — those stay the compact host
+    # tier; ints never stack on device): only when it beats BOTH raw
+    # and zstd does the device-layout tier win here
+    if _device_layout_on():
+        df = dfor.encode_int(v)
+        if df is not None and len(df) < min(len(raw), len(z)):
+            return bytes([DFOR]) + df
     if len(z) < len(raw):
         return bytes([ZSTD]) + z
     return bytes([RAW]) + raw
@@ -121,6 +142,8 @@ def decode_integer_block(buf: bytes | memoryview, n: int) -> np.ndarray:
         return np.full(n, struct.unpack("<q", payload[:8])[0], dtype=np.int64)
     if codec == S8B:
         return simple8b.decode(payload, n).view(np.int64)
+    if codec == DFOR:
+        return dfor.decode(payload, n, "i64")
     if codec == DELTA_S8B:
         first = struct.unpack("<q", payload[:8])[0]
         d = zigzag_decode(simple8b.decode(payload[8:], n))
@@ -149,7 +172,16 @@ def encode_float_block(values: np.ndarray, prefer: str = "auto") -> bytes:
         return bytes([RLE]) + payload
     if prefer == "gorilla":
         return bytes([GORILLA]) + gorilla.encode(v)
+    # device-friendly tier: floats are the type the HBM slab path
+    # stacks, so decode locality beats the last % of ratio — DFOR wins
+    # whenever it beats the RAW payload (a 2-decimal gauge packs to
+    # ~14-bit lanes; full-mantissa noise hits width 64 and falls
+    # through to the legacy menu)
     raw = v.tobytes()
+    if _device_layout_on():
+        df = dfor.encode_float(v)
+        if df is not None and len(df) < len(raw):
+            return bytes([DFOR]) + df
     z = _zstd_c_fast(raw)
     if len(z) < len(raw):
         return bytes([ZSTD]) + z
@@ -180,6 +212,8 @@ def decode_float_block(buf: bytes | memoryview, n: int) -> np.ndarray:
         return np.repeat(vals, lens)[:n]
     if codec == GORILLA:
         return gorilla.decode(bytes(payload), n)
+    if codec == DFOR:
+        return dfor.decode(payload, n, "f64")
     raise ValueError(f"bad float codec {codec}")
 
 

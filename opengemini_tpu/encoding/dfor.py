@@ -1,0 +1,268 @@
+"""DFOR — device-friendly frame-of-reference bit-packed numeric layout.
+
+The byte codecs (gorilla / simple8b / zstd) compress well but decode
+SEQUENTIALLY: every value depends on a variable-length prefix, which
+maps to neither the VPU nor a vectorized numpy gather. DFOR trades a
+few percent of ratio for a layout whose decode is pure shifts+masks
+over fixed-width lanes — the "GPU Acceleration of SQL Analytics on
+Compressed Data" design point (PAPERS.md): ship the COMPRESSED bytes
+over H2D and expand in-kernel (ops/device_decode.dfor_expand), instead
+of decoding on host and moving dense f64 planes.
+
+Wire format (after the 1-byte codec id of encoding/blocks.py):
+
+    [transform u8][width u8][dscale u8][pad u8][n u32][ref 8B][words u32…]
+
+One reference value + one bit width per segment; residuals are packed
+little-endian (value i occupies stream bits [i·width, (i+1)·width), bit
+j lives in u32 word j>>5 at lane position j&31). ``width`` is rounded
+UP to a multiple of 2 (shape-class hygiene: the device unpack kernel
+compiles per (width, n) class, so the encoder bounds the class count
+at write time; ≤ 1 wasted bit/value).
+
+Transforms (residual ↔ value, all bit-exact by construction):
+
+    T_INT     zigzag(v − ref) in wrapping int64 (ints/times; ref=v[0])
+    T_XORREF  bits(v) ^ bits(ref)                     (floats)
+    T_XORPRED bits(v_i) ^ bits(v_{i-1}), predecessor of v_0 is ref —
+              decode is a prefix-XOR scan (associative → vectorizes)
+    T_SCALED  zigzag(k − k0) where v == k / 10^dscale EXACTLY in f64 —
+              the decimal-quantized telemetry fast path (a 2-decimal
+              gauge packs to ~14 bits instead of 52 XOR mantissa bits).
+              Eligibility is VERIFIED at encode: every row must satisfy
+              fl(k / 10^dscale) == v bit for bit, so decode (int→f64
+              convert + one IEEE divide) reproduces the stored bits
+              exactly on host and on any real-f64 device backend.
+
+The encoder tries every eligible transform and keeps the narrowest;
+callers (encoding/blocks.py) only emit DFOR when it beats the RAW
+payload, behind ``OG_WRITE_DEVICE_LAYOUT``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .bitpack import bit_widths, zigzag_decode, zigzag_encode
+
+__all__ = ["T_INT", "T_XORREF", "T_XORPRED", "T_SCALED",
+           "HEADER_BYTES", "encode_int", "encode_float", "decode",
+           "parse_header", "payload_words", "unpack_words",
+           "pack_words", "inverse_transform_batch", "decode_batch"]
+
+T_INT = 0
+T_XORREF = 1
+T_XORPRED = 2
+T_SCALED = 3
+
+HEADER_BYTES = 16          # transform, width, dscale, pad, n u32, ref
+
+# largest decimal scale T_SCALED probes: 10^6 keeps k·scale round-trip
+# error far below 0.5 ulp for |k| < 2^51 (the verify step is still the
+# authority — this only bounds the probe loop)
+_MAX_DSCALE = 6
+
+_U64_1 = np.uint64(1)
+_U64_5 = np.uint64(5)
+_U64_31 = np.uint64(31)
+_U64_32 = np.uint64(32)
+_U64_64 = np.uint64(64)
+
+
+def _round_width(w: int) -> int:
+    """Shape-class hygiene: widths quantize to multiples of 2 so the
+    per-(width, n) device kernel classes stay bounded (≤ 32 widths)."""
+    return min(64, (int(w) + 1) & ~1)
+
+
+def pack_words(r: np.ndarray, width: int) -> np.ndarray:
+    """Pack (n,) uint64 residuals into little-endian u32 lanes."""
+    n = len(r)
+    if n == 0 or width == 0:
+        return np.zeros(0, dtype=np.uint32)
+    r = r.astype(np.uint64, copy=False)
+    if width == 64:
+        # degenerate lane width: the packed stream IS the raw
+        # little-endian bytes — one view, not the (n, 64) bit-matrix
+        # intermediate (512 B/value of temp on the flush hot path)
+        return np.ascontiguousarray(r).view("<u4").astype(
+            np.uint32, copy=False)
+    shifts = np.arange(width, dtype=np.uint64)
+    bits = ((r[:, None] >> shifts[None, :]) & _U64_1).astype(np.uint8)
+    packed = np.packbits(bits.reshape(-1), bitorder="little")
+    pad = (-len(packed)) % 4
+    if pad:
+        packed = np.concatenate(
+            [packed, np.zeros(pad, dtype=np.uint8)])
+    return packed.view("<u4").copy()
+
+
+def unpack_words(words: np.ndarray, n: int, width: int) -> np.ndarray:
+    """Inverse of pack_words; ``words`` may be (nw,) or batched
+    (nb, nw) — returns (n,) / (nb, n) uint64. The 3-word gather+shift
+    form here is the SAME arithmetic the device kernel runs
+    (ops/device_decode), so host/device parity is by construction."""
+    shape = words.shape[:-1] + (n,)
+    if n == 0 or width == 0:
+        return np.zeros(shape, dtype=np.uint64)
+    w64 = np.concatenate(
+        [words.astype(np.uint64),
+         np.zeros(words.shape[:-1] + (2,), dtype=np.uint64)], axis=-1)
+    pos = np.arange(n, dtype=np.uint64) * np.uint64(width)
+    iw = (pos >> _U64_5).astype(np.int64)
+    off = pos & _U64_31
+    lo = w64[..., iw]
+    mid = w64[..., iw + 1]
+    hi = w64[..., iw + 2]
+    r = (lo >> off) | (mid << (_U64_32 - off))
+    s3 = (_U64_64 - off) % _U64_64
+    r = r | np.where(off > 0, hi << s3, np.uint64(0))
+    if width < 64:
+        r = r & np.uint64((1 << width) - 1)
+    return r
+
+
+def _header(transform: int, width: int, dscale: int, n: int,
+            ref_u64: int) -> bytes:
+    return struct.pack("<BBBBIQ", transform, width, dscale, 0, n,
+                       ref_u64 & 0xFFFFFFFFFFFFFFFF)
+
+
+def parse_header(payload) -> tuple[int, int, int, int, int]:
+    """payload (after the codec byte) → (transform, width, dscale, n,
+    ref_u64)."""
+    transform, width, dscale, _pad, n, ref = struct.unpack(
+        "<BBBBIQ", bytes(payload[:HEADER_BYTES]))
+    return transform, width, dscale, n, ref
+
+
+def payload_words(payload, n: int, width: int) -> np.ndarray:
+    """The packed u32 lane array of one DFOR payload."""
+    nw = (n * width + 31) // 32
+    return np.frombuffer(bytes(payload[HEADER_BYTES:
+                                       HEADER_BYTES + 4 * nw]),
+                         dtype="<u4").astype(np.uint32, copy=False)
+
+
+# ------------------------------------------------------------ encode
+
+def _try_scaled(v: np.ndarray):
+    """(dscale, k int64) when v is exactly k/10^dscale in f64, else
+    None. Verified bit-for-bit — np.rint only proposes."""
+    if len(v) == 0 or not np.isfinite(v).all():
+        return None
+    vu = v.view(np.uint64)
+    for d in range(_MAX_DSCALE + 1):
+        scale = np.float64(10.0 ** d)
+        k = np.rint(v * scale)
+        if not np.isfinite(k).all() or np.abs(k).max() >= 2.0 ** 51:
+            return None            # larger d only grows k
+        ki = k.astype(np.int64)
+        if np.array_equal((ki / scale).view(np.uint64), vu):
+            return d, ki
+    return None
+
+
+def _zz_residuals(ki: np.ndarray):
+    """(residuals u64, ref u64-bits) — zigzag deltas against the first
+    value, in wrapping 64-bit arithmetic (zigzag extremes round-trip
+    through the wrap)."""
+    ref = int(ki[0]) & 0xFFFFFFFFFFFFFFFF
+    with np.errstate(over="ignore"):
+        d = ki.view(np.uint64) - np.uint64(ref)
+    return zigzag_encode(d.view(np.int64)), ref
+
+
+def encode_int(values: np.ndarray) -> bytes | None:
+    """DFOR payload for an int64/time block (T_INT), or None when the
+    packed form cannot beat the raw payload (width 64)."""
+    v = np.ascontiguousarray(values, dtype=np.int64)
+    n = len(v)
+    if n == 0:
+        return None
+    r, ref = _zz_residuals(v)
+    width = _round_width(int(bit_widths(r).max()) if n else 0)
+    if width >= 64:
+        return None
+    words = pack_words(r, width)
+    return _header(T_INT, width, 0, n, ref) + words.tobytes()
+
+
+def encode_float(values: np.ndarray) -> bytes | None:
+    """DFOR payload for an f64 block: narrowest of T_SCALED /
+    T_XORPRED / T_XORREF (bit-exact all three), or None for n == 0."""
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    n = len(v)
+    if n == 0:
+        return None
+    u = v.view(np.uint64)
+    cands: list[tuple[int, int, int, int, np.ndarray]] = []
+    sc = _try_scaled(v)
+    if sc is not None:
+        d, ki = sc
+        r, ref = _zz_residuals(ki)
+        cands.append((_round_width(int(bit_widths(r).max())),
+                      T_SCALED, d, ref, r))
+    r_pred = u ^ np.concatenate([u[:1], u[:-1]])
+    cands.append((_round_width(int(bit_widths(r_pred).max())),
+                  T_XORPRED, 0, int(u[0]), r_pred))
+    r_ref = u ^ u[0]
+    cands.append((_round_width(int(bit_widths(r_ref).max())),
+                  T_XORREF, 0, int(u[0]), r_ref))
+    width, transform, dscale, ref, r = min(
+        cands, key=lambda c: (c[0], c[1]))
+    words = pack_words(r, width)
+    return _header(transform, width, dscale, n, ref) + words.tobytes()
+
+
+# ------------------------------------------------------------ decode
+
+def inverse_transform_batch(r: np.ndarray, refs: np.ndarray,
+                            transform: int, dscale: int,
+                            kind: str) -> np.ndarray:
+    """Residuals (nb, n) u64 + per-row refs (nb,) u64 → decoded values
+    (nb, n), f64 (kind \"f64\") or i64. Shared by the per-segment host
+    decoder, the bulk flat-scan group decoder (query/scan.py) and the
+    host half of the device parity tests."""
+    refs = refs.astype(np.uint64, copy=False)[..., None]
+    if transform in (T_INT, T_SCALED):
+        with np.errstate(over="ignore"):
+            k = (zigzag_decode(r).view(np.uint64)
+                 + refs).view(np.int64)
+        if transform == T_INT:
+            return k if kind == "i64" else k.astype(np.float64)
+        return k / np.float64(10.0 ** dscale)
+    if transform == T_XORREF:
+        u = r ^ refs
+    elif transform == T_XORPRED:
+        u = np.bitwise_xor.accumulate(r, axis=-1) ^ refs
+    else:
+        raise ValueError(f"bad DFOR transform {transform}")
+    return u.view(np.float64) if kind == "f64" else u.view(np.int64)
+
+
+def decode_batch(words: np.ndarray, refs: np.ndarray, n: int,
+                 width: int, transform: int, dscale: int,
+                 kind: str) -> np.ndarray:
+    """Vectorized decode of a BATCH of same-shape DFOR segments:
+    (nb, nw) u32 words + (nb,) refs → (nb, n) values. One numpy pass
+    regardless of nb — the flat-scan group decoder's workhorse."""
+    r = unpack_words(words, n, width)
+    return inverse_transform_batch(r, refs, transform, dscale, kind)
+
+
+def decode(payload, n: int, kind: str) -> np.ndarray:
+    """One segment: DFOR payload (after the codec byte) → (n,) values.
+    ``kind`` is \"f64\" or \"i64\" (the column type decides — the
+    payload serves either view of T_INT)."""
+    transform, width, dscale, n_hdr, ref = parse_header(payload)
+    if n_hdr != n:
+        raise ValueError(f"DFOR row-count mismatch: header {n_hdr}, "
+                         f"caller {n}")
+    words = payload_words(payload, n, width)
+    out = decode_batch(words[None, :],
+                       np.array([ref], dtype=np.uint64),
+                       n, width, transform, dscale, kind)
+    return out[0]

@@ -170,6 +170,7 @@ class HttpServer:
                 store_database=self.config.stats.store_database)
             from ..utils.stats import (compaction_collector,
                                        device_collector,
+                                       device_decode_collector,
                                        devicecache_collector,
                                        executor_collector, raft_collector,
                                        rpc_collector, subscriber_collector,
@@ -178,6 +179,8 @@ class HttpServer:
             sp.register("readcache", readcache_collector)
             sp.register("executor", executor_collector)
             sp.register("devicecache", devicecache_collector)
+            sp.register("device_decode",
+                        device_decode_collector)
             sp.register("device", device_collector)
             from ..ops.devstats import phase_collector
             sp.register("query_phases", phase_collector)
@@ -916,6 +919,7 @@ class HttpServer:
         from ..utils.stats import (compaction_collector,
                                    compileaudit_collector,
                                    device_collector,
+                                   device_decode_collector,
                                    devicecache_collector,
                                    devicefault_collector,
                                    engine_collector, executor_collector,
@@ -930,6 +934,7 @@ class HttpServer:
                   "readcache": readcache_collector(),
                   "executor": executor_collector(),
                   "devicecache": devicecache_collector(),
+                  "device_decode": device_decode_collector(),
                   "device": device_collector(),
                   "query_phases": phase_collector(),
                   "scheduler": scheduler_collector(),
@@ -1604,7 +1609,8 @@ class _Handler(BaseHTTPRequestHandler):
             # attaching EXPLAIN ANALYZE
             from ..ops.devstats import device_collector, phase_collector
             from ..storage.wal import recovery_summary
-            from ..utils.stats import (devicecache_collector,
+            from ..utils.stats import (device_decode_collector,
+                                       devicecache_collector,
                                        devicefault_collector,
                                        hbm_collector,
                                        histogram_summaries,
@@ -1613,6 +1619,7 @@ class _Handler(BaseHTTPRequestHandler):
             out = dict(srv.stats)
             out["device"] = device_collector()
             out["devicecache"] = devicecache_collector()
+            out["device_decode"] = device_decode_collector()
             out["query_phases"] = phase_collector()
             out["scheduler"] = scheduler_collector()
             out["hbm"] = hbm_collector()

@@ -15,7 +15,11 @@ Roots recognized:
   ``jax.jit(partial(f, ...))`` call;
 - Pallas kernels passed to ``pl.pallas_call(kernel, ...)`` — the
   kernel body is traced exactly like jit code (ops/pallas_agg.py is
-  the f32 fast tier this matters for).
+  the f32 fast tier this matters for), including kernels built
+  through ``functools.partial`` and through kernel FACTORIES
+  (``pl.pallas_call(make_kernel(...), ...)`` roots every function
+  defined inside ``make_kernel`` — ops/device_decode's DFOR
+  bit-unpack kernel is built this way).
 
 Closure: every function lexically reachable from a root by same-module
 call-by-name (cross-module helpers are ops-layer jnp code in
@@ -150,6 +154,24 @@ def traced_functions(tree: ast.AST) -> dict[str, TracedFn]:
                 nm = dotted(arg0.args[0])
                 static = {kw.arg for kw in arg0.keywords
                           if kw.arg is not None}
+            elif not nm and isinstance(arg0, ast.Call) and \
+                    dotted(arg0.func) in by_name:
+                # pl.pallas_call(make_kernel(...), ...) — a kernel
+                # FACTORY (ops/device_decode._mk_unpack_kernel): the
+                # closure it returns is the traced body, so every
+                # function defined INSIDE the factory roots as a
+                # pallas kernel, with the factory's parameters static
+                # (trace-time constants baked into the closure).
+                # Without this, R5/R9 coverage would stop at the
+                # factory call and never see the kernel body.
+                fac = by_name[dotted(arg0.func)]
+                static = {a.arg for a in fac.args.args}
+                for sub in ast.walk(fac):
+                    if isinstance(sub, ast.FunctionDef) \
+                            and sub is not fac:
+                        roots.append(TracedFn(sub, root=True,
+                                              pallas=True,
+                                              static=set(static)))
             if nm in by_name:
                 roots.append(TracedFn(by_name[nm], root=True,
                                       pallas=True, static=static))

@@ -46,6 +46,20 @@ _BOOK_KEYS = {"h2d_bytes", "slab_bytes"}
 # RESIDENCY, not transfer, and must not satisfy this rule
 _BOOK_FNS = {"record_h2d"}
 
+# R1002: the manifest site-label sets are CLOSED — every record_h2d /
+# record_d2h call must name a LITERAL from them, so the per-site
+# attribution can be audited statically and an unknown/variable label
+# cannot slip bytes into the manifest under a name the cross-check
+# gates never see. MIRROR of ops/compileaudit.{H2D,D2H}_SITES —
+# duplicated here so the linter stays jax-import-free; drift between
+# the two is pinned by tests/test_oglint.py.
+_H2D_SITE_SET = {"slab", "limbs", "planes", "gids", "latcells",
+                 "scalars", "pplan", "decode", "dfor", "payload",
+                 "mesh", "sketch", "other"}
+_D2H_SITE_SET = {"stream", "batch", "segagg", "finalize", "repair",
+                 "topk", "decode", "other"}
+_FUNNELS = {"record_h2d": _H2D_SITE_SET, "record_d2h": _D2H_SITE_SET}
+
 
 def _in_scope(path: str) -> bool:
     if path in _EXEMPT:
@@ -75,11 +89,55 @@ class LaunchRule(Rule):
     codes = {
         "R1001": "unbooked H2D upload (device_put/jnp.asarray without "
                  "h2d byte accounting)",
+        "R1002": "transfer-manifest booking with a non-literal or "
+                 "undeclared site label",
     }
 
     def check(self, ctx: FileCtx) -> list[Violation]:
         if not _in_scope(ctx.path):
             return []
+        out = self._check_sites(ctx)
+        out.extend(self._check_uploads(ctx))
+        return out
+
+    def _check_sites(self, ctx: FileCtx) -> list[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            base = d.split(".")[-1] if d else ""
+            declared = _FUNNELS.get(base)
+            if declared is None:
+                continue
+            # positional OR keyword form — record_h2d(site=..., ...)
+            # must not slip past the closed-set audit
+            site = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "site"), None)
+            if site is None:
+                continue
+            if not (isinstance(site, ast.Constant)
+                    and isinstance(site.value, str)):
+                out.append(Violation(
+                    ctx.path, node.lineno, "R1002",
+                    f"{base}() site label must be a string LITERAL "
+                    "from the closed manifest set (a variable label "
+                    "defeats static attribution audit); accounted "
+                    "transports that thread a caller label live in "
+                    "the exempt modules only"))
+                continue
+            if site.value not in declared:
+                out.append(Violation(
+                    ctx.path, node.lineno, "R1002",
+                    f"{base}() books to undeclared manifest site "
+                    f"{site.value!r} — add it to ops/compileaudit."
+                    f"{'H2D' if base == 'record_h2d' else 'D2H'}"
+                    "_SITES AND the mirror set in lint/launch_rule.py "
+                    "in one reviewed change"))
+        return out
+
+    def _check_uploads(self, ctx: FileCtx) -> list[Violation]:
         traced = set(traced_functions(ctx.tree))
         # map every node to its enclosing function (innermost)
         out = []

@@ -21,4 +21,5 @@ from .segment_agg import (  # noqa: E402
 from .ogsketch import OGSketch  # noqa: E402
 from .device_decode import (  # noqa: E402
     const_delta_expand, const_expand, device_decode_float_block,
-    device_decode_time_block, rle_expand)
+    device_decode_int_block, device_decode_time_block, dfor_expand,
+    rle_expand)

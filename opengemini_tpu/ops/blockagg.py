@@ -231,9 +231,447 @@ class _TimeColMeta:
 _TimeCol = _TimeColMeta()
 
 
+# ------------------------------------ device-decode slab build
+#
+# The compressed-domain H2D diet (ROADMAP item 2): when a slab's
+# blocks carry device-expandable codecs (DFOR bit-packed lanes /
+# CONST values / CONST_DELTA times — query/decodestage.block_stage
+# picks the stage per block), the COMPRESSED payloads are what
+# crosses H2D; ops/device_decode expands them in-kernel and the limb
+# decomposition runs on device from the expanded planes. A 34 B/row
+# host-assembled slab (values+times+valid+bad+limbs) becomes ~2 B/row
+# of payload on the 2-decimal bench data. Blocks the device cannot
+# take — and any batch whose expand launch exhausts the PR 9 fault
+# ladder — heal PER BLOCK through the host stage (decode + dense
+# device_put, manifest site "slab"), so a sick kernel degrades one
+# batch, not the file.
+
+def _build_slab_device(reader, field: str, metas, seg: int, E: int,
+                       block0: int):
+    """Device-decode twin of _build_slab. Returns (BlockStack with
+    FULL-K limb planes, (K,) device activity flags, rebuild recipe) —
+    get_stacks slices the limb range and stakes the recipe into the
+    compressed HBM tier — or raises DeviceRouteDown when the decode
+    ladder exhausts beyond per-batch healing (caller falls back to
+    the host build)."""
+    import jax
+
+    from ..encoding import blocks as EB
+    from ..encoding import dfor as _dfm
+    from ..query import decodestage
+    from . import compileaudit, device_decode as dd
+
+    mm = reader._mm
+    B = len(metas)
+    sids = np.empty(B, dtype=np.int64)
+    tmin = np.full(B, I64MAX, dtype=np.int64)
+    tmax = np.full(B, I64MIN, dtype=np.int64)
+    steps = np.ones(B, dtype=np.int64)
+    rows_arr = np.zeros(B, dtype=np.int64)
+    all_const = True
+    refs: list = []
+    n_rows = 0
+    vbw = (seg + 7) // 8              # validity bitmap row width
+
+    dfor_groups: dict[tuple, list] = {}   # (w, tr, ds, r) → [(b, ref, words)]
+    const_blocks: list = []               # (b, value)
+    host_blocks: list = []                # block indices
+    cdelta_blocks: list = []                 # (b, t0, step) device times
+    vbits: dict[int, np.ndarray | None] = {}   # b → bitmap | None=CONST
+
+    for b, (sid, colm, s, tseg) in enumerate(metas):
+        sids[b] = sid
+        refs.append((colm, s))
+        r = s.rows
+        rows_arr[b] = r
+        n_rows += r
+        if r == 0:
+            host_blocks.append(b)     # zeros/I64MAX staging, no decode
+            continue
+        vcodec = mm[s.offset]
+        tcodec = mm[tseg.offset]
+        if decodestage.block_stage(vcodec, tcodec) != "device":
+            host_blocks.append(b)
+            continue
+        t0, step = struct_unpack_qq(mm, tseg.offset + 1)
+        tmin[b] = t0
+        tmax[b] = t0 + (r - 1) * step
+        if r > 1:
+            if step > 0:
+                steps[b] = step
+            else:
+                all_const = False
+        if vcodec == EB.DFOR:
+            hdr = mm[s.offset + 1:s.offset + 1 + _dfm.HEADER_BYTES]
+            tr, w, ds, n_hdr, ref = _dfm.parse_header(hdr)
+            if n_hdr != r:
+                host_blocks.append(b)
+                continue
+            nw = (r * w + 31) // 32
+            words = np.frombuffer(
+                mm[s.offset + 1 + _dfm.HEADER_BYTES:
+                   s.offset + 1 + _dfm.HEADER_BYTES + 4 * nw],
+                dtype="<u4")
+            dfor_groups.setdefault((w, tr, ds, r), []).append(
+                (b, ref, words))
+        else:                         # CONST float value
+            val = np.frombuffer(mm[s.offset + 1:s.offset + 9],
+                                dtype=np.float64)[0]
+            const_blocks.append((b, val))
+        vb0 = mm[s.valid_offset]
+        if vb0 == EB.CONST:
+            vbits[b] = None
+        else:
+            bm = np.zeros(vbw, dtype=np.uint8)
+            raw = np.frombuffer(
+                mm[s.valid_offset + 1:s.valid_offset + s.valid_size],
+                dtype=np.uint8)
+            bm[:len(raw)] = raw[:vbw]
+            vbits[b] = bm
+        cdelta_blocks.append((b, t0, step))
+
+    if not cdelta_blocks:
+        raise _AllHostSlab()
+
+    # ---- stage + upload the compressed payloads --------------------
+    def _pad_rows(mat, nb_pad):
+        if mat.shape[0] == nb_pad:
+            return mat
+        out = np.zeros((nb_pad,) + mat.shape[1:], dtype=mat.dtype)
+        out[:mat.shape[0]] = mat
+        return out
+
+    recipe: dict = {"seg": seg, "E": E, "block0": block0,
+                    "sids": sids, "refs": refs, "tmin": tmin,
+                    "tmax": tmax, "steps": steps, "rows": rows_arr,
+                    "all_const": all_const, "n_rows": n_rows,
+                    "dfor": [], "const": None, "host": None,
+                    "hsegs": [], "tbatch": None, "vbatch": None,
+                    "perm": None, "tperm": None, "k0": 0, "k1": 0}
+
+    for (w, tr, ds, r), blks in sorted(dfor_groups.items()):
+        nb = len(blks)
+        nb_pad = dd.pad_pow2(nb, 8)
+        nw = (r * w + 31) // 32
+        wmat = np.zeros((nb_pad, nw + 2), dtype=np.uint32)
+        rvec = np.zeros(nb_pad, dtype=np.uint64)
+        for j, (_b, ref, words) in enumerate(blks):
+            wmat[j, :nw] = words
+            rvec[j] = ref
+        wd = jax.device_put(wmat)
+        rd = jax.device_put(rvec)
+        compileaudit.record_h2d("dfor", int(wd.nbytes))
+        compileaudit.record_h2d("payload", int(rd.nbytes))
+        recipe["dfor"].append((wd, rd, w, tr, ds, r,
+                               [b for b, _r, _w in blks]))
+
+    if const_blocks:
+        nb_pad = dd.pad_pow2(len(const_blocks), 8)
+        cvals = _pad_rows(np.array([v for _b, v in const_blocks],
+                                   dtype=np.float64), nb_pad)
+        crows = _pad_rows(rows_arr[[b for b, _v in const_blocks]],
+                          nb_pad)
+        cvd, crd = jax.device_put(cvals), jax.device_put(crows)
+        compileaudit.record_h2d("payload",
+                                int(cvd.nbytes + crd.nbytes))
+        recipe["const"] = (cvd, crd, [b for b, _v in const_blocks])
+
+    # host-stage blocks (legacy codecs, empty, ragged headers): the
+    # per-block host heal target — decode + dense upload (site "slab")
+    if host_blocks:
+        _stage_host_blocks(reader, metas, host_blocks, seg, tmin,
+                           tmax, steps, rows_arr, recipe)
+
+    ndev = len(cdelta_blocks)
+    nd_pad = dd.pad_pow2(ndev, 8)
+    t0s = _pad_rows(np.array([t for _b, t, _s in cdelta_blocks],
+                             dtype=np.int64), nd_pad)
+    stp = _pad_rows(np.array([s_ for _b, _t, s_ in cdelta_blocks],
+                             dtype=np.int64), nd_pad)
+    drw = _pad_rows(rows_arr[[b for b, _t, _s in cdelta_blocks]], nd_pad)
+    bitm = np.zeros((nd_pad, vbw), dtype=np.uint8)
+    cflag = np.zeros(nd_pad, dtype=np.bool_)
+    for j, (b, _t, _s) in enumerate(cdelta_blocks):
+        if vbits[b] is None:
+            cflag[j] = True
+        else:
+            bitm[j] = vbits[b]
+    t0d, stpd, drwd = (jax.device_put(t0s), jax.device_put(stp),
+                       jax.device_put(drw))
+    bitd, cfd = jax.device_put(bitm), jax.device_put(cflag)
+    compileaudit.record_h2d("payload", int(
+        t0d.nbytes + stpd.nbytes + drwd.nbytes + bitd.nbytes
+        + cfd.nbytes))
+    recipe["tbatch"] = (t0d, stpd, drwd, bitd, cfd,
+                        [b for b, _t, _s in cdelta_blocks])
+
+    # permutations: meta order ← concatenated batch order
+    recipe["perm"], recipe["tperm"] = _recipe_perms(recipe, B)
+    st, act = _expand_recipe(recipe, reader, field, guarded=True)
+    return st, act, recipe
+
+
+class _AllHostSlab(Exception):
+    """Internal: no device-decodable block in this slab — the caller
+    takes the plain host build (not a fault, no breaker charge)."""
+
+
+def struct_unpack_qq(mm, off: int):
+    import struct as _s
+    return _s.unpack("<qq", mm[off:off + 16])
+
+
+def _stage_host_blocks(reader, metas, host_blocks, seg, tmin, tmax,
+                       steps, rows_arr, recipe):
+    """Per-block host-decode staging: decode the listed blocks on
+    host (values + times + validity), upload them as dense plane rows
+    (manifest site \"slab\" — the same bytes the legacy build would
+    have moved for them), and record their time bounds/steps. The
+    recipe keeps only the (colm, seg, tseg) refs (``hsegs``): the
+    dense planes themselves must NOT live in the compressed tier —
+    they are exactly as big as the decoded slabs the relief ladder
+    evicts first, so a rebuild re-stages them lazily instead
+    (_restage_host)."""
+    nbh = len(host_blocks)
+    all_const = recipe["all_const"]
+    for b in host_blocks:
+        _sid, colm, s, tseg = metas[b]
+        recipe["hsegs"].append((b, colm, s, tseg))
+        r = s.rows
+        if r == 0:
+            continue
+        tv = reader.read_segment(_TimeCol, tseg)
+        tmin[b] = tv.values[0]
+        tmax[b] = tv.values[r - 1]
+        if r > 1:
+            d = int(tv.values[1]) - int(tv.values[0])
+            if d > 0 and np.all(np.diff(tv.values) == d):
+                steps[b] = d
+            else:
+                all_const = False
+    recipe["host"] = "lazy"
+    recipe["all_const"] = all_const
+
+
+def _restage_host(reader, recipe):
+    """Decode + upload the host-stage blocks of one recipe (first
+    build AND compressed-tier rebuild — the planes are deliberately
+    not kept resident, see _stage_host_blocks). Returns
+    (values, valid, times, idxs) device planes."""
+    import jax
+
+    from . import compileaudit
+    seg = recipe["seg"]
+    hsegs = recipe["hsegs"]
+    nbh = len(hsegs)
+    hv = np.zeros((nbh, seg), dtype=np.float64)
+    hm = np.zeros((nbh, seg), dtype=np.bool_)
+    ht = np.full((nbh, seg), I64MAX, dtype=np.int64)
+    for j, (b, colm, s, tseg) in enumerate(hsegs):
+        r = s.rows
+        if r == 0:
+            continue
+        cv = reader.read_segment(colm, s)
+        tv = reader.read_segment(_TimeCol, tseg)
+        hv[j, :r] = cv.values.astype(np.float64, copy=False)
+        hm[j, :r] = cv.valid
+        ht[j, :r] = tv.values
+    hvd, hmd, htd = (jax.device_put(hv), jax.device_put(hm),
+                     jax.device_put(ht))
+    compileaudit.record_h2d("slab", int(
+        hvd.nbytes + hmd.nbytes + htd.nbytes))
+    return hvd, hmd, htd, [b for b, _c, _s, _t in hsegs]
+
+
+def _recipe_perms(recipe: dict, B: int):
+    """(values perm, times/valid perm): meta index → flat position in
+    the concatenated batch outputs (padded batch rows are never
+    selected)."""
+    perm = np.zeros(B, dtype=np.int32)
+    pos = 0
+    from . import device_decode as dd
+    for _wd, _rd, _w, _tr, _ds, _r, idxs in recipe["dfor"]:
+        for j, b in enumerate(idxs):
+            perm[b] = pos + j
+        pos += dd.pad_pow2(len(idxs), 8)
+    if recipe["const"] is not None:
+        _cv, _cr, idxs = recipe["const"]
+        for j, b in enumerate(idxs):
+            perm[b] = pos + j
+        pos += dd.pad_pow2(len(idxs), 8)
+    hidxs = [b for b, _c, _s, _t in recipe["hsegs"]]
+    for j, b in enumerate(hidxs):
+        perm[b] = pos + j
+    pos += len(hidxs)
+    tperm = np.zeros(B, dtype=np.int32)
+    tb = recipe["tbatch"]
+    tpos = 0
+    if tb is not None:
+        idxs = tb[5]
+        for j, b in enumerate(idxs):
+            tperm[b] = tpos + j
+        tpos += dd.pad_pow2(len(idxs), 8)
+    for j, b in enumerate(hidxs):
+        tperm[b] = tpos + j
+    return perm, tperm
+
+
+def _expand_recipe(recipe: dict, reader, field: str,
+                   guarded: bool = True):
+    """Run the expansion kernels of one staged/recipe'd slab →
+    (BlockStack with full-K limbs, (K,) activity flags). Shared by
+    the first build and the compressed-tier rebuild (which re-enters
+    with the SAME device-resident payloads and therefore zero H2D).
+    Expand launches ride breaker route \"block\" under the PR 9 fault
+    ladder at the ``device.decode.launch`` failpoint; a batch whose
+    ladder exhausts heals through the host stage per block."""
+    import jax
+
+    from . import compileaudit, device_decode as dd, exactsum
+    from .devicefault import DeviceRouteDown, guarded_launch
+
+    import jax.numpy as jnp
+
+    seg = recipe["seg"]
+    E = recipe["E"]
+
+    def _launch(fn):
+        if not guarded:
+            return fn()
+        return guarded_launch("block", fn,
+                              site="device.decode.launch",
+                              success_resets=False)
+
+    val_parts: list = []
+    for (wd, rd, w, tr, ds, r, idxs) in recipe["dfor"]:
+        try:
+            out = _launch(lambda: dd.fit_rows(dd.dfor_expand(
+                wd, rd, n=r, width=w, transform=tr, dscale=ds,
+                kind="f64"), seg))
+            dd._bump("dfor_blocks", len(idxs))
+        except DeviceRouteDown:
+            out = _heal_batch(reader, recipe["refs"], idxs,
+                              wd.shape[0], seg)
+        val_parts.append(out)
+    if recipe["const"] is not None:
+        cvd, crd, idxs = recipe["const"]
+        try:
+            out = _launch(lambda: dd.const_expand_batch(cvd, crd,
+                                                        seg))
+            dd._bump("const_blocks", len(idxs))
+        except DeviceRouteDown:
+            out = _heal_batch(reader, recipe["refs"], idxs,
+                              cvd.shape[0], seg)
+        val_parts.append(out)
+    host_planes = None
+    if recipe["host"] is not None:
+        # host-stage blocks re-decode + upload HERE on every expand:
+        # keeping their dense planes in the compressed tier would
+        # make it exactly as heavy as the decoded tier it rebuilds
+        host_planes = _restage_host(reader, recipe)
+        val_parts.append(host_planes[0])
+    if recipe.get("meta_dev") is None:
+        # per-slab device metadata uploads ONCE — the recipe keeps
+        # them resident so a compressed-tier rebuild moves 0 bytes
+        md = (jax.device_put(np.float64(recipe["block0"])),
+              jax.device_put(recipe["tmin"]),
+              jax.device_put(recipe["steps"]),
+              jax.device_put(recipe["rows"].astype(np.int32)),
+              jax.device_put(recipe["perm"]),
+              jax.device_put(recipe["tperm"]))
+        compileaudit.record_h2d("payload",
+                                sum(int(a.nbytes) for a in md))
+        recipe["meta_dev"] = md
+    block0_d, t0min_d, steps_d, rows32_d, perm_d, tperm_d = \
+        recipe["meta_dev"]
+    values = dd.permute_blocks(
+        val_parts[0] if len(val_parts) == 1
+        else jnp.concatenate(val_parts, axis=0), perm_d)
+
+    t0d, stpd, drwd, bitd, cfd, dev_idxs = recipe["tbatch"]
+    dd._bump("time_blocks", len(dev_idxs))
+    times_parts = [_launch(lambda: dd.times_expand_batch(
+        t0d, stpd, drwd, seg))]
+    valid_parts = [_launch(lambda: dd.validity_expand_batch(
+        bitd, cfd, drwd, seg))]
+    if host_planes is not None:
+        times_parts.append(host_planes[2])
+        valid_parts.append(host_planes[1])
+    times = dd.permute_blocks(
+        times_parts[0] if len(times_parts) == 1
+        else jnp.concatenate(times_parts, axis=0), tperm_d)
+    valid = dd.permute_blocks(
+        valid_parts[0] if len(valid_parts) == 1
+        else jnp.concatenate(valid_parts, axis=0), tperm_d)
+
+    scale0 = dd.limb_scale_dev(E)
+    limbs, bad, act = _launch(
+        lambda: dd.limbs_decompose(values, valid, scale0))
+
+    st = BlockStack(reader.path, field, seg, E, recipe["sids"],
+                    recipe["refs"], recipe["n_rows"], recipe["tmin"],
+                    recipe["tmax"], recipe["block0"])
+    st.values = values
+    st.valid = valid
+    st.times = times
+    st.limbs = limbs                  # full K — get_stacks slices
+    st.bad = bad
+    st.block0_dev = block0_d
+    st.t_rows = recipe["rows"]
+    st.all_const = recipe["all_const"]
+    st.t0_dev = t0min_d
+    st.step_dev = steps_d
+    st.rows_dev = rows32_d
+    return st, act
+
+
+def _heal_batch(reader, seg_refs, idxs, nb_pad: int, seg: int):
+    """Per-block host-decode heal of ONE faulted expand batch: the
+    same dense rows the device would have produced, decoded by the
+    host stage and uploaded (site \"slab\"). ``seg_refs`` is the
+    recipe's per-block (colmeta, segment) list, so the heal works on
+    first builds AND compressed-tier rebuilds alike."""
+    import jax
+
+    from . import compileaudit, device_decode as dd
+    hv = np.zeros((nb_pad, seg), dtype=np.float64)
+    for j, b in enumerate(idxs):
+        colm, s = seg_refs[b]
+        if s.rows:
+            cv = reader.read_segment(colm, s)
+            hv[j, :s.rows] = cv.values.astype(np.float64, copy=False)
+    hvd = jax.device_put(hv)
+    compileaudit.record_h2d("slab", int(hvd.nbytes))
+    dd._bump("host_heals", len(idxs))
+    return hvd
+
+
+def _slice_limb_range(limbs_dev, k0: int, k1: int):
+    """Device row-select of the active limb-plane range (the host
+    build uploads only [k0, k1); the device build decomposed all K
+    and slices once the file-wide range is known)."""
+    import jax.numpy as jnp
+    K = int(limbs_dev.shape[2])
+    if k0 == 0 and k1 == K:
+        return limbs_dev
+    key = ("lslice", K, k0, k1)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(x):
+            return x[:, :, k0:k1]
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(limbs_dev)
+
+
 def get_stacks(reader, field: str) -> list[BlockStack] | None:
     """Cached slab list for (file, field); None when the column can't
-    stack (missing, non-float) — negative results cache too."""
+    stack (missing, non-float) — negative results cache too. The
+    decode stage is pluggable per block (query/decodestage.py): when
+    the device stage serves a file, compressed payloads cross H2D and
+    expand in-kernel, and the payload recipe stakes into the
+    compressed HBM tier so a later slab eviction rebuilds with ZERO
+    H2D; OG_DEVICE_DECODE=0 (or any ineligible file/backend) takes
+    the classic host build below, byte-identical planes either way."""
     if not devicecache.enabled():
         return None
     cache = devicecache.global_cache()
@@ -243,35 +681,40 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
         return None
     if got is not None:
         return got
-    layout = _file_layout(reader, field)
-    if layout is None:
-        cache.put(key, _NO_STACK)
-        return None
-    metas, seg, E = layout
-    built = []
-    block0 = 0
-    K = exactsum.K_LIMBS
-    k0, k1 = K, 0
-    for i in range(0, len(metas), SLAB_BLOCKS):
-        st, limbs = _build_slab(reader, field,
-                                metas[i:i + SLAB_BLOCKS], seg, E,
-                                block0)
-        # file-wide active limb-plane range (plane k is dead iff every
-        # row's k-th limb is 0 — dead planes sum to 0, so skipping
-        # them is exact)
-        for k in range(K):
-            if limbs[..., k].any():
-                k0 = min(k0, k)
-                k1 = max(k1, k + 1)
-        built.append((st, limbs))
-        block0 += st.n_blocks
-    if k0 >= k1:
-        k0, k1 = 0, 1        # all-zero column: keep one plane
-    slabs = []
-    for st, limbs in built:
-        _upload_limbs(st, limbs, k0, k1)
-        slabs.append(st)
-    built = None
+    slabs = _stacks_from_compressed(reader, field)
+    if slabs is None:
+        layout = _file_layout(reader, field)
+        if layout is None:
+            cache.put(key, _NO_STACK)
+            return None
+        metas, seg, E = layout
+        slabs = _build_stacks_device(reader, field, metas, seg, E)
+    if slabs is None:
+        metas, seg, E = layout
+        built = []
+        block0 = 0
+        K = exactsum.K_LIMBS
+        k0, k1 = K, 0
+        for i in range(0, len(metas), SLAB_BLOCKS):
+            st, limbs = _build_slab(reader, field,
+                                    metas[i:i + SLAB_BLOCKS], seg, E,
+                                    block0)
+            # file-wide active limb-plane range (plane k is dead iff
+            # every row's k-th limb is 0 — dead planes sum to 0, so
+            # skipping them is exact)
+            for k in range(K):
+                if limbs[..., k].any():
+                    k0 = min(k0, k)
+                    k1 = max(k1, k + 1)
+            built.append((st, limbs))
+            block0 += st.n_blocks
+        if k0 >= k1:
+            k0, k1 = 0, 1        # all-zero column: keep one plane
+        slabs = []
+        for st, limbs in built:
+            _upload_limbs(st, limbs, k0, k1)
+            slabs.append(st)
+        built = None
     cache.put(key, slabs)
     # account the real HBM footprint (a slab LIST has no .nbytes, so
     # put() staked a 64-byte placeholder) — reprice mirrors the charge
@@ -280,6 +723,153 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
     from . import devstats
     devstats.bump("slabs_built", len(slabs))
     devstats.bump("slab_bytes", sum(s.nbytes for s in slabs))
+    return slabs
+
+
+def _build_stacks_device(reader, field: str, metas, seg: int,
+                         E: int) -> list[BlockStack] | None:
+    """Device-decode build of a whole (file, field): slabs expand from
+    compressed payloads in-kernel, limb planes decompose on device,
+    and the payload recipes stake into the compressed HBM tier. None
+    → caller takes the host build (stage ineligible, mostly-legacy
+    codecs, or the decode ladder exhausted beyond per-batch heal)."""
+    import time as _time
+
+    from ..query import decodestage
+    from . import compileaudit, device_decode as dd, devstats
+    from .devicefault import DeviceRouteDown
+    if not decodestage.device_stage_available():
+        return None
+    mm = reader._mm
+    # per-SLAB eligibility, decided BEFORE any device work: a slab
+    # window with zero device-decodable blocks would abort the build
+    # mid-file (_AllHostSlab) after earlier slabs already uploaded
+    # and expanded — paying the device build AND the host rebuild.
+    # Checking the windows up front keeps ineligible files on the
+    # host path for free.
+    n_dev = 0
+    for i in range(0, len(metas), SLAB_BLOCKS):
+        window = metas[i:i + SLAB_BLOCKS]
+        w_dev = sum(
+            1 for (_sid, _colm, s, tseg) in window
+            if s.rows and decodestage.block_stage(
+                mm[s.offset], mm[tseg.offset]) == "device")
+        if w_dev == 0:
+            return None      # an all-host slab window: host build
+        n_dev += w_dev
+    if n_dev * 2 < len(metas):
+        return None          # mostly legacy codecs: host build wins
+    t_ns = _time.perf_counter_ns()
+    built: list = []
+    recipes: list = []
+    block0 = 0
+    try:
+        for i in range(0, len(metas), SLAB_BLOCKS):
+            st, act, rec = _build_slab_device(
+                reader, field, metas[i:i + SLAB_BLOCKS], seg, E,
+                block0)
+            built.append((st, act))
+            recipes.append(rec)
+            block0 += st.n_blocks
+    except _AllHostSlab:
+        return None
+    except DeviceRouteDown:
+        # ladder exhausted outside the per-batch heal (times/valid/
+        # limb launches): the whole file falls back to the host build
+        return None
+    K = exactsum.K_LIMBS
+    k0, k1 = K, 0
+    for _st, act in built:
+        a = np.asarray(act)               # (K,) bools — one tiny pull
+        compileaudit.record_d2h("decode", int(a.nbytes))
+        for k in range(K):
+            if a[k]:
+                k0 = min(k0, k)
+                k1 = max(k1, k + 1)
+    if k0 >= k1:
+        k0, k1 = 0, 1
+    slabs = []
+    for (st, _act), rec in zip(built, recipes):
+        st.limbs = _slice_limb_range(st.limbs, k0, k1)
+        st.k0 = k0
+        rec["k0"], rec["k1"] = k0, k1
+        slabs.append(st)
+    _stake_compressed(reader, field, recipes)
+    dd._bump("slabs_device_decoded", len(slabs))
+    devstats.bump_phase("device_decode",
+                        _time.perf_counter_ns() - t_ns)
+    return slabs
+
+
+def _recipe_nbytes(recipes: list) -> int:
+    """HBM bytes a recipe holds RESIDENT: payload words/refs, the
+    tiny time/validity batch vectors, and the perm tables. The
+    per-slab meta arrays (block0/t0/steps/rows — meta_dev[:4]) are
+    the SAME buffers BlockStack.nbytes already charges to the
+    device_cache tier, so counting them here would double-book them
+    in the ledger; host-stage planes are deliberately not resident
+    at all (_stage_host_blocks)."""
+    nb = 0
+    for rec in recipes:
+        for (wd, rd, _w, _tr, _ds, _r, _i) in rec["dfor"]:
+            nb += int(wd.nbytes + rd.nbytes)
+        if rec["const"] is not None:
+            nb += int(rec["const"][0].nbytes + rec["const"][1].nbytes)
+        if rec["tbatch"] is not None:
+            nb += sum(int(a.nbytes) for a in rec["tbatch"][:5])
+        if rec.get("meta_dev") is not None:
+            nb += sum(int(a.nbytes) for a in rec["meta_dev"][4:])
+    return nb
+
+
+def _stake_compressed(reader, field: str, recipes: list) -> None:
+    """Stake a file's payload recipes into the compressed HBM tier:
+    the device-resident words/refs/metadata that can rebuild every
+    slab with zero H2D after a decoded-tier eviction (the relief
+    ladder evicts decoded planes FIRST for exactly this reason)."""
+    comp = devicecache.compressed_cache()
+    comp.put_sized((reader.path, field, "dforrecipe"), recipes,
+                   _recipe_nbytes(recipes))
+
+
+def _stacks_from_compressed(reader, field: str
+                            ) -> list[BlockStack] | None:
+    """Rebuild a file's slabs from the compressed HBM tier: the
+    decoded planes were evicted but the payload bytes stayed device-
+    resident, so the rebuild is expansion kernels only — zero H2D for
+    the device-stage blocks (manifest-delta-asserted in
+    tests/test_compressed_domain.py); host-stage blocks of mixed
+    files re-decode + re-upload lazily (their dense planes are
+    deliberately NOT kept resident — see _stage_host_blocks)."""
+    import time as _time
+
+    from ..query import decodestage
+    from . import device_decode as dd, devstats
+    from .devicefault import DeviceRouteDown
+    if not decodestage.device_stage_available():
+        return None
+    recipes = devicecache.compressed_cache().get(
+        (reader.path, field, "dforrecipe"))
+    if recipes is None:
+        return None
+    t_ns = _time.perf_counter_ns()
+    slabs = []
+    try:
+        for rec in recipes:
+            st, _act = _expand_recipe(rec, reader, field,
+                                      guarded=True)
+            st.limbs = _slice_limb_range(st.limbs, rec["k0"],
+                                         rec["k1"])
+            st.k0 = rec["k0"]
+            slabs.append(st)
+    except DeviceRouteDown:
+        return None                  # heal: full host rebuild
+    # counted only once the rebuild actually SERVED (a ladder-downed
+    # rebuild above fell back to the host build and served nothing)
+    dd._bump("compressed_hits")
+    dd._bump("compressed_rebuilds", len(slabs))
+    devstats.bump_phase("device_decode",
+                        _time.perf_counter_ns() - t_ns)
     return slabs
 
 

@@ -63,11 +63,18 @@ __all__ = ["CompileAuditor", "AUDITOR", "ensure_installed",
 
 # Declared transfer sites — the manifest's whole point is that every
 # byte names its mover, so the set is CLOSED (an unknown site raises;
-# add it here AND at the call site in one reviewed change).
+# add it here AND at the call site in one reviewed change; oglint
+# R1002 additionally pins every record_h2d call to a literal from
+# this set). "dfor" = packed DFOR word lanes (the compressed-domain
+# H2D diet), "payload" = the small per-block decode metadata (refs,
+# const values, time headers, validity bitmaps) riding next to them.
 H2D_SITES = ("slab", "limbs", "planes", "gids", "latcells", "scalars",
-             "pplan", "decode", "mesh", "sketch", "other")
+             "pplan", "decode", "dfor", "payload", "mesh", "sketch",
+             "other")
+# "decode" = the tiny limb-plane activity pull of the device-decode
+# slab build (ops/blockagg) — 6 flags per slab.
 D2H_SITES = ("stream", "batch", "segagg", "finalize", "repair",
-             "topk", "other")
+             "topk", "decode", "other")
 
 XFER_STATS: dict = register_counters("xfer", {
     **{f"h2d_{s}_bytes": 0 for s in H2D_SITES},

@@ -196,6 +196,8 @@ _CACHE: DeviceBlockCache | None = None
 _HOST_CACHE: DeviceBlockCache | None = None
 _SKETCH_CACHE: DeviceBlockCache | None = None
 _SKETCH_OWNER: DeviceBlockCache | None = None
+_COMPRESSED_CACHE: DeviceBlockCache | None = None
+_COMPRESSED_OWNER: DeviceBlockCache | None = None
 
 
 def capacity_bytes() -> int:
@@ -268,6 +270,37 @@ def sketch_capacity_bytes() -> int:
     if not enabled():
         return 0
     return knobs.get("OG_SKETCH_HBM_MB") * _MB
+
+
+def compressed_capacity_bytes() -> int:
+    """HBM budget of the compressed payload tier (device-resident DFOR
+    word lanes + per-block decode metadata, ops/blockagg's device-
+    decode slab build). ~15x denser than the decoded slabs it can
+    rebuild, so a modest budget keeps a large working set one kernel
+    launch — zero H2D — away from residency. OG_DEVICE_CACHE_MB=0
+    stays the global kill switch (same rule as the other tiers)."""
+    if not enabled():
+        return 0
+    return knobs.get("OG_HBM_COMPRESSED_MB") * _MB
+
+
+def compressed_cache() -> DeviceBlockCache:
+    """Singleton for the HBM compressed tier (ledger tier
+    \"compressed\"). The relief ladder (ops/devicefault.
+    hbm_pressure_relief) evicts DECODED planes before these bytes:
+    compressed payloads are the cheapest residency per decoded byte
+    and the thing that makes a post-eviction rebuild H2D-free.
+    Lifetime is pinned to the block-cache singleton exactly like the
+    sketch tier (test isolation resets _CACHE + the ledger without
+    knowing about the side tiers)."""
+    global _COMPRESSED_CACHE, _COMPRESSED_OWNER
+    owner = global_cache() if enabled() else None
+    if _COMPRESSED_CACHE is None or _COMPRESSED_OWNER is not owner:
+        _rebind_tier("compressed")
+        _COMPRESSED_CACHE = DeviceBlockCache(
+            compressed_capacity_bytes(), tier="compressed")
+        _COMPRESSED_OWNER = owner
+    return _COMPRESSED_CACHE
 
 
 def sketch_cache() -> DeviceBlockCache:

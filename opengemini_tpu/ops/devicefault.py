@@ -412,13 +412,21 @@ def hbm_pressure_relief(route: str, nbytes_hint: int = 0) -> int:
             from . import devicecache as _dc
             failpoint.inject("devicecache.evict")
             if _dc.enabled():
-                # sketch tier first: sorted-sample planes are pure
-                # derived state (one cellsort kernel rebuilds them),
-                # while block slabs cost a full decode + H2D to restake
+                # eviction order is cheapest-to-rebuild first: sketch
+                # planes are pure derived state (one cellsort kernel
+                # rebuilds them), DECODED slabs/planes rebuild from
+                # the compressed tier with one expand kernel and ZERO
+                # H2D while it survives — so the compressed payload
+                # bytes (the densest residency per decoded byte) are
+                # evicted LAST: only when the decoded tiers freed
+                # nothing, or less than the caller's byte hint
                 freed = _dc.sketch_cache().evict_bytes(
                     None, reason="oom_relief")
                 freed += _dc.global_cache().evict_bytes(
                     None, reason="oom_relief")
+                if freed < max(1, int(nbytes_hint)):
+                    freed += _dc.compressed_cache().evict_bytes(
+                        None, reason="oom_relief")
         except Exception as e:
             cls = classify(e)
             log.warning("oom relief eviction failed (route=%s, "
@@ -452,14 +460,27 @@ def _backoff_sleep(attempt: int, ctx=None) -> None:
         time.sleep(min(0.02, max(0.0, end - time.monotonic())))
 
 
-def guarded_launch(route: str, fn, ctx=None, span=None):
+def guarded_launch(route: str, fn, ctx=None, span=None,
+                   site: str | None = None,
+                   success_resets: bool = True):
     """Run one device-launch thunk under the fault ladder. ``fn`` must
     be a pure dispatch closure (safe to re-run — every launch thunk in
     the executor is). Raises ``DeviceRouteDown(route)`` when the
     ladder exhausts (the statement-level wrapper re-runs the statement
     against the host fallback), re-raises non-device exceptions
-    untouched."""
-    site = f"device.{route}.launch"
+    untouched. ``site`` overrides the failpoint site when several
+    launch families share one breaker route (the device-decode slab
+    expansions ride route \"block\" but inject at
+    ``device.decode.launch`` so chaos schedules can target them).
+    Such SECONDARY families pass ``success_resets=False``: they still
+    charge failures to the shared breaker, but a success must neither
+    reset the primary family's failure streak nor close a half-open
+    breaker the primary's probe owns — a persistent block-kernel
+    fault interleaved with healthy decode launches would otherwise
+    never accumulate to the trip threshold (measured: the statement
+    fallback looped 14 attempts with the breaker pinned closed)."""
+    if site is None:
+        site = f"device.{route}.launch"
     br = breaker_for(route)
     retries = _retry_budget()
     attempt = 0                    # transient retries taken
@@ -468,7 +489,8 @@ def guarded_launch(route: str, fn, ctx=None, span=None):
         try:
             failpoint.inject(site)
             out = fn()
-            br.record_success()
+            if success_resets:
+                br.record_success()
             if span is not None and (attempt or oom_retried):
                 span.add(device_fault_route=route,
                          device_fault_retries=attempt

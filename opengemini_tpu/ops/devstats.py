@@ -82,6 +82,10 @@ QUERY_PHASE_NS: dict = register_counters("query_phase", {
     # device ORDER BY/LIMIT cut (OG_DEVICE_TOPK): the segmented top-k
     # kernel over finalized planes + the winner-cell unpack/repair
     "device_topk_ns": 0,
+    # compressed-domain decode stage (OG_DEVICE_DECODE): the device-
+    # decode slab builds — payload staging, bit-unpack/expand kernel
+    # launches, limb decomposition, compressed-tier rebuilds
+    "device_decode_ns": 0,
     "grid_fold_ns": 0,
     # merge is NESTED inside finalize (exchange-merge of partials);
     # serialize is the HTTP-layer streaming JSON/CSV emit, outside the

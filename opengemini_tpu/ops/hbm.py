@@ -57,7 +57,8 @@ __all__ = ["HBMLedger", "LEDGER", "account", "release", "pressure",
            "reconcile", "cross_check", "UtilizationSampler", "sampler",
            "chrome_counter_events", "collector", "HBM_STATS"]
 
-TIERS = ("device_cache", "host_cache", "pipeline", "sketch")
+TIERS = ("device_cache", "host_cache", "pipeline", "sketch",
+         "compressed")
 
 # event counters + collector-refreshed gauges (utils.stats registry —
 # oglint R6 covers every bump key; the per-tier live numbers live in
@@ -260,7 +261,8 @@ def rebase_cache_tiers() -> None:
     from . import devicecache as _dc
     for tier, cache in (("device_cache", _dc.global_cache()),
                         ("host_cache", _dc.host_cache()),
-                        ("sketch", _dc.sketch_cache())):
+                        ("sketch", _dc.sketch_cache()),
+                        ("compressed", _dc.compressed_cache())):
         st = cache.stats()
         with LEDGER._lock:
             t = LEDGER._tier(tier)
@@ -275,11 +277,18 @@ def cross_check() -> dict:
     pipeline tier has no independent source — quiescent it must be 0.
     Returns per-tier {ledger, source, match}."""
     from . import devicecache as _dc
+    # materialize the singletons BEFORE snapshotting: the side tiers
+    # (sketch/compressed) pin their lifetime to the block-cache
+    # instance and their constructor drains a dead predecessor's
+    # ledger residue — a snapshot taken first would still show those
+    # bytes against the fresh (empty) instance
+    tiers = (("device_cache", _dc.global_cache()),
+             ("host_cache", _dc.host_cache()),
+             ("sketch", _dc.sketch_cache()),
+             ("compressed", _dc.compressed_cache()))
     snap = LEDGER.snapshot(events=False)
     out: dict = {}
-    for tier, cache in (("device_cache", _dc.global_cache()),
-                        ("host_cache", _dc.host_cache()),
-                        ("sketch", _dc.sketch_cache())):
+    for tier, cache in tiers:
         src = cache.stats()["bytes"]
         led = snap["tiers"][tier]["bytes"]
         out[tier] = {"ledger": led, "source": src,

@@ -1,0 +1,198 @@
+"""Pluggable per-block decode stage — the scan.py decode/dispatch split.
+
+Before round 14, decode lived tangled inside ``materialize_scan``'s
+closures: every block was host-decoded (thread pool) and the device
+paths consumed DENSE planes. This module splits decode into a stage
+the PLANNER picks per block from (codec, route):
+
+- ``HostDecodeStage`` — the classic path: segment bytes → numpy arrays
+  on the scan pool (zstd/numpy release the GIL). Every route can
+  consume it; it is also the per-block HEAL target when a device
+  decode launch faults (ops/blockagg._build_slab_device).
+- device stage — for route ``"block"`` (HBM slab residency) only:
+  blocks whose codecs are device-expandable (DFOR bit-packed lanes,
+  CONST values, CONST_DELTA times — ops/device_decode) ship their
+  COMPRESSED payloads over H2D and expand in-kernel. Flat/dense/
+  merged routes keep the host stage: their consumers are host arrays,
+  so a device expand would just round-trip the dense bytes back over
+  D2H (the opposite of the diet).
+
+``OG_DEVICE_DECODE=0`` pins every block to the host stage — the
+byte-identical escape hatch (same planes, same H2D sites as before
+round 14). The stage also pins to host on backends without real f64:
+the DFOR decimal-scale divide and the limb decomposition
+(device_decode.limbs_decompose) need IEEE f64, exactly like the
+finalize epilogue's backend gate (ops/blockagg._backend_real_f64).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..encoding import blocks as EB
+from ..record import DataType
+
+__all__ = ["block_stage", "device_stage_available",
+           "HostDecodeStage", "DEVICE_VALUE_CODECS"]
+
+# value codecs the device can expand in the slab path (RLE stays
+# host-side here: per-block run counts make ragged batch classes, and
+# slab data that survived the RLE run-heaviness test is rare — those
+# blocks ride the per-block host stage inside a device slab)
+DEVICE_VALUE_CODECS = (EB.DFOR, EB.CONST)
+
+_NUMERIC = (DataType.FLOAT, DataType.INTEGER, DataType.BOOLEAN)
+
+
+def device_stage_available() -> bool:
+    """Process-level gate: knob on, device cache on (the expanded
+    planes must land somewhere resident) and a real-f64 backend."""
+    from ..ops import blockagg, device_decode, devicecache
+    return (device_decode.device_decode_on() and devicecache.enabled()
+            and blockagg._backend_real_f64())
+
+
+def block_stage(value_codec: int, time_codec: int,
+                route: str = "block") -> str:
+    """The planner rule: ``"host"`` or ``"device"`` for ONE block,
+    from its codec bytes and the consuming route. Callers peek the
+    codec ids straight off the mmap (1 byte each — no decode)."""
+    if route != "block" or not device_stage_available():
+        return "host"
+    if (value_codec in DEVICE_VALUE_CODECS
+            and time_codec == EB.CONST_DELTA):
+        return "device"
+    return "host"
+
+
+class HostDecodeStage:
+    """The host decode stage: scan.py's flat/merged/dense decode
+    workers, extracted from materialize_scan's closures so the stage
+    is an object the planner hands to the pool (and blockagg's heal
+    path can reuse). Bit-for-bit the decode the closures did."""
+
+    name = "host"
+
+    def __init__(self, mst: str, needed: list[str], t_lo, t_hi):
+        self.mst = mst
+        self.needed = needed
+        self.t_lo = t_lo
+        self.t_hi = t_hi
+
+    # ------------------------------------------------- flat chunks
+
+    _EMPTY = (np.empty(0, dtype=np.int64), {}, {})
+
+    def run_flat(self, task):
+        """One flat decode task: (gid, decode-spec, record|merged-ref)
+        → (gid, times, cols, strs). Memtable records pass through;
+        merged series re-read through the shard; TSSP chunks decode
+        the kept segments."""
+        gid, dec, rec = task
+        if rec is not None:
+            if isinstance(rec, tuple):   # merged-series fallback
+                shard, sid = rec
+                rec = shard.read_series(self.mst, sid,
+                                        self.needed or None,
+                                        self.t_lo, self.t_hi)
+                if rec is None or rec.num_rows == 0:
+                    return (gid,) + self._EMPTY
+            cols = {}
+            strs = {}
+            for name in self.needed:
+                c = rec.column(name)
+                if c is None:
+                    continue
+                if c.type in _NUMERIC and c.values is not None:
+                    cols[name] = (c.values, c.valid, c.type)
+                elif c.is_string_like():
+                    strs[name] = c.slice(0, rec.num_rows)
+            return gid, rec.times, cols, strs
+        reader, cm, keep = dec
+        times, cols, strs = self.decode_chunk(reader, cm, keep)
+        return gid, times, cols, strs
+
+    def decode_chunk(self, reader, cm, keep: list[int]):
+        """Decode the selected time segments of one chunk. Returns
+        (times, {field: (vals, valid, DataType)}, strings) with the
+        query time range applied row-level."""
+        t_lo, t_hi = self.t_lo, self.t_hi
+        tm = cm.column("time")
+        tparts = [reader.read_segment(tm, tm.segments[si])
+                  for si in keep]
+        times = (tparts[0].values if len(tparts) == 1
+                 else np.concatenate([p.values for p in tparts]))
+        mask = None
+        if t_lo is not None or t_hi is not None:
+            mask = np.ones(len(times), dtype=bool)
+            if t_lo is not None:
+                mask &= times >= t_lo
+            if t_hi is not None:
+                mask &= times <= t_hi
+            if mask.all():
+                mask = None
+            else:
+                times = times[mask]
+        out: dict[str, tuple] = {}
+        strs: dict[str, object] = {}
+        for name in self.needed:
+            colm = cm.column(name)
+            if colm is None:
+                continue
+            parts = [reader.read_segment(colm, colm.segments[si])
+                     for si in keep]
+            if colm.type not in _NUMERIC:
+                cv = parts[0].slice(0, len(parts[0]))
+                for p in parts[1:]:
+                    cv.append(p)
+                if mask is not None:
+                    cv = cv.take(np.nonzero(mask)[0])
+                strs[name] = cv
+                continue
+            if len(parts) == 1:
+                vals, valid = parts[0].values, parts[0].valid
+            else:
+                vals = np.concatenate([p.values for p in parts])
+                valid = np.concatenate([p.valid for p in parts])
+            if mask is not None:
+                vals, valid = vals[mask], valid[mask]
+            out[name] = (vals, valid, colm.type)
+        return times, out, strs
+
+    # ------------------------------------------------ dense blocks
+
+    def run_dense(self, d, blocks_needed: bool = True):
+        """Decode one dense segment: (f, P) blocks per field + edge-
+        leftover flat parts. Times are affine — generated, never
+        decoded. With blocks_needed=False (device cache holds the
+        blocks) only the edge leftovers are produced — segments
+        without leftovers skip decode entirely."""
+        span = d.f * d.P
+        blocks: dict[str, tuple] = {}
+        left_cols: list[dict] = [dict(), dict()]
+        ranges = [(d.a, d.lo), (d.lo + span, d.b)]
+        has_left = any(i1 > i0 for i0, i1 in ranges)
+        if blocks_needed or has_left:
+            for name in self.needed:
+                colm = d.cm.column(name)
+                if colm is None or colm.type not in _NUMERIC:
+                    continue
+                cv = d.reader.read_segment(colm, colm.segments[d.si])
+                if blocks_needed:
+                    vals = cv.values.astype(np.float64, copy=False)
+                    blocks[name] = (
+                        vals[d.lo:d.lo + span].reshape(d.f, d.P),
+                        cv.valid[d.lo:d.lo + span].reshape(d.f, d.P),
+                        colm.type)
+                for k, (i0, i1) in enumerate(ranges):
+                    if i1 > i0:
+                        left_cols[k][name] = (cv.values[i0:i1],
+                                              cv.valid[i0:i1],
+                                              colm.type)
+        leftovers = []
+        for k, (i0, i1) in enumerate(ranges):
+            if i1 > i0:
+                times = d.t0 + d.step * np.arange(i0, i1,
+                                                  dtype=np.int64)
+                leftovers.append((d.gid, times, left_cols[k], {}))
+        return (blocks if blocks_needed else None), leftovers

@@ -2890,10 +2890,13 @@ class QueryExecutor:
                 # sorted-plane cache identity: the rowstore plan key
                 # already pins shard serials + memtable mutations, so
                 # content changes invalidate; residual filters mask
-                # rows after the scan and stay uncached
+                # rows after the scan and stay uncached. The FULL
+                # plan_key tuple is the identity — a 64-bit hash() of
+                # it would let two colliding plans serve each other's
+                # sorted planes (wrong percentiles, no error)
                 ck = None
                 if scan_plan is not None and cond.residual is None:
-                    ck = (hash(plan_key), fname, int(start),
+                    ck = (plan_key, fname, int(start),
                           int(interval_eff), W, int(npad))
                 try:
                     v_p, m_p = pad_rows([v_f, p["valid"]], npad,

@@ -378,87 +378,12 @@ def _dense_fingerprint(tasks: list["_DenseTask"]) -> str:
     return h.hexdigest()
 
 
-def _run_dense(d: _DenseTask, needed: list[str], W: int,
-               blocks_needed: bool = True):
-    """Decode one dense segment: (f, P) blocks per field + edge-leftover
-    flat parts. Times are affine — generated, never decoded. With
-    blocks_needed=False (device cache holds the blocks) only the edge
-    leftovers are produced — segments without leftovers skip decode
-    entirely."""
-    span = d.f * d.P
-    blocks: dict[str, tuple[np.ndarray, np.ndarray]] = {}
-    left_cols: list[dict] = [dict(), dict()]
-    ranges = [(d.a, d.lo), (d.lo + span, d.b)]
-    has_left = any(i1 > i0 for i0, i1 in ranges)
-    if blocks_needed or has_left:
-        for name in needed:
-            colm = d.cm.column(name)
-            if colm is None or colm.type not in _NUMERIC:
-                continue
-            cv = d.reader.read_segment(colm, colm.segments[d.si])
-            if blocks_needed:
-                vals = cv.values.astype(np.float64, copy=False)
-                blocks[name] = (vals[d.lo:d.lo + span].reshape(d.f, d.P),
-                                cv.valid[d.lo:d.lo + span].reshape(d.f,
-                                                                   d.P),
-                                colm.type)
-            for k, (i0, i1) in enumerate(ranges):
-                if i1 > i0:
-                    left_cols[k][name] = (cv.values[i0:i1],
-                                          cv.valid[i0:i1], colm.type)
-    leftovers = []
-    for k, (i0, i1) in enumerate(ranges):
-        if i1 > i0:
-            times = d.t0 + d.step * np.arange(i0, i1, dtype=np.int64)
-            leftovers.append((d.gid, times, left_cols[k], {}))
-    return blocks if blocks_needed else None, leftovers
-
-
-def _decode_chunk(reader, cm, needed: list[str], keep: list[int],
-                  t_lo, t_hi):
-    """Decode the selected time segments of one chunk. Returns
-    (times, {field: (vals, valid, DataType)}) with the query time range
-    applied row-level."""
-    tm = cm.column("time")
-    tparts = [reader.read_segment(tm, tm.segments[si]) for si in keep]
-    times = (tparts[0].values if len(tparts) == 1
-             else np.concatenate([p.values for p in tparts]))
-    mask = None
-    if t_lo is not None or t_hi is not None:
-        mask = np.ones(len(times), dtype=bool)
-        if t_lo is not None:
-            mask &= times >= t_lo
-        if t_hi is not None:
-            mask &= times <= t_hi
-        if mask.all():
-            mask = None
-        else:
-            times = times[mask]
-    out: dict[str, tuple] = {}
-    strs: dict[str, object] = {}
-    for name in needed:
-        colm = cm.column(name)
-        if colm is None:
-            continue
-        parts = [reader.read_segment(colm, colm.segments[si])
-                 for si in keep]
-        if colm.type not in _NUMERIC:
-            cv = parts[0].slice(0, len(parts[0]))
-            for p in parts[1:]:
-                cv.append(p)
-            if mask is not None:
-                cv = cv.take(np.nonzero(mask)[0])
-            strs[name] = cv
-            continue
-        if len(parts) == 1:
-            vals, valid = parts[0].values, parts[0].valid
-        else:
-            vals = np.concatenate([p.values for p in parts])
-            valid = np.concatenate([p.valid for p in parts])
-        if mask is not None:
-            vals, valid = vals[mask], valid[mask]
-        out[name] = (vals, valid, colm.type)
-    return times, out, strs
+# Decode itself lives in query/decodestage.py (HostDecodeStage): the
+# round-14 split makes decode a pluggable host|device stage the
+# planner picks per block from (codec, route) — this module plans and
+# assembles, the stage decodes. The device stage serves route "block"
+# (ops/blockagg._build_slab_device expands compressed payloads
+# in-kernel); every host consumer below uses HostDecodeStage.
 
 
 def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
@@ -585,33 +510,11 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                 tasks.append((sp.gid, (src.reader, cm, keep), None))
                 task_tags.append(_sp_tags(sp))
 
-    # ---- decode (thread pool: zstd + numpy release the GIL) ----------
-    _EMPTY = (np.empty(0, dtype=np.int64), {}, {})
-
-    def run_one(task):
-        gid, dec, rec = task
-        if rec is not None:
-            if isinstance(rec, tuple):   # merged-series fallback
-                shard, sid = rec
-                rec = shard.read_series(mst, sid, needed or None,
-                                        t_lo, t_hi)
-                if rec is None or rec.num_rows == 0:
-                    return (gid,) + _EMPTY
-            cols = {}
-            strs = {}
-            for name in needed:
-                c = rec.column(name)
-                if c is None:
-                    continue
-                if c.type in _NUMERIC and c.values is not None:
-                    cols[name] = (c.values, c.valid, c.type)
-                elif c.is_string_like():
-                    strs[name] = c.slice(0, rec.num_rows)
-            return gid, rec.times, cols, strs
-        reader, cm, keep = dec
-        times, cols, strs = _decode_chunk(reader, cm, needed, keep,
-                                          t_lo, t_hi)
-        return gid, times, cols, strs
+    # ---- decode (thread pool: zstd + numpy release the GIL): every
+    # task below is host-stage work — the device stage only serves the
+    # block route, which consumed its sources via skip_sources above
+    from .decodestage import HostDecodeStage
+    stage = HostDecodeStage(mst, needed, t_lo, t_hi)
 
     # group dense tasks by P and fingerprint each group BEFORE decode:
     # a device-cache hit (dense_cached callback) skips host assembly
@@ -632,14 +535,14 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
         # start pulling device results while flat rows still decode.
         # Collection stays list-ordered, so row/group order (and hence
         # positional first/last semantics) is unchanged.
-        dense_futs = [pool.submit(_run_dense, d, needed, W, blocks)
+        dense_futs = [pool.submit(stage.run_dense, d, blocks)
                       for _P, d, blocks in dense_jobs]
-        flat_futs = [pool.submit(run_one, t) for t in tasks]
+        flat_futs = [pool.submit(stage.run_flat, t) for t in tasks]
         results = [f.result() for f in flat_futs]
         dense_results = [f.result() for f in dense_futs]
     else:
-        results = [run_one(t) for t in tasks]
-        dense_results = [_run_dense(d, needed, W, blocks)
+        results = [stage.run_flat(t) for t in tasks]
+        dense_results = [stage.run_dense(d, blocks)
                          for _P, d, blocks in dense_jobs]
     if tag_cols:
         from ..record import ColVal
@@ -935,6 +838,44 @@ def bulk_flat_scan(plan: ScanPlan, mst: str, field: str, t_lo, t_hi,
                     pos = (row0[sel][:, None]
                            + np.arange(int(rows), dtype=np.int64)[None])
                     vals[pos.reshape(-1)] = np.repeat(cv, int(rows))
+            elif codec == EB.DFOR:
+                # DFOR segments decode by (width, transform, dscale,
+                # rows) GROUPS — one vectorized unpack per shape class
+                # (encoding/dfor.decode_batch), not one Python call
+                # per segment: at 1M+ series the per-segment loop
+                # below costs ~44µs each, the exact regression the
+                # bulk path exists to avoid
+                from ..encoding import dfor as _dfm
+                hdr = _gather_rows(buf, ft.v_off[m] + 1,
+                                   _dfm.HEADER_BYTES)
+                tr = hdr[:, 0].astype(np.int64)
+                wd = hdr[:, 1].astype(np.int64)
+                ds = hdr[:, 2].astype(np.int64)
+                refs_all = np.ascontiguousarray(
+                    hdr[:, 8:16]).view("<u8").reshape(-1)
+                midx = np.nonzero(m)[0]
+                rows_all = ft.rows[midx]
+                combo = (wd << 44) | (tr << 40) | (ds << 32) | rows_all
+                for ck in np.unique(combo):
+                    sel = np.nonzero(combo == ck)[0]
+                    gi = midx[sel]
+                    r = int(rows_all[sel[0]])
+                    w = int(wd[sel[0]])
+                    nw = (r * w + 31) // 32
+                    if nw:
+                        raw = _gather_rows(
+                            buf, ft.v_off[gi] + 1 + _dfm.HEADER_BYTES,
+                            4 * nw)
+                        words = np.ascontiguousarray(raw).view(
+                            "<u4").reshape(len(gi), nw)
+                    else:
+                        words = np.zeros((len(gi), 0), dtype=np.uint32)
+                    block = _dfm.decode_batch(
+                        words, refs_all[sel], r, w,
+                        int(tr[sel[0]]), int(ds[sel[0]]), "f64")
+                    pos = (row0[gi][:, None]
+                           + np.arange(r, dtype=np.int64)[None, :])
+                    vals[pos.reshape(-1)] = block.reshape(-1)
             else:
                 pending_slow_segs.append(("v", np.nonzero(m)[0]))
         # ---- validity ----

@@ -302,6 +302,35 @@ def _compute_preagg(col: ColVal, times: np.ndarray, lo: int,
     return pa
 
 
+# compaction-transcode loser memo: segments whose decode + full
+# encode-menu probe showed DFOR cannot beat their legacy codec are
+# remembered by content fingerprint, so stream compaction pays the
+# probe ONCE per distinct segment content instead of on every later
+# compaction of the same bytes (segments copy verbatim across
+# compactions, so the fingerprint recurs). A fingerprint collision
+# merely SKIPS a probe — the segment keeps its legacy codec, never a
+# correctness effect. Bounded FIFO; process-local (a restart re-pays
+# one probe per segment, which is the pre-memo behavior once).
+_DFOR_LOSERS: "dict[tuple, None]" = {}
+_DFOR_LOSERS_CAP = 1 << 16
+
+
+def _dfor_probe_key(seg_bytes, rows: int) -> tuple:
+    import zlib
+    head = bytes(seg_bytes[:64])
+    return (len(seg_bytes), rows, zlib.crc32(head))
+
+
+def _dfor_probe_lost(seg_bytes, rows: int) -> bool:
+    return _dfor_probe_key(seg_bytes, rows) in _DFOR_LOSERS
+
+
+def _dfor_probe_remember(seg_bytes, rows: int) -> None:
+    if len(_DFOR_LOSERS) >= _DFOR_LOSERS_CAP:
+        _DFOR_LOSERS.pop(next(iter(_DFOR_LOSERS)))
+    _DFOR_LOSERS[_dfor_probe_key(seg_bytes, rows)] = None
+
+
 class TSSPWriter:
     """Append-only writer: call write_series per series id (ascending,
     each series once), then finalize(). Analog of immutable/msbuilder.go."""
@@ -459,14 +488,45 @@ class TSSPWriter:
         out = ChunkMeta(sid, cms[0].min_time, cms[-1].max_time,
                         sum(cm.rows for cm in cms),
                         regular=all(cm.regular for cm in cms))
+        transcode = enc._device_layout_on()
         for colm0 in cms[0].columns:
             nc = ColumnMeta(colm0.name, colm0.type)
             for cm, r in holders:
                 colm = cm.column(colm0.name)
                 mm = r._mm
                 for s in colm.segments:
-                    off, size = self._append(
-                        mm[s.offset:s.offset + s.size])
+                    seg_bytes = mm[s.offset:s.offset + s.size]
+                    if (transcode and s.rows
+                            and colm0.type == DataType.FLOAT
+                            and seg_bytes[0] in (enc.ZSTD, enc.RAW,
+                                                 enc.GORILLA)
+                            and not _dfor_probe_lost(seg_bytes,
+                                                     s.rows)):
+                        # ONE-TIME transcode of legacy byte-codec
+                        # float segments into the device layout as
+                        # compaction rewrites them anyway
+                        # (OG_WRITE_DEVICE_LAYOUT). The rewrite is
+                        # kept ONLY when the menu actually picked
+                        # DFOR: data the device layout can't beat
+                        # stays on its ORIGINAL codec bytes (a
+                        # gorilla segment must not degrade to
+                        # zstd-of-raw). Winners leave the trigger set
+                        # (DFOR is not in it); losers are remembered
+                        # by content fingerprint so the decode +
+                        # full-menu probe is not re-paid on every
+                        # later compaction of the same bytes.
+                        # Byte-identical decoded values — enforced by
+                        # the round-trip oracle in tests/test_encoding
+                        # — and the pre-agg (incl. limb state) is
+                        # value-derived, so it carries over unchanged
+                        vals = enc.decode_float_block(seg_bytes,
+                                                      s.rows)
+                        re_enc = enc.encode_float_block(vals)
+                        if re_enc[0] == enc.DFOR:
+                            seg_bytes = re_enc
+                        else:
+                            _dfor_probe_remember(seg_bytes, s.rows)
+                    off, size = self._append(seg_bytes)
                     voff, vsize = self._append(
                         mm[s.valid_offset:s.valid_offset
                            + s.valid_size])

@@ -284,6 +284,27 @@ register("OG_HOST_CACHE_MB", int, 4096,
          "host pin-cache budget (assembled dense blocks, limb sums, "
          "result grids)", scope="cached")
 
+# --- compressed-domain device execution (encoding/dfor.py,
+#     ops/device_decode.py, ops/blockagg.py; cached: consulted per
+#     segment on the write path and per slab on the dispatch path)
+register("OG_WRITE_DEVICE_LAYOUT", bool, True,
+         "TSSP write/compaction emit the device-friendly DFOR "
+         "bit-packed layout for numeric blocks when it beats the raw "
+         "payload (old GORILLA/S8B/ZSTD blocks stay readable; "
+         "compaction transcodes them as it rewrites); 0 = legacy "
+         "codec menu only", scope="cached")
+register("OG_DEVICE_DECODE", bool, True,
+         "decode DFOR/CONST-DELTA block payloads ON DEVICE in the "
+         "HBM slab path: compressed bytes cross H2D and expand "
+         "in-kernel; 0 = host decode + dense plane upload "
+         "(byte-identical escape hatch)", scope="cached")
+register("OG_HBM_COMPRESSED_MB", int, 1024,
+         "HBM budget of the compressed payload tier (device-resident "
+         "DFOR words): a slab evicted under pressure rebuilds from "
+         "the ~15x denser compressed bytes with ZERO H2D; the relief "
+         "ladder evicts decoded planes before compressed bytes",
+         scope="cached")
+
 # --- query scheduler (query/scheduler.py; OG_SCHED cached: checked on
 #     every device launch)
 register("OG_SCHED", bool, True,
@@ -367,14 +388,16 @@ register("OG_COMPILE_AUDIT", bool, True,
 # fails the gate and is either a hazard to fix or a reviewed bump of
 # this table in the same change.
 RECOMPILE_BUDGETS: dict = {
-    # smoke shapes (48 hosts x 1h, scripts/perf_smoke.sh): measured 2
-    # cold compiles per shape (the shape's block kernel + the finalize
-    # epilogue; first shape also pays the tiny-op first-touch
-    # compiles). 16 leaves room for route variants (prefix/lattice/
-    # pack) on other datasets/backends while still catching the
-    # failure mode that matters: a per-value shape-class explosion
-    # compiles O(slabs) kernels and blows straight past this.
-    "1h": 16, "1m": 16, "cfg1": 16,
+    # smoke shapes (48 hosts x 1h, scripts/perf_smoke.sh): the first
+    # shape pays the tiny-op first-touch compiles plus the round-14
+    # device-decode classes (DFOR unpack/finish, times/validity/const
+    # expanders, limb decompose, permute/slice — measured 14 cold on
+    # "1h", 0 on the warm shapes). 24 leaves room for route variants
+    # (prefix/lattice/pack) and extra DFOR width classes on other
+    # datasets/backends while still catching the failure mode that
+    # matters: a per-value shape-class explosion compiles O(slabs)
+    # kernels and blows straight past this.
+    "1h": 24, "1m": 24, "cfg1": 24,
     # answer-sized D2H shapes (PR 12): the ORDER BY+LIMIT heavy shape
     # pays the finalize epilogue + topk cut kernels on top of the
     # lattice/block variants; the percentile shape pays the cellsort +

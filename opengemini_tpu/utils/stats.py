@@ -439,8 +439,18 @@ def devicecache_collector():
     out = devicecache.global_cache().stats()
     for k, v in devicecache.host_cache().stats().items():
         out[f"host_{k}"] = v
+    for k, v in devicecache.compressed_cache().stats().items():
+        out[f"compressed_{k}"] = v
     out.update(devicecache.PLANE_STATS)
     return out
+
+
+def device_decode_collector():
+    """Compressed-domain decode-stage metrics (round 14): blocks
+    expanded on device, batch launches, per-block host heals and the
+    compressed-tier rebuild counters (ops/device_decode.py)."""
+    from ..ops.device_decode import DECODE_STATS
+    return dict(DECODE_STATS)
 
 
 def compaction_collector():

@@ -114,6 +114,14 @@ assert r.get("xfer_ledger_checks", 0) > 0, r
 # zero warm recompiles (the warm gate above covers the new kernels)
 assert "topk-off" in r.get("configs", []), r
 assert "sketch-off" in r.get("configs", []), r
+# compressed-domain gate (round 14): the device-decode-off escape
+# hatch ran byte-identical on every shape (cold slab rebuilds, both
+# lattice routes), the cold-build H2D diet measurably engaged on the
+# heavy shape, and the seeded decode-launch faults healed per block
+assert "device-decode-off" in r.get("configs", []), r
+assert "device-decode-off-barrier" in r.get("configs", []), r
+assert r.get("dd_h2d_shrink_x", 0) >= 3.0, r
+assert r.get("dd_decode_heals", 0) > 0, r
 assert r.get("topk_d2h_shrink_x", 0) >= 2.0, r
 assert r.get("sketch_dev_grids", 0) > 0, r
 assert r.get("f32_tier_launches", 0) > 0, r
@@ -137,6 +145,9 @@ print(f"compile audit OK: {r['compiles_total']} compiles, budgets "
 print(f"transfer manifest OK: h2d {r['xfer_h2d_bytes']}B / d2h "
       f"{r['xfer_d2h_bytes']}B attributed, "
       f"{r['xfer_ledger_checks']} ledger checks, 0 mismatches")
+print(f"compressed domain OK: cold-build H2D {r['dd_h2d_shrink_x']}x "
+      f"({r['dd_h2d_bytes_off']}B -> {r['dd_h2d_bytes_on']}B), "
+      f"{r['dd_decode_heals']} per-block decode heals")
 print(f"answer-sized D2H OK: topk cut {r['topk_d2h_shrink_x']}x "
       f"({r['topk_d2h_bytes_off']}B -> {r['topk_d2h_bytes_on']}B), "
       f"{r['sketch_dev_grids']} device order-stat grids, f32 tier "

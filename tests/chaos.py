@@ -346,6 +346,7 @@ def run_schedule(root, seed: int, steps: int = 8,
 DEVICE_FAULT_SITES = [
     # (failpoint site, modes worth injecting there)
     ("device.block.launch", ("oom", "transient", "hang")),
+    ("device.decode.launch", ("oom", "transient")),
     ("device.lattice.launch", ("oom", "transient")),
     ("device.finalize.launch", ("oom", "transient")),
     ("pipeline.submit", ("oom", "transient")),

@@ -1,0 +1,32 @@
+"""R1002 failing fixture: manifest bookings with a variable site
+label and with literals outside the closed set."""
+import numpy as np
+
+from . import compileaudit
+
+
+def upload_with_variable_site(arr, site):
+    import jax
+    dev = jax.device_put(arr)
+    compileaudit.record_h2d(site, int(dev.nbytes))        # R1002
+    return dev
+
+
+def upload_with_unknown_site(arr):
+    import jax
+    dev = jax.device_put(arr)
+    compileaudit.record_h2d("warpcore", int(dev.nbytes))  # R1002
+    return dev
+
+
+def pull_with_unknown_site(dev):
+    out = np.asarray(dev)
+    compileaudit.record_d2h("sideband", int(out.nbytes))  # R1002
+    return out
+
+
+def upload_with_keyword_site(arr, label):
+    import jax
+    dev = jax.device_put(arr)
+    compileaudit.record_h2d(site=label, nbytes=int(dev.nbytes))  # R1002
+    return dev

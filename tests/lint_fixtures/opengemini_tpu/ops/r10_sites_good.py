@@ -1,0 +1,20 @@
+"""R1002 passing fixture: every manifest booking names a literal
+from the closed site set (incl. the round-14 dfor/payload sites)."""
+import numpy as np
+
+from . import compileaudit
+
+
+def upload_compressed_payload(words, refs):
+    import jax
+    wd = jax.device_put(words)
+    rd = jax.device_put(refs)
+    compileaudit.record_h2d("dfor", int(wd.nbytes))
+    compileaudit.record_h2d("payload", int(rd.nbytes))
+    return wd, rd
+
+
+def pull_activity(dev):
+    out = np.asarray(dev)
+    compileaudit.record_d2h("decode", int(out.nbytes))
+    return out

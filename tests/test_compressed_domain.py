@@ -1,0 +1,284 @@
+"""Compressed-domain device execution (round 14): the H2D diet.
+
+End-to-end coverage of the device decode stage over the HBM slab
+path — parity against the OG_DEVICE_DECODE=0 escape hatch, the
+measured H2D shrink, the compressed HBM tier's zero-H2D rebuild, the
+relief-ladder eviction order, and the per-block host-decode heal
+under seeded faults at the ``device.decode.launch`` failpoint, with
+the exact ledger reconciliation the PR 8 observatory demands."""
+
+import json
+
+import numpy as np
+import pytest
+
+import opengemini_tpu.ops.devicecache as dc
+import opengemini_tpu.query.executor as E
+from opengemini_tpu.ops import compileaudit, hbm
+from opengemini_tpu.ops import devicefault as df
+from opengemini_tpu.ops.device_decode import DECODE_STATS
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils import failpoint, knobs
+
+QTEXT = ("SELECT mean(usage_user), sum(usage_user), "
+         "count(usage_user) FROM cpu WHERE time >= 0 AND "
+         "time < 28800000000000 GROUP BY time(1h), hostname")
+
+
+@pytest.fixture()
+def db(tmp_path, monkeypatch):
+    dc.global_cache().purge()
+    dc.host_cache().purge()
+    dc.compressed_cache().purge()
+    for tier in ("device_cache", "host_cache", "compressed"):
+        resid = hbm.LEDGER.tier_bytes(tier)
+        if resid:
+            hbm.LEDGER.release(tier, resid,
+                               n=hbm.LEDGER.tier_count(tier))
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_COOLDOWN_S", "0.05")
+    eng = Engine(str(tmp_path / "data"),
+                 EngineOptions(shard_duration=1 << 62))
+    eng.create_database("db0")
+    rng = np.random.default_rng(42)
+    points = 720
+    times = np.arange(points, dtype=np.int64) * (10 * 10**9)
+    for h in range(8):
+        vals = np.round(np.clip(rng.normal(50, 15, points), 0, 100),
+                        2)
+        eng.write_record("db0", "cpu",
+                         {"hostname": f"host_{h}"}, times,
+                         {"usage_user": vals})
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    dc.global_cache().purge()
+    dc.host_cache().purge()
+    dc.compressed_cache().purge()
+    df.reset_breakers()
+    failpoint.disable_all()
+    eng.close()
+
+
+def _run(ex):
+    (stmt,) = parse_query(QTEXT)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return json.dumps(res, sort_keys=True, default=str)
+
+
+def _h2d_total():
+    m = compileaudit.manifest_snapshot()
+    return sum(v for k, v in m.items()
+               if k.startswith("h2d_") and k.endswith("_bytes"))
+
+
+def _purge_decoded():
+    dc.global_cache().purge()
+    dc.host_cache().purge()
+
+
+def test_device_decode_parity_and_h2d_shrink(db):
+    """The acceptance shape in miniature: device decode on vs the
+    byte-identical OG_DEVICE_DECODE=0 escape hatch, with a measured
+    multi-x drop in cold-build H2D bytes."""
+    _eng, ex = db
+    _purge_decoded()
+    dc.compressed_cache().purge()
+    b0 = _h2d_total()
+    on = _run(ex)
+    on_bytes = _h2d_total() - b0
+    assert DECODE_STATS["slabs_device_decoded"] > 0
+    knobs.set_env("OG_DEVICE_DECODE", "0")
+    try:
+        _purge_decoded()
+        dc.compressed_cache().purge()
+        b0 = _h2d_total()
+        off = _run(ex)
+        off_bytes = _h2d_total() - b0
+    finally:
+        knobs.del_env("OG_DEVICE_DECODE")
+    assert on == off, "device decode changed result bytes"
+    assert off_bytes > 3 * on_bytes, (off_bytes, on_bytes)
+    # exact ledger reconciliation; the manifest==devstats exactness
+    # gate is process-global (any earlier suite's unfunneled bump
+    # poisons it), so it lives in the controlled perf_smoke process
+    assert hbm.cross_check()["ok"]
+
+
+def test_compressed_tier_rebuild_zero_h2d(db):
+    """Evicting the DECODED slabs (what the relief ladder does first)
+    must leave a rebuild that expands from the resident compressed
+    payloads — manifest sites dfor/payload/slab/limbs move ZERO new
+    bytes; only per-query vectors (gids/scalars) may re-stake."""
+    _eng, ex = db
+    ref = _run(ex)
+    assert dc.compressed_cache().stats()["bytes"] > 0
+    h0 = DECODE_STATS["compressed_hits"]
+    _purge_decoded()
+    m0 = compileaudit.manifest_snapshot()
+    got = _run(ex)
+    m1 = compileaudit.manifest_snapshot()
+    assert got == ref
+    assert DECODE_STATS["compressed_hits"] > h0
+    for site in ("dfor", "payload", "slab", "limbs"):
+        assert m1[f"h2d_{site}_bytes"] == m0[f"h2d_{site}_bytes"], \
+            site
+    assert hbm.cross_check()["ok"]
+
+
+def test_compressed_tier_is_denser(db):
+    """The residency math behind the tier: compressed payload bytes
+    per decoded slab byte (the ~15:1 on-disk claim, here measured on
+    the 2-decimal gauge data)."""
+    _eng, ex = db
+    _run(ex)
+    comp = dc.compressed_cache().stats()["bytes"]
+    slabs = dc.global_cache().stats()["bytes"]
+    assert comp > 0 and slabs > 4 * comp, (comp, slabs)
+
+
+def test_relief_ladder_evicts_decoded_before_compressed(db):
+    """Eviction order contract: one relief pass drops decoded tiers
+    and keeps the compressed bytes (they are what makes the rebuild
+    H2D-free); only a relief pass that freed nothing touches them."""
+    _eng, ex = db
+    _run(ex)
+    assert dc.global_cache().stats()["bytes"] > 0
+    comp0 = dc.compressed_cache().stats()["bytes"]
+    assert comp0 > 0
+    freed = df.hbm_pressure_relief("block")
+    try:
+        assert freed > 0
+        assert dc.global_cache().stats()["bytes"] == 0
+        assert dc.compressed_cache().stats()["bytes"] == comp0
+        # a second pass with nothing decoded left takes the last rung
+        freed2 = df.hbm_pressure_relief("block")
+        assert freed2 > 0
+        assert dc.compressed_cache().stats()["bytes"] == 0
+        assert hbm.cross_check()["ok"]
+    finally:
+        df.restore_gate_permits()
+
+
+@pytest.mark.parametrize("mode,hits", [("oom", 2), ("transient", 3)])
+def test_decode_launch_fault_heals_per_block(db, mode, hits):
+    """Seeded fault at the new device.decode.launch failpoint: the
+    ladder (retry / pressure relief / per-block host-decode heal)
+    must absorb it — results byte-identical, heal counter proven,
+    exact hbm.cross_check(). ``hits`` exhausts exactly the FIRST
+    expand launch's ladder (transient: 1 + OG_DEVICE_RETRY retries;
+    oom: 1 + one post-relief retry), so the values batch heals
+    per-block while the later launches run clean."""
+    _eng, ex = db
+    ref = _run(ex)
+    _purge_decoded()
+    dc.compressed_cache().purge()
+    heals0 = DECODE_STATS["host_heals"]
+    failpoint.seed(7)
+    failpoint.enable("device.decode.launch", mode, maxhits=hits)
+    try:
+        got = _run(ex)
+        fired = not failpoint.active("device.decode.launch")
+    finally:
+        failpoint.disable("device.decode.launch")
+    assert fired, "device.decode.launch never fired"
+    assert got == ref, f"{mode} fault changed bytes"
+    assert DECODE_STATS["host_heals"] > heals0
+    assert hbm.cross_check()["ok"]
+    df.reset_breakers()
+    # healed run must still serve warm repeats
+    assert _run(ex) == ref
+
+
+def test_decode_single_fault_absorbed_by_ladder(db):
+    """One transient hit (maxhits=1) is absorbed by the in-ladder
+    retry: no heal, no breaker trip, identical bytes."""
+    _eng, ex = db
+    ref = _run(ex)
+    _purge_decoded()
+    dc.compressed_cache().purge()
+    heals0 = DECODE_STATS["host_heals"]
+    failpoint.seed(11)
+    failpoint.enable("device.decode.launch", "transient", maxhits=1)
+    try:
+        got = _run(ex)
+    finally:
+        failpoint.disable("device.decode.launch")
+    assert got == ref
+    assert DECODE_STATS["host_heals"] == heals0
+    assert not df.breaker_for("block").is_open
+    assert hbm.cross_check()["ok"]
+
+
+def test_block_stage_planner_rules():
+    """The decode-stage planner: codec + route decide, the knob and
+    backend gate pin to host."""
+    from opengemini_tpu.encoding import blocks as EB
+    from opengemini_tpu.query import decodestage as ds
+    if not ds.device_stage_available():
+        pytest.skip("device stage unavailable on this backend")
+    assert ds.block_stage(EB.DFOR, EB.CONST_DELTA) == "device"
+    assert ds.block_stage(EB.CONST, EB.CONST_DELTA) == "device"
+    assert ds.block_stage(EB.GORILLA, EB.CONST_DELTA) == "host"
+    assert ds.block_stage(EB.DFOR, EB.DELTA_S8B) == "host"
+    # only the block route profits from device expansion
+    assert ds.block_stage(EB.DFOR, EB.CONST_DELTA,
+                          route="flat") == "host"
+    knobs.set_env("OG_DEVICE_DECODE", "0")
+    try:
+        assert ds.block_stage(EB.DFOR, EB.CONST_DELTA) == "host"
+    finally:
+        knobs.del_env("OG_DEVICE_DECODE")
+
+
+def test_mixed_codec_slab_host_stage(db, tmp_path):
+    """A file mixing DFOR-able series with full-mantissa noise (ZSTD/
+    RAW codecs) must still take the device build when every slab
+    window has device blocks: the noise blocks ride the per-block
+    host stage (hsegs), results byte-identical to the all-host
+    escape hatch, and a compressed-tier rebuild (which re-stages the
+    host blocks lazily) stays identical too."""
+    eng, _ex = db
+    rng = np.random.default_rng(9)
+    points = 720
+    times = np.arange(points, dtype=np.int64) * (10 * 10**9)
+    for h in range(8, 12):        # full-mantissa noise series
+        eng.write_record("db0", "cpu", {"hostname": f"host_{h}"},
+                         times,
+                         {"usage_user": rng.normal(50, 15, points)})
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    ex2 = QueryExecutor(eng)
+    _purge_decoded()
+    dc.compressed_cache().purge()
+    dd0 = DECODE_STATS["slabs_device_decoded"]
+
+    def run2():
+        (stmt,) = parse_query(QTEXT)
+        res = ex2.execute(stmt, "db0")
+        assert "error" not in res, res
+        return json.dumps(res, sort_keys=True, default=str)
+
+    on = run2()
+    knobs.set_env("OG_DEVICE_DECODE", "0")
+    try:
+        _purge_decoded()
+        dc.compressed_cache().purge()
+        off = run2()
+    finally:
+        knobs.del_env("OG_DEVICE_DECODE")
+    assert on == off
+    # rebuild from the compressed tier re-stages host blocks lazily
+    _purge_decoded()
+    dc.compressed_cache().purge()
+    on2 = run2()                      # rebuild recipes
+    if DECODE_STATS["slabs_device_decoded"] > dd0:
+        _purge_decoded()              # decoded tiers only
+        assert run2() == on2
+    assert hbm.cross_check()["ok"]

@@ -72,3 +72,122 @@ def test_aggregate_straight_from_encoded_blocks():
     np.testing.assert_array_equal(np.asarray(res.sum), [1280.0, 2560.0])
     assert np.asarray(res.first)[0] == 10.0
     assert np.asarray(res.first_time)[1] == 1000 + 50 * 128
+
+
+# ---- DFOR device expansion (round 14: the compressed-domain tier) ----------
+
+import jax
+
+from opengemini_tpu.encoding import dfor
+from opengemini_tpu.encoding.blocks import DFOR as DFOR_ID
+from opengemini_tpu.ops import device_decode as dd
+from opengemini_tpu.utils import knobs
+
+
+def _stage(payload, n, w):
+    """Host staging of one payload as a 1-row padded batch."""
+    words = dfor.payload_words(payload, n, w)
+    wpad = np.zeros((1, len(words) + 2), dtype=np.uint32)
+    wpad[0, :len(words)] = words
+    ref = dfor.parse_header(payload)[4]
+    return (jax.device_put(wpad),
+            jax.device_put(np.array([ref], dtype=np.uint64)))
+
+
+@pytest.mark.parametrize("make,kind", [
+    (lambda r: np.round(r.normal(50, 15, 512), 2), "f64"),   # scaled
+    (lambda r: r.normal(0, 1, 300), "f64"),                  # width 64
+    (lambda r: np.cumsum(r.normal(0, 1e-9, 256)) + 1e5, "f64"),
+    (lambda r: np.full(128, -7.5), "f64"),                   # width 0
+    (lambda r: np.array([np.nan, np.inf, -np.inf, 0.0] * 33), "f64"),
+])
+def test_dfor_expand_device_vs_host_bit_identity(make, kind):
+    """Kernel-level parity: dfor_expand must reproduce the host
+    decoder's bits for every transform/width class, with ONLY the
+    compressed payload crossing H2D (transfer_guard over the staged
+    expansion)."""
+    v = make(np.random.default_rng(5))
+    p = dfor.encode_float(v)
+    tr, w, ds, n, _ref = dfor.parse_header(p)
+    wd, rd = _stage(p, n, w)
+    # warm the kernel class once (compile pulls nothing afterwards)
+    dd.dfor_expand(wd, rd, n=n, width=w, transform=tr, dscale=ds,
+                   kind=kind)
+    with jax.transfer_guard("disallow"):
+        out = dd.dfor_expand(wd, rd, n=n, width=w, transform=tr,
+                             dscale=ds, kind=kind)
+    host = dfor.decode(p, n, kind)
+    np.testing.assert_array_equal(np.asarray(out)[0].view(np.uint64),
+                                  host.view(np.uint64))
+
+
+def test_dfor_expand_int_parity():
+    v = (np.arange(777, dtype=np.int64) * 991) % 10007 - 5000
+    p = dfor.encode_int(v)
+    assert p is not None
+    tr, w, ds, n, _ref = dfor.parse_header(p)
+    wd, rd = _stage(p, n, w)
+    out = dd.dfor_expand(wd, rd, n=n, width=w, transform=tr,
+                         dscale=ds, kind="i64")
+    np.testing.assert_array_equal(np.asarray(out)[0],
+                                  dfor.decode(p, n, "i64"))
+
+
+def test_dfor_single_block_decode_books_manifest():
+    from opengemini_tpu.ops import compileaudit
+    v = np.round(np.random.default_rng(1).normal(50, 15, 1024), 2)
+    from opengemini_tpu.encoding.blocks import encode_float_block
+    enc = encode_float_block(v)
+    assert enc[0] == DFOR_ID
+    m0 = compileaudit.manifest_snapshot()
+    out = device_decode_float_block(enc, len(v))
+    m1 = compileaudit.manifest_snapshot()
+    np.testing.assert_array_equal(np.asarray(out).view(np.uint64),
+                                  v.view(np.uint64))
+    assert m1["h2d_dfor_bytes"] > m0["h2d_dfor_bytes"]
+    # compressed payload ≪ dense: the diet at the single-block level
+    assert (m1["h2d_dfor_bytes"] - m0["h2d_dfor_bytes"]) < v.nbytes / 3
+
+
+def test_dfor_device_decode_gated_by_knob():
+    v = np.round(np.random.default_rng(2).normal(50, 15, 256), 2)
+    from opengemini_tpu.encoding.blocks import encode_float_block
+    enc = encode_float_block(v)
+    assert enc[0] == DFOR_ID
+    knobs.set_env("OG_DEVICE_DECODE", "0")
+    try:
+        assert device_decode_float_block(enc, len(v)) is None
+    finally:
+        knobs.del_env("OG_DEVICE_DECODE")
+
+
+def test_pad_runs_bucketing_pinned():
+    """The jit-cache-key claim in _pad_runs' docstring, enforced:
+    ≤256 runs share the 256 class; above it, power-of-two growth."""
+    from opengemini_tpu.ops.device_decode import _pad_runs, pad_pow2
+    cases = {1: 256, 255: 256, 256: 256, 257: 512, 511: 512,
+             512: 512, 513: 1024, 1024: 1024, 1025: 2048}
+    for r, expect in cases.items():
+        vals = np.ones(r)
+        lens = np.ones(r, dtype=np.int64)
+        pv, pl = _pad_runs(vals, lens)
+        assert len(pv) == len(pl) == expect, (r, len(pv))
+        # padding is zero-length runs: expansion is unchanged
+        assert pl[r:].sum() == 0 and pl.sum() == r
+    assert pad_pow2(0) == 256
+    # monotone: a growing run count never shrinks its class
+    ps = [pad_pow2(r) for r in range(1, 5000, 7)]
+    assert all(a <= b for a, b in zip(ps, ps[1:]))
+
+
+def test_device_decode_counters_registered():
+    """oglint R6 contract at runtime: the device_decode_* counter
+    group is a registered declaration and the hot-path bumps name
+    declared keys only."""
+    from opengemini_tpu.ops.device_decode import DECODE_STATS
+    from opengemini_tpu.utils import stats as us
+    assert us.COUNTER_REGISTRY.get("device_decode") is DECODE_STATS
+    for key in ("dfor_blocks", "const_blocks", "time_blocks",
+                "batches", "host_heals", "slabs_device_decoded",
+                "compressed_hits", "compressed_rebuilds"):
+        assert key in DECODE_STATS

@@ -153,3 +153,170 @@ def test_validity_roundtrip():
     assert np.array_equal(decode_validity(encode_validity(allv), 77), allv)
     v = rng.integers(0, 2, 1000).astype(np.bool_)
     assert np.array_equal(decode_validity(encode_validity(v), 1000), v)
+
+
+# ---- DFOR (device-friendly frame-of-reference bit-packed layout) ------------
+#
+# The round-trip ORACLE for the compressed-domain tier: every DFOR
+# payload must decode to the EXACT bits of the values it encoded, and
+# the full encoder menu (with the device layout on) must stay
+# value-identical to the legacy menu (off) — including the one-time
+# compaction transcode of legacy byte-codec segments.
+
+from opengemini_tpu.encoding import dfor
+from opengemini_tpu.encoding.blocks import DFOR as DFOR_ID
+from opengemini_tpu.utils import knobs as _knobs
+
+
+def _adversarial_float_blocks():
+    r = np.random.default_rng(7)
+    # non-default NaN payload bits — must survive bit-for-bit
+    nan1 = np.array([0x7FF8000000000001],
+                    dtype=np.uint64).view(np.float64)[0]
+    yield "all-nan", np.full(257, np.nan)
+    yield "nan-payloads", np.array([np.nan] * 5 + [nan1] * 3)
+    yield "inf-mix", np.array([np.inf, -np.inf, 0.0, -0.0, np.nan,
+                               1.0, -1.0] * 9)
+    yield "denormals", np.array([5e-324, -5e-324, 2.2e-308,
+                                 -2.2e-308, 0.0] * 13)
+    yield "single-run", np.full(100, 3.25)
+    yield "single-value", np.array([-123.456])
+    yield "two-decimal", np.round(r.normal(50, 15, 1000), 2)
+    yield "six-decimal", np.round(r.normal(0, 1, 500), 6)
+    yield "integral", np.floor(r.normal(0, 1e6, 333))
+    yield "full-mantissa", r.normal(0, 1, 400)
+    yield "huge-span", np.array([1e-300, 1e300, -1e300, 0.0] * 8)
+    yield "slow-walk", np.cumsum(r.normal(0, 1e-9, 512)) + 7e5
+
+
+def _adversarial_int_blocks():
+    r = np.random.default_rng(11)
+    i64 = np.iinfo(np.int64)
+    yield "zigzag-extremes", np.array([i64.min, i64.max, 0, -1, 1],
+                                      dtype=np.int64)
+    yield "const", np.full(64, -42, dtype=np.int64)
+    yield "counter", np.arange(1000, dtype=np.int64) * 977
+    yield "small-noise", r.integers(-100, 100, 2048, dtype=np.int64)
+    yield "wrap-span", np.array([i64.min, i64.min + 1, i64.max - 1,
+                                 i64.max], dtype=np.int64)
+    yield "single", np.array([i64.min], dtype=np.int64)
+
+
+@pytest.mark.parametrize("name,v", list(_adversarial_float_blocks()))
+def test_dfor_float_fuzz_roundtrip(name, v):
+    p = dfor.encode_float(v)
+    assert p is not None
+    out = dfor.decode(p, len(v), "f64")
+    assert np.array_equal(v.view(np.uint64), out.view(np.uint64)), name
+    tr, w, ds, n, _ref = dfor.parse_header(p)
+    assert n == len(v)
+    assert 0 <= w <= 64 and w % 2 == 0    # shape-class hygiene
+
+
+@pytest.mark.parametrize("name,v", list(_adversarial_int_blocks()))
+def test_dfor_int_fuzz_roundtrip(name, v):
+    p = dfor.encode_int(v)
+    if p is None:                         # width-64 ints: raw wins
+        return
+    out = dfor.decode(p, len(v), "i64")
+    assert np.array_equal(v, out), name
+
+
+def test_dfor_width_edges():
+    # width 0: all residuals zero (const after transform)
+    p = dfor.encode_float(np.full(64, 1.5))
+    _tr, w, _ds, _n, _ref = dfor.parse_header(p)
+    assert w == 0 and len(p) == dfor.HEADER_BYTES
+    assert np.array_equal(dfor.decode(p, 64, "f64"), np.full(64, 1.5))
+    # width 64: full-mantissa noise still round-trips bit for bit
+    v = np.random.default_rng(3).normal(0, 1, 65)
+    p = dfor.encode_float(v)
+    assert dfor.parse_header(p)[1] == 64
+    assert np.array_equal(dfor.decode(p, 65, "f64").view(np.uint64),
+                          v.view(np.uint64))
+
+
+def test_dfor_scaled_verifies_not_guesses():
+    # values that LOOK decimal but are off by one ulp must not take
+    # the scaled transform onto a wrong decode
+    v = np.round(np.random.default_rng(5).normal(50, 10, 256), 2)
+    v[17] = np.nextafter(v[17], np.inf)
+    p = dfor.encode_float(v)
+    out = dfor.decode(p, len(v), "f64")
+    assert np.array_equal(v.view(np.uint64), out.view(np.uint64))
+
+
+def test_dfor_menu_oracle_values_identical():
+    """Device layout on vs off: the codec CHOICE may differ, the
+    decoded values may not — over every adversarial block."""
+    for name, v in _adversarial_float_blocks():
+        on = decode_float_block(encode_float_block(v), len(v))
+        _knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "0")
+        try:
+            off = decode_float_block(encode_float_block(v), len(v))
+        finally:
+            _knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+        assert np.array_equal(on.view(np.uint64),
+                              off.view(np.uint64)), name
+    for name, v in _adversarial_int_blocks():
+        on = decode_integer_block(encode_integer_block(v), len(v))
+        _knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "0")
+        try:
+            off = decode_integer_block(encode_integer_block(v),
+                                       len(v))
+        finally:
+            _knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+        assert np.array_equal(on, off), name
+
+
+def test_dfor_picked_for_decimal_gauges():
+    """The bench-shaped data (2-decimal cpu gauges) must take the
+    device layout by default — the compressed-domain H2D diet's
+    premise — and beat the raw payload by a wide margin."""
+    v = np.round(np.clip(
+        np.random.default_rng(42).normal(50, 15, 4096), 0, 100), 2)
+    buf = encode_float_block(v)
+    assert buf[0] == DFOR_ID
+    assert len(buf) < len(v.tobytes()) / 4      # ≥4x vs raw
+    assert np.array_equal(
+        decode_float_block(buf, len(v)).view(np.uint64),
+        v.view(np.uint64))
+
+
+def test_dfor_transcode_oracle_compaction():
+    """The compaction transcode (storage/tssp.write_series_raw):
+    legacy byte-codec float segments re-encode through the menu —
+    decoded values must be identical before and after."""
+    v = np.cumsum(np.random.default_rng(9).normal(0, 1, 500))
+    _knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "0")
+    try:
+        legacy = encode_float_block(v, prefer="gorilla")
+    finally:
+        _knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+    assert legacy[0] == 7                        # GORILLA
+    vals = decode_float_block(legacy, len(v))
+    transcoded = encode_float_block(vals)
+    out = decode_float_block(transcoded, len(v))
+    assert np.array_equal(v.view(np.uint64), out.view(np.uint64))
+
+
+def test_dfor_batch_decode_matches_scalar():
+    """decode_batch (the bulk flat-scan group decoder) must equal the
+    per-segment decode for a batch of same-shape segments."""
+    r = np.random.default_rng(13)
+    blocks = [np.round(r.normal(50, 15, 128), 2) for _ in range(9)]
+    payloads = [dfor.encode_float(b) for b in blocks]
+    heads = [dfor.parse_header(p) for p in payloads]
+    # group by (transform, width, dscale) as scan.py does
+    from collections import defaultdict
+    groups = defaultdict(list)
+    for i, (tr, w, ds, n, ref) in enumerate(heads):
+        groups[(tr, w, ds)].append(i)
+    for (tr, w, ds), idxs in groups.items():
+        words = np.stack([dfor.payload_words(payloads[i], 128, w)
+                          for i in idxs])
+        refs = np.array([heads[i][4] for i in idxs], dtype=np.uint64)
+        out = dfor.decode_batch(words, refs, 128, w, tr, ds, "f64")
+        for j, i in enumerate(idxs):
+            assert np.array_equal(out[j].view(np.uint64),
+                                  blocks[i].view(np.uint64))
