@@ -188,6 +188,8 @@ class HttpServer:
             sp.register("scheduler", scheduler_collector)
             from ..utils.stats import hbm_collector
             sp.register("hbm", hbm_collector)
+            from ..utils.stats import resultcache_collector
+            sp.register("resultcache", resultcache_collector)
             from ..utils.stats import devicefault_collector
             sp.register("devicefault", devicefault_collector)
             from ..utils.stats import (compileaudit_collector,
@@ -571,6 +573,19 @@ class HttpServer:
             return int(ms * 1e6)
         return int(self.config.http.slow_query_threshold_ns)
 
+    @staticmethod
+    def _tenant_of(headers) -> str:
+        """X-OG-Tenant request header → tenant identity for fair-share
+        admission and attribution ("" = the default tenant). Bounded:
+        a hostile header must not mint unbounded scheduler state."""
+        if headers is None:
+            return ""
+        try:
+            t = (headers.get("X-OG-Tenant") or "").strip()
+        except Exception:
+            return ""
+        return t[:64]
+
     def _trace_begin(self, kind: str, headers=None):
         """(trace_id, root_span | None, sampled): head-sample roll for
         one request. A client-supplied X-OG-Trace header forces the
@@ -588,7 +603,9 @@ class HttpServer:
 
     def _finish_trace(self, kind: str, text: str, db: str | None,
                       t0_ns: int, trace_id: str, root, sampled: bool,
-                      tstat: dict, meta: dict | None = None) -> None:
+                      tstat: dict, meta: dict | None = None,
+                      tenant: str = "",
+                      cache_status: str = "") -> None:
         """Close one request's trace: classify (ok/error/shed/killed/
         slow), log + ring-retain slow queries (the now-wired
         slow_query_threshold), record into the flight recorder. A
@@ -628,7 +645,8 @@ class HttpServer:
                 start_wall=time.time() - dur_ns / 1e9,
                 duration_ns=int(dur_ns), status=status,
                 error=tstat.get("error", ""), sampled=sampled,
-                root=root))
+                root=root, tenant=tenant,
+                cache_status=cache_status))
             if meta is not None:
                 meta["trace_id"] = trace_id
 
@@ -653,7 +671,8 @@ class HttpServer:
         self._finish_trace("write",
                            f"POST /write db={params.get('db') or ''}",
                            params.get("db"), t0, trace_id, root,
-                           sampled, tstat, meta)
+                           sampled, tstat, meta,
+                           tenant=self._tenant_of(headers))
         return code, payload
 
     def _handle_write_inner(self, params: dict, body: bytes,
@@ -721,6 +740,19 @@ class HttpServer:
             if sch.max_concurrent > 0 or sch.max_cells > 0:
                 cost = _qsched.estimate_request_cost(self.executor,
                                                      stmts, db)
+                # result-cache discount: a range mostly covered by a
+                # valid cached entry admits at its live-edge cost —
+                # warm dashboards must not queue behind estimates for
+                # work the cache will resolve (the estimate only; the
+                # serve path revalidates everything)
+                try:
+                    from ..query import resultcache as _rc
+                    cost = _rc.discount_cost(
+                        self.executor, stmts, db,
+                        getattr(ctx, "tenant", ""), cost)
+                except Exception:
+                    log.exception("result-cache admission discount "
+                                  "failed")
             else:
                 cost = _qsched.QueryCost(0)
             if ctx is not None:
@@ -771,12 +803,16 @@ class HttpServer:
         # when they fail or run slow
         t_q0 = time.perf_counter_ns()
         trace_id, root, sampled = self._trace_begin("query", headers)
+        tenant = self._tenant_of(headers)
         if root is not None:
-            root.add(db=db or "", statements=len(stmts))
+            root.add(db=db or "", statements=len(stmts),
+                     tenant=tenant or "default")
         tstat = {"status": "ok", "error": ""}
         # register at ENQUEUE time: a queued query is visible to SHOW
-        # QUERIES (status "queued") and killable before admission
-        ctx = self.query_manager.attach(qtext, db) \
+        # QUERIES (status "queued") and killable before admission;
+        # the tenant identity rides the ctx into scheduler fair-share
+        # accounting and the result-cache key
+        ctx = self.query_manager.attach(qtext, db, tenant=tenant) \
             if self.query_manager is not None else None
         if ctx is not None:
             ctx.trace_id = trace_id
@@ -905,8 +941,13 @@ class HttpServer:
             _observe(HTTP_HIST, "query_latency_ms",
                      (time.perf_counter_ns() - t_q0) / 1e6,
                      trace_id=trace_id if sampled else None)
+            cstat = getattr(ctx, "cache_status", "") \
+                if ctx is not None else ""
+            if root is not None and cstat:
+                root.add(cache_status=cstat)
             self._finish_trace("query", qtext, db, t_q0, trace_id,
-                               root, sampled, tstat, meta)
+                               root, sampled, tstat, meta,
+                               tenant=tenant, cache_status=cstat)
         return 200, {"results": results}
 
     def metrics_text(self, fmt: str = "prometheus") -> str:
@@ -925,6 +966,7 @@ class HttpServer:
                                    engine_collector, executor_collector,
                                    hbm_collector, raft_collector,
                                    readcache_collector,
+                                   resultcache_collector,
                                    rpc_collector, runtime_collector,
                                    scheduler_collector,
                                    subscriber_collector, wal_collector,
@@ -939,6 +981,7 @@ class HttpServer:
                   "query_phases": phase_collector(),
                   "scheduler": scheduler_collector(),
                   "hbm": hbm_collector(),
+                  "resultcache": resultcache_collector(),
                   "devicefault": devicefault_collector(),
                   "compileaudit": compileaudit_collector(),
                   "xfer": xfer_collector(),
@@ -978,7 +1021,8 @@ class HttpServer:
     # --------------------------------------------------- flux endpoint
 
     def handle_flux(self, body: bytes, content_type: str,
-                    user=None) -> tuple[int, dict | None, str | None]:
+                    user=None, headers=None
+                    ) -> tuple[int, dict | None, str | None]:
         """POST /api/v2/query — Flux pipeline queries (reference
         flux-read route handler.go:484-496; openGemini's own
         serveFluxQuery is a "not implementation" stub — here the
@@ -1021,7 +1065,8 @@ class HttpServer:
         # registration and killability — a monster must not bypass the
         # scheduler by arriving in flux clothing
         from ..query import scheduler as _qsched
-        ctx = self.query_manager.attach(qtext, comp.db) \
+        ctx = self.query_manager.attach(
+            qtext, comp.db, tenant=self._tenant_of(headers)) \
             if self.query_manager is not None else None
         ticket = None
         gate_held = False
@@ -1614,6 +1659,7 @@ class _Handler(BaseHTTPRequestHandler):
                                        devicefault_collector,
                                        hbm_collector,
                                        histogram_summaries,
+                                       resultcache_collector,
                                        scheduler_collector,
                                        wal_collector)
             out = dict(srv.stats)
@@ -1623,6 +1669,7 @@ class _Handler(BaseHTTPRequestHandler):
             out["query_phases"] = phase_collector()
             out["scheduler"] = scheduler_collector()
             out["hbm"] = hbm_collector()
+            out["resultcache"] = resultcache_collector()
             out["devicefault"] = devicefault_collector()
             # compile-cache + transfer audit layer (ops/compileaudit):
             # per-kernel compile log with shape signatures, the jaxpr
@@ -1725,6 +1772,7 @@ class _Handler(BaseHTTPRequestHandler):
             sch = _qs.get_scheduler()
             self._reply(200, {"enabled": _qs.enabled(),
                               "scheduler": sch.snapshot(),
+                              "tenants": sch.tenants_snapshot(),
                               "calibration":
                                   sch.calibration_snapshot()})
             return
@@ -1854,7 +1902,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad body: {e}"})
                 return
             code, payload, csv_text = srv.handle_flux(
-                body, self.headers.get("Content-Type", ""), user=user)
+                body, self.headers.get("Content-Type", ""), user=user,
+                headers=self.headers)
             if csv_text is not None:
                 data = csv_text.encode()
                 self.send_response(code)

@@ -87,6 +87,10 @@ QUERY_PHASE_NS: dict = register_counters("query_phase", {
     # launches, limb decomposition, compressed-tier rebuilds
     "device_decode_ns": 0,
     "grid_fold_ns": 0,
+    # result-cache bookkeeping (query/resultcache.py): key build,
+    # epoch validation, cached-prefix trim and store — NOT the fresh
+    # live-edge scan, which rides the ordinary phases above
+    "result_cache_ns": 0,
     # merge is NESTED inside finalize (exchange-merge of partials);
     # serialize is the HTTP-layer streaming JSON/CSV emit, outside the
     # executor span — so merge ⊂ finalize and serialize is additive

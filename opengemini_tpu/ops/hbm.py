@@ -58,7 +58,7 @@ __all__ = ["HBMLedger", "LEDGER", "account", "release", "pressure",
            "chrome_counter_events", "collector", "HBM_STATS"]
 
 TIERS = ("device_cache", "host_cache", "pipeline", "sketch",
-         "compressed")
+         "compressed", "result_cache")
 
 # event counters + collector-refreshed gauges (utils.stats registry —
 # oglint R6 covers every bump key; the per-tier live numbers live in
@@ -282,10 +282,12 @@ def cross_check() -> dict:
     # instance and their constructor drains a dead predecessor's
     # ledger residue — a snapshot taken first would still show those
     # bytes against the fresh (empty) instance
+    from ..query import resultcache as _rc
     tiers = (("device_cache", _dc.global_cache()),
              ("host_cache", _dc.host_cache()),
              ("sketch", _dc.sketch_cache()),
-             ("compressed", _dc.compressed_cache()))
+             ("compressed", _dc.compressed_cache()),
+             ("result_cache", _rc.global_cache()))
     snap = LEDGER.snapshot(events=False)
     out: dict = {}
     for tier, cache in tiers:

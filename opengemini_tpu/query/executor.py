@@ -895,12 +895,18 @@ class QueryExecutor:
                      # measured device-resource columns (observatory):
                      # shed/kill decisions can cite measured-vs-budget
                      round(getattr(c, "hbm_peak", 0) / 1e6, 3),
-                     round(getattr(c, "d2h_bytes", 0) / 1e6, 3)]
+                     round(getattr(c, "d2h_bytes", 0) / 1e6, 3),
+                     # sustained-serving columns: which tenant's fair
+                     # share this query charges, and how the result
+                     # cache resolved it (hit/partial/miss/bypass)
+                     getattr(c, "tenant", "") or "default",
+                     getattr(c, "cache_status", "")]
                     for c in qm.list()] if qm else []
             return _series("queries",
                            ["qid", "query", "database", "duration",
                             "status", "queue_ms", "device_ms",
-                            "hbm_peak_mb", "d2h_mb"], rows)
+                            "hbm_peak_mb", "d2h_mb", "tenant",
+                            "cache_status"], rows)
         if stmt.what == "subscriptions":
             if self.catalog is None:
                 return {"error": "meta catalog is not available"}
@@ -1513,13 +1519,28 @@ class QueryExecutor:
                 stmt, db, mst, cs, cond, tag_keys, inc_query_id, iter_id,
                 ctx=ctx, span=span)
         else:
-            # terminal=True: this partial goes straight to the local
-            # finalize — no cluster/incremental merge pending — so the
-            # block path may finalize grids ON DEVICE and ship answer
-            # planes instead of the mergeable limb wire format
-            partial = self.partial_agg(stmt, db, mst, cs, cond, tag_keys,
-                                       ctx=ctx, span=span, plan=hints,
-                                       terminal=True)
+            # result cache (sustained-serving tentpole): an eligible
+            # repeated dashboard aggregate serves its closed time
+            # buckets from cached mergeable partials and scans only
+            # the live edge; write epochs invalidate before any stale
+            # read. Ineligible/disabled → NotImplemented sentinel and
+            # the terminal fast path below runs unchanged.
+            from . import resultcache as _rc
+            served = _rc.serve(self, stmt, db, mst, cs, cond,
+                               tag_keys, ctx=ctx, span=span,
+                               plan=hints)
+            if served is not NotImplemented:
+                partial = served
+            else:
+                # terminal=True: this partial goes straight to the
+                # local finalize — no cluster/incremental merge
+                # pending — so the block path may finalize grids ON
+                # DEVICE and ship answer planes instead of the
+                # mergeable limb wire format
+                partial = self.partial_agg(stmt, db, mst, cs, cond,
+                                           tag_keys, ctx=ctx,
+                                           span=span, plan=hints,
+                                           terminal=True)
         from ..ops import devstats as _dstat
         _t_fin0 = _now_ns()
         if span is not None:

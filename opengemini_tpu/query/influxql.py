@@ -62,6 +62,7 @@ _DUR_NS = {"ns": 1, "u": 10**3, "µ": 10**3, "ms": 10**6, "s": 10**9,
 
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
+  | (?P<comment>--[^\n]*|/\*.*?\*/)
   | (?P<duration>\d+(?:ns|u|µ|ms|s|m|h|d|w)(?:\d+(?:ns|u|µ|ms|s|m|h|d|w))*)
   | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?i?)
   | (?P<string>'(?:[^'\\]|\\.)*')
@@ -82,7 +83,10 @@ class Lexer:
             kind = m.lastgroup
             val = m.group()
             pos = m.end()
-            if kind == "ws":
+            if kind in ("ws", "comment"):
+                # comments (`-- …`, `/* … */`) lex away like
+                # whitespace: commented variants of one dashboard
+                # query parse — and result-cache-key — identically
                 continue
             # 'other' covers characters only valid inside /regex/ bodies,
             # which the parser re-lexes from raw text via try_regex

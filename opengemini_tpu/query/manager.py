@@ -26,10 +26,18 @@ class QueryContext:
     the scheduler's admit loop watches the kill flag. queue_ns/
     device_ns are the per-query serving phases SHOW QUERIES reports."""
 
-    def __init__(self, qid: int, text: str, db: str | None):
+    def __init__(self, qid: int, text: str, db: str | None,
+                 tenant: str = ""):
         self.qid = qid
         self.text = text
         self.db = db or ""
+        # sustained-serving attribution: the X-OG-Tenant identity this
+        # query charges in the scheduler's per-tenant fair queue, and
+        # how the result cache resolved it (hit/partial/miss/bypass;
+        # "" = never reached an eligible SELECT) — SHOW QUERIES and
+        # the flight recorder surface both
+        self.tenant = tenant or ""
+        self.cache_status = ""
         self.start = time.monotonic()
         self.start_wall = time.time()
         self.state = "running"      # "queued" while awaiting admission
@@ -106,11 +114,12 @@ class QueryManager:
         self._next = 1
         self._running: dict[int, QueryContext] = {}
 
-    def attach(self, text: str, db: str | None) -> QueryContext:
+    def attach(self, text: str, db: str | None,
+               tenant: str = "") -> QueryContext:
         with self._lock:
             qid = self._next
             self._next += 1
-            ctx = QueryContext(qid, text, db)
+            ctx = QueryContext(qid, text, db, tenant=tenant)
             self._running[qid] = ctx
         return ctx
 

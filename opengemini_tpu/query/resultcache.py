@@ -1,0 +1,673 @@
+"""Time-bucketed result cache: the sustained-serving subsystem.
+
+PR 4 proved a 16-query *burst* can be scheduled fairly onto one
+device; production dashboard traffic is *sustained* and overwhelmingly
+repetitive — the same handful of statements polled by thousands of
+clients with sliding now()-relative ranges. Tailwind's framing
+(PAPERS.md): an accelerator pool is only economical when repeat work
+is deduplicated *before* it reaches the device. This module is that
+dedup layer, sitting between http.handle_query and the executor's
+partial-aggregation machinery:
+
+- **Canonical keys** (``canonical_key``): a statement keys by its
+  *parsed* shape — select list, dimensions, fill, order/limit, sorted
+  tag predicates, residual tree — plus (db, rp, measurement, tenant),
+  and NOT by its absolute time range. Whitespace/case/comment and
+  now()-relative-time variants of one dashboard query key identically;
+  differing limits/fills/tenants key apart (fuzz-tested).
+
+- **Bucket split** (``serve``): each query's window grid splits at the
+  *closed-bucket* boundary ``floor(now / OG_RESULT_BUCKET_S)``.
+  Windows wholly inside closed buckets serve from a cached mergeable
+  partial state (the PR 1/PR 3 exchange wire format —
+  ``merge_partials`` is the merge operator and is exact: integer limb
+  sums, counts, min/max/first/last states merge bit-identically, which
+  is why ``_CACHEABLE_OPS`` is exactly the exact-merge set); only the
+  live edge — and any unaligned head/tail fragment — recomputes.
+  ``OG_RESULT_CACHE=0`` restores the full recompute byte for byte.
+
+- **Write-epoch invalidation** (utils/epochs.py): every ingest batch
+  bumps a per-(db, measurement) epoch with its written time extent
+  (shard-granular bounds are fine); DELETE/DROP/retention wipe. A
+  cache entry stamps the epoch BEFORE its compute scan and validates
+  on every read: any overlapping write since the stamp — including
+  one racing the scan — invalidates. A write-then-read can never be
+  served stale (tier-1 tested).
+
+- **Byte budget** (``OG_RESULT_CACHE_MB``): LRU over entry byte
+  sizes, double-entry accounted as the ``result_cache`` tier of the
+  PR 8 HBM/host ledger (exact ``hbm.cross_check`` after every test
+  via the conftest leak guard).
+
+- **Admission discount** (``discount_cost``): a request whose range is
+  mostly covered by a valid entry is charged only its live-edge cells
+  in the scheduler's weighted-fair queue — cache-resolved work admits
+  at its real (near-zero) cost, so a warm dashboard storm never queues
+  behind its own estimates.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ..utils import epochs, knobs
+from ..utils.lockrank import RANK_RESULTCACHE, RankedLock
+from ..utils.stats import register_counters
+from .incremental import trim_left
+
+__all__ = ["ResultCache", "global_cache", "enabled", "serve",
+           "canonical_key", "discount_cost", "resultcache_collector",
+           "note_engine_closed", "RC_STATS"]
+
+# aggregate ops whose split-scan-and-merge is bit-identical to a
+# single full-range scan: counts and int sums are exact integers, f64
+# sums ride the exact-limb states, min/max/first/last/spread are
+# order-free selections. stddev (f64 sumsq), raw-slice ops
+# (percentile/median/mode/...), sketches and top/bottom multirow
+# selectors are excluded — their merge is not guaranteed bit-identical
+# to the unsplit scan, and byte-identity is this cache's contract.
+_CACHEABLE_OPS = frozenset(
+    {"count", "sum", "mean", "min", "max", "first", "last", "spread"})
+
+RC_STATS: dict = register_counters("resultcache", {
+    "hits": 0,               # full range served from cache (no scan)
+    "partial_hits": 0,       # closed prefix cached, live edge scanned
+    "misses": 0,             # eligible but nothing cached / unusable
+    "bypass": 0,             # ineligible statement or cache disabled
+    "inserts": 0,            # entries stored or refreshed
+    "invalidations_epoch": 0,  # entry dropped: overlapping write since
+    # its epoch stamp (or evicted epoch history — conservative)
+    "invalidations_wipe": 0,   # entry dropped: db wipe generation bump
+    "evictions": 0,          # LRU byte-budget evictions
+    "too_large": 0,          # partial bigger than the per-entry cap
+    "admit_discounts": 0,    # admission charges shrunk to live edge
+    "windows_served": 0,     # closed windows served from cache
+    "windows_computed": 0,   # windows recomputed (miss + live edge)
+})
+
+
+def _bump(key: str, n: int = 1) -> None:
+    from ..utils.stats import bump as _b
+    _b(RC_STATS, key, n)
+
+
+def enabled() -> bool:
+    """OG_RESULT_CACHE=0 disables the subsystem everywhere (serve,
+    store, admission discount) — the byte-identical escape hatch. The
+    byte budget doubles as a second gate so operators can size it to
+    zero."""
+    return bool(knobs.get("OG_RESULT_CACHE")) \
+        and int(knobs.get("OG_RESULT_CACHE_MB")) > 0
+
+
+# ------------------------------------------------------------- keying
+
+_ENG_LOCK = threading.Lock()
+_ENG_NEXT = [1]
+
+
+def _engine_token(engine) -> int:
+    """Stable per-Engine identity for cache keys: two engines serving
+    the same db name (test fixtures, reopened data dirs) must never
+    share entries. Monotonic — never reused after GC like id()."""
+    tok = getattr(engine, "_og_rc_token", None)
+    if tok is None:
+        with _ENG_LOCK:
+            tok = getattr(engine, "_og_rc_token", None)
+            if tok is None:
+                tok = _ENG_NEXT[0]
+                _ENG_NEXT[0] += 1
+                try:
+                    engine._og_rc_token = tok
+                except Exception:
+                    return -1        # unsettable engine: never cache
+    return tok
+
+
+def canonical_key(engine, db: str, mst: str, stmt, cond,
+                  tenant: str = "") -> tuple:
+    """Range-invariant canonical identity of one dashboard statement.
+    Built from the PARSED statement (the parser already normalizes
+    whitespace/case/comments and resolves now() to literals, and the
+    key drops the absolute time bounds), with sorted tag predicates so
+    predicate order cannot split the key. Everything result-affecting
+    stays in: select list, dimensions (interval/offset), fill, order/
+    limit/offset/slimit/soffset, tz, residual predicate, rp — and the
+    tenant, so entries are quota-isolated."""
+    return (
+        _engine_token(engine), db, stmt.from_rp or "", mst,
+        tenant or "",
+        repr(stmt.fields), repr(stmt.dimensions),
+        stmt.fill_option, repr(stmt.fill_value),
+        repr((stmt.order_desc, stmt.limit, stmt.offset, stmt.slimit,
+              stmt.soffset)),
+        stmt.tz or "",
+        repr(sorted((f.key, f.op, f.value)
+                    for f in cond.tag_filters)),
+        repr(cond.index_key()[1]),
+        repr(cond.residual))
+
+
+def _probe_key(engine, db: str, mst: str, stmt, tenant: str) -> tuple:
+    """Coarse admission-probe key: computable WITHOUT the tag-key
+    universe (which needs shard index walks). Several canonical keys
+    may share one probe key (differing WHERE residuals) — the probe
+    only shapes the admission *estimate*, never a served result."""
+    return (_engine_token(engine), db, stmt.from_rp or "", mst,
+            tenant or "", repr(stmt.fields), repr(stmt.dimensions),
+            stmt.fill_option)
+
+
+# ------------------------------------------------------ window algebra
+
+def _grid_offset(stmt, interval: int) -> int:
+    off = stmt.group_by_offset()
+    if stmt.tz and interval:
+        from .executor import tz_bucket_offset
+        off += tz_bucket_offset(stmt.tz, interval)
+    return off
+
+
+def _floor_align(t: int, interval: int, off: int) -> int:
+    return (t - off) // interval * interval + off
+
+
+def _ceil_align(t: int, interval: int, off: int) -> int:
+    f = _floor_align(t, interval, off)
+    return f if f == t else f + interval
+
+
+def _trim_keep(partial: dict, keep_w: int) -> dict | None:
+    """Keep the first ``keep_w`` windows of a fields-only partial
+    (copies — the cache must own its memory; kernel outputs can be
+    read-only views of device buffers)."""
+    if keep_w <= 0:
+        return None
+    out = dict(partial)
+    out["W"] = keep_w
+    out["fields"] = {
+        f: {k: np.asarray(v)[:, :keep_w].copy()
+            for k, v in st.items()}
+        for f, st in partial["fields"].items()}
+    return out
+
+
+def _partial_nbytes(partial: dict) -> int:
+    n = 256
+    for st in partial["fields"].values():
+        for v in st.values():
+            n += np.asarray(v).nbytes
+    n += 64 * len(partial.get("group_keys", ()))
+    return n
+
+
+def _entry_cap() -> int:
+    return max((int(knobs.get("OG_RESULT_CACHE_MB")) << 20) // 4, 1)
+
+
+def _view_nbytes(partial: dict, keep_w: int) -> int:
+    """Entry size a ``_trim_keep(partial, keep_w)`` WOULD produce,
+    computed from shapes alone — the over-cap rejection must not pay
+    the multi-hundred-MB copy it is rejecting."""
+    n = 256
+    for st in partial["fields"].values():
+        for v in st.values():
+            a = np.asarray(v)
+            per = a.itemsize
+            for d in a.shape[2:]:
+                per *= d
+            n += a.shape[0] * keep_w * per
+    n += 64 * len(partial.get("group_keys", ()))
+    return n
+
+
+# ------------------------------------------------------------ the cache
+
+class _Entry:
+    __slots__ = ("key", "probe", "db", "mst", "partial", "start",
+                 "watermark", "interval", "epoch", "gen", "db_gen",
+                 "nbytes", "hits", "ts")
+
+    def __init__(self, key, probe, db, mst, partial, watermark,
+                 stamp, nbytes):
+        self.key = key
+        self.probe = probe
+        self.db = db
+        self.mst = mst
+        self.partial = partial           # fields-only mergeable state
+        self.start = int(partial["start"])
+        self.watermark = int(watermark)  # exclusive cached end (ns)
+        self.interval = int(partial["interval"])
+        # (epoch, mst wipe gen, db wipe gen) — utils.epochs.snapshot,
+        # taken BEFORE the compute scan
+        self.epoch, self.gen, self.db_gen = (int(x) for x in stamp)
+        self.nbytes = int(nbytes)
+        self.hits = 0
+        self.ts = time.monotonic()
+
+
+class ResultCache:
+    """LRU of closed-bucket partial states, byte-budgeted and ledger-
+    accounted (tier ``result_cache``). One per process; entries carry
+    an engine token so test fixtures never cross-serve."""
+
+    def __init__(self):
+        self._lock = RankedLock("resultcache", RANK_RESULTCACHE)
+        self._lru: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._probe: dict[tuple, set] = {}
+        self._bytes = 0
+        # negative cache: keys whose partial state exceeded the
+        # per-entry cap — those statements BYPASS on later runs so
+        # they keep the terminal device-finalize/top-k transport diet
+        # instead of paying the mergeable wire format for a store that
+        # can never happen (bounded; cleared by purge)
+        self._too_large: set = set()
+
+    def note_too_large(self, key: tuple) -> None:
+        with self._lock:
+            if len(self._too_large) >= 1024:
+                self._too_large.clear()
+            self._too_large.add(key)
+
+    def is_too_large(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._too_large
+
+    # ---- ledger mirroring (every byte moves under self._lock, the
+    # ledger is booked inside the same critical section — the tier can
+    # never drift from self._bytes between operations)
+
+    def _account(self, n: int) -> None:
+        from ..ops import hbm
+        self._bytes += n
+        hbm.account("result_cache", n)
+
+    def _release(self, n: int) -> None:
+        from ..ops import hbm
+        self._bytes -= n
+        hbm.release("result_cache", n)
+
+    def _drop_locked(self, ent: _Entry, reason: str | None) -> None:
+        self._lru.pop(ent.key, None)
+        ps = self._probe.get(ent.probe)
+        if ps is not None:
+            ps.discard(ent.key)
+            if not ps:
+                self._probe.pop(ent.probe, None)
+        self._release(ent.nbytes)
+        if reason is not None:
+            from ..ops import hbm
+            hbm.pressure("result_cache", ent.nbytes, reason)
+
+    # ------------------------------------------------------- lookups
+
+    def _invalidate_locked(self, ent: _Entry) -> None:
+        _ep, g, dg = epochs.snapshot(ent.db, ent.mst)
+        wipe = g != ent.gen or dg != ent.db_gen
+        self._drop_locked(ent, None)
+        _bump("invalidations_wipe" if wipe else "invalidations_epoch")
+
+    def get_valid(self, key: tuple) -> _Entry | None:
+        """Entry under ``key`` after write-epoch validation; an entry
+        whose range saw a write (or whose history is unknowable) is
+        dropped here, so a stale partial can never reach a merge."""
+        with self._lock:
+            ent = self._lru.get(key)
+            if ent is None:
+                return None
+            changed, cur = epochs.changed_since(
+                ent.db, ent.mst, ent.epoch, ent.gen, ent.db_gen,
+                ent.start, ent.watermark)
+            if changed:
+                self._invalidate_locked(ent)
+                return None
+            ent.epoch = cur          # shorten the next ring scan
+            ent.hits += 1
+            ent.ts = time.monotonic()
+            self._lru.move_to_end(key)
+            return ent
+
+    def probe_coverage(self, probe: tuple) -> tuple[int, int, int] | None:
+        """(start, watermark, interval) of the freshest VALID entry
+        under a coarse probe key — the admission discount's view.
+        Validation here is the same epoch check as get_valid, so a
+        just-invalidated range cannot discount an admission charge."""
+        with self._lock:
+            keys = self._probe.get(probe)
+            if not keys:
+                return None
+            best = None
+            for k in list(keys):
+                ent = self._lru.get(k)
+                if ent is None:
+                    keys.discard(k)
+                    continue
+                changed, cur = epochs.changed_since(
+                    ent.db, ent.mst, ent.epoch, ent.gen, ent.db_gen,
+                    ent.start, ent.watermark)
+                if changed:
+                    self._invalidate_locked(ent)
+                    continue
+                ent.epoch = cur
+                if best is None or ent.watermark > best[1]:
+                    best = (ent.start, ent.watermark, ent.interval)
+            return best
+
+    # -------------------------------------------------------- store
+
+    def store(self, key: tuple, probe: tuple, db: str, mst: str,
+              partial: dict, watermark: int, stamp: tuple) -> bool:
+        budget = int(knobs.get("OG_RESULT_CACHE_MB")) << 20
+        if budget <= 0:
+            return False
+        nbytes = _partial_nbytes(partial)
+        if nbytes > max(budget // 4, 1):
+            _bump("too_large")
+            return False
+        with self._lock:
+            old = self._lru.get(key)
+            if old is not None:
+                self._drop_locked(old, None)
+            ent = _Entry(key, probe, db, mst, partial, watermark,
+                         stamp, nbytes)
+            self._lru[key] = ent
+            self._probe.setdefault(probe, set()).add(key)
+            self._account(nbytes)
+            while self._bytes > budget and len(self._lru) > 1:
+                victim = next(iter(self._lru.values()))
+                if victim is ent:
+                    break
+                self._drop_locked(victim, "lru_eviction")
+                _bump("evictions")
+        _bump("inserts")
+        return True
+
+    # ---------------------------------------------------- maintenance
+
+    def purge(self, token: int | None = None) -> int:
+        """Drop entries (all, or one engine token's) releasing their
+        ledger bytes — Engine.close() and test teardown."""
+        n = 0
+        with self._lock:
+            for key in list(self._lru):
+                if token is not None and key[0] != token:
+                    continue
+                self._drop_locked(self._lru[key], None)
+                n += 1
+            if token is None:
+                self._too_large.clear()
+            else:
+                self._too_large = {k for k in self._too_large
+                                   if k[0] != token}
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._lru), "bytes": self._bytes}
+
+
+_CACHE: ResultCache | None = None
+_CACHE_LOCK = threading.Lock()
+
+
+def global_cache() -> ResultCache:
+    global _CACHE
+    with _CACHE_LOCK:
+        if _CACHE is None:
+            _CACHE = ResultCache()
+        return _CACHE
+
+
+def note_engine_closed(engine) -> None:
+    """Engine.close(): its entries can never be served again — return
+    their bytes to the ledger now instead of waiting for LRU churn."""
+    tok = getattr(engine, "_og_rc_token", None)
+    if tok is not None and _CACHE is not None:
+        _CACHE.purge(tok)
+
+
+# -------------------------------------------------------- eligibility
+
+def _eligible(stmt, cs, cond) -> bool:
+    from .condition import MAX_TIME, MIN_TIME
+    if cs.mode != "agg" or cs.multirow is not None:
+        return False
+    if stmt.from_subquery is not None or stmt.extra_sources \
+            or stmt.join is not None:
+        return False
+    interval = stmt.group_by_interval()
+    if not interval:
+        return False
+    if not cond.has_time_range or cond.t_min == MIN_TIME \
+            or cond.t_max == MAX_TIME:
+        return False
+    if not cs.aggs or any(a.func not in _CACHEABLE_OPS
+                          for a in cs.aggs):
+        return False
+    return True
+
+
+def _closed_cut(now_ns: int) -> int:
+    bucket = int(float(knobs.get("OG_RESULT_BUCKET_S")) * 1e9)
+    if bucket <= 0:
+        bucket = 60_000_000_000
+    return now_ns // bucket * bucket
+
+
+# --------------------------------------------------------------- serve
+
+def _mark(ctx, span, status: str) -> None:
+    if ctx is not None:
+        ctx.cache_status = status
+    if span is not None:
+        span.add(cache_status=status)
+
+
+def serve(executor, stmt, db: str, mst: str, cs, cond, tag_keys,
+          ctx=None, span=None, plan=None):
+    """Cache-aware partial assembly for one eligible SELECT: serve the
+    closed-window prefix from a validated cache entry, scan only the
+    uncovered head/tail (the live edge), merge, and refresh the entry.
+    Returns the full-range partial dict (or None for no data), or the
+    sentinel ``NotImplemented`` when the statement is ineligible /
+    the cache is off — the caller then runs its ordinary terminal
+    path. The served result is bit-identical to a full recompute:
+    exact-merge ops only, and write epochs invalidate before any
+    stale read."""
+    from ..ops import devstats as _dstat
+    from .executor import merge_partials
+
+    if not enabled() or not _eligible(stmt, cs, cond):
+        _bump("bypass")
+        _mark(ctx, span, "bypass")
+        return NotImplemented
+
+    t0 = time.perf_counter_ns()
+    interval = int(stmt.group_by_interval())
+    off = _grid_offset(stmt, interval)
+    t_min, t_max = int(cond.t_min), int(cond.t_max)
+    lo_grid = _ceil_align(t_min, interval, off)
+    hi_grid = _floor_align(t_max + 1, interval, off)
+    cut = min(_closed_cut(time.time_ns()), hi_grid)
+    if cut - lo_grid < interval:
+        # nothing closed inside the range: pure live-edge query — the
+        # terminal fast path (device finalize diet) serves it better
+        _bump("bypass")
+        _mark(ctx, span, "bypass")
+        _dstat.bump_phase("result_cache",
+                          time.perf_counter_ns() - t0)
+        return NotImplemented
+
+    tenant = getattr(ctx, "tenant", "") if ctx is not None else ""
+    key = canonical_key(executor.engine, db, mst, stmt, cond, tenant)
+    probe = _probe_key(executor.engine, db, mst, stmt, tenant)
+    cache = global_cache()
+    # too-big-to-ever-cache statements bypass so they keep the
+    # terminal device-finalize/top-k transport diet. Keyed per
+    # statement (the request-level admission estimate sums all
+    # statements and is discount-shrunk — both wrong for this gate),
+    # so a monster pays the mergeable wire format exactly once
+    if cache.is_too_large(key):
+        _bump("bypass")
+        _mark(ctx, span, "bypass")
+        _dstat.bump_phase("result_cache",
+                          time.perf_counter_ns() - t0)
+        return NotImplemented
+    # epoch stamp BEFORE any scan: a write racing the compute lands a
+    # higher epoch and invalidates this entry on its next read
+    stamp = epochs.snapshot(db, mst)
+    ent = cache.get_valid(key)
+
+    used = None
+    if ent is not None and ent.interval == interval:
+        lo = max(ent.start, lo_grid)
+        hi = min(ent.watermark, hi_grid)
+        if hi - lo >= interval:
+            cp = trim_left(ent.partial, lo)
+            if cp is not None:
+                cp = _trim_keep(cp, int((hi - lo) // interval))
+            if cp is not None:
+                used = (cp, lo, hi)
+    _dstat.bump_phase("result_cache", time.perf_counter_ns() - t0)
+
+    def fresh(a: int, b: int):
+        c2 = copy.copy(cond)
+        c2.t_min, c2.t_max = a, b
+        return executor.partial_agg(stmt, db, mst, cs, c2, tag_keys,
+                                    ctx=ctx, span=span, plan=plan)
+
+    if used is not None:
+        cp, lo, hi = used
+        parts = [cp]
+        scans = []
+        if t_min < lo:
+            scans.append((t_min, lo - 1))
+        if hi <= t_max:
+            scans.append((hi, t_max))
+        status = "hit" if not scans else "partial"
+        for a, b in scans:
+            parts.append(fresh(a, b))
+        partial = merge_partials(parts) if len(parts) > 1 else parts[0]
+        _bump("hits" if status == "hit" else "partial_hits")
+        _bump("windows_served", int((hi - lo) // interval))
+        _bump("windows_computed",
+              sum(int((b + 1 - a + interval - 1) // interval)
+                  for a, b in scans))
+    else:
+        status = "miss"
+        partial = fresh(t_min, t_max)
+        _bump("misses")
+        _bump("windows_computed",
+              max(0, int((hi_grid - lo_grid) // interval)))
+    _mark(ctx, span, status)
+
+    # refresh the entry from the merged full-range partial: closed,
+    # unclipped windows only — [ceil_align(t_min), cut)
+    t1 = time.perf_counter_ns()
+    if partial is not None and "raw" not in partial \
+            and "sketch" not in partial and "topn" not in partial \
+            and partial.get("interval") == interval:
+        pstart = int(partial["start"])
+        keep_from = max(lo_grid, pstart)
+        trimmed = trim_left(partial, keep_from) \
+            if keep_from > pstart else partial
+        if trimmed is not None:
+            keep_w = min(int((cut - int(trimmed["start"]))
+                             // interval), trimmed["W"])
+            if keep_w >= 1 \
+                    and _view_nbytes(trimmed, keep_w) > _entry_cap():
+                # shape-only size check BEFORE the copy: an over-cap
+                # state must not pay the copy it is rejecting, and its
+                # key goes on the bypass list so later runs keep the
+                # terminal transport diet
+                cache.note_too_large(key)
+                _bump("too_large")
+                trimmed = None
+            else:
+                trimmed = _trim_keep(trimmed, keep_w)
+        if trimmed is not None and trimmed["W"] >= 1:
+            wm = int(trimmed["start"]) + trimmed["W"] * interval
+            old_wm = ent.watermark if ent is not None else -1
+            if status != "hit" or wm > old_wm:
+                cache.store(key, probe, db, mst, trimmed, wm,
+                            stamp)
+    _dstat.bump_phase("result_cache", time.perf_counter_ns() - t1)
+    return partial
+
+
+# --------------------------------------------------- admission discount
+
+def discount_cost(executor, stmts, db: str | None, tenant: str, cost):
+    """Shrink one request's admission charge to its uncovered (live
+    edge) fraction when a valid cache entry covers the rest. Shapes
+    the ESTIMATE only — serve() revalidates everything; a wrong
+    discount can misweight the fair queue for one grant, never corrupt
+    a result."""
+    if cost.cells <= 0 or not enabled():
+        return cost
+    from .ast import SelectStatement
+    from .condition import MAX_TIME, MIN_TIME, analyze_condition
+    covered = 0.0
+    n_sel = 0
+    try:
+        for stmt in stmts:
+            if not isinstance(stmt, SelectStatement):
+                continue
+            n_sel += 1
+            mst = stmt.from_measurement
+            if mst is None or not stmt.group_by_interval():
+                continue
+            cond = analyze_condition(stmt.condition, set())
+            if not cond.has_time_range or cond.t_min == MIN_TIME \
+                    or cond.t_max == MAX_TIME:
+                continue
+            cov = global_cache().probe_coverage(_probe_key(
+                executor.engine, stmt.from_db or db, mst, stmt,
+                tenant))
+            if cov is None:
+                continue
+            start, wm, _iv = cov
+            lo = max(start, cond.t_min)
+            hi = min(wm, cond.t_max + 1)
+            span_ns = max(1, cond.t_max + 1 - cond.t_min)
+            if hi > lo:
+                covered += (hi - lo) / span_ns
+    except Exception:
+        return cost
+    if n_sel == 0 or covered <= 0:
+        return cost
+    frac = max(0.0, 1.0 - covered / n_sel)
+    if frac >= 0.999:
+        return cost
+    _bump("admit_discounts")
+    from .scheduler import QueryCost
+    # floor keeps a covered query from admitting at literally zero,
+    # capped at the original estimate — a discount must never WORSEN
+    # a small query's fair-queue position
+    return QueryCost(min(cost.cells, max(64, int(cost.cells * frac))),
+                     max(0, int(cost.pull_bytes * frac)),
+                     max(0, int(cost.hbm_bytes * frac)))
+
+
+# ------------------------------------------------------------ collector
+
+def resultcache_collector() -> dict:
+    """utils.stats collector: counters + live gauges for /metrics,
+    /debug/vars and the stats pusher."""
+    from ..utils.stats import COUNTER_LOCK
+    out = {}
+    with COUNTER_LOCK:
+        out.update(RC_STATS)
+    st = global_cache().stats()
+    out["entries"] = st["entries"]
+    out["bytes"] = st["bytes"]
+    out.update(epochs.stats())
+    served = out["hits"] + out["partial_hits"]
+    total = served + out["misses"]
+    out["hit_ratio"] = round(served / total, 4) if total else 0.0
+    return out

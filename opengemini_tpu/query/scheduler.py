@@ -69,7 +69,7 @@ log = get_logger(__name__)
 __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
            "get_scheduler", "estimate_request_cost",
            "pull_bytes_per_cell", "sched_collector", "calib_mode",
-           "calib_record", "calib_apply"]
+           "calib_record", "calib_apply", "tenant_shares"]
 
 
 def enabled() -> bool:
@@ -220,10 +220,45 @@ CALIB_HIST: dict = register_histograms("sched_calib", {
 })
 
 
+_DEFAULT_TENANT = "default"
+
+_SHARES_MEMO: tuple | None = None      # (raw env string, parsed dict)
+
+
+def tenant_shares() -> dict[str, float]:
+    """Parse OG_TENANT_SHARES (`name:weight,name:weight`) — weights
+    scale a tenant's virtual-time charge down, so a share-4 tenant
+    drains 4x the work of a share-1 tenant under contention. Unlisted
+    tenants weigh 1. Malformed entries are skipped (an operator typo
+    must not take admission down). The parse is memoized on the raw
+    environment string (the knobs `cached`-scope pattern): admit()
+    runs this per request and must not re-split an identical config;
+    env flips stay visible immediately."""
+    global _SHARES_MEMO
+    raw = str(knobs.get_raw("OG_TENANT_SHARES") or "").strip()
+    memo = _SHARES_MEMO
+    if memo is not None and memo[0] == raw:
+        return memo[1]
+    out: dict[str, float] = {}
+    for part in raw.split(","):
+        if ":" not in part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            wv = float(w)
+        except ValueError:
+            continue
+        if name.strip() and wv > 0:
+            out[name.strip()] = wv
+    _SHARES_MEMO = (raw, out)
+    return out
+
+
 def calib_mode() -> str:
     """OG_SCHED_CALIB tri-state: '0' off (PR 4 byte-identical),
-    'record' estimate-vs-actual recording only (default), '1' record
-    AND apply the learned per-class bias to admission charges."""
+    'record' estimate-vs-actual recording only, '1' record AND apply
+    the learned per-class bias to admission charges (the default
+    since round 16 — the calibration loop is closed)."""
     raw = str(knobs.get("OG_SCHED_CALIB")).strip().lower()
     if raw in ("0", "off", "false"):
         return "0"
@@ -261,13 +296,17 @@ _CALIB_BIAS_CLAMP = 4.0          # |log2 bias| cap: 1/16x .. 16x
 
 class _Entry:
     __slots__ = ("vft", "seq", "cost", "ctx", "event", "granted",
-                 "cancelled", "enq_ns")
+                 "cancelled", "enq_ns", "tenant", "charge")
 
-    def __init__(self, vft: float, seq: int, cost: QueryCost, ctx):
+    def __init__(self, vft: float, seq: int, cost: QueryCost, ctx,
+                 tenant: str = _DEFAULT_TENANT, charge: float = 0.0):
         self.vft = vft
         self.seq = seq
         self.cost = cost
         self.ctx = ctx
+        self.tenant = tenant
+        self.charge = charge       # norm/share this entry advanced its
+        # tenant's virtual finish by (rolled back on cancel)
         self.event = threading.Event()
         self.granted = False
         self.cancelled = False
@@ -282,7 +321,8 @@ class _Ticket:
     Idempotent — the HTTP finally-path may race a handler error."""
 
     def __init__(self, sched: "QueryScheduler", cost: QueryCost,
-                 raw_cost: QueryCost | None = None):
+                 raw_cost: QueryCost | None = None,
+                 tenant: str = _DEFAULT_TENANT):
         self._sched = sched
         self.cost = cost           # granted charge — release() must
         # return exactly what admission took
@@ -291,12 +331,13 @@ class _Ticket:
         # learn log2(actual/corrected) — the bias would then chase
         # sqrt of the true error and oscillate instead of converging.
         self.raw_cost = raw_cost if raw_cost is not None else cost
+        self.tenant = tenant
         self._done = False
 
     def release(self) -> None:
         if not self._done:
             self._done = True
-            self._sched._release(self.cost)
+            self._sched._release(self.cost, self.tenant)
 
     def __enter__(self):
         return self
@@ -342,6 +383,52 @@ class QueryScheduler:
                    "ewma_log2_pull": 0.0}
             for name, _hi in _CALIB_CLASSES}
         self._calib_ring: deque = deque(maxlen=32)
+        # per-tenant fair share (sustained serving): start-time-fair
+        # virtual finish tags divided by the tenant's configured share,
+        # so one tenant's queued monsters cannot starve another
+        # tenant's dashboards. State per tenant: virtual finish of its
+        # last enqueued entry plus active/admitted/shed accounting
+        # ("quota tokens" — the chaos harness asserts active drains
+        # to 0 after kill/deadline storms).
+        self._tenants: dict[str, dict] = {}
+
+    # hostile/per-user X-OG-Tenant values must not mint unbounded
+    # scheduler state: past this many tenants, minting a new one first
+    # prunes idle entries (zero active, virtual finish already passed
+    # by global vtime — their fairness state is spent; cumulative
+    # admitted/shed counters go with them, which /debug/scheduler
+    # documents as best-effort for unlisted tenants)
+    MAX_TENANTS = 256
+
+    def _tenant_state(self, tenant: str) -> dict:
+        t = self._tenants.get(tenant)
+        if t is None:
+            if len(self._tenants) >= self.MAX_TENANTS:
+                # a QUEUED entry's tenant has active == 0 but its
+                # virtual-finish debt is live — pruning it would let
+                # its next enqueue restart at finish=0 and jump its
+                # own backlog, so queued tenants are never dropped
+                queued = {e.tenant for e in self._heap
+                          if not e.cancelled}
+                idle = [k for k, v in self._tenants.items()
+                        if v["active"] == 0 and k not in queued
+                        and v["finish"] <= self._vtime]
+                if len(idle) < len(self._tenants) // 4:
+                    # not enough spent entries: drop ANY zero-active
+                    # unqueued ones (in-flight tenants are bounded by
+                    # slots + queue, so this always converges)
+                    idle = [k for k, v in self._tenants.items()
+                            if v["active"] == 0 and k not in queued]
+                for k in idle:
+                    del self._tenants[k]
+            t = self._tenants[tenant] = {
+                "finish": 0.0, "active": 0, "admitted": 0, "shed": 0}
+        return t
+
+    @staticmethod
+    def _ctx_tenant(ctx) -> str:
+        t = getattr(ctx, "tenant", "") if ctx is not None else ""
+        return t or _DEFAULT_TENANT
 
     # ------------------------------------------------------- admission
 
@@ -432,10 +519,13 @@ class QueryScheduler:
                     f"{limit_mb}; retry after in-flight work drains",
                     http_code=429, reason="hbm_pressure",
                     retry_after_s=self._retry_after())
+        tenant = self._ctx_tenant(ctx)
+        shares = tenant_shares()
         with self._lock:
             if self.paused or self.draining:
                 _bump("shed")
                 _bump("shed_paused")
+                self._tenant_state(tenant)["shed"] += 1
                 raise SchedShed(
                     "scheduler is " + ("draining" if self.draining
                                        else "paused"),
@@ -445,18 +535,39 @@ class QueryScheduler:
                     and not self._heap):
                 self._active += 1
                 _bump("admitted")
+                ts = self._tenant_state(tenant)
+                ts["active"] += 1
+                ts["admitted"] += 1
                 if ctx is not None and hasattr(ctx, "mark_running"):
                     ctx.mark_running(0)
                 _observe(SCHED_HIST, "queue_wait_ms", 0.0)
-                return _Ticket(self, cost, raw_cost)
+                return _Ticket(self, cost, raw_cost, tenant)
             if len(self._heap) >= self.max_queued:
                 _bump("shed")
                 _bump("shed_queue_full")
+                self._tenant_state(tenant)["shed"] += 1
                 raise SchedShed(
                     f"too many queued queries (> {self.max_queued})",
                     http_code=429, retry_after_s=self._retry_after())
             self._seq += 1
-            ent = _Entry(self._vtime + cost.norm, self._seq, cost, ctx)
+            if not shares and tenant == _DEFAULT_TENANT:
+                # single-tenant serving: the exact PR 4 weighted-fair
+                # tag (ordering pinned by tests/test_scheduler.py)
+                vft, charge = self._vtime + cost.norm, 0.0
+            else:
+                # start-time-fair queuing across tenants: an entry
+                # starts no earlier than its tenant's previous virtual
+                # finish, and its charge shrinks with the tenant's
+                # share — a share-4 tenant's tags advance 4x slower,
+                # so it drains 4x the work under contention while a
+                # share-1 tenant still advances (no starvation)
+                share = shares.get(tenant, 1.0)
+                ts = self._tenant_state(tenant)
+                start = max(self._vtime, ts["finish"])
+                charge = cost.norm / share
+                vft = start + charge
+                ts["finish"] = vft
+            ent = _Entry(vft, self._seq, cost, ctx, tenant, charge)
             heapq.heappush(self._heap, ent)
             _bump("queued_total")
             if ctx is not None and hasattr(ctx, "mark_queued"):
@@ -475,7 +586,7 @@ class QueryScheduler:
                 if ent.ctx is not None and hasattr(ent.ctx,
                                                    "mark_running"):
                     ent.ctx.mark_running(wait_ns)
-                return _Ticket(self, ent.cost, raw_cost)
+                return _Ticket(self, ent.cost, raw_cost, ent.tenant)
             if ent.ctx is not None and getattr(ent.ctx, "killed", False):
                 if self._cancel(ent):
                     _bump("ejected_killed")
@@ -512,13 +623,24 @@ class QueryScheduler:
             if ent.granted:
                 return False
             ent.cancelled = True
+            if ent.charge:
+                # roll the tenant's virtual finish back when this was
+                # its newest tag — a killed/expired queued entry must
+                # not push the tenant's future entries later
+                ts = self._tenants.get(ent.tenant)
+                if ts is not None and ts["finish"] == ent.vft:
+                    ts["finish"] -= ent.charge
             self._heap = [e for e in self._heap if not e.cancelled]
             heapq.heapify(self._heap)
         return True
 
-    def _release(self, cost: QueryCost) -> None:
+    def _release(self, cost: QueryCost,
+                 tenant: str = _DEFAULT_TENANT) -> None:
         with self._lock:
             self._active -= 1
+            ts = self._tenants.get(tenant)
+            if ts is not None:
+                ts["active"] = max(0, ts["active"] - 1)
             # virtual time advances by COMPLETED work, so a parked
             # monster's finish tag is eventually reached (no starvation)
             self._vtime += cost.norm
@@ -538,6 +660,9 @@ class QueryScheduler:
                     continue
                 ent.granted = True
                 self._active += 1
+                ts = self._tenant_state(ent.tenant)
+                ts["active"] += 1
+                ts["admitted"] += 1
                 granted.append(ent)
         for ent in granted:
             _bump("admitted")
@@ -692,6 +817,20 @@ class QueryScheduler:
                         "draining": self.draining,
                         "vtime": round(self._vtime, 3)})
         return out
+
+    def tenants_snapshot(self) -> dict:
+        """Per-tenant fair-share state for /debug/scheduler (kept out
+        of snapshot(): tenant names are unbounded label cardinality
+        for /metrics). active is the live quota-token count — the
+        chaos harness asserts it drains to zero."""
+        shares = tenant_shares()
+        with self._lock:
+            return {name: {"active": t["active"],
+                           "admitted": t["admitted"],
+                           "shed": t["shed"],
+                           "share": shares.get(name, 1.0),
+                           "vfinish": round(t["finish"], 3)}
+                    for name, t in sorted(self._tenants.items())}
 
     def util_gauges(self) -> dict:
         """Light live gauges for the utilization sampler (ops/hbm.py):

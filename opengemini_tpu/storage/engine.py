@@ -19,6 +19,7 @@ import numpy as np
 
 from ..index import TagFilter
 from ..record import Record
+from ..utils import epochs as _epochs
 from ..utils import fileops, get_logger
 from ..utils.errors import ErrDatabaseNotFound, ErrQueryError
 from .rows import PointRow
@@ -160,6 +161,13 @@ class Database:
 
     def drop_shard(self, gi: int) -> None:
         import shutil
+        # retention drop destroys data non-append-wise: the result
+        # cache must never serve the dropped range — db-wide wipe
+        # generation bump (epochs has no per-mst view of a shard).
+        # Bumped BEFORE and AFTER the removal: a scan racing the
+        # delete could stamp the pre-bump epoch while reading
+        # partially-deleted state; the post-bump invalidates it
+        _epochs.note_wipe(self.name)
         with self._lock:
             # pop + rmtree under the lock so shard_for_time cannot recreate
             # the directory mid-delete (a later write re-creates it fresh)
@@ -174,6 +182,7 @@ class Database:
                 # lazily-discovered, never materialized: remove the dir
                 shutil.rmtree(os.path.join(self.path, f"shard_{gi}"),
                               ignore_errors=True)
+        _epochs.note_wipe(self.name)
 
     def shards_overlapping(self, t_min: int, t_max: int) -> list[Shard]:
         """Time-pruned shard selection (reference shard_mapper.go:74-117)."""
@@ -247,12 +256,17 @@ class Engine:
 
     def drop_database(self, name: str) -> None:
         import shutil
+        # wipe-generation bump BEFORE and AFTER: a scan racing the
+        # drop could stamp the pre-bump generation while reading
+        # half-removed state; the post-bump invalidates that entry
+        _epochs.note_wipe(name)
         with self._lock:
             db = self.databases.pop(name, None)
         if db is not None:
             for s in db.all_shards():
                 s.close()
             shutil.rmtree(db.path, ignore_errors=True)
+        _epochs.note_wipe(name)
 
     def database(self, name: str) -> Database:
         db = self.databases.get(name)
@@ -290,6 +304,26 @@ class Engine:
                 written.extend(batch)
             except Exception as e:
                 err = e
+        # result-cache invalidation: exact per-measurement write
+        # extents over ALL attempted rows — a shard write that raised
+        # may still have persisted rows before the error, so the bump
+        # must cover them (over-invalidation on the failed remainder
+        # is safe; a skipped bump would serve them stale). Bumped
+        # AFTER the writes so a scan racing the batch stamps a
+        # pre-bump epoch and invalidates.
+        if rows:
+            ext: dict[str, list] = {}
+            for r in rows:
+                e = ext.get(r.measurement)
+                if e is None:
+                    ext[r.measurement] = [r.time, r.time]
+                else:
+                    if r.time < e[0]:
+                        e[0] = r.time
+                    if r.time > e[1]:
+                        e[1] = r.time
+            for mst, (lo, hi) in ext.items():
+                _epochs.note_write(db_name, mst, lo, hi)
         # hooks see only rows that were actually stored — derived data
         # (streams, subscribers) must not diverge from the source
         if written:
@@ -353,12 +387,33 @@ class Engine:
         n = 0
         written: list = []
         err: Exception | None = None
+        # result-cache invalidation extents, shard-granular: the bulk
+        # path must not pay per-series numpy min/max — coarser ranges
+        # only over-invalidate, never serve stale
+        w_ext: dict[str, list] = {}
+
+        def _note_gi(mst: str, gi: int) -> None:
+            e = w_ext.get(mst)
+            if e is None:
+                w_ext[mst] = [gi, gi]
+            else:
+                if gi < e[0]:
+                    e[0] = gi
+                if gi > e[1]:
+                    e[1] = gi
+
         for (gi, mst, _names), ents in sorted(bulk_groups.items(),
                                               key=lambda kv: kv[0][:2]):
             if len(ents) < 8:
                 per_shard.setdefault(gi, []).extend(
                     (mst, tg, tm, f) for tg, tm, f in ents)
                 continue
+            # extent noted whether or not the write below succeeds: a
+            # raising shard may have persisted part of the group, and
+            # over-invalidating the failed remainder is safe while a
+            # skipped bump would serve persisted rows stale (the
+            # note_write bump itself lands after ALL shard writes)
+            _note_gi(mst, gi)
             try:
                 shard = db.shard_for_time(gi * sd)
                 n += shard.write_columns_bulk(
@@ -369,6 +424,8 @@ class Engine:
             except Exception as e:
                 err = e
         for gi, ents in sorted(per_shard.items()):
+            for mst, _tg, _tm, _f in ents:
+                _note_gi(mst, gi)
             try:
                 shard = db.shard_for_time(gi * sd)
                 n += shard.write_columns_batch(ents)
@@ -377,6 +434,9 @@ class Engine:
                 # keep going like write_points: hooks must see every
                 # row that WAS stored even when a later shard fails
                 err = e
+        for mst, (lo_gi, hi_gi) in w_ext.items():
+            _epochs.note_write(db_name, mst, lo_gi * sd,
+                               min((hi_gi + 1) * sd - 1, 1 << 62))
         if written and self.write_hooks:
             from .rows import PointRow
             rows = []
@@ -411,12 +471,21 @@ class Engine:
         times = np.ascontiguousarray(times, dtype=np.int64)
         slots = times // sd
         n = 0
-        for gi in np.unique(slots):
-            m = slots == gi
-            shard = db.shard_for_time(int(gi) * sd)
-            n += shard.write_series_matrix(
-                mst, keys, tag_cols, times[m],
-                {k: np.asarray(v)[:, m] for k, v in fields.items()})
+        try:
+            for gi in np.unique(slots):
+                m = slots == gi
+                shard = db.shard_for_time(int(gi) * sd)
+                n += shard.write_series_matrix(
+                    mst, keys, tag_cols, times[m],
+                    {k: np.asarray(v)[:, m] for k, v in fields.items()})
+        finally:
+            if len(times):
+                # one exact extent per call (all series share the time
+                # column) — result-cache invalidation. In a finally:
+                # a raising shard may have persisted earlier slices,
+                # and those must never be served stale
+                _epochs.note_write(db_name, mst, int(times.min()),
+                                   int(times.max()))
         if self.write_hooks:
             from .rows import PointRow
             rows = [PointRow(mst, dict(zip(keys, vals)),
@@ -469,10 +538,17 @@ class Engine:
         """DROP MEASUREMENT across all shards (reference
         Engine.DropMeasurement). Flush first: WAL replay must not
         resurrect the dropped rows."""
+        # epoch bump BEFORE and AFTER the removal: a scan racing the
+        # drop could stamp the pre-bump epoch while still seeing the
+        # rows; the post-bump invalidates that entry (the append path
+        # needs only the after-bump — rows there APPEAR rather than
+        # vanish, and a scan cannot cache what it never saw)
+        _epochs.note_wipe(db_name, mst)
         db = self.database(db_name)
         for s in db.all_shards():
             s.flush()
             s.drop_measurement(mst)
+        _epochs.note_wipe(db_name, mst)
 
     def delete_rows(self, db_name: str, mst: str,
                     t_min: int | None = None, t_max: int | None = None,
@@ -485,6 +561,7 @@ class Engine:
         drop_series=True additionally removes the matched series from
         each shard's tsi index (DROP SERIES semantics — DELETE keeps
         the series key visible, DROP SERIES does not)."""
+        _epochs.note_wipe(db_name, mst)
         db = self.database(db_name)
         removed = 0
         for s in db.all_shards():
@@ -500,9 +577,23 @@ class Engine:
                     s.index.drop_measurement(mst)
                 else:
                     s.index.drop_series(mst, sids)
+        # post-removal bump: invalidates any entry a racing scan
+        # stamped with the pre-bump epoch while the rows still existed
+        _epochs.note_wipe(db_name, mst)
         return removed
 
     def close(self) -> None:
+        # drop this engine's result-cache entries (keyed by engine
+        # token — they can never be served again). sys.modules guard:
+        # storage-only contexts (crash-harness children) must not pull
+        # the query stack — and jax — in just to close
+        import sys as _sys
+        _rc = _sys.modules.get("opengemini_tpu.query.resultcache")
+        if _rc is not None:
+            try:
+                _rc.note_engine_closed(self)
+            except Exception:
+                log.exception("result-cache purge on close failed")
         for db in list(self.databases.values()):
             with db._lock:
                 opened = [s for s in db.shards.values()

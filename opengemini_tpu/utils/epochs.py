@@ -1,0 +1,159 @@
+"""Per-(db, measurement) write epochs for result-cache invalidation.
+
+The result cache (query/resultcache.py) serves *closed* time buckets
+of repeated dashboard queries from cached mergeable partial states.
+Correctness hinges on one contract: a cached range must NEVER be
+served after data inside it changed. This module is that contract's
+ledger — a process-wide epoch counter per (db, measurement), bumped on
+every ingest-path write / delete / drop with the written time range,
+plus a bounded ring of recent (epoch, t_lo, t_hi) write extents so a
+reader can ask "did anything land inside MY cached range since epoch
+E?" exactly, and gets a conservative *yes* when the ring has already
+evicted that history.
+
+Kept in utils (no query/ops imports) so the storage write path can
+bump epochs without dragging the query stack — or jax — into
+storage-only contexts. Sustained ingest appends at the LIVE edge
+(t > every cached watermark), so cached closed buckets keep
+validating against the ring without ever rescanning; only a write
+*into* a closed range (backfill, DELETE, DROP, retention shard drop)
+invalidates, which is exactly the staleness the cache must not serve.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["note_write", "note_wipe", "snapshot", "changed_since",
+           "reset", "stats"]
+
+# ring depth per (db, mst): sized so a dashboard poll interval's worth
+# of ingest batches never outruns validation; evicted history degrades
+# to conservative invalidation, never to a stale serve
+_RING = 512
+
+_LOCK = threading.Lock()
+# (db, mst) -> {"epoch": int, "gen": int,
+#               "ring": deque[(epoch, t_lo, t_hi)]}
+# gen is the per-measurement WIPE generation: a wipe clears the ring
+# (its history is meaningless across destroyed data) and bumps gen,
+# so entries stamped before it invalidate even when stamped at epoch
+# 0 (data loaded from disk predates this process's epochs)
+_STORE: dict[tuple, dict] = {}
+# db -> wipe generation: DROP DATABASE / retention shard drops must
+# invalidate every measurement's entries without enumerating them
+_DB_GEN: dict[str, int] = {}
+# bound on tracked (db, mst) pairs: measurement churn must not grow
+# the store without end. Eviction is conservative — see changed_since
+_MAX_TRACKED = 4096
+
+
+def _ent(db: str, mst: str) -> dict:
+    e = _STORE.get((db, mst))
+    if e is None:
+        while len(_STORE) >= _MAX_TRACKED:
+            # FIFO-evict: a reader holding an evicted stamp reads
+            # "changed" (its epoch/gen is nonzero — see changed_since)
+            _STORE.pop(next(iter(_STORE)))
+        e = _STORE[(db, mst)] = {"epoch": 0, "gen": 0,
+                                 "ring": deque(maxlen=_RING)}
+    return e
+
+
+def note_write(db: str, mst: str, t_lo: int, t_hi: int) -> None:
+    """One ingest batch landed rows for ``mst`` in [t_lo, t_hi] (ns,
+    inclusive; shard-granular bounds are fine — coarser ranges only
+    over-invalidate, never under)."""
+    with _LOCK:
+        e = _ent(db, mst)
+        e["epoch"] += 1
+        e["ring"].append((e["epoch"], int(t_lo), int(t_hi)))
+
+
+def note_wipe(db: str, mst: str | None = None) -> None:
+    """Data destroyed or rewritten non-append-wise. Per-measurement
+    (DELETE, DROP MEASUREMENT): bump THAT measurement's generation
+    only — a retention DELETE on one measurement must not flush every
+    other dashboard's cache in the db. ``mst=None`` (DROP DATABASE,
+    retention shard drop — no per-mst view) bumps the db generation
+    and drops the per-mst state."""
+    with _LOCK:
+        if mst is None:
+            _DB_GEN[db] = _DB_GEN.get(db, 0) + 1
+            for key in [k for k in _STORE if k[0] == db]:
+                del _STORE[key]
+            return
+        e = _ent(db, mst)
+        # epoch bump keeps every post-wipe stamp nonzero, so a later
+        # eviction of this entry still reads as changed; the ring is
+        # history of destroyed data — meaningless, drop it
+        e["epoch"] += 1
+        e["gen"] += 1
+        e["ring"].clear()
+
+
+def snapshot(db: str, mst: str) -> tuple[int, int, int]:
+    """(epoch, mst_generation, db_generation) to stamp on a cache
+    entry BEFORE its compute scan starts — a write racing the scan
+    lands a higher epoch and the entry invalidates on its next
+    read."""
+    with _LOCK:
+        e = _STORE.get((db, mst))
+        return (e["epoch"] if e else 0, e["gen"] if e else 0,
+                _DB_GEN.get(db, 0))
+
+
+def changed_since(db: str, mst: str, epoch: int, gen: int,
+                  db_gen: int, t_lo: int, t_hi: int
+                  ) -> tuple[bool, int]:
+    """Did any write/wipe land inside [t_lo, t_hi) after the
+    (epoch, gen, db_gen) stamp? Returns (changed, current_epoch).
+    Evicted ring history, an evicted store entry under a nonzero
+    stamp, or any generation bump answers True — unknown must read
+    as changed. When nothing overlapped, callers refresh their epoch
+    stamp to current_epoch so future checks scan only the new tail."""
+    with _LOCK:
+        if _DB_GEN.get(db, 0) != db_gen:
+            return True, epoch
+        e = _STORE.get((db, mst))
+        if e is None:
+            # never written in this process: a zero stamp is still
+            # valid (disk-resident data, untouched); a nonzero stamp
+            # means the entry was evicted — conservative
+            return epoch != 0 or gen != 0, 0
+        if e["gen"] != gen:
+            return True, epoch
+        cur = e["epoch"]
+        if cur == epoch:
+            return False, cur
+        if epoch > cur:
+            # a foreign stamp (store reset between stamp and check):
+            # nothing to validate against — conservative
+            return True, cur
+        ring = e["ring"]
+        if not ring or ring[0][0] > epoch + 1:
+            # history older than the stamp already evicted
+            return True, cur
+        for ep, lo, hi in reversed(ring):
+            if ep <= epoch:
+                break
+            if lo < t_hi and hi >= t_lo:
+                return True, cur
+        return False, cur
+
+
+def stats() -> dict:
+    with _LOCK:
+        return {"tracked": len(_STORE),
+                "epochs_total": sum(e["epoch"]
+                                    for e in _STORE.values()),
+                "db_wipes": sum(_DB_GEN.values())}
+
+
+def reset() -> None:
+    """Tests only: forget all epochs (paired with a result-cache
+    purge — an empty cache cannot be served stale by a zeroed store)."""
+    with _LOCK:
+        _STORE.clear()
+        _DB_GEN.clear()

@@ -320,6 +320,28 @@ register("OG_SCHED_MAX_CELLS", str, "",
 register("OG_SCHED_DEPTH", int, 8,
          "global in-flight streamed-launch bound across all queries")
 
+# --- sustained serving: result cache + tenant fair share
+#     (query/resultcache.py, query/scheduler.py; cached: the enable
+#     gate runs per SELECT on the serving hot path)
+register("OG_RESULT_CACHE", bool, True,
+         "time-bucketed result cache: closed time buckets of repeated "
+         "dashboard aggregates serve from cached mergeable partial "
+         "states, only the live edge recomputes; 0 = byte-identical "
+         "full recompute on every query", scope="cached")
+register("OG_RESULT_CACHE_MB", int, 256,
+         "host-memory byte budget of the result cache (LRU; accounted "
+         "as the `result_cache` tier in the HBM/host ledger); 0 "
+         "disables the cache")
+register("OG_RESULT_BUCKET_S", float, 60.0,
+         "result-cache bucket width (seconds): windows ending at/after "
+         "the current bucket boundary are the live edge and always "
+         "recompute; closed windows are cacheable")
+register("OG_TENANT_SHARES", str, "",
+         "per-tenant weighted-fair shares for scheduler admission, "
+         "`name:weight,name:weight` (X-OG-Tenant header selects the "
+         "tenant; unlisted tenants weigh 1); unset = single-tenant "
+         "PR 4 ordering")
+
 # --- device resource observatory (ops/hbm.py, query/scheduler.py)
 register("OG_DEVUTIL_MS", float, 1000.0,
          "utilization-timeline sampler interval (ms) for the device "
@@ -331,11 +353,11 @@ register("OG_HBM_EVENTS", int, 256,
 register("OG_HBM_DRIFT_PCT", float, 25.0,
          "reconcile tolerance: tracked-vs-backend HBM drift beyond "
          "max(64MiB, this percent) flags and counts")
-register("OG_SCHED_CALIB", str, "record",
+register("OG_SCHED_CALIB", str, "1",
          "scheduler cost-model calibration: `0` = off (PR 4 "
          "byte-identical), `record` = record estimate-vs-actual "
-         "only, `1` = also apply the learned per-class bias to "
-         "admission charges")
+         "only, `1` (default since round 16) = also apply the "
+         "learned per-class bias to admission charges")
 
 # --- device fault domain (ops/devicefault.py, ops/pipeline.py)
 register("OG_DEVICE_RETRY", int, 2,
@@ -500,6 +522,22 @@ register("OG_BENCH_SCALE_ROWS", int, 500_000_000,
 register("OG_BENCH_CONC_HOSTS", str, "",
          "bench: concurrent phase host count (unset = min(hosts, "
          "1000))")
+register("OG_BENCH_SUST_QPS", float, 40.0,
+         "bench sustained phase: open-loop offered arrival rate "
+         "(requests/second over HTTP)")
+register("OG_BENCH_SUST_REQS", int, 1200,
+         "bench sustained phase: total requests per measured run")
+register("OG_BENCH_SUST_WORKERS", int, 64,
+         "bench sustained phase: HTTP client worker threads (the "
+         "open-loop schedule charges wait-for-worker time to latency)")
+register("OG_BENCH_SUST_HEAVY_PCT", float, 2.0,
+         "bench sustained phase: percent of requests that are the "
+         "heavy (1m-grid) shape; the rest are dashboard shapes")
+register("OG_BENCH_SUST_SLO_MS", float, 0.0,
+         "bench sustained phase: dashboard p99 SLO gate in ms "
+         "(0 = report only, no gate)")
+register("OG_BENCH_EST_SUST", int, 420,
+         "bench: sustained phase budget s")
 register("OG_BENCH_EST_PROM", int, 1300, "bench: prom phase budget s")
 register("OG_BENCH_EST_CS", int, 420, "bench: colstore budget s")
 register("OG_BENCH_EST_CONC", int, 420, "bench: concurrent budget s")

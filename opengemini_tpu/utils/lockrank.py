@@ -27,21 +27,25 @@ forever. This module turns the convention into a checked invariant:
   acquisitions that contradict the declared ranks.
 
 Ranks (gaps left for future locks):
-    SCHED_HANDLE(5) < SCHED(10) < DEVCACHE_FILL(15) < DEVCACHE(20)
-    < PIPELINE_POOL(25) < PIPELINE(30) < HBM(35) < STATS(40)
+    SCHED_HANDLE(5) < SCHED(10) < RESULTCACHE(12) < DEVCACHE_FILL(15)
+    < DEVCACHE(20) < PIPELINE_POOL(25) < PIPELINE(30) < HBM(35)
+    < STATS(40)
 """
 
 from __future__ import annotations
 
 import threading
 
-__all__ = ["RANK_SCHED_HANDLE", "RANK_SCHED", "RANK_DEVCACHE_FILL",
+__all__ = ["RANK_SCHED_HANDLE", "RANK_SCHED", "RANK_RESULTCACHE",
+           "RANK_DEVCACHE_FILL",
            "RANK_DEVCACHE", "RANK_PIPELINE_POOL", "RANK_PIPELINE",
            "RANK_HBM", "RANK_STATS", "LockRankError", "RankedLock",
            "RankedRLock", "enable", "enabled", "held_ranks"]
 
 RANK_SCHED_HANDLE = 5     # scheduler singleton construction
 RANK_SCHED = 10           # QueryScheduler._lock (admission + dispatch)
+RANK_RESULTCACHE = 12     # query/resultcache.py LRU (entry get/store;
+# may book its ledger tier (HBM 35) and bump stats (40) while held)
 RANK_DEVCACHE_FILL = 15   # decoded-plane base-fill stripes
 RANK_DEVCACHE = 20        # DeviceBlockCache._lock (HBM + host tiers)
 RANK_PIPELINE_POOL = 25   # shared pull-pool construction

@@ -492,6 +492,14 @@ def hbm_collector():
     return collector()
 
 
+def resultcache_collector():
+    """Result-cache metrics (query/resultcache.py): hit/partial/miss/
+    bypass counters, invalidations, evictions, live entry/byte gauges
+    and the derived hit ratio — the sustained-serving dedup signals."""
+    from ..query.resultcache import resultcache_collector as _rcc
+    return _rcc()
+
+
 def devicefault_collector():
     """Device fault domain metrics (ops/devicefault.py): classified
     error counts, retry/pressure-ladder/fallback counters, per-route

@@ -264,6 +264,11 @@ class TraceRecord:
     error: str = ""
     sampled: bool = True
     root: Span | None = None
+    # sustained-serving columns: which tenant's fair share the request
+    # charged (X-OG-Tenant) and how the result cache resolved it
+    # (hit/partial/miss/bypass; "" for writes / non-SELECTs)
+    tenant: str = ""
+    cache_status: str = ""
 
     def summary(self) -> dict:
         txt = self.text
@@ -274,6 +279,8 @@ class TraceRecord:
                 "start": self.start_wall,
                 "duration_ms": round(self.duration_ns / 1e6, 3),
                 "status": self.status, "sampled": self.sampled,
+                "tenant": self.tenant or "default",
+                "cache_status": self.cache_status,
                 **({"error": self.error} if self.error else {})}
 
 

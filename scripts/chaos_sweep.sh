@@ -2,7 +2,7 @@
 # Chaos sweep: run N seeded fault schedules (tests/test_chaos.py
 # slow schedules) and print a per-seed pass/fail table.
 #
-#   scripts/chaos_sweep.sh [--device|--crash] [N] [BASE_SEED]
+#   scripts/chaos_sweep.sh [--device|--crash|--sustained] [N] [BASE_SEED]
 #
 #   --device   run the DEVICE-fault storms (test_device_chaos_schedule:
 #              OOM / transient / hang across the device dispatch routes,
@@ -12,6 +12,11 @@
 #              (test_crash_chaos_schedule: one seeded SIGKILL/restart
 #              cycle per crash-point site through tests/crashharness.py,
 #              recovery contract C1-C5 per cycle)
+#   --sustained run the SUSTAINED-SERVING kill/deadline storms
+#              (test_sustained_chaos_schedule: result cache + tenant
+#              fair share under concurrent kills and invalidating
+#              writes, contract S1-S3 — byte identity, zero
+#              quota-token leak, exact result-cache ledger)
 #   N          number of seeds to run (default 5)
 #   BASE_SEED  first seed (default 1); seeds are BASE..BASE+N-1
 #
@@ -29,6 +34,10 @@ if [ "${1:-}" = "--device" ]; then
 elif [ "${1:-}" = "--crash" ]; then
     TEST=test_crash_chaos_schedule
     LABEL=crash
+    shift
+elif [ "${1:-}" = "--sustained" ]; then
+    TEST=test_sustained_chaos_schedule
+    LABEL=sustained
     shift
 fi
 N=${1:-5}

@@ -155,6 +155,30 @@ print(f"answer-sized D2H OK: topk cut {r['topk_d2h_shrink_x']}x "
       f"{r['f32_max_rel_err']} over {r['f32_checked_cells']} cells")
 EOF
 
+# result-cache gate (sustained serving, round 16): on every bench
+# shape, cache-on digests must equal the OG_RESULT_CACHE=0 reference
+# on the cold pass, the warm pass (served from cached closed-bucket
+# partials), AND immediately after a write into the cached range (the
+# write-epoch invalidation contract — no stale reads, zero grace
+# window), with a measured warm-hit latency shrink
+timeout -k 10 "${OG_SMOKE_TIMEOUT_S:-900}" \
+    python bench.py --phase rcgate | tee /tmp/og_rc_smoke.json
+
+python - <<'EOF'
+import json
+last = open("/tmp/og_rc_smoke.json").read().strip().splitlines()[-1]
+r = json.loads(last)
+assert r.get("metric") == "resultcache_gate", r
+assert r.get("rc_digest_ok") == 1, r
+assert r.get("rc_warm_hits", 0) >= 3, r
+assert r.get("rc_invalidations", 0) >= 1, r
+assert r.get("rc_warm_shrink_min_x", 0) >= 1.2, r
+print(f"result-cache gate OK: digests identical cold/warm/post-write "
+      f"on {r['shapes']}, {r['rc_warm_hits']} warm hits, "
+      f"{r['rc_invalidations']} epoch invalidations, warm-hit "
+      f"shrink {r['rc_warm_shrink_x']}")
+EOF
+
 # concurrency gate (device query scheduler): 16 dashboard + 1 heavy
 # query through the full HTTP path, scheduler-on AND OG_SCHED=0 —
 # every response must be bit-identical to the serial reference across

@@ -448,6 +448,11 @@ def run_device_schedule(root, seed: int, steps: int = 6,
     os.environ["OG_DEVICE_RETRY_BACKOFF_MS"] = "1"
     os.environ["OG_DEVICE_BREAKER_COOLDOWN_S"] = "0.05"
     df.reset_breakers()
+    # resync the mirrored cache tiers to the LIVE singletons before
+    # asserting exactness: earlier suites in the same process may have
+    # swapped singletons around (the documented rebase case) — D2 must
+    # catch drift created DURING this schedule, not inherited residue
+    hbm.rebase_cache_tiers()
     try:
         E.BLOCK_MIN_RATIO = 0
 
@@ -526,4 +531,187 @@ def run_device_schedule(root, seed: int, steps: int = 6,
             os.environ.pop(k, None)
         failpoint.disable_all()
         df.reset_breakers()
+        eng.close()
+
+
+# ------------------------------------------- sustained-serving chaos
+
+def run_sustained_schedule(root, seed: int, steps: int = 4,
+                           threads_per_step: int = 6,
+                           reqs_per_thread: int = 3) -> dict:
+    """One seeded kill/deadline storm over the sustained-serving stack
+    (result cache + tenant fair share, PR 15): every step fires a
+    burst of concurrent HTTP dashboard queries under rotating
+    X-OG-Tenant identities with random KILL QUERYs and micro deadline
+    budgets thrown in, and between steps randomly writes INTO the
+    cached range (epoch invalidation). Contract:
+
+      S1 byte-identity — every SUCCESSFUL response equals the current
+         fresh reference digest (recomputed after each write with
+         OG_RESULT_CACHE=0): kills, sheds and invalidations may fail a
+         request with a typed error, never corrupt one.
+      S2 exact accounting — after the storm drains: scheduler active
+         slots AND every per-tenant active count are 0 (no quota-token
+         leak), and hbm.cross_check() is exact (no result-cache ledger
+         byte leaked by a killed/deadline-expired request).
+      S3 typed failure — a non-success response carries a non-empty
+         error (never a connection drop / internal crash surface).
+    """
+    import threading
+
+    import numpy as np
+
+    from opengemini_tpu.http.server import HttpServer
+    from opengemini_tpu.ops import hbm
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.query import resultcache as rc
+    from opengemini_tpu.query.scheduler import get_scheduler
+    from opengemini_tpu.storage import Engine, EngineOptions
+    from opengemini_tpu.storage.rows import PointRow
+    from opengemini_tpu.utils import knobs
+    from opengemini_tpu.utils.config import Config
+
+    rng = random.Random(seed)
+    stats = {"seed": seed, "queries": 0, "ok": 0, "typed_errors": 0,
+             "sheds": 0, "kills_sent": 0, "writes": 0,
+             "invalidations": 0, "tenants": 0}
+    eng = Engine(str(root / "sustchaos"),
+                 EngineOptions(shard_duration=1 << 62))
+    vrng = np.random.default_rng(seed)
+    vals = np.round(vrng.normal(50.0, 12.0, (4, 240)), 2)
+    times = np.arange(240, dtype=np.int64) * 10**10
+    for h in range(4):
+        eng.write_record("sustchaos", "cpu", {"host": f"h{h}"},
+                         times, {"u": vals[h]})
+    for s in eng.database("sustchaos").all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    qtext = ("SELECT mean(u), count(u) FROM cpu WHERE time >= 0 AND "
+             "time < 2400000000000 GROUP BY time(1m), host")
+    (stmt,) = parse_query(qtext)
+    tenants = ["alpha", "beta", "gamma"]
+    knobs.set_env("OG_TENANT_SHARES", "alpha:4,beta:2")
+    knobs.set_env("OG_RESULT_CACHE", "1")
+    cfg = Config()
+    cfg.data.max_concurrent_queries = 2
+    cfg.data.max_queued_queries = 64
+    cfg.data.query_timeout_ns = 0
+    srv = HttpServer(eng, port=0, config=cfg)
+    srv.start()
+    inv0 = rc.RC_STATS["invalidations_epoch"]
+
+    def fresh_ref() -> str:
+        knobs.set_env("OG_RESULT_CACHE", "0")
+        try:
+            return _device_digest(ex.execute(stmt, "sustchaos"))
+        finally:
+            knobs.set_env("OG_RESULT_CACHE", "1")
+
+    try:
+        ref = [fresh_ref()]
+        lk = threading.Lock()
+        errs: list = []
+
+        def storm_worker(wi: int):
+            wrng = random.Random((seed << 8) ^ wi)
+            for _ in range(reqs_per_thread):
+                tenant = wrng.choice(tenants)
+                url = (f"http://127.0.0.1:{srv.port}/query?db="
+                       "sustchaos&q=" + urllib.parse.quote(qtext))
+                if wrng.random() < 0.2:
+                    url += f"&timeout={wrng.choice([0.001, 0.005])}"
+                req = urllib.request.Request(
+                    url, headers={"X-OG-Tenant": tenant})
+                with lk:
+                    stats["queries"] += 1
+                try:
+                    body = urllib.request.urlopen(
+                        req, timeout=60).read()
+                except urllib.error.HTTPError as e:
+                    if e.code in (429, 503):
+                        with lk:
+                            stats["sheds"] += 1
+                        continue
+                    with lk:
+                        errs.append(f"S3: HTTP {e.code}")
+                    continue
+                except Exception as e:   # noqa: BLE001
+                    with lk:
+                        errs.append(f"S3: transport {e!r}")
+                    continue
+                res = json.loads(body)["results"][0]
+                if "error" in res:
+                    with lk:
+                        if not str(res["error"]).strip():
+                            errs.append("S3: empty error")
+                        stats["typed_errors"] += 1
+                    continue
+                got = _device_digest(res)
+                with lk:
+                    if got != ref[0]:
+                        errs.append("S1: digest mismatch")
+                    stats["ok"] += 1
+
+        for _step in range(steps):
+            ts = [threading.Thread(target=storm_worker, args=(i,))
+                  for i in range(threads_per_step)]
+            for t in ts:
+                t.start()
+            # kill storm from the main thread while requests fly
+            for _ in range(3):
+                time.sleep(0.01)
+                running = srv.query_manager.list()
+                if running and rng.random() < 0.7:
+                    srv.query_manager.kill(
+                        rng.choice(running).qid)
+                    stats["kills_sent"] += 1
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), \
+                "storm thread wedged"
+            if rng.random() < 0.7:
+                # write INTO the cached range between steps — the next
+                # step's queries must see the new value (S1 vs a fresh
+                # reference), never the stale cached one
+                h = rng.randrange(4)
+                ti = rng.randrange(240)
+                eng.write_points("sustchaos", [PointRow(
+                    "cpu", {"host": f"h{h}"},
+                    {"u": round(rng.uniform(0, 100), 2)},
+                    int(times[ti]))])
+                for s in eng.database("sustchaos").all_shards():
+                    s.flush()
+                stats["writes"] += 1
+                ref[0] = fresh_ref()
+        assert not errs, errs[:5]
+
+        # S2: drained — no slot, quota token, or ledger byte leaked
+        sch = get_scheduler()
+        snap = sch.snapshot()
+        assert snap["active"] == 0, f"S2: active slots leak: {snap}"
+        tsnap = sch.tenants_snapshot()
+        leaked = {k: v for k, v in tsnap.items() if v["active"]}
+        assert not leaked, f"S2: tenant quota-token leak: {leaked}"
+        stats["tenants"] = len(tsnap)
+        # resync the device/host side tiers first: OTHER tests swap
+        # those singletons around (the documented rebase case) — this
+        # schedule owns the result_cache tier, which must be exact
+        # without any rebase
+        led0 = hbm.LEDGER.tier_bytes("result_cache")
+        src0 = rc.global_cache().stats()["bytes"]
+        assert led0 == src0, (
+            f"S2: result-cache ledger drift: {led0} != {src0}")
+        hbm.rebase_cache_tiers()
+        cross = hbm.cross_check()
+        assert cross["ok"], f"S2: ledger drift: {cross}"
+        st = rc.global_cache().stats()
+        assert st["bytes"] >= 0 and st["entries"] >= 0
+        stats["invalidations"] = (rc.RC_STATS["invalidations_epoch"]
+                                  - inv0)
+        assert stats["ok"] > 0, "storm produced no successes"
+        return stats
+    finally:
+        srv.stop()
+        knobs.del_env("OG_TENANT_SHARES")
+        knobs.del_env("OG_RESULT_CACHE")
         eng.close()

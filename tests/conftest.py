@@ -112,6 +112,55 @@ def _failpoint_hygiene():
         "call devicefault.restore_gate_permits()")
 
 
+# device-layer suites that assert device-side work happens on REPEAT
+# queries (counters, H2D/D2H bytes, fault injections): the serving-
+# layer result cache would satisfy the repeats from host memory and
+# starve those assertions. Its own behavior is covered in
+# tests/test_resultcache.py / test_sustained.py.
+_DEVICE_LAYER_SUITES = {
+    "test_device_faults", "test_device_finalize", "test_device_topk",
+    "test_compressed_domain", "test_pipeline", "test_scan",
+}
+
+
+@pytest.fixture(autouse=True)
+def _device_suites_pin_result_cache_off(request, monkeypatch):
+    mod = getattr(request, "module", None)
+    name = getattr(mod, "__name__", "").rpartition(".")[2]
+    if name in _DEVICE_LAYER_SUITES:
+        monkeypatch.setenv("OG_RESULT_CACHE", "0")
+
+
+@pytest.fixture(autouse=True)
+def _resultcache_ledger_guard():
+    """Result-cache tier integrity: after every test the HBM ledger's
+    ``result_cache`` tier must EQUAL what the cache itself reports,
+    byte for byte (the ledger is double-entry, not an estimate) — a
+    store/evict/purge path that leaks or double-releases bytes fails
+    the leaking test by name instead of poisoning reconcile math for
+    the rest of the run. Guarded on the module being imported so
+    storage-only tests never pull the query stack (and jax) in."""
+    import sys
+    yield
+    rc = sys.modules.get("opengemini_tpu.query.resultcache")
+    if rc is None:
+        return
+    from opengemini_tpu.ops import hbm
+    led = hbm.LEDGER.tier_bytes("result_cache")
+    src = rc.global_cache().stats()["bytes"]
+    if led != src:
+        # drain before asserting so one leak cannot cascade into
+        # every later test's guard
+        rc.global_cache().purge()
+        with hbm.LEDGER._lock:
+            hbm.LEDGER._tier("result_cache")["bytes"] = 0
+            hbm.LEDGER._tier("result_cache")["n"] = 0
+    assert led == src, (
+        f"test leaked result-cache ledger bytes: ledger={led} "
+        f"cache={src} — every store/evict must book through "
+        "ResultCache._account/_release")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

@@ -221,3 +221,21 @@ def test_crash_chaos_schedule(tmp_path, seed):
     from crashharness import CRASH_SITES
     stats = run_crash_schedule(tmp_path, seed)
     assert stats["fired"] == stats["cycles"] == len(CRASH_SITES)
+
+
+# -------------------------------- sustained-serving storms (PR 15)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_sustained_chaos_schedule(tmp_path, seed):
+    """Seeded kill/deadline storms over the sustained-serving stack
+    (result cache + tenant fair share; scripts/chaos_sweep.sh
+    --sustained). run_sustained_schedule asserts S1–S3: byte identity
+    under kills and invalidating writes, zero quota-token leak, exact
+    result-cache ledger. Reproduce with CHAOS_SEEDS=<seed>."""
+    from chaos import run_sustained_schedule
+    stats = run_sustained_schedule(tmp_path, seed, steps=5,
+                                   threads_per_step=8)
+    assert stats["ok"] > 0
+    assert stats["queries"] > 0

@@ -21,6 +21,7 @@ from opengemini_tpu.query import QueryExecutor, parse_query
 from opengemini_tpu.storage import Engine, EngineOptions
 from opengemini_tpu.utils import failpoint, knobs
 
+
 QTEXT = ("SELECT mean(usage_user), sum(usage_user), "
          "count(usage_user) FROM cpu WHERE time >= 0 AND "
          "time < 28800000000000 GROUP BY time(1h), hostname")

@@ -24,6 +24,7 @@ from opengemini_tpu.utils.failpoint import (FailpointError,
                                             FailpointTransient)
 
 
+
 @pytest.fixture(autouse=True)
 def _clean_faults():
     """Every test starts and ends with closed breakers, no armed

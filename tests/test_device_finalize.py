@@ -17,6 +17,7 @@ from opengemini_tpu.storage import Engine, EngineOptions
 from opengemini_tpu.utils.lineprotocol import parse_lines
 
 
+
 @pytest.fixture
 def db(tmp_path, monkeypatch):
     import opengemini_tpu.ops.devicecache as dc

@@ -395,7 +395,8 @@ def test_record_actual_feeds_histograms_and_bias():
     assert CALIB_HIST["cells_ratio"].snapshot()["count"] == c0 + 8
     assert SCHED_STATS["calib_records"] == n0 + 8
     snap = s.calibration_snapshot()
-    assert snap["mode"] == "record"
+    # graduated default (round 16): record AND apply
+    assert snap["mode"] == "1"
     cls = snap["classes"]["dash"]
     assert cls["n"] == 8
     # EWMA converges toward the true 4x / 2x bias
@@ -442,14 +443,15 @@ def test_calib_tristate_admission(monkeypatch):
     assert s.calibration_snapshot()["mode"] == "0"
     assert len(s.calibration_snapshot()["recent"]) == 0  # no records
     s.admit(cost=QueryCost(500)).release()      # 500 < 1000: admitted
-    # record (default): estimates graded but charges still raw
-    monkeypatch.delenv("OG_SCHED_CALIB", raising=False)
+    # record: estimates graded but charges still raw
+    monkeypatch.setenv("OG_SCHED_CALIB", "record")
     s = _poisoned()
     assert len(s.calibration_snapshot()["recent"]) > 0
     s.admit(cost=QueryCost(500)).release()
-    # OG_SCHED_CALIB=1: learned ~8x bias applies → 500 becomes ~4000
-    # which exceeds the 1000-cell budget and sheds citing the bias
-    monkeypatch.setenv("OG_SCHED_CALIB", "1")
+    # OG_SCHED_CALIB=1 (the graduated default — delenv exercises it):
+    # learned ~8x bias applies → 500 becomes ~4000 which exceeds the
+    # 1000-cell budget and sheds citing the bias
+    monkeypatch.delenv("OG_SCHED_CALIB", raising=False)
     s = _poisoned()
     a0 = SCHED_STATS["calib_applied"]
     with pytest.raises(SchedShed) as ei:
@@ -722,7 +724,8 @@ def test_debug_scheduler_endpoint(server):
     srv, _eng = server
     _get(srv, "/query?db=db0&q=" + urllib.parse.quote(Q_HIGH)).read()
     out = json.loads(_get(srv, "/debug/scheduler").read())
-    assert set(out) == {"enabled", "scheduler", "calibration"}
+    assert set(out) == {"enabled", "scheduler", "tenants",
+                        "calibration"}
     assert out["calibration"]["mode"] in ("0", "record", "1")
     assert set(out["calibration"]["classes"]) == \
         {"dash", "mid", "heavy"}
@@ -739,9 +742,10 @@ def test_show_queries_resource_columns_over_http(server):
         srv, "/query?db=db0&q=" + urllib.parse.quote("SHOW QUERIES")
     ).read())
     s = body["results"][0]["series"][0]
-    assert s["columns"][-2:] == ["hbm_peak_mb", "d2h_mb"]
+    assert s["columns"][-4:] == ["hbm_peak_mb", "d2h_mb", "tenant",
+                                 "cache_status"]
     # the in-flight SHOW itself: both columns present + non-negative
-    assert all(row[-1] >= 0 and row[-2] >= 0 for row in s["values"])
+    assert all(row[-3] >= 0 and row[-4] >= 0 for row in s["values"])
 
 
 # ------------------------------------------- ts-monitor round-trip

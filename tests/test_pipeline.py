@@ -15,6 +15,7 @@ from opengemini_tpu.ops.pipeline import (StreamingPipeline,
 # ----------------------------------------- device_get_parallel edges
 
 
+
 def test_pull_leaf_larger_than_chunk():
     """A leaf bigger than chunk_bytes splits along its longest axis and
     reassembles exactly."""

@@ -1,0 +1,534 @@
+"""Result cache (query/resultcache.py): canonical-key fuzz + collision
+oracle, write-then-read staleness, bucket-split byte-identity, LRU
+byte-budget/ledger accounting, and the admission discount."""
+
+import json
+import hashlib
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import hbm
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.query import resultcache as rc
+from opengemini_tpu.query.condition import analyze_condition
+from opengemini_tpu.query.functions import classify_select
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.storage.rows import PointRow
+from opengemini_tpu.utils import epochs, knobs
+
+DB = "rcdb"
+HOURS_NS = 3600 * 10**9
+
+
+@pytest.fixture(autouse=True)
+def _cache_on(monkeypatch):
+    monkeypatch.setenv("OG_RESULT_CACHE", "1")
+    yield
+    rc.global_cache().purge()
+
+
+@pytest.fixture()
+def db(tmp_path):
+    eng = Engine(str(tmp_path / "d"),
+                 EngineOptions(shard_duration=1 << 62))
+    rng = np.random.default_rng(7)
+    times = np.arange(360, dtype=np.int64) * 10**10    # 1h, 10s step
+    for h in range(6):
+        vals = np.round(np.clip(rng.normal(50, 15, 360), 0, 100), 2)
+        eng.write_record(DB, "cpu",
+                         {"host": f"h{h}", "region": f"r{h % 2}"},
+                         times, {"u": vals, "v": vals * 0.5})
+    for s in eng.database(DB).all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def dig(res: dict) -> str:
+    d = hashlib.sha256()
+    assert "error" not in res, res
+    for s in sorted(res.get("series", []),
+                    key=lambda s: json.dumps(s.get("tags", {}),
+                                             sort_keys=True)):
+        d.update(json.dumps(s.get("tags", {}),
+                            sort_keys=True).encode())
+        for r in s["values"]:
+            d.update(repr(tuple(r)).encode())
+    return d.hexdigest()
+
+
+def q(ex, text, db=DB):
+    (stmt,) = parse_query(text)
+    return ex.execute(stmt, db)
+
+
+def key_of(eng, text, tenant=""):
+    (stmt,) = parse_query(text)
+    cond = analyze_condition(stmt.condition, {"host", "region"})
+    return rc.canonical_key(eng, DB, stmt.from_measurement, stmt,
+                            cond, tenant)
+
+
+# ------------------------------------------------- canonicalizer fuzz
+
+BASE = ("SELECT mean(u) FROM cpu WHERE host = 'h1' AND "
+        "region = 'r0' AND time >= 0 AND time < 3600s "
+        "GROUP BY time(1m)")
+
+SAME_KEY_VARIANTS = [
+    # whitespace
+    ("SELECT   mean(u)\n\tFROM cpu   WHERE host = 'h1' AND "
+     "region = 'r0' AND time >= 0 AND time < 3600s "
+     "GROUP BY time(1m)"),
+    # keyword/function case (identifiers — incl. the `time` column —
+    # are case-SENSITIVE in InfluxQL and stay untouched)
+    ("select MEAN(u) from cpu where host = 'h1' and "
+     "region = 'r0' and time >= 0 AND time < 3600s "
+     "group by time(1m)"),
+    # comments (line + block)
+    ("SELECT mean(u) /* dashboards */ FROM cpu WHERE host = 'h1' "
+     "AND region = 'r0' AND time >= 0 AND time < 3600s "
+     "GROUP BY time(1m) -- panel 3"),
+    # tag-predicate order
+    ("SELECT mean(u) FROM cpu WHERE region = 'r0' AND host = 'h1' "
+     "AND time >= 0 AND time < 3600s GROUP BY time(1m)"),
+    # absolute range position in the conjunction
+    ("SELECT mean(u) FROM cpu WHERE time >= 0 AND host = 'h1' AND "
+     "time < 3600s AND region = 'r0' GROUP BY time(1m)"),
+]
+
+NOW_VARIANTS = [
+    ("SELECT mean(u) FROM cpu WHERE host = 'h1' AND region = 'r0' "
+     "AND time > now() - 1h GROUP BY time(1m)"),
+    ("SELECT mean(u) FROM cpu WHERE host = 'h1' AND region = 'r0' "
+     "AND time > now() - 60m GROUP BY time(1m)"),
+    ("SELECT mean(u) FROM cpu WHERE host = 'h1' AND region = 'r0' "
+     "AND time > now() - 3600s GROUP BY time(1m)"),
+]
+
+DIFF_KEY_VARIANTS = [
+    # limits / offsets
+    BASE + " LIMIT 5",
+    BASE + " LIMIT 10",
+    BASE + " LIMIT 5 OFFSET 2",
+    BASE + " SLIMIT 3",
+    # fill
+    BASE + " fill(none)",
+    BASE + " fill(0)",
+    BASE + " fill(previous)",
+    # order
+    BASE + " ORDER BY time DESC",
+    # select list / field
+    BASE.replace("mean(u)", "mean(v)"),
+    BASE.replace("mean(u)", "sum(u)"),
+    BASE.replace("mean(u)", "mean(u), count(u)"),
+    # interval / grouping
+    BASE.replace("time(1m)", "time(5m)"),
+    BASE.replace("GROUP BY time(1m)", "GROUP BY time(1m), host"),
+    # predicates
+    BASE.replace("host = 'h1'", "host = 'h2'"),
+    BASE.replace("region = 'r0'", "region = 'r1'"),
+    BASE.replace("host = 'h1' AND ", "host = 'h1' AND u > 10 AND "),
+]
+
+
+def test_canonical_key_invariants(db):
+    eng, _ex = db
+    k0 = key_of(eng, BASE)
+    for v in SAME_KEY_VARIANTS:
+        assert key_of(eng, v) == k0, v
+    # now()-relative variants of ONE range key identically (and also
+    # identically to each other parsed milliseconds apart)
+    nks = {key_of(eng, v) for v in NOW_VARIANTS}
+    assert len(nks) == 1
+    # ... and identically to the absolute form of the same statement
+    # (the key is range-invariant)
+    assert nks.pop() == key_of(
+        eng, BASE.replace(" AND time >= 0 AND time < 3600s", ""))
+    seen = {repr(k0): BASE}
+    for v in DIFF_KEY_VARIANTS:
+        k = key_of(eng, v)
+        assert repr(k) != repr(k0), f"collides with base: {v}"
+        assert repr(k) not in seen, f"collides with {seen[repr(k)]}: {v}"
+        seen[repr(k)] = v
+
+
+def test_canonical_key_tenant_and_engine_isolation(db, tmp_path):
+    eng, _ex = db
+    assert key_of(eng, BASE, "a") != key_of(eng, BASE, "b")
+    assert key_of(eng, BASE, "") != key_of(eng, BASE, "a")
+    eng2 = Engine(str(tmp_path / "other"))
+    try:
+        assert key_of(eng, BASE) != key_of(eng2, BASE)
+    finally:
+        eng2.close()
+
+
+def test_key_collision_oracle(db, monkeypatch):
+    """Any two statements that CANONICALIZE to the same key must
+    produce identical results over identical ranges — the oracle that
+    justifies serving one's cache entry to the other. Verified by
+    full recompute (cache off)."""
+    eng, ex = db
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    pool = [BASE] + SAME_KEY_VARIANTS + DIFF_KEY_VARIANTS
+    by_key: dict = {}
+    for text in pool:
+        by_key.setdefault(repr(key_of(eng, text)), []).append(text)
+    shared = {k: v for k, v in by_key.items() if len(v) > 1}
+    assert shared, "oracle needs at least one shared-key group"
+    for texts in shared.values():
+        digs = {dig(q(ex, t)) for t in texts}
+        assert len(digs) == 1, f"same key, different results: {texts}"
+
+
+# ------------------------------------------------ serve() correctness
+
+Q = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s "
+     "GROUP BY time(1m), host")
+
+
+def ref_and_cached(ex, text, monkeypatch):
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    ref = dig(q(ex, text))
+    monkeypatch.setenv("OG_RESULT_CACHE", "1")
+    return ref
+
+
+def test_hit_partial_and_unaligned_ranges_byte_identical(
+        db, monkeypatch):
+    eng, ex = db
+    cases = [
+        Q,                                                     # aligned
+        # unaligned t_min (head fragment recomputes)
+        Q.replace("time >= 0", "time >= 30s"),
+        # unaligned t_max (tail fragment recomputes)
+        Q.replace("time < 3600s", "time < 3570s"),
+        # both unaligned
+        Q.replace("time >= 0", "time >= 90s").replace(
+            "time < 3600s", "time < 3550s"),
+    ]
+    for text in cases:
+        refd = ref_and_cached(ex, text, monkeypatch)
+        assert dig(q(ex, text)) == refd, f"cold: {text}"
+        assert dig(q(ex, text)) == refd, f"warm: {text}"
+    # sliding + narrowing windows over one cached entry
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    assert dig(q(ex, Q)) == refd
+    for tmin, tmax in ((0, 1800), (600, 3600), (300, 900),
+                      (0, 3600)):
+        text = Q.replace("time >= 0", f"time >= {tmin}s").replace(
+            "time < 3600s", f"time < {tmax}s")
+        monkeypatch.setenv("OG_RESULT_CACHE", "0")
+        want = dig(q(ex, text))
+        monkeypatch.setenv("OG_RESULT_CACHE", "1")
+        assert dig(q(ex, text)) == want, (tmin, tmax)
+
+
+def test_warm_hit_serves_without_scan(db, monkeypatch):
+    eng, ex = db
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    h0, m0 = rc.RC_STATS["hits"], rc.RC_STATS["misses"]
+    assert dig(q(ex, Q)) == refd                    # miss, fills
+    assert rc.RC_STATS["misses"] == m0 + 1
+    assert dig(q(ex, Q)) == refd                    # full hit
+    assert rc.RC_STATS["hits"] == h0 + 1
+    # ctx carries the status for SHOW QUERIES / flight recorder
+    from opengemini_tpu.query.manager import QueryManager
+    qm = QueryManager()
+    ctx = qm.attach(Q, DB)
+    (stmt,) = parse_query(Q)
+    ex.execute(stmt, DB, ctx=ctx)
+    assert ctx.cache_status == "hit"
+    qm.detach(ctx)
+    # a DIFFERENT tenant keys apart: quota isolation means no
+    # cross-tenant serve, so its first query is a miss
+    ctx2 = qm.attach(Q, DB, tenant="t9")
+    ex.execute(stmt, DB, ctx=ctx2)
+    assert ctx2.cache_status == "miss"
+    assert ctx2.tenant == "t9"
+    qm.detach(ctx2)
+
+
+def test_partial_hit_extends_watermark(db, monkeypatch):
+    eng, ex = db
+    half = Q.replace("time < 3600s", "time < 1800s")
+    refh = ref_and_cached(ex, half, monkeypatch)
+    assert dig(q(ex, half)) == refh
+    p0 = rc.RC_STATS["partial_hits"]
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    reff = dig(q(ex, Q))
+    monkeypatch.setenv("OG_RESULT_CACHE", "1")
+    assert dig(q(ex, Q)) == reff        # cached prefix + fresh tail
+    assert rc.RC_STATS["partial_hits"] == p0 + 1
+    h0 = rc.RC_STATS["hits"]
+    assert dig(q(ex, Q)) == reff        # watermark advanced: full hit
+    assert rc.RC_STATS["hits"] == h0 + 1
+
+
+def test_ineligible_statements_bypass(db, monkeypatch):
+    eng, ex = db
+    b0 = rc.RC_STATS["bypass"]
+    cases = [
+        # raw-slice / sketch / stddev / multirow ops: merge is not
+        # bit-identical to the unsplit scan — never cached
+        Q.replace("mean(u)", "percentile(u, 95)"),
+        Q.replace("mean(u)", "stddev(u)"),
+        Q.replace("mean(u)", "top(u, 3)"),
+        # no GROUP BY time
+        "SELECT mean(u) FROM cpu WHERE time >= 0 AND time < 3600s",
+        # unbounded range
+        "SELECT mean(u) FROM cpu GROUP BY time(1m)",
+    ]
+    for text in cases:
+        q(ex, text)
+    assert rc.RC_STATS["bypass"] >= b0 + len(cases)
+    assert rc.global_cache().stats()["entries"] == 0
+
+
+# ------------------------------------------------ staleness contract
+
+def test_write_then_read_never_stale(db, monkeypatch):
+    """The acceptance-criteria staleness test: a write INTO a cached
+    range must invalidate — the very next read matches a fresh
+    recompute, byte for byte, with zero grace window."""
+    eng, ex = db
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    assert dig(q(ex, Q)) == refd
+    assert dig(q(ex, Q)) == refd                    # warm
+    for i in range(3):
+        eng.write_points(DB, [PointRow(
+            "cpu", {"host": "h0", "region": "r0"},
+            {"u": 90.0 + i}, (i + 1) * 600 * 10**9)])
+        for s in eng.database(DB).all_shards():
+            s.flush()
+        monkeypatch.setenv("OG_RESULT_CACHE", "0")
+        want = dig(q(ex, Q))
+        monkeypatch.setenv("OG_RESULT_CACHE", "1")
+        got = dig(q(ex, Q))
+        assert got == want, f"stale read after write {i}"
+        assert got != refd
+        refd = want
+        assert dig(q(ex, Q)) == refd                # re-warms
+
+
+def test_delete_and_drop_invalidate(db, monkeypatch):
+    eng, ex = db
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    assert dig(q(ex, Q)) == refd
+    eng.delete_rows(DB, "cpu", t_min=0, t_max=600 * 10**9)
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    want = dig(q(ex, Q))
+    monkeypatch.setenv("OG_RESULT_CACHE", "1")
+    assert want != refd
+    assert dig(q(ex, Q)) == want
+    # db-level wipe generation: drop_database invalidates everything
+    i0 = rc.RC_STATS["invalidations_wipe"]
+    assert dig(q(ex, Q)) == want                    # warm again
+    eng.drop_database(DB)
+    assert rc.global_cache().probe_coverage(
+        rc._probe_key(eng, DB, "cpu", parse_query(Q)[0], "")) is None
+    assert rc.RC_STATS["invalidations_wipe"] > i0
+
+
+def test_epoch_ring_semantics():
+    epochs.reset()
+    try:
+        e0, m0, g0 = epochs.snapshot("d", "m")
+        epochs.note_write("d", "m", 100, 200)
+        ch, cur = epochs.changed_since("d", "m", e0, m0, g0, 150, 300)
+        assert ch                                     # overlap
+        ch, cur = epochs.changed_since("d", "m", e0, m0, g0, 300, 400)
+        assert not ch and cur == e0 + 1               # disjoint
+        # refresh-to-current: later checks skip the scanned tail
+        ch, _ = epochs.changed_since("d", "m", cur, m0, g0, 0, 1 << 62)
+        assert not ch
+        # per-mst wipe invalidates THIS measurement everywhere...
+        epochs.note_wipe("d", "m")
+        ch, _ = epochs.changed_since("d", "m", cur, m0, g0, 300, 400)
+        assert ch
+        # ...but not a sibling measurement in the same db (a retention
+        # DELETE on one measurement must not flush every dashboard)
+        epochs.note_write("d", "other", 0, 10)
+        eo, mo, go = epochs.snapshot("d", "other")
+        epochs.note_wipe("d", "m")
+        ch, _ = epochs.changed_since("d", "other", eo, mo, go, 0, 10)
+        assert not ch
+        # evicted history answers CHANGED (conservative, never stale)
+        _e, m1, _g = epochs.snapshot("d", "m")
+        for i in range(600):
+            epochs.note_write("d", "m", 10**9 + i, 10**9 + i)
+        e1, m1, g1 = epochs.snapshot("d", "m")
+        ch, _ = epochs.changed_since("d", "m", e1 - 550, m1, g1, 0, 10)
+        assert ch
+        # db generation bump invalidates regardless of mst ranges
+        epochs.note_wipe("d")
+        ch, _ = epochs.changed_since("d", "m", e1, m1, g1, 0, 10)
+        assert ch
+        # an evicted store entry under a NONZERO stamp is conservative
+        epochs.note_write("d2", "m2", 0, 1)
+        e2, m2, g2 = epochs.snapshot("d2", "m2")
+        epochs.reset()
+        ch, _ = epochs.changed_since("d2", "m2", e2, m2, 0, 0, 10)
+        assert ch
+        # ...while a zero stamp (disk-resident data, never written in
+        # this process) stays valid on a missing entry
+        ch, _ = epochs.changed_since("d3", "m3", 0, 0, 0, 0, 10)
+        assert not ch
+    finally:
+        epochs.reset()
+
+
+def test_live_edge_write_does_not_invalidate_closed_prefix(
+        tmp_path, monkeypatch):
+    """Sustained ingest appends at the live edge: with shard-granular
+    extents TIGHTER than the cached range (small shard_duration), a
+    tail write must keep the closed-prefix entry valid."""
+    sd = 600 * 10**9
+    eng = Engine(str(tmp_path / "edge"),
+                 EngineOptions(shard_duration=sd))
+    try:
+        times = np.arange(360, dtype=np.int64) * 10**10
+        eng.write_record(DB, "cpu", {"host": "h0"}, times,
+                         {"u": np.ones(360) * 5})
+        for s in eng.database(DB).all_shards():
+            s.flush()
+        ex = QueryExecutor(eng)
+        half = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+                "time < 1800s GROUP BY time(1m)")
+        refd = ref_and_cached(ex, half, monkeypatch)
+        assert dig(q(ex, half)) == refd
+        # append into [3000s, 3600s) — beyond the cached watermark
+        eng.write_points(DB, [PointRow("cpu", {"host": "h0"},
+                                       {"u": 7.0}, 3100 * 10**9)])
+        for s in eng.database(DB).all_shards():
+            s.flush()
+        h0 = rc.RC_STATS["hits"]
+        assert dig(q(ex, half)) == refd
+        assert rc.RC_STATS["hits"] == h0 + 1, \
+            "live-edge append invalidated a disjoint closed prefix"
+    finally:
+        eng.close()
+
+
+# ------------------------------------------- budget / ledger / purge
+
+def _fake_partial(g=4, w=64):
+    return {"group_tags": ["host"],
+            "group_keys": [[f"h{i}"] for i in range(g)],
+            "interval": 60 * 10**9, "start": 0, "W": w,
+            "fields": {"u": {"count": np.ones((g, w), np.int64),
+                             "sum": np.ones((g, w))}},
+            "field_types": {"u": "float"}}
+
+
+def test_lru_byte_budget_and_ledger(monkeypatch):
+    cache = rc.ResultCache()
+    monkeypatch.setenv("OG_RESULT_CACHE_MB", "1")
+    nbytes = rc._partial_nbytes(_fake_partial())
+    cap = (1 << 20) // nbytes
+    led0 = hbm.LEDGER.tier_bytes("result_cache")
+    e0 = rc.RC_STATS["evictions"]
+    try:
+        for i in range(cap + 8):
+            key = ("k", i)
+            assert cache.store(key, ("p",), "d", "m",
+                               _fake_partial(), 10**9, (0, 0, 0))
+        st = cache.stats()
+        assert st["bytes"] <= 1 << 20
+        assert rc.RC_STATS["evictions"] >= e0 + 7
+        assert hbm.LEDGER.tier_bytes("result_cache") \
+            == led0 + st["bytes"]
+        # an entry bigger than budget/4 is refused, not half-booked
+        big = _fake_partial(g=256, w=512)
+        t0 = rc.RC_STATS["too_large"]
+        assert not cache.store(("big",), ("p",), "d", "m", big,
+                               10**9, (0, 0, 0))
+        assert rc.RC_STATS["too_large"] == t0 + 1
+    finally:
+        cache.purge()
+    assert cache.stats() == {"entries": 0, "bytes": 0}
+    assert hbm.LEDGER.tier_bytes("result_cache") == led0
+
+
+def test_cross_check_covers_result_cache_tier(db, monkeypatch):
+    eng, ex = db
+    ref_and_cached(ex, Q, monkeypatch)
+    q(ex, Q)
+    assert rc.global_cache().stats()["entries"] >= 1
+    # resync the device/host side tiers first — OTHER suites swap
+    # those singletons (the documented rebase case); the result_cache
+    # tier itself must be exact without any rebase
+    hbm.rebase_cache_tiers()
+    cc = hbm.cross_check()
+    assert cc["result_cache"]["match"], cc
+    assert cc["ok"], cc
+
+
+def test_engine_close_purges_entries(tmp_path, monkeypatch):
+    eng = Engine(str(tmp_path / "p"))
+    times = np.arange(240, dtype=np.int64) * 10**10
+    eng.write_record(DB, "cpu", {"host": "h0"}, times,
+                     {"u": np.ones(240)})
+    for s in eng.database(DB).all_shards():
+        s.flush()
+    ex = QueryExecutor(eng)
+    half = ("SELECT mean(u) FROM cpu WHERE time >= 0 AND "
+            "time < 2400s GROUP BY time(1m)")
+    monkeypatch.setenv("OG_RESULT_CACHE", "1")
+    q(ex, half)
+    tok = eng._og_rc_token
+    had = any(k[0] == tok for k in rc.global_cache()._lru)
+    assert had
+    eng.close()
+    assert not any(k[0] == tok for k in rc.global_cache()._lru)
+
+
+def test_too_large_statements_bypass_after_first_run(db, monkeypatch):
+    """A statement whose partial state exceeds the per-entry cap must
+    not pay the mergeable wire format forever: its first run notes the
+    key as too-large (shape-only check, no copy), later runs BYPASS
+    and keep the terminal transport diet."""
+    eng, ex = db
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    monkeypatch.setattr(rc, "_entry_cap", lambda: 1024)
+    t0 = rc.RC_STATS["too_large"]
+    assert dig(q(ex, Q)) == refd                 # miss, cap rejects
+    assert rc.RC_STATS["too_large"] == t0 + 1
+    assert rc.global_cache().stats()["entries"] == 0
+    b0 = rc.RC_STATS["bypass"]
+    m0 = rc.RC_STATS["misses"]
+    assert dig(q(ex, Q)) == refd                 # negative-cache hit
+    assert rc.RC_STATS["bypass"] == b0 + 1
+    assert rc.RC_STATS["misses"] == m0
+
+
+# ------------------------------------------------ admission discount
+
+def test_discount_cost_shrinks_to_live_edge(db, monkeypatch):
+    eng, ex = db
+    from opengemini_tpu.query.scheduler import QueryCost
+    stmts = parse_query(Q)
+    refd = ref_and_cached(ex, Q, monkeypatch)
+    cost = QueryCost(100_000, pull_bytes=10**6, hbm_bytes=10**7)
+    # nothing cached: estimate passes through untouched
+    assert rc.discount_cost(ex, stmts, DB, "", cost) is cost
+    assert dig(q(ex, Q)) == refd          # fill
+    d0 = rc.RC_STATS["admit_discounts"]
+    out = rc.discount_cost(ex, stmts, DB, "", cost)
+    assert out.cells < cost.cells // 10   # fully-covered range
+    assert rc.RC_STATS["admit_discounts"] == d0 + 1
+    # a write invalidates the entry — the discount must vanish WITH it
+    eng.write_points(DB, [PointRow("cpu",
+                                   {"host": "h0", "region": "r0"},
+                                   {"u": 1.0}, 600 * 10**9)])
+    for s in eng.database(DB).all_shards():
+        s.flush()
+    out2 = rc.discount_cost(ex, stmts, DB, "", cost)
+    assert out2.cells == cost.cells
+    # OG_RESULT_CACHE=0: no discount at all
+    assert dig(q(ex, Q)) == dig(q(ex, Q))
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    assert rc.discount_cost(ex, stmts, DB, "", cost) is cost

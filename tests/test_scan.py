@@ -11,6 +11,7 @@ from opengemini_tpu.query.scan import (materialize_scan,
 from opengemini_tpu.storage import Engine, EngineOptions
 from opengemini_tpu.utils.lineprotocol import parse_lines
 
+
 MIN = 60 * 10**9
 
 

@@ -421,6 +421,10 @@ def test_hammer_plan_and_device_cache_fills(db, monkeypatch):
     leader) and results stay identical."""
     eng, ex = db
     seed(eng)
+    # this test exercises the SCAN-PLAN singleflight: the result cache
+    # would serve the repeats without ever building a plan (its own
+    # dedup is tested in tests/test_resultcache.py)
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
     monkeypatch.setenv("OG_SCHED", "0")
     ref = q(ex, Q_HIGH)
     # fresh executor: cold plan cache, same engine
@@ -695,9 +699,14 @@ def test_show_queries_reports_phases(db):
     s = res["series"][0]
     assert s["columns"] == ["qid", "query", "database", "duration",
                             "status", "queue_ms", "device_ms",
-                            "hbm_peak_mb", "d2h_mb"]
+                            "hbm_peak_mb", "d2h_mb", "tenant",
+                            "cache_status"]
     row = s["values"][0]
     assert row[4] == "running" and row[5] >= 0 and row[6] >= 0
     # measured device-resource columns (observatory): present and
     # non-negative even for a query that never touched the device
     assert row[7] >= 0 and row[8] >= 0
+    # sustained-serving columns: a ctx attached without a tenant
+    # header reports the default tenant; a SHOW never reaches an
+    # eligible SELECT so its cache_status stays ""
+    assert row[9] == "default" and row[10] == ""
