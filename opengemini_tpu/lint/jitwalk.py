@@ -35,8 +35,11 @@ from .core import dotted
 
 # _named_jit is ops/blockagg.py's attribution-preserving jit wrapper
 # (renames the kernel for the compile auditor, then jax.jit's it) —
-# functions passed to it are roots exactly like jax.jit(f)
-_JIT_NAMES = ("jax.jit", "jit", "_named_jit")
+# functions passed to it are roots exactly like jax.jit(f).
+# _program_jit is ops/fused.py's shape-class twin (round 17): the
+# whole-plan fused program builder passes its traced program body
+# through it, so R5/R9 cover the fused body like any staged kernel.
+_JIT_NAMES = ("jax.jit", "jit", "_named_jit", "_program_jit")
 _PALLAS_CALL = ("pl.pallas_call", "pallas.pallas_call", "pallas_call",
                 "jax.experimental.pallas.pallas_call")
 

@@ -59,6 +59,8 @@ SLAB_BLOCKS = int(knobs.get("OG_BLOCK_SLAB"))
 
 
 @dataclass
+
+
 class BlockStack:
     """One slab of a (file, field)'s segments resident in HBM.
 
@@ -245,6 +247,7 @@ _TimeCol = _TimeColMeta()
 # ladder — heal PER BLOCK through the host stage (decode + dense
 # device_put, manifest site "slab"), so a sick kernel degrades one
 # batch, not the file.
+
 
 def _build_slab_device(reader, field: str, metas, seg: int, E: int,
                        block0: int):
@@ -977,6 +980,155 @@ def unpack_planes(packed: np.ndarray, want: tuple, K: int,
     return out
 
 
+def _mask_stage(values, valid, times, limbs, bad, gids, block0,
+                scalars, *, num_segments: int, want: tuple,
+                W: int, K: int, SEG: int):
+    """Trace-composable body of _kernel (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax
+    import jax.numpy as jnp
+
+    ns = num_segments + 1
+    use_mask = W <= MASK_W_MAX
+    t_lo, t_hi, start, interval = (scalars[0], scalars[1],
+                                   scalars[2], scalars[3])
+    B = values.shape[0]
+    m0 = (valid & (times >= t_lo) & (times <= t_hi)
+          & (gids >= 0)[:, None])
+    wid = (times - start) // interval
+    m0 = m0 & (wid >= 0) & (wid < W)
+    lbf = limbs.astype(jnp.float64) if "sum" in want else None
+    planes = []
+
+    if use_mask:
+        wid32 = wid.astype(jnp.int32)
+        gidx = (block0 * SEG
+                + jnp.arange(B * SEG, dtype=jnp.float64).reshape(
+                    values.shape))
+        st1 = {k: [] for k in ("count", "limbs", "bad", "sumsq",
+                               "min", "min_idx", "max", "max_idx")}
+        for w in range(W):
+            mw = m0 & (wid32 == w)
+            st1["count"].append(mw.sum(axis=1, dtype=jnp.float32)
+                                .astype(jnp.float64))
+            if "sum" in want:
+                st1["limbs"].append(jnp.where(
+                    mw[:, :, None], lbf, 0.0).sum(axis=1))
+                st1["bad"].append((mw & bad).any(axis=1)
+                                  .astype(jnp.float64))
+            if "sumsq" in want:
+                vz = jnp.where(mw, values, 0.0)
+                st1["sumsq"].append((vz * vz).sum(axis=1))
+            has_rows = mw.any(axis=1)
+            if "min" in want:
+                vm = jnp.where(mw, values, jnp.inf)
+                mn = vm.min(axis=1)
+                st1["min"].append(mn)
+                # mask on row presence, not finiteness: a stored
+                # +/-inf value is a REAL extremum whose index must
+                # survive (only truly empty windows drop to the
+                # sentinel); masked-out rows can't win the == test
+                # because mw-false positions hold the identity
+                ix = jnp.where(mw & (values == mn[:, None]), gidx,
+                               IDX_SENTINEL).min(axis=1)
+                st1["min_idx"].append(
+                    jnp.where(has_rows, ix, IDX_SENTINEL))
+            if "max" in want:
+                vm = jnp.where(mw, values, -jnp.inf)
+                mx = vm.max(axis=1)
+                st1["max"].append(mx)
+                ix = jnp.where(mw & (values == mx[:, None]), gidx,
+                               IDX_SENTINEL).min(axis=1)
+                st1["max_idx"].append(
+                    jnp.where(has_rows, ix, IDX_SENTINEL))
+        # stage 2: scatter (B*W) partials onto the cell grid
+        seg2 = (gids.astype(jnp.int32)[:, None] * W
+                + jnp.arange(W, dtype=jnp.int32)[None, :])
+        seg2 = jnp.where(gids[:, None] >= 0, seg2,
+                         num_segments).reshape(-1)
+
+        def sc_sum(x):
+            return jax.ops.segment_sum(x, seg2, ns)[:num_segments]
+
+        def sc_min(x):
+            return jax.ops.segment_min(x, seg2, ns)[:num_segments]
+
+        def sc_max(x):
+            return jax.ops.segment_max(x, seg2, ns)[:num_segments]
+
+        def flat(name):
+            return jnp.stack(st1[name], axis=1).reshape(-1)
+
+        planes.append(sc_sum(flat("count")))
+        if "sum" in want:
+            lw = jnp.stack(st1["limbs"], axis=1).reshape(-1, K)
+            for k in range(K):
+                planes.append(sc_sum(lw[:, k]))
+            planes.append(sc_max(flat("bad")))
+        if "sumsq" in want:
+            planes.append(sc_sum(flat("sumsq")))
+        if "min" in want:
+            mn = sc_min(flat("min"))
+            win = flat("min") == mn[seg2.reshape(gids.shape[0], W)
+                                    ].reshape(-1)
+            ix = sc_min(jnp.where(win, flat("min_idx"),
+                                  IDX_SENTINEL))
+            planes += [mn, ix]
+        if "max" in want:
+            mx = sc_max(flat("max"))
+            win = flat("max") == mx[seg2.reshape(gids.shape[0], W)
+                                    ].reshape(-1)
+            ix = sc_min(jnp.where(win, flat("max_idx"),
+                                  IDX_SENTINEL))
+            planes += [mx, ix]
+        return jnp.stack(planes)
+
+    # scatter fallback for wide windows (rare under the cell cap):
+    # i32 segment ids + f64 accumulators — the round-2 int64
+    # scatters hit the 64-bit emulation path and were ~60× slower
+    n = values.shape[0] * SEG
+    v = values.reshape(n)
+    m = m0.reshape(n)
+    lb = limbs.reshape(n, K) if "sum" in want else None
+    bd = bad.reshape(n)
+    g32 = jnp.repeat(gids.astype(jnp.int32), SEG)
+    seg = jnp.where(m, g32 * W + wid.reshape(n).astype(jnp.int32),
+                    num_segments)
+    planes.append(jax.ops.segment_sum(
+        m.astype(jnp.float64), seg, ns)[:num_segments])
+    if "sum" in want:
+        for k in range(K):
+            planes.append(jax.ops.segment_sum(
+                jnp.where(m, lb[:, k], 0).astype(jnp.float64),
+                seg, ns)[:num_segments])
+        planes.append(jax.ops.segment_max(
+            (m & bd).astype(jnp.float32), seg, ns)[:num_segments]
+            .astype(jnp.float64))
+    if "sumsq" in want:
+        vz = jnp.where(m, v, 0.0)
+        planes.append(jax.ops.segment_sum(vz * vz, seg,
+                                          ns)[:num_segments])
+    gidx = jnp.arange(n, dtype=jnp.float64) + block0 * SEG
+    if "min" in want:
+        ext = jax.ops.segment_min(jnp.where(m, v, jnp.inf), seg, ns)
+        at = m & (v == ext[seg])
+        planes += [ext[:num_segments],
+                   jax.ops.segment_min(
+                       jnp.where(at, gidx, IDX_SENTINEL), seg,
+                       ns)[:num_segments]]
+    if "max" in want:
+        ext = jax.ops.segment_max(jnp.where(m, v, -jnp.inf), seg, ns)
+        at = m & (v == ext[seg])
+        planes += [ext[:num_segments],
+                   jax.ops.segment_min(
+                       jnp.where(at, gidx, IDX_SENTINEL), seg,
+                       ns)[:num_segments]]
+    return jnp.stack(planes)
+
+
 def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
     """Per-slab reduction → ONE packed (P, num_segments) f64 array.
 
@@ -998,147 +1150,12 @@ def _kernel(num_segments: int, want: tuple, W: int, K: int, SEG: int):
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
-
-    ns = num_segments + 1
-    use_mask = W <= MASK_W_MAX
 
     def _f(values, valid, times, limbs, bad, gids, block0, scalars):
-        t_lo, t_hi, start, interval = (scalars[0], scalars[1],
-                                       scalars[2], scalars[3])
-        B = values.shape[0]
-        m0 = (valid & (times >= t_lo) & (times <= t_hi)
-              & (gids >= 0)[:, None])
-        wid = (times - start) // interval
-        m0 = m0 & (wid >= 0) & (wid < W)
-        lbf = limbs.astype(jnp.float64) if "sum" in want else None
-        planes = []
-
-        if use_mask:
-            wid32 = wid.astype(jnp.int32)
-            gidx = (block0 * SEG
-                    + jnp.arange(B * SEG, dtype=jnp.float64).reshape(
-                        values.shape))
-            st1 = {k: [] for k in ("count", "limbs", "bad", "sumsq",
-                                   "min", "min_idx", "max", "max_idx")}
-            for w in range(W):
-                mw = m0 & (wid32 == w)
-                st1["count"].append(mw.sum(axis=1, dtype=jnp.float32)
-                                    .astype(jnp.float64))
-                if "sum" in want:
-                    st1["limbs"].append(jnp.where(
-                        mw[:, :, None], lbf, 0.0).sum(axis=1))
-                    st1["bad"].append((mw & bad).any(axis=1)
-                                      .astype(jnp.float64))
-                if "sumsq" in want:
-                    vz = jnp.where(mw, values, 0.0)
-                    st1["sumsq"].append((vz * vz).sum(axis=1))
-                has_rows = mw.any(axis=1)
-                if "min" in want:
-                    vm = jnp.where(mw, values, jnp.inf)
-                    mn = vm.min(axis=1)
-                    st1["min"].append(mn)
-                    # mask on row presence, not finiteness: a stored
-                    # +/-inf value is a REAL extremum whose index must
-                    # survive (only truly empty windows drop to the
-                    # sentinel); masked-out rows can't win the == test
-                    # because mw-false positions hold the identity
-                    ix = jnp.where(mw & (values == mn[:, None]), gidx,
-                                   IDX_SENTINEL).min(axis=1)
-                    st1["min_idx"].append(
-                        jnp.where(has_rows, ix, IDX_SENTINEL))
-                if "max" in want:
-                    vm = jnp.where(mw, values, -jnp.inf)
-                    mx = vm.max(axis=1)
-                    st1["max"].append(mx)
-                    ix = jnp.where(mw & (values == mx[:, None]), gidx,
-                                   IDX_SENTINEL).min(axis=1)
-                    st1["max_idx"].append(
-                        jnp.where(has_rows, ix, IDX_SENTINEL))
-            # stage 2: scatter (B*W) partials onto the cell grid
-            seg2 = (gids.astype(jnp.int32)[:, None] * W
-                    + jnp.arange(W, dtype=jnp.int32)[None, :])
-            seg2 = jnp.where(gids[:, None] >= 0, seg2,
-                             num_segments).reshape(-1)
-
-            def sc_sum(x):
-                return jax.ops.segment_sum(x, seg2, ns)[:num_segments]
-
-            def sc_min(x):
-                return jax.ops.segment_min(x, seg2, ns)[:num_segments]
-
-            def sc_max(x):
-                return jax.ops.segment_max(x, seg2, ns)[:num_segments]
-
-            def flat(name):
-                return jnp.stack(st1[name], axis=1).reshape(-1)
-
-            planes.append(sc_sum(flat("count")))
-            if "sum" in want:
-                lw = jnp.stack(st1["limbs"], axis=1).reshape(-1, K)
-                for k in range(K):
-                    planes.append(sc_sum(lw[:, k]))
-                planes.append(sc_max(flat("bad")))
-            if "sumsq" in want:
-                planes.append(sc_sum(flat("sumsq")))
-            if "min" in want:
-                mn = sc_min(flat("min"))
-                win = flat("min") == mn[seg2.reshape(gids.shape[0], W)
-                                        ].reshape(-1)
-                ix = sc_min(jnp.where(win, flat("min_idx"),
-                                      IDX_SENTINEL))
-                planes += [mn, ix]
-            if "max" in want:
-                mx = sc_max(flat("max"))
-                win = flat("max") == mx[seg2.reshape(gids.shape[0], W)
-                                        ].reshape(-1)
-                ix = sc_min(jnp.where(win, flat("max_idx"),
-                                      IDX_SENTINEL))
-                planes += [mx, ix]
-            return jnp.stack(planes)
-
-        # scatter fallback for wide windows (rare under the cell cap):
-        # i32 segment ids + f64 accumulators — the round-2 int64
-        # scatters hit the 64-bit emulation path and were ~60× slower
-        n = values.shape[0] * SEG
-        v = values.reshape(n)
-        m = m0.reshape(n)
-        lb = limbs.reshape(n, K) if "sum" in want else None
-        bd = bad.reshape(n)
-        g32 = jnp.repeat(gids.astype(jnp.int32), SEG)
-        seg = jnp.where(m, g32 * W + wid.reshape(n).astype(jnp.int32),
-                        num_segments)
-        planes.append(jax.ops.segment_sum(
-            m.astype(jnp.float64), seg, ns)[:num_segments])
-        if "sum" in want:
-            for k in range(K):
-                planes.append(jax.ops.segment_sum(
-                    jnp.where(m, lb[:, k], 0).astype(jnp.float64),
-                    seg, ns)[:num_segments])
-            planes.append(jax.ops.segment_max(
-                (m & bd).astype(jnp.float32), seg, ns)[:num_segments]
-                .astype(jnp.float64))
-        if "sumsq" in want:
-            vz = jnp.where(m, v, 0.0)
-            planes.append(jax.ops.segment_sum(vz * vz, seg,
-                                              ns)[:num_segments])
-        gidx = jnp.arange(n, dtype=jnp.float64) + block0 * SEG
-        if "min" in want:
-            ext = jax.ops.segment_min(jnp.where(m, v, jnp.inf), seg, ns)
-            at = m & (v == ext[seg])
-            planes += [ext[:num_segments],
-                       jax.ops.segment_min(
-                           jnp.where(at, gidx, IDX_SENTINEL), seg,
-                           ns)[:num_segments]]
-        if "max" in want:
-            ext = jax.ops.segment_max(jnp.where(m, v, -jnp.inf), seg, ns)
-            at = m & (v == ext[seg])
-            planes += [ext[:num_segments],
-                       jax.ops.segment_min(
-                           jnp.where(at, gidx, IDX_SENTINEL), seg,
-                           ns)[:num_segments]]
-        return jnp.stack(planes)
+        return _mask_stage(values, valid, times, limbs, bad, gids,
+                           block0, scalars,
+                           num_segments=num_segments, want=want,
+                           W=W, K=K, SEG=SEG)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f
@@ -1160,6 +1177,71 @@ def packed_u32_planes(want: tuple, K: int) -> int:
     if "max" in want:
         n += 1                                   # max_idx
     return n
+
+
+def _pack_stage(planes, *, want: tuple, K: int):
+    """Trace-composable body of _pack_kernel (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax.numpy as jnp
+
+    Wn = (18 * K + 31) // 32
+    layout = plane_layout(want, K)
+    S = planes.shape[1]
+    u32, f64 = [], []
+    bits = jnp.zeros(0, dtype=jnp.uint32)
+    i = 0
+    for name, n in layout:
+        pl = planes[i:i + n]
+        i += n
+        if name == "count":
+            u32.append((pl[0].astype(jnp.int64) & _U32M)
+                       .astype(jnp.uint32))
+        elif name == "limbs":
+            ds = [pl[k].astype(jnp.int64) for k in range(K)]
+            for k in range(K - 1, 0, -1):
+                c = ds[k] >> 18          # arithmetic = floor
+                ds[k] = ds[k] - (c << 18)
+                ds[k - 1] = ds[k - 1] + c
+            top = ds[0] >> 18
+            ds[0] = ds[0] - (top << 18)
+            u32.append(((top & _U32M)).astype(jnp.uint32))
+            # digit stream Σ d_k·2^(18(K-1-k)) sliced into 32-bit
+            # words, high word first; each word overlaps ≤3 digits
+            for j in range(Wn):
+                w = jnp.zeros(S, dtype=jnp.int64)
+                for k in range(K):
+                    sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
+                    if -18 < sh < 32:
+                        t = (ds[k] << sh) if sh >= 0 \
+                            else (ds[k] >> (-sh))
+                        w = w | (t & _U32M)
+                u32.append(w.astype(jnp.uint32))
+        elif name == "bad":
+            b = (pl[0] > 0).astype(jnp.uint32)
+            pad = (-S) % 32
+            if pad:
+                b = jnp.concatenate(
+                    [b, jnp.zeros(pad, dtype=jnp.uint32)])
+            bits = (b.reshape(-1, 32)
+                    << jnp.arange(32, dtype=jnp.uint32)[None, :]
+                    ).sum(axis=1, dtype=jnp.uint32)
+        elif name == "sumsq":
+            f64.append(pl[0])
+        elif name in ("min", "max"):
+            pass                     # host fold never reads values
+        elif name in ("min_idx", "max_idx"):
+            p = pl[0]
+            real = (p >= 0) & (p < IDX_SENTINEL)
+            iv = jnp.where(real, p, 0.0).astype(jnp.int64)
+            u32.append(jnp.where(real, iv, IDX_U32_SENTINEL)
+                       .astype(jnp.uint32))
+    out = (jnp.stack(u32), bits)
+    if f64:
+        out = out + (jnp.stack(f64),)
+    return out
 
 
 def _pack_kernel(want: tuple, K: int):
@@ -1189,66 +1271,9 @@ def _pack_kernel(want: tuple, K: int):
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
-
-    Wn = (18 * K + 31) // 32
-    layout = plane_layout(want, K)
 
     def _p(planes):
-        S = planes.shape[1]
-        u32, f64 = [], []
-        bits = jnp.zeros(0, dtype=jnp.uint32)
-        i = 0
-        for name, n in layout:
-            pl = planes[i:i + n]
-            i += n
-            if name == "count":
-                u32.append((pl[0].astype(jnp.int64) & _U32M)
-                           .astype(jnp.uint32))
-            elif name == "limbs":
-                ds = [pl[k].astype(jnp.int64) for k in range(K)]
-                for k in range(K - 1, 0, -1):
-                    c = ds[k] >> 18          # arithmetic = floor
-                    ds[k] = ds[k] - (c << 18)
-                    ds[k - 1] = ds[k - 1] + c
-                top = ds[0] >> 18
-                ds[0] = ds[0] - (top << 18)
-                u32.append(((top & _U32M)).astype(jnp.uint32))
-                # digit stream Σ d_k·2^(18(K-1-k)) sliced into 32-bit
-                # words, high word first; each word overlaps ≤3 digits
-                for j in range(Wn):
-                    w = jnp.zeros(S, dtype=jnp.int64)
-                    for k in range(K):
-                        sh = 18 * (K - 1 - k) - 32 * (Wn - 1 - j)
-                        if -18 < sh < 32:
-                            t = (ds[k] << sh) if sh >= 0 \
-                                else (ds[k] >> (-sh))
-                            w = w | (t & _U32M)
-                    u32.append(w.astype(jnp.uint32))
-            elif name == "bad":
-                b = (pl[0] > 0).astype(jnp.uint32)
-                pad = (-S) % 32
-                if pad:
-                    b = jnp.concatenate(
-                        [b, jnp.zeros(pad, dtype=jnp.uint32)])
-                bits = (b.reshape(-1, 32)
-                        << jnp.arange(32, dtype=jnp.uint32)[None, :]
-                        ).sum(axis=1, dtype=jnp.uint32)
-            elif name == "sumsq":
-                f64.append(pl[0])
-            elif name in ("min", "max"):
-                pass                     # host fold never reads values
-            elif name in ("min_idx", "max_idx"):
-                p = pl[0]
-                real = (p >= 0) & (p < IDX_SENTINEL)
-                iv = jnp.where(real, p, 0.0).astype(jnp.int64)
-                u32.append(jnp.where(real, iv, IDX_U32_SENTINEL)
-                           .astype(jnp.uint32))
-        out = (jnp.stack(u32), bits)
-        if f64:
-            out = out + (jnp.stack(f64),)
-        return out
+        return _pack_stage(planes, want=want, K=K)
 
     _p = _named_jit(_p, key)
     _JITTED[key] = _p
@@ -1266,19 +1291,17 @@ def pack_eligible(want: tuple, n_rows: int, flat_n: int) -> bool:
             and not (idx_wanted and flat_n >= _U32M))
 
 
-def _prune_kernel(want: tuple, K: int):
-    """jit row-select dropping the min/max VALUE planes from a legacy
-    f64 grid before the pull (pruned_layout) — the host fold reads only
-    the index planes, so shipping the values was pure D2H waste."""
-    key = ("prune", want, K)
-    fn = _JITTED.get(key)
-    if fn is not None:
-        return fn
-    import jax
+def _prune_stage(planes, *, want: tuple, K: int):
+    """Trace-composable body of _prune_kernel (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
     import jax.numpy as jnp
 
-    # derive the kept rows FROM pruned_layout so the device row-select
-    # and the host unpack_planes(pruned=True) can never skew
+    # derive the kept rows FROM pruned_layout so the device
+    # row-select and the host unpack_planes(pruned=True) can
+    # never skew
     kept = {name for name, _n in pruned_layout(want, K)}
     keep: list[int] = []
     i = 0
@@ -1287,9 +1310,20 @@ def _prune_kernel(want: tuple, K: int):
             keep.extend(range(i, i + n))
         i += n
     idx = np.asarray(keep, dtype=np.int32)
+    return jnp.take(planes, idx, axis=0)
+
+
+def _prune_kernel(want: tuple, K: int):
+    """jit row-select dropping the min/max VALUE planes from a legacy
+    f64 grid before the pull (pruned_layout) — the host fold reads only
+    the index planes, so shipping the values was pure D2H waste."""
+    key = ("prune", want, K)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
 
     def _p(planes):
-        return jnp.take(planes, idx, axis=0)
+        return _prune_stage(planes, want=want, K=K)
 
     _p = _named_jit(_p, key)
     _JITTED[key] = _p
@@ -1470,6 +1504,46 @@ def expand_bits(bits: np.ndarray, S: int) -> np.ndarray:
     return lanes.reshape(-1)[:S].astype(bool)
 
 
+def _finalize_stage(planes, scale_lo, *, want: tuple, K: int,
+                    k0: int, dev_mean: bool, ship_sum: bool,
+                    need_count: bool):
+    """Trace-composable body of _finalize_kernel (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax.numpy as jnp
+
+    with_sum = ("sum" in want) and (ship_sum or dev_mean)
+    S = planes.shape[1]
+    cnt = planes[0]
+    u32 = []
+    if need_count:
+        u32.append((cnt.astype(jnp.int64) & _U32M)
+                   .astype(jnp.uint32))
+    pres = None if need_count else _bits_of(cnt > 0, S)
+    flag = None
+    f64 = []
+    if with_sum:
+        full = []
+        for j in range(exactsum.K_LIMBS):
+            full.append(planes[1 + (j - k0)].astype(jnp.int64)
+                        if k0 <= j < k0 + K
+                        else jnp.zeros(S, dtype=jnp.int64))
+        out, hazard = exactsum.finalize_exact_traced(full,
+                                                     scale_lo)
+        bad = planes[1 + K] > 0
+        flag = _bits_of(hazard | bad, S)
+        if ship_sum:
+            f64.append(out)
+        if dev_mean:
+            # same operand values as the host finalize_moment
+            # (sum / max(count, 1)) — identical IEEE division
+            f64.append(out / jnp.maximum(cnt, 1.0))
+    return (jnp.stack(u32) if u32 else None, pres, flag,
+            jnp.stack(f64) if f64 else None)
+
+
 def _finalize_kernel(want: tuple, K: int, k0: int,
                      dev_mean: bool, ship_sum: bool, need_count: bool):
     """jit finalize epilogue: the device-merged f64 plane grid → the
@@ -1485,39 +1559,12 @@ def _finalize_kernel(want: tuple, K: int, k0: int,
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
-
-    with_sum = ("sum" in want) and (ship_sum or dev_mean)
 
     def _f(planes, scale_lo):
-        S = planes.shape[1]
-        cnt = planes[0]
-        u32 = []
-        if need_count:
-            u32.append((cnt.astype(jnp.int64) & _U32M)
-                       .astype(jnp.uint32))
-        pres = None if need_count else _bits_of(cnt > 0, S)
-        flag = None
-        f64 = []
-        if with_sum:
-            full = []
-            for j in range(exactsum.K_LIMBS):
-                full.append(planes[1 + (j - k0)].astype(jnp.int64)
-                            if k0 <= j < k0 + K
-                            else jnp.zeros(S, dtype=jnp.int64))
-            out, hazard = exactsum.finalize_exact_traced(full,
-                                                         scale_lo)
-            bad = planes[1 + K] > 0
-            flag = _bits_of(hazard | bad, S)
-            if ship_sum:
-                f64.append(out)
-            if dev_mean:
-                # same operand values as the host finalize_moment
-                # (sum / max(count, 1)) — identical IEEE division
-                f64.append(out / jnp.maximum(cnt, 1.0))
-        return (jnp.stack(u32) if u32 else None, pres, flag,
-                jnp.stack(f64) if f64 else None)
+        return _finalize_stage(planes, scale_lo, want=want, K=K,
+                               k0=k0, dev_mean=dev_mean,
+                               ship_sum=ship_sum,
+                               need_count=need_count)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f
@@ -1604,6 +1651,35 @@ def unpack_finalized(arrs, planes_dev, K: int, k0: int,
     return bo
 
 
+def _combine_stage(a, b, *, want: tuple, K: int):
+    """Trace-composable body of _pairwise_combine (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax.numpy as jnp
+
+    layout = plane_layout(want, K)
+    out = []
+    i = 0
+    for name, n in layout:
+        if name in ("min_idx", "max_idx"):
+            continue        # consumed with its value plane below
+        pa, pb = a[i:i + n], b[i:i + n]
+        i += n
+        if name in ("count", "limbs", "sumsq"):
+            out.append(pa + pb)
+        elif name == "bad":
+            out.append(jnp.maximum(pa, pb))
+        elif name in ("min", "max"):
+            better = (pb < pa) if name == "min" else (pb > pa)
+            out.append(jnp.where(better, pb, pa))
+            ia, ib = a[i:i + 1], b[i:i + 1]
+            i += 1
+            out.append(jnp.where(better, ib, ia))
+    return jnp.concatenate(out)
+
+
 def _pairwise_combine(want: tuple, K: int):
     """Device combine of two packed plane arrays (same cell grid):
     adds for count/limbs/sumsq, any for bad, min/max keep the winning
@@ -1613,30 +1689,9 @@ def _pairwise_combine(want: tuple, K: int):
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
-
-    layout = plane_layout(want, K)
 
     def _c(a, b):
-        out = []
-        i = 0
-        for name, n in layout:
-            if name in ("min_idx", "max_idx"):
-                continue        # consumed with its value plane below
-            pa, pb = a[i:i + n], b[i:i + n]
-            i += n
-            if name in ("count", "limbs", "sumsq"):
-                out.append(pa + pb)
-            elif name == "bad":
-                out.append(jnp.maximum(pa, pb))
-            elif name in ("min", "max"):
-                better = (pb < pa) if name == "min" else (pb > pa)
-                out.append(jnp.where(better, pb, pa))
-                ia, ib = a[i:i + 1], b[i:i + 1]
-                i += 1
-                out.append(jnp.where(better, ib, ia))
-        return jnp.concatenate(out)
+        return _combine_stage(a, b, want=want, K=K)
 
     _c = _named_jit(_c, key)
     _JITTED[key] = _c
@@ -1720,6 +1775,65 @@ def _kernel_prefix(num_segments: int, want: tuple, W: int, K: int,
     return _f
 
 
+def _prefix_arith_stage(valid, times, limbs, bad, gids, scalars,
+                        t0v, stepv, rowsv, *, num_segments: int,
+                        want: tuple, W: int, K: int, SEG: int,
+                        G: int):
+    """Trace-composable body of _kernel_prefix_arith (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax
+    import jax.numpy as jnp
+    t_lo, t_hi = scalars[0], scalars[1]
+    start, interval = scalars[2], scalars[3]
+    B = valid.shape[0]
+    m0 = (valid & (times >= t_lo) & (times <= t_hi)
+          & (gids >= 0)[:, None])
+
+    def ecs(d):
+        c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+        return jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int32), c], axis=1)
+
+    planes = [ecs(m0.astype(jnp.int32))]
+    if "sum" in want:
+        lz = jnp.where(m0[:, :, None], limbs, 0)
+        for k in range(K):
+            planes.append(ecs(lz[:, :, k]))
+        planes.append(ecs((m0 & bad).astype(jnp.int32)))
+    bounds = start + jnp.arange(W + 1, dtype=jnp.int64) * interval
+    num = bounds[None, :] - t0v[:, None]
+    pos = jnp.clip(
+        (num + stepv[:, None] - 1) // stepv[:, None],
+        0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
+    # flat 1D take: ~9x faster than 2D take_along_axis on the
+    # v5e's gather lowering (measured 37ms vs 340ms per slab)
+    P = len(planes)
+    cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
+    fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
+            + pos).reshape(-1)
+    g = jnp.take(cs, fidx, axis=1).reshape(P, B, W + 1)
+    d = g[:, :, 1:] - g[:, :, :-1]                # (P, B, W) i32
+    if G == 1:
+        return d.astype(jnp.float64).sum(axis=1)
+    oh = (gids[:, None]
+          == jnp.arange(G, dtype=gids.dtype)[None, :]
+          ).astype(jnp.float32)                   # (B, G)
+    hp = jax.lax.Precision.HIGHEST
+    d0 = (d & 0xFFF).astype(jnp.float32)
+    d1 = ((d >> 12) & 0xFFF).astype(jnp.float32)
+    d2 = (d >> 24).astype(jnp.float32)            # signed top
+    g0 = jnp.einsum("bg,pbw->pgw", oh, d0, precision=hp)
+    g1 = jnp.einsum("bg,pbw->pgw", oh, d1, precision=hp)
+    g2 = jnp.einsum("bg,pbw->pgw", oh, d2, precision=hp)
+    cells = (g2.astype(jnp.float64) * 16777216.0
+             + g1.astype(jnp.float64) * 4096.0
+             + g0.astype(jnp.float64))
+    return cells.reshape(P, num_segments)
+
+
 def _kernel_prefix_arith(num_segments: int, want: tuple, W: int,
                          K: int, SEG: int, G: int):
     """Wide-window reduction for CONST-DELTA blocks: no searchsorted,
@@ -1744,56 +1858,12 @@ def _kernel_prefix_arith(num_segments: int, want: tuple, W: int,
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
 
     def _f(valid, times, limbs, bad, gids, scalars, t0v, stepv, rowsv):
-        t_lo, t_hi = scalars[0], scalars[1]
-        start, interval = scalars[2], scalars[3]
-        B = valid.shape[0]
-        m0 = (valid & (times >= t_lo) & (times <= t_hi)
-              & (gids >= 0)[:, None])
-
-        def ecs(d):
-            c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
-            return jnp.concatenate(
-                [jnp.zeros((B, 1), jnp.int32), c], axis=1)
-
-        planes = [ecs(m0.astype(jnp.int32))]
-        if "sum" in want:
-            lz = jnp.where(m0[:, :, None], limbs, 0)
-            for k in range(K):
-                planes.append(ecs(lz[:, :, k]))
-            planes.append(ecs((m0 & bad).astype(jnp.int32)))
-        bounds = start + jnp.arange(W + 1, dtype=jnp.int64) * interval
-        num = bounds[None, :] - t0v[:, None]
-        pos = jnp.clip(
-            (num + stepv[:, None] - 1) // stepv[:, None],
-            0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
-        # flat 1D take: ~9x faster than 2D take_along_axis on the
-        # v5e's gather lowering (measured 37ms vs 340ms per slab)
-        P = len(planes)
-        cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
-        fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
-                + pos).reshape(-1)
-        g = jnp.take(cs, fidx, axis=1).reshape(P, B, W + 1)
-        d = g[:, :, 1:] - g[:, :, :-1]                # (P, B, W) i32
-        if G == 1:
-            return d.astype(jnp.float64).sum(axis=1)
-        oh = (gids[:, None]
-              == jnp.arange(G, dtype=gids.dtype)[None, :]
-              ).astype(jnp.float32)                   # (B, G)
-        hp = jax.lax.Precision.HIGHEST
-        d0 = (d & 0xFFF).astype(jnp.float32)
-        d1 = ((d >> 12) & 0xFFF).astype(jnp.float32)
-        d2 = (d >> 24).astype(jnp.float32)            # signed top
-        g0 = jnp.einsum("bg,pbw->pgw", oh, d0, precision=hp)
-        g1 = jnp.einsum("bg,pbw->pgw", oh, d1, precision=hp)
-        g2 = jnp.einsum("bg,pbw->pgw", oh, d2, precision=hp)
-        cells = (g2.astype(jnp.float64) * 16777216.0
-                 + g1.astype(jnp.float64) * 4096.0
-                 + g0.astype(jnp.float64))
-        return cells.reshape(P, num_segments)
+        return _prefix_arith_stage(
+            valid, times, limbs, bad, gids, scalars, t0v, stepv,
+            rowsv, num_segments=num_segments, want=want, W=W,
+            K=K, SEG=SEG, G=G)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f
@@ -1813,6 +1883,58 @@ ARITH_G_MAX = int(knobs.get("OG_ARITH_G_MAX"))
 
 # per-slab byte cap for the pulled window lattice (P·B·WL·4)
 LATTICE_MAX_BYTES = int(knobs.get("OG_LATTICE_MAX_MB")) * (1 << 20)
+
+
+def _lattice_stage(valid, times, limbs, bad, gids, scalars, t0v,
+                   stepv, rowsv, *, want: tuple, K: int, SEG: int,
+                   WL: int, W: int):
+    """Trace-composable body of _kernel_lattice (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax.numpy as jnp
+    t_lo, t_hi = scalars[0], scalars[1]
+    start, interval = scalars[2], scalars[3]
+    B = valid.shape[0]
+    m0 = (valid & (times >= t_lo) & (times <= t_hi)
+          & (gids >= 0)[:, None])
+
+    def ecs(d):
+        c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+        return jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int32), c], axis=1)
+
+    planes = [ecs(m0.astype(jnp.int32))]
+    if "sum" in want:
+        lz = jnp.where(m0[:, :, None], limbs, 0)
+        for k in range(K):
+            planes.append(ecs(lz[:, :, k]))
+        planes.append(ecs((m0 & bad).astype(jnp.int32)))
+    # same formula as the host fold's w0 (fold_lattices)
+    w0 = jnp.clip((jnp.maximum(t0v, start) - start) // interval,
+                  0, W - 1)
+    wj = jnp.minimum(
+        w0[:, None] + jnp.arange(WL + 1, dtype=jnp.int64)[None, :],
+        W)
+    bounds = start + wj * interval
+    num = bounds - t0v[:, None]
+    pos = jnp.clip(
+        (num + stepv[:, None] - 1) // stepv[:, None],
+        0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
+    P = len(planes)
+    cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
+    fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
+            + pos).reshape(-1)
+    g = jnp.take(cs, fidx, axis=1).reshape(P, B, WL + 1)
+    d = g[:, :, 1:] - g[:, :, :-1]
+    # slim transport: counts fit int8 (<= rows/window, guarded by
+    # lattice_eligible's R bound), bad bits fit bool — 32B/entry
+    # -> 4K+2 bytes (the pull IS the wall on the tunnel link)
+    if "sum" in want:
+        return (d[0].astype(jnp.int8), d[1:1 + K],
+                (d[1 + K] != 0))
+    return (d[0].astype(jnp.int8),)
 
 
 def _kernel_lattice(want: tuple, K: int, SEG: int, WL: int, W: int):
@@ -1844,51 +1966,11 @@ def _kernel_lattice(want: tuple, K: int, SEG: int, WL: int, W: int):
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
 
     def _f(valid, times, limbs, bad, gids, scalars, t0v, stepv, rowsv):
-        t_lo, t_hi = scalars[0], scalars[1]
-        start, interval = scalars[2], scalars[3]
-        B = valid.shape[0]
-        m0 = (valid & (times >= t_lo) & (times <= t_hi)
-              & (gids >= 0)[:, None])
-
-        def ecs(d):
-            c = jnp.cumsum(d, axis=1, dtype=jnp.int32)
-            return jnp.concatenate(
-                [jnp.zeros((B, 1), jnp.int32), c], axis=1)
-
-        planes = [ecs(m0.astype(jnp.int32))]
-        if "sum" in want:
-            lz = jnp.where(m0[:, :, None], limbs, 0)
-            for k in range(K):
-                planes.append(ecs(lz[:, :, k]))
-            planes.append(ecs((m0 & bad).astype(jnp.int32)))
-        # same formula as the host fold's w0 (fold_lattices)
-        w0 = jnp.clip((jnp.maximum(t0v, start) - start) // interval,
-                      0, W - 1)
-        wj = jnp.minimum(
-            w0[:, None] + jnp.arange(WL + 1, dtype=jnp.int64)[None, :],
-            W)
-        bounds = start + wj * interval
-        num = bounds - t0v[:, None]
-        pos = jnp.clip(
-            (num + stepv[:, None] - 1) // stepv[:, None],
-            0, rowsv[:, None].astype(jnp.int64)).astype(jnp.int32)
-        P = len(planes)
-        cs = jnp.stack(planes).reshape(P, B * (SEG + 1))
-        fidx = (jnp.arange(B, dtype=jnp.int32)[:, None] * (SEG + 1)
-                + pos).reshape(-1)
-        g = jnp.take(cs, fidx, axis=1).reshape(P, B, WL + 1)
-        d = g[:, :, 1:] - g[:, :, :-1]
-        # slim transport: counts fit int8 (<= rows/window, guarded by
-        # lattice_eligible's R bound), bad bits fit bool — 32B/entry
-        # -> 4K+2 bytes (the pull IS the wall on the tunnel link)
-        if "sum" in want:
-            return (d[0].astype(jnp.int8), d[1:1 + K],
-                    (d[1 + K] != 0))
-        return (d[0].astype(jnp.int8),)
+        return _lattice_stage(valid, times, limbs, bad, gids,
+                              scalars, t0v, stepv, rowsv, want=want,
+                              K=K, SEG=SEG, WL=WL, W=W)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f
@@ -2045,6 +2127,7 @@ def fold_lattices(entries: list, gids_by_entry: list, start: int,
 
 # -------------------------------------------- on-device lattice fold
 
+
 def lattice_fold_on_device() -> bool:
     """Gate for folding window lattices ON DEVICE before the pull
     (OG_LATTICE_DEVICE_FOLD, default on): lattice entries ≥ result
@@ -2098,6 +2181,29 @@ def cached_cells(cells: np.ndarray):
     return dev
 
 
+def _lattice_fold_stage(c8, l32, b8, cells, *, num_segments: int,
+                        want: tuple, K: int, sorted_cells: bool):
+    """Trace-composable body of _kernel_lattice_fold (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax
+    import jax.numpy as jnp
+
+    ns = num_segments + 1
+    with_sum = "sum" in want
+    parts = [c8.astype(jnp.float64).reshape(-1)]
+    if with_sum:
+        lf = l32.astype(jnp.float64).reshape(K, -1)
+        parts += [lf[k] for k in range(K)]
+        parts.append(b8.astype(jnp.float64).reshape(-1))
+    data = jnp.stack(parts, axis=1)              # (B·WL, P)
+    out = jax.ops.segment_sum(data, cells, ns,
+                              indices_are_sorted=sorted_cells)
+    return out[:num_segments].T                  # (P, S)
+
+
 def _kernel_lattice_fold(num_segments: int, want: tuple, K: int,
                          sorted_cells: bool):
     """jit: one slab's lattice (the _kernel_lattice output) scattered
@@ -2114,22 +2220,12 @@ def _kernel_lattice_fold(num_segments: int, want: tuple, K: int,
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax
-    import jax.numpy as jnp
-
-    ns = num_segments + 1
-    with_sum = "sum" in want
 
     def _f(c8, l32, b8, cells):
-        parts = [c8.astype(jnp.float64).reshape(-1)]
-        if with_sum:
-            lf = l32.astype(jnp.float64).reshape(K, -1)
-            parts += [lf[k] for k in range(K)]
-            parts.append(b8.astype(jnp.float64).reshape(-1))
-        data = jnp.stack(parts, axis=1)              # (B·WL, P)
-        out = jax.ops.segment_sum(data, cells, ns,
-                                  indices_are_sorted=sorted_cells)
-        return out[:num_segments].T                  # (P, S)
+        return _lattice_fold_stage(c8, l32, b8, cells,
+                                   num_segments=num_segments,
+                                   want=want, K=K,
+                                   sorted_cells=sorted_cells)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f
@@ -2450,6 +2546,7 @@ def gather_exact_values(slabs: list[BlockStack], reader,
 
 # ----------------------- device order-statistic (sketch) finalize
 
+
 def device_sketch_on() -> bool:
     """Gate for the device order-statistic finalize of raw-slice
     aggregates (percentile/median/mode) over HBM-resident sorted-
@@ -2625,12 +2722,71 @@ def rawfin_grids(sv_dev, sid_dev, num_segments: int,
 
 # ------------------------------------ device ORDER BY / LIMIT cut
 
+
 def _unbits_of(bits, S: int):
     """Traced inverse of _bits_of → bool (S,)."""
     import jax.numpy as jnp
     lanes = ((bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :])
              & 1)
     return lanes.reshape(-1)[:S].astype(bool)
+
+
+def _topk_stage(u32, pres_bits, flag_bits, f64, *, G: int, W: int,
+                kk: int, desc: bool, offset: int, null_fill: bool,
+                need_count: bool, has_flag: bool, n_f64: int):
+    """Trace-composable body of _kernel_topk (round 17): a pure
+    function of traced operands + static keyword config that the
+    fused program tracer (ops/fused.py) inlines into one jit
+    body; the staged factory jit-wraps exactly this call — one
+    definition, bit-identical on both routes."""
+    import jax.numpy as jnp
+
+    S = G * W
+    BIG = W + kk + 2
+    wdt = jnp.uint16 if W <= 0xFFFF else jnp.int32
+    if need_count:
+        cnt = u32[0].astype(jnp.int64)
+        present = (cnt > 0).reshape(G, W)
+    else:
+        present = _unbits_of(pres_bits, S).reshape(G, W)
+    emit = jnp.ones((G, W), dtype=bool) if null_fill else present
+    if desc:
+        # suffix count: the highest emitting window ranks 1
+        rank = jnp.cumsum(emit[:, ::-1], axis=1)[:, ::-1]
+        rank = jnp.where(emit, rank, 0)
+    else:
+        rank = jnp.where(emit, jnp.cumsum(emit, axis=1), 0)
+    keyv = jnp.where(emit & (rank > offset)
+                     & (rank <= offset + kk),
+                     rank - offset, BIG).astype(jnp.int32)
+    order = jnp.argsort(keyv, axis=1, stable=True)[:, :kk]
+    kw = jnp.take_along_axis(keyv, order, axis=1)
+    win = kw <= kk                       # rank prefix per group
+    widx = jnp.where(win, order, 0).astype(wdt)
+    safe = jnp.maximum(order, 0)
+    nwin = win.sum(axis=1).astype(jnp.int32)
+    wpres = jnp.take_along_axis(present, safe, axis=1) & win
+    outs = [widx, nwin]
+    if null_fill:
+        # fill=null emits rows for empty windows, so winner
+        # presence and the group-has-any-data gate must ship
+        # (fill=none winners are present by construction)
+        outs.append(_bits_of(wpres.reshape(-1), G * kk))
+        outs.append(_bits_of(present.any(axis=1), G))
+    if need_count:
+        outs.append(jnp.where(
+            wpres, jnp.take_along_axis(cnt.reshape(G, W), safe,
+                                       axis=1), 0)
+            .astype(jnp.uint32))
+    if has_flag:
+        flags = _unbits_of(flag_bits, S).reshape(G, W)
+        wf = jnp.take_along_axis(flags, safe, axis=1) & wpres
+        outs.append(_bits_of(wf.reshape(-1), G * kk))
+    if n_f64:
+        fw = [jnp.take_along_axis(f64[i].reshape(G, W), safe,
+                                  axis=1) for i in range(n_f64)]
+        outs.append(jnp.stack(fw))
+    return tuple(outs)
 
 
 def _kernel_topk(G: int, W: int, kk: int, desc: bool, offset: int,
@@ -2654,56 +2810,13 @@ def _kernel_topk(G: int, W: int, kk: int, desc: bool, offset: int,
     fn = _JITTED.get(key)
     if fn is not None:
         return fn
-    import jax.numpy as jnp
-
-    S = G * W
-    BIG = W + kk + 2
-    wdt = jnp.uint16 if W <= 0xFFFF else jnp.int32
 
     def _f(u32, pres_bits, flag_bits, f64):
-        if need_count:
-            cnt = u32[0].astype(jnp.int64)
-            present = (cnt > 0).reshape(G, W)
-        else:
-            present = _unbits_of(pres_bits, S).reshape(G, W)
-        emit = jnp.ones((G, W), dtype=bool) if null_fill else present
-        if desc:
-            # suffix count: the highest emitting window ranks 1
-            rank = jnp.cumsum(emit[:, ::-1], axis=1)[:, ::-1]
-            rank = jnp.where(emit, rank, 0)
-        else:
-            rank = jnp.where(emit, jnp.cumsum(emit, axis=1), 0)
-        keyv = jnp.where(emit & (rank > offset)
-                         & (rank <= offset + kk),
-                         rank - offset, BIG).astype(jnp.int32)
-        order = jnp.argsort(keyv, axis=1, stable=True)[:, :kk]
-        kw = jnp.take_along_axis(keyv, order, axis=1)
-        win = kw <= kk                       # rank prefix per group
-        widx = jnp.where(win, order, 0).astype(wdt)
-        safe = jnp.maximum(order, 0)
-        nwin = win.sum(axis=1).astype(jnp.int32)
-        wpres = jnp.take_along_axis(present, safe, axis=1) & win
-        outs = [widx, nwin]
-        if null_fill:
-            # fill=null emits rows for empty windows, so winner
-            # presence and the group-has-any-data gate must ship
-            # (fill=none winners are present by construction)
-            outs.append(_bits_of(wpres.reshape(-1), G * kk))
-            outs.append(_bits_of(present.any(axis=1), G))
-        if need_count:
-            outs.append(jnp.where(
-                wpres, jnp.take_along_axis(cnt.reshape(G, W), safe,
-                                           axis=1), 0)
-                .astype(jnp.uint32))
-        if has_flag:
-            flags = _unbits_of(flag_bits, S).reshape(G, W)
-            wf = jnp.take_along_axis(flags, safe, axis=1) & wpres
-            outs.append(_bits_of(wf.reshape(-1), G * kk))
-        if n_f64:
-            fw = [jnp.take_along_axis(f64[i].reshape(G, W), safe,
-                                      axis=1) for i in range(n_f64)]
-            outs.append(jnp.stack(fw))
-        return tuple(outs)
+        return _topk_stage(u32, pres_bits, flag_bits, f64, G=G,
+                           W=W, kk=kk, desc=desc, offset=offset,
+                           null_fill=null_fill,
+                           need_count=need_count,
+                           has_flag=has_flag, n_f64=n_f64)
 
     _f = _named_jit(_f, key)
     _JITTED[key] = _f

@@ -276,6 +276,15 @@ def _traced_inverse(r, refs, scale, transform: int, kind: str):
         u, jnp.float64 if kind == "f64" else jnp.int64)
 
 
+def dfor_finish_stage(r32, refs, scale, *, transform: int, kind: str):
+    """Trace-composable inverse-transform epilogue over Pallas-
+    unpacked u32 residuals (round 17): pure traced-operand function
+    the fused program tracer (ops/fused.py) can inline; _finish_fn
+    jit-wraps exactly this call."""
+    return _traced_inverse(r32.astype(_U64), refs, scale,
+                           transform, kind)
+
+
 def _finish_fn(transform: int, kind: str, n: int):
     """jit inverse-transform epilogue over Pallas-unpacked u32
     residuals (the decimal scale rides as a traced operand, so one
@@ -284,10 +293,23 @@ def _finish_fn(transform: int, kind: str, n: int):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(r32, refs, scale):
-            return _traced_inverse(r32.astype(_U64), refs, scale,
-                                   transform, kind)
+            return dfor_finish_stage(r32, refs, scale,
+                                     transform=transform, kind=kind)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn
+
+
+def dfor_wide_stage(words, refs, scale, *, n: int, width: int,
+                    transform: int, kind: str):
+    """Trace-composable u64 unpack + inverse transform (round 17):
+    the _wide_fn body as a pure traced-operand function the fused
+    program tracer can inline."""
+    if width == 0:
+        nb = words.shape[0]
+        r = jnp.zeros((nb, n), dtype=_U64)
+    else:
+        r = _traced_unpack_wide(words, n, width)
+    return _traced_inverse(r, refs, scale, transform, kind)
 
 
 def _wide_fn(transform: int, kind: str, n: int, width: int):
@@ -297,12 +319,9 @@ def _wide_fn(transform: int, kind: str, n: int, width: int):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(words, refs, scale):
-            if width == 0:
-                nb = words.shape[0]
-                r = jnp.zeros((nb, n), dtype=_U64)
-            else:
-                r = _traced_unpack_wide(words, n, width)
-            return _traced_inverse(r, refs, scale, transform, kind)
+            return dfor_wide_stage(words, refs, scale, n=n,
+                                   width=width, transform=transform,
+                                   kind=kind)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn
 
@@ -356,11 +375,16 @@ def times_expand_batch(t0s_dev, steps_dev, rows_dev, seg: int):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(t0s, steps, rows):
-            i = jnp.arange(seg, dtype=jnp.int64)[None, :]
-            t = t0s[:, None] + steps[:, None] * i
-            return jnp.where(i < rows[:, None], t, I64MAX)
+            return times_stage(t0s, steps, rows, seg=seg)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(t0s_dev, steps_dev, rows_dev)
+
+
+def times_stage(t0s, steps, rows, *, seg: int):
+    """Trace-composable body of times_expand_batch (round 17)."""
+    i = jnp.arange(seg, dtype=jnp.int64)[None, :]
+    t = t0s[:, None] + steps[:, None] * i
+    return jnp.where(i < rows[:, None], t, I64MAX)
 
 
 def validity_expand_batch(bits_dev, const_dev, rows_dev, seg: int):
@@ -374,16 +398,21 @@ def validity_expand_batch(bits_dev, const_dev, rows_dev, seg: int):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(bits, const, rows):
-            i = jnp.arange(seg, dtype=jnp.int32)[None, :]
-            byte = jnp.take(bits, np.arange(seg, dtype=np.int32) >> 3,
-                            axis=1)
-            sh = (7 - (np.arange(seg, dtype=np.int32) & 7)).astype(
-                np.uint8)
-            unpacked = ((byte >> sh[None, :]) & 1).astype(jnp.bool_)
-            from_const = i < rows[:, None]
-            return jnp.where(const[:, None], from_const, unpacked)
+            return validity_stage(bits, const, rows, seg=seg)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(bits_dev, const_dev, rows_dev)
+
+
+def validity_stage(bits, const, rows, *, seg: int):
+    """Trace-composable body of validity_expand_batch (round 17)."""
+    i = jnp.arange(seg, dtype=jnp.int32)[None, :]
+    byte = jnp.take(bits, np.arange(seg, dtype=np.int32) >> 3,
+                    axis=1)
+    sh = (7 - (np.arange(seg, dtype=np.int32) & 7)).astype(
+        np.uint8)
+    unpacked = ((byte >> sh[None, :]) & 1).astype(jnp.bool_)
+    from_const = i < rows[:, None]
+    return jnp.where(const[:, None], from_const, unpacked)
 
 
 def const_expand_batch(vals_dev, rows_dev, seg: int):
@@ -393,10 +422,15 @@ def const_expand_batch(vals_dev, rows_dev, seg: int):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(vals, rows):
-            i = jnp.arange(seg, dtype=jnp.int64)[None, :]
-            return jnp.where(i < rows[:, None], vals[:, None], 0.0)
+            return const_stage(vals, rows, seg=seg)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(vals_dev, rows_dev)
+
+
+def const_stage(vals, rows, *, seg: int):
+    """Trace-composable body of const_expand_batch (round 17)."""
+    i = jnp.arange(seg, dtype=jnp.int64)[None, :]
+    return jnp.where(i < rows[:, None], vals[:, None], 0.0)
 
 
 def fit_rows(plane_dev, seg: int, fill=None):
@@ -409,10 +443,15 @@ def fit_rows(plane_dev, seg: int, fill=None):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(x):
-            return jnp.pad(x, ((0, 0), (0, seg - r)),
-                           constant_values=0 if fill is None else fill)
+            return fit_stage(x, r=r, seg=seg, fill=fill)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(plane_dev)
+
+
+def fit_stage(x, *, r: int, seg: int, fill=None):
+    """Trace-composable body of fit_rows (round 17)."""
+    return jnp.pad(x, ((0, 0), (0, seg - r)),
+                   constant_values=0 if fill is None else fill)
 
 
 def permute_blocks(plane_dev, perm_dev):
@@ -422,9 +461,14 @@ def permute_blocks(plane_dev, perm_dev):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(p, idx):
-            return jnp.take(p, idx, axis=0)
+            return permute_stage(p, idx)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(plane_dev, perm_dev)
+
+
+def permute_stage(p, idx):
+    """Trace-composable body of permute_blocks (round 17)."""
+    return jnp.take(p, idx, axis=0)
 
 
 def limbs_decompose(values_dev, valid_dev, scale0):
@@ -444,27 +488,35 @@ def limbs_decompose(values_dev, valid_dev, scale0):
     fn = _JITTED.get(key)
     if fn is None:
         def _f(v, valid, s0):
-            finite = jnp.isfinite(v)
-            a = jnp.abs(jnp.where(finite, v, 0.0))
-            sign = jnp.where(v < 0, -1.0, 1.0)
-            limbs = []
-            s = s0
-            for _k in range(K):
-                b = jnp.floor(a / s)
-                b = jnp.minimum(b, float(exactsum._RADIX - 1))
-                a = a - b * s
-                limbs.append(sign * b)
-                s = s * (1.0 / exactsum._RADIX)
-            res = jnp.where(finite, sign * a, jnp.nan)
-            bad = (res != 0.0) | ~jnp.isfinite(res)
-            lb = jnp.stack(limbs, axis=-1)
-            lb = jnp.where(valid[..., None], lb, 0.0)
-            bad = bad & valid
-            lb32 = lb.astype(jnp.int32)
-            act = (lb32 != 0).any(axis=(0, 1))
-            return lb32, bad, act
+            return limbs_stage(v, valid, s0, K=K)
         fn = _JITTED[key] = _named_jit(_f, key)
     return fn(values_dev, valid_dev, scale0)
+
+
+def limbs_stage(v, valid, s0, *, K: int):
+    """Trace-composable body of limbs_decompose (round 17): the
+    traced twin of ops/exactsum.host_limbs as a pure stage function
+    the fused program tracer can inline."""
+    from . import exactsum
+    finite = jnp.isfinite(v)
+    a = jnp.abs(jnp.where(finite, v, 0.0))
+    sign = jnp.where(v < 0, -1.0, 1.0)
+    limbs = []
+    s = s0
+    for _k in range(K):
+        b = jnp.floor(a / s)
+        b = jnp.minimum(b, float(exactsum._RADIX - 1))
+        a = a - b * s
+        limbs.append(sign * b)
+        s = s * (1.0 / exactsum._RADIX)
+    res = jnp.where(finite, sign * a, jnp.nan)
+    bad = (res != 0.0) | ~jnp.isfinite(res)
+    lb = jnp.stack(limbs, axis=-1)
+    lb = jnp.where(valid[..., None], lb, 0.0)
+    bad = bad & valid
+    lb32 = lb.astype(jnp.int32)
+    act = (lb32 != 0).any(axis=(0, 1))
+    return lb32, bad, act
 
 
 # --------------------------------------------- single-block decode

@@ -48,7 +48,7 @@ Failpoint sites (utils/failpoint.py; arm with actions oom / transient
 ``device.segagg.launch``, ``device.finalize.launch``,
 ``pipeline.submit``, ``pipeline.pull``, ``pipeline.unpack``,
 ``devicecache.fill``, ``devicecache.evict``, ``hbm.reconcile``,
-``blockagg.lattice_fold``.
+``blockagg.lattice_fold``, ``device.fused.launch``.
 """
 
 from __future__ import annotations
@@ -73,7 +73,7 @@ __all__ = ["ROUTES", "DeviceRouteDown", "classify", "guarded_launch",
 # device dispatch families; each has a byte-identical host fallback the
 # executor's route gates already implement (see module doc)
 ROUTES = ("block", "lattice", "dense", "segagg", "finalize",
-          "pipeline")
+          "pipeline", "fused")
 
 DEVFAULT_STATS: dict = register_counters("devicefault", {
     "transient_errors": 0,      # classified transient device failures

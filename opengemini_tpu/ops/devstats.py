@@ -52,6 +52,13 @@ DEVICE_STATS: dict = register_counters("device", {
     "topk_cells_pulled": 0,    # k x groups winner cells that crossed
     "f32_tier_launches": 0,    # pallas dense-window fast-tier calls
     "f32_tier_rows": 0,
+    # whole-plan mega-kernel fusion (round 17): terminal big-grid
+    # plans traced end-to-end as ONE program per shape class
+    # (ops/fused.py) — launches, per-query heals back to the staged
+    # dispatch, and answer cells produced through the fused route
+    "fused_launches": 0,
+    "fused_fallbacks": 0,
+    "fused_cells": 0,
     # gauges (last completed query, not cumulative): the numbers an
     # operator needs to judge whether the pull or the kernel is the
     # current wall without attaching EXPLAIN ANALYZE
@@ -86,6 +93,10 @@ QUERY_PHASE_NS: dict = register_counters("query_phase", {
     # decode slab builds — payload staging, bit-unpack/expand kernel
     # launches, limb decomposition, compressed-tier rebuilds
     "device_decode_ns": 0,
+    # whole-plan fused execution (OG_FUSED_PLAN): the single fused
+    # program dispatch replacing lattice/fold/combine/finalize/topk
+    # launches on eligible terminal plans, plus its winner unpack
+    "fused_exec_ns": 0,
     "grid_fold_ns": 0,
     # result-cache bookkeeping (query/resultcache.py): key build,
     # epoch validation, cached-prefix trim and store — NOT the fresh

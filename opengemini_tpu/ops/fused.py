@@ -1,0 +1,152 @@
+"""Whole-plan mega-kernel fusion (round 17): ONE compiled program per
+query shape class for terminal big-grid plans.
+
+The r07/r08 phase profiles put the heavy dashboard shape's device time
+in ~6 separately launched stages (slab lattice → cell fold → cross-
+slab combine → finalize epilogue → top-k cut), each materializing its
+intermediate in HBM and crossing the dispatcher. With the transfer
+story told (packed/finalized/winner transports, compressed HBM tier),
+launch overhead and intermediate materialization are the remaining
+wall. This module traces the entire chain as ONE jit program built
+from the trace-composable stage functions the staged kernels now
+share (ops/blockagg._lattice_stage and friends — satellite of this
+round): inputs are the HBM-resident slab planes (themselves expanded
+from compressed DFOR payloads by the decode stage) plus the tiny
+traced scalars, outputs are the answer-sized finalized/top-k planes
+AND the merged plane grid (kept resident for the sparse flagged-cell
+repair pull) — no decoded lattice, merged grid, or finalize
+intermediate ever round-trips through the dispatcher between stages.
+
+Predication: WHERE time-range residuals and fill/nil handling are
+already branch-free lanes inside the stage bodies (validity masks
+multiply into the exact-limb cumsums; empty windows carry zero
+counts), so the fused body inherits the data-parallel predicated form
+— no host-side branching enters the trace.
+
+Bit-identity with the staged dispatch is by construction: every
+lattice/fold/combine value is an integer-valued f64 < 2^49 (exact,
+order-free adds), and the finalize/top-k tails are the SAME traced
+stage bodies the staged kernels jit individually — XLA does not
+reassociate f64, so fusing the composition cannot move a bit.
+
+Shape classes: the static residue of a plan (want/limb window/grid
+geometry/per-slab lattice spans/finalize recipe/top-k spec/transport
+mode) interns to a stable id in query/plancache.intern_shape_class;
+the compiled program carries the class name (og_fused_c<N>) so the
+compile auditor attributes fused compiles per class and the warm-
+compile gate can pin repeats to zero.
+
+Fault domain: the executor dispatches fused programs through
+guarded_launch route ``fused`` (failpoint site ``device.fused.launch``
+— see ops/devicefault.py); any exhausted fault heals per query to the
+staged dispatch, byte-identical, and OG_FUSED_PLAN=0 is the global
+escape hatch (query/fusedplan.py owns the gate and the plan
+compiler)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import blockagg, devstats, exactsum
+
+# compiled fused programs per shape-class key — the same role as
+# blockagg._JITTED: jit caches per (structure, shapes) underneath, this
+# dict pins one wrapper per static class so a warm repeat dispatches
+# without re-entering the builder (duplicate-compile gate clean)
+_PROGRAMS: dict = {}
+
+
+def _program_jit(fn, name: str):
+    """jit-wrap a fused whole-plan program under its shape-class name
+    (query/plancache.intern_shape_class): the compile auditor logs
+    "Compiling og_fused_c<N> ..." per class instead of blurring every
+    fused variant into one ``_prog`` row — the same attribution
+    contract as blockagg._named_jit, keyed by class id because the
+    full static key would overflow a kernel name."""
+    import jax
+    fn.__name__ = name
+    fn.__qualname__ = name
+    return jax.jit(fn)
+
+
+def program_for(key: tuple):
+    """Build (or fetch) the fused program for one shape-class key:
+
+      key = (want, K, k0, G, W, slab_specs, rec, tk, mode)
+
+    with slab_specs a tuple of per-slab (SEG, WL, sorted_cells), rec
+    the finalize transport recipe (dev_mean, ship_sum, need_count) or
+    None, tk the (kk, desc, offset, null_fill) top-k spec or None, and
+    mode one of "merge" | "fin" | "topk". Mode "merge" ends at the
+    combined plane grid (the caller ships it through the ordinary
+    staged pack_grid — the rare non-finalizable corner stays two
+    launches); "fin"/"topk" run the finalize epilogue (and the cut)
+    in-trace and the answer planes come out of the single program.
+
+    The program takes (slab_args, scalars, scale_lo) — slab_args a
+    tuple of per-slab (valid, times, limbs, bad, gids, t0v, stepv,
+    rowsv, cells) traced operands — and returns (merged, fin, cut):
+    the merged (P, G·W) plane grid (stays resident for sparse repair),
+    the finalize transport tuple (mode "fin") and the top-k winner
+    tuple (mode "topk"). Unused outputs are None."""
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    want, K, k0, G, W, slab_specs, rec, tk, mode = key
+    num_segments = G * W
+
+    def _prog(slab_args, scalars, scale_lo):
+        merged = None
+        for (SEG, WL, srt), args in zip(slab_specs, slab_args):
+            (valid, times, limbs, bad, g, t0v, stepv, rowsv,
+             cells) = args
+            d = blockagg._lattice_stage(
+                valid, times, limbs, bad, g, scalars, t0v, stepv,
+                rowsv, want=want, K=K, SEG=SEG, WL=WL, W=W)
+            o = blockagg._lattice_fold_stage(
+                d[0], d[1] if len(d) > 1 else None,
+                d[2] if len(d) > 2 else None, cells,
+                num_segments=num_segments, want=want, K=K,
+                sorted_cells=srt)
+            merged = o if merged is None \
+                else blockagg._combine_stage(merged, o, want=want,
+                                             K=K)
+        if mode == "merge":
+            return (merged, None, None)
+        dm, ss, nc = rec
+        fin = blockagg._finalize_stage(
+            merged, scale_lo, want=want, K=K, k0=k0, dev_mean=dm,
+            ship_sum=ss, need_count=nc)
+        if mode == "fin":
+            return (merged, fin, None)
+        # mode "topk": the finalize transport feeds the cut in-trace;
+        # its static layout derives from the recipe exactly as the
+        # staged topk_cut derives it from finalize_grid's outputs
+        with_sum = ("sum" in want) and (ss or dm)
+        kk, desc, offset, null_fill = tk
+        cut = blockagg._topk_stage(
+            fin[0], fin[1], fin[2], fin[3], G=G, W=W, kk=kk,
+            desc=desc, offset=offset, null_fill=null_fill,
+            need_count=nc, has_flag=with_sum,
+            n_f64=(int(ss) + int(dm)) if with_sum else 0)
+        return (merged, None, cut)
+
+    from ..query import plancache
+    _sid, name = plancache.intern_shape_class(key)
+    _prog = _program_jit(_prog, name)
+    _PROGRAMS[key] = _prog
+    return _prog
+
+
+def fused_launch(key: tuple, slab_args: tuple, scalars, E: int):
+    """ONE device dispatch for a whole (field, scale) group: launch
+    the shape class's fused program over the resident slab planes.
+    The limb scale rides as the traced ``scale_lo`` operand (one
+    compiled class serves every E — same contract as the staged
+    finalize). Counts one kernel launch: that is the point."""
+    fn = program_for(key)
+    scale_lo = np.float64(2.0 ** float(E - exactsum.SPAN_BITS))
+    out = fn(slab_args, scalars, scale_lo)
+    devstats.bump("kernel_launches")
+    devstats.bump("fused_launches")
+    return out

@@ -2079,6 +2079,28 @@ class QueryExecutor:
                                 blockagg.lattice_fold_on_device()
                                 and _route_on("lattice"))
                         return _lat_fold_memo[0]
+                    # whole-plan fused execution (round 17,
+                    # OG_FUSED_PLAN): TERMINAL lattice-eligible groups
+                    # defer here and dispatch as ONE compiled program
+                    # per shape class (query/fusedplan.py) once the
+                    # finalize/top-k transport is known — the staged
+                    # lattice/fold/combine/finalize/cut launches
+                    # collapse into a single device dispatch. Only a
+                    # terminal partial may fuse (the fused tail emits
+                    # answer transports); route consult LAST +
+                    # memoized, same probe economy as lat_dev_fold()
+                    from . import fusedplan as _fpl
+                    fused_jobs: dict = {}   # lkey → [(slabs, gids)]
+                    fused_rows: dict = {}
+                    _fused_memo: list = []
+
+                    def fused_route() -> bool:
+                        if not _fused_memo:
+                            _fused_memo.append(
+                                terminal and _fpl.fused_plan_on()
+                                and blockagg.lattice_fold_on_device()
+                                and _route_on("fused"))
+                        return _fused_memo[0]
                     from ..ops.exactsum import K_LIMBS as _KLq
                     lat_lock = __import__("threading").Lock()
 
@@ -2168,6 +2190,15 @@ class QueryExecutor:
                                 wf = want_of(fname)
                                 lkey = (fname, sl[0].E, sl[0].k0,
                                         sl[0].limbs.shape[-1])
+                                if fused_route():
+                                    fused_jobs.setdefault(
+                                        lkey, []).append(
+                                        (sl, gid_arr))
+                                    fused_rows[lkey] = (
+                                        fused_rows.get(lkey, 0)
+                                        + sum(st.n_rows
+                                              for st in sl))
+                                    continue
                                 if lat_dev_fold():
                                     folded = _sched_launch(
                                         "lattice",
@@ -2316,7 +2347,8 @@ class QueryExecutor:
                         fin_ok = _route_on("finalize")
                     field_nkeys: dict = {}
                     for (fname, _E, _k0, _ka) in (list(merged_by)
-                                                  + list(lat_dev_acc)):
+                                                  + list(lat_dev_acc)
+                                                  + list(fused_jobs)):
                         field_nkeys[fname] = \
                             field_nkeys.get(fname, 0) + 1
                     # device ORDER BY/LIMIT cut (OG_DEVICE_TOPK): when
@@ -2336,7 +2368,8 @@ class QueryExecutor:
                             and plan.get("limit", True)
                             and blockagg.device_topk_on()
                             and _eff_fill in ("none", "null")
-                            and len(merged_by) + len(lat_dev_acc) == 1
+                            and len(merged_by) + len(lat_dev_acc)
+                            + len(fused_jobs) == 1
                             and not fields_perfile
                             and all(a.field is not None
                                     for a in aggs)
@@ -2426,6 +2459,111 @@ class QueryExecutor:
                         _emit_merged(fname, _E, _k0, _ka, out,
                                      lat_dev_rows[(fname, _E, _k0,
                                                    _ka)])
+                    # fused whole-plan groups: the entire
+                    # lattice→fold→combine→finalize→top-k chain is ONE
+                    # program dispatch per (field, scale) group. An
+                    # exhausted fault on route "fused" heals THIS
+                    # query to the staged per-file chain — the same
+                    # launches OG_FUSED_PLAN=0 would have issued, so
+                    # the heal is byte-identical by construction.
+                    n_fused = 0
+                    fused_ns = 0
+                    _t_fu0 = _now_ns()
+                    from ..ops.devicefault import \
+                        DeviceRouteDown as _RouteDown
+                    for lkey, jb in fused_jobs.items():
+                        fname, _E, _k0, _ka = lkey
+                        nrows = fused_rows[lkey]
+                        wf = want_of(fname)
+                        fin_allowed = (
+                            fin_ok and fname not in fields_perfile
+                            and field_nkeys.get(fname) == 1)
+                        _t_f0 = _now_ns()
+                        try:
+                            mode, rec, out3 = _sched_launch(
+                                "fused",
+                                lambda jb=jb, fname=fname, wf=wf,
+                                _E=_E, _k0=_k0, _ka=_ka,
+                                fin_allowed=fin_allowed,
+                                nrows=nrows:
+                                _fpl.run_fused_group(
+                                    jb, want=wf, K=_ka, k0=_k0,
+                                    E=_E, start=int(start),
+                                    interval=int(interval_eff),
+                                    G=G, W=W, scalars=scalars,
+                                    ops=field_ops.get(fname, set()),
+                                    fin_allowed=fin_allowed,
+                                    topk_spec=(topk_spec
+                                               if fin_allowed
+                                               else None),
+                                    nrows=nrows),
+                                ctx=ctx, span=span)
+                        except _RouteDown as e:
+                            if e.route != "fused":
+                                raise
+                            _dstat.bump("fused_fallbacks")
+                            healed = None
+                            comb = blockagg._pairwise_combine(wf,
+                                                              _ka)
+                            for sl, gid_arr in jb:
+                                folded = _sched_launch(
+                                    "lattice",
+                                    lambda sl=sl, gid_arr=gid_arr,
+                                    wf=wf:
+                                    blockagg.file_lattice_fold(
+                                        sl, gid_arr, t_lo, t_hi,
+                                        int(start),
+                                        int(interval_eff),
+                                        W, G * W, wf,
+                                        scalars=scalars,
+                                        gids_dev=
+                                        blockagg.cached_gids(
+                                            gid_arr)),
+                                    ctx=ctx, span=span)
+                                healed = folded if healed is None \
+                                    else comb(healed, folded)
+                            fused_ns += _now_ns() - _t_f0
+                            _emit_merged(fname, _E, _k0, _ka,
+                                         healed, nrows)
+                            continue
+                        n_fused += 1
+                        merged, fin4, cut = out3
+                        if mode == "topk":
+                            dm, ss, nc = rec
+                            _emit(fname, None,
+                                  _TopkMeta(_E, _k0, _ka, dm, ss,
+                                            nc, G, W, merged,
+                                            topk_spec["kk"],
+                                            topk_spec["desc"],
+                                            topk_spec["offset"],
+                                            topk_spec["null_fill"]),
+                                  ("k",) + cut)
+                        elif mode == "fin":
+                            dm, ss, nc = rec
+                            _emit(fname, None,
+                                  _FinMeta(_E, _k0, _ka, dm, ss,
+                                           nc, G * W, merged),
+                                  ("f",) + fin4)
+                        else:
+                            # non-finalizable corner: ship the fused
+                            # merged grid through the ordinary staged
+                            # transport (second launch — still ≤ 2)
+                            _emit(fname, None,
+                                  _BlockMeta(_E, _k0, _ka),
+                                  blockagg.pack_grid(
+                                      merged, wf, _ka, nrows, 0,
+                                      prune_legacy=fin_gate))
+                        fused_ns += _now_ns() - _t_f0
+                    if fused_jobs:
+                        _dstat.bump_phase("fused_exec", fused_ns)
+                        if span is not None:
+                            fup = span.child("fused_exec")
+                            fup.start_ns = _t_fu0
+                            fup.end_ns = _t_fu0 + fused_ns
+                            fup.add(groups=len(fused_jobs),
+                                    fused=n_fused,
+                                    healed=(len(fused_jobs)
+                                            - n_fused))
                     if n_fin:
                         _dstat.bump_phase("device_finalize", fin_ns)
                         if span is not None:

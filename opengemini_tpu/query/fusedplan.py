@@ -1,0 +1,113 @@
+"""Scan-plan compiler for whole-plan fused execution (round 17).
+
+The executor's big-grid lattice route dispatches a terminal plan as a
+chain of staged launches — per-slab lattice kernel, cell fold,
+cross-file combine, finalize epilogue, top-k cut — each one a separate
+compiled program with its intermediate materialized in HBM and control
+bouncing back through the Python dispatcher. This module compiles that
+WHOLE chain down to one shape-class key + one traced-operand bundle
+and hands it to ops/fused.py, which jits the composition as a single
+program. The host work left on the query path is exactly what the
+staged route already does per slab (window spans, the lattice cell
+index, the content-keyed uploads); everything between "slabs resident"
+and "answer planes resident" becomes one device dispatch.
+
+Planning is deliberately dumb: there is no cost model and no search.
+A plan either matches the fused template (terminal + lattice-eligible
++ device fold on + the ``fused`` breaker route closed) or it runs
+staged — and OG_FUSED_PLAN=0 turns the template off entirely. Both
+routes compute bit-identical bytes (same stage bodies, exact integer
+limb arithmetic), so route choice is purely a launch-count/perf
+decision, never a correctness one."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import blockagg, devstats, fused
+from ..utils import knobs
+
+
+def fused_plan_on() -> bool:
+    """OG_FUSED_PLAN gate, read dynamically (perf_smoke diffs the
+    fused and staged routes digest-for-digest in one process)."""
+    return bool(knobs.get("OG_FUSED_PLAN"))
+
+
+def transport_mode(ops: set, fin_allowed: bool, topk_spec,
+                   nrows: int):
+    """Pick the fused program's terminal transport — (mode, rec) —
+    mirroring the staged emit ladder decision for decision:
+    finalize_grid's recipe+row-cap gate, then topk_cut on top of a
+    finalized plane-set. A group that cannot finalize on device runs
+    the program in "merge" mode and the executor ships the combined
+    grid through the ordinary staged pack_grid — the SAME transport
+    the staged route would pick, so the emitted bytes cannot differ."""
+    rec = None
+    if fin_allowed:
+        rec = blockagg.finalize_fops(ops)
+        if rec is not None and nrows >= (1 << 28):
+            rec = None                 # finalize_grid's count-plane cap
+    if rec is not None:
+        return ("topk" if topk_spec else "fin"), rec
+    return "merge", None
+
+
+def compile_group(jobs: list, *, want: tuple, K: int, start: int,
+                  interval: int, W: int, num_segments: int):
+    """Lower one (field, scale) group — [(slabs, gid_arr)] per file —
+    to (slab_specs, slab_args): the static shape residue and the
+    traced operand bundle of the fused program, in the exact slab
+    order the staged file_lattice_fold + cross-file combine would
+    visit (exact integer adds make the fold order-free bitwise, but
+    keeping the order identical keeps the claim trivial).
+
+    Host-side per slab: the window spans and flat cell index (same
+    helpers the staged route calls), plus the content-keyed gid/cell
+    uploads — warm repeats upload nothing, cold ones book their bytes
+    into the transfer manifest exactly as staged."""
+    slab_specs: list = []
+    slab_args: list = []
+    for sl, gid_arr in jobs:
+        ga = np.asarray(gid_arr, dtype=np.int64)
+        gids_dev = blockagg.cached_gids(ga)
+        for st in sl:
+            gh = ga[st.block0:st.block0 + st.n_blocks]
+            g = gids_dev[st.block0:st.block0 + st.n_blocks]
+            _w0, _wl, WL = blockagg._prefix_spans(
+                st, gh, start, interval, W)
+            cells = blockagg._lattice_cells(
+                st, gh, start, interval, W, WL, num_segments)
+            srt = bool(np.all(cells[:-1] <= cells[1:])) \
+                if len(cells) else True
+            slab_specs.append((int(st.seg_rows), int(WL), srt))
+            slab_args.append(
+                (st.valid, st.times, st.limbs, st.bad, g,
+                 st.t0_dev, st.step_dev, st.rows_dev,
+                 blockagg.cached_cells(cells)))
+    return tuple(slab_specs), tuple(slab_args)
+
+
+def run_fused_group(jobs: list, *, want: tuple, K: int, k0: int,
+                    E: int, start: int, interval: int, G: int, W: int,
+                    scalars, ops: set, fin_allowed: bool, topk_spec,
+                    nrows: int):
+    """Execute one (field, scale) group through the fused route:
+    compile to a shape class, dispatch ONE program, return
+    (mode, rec, (merged, fin, tail)). Raises whatever the program
+    launch raises — the executor wraps this in guarded_launch route
+    ``fused`` and heals an exhausted fault back to the staged chain
+    for this query only."""
+    num_segments = G * W
+    slab_specs, slab_args = compile_group(
+        jobs, want=want, K=K, start=start, interval=interval, W=W,
+        num_segments=num_segments)
+    mode, rec = transport_mode(ops, fin_allowed, topk_spec, nrows)
+    tk = None
+    if mode == "topk":
+        tk = (int(topk_spec["kk"]), bool(topk_spec["desc"]),
+              int(topk_spec["offset"]), bool(topk_spec["null_fill"]))
+    key = (want, K, k0, G, W, slab_specs, rec, tk, mode)
+    out = fused.fused_launch(key, slab_args, scalars, E)
+    devstats.bump("fused_cells", num_segments)
+    return mode, rec, out

@@ -77,6 +77,42 @@ class CachedPlan:
         return out
 
 
+# ------------------------- fused-plan shape classes (round 17) ------
+#
+# The whole-plan fused executor (ops/fused.py) compiles ONE program
+# per plan SHAPE CLASS — the static residue of a terminal plan after
+# every data-dependent value has been demoted to a traced operand:
+# (want, limb window, grid geometry, per-slab lattice spans, finalize
+# recipe, top-k spec, transport form). Interning the class here, next
+# to the plan-template machinery, gives each class a stable small id
+# that names the compiled program for the compile auditor
+# (og_fused_c<N>) — the same shape-pool role SqlPlanTemplate plays for
+# parse trees, one layer down.
+
+_SHAPE_LOCK = threading.Lock()
+_SHAPE_IDS: dict[tuple, int] = {}
+
+
+def intern_shape_class(key: tuple) -> tuple[int, str]:
+    """Stable (id, auditor name) for a fused-plan shape-class key.
+    The id is assigned on first sight and never reused; the name is
+    what the compile auditor attributes the fused program's compiles
+    to (bounded: one per distinct static key, warm repeats hit the
+    program cache and compile nothing)."""
+    with _SHAPE_LOCK:
+        sid = _SHAPE_IDS.get(key)
+        if sid is None:
+            sid = len(_SHAPE_IDS)
+            _SHAPE_IDS[key] = sid
+    return sid, f"og_fused_c{sid}"
+
+
+def shape_class_count() -> int:
+    """Interned fused shape classes so far (introspection/tests)."""
+    with _SHAPE_LOCK:
+        return len(_SHAPE_IDS)
+
+
 class PlanCache:
     """LRU of parsed query plans keyed by query text (the SqlPlanTemplate
     pool analog — repeated dashboard queries skip the parser)."""

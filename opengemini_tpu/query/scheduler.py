@@ -68,7 +68,8 @@ log = get_logger(__name__)
 
 __all__ = ["QueryScheduler", "QueryCost", "SchedShed", "enabled",
            "get_scheduler", "estimate_request_cost",
-           "pull_bytes_per_cell", "sched_collector", "calib_mode",
+           "pull_bytes_per_cell", "hbm_bytes_per_cell",
+           "sched_collector", "calib_mode",
            "calib_record", "calib_apply", "tenant_shares"]
 
 
@@ -156,6 +157,25 @@ def pull_bytes_per_cell() -> int:
     except Exception:
         pass
     return _PULL_BYTES_PER_CELL
+
+
+def hbm_bytes_per_cell() -> int:
+    """Admission HBM charge per result cell, matching the route the
+    executor will actually run. The staged big-grid dispatch double-
+    buffers the merged plane grid during the cross-file pairwise
+    combine (prev + folded resident together between launches); the
+    whole-plan fused program (OG_FUSED_PLAN, round 17) folds the
+    combine in-trace, so only the single merged grid is ever a named
+    resident buffer. Read dynamically — perf_smoke flips the route
+    per run."""
+    try:
+        from ..ops.blockagg import lattice_fold_on_device
+        from .fusedplan import fused_plan_on
+        if fused_plan_on() and lattice_fold_on_device():
+            return _HBM_BYTES_PER_CELL
+    except Exception:
+        pass
+    return 2 * _HBM_BYTES_PER_CELL
 
 # scheduler counters (utils.stats.scheduler_collector → /metrics,
 # /debug/vars). Writers use utils.stats.bump (threaded HTTP server).
@@ -1020,7 +1040,7 @@ def estimate_request_cost(executor, stmts, db: str | None) -> QueryCost:
         pull_b += c * _stmt_pull_rate(stmt)
     if not seen_select:
         return QueryCost(0, 0, 0)
-    return QueryCost(cells, pull_b, cells * _HBM_BYTES_PER_CELL)
+    return QueryCost(cells, pull_b, cells * hbm_bytes_per_cell())
 
 
 def _stmt_pull_rate(stmt) -> int:

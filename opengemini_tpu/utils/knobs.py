@@ -305,6 +305,15 @@ register("OG_HBM_COMPRESSED_MB", int, 1024,
          "ladder evicts decoded planes before compressed bytes",
          scope="cached")
 
+# --- whole-plan fused execution (ops/fused.py, query/fusedplan.py)
+register("OG_FUSED_PLAN", bool, True,
+         "trace eligible TERMINAL big-grid plans (lattice route + "
+         "device fold) as ONE jit program per shape class — slab "
+         "lattice, cell fold, cross-slab combine, finalize epilogue "
+         "and top-k cut fuse into a single device dispatch with no "
+         "intermediate grids re-crossing the dispatcher; 0 = staged "
+         "per-kernel dispatch (byte-identical escape hatch)")
+
 # --- query scheduler (query/scheduler.py; OG_SCHED cached: checked on
 #     every device launch)
 register("OG_SCHED", bool, True,
@@ -419,12 +428,19 @@ RECOMPILE_BUDGETS: dict = {
     # datasets/backends while still catching the failure mode that
     # matters: a per-value shape-class explosion compiles O(slabs)
     # kernels and blows straight past this.
-    "1h": 24, "1m": 24, "cfg1": 24,
+    # round 17 (+4): the fused whole-plan programs compile one class
+    # per (shape, lattice-route, transport) combination on a shape's
+    # first run — the smoke sweep touches both lattice routes and the
+    # forced-lattice variant, so a shape can pay a handful of fused
+    # cold compiles on top of the staged kernel classes (which still
+    # compile: the escape-hatch configs run them in the same sweep).
+    "1h": 28, "1m": 28, "cfg1": 28,
     # answer-sized D2H shapes (PR 12): the ORDER BY+LIMIT heavy shape
     # pays the finalize epilogue + topk cut kernels on top of the
     # lattice/block variants; the percentile shape pays the cellsort +
-    # order-stat finalize pair. Same 16 headroom rule as above.
-    "1m-topk": 16, "pctl": 16,
+    # order-stat finalize pair. Same headroom rule as above, +4 for
+    # the round-17 fused program classes.
+    "1m-topk": 20, "pctl": 20,
     # any undeclared window label: strict by default
     "default": 0,
 }

@@ -25,9 +25,16 @@
 # new 1m-topk and pctl shapes, a measured winner-cell D2H shrink, a
 # routing proof for the device percentile finalize, and the opt-in
 # f32 fast tier gated on TOLERANCE (not digests) with zero warm
-# recompiles. Runs a scaled-down bench dataset on the CPU backend
-# with per-phase output — CI-safe (no accelerator needed, minutes of
-# wall).
+# recompiles. The whole-plan fused gate (round 17) adds fused-off /
+# fused-off-barrier configs (the staged chain is the byte-identical
+# escape hatch of the one-dispatch fused program) over every shape and
+# both lattice routes, a measured launch-count collapse on the warm
+# forced-lattice heavy shape (<= 2 device launches where the staged
+# chain pays ~6, with zero warm compiles), and a seeded fault at
+# device.fused.launch that must heal per query to the staged chain
+# with the digest unchanged. Runs a scaled-down bench dataset on the
+# CPU backend with per-phase output — CI-safe (no accelerator needed,
+# minutes of wall).
 #
 # Usage: scripts/perf_smoke.sh  [env overrides: OG_BENCH_HOSTS,
 #        OG_BENCH_HOURS, OG_SMOKE_TIMEOUT_S]
@@ -127,6 +134,16 @@ assert r.get("sketch_dev_grids", 0) > 0, r
 assert r.get("f32_tier_launches", 0) > 0, r
 assert r.get("f32_checked_cells", 0) > 0, r
 assert r.get("f32_max_rel_err", 1.0) < 1e-4, r
+# whole-plan fused gate (round 17): the fused-off escape hatch ran
+# byte-identical on every shape and both transports, the fused route
+# measurably engaged, a warm heavy-shape repeat fit the <= 2 launch
+# budget with zero warm compiles, and the seeded fused-launch fault
+# healed per query to the staged chain
+assert "fused-off" in r.get("configs", []), r
+assert "fused-off-barrier" in r.get("configs", []), r
+assert r.get("fused_launches", 0) > 0, r
+assert 0 < r.get("fused_warm_launches", 99) <= 2, r
+assert r.get("fused_heals", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -153,6 +170,9 @@ print(f"answer-sized D2H OK: topk cut {r['topk_d2h_shrink_x']}x "
       f"{r['sketch_dev_grids']} device order-stat grids, f32 tier "
       f"{r['f32_tier_launches']} launches max rel err "
       f"{r['f32_max_rel_err']} over {r['f32_checked_cells']} cells")
+print(f"fused plan OK: {r['fused_launches']} fused dispatches, warm "
+      f"heavy shape in {r['fused_warm_launches']} launch(es), "
+      f"{r['fused_heals']} per-query heals to the staged chain")
 EOF
 
 # result-cache gate (sustained serving, round 16): on every bench

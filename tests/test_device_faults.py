@@ -614,6 +614,11 @@ def _apply_route_config(route_cfg, monkeypatch):
     if route_cfg == "lattice":
         monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
         monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+        # round 17: the fused program intercepts terminal lattice plans
+        # before device.lattice.launch / blockagg.lattice_fold exist —
+        # pin the staged chain so these sites stay reachable (the fused
+        # site has its own matrix in tests/test_fused_plan.py)
+        monkeypatch.setenv("OG_FUSED_PLAN", "0")
     elif route_cfg == "segagg":
         # the jittered measurement is dense-ineligible: its rows ride
         # the sparse segment reduction, forced onto device

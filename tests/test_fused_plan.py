@@ -1,0 +1,302 @@
+"""Whole-plan fused execution (round 17, OG_FUSED_PLAN): terminal
+big-grid plans trace decode→lattice→fold→combine→finalize→top-k as ONE
+jit program per shape class (ops/fused.py, query/fusedplan.py). Every
+byte must equal the staged chain (OG_FUSED_PLAN=0) on every op × fill ×
+nil × predicate × top-k shape and both lattice fold routes; the warm
+heavy shape must answer in ≤2 device launches; a seeded fault at
+``device.fused.launch`` must heal THAT query to the staged chain with
+the HBM ledger exactly reconciled; and the warm program dispatch must
+be transfer-free (resident slabs in, answer planes out)."""
+
+import ast
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_HOST_CACHE_MB", "64")
+    # the serving-layer result cache (round 16) would answer every
+    # repeat from host memory and the fused route would never dispatch
+    # — the on/off digest compares below NEED the device path live
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)   # force the path
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    eng.close()
+
+
+def force_lattice(monkeypatch):
+    """Tiny cell cap → the big-grid lattice route (the fused template's
+    habitat) on the seeded dataset."""
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(E, "BLOCK_MAX_CELLS", 8)
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO_PACKED", 0)
+
+
+def seed(eng, hosts=6, points=512, nil_every=0, seed_=11):
+    rng = np.random.default_rng(seed_)
+    vals = np.round(np.clip(rng.normal(50.0, 15.0, (hosts, points)),
+                            0, 100), 2)
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            if nil_every and (h + i) % nil_every == 0:
+                continue
+            lines.append(
+                f"cpu,host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    return vals
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+_RANGE = "time >= 0 AND time < 5120s"
+HEAVY = (f"SELECT mean(u), sum(u), count(u) FROM cpu WHERE {_RANGE} "
+         "GROUP BY time(1m), host")
+
+# ops × fill × predicate × top-k × sketch: every shape the staged emit
+# ladder distinguishes (fin transport, top-k cut, merge-only corners,
+# non-lattice carve-outs where fused simply must not corrupt)
+MATRIX = [
+    f"SELECT mean(u) FROM cpu WHERE {_RANGE} GROUP BY time(1m), host",
+    f"SELECT sum(u) FROM cpu WHERE {_RANGE} GROUP BY time(2m), host",
+    f"SELECT count(u) FROM cpu WHERE {_RANGE} GROUP BY time(1m), host",
+    HEAVY,
+    # fill lanes ride the same grid — presence decides the hole
+    f"SELECT mean(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(1m), host fill(0)",
+    f"SELECT mean(u), count(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(1m), host fill(none)",
+    f"SELECT sum(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(2m), host fill(previous)",
+    # tag predicate narrows the slab set, not the program shape
+    f"SELECT mean(u) FROM cpu WHERE {_RANGE} AND host = 'h1' "
+    "GROUP BY time(1m), host",
+    # device top-k cut on top of the fused finalize
+    f"SELECT mean(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(1m), host ORDER BY time DESC LIMIT 5",
+    f"SELECT mean(u), sum(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(1m), host ORDER BY time DESC LIMIT 3 OFFSET 2",
+    # carve-outs: extrema / sketch shapes keep their own routes — the
+    # fused probe must decline without corrupting either
+    f"SELECT min(u), max(u), mean(u) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(1m), host",
+    f"SELECT percentile(u, 95) FROM cpu WHERE {_RANGE} "
+    "GROUP BY time(5m), host",
+]
+
+
+@pytest.mark.parametrize("nil_every", [0, 7])
+@pytest.mark.parametrize("fold", ["1", "0"])
+def test_fused_parity_matrix(db, monkeypatch, fold, nil_every):
+    """Every matrix shape × both lattice fold routes × nil pattern:
+    OG_FUSED_PLAN=1 (cold AND warm) must equal =0 bit for bit. With
+    the device fold off the fused template is ineligible by
+    construction — the flag must then be a pure no-op."""
+    eng, ex = db
+    seed(eng, nil_every=nil_every)
+    force_lattice(monkeypatch)
+    monkeypatch.setenv("OG_LATTICE_DEVICE_FOLD", fold)
+    for text in MATRIX:
+        monkeypatch.setenv("OG_FUSED_PLAN", "0")
+        ref = q(ex, text)
+        monkeypatch.setenv("OG_FUSED_PLAN", "1")
+        assert q(ex, text) == ref, text          # cold
+        assert q(ex, text) == ref, text          # warm repeat
+
+
+def test_fused_launch_collapse_and_counters(db, monkeypatch):
+    """The acceptance direction: a WARM repeat of the heavy forced-
+    lattice shape answers in ≤2 device launches through the fused
+    route (the staged chain pays ~6), with the fused counters and the
+    fused_exec phase moving."""
+    from opengemini_tpu.ops.devstats import DEVICE_STATS, QUERY_PHASE_NS
+    eng, ex = db
+    seed(eng)
+    force_lattice(monkeypatch)
+    fu0 = DEVICE_STATS["fused_launches"]
+    fc0 = DEVICE_STATS["fused_cells"]
+    ref = q(ex, HEAVY)                           # cold: compile+upload
+    assert DEVICE_STATS["fused_launches"] > fu0
+    assert DEVICE_STATS["fused_cells"] > fc0
+    kl0 = DEVICE_STATS["kernel_launches"]
+    ph0 = QUERY_PHASE_NS["fused_exec_ns"]
+    assert q(ex, HEAVY) == ref                   # warm repeat
+    assert DEVICE_STATS["kernel_launches"] - kl0 <= 2
+    assert QUERY_PHASE_NS["fused_exec_ns"] > ph0
+
+
+def test_fused_fault_heals_per_query(db, monkeypatch):
+    """Seeded OOM/transient at device.fused.launch with retries
+    disabled: THAT query heals to the staged chain byte-identically
+    (fused_fallbacks moves), the next query rides fused again, and the
+    HBM ledger stays exactly reconciled across the storm."""
+    from opengemini_tpu.ops import devicefault as df
+    from opengemini_tpu.ops import hbm
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    from opengemini_tpu.utils import failpoint as fp
+    eng, ex = db
+    seed(eng)
+    force_lattice(monkeypatch)
+    monkeypatch.setenv("OG_DEVICE_RETRY", "0")
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    ref = q(ex, HEAVY)
+    fp.seed(17)
+    try:
+        # an OOM always earns ONE pressure-ladder retry (devicefault
+        # ladder) before the route is declared down — two seeded hits
+        # exhaust it; a transient with retries=0 falls on the first
+        for mode, hits in (("oom", 2), ("transient", 1)):
+            fb0 = DEVICE_STATS["fused_fallbacks"]
+            fp.enable("device.fused.launch", mode, maxhits=hits)
+            assert q(ex, HEAVY) == ref, mode     # healed, same bytes
+            assert not fp.active("device.fused.launch"), mode
+            fp.disable("device.fused.launch")
+            assert DEVICE_STATS["fused_fallbacks"] > fb0, mode
+            fu0 = DEVICE_STATS["fused_launches"]
+            assert q(ex, HEAVY) == ref           # back on fused
+            assert DEVICE_STATS["fused_launches"] > fu0
+        cc = hbm.cross_check()
+        assert cc["ok"], cc
+    finally:
+        fp.disable_all()
+        df.reset_breakers()
+
+
+def test_fused_breaker_opens_on_persistent_fault(db, monkeypatch):
+    """A persistent fused-launch fault trips the ``fused`` breaker;
+    with the breaker open the route probe turns the template off
+    entirely (no launches, no per-query fallbacks) and answers stay
+    correct through the staged chain."""
+    from opengemini_tpu.ops import devicefault as df
+    from opengemini_tpu.ops.devstats import DEVICE_STATS
+    from opengemini_tpu.utils import failpoint as fp
+    eng, ex = db
+    seed(eng)
+    force_lattice(monkeypatch)
+    monkeypatch.setenv("OG_DEVICE_RETRY", "0")
+    monkeypatch.setenv("OG_DEVICE_RETRY_BACKOFF_MS", "1")
+    monkeypatch.setenv("OG_DEVICE_BREAKER_COOLDOWN_S", "60")
+    ref = q(ex, HEAVY)
+    fp.seed(23)
+    try:
+        fp.enable("device.fused.launch", "oom")  # persistent
+        for _ in range(5):
+            assert q(ex, HEAVY) == ref
+            if df.breaker_for("fused").is_open:
+                break
+        assert df.breaker_for("fused").is_open
+        fu0 = DEVICE_STATS["fused_launches"]
+        fb0 = DEVICE_STATS["fused_fallbacks"]
+        assert q(ex, HEAVY) == ref
+        assert DEVICE_STATS["fused_launches"] == fu0
+        assert DEVICE_STATS["fused_fallbacks"] == fb0
+    finally:
+        fp.disable_all()
+        df.reset_breakers()
+
+
+def test_fused_program_dispatch_no_implicit_transfers(db, monkeypatch):
+    """Warm fused dispatch is transfer-free: every slab operand is
+    device-resident (content-keyed caches), the query scalars shipped
+    once, and the answer planes stay on device until the explicit
+    pull. Capture a real warm launch's operands and replay the program
+    under jax.transfer_guard("disallow")."""
+    from opengemini_tpu.ops import exactsum, fused
+    eng, ex = db
+    seed(eng)
+    force_lattice(monkeypatch)
+    q(ex, HEAVY)                                 # cold compile+upload
+    cap = {}
+    orig = fused.fused_launch
+
+    def spy(key, slab_args, scalars, E):
+        cap.update(key=key, args=slab_args, scalars=scalars, E=E)
+        return orig(key, slab_args, scalars, E)
+
+    monkeypatch.setattr(fused, "fused_launch", spy)
+    q(ex, HEAVY)                                 # warm: resident slabs
+    assert cap, "fused route never dispatched on the forced lattice"
+    fn = fused.program_for(cap["key"])
+    scale = jax.device_put(np.float64(
+        2.0 ** float(cap["E"] - exactsum.SPAN_BITS)))
+    with jax.transfer_guard("disallow"):
+        out = fn(cap["args"], cap["scalars"], scale)
+        jax.block_until_ready(out[0])
+    assert out[0] is not None
+
+
+def test_transport_mode_mirrors_staged_ladder():
+    """The fused terminal transport decision must be the staged emit
+    ladder's, decision for decision: finalize recipe when eligible,
+    top-k only on top of a finalizable grid, the 2^28 count-plane row
+    cap, merge for everything else."""
+    from opengemini_tpu.ops import blockagg
+    from opengemini_tpu.query import fusedplan
+    ops = {"mean", "count", "sum"}
+    mode, rec = fusedplan.transport_mode(ops, True, None, 1000)
+    assert mode == "fin" and rec == blockagg.finalize_fops(ops)
+    mode, _rec = fusedplan.transport_mode(ops, True, {"kk": 5}, 1000)
+    assert mode == "topk"
+    assert fusedplan.transport_mode(ops, True, None,
+                                    1 << 28) == ("merge", None)
+    assert fusedplan.transport_mode({"min"}, True, None,
+                                    10)[0] == "merge"
+    assert fusedplan.transport_mode(ops, False, None,
+                                    10) == ("merge", None)
+
+
+def test_shape_class_interning_stable():
+    """Shape-class ids are assigned once, never reused, and name the
+    compiled program for the compile auditor."""
+    from opengemini_tpu.query import plancache
+    k1 = ("og-test-shape", 1)
+    k2 = ("og-test-shape", 2)
+    sid1, n1 = plancache.intern_shape_class(k1)
+    sid2, n2 = plancache.intern_shape_class(k2)
+    assert sid1 != sid2
+    assert n1 == f"og_fused_c{sid1}" and n2 == f"og_fused_c{sid2}"
+    assert plancache.intern_shape_class(k1) == (sid1, n1)
+    assert plancache.shape_class_count() >= 2
+
+
+def test_program_cache_pins_one_wrapper_per_class():
+    """program_for returns the SAME jit wrapper for a repeated key —
+    the duplicate-compile gate depends on the pin, and the wrapper
+    carries the auditor-visible class name."""
+    from opengemini_tpu.ops import fused
+    key = (("sum",), 1, 0, 2, 3, ((8, 32, True),), None, None, "merge")
+    fn = fused.program_for(key)
+    assert fused.program_for(key) is fn
+
+
+def test_jitwalk_roots_fused_builder():
+    """oglint R5/R9 walker coverage: the fused program builder's
+    inline _program_jit(_prog, name) call must root ``_prog`` so the
+    whole fused trace is inside the walked-jit universe."""
+    from opengemini_tpu.lint import jitwalk
+    from opengemini_tpu.ops import fused
+    src = pathlib.Path(fused.__file__).read_text()
+    names = jitwalk.traced_functions(ast.parse(src))
+    assert "_prog" in names
