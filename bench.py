@@ -78,6 +78,15 @@ QUERY_1M_TOPK = QUERY_1M + " ORDER BY time DESC LIMIT 5"
 QUERY_PCTL = ("SELECT percentile(usage_user, 95) FROM cpu WHERE "
               f"time >= 0 AND time < {int(HOURS * 3600)}s "
               "GROUP BY time(5m), hostname")
+# packed-space predicates (round 18): the headline 1h cut with a field
+# residual — the smoke sweep runs it under every config (including the
+# OG_PACKED_PREDICATE=0 hatch pair) on both lattice routes; the
+# measured selectivity gate builds its own time-ramped measurement
+# because the normal-distributed cpu gauge never lets a segment
+# envelope exclude a realistic threshold
+QUERY_PRED = ("SELECT mean(usage_user) FROM cpu WHERE usage_user >= 50"
+              f" AND time >= 0 AND time < {int(HOURS * 3600)}s "
+              "GROUP BY time(1h), hostname")
 
 # ---------------------------------------------------------------- util
 
@@ -386,6 +395,50 @@ def run_query_phase(data_dir: str, runs: int,
         "dfor_blocks": int(_DDQ["dfor_blocks"]),
         "host_heals": int(_DDQ["host_heals"]),
     }
+    # packed-space predicates (round 18): selectivity sweep on the 1h
+    # cut — thresholds at the ~50%/1%/0.1% quantiles of the N(50,15)
+    # gauge — reporting the rows that EXPAND out of packed space
+    # (pushdown_lanes_expanded) packed-on vs the OG_PACKED_PREDICATE=0
+    # expand-then-filter hatch (which decodes every stored row on the
+    # scan route), the decode-phase wall, and per-threshold digest
+    # equality. The 3x-shrink assertion lives in the smoke gate, whose
+    # ramp measurement gives envelopes a real chance to skip — here
+    # the numbers are honest observations on TSBS data
+    pp = {}
+    for tag, thr in (("50pct", 50.0), ("1pct", 84.9),
+                     ("0.1pct", 96.3)):
+        qp = ("SELECT mean(usage_user) FROM cpu WHERE usage_user >= "
+              f"{thr!r} AND time >= 0 AND time < "
+              f"{int(HOURS * 3600)}s GROUP BY time(1h), hostname")
+        (stmt_pp,) = parse_query(qp)
+        _dcq.global_cache().purge()
+        _dcq.host_cache().purge()
+        l0 = _DDQ["pushdown_lanes_expanded"]
+        d0 = _QPN["device_decode_ns"]
+        res_pp = ex.execute(stmt_pp, "bench")
+        lanes_on = _DDQ["pushdown_lanes_expanded"] - l0
+        pp_dec_ms = (_QPN["device_decode_ns"] - d0) / 1e6
+        knobs.set_env("OG_PACKED_PREDICATE", "0")
+        try:
+            _dcq.global_cache().purge()
+            _dcq.host_cache().purge()
+            res_pph = ex.execute(stmt_pp, "bench")
+        finally:
+            knobs.del_env("OG_PACKED_PREDICATE")
+        # the hatch is the row-wise scan route: it decodes every
+        # stored row in range before filtering (no slabs, no lanes
+        # counter) — that row count is its side of the comparison
+        lanes_off = HOSTS * int(HOURS * 3600 / STEP_S)
+        dig_pp, _c = _digest_series(res_pp)
+        dig_pph, _c = _digest_series(res_pph)
+        pp[tag] = {"lanes_on": int(lanes_on),
+                   "lanes_off": int(lanes_off),
+                   "decode_ms": round(pp_dec_ms, 3),
+                   "digest": dig_pp[:16],
+                   "bit_identical": dig_pp == dig_pph}
+    pp["segments_skipped"] = int(_DDQ["pushdown_segments_skipped"])
+    pp["blocks_masked"] = int(_DDQ["pushdown_blocks_masked"])
+    out["packed_predicate"] = pp
     # serialize phase: stream the 11.5M-cell 1m result (kept from the
     # timing loop — no extra execution) through the chunked encoder
     # (http/serializer — what the HTTP layer emits); measured here
@@ -675,6 +728,10 @@ def headline_phase(runs: int, cpu_timeout: float) -> dict:
         # 1m heavy shape — device decode on vs off, compressed HBM
         # tier residency/rebuild, decode-stage wall split
         "compressed_domain": tpu.get("compressed_domain", {}),
+        # packed-space predicates (round 18): selectivity sweep of
+        # the 1h cut — expand-lane counts on vs hatch, decode wall,
+        # per-threshold digest equality
+        "packed_predicate": tpu.get("packed_predicate", {}),
         "phases_ms_heavy": tpu.get("phases_ms_heavy", {}),
         "bit_identical": True,
         "ingest_rows_per_sec": round(n_rows / max(t_ing, 1e-9), 1),
@@ -1212,7 +1269,20 @@ def smoke_phase() -> dict:
                    ("fused-off", {"OG_PIPELINE_DEPTH": "4",
                                   "OG_FUSED_PLAN": "0"}),
                    ("fused-off-barrier", {"OG_PIPELINE_DEPTH": "0",
-                                          "OG_FUSED_PLAN": "0"})]
+                                          "OG_FUSED_PLAN": "0"}),
+                   # packed-predicate gate (round 18): packed-space
+                   # residual evaluation (default on, engaging on the
+                   # 1h-pred shape below) vs the byte-identical
+                   # expand-then-filter hatch (OG_PACKED_PREDICATE=0)
+                   # — every cell of every shape, streamed AND single-
+                   # barrier, both lattice routes; the measured
+                   # selectivity/shrink gate runs separately after the
+                   # sweeps
+                   ("packed-off", {"OG_PIPELINE_DEPTH": "4",
+                                   "OG_PACKED_PREDICATE": "0"}),
+                   ("packed-off-barrier",
+                    {"OG_PIPELINE_DEPTH": "0",
+                     "OG_PACKED_PREDICATE": "0"})]
         from opengemini_tpu.ops import hbm as _hbm
         # force the block path + lattice route so the smoke covers the
         # shapes the streaming pipeline actually rewires (originals
@@ -1229,7 +1299,8 @@ def smoke_phase() -> dict:
             for key, qtext in (("1h", QUERY), ("1m", QUERY_1M),
                                ("cfg1", QUERY_CFG1),
                                ("1m-topk", QUERY_1M_TOPK),
-                               ("pctl", QUERY_PCTL)):
+                               ("pctl", QUERY_PCTL),
+                               ("1h-pred", QUERY_PRED)):
                 ref = None
                 for cname, env in configs:
                     for k, v in env.items():
@@ -1588,6 +1659,130 @@ def smoke_phase() -> dict:
             knobs.del_env("OG_FUSED_PLAN")
             E.BLOCK_MAX_CELLS = _blk_cells0
             E.BLOCK_MIN_RATIO_PACKED = _blk_packed0
+        # --------------- packed-predicate selectivity gate (round 18)
+        # measured lane diet: a predicate must cut the rows that ever
+        # EXPAND out of packed space, not merely filter them after. A
+        # time-ramped measurement (decimal-scaled values climbing 0.01
+        # per point) gives every 4096-row segment a tight DFOR
+        # envelope, so a selective threshold classifies most segments
+        # "none" and they never stage — pushdown_lanes_expanded under
+        # the packed route vs the OG_PACKED_PREDICATE=0 hatch is the
+        # shrink. Digests must agree per threshold (cold AND warm,
+        # the warm repeat recompiling nothing), and a seeded fault at
+        # the mask-launch site (device.pushdown.eval) must heal per
+        # batch to the host expand-then-filter mask, byte-identical,
+        # with the HBM ledger still reconciled after
+        import opengemini_tpu.ops.devicecache as _dcr
+        from opengemini_tpu.ops.device_decode import DECODE_STATS as _DDS
+        rp_pts, rp_hosts = 1 << 16, 2
+        rp_times = np.arange(rp_pts, dtype=np.int64) * 10**9
+        rp_vals = np.round(np.arange(rp_pts, dtype=np.float64) * 0.01,
+                           2)
+        rp_max = float(rp_vals[-1])
+        for h in range(rp_hosts):
+            eng.write_record("bench", "ramp",
+                             {"hostname": f"host_{h}"}, rp_times,
+                             {"v": rp_vals})
+        for s in eng.database("bench").all_shards():
+            s.flush()
+
+        def _ramp_q(thr):
+            return (f"SELECT sum(v), count(v), mean(v) FROM ramp "
+                    f"WHERE v >= {thr!r} AND time >= 0 AND time < "
+                    f"{rp_pts}s GROUP BY time(1h), hostname")
+
+        def _purge_planes():
+            # comparable cold builds: the hatch's pred-free slab key
+            # may be warm from an earlier run (and vice versa)
+            _dcr.global_cache().purge()
+            _dcr.host_cache().purge()
+
+        pd_sel = {}
+        pd_heals = 0
+        try:
+            sk0 = _DDS["pushdown_segments_skipped"]
+            for tag, frac in (("50pct", 0.5), ("1pct", 0.01),
+                              ("0.1pct", 0.001)):
+                qtext = _ramp_q(round(rp_max * (1.0 - frac), 2))
+                _purge_planes()
+                l0 = _DDS["pushdown_lanes_expanded"]
+                dig_on, _pc = run(qtext)
+                lanes_on = _DDS["pushdown_lanes_expanded"] - l0
+                mark = _ca.AUDITOR.mark()
+                dig_w, _pc = run(qtext)          # warm packed repeat
+                if _ca.AUDITOR.since(mark):
+                    raise SystemExit(
+                        f"PACKED GATE [{tag}]: warm packed repeat "
+                        "recompiled — a predicate value leaked into a "
+                        "shape-deriving traced argument")
+                knobs.set_env("OG_PACKED_PREDICATE", "0")
+                try:
+                    _purge_planes()
+                    dig_off, _pc = run(qtext)
+                finally:
+                    knobs.del_env("OG_PACKED_PREDICATE")
+                # the hatch takes the row-wise scan route — no block
+                # slabs, no lanes counter — and decodes EVERY stored
+                # row in range before filtering: that row count is
+                # the expand-then-filter side of the shrink
+                lanes_off = rp_pts * rp_hosts
+                if not dig_on == dig_w == dig_off:
+                    raise SystemExit(
+                        f"PACKED GATE [{tag}]: packed route changed "
+                        f"bytes: cold {dig_on[:16]} warm {dig_w[:16]}"
+                        f" hatch {dig_off[:16]}")
+                pd_sel[tag] = {"lanes_on": int(lanes_on),
+                               "lanes_off": int(lanes_off)}
+            pd_skipped = _DDS["pushdown_segments_skipped"] - sk0
+            if pd_skipped <= 0:
+                raise SystemExit(
+                    "PACKED GATE: no segment envelope classified "
+                    '"none" across the selectivity sweep — the skip-'
+                    "before-stage path is dead")
+            sel = pd_sel["0.1pct"]
+            pd_shrink = sel["lanes_off"] / max(sel["lanes_on"], 1)
+            if pd_shrink < 3.0:
+                raise SystemExit(
+                    f"PACKED GATE: 0.1% selectivity expanded "
+                    f"{sel['lanes_on']} lanes vs {sel['lanes_off']} "
+                    f"under the hatch — shrink {pd_shrink:.1f}x < 3x")
+            # per-batch heal: a persistent transient at the mask
+            # launch exhausts its retries and the builder re-derives
+            # THAT batch's survivor mask on host (expand-then-filter)
+            # — a fresh threshold forces the cold build that actually
+            # launches
+            thr_heal = round(rp_max * 0.61, 2)
+            _fpu.seed(18)
+            h0 = _DDS["pushdown_heals"]
+            _fpu.enable("device.pushdown.eval", "transient")
+            try:
+                dig_h, _pc = run(_ramp_q(thr_heal))
+            finally:
+                _fpu.disable("device.pushdown.eval")
+                _dfu.reset_breakers()
+            pd_heals = _DDS["pushdown_heals"] - h0
+            if pd_heals <= 0:
+                raise SystemExit(
+                    "PACKED GATE: seeded device.pushdown.eval fault "
+                    "produced no per-batch heal (pushdown_heals flat)")
+            knobs.set_env("OG_PACKED_PREDICATE", "0")
+            try:
+                _purge_planes()
+                dig_hh, _pc = run(_ramp_q(thr_heal))
+            finally:
+                knobs.del_env("OG_PACKED_PREDICATE")
+            if dig_h != dig_hh:
+                raise SystemExit(
+                    f"PACKED GATE: healed query changed bytes: "
+                    f"{dig_h[:16]} != hatch {dig_hh[:16]}")
+            cross = _hbm.cross_check()
+            if not cross["ok"]:
+                raise SystemExit(f"PACKED GATE: HBM ledger diverged "
+                                 f"across the pushdown heal: {cross}")
+        finally:
+            _fpu.disable_all()
+            _dfu.reset_breakers()
+            knobs.del_env("OG_PACKED_PREDICATE")
         # ------------------------------------------------ chaos gate
         # device fault domain (PR 9): one seeded device-fault schedule
         # per bench shape — OOM + transient + hang injections across
@@ -1840,6 +2035,11 @@ def smoke_phase() -> dict:
             "fused_launches": int(_DSM["fused_launches"]),
             "fused_warm_launches": int(fused_warm_launches),
             "fused_heals": int(fused_heals),
+            # packed-predicate gate (round 18)
+            "pd_lane_shrink_x": round(pd_shrink, 1),
+            "pd_selectivity": pd_sel,
+            "pd_segments_skipped": int(pd_skipped),
+            "pd_heals": int(pd_heals),
             # compile-cache + transfer audit gates (PR 11)
             "recompile_budget_ok": 1,
             "recompile_budget": recompile_report,

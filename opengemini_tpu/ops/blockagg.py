@@ -96,6 +96,10 @@ class BlockStack:
     t0_dev: object = None            # jax (B,) i64 first time
     step_dev: object = None          # jax (B,) i64 delta (1 if rows<2)
     rows_dev: object = None          # jax (B,) i32 real rows
+    # int-mode slab (OG_LIMB_INT, round 18): limbs decomposed in int
+    # space on device, NO values plane — the executor gates wants to
+    # count/sum (min/max/sumsq need the f64 plane)
+    int_only: bool = False
 
     @property
     def n_blocks(self) -> int:
@@ -141,7 +145,7 @@ def _file_layout(reader, field: str):
 
 
 def _build_slab(reader, field: str, metas, seg: int, E: int,
-                block0: int):
+                block0: int, pred=None):
     """Host-side slab assembly: decode + limb decompose. Upload happens
     in get_stacks once the file-wide active limb-plane range is known
     (most real columns use ≤4 of the 6 planes — a 52-bit mantissa spans
@@ -183,6 +187,14 @@ def _build_slab(reader, field: str, metas, seg: int, E: int,
         sids[b] = sid
         refs.append((colm, s))
         n_rows += r
+    if pred is not None:
+        # packed-predicate rows land on the VALID plane before limb
+        # decomposition — the exact leaf compares eval_residual would
+        # run (ops/pushdown.eval_numpy), so every downstream kernel
+        # late-materializes only survivors without knowing pushdown
+        # exists
+        from . import pushdown as _pu
+        valid &= _pu.eval_numpy(pred, vals)
     limbs, bad = exactsum.host_limbs(vals, valid, E)
     st = BlockStack(reader.path, field, seg, E, sids, refs, n_rows,
                     tmin, tmax, block0)
@@ -250,7 +262,7 @@ _TimeCol = _TimeColMeta()
 
 
 def _build_slab_device(reader, field: str, metas, seg: int, E: int,
-                       block0: int):
+                       block0: int, pred=None, int_mode: bool = False):
     """Device-decode twin of _build_slab. Returns (BlockStack with
     FULL-K limb planes, (K,) device activity flags, rebuild recipe) —
     get_stacks slices the limb range and stakes the recipe into the
@@ -278,6 +290,7 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
 
     dfor_groups: dict[tuple, list] = {}   # (w, tr, ds, r) → [(b, ref, words)]
     const_blocks: list = []               # (b, value)
+    rle_groups: dict[int, list] = {}      # padded runs → [(b, pv, pl)]
     host_blocks: list = []                # block indices
     cdelta_blocks: list = []                 # (b, t0, step) device times
     vbits: dict[int, np.ndarray | None] = {}   # b → bitmap | None=CONST
@@ -294,6 +307,13 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
         vcodec = mm[s.offset]
         tcodec = mm[tseg.offset]
         if decodestage.block_stage(vcodec, tcodec) != "device":
+            host_blocks.append(b)
+            continue
+        if int_mode and not _int_block_ok(mm, s, E):
+            # int-space decomposition serves zigzag-delta ints whose
+            # envelope fits below 2^E; everything else (XOR floats,
+            # scaled decimals, CONST, RLE, wrap-risk widths) takes the
+            # host stage — host f64 limb math is exact
             host_blocks.append(b)
             continue
         t0, step = struct_unpack_qq(mm, tseg.offset + 1)
@@ -317,6 +337,10 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
                 dtype="<u4")
             dfor_groups.setdefault((w, tr, ds, r), []).append(
                 (b, ref, words))
+        elif vcodec == EB.RLE:        # arithmetic run payload
+            rvals, rlens = _parse_rle(mm, s)
+            pv, pl = dd._pad_runs(rvals, rlens)
+            rle_groups.setdefault(len(pv), []).append((b, pv, pl))
         else:                         # CONST float value
             val = np.frombuffer(mm[s.offset + 1:s.offset + 9],
                                 dtype=np.float64)[0]
@@ -348,9 +372,19 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
                     "sids": sids, "refs": refs, "tmin": tmin,
                     "tmax": tmax, "steps": steps, "rows": rows_arr,
                     "all_const": all_const, "n_rows": n_rows,
-                    "dfor": [], "const": None, "host": None,
-                    "hsegs": [], "tbatch": None, "vbatch": None,
-                    "perm": None, "tperm": None, "k0": 0, "k1": 0}
+                    "dfor": [], "rle": [], "const": None,
+                    "host": None, "hsegs": [], "tbatch": None,
+                    "vbatch": None, "perm": None, "tperm": None,
+                    "k0": 0, "k1": 0, "int": int_mode,
+                    "pred": pred, "pdmask": [], "pdf": None}
+    if pred is not None:
+        from . import pushdown as _pu
+        # post-expand f64 thresholds (RLE batches, heals): device-
+        # resident in the recipe so compressed-tier rebuilds move 0 B
+        recipe["pdf"] = jax.device_put(np.array(
+            [c for _op, c in pred.conjs], dtype=np.float64))
+        compileaudit.record_h2d("payload",
+                                int(recipe["pdf"].nbytes))
 
     for (w, tr, ds, r), blks in sorted(dfor_groups.items()):
         nb = len(blks)
@@ -367,6 +401,33 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
         compileaudit.record_h2d("payload", int(rd.nbytes))
         recipe["dfor"].append((wd, rd, w, tr, ds, r,
                                [b for b, _r, _w in blks]))
+        plan = None
+        if pred is not None:
+            from . import pushdown as _pu
+            classes = [_pu.classify_dfor(pred, tr, w, ds, int(ref))
+                       for _b, ref, _w2 in blks]
+            plan = _pu.batch_mask_plan(pred, tr, w, ds, classes)
+            if plan is not None:
+                mode_p, sig_p, thr = plan
+                thr_d = jax.device_put(thr)
+                compileaudit.record_h2d("payload", int(thr_d.nbytes))
+                plan = (mode_p, sig_p, thr_d)
+        recipe["pdmask"].append(plan)
+
+    for rp, blks in sorted(rle_groups.items()):
+        nb_pad = dd.pad_pow2(len(blks), 8)
+        pvm = np.zeros((nb_pad, rp), dtype=np.float64)
+        plm = np.zeros((nb_pad, rp), dtype=np.int64)
+        for j, (_b, pv, pl) in enumerate(blks):
+            pvm[j] = pv
+            plm[j] = pl
+        rrw = _pad_rows(rows_arr[[b for b, _v, _l in blks]], nb_pad)
+        pvd, pld, rrd = (jax.device_put(pvm), jax.device_put(plm),
+                         jax.device_put(rrw))
+        compileaudit.record_h2d("payload", int(
+            pvd.nbytes + pld.nbytes + rrd.nbytes))
+        recipe["rle"].append((pvd, pld, rrd,
+                              [b for b, _v, _l in blks]))
 
     if const_blocks:
         nb_pad = dd.pad_pow2(len(const_blocks), 8)
@@ -424,6 +485,148 @@ def struct_unpack_qq(mm, off: int):
     return _s.unpack("<qq", mm[off:off + 16])
 
 
+def _parse_rle(mm, seg_meta):
+    """Host-parse one RLE segment's (tiny) run payload from the mmap —
+    what crosses H2D instead of the expanded rows."""
+    from ..encoding.blocks import parse_rle_payload
+    return parse_rle_payload(
+        mm[seg_meta.offset + 1:seg_meta.offset + seg_meta.size])
+
+
+def _int_block_ok(mm, s, E: int) -> bool:
+    """Int-mode device eligibility of one value segment: zigzag-delta
+    DFOR (T_INT, or T_SCALED with dscale 0 — the divide by 10^0 is the
+    identity) whose header envelope bounds |k| below 2^E, so the
+    static-shift limb windows of ops/device_decode.int_limbs_batch
+    capture every bit and the clamp cascade never engages."""
+    from ..encoding import blocks as EB
+    from ..encoding import dfor as _dfm
+    from . import pushdown as _pu
+    if mm[s.offset] != EB.DFOR:
+        return False
+    hdr = mm[s.offset + 1:s.offset + 1 + _dfm.HEADER_BYTES]
+    tr, w, ds, n_hdr, ref = _dfm.parse_header(hdr)
+    if n_hdr != s.rows:
+        return False
+    if tr not in (_dfm.T_INT, _dfm.T_SCALED) or (
+            tr == _dfm.T_SCALED and ds != 0):
+        return False
+    env = _pu.envelope_k(w, ref)
+    if env is None:
+        return False
+    return max(abs(env[0]), abs(env[1])) < (1 << E)
+
+
+def _classify_metas(reader, pred, metas):
+    """Segment-envelope pre-filter (ops/pushdown.classify_dfor): drop
+    segments wholly outside the predicate BEFORE any slab batching —
+    they never unpack, never upload, never mask. Classification reads
+    only the 16-byte DFOR header / 8-byte CONST value from the mmap.
+    Non-classifiable codecs (RLE, legacy) stay and row-mask
+    post-expand."""
+    from ..encoding import blocks as EB
+    from ..encoding import dfor as _dfm
+    from . import device_decode as dd, pushdown as _pu
+    mm = reader._mm
+    kept = []
+    skip_seg = skip_rows = 0
+    for m in metas:
+        _sid, _colm, s, _tseg = m
+        cls = "fallback"
+        if s.rows == 0:
+            cls = "none"          # nothing to aggregate either way
+        else:
+            vcodec = mm[s.offset]
+            if vcodec == EB.DFOR:
+                hdr = mm[s.offset + 1:
+                         s.offset + 1 + _dfm.HEADER_BYTES]
+                tr, w, ds, n_hdr, ref = _dfm.parse_header(hdr)
+                if n_hdr == s.rows:
+                    cls = _pu.classify_dfor(pred, tr, w, ds, ref)
+            elif vcodec == EB.CONST:
+                val = np.frombuffer(mm[s.offset + 1:s.offset + 9],
+                                    dtype=np.float64)[0]
+                cls = _pu.classify_const(pred, val)
+        if cls == "none":
+            skip_seg += 1
+            skip_rows += int(s.rows)
+            continue
+        kept.append(m)
+    dd._bump("pushdown_segments_skipped", skip_seg)
+    dd._bump("pushdown_rows_skipped", skip_rows)
+    return kept
+
+
+def _heal_mask(reader, seg_refs, idxs, nb_pad: int, seg: int, pred):
+    """Heal of a faulted expand+mask pushdown launch: host decode of
+    the batch (the same rows _heal_batch stages) PLUS the host
+    eval_numpy mask — expand-then-filter, byte-identical. Returns
+    (values_dev, mask_dev)."""
+    import jax
+
+    from . import compileaudit, device_decode as dd, pushdown as _pu
+    hv = np.zeros((nb_pad, seg), dtype=np.float64)
+    for j, b in enumerate(idxs):
+        colm, s = seg_refs[b]
+        if s.rows:
+            cv = reader.read_segment(colm, s)
+            hv[j, :s.rows] = cv.values.astype(np.float64, copy=False)
+    mk = _pu.eval_numpy(pred, hv)
+    hvd, mkd = jax.device_put(hv), jax.device_put(mk)
+    compileaudit.record_h2d("slab", int(hvd.nbytes + mkd.nbytes))
+    dd._bump("pushdown_heals", len(idxs))
+    return hvd, mkd
+
+
+def _heal_mask_only(reader, seg_refs, idxs, nb_pad: int, seg: int,
+                    pred):
+    """Heal of a faulted mask-only launch (RLE plane_mask / int-mode
+    k_mask): the values (or k limbs) expanded fine — only the survivor
+    mask re-derives on host."""
+    import jax
+
+    from . import compileaudit, device_decode as dd, pushdown as _pu
+    hv = np.zeros((nb_pad, seg), dtype=np.float64)
+    for j, b in enumerate(idxs):
+        colm, s = seg_refs[b]
+        if s.rows:
+            cv = reader.read_segment(colm, s)
+            hv[j, :s.rows] = cv.values.astype(np.float64, copy=False)
+    mkd = jax.device_put(_pu.eval_numpy(pred, hv))
+    compileaudit.record_h2d("slab", int(mkd.nbytes))
+    dd._bump("pushdown_heals", len(idxs))
+    return mkd
+
+
+def _heal_limbs(reader, seg_refs, idxs, nb_pad: int, seg: int,
+                E: int, pred=None):
+    """Int-mode heal of a faulted k-expand/limb launch: host decode +
+    exact host f64 limb decomposition (the final mask_limbs_batch
+    zeroes by valid, so no pre-masking here). Returns
+    (limbs_dev, bad_dev, mask_dev|None)."""
+    import jax
+
+    from . import compileaudit, device_decode as dd, exactsum, \
+        pushdown as _pu
+    hv = np.zeros((nb_pad, seg), dtype=np.float64)
+    for j, b in enumerate(idxs):
+        colm, s = seg_refs[b]
+        if s.rows:
+            cv = reader.read_segment(colm, s)
+            hv[j, :s.rows] = cv.values.astype(np.float64, copy=False)
+    hl, hb = exactsum.host_limbs(hv, None, E)
+    hld, hbd = jax.device_put(hl), jax.device_put(hb)
+    mkd = None
+    if pred is not None:
+        mkd = jax.device_put(_pu.eval_numpy(pred, hv))
+        dd._bump("pushdown_heals", len(idxs))
+    compileaudit.record_h2d("slab", int(
+        hld.nbytes + hbd.nbytes
+        + (mkd.nbytes if mkd is not None else 0)))
+    dd._bump("host_heals", len(idxs))
+    return hld, hbd, mkd
+
+
 def _stage_host_blocks(reader, metas, host_blocks, seg, tmin, tmax,
                        steps, rows_arr, recipe):
     """Per-block host-decode staging: decode the listed blocks on
@@ -460,10 +663,11 @@ def _restage_host(reader, recipe):
     """Decode + upload the host-stage blocks of one recipe (first
     build AND compressed-tier rebuild — the planes are deliberately
     not kept resident, see _stage_host_blocks). Returns
-    (values, valid, times, idxs) device planes."""
+    (values, valid, times, idxs, limbs|None, bad|None) device
+    planes (the limb pair only on int-mode recipes)."""
     import jax
 
-    from . import compileaudit
+    from . import compileaudit, exactsum
     seg = recipe["seg"]
     hsegs = recipe["hsegs"]
     nbh = len(hsegs)
@@ -479,11 +683,26 @@ def _restage_host(reader, recipe):
         hv[j, :r] = cv.values.astype(np.float64, copy=False)
         hm[j, :r] = cv.valid
         ht[j, :r] = tv.values
+    pred = recipe.get("pred")
+    if pred is not None:
+        # host-stage blocks filter in numpy BEFORE upload — the same
+        # leaf compares the device mask launches run
+        from . import pushdown as _pu
+        hm &= _pu.eval_numpy(pred, hv)
+    hld = hbd = None
+    if recipe.get("int"):
+        # int-mode slab: the device limb decomposition is off-limits
+        # (that is the point) — host-stage blocks decompose HERE in
+        # exact host f64 and ship limb planes
+        hl, hb = exactsum.host_limbs(hv, hm, recipe["E"])
+        hld, hbd = jax.device_put(hl), jax.device_put(hb)
+        compileaudit.record_h2d("limbs", int(hld.nbytes
+                                             + hbd.nbytes))
     hvd, hmd, htd = (jax.device_put(hv), jax.device_put(hm),
                      jax.device_put(ht))
     compileaudit.record_h2d("slab", int(
         hvd.nbytes + hmd.nbytes + htd.nbytes))
-    return hvd, hmd, htd, [b for b, _c, _s, _t in hsegs]
+    return hvd, hmd, htd, [b for b, _c, _s, _t in hsegs], hld, hbd
 
 
 def _recipe_perms(recipe: dict, B: int):
@@ -494,6 +713,10 @@ def _recipe_perms(recipe: dict, B: int):
     pos = 0
     from . import device_decode as dd
     for _wd, _rd, _w, _tr, _ds, _r, idxs in recipe["dfor"]:
+        for j, b in enumerate(idxs):
+            perm[b] = pos + j
+        pos += dd.pad_pow2(len(idxs), 8)
+    for _pv, _pl, _rw, idxs in recipe.get("rle", ()):
         for j, b in enumerate(idxs):
             perm[b] = pos + j
         pos += dd.pad_pow2(len(idxs), 8)
@@ -537,6 +760,8 @@ def _expand_recipe(recipe: dict, reader, field: str,
 
     seg = recipe["seg"]
     E = recipe["E"]
+    pred = recipe.get("pred")
+    int_mode = bool(recipe.get("int"))
 
     def _launch(fn):
         if not guarded:
@@ -545,17 +770,113 @@ def _expand_recipe(recipe: dict, reader, field: str,
                               site="device.decode.launch",
                               success_resets=False)
 
+    def _pd_launch(fn):
+        # pushdown mask launches carry their own failpoint: a sick
+        # mask kernel heals THIS batch to expand-then-filter while
+        # the plain decode ladder stays untouched
+        if not guarded:
+            return fn()
+        return guarded_launch("block", fn,
+                              site="device.pushdown.eval",
+                              success_resets=False)
+
+    from ..encoding import dfor as _dfm
     val_parts: list = []
-    for (wd, rd, w, tr, ds, r, idxs) in recipe["dfor"]:
+    mask_parts: list = []          # pred survivor masks, values order
+    part_rows: list = []           # padded batch heights, values order
+    limb_parts: list = []          # int mode: limb/bad planes instead
+    bad_parts: list = []           # of an f64 values plane
+    pdmask = recipe.get("pdmask") or []
+    pdmask = list(pdmask) + [None] * (len(recipe["dfor"])
+                                      - len(pdmask))
+    for (wd, rd, w, tr, ds, r, idxs), plan in zip(recipe["dfor"],
+                                                  pdmask):
+        nb_pad = wd.shape[0]
+        mk = None
+        if int_mode:
+            # expand the zigzag-delta integer k itself and window its
+            # bits (ops/device_decode.int_limbs_batch) — all-integer,
+            # exact on f32-pair-emulated backends; T_SCALED dscale-0
+            # groups share the T_INT arithmetic (_int_block_ok admits
+            # only those)
+            try:
+                k = _launch(lambda: dd.fit_rows(dd.dfor_expand(
+                    wd, rd, n=r, width=w, transform=_dfm.T_INT,
+                    dscale=0, kind="i64"), seg))
+                lb = _launch(lambda: dd.int_limbs_batch(k, E=E))
+                bd = jnp.zeros((nb_pad, seg), dtype=jnp.bool_)
+                if plan is not None and plan[0] == "int":
+                    try:
+                        mk = _pd_launch(lambda: dd.k_mask(
+                            k, plan[2], sig=plan[1]))
+                        dd._bump("pushdown_blocks_masked", len(idxs))
+                    except DeviceRouteDown:
+                        mk = _heal_mask_only(reader, recipe["refs"],
+                                             idxs, nb_pad, seg, pred)
+                elif plan is not None:
+                    # int-eligible groups always translate — this is
+                    # unreachable paranoia, healed on host
+                    mk = _heal_mask_only(reader, recipe["refs"],
+                                         idxs, nb_pad, seg, pred)
+                dd._bump("dfor_blocks", len(idxs))
+            except DeviceRouteDown:
+                lb, bd, mk = _heal_limbs(
+                    reader, recipe["refs"], idxs, nb_pad, seg, E,
+                    pred if plan is not None else None)
+            limb_parts.append(lb)
+            bad_parts.append(bd)
+        elif plan is not None:
+            # ONE launch expands values AND evaluates the packed
+            # predicate on the un-decoded integer k (mode "int") or
+            # the decoded plane (mode "f64" — XOR fallback)
+            try:
+                out, mk = _pd_launch(lambda: tuple(
+                    dd.fit_rows(x, seg) for x in dd.dfor_expand_pred(
+                        wd, rd, plan[2], n=r, width=w, transform=tr,
+                        dscale=ds, mode=plan[0], sig=plan[1])))
+                dd._bump("dfor_blocks", len(idxs))
+                dd._bump("pushdown_blocks_masked", len(idxs))
+            except DeviceRouteDown:
+                out, mk = _heal_mask(reader, recipe["refs"], idxs,
+                                     nb_pad, seg, pred)
+            val_parts.append(out)
+        else:
+            try:
+                out = _launch(lambda: dd.fit_rows(dd.dfor_expand(
+                    wd, rd, n=r, width=w, transform=tr, dscale=ds,
+                    kind="f64"), seg))
+                dd._bump("dfor_blocks", len(idxs))
+            except DeviceRouteDown:
+                out = _heal_batch(reader, recipe["refs"], idxs,
+                                  wd.shape[0], seg)
+            val_parts.append(out)
+        mask_parts.append(mk)
+        part_rows.append(nb_pad)
+    for (pvd, pld, rrd, idxs) in recipe.get("rle", ()):
+        # device RLE expansion (round 18): cumsum over run lengths —
+        # the run payload crossed H2D, never the expanded rows
+        nb_pad = pvd.shape[0]
+        mk = None
         try:
-            out = _launch(lambda: dd.fit_rows(dd.dfor_expand(
-                wd, rd, n=r, width=w, transform=tr, dscale=ds,
-                kind="f64"), seg))
-            dd._bump("dfor_blocks", len(idxs))
+            out = _launch(lambda: dd.rle_expand_batch(pvd, pld, rrd,
+                                                      seg))
+            dd._bump("rle_blocks", len(idxs))
         except DeviceRouteDown:
-            out = _heal_batch(reader, recipe["refs"], idxs,
-                              wd.shape[0], seg)
+            out = _heal_batch(reader, recipe["refs"], idxs, nb_pad,
+                              seg)
+        if pred is not None:
+            # runs are not frame-of-reference packed: post-expand
+            # f64 mask, same compares as the escape hatch
+            try:
+                mk = _pd_launch(lambda: dd.plane_mask(
+                    out, recipe["pdf"], sig=pred.sig))
+                dd._bump("pushdown_blocks_masked", len(idxs))
+            except DeviceRouteDown:
+                mk = _heal_mask_only(reader, recipe["refs"], idxs,
+                                     nb_pad, seg, pred)
         val_parts.append(out)
+        mask_parts.append(mk)
+        part_rows.append(nb_pad)
     if recipe["const"] is not None:
         cvd, crd, idxs = recipe["const"]
         try:
@@ -566,13 +887,22 @@ def _expand_recipe(recipe: dict, reader, field: str,
             out = _heal_batch(reader, recipe["refs"], idxs,
                               cvd.shape[0], seg)
         val_parts.append(out)
+        # surviving CONST blocks classified "all" — never masked
+        mask_parts.append(None)
+        part_rows.append(cvd.shape[0])
     host_planes = None
     if recipe["host"] is not None:
         # host-stage blocks re-decode + upload HERE on every expand:
         # keeping their dense planes in the compressed tier would
         # make it exactly as heavy as the decoded tier it rebuilds
+        # (pred rows were already masked onto their valid plane)
         host_planes = _restage_host(reader, recipe)
         val_parts.append(host_planes[0])
+        mask_parts.append(None)
+        part_rows.append(host_planes[0].shape[0])
+        if int_mode:
+            limb_parts.append(host_planes[4])
+            bad_parts.append(host_planes[5])
     if recipe.get("meta_dev") is None:
         # per-slab device metadata uploads ONCE — the recipe keeps
         # them resident so a compressed-tier rebuild moves 0 bytes
@@ -587,9 +917,11 @@ def _expand_recipe(recipe: dict, reader, field: str,
         recipe["meta_dev"] = md
     block0_d, t0min_d, steps_d, rows32_d, perm_d, tperm_d = \
         recipe["meta_dev"]
-    values = dd.permute_blocks(
-        val_parts[0] if len(val_parts) == 1
-        else jnp.concatenate(val_parts, axis=0), perm_d)
+    values = None
+    if not int_mode:
+        values = dd.permute_blocks(
+            val_parts[0] if len(val_parts) == 1
+            else jnp.concatenate(val_parts, axis=0), perm_d)
 
     t0d, stpd, drwd, bitd, cfd, dev_idxs = recipe["tbatch"]
     dd._bump("time_blocks", len(dev_idxs))
@@ -607,9 +939,32 @@ def _expand_recipe(recipe: dict, reader, field: str,
         valid_parts[0] if len(valid_parts) == 1
         else jnp.concatenate(valid_parts, axis=0), tperm_d)
 
-    scale0 = dd.limb_scale_dev(E)
-    limbs, bad, act = _launch(
-        lambda: dd.limbs_decompose(values, valid, scale0))
+    if any(m is not None for m in mask_parts):
+        # the packed-predicate survivor mask lands on the VALID plane
+        # BEFORE limb decomposition: every downstream kernel (staged
+        # lattice, fused whole-plan, min/max, count) sees only
+        # surviving lanes without knowing pushdown exists
+        mparts = [m if m is not None
+                  else jnp.ones((nb, seg), dtype=jnp.bool_)
+                  for m, nb in zip(mask_parts, part_rows)]
+        mask_full = dd.permute_blocks(
+            mparts[0] if len(mparts) == 1
+            else jnp.concatenate(mparts, axis=0), perm_d)
+        valid = dd.and_planes(valid, mask_full)
+
+    if int_mode:
+        limbs_cat = (limb_parts[0] if len(limb_parts) == 1
+                     else jnp.concatenate(limb_parts, axis=0))
+        bad_cat = (bad_parts[0] if len(bad_parts) == 1
+                   else jnp.concatenate(bad_parts, axis=0))
+        limbs, bad, act = _launch(lambda: dd.mask_limbs_batch(
+            dd.permute_blocks(limbs_cat, perm_d),
+            dd.permute_blocks(bad_cat, perm_d), valid))
+        dd._bump("int_limb_slabs")
+    else:
+        scale0 = dd.limb_scale_dev(E)
+        limbs, bad, act = _launch(
+            lambda: dd.limbs_decompose(values, valid, scale0))
 
     st = BlockStack(reader.path, field, seg, E, recipe["sids"],
                     recipe["refs"], recipe["n_rows"], recipe["tmin"],
@@ -625,6 +980,7 @@ def _expand_recipe(recipe: dict, reader, field: str,
     st.t0_dev = t0min_d
     st.step_dev = steps_d
     st.rows_dev = rows32_d
+    st.int_only = int_mode
     return st, act
 
 
@@ -666,7 +1022,114 @@ def _slice_limb_range(limbs_dev, k0: int, k1: int):
     return fn(limbs_dev)
 
 
-def get_stacks(reader, field: str) -> list[BlockStack] | None:
+def dense_fill_compressed(sources, field: str, P: int, E):
+    """Decoded-plane devicecache fill for one dense (S, P) group
+    straight from COMPRESSED DFOR payloads (round 18): the packed word
+    lanes cross H2D (sites ``dfor``/``payload``), expansion runs in
+    the shared dfor_expand kernel classes, and ONE layout-keyed
+    assembly launch trims/reshapes the segments to the (S, P) planes —
+    with the (S, P, K) limb decomposition fused in when the query
+    needs exact sums (``E`` is not None). The dense H2D upload the
+    host fill would pay never happens.
+
+    Returns (vals_dev, valid_dev, limbs_dev | None, bad_any) or None
+    when ANY segment is ineligible — non-DFOR codec, bitmapped
+    validity (nulls), non-FLOAT column, header/rows mismatch, or a
+    non-f64 stage mode — in which case the caller takes the classic
+    host assembly upload, byte-identical planes either way. Values are
+    bit-identical to the host decode (dfor_expand's pinned parity) and
+    the limb planes to exactsum.host_limbs (limbs_stage's pinned
+    parity), so downstream dense reductions cannot tell the fills
+    apart."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..encoding import blocks as EBL
+    from ..encoding import dfor as _dfm
+    from ..query import decodestage
+    from ..record import DataType
+    from . import compileaudit, device_decode as dd
+    if decodestage.stage_mode() != "f64" or not sources:
+        return None
+    segs = []
+    for (reader, cm, si, lo, f) in sources:
+        colm = cm.column(field)
+        if colm is None or colm.type != DataType.FLOAT:
+            return None
+        s = colm.segments[si]
+        mm = reader._mm
+        if s.rows == 0 or mm[s.offset] != EBL.DFOR:
+            return None
+        if mm[s.valid_offset] != EBL.CONST:
+            return None          # bitmapped nulls → host assembly
+        hdr = mm[s.offset + 1:s.offset + 1 + _dfm.HEADER_BYTES]
+        tr, w, ds, n_hdr, ref = _dfm.parse_header(hdr)
+        if n_hdr != s.rows:
+            return None
+        nw = (s.rows * w + 31) // 32
+        words = np.frombuffer(
+            mm[s.offset + 1 + _dfm.HEADER_BYTES:
+               s.offset + 1 + _dfm.HEADER_BYTES + 4 * nw],
+            dtype="<u4")
+        segs.append((w, tr, ds, int(s.rows), ref, int(lo), int(f),
+                     words))
+    # batch same-shape segments into shared dfor_expand classes; the
+    # assembly order (and hence the (S, P) row order) is the sources
+    # order, exactly like the host run_dense concatenation
+    groups: dict = {}
+    order = []                     # (group_key, row_in_group, lo, f)
+    for (w, tr, ds, r, ref, lo, f, words) in segs:
+        gk = (w, tr, ds, r)
+        lst = groups.setdefault(gk, [])
+        order.append((gk, len(lst), lo, f))
+        lst.append((ref, words))
+    gkeys = sorted(groups)
+    outs = []
+    for gk in gkeys:
+        w, tr, ds, r = gk
+        blks = groups[gk]
+        nb_pad = dd.pad_pow2(len(blks), 8)
+        nw = (r * w + 31) // 32
+        wmat = np.zeros((nb_pad, nw + 2), dtype=np.uint32)
+        rvec = np.zeros(nb_pad, dtype=np.uint64)
+        for i, (ref, words) in enumerate(blks):
+            wmat[i, :nw] = words
+            rvec[i] = ref
+        wd = jax.device_put(wmat)
+        rd = jax.device_put(rvec)
+        compileaudit.record_h2d("dfor", int(wd.nbytes))
+        compileaudit.record_h2d("payload", int(rd.nbytes))
+        outs.append(dd.dfor_expand(wd, rd, n=r, width=w,
+                                   transform=tr, dscale=ds,
+                                   kind="f64"))
+    gidx = {gk: i for i, gk in enumerate(gkeys)}
+    layout = tuple((gidx[gk], i, lo, f) for gk, i, lo, f in order)
+    key = ("densefill", P, E is not None, layout)
+    fn = _JITTED.get(key)
+    if fn is None:
+        K = exactsum.K_LIMBS
+
+        def _f(parts, s0):
+            vals = jnp.concatenate(
+                [parts[gi][i, lo:lo + f * P].reshape(f, P)
+                 for (gi, i, lo, f) in layout], axis=0)
+            valid = jnp.ones(vals.shape, dtype=jnp.bool_)
+            if s0 is None:
+                return vals, valid, None, jnp.zeros((), jnp.bool_)
+            limbs, bad, _act = dd.limbs_stage(vals, valid, s0, K=K)
+            return vals, valid, limbs, bad.any()
+        fn = _JITTED[key] = _named_jit(
+            _f, ("densefill", P, len(layout)))
+    s0 = dd.limb_scale_dev(E) if E is not None else None
+    dv, dm, dl, bad = fn(tuple(outs), s0)
+    bad_any = bool(np.asarray(bad))
+    compileaudit.record_d2h("decode", 1)
+    dd._bump("dense_fills_compressed")
+    return dv, dm, dl, bad_any
+
+
+def get_stacks(reader, field: str,
+               pred=None) -> list[BlockStack] | None:
     """Cached slab list for (file, field); None when the column can't
     stack (missing, non-float) — negative results cache too. The
     decode stage is pluggable per block (query/decodestage.py): when
@@ -677,21 +1140,39 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
     the classic host build below, byte-identical planes either way."""
     if not devicecache.enabled():
         return None
+    from ..query import decodestage
+    int_mode = decodestage.stage_mode() == "int"
+    sfx: tuple = ("int",) if int_mode else ()
+    if pred is not None:
+        sfx += ("pd", pred.key)
     cache = devicecache.global_cache()
-    key = (reader.path, field, "blockslabs")
+    key = (reader.path, field, "blockslabs") + sfx
     got = cache.get(key)
     if got is _NO_STACK:
         return None
     if got is not None:
         return got
-    slabs = _stacks_from_compressed(reader, field)
+    slabs = _stacks_from_compressed(reader, field, sfx)
     if slabs is None:
         layout = _file_layout(reader, field)
         if layout is None:
             cache.put(key, _NO_STACK)
             return None
         metas, seg, E = layout
-        slabs = _build_stacks_device(reader, field, metas, seg, E)
+        if pred is not None:
+            # envelope pre-filter: wholly-outside segments never
+            # batch, upload, or expand (counters feed the perf_smoke
+            # selectivity gate)
+            metas = _classify_metas(reader, pred, metas)
+            if not metas:
+                # every segment skipped: an EMPTY slab list (not
+                # None) — the caller still consumes the sources
+                cache.put(key, [])
+                return []
+            layout = (metas, seg, E)
+        slabs = _build_stacks_device(reader, field, metas, seg, E,
+                                     sfx, pred=pred,
+                                     int_mode=int_mode)
     if slabs is None:
         metas, seg, E = layout
         built = []
@@ -701,7 +1182,7 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
         for i in range(0, len(metas), SLAB_BLOCKS):
             st, limbs = _build_slab(reader, field,
                                     metas[i:i + SLAB_BLOCKS], seg, E,
-                                    block0)
+                                    block0, pred=pred)
             # file-wide active limb-plane range (plane k is dead iff
             # every row's k-th limb is 0 — dead planes sum to 0, so
             # skipping them is exact)
@@ -723,14 +1204,21 @@ def get_stacks(reader, field: str) -> list[BlockStack] | None:
     # put() staked a 64-byte placeholder) — reprice mirrors the charge
     # into the HBM ledger too (ops/hbm.py)
     cache.reprice(key, sum(s.nbytes for s in slabs))
-    from . import devstats
+    from . import device_decode as _dd, devstats
+    # rows that actually expanded/staged — the packed-predicate diet
+    # shrinks this vs an OG_PACKED_PREDICATE=0 run of the same query
+    # (bench's selectivity gate divides the two)
+    _dd._bump("pushdown_lanes_expanded",
+              sum(s.n_rows for s in slabs))
     devstats.bump("slabs_built", len(slabs))
     devstats.bump("slab_bytes", sum(s.nbytes for s in slabs))
     return slabs
 
 
 def _build_stacks_device(reader, field: str, metas, seg: int,
-                         E: int) -> list[BlockStack] | None:
+                         E: int, sfx: tuple = (), pred=None,
+                         int_mode: bool = False
+                         ) -> list[BlockStack] | None:
     """Device-decode build of a whole (file, field): slabs expand from
     compressed payloads in-kernel, limb planes decompose on device,
     and the payload recipes stake into the compressed HBM tier. None
@@ -756,7 +1244,8 @@ def _build_stacks_device(reader, field: str, metas, seg: int,
         w_dev = sum(
             1 for (_sid, _colm, s, tseg) in window
             if s.rows and decodestage.block_stage(
-                mm[s.offset], mm[tseg.offset]) == "device")
+                mm[s.offset], mm[tseg.offset]) == "device"
+            and (not int_mode or _int_block_ok(mm, s, E)))
         if w_dev == 0:
             return None      # an all-host slab window: host build
         n_dev += w_dev
@@ -770,7 +1259,7 @@ def _build_stacks_device(reader, field: str, metas, seg: int,
         for i in range(0, len(metas), SLAB_BLOCKS):
             st, act, rec = _build_slab_device(
                 reader, field, metas[i:i + SLAB_BLOCKS], seg, E,
-                block0)
+                block0, pred=pred, int_mode=int_mode)
             built.append((st, act))
             recipes.append(rec)
             block0 += st.n_blocks
@@ -797,7 +1286,7 @@ def _build_stacks_device(reader, field: str, metas, seg: int,
         st.k0 = k0
         rec["k0"], rec["k1"] = k0, k1
         slabs.append(st)
-    _stake_compressed(reader, field, recipes)
+    _stake_compressed(reader, field, recipes, sfx)
     dd._bump("slabs_device_decoded", len(slabs))
     devstats.bump_phase("device_decode",
                         _time.perf_counter_ns() - t_ns)
@@ -816,8 +1305,15 @@ def _recipe_nbytes(recipes: list) -> int:
     for rec in recipes:
         for (wd, rd, _w, _tr, _ds, _r, _i) in rec["dfor"]:
             nb += int(wd.nbytes + rd.nbytes)
+        for (pvd, pld, rrd, _i) in rec.get("rle", ()):
+            nb += int(pvd.nbytes + pld.nbytes + rrd.nbytes)
         if rec["const"] is not None:
             nb += int(rec["const"][0].nbytes + rec["const"][1].nbytes)
+        for plan in rec.get("pdmask") or ():
+            if plan is not None:
+                nb += int(plan[2].nbytes)
+        if rec.get("pdf") is not None:
+            nb += int(rec["pdf"].nbytes)
         if rec["tbatch"] is not None:
             nb += sum(int(a.nbytes) for a in rec["tbatch"][:5])
         if rec.get("meta_dev") is not None:
@@ -825,17 +1321,19 @@ def _recipe_nbytes(recipes: list) -> int:
     return nb
 
 
-def _stake_compressed(reader, field: str, recipes: list) -> None:
+def _stake_compressed(reader, field: str, recipes: list,
+                      sfx: tuple = ()) -> None:
     """Stake a file's payload recipes into the compressed HBM tier:
     the device-resident words/refs/metadata that can rebuild every
     slab with zero H2D after a decoded-tier eviction (the relief
-    ladder evicts decoded planes FIRST for exactly this reason)."""
+    ladder evicts decoded planes FIRST for exactly this reason).
+    ``sfx`` distinguishes pred-masked / int-mode recipe sets."""
     comp = devicecache.compressed_cache()
-    comp.put_sized((reader.path, field, "dforrecipe"), recipes,
+    comp.put_sized((reader.path, field, "dforrecipe") + sfx, recipes,
                    _recipe_nbytes(recipes))
 
 
-def _stacks_from_compressed(reader, field: str
+def _stacks_from_compressed(reader, field: str, sfx: tuple = ()
                             ) -> list[BlockStack] | None:
     """Rebuild a file's slabs from the compressed HBM tier: the
     decoded planes were evicted but the payload bytes stayed device-
@@ -852,7 +1350,7 @@ def _stacks_from_compressed(reader, field: str
     if not decodestage.device_stage_available():
         return None
     recipes = devicecache.compressed_cache().get(
-        (reader.path, field, "dforrecipe"))
+        (reader.path, field, "dforrecipe") + sfx)
     if recipes is None:
         return None
     t_ns = _time.perf_counter_ns()
@@ -995,7 +1493,11 @@ def _mask_stage(values, valid, times, limbs, bad, gids, block0,
     use_mask = W <= MASK_W_MAX
     t_lo, t_hi, start, interval = (scalars[0], scalars[1],
                                    scalars[2], scalars[3])
-    B = values.shape[0]
+    # shape/index sources come from the VALID plane: int-mode slabs
+    # (OG_LIMB_INT, round 18) carry values=None — the executor gates
+    # their wants to count/sum, so values is only ever touched under
+    # sumsq/min/max
+    B = valid.shape[0]
     m0 = (valid & (times >= t_lo) & (times <= t_hi)
           & (gids >= 0)[:, None])
     wid = (times - start) // interval
@@ -1007,7 +1509,7 @@ def _mask_stage(values, valid, times, limbs, bad, gids, block0,
         wid32 = wid.astype(jnp.int32)
         gidx = (block0 * SEG
                 + jnp.arange(B * SEG, dtype=jnp.float64).reshape(
-                    values.shape))
+                    valid.shape))
         st1 = {k: [] for k in ("count", "limbs", "bad", "sumsq",
                                "min", "min_idx", "max", "max_idx")}
         for w in range(W):
@@ -1089,8 +1591,8 @@ def _mask_stage(values, valid, times, limbs, bad, gids, block0,
     # scatter fallback for wide windows (rare under the cell cap):
     # i32 segment ids + f64 accumulators — the round-2 int64
     # scatters hit the 64-bit emulation path and were ~60× slower
-    n = values.shape[0] * SEG
-    v = values.reshape(n)
+    n = valid.shape[0] * SEG
+    v = values.reshape(n) if values is not None else None
     m = m0.reshape(n)
     lb = limbs.reshape(n, K) if "sum" in want else None
     bd = bad.reshape(n)
@@ -1732,7 +2234,7 @@ def _kernel_prefix(num_segments: int, want: tuple, W: int, K: int,
            w0, gather_idx):
         t_lo, t_hi, start, interval = (scalars[0], scalars[1],
                                        scalars[2], scalars[3])
-        B = values.shape[0]
+        B = valid.shape[0]          # values is None on int-mode slabs
         m0 = (valid & (times >= t_lo) & (times <= t_hi)
               & (gids >= 0)[:, None])
         # int64-overflow-safe window ids, monotone per block (times

@@ -54,7 +54,10 @@ __all__ = ["rle_expand", "const_expand", "const_delta_expand",
            "device_decode_int_block", "dfor_expand", "pad_pow2",
            "times_expand_batch", "validity_expand_batch",
            "const_expand_batch", "limbs_decompose", "permute_blocks",
-           "device_decode_on", "DECODE_STATS"]
+           "device_decode_on", "DECODE_STATS", "dfor_expand_pred",
+           "plane_mask", "k_mask", "and_planes", "rle_expand_batch",
+           "int_limbs_batch", "const_limbs_batch",
+           "mask_limbs_batch"]
 
 I64MAX = np.iinfo(np.int64).max
 
@@ -70,6 +73,16 @@ DECODE_STATS: dict = register_counters("device_decode", {
     "slabs_device_decoded": 0,
     "compressed_hits": 0,    # slab rebuilds served from the HBM
     "compressed_rebuilds": 0,  # compressed tier (zero H2D)
+    "rle_blocks": 0,         # RLE segments expanded on device
+    "int_limb_slabs": 0,     # slabs limb-decomposed in int space
+    "dense_fills_compressed": 0,  # dense-group plane fills served
+                                  # straight from compressed payloads
+    # packed-space predicate pushdown (ops/pushdown.py, round 18)
+    "pushdown_segments_skipped": 0,  # envelope-skipped, never expand
+    "pushdown_rows_skipped": 0,      # rows inside skipped segments
+    "pushdown_blocks_masked": 0,     # partial blocks (row masks)
+    "pushdown_lanes_expanded": 0,    # rows expanded under a pred build
+    "pushdown_heals": 0,             # mask launches healed to host
 })
 
 
@@ -362,6 +375,224 @@ def dfor_expand(words_dev, refs_dev, *, n: int, width: int,
         return _finish_fn(transform, kind, n)(r32, refs_dev, scale)
     return _wide_fn(transform, kind, n, width)(
         words_dev, refs_dev, scale)
+
+
+def pred_finish_stage(r, refs, scale, thr, *, transform: int,
+                      mode: str, sig: tuple):
+    """Trace-composable inverse transform + packed-predicate mask:
+    (values f64, mask bool) from the SAME unpacked residuals — the
+    pushdown launch never walks the words twice. ``mode`` "int"
+    compares the un-zigzagged integer k against traced int64
+    thresholds (exact, ops/pushdown.translate); "f64" compares the
+    decoded plane (XOR fallback — the identical IEEE compares the
+    host residual would run).
+
+    The decimal divide stays the TRACED-operand divide from
+    _traced_inverse on this survivor-masked path too — a trace-
+    constant scale would let XLA strength-reduce to a reciprocal
+    multiply and re-open the PR 13 1-ulp drift (pinned by
+    tests/test_pushdown.py::test_masked_expand_bit_identity)."""
+    from . import pushdown as _pd
+    v = _traced_inverse(r, refs, scale, transform, "f64")
+    if mode == "int":
+        refs_u = refs.astype(_U64)[:, None]
+        u = (r >> _U64(1)) ^ (_U64(0) - (r & _U64(1)))
+        k = jax.lax.bitcast_convert_type(u + refs_u, jnp.int64)
+        m = _pd.mask_from_k_stage(k, thr, sig=sig)
+    else:
+        m = _pd.mask_from_values_stage(v, thr, sig=sig)
+    return v, m
+
+
+def dfor_expand_pred(words_dev, refs_dev, thr_dev, *, n: int,
+                     width: int, transform: int, dscale: int,
+                     mode: str, sig: tuple,
+                     interpret: bool | None = None):
+    """Batched expand WITH packed-predicate mask in one launch:
+    (nb, n) f64 values + (nb, n) bool survivor mask. Thresholds ride
+    as TRACED operands, so one compiled class per interned
+    (mode, ops-signature) serves every literal
+    (query/plancache.intern_pred_class names the class for the
+    compile auditor)."""
+    from ..query import plancache
+    _bump("batches")
+    scale = _scale_dev(dscale)
+    pid, _name = plancache.intern_pred_class((mode, sig))
+    if 0 < width <= 32:
+        r32 = _pallas_unpack(words_dev, n, width, interpret)
+        key = ("dforpred", transform, mode, pid, n)
+        fn = _JITTED.get(key)
+        if fn is None:
+            def _f(r32, refs, scale, thr):
+                return pred_finish_stage(
+                    r32.astype(_U64), refs, scale, thr,
+                    transform=transform, mode=mode, sig=sig)
+            fn = _JITTED[key] = _named_jit(_f, key)
+        return fn(r32, refs_dev, scale, thr_dev)
+    key = ("dforpredwide", transform, mode, pid, n, width)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(words, refs, scale, thr):
+            if width == 0:
+                r = jnp.zeros((words.shape[0], n), dtype=_U64)
+            else:
+                r = _traced_unpack_wide(words, n, width)
+            return pred_finish_stage(r, refs, scale, thr,
+                                     transform=transform, mode=mode,
+                                     sig=sig)
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(words_dev, refs_dev, scale, thr_dev)
+
+
+def plane_mask(values_dev, thr_dev, *, sig: tuple):
+    """Post-expand predicate mask over an already-decoded (nb, seg)
+    f64 plane (CONST-batch / RLE-partial / host-plane pushdown): the
+    same traced f64 compares as pred_finish_stage mode "f64"."""
+    from ..query import plancache
+    from . import pushdown as _pd
+    pid, _name = plancache.intern_pred_class(("f64", sig))
+    key = ("planemask", pid)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(v, thr):
+            return _pd.mask_from_values_stage(v, thr, sig=sig)
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(values_dev, thr_dev)
+
+
+def k_mask(k_dev, thr_dev, *, sig: tuple):
+    """Int-mode packed-predicate mask over an (nb, seg) i64 k plane
+    (the limb-decomposition input): exact int64 compares against the
+    translated thresholds."""
+    from ..query import plancache
+    from . import pushdown as _pd
+    pid, _name = plancache.intern_pred_class(("int", sig))
+    key = ("kmask", pid)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(k, thr):
+            return _pd.mask_from_k_stage(k, thr, sig=sig)
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(k_dev, thr_dev)
+
+
+def and_planes(a_dev, b_dev):
+    """valid ∧ survivor-mask combine (both (B, seg) bool, meta
+    order) — the point where the packed predicate lands on the valid
+    plane every downstream kernel masks by."""
+    key = ("andplane",)
+    fn = _JITTED.get(key)
+    if fn is None:
+        fn = _JITTED[key] = _named_jit(lambda a, b: a & b, key)
+    return fn(a_dev, b_dev)
+
+
+# ------------------------------------------- RLE batched expansion
+
+def rle_expand_batch(vals_dev, lens_dev, rows_dev, seg: int):
+    """Batched device RLE expansion (the decode-frontier holdout at
+    device_decode_float_block's single-block path): (nb, R) run
+    values + run lengths → (nb, seg) dense f64 rows, zero beyond the
+    real rows. cumsum over run lengths + a per-row searchsorted
+    reproduces np.repeat exactly (host decoder parity is pinned under
+    jax.transfer_guard("disallow") in tests/test_device_decode.py);
+    run counts bucket through _pad_runs so jit cache keys recur."""
+    R = int(vals_dev.shape[1])
+    key = ("rlebatch", R, seg)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(vals, lens, rows):
+            return rle_stage(vals, lens, rows, R=R, seg=seg)
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(vals_dev, lens_dev, rows_dev)
+
+
+def rle_stage(vals, lens, rows, *, R: int, seg: int):
+    """Trace-composable body of rle_expand_batch."""
+    cum = jnp.cumsum(lens, axis=1)
+    i = jnp.arange(seg, dtype=jnp.int64)
+    idx = jax.vmap(
+        lambda c: jnp.searchsorted(c, i, side="right"))(cum)
+    out = jnp.take_along_axis(vals, jnp.clip(idx, 0, R - 1), axis=1)
+    return jnp.where(i[None, :] < rows[:, None], out, 0.0)
+
+
+# ------------------------------------- int-space limb decomposition
+
+def int_limbs_batch(k_dev, *, E: int):
+    """Integer-space twin of limbs_decompose for T_INT segments
+    (round 18 — the real-f64 gate's escape route): (nb, seg) i64
+    integer values → (nb, seg, K) i32 limb planes via STATIC binary
+    shifts only. Every op is integer → exact on f32-pair-emulated
+    backends where the f64 floor/divide cascade drifts. The caller
+    guarantees |k| < 2^E (ops/blockagg checks the segment envelope at
+    build; over-range blocks host-stage), so the host clamp cascade
+    never engages and the base-2^18 digits are pure bit windows —
+    bit-identical to exactsum.host_limbs on f64(k) by construction."""
+    from . import exactsum
+    K = exactsum.K_LIMBS
+    key = ("intlimbs", E, K)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(k):
+            return int_limbs_stage(k, E=E, K=K)
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(k_dev)
+
+
+def int_limbs_stage(k, *, E: int, K: int):
+    """Trace-composable body of int_limbs_batch: limb j is the 18-bit
+    window of |k| at bit position E - 18*(j+1), times sign. Windows
+    below the binary point (negative shift) are zero for integers;
+    E ≤ 72 for int64-representable magnitudes, so E - 108 < 0 and the
+    residue (hence bad) is identically zero."""
+    neg = k < 0
+    a = jax.lax.bitcast_convert_type(jnp.where(neg, -k, k), _U64)
+    sign = jnp.where(neg, -1, 1).astype(jnp.int32)
+    limbs = []
+    for j in range(K):
+        s = E - 18 * (j + 1)
+        if 0 <= s < 64:
+            d = ((a >> _U64(s)) & _U64(0x3FFFF)).astype(jnp.int32)
+        else:
+            d = jnp.zeros(k.shape, dtype=jnp.int32)
+        limbs.append(sign * d)
+    return jnp.stack(limbs, axis=-1)
+
+
+def const_limbs_batch(vecs_dev, bad_dev, seg: int):
+    """CONST int-mode batch: per-block HOST-computed limb vectors
+    (exactsum.host_limbs on one value — f64 host math, exact)
+    broadcast to (nb, seg, K) plane rows + (nb, seg) bad rows; the
+    final valid mask (mask_limbs_batch) zeroes the padding."""
+    K = int(vecs_dev.shape[1])
+    key = ("constlimbs", K, seg)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(vecs, bad):
+            nb = vecs.shape[0]
+            lb = jnp.broadcast_to(vecs[:, None, :], (nb, seg, K))
+            bd = jnp.broadcast_to(bad[:, None], (nb, seg))
+            return lb, bd
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(vecs_dev, bad_dev)
+
+
+def mask_limbs_batch(limbs_dev, bad_dev, valid_dev):
+    """Assembled int-mode limb planes → valid-masked planes +
+    activity flags (the exact tail of limbs_stage: limbs zero where
+    invalid, bad only where valid)."""
+    K = int(limbs_dev.shape[-1])
+    key = ("limbmaskb", K)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def _f(lb, bd, valid):
+            lb = jnp.where(valid[..., None], lb, 0)
+            bd = bd & valid
+            act = (lb != 0).any(axis=(0, 1))
+            return lb, bd, act
+        fn = _JITTED[key] = _named_jit(_f, key)
+    return fn(limbs_dev, bad_dev, valid_dev)
 
 
 # ------------------------------------ batched slab-plane expanders

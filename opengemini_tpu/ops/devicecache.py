@@ -446,6 +446,37 @@ def put_decoded_planes(fp: str, field: str, E, vals, valid, limbs):
     return (base[0], base[1], dl)
 
 
+def stake_decoded_planes(fp: str, field: str, E, dv, dm, dl):
+    """put_decoded_planes for planes that are ALREADY device-resident
+    (the round-18 compressed fill, ops/blockagg.dense_fill_compressed,
+    expands packed payloads on device — there is no host array to
+    upload and no ``planes`` H2D to book; the payload bytes were
+    recorded at staging time). Same keys, same base-fill lock, same
+    failpoint, same accounting minus the device_put."""
+    from ..utils import failpoint
+    failpoint.inject("devicecache.fill")
+    cache = global_cache() if enabled() else None
+    nb = 0
+    with _base_fill_lock(fp, field):
+        base = cache.get(_vals_key(fp, field)) if cache is not None \
+            else None
+        if base is None:
+            nb += int(dv.nbytes + dm.nbytes)
+            base = (dv, dm)
+            if cache is not None:
+                cache.put_sized(_vals_key(fp, field), base,
+                                int(dv.nbytes + dm.nbytes))
+    if dl is not None:
+        nb += int(dl.nbytes)
+        if cache is not None:
+            cache.put_sized(_limb_key(fp, field, E), dl,
+                            int(dl.nbytes))
+    if cache is not None:
+        _bump_plane("plane_puts")
+        _bump_plane("plane_put_bytes", nb)
+    return (base[0], base[1], dl)
+
+
 def put_no_planes(fp: str, field: str, E) -> None:
     """Mark (group, field, scale) as undecomposable (residue rows):
     the bad flags depend on E, so the marker lives on the limb key and

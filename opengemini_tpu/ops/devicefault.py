@@ -48,7 +48,10 @@ Failpoint sites (utils/failpoint.py; arm with actions oom / transient
 ``device.segagg.launch``, ``device.finalize.launch``,
 ``pipeline.submit``, ``pipeline.pull``, ``pipeline.unpack``,
 ``devicecache.fill``, ``devicecache.evict``, ``hbm.reconcile``,
-``blockagg.lattice_fold``, ``device.fused.launch``.
+``blockagg.lattice_fold``, ``device.fused.launch``,
+``device.pushdown.eval`` (round 18: packed-space predicate mask
+launches — heals per batch to expand-then-filter on host-identical
+masks; rides route ``block``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,459 @@
+"""Packed-space predicate pushdown (round 18) — filter BEFORE decode.
+
+PR 13 put compressed DFOR bytes on the device and PR 17 fused the
+whole lattice plan, but WHERE residuals still evaluated on fully
+EXPANDED planes: every segment paid bit-unpack + inverse-transform
+even when 99% of its rows were about to be filtered out. This module
+is the planner + translation layer that moves the filter into packed
+space ("GPU Acceleration of SQL Analytics on Compressed Data",
+PAPERS.md):
+
+* ``plan_residual`` classifies a WHERE residual as packed-translatable
+  — an AND of ``field op numeric-literal`` comparisons on ONE field —
+  and normalizes it into a :class:`PackedPredicate`.
+* ``translate`` turns each conjunct into an EXACT integer-space
+  constraint on the un-zigzagged DFOR residual ``k`` (``v op c`` ⇔
+  ``k op' K``): for zigzag-delta ints the stored f64 is the integer
+  ``k`` bit-for-bit, so a Fraction-exact floor/ceil of the literal is
+  the whole translation; for decimal-scaled ints the stored value is
+  ``fl(k / 10^d)`` — the threshold search walks the few candidate
+  ``k`` around the rational boundary with REAL np.float64 arithmetic,
+  so the integer compare reproduces the rounded float compare
+  bit-for-bit. Equality on decimal-scaled ints becomes a single
+  packed ``k == K`` that never decodes.
+* ``classify`` evaluates the predicate against a segment's
+  frame-of-reference envelope ``[ref - 2^(w-1), ref + 2^(w-1) - 1]``
+  (Python bignums — int64 wrap disables the skip, never the row
+  compare): segments wholly outside skip ALL per-row work (they are
+  dropped before the slab even batches), segments wholly inside pay
+  no mask.
+* Non-translatable transforms (prefix-XOR floats) fall back to
+  expand-then-filter: the SAME f64 compare numpy would run, traced —
+  byte-identical by construction (mode "f64").
+
+The masks land on the slab VALID plane before limb decomposition, so
+every downstream route (staged lattice, fused whole-plan, min/max
+mask kernel, count) late-materializes only surviving lanes without
+knowing pushdown exists. ``OG_PACKED_PREDICATE=0`` keeps the classic
+expand-then-residual path — byte-identical escape hatch. Mask
+launches ride breaker route ``block`` at the ``device.pushdown.eval``
+failpoint and heal per batch to host expand-then-filter
+(ops/blockagg._heal_mask) under the PR 9 ladder.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+
+from ..encoding import dfor as _dfor
+from ..utils import knobs
+
+_CMP_OPS = ("<", "<=", ">", ">=", "=", "!=")
+
+# literal-first leaves normalize field-first (mirrors
+# query/condition._walk_and's flip map)
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+         "=": "=", "!=": "!="}
+
+
+def packed_predicate_on() -> bool:
+    """OG_PACKED_PREDICATE gate, read per query (perf_smoke diffs the
+    packed and expand-then-filter routes digest-for-digest)."""
+    return bool(knobs.get("OG_PACKED_PREDICATE"))
+
+
+class PackedPredicate:
+    """Normalized AND-of-comparisons on one field.
+
+    ``conjs`` is a tuple of ``(op, c)`` with ``op`` field-first in
+    ``_CMP_OPS`` and ``c`` a python float (the np.float64 the numpy
+    residual compare would coerce the literal to — int literals ride
+    NEP-50 weak promotion to f64, so this IS the compared value).
+    ``key`` is the full value identity (cache key for pred-masked
+    slabs); ``sig`` is the threshold-free ops signature (compile
+    class — thresholds ride as traced operands)."""
+
+    __slots__ = ("field", "conjs")
+
+    def __init__(self, field: str, conjs: tuple):
+        self.field = field
+        self.conjs = conjs
+
+    @property
+    def key(self) -> tuple:
+        return (self.field, self.conjs)
+
+    @property
+    def sig(self) -> tuple:
+        return tuple(op for op, _c in self.conjs)
+
+    def __repr__(self):
+        body = " and ".join(f"{self.field} {op} {c!r}"
+                            for op, c in self.conjs)
+        return f"PackedPredicate({body})"
+
+
+def plan_residual(residual, tag_keys=()) -> PackedPredicate | None:
+    """Classify a residual AST as packed-translatable → normalized
+    PackedPredicate, or None (stays on the post-expand path). Only
+    AND-trees of ``field op numeric-literal`` over ONE non-tag field
+    qualify; regex/string ops, OR trees, arithmetic and multi-field
+    residuals all stay behind."""
+    from ..query.ast import BinaryExpr, FieldRef, Literal
+    if residual is None:
+        return None
+    leaves: list = []
+
+    def walk(e) -> bool:
+        if isinstance(e, BinaryExpr) and e.op == "and":
+            return walk(e.lhs) and walk(e.rhs)
+        if not isinstance(e, BinaryExpr) or e.op not in _CMP_OPS:
+            return False
+        lhs, rhs, op = e.lhs, e.rhs, e.op
+        if isinstance(lhs, Literal) and isinstance(rhs, FieldRef):
+            lhs, rhs, op = rhs, lhs, _FLIP[op]
+        if not (isinstance(lhs, FieldRef) and isinstance(rhs, Literal)):
+            return False
+        v = rhs.value
+        # bool is an int subclass — numpy compares it as 0/1 but the
+        # intent is almost surely a typo'd tag filter; stay safe
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False
+        leaves.append((lhs.name, op, float(np.float64(v))))
+        return True
+
+    if not walk(residual) or not leaves:
+        return None
+    fields = {f for f, _o, _c in leaves}
+    if len(fields) != 1:
+        return None
+    field = next(iter(fields))
+    if field == "time" or field in set(tag_keys):
+        return None
+    return PackedPredicate(field,
+                           tuple((op, c) for _f, op, c in leaves))
+
+
+# ------------------------------------------------ exact translation
+#
+# Integer-space constraint forms on the decoded integer k:
+#   ("ge", K) ("le", K) ("eq", K) ("ne", K) ("true",) ("false",)
+
+def _int_constraint(op: str, c: float) -> tuple:
+    """T_INT: stored value v == f64(k) EXACTLY (slabs stack FLOAT
+    columns only, so k came FROM an f64 — conversion is lossless at
+    any magnitude). Both sides of the numpy compare are exact reals
+    → Fraction floor/ceil of the literal is the exact translation."""
+    if np.isnan(c):
+        return ("true",) if op == "!=" else ("false",)
+    if np.isinf(c):
+        pos = c > 0
+        if op in ("<", "<="):
+            return ("true",) if pos else ("false",)
+        if op in (">", ">="):
+            return ("false",) if pos else ("true",)
+        return ("true",) if op == "!=" else ("false",)
+    f = Fraction(c)
+    integral = f.denominator == 1
+    if op == "<":
+        return ("le", (f.numerator - 1) if integral else _ffloor(f))
+    if op == "<=":
+        return ("le", _ffloor(f))
+    if op == ">":
+        return ("ge", (f.numerator + 1) if integral else _fceil(f))
+    if op == ">=":
+        return ("ge", _fceil(f))
+    if op == "=":
+        return ("eq", f.numerator) if integral else ("false",)
+    return ("ne", f.numerator) if integral else ("true",)
+
+
+def _ffloor(f: Fraction) -> int:
+    return f.numerator // f.denominator
+
+
+def _fceil(f: Fraction) -> int:
+    return -((-f.numerator) // f.denominator)
+
+
+def _scaled_constraint(op: str, c: float, ds: int) -> tuple:
+    """T_SCALED: stored value v == fl(k / 10^ds) — the f64 DIVISION
+    ROUNDS, so the exact rational boundary can sit one k off the
+    float-compare boundary. Start from the Fraction boundary and walk
+    ±2 candidates with the same np.float64 divide the decoder runs
+    (monotone in k), landing on thresholds that reproduce the rounded
+    compare bit-for-bit. |k| < 2^51 (encoding/dfor._try_scaled), so
+    f64(k) is exact and fl is strictly monotone over distinct k."""
+    if np.isnan(c) or np.isinf(c):
+        return _int_constraint(op, c)      # same whole-line semantics
+    S = 10 ** ds
+    Sf = np.float64(10.0 ** ds)
+
+    def val(k: int) -> np.float64:
+        return np.float64(k) / Sf
+
+    f = Fraction(c) * S
+    if op in ("<", "<="):
+        # K = max{k : fl(k/S) op c} — rounding shifts the boundary by
+        # at most one k (0.5 ulp < half a k-unit at |k| < 2^51), the
+        # ±4 window is pure paranoia; an unexpectedly empty window
+        # falls back to the f64 row compare (None)
+        ok = (lambda x: x < c) if op == "<" else (lambda x: x <= c)
+        for k in range(_ffloor(f) + 4, _ffloor(f) - 5, -1):
+            if ok(val(k)):
+                return ("le", k)
+        return None
+    if op in (">", ">="):
+        ok = (lambda x: x > c) if op == ">" else (lambda x: x >= c)
+        for k in range(_fceil(f) - 4, _fceil(f) + 5):
+            if ok(val(k)):
+                return ("ge", k)
+        return None
+    # =, != : distinct k give distinct floats (spacing 10^-ds beats
+    # ulp at |k| < 2^51), so at most one k matches
+    k0 = _ffloor(f)
+    hit = [k for k in range(k0 - 2, k0 + 3) if val(k) == c]
+    if op == "=":
+        return ("eq", hit[0]) if hit else ("false",)
+    return ("ne", hit[0]) if hit else ("true",)
+
+
+def translate(pred: PackedPredicate, transform: int,
+              dscale: int) -> list | None:
+    """Integer-space constraint list for one (transform, dscale)
+    class, or None when the transform is not packed-translatable
+    (zigzag is monotone-decodable; the XOR transforms are not).
+    ``("false",)`` anywhere means the whole class is empty."""
+    if transform not in (_dfor.T_INT, _dfor.T_SCALED):
+        return None
+    out = []
+    for op, c in pred.conjs:
+        if transform == _dfor.T_INT:
+            con = _int_constraint(op, c)
+        else:
+            con = _scaled_constraint(op, c, dscale)
+        if con is None:
+            return None
+        if con[0] == "false":
+            return [("false",)]
+        if con[0] != "true":
+            out.append(con)
+    return out
+
+
+_I64_LO, _I64_HI = -(1 << 63), (1 << 63) - 1
+
+
+def clamp_constraints(cons: list) -> list | None:
+    """Saturate thresholds into int64 (device compare operands).
+    Returns None when saturation makes the class empty ("none")."""
+    out = []
+    for con in cons:
+        if con[0] == "false":
+            return None
+        kind, K = con
+        if kind == "ge":
+            if K > _I64_HI:
+                return None
+            out.append(("ge", max(K, _I64_LO)))
+        elif kind == "le":
+            if K < _I64_LO:
+                return None
+            out.append(("le", min(K, _I64_HI)))
+        elif kind == "eq":
+            if not (_I64_LO <= K <= _I64_HI):
+                return None
+            out.append(con)
+        else:                                   # ne
+            if _I64_LO <= K <= _I64_HI:
+                out.append(con)
+    return out
+
+
+# -------------------------------------------- envelope classification
+
+def envelope_k(w: int, ref: int) -> tuple | None:
+    """Exact k-interval [klo, khi] of a DFOR int-space segment from
+    its header (Python bignums), or None when the un-zigzagged delta
+    can wrap int64 (the interval would be a torus arc — the per-row
+    compare stays exact, only the SKIP is disabled)."""
+    if w >= 64:
+        return None
+    ref_i = ref - (1 << 64) if ref >= (1 << 63) else ref
+    if w == 0:
+        return (ref_i, ref_i)
+    half = 1 << (w - 1)
+    klo, khi = ref_i - half, ref_i + half - 1
+    if klo < _I64_LO or khi > _I64_HI:
+        return None
+    return (klo, khi)
+
+
+def classify_interval(cons: list, klo: int, khi: int) -> str:
+    """\"all\" | \"none\" | \"partial\" of the AND of int-space
+    constraints over k ∈ [klo, khi]."""
+    if cons and cons[0][0] == "false":
+        return "none"
+    all_ok = True
+    for kind, K in cons:
+        if kind == "ge":
+            if khi < K:
+                return "none"
+            if klo < K:
+                all_ok = False
+        elif kind == "le":
+            if klo > K:
+                return "none"
+            if khi > K:
+                all_ok = False
+        elif kind == "eq":
+            if K < klo or K > khi:
+                return "none"
+            if klo != khi:
+                all_ok = False
+        else:                                   # ne
+            if klo == khi == K:
+                return "none"
+            if klo <= K <= khi:
+                all_ok = False
+    return "all" if all_ok else "partial"
+
+
+def classify_dfor(pred: PackedPredicate, transform: int, w: int,
+                  ds: int, ref: int) -> str:
+    """Per-segment envelope decision from the DFOR header alone:
+    \"none\" → the segment is DROPPED before any device work;
+    \"all\" → no mask needed; \"partial\" → packed row mask;
+    \"fallback\" → post-expand f64 row mask (XOR transforms, or an
+    envelope the int space can't bound)."""
+    cons = translate(pred, transform, ds)
+    if cons is None:
+        return "fallback"
+    if cons and cons[0][0] == "false":
+        return "none"
+    env = envelope_k(w, ref)
+    if env is None:
+        return "partial"
+    return classify_interval(cons, env[0], env[1])
+
+
+def eval_numpy(pred: PackedPredicate, values: np.ndarray) -> np.ndarray:
+    """Host mask over raw f64 values — EXACTLY the compares
+    query/condition.eval_residual would run leaf-by-leaf (the caller
+    ANDs validity, same as the leaf's ``& valid``). This is the
+    ground truth every device mask is pinned against, and the heal
+    target when the pushdown launch faults."""
+    m = np.ones(values.shape, dtype=bool)
+    with np.errstate(invalid="ignore"):
+        for op, c in pred.conjs:
+            if op == "<":
+                m &= values < c
+            elif op == "<=":
+                m &= values <= c
+            elif op == ">":
+                m &= values > c
+            elif op == ">=":
+                m &= values >= c
+            elif op == "=":
+                m &= values == c
+            else:
+                m &= values != c
+    return m
+
+
+def classify_const(pred: PackedPredicate, val: float) -> str:
+    """CONST segments carry one value — the envelope IS the value
+    (numpy f64 compare semantics, NaN-aware)."""
+    return "all" if bool(eval_numpy(pred, np.array([val]))[0]) \
+        else "none"
+
+
+def classify_runs(pred: PackedPredicate, run_vals: np.ndarray) -> str:
+    """RLE segments: the run values are the (tiny) host-parsed
+    payload — evaluate them directly (exact, NaN-aware; no envelope
+    approximation needed)."""
+    m = eval_numpy(pred, run_vals)
+    if m.all():
+        return "all"
+    if not m.any():
+        return "none"
+    return "partial"
+
+
+# ---------------------------------------------- device mask recipes
+
+def batch_mask_plan(pred: PackedPredicate, transform: int, w: int,
+                    ds: int, classes: list):
+    """Mask plan for ONE same-(w, transform, ds) expand batch whose
+    per-block classes are ``classes`` (never \"none\" — those blocks
+    were dropped before batching). Returns None (all \"all\": no mask
+    work at all) or (mode, sig, thr_host):
+
+    * ("int", sig, (m,) i64) — packed compare on the un-zigzagged k
+      inside the SAME launch that expands values (never decodes when
+      the values themselves aren't wanted).
+    * ("f64", sig, (m,) f64) — post-expand compare on the decoded
+      plane, bit-identical to the escape hatch by construction.
+
+    Thresholds are TRACED operands — one compiled class per ops
+    signature serves every literal (query/plancache.intern_pred_class
+    names the class for the compile auditor)."""
+    if all(cl == "all" for cl in classes):
+        return None
+    cons = translate(pred, transform, ds)
+    if cons is not None and "fallback" not in classes:
+        cons = clamp_constraints(cons)
+        if cons is not None:
+            sig = tuple(kind for kind, _K in cons)
+            thr = np.array([K for _kind, K in cons], dtype=np.int64)
+            if not sig:                # all-true after clamping
+                return None
+            return ("int", sig, thr)
+    sig = pred.sig
+    thr = np.array([c for _op, c in pred.conjs], dtype=np.float64)
+    return ("f64", sig, thr)
+
+
+def mask_from_k_stage(k, thr, *, sig: tuple):
+    """Traced packed-space mask: AND of int64 compares of the decoded
+    integer k against traced thresholds. Pure trace-composable stage
+    (round-17 discipline) — ops/device_decode fuses it into the
+    expand launch."""
+    m = None
+    for j, kind in enumerate(sig):
+        t = thr[j]
+        if kind == "ge":
+            c = k >= t
+        elif kind == "le":
+            c = k <= t
+        elif kind == "eq":
+            c = k == t
+        else:
+            c = k != t
+        m = c if m is None else (m & c)
+    return m
+
+
+def mask_from_values_stage(v, thr, *, sig: tuple):
+    """Traced post-expand mask: the SAME f64 compares numpy's
+    eval_residual runs, over the decoded plane (XOR-transform
+    fallback; NaN compares false, != true — IEEE == numpy == jnp)."""
+    m = None
+    for j, op in enumerate(sig):
+        t = thr[j]
+        if op == "<":
+            c = v < t
+        elif op == "<=":
+            c = v <= t
+        elif op == ">":
+            c = v > t
+        elif op == ">=":
+            c = v >= t
+        elif op == "=":
+            c = v == t
+        else:
+            c = v != t
+        m = c if m is None else (m & c)
+    return m

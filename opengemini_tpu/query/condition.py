@@ -4,6 +4,13 @@ reference's influxql.ConditionExpr / shard_mapper time pruning).
 
 Only AND-connected time/tag predicates are extracted; OR trees and field
 comparisons stay in the residual (evaluated row-wise post-scan).
+
+The residual is no longer always row-wise: when it is an AND-tree of
+single-field numeric range/equality conjuncts, ops/pushdown.plan_residual
+re-expresses it in PACKED lane space and the block route evaluates it
+against compressed segments before expansion (round 18). Residuals the
+planner can't translate — OR trees, multi-field, string/bool — keep the
+classic post-scan row filter, byte for byte.
 """
 
 from __future__ import annotations

@@ -19,10 +19,22 @@ the PLANNER picks per block from (codec, route):
 
 ``OG_DEVICE_DECODE=0`` pins every block to the host stage — the
 byte-identical escape hatch (same planes, same H2D sites as before
-round 14). The stage also pins to host on backends without real f64:
-the DFOR decimal-scale divide and the limb decomposition
-(device_decode.limbs_decompose) need IEEE f64, exactly like the
+round 14).
+
+Round 18 closes the f64 holdout with a second device MODE. The f64
+mode (DFOR decimal-scale divide + limb decomposition in
+device_decode.limbs_decompose) needs IEEE f64, exactly like the
 finalize epilogue's backend gate (ops/blockagg._backend_real_f64).
+On f32-pair-emulated backends (TPU today) ``stage_mode()`` now
+returns ``"int"`` instead of pinning to host: integer-space DFOR
+blocks (T_INT, and T_SCALED with dscale 0) expand to raw int64 ``k``
+planes and limb-decompose with pure shifts/masks
+(device_decode.int_limbs_batch) — no f64 arithmetic anywhere on the
+device, so the decode stage engages on every backend. Blocks outside
+the int-expressible family (decimal-scaled, XOR floats, RLE in int
+mode) ride the per-block host stage inside the same slab.
+``OG_LIMB_INT=1`` forces int mode (the CPU parity pin);
+``OG_LIMB_INT=0`` restores the pre-round-18 f64-only gating.
 """
 
 from __future__ import annotations
@@ -32,24 +44,52 @@ import numpy as np
 from ..encoding import blocks as EB
 from ..record import DataType
 
-__all__ = ["block_stage", "device_stage_available",
+__all__ = ["block_stage", "device_stage_available", "stage_mode",
            "HostDecodeStage", "DEVICE_VALUE_CODECS"]
 
-# value codecs the device can expand in the slab path (RLE stays
-# host-side here: per-block run counts make ragged batch classes, and
-# slab data that survived the RLE run-heaviness test is rare — those
-# blocks ride the per-block host stage inside a device slab)
-DEVICE_VALUE_CODECS = (EB.DFOR, EB.CONST)
+# value codecs the device can expand in the slab path. RLE joined in
+# round 18: runs pad to power-of-two buckets (device_decode._pad_runs)
+# and expand via a searchsorted-over-cumsum gather
+# (device_decode.rle_expand_batch), so ragged run counts cost at most
+# log2 extra kernel classes, not one per count.
+DEVICE_VALUE_CODECS = (EB.DFOR, EB.CONST, EB.RLE)
 
 _NUMERIC = (DataType.FLOAT, DataType.INTEGER, DataType.BOOLEAN)
 
 
+def stage_mode() -> str | None:
+    """Which device decode MODE the backend supports, or ``None`` for
+    host-everything.
+
+    - ``"f64"`` — full inverse transforms + f64 limb decomposition on
+      device (real-f64 backends: CPU, GPU).
+    - ``"int"`` — integer-space decode: T_INT / dscale-0 T_SCALED
+      blocks expand to int64 ``k`` and limb-decompose with shifts
+      (device_decode.int_limbs_batch); everything else host-stages
+      per block. This unlocks f32-pair-emulated backends.
+    - ``None`` — knob off, device cache off.
+
+    ``OG_LIMB_INT``: ``"1"`` forces int mode everywhere (the CPU
+    parity pin for tests), ``"0"`` restores the round-14 f64-only
+    gate (host stage on emulated backends), ``""`` (default) picks
+    f64 when the backend has it, int otherwise."""
+    from ..ops import blockagg, device_decode, devicecache
+    from ..utils import knobs
+    if not (device_decode.device_decode_on() and devicecache.enabled()):
+        return None
+    limb = str(knobs.get("OG_LIMB_INT"))
+    if limb == "1":
+        return "int"
+    if blockagg._backend_real_f64():
+        return "f64"
+    return None if limb == "0" else "int"
+
+
 def device_stage_available() -> bool:
     """Process-level gate: knob on, device cache on (the expanded
-    planes must land somewhere resident) and a real-f64 backend."""
-    from ..ops import blockagg, device_decode, devicecache
-    return (device_decode.device_decode_on() and devicecache.enabled()
-            and blockagg._backend_real_f64())
+    planes must land somewhere resident) and a backend mode — f64 or
+    int-space — that can run the decode (``stage_mode``)."""
+    return stage_mode() is not None
 
 
 def block_stage(value_codec: int, time_codec: int,

@@ -213,7 +213,7 @@ def _dense_device_on() -> bool:
 
 
 def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
-                      want_exact, ctx=None):
+                      want_exact, ctx=None, sources=None, P=None):
     """Device dense path for one (group, field). Returns
     ("res", (res, exact), rkey) on a host-result-cache hit,
     ("dev", (res_tree, lsum_dev), rkey) when a device launch was
@@ -237,6 +237,24 @@ def _dense_device_try(dcache, fp, fname, dvals, dvalid, spec, E,
             got2 = _dc.get_decoded_planes(fp, fname, e_key)
             if got2 is not None:
                 return got2
+            if sources and P:
+                # round-18 compressed fill: expand the group's DFOR
+                # payloads ON DEVICE (ops/blockagg.dense_fill_compressed)
+                # — the planes never exist as host arrays and the H2D
+                # bytes are the packed words, not the f64 planes.
+                # Ineligible layouts (non-DFOR codecs, bitmapped nulls,
+                # non-float columns) return None and fall through to
+                # the host fill below, byte-identical to round 17.
+                from ..ops import blockagg as _ba
+                got3 = _ba.dense_fill_compressed(
+                    sources, fname, P, E if want_exact else None)
+                if got3 is not None:
+                    dv3, dm3, dl3, bad3 = got3
+                    if want_exact and bad3:
+                        _dc.put_no_planes(fp, fname, e_key)
+                        return _dc.NO_PLANES
+                    return _dc.stake_decoded_planes(
+                        fp, fname, e_key, dv3, dm3, dl3)
             limbs = None
             if want_exact:
                 from ..ops import exactsum
@@ -1958,13 +1976,38 @@ class QueryExecutor:
             # probe would never report and the route would stay parked
             # on the fallback until the stale-probe promotion)
             from ..ops.devicefault import route_on as _route_on
+            # packed-space predicate pushdown (ops/pushdown.py, round
+            # 18): a single-field range/equality residual no longer
+            # vetoes the block route — the planner translates it into
+            # packed-lane compares inside the slab build and the
+            # survivor mask rides the valid plane, so every downstream
+            # kernel (staged lattice, fused whole-plan) filters for
+            # free. Only the pred's own field may be needed: the mask
+            # lives per-field, so a cross-field residual stays on the
+            # host expand-then-filter path. OG_PACKED_PREDICATE=0
+            # keeps the pre-round-18 veto (byte-identical).
+            from ..ops import pushdown as _pu
+            from . import decodestage as _ds
+            pd_spec = None
+            if (cond.residual is not None and _pu.packed_predicate_on()
+                    and _ds.device_stage_available()):
+                pd_spec = _pu.plan_residual(cond.residual, tag_keys)
+                if (pd_spec is not None
+                        and set(needed_fields) != {pd_spec.field}):
+                    pd_spec = None
+            # int-space decode mode carries no f64 values plane, so
+            # min/max (exact value gathers) keep the host paths
+            _blk_states = ({"count", "sum"}
+                           if _ds.stage_mode() == "int"
+                           else {"count", "sum", "min", "max"})
             block_ok = (
                 plan_fast == "preagg+dense+block"
-                and _dc.enabled() and cond.residual is None
+                and _dc.enabled()
+                and (cond.residual is None or pd_spec is not None)
                 and not raw_fields
                 # no sumsq: device f64 emulation would break the
                 # cross-backend stddev digest (no limb state for v²)
-                and spec_names <= {"count", "sum", "min", "max"}
+                and spec_names <= _blk_states
                 and (EXACT_SUM or "sum" not in spec_names)
                 and G * W <= cells_cap
                 # windowless queries are pre-agg's sweet spot: whole
@@ -2014,7 +2057,13 @@ class QueryExecutor:
                         continue
                     stacks = {}
                     for fname in needed_fields:
-                        sl = blockagg.get_stacks(reader, fname)
+                        # an EMPTY list (≠ None) means the packed
+                        # predicate envelope-skipped every segment:
+                        # the file is fully answered (zero survivors)
+                        # with no slab at all — its sources still
+                        # count as consumed below
+                        sl = blockagg.get_stacks(reader, fname,
+                                                 pred=pd_spec)
                         if sl is None:
                             stacks = None
                             break
@@ -2026,7 +2075,7 @@ class QueryExecutor:
                                 want_of(f2), nrows,
                                 (sl[-1].block0 + sl[-1].n_blocks)
                                 * sl[0].seg_rows)
-                            for f2, sl in stacks.items()):
+                            for f2, sl in stacks.items() if sl):
                         # above the legacy cap the pull must be the
                         # packed transport; ranges that force the f64
                         # fallback route this file to the host paths
@@ -2035,11 +2084,12 @@ class QueryExecutor:
                     # different block layouts (a field absent from some
                     # series skips those blocks entirely)
                     gids_by_field = {
-                        fname: np.concatenate(
+                        fname: (np.concatenate(
                             [np.array([sid2gid.get(int(s), -1)
                                        for s in sl.block_sids],
                                       dtype=np.int64)
-                             for sl in sls])
+                             for sl in sls]) if sls
+                            else np.empty(0, dtype=np.int64))
                         for fname, sls in stacks.items()}
                     jobs.append((reader, stacks, gids_by_field, srcs))
                 if jobs:
@@ -2183,9 +2233,12 @@ class QueryExecutor:
                                         sl, gids_by_field[f],
                                         int(start), int(interval_eff),
                                         W, want_of(f))
-                                    for f, sl in stacks.items()):
+                                    for f, sl in stacks.items()
+                                    if sl):
                                 continue
                             for fname, sl in stacks.items():
+                                if not sl:    # envelope-skipped file
+                                    continue
                                 gid_arr = gids_by_field[fname]
                                 wf = want_of(fname)
                                 lkey = (fname, sl[0].E, sl[0].k0,
@@ -2256,6 +2309,8 @@ class QueryExecutor:
                                 block_skip.add(id(src))
                             continue
                         for fname, sl in stacks.items():
+                            if not sl:        # envelope-skipped file
+                                continue
                             gid_arr = gids_by_field[fname]
                             wf = want_of(fname)
                             out = _sched_launch(
@@ -2660,10 +2715,15 @@ class QueryExecutor:
                 mask = eval_residual(cond.residual, scanres.to_record())
                 if not mask.all():
                     scanres.apply_mask(np.asarray(mask, dtype=bool))
-                if scanres.n_rows == 0:
-                    # every row filtered out → empty result, not a grid
-                    # of null windows (preagg/dense are disabled when a
-                    # residual exists, so nothing else contributes)
+                if scanres.n_rows == 0 and not (
+                        block_launches or n_stream or n_lat_stream
+                        or lat_host_acc):
+                    # every host row filtered out AND no device-side
+                    # contribution → empty result, not a grid of null
+                    # windows (preagg/dense are disabled when a
+                    # residual exists; under packed pushdown the block
+                    # launches carry the pre-masked survivors, so they
+                    # must keep the query alive)
                     return None
             times = scanres.times
             gids = scanres.gids
@@ -3151,7 +3211,7 @@ class QueryExecutor:
                             dcache, fp, fname, dvals, dvalid, spec,
                             exact_scales.get(fname, 0),
                             exact_on and fname in exact_scales,
-                            ctx=ctx)
+                            ctx=ctx, sources=grp.sources, P=P)
                         if got is not None:
                             kind, payload, rkey2 = got
                             if kind == "res":

@@ -18,7 +18,14 @@ A plan either matches the fused template (terminal + lattice-eligible
 staged — and OG_FUSED_PLAN=0 turns the template off entirely. Both
 routes compute bit-identical bytes (same stage bodies, exact integer
 limb arithmetic), so route choice is purely a launch-count/perf
-decision, never a correctness one."""
+decision, never a correctness one.
+
+Round 18's packed-predicate pushdown (ops/pushdown.py) composes with
+both routes for free: survivor masks AND into the slab VALID plane at
+build time (ops/blockagg), before any lattice/fused launch sees the
+slab, and the fused template's slab_args carry plane handles — no
+values operand — so a pred-masked slab rides the same compiled
+program as an unmasked one, same shape class, zero new compiles."""
 
 from __future__ import annotations
 

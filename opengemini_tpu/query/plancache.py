@@ -113,6 +113,31 @@ def shape_class_count() -> int:
         return len(_SHAPE_IDS)
 
 
+_PRED_LOCK = threading.Lock()
+_PRED_IDS: dict[tuple, int] = {}
+
+
+def intern_pred_class(key: tuple) -> tuple[int, str]:
+    """Stable (id, auditor name) for a packed-predicate mask class
+    (round 18): the THRESHOLD-FREE ops signature + compare mode of a
+    pushdown mask kernel (ops/pushdown.batch_mask_plan). Literals
+    ride as traced operands, so one interned class serves every
+    threshold — the compile auditor sees og_pred_c<N> once per
+    distinct (mode, ops) shape, never once per constant."""
+    with _PRED_LOCK:
+        pid = _PRED_IDS.get(key)
+        if pid is None:
+            pid = len(_PRED_IDS)
+            _PRED_IDS[key] = pid
+    return pid, f"og_pred_c{pid}"
+
+
+def pred_class_count() -> int:
+    """Interned packed-predicate mask classes (introspection/tests)."""
+    with _PRED_LOCK:
+        return len(_PRED_IDS)
+
+
 class PlanCache:
     """LRU of parsed query plans keyed by query text (the SqlPlanTemplate
     pool analog — repeated dashboard queries skip the parser)."""

@@ -105,12 +105,19 @@ class DenseGroup:
     segment offsets + trims, in assembly order) — the device block
     cache's key. ``cached=True`` means the caller vouched the device
     cache holds this group's blocks, so ``fields`` is left empty and no
-    host assembly happened."""
+    host assembly happened.
+
+    ``sources`` carries the segment provenance (reader, chunk meta,
+    segment index, trim) in assembly order, so the device decode stage
+    can fill the decoded-plane cache straight from COMPRESSED payloads
+    (ops/blockagg.dense_fill_compressed, round 18) instead of
+    uploading the host-assembled dense planes."""
     P: int
     cells: np.ndarray                       # (S,) int64 in [0, G*W]
     fields: dict[str, tuple[np.ndarray, np.ndarray]]  # (S,P) vals/valid
     fingerprint: str = ""
     cached: bool = False
+    sources: list = dc_field(default_factory=list)  # (reader,cm,si,lo,f)
 
 
 @dataclass
@@ -564,9 +571,11 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
         cells = np.concatenate(
             [d.gid * W + np.arange(d.w0, d.w0 + d.f, dtype=np.int64)
              for d, _b in entries])
+        srcs = [(d.reader, d.cm, d.si, d.lo, d.f)
+                for d, _b in entries]
         if group_hit[P]:
             dense_groups[P] = DenseGroup(P, cells, {}, group_fp[P],
-                                         cached=True)
+                                         cached=True, sources=srcs)
             stats.dense_cache_hits += 1
             continue
         names = sorted(set().union(*[b.keys() for _d, b in entries]))
@@ -587,7 +596,8 @@ def materialize_scan(plan: ScanPlan, mst: str, needed: list[str],
                         field_types[name] = ft
             gfields[name] = (np.concatenate(vparts),
                              np.concatenate(mparts))
-        dense_groups[P] = DenseGroup(P, cells, gfields, group_fp[P])
+        dense_groups[P] = DenseGroup(P, cells, gfields, group_fp[P],
+                                     sources=srcs)
 
     s_parts: list[dict] = []
     str_names: set[str] = set()

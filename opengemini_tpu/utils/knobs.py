@@ -298,6 +298,19 @@ register("OG_DEVICE_DECODE", bool, True,
          "HBM slab path: compressed bytes cross H2D and expand "
          "in-kernel; 0 = host decode + dense plane upload "
          "(byte-identical escape hatch)", scope="cached")
+register("OG_PACKED_PREDICATE", bool, True,
+         "push WHERE residuals into packed space (ops/pushdown.py): "
+         "range/equality conjuncts on one field translate to exact "
+         "integer compares on DFOR lanes, envelope-skipped segments "
+         "never expand, survivors late-materialize via the slab "
+         "valid plane; 0 = expand-then-filter (byte-identical "
+         "escape hatch)", scope="cached")
+register("OG_LIMB_INT", str, "",
+         "int-space limb decomposition for the device decode stage "
+         "(ops/device_decode.int_limbs_batch): \"\" = auto (engages "
+         "when the backend lacks real f64 — f32-pair-emulated TPUs), "
+         "1 = force on (CPU parity testing), 0 = off (emulated "
+         "backends keep the host decode stage)", scope="cached")
 register("OG_HBM_COMPRESSED_MB", int, 1024,
          "HBM budget of the compressed payload tier (device-resident "
          "DFOR words): a slab evicted under pressure rebuilds from "

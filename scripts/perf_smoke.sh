@@ -32,7 +32,16 @@
 # forced-lattice heavy shape (<= 2 device launches where the staged
 # chain pays ~6, with zero warm compiles), and a seeded fault at
 # device.fused.launch that must heal per query to the staged chain
-# with the digest unchanged. Runs a scaled-down bench dataset on the
+# with the digest unchanged. The packed-predicate gate (round 18) adds
+# packed-off / packed-off-barrier configs (the expand-then-filter scan
+# is the byte-identical escape hatch of packed-space residual
+# evaluation) over every shape — including the new 1h-pred shape —
+# and both lattice routes, a measured selectivity sweep on a
+# time-ramped measurement (0.1% selectivity must shrink the rows that
+# expand out of packed space >= 3x with segment-envelope skips > 0 and
+# zero warm compiles), and a seeded fault at device.pushdown.eval that
+# must heal per batch to the host survivor mask with the digest
+# unchanged. Runs a scaled-down bench dataset on the
 # CPU backend with per-phase output — CI-safe (no accelerator needed,
 # minutes of wall).
 #
@@ -144,6 +153,17 @@ assert "fused-off-barrier" in r.get("configs", []), r
 assert r.get("fused_launches", 0) > 0, r
 assert 0 < r.get("fused_warm_launches", 99) <= 2, r
 assert r.get("fused_heals", 0) > 0, r
+# packed-predicate gate (round 18): the packed-off escape hatch ran
+# byte-identical on every shape (incl. the 1h-pred residual shape)
+# and both lattice routes, the 0.1%-selectivity ramp query expanded
+# >= 3x fewer rows out of packed space than the hatch with segment-
+# envelope skips engaged, warm packed repeats compiled nothing, and
+# the seeded mask-launch fault healed per batch to the host mask
+assert "packed-off" in r.get("configs", []), r
+assert "packed-off-barrier" in r.get("configs", []), r
+assert r.get("pd_lane_shrink_x", 0) >= 3.0, r
+assert r.get("pd_segments_skipped", 0) > 0, r
+assert r.get("pd_heals", 0) > 0, r
 print(f"perf smoke OK: {r['cells_checked']} cells checked, "
       f"phases {r.get('phases_ms', {})}")
 print(f"tracing gate OK: overhead {r['trace_overhead_pct']}% "
@@ -173,6 +193,12 @@ print(f"answer-sized D2H OK: topk cut {r['topk_d2h_shrink_x']}x "
 print(f"fused plan OK: {r['fused_launches']} fused dispatches, warm "
       f"heavy shape in {r['fused_warm_launches']} launch(es), "
       f"{r['fused_heals']} per-query heals to the staged chain")
+print(f"packed predicate OK: 0.1% selectivity expands "
+      f"{r['pd_lane_shrink_x']}x fewer lanes "
+      f"({r['pd_selectivity']['0.1pct']['lanes_off']} -> "
+      f"{r['pd_selectivity']['0.1pct']['lanes_on']}), "
+      f"{r['pd_segments_skipped']} envelope-skipped segments, "
+      f"{r['pd_heals']} per-batch mask heals")
 EOF
 
 # result-cache gate (sustained serving, round 16): on every bench

@@ -191,3 +191,56 @@ def test_device_decode_counters_registered():
                 "batches", "host_heals", "slabs_device_decoded",
                 "compressed_hits", "compressed_rebuilds"):
         assert key in DECODE_STATS
+
+
+# -------------------------------- round-18 decode-frontier closers
+
+
+def test_rle_expand_batch_transfer_guard_parity():
+    """Batched device RLE expansion (rle_expand_batch) reproduces
+    np.repeat bit-for-bit with ONLY the run payload resident — the
+    expansion itself moves nothing across the transfer boundary."""
+    from opengemini_tpu.ops.device_decode import _pad_runs
+    rng = np.random.default_rng(13)
+    planes, stage = [], []
+    for nb in range(3):
+        vals = np.round(rng.normal(5, 2, 7 + nb), 1)
+        lens = rng.integers(1, 40, 7 + nb).astype(np.int64)
+        planes.append(np.repeat(vals, lens))
+        stage.append(_pad_runs(vals, lens))
+    seg = max(len(p) for p in planes)
+    R = max(len(v) for v, _l in stage)
+    pv = np.zeros((len(stage), R))
+    pl = np.zeros((len(stage), R), dtype=np.int64)
+    rr = np.array([len(p) for p in planes], dtype=np.int64)
+    for i, (v, l) in enumerate(stage):
+        pv[i, :len(v)] = v
+        pl[i, :len(l)] = l
+    pvd, pld, rrd = (jax.device_put(pv), jax.device_put(pl),
+                     jax.device_put(rr))
+    dd.rle_expand_batch(pvd, pld, rrd, seg)          # warm compile
+    with jax.transfer_guard("disallow"):
+        out = dd.rle_expand_batch(pvd, pld, rrd, seg)
+    host = np.asarray(out)
+    for i, p in enumerate(planes):
+        np.testing.assert_array_equal(host[i, :len(p)].view(np.uint64),
+                                      p.view(np.uint64))
+        assert (host[i, len(p):] == 0).all()
+
+
+def test_int_limbs_batch_matches_host_limbs():
+    """Integer-space limb windows (pure shifts) are bit-identical to
+    the f64 host decomposition for every in-envelope magnitude — the
+    invariant that lets the int stage mode serve f32-pair-emulated
+    backends."""
+    from opengemini_tpu.ops import exactsum
+    rng = np.random.default_rng(17)
+    k = np.concatenate([
+        rng.integers(-(1 << 40), 1 << 40, 500),
+        np.array([0, 1, -1, (1 << 40) - 1, -(1 << 40)])]).astype(
+            np.int64).reshape(5, -1)
+    E = exactsum.pick_scale(float(np.abs(k).max()))
+    lb = np.asarray(dd.int_limbs_batch(jax.device_put(k), E=E))
+    hl, hb = exactsum.host_limbs(k.astype(np.float64), None, E)
+    np.testing.assert_array_equal(lb, hl)
+    assert not hb.any()

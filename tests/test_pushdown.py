@@ -1,0 +1,403 @@
+"""Compressed-domain predicate pushdown (round 18, ops/pushdown.py):
+packed-space masks must be bit-identical to the expand-then-filter
+escape hatch (OG_PACKED_PREDICATE=0) across ops, transforms and
+widths; envelope skips drop segments before any device work; faults
+at the mask launch heal per batch; and the decode-frontier closers
+(device RLE expansion, int-space limbs, dense compressed fill) pin
+their parity here."""
+
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from opengemini_tpu.encoding import dfor
+from opengemini_tpu.ops import device_decode as dd
+from opengemini_tpu.ops import pushdown as pu
+from opengemini_tpu.ops.device_decode import DECODE_STATS
+from opengemini_tpu.query import QueryExecutor, parse_query
+from opengemini_tpu.storage import Engine, EngineOptions
+from opengemini_tpu.utils import failpoint
+from opengemini_tpu.utils.lineprotocol import parse_lines
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.ops.devicefault as df
+    import opengemini_tpu.query.executor as E
+    monkeypatch.setattr(dc, "_CACHE", None)
+    monkeypatch.setattr(dc, "_HOST_CACHE", None)
+    monkeypatch.setenv("OG_DEVICE_CACHE_MB", "256")
+    monkeypatch.setenv("OG_RESULT_CACHE", "0")   # force real re-execution
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 0)
+    df.reset_breakers()
+    eng = Engine(str(tmp_path / "data"), EngineOptions(segment_size=64))
+    ex = QueryExecutor(eng)
+    yield eng, ex
+    failpoint.disable_all()
+    df.reset_breakers()
+    eng.close()
+
+
+def seed(eng, mst, make, hosts=3, points=300):
+    rng = np.random.default_rng(29)
+    vals = make(rng, hosts, points)
+    lines = []
+    for h in range(hosts):
+        for i in range(points):
+            lines.append(
+                f"{mst},host=h{h} u={float(vals[h, i])!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    return vals
+
+
+SCALED = lambda r, h, p: np.round(r.normal(50, 15, (h, p)), 2)
+INTS = lambda r, h, p: r.integers(-500, 500, (h, p)).astype(np.float64)
+XOR = lambda r, h, p: r.normal(0, 1, (h, p))
+RUNS = lambda r, h, p: np.repeat(
+    r.integers(0, 6, (h, (p + 19) // 20)).astype(np.float64) * 1.5,
+    20, axis=1)[:, :p]
+
+
+def q(ex, text):
+    (stmt,) = parse_query(text)
+    res = ex.execute(stmt, "db0")
+    assert "error" not in res, res
+    return res
+
+
+def both_routes(ex, monkeypatch, text):
+    """(packed, hatch) results for one query text — the hatch is the
+    expand-then-filter scan route (block gate closed on residuals)."""
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "1")
+    on = q(ex, text)
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "0")
+    off = q(ex, text)
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "1")
+    return on, off
+
+
+AGG = "SELECT sum(u), count(u), min(u), max(u), mean(u) FROM cpu"
+TAIL = " AND time >= 0 AND time < 3000s GROUP BY time(5m), host"
+
+
+@pytest.mark.parametrize("make,name", [
+    (SCALED, "scaled"), (INTS, "ints"), (XOR, "xor"), (RUNS, "runs")])
+@pytest.mark.parametrize("where", [
+    "u > {med}", "u >= {med}", "u < {med}", "u <= {med}",
+    "u = {hit}", "u != {hit}", "u > {lo} AND u <= {hi}"])
+def test_parity_ops_by_transform(db, monkeypatch, make, name, where):
+    """Every comparison op × every transform class (decimal-scaled,
+    int-space, XOR fallback, RLE runs) answers bit-identically to the
+    OG_PACKED_PREDICATE=0 escape hatch."""
+    eng, ex = db
+    vals = seed(eng, "cpu", make)
+    med = float(np.median(vals))
+    text = (AGG + " WHERE "
+            + where.format(med=repr(med), hit=repr(float(vals[1, 7])),
+                           lo=repr(float(np.quantile(vals, 0.25))),
+                           hi=repr(float(np.quantile(vals, 0.75))))
+            + TAIL)
+    on, off = both_routes(ex, monkeypatch, text)
+    assert on == off
+
+
+def test_pushdown_engages_and_shrinks_lanes(db, monkeypatch):
+    """The packed route must actually mask blocks (counters) and the
+    answer must match a host ground truth computed from the seed."""
+    eng, ex = db
+    vals = seed(eng, "cpu", SCALED)
+    med = float(np.median(vals))
+    text = AGG + f" WHERE u >= {med!r}" + TAIL
+    c0 = dict(DECODE_STATS)
+    res = q(ex, text)
+    assert DECODE_STATS["pushdown_blocks_masked"] > \
+        c0["pushdown_blocks_masked"]
+    for s in res["series"]:
+        h = int(s["tags"]["host"][1:])
+        for row in s["values"]:
+            w = row[0] // (300 * 10**9)
+            cell = [v for i, v in enumerate(vals[h]) if
+                    w * 30 <= i < (w + 1) * 30 and v >= med]
+            if cell:
+                assert row[2] == len(cell)
+                assert row[1] == math.fsum(cell)
+                assert row[3] == min(cell) and row[4] == max(cell)
+
+
+def test_envelope_skip_drops_segments(db, monkeypatch):
+    """Int-space data with a predicate past the global max: every
+    segment's envelope classifies \"none\", the file answers with zero
+    survivors BEFORE any expansion, and the result still equals the
+    hatch (which scans and filters every row)."""
+    eng, ex = db
+    vals = seed(eng, "cpu", INTS)
+    # beyond the REPRESENTABLE envelope (ref ± 2^(w-1)), not merely
+    # the data max — a near-miss threshold classifies "partial"
+    thr = float(vals.max() + 10**6)
+    text = AGG + f" WHERE u > {thr!r}" + TAIL
+    c0 = dict(DECODE_STATS)
+    on, off = both_routes(ex, monkeypatch, text)
+    assert on == off
+    assert DECODE_STATS["pushdown_segments_skipped"] > \
+        c0["pushdown_segments_skipped"]
+    assert DECODE_STATS["pushdown_rows_skipped"] > \
+        c0["pushdown_rows_skipped"]
+    # fully-inside predicate: no segment masks, answer == no-pred run
+    t2 = AGG + f" WHERE u >= {float(vals.min() - 10**6)!r}" + TAIL
+    base = (AGG + " WHERE time >= 0 AND time < 3000s "
+            "GROUP BY time(5m), host")
+    assert q(ex, t2) == q(ex, base)
+
+
+def test_equality_exact_packed_never_decodes_boundary(db, monkeypatch):
+    """Decimal-scaled equality translates to ONE exact k — survivors
+    exactly the rows whose stored f64 equals the literal, and a
+    literal between representable k values is provably empty."""
+    eng, ex = db
+    vals = seed(eng, "cpu", SCALED)
+    hit = float(vals[0, 3])
+    on, off = both_routes(ex, monkeypatch,
+                          AGG + f" WHERE u = {hit!r}" + TAIL)
+    assert on == off
+    # 0.005 sits between scale-2 lattice points → exact empty
+    on2, off2 = both_routes(
+        ex, monkeypatch, AGG + " WHERE u = 17.005" + TAIL)
+    assert on2 == off2
+
+
+def test_fault_heal_expand_then_filter(db, monkeypatch):
+    """A persistent fault at device.pushdown.eval heals every mask
+    batch to host expand-then-filter — bytes identical to both the
+    healthy packed run and the hatch, heals counted, and the HBM
+    ledger still reconciles exactly."""
+    from opengemini_tpu.ops import hbm
+    eng, ex = db
+    seed(eng, "cpu", SCALED)
+    text = AGG + " WHERE u >= 50.0" + TAIL
+    healthy = q(ex, text)
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "0")
+    hatch = q(ex, text)
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "1")
+    assert healthy == hatch
+    import opengemini_tpu.ops.devicecache as dc
+    dc._CACHE = None                      # drop pred-masked slabs
+    c0 = DECODE_STATS["pushdown_heals"]
+    failpoint.enable("device.pushdown.eval", "transient")
+    try:
+        healed = q(ex, text)
+    finally:
+        failpoint.disable_all()
+    assert healed == healthy
+    assert DECODE_STATS["pushdown_heals"] > c0
+    chk = hbm.cross_check()
+    assert chk["ok"], chk
+
+
+def test_escape_hatch_runs_zero_pushdown(db, monkeypatch):
+    eng, ex = db
+    seed(eng, "cpu", SCALED)
+    monkeypatch.setenv("OG_PACKED_PREDICATE", "0")
+    c0 = dict(DECODE_STATS)
+    q(ex, AGG + " WHERE u >= 50.0" + TAIL)
+    for k in ("pushdown_blocks_masked", "pushdown_segments_skipped",
+              "pushdown_heals"):
+        assert DECODE_STATS[k] == c0[k]
+
+
+def test_multi_field_residual_stays_rowwise(db, monkeypatch):
+    """A residual over two fields is not packed-translatable — the
+    planner leaves it on the row-filter path and both knob settings
+    agree (they run the same route)."""
+    eng, ex = db
+    rng = np.random.default_rng(31)
+    lines = []
+    for h in range(2):
+        for i in range(200):
+            lines.append(f"cpu,host=h{h} "
+                         f"u={float(rng.normal(50, 9))!r},"
+                         f"v={float(rng.normal(10, 2))!r} {i * 10**10}")
+    eng.write_points("db0", parse_lines("\n".join(lines)))
+    for s in eng.database("db0").all_shards():
+        s.flush()
+    text = ("SELECT sum(u), count(u) FROM cpu WHERE u > 45 AND v > 10"
+            + TAIL)
+    c0 = DECODE_STATS["pushdown_blocks_masked"]
+    on, off = both_routes(ex, monkeypatch, text)
+    assert on == off
+    assert DECODE_STATS["pushdown_blocks_masked"] == c0
+
+
+# ---------------------------------------------------- int-space mode
+
+
+def test_int_limb_mode_bit_identity(db, monkeypatch):
+    """OG_LIMB_INT=1 (the f32-pair-emulation escape route, forced on
+    CPU as the parity pin): shift-window limb decomposition answers
+    sum/count/mean bit-identically to the f64 device stage — with and
+    without a packed predicate riding the same launch."""
+    eng, ex = db
+    seed(eng, "cpu", INTS)
+    for where in ("WHERE time >= 0 AND time < 3000s",
+                  "WHERE u >= 45 AND time >= 0 AND time < 3000s"):
+        text = ("SELECT sum(u), count(u), mean(u) FROM cpu "
+                + where + " GROUP BY time(5m), host")
+        monkeypatch.setenv("OG_LIMB_INT", "0")
+        f64 = q(ex, text)
+        monkeypatch.setenv("OG_LIMB_INT", "1")
+        assert q(ex, text) == f64
+        monkeypatch.delenv("OG_LIMB_INT")
+
+
+# ------------------------------------------------- kernel-level pins
+
+
+def _stage1(payload, n, w):
+    words = dfor.payload_words(payload, n, w)
+    wpad = np.zeros((1, len(words) + 2), dtype=np.uint32)
+    wpad[0, :len(words)] = words
+    ref = dfor.parse_header(payload)[4]
+    return (jax.device_put(wpad),
+            jax.device_put(np.array([ref], dtype=np.uint64)))
+
+
+def test_masked_expand_bit_identity():
+    """The survivor-masked expand (dfor_expand_pred) must keep the
+    TRACED-operand decimal divide: its decoded values are pinned
+    bit-for-bit to the host decoder. A trace-constant scale would let
+    XLA strength-reduce to a reciprocal multiply and re-open the PR 13
+    1-ulp drift — this is the regression pin."""
+    v = np.round(np.random.default_rng(7).normal(40, 9, 300), 2)
+    p = dfor.encode_float(v)
+    tr, w, ds, n, ref = dfor.parse_header(p)
+    assert ds > 0                       # decimal divide on this path
+    pred = pu.PackedPredicate("u", ((">=", 40.0),))
+    plan = pu.batch_mask_plan(pred, tr, w, ds, ["partial"])
+    assert plan is not None and plan[0] == "int"
+    wd, rd = _stage1(p, n, w)
+    thr = jax.device_put(plan[2])
+    out, mk = dd.dfor_expand_pred(wd, rd, thr, n=n, width=w,
+                                  transform=tr, dscale=ds,
+                                  mode=plan[0], sig=plan[1])
+    host = dfor.decode(p, n, "f64")
+    np.testing.assert_array_equal(
+        np.asarray(out)[0].view(np.uint64), host.view(np.uint64))
+    np.testing.assert_array_equal(np.asarray(mk)[0],
+                                  pu.eval_numpy(pred, host))
+
+
+@pytest.mark.parametrize("op", ["<", "<=", ">", ">=", "=", "!="])
+def test_f64_mask_nan_inf_parity(op):
+    """The post-expand f64 mask (XOR fallback) over NaN/±inf planes
+    matches numpy's row compare for every op (NaN compares false,
+    != true)."""
+    v = np.array([np.nan, np.inf, -np.inf, 0.0, 1.5, -2.25] * 40)
+    pred = pu.PackedPredicate("u", ((op, 0.0),))
+    vd = jax.device_put(v.reshape(1, -1))
+    thr = jax.device_put(np.array([0.0]))
+    mk = dd.plane_mask(vd, thr, sig=pred.sig)
+    np.testing.assert_array_equal(np.asarray(mk)[0],
+                                  pu.eval_numpy(pred, v))
+
+
+def test_constraint_translation_edges():
+    """Fraction-exact boundary walks: non-integral literals tighten
+    to the next representable k; NaN/±inf collapse to whole-line
+    true/false; equality off the lattice is provably empty."""
+    assert pu._int_constraint(">", 4.5) == ("ge", 5)
+    assert pu._int_constraint(">", 4.0) == ("ge", 5)
+    assert pu._int_constraint(">=", 4.0) == ("ge", 4)
+    assert pu._int_constraint("<", -3.5) == ("le", -4)
+    assert pu._int_constraint("=", 2.5) == ("false",)
+    assert pu._int_constraint("!=", 2.5) == ("true",)
+    assert pu._int_constraint("=", float("nan")) == ("false",)
+    assert pu._int_constraint("!=", float("nan")) == ("true",)
+    assert pu._int_constraint("<", float("inf")) == ("true",)
+    assert pu._int_constraint(">", float("inf")) == ("false",)
+    assert pu._int_constraint(">", float("-inf")) == ("true",)
+    # scaled: the threshold must reproduce the ROUNDED f64 divide
+    con = pu._scaled_constraint("<=", 0.1, 2)
+    assert con is not None and con[0] == "le"
+    assert np.float64(con[1]) / np.float64(100.0) <= 0.1
+    assert np.float64(con[1] + 1) / np.float64(100.0) > 0.1
+    # envelope: w=0 pins to ref; w=64 cannot bound (torus arc)
+    assert pu.envelope_k(0, 7) == (7, 7)
+    assert pu.envelope_k(64, 0) is None
+    assert pu.classify_interval([("ge", 5)], 5, 9) == "all"
+    assert pu.classify_interval([("ge", 10)], 5, 9) == "none"
+    assert pu.classify_interval([("ge", 7)], 5, 9) == "partial"
+    assert pu.classify_interval([("eq", 7)], 7, 7) == "all"
+
+
+def test_width_edges_parity():
+    """Width-0 (all-equal segment) and width-64 (uncompressible
+    deltas) both mask correctly against the host ground truth."""
+    # w=0: constant values XOR to ref exactly → T_XORREF, which is
+    # not packed-translatable — the f64 fallback mask carries it
+    v0 = np.full(128, 37.0)
+    p0 = dfor.encode_float(v0)
+    tr, w, ds, n, ref = dfor.parse_header(p0)
+    assert w == 0 and tr == dfor.T_XORREF
+    pred = pu.PackedPredicate("u", ((">=", 37.0),))
+    assert pu.classify_dfor(pred, tr, w, ds, ref) == "fallback"
+    plan = pu.batch_mask_plan(pred, tr, w, ds, ["fallback"])
+    assert plan is not None and plan[0] == "f64"
+    wd, rd = _stage1(p0, n, w)
+    out, mk = dd.dfor_expand_pred(
+        wd, rd, jax.device_put(plan[2]), n=n, width=w, transform=tr,
+        dscale=ds, mode=plan[0], sig=plan[1])
+    np.testing.assert_array_equal(np.asarray(mk)[0],
+                                  pu.eval_numpy(pred, v0))
+    # constant SEGMENTS encode codec CONST — envelope IS the value
+    assert pu.classify_const(pred, 37.0) == "all"
+    assert pu.classify_const(
+        pu.PackedPredicate("u", ((">", 37.0),)), 37.0) == "none"
+    # w=64: huge alternating integer deltas → per-row compare stays
+    rng = np.random.default_rng(11)
+    v1 = (rng.integers(-(1 << 50), 1 << 50, 64) << 10).astype(
+        np.float64)
+    p1 = dfor.encode_float(v1)
+    tr, w, ds, n, ref = dfor.parse_header(p1)
+    if w >= 64:
+        assert pu.envelope_k(w, ref) is None
+    plan = pu.batch_mask_plan(pred, tr, w, ds,
+                              [pu.classify_dfor(pred, tr, w, ds, ref)])
+    if plan is not None:
+        wd, rd = _stage1(p1, n, w)
+        out, mk = dd.dfor_expand_pred(
+            wd, rd, jax.device_put(plan[2]), n=n, width=w,
+            transform=tr, dscale=ds, mode=plan[0], sig=plan[1])
+        np.testing.assert_array_equal(
+            np.asarray(mk)[0], pu.eval_numpy(pred, dfor.decode(
+                p1, n, "f64")))
+
+
+# ------------------------------------- dense compressed fill (route)
+
+
+def test_dense_compressed_fill_parity(db, monkeypatch):
+    """OG_DENSE_DEVICE dense groups fill the decoded-plane tier from
+    COMPRESSED payloads (ops/blockagg.dense_fill_compressed): same
+    answer as the host fold, fills counted, warm repeats never
+    refill."""
+    import opengemini_tpu.ops.devicecache as dc
+    import opengemini_tpu.query.executor as E
+    eng, ex = db
+    monkeypatch.setattr(E, "BLOCK_MIN_RATIO", 1 << 40)  # dense route
+    seed(eng, "cpu", SCALED, hosts=3, points=360)
+    text = ("SELECT mean(u), count(u), sum(u) FROM cpu WHERE "
+            "time >= 0 AND time < 3600s GROUP BY time(1m), host")
+    host_res = q(ex, text)
+    monkeypatch.setenv("OG_DENSE_DEVICE", "1")
+    monkeypatch.setattr(dc, "_CACHE", None)
+    c0 = DECODE_STATS["dense_fills_compressed"]
+    p0 = dc.PLANE_STATS["plane_puts"]
+    assert q(ex, text) == host_res
+    assert DECODE_STATS["dense_fills_compressed"] > c0
+    assert dc.PLANE_STATS["plane_puts"] > p0
+    assert q(ex, text) == host_res                      # warm
+    assert DECODE_STATS["dense_fills_compressed"] == c0 + 1
