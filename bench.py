@@ -2540,6 +2540,165 @@ def sustained_phase() -> dict:
     return out
 
 
+
+def ingest_phase() -> dict:
+    """Flight-ingest line-rate gate (ROADMAP PR 20): the columnar
+    fast lane — Arrow RecordBatch → batch_to_columns →
+    Engine.write_record_batch over an uncompressed scatter-gather WAL
+    — measured open-loop in-process (no gRPC socket, so the number is
+    the storage lane itself), against the r08 row-wise baseline
+    (1,366,408.7 rows/s on this container). Also measured: the
+    row-wise hatch (same batches through batch_to_rows →
+    write_points) for the lane multiple, a cross-lane digest parity
+    gate (columnar vs hatch must serve bit-identical query results),
+    and one fsync-acknowledged group-commit cycle with
+    OG_INGEST_WORKERS concurrent writers proving fsyncs coalesce."""
+    import numpy as np
+    try:
+        import pyarrow as pa
+    except Exception as e:                        # pragma: no cover
+        return {"skipped": f"pyarrow unavailable: {e}"}
+    from opengemini_tpu.query import QueryExecutor, parse_query
+    from opengemini_tpu.services.arrowflight import (batch_to_columns,
+                                                     batch_to_rows)
+    from opengemini_tpu.storage import Engine, EngineOptions
+    from opengemini_tpu.storage.wal import WAL_STATS
+
+    BASELINE = 1366408.7                 # r08 row-wise rows/s
+    BR = 65536
+    n_batches = max(2, int(knobs.get("OG_BENCH_INGEST_BATCHES")))
+    rng = np.random.default_rng(20)
+    host = pa.array([f"h{j}" for j in rng.integers(0, 32, BR)]) \
+        .dictionary_encode()
+    region = pa.array([f"r{j}" for j in rng.integers(0, 4, BR)]) \
+        .dictionary_encode()
+    t0 = 1_700_000_000_000_000_000
+
+    def mk(i):
+        times = pa.array(t0 + i * BR * 1000 + np.arange(BR) * 1000,
+                         type=pa.int64())
+        return pa.RecordBatch.from_arrays(
+            [host, region, times,
+             pa.array(rng.random(BR)), pa.array(rng.random(BR)),
+             pa.array(rng.integers(0, 1000, BR))],
+            names=["host", "region", "time",
+                   "usage", "load", "count"])
+
+    batches = [mk(i) for i in range(n_batches)]
+    tags = ["host", "region"]
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    opts = dict(wal_compression="none", flush_bytes=1 << 40,
+                shard_duration=1 << 62)
+
+    def ingest_columnar(eng, sub=None):
+        rows = 0
+        for b in batches[:sub]:
+            groups = batch_to_columns(b, tags)
+            eng.write_record_batch(
+                "bench", [("cpu",) + g for g in groups])
+            rows += b.num_rows
+        return rows
+
+    def ingest_hatch(eng, sub):
+        rows = 0
+        for b in batches[:sub]:
+            pts = batch_to_rows(b, "cpu", tags)
+            eng.write_points("bench", pts)
+            rows += len(pts)
+        return rows
+
+    out = {"batch_rows": BR, "batches": n_batches}
+
+    # ---- columnar lane: best-of-3 single-writer reps -------------
+    best = 0.0
+    with tempfile.TemporaryDirectory(prefix="og-ing-", dir=shm) as td:
+        _register_tmp(td)
+        eng = Engine(td, EngineOptions(**opts))
+        eng.create_database("bench")
+        ingest_columnar(eng, 2)          # warmup: import/alloc paths
+        import gc as _gc
+        _gc.collect()
+        for _ in range(5):
+            t = time.perf_counter()
+            rows = ingest_columnar(eng)
+            best = max(best, rows / (time.perf_counter() - t))
+        eng.close()
+    out["ingest_rows_per_sec"] = round(best, 1)
+    out["baseline_rows_per_sec"] = BASELINE
+    out["ingest_x_baseline"] = round(best / BASELINE, 2)
+
+    # ---- row hatch + cross-lane digest parity --------------------
+    sub = min(2, n_batches)              # hatch is ~25x slower
+    qs = [("SELECT count(usage), sum(count) FROM cpu WHERE time >= 0 "
+           "GROUP BY host"),
+          ("SELECT mean(load) FROM cpu WHERE time >= 0 "
+           "GROUP BY region")]
+
+    def digests(ing):
+        with tempfile.TemporaryDirectory(prefix="og-ing-",
+                                         dir=shm) as td:
+            _register_tmp(td)
+            eng = Engine(td, EngineOptions(**opts))
+            eng.create_database("bench")
+            t = time.perf_counter()
+            rows = ing(eng)
+            rps = rows / (time.perf_counter() - t)
+            ex = QueryExecutor(eng)
+            digs = []
+            for q in qs:
+                (stmt,) = parse_query(q)
+                res = ex.execute(stmt, "bench")
+                if "error" in res:
+                    raise SystemExit(
+                        f"ingest parity query error: {res['error']}")
+                digs.append(_digest_series(res)[0])
+            eng.close()
+            return rps, digs
+
+    hatch_rps, hatch_digs = digests(lambda e: ingest_hatch(e, sub))
+    col_rps, col_digs = digests(lambda e: ingest_columnar(e, sub))
+    out["row_hatch_rows_per_sec"] = round(hatch_rps, 1)
+    out["columnar_x_hatch"] = round(best / max(hatch_rps, 1e-9), 2)
+    out["lanes_bit_identical"] = col_digs == hatch_digs
+    if col_digs != hatch_digs:
+        raise SystemExit("ingest parity FAILED: columnar and row-wise "
+                         "lanes served different query digests")
+
+    # ---- group commit under fsync-acknowledged load --------------
+    workers = max(1, int(knobs.get("OG_INGEST_WORKERS")))
+    knobs.set_env("OG_WAL_GROUP_COMMIT_US", "2000")
+    try:
+        with tempfile.TemporaryDirectory(prefix="og-ing-",
+                                         dir=shm) as td:
+            _register_tmp(td)
+            eng = Engine(td, EngineOptions(wal_sync=True, **opts))
+            eng.create_database("bench")
+            gc0 = int(WAL_STATS.get("group_commits", 0))
+            fr0 = int(WAL_STATS.get("writes", 0))
+            import concurrent.futures as cf
+            t = time.perf_counter()
+            with cf.ThreadPoolExecutor(workers) as pool:
+                futs = [pool.submit(
+                    eng.write_record_batch, "bench",
+                    [("cpu",) + g for g in batch_to_columns(b, tags)])
+                    for b in batches[:8]]
+                rows = 0
+                for f in futs:
+                    f.result()
+                rows = sum(b.num_rows for b in batches[:8])
+            dt = time.perf_counter() - t
+            out["group_commit"] = {
+                "workers": workers,
+                "rows_per_sec_fsync": round(rows / dt, 1),
+                "frames": int(WAL_STATS.get("writes", 0)) - fr0,
+                "fsyncs": int(WAL_STATS.get("group_commits", 0)) - gc0,
+            }
+            eng.close()
+    finally:
+        knobs.del_env("OG_WAL_GROUP_COMMIT_US")
+    return out
+
+
 # --------------------------------------------------------------- main
 
 # conservative wall-clock estimates (s) used to gate auxiliaries; a
@@ -2553,6 +2712,7 @@ EST_SUST = int(knobs.get("OG_BENCH_EST_SUST"))
 # only runs under a generous driver budget (the gate skips it
 # honestly otherwise; OG_BENCH_SCALE_ROWS shrinks it for smoke runs)
 EST_SCALE = int(knobs.get("OG_BENCH_EST_SCALE"))
+EST_ING = int(knobs.get("OG_BENCH_EST_INGEST"))
 # r04/r05 hit the DRIVER's external kill (rc 124) with the old 3300s
 # budget: the orchestrator's own gating only bounds phase STARTS, so
 # the total can overshoot the budget by a phase. 1800s keeps headline
@@ -2568,7 +2728,7 @@ def main():
                              "scalequery", "headline", "csfull",
                              "promfull", "scalefull", "smoke",
                              "concurrent", "crashchild", "rcgate",
-                             "sustained"],
+                             "sustained", "ingest"],
                     default=None)
     ap.add_argument("--data", default=None)
     ap.add_argument("--runs", type=int, default=3)
@@ -2625,6 +2785,9 @@ def main():
     if args.phase == "sustained":
         print(json.dumps(sustained_phase()))
         return
+    if args.phase == "ingest":
+        print(json.dumps(ingest_phase()))
+        return
     if args.phase == "headline":
         print(json.dumps(headline_phase(
             args.runs, cpu_timeout=BUDGET_S * 0.8)))
@@ -2672,7 +2835,8 @@ def main():
         return
     print(headline, flush=True)          # lands even if killed later
 
-    for name, est in (("concurrent", EST_CONC),
+    for name, est in (("ingest", EST_ING),
+                      ("concurrent", EST_CONC),
                       ("sustained", EST_SUST),
                       ("promfull", EST_PROM),
                       ("csfull", EST_CS), ("scalefull", EST_SCALE)):

@@ -96,7 +96,31 @@ def _zstd_d(b) -> bytes:
 
 # ---------------------------------------------------------------- integers
 
+# simple8b packing floor: a word with selector (count, width) carries
+# EXACTLY `count` values, and a value of bit width b only fits words
+# whose selector width ≥ b — whose count is at most c_max(b). So any
+# s8b packing spends #words ≥ Σ 1/c_max(b_i), i.e. ≥ ceil(Σ units /
+# 5040) words with units = 5040 / c_max(b) (5040 = lcm of the selector
+# counts; exact integer arithmetic, no float ceilings). c_max by
+# width: 0→240, 1→60, 2→30, 3→20, 4→15, 5→12, 6→10, 7→8, 8→7,
+# 9-10→6, 11-12→5, 13-15→4, 16-20→3, 21-30→2, 31+→1.
+_S8B_UNITS = np.array(
+    [5040 // 240] + [5040 // 60] + [5040 // 30] + [5040 // 20]
+    + [5040 // 15] + [5040 // 12] + [5040 // 10] + [5040 // 8]
+    + [5040 // 7] + [5040 // 6] * 2 + [5040 // 5] * 2
+    + [5040 // 4] * 3 + [5040 // 3] * 5 + [5040 // 2] * 10
+    + [5040] * 34, dtype=np.int64)
+
+
+def _s8b_floor(widths: np.ndarray) -> int:
+    """Bytes ANY simple8b packing of values with these bit widths must
+    spend (a provable lower bound — see _S8B_UNITS)."""
+    units = int(_S8B_UNITS[np.minimum(widths, 64)].sum())
+    return 8 * (-(-units // 5040))
+
+
 def encode_integer_block(values: np.ndarray) -> bytes:
+    from .bitpack import bit_widths
     v = np.ascontiguousarray(values, dtype=np.int64)
     n = len(v)
     if n == 0:
@@ -107,12 +131,39 @@ def encode_integer_block(values: np.ndarray) -> bytes:
     d = np.diff(v, prepend=v[0:1])
     d[0] = 0
     zz = zigzag_encode(d)
-    if simple8b.can_encode(zz):
+    u = v.view(np.uint64)
+    # codec PRE-SELECTION from shape probes alone: the DFOR
+    # frame-of-reference width costs one zigzag + one max (no
+    # packing), and the s8b floors above bound the menu's other exits
+    # without running the greedy packer. Two short-circuits follow:
+    # (1) DFOR in the narrow-lane band (width ≤ 16, ≥ 4× under raw)
+    # whose EXACT payload size undercuts both s8b floors and raw is
+    # emitted directly — no possible s8b packing can beat it, and the
+    # zstd trial is skipped too (heuristic, not proof: the LZ4-tier
+    # codec does not reach 4× on entropy-bearing numeric lanes); the
+    # device layout lands on disk so cold queries ride compressed
+    # H2D. (2) An s8b trial whose floor already reaches the raw
+    # payload is provably futile and skipped byte-identically.
+    zz_ok = simple8b.can_encode(zz)
+    u_ok = simple8b.can_encode(u)
+    big = 1 << 62
+    floor_delta = 8 + _s8b_floor(bit_widths(zz)) if zz_ok else big
+    floor_raw = _s8b_floor(bit_widths(u)) if u_ok else big
+    if _device_layout_on():
+        r, ref, w = dfor.probe_int(v)
+        if 0 < w <= 16:
+            df_size = dfor.size_bytes(n, w)
+            # the menu is first-hit, so DFOR wins by undercutting the
+            # first trial that would have fired (delta-s8b when the
+            # deltas are encodable, raw-s8b otherwise) plus raw
+            first_floor = floor_delta if zz_ok else floor_raw
+            if df_size <= min(first_floor, 8 * n):
+                return bytes([DFOR]) + dfor.finish_int(r, ref, w)
+    if zz_ok and floor_delta < 8 * n:
         payload = struct.pack("<q", int(v[0])) + simple8b.encode(zz)
         if len(payload) < 8 * n:
             return bytes([DELTA_S8B]) + payload
-    u = v.view(np.uint64)
-    if simple8b.can_encode(u):
+    if u_ok and floor_raw < 8 * n:
         payload = simple8b.encode(u)
         if len(payload) < 8 * n:
             return bytes([S8B]) + payload
@@ -176,12 +227,13 @@ def encode_float_block(values: np.ndarray, prefer: str = "auto") -> bytes:
     # stacks, so decode locality beats the last % of ratio — DFOR wins
     # whenever it beats the RAW payload (a 2-decimal gauge packs to
     # ~14-bit lanes; full-mantissa noise hits width 64 and falls
-    # through to the legacy menu)
-    raw = v.tobytes()
+    # through to the legacy menu). raw bytes only materialize on the
+    # fall-through: the winning-DFOR path needs just the size bound
     if _device_layout_on():
         df = dfor.encode_float(v)
-        if df is not None and len(df) < len(raw):
+        if df is not None and len(df) < 8 * n:
             return bytes([DFOR]) + df
+    raw = v.tobytes()
     z = _zstd_c_fast(raw)
     if len(z) < len(raw):
         return bytes([ZSTD]) + z

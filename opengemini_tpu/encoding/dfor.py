@@ -45,10 +45,11 @@ import struct
 
 import numpy as np
 
-from .bitpack import bit_widths, zigzag_decode, zigzag_encode
+from .bitpack import zigzag_decode, zigzag_encode
 
 __all__ = ["T_INT", "T_XORREF", "T_XORPRED", "T_SCALED",
            "HEADER_BYTES", "encode_int", "encode_float", "decode",
+           "probe_int", "finish_int", "size_bytes",
            "parse_header", "payload_words", "unpack_words",
            "pack_words", "inverse_transform_batch", "decode_batch"]
 
@@ -175,24 +176,59 @@ def _zz_residuals(ki: np.ndarray):
     return zigzag_encode(d.view(np.int64)), ref
 
 
+def _max_width(r: np.ndarray) -> int:
+    """max bit width over u64 residuals — bit_length of the max value
+    (one vectorized max; the per-element bit_widths pass is only
+    needed when a caller wants the full distribution)."""
+    return int(r.max()).bit_length() if len(r) else 0
+
+
+def probe_int(values: np.ndarray):
+    """Cheap shape probe for the int menu's codec PRE-SELECTION:
+    (residuals u64, ref, rounded width) without packing a single word
+    — cost is one zigzag + one max. ``size_bytes(n, width)`` of the
+    result tells the caller whether DFOR provably undercuts the other
+    tiers BEFORE any of them runs."""
+    v = np.ascontiguousarray(values, dtype=np.int64)
+    r, ref = _zz_residuals(v)
+    return r, ref, _round_width(_max_width(r))
+
+
+def size_bytes(n: int, width: int) -> int:
+    """Exact DFOR payload size (header + u32 lanes) for n values at
+    ``width`` bits — computable from the probe alone."""
+    return HEADER_BYTES + 4 * ((n * width + 31) // 32)
+
+
+def finish_int(r: np.ndarray, ref: int, width: int) -> bytes:
+    """Pack a probe_int() result into the T_INT payload."""
+    return (_header(T_INT, width, 0, len(r), ref)
+            + pack_words(r, width).tobytes())
+
+
 def encode_int(values: np.ndarray) -> bytes | None:
     """DFOR payload for an int64/time block (T_INT), or None when the
     packed form cannot beat the raw payload (width 64)."""
-    v = np.ascontiguousarray(values, dtype=np.int64)
-    n = len(v)
+    n = len(values)
     if n == 0:
         return None
-    r, ref = _zz_residuals(v)
-    width = _round_width(int(bit_widths(r).max()) if n else 0)
+    r, ref, width = probe_int(values)
     if width >= 64:
         return None
-    words = pack_words(r, width)
-    return _header(T_INT, width, 0, n, ref) + words.tobytes()
+    return finish_int(r, ref, width)
 
 
 def encode_float(values: np.ndarray) -> bytes | None:
     """DFOR payload for an f64 block: narrowest of T_SCALED /
-    T_XORPRED / T_XORREF (bit-exact all three), or None for n == 0."""
+    T_XORPRED / T_XORREF (bit-exact all three), or None for n == 0.
+
+    Codec pre-selection fast path: a T_SCALED hit at width ≤ 16 (the
+    decimal-quantized telemetry shape — a 2-decimal gauge packs to
+    ~14-bit lanes, ≥ 4× under the raw payload) is emitted WITHOUT
+    trying the XOR transforms: on data that quantizes to ≤ 16-bit
+    deltas the mantissa-XOR residuals are never competitive, and the
+    two skipped transform trials were the float flush encode's
+    dominant cost."""
     v = np.ascontiguousarray(values, dtype=np.float64)
     n = len(v)
     if n == 0:
@@ -203,13 +239,16 @@ def encode_float(values: np.ndarray) -> bytes | None:
     if sc is not None:
         d, ki = sc
         r, ref = _zz_residuals(ki)
-        cands.append((_round_width(int(bit_widths(r).max())),
-                      T_SCALED, d, ref, r))
+        w = _round_width(_max_width(r))
+        if w <= 16:
+            return (_header(T_SCALED, w, d, n, ref)
+                    + pack_words(r, w).tobytes())
+        cands.append((w, T_SCALED, d, ref, r))
     r_pred = u ^ np.concatenate([u[:1], u[:-1]])
-    cands.append((_round_width(int(bit_widths(r_pred).max())),
+    cands.append((_round_width(_max_width(r_pred)),
                   T_XORPRED, 0, int(u[0]), r_pred))
     r_ref = u ^ u[0]
-    cands.append((_round_width(int(bit_widths(r_ref).max())),
+    cands.append((_round_width(_max_width(r_ref)),
                   T_XORREF, 0, int(u[0]), r_ref))
     width, transform, dscale, ref, r = min(
         cands, key=lambda c: (c[0], c[1]))

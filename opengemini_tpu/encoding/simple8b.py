@@ -43,34 +43,35 @@ def encode(values: np.ndarray) -> bytes:
     if int(widths.max()) > 60:
         raise ValueError("simple8b: value exceeds 60 bits")
 
-    # runlen[i] = how many consecutive values starting at i fit in `width`
-    # bits; we precompute, per selector, whether a full word fits at i.
-    fits = {}
+    # fits[sel][i] == True iff a word with selector `sel` starting at i
+    # is feasible (ok[i..i+count-1] all true and in range); the greedy
+    # choice at i is then the FIRST feasible selector (largest count),
+    # which one vectorized argmax over the (16, n) matrix yields for
+    # every start position at once — the walk below is one O(1) list
+    # hop per OUTPUT word (the per-(word, selector) scalar-indexing
+    # loop this replaces was the flush encode's top Python cost)
+    F = np.zeros((len(SELECTORS), n), dtype=np.bool_)
     for sel, (count, width) in enumerate(SELECTORS):
         ok = widths <= width if width else (v == 0)
         if count == 1:
-            fits[sel] = ok
+            F[sel] = ok
         else:
-            # fits[sel][i] == True iff ok[i..i+count-1] all true and in range
             c = np.cumsum(np.concatenate([[0], ok.astype(np.int64)]))
-            m = np.zeros(n, dtype=np.bool_)
             last = n - count
             if last >= 0:
-                m[: last + 1] = (c[count:] - c[:-count]) == count
-            fits[sel] = m
-
-    # greedy: pick the selector with the largest count that fits
+                F[sel, : last + 1] = (c[count:] - c[:-count]) == count
+    # selector 15 (count=1, width=60) always fits → argmax finds a True
+    first = np.argmax(F, axis=0)
+    counts_at = np.array([c for c, _ in SELECTORS],
+                         dtype=np.int64)[first].tolist()
+    sel_at = first.tolist()
     sel_of_word = []
     start_of_word = []
     i = 0
     while i < n:
-        # selector 15 (count=1, width=60) always fits, so this always breaks
-        for sel, (count, width) in enumerate(SELECTORS):
-            if i + count <= n and fits[sel][i]:
-                sel_of_word.append(sel)
-                start_of_word.append(i)
-                i += count
-                break
+        sel_of_word.append(sel_at[i])
+        start_of_word.append(i)
+        i += counts_at[i]
 
     sels = np.array(sel_of_word, dtype=np.int64)
     starts = np.array(start_of_word, dtype=np.int64)

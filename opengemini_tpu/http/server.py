@@ -199,6 +199,8 @@ class HttpServer:
             from ..utils.stats import latency_collector
             sp.register("latency", latency_collector)
             sp.register("wal", wal_collector)
+            from ..utils.stats import flight_collector
+            sp.register("flight", flight_collector)
             sp.register("raft", raft_collector)
             sp.register("subscriber", subscriber_collector)
             sp.register("compaction", compaction_collector)
@@ -964,6 +966,7 @@ class HttpServer:
                                    devicecache_collector,
                                    devicefault_collector,
                                    engine_collector, executor_collector,
+                                   flight_collector,
                                    hbm_collector, raft_collector,
                                    readcache_collector,
                                    resultcache_collector,
@@ -986,6 +989,7 @@ class HttpServer:
                   "compileaudit": compileaudit_collector(),
                   "xfer": xfer_collector(),
                   "wal": wal_collector(),
+                  "flight": flight_collector(),
                   "raft": raft_collector(),
                   "subscriber": subscriber_collector(),
                   "compaction": compaction_collector(),
@@ -1657,6 +1661,7 @@ class _Handler(BaseHTTPRequestHandler):
             from ..utils.stats import (device_decode_collector,
                                        devicecache_collector,
                                        devicefault_collector,
+                                       flight_collector,
                                        hbm_collector,
                                        histogram_summaries,
                                        resultcache_collector,
@@ -1680,6 +1685,7 @@ class _Handler(BaseHTTPRequestHandler):
             out["compileaudit"] = audit_snapshot()
             out["xfer"] = manifest_snapshot()
             out["wal"] = wal_collector()
+            out["flight"] = flight_collector()
             # startup recovery report: cumulative replay/salvage/
             # quarantine counters plus the recent per-shard reports
             # ring — what the last restart actually recovered

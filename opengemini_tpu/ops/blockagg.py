@@ -331,9 +331,13 @@ def _build_slab_device(reader, field: str, metas, seg: int, E: int,
                 host_blocks.append(b)
                 continue
             nw = (r * w + 31) // 32
+            # zero-staging: a view straight over the mapped pages —
+            # no bytes() copy; the words land in wmat (a real copy)
+            # before H2D, so nothing retained aliases the mmap
             words = np.frombuffer(
-                mm[s.offset + 1 + _dfm.HEADER_BYTES:
-                   s.offset + 1 + _dfm.HEADER_BYTES + 4 * nw],
+                memoryview(mm)[s.offset + 1 + _dfm.HEADER_BYTES:
+                               s.offset + 1 + _dfm.HEADER_BYTES
+                               + 4 * nw],
                 dtype="<u4")
             dfor_groups.setdefault((w, tr, ds, r), []).append(
                 (b, ref, words))
@@ -1067,9 +1071,10 @@ def dense_fill_compressed(sources, field: str, P: int, E):
         if n_hdr != s.rows:
             return None
         nw = (s.rows * w + 31) // 32
+        # zero-staging: view over the mmap, copied into wmat below
         words = np.frombuffer(
-            mm[s.offset + 1 + _dfm.HEADER_BYTES:
-               s.offset + 1 + _dfm.HEADER_BYTES + 4 * nw],
+            memoryview(mm)[s.offset + 1 + _dfm.HEADER_BYTES:
+                           s.offset + 1 + _dfm.HEADER_BYTES + 4 * nw],
             dtype="<u4")
         segs.append((w, tr, ds, int(s.rows), ref, int(lo), int(f),
                      words))

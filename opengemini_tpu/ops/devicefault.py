@@ -433,7 +433,7 @@ def hbm_pressure_relief(route: str, nbytes_hint: int = 0) -> int:
         except Exception as e:
             cls = classify(e)
             log.warning("oom relief eviction failed (route=%s, "
-                        "class=%s): %s", route, cls, e)
+                        "class=%s): %s", route, cls, str(e))
     if freed:
         _bump("oom_evicted_bytes", freed)
     _shrink_gate_permit()
@@ -516,16 +516,20 @@ def guarded_launch(route: str, fn, ctx=None, span=None,
             if cls == "transient" and attempt < retries:
                 attempt += 1
                 _bump("retries")
+                # str(e), not e: a LogRecord retains its args, and a
+                # live exception pins its whole traceback (frames
+                # holding zero-staging mmap views) in any deferred-
+                # formatting handler
                 log.warning("transient device fault on route %s "
                             "(attempt %d/%d): %s", route, attempt,
-                            retries, e)
+                            retries, str(e))
                 _backoff_sleep(attempt - 1, ctx=ctx)
                 continue
             if cls == "oom" and not oom_retried:
                 oom_retried = True
                 hbm_pressure_relief(route)
                 log.warning("device OOM on route %s — pressure ladder "
-                            "ran, retrying once: %s", route, e)
+                            "ran, retrying once: %s", route, str(e))
                 continue
             # exhausted (or fatal): this route is sick — charge the
             # breaker and hand the statement to the fallback wrapper
@@ -537,7 +541,7 @@ def guarded_launch(route: str, fn, ctx=None, span=None,
             log.warning(
                 "device route %s failed (%s, retries exhausted=%s, "
                 "breaker=%s): %s", route, cls, attempt >= retries,
-                br.snapshot()["state"], e)
+                br.snapshot()["state"], str(e))
             raise DeviceRouteDown(route, e) from e
 
 

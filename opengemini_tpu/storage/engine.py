@@ -37,7 +37,7 @@ class EngineOptions:
     shard_duration: int = DEFAULT_SHARD_DURATION
     flush_bytes: int = 256 * 1024 * 1024
     wal_sync: bool = False
-    wal_compression: str = "zstd"     # "zstd" | "lz4" (native codec)
+    wal_compression: str = "zstd"     # "zstd" | "lz4" | "none"
     segment_size: int = SEGMENT_SIZE
     obs_store: object | None = None   # hierarchical cold tier (obs.py)
     # lazy shard open (reference engine.go:780 openShardLazy): startup
@@ -438,24 +438,67 @@ class Engine:
             _epochs.note_write(db_name, mst, lo_gi * sd,
                                min((hi_gi + 1) * sd - 1, 1 << 62))
         if written and self.write_hooks:
-            from .rows import PointRow
-            rows = []
-            for mst, tags, times, fields in written:
-                np_fields = {k: np.asarray(v) for k, v in fields.items()}
-                rows.extend(
-                    PointRow(mst, tags,
-                             {k: v[i].item()
-                              for k, v in np_fields.items()},
-                             int(times[i]))
-                    for i in range(len(times)))
-            for hook in self.write_hooks:
+            self._fanout_hooks(db_name, written)
+        if err is not None:
+            raise err
+        return n
+
+    # bound on PointRows materialized at once for row-wise write hooks:
+    # the bulk ingest path must not allocate a million-row list just
+    # because a stream task is registered
+    _HOOK_CHUNK = 65536
+
+    def _fanout_hooks(self, db_name: str, written: list) -> None:
+        """Write-hook fan-out for the bulk columnar paths. ``written``
+        is [(mst, tags, times, field arrays)] batches. Hooks that set
+        ``wants_columnar = True`` receive those batches directly (no
+        row materialization at all); row-wise hooks get PointRows
+        built in bounded chunks from ONE per-column tolist() each —
+        no per-value ndarray .item() calls."""
+        import numpy as np
+        from .rows import PointRow
+        row_hooks, col_hooks = [], []
+        for h in self.write_hooks:
+            (col_hooks if getattr(h, "wants_columnar", False)
+             else row_hooks).append(h)
+        for hook in col_hooks:
+            try:
+                hook(db_name, written)
+            except Exception:
+                log.exception("write hook failed")
+        if not row_hooks:
+            return
+        chunk: list = []
+
+        def _flush() -> None:
+            # hooks may keep the list past this call (the subscriber
+            # encodes its batch lazily on a worker thread) — hand over
+            # ownership and start a fresh chunk instead of clearing
+            nonlocal chunk
+            if not chunk:
+                return
+            rows, chunk = chunk, []
+            for hook in row_hooks:
                 try:
                     hook(db_name, rows)
                 except Exception:
                     log.exception("write hook failed")
-        if err is not None:
-            raise err
-        return n
+
+        C = self._HOOK_CHUNK
+        for mst, tags, times, fields in written:
+            names = list(fields)
+            cols = [np.asarray(v).tolist() for v in fields.values()]
+            tl = np.asarray(times).tolist()
+            for i0 in range(0, len(tl), C):
+                i1 = min(i0 + C, len(tl))
+                chunk.extend(
+                    PointRow(mst, tags, dict(zip(names, vals)), t)
+                    for t, vals in zip(
+                        tl[i0:i1],
+                        zip(*(c[i0:i1] for c in cols))))
+                if len(chunk) >= C:
+                    _flush()
+        _flush()
 
     def write_series_matrix(self, db_name: str, mst: str, keys: list,
                             tag_cols: list, times, fields: dict,
@@ -487,18 +530,14 @@ class Engine:
                 _epochs.note_write(db_name, mst, int(times.min()),
                                    int(times.max()))
         if self.write_hooks:
-            from .rows import PointRow
-            rows = [PointRow(mst, dict(zip(keys, vals)),
-                             {k: np.asarray(v)[si, pi].item()
-                              for k, v in fields.items()},
-                             int(times[pi]))
-                    for si, vals in enumerate(zip(*tag_cols))
-                    for pi in range(len(times))]
-            for hook in self.write_hooks:
-                try:
-                    hook(db_name, rows)
-                except Exception:
-                    log.exception("write hook failed")
+            # reshape the matrix into the bulk `written` batch form
+            # (per-series numpy row VIEWS — no copies) and share the
+            # chunked/columnar fan-out with write_record_batch
+            mats = {k: np.asarray(v) for k, v in fields.items()}
+            written = [(mst, dict(zip(keys, vals)), times,
+                        {k: m[si] for k, m in mats.items()})
+                       for si, vals in enumerate(zip(*tag_cols))]
+            self._fanout_hooks(db_name, written)
         return n
 
     # ---- reads -----------------------------------------------------------

@@ -340,9 +340,13 @@ class Shard:
             # lock spans wal.write + mem.write so a concurrent flush cannot
             # seal the WAL segment between them (which would let commit
             # delete the only durable copy of these rows)
-            self.wal.write(batch)
+            ticket = self.wal.write(batch, defer_sync=True)
             for mst, sid, fields, t in batch:
                 self.mem.write(mst, sid, fields, t)
+        # durability wait OUTSIDE the shard lock: with group commit on,
+        # concurrent shards coalesce into one fsync; the write is acked
+        # (returns) only once its WAL frame is covered by a sync
+        self.wal.wait_durable(ticket)
         if self.mem.approx_bytes >= self.flush_bytes:
             self.flush()
         return len(batch)
@@ -448,10 +452,15 @@ class Shard:
                 if sch.get(k) == DataType.FLOAT \
                         and fields_cat[k].dtype == np.int64:
                     fields_cat[k] = fields_cat[k].astype(np.float64)
-            self.wal.write_cols_bulk(mst, sids, offsets, times_cat,
-                                     fields_cat)
+            ticket = self.wal.write_cols_bulk(
+                mst, sids, offsets, times_cat, fields_cat,
+                defer_sync=True)
             self.mem.write_columns_bulk(mst, sids, offsets, times_cat,
                                         fields_cat)
+        # group-commit: fsync wait happens OUTSIDE the shard lock so
+        # concurrent bulk writers (other shards, other Flight batches)
+        # coalesce into one sync; ack only after the wait returns
+        self.wal.wait_durable(ticket)
         if self.mem.approx_bytes >= self.flush_bytes:
             self.flush()
         return n
@@ -505,10 +514,12 @@ class Shard:
                 if sch.get(k) == DataType.FLOAT \
                         and fields_cat[k].dtype == np.int64:
                     fields_cat[k] = fields_cat[k].astype(np.float64)
-            self.wal.write_cols_bulk(mst, sids, offsets, times_cat,
-                                     fields_cat)
+            ticket = self.wal.write_cols_bulk(
+                mst, sids, offsets, times_cat, fields_cat,
+                defer_sync=True)
             self.mem.write_columns_bulk(mst, sids, offsets, times_cat,
                                         fields_cat)
+        self.wal.wait_durable(ticket)
         if self.mem.approx_bytes >= self.flush_bytes:
             self.flush()
         return S * P
@@ -556,9 +567,10 @@ class Shard:
                         norm[k] = norm[k].astype(np.float64)
                 wal_entries.append((mst, sid, times, norm))
                 n += len(times)
-            self.wal.write_cols(wal_entries)
+            ticket = self.wal.write_cols(wal_entries, defer_sync=True)
             for mst, sid, times, norm in wal_entries:
                 self.mem.write_columns(mst, sid, times, norm)
+        self.wal.wait_durable(ticket)
         if self.mem.approx_bytes >= self.flush_bytes:
             self.flush()
         return n

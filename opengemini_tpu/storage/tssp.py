@@ -45,15 +45,19 @@ MAGIC = 0x54505553  # "SUPT" — distinct from reference's 53ac2021
 
 def encode_workers() -> int:
     """Worker count for the flush encode pool (OG_ENCODE_WORKERS;
-    0/1 = serial, the default). The pool keeps file bytes identical
-    (encode stage is pure; appends stay ordered on the caller's
-    thread), but measured on the TSBS flush shape the GIL handoff
-    storm around the many small numpy ops made 2-8 threads 2-4×
-    SLOWER than serial — the serial path is already dominated by
-    GIL-releasing native codecs (gorilla, LZ4, og_limb_sums). The
-    knob exists for compression-heavy deployments (real zstandard at
-    high levels, string-block-heavy schemas) where the C share is
-    large enough to pay; measure before enabling."""
+    unset = auto = min(4, cores), ``1`` pins the serial pre-PR-20
+    behavior). The pool keeps file bytes identical (encode stage is
+    pure; appends stay ordered on the caller's thread). The PR-3
+    measurement that pinned the default to serial — a GIL handoff
+    storm of many small numpy ops making 2-8 threads 2-4× SLOWER —
+    predates the probe-driven encode menu: with codec pre-selection
+    emitting DFOR from shape probes, provably-futile simple8b trials
+    skipped, and the greedy packer vectorized, the same TSBS flush
+    shape now measures NEUTRAL under threads, and native-codec-heavy
+    schemas (zstd/LZ4 string blocks, gorilla) that release the GIL
+    see real overlap. Auto therefore scales with cores (a 1-core
+    container stays serial); small flushes (≤ one submit batch) stay
+    serial regardless — see write_series_stream."""
     raw = knobs.get_raw("OG_ENCODE_WORKERS") or ""
     try:
         n = int(raw)
@@ -61,7 +65,7 @@ def encode_workers() -> int:
         n = -1
     if n >= 0:
         return n
-    return 0
+    return min(4, os.cpu_count() or 1)
 VERSION = 3                  # v2: PreAgg carries reproducible-sum limbs
 #                              v3: trailer carries a CRC32 over the
 #                              meta/index/bloom sections, verified at
@@ -427,9 +431,21 @@ class TSSPWriter:
         The in-flight window is bounded (4 per worker) so a 69M-row
         flush never holds more than a few dozen encoded series in
         memory. The flush path uses this for the bench's 16k-series
-        ingest; 0/1 workers = the serial loop."""
+        ingest; 0/1 workers = the serial loop, and a flush that fits
+        in one submit batch (≤ 32 series) stays serial too — pool
+        startup would dominate the overlap it buys."""
         w = encode_workers()
-        if w <= 1:
+        head = None
+        if w > 1:
+            import itertools
+            cutoff = max(0, int(knobs.get("OG_ENCODE_SERIAL_CUTOFF")))
+            pairs = iter(pairs)
+            head = list(itertools.islice(pairs, cutoff + 1))
+            if len(head) <= cutoff:
+                pairs, head = iter(head), None
+            else:
+                pairs = itertools.chain(head, pairs)
+        if w <= 1 or head is None:
             for sid, rec in pairs:
                 self.write_series(sid, rec)
             return
@@ -444,6 +460,11 @@ class TSSPWriter:
         batch: list = []
 
         def drain_one():
+            # crash boundary: worker-encoded series are being
+            # committed to the (still .tmp) file in submission order
+            # — a kill here must leave only an orphan .tmp that the
+            # restart sweeps (C4), with every row still in the WAL
+            failpoint.inject("tssp.parallel_flush.crash")
             for psid, encoded in pending.popleft().result():
                 self._append_encoded(psid, encoded)
 
@@ -961,7 +982,17 @@ class TSSPReader:
         self._meta_cache: dict[int, dict[int, ChunkMeta]] = {}
 
     def close(self) -> None:
-        self._mm.close()
+        try:
+            self._mm.close()
+        except BufferError:
+            # zero-staging hands out transient views over the mmap
+            # (payload_view / _decode_segment / blockagg word views);
+            # an exception traceback cycle (device-decode fault paths)
+            # can pin a dead frame holding one until the cycle
+            # collector runs — collect and retry before surfacing
+            import gc
+            gc.collect()
+            self._mm.close()
         if self._file is not None:
             self._file.close()
 
@@ -979,7 +1010,7 @@ class TSSPReader:
         if cached is not None:
             return cached
         _, _, off, size, count = self._index[gi]
-        blob = enc._zstd_d(self._mm[off:off + size])
+        blob = enc._zstd_d(self._window(off, size))
         metas: dict[int, ChunkMeta] = {}
         pos = 0
         for _ in range(count):
@@ -1045,11 +1076,32 @@ class TSSPReader:
             return out
         return self._decode_segment(col, seg)
 
+    def payload_view(self, seg: Segment) -> memoryview:
+        """ZERO-STAGING handoff: the segment's encoded payload as a
+        memoryview straight over the file mmap — no staging copy. The
+        view is transient scan-side state: every block decoder accepts
+        a memoryview and returns freshly-allocated arrays (RAW/ZSTD
+        ``.copy()``, gorilla/dfor ``bytes()`` their payload words), so
+        nothing decoded aliases the mmap and ``close()`` stays safe.
+        Callers must not hold the view past the reader's lifetime."""
+        return self._window(seg.offset, seg.size)
+
+    def _window(self, off: int, size: int) -> memoryview:
+        """[off, off+size) as a memoryview. mmap-backed readers get a
+        zero-copy window over the map; detached (object-store) readers
+        slice through DetachedSource.__getitem__, which range-GETs and
+        caches blocks — there the bytes ARE the staging, unavoidably."""
+        if self.detached:
+            return memoryview(self._mm[off:off + size])
+        return memoryview(self._mm)[off:off + size]
+
     def _decode_segment(self, col: ColumnMeta, seg: Segment) -> ColVal:
-        mm = self._mm
-        raw = mm[seg.offset:seg.offset + seg.size]
+        # zero-staging: decoders consume memoryviews of the mmap
+        # directly (no bytes() staging copy of the encoded payload);
+        # see payload_view for the aliasing contract
+        raw = self._window(seg.offset, seg.size)
         valid = enc.decode_validity(
-            mm[seg.valid_offset:seg.valid_offset + seg.valid_size], seg.rows)
+            self._window(seg.valid_offset, seg.valid_size), seg.rows)
         t = col.type
         if t == DataType.TIME:
             return ColVal(t, enc.decode_time_block(raw, seg.rows), valid)

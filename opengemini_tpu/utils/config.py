@@ -94,7 +94,7 @@ class DataConfig:
     """[data] — reference [data] store dirs, wal, compaction, cache."""
     store_data_dir: str = "./data"
     wal_sync: bool = False
-    wal_compression: str = "zstd"         # zstd | lz4
+    wal_compression: str = "zstd"         # zstd | lz4 | none
     shard_duration_ns: int = 24 * 3600 * NS
     flush_bytes: int = 256 * 1024 * 1024
     segment_size: int = 8192

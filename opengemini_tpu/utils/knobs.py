@@ -495,7 +495,26 @@ register("OG_PROM_DEVICE_CHUNK_ROWS", int, 16_000_000,
 
 # --- storage / index / ingest
 register("OG_ENCODE_WORKERS", str, "",
-         "TSSP flush encode pool size; unset = serial")
+         "TSSP flush encode pool size; unset = auto (min(4, cores), "
+         "serial for small flushes) — DFOR made encode numpy-bound so "
+         "the pool now wins; `1` pins the serial pre-PR-20 behavior")
+register("OG_FLIGHT_COLUMNAR", bool, True,
+         "Arrow Flight DoPut columnar fast lane: land Arrow columns "
+         "directly in Engine.write_record_batch (no per-row "
+         "PointRow materialization); 0 = row-wise batch_to_rows path")
+register("OG_WAL_GROUP_COMMIT_US", int, 0,
+         "WAL group commit window in microseconds: concurrent "
+         "writers coalesce into one fsync (leader waits this long "
+         "for followers before syncing); 0 = every write syncs "
+         "itself (pre-PR-20 behavior)")
+register("OG_INGEST_WORKERS", int, 4,
+         "bench --phase ingest: concurrent open-loop ingest writer "
+         "threads")
+register("OG_ENCODE_SERIAL_CUTOFF", int, 32,
+         "flushes with <= this many series stay serial even when "
+         "OG_ENCODE_WORKERS > 1 (pool startup would dominate); the "
+         "crash harness lowers it to force the parallel publish "
+         "path on its small deterministic flushes")
 register("OG_TSI_SNAP_BYTES", int, 4 << 20,
          "TSI log-size threshold that triggers an index snapshot",
          scope="module-init")
@@ -571,6 +590,9 @@ register("OG_BENCH_EST_PROM", int, 1300, "bench: prom phase budget s")
 register("OG_BENCH_EST_CS", int, 420, "bench: colstore budget s")
 register("OG_BENCH_EST_CONC", int, 420, "bench: concurrent budget s")
 register("OG_BENCH_EST_SCALE", int, 3000, "bench: scale budget s")
+register("OG_BENCH_EST_INGEST", int, 240, "bench: ingest budget s")
+register("OG_BENCH_INGEST_BATCHES", int, 24,
+         "bench --phase ingest: 65536-row Arrow batches per rep")
 register("OG_BENCH_BUDGET_S", float, 1800.0,
          "bench: total wall budget the orchestrator sub-divides")
 register("OG_SERIES_BENCH_N", int, 1_000_000,

@@ -516,6 +516,15 @@ def wal_collector():
     return dict(WAL_STATS)
 
 
+def flight_collector():
+    """Arrow Flight ingest metrics (services/arrowflight.py): rows,
+    batches, columnar fast-lane batches and write errors. The
+    columnar_batches / batches ratio says how much DoPut traffic is
+    riding the vectorized lane vs the row hatch."""
+    from ..services.arrowflight import FLIGHT_STATS
+    return dict(FLIGHT_STATS)
+
+
 def compileaudit_collector():
     """Compile-cache audit metrics (ops/compileaudit.py): XLA compile
     / retrace totals, duplicate (kernel, signature) compiles — the

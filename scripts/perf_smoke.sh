@@ -201,6 +201,44 @@ print(f"packed predicate OK: 0.1% selectivity expands "
       f"{r['pd_heals']} per-batch mask heals")
 EOF
 
+# ingest line-rate gate (round 20): the columnar Flight lane must beat
+# the row-wise hatch >= 3x at smoke scale with bit-identical query
+# digests across lanes, group commit must coalesce fsyncs under
+# concurrent fsync-acknowledged writers, and one SIGKILL/restart cycle
+# at the group-commit boundary must satisfy the full recovery contract
+timeout -k 10 "${OG_SMOKE_TIMEOUT_S:-900}" \
+    python bench.py --phase ingest | tee /tmp/og_ingest_smoke.json
+
+python - <<'EOF'
+import json
+last = open("/tmp/og_ingest_smoke.json").read().strip().splitlines()[-1]
+r = json.loads(last)
+assert r.get("ingest_rows_per_sec", 0) > 0, r
+assert r.get("columnar_x_hatch", 0) >= 3.0, r
+assert r.get("lanes_bit_identical") is True, r
+gc = r.get("group_commit", {})
+assert gc.get("fsyncs", 99) <= gc.get("frames", 0), r
+print(f"ingest gate OK: columnar {r['ingest_rows_per_sec']:,} rows/s "
+      f"({r['ingest_x_baseline']}x r08 baseline, "
+      f"{r['columnar_x_hatch']}x the row hatch), lanes bit-identical, "
+      f"group commit {gc.get('frames')} frames -> {gc.get('fsyncs')} "
+      f"fsyncs")
+EOF
+
+# one real SIGKILL mid-group-commit + two restarts (C1-C5): the write
+# path smoke above proves speed; this proves the new fsync boundary
+# loses nothing it acknowledged
+python tests/crashharness.py cycle /tmp/og_ingest_crash \
+    wal.group_commit.crash 2020 > /tmp/og_ingest_crash.json
+python - <<'EOF'
+import json
+r = json.loads(open("/tmp/og_ingest_crash.json").read())
+assert r.get("fired") is True, r
+print("ingest crash gate OK: group-commit SIGKILL cycle recovered, "
+      "digests idempotent across two restarts")
+EOF
+rm -rf /tmp/og_ingest_crash /tmp/og_ingest_crash.json
+
 # result-cache gate (sustained serving, round 16): on every bench
 # shape, cache-on digests must equal the OG_RESULT_CACHE=0 reference
 # on the cold pass, the warm pass (served from cached closed-bucket

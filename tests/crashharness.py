@@ -96,6 +96,24 @@ CRASH_SITES: dict[str, int] = {
     "colstore.publish.crash": 2,
     "compact.swap.crash": 0,
     "backup.manifest.crash": 0,
+    # PR 20 publish paths: the grouped-fsync boundary (all frames of
+    # a commit group appended, none synced) and the parallel-encode
+    # ordered append into the still-.tmp TSSP file
+    "wal.group_commit.crash": 5,
+    "tssp.parallel_flush.crash": 2,
+}
+
+# extra CHILD environment a site needs to put its code path on the
+# harness workload (the verifier always runs on defaults: recovery
+# must not depend on the writer's tuning)
+SITE_ENV: dict[str, dict[str, str]] = {
+    # group commit only engages with a window armed; every
+    # fsync-acknowledged write then takes the leader path
+    "wal.group_commit.crash": {"OG_WAL_GROUP_COMMIT_US": "500"},
+    # the harness flush is 8 series — force the parallel path by
+    # dropping the serial-peek cutoff under the worker pool
+    "tssp.parallel_flush.crash": {"OG_ENCODE_WORKERS": "2",
+                                  "OG_ENCODE_SERIAL_CUTOFF": "1"},
 }
 
 
@@ -388,6 +406,7 @@ def run_crash_cycle(workdir: str, site: str, seed: int,
     env = dict(os.environ)
     env["OG_CRASH_OK"] = "1"         # the child, and ONLY the child
     env.pop("OG_WAL_SALVAGE", None)  # contract is proven on defaults
+    env.update(SITE_ENV.get(site, {}))
     child = _run(_harness_cmd("child", workdir, site, str(seed),
                               str(skip)), env, timeout_s)
     if child.returncode == -signal.SIGKILL:

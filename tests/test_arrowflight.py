@@ -79,6 +79,10 @@ class TestFlightIngest:
         w.write_table("db0", "cpu", _table(), tag_columns=["hostname", "region"])
         w.close()
         assert svc.stats()["rows_written"] == 8
+        from opengemini_tpu.utils.stats import flight_collector
+        fam = flight_collector()     # /debug/vars mirror of svc.stats()
+        assert fam.get("rows_written", 0) >= 8
+        assert fam.get("batches", 0) >= 1
         res = _q(eng, "SELECT sum(usage_user) FROM cpu")
         total = res["series"][0]["values"][0][1]
         assert total == pytest.approx(np.linspace(1.0, 8, 8).sum())
@@ -122,3 +126,139 @@ class TestFlightAuth:
         finally:
             svc.stop()
             eng.close()
+
+# ------------------------------------------------- PR 20 lane parity
+
+def _lane_dataset(n=256):
+    """Deterministic exact-binary dataset ingestible by every lane."""
+    hosts = [f"h{i % 4}" for i in range(n)]
+    regions = [f"r{i % 2}" for i in range(n)]
+    usage = (np.arange(n, dtype=np.float64) + 1) / 8.0   # exact floats
+    count = np.arange(n, dtype=np.int64) * 3 + 1
+    times = (np.arange(n, dtype=np.int64) + 1) * 1_000_000_000
+    return hosts, regions, usage, count, times
+
+
+def _lane_table(n=256):
+    hosts, regions, usage, count, times = _lane_dataset(n)
+    return pa.table({
+        "host": pa.array(hosts).dictionary_encode(),
+        "region": pa.array(regions).dictionary_encode(),
+        "usage": pa.array(usage),
+        "count": pa.array(count),
+        "time": pa.array(times)})
+
+
+def _lane_lines(n=256) -> bytes:
+    hosts, regions, usage, count, times = _lane_dataset(n)
+    return "\n".join(
+        f"cpu,host={hosts[i]},region={regions[i]} "
+        f"usage={float(usage[i])!r},count={count[i]}i {times[i]}"
+        for i in range(n)).encode()
+
+
+def _lane_digests(eng) -> list[str]:
+    import hashlib
+    import json
+    digs = []
+    for q in ("SELECT count(usage), sum(count) FROM cpu GROUP BY host",
+              "SELECT mean(usage) FROM cpu GROUP BY region",
+              "SELECT sum(usage) FROM cpu WHERE host = 'h1'"):
+        res = _q(eng, q)
+        assert "error" not in res, res
+        digs.append(hashlib.sha256(
+            json.dumps(res, sort_keys=True).encode()).hexdigest())
+    return digs
+
+
+class TestIngestLaneParity:
+    """DoPut columnar, DoPut row hatch (OG_FLIGHT_COLUMNAR=0) and HTTP
+    line protocol must serve bit-identical query results — the fast
+    lane is an optimization, never a semantic."""
+
+    def _flight_ingest(self, tmp_path, sub, columnar: bool):
+        from opengemini_tpu.utils import knobs
+        knobs.set_env("OG_FLIGHT_COLUMNAR", "1" if columnar else "0")
+        try:
+            eng = Engine(str(tmp_path / sub))
+            svc = ArrowFlightService(eng)
+            svc.start()
+            try:
+                w = FlightWriter(svc.location)
+                w.write_table("db0", "cpu", _lane_table(),
+                              tag_columns=["host", "region"])
+                w.close()
+                stats = svc.stats()
+                assert stats["rows_written"] == 256
+                assert stats["columnar_batches"] == \
+                    (stats["batches"] if columnar else 0)
+            finally:
+                svc.stop()
+            return eng
+        finally:
+            knobs.del_env("OG_FLIGHT_COLUMNAR")
+
+    def test_three_lanes_bit_identical(self, tmp_path):
+        from opengemini_tpu.utils.lineprotocol import ingest_lines
+        eng_col = self._flight_ingest(tmp_path, "col", columnar=True)
+        eng_row = self._flight_ingest(tmp_path, "row", columnar=False)
+        eng_lp = Engine(str(tmp_path / "lp"))
+        eng_lp.create_database("db0")
+        assert ingest_lines(eng_lp, "db0", _lane_lines()) == 256
+        try:
+            d_col = _lane_digests(eng_col)
+            d_row = _lane_digests(eng_row)
+            d_lp = _lane_digests(eng_lp)
+            assert d_col == d_row, "columnar lane diverged from hatch"
+            assert d_col == d_lp, "flight lanes diverged from line protocol"
+        finally:
+            eng_col.close()
+            eng_row.close()
+            eng_lp.close()
+
+    def test_parity_survives_flush(self, tmp_path):
+        """Same gate after the memtable reaches TSSP files (the DFOR
+        codec pre-selection path runs at flush time)."""
+        eng_col = self._flight_ingest(tmp_path, "col", columnar=True)
+        eng_row = self._flight_ingest(tmp_path, "row", columnar=False)
+        try:
+            eng_col.flush_all()
+            eng_row.flush_all()
+            assert _lane_digests(eng_col) == _lane_digests(eng_row)
+        finally:
+            eng_col.close()
+            eng_row.close()
+
+    def test_null_field_batches_degrade_to_hatch(self, tmp_path):
+        """A batch with a null field is ineligible for the columnar
+        lane (sparse-field semantics) and must take the row hatch —
+        batch-wise, with results identical to a pure row-wise server."""
+        t = _table()                        # usage_system has a null
+        from opengemini_tpu.utils import knobs
+        engines = {}
+        for sub, col in (("a", "1"), ("b", "0")):
+            knobs.set_env("OG_FLIGHT_COLUMNAR", col)
+            try:
+                eng = Engine(str(tmp_path / sub))
+                svc = ArrowFlightService(eng)
+                svc.start()
+                try:
+                    w = FlightWriter(svc.location)
+                    w.write_table("db0", "cpu", t,
+                                  tag_columns=["hostname", "region"])
+                    w.close()
+                    assert svc.stats()["columnar_batches"] == 0
+                finally:
+                    svc.stop()
+                engines[sub] = eng
+            finally:
+                knobs.del_env("OG_FLIGHT_COLUMNAR")
+        try:
+            qa = _q(engines["a"], "SELECT sum(usage_system) FROM cpu "
+                                  "GROUP BY hostname")
+            qb = _q(engines["b"], "SELECT sum(usage_system) FROM cpu "
+                                  "GROUP BY hostname")
+            assert qa == qb
+        finally:
+            for eng in engines.values():
+                eng.close()

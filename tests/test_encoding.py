@@ -320,3 +320,53 @@ def test_dfor_batch_decode_matches_scalar():
         for j, i in enumerate(idxs):
             assert np.array_equal(out[j].view(np.uint64),
                                   blocks[i].view(np.uint64))
+
+
+# ---- PR 20: codec pre-selection shortcut ------------------------------------
+
+def test_dfor_preselect_fires_on_narrow_lane():
+    """Narrow-range jumpy gauges (big frame of reference, small spread,
+    every delta as wide as the range — s8b's worst packing class) sit
+    squarely in the DFOR shortcut band (width <= 16, >= 4x under raw):
+    the menu must emit DFOR without running the s8b packer, and
+    size_bytes must predict the payload exactly."""
+    from opengemini_tpu.encoding import blocks, dfor
+    from opengemini_tpu.utils import knobs
+    knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "1")
+    try:
+        v = 10**15 + ((np.arange(2000, dtype=np.int64) * 73) % 128)
+        enc = encode_integer_block(v)
+        assert enc[0] == blocks.DFOR
+        _r, _ref, w = dfor.probe_int(v)
+        assert 0 < w <= 16
+        assert len(enc) == 1 + dfor.size_bytes(len(v), w)
+        np.testing.assert_array_equal(decode_integer_block(enc, len(v)), v)
+    finally:
+        knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+
+
+def test_dfor_preselect_never_beaten_by_skipped_trial():
+    """When the shortcut fires it skipped the s8b trials on a size
+    floor — the encoding it skipped must never have been smaller."""
+    from opengemini_tpu.encoding import blocks
+    from opengemini_tpu.utils import knobs
+    shapes = [
+        np.cumsum(rng.integers(0, 200, 1500)).astype(np.int64),
+        np.arange(3000, dtype=np.int64) * 1000,
+        rng.integers(0, 1 << 12, 800).astype(np.int64),
+    ]
+    for v in shapes:
+        knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "1")
+        try:
+            fast = encode_integer_block(v)
+        finally:
+            knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+        knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "0")
+        try:
+            menu = encode_integer_block(v)
+        finally:
+            knobs.del_env("OG_WRITE_DEVICE_LAYOUT")
+        if fast[0] == blocks.DFOR:
+            assert len(fast) <= len(menu), (fast[0], len(fast), len(menu))
+        np.testing.assert_array_equal(decode_integer_block(fast, len(v)),
+                                      decode_integer_block(menu, len(v)))

@@ -1,0 +1,212 @@
+"""Ingest fast-lane units (PR 20): scatter-gather WAL framing and the
+"none" codec, group-commit fsync coalescing, columnar tag grouping
+parity with the row path, and the encode-menu pre-selection floor
+(simple8b word-occupancy bound + DFOR first-hit shortcut)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from opengemini_tpu.encoding import blocks, simple8b
+from opengemini_tpu.storage.wal import (WAL, WAL_STATS,
+                                        _pack_cols_bulk,
+                                        _pack_cols_bulk_parts)
+from opengemini_tpu.utils import knobs
+
+
+def _bulk_args(rows=512, ns=16):
+    rng = np.random.default_rng(3)
+    sids = np.arange(ns, dtype=np.int64)
+    offsets = np.linspace(0, rows, ns + 1).astype(np.int64)
+    times = np.arange(rows, dtype=np.int64) * 1000
+    fields = {"v": rng.random(rows),
+              "c": rng.integers(0, 99, rows).astype(np.int64)}
+    return "cpu", sids, offsets, times, fields
+
+
+# ------------------------------------------------ WAL scatter-gather
+
+class TestWalScatterGather:
+    def test_parts_join_equals_pack(self):
+        args = _bulk_args()
+        assert b"".join(_pack_cols_bulk_parts(*args)) == \
+            _pack_cols_bulk(*args)
+
+    @pytest.mark.parametrize("compression", ["none", "zstd", "lz4"])
+    def test_bulk_roundtrip_every_codec(self, tmp_path, compression):
+        mst, sids, offsets, times, fields = _bulk_args()
+        w = WAL(str(tmp_path), sync=False, compression=compression)
+        w.write_cols_bulk(mst, sids, offsets, times, fields)
+        w.close()
+        w2 = WAL(str(tmp_path), sync=False, compression=compression)
+        ((kind, payload),) = list(w2.replay())
+        w2.close()
+        assert kind == "colsb"
+        m2, s2, o2, t2, f2 = payload
+        assert m2 == mst
+        np.testing.assert_array_equal(s2, sids)
+        np.testing.assert_array_equal(o2, offsets)
+        np.testing.assert_array_equal(t2, times)
+        np.testing.assert_array_equal(f2["v"], fields["v"])
+        np.testing.assert_array_equal(f2["c"], fields["c"])
+
+    def test_none_codec_frame_bytes_identical_to_joined(self, tmp_path):
+        """The scatter-gather emit must write the SAME bytes as the
+        joined-frame emit — the frame format is a replay contract."""
+        import os
+        import struct
+        import zlib
+        mst, sids, offsets, times, fields = _bulk_args()
+        w = WAL(str(tmp_path), sync=False, compression="none")
+        w.write_cols_bulk(mst, sids, offsets, times, fields)
+        w.close()
+        fn = [f for f in os.listdir(tmp_path) if f.endswith(".wal")][0]
+        data = (tmp_path / fn).read_bytes()
+        ln, crc = struct.unpack("<II", data[:8])
+        payload = data[8:8 + ln]
+        raw = _pack_cols_bulk(mst, sids, offsets, times, fields)
+        assert payload == struct.pack("<BI", 9, len(raw)) + raw
+        assert zlib.crc32(payload) == crc
+
+
+class TestGroupCommit:
+    def test_concurrent_writers_coalesce_fsyncs(self, tmp_path):
+        knobs.set_env("OG_WAL_GROUP_COMMIT_US", "3000")
+        try:
+            w = WAL(str(tmp_path), sync=True)
+            gc0 = int(WAL_STATS.get("group_commits", 0))
+            n_threads, per = 4, 10
+
+            def writer(k):
+                for i in range(per):
+                    w.write([("m", k * 1000 + i, {"v": 1.0},
+                              (k * per + i) * 10**9)])
+
+            ts = [threading.Thread(target=writer, args=(k,))
+                  for k in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            w.close()
+            fsyncs = int(WAL_STATS.get("group_commits", 0)) - gc0
+            frames = n_threads * per
+            assert 0 < fsyncs < frames, (
+                f"{frames} frames took {fsyncs} fsyncs — group "
+                f"commit is not coalescing")
+            # every acked frame must replay: coalescing never drops
+            w2 = WAL(str(tmp_path), sync=False)
+            replayed = sum(len(b) for b in w2.replay())
+            w2.close()
+            assert replayed == frames
+        finally:
+            knobs.del_env("OG_WAL_GROUP_COMMIT_US")
+
+    def test_defer_sync_requires_wait_durable(self, tmp_path):
+        knobs.set_env("OG_WAL_GROUP_COMMIT_US", "1000")
+        try:
+            w = WAL(str(tmp_path), sync=True)
+            t1 = w.write([("m", 1, {"v": 1.0}, 10**9)], defer_sync=True)
+            t2 = w.write([("m", 2, {"v": 2.0}, 2 * 10**9)],
+                         defer_sync=True)
+            assert t2 > t1
+            w.wait_durable(t2)          # covers t1 too
+            w.wait_durable(t1)          # no-op, already durable
+            w.close()
+        finally:
+            knobs.del_env("OG_WAL_GROUP_COMMIT_US")
+
+
+# ------------------------------------------- columnar grouping parity
+
+class TestColumnarGrouping:
+    def _batch(self, n=4096, null_tags=False):
+        rng = np.random.default_rng(11)
+        hosts = [None if null_tags and i % 7 == 0 else f"h{i % 5}"
+                 for i in rng.integers(0, 5, n)]
+        regions = [f"r{i}" for i in rng.integers(0, 3, n)]
+        return pa.RecordBatch.from_arrays(
+            [pa.array(hosts).dictionary_encode(),
+             pa.array(regions).dictionary_encode(),
+             pa.array((np.arange(n) + 1) * 10**9),
+             pa.array(rng.random(n)),
+             pa.array(rng.integers(0, 50, n))],
+            names=["host", "region", "time", "usage", "count"])
+
+    @pytest.mark.parametrize("null_tags", [False, True])
+    def test_groups_match_row_path(self, null_tags):
+        from opengemini_tpu.services.arrowflight import (batch_to_columns,
+                                                         batch_to_rows)
+        b = self._batch(null_tags=null_tags)
+        groups = batch_to_columns(b, ["host", "region"])
+        rows = batch_to_rows(b, "cpu", ["host", "region"])
+        by_tags = {}
+        for r in rows:
+            by_tags.setdefault(tuple(sorted(r.tags.items())), []).append(
+                (r.time, r.fields["usage"], r.fields["count"]))
+        got = {}
+        for tags, times, fields in groups:
+            got[tuple(sorted(tags.items()))] = list(
+                zip(times.tolist(), fields["usage"].tolist(),
+                    fields["count"].tolist()))
+        assert set(got) == set(by_tags)
+        for k in by_tags:
+            assert got[k] == by_tags[k], f"group {k} diverged"
+
+    def test_tag_key_order_preserved(self):
+        from opengemini_tpu.services.arrowflight import batch_to_columns
+        b = self._batch(n=64)
+        for tags, _t, _f in batch_to_columns(b, ["host", "region"]):
+            assert list(tags) == [k for k in ("host", "region")
+                                  if k in tags]
+
+
+# ------------------------------------- encode-menu pre-selection floor
+
+class TestS8bFloor:
+    def test_floor_never_exceeds_actual(self):
+        rng = np.random.default_rng(5)
+        for _ in range(60):
+            n = int(rng.integers(1, 400))
+            w = int(rng.integers(0, 40))
+            u = rng.integers(0, 1 << w, n, dtype=np.uint64) \
+                if w else np.zeros(n, dtype=np.uint64)
+            if not simple8b.can_encode(u.astype(np.int64)):
+                continue
+            from opengemini_tpu.encoding.bitpack import bit_widths
+            floor = blocks._s8b_floor(bit_widths(u))
+            actual = len(simple8b.encode(u.astype(np.int64)))
+            assert floor <= actual, (n, w, floor, actual)
+
+    def test_preselected_dfor_roundtrips(self):
+        """Decimal-scaled gauges and narrow-delta ints — the shapes
+        pre-selection targets — must decode bit-identically whether
+        or not the shortcut fired."""
+        rng = np.random.default_rng(6)
+        shapes = [
+            np.cumsum(rng.integers(0, 50, 500)).astype(np.int64),
+            (np.arange(700, dtype=np.int64) * 1000) + 10**15,
+            rng.integers(-5, 5, 300).astype(np.int64),
+        ]
+        for v in shapes:
+            enc = blocks.encode_integer_block(v)
+            out = blocks.decode_integer_block(enc, len(v))
+            np.testing.assert_array_equal(out, v)
+
+    def test_preselection_byte_identical_when_disabled(self):
+        """OG_WRITE_DEVICE_LAYOUT off disables the DFOR shortcut; the s8b
+        futile-trial skip must never change encoded bytes."""
+        rng = np.random.default_rng(7)
+        knobs.set_env("OG_WRITE_DEVICE_LAYOUT", "0")
+        try:
+            for _ in range(20):
+                v = rng.integers(0, 1 << int(rng.integers(1, 45)),
+                                 int(rng.integers(2, 600))
+                                 ).astype(np.int64)
+                enc = blocks.encode_integer_block(v)
+                out = blocks.decode_integer_block(enc, len(v))
+                np.testing.assert_array_equal(out, v)
+        finally:
+            knobs.del_env("OG_WRITE_DEVICE_LAYOUT")

@@ -336,9 +336,15 @@ def test_constraint_translation_edges():
 def test_width_edges_parity():
     """Width-0 (all-equal segment) and width-64 (uncompressible
     deltas) both mask correctly against the host ground truth."""
-    # w=0: constant values XOR to ref exactly → T_XORREF, which is
+    # a decimal-scalable constant takes the T_SCALED pre-selection
+    # shortcut (w=0, packed-translatable — no fallback needed)
+    ps = dfor.encode_float(np.full(128, 37.0))
+    tr_s, w_s, _, _, _ = dfor.parse_header(ps)
+    assert w_s == 0 and tr_s == dfor.T_SCALED
+    # w=0 via XOR: a constant NOT on any decimal lattice misses the
+    # scaled shortcut and XORs to ref exactly → T_XORREF, which is
     # not packed-translatable — the f64 fallback mask carries it
-    v0 = np.full(128, 37.0)
+    v0 = np.full(128, np.pi)
     p0 = dfor.encode_float(v0)
     tr, w, ds, n, ref = dfor.parse_header(p0)
     assert w == 0 and tr == dfor.T_XORREF

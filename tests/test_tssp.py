@@ -153,3 +153,66 @@ def test_irregular_times_not_regular_flag(tmp_path):
     out = r.read_series(1)
     assert np.array_equal(out.times, t)
     r.close()
+
+
+# --------------------------------------------- PR 20: flush fast lane
+
+def test_parallel_stream_bytes_identical_to_serial(tmp_path):
+    """write_series_stream with workers appends encoded series in
+    submission order — the on-disk bytes must equal serial
+    write_series calls, or flush output would depend on a knob."""
+    from opengemini_tpu.utils import knobs
+    series = [(sid, make_series_record(50 + sid, t0=sid))
+              for sid in range(1, 41)]
+    p_serial = str(tmp_path / "serial.tssp")
+    w = TSSPWriter(p_serial, segment_size=128)
+    for sid, rec in series:
+        w.write_series(sid, rec)
+    w.finalize()
+    knobs.set_env("OG_ENCODE_WORKERS", "3")
+    knobs.set_env("OG_ENCODE_SERIAL_CUTOFF", "1")
+    try:
+        p_par = str(tmp_path / "parallel.tssp")
+        w2 = TSSPWriter(p_par, segment_size=128)
+        w2.write_series_stream(iter(series))
+        w2.finalize()
+    finally:
+        knobs.del_env("OG_ENCODE_WORKERS")
+        knobs.del_env("OG_ENCODE_SERIAL_CUTOFF")
+    with open(p_serial, "rb") as a, open(p_par, "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_serial_cutoff_small_flush_stays_serial(tmp_path):
+    """A flush at or under OG_ENCODE_SERIAL_CUTOFF series must produce
+    the same bytes through the serial peek (no pool spin-up)."""
+    from opengemini_tpu.utils import knobs
+    series = [(sid, make_series_record(30)) for sid in range(1, 5)]
+    outs = []
+    for name, workers in (("a.tssp", "0"), ("b.tssp", "4")):
+        knobs.set_env("OG_ENCODE_WORKERS", workers)
+        try:
+            p = str(tmp_path / name)
+            w = TSSPWriter(p, segment_size=256)
+            w.write_series_stream(iter(series))   # 4 <= cutoff (32)
+            w.finalize()
+            outs.append(open(p, "rb").read())
+        finally:
+            knobs.del_env("OG_ENCODE_WORKERS")
+    assert outs[0] == outs[1]
+
+
+def test_payload_view_is_mmap_window(tmp_path):
+    """payload_view hands scan stages a memoryview straight over the
+    file mmap (zero staging copy) that matches the file bytes."""
+    rec = make_series_record(400)
+    path = write_file(tmp_path, [(3, rec)], seg_size=128)
+    raw = open(path, "rb").read()
+    r = TSSPReader(path)
+    cm = r.chunk_meta(3)
+    for seg in cm.column("usage_user").segments:
+        mv = r.payload_view(seg)
+        assert isinstance(mv, memoryview)
+        assert bytes(mv) == raw[seg.offset:seg.offset + seg.size]
+        del mv            # release before close() unmaps
+    r.close()
